@@ -1,0 +1,152 @@
+"""Density benchmark: scheduler_perf analog on real TPU.
+
+Reference harness: test/integration/scheduler_perf/scheduler_test.go — 100
+nodes x 3k pods with an enforced minimum of 30 pods/s and a warning threshold
+of 100 pods/s (scheduler_test.go:34-38).  The north star (BASELINE.json) is
+>=10k pods/s on a 5k-node snapshot with full predicate parity, single v5e-1.
+
+This benchmark builds a 5k-node cluster (20 deployments behind services, so
+resource fit + spreading + zone blending + taints/selector paths are all
+live), then schedules 10k pods through the sequential-commit device program in
+batches, chaining device-resident cluster state between batches (requested /
+nonzero / spread counts never leave HBM) while the host performs the
+cache-commit bookkeeping for every placement.
+
+Prints ONE JSON line: pods scheduled per second, vs_baseline = value / 30
+(the reference's enforced minimum).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=5000)
+    ap.add_argument("--pods", type=int, default=10000)
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--warmup", type=int, default=1, help="warmup batches (compile)")
+    ap.add_argument(
+        "--platform",
+        default=None,
+        help="force a jax platform (e.g. cpu); default = environment (TPU)",
+    )
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from tests.fixtures import make_node, make_pod
+    from kubernetes_tpu.codec import SnapshotEncoder
+    from kubernetes_tpu.models.batched import (
+        encode_batch_ports,
+        make_sequential_scheduler,
+    )
+
+    zone = "failure-domain.beta.kubernetes.io/zone"
+    enc = SnapshotEncoder()
+    t0 = time.monotonic()
+    for i in range(args.nodes):
+        enc.add_node(
+            make_node(
+                f"node-{i}",
+                cpu="32",
+                mem="256Gi",
+                pods=110,
+                labels={zone: f"zone-{i % 8}", "tier": "a" if i % 3 else "b"},
+                taints=[{"key": "dedicated", "value": "x", "effect": "NoSchedule"}]
+                if i % 50 == 0
+                else [],
+            )
+        )
+    n_deploy = 20
+    for d in range(n_deploy):
+        enc.add_spread_selector("default", {"app": f"dep-{d}"})
+    t_nodes = time.monotonic() - t0
+
+    def pending_pod(i):
+        d = i % n_deploy
+        return make_pod(
+            f"pod-{i}",
+            cpu="100m",
+            mem="256Mi",
+            labels={"app": f"dep-{d}"},
+            node_selector={"tier": "a"} if d % 4 == 0 else None,
+            owner=("ReplicaSet", f"rs-{d}"),
+        )
+
+    fn = make_sequential_scheduler(
+        unsched_taint_key=enc.interner.intern("node.kubernetes.io/unschedulable"),
+        zone_key_id=enc.zone_key,
+    )
+
+    # warmup/compile on one batch shape
+    pods = [pending_pod(i) for i in range(args.batch)]
+    batch = enc.encode_pods(pods)
+    ports = encode_batch_ports(enc, pods, enc.dims.N)
+    cluster = enc.snapshot()
+    for _ in range(args.warmup):
+        hosts, new_cluster = fn(cluster, batch, ports, np.int32(0))
+        jax.block_until_ready(hosts)
+
+    # timed run: chain device state, host does cache-commit bookkeeping
+    import dataclasses
+
+    row_names = {row: name for name, row in enc.node_rows.items()}
+    scheduled = 0
+    unschedulable = 0
+    t0 = time.monotonic()
+    state = cluster
+    last = 0
+    for start in range(0, args.pods, args.batch):
+        pods = [pending_pod(start + j) for j in range(min(args.batch, args.pods - start))]
+        batch = enc.encode_pods(pods)
+        ports = encode_batch_ports(enc, pods, enc.dims.N)
+        hosts, state = fn(state, batch, ports, np.int32(last))
+        last += len(pods)
+        hosts = np.asarray(hosts)
+        # host-side cache commit (assume/confirm bookkeeping)
+        for j, pod in enumerate(pods):
+            r = int(hosts[j])
+            if r < 0:
+                unschedulable += 1
+                continue
+            committed = dataclasses.replace(
+                pod, spec=dataclasses.replace(pod.spec, node_name=row_names[r])
+            )
+            enc.add_pod(committed)
+            scheduled += 1
+    jax.block_until_ready(state.requested)
+    dt = time.monotonic() - t0
+
+    pods_per_s = scheduled / dt if dt > 0 else 0.0
+    result = {
+        "metric": "pods_scheduled_per_sec_5k_nodes",
+        "value": round(pods_per_s, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(pods_per_s / 30.0, 2),
+        "detail": {
+            "nodes": args.nodes,
+            "pods_scheduled": scheduled,
+            "unschedulable": unschedulable,
+            "batch": args.batch,
+            "seconds": round(dt, 3),
+            "node_encode_seconds": round(t_nodes, 3),
+            "device": str(jax.devices()[0]),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

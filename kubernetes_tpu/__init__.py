@@ -1,0 +1,37 @@
+"""kubernetes_tpu — a TPU-native cluster-scheduling framework.
+
+A from-scratch re-design of the capabilities of Kubernetes' kube-scheduler
+(reference: Silveryfu/kubernetes, ~v1.15) for TPU hardware.  Instead of a
+16-goroutine per-pod scan over nodes (ref pkg/scheduler/core/generic_scheduler.go:518),
+cluster state is encoded as device-resident columnar tensors and the whole
+Filter/Score pipeline runs as vmapped JAX/XLA kernels emitting a pods x nodes
+feasibility mask and score matrix in a single launch.
+
+Layer map (mirrors SURVEY.md section 1, re-designed TPU-first):
+
+  api/        object model: Pod, Node, quantities, label selectors
+              (ref staging/src/k8s.io/api + pkg/apis/core/types.go)
+  codec/      tensor schema + snapshot encoder: the device mirror of
+              NodeInfo / NodeInfoSnapshot (ref pkg/scheduler/nodeinfo/node_info.go:47-148,
+              pkg/scheduler/internal/cache/interface.go:125-128)
+  ops/        the compute kernels: predicates (Filter), priorities (Score),
+              host selection (ref pkg/scheduler/algorithm/{predicates,priorities})
+  models/     scheduling algorithms composed from ops: one-pod generic
+              schedule, batched scan-commit, preemption, gang
+              (ref pkg/scheduler/core/generic_scheduler.go)
+  parallel/   device-mesh sharding of the node axis (pjit / shard_map / ICI
+              collectives) — the TPU-native analog of the reference's
+              goroutine parallelism and of multi-host scale-out
+  runtime/    host-side control loop: scheduling queue, cache with
+              assume/confirm/expire, event handlers, scheduleOne
+              (ref pkg/scheduler/scheduler.go, internal/{queue,cache})
+  extender/   the out-of-process seam: HTTP extender protocol server so a
+              stock Go kube-scheduler can offload Filter/Score to this
+              framework (ref pkg/scheduler/core/extender.go)
+  cpuref/     pure-numpy golden implementation of every kernel, used by the
+              parity test-suite (the analog of the reference's table-driven
+              predicate/priority unit tests)
+  utils/      tracing spans, metrics histograms, feature gates
+"""
+
+__version__ = "0.1.0"

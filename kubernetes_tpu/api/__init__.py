@@ -1,0 +1,39 @@
+"""Object model: the minimal, scheduler-relevant slice of the Kubernetes API.
+
+Reference: staging/src/k8s.io/api/core/v1/types.go and pkg/apis/core/types.go.
+Only the fields the scheduling pipeline reads are modeled; everything is a
+plain frozen-ish dataclass with a `from_dict` codec accepting the familiar
+Kubernetes JSON/YAML shapes.
+"""
+
+from kubernetes_tpu.api.resource import Quantity, parse_quantity
+from kubernetes_tpu.api.labels import (
+    Requirement,
+    Selector,
+    selector_from_label_selector,
+    selector_from_match_labels,
+)
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Container,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    PodStatus,
+    NodeStatus,
+    NodeSpec,
+    ContainerImage,
+    ContainerPort,
+    Taint,
+    Toleration,
+    WeightedPodAffinityTerm,
+    PreferredSchedulingTerm,
+)

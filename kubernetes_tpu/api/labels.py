@@ -1,0 +1,96 @@
+"""Label selectors.
+
+Reference: staging/src/k8s.io/apimachinery/pkg/labels (Requirement/Selector)
+and staging/src/k8s.io/apimachinery/pkg/apis/meta/v1/types.go (LabelSelector
+with MatchLabels + MatchExpressions).  Operators: In, NotIn, Exists,
+DoesNotExist, Gt, Lt — the same set node-affinity terms use
+(pkg/apis/core/types.go NodeSelectorOperator).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence
+
+IN = "In"
+NOT_IN = "NotIn"
+EXISTS = "Exists"
+DOES_NOT_EXIST = "DoesNotExist"
+GT = "Gt"
+LT = "Lt"
+
+OPERATORS = (IN, NOT_IN, EXISTS, DOES_NOT_EXIST, GT, LT)
+
+
+@dataclass(frozen=True)
+class Requirement:
+    key: str
+    operator: str
+    values: tuple = ()
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        has = self.key in labels
+        if self.operator == IN:
+            return has and labels[self.key] in self.values
+        if self.operator == NOT_IN:
+            # ref labels.Requirement.Matches: NotIn matches when the key is
+            # absent OR the value is not in the set.
+            return not has or labels[self.key] not in self.values
+        if self.operator == EXISTS:
+            return has
+        if self.operator == DOES_NOT_EXIST:
+            return not has
+        if self.operator in (GT, LT):
+            if not has:
+                return False
+            try:
+                lhs = int(labels[self.key])
+                rhs = int(self.values[0])
+            except (ValueError, IndexError):
+                return False
+            return lhs > rhs if self.operator == GT else lhs < rhs
+        raise ValueError(f"unknown operator {self.operator!r}")
+
+
+@dataclass(frozen=True)
+class Selector:
+    """Conjunction of requirements. Empty selector matches everything;
+    a None selector (absent) matches nothing — mirroring
+    metav1.LabelSelectorAsSelector semantics."""
+
+    requirements: tuple = ()
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        return all(r.matches(labels) for r in self.requirements)
+
+    @property
+    def keys(self) -> List[str]:
+        return [r.key for r in self.requirements]
+
+
+def selector_from_match_labels(match_labels: Mapping[str, str]) -> Selector:
+    """A plain map selector (Service.spec.selector, RC.spec.selector)."""
+    return Selector(
+        tuple(Requirement(k, IN, (v,)) for k, v in sorted(match_labels.items()))
+    )
+
+
+def selector_from_label_selector(ls: Optional[dict]) -> Optional[Selector]:
+    """metav1.LabelSelector {matchLabels, matchExpressions} -> Selector.
+
+    Returns None for a None input (matches nothing), and an empty Selector for
+    an empty LabelSelector (matches everything) — ref
+    apimachinery/pkg/apis/meta/v1/helpers.go LabelSelectorAsSelector.
+    """
+    if ls is None:
+        return None
+    reqs: List[Requirement] = []
+    for k, v in sorted((ls.get("matchLabels") or {}).items()):
+        reqs.append(Requirement(k, IN, (v,)))
+    for expr in ls.get("matchExpressions") or []:
+        reqs.append(
+            Requirement(
+                expr["key"], expr["operator"], tuple(expr.get("values") or ())
+            )
+        )
+    return Selector(tuple(reqs))

@@ -1,0 +1,23 @@
+"""Tensor schema + snapshot codec: the device mirror of the scheduler cache.
+
+This is the TPU-native redesign of `NodeInfo` / `NodeInfoSnapshot`
+(ref pkg/scheduler/nodeinfo/node_info.go:47-148,
+pkg/scheduler/internal/cache/interface.go:125-128): instead of a map of
+per-node structs, cluster state is a struct-of-arrays over the node axis, with
+all strings interned to int32 ids on the host so every predicate/priority
+becomes pure integer/float tensor math on device.
+"""
+
+from kubernetes_tpu.codec.interner import Interner
+from kubernetes_tpu.codec.schema import (
+    ClusterTensors,
+    PodBatch,
+    PadDims,
+    EFFECT_CODES,
+    TOL_OP_CODES,
+    SEL_OP_CODES,
+    FIELD_NODE_NAME,
+    PAD,
+    WILDCARD,
+)
+from kubernetes_tpu.codec.encoder import SnapshotEncoder

@@ -1,0 +1,1237 @@
+"""Incremental snapshot encoder: API objects -> device tensors.
+
+The TPU-native redesign of the scheduler cache's snapshot path
+(ref pkg/scheduler/internal/cache/cache.go:210-222 UpdateNodeInfoSnapshot):
+node and pod mutations update numpy arenas in place (the analog of the
+generation-numbered NodeInfo list), and `snapshot()` emits a `ClusterTensors`
+copy tagged with a generation counter.  String work (label interning, selector
+matching against existing pods) happens here, vectorized over numpy columns,
+so the device kernels see only integer ids — the tensorization of
+predicateMetadata's topologyPairsMaps (algorithm/predicates/metadata.go:64-94).
+
+Inter-pod-affinity bookkeeping: existing pods' (anti-)affinity terms are
+grouped by signature (selector, namespaces, topologyKey, kind, weight) — pods
+stamped out by one controller share one group — and each group maintains a
+per-topology-pair member count.  Encoding an incoming pod evaluates each
+group's selector against that one pod (cheap) instead of scanning every
+existing pod (the same asymptotic trick as the reference's metadata maps).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api import labels as klabels
+from kubernetes_tpu.api.types import (
+    DEFAULT_MEMORY_REQUEST,
+    DEFAULT_MILLI_CPU_REQUEST,
+    Node,
+    Pod,
+    PodAffinityTerm,
+    RESOURCE_CPU,
+    RESOURCE_EPHEMERAL_STORAGE,
+    RESOURCE_MEMORY,
+    RESOURCE_PODS,
+)
+from kubernetes_tpu.codec.interner import Interner
+from kubernetes_tpu.codec.schema import (
+    ClusterTensors,
+    EFFECT_CODES,
+    FIELD_NODE_NAME,
+    NUM_VOL_TYPES,
+    PAD,
+    PadDims,
+    PodBatch,
+    RES_EPHEMERAL,
+    RES_EXT0,
+    RES_MEMORY,
+    RES_MILLICPU,
+    RES_PODS,
+    SEL_OP_CODES,
+    TOL_OP_CODES,
+    VOL_AZURE,
+    VOL_CINDER,
+    VOL_CSI,
+    VOL_EBS,
+    VOL_GCE,
+    _pow2,
+)
+
+HOSTNAME_KEY = "kubernetes.io/hostname"
+ZONE_KEY = "failure-domain.beta.kubernetes.io/zone"
+REGION_KEY = "failure-domain.beta.kubernetes.io/region"
+
+# kinds of existing-pod affinity term groups
+K_ANTI_REQ, K_ANTI_PREF, K_AFF_REQ, K_AFF_PREF = 0, 1, 2, 3
+
+
+def _sel_requirements(raw_selector: Optional[dict]) -> Optional[klabels.Selector]:
+    return klabels.selector_from_label_selector(raw_selector)
+
+
+@dataclass
+class _TermGroup:
+    """One distinct (anti-)affinity term shared by many existing pods."""
+
+    kind: int
+    topo_key_id: int
+    namespaces: frozenset            # namespace strings
+    selector: klabels.Selector
+    weight: float
+    pair_counts: np.ndarray          # f32[TP-cap] matching member pods per topology pair
+    members: int = 0
+
+
+@dataclass
+class _PodRecord:
+    key: Tuple[str, str]
+    labels: Dict[str, str]
+    ns: str
+    node_row: int                    # -1 unassigned
+    m: int                           # pod-arena index
+    req: np.ndarray                  # f32[R-cap]
+    nonzero: np.ndarray              # f32[2]
+    ports: List[Tuple[int, int]]     # (proto/port id, ip id)
+    disk_vols: List[int]
+    vol_counts: np.ndarray           # f32[NUM_VOL_TYPES]
+    priority: int = 0
+    group_refs: List[Tuple] = field(default_factory=list)  # term-group signatures
+
+
+class SnapshotEncoder:
+    def __init__(self, dims: Optional[PadDims] = None,
+                 hard_pod_affinity_weight: float = 1.0):
+        self.dims = dims or PadDims()
+        self.interner = Interner()
+        self.generation = 0
+        # HardPodAffinitySymmetricWeight (ref apis/config/types.go, default 1)
+        self.hard_pod_affinity_weight = hard_pod_affinity_weight
+
+        self._field_node_name = self.interner.intern(FIELD_NODE_NAME)
+        assert self._field_node_name == 1, "FIELD_NODE_NAME_ID contract"
+        self.hostname_key = self.interner.intern(HOSTNAME_KEY)
+        self.zone_key = self.interner.intern(ZONE_KEY)
+        self.region_key = self.interner.intern(REGION_KEY)
+        self.topo_keys: Set[int] = {self.hostname_key, self.zone_key, self.region_key}
+
+        # topology-pair vocabulary
+        self._pair_vocab: Dict[Tuple[int, int], int] = {}
+        self._pair_topo_key: List[int] = []
+
+        # resource columns beyond the core four
+        self._res_cols: Dict[str, int] = {}
+
+        # ---- node arena ----
+        self._cap_n = self.dims.N
+        self.node_rows: Dict[str, int] = {}
+        self._row_node: Dict[int, Node] = {}
+        self._free_rows: List[int] = []
+        self._next_row = 0
+        self._row_pods: Dict[int, Set[Tuple[str, str]]] = {}
+        self._node_ports: Dict[int, Counter] = {}
+        self._node_disk_vols: Dict[int, Counter] = {}
+        self._alloc_node_arena()
+
+        # ---- existing-pod arena (vectorized selector matching) ----
+        self._cap_m = 64
+        self.pods: Dict[Tuple[str, str], _PodRecord] = {}
+        self._free_m: List[int] = []
+        self._next_m = 0
+        self.p_alive = np.zeros(self._cap_m, dtype=bool)
+        self.p_ns = np.full(self._cap_m, PAD, dtype=np.int32)
+        self.p_node = np.full(self._cap_m, PAD, dtype=np.int32)
+        self._label_cols: Dict[int, np.ndarray] = {}
+
+        # affinity term groups of existing pods
+        self.term_groups: Dict[Tuple, _TermGroup] = {}
+
+        # spreading groups (services / RCs / RSs / StatefulSets)
+        # ref priorities/selector_spreading.go getSelectors
+        self._spread: List[Tuple[str, klabels.Selector]] = []  # (namespace, selector)
+
+        # image -> number of nodes having it (for ImageLocality spread scaling,
+        # ref priorities/image_locality.go scaledImageScore)
+        self._image_nodes: Counter = Counter()
+
+        # template-row cache for encode_pods: pods stamped out by one
+        # controller share an identical spec, so their encoded batch row is
+        # identical.  Keyed by content; invalidated when the spread-group
+        # registry or pad dims change.  Pods with (anti-)affinity are never
+        # cached (their pair tensors depend on current cluster state).
+        self._pod_row_cache: Dict[Tuple, Dict[str, np.ndarray]] = {}
+        self._pod_cache_token: Tuple = ()
+
+    # ------------------------------------------------------------------ arena
+
+    def _alloc_node_arena(self) -> None:
+        d, n = self.dims, self._cap_n
+        f32 = np.float32
+        i32 = np.int32
+        self.a_allocatable = np.zeros((n, d.R), f32)
+        self.a_requested = np.zeros((n, d.R), f32)
+        self.a_nonzero = np.zeros((n, 2), f32)
+        self.a_valid = np.zeros(n, bool)
+        self.a_unsched = np.zeros(n, bool)
+        self.a_notready = np.zeros(n, bool)
+        self.a_mempress = np.zeros(n, bool)
+        self.a_diskpress = np.zeros(n, bool)
+        self.a_pidpress = np.zeros(n, bool)
+        self.a_name = np.full(n, PAD, i32)
+        self.a_lkeys = np.full((n, d.L), PAD, i32)
+        self.a_lvals = np.full((n, d.L), PAD, i32)
+        self.a_lnums = np.full((n, d.L), np.nan, f32)
+        self.a_tkey = np.full((n, d.T), PAD, i32)
+        self.a_tval = np.full((n, d.T), PAD, i32)
+        self.a_teff = np.full((n, d.T), PAD, i32)
+        self.a_ppp = np.full((n, d.P), PAD, i32)
+        self.a_pip = np.full((n, d.P), PAD, i32)
+        self.a_pused = np.zeros((n, d.P), bool)
+        self.a_topo = np.zeros((n, self.dims.TP), bool)
+        self.a_zone = np.full(n, PAD, i32)
+        self.a_img_id = np.full((n, d.I), PAD, i32)
+        self.a_img_sz = np.zeros((n, d.I), f32)
+        self.a_avoid = np.full((n, d.A), PAD, i32)
+        self.a_volcnt = np.zeros((n, NUM_VOL_TYPES), f32)
+        self.a_dvol = np.full((n, d.DVN), PAD, i32)
+        # per-topo-key per-node value/pair id (host-side helper columns)
+        self._node_pair_id: Dict[int, np.ndarray] = {
+            k: np.full(n, PAD, i32) for k in self.topo_keys
+        }
+
+    def _grow_nodes(self) -> None:
+        old = self._cap_n
+        self.dims = dataclasses.replace(self.dims, N=old * 2)
+        self._regrow_node_arena(old)
+
+    def _regrow_node_arena(self, old_cap: int) -> None:
+        """Retile the node arena (bigger N or wider pad dims), preserving the
+        overlapping region."""
+        names = [a for a in dir(self) if a.startswith("a_")]
+        keep = {a: getattr(self, a) for a in names}
+        keep_pair = self._node_pair_id
+        self._cap_n = self.dims.N
+        self._alloc_node_arena()
+        for a, src in keep.items():
+            new = getattr(self, a)
+            sl = tuple(slice(0, min(s, ns)) for s, ns in zip(src.shape, new.shape))
+            new[sl] = src[sl]
+        for k, col in keep_pair.items():
+            if k in self._node_pair_id:
+                n = min(old_cap, self._cap_n)
+                self._node_pair_id[k][:n] = col[:n]
+
+    def _grow_pods(self) -> None:
+        old = self._cap_m
+        self._cap_m *= 2
+        for name in ("p_alive", "p_ns", "p_node"):
+            src = getattr(self, name)
+            new = np.full(self._cap_m, False if src.dtype == bool else PAD, src.dtype)
+            new[:old] = src
+            setattr(self, name, new)
+        for k, col in list(self._label_cols.items()):
+            new = np.full(self._cap_m, PAD, np.int32)
+            new[:old] = col
+            self._label_cols[k] = new
+
+    def _grow_pairs(self) -> None:
+        """Topology-pair vocabulary outgrew TP: double it."""
+        self.dims = dataclasses.replace(self.dims, TP=self.dims.TP * 2)
+        new = np.zeros((self._cap_n, self.dims.TP), bool)
+        new[:, : self.a_topo.shape[1]] = self.a_topo
+        self.a_topo = new
+        for g in self.term_groups.values():
+            nc = np.zeros(self.dims.TP, np.float32)
+            nc[: g.pair_counts.shape[0]] = g.pair_counts
+            g.pair_counts = nc
+
+    # ------------------------------------------------------------- vocabulary
+
+    def _pair_id(self, key_id: int, val_id: int) -> int:
+        pid = self._pair_vocab.get((key_id, val_id))
+        if pid is None:
+            pid = len(self._pair_topo_key)
+            self._pair_vocab[(key_id, val_id)] = pid
+            self._pair_topo_key.append(key_id)
+            if pid >= self.dims.TP:
+                self._grow_pairs()
+        return pid
+
+    def register_topology_key(self, key: str) -> int:
+        """Ensure `key` is tracked as a topology key; backfill existing nodes."""
+        kid = self.interner.intern(key)
+        if kid in self.topo_keys:
+            return kid
+        self.topo_keys.add(kid)
+        self._node_pair_id[kid] = np.full(self._cap_n, PAD, np.int32)
+        for name, row in self.node_rows.items():
+            node = self._row_node[row]
+            val = node.labels.get(key)
+            if val is not None:
+                pid = self._pair_id(kid, self.interner.intern(val))
+                self.a_topo[row, pid] = True
+                self._node_pair_id[kid][row] = pid
+        return kid
+
+    def _res_col(self, name: str) -> int:
+        if name == RESOURCE_CPU:
+            return RES_MILLICPU
+        if name == RESOURCE_MEMORY:
+            return RES_MEMORY
+        if name == RESOURCE_EPHEMERAL_STORAGE:
+            return RES_EPHEMERAL
+        if name == RESOURCE_PODS:
+            return RES_PODS
+        col = self._res_cols.get(name)
+        if col is None:
+            col = RES_EXT0 + len(self._res_cols)
+            if col >= self.dims.R:
+                old = self.dims.R
+                self.dims = dataclasses.replace(self.dims, R=_pow2(col + 1))
+                for attr in ("a_allocatable", "a_requested"):
+                    src = getattr(self, attr)
+                    new = np.zeros((self._cap_n, self.dims.R), np.float32)
+                    new[:, :old] = src
+                    setattr(self, attr, new)
+                for rec in self.pods.values():
+                    r = np.zeros(self.dims.R, np.float32)
+                    r[:old] = rec.req
+                    rec.req = r
+            self._res_cols[name] = col
+        return col
+
+    def _req_vector(self, requests: Dict) -> np.ndarray:
+        v = np.zeros(self.dims.R, np.float32)
+        for name, q in requests.items():
+            col = self._res_col(name)
+            v[col] = q.milli if name == RESOURCE_CPU else float(q)
+        v[RES_PODS] = 1.0
+        return v
+
+    # ----------------------------------------------------------------- nodes
+
+    def add_node(self, node: Node) -> int:
+        if node.name in self.node_rows:
+            return self.update_node(node)
+        if self._free_rows:
+            row = self._free_rows.pop()
+        else:
+            row = self._next_row
+            self._next_row += 1
+            while row >= self._cap_n:
+                self._grow_nodes()
+        self.node_rows[node.name] = row
+        self._node_ports[row] = Counter()
+        self._node_disk_vols[row] = Counter()
+        self._write_node_row(row, node)
+        self.generation += 1
+        return row
+
+    def update_node(self, node: Node) -> int:
+        row = self.node_rows[node.name]
+        old = self._row_node.get(row)
+        if old is not None:
+            for img in old.status.images:
+                if img.names:
+                    self._image_nodes[img.names[0]] -= 1
+        # topology labels may change: lift resident pods' pair contributions
+        # off the old pairs, rewrite the row, then re-apply on the new pairs
+        resident = [
+            self.pods[key] for key in self._row_pods.get(row, ()) if key in self.pods
+        ]
+        for rec in resident:
+            self._shift_pod_pairs(rec, add=False)
+        self._write_node_row(row, node)
+        for rec in resident:
+            self._shift_pod_pairs(rec, add=True)
+        self.generation += 1
+        return row
+
+    def remove_node(self, name: str) -> None:
+        row = self.node_rows.pop(name)
+        node = self._row_node.pop(row, None)
+        if node is not None:
+            for img in node.status.images:
+                if img.names:
+                    self._image_nodes[img.names[0]] -= 1
+        # detach pods still charged to this row (the informer's pod deletes
+        # arrive separately, ref cache.go RemoveNode keeps pod entries):
+        # their term-group pair contributions and arena links must not leak
+        # into whichever node reuses the row.  Group *membership* stays (the
+        # pod still exists); only the per-pair placement contribution goes.
+        for key in list(self._row_pods.get(row, ())):
+            rec = self.pods.get(key)
+            if rec is None:
+                continue
+            self._shift_pod_pairs(rec, add=False)
+            rec.node_row = -1
+            self.p_node[rec.m] = PAD
+        self._row_pods.pop(row, None)
+        # zero the aggregates so row reuse starts clean
+        self.a_requested[row, :] = 0.0
+        self.a_nonzero[row, :] = 0.0
+        self.a_volcnt[row, :] = 0.0
+        self._node_ports[row] = Counter()
+        self._node_disk_vols[row] = Counter()
+        self._rebuild_node_ports(row)
+        self._rebuild_node_vols(row)
+        self.a_valid[row] = False
+        self.a_topo[row, :] = False
+        for col in self._node_pair_id.values():
+            col[row] = PAD
+        self._free_rows.append(row)
+        self.generation += 1
+
+    def _write_node_row(self, row: int, node: Node) -> None:
+        d = self.dims
+        it = self.interner
+        self._row_node[row] = node
+        # pad-dim growth checks
+        grow = {}
+        if len(node.labels) > d.L:
+            grow["L"] = len(node.labels)
+        if len(node.spec.taints) > d.T:
+            grow["T"] = len(node.spec.taints)
+        if len(node.status.images) > d.I:
+            grow["I"] = len(node.status.images)
+        if grow:
+            self.dims = self.dims.bump(**grow)
+            self._regrow_node_arena(self._cap_n)
+            self._reapply_pods_to_arena()
+        self.a_valid[row] = True
+        self.a_name[row] = it.intern(node.name)
+        self.a_unsched[row] = node.spec.unschedulable
+        cond = node.status.conditions
+        # ref predicates.go CheckNodeConditionPredicate: Ready!=True,
+        # OutOfDisk==True, or NetworkUnavailable==True fail the node
+        self.a_notready[row] = (
+            cond.get("Ready", "True") != "True"
+            or cond.get("OutOfDisk", "False") == "True"
+            or cond.get("NetworkUnavailable", "False") == "True"
+        )
+        self.a_mempress[row] = cond.get("MemoryPressure", "False") == "True"
+        self.a_diskpress[row] = cond.get("DiskPressure", "False") == "True"
+        self.a_pidpress[row] = cond.get("PIDPressure", "False") == "True"
+        # allocatable
+        self.a_allocatable[row, :] = 0.0
+        for name, q in node.status.allocatable.items():
+            col = self._res_col(name)
+            self.a_allocatable[row, col] = (
+                q.milli if name == RESOURCE_CPU else float(q)
+            )
+        # labels
+        self.a_lkeys[row, :] = PAD
+        self.a_lvals[row, :] = PAD
+        self.a_lnums[row, :] = np.nan
+        for j, (k, v) in enumerate(sorted(node.labels.items())):
+            self.a_lkeys[row, j] = it.intern(k)
+            self.a_lvals[row, j] = it.intern(v)
+            try:
+                self.a_lnums[row, j] = float(int(v))
+            except ValueError:
+                pass
+        # taints
+        self.a_tkey[row, :] = PAD
+        self.a_tval[row, :] = PAD
+        self.a_teff[row, :] = PAD
+        for j, t in enumerate(node.spec.taints):
+            self.a_tkey[row, j] = it.intern(t.key)
+            self.a_tval[row, j] = it.intern(t.value)
+            self.a_teff[row, j] = EFFECT_CODES.get(t.effect, 0)
+        # topology pairs
+        self.a_topo[row, :] = False
+        for kid in self.topo_keys:
+            key = it.string(kid)
+            val = node.labels.get(key)
+            col = self._node_pair_id[kid]
+            if val is not None:
+                pid = self._pair_id(kid, it.intern(val))
+                self.a_topo[row, pid] = True
+                col[row] = pid
+            else:
+                col[row] = PAD
+        zone = node.labels.get(ZONE_KEY)
+        self.a_zone[row] = it.intern(zone) if zone is not None else PAD
+        # images
+        self.a_img_id[row, :] = PAD
+        self.a_img_sz[row, :] = 0.0
+        for j, img in enumerate(node.status.images):
+            if img.names:
+                self.a_img_id[row, j] = it.intern(img.names[0])
+                self.a_img_sz[row, j] = float(img.size_bytes)
+                self._image_nodes[img.names[0]] += 1
+        # prefer-avoid-pods annotation
+        # ref api/v1/pod/util.go GetAvoidPodsFromNodeAnnotations + priorities/
+        # node_prefer_avoid_pods.go: annotation lists controller refs to avoid.
+        self.a_avoid[row, :] = PAD
+        import json
+
+        ann = node.metadata.annotations.get("scheduler.alpha.kubernetes.io/preferAvoidPods")
+        if ann:
+            try:
+                avoid = json.loads(ann)
+                uids = [
+                    e.get("podSignature", {})
+                    .get("podController", {})
+                    .get("uid", "")
+                    for e in avoid.get("preferAvoidPods", [])
+                ]
+                for j, u in enumerate(uids[: d.A]):
+                    if u:
+                        self.a_avoid[row, j] = it.intern(u)
+            except (ValueError, AttributeError):
+                pass
+        self._rebuild_node_ports(row)
+        self._rebuild_node_vols(row)
+
+    def _reapply_pods_to_arena(self) -> None:
+        """After an arena retile, re-accumulate pod aggregates into node rows."""
+        self.a_requested[:, :] = 0.0
+        self.a_nonzero[:, :] = 0.0
+        for rec in self.pods.values():
+            if rec.node_row >= 0:
+                self.a_requested[rec.node_row, : rec.req.shape[0]] += rec.req
+                self.a_nonzero[rec.node_row] += rec.nonzero
+        for row in self._node_ports:
+            self._rebuild_node_ports(row)
+            self._rebuild_node_vols(row)
+
+    def _rebuild_node_ports(self, row: int) -> None:
+        self.a_ppp[row, :] = PAD
+        self.a_pip[row, :] = PAD
+        self.a_pused[row, :] = False
+        ports = self._node_ports.get(row, Counter())
+        if len(ports) > self.dims.P:
+            self.dims = self.dims.bump(P=len(ports))
+            self._regrow_node_arena(self._cap_n)
+            self._reapply_pods_to_arena()
+            return
+        for j, (pp, ip) in enumerate(sorted(ports)):
+            self.a_ppp[row, j] = pp
+            self.a_pip[row, j] = ip
+            self.a_pused[row, j] = True
+
+    def _rebuild_node_vols(self, row: int) -> None:
+        self.a_dvol[row, :] = PAD
+        vols = self._node_disk_vols.get(row, Counter())
+        if len(vols) > self.dims.DVN:
+            self.dims = self.dims.bump(DVN=len(vols))
+            self._regrow_node_arena(self._cap_n)
+            self._reapply_pods_to_arena()
+            return
+        for j, v in enumerate(sorted(vols)):
+            self.a_dvol[row, j] = v
+
+    # ------------------------------------------------------------------ pods
+
+    def _pod_ports(self, pod: Pod) -> List[Tuple[int, int]]:
+        out = []
+        for p in pod.host_ports():
+            pp = self.interner.intern(f"{p.protocol or 'TCP'}/{p.host_port}")
+            ip = p.host_ip
+            if ip in ("", "0.0.0.0"):
+                ipid = 0
+            else:
+                ipid = self.interner.intern(ip)
+            out.append((pp, ipid))
+        return out
+
+    def _pod_vols(self, pod: Pod) -> Tuple[List[int], np.ndarray]:
+        """(exclusive disk-conflict volume ids, per-filter-type new volume counts).
+
+        ref predicates.go NoDiskConflict (GCE PD / AWS EBS / RBD / ISCSI) and
+        MaxVolumeCount filters.  PVC indirection is resolved by the caller's
+        store in a later round; direct volumes are handled here.
+        """
+        disk: List[int] = []
+        counts = np.zeros(NUM_VOL_TYPES, np.float32)
+        for v in getattr(pod.spec, "volumes", ()) or ():
+            if "gcePersistentDisk" in v:
+                disk.append(self.interner.intern("gce/" + v["gcePersistentDisk"].get("pdName", "")))
+                counts[VOL_GCE] += 1
+            elif "awsElasticBlockStore" in v:
+                disk.append(self.interner.intern("ebs/" + v["awsElasticBlockStore"].get("volumeID", "")))
+                counts[VOL_EBS] += 1
+            elif "rbd" in v:
+                r = v["rbd"]
+                disk.append(
+                    self.interner.intern(
+                        "rbd/%s/%s/%s" % (",".join(r.get("monitors", [])), r.get("pool", "rbd"), r.get("image", ""))
+                    )
+                )
+            elif "iscsi" in v:
+                r = v["iscsi"]
+                disk.append(
+                    self.interner.intern("iscsi/%s/%s/%s" % (r.get("targetPortal", ""), r.get("iqn", ""), r.get("lun", 0)))
+                )
+            elif "azureDisk" in v:
+                counts[VOL_AZURE] += 1
+            elif "cinder" in v:
+                counts[VOL_CINDER] += 1
+        return disk, counts
+
+    def _nonzero(self, pod: Pod) -> np.ndarray:
+        cpu = 0.0
+        mem = 0.0
+        for c in pod.spec.containers:
+            cpu += (
+                c.requests[RESOURCE_CPU].milli
+                if RESOURCE_CPU in c.requests
+                else DEFAULT_MILLI_CPU_REQUEST
+            )
+            mem += (
+                float(c.requests[RESOURCE_MEMORY])
+                if RESOURCE_MEMORY in c.requests
+                else DEFAULT_MEMORY_REQUEST
+            )
+        return np.array([cpu, mem], np.float32)
+
+    def add_pod(self, pod: Pod) -> None:
+        """Add an assigned (or assumed) pod: accumulate into its node's row and
+        the vectorized pod index (ref internal/cache/cache.go AddPod/AssumePod)."""
+        key = (pod.namespace, pod.name)
+        if key in self.pods:
+            self.remove_pod(pod)
+        if self._free_m:
+            m = self._free_m.pop()
+        else:
+            m = self._next_m
+            self._next_m += 1
+            if m >= self._cap_m:
+                self._grow_pods()
+        node_row = self.node_rows.get(pod.spec.node_name, -1)
+        req = self._req_vector(pod.resource_request())
+        nonzero = self._nonzero(pod)
+        ports = self._pod_ports(pod)
+        disk, vcounts = self._pod_vols(pod)
+        rec = _PodRecord(
+            key=key,
+            labels=dict(pod.labels),
+            ns=pod.namespace,
+            node_row=node_row,
+            m=m,
+            req=req,
+            nonzero=nonzero,
+            ports=ports,
+            disk_vols=disk,
+            vol_counts=vcounts,
+            priority=pod.spec.priority,
+        )
+        self.pods[key] = rec
+        self.p_alive[m] = True
+        self.p_ns[m] = self.interner.intern(pod.namespace)
+        self.p_node[m] = node_row
+        for k, v in pod.labels.items():
+            kid = self.interner.intern(k)
+            col = self._label_cols.get(kid)
+            if col is None:
+                col = np.full(self._cap_m, PAD, np.int32)
+                self._label_cols[kid] = col
+            col[m] = self.interner.intern(v)
+        if node_row >= 0:
+            self._row_pods.setdefault(node_row, set()).add(key)
+            self.a_requested[node_row, : req.shape[0]] += req
+            self.a_nonzero[node_row] += nonzero
+            for pp_ip in ports:
+                self._node_ports[node_row][pp_ip] += 1
+            self._rebuild_node_ports(node_row)
+            for dv in disk:
+                self._node_disk_vols[node_row][dv] += 1
+            self._rebuild_node_vols(node_row)
+            self.a_volcnt[node_row] += vcounts
+        self._register_pod_terms(pod, rec)
+        self.generation += 1
+
+    def remove_pod(self, pod: Pod) -> None:
+        key = (pod.namespace, pod.name)
+        rec = self.pods.pop(key, None)
+        if rec is None:
+            return
+        m = rec.m
+        self.p_alive[m] = False
+        self.p_ns[m] = PAD
+        self.p_node[m] = PAD
+        for col in self._label_cols.values():
+            col[m] = PAD
+        self._free_m.append(m)
+        row = rec.node_row
+        if row >= 0:
+            self._row_pods.get(row, set()).discard(key)
+            self.a_requested[row, : rec.req.shape[0]] -= rec.req
+            self.a_nonzero[row] -= rec.nonzero
+            for pp_ip in rec.ports:
+                c = self._node_ports[row]
+                c[pp_ip] -= 1
+                if c[pp_ip] <= 0:
+                    del c[pp_ip]
+            self._rebuild_node_ports(row)
+            for dv in rec.disk_vols:
+                c = self._node_disk_vols[row]
+                c[dv] -= 1
+                if c[dv] <= 0:
+                    del c[dv]
+            self._rebuild_node_vols(row)
+            self.a_volcnt[row] -= rec.vol_counts
+        self._unregister_pod_terms(rec)
+        self.generation += 1
+
+    # ------------------------------------------------- affinity term grouping
+
+    def _iter_pod_terms(self, pod: Pod):
+        aff = pod.spec.affinity
+        if aff is None:
+            return
+        if aff.pod_anti_affinity:
+            for t in aff.pod_anti_affinity.required:
+                yield K_ANTI_REQ, 1.0, t
+            for wt in aff.pod_anti_affinity.preferred:
+                yield K_ANTI_PREF, float(wt.weight), wt.term
+        if aff.pod_affinity:
+            for t in aff.pod_affinity.required:
+                yield K_AFF_REQ, 1.0, t
+            for wt in aff.pod_affinity.preferred:
+                yield K_AFF_PREF, float(wt.weight), wt.term
+
+    def _term_sig(self, kind: int, weight: float, term: PodAffinityTerm, pod_ns: str):
+        namespaces = frozenset(term.namespaces or (pod_ns,))
+        sel = _sel_requirements(term.label_selector)
+        sel_key = tuple(sel.requirements) if sel is not None else None
+        return (kind, weight, term.topology_key, namespaces, sel_key)
+
+    def _register_pod_terms(self, pod: Pod, rec: _PodRecord) -> None:
+        for kind, weight, term in self._iter_pod_terms(pod):
+            if not term.topology_key:
+                continue
+            kid = self.register_topology_key(term.topology_key)
+            sig = self._term_sig(kind, weight, term, pod.namespace)
+            g = self.term_groups.get(sig)
+            if g is None:
+                sel = _sel_requirements(term.label_selector)
+                g = _TermGroup(
+                    kind=kind,
+                    topo_key_id=kid,
+                    namespaces=frozenset(term.namespaces or (pod.namespace,)),
+                    selector=sel if sel is not None else klabels.Selector(()),
+                    weight=weight,
+                    pair_counts=np.zeros(self.dims.TP, np.float32),
+                )
+                self.term_groups[sig] = g
+            g.members += 1
+            if rec.node_row >= 0:
+                pid = self._node_pair_id[kid][rec.node_row]
+                if pid >= 0:
+                    g.pair_counts[pid] += 1
+            rec.group_refs.append(sig)
+
+    def _shift_pod_pairs(self, rec: _PodRecord, add: bool) -> None:
+        """Add/remove rec's term-group pair contributions for its current
+        node_row (used when the pod's node assignment or the node's topology
+        labels change, without touching group membership)."""
+        if rec.node_row < 0:
+            return
+        delta = 1.0 if add else -1.0
+        for sig in rec.group_refs:
+            g = self.term_groups.get(sig)
+            if g is None:
+                continue
+            pid = self._node_pair_id[g.topo_key_id][rec.node_row]
+            if pid >= 0:
+                g.pair_counts[pid] += delta
+
+    def _unregister_pod_terms(self, rec: _PodRecord) -> None:
+        for sig in rec.group_refs:
+            g = self.term_groups.get(sig)
+            if g is None:
+                continue
+            g.members -= 1
+            if rec.node_row >= 0:
+                pid = self._node_pair_id[g.topo_key_id][rec.node_row]
+                if pid >= 0:
+                    g.pair_counts[pid] -= 1
+            if g.members <= 0:
+                del self.term_groups[sig]
+
+    # ------------------------------------------------------------- spreading
+
+    def add_spread_selector(self, namespace: str, match_labels: Dict[str, str]) -> None:
+        """Register a Service/RC/RS/StatefulSet selector for SelectorSpread
+        (ref priorities/selector_spreading.go getSelectors)."""
+        self._spread.append((namespace, klabels.selector_from_match_labels(match_labels)))
+        if len(self._spread) > self.dims.G:
+            self.dims = self.dims.bump(G=len(self._spread))
+        self.generation += 1
+
+    def _match_selector_vec(
+        self, sel: klabels.Selector, ns_ids: Optional[Sequence[int]]
+    ) -> np.ndarray:
+        """Vectorized selector match over the existing-pod arena -> bool[M]."""
+        m = self.p_alive.copy()
+        if ns_ids is not None:
+            m &= np.isin(self.p_ns, np.asarray(list(ns_ids), np.int32))
+        for r in sel.requirements:
+            kid = self.interner.lookup(r.key)
+            col = self._label_cols.get(kid) if kid >= 0 else None
+            if col is None:
+                vals = np.full(self._cap_m, PAD, np.int32)
+            else:
+                vals = col
+            if r.operator == klabels.IN:
+                ids = [self.interner.lookup(v) for v in r.values]
+                m &= np.isin(vals, np.asarray([i for i in ids if i >= 0] or [-2], np.int32))
+            elif r.operator == klabels.NOT_IN:
+                ids = [self.interner.lookup(v) for v in r.values]
+                m &= ~np.isin(vals, np.asarray([i for i in ids if i >= 0] or [-2], np.int32))
+            elif r.operator == klabels.EXISTS:
+                m &= vals != PAD
+            elif r.operator == klabels.DOES_NOT_EXIST:
+                m &= vals == PAD
+            else:  # Gt/Lt: rare — fall back to per-pod python
+                keep = np.zeros(self._cap_m, bool)
+                for rec in self.pods.values():
+                    keep[rec.m] = r.matches(rec.labels)
+                m &= keep
+        return m
+
+    def _group_counts(self) -> np.ndarray:
+        counts = np.zeros((self._cap_n, self.dims.G), np.float32)
+        for gi, (ns, sel) in enumerate(self._spread):
+            nsid = self.interner.lookup(ns)
+            if nsid < 0:
+                continue
+            matched = self._match_selector_vec(sel, [nsid])
+            nodes = self.p_node[matched]
+            nodes = nodes[nodes >= 0]
+            if nodes.size:
+                counts[:, gi] = np.bincount(nodes, minlength=self._cap_n).astype(np.float32)
+        return counts
+
+    # ------------------------------------------------------------- snapshot
+
+    def snapshot(self) -> ClusterTensors:
+        pk = np.full(self.dims.TP, PAD, np.int32)
+        if self._pair_topo_key:
+            pk[: len(self._pair_topo_key)] = np.asarray(self._pair_topo_key, np.int32)
+        # image spread scaling (image_locality.go scaledImageScore):
+        # scaled = size * numNodesWithImage / totalNodes
+        total = max(len(self.node_rows), 1)
+        scale = np.ones_like(self.a_img_sz)
+        ids = self.a_img_id
+        if self._image_nodes:
+            lut = np.zeros(len(self.interner), np.float32)
+            for name, cnt in self._image_nodes.items():
+                iid = self.interner.lookup(name)
+                if iid >= 0:
+                    lut[iid] = cnt / total
+            scale = np.where(ids >= 0, lut[np.maximum(ids, 0)], 0.0)
+        return ClusterTensors(
+            allocatable=self.a_allocatable.copy(),
+            requested=self.a_requested.copy(),
+            nonzero_req=self.a_nonzero.copy(),
+            valid=self.a_valid.copy(),
+            unschedulable=self.a_unsched.copy(),
+            not_ready=self.a_notready.copy(),
+            mem_pressure=self.a_mempress.copy(),
+            disk_pressure=self.a_diskpress.copy(),
+            pid_pressure=self.a_pidpress.copy(),
+            node_name_id=self.a_name.copy(),
+            label_keys=self.a_lkeys.copy(),
+            label_vals=self.a_lvals.copy(),
+            label_nums=self.a_lnums.copy(),
+            taint_key=self.a_tkey.copy(),
+            taint_val=self.a_tval.copy(),
+            taint_effect=self.a_teff.copy(),
+            port_pp=self.a_ppp.copy(),
+            port_ip=self.a_pip.copy(),
+            port_used=self.a_pused.copy(),
+            topo_pairs=self.a_topo.copy(),
+            zone_id=self.a_zone.copy(),
+            group_counts=self._group_counts(),
+            pair_topo_key=pk,
+            image_id=self.a_img_id.copy(),
+            image_size=(self.a_img_sz * scale).astype(np.float32),
+            avoid_owner=self.a_avoid.copy(),
+            vol_counts=self.a_volcnt.copy(),
+            disk_vol_ids=self.a_dvol.copy(),
+        )
+
+    def pods_snapshot(self):
+        """Per-pod device tensors for preemption what-ifs: the assigned-pod
+        arena as (node_row i32[M], priority i32[M], req f32[M, R],
+        nonzero f32[M, 2], valid bool[M], keys list[M]).
+
+        M is the padded pod capacity; `keys` maps arena index -> (ns, name)
+        for decoding victim picks on the host."""
+        M = self._cap_m
+        node = np.full(M, PAD, np.int32)
+        prio = np.zeros(M, np.int32)
+        req = np.zeros((M, self.dims.R), np.float32)
+        nz = np.zeros((M, 2), np.float32)
+        valid = np.zeros(M, bool)
+        keys: List = [None] * M
+        for rec in self.pods.values():
+            m = rec.m
+            node[m] = rec.node_row
+            prio[m] = rec.priority
+            req[m, : rec.req.shape[0]] = rec.req
+            nz[m] = rec.nonzero
+            valid[m] = rec.node_row >= 0
+            keys[m] = rec.key
+        return node, prio, req, nz, valid, keys
+
+    # ------------------------------------------------------------ pod batch
+
+    def encode_pods(self, pods: Sequence[Pod]) -> PodBatch:
+        """Encode pending pods into a PodBatch, precomputing the
+        inter-pod-affinity pair tensors against current cluster state."""
+        d = self.dims
+        B = _pow2(len(pods), max(d.B, 1))
+        if B > d.B:
+            self.dims = d = dataclasses.replace(d, B=B)
+        # grow per-pod dims to fit
+        need = dict(Q=1, TT=1, NS=1, S=1, E=1, V=1, PS=1, PT=1, AT=1, GP=1, C=1, DV=1)
+        for pod in pods:
+            need["Q"] = max(need["Q"], len(pod.host_ports()))
+            need["TT"] = max(need["TT"], len(pod.spec.tolerations))
+            need["NS"] = max(need["NS"], len(pod.spec.node_selector))
+            need["C"] = max(need["C"], len(pod.spec.containers))
+            aff = pod.spec.affinity
+            na = aff.node_affinity if aff else None
+            if na and na.required:
+                need["S"] = max(need["S"], len(na.required.terms))
+                for t in na.required.terms:
+                    need["E"] = max(need["E"], len(t.match_expressions) + len(t.match_fields))
+                    for e in t.match_expressions:
+                        need["V"] = max(need["V"], len(e.values))
+            if na:
+                need["PS"] = max(need["PS"], len(na.preferred))
+                for p in na.preferred:
+                    need["E"] = max(need["E"], len(p.preference.match_expressions))
+                    for e in p.preference.match_expressions:
+                        need["V"] = max(need["V"], len(e.values))
+            if aff and aff.pod_affinity:
+                need["PT"] = max(need["PT"], len(aff.pod_affinity.required))
+            if aff and aff.pod_anti_affinity:
+                need["AT"] = max(need["AT"], len(aff.pod_anti_affinity.required))
+        bump = {k: v for k, v in need.items() if v > getattr(d, k)}
+        if bump:
+            self.dims = d = self.dims.bump(**bump)
+        # topology keys must be registered before encoding pair tensors, and
+        # extended-resource columns before the out arrays are allocated
+        # (a mid-loop dims.R bump would orphan the already-allocated arrays)
+        for pod in pods:
+            for _, _, term in self._iter_pod_terms(pod):
+                if term.topology_key:
+                    self.register_topology_key(term.topology_key)
+            for rname in pod.resource_request():
+                self._res_col(rname)
+        d = self.dims
+        it = self.interner
+        f32, i32 = np.float32, np.int32
+
+        def zi(*shape):
+            return np.full(shape, PAD, i32)
+
+        def zf(*shape):
+            return np.zeros(shape, f32)
+
+        def zb(*shape):
+            return np.zeros(shape, bool)
+
+        out = dict(
+            valid=zb(B),
+            req=zf(B, d.R),
+            nonzero_req=zf(B, 2),
+            priority=np.zeros(B, i32),
+            best_effort=zb(B),
+            ns_id=zi(B),
+            owner_uid=zi(B),
+            node_name_req=zi(B),
+            port_pp=zi(B, d.Q),
+            port_ip=zi(B, d.Q),
+            port_valid=zb(B, d.Q),
+            tol_key=zi(B, d.TT),
+            tol_op=np.zeros((B, d.TT), i32),
+            tol_val=zi(B, d.TT),
+            tol_effect=zi(B, d.TT),
+            tol_valid=zb(B, d.TT),
+            ns_keys=zi(B, d.NS),
+            ns_vals=zi(B, d.NS),
+            ns_valid=zb(B, d.NS),
+            has_req_affinity=zb(B),
+            term_valid=zb(B, d.S),
+            expr_key=zi(B, d.S, d.E),
+            expr_op=np.zeros((B, d.S, d.E), i32),
+            expr_vals=zi(B, d.S, d.E, d.V),
+            expr_nval=np.zeros((B, d.S, d.E), i32),
+            expr_num=np.full((B, d.S, d.E), np.nan, f32),
+            expr_valid=zb(B, d.S, d.E),
+            pref_weight=zf(B, d.PS),
+            pref_term_valid=zb(B, d.PS),
+            pref_expr_key=zi(B, d.PS, d.E),
+            pref_expr_op=np.zeros((B, d.PS, d.E), i32),
+            pref_expr_vals=zi(B, d.PS, d.E, d.V),
+            pref_expr_nval=np.zeros((B, d.PS, d.E), i32),
+            pref_expr_num=np.full((B, d.PS, d.E), np.nan, f32),
+            pref_expr_valid=zb(B, d.PS, d.E),
+            forbidden_pairs=zb(B, d.TP),
+            aff_term_pairs=zb(B, d.PT, d.TP),
+            aff_term_valid=zb(B, d.PT),
+            aff_term_self=zb(B, d.PT),
+            aff_term_topo_key=zi(B, d.PT),
+            anti_term_pairs=zb(B, d.AT, d.TP),
+            anti_term_valid=zb(B, d.AT),
+            anti_term_topo_key=zi(B, d.AT),
+            anti_term_self=zb(B, d.AT),
+            pref_pair_weights=zf(B, d.TP),
+            group_ids=zi(B, d.GP),
+            group_valid=zb(B, d.GP),
+            image_ids=zi(B, d.C),
+            image_bytes=zf(B, d.C),
+            new_vol_counts=zf(B, NUM_VOL_TYPES),
+            disk_vol_ids=zi(B, d.DV),
+        )
+
+        # interner ids are append-only (stable), so only pad-dim or
+        # spread-registry changes invalidate cached rows
+        token = (self.dims, len(self._spread))
+        if token != self._pod_cache_token:
+            self._pod_row_cache.clear()
+            self._pod_cache_token = token
+
+        for b, pod in enumerate(pods):
+            ck = self._pod_static_key(pod)
+            cached = self._pod_row_cache.get(ck) if ck is not None else None
+            if cached is not None:
+                for k, v in cached.items():
+                    out[k][b] = v
+                continue
+            out["valid"][b] = True
+            req = self._req_vector(pod.resource_request())
+            out["req"][b, : req.shape[0]] = req
+            out["nonzero_req"][b] = self._nonzero(pod)
+            out["priority"][b] = pod.spec.priority
+            out["best_effort"][b] = all(
+                not c.requests and not c.limits for c in pod.spec.containers
+            )
+            out["ns_id"][b] = it.intern(pod.namespace)
+            # NodePreferAvoidPods only applies to RC/RS-owned pods
+            # (ref priorities/node_prefer_avoid_pods.go:41-55)
+            if pod.metadata.owner_uid and pod.metadata.owner_kind in (
+                "ReplicationController",
+                "ReplicaSet",
+            ):
+                out["owner_uid"][b] = it.intern(pod.metadata.owner_uid)
+            if pod.spec.node_name:
+                out["node_name_req"][b] = it.intern(pod.spec.node_name)
+            for j, (pp, ip) in enumerate(self._pod_ports(pod)[: d.Q]):
+                out["port_pp"][b, j] = pp
+                out["port_ip"][b, j] = ip
+                out["port_valid"][b, j] = True
+            for j, t in enumerate(pod.spec.tolerations[: d.TT]):
+                out["tol_key"][b, j] = it.intern(t.key) if t.key else 0
+                out["tol_op"][b, j] = TOL_OP_CODES.get(t.operator, 0)
+                out["tol_val"][b, j] = it.intern(t.value)
+                out["tol_effect"][b, j] = EFFECT_CODES.get(t.effect, PAD) if t.effect else PAD
+                out["tol_valid"][b, j] = True
+            for j, (k, v) in enumerate(sorted(pod.spec.node_selector.items())[: d.NS]):
+                out["ns_keys"][b, j] = it.intern(k)
+                out["ns_vals"][b, j] = it.lookup(v) if it.lookup(v) >= 0 else it.intern(v)
+                out["ns_valid"][b, j] = True
+            aff = pod.spec.affinity
+            na = aff.node_affinity if aff else None
+            if na and na.required is not None:
+                out["has_req_affinity"][b] = True
+                for s, term in enumerate(na.required.terms[: d.S]):
+                    out["term_valid"][b, s] = True
+                    e = 0
+                    for expr in term.match_expressions:
+                        if e >= d.E:
+                            break
+                        self._encode_expr(out, "expr", b, s, e, expr.key, expr.operator, expr.values)
+                        e += 1
+                    for expr in term.match_fields:
+                        if e >= d.E:
+                            break
+                        # matchFields only supports metadata.name (ref
+                        # apis/core/validation: NodeFieldSelectorKeys)
+                        self._encode_expr(
+                            out, "expr", b, s, e, FIELD_NODE_NAME, expr.operator, expr.values
+                        )
+                        e += 1
+            if na:
+                for s, pterm in enumerate(na.preferred[: d.PS]):
+                    out["pref_term_valid"][b, s] = True
+                    out["pref_weight"][b, s] = float(pterm.weight)
+                    for e, expr in enumerate(pterm.preference.match_expressions[: d.E]):
+                        self._encode_expr(
+                            out, "pref_expr", b, s, e, expr.key, expr.operator, expr.values
+                        )
+            self._encode_pod_affinity(out, b, pod)
+            gi = 0
+            for g, (ns, sel) in enumerate(self._spread):
+                if gi >= d.GP:
+                    break
+                if ns == pod.namespace and sel.matches(pod.labels):
+                    out["group_ids"][b, gi] = g
+                    out["group_valid"][b, gi] = True
+                    gi += 1
+            for j, c in enumerate(pod.spec.containers[: d.C]):
+                if c.image:
+                    out["image_ids"][b, j] = it.lookup(c.image)
+            disk, vcounts = self._pod_vols(pod)
+            out["new_vol_counts"][b] = vcounts
+            for j, dv in enumerate(disk[: d.DV]):
+                out["disk_vol_ids"][b, j] = dv
+            if ck is not None:
+                self._pod_row_cache[ck] = {
+                    k: np.copy(v[b]) for k, v in out.items()
+                }
+
+        return PodBatch(**out)
+
+    def _pod_static_key(self, pod: Pod):
+        """Cache key for state-independent pods; None disables caching.
+
+        A pod with no affinity of its own is still state-dependent when ANY
+        existing pod carries (anti-)affinity terms: its forbidden_pairs /
+        pref_pair_weights rows come from matching those terms, whose pair
+        counts move with every placement."""
+        if pod.spec.affinity is not None or pod.spec.volumes or self.term_groups:
+            return None
+        try:
+            return (
+                pod.namespace,
+                tuple(sorted(pod.labels.items())),
+                tuple(sorted(pod.spec.node_selector.items())),
+                # the *resolved* image id goes into the key: a lookup miss
+                # (image not yet on any node) must not freeze ImageLocality
+                # at 0 once the image appears and gets interned
+                tuple(
+                    (self.interner.lookup(c.image),
+                     tuple(sorted((k, str(q)) for k, q in c.requests.items())),
+                     tuple(c.ports))
+                    for c in pod.spec.containers
+                ),
+                tuple(
+                    (c.image, tuple(sorted((k, str(q)) for k, q in c.requests.items())))
+                    for c in pod.spec.init_containers
+                ),
+                pod.spec.tolerations,
+                pod.spec.node_name,
+                pod.spec.priority,
+                pod.metadata.owner_uid,
+                pod.metadata.owner_kind,
+            )
+        except TypeError:
+            return None
+
+    def _encode_expr(self, out, prefix, b, s, e, key, op, values) -> None:
+        it = self.interner
+        out[f"{prefix}_key"][b, s, e] = it.intern(key)
+        out[f"{prefix}_op"][b, s, e] = SEL_OP_CODES[op]
+        out[f"{prefix}_valid"][b, s, e] = True
+        if op in (klabels.GT, klabels.LT):
+            try:
+                out[f"{prefix}_num"][b, s, e] = float(int(values[0]))
+            except (ValueError, IndexError):
+                out[f"{prefix}_num"][b, s, e] = np.nan
+        else:
+            nv = 0
+            for v in values[: out[f"{prefix}_vals"].shape[-1]]:
+                vid = it.lookup(v)
+                out[f"{prefix}_vals"][b, s, e, nv] = vid if vid >= 0 else it.intern(v)
+                nv += 1
+            out[f"{prefix}_nval"][b, s, e] = nv
+
+    def _matches_one(self, sel: klabels.Selector, namespaces: frozenset, pod: Pod) -> bool:
+        return pod.namespace in namespaces and sel.matches(pod.labels)
+
+    def _term_pairs(self, term: PodAffinityTerm, pod_ns: str) -> Tuple[np.ndarray, int]:
+        """f32[TP] count of existing pods matching `term` per topology pair
+        (counts matter: the priority adds weight once per matching pod,
+        ref priorities/interpod_affinity.go processExistingPod)."""
+        kid = self.interner.lookup(term.topology_key)
+        pairs = np.zeros(self.dims.TP, np.float32)
+        sel = _sel_requirements(term.label_selector)
+        if sel is None or kid < 0:
+            return pairs, kid
+        ns_ids = [
+            self.interner.lookup(n)
+            for n in (term.namespaces or (pod_ns,))
+            if self.interner.lookup(n) >= 0
+        ]
+        if not ns_ids:
+            return pairs, kid
+        matched = self._match_selector_vec(sel, ns_ids)
+        nodes = self.p_node[matched]
+        nodes = nodes[nodes >= 0]
+        if nodes.size:
+            pids = self._node_pair_id[kid][nodes]
+            pids = pids[pids >= 0]
+            if pids.size:
+                pairs += np.bincount(pids, minlength=self.dims.TP).astype(np.float32)
+        return pairs, kid
+
+    def _encode_pod_affinity(self, out, b: int, pod: Pod) -> None:
+        """Fill forbidden/affinity pair tensors for one incoming pod.
+
+        forbidden_pairs: existing pods' required anti-affinity terms that match
+        this pod forbid their topology pairs (ref predicates.go
+        satisfiesExistingPodsAntiAffinity via metadata
+        topologyPairsAntiAffinityPodsMap).
+        pref_pair_weights: soft scoring weight per pair — combines the incoming
+        pod's preferred terms and existing pods' preferred (anti-)affinity and
+        hard-affinity symmetry (ref priorities/interpod_affinity.go).
+        """
+        d = self.dims
+        hard_w = self.hard_pod_affinity_weight
+        for sig, g in self.term_groups.items():
+            if g.members <= 0:
+                continue
+            if not self._matches_one(g.selector, g.namespaces, pod):
+                continue
+            if g.kind == K_ANTI_REQ:
+                out["forbidden_pairs"][b] |= g.pair_counts[: d.TP] > 0
+            elif g.kind == K_ANTI_PREF:
+                out["pref_pair_weights"][b] -= g.weight * g.pair_counts[: d.TP]
+            elif g.kind == K_AFF_PREF:
+                out["pref_pair_weights"][b] += g.weight * g.pair_counts[: d.TP]
+            elif g.kind == K_AFF_REQ and hard_w:
+                out["pref_pair_weights"][b] += hard_w * g.pair_counts[: d.TP]
+        aff = pod.spec.affinity
+        if aff is None:
+            return
+        if aff.pod_affinity:
+            for j, term in enumerate(aff.pod_affinity.required[: d.PT]):
+                pairs, kid = self._term_pairs(term, pod.namespace)
+                out["aff_term_pairs"][b, j] = pairs > 0
+                out["aff_term_valid"][b, j] = True
+                out["aff_term_topo_key"][b, j] = kid
+                sel = _sel_requirements(term.label_selector)
+                out["aff_term_self"][b, j] = bool(
+                    sel is not None
+                    and pod.namespace in (term.namespaces or (pod.namespace,))
+                    and sel.matches(pod.labels)
+                )
+            for wt in aff.pod_affinity.preferred:
+                pairs, _ = self._term_pairs(wt.term, pod.namespace)
+                out["pref_pair_weights"][b] += float(wt.weight) * pairs
+        if aff.pod_anti_affinity:
+            for j, term in enumerate(aff.pod_anti_affinity.required[: d.AT]):
+                pairs, kid = self._term_pairs(term, pod.namespace)
+                out["anti_term_pairs"][b, j] = pairs > 0
+                out["anti_term_valid"][b, j] = True
+                out["anti_term_topo_key"][b, j] = kid
+                sel = _sel_requirements(term.label_selector)
+                out["anti_term_self"][b, j] = bool(
+                    sel is not None
+                    and pod.namespace in (term.namespaces or (pod.namespace,))
+                    and sel.matches(pod.labels)
+                )
+            for wt in aff.pod_anti_affinity.preferred:
+                pairs, _ = self._term_pairs(wt.term, pod.namespace)
+                out["pref_pair_weights"][b] -= float(wt.weight) * pairs

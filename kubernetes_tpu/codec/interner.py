@@ -1,0 +1,43 @@
+"""String interning: the host-side bridge from label/taint/name strings to
+device int32 ids.
+
+The reference does string compares in the hot loop (label map lookups in every
+predicate, e.g. predicates.go PodMatchNodeSelector); on TPU strings cannot
+exist, so every string the kernels consume is interned once at snapshot-encode
+time.  Id 0 is reserved as the wildcard/empty id (used e.g. for host-port IP
+"" / "0.0.0.0" which conflicts with every address, predicates host_ports
+semantics); -1 is the universal padding value.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+
+class Interner:
+    WILDCARD = 0
+
+    def __init__(self) -> None:
+        self._ids: Dict[str, int] = {"": self.WILDCARD}
+        self._strs: List[str] = [""]
+
+    def intern(self, s: str) -> int:
+        i = self._ids.get(s)
+        if i is None:
+            i = len(self._strs)
+            self._ids[s] = i
+            self._strs.append(s)
+        return i
+
+    def lookup(self, s: str) -> int:
+        """Return the id for s, or -1 if never interned (matches nothing)."""
+        return self._ids.get(s, -1)
+
+    def string(self, i: int) -> str:
+        return self._strs[i]
+
+    def __len__(self) -> int:
+        return len(self._strs)
+
+    def intern_all(self, strs: Iterable[str]) -> List[int]:
+        return [self.intern(s) for s in strs]

@@ -1,0 +1,310 @@
+"""Static tensor schema for device-resident cluster state.
+
+Design (SURVEY.md section 7): cluster state is a columnar struct-of-arrays over
+the node axis N, pending pods a struct-of-arrays over the batch axis B.  All
+strings are interned int32 ids (codec/interner.py); all variable-length lists
+are padded to static widths declared in `PadDims` so that a single jit
+compilation serves every snapshot of the same padded shape.  Growing beyond a
+pad width bumps the dim to the next power of two (one recompile, amortized to
+zero — same trade XLA makes for any bucketed dynamic workload).
+
+The mapping from the reference:
+  NodeInfo (pkg/scheduler/nodeinfo/node_info.go:47-148)  -> rows of ClusterTensors
+  NodeInfoSnapshot (internal/cache/interface.go:125-128) -> ClusterTensors + generation
+  predicateMetadata topology-pair maps (algorithm/predicates/metadata.go:64-94)
+      -> the [*, TP] topology-pair incidence tensors
+  priorityMetadata selectors (algorithm/priorities/metadata.go)
+      -> the spread-group count columns
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+PAD = -1  # universal padding id
+WILDCARD = 0  # interner id of "" — wildcard IP for host ports
+
+# A reserved pseudo-label key id representing the node-name field, used to
+# fold NodeSelectorTerm.matchFields (metadata.name) into the same expression
+# encoding as matchExpressions.  Interners reserve id 0 for ""; encoders
+# intern this sentinel string first, so its id is always 1 (asserted there).
+FIELD_NODE_NAME = "__field:metadata.name"
+FIELD_NODE_NAME_ID = 1
+
+# Taint effects (ref core/v1/types.go TaintEffect)
+EFFECT_CODES = {"NoSchedule": 0, "PreferNoSchedule": 1, "NoExecute": 2}
+# Toleration operators (ref core/v1/types.go TolerationOperator); empty
+# operator defaults to Equal (toleration.go ToleratesTaint)
+TOL_OP_CODES = {"Equal": 0, "": 0, "Exists": 1}
+# Node-selector operators (ref core/v1/types.go NodeSelectorOperator)
+SEL_OP_CODES = {"In": 0, "NotIn": 1, "Exists": 2, "DoesNotExist": 3, "Gt": 4, "Lt": 5}
+
+# Resource columns. Fixed layout of the resource axis R; extended resources
+# (device plugins etc.) occupy columns >= RES_EXT0.
+# ref nodeinfo.Resource (node_info.go:139-148): MilliCPU, Memory,
+# EphemeralStorage, AllowedPodNumber, ScalarResources.
+RES_MILLICPU = 0
+RES_MEMORY = 1
+RES_EPHEMERAL = 2
+RES_PODS = 3
+RES_EXT0 = 4
+
+# Predicate codes, in the reference's mandatory evaluation order
+# (algorithm/predicates/predicates.go:142-151 predicatesOrdering).  The TPU
+# path evaluates ALL of them in one launch; this order is used only to
+# attribute the *first* failure reason for FitError parity
+# (generic_scheduler.go podFitsOnNode short-circuit semantics).
+PREDICATE_ORDER = (
+    "CheckNodeCondition",
+    "CheckNodeUnschedulable",
+    "GeneralPredicates",      # = HostName + HostPorts + Resources + NodeSelector
+    "PodFitsHost",
+    "PodFitsHostPorts",
+    "PodMatchNodeSelector",
+    "PodFitsResources",
+    "NoDiskConflict",
+    "PodToleratesNodeTaints",
+    "PodToleratesNodeNoExecuteTaints",
+    "CheckNodeLabelPresence",
+    "CheckServiceAffinity",
+    "MaxEBSVolumeCount",
+    "MaxGCEPDVolumeCount",
+    "MaxCSIVolumeCount",
+    "MaxAzureDiskVolumeCount",
+    "MaxCinderVolumeCount",
+    "CheckVolumeBinding",
+    "NoVolumeZoneConflict",
+    "CheckNodeMemoryPressure",
+    "CheckNodePIDPressure",
+    "CheckNodeDiskPressure",
+    "MatchInterPodAffinity",
+)
+PRED_INDEX = {name: i for i, name in enumerate(PREDICATE_ORDER)}
+NUM_PREDICATES = len(PREDICATE_ORDER)
+
+# Priority (score) functions, default set + weights
+# (algorithmprovider/defaults/defaults.go defaultPriorities(): all weight 1;
+# NodePreferAvoidPods weight 10000, register_priorities.go:87)
+PRIORITY_ORDER = (
+    "SelectorSpreadPriority",
+    "InterPodAffinityPriority",
+    "LeastRequestedPriority",
+    "BalancedResourceAllocation",
+    "NodePreferAvoidPodsPriority",
+    "NodeAffinityPriority",
+    "TaintTolerationPriority",
+    "ImageLocalityPriority",
+)
+PRIO_INDEX = {name: i for i, name in enumerate(PRIORITY_ORDER)}
+NUM_PRIORITIES = len(PRIORITY_ORDER)
+DEFAULT_PRIORITY_WEIGHTS = np.array(
+    [1.0, 1.0, 1.0, 1.0, 10000.0, 1.0, 1.0, 1.0], dtype=np.float32
+)
+
+# Volume filter types for MaxVolumeCount predicates
+# (predicates.go EBS/GCE/AzureDisk/Cinder VolumeFilterType + CSI)
+VOL_EBS, VOL_GCE, VOL_CSI, VOL_AZURE, VOL_CINDER = 0, 1, 2, 3, 4
+NUM_VOL_TYPES = 5
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    n = max(n, floor)
+    return 1 << (n - 1).bit_length()
+
+
+@dataclass(frozen=True)
+class PadDims:
+    """Static pad widths.  Every field is a maximum-over-the-snapshot, rounded
+    up to a power of two by `SnapshotEncoder.fit()`."""
+
+    N: int = 8        # nodes (padded; `valid` masks the tail)
+    B: int = 1        # pod batch
+    R: int = 8        # resource columns (4 core + extended)
+    L: int = 8        # labels per node
+    T: int = 4        # taints per node
+    P: int = 8        # occupied host-ports per node
+    Q: int = 4        # host-ports per pod
+    TT: int = 4       # tolerations per pod
+    NS: int = 4       # plain nodeSelector (map) entries per pod
+    S: int = 2        # required node-affinity terms per pod
+    E: int = 4        # expressions per node-affinity term
+    V: int = 4        # values per expression
+    PS: int = 2       # preferred node-affinity terms per pod
+    TP: int = 16      # topology-pair vocabulary size
+    PT: int = 2       # required pod-affinity terms per pod
+    AT: int = 2       # required pod-anti-affinity terms per pod
+    G: int = 16       # spread-group vocabulary (services/RCs/RSs/SSs)
+    GP: int = 4       # spread groups per pod
+    I: int = 8        # images per node
+    C: int = 4        # containers (images) per pod
+    A: int = 2        # prefer-avoid owner uids per node
+    DV: int = 4       # disk-conflict volume ids per pod
+    DVN: int = 8      # disk-conflict volume ids per node
+
+    def bump(self, **kw: int) -> "PadDims":
+        return dataclasses.replace(
+            self, **{k: _pow2(v) for k, v in kw.items() if v > getattr(self, k)}
+        )
+
+
+def _dc_pytree(cls):
+    """Register a plain dataclass of arrays as a jax pytree."""
+    data = [f.name for f in fields(cls)]
+    jax.tree_util.register_dataclass(cls, data_fields=data, meta_fields=[])
+    return cls
+
+
+@_dc_pytree
+@dataclass
+class ClusterTensors:
+    """Struct-of-arrays cluster snapshot, node axis N.
+
+    Dynamic fields (mutated by the on-device commit step of batched
+    scheduling): requested, nonzero_req, port_used, group_counts, pair_counts.
+    Everything else is static per snapshot.
+    """
+
+    # -- resources (PodFitsResources, resource scores) --
+    allocatable: Any        # f32[N, R]
+    requested: Any          # f32[N, R]   (col RES_PODS counts pods)
+    nonzero_req: Any        # f32[N, 2]   (milliCPU, memory) with nonzero defaults
+    # -- node status / spec --
+    valid: Any              # bool[N]     padding mask
+    unschedulable: Any      # bool[N]     (.spec.unschedulable)
+    not_ready: Any          # bool[N]     CheckNodeCondition (Ready!="True" | net unavailable)
+    mem_pressure: Any       # bool[N]
+    disk_pressure: Any      # bool[N]
+    pid_pressure: Any       # bool[N]
+    node_name_id: Any       # i32[N]
+    # -- labels --
+    label_keys: Any         # i32[N, L]  (PAD-filled)
+    label_vals: Any         # i32[N, L]
+    label_nums: Any         # f32[N, L]  numeric value of label (nan if not an int) for Gt/Lt
+    # -- taints --
+    taint_key: Any          # i32[N, T]
+    taint_val: Any          # i32[N, T]
+    taint_effect: Any       # i32[N, T]  (EFFECT_CODES, PAD)
+    # -- host ports (occupied by existing pods) --
+    port_pp: Any            # i32[N, P]  interned "proto/port" id, PAD empty
+    port_ip: Any            # i32[N, P]  interned IP, WILDCARD = 0.0.0.0/""
+    port_used: Any          # bool[N, P] slot occupancy
+    # -- topology --
+    topo_pairs: Any         # bool[N, TP] node belongs to topology pair tp
+    zone_id: Any            # i32[N]      interned zone label value (PAD none)
+    # -- spreading (SelectorSpread) --
+    group_counts: Any       # f32[N, G]  matching existing pods per spread group
+    # -- inter-pod affinity state --
+    pair_topo_key: Any      # i32[TP]    topology-key id of each pair (PAD unused)
+    # -- images (ImageLocality) --
+    image_id: Any           # i32[N, I]
+    image_size: Any         # f32[N, I]  bytes
+    # -- NodePreferAvoidPods --
+    avoid_owner: Any        # i32[N, A]  controller-owner uid ids to avoid
+    # -- volumes --
+    vol_counts: Any         # f32[N, NUM_VOL_TYPES] attached unique volumes per filter type
+    disk_vol_ids: Any       # i32[N, DVN] interned volume ids in use (NoDiskConflict)
+
+    @property
+    def n_nodes(self) -> int:
+        return self.allocatable.shape[0]
+
+
+@_dc_pytree
+@dataclass
+class PodBatch:
+    """Struct-of-arrays pending-pod batch, batch axis B.
+
+    The per-pod topology-pair tensors (forbidden_pairs, aff_term_pairs, ...)
+    are the tensorization of predicateMetadata's topologyPairsMaps
+    (algorithm/predicates/metadata.go:64-94): host code matches label
+    selectors against existing pods (vectorized numpy) and the device reduces
+    pair incidence per node.
+    """
+
+    valid: Any              # bool[B]
+    req: Any                # f32[B, R]  resource request (col RES_PODS = 1)
+    nonzero_req: Any        # f32[B, 2]
+    priority: Any           # i32[B]
+    best_effort: Any        # bool[B]    QoS BestEffort (no requests/limits at all)
+    ns_id: Any              # i32[B]     namespace id
+    owner_uid: Any          # i32[B]     controller owner uid id (PAD none)
+    node_name_req: Any      # i32[B]     .spec.nodeName / PAD (PodFitsHost)
+    # host ports requested
+    port_pp: Any            # i32[B, Q]
+    port_ip: Any            # i32[B, Q]
+    port_valid: Any         # bool[B, Q]
+    # tolerations
+    tol_key: Any            # i32[B, TT]  (PAD slot invalid; WILDCARD key = all keys)
+    tol_op: Any             # i32[B, TT]  TOL_OP_CODES
+    tol_val: Any            # i32[B, TT]
+    tol_effect: Any         # i32[B, TT]  EFFECT_CODES; PAD = matches all effects
+    tol_valid: Any          # bool[B, TT]
+    # plain nodeSelector map (AND of key==value)
+    ns_keys: Any            # i32[B, NS]
+    ns_vals: Any            # i32[B, NS]
+    ns_valid: Any           # bool[B, NS]
+    # required node affinity: OR over S terms of AND over E exprs
+    has_req_affinity: Any   # bool[B]
+    term_valid: Any         # bool[B, S]
+    expr_key: Any           # i32[B, S, E]
+    expr_op: Any            # i32[B, S, E]  SEL_OP_CODES
+    expr_vals: Any          # i32[B, S, E, V]
+    expr_nval: Any          # i32[B, S, E]  number of valid values
+    expr_num: Any           # f32[B, S, E]  numeric value for Gt/Lt (nan if invalid)
+    expr_valid: Any         # bool[B, S, E]
+    # preferred node affinity (score): PS terms, each AND of E exprs, weighted
+    pref_weight: Any        # f32[B, PS]
+    pref_term_valid: Any    # bool[B, PS]
+    pref_expr_key: Any      # i32[B, PS, E]
+    pref_expr_op: Any       # i32[B, PS, E]
+    pref_expr_vals: Any     # i32[B, PS, E, V]
+    pref_expr_nval: Any     # i32[B, PS, E]
+    pref_expr_num: Any      # f32[B, PS, E]
+    pref_expr_valid: Any    # bool[B, PS, E]
+    # inter-pod affinity (precomputed pair incidence)
+    forbidden_pairs: Any    # bool[B, TP] existing anti-affinity violated here
+    aff_term_pairs: Any     # bool[B, PT, TP] pairs satisfying required affinity term
+    aff_term_valid: Any     # bool[B, PT]
+    aff_term_self: Any      # bool[B, PT] term's selector matches the pod itself
+    aff_term_topo_key: Any  # i32[B, PT]  topology key id of the term
+    anti_term_pairs: Any    # bool[B, AT, TP] pairs violating pod's own anti-affinity
+    anti_term_valid: Any    # bool[B, AT]
+    anti_term_topo_key: Any # i32[B, AT]
+    anti_term_self: Any     # bool[B, AT] term matches the pod itself (self-anti-affinity)
+    pref_pair_weights: Any  # f32[B, TP] combined soft affinity weight per pair
+    # spreading
+    group_ids: Any          # i32[B, GP]
+    group_valid: Any        # bool[B, GP]
+    # images
+    image_ids: Any          # i32[B, C]  (PAD empty)
+    image_bytes: Any        # f32[B, C]  total size if known (0 otherwise)
+    # volumes
+    new_vol_counts: Any     # f32[B, NUM_VOL_TYPES] new unique volumes the pod adds
+    disk_vol_ids: Any       # i32[B, DV] exclusive-use volume ids (NoDiskConflict)
+
+    @property
+    def n_pods(self) -> int:
+        return self.req.shape[0]
+
+
+@dataclass(frozen=True)
+class FilterConfig:
+    """Static knobs threaded through the kernels (part of the jit cache key).
+
+    max_vols mirrors DefaultMaxEBSVolumes=39/aws, GCE/Azure=16
+    (predicates.go:109-115); hard_pod_affinity_weight ref
+    apis/config/types.go HardPodAffinitySymmetricWeight default 1.
+    """
+
+    max_vols: tuple = (39.0, 16.0, 1e9, 16.0, 1e9)
+    hard_pod_affinity_weight: float = 1.0
+    # CheckNodeLabelPresence / CheckServiceAffinity are policy-configured and
+    # default-off (defaults.go defaultPredicates has neither); encoded as
+    # always-pass unless configured.
+    label_presence_keys: tuple = ()
+    label_presence_present: bool = True

@@ -1,0 +1,14 @@
+"""Pure-Python/numpy golden implementation of the scheduling pipeline.
+
+This is the analog of the reference's table-driven predicate/priority unit
+tests (e.g. algorithm/predicates/predicates_test.go): an independent,
+object-level implementation of the same semantics, used to differential-test
+the TPU kernels on randomized cluster states.  It is also the CPU fallback
+path (the north star's "graceful fallback").
+"""
+
+from kubernetes_tpu.cpuref.reference import (
+    CPUScheduler,
+    run_predicates,
+    run_priorities,
+)

@@ -1,0 +1,726 @@
+"""Object-level golden scheduler, mirroring pkg/scheduler/algorithm semantics.
+
+Every function cites the reference Go code it reproduces.  Integer score math
+uses Python ints, matching the reference's int64 truncation exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.api import labels as klabels
+from kubernetes_tpu.api.resource import Quantity
+from kubernetes_tpu.api.types import (
+    DEFAULT_MEMORY_REQUEST,
+    DEFAULT_MILLI_CPU_REQUEST,
+    Node,
+    Pod,
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    RESOURCE_PODS,
+    TAINT_NO_EXECUTE,
+    TAINT_NO_SCHEDULE,
+    TAINT_PREFER_NO_SCHEDULE,
+    Taint,
+)
+
+MAX_PRIORITY = 10
+ZONE_KEY = "failure-domain.beta.kubernetes.io/zone"
+REGION_KEY = "failure-domain.beta.kubernetes.io/region"
+ZONE_WEIGHTING = 2.0 / 3.0
+TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def pod_requests(pod: Pod) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for k, q in pod.resource_request().items():
+        out[k] = q.milli if k == RESOURCE_CPU else float(q)
+    return out
+
+
+def nonzero_requests(pod: Pod) -> Tuple[float, float]:
+    """ref pkg/scheduler/util/non_zero.go GetNonzeroRequests."""
+    cpu = 0.0
+    mem = 0.0
+    for c in pod.spec.containers:
+        cpu += (
+            c.requests[RESOURCE_CPU].milli
+            if RESOURCE_CPU in c.requests
+            else DEFAULT_MILLI_CPU_REQUEST
+        )
+        mem += (
+            float(c.requests[RESOURCE_MEMORY])
+            if RESOURCE_MEMORY in c.requests
+            else DEFAULT_MEMORY_REQUEST
+        )
+    return cpu, mem
+
+
+def is_best_effort(pod: Pod) -> bool:
+    return all(not c.requests and not c.limits for c in pod.spec.containers)
+
+
+def node_allocatable(node: Node) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for k, q in node.status.allocatable.items():
+        out[k] = q.milli if k == RESOURCE_CPU else float(q)
+    return out
+
+
+def tolerations_tolerate(pod: Pod, taint: Taint) -> bool:
+    return any(t.tolerates(taint) for t in pod.spec.tolerations)
+
+
+def match_node_selector_term(pod_term, node: Node) -> bool:
+    """ref v1helper.MatchNodeSelectorTerms: AND of matchExpressions (as label
+    requirements) and matchFields (metadata.name)."""
+    for expr in pod_term.match_expressions:
+        req = klabels.Requirement(expr.key, expr.operator, tuple(expr.values))
+        if not req.matches(node.labels):
+            return False
+    for expr in pod_term.match_fields:
+        fields = {"metadata.name": node.name}
+        req = klabels.Requirement(expr.key, expr.operator, tuple(expr.values))
+        if not req.matches(fields):
+            return False
+    return bool(pod_term.match_expressions or pod_term.match_fields)
+
+
+def _term_namespaces(term, pod: Pod):
+    return set(term.namespaces) if term.namespaces else {pod.namespace}
+
+
+def _term_matches_pod(term, src_pod: Pod, dst_pod: Pod) -> bool:
+    """Does `term` (belonging to src_pod) select dst_pod?
+    ref predicates.go podMatchesPodAffinityTerms."""
+    if dst_pod.namespace not in _term_namespaces(term, src_pod):
+        return False
+    sel = klabels.selector_from_label_selector(term.label_selector)
+    if sel is None:
+        return False
+    return sel.matches(dst_pod.labels)
+
+
+def _topo_value(node: Optional[Node], key: str) -> Optional[str]:
+    if node is None:
+        return None
+    return node.labels.get(key)
+
+
+# ---------------------------------------------------------------- predicates
+
+
+class CPUScheduler:
+    """Golden scheduler over plain objects.  `nodes` is the cluster; `pods`
+    are the scheduled/assumed pods (with spec.node_name set); `services` are
+    (namespace, selector-dict) pairs for SelectorSpread."""
+
+    def __init__(
+        self,
+        nodes: Sequence[Node],
+        pods: Sequence[Pod] = (),
+        services: Sequence[Tuple[str, Dict[str, str]]] = (),
+        max_vols: Tuple[float, ...] = (39, 16, 1e9, 16, 1e9),
+    ):
+        self.nodes = list(nodes)
+        self.pods = list(pods)
+        self.services = list(services)
+        self.max_vols = max_vols
+        self.by_node: Dict[str, List[Pod]] = defaultdict(list)
+        for p in self.pods:
+            if p.spec.node_name:
+                self.by_node[p.spec.node_name].append(p)
+        self.node_by_name = {n.name: n for n in self.nodes}
+
+    # ---- individual predicates (each returns True = fits) ----
+
+    def pod_fits_resources(self, pod: Pod, node: Node) -> bool:
+        alloc = node_allocatable(node)
+        used: Dict[str, float] = defaultdict(float)
+        for p in self.by_node[node.name]:
+            for k, v in pod_requests(p).items():
+                used[k] += v
+        used[RESOURCE_PODS] += len(self.by_node[node.name])
+        req = pod_requests(pod)
+        req[RESOURCE_PODS] = 1
+        for k, v in req.items():
+            if v <= 0:
+                continue
+            if used.get(k, 0.0) + v > alloc.get(k, 0.0):
+                return False
+        return True
+
+    def pod_fits_host(self, pod: Pod, node: Node) -> bool:
+        return not pod.spec.node_name or pod.spec.node_name == node.name
+
+    def pod_fits_host_ports(self, pod: Pod, node: Node) -> bool:
+        want = [(p.protocol or "TCP", p.host_ip or "0.0.0.0", p.host_port) for p in pod.host_ports()]
+        if not want:
+            return True
+        have = []
+        for p in self.by_node[node.name]:
+            for cp in p.host_ports():
+                have.append((cp.protocol or "TCP", cp.host_ip or "0.0.0.0", cp.host_port))
+        for proto, ip, port in want:
+            for hproto, hip, hport in have:
+                if proto == hproto and port == hport:
+                    if ip == hip or ip == "0.0.0.0" or hip == "0.0.0.0":
+                        return False
+        return True
+
+    def pod_match_node_selector(self, pod: Pod, node: Node) -> bool:
+        for k, v in pod.spec.node_selector.items():
+            if node.labels.get(k) != v:
+                return False
+        aff = pod.spec.affinity
+        na = aff.node_affinity if aff else None
+        if na and na.required is not None:
+            if not any(match_node_selector_term(t, node) for t in na.required.terms):
+                return False
+        return True
+
+    def pod_tolerates_node_taints(self, pod: Pod, node: Node, effects=(TAINT_NO_SCHEDULE, TAINT_NO_EXECUTE)) -> bool:
+        for t in node.spec.taints:
+            if t.effect in effects and not tolerations_tolerate(pod, t):
+                return False
+        return True
+
+    def check_node_unschedulable(self, pod: Pod, node: Node) -> bool:
+        if not node.spec.unschedulable:
+            return True
+        return tolerations_tolerate(
+            pod, Taint(key=TAINT_NODE_UNSCHEDULABLE, effect=TAINT_NO_SCHEDULE)
+        )
+
+    def check_node_condition(self, pod: Pod, node: Node) -> bool:
+        c = node.status.conditions
+        return not (
+            c.get("Ready", "True") != "True"
+            or c.get("OutOfDisk", "False") == "True"
+            or c.get("NetworkUnavailable", "False") == "True"
+        )
+
+    def check_node_memory_pressure(self, pod: Pod, node: Node) -> bool:
+        if node.status.conditions.get("MemoryPressure", "False") != "True":
+            return True
+        return not is_best_effort(pod)
+
+    def check_node_disk_pressure(self, pod: Pod, node: Node) -> bool:
+        return node.status.conditions.get("DiskPressure", "False") != "True"
+
+    def check_node_pid_pressure(self, pod: Pod, node: Node) -> bool:
+        return node.status.conditions.get("PIDPressure", "False") != "True"
+
+    @staticmethod
+    def _disk_vols(pod: Pod) -> List[str]:
+        out = []
+        for v in pod.spec.volumes:
+            if "gcePersistentDisk" in v:
+                out.append("gce/" + v["gcePersistentDisk"].get("pdName", ""))
+            elif "awsElasticBlockStore" in v:
+                out.append("ebs/" + v["awsElasticBlockStore"].get("volumeID", ""))
+            elif "rbd" in v:
+                r = v["rbd"]
+                out.append("rbd/%s/%s/%s" % (",".join(r.get("monitors", [])), r.get("pool", "rbd"), r.get("image", "")))
+            elif "iscsi" in v:
+                r = v["iscsi"]
+                out.append("iscsi/%s/%s/%s" % (r.get("targetPortal", ""), r.get("iqn", ""), r.get("lun", 0)))
+        return out
+
+    @staticmethod
+    def _vol_type_counts(pod: Pod) -> List[float]:
+        counts = [0.0] * 5
+        for v in pod.spec.volumes:
+            if "awsElasticBlockStore" in v:
+                counts[0] += 1
+            elif "gcePersistentDisk" in v:
+                counts[1] += 1
+            elif "azureDisk" in v:
+                counts[3] += 1
+            elif "cinder" in v:
+                counts[4] += 1
+        return counts
+
+    def no_disk_conflict(self, pod: Pod, node: Node) -> bool:
+        mine = set(self._disk_vols(pod))
+        if not mine:
+            return True
+        for p in self.by_node[node.name]:
+            if mine & set(self._disk_vols(p)):
+                return False
+        return True
+
+    def max_volume_counts(self, pod: Pod, node: Node) -> bool:
+        new = self._vol_type_counts(pod)
+        if not any(new):
+            return True
+        used = [0.0] * 5
+        for p in self.by_node[node.name]:
+            for i, c in enumerate(self._vol_type_counts(p)):
+                used[i] += c
+        return all(
+            not (new[i] > 0 and used[i] + new[i] > self.max_vols[i]) for i in range(5)
+        )
+
+    def match_inter_pod_affinity(self, pod: Pod, node: Node) -> bool:
+        """ref predicates.go InterPodAffinityMatches (:1196-1509)."""
+        # 1. existing pods' required anti-affinity
+        for other in self.pods:
+            onode = self.node_by_name.get(other.spec.node_name)
+            if onode is None:
+                continue
+            aff = other.spec.affinity
+            if not (aff and aff.pod_anti_affinity):
+                continue
+            for term in aff.pod_anti_affinity.required:
+                if not _term_matches_pod(term, other, pod):
+                    continue
+                tv = _topo_value(onode, term.topology_key)
+                if tv is not None and _topo_value(node, term.topology_key) == tv:
+                    return False
+        aff = pod.spec.affinity
+        if aff is None:
+            return True
+        # 2. own anti-affinity
+        if aff.pod_anti_affinity:
+            for term in aff.pod_anti_affinity.required:
+                for other in self.pods:
+                    onode = self.node_by_name.get(other.spec.node_name)
+                    if onode is None:
+                        continue
+                    if not _term_matches_pod(term, pod, other):
+                        continue
+                    tv = _topo_value(onode, term.topology_key)
+                    if tv is not None and _topo_value(node, term.topology_key) == tv:
+                        return False
+        # 3. own required affinity
+        if aff.pod_affinity:
+            for term in aff.pod_affinity.required:
+                matches_any = False
+                satisfied = False
+                for other in self.pods:
+                    onode = self.node_by_name.get(other.spec.node_name)
+                    if onode is None or not _term_matches_pod(term, pod, other):
+                        continue
+                    matches_any = True
+                    tv = _topo_value(onode, term.topology_key)
+                    if tv is not None and _topo_value(node, term.topology_key) == tv:
+                        satisfied = True
+                        break
+                if satisfied:
+                    continue
+                # first-pod bootstrap: no matching pod anywhere and the term
+                # matches the incoming pod itself, on nodes having the key
+                if (
+                    not matches_any
+                    and _term_matches_pod(term, pod, pod)
+                    and _topo_value(node, term.topology_key) is not None
+                ):
+                    continue
+                return False
+        return True
+
+    # ---- combined filter, reference ordering ----
+
+    def predicates(self, pod: Pod, node: Node) -> Dict[str, bool]:
+        res = self.pod_fits_resources(pod, node)
+        host = self.pod_fits_host(pod, node)
+        ports = self.pod_fits_host_ports(pod, node)
+        sel = self.pod_match_node_selector(pod, node)
+        vols = self.max_volume_counts(pod, node)
+        return {
+            "CheckNodeCondition": self.check_node_condition(pod, node),
+            "CheckNodeUnschedulable": self.check_node_unschedulable(pod, node),
+            "GeneralPredicates": res and host and ports and sel,
+            "PodFitsHost": host,
+            "PodFitsHostPorts": ports,
+            "PodMatchNodeSelector": sel,
+            "PodFitsResources": res,
+            "NoDiskConflict": self.no_disk_conflict(pod, node),
+            "PodToleratesNodeTaints": self.pod_tolerates_node_taints(pod, node),
+            "PodToleratesNodeNoExecuteTaints": self.pod_tolerates_node_taints(
+                pod, node, effects=(TAINT_NO_EXECUTE,)
+            ),
+            "CheckNodeLabelPresence": True,
+            "CheckServiceAffinity": True,
+            "MaxEBSVolumeCount": vols,
+            "MaxGCEPDVolumeCount": vols,
+            "MaxCSIVolumeCount": True,
+            "MaxAzureDiskVolumeCount": vols,
+            "MaxCinderVolumeCount": vols,
+            "CheckVolumeBinding": True,
+            "NoVolumeZoneConflict": True,
+            "CheckNodeMemoryPressure": self.check_node_memory_pressure(pod, node),
+            "CheckNodePIDPressure": self.check_node_pid_pressure(pod, node),
+            "CheckNodeDiskPressure": self.check_node_disk_pressure(pod, node),
+            "MatchInterPodAffinity": self.match_inter_pod_affinity(pod, node),
+        }
+
+    def fits(self, pod: Pod, node: Node) -> bool:
+        return all(self.predicates(pod, node).values())
+
+    # ------------------------------------------------------------ priorities
+
+    def _used_nonzero(self, node: Node) -> Tuple[float, float]:
+        cpu = mem = 0.0
+        for p in self.by_node[node.name]:
+            c, m = nonzero_requests(p)
+            cpu += c
+            mem += m
+        return cpu, mem
+
+    @staticmethod
+    def _least_score(requested: float, capacity: float) -> int:
+        if capacity == 0 or requested > capacity:
+            return 0
+        return int((capacity - requested) * MAX_PRIORITY // capacity)
+
+    @staticmethod
+    def _most_score(requested: float, capacity: float) -> int:
+        if capacity == 0 or requested > capacity:
+            return 0
+        return int(requested * MAX_PRIORITY // capacity)
+
+    def least_requested(self, pod: Pod, node: Node) -> int:
+        pc, pm = nonzero_requests(pod)
+        uc, um = self._used_nonzero(node)
+        alloc = node_allocatable(node)
+        return (
+            self._least_score(pc + uc, alloc.get(RESOURCE_CPU, 0.0))
+            + self._least_score(pm + um, alloc.get(RESOURCE_MEMORY, 0.0))
+        ) // 2
+
+    def most_requested(self, pod: Pod, node: Node) -> int:
+        pc, pm = nonzero_requests(pod)
+        uc, um = self._used_nonzero(node)
+        alloc = node_allocatable(node)
+        return (
+            self._most_score(pc + uc, alloc.get(RESOURCE_CPU, 0.0))
+            + self._most_score(pm + um, alloc.get(RESOURCE_MEMORY, 0.0))
+        ) // 2
+
+    def balanced_allocation(self, pod: Pod, node: Node) -> int:
+        pc, pm = nonzero_requests(pod)
+        uc, um = self._used_nonzero(node)
+        alloc = node_allocatable(node)
+        ccap = alloc.get(RESOURCE_CPU, 0.0)
+        mcap = alloc.get(RESOURCE_MEMORY, 0.0)
+        if ccap == 0 or mcap == 0:
+            return 0
+        cf = (pc + uc) / ccap
+        mf = (pm + um) / mcap
+        if cf >= 1 or mf >= 1:
+            return 0
+        return int((1 - abs(cf - mf)) * MAX_PRIORITY)
+
+    def node_affinity_counts(self, pod: Pod) -> Dict[str, int]:
+        counts = {}
+        aff = pod.spec.affinity
+        na = aff.node_affinity if aff else None
+        for node in self.nodes:
+            c = 0
+            if na:
+                for pt in na.preferred:
+                    term = pt.preference
+                    ok = all(
+                        klabels.Requirement(e.key, e.operator, tuple(e.values)).matches(node.labels)
+                        for e in term.match_expressions
+                    ) and bool(term.match_expressions)
+                    if ok:
+                        c += pt.weight
+            counts[node.name] = c
+        return counts
+
+    def taint_tol_counts(self, pod: Pod) -> Dict[str, int]:
+        counts = {}
+        for node in self.nodes:
+            c = 0
+            for t in node.spec.taints:
+                if t.effect == TAINT_PREFER_NO_SCHEDULE and not tolerations_tolerate(pod, t):
+                    c += 1
+            counts[node.name] = c
+        return counts
+
+    @staticmethod
+    def _normalize(counts: Dict[str, int], reverse: bool) -> Dict[str, int]:
+        maxc = max(counts.values()) if counts else 0
+        if maxc == 0:
+            return {k: (MAX_PRIORITY if reverse else 0) for k in counts}
+        out = {}
+        for k, v in counts.items():
+            s = MAX_PRIORITY * v // maxc
+            out[k] = MAX_PRIORITY - s if reverse else s
+        return out
+
+    def selector_spread(self, pod: Pod) -> Dict[str, int]:
+        """ref priorities/selector_spreading.go CalculateSpreadPriorityMap/Reduce."""
+        selectors = [
+            klabels.selector_from_match_labels(sel)
+            for ns, sel in self.services
+            if ns == pod.namespace and klabels.selector_from_match_labels(sel).matches(pod.labels)
+        ]
+        counts: Dict[str, int] = {}
+        for node in self.nodes:
+            c = 0
+            for p in self.by_node[node.name]:
+                if p.namespace != pod.namespace:
+                    continue
+                for sel in selectors:
+                    if sel.matches(p.labels):
+                        c += 1
+                        break
+            counts[node.name] = c
+        max_node = max(counts.values()) if counts else 0
+        zone_counts: Dict[str, int] = defaultdict(int)
+        have_zones = False
+        for node in self.nodes:
+            z = node.labels.get(ZONE_KEY)
+            if z is not None:
+                have_zones = True
+                zone_counts[z] += counts[node.name]
+        max_zone = max(zone_counts.values()) if zone_counts else 0
+        out = {}
+        for node in self.nodes:
+            if max_node > 0:
+                f = MAX_PRIORITY * (max_node - counts[node.name]) / max_node
+            else:
+                f = MAX_PRIORITY
+            z = node.labels.get(ZONE_KEY)
+            if have_zones and z is not None:
+                if max_zone > 0:
+                    zs = MAX_PRIORITY * (max_zone - zone_counts[z]) / max_zone
+                else:
+                    zs = MAX_PRIORITY
+                f = (1 - ZONE_WEIGHTING) * f + ZONE_WEIGHTING * zs
+            out[node.name] = int(f)
+        return out
+
+    def image_locality(self, pod: Pod) -> Dict[str, int]:
+        mb = 1024 * 1024
+        min_t, max_t = 23 * mb, 1000 * mb
+        total = max(len(self.nodes), 1)
+        num_nodes: Dict[str, int] = defaultdict(int)
+        for node in self.nodes:
+            for img in node.status.images:
+                if img.names:
+                    num_nodes[img.names[0]] += 1
+        out = {}
+        for node in self.nodes:
+            sizes = {}
+            for img in node.status.images:
+                if img.names:
+                    sizes[img.names[0]] = img.size_bytes
+            s = 0
+            for c in pod.spec.containers:
+                if c.image in sizes:
+                    s += int(sizes[c.image] * (num_nodes[c.image] / total))
+            s = min(max(s, min_t), max_t)
+            out[node.name] = int(MAX_PRIORITY * (s - min_t) // (max_t - min_t))
+        return out
+
+    def node_prefer_avoid(self, pod: Pod) -> Dict[str, int]:
+        out = {}
+        owner = pod.metadata.owner_uid
+        applies = pod.metadata.owner_kind in ("ReplicationController", "ReplicaSet")
+        for node in self.nodes:
+            score = MAX_PRIORITY
+            ann = node.metadata.annotations.get(
+                "scheduler.alpha.kubernetes.io/preferAvoidPods"
+            )
+            if ann and applies and owner:
+                try:
+                    avoid = json.loads(ann)
+                    for e in avoid.get("preferAvoidPods", []):
+                        uid = e.get("podSignature", {}).get("podController", {}).get("uid", "")
+                        if uid == owner:
+                            score = 0
+                except ValueError:
+                    pass
+            out[node.name] = score
+        return out
+
+    def inter_pod_affinity_score(self, pod: Pod, hard_weight: float = 1.0) -> Dict[str, int]:
+        """ref priorities/interpod_affinity.go CalculateInterPodAffinityPriority."""
+        sums: Dict[str, float] = {n.name: 0.0 for n in self.nodes}
+
+        def bump(topo_key: str, anchor_node: Node, w: float):
+            tv = _topo_value(anchor_node, topo_key)
+            if tv is None:
+                return
+            for node in self.nodes:
+                if _topo_value(node, topo_key) == tv:
+                    sums[node.name] += w
+
+        aff = pod.spec.affinity
+        for other in self.pods:
+            onode = self.node_by_name.get(other.spec.node_name)
+            if onode is None:
+                continue
+            # incoming pod's preferred terms matching the existing pod
+            if aff and aff.pod_affinity:
+                for wt in aff.pod_affinity.preferred:
+                    if _term_matches_pod(wt.term, pod, other):
+                        bump(wt.term.topology_key, onode, float(wt.weight))
+            if aff and aff.pod_anti_affinity:
+                for wt in aff.pod_anti_affinity.preferred:
+                    if _term_matches_pod(wt.term, pod, other):
+                        bump(wt.term.topology_key, onode, -float(wt.weight))
+            oaff = other.spec.affinity
+            # existing pods' preferred terms matching the incoming pod
+            if oaff and oaff.pod_affinity:
+                for wt in oaff.pod_affinity.preferred:
+                    if _term_matches_pod(wt.term, other, pod):
+                        bump(wt.term.topology_key, onode, float(wt.weight))
+                if hard_weight > 0:
+                    for term in oaff.pod_affinity.required:
+                        if _term_matches_pod(term, other, pod):
+                            bump(term.topology_key, onode, hard_weight)
+            if oaff and oaff.pod_anti_affinity:
+                for wt in oaff.pod_anti_affinity.preferred:
+                    if _term_matches_pod(wt.term, other, pod):
+                        bump(wt.term.topology_key, onode, -float(wt.weight))
+        mx = max(sums.values()) if sums else 0.0
+        mn = min(sums.values()) if sums else 0.0
+        out = {}
+        for name, s in sums.items():
+            if mx - mn > 0:
+                out[name] = int(MAX_PRIORITY * (s - mn) / (mx - mn))
+            else:
+                out[name] = 0
+        return out
+
+    def priorities(self, pod: Pod) -> Dict[str, Dict[str, int]]:
+        na = self._normalize(self.node_affinity_counts(pod), reverse=False)
+        tt = self._normalize(self.taint_tol_counts(pod), reverse=True)
+        out = {
+            "SelectorSpreadPriority": self.selector_spread(pod),
+            "InterPodAffinityPriority": self.inter_pod_affinity_score(pod),
+            "LeastRequestedPriority": {
+                n.name: self.least_requested(pod, n) for n in self.nodes
+            },
+            "BalancedResourceAllocation": {
+                n.name: self.balanced_allocation(pod, n) for n in self.nodes
+            },
+            "NodePreferAvoidPodsPriority": self.node_prefer_avoid(pod),
+            "NodeAffinityPriority": na,
+            "TaintTolerationPriority": tt,
+            "ImageLocalityPriority": self.image_locality(pod),
+        }
+        return out
+
+    def total_scores(self, pod: Pod, weights: Optional[Dict[str, float]] = None) -> Dict[str, float]:
+        from kubernetes_tpu.codec.schema import DEFAULT_PRIORITY_WEIGHTS, PRIORITY_ORDER
+
+        if weights is None:
+            weights = dict(zip(PRIORITY_ORDER, DEFAULT_PRIORITY_WEIGHTS))
+        per = self.priorities(pod)
+        totals: Dict[str, float] = defaultdict(float)
+        for pname, scores in per.items():
+            for node, s in scores.items():
+                totals[node] += s * weights.get(pname, 1.0)
+        return dict(totals)
+
+    # ------------------------------------------------------------ preemption
+
+    def _fits_resources_minus(self, pod: Pod, node: Node, removed) -> bool:
+        """PodFitsResources with a victim set removed (what-if)."""
+        alloc = node_allocatable(node)
+        used: Dict[str, float] = defaultdict(float)
+        count = 0
+        for p in self.by_node[node.name]:
+            if (p.namespace, p.name) in removed:
+                continue
+            count += 1
+            for k, v in pod_requests(p).items():
+                used[k] += v
+        used[RESOURCE_PODS] += count
+        req = pod_requests(pod)
+        req[RESOURCE_PODS] = 1
+        for k, v in req.items():
+            if v <= 0:
+                continue
+            if used.get(k, 0.0) + v > alloc.get(k, 0.0):
+                return False
+        return True
+
+    def select_victims_on_node(self, pod: Pod, node: Node):
+        """selectVictimsOnNode (generic_scheduler.go:1054-1128): evict all
+        lower-priority pods, then reprieve highest-priority-first while the
+        preemptor still fits.  Returns victim key set or None if impossible."""
+        potential = [
+            p
+            for p in self.by_node[node.name]
+            if p.spec.priority < pod.spec.priority
+        ]
+        removed = {(p.namespace, p.name) for p in potential}
+        if not self._fits_resources_minus(pod, node, removed):
+            return None
+        for p in sorted(potential, key=lambda q: -q.spec.priority):
+            key = (p.namespace, p.name)
+            removed.discard(key)
+            if not self._fits_resources_minus(pod, node, removed):
+                removed.add(key)
+        return removed
+
+    def preempt(self, pod: Pod):
+        """Preempt (:310-369) + pickOneNodeForPreemption criteria 1-3.
+        Only resource-resolvable failures are considered (matching the
+        device model's scope)."""
+        best = None
+        for node in self.nodes:
+            preds = self.predicates(pod, node)
+            if all(preds.values()):
+                continue
+            resolvable = all(
+                preds[p]
+                for p in (
+                    "CheckNodeCondition", "CheckNodeUnschedulable", "PodFitsHost",
+                    "PodMatchNodeSelector", "PodToleratesNodeTaints",
+                    "PodToleratesNodeNoExecuteTaints", "CheckNodeMemoryPressure",
+                    "CheckNodePIDPressure", "CheckNodeDiskPressure",
+                    "MaxEBSVolumeCount", "MaxGCEPDVolumeCount", "MaxCSIVolumeCount",
+                    "MaxAzureDiskVolumeCount", "MaxCinderVolumeCount",
+                )
+            )
+            if not resolvable:
+                continue
+            victims = self.select_victims_on_node(pod, node)
+            if victims is None:
+                continue
+            vic_pods = [p for p in self.by_node[node.name] if (p.namespace, p.name) in victims]
+            max_p = max((p.spec.priority for p in vic_pods), default=-(2**31))
+            sum_p = sum(p.spec.priority for p in vic_pods)
+            key = (max_p, sum_p, len(vic_pods))
+            if best is None or key < best[0]:
+                best = (key, node.name, victims)
+        if best is None:
+            return None, set()
+        return best[1], best[2]
+
+    # ------------------------------------------------------------- schedule
+
+    def schedule(self, pod: Pod, last_index: int = 0) -> Tuple[Optional[str], int]:
+        """Full schedule cycle: filter + score + selectHost round-robin
+        (generic_scheduler.go:184-296).  Returns (node name or None, ties)."""
+        feasible = [n for n in self.nodes if self.fits(pod, n)]
+        if not feasible:
+            return None, 0
+        totals = self.total_scores(pod)
+        best = max(totals[n.name] for n in feasible)
+        ties = [n.name for n in feasible if totals[n.name] == best]
+        return ties[last_index % len(ties)], len(ties)
+
+
+def run_predicates(pod: Pod, nodes, pods=(), services=()) -> Dict[str, Dict[str, bool]]:
+    s = CPUScheduler(nodes, pods, services)
+    return {n.name: s.predicates(pod, n) for n in nodes}
+
+
+def run_priorities(pod: Pod, nodes, pods=(), services=()) -> Dict[str, Dict[str, int]]:
+    return CPUScheduler(nodes, pods, services).priorities(pod)

@@ -1,0 +1,18 @@
+"""Scheduling algorithms composed from ops/ kernels.
+
+  generic.py  — independent Filter/Score over a pod batch in one launch
+                (the ScheduleAlgorithm.Schedule analog,
+                ref core/generic_scheduler.go:184-254)
+  batched.py  — sequential-commit batch scheduling under lax.scan: B pods
+                placed in ONE device launch with on-device state updates
+                between pods (the >=10k pods/s path; no reference analog —
+                the reference schedules strictly one pod at a time)
+  preemption.py — vectorized preemption what-if (ref Preempt :310-369)
+"""
+
+from kubernetes_tpu.models.generic import schedule_batch_independent
+from kubernetes_tpu.models.batched import (
+    BatchPortState,
+    encode_batch_ports,
+    make_sequential_scheduler,
+)

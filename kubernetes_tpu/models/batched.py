@@ -1,0 +1,251 @@
+"""Sequential-commit batch scheduling: B pods in ONE device launch.
+
+The reference schedules strictly one pod per cycle (scheduler.go:438
+scheduleOne); at 5k nodes that caps throughput at the per-cycle host latency.
+Here the host loop drains B pods from the queue, encodes them once, and a
+single jitted program places them *sequentially* under `lax.scan`: each step
+filters+scores pod i against the *current* on-device cluster state, picks a
+host (argmax + round-robin tie-break), and commits the placement by updating
+the dynamic state columns — so pod i+1 sees pod i's resources, ports, and
+spreading counts exactly as if the reference had scheduled them one by one.
+
+Dynamic state inside the scan (everything else is precomputed static):
+  requested[N, R], nonzero[N, 2]        — PodFitsResources + resource scores
+  group_counts[N, G]                    — SelectorSpreadPriority
+  port_used[N, PV]                      — PodFitsHostPorts within the batch,
+                                          over a batch-local port vocabulary
+                                          with a precomputed conflict matrix
+                                          (wildcard-IP semantics preserved)
+
+Known batch-semantics gap (tracked in PARITY.md): inter-pod affinity terms of
+pods in the same batch do not see each other's placements yet; anti-affinity
+heavy workloads should use batch=1 until the pair-count state moves into the
+scan.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.codec.schema import (
+    ClusterTensors,
+    FilterConfig,
+    PAD,
+    PodBatch,
+    _pow2,
+)
+from kubernetes_tpu.ops.predicates import filter_batch
+from kubernetes_tpu.ops.priorities import (
+    balanced_allocation_score,
+    inter_pod_affinity_score,
+    image_locality,
+    least_requested_score,
+    node_affinity,
+    node_capacity2,
+    node_prefer_avoid_pods,
+    pod_group_onehot,
+    spread_score_from_counts,
+    taint_toleration,
+)
+from kubernetes_tpu.ops.select import select_host
+from kubernetes_tpu.codec.schema import DEFAULT_PRIORITY_WEIGHTS, PRIO_INDEX
+
+
+@dataclass
+class BatchPortState:
+    """Batch-local host-port vocabulary (see module docstring)."""
+
+    pod_ports: Any      # bool[B, PV]  ports requested by each pod
+    conflict: Any       # bool[PV, PV] do two batch ports conflict
+    node_conflict: Any  # bool[N, PV]  does the node's existing occupancy conflict
+
+
+jax.tree_util.register_dataclass(
+    BatchPortState,
+    data_fields=["pod_ports", "conflict", "node_conflict"],
+    meta_fields=[],
+)
+
+
+def encode_batch_ports(encoder, pods: Sequence, n_cap: int) -> BatchPortState:
+    """Host-side precompute of the batch port vocabulary.
+
+    Conflict semantics mirror nodeinfo/host_ports.go CheckConflict:
+    same protocol+port and (same IP or either wildcard)."""
+    vocab = {}
+    plist = []
+    for pod in pods:
+        for pp, ip in encoder._pod_ports(pod):
+            if (pp, ip) not in vocab:
+                vocab[(pp, ip)] = len(plist)
+                plist.append((pp, ip))
+    PV = _pow2(max(len(plist), 1))
+    B = _pow2(max(len(pods), 1, encoder.dims.B))
+    pod_ports = np.zeros((B, PV), bool)
+    for b, pod in enumerate(pods):
+        for pp, ip in encoder._pod_ports(pod):
+            pod_ports[b, vocab[(pp, ip)]] = True
+    conflict = np.zeros((PV, PV), bool)
+    for i, (pp1, ip1) in enumerate(plist):
+        for j, (pp2, ip2) in enumerate(plist):
+            conflict[i, j] = pp1 == pp2 and (ip1 == ip2 or ip1 == 0 or ip2 == 0)
+    node_conflict = np.zeros((n_cap, PV), bool)
+    for row, ports in encoder._node_ports.items():
+        for (npp, nip) in ports:
+            for v, (pp, ip) in enumerate(plist):
+                if pp == npp and (ip == nip or ip == 0 or nip == 0):
+                    node_conflict[row, v] = True
+    return BatchPortState(
+        pod_ports=pod_ports, conflict=conflict, node_conflict=node_conflict
+    )
+
+
+def _dynamic_scores(cluster, req_cpu_mem, requested2, zone_key_id, group_counts, group_onehot):
+    """The three state-dependent priorities, recomputed per scan step from the
+    shared scoring cores in ops/priorities.py.
+
+    req_cpu_mem: f32[2] nonzero request of the current pod;
+    requested2: f32[N, 2] current nonzero usage;
+    group_onehot: f32[G] the pod's spread groups."""
+    cap = node_capacity2(cluster)                            # [N, 2]
+    req = requested2 + req_cpu_mem[None, :]
+    least = least_requested_score(req, cap)                  # [N]
+    balanced = balanced_allocation_score(req, cap)
+    counts = group_counts @ group_onehot                     # [N]
+    spread = spread_score_from_counts(counts, cluster, zone_key_id)
+    return least, balanced, spread
+
+
+_SEQ_CACHE = {}
+
+
+def make_sequential_scheduler(
+    cfg: FilterConfig = FilterConfig(),
+    weights=None,
+    unsched_taint_key: int = 0,
+    zone_key_id: int = 3,
+):
+    """Build (or fetch the memoized) jitted sequential-commit scheduler.
+
+    Returns fn(cluster, pods, ports: BatchPortState, last_index0) ->
+      (hosts i32[B] (-1 = unschedulable), new_cluster) where new_cluster has
+      the committed requested/nonzero/group_counts columns."""
+    key = (
+        cfg,
+        tuple(np.asarray(weights, np.float32)) if weights is not None else None,
+        unsched_taint_key,
+        zone_key_id,
+    )
+    hit = _SEQ_CACHE.get(key)
+    if hit is not None:
+        return hit
+    w = np.asarray(
+        DEFAULT_PRIORITY_WEIGHTS if weights is None else weights, np.float32
+    )
+    w_least = float(w[PRIO_INDEX["LeastRequestedPriority"]])
+    w_bal = float(w[PRIO_INDEX["BalancedResourceAllocation"]])
+    w_spread = float(w[PRIO_INDEX["SelectorSpreadPriority"]])
+
+    @jax.jit
+    def schedule(cluster: ClusterTensors, pods: PodBatch, ports: BatchPortState,
+                 last_index0: jnp.ndarray):
+        B = pods.n_pods
+        G = cluster.group_counts.shape[1]
+        # ---- static pass: every predicate except the dynamic ones, plus the
+        # static score components, in one batched launch
+        mask_static, per_pred = filter_batch(cluster, pods, cfg, unsched_taint_key)
+        # static mask must EXCLUDE resources (recomputed in-scan); keep the
+        # initial ports check (vs pre-batch occupancy) — in-scan adds claims.
+        from kubernetes_tpu.codec.schema import PRED_INDEX
+
+        res_idx = PRED_INDEX["PodFitsResources"]
+        gen_idx = PRED_INDEX["GeneralPredicates"]
+        non_resource = jnp.ones((per_pred.shape[1],), bool)
+        non_resource = non_resource.at[res_idx].set(False)
+        non_resource = non_resource.at[gen_idx].set(False)
+        static_mask = jnp.all(per_pred | ~non_resource[None, :, None], axis=1)
+        # GeneralPredicates minus resources = host+ports+selector
+        host_idx = PRED_INDEX["PodFitsHost"]
+        ports_idx = PRED_INDEX["PodFitsHostPorts"]
+        sel_idx = PRED_INDEX["PodMatchNodeSelector"]
+        static_mask = (
+            static_mask
+            & per_pred[:, host_idx]
+            & per_pred[:, ports_idx]
+            & per_pred[:, sel_idx]
+            & cluster.valid[None]
+            & pods.valid[:, None]
+        )
+        # static score components (everything but least/balanced/spread)
+        static_score = (
+            w[PRIO_INDEX["InterPodAffinityPriority"]] * inter_pod_affinity_score(cluster, pods)
+            + w[PRIO_INDEX["NodePreferAvoidPodsPriority"]] * node_prefer_avoid_pods(cluster, pods)
+            + w[PRIO_INDEX["NodeAffinityPriority"]] * node_affinity(cluster, pods)
+            + w[PRIO_INDEX["TaintTolerationPriority"]] * taint_toleration(cluster, pods)
+            + w[PRIO_INDEX["ImageLocalityPriority"]] * image_locality(cluster, pods)
+        )
+        group_onehot = pod_group_onehot(pods, G)              # [B, G]
+
+        def step(state, xs):
+            requested, nonzero2, group_counts, port_used, last_idx = state
+            smask, sscore, req, nz2, gonehot, pport = xs
+            # dynamic resource fit (PodFitsResources on current state)
+            fit = ~jnp.any(
+                (req[None, :] > 0)
+                & (requested + req[None, :] > cluster.allocatable),
+                axis=-1,
+            )
+            # in-batch port conflicts: used claims x conflict matrix
+            claimed_conflict = (port_used.astype(jnp.float32) @ ports.conflict.astype(jnp.float32)) > 0
+            port_bad = jnp.any(pport[None, :] & claimed_conflict, axis=-1)
+            mask = smask & fit & ~port_bad
+            least, balanced, spread = _dynamic_scores(
+                cluster, nz2, nonzero2, zone_key_id, group_counts, gonehot
+            )
+            total = sscore + w_least * least + w_bal * balanced + w_spread * spread
+            host, feasible = select_host(total, mask, last_idx)
+            # commit
+            commit = feasible
+            onehot = (jnp.arange(requested.shape[0]) == host) & commit  # [N]
+            requested = requested + onehot[:, None] * req[None, :]
+            nonzero2 = nonzero2 + onehot[:, None] * nz2[None, :]
+            group_counts = group_counts + onehot[:, None] * gonehot[None, :]
+            port_used = port_used | (onehot[:, None] & pport[None, :])
+            out_host = jnp.where(feasible, host, -1)
+            return (requested, nonzero2, group_counts, port_used, last_idx + 1), out_host
+
+        PV = ports.pod_ports.shape[1]
+        init = (
+            cluster.requested,
+            cluster.nonzero_req,
+            cluster.group_counts,
+            jnp.zeros((cluster.n_nodes, PV), bool),
+            last_index0.astype(jnp.int32),
+        )
+        xs = (
+            static_mask,
+            static_score,
+            pods.req,
+            pods.nonzero_req,
+            group_onehot,
+            ports.pod_ports,
+        )
+        (requested, nonzero2, group_counts, _, _), hosts = jax.lax.scan(step, init, xs)
+        import dataclasses as _dc
+
+        new_cluster = _dc.replace(
+            cluster,
+            requested=requested,
+            nonzero_req=nonzero2,
+            group_counts=group_counts,
+        )
+        return hosts, new_cluster
+
+    _SEQ_CACHE[key] = schedule
+    return schedule

@@ -1,0 +1,48 @@
+"""One-launch independent scheduling of a pod batch.
+
+The analog of genericScheduler.Schedule (core/generic_scheduler.go:184-254)
+for B pods at once *without* inter-pod commit effects: every pod sees the same
+snapshot.  This is the semantics a stock kube-scheduler gets from the extender
+seam (one pod per HTTP call), and the building block the sequential-commit
+model refines.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.codec.schema import ClusterTensors, FilterConfig, PodBatch
+from kubernetes_tpu.ops.predicates import filter_batch, first_failure
+from kubernetes_tpu.ops.priorities import score_batch
+from kubernetes_tpu.ops.select import select_hosts_batch
+
+
+@partial(jax.jit, static_argnames=("cfg", "unsched_taint_key", "zone_key_id"))
+def schedule_batch_independent(
+    cluster: ClusterTensors,
+    pods: PodBatch,
+    last_index0: int = 0,
+    cfg: FilterConfig = FilterConfig(),
+    unsched_taint_key: int = 0,
+    zone_key_id: int = 3,
+):
+    """Filter + Score + selectHost for every pod against one snapshot.
+
+    Returns dict with hosts i32[B] (winning node row), feasible bool[B],
+    mask bool[B,N], scores f32[B,N], failure i32[B,N] (first failing
+    predicate index, FitError attribution)."""
+    mask, per_pred = filter_batch(cluster, pods, cfg, unsched_taint_key)
+    total, per_prio = score_batch(cluster, pods)
+    hosts, feasible = select_hosts_batch(total, mask, last_index0)
+    return {
+        "hosts": hosts,
+        "feasible": feasible,
+        "mask": mask,
+        "scores": total,
+        "per_pred": per_pred,
+        "per_prio": per_prio,
+        "failure": first_failure(per_pred),
+    }

@@ -1,0 +1,163 @@
+"""Preemption as a vectorized what-if over all nodes at once.
+
+Reference flow (core/generic_scheduler.go):
+  Preempt (:310-369) -> nodesWherePreemptionMightHelp (failure must be
+  resolvable, :65-123 unresolvablePredicateFailureErrors) ->
+  selectNodesForPreemption over all nodes in parallel (:964-998) ->
+  selectVictimsOnNode remove-all-lower-priority + reprieve loop (:1054-1128)
+  -> pickOneNodeForPreemption lexicographic pick (:837-962).
+
+TPU shape:
+  * the "remove all lower-priority pods, does it fit?" what-if is one
+    segment-sum over the assigned-pod arena, for ALL nodes simultaneously;
+  * the reprieve loop — re-add victims highest-priority-first while the
+    preemptor still fits — runs as a lax.scan over the host-sorted victim
+    list.  Steps touching different nodes are independent, so one global
+    scan reprieves every candidate node in the same launch, exactly
+    reproducing the reference's per-node greedy (equal-priority order is
+    arena order; the reference uses pod start time there — pending, with
+    PDB-awareness, in PARITY.md);
+  * node pick: lexicographic (min highest-victim-priority, min priority-sum,
+    min victim-count) = criteria 2-4 of pickOneNodeForPreemption (PDB
+    violation count and start-time tie-breaks pending).
+
+The host then deletes the victims, records the nominated node on the
+preemptor (queue nominatedPods map), and requeues.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.codec.schema import ClusterTensors, PRED_INDEX
+
+# Failures preemption can NEVER fix (generic_scheduler.go:65-123):
+# evicting pods does not change node labels/taints/conditions/name.
+UNRESOLVABLE = (
+    "CheckNodeCondition",
+    "CheckNodeUnschedulable",
+    "PodFitsHost",
+    "PodMatchNodeSelector",
+    "PodToleratesNodeTaints",
+    "PodToleratesNodeNoExecuteTaints",
+    "CheckNodeLabelPresence",
+    "CheckNodeMemoryPressure",
+    "CheckNodePIDPressure",
+    "CheckNodeDiskPressure",
+    "NoVolumeZoneConflict",
+    "MaxEBSVolumeCount",
+    "MaxGCEPDVolumeCount",
+    "MaxCSIVolumeCount",
+    "MaxAzureDiskVolumeCount",
+    "MaxCinderVolumeCount",
+)
+
+INT_MIN = np.iinfo(np.int32).min
+INT_MAX = np.iinfo(np.int32).max
+
+
+class PreemptionResult(NamedTuple):
+    node: jnp.ndarray          # i32 chosen node row (-1 = preemption helps nowhere)
+    victim_mask: jnp.ndarray   # bool[M] pods to evict (on the chosen node)
+    n_victims: jnp.ndarray     # i32
+
+
+def preemption_candidates(per_pred, valid):
+    """bool[B, N]: nodes where preemption might help — the pod does not fit,
+    but no unresolvable predicate failed (nodesWherePreemptionMightHelp)."""
+    fits = jnp.all(per_pred, axis=1)
+    unresolvable_idx = jnp.asarray([PRED_INDEX[p] for p in UNRESOLVABLE])
+    hard_fail = jnp.any(~per_pred[:, unresolvable_idx, :], axis=1)
+    return (~fits) & (~hard_fail) & valid[None]
+
+
+def sorted_victim_slots(pods_priority, pods_valid, pods_node, pod_priority,
+                        cap: int = 1024):
+    """Host helper: arena indices of potential victims, highest priority
+    first (the reprieve order, generic_scheduler.go:1085-1103), -1-padded to
+    a power of two."""
+    prio = np.asarray(pods_priority)
+    ok = np.asarray(pods_valid) & (np.asarray(pods_node) >= 0) & (prio < pod_priority)
+    idx = np.nonzero(ok)[0]
+    idx = idx[np.argsort(-prio[idx], kind="stable")]
+    k = 1
+    while k < max(len(idx), 1) and k < cap:
+        k *= 2
+    idx = idx[:k]
+    out = np.full(k, -1, np.int32)
+    out[: len(idx)] = idx
+    return out
+
+
+@jax.jit
+def preempt_one(
+    cluster: ClusterTensors,
+    pod_req: jnp.ndarray,       # f32[R] the preemptor's request
+    candidates: jnp.ndarray,    # bool[N] from preemption_candidates
+    pods_node: jnp.ndarray,     # i32[M] arena: pod -> node row (-1 unassigned)
+    pods_priority: jnp.ndarray, # i32[M]
+    pods_req: jnp.ndarray,      # f32[M, R]
+    victim_slots: jnp.ndarray,  # i32[Kv] from sorted_victim_slots
+) -> PreemptionResult:
+    N = cluster.n_nodes
+    M = pods_node.shape[0]
+    # pad slots (-1) are redirected out of bounds and dropped — a plain
+    # where(...,0) would race duplicate writes against arena index 0
+    slot_idx = jnp.where(victim_slots >= 0, victim_slots, M)
+    listed = jnp.zeros(M, bool).at[slot_idx].set(True, mode="drop")
+    seg = jnp.where(pods_node >= 0, pods_node, N)
+    freed_all = jax.ops.segment_sum(
+        pods_req * listed[:, None].astype(jnp.float32), seg, num_segments=N + 1
+    )[:N]                                                    # [N, R]
+    need = pod_req[None] > 0
+
+    def fits(freed_row, node_row):
+        return ~jnp.any(
+            (pod_req > 0)
+            & (cluster.requested[node_row] - freed_row + pod_req
+               > cluster.allocatable[node_row])
+        )
+
+    fits_all = ~jnp.any(
+        need & (cluster.requested - freed_all + pod_req[None] > cluster.allocatable),
+        axis=-1,
+    )
+    possible = candidates & fits_all                         # [N]
+
+    # ---- reprieve: re-add victims (priority desc) while the pod still fits
+    def step(freed, m):
+        valid_slot = m >= 0
+        mi = jnp.maximum(m, 0)
+        n = jnp.clip(pods_node[mi], 0, N - 1)
+        new_row = freed[n] - pods_req[mi]
+        keep = fits(new_row, n) & valid_slot & possible[n]
+        freed = freed.at[n].set(jnp.where(keep, new_row, freed[n]))
+        return freed, keep
+
+    _, kept = jax.lax.scan(step, freed_all, victim_slots)
+    kept_mask = jnp.zeros(M, bool).at[slot_idx].set(kept, mode="drop")
+    vic_m = listed & ~kept_mask                              # final victims [M]
+
+    ones = vic_m.astype(jnp.int32)
+    n_victims = jax.ops.segment_sum(ones, seg, num_segments=N + 1)[:N]
+    sum_prio = jax.ops.segment_sum(pods_priority * ones, seg, num_segments=N + 1)[:N]
+    max_prio = jax.ops.segment_max(
+        jnp.where(vic_m, pods_priority, INT_MIN), seg, num_segments=N + 1
+    )[:N]
+
+    # lexicographic pick: min max_prio, then min sum_prio, then min n_victims
+    best = possible
+    m1 = jnp.min(jnp.where(best, max_prio, INT_MAX))
+    best = best & (max_prio == m1)
+    m2 = jnp.min(jnp.where(best, sum_prio, INT_MAX))
+    best = best & (sum_prio == m2)
+    m3 = jnp.min(jnp.where(best, n_victims, INT_MAX))
+    best = best & (n_victims == m3)
+    ok = jnp.any(possible)
+    node = jnp.where(ok, jnp.argmax(best).astype(jnp.int32), -1)
+    victim_mask = vic_m & (pods_node == node) & ok
+    return PreemptionResult(node, victim_mask, jnp.sum(victim_mask))

@@ -1,0 +1,15 @@
+"""Device kernels: the Filter/Score pipeline as pure tensor functions.
+
+Every op is a pure function `(ClusterTensors, PodBatch) -> [B, N] array`, so
+the whole pipeline — 23 predicates, 8 priorities, weighted sum, host pick —
+compiles to ONE XLA launch, replacing the reference's 16-goroutine per-node
+scan (ref pkg/scheduler/core/generic_scheduler.go:518,725).
+"""
+
+from kubernetes_tpu.ops.predicates import filter_batch, first_failure
+from kubernetes_tpu.ops.priorities import score_batch
+from kubernetes_tpu.ops.select import (
+    select_host,
+    select_hosts_batch,
+    num_feasible_nodes_to_find,
+)

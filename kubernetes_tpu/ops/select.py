@@ -1,0 +1,67 @@
+"""Host selection and the node-sampling knob.
+
+select_host reproduces the reference's argmax-with-round-robin-tie-break
+(core/generic_scheduler.go:268-296 selectHost/findMaxScores): among the
+feasible nodes with the maximum score, pick the (lastIndex % numTies)-th in
+node order, and advance lastIndex each cycle so repeated ties rotate.
+
+num_feasible_nodes_to_find reproduces the adaptive sampling formula
+(generic_scheduler.go:434-453).  The TPU path always scores every node in one
+launch, so the knob exists for semantic parity (and for the CPU fallback),
+not as a performance necessity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MIN_FEASIBLE_NODES_TO_FIND = 100          # generic_scheduler.go:52-57
+MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5  # generic_scheduler.go:58-63
+DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE = 50  # api/types.go:40
+
+
+def num_feasible_nodes_to_find(num_all_nodes: int, percentage: int = 0) -> int:
+    """generic_scheduler.go:434-453 numFeasibleNodesToFind."""
+    if num_all_nodes < MIN_FEASIBLE_NODES_TO_FIND or percentage >= 100:
+        return num_all_nodes
+    adaptive = percentage
+    if adaptive == 0:
+        adaptive = DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE - num_all_nodes // 125
+        if adaptive < MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND:
+            adaptive = MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND
+    num_nodes = num_all_nodes * adaptive // 100
+    if num_nodes < MIN_FEASIBLE_NODES_TO_FIND:
+        return MIN_FEASIBLE_NODES_TO_FIND
+    return num_nodes
+
+
+def select_host(scores, mask, last_index):
+    """(scores f32[N], mask bool[N], last_index i32) -> (host i32, feasible bool).
+
+    host is the winning node index (or 0 when nothing is feasible — check
+    `feasible`).  Pass last_index + 1 on the next cycle for the round-robin
+    rotation (the caller owns the counter, as generic_scheduler owns
+    lastNodeIndex).
+    """
+    neg = jnp.float32(-3.4e38)
+    s = jnp.where(mask, scores, neg)
+    best = jnp.max(s)
+    feasible = jnp.any(mask)
+    is_tie = mask & (s == best)
+    num_ties = jnp.sum(is_tie.astype(jnp.int32))
+    k = jnp.where(num_ties > 0, last_index % jnp.maximum(num_ties, 1), 0)
+    # index of the (k+1)-th tie in node order
+    rank = jnp.cumsum(is_tie.astype(jnp.int32)) - 1          # rank among ties
+    host = jnp.argmax(is_tie & (rank == k))
+    return host.astype(jnp.int32), feasible
+
+
+def select_hosts_batch(scores, mask, last_index0):
+    """Vectorized independent selection for a [B, N] grid (no sequential
+    commit): pod b uses rotation counter last_index0 + b."""
+    import jax
+
+    B = scores.shape[0]
+    idxs = last_index0 + jnp.arange(B, dtype=jnp.int32)
+    hosts, feas = jax.vmap(select_host)(scores, mask, idxs)
+    return hosts, feas

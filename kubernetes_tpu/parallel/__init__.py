@@ -1,0 +1,6 @@
+from kubernetes_tpu.parallel.mesh import (
+    make_mesh,
+    shard_cluster,
+    replicate,
+    NODE_AXIS,
+)

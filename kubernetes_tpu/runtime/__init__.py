@@ -1,0 +1,9 @@
+"""Host-side control loop: queue, cache, and the scheduling service.
+
+The analog of pkg/scheduler/scheduler.go + internal/{queue,cache}: the control
+plane stays on the host (Python), the Filter/Score math lives on device.
+"""
+
+from kubernetes_tpu.runtime.queue import PriorityQueue, PodBackoff
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig

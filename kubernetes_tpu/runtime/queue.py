@@ -1,0 +1,228 @@
+"""Scheduling queue: active / backoff / unschedulable, priority-ordered.
+
+Mirrors SchedulingQueue / PriorityQueue semantics
+(ref pkg/scheduler/internal/queue/scheduling_queue.go:57-811):
+  * activeQ — heap ordered by (pod priority desc, enqueue time asc)
+  * podBackoffQ — heap by backoff expiry; moved to active when expired
+  * unschedulableQ — parking lot, flushed to active/backoff by
+    move_all_to_active (cluster events) or the 60s leftover flush
+    (flushUnschedulableQLeftover)
+  * schedulingCycle / moveRequestCycle counters decide whether a failed pod
+    saw the latest cluster event (scheduling_queue.go:107-137)
+
+Heap deletion is lazy (entries carry a valid flag), so delete/re-add cannot
+double-pop a pod.  Backoff mirrors pod_backoff.go: initial 1s, doubling,
+max 10s.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.types import Pod
+
+UNSCHEDULABLE_TIME_LIMIT = 60.0  # flushUnschedulableQLeftover interval
+
+
+class PodBackoff:
+    """ref internal/queue/pod_backoff.go PodBackoffMap."""
+
+    def __init__(self, initial: float = 1.0, max_duration: float = 10.0):
+        self.initial = initial
+        self.max = max_duration
+        self._attempts: Dict[Tuple[str, str], int] = {}
+        self._last_update: Dict[Tuple[str, str], float] = {}
+
+    def backoff_time(self, key: Tuple[str, str]) -> float:
+        n = self._attempts.get(key, 0)
+        if n == 0:
+            return 0.0
+        d = min(self.initial * (2 ** (n - 1)), self.max)
+        return self._last_update.get(key, 0.0) + d
+
+    def boost(self, key: Tuple[str, str], now: Optional[float] = None) -> None:
+        self._attempts[key] = self._attempts.get(key, 0) + 1
+        self._last_update[key] = now if now is not None else time.monotonic()
+
+    def clear(self, key: Tuple[str, str]) -> None:
+        self._attempts.pop(key, None)
+        self._last_update.pop(key, None)
+
+
+def _pod_key(pod: Pod) -> Tuple[str, str]:
+    return (pod.namespace, pod.name)
+
+
+# entry layout: [sort_key..., pod, valid]
+_VALID = -1  # index of the valid flag
+
+
+class PriorityQueue:
+    """Blocking pop; thread-safe.  Ordering: higher .spec.priority first, then
+    FIFO by add time (the default queue-sort plugin semantics)."""
+
+    def __init__(self, backoff: Optional[PodBackoff] = None):
+        self._lock = threading.Condition()
+        self._counter = itertools.count()
+        self._active: List[list] = []          # [-prio, seq, pod, valid]
+        self._active_entry: Dict[Tuple[str, str], list] = {}
+        self._backoffq: List[list] = []        # [expiry, seq, pod, valid]
+        self._backoff_entry: Dict[Tuple[str, str], list] = {}
+        # key -> (pod, cycle, parked_at)
+        self._unschedulable: Dict[Tuple[str, str], Tuple[Pod, int, float]] = {}
+        self.backoff = backoff or PodBackoff()
+        self.scheduling_cycle = 0
+        self.move_request_cycle = -1
+        self._closed = False
+
+    # ---- internal (lock held) ----
+
+    def _push_active(self, pod: Pod) -> None:
+        key = _pod_key(pod)
+        if key in self._active_entry:
+            return
+        entry = [-pod.spec.priority, next(self._counter), pod, True]
+        heapq.heappush(self._active, entry)
+        self._active_entry[key] = entry
+
+    def _push_backoff(self, pod: Pod, expiry: float) -> None:
+        key = _pod_key(pod)
+        old = self._backoff_entry.get(key)
+        if old is not None:
+            old[_VALID] = False
+        entry = [expiry, next(self._counter), pod, True]
+        heapq.heappush(self._backoffq, entry)
+        self._backoff_entry[key] = entry
+
+    # ---- producers ----
+
+    def add(self, pod: Pod) -> None:
+        with self._lock:
+            key = _pod_key(pod)
+            self._unschedulable.pop(key, None)
+            self._push_active(pod)
+            self._lock.notify()
+
+    def add_unschedulable(self, pod: Pod, cycle: int) -> None:
+        """Failed-to-schedule pod (scheduling_queue.go AddUnschedulableIfNotPresent):
+        if a move request happened after this pod's cycle began, it goes to
+        backoff (a cluster event might have made it schedulable); otherwise it
+        parks in unschedulableQ until an event or the 60s leftover flush."""
+        with self._lock:
+            key = _pod_key(pod)
+            self.backoff.boost(key)
+            if self.move_request_cycle >= cycle:
+                self._push_backoff(pod, self.backoff.backoff_time(key))
+            else:
+                self._unschedulable[key] = (pod, cycle, time.monotonic())
+            self._lock.notify()
+
+    def move_all_to_active(self) -> None:
+        """Cluster event: flush unschedulableQ (MoveAllToActiveQueue,
+        scheduling_queue.go:73; wired from eventhandlers.go:319-378)."""
+        with self._lock:
+            self.move_request_cycle = self.scheduling_cycle
+            for key, (pod, _, _) in list(self._unschedulable.items()):
+                self._push_backoff(pod, self.backoff.backoff_time(key))
+            self._unschedulable.clear()
+            self._lock.notify()
+
+    def delete(self, pod: Pod) -> None:
+        with self._lock:
+            key = _pod_key(pod)
+            self._unschedulable.pop(key, None)
+            entry = self._active_entry.pop(key, None)
+            if entry is not None:
+                entry[_VALID] = False
+            entry = self._backoff_entry.pop(key, None)
+            if entry is not None:
+                entry[_VALID] = False
+            self.backoff.clear(key)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+
+    # ---- consumer ----
+
+    def _flush(self, now: float) -> None:
+        # expired backoff -> active
+        while self._backoffq and (
+            not self._backoffq[0][_VALID] or self._backoffq[0][0] <= now
+        ):
+            entry = heapq.heappop(self._backoffq)
+            if not entry[_VALID]:
+                continue
+            pod = entry[2]
+            key = _pod_key(pod)
+            if self._backoff_entry.get(key) is entry:
+                del self._backoff_entry[key]
+            self._push_active(pod)
+        # unschedulable leftovers past the 60s limit -> backoff
+        # (flushUnschedulableQLeftover)
+        for key, (pod, _, parked) in list(self._unschedulable.items()):
+            if now - parked >= UNSCHEDULABLE_TIME_LIMIT:
+                del self._unschedulable[key]
+                self._push_backoff(pod, self.backoff.backoff_time(key))
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[Pod]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                self._flush(time.monotonic())
+                while self._active:
+                    entry = heapq.heappop(self._active)
+                    if not entry[_VALID]:
+                        continue
+                    pod = entry[2]
+                    key = _pod_key(pod)
+                    if self._active_entry.get(key) is entry:
+                        del self._active_entry[key]
+                    self.scheduling_cycle += 1
+                    return pod
+                if self._closed:
+                    return None
+                wait = None
+                if self._backoffq:
+                    wait = max(self._backoffq[0][0] - time.monotonic(), 0.01)
+                if self._unschedulable:
+                    oldest = min(t for _, _, t in self._unschedulable.values())
+                    leftover = max(oldest + UNSCHEDULABLE_TIME_LIMIT - time.monotonic(), 0.01)
+                    wait = leftover if wait is None else min(wait, leftover)
+                if deadline is not None:
+                    remain = deadline - time.monotonic()
+                    if remain <= 0:
+                        return None
+                    wait = remain if wait is None else min(wait, remain)
+                self._lock.wait(wait)
+
+    def pop_batch(self, max_batch: int, timeout: Optional[float] = None,
+                  batch_window: float = 0.0) -> List[Pod]:
+        """Drain up to max_batch pods; waits `timeout` for the first pod then
+        `batch_window` more for stragglers (deadline-driven batch formation)."""
+        out = []
+        first = self.pop(timeout)
+        if first is None:
+            return out
+        out.append(first)
+        deadline = time.monotonic() + batch_window
+        while len(out) < max_batch:
+            remain = deadline - time.monotonic()
+            nxt = self.pop(max(remain, 0.0) if batch_window else 0.0)
+            if nxt is None:
+                break
+            out.append(nxt)
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return (
+                len(self._active_entry)
+                + len(self._backoff_entry)
+                + len(self._unschedulable)
+            )

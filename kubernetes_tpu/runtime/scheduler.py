@@ -1,0 +1,151 @@
+"""The scheduling service: the scheduleOne loop, batched.
+
+Mirrors Scheduler.Run / scheduleOne (ref pkg/scheduler/scheduler.go:250-593)
+with the one structural change that unlocks TPU throughput: instead of one
+pod per cycle, each cycle drains a batch from the queue and places it with
+the sequential-commit device program (models/batched.py) — semantically the
+same as running scheduleOne B times against a continuously-updated cache,
+but in a single XLA launch.
+
+Per cycle:
+  1. queue.pop_batch                      (NextPod, scheduler.go:438-447)
+  2. cache.snapshot -> device tensors     (the snapshot seam, :176-179)
+  3. sequential-commit schedule on device
+  4. per pod: assume + bind via the binder callback (async),
+     or add_unschedulable on failure     (:463-475, MakeDefaultErrorFunc)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.codec.schema import FilterConfig
+from kubernetes_tpu.models.batched import encode_batch_ports, make_sequential_scheduler
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.queue import PriorityQueue
+from kubernetes_tpu.utils.trace import Trace
+
+TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
+
+
+@dataclass
+class SchedulerConfig:
+    batch_size: int = 256
+    batch_window_s: float = 0.001
+    percentage_of_nodes_to_score: int = 100  # TPU path scans all; knob for parity
+    disable_preemption: bool = False
+    weights: Optional[Sequence[float]] = None
+    filter_config: FilterConfig = field(default_factory=FilterConfig)
+
+
+@dataclass
+class ScheduleResult:
+    pod: Pod
+    node: Optional[str]          # None = unschedulable
+    generation: int = 0
+
+
+class Scheduler:
+    """Binder: callable (pod, node_name) -> bool (the POST .../binding analog,
+    scheduler.go:411-435).  A False/raising binder triggers ForgetPod + requeue."""
+
+    def __init__(
+        self,
+        cache: Optional[SchedulerCache] = None,
+        queue: Optional[PriorityQueue] = None,
+        binder: Optional[Callable[[Pod, str], bool]] = None,
+        config: Optional[SchedulerConfig] = None,
+    ):
+        # NB: PriorityQueue defines __len__, so `queue or PriorityQueue()`
+        # would silently replace an *empty* caller-owned queue
+        self.cache = cache if cache is not None else SchedulerCache()
+        self.queue = queue if queue is not None else PriorityQueue()
+        self.binder = binder if binder is not None else (lambda pod, node: True)
+        self.config = config if config is not None else SchedulerConfig()
+        enc = self.cache.encoder
+        enc.hard_pod_affinity_weight = self.config.filter_config.hard_pod_affinity_weight
+        self._unsched_key = enc.interner.intern(TAINT_NODE_UNSCHEDULABLE)
+        self._schedule_fn = make_sequential_scheduler(
+            cfg=self.config.filter_config,
+            weights=self.config.weights,
+            unsched_taint_key=self._unsched_key,
+            zone_key_id=enc.zone_key,
+        )
+        self._last_index = 0
+        self._stop = threading.Event()
+        self.results: List[ScheduleResult] = []
+
+    # ------------------------------------------------------------- one cycle
+
+    def schedule_cycle(self, pods: Sequence[Pod]) -> List[ScheduleResult]:
+        """Place a batch of pods against the current cache state; assume+bind
+        winners, requeue losers.  Returns per-pod results."""
+        if not pods:
+            return []
+        trace = Trace("schedule_cycle", pods=len(pods))
+        enc = self.cache.encoder
+        cycle = self.queue.scheduling_cycle
+        with self.cache._lock:
+            batch = enc.encode_pods(pods)
+            ports = encode_batch_ports(enc, pods, enc.dims.N)
+            cluster, generation = self.cache.snapshot()
+        trace.step("encode")
+        hosts, _ = self._schedule_fn(
+            cluster, batch, ports, np.int32(self._last_index)
+        )
+        hosts = np.asarray(hosts)
+        self._last_index += len(pods)
+        trace.step("device")
+        results = []
+        row_names = {row: name for name, row in enc.node_rows.items()}
+        for i, pod in enumerate(pods):
+            row = int(hosts[i])
+            if row < 0:
+                # FitError path: park in unschedulableQ with backoff
+                # (factory.go MakeDefaultErrorFunc)
+                self.queue.add_unschedulable(pod, cycle)
+                results.append(ScheduleResult(pod, None, generation))
+                continue
+            node_name = row_names[row]
+            assumed = dataclasses.replace(
+                pod, spec=dataclasses.replace(pod.spec, node_name=node_name)
+            )
+            self.cache.assume_pod(assumed)
+            ok = False
+            try:
+                ok = self.binder(assumed, node_name)
+            except Exception:
+                ok = False
+            if not ok:
+                self.cache.forget_pod(assumed)
+                self.queue.add_unschedulable(pod, cycle)
+                results.append(ScheduleResult(pod, None, generation))
+            else:
+                results.append(ScheduleResult(pod, node_name, generation))
+        trace.step("commit")
+        trace.log_if_long(0.1)
+        self.results.extend(results)
+        return results
+
+    # ------------------------------------------------------------- run loop
+
+    def run_once(self, timeout: float = 0.1) -> int:
+        pods = self.queue.pop_batch(
+            self.config.batch_size, timeout, self.config.batch_window_s
+        )
+        return len(self.schedule_cycle(pods))
+
+    def run(self) -> None:
+        """wait.Until(scheduleOne) analog (scheduler.go:250-256)."""
+        while not self._stop.is_set():
+            self.run_once(timeout=0.5)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.close()

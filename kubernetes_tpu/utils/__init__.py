@@ -1,0 +1,2 @@
+from kubernetes_tpu.utils.trace import Trace
+from kubernetes_tpu.utils.metrics import Histogram, Counter, Gauge, REGISTRY

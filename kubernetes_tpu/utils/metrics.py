@@ -1,0 +1,119 @@
+"""Metrics: Prometheus-style registry mirroring the scheduler's observables.
+
+The reference exposes latency histograms + counters on /metrics
+(ref pkg/scheduler/metrics/metrics.go:31-199: e2e_scheduling_duration,
+scheduling_algorithm_duration, binding_duration, schedule_attempts_total,
+pending_pods, ...).  This module implements a dependency-free registry with
+the same metric names, exposable in the Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_DEF_BUCKETS = [0.001 * (2 ** i) for i in range(16)]  # 1ms .. ~32s
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "", buckets: Optional[List[float]] = None):
+        self.name = name
+        self.help = help_
+        self.buckets = sorted(buckets or _DEF_BUCKETS)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            i = bisect.bisect_left(self.buckets, v)
+            self.counts[i] += 1
+            self.sum += v
+            self.total += 1
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from bucket boundaries (upper bound)."""
+        with self._lock:
+            if self.total == 0:
+                return 0.0
+            target = q * self.total
+            acc = 0
+            for i, c in enumerate(self.counts):
+                acc += c
+                if acc >= target:
+                    return self.buckets[i] if i < len(self.buckets) else float("inf")
+            return float("inf")
+
+    def expose(self) -> str:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        acc = 0
+        for i, b in enumerate(self.buckets):
+            acc += self.counts[i]
+            out.append(f'{self.name}_bucket{{le="{b}"}} {acc}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {self.total}')
+        out.append(f"{self.name}_sum {self.sum}")
+        out.append(f"{self.name}_count {self.total}")
+        return "\n".join(out)
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self.value += v
+
+    def expose(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n# TYPE {self.name} counter\n"
+            f"{self.name} {self.value}"
+        )
+
+
+class Gauge(Counter):
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = v
+
+    def expose(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n# TYPE {self.name} gauge\n"
+            f"{self.name} {self.value}"
+        )
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def register(self, m):
+        with self._lock:
+            self._metrics[m.name] = m
+        return m
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def expose(self) -> str:
+        with self._lock:
+            return "\n".join(m.expose() for m in self._metrics.values()) + "\n"
+
+
+REGISTRY = Registry()
+
+# the scheduler's metric families (metrics.go:86-199 names, seconds units)
+E2E_LATENCY = REGISTRY.register(Histogram("scheduler_e2e_scheduling_duration_seconds"))
+ALGO_LATENCY = REGISTRY.register(Histogram("scheduler_scheduling_algorithm_duration_seconds"))
+PREDICATE_LATENCY = REGISTRY.register(Histogram("scheduler_scheduling_algorithm_predicate_evaluation_seconds"))
+PRIORITY_LATENCY = REGISTRY.register(Histogram("scheduler_scheduling_algorithm_priority_evaluation_seconds"))
+PREEMPTION_LATENCY = REGISTRY.register(Histogram("scheduler_scheduling_algorithm_preemption_evaluation_seconds"))
+BINDING_LATENCY = REGISTRY.register(Histogram("scheduler_binding_duration_seconds"))
+SCHEDULE_ATTEMPTS = REGISTRY.register(Counter("scheduler_schedule_attempts_total"))
+PENDING_PODS = REGISTRY.register(Gauge("scheduler_pending_pods"))

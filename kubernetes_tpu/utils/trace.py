@@ -1,0 +1,40 @@
+"""Tracing spans: dump-only-if-slow step timing.
+
+The analog of utiltrace (ref vendor/k8s.io/utils/trace/trace.go:30-90), which
+the reference wraps around every scheduling cycle with a 100ms threshold
+(generic_scheduler.go:185-186).  Device-side profiling composes with
+jax.profiler traces; this covers the host spans.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Tuple
+
+logger = logging.getLogger("kubernetes_tpu")
+
+
+class Trace:
+    def __init__(self, name: str, **fields):
+        self.name = name
+        self.fields = fields
+        self.start = time.monotonic()
+        self.steps: List[Tuple[float, str]] = []
+
+    def step(self, msg: str) -> None:
+        self.steps.append((time.monotonic(), msg))
+
+    def total(self) -> float:
+        return time.monotonic() - self.start
+
+    def log_if_long(self, threshold_s: float) -> None:
+        total = self.total()
+        if total < threshold_s:
+            return
+        parts = [f'"{self.name}" {self.fields} (total {total*1000:.1f}ms):']
+        prev = self.start
+        for t, msg in self.steps:
+            parts.append(f"  +{(t - prev)*1000:.1f}ms {msg}")
+            prev = t
+        logger.info("\n".join(parts))

@@ -1,0 +1,34 @@
+"""Test fixture: force an 8-device virtual CPU mesh.
+
+The image preloads jax (PYTHONPATH site hook) with JAX_PLATFORMS=axon — the
+tunnel to the single real TPU chip.  Tests must NOT ride the tunnel (remote
+compiles are ~25s each and concurrent test processes wedge it), so we
+hard-override the platform to cpu *via jax.config* (the env var was already
+consumed at import time) and request 8 virtual host devices, matching the
+driver's dryrun_multichip environment.  The real-TPU path is exercised by
+bench.py.
+"""
+
+import os
+
+# must be appended before the cpu backend initializes
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/ktpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(20260729)

@@ -1,0 +1,274 @@
+"""Test object makers + randomized cluster generator.
+
+The analog of the reference's fixture helpers
+(pkg/scheduler/algorithm/predicates/testing_helper.go, testing/fake_lister.go,
+test/utils/runners.go node/pod strategies).  Memory values are Mi-granular so
+float32 device math stays exact for score parity.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.codec.schema import PadDims
+
+# One shared pad configuration for the whole test-suite: identical tensor
+# shapes => one XLA compilation serves every test (compiles dominate CPU test
+# wall-clock otherwise).
+TEST_DIMS = PadDims(N=16, B=16, TP=32)
+
+ZONE_KEY = "failure-domain.beta.kubernetes.io/zone"
+REGION_KEY = "failure-domain.beta.kubernetes.io/region"
+HOSTNAME_KEY = "kubernetes.io/hostname"
+
+
+def make_node(
+    name: str,
+    cpu: str = "4",
+    mem: str = "8Gi",
+    pods: int = 110,
+    labels: Optional[Dict[str, str]] = None,
+    taints: Sequence[dict] = (),
+    unschedulable: bool = False,
+    conditions: Sequence[dict] = (),
+    images: Sequence[dict] = (),
+    annotations: Optional[Dict[str, str]] = None,
+) -> Node:
+    lab = {HOSTNAME_KEY: name}
+    lab.update(labels or {})
+    return Node.from_dict(
+        {
+            "metadata": {"name": name, "labels": lab, "annotations": annotations or {}},
+            "spec": {"unschedulable": unschedulable, "taints": list(taints)},
+            "status": {
+                "allocatable": {"cpu": cpu, "memory": mem, "pods": pods},
+                "conditions": list(conditions) or [{"type": "Ready", "status": "True"}],
+                "images": list(images),
+            },
+        }
+    )
+
+
+def make_pod(
+    name: str,
+    namespace: str = "default",
+    cpu: Optional[str] = None,
+    mem: Optional[str] = None,
+    labels: Optional[Dict[str, str]] = None,
+    node_name: str = "",
+    node_selector: Optional[Dict[str, str]] = None,
+    tolerations: Sequence[dict] = (),
+    affinity: Optional[dict] = None,
+    ports: Sequence[dict] = (),
+    priority: int = 0,
+    images: Sequence[str] = (),
+    owner: Optional[Tuple[str, str]] = None,  # (kind, uid)
+    volumes: Sequence[dict] = (),
+) -> Pod:
+    requests = {}
+    if cpu is not None:
+        requests["cpu"] = cpu
+    if mem is not None:
+        requests["memory"] = mem
+    containers = [
+        {
+            "name": "c0",
+            "image": images[0] if images else "",
+            "resources": {"requests": requests} if requests else {},
+            "ports": list(ports),
+        }
+    ]
+    for i, img in enumerate(images[1:], 1):
+        containers.append({"name": f"c{i}", "image": img})
+    meta: dict = {"name": name, "namespace": namespace, "labels": labels or {}}
+    if owner:
+        meta["ownerReferences"] = [
+            {"kind": owner[0], "uid": owner[1], "controller": True}
+        ]
+    return Pod.from_dict(
+        {
+            "metadata": meta,
+            "spec": {
+                "nodeName": node_name,
+                "nodeSelector": node_selector or {},
+                "tolerations": list(tolerations),
+                "affinity": affinity,
+                "containers": containers,
+                "priority": priority,
+                "volumes": list(volumes),
+            },
+        }
+    )
+
+
+# ------------------------------------------------------- randomized clusters
+
+_LABEL_KEYS = ["disk", "gpu", "tier", "arch"]
+_LABEL_VALS = ["a", "b", "c"]
+_TAINT_KEYS = ["dedicated", "gpu-node"]
+_EFFECTS = ["NoSchedule", "PreferNoSchedule", "NoExecute"]
+
+
+def random_cluster(
+    rng: np.random.Generator,
+    n_nodes: int = 12,
+    n_pods: int = 30,
+    zones: int = 3,
+    with_affinity: bool = True,
+) -> Tuple[List[Node], List[Pod], List[Tuple[str, Dict[str, str]]]]:
+    nodes = []
+    for i in range(n_nodes):
+        labels = {
+            ZONE_KEY: f"zone-{i % zones}",
+            REGION_KEY: f"region-{i % 2}",
+        }
+        for k in _LABEL_KEYS:
+            if rng.random() < 0.5:
+                labels[k] = str(rng.choice(_LABEL_VALS))
+        taints = []
+        if rng.random() < 0.25:
+            taints.append(
+                {
+                    "key": str(rng.choice(_TAINT_KEYS)),
+                    "value": str(rng.choice(_LABEL_VALS)),
+                    "effect": str(rng.choice(_EFFECTS)),
+                }
+            )
+        images = []
+        if rng.random() < 0.4:
+            images.append(
+                {
+                    "names": [f"img-{rng.integers(4)}"],
+                    "sizeBytes": int(rng.integers(1, 40)) * 64 * 1024 * 1024,
+                }
+            )
+        nodes.append(
+            make_node(
+                f"node-{i}",
+                cpu=str(int(rng.integers(2, 9))),
+                mem=f"{int(rng.integers(2, 17))}Gi",
+                pods=int(rng.integers(8, 32)),
+                labels=labels,
+                taints=taints,
+                unschedulable=bool(rng.random() < 0.05),
+                images=images,
+            )
+        )
+    pods = []
+    for i in range(n_pods):
+        labels = {"app": f"app-{rng.integers(4)}"}
+        affinity = None
+        if with_affinity and rng.random() < 0.3:
+            term = {
+                "labelSelector": {"matchLabels": {"app": f"app-{rng.integers(4)}"}},
+                "topologyKey": ZONE_KEY if rng.random() < 0.5 else HOSTNAME_KEY,
+            }
+            if rng.random() < 0.5:
+                affinity = {"podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [term]}}
+            else:
+                affinity = {"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [term]}}
+        pods.append(
+            make_pod(
+                f"pod-{i}",
+                cpu=f"{int(rng.integers(1, 9)) * 100}m" if rng.random() < 0.8 else None,
+                mem=f"{int(rng.integers(1, 9)) * 128}Mi" if rng.random() < 0.8 else None,
+                labels=labels,
+                node_name=f"node-{rng.integers(n_nodes)}",
+                ports=[{"hostPort": int(rng.integers(8000, 8004)), "protocol": "TCP"}]
+                if rng.random() < 0.2
+                else [],
+                affinity=affinity,
+                images=[f"img-{rng.integers(4)}"] if rng.random() < 0.3 else (),
+            )
+        )
+    services = [
+        ("default", {"app": f"app-{i}"}) for i in range(3)
+    ]
+    return nodes, pods, services
+
+
+def random_pending_pod(rng: np.random.Generator, idx: int = 0, with_affinity: bool = True) -> Pod:
+    labels = {"app": f"app-{rng.integers(4)}"}
+    affinity: Optional[dict] = None
+    r = rng.random()
+    if with_affinity and r < 0.5:
+        term = {
+            "labelSelector": {"matchLabels": {"app": f"app-{rng.integers(4)}"}},
+            "topologyKey": ZONE_KEY if rng.random() < 0.5 else HOSTNAME_KEY,
+        }
+        kind = "podAffinity" if rng.random() < 0.5 else "podAntiAffinity"
+        if rng.random() < 0.5:
+            affinity = {kind: {"requiredDuringSchedulingIgnoredDuringExecution": [term]}}
+        else:
+            affinity = {
+                kind: {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {"weight": int(rng.integers(1, 100)), "podAffinityTerm": term}
+                    ]
+                }
+            }
+    node_affinity = None
+    if rng.random() < 0.4:
+        node_affinity = {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [
+                        {
+                            "matchExpressions": [
+                                {
+                                    "key": str(rng.choice(_LABEL_KEYS)),
+                                    "operator": str(rng.choice(["In", "NotIn", "Exists", "DoesNotExist"])),
+                                    "values": [str(rng.choice(_LABEL_VALS))],
+                                }
+                            ]
+                        }
+                    ]
+                },
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "weight": int(rng.integers(1, 100)),
+                        "preference": {
+                            "matchExpressions": [
+                                {
+                                    "key": str(rng.choice(_LABEL_KEYS)),
+                                    "operator": "In",
+                                    "values": [str(rng.choice(_LABEL_VALS))],
+                                }
+                            ]
+                        },
+                    }
+                ],
+            }
+        }
+    if affinity and node_affinity:
+        affinity.update(node_affinity)
+    elif node_affinity:
+        affinity = node_affinity
+    tolerations = []
+    if rng.random() < 0.4:
+        tolerations.append(
+            {
+                "key": str(rng.choice(_TAINT_KEYS)),
+                "operator": "Exists" if rng.random() < 0.5 else "Equal",
+                "value": str(rng.choice(_LABEL_VALS)),
+                "effect": str(rng.choice(_EFFECTS + [""])),
+            }
+        )
+    return make_pod(
+        f"pending-{idx}",
+        cpu=f"{int(rng.integers(1, 9)) * 100}m" if rng.random() < 0.8 else None,
+        mem=f"{int(rng.integers(1, 9)) * 128}Mi" if rng.random() < 0.8 else None,
+        labels=labels,
+        node_selector={str(rng.choice(_LABEL_KEYS)): str(rng.choice(_LABEL_VALS))}
+        if rng.random() < 0.3
+        else None,
+        tolerations=tolerations,
+        affinity=affinity,
+        ports=[{"hostPort": int(rng.integers(8000, 8004)), "protocol": "TCP"}]
+        if rng.random() < 0.25
+        else [],
+        images=[f"img-{rng.integers(4)}"] if rng.random() < 0.4 else (),
+    )

@@ -1,0 +1,85 @@
+"""API object-model tests: quantities, selectors, tolerations."""
+
+import pytest
+
+from kubernetes_tpu.api import parse_quantity
+from kubernetes_tpu.api.labels import (
+    Requirement,
+    selector_from_label_selector,
+    selector_from_match_labels,
+)
+from kubernetes_tpu.api.types import Taint, Toleration
+
+
+@pytest.mark.parametrize(
+    "s,milli,value",
+    [
+        ("100m", 100, 1),
+        ("1", 1000, 1),
+        ("2", 2000, 2),
+        ("1500m", 1500, 2),
+        ("0.5", 500, 1),
+        ("2Gi", 2 * 1024**3 * 1000, 2 * 1024**3),
+        ("128Mi", 128 * 1024**2 * 1000, 128 * 1024**2),
+        ("1G", 10**9 * 1000, 10**9),
+        ("1e3", 10**6, 1000),
+        ("5k", 5000 * 1000, 5000),
+        (".5", 500, 1),
+    ],
+)
+def test_parse_quantity(s, milli, value):
+    q = parse_quantity(s)
+    assert q.milli == milli
+    assert q.scalar == value
+
+
+def test_quantity_arithmetic():
+    a = parse_quantity("1500m")
+    b = parse_quantity("500m")
+    assert (a + b).milli == 2000
+    assert (a - b).milli == 1000
+    assert b < a
+
+
+def test_selector_match_labels():
+    sel = selector_from_match_labels({"app": "web", "tier": "fe"})
+    assert sel.matches({"app": "web", "tier": "fe", "extra": "x"})
+    assert not sel.matches({"app": "web"})
+
+
+def test_selector_expressions():
+    sel = selector_from_label_selector(
+        {
+            "matchExpressions": [
+                {"key": "env", "operator": "In", "values": ["prod", "staging"]},
+                {"key": "canary", "operator": "DoesNotExist"},
+            ]
+        }
+    )
+    assert sel.matches({"env": "prod"})
+    assert not sel.matches({"env": "dev"})
+    assert not sel.matches({"env": "prod", "canary": "true"})
+
+
+def test_not_in_absent_key_matches():
+    # labels.Requirement semantics: NotIn matches when the key is absent
+    assert Requirement("x", "NotIn", ("a",)).matches({})
+    assert not Requirement("x", "In", ("a",)).matches({})
+
+
+def test_gt_lt():
+    assert Requirement("n", "Gt", ("5",)).matches({"n": "7"})
+    assert not Requirement("n", "Gt", ("5",)).matches({"n": "5"})
+    assert Requirement("n", "Lt", ("5",)).matches({"n": "3"})
+    assert not Requirement("n", "Gt", ("5",)).matches({"n": "abc"})
+
+
+def test_toleration_matrix():
+    taint = Taint(key="k", value="v", effect="NoSchedule")
+    assert Toleration(key="k", operator="Equal", value="v", effect="NoSchedule").tolerates(taint)
+    assert Toleration(key="k", operator="Exists", effect="NoSchedule").tolerates(taint)
+    assert Toleration(key="k", operator="Exists").tolerates(taint)  # empty effect = all
+    assert Toleration(operator="Exists").tolerates(taint)  # empty key = all keys
+    assert not Toleration(key="k", operator="Equal", value="w").tolerates(taint)
+    assert not Toleration(key="other", operator="Exists").tolerates(taint)
+    assert not Toleration(key="k", operator="Exists", effect="NoExecute").tolerates(taint)

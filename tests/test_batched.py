@@ -1,0 +1,134 @@
+"""Sequential-commit batch scheduling: one launch must equal the one-pod-at-
+a-time golden loop (schedule -> commit -> schedule ...)."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.cpuref import CPUScheduler
+from kubernetes_tpu.models.batched import encode_batch_ports, make_sequential_scheduler
+
+from fixtures import TEST_DIMS, make_node, make_pod, random_cluster, random_pending_pod
+
+
+def golden_sequential(nodes, existing, services, pending):
+    """Reference loop: schedule one pod, commit it, repeat
+    (scheduleOne semantics, scheduler.go:438-593)."""
+    placed = list(existing)
+    out = []
+    last = 0
+    for pod in pending:
+        golden = CPUScheduler(nodes, placed, services)
+        host, _ = golden.schedule(pod, last_index=last)
+        last += 1
+        out.append(host)
+        if host is not None:
+            import dataclasses
+
+            committed = dataclasses.replace(
+                pod, spec=dataclasses.replace(pod.spec, node_name=host)
+            )
+            placed.append(committed)
+    return out
+
+
+def run_device_sequential(nodes, existing, services, pending):
+    enc = SnapshotEncoder(TEST_DIMS)
+    for n in nodes:
+        enc.add_node(n)
+    for p in existing:
+        enc.add_pod(p)
+    for ns, sel in services:
+        enc.add_spread_selector(ns, sel)
+    batch = enc.encode_pods(pending)
+    ports = encode_batch_ports(enc, pending, enc.dims.N)
+    cluster = enc.snapshot()
+    unsched = enc.interner.intern("node.kubernetes.io/unschedulable")
+    fn = make_sequential_scheduler(
+        unsched_taint_key=unsched, zone_key_id=enc.zone_key
+    )
+    hosts, new_cluster = fn(cluster, batch, ports, np.int32(0))
+    hosts = np.asarray(hosts)
+    row_names = {row: name for name, row in enc.node_rows.items()}
+    return [
+        row_names[int(h)] if int(h) >= 0 else None for h in hosts[: len(pending)]
+    ], np.asarray(new_cluster.requested)
+
+
+def test_sequential_commits_resources():
+    # each node fits exactly two 400m pods on 1 cpu; commits inside the batch
+    # must make later pods see earlier placements
+    nodes = [make_node("n1", cpu="1", mem="8Gi"), make_node("n2", cpu="1", mem="8Gi")]
+    pending = [make_pod(f"p{i}", cpu="400m", mem="128Mi") for i in range(4)]
+    got, _ = run_device_sequential(nodes, [], [], pending)
+    want = golden_sequential(nodes, [], [], pending)
+    assert got == want
+    assert got.count("n1") == 2 and got.count("n2") == 2
+
+
+def test_sequential_unschedulable_tail():
+    nodes = [make_node("n1", cpu="1", mem="1Gi", pods=2)]
+    pending = [make_pod(f"p{i}", cpu="300m", mem="128Mi") for i in range(4)]
+    got, _ = run_device_sequential(nodes, [], [], pending)
+    want = golden_sequential(nodes, [], [], pending)
+    assert got == want
+    assert got[2] is None and got[3] is None  # pod-count cap = 2
+
+
+def test_sequential_ports_within_batch():
+    nodes = [make_node("n1"), make_node("n2"), make_node("n3")]
+    pending = [
+        make_pod(f"p{i}", ports=[{"hostPort": 8080, "protocol": "TCP"}])
+        for i in range(4)
+    ]
+    got, _ = run_device_sequential(nodes, [], [], pending)
+    want = golden_sequential(nodes, [], [], pending)
+    assert got == want
+    # only three nodes can hold hostPort 8080
+    assert sorted(h for h in got if h) == ["n1", "n2", "n3"] and got.count(None) == 1
+
+
+def test_sequential_spreading_within_batch():
+    nodes = [make_node(f"n{i}") for i in range(3)]
+    services = [("default", {"app": "web"})]
+    pending = [make_pod(f"w{i}", labels={"app": "web"}) for i in range(6)]
+    got, _ = run_device_sequential(nodes, [], services, pending)
+    want = golden_sequential(nodes, [], services, pending)
+    assert got == want
+    # spreading should land 2 per node
+    from collections import Counter
+
+    assert sorted(Counter(got).values()) == [2, 2, 2]
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_sequential_randomized(seed):
+    """Follow the device trajectory; each placement must be feasible per the
+    golden and within the float-blend tolerance (PARITY.md delta 1: three
+    priorities may each drift ±1, weights 1) of the golden best score."""
+    import dataclasses
+
+    rng = np.random.default_rng(7000 + seed)
+    nodes, existing, services = random_cluster(
+        rng, n_nodes=8, n_pods=16, with_affinity=False
+    )
+    pending = [
+        random_pending_pod(rng, i, with_affinity=False) for i in range(10)
+    ]
+    got, _ = run_device_sequential(nodes, existing, services, pending)
+    placed = list(existing)
+    for pod, host in zip(pending, got):
+        golden = CPUScheduler(nodes, placed, services)
+        feasible = {n.name for n in nodes if golden.fits(pod, n)}
+        if host is None:
+            assert not feasible, f"{pod.name}: device said unschedulable, golden fits {feasible}"
+            continue
+        assert host in feasible, f"{pod.name}: device placed on infeasible {host}"
+        totals = golden.total_scores(pod)
+        best = max(totals[n] for n in feasible)
+        assert totals[host] >= best - 3.0, (
+            f"{pod.name}: device host {host} score {totals[host]} vs best {best}"
+        )
+        placed.append(
+            dataclasses.replace(pod, spec=dataclasses.replace(pod.spec, node_name=host))
+        )

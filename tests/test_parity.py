@@ -1,0 +1,312 @@
+"""Differential parity: TPU kernels vs the object-level golden (cpuref).
+
+The TPU-build analog of the reference's table-driven predicate/priority tests
+plus randomized differential coverage (SURVEY.md section 4 testing lesson):
+every (pod, node) cell of every predicate and every priority must agree with
+the independent Python implementation.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.codec.schema import (
+    FilterConfig,
+    PRED_INDEX,
+    PREDICATE_ORDER,
+    PRIO_INDEX,
+    PRIORITY_ORDER,
+)
+from kubernetes_tpu.cpuref import CPUScheduler
+from kubernetes_tpu.ops import filter_batch, score_batch
+
+from fixtures import TEST_DIMS, make_node, make_pod, random_cluster, random_pending_pod
+
+# Priorities computed through float *division/blending* chains: the reference
+# does these in float64 and truncates to int; TPUs have no f64, so at exact
+# integer boundaries (decimal fractions like 0.2 that are not binary-exact)
+# the f32 result can floor one lower/higher.  Allowed drift: 1.  Everything
+# else must match bit-for-bit.  (Tracked in PARITY.md.)
+_FLOAT_BLEND_PRIORITIES = {
+    "BalancedResourceAllocation",
+    "SelectorSpreadPriority",
+    "InterPodAffinityPriority",
+}
+_CHECKED_PRIORITIES = list(PRIORITY_ORDER)
+
+
+def build_encoder(nodes, pods, services):
+    enc = SnapshotEncoder(TEST_DIMS)
+    for n in nodes:
+        enc.add_node(n)
+    for p in pods:
+        enc.add_pod(p)
+    for ns, sel in services:
+        enc.add_spread_selector(ns, sel)
+    return enc
+
+
+def run_device(enc, pending):
+    cluster = enc.snapshot()
+    batch = enc.encode_pods(pending)
+    unsched = enc.interner.lookup("node.kubernetes.io/unschedulable")
+    mask, per_pred = filter_batch(cluster, batch, FilterConfig(), max(unsched, 0))
+    total, per_prio = score_batch(cluster, batch)
+    return cluster, batch, np.asarray(mask), np.asarray(per_pred), np.asarray(total), np.asarray(per_prio)
+
+
+def assert_parity(enc, nodes, pods, services, pending):
+    golden = CPUScheduler(nodes, pods, services)
+    _, _, mask, per_pred, _, per_prio = run_device(enc, pending)
+    row = {name: enc.node_rows[name] for name in (n.name for n in nodes)}
+    for b, pod in enumerate(pending):
+        for node in nodes:
+            want = golden.predicates(pod, node)
+            r = row[node.name]
+            for pname, ok in want.items():
+                got = bool(per_pred[b, PRED_INDEX[pname], r])
+                assert got == ok, (
+                    f"pod={pod.name} node={node.name} predicate={pname}: "
+                    f"device={got} golden={ok}"
+                )
+            assert bool(mask[b, r]) == all(want.values())
+        prio = golden.priorities(pod)
+        for pname in _CHECKED_PRIORITIES:
+            tol = 1 if pname in _FLOAT_BLEND_PRIORITIES else 0
+            for node in nodes:
+                got = per_prio[b, PRIO_INDEX[pname], row[node.name]]
+                want_score = prio[pname][node.name]
+                assert abs(got - want_score) <= tol, (
+                    f"pod={pod.name} node={node.name} priority={pname}: "
+                    f"device={got} golden={want_score}"
+                )
+
+
+def test_basic_resources_fit():
+    nodes = [make_node("n1", cpu="1", mem="1Gi"), make_node("n2", cpu="4", mem="8Gi")]
+    pods = [make_pod("existing", cpu="500m", mem="512Mi", node_name="n1")]
+    pending = [make_pod("p", cpu="600m", mem="256Mi")]
+    enc = build_encoder(nodes, pods, [])
+    assert_parity(enc, nodes, pods, [], pending)
+
+
+def test_taints_tolerations():
+    nodes = [
+        make_node("n1", taints=[{"key": "dedicated", "value": "gpu", "effect": "NoSchedule"}]),
+        make_node("n2", taints=[{"key": "x", "effect": "PreferNoSchedule"}]),
+        make_node("n3"),
+    ]
+    pending = [
+        make_pod("p1"),
+        make_pod("p2", tolerations=[{"key": "dedicated", "operator": "Equal", "value": "gpu", "effect": "NoSchedule"}]),
+        make_pod("p3", tolerations=[{"operator": "Exists"}]),
+    ]
+    enc = build_encoder(nodes, [], [])
+    assert_parity(enc, nodes, [], [], pending)
+
+
+def test_node_selector_and_affinity():
+    nodes = [
+        make_node("n1", labels={"disk": "ssd", "num": "5"}),
+        make_node("n2", labels={"disk": "hdd"}),
+        make_node("n3"),
+    ]
+    pending = [
+        make_pod("p1", node_selector={"disk": "ssd"}),
+        make_pod(
+            "p2",
+            affinity={
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [
+                            {"matchExpressions": [{"key": "disk", "operator": "In", "values": ["ssd", "nvme"]}]},
+                            {"matchExpressions": [{"key": "num", "operator": "Gt", "values": ["3"]}]},
+                        ]
+                    }
+                }
+            },
+        ),
+        make_pod(
+            "p3",
+            affinity={
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [
+                            {"matchFields": [{"key": "metadata.name", "operator": "In", "values": ["n3"]}]}
+                        ]
+                    }
+                }
+            },
+        ),
+    ]
+    enc = build_encoder(nodes, [], [])
+    assert_parity(enc, nodes, [], [], pending)
+
+
+def test_host_ports():
+    nodes = [make_node("n1"), make_node("n2")]
+    pods = [
+        make_pod("e1", node_name="n1", ports=[{"hostPort": 80, "protocol": "TCP"}]),
+        make_pod("e2", node_name="n2", ports=[{"hostPort": 80, "protocol": "TCP", "hostIP": "10.0.0.1"}]),
+    ]
+    pending = [
+        make_pod("p1", ports=[{"hostPort": 80, "protocol": "TCP"}]),
+        make_pod("p2", ports=[{"hostPort": 80, "protocol": "UDP"}]),
+        make_pod("p3", ports=[{"hostPort": 80, "protocol": "TCP", "hostIP": "10.0.0.2"}]),
+    ]
+    enc = build_encoder(nodes, pods, [])
+    assert_parity(enc, nodes, pods, [], pending)
+
+
+def test_inter_pod_affinity_required():
+    zone = "failure-domain.beta.kubernetes.io/zone"
+    nodes = [
+        make_node("n1", labels={zone: "z1"}),
+        make_node("n2", labels={zone: "z1"}),
+        make_node("n3", labels={zone: "z2"}),
+    ]
+    pods = [make_pod("web", labels={"app": "web"}, node_name="n1")]
+    pending = [
+        make_pod(
+            "want-near",
+            labels={"app": "cache"},
+            affinity={
+                "podAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {"labelSelector": {"matchLabels": {"app": "web"}}, "topologyKey": zone}
+                    ]
+                }
+            },
+        ),
+        make_pod(
+            "want-away",
+            labels={"app": "web"},
+            affinity={
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {"labelSelector": {"matchLabels": {"app": "web"}}, "topologyKey": zone}
+                    ]
+                }
+            },
+        ),
+        make_pod(
+            "bootstrap",
+            labels={"app": "new"},
+            affinity={
+                "podAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {"labelSelector": {"matchLabels": {"app": "new"}}, "topologyKey": zone}
+                    ]
+                }
+            },
+        ),
+    ]
+    enc = build_encoder(nodes, pods, [])
+    assert_parity(enc, nodes, pods, [], pending)
+
+
+def test_existing_anti_affinity_blocks():
+    host = "kubernetes.io/hostname"
+    nodes = [make_node("n1"), make_node("n2")]
+    pods = [
+        make_pod(
+            "lonely",
+            labels={"app": "lonely"},
+            node_name="n1",
+            affinity={
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {"labelSelector": {"matchLabels": {"app": "web"}}, "topologyKey": host}
+                    ]
+                }
+            },
+        )
+    ]
+    pending = [make_pod("w", labels={"app": "web"}), make_pod("other", labels={"app": "db"})]
+    enc = build_encoder(nodes, pods, [])
+    assert_parity(enc, nodes, pods, [], pending)
+
+
+def test_spreading_and_scores():
+    zone = "failure-domain.beta.kubernetes.io/zone"
+    nodes = [
+        make_node("n1", labels={zone: "z1"}),
+        make_node("n2", labels={zone: "z1"}),
+        make_node("n3", labels={zone: "z2"}),
+    ]
+    pods = [
+        make_pod("a1", labels={"app": "a"}, node_name="n1"),
+        make_pod("a2", labels={"app": "a"}, node_name="n1"),
+        make_pod("a3", labels={"app": "a"}, node_name="n3"),
+    ]
+    services = [("default", {"app": "a"})]
+    pending = [make_pod("a4", labels={"app": "a"})]
+    enc = build_encoder(nodes, pods, services)
+    assert_parity(enc, nodes, pods, services, pending)
+
+
+def test_prefer_avoid_and_images():
+    ann = (
+        '{"preferAvoidPods": [{"podSignature": {"podController": '
+        '{"kind": "ReplicationController", "uid": "rc-1"}}}]}'
+    )
+    nodes = [
+        make_node(
+            "n1",
+            annotations={"scheduler.alpha.kubernetes.io/preferAvoidPods": ann},
+            images=[{"names": ["img-big"], "sizeBytes": 900 * 1024 * 1024}],
+        ),
+        make_node("n2", images=[{"names": ["img-big"], "sizeBytes": 900 * 1024 * 1024}]),
+        make_node("n3"),
+    ]
+    pending = [
+        make_pod("p1", owner=("ReplicationController", "rc-1"), images=["img-big"]),
+        make_pod("p2", owner=("Deployment", "rc-1")),
+    ]
+    enc = build_encoder(nodes, [], [])
+    assert_parity(enc, nodes, [], [], pending)
+
+
+def test_unschedulable_and_conditions():
+    nodes = [
+        make_node("n1", unschedulable=True),
+        make_node("n2", conditions=[{"type": "Ready", "status": "False"}]),
+        make_node("n3", conditions=[{"type": "Ready", "status": "True"}, {"type": "MemoryPressure", "status": "True"}]),
+        make_node("n4"),
+    ]
+    pending = [
+        make_pod("best-effort"),
+        make_pod("burstable", cpu="100m"),
+        make_pod(
+            "tolerates-unsched",
+            tolerations=[{"key": "node.kubernetes.io/unschedulable", "operator": "Exists"}],
+        ),
+    ]
+    enc = build_encoder(nodes, [], [])
+    assert_parity(enc, nodes, [], [], pending)
+
+
+def test_disk_conflict_and_vol_counts():
+    nodes = [make_node("n1"), make_node("n2")]
+    pods = [
+        make_pod(
+            "e1",
+            node_name="n1",
+            volumes=[{"gcePersistentDisk": {"pdName": "disk-a"}}],
+        )
+    ]
+    pending = [
+        make_pod("p1", volumes=[{"gcePersistentDisk": {"pdName": "disk-a"}}]),
+        make_pod("p2", volumes=[{"gcePersistentDisk": {"pdName": "disk-b"}}]),
+    ]
+    enc = build_encoder(nodes, pods, [])
+    assert_parity(enc, nodes, pods, [], pending)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_randomized_differential(seed):
+    rng = np.random.default_rng(1000 + seed)
+    nodes, pods, services = random_cluster(rng, n_nodes=10, n_pods=24)
+    pending = [random_pending_pod(rng, i) for i in range(8)]
+    enc = build_encoder(nodes, pods, services)
+    assert_parity(enc, nodes, pods, services, pending)

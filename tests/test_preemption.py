@@ -1,0 +1,132 @@
+"""Preemption what-if parity: device level-sweep vs golden per-pod reprieve."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.codec.schema import FilterConfig
+from kubernetes_tpu.cpuref import CPUScheduler
+from kubernetes_tpu.models.preemption import (
+    preempt_one,
+    preemption_candidates,
+    sorted_victim_slots,
+)
+from kubernetes_tpu.ops import filter_batch
+
+from fixtures import TEST_DIMS, make_node, make_pod
+
+
+def run_device_preempt(nodes, existing, preemptor):
+    enc = SnapshotEncoder(TEST_DIMS)
+    for n in nodes:
+        enc.add_node(n)
+    for p in existing:
+        enc.add_pod(p)
+    cluster = enc.snapshot()
+    batch = enc.encode_pods([preemptor])
+    _, per_pred = filter_batch(cluster, batch, FilterConfig(), 0)
+    cands = preemption_candidates(np.asarray(per_pred), np.asarray(cluster.valid))[0]
+    pods_node, pods_prio, pods_req, _, pods_valid, keys = enc.pods_snapshot()
+    slots = sorted_victim_slots(
+        pods_prio, pods_valid, pods_node, preemptor.spec.priority
+    )
+    res = preempt_one(
+        cluster,
+        np.asarray(batch.req)[0],
+        cands,
+        pods_node,
+        pods_prio,
+        pods_req,
+        slots,
+    )
+    node_row = int(res.node)
+    row_names = {row: name for name, row in enc.node_rows.items()}
+    victims = {
+        keys[m] for m in np.nonzero(np.asarray(res.victim_mask))[0]
+    }
+    return (row_names[node_row] if node_row >= 0 else None), victims
+
+
+def test_preempt_basic():
+    nodes = [make_node("n1", cpu="1", mem="4Gi"), make_node("n2", cpu="1", mem="4Gi")]
+    existing = [
+        make_pod("low-a", cpu="600m", node_name="n1", priority=1),
+        make_pod("low-b", cpu="600m", node_name="n2", priority=2),
+    ]
+    preemptor = make_pod("high", cpu="800m", priority=100)
+    got_node, got_victims = run_device_preempt(nodes, existing, preemptor)
+    golden = CPUScheduler(nodes, existing)
+    want_node, want_victims = golden.preempt(preemptor)
+    assert got_node == want_node
+    assert got_victims == want_victims
+    assert got_node == "n1"  # victim priority 1 < 2
+
+
+def test_preempt_reprieve_keeps_high_priority():
+    # node has two victims; evicting only the lower one suffices
+    nodes = [make_node("n1", cpu="2", mem="4Gi")]
+    existing = [
+        make_pod("keep", cpu="500m", node_name="n1", priority=50),
+        make_pod("evict", cpu="1", node_name="n1", priority=1),
+    ]
+    preemptor = make_pod("high", cpu="1400m", priority=100)
+    got_node, got_victims = run_device_preempt(nodes, existing, preemptor)
+    golden = CPUScheduler(nodes, existing)
+    want_node, want_victims = golden.preempt(preemptor)
+    assert got_node == want_node == "n1"
+    assert got_victims == want_victims == {("default", "evict")}
+
+
+def test_preempt_impossible():
+    # higher-priority occupants: nothing to evict
+    nodes = [make_node("n1", cpu="1", mem="4Gi")]
+    existing = [make_pod("top", cpu="900m", node_name="n1", priority=1000)]
+    preemptor = make_pod("mid", cpu="500m", priority=100)
+    got_node, got_victims = run_device_preempt(nodes, existing, preemptor)
+    golden = CPUScheduler(nodes, existing)
+    want_node, _ = golden.preempt(preemptor)
+    assert got_node is None and want_node is None
+    assert got_victims == set()
+
+
+def test_preempt_unresolvable_node_skipped():
+    # n1 requires a selector the pod lacks: preemption can't help there
+    nodes = [
+        make_node("n1", cpu="4", mem="8Gi", labels={"disk": "ssd"}),
+        make_node("n2", cpu="1", mem="4Gi"),
+    ]
+    existing = [make_pod("low", cpu="900m", node_name="n2", priority=1)]
+    preemptor = make_pod(
+        "high", cpu="500m", priority=100, node_selector={"disk": "nvme"}
+    )
+    got_node, _ = run_device_preempt(nodes, existing, preemptor)
+    # pod matches NO node's selector -> no candidate anywhere
+    assert got_node is None
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_preempt_randomized(seed):
+    rng = np.random.default_rng(4000 + seed)
+    nodes = [
+        make_node(f"n{i}", cpu=str(int(rng.integers(1, 4))), mem="8Gi")
+        for i in range(6)
+    ]
+    existing = []
+    for i in range(14):
+        existing.append(
+            make_pod(
+                f"e{i}",
+                cpu=f"{int(rng.integers(1, 8)) * 100}m",
+                node_name=f"n{int(rng.integers(6))}",
+                priority=int(rng.integers(0, 5)) * 10,  # distinct level classes
+            )
+        )
+    preemptor = make_pod("boss", cpu="900m", priority=1000)
+    got_node, got_victims = run_device_preempt(nodes, existing, preemptor)
+    golden = CPUScheduler(nodes, existing)
+    want_node, want_victims = golden.preempt(preemptor)
+    if want_node is None:
+        assert got_node is None
+    else:
+        assert got_node == want_node
+        assert got_victims == want_victims

@@ -1,0 +1,177 @@
+"""Host-runtime tests: queue semantics, cache lifecycle, scheduler service.
+
+Covers the regressions found in review: heap lazy-deletion (double pop),
+node-row reuse after remove_node, topology-label moves reconciling
+anti-affinity pair counts, mid-batch extended-resource growth.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.cpuref import CPUScheduler
+from kubernetes_tpu.runtime import PriorityQueue, Scheduler, SchedulerCache, SchedulerConfig
+import kubernetes_tpu.runtime.queue as queue_mod
+
+from fixtures import TEST_DIMS, make_node, make_pod
+
+
+# ------------------------------------------------------------------- queue
+
+
+def test_queue_priority_then_fifo():
+    q = PriorityQueue()
+    q.add(make_pod("a", priority=1))
+    q.add(make_pod("b", priority=5))
+    q.add(make_pod("c", priority=5))
+    assert [q.pop(0.1).name for _ in range(3)] == ["b", "c", "a"]
+
+
+def test_queue_delete_prevents_pop():
+    q = PriorityQueue()
+    p = make_pod("gone")
+    q.add(p)
+    q.delete(p)
+    assert q.pop(timeout=0.05) is None
+    assert len(q) == 0
+
+
+def test_queue_delete_readd_single_pop():
+    q = PriorityQueue()
+    p = make_pod("x")
+    q.add(p)
+    q.delete(p)
+    q.add(p)
+    assert q.pop(0.1).name == "x"
+    assert q.pop(timeout=0.05) is None  # no stale duplicate
+
+
+def test_queue_backoff_then_active():
+    q = PriorityQueue()
+    p = make_pod("r")
+    q.add(p)
+    assert q.pop(0.1).name == "r"
+    cycle = q.scheduling_cycle
+    q.move_all_to_active()  # a cluster event happened after the cycle started
+    q.add_unschedulable(p, cycle - 1)
+    # backoff (1s initial) must delay the retry
+    assert q.pop(timeout=0.05) is None
+    got = q.pop(timeout=2.0)
+    assert got is not None and got.name == "r"
+
+
+def test_queue_unschedulable_leftover_flush(monkeypatch):
+    monkeypatch.setattr(queue_mod, "UNSCHEDULABLE_TIME_LIMIT", 0.2)
+    q = PriorityQueue()
+    p = make_pod("parked")
+    q.add(p)
+    assert q.pop(0.1).name == "parked"
+    q.add_unschedulable(p, q.scheduling_cycle)  # no move event -> parks
+    assert q.pop(timeout=0.05) is None
+    got = q.pop(timeout=3.0)  # leftover flush + backoff expiry
+    assert got is not None and got.name == "parked"
+
+
+# ------------------------------------------------------------------- cache
+
+
+def _snapshot_requested(enc, name):
+    return enc.a_requested[enc.node_rows[name]].copy()
+
+
+def test_remove_node_row_reuse_is_clean():
+    enc = SnapshotEncoder(TEST_DIMS)
+    enc.add_node(make_node("a", cpu="4"))
+    pod = make_pod("p", cpu="1", node_name="a")
+    enc.add_pod(pod)
+    enc.remove_node("a")
+    enc.add_node(make_node("b", cpu="8"))
+    # b reuses a's row: must start with zero usage
+    assert _snapshot_requested(enc, "b")[0] == 0.0
+    # the orphaned pod must not poison b when removed later
+    enc.remove_pod(pod)
+    assert _snapshot_requested(enc, "b")[0] == 0.0
+
+
+def test_update_node_topology_move_reconciles_anti_affinity():
+    zone = "failure-domain.beta.kubernetes.io/zone"
+    enc = SnapshotEncoder(TEST_DIMS)
+    enc.add_node(make_node("n1", labels={zone: "z1"}))
+    enc.add_node(make_node("n2", labels={zone: "z2"}))
+    anti = {
+        "podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": {"matchLabels": {"app": "w"}}, "topologyKey": zone}
+            ]
+        }
+    }
+    guard = make_pod("guard", labels={"app": "w"}, node_name="n1", affinity=anti)
+    enc.add_pod(guard)
+    from kubernetes_tpu.codec.schema import FilterConfig
+    from kubernetes_tpu.ops import filter_batch
+
+    def allowed_on(name):
+        batch = enc.encode_pods([make_pod("w2", labels={"app": "w"})])
+        mask, _ = filter_batch(enc.snapshot(), batch, FilterConfig(), 0)
+        return bool(np.asarray(mask)[0, enc.node_rows[name]])
+
+    assert not allowed_on("n1") and allowed_on("n2")
+    # move n1 to z2: the forbidden zone must follow
+    enc.update_node(make_node("n1", labels={zone: "z2"}))
+    assert not allowed_on("n2")
+    assert not allowed_on("n1")
+    # remove the guard: counts must return to zero everywhere (not negative)
+    enc.remove_pod(guard)
+    assert allowed_on("n1") and allowed_on("n2")
+
+
+def test_extended_resource_growth_mid_batch():
+    enc = SnapshotEncoder(TEST_DIMS)
+    node = make_node("n1", cpu="4")
+    node.status.allocatable["example.com/gadget"] = __import__(
+        "kubernetes_tpu.api.resource", fromlist=["parse_quantity"]
+    ).parse_quantity("2")
+    enc.add_node(node)
+    # pod requesting a resource never seen before (R must grow pre-allocation)
+    pod = make_pod("p", cpu="100m")
+    from kubernetes_tpu.api.resource import parse_quantity
+
+    pod.spec.containers[0].requests["example.com/widget-%d" % 7] = parse_quantity("1")
+    for i in range(8):  # enough new names to overflow the default R
+        p2 = make_pod(f"q{i}", cpu="100m")
+        p2.spec.containers[0].requests[f"example.com/res-{i}"] = parse_quantity("1")
+        pod_batch = enc.encode_pods([pod, p2])  # must not crash
+    assert pod_batch.req.shape[1] == enc.dims.R
+
+
+# ----------------------------------------------------------------- service
+
+
+def test_scheduler_uses_caller_queue_even_when_empty():
+    q = PriorityQueue()
+    s = Scheduler(queue=q)
+    assert s.queue is q
+
+
+def test_scheduler_end_to_end_cycle():
+    cache = SchedulerCache()
+    q = PriorityQueue()
+    bound = []
+    sched = Scheduler(cache, q, lambda p, n: bound.append((p.name, n)) or True,
+                      SchedulerConfig(batch_size=16, batch_window_s=0.0))
+    cache.add_node(make_node("n1", cpu="1"))
+    cache.add_node(make_node("n2", cpu="1"))
+    for i in range(4):
+        q.add(make_pod(f"p{i}", cpu="400m"))
+    n = sched.run_once(timeout=0.2)
+    assert n == 4
+    placed = [node for _, node in bound]
+    assert placed.count("n1") == 2 and placed.count("n2") == 2
+    # binder failure rolls back the cache
+    gen = cache.generation
+    sched.binder = lambda p, n: False
+    q.add(make_pod("fail", cpu="100m"))
+    sched.run_once(timeout=0.2)
+    assert sched.results[-1].node is None
