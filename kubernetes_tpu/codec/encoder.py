@@ -858,6 +858,12 @@ class SnapshotEncoder:
             disk_vol_ids=self.a_dvol.copy(),
         )
 
+    def row_name(self, row: int) -> str:
+        """Node name for an arena row (O(1); _row_node is kept consistent by
+        add/update/remove_node)."""
+        node = self._row_node.get(row)
+        return node.name if node is not None else ""
+
     def pods_snapshot(self):
         """Per-pod device tensors for preemption what-ifs: the assigned-pod
         arena as (node_row i32[M], priority i32[M], req f32[M, R],

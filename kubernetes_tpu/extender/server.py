@@ -1,0 +1,280 @@
+"""HTTP scheduler-extender sidecar: the out-of-process seam.
+
+Implements the reference's extender protocol (pkg/scheduler/core/extender.go;
+wire types pkg/scheduler/api/v1/types.go; config api/types.go:203-233) so a
+STOCK Go kube-scheduler can offload Filter/Prioritize/Preempt/Bind to the TPU
+pipeline with `NodeCacheCapable: true`:
+
+  POST <filterVerb>      ExtenderArgs{Pod, NodeNames}   -> ExtenderFilterResult
+  POST <prioritizeVerb>  ExtenderArgs{Pod, NodeNames}   -> HostPriorityList
+  POST <preemptVerb>     ExtenderPreemptionArgs          -> ExtenderPreemptionResult
+  POST <bindVerb>        ExtenderBindingArgs             -> ExtenderBindingResult
+
+NodeCacheCapable=true means the scheduler sends only node *names* and the
+extender mirrors cluster state itself (api/types.go:226-229) — exactly the
+device-resident-tensor model.  The mirror is fed by the sync endpoints
+(the watch-ingest seam; a client-go informer relay or our LocalCluster can
+drive them):
+
+  POST /sync/node        add/update one Node (JSON)
+  POST /sync/node/remove {"name": ...}
+  POST /sync/pod         add one (assigned) Pod
+  POST /sync/pod/remove  {"namespace": ..., "name": ...}
+  POST /sync/service     {"namespace": ..., "selector": {...}}
+  GET  /healthz, /metrics (Prometheus text)
+
+Scoring contract: extender Prioritize returns 0..10 per node (weighted by the
+extender's configured weight on the scheduler side, extender.go:318-358); we
+return the TPU total score rescaled to 0..10.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import numpy as np
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.codec.schema import FilterConfig, NUM_PREDICATES, PREDICATE_ORDER
+from kubernetes_tpu.models.generic import schedule_batch_independent
+from kubernetes_tpu.models.preemption import (
+    preempt_one,
+    preemption_candidates,
+    sorted_victim_slots,
+)
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.utils import metrics as m
+
+
+class ExtenderServer:
+    """Threaded HTTP server around a SchedulerCache + the device pipeline."""
+
+    def __init__(
+        self,
+        cache: Optional[SchedulerCache] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        filter_config: Optional[FilterConfig] = None,
+    ):
+        self.cache = cache or SchedulerCache()
+        self.cfg = filter_config or FilterConfig()
+        enc = self.cache.encoder
+        self._unsched = enc.interner.intern("node.kubernetes.io/unschedulable")
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def address(self):
+        return self._httpd.server_address
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ------------------------------------------------------------ pipeline
+
+    @staticmethod
+    def _arg(args: dict, *names):
+        """Wire tolerance: the v1 wire format is lowercase ("pod",
+        "nodenames" — api/v1/types.go:241-247 json tags) but accept the Go
+        field spelling too."""
+        for n in names:
+            if n in args and args[n] is not None:
+                return args[n]
+        return None
+
+    def _requested_nodes(self, args: dict, enc):
+        names = self._arg(args, "nodenames", "NodeNames")
+        if names is None:
+            # non-NodeCacheCapable mode: full NodeList objects
+            nl = self._arg(args, "nodes", "Nodes") or {}
+            items = nl.get("items") if isinstance(nl, dict) else None
+            if items:
+                names = [n.get("metadata", {}).get("name", "") for n in items]
+        return names if names is not None else list(enc.node_rows)
+
+    def filter(self, args: dict) -> dict:
+        pod_d = self._arg(args, "pod", "Pod")
+        if pod_d is None:
+            return {"nodenames": [], "failedNodes": {}, "error": "missing pod"}
+        pod = Pod.from_dict(pod_d)
+        enc = self.cache.encoder
+        # hold the cache lock across compute AND row->name decode: a
+        # concurrent /sync could recycle rows between the two
+        with self.cache._lock:
+            cluster, _ = self.cache.snapshot()
+            batch = enc.encode_pods([pod])
+            out = schedule_batch_independent(
+                cluster, batch, 0, self.cfg, self._unsched, enc.zone_key
+            )
+            mask = np.asarray(out["mask"])[0]
+            failure = np.asarray(out["failure"])[0]
+            requested = self._requested_nodes(args, enc)
+            ok, failed = [], {}
+            for name in requested:
+                row = enc.node_rows.get(name)
+                if row is None:
+                    failed[name] = "node not in extender cache"
+                elif mask[row]:
+                    ok.append(name)
+                else:
+                    idx = int(failure[row])
+                    failed[name] = (
+                        PREDICATE_ORDER[idx] if idx < NUM_PREDICATES else "Unschedulable"
+                    )
+        return {"nodenames": ok, "failedNodes": failed, "error": ""}
+
+    def prioritize(self, args: dict) -> list:
+        pod_d = self._arg(args, "pod", "Pod")
+        if pod_d is None:
+            return []
+        pod = Pod.from_dict(pod_d)
+        enc = self.cache.encoder
+        with self.cache._lock:
+            cluster, _ = self.cache.snapshot()
+            batch = enc.encode_pods([pod])
+            out = schedule_batch_independent(
+                cluster, batch, 0, self.cfg, self._unsched, enc.zone_key
+            )
+            scores = np.asarray(out["scores"])[0]
+            requested = self._requested_nodes(args, enc)
+            # rescale the weighted total to the extender's 0..10 contract
+            rows = [enc.node_rows[n] for n in requested if n in enc.node_rows]
+            mx = max((scores[r] for r in rows), default=0.0)
+            result = []
+            for name in requested:
+                row = enc.node_rows.get(name)
+                s = 0 if row is None or mx <= 0 else int(10.0 * scores[row] / mx)
+                result.append({"host": name, "score": s})
+        return result
+
+    def preempt(self, args: dict) -> dict:
+        pod_d = self._arg(args, "pod", "Pod")
+        if pod_d is None:
+            return {"nodeNameToMetaVictims": {}}
+        pod = Pod.from_dict(pod_d)
+        enc = self.cache.encoder
+        from kubernetes_tpu.ops import filter_batch
+
+        with self.cache._lock:
+            cluster, _ = self.cache.snapshot()
+            batch = enc.encode_pods([pod])
+            _, per_pred = filter_batch(cluster, batch, self.cfg, self._unsched)
+            cands = preemption_candidates(
+                np.asarray(per_pred), np.asarray(cluster.valid)
+            )[0]
+            pods_node, pods_prio, pods_req, _, pods_valid, keys = enc.pods_snapshot()
+            slots = sorted_victim_slots(
+                pods_prio, pods_valid, pods_node, pod.spec.priority
+            )
+            res = preempt_one(
+                cluster, np.asarray(batch.req)[0], cands,
+                pods_node, pods_prio, pods_req, slots,
+            )
+            node_row = int(res.node)
+            if node_row < 0:
+                return {"nodeNameToMetaVictims": {}}
+            node_name = enc.row_name(node_row)
+            victims = [
+                {"uid": f"{keys[mi][0]}/{keys[mi][1]}"}
+                for mi in np.nonzero(np.asarray(res.victim_mask))[0]
+            ]
+        return {
+            "nodeNameToMetaVictims": {
+                node_name: {"pods": victims, "numPDBViolations": 0}
+            }
+        }
+
+    def bind(self, args: dict) -> dict:
+        # assume into the mirror; the scheduler does the real API bind when
+        # BindVerb is configured the extender owns binding (extender.go:360-385)
+        name = args.get("PodName", "")
+        ns = args.get("PodNamespace", "default")
+        node = args.get("Node", "")
+        rec = self.cache.encoder.pods.get((ns, name))
+        if rec is None:
+            pod = Pod.from_dict(
+                {"metadata": {"name": name, "namespace": ns}, "spec": {"nodeName": node}}
+            )
+            self.cache.assume_pod(pod)
+        return {"Error": ""}
+
+    # ------------------------------------------------------------- handler
+
+    def _make_handler(self):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send(self, obj, code=200, content_type="application/json"):
+                body = (
+                    obj.encode() if isinstance(obj, str) else json.dumps(obj).encode()
+                )
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send("ok", content_type="text/plain")
+                elif self.path == "/metrics":
+                    self._send(m.REGISTRY.expose(), content_type="text/plain")
+                else:
+                    self._send({"error": "not found"}, 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    args = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    self._send({"Error": "bad json"}, 400)
+                    return
+                try:
+                    if self.path == "/filter":
+                        self._send(outer.filter(args))
+                    elif self.path == "/prioritize":
+                        self._send(outer.prioritize(args))
+                    elif self.path == "/preempt":
+                        self._send(outer.preempt(args))
+                    elif self.path == "/bind":
+                        self._send(outer.bind(args))
+                    elif self.path == "/sync/node":
+                        outer.cache.add_node(Node.from_dict(args))
+                        self._send({"ok": True})
+                    elif self.path == "/sync/node/remove":
+                        outer.cache.remove_node(args["name"])
+                        self._send({"ok": True})
+                    elif self.path == "/sync/pod":
+                        outer.cache.add_pod(Pod.from_dict(args))
+                        self._send({"ok": True})
+                    elif self.path == "/sync/pod/remove":
+                        outer.cache.remove_pod(
+                            Pod.from_dict(
+                                {"metadata": {"name": args["name"], "namespace": args.get("namespace", "default")}}
+                            )
+                        )
+                        self._send({"ok": True})
+                    elif self.path == "/sync/service":
+                        outer.cache.encoder.add_spread_selector(
+                            args.get("namespace", "default"), args.get("selector") or {}
+                        )
+                        self._send({"ok": True})
+                    else:
+                        self._send({"error": "not found"}, 404)
+                except Exception as e:  # surface errors in the reply, not a 500 stack
+                    self._send({"Error": f"{type(e).__name__}: {e}"}, 500)
+
+        return Handler

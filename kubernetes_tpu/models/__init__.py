@@ -16,3 +16,10 @@ from kubernetes_tpu.models.batched import (
     encode_batch_ports,
     make_sequential_scheduler,
 )
+from kubernetes_tpu.models.preemption import (
+    preempt_one,
+    preemption_candidates,
+    sorted_victim_slots,
+)
+from kubernetes_tpu.models.gang import GangScheduler, PodGroup
+from kubernetes_tpu.models.binpack import binpack_ffd, binpack_shapes, what_if

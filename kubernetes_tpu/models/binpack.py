@@ -1,0 +1,80 @@
+"""Cluster-autoscaler what-if binpack.
+
+Not in the reference tree (the autoscaler is a sibling repo); BASELINE.md
+lists "what-if binpack: 50k pending pods x 10k candidate node shapes" as a
+new capability.  The question an autoscaler asks: *if I added nodes of shape
+S, how many would the pending set need?*  Classic first-fit-decreasing (the
+autoscaler estimator's algorithm), tensorized:
+
+  * pods sorted by dominant-resource size descending (host);
+  * one lax.scan over pods; state = bin load matrix [max_bins, R];
+  * per step: fits = load + req <= cap (vectorized over all bins),
+    place into the FIRST fitting bin (argmax of a bool vector), opening a
+    new bin is just fitting into an all-zero row.
+
+Evaluating many candidate shapes is a vmap over the capacity vector — 10k
+shapes x 50k pods runs as one batched program, which is the whole point of
+doing this on a TPU instead of the autoscaler's Go loop.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("max_bins",))
+def binpack_ffd(pod_reqs, capacity, max_bins: int = 1024):
+    """First-fit binpack of pod_reqs f32[P, R] into bins of `capacity` f32[R].
+
+    pod_reqs should be pre-sorted descending (see sort_pods_for_ffd) for the
+    FFD guarantee; zero rows (padding) are skipped.  Returns (n_bins i32,
+    loads f32[max_bins, R], placed bool[P] — False when max_bins overflowed).
+    """
+
+    def step(loads, req):
+        real = jnp.any(req > 0)
+        fits = jnp.all(loads + req[None, :] <= capacity[None, :], axis=-1)
+        idx = jnp.argmax(fits)  # first fitting bin (zeros always fit if req<=cap)
+        ok = real & fits[idx]
+        loads = loads.at[idx].add(jnp.where(ok, req, 0.0))
+        return loads, ok | ~real
+
+    loads, placed = jax.lax.scan(
+        step, jnp.zeros((max_bins, pod_reqs.shape[1]), jnp.float32), pod_reqs
+    )
+    used = jnp.sum(jnp.any(loads > 0, axis=-1))
+    return used.astype(jnp.int32), loads, placed
+
+
+@partial(jax.jit, static_argnames=("max_bins",))
+def binpack_shapes(pod_reqs, capacities, max_bins: int = 1024):
+    """vmap the what-if over candidate node shapes: capacities f32[S, R] ->
+    (bins_needed i32[S], all_placed bool[S]).
+
+    The FFD "decreasing" order is shape-relative (dominant fraction of THAT
+    shape's capacity), so each lane sorts its own copy of the pod list on
+    device before packing — heterogeneous shapes get a true FFD each."""
+
+    def one(cap):
+        frac = pod_reqs / jnp.maximum(cap[None, :], 1e-30)
+        key = jnp.max(frac, axis=-1)
+        order = jnp.argsort(-key, stable=True)
+        used, _, placed = binpack_ffd(pod_reqs[order], cap, max_bins=max_bins)
+        return used, jnp.all(placed)
+
+    return jax.vmap(one)(capacities)
+
+
+def what_if(pod_reqs: np.ndarray, shapes: np.ndarray, max_bins: int = 1024):
+    """Autoscaler entry: pending pod requests [P, R] x candidate shapes
+    [S, R] -> list of (shape index, nodes needed) for shapes that fit all."""
+    bins, ok = binpack_shapes(
+        pod_reqs.astype(np.float32), shapes.astype(np.float32), max_bins=max_bins
+    )
+    bins = np.asarray(bins)
+    ok = np.asarray(ok)
+    return [(int(s), int(bins[s])) for s in range(shapes.shape[0]) if ok[s]]

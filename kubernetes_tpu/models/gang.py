@@ -1,0 +1,106 @@
+"""Gang / coscheduling: all-or-nothing batched assignment.
+
+Not in the reference tree (PodGroup coscheduling lives in the sibling
+scheduler-plugins project; BASELINE.md lists it as a new capability —
+"Gang/coscheduling PodGroup: 1k gangs x 32 pods").  The TPU design makes it
+almost free: the sequential-commit scan (models/batched.py) is *functional* —
+it returns the committed cluster state as a new value — so an all-or-nothing
+gang is one scan plus a host-side decision of WHICH state to keep:
+
+    hosts, new_state = seq_schedule(state, gang_pods, ...)
+    placed = all(hosts >= 0)
+    state  = new_state if placed else state      # rollback = keep the old pytree
+
+No unwind pass, no victim bookkeeping: immutability gives transactional
+semantics.  minMember < len(gang) keeps the first minMember placements only
+if at least minMember fit (PodGroup.spec.minMember semantics).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api.types import Pod
+
+
+@dataclass
+class PodGroup:
+    """PodGroup CRD analog (scheduler-plugins coscheduling API)."""
+
+    name: str
+    namespace: str = "default"
+    min_member: int = 0  # 0 => all pods required
+
+
+class GangScheduler:
+    """Schedules pod groups transactionally against an encoder + device fn.
+
+    Reuses the Scheduler's sequential-commit program; `schedule_gang` either
+    commits every placement to the cache (assume) or none.
+    """
+
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+
+    def schedule_gang(
+        self, group: PodGroup, pods: Sequence[Pod]
+    ) -> Tuple[Optional[List[str]], int]:
+        """Returns (node names per pod, n_placed) — names is None if the gang
+        did not reach min_member and nothing was committed."""
+        from kubernetes_tpu.models.batched import encode_batch_ports
+
+        sched = self.scheduler
+        enc = sched.cache.encoder
+        need = group.min_member or len(pods)
+        with sched.cache._lock:
+            batch = enc.encode_pods(pods)
+            ports = encode_batch_ports(enc, pods, enc.dims.N)
+            cluster, _ = sched.cache.snapshot()
+        hosts, _new_state = sched._schedule_fn(
+            cluster, batch, ports, np.int32(sched._last_index)
+        )
+        sched._last_index += len(pods)
+        hosts = np.asarray(hosts)[: len(pods)]
+        placed = int((hosts >= 0).sum())
+        if placed < need:
+            return None, placed
+        out: List[str] = []
+        import dataclasses
+
+        committed: List = []  # (assumed pod, node) pairs, for rollback
+        failed = False
+        for i, pod in enumerate(pods):
+            if len(committed) >= need and group.min_member:
+                out.append("")
+                continue
+            r = int(hosts[i])
+            if r < 0:
+                out.append("")
+                continue
+            node = enc.row_name(r)
+            assumed = dataclasses.replace(
+                pod, spec=dataclasses.replace(pod.spec, node_name=node)
+            )
+            sched.cache.assume_pod(assumed)
+            try:
+                ok = sched.binder(assumed, node)
+            except Exception:
+                ok = False
+            if not ok:
+                sched.cache.forget_pod(assumed)
+                failed = True
+                break
+            committed.append((assumed, node))
+            out.append(node)
+        if failed or len(committed) < need:
+            # all-or-nothing: unwind every bind of this gang
+            for assumed, _node in committed:
+                sched.cache.forget_pod(assumed)
+                unbinder = getattr(sched, "unbinder", None)
+                if unbinder is not None:
+                    unbinder(assumed)
+            return None, len(committed)
+        return out, len(committed)

@@ -1,0 +1,211 @@
+"""LocalCluster: the in-process control-plane blackboard.
+
+The reference's architecture routes ALL component communication through the
+API server + watch (SURVEY.md section 1: "blackboard architecture") — storage
+(etcd3 store + watch cache, staging/.../storage/etcd3/store.go, cacher.go),
+REST registry, and client-go informers (reflector -> DeltaFIFO -> shared
+informer).  For the standalone framework the same seam is one in-process
+object store with revisioned watch fan-out:
+
+  * every object carries a monotonically-increasing resourceVersion
+    (etcd3's mod_revision analog), bumped on each write;
+  * optimistic concurrency: update(obj, expect_rv=...) fails on conflict the
+    way etcd3 compare-and-swap does (GuaranteedUpdate);
+  * watchers get (event_type, kind, obj) callbacks after each commit —
+    the informer seam, minus the network;
+  * `wire_scheduler` reproduces the scheduler's informer wiring
+    (pkg/scheduler/eventhandlers.go:319-378): assigned pods -> cache,
+    unassigned pods -> queue, node/service events -> cache +
+    MoveAllToActiveQueue.
+
+A real multi-process deployment swaps this for an apiserver client; the
+extender sidecar's /sync endpoints speak the same three verbs.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.types import Node, Pod
+
+ADDED, MODIFIED, DELETED = "ADDED", "MODIFIED", "DELETED"
+
+
+class ConflictError(Exception):
+    """resourceVersion mismatch (etcd3 txn failure analog)."""
+
+
+@dataclass
+class _Stored:
+    obj: object
+    rv: int
+
+
+class LocalCluster:
+    KINDS = ("nodes", "pods", "services")
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._rv = 0
+        self._store: Dict[str, Dict[Tuple[str, str], _Stored]] = {
+            k: {} for k in self.KINDS
+        }
+        self._watchers: List[Callable[[str, str, object], None]] = []
+
+    # ------------------------------------------------------------ storage
+
+    @staticmethod
+    def _key(kind: str, obj) -> Tuple[str, str]:
+        if kind == "nodes":
+            return ("", obj.name)
+        if kind == "services":
+            return (obj["namespace"], obj["name"])
+        return (obj.namespace, obj.name)
+
+    def _notify(self, event: str, kind: str, obj) -> None:
+        for w in list(self._watchers):
+            w(event, kind, obj)
+
+    def watch(self, fn: Callable[[str, str, object], None]) -> None:
+        """Subscribe; immediately replays the current state as ADDED events
+        (the reflector LIST+WATCH contract)."""
+        with self._lock:
+            self._watchers.append(fn)
+            for kind in self.KINDS:
+                for s in self._store[kind].values():
+                    fn(ADDED, kind, s.obj)
+
+    def create(self, kind: str, obj) -> int:
+        with self._lock:
+            key = self._key(kind, obj)
+            if key in self._store[kind]:
+                raise ConflictError(f"{kind} {key} exists")
+            self._rv += 1
+            self._store[kind][key] = _Stored(obj, self._rv)
+            self._notify(ADDED, kind, obj)
+            return self._rv
+
+    def update(self, kind: str, obj, expect_rv: Optional[int] = None) -> int:
+        with self._lock:
+            key = self._key(kind, obj)
+            cur = self._store[kind].get(key)
+            if cur is None:
+                raise ConflictError(f"{kind} {key} missing")
+            if expect_rv is not None and cur.rv != expect_rv:
+                raise ConflictError(f"{kind} {key} rv {cur.rv} != {expect_rv}")
+            self._rv += 1
+            self._store[kind][key] = _Stored(obj, self._rv)
+            self._notify(MODIFIED, kind, obj)
+            return self._rv
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            key = (namespace if kind != "nodes" else "", name)
+            cur = self._store[kind].pop(key, None)
+            if cur is not None:
+                self._rv += 1
+                self._notify(DELETED, kind, cur.obj)
+
+    def get(self, kind: str, namespace: str, name: str):
+        with self._lock:
+            key = (namespace if kind != "nodes" else "", name)
+            s = self._store[kind].get(key)
+            return s.obj if s else None
+
+    def list(self, kind: str) -> List[object]:
+        with self._lock:
+            return [s.obj for s in self._store[kind].values()]
+
+    # ------------------------------------------------------------- helpers
+
+    def add_node(self, node: Node) -> None:
+        self.create("nodes", node)
+
+    def add_pod(self, pod: Pod) -> None:
+        self.create("pods", pod)
+
+    def add_service(self, namespace: str, name: str, selector: Dict[str, str]) -> None:
+        self.create(
+            "services", {"namespace": namespace, "name": name, "selector": selector}
+        )
+
+    def bind(self, pod: Pod, node_name: str) -> bool:
+        """The Binding-subresource analog (registry sets spec.nodeName,
+        SURVEY section 3.3): CAS on the stored pod."""
+        import dataclasses
+
+        with self._lock:
+            cur = self.get("pods", pod.namespace, pod.name)
+            if cur is None:
+                return False
+            if cur.spec.node_name:
+                return False  # already bound
+            bound = dataclasses.replace(
+                cur, spec=dataclasses.replace(cur.spec, node_name=node_name)
+            )
+            self.update("pods", bound)
+            return True
+
+
+def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
+    """AddAllEventHandlers analog (pkg/scheduler/eventhandlers.go:319-378):
+    route store events into the scheduler's cache and queue."""
+    cache = scheduler.cache
+    queue = scheduler.queue
+
+    def on_event(event: str, kind: str, obj) -> None:
+        if kind == "nodes":
+            if event == ADDED:
+                cache.add_node(obj)
+            elif event == MODIFIED:
+                cache.update_node(obj)
+            else:
+                cache.remove_node(obj.name)
+            # node changes can make unschedulable pods feasible
+            queue.move_all_to_active()
+        elif kind == "pods":
+            assigned = bool(obj.spec.node_name)
+            if event == ADDED:
+                if assigned:
+                    cache.add_pod(obj)
+                    queue.move_all_to_active()
+                else:
+                    queue.add(obj)
+            elif event == MODIFIED:
+                if assigned:
+                    # another scheduler (or this one) bound it: confirm in
+                    # the cache AND drop it from the queue — otherwise a
+                    # second scheduler sharing the store double-binds
+                    # (eventhandlers.go moves pods between the unscheduled
+                    # and scheduled informers on assignment)
+                    cache.add_pod(obj)
+                    queue.delete(obj)
+                else:
+                    # spec update while pending: re-queue the fresh copy
+                    queue.delete(obj)
+                    queue.add(obj)
+            else:
+                if assigned:
+                    cache.remove_pod(obj)
+                    queue.move_all_to_active()
+                else:
+                    queue.delete(obj)
+        elif kind == "services":
+            if event == ADDED:
+                cache.encoder.add_spread_selector(
+                    obj["namespace"], obj["selector"]
+                )
+                queue.move_all_to_active()
+
+    cluster.watch(on_event)
+
+
+def make_cluster_binder(cluster: LocalCluster):
+    """Binder callback for Scheduler: POST .../binding analog."""
+
+    def binder(pod: Pod, node_name: str) -> bool:
+        return cluster.bind(pod, node_name)
+
+    return binder

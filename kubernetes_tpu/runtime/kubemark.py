@@ -1,0 +1,65 @@
+"""Hollow nodes: scale testing without machines.
+
+The reference measures 5k-node behavior with kubemark hollow nodes — a real
+kubelet sync loop wired to fake runtime backends (pkg/kubemark/
+hollow_kubelet.go:53-74, cmd/kubemark/hollow-node.go).  The analog here: a
+HollowNode registers a Node object and runs the node-agent's observable
+contract against the LocalCluster — acknowledge bound pods by driving
+status.phase to Running (the statusManager PATCH analog) — without any
+containers underneath.  The density harness (tests + bench) uses fleets of
+these to exercise the full schedule->bind->run loop.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.runtime.cluster import ADDED, MODIFIED, LocalCluster
+
+
+class HollowNode:
+    def __init__(self, cluster: LocalCluster, node: Node):
+        self.cluster = cluster
+        self.node = node
+        self.running: Dict = {}
+        cluster.add_node(node)
+
+    def observe(self, event: str, kind: str, obj) -> None:
+        """Pod-informer callback: claim pods bound to this node."""
+        if kind != "pods" or event not in (ADDED, MODIFIED):
+            return
+        if obj.spec.node_name != self.node.name:
+            return
+        key = (obj.namespace, obj.name)
+        if key in self.running:
+            return
+        self.running[key] = obj
+        if obj.status.phase != "Running":
+            import dataclasses
+
+            from kubernetes_tpu.api.types import PodStatus
+
+            self.cluster.update(
+                "pods", dataclasses.replace(obj, status=PodStatus(phase="Running"))
+            )
+
+
+class HollowFleet:
+    """N hollow nodes sharing one watch subscription."""
+
+    def __init__(self, cluster: LocalCluster, nodes: List[Node]):
+        self.cluster = cluster
+        self.nodes = [HollowNode(cluster, n) for n in nodes]
+        by_name = {h.node.name: h for h in self.nodes}
+
+        def fanout(event, kind, obj):
+            if kind == "pods" and obj.spec.node_name in by_name:
+                by_name[obj.spec.node_name].observe(event, kind, obj)
+
+        cluster.watch(fanout)
+
+    @property
+    def total_running(self) -> int:
+        return sum(len(h.running) for h in self.nodes)

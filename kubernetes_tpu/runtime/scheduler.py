@@ -103,7 +103,6 @@ class Scheduler:
         self._last_index += len(pods)
         trace.step("device")
         results = []
-        row_names = {row: name for name, row in enc.node_rows.items()}
         for i, pod in enumerate(pods):
             row = int(hosts[i])
             if row < 0:
@@ -112,7 +111,7 @@ class Scheduler:
                 self.queue.add_unschedulable(pod, cycle)
                 results.append(ScheduleResult(pod, None, generation))
                 continue
-            node_name = row_names[row]
+            node_name = enc.row_name(row)
             assumed = dataclasses.replace(
                 pod, spec=dataclasses.replace(pod.spec, node_name=node_name)
             )

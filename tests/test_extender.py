@@ -1,0 +1,135 @@
+"""Extender-protocol integration test: a real HTTP client (standing in for a
+stock kube-scheduler with NodeCacheCapable=true) drives the sidecar — the
+analog of test/integration/scheduler/extender_test.go, inverted: there the
+scheduler-under-test calls a test extender; here the extender is the system
+under test."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.extender import ExtenderServer
+from kubernetes_tpu.cpuref import CPUScheduler
+
+from fixtures import make_node, make_pod
+
+
+def _post(addr, path, obj):
+    req = urllib.request.Request(
+        f"http://{addr[0]}:{addr[1]}{path}",
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _pod_dict(name, cpu=None, labels=None, tolerations=None):
+    spec = {"containers": [{"name": "c", "resources": {"requests": {"cpu": cpu}} if cpu else {}}]}
+    if tolerations:
+        spec["tolerations"] = tolerations
+    return {"metadata": {"name": name, "namespace": "default", "labels": labels or {}}, "spec": spec}
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = ExtenderServer()
+    s.start()
+    addr = s.address
+    # sync a small cluster over the wire
+    nodes = [
+        {"metadata": {"name": "n1", "labels": {}},
+         "status": {"allocatable": {"cpu": "1", "memory": "4Gi", "pods": 10}}},
+        {"metadata": {"name": "n2", "labels": {}},
+         "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": 10}}},
+        {"metadata": {"name": "tainted", "labels": {}},
+         "spec": {"taints": [{"key": "dedicated", "value": "x", "effect": "NoSchedule"}]},
+         "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": 10}}},
+    ]
+    for n in nodes:
+        _post(addr, "/sync/node", n)
+    _post(addr, "/sync/pod", {
+        "metadata": {"name": "existing", "namespace": "default"},
+        "spec": {"nodeName": "n1",
+                 "containers": [{"name": "c", "resources": {"requests": {"cpu": "800m"}}}]},
+    })
+    yield s
+    s.stop()
+
+
+def test_filter_verb_wire_format(server):
+    """The v1 wire format is lowercase (api/v1/types.go json tags): this is
+    what a stock Go kube-scheduler actually POSTs."""
+    addr = server.address
+    res = _post(addr, "/filter", {
+        "pod": _pod_dict("p", cpu="500m"),
+        "nodenames": ["n1", "n2", "tainted", "ghost"],
+    })
+    assert res["error"] == ""
+    assert res["nodenames"] == ["n2"]
+    # n1: 800m used of 1 cpu -> resources; tainted -> taints; ghost unknown
+    assert res["failedNodes"]["n1"] == "GeneralPredicates"
+    assert res["failedNodes"]["tainted"] == "PodToleratesNodeTaints"
+    assert "ghost" in res["failedNodes"]
+
+
+def test_filter_accepts_go_field_spelling(server):
+    addr = server.address
+    res = _post(addr, "/filter", {
+        "Pod": _pod_dict("p", cpu="500m"),
+        "NodeNames": ["n2"],
+    })
+    assert res["nodenames"] == ["n2"]
+
+
+def test_filter_nodelist_mode(server):
+    """Non-NodeCacheCapable mode sends full NodeList objects."""
+    addr = server.address
+    res = _post(addr, "/filter", {
+        "pod": _pod_dict("p", cpu="500m"),
+        "nodes": {"items": [{"metadata": {"name": "n2"}}, {"metadata": {"name": "n1"}}]},
+    })
+    assert res["nodenames"] == ["n2"]
+
+
+def test_prioritize_verb(server):
+    addr = server.address
+    res = _post(addr, "/prioritize", {
+        "pod": _pod_dict("p", cpu="100m"),
+        "nodenames": ["n1", "n2"],
+    })
+    scores = {e["host"]: e["score"] for e in res}
+    assert set(scores) == {"n1", "n2"}
+    assert scores["n2"] >= scores["n1"]  # emptier node scores higher
+    assert max(scores.values()) == 10   # rescaled to the 0..10 contract
+
+
+def test_bind_verb_updates_mirror(server):
+    addr = server.address
+    res = _post(addr, "/bind", {
+        "PodName": "bound-pod", "PodNamespace": "default", "PodUID": "u1", "Node": "n2",
+    })
+    assert res["Error"] == ""
+    # the mirror now charges n2 with one more pod
+    assert ("default", "bound-pod") in server.cache.encoder.pods
+
+
+def test_preempt_verb(server):
+    addr = server.address
+    # preemptor needing n1's capacity; existing pod has priority 0
+    pod = _pod_dict("boss", cpu="900m")
+    pod["spec"]["priority"] = 100
+    res = _post(addr, "/preempt", {"pod": pod})
+    victims = res["nodeNameToMetaVictims"]
+    assert "n1" in victims
+    assert victims["n1"]["pods"] == [{"uid": "default/existing"}]
+
+
+def test_health_and_metrics(server):
+    addr = server.address
+    with urllib.request.urlopen(f"http://{addr[0]}:{addr[1]}/healthz", timeout=10) as r:
+        assert r.read() == b"ok"
+    with urllib.request.urlopen(f"http://{addr[0]}:{addr[1]}/metrics", timeout=10) as r:
+        assert b"scheduler_" in r.read()
