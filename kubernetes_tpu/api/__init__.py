@@ -13,6 +13,13 @@ from kubernetes_tpu.api.labels import (
     selector_from_label_selector,
     selector_from_match_labels,
 )
+from kubernetes_tpu.api.storage import (
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+    IMMEDIATE,
+    WAIT_FOR_FIRST_CONSUMER,
+)
 from kubernetes_tpu.api.types import (
     Affinity,
     Container,

@@ -1,0 +1,125 @@
+"""Storage object model: PV / PVC / StorageClass (scheduler-relevant slice).
+
+Reference: staging/src/k8s.io/api/core/v1/types.go (PersistentVolume,
+PersistentVolumeClaim) and storage/v1 StorageClass.  The scheduler consumes:
+  * PVC -> bound PV (spec.volumeName) or its storageClassName for binding;
+  * PV zone/region labels (NoVolumeZoneConflict, predicates.go:616-741);
+  * PV spec.nodeAffinity.required (CheckVolumeBinding via the volume binder);
+  * the PV's source type (MaxVolumeCount filters, csi for MaxCSIVolumeCount);
+  * StorageClass.volumeBindingMode: Immediate vs WaitForFirstConsumer
+    (delayed binding — the scheduler picks the node first).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from kubernetes_tpu.api.resource import Quantity, parse_quantity
+from kubernetes_tpu.api.types import NodeSelector, ObjectMeta
+
+IMMEDIATE = "Immediate"
+WAIT_FOR_FIRST_CONSUMER = "WaitForFirstConsumer"
+
+# volume source kinds the filters care about
+SRC_EBS = "awsElasticBlockStore"
+SRC_GCE = "gcePersistentDisk"
+SRC_AZURE = "azureDisk"
+SRC_CINDER = "cinder"
+SRC_CSI = "csi"
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    capacity: Optional[Quantity] = None
+    access_modes: Tuple[str, ...] = ()
+    storage_class: str = ""
+    node_affinity: Optional[NodeSelector] = None  # spec.nodeAffinity.required
+    source_kind: str = ""                          # SRC_* ("" unknown)
+    csi_driver: str = ""
+    phase: str = "Available"                       # Available | Bound | ...
+    claim_ref: str = ""                            # "ns/name" of bound PVC
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        return self.metadata.labels
+
+    @staticmethod
+    def from_dict(d: dict) -> "PersistentVolume":
+        spec = d.get("spec") or {}
+        source_kind = ""
+        csi_driver = ""
+        for k in (SRC_EBS, SRC_GCE, SRC_AZURE, SRC_CINDER, SRC_CSI):
+            if k in spec:
+                source_kind = k
+                if k == SRC_CSI:
+                    csi_driver = spec[k].get("driver", "")
+                break
+        na = None
+        aff = (spec.get("nodeAffinity") or {}).get("required")
+        if aff:
+            na = NodeSelector.from_dict(aff)
+        cap = (spec.get("capacity") or {}).get("storage")
+        cr = spec.get("claimRef") or {}
+        return PersistentVolume(
+            metadata=ObjectMeta.from_dict(d.get("metadata")),
+            capacity=parse_quantity(cap) if cap is not None else None,
+            access_modes=tuple(spec.get("accessModes") or ()),
+            storage_class=spec.get("storageClassName", ""),
+            node_affinity=na,
+            source_kind=source_kind,
+            csi_driver=csi_driver,
+            phase=(d.get("status") or {}).get("phase", "Available"),
+            claim_ref=f"{cr.get('namespace', '')}/{cr.get('name', '')}" if cr else "",
+        )
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    storage_class: str = ""
+    volume_name: str = ""         # bound PV
+    request: Optional[Quantity] = None
+    access_modes: Tuple[str, ...] = ()
+    phase: str = "Pending"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @staticmethod
+    def from_dict(d: dict) -> "PersistentVolumeClaim":
+        spec = d.get("spec") or {}
+        req = ((spec.get("resources") or {}).get("requests") or {}).get("storage")
+        return PersistentVolumeClaim(
+            metadata=ObjectMeta.from_dict(d.get("metadata")),
+            storage_class=spec.get("storageClassName", ""),
+            volume_name=spec.get("volumeName", ""),
+            request=parse_quantity(req) if req is not None else None,
+            access_modes=tuple(spec.get("accessModes") or ()),
+            phase=(d.get("status") or {}).get("phase", "Pending"),
+        )
+
+
+@dataclass
+class StorageClass:
+    name: str = ""
+    provisioner: str = ""
+    binding_mode: str = IMMEDIATE
+
+    @staticmethod
+    def from_dict(d: dict) -> "StorageClass":
+        return StorageClass(
+            name=(d.get("metadata") or {}).get("name", ""),
+            provisioner=d.get("provisioner", ""),
+            binding_mode=d.get("volumeBindingMode", IMMEDIATE),
+        )

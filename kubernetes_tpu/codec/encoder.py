@@ -158,6 +158,12 @@ class SnapshotEncoder:
         # ref priorities/image_locality.go scaledImageScore)
         self._image_nodes: Counter = Counter()
 
+        # storage objects (PV/PVC/StorageClass), consumed by the volume
+        # predicates and the volume binder (ref pkg/scheduler/volumebinder)
+        self.pvs: Dict[str, object] = {}
+        self.pvcs: Dict[Tuple[str, str], object] = {}
+        self.storage_classes: Dict[str, object] = {}
+
         # template-row cache for encode_pods: pods stamped out by one
         # controller share an identical spec, so their encoded batch row is
         # identical.  Keyed by content; invalidated when the spread-group
@@ -197,6 +203,7 @@ class SnapshotEncoder:
         self.a_img_sz = np.zeros((n, d.I), f32)
         self.a_avoid = np.full((n, d.A), PAD, i32)
         self.a_volcnt = np.zeros((n, NUM_VOL_TYPES), f32)
+        self.a_vollim = np.full((n, NUM_VOL_TYPES), np.inf, f32)
         self.a_dvol = np.full((n, d.DVN), PAD, i32)
         # per-topo-key per-node value/pair id (host-side helper columns)
         self._node_pair_id: Dict[int, np.ndarray] = {
@@ -416,9 +423,21 @@ class SnapshotEncoder:
         self.a_mempress[row] = cond.get("MemoryPressure", "False") == "True"
         self.a_diskpress[row] = cond.get("DiskPressure", "False") == "True"
         self.a_pidpress[row] = cond.get("PIDPressure", "False") == "True"
-        # allocatable
+        # allocatable (+ per-node attachable-volume limits, ref the
+        # AttachVolumeLimit feature's attachable-volumes-* allocatable keys)
         self.a_allocatable[row, :] = 0.0
+        self.a_vollim[row, :] = np.inf
+        vol_limit_cols = {
+            "attachable-volumes-aws-ebs": VOL_EBS,
+            "attachable-volumes-gce-pd": VOL_GCE,
+            "attachable-volumes-azure-disk": VOL_AZURE,
+        }
         for name, q in node.status.allocatable.items():
+            if name.startswith("attachable-volumes-"):
+                col = vol_limit_cols.get(name, VOL_CSI if "csi" in name else None)
+                if col is not None:
+                    self.a_vollim[row, col] = float(q)
+                continue
             col = self._res_col(name)
             self.a_allocatable[row, col] = (
                 q.milli if name == RESOURCE_CPU else float(q)
@@ -572,6 +591,25 @@ class SnapshotEncoder:
                 counts[VOL_AZURE] += 1
             elif "cinder" in v:
                 counts[VOL_CINDER] += 1
+            elif "persistentVolumeClaim" in v:
+                # resolve the claim to count the bound PV's attachment type
+                pvc = self.pvcs.get(
+                    (pod.namespace, v["persistentVolumeClaim"].get("claimName", ""))
+                )
+                if pvc is not None and pvc.volume_name:
+                    pv = self.pvs.get(pvc.volume_name)
+                    if pv is not None:
+                        from kubernetes_tpu.api import storage as kstorage
+
+                        col = {
+                            kstorage.SRC_EBS: VOL_EBS,
+                            kstorage.SRC_GCE: VOL_GCE,
+                            kstorage.SRC_CSI: VOL_CSI,
+                            kstorage.SRC_AZURE: VOL_AZURE,
+                            kstorage.SRC_CINDER: VOL_CINDER,
+                        }.get(pv.source_kind)
+                        if col is not None:
+                            counts[col] += 1
         return disk, counts
 
     def _nonzero(self, pod: Pod) -> np.ndarray:
@@ -755,6 +793,136 @@ class SnapshotEncoder:
             if g.members <= 0:
                 del self.term_groups[sig]
 
+    # -------------------------------------------------------------- storage
+
+    def add_pv(self, pv) -> None:
+        self.pvs[pv.name] = pv
+        self.generation += 1
+
+    def remove_pv(self, name: str) -> None:
+        self.pvs.pop(name, None)
+        self.generation += 1
+
+    def add_pvc(self, pvc) -> None:
+        self.pvcs[(pvc.namespace, pvc.name)] = pvc
+        self.generation += 1
+
+    def remove_pvc(self, namespace: str, name: str) -> None:
+        self.pvcs.pop((namespace, name), None)
+        self.generation += 1
+
+    def add_storage_class(self, sc) -> None:
+        self.storage_classes[sc.name] = sc
+        self.generation += 1
+
+    def _rows_matching_pv_topology(self, pv) -> List[int]:
+        """Node rows compatible with a PV's nodeAffinity (exact host-side
+        evaluation — ref volumebinder checking PV.spec.nodeAffinity)."""
+        from kubernetes_tpu.cpuref.reference import match_node_selector_term
+
+        rows = []
+        for name, row in self.node_rows.items():
+            node = self._row_node[row]
+            if pv.node_affinity is not None:
+                if not any(
+                    match_node_selector_term(t, node)
+                    for t in pv.node_affinity.terms
+                ):
+                    continue
+            rows.append(row)
+        return rows
+
+    def _rows_matching_pv_zone(self, pv) -> Optional[List[int]]:
+        """Node rows matching the PV's zone/region labels, or None if the PV
+        carries no zone labels (no restriction) — ref predicates.go
+        NoVolumeZoneConflict (:616-741); multi-zone PV label values use the
+        "__" separator (volumehelpers.LabelZonesToSet)."""
+        restricting = {}
+        for key in (HOSTNAME_KEY, ZONE_KEY, REGION_KEY):
+            val = pv.labels.get(key)
+            if val is not None:
+                restricting[key] = set(val.split("__"))
+        if not restricting:
+            return None
+        rows = []
+        for name, row in self.node_rows.items():
+            node = self._row_node[row]
+            if all(node.labels.get(k) in vs for k, vs in restricting.items()):
+                rows.append(row)
+        return rows
+
+    def _rows_to_pairs(self, rows: List[int]) -> np.ndarray:
+        pairs = np.zeros(self.dims.TP, bool)
+        col = self._node_pair_id[self.hostname_key]
+        for r in rows:
+            pid = col[r]
+            if pid >= 0:
+                pairs[pid] = True
+        return pairs
+
+    def _candidate_pvs(self, pvc) -> List[object]:
+        """Available PVs that could satisfy an unbound claim (class, size,
+        access modes) — the volume binder's FindPodVolumes matching."""
+        out = []
+        for pv in self.pvs.values():
+            if pv.phase not in ("Available",):
+                continue
+            if pv.storage_class != pvc.storage_class:
+                continue
+            if pvc.request is not None and pv.capacity is not None and pv.capacity < pvc.request:
+                continue
+            if pvc.access_modes and not set(pvc.access_modes) <= set(pv.access_modes):
+                continue
+            out.append(pv)
+        return out
+
+    def _pod_volume_terms(self, pod: Pod):
+        """(zone_terms, bind_terms, fail_all): per-PVC topology restrictions
+        as hostname-pair sets.  (Attachment-type counts are handled by
+        _pod_vols, which both add_pod and encode_pods use.)"""
+        zone_terms: List[np.ndarray] = []
+        bind_terms: List[np.ndarray] = []
+        fail_all = False
+        for v in pod.spec.volumes:
+            claim = v.get("persistentVolumeClaim")
+            if not claim:
+                continue
+            pvc = self.pvcs.get((pod.namespace, claim.get("claimName", "")))
+            if pvc is None:
+                fail_all = True  # missing PVC: unschedulable (ErrMissingPVC)
+                continue
+            if pvc.volume_name:
+                pv = self.pvs.get(pvc.volume_name)
+                if pv is None:
+                    fail_all = True
+                    continue
+                zrows = self._rows_matching_pv_zone(pv)
+                if zrows is not None:
+                    zone_terms.append(self._rows_to_pairs(zrows))
+                if pv.node_affinity is not None:
+                    bind_terms.append(
+                        self._rows_to_pairs(self._rows_matching_pv_topology(pv))
+                    )
+            else:
+                sc = self.storage_classes.get(pvc.storage_class)
+                cands = self._candidate_pvs(pvc)
+                if cands:
+                    allowed = np.zeros(self.dims.TP, bool)
+                    for pv in cands:
+                        rows = self._rows_matching_pv_topology(pv)
+                        zrows = self._rows_matching_pv_zone(pv)
+                        if zrows is not None:
+                            rows = [r for r in rows if r in set(zrows)]
+                        allowed |= self._rows_to_pairs(rows)
+                    bind_terms.append(allowed)
+                elif sc is not None and sc.provisioner:
+                    # dynamic provisioning: WaitForFirstConsumer defers to
+                    # the chosen node; Immediate will provision anywhere
+                    pass
+                else:
+                    fail_all = True
+        return zone_terms, bind_terms, fail_all
+
     # ------------------------------------------------------------- spreading
 
     def add_spread_selector(self, namespace: str, match_labels: Dict[str, str]) -> None:
@@ -855,6 +1023,7 @@ class SnapshotEncoder:
             image_size=(self.a_img_sz * scale).astype(np.float32),
             avoid_owner=self.a_avoid.copy(),
             vol_counts=self.a_volcnt.copy(),
+            vol_limits=self.a_vollim.copy(),
             disk_vol_ids=self.a_dvol.copy(),
         )
 
@@ -898,9 +1067,13 @@ class SnapshotEncoder:
         if B > d.B:
             self.dims = d = dataclasses.replace(d, B=B)
         # grow per-pod dims to fit
-        need = dict(Q=1, TT=1, NS=1, S=1, E=1, V=1, PS=1, PT=1, AT=1, GP=1, C=1, DV=1)
+        need = dict(Q=1, TT=1, NS=1, S=1, E=1, V=1, PS=1, PT=1, AT=1, GP=1, C=1,
+                    DV=1, VZ=1, VB=1)
         for pod in pods:
             need["Q"] = max(need["Q"], len(pod.host_ports()))
+            n_pvc = sum(1 for v in pod.spec.volumes if "persistentVolumeClaim" in v)
+            need["VZ"] = max(need["VZ"], n_pvc)
+            need["VB"] = max(need["VB"], n_pvc)
             need["TT"] = max(need["TT"], len(pod.spec.tolerations))
             need["NS"] = max(need["NS"], len(pod.spec.node_selector))
             need["C"] = max(need["C"], len(pod.spec.containers))
@@ -951,6 +1124,7 @@ class SnapshotEncoder:
             valid=zb(B),
             req=zf(B, d.R),
             nonzero_req=zf(B, 2),
+            limits2=zf(B, 2),
             priority=np.zeros(B, i32),
             best_effort=zb(B),
             ns_id=zi(B),
@@ -999,6 +1173,11 @@ class SnapshotEncoder:
             image_bytes=zf(B, d.C),
             new_vol_counts=zf(B, NUM_VOL_TYPES),
             disk_vol_ids=zi(B, d.DV),
+            vol_zone_pairs=zb(B, d.VZ, d.TP),
+            vol_zone_valid=zb(B, d.VZ),
+            vol_bind_pairs=zb(B, d.VB, d.TP),
+            vol_bind_valid=zb(B, d.VB),
+            vol_fail_all=zb(B),
         )
 
         # interner ids are append-only (stable), so only pad-dim or
@@ -1019,6 +1198,15 @@ class SnapshotEncoder:
             req = self._req_vector(pod.resource_request())
             out["req"][b, : req.shape[0]] = req
             out["nonzero_req"][b] = self._nonzero(pod)
+            # summed container limits (ResourceLimitsPriority,
+            # priorities/resource_limits.go getResourceLimits)
+            lim_cpu = lim_mem = 0.0
+            for c in pod.spec.containers:
+                if RESOURCE_CPU in c.limits:
+                    lim_cpu += c.limits[RESOURCE_CPU].milli
+                if RESOURCE_MEMORY in c.limits:
+                    lim_mem += float(c.limits[RESOURCE_MEMORY])
+            out["limits2"][b] = (lim_cpu, lim_mem)
             out["priority"][b] = pod.spec.priority
             out["best_effort"][b] = all(
                 not c.requests and not c.limits for c in pod.spec.containers
@@ -1092,6 +1280,14 @@ class SnapshotEncoder:
             out["new_vol_counts"][b] = vcounts
             for j, dv in enumerate(disk[: d.DV]):
                 out["disk_vol_ids"][b, j] = dv
+            zone_terms, bind_terms, fail_all = self._pod_volume_terms(pod)
+            out["vol_fail_all"][b] = fail_all
+            for j, pairs in enumerate(zone_terms[: d.VZ]):
+                out["vol_zone_pairs"][b, j] = pairs[: d.TP]
+                out["vol_zone_valid"][b, j] = True
+            for j, pairs in enumerate(bind_terms[: d.VB]):
+                out["vol_bind_pairs"][b, j] = pairs[: d.TP]
+                out["vol_bind_valid"][b, j] = True
             if ck is not None:
                 self._pod_row_cache[ck] = {
                     k: np.copy(v[b]) for k, v in out.items()

@@ -87,9 +87,13 @@ PREDICATE_ORDER = (
 PRED_INDEX = {name: i for i, name in enumerate(PREDICATE_ORDER)}
 NUM_PREDICATES = len(PREDICATE_ORDER)
 
-# Priority (score) functions, default set + weights
+# Priority (score) functions.  The first eight are the default provider set
 # (algorithmprovider/defaults/defaults.go defaultPriorities(): all weight 1;
-# NodePreferAvoidPods weight 10000, register_priorities.go:87)
+# NodePreferAvoidPods weight 10000, register_priorities.go:87); the tail are
+# registered-but-default-off functions selectable via Policy / providers /
+# feature gates (MostRequested: ClusterAutoscalerProvider; NodeLabel +
+# RequestedToCapacityRatio: policy arguments; ResourceLimits: the
+# ResourceLimitsPriorityFunction feature gate).
 PRIORITY_ORDER = (
     "SelectorSpreadPriority",
     "InterPodAffinityPriority",
@@ -99,11 +103,16 @@ PRIORITY_ORDER = (
     "NodeAffinityPriority",
     "TaintTolerationPriority",
     "ImageLocalityPriority",
+    "MostRequestedPriority",
+    "NodeLabelPriority",
+    "RequestedToCapacityRatioPriority",
+    "ResourceLimitsPriority",
 )
 PRIO_INDEX = {name: i for i, name in enumerate(PRIORITY_ORDER)}
 NUM_PRIORITIES = len(PRIORITY_ORDER)
 DEFAULT_PRIORITY_WEIGHTS = np.array(
-    [1.0, 1.0, 1.0, 1.0, 10000.0, 1.0, 1.0, 1.0], dtype=np.float32
+    [1.0, 1.0, 1.0, 1.0, 10000.0, 1.0, 1.0, 1.0, 0.0, 0.0, 0.0, 0.0],
+    dtype=np.float32,
 )
 
 # Volume filter types for MaxVolumeCount predicates
@@ -145,6 +154,8 @@ class PadDims:
     A: int = 2        # prefer-avoid owner uids per node
     DV: int = 4       # disk-conflict volume ids per pod
     DVN: int = 8      # disk-conflict volume ids per node
+    VZ: int = 2       # volume zone-restriction terms per pod (bound PV labels)
+    VB: int = 2       # volume binding-restriction terms per pod
 
     def bump(self, **kw: int) -> "PadDims":
         return dataclasses.replace(
@@ -207,6 +218,7 @@ class ClusterTensors:
     avoid_owner: Any        # i32[N, A]  controller-owner uid ids to avoid
     # -- volumes --
     vol_counts: Any         # f32[N, NUM_VOL_TYPES] attached unique volumes per filter type
+    vol_limits: Any         # f32[N, NUM_VOL_TYPES] per-node attachable limits
     disk_vol_ids: Any       # i32[N, DVN] interned volume ids in use (NoDiskConflict)
 
     @property
@@ -229,6 +241,7 @@ class PodBatch:
     valid: Any              # bool[B]
     req: Any                # f32[B, R]  resource request (col RES_PODS = 1)
     nonzero_req: Any        # f32[B, 2]
+    limits2: Any            # f32[B, 2]  (milliCPU, memory) limits (ResourceLimitsPriority)
     priority: Any           # i32[B]
     best_effort: Any        # bool[B]    QoS BestEffort (no requests/limits at all)
     ns_id: Any              # i32[B]     namespace id
@@ -286,6 +299,14 @@ class PodBatch:
     # volumes
     new_vol_counts: Any     # f32[B, NUM_VOL_TYPES] new unique volumes the pod adds
     disk_vol_ids: Any       # i32[B, DV] exclusive-use volume ids (NoDiskConflict)
+    # volume topology restrictions, as hostname-pair sets (exact: the host
+    # evaluates PV zone labels / nodeAffinity / binding candidates against
+    # every node and emits the allowed-node pair set per volume)
+    vol_zone_pairs: Any     # bool[B, VZ, TP] NoVolumeZoneConflict terms
+    vol_zone_valid: Any     # bool[B, VZ]
+    vol_bind_pairs: Any     # bool[B, VB, TP] CheckVolumeBinding terms
+    vol_bind_valid: Any     # bool[B, VB]
+    vol_fail_all: Any       # bool[B] unbound PVC with no candidate PV / missing PVC
 
     @property
     def n_pods(self) -> int:
@@ -299,6 +320,9 @@ class FilterConfig:
     max_vols mirrors DefaultMaxEBSVolumes=39/aws, GCE/Azure=16
     (predicates.go:109-115); hard_pod_affinity_weight ref
     apis/config/types.go HardPodAffinitySymmetricWeight default 1.
+    `enabled` selects the active predicate set (None = all): the analog of
+    the provider/Policy predicate registry (factory/plugins.go); disabled
+    predicates neither filter nor appear in failure attribution.
     """
 
     max_vols: tuple = (39.0, 16.0, 1e9, 16.0, 1e9)
@@ -308,3 +332,19 @@ class FilterConfig:
     # always-pass unless configured.
     label_presence_keys: tuple = ()
     label_presence_present: bool = True
+    enabled: Optional[tuple] = None  # tuple of predicate names, or None=all
+
+
+@dataclass(frozen=True)
+class ScoreConfig:
+    """Static arguments for the policy-driven priorities.
+
+    label_prefs: ((key_id, presence, weight), ...) — NodeLabelPriority
+    (priorities/node_label.go): presence=True scores 10 when the label
+    exists.  rtc_shape: ((utilization%, score), ...) ascending — the
+    RequestedToCapacityRatio piecewise-linear curve
+    (priorities/requested_to_capacity_ratio.go).
+    """
+
+    label_prefs: tuple = ()
+    rtc_shape: tuple = ((0.0, 10.0), (100.0, 0.0))

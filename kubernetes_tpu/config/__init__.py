@@ -1,0 +1,9 @@
+from kubernetes_tpu.config.featuregates import FeatureGates, DEFAULT_GATES
+from kubernetes_tpu.config.profile import (
+    SchedulingProfile,
+    algorithm_provider,
+    profile_from_policy,
+    DEFAULT_PROVIDER,
+    CLUSTER_AUTOSCALER_PROVIDER,
+)
+from kubernetes_tpu.config.types import KubeSchedulerConfiguration

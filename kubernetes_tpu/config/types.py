@@ -1,0 +1,99 @@
+"""Component configuration.
+
+Reference: pkg/scheduler/apis/config/types.go KubeSchedulerConfiguration
+(:42-108) — the versioned config object every kube-scheduler binary loads,
+with AlgorithmSource (provider | policy file/ConfigMap), leader election,
+client connection, and the perf knobs.  Mirrored here as a dataclass with a
+from_dict loader (JSON; YAML documents parse the same once loaded).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kubernetes_tpu.config.featuregates import FeatureGates
+from kubernetes_tpu.config.profile import (
+    DEFAULT_PROVIDER,
+    SchedulingProfile,
+    algorithm_provider,
+    profile_from_policy,
+)
+
+
+@dataclass
+class LeaderElectionConfig:
+    """component-base config.LeaderElectionConfiguration."""
+
+    leader_elect: bool = True
+    lease_duration_s: float = 15.0
+    renew_deadline_s: float = 10.0
+    retry_period_s: float = 2.0
+    resource_namespace: str = "kube-system"
+    resource_name: str = "kube-scheduler"
+
+
+@dataclass
+class KubeSchedulerConfiguration:
+    scheduler_name: str = "default-scheduler"
+    algorithm_provider: str = DEFAULT_PROVIDER
+    policy: Optional[dict] = None            # legacy Policy JSON (wins if set)
+    hard_pod_affinity_symmetric_weight: int = 1
+    percentage_of_nodes_to_score: int = 0    # 0 => adaptive default
+    bind_timeout_seconds: int = 100          # scheduler.go:48-53
+    disable_preemption: bool = False
+    leader_election: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)
+    healthz_bind_address: str = "0.0.0.0:10251"
+    metrics_bind_address: str = "0.0.0.0:10251"
+    feature_gates: FeatureGates = field(default_factory=FeatureGates)
+    # TPU-specific: batch formation knobs (no reference analog; the reference
+    # schedules one pod per cycle)
+    batch_size: int = 256
+    batch_window_s: float = 0.001
+
+    def build_profile(self, interner=None) -> SchedulingProfile:
+        """CreateFromConfig / CreateFromProvider (scheduler.go:162-192)."""
+        if self.policy is not None:
+            return profile_from_policy(
+                self.policy, interner=interner, gates=self.feature_gates
+            )
+        return algorithm_provider(
+            self.algorithm_provider,
+            gates=self.feature_gates,
+            hard_pod_affinity_weight=float(self.hard_pod_affinity_symmetric_weight),
+        )
+
+    @staticmethod
+    def from_dict(d: dict) -> "KubeSchedulerConfiguration":
+        le = d.get("leaderElection") or {}
+        return KubeSchedulerConfiguration(
+            scheduler_name=d.get("schedulerName", "default-scheduler"),
+            algorithm_provider=(d.get("algorithmSource") or {}).get(
+                "provider", DEFAULT_PROVIDER
+            )
+            or DEFAULT_PROVIDER,
+            policy=(d.get("algorithmSource") or {}).get("policy"),
+            hard_pod_affinity_symmetric_weight=int(
+                d.get("hardPodAffinitySymmetricWeight", 1)
+            ),
+            percentage_of_nodes_to_score=int(d.get("percentageOfNodesToScore", 0)),
+            bind_timeout_seconds=int(d.get("bindTimeoutSeconds", 100)),
+            disable_preemption=bool(d.get("disablePreemption", False)),
+            leader_election=LeaderElectionConfig(
+                leader_elect=bool(le.get("leaderElect", True)),
+                lease_duration_s=float(le.get("leaseDuration", 15.0)),
+                renew_deadline_s=float(le.get("renewDeadline", 10.0)),
+                retry_period_s=float(le.get("retryPeriod", 2.0)),
+            ),
+            healthz_bind_address=d.get("healthzBindAddress", "0.0.0.0:10251"),
+            metrics_bind_address=d.get("metricsBindAddress", "0.0.0.0:10251"),
+            feature_gates=FeatureGates(d.get("featureGates")),
+            batch_size=int(d.get("batchSize", 256)),
+            batch_window_s=float(d.get("batchWindowSeconds", 0.001)),
+        )
+
+    @staticmethod
+    def from_file(path: str) -> "KubeSchedulerConfiguration":
+        with open(path) as f:
+            return KubeSchedulerConfiguration.from_dict(json.load(f))

@@ -127,11 +127,17 @@ class CPUScheduler:
         pods: Sequence[Pod] = (),
         services: Sequence[Tuple[str, Dict[str, str]]] = (),
         max_vols: Tuple[float, ...] = (39, 16, 1e9, 16, 1e9),
+        pvs: Sequence = (),
+        pvcs: Sequence = (),
+        storage_classes: Sequence = (),
     ):
         self.nodes = list(nodes)
         self.pods = list(pods)
         self.services = list(services)
         self.max_vols = max_vols
+        self.pvs = {pv.name: pv for pv in pvs}
+        self.pvcs = {(c.namespace, c.name): c for c in pvcs}
+        self.storage_classes = {s.name: s for s in storage_classes}
         self.by_node: Dict[str, List[Pod]] = defaultdict(list)
         for p in self.pods:
             if p.spec.node_name:
@@ -268,6 +274,116 @@ class CPUScheduler:
             not (new[i] > 0 and used[i] + new[i] > self.max_vols[i]) for i in range(5)
         )
 
+    # ---- volume predicates (object-level, independent of the encoder) ----
+
+    def _pod_pvcs(self, pod: Pod):
+        for v in pod.spec.volumes:
+            claim = v.get("persistentVolumeClaim")
+            if claim:
+                yield self.pvcs.get((pod.namespace, claim.get("claimName", "")))
+
+    @staticmethod
+    def _pv_zone_ok(pv, node: Node) -> bool:
+        for key in (
+            "kubernetes.io/hostname",
+            ZONE_KEY,
+            REGION_KEY,
+        ):
+            val = pv.labels.get(key)
+            if val is not None and node.labels.get(key) not in set(val.split("__")):
+                return False
+        return True
+
+    @staticmethod
+    def _pv_affinity_ok(pv, node: Node) -> bool:
+        if pv.node_affinity is None:
+            return True
+        return any(
+            match_node_selector_term(t, node) for t in pv.node_affinity.terms
+        )
+
+    def _pv_candidates(self, pvc):
+        for pv in self.pvs.values():
+            if pv.phase != "Available":
+                continue
+            if pv.storage_class != pvc.storage_class:
+                continue
+            if pvc.request is not None and pv.capacity is not None and pv.capacity < pvc.request:
+                continue
+            if pvc.access_modes and not set(pvc.access_modes) <= set(pv.access_modes):
+                continue
+            yield pv
+
+    def no_volume_zone_conflict(self, pod: Pod, node: Node) -> bool:
+        """ref predicates.go:616-741."""
+        for pvc in self._pod_pvcs(pod):
+            if pvc is None or not pvc.volume_name:
+                continue
+            pv = self.pvs.get(pvc.volume_name)
+            if pv is not None and not self._pv_zone_ok(pv, node):
+                return False
+        return True
+
+    def check_volume_binding(self, pod: Pod, node: Node) -> bool:
+        """ref predicates.go:1651-1700 via the volume binder semantics."""
+        for pvc in self._pod_pvcs(pod):
+            if pvc is None:
+                return False  # ErrMissingPVC
+            if pvc.volume_name:
+                pv = self.pvs.get(pvc.volume_name)
+                if pv is None:
+                    return False
+                if not self._pv_affinity_ok(pv, node):
+                    return False
+            else:
+                ok = any(
+                    self._pv_affinity_ok(pv, node) and self._pv_zone_ok(pv, node)
+                    for pv in self._pv_candidates(pvc)
+                )
+                if not ok:
+                    sc = self.storage_classes.get(pvc.storage_class)
+                    if sc is None or not sc.provisioner:
+                        return False
+        return True
+
+    def _vol_counts_with_pvc(self, pod: Pod) -> List[float]:
+        counts = self._vol_type_counts(pod)
+        kind_col = {
+            "awsElasticBlockStore": 0,
+            "gcePersistentDisk": 1,
+            "csi": 2,
+            "azureDisk": 3,
+            "cinder": 4,
+        }
+        for pvc in self._pod_pvcs(pod):
+            if pvc is not None and pvc.volume_name:
+                pv = self.pvs.get(pvc.volume_name)
+                if pv is not None and pv.source_kind in kind_col:
+                    counts[kind_col[pv.source_kind]] += 1
+        return counts
+
+    def max_volume_counts_full(self, pod: Pod, node: Node) -> List[bool]:
+        """Per-filter-type verdicts [EBS, GCE, CSI, Azure, Cinder]."""
+        new = self._vol_counts_with_pvc(pod)
+        used = [0.0] * 5
+        for p in self.by_node[node.name]:
+            for i, c in enumerate(self._vol_counts_with_pvc(p)):
+                used[i] += c
+        limits = list(self.max_vols)
+        limit_keys = {
+            "attachable-volumes-aws-ebs": 0,
+            "attachable-volumes-gce-pd": 1,
+            "attachable-volumes-azure-disk": 3,
+        }
+        for k, q in node.status.allocatable.items():
+            if k in limit_keys:
+                limits[limit_keys[k]] = min(limits[limit_keys[k]], float(q))
+            elif k.startswith("attachable-volumes-") and "csi" in k:
+                limits[2] = min(limits[2], float(q))
+        return [
+            not (new[i] > 0 and used[i] + new[i] > limits[i]) for i in range(5)
+        ]
+
     def match_inter_pod_affinity(self, pod: Pod, node: Node) -> bool:
         """ref predicates.go InterPodAffinityMatches (:1196-1509)."""
         # 1. existing pods' required anti-affinity
@@ -333,7 +449,7 @@ class CPUScheduler:
         host = self.pod_fits_host(pod, node)
         ports = self.pod_fits_host_ports(pod, node)
         sel = self.pod_match_node_selector(pod, node)
-        vols = self.max_volume_counts(pod, node)
+        vols = self.max_volume_counts_full(pod, node)
         return {
             "CheckNodeCondition": self.check_node_condition(pod, node),
             "CheckNodeUnschedulable": self.check_node_unschedulable(pod, node),
@@ -349,13 +465,13 @@ class CPUScheduler:
             ),
             "CheckNodeLabelPresence": True,
             "CheckServiceAffinity": True,
-            "MaxEBSVolumeCount": vols,
-            "MaxGCEPDVolumeCount": vols,
-            "MaxCSIVolumeCount": True,
-            "MaxAzureDiskVolumeCount": vols,
-            "MaxCinderVolumeCount": vols,
-            "CheckVolumeBinding": True,
-            "NoVolumeZoneConflict": True,
+            "MaxEBSVolumeCount": vols[0],
+            "MaxGCEPDVolumeCount": vols[1],
+            "MaxCSIVolumeCount": vols[2],
+            "MaxAzureDiskVolumeCount": vols[3],
+            "MaxCinderVolumeCount": vols[4],
+            "CheckVolumeBinding": self.check_volume_binding(pod, node),
+            "NoVolumeZoneConflict": self.no_volume_zone_conflict(pod, node),
             "CheckNodeMemoryPressure": self.check_node_memory_pressure(pod, node),
             "CheckNodePIDPressure": self.check_node_pid_pressure(pod, node),
             "CheckNodeDiskPressure": self.check_node_disk_pressure(pod, node),
@@ -595,7 +711,62 @@ class CPUScheduler:
                 out[name] = 0
         return out
 
-    def priorities(self, pod: Pod) -> Dict[str, Dict[str, int]]:
+    def node_label_priority(self, pod: Pod, label_prefs=()) -> Dict[str, float]:
+        out = {}
+        for node in self.nodes:
+            s = 0.0
+            for key, presence, weight in label_prefs:
+                present = key in node.labels
+                s += weight * (MAX_PRIORITY if present == bool(presence) else 0)
+            out[node.name] = s
+        return out
+
+    def requested_to_capacity_ratio(
+        self, pod: Pod, shape=((0.0, 10.0), (100.0, 0.0))
+    ) -> Dict[str, int]:
+        """priorities/requested_to_capacity_ratio.go piecewise-linear curve."""
+
+        def curve(u: float) -> float:
+            pts = list(shape)
+            if u <= pts[0][0]:
+                return pts[0][1]
+            for (x0, y0), (x1, y1) in zip(pts, pts[1:]):
+                if u <= x1:
+                    return y0 + (y1 - y0) * (u - x0) / (x1 - x0)
+            return pts[-1][1]
+
+        pc, pm = nonzero_requests(pod)
+        out = {}
+        for node in self.nodes:
+            uc, um = self._used_nonzero(node)
+            alloc = node_allocatable(node)
+            ccap = alloc.get(RESOURCE_CPU, 0.0)
+            mcap = alloc.get(RESOURCE_MEMORY, 0.0)
+            cu = (pc + uc) * 100.0 / ccap if ccap > 0 else 100.0
+            mu = (pm + um) * 100.0 / mcap if mcap > 0 else 100.0
+            out[node.name] = int((curve(cu) + curve(mu)) // 2)
+        return out
+
+    def resource_limits(self, pod: Pod) -> Dict[str, int]:
+        """priorities/resource_limits.go (feature-gated)."""
+        lim_cpu = lim_mem = 0.0
+        for c in pod.spec.containers:
+            if RESOURCE_CPU in c.limits:
+                lim_cpu += c.limits[RESOURCE_CPU].milli
+            if RESOURCE_MEMORY in c.limits:
+                lim_mem += float(c.limits[RESOURCE_MEMORY])
+        out = {}
+        for node in self.nodes:
+            alloc = node_allocatable(node)
+            ok = (lim_cpu == 0 or alloc.get(RESOURCE_CPU, 0.0) >= lim_cpu) and (
+                lim_mem == 0 or alloc.get(RESOURCE_MEMORY, 0.0) >= lim_mem
+            )
+            out[node.name] = 1 if ok and (lim_cpu > 0 or lim_mem > 0) else 0
+        return out
+
+    def priorities(
+        self, pod: Pod, label_prefs=(), rtc_shape=((0.0, 10.0), (100.0, 0.0))
+    ) -> Dict[str, Dict[str, int]]:
         na = self._normalize(self.node_affinity_counts(pod), reverse=False)
         tt = self._normalize(self.taint_tol_counts(pod), reverse=True)
         out = {
@@ -611,6 +782,14 @@ class CPUScheduler:
             "NodeAffinityPriority": na,
             "TaintTolerationPriority": tt,
             "ImageLocalityPriority": self.image_locality(pod),
+            "MostRequestedPriority": {
+                n.name: self.most_requested(pod, n) for n in self.nodes
+            },
+            "NodeLabelPriority": self.node_label_priority(pod, label_prefs),
+            "RequestedToCapacityRatioPriority": self.requested_to_capacity_ratio(
+                pod, rtc_shape
+            ),
+            "ResourceLimitsPriority": self.resource_limits(pod),
         }
         return out
 

@@ -42,19 +42,27 @@ from kubernetes_tpu.codec.schema import (
 )
 from kubernetes_tpu.ops.predicates import filter_batch
 from kubernetes_tpu.ops.priorities import (
+    MAX_PRIORITY,
     balanced_allocation_score,
     inter_pod_affinity_score,
     image_locality,
     least_requested_score,
+    most_requested_score,
     node_affinity,
     node_capacity2,
+    node_label_priority,
     node_prefer_avoid_pods,
     pod_group_onehot,
+    resource_limits,
     spread_score_from_counts,
     taint_toleration,
 )
 from kubernetes_tpu.ops.select import select_host
-from kubernetes_tpu.codec.schema import DEFAULT_PRIORITY_WEIGHTS, PRIO_INDEX
+from kubernetes_tpu.codec.schema import (
+    DEFAULT_PRIORITY_WEIGHTS,
+    PRIO_INDEX,
+    ScoreConfig,
+)
 
 
 @dataclass
@@ -106,8 +114,9 @@ def encode_batch_ports(encoder, pods: Sequence, n_cap: int) -> BatchPortState:
     )
 
 
-def _dynamic_scores(cluster, req_cpu_mem, requested2, zone_key_id, group_counts, group_onehot):
-    """The three state-dependent priorities, recomputed per scan step from the
+def _dynamic_scores(cluster, req_cpu_mem, requested2, zone_key_id, group_counts,
+                    group_onehot, rtc_xs, rtc_ys):
+    """The state-dependent priorities, recomputed per scan step from the
     shared scoring cores in ops/priorities.py.
 
     req_cpu_mem: f32[2] nonzero request of the current pod;
@@ -116,10 +125,13 @@ def _dynamic_scores(cluster, req_cpu_mem, requested2, zone_key_id, group_counts,
     cap = node_capacity2(cluster)                            # [N, 2]
     req = requested2 + req_cpu_mem[None, :]
     least = least_requested_score(req, cap)                  # [N]
+    most = most_requested_score(req, cap)
     balanced = balanced_allocation_score(req, cap)
     counts = group_counts @ group_onehot                     # [N]
     spread = spread_score_from_counts(counts, cluster, zone_key_id)
-    return least, balanced, spread
+    util = jnp.where(cap > 0, req * 100.0 / jnp.maximum(cap, 1e-30), 100.0)
+    rtc = jnp.floor(jnp.sum(jnp.interp(util, rtc_xs, rtc_ys), axis=-1) / 2.0)
+    return least, most, balanced, spread, rtc
 
 
 _SEQ_CACHE = {}
@@ -130,17 +142,21 @@ def make_sequential_scheduler(
     weights=None,
     unsched_taint_key: int = 0,
     zone_key_id: int = 3,
+    score_cfg: Optional[ScoreConfig] = None,
 ):
     """Build (or fetch the memoized) jitted sequential-commit scheduler.
 
     Returns fn(cluster, pods, ports: BatchPortState, last_index0) ->
       (hosts i32[B] (-1 = unschedulable), new_cluster) where new_cluster has
       the committed requested/nonzero/group_counts columns."""
+    if score_cfg is None:
+        score_cfg = ScoreConfig()
     key = (
         cfg,
         tuple(np.asarray(weights, np.float32)) if weights is not None else None,
         unsched_taint_key,
         zone_key_id,
+        score_cfg,
     )
     hit = _SEQ_CACHE.get(key)
     if hit is not None:
@@ -149,8 +165,12 @@ def make_sequential_scheduler(
         DEFAULT_PRIORITY_WEIGHTS if weights is None else weights, np.float32
     )
     w_least = float(w[PRIO_INDEX["LeastRequestedPriority"]])
+    w_most = float(w[PRIO_INDEX["MostRequestedPriority"]])
     w_bal = float(w[PRIO_INDEX["BalancedResourceAllocation"]])
     w_spread = float(w[PRIO_INDEX["SelectorSpreadPriority"]])
+    w_rtc = float(w[PRIO_INDEX["RequestedToCapacityRatioPriority"]])
+    rtc_xs = np.asarray([p[0] for p in score_cfg.rtc_shape], np.float32)
+    rtc_ys = np.asarray([p[1] for p in score_cfg.rtc_shape], np.float32)
 
     @jax.jit
     def schedule(cluster: ClusterTensors, pods: PodBatch, ports: BatchPortState,
@@ -182,7 +202,7 @@ def make_sequential_scheduler(
             & cluster.valid[None]
             & pods.valid[:, None]
         )
-        # static score components (everything but least/balanced/spread)
+        # static score components (state-independent priorities)
         static_score = (
             w[PRIO_INDEX["InterPodAffinityPriority"]] * inter_pod_affinity_score(cluster, pods)
             + w[PRIO_INDEX["NodePreferAvoidPodsPriority"]] * node_prefer_avoid_pods(cluster, pods)
@@ -190,6 +210,14 @@ def make_sequential_scheduler(
             + w[PRIO_INDEX["TaintTolerationPriority"]] * taint_toleration(cluster, pods)
             + w[PRIO_INDEX["ImageLocalityPriority"]] * image_locality(cluster, pods)
         )
+        if w[PRIO_INDEX["NodeLabelPriority"]]:
+            static_score = static_score + w[PRIO_INDEX["NodeLabelPriority"]] * node_label_priority(
+                cluster, pods, score_cfg
+            )
+        if w[PRIO_INDEX["ResourceLimitsPriority"]]:
+            static_score = static_score + w[PRIO_INDEX["ResourceLimitsPriority"]] * resource_limits(
+                cluster, pods
+            )
         group_onehot = pod_group_onehot(pods, G)              # [B, G]
 
         def step(state, xs):
@@ -205,10 +233,18 @@ def make_sequential_scheduler(
             claimed_conflict = (port_used.astype(jnp.float32) @ ports.conflict.astype(jnp.float32)) > 0
             port_bad = jnp.any(pport[None, :] & claimed_conflict, axis=-1)
             mask = smask & fit & ~port_bad
-            least, balanced, spread = _dynamic_scores(
-                cluster, nz2, nonzero2, zone_key_id, group_counts, gonehot
+            least, most, balanced, spread, rtc = _dynamic_scores(
+                cluster, nz2, nonzero2, zone_key_id, group_counts, gonehot,
+                rtc_xs, rtc_ys,
             )
-            total = sscore + w_least * least + w_bal * balanced + w_spread * spread
+            total = (
+                sscore
+                + w_least * least
+                + w_most * most
+                + w_bal * balanced
+                + w_spread * spread
+                + w_rtc * rtc
+            )
             host, feasible = select_host(total, mask, last_idx)
             # commit
             commit = feasible

@@ -226,11 +226,37 @@ def no_disk_conflict(cluster: ClusterTensors, pods: PodBatch):
 
 def max_volume_counts(cluster: ClusterTensors, pods: PodBatch, max_vols):
     """MaxEBS/GCE/CSI/Azure/Cinder volume-count filters (predicates.go:330-614)
-    -> bool[B, 5, N], one slice per filter type."""
+    -> bool[B, 5, N], one slice per filter type.  Per-node attachable limits
+    (the AttachVolumeLimit allocatable keys) override the static defaults."""
     new = pods.new_vol_counts[:, :, None]       # [B, 5, 1]
     used = cluster.vol_counts.T[None]           # [1, 5, N]
-    limit = jnp.asarray(max_vols, jnp.float32)[None, :, None]
+    default = jnp.asarray(max_vols, jnp.float32)[None, :, None]
+    node_lim = cluster.vol_limits.T[None]       # [1, 5, N] (inf = unset)
+    limit = jnp.minimum(default, node_lim)
     return ~((new > 0) & (used + new > limit))
+
+
+def _pair_terms_ok(cluster: ClusterTensors, term_pairs, term_valid):
+    """AND over terms of 'node belongs to one of the term's allowed pairs'.
+    term_pairs bool[B, K, TP], term_valid bool[B, K] -> bool[B, N]."""
+    topo = cluster.topo_pairs.astype(jnp.float32)            # [N, TP]
+    hit = jnp.einsum("btp,np->btn", term_pairs.astype(jnp.float32), topo) > 0
+    return jnp.all(hit | ~term_valid[..., None], axis=1)
+
+
+def no_volume_zone_conflict(cluster: ClusterTensors, pods: PodBatch):
+    """NoVolumeZoneConflict (predicates.go:616-741): the node must carry the
+    zone/region labels of every bound PV the pod claims (precomputed as
+    allowed hostname-pair sets by the encoder)."""
+    return _pair_terms_ok(cluster, pods.vol_zone_pairs, pods.vol_zone_valid)
+
+
+def check_volume_binding(cluster: ClusterTensors, pods: PodBatch):
+    """CheckVolumeBinding (predicates.go:1651-1700): bound PVs' node affinity
+    must match; unbound claims need a reachable candidate PV (or deferred
+    provisioning); a claim with no PVC/PV at all fails everywhere."""
+    ok = _pair_terms_ok(cluster, pods.vol_bind_pairs, pods.vol_bind_valid)
+    return ok & ~pods.vol_fail_all[:, None]
 
 
 def check_node_label_presence(cluster: ClusterTensors, pods: PodBatch, cfg: FilterConfig):
@@ -320,14 +346,23 @@ def filter_batch(cluster: ClusterTensors, pods: PodBatch, cfg: FilterConfig,
         "MaxCSIVolumeCount": vols[:, 2],
         "MaxAzureDiskVolumeCount": vols[:, 3],
         "MaxCinderVolumeCount": vols[:, 4],
-        "CheckVolumeBinding": ones,
-        "NoVolumeZoneConflict": ones,
+        "CheckVolumeBinding": check_volume_binding(cluster, pods),
+        "NoVolumeZoneConflict": no_volume_zone_conflict(cluster, pods),
         "CheckNodeMemoryPressure": check_node_memory_pressure(cluster, pods),
         "CheckNodePIDPressure": check_node_pid_pressure(cluster, pods),
         "CheckNodeDiskPressure": check_node_disk_pressure(cluster, pods),
         "MatchInterPodAffinity": match_inter_pod_affinity(cluster, pods),
     }
-    stack = jnp.stack([per[name] for name, _ in sorted(PRED_INDEX.items(), key=lambda kv: kv[1])], axis=1)
+    rows = []
+    enabled = set(cfg.enabled) if cfg.enabled is not None else None
+    for name, _ in sorted(PRED_INDEX.items(), key=lambda kv: kv[1]):
+        if enabled is not None and name not in enabled:
+            # disabled by the provider/Policy profile: never filters, never
+            # appears in failure attribution (factory predicate registry)
+            rows.append(ones)
+        else:
+            rows.append(per[name])
+    stack = jnp.stack(rows, axis=1)
     alive = cluster.valid[None] & pods.valid[:, None]
     mask = jnp.all(stack, axis=1) & alive
     return mask, stack

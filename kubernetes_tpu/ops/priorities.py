@@ -264,14 +264,61 @@ def inter_pod_affinity_score(cluster: ClusterTensors, pods: PodBatch):
     return jnp.where(valid, score, 0.0)
 
 
+# --------------------------------------------------- policy-driven priorities
+
+
+def node_label_priority(cluster: ClusterTensors, pods: PodBatch, score_cfg):
+    """NodeLabelPriority (priorities/node_label.go): per configured
+    (key, presence) pref: 10 when presence matches, else 0; weighted sum of
+    prefs, then NOT normalized (each pref is its own PriorityConfig in the
+    reference — we fold them with their weights here)."""
+    B, N = pods.n_pods, cluster.n_nodes
+    total = jnp.zeros((B, N), jnp.float32)
+    for key_id, presence, weight in score_cfg.label_prefs:
+        present = jnp.any(cluster.label_keys == key_id, axis=-1)  # [N]
+        score = jnp.where(present == bool(presence), MAX_PRIORITY, 0.0)
+        total = total + weight * score[None, :]
+    return total
+
+
+def requested_to_capacity_ratio(cluster: ClusterTensors, pods: PodBatch, score_cfg):
+    """RequestedToCapacityRatioPriority (priorities/
+    requested_to_capacity_ratio.go): per-resource utilization% mapped through
+    the configured piecewise-linear curve, averaged over (cpu, mem)."""
+    req = _requested_with_pod(cluster, pods)                 # [B, N, 2]
+    cap = node_capacity2(cluster)[None]
+    util = jnp.where(cap > 0, req * 100.0 / jnp.maximum(cap, 1e-30), 100.0)
+    pts = score_cfg.rtc_shape
+    xs = jnp.asarray([p[0] for p in pts], jnp.float32)
+    ys = jnp.asarray([p[1] for p in pts], jnp.float32)
+    score = jnp.interp(util, xs, ys)                         # clamps at ends
+    return jnp.floor(jnp.sum(score, axis=-1) / 2.0)
+
+
+def resource_limits(cluster: ClusterTensors, pods: PodBatch):
+    """ResourceLimitsPriority (priorities/resource_limits.go, feature-gated):
+    1 if the node's allocatable satisfies the pod's cpu+mem limits and at
+    least one limit is set, else 0."""
+    cap = node_capacity2(cluster)[None]                      # [1, N, 2]
+    lim = pods.limits2[:, None, :]                           # [B, 1, 2]
+    ok = jnp.all((lim == 0) | (cap >= lim), axis=-1)
+    any_lim = jnp.any(pods.limits2 > 0, axis=-1)[:, None]
+    return jnp.where(ok & any_lim, 1.0, 0.0)
+
+
 # ------------------------------------------------------------------ combined
 
 
-def score_batch(cluster: ClusterTensors, pods: PodBatch, weights=None):
+def score_batch(cluster: ClusterTensors, pods: PodBatch, weights=None,
+                score_cfg=None):
     """All priorities + weighted sum -> (total f32[B, N], per f32[B, P, N]).
 
     weights follows PRIORITY_ORDER; defaults to the stock weights
-    (all 1, NodePreferAvoidPods 10000)."""
+    (default provider set at 1 / 10000, policy-only functions at 0)."""
+    if score_cfg is None:
+        from kubernetes_tpu.codec.schema import ScoreConfig
+
+        score_cfg = ScoreConfig()
     per = {
         "SelectorSpreadPriority": selector_spread(cluster, pods),
         "InterPodAffinityPriority": inter_pod_affinity_score(cluster, pods),
@@ -281,6 +328,12 @@ def score_batch(cluster: ClusterTensors, pods: PodBatch, weights=None):
         "NodeAffinityPriority": node_affinity(cluster, pods),
         "TaintTolerationPriority": taint_toleration(cluster, pods),
         "ImageLocalityPriority": image_locality(cluster, pods),
+        "MostRequestedPriority": most_requested(cluster, pods),
+        "NodeLabelPriority": node_label_priority(cluster, pods, score_cfg),
+        "RequestedToCapacityRatioPriority": requested_to_capacity_ratio(
+            cluster, pods, score_cfg
+        ),
+        "ResourceLimitsPriority": resource_limits(cluster, pods),
     }
     stack = jnp.stack(
         [per[name] for name, _ in sorted(PRIO_INDEX.items(), key=lambda kv: kv[1])],

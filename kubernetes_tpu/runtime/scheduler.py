@@ -42,6 +42,22 @@ class SchedulerConfig:
     disable_preemption: bool = False
     weights: Optional[Sequence[float]] = None
     filter_config: FilterConfig = field(default_factory=FilterConfig)
+    profile: Optional[object] = None  # config.SchedulingProfile; overrides
+                                      # filter_config/weights when set
+
+    @staticmethod
+    def from_component_config(cc, interner=None) -> "SchedulerConfig":
+        """Build from a KubeSchedulerConfiguration (config/types.py)."""
+        profile = cc.build_profile(interner=interner)
+        return SchedulerConfig(
+            batch_size=cc.batch_size,
+            batch_window_s=cc.batch_window_s,
+            percentage_of_nodes_to_score=cc.percentage_of_nodes_to_score or 100,
+            disable_preemption=cc.disable_preemption,
+            weights=profile.weights_array(),
+            filter_config=profile.filter_config,
+            profile=profile,
+        )
 
 
 @dataclass
@@ -69,6 +85,10 @@ class Scheduler:
         self.binder = binder if binder is not None else (lambda pod, node: True)
         self.config = config if config is not None else SchedulerConfig()
         enc = self.cache.encoder
+        prof = self.config.profile
+        if prof is not None:
+            self.config.filter_config = prof.filter_config
+            self.config.weights = prof.weights_array()
         enc.hard_pod_affinity_weight = self.config.filter_config.hard_pod_affinity_weight
         self._unsched_key = enc.interner.intern(TAINT_NODE_UNSCHEDULABLE)
         self._schedule_fn = make_sequential_scheduler(
@@ -76,6 +96,7 @@ class Scheduler:
             weights=self.config.weights,
             unsched_taint_key=self._unsched_key,
             zone_key_id=enc.zone_key,
+            score_cfg=prof.score_config if prof is not None else None,
         )
         self._last_index = 0
         self._stop = threading.Event()

@@ -1,0 +1,70 @@
+"""Volume binder: assume/bind PVs alongside pod placement.
+
+Reference: pkg/scheduler/volumebinder + the scheduling flow's
+assumeVolumes/bindVolumes steps (scheduler.go:344-378): once a node is
+picked, unbound WaitForFirstConsumer claims are bound to a compatible PV (or
+left for the dynamic provisioner), atomically with the pod's assume; a bind
+failure rolls everything back (ForgetPod + volume rollback).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.codec.encoder import SnapshotEncoder
+
+
+class VolumeBinder:
+    def __init__(self, encoder: SnapshotEncoder):
+        self.encoder = encoder
+
+    def assume_pod_volumes(self, pod: Pod, node_name: str) -> Tuple[bool, List]:
+        """Bind the pod's unbound claims to PVs compatible with node_name.
+        Returns (all_bound, assumptions) — assumptions feed revert()."""
+        enc = self.encoder
+        row = enc.node_rows.get(node_name)
+        if row is None:
+            return False, []
+        assumptions = []
+        for v in pod.spec.volumes:
+            claim = v.get("persistentVolumeClaim")
+            if not claim:
+                continue
+            pvc = enc.pvcs.get((pod.namespace, claim.get("claimName", "")))
+            if pvc is None:
+                self.revert(assumptions)
+                return False, []
+            if pvc.volume_name:
+                continue  # already bound
+            chosen = None
+            for pv in enc._candidate_pvs(pvc):
+                rows = set(enc._rows_matching_pv_topology(pv))
+                zrows = enc._rows_matching_pv_zone(pv)
+                if zrows is not None:
+                    rows &= set(zrows)
+                if row in rows:
+                    chosen = pv
+                    break
+            if chosen is None:
+                sc = enc.storage_classes.get(pvc.storage_class)
+                if sc is not None and sc.provisioner:
+                    continue  # dynamic provisioning on the chosen node
+                self.revert(assumptions)
+                return False, []
+            old_pvc = pvc
+            old_phase, old_ref = chosen.phase, chosen.claim_ref
+            pvc.volume_name = chosen.name
+            chosen.phase = "Bound"
+            chosen.claim_ref = f"{pvc.namespace}/{pvc.name}"
+            enc.generation += 1
+            assumptions.append((old_pvc, chosen, old_phase, old_ref))
+        return True, assumptions
+
+    def revert(self, assumptions: List) -> None:
+        for pvc, pv, old_phase, old_ref in assumptions:
+            pvc.volume_name = ""
+            pv.phase = old_phase
+            pv.claim_ref = old_ref
+            self.encoder.generation += 1

@@ -1,0 +1,145 @@
+"""Config layer: providers, Policy JSON, feature gates, profile wiring."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.codec.schema import PRED_INDEX, PRIO_INDEX
+from kubernetes_tpu.config import (
+    CLUSTER_AUTOSCALER_PROVIDER,
+    FeatureGates,
+    KubeSchedulerConfiguration,
+    algorithm_provider,
+    profile_from_policy,
+)
+from kubernetes_tpu.cpuref import CPUScheduler
+from kubernetes_tpu.ops import filter_batch, score_batch
+
+from fixtures import TEST_DIMS, make_node, make_pod
+
+
+def test_feature_gates_parse_and_defaults():
+    g = FeatureGates.from_string("TaintNodesByCondition=false,Foo=true")
+    assert not g.enabled("TaintNodesByCondition")
+    assert g.enabled("Foo")
+    assert FeatureGates().enabled("TaintNodesByCondition")
+
+
+def test_default_provider_profile():
+    p = algorithm_provider()
+    # TaintNodesByCondition default-on removes condition predicates
+    assert "CheckNodeCondition" not in p.filter_config.enabled
+    assert "CheckNodeUnschedulable" in p.filter_config.enabled
+    assert "PodToleratesNodeTaints" in p.filter_config.enabled
+    w = p.weights_array()
+    assert w[PRIO_INDEX["LeastRequestedPriority"]] == 1.0
+    assert w[PRIO_INDEX["NodePreferAvoidPodsPriority"]] == 10000.0
+    assert w[PRIO_INDEX["MostRequestedPriority"]] == 0.0
+
+
+def test_autoscaler_provider_swaps_most_requested():
+    p = algorithm_provider(CLUSTER_AUTOSCALER_PROVIDER)
+    w = p.weights_array()
+    assert w[PRIO_INDEX["LeastRequestedPriority"]] == 0.0
+    assert w[PRIO_INDEX["MostRequestedPriority"]] == 1.0
+
+
+def test_gates_keep_condition_predicates_when_disabled():
+    p = algorithm_provider(gates=FeatureGates({"TaintNodesByCondition": False}))
+    assert "CheckNodeCondition" in p.filter_config.enabled
+
+
+def test_disabled_predicate_does_not_filter():
+    enc = SnapshotEncoder(TEST_DIMS)
+    enc.add_node(make_node("tainted", taints=[{"key": "k", "effect": "NoSchedule"}]))
+    pod = make_pod("p")
+    batch = enc.encode_pods([pod])
+    cluster = enc.snapshot()
+    prof_all = algorithm_provider()
+    mask, _ = filter_batch(cluster, batch, prof_all.filter_config, 0)
+    assert not np.asarray(mask)[0, 0]  # taints filter
+    import dataclasses
+
+    fc = dataclasses.replace(
+        prof_all.filter_config,
+        enabled=tuple(
+            n for n in prof_all.filter_config.enabled if "Taint" not in n
+        ),
+    )
+    mask, per = filter_batch(cluster, batch, fc, 0)
+    assert np.asarray(mask)[0, 0]  # taints predicate disabled -> passes
+    assert np.asarray(per)[0, PRED_INDEX["PodToleratesNodeTaints"], 0]
+
+
+def test_policy_json_full():
+    enc = SnapshotEncoder(TEST_DIMS)
+    policy = {
+        "kind": "Policy",
+        "predicates": [
+            {"name": "PodFitsResources"},
+            {"name": "PodToleratesNodeTaints"},
+            {"name": "TestLabelsPresence",
+             "argument": {"labelsPresence": {"labels": ["disk"], "presence": True}}},
+        ],
+        "priorities": [
+            {"name": "LeastRequestedPriority", "weight": 2},
+            {"name": "TestLabelPreference", "weight": 3,
+             "argument": {"labelPreference": {"label": "tier", "presence": True}}},
+            {"name": "RequestedToCapacityRatioPriority", "weight": 2,
+             "argument": {"requestedToCapacityRatioArguments": {"shape": [
+                 {"utilization": 0, "score": 0}, {"utilization": 100, "score": 10}]}}},
+        ],
+        "hardPodAffinitySymmetricWeight": 5,
+    }
+    p = profile_from_policy(policy, interner=enc.interner)
+    assert "CheckNodeLabelPresence" in p.filter_config.enabled
+    assert p.filter_config.label_presence_keys == (enc.interner.lookup("disk"),)
+    w = p.weights_array()
+    assert w[PRIO_INDEX["LeastRequestedPriority"]] == 2.0
+    assert w[PRIO_INDEX["RequestedToCapacityRatioPriority"]] == 2.0
+    assert p.score_config.label_prefs == ((enc.interner.lookup("tier"), True, 3.0),)
+    assert p.hard_pod_affinity_weight == 5.0
+    # label-presence predicate actually filters
+    enc.add_node(make_node("with", labels={"disk": "ssd"}))
+    enc.add_node(make_node("without"))
+    batch = enc.encode_pods([make_pod("p", cpu="100m")])
+    mask, _ = filter_batch(enc.snapshot(), batch, p.filter_config, 0)
+    mask = np.asarray(mask)[0]
+    assert mask[enc.node_rows["with"]] and not mask[enc.node_rows["without"]]
+
+
+def test_component_config_and_new_priorities_parity():
+    cc = KubeSchedulerConfiguration.from_dict(
+        {
+            "schedulerName": "tpu-scheduler",
+            "algorithmSource": {"provider": "ClusterAutoscalerProvider"},
+            "percentageOfNodesToScore": 100,
+            "featureGates": {"ResourceLimitsPriorityFunction": True},
+        }
+    )
+    prof = cc.build_profile()
+    w = prof.weights_array()
+    assert w[PRIO_INDEX["MostRequestedPriority"]] == 1.0
+    assert w[PRIO_INDEX["ResourceLimitsPriority"]] == 1.0
+    # device vs golden for the newly-enabled priorities
+    enc = SnapshotEncoder(TEST_DIMS)
+    nodes = [make_node("n1", cpu="2", mem="4Gi"), make_node("n2", cpu="8", mem="32Gi")]
+    for n in nodes:
+        enc.add_node(n)
+    pod = make_pod("p", cpu="500m", mem="512Mi")
+    from kubernetes_tpu.api.resource import parse_quantity
+
+    pod.spec.containers[0].limits["cpu"] = parse_quantity("4")
+    batch = enc.encode_pods([pod])
+    _, per = score_batch(enc.snapshot(), batch)
+    per = np.asarray(per)
+    golden = CPUScheduler(nodes)
+    gp = golden.priorities(pod)
+    for name in ("MostRequestedPriority", "ResourceLimitsPriority",
+                 "RequestedToCapacityRatioPriority"):
+        for node in nodes:
+            got = per[0, PRIO_INDEX[name], enc.node_rows[node.name]]
+            want = gp[name][node.name]
+            assert abs(got - want) <= (1 if name == "RequestedToCapacityRatioPriority" else 0), (
+                name, node.name, got, want
+            )

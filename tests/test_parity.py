@@ -31,6 +31,7 @@ _FLOAT_BLEND_PRIORITIES = {
     "BalancedResourceAllocation",
     "SelectorSpreadPriority",
     "InterPodAffinityPriority",
+    "RequestedToCapacityRatioPriority",
 }
 _CHECKED_PRIORITIES = list(PRIORITY_ORDER)
 

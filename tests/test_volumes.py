@@ -1,0 +1,212 @@
+"""Volume predicates: zone conflict, binding, PVC-resolved count limits —
+device kernels vs the object-level golden."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.storage import PersistentVolume, PersistentVolumeClaim, StorageClass
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.codec.schema import FilterConfig, PRED_INDEX
+from kubernetes_tpu.cpuref import CPUScheduler
+from kubernetes_tpu.ops import filter_batch
+
+from fixtures import TEST_DIMS, make_node, make_pod
+
+ZONE = "failure-domain.beta.kubernetes.io/zone"
+
+
+def pvc_pod(name, claim, **kw):
+    return make_pod(name, volumes=[{"persistentVolumeClaim": {"claimName": claim}}], **kw)
+
+
+def build(nodes, pods, pvs, pvcs, scs=()):
+    enc = SnapshotEncoder(TEST_DIMS)
+    for n in nodes:
+        enc.add_node(n)
+    for sc in scs:
+        enc.add_storage_class(sc)
+    for pv in pvs:
+        enc.add_pv(pv)
+    for c in pvcs:
+        enc.add_pvc(c)
+    for p in pods:
+        enc.add_pod(p)
+    return enc
+
+
+def check(enc, nodes, pods, pvs, pvcs, scs, pending, preds):
+    golden = CPUScheduler(nodes, pods, pvs=pvs, pvcs=pvcs, storage_classes=scs)
+    batch = enc.encode_pods(pending)
+    _, per_pred = filter_batch(enc.snapshot(), batch, FilterConfig(), 0)
+    per_pred = np.asarray(per_pred)
+    for b, pod in enumerate(pending):
+        want = golden.predicates(pod, nodes[0])  # warm path; per-node below
+        for node in nodes:
+            gold = golden.predicates(pod, node)
+            row = enc.node_rows[node.name]
+            for pname in preds:
+                got = bool(per_pred[b, PRED_INDEX[pname], row])
+                assert got == gold[pname], (pod.name, node.name, pname, got, gold[pname])
+
+
+def test_zone_conflict_bound_pv():
+    nodes = [make_node("a", labels={ZONE: "z1"}), make_node("b", labels={ZONE: "z2"})]
+    pv = PersistentVolume.from_dict({
+        "metadata": {"name": "pv1", "labels": {ZONE: "z1"}},
+        "spec": {"gcePersistentDisk": {"pdName": "d"}, "capacity": {"storage": "10Gi"}},
+    })
+    pvc = PersistentVolumeClaim.from_dict({
+        "metadata": {"name": "c1", "namespace": "default"},
+        "spec": {"volumeName": "pv1"},
+    })
+    enc = build(nodes, [], [pv], [pvc])
+    pending = [pvc_pod("p", "c1")]
+    check(enc, nodes, [], [pv], [pvc], [], pending,
+          ["NoVolumeZoneConflict", "CheckVolumeBinding"])
+    batch = enc.encode_pods(pending)
+    mask, _ = filter_batch(enc.snapshot(), batch, FilterConfig(), 0)
+    mask = np.asarray(mask)[0]
+    assert mask[enc.node_rows["a"]] and not mask[enc.node_rows["b"]]
+
+
+def test_multi_zone_pv_label():
+    nodes = [make_node(f"n{i}", labels={ZONE: f"z{i}"}) for i in range(3)]
+    pv = PersistentVolume.from_dict({
+        "metadata": {"name": "pv1", "labels": {ZONE: "z0__z2"}},
+        "spec": {"awsElasticBlockStore": {"volumeID": "v"}},
+    })
+    pvc = PersistentVolumeClaim.from_dict({
+        "metadata": {"name": "c1", "namespace": "default"},
+        "spec": {"volumeName": "pv1"},
+    })
+    enc = build(nodes, [], [pv], [pvc])
+    pending = [pvc_pod("p", "c1")]
+    check(enc, nodes, [], [pv], [pvc], [], pending, ["NoVolumeZoneConflict"])
+
+
+def test_local_pv_node_affinity():
+    nodes = [make_node("a"), make_node("b")]
+    pv = PersistentVolume.from_dict({
+        "metadata": {"name": "local1"},
+        "spec": {
+            "capacity": {"storage": "50Gi"},
+            "storageClassName": "local",
+            "nodeAffinity": {"required": {"nodeSelectorTerms": [
+                {"matchExpressions": [
+                    {"key": "kubernetes.io/hostname", "operator": "In", "values": ["a"]}
+                ]}
+            ]}},
+        },
+    })
+    pvc = PersistentVolumeClaim.from_dict({
+        "metadata": {"name": "c1", "namespace": "default"},
+        "spec": {"volumeName": "local1", "storageClassName": "local"},
+    })
+    enc = build(nodes, [], [pv], [pvc])
+    pending = [pvc_pod("p", "c1")]
+    check(enc, nodes, [], [pv], [pvc], [], pending, ["CheckVolumeBinding"])
+    batch = enc.encode_pods(pending)
+    mask, _ = filter_batch(enc.snapshot(), batch, FilterConfig(), 0)
+    mask = np.asarray(mask)[0]
+    assert mask[enc.node_rows["a"]] and not mask[enc.node_rows["b"]]
+
+
+def test_unbound_claim_with_candidates():
+    nodes = [make_node("a", labels={ZONE: "z1"}), make_node("b", labels={ZONE: "z2"})]
+    pv = PersistentVolume.from_dict({
+        "metadata": {"name": "avail", "labels": {ZONE: "z2"}},
+        "spec": {"capacity": {"storage": "100Gi"}, "storageClassName": "std",
+                 "accessModes": ["ReadWriteOnce"]},
+    })
+    pvc = PersistentVolumeClaim.from_dict({
+        "metadata": {"name": "want", "namespace": "default"},
+        "spec": {"storageClassName": "std",
+                 "resources": {"requests": {"storage": "10Gi"}},
+                 "accessModes": ["ReadWriteOnce"]},
+    })
+    enc = build(nodes, [], [pv], [pvc])
+    pending = [pvc_pod("p", "want")]
+    check(enc, nodes, [], [pv], [pvc], [], pending, ["CheckVolumeBinding"])
+    # too-big claim: no candidate, no provisioner -> fails everywhere
+    big = PersistentVolumeClaim.from_dict({
+        "metadata": {"name": "big", "namespace": "default"},
+        "spec": {"storageClassName": "std",
+                 "resources": {"requests": {"storage": "1000Gi"}}},
+    })
+    enc.add_pvc(big)
+    pending2 = [pvc_pod("p2", "big")]
+    check(enc, nodes, [], [pv], [pvc, big], [], pending2, ["CheckVolumeBinding"])
+
+
+def test_wait_for_first_consumer_provisioning():
+    nodes = [make_node("a")]
+    sc = StorageClass.from_dict({
+        "metadata": {"name": "fast"}, "provisioner": "csi.example.com",
+        "volumeBindingMode": "WaitForFirstConsumer",
+    })
+    pvc = PersistentVolumeClaim.from_dict({
+        "metadata": {"name": "dyn", "namespace": "default"},
+        "spec": {"storageClassName": "fast",
+                 "resources": {"requests": {"storage": "10Gi"}}},
+    })
+    enc = build(nodes, [], [], [pvc], [sc])
+    pending = [pvc_pod("p", "dyn")]
+    check(enc, nodes, [], [], [pvc], [sc], pending, ["CheckVolumeBinding"])
+
+
+def test_missing_pvc_fails_everywhere():
+    nodes = [make_node("a")]
+    enc = build(nodes, [], [], [])
+    pending = [pvc_pod("p", "ghost")]
+    check(enc, nodes, [], [], [], [], pending, ["CheckVolumeBinding"])
+
+
+def test_pvc_resolved_volume_limits():
+    node = make_node("a")
+    from kubernetes_tpu.api.resource import parse_quantity
+
+    node.status.allocatable["attachable-volumes-aws-ebs"] = parse_quantity("1")
+    nodes = [node, make_node("b")]
+    pv1 = PersistentVolume.from_dict({
+        "metadata": {"name": "ebs1"},
+        "spec": {"awsElasticBlockStore": {"volumeID": "v1"}},
+    })
+    pv2 = PersistentVolume.from_dict({
+        "metadata": {"name": "ebs2"},
+        "spec": {"awsElasticBlockStore": {"volumeID": "v2"}},
+    })
+    c1 = PersistentVolumeClaim.from_dict({
+        "metadata": {"name": "c1", "namespace": "default"}, "spec": {"volumeName": "ebs1"}})
+    c2 = PersistentVolumeClaim.from_dict({
+        "metadata": {"name": "c2", "namespace": "default"}, "spec": {"volumeName": "ebs2"}})
+    existing = [pvc_pod("e1", "c1", node_name="a")]
+    enc = build(nodes, existing, [pv1, pv2], [c1, c2])
+    pending = [pvc_pod("p", "c2")]
+    check(enc, nodes, existing, [pv1, pv2], [c1, c2], [], pending, ["MaxEBSVolumeCount"])
+    batch = enc.encode_pods(pending)
+    _, per_pred = filter_batch(enc.snapshot(), batch, FilterConfig(), 0)
+    per = np.asarray(per_pred)[0, PRED_INDEX["MaxEBSVolumeCount"]]
+    assert not per[enc.node_rows["a"]] and per[enc.node_rows["b"]]
+
+
+def test_volume_binder_assume_and_revert():
+    from kubernetes_tpu.runtime.volumebinder import VolumeBinder
+
+    nodes = [make_node("a", labels={ZONE: "z1"}), make_node("b", labels={ZONE: "z2"})]
+    pv = PersistentVolume.from_dict({
+        "metadata": {"name": "avail", "labels": {ZONE: "z1"}},
+        "spec": {"capacity": {"storage": "100Gi"}, "storageClassName": "std"},
+    })
+    pvc = PersistentVolumeClaim.from_dict({
+        "metadata": {"name": "want", "namespace": "default"},
+        "spec": {"storageClassName": "std",
+                 "resources": {"requests": {"storage": "10Gi"}}},
+    })
+    enc = build(nodes, [], [pv], [pvc])
+    vb = VolumeBinder(enc)
+    ok, assumptions = vb.assume_pod_volumes(pvc_pod("p", "want"), "b")
+    assert not ok  # pv is zone-z1 only
+    ok, assumptions = vb.assume_pod_volumes(pvc_pod("p", "want"), "a")
+    assert ok and pvc.volume_name == "avail" and pv.phase == "Bound"
+    vb.revert(assumptions)
+    assert pvc.volume_name == "" and pv.phase == "Available"
