@@ -12,39 +12,121 @@ batches, chaining device-resident cluster state between batches (requested /
 nonzero / spread counts never leave HBM) while the host performs the
 cache-commit bookkeeping for every placement.
 
-Prints ONE JSON line: pods scheduled per second, vs_baseline = value / 30
-(the reference's enforced minimum).
+Robustness (the axon tunnel to the single TPU chip can be wedged or leased
+elsewhere): device access is serialized through a file lock, TPU backend-init
+or compile failures trigger a fresh-interpreter retry (re-exec, since a failed
+jax backend poisons the process), and after the retry budget the benchmark
+falls back to CPU with the TPU error recorded in the JSON detail.  Exactly ONE
+JSON line is always printed — even on total failure.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
+_ATTEMPT_ENV = "KTPU_BENCH_ATTEMPT"
+_TPU_ERROR_ENV = "KTPU_BENCH_TPU_ERROR"
+_LOCK_PATH = "/tmp/ktpu_device.lock"
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--nodes", type=int, default=5000)
-    ap.add_argument("--pods", type=int, default=10000)
-    ap.add_argument("--batch", type=int, default=512)
-    ap.add_argument("--warmup", type=int, default=1, help="warmup batches (compile)")
-    ap.add_argument(
-        "--platform",
-        default=None,
-        help="force a jax platform (e.g. cpu); default = environment (TPU)",
-    )
-    args = ap.parse_args()
 
+def _emit(result: dict) -> None:
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+
+def _error_line(stage: str, err: BaseException) -> dict:
+    return {
+        "metric": "pods_scheduled_per_sec_5k_nodes",
+        "value": 0.0,
+        "unit": "pods/s",
+        "vs_baseline": 0.0,
+        "detail": {
+            "error": f"{type(err).__name__}: {err}"[:2000],
+            "stage": stage,
+            "attempt": int(os.environ.get(_ATTEMPT_ENV, "0")),
+        },
+    }
+
+
+_RETRYABLE = (
+    "UNAVAILABLE",
+    "DEADLINE",
+    "INTERNAL",
+    "RESOURCE_EXHAUSTED",
+    "JaxRuntimeError",
+    "XlaRuntimeError",
+    "backend",
+    "tunnel",
+    "RPC",
+    "timed out",
+)
+
+
+def _is_transient(err: BaseException) -> bool:
+    """Only tunnel/backend failures warrant a fresh-process retry; a
+    deterministic host-side bug should surface immediately."""
+    s = f"{type(err).__name__}: {err}"
+    if "not in the list of known backends" in s:
+        return False  # plugin registration failure: permanent within this image
+    return any(k in s for k in _RETRYABLE)
+
+
+def _reexec(attempt: int, err: BaseException, max_attempts: int, backoff: float) -> None:
+    """Retry in a fresh interpreter (a failed jax backend poisons this one).
+
+    After the retry budget, re-exec once more with JAX_PLATFORMS=cpu so the
+    run still yields a labeled number instead of nothing.
+    """
+    msg = f"{type(err).__name__}: {err}"[:1000]
+    if attempt < max_attempts:
+        sys.stderr.write(f"bench: device attempt {attempt} failed ({msg}); retrying\n")
+        sys.stderr.flush()
+        time.sleep(backoff * (attempt + 1))
+        os.environ[_ATTEMPT_ENV] = str(attempt + 1)
+    elif os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        sys.stderr.write(f"bench: TPU retries exhausted ({msg}); falling back to cpu\n")
+        sys.stderr.flush()
+        os.environ[_ATTEMPT_ENV] = str(attempt + 1)
+        os.environ[_TPU_ERROR_ENV] = msg
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    else:
+        _emit(_error_line("cpu-fallback", err))
+        sys.exit(0)
+    os.execv(sys.executable, [sys.executable] + sys.argv)
+
+
+def _acquire_device_lock(timeout_s: float):
+    """Serialize device processes: concurrent axon clients wedge the tunnel.
+
+    Polls with LOCK_NB up to timeout_s so a wedged lock holder cannot make
+    this process hang forever without printing its JSON line; returns None on
+    timeout (caller emits a diagnostic line).
+    """
+    import fcntl
+
+    f = open(_LOCK_PATH, "w")
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return f
+        except OSError:
+            if time.monotonic() >= deadline:
+                f.close()
+                return None
+            time.sleep(2.0)
+
+
+def run(args) -> dict:
     import jax
-
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
 
     from tests.fixtures import make_node, make_pod
     from kubernetes_tpu.codec import SnapshotEncoder
@@ -130,22 +212,96 @@ def main():
     dt = time.monotonic() - t0
 
     pods_per_s = scheduled / dt if dt > 0 else 0.0
-    result = {
+    detail = {
+        "nodes": args.nodes,
+        "pods_scheduled": scheduled,
+        "unschedulable": unschedulable,
+        "batch": args.batch,
+        "seconds": round(dt, 3),
+        "node_encode_seconds": round(t_nodes, 3),
+        "device": str(jax.devices()[0]),
+        "attempt": int(os.environ.get(_ATTEMPT_ENV, "0")),
+    }
+    if os.environ.get(_TPU_ERROR_ENV):
+        detail["tpu_error"] = os.environ[_TPU_ERROR_ENV]
+    return {
         "metric": "pods_scheduled_per_sec_5k_nodes",
         "value": round(pods_per_s, 1),
         "unit": "pods/s",
         "vs_baseline": round(pods_per_s / 30.0, 2),
-        "detail": {
-            "nodes": args.nodes,
-            "pods_scheduled": scheduled,
-            "unschedulable": unschedulable,
-            "batch": args.batch,
-            "seconds": round(dt, 3),
-            "node_encode_seconds": round(t_nodes, 3),
-            "device": str(jax.devices()[0]),
-        },
+        "detail": detail,
     }
-    print(json.dumps(result))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=5000)
+    ap.add_argument("--pods", type=int, default=10000)
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--warmup", type=int, default=1, help="warmup batches (compile)")
+    ap.add_argument("--retries", type=int, default=3, help="fresh-process TPU retries")
+    ap.add_argument("--retry-backoff", type=float, default=20.0, help="seconds")
+    ap.add_argument("--lock-timeout", type=float, default=600.0, help="seconds")
+    ap.add_argument(
+        "--platform",
+        default=None,
+        help="force a jax platform (e.g. cpu); default = environment (TPU)",
+    )
+    args = ap.parse_args()
+
+    attempt = int(os.environ.get(_ATTEMPT_ENV, "0"))
+    on_cpu = args.platform == "cpu" or os.environ.get("JAX_PLATFORMS") == "cpu"
+    lock = None
+    if not on_cpu:  # cpu runs don't touch the tunnel; no serialization needed
+        lock = _acquire_device_lock(args.lock_timeout)
+        if lock is None:
+            _emit(
+                _error_line(
+                    "device-lock",
+                    TimeoutError(
+                        f"could not acquire {_LOCK_PATH} in {args.lock_timeout}s"
+                    ),
+                )
+            )
+            return
+    try:
+        try:
+            import jax
+
+            if args.platform:
+                jax.config.update("jax_platforms", args.platform)
+            # persistent compile cache: the sequential-scan compile is minutes
+            # through the axon tunnel; cache it across processes/rounds
+            from kubernetes_tpu.utils.jaxenv import enable_compile_cache
+
+            enable_compile_cache()
+            jax.devices()  # force backend init under our error handling
+        except Exception as e:  # backend init failed (tunnel wedged / no lease)
+            if args.platform or not _is_transient(e):
+                _emit(_error_line("backend-init", e))
+                return
+            if lock is not None:
+                lock.close()  # release before exec; the child re-acquires
+            _reexec(attempt, e, args.retries, args.retry_backoff)
+            return  # unreachable
+
+        try:
+            result = run(args)
+        except Exception as e:  # compile/runtime failure mid-run
+            if args.platform or not _is_transient(e):
+                _emit(_error_line("run", e))
+                return
+            if lock is not None:
+                lock.close()
+            _reexec(attempt, e, args.retries, args.retry_backoff)
+            return  # unreachable
+        _emit(result)
+    finally:
+        if lock is not None:
+            try:
+                lock.close()
+            except Exception:
+                pass
 
 
 if __name__ == "__main__":
