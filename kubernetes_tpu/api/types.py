@@ -39,6 +39,28 @@ DEFAULT_MILLI_CPU_REQUEST = 100
 DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024
 
 
+def parse_time(v) -> Optional[float]:
+    """Timestamp codec: the Kubernetes wire format serializes times as
+    RFC3339 strings (metav1.Time); tests and internal callers may pass epoch
+    seconds directly.  Returns epoch seconds or None."""
+    if v is None or v == "":
+        return None
+    if isinstance(v, (int, float)):
+        return float(v)
+    from datetime import datetime, timezone
+
+    s = str(v)
+    if s.endswith("Z"):
+        s = s[:-1] + "+00:00"
+    try:
+        dt = datetime.fromisoformat(s)
+    except ValueError:
+        return None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt.timestamp()
+
+
 @dataclass
 class ObjectMeta:
     name: str = ""
@@ -48,6 +70,10 @@ class ObjectMeta:
     uid: str = ""
     owner_uid: str = ""   # flattened controller ownerReference UID
     owner_kind: str = ""  # its kind (ReplicationController / ReplicaSet / ...)
+    # epoch seconds when a graceful delete began, None if not deleting
+    # (ref metav1.ObjectMeta.DeletionTimestamp; consulted by
+    # podEligibleToPreemptOthers, generic_scheduler.go:1159-1180)
+    deletion_timestamp: Optional[float] = None
 
     @staticmethod
     def from_dict(d: Optional[dict]) -> "ObjectMeta":
@@ -66,6 +92,7 @@ class ObjectMeta:
             uid=d.get("uid", ""),
             owner_uid=owner_uid,
             owner_kind=owner_kind,
+            deletion_timestamp=parse_time(d.get("deletionTimestamp")),
         )
 
 
@@ -331,6 +358,13 @@ class PodSpec:
 @dataclass
 class PodStatus:
     phase: str = "Pending"
+    # epoch seconds the pod started running; 0 = unknown (ref v1.PodStatus
+    # .StartTime, consumed by pickOneNodeForPreemption criterion 5 via
+    # util.GetEarliestPodStartTime)
+    start_time: float = 0.0
+    # node name this pod preempted victims on and expects to land on
+    # (ref v1.PodStatus.NominatedNodeName, scheduler.go:310-312)
+    nominated_node_name: str = ""
 
 
 @dataclass
@@ -372,10 +406,60 @@ class Pod:
 
     @staticmethod
     def from_dict(d: dict) -> "Pod":
+        st = d.get("status") or {}
         return Pod(
             metadata=ObjectMeta.from_dict(d.get("metadata")),
             spec=PodSpec.from_dict(d.get("spec")),
-            status=PodStatus(phase=(d.get("status") or {}).get("phase", "Pending")),
+            status=PodStatus(
+                phase=st.get("phase", "Pending"),
+                start_time=parse_time(st.get("startTime")) or 0.0,
+                nominated_node_name=st.get("nominatedNodeName", ""),
+            ),
+        )
+
+
+@dataclass
+class PodDisruptionBudget:
+    """The preemption-relevant slice of policy/v1beta1 PodDisruptionBudget
+    (ref staging/src/k8s.io/api/policy/v1beta1/types.go): a label selector
+    over pods plus the controller-maintained disruptions-allowed count.
+    Preemption groups victims by whether evicting them would violate a PDB
+    (generic_scheduler.go filterPodsWithPDBViolation: a pod is violating if
+    ANY matching PDB has PodDisruptionsAllowed <= 0)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[dict] = None  # raw metav1.LabelSelector dict
+    disruptions_allowed: int = 0     # status.disruptionsAllowed
+
+    def matches(self, pod: "Pod") -> bool:
+        if pod.namespace != self.metadata.namespace or self.selector is None:
+            return False
+        for k, v in (self.selector.get("matchLabels") or {}).items():
+            if pod.labels.get(k) != v:
+                return False
+        for e in self.selector.get("matchExpressions") or ():
+            op, key, vals = e.get("operator"), e.get("key"), e.get("values") or ()
+            has = key in pod.labels
+            if op == "In" and not (has and pod.labels[key] in vals):
+                return False
+            if op == "NotIn" and has and pod.labels[key] in vals:
+                return False
+            if op == "Exists" and not has:
+                return False
+            if op == "DoesNotExist" and has:
+                return False
+        return True
+
+    @staticmethod
+    def from_dict(d: dict) -> "PodDisruptionBudget":
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        return PodDisruptionBudget(
+            metadata=ObjectMeta.from_dict(d.get("metadata")),
+            selector=spec.get("selector"),
+            disruptions_allowed=int(
+                status.get("disruptionsAllowed", status.get("PodDisruptionsAllowed", 0))
+            ),
         )
 
 

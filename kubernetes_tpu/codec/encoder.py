@@ -22,7 +22,7 @@ from __future__ import annotations
 import dataclasses
 from collections import Counter
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -59,6 +59,7 @@ from kubernetes_tpu.codec.schema import (
     VOL_CSI,
     VOL_EBS,
     VOL_GCE,
+    WILDCARD,
     _pow2,
 )
 
@@ -72,6 +73,19 @@ K_ANTI_REQ, K_ANTI_PREF, K_AFF_REQ, K_AFF_PREF = 0, 1, 2, 3
 
 def _sel_requirements(raw_selector: Optional[dict]) -> Optional[klabels.Selector]:
     return klabels.selector_from_label_selector(raw_selector)
+
+
+class PodsArena(NamedTuple):
+    """Assigned-pod arena view for preemption what-ifs (see pods_snapshot)."""
+
+    node: np.ndarray        # i32[M] node row (-1 unassigned)
+    priority: np.ndarray    # i32[M]
+    req: np.ndarray         # f32[M, R]
+    nonzero: np.ndarray     # f32[M, 2]
+    valid: np.ndarray       # bool[M] assigned & alive
+    start: np.ndarray       # f32[M] status.startTime epoch seconds
+    keys: List              # [M] (ns, name) or None
+    uids: List              # [M] metadata.uid or ""
 
 
 @dataclass
@@ -101,6 +115,10 @@ class _PodRecord:
     vol_counts: np.ndarray           # f32[NUM_VOL_TYPES]
     priority: int = 0
     group_refs: List[Tuple] = field(default_factory=list)  # term-group signatures
+    pod: Optional[Pod] = None        # the full object (victim deletion, host
+                                     # what-if verification, PDB matching)
+    start_time: float = 0.0          # status.startTime (preemption criterion 5)
+    uid: str = ""                    # metadata.uid (extender MetaPod victims)
 
 
 class SnapshotEncoder:
@@ -658,6 +676,9 @@ class SnapshotEncoder:
             disk_vols=disk,
             vol_counts=vcounts,
             priority=pod.spec.priority,
+            pod=pod,
+            start_time=pod.status.start_time,
+            uid=pod.metadata.uid,
         )
         self.pods[key] = rec
         self.p_alive[m] = True
@@ -1033,20 +1054,22 @@ class SnapshotEncoder:
         node = self._row_node.get(row)
         return node.name if node is not None else ""
 
-    def pods_snapshot(self):
+    def pods_snapshot(self) -> "PodsArena":
         """Per-pod device tensors for preemption what-ifs: the assigned-pod
-        arena as (node_row i32[M], priority i32[M], req f32[M, R],
-        nonzero f32[M, 2], valid bool[M], keys list[M]).
+        arena as a PodsArena view (node_row, priority, req, nonzero, valid,
+        start, keys, uids).
 
         M is the padded pod capacity; `keys` maps arena index -> (ns, name)
-        for decoding victim picks on the host."""
+        and `uids` -> metadata.uid for decoding victim picks on the host."""
         M = self._cap_m
         node = np.full(M, PAD, np.int32)
         prio = np.zeros(M, np.int32)
         req = np.zeros((M, self.dims.R), np.float32)
         nz = np.zeros((M, 2), np.float32)
         valid = np.zeros(M, bool)
+        start = np.zeros(M, np.float32)
         keys: List = [None] * M
+        uids: List = [""] * M
         for rec in self.pods.values():
             m = rec.m
             node[m] = rec.node_row
@@ -1054,8 +1077,86 @@ class SnapshotEncoder:
             req[m, : rec.req.shape[0]] = rec.req
             nz[m] = rec.nonzero
             valid[m] = rec.node_row >= 0
+            start[m] = rec.start_time
             keys[m] = rec.key
-        return node, prio, req, nz, valid, keys
+            uids[m] = rec.uid
+        return PodsArena(node, prio, req, nz, valid, start, keys, uids)
+
+    def preemption_arrays(self, pod: Pod, max_vols=(39.0, 16.0, 1e9, 16.0, 1e9)):
+        """Extended what-if arrays for models.preemption.preempt_one.
+
+        selectVictimsOnNode re-runs all predicates after victim removal
+        (generic_scheduler.go:1054-1128); the resolvable ones with per-pod
+        device state — resources, host ports, disk conflicts, volume-count
+        budgets — fold into one `used - freed + req <= allocatable` check by
+        appending columns to the resource axis:
+
+          col R     : count of pods whose host ports conflict with `pod`
+                      (limit 0.5, pod "requests" 0.25 -> remaining must be 0)
+          col R+1   : count of pods holding one of `pod`'s exclusive disk
+                      volumes (same encoding)
+          col R+2.. : the five Max*VolumeCount budgets
+
+        Returns (pod_req_ext f32[E], requested_ext f32[N, E],
+        allocatable_ext f32[N, E], pods_req_ext f32[M, E])."""
+        R = self.dims.R
+        E = R + 2 + NUM_VOL_TYPES
+        M, N = self._cap_m, self._cap_n
+
+        want_ports = self._pod_ports(pod)
+        want_disk, new_vols = self._pod_vols(pod)
+        want_disk_set = set(want_disk)
+
+        pods_ext = np.zeros((M, E), np.float32)
+        for rec in self.pods.values():
+            m = rec.m
+            pods_ext[m, : rec.req.shape[0]] = rec.req
+            if want_ports and rec.node_row >= 0:
+                for pp, ip in rec.ports:
+                    if any(
+                        pp == wpp and (ip == wip or ip == WILDCARD or wip == WILDCARD)
+                        for wpp, wip in want_ports
+                    ):
+                        pods_ext[m, R] = 1.0
+                        break
+            if want_disk_set and rec.node_row >= 0:
+                if any(dv in want_disk_set for dv in rec.disk_vols):
+                    pods_ext[m, R + 1] = 1.0
+            pods_ext[m, R + 2 :] = rec.vol_counts
+
+        requested_ext = np.zeros((N, E), np.float32)
+        requested_ext[:, :R] = self.a_requested
+        arena_nodes = np.array(
+            [rec.node_row for rec in self.pods.values()], np.int32
+        ).reshape(-1)
+        arena_ms = np.array([rec.m for rec in self.pods.values()], np.int32).reshape(-1)
+        if len(arena_ms):
+            on_node = arena_nodes >= 0
+            np.add.at(
+                requested_ext[:, R], arena_nodes[on_node], pods_ext[arena_ms[on_node], R]
+            )
+            np.add.at(
+                requested_ext[:, R + 1],
+                arena_nodes[on_node],
+                pods_ext[arena_ms[on_node], R + 1],
+            )
+        requested_ext[:, R + 2 :] = self.a_volcnt
+
+        allocatable_ext = np.zeros((N, E), np.float32)
+        allocatable_ext[:, :R] = self.a_allocatable
+        allocatable_ext[:, R] = 0.5
+        allocatable_ext[:, R + 1] = 0.5
+        allocatable_ext[:, R + 2 :] = np.minimum(
+            np.asarray(max_vols, np.float32)[None], self.a_vollim
+        )
+
+        pod_req_ext = np.zeros(E, np.float32)
+        req = self._req_vector(pod.resource_request())
+        pod_req_ext[: req.shape[0]] = req
+        pod_req_ext[R] = 0.25 if want_ports else 0.0
+        pod_req_ext[R + 1] = 0.25 if want_disk_set else 0.0
+        pod_req_ext[R + 2 :] = new_vols
+        return pod_req_ext, requested_ext, allocatable_ext, pods_ext
 
     # ------------------------------------------------------------ pod batch
 
@@ -1315,11 +1416,16 @@ class SnapshotEncoder:
                 tuple(
                     (self.interner.lookup(c.image),
                      tuple(sorted((k, str(q)) for k, q in c.requests.items())),
+                     # limits participate in the row (limits2, best_effort):
+                     # two pods differing only in limits must not share a row
+                     tuple(sorted((k, str(q)) for k, q in c.limits.items())),
                      tuple(c.ports))
                     for c in pod.spec.containers
                 ),
                 tuple(
-                    (c.image, tuple(sorted((k, str(q)) for k, q in c.requests.items())))
+                    (c.image,
+                     tuple(sorted((k, str(q)) for k, q in c.requests.items())),
+                     tuple(sorted((k, str(q)) for k, q in c.limits.items())))
                     for c in pod.spec.init_containers
                 ),
                 pod.spec.tolerations,

@@ -807,77 +807,133 @@ class CPUScheduler:
 
     # ------------------------------------------------------------ preemption
 
-    def _fits_resources_minus(self, pod: Pod, node: Node, removed) -> bool:
-        """PodFitsResources with a victim set removed (what-if)."""
-        alloc = node_allocatable(node)
-        used: Dict[str, float] = defaultdict(float)
-        count = 0
-        for p in self.by_node[node.name]:
-            if (p.namespace, p.name) in removed:
-                continue
-            count += 1
-            for k, v in pod_requests(p).items():
-                used[k] += v
-        used[RESOURCE_PODS] += count
-        req = pod_requests(pod)
-        req[RESOURCE_PODS] = 1
-        for k, v in req.items():
-            if v <= 0:
-                continue
-            if used.get(k, 0.0) + v > alloc.get(k, 0.0):
-                return False
-        return True
+    def _clone_without(self, removed) -> "CPUScheduler":
+        """A what-if copy with a victim set removed (nodeInfoCopy +
+        meta.RemovePod analog: the clone re-derives ALL state, so ports,
+        disk volumes, volume counts, and affinity pair maps reflect the
+        removal)."""
+        return CPUScheduler(
+            self.nodes,
+            [p for p in self.pods if (p.namespace, p.name) not in removed],
+            self.services,
+            self.max_vols,
+            list(self.pvs.values()),
+            list(self.pvcs.values()),
+            list(self.storage_classes.values()),
+        )
 
-    def select_victims_on_node(self, pod: Pod, node: Node):
+    def _fits_minus(self, pod: Pod, node: Node, removed) -> bool:
+        """podFitsOnNode with a victim set removed: the full predicate set
+        (selectVictimsOnNode re-runs every predicate, not just resources)."""
+        return self._clone_without(removed).fits(pod, node)
+
+    @staticmethod
+    def _pdb_violating(pod: Pod, pdbs) -> bool:
+        """filterPodsWithPDBViolation: evicting `pod` violates a PDB if any
+        matching PDB has disruptionsAllowed <= 0."""
+        return any(pdb.matches(pod) and pdb.disruptions_allowed <= 0 for pdb in pdbs)
+
+    def select_victims_on_node(self, pod: Pod, node: Node, pdbs=()):
         """selectVictimsOnNode (generic_scheduler.go:1054-1128): evict all
-        lower-priority pods, then reprieve highest-priority-first while the
-        preemptor still fits.  Returns victim key set or None if impossible."""
+        lower-priority pods, then reprieve — PDB-violating victims first,
+        then non-violating, highest priority first (ties: earliest start) —
+        while the preemptor still fits.  Returns (victim key set,
+        num PDB violations) or (None, 0) if impossible."""
         potential = [
             p
             for p in self.by_node[node.name]
             if p.spec.priority < pod.spec.priority
         ]
         removed = {(p.namespace, p.name) for p in potential}
-        if not self._fits_resources_minus(pod, node, removed):
-            return None
-        for p in sorted(potential, key=lambda q: -q.spec.priority):
-            key = (p.namespace, p.name)
-            removed.discard(key)
-            if not self._fits_resources_minus(pod, node, removed):
-                removed.add(key)
-        return removed
+        if not self._fits_minus(pod, node, removed):
+            return None, 0
+        # MoreImportantPod order: priority desc, then earlier start
+        order = sorted(
+            potential, key=lambda q: (-q.spec.priority, q.status.start_time)
+        )
+        violating = [p for p in order if self._pdb_violating(p, pdbs)]
+        non_violating = [p for p in order if not self._pdb_violating(p, pdbs)]
+        n_viol = 0
+        for group, count_violations in ((violating, True), (non_violating, False)):
+            for p in group:
+                key = (p.namespace, p.name)
+                removed.discard(key)
+                if not self._fits_minus(pod, node, removed):
+                    removed.add(key)
+                    if count_violations:
+                        n_viol += 1
+        return removed, n_viol
 
-    def preempt(self, pod: Pod):
-        """Preempt (:310-369) + pickOneNodeForPreemption criteria 1-3.
-        Only resource-resolvable failures are considered (matching the
-        device model's scope)."""
+    # ErrPodAffinityRulesNotMatch analog: required affinity rules alone
+    def _affinity_rules_ok(self, pod: Pod, node: Node) -> bool:
+        aff = pod.spec.affinity
+        pa = aff.pod_affinity if aff else None
+        if pa is None or not pa.required:
+            return True
+        for term in pa.required:
+            matches_somewhere = False
+            domain_ok = False
+            tval = _topo_value(node, term.topology_key)
+            for p in self.pods:
+                if not p.spec.node_name:
+                    continue
+                if _term_matches_pod(term, pod, p):
+                    matches_somewhere = True
+                    pnode = self.node_by_name.get(p.spec.node_name)
+                    if (
+                        tval is not None
+                        and _topo_value(pnode, term.topology_key) == tval
+                    ):
+                        domain_ok = True
+            if not domain_ok:
+                # first-pod bootstrap: no matching pod anywhere and the term
+                # matches the incoming pod itself on a node carrying the key
+                if not (
+                    not matches_somewhere
+                    and _term_matches_pod(term, pod, pod)
+                    and tval is not None
+                ):
+                    return False
+        return True
+
+    UNRESOLVABLE = (
+        "CheckNodeCondition", "CheckNodeUnschedulable", "PodFitsHost",
+        "PodMatchNodeSelector", "PodToleratesNodeTaints",
+        "PodToleratesNodeNoExecuteTaints", "CheckNodeLabelPresence",
+        "CheckNodeMemoryPressure", "CheckNodePIDPressure",
+        "CheckNodeDiskPressure", "NoVolumeZoneConflict", "CheckVolumeBinding",
+    )
+
+    def preempt(self, pod: Pod, pdbs=()):
+        """Preempt (:310-369) + pickOneNodeForPreemption criteria 1-6
+        (generic_scheduler.go:837-962)."""
         best = None
-        for node in self.nodes:
+        for i, node in enumerate(self.nodes):
             preds = self.predicates(pod, node)
             if all(preds.values()):
                 continue
-            resolvable = all(
-                preds[p]
-                for p in (
-                    "CheckNodeCondition", "CheckNodeUnschedulable", "PodFitsHost",
-                    "PodMatchNodeSelector", "PodToleratesNodeTaints",
-                    "PodToleratesNodeNoExecuteTaints", "CheckNodeMemoryPressure",
-                    "CheckNodePIDPressure", "CheckNodeDiskPressure",
-                    "MaxEBSVolumeCount", "MaxGCEPDVolumeCount", "MaxCSIVolumeCount",
-                    "MaxAzureDiskVolumeCount", "MaxCinderVolumeCount",
-                )
-            )
-            if not resolvable:
+            # nodesWherePreemptionMightHelp: no unresolvable failure
+            if not all(preds[p] for p in self.UNRESOLVABLE if p in preds):
                 continue
-            victims = self.select_victims_on_node(pod, node)
+            if not self._affinity_rules_ok(pod, node):
+                continue
+            victims, n_viol = self.select_victims_on_node(pod, node, pdbs)
             if victims is None:
                 continue
-            vic_pods = [p for p in self.by_node[node.name] if (p.namespace, p.name) in victims]
+            vic_pods = [
+                p for p in self.by_node[node.name] if (p.namespace, p.name) in victims
+            ]
             max_p = max((p.spec.priority for p in vic_pods), default=-(2**31))
-            sum_p = sum(p.spec.priority for p in vic_pods)
-            key = (max_p, sum_p, len(vic_pods))
+            sum_p = sum(p.spec.priority + 2**31 for p in vic_pods)
+            top = [p for p in vic_pods if p.spec.priority == max_p]
+            earliest_top = min(
+                (p.status.start_time for p in top), default=float("inf")
+            )
+            # criteria: min violations, min max prio, min sum, min count,
+            # LATEST earliest-start (negate), first index
+            key = (n_viol, max_p, sum_p, len(vic_pods), -earliest_top, i)
             if best is None or key < best[0]:
-                best = (key, node.name, victims)
+                best = (key, node.name, victims, n_viol)
         if best is None:
             return None, set()
         return best[1], best[2]

@@ -30,8 +30,10 @@ return the TPU total score rescaled to 0..10.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import threading
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
@@ -44,6 +46,7 @@ from kubernetes_tpu.models.preemption import (
     preempt_one,
     preemption_candidates,
     sorted_victim_slots,
+    verify_nomination,
 )
 from kubernetes_tpu.runtime.cache import SchedulerCache
 from kubernetes_tpu.utils import metrics as m
@@ -63,6 +66,11 @@ class ExtenderServer:
         self.cfg = filter_config or FilterConfig()
         enc = self.cache.encoder
         self._unsched = enc.interner.intern("node.kubernetes.io/unschedulable")
+        # pods seen via /filter, so a later /bind can assume them with their
+        # real resource requests; evicted on bind and on /sync pod events,
+        # FIFO-capped so never-bound pods cannot leak for the server's life
+        self._pending: "OrderedDict[tuple, Pod]" = OrderedDict()
+        self._pending_cap = 10000
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._thread: Optional[threading.Thread] = None
 
@@ -107,6 +115,10 @@ class ExtenderServer:
         if pod_d is None:
             return {"nodenames": [], "failedNodes": {}, "error": "missing pod"}
         pod = Pod.from_dict(pod_d)
+        self._pending.pop((pod.namespace, pod.name), None)
+        self._pending[(pod.namespace, pod.name)] = pod
+        while len(self._pending) > self._pending_cap:
+            self._pending.popitem(last=False)
         enc = self.cache.encoder
         # hold the cache lock across compute AND row->name decode: a
         # concurrent /sync could recycle rows between the two
@@ -165,32 +177,61 @@ class ExtenderServer:
         enc = self.cache.encoder
         from kubernetes_tpu.ops import filter_batch
 
+        from kubernetes_tpu.ops.predicates import required_affinity_ok
+
         with self.cache._lock:
             cluster, _ = self.cache.snapshot()
             batch = enc.encode_pods([pod])
             _, per_pred = filter_batch(cluster, batch, self.cfg, self._unsched)
+            aff_ok = required_affinity_ok(cluster, batch)
             cands = preemption_candidates(
-                np.asarray(per_pred), np.asarray(cluster.valid)
+                np.asarray(per_pred), np.asarray(cluster.valid), np.asarray(aff_ok)
             )[0]
-            pods_node, pods_prio, pods_req, _, pods_valid, keys = enc.pods_snapshot()
+            arena = enc.pods_snapshot()
+            violating = np.zeros(len(arena.node), bool)  # no PDB feed over the wire
             slots = sorted_victim_slots(
-                pods_prio, pods_valid, pods_node, pod.spec.priority
+                arena.priority, arena.valid, arena.node, pod.spec.priority,
+                violating, arena.start,
             )
-            res = preempt_one(
-                cluster, np.asarray(batch.req)[0], cands,
-                pods_node, pods_prio, pods_req, slots,
+            pod_req_ext, requested_ext, allocatable_ext, pods_ext = (
+                enc.preemption_arrays(pod, self.cfg.max_vols)
             )
-            node_row = int(res.node)
-            if node_row < 0:
-                return {"nodeNameToMetaVictims": {}}
+            cands = np.asarray(cands).copy()
+            while True:
+                if not cands.any():
+                    return {"nodeNameToMetaVictims": {}}
+                res = preempt_one(
+                    requested_ext, allocatable_ext, pod_req_ext, cands,
+                    arena.node, arena.priority, pods_ext, violating, arena.start,
+                    slots,
+                )
+                node_row = int(res.node)
+                if node_row < 0:
+                    return {"nodeNameToMetaVictims": {}}
+                victim_ms = np.nonzero(np.asarray(res.victim_mask))[0]
+                vic_pods = [
+                    enc.pods[arena.keys[mi]].pod
+                    for mi in victim_ms
+                    if arena.keys[mi] in enc.pods and enc.pods[arena.keys[mi]].pod
+                ]
+                # host gate: the device what-if cannot see anti-affinity
+                # state after victim removal; a veto masks the node
+                if verify_nomination(enc, pod, node_row, vic_pods, self.cfg.max_vols):
+                    break
+                cands[node_row] = False
             node_name = enc.row_name(node_row)
+            # the v1.15 scheduler (HTTPExtender.convertPodUIDToPod) matches
+            # MetaPod.UID against pod.UID in its NodeInfo — emit the real uid
             victims = [
-                {"uid": f"{keys[mi][0]}/{keys[mi][1]}"}
-                for mi in np.nonzero(np.asarray(res.victim_mask))[0]
+                {"uid": arena.uids[mi] or f"{arena.keys[mi][0]}/{arena.keys[mi][1]}"}
+                for mi in victim_ms
             ]
         return {
             "nodeNameToMetaVictims": {
-                node_name: {"pods": victims, "numPDBViolations": 0}
+                node_name: {
+                    "pods": victims,
+                    "numPDBViolations": int(res.n_pdb_violations),
+                }
             }
         }
 
@@ -201,12 +242,21 @@ class ExtenderServer:
         ns = args.get("PodNamespace", "default")
         node = args.get("Node", "")
         rec = self.cache.encoder.pods.get((ns, name))
-        if rec is None:
-            pod = Pod.from_dict(
-                {"metadata": {"name": name, "namespace": ns}, "spec": {"nodeName": node}}
+        if rec is not None:
+            return {"Error": ""}
+        # an unknown pod cannot be assumed with real resource accounting: the
+        # NodeCacheCapable contract requires the extender mirror to have seen
+        # it via /sync first — surface the miss instead of fabricating an
+        # empty pod that would never be charged to the node
+        pending = self._pending.pop((ns, name), None)
+        if pending is not None:
+            self.cache.assume_pod(
+                dataclasses.replace(
+                    pending, spec=dataclasses.replace(pending.spec, node_name=node)
+                )
             )
-            self.cache.assume_pod(pod)
-        return {"Error": ""}
+            return {"Error": ""}
+        return {"Error": f"unknown pod {ns}/{name}: not in extender mirror"}
 
     # ------------------------------------------------------------- handler
 
@@ -258,12 +308,16 @@ class ExtenderServer:
                         outer.cache.remove_node(args["name"])
                         self._send({"ok": True})
                     elif self.path == "/sync/pod":
-                        outer.cache.add_pod(Pod.from_dict(args))
+                        p = Pod.from_dict(args)
+                        outer._pending.pop((p.namespace, p.name), None)
+                        outer.cache.add_pod(p)
                         self._send({"ok": True})
                     elif self.path == "/sync/pod/remove":
+                        key = (args.get("namespace", "default"), args["name"])
+                        outer._pending.pop(key, None)
                         outer.cache.remove_pod(
                             Pod.from_dict(
-                                {"metadata": {"name": args["name"], "namespace": args.get("namespace", "default")}}
+                                {"metadata": {"name": key[1], "namespace": key[0]}}
                             )
                         )
                         self._send({"ok": True})

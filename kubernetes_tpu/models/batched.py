@@ -27,7 +27,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Any, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -79,6 +79,48 @@ jax.tree_util.register_dataclass(
     data_fields=["pod_ports", "conflict", "node_conflict"],
     meta_fields=[],
 )
+
+
+@dataclass
+class NominatedState:
+    """Nominated pods (preemptors awaiting their victims' graceful exit).
+
+    The two-pass fit evaluation (ref generic_scheduler.go:598-664
+    podFitsOnNode) adds nominated pods with priority >= the scheduled pod's
+    to their nominated node before filtering, so a preempted-for claim is
+    visible to later cycles; the pod must ALSO fit without them (pass two).
+    Resource claims are modeled; nominated ports/affinity are a tracked
+    parity gap (PARITY.md)."""
+
+    node: Any   # i32[K] nominated node row (-1 = unused slot)
+    prio: Any   # i32[K]
+    req: Any    # f32[K, R]
+
+
+jax.tree_util.register_dataclass(
+    NominatedState, data_fields=["node", "prio", "req"], meta_fields=[]
+)
+
+
+def encode_nominated(encoder, nominated_pairs, k_min: int = 8):
+    """Host helper: (pod, node_name) pairs -> NominatedState (power-of-two
+    padded), or None when empty."""
+    pairs = [
+        (p, encoder.node_rows.get(n, -1)) for p, n in nominated_pairs
+    ]
+    pairs = [(p, r) for p, r in pairs if r >= 0]
+    if not pairs:
+        return None
+    K = _pow2(len(pairs), k_min)
+    node = np.full(K, -1, np.int32)
+    prio = np.zeros(K, np.int32)
+    req = np.zeros((K, encoder.dims.R), np.float32)
+    for i, (p, r) in enumerate(pairs):
+        node[i] = r
+        prio[i] = p.spec.priority
+        v = encoder._req_vector(p.resource_request())
+        req[i, : v.shape[0]] = v
+    return NominatedState(node=node, prio=prio, req=req)
 
 
 def encode_batch_ports(encoder, pods: Sequence, n_cap: int) -> BatchPortState:
@@ -174,7 +216,7 @@ def make_sequential_scheduler(
 
     @jax.jit
     def schedule(cluster: ClusterTensors, pods: PodBatch, ports: BatchPortState,
-                 last_index0: jnp.ndarray):
+                 last_index0: jnp.ndarray, nominated: Optional[NominatedState] = None):
         B = pods.n_pods
         G = cluster.group_counts.shape[1]
         # ---- static pass: every predicate except the dynamic ones, plus the
@@ -222,13 +264,35 @@ def make_sequential_scheduler(
 
         def step(state, xs):
             requested, nonzero2, group_counts, port_used, last_idx = state
-            smask, sscore, req, nz2, gonehot, pport = xs
+            smask, sscore, req, nz2, gonehot, pprio, pport = xs
             # dynamic resource fit (PodFitsResources on current state)
             fit = ~jnp.any(
                 (req[None, :] > 0)
                 & (requested + req[None, :] > cluster.allocatable),
                 axis=-1,
             )
+            if nominated is not None:
+                # two-pass nominated evaluation (podFitsOnNode,
+                # generic_scheduler.go:598-664): pass one adds nominated pods
+                # with priority >= this pod's to their nominated node; the
+                # no-nominated pass is `fit` itself (resource fit is monotone,
+                # so pass one implies pass two here)
+                w = (
+                    (nominated.prio >= pprio) & (nominated.node >= 0)
+                ).astype(jnp.float32)                         # [K]
+                onehot_nom = (
+                    nominated.node[:, None]
+                    == jnp.arange(requested.shape[0])[None, :]
+                ).astype(jnp.float32)                         # [K, N]
+                extra = jnp.einsum(
+                    "k,kn,kr->nr", w, onehot_nom, nominated.req
+                )                                             # [N, R]
+                fit_nom = ~jnp.any(
+                    (req[None, :] > 0)
+                    & (requested + extra + req[None, :] > cluster.allocatable),
+                    axis=-1,
+                )
+                fit = fit & fit_nom
             # in-batch port conflicts: used claims x conflict matrix
             claimed_conflict = (port_used.astype(jnp.float32) @ ports.conflict.astype(jnp.float32)) > 0
             port_bad = jnp.any(pport[None, :] & claimed_conflict, axis=-1)
@@ -270,6 +334,7 @@ def make_sequential_scheduler(
             pods.req,
             pods.nonzero_req,
             group_onehot,
+            pods.priority,
             ports.pod_ports,
         )
         (requested, nonzero2, group_counts, _, _), hosts = jax.lax.scan(step, init, xs)

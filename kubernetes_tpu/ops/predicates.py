@@ -270,6 +270,30 @@ def check_node_label_presence(cluster: ClusterTensors, pods: PodBatch, cfg: Filt
     return ok
 
 
+def required_affinity_ok(cluster: ClusterTensors, pods: PodBatch):
+    """bool[B, N]: the pod's *required affinity rules* alone hold on the node
+    (component 3 of MatchInterPodAffinity).  Preemption needs this split:
+    ErrPodAffinityRulesNotMatch is unresolvable (evicting pods can only lose
+    matches), while the anti-affinity components ARE resolvable
+    (generic_scheduler.go:65-123 unresolvablePredicateFailureErrors)."""
+    topo = cluster.topo_pairs.astype(jnp.float32)            # [N, TP]
+    aff_hit = jnp.einsum(
+        "btp,np->btn", pods.aff_term_pairs.astype(jnp.float32), topo
+    ) > 0                                                    # [B, PT, N]
+    any_match = jnp.any(pods.aff_term_pairs, axis=-1)        # [B, PT]
+    key_pairs = (
+        pods.aff_term_topo_key[:, :, None] == cluster.pair_topo_key[None, None]
+    )                                                        # [B, PT, TP]
+    node_has_key = jnp.einsum(
+        "btp,np->btn", key_pairs.astype(jnp.float32), topo
+    ) > 0                                                    # [B, PT, N]
+    bootstrap = (
+        ~any_match[..., None] & pods.aff_term_self[..., None] & node_has_key
+    )
+    term_ok = aff_hit | bootstrap | ~pods.aff_term_valid[..., None]
+    return jnp.all(term_ok, axis=1)
+
+
 def match_inter_pod_affinity(cluster: ClusterTensors, pods: PodBatch):
     """MatchInterPodAffinity (predicates.go:1196-1509) via topology-pair
     incidence tensors (the tensorization of metadata.go:64-94):
@@ -292,21 +316,7 @@ def match_inter_pod_affinity(cluster: ClusterTensors, pods: PodBatch):
     ) > 0                                                    # [B, AT, N]
     viol2 = jnp.any(anti_hit & pods.anti_term_valid[..., None], axis=1)
     # 3. own required affinity
-    aff_hit = jnp.einsum(
-        "btp,np->btn", pods.aff_term_pairs.astype(jnp.float32), topo
-    ) > 0                                                    # [B, PT, N]
-    any_match = jnp.any(pods.aff_term_pairs, axis=-1)        # [B, PT]
-    key_pairs = (
-        pods.aff_term_topo_key[:, :, None] == cluster.pair_topo_key[None, None]
-    )                                                        # [B, PT, TP]
-    node_has_key = jnp.einsum(
-        "btp,np->btn", key_pairs.astype(jnp.float32), topo
-    ) > 0                                                    # [B, PT, N]
-    bootstrap = (
-        ~any_match[..., None] & pods.aff_term_self[..., None] & node_has_key
-    )
-    term_ok = aff_hit | bootstrap | ~pods.aff_term_valid[..., None]
-    aff_ok = jnp.all(term_ok, axis=1)
+    aff_ok = required_affinity_ok(cluster, pods)
     return ~viol1 & ~viol2 & aff_ok
 
 

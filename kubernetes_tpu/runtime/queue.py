@@ -74,6 +74,10 @@ class PriorityQueue:
         self._backoff_entry: Dict[Tuple[str, str], list] = {}
         # key -> (pod, cycle, parked_at)
         self._unschedulable: Dict[Tuple[str, str], Tuple[Pod, int, float]] = {}
+        # nominatedPods map (scheduling_queue.go:107-137): pods that preempted
+        # victims and expect to land on a node; consulted by the two-pass fit
+        # evaluation (generic_scheduler.go:598-664 podFitsOnNode)
+        self._nominated: Dict[Tuple[str, str], Tuple[Pod, str]] = {}
         self.backoff = backoff or PodBackoff()
         self.scheduling_cycle = 0
         self.move_request_cycle = -1
@@ -135,6 +139,7 @@ class PriorityQueue:
         with self._lock:
             key = _pod_key(pod)
             self._unschedulable.pop(key, None)
+            self._nominated.pop(key, None)
             entry = self._active_entry.pop(key, None)
             if entry is not None:
                 entry[_VALID] = False
@@ -142,6 +147,25 @@ class PriorityQueue:
             if entry is not None:
                 entry[_VALID] = False
             self.backoff.clear(key)
+
+    # ---- nominated pods (UpdateNominatedPodForNode / DeleteNominatedPodIfExists) ----
+
+    def update_nominated_pod(self, pod: Pod, node_name: str) -> None:
+        with self._lock:
+            self._nominated[_pod_key(pod)] = (pod, node_name)
+
+    def delete_nominated_pod_if_exists(self, pod: Pod) -> None:
+        with self._lock:
+            self._nominated.pop(_pod_key(pod), None)
+
+    def nominated_pods(self) -> List[Tuple[Pod, str]]:
+        """Snapshot of (pod, nominated node name) pairs."""
+        with self._lock:
+            return list(self._nominated.values())
+
+    def nominated_pods_for_node(self, node_name: str) -> List[Pod]:
+        with self._lock:
+            return [p for p, n in self._nominated.values() if n == node_name]
 
     def close(self) -> None:
         with self._lock:

@@ -24,9 +24,20 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.api.types import Pod, PodDisruptionBudget
 from kubernetes_tpu.codec.schema import FilterConfig
-from kubernetes_tpu.models.batched import encode_batch_ports, make_sequential_scheduler
+from kubernetes_tpu.models.batched import (
+    encode_batch_ports,
+    encode_nominated,
+    make_sequential_scheduler,
+)
+from kubernetes_tpu.models.preemption import (
+    preempt_one,
+    preemption_candidates,
+    sorted_victim_slots,
+    verify_nomination,
+)
+from kubernetes_tpu.ops.predicates import filter_batch, required_affinity_ok
 from kubernetes_tpu.runtime.cache import SchedulerCache
 from kubernetes_tpu.runtime.queue import PriorityQueue
 from kubernetes_tpu.utils.trace import Trace
@@ -77,6 +88,8 @@ class Scheduler:
         queue: Optional[PriorityQueue] = None,
         binder: Optional[Callable[[Pod, str], bool]] = None,
         config: Optional[SchedulerConfig] = None,
+        victim_deleter: Optional[Callable[[Pod], None]] = None,
+        pdb_lister: Optional[Callable[[], List[PodDisruptionBudget]]] = None,
     ):
         # NB: PriorityQueue defines __len__, so `queue or PriorityQueue()`
         # would silently replace an *empty* caller-owned queue
@@ -98,9 +111,15 @@ class Scheduler:
             zone_key_id=enc.zone_key,
             score_cfg=prof.score_config if prof is not None else None,
         )
+        # PodPreemptor.DeletePod analog (scheduler.go:319-326); default
+        # removes the victim straight from the cache
+        self.victim_deleter = victim_deleter or (lambda pod: self.cache.remove_pod(pod))
+        self.pdb_lister = pdb_lister or (lambda: [])
         self._last_index = 0
         self._stop = threading.Event()
         self.results: List[ScheduleResult] = []
+        # (preemptor key, node name, victim keys) per successful preemption
+        self.preemptions: List[Tuple[Tuple[str, str], str, List[Tuple[str, str]]]] = []
 
     # ------------------------------------------------------------- one cycle
 
@@ -112,25 +131,39 @@ class Scheduler:
         trace = Trace("schedule_cycle", pods=len(pods))
         enc = self.cache.encoder
         cycle = self.queue.scheduling_cycle
+        batch_keys = {(p.namespace, p.name) for p in pods}
         with self.cache._lock:
             batch = enc.encode_pods(pods)
             ports = encode_batch_ports(enc, pods, enc.dims.N)
+            # two-pass evaluation: nominated pods (other than those being
+            # scheduled now) are added to their nominated nodes in pass one
+            nominated = encode_nominated(
+                enc,
+                [
+                    (p, n)
+                    for p, n in self.queue.nominated_pods()
+                    if (p.namespace, p.name) not in batch_keys
+                ],
+            )
             cluster, generation = self.cache.snapshot()
         trace.step("encode")
         hosts, _ = self._schedule_fn(
-            cluster, batch, ports, np.int32(self._last_index)
+            cluster, batch, ports, np.int32(self._last_index), nominated
         )
         hosts = np.asarray(hosts)
         self._last_index += len(pods)
         trace.step("device")
         results = []
+        fit_errors: List[Pod] = []
         for i, pod in enumerate(pods):
             row = int(hosts[i])
             if row < 0:
                 # FitError path: park in unschedulableQ with backoff
-                # (factory.go MakeDefaultErrorFunc)
+                # (factory.go MakeDefaultErrorFunc), then try preemption
+                # (scheduler.go:463-475)
                 self.queue.add_unschedulable(pod, cycle)
                 results.append(ScheduleResult(pod, None, generation))
+                fit_errors.append(pod)
                 continue
             node_name = enc.row_name(row)
             assumed = dataclasses.replace(
@@ -146,12 +179,160 @@ class Scheduler:
                 self.cache.forget_pod(assumed)
                 self.queue.add_unschedulable(pod, cycle)
                 results.append(ScheduleResult(pod, None, generation))
+                fit_errors.append(pod)
             else:
+                self.queue.delete_nominated_pod_if_exists(pod)
                 results.append(ScheduleResult(pod, node_name, generation))
         trace.step("commit")
+        if not self.config.disable_preemption:
+            for pod in fit_errors:
+                self.preempt(pod)
+            trace.step("preempt")
         trace.log_if_long(0.1)
         self.results.extend(results)
         return results
+
+    # ---------------------------------------------------------- preemption
+
+    def preempt(self, pod: Pod) -> Optional[str]:
+        """Try to make room for a pod that failed to fit: pick a node +
+        minimal victim set on device, verify the nomination host-side against
+        the full predicate set, delete the victims, and record the nominated
+        node so the two-pass evaluation protects the claim.
+
+        Mirrors Scheduler.preempt (scheduler.go:292-342) + genericScheduler
+        .Preempt (generic_scheduler.go:310-369).  Returns the nominated node
+        name, or None if preemption does not help."""
+        if self.config.disable_preemption:
+            return None
+        enc = self.cache.encoder
+        with self.cache._lock:
+            if not self._eligible_to_preempt(pod):
+                return None
+            batch = enc.encode_pods([pod])
+            cluster, _ = self.cache.snapshot()
+            _, per_pred = filter_batch(
+                cluster, batch, self.config.filter_config, self._unsched_key
+            )
+            aff_ok = required_affinity_ok(cluster, batch)
+            cands = np.asarray(
+                preemption_candidates(
+                    np.asarray(per_pred), np.asarray(cluster.valid), np.asarray(aff_ok)
+                )
+            )[0].copy()
+            if not cands.any():
+                # nodesWherePreemptionMightHelp came back empty: clear any
+                # previous nomination (generic_scheduler.go:328-333)
+                self._clear_nomination(pod)
+                return None
+            arena = enc.pods_snapshot()
+            violating = self._pdb_violating_flags(enc, len(arena.node))
+            pod_req_ext, requested_ext, allocatable_ext, pods_ext = (
+                enc.preemption_arrays(pod, self.config.filter_config.max_vols)
+            )
+            slots = sorted_victim_slots(
+                arena.priority,
+                arena.valid,
+                arena.node,
+                pod.spec.priority,
+                violating,
+                arena.start,
+            )
+            victims: List[Pod] = []
+            row = -1
+            while cands.any():
+                res = preempt_one(
+                    requested_ext,
+                    allocatable_ext,
+                    pod_req_ext,
+                    cands,
+                    arena.node,
+                    arena.priority,
+                    pods_ext,
+                    violating,
+                    arena.start,
+                    slots,
+                )
+                row = int(res.node)
+                if row < 0:
+                    self._clear_nomination(pod)
+                    return None
+                victims = [
+                    enc.pods[arena.keys[m]].pod
+                    for m in np.nonzero(np.asarray(res.victim_mask))[0]
+                    if arena.keys[m] in enc.pods and enc.pods[arena.keys[m]].pod
+                ]
+                if self._verify_preemption(pod, row, victims):
+                    break
+                # device what-if can't see anti-affinity state; a host veto
+                # masks the node and re-picks (rare)
+                cands[row] = False
+                row = -1
+            if row < 0:
+                self._clear_nomination(pod)
+                return None
+            node_name = enc.row_name(row)
+        for v in victims:
+            self.victim_deleter(v)
+        pod.status.nominated_node_name = node_name
+        self.queue.update_nominated_pod(pod, node_name)
+        self.preemptions.append(
+            (
+                (pod.namespace, pod.name),
+                node_name,
+                [(v.namespace, v.name) for v in victims],
+            )
+        )
+        # victim deletions are cluster events (eventhandlers.go ->
+        # MoveAllToActiveQueue); in standalone mode emulate the move so the
+        # preemptor retries promptly
+        self.queue.move_all_to_active()
+        return node_name
+
+    def _eligible_to_preempt(self, pod: Pod) -> bool:
+        """podEligibleToPreemptOthers (generic_scheduler.go:1159-1180): if the
+        pod already nominated a node and a lower-priority pod there is still
+        terminating, wait instead of preempting more."""
+        nom = pod.status.nominated_node_name
+        if not nom:
+            return True
+        enc = self.cache.encoder
+        row = enc.node_rows.get(nom, -1)
+        if row < 0:
+            return True
+        for key in enc._row_pods.get(row, ()):
+            rec = enc.pods.get(key)
+            if (
+                rec is not None
+                and rec.pod is not None
+                and rec.pod.metadata.deletion_timestamp is not None
+                and rec.priority < pod.spec.priority
+            ):
+                return False
+        return True
+
+    def _clear_nomination(self, pod: Pod) -> None:
+        pod.status.nominated_node_name = ""
+        self.queue.delete_nominated_pod_if_exists(pod)
+
+    def _pdb_violating_flags(self, enc, m_cap: int) -> np.ndarray:
+        """bool[M]: evicting arena pod m would violate a PodDisruptionBudget
+        (filterPodsWithPDBViolation, generic_scheduler.go:990-1035)."""
+        flags = np.zeros(m_cap, bool)
+        pdbs = [p for p in self.pdb_lister() if p.disruptions_allowed <= 0]
+        if not pdbs:
+            return flags
+        for rec in enc.pods.values():
+            if rec.pod is None or rec.node_row < 0:
+                continue
+            if any(pdb.matches(rec.pod) for pdb in pdbs):
+                flags[rec.m] = True
+        return flags
+
+    def _verify_preemption(self, pod: Pod, row: int, victims: List[Pod]) -> bool:
+        return verify_nomination(
+            self.cache.encoder, pod, row, victims, self.config.filter_config.max_vols
+        )
 
     # ------------------------------------------------------------- run loop
 

@@ -108,12 +108,24 @@ def test_prioritize_verb(server):
 
 def test_bind_verb_updates_mirror(server):
     addr = server.address
+    # a pod the extender never saw cannot be assumed with real accounting
+    res = _post(addr, "/bind", {
+        "PodName": "ghost", "PodNamespace": "default", "PodUID": "u0", "Node": "n2",
+    })
+    assert "unknown pod" in res["Error"]
+    # normal flow: /filter sees the full pod, /bind assumes it
+    _post(addr, "/filter", {
+        "pod": _pod_dict("bound-pod", cpu="100m"),
+        "nodenames": ["n1", "n2"],
+    })
     res = _post(addr, "/bind", {
         "PodName": "bound-pod", "PodNamespace": "default", "PodUID": "u1", "Node": "n2",
     })
     assert res["Error"] == ""
-    # the mirror now charges n2 with one more pod
-    assert ("default", "bound-pod") in server.cache.encoder.pods
+    # the mirror now charges n2 with the pod's REAL cpu request
+    rec = server.cache.encoder.pods[("default", "bound-pod")]
+    assert rec.node_row == server.cache.encoder.node_rows["n2"]
+    assert rec.req[0] == 100.0  # milliCPU
 
 
 def test_preempt_verb(server):

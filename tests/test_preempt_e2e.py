@@ -1,0 +1,153 @@
+"""End-to-end preemption through the scheduling loop.
+
+Covers the wiring the reference does in scheduleOne (scheduler.go:463-475):
+FitError -> preempt -> victims deleted -> nominated node recorded -> requeue
+-> the preemptor lands; plus the two-pass nominated-pod evaluation
+(generic_scheduler.go:598-664) protecting the claim from later cycles, and
+the DisablePreemption gate.
+"""
+
+import time
+
+import numpy as np
+
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.queue import PodBackoff, PriorityQueue
+from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+from fixtures import make_node, make_pod
+
+
+def _scheduler(disable_preemption=False, pdb_lister=None):
+    bound = []
+    deleted = []
+    cache = SchedulerCache()
+    queue = PriorityQueue(backoff=PodBackoff(initial=0.01, max_duration=0.05))
+    sched = Scheduler(
+        cache=cache,
+        queue=queue,
+        binder=lambda pod, node: bound.append((pod.name, node)) or True,
+        config=SchedulerConfig(disable_preemption=disable_preemption),
+        victim_deleter=lambda pod: deleted.append(pod.name) or cache.remove_pod(pod),
+        pdb_lister=pdb_lister,
+    )
+    return sched, cache, queue, bound, deleted
+
+
+def _drain(sched, cycles=6, timeout=0.2, settle=0.06):
+    for _ in range(cycles):
+        sched.run_once(timeout=timeout)
+        time.sleep(settle)  # let backoff expire
+
+
+def test_preempt_end_to_end():
+    sched, cache, queue, bound, deleted = _scheduler()
+    cache.add_node(make_node("n1", cpu="1", mem="4Gi"))
+    cache.add_node(make_node("n2", cpu="1", mem="4Gi"))
+    # reference-chosen victim: lowest max priority -> n1's priority-1 pod
+    cache.add_pod(make_pod("low-a", cpu="600m", node_name="n1", priority=1))
+    cache.add_pod(make_pod("low-b", cpu="600m", node_name="n2", priority=2))
+    boss = make_pod("boss", cpu="800m", priority=100)
+    queue.add(boss)
+    _drain(sched)
+    assert deleted == ["low-a"]
+    assert ("boss", "n1") in bound
+    assert sched.preemptions and sched.preemptions[0][0] == ("default", "boss")
+    assert sched.preemptions[0][1] == "n1"
+    assert sched.preemptions[0][2] == [("default", "low-a")]
+
+
+def test_disable_preemption_is_not_a_noop():
+    sched, cache, queue, bound, deleted = _scheduler(disable_preemption=True)
+    cache.add_node(make_node("n1", cpu="1", mem="4Gi"))
+    cache.add_pod(make_pod("low", cpu="900m", node_name="n1", priority=1))
+    queue.add(make_pod("boss", cpu="800m", priority=100))
+    _drain(sched, cycles=3)
+    assert deleted == []
+    assert bound == []
+    assert sched.preemptions == []
+
+
+def test_nominated_claim_protected_from_later_cycles():
+    # boss preempts on n1; while its victims terminate, a lower-priority pod
+    # that would fit in the freed space must NOT steal it (two-pass
+    # evaluation adds the nominated pod's request in pass one)
+    sched, cache, queue, bound, deleted = _scheduler()
+    cache.add_node(make_node("n1", cpu="1", mem="4Gi"))
+    cache.add_pod(make_pod("low", cpu="900m", node_name="n1", priority=1))
+    boss = make_pod("boss", cpu="800m", priority=100)
+    nom = sched.preempt(boss)
+    assert nom == "n1"
+    assert deleted == ["low"]
+    assert boss.status.nominated_node_name == "n1"
+    # now a cheeky lower-priority pod arrives wanting the freed space
+    queue.add(make_pod("cheeky", cpu="800m", priority=0))
+    sched.run_once(timeout=0.2)
+    assert ("cheeky", "n1") not in bound
+    # the boss itself still schedules there (its own nomination is excluded
+    # from its pass-one state)
+    queue.add(boss)
+    sched.run_once(timeout=0.2)
+    assert ("boss", "n1") in bound
+    # nomination cleared on successful bind
+    assert queue.nominated_pods() == []
+
+
+def test_preempt_respects_pdb_choice():
+    pdbs = []
+    sched, cache, queue, bound, deleted = _scheduler(pdb_lister=lambda: pdbs)
+    from kubernetes_tpu.api.types import ObjectMeta, PodDisruptionBudget
+
+    pdbs.append(
+        PodDisruptionBudget(
+            metadata=ObjectMeta(name="guard", namespace="default"),
+            selector={"matchLabels": {"app": "guarded"}},
+            disruptions_allowed=0,
+        )
+    )
+    cache.add_node(make_node("n1", cpu="1", mem="4Gi"))
+    cache.add_node(make_node("n2", cpu="1", mem="4Gi"))
+    cache.add_pod(
+        make_pod("prot", cpu="900m", node_name="n1", priority=1,
+                 labels={"app": "guarded"})
+    )
+    cache.add_pod(make_pod("plain", cpu="900m", node_name="n2", priority=5))
+    boss = make_pod("boss", cpu="800m", priority=100)
+    nom = sched.preempt(boss)
+    assert nom == "n2"
+    assert deleted == ["plain"]
+
+
+def test_preempt_verifies_anti_affinity_host_side():
+    # n1's only low-priority victim frees resources, but a HIGH-priority pod
+    # elsewhere in the same zone repels the preemptor via anti-affinity the
+    # device what-if cannot see: the host gate must veto n1 (and n2, same
+    # zone) and preemption must fail entirely
+    sched, cache, queue, bound, deleted = _scheduler()
+    zone = "failure-domain.beta.kubernetes.io/zone"
+    cache.add_node(make_node("n1", cpu="1", mem="4Gi", labels={zone: "z1"}))
+    cache.add_node(make_node("n2", cpu="1", mem="4Gi", labels={zone: "z1"}))
+    cache.add_pod(make_pod("low", cpu="900m", node_name="n1", priority=1))
+    # the guard pod: high priority, sits on n2, ANTI-affine to app=boss
+    cache.add_pod(
+        make_pod(
+            "guard",
+            cpu="100m",
+            node_name="n2",
+            priority=1000,
+            affinity={
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "labelSelector": {"matchLabels": {"app": "boss"}},
+                            "topologyKey": zone,
+                        }
+                    ]
+                }
+            },
+        )
+    )
+    boss = make_pod("boss", cpu="800m", priority=100, labels={"app": "boss"})
+    nom = sched.preempt(boss)
+    assert nom is None
+    assert deleted == []

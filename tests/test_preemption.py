@@ -12,11 +12,12 @@ from kubernetes_tpu.models.preemption import (
     sorted_victim_slots,
 )
 from kubernetes_tpu.ops import filter_batch
+from kubernetes_tpu.ops.predicates import required_affinity_ok
 
 from fixtures import TEST_DIMS, make_node, make_pod
 
 
-def run_device_preempt(nodes, existing, preemptor):
+def run_device_preempt(nodes, existing, preemptor, pdbs=()):
     enc = SnapshotEncoder(TEST_DIMS)
     for n in nodes:
         enc.add_node(n)
@@ -25,24 +26,40 @@ def run_device_preempt(nodes, existing, preemptor):
     cluster = enc.snapshot()
     batch = enc.encode_pods([preemptor])
     _, per_pred = filter_batch(cluster, batch, FilterConfig(), 0)
-    cands = preemption_candidates(np.asarray(per_pred), np.asarray(cluster.valid))[0]
-    pods_node, pods_prio, pods_req, _, pods_valid, keys = enc.pods_snapshot()
+    aff_ok = required_affinity_ok(cluster, batch)
+    cands = preemption_candidates(
+        np.asarray(per_pred), np.asarray(cluster.valid), np.asarray(aff_ok)
+    )[0]
+    arena = enc.pods_snapshot()
+    violating = np.zeros(len(arena.node), bool)
+    for rec in enc.pods.values():
+        if rec.pod is not None and rec.node_row >= 0:
+            violating[rec.m] = any(
+                pdb.matches(rec.pod) and pdb.disruptions_allowed <= 0 for pdb in pdbs
+            )
     slots = sorted_victim_slots(
-        pods_prio, pods_valid, pods_node, preemptor.spec.priority
+        arena.priority, arena.valid, arena.node, preemptor.spec.priority,
+        violating, arena.start,
+    )
+    pod_req_ext, requested_ext, allocatable_ext, pods_ext = enc.preemption_arrays(
+        preemptor
     )
     res = preempt_one(
-        cluster,
-        np.asarray(batch.req)[0],
+        requested_ext,
+        allocatable_ext,
+        pod_req_ext,
         cands,
-        pods_node,
-        pods_prio,
-        pods_req,
+        arena.node,
+        arena.priority,
+        pods_ext,
+        violating,
+        arena.start,
         slots,
     )
     node_row = int(res.node)
     row_names = {row: name for name, row in enc.node_rows.items()}
     victims = {
-        keys[m] for m in np.nonzero(np.asarray(res.victim_mask))[0]
+        arena.keys[m] for m in np.nonzero(np.asarray(res.victim_mask))[0]
     }
     return (row_names[node_row] if node_row >= 0 else None), victims
 
@@ -101,6 +118,77 @@ def test_preempt_unresolvable_node_skipped():
     )
     got_node, _ = run_device_preempt(nodes, existing, preemptor)
     # pod matches NO node's selector -> no candidate anywhere
+    assert got_node is None
+
+
+def _make_pdb(name, match_labels, allowed=0, ns="default"):
+    from kubernetes_tpu.api.types import ObjectMeta, PodDisruptionBudget
+
+    return PodDisruptionBudget(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        selector={"matchLabels": match_labels},
+        disruptions_allowed=allowed,
+    )
+
+
+def test_preempt_pdb_criterion_prefers_non_violating_node():
+    # both nodes preemptable; n1's victim is PDB-protected -> pick n2 even
+    # though n2's victim has higher priority (criterion 1 precedes 2)
+    nodes = [make_node("n1", cpu="1", mem="4Gi"), make_node("n2", cpu="1", mem="4Gi")]
+    existing = [
+        make_pod("prot", cpu="900m", node_name="n1", priority=1,
+                 labels={"app": "guarded"}),
+        make_pod("plain", cpu="900m", node_name="n2", priority=5),
+    ]
+    pdbs = [_make_pdb("pdb", {"app": "guarded"}, allowed=0)]
+    preemptor = make_pod("high", cpu="800m", priority=100)
+    got_node, got_victims = run_device_preempt(nodes, existing, preemptor, pdbs)
+    golden = CPUScheduler(nodes, existing)
+    want_node, want_victims = golden.preempt(preemptor, pdbs)
+    assert got_node == want_node == "n2"
+    assert got_victims == want_victims == {("default", "plain")}
+
+
+def test_preempt_start_time_criterion():
+    # identical victims except start time: pick the node whose victim
+    # started LATER (criterion 5)
+    nodes = [make_node("n1", cpu="1", mem="4Gi"), make_node("n2", cpu="1", mem="4Gi")]
+    old = make_pod("old", cpu="900m", node_name="n1", priority=1)
+    old.status.start_time = 100.0
+    young = make_pod("young", cpu="900m", node_name="n2", priority=1)
+    young.status.start_time = 500.0
+    preemptor = make_pod("high", cpu="800m", priority=10)
+    got_node, got_victims = run_device_preempt(nodes, [old, young], preemptor)
+    golden = CPUScheduler(nodes, [old, young])
+    want_node, want_victims = golden.preempt(preemptor)
+    assert got_node == want_node == "n2"
+    assert got_victims == want_victims == {("default", "young")}
+
+
+def test_preempt_host_port_conflict_resolvable():
+    # the preemptor's host port clashes with a low-priority pod: port
+    # conflicts are resolvable (NOT in unresolvablePredicateFailureErrors),
+    # and the what-if must verify the victim frees the port
+    nodes = [make_node("n1", cpu="4", mem="8Gi")]
+    holder = make_pod("holder", cpu="100m", node_name="n1", priority=1,
+                      ports=[{"hostPort": 8080, "protocol": "TCP"}])
+    preemptor = make_pod("high", cpu="100m", priority=100, ports=[{"hostPort": 8080, "protocol": "TCP"}])
+    got_node, got_victims = run_device_preempt(nodes, [holder], preemptor)
+    assert got_node == "n1"
+    assert got_victims == {("default", "holder")}
+
+
+def test_preempt_port_held_by_higher_priority_not_chosen():
+    # port holder outranks the preemptor: removing lower-priority pods does
+    # not free the port, so the node is not a preemption target
+    nodes = [make_node("n1", cpu="4", mem="8Gi")]
+    existing = [
+        make_pod("portly", cpu="100m", node_name="n1", priority=1000,
+                 ports=[{"hostPort": 8080, "protocol": "TCP"}]),
+        make_pod("filler", cpu="100m", node_name="n1", priority=1),
+    ]
+    preemptor = make_pod("high", cpu="100m", priority=100, ports=[{"hostPort": 8080, "protocol": "TCP"}])
+    got_node, got_victims = run_device_preempt(nodes, existing, preemptor)
     assert got_node is None
 
 
