@@ -83,7 +83,7 @@ class PodsArena(NamedTuple):
     req: np.ndarray         # f32[M, R]
     nonzero: np.ndarray     # f32[M, 2]
     valid: np.ndarray       # bool[M] assigned & alive
-    start: np.ndarray       # f32[M] status.startTime epoch seconds
+    start: np.ndarray       # f64[M] status.startTime epoch seconds
     keys: List              # [M] (ns, name) or None
     uids: List              # [M] metadata.uid or ""
 
@@ -1067,7 +1067,9 @@ class SnapshotEncoder:
         req = np.zeros((M, self.dims.R), np.float32)
         nz = np.zeros((M, 2), np.float32)
         valid = np.zeros(M, bool)
-        start = np.zeros(M, np.float32)
+        # f64: epoch-second timestamps quantize to ~128s in f32; device
+        # kernels receive dense RANKS (models.preemption.dense_start_ranks)
+        start = np.zeros(M, np.float64)
         keys: List = [None] * M
         uids: List = [""] * M
         for rec in self.pods.values():

@@ -43,10 +43,9 @@ from kubernetes_tpu.api.types import Node, Pod
 from kubernetes_tpu.codec.schema import FilterConfig, NUM_PREDICATES, PREDICATE_ORDER
 from kubernetes_tpu.models.generic import schedule_batch_independent
 from kubernetes_tpu.models.preemption import (
-    preempt_one,
+    pick_preemption_node,
     preemption_candidates,
     sorted_victim_slots,
-    verify_nomination,
 )
 from kubernetes_tpu.runtime.cache import SchedulerCache
 from kubernetes_tpu.utils import metrics as m
@@ -115,14 +114,15 @@ class ExtenderServer:
         if pod_d is None:
             return {"nodenames": [], "failedNodes": {}, "error": "missing pod"}
         pod = Pod.from_dict(pod_d)
-        self._pending.pop((pod.namespace, pod.name), None)
-        self._pending[(pod.namespace, pod.name)] = pod
-        while len(self._pending) > self._pending_cap:
-            self._pending.popitem(last=False)
         enc = self.cache.encoder
         # hold the cache lock across compute AND row->name decode: a
-        # concurrent /sync could recycle rows between the two
+        # concurrent /sync could recycle rows between the two (_pending is
+        # guarded by the same lock against concurrent handler threads)
         with self.cache._lock:
+            self._pending.pop((pod.namespace, pod.name), None)
+            self._pending[(pod.namespace, pod.name)] = pod
+            while len(self._pending) > self._pending_cap:
+                self._pending.popitem(last=False)
             cluster, _ = self.cache.snapshot()
             batch = enc.encode_pods([pod])
             out = schedule_batch_independent(
@@ -193,32 +193,11 @@ class ExtenderServer:
                 arena.priority, arena.valid, arena.node, pod.spec.priority,
                 violating, arena.start,
             )
-            pod_req_ext, requested_ext, allocatable_ext, pods_ext = (
-                enc.preemption_arrays(pod, self.cfg.max_vols)
+            node_row, victim_ms, _, res = pick_preemption_node(
+                enc, pod, cands, arena, slots, violating, self.cfg.max_vols
             )
-            cands = np.asarray(cands).copy()
-            while True:
-                if not cands.any():
-                    return {"nodeNameToMetaVictims": {}}
-                res = preempt_one(
-                    requested_ext, allocatable_ext, pod_req_ext, cands,
-                    arena.node, arena.priority, pods_ext, violating, arena.start,
-                    slots,
-                )
-                node_row = int(res.node)
-                if node_row < 0:
-                    return {"nodeNameToMetaVictims": {}}
-                victim_ms = np.nonzero(np.asarray(res.victim_mask))[0]
-                vic_pods = [
-                    enc.pods[arena.keys[mi]].pod
-                    for mi in victim_ms
-                    if arena.keys[mi] in enc.pods and enc.pods[arena.keys[mi]].pod
-                ]
-                # host gate: the device what-if cannot see anti-affinity
-                # state after victim removal; a veto masks the node
-                if verify_nomination(enc, pod, node_row, vic_pods, self.cfg.max_vols):
-                    break
-                cands[node_row] = False
+            if node_row < 0:
+                return {"nodeNameToMetaVictims": {}}
             node_name = enc.row_name(node_row)
             # the v1.15 scheduler (HTTPExtender.convertPodUIDToPod) matches
             # MetaPod.UID against pod.UID in its NodeInfo — emit the real uid
@@ -241,14 +220,15 @@ class ExtenderServer:
         name = args.get("PodName", "")
         ns = args.get("PodNamespace", "default")
         node = args.get("Node", "")
-        rec = self.cache.encoder.pods.get((ns, name))
-        if rec is not None:
-            return {"Error": ""}
-        # an unknown pod cannot be assumed with real resource accounting: the
-        # NodeCacheCapable contract requires the extender mirror to have seen
-        # it via /sync first — surface the miss instead of fabricating an
-        # empty pod that would never be charged to the node
-        pending = self._pending.pop((ns, name), None)
+        with self.cache._lock:
+            rec = self.cache.encoder.pods.get((ns, name))
+            if rec is not None:
+                return {"Error": ""}
+            # an unknown pod cannot be assumed with real resource accounting:
+            # the NodeCacheCapable contract requires the extender mirror to
+            # have seen it via /sync first — surface the miss instead of
+            # fabricating an empty pod never charged to the node
+            pending = self._pending.pop((ns, name), None)
         if pending is not None:
             self.cache.assume_pod(
                 dataclasses.replace(
@@ -309,12 +289,14 @@ class ExtenderServer:
                         self._send({"ok": True})
                     elif self.path == "/sync/pod":
                         p = Pod.from_dict(args)
-                        outer._pending.pop((p.namespace, p.name), None)
+                        with outer.cache._lock:
+                            outer._pending.pop((p.namespace, p.name), None)
                         outer.cache.add_pod(p)
                         self._send({"ok": True})
                     elif self.path == "/sync/pod/remove":
                         key = (args.get("namespace", "default"), args["name"])
-                        outer._pending.pop(key, None)
+                        with outer.cache._lock:
+                            outer._pending.pop(key, None)
                         outer.cache.remove_pod(
                             Pod.from_dict(
                                 {"metadata": {"name": key[1], "namespace": key[0]}}

@@ -1,0 +1,19 @@
+from kubernetes_tpu.framework.v1alpha1 import (
+    Code,
+    Framework,
+    PluginContext,
+    PodInfo,
+    Registry,
+    Status,
+    WaitingPod,
+)
+
+__all__ = [
+    "Code",
+    "Framework",
+    "PluginContext",
+    "PodInfo",
+    "Registry",
+    "Status",
+    "WaitingPod",
+]

@@ -1,0 +1,424 @@
+"""Scheduling-framework plugin API, v1alpha1.
+
+Mirrors pkg/scheduler/framework/v1alpha1 (interface.go:106-177 plugin
+interfaces, framework.go:52-60 runner, registry.go:31, context.go,
+waiting_pods_map.go): QueueSort / Reserve / Permit / Prebind / Unreserve
+extension points around the assume->bind sequence, a per-cycle PluginContext
+key/value store, and a waiting-pods map for Permit "wait" verdicts.
+
+This snapshot of the reference has no Filter/Score plugin points (they are
+the legacy FitPredicate/PriorityConfig registries); the forward-looking shape
+SURVEY.md prescribes for the TPU path is exposed here as *tensor-level*
+Filter/Score plugins: instead of a per-(pod, node) callback — which would
+put a Python call inside the hot loop — a TensorFilterPlugin/
+TensorScorePlugin transforms the whole pods x nodes feasibility mask / score
+matrix between the device launch and host selection, keeping plugin cost
+O(1) launches rather than O(pods x nodes) calls.
+
+Plugins implement extension points by subclassing the marker classes (the
+Python analog of the reference's interface type-assertions in
+framework.go:NewFramework); a single class may implement several.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.types import Pod
+
+# Specifies the maximum timeout a permit plugin can return
+# (framework.go maxTimeout = 15 minutes).
+MAX_PERMIT_TIMEOUT_S = 15 * 60.0
+
+
+class Code(IntEnum):
+    """Status codes returned from plugins (interface.go:32-45)."""
+
+    SUCCESS = 0
+    ERROR = 1
+    UNSCHEDULABLE = 2
+    WAIT = 3
+
+
+@dataclass(frozen=True)
+class Status:
+    """Result of running a plugin; None is also treated as Success
+    (interface.go:47-90)."""
+
+    code: Code = Code.SUCCESS
+    message: str = ""
+
+    def is_success(self) -> bool:
+        return self.code == Code.SUCCESS
+
+
+SUCCESS = Status()
+
+
+def _code(status: Optional[Status]) -> Code:
+    return Code.SUCCESS if status is None else status.code
+
+
+@dataclass
+class PodInfo:
+    """Minimum cell in the scheduling queue (interface.go PodInfo)."""
+
+    pod: Pod
+    timestamp: float = 0.0
+
+
+# LessFunc: (PodInfo, PodInfo) -> bool
+LessFunc = Callable[[PodInfo, PodInfo], bool]
+
+
+class PluginContext:
+    """Per-scheduling-cycle key/value store shared by plugins
+    (context.go ContextData); thread-safe because permit waits and binds may
+    run off-thread."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._data: Dict[str, Any] = {}
+
+    def read(self, key: str) -> Any:
+        with self._lock:
+            if key not in self._data:
+                raise KeyError(key)
+            return self._data[key]
+
+    def write(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._data[key] = value
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+
+# ------------------------------------------------------------------ plugins
+
+
+class Plugin:
+    """Parent type for all plugins (interface.go:106-108).  NAME defaults to
+    the class name."""
+
+    NAME: str = ""
+
+    def name(self) -> str:
+        return self.NAME or type(self).__name__
+
+
+class QueueSortPlugin(Plugin):
+    """Sorts pods in the scheduling queue; only one may be enabled
+    (interface.go:123-130)."""
+
+    def less(self, pi1: PodInfo, pi2: PodInfo) -> bool:
+        raise NotImplementedError
+
+
+class ReservePlugin(Plugin):
+    """Called when the scheduler cache is updated ('assume');
+    anything but Success rejects the pod (interface.go:132-143)."""
+
+    def reserve(self, pc: PluginContext, pod: Pod, node_name: str) -> Optional[Status]:
+        return None
+
+
+class PrebindPlugin(Plugin):
+    """Called before binding; must return Success or the pod is rejected
+    (interface.go:145-152)."""
+
+    def prebind(self, pc: PluginContext, pod: Pod, node_name: str) -> Optional[Status]:
+        return None
+
+
+class UnreservePlugin(Plugin):
+    """Informational: a reserved pod was rejected later (interface.go:154-163)."""
+
+    def unreserve(self, pc: PluginContext, pod: Pod, node_name: str) -> None:
+        pass
+
+
+class PermitPlugin(Plugin):
+    """Called before binding to prevent or delay it; returns
+    (Status, timeout_seconds) where a WAIT status parks the pod in the
+    waiting-pods map (interface.go:165-175)."""
+
+    def permit(
+        self, pc: PluginContext, pod: Pod, node_name: str
+    ) -> Tuple[Optional[Status], float]:
+        return None, 0.0
+
+
+class TensorFilterPlugin(Plugin):
+    """TPU-shaped Filter point: transform the whole feasibility mask
+    bool[B, N] after the device launch (returns the new mask).  The batch
+    analog of a Filter plugin — one call per launch, not per (pod, node)."""
+
+    def filter_tensor(self, pc: PluginContext, cluster, pods, mask):
+        return mask
+
+
+class TensorScorePlugin(Plugin):
+    """TPU-shaped Score point: transform the score matrix f32[B, N]."""
+
+    def score_tensor(self, pc: PluginContext, cluster, pods, scores):
+        return scores
+
+
+# -------------------------------------------------------------- waiting map
+
+
+class WaitingPod:
+    """A pod paused in the permit phase (waiting_pods_map.go waitingPod):
+    exactly one verdict is delivered; allow()/reject() return False if a
+    verdict was already set or nobody is waiting."""
+
+    def __init__(self, pod: Pod):
+        self.pod = pod
+        self._event = threading.Event()
+        self._status: Optional[Status] = None
+        self._lock = threading.Lock()
+
+    def get_pod(self) -> Pod:
+        return self.pod
+
+    def _signal(self, status: Status) -> bool:
+        with self._lock:
+            if self._status is not None:
+                return False
+            self._status = status
+            self._event.set()
+            return True
+
+    def allow(self) -> bool:
+        return self._signal(SUCCESS)
+
+    def reject(self, msg: str) -> bool:
+        return self._signal(Status(Code.UNSCHEDULABLE, msg))
+
+    def wait(self, timeout_s: float) -> Status:
+        """Block until a verdict or the timeout; timeout rejects
+        (framework.go RunPermitPlugins wait branch)."""
+        if self._event.wait(timeout=timeout_s):
+            return self._status  # type: ignore[return-value]
+        self._signal(
+            Status(Code.UNSCHEDULABLE, f"pod {self.pod.name} timed out waiting at permit")
+        )
+        return self._status  # type: ignore[return-value]
+
+
+class _WaitingPodsMap:
+    """Thread-safe UID -> WaitingPod map (waiting_pods_map.go)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._pods: Dict[str, WaitingPod] = {}
+
+    @staticmethod
+    def _uid(pod: Pod) -> str:
+        return pod.metadata.uid or f"{pod.namespace}/{pod.name}"
+
+    def add(self, wp: WaitingPod) -> None:
+        with self._lock:
+            self._pods[self._uid(wp.pod)] = wp
+
+    def remove(self, pod: Pod) -> None:
+        with self._lock:
+            self._pods.pop(self._uid(pod), None)
+
+    def get(self, uid: str) -> Optional[WaitingPod]:
+        with self._lock:
+            return self._pods.get(uid)
+
+    def iterate(self, callback: Callable[[WaitingPod], None]) -> None:
+        with self._lock:
+            for wp in list(self._pods.values()):
+                callback(wp)
+
+
+# ------------------------------------------------------------------ registry
+
+
+class Registry(Dict[str, Callable]):
+    """name -> factory(plugin_config, handle) -> Plugin (registry.go:31)."""
+
+    def register(self, name: str, factory: Callable) -> None:
+        if name in self:
+            raise ValueError(f"a plugin named {name} already exists")
+        self[name] = factory
+
+    def unregister(self, name: str) -> None:
+        if name not in self:
+            raise ValueError(f"no plugin named {name} exists")
+        del self[name]
+
+
+# ----------------------------------------------------------------- framework
+
+
+class Framework:
+    """Runs the configured plugin set at each extension point
+    (framework.go:52-60; NewFramework instantiates every registered factory
+    and sorts instances into per-point lists by implemented interface)."""
+
+    def __init__(
+        self,
+        registry: Optional[Registry] = None,
+        plugin_config: Any = None,
+        handle: Any = None,
+    ):
+        self.handle = handle
+        self.waiting_pods = _WaitingPodsMap()
+        self.plugins: Dict[str, Plugin] = {}
+        self.queue_sort_plugins: List[QueueSortPlugin] = []
+        self.reserve_plugins: List[ReservePlugin] = []
+        self.prebind_plugins: List[PrebindPlugin] = []
+        self.unreserve_plugins: List[UnreservePlugin] = []
+        self.permit_plugins: List[PermitPlugin] = []
+        self.tensor_filter_plugins: List[TensorFilterPlugin] = []
+        self.tensor_score_plugins: List[TensorScorePlugin] = []
+        for name, factory in (registry or {}).items():
+            p = factory(plugin_config, self)
+            self.plugins[name] = p
+            if isinstance(p, QueueSortPlugin):
+                self.queue_sort_plugins.append(p)
+            if isinstance(p, ReservePlugin):
+                self.reserve_plugins.append(p)
+            if isinstance(p, PrebindPlugin):
+                self.prebind_plugins.append(p)
+            if isinstance(p, UnreservePlugin):
+                self.unreserve_plugins.append(p)
+            if isinstance(p, PermitPlugin):
+                self.permit_plugins.append(p)
+            if isinstance(p, TensorFilterPlugin):
+                self.tensor_filter_plugins.append(p)
+            if isinstance(p, TensorScorePlugin):
+                self.tensor_score_plugins.append(p)
+        if len(self.queue_sort_plugins) > 1:
+            raise ValueError("only one QueueSort plugin may be enabled")
+
+    # -- FrameworkHandle (interface.go:208-223) --
+
+    def get_waiting_pod(self, uid: str) -> Optional[WaitingPod]:
+        return self.waiting_pods.get(uid)
+
+    def iterate_over_waiting_pods(self, callback) -> None:
+        self.waiting_pods.iterate(callback)
+
+    # -- extension-point runners --
+
+    def queue_sort_func(self) -> Optional[LessFunc]:
+        if not self.queue_sort_plugins:
+            return None
+        return self.queue_sort_plugins[0].less
+
+    def run_reserve_plugins(
+        self, pc: PluginContext, pod: Pod, node_name: str
+    ) -> Status:
+        for pl in self.reserve_plugins:
+            status = pl.reserve(pc, pod, node_name)
+            if _code(status) != Code.SUCCESS:
+                return Status(
+                    Code.ERROR,
+                    f"error while running {pl.name()} reserve plugin for pod "
+                    f"{pod.name}: {status.message if status else ''}",
+                )
+        return SUCCESS
+
+    def run_prebind_plugins(
+        self, pc: PluginContext, pod: Pod, node_name: str
+    ) -> Status:
+        for pl in self.prebind_plugins:
+            status = pl.prebind(pc, pod, node_name)
+            code = _code(status)
+            if code != Code.SUCCESS:
+                msg = status.message if status else ""
+                if code == Code.UNSCHEDULABLE:
+                    return Status(
+                        code, f"rejected by {pl.name()} at prebind: {msg}"
+                    )
+                return Status(
+                    Code.ERROR,
+                    f"error while running {pl.name()} prebind plugin for pod "
+                    f"{pod.name}: {msg}",
+                )
+        return SUCCESS
+
+    def run_unreserve_plugins(
+        self, pc: PluginContext, pod: Pod, node_name: str
+    ) -> None:
+        for pl in self.unreserve_plugins:
+            pl.unreserve(pc, pod, node_name)
+
+    def start_permit(
+        self, pc: PluginContext, pod: Pod, node_name: str
+    ) -> Tuple[Status, Optional[WaitingPod], float]:
+        """Run permit plugins without blocking: returns (status, waiting_pod,
+        timeout).  A WAIT status registers the pod in the waiting-pods map;
+        the caller decides where to block (the reference blocks inside its
+        per-pod bind goroutine — scheduler.py spawns the analogous thread)."""
+        timeout = MAX_PERMIT_TIMEOUT_S
+        wait = False
+        for pl in self.permit_plugins:
+            status, d = pl.permit(pc, pod, node_name)
+            code = _code(status)
+            if code == Code.SUCCESS:
+                continue
+            msg = status.message if status else ""
+            if code == Code.UNSCHEDULABLE:
+                return (
+                    Status(code, f"rejected by {pl.name()} at permit: {msg}"),
+                    None,
+                    0.0,
+                )
+            if code == Code.WAIT:
+                # use the minimum timeout duration (framework.go:176-180)
+                timeout = min(timeout, d if d > 0 else MAX_PERMIT_TIMEOUT_S)
+                wait = True
+            else:
+                return (
+                    Status(
+                        Code.ERROR,
+                        f"error while running {pl.name()} permit plugin for "
+                        f"pod {pod.name}: {msg}",
+                    ),
+                    None,
+                    0.0,
+                )
+        if not wait:
+            return SUCCESS, None, 0.0
+        wp = WaitingPod(pod)
+        self.waiting_pods.add(wp)
+        return Status(Code.WAIT), wp, timeout
+
+    def run_permit_plugins(
+        self, pc: PluginContext, pod: Pod, node_name: str
+    ) -> Status:
+        """The reference's blocking form (framework.go RunPermitPlugins):
+        waits out a WAIT verdict before returning."""
+        status, wp, timeout = self.start_permit(pc, pod, node_name)
+        if wp is None:
+            return status
+        try:
+            return wp.wait(timeout)
+        finally:
+            self.waiting_pods.remove(pod)
+
+    def run_filter_tensor(self, pc: PluginContext, cluster, pods, mask):
+        for pl in self.tensor_filter_plugins:
+            mask = pl.filter_tensor(pc, cluster, pods, mask)
+        return mask
+
+    def run_score_tensor(self, pc: PluginContext, cluster, pods, scores):
+        for pl in self.tensor_score_plugins:
+            scores = pl.score_tensor(pc, cluster, pods, scores)
+        return scores
+
+    @property
+    def has_bind_phase_plugins(self) -> bool:
+        return bool(self.permit_plugins or self.prebind_plugins)

@@ -216,7 +216,11 @@ def make_sequential_scheduler(
 
     @jax.jit
     def schedule(cluster: ClusterTensors, pods: PodBatch, ports: BatchPortState,
-                 last_index0: jnp.ndarray, nominated: Optional[NominatedState] = None):
+                 last_index0: jnp.ndarray, nominated: Optional[NominatedState] = None,
+                 extra_mask=None, extra_score=None):
+        """extra_mask bool[B, N] / extra_score f32[B, N]: the framework's
+        tensor-level Filter/Score plugin outputs, folded into the static
+        pass (one launch total — the TPU-shaped plugin seam)."""
         B = pods.n_pods
         G = cluster.group_counts.shape[1]
         # ---- static pass: every predicate except the dynamic ones, plus the
@@ -244,6 +248,8 @@ def make_sequential_scheduler(
             & cluster.valid[None]
             & pods.valid[:, None]
         )
+        if extra_mask is not None:
+            static_mask = static_mask & extra_mask
         # static score components (state-independent priorities)
         static_score = (
             w[PRIO_INDEX["InterPodAffinityPriority"]] * inter_pod_affinity_score(cluster, pods)
@@ -260,6 +266,8 @@ def make_sequential_scheduler(
             static_score = static_score + w[PRIO_INDEX["ResourceLimitsPriority"]] * resource_limits(
                 cluster, pods
             )
+        if extra_score is not None:
+            static_score = static_score + extra_score
         group_onehot = pod_group_onehot(pods, G)              # [B, G]
 
         def step(state, xs):

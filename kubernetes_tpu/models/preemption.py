@@ -127,6 +127,61 @@ def sorted_victim_slots(pods_priority, pods_valid, pods_node, pod_priority,
     return out
 
 
+def dense_start_ranks(starts) -> np.ndarray:
+    """f32[M] dense ranks of f64 start times: rank comparisons on device are
+    exactly the f64 time comparisons (f32 would quantize epoch seconds to
+    ~128s and merge distinct start times)."""
+    starts = np.asarray(starts, np.float64)
+    _, inv = np.unique(starts, return_inverse=True)
+    return inv.astype(np.float32)
+
+
+def pick_preemption_node(encoder, pod, cands, arena, slots, violating, max_vols):
+    """Shared host driver for the pick -> verify -> veto loop (used by both
+    the scheduler's preempt and the extender's /preempt verb):
+
+      1. preempt_one picks (node, victims) over the extended what-if arrays;
+      2. verify_nomination re-runs the full object-level predicate set with
+         the victims removed (the part the counting what-if cannot model —
+         anti-affinity state);
+      3. a veto masks the node and re-picks.
+
+    Returns (node_row, victim_arena_indices, victim_pods, PreemptionResult)
+    with node_row == -1 when preemption helps nowhere."""
+    pod_req_ext, requested_ext, allocatable_ext, pods_ext = (
+        encoder.preemption_arrays(pod, max_vols)
+    )
+    start_ranks = dense_start_ranks(arena.start)
+    cands = np.asarray(cands).copy()
+    while cands.any():
+        res = preempt_one(
+            requested_ext,
+            allocatable_ext,
+            pod_req_ext,
+            cands,
+            arena.node,
+            arena.priority,
+            pods_ext,
+            violating,
+            start_ranks,
+            slots,
+        )
+        row = int(res.node)
+        if row < 0:
+            return -1, [], [], None
+        victim_ms = np.nonzero(np.asarray(res.victim_mask))[0]
+        victims = [
+            encoder.pods[arena.keys[m]].pod
+            for m in victim_ms
+            if arena.keys[m] in encoder.pods
+            and encoder.pods[arena.keys[m]].pod is not None
+        ]
+        if verify_nomination(encoder, pod, row, victims, max_vols):
+            return row, victim_ms, victims, res
+        cands[row] = False
+    return -1, [], [], None
+
+
 def verify_nomination(encoder, pod, row: int, victims, max_vols) -> bool:
     """Host-side nomination gate: re-run the full object-level predicate set
     on the picked node with the victims removed — the analog of
@@ -192,7 +247,8 @@ def preempt_one(
     pods_priority: jnp.ndarray, # i32[M]
     pods_req: jnp.ndarray,      # f32[M, R'] per-pod usage, extended columns
     pods_violating: jnp.ndarray,  # bool[M] eviction would violate a PDB
-    pods_start: jnp.ndarray,    # f32[M] status.startTime
+    pods_start: jnp.ndarray,    # f32[M] start-time dense ranks
+                                # (dense_start_ranks; order == f64 times)
     victim_slots: jnp.ndarray,  # i32[Kv] from sorted_victim_slots
 ) -> PreemptionResult:
     N = requested.shape[0]

@@ -61,11 +61,36 @@ def _pod_key(pod: Pod) -> Tuple[str, str]:
 _VALID = -1  # index of the valid flag
 
 
+class _CmpKey:
+    """Adapts a framework QueueSort LessFunc to heapq's `<` protocol.
+
+    Ties (neither less) compare equal so list comparison falls through to
+    the FIFO sequence number."""
+
+    __slots__ = ("info", "less")
+
+    def __init__(self, info, less):
+        self.info = info
+        self.less = less
+
+    def __lt__(self, other):
+        return self.less(self.info, other.info)
+
+    def __eq__(self, other):
+        return not self.less(self.info, other.info) and not self.less(
+            other.info, self.info
+        )
+
+
 class PriorityQueue:
     """Blocking pop; thread-safe.  Ordering: higher .spec.priority first, then
-    FIFO by add time (the default queue-sort plugin semantics)."""
+    FIFO by add time (the default queue-sort plugin semantics).  A framework
+    QueueSort plugin's LessFunc (`less`) replaces the default ordering
+    (scheduling_queue.go NewPriorityQueueWithClock activeQComp /
+    framework.QueueSortFunc)."""
 
-    def __init__(self, backoff: Optional[PodBackoff] = None):
+    def __init__(self, backoff: Optional[PodBackoff] = None, less=None):
+        self._less = less
         self._lock = threading.Condition()
         self._counter = itertools.count()
         self._active: List[list] = []          # [-prio, seq, pod, valid]
@@ -89,7 +114,13 @@ class PriorityQueue:
         key = _pod_key(pod)
         if key in self._active_entry:
             return
-        entry = [-pod.spec.priority, next(self._counter), pod, True]
+        if self._less is not None:
+            from kubernetes_tpu.framework.v1alpha1 import PodInfo
+
+            sort_key = _CmpKey(PodInfo(pod, time.monotonic()), self._less)
+        else:
+            sort_key = -pod.spec.priority
+        entry = [sort_key, next(self._counter), pod, True]
         heapq.heappush(self._active, entry)
         self._active_entry[key] = entry
 

@@ -32,7 +32,7 @@ from kubernetes_tpu.models.batched import (
     make_sequential_scheduler,
 )
 from kubernetes_tpu.models.preemption import (
-    preempt_one,
+    pick_preemption_node,
     preemption_candidates,
     sorted_victim_slots,
     verify_nomination,
@@ -90,6 +90,7 @@ class Scheduler:
         config: Optional[SchedulerConfig] = None,
         victim_deleter: Optional[Callable[[Pod], None]] = None,
         pdb_lister: Optional[Callable[[], List[PodDisruptionBudget]]] = None,
+        framework=None,  # framework.v1alpha1.Framework; None = no plugins
     ):
         # NB: PriorityQueue defines __len__, so `queue or PriorityQueue()`
         # would silently replace an *empty* caller-owned queue
@@ -111,6 +112,7 @@ class Scheduler:
             zone_key_id=enc.zone_key,
             score_cfg=prof.score_config if prof is not None else None,
         )
+        self.framework = framework
         # PodPreemptor.DeletePod analog (scheduler.go:319-326); default
         # removes the victim straight from the cache
         self.victim_deleter = victim_deleter or (lambda pod: self.cache.remove_pod(pod))
@@ -147,8 +149,28 @@ class Scheduler:
             )
             cluster, generation = self.cache.snapshot()
         trace.step("encode")
+        fwk = self.framework
+        pc = None
+        extra_mask = extra_score = None
+        if fwk is not None and (fwk.tensor_filter_plugins or fwk.tensor_score_plugins):
+            from kubernetes_tpu.framework.v1alpha1 import PluginContext
+
+            pc = PluginContext()
+            B, N = batch.n_pods, cluster.n_nodes
+            if fwk.tensor_filter_plugins:
+                extra_mask = np.asarray(
+                    fwk.run_filter_tensor(pc, cluster, batch, np.ones((B, N), bool))
+                )
+            if fwk.tensor_score_plugins:
+                extra_score = np.asarray(
+                    fwk.run_score_tensor(
+                        pc, cluster, batch, np.zeros((B, N), np.float32)
+                    ),
+                    np.float32,
+                )
         hosts, _ = self._schedule_fn(
-            cluster, batch, ports, np.int32(self._last_index), nominated
+            cluster, batch, ports, np.int32(self._last_index), nominated,
+            extra_mask, extra_score,
         )
         hosts = np.asarray(hosts)
         self._last_index += len(pods)
@@ -169,20 +191,15 @@ class Scheduler:
             assumed = dataclasses.replace(
                 pod, spec=dataclasses.replace(pod.spec, node_name=node_name)
             )
-            self.cache.assume_pod(assumed)
-            ok = False
-            try:
-                ok = self.binder(assumed, node_name)
-            except Exception:
-                ok = False
-            if not ok:
-                self.cache.forget_pod(assumed)
-                self.queue.add_unschedulable(pod, cycle)
-                results.append(ScheduleResult(pod, None, generation))
-                fit_errors.append(pod)
-            else:
+            # post-assume failures (permit/prebind/bind) requeue WITHOUT
+            # preemption: the reference preempts only on a scheduling
+            # FitError (scheduler.go:463: `if fitError, ok := err.(...)`),
+            # not on binding hiccups for a pod that fits somewhere
+            if self._reserve_and_bind(pod, assumed, node_name, cycle):
                 self.queue.delete_nominated_pod_if_exists(pod)
                 results.append(ScheduleResult(pod, node_name, generation))
+            else:
+                results.append(ScheduleResult(pod, None, generation))
         trace.step("commit")
         if not self.config.disable_preemption:
             for pod in fit_errors:
@@ -191,6 +208,79 @@ class Scheduler:
         trace.log_if_long(0.1)
         self.results.extend(results)
         return results
+
+    # ------------------------------------------------- reserve/permit/bind
+
+    def _reserve_and_bind(
+        self, pod: Pod, assumed: Pod, node_name: str, cycle: int
+    ) -> bool:
+        """Framework extension points around assume->bind (scheduleOne,
+        scheduler.go:507-580): Reserve -> assume -> Permit -> Prebind ->
+        bind, with Unreserve + ForgetPod + requeue on any later rejection."""
+        fwk = self.framework
+        pc = None
+        if fwk is not None:
+            from kubernetes_tpu.framework.v1alpha1 import PluginContext
+
+            pc = PluginContext()
+            st = fwk.run_reserve_plugins(pc, assumed, node_name)
+            if not st.is_success():
+                # reserve failure is an internal error: requeue, no preemption
+                self.queue.add_unschedulable(pod, cycle)
+                return False
+        self.cache.assume_pod(assumed)
+        if fwk is not None and fwk.permit_plugins:
+            status, wp, timeout = fwk.start_permit(pc, assumed, node_name)
+            if wp is not None:
+                # the GOROUTINE BOUNDARY (scheduler.go:523): binding of a
+                # waiting pod completes asynchronously; the cycle moves on
+                # with the pod optimistically assumed
+                threading.Thread(
+                    target=self._finish_waiting_pod,
+                    args=(fwk, pc, pod, assumed, node_name, cycle, wp, timeout),
+                    daemon=True,
+                ).start()
+                return True
+            if not status.is_success():
+                self._reject_assumed(fwk, pc, pod, assumed, node_name, cycle)
+                return False
+        return self._prebind_and_bind(fwk, pc, pod, assumed, node_name, cycle)
+
+    def _prebind_and_bind(self, fwk, pc, pod, assumed, node_name, cycle) -> bool:
+        if fwk is not None and fwk.prebind_plugins:
+            st = fwk.run_prebind_plugins(pc, assumed, node_name)
+            if not st.is_success():
+                self._reject_assumed(fwk, pc, pod, assumed, node_name, cycle)
+                return False
+        ok = False
+        try:
+            ok = self.binder(assumed, node_name)
+        except Exception:
+            ok = False
+        if not ok:
+            self._reject_assumed(fwk, pc, pod, assumed, node_name, cycle)
+            return False
+        return True
+
+    def _reject_assumed(self, fwk, pc, pod, assumed, node_name, cycle) -> None:
+        """Rollback for a pod rejected after assume (scheduler.go:416-426
+        ForgetPod + MakeDefaultErrorFunc requeue + unreserve plugins)."""
+        self.cache.forget_pod(assumed)
+        if fwk is not None:
+            fwk.run_unreserve_plugins(pc, assumed, node_name)
+        self.queue.add_unschedulable(pod, cycle)
+
+    def _finish_waiting_pod(
+        self, fwk, pc, pod, assumed, node_name, cycle, wp, timeout
+    ) -> None:
+        try:
+            st = wp.wait(timeout)
+        finally:
+            fwk.waiting_pods.remove(assumed)
+        if st.is_success():
+            self._prebind_and_bind(fwk, pc, pod, assumed, node_name, cycle)
+        else:
+            self._reject_assumed(fwk, pc, pod, assumed, node_name, cycle)
 
     # ---------------------------------------------------------- preemption
 
@@ -227,9 +317,6 @@ class Scheduler:
                 return None
             arena = enc.pods_snapshot()
             violating = self._pdb_violating_flags(enc, len(arena.node))
-            pod_req_ext, requested_ext, allocatable_ext, pods_ext = (
-                enc.preemption_arrays(pod, self.config.filter_config.max_vols)
-            )
             slots = sorted_victim_slots(
                 arena.priority,
                 arena.valid,
@@ -238,36 +325,10 @@ class Scheduler:
                 violating,
                 arena.start,
             )
-            victims: List[Pod] = []
-            row = -1
-            while cands.any():
-                res = preempt_one(
-                    requested_ext,
-                    allocatable_ext,
-                    pod_req_ext,
-                    cands,
-                    arena.node,
-                    arena.priority,
-                    pods_ext,
-                    violating,
-                    arena.start,
-                    slots,
-                )
-                row = int(res.node)
-                if row < 0:
-                    self._clear_nomination(pod)
-                    return None
-                victims = [
-                    enc.pods[arena.keys[m]].pod
-                    for m in np.nonzero(np.asarray(res.victim_mask))[0]
-                    if arena.keys[m] in enc.pods and enc.pods[arena.keys[m]].pod
-                ]
-                if self._verify_preemption(pod, row, victims):
-                    break
-                # device what-if can't see anti-affinity state; a host veto
-                # masks the node and re-picks (rare)
-                cands[row] = False
-                row = -1
+            row, _, victims, _ = pick_preemption_node(
+                enc, pod, cands, arena, slots, violating,
+                self.config.filter_config.max_vols,
+            )
             if row < 0:
                 self._clear_nomination(pod)
                 return None

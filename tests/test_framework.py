@@ -1,0 +1,228 @@
+"""Framework plugin API: extension points around assume->bind.
+
+Mirrors the reference's framework tests (pkg/scheduler/framework/v1alpha1,
+test/integration/scheduler/framework_test.go): a Permit plugin delaying a pod
+via "wait" + Allow/Reject, a Prebind rejection causing ForgetPod + requeue,
+QueueSort replacing the pop order, and the TPU-shaped tensor Filter/Score
+points steering the device launch.
+"""
+
+import time
+
+import numpy as np
+
+from kubernetes_tpu.framework.v1alpha1 import (
+    Code,
+    Framework,
+    PermitPlugin,
+    PrebindPlugin,
+    QueueSortPlugin,
+    Registry,
+    ReservePlugin,
+    Status,
+    TensorFilterPlugin,
+    TensorScorePlugin,
+    UnreservePlugin,
+)
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.queue import PodBackoff, PriorityQueue
+from kubernetes_tpu.runtime.scheduler import Scheduler
+
+from fixtures import make_node, make_pod
+
+
+class _Recorder(ReservePlugin, UnreservePlugin):
+    NAME = "recorder"
+
+    def __init__(self):
+        self.reserved = []
+        self.unreserved = []
+
+    def reserve(self, pc, pod, node_name):
+        self.reserved.append((pod.name, node_name))
+        return None
+
+    def unreserve(self, pc, pod, node_name):
+        self.unreserved.append((pod.name, node_name))
+
+
+def _sched(registry, **kw):
+    bound = []
+    fwk = Framework(registry)
+    cache = SchedulerCache()
+    queue = PriorityQueue(
+        backoff=PodBackoff(initial=0.01, max_duration=0.05),
+        less=fwk.queue_sort_func(),
+    )
+    sched = Scheduler(
+        cache=cache,
+        queue=queue,
+        binder=lambda pod, node: bound.append((pod.name, node)) or True,
+        framework=fwk,
+        **kw,
+    )
+    cache.add_node(make_node("n1", cpu="4", mem="8Gi"))
+    cache.add_node(make_node("n2", cpu="4", mem="8Gi"))
+    return sched, fwk, cache, queue, bound
+
+
+def test_reserve_and_unreserve_on_prebind_reject():
+    rec = _Recorder()
+
+    class Rejector(PrebindPlugin):
+        NAME = "rejector"
+
+        def __init__(self):
+            self.calls = 0
+
+        def prebind(self, pc, pod, node_name):
+            self.calls += 1
+            if pod.name == "bad":
+                return Status(Code.UNSCHEDULABLE, "computer says no")
+            return None
+
+    rej = Rejector()
+    reg = Registry()
+    reg.register("recorder", lambda cfg, h: rec)
+    reg.register("rejector", lambda cfg, h: rej)
+    sched, fwk, cache, queue, bound = _sched(reg)
+    queue.add(make_pod("good", cpu="100m"))
+    queue.add(make_pod("bad", cpu="100m"))
+    sched.run_once(timeout=0.2)
+    assert ("good", bound[0][1]) in bound
+    assert all(name != "bad" for name, _ in bound)
+    # the rejected pod was unreserved and forgotten
+    assert any(name == "bad" for name, _ in rec.unreserved)
+    assert ("default", "bad") not in cache.encoder.pods
+    # and requeued (unschedulable or backoff)
+    assert len(queue) == 1
+
+
+def test_permit_wait_then_allow():
+    class Waiter(PermitPlugin):
+        NAME = "waiter"
+
+        def permit(self, pc, pod, node_name):
+            if pod.name == "delayed":
+                return Status(Code.WAIT), 5.0
+            return None, 0.0
+
+    reg = Registry()
+    reg.register("waiter", lambda cfg, h: Waiter())
+    sched, fwk, cache, queue, bound = _sched(reg)
+    queue.add(make_pod("delayed", cpu="100m"))
+    sched.run_once(timeout=0.2)
+    assert bound == []  # parked at permit
+    wp = fwk.get_waiting_pod("default/delayed")
+    assert wp is not None and wp.get_pod().name == "delayed"
+    assert wp.allow()
+    deadline = time.monotonic() + 2.0
+    while not bound and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert bound and bound[0][0] == "delayed"
+
+
+def test_permit_wait_reject_requeues():
+    rec = _Recorder()
+
+    class Waiter(PermitPlugin):
+        NAME = "waiter"
+
+        def permit(self, pc, pod, node_name):
+            return Status(Code.WAIT), 5.0
+
+    reg = Registry()
+    reg.register("recorder", lambda cfg, h: rec)
+    reg.register("waiter", lambda cfg, h: Waiter())
+    sched, fwk, cache, queue, bound = _sched(reg)
+    queue.add(make_pod("doomed", cpu="100m"))
+    sched.run_once(timeout=0.2)
+    wp = fwk.get_waiting_pod("default/doomed")
+    assert wp.reject("no entry")
+    deadline = time.monotonic() + 2.0
+    while not rec.unreserved and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert rec.unreserved and rec.unreserved[0][0] == "doomed"
+    assert bound == []
+    assert ("default", "doomed") not in cache.encoder.pods
+
+
+def test_permit_wait_timeout_rejects():
+    class Waiter(PermitPlugin):
+        NAME = "waiter"
+
+        def permit(self, pc, pod, node_name):
+            return Status(Code.WAIT), 0.05  # 50ms
+
+    rec = _Recorder()
+    reg = Registry()
+    reg.register("recorder", lambda cfg, h: rec)
+    reg.register("waiter", lambda cfg, h: Waiter())
+    sched, fwk, cache, queue, bound = _sched(reg)
+    queue.add(make_pod("late", cpu="100m"))
+    sched.run_once(timeout=0.2)
+    deadline = time.monotonic() + 2.0
+    while not rec.unreserved and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert rec.unreserved and bound == []
+
+
+def test_queue_sort_plugin_overrides_order():
+    class LowestFirst(QueueSortPlugin):
+        NAME = "lowest-first"
+
+        def less(self, pi1, pi2):
+            return pi1.pod.spec.priority < pi2.pod.spec.priority
+
+    reg = Registry()
+    reg.register("lowest-first", lambda cfg, h: LowestFirst())
+    fwk = Framework(reg)
+    q = PriorityQueue(less=fwk.queue_sort_func())
+    q.add(make_pod("high", priority=100))
+    q.add(make_pod("low", priority=1))
+    q.add(make_pod("mid", priority=50))
+    assert [q.pop(0.1).name for _ in range(3)] == ["low", "mid", "high"]
+
+
+def test_tensor_filter_and_score_plugins():
+    class VetoN1(TensorFilterPlugin):
+        NAME = "veto-n1"
+
+        def __init__(self, row):
+            self.row = row
+
+        def filter_tensor(self, pc, cluster, pods, mask):
+            mask = np.asarray(mask).copy()
+            mask[:, self.row] = False
+            return mask
+
+    class FavorN2(TensorScorePlugin):
+        NAME = "favor-n2"
+
+        def __init__(self, row):
+            self.row = row
+
+        def score_tensor(self, pc, cluster, pods, scores):
+            scores = np.asarray(scores).copy()
+            scores[:, self.row] += 1000.0
+            return scores
+
+    # veto: both nodes fit, n1 vetoed -> everything lands on n2
+    reg = Registry()
+    sched, fwk, cache, queue, bound = _sched(reg)
+    row1 = cache.encoder.node_rows["n1"]
+    fwk.tensor_filter_plugins.append(VetoN1(row1))
+    queue.add(make_pod("a", cpu="100m"))
+    queue.add(make_pod("b", cpu="100m"))
+    sched.run_once(timeout=0.2)
+    assert {n for _, n in bound} == {"n2"}
+
+    # score: fresh scheduler, n1 boosted -> everything lands on n1
+    reg2 = Registry()
+    sched2, fwk2, cache2, queue2, bound2 = _sched(reg2)
+    row1b = cache2.encoder.node_rows["n1"]
+    fwk2.tensor_score_plugins.append(FavorN2(row1b))
+    queue2.add(make_pod("c", cpu="100m"))
+    queue2.add(make_pod("d", cpu="100m"))
+    sched2.run_once(timeout=0.2)
+    assert {n for _, n in bound2} == {"n1"}
