@@ -77,8 +77,11 @@ LessFunc = Callable[[PodInfo, PodInfo], bool]
 
 class PluginContext:
     """Per-scheduling-cycle key/value store shared by plugins
-    (context.go ContextData); thread-safe because permit waits and binds may
-    run off-thread."""
+    (context.go ContextData); one instance spans every extension point of a
+    cycle — in the batched scheduler, a cycle is one batch, so a plugin
+    writing at the tensor Filter point can read at Prebind (namespace keys
+    per pod if per-pod data is stored).  Thread-safe because permit waits
+    and binds may run off-thread."""
 
     def __init__(self):
         self._lock = threading.RLock()

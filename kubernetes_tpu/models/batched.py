@@ -102,6 +102,87 @@ jax.tree_util.register_dataclass(
 )
 
 
+@dataclass
+class BatchAffinityState:
+    """In-batch inter-pod-affinity cross-match tensors.
+
+    The per-pod pair tensors in PodBatch are computed against the PRE-batch
+    snapshot; these matrices let the sequential-commit scan update affinity
+    state as co-batched pods land (the tensorization of predicateMetadata's
+    incremental AddPod, ref algorithm/predicates/metadata.go:64-94), so pod
+    i+1's MatchInterPodAffinity sees pod i's placement.
+
+    Orientation: step axis first.  aff_match[j, i, t] = "batch pod j matches
+    pod i's required-affinity term t" (namespaces + selector); anti_match
+    likewise for pod i's anti terms; anti_own[j, t, i] = "pod i matches pod
+    j's anti term t" (the committed pod's anti-affinity forbids later
+    matching pods from its topology domains)."""
+
+    aff_match: Any   # bool[B, B, PT]
+    anti_match: Any  # bool[B, B, AT]
+    anti_own: Any    # bool[B, AT, B]
+    aff_own: Any     # bool[B, PT, B]  [j, t, i]: i matches j's aff term t
+                     # (hard-affinity symmetric score, encoder K_AFF_REQ)
+
+
+jax.tree_util.register_dataclass(
+    BatchAffinityState,
+    data_fields=["aff_match", "anti_match", "anti_own", "aff_own"],
+    meta_fields=[],
+)
+
+
+def batch_has_required_affinity(pods: Sequence) -> bool:
+    """True if any pod carries required (anti-)affinity terms — the signal
+    to run the affinity-aware scan variant (costlier; only paid when
+    needed)."""
+    for p in pods:
+        a = p.spec.affinity
+        if a is not None and (
+            (a.pod_affinity is not None and a.pod_affinity.required)
+            or (a.pod_anti_affinity is not None and a.pod_anti_affinity.required)
+        ):
+            return True
+    return False
+
+
+def encode_batch_affinity(encoder, pods: Sequence) -> BatchAffinityState:
+    """Host-side precompute of the in-batch cross-match tensors; term slot
+    order matches SnapshotEncoder._encode_pod_affinity (required[:PT] /
+    required[:AT] in spec order)."""
+    from kubernetes_tpu.api import labels as klabels
+
+    d = encoder.dims
+    B = _pow2(max(len(pods), 1, d.B))
+    A = np.zeros((B, d.PT, B), bool)   # [owner i, term t, candidate j]
+    N = np.zeros((B, d.AT, B), bool)
+
+    def _fill(out, terms, i, owner):
+        for t, term in enumerate(terms):
+            sel = klabels.selector_from_label_selector(term.label_selector)
+            if sel is None:
+                continue
+            nss = term.namespaces or (owner.namespace,)
+            for j, other in enumerate(pods):
+                if other.namespace in nss and sel.matches(other.labels):
+                    out[i, t, j] = True
+
+    for i, pod in enumerate(pods):
+        a = pod.spec.affinity
+        if a is None:
+            continue
+        if a.pod_affinity is not None:
+            _fill(A, a.pod_affinity.required[: d.PT], i, pod)
+        if a.pod_anti_affinity is not None:
+            _fill(N, a.pod_anti_affinity.required[: d.AT], i, pod)
+    return BatchAffinityState(
+        aff_match=A.transpose(2, 0, 1),   # [step j, i, t]
+        anti_match=N.transpose(2, 0, 1),  # [step j, i, t]
+        anti_own=N,                       # [step j(owner), t, i]
+        aff_own=A,                        # [step j(owner), t, i]
+    )
+
+
 def encode_nominated(encoder, nominated_pairs, k_min: int = 8):
     """Host helper: (pod, node_name) pairs -> NominatedState (power-of-two
     padded), or None when empty."""
@@ -217,10 +298,16 @@ def make_sequential_scheduler(
     @jax.jit
     def schedule(cluster: ClusterTensors, pods: PodBatch, ports: BatchPortState,
                  last_index0: jnp.ndarray, nominated: Optional[NominatedState] = None,
-                 extra_mask=None, extra_score=None):
+                 extra_mask=None, extra_score=None,
+                 aff_state: Optional[BatchAffinityState] = None):
         """extra_mask bool[B, N] / extra_score f32[B, N]: the framework's
         tensor-level Filter/Score plugin outputs, folded into the static
-        pass (one launch total — the TPU-shaped plugin seam)."""
+        pass (one launch total — the TPU-shaped plugin seam).
+
+        aff_state: in-batch affinity cross-matches; when given,
+        MatchInterPodAffinity moves from the static pass into the scan with
+        carried per-topology-pair extras, so co-batched pods see each
+        other's placements (kills the batch>1 affinity-blindness gap)."""
         B = pods.n_pods
         G = cluster.group_counts.shape[1]
         # ---- static pass: every predicate except the dynamic ones, plus the
@@ -235,6 +322,12 @@ def make_sequential_scheduler(
         non_resource = jnp.ones((per_pred.shape[1],), bool)
         non_resource = non_resource.at[res_idx].set(False)
         non_resource = non_resource.at[gen_idx].set(False)
+        if aff_state is not None:
+            # affinity is re-evaluated per step against (static | in-batch)
+            # pair state instead of statically
+            non_resource = non_resource.at[PRED_INDEX["MatchInterPodAffinity"]].set(
+                False
+            )
         static_mask = jnp.all(per_pred | ~non_resource[None, :, None], axis=1)
         # GeneralPredicates minus resources = host+ports+selector
         host_idx = PRED_INDEX["PodFitsHost"]
@@ -250,9 +343,16 @@ def make_sequential_scheduler(
         )
         if extra_mask is not None:
             static_mask = static_mask & extra_mask
-        # static score components (state-independent priorities)
+        # static score components (state-independent priorities); with
+        # in-batch affinity the IPA score moves into the scan (its raw pair
+        # weights gain in-batch contributions and must renormalize)
         static_score = (
-            w[PRIO_INDEX["InterPodAffinityPriority"]] * inter_pod_affinity_score(cluster, pods)
+            (
+                0.0
+                if aff_state is not None
+                else w[PRIO_INDEX["InterPodAffinityPriority"]]
+                * inter_pod_affinity_score(cluster, pods)
+            )
             + w[PRIO_INDEX["NodePreferAvoidPodsPriority"]] * node_prefer_avoid_pods(cluster, pods)
             + w[PRIO_INDEX["NodeAffinityPriority"]] * node_affinity(cluster, pods)
             + w[PRIO_INDEX["TaintTolerationPriority"]] * taint_toleration(cluster, pods)
@@ -270,9 +370,24 @@ def make_sequential_scheduler(
             static_score = static_score + extra_score
         group_onehot = pod_group_onehot(pods, G)              # [B, G]
 
+        topo = cluster.topo_pairs.astype(jnp.float32)         # [N, TP]
+        TP = topo.shape[1]
+        if aff_state is not None:
+            aff_key_pairs = (
+                pods.aff_term_topo_key[:, :, None] == cluster.pair_topo_key[None, None]
+            )                                                 # [B, PT, TP]
+            anti_key_pairs = (
+                pods.anti_term_topo_key[:, :, None] == cluster.pair_topo_key[None, None]
+            )                                                 # [B, AT, TP]
+
+        w_ipa = float(w[PRIO_INDEX["InterPodAffinityPriority"]])
+        hard_w = float(cfg.hard_pod_affinity_weight)
+
         def step(state, xs):
-            requested, nonzero2, group_counts, port_used, last_idx = state
-            smask, sscore, req, nz2, gonehot, pprio, pport = xs
+            (requested, nonzero2, group_counts, port_used, last_idx,
+             extra_aff, extra_anti, extra_forb, extra_pref) = state
+            (smask, sscore, req, nz2, gonehot, pprio, pport, step_no,
+             aff_xs) = xs
             # dynamic resource fit (PodFitsResources on current state)
             fit = ~jnp.any(
                 (req[None, :] > 0)
@@ -305,6 +420,25 @@ def make_sequential_scheduler(
             claimed_conflict = (port_used.astype(jnp.float32) @ ports.conflict.astype(jnp.float32)) > 0
             port_bad = jnp.any(pport[None, :] & claimed_conflict, axis=-1)
             mask = smask & fit & ~port_bad
+            if aff_state is not None:
+                # MatchInterPodAffinity against (pre-batch | in-batch) state
+                (aff_pairs_j, aff_valid_j, aff_self_j, aff_key_j,
+                 anti_pairs_j, anti_valid_j, anti_key_j, forb_j,
+                 pref_w_j, aff_match_j, anti_match_j, anti_own_j,
+                 aff_own_j) = aff_xs
+                aff_pairs = aff_pairs_j | extra_aff[step_no]       # [PT, TP]
+                aff_hit = (aff_pairs.astype(jnp.float32) @ topo.T) > 0   # [PT, N]
+                any_match = jnp.any(aff_pairs, axis=-1)            # [PT]
+                node_has_key = (aff_key_j.astype(jnp.float32) @ topo.T) > 0
+                bootstrap = ~any_match[:, None] & aff_self_j[:, None] & node_has_key
+                term_ok = aff_hit | bootstrap | ~aff_valid_j[:, None]
+                aff_ok = jnp.all(term_ok, axis=0)                  # [N]
+                anti_pairs = anti_pairs_j | extra_anti[step_no]
+                anti_hit = (anti_pairs.astype(jnp.float32) @ topo.T) > 0
+                viol2 = jnp.any(anti_hit & anti_valid_j[:, None], axis=0)
+                forb = forb_j | extra_forb[step_no]
+                viol1 = (forb.astype(jnp.float32) @ topo.T) > 0    # [N]
+                mask = mask & aff_ok & ~viol1 & ~viol2
             least, most, balanced, spread, rtc = _dynamic_scores(
                 cluster, nz2, nonzero2, zone_key_id, group_counts, gonehot,
                 rtc_xs, rtc_ys,
@@ -317,6 +451,20 @@ def make_sequential_scheduler(
                 + w_spread * spread
                 + w_rtc * rtc
             )
+            if aff_state is not None:
+                # IPA score over (pre-batch | in-batch) raw pair weights,
+                # renormalized per step (interpod_affinity.go fScore)
+                raw = (pref_w_j + extra_pref[step_no]) @ topo.T    # [N]
+                big = jnp.float32(3.4e38)
+                mn = jnp.min(jnp.where(cluster.valid, raw, big))
+                mx = jnp.max(jnp.where(cluster.valid, raw, -big))
+                spread_r = mx - mn
+                ipa = jnp.where(
+                    spread_r > 0,
+                    jnp.floor(MAX_PRIORITY * (raw - mn) / spread_r),
+                    0.0,
+                )
+                total = total + w_ipa * jnp.where(cluster.valid, ipa, 0.0)
             host, feasible = select_host(total, mask, last_idx)
             # commit
             commit = feasible
@@ -325,17 +473,74 @@ def make_sequential_scheduler(
             nonzero2 = nonzero2 + onehot[:, None] * nz2[None, :]
             group_counts = group_counts + onehot[:, None] * gonehot[None, :]
             port_used = port_used | (onehot[:, None] & pport[None, :])
+            if aff_state is not None:
+                # predicateMetadata.AddPod analog: the committed pod's
+                # topology pairs flow into later pods' affinity state
+                node_pairs = (onehot.astype(jnp.float32) @ topo) > 0   # [TP]
+                extra_aff = extra_aff | (
+                    aff_match_j[:, :, None] & aff_key_pairs & node_pairs[None, None]
+                )
+                extra_anti = extra_anti | (
+                    anti_match_j[:, :, None] & anti_key_pairs & node_pairs[None, None]
+                )
+                forb_contrib = jnp.einsum(
+                    "tb,tp->bp",
+                    anti_own_j.astype(jnp.float32),
+                    (anti_key_j & node_pairs[None]).astype(jnp.float32),
+                ) > 0
+                extra_forb = extra_forb | forb_contrib
+                # hard-affinity symmetry: the committed pod's required
+                # affinity terms add hard_w per matching later pod per pair
+                # (encoder K_AFF_REQ group semantics)
+                extra_pref = extra_pref + hard_w * jnp.einsum(
+                    "tb,tp->bp",
+                    aff_own_j.astype(jnp.float32),
+                    (aff_key_j & node_pairs[None]).astype(jnp.float32),
+                )
             out_host = jnp.where(feasible, host, -1)
-            return (requested, nonzero2, group_counts, port_used, last_idx + 1), out_host
+            return (
+                (requested, nonzero2, group_counts, port_used, last_idx + 1,
+                 extra_aff, extra_anti, extra_forb, extra_pref),
+                out_host,
+            )
 
         PV = ports.pod_ports.shape[1]
+        PT = pods.aff_term_pairs.shape[1]
+        AT = pods.anti_term_pairs.shape[1]
+        if aff_state is not None:
+            extras_init = (
+                jnp.zeros((B, PT, TP), bool),
+                jnp.zeros((B, AT, TP), bool),
+                jnp.zeros((B, TP), bool),
+                jnp.zeros((B, TP), jnp.float32),
+            )
+        else:  # unused: scalar placeholders keep the carry structure cheap
+            extras_init = tuple(jnp.zeros(()) for _ in range(4))
         init = (
             cluster.requested,
             cluster.nonzero_req,
             cluster.group_counts,
             jnp.zeros((cluster.n_nodes, PV), bool),
             last_index0.astype(jnp.int32),
-        )
+        ) + extras_init
+        if aff_state is not None:
+            aff_xs_in = (
+                pods.aff_term_pairs,
+                pods.aff_term_valid,
+                pods.aff_term_self,
+                aff_key_pairs,
+                pods.anti_term_pairs,
+                pods.anti_term_valid,
+                anti_key_pairs,
+                pods.forbidden_pairs,
+                pods.pref_pair_weights,
+                aff_state.aff_match,
+                aff_state.anti_match,
+                aff_state.anti_own,
+                aff_state.aff_own,
+            )
+        else:
+            aff_xs_in = None
         xs = (
             static_mask,
             static_score,
@@ -344,8 +549,10 @@ def make_sequential_scheduler(
             group_onehot,
             pods.priority,
             ports.pod_ports,
+            jnp.arange(B, dtype=jnp.int32),
+            aff_xs_in,
         )
-        (requested, nonzero2, group_counts, _, _), hosts = jax.lax.scan(step, init, xs)
+        (requested, nonzero2, group_counts, *_), hosts = jax.lax.scan(step, init, xs)
         import dataclasses as _dc
 
         new_cluster = _dc.replace(

@@ -50,7 +50,11 @@ class GangScheduler:
     ) -> Tuple[Optional[List[str]], int]:
         """Returns (node names per pod, n_placed) — names is None if the gang
         did not reach min_member and nothing was committed."""
-        from kubernetes_tpu.models.batched import encode_batch_ports
+        from kubernetes_tpu.models.batched import (
+            batch_has_required_affinity,
+            encode_batch_affinity,
+            encode_batch_ports,
+        )
 
         sched = self.scheduler
         enc = sched.cache.encoder
@@ -58,9 +62,17 @@ class GangScheduler:
         with sched.cache._lock:
             batch = enc.encode_pods(pods)
             ports = encode_batch_ports(enc, pods, enc.dims.N)
+            # gangs with mutual required (anti-)affinity need the in-batch
+            # affinity state exactly like any other batch
+            aff_state = (
+                encode_batch_affinity(enc, pods)
+                if batch_has_required_affinity(pods)
+                else None
+            )
             cluster, _ = sched.cache.snapshot()
         hosts, _new_state = sched._schedule_fn(
-            cluster, batch, ports, np.int32(sched._last_index)
+            cluster, batch, ports, np.int32(sched._last_index), None, None, None,
+            aff_state,
         )
         sched._last_index += len(pods)
         hosts = np.asarray(hosts)[: len(pods)]

@@ -27,6 +27,8 @@ import numpy as np
 from kubernetes_tpu.api.types import Pod, PodDisruptionBudget
 from kubernetes_tpu.codec.schema import FilterConfig
 from kubernetes_tpu.models.batched import (
+    batch_has_required_affinity,
+    encode_batch_affinity,
     encode_batch_ports,
     encode_nominated,
     make_sequential_scheduler,
@@ -137,6 +139,13 @@ class Scheduler:
         with self.cache._lock:
             batch = enc.encode_pods(pods)
             ports = encode_batch_ports(enc, pods, enc.dims.N)
+            # in-batch affinity state only when some pod carries required
+            # (anti-)affinity — the plain path stays cheap
+            aff_state = (
+                encode_batch_affinity(enc, pods)
+                if batch_has_required_affinity(pods)
+                else None
+            )
             # two-pass evaluation: nominated pods (other than those being
             # scheduled now) are added to their nominated nodes in pass one
             nominated = encode_nominated(
@@ -152,10 +161,14 @@ class Scheduler:
         fwk = self.framework
         pc = None
         extra_mask = extra_score = None
-        if fwk is not None and (fwk.tensor_filter_plugins or fwk.tensor_score_plugins):
+        if fwk is not None:
+            # ONE PluginContext per cycle, shared across every extension
+            # point (the CycleState pattern: a plugin computes at the tensor
+            # Filter point and consumes at Prebind)
             from kubernetes_tpu.framework.v1alpha1 import PluginContext
 
             pc = PluginContext()
+        if fwk is not None and (fwk.tensor_filter_plugins or fwk.tensor_score_plugins):
             B, N = batch.n_pods, cluster.n_nodes
             if fwk.tensor_filter_plugins:
                 extra_mask = np.asarray(
@@ -170,7 +183,7 @@ class Scheduler:
                 )
         hosts, _ = self._schedule_fn(
             cluster, batch, ports, np.int32(self._last_index), nominated,
-            extra_mask, extra_score,
+            extra_mask, extra_score, aff_state,
         )
         hosts = np.asarray(hosts)
         self._last_index += len(pods)
@@ -195,7 +208,7 @@ class Scheduler:
             # preemption: the reference preempts only on a scheduling
             # FitError (scheduler.go:463: `if fitError, ok := err.(...)`),
             # not on binding hiccups for a pod that fits somewhere
-            if self._reserve_and_bind(pod, assumed, node_name, cycle):
+            if self._reserve_and_bind(pod, assumed, node_name, cycle, pc):
                 self.queue.delete_nominated_pod_if_exists(pod)
                 results.append(ScheduleResult(pod, node_name, generation))
             else:
@@ -212,17 +225,14 @@ class Scheduler:
     # ------------------------------------------------- reserve/permit/bind
 
     def _reserve_and_bind(
-        self, pod: Pod, assumed: Pod, node_name: str, cycle: int
+        self, pod: Pod, assumed: Pod, node_name: str, cycle: int, pc=None
     ) -> bool:
         """Framework extension points around assume->bind (scheduleOne,
         scheduler.go:507-580): Reserve -> assume -> Permit -> Prebind ->
-        bind, with Unreserve + ForgetPod + requeue on any later rejection."""
+        bind, with Unreserve + ForgetPod + requeue on any later rejection.
+        `pc` is the cycle's shared PluginContext (from schedule_cycle)."""
         fwk = self.framework
-        pc = None
         if fwk is not None:
-            from kubernetes_tpu.framework.v1alpha1 import PluginContext
-
-            pc = PluginContext()
             st = fwk.run_reserve_plugins(pc, assumed, node_name)
             if not st.is_success():
                 # reserve failure is an internal error: requeue, no preemption

@@ -1,0 +1,249 @@
+"""In-batch inter-pod affinity: one batch == pod-by-pod scheduling.
+
+VERDICT/PARITY delta 2: pair tensors are precomputed against the pre-batch
+snapshot, so without the scan-carried extras, co-batched pods silently
+ignore each other's (anti-)affinity.  These tests schedule affinity chains
+in a SINGLE batch and assert placements equal batch=1 sequential scheduling
+and the cpuref golden (reference semantics: metadata.go:64-94 AddPod).
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.cpuref import CPUScheduler
+from kubernetes_tpu.models.batched import (
+    batch_has_required_affinity,
+    encode_batch_affinity,
+    encode_batch_ports,
+    make_sequential_scheduler,
+)
+
+from fixtures import TEST_DIMS, ZONE_KEY, make_node, make_pod
+
+import dataclasses
+
+
+def _run_batch(nodes, pending, existing=(), services=()):
+    """One-launch batch placement with in-batch affinity state."""
+    enc = SnapshotEncoder(TEST_DIMS)
+    for n in nodes:
+        enc.add_node(n)
+    for ns, sel in services:
+        enc.add_spread_selector(ns, sel)
+    for p in existing:
+        enc.add_pod(p)
+    batch = enc.encode_pods(pending)
+    cluster = enc.snapshot()
+    ports = encode_batch_ports(enc, pending, enc.dims.N)
+    aff = encode_batch_affinity(enc, pending)
+    fn = make_sequential_scheduler(zone_key_id=enc.zone_key)
+    hosts, _ = fn(cluster, batch, ports, np.int32(0), None, None, None, aff)
+    hosts = np.asarray(hosts)
+    row_names = {row: name for name, row in enc.node_rows.items()}
+    return [
+        row_names[int(hosts[i])] if int(hosts[i]) >= 0 else None
+        for i in range(len(pending))
+    ]
+
+
+def _run_sequential(nodes, pending, existing=(), services=()):
+    """batch=1 golden path: commit each pod to the encoder before the next."""
+    enc = SnapshotEncoder(TEST_DIMS)
+    for n in nodes:
+        enc.add_node(n)
+    for ns, sel in services:
+        enc.add_spread_selector(ns, sel)
+    for p in existing:
+        enc.add_pod(p)
+    fn = make_sequential_scheduler(zone_key_id=enc.zone_key)
+    out = []
+    row_names = lambda: {row: name for name, row in enc.node_rows.items()}
+    for i, pod in enumerate(pending):
+        batch = enc.encode_pods([pod])
+        cluster = enc.snapshot()
+        ports = encode_batch_ports(enc, [pod], enc.dims.N)
+        hosts, _ = fn(cluster, batch, ports, np.int32(i))
+        row = int(np.asarray(hosts)[0])
+        if row >= 0:
+            name = row_names()[row]
+            out.append(name)
+            enc.add_pod(
+                dataclasses.replace(
+                    pod, spec=dataclasses.replace(pod.spec, node_name=name)
+                )
+            )
+        else:
+            out.append(None)
+    return out
+
+
+def _run_cpuref(nodes, pending, existing=(), services=()):
+    pods = list(existing)
+    ref = CPUScheduler(nodes, pods, list(services))
+    out = []
+    for i, pod in enumerate(pending):
+        name, _ = ref.schedule(pod, last_index=i)
+        out.append(name)
+        if name:
+            committed = dataclasses.replace(
+                pod, spec=dataclasses.replace(pod.spec, node_name=name)
+            )
+            pods.append(committed)
+            ref = CPUScheduler(nodes, pods, list(services))
+    return out
+
+
+def _anti(app, key=ZONE_KEY):
+    return {
+        "podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": {"matchLabels": {"app": app}}, "topologyKey": key}
+            ]
+        }
+    }
+
+
+def _aff(app, key=ZONE_KEY):
+    return {
+        "podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": {"matchLabels": {"app": app}}, "topologyKey": key}
+            ]
+        }
+    }
+
+
+HOSTNAME = "kubernetes.io/hostname"
+
+
+def test_inbatch_anti_affinity_spreads():
+    # 3 self-anti-affine pods (hostname topology) in ONE batch must land on
+    # 3 distinct nodes; without in-batch state they'd all pick the same one
+    nodes = [make_node(f"n{i}", cpu="4", mem="8Gi") for i in range(3)]
+    pending = [
+        make_pod(f"p{i}", cpu="100m", labels={"app": "x"}, affinity=_anti("x", HOSTNAME))
+        for i in range(3)
+    ]
+    got = _run_batch(nodes, pending)
+    want = _run_sequential(nodes, pending)
+    ref = _run_cpuref(nodes, pending)
+    assert got == want == ref
+    assert len({g for g in got if g}) == 3
+
+
+def test_inbatch_anti_affinity_zone_exhaustion():
+    # 2 zones, 3 zone-anti-affine pods: third must be unschedulable
+    nodes = [
+        make_node("n0", cpu="4", mem="8Gi", labels={ZONE_KEY: "z0"}),
+        make_node("n1", cpu="4", mem="8Gi", labels={ZONE_KEY: "z1"}),
+        make_node("n2", cpu="4", mem="8Gi", labels={ZONE_KEY: "z0"}),
+    ]
+    pending = [
+        make_pod(f"p{i}", cpu="100m", labels={"app": "z"}, affinity=_anti("z"))
+        for i in range(3)
+    ]
+    got = _run_batch(nodes, pending)
+    want = _run_sequential(nodes, pending)
+    ref = _run_cpuref(nodes, pending)
+    assert got == want == ref
+    assert got[2] is None
+
+
+def test_inbatch_affinity_chain():
+    # leader bootstraps (self-match), followers require affinity to it in
+    # the same zone — all in one batch
+    nodes = [
+        make_node("n0", cpu="4", mem="8Gi", labels={ZONE_KEY: "z0"}),
+        make_node("n1", cpu="4", mem="8Gi", labels={ZONE_KEY: "z1"}),
+    ]
+    pending = [
+        make_pod("leader", cpu="100m", labels={"app": "ring"}, affinity=_aff("ring")),
+        make_pod("f1", cpu="100m", labels={"app": "follower"}, affinity=_aff("ring")),
+        make_pod("f2", cpu="100m", labels={"app": "follower"}, affinity=_aff("ring")),
+    ]
+    got = _run_batch(nodes, pending)
+    want = _run_sequential(nodes, pending)
+    ref = _run_cpuref(nodes, pending)
+    assert got == want == ref
+    # followers share the leader's zone
+    zone_of = {"n0": "z0", "n1": "z1"}
+    assert got[0] is not None
+    assert zone_of[got[1]] == zone_of[got[0]]
+    assert zone_of[got[2]] == zone_of[got[0]]
+
+
+def test_inbatch_mixed_affinity_and_plain():
+    # plain pods in the same batch are unaffected by the affinity machinery
+    nodes = [make_node(f"n{i}", cpu="4", mem="8Gi") for i in range(3)]
+    pending = [
+        make_pod("plain-a", cpu="100m"),
+        make_pod("anti-1", cpu="100m", labels={"app": "s"}, affinity=_anti("s", HOSTNAME)),
+        make_pod("plain-b", cpu="100m"),
+        make_pod("anti-2", cpu="100m", labels={"app": "s"}, affinity=_anti("s", HOSTNAME)),
+    ]
+    got = _run_batch(nodes, pending)
+    want = _run_sequential(nodes, pending)
+    assert got == want
+    assert got[1] != got[3]  # anti pair split across nodes
+
+
+def test_gang_respects_inbatch_anti_affinity():
+    # a gang of mutually anti-affine pods must spread, not pack (the gang
+    # path shares the affinity-aware scan)
+    from kubernetes_tpu.models.gang import GangScheduler, PodGroup
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+    from kubernetes_tpu.runtime.scheduler import Scheduler
+
+    cache = SchedulerCache()
+    bound = []
+    sched = Scheduler(cache=cache, binder=lambda p, n: bound.append((p.name, n)) or True)
+    for i in range(3):
+        cache.add_node(make_node(f"n{i}", cpu="8", mem="16Gi"))
+    gang = [
+        make_pod(f"g{i}", cpu="100m", labels={"app": "gang"},
+                 affinity=_anti("gang", HOSTNAME))
+        for i in range(3)
+    ]
+    names, placed = GangScheduler(sched).schedule_gang(PodGroup("grp"), gang)
+    assert placed == 3
+    assert names is not None and len(set(names)) == 3
+
+
+def test_batch_has_required_affinity_detector():
+    assert not batch_has_required_affinity([make_pod("a"), make_pod("b")])
+    assert batch_has_required_affinity(
+        [make_pod("a"), make_pod("b", affinity=_anti("x"))]
+    )
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_inbatch_affinity_randomized(seed):
+    rng = np.random.default_rng(7000 + seed)
+    nodes = [
+        make_node(
+            f"n{i}", cpu="2", mem="8Gi", labels={ZONE_KEY: f"z{i % 3}"}
+        )
+        for i in range(6)
+    ]
+    apps = ["a", "b", "c"]
+    pending = []
+    for i in range(8):
+        app = str(rng.choice(apps))
+        kind = rng.random()
+        affinity = None
+        if kind < 0.4:
+            affinity = _anti(app, HOSTNAME if rng.random() < 0.5 else ZONE_KEY)
+        elif kind < 0.7:
+            affinity = _aff(app, ZONE_KEY)
+        pending.append(
+            make_pod(
+                f"p{i}",
+                cpu=f"{int(rng.integers(1, 4)) * 100}m",
+                labels={"app": app},
+                affinity=affinity,
+            )
+        )
+    got = _run_batch(nodes, pending)
+    want = _run_sequential(nodes, pending)
+    assert got == want

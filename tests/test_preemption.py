@@ -7,6 +7,7 @@ from kubernetes_tpu.codec import SnapshotEncoder
 from kubernetes_tpu.codec.schema import FilterConfig
 from kubernetes_tpu.cpuref import CPUScheduler
 from kubernetes_tpu.models.preemption import (
+    dense_start_ranks,
     preempt_one,
     preemption_candidates,
     sorted_victim_slots,
@@ -53,7 +54,7 @@ def run_device_preempt(nodes, existing, preemptor, pdbs=()):
         arena.priority,
         pods_ext,
         violating,
-        arena.start,
+        dense_start_ranks(arena.start),
         slots,
     )
     node_row = int(res.node)
