@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.runtime.events import EventRecorder
 
 ADDED, MODIFIED, DELETED = "ADDED", "MODIFIED", "DELETED"
 
@@ -44,7 +45,7 @@ class _Stored:
 
 
 class LocalCluster:
-    KINDS = ("nodes", "pods", "services")
+    KINDS = ("nodes", "pods", "services", "leases")
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
@@ -53,6 +54,9 @@ class LocalCluster:
             k: {} for k in self.KINDS
         }
         self._watchers: List[Callable[[str, str, object], None]] = []
+        # the events API analog: components record through here
+        # (tools/record; queryable via cluster.events.events(...))
+        self.events = EventRecorder()
 
     # ------------------------------------------------------------ storage
 
@@ -60,7 +64,7 @@ class LocalCluster:
     def _key(kind: str, obj) -> Tuple[str, str]:
         if kind == "nodes":
             return ("", obj.name)
-        if kind == "services":
+        if isinstance(obj, dict):  # services / leases
             return (obj["namespace"], obj["name"])
         return (obj.namespace, obj.name)
 
@@ -114,6 +118,13 @@ class LocalCluster:
             s = self._store[kind].get(key)
             return s.obj if s else None
 
+    def get_with_rv(self, kind: str, namespace: str, name: str):
+        """(obj, rv) pair for compare-and-swap callers (leader election)."""
+        with self._lock:
+            key = (namespace if kind != "nodes" else "", name)
+            s = self._store[kind].get(key)
+            return (s.obj, s.rv) if s else (None, 0)
+
     def list(self, kind: str) -> List[object]:
         with self._lock:
             return [s.obj for s in self._store[kind].values()]
@@ -151,9 +162,12 @@ class LocalCluster:
 
 def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
     """AddAllEventHandlers analog (pkg/scheduler/eventhandlers.go:319-378):
-    route store events into the scheduler's cache and queue."""
+    route store events into the scheduler's cache and queue; the scheduler's
+    event recorder becomes the cluster's (one audit trail)."""
     cache = scheduler.cache
     queue = scheduler.queue
+    if getattr(scheduler, "_recorder_defaulted", False):
+        scheduler.recorder = cluster.events
 
     def on_event(event: str, kind: str, obj) -> None:
         if kind == "nodes":

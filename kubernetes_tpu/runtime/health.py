@@ -1,0 +1,75 @@
+"""Scheduler healthz + metrics HTTP endpoints.
+
+The reference serves /healthz and Prometheus /metrics from the scheduler
+binary itself (cmd/kube-scheduler/app/server.go:194-222
+installMetricHandler / newHealthzHandler); previously only the extender
+sidecar exposed them here.  `start_health_server` serves the shared metrics
+registry and an optional liveness callback (the leader-election watchdog
+hook, server.go:196-197).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from kubernetes_tpu.utils import metrics as m
+
+
+class HealthServer:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        healthy: Optional[Callable[[], bool]] = None,
+        registry=None,
+    ):
+        self._healthy = healthy or (lambda: True)
+        self._registry = registry or m.REGISTRY
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send(self, body: bytes, code: int = 200, ct: str = "text/plain"):
+                self.send_response(code)
+                self.send_header("Content-Type", ct)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    if outer._healthy():
+                        self._send(b"ok")
+                    else:
+                        self._send(b"unhealthy", 500)
+                elif self.path == "/metrics":
+                    self._send(
+                        outer._registry.expose().encode(),
+                        ct="text/plain; version=0.0.4",
+                    )
+                else:
+                    self._send(b"not found", 404)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self):
+        return self._httpd.server_address
+
+    def start(self) -> "HealthServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def start_health_server(host: str = "127.0.0.1", port: int = 0, **kw) -> HealthServer:
+    return HealthServer(host, port, **kw).start()

@@ -1,0 +1,174 @@
+"""Leader election over the blackboard's CAS lease.
+
+Mirrors client-go/tools/leaderelection/leaderelection.go (384 LoC): a
+LeaderElectionRecord in a resource lock, acquired/renewed by compare-and-swap
+on the store's resourceVersion (the etcd3 txn analog), with
+LeaseDuration / RenewDeadline / RetryPeriod semantics.  The scheduler wires
+it the way cmd/kube-scheduler/app/server.go:248-262 does: only the elected
+instance runs the scheduling loop; on lost leadership it stops, and a
+standby's elector acquires the expired lease and starts its own loop —
+active/standby replication for the control plane.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from kubernetes_tpu.runtime.cluster import ConflictError, LocalCluster
+
+
+@dataclass
+class LeaderElectionConfig:
+    """leaderelection.go LeaderElectionConfig; durations in seconds
+    (defaults mirror component-base LeaderElectionConfiguration: 15/10/2)."""
+
+    lease_name: str = "kube-scheduler"
+    namespace: str = "kube-system"
+    lease_duration: float = 15.0
+    renew_deadline: float = 10.0
+    retry_period: float = 2.0
+
+
+class LeaderElector:
+    """Run acquire/renew against the cluster's "leases" kind.
+
+    on_started_leading fires (in the elector thread) when the lease is
+    acquired; on_stopped_leading when renewal fails past RenewDeadline or
+    stop() is called while leading."""
+
+    def __init__(
+        self,
+        cluster: LocalCluster,
+        identity: str,
+        config: Optional[LeaderElectionConfig] = None,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ):
+        self.cluster = cluster
+        self.identity = identity
+        self.config = config or LeaderElectionConfig()
+        self.on_started_leading = on_started_leading or (lambda: None)
+        self.on_stopped_leading = on_stopped_leading or (lambda: None)
+        self.is_leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_renew = 0.0
+
+    # ------------------------------------------------------------- lease CAS
+
+    def _try_acquire_or_renew(self) -> bool:
+        """tryAcquireOrRenew (leaderelection.go:322-378): create the record,
+        or CAS-update it when expired or already ours."""
+        cfg = self.config
+        now = time.monotonic()
+        cur, rv = self.cluster.get_with_rv("leases", cfg.namespace, cfg.lease_name)
+        if cur is None:
+            rec = {
+                "namespace": cfg.namespace,
+                "name": cfg.lease_name,
+                "holder": self.identity,
+                "lease_duration": cfg.lease_duration,
+                "acquire_time": now,
+                "renew_time": now,
+            }
+            try:
+                self.cluster.create("leases", rec)
+                return True
+            except ConflictError:
+                return False
+        held_by_other = cur["holder"] != self.identity
+        expired = now >= cur["renew_time"] + cur["lease_duration"]
+        if held_by_other and not expired:
+            return False
+        rec = dict(cur)
+        rec["holder"] = self.identity
+        rec["lease_duration"] = cfg.lease_duration
+        rec["renew_time"] = now
+        if held_by_other:
+            rec["acquire_time"] = now
+        try:
+            self.cluster.update("leases", rec, expect_rv=rv)
+            return True
+        except ConflictError:
+            return False
+
+    # ------------------------------------------------------------- run loop
+
+    def _loop(self) -> None:
+        cfg = self.config
+        while not self._stop.is_set():
+            if self._try_acquire_or_renew():
+                self._last_renew = time.monotonic()
+                if not self.is_leader:
+                    self.is_leader = True
+                    self.on_started_leading()
+            elif self.is_leader and (
+                time.monotonic() - self._last_renew >= cfg.renew_deadline
+            ):
+                # failed to renew within the deadline: step down
+                self.is_leader = False
+                self.on_stopped_leading()
+            self._stop.wait(cfg.retry_period)
+        if self.is_leader:
+            self.is_leader = False
+            self.on_stopped_leading()
+
+    def start(self) -> "LeaderElector":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, release: bool = True) -> None:
+        """Stop the elector; `release` zeroes the renew time so a standby
+        acquires immediately (ReleaseOnCancel semantics)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if release and self.cluster is not None:
+            cfg = self.config
+            cur, rv = self.cluster.get_with_rv(
+                "leases", cfg.namespace, cfg.lease_name
+            )
+            if cur is not None and cur["holder"] == self.identity:
+                rec = dict(cur)
+                rec["renew_time"] = -cur["lease_duration"]
+                try:
+                    self.cluster.update("leases", rec, expect_rv=rv)
+                except ConflictError:
+                    pass
+
+    def healthy(self) -> bool:
+        """Lease-renewal watchdog for /healthz (server.go:196-197)."""
+        if not self.is_leader:
+            return True
+        return time.monotonic() - self._last_renew < self.config.renew_deadline
+
+
+def run_scheduler_elected(
+    cluster: LocalCluster,
+    scheduler,
+    identity: str,
+    config: Optional[LeaderElectionConfig] = None,
+) -> LeaderElector:
+    """server.go:248-262 wiring: OnStartedLeading runs the scheduling loop in
+    a thread; OnStoppedLeading stops it.  Returns the started elector."""
+    state = {"thread": None}
+
+    def started():
+        t = threading.Thread(target=scheduler.run, daemon=True)
+        state["thread"] = t
+        t.start()
+
+    def stopped():
+        scheduler.stop()
+        t = state.get("thread")
+        if t is not None:
+            t.join(timeout=5.0)
+
+    return LeaderElector(
+        cluster, identity, config,
+        on_started_leading=started, on_stopped_leading=stopped,
+    ).start()

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -41,7 +42,13 @@ from kubernetes_tpu.models.preemption import (
 )
 from kubernetes_tpu.ops.predicates import filter_batch, required_affinity_ok
 from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.events import (
+    EVENT_TYPE_NORMAL,
+    EVENT_TYPE_WARNING,
+    EventRecorder,
+)
 from kubernetes_tpu.runtime.queue import PriorityQueue
+from kubernetes_tpu.utils import metrics as m
 from kubernetes_tpu.utils.trace import Trace
 
 TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
@@ -93,6 +100,7 @@ class Scheduler:
         victim_deleter: Optional[Callable[[Pod], None]] = None,
         pdb_lister: Optional[Callable[[], List[PodDisruptionBudget]]] = None,
         framework=None,  # framework.v1alpha1.Framework; None = no plugins
+        recorder: Optional[EventRecorder] = None,
     ):
         # NB: PriorityQueue defines __len__, so `queue or PriorityQueue()`
         # would silently replace an *empty* caller-owned queue
@@ -115,6 +123,11 @@ class Scheduler:
             score_cfg=prof.score_config if prof is not None else None,
         )
         self.framework = framework
+        # "Scheduled"/"FailedScheduling"/"Preempted" audit trail
+        # (tools/record; scheduler.go:268,433,325); wire_scheduler replaces a
+        # defaulted recorder with the cluster's shared one
+        self._recorder_defaulted = recorder is None
+        self.recorder = recorder if recorder is not None else EventRecorder()
         # PodPreemptor.DeletePod analog (scheduler.go:319-326); default
         # removes the victim straight from the cache
         self.victim_deleter = victim_deleter or (lambda pod: self.cache.remove_pod(pod))
@@ -132,6 +145,7 @@ class Scheduler:
         winners, requeue losers.  Returns per-pod results."""
         if not pods:
             return []
+        t_cycle0 = time.monotonic()
         trace = Trace("schedule_cycle", pods=len(pods))
         enc = self.cache.encoder
         cycle = self.queue.scheduling_cycle
@@ -188,6 +202,11 @@ class Scheduler:
         hosts = np.asarray(hosts)
         self._last_index += len(pods)
         trace.step("device")
+        # algorithm latency: encode + device filter/score/select, amortized
+        # per pod (metrics.go SchedulingAlgorithmLatency)
+        algo_dt = (time.monotonic() - t_cycle0) / len(pods)
+        for _ in pods:
+            m.ALGO_LATENCY.observe(algo_dt)
         results = []
         fit_errors: List[Pod] = []
         for i, pod in enumerate(pods):
@@ -199,6 +218,12 @@ class Scheduler:
                 self.queue.add_unschedulable(pod, cycle)
                 results.append(ScheduleResult(pod, None, generation))
                 fit_errors.append(pod)
+                m.SCHEDULE_ATTEMPTS.inc(result=m.UNSCHEDULABLE)
+                self.recorder.eventf(
+                    "Pod", pod.namespace, pod.name,
+                    EVENT_TYPE_WARNING, "FailedScheduling",
+                    "0/%d nodes are available", len(self.cache.encoder.node_rows),
+                )
                 continue
             node_name = enc.row_name(row)
             assumed = dataclasses.replace(
@@ -208,36 +233,61 @@ class Scheduler:
             # preemption: the reference preempts only on a scheduling
             # FitError (scheduler.go:463: `if fitError, ok := err.(...)`),
             # not on binding hiccups for a pod that fits somewhere
-            if self._reserve_and_bind(pod, assumed, node_name, cycle, pc):
+            t_pod = time.monotonic()
+            outcome = self._reserve_and_bind(
+                pod, assumed, node_name, cycle, pc, algo_dt, t_pod
+            )
+            if outcome == "failed":
+                results.append(ScheduleResult(pod, None, generation))
+                m.SCHEDULE_ATTEMPTS.inc(result=m.SCHEDULE_ERROR)
+            else:
                 self.queue.delete_nominated_pod_if_exists(pod)
                 results.append(ScheduleResult(pod, node_name, generation))
-            else:
-                results.append(ScheduleResult(pod, None, generation))
+                if outcome == "bound":
+                    # "waiting" pods record on async bind completion instead
+                    self._record_scheduled(
+                        pod, node_name, algo_dt + (time.monotonic() - t_pod)
+                    )
         trace.step("commit")
         if not self.config.disable_preemption:
             for pod in fit_errors:
                 self.preempt(pod)
             trace.step("preempt")
         trace.log_if_long(0.1)
+        m.PENDING_PODS.set(float(len(self.queue)))
         self.results.extend(results)
         return results
 
     # ------------------------------------------------- reserve/permit/bind
 
+    def _record_scheduled(self, pod: Pod, node_name: str, e2e: float) -> None:
+        """Scheduled event + counters, only once a bind actually succeeded
+        (scheduler.go:268 emits after bind, not at assume)."""
+        m.SCHEDULE_ATTEMPTS.inc(result=m.SCHEDULED)
+        m.E2E_LATENCY.observe(e2e)
+        self.recorder.eventf(
+            "Pod", pod.namespace, pod.name,
+            EVENT_TYPE_NORMAL, "Scheduled",
+            "Successfully assigned %s/%s to %s",
+            pod.namespace, pod.name, node_name,
+        )
+
     def _reserve_and_bind(
-        self, pod: Pod, assumed: Pod, node_name: str, cycle: int, pc=None
-    ) -> bool:
+        self, pod: Pod, assumed: Pod, node_name: str, cycle: int, pc=None,
+        algo_dt: float = 0.0, t_pod: float = 0.0,
+    ) -> str:
         """Framework extension points around assume->bind (scheduleOne,
         scheduler.go:507-580): Reserve -> assume -> Permit -> Prebind ->
         bind, with Unreserve + ForgetPod + requeue on any later rejection.
-        `pc` is the cycle's shared PluginContext (from schedule_cycle)."""
+        `pc` is the cycle's shared PluginContext.  Returns "bound",
+        "waiting" (bind completes asynchronously), or "failed"."""
         fwk = self.framework
         if fwk is not None:
             st = fwk.run_reserve_plugins(pc, assumed, node_name)
             if not st.is_success():
                 # reserve failure is an internal error: requeue, no preemption
                 self.queue.add_unschedulable(pod, cycle)
-                return False
+                return "failed"
         self.cache.assume_pod(assumed)
         if fwk is not None and fwk.permit_plugins:
             status, wp, timeout = fwk.start_permit(pc, assumed, node_name)
@@ -247,50 +297,76 @@ class Scheduler:
                 # with the pod optimistically assumed
                 threading.Thread(
                     target=self._finish_waiting_pod,
-                    args=(fwk, pc, pod, assumed, node_name, cycle, wp, timeout),
+                    args=(fwk, pc, pod, assumed, node_name, cycle, wp, timeout,
+                          algo_dt, t_pod),
                     daemon=True,
                 ).start()
-                return True
+                return "waiting"
             if not status.is_success():
-                self._reject_assumed(fwk, pc, pod, assumed, node_name, cycle)
-                return False
-        return self._prebind_and_bind(fwk, pc, pod, assumed, node_name, cycle)
+                self._reject_assumed(
+                    fwk, pc, pod, assumed, node_name, cycle, status.message
+                )
+                return "failed"
+        ok = self._prebind_and_bind(fwk, pc, pod, assumed, node_name, cycle)
+        return "bound" if ok else "failed"
 
     def _prebind_and_bind(self, fwk, pc, pod, assumed, node_name, cycle) -> bool:
         if fwk is not None and fwk.prebind_plugins:
             st = fwk.run_prebind_plugins(pc, assumed, node_name)
             if not st.is_success():
-                self._reject_assumed(fwk, pc, pod, assumed, node_name, cycle)
+                self._reject_assumed(
+                    fwk, pc, pod, assumed, node_name, cycle, st.message
+                )
                 return False
         ok = False
+        t0 = time.monotonic()
         try:
             ok = self.binder(assumed, node_name)
         except Exception:
             ok = False
+        m.BINDING_LATENCY.observe(time.monotonic() - t0)
         if not ok:
-            self._reject_assumed(fwk, pc, pod, assumed, node_name, cycle)
+            self._reject_assumed(
+                fwk, pc, pod, assumed, node_name, cycle,
+                f"Binding rejected for {pod.namespace}/{pod.name} on {node_name}",
+            )
             return False
         return True
 
-    def _reject_assumed(self, fwk, pc, pod, assumed, node_name, cycle) -> None:
+    def _reject_assumed(
+        self, fwk, pc, pod, assumed, node_name, cycle, message: str = ""
+    ) -> None:
         """Rollback for a pod rejected after assume (scheduler.go:416-426
-        ForgetPod + MakeDefaultErrorFunc requeue + unreserve plugins)."""
+        ForgetPod + MakeDefaultErrorFunc requeue + unreserve plugins +
+        FailedScheduling event, scheduler.go:433)."""
         self.cache.forget_pod(assumed)
         if fwk is not None:
             fwk.run_unreserve_plugins(pc, assumed, node_name)
         self.queue.add_unschedulable(pod, cycle)
+        self.recorder.eventf(
+            "Pod", pod.namespace, pod.name,
+            EVENT_TYPE_WARNING, "FailedScheduling",
+            "%s", message or f"rejected after assume on {node_name}",
+        )
 
     def _finish_waiting_pod(
-        self, fwk, pc, pod, assumed, node_name, cycle, wp, timeout
+        self, fwk, pc, pod, assumed, node_name, cycle, wp, timeout,
+        algo_dt: float = 0.0, t_pod: float = 0.0,
     ) -> None:
         try:
             st = wp.wait(timeout)
         finally:
             fwk.waiting_pods.remove(assumed)
         if st.is_success():
-            self._prebind_and_bind(fwk, pc, pod, assumed, node_name, cycle)
+            if self._prebind_and_bind(fwk, pc, pod, assumed, node_name, cycle):
+                self._record_scheduled(
+                    pod, node_name,
+                    algo_dt + (time.monotonic() - t_pod) if t_pod else algo_dt,
+                )
         else:
-            self._reject_assumed(fwk, pc, pod, assumed, node_name, cycle)
+            self._reject_assumed(
+                fwk, pc, pod, assumed, node_name, cycle, st.message
+            )
 
     # ---------------------------------------------------------- preemption
 
@@ -305,6 +381,16 @@ class Scheduler:
         name, or None if preemption does not help."""
         if self.config.disable_preemption:
             return None
+        m.PREEMPTION_ATTEMPTS.inc()
+        t0 = time.monotonic()
+        try:
+            return self._preempt_inner(pod)
+        finally:
+            # every attempt's evaluation cost lands in the histogram, not
+            # just successful nominations
+            m.PREEMPTION_LATENCY.observe(time.monotonic() - t0)
+
+    def _preempt_inner(self, pod: Pod) -> Optional[str]:
         enc = self.cache.encoder
         with self.cache._lock:
             if not self._eligible_to_preempt(pod):
@@ -345,6 +431,13 @@ class Scheduler:
             node_name = enc.row_name(row)
         for v in victims:
             self.victim_deleter(v)
+            self.recorder.eventf(
+                "Pod", v.namespace, v.name,
+                EVENT_TYPE_NORMAL, "Preempted",
+                "by %s/%s on node %s", pod.namespace, pod.name, node_name,
+            )
+        m.PREEMPTION_VICTIMS.set(float(len(victims)))
+        m.PREEMPTION_LATENCY.observe(time.monotonic() - t0)
         pod.status.nominated_node_name = node_name
         self.queue.update_nominated_pod(pod, node_name)
         self.preemptions.append(

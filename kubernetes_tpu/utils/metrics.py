@@ -88,6 +88,39 @@ class Gauge(Counter):
         )
 
 
+class LabeledCounter:
+    """Counter family with label sets (e.g. schedule_attempts_total{result=})
+    — the prometheus CounterVec analog (metrics.go scheduleAttempts)."""
+
+    def __init__(self, name: str, help_: str = "", label_names: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._children: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, v: float = 1.0, **labels) -> None:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        with self._lock:
+            self._children[key] = self._children.get(key, 0.0) + v
+
+    def value(self, **labels) -> float:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        with self._lock:
+            return self._children.get(key, 0.0)
+
+    def expose(self) -> str:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            # an empty family exposes only HELP/TYPE (prometheus CounterVec)
+            for key, v in sorted(self._children.items()):
+                lbl = ",".join(
+                    f'{n}="{val}"' for n, val in zip(self.label_names, key)
+                )
+                out.append(f"{self.name}{{{lbl}}} {v}")
+        return "\n".join(out)
+
+
 class Registry:
     def __init__(self) -> None:
         self._metrics: Dict[str, object] = {}
@@ -115,5 +148,20 @@ PREDICATE_LATENCY = REGISTRY.register(Histogram("scheduler_scheduling_algorithm_
 PRIORITY_LATENCY = REGISTRY.register(Histogram("scheduler_scheduling_algorithm_priority_evaluation_seconds"))
 PREEMPTION_LATENCY = REGISTRY.register(Histogram("scheduler_scheduling_algorithm_preemption_evaluation_seconds"))
 BINDING_LATENCY = REGISTRY.register(Histogram("scheduler_binding_duration_seconds"))
-SCHEDULE_ATTEMPTS = REGISTRY.register(Counter("scheduler_schedule_attempts_total"))
+SCHEDULE_ATTEMPTS = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_schedule_attempts_total",
+        "Number of attempts to schedule pods, by result",
+        ("result",),
+    )
+)
 PENDING_PODS = REGISTRY.register(Gauge("scheduler_pending_pods"))
+PREEMPTION_VICTIMS = REGISTRY.register(
+    Gauge("scheduler_pod_preemption_victims", "Number of selected preemption victims")
+)
+PREEMPTION_ATTEMPTS = REGISTRY.register(
+    Counter("scheduler_total_preemption_attempts", "Total preemption attempts")
+)
+
+# schedule_attempts_total result label values (metrics.go:44-52)
+SCHEDULED, UNSCHEDULABLE, SCHEDULE_ERROR = "scheduled", "unschedulable", "error"

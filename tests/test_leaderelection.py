@@ -1,0 +1,126 @@
+"""Leader election: CAS lease, active/standby failover mid-workload.
+
+Mirrors client-go/tools/leaderelection semantics wired the way
+cmd/kube-scheduler/app/server.go:248-262 runs the scheduler: only the
+elected instance schedules; when the leader dies, the standby acquires the
+expired lease and finishes the workload.
+"""
+
+import time
+
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.cluster import LocalCluster, make_cluster_binder, wire_scheduler
+from kubernetes_tpu.runtime.leaderelection import (
+    LeaderElectionConfig,
+    LeaderElector,
+    run_scheduler_elected,
+)
+from kubernetes_tpu.runtime.queue import PodBackoff, PriorityQueue
+from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+from fixtures import make_node, make_pod
+
+FAST = LeaderElectionConfig(
+    lease_duration=0.4, renew_deadline=0.3, retry_period=0.05
+)
+
+
+def test_single_elector_acquires_and_renews():
+    cluster = LocalCluster()
+    el = LeaderElector(cluster, "a", FAST).start()
+    try:
+        deadline = time.monotonic() + 2.0
+        while not el.is_leader and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert el.is_leader
+        lease = cluster.get("leases", "kube-system", "kube-scheduler")
+        assert lease["holder"] == "a"
+        assert el.healthy()
+    finally:
+        el.stop()
+
+
+def test_standby_does_not_acquire_while_leader_alive():
+    cluster = LocalCluster()
+    a = LeaderElector(cluster, "a", FAST).start()
+    b = LeaderElector(cluster, "b", FAST).start()
+    try:
+        time.sleep(0.6)  # beyond one lease duration
+        assert a.is_leader != b.is_leader  # exactly one leader
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_release_on_stop_hands_over_immediately():
+    cluster = LocalCluster()
+    a = LeaderElector(cluster, "a", FAST).start()
+    while not a.is_leader:
+        time.sleep(0.02)
+    b = LeaderElector(cluster, "b", FAST).start()
+    a.stop(release=True)
+    try:
+        deadline = time.monotonic() + 2.0
+        while not b.is_leader and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert b.is_leader
+    finally:
+        b.stop()
+
+
+def _make_member(cluster, name, bind_counts, bind_delay=0.02):
+    cache = SchedulerCache()
+    queue = PriorityQueue(backoff=PodBackoff(initial=0.01, max_duration=0.05))
+    inner = make_cluster_binder(cluster)
+
+    def binder(pod, node):
+        time.sleep(bind_delay)  # slow apiserver: keeps the kill mid-density
+        ok = inner(pod, node)
+        if ok:
+            bind_counts[name] = bind_counts.get(name, 0) + 1
+        return ok
+
+    sched = Scheduler(
+        cache=cache,
+        queue=queue,
+        binder=binder,
+        config=SchedulerConfig(batch_size=4, disable_preemption=True),
+    )
+    wire_scheduler(cluster, sched)
+    return sched
+
+
+def test_failover_mid_density_standby_finishes():
+    cluster = LocalCluster()
+    for i in range(3):
+        cluster.add_node(make_node(f"n{i}", cpu="16", mem="32Gi", pods=110))
+    counts = {}
+    sched_a = _make_member(cluster, "a", counts)
+    sched_b = _make_member(cluster, "b", counts)
+    el_a = run_scheduler_elected(cluster, sched_a, "a", FAST)
+    while not el_a.is_leader:
+        time.sleep(0.02)
+    el_b = run_scheduler_elected(cluster, sched_b, "b", FAST)
+
+    n_pods = 24
+    for i in range(n_pods):
+        cluster.add_pod(make_pod(f"d{i}", cpu="100m", mem="64Mi"))
+
+    def bound_count():
+        return sum(1 for p in cluster.list("pods") if p.spec.node_name)
+
+    # let the leader schedule part of the workload, then kill it abruptly
+    deadline = time.monotonic() + 10.0
+    while bound_count() < 6 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    killed_at = bound_count()
+    assert killed_at >= 6
+    el_a.stop(release=False)  # crash: no lease handover, standby must expire it
+
+    deadline = time.monotonic() + 15.0
+    while bound_count() < n_pods and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert bound_count() == n_pods
+    assert counts.get("b", 0) > 0  # the standby took over and finished
+    assert el_b.is_leader
+    el_b.stop()
