@@ -437,7 +437,6 @@ class Scheduler:
                 "by %s/%s on node %s", pod.namespace, pod.name, node_name,
             )
         m.PREEMPTION_VICTIMS.set(float(len(victims)))
-        m.PREEMPTION_LATENCY.observe(time.monotonic() - t0)
         pod.status.nominated_node_name = node_name
         self.queue.update_nominated_pod(pod, node_name)
         self.preemptions.append(
