@@ -169,7 +169,7 @@ def run(args) -> dict:
 
     fn = make_sequential_scheduler(
         unsched_taint_key=enc.interner.intern("node.kubernetes.io/unschedulable"),
-        zone_key_id=enc.zone_key,
+        zone_key_id=enc.getzone_key,
     )
 
     # warmup/compile on one batch shape

@@ -66,6 +66,10 @@ from kubernetes_tpu.codec.schema import (
 HOSTNAME_KEY = "kubernetes.io/hostname"
 ZONE_KEY = "failure-domain.beta.kubernetes.io/zone"
 REGION_KEY = "failure-domain.beta.kubernetes.io/region"
+# synthetic topology key for GetZoneKey (pkg/util/node/node.go:126-143):
+# the SelectorSpread zone reduce groups nodes by region+zone CONCAT, not the
+# zone label alone.  The NUL prefix keeps it out of any user label vocabulary.
+GETZONE_KEY = "\x00getzonekey"
 
 # kinds of existing-pod affinity term groups
 K_ANTI_REQ, K_ANTI_PREF, K_AFF_REQ, K_AFF_PREF = 0, 1, 2, 3
@@ -135,6 +139,7 @@ class SnapshotEncoder:
         self.hostname_key = self.interner.intern(HOSTNAME_KEY)
         self.zone_key = self.interner.intern(ZONE_KEY)
         self.region_key = self.interner.intern(REGION_KEY)
+        self.getzone_key = self.interner.intern(GETZONE_KEY)
         self.topo_keys: Set[int] = {self.hostname_key, self.zone_key, self.region_key}
 
         # topology-pair vocabulary
@@ -491,8 +496,20 @@ class SnapshotEncoder:
                 col[row] = pid
             else:
                 col[row] = PAD
-        zone = node.labels.get(ZONE_KEY)
-        self.a_zone[row] = it.intern(zone) if zone is not None else PAD
+        # GetZoneKey pair (util/node/node.go:126-143): region + ":\x00:" + zone,
+        # present when either label is non-empty; this is the grouping unit of
+        # the SelectorSpread zone reduce (two same-named zones in different
+        # regions are distinct).
+        region = node.labels.get(REGION_KEY, "")
+        zone = node.labels.get(ZONE_KEY, "")
+        if region or zone:
+            gz_pid = self._pair_id(
+                self.getzone_key, it.intern(region + ":\x00:" + zone)
+            )
+            self.a_topo[row, gz_pid] = True
+            self.a_zone[row] = gz_pid
+        else:
+            self.a_zone[row] = PAD
         # images
         self.a_img_id[row, :] = PAD
         self.a_img_sz[row, :] = 0.0
@@ -1396,7 +1413,46 @@ class SnapshotEncoder:
                     k: np.copy(v[b]) for k, v in out.items()
                 }
 
-        return PodBatch(**out)
+        # state-dependent, so computed fresh every call (outside the row
+        # cache): per-node counts of existing pods matching ALL of each pod's
+        # spread selectors — countMatchingPods AND semantics
+        # (selector_spreading.go:165-187), not one count per selector.
+        return PodBatch(**out, spread_counts=self._spread_and_counts(out))
+
+    def _spread_and_counts(self, out) -> np.ndarray:
+        """f32[B, N] from the batch's group_ids/group_valid rows: existing
+        alive pods per node matching every one of the pod's spread groups
+        (a pod with no groups contributes all-zero counts, which the reduce
+        maps to the uniform MAX_PRIORITY — the len(selectors)==0 score-0
+        path of CalculateSpreadPriorityMap)."""
+        B = out["group_ids"].shape[0]
+        counts = np.zeros((B, self._cap_n), np.float32)
+        mask_cache: Dict[int, np.ndarray] = {}
+        for b in range(B):
+            gs = out["group_ids"][b][out["group_valid"][b]]
+            if gs.size == 0:
+                continue
+            m = None
+            for g in gs:
+                g = int(g)
+                mg = mask_cache.get(g)
+                if mg is None:
+                    ns, sel = self._spread[g]
+                    nsid = self.interner.lookup(ns)
+                    mg = (
+                        self._match_selector_vec(sel, [nsid])
+                        if nsid >= 0
+                        else np.zeros(self._cap_m, bool)
+                    )
+                    mask_cache[g] = mg
+                m = mg if m is None else (m & mg)
+            nodes = self.p_node[m]
+            nodes = nodes[nodes >= 0]
+            if nodes.size:
+                counts[b] = np.bincount(
+                    nodes, minlength=self._cap_n
+                )[: self._cap_n].astype(np.float32)
+        return counts
 
     def _pod_static_key(self, pod: Pod):
         """Cache key for state-independent pods; None disables caching.

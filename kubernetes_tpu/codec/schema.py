@@ -206,7 +206,7 @@ class ClusterTensors:
     port_used: Any          # bool[N, P] slot occupancy
     # -- topology --
     topo_pairs: Any         # bool[N, TP] node belongs to topology pair tp
-    zone_id: Any            # i32[N]      interned zone label value (PAD none)
+    zone_id: Any            # i32[N]      GetZoneKey pair id (PAD = no zone)
     # -- spreading (SelectorSpread) --
     group_counts: Any       # f32[N, G]  matching existing pods per spread group
     # -- inter-pod affinity state --
@@ -293,6 +293,9 @@ class PodBatch:
     # spreading
     group_ids: Any          # i32[B, GP]
     group_valid: Any        # bool[B, GP]
+    spread_counts: Any      # f32[B, N] existing pods per node matching ALL of
+                            #   the pod's spread selectors (countMatchingPods
+                            #   AND semantics, selector_spreading.go:165-187)
     # images
     image_ids: Any          # i32[B, C]  (PAD empty)
     image_bytes: Any        # f32[B, C]  total size if known (0 otherwise)

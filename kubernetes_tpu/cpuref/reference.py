@@ -37,6 +37,16 @@ TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
 # ------------------------------------------------------------------ helpers
 
 
+def get_zone_key(node: Node) -> Optional[str]:
+    """ref pkg/util/node/node.go:126-143 GetZoneKey: region + ":\\x00:" + zone,
+    None when both labels are absent/empty (node belongs to no zone)."""
+    region = node.labels.get(REGION_KEY, "")
+    zone = node.labels.get(ZONE_KEY, "")
+    if not region and not zone:
+        return None
+    return region + ":\x00:" + zone
+
+
 def pod_requests(pod: Pod) -> Dict[str, float]:
     out: Dict[str, float] = {}
     for k, q in pod.resource_request().items():
@@ -584,19 +594,20 @@ class CPUScheduler:
         counts: Dict[str, int] = {}
         for node in self.nodes:
             c = 0
-            for p in self.by_node[node.name]:
-                if p.namespace != pod.namespace:
-                    continue
-                for sel in selectors:
-                    if sel.matches(p.labels):
+            if selectors:
+                for p in self.by_node[node.name]:
+                    if p.namespace != pod.namespace:
+                        continue
+                    # countMatchingPods (selector_spreading.go:165-187): the
+                    # existing pod counts once iff it matches ALL selectors
+                    if all(sel.matches(p.labels) for sel in selectors):
                         c += 1
-                        break
             counts[node.name] = c
         max_node = max(counts.values()) if counts else 0
         zone_counts: Dict[str, int] = defaultdict(int)
         have_zones = False
         for node in self.nodes:
-            z = node.labels.get(ZONE_KEY)
+            z = get_zone_key(node)
             if z is not None:
                 have_zones = True
                 zone_counts[z] += counts[node.name]
@@ -607,7 +618,7 @@ class CPUScheduler:
                 f = MAX_PRIORITY * (max_node - counts[node.name]) / max_node
             else:
                 f = MAX_PRIORITY
-            z = node.labels.get(ZONE_KEY)
+            z = get_zone_key(node)
             if have_zones and z is not None:
                 if max_zone > 0:
                     zs = MAX_PRIORITY * (max_zone - zone_counts[z]) / max_zone

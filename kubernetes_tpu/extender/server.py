@@ -126,7 +126,7 @@ class ExtenderServer:
             cluster, _ = self.cache.snapshot()
             batch = enc.encode_pods([pod])
             out = schedule_batch_independent(
-                cluster, batch, 0, self.cfg, self._unsched, enc.zone_key
+                cluster, batch, 0, self.cfg, self._unsched, enc.getzone_key
             )
             mask = np.asarray(out["mask"])[0]
             failure = np.asarray(out["failure"])[0]
@@ -155,7 +155,7 @@ class ExtenderServer:
             cluster, _ = self.cache.snapshot()
             batch = enc.encode_pods([pod])
             out = schedule_batch_independent(
-                cluster, batch, 0, self.cfg, self._unsched, enc.zone_key
+                cluster, batch, 0, self.cfg, self._unsched, enc.getzone_key
             )
             scores = np.asarray(out["scores"])[0]
             requested = self._requested_nodes(args, enc)

@@ -11,16 +11,15 @@ spreading counts exactly as if the reference had scheduled them one by one.
 
 Dynamic state inside the scan (everything else is precomputed static):
   requested[N, R], nonzero[N, 2]        — PodFitsResources + resource scores
-  group_counts[N, G]                    — SelectorSpreadPriority
+  spread_extra[B, N]                    — SelectorSpreadPriority in-batch
+                                          increments (AND-match cross matrix)
   port_used[N, PV]                      — PodFitsHostPorts within the batch,
                                           over a batch-local port vocabulary
                                           with a precomputed conflict matrix
                                           (wildcard-IP semantics preserved)
-
-Known batch-semantics gap (tracked in PARITY.md): inter-pod affinity terms of
-pods in the same batch do not see each other's placements yet; anti-affinity
-heavy workloads should use batch=1 until the pair-count state moves into the
-scan.
+  extra_aff/anti/forb/pref              — in-batch inter-pod affinity pair
+                                          state (predicateMetadata.AddPod
+                                          analog) when aff_state is given
 """
 
 from __future__ import annotations
@@ -237,20 +236,20 @@ def encode_batch_ports(encoder, pods: Sequence, n_cap: int) -> BatchPortState:
     )
 
 
-def _dynamic_scores(cluster, req_cpu_mem, requested2, zone_key_id, group_counts,
-                    group_onehot, rtc_xs, rtc_ys):
+def _dynamic_scores(cluster, req_cpu_mem, requested2, zone_key_id, counts,
+                    rtc_xs, rtc_ys):
     """The state-dependent priorities, recomputed per scan step from the
     shared scoring cores in ops/priorities.py.
 
     req_cpu_mem: f32[2] nonzero request of the current pod;
     requested2: f32[N, 2] current nonzero usage;
-    group_onehot: f32[G] the pod's spread groups."""
+    counts: f32[N] pods matching ALL the pod's spread selectors per node
+    (pre-batch base + in-batch commits)."""
     cap = node_capacity2(cluster)                            # [N, 2]
     req = requested2 + req_cpu_mem[None, :]
     least = least_requested_score(req, cap)                  # [N]
     most = most_requested_score(req, cap)
     balanced = balanced_allocation_score(req, cap)
-    counts = group_counts @ group_onehot                     # [N]
     spread = spread_score_from_counts(counts, cluster, zone_key_id)
     util = jnp.where(cap > 0, req * 100.0 / jnp.maximum(cap, 1e-30), 100.0)
     rtc = jnp.floor(jnp.sum(jnp.interp(util, rtc_xs, rtc_ys), axis=-1) / 2.0)
@@ -264,14 +263,14 @@ def make_sequential_scheduler(
     cfg: FilterConfig = FilterConfig(),
     weights=None,
     unsched_taint_key: int = 0,
-    zone_key_id: int = 3,
+    zone_key_id: int = 5,
     score_cfg: Optional[ScoreConfig] = None,
 ):
     """Build (or fetch the memoized) jitted sequential-commit scheduler.
 
     Returns fn(cluster, pods, ports: BatchPortState, last_index0) ->
       (hosts i32[B] (-1 = unschedulable), new_cluster) where new_cluster has
-      the committed requested/nonzero/group_counts columns."""
+      the committed requested/nonzero columns."""
     if score_cfg is None:
         score_cfg = ScoreConfig()
     key = (
@@ -369,6 +368,15 @@ def make_sequential_scheduler(
         if extra_score is not None:
             static_score = static_score + extra_score
         group_onehot = pod_group_onehot(pods, G)              # [B, G]
+        # in-batch spread cross-matches: committing pod j raises later pod
+        # i's count at j's node iff j matches ALL of i's selectors — i.e.
+        # i's group set is a subset of j's (groups are ns-scoped, so the
+        # namespace check rides along).  countMatchingPods AND semantics.
+        has_groups = jnp.any(pods.group_valid, axis=1)        # [B]
+        spread_match = (
+            has_groups[:, None]
+            & ((group_onehot @ (1.0 - group_onehot).T) == 0)
+        ).astype(jnp.float32)                                 # [B, B] [i, j]
 
         topo = cluster.topo_pairs.astype(jnp.float32)         # [N, TP]
         TP = topo.shape[1]
@@ -384,9 +392,9 @@ def make_sequential_scheduler(
         hard_w = float(cfg.hard_pod_affinity_weight)
 
         def step(state, xs):
-            (requested, nonzero2, group_counts, port_used, last_idx,
+            (requested, nonzero2, spread_extra, port_used, last_idx,
              extra_aff, extra_anti, extra_forb, extra_pref) = state
-            (smask, sscore, req, nz2, gonehot, pprio, pport, step_no,
+            (smask, sscore, req, nz2, spread_base, pprio, pport, step_no,
              aff_xs) = xs
             # dynamic resource fit (PodFitsResources on current state)
             fit = ~jnp.any(
@@ -440,8 +448,8 @@ def make_sequential_scheduler(
                 viol1 = (forb.astype(jnp.float32) @ topo.T) > 0    # [N]
                 mask = mask & aff_ok & ~viol1 & ~viol2
             least, most, balanced, spread, rtc = _dynamic_scores(
-                cluster, nz2, nonzero2, zone_key_id, group_counts, gonehot,
-                rtc_xs, rtc_ys,
+                cluster, nz2, nonzero2, zone_key_id,
+                spread_base + spread_extra[step_no], rtc_xs, rtc_ys,
             )
             total = (
                 sscore
@@ -471,7 +479,10 @@ def make_sequential_scheduler(
             onehot = (jnp.arange(requested.shape[0]) == host) & commit  # [N]
             requested = requested + onehot[:, None] * req[None, :]
             nonzero2 = nonzero2 + onehot[:, None] * nz2[None, :]
-            group_counts = group_counts + onehot[:, None] * gonehot[None, :]
+            # later pods whose selector set this pod covers see it at its node
+            spread_extra = spread_extra + (
+                spread_match[:, step_no][:, None] * onehot[None, :]
+            )
             port_used = port_used | (onehot[:, None] & pport[None, :])
             if aff_state is not None:
                 # predicateMetadata.AddPod analog: the committed pod's
@@ -499,7 +510,7 @@ def make_sequential_scheduler(
                 )
             out_host = jnp.where(feasible, host, -1)
             return (
-                (requested, nonzero2, group_counts, port_used, last_idx + 1,
+                (requested, nonzero2, spread_extra, port_used, last_idx + 1,
                  extra_aff, extra_anti, extra_forb, extra_pref),
                 out_host,
             )
@@ -519,7 +530,7 @@ def make_sequential_scheduler(
         init = (
             cluster.requested,
             cluster.nonzero_req,
-            cluster.group_counts,
+            jnp.zeros((B, cluster.n_nodes), jnp.float32),
             jnp.zeros((cluster.n_nodes, PV), bool),
             last_index0.astype(jnp.int32),
         ) + extras_init
@@ -546,20 +557,19 @@ def make_sequential_scheduler(
             static_score,
             pods.req,
             pods.nonzero_req,
-            group_onehot,
+            pods.spread_counts,
             pods.priority,
             ports.pod_ports,
             jnp.arange(B, dtype=jnp.int32),
             aff_xs_in,
         )
-        (requested, nonzero2, group_counts, *_), hosts = jax.lax.scan(step, init, xs)
+        (requested, nonzero2, *_), hosts = jax.lax.scan(step, init, xs)
         import dataclasses as _dc
 
         new_cluster = _dc.replace(
             cluster,
             requested=requested,
             nonzero_req=nonzero2,
-            group_counts=group_counts,
         )
         return hosts, new_cluster
 

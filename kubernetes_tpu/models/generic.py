@@ -27,7 +27,7 @@ def schedule_batch_independent(
     last_index0: int = 0,
     cfg: FilterConfig = FilterConfig(),
     unsched_taint_key: int = 0,
-    zone_key_id: int = 3,
+    zone_key_id: int = 5,
 ):
     """Filter + Score + selectHost for every pod against one snapshot.
 
@@ -35,7 +35,7 @@ def schedule_batch_independent(
     mask bool[B,N], scores f32[B,N], failure i32[B,N] (first failing
     predicate index, FitError attribution)."""
     mask, per_pred = filter_batch(cluster, pods, cfg, unsched_taint_key)
-    total, per_prio = score_batch(cluster, pods)
+    total, per_prio = score_batch(cluster, pods, zone_key_id=zone_key_id)
     hosts, feasible = select_hosts_batch(total, mask, last_index0)
     return {
         "hosts": hosts,

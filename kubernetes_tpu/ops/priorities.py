@@ -233,14 +233,13 @@ def pod_group_onehot(pods: PodBatch, n_groups: int):
     ).astype(jnp.float32).sum(axis=1)
 
 
-def selector_spread(cluster: ClusterTensors, pods: PodBatch, zone_key_id: int = 3):
+def selector_spread(cluster: ClusterTensors, pods: PodBatch, zone_key_id: int = 5):
     """SelectorSpreadPriority (priorities/selector_spreading.go:77-140):
-    count matching existing pods per node (maintained spread-group columns),
-    then the zone-weighted reduce.  zone_key_id is the interned id of the
-    zone label key (the encoder interns it at a fixed position)."""
-    onehot = pod_group_onehot(pods, cluster.group_counts.shape[1])
-    counts = onehot @ cluster.group_counts.T                 # [B, N]
-    return spread_score_from_counts(counts, cluster, zone_key_id)
+    per-node counts of existing pods matching ALL the pod's selectors
+    (encoder-computed, countMatchingPods AND semantics), then the
+    zone-weighted reduce.  zone_key_id is the interned id of the encoder's
+    synthetic GetZoneKey topology key (region+zone grouping)."""
+    return spread_score_from_counts(pods.spread_counts, cluster, zone_key_id)
 
 
 # --------------------------------------------------------- inter-pod affinity
@@ -310,7 +309,7 @@ def resource_limits(cluster: ClusterTensors, pods: PodBatch):
 
 
 def score_batch(cluster: ClusterTensors, pods: PodBatch, weights=None,
-                score_cfg=None):
+                score_cfg=None, zone_key_id: int = 5):
     """All priorities + weighted sum -> (total f32[B, N], per f32[B, P, N]).
 
     weights follows PRIORITY_ORDER; defaults to the stock weights
@@ -320,7 +319,7 @@ def score_batch(cluster: ClusterTensors, pods: PodBatch, weights=None,
 
         score_cfg = ScoreConfig()
     per = {
-        "SelectorSpreadPriority": selector_spread(cluster, pods),
+        "SelectorSpreadPriority": selector_spread(cluster, pods, zone_key_id),
         "InterPodAffinityPriority": inter_pod_affinity_score(cluster, pods),
         "LeastRequestedPriority": least_requested(cluster, pods),
         "BalancedResourceAllocation": balanced_allocation(cluster, pods),

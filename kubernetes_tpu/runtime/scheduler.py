@@ -119,7 +119,7 @@ class Scheduler:
             cfg=self.config.filter_config,
             weights=self.config.weights,
             unsched_taint_key=self._unsched_key,
-            zone_key_id=enc.zone_key,
+            zone_key_id=enc.getzone_key,
             score_cfg=prof.score_config if prof is not None else None,
         )
         self.framework = framework

@@ -36,6 +36,7 @@ def make_node(
     conditions: Sequence[dict] = (),
     images: Sequence[dict] = (),
     annotations: Optional[Dict[str, str]] = None,
+    allocatable_extra: Optional[Dict[str, str]] = None,
 ) -> Node:
     lab = {HOSTNAME_KEY: name}
     lab.update(labels or {})
@@ -44,7 +45,10 @@ def make_node(
             "metadata": {"name": name, "labels": lab, "annotations": annotations or {}},
             "spec": {"unschedulable": unschedulable, "taints": list(taints)},
             "status": {
-                "allocatable": {"cpu": cpu, "memory": mem, "pods": pods},
+                "allocatable": {
+                    "cpu": cpu, "memory": mem, "pods": pods,
+                    **(allocatable_extra or {}),
+                },
                 "conditions": list(conditions) or [{"type": "Ready", "status": "True"}],
                 "images": list(images),
             },
@@ -67,22 +71,34 @@ def make_pod(
     images: Sequence[str] = (),
     owner: Optional[Tuple[str, str]] = None,  # (kind, uid)
     volumes: Sequence[dict] = (),
+    requests: Optional[Dict[str, str]] = None,  # full request dict (extended
+                                                # resources, ephemeral-storage…)
+    init_requests: Sequence[Dict[str, str]] = (),  # one init container each
+    extra_containers: Sequence[Dict[str, str]] = (),  # request dict each
 ) -> Pod:
-    requests = {}
+    req = dict(requests or {})
     if cpu is not None:
-        requests["cpu"] = cpu
+        req["cpu"] = cpu
     if mem is not None:
-        requests["memory"] = mem
+        req["memory"] = mem
     containers = [
         {
             "name": "c0",
             "image": images[0] if images else "",
-            "resources": {"requests": requests} if requests else {},
+            "resources": {"requests": req} if req else {},
             "ports": list(ports),
         }
     ]
     for i, img in enumerate(images[1:], 1):
         containers.append({"name": f"c{i}", "image": img})
+    for i, r in enumerate(extra_containers):
+        containers.append(
+            {"name": f"x{i}", "image": "", "resources": {"requests": dict(r)}}
+        )
+    init_containers = [
+        {"name": f"i{i}", "image": "", "resources": {"requests": dict(r)}}
+        for i, r in enumerate(init_requests)
+    ]
     meta: dict = {"name": name, "namespace": namespace, "labels": labels or {}}
     if owner:
         meta["ownerReferences"] = [
@@ -97,6 +113,7 @@ def make_pod(
                 "tolerations": list(tolerations),
                 "affinity": affinity,
                 "containers": containers,
+                "initContainers": init_containers,
                 "priority": priority,
                 "volumes": list(volumes),
             },

@@ -1,0 +1,516 @@
+"""Reference table-driven goldens, ported.
+
+Expected values are transcribed from the reference's own test tables and
+asserted against BOTH the device kernels and the cpuref golden:
+
+  * TestSelectorSpreadPriority       priorities/selector_spreading_test.go:41-343
+  * TestZoneSelectorSpreadPriority   selector_spreading_test.go:377-638
+  * TestTaintAndToleration           priorities/taint_toleration_test.go:51-231
+  * TestPodFitsResources             predicates/predicates_test.go:94-360
+  * TestPodFitsHost                  predicates_test.go:494-579
+  * TestPodFitsHostPorts             predicates_test.go:580-695
+  * TestCheckNodeUnschedulablePredicate predicates_test.go:4945-4995
+
+Scores computed through float blending (SelectorSpread's 2/3-zone weighting)
+follow the PARITY.md f32 rule: +-1 at non-binary-exact int boundaries;
+everything else matches exactly.
+
+Go test objects with no namespace carry the empty namespace ""; here that is
+spelled "nsnone" (a plain distinct namespace) so interning stays trivial while
+same/different-namespace relations are preserved.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.codec.schema import FilterConfig, PRED_INDEX, PRIO_INDEX
+from kubernetes_tpu.cpuref import CPUScheduler
+from kubernetes_tpu.ops import filter_batch, score_batch
+
+from fixtures import TEST_DIMS, ZONE_KEY, make_node, make_pod
+
+MAXP = 10
+LAB1 = {"foo": "bar", "baz": "blah"}
+LAB2 = {"bar": "foo", "baz": "blah"}
+
+
+def _run(nodes, pods, services, pending):
+    enc = SnapshotEncoder(TEST_DIMS)
+    for n in nodes:
+        enc.add_node(n)
+    for p in pods:
+        enc.add_pod(p)
+    for ns, sel in services:
+        enc.add_spread_selector(ns, sel)
+    cluster = enc.snapshot()
+    batch = enc.encode_pods([pending])
+    unsched = enc.interner.lookup("node.kubernetes.io/unschedulable")
+    mask, per_pred = filter_batch(cluster, batch, FilterConfig(), max(unsched, 0))
+    _, per_prio = score_batch(cluster, batch, zone_key_id=enc.getzone_key)
+    golden = CPUScheduler(nodes, pods, services)
+    row = {n.name: enc.node_rows[n.name] for n in nodes}
+    return (
+        np.asarray(mask), np.asarray(per_pred), np.asarray(per_prio),
+        golden, row,
+    )
+
+
+def check_priority(prio_name, nodes, pods, services, pending, expected,
+                   tol=0):
+    """expected: {node_name: score}; device AND cpuref must reproduce it."""
+    _, _, per_prio, golden, row = _run(nodes, pods, services, pending)
+    gold = golden.priorities(pending)[prio_name]
+    for name, want in expected.items():
+        got_dev = float(per_prio[0, PRIO_INDEX[prio_name], row[name]])
+        got_ref = gold[name]
+        assert abs(got_dev - want) <= tol, (
+            f"{prio_name}[{name}]: device={got_dev} want={want}"
+        )
+        assert abs(got_ref - want) <= tol, (
+            f"{prio_name}[{name}]: cpuref={got_ref} want={want}"
+        )
+
+
+def check_predicate(pred_name, nodes, pods, pending, expected):
+    """expected: {node_name: fits_bool}."""
+    _, per_pred, _, golden, row = _run(nodes, pods, [], pending)
+    for name, want in expected.items():
+        got_dev = bool(per_pred[0, PRED_INDEX[pred_name], row[name]])
+        got_ref = golden.predicates(pending, next(n for n in nodes if n.name == name))[pred_name]
+        assert got_dev == want, f"{pred_name}[{name}]: device={got_dev} want={want}"
+        assert got_ref == want, f"{pred_name}[{name}]: cpuref={got_ref} want={want}"
+
+
+# --------------------------------------------------------------------------
+# TestSelectorSpreadPriority (selector_spreading_test.go:41-343)
+# --------------------------------------------------------------------------
+
+def _m(name):
+    return make_node(name, cpu="4", mem="8Gi")
+
+
+def _p(name, node="", labels=None, ns="nsnone"):
+    return make_pod(name, namespace=ns, node_name=node, labels=labels or {})
+
+
+M12 = ["machine1", "machine2"]
+
+SPREAD_CASES = [
+    # (name, pending(labels, ns), existing[(node, labels, ns)],
+    #  services[(ns, selector)], expected{machine: score})
+    ("nothing scheduled",
+     ({}, "nsnone"), [], [], {"machine1": MAXP, "machine2": MAXP}),
+    ("no services",
+     (LAB1, "nsnone"), [("machine1", {}, "nsnone")], [],
+     {"machine1": MAXP, "machine2": MAXP}),
+    ("different services",
+     (LAB1, "nsnone"), [("machine1", LAB2, "nsnone")],
+     [("nsnone", {"key": "value"})],
+     {"machine1": MAXP, "machine2": MAXP}),
+    ("two pods, one service pod",
+     (LAB1, "nsnone"),
+     [("machine1", LAB2, "nsnone"), ("machine2", LAB1, "nsnone")],
+     [("nsnone", LAB1)],
+     {"machine1": MAXP, "machine2": 0}),
+    ("five pods, one service pod in no namespace",
+     (LAB1, "nsnone"),
+     [("machine1", LAB2, "nsnone"),
+      ("machine1", LAB1, "default"),
+      ("machine1", LAB1, "ns1"),
+      ("machine2", LAB1, "nsnone"),
+      ("machine2", LAB2, "nsnone")],
+     [("nsnone", LAB1)],
+     {"machine1": MAXP, "machine2": 0}),
+    ("four pods, one service pod in default namespace",
+     (LAB1, "default"),
+     [("machine1", LAB1, "nsnone"),
+      ("machine1", LAB1, "ns1"),
+      ("machine2", LAB1, "default"),
+      ("machine2", LAB2, "nsnone")],
+     [("default", LAB1)],
+     {"machine1": MAXP, "machine2": 0}),
+    ("five pods, one service pod in specific namespace",
+     (LAB1, "ns1"),
+     [("machine1", LAB1, "nsnone"),
+      ("machine1", LAB1, "default"),
+      ("machine1", LAB1, "ns2"),
+      ("machine2", LAB1, "ns1"),
+      ("machine2", LAB2, "nsnone")],
+     [("ns1", LAB1)],
+     {"machine1": MAXP, "machine2": 0}),
+    ("three pods, two service pods on different machines",
+     (LAB1, "nsnone"),
+     [("machine1", LAB2, "nsnone"),
+      ("machine1", LAB1, "nsnone"),
+      ("machine2", LAB1, "nsnone")],
+     [("nsnone", LAB1)],
+     {"machine1": 0, "machine2": 0}),
+    ("four pods, three service pods",
+     (LAB1, "nsnone"),
+     [("machine1", LAB2, "nsnone"),
+      ("machine1", LAB1, "nsnone"),
+      ("machine2", LAB1, "nsnone"),
+      ("machine2", LAB1, "nsnone")],
+     [("nsnone", LAB1)],
+     {"machine1": 5, "machine2": 0}),
+    ("service with partial pod label matches",
+     (LAB1, "nsnone"),
+     [("machine1", LAB2, "nsnone"),
+      ("machine1", LAB1, "nsnone"),
+      ("machine2", LAB1, "nsnone")],
+     [("nsnone", {"baz": "blah"})],
+     {"machine1": 0, "machine2": 5}),
+    # service selects {baz: blah} AND the RC selects {foo: bar}: only pods
+    # matching BOTH count (countMatchingPods AND semantics) -> pod2+pod3
+    ("service with partial pod label matches with service and replication controller",
+     (LAB1, "nsnone"),
+     [("machine1", LAB2, "nsnone"),
+      ("machine1", LAB1, "nsnone"),
+      ("machine2", LAB1, "nsnone")],
+     [("nsnone", {"baz": "blah"}), ("nsnone", {"foo": "bar"})],
+     {"machine1": 0, "machine2": 0}),
+    ("disjoined service and replication controller matches no pods",
+     ({"foo": "bar", "bar": "foo"}, "nsnone"),
+     [("machine1", LAB2, "nsnone"),
+      ("machine1", LAB1, "nsnone"),
+      ("machine2", LAB1, "nsnone")],
+     [("nsnone", {"bar": "foo"}), ("nsnone", {"foo": "bar"})],
+     {"machine1": MAXP, "machine2": MAXP}),
+    ("Replication controller with partial pod label matches",
+     (LAB1, "nsnone"),
+     [("machine1", LAB2, "nsnone"),
+      ("machine1", LAB1, "nsnone"),
+      ("machine2", LAB1, "nsnone")],
+     [("nsnone", {"foo": "bar"})],
+     {"machine1": 0, "machine2": 0}),
+    ("Another replication controller with partial pod label matches",
+     (LAB1, "nsnone"),
+     [("machine1", LAB2, "nsnone"),
+      ("machine1", LAB1, "nsnone"),
+      ("machine2", LAB1, "nsnone")],
+     [("nsnone", {"baz": "blah"})],
+     {"machine1": 0, "machine2": 5}),
+]
+
+
+@pytest.mark.parametrize(
+    "case", SPREAD_CASES, ids=[c[0] for c in SPREAD_CASES]
+)
+def test_selector_spread_table(case):
+    name, (plabels, pns), existing, services, expected = case
+    nodes = [_m(n) for n in M12]
+    pods = [
+        _p(f"e{i}", node=n, labels=l, ns=ns)
+        for i, (n, l, ns) in enumerate(existing)
+    ]
+    pending = _p("pending", labels=plabels, ns=pns)
+    check_priority(
+        "SelectorSpreadPriority", nodes, pods, services, pending, expected,
+        tol=1,
+    )
+
+
+# --------------------------------------------------------------------------
+# TestZoneSelectorSpreadPriority (selector_spreading_test.go:377-638)
+# --------------------------------------------------------------------------
+
+ZN = [
+    ("machine1.zone1", "zone1"),
+    ("machine1.zone2", "zone2"),
+    ("machine2.zone2", "zone2"),
+    ("machine1.zone3", "zone3"),
+    ("machine2.zone3", "zone3"),
+    ("machine3.zone3", "zone3"),
+]
+L1Z = {"label1": "l1", "baz": "blah"}
+L2Z = {"label2": "l2", "baz": "blah"}
+
+ZONE_CASES = [
+    ("nothing scheduled", {}, [], [],
+     {n: MAXP for n, _ in ZN}),
+    ("no services", L1Z, [("machine1.zone1", None)], [],
+     {n: MAXP for n, _ in ZN}),
+    ("different services", L1Z, [("machine1.zone1", L2Z)],
+     [("nsnone", {"key": "value"})],
+     {n: MAXP for n, _ in ZN}),
+    ("two pods, 0 matching", L1Z,
+     [("machine1.zone1", L2Z), ("machine1.zone2", L2Z)],
+     [("nsnone", L1Z)],
+     {n: MAXP for n, _ in ZN}),
+    ("two pods, 1 matching (in z2)", L1Z,
+     [("machine1.zone1", L2Z), ("machine1.zone2", L1Z)],
+     [("nsnone", L1Z)],
+     {"machine1.zone1": MAXP, "machine1.zone2": 0, "machine2.zone2": 3,
+      "machine1.zone3": MAXP, "machine2.zone3": MAXP, "machine3.zone3": MAXP}),
+    ("five pods, 3 matching (z2=2, z3=1)", L1Z,
+     [("machine1.zone1", L2Z), ("machine1.zone2", L1Z),
+      ("machine2.zone2", L1Z), ("machine1.zone3", L2Z),
+      ("machine2.zone3", L1Z)],
+     [("nsnone", L1Z)],
+     {"machine1.zone1": MAXP, "machine1.zone2": 0, "machine2.zone2": 0,
+      "machine1.zone3": 6, "machine2.zone3": 3, "machine3.zone3": 6}),
+    ("four pods, 3 matching (z1=1, z2=1, z3=1)", L1Z,
+     [("machine1.zone1", L1Z), ("machine1.zone2", L1Z),
+      ("machine2.zone2", L2Z), ("machine1.zone3", L1Z)],
+     [("nsnone", L1Z)],
+     {"machine1.zone1": 0, "machine1.zone2": 0, "machine2.zone2": 3,
+      "machine1.zone3": 0, "machine2.zone3": 3, "machine3.zone3": 3}),
+]
+
+
+@pytest.mark.parametrize("case", ZONE_CASES, ids=[c[0] for c in ZONE_CASES])
+def test_zone_selector_spread_table(case):
+    name, plabels, existing, services, expected = case
+    nodes = [
+        make_node(n, labels={ZONE_KEY: z}) for n, z in ZN
+    ]
+    pods = [
+        _p(f"e{i}", node=n, labels=l)
+        for i, (n, l) in enumerate(existing)
+    ]
+    pending = _p("pending", labels=plabels)
+    check_priority(
+        "SelectorSpreadPriority", nodes, pods, services, pending, expected,
+        tol=1,
+    )
+
+
+# --------------------------------------------------------------------------
+# TestTaintAndToleration (taint_toleration_test.go:51-231)
+# --------------------------------------------------------------------------
+
+def _taint(key, value, effect):
+    return {"key": key, "value": value, "effect": effect}
+
+
+def _tol(key, value, effect, op="Equal"):
+    return {"key": key, "operator": op, "value": value, "effect": effect}
+
+
+TAINT_CASES = [
+    ("tolerated beats intolerable",
+     [_tol("foo", "bar", "PreferNoSchedule")],
+     [("nodeA", [_taint("foo", "bar", "PreferNoSchedule")]),
+      ("nodeB", [_taint("foo", "blah", "PreferNoSchedule")])],
+     {"nodeA": MAXP, "nodeB": 0}),
+    ("count of tolerated taints does not matter",
+     [_tol("cpu-type", "arm64", "PreferNoSchedule"),
+      _tol("disk-type", "ssd", "PreferNoSchedule")],
+     [("nodeA", []),
+      ("nodeB", [_taint("cpu-type", "arm64", "PreferNoSchedule")]),
+      ("nodeC", [_taint("cpu-type", "arm64", "PreferNoSchedule"),
+                 _taint("disk-type", "ssd", "PreferNoSchedule")])],
+     {"nodeA": MAXP, "nodeB": MAXP, "nodeC": MAXP}),
+    ("more intolerable taints, lower score",
+     [_tol("foo", "bar", "PreferNoSchedule")],
+     [("nodeA", []),
+      ("nodeB", [_taint("cpu-type", "arm64", "PreferNoSchedule")]),
+      ("nodeC", [_taint("cpu-type", "arm64", "PreferNoSchedule"),
+                 _taint("disk-type", "ssd", "PreferNoSchedule")])],
+     {"nodeA": MAXP, "nodeB": 5, "nodeC": 0}),
+    ("only PreferNoSchedule counted",
+     [_tol("cpu-type", "arm64", "NoSchedule"),
+      _tol("disk-type", "ssd", "NoSchedule")],
+     [("nodeA", []),
+      ("nodeB", [_taint("cpu-type", "arm64", "NoSchedule")]),
+      ("nodeC", [_taint("cpu-type", "arm64", "PreferNoSchedule"),
+                 _taint("disk-type", "ssd", "PreferNoSchedule")])],
+     {"nodeA": MAXP, "nodeB": MAXP, "nodeC": 0}),
+    ("no tolerations lands on untainted",
+     [],
+     [("nodeA", []),
+      ("nodeB", [_taint("cpu-type", "arm64", "PreferNoSchedule")])],
+     {"nodeA": MAXP, "nodeB": 0}),
+]
+
+
+@pytest.mark.parametrize("case", TAINT_CASES, ids=[c[0] for c in TAINT_CASES])
+def test_taint_toleration_table(case):
+    name, tols, node_taints, expected = case
+    nodes = [make_node(n, taints=t) for n, t in node_taints]
+    pending = make_pod("pending", tolerations=tols)
+    check_priority("TaintTolerationPriority", nodes, [], [], pending, expected)
+
+
+# --------------------------------------------------------------------------
+# TestPodFitsResources (predicates_test.go:94-360); node allocatable
+# mirrors makeAllocatableResources(10, 20, 32, 5, 20, 5):
+#   cpu=10m, memory=20 bytes, pods=32, example.com/aaa=5,
+#   ephemeral-storage=20, hugepages-2Mi=5
+# --------------------------------------------------------------------------
+
+EXT_A = "example.com/aaa"
+EXT_B = "example.com/bbb"
+
+
+def _res_node():
+    return make_node(
+        "n1", cpu="10m", mem="20", pods=32,
+        allocatable_extra={EXT_A: "5", "ephemeral-storage": "20",
+                           "hugepages-2Mi": "5"},
+    )
+
+
+def _res_pod(name, cpu=0, mem=0, node="", extra=None, inits=None):
+    req = {}
+    if cpu:
+        req["cpu"] = f"{cpu}m"
+    if mem:
+        req["memory"] = str(mem)
+    req.update(extra or {})
+    return make_pod(
+        name, node_name=node, requests=req,
+        init_requests=inits or (),
+    )
+
+
+RES_CASES = [
+    # (name, pending, existing-usage(cpu, mem, extra), fits)
+    ("no resources requested always fits", _res_pod("p"), (10, 20, None), True),
+    ("too many resources fails", _res_pod("p", 1, 1), (10, 20, None), False),
+    ("too many resources fails due to init container cpu",
+     _res_pod("p", 1, 1, inits=[{"cpu": "3m", "memory": "1"}]),
+     (8, 19, None), False),
+    ("too many resources fails due to highest init container cpu",
+     _res_pod("p", 1, 1, inits=[{"cpu": "3m", "memory": "1"},
+                                {"cpu": "2m", "memory": "1"}]),
+     (8, 19, None), False),
+    ("too many resources fails due to init container memory",
+     _res_pod("p", 1, 1, inits=[{"cpu": "1m", "memory": "3"}]),
+     (9, 19, None), False),
+    ("init container fits because it's the max, not sum",
+     _res_pod("p", 1, 1, inits=[{"cpu": "1m", "memory": "1"}]),
+     (9, 19, None), True),
+    ("both resources fit", _res_pod("p", 1, 1), (5, 5, None), True),
+    ("one resource memory fits", _res_pod("p", 2, 1), (9, 5, None), False),
+    ("one resource cpu fits", _res_pod("p", 1, 2), (5, 19, None), False),
+    ("equal edge case", _res_pod("p", 5, 1), (5, 19, None), True),
+    ("extended resource fits",
+     _res_pod("p", extra={EXT_A: "1"}), (0, 0, None), True),
+    ("extended resource capacity enforced",
+     _res_pod("p", 1, 1, extra={EXT_A: "10"}), (0, 0, None), False),
+    ("extended resource allocatable enforced",
+     _res_pod("p", 1, 1, extra={EXT_A: "1"}), (0, 0, {EXT_A: "5"}), False),
+    ("extended resource allocatable enforced for unknown resource",
+     _res_pod("p", 1, 1, extra={EXT_B: "1"}), (0, 0, None), False),
+    ("storage ephemeral request exceeds allocatable",
+     _res_pod("p", extra={"ephemeral-storage": "25"}), (2, 2, None), False),
+    ("ephemeral storage pod fits",
+     _res_pod("p", extra={"ephemeral-storage": "10"}), (2, 2, None), True),
+]
+
+
+@pytest.mark.parametrize("case", RES_CASES, ids=[c[0] for c in RES_CASES])
+def test_pod_fits_resources_table(case):
+    name, pending, (ucpu, umem, uextra), fits = case
+    node = _res_node()
+    existing = _res_pod("existing", ucpu, umem, node="n1", extra=uextra)
+    check_predicate(
+        "PodFitsResources", [node], [existing], pending, {"n1": fits}
+    )
+
+
+# --------------------------------------------------------------------------
+# TestPodFitsHost (predicates_test.go:494-579)
+# --------------------------------------------------------------------------
+
+HOST_CASES = [
+    ("no host specified", "", "foo", True),
+    ("host matches", "foo", "foo", True),
+    ("host doesn't match", "bar", "foo", False),
+]
+
+
+@pytest.mark.parametrize("case", HOST_CASES, ids=[c[0] for c in HOST_CASES])
+def test_pod_fits_host_table(case):
+    name, want_host, node_name, fits = case
+    node = make_node(node_name)
+    # spec.nodeName on a PENDING pod = requested host (PodFitsHost)
+    pending = make_pod("pending", node_name=want_host)
+    check_predicate("PodFitsHost", [node], [], pending, {node_name: fits})
+
+
+# --------------------------------------------------------------------------
+# TestPodFitsHostPorts (predicates_test.go:580-695)
+# port spec: (protocol, hostIP, hostPort)
+# --------------------------------------------------------------------------
+
+def _ports_pod(name, specs, node=""):
+    return make_pod(
+        name, node_name=node,
+        ports=[
+            {"protocol": proto, "hostIP": ip, "hostPort": port,
+             "containerPort": port}
+            for proto, ip, port in specs
+        ],
+    )
+
+
+PORT_CASES = [
+    ("nothing running", [], [], True),
+    ("other port", [("UDP", "127.0.0.1", 8080)],
+     [("UDP", "127.0.0.1", 9090)], True),
+    ("same udp port", [("UDP", "127.0.0.1", 8080)],
+     [("UDP", "127.0.0.1", 8080)], False),
+    ("same tcp port", [("TCP", "127.0.0.1", 8080)],
+     [("TCP", "127.0.0.1", 8080)], False),
+    ("different host ip", [("TCP", "127.0.0.1", 8080)],
+     [("TCP", "127.0.0.2", 8080)], True),
+    ("different protocol", [("UDP", "127.0.0.1", 8080)],
+     [("TCP", "127.0.0.1", 8080)], True),
+    ("second udp port conflict",
+     [("UDP", "127.0.0.1", 8000), ("UDP", "127.0.0.1", 8080)],
+     [("UDP", "127.0.0.1", 8080)], False),
+    ("first tcp port conflict",
+     [("TCP", "127.0.0.1", 8001), ("UDP", "127.0.0.1", 8080)],
+     [("TCP", "127.0.0.1", 8001), ("UDP", "127.0.0.1", 8081)], False),
+    ("first tcp port conflict due to 0.0.0.0 hostIP",
+     [("TCP", "0.0.0.0", 8001)], [("TCP", "127.0.0.1", 8001)], False),
+    ("TCP hostPort conflict due to 0.0.0.0 hostIP",
+     [("TCP", "10.0.10.10", 8001), ("TCP", "0.0.0.0", 8001)],
+     [("TCP", "127.0.0.1", 8001)], False),
+    ("second tcp port conflict to 0.0.0.0 hostIP",
+     [("TCP", "127.0.0.1", 8001)], [("TCP", "0.0.0.0", 8001)], False),
+    ("second different protocol",
+     [("UDP", "127.0.0.1", 8001)], [("TCP", "0.0.0.0", 8001)], True),
+    ("UDP hostPort conflict due to 0.0.0.0 hostIP",
+     [("UDP", "127.0.0.1", 8001)],
+     [("TCP", "0.0.0.0", 8001), ("UDP", "0.0.0.0", 8001)], False),
+]
+
+
+@pytest.mark.parametrize("case", PORT_CASES, ids=[c[0] for c in PORT_CASES])
+def test_pod_fits_host_ports_table(case):
+    name, want, running, fits = case
+    node = make_node("m1")
+    existing = [_ports_pod("existing", running, node="m1")] if running else []
+    pending = _ports_pod("pending", want)
+    check_predicate(
+        "PodFitsHostPorts", [node], existing, pending, {"m1": fits}
+    )
+
+
+# --------------------------------------------------------------------------
+# TestCheckNodeUnschedulablePredicate (predicates_test.go:4945-4995)
+# --------------------------------------------------------------------------
+
+def test_check_node_unschedulable_table():
+    sched = make_node("ok")
+    unsched = make_node("cordoned", unschedulable=True)
+    pending = make_pod("pending")
+    check_predicate(
+        "CheckNodeUnschedulable", [sched, unsched], [], pending,
+        {"ok": True, "cordoned": False},
+    )
+    # pod tolerating the unschedulable taint passes
+    # (predicates.go:1511-1529 tolerates node.kubernetes.io/unschedulable)
+    tol = make_pod(
+        "tolerant",
+        tolerations=[{"key": "node.kubernetes.io/unschedulable",
+                      "operator": "Exists"}],
+    )
+    check_predicate(
+        "CheckNodeUnschedulable", [sched, unsched], [], tol,
+        {"ok": True, "cordoned": True},
+    )

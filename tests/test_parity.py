@@ -52,7 +52,7 @@ def run_device(enc, pending):
     batch = enc.encode_pods(pending)
     unsched = enc.interner.lookup("node.kubernetes.io/unschedulable")
     mask, per_pred = filter_batch(cluster, batch, FilterConfig(), max(unsched, 0))
-    total, per_prio = score_batch(cluster, batch)
+    total, per_prio = score_batch(cluster, batch, zone_key_id=enc.getzone_key)
     return cluster, batch, np.asarray(mask), np.asarray(per_pred), np.asarray(total), np.asarray(per_prio)
 
 
