@@ -140,6 +140,8 @@ class SnapshotEncoder:
         self.zone_key = self.interner.intern(ZONE_KEY)
         self.region_key = self.interner.intern(REGION_KEY)
         self.getzone_key = self.interner.intern(GETZONE_KEY)
+        # zone_key_id=5 default in ops/models signatures rides this order
+        assert self.getzone_key == 5, "GETZONE_KEY intern-order contract"
         self.topo_keys: Set[int] = {self.hostname_key, self.zone_key, self.region_key}
 
         # topology-pair vocabulary
@@ -221,7 +223,6 @@ class SnapshotEncoder:
         self.a_pip = np.full((n, d.P), PAD, i32)
         self.a_pused = np.zeros((n, d.P), bool)
         self.a_topo = np.zeros((n, self.dims.TP), bool)
-        self.a_zone = np.full(n, PAD, i32)
         self.a_img_id = np.full((n, d.I), PAD, i32)
         self.a_img_sz = np.zeros((n, d.I), f32)
         self.a_avoid = np.full((n, d.A), PAD, i32)
@@ -507,9 +508,6 @@ class SnapshotEncoder:
                 self.getzone_key, it.intern(region + ":\x00:" + zone)
             )
             self.a_topo[row, gz_pid] = True
-            self.a_zone[row] = gz_pid
-        else:
-            self.a_zone[row] = PAD
         # images
         self.a_img_id[row, :] = PAD
         self.a_img_sz[row, :] = 0.0
@@ -1002,19 +1000,6 @@ class SnapshotEncoder:
                 m &= keep
         return m
 
-    def _group_counts(self) -> np.ndarray:
-        counts = np.zeros((self._cap_n, self.dims.G), np.float32)
-        for gi, (ns, sel) in enumerate(self._spread):
-            nsid = self.interner.lookup(ns)
-            if nsid < 0:
-                continue
-            matched = self._match_selector_vec(sel, [nsid])
-            nodes = self.p_node[matched]
-            nodes = nodes[nodes >= 0]
-            if nodes.size:
-                counts[:, gi] = np.bincount(nodes, minlength=self._cap_n).astype(np.float32)
-        return counts
-
     # ------------------------------------------------------------- snapshot
 
     def snapshot(self) -> ClusterTensors:
@@ -1054,8 +1039,9 @@ class SnapshotEncoder:
             port_ip=self.a_pip.copy(),
             port_used=self.a_pused.copy(),
             topo_pairs=self.a_topo.copy(),
-            zone_id=self.a_zone.copy(),
-            group_counts=self._group_counts(),
+            # shape carrier only: spread scoring reads PodBatch.spread_counts;
+            # G here sizes the in-batch group one-hots
+            group_counts=np.zeros((self._cap_n, self.dims.G), np.float32),
             pair_topo_key=pk,
             image_id=self.a_img_id.copy(),
             image_size=(self.a_img_sz * scale).astype(np.float32),

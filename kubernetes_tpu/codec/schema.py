@@ -176,7 +176,7 @@ class ClusterTensors:
     """Struct-of-arrays cluster snapshot, node axis N.
 
     Dynamic fields (mutated by the on-device commit step of batched
-    scheduling): requested, nonzero_req, port_used, group_counts, pair_counts.
+    scheduling): requested, nonzero_req, port_used.
     Everything else is static per snapshot.
     """
 
@@ -206,9 +206,11 @@ class ClusterTensors:
     port_used: Any          # bool[N, P] slot occupancy
     # -- topology --
     topo_pairs: Any         # bool[N, TP] node belongs to topology pair tp
-    zone_id: Any            # i32[N]      GetZoneKey pair id (PAD = no zone)
+    #   (includes the synthetic GetZoneKey pair grouping nodes by region+zone)
     # -- spreading (SelectorSpread) --
-    group_counts: Any       # f32[N, G]  matching existing pods per spread group
+    group_counts: Any       # f32[N, G]  zero-filled shape carrier (G = spread
+                            #   groups); per-pod counts live in
+                            #   PodBatch.spread_counts
     # -- inter-pod affinity state --
     pair_topo_key: Any      # i32[TP]    topology-key id of each pair (PAD unused)
     # -- images (ImageLocality) --

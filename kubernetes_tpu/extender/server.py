@@ -123,8 +123,10 @@ class ExtenderServer:
             self._pending[(pod.namespace, pod.name)] = pod
             while len(self._pending) > self._pending_cap:
                 self._pending.popitem(last=False)
-            cluster, _ = self.cache.snapshot()
+            # encode BEFORE snapshot: terms register topology keys with
+            # node-pair backfill that the snapshot must include
             batch = enc.encode_pods([pod])
+            cluster, _ = self.cache.snapshot()
             out = schedule_batch_independent(
                 cluster, batch, 0, self.cfg, self._unsched, enc.getzone_key
             )
@@ -152,8 +154,10 @@ class ExtenderServer:
         pod = Pod.from_dict(pod_d)
         enc = self.cache.encoder
         with self.cache._lock:
-            cluster, _ = self.cache.snapshot()
+            # encode BEFORE snapshot: terms register topology keys with
+            # node-pair backfill that the snapshot must include
             batch = enc.encode_pods([pod])
+            cluster, _ = self.cache.snapshot()
             out = schedule_batch_independent(
                 cluster, batch, 0, self.cfg, self._unsched, enc.getzone_key
             )

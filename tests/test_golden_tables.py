@@ -10,6 +10,7 @@ asserted against BOTH the device kernels and the cpuref golden:
   * TestPodFitsHost                  predicates_test.go:494-579
   * TestPodFitsHostPorts             predicates_test.go:580-695
   * TestCheckNodeUnschedulablePredicate predicates_test.go:4945-4995
+  * TestInterPodAffinity              predicates_test.go:1960-2920 (1-node cases)
 
 Scores computed through float blending (SelectorSpread's 2/3-zone weighting)
 follow the PARITY.md f32 rule: +-1 at non-binary-exact int boundaries;
@@ -43,8 +44,10 @@ def _run(nodes, pods, services, pending):
         enc.add_pod(p)
     for ns, sel in services:
         enc.add_spread_selector(ns, sel)
-    cluster = enc.snapshot()
+    # encode first: terms register their topology keys (node-pair backfill)
+    # before the snapshot is cut, matching the runtime's encode->snapshot order
     batch = enc.encode_pods([pending])
+    cluster = enc.snapshot()
     unsched = enc.interner.lookup("node.kubernetes.io/unschedulable")
     mask, per_pred = filter_batch(cluster, batch, FilterConfig(), max(unsched, 0))
     _, per_prio = score_batch(cluster, batch, zone_key_id=enc.getzone_key)
@@ -513,4 +516,133 @@ def test_check_node_unschedulable_table():
     check_predicate(
         "CheckNodeUnschedulable", [sched, unsched], [], tol,
         {"ok": True, "cordoned": True},
+    )
+
+
+# --------------------------------------------------------------------------
+# TestInterPodAffinity (predicates_test.go:1960-2920), single-node cases.
+# machine1 carries labels {region: r1, zone: z11}; terms use topology keys
+# region/zone/node ("node" is absent from the node's labels).
+# --------------------------------------------------------------------------
+
+POD_LABEL = {"service": "securityscan"}
+POD_LABEL2 = {"security": "S1"}
+
+
+def _term(exprs, topo="", namespaces=None):
+    t = {
+        "labelSelector": {
+            "matchExpressions": [
+                {"key": k, "operator": op,
+                 **({"values": list(vals)} if vals else {})}
+                for k, op, vals in exprs
+            ]
+        },
+        "topologyKey": topo,
+    }
+    if namespaces:
+        t["namespaces"] = list(namespaces)
+    return t
+
+
+def _aff(aff=None, anti=None):
+    d = {}
+    if aff:
+        d["podAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": list(aff)
+        }
+    if anti:
+        d["podAntiAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": list(anti)
+        }
+    return d or None
+
+
+IPA_CASES = [
+    ("no required affinity schedules onto empty node",
+     ({}, None), [], True),
+    ("In operator matches existing pod",
+     (POD_LABEL2, _aff(aff=[_term([("service", "In", ["securityscan", "value2"])], "region")])),
+     [("machine1", POD_LABEL, None, "nsnone")], True),
+    ("NotIn operator matches existing pod",
+     (POD_LABEL2, _aff(aff=[_term([("service", "NotIn", ["securityscan3", "value3"])], "region")])),
+     [("machine1", POD_LABEL, None, "nsnone")], True),
+    ("diff namespace does not satisfy",
+     (POD_LABEL2, _aff(aff=[_term([("service", "In", ["securityscan", "value2"])], namespaces=["diffns"])])),
+     [("machine1", POD_LABEL, None, "ns")], False),
+    ("unmatching labelSelector fails",
+     (POD_LABEL2, _aff(aff=[_term([("service", "In", ["antivirusscan", "value2"])])])),
+     [("machine1", POD_LABEL, None, "nsnone")], False),
+    ("multiple operators across terms all satisfied",
+     (POD_LABEL2, _aff(aff=[
+         _term([("service", "Exists", None), ("wrongkey", "DoesNotExist", None)], "region"),
+         _term([("service", "In", ["securityscan"]), ("service", "NotIn", ["WrongValue"])], "region")])),
+     [("machine1", POD_LABEL, None, "nsnone")], True),
+    ("ANDed matchExpressions: one mismatching item fails",
+     (POD_LABEL2, _aff(aff=[
+         _term([("service", "Exists", None), ("wrongkey", "DoesNotExist", None)], "region"),
+         _term([("service", "In", ["securityscan2"]), ("service", "NotIn", ["WrongValue"])], "region")])),
+     [("machine1", POD_LABEL, None, "nsnone")], False),
+    ("affinity + non-matching anti-affinity",
+     (POD_LABEL2, _aff(
+         aff=[_term([("service", "In", ["securityscan", "value2"])], "region")],
+         anti=[_term([("service", "In", ["antivirusscan", "value2"])], "node")])),
+     [("machine1", POD_LABEL, None, "nsnone")], True),
+    ("affinity + anti-affinity + existing-pod anti-affinity symmetry ok",
+     (POD_LABEL2, _aff(
+         aff=[_term([("service", "In", ["securityscan", "value2"])], "region")],
+         anti=[_term([("service", "In", ["antivirusscan", "value2"])], "node")])),
+     [("machine1", POD_LABEL,
+       _aff(anti=[_term([("service", "In", ["antivirusscan", "value2"])], "node")]),
+       "nsnone")], True),
+    ("affinity ok but anti-affinity violated",
+     (POD_LABEL2, _aff(
+         aff=[_term([("service", "In", ["securityscan", "value2"])], "region")],
+         anti=[_term([("service", "In", ["securityscan", "value2"])], "zone")])),
+     [("machine1", POD_LABEL, None, "nsnone")], False),
+    ("existing pod's anti-affinity symmetry violated",
+     (POD_LABEL, _aff(
+         aff=[_term([("service", "In", ["securityscan", "value2"])], "region")],
+         anti=[_term([("service", "In", ["antivirusscan", "value2"])], "node")])),
+     [("machine1", POD_LABEL,
+       _aff(anti=[_term([("service", "In", ["securityscan", "value2"])], "zone")]),
+       "nsnone")], False),
+    ("pod matching its own label does not bootstrap a NotIn term",
+     (POD_LABEL, _aff(aff=[_term([("service", "NotIn", ["securityscan", "value2"])], "region")])),
+     [("machine2", POD_LABEL, None, "nsnone")], False),
+    ("existing anti-affinity respected: symmetry violated",
+     (POD_LABEL, None),
+     [("machine1", POD_LABEL,
+       _aff(anti=[_term([("service", "In", ["securityscan", "value2"])], "zone")]),
+       "nsnone")], False),
+    ("existing anti-affinity respected: symmetry satisfied",
+     (POD_LABEL, None),
+     [("machine1", POD_LABEL,
+       _aff(anti=[_term([("service", "NotIn", ["securityscan", "value2"])], "zone")]),
+       "nsnone")], True),
+    ("own anti-affinity partially matches existing pod",
+     (POD_LABEL, _aff(anti=[
+         _term([("service", "Exists", None)], "region"),
+         _term([("security", "Exists", None)], "region")])),
+     [("machine1", POD_LABEL2,
+       _aff(anti=[_term([("security", "Exists", None)], "zone")]),
+       "nsnone")], False),
+]
+
+
+@pytest.mark.parametrize("case", IPA_CASES, ids=[c[0] for c in IPA_CASES])
+def test_inter_pod_affinity_table(case):
+    name, (plabels, paff), existing, fits = case
+    nodes = [
+        make_node("machine1", labels={"region": "r1", "zone": "z11"}),
+        make_node("machine2"),  # bare landing spot for off-node existing pods
+    ]
+    pods = [
+        make_pod(f"e{i}", namespace=ns, node_name=n, labels=l, affinity=a)
+        for i, (n, l, a, ns) in enumerate(existing)
+    ]
+    pending = make_pod("pending", namespace="nsnone", labels=plabels,
+                       affinity=paff)
+    check_predicate(
+        "MatchInterPodAffinity", nodes, pods, pending, {"machine1": fits}
     )
