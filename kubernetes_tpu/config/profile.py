@@ -71,6 +71,9 @@ class SchedulingProfile:
     weights: tuple  # len == NUM_PRIORITIES, PRIORITY_ORDER order
     hard_pod_affinity_weight: float = 1.0
     always_check_all_predicates: bool = False
+    # Policy "extenders" entries (api/types.go:203-240), as
+    # extender.client.ExtenderConfig
+    extender_configs: tuple = ()
 
     def weights_array(self) -> np.ndarray:
         return np.asarray(self.weights, np.float32)
@@ -226,6 +229,8 @@ def profile_from_policy(
         label_prefs=tuple(label_prefs),
         rtc_shape=rtc_shape if rtc_shape else ScoreConfig.rtc_shape,
     )
+    from kubernetes_tpu.extender.client import ExtenderConfig
+
     return SchedulingProfile(
         name="policy",
         filter_config=fc,
@@ -233,4 +238,7 @@ def profile_from_policy(
         weights=_weights_vector(prio),
         hard_pod_affinity_weight=hard_w,
         always_check_all_predicates=bool(policy.get("alwaysCheckAllPredicates", False)),
+        extender_configs=tuple(
+            ExtenderConfig.from_dict(e) for e in policy.get("extenders") or ()
+        ),
     )

@@ -1,0 +1,257 @@
+"""Scheduler-side HTTP extender client.
+
+The analog of HTTPExtender (ref pkg/scheduler/core/extender.go:42-445): our
+scheduler *calls out* to external extenders — filter round-trips narrow the
+feasible set, prioritize results weight-merge into the score matrix
+(generic_scheduler.go:774-804), and a bind-verb extender replaces the default
+binder for pods it manages.  Config spelling mirrors ExtenderConfig
+(pkg/scheduler/api/types.go:203-240: urlPrefix/filterVerb/prioritizeVerb/
+bindVerb/weight/httpTimeout/nodeCacheCapable/managedResources/ignorable).
+
+Tensor-pipeline integration: the reference chains extenders AFTER its in-tree
+predicate scan per pod (generic_scheduler.go:527-554).  Here the device scan
+is one launch for the whole batch, so extender verdicts are gathered host-side
+FIRST and folded in as an extra feasibility mask / score addend — the same
+intersection/addition semantics, reordered (extender approval is never a
+union, so filtering before or after the device pass yields the same set).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.api.types import Pod
+
+
+class ExtenderError(Exception):
+    """Non-ignorable extender failure: scheduling of the pod fails
+    (generic_scheduler.go:533-541)."""
+
+
+@dataclass(frozen=True)
+class ExtenderConfig:
+    """ref pkg/scheduler/api/types.go:203-240 (ExtenderConfig)."""
+
+    url_prefix: str
+    filter_verb: str = ""
+    preempt_verb: str = ""
+    prioritize_verb: str = ""
+    bind_verb: str = ""
+    weight: int = 1
+    http_timeout: float = 30.0      # DefaultExtenderTimeout (extender.go:39)
+    node_cache_capable: bool = False
+    managed_resources: Tuple[str, ...] = ()
+    ignorable: bool = False
+
+    @staticmethod
+    def from_dict(d: dict) -> "ExtenderConfig":
+        """Policy-JSON spelling (v1 Policy "extenders" entries).
+
+        httpTimeout is a Go time.Duration, which marshals to JSON as integer
+        NANOSECONDS — a real policy file says 100000000 for 100ms."""
+        ns = d.get("httpTimeout")
+        return ExtenderConfig(
+            url_prefix=d.get("urlPrefix", ""),
+            filter_verb=d.get("filterVerb", ""),
+            preempt_verb=d.get("preemptVerb", ""),
+            prioritize_verb=d.get("prioritizeVerb", ""),
+            bind_verb=d.get("bindVerb", ""),
+            weight=int(d.get("weight", 1)),
+            http_timeout=float(ns) / 1e9 if ns else 30.0,
+            node_cache_capable=bool(d.get("nodeCacheCapable", False)),
+            managed_resources=tuple(
+                r.get("name", "") for r in d.get("managedResources") or ()
+            ),
+            ignorable=bool(d.get("ignorable", False)),
+        )
+
+
+def pod_to_dict(pod: Pod) -> dict:
+    """Wire form of the fields our Pod model carries (ExtenderArgs.Pod)."""
+    return {
+        "metadata": {
+            "name": pod.name,
+            "namespace": pod.namespace,
+            "uid": pod.metadata.uid,
+            "labels": dict(pod.labels),
+        },
+        "spec": {
+            "nodeName": pod.spec.node_name,
+            "priority": pod.spec.priority,
+            "containers": [
+                {
+                    "name": c.name,
+                    "image": c.image,
+                    "resources": {
+                        "requests": {k: str(q) for k, q in c.requests.items()},
+                        "limits": {k: str(q) for k, q in c.limits.items()},
+                    },
+                    "ports": [
+                        {
+                            "hostPort": p.host_port,
+                            "containerPort": p.container_port,
+                            "protocol": p.protocol,
+                            "hostIP": p.host_ip,
+                        }
+                        for p in c.ports
+                    ],
+                }
+                for c in pod.spec.containers
+            ],
+        },
+    }
+
+
+class HTTPExtender:
+    """One configured extender endpoint.
+
+    `transport` (tests): callable (url, payload_dict, timeout) -> response
+    dict, replacing the urllib POST.
+    """
+
+    def __init__(
+        self,
+        config: ExtenderConfig,
+        transport: Optional[Callable[[str, dict, float], dict]] = None,
+    ):
+        self.config = config
+        self._transport = transport or self._http_post
+
+    @property
+    def name(self) -> str:                       # extender.go:119-122
+        return self.config.url_prefix
+
+    @property
+    def is_binder(self) -> bool:                 # extender.go:384-387
+        return bool(self.config.bind_verb)
+
+    @property
+    def is_ignorable(self) -> bool:
+        return self.config.ignorable
+
+    def is_interested(self, pod: Pod) -> bool:
+        """extender.go:415-436: managed-resources gate — empty set means
+        every pod; otherwise any container (incl. init) must request one."""
+        managed = set(self.config.managed_resources)
+        if not managed:
+            return True
+        for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+            if managed & set(c.requests) or managed & set(c.limits):
+                return True
+        return False
+
+    @property
+    def supports_preemption(self) -> bool:    # extender.go:129-132
+        return bool(self.config.preempt_verb)
+
+    # ------------------------------------------------------------- verbs
+
+    def _args(self, pod: Pod, node_names: Sequence[str]) -> dict:
+        """ExtenderArgs: names only when nodeCacheCapable, else node
+        objects (extender.go:274-291)."""
+        args: dict = {"pod": pod_to_dict(pod)}
+        if self.config.node_cache_capable:
+            args["nodenames"] = list(node_names)
+        else:
+            args["nodes"] = {
+                "items": [{"metadata": {"name": n}} for n in node_names]
+            }
+        return args
+
+    def filter(
+        self, pod: Pod, node_names: Sequence[str]
+    ) -> Tuple[List[str], Dict[str, str]]:
+        """extender.go:258-316 Filter.  Returns (feasible subset, failed
+        node -> reason).  Raises ExtenderError on transport/Error result."""
+        if not self.config.filter_verb:
+            return list(node_names), {}
+        result = self._send(self.config.filter_verb, self._args(pod, node_names))
+        if result.get("error"):
+            raise ExtenderError(result["error"])
+        if self.config.node_cache_capable and result.get("nodenames") is not None:
+            ok = list(result["nodenames"])
+        elif result.get("nodes") is not None:
+            ok = [
+                it.get("metadata", {}).get("name", "")
+                for it in result["nodes"].get("items", [])
+            ]
+        else:
+            ok = []
+        return ok, dict(result.get("failedNodes") or {})
+
+    def prioritize(
+        self, pod: Pod, node_names: Sequence[str]
+    ) -> Tuple[Dict[str, float], int]:
+        """extender.go:318-358 Prioritize: (host -> score, weight); scores
+        merge additively as score*weight (generic_scheduler.go:790-799)."""
+        if not self.config.prioritize_verb:
+            return {n: 0.0 for n in node_names}, 0
+        result = self._send(
+            self.config.prioritize_verb, self._args(pod, node_names)
+        )
+        scores: Dict[str, float] = {}
+        for item in result or []:
+            scores[item.get("host", "")] = float(item.get("score", 0))
+        return scores, self.config.weight
+
+    def process_preemption(
+        self, pod: Pod, node_victims: Dict[str, dict]
+    ) -> Dict[str, dict]:
+        """extender.go:135-200 ProcessPreemption: candidate node ->
+        MetaVictims ({"pods": [{"uid": ...}], "numPDBViolations": n});
+        the extender returns the (possibly narrowed) map — a node absent
+        from the reply is no longer a preemption candidate."""
+        if not self.supports_preemption:
+            raise ExtenderError(
+                f"preempt verb is not defined for extender {self.name}"
+            )
+        args = {
+            "pod": pod_to_dict(pod),
+            "nodeNameToMetaVictims": node_victims,
+        }
+        result = self._send(self.config.preempt_verb, args)
+        return dict(result.get("nodeNameToMetaVictims") or {})
+
+    def bind(self, namespace: str, name: str, uid: str, node: str) -> None:
+        """extender.go:360-382 Bind; raises ExtenderError on failure."""
+        if not self.is_binder:
+            raise ExtenderError("unexpected empty bindVerb in extender")
+        result = self._send(
+            self.config.bind_verb,
+            {"podName": name, "podNamespace": namespace, "podUID": uid,
+             "node": node},
+        )
+        if result and result.get("error"):
+            raise ExtenderError(result["error"])
+
+    # --------------------------------------------------------- transport
+
+    def _send(self, verb: str, args) -> dict:
+        url = self.config.url_prefix.rstrip("/") + "/" + verb
+        try:
+            return self._transport(url, args, self.config.http_timeout)
+        except ExtenderError:
+            raise
+        except Exception as e:  # timeouts, refused connections, bad JSON
+            raise ExtenderError(f"extender {url}: {e}") from e
+
+    @staticmethod
+    def _http_post(url: str, payload: dict, timeout: float) -> dict:
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+
+
+def build_extenders(configs: Sequence[dict]) -> List[HTTPExtender]:
+    """Policy JSON "extenders" list -> clients (factory.go CreateFromConfig
+    path that instantiates HTTPExtender per entry)."""
+    return [HTTPExtender(ExtenderConfig.from_dict(c)) for c in configs]

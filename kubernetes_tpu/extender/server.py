@@ -184,8 +184,10 @@ class ExtenderServer:
         from kubernetes_tpu.ops.predicates import required_affinity_ok
 
         with self.cache._lock:
-            cluster, _ = self.cache.snapshot()
+            # encode BEFORE snapshot (topology-key backfill), as in filter/
+            # prioritize above
             batch = enc.encode_pods([pod])
+            cluster, _ = self.cache.snapshot()
             _, per_pred = filter_batch(cluster, batch, self.cfg, self._unsched)
             aff_ok = required_affinity_ok(cluster, batch)
             cands = preemption_candidates(

@@ -101,6 +101,7 @@ class Scheduler:
         pdb_lister: Optional[Callable[[], List[PodDisruptionBudget]]] = None,
         framework=None,  # framework.v1alpha1.Framework; None = no plugins
         recorder: Optional[EventRecorder] = None,
+        extenders: Optional[Sequence] = None,  # extender.client.HTTPExtender
     ):
         # NB: PriorityQueue defines __len__, so `queue or PriorityQueue()`
         # would silently replace an *empty* caller-owned queue
@@ -123,6 +124,14 @@ class Scheduler:
             score_cfg=prof.score_config if prof is not None else None,
         )
         self.framework = framework
+        # scheduler-side extender chain (core/extender.go; chained in config
+        # order at generic_scheduler.go:527-554); built from the Policy's
+        # "extenders" entries when not injected directly
+        if extenders is None and prof is not None and prof.extender_configs:
+            from kubernetes_tpu.extender.client import HTTPExtender
+
+            extenders = [HTTPExtender(c) for c in prof.extender_configs]
+        self.extenders = list(extenders or [])
         # "Scheduled"/"FailedScheduling"/"Preempted" audit trail
         # (tools/record; scheduler.go:268,433,325); wire_scheduler replaces a
         # defaulted recorder with the cluster's shared one
@@ -171,6 +180,10 @@ class Scheduler:
                 ],
             )
             cluster, generation = self.cache.snapshot()
+            # point-in-time name->row map consistent with THIS snapshot;
+            # extender round-trips below run outside the lock, and the live
+            # node_rows dict may be mutated (rows recycled/regrown) meanwhile
+            node_row_map = dict(enc.node_rows)
         trace.step("encode")
         fwk = self.framework
         pc = None
@@ -195,6 +208,12 @@ class Scheduler:
                     ),
                     np.float32,
                 )
+        ext_failed: Dict[int, str] = {}
+        if self.extenders:
+            extra_mask, extra_score, ext_failed = self._apply_extenders(
+                pods, node_row_map, cluster, extra_mask, extra_score
+            )
+            trace.step("extenders")
         hosts, _ = self._schedule_fn(
             cluster, batch, ports, np.int32(self._last_index), nominated,
             extra_mask, extra_score, aff_state,
@@ -211,6 +230,19 @@ class Scheduler:
         fit_errors: List[Pod] = []
         for i, pod in enumerate(pods):
             row = int(hosts[i])
+            if i in ext_failed:
+                # non-ignorable extender error: plain error requeue, NOT a
+                # FitError — no preemption (scheduler.go:463 preempts only
+                # on core.FitError; extender errors surface as plain errors)
+                self.queue.add_unschedulable(pod, cycle)
+                results.append(ScheduleResult(pod, None, generation))
+                m.SCHEDULE_ATTEMPTS.inc(result=m.SCHEDULE_ERROR)
+                self.recorder.eventf(
+                    "Pod", pod.namespace, pod.name,
+                    EVENT_TYPE_WARNING, "FailedScheduling",
+                    "extender error: %s", ext_failed[i],
+                )
+                continue
             if row < 0:
                 # FitError path: park in unschedulableQ with backoff
                 # (factory.go MakeDefaultErrorFunc), then try preemption
@@ -257,6 +289,79 @@ class Scheduler:
         m.PENDING_PODS.set(float(len(self.queue)))
         self.results.extend(results)
         return results
+
+    # --------------------------------------------------------- extenders
+
+    def _apply_extenders(self, pods, rows, cluster, extra_mask, extra_score):
+        """Chain the configured HTTP extenders per pod: each filter
+        round-trip intersects the feasibility mask (an extender can only
+        veto, never resurrect — generic_scheduler.go:527-554), prioritize
+        results add score*weight (:774-804, merged before selectHost).
+
+        `rows` is the snapshot-consistent name->row map captured under the
+        cache lock.  The extender chain is sequential per pod (each link
+        sees the previous link's narrowed list), but pods fan out across a
+        small thread pool — the reference's 16-goroutine analog for the
+        network-bound section.  Returns (mask, score, failed{batch index:
+        message}); a pod whose non-ignorable extender errored is fully
+        masked and listed in failed."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from kubernetes_tpu.extender.client import ExtenderError
+
+        B, N = len(pods), cluster.n_nodes
+        mask = (
+            np.ones((B, N), bool)
+            if extra_mask is None else np.array(extra_mask, bool)
+        )
+        score = (
+            np.zeros((B, N), np.float32)
+            if extra_score is None else np.array(extra_score, np.float32)
+        )
+        failed: Dict[int, str] = {}
+        all_names = [n for n, r in rows.items() if r < N]
+
+        def one_pod(i_pod):
+            i, pod = i_pod
+            names = list(all_names)
+            for ext in self.extenders:
+                if not ext.is_interested(pod):
+                    continue
+                try:
+                    ok, _failed_nodes = ext.filter(pod, names)
+                except ExtenderError as e:
+                    if ext.is_ignorable:
+                        # skip it, let the rest decide (:534-537)
+                        continue
+                    failed[i] = str(e)
+                    mask[i, :] = False
+                    return
+                okset = set(ok)
+                for n in names:
+                    if n not in okset:
+                        mask[i, rows[n]] = False
+                names = [n for n in names if n in okset]
+                if not names:
+                    return
+            for ext in self.extenders:
+                if not ext.is_interested(pod) or not ext.config.prioritize_verb:
+                    continue
+                try:
+                    scores, weight = ext.prioritize(pod, names)
+                except ExtenderError:
+                    # prioritize errors are ignorable by design (:784-787)
+                    continue
+                for n, s in scores.items():
+                    r = rows.get(n)
+                    if r is not None and r < N:
+                        score[i, r] += s * weight
+
+        if B == 1:
+            one_pod((0, pods[0]))
+        else:
+            with ThreadPoolExecutor(max_workers=16) as pool:
+                list(pool.map(one_pod, enumerate(pods)))
+        return mask, score, failed
 
     # ------------------------------------------------- reserve/permit/bind
 
@@ -320,8 +425,21 @@ class Scheduler:
                 return False
         ok = False
         t0 = time.monotonic()
+        # a bind-verb extender binds pods it manages in place of the default
+        # binder (extender.go:360-387; scheduler.go bind path)
+        binder_ext = next(
+            (e for e in self.extenders
+             if e.is_binder and e.is_interested(pod)),
+            None,
+        )
         try:
-            ok = self.binder(assumed, node_name)
+            if binder_ext is not None:
+                binder_ext.bind(
+                    pod.namespace, pod.name, pod.metadata.uid, node_name
+                )
+                ok = True
+            else:
+                ok = self.binder(assumed, node_name)
         except Exception:
             ok = False
         m.BINDING_LATENCY.observe(time.monotonic() - t0)
@@ -421,7 +539,7 @@ class Scheduler:
                 violating,
                 arena.start,
             )
-            row, _, victims, _ = pick_preemption_node(
+            row, _, victims, res = pick_preemption_node(
                 enc, pod, cands, arena, slots, violating,
                 self.config.filter_config.max_vols,
             )
@@ -429,6 +547,13 @@ class Scheduler:
                 self._clear_nomination(pod)
                 return None
             node_name = enc.row_name(row)
+        # preempt-verb extenders vet the candidate + victim set
+        # (processPreemptionWithExtenders, generic_scheduler.go:342-369);
+        # HTTP round-trips happen outside the cache lock
+        victims = self._extender_preemption(pod, node_name, victims, res)
+        if victims is None:
+            self._clear_nomination(pod)
+            return None
         for v in victims:
             self.victim_deleter(v)
             self.recorder.eventf(
@@ -451,6 +576,44 @@ class Scheduler:
         # preemptor retries promptly
         self.queue.move_all_to_active()
         return node_name
+
+    def _extender_preemption(self, pod, node_name, victims, res):
+        """Run ProcessPreemption through every preempt-verb extender that is
+        interested; each may narrow the victim set or drop the node entirely
+        (return None -> abort, nothing evicted).  Non-preempt-verb extenders
+        are skipped, ignorable errors skip just that extender
+        (generic_scheduler.go:342-369)."""
+        chain = [
+            e for e in self.extenders
+            if e.supports_preemption and e.is_interested(pod)
+        ]
+        if not chain:
+            return victims
+        from kubernetes_tpu.extender.client import ExtenderError
+
+        meta = {
+            node_name: {
+                "pods": [{"uid": v.metadata.uid or f"{v.namespace}/{v.name}"}
+                         for v in victims],
+                "numPDBViolations": int(getattr(res, "n_pdb_violations", 0)),
+            }
+        }
+        for ext in chain:
+            try:
+                meta = ext.process_preemption(pod, meta)
+            except ExtenderError:
+                if ext.is_ignorable:
+                    continue
+                return None
+            if node_name not in meta:
+                return None
+        keep = {
+            p.get("uid") for p in meta[node_name].get("pods", [])
+        }
+        return [
+            v for v in victims
+            if (v.metadata.uid or f"{v.namespace}/{v.name}") in keep
+        ]
 
     def _eligible_to_preempt(self, pod: Pod) -> bool:
         """podEligibleToPreemptOthers (generic_scheduler.go:1159-1180): if the

@@ -1,0 +1,310 @@
+"""Scheduler-side extender chaining (ref core/extender.go:42-445,
+generic_scheduler.go:527-554,774-804).
+
+The mock extender is injected through HTTPExtender's transport seam (same
+wire dicts as a real HTTP round-trip, no sockets); one test drives a real
+HTTP server end-to-end to cover the urllib path.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from kubernetes_tpu.extender.client import (
+    ExtenderConfig,
+    ExtenderError,
+    HTTPExtender,
+    build_extenders,
+)
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.queue import PriorityQueue
+from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+from fixtures import make_node, make_pod
+
+
+def _sched(extenders, nodes=3):
+    cache = SchedulerCache()
+    for i in range(nodes):
+        cache.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    bound = []
+    s = Scheduler(
+        cache=cache,
+        queue=PriorityQueue(),
+        binder=lambda p, n: bound.append((p.name, n)) or True,
+        config=SchedulerConfig(),
+        extenders=extenders,
+    )
+    return s, bound
+
+
+class FakeTransport:
+    """Records calls; serves canned per-verb responses."""
+
+    def __init__(self, responses):
+        self.responses = responses
+        self.calls = []
+
+    def __call__(self, url, payload, timeout):
+        verb = url.rsplit("/", 1)[1]
+        self.calls.append((verb, json.loads(json.dumps(payload))))
+        r = self.responses[verb]
+        if isinstance(r, Exception):
+            raise r
+        return r
+
+
+def _ext(responses, **cfg):
+    t = FakeTransport(responses)
+    e = HTTPExtender(
+        ExtenderConfig(url_prefix="http://ext", **cfg), transport=t
+    )
+    return e, t
+
+
+def test_filter_veto_narrows_placement():
+    # extender approves only n1: every pod must land there
+    e, t = _ext(
+        {"filter": {"nodenames": ["n1"], "failedNodes": {}, "error": ""}},
+        filter_verb="filter", node_cache_capable=True,
+    )
+    s, bound = _sched([e])
+    s.queue.add(make_pod("p0", cpu="100m"))
+    s.queue.add(make_pod("p1", cpu="100m"))
+    s.run_once(timeout=0.5)
+    assert sorted(bound) == [("p0", "n1"), ("p1", "n1")]
+    assert t.calls and t.calls[0][0] == "filter"
+    # node_cache_capable sends names only, no node objects
+    assert "nodenames" in t.calls[0][1] and "nodes" not in t.calls[0][1]
+
+
+def test_prioritize_weight_merge_skews_selection():
+    # device scores tie across empty identical nodes; the extender's
+    # score*weight addend must break the tie toward n2
+    e, _ = _ext(
+        {"prioritize": [
+            {"host": "n0", "score": 0},
+            {"host": "n1", "score": 1},
+            {"host": "n2", "score": 5},
+        ]},
+        prioritize_verb="prioritize", weight=10, node_cache_capable=True,
+    )
+    s, bound = _sched([e])
+    s.queue.add(make_pod("p0", cpu="100m"))
+    s.run_once(timeout=0.5)
+    assert bound == [("p0", "n2")]
+
+
+def test_extender_bind_replaces_default_binder():
+    e, t = _ext(
+        {"filter": {"nodenames": ["n0", "n1", "n2"], "failedNodes": {},
+                    "error": ""},
+         "bind": {"error": ""}},
+        filter_verb="filter", bind_verb="bind", node_cache_capable=True,
+    )
+    s, bound = _sched([e])
+    s.queue.add(make_pod("p0", cpu="100m"))
+    s.run_once(timeout=0.5)
+    assert bound == []  # default binder bypassed
+    binds = [c for c in t.calls if c[0] == "bind"]
+    assert len(binds) == 1
+    assert binds[0][1]["podName"] == "p0"
+    assert binds[0][1]["node"] in {"n0", "n1", "n2"}
+    assert s.results[-1].node is not None
+
+
+def test_nonignorable_error_requeues_without_preemption():
+    e, _ = _ext(
+        {"filter": ExtenderError("boom")},
+        filter_verb="filter", node_cache_capable=True,
+    )
+    s, bound = _sched([e])
+    s.queue.add(make_pod("p0", cpu="100m"))
+    s.run_once(timeout=0.5)
+    assert bound == []
+    assert s.results[-1].node is None
+    assert s.preemptions == []
+    assert len(s.queue) == 1  # parked for retry
+
+
+def test_ignorable_error_is_skipped():
+    bad, _ = _ext(
+        {"filter": ExtenderError("down")},
+        filter_verb="filter", node_cache_capable=True, ignorable=True,
+    )
+    s, bound = _sched([bad])
+    s.queue.add(make_pod("p0", cpu="100m"))
+    s.run_once(timeout=0.5)
+    assert len(bound) == 1  # scheduling proceeded without the extender
+
+
+def test_managed_resources_gate():
+    e, t = _ext(
+        {"filter": {"nodenames": [], "failedNodes": {}, "error": ""}},
+        filter_verb="filter", node_cache_capable=True,
+        managed_resources=("example.com/gpu",),
+    )
+    s, bound = _sched([e])
+    # uninterested pod: never sent to the extender, schedules normally
+    s.queue.add(make_pod("plain", cpu="100m"))
+    s.run_once(timeout=0.5)
+    assert len(bound) == 1 and not t.calls
+    # interested pod: extender approves nothing -> unschedulable
+    s.queue.add(make_pod("gpu", requests={"example.com/gpu": "1"}))
+    s.run_once(timeout=0.5)
+    assert len(bound) == 1 and t.calls
+    assert s.results[-1].node is None
+
+
+def test_chaining_intersects():
+    # first approves {n0, n1}, second (fed the narrowed list) approves {n1}
+    e1, _ = _ext(
+        {"filter": {"nodenames": ["n0", "n1"], "failedNodes": {},
+                    "error": ""}},
+        filter_verb="filter", node_cache_capable=True,
+    )
+
+    class SecondTransport(FakeTransport):
+        def __call__(self, url, payload, timeout):
+            got = set(payload["nodenames"])
+            assert got == {"n0", "n1"}, got  # chained input is narrowed
+            return super().__call__(url, payload, timeout)
+
+    t2 = SecondTransport(
+        {"filter": {"nodenames": ["n1"], "failedNodes": {}, "error": ""}}
+    )
+    e2 = HTTPExtender(
+        ExtenderConfig(url_prefix="http://ext2", filter_verb="filter",
+                       node_cache_capable=True),
+        transport=t2,
+    )
+    s, bound = _sched([e1, e2])
+    s.queue.add(make_pod("p0", cpu="100m"))
+    s.run_once(timeout=0.5)
+    assert bound == [("p0", "n1")]
+
+
+def test_non_cache_capable_sends_node_objects():
+    e, t = _ext(
+        {"filter": {"nodes": {"items": [{"metadata": {"name": "n0"}}]},
+                    "failedNodes": {}, "error": ""}},
+        filter_verb="filter", node_cache_capable=False,
+    )
+    s, bound = _sched([e])
+    s.queue.add(make_pod("p0", cpu="100m"))
+    s.run_once(timeout=0.5)
+    assert bound == [("p0", "n0")]
+    assert "nodes" in t.calls[0][1] and "nodenames" not in t.calls[0][1]
+
+
+def test_policy_json_builds_extenders():
+    exts = build_extenders([
+        {"urlPrefix": "http://127.0.0.1:9999/sched", "filterVerb": "filter",
+         "prioritizeVerb": "prioritize", "weight": 3,
+         "nodeCacheCapable": True, "ignorable": True,
+         "managedResources": [{"name": "example.com/gpu"}]},
+    ])
+    assert len(exts) == 1
+    c = exts[0].config
+    assert c.weight == 3 and c.node_cache_capable and c.ignorable
+    assert c.managed_resources == ("example.com/gpu",)
+    assert exts[0].name == "http://127.0.0.1:9999/sched"
+
+
+def test_real_http_round_trip():
+    """urllib path against an in-process HTTP extender."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            body = json.loads(self.rfile.read(n))
+            if self.path.endswith("/filter"):
+                out = {"nodenames": [body["nodenames"][0]],
+                       "failedNodes": {}, "error": ""}
+            else:
+                out = {"error": "unknown verb"}
+            data = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=f"http://127.0.0.1:{srv.server_port}",
+            filter_verb="filter", node_cache_capable=True, http_timeout=5.0,
+        ))
+        pod = make_pod("p0", cpu="100m")
+        ok, failed = ext.filter(pod, ["a", "b", "c"])
+        assert ok == ["a"] and failed == {}
+    finally:
+        srv.shutdown()
+
+
+def test_http_timeout_parsed_as_go_duration_nanoseconds():
+    c = ExtenderConfig.from_dict(
+        {"urlPrefix": "http://x", "httpTimeout": 100000000}  # 100ms
+    )
+    assert c.http_timeout == pytest.approx(0.1)
+    assert ExtenderConfig.from_dict({"urlPrefix": "http://x"}).http_timeout == 30.0
+
+
+def _preempt_world():
+    """1-node world where hi must evict low."""
+    cache = SchedulerCache()
+    cache.add_node(make_node("solo", cpu="2", mem="4Gi"))
+    deleted = []
+    s = Scheduler(
+        cache=cache, queue=PriorityQueue(), binder=lambda p, n: True,
+        config=SchedulerConfig(),
+    )
+    s.victim_deleter = lambda v: deleted.append(v.name)
+    low = make_pod("low", cpu="1500m", mem="1Gi")
+    low.spec.priority = 0
+    s.queue.add(low)
+    s.run_once(timeout=0.5)
+    hi = make_pod("hi", cpu="1500m", mem="1Gi")
+    hi.spec.priority = 100
+    return s, deleted, hi
+
+
+def test_preempt_verb_extender_can_veto_preemption():
+    s, deleted, hi = _preempt_world()
+    e, t = _ext(
+        {"preempt": {"nodeNameToMetaVictims": {}}},  # drops every candidate
+        preempt_verb="preempt", node_cache_capable=True,
+    )
+    s.extenders = [e]
+    s.queue.add(hi)
+    s.run_once(timeout=0.5)
+    assert deleted == []  # nothing evicted
+    assert not hi.status.nominated_node_name
+    assert [c for c in t.calls if c[0] == "preempt"]
+
+
+def test_preempt_verb_extender_passthrough_keeps_victims():
+    s, deleted, hi = _preempt_world()
+
+    class Echo(FakeTransport):
+        def __call__(self, url, payload, timeout):
+            self.calls.append(("preempt", payload))
+            return {"nodeNameToMetaVictims": payload["nodeNameToMetaVictims"]}
+
+    e = HTTPExtender(
+        ExtenderConfig(url_prefix="http://e", preempt_verb="preempt",
+                       node_cache_capable=True),
+        transport=Echo({}),
+    )
+    s.extenders = [e]
+    s.queue.add(hi)
+    s.run_once(timeout=0.5)
+    assert deleted == ["low"]
+    assert hi.status.nominated_node_name == "solo"
