@@ -82,27 +82,31 @@ def pod_to_dict(pod: Pod) -> dict:
         "spec": {
             "nodeName": pod.spec.node_name,
             "priority": pod.spec.priority,
-            "containers": [
-                {
-                    "name": c.name,
-                    "image": c.image,
-                    "resources": {
-                        "requests": {k: str(q) for k, q in c.requests.items()},
-                        "limits": {k: str(q) for k, q in c.limits.items()},
-                    },
-                    "ports": [
-                        {
-                            "hostPort": p.host_port,
-                            "containerPort": p.container_port,
-                            "protocol": p.protocol,
-                            "hostIP": p.host_ip,
-                        }
-                        for p in c.ports
-                    ],
-                }
-                for c in pod.spec.containers
+            "containers": [_container_to_dict(c) for c in pod.spec.containers],
+            "initContainers": [
+                _container_to_dict(c) for c in pod.spec.init_containers
             ],
         },
+    }
+
+
+def _container_to_dict(c) -> dict:
+    return {
+        "name": c.name,
+        "image": c.image,
+        "resources": {
+            "requests": {k: str(q) for k, q in c.requests.items()},
+            "limits": {k: str(q) for k, q in c.limits.items()},
+        },
+        "ports": [
+            {
+                "hostPort": p.host_port,
+                "containerPort": p.container_port,
+                "protocol": p.protocol,
+                "hostIP": p.host_ip,
+            }
+            for p in c.ports
+        ],
     }
 
 
@@ -170,18 +174,25 @@ class HTTPExtender:
         if not self.config.filter_verb:
             return list(node_names), {}
         result = self._send(self.config.filter_verb, self._args(pod, node_names))
-        if result.get("error"):
-            raise ExtenderError(result["error"])
-        if self.config.node_cache_capable and result.get("nodenames") is not None:
-            ok = list(result["nodenames"])
-        elif result.get("nodes") is not None:
-            ok = [
-                it.get("metadata", {}).get("name", "")
-                for it in result["nodes"].get("items", [])
-            ]
-        else:
-            ok = []
-        return ok, dict(result.get("failedNodes") or {})
+        try:
+            if result.get("error"):
+                raise ExtenderError(result["error"])
+            if self.config.node_cache_capable and result.get("nodenames") is not None:
+                ok = list(result["nodenames"])
+            elif result.get("nodes") is not None:
+                ok = [
+                    it.get("metadata", {}).get("name", "")
+                    for it in result["nodes"].get("items", [])
+                ]
+            else:
+                ok = []
+            return ok, dict(result.get("failedNodes") or {})
+        except ExtenderError:
+            raise
+        except Exception as e:  # malformed 200 response
+            raise ExtenderError(
+                f"extender {self.name} filter: bad response: {e}"
+            ) from e
 
     def prioritize(
         self, pod: Pod, node_names: Sequence[str]
@@ -193,10 +204,15 @@ class HTTPExtender:
         result = self._send(
             self.config.prioritize_verb, self._args(pod, node_names)
         )
-        scores: Dict[str, float] = {}
-        for item in result or []:
-            scores[item.get("host", "")] = float(item.get("score", 0))
-        return scores, self.config.weight
+        try:
+            scores: Dict[str, float] = {}
+            for item in result or []:
+                scores[item.get("host", "")] = float(item.get("score", 0))
+            return scores, self.config.weight
+        except Exception as e:  # malformed 200 response (dict, strings, ...)
+            raise ExtenderError(
+                f"extender {self.name} prioritize: bad response: {e}"
+            ) from e
 
     def process_preemption(
         self, pod: Pod, node_victims: Dict[str, dict]
@@ -214,7 +230,12 @@ class HTTPExtender:
             "nodeNameToMetaVictims": node_victims,
         }
         result = self._send(self.config.preempt_verb, args)
-        return dict(result.get("nodeNameToMetaVictims") or {})
+        try:
+            return dict(result.get("nodeNameToMetaVictims") or {})
+        except Exception as e:
+            raise ExtenderError(
+                f"extender {self.name} preempt: bad response: {e}"
+            ) from e
 
     def bind(self, namespace: str, name: str, uid: str, node: str) -> None:
         """extender.go:360-382 Bind; raises ExtenderError on failure."""
@@ -225,8 +246,9 @@ class HTTPExtender:
             {"podName": name, "podNamespace": namespace, "podUID": uid,
              "node": node},
         )
-        if result and result.get("error"):
-            raise ExtenderError(result["error"])
+        err = result.get("error") if isinstance(result, dict) else None
+        if err:
+            raise ExtenderError(err)
 
     # --------------------------------------------------------- transport
 

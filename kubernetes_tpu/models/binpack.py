@@ -78,3 +78,30 @@ def what_if(pod_reqs: np.ndarray, shapes: np.ndarray, max_bins: int = 1024):
     bins = np.asarray(bins)
     ok = np.asarray(ok)
     return [(int(s), int(bins[s])) for s in range(shapes.shape[0]) if ok[s]]
+
+
+def what_if_sharded(pod_reqs: np.ndarray, shapes: np.ndarray, mesh,
+                    max_bins: int = 1024):
+    """Blockwise what-if over a device mesh: the candidate-shape axis is
+    data-parallel (each lane packs independently), so shapes shard across
+    the mesh and the pod list replicates — the 50k pods x 10k shapes
+    BASELINE config runs as mesh-width blocks instead of one device's
+    memory footprint.  XLA partitions the vmap lanes; no collectives are
+    needed until the host gathers the per-shape results."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    S = shapes.shape[0]
+    pad = (-S) % n_dev                     # lanes must tile evenly
+    shp = np.zeros((S + pad, shapes.shape[1]), np.float32)
+    shp[:S] = shapes
+    shp_s = jax.device_put(shp, NamedSharding(mesh, P(axis, None)))
+    reqs = jax.device_put(
+        pod_reqs.astype(np.float32), NamedSharding(mesh, P(None, None))
+    )
+    with mesh:
+        bins, ok = binpack_shapes(reqs, shp_s, max_bins=max_bins)
+    bins = np.asarray(bins)[:S]
+    ok = np.asarray(ok)[:S]
+    return [(int(s), int(bins[s])) for s in range(S) if ok[s]]

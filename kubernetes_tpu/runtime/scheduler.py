@@ -209,7 +209,12 @@ class Scheduler:
                     np.float32,
                 )
         ext_failed: Dict[int, str] = {}
-        if self.extenders:
+        # bind-/preempt-only extenders don't participate in filter/score;
+        # skip the fan-out (and keep extra_mask None) when none do
+        if any(
+            e.config.filter_verb or e.config.prioritize_verb
+            for e in self.extenders
+        ):
             extra_mask, extra_score, ext_failed = self._apply_extenders(
                 pods, node_row_map, cluster, extra_mask, extra_score
             )

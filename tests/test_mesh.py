@@ -1,0 +1,136 @@
+"""Multi-chip mesh path in CI (conftest provisions 8 virtual CPU devices).
+
+The sequential-commit scheduler and the autoscaler binpack must produce
+IDENTICAL results sharded over a `jax.sharding.Mesh` vs unsharded — the
+sharding is pure data-parallel annotation (scaling-book recipe: pick a mesh,
+annotate, let XLA insert collectives for the cross-shard argmax/min/max).
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.codec.schema import PadDims
+from kubernetes_tpu.models.batched import (
+    encode_batch_ports,
+    make_sequential_scheduler,
+)
+from kubernetes_tpu.models.binpack import what_if, what_if_sharded
+from kubernetes_tpu.models.generic import schedule_batch_independent
+from kubernetes_tpu.parallel import NODE_AXIS, make_mesh, replicate, shard_cluster
+
+from fixtures import ZONE_KEY, make_node, make_pod
+
+N_DEV = 8
+MESH_DIMS = PadDims(N=64, B=16, TP=32)
+
+
+def _world(n_nodes=64, n_pending=12):
+    enc = SnapshotEncoder(MESH_DIMS)
+    for i in range(n_nodes):
+        enc.add_node(make_node(
+            f"n{i}", cpu="4", mem="8Gi",
+            labels={ZONE_KEY: f"z{i % 4}", "disk": "ssd" if i % 2 else "hdd"},
+        ))
+    enc.add_spread_selector("default", {"app": "web"})
+    for i in range(n_nodes // 2):
+        enc.add_pod(make_pod(
+            f"e{i}", cpu="500m", mem="512Mi", node_name=f"n{i}",
+            labels={"app": "web" if i % 3 else "db"},
+        ))
+    pending = [
+        make_pod(
+            f"p{i}", cpu="250m", mem="256Mi",
+            labels={"app": "web"},
+            node_selector={"disk": "ssd"} if i % 4 == 0 else None,
+        )
+        for i in range(n_pending)
+    ]
+    batch = enc.encode_pods(pending)
+    cluster = enc.snapshot()
+    ports = encode_batch_ports(enc, pending, enc.dims.N)
+    return enc, cluster, batch, ports
+
+
+def _shard_all(cluster, batch, ports, mesh):
+    cluster_s = shard_cluster(cluster, mesh)
+    batch_s = replicate(batch, mesh)
+    ports_s = dataclasses.replace(
+        replicate(ports, mesh),
+        node_conflict=jax.device_put(
+            np.asarray(ports.node_conflict),
+            NamedSharding(mesh, P(NODE_AXIS, None)),
+        ),
+    )
+    return cluster_s, batch_s, ports_s
+
+
+def test_mesh_has_eight_devices():
+    assert len(jax.devices()) >= N_DEV
+
+
+def test_sequential_commit_sharded_matches_unsharded():
+    enc, cluster, batch, ports = _world()
+    fn = make_sequential_scheduler(
+        unsched_taint_key=enc.interner.intern("node.kubernetes.io/unschedulable"),
+        zone_key_id=enc.getzone_key,
+    )
+    hosts_ref, new_ref = fn(cluster, batch, ports, np.int32(0))
+    hosts_ref = np.asarray(hosts_ref)
+    assert (hosts_ref[:12] >= 0).all(), "fixture must be schedulable"
+
+    mesh = make_mesh(N_DEV)
+    cluster_s, batch_s, ports_s = _shard_all(cluster, batch, ports, mesh)
+    with mesh:
+        hosts_s, new_s = fn(cluster_s, batch_s, ports_s, np.int32(0))
+    np.testing.assert_array_equal(np.asarray(hosts_s), hosts_ref)
+    np.testing.assert_allclose(
+        np.asarray(new_s.requested), np.asarray(new_ref.requested), rtol=0, atol=0
+    )
+    # the cluster columns really are distributed, not replicated
+    shard_set = {
+        s.index for s in jax.block_until_ready(cluster_s.requested).addressable_shards
+    }
+    assert len(shard_set) == N_DEV
+
+
+def test_generic_schedule_sharded_matches_unsharded():
+    enc, cluster, batch, ports = _world()
+    out_ref = schedule_batch_independent(
+        cluster, batch, 0, unsched_taint_key=0, zone_key_id=enc.getzone_key
+    )
+    mesh = make_mesh(N_DEV)
+    cluster_s = shard_cluster(cluster, mesh)
+    batch_s = replicate(batch, mesh)
+    with mesh:
+        out_s = schedule_batch_independent(
+            cluster_s, batch_s, 0, unsched_taint_key=0,
+            zone_key_id=enc.getzone_key,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(out_s["hosts"]), np.asarray(out_ref["hosts"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_s["mask"]), np.asarray(out_ref["mask"])
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_s["scores"]), np.asarray(out_ref["scores"])
+    )
+
+
+def test_binpack_blockwise_sharded_matches_unsharded():
+    rng = np.random.default_rng(7)
+    reqs = np.zeros((256, 2), np.float32)
+    reqs[:200] = rng.uniform(0.1, 2.0, (200, 2))   # 56 padding rows
+    shapes = np.stack(
+        [np.full(2, c, np.float32) for c in np.linspace(2.0, 8.0, 20)]
+    )  # 20 shapes -> padded to 24 lanes over 8 devices
+    ref = what_if(reqs, shapes, max_bins=256)
+    mesh = make_mesh(N_DEV, axis="shapes")
+    got = what_if_sharded(reqs, shapes, mesh, max_bins=256)
+    assert got == ref
+    assert ref, "at least the largest shapes must pack everything"
