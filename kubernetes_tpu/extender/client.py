@@ -246,9 +246,12 @@ class HTTPExtender:
             {"podName": name, "podNamespace": namespace, "podUID": uid,
              "node": node},
         )
-        err = result.get("error") if isinstance(result, dict) else None
-        if err:
-            raise ExtenderError(err)
+        if not isinstance(result, dict):
+            raise ExtenderError(
+                f"extender {self.name} bind: bad response: {result!r}"
+            )
+        if result.get("error"):
+            raise ExtenderError(result["error"])
 
     # --------------------------------------------------------- transport
 

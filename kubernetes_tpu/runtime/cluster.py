@@ -45,7 +45,7 @@ class _Stored:
 
 
 class LocalCluster:
-    KINDS = ("nodes", "pods", "services", "leases")
+    KINDS = ("nodes", "pods", "services", "leases", "replicasets")
 
     def __init__(self) -> None:
         self._lock = threading.RLock()

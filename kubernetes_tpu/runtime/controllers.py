@@ -1,0 +1,382 @@
+"""Controllers: the reconcile layer over the LocalCluster blackboard.
+
+The reference runs ~30 reconcilers sharing one shape (SURVEY.md section 3.5;
+list at cmd/kube-controller-manager/app/controllermanager.go:372-413):
+
+  informer event -> workqueue.Add(key)
+  worker: key := queue.Get() -> sync<Kind>(key):
+      desired (lister) vs observed (lister) -> diff -> client writes
+      error -> queue.AddRateLimited(key)
+
+Implemented here:
+  * WorkQueue — the client-go util/workqueue analog (dedup while queued,
+    mark-dirty while processing, per-key exponential requeue backoff).
+  * ReplicaSetController — pkg/controller/replicaset: keeps
+    spec.replicas pods matching the selector alive; creates through the
+    store (so the scheduler sees them) and deletes surplus.  This is the
+    controller-created-pods density pattern of test/utils/runners.go:1118
+    (NewSimpleWithControllerCreatePodStrategy).
+  * NodeLifecycleController — pkg/controller/nodelifecycle: watches node
+    lease heartbeats ("kube-node-lease" objects in the store); a node whose
+    lease outlives the grace period is marked NotReady + tainted
+    unreachable:NoExecute, and its pods are evicted (deleted) so owning
+    controllers replace them elsewhere.  Recovery removes the taint.
+
+Everything communicates through LocalCluster create/update/delete + watch —
+no controller talks to another directly (blackboard architecture).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from kubernetes_tpu.api import labels as klabels
+from kubernetes_tpu.api.types import Node, Pod, Taint
+from kubernetes_tpu.runtime.cluster import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    ConflictError,
+    LocalCluster,
+)
+
+TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
+LEASE_NAMESPACE = "kube-node-lease"
+
+
+# ---------------------------------------------------------------- workqueue
+
+
+class WorkQueue:
+    """client-go util/workqueue: a key queued twice before processing is
+    worked once; a key re-added DURING processing is re-queued after done()
+    (the dirty set); add_rate_limited applies per-key exponential delay."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1.0):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List = []
+        self._dirty: Set = set()
+        self._processing: Set = set()
+        self._failures: Dict = {}
+        self._base, self._max = base_delay, max_delay
+        self._closed = False
+
+    def add(self, key) -> None:
+        with self._cond:
+            if key in self._dirty:
+                return
+            self._dirty.add(key)
+            if key in self._processing:
+                return
+            self._queue.append(key)
+            self._cond.notify()
+
+    def add_rate_limited(self, key) -> None:
+        with self._lock:
+            n = self._failures.get(key, 0)
+            self._failures[key] = n + 1
+            delay = min(self._base * (2 ** n), self._max)
+        t = threading.Timer(delay, self.add, args=(key,))
+        t.daemon = True
+        t.start()
+
+    def forget(self, key) -> None:
+        with self._lock:
+            self._failures.pop(key, None)
+
+    def get(self, timeout: Optional[float] = None):
+        with self._cond:
+            deadline = time.monotonic() + timeout if timeout is not None else None
+            while not self._queue:
+                if self._closed:
+                    return None
+                left = None if deadline is None else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return None
+                self._cond.wait(left)
+            key = self._queue.pop(0)
+            self._dirty.discard(key)
+            self._processing.add(key)
+            return key
+
+    def done(self, key) -> None:
+        with self._cond:
+            self._processing.discard(key)
+            if key in self._dirty:
+                self._queue.append(key)
+                self._cond.notify()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+
+# --------------------------------------------------------------- ReplicaSet
+
+
+@dataclass
+class ReplicaSet:
+    """The scheduler-relevant slice of apps/v1 ReplicaSet."""
+
+    namespace: str
+    name: str
+    replicas: int
+    selector: Dict[str, str]                 # matchLabels
+    template: dict                           # pod dict (k8s JSON form); its
+                                             # metadata.labels must satisfy
+                                             # the selector
+    uid: str = field(default_factory=lambda: uuid.uuid4().hex)
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.namespace, self.name)
+
+
+class ReplicaSetController:
+    """pkg/controller/replicaset syncReplicaSet: observed = store pods owned
+    by the RS (owner_uid) and matching the selector; diff against
+    spec.replicas; create/delete through the store."""
+
+    def __init__(self, cluster: LocalCluster):
+        self.cluster = cluster
+        self.queue = WorkQueue()
+        self._seq = 0
+        cluster.watch(self._on_event)
+
+    # ------------------------------------------------------ informer seam
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "replicasets":
+            self.queue.add(obj.key)
+        elif kind == "pods" and getattr(obj.metadata, "owner_uid", ""):
+            # resolve owner RS by uid (resolveControllerRef)
+            for rs in self.cluster.list("replicasets"):
+                if rs.uid == obj.metadata.owner_uid:
+                    self.queue.add(rs.key)
+                    break
+
+    # ------------------------------------------------------------- sync
+
+    def _owned_pods(self, rs: ReplicaSet) -> List[Pod]:
+        sel = klabels.selector_from_match_labels(rs.selector)
+        return [
+            p for p in self.cluster.list("pods")
+            if p.namespace == rs.namespace
+            and p.metadata.owner_uid == rs.uid
+            and sel.matches(p.labels)
+        ]
+
+    def sync(self, key: Tuple[str, str]) -> None:
+        ns, name = key
+        rs = self.cluster.get("replicasets", ns, name)
+        if rs is None:
+            # RS deleted: cascade-delete pods whose owner uid no longer
+            # resolves to a live ReplicaSet (the garbagecollector analog)
+            live = {r.uid for r in self.cluster.list("replicasets")}
+            for p in self.cluster.list("pods"):
+                if (
+                    p.namespace == ns
+                    and p.metadata.owner_kind == "ReplicaSet"
+                    and p.metadata.owner_uid not in live
+                ):
+                    self.cluster.delete("pods", p.namespace, p.name)
+            return
+        owned = self._owned_pods(rs)
+        diff = rs.replicas - len(owned)
+        if diff > 0:
+            for _ in range(diff):
+                self._seq += 1
+                d = dict(rs.template)
+                meta = dict(d.get("metadata") or {})
+                meta["name"] = f"{rs.name}-{self._seq:05d}"
+                meta["namespace"] = rs.namespace
+                meta["ownerReferences"] = [
+                    {"kind": "ReplicaSet", "name": rs.name, "uid": rs.uid,
+                     "controller": True}
+                ]
+                d["metadata"] = meta
+                self.cluster.create("pods", Pod.from_dict(d))
+        elif diff < 0:
+            # delete surplus: prefer unassigned, then youngest (the
+            # getPodsToDelete ranking, abbreviated; names carry the creation
+            # sequence so name-descending = youngest-first)
+            owned.sort(key=lambda p: p.name, reverse=True)
+            owned.sort(key=lambda p: bool(p.spec.node_name))  # stable
+            for p in owned[:-diff]:
+                self.cluster.delete("pods", p.namespace, p.name)
+
+    # -------------------------------------------------------------- run
+
+    def process_one(self, timeout: float = 0.2) -> bool:
+        key = self.queue.get(timeout)
+        if key is None:
+            return False
+        try:
+            self.sync(key)
+            self.queue.forget(key)
+        except Exception:
+            # client-go worker shape: HandleError + rate-limited requeue —
+            # a bad object must not kill the reconcile thread
+            self.queue.add_rate_limited(key)
+        finally:
+            self.queue.done(key)
+        return True
+
+    def run(self, stop: threading.Event, workers: int = 1) -> List[threading.Thread]:
+        def worker():
+            while not stop.is_set():
+                self.process_one(timeout=0.05)
+
+        threads = [
+            threading.Thread(target=worker, daemon=True) for _ in range(workers)
+        ]
+        for t in threads:
+            t.start()
+        return threads
+
+
+def add_replicaset(cluster: LocalCluster, rs: ReplicaSet) -> None:
+    cluster.create("replicasets", rs)
+
+
+# ------------------------------------------------------------ node lifecycle
+
+
+def renew_node_lease(cluster: LocalCluster, node_name: str,
+                     now: Optional[float] = None) -> None:
+    """The kubelet heartbeat (NodeLease): upsert the node's lease object
+    with renewTime = now."""
+    now = time.monotonic() if now is None else now
+    lease = {"namespace": LEASE_NAMESPACE, "name": node_name, "renew_time": now}
+    with cluster._lock:
+        if cluster.get("leases", LEASE_NAMESPACE, node_name) is None:
+            cluster.create("leases", lease)
+        else:
+            cluster.update("leases", lease)
+
+
+class NodeLifecycleController:
+    """pkg/controller/nodelifecycle, lease-heartbeat slice: monitor() is the
+    monitorNodeHealth tick — nodes with expired leases get Ready=False +
+    the unreachable NoExecute taint and their pods evicted; recovered nodes
+    are restored.  Drive monitor(now) from a loop or directly in tests."""
+
+    def __init__(self, cluster: LocalCluster, grace_period: float = 40.0):
+        self.cluster = cluster
+        self.grace = grace_period
+        self.evictions: List[Tuple[str, str, str]] = []  # (ns, pod, node)
+
+    def _lease_age(self, node_name: str, now: float) -> Optional[float]:
+        lease = self.cluster.get("leases", LEASE_NAMESPACE, node_name)
+        if lease is None:
+            return None
+        return now - lease["renew_time"]
+
+    @staticmethod
+    def _is_tainted(node: Node) -> bool:
+        return any(t.key == TAINT_UNREACHABLE for t in node.spec.taints)
+
+    def monitor(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        for node in self.cluster.list("nodes"):
+            age = self._lease_age(node.name, now)
+            if age is None:
+                continue  # never heartbeated: agent not started yet
+            if age > self.grace and not self._is_tainted(node):
+                self._mark_unreachable(node)
+            elif age <= self.grace and self._is_tainted(node):
+                self._restore(node)
+
+    def _mark_unreachable(self, node: Node) -> None:
+        tainted = dataclasses.replace(
+            node,
+            spec=dataclasses.replace(
+                node.spec,
+                taints=tuple(node.spec.taints) + (
+                    Taint(key=TAINT_UNREACHABLE, value="", effect="NoExecute"),
+                    Taint(key=TAINT_UNREACHABLE, value="", effect="NoSchedule"),
+                ),
+            ),
+            status=dataclasses.replace(
+                node.status,
+                conditions={**node.status.conditions, "Ready": "Unknown"},
+            ),
+        )
+        self.cluster.update("nodes", tainted)
+        self.cluster.events.eventf(
+            "Node", "", node.name, "Warning", "NodeNotReady",
+            "lease expired; tainting %s", TAINT_UNREACHABLE,
+        )
+        # TaintBasedEviction: NoExecute evicts everything without a matching
+        # toleration (zero tolerationSeconds path)
+        for p in self.cluster.list("pods"):
+            if p.spec.node_name == node.name and not _tolerates_noexecute(p):
+                self.cluster.delete("pods", p.namespace, p.name)
+                self.evictions.append((p.namespace, p.name, node.name))
+
+    def _restore(self, node: Node) -> None:
+        restored = dataclasses.replace(
+            node,
+            spec=dataclasses.replace(
+                node.spec,
+                taints=tuple(
+                    t for t in node.spec.taints if t.key != TAINT_UNREACHABLE
+                ),
+            ),
+            status=dataclasses.replace(
+                node.status,
+                conditions={**node.status.conditions, "Ready": "True"},
+            ),
+        )
+        self.cluster.update("nodes", restored)
+        self.cluster.events.eventf(
+            "Node", "", node.name, "Normal", "NodeReady", "lease renewed"
+        )
+
+    def run(self, stop: threading.Event, period: float = 5.0) -> threading.Thread:
+        def loop():
+            while not stop.is_set():
+                self.monitor()
+                stop.wait(period)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t
+
+
+def _tolerates_noexecute(pod: Pod) -> bool:
+    taint = Taint(key=TAINT_UNREACHABLE, value="", effect="NoExecute")
+    return any(t.tolerates(taint) for t in pod.spec.tolerations)
+
+
+class ControllerManager:
+    """cmd/kube-controller-manager shape: start every controller against one
+    cluster; stop() tears all of them down."""
+
+    def __init__(self, cluster: LocalCluster, grace_period: float = 40.0):
+        self.cluster = cluster
+        self.replicaset = ReplicaSetController(cluster)
+        self.nodelifecycle = NodeLifecycleController(cluster, grace_period)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def start(self, rs_workers: int = 2, monitor_period: float = 5.0) -> None:
+        self._threads += self.replicaset.run(self._stop, workers=rs_workers)
+        self._threads.append(
+            self.nodelifecycle.run(self._stop, period=monitor_period)
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.replicaset.queue.close()

@@ -1,0 +1,205 @@
+"""Controller layer: ReplicaSet reconcile + node lifecycle over the
+blackboard (ref pkg/controller/replicaset, pkg/controller/nodelifecycle,
+shape at SURVEY.md section 3.5)."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.runtime.cluster import LocalCluster, make_cluster_binder, wire_scheduler
+from kubernetes_tpu.runtime.controllers import (
+    ControllerManager,
+    NodeLifecycleController,
+    ReplicaSet,
+    ReplicaSetController,
+    WorkQueue,
+    add_replicaset,
+    renew_node_lease,
+    TAINT_UNREACHABLE,
+)
+from kubernetes_tpu.runtime.kubemark import HollowFleet
+from kubernetes_tpu.runtime.queue import PriorityQueue
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+from fixtures import make_node, make_pod
+
+
+def _template(labels, cpu="100m"):
+    return {
+        "metadata": {"labels": dict(labels)},
+        "spec": {
+            "containers": [
+                {"name": "c0", "image": "app:v1",
+                 "resources": {"requests": {"cpu": cpu, "memory": "64Mi"}}}
+            ]
+        },
+    }
+
+
+# ------------------------------------------------------------------ workqueue
+
+
+def test_workqueue_dedup_and_dirty_requeue():
+    q = WorkQueue()
+    q.add("a"); q.add("a"); q.add("b")
+    assert len(q) == 2
+    k = q.get(0.1)
+    assert k == "a"
+    q.add("a")            # re-added while processing -> dirty
+    assert len(q) == 1    # not queued twice
+    q.done("a")
+    assert len(q) == 2    # requeued after done
+    q.get(0.1); q.get(0.1)
+    assert q.get(0.01) is None
+
+
+def test_workqueue_rate_limited_backoff():
+    q = WorkQueue(base_delay=0.1)
+    q.add_rate_limited("k")
+    assert q.get(0.005) is None         # well inside the delay window
+    assert q.get(1.0) == "k"            # arrives after the delay
+
+
+# ----------------------------------------------------------------- replicaset
+
+
+def _drain(ctrl, n=20):
+    while ctrl.process_one(timeout=0.05):
+        n -= 1
+        if n <= 0:
+            break
+
+
+def test_replicaset_scales_up_and_down():
+    cluster = LocalCluster()
+    ctrl = ReplicaSetController(cluster)
+    rs = ReplicaSet("default", "web", 3, {"app": "web"},
+                    _template({"app": "web"}))
+    add_replicaset(cluster, rs)
+    _drain(ctrl)
+    pods = cluster.list("pods")
+    assert len(pods) == 3
+    assert all(p.metadata.owner_uid == rs.uid for p in pods)
+    assert all(p.labels == {"app": "web"} for p in pods)
+
+    # scale down to 1
+    rs.replicas = 1
+    cluster.update("replicasets", rs)
+    _drain(ctrl)
+    assert len(cluster.list("pods")) == 1
+
+    # a deleted pod is replaced
+    survivor = cluster.list("pods")[0]
+    cluster.delete("pods", survivor.namespace, survivor.name)
+    _drain(ctrl)
+    assert len(cluster.list("pods")) == 1
+    assert cluster.list("pods")[0].name != survivor.name
+
+
+def test_controller_created_pods_drive_the_scheduler():
+    """Density via controller-created pods (test/utils/runners.go:1118
+    NewSimpleWithControllerCreatePodStrategy): RS -> store -> scheduler ->
+    bind -> hollow nodes Running."""
+    cluster = LocalCluster()
+    sched = Scheduler(
+        cache=SchedulerCache(), queue=PriorityQueue(),
+        binder=make_cluster_binder(cluster), config=SchedulerConfig(),
+    )
+    wire_scheduler(cluster, sched)
+    fleet = HollowFleet(cluster, [make_node(f"n{i}", cpu="4") for i in range(4)])
+    ctrl = ReplicaSetController(cluster)
+    add_replicaset(
+        cluster,
+        ReplicaSet("default", "web", 12, {"app": "web"},
+                   _template({"app": "web"})),
+    )
+    _drain(ctrl)
+    for _ in range(6):
+        sched.run_once(timeout=0.3)
+        if fleet.total_running >= 12:
+            break
+    assert fleet.total_running == 12
+    assert all(p.spec.node_name for p in cluster.list("pods"))
+
+
+# -------------------------------------------------------------- nodelifecycle
+
+
+def test_node_failure_evicts_and_reschedules():
+    cluster = LocalCluster()
+    sched = Scheduler(
+        cache=SchedulerCache(), queue=PriorityQueue(),
+        binder=make_cluster_binder(cluster), config=SchedulerConfig(),
+    )
+    wire_scheduler(cluster, sched)
+    fleet = HollowFleet(cluster, [make_node(f"n{i}", cpu="4") for i in range(3)])
+    ctrl = ReplicaSetController(cluster)
+    lifecycle = NodeLifecycleController(cluster, grace_period=10.0)
+    add_replicaset(
+        cluster,
+        ReplicaSet("default", "web", 6, {"app": "web"},
+                   _template({"app": "web"})),
+    )
+    _drain(ctrl)
+    for _ in range(4):
+        sched.run_once(timeout=0.3)
+    assert all(p.spec.node_name for p in cluster.list("pods"))
+
+    # heartbeats: n0 goes silent, n1/n2 stay fresh
+    t0 = 1000.0
+    for n in ("n0", "n1", "n2"):
+        renew_node_lease(cluster, n, now=t0)
+    lifecycle.monitor(now=t0 + 5)           # all healthy
+    assert not lifecycle.evictions
+    renew_node_lease(cluster, "n1", now=t0 + 20)
+    renew_node_lease(cluster, "n2", now=t0 + 20)
+    lifecycle.monitor(now=t0 + 21)          # n0's lease 21s old > 10s grace
+    node0 = cluster.get("nodes", "", "n0")
+    assert any(t.key == TAINT_UNREACHABLE for t in node0.spec.taints)
+    assert node0.status.conditions["Ready"] == "Unknown"
+    evicted = [e for e in lifecycle.evictions if e[2] == "n0"]
+    assert evicted, "pods on n0 must be evicted"
+
+    # the RS replaces them; the scheduler must avoid the tainted node
+    _drain(ctrl)
+    for _ in range(4):
+        sched.run_once(timeout=0.3)
+    pods = cluster.list("pods")
+    assert len(pods) == 6
+    assert all(p.spec.node_name in ("n1", "n2") for p in pods)
+
+    # recovery: lease renewed -> taint removed, Ready True
+    renew_node_lease(cluster, "n0", now=t0 + 30)
+    lifecycle.monitor(now=t0 + 31)
+    node0 = cluster.get("nodes", "", "n0")
+    assert not any(t.key == TAINT_UNREACHABLE for t in node0.spec.taints)
+    assert node0.status.conditions["Ready"] == "True"
+
+
+def test_controller_manager_runs_threaded():
+    cluster = LocalCluster()
+    sched = Scheduler(
+        cache=SchedulerCache(), queue=PriorityQueue(),
+        binder=make_cluster_binder(cluster), config=SchedulerConfig(),
+    )
+    wire_scheduler(cluster, sched)
+    HollowFleet(cluster, [make_node(f"n{i}", cpu="4") for i in range(2)])
+    cm = ControllerManager(cluster, grace_period=30.0)
+    cm.start(rs_workers=2, monitor_period=0.05)
+    try:
+        add_replicaset(
+            cluster,
+            ReplicaSet("default", "api", 4, {"app": "api"},
+                       _template({"app": "api"})),
+        )
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            sched.run_once(timeout=0.2)
+            if len([p for p in cluster.list("pods") if p.spec.node_name]) >= 4:
+                break
+        bound = [p for p in cluster.list("pods") if p.spec.node_name]
+        assert len(bound) == 4
+    finally:
+        cm.stop()
