@@ -1,0 +1,7 @@
+"""Component entry points (SURVEY.md layer 10).
+
+The reference ships one cobra binary per component (cmd/kube-scheduler,
+cmd/kube-controller-manager, the extender is out-of-tree); here each is a
+`python -m kubernetes_tpu.cmd.<component>` module sharing the flag/config/
+signal plumbing in `kubernetes_tpu.cmd.base`.
+"""

@@ -1,0 +1,63 @@
+"""Shared CLI plumbing for component binaries (component-base analog:
+staging/src/k8s.io/component-base cli flags/logs; option pattern of
+cmd/kube-scheduler/app/options)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import threading
+from typing import Optional, Tuple
+
+
+def add_common_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--config", metavar="FILE",
+        help="component configuration file (KubeSchedulerConfiguration JSON)",
+    )
+    p.add_argument(
+        "--platform", default=None, choices=("cpu", "tpu"),
+        help="force the jax platform (cpu = 8 virtual host devices; "
+        "default keeps the environment's backend)",
+    )
+    p.add_argument("-v", "--verbosity", type=int, default=0,
+                   help="log level (klog.V analog)")
+
+
+def parse_hostport(addr: str, default_port: int) -> Tuple[str, int]:
+    """'0.0.0.0:10251' / ':10251' / '10251' -> (host, port)."""
+    if ":" in addr:
+        host, _, port = addr.rpartition(":")
+        return host or "0.0.0.0", int(port or default_port)
+    return "0.0.0.0", int(addr or default_port)
+
+
+def apply_platform(platform: Optional[str]) -> None:
+    """The axon-tunnel gotcha: env vars were consumed at interpreter start,
+    so the cpu override must go through jax.config before first backend
+    touch (tests/conftest.py recipe)."""
+    if platform == "cpu":
+        from kubernetes_tpu.utils.jaxenv import force_cpu_mesh
+
+        force_cpu_mesh(8)
+
+
+def load_component_config(path: Optional[str]):
+    from kubernetes_tpu.config.types import KubeSchedulerConfiguration
+
+    if path:
+        return KubeSchedulerConfiguration.from_file(path)
+    return KubeSchedulerConfiguration()
+
+
+def wait_for_term(stop_event: Optional[threading.Event] = None) -> None:
+    """Block until SIGINT/SIGTERM (the stopCh pattern)."""
+    ev = stop_event or threading.Event()
+
+    def handler(signum, frame):
+        ev.set()
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+    ev.wait()

@@ -1,0 +1,63 @@
+"""TPU extender sidecar binary.
+
+The deployable artifact for hybrid clusters: a stock kube-scheduler offloads
+Filter/Prioritize/Preempt/Bind to this process over the extender wire
+protocol (pkg/scheduler/core/extender.go; server side in
+kubernetes_tpu/extender/server.py), while the TPU evaluates the whole
+pods x nodes grid per request.  Cluster state arrives through the /sync/*
+endpoints (NodeCacheCapable contract).
+
+    python -m kubernetes_tpu.cmd.extender --port 10250 --platform cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from kubernetes_tpu.cmd.base import (
+    add_common_flags,
+    apply_platform,
+    load_component_config,
+    wait_for_term,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kubernetes-tpu-extender",
+        description="TPU scheduler-extender sidecar",
+    )
+    add_common_flags(p)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=10250)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    apply_platform(args.platform)
+
+    from kubernetes_tpu.extender.server import ExtenderServer
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+
+    cc = load_component_config(args.config)
+    profile = cc.build_profile()
+    srv = ExtenderServer(
+        cache=SchedulerCache(),
+        host=args.host,
+        port=args.port,
+        filter_config=profile.filter_config,
+    )
+    srv.start()
+    print(f"extender serving on {srv.address[0]}:{srv.address[1]}",
+          file=sys.stderr)
+    try:
+        wait_for_term()
+    finally:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

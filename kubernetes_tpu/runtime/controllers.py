@@ -32,11 +32,18 @@ import dataclasses
 import threading
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from kubernetes_tpu.api import labels as klabels
-from kubernetes_tpu.api.types import Node, Pod, Taint
+from kubernetes_tpu.api.types import (
+    Node,
+    Pod,
+    TAINT_NO_EXECUTE,
+    TAINT_NO_SCHEDULE,
+    Taint,
+)
 from kubernetes_tpu.runtime.cluster import (
     ADDED,
     DELETED,
@@ -60,7 +67,7 @@ class WorkQueue:
     def __init__(self, base_delay: float = 0.005, max_delay: float = 1.0):
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._queue: List = []
+        self._queue: deque = deque()
         self._dirty: Set = set()
         self._processing: Set = set()
         self._failures: Dict = {}
@@ -100,7 +107,7 @@ class WorkQueue:
                 if left is not None and left <= 0:
                     return None
                 self._cond.wait(left)
-            key = self._queue.pop(0)
+            key = self._queue.popleft()
             self._dirty.discard(key)
             self._processing.add(key)
             return key
@@ -259,11 +266,10 @@ def renew_node_lease(cluster: LocalCluster, node_name: str,
     with renewTime = now."""
     now = time.monotonic() if now is None else now
     lease = {"namespace": LEASE_NAMESPACE, "name": node_name, "renew_time": now}
-    with cluster._lock:
-        if cluster.get("leases", LEASE_NAMESPACE, node_name) is None:
-            cluster.create("leases", lease)
-        else:
-            cluster.update("leases", lease)
+    try:
+        cluster.create("leases", lease)
+    except ConflictError:
+        cluster.update("leases", lease)
 
 
 class NodeLifecycleController:
@@ -293,8 +299,14 @@ class NodeLifecycleController:
             age = self._lease_age(node.name, now)
             if age is None:
                 continue  # never heartbeated: agent not started yet
-            if age > self.grace and not self._is_tainted(node):
-                self._mark_unreachable(node)
+            if age > self.grace:
+                if not self._is_tainted(node):
+                    self._mark_unreachable(node)
+                else:
+                    # the NoExecute taint manager evicts CONTINUOUSLY: a pod
+                    # that slipped onto an already-tainted node (bind raced
+                    # the taint) goes next tick
+                    self._evict_pods(node)
             elif age <= self.grace and self._is_tainted(node):
                 self._restore(node)
 
@@ -304,8 +316,10 @@ class NodeLifecycleController:
             spec=dataclasses.replace(
                 node.spec,
                 taints=tuple(node.spec.taints) + (
-                    Taint(key=TAINT_UNREACHABLE, value="", effect="NoExecute"),
-                    Taint(key=TAINT_UNREACHABLE, value="", effect="NoSchedule"),
+                    Taint(key=TAINT_UNREACHABLE, value="",
+                          effect=TAINT_NO_EXECUTE),
+                    Taint(key=TAINT_UNREACHABLE, value="",
+                          effect=TAINT_NO_SCHEDULE),
                 ),
             ),
             status=dataclasses.replace(
@@ -318,6 +332,9 @@ class NodeLifecycleController:
             "Node", "", node.name, "Warning", "NodeNotReady",
             "lease expired; tainting %s", TAINT_UNREACHABLE,
         )
+        self._evict_pods(node)
+
+    def _evict_pods(self, node: Node) -> None:
         # TaintBasedEviction: NoExecute evicts everything without a matching
         # toleration (zero tolerationSeconds path)
         for p in self.cluster.list("pods"):
@@ -356,7 +373,7 @@ class NodeLifecycleController:
 
 
 def _tolerates_noexecute(pod: Pod) -> bool:
-    taint = Taint(key=TAINT_UNREACHABLE, value="", effect="NoExecute")
+    taint = Taint(key=TAINT_UNREACHABLE, value="", effect=TAINT_NO_EXECUTE)
     return any(t.tolerates(taint) for t in pod.spec.tolerations)
 
 

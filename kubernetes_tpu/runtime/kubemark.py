@@ -16,7 +16,7 @@ import threading
 from typing import Dict, List, Optional
 
 from kubernetes_tpu.api.types import Node, Pod
-from kubernetes_tpu.runtime.cluster import ADDED, MODIFIED, LocalCluster
+from kubernetes_tpu.runtime.cluster import ADDED, DELETED, MODIFIED, LocalCluster
 
 
 class HollowNode:
@@ -27,13 +27,17 @@ class HollowNode:
         cluster.add_node(node)
 
     def observe(self, event: str, kind: str, obj) -> None:
-        """Pod-informer callback: claim pods bound to this node."""
-        if kind != "pods" or event not in (ADDED, MODIFIED):
+        """Pod-informer callback: claim pods bound to this node; release
+        deleted ones (eviction/GC) so running never overcounts."""
+        if kind != "pods":
             return
         if obj.spec.node_name != self.node.name:
             return
         key = (obj.namespace, obj.name)
-        if key in self.running:
+        if event == DELETED:
+            self.running.pop(key, None)
+            return
+        if event not in (ADDED, MODIFIED) or key in self.running:
             return
         self.running[key] = obj
         if obj.status.phase != "Running":
