@@ -1,0 +1,3 @@
+from kubernetes_tpu.apiserver.server import AdmissionDenied, APIServer
+
+__all__ = ["APIServer", "AdmissionDenied"]

@@ -1,0 +1,373 @@
+"""REST API server over the LocalCluster store (SURVEY.md layer 4 slice).
+
+The reference's write path (SURVEY section 3.3) is: handler chain
+(authn/authz) -> admission chain -> registry strategy -> etcd3 storage ->
+watch fan-out.  This server reproduces the layers that shape behavior:
+
+  * kube-style REST paths over HTTP JSON:
+      GET  /healthz, /metrics, /version
+      GET/POST          /api/v1/nodes[/{name}]
+      GET/POST          /api/v1/namespaces/{ns}/pods[/{name}]
+      PUT/DELETE        .../{name}            (PUT honors resourceVersion)
+      POST              .../pods/{name}/binding   (the Binding subresource:
+                        sets spec.nodeName — pkg/registry/core/pod)
+      GET/POST/PUT/DELETE /apis/apps/v1/namespaces/{ns}/replicasets[/{name}]
+      GET  /api/v1/watch     chunked JSON-lines watch stream
+  * an admission chain (plugin/pkg/admission analog): callables
+    (op, kind, obj_dict) -> obj_dict run in order on every write; raising
+    AdmissionDenied turns into HTTP 403, mutations flow through;
+  * optimistic concurrency: PUT with metadata.resourceVersion mismatching
+    the stored revision returns 409 (etcd3 txn CAS).
+
+Storage is the LocalCluster (etcd3-semantics store); any scheduler /
+controller wired to the same cluster observes API writes immediately.
+"""
+
+from __future__ import annotations
+
+import json
+import queue as _queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional, Tuple
+
+from kubernetes_tpu.api.serialize import object_to_dict
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.runtime.cluster import ConflictError, LocalCluster
+from kubernetes_tpu.utils import metrics as m
+
+LIST_KINDS = {"pods": "PodList", "nodes": "NodeList",
+              "replicasets": "ReplicaSetList", "services": "ServiceList"}
+
+
+class AdmissionDenied(Exception):
+    """An admission plugin rejected the write (HTTP 403)."""
+
+
+def _decode(kind: str, d: dict):
+    if kind == "pods":
+        return Pod.from_dict(d)
+    if kind == "nodes":
+        return Node.from_dict(d)
+    if kind == "replicasets":
+        from kubernetes_tpu.runtime.controllers import ReplicaSet
+
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        rs = ReplicaSet(
+            namespace=meta.get("namespace", "default"),
+            name=meta.get("name", ""),
+            replicas=int(spec.get("replicas", 0)),
+            selector=dict((spec.get("selector") or {}).get("matchLabels") or {}),
+            template=spec.get("template") or {},
+        )
+        if meta.get("uid"):
+            rs.uid = meta["uid"]
+        return rs
+    if kind == "services":
+        meta = d.get("metadata") or {}
+        return {
+            "namespace": meta.get("namespace", "default"),
+            "name": meta.get("name", ""),
+            "selector": dict((d.get("spec") or {}).get("selector") or {}),
+        }
+    raise ValueError(f"unknown kind {kind!r}")
+
+
+class APIServer:
+    def __init__(
+        self,
+        cluster: Optional[LocalCluster] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        admission: Optional[List[Callable[[str, str, dict], dict]]] = None,
+    ):
+        self.cluster = cluster if cluster is not None else LocalCluster()
+        # ordered admission chain (mutating-then-validating collapses to
+        # "each plugin may mutate or raise")
+        self.admission: List[Callable[[str, str, dict], dict]] = list(
+            admission or []
+        )
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        h, p = self.address
+        return f"http://{h}:{p}"
+
+    def start(self) -> "APIServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ----------------------------------------------------------- admission
+
+    def _admit(self, op: str, kind: str, obj_dict: dict) -> dict:
+        for plugin in self.admission:
+            obj_dict = plugin(op, kind, obj_dict)
+        return obj_dict
+
+    # ------------------------------------------------------------- routes
+
+    @staticmethod
+    def _route(path: str):
+        """-> (kind, namespace, name, subresource) or None."""
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        # /api/v1/... or /apis/apps/v1/...
+        if parts[:2] == ["api", "v1"]:
+            rest = parts[2:]
+        elif parts[:3] == ["apis", "apps", "v1"]:
+            rest = parts[3:]
+        else:
+            return None
+        if not rest:
+            return None
+        if rest[0] == "watch":
+            return ("watch", "", "", "")
+        if rest[0] == "namespaces" and len(rest) >= 3:
+            ns, kind = rest[1], rest[2]
+            name = rest[3] if len(rest) > 3 else ""
+            sub = rest[4] if len(rest) > 4 else ""
+            return (kind, ns, name, sub)
+        kind = rest[0]
+        name = rest[1] if len(rest) > 1 else ""
+        return (kind, "", name, "")
+
+    def _make_handler(self):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, obj, code: int = 200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _status(self, code: int, reason: str, message: str):
+                self._send(
+                    {"kind": "Status", "apiVersion": "v1", "code": code,
+                     "reason": reason, "message": message},
+                    code,
+                )
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            # ------------------------------------------------------- GET
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send_text(b"ok")
+                    return
+                if self.path == "/metrics":
+                    self._send_text(
+                        m.REGISTRY.expose().encode(),
+                        ct="text/plain; version=0.0.4",
+                    )
+                    return
+                if self.path == "/version":
+                    self._send({"gitVersion": "v1.15-tpu", "major": "1",
+                                "minor": "15"})
+                    return
+                r = outer._route(self.path)
+                if r is None:
+                    self._status(404, "NotFound", self.path)
+                    return
+                kind, ns, name, _sub = r
+                if kind == "watch":
+                    self._serve_watch()
+                    return
+                if kind not in LIST_KINDS:
+                    self._status(404, "NotFound", f"unknown resource {kind}")
+                    return
+                if name:
+                    obj = outer.cluster.get(kind, ns, name)
+                    if obj is None:
+                        self._status(404, "NotFound", f"{kind} {ns}/{name}")
+                        return
+                    self._send(object_to_dict(kind, obj))
+                else:
+                    def ns_of(o):
+                        if isinstance(o, dict):
+                            return o.get("namespace", "")
+                        return getattr(o, "namespace", "")
+
+                    items = [
+                        object_to_dict(kind, o)
+                        for o in outer.cluster.list(kind)
+                        if not ns or ns_of(o) == ns
+                    ]
+                    self._send({"kind": LIST_KINDS[kind], "apiVersion": "v1",
+                                "items": items})
+
+            def _send_text(self, body: bytes, ct: str = "text/plain"):
+                self.send_response(200)
+                self.send_header("Content-Type", ct)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _serve_watch(self):
+                """Chunked JSON-lines stream (the watch contract; one line
+                per event, replay-then-follow)."""
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                q: "_queue.Queue" = _queue.Queue(maxsize=10000)
+                overflow = threading.Event()
+
+                def fan(event, kind, obj):
+                    try:
+                        q.put_nowait((event, kind, obj))
+                    except _queue.Full:
+                        # a watcher this far behind must re-list; closing the
+                        # stream is the 410 Gone analog — never drop silently
+                        overflow.set()
+
+                outer.cluster.watch(fan)
+                try:
+                    while not overflow.is_set():
+                        try:
+                            event, kind, obj = q.get(timeout=1.0)
+                        except _queue.Empty:
+                            # heartbeat chunk keeps the connection honest
+                            self.wfile.write(b"1\r\n\n\r\n")
+                            self.wfile.flush()
+                            continue
+                        line = json.dumps({
+                            "type": event,
+                            "kind": kind,
+                            "object": object_to_dict(kind, obj),
+                        }).encode() + b"\n"
+                        self.wfile.write(
+                            f"{len(line):x}\r\n".encode() + line + b"\r\n"
+                        )
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                finally:
+                    outer.cluster.unwatch(fan)
+
+            # ------------------------------------------------------ writes
+
+            def do_POST(self):
+                r = outer._route(self.path)
+                if r is None:
+                    self._status(404, "NotFound", self.path)
+                    return
+                kind, ns, name, sub = r
+                try:
+                    body = self._body()
+                except ValueError:
+                    self._status(400, "BadRequest", "invalid JSON")
+                    return
+                try:
+                    if kind == "pods" and sub == "binding":
+                        # Binding subresource: {"target": {"name": node}}
+                        node = (body.get("target") or {}).get("name", "")
+                        pod = outer.cluster.get("pods", ns, name)
+                        if pod is None:
+                            self._status(404, "NotFound", f"pod {ns}/{name}")
+                            return
+                        if not outer.cluster.bind(pod, node):
+                            self._status(409, "Conflict",
+                                         "pod already bound or gone")
+                            return
+                        self._status(201, "Created", "binding recorded")
+                        return
+                    if kind not in LIST_KINDS:
+                        self._status(404, "NotFound", f"unknown resource {kind}")
+                        return
+                    body = outer._admit("CREATE", kind, body)
+                    meta = body.setdefault("metadata", {})
+                    if ns and not meta.get("namespace"):
+                        meta["namespace"] = ns
+                    obj = _decode(kind, body)
+                    rv = outer.cluster.create(kind, obj)
+                    out = object_to_dict(kind, obj)
+                    out.setdefault("metadata", {})["resourceVersion"] = str(rv)
+                    self._send(out, 201)
+                except AdmissionDenied as e:
+                    self._status(403, "Forbidden", str(e))
+                except ConflictError as e:
+                    self._status(409, "AlreadyExists", str(e))
+                except Exception as e:
+                    self._status(422, "Invalid", f"{type(e).__name__}: {e}")
+
+            def do_PUT(self):
+                r = outer._route(self.path)
+                if r is None or not r[2]:
+                    self._status(404, "NotFound", self.path)
+                    return
+                kind, ns, name, _sub = r
+                try:
+                    body = self._body()
+                except ValueError:
+                    self._status(400, "BadRequest", "invalid JSON")
+                    return
+                try:
+                    body = outer._admit("UPDATE", kind, body)
+                    expect = (body.get("metadata") or {}).get("resourceVersion")
+                    obj = _decode(kind, body)
+                    if kind == "replicasets" and not (
+                        (body.get("metadata") or {}).get("uid")
+                    ):
+                        # keep the stored identity: a spec-only manifest must
+                        # not orphan the RS's pods behind a fresh uid
+                        cur = outer.cluster.get(kind, ns, name)
+                        if cur is not None:
+                            obj.uid = cur.uid
+                    rv = outer.cluster.update(
+                        kind, obj,
+                        expect_rv=int(expect) if expect else None,
+                    )
+                    out = object_to_dict(kind, obj)
+                    out.setdefault("metadata", {})["resourceVersion"] = str(rv)
+                    self._send(out)
+                except AdmissionDenied as e:
+                    self._status(403, "Forbidden", str(e))
+                except ConflictError as e:
+                    self._status(409, "Conflict", str(e))
+                except Exception as e:
+                    self._status(422, "Invalid", f"{type(e).__name__}: {e}")
+
+            def do_DELETE(self):
+                r = outer._route(self.path)
+                if r is None or not r[2]:
+                    self._status(404, "NotFound", self.path)
+                    return
+                kind, ns, name, _sub = r
+                if kind not in LIST_KINDS:
+                    self._status(404, "NotFound", f"unknown resource {kind}")
+                    return
+                if outer.cluster.get(kind, ns if kind != "nodes" else "",
+                                     name) is None:
+                    self._status(404, "NotFound", f"{kind} {ns}/{name}")
+                    return
+                outer.cluster.delete(kind, ns, name)
+                self._status(200, "Success", "deleted")
+
+        return Handler

@@ -1,0 +1,82 @@
+"""kube-apiserver binary analog — optionally an all-in-one control plane.
+
+Serves the REST layer over a LocalCluster; --with-scheduler /
+--with-controllers / --hollow-nodes N embed the other components against the
+same store, giving a single-process cluster a kubectl analog can drive
+(the local-up-cluster.sh shape):
+
+    python -m kubernetes_tpu.cmd.apiserver --platform cpu --port 8001 \
+        --with-scheduler --with-controllers --hollow-nodes 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+
+from kubernetes_tpu.cmd.base import (
+    add_common_flags,
+    apply_platform,
+    load_component_config,
+    wait_for_term,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kubernetes-tpu-apiserver",
+        description="REST API server over the in-process store",
+    )
+    add_common_flags(p)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8001)
+    p.add_argument("--with-scheduler", action="store_true")
+    p.add_argument("--with-controllers", action="store_true")
+    p.add_argument("--hollow-nodes", type=int, default=0)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    apply_platform(args.platform)
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster, host=args.host, port=args.port).start()
+    print(f"apiserver on {srv.url}", file=sys.stderr)
+
+    sched = cm = None
+    if args.with_scheduler:
+        from kubernetes_tpu.cmd.base import build_wired_scheduler
+
+        sched = build_wired_scheduler(
+            cluster, load_component_config(args.config)
+        )
+        threading.Thread(target=sched.run, daemon=True).start()
+    if args.with_controllers:
+        from kubernetes_tpu.runtime.controllers import ControllerManager
+
+        cm = ControllerManager(cluster)
+        cm.start()
+    if args.hollow_nodes:
+        from kubernetes_tpu.cmd.scheduler import _sim_nodes
+        from kubernetes_tpu.runtime.kubemark import HollowFleet
+
+        HollowFleet(cluster, _sim_nodes(args.hollow_nodes))
+
+    try:
+        wait_for_term()
+    finally:
+        if sched is not None:
+            sched.stop()
+        if cm is not None:
+            cm.stop()
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

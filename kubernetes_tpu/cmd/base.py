@@ -61,3 +61,27 @@ def wait_for_term(stop_event: Optional[threading.Event] = None) -> None:
     signal.signal(signal.SIGINT, handler)
     signal.signal(signal.SIGTERM, handler)
     ev.wait()
+
+
+def build_wired_scheduler(cluster, cc=None):
+    """One shared recipe for embedding a scheduler against a LocalCluster
+    (the server.go:164-201 build + AddAllEventHandlers): component config
+    honored when given."""
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+    from kubernetes_tpu.runtime.cluster import (
+        make_cluster_binder,
+        wire_scheduler,
+    )
+    from kubernetes_tpu.runtime.queue import PriorityQueue
+    from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+    cfg = (
+        SchedulerConfig.from_component_config(cc)
+        if cc is not None else SchedulerConfig()
+    )
+    sched = Scheduler(
+        cache=SchedulerCache(), queue=PriorityQueue(),
+        binder=make_cluster_binder(cluster), config=cfg,
+    )
+    wire_scheduler(cluster, sched)
+    return sched

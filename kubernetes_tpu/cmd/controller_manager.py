@@ -44,32 +44,22 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     apply_platform(args.platform)
 
+    from kubernetes_tpu.cmd.base import build_wired_scheduler, load_component_config
     from kubernetes_tpu.cmd.scheduler import _sim_nodes
-    from kubernetes_tpu.runtime.cache import SchedulerCache
-    from kubernetes_tpu.runtime.cluster import (
-        LocalCluster,
-        make_cluster_binder,
-        wire_scheduler,
-    )
+    from kubernetes_tpu.runtime.cluster import LocalCluster
     from kubernetes_tpu.runtime.controllers import (
         ControllerManager,
         ReplicaSet,
         add_replicaset,
     )
     from kubernetes_tpu.runtime.kubemark import HollowFleet
-    from kubernetes_tpu.runtime.queue import PriorityQueue
-    from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
 
     cluster = LocalCluster()
     cm = ControllerManager(cluster, grace_period=args.node_monitor_grace_period)
 
     fleet = sched = None
     if args.simulate_nodes:
-        sched = Scheduler(
-            cache=SchedulerCache(), queue=PriorityQueue(),
-            binder=make_cluster_binder(cluster), config=SchedulerConfig(),
-        )
-        wire_scheduler(cluster, sched)
+        sched = build_wired_scheduler(cluster, load_component_config(args.config))
         fleet = HollowFleet(cluster, _sim_nodes(args.simulate_nodes))
     if args.simulate_replicas:
         add_replicaset(cluster, ReplicaSet(

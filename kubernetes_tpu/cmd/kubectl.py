@@ -1,0 +1,179 @@
+"""kubectl analog: CLI verbs over the REST API server (SURVEY.md layer 10;
+pkg/kubectl verbs over layer 4).
+
+    ktl = python -m kubernetes_tpu.cmd.kubectl --server http://127.0.0.1:8001
+    ktl get pods [-n NS] [-o json|wide]
+    ktl get nodes
+    ktl create -f pod.json
+    ktl delete pod NAME [-n NS]
+    ktl describe pod NAME [-n NS]
+    ktl bind POD NODE [-n NS]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+KIND_PATHS = {
+    "pods": "/api/v1/namespaces/{ns}/pods",
+    "pod": "/api/v1/namespaces/{ns}/pods",
+    "nodes": "/api/v1/nodes",
+    "node": "/api/v1/nodes",
+    "replicasets": "/apis/apps/v1/namespaces/{ns}/replicasets",
+    "rs": "/apis/apps/v1/namespaces/{ns}/replicasets",
+    "services": "/api/v1/namespaces/{ns}/services",
+}
+
+
+def _req(server: str, method: str, path: str, payload=None) -> dict:
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        server.rstrip("/") + path, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        body = e.read().decode(errors="replace")
+        try:
+            return json.loads(body)
+        except ValueError:
+            return {"kind": "Status", "code": e.code, "message": body}
+    except urllib.error.URLError as e:
+        return {"kind": "Status", "code": 503,
+                "message": f"cannot reach apiserver {server}: {e.reason}"}
+
+
+def _path(kind: str, ns: str, name: str = "") -> str:
+    base = KIND_PATHS[kind].format(ns=ns)
+    return f"{base}/{name}" if name else base
+
+
+def _pod_row(p: dict):
+    meta, spec, status = p.get("metadata", {}), p.get("spec", {}), p.get("status", {})
+    return (meta.get("namespace", ""), meta.get("name", ""),
+            status.get("phase", ""), spec.get("nodeName", "") or "<none>")
+
+
+def _node_row(n: dict):
+    meta, spec, status = n.get("metadata", {}), n.get("spec", {}), n.get("status", {})
+    ready = "Unknown"
+    for c in status.get("conditions", []):
+        if c.get("type") == "Ready":
+            ready = {"True": "Ready", "False": "NotReady"}.get(
+                c.get("status"), "Unknown"
+            )
+    if spec.get("unschedulable"):
+        ready += ",SchedulingDisabled"
+    return (meta.get("name", ""), ready,
+            status.get("allocatable", {}).get("cpu", ""),
+            status.get("allocatable", {}).get("memory", ""))
+
+
+def _print_table(rows, header):
+    widths = [max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))]
+    for r in [header] + rows:
+        print("  ".join(str(v).ljust(w) for v, w in zip(r, widths)).rstrip())
+
+
+def main(argv=None) -> int:
+    # SUPPRESS keeps the subparser's copy of a flag from clobbering a value
+    # parsed before the verb (kubectl accepts flags on either side)
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--server", "-s", default=argparse.SUPPRESS)
+    common.add_argument("-n", "--namespace", default=argparse.SUPPRESS)
+    common.add_argument("-o", "--output", choices=("", "json", "wide"),
+                        default=argparse.SUPPRESS)
+    p = argparse.ArgumentParser(prog="kubectl (kubernetes-tpu)",
+                                parents=[common])
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    g = sub.add_parser("get", parents=[common])
+    g.add_argument("kind")
+    g.add_argument("name", nargs="?", default="")
+
+    c = sub.add_parser("create", parents=[common])
+    c.add_argument("-f", "--filename", required=True)
+
+    d = sub.add_parser("delete", parents=[common])
+    d.add_argument("kind")
+    d.add_argument("name")
+
+    e = sub.add_parser("describe", parents=[common])
+    e.add_argument("kind")
+    e.add_argument("name")
+
+    b = sub.add_parser("bind", parents=[common])
+    b.add_argument("pod")
+    b.add_argument("node")
+
+    args = p.parse_args(argv)
+    args.server = getattr(args, "server", "http://127.0.0.1:8001")
+    args.output = getattr(args, "output", "")
+    ns = getattr(args, "namespace", "default")
+
+    if args.verb == "get":
+        out = _req(args.server, "GET", _path(args.kind, ns, args.name))
+        if out.get("kind") == "Status":
+            print(out.get("message", ""), file=sys.stderr)
+            return 1
+        if args.output == "json":
+            print(json.dumps(out, indent=2))
+            return 0
+        items = out.get("items", [out] if out else [])
+        if args.kind in ("nodes", "node"):
+            _print_table([_node_row(i) for i in items],
+                         ("NAME", "STATUS", "CPU", "MEMORY"))
+        else:
+            _print_table([_pod_row(i) for i in items],
+                         ("NAMESPACE", "NAME", "STATUS", "NODE"))
+        return 0
+
+    if args.verb == "create":
+        with open(args.filename) as f:
+            obj = json.load(f)
+        kind = obj.get("kind", "Pod").lower() + "s"
+        obj_ns = (obj.get("metadata") or {}).get("namespace") or ns
+        out = _req(args.server, "POST", _path(kind, obj_ns), obj)
+        if out.get("kind") == "Status" and out.get("code", 201) >= 400:
+            print(out.get("message", ""), file=sys.stderr)
+            return 1
+        name = (out.get("metadata") or {}).get("name", "")
+        print(f"{kind[:-1]}/{name} created")
+        return 0
+
+    if args.verb == "delete":
+        out = _req(args.server, "DELETE", _path(args.kind, ns, args.name))
+        ok = out.get("reason") == "Success"
+        print(out.get("message", ""), file=sys.stderr if not ok else sys.stdout)
+        return 0 if ok else 1
+
+    if args.verb == "describe":
+        out = _req(args.server, "GET", _path(args.kind, ns, args.name))
+        if out.get("kind") == "Status":
+            print(out.get("message", ""), file=sys.stderr)
+            return 1
+        print(json.dumps(out, indent=2))
+        return 0
+
+    if args.verb == "bind":
+        out = _req(
+            args.server, "POST",
+            _path("pods", ns, args.pod) + "/binding",
+            {"target": {"name": args.node}},
+        )
+        ok = out.get("code", 0) in (200, 201)
+        print(out.get("message", ""),
+              file=sys.stdout if ok else sys.stderr)
+        return 0 if ok else 1
+
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

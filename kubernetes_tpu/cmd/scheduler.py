@@ -59,16 +59,10 @@ def main(argv=None) -> int:
 
     import json
 
-    from kubernetes_tpu.runtime.cache import SchedulerCache
-    from kubernetes_tpu.runtime.cluster import (
-        LocalCluster,
-        make_cluster_binder,
-        wire_scheduler,
-    )
+    from kubernetes_tpu.cmd.base import build_wired_scheduler
+    from kubernetes_tpu.runtime.cluster import LocalCluster
     from kubernetes_tpu.runtime.health import HealthServer
     from kubernetes_tpu.runtime.kubemark import HollowFleet
-    from kubernetes_tpu.runtime.queue import PriorityQueue
-    from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
 
     cc = load_component_config(args.config)
     if args.policy_config_file:
@@ -80,13 +74,7 @@ def main(argv=None) -> int:
         cc.batch_size = args.batch_size
 
     cluster = LocalCluster()
-    sched = Scheduler(
-        cache=SchedulerCache(),
-        queue=PriorityQueue(),
-        binder=make_cluster_binder(cluster),
-        config=SchedulerConfig.from_component_config(cc),
-    )
-    wire_scheduler(cluster, sched)
+    sched = build_wired_scheduler(cluster, cc)
 
     health = None
     addr = args.healthz_bind_address or cc.healthz_bind_address

@@ -81,6 +81,14 @@ class LocalCluster:
                 for s in self._store[kind].values():
                     fn(ADDED, kind, s.obj)
 
+    def unwatch(self, fn: Callable[[str, str, object], None]) -> None:
+        """Drop a subscription (watch-stream teardown)."""
+        with self._lock:
+            try:
+                self._watchers.remove(fn)
+            except ValueError:
+                pass
+
     def create(self, kind: str, obj) -> int:
         with self._lock:
             key = self._key(kind, obj)

@@ -1,0 +1,267 @@
+"""REST API server layer (SURVEY.md layer 4 slice + section 3.3 write path)
+and the kubectl analog."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.serialize import node_to_dict, pod_to_dict
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.apiserver import AdmissionDenied, APIServer
+from kubernetes_tpu.cmd import kubectl
+from kubernetes_tpu.runtime.cluster import LocalCluster
+
+from fixtures import make_node, make_pod
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer().start()
+    yield srv
+    srv.stop()
+
+
+def _req(url, method="GET", payload=None):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+# ------------------------------------------------------------- serialization
+
+
+def test_pod_round_trip_serialization():
+    pod = make_pod(
+        "p", cpu="500m", mem="512Mi", labels={"app": "x"},
+        node_selector={"disk": "ssd"},
+        tolerations=[{"key": "k", "operator": "Exists", "effect": "NoSchedule"}],
+        affinity={
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{"matchExpressions": [
+                        {"key": "zone", "operator": "In", "values": ["z1"]}
+                    ]}]
+                }
+            },
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "labelSelector": {"matchLabels": {"app": "x"}},
+                    "topologyKey": "kubernetes.io/hostname",
+                }]
+            },
+        },
+        ports=[{"hostPort": 80, "containerPort": 8080, "protocol": "TCP"}],
+        priority=7,
+        init_requests=[{"cpu": "1"}],
+        owner=("ReplicaSet", "uid-1"),
+    )
+    rt = Pod.from_dict(pod_to_dict(pod))
+    assert rt == pod
+
+
+def test_node_round_trip_serialization():
+    node = make_node(
+        "n", cpu="4", mem="8Gi", labels={"zone": "z1"},
+        taints=[{"key": "k", "value": "v", "effect": "NoSchedule"}],
+        unschedulable=True,
+        images=[{"names": ["img:v1"], "sizeBytes": 1000}],
+    )
+    rt = Node.from_dict(node_to_dict(node))
+    assert rt == node
+
+
+# --------------------------------------------------------------------- CRUD
+
+
+def test_crud_and_binding_flow(server):
+    u = server.url
+    code, _ = _req(f"{u}/api/v1/nodes", "POST",
+                   node_to_dict(make_node("n1", cpu="4")))
+    assert code == 201
+    code, out = _req(f"{u}/api/v1/namespaces/default/pods", "POST",
+                     pod_to_dict(make_pod("p1", cpu="500m")))
+    assert code == 201 and out["metadata"]["resourceVersion"]
+
+    code, lst = _req(f"{u}/api/v1/namespaces/default/pods")
+    assert code == 200 and len(lst["items"]) == 1
+
+    # the Binding subresource sets spec.nodeName (registry strategy)
+    code, _ = _req(f"{u}/api/v1/namespaces/default/pods/p1/binding", "POST",
+                   {"target": {"name": "n1"}})
+    assert code == 201
+    code, got = _req(f"{u}/api/v1/namespaces/default/pods/p1")
+    assert got["spec"]["nodeName"] == "n1"
+    # double bind conflicts
+    code, _ = _req(f"{u}/api/v1/namespaces/default/pods/p1/binding", "POST",
+                   {"target": {"name": "n1"}})
+    assert code == 409
+
+    code, _ = _req(f"{u}/api/v1/namespaces/default/pods/p1", "DELETE")
+    assert code == 200
+    code, _ = _req(f"{u}/api/v1/namespaces/default/pods/p1")
+    assert code == 404
+
+
+def test_optimistic_concurrency_put(server):
+    u = server.url
+    code, out = _req(f"{u}/api/v1/nodes", "POST",
+                     node_to_dict(make_node("n1", cpu="4")))
+    rv = out["metadata"]["resourceVersion"]
+    upd = node_to_dict(make_node("n1", cpu="8"))
+    upd["metadata"]["resourceVersion"] = rv
+    code, out2 = _req(f"{u}/api/v1/nodes/n1", "PUT", upd)
+    assert code == 200
+    # stale rv -> 409 (etcd3 CAS)
+    upd["metadata"]["resourceVersion"] = rv
+    code, _ = _req(f"{u}/api/v1/nodes/n1", "PUT", upd)
+    assert code == 409
+
+
+def test_admission_chain_mutates_and_denies():
+    def defaulter(op, kind, d):
+        if kind == "pods":
+            d.setdefault("metadata", {}).setdefault("labels", {})["injected"] = "yes"
+        return d
+
+    def quota(op, kind, d):
+        if kind == "pods" and op == "CREATE" and \
+                d["metadata"].get("namespace") == "limited":
+            raise AdmissionDenied("namespace quota exceeded")
+        return d
+
+    srv = APIServer(admission=[defaulter, quota]).start()
+    try:
+        u = srv.url
+        code, out = _req(f"{u}/api/v1/namespaces/default/pods", "POST",
+                         pod_to_dict(make_pod("ok", cpu="1")))
+        assert code == 201 and out["metadata"]["labels"]["injected"] == "yes"
+        code, out = _req(f"{u}/api/v1/namespaces/limited/pods", "POST",
+                         pod_to_dict(make_pod("no", namespace="limited")))
+        assert code == 403 and out["reason"] == "Forbidden"
+    finally:
+        srv.stop()
+
+
+def test_watch_stream_delivers_events(server):
+    u = server.url
+    got = []
+    done = threading.Event()
+
+    def reader():
+        req = urllib.request.Request(f"{u}/api/v1/watch")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            for raw in resp:
+                line = raw.strip()
+                if not line:
+                    continue
+                got.append(json.loads(line))
+                if len(got) >= 2:
+                    done.set()
+                    return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.2)  # let the watch register
+    _req(f"{u}/api/v1/nodes", "POST", node_to_dict(make_node("n1")))
+    _req(f"{u}/api/v1/namespaces/default/pods", "POST",
+         pod_to_dict(make_pod("p1", cpu="1")))
+    assert done.wait(5.0), f"only saw {got}"
+    kinds = {(e["type"], e["kind"]) for e in got}
+    assert ("ADDED", "nodes") in kinds and ("ADDED", "pods") in kinds
+
+
+def test_replicasets_rest(server):
+    u = server.url
+    rs = {
+        "kind": "ReplicaSet", "apiVersion": "apps/v1",
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"replicas": 3,
+                 "selector": {"matchLabels": {"app": "web"}},
+                 "template": {"metadata": {"labels": {"app": "web"}},
+                              "spec": {"containers": [{"name": "c0"}]}}},
+    }
+    code, _ = _req(f"{u}/apis/apps/v1/namespaces/default/replicasets", "POST", rs)
+    assert code == 201
+    code, lst = _req(f"{u}/apis/apps/v1/namespaces/default/replicasets")
+    assert code == 200 and lst["items"][0]["spec"]["replicas"] == 3
+
+
+# ------------------------------------------------------------------ kubectl
+
+
+def test_kubectl_verbs(server, tmp_path, capsys):
+    u = server.url
+    _req(f"{u}/api/v1/nodes", "POST", node_to_dict(make_node("n1", cpu="4")))
+
+    f = tmp_path / "pod.json"
+    f.write_text(json.dumps(pod_to_dict(make_pod("p1", cpu="250m"))))
+    assert kubectl.main(["-s", u, "create", "-f", str(f)]) == 0
+    assert "pod/p1 created" in capsys.readouterr().out
+
+    assert kubectl.main(["-s", u, "get", "pods"]) == 0
+    out = capsys.readouterr().out
+    assert "p1" in out and "Pending" in out
+
+    assert kubectl.main(["-s", u, "bind", "p1", "n1"]) == 0
+    capsys.readouterr()
+    assert kubectl.main(["-s", u, "get", "pods", "-o", "json"]) == 0
+    got = json.loads(capsys.readouterr().out)
+    assert got["items"][0]["spec"]["nodeName"] == "n1"
+
+    assert kubectl.main(["-s", u, "get", "nodes"]) == 0
+    assert "n1" in capsys.readouterr().out
+
+    assert kubectl.main(["-s", u, "delete", "pod", "p1"]) == 0
+    capsys.readouterr()
+    assert kubectl.main(["-s", u, "get", "pods", "p1"]) == 1
+
+
+# ----------------------------------------------------------- all-in-one loop
+
+
+def test_apiserver_with_scheduler_end_to_end():
+    """POST pods through REST; the wired scheduler binds them; hollow nodes
+    run them — the full section 3.3 write path in-process."""
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+    from kubernetes_tpu.runtime.cluster import make_cluster_binder, wire_scheduler
+    from kubernetes_tpu.runtime.kubemark import HollowFleet
+    from kubernetes_tpu.runtime.queue import PriorityQueue
+    from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster).start()
+    try:
+        sched = Scheduler(
+            cache=SchedulerCache(), queue=PriorityQueue(),
+            binder=make_cluster_binder(cluster), config=SchedulerConfig(),
+        )
+        wire_scheduler(cluster, sched)
+        fleet = HollowFleet(cluster, [make_node(f"n{i}", cpu="4")
+                                      for i in range(3)])
+        u = srv.url
+        for i in range(9):
+            code, _ = _req(f"{u}/api/v1/namespaces/default/pods", "POST",
+                           pod_to_dict(make_pod(f"p{i}", cpu="200m")))
+            assert code == 201
+        for _ in range(5):
+            sched.run_once(timeout=0.3)
+            if fleet.total_running >= 9:
+                break
+        assert fleet.total_running == 9
+        code, lst = _req(f"{u}/api/v1/namespaces/default/pods")
+        assert all(p["spec"].get("nodeName") for p in lst["items"])
+        assert all(p["status"]["phase"] == "Running" for p in lst["items"])
+    finally:
+        srv.stop()
