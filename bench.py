@@ -134,6 +134,7 @@ def run(args) -> dict:
         encode_batch_ports,
         make_sequential_scheduler,
     )
+    from kubernetes_tpu.models.speculative import make_speculative_scheduler
 
     zone = "failure-domain.beta.kubernetes.io/zone"
     enc = SnapshotEncoder()
@@ -167,7 +168,12 @@ def run(args) -> dict:
             owner=("ReplicaSet", f"rs-{d}"),
         )
 
-    fn = make_sequential_scheduler(
+    make_engine = (
+        make_speculative_scheduler
+        if args.engine == "speculative"
+        else make_sequential_scheduler
+    )
+    fn = make_engine(
         unsched_taint_key=enc.interner.intern("node.kubernetes.io/unschedulable"),
         zone_key_id=enc.getzone_key,
     )
@@ -217,6 +223,7 @@ def run(args) -> dict:
         "pods_scheduled": scheduled,
         "unschedulable": unschedulable,
         "batch": args.batch,
+        "engine": args.engine,
         "seconds": round(dt, 3),
         "node_encode_seconds": round(t_nodes, 3),
         "device": str(jax.devices()[0]),
@@ -238,6 +245,11 @@ def main():
     ap.add_argument("--nodes", type=int, default=5000)
     ap.add_argument("--pods", type=int, default=10000)
     ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument(
+        "--engine", choices=("speculative", "sequential"), default="speculative",
+        help="speculative = parallel placement + conflict repair (fast path); "
+        "sequential = exact one-at-a-time commit semantics",
+    )
     ap.add_argument("--warmup", type=int, default=1, help="warmup batches (compile)")
     ap.add_argument("--retries", type=int, default=3, help="fresh-process TPU retries")
     ap.add_argument("--retry-backoff", type=float, default=20.0, help="seconds")

@@ -1,0 +1,172 @@
+"""Speculative parallel placement: the high-throughput engine.
+
+The sequential-commit scan (models/batched.py) reproduces one-pod-at-a-time
+semantics exactly, but a `lax.scan` step is latency-bound (~ms on TPU), so B
+pods cost B sequential steps.  This engine instead places the WHOLE batch in
+one fully-parallel launch (filter + score over the pods x nodes grid — all
+MXU work), then resolves conflicts host-side:
+
+  round r:
+    1. one launch: mask/score every remaining pod against the current
+       cluster state, argmax with per-pod staggered tie-break
+       (ops/select.select_hosts_batch — identical pods rotate across tied
+       nodes, so collisions are rare by construction);
+    2. host commit, in batch order: accept a pod iff its node still has
+       capacity AND no host-port conflict with pods committed this cycle;
+       rejected pods get extra_mask[b, node] = False (guaranteed progress:
+       a pod never re-picks a node it was bounced from) and go to round r+1
+       against the updated resource columns.
+
+Every PREDICATE is enforced (device mask + host commit re-check); what
+differs from the sequential scan is in-batch score freshness: same-round
+pods don't see each other in the spreading/balance scores (they do between
+rounds).  Workloads carrying required (anti-)affinity should use the
+sequential scan (the scheduler's auto mode does), since in-batch affinity
+state lives there.
+
+Typical convergence: round 1 commits ~all pods (staggered ties), so the cost
+is ~1 parallel launch per batch instead of B scan steps — the path to the
+>=10k pods/s north star (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.codec.schema import (
+    ClusterTensors,
+    FilterConfig,
+    PAD,
+    PodBatch,
+    WILDCARD,
+)
+from kubernetes_tpu.models.generic import schedule_batch_independent
+
+MAX_ROUNDS = 16
+
+
+def _ports_of(pods: PodBatch, b: int):
+    """[(proto_port_id, ip_id)] requested by batch pod b (host-side)."""
+    pp = np.asarray(pods.port_pp[b])
+    ip = np.asarray(pods.port_ip[b])
+    ok = np.asarray(pods.port_valid[b])
+    return [(int(p), int(i)) for p, i, v in zip(pp, ip, ok) if v]
+
+
+def _port_conflict(claimed, want) -> bool:
+    """Wildcard-IP host-port semantics (nodeinfo/host_ports.go)."""
+    for cp, ci in claimed:
+        for wp, wi in want:
+            if cp == wp and (ci == wi or ci == WILDCARD or wi == WILDCARD):
+                return True
+    return False
+
+
+def make_speculative_scheduler(
+    cfg: FilterConfig = FilterConfig(),
+    weights=None,
+    unsched_taint_key: int = 0,
+    zone_key_id: int = 5,
+    score_cfg=None,
+):
+    """Same call contract as make_sequential_scheduler:
+    fn(cluster, pods, ports, last_index0, extra_mask=None, extra_score=None)
+    -> (hosts i32[B] (-1 unschedulable), new_cluster with committed
+    requested/nonzero columns)."""
+
+    @jax.jit
+    def one_round(cluster, pods, requested, nonzero, active, last_index0,
+                  extra_mask, extra_score):
+        cl = dataclasses.replace(
+            cluster, requested=requested, nonzero_req=nonzero
+        )
+        out = schedule_batch_independent(
+            cl, pods, 0, cfg, unsched_taint_key, zone_key_id
+        )
+        mask = out["mask"] & active[:, None] & extra_mask
+        total = out["scores"] + extra_score
+        from kubernetes_tpu.ops.select import select_hosts_batch
+
+        hosts, feasible = select_hosts_batch(total, mask, last_index0)
+        return hosts, feasible & jnp.any(mask, axis=1)
+
+    def schedule(cluster: ClusterTensors, pods: PodBatch, ports,
+                 last_index0, nominated=None, extra_mask=None,
+                 extra_score=None, aff_state=None):
+        B = pods.n_pods
+        N = cluster.n_nodes
+        assert aff_state is None and nominated is None, (
+            "speculative engine handles the plain fast path; affinity/"
+            "nominated batches take the sequential scan"
+        )
+        # host mirrors for the commit checks / inter-round updates
+        req_host = np.array(cluster.requested, np.float32)
+        nz_host = np.array(cluster.nonzero_req, np.float32)
+        alloc = np.asarray(cluster.allocatable)
+        pod_req = np.asarray(pods.req)
+        pod_nz = np.asarray(pods.nonzero_req)
+        valid = np.asarray(pods.valid)
+
+        emask = (
+            np.ones((B, N), bool) if extra_mask is None
+            else np.array(extra_mask, bool)
+        )
+        escore = (
+            np.zeros((B, N), np.float32) if extra_score is None
+            else np.asarray(extra_score, np.float32)
+        )
+        hosts_out = np.full(B, -1, np.int32)
+        active = valid.copy()
+        claimed_ports: dict = {}
+        li = int(last_index0)
+
+        rounds = 0
+        while active.any() and rounds < MAX_ROUNDS:
+            rounds += 1
+            hosts, feasible = one_round(
+                cluster, pods, req_host, nz_host, active,
+                np.int32(li), emask, escore,
+            )
+            hosts = np.asarray(hosts)
+            feasible = np.asarray(feasible)
+            li += B
+            progressed = False
+            for b in np.nonzero(active)[0]:
+                if not feasible[b]:
+                    active[b] = False  # truly unschedulable this cycle
+                    continue
+                n = int(hosts[b])
+                req = pod_req[b]
+                fits = not np.any(
+                    (req > 0) & (req_host[n] + req > alloc[n])
+                )
+                want = _ports_of(pods, b)
+                ok_ports = not _port_conflict(claimed_ports.get(n, ()), want)
+                if fits and ok_ports:
+                    hosts_out[b] = n
+                    req_host[n] += req
+                    nz_host[n] += pod_nz[b]
+                    if want:
+                        claimed_ports.setdefault(n, []).extend(want)
+                    active[b] = False
+                    progressed = True
+                else:
+                    # never re-pick the node that bounced you: progress
+                    # guarantee for the next round
+                    emask[b, n] = False
+            if not progressed:
+                break
+
+        new_cluster = dataclasses.replace(
+            cluster,
+            requested=jnp.asarray(req_host),
+            nonzero_req=jnp.asarray(nz_host),
+        )
+        return jnp.asarray(hosts_out), new_cluster
+
+    return schedule

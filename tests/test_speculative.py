@@ -1,0 +1,111 @@
+"""Speculative parallel placement engine (models/speculative.py): every
+predicate + capacity constraint must hold, conflicts must repair, and the
+plain path must match the sequential engine's feasibility."""
+
+import numpy as np
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.codec.schema import FilterConfig
+from kubernetes_tpu.models.batched import (
+    encode_batch_ports,
+    make_sequential_scheduler,
+)
+from kubernetes_tpu.models.speculative import make_speculative_scheduler
+from kubernetes_tpu.ops import filter_batch
+
+from fixtures import TEST_DIMS, make_node, make_pod
+
+
+def _engines(enc):
+    kw = dict(
+        unsched_taint_key=enc.interner.intern("node.kubernetes.io/unschedulable"),
+        zone_key_id=enc.getzone_key,
+    )
+    return make_speculative_scheduler(**kw), make_sequential_scheduler(**kw)
+
+
+def _run(enc, fn, pods):
+    batch = enc.encode_pods(pods)
+    cluster = enc.snapshot()
+    ports = encode_batch_ports(enc, pods, enc.dims.N)
+    hosts, new_cluster = fn(cluster, batch, ports, np.int32(0))
+    return np.asarray(hosts), cluster, batch, new_cluster
+
+
+def test_speculative_places_all_when_space_exists():
+    enc = SnapshotEncoder(TEST_DIMS)
+    for i in range(8):
+        enc.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    spec, _ = _engines(enc)
+    pods = [make_pod(f"p{i}", cpu="500m", mem="512Mi") for i in range(12)]
+    hosts, cluster, batch, new_cluster = _run(enc, spec, pods)
+    assert (hosts[:12] >= 0).all()
+    # staggered tie-break spreads identical pods, so round 1 commits all:
+    # placements cover several nodes, none over capacity
+    used = np.bincount(hosts[:12], minlength=8)
+    assert used.max() <= 8  # 4 cpu / 500m
+    req = np.asarray(new_cluster.requested)
+    alloc = np.asarray(cluster.allocatable)
+    assert (req <= alloc + 1e-6).all()
+
+
+def test_conflict_repair_respects_capacity():
+    """2-cpu nodes, 1.5-cpu pods: one pod per node; surplus unschedulable."""
+    enc = SnapshotEncoder(TEST_DIMS)
+    for i in range(3):
+        enc.add_node(make_node(f"n{i}", cpu="2", mem="4Gi"))
+    spec, _ = _engines(enc)
+    pods = [make_pod(f"p{i}", cpu="1500m", mem="1Gi") for i in range(5)]
+    hosts, *_ = _run(enc, spec, pods)
+    placed = hosts[:5][hosts[:5] >= 0]
+    assert len(placed) == 3
+    assert len(set(placed.tolist())) == 3  # one per node, never double-packed
+
+
+def test_speculative_port_conflicts_within_batch():
+    enc = SnapshotEncoder(TEST_DIMS)
+    for i in range(2):
+        enc.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    spec, _ = _engines(enc)
+    pods = [
+        make_pod(f"p{i}", cpu="100m",
+                 ports=[{"hostPort": 8080, "containerPort": 80,
+                         "protocol": "TCP"}])
+        for i in range(3)
+    ]
+    hosts, *_ = _run(enc, spec, pods)
+    placed = hosts[:3][hosts[:3] >= 0]
+    # only one 8080 claim per node -> at most 2 of 3 place
+    assert len(placed) == 2
+    assert len(set(placed.tolist())) == 2
+
+
+def test_speculative_matches_sequential_feasibility():
+    """Same pods, both engines: identical scheduled/unschedulable counts and
+    every speculative placement passes the full predicate mask."""
+    enc = SnapshotEncoder(TEST_DIMS)
+    for i in range(8):
+        enc.add_node(make_node(
+            f"n{i}", cpu="4", mem="8Gi",
+            labels={"disk": "ssd" if i % 2 else "hdd"},
+        ))
+    enc.add_spread_selector("default", {"app": "w"})
+    for i in range(4):
+        enc.add_pod(make_pod(f"e{i}", cpu="1", mem="1Gi", node_name=f"n{i}",
+                             labels={"app": "w"}))
+    spec, seq = _engines(enc)
+    mk = lambda i: make_pod(
+        f"p{i}", cpu="700m", mem="512Mi", labels={"app": "w"},
+        node_selector={"disk": "ssd"} if i % 3 == 0 else None,
+    )
+    pods = [mk(i) for i in range(10)]
+    h_spec, cluster, batch, _ = _run(enc, spec, pods)
+    h_seq, *_ = _run(enc, seq, pods)
+    B = len(pods)
+    assert (h_spec[:B] >= 0).sum() == (h_seq[:B] >= 0).sum()
+    # every speculative placement satisfies the static predicate mask
+    mask, _ = filter_batch(cluster, batch, FilterConfig(), 0)
+    mask = np.asarray(mask)
+    for b in range(B):
+        if h_spec[b] >= 0:
+            assert mask[b, h_spec[b]], f"pod {b} on masked node {h_spec[b]}"
