@@ -181,7 +181,7 @@ def run(args) -> dict:
     # warmup/compile on one batch shape
     pods = [pending_pod(i) for i in range(args.batch)]
     batch = enc.encode_pods(pods)
-    ports = encode_batch_ports(enc, pods, enc.dims.N)
+    ports = encode_batch_ports(enc, pods)
     cluster = enc.snapshot()
     for _ in range(args.warmup):
         hosts, new_cluster = fn(cluster, batch, ports, np.int32(0))
@@ -199,7 +199,7 @@ def run(args) -> dict:
     for start in range(0, args.pods, args.batch):
         pods = [pending_pod(start + j) for j in range(min(args.batch, args.pods - start))]
         batch = enc.encode_pods(pods)
-        ports = encode_batch_ports(enc, pods, enc.dims.N)
+        ports = encode_batch_ports(enc, pods)
         hosts, state = fn(state, batch, ports, np.int32(last))
         last += len(pods)
         hosts = np.asarray(hosts)

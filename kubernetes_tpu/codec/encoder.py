@@ -178,6 +178,10 @@ class SnapshotEncoder:
         # spreading groups (services / RCs / RSs / StatefulSets)
         # ref priorities/selector_spreading.go getSelectors
         self._spread: List[Tuple[str, klabels.Selector]] = []  # (namespace, selector)
+        self._spread_kinds: List[str] = []  # "Service" | "ReplicaSet" | ...
+
+        # CheckServiceAffinity label keys (interned), empty = predicate off
+        self.service_affinity_keys: List[int] = []
 
         # image -> number of nodes having it (for ImageLocality spread scaling,
         # ref priorities/image_locality.go scaledImageScore)
@@ -961,10 +965,38 @@ class SnapshotEncoder:
 
     # ------------------------------------------------------------- spreading
 
-    def add_spread_selector(self, namespace: str, match_labels: Dict[str, str]) -> None:
+    def set_service_affinity_keys(self, key_ids: Sequence[int]) -> None:
+        """Configure the CheckServiceAffinity homogeneity labels (Policy
+        serviceAffinity argument, predicates.go:993-1067)."""
+        self.service_affinity_keys = list(key_ids)
+        self._pod_row_cache.clear()
+
+    def adopt_filter_config(self, cfg):
+        """Normalize a FilterConfig against THIS encoder: intern any
+        still-string service-affinity labels and register the keys so
+        encode_pods emits the candidate columns.  Returns the (possibly
+        replaced) config — the single entry point for runtime components
+        (Scheduler, ExtenderServer)."""
+        if cfg.service_affinity_labels:
+            import dataclasses as _dc
+
+            ids = tuple(
+                self.interner.intern(x) if isinstance(x, str) else int(x)
+                for x in cfg.service_affinity_labels
+            )
+            if ids != tuple(cfg.service_affinity_labels):
+                cfg = _dc.replace(cfg, service_affinity_labels=ids)
+            self.set_service_affinity_keys(ids)
+        return cfg
+
+    def add_spread_selector(self, namespace: str, match_labels: Dict[str, str],
+                            kind: str = "Service") -> None:
         """Register a Service/RC/RS/StatefulSet selector for SelectorSpread
-        (ref priorities/selector_spreading.go getSelectors)."""
+        (ref priorities/selector_spreading.go getSelectors).  `kind` matters
+        to CheckServiceAffinity, whose backfill gate counts only Services
+        (GetPodServices, predicates.go:978)."""
         self._spread.append((namespace, klabels.selector_from_match_labels(match_labels)))
+        self._spread_kinds.append(kind)
         if len(self._spread) > self.dims.G:
             self.dims = self.dims.bump(G=len(self._spread))
         self.generation += 1
@@ -1039,9 +1071,11 @@ class SnapshotEncoder:
             port_ip=self.a_pip.copy(),
             port_used=self.a_pused.copy(),
             topo_pairs=self.a_topo.copy(),
-            # shape carrier only: spread scoring reads PodBatch.spread_counts;
-            # G here sizes the in-batch group one-hots
-            group_counts=np.zeros((self._cap_n, self.dims.G), np.float32),
+            # per-group per-node matching-pod counts: the device-side source
+            # for SelectorSpread when the batch is spread-lean (every pod in
+            # <= 1 group); multi-group batches ship exact AND counts in
+            # PodBatch.spread_counts instead
+            group_counts=self._group_counts(),
             pair_topo_key=pk,
             image_id=self.a_img_id.copy(),
             image_size=(self.a_img_sz * scale).astype(np.float32),
@@ -1226,6 +1260,26 @@ class SnapshotEncoder:
         def zb(*shape):
             return np.zeros(shape, bool)
 
+        # ---- lean widths: the pair tensors are [.., TP] with TP the whole
+        # topology-pair vocabulary (hostname pairs dominate: ~1 per node).
+        # For a batch with no inter-pod-affinity exposure / no volumes they
+        # are provably all-zero, so emit width-1 placeholders instead — the
+        # kernels gate on shape (ops/predicates._is_lean) and skip the work.
+        # At 5k nodes this removes ~70MB of zero upload per 512-pod batch,
+        # the dominant cost through a remote-device tunnel.
+        aff_lean = not self.term_groups and not any(
+            p.spec.affinity is not None
+            and (
+                p.spec.affinity.pod_affinity is not None
+                or p.spec.affinity.pod_anti_affinity is not None
+            )
+            for p in pods
+        )
+        vol_lean = not any(p.spec.volumes for p in pods)
+        TPA = 1 if aff_lean else d.TP
+        TPV = 1 if vol_lean else d.TP
+        SA = max(len(self.service_affinity_keys), 1)
+
         out = dict(
             valid=zb(B),
             req=zf(B, d.R),
@@ -1263,32 +1317,34 @@ class SnapshotEncoder:
             pref_expr_nval=np.zeros((B, d.PS, d.E), i32),
             pref_expr_num=np.full((B, d.PS, d.E), np.nan, f32),
             pref_expr_valid=zb(B, d.PS, d.E),
-            forbidden_pairs=zb(B, d.TP),
-            aff_term_pairs=zb(B, d.PT, d.TP),
+            forbidden_pairs=zb(B, TPA),
+            aff_term_pairs=zb(B, d.PT, TPA),
             aff_term_valid=zb(B, d.PT),
             aff_term_self=zb(B, d.PT),
             aff_term_topo_key=zi(B, d.PT),
-            anti_term_pairs=zb(B, d.AT, d.TP),
+            anti_term_pairs=zb(B, d.AT, TPA),
             anti_term_valid=zb(B, d.AT),
             anti_term_topo_key=zi(B, d.AT),
             anti_term_self=zb(B, d.AT),
-            pref_pair_weights=zf(B, d.TP),
+            pref_pair_weights=zf(B, TPA),
             group_ids=zi(B, d.GP),
             group_valid=zb(B, d.GP),
+            svc_aff_fixed=zi(B, SA),
             image_ids=zi(B, d.C),
             image_bytes=zf(B, d.C),
             new_vol_counts=zf(B, NUM_VOL_TYPES),
             disk_vol_ids=zi(B, d.DV),
-            vol_zone_pairs=zb(B, d.VZ, d.TP),
+            vol_zone_pairs=zb(B, d.VZ, TPV),
             vol_zone_valid=zb(B, d.VZ),
-            vol_bind_pairs=zb(B, d.VB, d.TP),
+            vol_bind_pairs=zb(B, d.VB, TPV),
             vol_bind_valid=zb(B, d.VB),
             vol_fail_all=zb(B),
         )
 
         # interner ids are append-only (stable), so only pad-dim or
         # spread-registry changes invalidate cached rows
-        token = (self.dims, len(self._spread))
+        token = (self.dims, len(self._spread), aff_lean, vol_lean,
+                 tuple(self.service_affinity_keys))
         if token != self._pod_cache_token:
             self._pod_row_cache.clear()
             self._pod_cache_token = token
@@ -1371,6 +1427,10 @@ class SnapshotEncoder:
                             out, "pref_expr", b, s, e, expr.key, expr.operator, expr.values
                         )
             self._encode_pod_affinity(out, b, pod)
+            for j, kid in enumerate(self.service_affinity_keys):
+                v = pod.spec.node_selector.get(it.string(kid))
+                if v is not None:
+                    out["svc_aff_fixed"][b, j] = it.intern(v)
             gi = 0
             for g, (ns, sel) in enumerate(self._spread):
                 if gi >= d.GP:
@@ -1403,7 +1463,67 @@ class SnapshotEncoder:
         # cache): per-node counts of existing pods matching ALL of each pod's
         # spread selectors — countMatchingPods AND semantics
         # (selector_spreading.go:165-187), not one count per selector.
-        return PodBatch(**out, spread_counts=self._spread_and_counts(out))
+        # Lean form: when every pod belongs to <= 1 spread group, the AND
+        # degenerates to that group's column of cluster.group_counts — the
+        # device derives counts from the snapshot (selector_spread gates on
+        # shape) and the [B, N] host tensor is skipped entirely.
+        if not (out["group_valid"].sum(axis=1) > 1).any():
+            spread = np.zeros((out["group_ids"].shape[0], 1), np.float32)
+        else:
+            spread = self._spread_and_counts(out)
+        d0, d1 = self._service_affinity_candidates(pods, out)
+        return PodBatch(
+            **out, spread_counts=spread, svc_aff_d0=d0, svc_aff_d1=d1
+        )
+
+    def _service_affinity_candidates(self, pods, out):
+        """(d0, d1) i32[B]: first same-namespace arena pod whose labels
+        superset-match the pod's own labels (CreateSelectorFromLabels of
+        pod.Labels, predicates.go serviceAffinityMetadataProducer), and the
+        first such pod on a DIFFERENT node — together they resolve
+        FilterOutPods(evaluated node) per node on device.  Gated on some
+        service selecting the pod (GetPodServices non-empty)."""
+        B = out["group_ids"].shape[0]
+        d0 = np.full(B, -1, np.int32)
+        d1 = np.full(B, -1, np.int32)
+        if not self.service_affinity_keys:
+            return d0, d1
+        for b, pod in enumerate(pods):
+            # gate: some SERVICE selects the pod (GetPodServices; RC/RS/SS
+            # spread selectors don't count, predicates.go:978)
+            if not any(
+                kind == "Service" and ns == pod.namespace
+                and sel.matches(pod.labels)
+                for (ns, sel), kind in zip(self._spread, self._spread_kinds)
+            ):
+                continue
+            nsid = self.interner.lookup(pod.namespace)
+            if nsid < 0:
+                continue
+            sel = klabels.selector_from_match_labels(pod.labels)
+            m = self._match_selector_vec(sel, [nsid])
+            nodes = self.p_node[m & (self.p_node >= 0)]
+            if nodes.size:
+                d0[b] = nodes[0]
+                other = nodes[nodes != nodes[0]]
+                if other.size:
+                    d1[b] = other[0]
+        return d0, d1
+
+    def _group_counts(self) -> np.ndarray:
+        counts = np.zeros((self._cap_n, self.dims.G), np.float32)
+        for gi, (ns, sel) in enumerate(self._spread):
+            nsid = self.interner.lookup(ns)
+            if nsid < 0:
+                continue
+            matched = self._match_selector_vec(sel, [nsid])
+            nodes = self.p_node[matched]
+            nodes = nodes[nodes >= 0]
+            if nodes.size:
+                counts[:, gi] = np.bincount(
+                    nodes, minlength=self._cap_n
+                )[: self._cap_n].astype(np.float32)
+        return counts
 
     def _spread_and_counts(self, out) -> np.ndarray:
         """f32[B, N] from the batch's group_ids/group_valid rows: existing

@@ -297,7 +297,16 @@ class PodBatch:
     group_valid: Any        # bool[B, GP]
     spread_counts: Any      # f32[B, N] existing pods per node matching ALL of
                             #   the pod's spread selectors (countMatchingPods
-                            #   AND semantics, selector_spreading.go:165-187)
+                            #   AND semantics, selector_spreading.go:165-187);
+                            #   [B, 1] placeholder for spread-lean batches
+    # CheckServiceAffinity (predicates.go:993-1067), policy-configured:
+    svc_aff_fixed: Any      # i32[B, SA] value id the pod's nodeSelector pins
+                            #   for configured label j (PAD = not pinned)
+    svc_aff_d0: Any         # i32[B] node row of the FIRST same-ns pod whose
+                            #   labels superset-match the pod's (-1 = none)
+    svc_aff_d1: Any         # i32[B] first such pod on a DIFFERENT node than
+                            #   d0 (-1 = none) — FilterOutPods(evaluated
+                            #   node) reduces to d0-unless-thats-you-else-d1
     # images
     image_ids: Any          # i32[B, C]  (PAD empty)
     image_bytes: Any        # f32[B, C]  total size if known (0 otherwise)
@@ -337,6 +346,9 @@ class FilterConfig:
     # always-pass unless configured.
     label_presence_keys: tuple = ()
     label_presence_present: bool = True
+    # CheckServiceAffinity homogeneity labels (interned key ids; the Policy
+    # serviceAffinity argument, predicates.go:993-1067)
+    service_affinity_labels: tuple = ()
     enabled: Optional[tuple] = None  # tuple of predicate names, or None=all
 
 

@@ -156,6 +156,7 @@ def profile_from_policy(
     label_keys: list = []
     label_presence = True
     label_prefs: list = []
+    svc_aff_labels: list = []
     rtc_shape = None
 
     preds = policy.get("predicates")
@@ -175,7 +176,11 @@ def profile_from_policy(
                     )
                 label_presence = bool(lp.get("presence", True))
             elif "serviceAffinity" in arg:
-                name = "CheckServiceAffinity"  # tracked in PARITY.md
+                name = "CheckServiceAffinity"
+                for lab in arg["serviceAffinity"].get("labels", []):
+                    svc_aff_labels.append(
+                        interner.intern(lab) if interner is not None else lab
+                    )
             if name == "GeneralPredicates":
                 pred_set |= {
                     "PodFitsHost", "PodFitsHostPorts",
@@ -224,6 +229,7 @@ def profile_from_policy(
         hard_pod_affinity_weight=hard_w,
         label_presence_keys=tuple(label_keys),
         label_presence_present=label_presence,
+        service_affinity_labels=tuple(svc_aff_labels),
     )
     sc = ScoreConfig(
         label_prefs=tuple(label_prefs),

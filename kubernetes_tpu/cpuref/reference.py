@@ -140,7 +140,9 @@ class CPUScheduler:
         pvs: Sequence = (),
         pvcs: Sequence = (),
         storage_classes: Sequence = (),
+        service_affinity_labels: Sequence[str] = (),
     ):
+        self.service_affinity_labels = list(service_affinity_labels)
         self.nodes = list(nodes)
         self.pods = list(pods)
         self.services = list(services)
@@ -200,6 +202,44 @@ class CPUScheduler:
             if not any(match_node_selector_term(t, node) for t in na.required.terms):
                 return False
         return True
+
+    def check_service_affinity(self, pod: Pod, node: Node) -> bool:
+        """ref predicates.go:993-1067 checkServiceAffinity: configured labels
+        must be homogenous across a service's pods.  Pinned by the pod's own
+        nodeSelector where present; otherwise backfilled from the node of the
+        first same-namespace pod whose labels superset-match the pod's own
+        (serviceAffinityMetadataProducer), excluding pods on the evaluated
+        node (FilterOutPods)."""
+        cfg = self.service_affinity_labels
+        if not cfg:
+            return True
+        affinity = {
+            k: pod.spec.node_selector[k]
+            for k in cfg if k in pod.spec.node_selector
+        }
+        if len(cfg) > len(affinity):
+            services = [
+                (ns, sel) for ns, sel in self.services
+                if ns == pod.namespace
+                and klabels.selector_from_match_labels(sel).matches(pod.labels)
+            ]
+            if services:
+                matches = [
+                    p for p in self.pods
+                    if p.namespace == pod.namespace
+                    and all(
+                        p.labels.get(k) == v for k, v in pod.labels.items()
+                    )
+                    and p.spec.node_name
+                    and p.spec.node_name != node.name
+                ]
+                if matches:
+                    src = self.node_by_name.get(matches[0].spec.node_name)
+                    if src is not None:
+                        for k in cfg:
+                            if k not in affinity and k in src.labels:
+                                affinity[k] = src.labels[k]
+        return all(node.labels.get(k) == v for k, v in affinity.items())
 
     def pod_tolerates_node_taints(self, pod: Pod, node: Node, effects=(TAINT_NO_SCHEDULE, TAINT_NO_EXECUTE)) -> bool:
         for t in node.spec.taints:
@@ -474,7 +514,7 @@ class CPUScheduler:
                 pod, node, effects=(TAINT_NO_EXECUTE,)
             ),
             "CheckNodeLabelPresence": True,
-            "CheckServiceAffinity": True,
+            "CheckServiceAffinity": self.check_service_affinity(pod, node),
             "MaxEBSVolumeCount": vols[0],
             "MaxGCEPDVolumeCount": vols[1],
             "MaxCSIVolumeCount": vols[2],

@@ -64,6 +64,7 @@ class ExtenderServer:
         self.cache = cache or SchedulerCache()
         self.cfg = filter_config or FilterConfig()
         enc = self.cache.encoder
+        self.cfg = enc.adopt_filter_config(self.cfg)
         self._unsched = enc.interner.intern("node.kubernetes.io/unschedulable")
         # pods seen via /filter, so a later /bind can assume them with their
         # real resource requests; evicted on bind and on /sync pod events,

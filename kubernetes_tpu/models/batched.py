@@ -53,6 +53,7 @@ from kubernetes_tpu.ops.priorities import (
     node_prefer_avoid_pods,
     pod_group_onehot,
     resource_limits,
+    spread_counts,
     spread_score_from_counts,
     taint_toleration,
 )
@@ -70,12 +71,11 @@ class BatchPortState:
 
     pod_ports: Any      # bool[B, PV]  ports requested by each pod
     conflict: Any       # bool[PV, PV] do two batch ports conflict
-    node_conflict: Any  # bool[N, PV]  does the node's existing occupancy conflict
 
 
 jax.tree_util.register_dataclass(
     BatchPortState,
-    data_fields=["pod_ports", "conflict", "node_conflict"],
+    data_fields=["pod_ports", "conflict"],
     meta_fields=[],
 )
 
@@ -203,7 +203,7 @@ def encode_nominated(encoder, nominated_pairs, k_min: int = 8):
     return NominatedState(node=node, prio=prio, req=req)
 
 
-def encode_batch_ports(encoder, pods: Sequence, n_cap: int) -> BatchPortState:
+def encode_batch_ports(encoder, pods: Sequence) -> BatchPortState:
     """Host-side precompute of the batch port vocabulary.
 
     Conflict semantics mirror nodeinfo/host_ports.go CheckConflict:
@@ -225,15 +225,9 @@ def encode_batch_ports(encoder, pods: Sequence, n_cap: int) -> BatchPortState:
     for i, (pp1, ip1) in enumerate(plist):
         for j, (pp2, ip2) in enumerate(plist):
             conflict[i, j] = pp1 == pp2 and (ip1 == ip2 or ip1 == 0 or ip2 == 0)
-    node_conflict = np.zeros((n_cap, PV), bool)
-    for row, ports in encoder._node_ports.items():
-        for (npp, nip) in ports:
-            for v, (pp, ip) in enumerate(plist):
-                if pp == npp and (ip == nip or ip == 0 or nip == 0):
-                    node_conflict[row, v] = True
-    return BatchPortState(
-        pod_ports=pod_ports, conflict=conflict, node_conflict=node_conflict
-    )
+    # NB: conflicts vs EXISTING node occupancy are the static
+    # PodFitsHostPorts predicate's job; only in-batch claims live here
+    return BatchPortState(pod_ports=pod_ports, conflict=conflict)
 
 
 def _dynamic_scores(cluster, req_cpu_mem, requested2, zone_key_id, counts,
@@ -557,7 +551,8 @@ def make_sequential_scheduler(
             static_score,
             pods.req,
             pods.nonzero_req,
-            pods.spread_counts,
+            # device-derived for spread-lean batches (no [B, N] upload)
+            spread_counts(cluster, pods),
             pods.priority,
             ports.pod_ports,
             jnp.arange(B, dtype=jnp.int32),

@@ -61,7 +61,7 @@ class GangScheduler:
         need = group.min_member or len(pods)
         with sched.cache._lock:
             batch = enc.encode_pods(pods)
-            ports = encode_batch_ports(enc, pods, enc.dims.N)
+            ports = encode_batch_ports(enc, pods)
             # gangs with mutual required (anti-)affinity need the in-batch
             # affinity state exactly like any other batch
             aff_state = (

@@ -18,9 +18,11 @@ MXU work), then resolves conflicts host-side:
        against the updated resource columns.
 
 Every PREDICATE is enforced (device mask + host commit re-check); what
-differs from the sequential scan is in-batch score freshness: same-round
-pods don't see each other in the spreading/balance scores (they do between
-rounds).  Workloads carrying required (anti-)affinity should use the
+differs from the sequential scan is in-batch score freshness: the resource
+balance scores refresh between rounds (requested/nonzero are re-uploaded),
+but spreading counts come from the immutable snapshot, so same-batch
+service mates don't repel each other until the next cycle's snapshot.
+Workloads carrying required (anti-)affinity should use the
 sequential scan (the scheduler's auto mode does), since in-batch affinity
 state lives there.
 
@@ -41,30 +43,11 @@ import numpy as np
 from kubernetes_tpu.codec.schema import (
     ClusterTensors,
     FilterConfig,
-    PAD,
     PodBatch,
-    WILDCARD,
 )
-from kubernetes_tpu.models.generic import schedule_batch_independent
-
-MAX_ROUNDS = 16
-
-
-def _ports_of(pods: PodBatch, b: int):
-    """[(proto_port_id, ip_id)] requested by batch pod b (host-side)."""
-    pp = np.asarray(pods.port_pp[b])
-    ip = np.asarray(pods.port_ip[b])
-    ok = np.asarray(pods.port_valid[b])
-    return [(int(p), int(i)) for p, i, v in zip(pp, ip, ok) if v]
-
-
-def _port_conflict(claimed, want) -> bool:
-    """Wildcard-IP host-port semantics (nodeinfo/host_ports.go)."""
-    for cp, ci in claimed:
-        for wp, wi in want:
-            if cp == wp and (ci == wi or ci == WILDCARD or wi == WILDCARD):
-                return True
-    return False
+from kubernetes_tpu.ops.predicates import filter_batch
+from kubernetes_tpu.ops.priorities import score_batch
+from kubernetes_tpu.ops.select import select_hosts_batch
 
 
 def make_speculative_scheduler(
@@ -78,6 +61,7 @@ def make_speculative_scheduler(
     fn(cluster, pods, ports, last_index0, extra_mask=None, extra_score=None)
     -> (hosts i32[B] (-1 unschedulable), new_cluster with committed
     requested/nonzero columns)."""
+    w = None if weights is None else np.asarray(weights, np.float32)
 
     @jax.jit
     def one_round(cluster, pods, requested, nonzero, active, last_index0,
@@ -85,13 +69,12 @@ def make_speculative_scheduler(
         cl = dataclasses.replace(
             cluster, requested=requested, nonzero_req=nonzero
         )
-        out = schedule_batch_independent(
-            cl, pods, 0, cfg, unsched_taint_key, zone_key_id
+        mask, _ = filter_batch(cl, pods, cfg, unsched_taint_key)
+        total, _ = score_batch(
+            cl, pods, weights=w, score_cfg=score_cfg, zone_key_id=zone_key_id
         )
-        mask = out["mask"] & active[:, None] & extra_mask
-        total = out["scores"] + extra_score
-        from kubernetes_tpu.ops.select import select_hosts_batch
-
+        mask = mask & active[:, None] & extra_mask & pods.valid[:, None]
+        total = total + extra_score
         hosts, feasible = select_hosts_batch(total, mask, last_index0)
         return hosts, feasible & jnp.any(mask, axis=1)
 
@@ -111,6 +94,12 @@ def make_speculative_scheduler(
         pod_req = np.asarray(pods.req)
         pod_nz = np.asarray(pods.nonzero_req)
         valid = np.asarray(pods.valid)
+        # in-cycle host-port claims ride the SAME batch-local vocabulary and
+        # conflict matrix the scan uses (one source of wildcard-IP
+        # semantics, batched.encode_batch_ports)
+        pod_ports = np.asarray(ports.pod_ports)          # [B, PV]
+        conflict = np.asarray(ports.conflict, np.int32)  # [PV, PV]
+        claimed = np.zeros((N, conflict.shape[0]), bool)  # [N, PV]
 
         emask = (
             np.ones((B, N), bool) if extra_mask is None
@@ -122,12 +111,13 @@ def make_speculative_scheduler(
         )
         hosts_out = np.full(B, -1, np.int32)
         active = valid.copy()
-        claimed_ports: dict = {}
         li = int(last_index0)
 
-        rounds = 0
-        while active.any() and rounds < MAX_ROUNDS:
-            rounds += 1
+        # termination: every round either commits a pod (<= B times), marks
+        # one unschedulable, or clears at least one emask bit (<= B*N) — a
+        # zero-change round means every active pod is infeasible, which the
+        # `feasible` branch already retires.
+        while active.any():
             hosts, feasible = one_round(
                 cluster, pods, req_host, nz_host, active,
                 np.int32(li), emask, escore,
@@ -135,31 +125,33 @@ def make_speculative_scheduler(
             hosts = np.asarray(hosts)
             feasible = np.asarray(feasible)
             li += B
-            progressed = False
+            changed = False
             for b in np.nonzero(active)[0]:
                 if not feasible[b]:
                     active[b] = False  # truly unschedulable this cycle
+                    changed = True
                     continue
                 n = int(hosts[b])
                 req = pod_req[b]
                 fits = not np.any(
                     (req > 0) & (req_host[n] + req > alloc[n])
                 )
-                want = _ports_of(pods, b)
-                ok_ports = not _port_conflict(claimed_ports.get(n, ()), want)
+                want = pod_ports[b]
+                ok_ports = not np.any(
+                    want & ((claimed[n].astype(np.int32) @ conflict) > 0)
+                )
                 if fits and ok_ports:
                     hosts_out[b] = n
                     req_host[n] += req
                     nz_host[n] += pod_nz[b]
-                    if want:
-                        claimed_ports.setdefault(n, []).extend(want)
+                    claimed[n] |= want
                     active[b] = False
-                    progressed = True
                 else:
                     # never re-pick the node that bounced you: progress
                     # guarantee for the next round
                     emask[b, n] = False
-            if not progressed:
+                changed = True
+            if not changed:  # defensive; unreachable by construction
                 break
 
         new_cluster = dataclasses.replace(

@@ -236,9 +236,20 @@ def max_volume_counts(cluster: ClusterTensors, pods: PodBatch, max_vols):
     return ~((new > 0) & (used + new > limit))
 
 
+def _is_lean(pair_tensor, cluster: ClusterTensors) -> bool:
+    """True when the encoder emitted a width-1 placeholder instead of the
+    TP-wide pair tensor: the batch provably carries none of these terms, so
+    the kernel is skipped (shape is static at trace time — two compiled
+    variants, lean and full)."""
+    return pair_tensor.shape[-1] != cluster.topo_pairs.shape[-1]
+
+
 def _pair_terms_ok(cluster: ClusterTensors, term_pairs, term_valid):
     """AND over terms of 'node belongs to one of the term's allowed pairs'.
     term_pairs bool[B, K, TP], term_valid bool[B, K] -> bool[B, N]."""
+    if _is_lean(term_pairs, cluster):
+        B, N = term_pairs.shape[0], cluster.n_nodes
+        return jnp.ones((B, N), bool)
     topo = cluster.topo_pairs.astype(jnp.float32)            # [N, TP]
     hit = jnp.einsum("btp,np->btn", term_pairs.astype(jnp.float32), topo) > 0
     return jnp.all(hit | ~term_valid[..., None], axis=1)
@@ -259,6 +270,43 @@ def check_volume_binding(cluster: ClusterTensors, pods: PodBatch):
     return ok & ~pods.vol_fail_all[:, None]
 
 
+def _node_label_value(cluster: ClusterTensors, key_id: int):
+    """i32[N]: the node's value id for label `key_id` (PAD when absent)."""
+    hit = cluster.label_keys == key_id                       # [N, L]
+    val = jnp.max(jnp.where(hit, cluster.label_vals, PAD), axis=1)
+    return jnp.where(jnp.any(hit, axis=1), val, PAD)
+
+
+def check_service_affinity(cluster: ClusterTensors, pods: PodBatch,
+                           cfg: FilterConfig):
+    """CheckServiceAffinity (predicates.go:993-1067): for each configured
+    label L the pod must land on a node whose L-value matches either (a) the
+    pod's own nodeSelector pin, or (b) the L-value of the node hosting the
+    first same-service pod — excluding pods on the evaluated node itself
+    (FilterOutPods), which reduces to "first candidate node d0 unless d0 IS
+    the evaluated node, then d1" (encoder svc_aff_d0/d1).  Unpinned labels
+    with no candidate (or a candidate node lacking L) constrain nothing
+    (AddUnsetLabelsToMap adds only present labels)."""
+    B, N = pods.n_pods, cluster.n_nodes
+    ok = jnp.ones((B, N), bool)
+    if not cfg.service_affinity_labels:
+        return ok
+    narange = jnp.arange(N, dtype=jnp.int32)[None]           # [1, N]
+    d0 = pods.svc_aff_d0[:, None]
+    d1 = pods.svc_aff_d1[:, None]
+    src = jnp.where(d0 == narange, d1, d0)                   # [B, N]
+    has_src = src >= 0
+    src_c = jnp.clip(src, 0)
+    for j, key_id in enumerate(cfg.service_affinity_labels):
+        vals = _node_label_value(cluster, key_id)            # [N]
+        fixed = pods.svc_aff_fixed[:, j][:, None]            # [B, 1]
+        v_src = jnp.where(has_src, vals[src_c], PAD)         # [B, N]
+        ok_fixed = vals[None] == fixed
+        ok_backfill = ~has_src | (v_src == PAD) | (vals[None] == v_src)
+        ok = ok & jnp.where(fixed != PAD, ok_fixed, ok_backfill)
+    return ok
+
+
 def check_node_label_presence(cluster: ClusterTensors, pods: PodBatch, cfg: FilterConfig):
     """CheckNodeLabelPresence (predicates.go:923-967), policy-configured."""
     B = pods.n_pods
@@ -276,6 +324,8 @@ def required_affinity_ok(cluster: ClusterTensors, pods: PodBatch):
     ErrPodAffinityRulesNotMatch is unresolvable (evicting pods can only lose
     matches), while the anti-affinity components ARE resolvable
     (generic_scheduler.go:65-123 unresolvablePredicateFailureErrors)."""
+    if _is_lean(pods.aff_term_pairs, cluster):
+        return jnp.ones((pods.n_pods, cluster.n_nodes), bool)
     topo = cluster.topo_pairs.astype(jnp.float32)            # [N, TP]
     aff_hit = jnp.einsum(
         "btp,np->btn", pods.aff_term_pairs.astype(jnp.float32), topo
@@ -307,6 +357,8 @@ def match_inter_pod_affinity(cluster: ClusterTensors, pods: PodBatch):
          the term matches the incoming pod itself (first-pod bootstrap rule,
          predicates.go podMatchesPodAffinityTerms path).
     """
+    if _is_lean(pods.aff_term_pairs, cluster):
+        return jnp.ones((pods.n_pods, cluster.n_nodes), bool)
     topo = cluster.topo_pairs.astype(jnp.float32)            # [N, TP]
     # 1. existing anti-affinity
     viol1 = (pods.forbidden_pairs.astype(jnp.float32) @ topo.T) > 0   # [B, N]
@@ -350,7 +402,7 @@ def filter_batch(cluster: ClusterTensors, pods: PodBatch, cfg: FilterConfig,
         "PodToleratesNodeTaints": pod_tolerates_node_taints(cluster, pods),
         "PodToleratesNodeNoExecuteTaints": pod_tolerates_no_execute_taints(cluster, pods),
         "CheckNodeLabelPresence": check_node_label_presence(cluster, pods, cfg),
-        "CheckServiceAffinity": ones,
+        "CheckServiceAffinity": check_service_affinity(cluster, pods, cfg),
         "MaxEBSVolumeCount": vols[:, 0],
         "MaxGCEPDVolumeCount": vols[:, 1],
         "MaxCSIVolumeCount": vols[:, 2],

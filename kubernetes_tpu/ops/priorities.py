@@ -236,10 +236,23 @@ def pod_group_onehot(pods: PodBatch, n_groups: int):
 def selector_spread(cluster: ClusterTensors, pods: PodBatch, zone_key_id: int = 5):
     """SelectorSpreadPriority (priorities/selector_spreading.go:77-140):
     per-node counts of existing pods matching ALL the pod's selectors
-    (encoder-computed, countMatchingPods AND semantics), then the
-    zone-weighted reduce.  zone_key_id is the interned id of the encoder's
-    synthetic GetZoneKey topology key (region+zone grouping)."""
-    return spread_score_from_counts(pods.spread_counts, cluster, zone_key_id)
+    (countMatchingPods AND semantics), then the zone-weighted reduce.
+    zone_key_id is the interned id of the encoder's synthetic GetZoneKey
+    topology key (region+zone grouping).
+
+    Counts source: spread-lean batches (every pod in <= 1 group — the
+    common shape) derive counts on device from the snapshot's per-group
+    columns; multi-group batches ship exact host-computed AND counts."""
+    counts = spread_counts(cluster, pods)
+    return spread_score_from_counts(counts, cluster, zone_key_id)
+
+
+def spread_counts(cluster: ClusterTensors, pods: PodBatch):
+    """f32[B, N] matching-pod counts (see selector_spread)."""
+    if pods.spread_counts.shape[-1] != cluster.n_nodes:
+        onehot = pod_group_onehot(pods, cluster.group_counts.shape[1])
+        return onehot @ cluster.group_counts.T               # [B, N]
+    return pods.spread_counts
 
 
 # --------------------------------------------------------- inter-pod affinity
@@ -251,6 +264,10 @@ def inter_pod_affinity_score(cluster: ClusterTensors, pods: PodBatch):
     incoming pod, preferred+hard-symmetric terms of existing pods — all folded
     into pref_pair_weights by the encoder), then the min/max normalize
     fScore = 10 * (sum - min) / (max - min)."""
+    if pods.pref_pair_weights.shape[-1] != cluster.topo_pairs.shape[-1]:
+        # lean batch: no affinity exposure anywhere -> all sums identical
+        # (zero) -> score 0 on every node, computed for free
+        return jnp.zeros((pods.n_pods, cluster.n_nodes), jnp.float32)
     sums = pods.pref_pair_weights @ cluster.topo_pairs.astype(jnp.float32).T
     valid = cluster.valid[None]
     big = jnp.float32(3.4e38)

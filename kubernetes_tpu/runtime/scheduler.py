@@ -115,6 +115,9 @@ class Scheduler:
             self.config.filter_config = prof.filter_config
             self.config.weights = prof.weights_array()
         enc.hard_pod_affinity_weight = self.config.filter_config.hard_pod_affinity_weight
+        self.config.filter_config = enc.adopt_filter_config(
+            self.config.filter_config
+        )
         self._unsched_key = enc.interner.intern(TAINT_NODE_UNSCHEDULABLE)
         self._schedule_fn = make_sequential_scheduler(
             cfg=self.config.filter_config,
@@ -161,7 +164,7 @@ class Scheduler:
         batch_keys = {(p.namespace, p.name) for p in pods}
         with self.cache._lock:
             batch = enc.encode_pods(pods)
-            ports = encode_batch_ports(enc, pods, enc.dims.N)
+            ports = encode_batch_ports(enc, pods)
             # in-batch affinity state only when some pod carries required
             # (anti-)affinity — the plain path stays cheap
             aff_state = (

@@ -41,7 +41,7 @@ def run_device_sequential(nodes, existing, services, pending):
     for ns, sel in services:
         enc.add_spread_selector(ns, sel)
     batch = enc.encode_pods(pending)
-    ports = encode_batch_ports(enc, pending, enc.dims.N)
+    ports = encode_batch_ports(enc, pending)
     cluster = enc.snapshot()
     unsched = enc.interner.intern("node.kubernetes.io/unschedulable")
     fn = make_sequential_scheduler(

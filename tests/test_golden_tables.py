@@ -646,3 +646,101 @@ def test_inter_pod_affinity_table(case):
     check_predicate(
         "MatchInterPodAffinity", nodes, pods, pending, {"machine1": fits}
     )
+
+
+# --------------------------------------------------------------------------
+# TestServiceAffinity (predicates_test.go:1695-1875): nodes labeled with
+# region/zone; the configured labels must be homogenous per service.
+# --------------------------------------------------------------------------
+
+SVC_SEL = {"foo": "bar"}
+SVC_NODES = [
+    ("machine1", {"region": "r1", "zone": "z11"}),
+    ("machine2", {"region": "r1", "zone": "z12"}),
+    ("machine3", {"region": "r2", "zone": "z21"}),
+    ("machine4", {"region": "r2", "zone": "z22"}),
+    ("machine5", {"region": "r2", "zone": "z22"}),
+]
+
+SVC_AFF_CASES = [
+    # (name, labels_cfg, pending(labels, ns, nodeSelector),
+    #  existing[(node, labels, ns)], services[(ns, sel)], check_node, fits)
+    ("nothing scheduled", ["region"],
+     ({}, "nsnone", None), [], [], "machine1", True),
+    ("pod with region label match", ["region"],
+     ({}, "nsnone", {"region": "r1"}), [], [], "machine1", True),
+    ("pod with region label mismatch", ["region"],
+     ({}, "nsnone", {"region": "r2"}), [], [], "machine1", False),
+    ("service pod on same node", ["region"],
+     (SVC_SEL, "nsnone", None),
+     [("machine1", SVC_SEL, "nsnone")],
+     [("nsnone", SVC_SEL)], "machine1", True),
+    ("service pod on different node, region match", ["region"],
+     (SVC_SEL, "nsnone", None),
+     [("machine2", SVC_SEL, "nsnone")],
+     [("nsnone", SVC_SEL)], "machine1", True),
+    ("service pod on different node, region mismatch", ["region"],
+     (SVC_SEL, "nsnone", None),
+     [("machine3", SVC_SEL, "nsnone")],
+     [("nsnone", SVC_SEL)], "machine1", False),
+    ("service in different namespace, region mismatch", ["region"],
+     (SVC_SEL, "ns1", None),
+     [("machine3", SVC_SEL, "ns1")],
+     [("ns2", SVC_SEL)], "machine1", True),
+    ("pod in different namespace, region mismatch", ["region"],
+     (SVC_SEL, "ns1", None),
+     [("machine3", SVC_SEL, "ns2")],
+     [("ns1", SVC_SEL)], "machine1", True),
+    ("service and pod in same namespace, region mismatch", ["region"],
+     (SVC_SEL, "ns1", None),
+     [("machine3", SVC_SEL, "ns1")],
+     [("ns1", SVC_SEL)], "machine1", False),
+    ("multiple labels, not all match", ["region", "zone"],
+     (SVC_SEL, "nsnone", None),
+     [("machine2", SVC_SEL, "nsnone")],
+     [("nsnone", SVC_SEL)], "machine1", False),
+    ("multiple labels, all match", ["region", "zone"],
+     (SVC_SEL, "nsnone", None),
+     [("machine5", SVC_SEL, "nsnone")],
+     [("nsnone", SVC_SEL)], "machine4", True),
+]
+
+
+@pytest.mark.parametrize(
+    "case", SVC_AFF_CASES, ids=[c[0] for c in SVC_AFF_CASES]
+)
+def test_service_affinity_table(case):
+    from kubernetes_tpu.codec.schema import FilterConfig
+
+    name, cfg_labels, (plabels, pns, psel), existing, services, check, fits = case
+    nodes = [make_node(n, labels=l) for n, l in SVC_NODES]
+    pods = [
+        make_pod(f"e{i}", namespace=ns, node_name=n, labels=l)
+        for i, (n, l, ns) in enumerate(existing)
+    ]
+    pending = make_pod("pending", namespace=pns, labels=plabels,
+                       node_selector=psel)
+
+    enc = SnapshotEncoder(TEST_DIMS)
+    key_ids = [enc.interner.intern(k) for k in cfg_labels]
+    enc.set_service_affinity_keys(key_ids)
+    for n in nodes:
+        enc.add_node(n)
+    for p in pods:
+        enc.add_pod(p)
+    for ns, sel in services:
+        enc.add_spread_selector(ns, sel)
+    batch = enc.encode_pods([pending])
+    cluster = enc.snapshot()
+    cfg = FilterConfig(service_affinity_labels=tuple(key_ids))
+    _, per_pred = filter_batch(cluster, batch, cfg, 0)
+    per_pred = np.asarray(per_pred)
+    row = enc.node_rows[check]
+    got_dev = bool(per_pred[0, PRED_INDEX["CheckServiceAffinity"], row])
+    golden = CPUScheduler(nodes, pods, services,
+                          service_affinity_labels=cfg_labels)
+    got_ref = golden.check_service_affinity(
+        pending, next(n for n in nodes if n.name == check)
+    )
+    assert got_dev == fits, f"device={got_dev} want={fits}"
+    assert got_ref == fits, f"cpuref={got_ref} want={fits}"

@@ -35,7 +35,7 @@ def _run_batch(nodes, pending, existing=(), services=()):
         enc.add_pod(p)
     batch = enc.encode_pods(pending)
     cluster = enc.snapshot()
-    ports = encode_batch_ports(enc, pending, enc.dims.N)
+    ports = encode_batch_ports(enc, pending)
     aff = encode_batch_affinity(enc, pending)
     fn = make_sequential_scheduler(zone_key_id=enc.zone_key)
     hosts, _ = fn(cluster, batch, ports, np.int32(0), None, None, None, aff)
@@ -62,7 +62,7 @@ def _run_sequential(nodes, pending, existing=(), services=()):
     for i, pod in enumerate(pending):
         batch = enc.encode_pods([pod])
         cluster = enc.snapshot()
-        ports = encode_batch_ports(enc, [pod], enc.dims.N)
+        ports = encode_batch_ports(enc, [pod])
         hosts, _ = fn(cluster, batch, ports, np.int32(i))
         row = int(np.asarray(hosts)[0])
         if row >= 0:

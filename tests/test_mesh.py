@@ -52,20 +52,14 @@ def _world(n_nodes=64, n_pending=12):
     ]
     batch = enc.encode_pods(pending)
     cluster = enc.snapshot()
-    ports = encode_batch_ports(enc, pending, enc.dims.N)
+    ports = encode_batch_ports(enc, pending)
     return enc, cluster, batch, ports
 
 
 def _shard_all(cluster, batch, ports, mesh):
     cluster_s = shard_cluster(cluster, mesh)
     batch_s = replicate(batch, mesh)
-    ports_s = dataclasses.replace(
-        replicate(ports, mesh),
-        node_conflict=jax.device_put(
-            np.asarray(ports.node_conflict),
-            NamedSharding(mesh, P(NODE_AXIS, None)),
-        ),
-    )
+    ports_s = replicate(ports, mesh)
     return cluster_s, batch_s, ports_s
 
 

@@ -27,7 +27,7 @@ def _engines(enc):
 def _run(enc, fn, pods):
     batch = enc.encode_pods(pods)
     cluster = enc.snapshot()
-    ports = encode_batch_ports(enc, pods, enc.dims.N)
+    ports = encode_batch_ports(enc, pods)
     hosts, new_cluster = fn(cluster, batch, ports, np.int32(0))
     return np.asarray(hosts), cluster, batch, new_cluster
 
