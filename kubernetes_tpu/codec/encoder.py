@@ -179,6 +179,9 @@ class SnapshotEncoder:
         # ref priorities/selector_spreading.go getSelectors
         self._spread: List[Tuple[str, klabels.Selector]] = []  # (namespace, selector)
         self._spread_kinds: List[str] = []  # "Service" | "ReplicaSet" | ...
+        # raw (namespace, matchLabels) of Service entries — the cpuref
+        # what-if (preemption victim verification) needs dict selectors
+        self._service_selectors: List[Tuple[str, Dict[str, str]]] = []
 
         # CheckServiceAffinity label keys (interned), empty = predicate off
         self.service_affinity_keys: List[int] = []
@@ -997,6 +1000,8 @@ class SnapshotEncoder:
         (GetPodServices, predicates.go:978)."""
         self._spread.append((namespace, klabels.selector_from_match_labels(match_labels)))
         self._spread_kinds.append(kind)
+        if kind == "Service":
+            self._service_selectors.append((namespace, dict(match_labels)))
         if len(self._spread) > self.dims.G:
             self.dims = self.dims.bump(G=len(self._spread))
         self.generation += 1

@@ -40,7 +40,10 @@ class KubeSchedulerConfiguration:
     algorithm_provider: str = DEFAULT_PROVIDER
     policy: Optional[dict] = None            # legacy Policy JSON (wins if set)
     hard_pod_affinity_symmetric_weight: int = 1
-    percentage_of_nodes_to_score: int = 0    # 0 => adaptive default
+    percentage_of_nodes_to_score: int = 100  # 100 = full scan (the TPU
+                                             # default: one launch covers all
+                                             # nodes); 0 = the reference's
+                                             # adaptive formula; 1-99 fixed %
     bind_timeout_seconds: int = 100          # scheduler.go:48-53
     disable_preemption: bool = False
     leader_election: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)

@@ -871,6 +871,7 @@ class CPUScheduler:
             list(self.pvs.values()),
             list(self.pvcs.values()),
             list(self.storage_classes.values()),
+            service_affinity_labels=self.service_affinity_labels,
         )
 
     def _fits_minus(self, pod: Pod, node: Node, removed) -> bool:

@@ -57,7 +57,11 @@ from kubernetes_tpu.ops.priorities import (
     spread_score_from_counts,
     taint_toleration,
 )
-from kubernetes_tpu.ops.select import select_host
+from kubernetes_tpu.ops.select import (
+    limit_feasible,
+    num_feasible_nodes_device,
+    select_host,
+)
 from kubernetes_tpu.codec.schema import (
     DEFAULT_PRIORITY_WEIGHTS,
     PRIO_INDEX,
@@ -250,7 +254,10 @@ def _dynamic_scores(cluster, req_cpu_mem, requested2, zone_key_id, counts,
     return least, most, balanced, spread, rtc
 
 
-_SEQ_CACHE = {}
+from collections import OrderedDict
+
+_SEQ_CACHE: "OrderedDict" = OrderedDict()
+_SEQ_CACHE_CAP = 32  # bounds pinned executables (autoscaler what-if scale)
 
 
 def make_sequential_scheduler(
@@ -259,6 +266,7 @@ def make_sequential_scheduler(
     unsched_taint_key: int = 0,
     zone_key_id: int = 5,
     score_cfg: Optional[ScoreConfig] = None,
+    percentage_of_nodes_to_score: int = 100,
 ):
     """Build (or fetch the memoized) jitted sequential-commit scheduler.
 
@@ -273,9 +281,11 @@ def make_sequential_scheduler(
         unsched_taint_key,
         zone_key_id,
         score_cfg,
+        percentage_of_nodes_to_score,
     )
     hit = _SEQ_CACHE.get(key)
     if hit is not None:
+        _SEQ_CACHE.move_to_end(key)
         return hit
     w = np.asarray(
         DEFAULT_PRIORITY_WEIGHTS if weights is None else weights, np.float32
@@ -361,6 +371,14 @@ def make_sequential_scheduler(
             )
         if extra_score is not None:
             static_score = static_score + extra_score
+        feas_limit = (
+            num_feasible_nodes_device(
+                jnp.sum(cluster.valid.astype(jnp.int32)),
+                percentage_of_nodes_to_score,
+            )
+            if percentage_of_nodes_to_score < 100  # 0 = adaptive
+            else None
+        )
         group_onehot = pod_group_onehot(pods, G)              # [B, G]
         # in-batch spread cross-matches: committing pod j raises later pod
         # i's count at j's node iff j matches ALL of i's selectors — i.e.
@@ -467,6 +485,10 @@ def make_sequential_scheduler(
                     0.0,
                 )
                 total = total + w_ipa * jnp.where(cluster.valid, ipa, 0.0)
+            if percentage_of_nodes_to_score < 100:  # 0 = adaptive
+                # adaptive node sampling (numFeasibleNodesToFind) with the
+                # reference's rotating start offset
+                mask = limit_feasible(mask, feas_limit, last_idx)
             host, feasible = select_host(total, mask, last_idx)
             # commit
             commit = feasible
@@ -569,4 +591,6 @@ def make_sequential_scheduler(
         return hosts, new_cluster
 
     _SEQ_CACHE[key] = schedule
+    while len(_SEQ_CACHE) > _SEQ_CACHE_CAP:
+        _SEQ_CACHE.popitem(last=False)
     return schedule

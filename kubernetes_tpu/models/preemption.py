@@ -206,10 +206,15 @@ def verify_nomination(encoder, pod, row: int, victims, max_vols) -> bool:
     ref = CPUScheduler(
         nodes,
         remaining,
+        services=list(encoder._service_selectors),
         max_vols=max_vols,
         pvs=list(encoder.pvs.values()),
         pvcs=list(encoder.pvcs.values()),
         storage_classes=list(encoder.storage_classes.values()),
+        service_affinity_labels=[
+            encoder.interner.string(k)
+            for k in encoder.service_affinity_keys
+        ],
     )
     return all(ref.predicates(pod, node).values())
 

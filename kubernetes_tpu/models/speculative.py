@@ -47,7 +47,11 @@ from kubernetes_tpu.codec.schema import (
 )
 from kubernetes_tpu.ops.predicates import filter_batch
 from kubernetes_tpu.ops.priorities import score_batch
-from kubernetes_tpu.ops.select import select_hosts_batch
+from kubernetes_tpu.ops.select import (
+    limit_feasible,
+    num_feasible_nodes_device,
+    select_hosts_batch,
+)
 
 
 def make_speculative_scheduler(
@@ -56,6 +60,7 @@ def make_speculative_scheduler(
     unsched_taint_key: int = 0,
     zone_key_id: int = 5,
     score_cfg=None,
+    percentage_of_nodes_to_score: int = 100,
 ):
     """Same call contract as make_sequential_scheduler:
     fn(cluster, pods, ports, last_index0, extra_mask=None, extra_score=None)
@@ -74,6 +79,15 @@ def make_speculative_scheduler(
             cl, pods, weights=w, score_cfg=score_cfg, zone_key_id=zone_key_id
         )
         mask = mask & active[:, None] & extra_mask & pods.valid[:, None]
+        if percentage_of_nodes_to_score < 100:  # 0 = adaptive
+            lim = num_feasible_nodes_device(
+                jnp.sum(cl.valid.astype(jnp.int32)),
+                percentage_of_nodes_to_score,
+            )
+            starts = last_index0 + jnp.arange(mask.shape[0], dtype=jnp.int32)
+            mask = jax.vmap(limit_feasible, in_axes=(0, None, 0))(
+                mask, lim, starts
+            )
         total = total + extra_score
         hosts, feasible = select_hosts_batch(total, mask, last_index0)
         return hosts, feasible & jnp.any(mask, axis=1)

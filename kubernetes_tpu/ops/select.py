@@ -35,6 +35,39 @@ def num_feasible_nodes_to_find(num_all_nodes: int, percentage: int = 0) -> int:
     return num_nodes
 
 
+def num_feasible_nodes_device(num_all, percentage: int):
+    """num_feasible_nodes_to_find with a traced node count (the device-side
+    twin; generic_scheduler.go:434-453)."""
+    adaptive = (
+        jnp.maximum(
+            DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE - num_all // 125,
+            MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND,
+        )
+        if percentage == 0 else jnp.int32(percentage)
+    )
+    num = jnp.maximum(num_all * adaptive // 100, MIN_FEASIBLE_NODES_TO_FIND)
+    return jnp.where(num_all < MIN_FEASIBLE_NODES_TO_FIND, num_all, num)
+
+
+def limit_feasible(mask, limit, start):
+    """Keep only the first `limit` feasible nodes in round-robin order from
+    `start` — the device form of findNodesThatFit's adaptive early exit
+    (generic_scheduler.go:457-556 with numFeasibleNodesToFind + the
+    lastIndex offset :486,519).  The reference neither checks nor scores
+    nodes beyond the sample; masking them off is equivalent.
+
+    mask bool[N], limit i32 (traced ok), start i32 -> bool[N]."""
+    n = mask.shape[-1]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    rot = (idx - start) % n                   # position in scan order
+    order = jnp.argsort(rot)                  # node ids in scan order
+    feas_sorted = mask[..., order]
+    rank = jnp.cumsum(feas_sorted.astype(jnp.int32), axis=-1) - 1
+    keep_sorted = feas_sorted & (rank < limit)
+    inv = jnp.argsort(order)
+    return keep_sorted[..., inv]
+
+
 def select_host(scores, mask, last_index):
     """(scores f32[N], mask bool[N], last_index i32) -> (host i32, feasible bool).
 

@@ -72,7 +72,7 @@ class SchedulerConfig:
         return SchedulerConfig(
             batch_size=cc.batch_size,
             batch_window_s=cc.batch_window_s,
-            percentage_of_nodes_to_score=cc.percentage_of_nodes_to_score or 100,
+            percentage_of_nodes_to_score=cc.percentage_of_nodes_to_score,
             disable_preemption=cc.disable_preemption,
             weights=profile.weights_array(),
             filter_config=profile.filter_config,
@@ -125,6 +125,7 @@ class Scheduler:
             unsched_taint_key=self._unsched_key,
             zone_key_id=enc.getzone_key,
             score_cfg=prof.score_config if prof is not None else None,
+            percentage_of_nodes_to_score=self.config.percentage_of_nodes_to_score,
         )
         self.framework = framework
         # scheduler-side extender chain (core/extender.go; chained in config

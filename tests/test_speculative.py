@@ -109,3 +109,28 @@ def test_speculative_matches_sequential_feasibility():
     for b in range(B):
         if h_spec[b] >= 0:
             assert mask[b, h_spec[b]], f"pod {b} on masked node {h_spec[b]}"
+
+
+def test_percentage_of_nodes_to_score_limits_sample():
+    """The adaptive sampling knob (numFeasibleNodesToFind semantics,
+    generic_scheduler.go:434-453): with limit < feasible count, selection is
+    confined to the first K feasible nodes in round-robin order."""
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.ops.select import limit_feasible, num_feasible_nodes_device
+
+    # formula parity with the host version at representative sizes
+    from kubernetes_tpu.ops.select import num_feasible_nodes_to_find
+
+    for n in (50, 100, 1000, 5000, 50000):
+        for pct in (0, 5, 40, 100):
+            want = num_feasible_nodes_to_find(n, pct)
+            got = int(num_feasible_nodes_device(jnp.int32(n), pct))
+            assert got == want, (n, pct, got, want)
+
+    mask = np.array([True, False, True, True, False, True, True, True])
+    out = np.asarray(limit_feasible(jnp.asarray(mask), jnp.int32(2), jnp.int32(0)))
+    assert out.tolist() == [True, False, True, False, False, False, False, False]
+    # rotated start: first 2 feasible from index 4 -> nodes 5, 6
+    out = np.asarray(limit_feasible(jnp.asarray(mask), jnp.int32(2), jnp.int32(4)))
+    assert out.tolist() == [False, False, False, False, False, True, True, False]
