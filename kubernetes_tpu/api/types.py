@@ -430,6 +430,19 @@ class PodDisruptionBudget:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: Optional[dict] = None  # raw metav1.LabelSelector dict
     disruptions_allowed: int = 0     # status.disruptionsAllowed
+    # spec.minAvailable / spec.maxUnavailable: int or percent string
+    # ("50%"); at most one set (validation).  The disruption controller
+    # derives disruptions_allowed from these.
+    min_available: object = None
+    max_unavailable: object = None
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
 
     def matches(self, pod: "Pod") -> bool:
         if pod.namespace != self.metadata.namespace or self.selector is None:
@@ -460,6 +473,8 @@ class PodDisruptionBudget:
             disruptions_allowed=int(
                 status.get("disruptionsAllowed", status.get("PodDisruptionsAllowed", 0))
             ),
+            min_available=spec.get("minAvailable"),
+            max_unavailable=spec.get("maxUnavailable"),
         )
 
 

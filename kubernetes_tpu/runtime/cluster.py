@@ -45,7 +45,8 @@ class _Stored:
 
 
 class LocalCluster:
-    KINDS = ("nodes", "pods", "services", "leases", "replicasets")
+    KINDS = ("nodes", "pods", "services", "leases", "replicasets",
+             "poddisruptionbudgets", "endpoints")
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
@@ -176,6 +177,17 @@ def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
     queue = scheduler.queue
     if getattr(scheduler, "_recorder_defaulted", False):
         scheduler.recorder = cluster.events
+    if getattr(scheduler, "_pdb_defaulted", False):
+        # PDB-aware preemption reads live budgets from the store
+        # (the disruption controller maintains disruptionsAllowed)
+        scheduler.pdb_lister = lambda: cluster.list("poddisruptionbudgets")
+    if getattr(scheduler, "_victim_deleter_defaulted", False):
+        # preemption victims must leave the STORE (the DELETE the reference
+        # POSTs, scheduler.go:319-326) so controllers replace them and PDB
+        # budgets are debited; the cache-only default is for storeless use
+        scheduler.victim_deleter = (
+            lambda v: cluster.delete("pods", v.namespace, v.name)
+        )
 
     def on_event(event: str, kind: str, obj) -> None:
         if kind == "nodes":

@@ -129,6 +129,52 @@ class WorkQueue:
             return len(self._queue)
 
 
+# ---------------------------------------------------------------- reconciler
+
+
+class Reconciler:
+    """The shared controller worker shape (SURVEY.md section 3.5): a
+    WorkQueue of keys + sync(key), with rate-limited requeue on ANY error
+    (client-go HandleError semantics — a bad object must not kill the
+    thread).  Subclasses implement sync() and enqueue from watch events."""
+
+    def __init__(self, cluster: LocalCluster):
+        self.cluster = cluster
+        self.queue = WorkQueue()
+        cluster.watch(self._on_event)
+
+    def _on_event(self, event: str, kind: str, obj) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def sync(self, key) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def process_one(self, timeout: float = 0.2) -> bool:
+        key = self.queue.get(timeout)
+        if key is None:
+            return False
+        try:
+            self.sync(key)
+            self.queue.forget(key)
+        except Exception:
+            self.queue.add_rate_limited(key)
+        finally:
+            self.queue.done(key)
+        return True
+
+    def run(self, stop: threading.Event, workers: int = 1) -> List[threading.Thread]:
+        def worker():
+            while not stop.is_set():
+                self.process_one(timeout=0.05)
+
+        threads = [
+            threading.Thread(target=worker, daemon=True) for _ in range(workers)
+        ]
+        for t in threads:
+            t.start()
+        return threads
+
+
 # --------------------------------------------------------------- ReplicaSet
 
 
@@ -150,16 +196,14 @@ class ReplicaSet:
         return (self.namespace, self.name)
 
 
-class ReplicaSetController:
+class ReplicaSetController(Reconciler):
     """pkg/controller/replicaset syncReplicaSet: observed = store pods owned
     by the RS (owner_uid) and matching the selector; diff against
     spec.replicas; create/delete through the store."""
 
     def __init__(self, cluster: LocalCluster):
-        self.cluster = cluster
-        self.queue = WorkQueue()
         self._seq = 0
-        cluster.watch(self._on_event)
+        super().__init__(cluster)
 
     # ------------------------------------------------------ informer seam
 
@@ -222,35 +266,6 @@ class ReplicaSetController:
             owned.sort(key=lambda p: bool(p.spec.node_name))  # stable
             for p in owned[:-diff]:
                 self.cluster.delete("pods", p.namespace, p.name)
-
-    # -------------------------------------------------------------- run
-
-    def process_one(self, timeout: float = 0.2) -> bool:
-        key = self.queue.get(timeout)
-        if key is None:
-            return False
-        try:
-            self.sync(key)
-            self.queue.forget(key)
-        except Exception:
-            # client-go worker shape: HandleError + rate-limited requeue —
-            # a bad object must not kill the reconcile thread
-            self.queue.add_rate_limited(key)
-        finally:
-            self.queue.done(key)
-        return True
-
-    def run(self, stop: threading.Event, workers: int = 1) -> List[threading.Thread]:
-        def worker():
-            while not stop.is_set():
-                self.process_one(timeout=0.05)
-
-        threads = [
-            threading.Thread(target=worker, daemon=True) for _ in range(workers)
-        ]
-        for t in threads:
-            t.start()
-        return threads
 
 
 def add_replicaset(cluster: LocalCluster, rs: ReplicaSet) -> None:
@@ -385,6 +400,10 @@ class ControllerManager:
         self.cluster = cluster
         self.replicaset = ReplicaSetController(cluster)
         self.nodelifecycle = NodeLifecycleController(cluster, grace_period)
+        self.disruption = DisruptionController(cluster)
+        from kubernetes_tpu.runtime.network import EndpointsController
+
+        self.endpoints = EndpointsController(cluster)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -393,7 +412,80 @@ class ControllerManager:
         self._threads.append(
             self.nodelifecycle.run(self._stop, period=monitor_period)
         )
+        self._threads.append(self.disruption.run(self._stop))
+        self._threads.append(self.endpoints.run(self._stop))
 
     def stop(self) -> None:
         self._stop.set()
         self.replicaset.queue.close()
+        self.disruption.queue.close()
+        self.endpoints.queue.close()
+
+
+# ---------------------------------------------------------------- disruption
+
+
+def _int_or_percent(v, total: int, round_up: bool) -> int:
+    """intstr.GetValueFromIntOrPercent: "50%" scales against total (ceil for
+    minAvailable, floor for maxUnavailable), ints pass through."""
+    if isinstance(v, str) and v.endswith("%"):
+        pct = int(v[:-1])
+        scaled = pct * total / 100.0
+        import math
+
+        return math.ceil(scaled) if round_up else math.floor(scaled)
+    return int(v)
+
+
+class DisruptionController(Reconciler):
+    """pkg/controller/disruption: maintains each PodDisruptionBudget's
+    status.disruptionsAllowed = currentHealthy - desiredHealthy, where
+    desiredHealthy comes from spec.minAvailable or expected -
+    spec.maxUnavailable — BOTH percentage forms round UP
+    (GetValueFromIntOrPercent(..., true) in the disruption controller;
+    floor-for-maxUnavailable is the Deployment rollout rule, not this one).
+    Healthy = matching pods that are assigned and Running.  The scheduler's
+    PDB-aware preemption consumes the result (filterPodsWithPDBViolation)."""
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        # watch callbacks run under the store lock: never list/match here —
+        # enqueue a marker and resolve matching PDBs in the worker
+        if kind == "poddisruptionbudgets":
+            self.queue.add((obj.namespace, obj.name))
+        elif kind == "pods":
+            self.queue.add(("@pod", obj.namespace))
+
+    def sync(self, key) -> None:
+        if key[0] == "@pod":
+            # a pod in the namespace changed: re-sync every PDB there
+            for pdb in self.cluster.list("poddisruptionbudgets"):
+                if pdb.namespace == key[1]:
+                    self.sync((pdb.namespace, pdb.name))
+            return
+        ns, name = key
+        pdb, rv = self.cluster.get_with_rv("poddisruptionbudgets", ns, name)
+        if pdb is None:
+            return
+        matching = [p for p in self.cluster.list("pods") if pdb.matches(p)]
+        expected = len(matching)
+        healthy = sum(
+            1 for p in matching
+            if p.spec.node_name and p.status.phase == "Running"
+        )
+        if pdb.min_available is not None:
+            desired = _int_or_percent(pdb.min_available, expected, True)
+        elif pdb.max_unavailable is not None:
+            desired = expected - _int_or_percent(
+                pdb.max_unavailable, expected, True
+            )
+        else:
+            desired = expected  # no budget spec: nothing disruptable
+        allowed = max(healthy - desired, 0)
+        if allowed != pdb.disruptions_allowed:
+            # CAS against the read revision: a concurrent spec update wins
+            # and the ConflictError requeues this key (process_one)
+            self.cluster.update(
+                "poddisruptionbudgets",
+                dataclasses.replace(pdb, disruptions_allowed=allowed),
+                expect_rv=rv,
+            )

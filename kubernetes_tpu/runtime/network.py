@@ -1,0 +1,143 @@
+"""Service networking slice (SURVEY.md layer 9).
+
+The reference's dataplane is kube-proxy programming iptables/ipvs from
+Service+Endpoints watches (pkg/proxy; `syncProxyRules`
+iptables/proxier.go:667).  The standalone analog keeps the same two-stage
+architecture over the blackboard:
+
+  * EndpointsController (pkg/controller/endpoint): for every Service,
+    derive the Endpoints object = ready backends (assigned + Running pods
+    matching the selector), written back to the store;
+  * ServiceProxy (kube-proxy): watches services + endpoints and maintains a
+    versioned rules table (the iptables-rules analog — rebuilt by a full
+    `sync_rules` sweep, like syncProxyRules' full-table writes), exposing
+    `route(ns, service)` round-robin backend selection (the ipvs/iptables
+    DNAT probability-chain analog).
+
+Backends are addressed as (pod name, node name) — the hollow world has no
+pod IPs; a real deployment substitutes the CNI address at the same seam.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api import labels as klabels
+from kubernetes_tpu.runtime.cluster import LocalCluster
+from kubernetes_tpu.runtime.controllers import Reconciler, WorkQueue
+
+
+def _service_backends(cluster: LocalCluster, svc: dict) -> List[dict]:
+    sel = klabels.selector_from_match_labels(svc.get("selector") or {})
+    out = []
+    for p in cluster.list("pods"):
+        if (
+            p.namespace == svc["namespace"]
+            and p.spec.node_name
+            and p.status.phase == "Running"
+            and sel.matches(p.labels)
+        ):
+            out.append({"pod": p.name, "node": p.spec.node_name})
+    out.sort(key=lambda a: a["pod"])
+    return out
+
+
+class EndpointsController(Reconciler):
+    """pkg/controller/endpoint: Service selector + ready pods -> Endpoints
+    object in the store (the objects kube-proxy consumes)."""
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        # watch callbacks run under the store lock: enqueue markers only
+        if kind == "services":
+            self.queue.add((obj["namespace"], obj["name"]))
+        elif kind == "pods":
+            self.queue.add(("@pod", obj.namespace))
+
+    def sync(self, key) -> None:
+        if key[0] == "@pod":
+            for svc in self.cluster.list("services"):
+                if svc["namespace"] == key[1]:
+                    self.sync((svc["namespace"], svc["name"]))
+            return
+        ns, name = key
+        svc = self.cluster.get("services", ns, name)
+        if svc is None:
+            self.cluster.delete("endpoints", ns, name)
+            return
+        ep = {
+            "namespace": ns,
+            "name": name,
+            "addresses": _service_backends(self.cluster, svc),
+        }
+        cur = self.cluster.get("endpoints", ns, name)
+        if cur is None:
+            self.cluster.create("endpoints", ep)
+        elif cur.get("addresses") != ep["addresses"]:
+            self.cluster.update("endpoints", ep)
+
+class ServiceProxy:
+    """kube-proxy analog: a full-resync rules table + round-robin routing.
+
+    `sync_rules` is the syncProxyRules shape — recompute the WHOLE table
+    from the current services+endpoints state (level-triggered; the version
+    counter is the iptables-restore generation).  `route` picks the next
+    backend for a service round-robin (the ipvs rr scheduler / iptables
+    statistic-mode chain)."""
+
+    def __init__(self, cluster: LocalCluster, node_name: str = "proxy-0"):
+        self.cluster = cluster
+        self.node_name = node_name
+        self._lock = threading.Lock()
+        self.rules: Dict[Tuple[str, str], List[dict]] = {}
+        self.rules_version = 0
+        self._rr: Dict[Tuple[str, str], int] = {}
+        self._dirty = threading.Event()
+        cluster.watch(self._on_event)
+        self.sync_rules()
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind in ("services", "endpoints"):
+            self._dirty.set()
+
+    def sync_rules(self) -> int:
+        """Full-table rebuild (iptables/proxier.go:667 syncProxyRules)."""
+        table: Dict[Tuple[str, str], List[dict]] = {}
+        for svc in self.cluster.list("services"):
+            key = (svc["namespace"], svc["name"])
+            ep = self.cluster.get("endpoints", *key)
+            table[key] = list(ep.get("addresses", [])) if ep else []
+        with self._lock:
+            self.rules = table
+            self.rules_version += 1
+            self._dirty.clear()
+            return self.rules_version
+
+    def sync_if_dirty(self) -> bool:
+        if self._dirty.is_set():
+            self.sync_rules()
+            return True
+        return False
+
+    def route(self, namespace: str, service: str) -> Optional[dict]:
+        """Next backend for the service VIP, or None (blackhole — the
+        REJECT rule for an endpoint-less service)."""
+        key = (namespace, service)
+        with self._lock:
+            backends = self.rules.get(key) or []
+            if not backends:
+                return None
+            i = self._rr.get(key, 0) % len(backends)
+            self._rr[key] = i + 1
+            return backends[i]
+
+    def run(self, stop: threading.Event, period: float = 0.05) -> threading.Thread:
+        def loop():
+            while not stop.is_set():
+                self.sync_if_dirty()
+                stop.wait(period)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t

@@ -143,7 +143,9 @@ class Scheduler:
         self.recorder = recorder if recorder is not None else EventRecorder()
         # PodPreemptor.DeletePod analog (scheduler.go:319-326); default
         # removes the victim straight from the cache
+        self._victim_deleter_defaulted = victim_deleter is None
         self.victim_deleter = victim_deleter or (lambda pod: self.cache.remove_pod(pod))
+        self._pdb_defaulted = pdb_lister is None
         self.pdb_lister = pdb_lister or (lambda: [])
         self._last_index = 0
         self._stop = threading.Event()

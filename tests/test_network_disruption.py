@@ -1,0 +1,123 @@
+"""Service networking slice (endpoints controller + kube-proxy analog) and
+the disruption controller (ref pkg/controller/endpoint, pkg/proxy,
+pkg/controller/disruption)."""
+
+from kubernetes_tpu.api.types import PodDisruptionBudget
+from kubernetes_tpu.runtime.cluster import LocalCluster, make_cluster_binder, wire_scheduler
+from kubernetes_tpu.runtime.controllers import DisruptionController
+from kubernetes_tpu.runtime.kubemark import HollowFleet
+from kubernetes_tpu.runtime.network import EndpointsController, ServiceProxy
+from kubernetes_tpu.runtime.queue import PriorityQueue
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+from fixtures import make_node, make_pod
+
+
+def _drain(ctrl, n=50):
+    while ctrl.process_one(timeout=0.05) and n:
+        n -= 1
+
+
+def _world(n_nodes=3):
+    cluster = LocalCluster()
+    sched = Scheduler(
+        cache=SchedulerCache(), queue=PriorityQueue(),
+        binder=make_cluster_binder(cluster), config=SchedulerConfig(),
+    )
+    wire_scheduler(cluster, sched)
+    fleet = HollowFleet(cluster, [make_node(f"n{i}", cpu="4") for i in range(n_nodes)])
+    return cluster, sched, fleet
+
+
+def test_endpoints_track_running_service_pods():
+    cluster, sched, fleet = _world()
+    ep_ctrl = EndpointsController(cluster)
+    cluster.add_service("default", "web", {"app": "web"})
+    for i in range(3):
+        cluster.add_pod(make_pod(f"w{i}", cpu="100m", labels={"app": "web"}))
+    cluster.add_pod(make_pod("other", cpu="100m", labels={"app": "db"}))
+    sched.run_once(timeout=0.5)
+    _drain(ep_ctrl)
+    ep = cluster.get("endpoints", "default", "web")
+    assert ep and len(ep["addresses"]) == 3
+    assert {a["pod"] for a in ep["addresses"]} == {"w0", "w1", "w2"}
+
+    # pod deletion shrinks the endpoints
+    cluster.delete("pods", "default", "w1")
+    _drain(ep_ctrl)
+    ep = cluster.get("endpoints", "default", "web")
+    assert {a["pod"] for a in ep["addresses"]} == {"w0", "w2"}
+
+
+def test_proxy_round_robin_and_blackhole():
+    cluster, sched, fleet = _world()
+    ep_ctrl = EndpointsController(cluster)
+    proxy = ServiceProxy(cluster)
+    cluster.add_service("default", "web", {"app": "web"})
+    for i in range(2):
+        cluster.add_pod(make_pod(f"w{i}", cpu="100m", labels={"app": "web"}))
+    sched.run_once(timeout=0.5)
+    _drain(ep_ctrl)
+    assert proxy.sync_if_dirty()
+    picks = [proxy.route("default", "web")["pod"] for _ in range(4)]
+    assert picks == ["w0", "w1", "w0", "w1"]  # rr over sorted backends
+    # unknown / endpoint-less service blackholes
+    assert proxy.route("default", "nope") is None
+    v = proxy.rules_version
+    cluster.add_service("default", "empty", {"app": "nothing"})
+    _drain(ep_ctrl)
+    proxy.sync_if_dirty()
+    assert proxy.rules_version > v
+    assert proxy.route("default", "empty") is None
+
+
+def test_disruption_controller_maintains_allowed():
+    cluster, sched, fleet = _world()
+    ctrl = DisruptionController(cluster)
+    pdb = PodDisruptionBudget.from_dict({
+        "metadata": {"name": "web-pdb", "namespace": "default"},
+        "spec": {"selector": {"matchLabels": {"app": "web"}},
+                 "minAvailable": 2},
+    })
+    cluster.create("poddisruptionbudgets", pdb)
+    for i in range(3):
+        cluster.add_pod(make_pod(f"w{i}", cpu="100m", labels={"app": "web"}))
+    sched.run_once(timeout=0.5)
+    _drain(ctrl)
+    got = cluster.get("poddisruptionbudgets", "default", "web-pdb")
+    assert got.disruptions_allowed == 1  # 3 healthy - 2 minAvailable
+
+    # percentage form: 50% of 3 -> ceil 2 -> allowed 1
+    pdb2 = PodDisruptionBudget.from_dict({
+        "metadata": {"name": "pct", "namespace": "default"},
+        "spec": {"selector": {"matchLabels": {"app": "web"}},
+                 "minAvailable": "50%"},
+    })
+    cluster.create("poddisruptionbudgets", pdb2)
+    _drain(ctrl)
+    assert cluster.get("poddisruptionbudgets", "default", "pct").disruptions_allowed == 1
+
+    # losing a pod drops allowed to 0
+    cluster.delete("pods", "default", "w0")
+    _drain(ctrl)
+    got = cluster.get("poddisruptionbudgets", "default", "web-pdb")
+    assert got.disruptions_allowed == 0
+
+
+def test_pdb_blocks_preemption_through_store():
+    """End to end: the controller-maintained budget feeds PDB-aware victim
+    ranking (scheduler.pdb_lister wired by wire_scheduler)."""
+    cluster = LocalCluster()
+    sched = Scheduler(
+        cache=SchedulerCache(), queue=PriorityQueue(),
+        binder=make_cluster_binder(cluster), config=SchedulerConfig(),
+    )
+    wire_scheduler(cluster, sched)
+    assert sched.pdb_lister() == []
+    pdb = PodDisruptionBudget.from_dict({
+        "metadata": {"name": "p", "namespace": "default"},
+        "spec": {"selector": {"matchLabels": {"app": "x"}}, "minAvailable": 1},
+    })
+    cluster.create("poddisruptionbudgets", pdb)
+    assert len(sched.pdb_lister()) == 1
