@@ -34,10 +34,15 @@ import numpy as np
 
 _ATTEMPT_ENV = "KTPU_BENCH_ATTEMPT"
 _TPU_ERROR_ENV = "KTPU_BENCH_TPU_ERROR"
+_DEADLINE_ENV = "KTPU_BENCH_DEADLINE"  # wall-clock; survives the re-exec
 _LOCK_PATH = "/tmp/ktpu_device.lock"
+
+_EMITTED = False
 
 
 def _emit(result: dict) -> None:
+    global _EMITTED
+    _EMITTED = True
     print(json.dumps(result))
     sys.stdout.flush()
 
@@ -254,6 +259,12 @@ def main():
     ap.add_argument("--retries", type=int, default=3, help="fresh-process TPU retries")
     ap.add_argument("--retry-backoff", type=float, default=20.0, help="seconds")
     ap.add_argument("--lock-timeout", type=float, default=600.0, help="seconds")
+    ap.add_argument("--init-timeout", type=float, default=180.0,
+                    help="seconds before a hung backend init counts as a "
+                    "transient failure (re-exec retry)")
+    ap.add_argument("--watchdog", type=float, default=2100.0,
+                    help="hard whole-run deadline; emits a diagnostic JSON "
+                    "line and exits instead of hanging the driver")
     ap.add_argument(
         "--platform",
         default=None,
@@ -276,6 +287,37 @@ def main():
                 )
             )
             return
+    # whole-run watchdog: a wedged tunnel can HANG (nanosleep, no error)
+    # rather than fail — backend init and even mid-run transfers have no
+    # timeout of their own.  The watchdog guarantees the driver always gets
+    # one JSON line instead of an rc=124.
+    import threading
+
+    # the deadline is wall-clock in an env var so retry re-execs inherit the
+    # REMAINING budget instead of restarting it (the driver's own timeout is
+    # the thing this must stay inside)
+    if _DEADLINE_ENV not in os.environ:
+        os.environ[_DEADLINE_ENV] = str(time.time() + args.watchdog)
+    remaining = float(os.environ[_DEADLINE_ENV]) - time.time()
+
+    def _watchdog_fire():
+        if _EMITTED:
+            return  # result already out; let the normal exit happen
+        _emit(_error_line(
+            "watchdog",
+            TimeoutError(
+                f"no result within {args.watchdog}s (tunnel wedge?)"
+            ),
+        ))
+        os._exit(2)
+
+    if remaining <= 0:
+        _watchdog_fire()
+        return
+    wd = threading.Timer(remaining, _watchdog_fire)
+    wd.daemon = True
+    wd.start()
+
     try:
         try:
             import jax
@@ -287,7 +329,26 @@ def main():
             from kubernetes_tpu.utils.jaxenv import enable_compile_cache
 
             enable_compile_cache()
-            jax.devices()  # force backend init under our error handling
+            # backend init in a worker thread: a wedged tunnel HANGS here
+            # (hrtimer_nanosleep) instead of raising, so poll with a deadline
+            # and treat a stuck init as transient (fresh-process retry)
+            init_done: dict = {}
+
+            def _init():
+                try:
+                    init_done["devices"] = jax.devices()
+                except Exception as ie:  # noqa: BLE001
+                    init_done["error"] = ie
+
+            t_init = threading.Thread(target=_init, daemon=True)
+            t_init.start()
+            t_init.join(args.init_timeout)
+            if t_init.is_alive():
+                raise TimeoutError(
+                    f"UNAVAILABLE: backend init exceeded {args.init_timeout}s"
+                )
+            if "error" in init_done:
+                raise init_done["error"]
         except Exception as e:  # backend init failed (tunnel wedged / no lease)
             if args.platform or not _is_transient(e):
                 _emit(_error_line("backend-init", e))

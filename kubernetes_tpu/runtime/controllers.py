@@ -412,8 +412,8 @@ class ControllerManager:
         self._threads.append(
             self.nodelifecycle.run(self._stop, period=monitor_period)
         )
-        self._threads.append(self.disruption.run(self._stop))
-        self._threads.append(self.endpoints.run(self._stop))
+        self._threads += self.disruption.run(self._stop)
+        self._threads += self.endpoints.run(self._stop)
 
     def stop(self) -> None:
         self._stop.set()
@@ -425,15 +425,14 @@ class ControllerManager:
 # ---------------------------------------------------------------- disruption
 
 
-def _int_or_percent(v, total: int, round_up: bool) -> int:
-    """intstr.GetValueFromIntOrPercent: "50%" scales against total (ceil for
-    minAvailable, floor for maxUnavailable), ints pass through."""
+def _int_or_percent(v, total: int) -> int:
+    """intstr.GetValueFromIntOrPercent with round-up: "50%" scales against
+    total with ceil (the disruption controller rounds UP for both
+    minAvailable and maxUnavailable), ints pass through."""
     if isinstance(v, str) and v.endswith("%"):
-        pct = int(v[:-1])
-        scaled = pct * total / 100.0
         import math
 
-        return math.ceil(scaled) if round_up else math.floor(scaled)
+        return math.ceil(int(v[:-1]) * total / 100.0)
     return int(v)
 
 
@@ -473,11 +472,9 @@ class DisruptionController(Reconciler):
             if p.spec.node_name and p.status.phase == "Running"
         )
         if pdb.min_available is not None:
-            desired = _int_or_percent(pdb.min_available, expected, True)
+            desired = _int_or_percent(pdb.min_available, expected)
         elif pdb.max_unavailable is not None:
-            desired = expected - _int_or_percent(
-                pdb.max_unavailable, expected, True
-            )
+            desired = expected - _int_or_percent(pdb.max_unavailable, expected)
         else:
             desired = expected  # no budget spec: nothing disruptable
         allowed = max(healthy - desired, 0)

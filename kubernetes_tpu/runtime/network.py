@@ -26,7 +26,7 @@ from typing import Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api import labels as klabels
 from kubernetes_tpu.runtime.cluster import LocalCluster
-from kubernetes_tpu.runtime.controllers import Reconciler, WorkQueue
+from kubernetes_tpu.runtime.controllers import Reconciler
 
 
 def _service_backends(cluster: LocalCluster, svc: dict) -> List[dict]:
@@ -102,7 +102,10 @@ class ServiceProxy:
             self._dirty.set()
 
     def sync_rules(self) -> int:
-        """Full-table rebuild (iptables/proxier.go:667 syncProxyRules)."""
+        """Full-table rebuild (iptables/proxier.go:667 syncProxyRules).
+        The dirty mark clears BEFORE reading state: a commit landing during
+        the sweep re-marks and forces another sweep (level-triggered)."""
+        self._dirty.clear()
         table: Dict[Tuple[str, str], List[dict]] = {}
         for svc in self.cluster.list("services"):
             key = (svc["namespace"], svc["name"])
@@ -111,7 +114,6 @@ class ServiceProxy:
         with self._lock:
             self.rules = table
             self.rules_version += 1
-            self._dirty.clear()
             return self.rules_version
 
     def sync_if_dirty(self) -> bool:
