@@ -37,14 +37,23 @@ _TPU_ERROR_ENV = "KTPU_BENCH_TPU_ERROR"
 _DEADLINE_ENV = "KTPU_BENCH_DEADLINE"  # wall-clock; survives the re-exec
 _LOCK_PATH = "/tmp/ktpu_device.lock"
 
+import threading as _threading
+
 _EMITTED = False
+_EMIT_LOCK = _threading.Lock()
 
 
-def _emit(result: dict) -> None:
+def _emit(result: dict) -> bool:
+    """Exactly-one-JSON-line contract: the first caller prints, every later
+    caller (e.g. the watchdog racing a just-finished run) no-ops."""
     global _EMITTED
-    _EMITTED = True
-    print(json.dumps(result))
-    sys.stdout.flush()
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return False
+        _EMITTED = True
+        print(json.dumps(result))
+        sys.stdout.flush()
+        return True
 
 
 def _error_line(stage: str, err: BaseException) -> dict:
@@ -301,15 +310,14 @@ def main():
     remaining = float(os.environ[_DEADLINE_ENV]) - time.time()
 
     def _watchdog_fire():
-        if _EMITTED:
-            return  # result already out; let the normal exit happen
-        _emit(_error_line(
+        fired = _emit(_error_line(
             "watchdog",
             TimeoutError(
                 f"no result within {args.watchdog}s (tunnel wedge?)"
             ),
         ))
-        os._exit(2)
+        if fired:  # a completed run already emitted -> let it exit normally
+            os._exit(2)
 
     if remaining <= 0:
         _watchdog_fire()
@@ -324,6 +332,11 @@ def main():
 
             if args.platform:
                 jax.config.update("jax_platforms", args.platform)
+            elif os.environ.get("JAX_PLATFORMS") == "cpu":
+                # the cpu-fallback re-exec sets the env var, but the image's
+                # sitecustomize overrides env at interpreter start — only an
+                # in-process config update actually switches the backend
+                jax.config.update("jax_platforms", "cpu")
             # persistent compile cache: the sequential-scan compile is minutes
             # through the axon tunnel; cache it across processes/rounds
             from kubernetes_tpu.utils.jaxenv import enable_compile_cache

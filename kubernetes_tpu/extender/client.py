@@ -241,17 +241,21 @@ class HTTPExtender:
         """extender.go:360-382 Bind; raises ExtenderError on failure."""
         if not self.is_binder:
             raise ExtenderError("unexpected empty bindVerb in extender")
+        # ExtenderBindingArgs carries NO json tags in the reference
+        # (api/v1/types.go), so the wire spelling is the Go field names
         result = self._send(
             self.config.bind_verb,
-            {"podName": name, "podNamespace": namespace, "podUID": uid,
-             "node": node},
+            {"PodName": name, "PodNamespace": namespace, "PodUID": uid,
+             "Node": node},
         )
         if not isinstance(result, dict):
             raise ExtenderError(
                 f"extender {self.name} bind: bad response: {result!r}"
             )
-        if result.get("error"):
-            raise ExtenderError(result["error"])
+        # ExtenderBindingResult also has no json tags -> "Error" on the wire
+        err = result.get("Error") or result.get("error")
+        if err:
+            raise ExtenderError(err)
 
     # --------------------------------------------------------- transport
 

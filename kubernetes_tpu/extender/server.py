@@ -224,9 +224,9 @@ class ExtenderServer:
     def bind(self, args: dict) -> dict:
         # assume into the mirror; the scheduler does the real API bind when
         # BindVerb is configured the extender owns binding (extender.go:360-385)
-        name = args.get("PodName", "")
-        ns = args.get("PodNamespace", "default")
-        node = args.get("Node", "")
+        name = self._arg(args, "PodName", "podName") or ""
+        ns = self._arg(args, "PodNamespace", "podNamespace") or "default"
+        node = self._arg(args, "Node", "node") or ""
         with self.cache._lock:
             rec = self.cache.encoder.pods.get((ns, name))
             if rec is not None:

@@ -151,6 +151,24 @@ class LocalCluster:
             "services", {"namespace": namespace, "name": name, "selector": selector}
         )
 
+    def unbind(self, pod: Pod) -> bool:
+        """Clear spec.nodeName (gang-rollback inverse of bind; the reference
+        has no unbind verb — coscheduling plugins DELETE and recreate, but a
+        store-level clear keeps the pod's identity/queue position)."""
+        import dataclasses
+
+        with self._lock:
+            cur = self.get("pods", pod.namespace, pod.name)
+            if cur is None or not cur.spec.node_name:
+                return False
+            self.update(
+                "pods",
+                dataclasses.replace(
+                    cur, spec=dataclasses.replace(cur.spec, node_name="")
+                ),
+            )
+            return True
+
     def bind(self, pod: Pod, node_name: str) -> bool:
         """The Binding-subresource analog (registry sets spec.nodeName,
         SURVEY section 3.3): CAS on the stored pod."""
@@ -181,6 +199,9 @@ def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
         # PDB-aware preemption reads live budgets from the store
         # (the disruption controller maintains disruptionsAllowed)
         scheduler.pdb_lister = lambda: cluster.list("poddisruptionbudgets")
+    if getattr(scheduler, "unbinder", None) is None:
+        # gang all-or-nothing rollback undoes real binds through the store
+        scheduler.unbinder = lambda pod: cluster.unbind(pod)
     if getattr(scheduler, "_victim_deleter_defaulted", False):
         # preemption victims must leave the STORE (the DELETE the reference
         # POSTs, scheduler.go:319-326) so controllers replace them and PDB
@@ -217,6 +238,11 @@ def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
                     cache.add_pod(obj)
                     queue.delete(obj)
                 else:
+                    # assigned -> unassigned (gang-rollback unbind) must
+                    # DECHARGE the cache — confirm-on-bind popped the pod
+                    # from the assumed map, so forget_pod alone is a no-op;
+                    # remove_pod tolerates pods the cache never held
+                    cache.remove_pod(obj)
                     # spec update while pending: re-queue the fresh copy
                     queue.delete(obj)
                     queue.add(obj)

@@ -110,8 +110,8 @@ def test_extender_bind_replaces_default_binder():
     assert bound == []  # default binder bypassed
     binds = [c for c in t.calls if c[0] == "bind"]
     assert len(binds) == 1
-    assert binds[0][1]["podName"] == "p0"
-    assert binds[0][1]["node"] in {"n0", "n1", "n2"}
+    assert binds[0][1]["PodName"] == "p0"
+    assert binds[0][1]["Node"] in {"n0", "n1", "n2"}
     assert s.results[-1].node is not None
 
 
@@ -308,3 +308,50 @@ def test_preempt_verb_extender_passthrough_keeps_victims():
     s.run_once(timeout=0.5)
     assert deleted == ["low"]
     assert hi.status.nominated_node_name == "solo"
+
+
+def test_client_against_our_extender_server_bind():
+    """Wire-dialect cross-check: OUR client speaking to OUR ExtenderServer
+    (filter then bind) — catches json-tag spelling drift on either side."""
+    from kubernetes_tpu.extender.server import ExtenderServer
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+
+    cache = SchedulerCache()
+    cache.add_node(make_node("n0", cpu="4", mem="8Gi"))
+    srv = ExtenderServer(cache=cache, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        host, port = srv.address
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=f"http://{host}:{port}",
+            filter_verb="filter", bind_verb="bind",
+            node_cache_capable=True, http_timeout=10.0,
+        ))
+        pod = make_pod("p0", cpu="500m", mem="256Mi")
+        ok, failed = ext.filter(pod, ["n0"])
+        assert ok == ["n0"], (ok, failed)
+        ext.bind(pod.namespace, pod.name, "uid-1", "n0")  # raises on error
+        # the mirror assumed the pod with its REAL requests
+        assert ("default", "p0") in cache.encoder.pods
+    finally:
+        srv.stop()
+
+
+def test_client_bind_error_surfaces_from_our_server():
+    """ExtenderBindingResult has no json tags -> "Error" key; the client
+    must raise, not swallow (unknown pod = mirror never saw it)."""
+    from kubernetes_tpu.extender.server import ExtenderServer
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+
+    srv = ExtenderServer(cache=SchedulerCache(), host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        host, port = srv.address
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=f"http://{host}:{port}", bind_verb="bind",
+            http_timeout=10.0,
+        ))
+        with pytest.raises(ExtenderError, match="not in extender mirror"):
+            ext.bind("default", "ghost", "uid", "n0")
+    finally:
+        srv.stop()

