@@ -94,3 +94,46 @@ def selector_from_label_selector(ls: Optional[dict]) -> Optional[Selector]:
             )
         )
     return Selector(tuple(reqs))
+
+import re as _re
+
+_LABEL_VALUE_RE = _re.compile(r"(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?")
+_QUAL_NAME_RE = _re.compile(r"([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9]")
+_SUBDOMAIN_RE = _re.compile(
+    r"[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*"
+)
+
+
+def is_valid_label_value(v: str) -> bool:
+    """apimachinery validation.IsValidLabelValue: <= 63 chars, empty OK,
+    else alphanumeric at the ends, [-_.alnum] in the middle."""
+    return len(v) <= 63 and bool(_LABEL_VALUE_RE.fullmatch(v))
+
+
+def is_valid_label_key(k: str) -> bool:
+    """validation.IsQualifiedName: optional dns-1123-subdomain prefix '/',
+    then a <=63-char name."""
+    parts = k.split("/")
+    if len(parts) == 2:
+        prefix, name = parts
+        if not prefix or len(prefix) > 253 or not _SUBDOMAIN_RE.fullmatch(prefix):
+            return False
+    elif len(parts) == 1:
+        name = parts[0]
+    else:
+        return False
+    return 0 < len(name) <= 63 and bool(_QUAL_NAME_RE.fullmatch(name))
+
+
+def requirement_is_unbuildable(key: str, op: str, values) -> bool:
+    """labels.NewRequirement error cases for NodeSelector matchExpressions:
+    an invalid key (any operator) or an invalid In/NotIn value makes
+    NodeSelectorRequirementsAsSelector error, so the containing TERM never
+    matches (v1helper.MatchNodeSelectorTerms skips it).  matchFields are
+    exempt (NodeSelectorRequirementsAsFieldSelector does not validate label
+    syntax)."""
+    if not is_valid_label_key(key):
+        return True
+    if op in (IN, NOT_IN) and any(not is_valid_label_value(v) for v in values):
+        return True
+    return False

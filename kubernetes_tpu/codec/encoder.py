@@ -1420,7 +1420,8 @@ class SnapshotEncoder:
                         # matchFields only supports metadata.name (ref
                         # apis/core/validation: NodeFieldSelectorKeys)
                         self._encode_expr(
-                            out, "expr", b, s, e, FIELD_NODE_NAME, expr.operator, expr.values
+                            out, "expr", b, s, e, FIELD_NODE_NAME,
+                            expr.operator, expr.values, is_field=True,
                         )
                         e += 1
             if na:
@@ -1606,11 +1607,19 @@ class SnapshotEncoder:
         except TypeError:
             return None
 
-    def _encode_expr(self, out, prefix, b, s, e, key, op, values) -> None:
+    def _encode_expr(self, out, prefix, b, s, e, key, op, values,
+                     is_field: bool = False) -> None:
         it = self.interner
         out[f"{prefix}_key"][b, s, e] = it.intern(key)
         out[f"{prefix}_op"][b, s, e] = SEL_OP_CODES[op]
         out[f"{prefix}_valid"][b, s, e] = True
+        if not is_field and klabels.requirement_is_unbuildable(key, op, values):
+            # the requirement cannot be built (NodeSelectorRequirements
+            # AsSelector errors), so the TERM never matches — encode as
+            # In-with-no-values (matches nothing); matchFields exempt
+            out[f"{prefix}_op"][b, s, e] = SEL_OP_CODES[klabels.IN]
+            out[f"{prefix}_nval"][b, s, e] = 0
+            return
         if op in (klabels.GT, klabels.LT):
             try:
                 out[f"{prefix}_num"][b, s, e] = float(int(values[0]))

@@ -89,8 +89,13 @@ def tolerations_tolerate(pod: Pod, taint: Taint) -> bool:
 
 def match_node_selector_term(pod_term, node: Node) -> bool:
     """ref v1helper.MatchNodeSelectorTerms: AND of matchExpressions (as label
-    requirements) and matchFields (metadata.name)."""
+    requirements) and matchFields (metadata.name); a term with an invalid
+    label value never matches (NodeSelectorRequirementsAsSelector error)."""
     for expr in pod_term.match_expressions:
+        if klabels.requirement_is_unbuildable(
+            expr.key, expr.operator, expr.values
+        ):
+            return False
         req = klabels.Requirement(expr.key, expr.operator, tuple(expr.values))
         if not req.matches(node.labels):
             return False

@@ -141,7 +141,15 @@ def pod_match_node_selector(cluster: ClusterTensors, pods: PodBatch):
         pods.expr_num,
         pods.expr_valid,
     )                                                       # [B, S, E, N]
-    term_ok = jnp.all(m, axis=2) & pods.term_valid[..., None]
+    # a term with ZERO requirements matches nothing (v1helper semantics:
+    # nodeSelectorTerms entries with empty matchExpressions+matchFields are
+    # skipped, i.e. never satisfy the OR)
+    term_nonempty = jnp.any(pods.expr_valid, axis=2)        # [B, S]
+    term_ok = (
+        jnp.all(m, axis=2)
+        & pods.term_valid[..., None]
+        & term_nonempty[..., None]
+    )
     any_term = jnp.any(term_ok, axis=1)                     # [B, N]
     aff_ok = jnp.where(pods.has_req_affinity[:, None], any_term, True)
     return sel_ok & aff_ok

@@ -744,3 +744,121 @@ def test_service_affinity_table(case):
     )
     assert got_dev == fits, f"device={got_dev} want={fits}"
     assert got_ref == fits, f"cpuref={got_ref} want={fits}"
+
+
+# --------------------------------------------------------------------------
+# TestPodFitsSelector (predicates_test.go:929-1626): nodeSelector + required
+# node-affinity incl. matchExpressions operators, ORed terms, matchFields.
+# --------------------------------------------------------------------------
+
+def _naff(terms):
+    return {"nodeAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": terms}}}
+
+
+def _nterm(exprs=None, fields=None):
+    t = {}
+    if exprs is not None:
+        t["matchExpressions"] = [
+            {"key": k, "operator": op,
+             **({"values": list(v)} if v is not None else {})}
+            for k, op, v in exprs
+        ]
+    if fields is not None:
+        t["matchFields"] = [
+            {"key": "metadata.name", "operator": op, "values": list(v)}
+            for op, v in fields
+        ]
+    return t
+
+
+SELECTOR_CASES = [
+    # (name, node_selector, affinity, node_labels, node_name, fits)
+    ("no selector", None, None, {}, "node_1", True),
+    ("missing labels", {"foo": "bar"}, None, {}, "node_1", False),
+    ("same labels", {"foo": "bar"}, None, {"foo": "bar"}, "node_1", True),
+    ("node labels are superset", {"foo": "bar"}, None,
+     {"foo": "bar", "baz": "blah"}, "node_1", True),
+    ("node labels are subset", {"foo": "bar", "baz": "blah"}, None,
+     {"foo": "bar"}, "node_1", False),
+    ("In operator matches", None,
+     _naff([_nterm(exprs=[("foo", "In", ["bar", "value2"])])]),
+     {"foo": "bar"}, "node_1", True),
+    ("Gt operator matches", None,
+     _naff([_nterm(exprs=[("kernel-version", "Gt", ["0204"])])]),
+     {"kernel-version": "0206"}, "node_1", True),
+    ("NotIn operator matches", None,
+     _naff([_nterm(exprs=[("mem-type", "NotIn", ["DDR", "DDR2"])])]),
+     {"mem-type": "DDR3"}, "node_1", True),
+    ("Exists operator matches", None,
+     _naff([_nterm(exprs=[("GPU", "Exists", None)])]),
+     {"GPU": "NVIDIA-GRID-K1"}, "node_1", True),
+    ("affinity values don't match", None,
+     _naff([_nterm(exprs=[("foo", "In", ["value1", "value2"])])]),
+     {"foo": "bar"}, "node_1", False),
+    ("empty NodeSelectorTerms never matches", None,
+     _naff([]), {"foo": "bar"}, "node_1", False),
+    ("empty MatchExpressions never matches", None,
+     _naff([_nterm(exprs=[])]), {"foo": "bar"}, "node_1", False),
+    ("no affinity schedules", None, None, {"foo": "bar"}, "node_1", True),
+    ("nil NodeSelector in affinity schedules", None,
+     {"nodeAffinity": {}}, {"foo": "bar"}, "node_1", True),
+    ("multiple ANDed expressions match", None,
+     _naff([_nterm(exprs=[("foo", "In", ["bar"]),
+                          ("baz", "NotIn", ["blah2"])])]),
+     {"foo": "bar", "baz": "blah"}, "node_1", True),
+    ("multiple ANDed expressions don't match", None,
+     _naff([_nterm(exprs=[("foo", "In", ["bar"]),
+                          ("baz", "In", ["blah2"])])]),
+     {"foo": "bar", "baz": "blah"}, "node_1", False),
+    ("ORed terms match", None,
+     _naff([_nterm(exprs=[("nope", "In", ["x"])]),
+            _nterm(exprs=[("foo", "In", ["bar"])])]),
+     {"foo": "bar"}, "node_1", True),
+    ("affinity AND nodeSelector both required: both match",
+     {"baz": "blah"},
+     _naff([_nterm(exprs=[("foo", "In", ["bar"])])]),
+     {"foo": "bar", "baz": "blah"}, "node_1", True),
+    ("affinity matches but nodeSelector doesn't",
+     {"baz": "blah"},
+     _naff([_nterm(exprs=[("foo", "In", ["bar"])])]),
+     {"foo": "bar"}, "node_1", False),
+    ("invalid value in affinity term never matches", None,
+     _naff([_nterm(exprs=[("foo", "NotIn", ["invalid value: ___@#$%^"])])]),
+     {"foo": "bar"}, "node_1", False),
+    ("matchFields In matches node name", None,
+     _naff([_nterm(fields=[("In", ["node_1"])])]),
+     {}, "node_1", True),
+    ("matchFields In does not match node name", None,
+     _naff([_nterm(fields=[("In", ["node_1"])])]),
+     {}, "node_2", False),
+    ("two terms: fields no, expressions yes -> OR passes", None,
+     _naff([_nterm(fields=[("In", ["node_1"])]),
+            _nterm(exprs=[("foo", "In", ["bar"])])]),
+     {"foo": "bar"}, "node_2", True),
+    ("one term: fields no AND expressions yes -> fails", None,
+     _naff([_nterm(fields=[("In", ["node_1"])],
+                   exprs=[("foo", "In", ["bar"])])]),
+     {"foo": "bar"}, "node_2", False),
+    ("one term: both fields and expressions match", None,
+     _naff([_nterm(fields=[("In", ["node_1"])],
+                   exprs=[("foo", "In", ["bar"])])]),
+     {"foo": "bar"}, "node_1", True),
+    ("two terms: neither matches", None,
+     _naff([_nterm(fields=[("In", ["node_1"])]),
+            _nterm(exprs=[("foo", "In", ["bar"])])]),
+     {"foo": "blah"}, "node_2", False),
+]
+
+
+@pytest.mark.parametrize(
+    "case", SELECTOR_CASES, ids=[c[0] for c in SELECTOR_CASES]
+)
+def test_pod_fits_selector_table(case):
+    name, nsel, aff, nlabels, node_name, fits = case
+    node = make_node(node_name, labels=nlabels)
+    pending = make_pod("pending", node_selector=nsel, affinity=aff)
+    check_predicate(
+        "PodMatchNodeSelector", [node], [], pending, {node_name: fits}
+    )
