@@ -126,14 +126,24 @@ def is_valid_label_key(k: str) -> bool:
 
 
 def requirement_is_unbuildable(key: str, op: str, values) -> bool:
-    """labels.NewRequirement error cases for NodeSelector matchExpressions:
-    an invalid key (any operator) or an invalid In/NotIn value makes
-    NodeSelectorRequirementsAsSelector error, so the containing TERM never
-    matches (v1helper.MatchNodeSelectorTerms skips it).  matchFields are
-    exempt (NodeSelectorRequirementsAsFieldSelector does not validate label
-    syntax)."""
+    """labels.NewRequirement error cases for NodeSelector matchExpressions —
+    any of these makes NodeSelectorRequirementsAsSelector error, so the
+    containing TERM never matches (v1helper.MatchNodeSelectorTerms skips
+    it).  matchFields are exempt (NodeSelectorRequirementsAsFieldSelector
+    does not validate label syntax):
+      * invalid label key (any operator)
+      * In/NotIn with zero values or any invalid value
+      * Exists/DoesNotExist with values
+      * Gt/Lt with a value count other than one"""
+    values = list(values)
     if not is_valid_label_key(key):
         return True
-    if op in (IN, NOT_IN) and any(not is_valid_label_value(v) for v in values):
-        return True
+    if op in (IN, NOT_IN):
+        return not values or any(
+            not is_valid_label_value(v) for v in values
+        )
+    if op in (EXISTS, DOES_NOT_EXIST):
+        return bool(values)
+    if op in (GT, LT):
+        return len(values) != 1
     return False

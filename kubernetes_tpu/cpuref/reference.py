@@ -599,8 +599,16 @@ class CPUScheduler:
             if na:
                 for pt in na.preferred:
                     term = pt.preference
+                    # an unbuildable requirement voids the term (device
+                    # encodes it as match-nothing; the Go map function
+                    # would error the whole priority)
                     ok = all(
-                        klabels.Requirement(e.key, e.operator, tuple(e.values)).matches(node.labels)
+                        not klabels.requirement_is_unbuildable(
+                            e.key, e.operator, e.values
+                        )
+                        and klabels.Requirement(
+                            e.key, e.operator, tuple(e.values)
+                        ).matches(node.labels)
                         for e in term.match_expressions
                     ) and bool(term.match_expressions)
                     if ok:

@@ -46,7 +46,7 @@ class _Stored:
 
 class LocalCluster:
     KINDS = ("nodes", "pods", "services", "leases", "replicasets",
-             "poddisruptionbudgets", "endpoints")
+             "poddisruptionbudgets", "endpoints", "deployments")
 
     def __init__(self) -> None:
         self._lock = threading.RLock()

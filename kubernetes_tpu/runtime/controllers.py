@@ -190,6 +190,7 @@ class ReplicaSet:
                                              # metadata.labels must satisfy
                                              # the selector
     uid: str = field(default_factory=lambda: uuid.uuid4().hex)
+    owner_uid: str = ""   # owning Deployment's uid ("" = standalone)
 
     @property
     def key(self) -> Tuple[str, str]:
@@ -401,6 +402,7 @@ class ControllerManager:
         self.replicaset = ReplicaSetController(cluster)
         self.nodelifecycle = NodeLifecycleController(cluster, grace_period)
         self.disruption = DisruptionController(cluster)
+        self.deployment = DeploymentController(cluster)
         from kubernetes_tpu.runtime.network import EndpointsController
 
         self.endpoints = EndpointsController(cluster)
@@ -413,26 +415,30 @@ class ControllerManager:
             self.nodelifecycle.run(self._stop, period=monitor_period)
         )
         self._threads += self.disruption.run(self._stop)
+        self._threads += self.deployment.run(self._stop)
         self._threads += self.endpoints.run(self._stop)
 
     def stop(self) -> None:
         self._stop.set()
         self.replicaset.queue.close()
         self.disruption.queue.close()
+        self.deployment.queue.close()
         self.endpoints.queue.close()
 
 
 # ---------------------------------------------------------------- disruption
 
 
-def _int_or_percent(v, total: int) -> int:
-    """intstr.GetValueFromIntOrPercent with round-up: "50%" scales against
-    total with ceil (the disruption controller rounds UP for both
-    minAvailable and maxUnavailable), ints pass through."""
+def _int_or_percent(v, total: int, round_up: bool = True) -> int:
+    """intstr.GetValueFromIntOrPercent: "50%" scales against total (the
+    disruption controller rounds UP for both minAvailable and
+    maxUnavailable; Deployment maxUnavailable rounds DOWN), ints pass
+    through."""
     if isinstance(v, str) and v.endswith("%"):
         import math
 
-        return math.ceil(int(v[:-1]) * total / 100.0)
+        scaled = int(v[:-1]) * total / 100.0
+        return math.ceil(scaled) if round_up else math.floor(scaled)
     return int(v)
 
 
@@ -486,3 +492,184 @@ class DisruptionController(Reconciler):
                 dataclasses.replace(pdb, disruptions_allowed=allowed),
                 expect_rv=rv,
             )
+
+
+# ---------------------------------------------------------------- deployment
+
+
+def _template_hash(template: dict) -> str:
+    """Stable pod-template hash (the pod-template-hash label value)."""
+    import hashlib
+    import json as _json
+
+    return hashlib.sha1(
+        _json.dumps(template, sort_keys=True).encode()
+    ).hexdigest()[:10]
+
+
+@dataclass
+class Deployment:
+    """apps/v1 Deployment slice: declarative rollout over ReplicaSets
+    (pkg/controller/deployment)."""
+
+    namespace: str
+    name: str
+    replicas: int
+    selector: Dict[str, str]                  # matchLabels
+    template: dict                            # pod dict (k8s JSON form)
+    strategy: str = "RollingUpdate"           # or "Recreate"
+    max_surge: object = "25%"                 # int or percent (round UP)
+    max_unavailable: object = "25%"           # int or percent (round DOWN)
+    uid: str = field(default_factory=lambda: uuid.uuid4().hex)
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.namespace, self.name)
+
+
+class DeploymentController(Reconciler):
+    """pkg/controller/deployment, rolling-update slice: one ReplicaSet per
+    pod-template hash; the current template's RS scales up bounded by
+    maxSurge (ceil) while old RSs scale down bounded by maxUnavailable
+    (floor) against the READY pod count — each pod/RS event re-syncs, so
+    the rollout progresses as replacements come up (rolling.go
+    reconcileNewReplicaSet / reconcileOldReplicaSets shape).  "Recreate"
+    scales old to zero first and only then brings the new set up."""
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        # under the store lock: enqueue markers only, resolve in the worker
+        if kind == "deployments":
+            self.queue.add(obj.key)
+        elif kind == "replicasets":
+            self.queue.add(("@rs-owner", obj.namespace,
+                            getattr(obj, "owner_uid", "")))
+        elif kind == "pods" and obj.metadata.owner_uid:
+            self.queue.add(("@pod-owner", obj.namespace,
+                            obj.metadata.owner_uid))
+
+    def _owned_rs(self, dep: Deployment) -> List[ReplicaSet]:
+        return [
+            rs for rs in self.cluster.list("replicasets")
+            if rs.namespace == dep.namespace
+            and getattr(rs, "owner_uid", "") == dep.uid
+        ]
+
+    def _ready(self, rs: ReplicaSet) -> int:
+        sel = klabels.selector_from_match_labels(rs.selector)
+        return sum(
+            1 for p in self.cluster.list("pods")
+            if p.namespace == rs.namespace
+            and p.metadata.owner_uid == rs.uid
+            and sel.matches(p.labels)
+            and p.spec.node_name and p.status.phase == "Running"
+        )
+
+    def sync(self, key) -> None:
+        if key[0] == "@pod-owner":
+            # pod -> owning RS -> owning deployment (resolveControllerRef)
+            _, ns, pod_owner = key
+            rs = next(
+                (r for r in self.cluster.list("replicasets")
+                 if r.uid == pod_owner), None,
+            )
+            if rs is not None and rs.owner_uid:
+                self.sync(("@rs-owner", ns, rs.owner_uid))
+            return
+        if key[0] == "@rs-owner":
+            _, ns, dep_uid = key
+            if not dep_uid:
+                return
+            dep = next(
+                (d for d in self.cluster.list("deployments")
+                 if d.uid == dep_uid), None,
+            )
+            if dep is not None:
+                self.sync(dep.key)
+            else:
+                # owner gone: cascade-delete the orphaned RSs (the
+                # garbagecollector analog; RS deletion cascades its pods)
+                for rs in self.cluster.list("replicasets"):
+                    if rs.namespace == ns and rs.owner_uid == dep_uid:
+                        self.cluster.delete(
+                            "replicasets", rs.namespace, rs.name
+                        )
+            return
+        ns, name = key
+        dep = self.cluster.get("deployments", ns, name)
+        if dep is None:
+            # deleted: drop every RS still claiming a now-dead owner
+            live = {d.uid for d in self.cluster.list("deployments")}
+            for rs in self.cluster.list("replicasets"):
+                if (
+                    rs.namespace == ns and rs.owner_uid
+                    and rs.owner_uid not in live
+                ):
+                    self.cluster.delete("replicasets", rs.namespace, rs.name)
+            return
+        h = _template_hash(dep.template)
+        owned = self._owned_rs(dep)
+        new_rs = next(
+            (rs for rs in owned if rs.selector.get("pod-template-hash") == h),
+            None,
+        )
+        if new_rs is None:
+            tmpl = dict(dep.template)
+            meta = dict(tmpl.get("metadata") or {})
+            meta["labels"] = {**(meta.get("labels") or {}),
+                              "pod-template-hash": h}
+            tmpl["metadata"] = meta
+            new_rs = ReplicaSet(
+                dep.namespace, f"{dep.name}-{h}", 0,
+                {**dep.selector, "pod-template-hash": h}, tmpl,
+            )
+            new_rs.owner_uid = dep.uid
+            self.cluster.create("replicasets", new_rs)
+            owned.append(new_rs)
+        old = [rs for rs in owned if rs is not new_rs]
+        old_total = sum(rs.replicas for rs in old)
+        ready_total = sum(self._ready(rs) for rs in owned)
+
+        if dep.strategy == "Recreate":
+            for rs in old:
+                if rs.replicas:
+                    self._scale(rs, 0)
+            if any(self._ready(rs) for rs in old) or old_total:
+                return  # old still draining; new waits
+            if new_rs.replicas != dep.replicas:
+                self._scale(new_rs, dep.replicas)
+            return
+
+        surge = _int_or_percent(dep.max_surge, dep.replicas)
+        unavail = _int_or_percent(dep.max_unavailable, dep.replicas, round_up=False)
+        # cleanupUnhealthyReplicas analog: old replicas that never became
+        # ready cost no availability, so they scale down unconditionally —
+        # without this, one stuck old pod deadlocks the whole rollout
+        for rs in old:
+            unhealthy = rs.replicas - self._ready(rs)
+            if rs.replicas and unhealthy > 0:
+                self._scale(rs, rs.replicas - unhealthy)
+        old_total = sum(rs.replicas for rs in old)
+        max_total = dep.replicas + surge
+        # scale the new RS up into the surge headroom
+        new_target = min(dep.replicas, max(
+            new_rs.replicas, max_total - old_total
+        ))
+        if new_target != new_rs.replicas:
+            self._scale(new_rs, new_target)
+        # scale old down as availability allows
+        min_available = dep.replicas - unavail
+        budget = ready_total - min_available
+        for rs in sorted(old, key=lambda r: r.name):
+            if budget <= 0 or rs.replicas == 0:
+                continue
+            step = min(rs.replicas, budget)
+            self._scale(rs, rs.replicas - step)
+            budget -= step
+
+    def _scale(self, rs: ReplicaSet, replicas: int) -> None:
+        rs.replicas = replicas
+        self.cluster.update("replicasets", rs)
+
+
+def add_deployment(cluster: LocalCluster, dep: Deployment) -> None:
+    cluster.create("deployments", dep)
