@@ -196,6 +196,35 @@ def object_to_dict(kind: str, obj) -> dict:
         return node_to_dict(obj)
     if isinstance(obj, dict):
         return obj  # services / leases / raw objects
+    if kind == "deployments":
+        return {
+            "kind": "Deployment",
+            "apiVersion": "apps/v1",
+            "metadata": {"name": obj.name, "namespace": obj.namespace,
+                         "uid": obj.uid},
+            "spec": {
+                "replicas": obj.replicas,
+                "selector": {"matchLabels": dict(obj.selector)},
+                "template": obj.template,
+                "strategy": {
+                    "type": obj.strategy,
+                    "rollingUpdate": {"maxSurge": obj.max_surge,
+                                      "maxUnavailable": obj.max_unavailable},
+                },
+            },
+        }
+    if kind == "poddisruptionbudgets":
+        return {
+            "kind": "PodDisruptionBudget",
+            "apiVersion": "policy/v1beta1",
+            "metadata": {"name": obj.name, "namespace": obj.namespace},
+            "spec": _drop_empty({
+                "selector": obj.selector,
+                "minAvailable": obj.min_available,
+                "maxUnavailable": obj.max_unavailable,
+            }),
+            "status": {"disruptionsAllowed": obj.disruptions_allowed},
+        }
     if kind == "replicasets":
         return {
             "kind": "ReplicaSet",

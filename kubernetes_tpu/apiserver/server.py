@@ -37,7 +37,10 @@ from kubernetes_tpu.runtime.cluster import ConflictError, LocalCluster
 from kubernetes_tpu.utils import metrics as m
 
 LIST_KINDS = {"pods": "PodList", "nodes": "NodeList",
-              "replicasets": "ReplicaSetList", "services": "ServiceList"}
+              "replicasets": "ReplicaSetList", "services": "ServiceList",
+              "deployments": "DeploymentList",
+              "poddisruptionbudgets": "PodDisruptionBudgetList",
+              "endpoints": "EndpointsList"}
 
 
 class AdmissionDenied(Exception):
@@ -64,6 +67,35 @@ def _decode(kind: str, d: dict):
         if meta.get("uid"):
             rs.uid = meta["uid"]
         return rs
+    if kind == "deployments":
+        from kubernetes_tpu.runtime.controllers import Deployment
+
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        strat = spec.get("strategy") or {}
+        ru = strat.get("rollingUpdate") or {}
+        dep = Deployment(
+            namespace=meta.get("namespace", "default"),
+            name=meta.get("name", ""),
+            replicas=int(spec.get("replicas", 0)),
+            selector=dict((spec.get("selector") or {}).get("matchLabels") or {}),
+            template=spec.get("template") or {},
+            strategy=strat.get("type", "RollingUpdate"),
+            max_surge=ru.get("maxSurge", "25%"),
+            max_unavailable=ru.get("maxUnavailable", "25%"),
+        )
+        if meta.get("uid"):
+            dep.uid = meta["uid"]
+        return dep
+    if kind == "poddisruptionbudgets":
+        from kubernetes_tpu.api.types import PodDisruptionBudget
+
+        return PodDisruptionBudget.from_dict(d)
+    if kind == "endpoints":
+        meta = d.get("metadata") or {}
+        return {"namespace": meta.get("namespace", "default"),
+                "name": meta.get("name", ""),
+                "addresses": list(d.get("addresses") or ())}
     if kind == "services":
         meta = d.get("metadata") or {}
         return {
@@ -131,6 +163,8 @@ class APIServer:
         if parts[:2] == ["api", "v1"]:
             rest = parts[2:]
         elif parts[:3] == ["apis", "apps", "v1"]:
+            rest = parts[3:]
+        elif parts[:3] == ["apis", "policy", "v1beta1"]:
             rest = parts[3:]
         else:
             return None
@@ -332,7 +366,7 @@ class APIServer:
                     body = outer._admit("UPDATE", kind, body)
                     expect = (body.get("metadata") or {}).get("resourceVersion")
                     obj = _decode(kind, body)
-                    if kind == "replicasets" and not (
+                    if kind in ("replicasets", "deployments") and not (
                         (body.get("metadata") or {}).get("uid")
                     ):
                         # keep the stored identity: a spec-only manifest must

@@ -25,6 +25,11 @@ KIND_PATHS = {
     "node": "/api/v1/nodes",
     "replicasets": "/apis/apps/v1/namespaces/{ns}/replicasets",
     "rs": "/apis/apps/v1/namespaces/{ns}/replicasets",
+    "deployments": "/apis/apps/v1/namespaces/{ns}/deployments",
+    "deploy": "/apis/apps/v1/namespaces/{ns}/deployments",
+    "poddisruptionbudgets": "/apis/policy/v1beta1/namespaces/{ns}/poddisruptionbudgets",
+    "pdb": "/apis/policy/v1beta1/namespaces/{ns}/poddisruptionbudgets",
+    "endpoints": "/api/v1/namespaces/{ns}/endpoints",
     "services": "/api/v1/namespaces/{ns}/services",
 }
 

@@ -265,3 +265,102 @@ def test_apiserver_with_scheduler_end_to_end():
         assert all(p["status"]["phase"] == "Running" for p in lst["items"])
     finally:
         srv.stop()
+
+
+def test_deployments_and_pdb_rest(server):
+    u = server.url
+    dep = {
+        "kind": "Deployment", "apiVersion": "apps/v1",
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"replicas": 2,
+                 "selector": {"matchLabels": {"app": "web"}},
+                 "template": {"metadata": {"labels": {"app": "web"}},
+                              "spec": {"containers": [{"name": "c0"}]}},
+                 "strategy": {"type": "RollingUpdate",
+                              "rollingUpdate": {"maxSurge": 1,
+                                                "maxUnavailable": 0}}},
+    }
+    code, out = _req(f"{u}/apis/apps/v1/namespaces/default/deployments",
+                     "POST", dep)
+    assert code == 201
+    code, got = _req(f"{u}/apis/apps/v1/namespaces/default/deployments/web")
+    assert code == 200 and got["spec"]["replicas"] == 2
+    assert got["spec"]["strategy"]["rollingUpdate"]["maxSurge"] == 1
+    # spec-only PUT keeps identity (uid preserved)
+    uid = got["metadata"]["uid"]
+    dep["spec"]["replicas"] = 5
+    code, got2 = _req(f"{u}/apis/apps/v1/namespaces/default/deployments/web",
+                      "PUT", dep)
+    assert code == 200 and got2["metadata"]["uid"] == uid
+    assert got2["spec"]["replicas"] == 5
+
+    pdb = {
+        "kind": "PodDisruptionBudget", "apiVersion": "policy/v1beta1",
+        "metadata": {"name": "web-pdb", "namespace": "default"},
+        "spec": {"selector": {"matchLabels": {"app": "web"}},
+                 "minAvailable": 1},
+    }
+    code, _ = _req(
+        f"{u}/apis/policy/v1beta1/namespaces/default/poddisruptionbudgets",
+        "POST", pdb)
+    assert code == 201
+    code, lst = _req(
+        f"{u}/apis/policy/v1beta1/namespaces/default/poddisruptionbudgets")
+    assert code == 200 and lst["items"][0]["spec"]["minAvailable"] == 1
+
+
+def test_full_stack_deployment_through_rest():
+    """kubectl-shaped flow: POST a Deployment over REST; embedded
+    controllers roll it out; endpoints appear; GET confirms."""
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+    from kubernetes_tpu.runtime.cluster import make_cluster_binder, wire_scheduler
+    from kubernetes_tpu.runtime.controllers import DeploymentController, ReplicaSetController
+    from kubernetes_tpu.runtime.kubemark import HollowFleet
+    from kubernetes_tpu.runtime.network import EndpointsController
+    from kubernetes_tpu.runtime.queue import PriorityQueue
+    from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster).start()
+    try:
+        sched = Scheduler(
+            cache=SchedulerCache(), queue=PriorityQueue(),
+            binder=make_cluster_binder(cluster), config=SchedulerConfig(),
+        )
+        wire_scheduler(cluster, sched)
+        fleet = HollowFleet(cluster, [make_node("n0", cpu="8")])
+        rs_ctrl = ReplicaSetController(cluster)
+        dep_ctrl = DeploymentController(cluster)
+        ep_ctrl = EndpointsController(cluster)
+        u = srv.url
+        _req(f"{u}/api/v1/namespaces/default/services", "POST",
+             {"metadata": {"name": "web", "namespace": "default"},
+              "spec": {"selector": {"app": "web"}}})
+        dep = {
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"replicas": 3,
+                     "selector": {"matchLabels": {"app": "web"}},
+                     "template": {"metadata": {"labels": {"app": "web"}},
+                                  "spec": {"containers": [{
+                                      "name": "c0",
+                                      "resources": {"requests": {
+                                          "cpu": "100m"}}}]}}},
+        }
+        code, _ = _req(f"{u}/apis/apps/v1/namespaces/default/deployments",
+                       "POST", dep)
+        assert code == 201
+        for _ in range(6):
+            while dep_ctrl.process_one(timeout=0.02):
+                pass
+            while rs_ctrl.process_one(timeout=0.02):
+                pass
+            sched.run_once(timeout=0.2)
+            while ep_ctrl.process_one(timeout=0.02):
+                pass
+            if fleet.total_running >= 3:
+                break
+        assert fleet.total_running == 3
+        code, ep = _req(f"{u}/api/v1/namespaces/default/endpoints/web")
+        assert code == 200 and len(ep["addresses"]) == 3
+    finally:
+        srv.stop()
