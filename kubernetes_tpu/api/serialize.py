@@ -217,7 +217,7 @@ def object_to_dict(kind: str, obj) -> dict:
         return {
             "kind": "PodDisruptionBudget",
             "apiVersion": "policy/v1beta1",
-            "metadata": {"name": obj.name, "namespace": obj.namespace},
+            "metadata": meta_to_dict(obj.metadata),
             "spec": _drop_empty({
                 "selector": obj.selector,
                 "minAvailable": obj.min_available,

@@ -77,7 +77,7 @@ def _decode(kind: str, d: dict):
         dep = Deployment(
             namespace=meta.get("namespace", "default"),
             name=meta.get("name", ""),
-            replicas=int(spec.get("replicas", 0)),
+            replicas=int(spec.get("replicas", 1)),  # k8s defaults to 1
             selector=dict((spec.get("selector") or {}).get("matchLabels") or {}),
             template=spec.get("template") or {},
             strategy=strat.get("type", "RollingUpdate"),
@@ -92,10 +92,16 @@ def _decode(kind: str, d: dict):
 
         return PodDisruptionBudget.from_dict(d)
     if kind == "endpoints":
+        # accept our flat form (GET round-trip), the metadata form, and a
+        # k8s-wire subsets[].addresses form
         meta = d.get("metadata") or {}
-        return {"namespace": meta.get("namespace", "default"),
-                "name": meta.get("name", ""),
-                "addresses": list(d.get("addresses") or ())}
+        addresses = list(d.get("addresses") or ())
+        if not addresses:
+            for sub in d.get("subsets") or ():
+                addresses.extend(sub.get("addresses") or ())
+        return {"namespace": d.get("namespace") or meta.get("namespace", "default"),
+                "name": d.get("name") or meta.get("name", ""),
+                "addresses": addresses}
     if kind == "services":
         meta = d.get("metadata") or {}
         return {

@@ -142,7 +142,8 @@ def main(argv=None) -> int:
     if args.verb == "create":
         with open(args.filename) as f:
             obj = json.load(f)
-        kind = obj.get("kind", "Pod").lower() + "s"
+        k = obj.get("kind", "Pod").lower()
+        kind = k if k.endswith("s") else k + "s"  # Endpoints stays Endpoints
         obj_ns = (obj.get("metadata") or {}).get("namespace") or ns
         out = _req(args.server, "POST", _path(kind, obj_ns), obj)
         if out.get("kind") == "Status" and out.get("code", 201) >= 400:
