@@ -225,6 +225,27 @@ def object_to_dict(kind: str, obj) -> dict:
             }),
             "status": {"disruptionsAllowed": obj.disruptions_allowed},
         }
+    if kind == "jobs":
+        return {
+            "kind": "Job",
+            "apiVersion": "batch/v1",
+            "metadata": {"name": obj.name, "namespace": obj.namespace,
+                         "uid": obj.uid},
+            "spec": {"completions": obj.completions,
+                     "parallelism": obj.parallelism,
+                     "backoffLimit": obj.backoff_limit,
+                     "template": obj.template},
+            "status": _drop_empty({
+                "succeeded": obj.succeeded,
+                "failed": obj.failed,
+                "conditions": (
+                    [{"type": "Complete", "status": "True"}]
+                    if obj.complete else
+                    ([{"type": "Failed", "status": "True"}]
+                     if getattr(obj, "failed_state", False) else [])
+                ),
+            }),
+        }
     if kind == "replicasets":
         return {
             "kind": "ReplicaSet",

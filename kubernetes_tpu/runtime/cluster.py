@@ -46,7 +46,7 @@ class _Stored:
 
 class LocalCluster:
     KINDS = ("nodes", "pods", "services", "leases", "replicasets",
-             "poddisruptionbudgets", "endpoints", "deployments")
+             "poddisruptionbudgets", "endpoints", "deployments", "jobs")
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
@@ -221,6 +221,15 @@ def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
             # node changes can make unschedulable pods feasible
             queue.move_all_to_active()
         elif kind == "pods":
+            # the reference's pod informer uses the non-terminated field
+            # selector (status.phase != Succeeded/Failed): completed pods
+            # leave the scheduler's world and release their resources
+            if obj.status.phase in ("Succeeded", "Failed"):
+                if event != DELETED:
+                    cache.remove_pod(obj)
+                    queue.delete(obj)
+                    queue.move_all_to_active()
+                return
             assigned = bool(obj.spec.node_name)
             if event == ADDED:
                 if assigned:

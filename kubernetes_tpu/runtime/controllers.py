@@ -354,7 +354,11 @@ class NodeLifecycleController:
         # TaintBasedEviction: NoExecute evicts everything without a matching
         # toleration (zero tolerationSeconds path)
         for p in self.cluster.list("pods"):
-            if p.spec.node_name == node.name and not _tolerates_noexecute(p):
+            if (
+                p.spec.node_name == node.name
+                and p.status.phase not in ("Succeeded", "Failed")
+                and not _tolerates_noexecute(p)
+            ):
                 self.cluster.delete("pods", p.namespace, p.name)
                 self.evictions.append((p.namespace, p.name, node.name))
 
@@ -403,6 +407,7 @@ class ControllerManager:
         self.nodelifecycle = NodeLifecycleController(cluster, grace_period)
         self.disruption = DisruptionController(cluster)
         self.deployment = DeploymentController(cluster)
+        self.job = JobController(cluster)
         from kubernetes_tpu.runtime.network import EndpointsController
 
         self.endpoints = EndpointsController(cluster)
@@ -416,6 +421,7 @@ class ControllerManager:
         )
         self._threads += self.disruption.run(self._stop)
         self._threads += self.deployment.run(self._stop)
+        self._threads += self.job.run(self._stop)
         self._threads += self.endpoints.run(self._stop)
 
     def stop(self) -> None:
@@ -423,6 +429,7 @@ class ControllerManager:
         self.replicaset.queue.close()
         self.disruption.queue.close()
         self.deployment.queue.close()
+        self.job.queue.close()
         self.endpoints.queue.close()
 
 
@@ -673,3 +680,129 @@ class DeploymentController(Reconciler):
 
 def add_deployment(cluster: LocalCluster, dep: Deployment) -> None:
     cluster.create("deployments", dep)
+
+
+# ----------------------------------------------------------------------- job
+
+
+@dataclass
+class Job:
+    """batch/v1 Job slice: run pods to completion (pkg/controller/job).
+    completions = successful pods required; parallelism = max concurrently
+    active (Pending/Running) pods."""
+
+    namespace: str
+    name: str
+    completions: int = 1
+    parallelism: int = 1
+    template: dict = field(default_factory=dict)
+    backoff_limit: int = 6
+    uid: str = field(default_factory=lambda: uuid.uuid4().hex)
+    # status (controller-maintained; succeeded/complete are MONOTONIC —
+    # deleting a terminal pod cannot un-complete finished work)
+    succeeded: int = 0
+    failed: int = 0
+    complete: bool = False
+    failed_state: bool = False  # backoffLimit exceeded ("Failed" condition)
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.namespace, self.name)
+
+
+class JobController(Reconciler):
+    """pkg/controller/job syncJob: keep min(parallelism, completions -
+    succeeded) pods active until `completions` pods have Succeeded; mark the
+    Job complete and stop creating.  Failed pods count toward backoffLimit;
+    exceeding it fails the Job (no more pods)."""
+
+    def __init__(self, cluster: LocalCluster):
+        self._seq = 0
+        super().__init__(cluster)
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "jobs":
+            self.queue.add(obj.key)
+        elif kind == "pods" and obj.metadata.owner_kind == "Job":
+            self.queue.add(("@job-owner", obj.namespace,
+                            obj.metadata.owner_uid))
+
+    def sync(self, key) -> None:
+        if key[0] == "@job-owner":
+            _, ns, uid = key
+            job = next(
+                (j for j in self.cluster.list("jobs") if j.uid == uid), None
+            )
+            if job is not None:
+                self.sync(job.key)
+            return
+        ns, name = key
+        job, rv = self.cluster.get_with_rv("jobs", ns, name)
+        if job is None:
+            # cascade: pods of deleted jobs
+            live = {j.uid for j in self.cluster.list("jobs")}
+            for p in self.cluster.list("pods"):
+                if (
+                    p.namespace == ns and p.metadata.owner_kind == "Job"
+                    and p.metadata.owner_uid not in live
+                ):
+                    self.cluster.delete("pods", p.namespace, p.name)
+            return
+        owned = [
+            p for p in self.cluster.list("pods")
+            if p.namespace == job.namespace
+            and p.metadata.owner_uid == job.uid
+        ]
+        # monotonic counters: a deleted terminal pod must not revert status
+        succeeded = max(
+            job.succeeded,
+            sum(1 for p in owned if p.status.phase == "Succeeded"),
+        )
+        failed = max(
+            job.failed,
+            sum(1 for p in owned if p.status.phase == "Failed"),
+        )
+        active = [
+            p for p in owned if p.status.phase in ("Pending", "Running")
+        ]
+        complete = job.complete or succeeded >= job.completions
+        failed_state = job.failed_state or failed > job.backoff_limit
+        if complete or failed_state:
+            # terminal: a failed job terminates its still-active pods
+            # (k8s deletes them); a complete one has none by construction
+            if failed_state:
+                for p in active:
+                    self.cluster.delete("pods", p.namespace, p.name)
+        else:
+            want_active = min(
+                job.parallelism, job.completions - succeeded
+            ) - len(active)
+            for _ in range(max(want_active, 0)):
+                self._seq += 1
+                d = dict(job.template)
+                meta = dict(d.get("metadata") or {})
+                meta["name"] = f"{job.name}-{self._seq:05d}"
+                meta["namespace"] = job.namespace
+                meta["ownerReferences"] = [
+                    {"kind": "Job", "name": job.name, "uid": job.uid,
+                     "controller": True}
+                ]
+                d["metadata"] = meta
+                self.cluster.create("pods", Pod.from_dict(d))
+        if (
+            succeeded != job.succeeded or failed != job.failed
+            or complete != job.complete
+            or failed_state != job.failed_state
+        ):
+            self.cluster.update(
+                "jobs",
+                dataclasses.replace(
+                    job, succeeded=succeeded, failed=failed,
+                    complete=complete, failed_state=failed_state,
+                ),
+                expect_rv=rv,
+            )
+
+
+def add_job(cluster: LocalCluster, job: Job) -> None:
+    cluster.create("jobs", job)

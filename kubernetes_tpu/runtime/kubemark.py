@@ -20,10 +20,17 @@ from kubernetes_tpu.runtime.cluster import ADDED, DELETED, MODIFIED, LocalCluste
 
 
 class HollowNode:
-    def __init__(self, cluster: LocalCluster, node: Node):
+    """`completer(pod) -> bool`: when given, pods it approves transition
+    Running -> Succeeded — consulted on pod events for already-Running pods
+    and on explicit `tick()` sweeps (a completer that declines keeps the
+    pod Running until a later tick; call fleet.tick() from the drive loop
+    for time-based completion)."""
+
+    def __init__(self, cluster: LocalCluster, node: Node, completer=None):
         self.cluster = cluster
         self.node = node
         self.running: Dict = {}
+        self.completer = completer
         cluster.add_node(node)
 
     def observe(self, event: str, kind: str, obj) -> None:
@@ -37,14 +44,40 @@ class HollowNode:
         if event == DELETED:
             self.running.pop(key, None)
             return
-        if event not in (ADDED, MODIFIED) or key in self.running:
+        if event not in (ADDED, MODIFIED):
             return
+        import dataclasses
+
+        from kubernetes_tpu.api.types import PodStatus
+
+        if key in self.running:
+            if (
+                obj.status.phase == "Running"
+                and self.completer is not None
+                and self.completer(obj)
+            ):
+                self.running.pop(key, None)
+                self.cluster.update(
+                    "pods",
+                    dataclasses.replace(obj, status=PodStatus(phase="Succeeded")),
+                )
+            return
+        if obj.status.phase in ("Succeeded", "Failed"):
+            return  # terminal pods are never (re)claimed
         self.running[key] = obj
+        if (
+            obj.status.phase == "Running"
+            and self.completer is not None
+            and self.completer(obj)
+        ):
+            # claimed already-Running (watch replay): complete immediately
+            self.running.pop(key, None)
+            self.cluster.update(
+                "pods",
+                dataclasses.replace(obj, status=PodStatus(phase="Succeeded")),
+            )
+            return
         if obj.status.phase != "Running":
-            import dataclasses
-
-            from kubernetes_tpu.api.types import PodStatus
-
             self.cluster.update(
                 "pods", dataclasses.replace(obj, status=PodStatus(phase="Running"))
             )
@@ -53,9 +86,10 @@ class HollowNode:
 class HollowFleet:
     """N hollow nodes sharing one watch subscription."""
 
-    def __init__(self, cluster: LocalCluster, nodes: List[Node]):
+    def __init__(self, cluster: LocalCluster, nodes: List[Node],
+                 completer=None):
         self.cluster = cluster
-        self.nodes = [HollowNode(cluster, n) for n in nodes]
+        self.nodes = [HollowNode(cluster, n, completer) for n in nodes]
         by_name = {h.node.name: h for h in self.nodes}
 
         def fanout(event, kind, obj):
@@ -63,6 +97,29 @@ class HollowFleet:
                 by_name[obj.spec.node_name].observe(event, kind, obj)
 
         cluster.watch(fanout)
+
+    def tick(self) -> int:
+        """Re-consult the completer for every running pod (the PLEG relist
+        analog); returns how many completed this sweep."""
+        import dataclasses
+
+        from kubernetes_tpu.api.types import PodStatus
+
+        done = 0
+        for h in self.nodes:
+            if h.completer is None:
+                continue
+            for key, pod in list(h.running.items()):
+                if h.completer(pod):
+                    h.running.pop(key, None)
+                    self.cluster.update(
+                        "pods",
+                        dataclasses.replace(
+                            pod, status=PodStatus(phase="Succeeded")
+                        ),
+                    )
+                    done += 1
+        return done
 
     @property
     def total_running(self) -> int:
