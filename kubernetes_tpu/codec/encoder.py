@@ -63,6 +63,14 @@ from kubernetes_tpu.codec.schema import (
     _pow2,
 )
 
+def normalized_image(name: str) -> str:
+    """priorities/image_locality.go:99-109 normalizedImageName: append the
+    default tag when the reference has none after the last path segment."""
+    if name.rfind(":") <= name.rfind("/"):
+        return name + ":latest"
+    return name
+
+
 HOSTNAME_KEY = "kubernetes.io/hostname"
 ZONE_KEY = "failure-domain.beta.kubernetes.io/zone"
 REGION_KEY = "failure-domain.beta.kubernetes.io/region"
@@ -515,14 +523,19 @@ class SnapshotEncoder:
                 self.getzone_key, it.intern(region + ":\x00:" + zone)
             )
             self.a_topo[row, gz_pid] = True
-        # images
+        # images: EVERY name of an image is a lookup key (the reference's
+        # imageStates maps each entry of image.Names to the same state)
         self.a_img_id[row, :] = PAD
         self.a_img_sz[row, :] = 0.0
-        for j, img in enumerate(node.status.images):
-            if img.names:
-                self.a_img_id[row, j] = it.intern(img.names[0])
+        j = 0
+        for img in node.status.images:
+            for name in img.names:
+                if j >= self.dims.I:
+                    break
+                self.a_img_id[row, j] = it.intern(name)
                 self.a_img_sz[row, j] = float(img.size_bytes)
-                self._image_nodes[img.names[0]] += 1
+                self._image_nodes[name] += 1
+                j += 1
         # prefer-avoid-pods annotation
         # ref api/v1/pod/util.go GetAvoidPodsFromNodeAnnotations + priorities/
         # node_prefer_avoid_pods.go: annotation lists controller refs to avoid.
@@ -1447,7 +1460,9 @@ class SnapshotEncoder:
                     gi += 1
             for j, c in enumerate(pod.spec.containers[: d.C]):
                 if c.image:
-                    out["image_ids"][b, j] = it.lookup(c.image)
+                    out["image_ids"][b, j] = it.lookup(
+                        normalized_image(c.image)
+                    )
             disk, vcounts = self._pod_vols(pod)
             out["new_vol_counts"][b] = vcounts
             for j, dv in enumerate(disk[: d.DV]):
@@ -1584,7 +1599,7 @@ class SnapshotEncoder:
                 # (image not yet on any node) must not freeze ImageLocality
                 # at 0 once the image appears and gets interned
                 tuple(
-                    (self.interner.lookup(c.image),
+                    (self.interner.lookup(normalized_image(c.image)),
                      tuple(sorted((k, str(q)) for k, q in c.requests.items())),
                      # limits participate in the row (limits2, best_effort):
                      # two pods differing only in limits must not share a row

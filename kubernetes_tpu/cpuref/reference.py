@@ -681,6 +681,13 @@ class CPUScheduler:
             out[node.name] = int(f)
         return out
 
+    @staticmethod
+    def _normalized_image(name: str) -> str:
+        """image_locality.go:99-109 normalizedImageName."""
+        if name.rfind(":") <= name.rfind("/"):
+            return name + ":latest"
+        return name
+
     def image_locality(self, pod: Pod) -> Dict[str, int]:
         mb = 1024 * 1024
         min_t, max_t = 23 * mb, 1000 * mb
@@ -688,18 +695,19 @@ class CPUScheduler:
         num_nodes: Dict[str, int] = defaultdict(int)
         for node in self.nodes:
             for img in node.status.images:
-                if img.names:
-                    num_nodes[img.names[0]] += 1
+                for nm in img.names:  # every name keys the same state
+                    num_nodes[nm] += 1
         out = {}
         for node in self.nodes:
             sizes = {}
             for img in node.status.images:
-                if img.names:
-                    sizes[img.names[0]] = img.size_bytes
+                for nm in img.names:
+                    sizes[nm] = img.size_bytes
             s = 0
             for c in pod.spec.containers:
-                if c.image in sizes:
-                    s += int(sizes[c.image] * (num_nodes[c.image] / total))
+                key = self._normalized_image(c.image)
+                if key in sizes:
+                    s += int(sizes[key] * (num_nodes[key] / total))
             s = min(max(s, min_t), max_t)
             out[node.name] = int(MAX_PRIORITY * (s - min_t) // (max_t - min_t))
         return out

@@ -862,3 +862,33 @@ def test_pod_fits_selector_table(case):
     check_predicate(
         "PodMatchNodeSelector", [node], [], pending, {node_name: fits}
     )
+
+
+# --------------------------------------------------------------------------
+# ImageLocality name normalization (image_locality.go:99-109) + multi-name
+# imageStates: a pod saying "app" must hit a node image named "app:latest",
+# and ANY name of an image is a valid key.
+# --------------------------------------------------------------------------
+
+def test_image_locality_normalization_and_multi_names():
+    big = 500 * 1024 * 1024
+    nodes = [
+        make_node("with-image", images=[
+            {"names": ["app:latest", "registry.example/app:v1"],
+             "sizeBytes": big},
+        ]),
+        make_node("without-image"),
+    ]
+    # untagged "app" normalizes to "app:latest"; "registry.example/app:v1"
+    # is an alternate name of the SAME image
+    for image in ("app", "registry.example/app:v1"):
+        pending = make_pod("pending", images=[image], cpu="100m")
+        _, _, per_prio, golden, row = _run(nodes, [], [], pending)
+        dev = float(per_prio[0, PRIO_INDEX["ImageLocalityPriority"],
+                            row["with-image"]])
+        ref = golden.priorities(pending)["ImageLocalityPriority"]["with-image"]
+        assert dev == ref
+        assert dev > 0, f"{image}: locality score must see the image"
+        dev0 = float(per_prio[0, PRIO_INDEX["ImageLocalityPriority"],
+                              row["without-image"]])
+        assert dev0 == 0.0
