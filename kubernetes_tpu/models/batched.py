@@ -126,24 +126,29 @@ class BatchAffinityState:
     anti_own: Any    # bool[B, AT, B]
     aff_own: Any     # bool[B, PT, B]  [j, t, i]: i matches j's aff term t
                      # (hard-affinity symmetric score, encoder K_AFF_REQ)
+    # preferred (soft) terms — both directions of the IPA score:
+    pref_topo_key: Any  # i32[B, PP]  topology key id of each preferred term
+    pref_weight: Any    # f32[B, PP]  signed weight (+affinity / -anti)
+    pref_match: Any     # bool[B, B, PP]  [j, i, t]: j matches i's pref term t
+    pref_own: Any       # bool[B, PP, B]  [j, t, i]: i matches j's pref term t
 
 
 jax.tree_util.register_dataclass(
     BatchAffinityState,
-    data_fields=["aff_match", "anti_match", "anti_own", "aff_own"],
+    data_fields=["aff_match", "anti_match", "anti_own", "aff_own",
+                 "pref_topo_key", "pref_weight", "pref_match", "pref_own"],
     meta_fields=[],
 )
 
 
-def batch_has_required_affinity(pods: Sequence) -> bool:
-    """True if any pod carries required (anti-)affinity terms — the signal
-    to run the affinity-aware scan variant (costlier; only paid when
-    needed)."""
+def batch_has_pod_affinity(pods: Sequence) -> bool:
+    """True if any pod carries ANY pod-(anti-)affinity terms (required or
+    preferred) — the signal to run the affinity-aware scan variant so
+    co-batched pods see each other in both the filter and the IPA score."""
     for p in pods:
         a = p.spec.affinity
         if a is not None and (
-            (a.pod_affinity is not None and a.pod_affinity.required)
-            or (a.pod_anti_affinity is not None and a.pod_anti_affinity.required)
+            a.pod_affinity is not None or a.pod_anti_affinity is not None
         ):
             return True
     return False
@@ -160,7 +165,7 @@ def encode_batch_affinity(encoder, pods: Sequence) -> BatchAffinityState:
     A = np.zeros((B, d.PT, B), bool)   # [owner i, term t, candidate j]
     N = np.zeros((B, d.AT, B), bool)
 
-    def _fill(out, terms, i, owner):
+    def _fill(out, terms, i, owner, slot=None):
         for t, term in enumerate(terms):
             sel = klabels.selector_from_label_selector(term.label_selector)
             if sel is None:
@@ -168,7 +173,26 @@ def encode_batch_affinity(encoder, pods: Sequence) -> BatchAffinityState:
             nss = term.namespaces or (owner.namespace,)
             for j, other in enumerate(pods):
                 if other.namespace in nss and sel.matches(other.labels):
-                    out[i, t, j] = True
+                    out[i, slot if slot is not None else t, j] = True
+
+    # preferred terms: owner-major lists (signed weights), then the same
+    # cross-match fill as required terms
+    pref_lists = []
+    for pod in pods:
+        terms = []
+        a = pod.spec.affinity
+        if a is not None:
+            if a.pod_affinity is not None:
+                terms += [(+float(w.weight), w.term)
+                          for w in a.pod_affinity.preferred]
+            if a.pod_anti_affinity is not None:
+                terms += [(-float(w.weight), w.term)
+                          for w in a.pod_anti_affinity.preferred]
+        pref_lists.append(terms)
+    PP = _pow2(max([len(t) for t in pref_lists] + [1]))
+    P = np.zeros((B, PP, B), bool)       # [owner i, term t, candidate j]
+    p_key = np.zeros((B, PP), np.int32)
+    p_w = np.zeros((B, PP), np.float32)
 
     for i, pod in enumerate(pods):
         a = pod.spec.affinity
@@ -178,11 +202,19 @@ def encode_batch_affinity(encoder, pods: Sequence) -> BatchAffinityState:
             _fill(A, a.pod_affinity.required[: d.PT], i, pod)
         if a.pod_anti_affinity is not None:
             _fill(N, a.pod_anti_affinity.required[: d.AT], i, pod)
+        for t, (w, term) in enumerate(pref_lists[i][:PP]):
+            p_w[i, t] = w
+            p_key[i, t] = encoder.register_topology_key(term.topology_key)
+            _fill(P, [term], i, pod, slot=t)
     return BatchAffinityState(
         aff_match=A.transpose(2, 0, 1),   # [step j, i, t]
         anti_match=N.transpose(2, 0, 1),  # [step j, i, t]
         anti_own=N,                       # [step j(owner), t, i]
         aff_own=A,                        # [step j(owner), t, i]
+        pref_topo_key=p_key,
+        pref_weight=p_w,
+        pref_match=P.transpose(2, 0, 1),  # [step j, i, t]
+        pref_own=P,                       # [step j(owner), t, i]
     )
 
 
@@ -399,6 +431,11 @@ def make_sequential_scheduler(
             anti_key_pairs = (
                 pods.anti_term_topo_key[:, :, None] == cluster.pair_topo_key[None, None]
             )                                                 # [B, AT, TP]
+            pref_key_pairs = (
+                aff_state.pref_topo_key[:, :, None]
+                == cluster.pair_topo_key[None, None]
+            )                                                 # [B, PP, TP]
+            pref_w_all = aff_state.pref_weight                # [B, PP]
 
         w_ipa = float(w[PRIO_INDEX["InterPodAffinityPriority"]])
         hard_w = float(cfg.hard_pod_affinity_weight)
@@ -445,7 +482,8 @@ def make_sequential_scheduler(
                 (aff_pairs_j, aff_valid_j, aff_self_j, aff_key_j,
                  anti_pairs_j, anti_valid_j, anti_key_j, forb_j,
                  pref_w_j, aff_match_j, anti_match_j, anti_own_j,
-                 aff_own_j) = aff_xs
+                 aff_own_j, prefm_j, pref_own_j, pref_wt_j,
+                 pref_key_j) = aff_xs
                 aff_pairs = aff_pairs_j | extra_aff[step_no]       # [PT, TP]
                 aff_hit = (aff_pairs.astype(jnp.float32) @ topo.T) > 0   # [PT, N]
                 any_match = jnp.any(aff_pairs, axis=-1)            # [PT]
@@ -524,6 +562,25 @@ def make_sequential_scheduler(
                     aff_own_j.astype(jnp.float32),
                     (aff_key_j & node_pairs[None]).astype(jnp.float32),
                 )
+                # preferred (soft) terms, both directions:
+                # 1. LATER pods' own preferred terms the committed pod
+                #    matches gain +-w at the committed node's domain
+                kp = (
+                    pref_key_pairs & node_pairs[None, None]
+                ).astype(jnp.float32)                         # [B, PP, TP]
+                extra_pref = extra_pref + jnp.einsum(
+                    "it,itp->ip",
+                    prefm_j.astype(jnp.float32) * pref_w_all, kp,
+                )
+                # 2. the committed pod's preferred terms add +-w_j for each
+                #    later pod they match (existing-pod K_AFF_PREF/K_ANTI_PREF
+                #    group semantics)
+                extra_pref = extra_pref + jnp.einsum(
+                    "ti,t,tp->ip",
+                    pref_own_j.astype(jnp.float32),
+                    pref_wt_j,
+                    (pref_key_j & node_pairs[None]).astype(jnp.float32),
+                )
             out_host = jnp.where(feasible, host, -1)
             return (
                 (requested, nonzero2, spread_extra, port_used, last_idx + 1,
@@ -565,6 +622,10 @@ def make_sequential_scheduler(
                 aff_state.anti_match,
                 aff_state.anti_own,
                 aff_state.aff_own,
+                aff_state.pref_match,
+                aff_state.pref_own,
+                aff_state.pref_weight,
+                pref_key_pairs,
             )
         else:
             aff_xs_in = None

@@ -51,7 +51,7 @@ class GangScheduler:
         """Returns (node names per pod, n_placed) — names is None if the gang
         did not reach min_member and nothing was committed."""
         from kubernetes_tpu.models.batched import (
-            batch_has_required_affinity,
+            batch_has_pod_affinity,
             encode_batch_affinity,
             encode_batch_ports,
         )
@@ -60,15 +60,15 @@ class GangScheduler:
         enc = sched.cache.encoder
         need = group.min_member or len(pods)
         with sched.cache._lock:
-            batch = enc.encode_pods(pods)
-            ports = encode_batch_ports(enc, pods)
-            # gangs with mutual required (anti-)affinity need the in-batch
-            # affinity state exactly like any other batch
+            # affinity state first: novel term topology keys must register
+            # before the TP-wide batch tensors are cut (vocab growth retiles)
             aff_state = (
                 encode_batch_affinity(enc, pods)
-                if batch_has_required_affinity(pods)
+                if len(pods) > 1 and batch_has_pod_affinity(pods)
                 else None
             )
+            batch = enc.encode_pods(pods)
+            ports = encode_batch_ports(enc, pods)
             cluster, _ = sched.cache.snapshot()
         hosts, _new_state = sched._schedule_fn(
             cluster, batch, ports, np.int32(sched._last_index), None, None, None,

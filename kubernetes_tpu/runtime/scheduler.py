@@ -28,7 +28,7 @@ import numpy as np
 from kubernetes_tpu.api.types import Pod, PodDisruptionBudget
 from kubernetes_tpu.codec.schema import FilterConfig
 from kubernetes_tpu.models.batched import (
-    batch_has_required_affinity,
+    batch_has_pod_affinity,
     encode_batch_affinity,
     encode_batch_ports,
     encode_nominated,
@@ -166,15 +166,17 @@ class Scheduler:
         cycle = self.queue.scheduling_cycle
         batch_keys = {(p.namespace, p.name) for p in pods}
         with self.cache._lock:
-            batch = enc.encode_pods(pods)
-            ports = encode_batch_ports(enc, pods)
-            # in-batch affinity state only when some pod carries required
-            # (anti-)affinity — the plain path stays cheap
+            # in-batch affinity state when pods carry ANY pod-affinity terms
+            # (required or preferred) AND can interact (B > 1); built BEFORE
+            # encode_pods so novel term topology keys register (and possibly
+            # grow the pair vocabulary) before any TP-wide tensor is cut
             aff_state = (
                 encode_batch_affinity(enc, pods)
-                if batch_has_required_affinity(pods)
+                if len(pods) > 1 and batch_has_pod_affinity(pods)
                 else None
             )
+            batch = enc.encode_pods(pods)
+            ports = encode_batch_ports(enc, pods)
             # two-pass evaluation: nominated pods (other than those being
             # scheduled now) are added to their nominated nodes in pass one
             nominated = encode_nominated(

@@ -13,7 +13,7 @@ import pytest
 from kubernetes_tpu.codec import SnapshotEncoder
 from kubernetes_tpu.cpuref import CPUScheduler
 from kubernetes_tpu.models.batched import (
-    batch_has_required_affinity,
+    batch_has_pod_affinity,
     encode_batch_affinity,
     encode_batch_ports,
     make_sequential_scheduler,
@@ -210,9 +210,9 @@ def test_gang_respects_inbatch_anti_affinity():
     assert names is not None and len(set(names)) == 3
 
 
-def test_batch_has_required_affinity_detector():
-    assert not batch_has_required_affinity([make_pod("a"), make_pod("b")])
-    assert batch_has_required_affinity(
+def test_batch_has_pod_affinity_detector():
+    assert not batch_has_pod_affinity([make_pod("a"), make_pod("b")])
+    assert batch_has_pod_affinity(
         [make_pod("a"), make_pod("b", affinity=_anti("x"))]
     )
 
@@ -247,3 +247,87 @@ def test_inbatch_affinity_randomized(seed):
     got = _run_batch(nodes, pending)
     want = _run_sequential(nodes, pending)
     assert got == want
+
+
+def test_inbatch_preferred_affinity_matches_sequential():
+    """PARITY delta 2 tail: PREFERRED (soft) terms of co-batched pods must
+    score each other — one batch == one-pod-at-a-time placements."""
+    import numpy as np
+
+    from kubernetes_tpu.codec import SnapshotEncoder
+    from kubernetes_tpu.models.batched import (
+        batch_has_pod_affinity,
+        encode_batch_affinity,
+        encode_batch_ports,
+        make_sequential_scheduler,
+    )
+    from fixtures import TEST_DIMS, make_node, make_pod
+
+    def prefer(labels_sel, weight=100, anti=False):
+        kind = "podAntiAffinity" if anti else "podAffinity"
+        return {kind: {
+            "preferredDuringSchedulingIgnoredDuringExecution": [{
+                "weight": weight,
+                "podAffinityTerm": {
+                    "labelSelector": {"matchLabels": labels_sel},
+                    "topologyKey": "kubernetes.io/hostname",
+                },
+            }]
+        }}
+
+    def build():
+        enc = SnapshotEncoder(TEST_DIMS)
+        for i in range(6):
+            enc.add_node(make_node(f"n{i}", cpu="8", mem="16Gi"))
+        return enc
+
+    # web-0 lands anywhere; web-1/web-2 PREFER web's hostname domain ->
+    # should co-locate; loner ANTI-prefers web -> should avoid that node
+    def pods():
+        return [
+            make_pod("web-0", cpu="100m", labels={"app": "web"}),
+            make_pod("web-1", cpu="100m", labels={"app": "web"},
+                     affinity=prefer({"app": "web"})),
+            make_pod("web-2", cpu="100m", labels={"app": "web"},
+                     affinity=prefer({"app": "web"})),
+            make_pod("loner", cpu="100m", labels={"app": "loner"},
+                     affinity=prefer({"app": "web"}, anti=True)),
+        ]
+
+    assert batch_has_pod_affinity(pods())
+
+    # one batch
+    enc = build()
+    batch_pods = pods()
+    fn = make_sequential_scheduler(zone_key_id=enc.getzone_key)
+    batch = enc.encode_pods(batch_pods)
+    ports = encode_batch_ports(enc, batch_pods)
+    aff = encode_batch_affinity(enc, batch_pods)
+    cluster = enc.snapshot()
+    hosts, _ = fn(cluster, batch, ports, np.int32(0), None, None, None, aff)
+    hosts = np.asarray(hosts)[:4]
+    names_batch = [enc.row_name(int(r)) for r in hosts]
+
+    # one pod at a time (ground truth)
+    enc2 = build()
+    fn2 = make_sequential_scheduler(zone_key_id=enc2.getzone_key)
+    names_seq = []
+    for i, pod in enumerate(pods()):
+        b = enc2.encode_pods([pod])
+        pt = encode_batch_ports(enc2, [pod])
+        af = encode_batch_affinity(enc2, [pod])
+        cl = enc2.snapshot()
+        h, _ = fn2(cl, b, pt, np.int32(i), None, None, None, af)
+        r = int(np.asarray(h)[0])
+        name = enc2.row_name(r)
+        names_seq.append(name)
+        import dataclasses
+
+        enc2.add_pod(dataclasses.replace(
+            pod, spec=dataclasses.replace(pod.spec, node_name=name)
+        ))
+
+    assert names_batch == names_seq, (names_batch, names_seq)
+    # semantics: the web trio co-locates, the loner avoids their node
+    assert names_batch[1] == names_batch[0] == names_batch[2]
+    assert names_batch[3] != names_batch[0]
