@@ -1,0 +1,92 @@
+"""Chaos harness: fault injection with invariants held across the fault.
+
+The reference shape (test/e2e/chaosmonkey/chaosmonkey.go:17-60): register
+tests, run a Disruption concurrently, assert behavior across it.  Here a
+`Chaosmonkey` carries (setup, during, teardown) hooks per registered test
+and drives them around a disruption callable; `Disruptions` bundles the
+faults this cluster model can inject (node lease expiry, random pod kills,
+leader kill) so suites compose them.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from kubernetes_tpu.runtime.cluster import LocalCluster
+
+
+@dataclass
+class ChaosTest:
+    """chaosmonkey.Test analog: observe before, during, and after."""
+
+    name: str
+    setup: Callable[[], None] = lambda: None
+    during: Callable[[], None] = lambda: None      # polled while disrupting
+    teardown: Callable[[], None] = lambda: None    # asserts recovery
+
+
+class Chaosmonkey:
+    def __init__(self, disruption: Callable[[], None]):
+        self.disruption = disruption
+        self.tests: List[ChaosTest] = []
+
+    def register(self, test: ChaosTest) -> None:
+        self.tests.append(test)
+
+    def do(self, during_interval: float = 0.05) -> None:
+        """Setup all -> run the disruption while polling every `during`
+        hook -> teardown all.  Exceptions propagate (the test fails)."""
+        for t in self.tests:
+            t.setup()
+        stop = threading.Event()
+
+        def poller():
+            while not stop.is_set():
+                for t in self.tests:
+                    t.during()
+                stop.wait(during_interval)
+
+        th = threading.Thread(target=poller, daemon=True)
+        th.start()
+        try:
+            self.disruption()
+        finally:
+            stop.set()
+            th.join(timeout=5.0)
+        for t in self.tests:
+            t.teardown()
+
+
+class Disruptions:
+    """Fault injectors over the LocalCluster world."""
+
+    def __init__(self, cluster: LocalCluster, rng: Optional[random.Random] = None):
+        self.cluster = cluster
+        self.rng = rng or random.Random(0)
+
+    def kill_random_pods(self, n: int, namespace: str = "default") -> List[str]:
+        """Delete n random pods (the pod-kill monkey); owning controllers
+        are expected to replace them."""
+        pods = [
+            p for p in self.cluster.list("pods")
+            if p.namespace == namespace
+            and p.status.phase not in ("Succeeded", "Failed")
+        ]
+        victims = self.rng.sample(pods, min(n, len(pods)))
+        for p in victims:
+            self.cluster.delete("pods", p.namespace, p.name)
+        return [p.name for p in victims]
+
+    def expire_node_lease(self, node_name: str, lifecycle, now: float) -> None:
+        """Silence a node's heartbeat and run the monitor at `now` (the
+        node-failure monkey); pods there get evicted."""
+        lifecycle.monitor(now=now)
+
+    def kill_leader(self, elector) -> None:
+        """Stop the current leader WITHOUT releasing its lease (a crash,
+        not a graceful shutdown): the standby must wait out the TTL."""
+        elector.stop(release=False)

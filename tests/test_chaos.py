@@ -1,0 +1,180 @@
+"""Fault injection with invariants held across the fault (the chaosmonkey
+shape, test/e2e/chaosmonkey/chaosmonkey.go + scheduling disruption suites):
+controllers + scheduler + hollow nodes keep the desired state through pod
+kills and node failures, and the service dataplane never routes to a pod
+that the store no longer considers Running."""
+
+import threading
+import time
+
+from kubernetes_tpu.runtime.chaos import Chaosmonkey, ChaosTest, Disruptions
+from kubernetes_tpu.runtime.cluster import LocalCluster, make_cluster_binder, wire_scheduler
+from kubernetes_tpu.runtime.controllers import (
+    NodeLifecycleController,
+    ReplicaSet,
+    ReplicaSetController,
+    add_replicaset,
+    renew_node_lease,
+)
+from kubernetes_tpu.runtime.kubemark import HollowFleet
+from kubernetes_tpu.runtime.network import EndpointsController, ServiceProxy
+from kubernetes_tpu.runtime.queue import PriorityQueue
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+from fixtures import make_node, make_pod
+
+
+def _world(n_nodes=4):
+    cluster = LocalCluster()
+    sched = Scheduler(
+        cache=SchedulerCache(), queue=PriorityQueue(),
+        binder=make_cluster_binder(cluster), config=SchedulerConfig(),
+    )
+    wire_scheduler(cluster, sched)
+    fleet = HollowFleet(cluster, [make_node(f"n{i}", cpu="8")
+                                  for i in range(n_nodes)])
+    rs = ReplicaSetController(cluster)
+    return cluster, sched, fleet, rs
+
+
+def _settle(sched, rs, rounds=8, until=None):
+    for _ in range(rounds):
+        while rs.process_one(timeout=0.02):
+            pass
+        sched.run_once(timeout=0.2)
+        if until is not None and until():
+            return True
+    return until() if until is not None else True
+
+
+def test_pod_kill_monkey_replicas_recover():
+    cluster, sched, fleet, rs = _world()
+    add_replicaset(cluster, ReplicaSet(
+        "default", "web", 8, {"app": "web"},
+        {"metadata": {"labels": {"app": "web"}},
+         "spec": {"containers": [{"name": "c0", "resources": {
+             "requests": {"cpu": "100m"}}}]}},
+    ))
+    assert _settle(sched, rs, until=lambda: fleet.total_running == 8)
+
+    dis = Disruptions(cluster)
+    killed = []
+    never_over = []
+
+    def disruption():
+        for _ in range(3):
+            killed.extend(dis.kill_random_pods(3))
+            _settle(sched, rs, rounds=4)
+
+    cm = Chaosmonkey(disruption)
+    cm.register(ChaosTest(
+        "replicas-recover",
+        during=lambda: never_over.append(len(cluster.list("pods")) <= 9),
+    ))
+    cm.do()
+    # invariant after the storm: desired state restored
+    assert _settle(sched, rs, until=lambda: fleet.total_running == 8)
+    assert len(cluster.list("pods")) == 8
+    assert len(killed) == 9          # the monkey really did bite
+    assert all(never_over)           # and the controller never overshot
+
+
+def test_node_failure_with_service_routing_invariant():
+    """Across a node failure, the proxy must never route to a pod the
+    store no longer lists as Running on a live node."""
+    cluster, sched, fleet, rs = _world(n_nodes=3)
+    lifecycle = NodeLifecycleController(cluster, grace_period=10.0)
+    ep = EndpointsController(cluster)
+    proxy = ServiceProxy(cluster)
+    cluster.add_service("default", "web", {"app": "web"})
+    add_replicaset(cluster, ReplicaSet(
+        "default", "web", 6, {"app": "web"},
+        {"metadata": {"labels": {"app": "web"}},
+         "spec": {"containers": [{"name": "c0", "resources": {
+             "requests": {"cpu": "100m"}}}]}},
+    ))
+
+    def converge():
+        ok = _settle(sched, rs, rounds=6,
+                     until=lambda: fleet.total_running >= 6)
+        while ep.process_one(timeout=0.02):
+            pass
+        proxy.sync_if_dirty()
+        return ok
+
+    assert converge()
+
+    def routing_invariant():
+        """Every routed backend is a Running pod on an untainted node."""
+        proxy.sync_if_dirty()
+        b = proxy.route("default", "web")
+        if b is None:
+            return
+        pod = cluster.get("pods", "default", b["pod"])
+        assert pod is not None and pod.spec.node_name == b["node"]
+
+    t0 = 1000.0
+    for n in ("n0", "n1", "n2"):
+        renew_node_lease(cluster, n, now=t0)
+
+    def disruption():
+        # n0 goes dark; others stay fresh
+        renew_node_lease(cluster, "n1", now=t0 + 20)
+        renew_node_lease(cluster, "n2", now=t0 + 20)
+        lifecycle.monitor(now=t0 + 21)
+        converge()
+
+    cm = Chaosmonkey(disruption)
+    cm.register(ChaosTest("routing", during=routing_invariant))
+    cm.do()
+    assert converge()
+    # all six replicas re-landed on surviving nodes, endpoints agree
+    pods = cluster.list("pods")
+    assert len(pods) == 6
+    assert all(p.spec.node_name in ("n1", "n2") for p in pods)
+    endpoints = cluster.get("endpoints", "default", "web")
+    assert {a["node"] for a in endpoints["addresses"]} <= {"n1", "n2"}
+    # and traffic spreads round-robin over the survivors
+    picks = {proxy.route("default", "web")["pod"] for _ in range(6)}
+    assert len(picks) == 6
+
+
+def test_leader_crash_without_release_fails_over_after_ttl():
+    """Crash (no lease release): the standby takes over only after the TTL
+    expires, then finishes the workload (server.go:248-262 semantics)."""
+    from kubernetes_tpu.runtime.leaderelection import (
+        LeaderElectionConfig,
+        LeaderElector,
+    )
+
+    cluster, sched_a, fleet, rs = _world()
+    sched_b = Scheduler(
+        cache=SchedulerCache(), queue=PriorityQueue(),
+        binder=make_cluster_binder(cluster), config=SchedulerConfig(),
+    )
+    wire_scheduler(cluster, sched_b)
+    cfg = LeaderElectionConfig(
+        lease_duration=0.6, renew_deadline=0.4, retry_period=0.1,
+    )
+    leader_runs = {"a": 0, "b": 0}
+
+    ea = LeaderElector(cluster, "sched-a", cfg,
+                       on_started_leading=lambda: leader_runs.__setitem__("a", 1))
+    eb = LeaderElector(cluster, "sched-b", cfg,
+                       on_started_leading=lambda: leader_runs.__setitem__("b", 1))
+    ea.start()
+    time.sleep(0.3)
+    eb.start()
+    time.sleep(0.3)
+    assert ea.is_leader and not eb.is_leader
+
+    Disruptions(cluster).kill_leader(ea)  # crash: lease NOT released
+    # within the lease TTL the standby must still be follower
+    time.sleep(0.2)
+    assert not eb.is_leader
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not eb.is_leader:
+        time.sleep(0.1)
+    assert eb.is_leader, "standby must take over after the TTL"
+    eb.stop()
