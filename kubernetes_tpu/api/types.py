@@ -39,6 +39,13 @@ DEFAULT_MILLI_CPU_REQUEST = 100
 DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024
 
 
+def is_best_effort(pod: "Pod") -> bool:
+    """QoS BestEffort: no container requests or limits (qos.GetPodQOS
+    slice — the class CheckNodeMemoryPressure repels and the kubelet
+    eviction manager ranks first)."""
+    return all(not c.requests and not c.limits for c in pod.spec.containers)
+
+
 def parse_time(v) -> Optional[float]:
     """Timestamp codec: the Kubernetes wire format serializes times as
     RFC3339 strings (metav1.Time); tests and internal callers may pass epoch

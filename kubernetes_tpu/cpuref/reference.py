@@ -72,8 +72,7 @@ def nonzero_requests(pod: Pod) -> Tuple[float, float]:
     return cpu, mem
 
 
-def is_best_effort(pod: Pod) -> bool:
-    return all(not c.requests and not c.limits for c in pod.spec.containers)
+from kubernetes_tpu.api.types import is_best_effort  # noqa: F401 (shared QoS rule)
 
 
 def node_allocatable(node: Node) -> Dict[str, float]:

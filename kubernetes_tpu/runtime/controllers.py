@@ -221,12 +221,15 @@ class ReplicaSetController(Reconciler):
     # ------------------------------------------------------------- sync
 
     def _owned_pods(self, rs: ReplicaSet) -> List[Pod]:
+        # FilterActivePods: terminal pods don't count toward replicas, so an
+        # Evicted (Failed) pod gets replaced
         sel = klabels.selector_from_match_labels(rs.selector)
         return [
             p for p in self.cluster.list("pods")
             if p.namespace == rs.namespace
             and p.metadata.owner_uid == rs.uid
             and sel.matches(p.labels)
+            and p.status.phase not in ("Succeeded", "Failed")
         ]
 
     def sync(self, key: Tuple[str, str]) -> None:

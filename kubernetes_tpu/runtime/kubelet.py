@@ -1,0 +1,203 @@
+"""Node agent: the kubelet slice over the blackboard.
+
+The reference kubelet (pkg/kubelet, SURVEY section 3.4) is a sync loop
+driven by three channels — apiserver watch (configCh), runtime relist
+(plegCh), housekeeping — talking to the container runtime over the CRI gRPC
+contract (staging/src/k8s.io/cri-api api.proto) and PATCHing status back.
+The standalone analog keeps every seam:
+
+  * PodSandboxRuntime — the CRI slice (RunPodSandbox / StopPodSandbox /
+    RemovePodSandbox / ListPodSandboxes); `FakeRuntime` is the hollow
+    backend (kubemark's fake docker client analog), a real node would put a
+    gRPC client here;
+  * Kubelet.observe — the configCh: pods bound to this node sync into
+    sandboxes and report Running (statusManager update);
+  * Kubelet.pleg_relist — the plegCh: reconcile runtime state against
+    desired state, complete pods the `completer` approves;
+  * Kubelet.heartbeat — the node-lease renewal;
+  * Kubelet.eviction_tick — pkg/kubelet/eviction slice: under a
+    MemoryPressure condition, BestEffort pods are evicted first (phase
+    Failed, reason Evicted), mirroring the qos-ranked eviction order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.types import Node, Pod, PodStatus, is_best_effort
+from kubernetes_tpu.runtime.cluster import ADDED, DELETED, MODIFIED, LocalCluster
+from kubernetes_tpu.runtime.controllers import renew_node_lease
+
+SANDBOX_READY = "SANDBOX_READY"
+SANDBOX_NOTREADY = "SANDBOX_NOTREADY"
+
+
+class FakeRuntime:
+    """In-memory CRI backend (hollow_kubelet.go's fake docker client)."""
+
+    _ids = itertools.count(1)
+
+    def __init__(self):
+        self.sandboxes: Dict[str, dict] = {}
+
+    def run_pod_sandbox(self, pod: Pod) -> str:
+        sid = f"sandbox-{next(self._ids)}"
+        self.sandboxes[sid] = {
+            "id": sid,
+            "pod": (pod.namespace, pod.name),
+            "state": SANDBOX_READY,
+        }
+        return sid
+
+    def stop_pod_sandbox(self, sandbox_id: str) -> None:
+        sb = self.sandboxes.get(sandbox_id)
+        if sb is not None:
+            sb["state"] = SANDBOX_NOTREADY
+
+    def remove_pod_sandbox(self, sandbox_id: str) -> None:
+        self.sandboxes.pop(sandbox_id, None)
+
+    def list_pod_sandboxes(self) -> List[dict]:
+        return list(self.sandboxes.values())
+
+
+class Kubelet:
+    """One node's agent.  Drive with events (wire via `register`) plus
+    explicit pleg_relist()/heartbeat()/eviction_tick() calls from a loop or
+    a test harness (the syncLoopIteration select arms)."""
+
+    def __init__(
+        self,
+        cluster: LocalCluster,
+        node: Node,
+        runtime=None,
+        completer=None,
+        register: bool = True,
+        subscribe: bool = True,
+    ):
+        self.cluster = cluster
+        self.node = node
+        self.runtime = runtime if runtime is not None else FakeRuntime()
+        self.completer = completer
+        self.sandbox_of: Dict[tuple, str] = {}   # pod key -> sandbox id
+        self.evictions: List[tuple] = []
+        if register:
+            cluster.add_node(node)
+        if register and subscribe:
+            cluster.watch(self.observe)
+
+    # ------------------------------------------------------------ configCh
+
+    def observe(self, event: str, kind: str, obj) -> None:
+        if kind == "nodes" and obj.name == self.node.name:
+            self.node = obj  # track condition changes (pressure)
+            return
+        if kind != "pods" or obj.spec.node_name != self.node.name:
+            return
+        key = (obj.namespace, obj.name)
+        if event == DELETED or obj.status.phase in ("Succeeded", "Failed"):
+            self._teardown(key)
+            return
+        if key in self.sandbox_of:
+            # event-driven completion (the hollow-node fast path; pleg_relist
+            # re-consults for completers that declined here)
+            if (
+                obj.status.phase == "Running"
+                and self.completer is not None
+                and self.completer(obj)
+            ):
+                self._teardown(key)
+                self.cluster.update(
+                    "pods",
+                    dataclasses.replace(
+                        obj, status=PodStatus(phase="Succeeded")
+                    ),
+                )
+            return
+        self.sync_pod(obj)
+
+    def sync_pod(self, pod: Pod) -> None:
+        """kubelet.syncPod -> kuberuntime SyncPod -> CRI RunPodSandbox, then
+        the statusManager reports Running."""
+        key = (pod.namespace, pod.name)
+        self.sandbox_of[key] = self.runtime.run_pod_sandbox(pod)
+        if pod.status.phase != "Running":
+            self.cluster.update(
+                "pods",
+                dataclasses.replace(
+                    pod,
+                    # the statusManager stamps startTime (preemption's
+                    # earliest-start-time criterion reads it)
+                    status=PodStatus(phase="Running", start_time=time.time()),
+                ),
+            )
+
+    def _teardown(self, key: tuple) -> None:
+        sid = self.sandbox_of.pop(key, None)
+        if sid is not None:
+            self.runtime.stop_pod_sandbox(sid)
+            self.runtime.remove_pod_sandbox(sid)
+
+    # -------------------------------------------------------------- plegCh
+
+    def pleg_relist(self) -> int:
+        """Reconcile runtime sandboxes against the store (PLEG): complete
+        pods the completer approves, tear down sandboxes whose pod is gone.
+        Returns completions this sweep."""
+        done = 0
+        for sb in self.runtime.list_pod_sandboxes():
+            ns, name = sb["pod"]
+            pod = self.cluster.get("pods", ns, name)
+            if pod is None or pod.spec.node_name != self.node.name:
+                # reap directly by id: orphans (kubelet restarted over a
+                # live runtime) are not in sandbox_of
+                self.sandbox_of.pop((ns, name), None)
+                self.runtime.stop_pod_sandbox(sb["id"])
+                self.runtime.remove_pod_sandbox(sb["id"])
+                continue
+            if (
+                pod.status.phase == "Running"
+                and self.completer is not None
+                and self.completer(pod)
+            ):
+                self._teardown((ns, name))
+                self.cluster.update(
+                    "pods",
+                    dataclasses.replace(
+                        pod, status=PodStatus(phase="Succeeded")
+                    ),
+                )
+                done += 1
+        return done
+
+    # --------------------------------------------------------- housekeeping
+
+    def heartbeat(self, now: Optional[float] = None) -> None:
+        renew_node_lease(self.cluster, self.node.name, now=now)
+
+    def eviction_tick(self) -> List[tuple]:
+        """pkg/kubelet/eviction slice: under MemoryPressure, evict
+        BestEffort pods (the lowest qos rank) — phase Failed, torn down,
+        recorded as an Evicted event.  Returns evicted pod keys."""
+        if self.node.status.conditions.get("MemoryPressure") != "True":
+            return []
+        evicted = []
+        for key in list(self.sandbox_of):
+            pod = self.cluster.get("pods", *key)
+            if pod is None or not is_best_effort(pod):
+                continue
+            self._teardown(key)
+            self.cluster.update(
+                "pods",
+                dataclasses.replace(pod, status=PodStatus(phase="Failed")),
+            )
+            self.cluster.events.eventf(
+                "Pod", pod.namespace, pod.name, "Warning", "Evicted",
+                "node %s under memory pressure", self.node.name,
+            )
+            evicted.append(key)
+        self.evictions.extend(evicted)
+        return evicted

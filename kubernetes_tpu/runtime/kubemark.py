@@ -2,125 +2,63 @@
 
 The reference measures 5k-node behavior with kubemark hollow nodes — a real
 kubelet sync loop wired to fake runtime backends (pkg/kubemark/
-hollow_kubelet.go:53-74, cmd/kubemark/hollow-node.go).  The analog here: a
-HollowNode registers a Node object and runs the node-agent's observable
-contract against the LocalCluster — acknowledge bound pods by driving
-status.phase to Running (the statusManager PATCH analog) — without any
-containers underneath.  The density harness (tests + bench) uses fleets of
-these to exercise the full schedule->bind->run loop.
+hollow_kubelet.go:53-74, cmd/kubemark/hollow-node.go).  The analog is
+literal here: a HollowNode IS the Kubelet (runtime/kubelet.py) over a
+FakeRuntime — same configCh claim -> CRI sandbox -> Running status flow,
+same completer hooks — just nothing underneath the runtime.  The density
+harness (tests + bench) uses fleets of these to exercise the full
+schedule -> bind -> run loop.
 """
 
 from __future__ import annotations
 
-import threading
-from typing import Dict, List, Optional
+from typing import List, Optional
 
-from kubernetes_tpu.api.types import Node, Pod
-from kubernetes_tpu.runtime.cluster import ADDED, DELETED, MODIFIED, LocalCluster
+from kubernetes_tpu.api.types import Node
+from kubernetes_tpu.runtime.cluster import LocalCluster
+from kubernetes_tpu.runtime.kubelet import FakeRuntime, Kubelet
 
 
-class HollowNode:
-    """`completer(pod) -> bool`: when given, pods it approves transition
-    Running -> Succeeded — consulted on pod events for already-Running pods
-    and on explicit `tick()` sweeps (a completer that declines keeps the
-    pod Running until a later tick; call fleet.tick() from the drive loop
-    for time-based completion)."""
+class HollowNode(Kubelet):
+    """hollow_kubelet.go analog: the Kubelet over a FakeRuntime."""
 
-    def __init__(self, cluster: LocalCluster, node: Node, completer=None):
-        self.cluster = cluster
-        self.node = node
-        self.running: Dict = {}
-        self.completer = completer
-        cluster.add_node(node)
-
-    def observe(self, event: str, kind: str, obj) -> None:
-        """Pod-informer callback: claim pods bound to this node; release
-        deleted ones (eviction/GC) so running never overcounts."""
-        if kind != "pods":
-            return
-        if obj.spec.node_name != self.node.name:
-            return
-        key = (obj.namespace, obj.name)
-        if event == DELETED:
-            self.running.pop(key, None)
-            return
-        if event not in (ADDED, MODIFIED):
-            return
-        import dataclasses
-
-        from kubernetes_tpu.api.types import PodStatus
-
-        if key in self.running:
-            if (
-                obj.status.phase == "Running"
-                and self.completer is not None
-                and self.completer(obj)
-            ):
-                self.running.pop(key, None)
-                self.cluster.update(
-                    "pods",
-                    dataclasses.replace(obj, status=PodStatus(phase="Succeeded")),
-                )
-            return
-        if obj.status.phase in ("Succeeded", "Failed"):
-            return  # terminal pods are never (re)claimed
-        self.running[key] = obj
-        if (
-            obj.status.phase == "Running"
-            and self.completer is not None
-            and self.completer(obj)
-        ):
-            # claimed already-Running (watch replay): complete immediately
-            self.running.pop(key, None)
-            self.cluster.update(
-                "pods",
-                dataclasses.replace(obj, status=PodStatus(phase="Succeeded")),
-            )
-            return
-        if obj.status.phase != "Running":
-            self.cluster.update(
-                "pods", dataclasses.replace(obj, status=PodStatus(phase="Running"))
-            )
+    def __init__(self, cluster: LocalCluster, node: Node, completer=None,
+                 register: bool = True, subscribe: bool = True):
+        super().__init__(
+            cluster, node, FakeRuntime(), completer,
+            register=register, subscribe=subscribe,
+        )
 
 
 class HollowFleet:
-    """N hollow nodes sharing one watch subscription."""
+    """N hollow nodes sharing ONE watch subscription (the informer fan-out
+    a real fleet gets from per-process reflectors)."""
 
     def __init__(self, cluster: LocalCluster, nodes: List[Node],
                  completer=None):
         self.cluster = cluster
-        self.nodes = [HollowNode(cluster, n, completer) for n in nodes]
+        self.nodes = [
+            HollowNode(cluster, n, completer, register=True, subscribe=False)
+            for n in nodes
+        ]
         by_name = {h.node.name: h for h in self.nodes}
 
         def fanout(event, kind, obj):
             if kind == "pods" and obj.spec.node_name in by_name:
                 by_name[obj.spec.node_name].observe(event, kind, obj)
+            elif kind == "nodes" and obj.name in by_name:
+                by_name[obj.name].observe(event, kind, obj)
 
         cluster.watch(fanout)
 
     def tick(self) -> int:
-        """Re-consult the completer for every running pod (the PLEG relist
-        analog); returns how many completed this sweep."""
-        import dataclasses
+        """PLEG relist sweep across the fleet; returns completions."""
+        return sum(h.pleg_relist() for h in self.nodes)
 
-        from kubernetes_tpu.api.types import PodStatus
-
-        done = 0
+    def heartbeat_all(self, now: Optional[float] = None) -> None:
         for h in self.nodes:
-            if h.completer is None:
-                continue
-            for key, pod in list(h.running.items()):
-                if h.completer(pod):
-                    h.running.pop(key, None)
-                    self.cluster.update(
-                        "pods",
-                        dataclasses.replace(
-                            pod, status=PodStatus(phase="Succeeded")
-                        ),
-                    )
-                    done += 1
-        return done
+            h.heartbeat(now=now)
 
     @property
     def total_running(self) -> int:
-        return sum(len(h.running) for h in self.nodes)
+        return sum(len(h.sandbox_of) for h in self.nodes)

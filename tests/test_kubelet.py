@@ -1,0 +1,111 @@
+"""Kubelet slice: CRI sandbox lifecycle, PLEG relist, node-pressure
+eviction (pkg/kubelet + cri-api + pkg/kubelet/eviction analogs)."""
+
+import dataclasses
+
+from kubernetes_tpu.runtime.cluster import LocalCluster, make_cluster_binder, wire_scheduler
+from kubernetes_tpu.runtime.controllers import ReplicaSet, ReplicaSetController, add_replicaset
+from kubernetes_tpu.runtime.kubelet import (
+    FakeRuntime,
+    Kubelet,
+    SANDBOX_READY,
+)
+from kubernetes_tpu.runtime.queue import PriorityQueue
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+from fixtures import make_node, make_pod
+
+
+def _world():
+    cluster = LocalCluster()
+    sched = Scheduler(
+        cache=SchedulerCache(), queue=PriorityQueue(),
+        binder=make_cluster_binder(cluster), config=SchedulerConfig(),
+    )
+    wire_scheduler(cluster, sched)
+    return cluster, sched
+
+
+def test_sandbox_lifecycle_through_cri_seam():
+    cluster, sched = _world()
+    rt = FakeRuntime()
+    kubelet = Kubelet(cluster, make_node("n0", cpu="4"), runtime=rt)
+    cluster.add_pod(make_pod("p0", cpu="100m"))
+    sched.run_once(timeout=0.5)
+
+    sbs = rt.list_pod_sandboxes()
+    assert len(sbs) == 1 and sbs[0]["state"] == SANDBOX_READY
+    assert sbs[0]["pod"] == ("default", "p0")
+    pod = cluster.get("pods", "default", "p0")
+    assert pod.status.phase == "Running"
+
+    cluster.delete("pods", "default", "p0")
+    assert rt.list_pod_sandboxes() == []  # stopped + removed
+
+
+def test_pleg_relist_completes_and_reaps():
+    cluster, sched = _world()
+    rt = FakeRuntime()
+    gate = {"open": False}
+    kubelet = Kubelet(cluster, make_node("n0", cpu="4"), runtime=rt,
+                      completer=lambda p: gate["open"])
+    cluster.add_pod(make_pod("p0", cpu="100m"))
+    sched.run_once(timeout=0.5)
+    assert kubelet.pleg_relist() == 0     # gate closed: stays Running
+    gate["open"] = True
+    assert kubelet.pleg_relist() == 1
+    assert cluster.get("pods", "default", "p0").status.phase == "Succeeded"
+    assert rt.list_pod_sandboxes() == []
+
+
+def test_memory_pressure_evicts_best_effort_first_and_rs_replaces():
+    cluster, sched = _world()
+    k0 = Kubelet(cluster, make_node("n0", cpu="4"))
+    k1 = Kubelet(cluster, make_node("n1", cpu="4"))
+    rs_ctrl = ReplicaSetController(cluster)
+    # a best-effort RS pod and a guaranteed standalone pod, both on n0
+    add_replicaset(cluster, ReplicaSet(
+        "default", "be", 1, {"app": "be"},
+        {"metadata": {"labels": {"app": "be"}},
+         "spec": {"containers": [{"name": "c0"}]}},  # no requests: BestEffort
+    ))
+    while rs_ctrl.process_one(timeout=0.05):
+        pass
+    cluster.add_pod(make_pod("g0", cpu="500m", mem="256Mi"))
+    for _ in range(4):
+        sched.run_once(timeout=0.3)
+        if all(p.spec.node_name for p in cluster.list("pods")):
+            break
+    be_pod = next(p for p in cluster.list("pods") if p.labels.get("app") == "be")
+    be_node = {"n0": k0, "n1": k1}[be_pod.spec.node_name]
+
+    # the BE pod's node develops memory pressure
+    node = be_node.node
+    cluster.update("nodes", dataclasses.replace(
+        node,
+        status=dataclasses.replace(
+            node.status,
+            conditions={**node.status.conditions, "MemoryPressure": "True"},
+        ),
+    ))
+    evicted = be_node.eviction_tick()
+    assert evicted == [(be_pod.namespace, be_pod.name)]
+    assert cluster.get("pods", "default", be_pod.name).status.phase == "Failed"
+    ev = cluster.events.events(namespace="default", name=be_pod.name,
+                               reason="Evicted")
+    assert ev
+
+    # the RS replaces the evicted BestEffort pod; the scheduler must avoid
+    # the pressured node (CheckNodeMemoryPressure repels BestEffort)
+    while rs_ctrl.process_one(timeout=0.05):
+        pass
+    for _ in range(4):
+        sched.run_once(timeout=0.3)
+        fresh = [p for p in cluster.list("pods")
+                 if p.labels.get("app") == "be"
+                 and p.status.phase == "Running"]
+        if fresh:
+            break
+    assert fresh and fresh[0].name != be_pod.name
+    assert fresh[0].spec.node_name != be_node.node.name
