@@ -117,6 +117,14 @@ def main(argv=None) -> int:
     b.add_argument("pod")
     b.add_argument("node")
 
+    sc = sub.add_parser("scale", parents=[common])
+    sc.add_argument("kind")
+    sc.add_argument("name")
+    sc.add_argument("--replicas", type=int, required=True)
+
+    ap_ = sub.add_parser("apply", parents=[common])
+    ap_.add_argument("-f", "--filename", required=True)
+
     args = p.parse_args(argv)
     args.server = getattr(args, "server", "http://127.0.0.1:8001")
     args.output = getattr(args, "output", "")
@@ -165,6 +173,43 @@ def main(argv=None) -> int:
             print(out.get("message", ""), file=sys.stderr)
             return 1
         print(json.dumps(out, indent=2))
+        return 0
+
+    if args.verb == "scale":
+        # GET -> mutate spec.replicas -> PUT (kubectl scale shape)
+        out = _req(args.server, "GET", _path(args.kind, ns, args.name))
+        if out.get("kind") == "Status":
+            print(out.get("message", ""), file=sys.stderr)
+            return 1
+        out.setdefault("spec", {})["replicas"] = args.replicas
+        res = _req(args.server, "PUT", _path(args.kind, ns, args.name), out)
+        if res.get("kind") == "Status" and res.get("code", 200) >= 400:
+            print(res.get("message", ""), file=sys.stderr)
+            return 1
+        print(f"{args.kind[:-1] if args.kind.endswith('s') else args.kind}"
+              f"/{args.name} scaled")
+        return 0
+
+    if args.verb == "apply":
+        # create-or-update (server-side apply lite): POST, 409 -> PUT
+        with open(args.filename) as f:
+            obj = json.load(f)
+        k = obj.get("kind", "Pod").lower()
+        kind = k if k.endswith("s") else k + "s"
+        obj_ns = (obj.get("metadata") or {}).get("namespace") or ns
+        name = (obj.get("metadata") or {}).get("name", "")
+        out = _req(args.server, "POST", _path(kind, obj_ns), obj)
+        if out.get("kind") == "Status" and out.get("code") == 409:
+            out = _req(args.server, "PUT", _path(kind, obj_ns, name), obj)
+            if out.get("kind") == "Status" and out.get("code", 200) >= 400:
+                print(out.get("message", ""), file=sys.stderr)
+                return 1
+            print(f"{kind[:-1]}/{name} configured")
+            return 0
+        if out.get("kind") == "Status" and out.get("code", 201) >= 400:
+            print(out.get("message", ""), file=sys.stderr)
+            return 1
+        print(f"{kind[:-1]}/{name} created")
         return 0
 
     if args.verb == "bind":

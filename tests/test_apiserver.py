@@ -364,3 +364,33 @@ def test_full_stack_deployment_through_rest():
         assert code == 200 and len(ep["addresses"]) == 3
     finally:
         srv.stop()
+
+
+def test_kubectl_scale_and_apply(server, tmp_path, capsys):
+    u = server.url
+    dep = {
+        "kind": "Deployment", "apiVersion": "apps/v1",
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"replicas": 2,
+                 "selector": {"matchLabels": {"app": "web"}},
+                 "template": {"metadata": {"labels": {"app": "web"}},
+                              "spec": {"containers": [{"name": "c0"}]}}},
+    }
+    f = tmp_path / "dep.json"
+    f.write_text(json.dumps(dep))
+    assert kubectl.main(["-s", u, "apply", "-f", str(f)]) == 0
+    assert "deployment/web created" in capsys.readouterr().out
+
+    assert kubectl.main(["-s", u, "scale", "deployments", "web",
+                         "--replicas", "7"]) == 0
+    capsys.readouterr()
+    code, got = _req(f"{u}/apis/apps/v1/namespaces/default/deployments/web")
+    assert got["spec"]["replicas"] == 7
+
+    # apply again (update path): change replicas via manifest
+    dep["spec"]["replicas"] = 3
+    f.write_text(json.dumps(dep))
+    assert kubectl.main(["-s", u, "apply", "-f", str(f)]) == 0
+    assert "deployment/web configured" in capsys.readouterr().out
+    code, got = _req(f"{u}/apis/apps/v1/namespaces/default/deployments/web")
+    assert got["spec"]["replicas"] == 3
