@@ -172,6 +172,8 @@ class APIServer:
             rest = parts[3:]
         elif parts[:3] == ["apis", "policy", "v1beta1"]:
             rest = parts[3:]
+        elif parts[:3] == ["apis", "metrics.k8s.io", "v1beta1"]:
+            rest = ["@metrics"] + parts[3:]
         else:
             return None
         if not rest:
@@ -239,6 +241,9 @@ class APIServer:
                 if kind == "watch":
                     self._serve_watch()
                     return
+                if kind == "@metrics":
+                    self._serve_metrics_api(ns, name)
+                    return
                 if kind not in LIST_KINDS:
                     self._status(404, "NotFound", f"unknown resource {kind}")
                     return
@@ -261,6 +266,82 @@ class APIServer:
                     ]
                     self._send({"kind": LIST_KINDS[kind], "apiVersion": "v1",
                                 "items": items})
+
+            def _serve_metrics_api(self, ns: str, name: str):
+                """metrics.k8s.io/v1beta1 analog (staging/src/k8s.io/metrics
+                resource-metrics API): usage derived from Running pods\'
+                requests — the hollow world\'s stand-in for cadvisor stats
+                (a real node would report measured usage at this same seam).
+                Paths: .../nodes[/{name}] and .../namespaces/{ns}/pods."""
+                route = self.path.split("?")[0].split("/")
+                # /apis/metrics.k8s.io/v1beta1/<rest...>
+                rest = [p for p in route if p][3:]
+                pods = outer.cluster.list("pods")
+
+                def pod_usage(p):
+                    cpu = mem = 0.0
+                    for c in p.spec.containers:
+                        if "cpu" in c.requests:
+                            cpu += c.requests["cpu"].milli
+                        if "memory" in c.requests:
+                            mem += float(c.requests["memory"])
+                    return cpu, mem
+
+                if rest[:1] == ["nodes"]:
+                    want = rest[1] if len(rest) > 1 else ""
+                    items = []
+                    for node in outer.cluster.list("nodes"):
+                        if want and node.name != want:
+                            continue
+                        cpu = mem = 0.0
+                        for p in pods:
+                            if (
+                                p.spec.node_name == node.name
+                                and p.status.phase == "Running"
+                            ):
+                                c_, m_ = pod_usage(p)
+                                cpu += c_
+                                mem += m_
+                        items.append({
+                            "metadata": {"name": node.name},
+                            "usage": {"cpu": f"{int(cpu)}m",
+                                      "memory": f"{int(mem)}"},
+                        })
+                    if want:
+                        if not items:
+                            self._status(404, "NotFound", f"node {want}")
+                            return
+                        self._send(items[0])
+                        return
+                    self._send({"kind": "NodeMetricsList",
+                                "apiVersion": "metrics.k8s.io/v1beta1",
+                                "items": items})
+                    return
+                if rest[:1] == ["namespaces"] and rest[2:3] == ["pods"]:
+                    ns_want = rest[1]
+                    items = []
+                    for p in pods:
+                        if p.namespace != ns_want or p.status.phase != "Running":
+                            continue
+                        cpu, mem = pod_usage(p)
+                        items.append({
+                            "metadata": {"name": p.name,
+                                         "namespace": p.namespace},
+                            "containers": [{
+                                "name": c.name,
+                                "usage": {
+                                    "cpu": f"{int(c.requests['cpu'].milli) if 'cpu' in c.requests else 0}m",
+                                    "memory": f"{int(float(c.requests['memory'])) if 'memory' in c.requests else 0}",
+                                },
+                            } for c in p.spec.containers],
+                            "usage": {"cpu": f"{int(cpu)}m",
+                                      "memory": f"{int(mem)}"},
+                        })
+                    self._send({"kind": "PodMetricsList",
+                                "apiVersion": "metrics.k8s.io/v1beta1",
+                                "items": items})
+                    return
+                self._status(404, "NotFound", self.path)
 
             def _send_text(self, body: bytes, ct: str = "text/plain"):
                 self.send_response(200)

@@ -394,3 +394,39 @@ def test_kubectl_scale_and_apply(server, tmp_path, capsys):
     assert "deployment/web configured" in capsys.readouterr().out
     code, got = _req(f"{u}/apis/apps/v1/namespaces/default/deployments/web")
     assert got["spec"]["replicas"] == 3
+
+
+def test_metrics_api_analog():
+    """metrics.k8s.io/v1beta1: node and pod usage from Running pods."""
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+    from kubernetes_tpu.runtime.cluster import make_cluster_binder, wire_scheduler
+    from kubernetes_tpu.runtime.kubemark import HollowFleet
+    from kubernetes_tpu.runtime.queue import PriorityQueue
+    from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster).start()
+    try:
+        sched = Scheduler(
+            cache=SchedulerCache(), queue=PriorityQueue(),
+            binder=make_cluster_binder(cluster), config=SchedulerConfig(),
+        )
+        wire_scheduler(cluster, sched)
+        HollowFleet(cluster, [make_node("n0", cpu="4")])
+        cluster.add_pod(make_pod("p0", cpu="250m", mem="128Mi"))
+        cluster.add_pod(make_pod("p1", cpu="250m", mem="128Mi"))
+        for _ in range(3):
+            sched.run_once(timeout=0.3)
+        u = srv.url
+        code, nodes = _req(f"{u}/apis/metrics.k8s.io/v1beta1/nodes")
+        assert code == 200 and nodes["kind"] == "NodeMetricsList"
+        n0 = nodes["items"][0]
+        assert n0["usage"]["cpu"] == "500m"
+        code, one = _req(f"{u}/apis/metrics.k8s.io/v1beta1/nodes/n0")
+        assert code == 200 and one["usage"]["cpu"] == "500m"
+        code, podm = _req(
+            f"{u}/apis/metrics.k8s.io/v1beta1/namespaces/default/pods")
+        assert code == 200 and len(podm["items"]) == 2
+        assert podm["items"][0]["usage"]["cpu"] == "250m"
+    finally:
+        srv.stop()
