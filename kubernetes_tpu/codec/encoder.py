@@ -124,7 +124,8 @@ class _PodRecord:
     nonzero: np.ndarray              # f32[2]
     ports: List[Tuple[int, int]]     # (proto/port id, ip id)
     disk_vols: List[int]
-    vol_counts: np.ndarray           # f32[NUM_VOL_TYPES]
+    vol_counts: np.ndarray           # f32[NUM_VOL_TYPES] (unique per pod)
+    cnt_vols: list = None            # per-type unique volume id sets
     priority: int = 0
     group_refs: List[Tuple] = field(default_factory=list)  # term-group signatures
     pod: Optional[Pod] = None        # the full object (victim deletion, host
@@ -168,6 +169,10 @@ class SnapshotEncoder:
         self._row_pods: Dict[int, Set[Tuple[str, str]]] = {}
         self._node_ports: Dict[int, Counter] = {}
         self._node_disk_vols: Dict[int, Counter] = {}
+        # attachable-count volumes: per row per TYPE id refcounts, plus the
+        # reverse id -> rows index (per-(pod,node) overlap tensors)
+        self._node_cnt_vols: Dict[int, list] = {}
+        self._cnt_vol_rows: list = [dict() for _ in range(NUM_VOL_TYPES)]
         self._alloc_node_arena()
 
         # ---- existing-pod arena (vectorized selector matching) ----
@@ -423,6 +428,16 @@ class SnapshotEncoder:
         self.a_volcnt[row, :] = 0.0
         self._node_ports[row] = Counter()
         self._node_disk_vols[row] = Counter()
+        # drop this row from the attachable-volume reverse index
+        old_cnts = self._node_cnt_vols.pop(row, None)
+        if old_cnts is not None:
+            for t, ctr in enumerate(old_cnts):
+                for vid in ctr:
+                    rows = self._cnt_vol_rows[t].get(vid)
+                    if rows is not None:
+                        rows.discard(row)
+                        if not rows:
+                            del self._cnt_vol_rows[t][vid]
         self._rebuild_node_ports(row)
         self._rebuild_node_vols(row)
         self.a_valid[row] = False
@@ -564,10 +579,25 @@ class SnapshotEncoder:
         """After an arena retile, re-accumulate pod aggregates into node rows."""
         self.a_requested[:, :] = 0.0
         self.a_nonzero[:, :] = 0.0
+        self.a_volcnt[:, :] = 0.0
+        self._node_cnt_vols.clear()
+        self._cnt_vol_rows = [dict() for _ in range(NUM_VOL_TYPES)]
         for rec in self.pods.values():
             if rec.node_row >= 0:
                 self.a_requested[rec.node_row, : rec.req.shape[0]] += rec.req
                 self.a_nonzero[rec.node_row] += rec.nonzero
+                if rec.cnt_vols:
+                    cnts = self._node_cnt_vols.setdefault(
+                        rec.node_row,
+                        [Counter() for _ in range(NUM_VOL_TYPES)],
+                    )
+                    for t, ids in enumerate(rec.cnt_vols):
+                        for vid in ids:
+                            cnts[t][vid] += 1
+                            self._cnt_vol_rows[t].setdefault(
+                                vid, set()
+                            ).add(rec.node_row)
+                        self.a_volcnt[rec.node_row, t] = len(cnts[t])
         for row in self._node_ports:
             self._rebuild_node_ports(row)
             self._rebuild_node_vols(row)
@@ -612,22 +642,26 @@ class SnapshotEncoder:
             out.append((pp, ipid))
         return out
 
-    def _pod_vols(self, pod: Pod) -> Tuple[List[int], np.ndarray]:
-        """(exclusive disk-conflict volume ids, per-filter-type new volume counts).
+    def _pod_vols(self, pod: Pod) -> Tuple[List[int], np.ndarray, list]:
+        """(exclusive disk-conflict volume ids, per-filter-type UNIQUE new
+        volume counts, per-type unique id sets).
 
         ref predicates.go NoDiskConflict (GCE PD / AWS EBS / RBD / ISCSI) and
-        MaxVolumeCount filters.  PVC indirection is resolved by the caller's
-        store in a later round; direct volumes are handled here.
+        MaxVolumeCount filters — the count predicates dedupe by volume
+        identity (filterVolumes keys a map by unique id), so a pod
+        referencing one EBS volume twice counts once.
         """
         disk: List[int] = []
-        counts = np.zeros(NUM_VOL_TYPES, np.float32)
+        cnt_ids: list = [set() for _ in range(NUM_VOL_TYPES)]
         for v in getattr(pod.spec, "volumes", ()) or ():
             if "gcePersistentDisk" in v:
-                disk.append(self.interner.intern("gce/" + v["gcePersistentDisk"].get("pdName", "")))
-                counts[VOL_GCE] += 1
+                vid = self.interner.intern("gce/" + v["gcePersistentDisk"].get("pdName", ""))
+                disk.append(vid)
+                cnt_ids[VOL_GCE].add(vid)
             elif "awsElasticBlockStore" in v:
-                disk.append(self.interner.intern("ebs/" + v["awsElasticBlockStore"].get("volumeID", "")))
-                counts[VOL_EBS] += 1
+                vid = self.interner.intern("ebs/" + v["awsElasticBlockStore"].get("volumeID", ""))
+                disk.append(vid)
+                cnt_ids[VOL_EBS].add(vid)
             elif "rbd" in v:
                 r = v["rbd"]
                 disk.append(
@@ -641,9 +675,13 @@ class SnapshotEncoder:
                     self.interner.intern("iscsi/%s/%s/%s" % (r.get("targetPortal", ""), r.get("iqn", ""), r.get("lun", 0)))
                 )
             elif "azureDisk" in v:
-                counts[VOL_AZURE] += 1
+                cnt_ids[VOL_AZURE].add(
+                    self.interner.intern("azd/" + v["azureDisk"].get("diskName", ""))
+                )
             elif "cinder" in v:
-                counts[VOL_CINDER] += 1
+                cnt_ids[VOL_CINDER].add(
+                    self.interner.intern("cinder/" + v["cinder"].get("volumeID", ""))
+                )
             elif "persistentVolumeClaim" in v:
                 # resolve the claim to count the bound PV's attachment type
                 pvc = self.pvcs.get(
@@ -662,8 +700,11 @@ class SnapshotEncoder:
                             kstorage.SRC_CINDER: VOL_CINDER,
                         }.get(pv.source_kind)
                         if col is not None:
-                            counts[col] += 1
-        return disk, counts
+                            cnt_ids[col].add(
+                                self.interner.intern("pv/" + pv.name)
+                            )
+        counts = np.asarray([len(ids) for ids in cnt_ids], np.float32)
+        return disk, counts, cnt_ids
 
     def _nonzero(self, pod: Pod) -> np.ndarray:
         cpu = 0.0
@@ -698,7 +739,7 @@ class SnapshotEncoder:
         req = self._req_vector(pod.resource_request())
         nonzero = self._nonzero(pod)
         ports = self._pod_ports(pod)
-        disk, vcounts = self._pod_vols(pod)
+        disk, vcounts, cnt_ids = self._pod_vols(pod)
         rec = _PodRecord(
             key=key,
             labels=dict(pod.labels),
@@ -710,6 +751,7 @@ class SnapshotEncoder:
             ports=ports,
             disk_vols=disk,
             vol_counts=vcounts,
+            cnt_vols=cnt_ids,
             priority=pod.spec.priority,
             pod=pod,
             start_time=pod.status.start_time,
@@ -736,7 +778,16 @@ class SnapshotEncoder:
             for dv in disk:
                 self._node_disk_vols[node_row][dv] += 1
             self._rebuild_node_vols(node_row)
-            self.a_volcnt[node_row] += vcounts
+            # attachable-count state dedupes by volume identity: the node's
+            # used count is the number of DISTINCT ids per type
+            cnts = self._node_cnt_vols.setdefault(
+                node_row, [Counter() for _ in range(NUM_VOL_TYPES)]
+            )
+            for t, ids in enumerate(cnt_ids):
+                for vid in ids:
+                    cnts[t][vid] += 1
+                    self._cnt_vol_rows[t].setdefault(vid, set()).add(node_row)
+                self.a_volcnt[node_row, t] = len(cnts[t])
         self._register_pod_terms(pod, rec)
         self.generation += 1
 
@@ -769,7 +820,19 @@ class SnapshotEncoder:
                 if c[dv] <= 0:
                     del c[dv]
             self._rebuild_node_vols(row)
-            self.a_volcnt[row] -= rec.vol_counts
+            cnts = self._node_cnt_vols.get(row)
+            if cnts is not None:
+                for t, ids in enumerate(rec.cnt_vols):
+                    for vid in ids:
+                        cnts[t][vid] -= 1
+                        if cnts[t][vid] <= 0:
+                            del cnts[t][vid]
+                            rows = self._cnt_vol_rows[t].get(vid)
+                            if rows is not None:
+                                rows.discard(row)
+                                if not rows:
+                                    del self._cnt_vol_rows[t][vid]
+                    self.a_volcnt[row, t] = len(cnts[t])
         self._unregister_pod_terms(rec)
         self.generation += 1
 
@@ -1161,7 +1224,7 @@ class SnapshotEncoder:
         M, N = self._cap_m, self._cap_n
 
         want_ports = self._pod_ports(pod)
-        want_disk, new_vols = self._pod_vols(pod)
+        want_disk, new_vols, _ = self._pod_vols(pod)
         want_disk_set = set(want_disk)
 
         pods_ext = np.zeros((M, E), np.float32)
@@ -1463,7 +1526,7 @@ class SnapshotEncoder:
                     out["image_ids"][b, j] = it.lookup(
                         normalized_image(c.image)
                     )
-            disk, vcounts = self._pod_vols(pod)
+            disk, vcounts, _cnt_ids = self._pod_vols(pod)
             out["new_vol_counts"][b] = vcounts
             for j, dv in enumerate(disk[: d.DV]):
                 out["disk_vol_ids"][b, j] = dv
@@ -1494,8 +1557,28 @@ class SnapshotEncoder:
             spread = self._spread_and_counts(out)
         d0, d1 = self._service_affinity_candidates(pods, out)
         return PodBatch(
-            **out, spread_counts=spread, svc_aff_d0=d0, svc_aff_d1=d1
+            **out, spread_counts=spread, svc_aff_d0=d0, svc_aff_d1=d1,
+            vol_overlap=self._vol_overlap(pods),
         )
+
+    def _vol_overlap(self, pods) -> np.ndarray:
+        """f32[B, NUM_VOL_TYPES, N] count of the pod's attachable volumes
+        ALREADY mounted on each node (filterVolumes' already-mounted
+        subtraction: they add no new attachment); [B, VT, 1] lean
+        placeholder when no pod carries volumes."""
+        B = _pow2(max(len(pods), 1, self.dims.B))
+        if not any(getattr(p.spec, "volumes", None) for p in pods):
+            return np.zeros((B, NUM_VOL_TYPES, 1), np.float32)
+        out = np.zeros((B, NUM_VOL_TYPES, self._cap_n), np.float32)
+        for b, pod in enumerate(pods):
+            if not pod.spec.volumes:
+                continue
+            _, _, cnt_ids = self._pod_vols(pod)
+            for t, ids in enumerate(cnt_ids):
+                for vid in ids:
+                    for row in self._cnt_vol_rows[t].get(vid, ()):
+                        out[b, t, row] += 1.0
+        return out
 
     def _service_affinity_candidates(self, pods, out):
         """(d0, d1) i32[B]: first same-namespace arena pod whose labels

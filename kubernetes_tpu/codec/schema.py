@@ -311,7 +311,11 @@ class PodBatch:
     image_ids: Any          # i32[B, C]  (PAD empty)
     image_bytes: Any        # f32[B, C]  total size if known (0 otherwise)
     # volumes
-    new_vol_counts: Any     # f32[B, NUM_VOL_TYPES] new unique volumes the pod adds
+    new_vol_counts: Any     # f32[B, NUM_VOL_TYPES] unique volumes the pod
+                            #   references (per attach-count filter type)
+    vol_overlap: Any        # f32[B, VT, N] of those, how many are already
+                            #   mounted per node (subtract: they attach
+                            #   nothing new); [B, VT, 1] lean placeholder
     disk_vol_ids: Any       # i32[B, DV] exclusive-use volume ids (NoDiskConflict)
     # volume topology restrictions, as hostname-pair sets (exact: the host
     # evaluates PV zone labels / nodeAffinity / binding candidates against

@@ -293,20 +293,6 @@ class CPUScheduler:
                 out.append("iscsi/%s/%s/%s" % (r.get("targetPortal", ""), r.get("iqn", ""), r.get("lun", 0)))
         return out
 
-    @staticmethod
-    def _vol_type_counts(pod: Pod) -> List[float]:
-        counts = [0.0] * 5
-        for v in pod.spec.volumes:
-            if "awsElasticBlockStore" in v:
-                counts[0] += 1
-            elif "gcePersistentDisk" in v:
-                counts[1] += 1
-            elif "azureDisk" in v:
-                counts[3] += 1
-            elif "cinder" in v:
-                counts[4] += 1
-        return counts
-
     def no_disk_conflict(self, pod: Pod, node: Node) -> bool:
         mine = set(self._disk_vols(pod))
         if not mine:
@@ -317,16 +303,7 @@ class CPUScheduler:
         return True
 
     def max_volume_counts(self, pod: Pod, node: Node) -> bool:
-        new = self._vol_type_counts(pod)
-        if not any(new):
-            return True
-        used = [0.0] * 5
-        for p in self.by_node[node.name]:
-            for i, c in enumerate(self._vol_type_counts(p)):
-                used[i] += c
-        return all(
-            not (new[i] > 0 and used[i] + new[i] > self.max_vols[i]) for i in range(5)
-        )
+        return all(self.max_volume_counts_full(pod, node))
 
     # ---- volume predicates (object-level, independent of the encoder) ----
 
@@ -400,8 +377,19 @@ class CPUScheduler:
                         return False
         return True
 
-    def _vol_counts_with_pvc(self, pod: Pod) -> List[float]:
-        counts = self._vol_type_counts(pod)
+    def _vol_ids_with_pvc(self, pod: Pod) -> List[set]:
+        """Per-type UNIQUE volume identities (direct + PVC-resolved) — the
+        filterVolumes map keys (predicates.go:330-430)."""
+        ids: List[set] = [set() for _ in range(5)]
+        for v in pod.spec.volumes:
+            if "awsElasticBlockStore" in v:
+                ids[0].add("ebs/" + v["awsElasticBlockStore"].get("volumeID", ""))
+            elif "gcePersistentDisk" in v:
+                ids[1].add("gce/" + v["gcePersistentDisk"].get("pdName", ""))
+            elif "azureDisk" in v:
+                ids[3].add("azd/" + v["azureDisk"].get("diskName", ""))
+            elif "cinder" in v:
+                ids[4].add("cinder/" + v["cinder"].get("volumeID", ""))
         kind_col = {
             "awsElasticBlockStore": 0,
             "gcePersistentDisk": 1,
@@ -413,16 +401,24 @@ class CPUScheduler:
             if pvc is not None and pvc.volume_name:
                 pv = self.pvs.get(pvc.volume_name)
                 if pv is not None and pv.source_kind in kind_col:
-                    counts[kind_col[pv.source_kind]] += 1
-        return counts
+                    ids[kind_col[pv.source_kind]].add("pv/" + pv.name)
+        return ids
+
+    def _vol_counts_with_pvc(self, pod: Pod) -> List[float]:
+        return [float(len(x)) for x in self._vol_ids_with_pvc(pod)]
 
     def max_volume_counts_full(self, pod: Pod, node: Node) -> List[bool]:
-        """Per-filter-type verdicts [EBS, GCE, CSI, Azure, Cinder]."""
-        new = self._vol_counts_with_pvc(pod)
-        used = [0.0] * 5
+        """Per-filter-type verdicts [EBS, GCE, CSI, Azure, Cinder]: used is
+        the node's DISTINCT attached set, and pod volumes already mounted
+        there attach nothing new (the already-mounted subtraction,
+        predicates.go:349-363)."""
+        pod_ids = self._vol_ids_with_pvc(pod)
+        node_ids: List[set] = [set() for _ in range(5)]
         for p in self.by_node[node.name]:
-            for i, c in enumerate(self._vol_counts_with_pvc(p)):
-                used[i] += c
+            for i, x in enumerate(self._vol_ids_with_pvc(p)):
+                node_ids[i] |= x
+        used = [float(len(x)) for x in node_ids]
+        new = [float(len(pod_ids[i] - node_ids[i])) for i in range(5)]
         limits = list(self.max_vols)
         limit_keys = {
             "attachable-volumes-aws-ebs": 0,

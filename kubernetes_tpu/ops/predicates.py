@@ -234,9 +234,15 @@ def no_disk_conflict(cluster: ClusterTensors, pods: PodBatch):
 
 def max_volume_counts(cluster: ClusterTensors, pods: PodBatch, max_vols):
     """MaxEBS/GCE/CSI/Azure/Cinder volume-count filters (predicates.go:330-614)
-    -> bool[B, 5, N], one slice per filter type.  Per-node attachable limits
-    (the AttachVolumeLimit allocatable keys) override the static defaults."""
+    -> bool[B, 5, N], one slice per filter type.  Counting dedupes by volume
+    identity on BOTH sides: `used` is the node's distinct attached set and a
+    pod volume already mounted there attaches nothing new (the
+    already-mounted subtraction, predicate lines 355-361).  Per-node
+    attachable limits (AttachVolumeLimit allocatable keys) override the
+    static defaults."""
     new = pods.new_vol_counts[:, :, None]       # [B, 5, 1]
+    if pods.vol_overlap.shape[-1] == cluster.n_nodes:
+        new = jnp.maximum(new - pods.vol_overlap, 0.0)
     used = cluster.vol_counts.T[None]           # [1, 5, N]
     default = jnp.asarray(max_vols, jnp.float32)[None, :, None]
     node_lim = cluster.vol_limits.T[None]       # [1, 5, N] (inf = unset)

@@ -892,3 +892,54 @@ def test_image_locality_normalization_and_multi_names():
         dev0 = float(per_prio[0, PRIO_INDEX["ImageLocalityPriority"],
                               row["without-image"]])
         assert dev0 == 0.0
+
+
+# --------------------------------------------------------------------------
+# MaxVolumeCount dedup semantics (predicates.go:330-430 filterVolumes):
+# counts key a map by volume IDENTITY — a pod referencing one volume twice
+# counts once, and a volume already mounted on the node attaches nothing new.
+# --------------------------------------------------------------------------
+
+def test_max_volume_count_dedup_within_pod_and_node():
+    from kubernetes_tpu.codec.schema import FilterConfig as FC
+
+    ebs = lambda vid: {"awsElasticBlockStore": {"volumeID": vid}}
+    nodes = [make_node("n1", cpu="8", mem="16Gi")]
+    # node already holds vol-a and vol-b via two pods (vol-b from both:
+    # distinct count must be 2, not 3)
+    pods = [
+        make_pod("e0", cpu="100m", node_name="n1",
+                 volumes=[ebs("vol-a"), ebs("vol-b")]),
+        make_pod("e1", cpu="100m", node_name="n1", volumes=[ebs("vol-b")]),
+    ]
+    # limit 3: a pod adding {vol-a (mounted), vol-c} needs 1 new -> 2+1 <= 3
+    pending_fit = make_pod("fit", cpu="100m",
+                           volumes=[ebs("vol-a"), ebs("vol-a"), ebs("vol-c")])
+    # a pod adding {vol-c, vol-d} needs 2 new -> 2+2 > 3
+    pending_no = make_pod("no", cpu="100m",
+                          volumes=[ebs("vol-c"), ebs("vol-d")])
+
+    enc = SnapshotEncoder(TEST_DIMS)
+    for n in nodes:
+        enc.add_node(n)
+    for p in pods:
+        enc.add_pod(p)
+    cfg = FC(max_vols=(3.0, 16.0, 1e9, 16.0, 1e9))
+    golden = CPUScheduler(nodes, pods, max_vols=(3, 16, 1e9, 16, 1e9))
+    for pending, want in ((pending_fit, True), (pending_no, False)):
+        batch = enc.encode_pods([pending])
+        cluster = enc.snapshot()
+        _, per_pred = filter_batch(cluster, batch, cfg, 0)
+        got_dev = bool(np.asarray(per_pred)[
+            0, PRED_INDEX["MaxEBSVolumeCount"], enc.node_rows["n1"]])
+        got_ref = golden.predicates(pending, nodes[0])["MaxEBSVolumeCount"]
+        assert got_dev == want, f"{pending.name}: device={got_dev}"
+        assert got_ref == want, f"{pending.name}: cpuref={got_ref}"
+
+    # removing e1 keeps vol-b attached via e0 (refcounted identity)
+    enc.remove_pod(pods[1])
+    cluster = enc.snapshot()
+    assert float(np.asarray(cluster.vol_counts)[enc.node_rows["n1"], 0]) == 2.0
+    enc.remove_pod(pods[0])
+    cluster = enc.snapshot()
+    assert float(np.asarray(cluster.vol_counts)[enc.node_rows["n1"], 0]) == 0.0
