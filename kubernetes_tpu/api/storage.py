@@ -38,6 +38,11 @@ class PersistentVolume:
     node_affinity: Optional[NodeSelector] = None  # spec.nodeAffinity.required
     source_kind: str = ""                          # SRC_* ("" unknown)
     csi_driver: str = ""
+    # the underlying volume identity (EBS volumeID / GCE pdName / Azure
+    # diskName / Cinder volumeID / CSI volumeHandle): attach-count dedup
+    # keys by THIS, so a PV and a direct volume over the same disk (or two
+    # PVs over one disk) count once (filterVolumes FilterPersistentVolume)
+    source_id: str = ""
     phase: str = "Available"                       # Available | Bound | ...
     claim_ref: str = ""                            # "ns/name" of bound PVC
 
@@ -54,9 +59,15 @@ class PersistentVolume:
         spec = d.get("spec") or {}
         source_kind = ""
         csi_driver = ""
+        source_id = ""
+        id_field = {
+            SRC_EBS: "volumeID", SRC_GCE: "pdName", SRC_AZURE: "diskName",
+            SRC_CINDER: "volumeID", SRC_CSI: "volumeHandle",
+        }
         for k in (SRC_EBS, SRC_GCE, SRC_AZURE, SRC_CINDER, SRC_CSI):
             if k in spec:
                 source_kind = k
+                source_id = spec[k].get(id_field[k], "")
                 if k == SRC_CSI:
                     csi_driver = spec[k].get("driver", "")
                 break

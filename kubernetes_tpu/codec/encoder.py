@@ -700,8 +700,14 @@ class SnapshotEncoder:
                             kstorage.SRC_CINDER: VOL_CINDER,
                         }.get(pv.source_kind)
                         if col is not None:
+                            prefix = {
+                                VOL_EBS: "ebs/", VOL_GCE: "gce/",
+                                VOL_CSI: "csi/", VOL_AZURE: "azd/",
+                                VOL_CINDER: "cinder/",
+                            }[col]
+                            ident = pv.source_id or ("pvname/" + pv.name)
                             cnt_ids[col].add(
-                                self.interner.intern("pv/" + pv.name)
+                                self.interner.intern(prefix + ident)
                             )
         counts = np.asarray([len(ids) for ids in cnt_ids], np.float32)
         return disk, counts, cnt_ids
@@ -1260,7 +1266,12 @@ class SnapshotEncoder:
                 arena_nodes[on_node],
                 pods_ext[arena_ms[on_node], R + 1],
             )
-        requested_ext[:, R + 2 :] = self.a_volcnt
+        # the pending pod's volumes already attached on a node consume no
+        # NEW attachment there (filterVolumes already-mounted subtraction):
+        # credit them against the node's distinct-attached counts
+        requested_ext[:, R + 2 :] = np.maximum(
+            self.a_volcnt - self._vol_overlap([pod])[0].T, 0.0
+        )
 
         allocatable_ext = np.zeros((N, E), np.float32)
         allocatable_ext[:, :R] = self.a_allocatable
@@ -1426,6 +1437,7 @@ class SnapshotEncoder:
         # spread-registry changes invalidate cached rows
         token = (self.dims, len(self._spread), aff_lean, vol_lean,
                  tuple(self.service_affinity_keys))
+        cnt_ids_by_b: dict = {}
         if token != self._pod_cache_token:
             self._pod_row_cache.clear()
             self._pod_cache_token = token
@@ -1526,7 +1538,8 @@ class SnapshotEncoder:
                     out["image_ids"][b, j] = it.lookup(
                         normalized_image(c.image)
                     )
-            disk, vcounts, _cnt_ids = self._pod_vols(pod)
+            disk, vcounts, cnt_ids = self._pod_vols(pod)
+            cnt_ids_by_b[b] = cnt_ids
             out["new_vol_counts"][b] = vcounts
             for j, dv in enumerate(disk[: d.DV]):
                 out["disk_vol_ids"][b, j] = dv
@@ -1558,14 +1571,15 @@ class SnapshotEncoder:
         d0, d1 = self._service_affinity_candidates(pods, out)
         return PodBatch(
             **out, spread_counts=spread, svc_aff_d0=d0, svc_aff_d1=d1,
-            vol_overlap=self._vol_overlap(pods),
+            vol_overlap=self._vol_overlap(pods, cnt_ids_by_b),
         )
 
-    def _vol_overlap(self, pods) -> np.ndarray:
+    def _vol_overlap(self, pods, cnt_ids_by_b=None) -> np.ndarray:
         """f32[B, NUM_VOL_TYPES, N] count of the pod's attachable volumes
         ALREADY mounted on each node (filterVolumes' already-mounted
         subtraction: they add no new attachment); [B, VT, 1] lean
-        placeholder when no pod carries volumes."""
+        placeholder when no pod carries volumes.  `cnt_ids_by_b` reuses the
+        id sets the encode loop already computed."""
         B = _pow2(max(len(pods), 1, self.dims.B))
         if not any(getattr(p.spec, "volumes", None) for p in pods):
             return np.zeros((B, NUM_VOL_TYPES, 1), np.float32)
@@ -1573,7 +1587,9 @@ class SnapshotEncoder:
         for b, pod in enumerate(pods):
             if not pod.spec.volumes:
                 continue
-            _, _, cnt_ids = self._pod_vols(pod)
+            cnt_ids = (cnt_ids_by_b or {}).get(b)
+            if cnt_ids is None:
+                _, _, cnt_ids = self._pod_vols(pod)
             for t, ids in enumerate(cnt_ids):
                 for vid in ids:
                     for row in self._cnt_vol_rows[t].get(vid, ()):

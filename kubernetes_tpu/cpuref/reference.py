@@ -397,15 +397,15 @@ class CPUScheduler:
             "azureDisk": 3,
             "cinder": 4,
         }
+        prefix = ["ebs/", "gce/", "csi/", "azd/", "cinder/"]
         for pvc in self._pod_pvcs(pod):
             if pvc is not None and pvc.volume_name:
                 pv = self.pvs.get(pvc.volume_name)
                 if pv is not None and pv.source_kind in kind_col:
-                    ids[kind_col[pv.source_kind]].add("pv/" + pv.name)
+                    col = kind_col[pv.source_kind]
+                    ident = getattr(pv, "source_id", "") or ("pvname/" + pv.name)
+                    ids[col].add(prefix[col] + ident)
         return ids
-
-    def _vol_counts_with_pvc(self, pod: Pod) -> List[float]:
-        return [float(len(x)) for x in self._vol_ids_with_pvc(pod)]
 
     def max_volume_counts_full(self, pod: Pod, node: Node) -> List[bool]:
         """Per-filter-type verdicts [EBS, GCE, CSI, Azure, Cinder]: used is
