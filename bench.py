@@ -111,6 +111,9 @@ def _reexec(attempt: int, err: BaseException, max_attempts: int, backoff: float)
         os.environ[_ATTEMPT_ENV] = str(attempt + 1)
         os.environ[_TPU_ERROR_ENV] = msg
         os.environ["JAX_PLATFORMS"] = "cpu"
+        # the fallback is the last resort: give it a FRESH watchdog budget
+        # (a late CPU number beats a watchdog error line)
+        os.environ.pop(_DEADLINE_ENV, None)
     else:
         _emit(_error_line("cpu-fallback", err))
         sys.exit(0)
