@@ -58,6 +58,12 @@ TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
 class SchedulerConfig:
     batch_size: int = 256
     batch_window_s: float = 0.001
+    # "sequential" = exact one-pod-at-a-time commit semantics (lax.scan);
+    # "speculative" = parallel placement + conflict repair (higher
+    # throughput; in-batch spread scores stale within a cycle).  Batches
+    # carrying pod affinity or nominated pods always take the sequential
+    # scan regardless (the in-batch state lives there).
+    engine: str = "sequential"
     percentage_of_nodes_to_score: int = 100  # TPU path scans all; knob for parity
     disable_preemption: bool = False
     weights: Optional[Sequence[float]] = None
@@ -119,7 +125,7 @@ class Scheduler:
             self.config.filter_config
         )
         self._unsched_key = enc.interner.intern(TAINT_NODE_UNSCHEDULABLE)
-        self._schedule_fn = make_sequential_scheduler(
+        engine_kw = dict(
             cfg=self.config.filter_config,
             weights=self.config.weights,
             unsched_taint_key=self._unsched_key,
@@ -127,6 +133,15 @@ class Scheduler:
             score_cfg=prof.score_config if prof is not None else None,
             percentage_of_nodes_to_score=self.config.percentage_of_nodes_to_score,
         )
+        self._schedule_fn = make_sequential_scheduler(**engine_kw)
+        if self.config.engine == "speculative":
+            from kubernetes_tpu.models.speculative import (
+                make_speculative_scheduler,
+            )
+
+            self._speculative_fn = make_speculative_scheduler(**engine_kw)
+        else:
+            self._speculative_fn = None
         self.framework = framework
         # scheduler-side extender chain (core/extender.go; chained in config
         # order at generic_scheduler.go:527-554); built from the Policy's
@@ -227,7 +242,13 @@ class Scheduler:
                 pods, node_row_map, cluster, extra_mask, extra_score
             )
             trace.step("extenders")
-        hosts, _ = self._schedule_fn(
+        fn = self._schedule_fn
+        if (
+            self._speculative_fn is not None
+            and aff_state is None and nominated is None
+        ):
+            fn = self._speculative_fn
+        hosts, _ = fn(
             cluster, batch, ports, np.int32(self._last_index), nominated,
             extra_mask, extra_score, aff_state,
         )

@@ -134,3 +134,37 @@ def test_percentage_of_nodes_to_score_limits_sample():
     # rotated start: first 2 feasible from index 4 -> nodes 5, 6
     out = np.asarray(limit_feasible(jnp.asarray(mask), jnp.int32(2), jnp.int32(4)))
     assert out.tolist() == [False, False, False, False, False, True, True, False]
+
+
+def test_scheduler_runtime_with_speculative_engine():
+    """SchedulerConfig(engine='speculative') drives the runtime end to end;
+    affinity batches still fall back to the sequential scan."""
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+    from kubernetes_tpu.runtime.queue import PriorityQueue
+    from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+    cache = SchedulerCache()
+    for i in range(4):
+        cache.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    bound = []
+    s = Scheduler(
+        cache=cache, queue=PriorityQueue(),
+        binder=lambda p, n: bound.append((p.name, n)) or True,
+        config=SchedulerConfig(engine="speculative"),
+    )
+    for i in range(10):
+        s.queue.add(make_pod(f"p{i}", cpu="500m", mem="512Mi"))
+    s.run_once(timeout=0.5)
+    assert len(bound) == 10
+    # an affinity pod routes through the sequential scan (no assert crash
+    # from the speculative engine's aff_state guard)
+    anti = {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [{
+            "labelSelector": {"matchLabels": {"app": "x"}},
+            "topologyKey": "kubernetes.io/hostname"}]}}
+    s.queue.add(make_pod("a1", cpu="100m", labels={"app": "x"}, affinity=anti))
+    s.queue.add(make_pod("a2", cpu="100m", labels={"app": "x"}, affinity=anti))
+    s.run_once(timeout=0.5)
+    placed = {name: node for name, node in bound}
+    assert "a1" in placed and "a2" in placed
+    assert placed["a1"] != placed["a2"]
