@@ -195,16 +195,30 @@ def run(args) -> dict:
         zone_key_id=enc.getzone_key,
     )
 
-    # warmup/compile on one batch shape
+    # warmup/compile on one batch shape; device-put the snapshot ONCE —
+    # the static leaves stay resident and chain through every batch (the
+    # tunnel otherwise re-uploads ~70MB of label/taint/topology tensors
+    # per call)
     pods = [pending_pod(i) for i in range(args.batch)]
     batch = enc.encode_pods(pods)
     ports = encode_batch_ports(enc, pods)
-    cluster = enc.snapshot()
-    for _ in range(args.warmup):
-        hosts, new_cluster = fn(cluster, batch, ports, np.int32(0))
-        jax.block_until_ready(hosts)
+    cluster = jax.device_put(enc.snapshot())
+    for _ in range(max(args.warmup, 2)):
+        # chain the device state exactly like the timed loop, and FETCH the
+        # result: on the tunnel-attached TPU the first device->host copy
+        # after compile pays a multi-second one-time setup cost
+        # (block_until_ready alone does not surface it)
+        hosts, warm_state = fn(cluster, batch, ports, np.int32(0))
+        np.asarray(hosts)
+        hosts, _ = fn(warm_state, batch, ports, np.int32(args.batch))
+        np.asarray(hosts)
 
-    # timed run: chain device state, host does cache-commit bookkeeping
+    # timed run: chain device state, host does cache-commit bookkeeping.
+    # Dispatch is async — batch k+1's encode+launch overlaps the fetch of
+    # batch k's hosts, so the tunnel RTT and the host commit loop hide
+    # behind device compute (spread counts for batch k+1 then lag one
+    # batch, the same staleness the speculative engine already accepts
+    # within a batch).
     import dataclasses
 
     row_names = {row: name for name, row in enc.node_rows.items()}
@@ -213,14 +227,11 @@ def run(args) -> dict:
     t0 = time.monotonic()
     state = cluster
     last = 0
-    for start in range(0, args.pods, args.batch):
-        pods = [pending_pod(start + j) for j in range(min(args.batch, args.pods - start))]
-        batch = enc.encode_pods(pods)
-        ports = encode_batch_ports(enc, pods)
-        hosts, state = fn(state, batch, ports, np.int32(last))
-        last += len(pods)
-        hosts = np.asarray(hosts)
-        # host-side cache commit (assume/confirm bookkeeping)
+    in_flight = None  # (pods, hosts_device)
+
+    def commit(pods, hosts_dev):
+        nonlocal scheduled, unschedulable
+        hosts = np.asarray(hosts_dev)
         for j, pod in enumerate(pods):
             r = int(hosts[j])
             if r < 0:
@@ -231,7 +242,37 @@ def run(args) -> dict:
             )
             enc.add_pod(committed)
             scheduled += 1
+
+    phases = {"encode": 0.0, "dispatch": 0.0, "commit": 0.0}
+    for start in range(0, args.pods, args.batch):
+        n = min(args.batch, args.pods - start)
+        tp = time.monotonic()
+        pods = [pending_pod(start + j) for j in range(n)]
+        if n < args.batch:  # pad the tail batch: same shape, no recompile
+            pods += [pending_pod(start) for _ in range(args.batch - n)]
+        batch = enc.encode_pods(pods)
+        if n < args.batch:
+            valid = np.array(batch.valid, bool)  # padded width, not args.batch
+            valid[n:] = False
+            batch = dataclasses.replace(batch, valid=valid)
+        ports = encode_batch_ports(enc, pods)
+        phases["encode"] += time.monotonic() - tp
+        tp = time.monotonic()
+        hosts, state = fn(state, batch, ports, np.int32(last))
+        if hasattr(hosts, "copy_to_host_async"):
+            hosts.copy_to_host_async()
+        phases["dispatch"] += time.monotonic() - tp
+        last += n
+        tp = time.monotonic()
+        if in_flight is not None:
+            commit(*in_flight)
+        phases["commit"] += time.monotonic() - tp
+        in_flight = (pods[:n], hosts)
+    tp = time.monotonic()
+    if in_flight is not None:
+        commit(*in_flight)
     jax.block_until_ready(state.requested)
+    phases["commit"] += time.monotonic() - tp
     dt = time.monotonic() - t0
 
     pods_per_s = scheduled / dt if dt > 0 else 0.0
@@ -243,6 +284,7 @@ def run(args) -> dict:
         "engine": args.engine,
         "seconds": round(dt, 3),
         "node_encode_seconds": round(t_nodes, 3),
+        "phases": {k: round(v, 3) for k, v in phases.items()},
         "device": str(jax.devices()[0]),
         "attempt": int(os.environ.get(_ATTEMPT_ENV, "0")),
     }
@@ -261,7 +303,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=5000)
     ap.add_argument("--pods", type=int, default=10000)
-    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=2048)
     ap.add_argument(
         "--engine", choices=("speculative", "sequential"), default="speculative",
         help="speculative = parallel placement + conflict repair (fast path); "

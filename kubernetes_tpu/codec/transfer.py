@@ -1,0 +1,74 @@
+"""Packed host->device transfer for remote-attached accelerators.
+
+A pytree of small numpy leaves (PodBatch has ~60) costs one host->device
+round trip PER LEAF when passed straight into a jitted call — on a
+tunnel-attached TPU that is ~8ms x 60 = ~0.5s per scheduling batch, far more
+than the compute itself.  pack_tree collapses the tree into at most three
+flat buffers (one per dtype kind: float, int, bool) so the device pays one
+RTT each; unpack_tree rebuilds the original tree *inside* the jitted
+program with static slices (free: XLA folds them into the consumers).
+
+The reference has no analog (its scheduler state never leaves host RAM);
+this is TPU-plumbing the same way protobuf wire-batching is etcd-plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_GROUPS = ("f", "i", "b")
+_HOST_DTYPE = {"f": np.float32, "i": np.int32, "b": np.bool_}
+_DEV_DTYPE = {"f": jnp.float32, "i": jnp.int32, "b": jnp.bool_}
+
+
+def _group(dtype) -> str:
+    k = np.dtype(dtype).kind
+    if k == "f":
+        return "f"
+    if k in ("i", "u"):
+        return "i"
+    if k == "b":
+        return "b"
+    raise TypeError(f"unsupported leaf dtype {dtype!r}")
+
+
+def pack_tree(tree) -> Tuple[Tuple[np.ndarray, ...], Any]:
+    """tree (numpy/scalar leaves) -> (buffers, meta).
+
+    buffers: up to 3 flat numpy arrays (f32 / i32 / bool).  meta is hashable
+    (treedef + per-leaf placement) and is the jit-cache key for the matching
+    unpack — identical batch shapes share one compiled program.
+    64-bit leaves are narrowed to 32-bit (the device schema is 32-bit).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    chunks = {g: [] for g in _GROUPS}
+    offs = {g: 0 for g in _GROUPS}
+    metas = []
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        g = _group(a.dtype)
+        flat = np.ravel(a).astype(_HOST_DTYPE[g], copy=False)
+        metas.append((g, offs[g], a.shape))
+        offs[g] += flat.size
+        chunks[g].append(flat)
+    bufs = tuple(
+        np.concatenate(chunks[g]) if chunks[g] else np.zeros(0, _HOST_DTYPE[g])
+        for g in _GROUPS
+    )
+    return bufs, (treedef, tuple(metas))
+
+
+def unpack_tree(bufs, meta):
+    """Rebuild the packed tree from device buffers (call inside jit)."""
+    treedef, metas = meta
+    by_group = dict(zip(_GROUPS, bufs))
+    leaves = []
+    for g, off, shape in metas:
+        size = int(np.prod(shape)) if shape else 1
+        piece = by_group[g][off:off + size]
+        leaves.append(jnp.reshape(piece, shape).astype(_DEV_DTYPE[g]))
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,50 +1,71 @@
 """Speculative parallel placement: the high-throughput engine.
 
 The sequential-commit scan (models/batched.py) reproduces one-pod-at-a-time
-semantics exactly, but a `lax.scan` step is latency-bound (~ms on TPU), so B
-pods cost B sequential steps.  This engine instead places the WHOLE batch in
-one fully-parallel launch (filter + score over the pods x nodes grid — all
-MXU work), then resolves conflicts host-side:
+semantics exactly, but a `lax.scan` step is latency-bound, so B pods cost B
+sequential steps.  This engine places the WHOLE batch in one device launch:
 
-  round r:
-    1. one launch: mask/score every remaining pod against the current
-       cluster state, argmax with per-pod staggered tie-break
-       (ops/select.select_hosts_batch — identical pods rotate across tied
-       nodes, so collisions are rare by construction);
-    2. host commit, in batch order: accept a pod iff its node still has
-       capacity AND no host-port conflict with pods committed this cycle;
-       rejected pods get extra_mask[b, node] = False (guaranteed progress:
-       a pod never re-picks a node it was bounced from) and go to round r+1
-       against the updated resource columns.
+  round r (all rounds run inside ONE jitted while_loop — no host round
+  trips; on a tunnel-attached TPU a single device<->host sync costs ~50ms,
+  so the round-1 design goal is zero syncs between upload and the final
+  hosts fetch):
+    1. mask/score every remaining pod against the current in-loop cluster
+       state (filter_batch + score_batch over the pods x nodes grid — MXU
+       work), argmax with per-pod staggered tie-break
+       (ops/select.select_hosts_batch);
+    2. commit on device, in batch order: pod b is accepted iff its proposed
+       node still fits the resources of b PLUS every earlier same-node
+       proposer this round, and none of b's host ports conflict with ports
+       already claimed on the node or wanted by an earlier same-node
+       proposer.  "Earlier same-node proposer" is a strictly-lower-triangle
+       incidence product (one_hot(hosts) @ one_hot(hosts).T masked by
+       tril) — the conflict-repair bookkeeping is three small matmuls, not
+       a host loop.  Rejected pods get emask[b, node] = False (progress:
+       a pod never re-picks a node it was bounced from) and go to round
+       r+1 against the updated resource columns.
 
-Every PREDICATE is enforced (device mask + host commit re-check); what
-differs from the sequential scan is in-batch score freshness: the resource
-balance scores refresh between rounds (requested/nonzero are re-uploaded),
-but spreading counts come from the immutable snapshot, so same-batch
-service mates don't repel each other until the next cycle's snapshot.
-Workloads carrying required (anti-)affinity should use the
-sequential scan (the scheduler's auto mode does), since in-batch affinity
-state lives there.
+The commit is slightly more conservative than a sequential host commit:
+earlier proposers count against a node's budget even if they themselves end
+up bounced on ports, so an accepted placement NEVER overcommits, but a pod
+can be bounced a round earlier than strictly necessary (it simply re-picks
+next round).  Every PREDICATE is enforced on the accepted state.  What
+differs from the sequential scan is in-batch score freshness: resource
+balance refreshes between rounds, but spreading counts come from the
+immutable snapshot, so same-batch service mates don't repel each other
+until the next cycle's snapshot.  Workloads carrying required
+(anti-)affinity use the sequential scan (the scheduler's auto mode does),
+since in-batch affinity state lives there.
 
-Typical convergence: round 1 commits ~all pods (staggered ties), so the cost
-is ~1 parallel launch per batch instead of B scan steps — the path to the
->=10k pods/s north star (BASELINE.json).
+Transfer discipline (the tunnel bills per leaf AND per byte):
+  * the PodBatch/port tensors are packed into three flat buffers
+    (codec/transfer.py) — one RTT instead of ~60;
+  * the cluster snapshot should be device-put ONCE by the caller and
+    chained between batches (the returned new_cluster reuses the resident
+    static leaves); this module device-puts it on first sight as a
+    fallback.
+
+Termination: each round every active pod is accepted (retired), infeasible
+(retired), or bounced (clears one emask bit) — bounded by B*N bit-clears.
+Typical convergence: round 1 commits ~all pods (staggered ties make
+collisions rare by construction) — ~1 parallel launch per batch instead of
+B scan steps, the path to the >=10k pods/s north star (BASELINE.json).
+
+Reference for the semantics being reproduced at batch scale:
+core/generic_scheduler.go Schedule (:184-254) / selectHost (:284-296);
+the 16-goroutine scan it replaces is workqueue.ParallelizeUntil at :518.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Tuple
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
-from kubernetes_tpu.codec.schema import (
-    ClusterTensors,
-    FilterConfig,
-    PodBatch,
-)
+from kubernetes_tpu.codec.schema import ClusterTensors, FilterConfig, PodBatch
+from kubernetes_tpu.codec.transfer import pack_tree, unpack_tree
 from kubernetes_tpu.ops.predicates import filter_batch
 from kubernetes_tpu.ops.priorities import score_batch
 from kubernetes_tpu.ops.select import (
@@ -52,6 +73,8 @@ from kubernetes_tpu.ops.select import (
     num_feasible_nodes_device,
     select_hosts_batch,
 )
+
+_X = lax.Precision.HIGHEST  # exact f32 matmuls: these carry counts, not ML
 
 
 def make_speculative_scheduler(
@@ -65,114 +88,164 @@ def make_speculative_scheduler(
     """Same call contract as make_sequential_scheduler:
     fn(cluster, pods, ports, last_index0, extra_mask=None, extra_score=None)
     -> (hosts i32[B] (-1 unschedulable), new_cluster with committed
-    requested/nonzero columns)."""
+    requested/nonzero columns).  hosts is returned as a device array so the
+    caller can overlap its fetch with the next batch's dispatch."""
     w = None if weights is None else np.asarray(weights, np.float32)
 
-    @jax.jit
-    def one_round(cluster, pods, requested, nonzero, active, last_index0,
-                  extra_mask, extra_score):
-        cl = dataclasses.replace(
-            cluster, requested=requested, nonzero_req=nonzero
-        )
-        mask, _ = filter_batch(cl, pods, cfg, unsched_taint_key)
-        total, _ = score_batch(
-            cl, pods, weights=w, score_cfg=score_cfg, zone_key_id=zone_key_id
-        )
-        mask = mask & active[:, None] & extra_mask & pods.valid[:, None]
-        if percentage_of_nodes_to_score < 100:  # 0 = adaptive
-            lim = num_feasible_nodes_device(
-                jnp.sum(cl.valid.astype(jnp.int32)),
-                percentage_of_nodes_to_score,
+    def _impl(cluster, pods, pod_ports, conflict, last_index0, emask0, escore):
+        B = pods.valid.shape[0]
+        N = cluster.allocatable.shape[0]
+        reqf = pods.req.astype(jnp.float32)
+        nzf = pods.nonzero_req.astype(jnp.float32)
+        pports = pod_ports.astype(jnp.bool_)
+        pports_f = pod_ports.astype(jnp.float32)
+        conflict_f = conflict.astype(jnp.float32)
+        tril = jnp.tril(jnp.ones((B, B), jnp.float32), k=-1)
+
+        def cond(c):
+            return jnp.any(c["active"])
+
+        def body(c):
+            cl = dataclasses.replace(
+                cluster, requested=c["req"], nonzero_req=c["nz"]
             )
-            starts = last_index0 + jnp.arange(mask.shape[0], dtype=jnp.int32)
-            mask = jax.vmap(limit_feasible, in_axes=(0, None, 0))(
-                mask, lim, starts
+            mask, _ = filter_batch(cl, pods, cfg, unsched_taint_key)
+            total, _ = score_batch(
+                cl, pods, weights=w, score_cfg=score_cfg,
+                zone_key_id=zone_key_id,
             )
-        total = total + extra_score
-        hosts, feasible = select_hosts_batch(total, mask, last_index0)
-        return hosts, feasible & jnp.any(mask, axis=1)
+            mask = mask & c["active"][:, None] & c["emask"] & pods.valid[:, None]
+            if percentage_of_nodes_to_score < 100:  # 0 = adaptive
+                lim = num_feasible_nodes_device(
+                    jnp.sum(cl.valid.astype(jnp.int32)),
+                    percentage_of_nodes_to_score,
+                )
+                starts = c["li"] + jnp.arange(B, dtype=jnp.int32)
+                mask = jax.vmap(limit_feasible, in_axes=(0, None, 0))(
+                    mask, lim, starts
+                )
+            total = total + escore
+            hosts, feasible = select_hosts_batch(total, mask, c["li"])
+            feasible = feasible & jnp.any(mask, axis=1)
+            prop = c["active"] & feasible            # proposers this round
+            onehot = jax.nn.one_hot(hosts, N, dtype=jnp.float32)
+            onehot = onehot * prop[:, None].astype(jnp.float32)  # [B, N]
+            # earlier same-node proposers, as a strict-lower-triangle
+            # incidence product (batch order = commit order)
+            same = jnp.matmul(onehot, onehot.T, precision=_X)    # [B, B]
+            prior = same * tril
+            cum_req = jnp.matmul(prior, reqf, precision=_X)      # [B, R]
+            node_req = c["req"][hosts]                           # [B, R]
+            alloc_h = cluster.allocatable[hosts]
+            over = (reqf > 0) & (node_req + cum_req + reqf > alloc_h)
+            fits = ~jnp.any(over, axis=1)
+            # ports: conflict with claims already on the node OR with an
+            # earlier same-node proposer's wanted ports
+            prior_ports = jnp.matmul(prior, pports_f, precision=_X) > 0
+            claimed_h = c["claimed"][hosts]                      # [B, PV]
+            blocked = jnp.matmul(
+                (claimed_h | prior_ports).astype(jnp.float32),
+                conflict_f, precision=_X,
+            ) > 0
+            pconf = jnp.any(pports & blocked, axis=1)
+            accept = prop & fits & ~pconf
+            acc1 = onehot * accept[:, None].astype(jnp.float32)
+            # the accept pass is conservative (earlier proposers count even
+            # if they themselves bounce), which never overcommits but can
+            # bounce a pod that would fit the truly-accepted state.  Only
+            # ban the node (emask clear) when the bounce ALSO holds against
+            # accepted-only prior state — a conservatively-bounced pod keeps
+            # the node and retries next round.
+            prior_acc = prior * accept[None, :].astype(jnp.float32)
+            cum_acc = jnp.matmul(prior_acc, reqf, precision=_X)
+            over_acc = (reqf > 0) & (node_req + cum_acc + reqf > alloc_h)
+            fits_acc = ~jnp.any(over_acc, axis=1)
+            prior_ports_acc = jnp.matmul(prior_acc, pports_f, precision=_X) > 0
+            blocked_acc = jnp.matmul(
+                (claimed_h | prior_ports_acc).astype(jnp.float32),
+                conflict_f, precision=_X,
+            ) > 0
+            pconf_acc = jnp.any(pports & blocked_acc, axis=1)
+            real_bounce = prop & ~accept & (~fits_acc | pconf_acc)
+            return {
+                "hosts": jnp.where(accept, hosts, c["hosts"]),
+                "req": c["req"] + jnp.matmul(acc1.T, reqf, precision=_X),
+                "nz": c["nz"] + jnp.matmul(acc1.T, nzf, precision=_X),
+                "claimed": c["claimed"]
+                | (jnp.matmul(acc1.T, pports_f, precision=_X) > 0),
+                # really-bounced proposers never re-pick the node that
+                # bounced them (progress: the first active proposer of any
+                # contended node is always accepted or really bounced)
+                "emask": c["emask"] & ~((onehot > 0) & real_bounce[:, None]),
+                # retired: accepted, or nothing feasible this round
+                "active": c["active"] & feasible & ~accept,
+                "li": c["li"] + jnp.int32(B),
+            }
+
+        init = {
+            "hosts": jnp.full((B,), -1, jnp.int32),
+            "req": cluster.requested.astype(jnp.float32),
+            "nz": cluster.nonzero_req.astype(jnp.float32),
+            "claimed": jnp.zeros((N, pod_ports.shape[1]), jnp.bool_),
+            "emask": emask0,
+            "active": pods.valid,
+            "li": jnp.asarray(last_index0, jnp.int32),
+        }
+        out = lax.while_loop(cond, body, init)
+        return out["hosts"], out["req"], out["nz"]
+
+    @lru_cache(maxsize=64)
+    def _packed_plain(meta):
+        @jax.jit
+        def run(cluster, bufs, last_index0):
+            pods, pod_ports, conflict = unpack_tree(bufs, meta)
+            B = pods.valid.shape[0]
+            N = cluster.allocatable.shape[0]
+            return _impl(
+                cluster, pods, pod_ports, conflict, last_index0,
+                jnp.ones((B, N), jnp.bool_), jnp.zeros((B, N), jnp.float32),
+            )
+
+        return run
+
+    @lru_cache(maxsize=64)
+    def _packed_extras(meta):
+        @jax.jit
+        def run(cluster, bufs, last_index0, emask0, escore):
+            pods, pod_ports, conflict = unpack_tree(bufs, meta)
+            return _impl(
+                cluster, pods, pod_ports, conflict, last_index0,
+                emask0.astype(jnp.bool_), escore.astype(jnp.float32),
+            )
+
+        return run
 
     def schedule(cluster: ClusterTensors, pods: PodBatch, ports,
                  last_index0, nominated=None, extra_mask=None,
                  extra_score=None, aff_state=None):
-        B = pods.n_pods
-        N = cluster.n_nodes
         assert aff_state is None and nominated is None, (
             "speculative engine handles the plain fast path; affinity/"
             "nominated batches take the sequential scan"
         )
-        # host mirrors for the commit checks / inter-round updates
-        req_host = np.array(cluster.requested, np.float32)
-        nz_host = np.array(cluster.nonzero_req, np.float32)
-        alloc = np.asarray(cluster.allocatable)
-        pod_req = np.asarray(pods.req)
-        pod_nz = np.asarray(pods.nonzero_req)
-        valid = np.asarray(pods.valid)
-        # in-cycle host-port claims ride the SAME batch-local vocabulary and
-        # conflict matrix the scan uses (one source of wildcard-IP
-        # semantics, batched.encode_batch_ports)
-        pod_ports = np.asarray(ports.pod_ports)          # [B, PV]
-        conflict = np.asarray(ports.conflict, np.int32)  # [PV, PV]
-        claimed = np.zeros((N, conflict.shape[0]), bool)  # [N, PV]
-
-        emask = (
-            np.ones((B, N), bool) if extra_mask is None
-            else np.array(extra_mask, bool)
-        )
-        escore = (
-            np.zeros((B, N), np.float32) if extra_score is None
-            else np.asarray(extra_score, np.float32)
-        )
-        hosts_out = np.full(B, -1, np.int32)
-        active = valid.copy()
-        li = int(last_index0)
-
-        # termination: every round either commits a pod (<= B times), marks
-        # one unschedulable, or clears at least one emask bit (<= B*N) — a
-        # zero-change round means every active pod is infeasible, which the
-        # `feasible` branch already retires.
-        while active.any():
-            hosts, feasible = one_round(
-                cluster, pods, req_host, nz_host, active,
-                np.int32(li), emask, escore,
+        bufs, meta = pack_tree((pods, ports.pod_ports, ports.conflict))
+        if extra_mask is None and extra_score is None:
+            hosts, req, nz = _packed_plain(meta)(
+                cluster, bufs, np.int32(last_index0)
             )
-            hosts = np.asarray(hosts)
-            feasible = np.asarray(feasible)
-            li += B
-            changed = False
-            for b in np.nonzero(active)[0]:
-                if not feasible[b]:
-                    active[b] = False  # truly unschedulable this cycle
-                    changed = True
-                    continue
-                n = int(hosts[b])
-                req = pod_req[b]
-                fits = not np.any(
-                    (req > 0) & (req_host[n] + req > alloc[n])
-                )
-                want = pod_ports[b]
-                ok_ports = not np.any(
-                    want & ((claimed[n].astype(np.int32) @ conflict) > 0)
-                )
-                if fits and ok_ports:
-                    hosts_out[b] = n
-                    req_host[n] += req
-                    nz_host[n] += pod_nz[b]
-                    claimed[n] |= want
-                    active[b] = False
-                else:
-                    # never re-pick the node that bounced you: progress
-                    # guarantee for the next round
-                    emask[b, n] = False
-                changed = True
-            if not changed:  # defensive; unreachable by construction
-                break
-
-        new_cluster = dataclasses.replace(
-            cluster,
-            requested=jnp.asarray(req_host),
-            nonzero_req=jnp.asarray(nz_host),
-        )
-        return jnp.asarray(hosts_out), new_cluster
+        else:
+            B, N = pods.valid.shape[0], cluster.valid.shape[0]
+            emask = (
+                np.ones((B, N), bool) if extra_mask is None
+                else np.asarray(extra_mask, bool)
+            )
+            esc = (
+                np.zeros((B, N), np.float32) if extra_score is None
+                else np.asarray(extra_score, np.float32)
+            )
+            hosts, req, nz = _packed_extras(meta)(
+                cluster, bufs, np.int32(last_index0), emask, esc
+            )
+        new_cluster = dataclasses.replace(cluster, requested=req, nonzero_req=nz)
+        return hosts, new_cluster
 
     return schedule
