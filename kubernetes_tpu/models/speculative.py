@@ -40,8 +40,8 @@ Transfer discipline (the tunnel bills per leaf AND per byte):
     (codec/transfer.py) — one RTT instead of ~60;
   * the cluster snapshot should be device-put ONCE by the caller and
     chained between batches (the returned new_cluster reuses the resident
-    static leaves); this module device-puts it on first sight as a
-    fallback.
+    static leaves) — bench.py does; the scheduler runtime uploads through
+    the encoder's incremental device-snapshot cache.
 
 Termination: each round every active pod is accepted (retired), infeasible
 (retired), or bounced (clears one emask bit) — bounded by B*N bit-clears.
@@ -126,7 +126,6 @@ def make_speculative_scheduler(
                 )
             total = total + escore
             hosts, feasible = select_hosts_batch(total, mask, c["li"])
-            feasible = feasible & jnp.any(mask, axis=1)
             prop = c["active"] & feasible            # proposers this round
             onehot = jax.nn.one_hot(hosts, N, dtype=jnp.float32)
             onehot = onehot * prop[:, None].astype(jnp.float32)  # [B, N]
@@ -211,11 +210,11 @@ def make_speculative_scheduler(
     @lru_cache(maxsize=64)
     def _packed_extras(meta):
         @jax.jit
-        def run(cluster, bufs, last_index0, emask0, escore):
-            pods, pod_ports, conflict = unpack_tree(bufs, meta)
+        def run(cluster, bufs, last_index0):
+            pods, pod_ports, conflict, emask0, escore = unpack_tree(bufs, meta)
             return _impl(
                 cluster, pods, pod_ports, conflict, last_index0,
-                emask0.astype(jnp.bool_), escore.astype(jnp.float32),
+                emask0, escore,
             )
 
         return run
@@ -227,8 +226,8 @@ def make_speculative_scheduler(
             "speculative engine handles the plain fast path; affinity/"
             "nominated batches take the sequential scan"
         )
-        bufs, meta = pack_tree((pods, ports.pod_ports, ports.conflict))
         if extra_mask is None and extra_score is None:
+            bufs, meta = pack_tree((pods, ports.pod_ports, ports.conflict))
             hosts, req, nz = _packed_plain(meta)(
                 cluster, bufs, np.int32(last_index0)
             )
@@ -242,8 +241,12 @@ def make_speculative_scheduler(
                 np.zeros((B, N), np.float32) if extra_score is None
                 else np.asarray(extra_score, np.float32)
             )
+            # the extras ride the same packed buffers (3 RTTs, not 3 + 2)
+            bufs, meta = pack_tree(
+                (pods, ports.pod_ports, ports.conflict, emask, esc)
+            )
             hosts, req, nz = _packed_extras(meta)(
-                cluster, bufs, np.int32(last_index0), emask, esc
+                cluster, bufs, np.int32(last_index0)
             )
         new_cluster = dataclasses.replace(cluster, requested=req, nonzero_req=nz)
         return hosts, new_cluster

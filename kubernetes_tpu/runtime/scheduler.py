@@ -134,6 +134,11 @@ class Scheduler:
             percentage_of_nodes_to_score=self.config.percentage_of_nodes_to_score,
         )
         self._schedule_fn = make_sequential_scheduler(**engine_kw)
+        # incremental host->device snapshot upload: unchanged fields reuse
+        # their resident device buffers between cycles (codec/transfer.py)
+        from kubernetes_tpu.codec.transfer import DeviceSnapshotCache
+
+        self._dev_snapshot = DeviceSnapshotCache()
         if self.config.engine == "speculative":
             from kubernetes_tpu.models.speculative import (
                 make_speculative_scheduler,
@@ -249,7 +254,8 @@ class Scheduler:
         ):
             fn = self._speculative_fn
         hosts, _ = fn(
-            cluster, batch, ports, np.int32(self._last_index), nominated,
+            self._dev_snapshot.update(cluster), batch, ports,
+            np.int32(self._last_index), nominated,
             extra_mask, extra_score, aff_state,
         )
         hosts = np.asarray(hosts)

@@ -1,0 +1,83 @@
+"""Packed transfers (codec/transfer.py): pack/unpack round-trip and the
+incremental DeviceSnapshotCache reuse semantics."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.codec.transfer import (
+    DeviceSnapshotCache,
+    pack_tree,
+    unpack_tree,
+)
+
+from fixtures import TEST_DIMS, make_node, make_pod
+
+
+def test_pack_unpack_roundtrip():
+    tree = {
+        "f": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "i": np.arange(6, dtype=np.int32).reshape(2, 3),
+        "b": np.array([[True, False], [False, True]]),
+        "i64": np.arange(4, dtype=np.int64),
+    }
+    bufs, meta = pack_tree(tree)
+    assert len(bufs) == 3
+
+    @jax.jit
+    def rt(bufs):
+        return unpack_tree(bufs, meta)
+
+    out = rt(bufs)
+    np.testing.assert_array_equal(np.asarray(out["f"]), tree["f"])
+    np.testing.assert_array_equal(np.asarray(out["i"]), tree["i"])
+    np.testing.assert_array_equal(np.asarray(out["b"]), tree["b"])
+    np.testing.assert_array_equal(np.asarray(out["i64"]), tree["i64"])
+    assert out["b"].dtype == jnp.bool_
+
+
+def test_pack_meta_is_jit_cache_stable():
+    a = {"x": np.zeros((4, 4), np.float32), "y": np.ones(3, np.int32)}
+    b = {"x": np.ones((4, 4), np.float32), "y": np.zeros(3, np.int32)}
+    _, ma = pack_tree(a)
+    _, mb = pack_tree(b)
+    assert ma == mb and hash(ma) == hash(mb)
+
+
+def test_device_snapshot_cache_reuses_unchanged_fields():
+    enc = SnapshotEncoder(TEST_DIMS)
+    for i in range(4):
+        enc.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    cache = DeviceSnapshotCache()
+    d1 = cache.update(enc.snapshot())
+    # a pod commit moves requested/nonzero but not the label/taint tensors
+    enc.add_pod(make_pod("p0", cpu="500m", mem="512Mi", node_name="n1"))
+    d2 = cache.update(enc.snapshot())
+    assert d2.label_keys is d1.label_keys          # resident buffer reused
+    assert d2.taint_key is d1.taint_key
+    assert d2.requested is not d1.requested        # changed -> re-uploaded
+    row = enc.node_rows["n1"]
+    assert np.asarray(d2.requested)[row, 0] == 500.0
+    # device contents always match a fresh full upload
+    full = enc.snapshot()
+    for f in dataclasses.fields(full):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(d2, f.name)), np.asarray(getattr(full, f.name)),
+            err_msg=f.name,
+        )
+
+
+def test_device_snapshot_cache_handles_regrow():
+    enc = SnapshotEncoder(TEST_DIMS)
+    enc.add_node(make_node("n0", cpu="4", mem="8Gi"))
+    cache = DeviceSnapshotCache()
+    d1 = cache.update(enc.snapshot())
+    n1 = d1.valid.shape[0]
+    for i in range(1, 3 * n1):  # force at least one node-arena regrow
+        enc.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    d2 = cache.update(enc.snapshot())
+    assert d2.valid.shape[0] > n1
+    assert int(np.asarray(d2.valid).sum()) == 3 * n1
