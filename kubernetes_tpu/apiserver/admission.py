@@ -1,0 +1,389 @@
+"""Admission plugins: the write-path policy chain.
+
+Reference: plugin/pkg/admission/* (23 plugins) wired through the generic
+admission chain (staging/src/k8s.io/apiserver/pkg/admission/chain.go).  A
+plugin here is a callable ``(op, kind, obj_dict) -> obj_dict`` — mutating
+plugins return a (possibly modified) dict, validating plugins raise
+``AdmissionDenied`` — the exact contract ``APIServer._admit`` runs for
+CREATE/UPDATE/DELETE before the registry strategy.
+
+Implemented plugins (each cites its reference):
+
+  NamespaceLifecycle        plugin/pkg/admission/namespace/lifecycle/admission.go
+  LimitRanger               plugin/pkg/admission/limitranger/admission.go
+  PodNodeSelector           plugin/pkg/admission/podnodeselector/admission.go
+  Priority                  plugin/pkg/admission/priority/admission.go
+  DefaultTolerationSeconds  plugin/pkg/admission/defaulttolerationseconds/admission.go
+  TaintNodesByCondition     plugin/pkg/admission/nodetaint/admission.go
+  ResourceQuota             plugin/pkg/admission/resourcequota/admission.go
+
+``default_admission_chain`` assembles them in the reference's recommended
+order (mutating before validating; ResourceQuota last —
+kubeapiserver/options/plugins.go).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.api.resource import Quantity, parse_quantity
+
+# the server's AdmissionDenied lives in server.py; import lazily to avoid a
+# cycle (server imports this module for the default chain)
+
+
+class AdmissionDenied(Exception):
+    """Raised by validating plugins; surfaced as HTTP 403 Forbidden."""
+
+
+# immortal namespaces (lifecycle/admission.go: v1.NamespaceDefault,
+# NamespaceSystem, NamespacePublic cannot be deleted)
+IMMORTAL_NAMESPACES = ("default", "kube-system", "kube-public")
+
+# built-in priority classes (scheduling.SystemCriticalPriority,
+# pkg/apis/scheduling/types.go:29-41)
+SYSTEM_PRIORITY_CLASSES = {
+    "system-node-critical": 2000001000,
+    "system-cluster-critical": 2000000000,
+}
+
+NAMESPACED_KINDS = (
+    "pods", "services", "replicasets", "deployments", "jobs", "endpoints",
+    "poddisruptionbudgets", "limitranges", "resourcequotas",
+)
+
+
+def _meta(obj: dict) -> dict:
+    return obj.setdefault("metadata", {})
+
+
+class NamespaceLifecycle:
+    """Reject writes into missing/terminating namespaces and deletion of the
+    immortal ones (lifecycle/admission.go:94-200)."""
+
+    def __init__(self, cluster) -> None:
+        self.cluster = cluster
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind == "namespaces":
+            if op == "DELETE" and _meta(obj).get("name") in IMMORTAL_NAMESPACES:
+                raise AdmissionDenied(
+                    f"namespace {_meta(obj)['name']!r} is immortal"
+                )
+            return obj
+        if op != "CREATE" or kind not in NAMESPACED_KINDS:
+            return obj
+        ns = _meta(obj).get("namespace", "default")
+        if ns in IMMORTAL_NAMESPACES:
+            return obj
+        rec = self.cluster.get("namespaces", "", ns)
+        if rec is None:
+            raise AdmissionDenied(f"namespace {ns!r} not found")
+        phase = ((rec.get("status") or {}).get("phase")) if isinstance(rec, dict) else ""
+        if phase == "Terminating":
+            raise AdmissionDenied(f"namespace {ns!r} is terminating")
+        return obj
+
+
+class LimitRanger:
+    """Apply LimitRange defaults and enforce min/max on pod containers
+    (limitranger/admission.go:287-344 mergePodResourceRequirements +
+    PodValidateLimitFunc)."""
+
+    def __init__(self, cluster) -> None:
+        self.cluster = cluster
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind != "pods" or op not in ("CREATE", "UPDATE"):
+            return obj
+        ns = _meta(obj).get("namespace", "default")
+        ranges = [
+            lr for lr in self.cluster.list("limitranges")
+            if lr.get("namespace") == ns
+        ]
+        if not ranges:
+            return obj
+        containers = (obj.get("spec") or {}).get("containers") or []
+        for lr in ranges:
+            for item in (lr.get("spec") or {}).get("limits") or []:
+                if item.get("type", "Container") != "Container":
+                    continue
+                d_req = item.get("defaultRequest") or {}
+                d_lim = item.get("default") or {}
+                lo = item.get("min") or {}
+                hi = item.get("max") or {}
+                for c in containers:
+                    res = c.setdefault("resources", {})
+                    req = res.setdefault("requests", {})
+                    lim = res.setdefault("limits", {})
+                    for k, v in d_req.items():
+                        req.setdefault(k, v)
+                    for k, v in d_lim.items():
+                        lim.setdefault(k, v)
+                        req.setdefault(k, v)  # request defaults to limit
+                    for k, v in lo.items():
+                        got = req.get(k)
+                        if got is not None and parse_quantity(got) < parse_quantity(v):
+                            raise AdmissionDenied(
+                                f"minimum {k} usage per Container is {v}"
+                            )
+                    for k, v in hi.items():
+                        got = lim.get(k) or req.get(k)
+                        if got is not None and parse_quantity(v) < parse_quantity(got):
+                            raise AdmissionDenied(
+                                f"maximum {k} usage per Container is {v}"
+                            )
+        return obj
+
+
+class PodNodeSelector:
+    """Merge the namespace's node-selector annotation into the pod; deny on
+    conflict (podnodeselector/admission.go:95-150)."""
+
+    ANNOTATION = "scheduler.alpha.kubernetes.io/node-selector"
+
+    def __init__(self, cluster) -> None:
+        self.cluster = cluster
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind != "pods" or op != "CREATE":
+            return obj
+        ns = _meta(obj).get("namespace", "default")
+        rec = self.cluster.get("namespaces", "", ns)
+        if not isinstance(rec, dict):
+            return obj
+        ann = ((rec.get("metadata") or {}).get("annotations") or {}).get(
+            self.ANNOTATION
+        )
+        if not ann:
+            return obj
+        ns_sel: Dict[str, str] = {}
+        for part in ann.split(","):
+            part = part.strip()
+            if part:
+                k, _, v = part.partition("=")
+                ns_sel[k.strip()] = v.strip()
+        spec = obj.setdefault("spec", {})
+        sel = spec.setdefault("nodeSelector", {})
+        for k, v in ns_sel.items():
+            if k in sel and sel[k] != v:
+                raise AdmissionDenied(
+                    f"pod node label selector conflicts with namespace "
+                    f"node label selector for key {k!r}"
+                )
+            sel[k] = v
+        return obj
+
+
+def _pc_field(pc: dict, field: str, default=None):
+    """PriorityClass fields live at the top level on the wire (scheduling/
+    v1beta1 has no spec), but accept a spec-nested form too — resolution
+    must read wherever validation accepted."""
+    if field in pc:
+        return pc[field]
+    return (pc.get("spec") or {}).get(field, default)
+
+
+class Priority:
+    """Resolve priorityClassName -> spec.priority
+    (priority/admission.go:106-179): unknown class is denied; empty falls
+    back to the globalDefault class or 0."""
+
+    def __init__(self, cluster) -> None:
+        self.cluster = cluster
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind == "priorityclasses" and op in ("CREATE", "UPDATE"):
+            if _pc_field(obj, "value") is None:
+                raise AdmissionDenied("priority class needs a value")
+            return obj
+        if kind != "pods" or op != "CREATE":
+            return obj
+        spec = obj.setdefault("spec", {})
+        name = spec.get("priorityClassName", "")
+        if name:
+            if name in SYSTEM_PRIORITY_CLASSES:
+                spec["priority"] = SYSTEM_PRIORITY_CLASSES[name]
+                return obj
+            pc = self.cluster.get("priorityclasses", "", name)
+            if pc is None:
+                raise AdmissionDenied(
+                    f"no PriorityClass with name {name} was found"
+                )
+            spec["priority"] = int(_pc_field(pc, "value", 0))
+            return obj
+        if "priority" in spec:
+            return obj
+        default = 0
+        for pc in self.cluster.list("priorityclasses"):
+            if _pc_field(pc, "globalDefault"):
+                default = int(_pc_field(pc, "value", 0))
+                break
+        spec["priority"] = default
+        return obj
+
+
+class DefaultTolerationSeconds:
+    """Add the 300s not-ready/unreachable NoExecute tolerations unless the
+    pod already tolerates those taints
+    (defaulttolerationseconds/admission.go:78-119)."""
+
+    NOT_READY = "node.kubernetes.io/not-ready"
+    UNREACHABLE = "node.kubernetes.io/unreachable"
+    SECONDS = 300
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind != "pods" or op not in ("CREATE", "UPDATE"):
+            return obj
+        spec = obj.setdefault("spec", {})
+        tols = spec.setdefault("tolerations", [])
+        have = {t.get("key") for t in tols if isinstance(t, dict)}
+        wildcard = any(
+            isinstance(t, dict) and not t.get("key")
+            and t.get("operator") == "Exists" for t in tols
+        )
+        for key in (self.NOT_READY, self.UNREACHABLE):
+            if wildcard or key in have:
+                continue
+            tols.append({
+                "key": key,
+                "operator": "Exists",
+                "effect": "NoExecute",
+                "tolerationSeconds": self.SECONDS,
+            })
+        return obj
+
+
+class TaintNodesByCondition:
+    """Taint fresh nodes not-ready:NoSchedule so nothing lands before the
+    node reports Ready (nodetaint/admission.go:69-94; the nodelifecycle
+    controller removes it)."""
+
+    NOT_READY = "node.kubernetes.io/not-ready"
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind != "nodes" or op != "CREATE":
+            return obj
+        spec = obj.setdefault("spec", {})
+        taints = spec.setdefault("taints", [])
+        if not any(
+            t.get("key") == self.NOT_READY and t.get("effect") == "NoSchedule"
+            for t in taints if isinstance(t, dict)
+        ):
+            taints.append({"key": self.NOT_READY, "effect": "NoSchedule"})
+        return obj
+
+
+# quota resource names -> how to charge a pod for them
+# (resourcequota/evaluator/core/pods.go podUsageHelper)
+_QUOTA_POD_RESOURCES = (
+    "pods", "cpu", "memory", "requests.cpu", "requests.memory",
+    "limits.cpu", "limits.memory",
+)
+
+
+def _pod_charge(spec: dict, resource: str) -> Quantity:
+    """How much a pod wire spec charges against a quota resource."""
+    if resource == "pods":
+        return parse_quantity(1)
+    bucket, _, plain = resource.partition(".")
+    if not plain:  # bare "cpu"/"memory" count requests (pods.go:282-297)
+        bucket, plain = "requests", resource
+    total = parse_quantity(0)
+    for c in spec.get("containers") or []:
+        res = (c.get("resources") or {}).get(bucket) or {}
+        if plain in res:
+            total = total + parse_quantity(res[plain])
+    return total
+
+
+def _pod_object_charge(pod, resource: str) -> Quantity:
+    """_pod_charge for a decoded Pod object (no wire-dict rebuild)."""
+    if resource == "pods":
+        return parse_quantity(1)
+    bucket, _, plain = resource.partition(".")
+    if not plain:
+        bucket, plain = "requests", resource
+    total = parse_quantity(0)
+    for c in pod.spec.containers:
+        d = c.requests if bucket == "requests" else c.limits
+        if plain in d:
+            total = total + d[plain]
+    return total
+
+
+def quota_usage(cluster, ns: str, resources) -> Dict[str, Quantity]:
+    """Live usage of the tracked quota resources: ONE pass over the pod
+    list, charging every resource at once (non-terminal pods only — the
+    quota controller's replenishment semantics)."""
+    totals = {r: parse_quantity(0) for r in resources}
+    for p in cluster.list("pods"):
+        if p.namespace != ns or p.status.phase in ("Succeeded", "Failed"):
+            continue
+        for r in resources:
+            totals[r] = totals[r] + _pod_object_charge(p, r)
+    return totals
+
+
+class ResourceQuota:
+    """Enforce ResourceQuota hard limits on pod creation
+    (resourcequota/controller.go checkRequest): live usage is recomputed
+    from non-terminal pods in the namespace, matching the quota
+    controller's replenishment semantics."""
+
+    def __init__(self, cluster) -> None:
+        self.cluster = cluster
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind != "pods" or op != "CREATE":
+            return obj
+        ns = _meta(obj).get("namespace", "default")
+        quotas = [
+            q for q in self.cluster.list("resourcequotas")
+            if q.get("namespace") == ns
+        ]
+        if not quotas:
+            return obj
+        spec = obj.get("spec") or {}
+        tracked = {
+            rname
+            for q in quotas
+            for rname in ((q.get("spec") or {}).get("hard") or {})
+            if rname in _QUOTA_POD_RESOURCES
+        }
+        used = quota_usage(self.cluster, ns, tracked)
+        for q in quotas:
+            hard = (q.get("spec") or {}).get("hard") or {}
+            for rname, cap in hard.items():
+                if rname not in _QUOTA_POD_RESOURCES:
+                    continue
+                want = _pod_charge(spec, rname)
+                if float(want) == 0 and rname != "pods":
+                    # quota-limited resources REQUIRE a request
+                    # (checkRequest: "must specify <r>")
+                    raise AdmissionDenied(
+                        f"failed quota: {q.get('name')}: must specify {rname}"
+                    )
+                if parse_quantity(cap) < used[rname] + want:
+                    raise AdmissionDenied(
+                        f"exceeded quota: {q.get('name')}, requested: "
+                        f"{rname}={want}, used: {rname}={used[rname]}, "
+                        f"limited: {rname}={cap}"
+                    )
+        return obj
+
+
+def default_admission_chain(cluster) -> List[Callable]:
+    """The enabled-by-default chain in reference order
+    (pkg/kubeapiserver/options/plugins.go:43-77: NamespaceLifecycle,
+    LimitRanger, ..., Priority, DefaultTolerationSeconds, TaintNodesBy
+    Condition, ..., ResourceQuota last)."""
+    return [
+        NamespaceLifecycle(cluster),
+        LimitRanger(cluster),
+        PodNodeSelector(cluster),
+        Priority(cluster),
+        DefaultTolerationSeconds(),
+        TaintNodesByCondition(),
+        ResourceQuota(cluster),
+    ]

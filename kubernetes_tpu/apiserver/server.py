@@ -40,11 +40,25 @@ LIST_KINDS = {"pods": "PodList", "nodes": "NodeList",
               "replicasets": "ReplicaSetList", "services": "ServiceList",
               "deployments": "DeploymentList",
               "poddisruptionbudgets": "PodDisruptionBudgetList",
-              "endpoints": "EndpointsList"}
+              "endpoints": "EndpointsList",
+              "namespaces": "NamespaceList",
+              "limitranges": "LimitRangeList",
+              "resourcequotas": "ResourceQuotaList",
+              "priorityclasses": "PriorityClassList"}
+
+# kinds stored as plain dicts carrying the original wire body plus flat
+# namespace/name keys for the store (cluster-scoped kinds use "")
+_DICT_KINDS = {
+    "namespaces": "",          # cluster-scoped
+    "priorityclasses": "",     # cluster-scoped
+    "limitranges": "default",
+    "resourcequotas": "default",
+}
 
 
-class AdmissionDenied(Exception):
-    """An admission plugin rejected the write (HTTP 403)."""
+# the canonical exception lives with the plugins; re-exported here so
+# handler code and external callers share one type
+from kubernetes_tpu.apiserver.admission import AdmissionDenied  # noqa: E402
 
 
 def _decode(kind: str, d: dict):
@@ -109,6 +123,16 @@ def _decode(kind: str, d: dict):
             "name": meta.get("name", ""),
             "selector": dict((d.get("spec") or {}).get("selector") or {}),
         }
+    if kind in _DICT_KINDS:
+        meta = d.get("metadata") or {}
+        out = dict(d)
+        out["name"] = d.get("name") or meta.get("name", "")
+        default_ns = _DICT_KINDS[kind]
+        out["namespace"] = (
+            "" if default_ns == ""
+            else (d.get("namespace") or meta.get("namespace", default_ns))
+        )
+        return out
     raise ValueError(f"unknown kind {kind!r}")
 
 
@@ -451,7 +475,10 @@ class APIServer:
                     return
                 try:
                     body = outer._admit("UPDATE", kind, body)
-                    expect = (body.get("metadata") or {}).get("resourceVersion")
+                    meta = body.setdefault("metadata", {})
+                    if ns and not meta.get("namespace"):
+                        meta["namespace"] = ns  # path ns wins, as on POST
+                    expect = meta.get("resourceVersion")
                     obj = _decode(kind, body)
                     if kind in ("replicasets", "deployments") and not (
                         (body.get("metadata") or {}).get("uid")
@@ -484,11 +511,40 @@ class APIServer:
                 if kind not in LIST_KINDS:
                     self._status(404, "NotFound", f"unknown resource {kind}")
                     return
-                if outer.cluster.get(kind, ns if kind != "nodes" else "",
-                                     name) is None:
+                store_ns = "" if kind in ("nodes",) or (
+                    kind in _DICT_KINDS and _DICT_KINDS[kind] == ""
+                ) else ns
+                cur = outer.cluster.get(kind, store_ns, name)
+                if cur is None:
                     self._status(404, "NotFound", f"{kind} {ns}/{name}")
                     return
-                outer.cluster.delete(kind, ns, name)
+                try:
+                    outer._admit(
+                        "DELETE", kind,
+                        {"metadata": {"namespace": store_ns, "name": name}},
+                    )
+                except AdmissionDenied as e:
+                    self._status(403, "Forbidden", str(e))
+                    return
+                if kind == "namespaces":
+                    # graceful namespace teardown: flip to Terminating and
+                    # let the namespace controller empty + finalize it
+                    # (pkg/registry/core/namespace strategy +
+                    # pkg/controller/namespace)
+                    obj = dict(cur) if isinstance(cur, dict) else cur
+                    status = dict(obj.get("status") or {})
+                    if status.get("phase") != "Terminating":
+                        obj = dict(obj)
+                        obj["status"] = {**status, "phase": "Terminating"}
+                        try:
+                            outer.cluster.update(kind, obj)
+                        except ConflictError:
+                            # the controller finalized it between our GET
+                            # and UPDATE — deletion already done
+                            pass
+                    self._status(200, "Success", "namespace terminating")
+                    return
+                outer.cluster.delete(kind, store_ns, name)
                 self._status(200, "Success", "deleted")
 
         return Handler

@@ -34,6 +34,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--with-scheduler", action="store_true")
     p.add_argument("--with-controllers", action="store_true")
     p.add_argument("--hollow-nodes", type=int, default=0)
+    p.add_argument(
+        "--disable-admission", action="store_true",
+        help="skip the default admission chain (NamespaceLifecycle, "
+        "LimitRanger, PodNodeSelector, Priority, DefaultTolerationSeconds, "
+        "TaintNodesByCondition, ResourceQuota)",
+    )
     return p
 
 
@@ -45,7 +51,14 @@ def main(argv=None) -> int:
     from kubernetes_tpu.runtime.cluster import LocalCluster
 
     cluster = LocalCluster()
-    srv = APIServer(cluster=cluster, host=args.host, port=args.port).start()
+    admission = None
+    if not args.disable_admission:
+        from kubernetes_tpu.apiserver.admission import default_admission_chain
+
+        admission = default_admission_chain(cluster)
+    srv = APIServer(
+        cluster=cluster, host=args.host, port=args.port, admission=admission
+    ).start()
     print(f"apiserver on {srv.url}", file=sys.stderr)
 
     sched = cm = None

@@ -31,6 +31,14 @@ KIND_PATHS = {
     "pdb": "/apis/policy/v1beta1/namespaces/{ns}/poddisruptionbudgets",
     "endpoints": "/api/v1/namespaces/{ns}/endpoints",
     "services": "/api/v1/namespaces/{ns}/services",
+    "namespaces": "/api/v1/namespaces",
+    "ns": "/api/v1/namespaces",
+    "limitranges": "/api/v1/namespaces/{ns}/limitranges",
+    "limits": "/api/v1/namespaces/{ns}/limitranges",
+    "resourcequotas": "/api/v1/namespaces/{ns}/resourcequotas",
+    "quota": "/api/v1/namespaces/{ns}/resourcequotas",
+    "priorityclasses": "/api/v1/priorityclasses",
+    "pc": "/api/v1/priorityclasses",
 }
 
 
@@ -57,6 +65,18 @@ def _req(server: str, method: str, path: str, payload=None) -> dict:
 def _path(kind: str, ns: str, name: str = "") -> str:
     base = KIND_PATHS[kind].format(ns=ns)
     return f"{base}/{name}" if name else base
+
+
+def _plural(k: str) -> str:
+    """Wire-kind -> resource plural.  Lookup beats heuristics: Endpoints is
+    already plural, PriorityClass ends in 's' but is singular."""
+    if k in KIND_PATHS:
+        return k
+    if k + "s" in KIND_PATHS:
+        return k + "s"
+    if k + "es" in KIND_PATHS:
+        return k + "es"
+    return k if k.endswith("s") else k + "s"
 
 
 def _pod_row(p: dict):
@@ -151,14 +171,14 @@ def main(argv=None) -> int:
         with open(args.filename) as f:
             obj = json.load(f)
         k = obj.get("kind", "Pod").lower()
-        kind = k if k.endswith("s") else k + "s"  # Endpoints stays Endpoints
+        kind = _plural(k)
         obj_ns = (obj.get("metadata") or {}).get("namespace") or ns
         out = _req(args.server, "POST", _path(kind, obj_ns), obj)
         if out.get("kind") == "Status" and out.get("code", 201) >= 400:
             print(out.get("message", ""), file=sys.stderr)
             return 1
         name = (out.get("metadata") or {}).get("name", "")
-        print(f"{kind[:-1]}/{name} created")
+        print(f"{k}/{name} created")
         return 0
 
     if args.verb == "delete":
@@ -195,7 +215,7 @@ def main(argv=None) -> int:
         with open(args.filename) as f:
             obj = json.load(f)
         k = obj.get("kind", "Pod").lower()
-        kind = k if k.endswith("s") else k + "s"
+        kind = _plural(k)
         obj_ns = (obj.get("metadata") or {}).get("namespace") or ns
         name = (obj.get("metadata") or {}).get("name", "")
         out = _req(args.server, "POST", _path(kind, obj_ns), obj)
@@ -204,12 +224,12 @@ def main(argv=None) -> int:
             if out.get("kind") == "Status" and out.get("code", 200) >= 400:
                 print(out.get("message", ""), file=sys.stderr)
                 return 1
-            print(f"{kind[:-1]}/{name} configured")
+            print(f"{k}/{name} configured")
             return 0
         if out.get("kind") == "Status" and out.get("code", 201) >= 400:
             print(out.get("message", ""), file=sys.stderr)
             return 1
-        print(f"{kind[:-1]}/{name} created")
+        print(f"{k}/{name} created")
         return 0
 
     if args.verb == "bind":

@@ -46,7 +46,9 @@ class _Stored:
 
 class LocalCluster:
     KINDS = ("nodes", "pods", "services", "leases", "replicasets",
-             "poddisruptionbudgets", "endpoints", "deployments", "jobs")
+             "poddisruptionbudgets", "endpoints", "deployments", "jobs",
+             "namespaces", "limitranges", "resourcequotas",
+             "priorityclasses")
 
     def __init__(self) -> None:
         self._lock = threading.RLock()

@@ -53,6 +53,7 @@ from kubernetes_tpu.runtime.cluster import (
 )
 
 TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
+TAINT_NOT_READY = "node.kubernetes.io/not-ready"
 LEASE_NAMESPACE = "kube-node-lease"
 
 
@@ -312,6 +313,10 @@ class NodeLifecycleController:
     def _is_tainted(node: Node) -> bool:
         return any(t.key == TAINT_UNREACHABLE for t in node.spec.taints)
 
+    @staticmethod
+    def _has_not_ready(node: Node) -> bool:
+        return any(t.key == TAINT_NOT_READY for t in node.spec.taints)
+
     def monitor(self, now: Optional[float] = None) -> None:
         now = time.monotonic() if now is None else now
         for node in self.cluster.list("nodes"):
@@ -326,7 +331,15 @@ class NodeLifecycleController:
                     # that slipped onto an already-tainted node (bind raced
                     # the taint) goes next tick
                     self._evict_pods(node)
-            elif age <= self.grace and self._is_tainted(node):
+            elif age <= self.grace and (
+                self._is_tainted(node) or self._has_not_ready(node)
+            ):
+                # a heartbeating node sheds BOTH condition taints: the
+                # unreachable pair this controller added and the
+                # registration not-ready taint the TaintNodesByCondition
+                # admission plugin added (nodetaint/admission.go — the
+                # reference's nodelifecycle reconciles condition taints,
+                # nodelifecycle/node_lifecycle_controller.go taintMap)
                 self._restore(node)
 
     def _mark_unreachable(self, node: Node) -> None:
@@ -371,7 +384,8 @@ class NodeLifecycleController:
             spec=dataclasses.replace(
                 node.spec,
                 taints=tuple(
-                    t for t in node.spec.taints if t.key != TAINT_UNREACHABLE
+                    t for t in node.spec.taints
+                    if t.key not in (TAINT_UNREACHABLE, TAINT_NOT_READY)
                 ),
             ),
             status=dataclasses.replace(
@@ -414,6 +428,10 @@ class ControllerManager:
         from kubernetes_tpu.runtime.network import EndpointsController
 
         self.endpoints = EndpointsController(cluster)
+        self.namespace = NamespaceController(cluster)
+        self.gc = GarbageCollector(cluster)
+        self.podgc = PodGCController(cluster)
+        self.quota = ResourceQuotaController(cluster)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -426,6 +444,18 @@ class ControllerManager:
         self._threads += self.deployment.run(self._stop)
         self._threads += self.job.run(self._stop)
         self._threads += self.endpoints.run(self._stop)
+        self._threads += self.namespace.run(self._stop)
+        self._threads += self.gc.run(self._stop)
+        self._threads.append(self.podgc.run(self._stop))
+        self._threads += self.quota.run(self._stop)
+
+        def gc_resweep():
+            while not self._stop.wait(30.0):
+                self.gc.sweep_all()
+
+        t = threading.Thread(target=gc_resweep, daemon=True)
+        t.start()
+        self._threads.append(t)
 
     def stop(self) -> None:
         self._stop.set()
@@ -434,6 +464,9 @@ class ControllerManager:
         self.deployment.queue.close()
         self.job.queue.close()
         self.endpoints.queue.close()
+        self.namespace.queue.close()
+        self.gc.queue.close()
+        self.quota.queue.close()
 
 
 # ---------------------------------------------------------------- disruption
@@ -809,3 +842,200 @@ class JobController(Reconciler):
 
 def add_job(cluster: LocalCluster, job: Job) -> None:
     cluster.create("jobs", job)
+
+
+# ---------------------------------------------------------------- namespace
+
+
+# every namespaced kind the deletion sweep must empty (the reference
+# discovers these dynamically; pkg/controller/namespace/deletion/
+# namespaced_resources_deleter.go:388-480) — single source of truth shared
+# with the NamespaceLifecycle admission plugin
+from kubernetes_tpu.apiserver.admission import NAMESPACED_KINDS  # noqa: E402
+
+
+class NamespaceController(Reconciler):
+    """pkg/controller/namespace: a namespace in phase Terminating is emptied
+    of every namespaced object, then removed from the store (the finalizer
+    step).  The API server only flips the phase; this controller does the
+    actual teardown."""
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind != "namespaces" or event == "DELETED":
+            return
+        name = obj.get("name") if isinstance(obj, dict) else None
+        if name:
+            self.queue.add(name)
+
+    def sync(self, key) -> None:
+        ns = self.cluster.get("namespaces", "", key)
+        if ns is None or not isinstance(ns, dict):
+            return
+        if (ns.get("status") or {}).get("phase") != "Terminating":
+            return
+        def contents():
+            found = []
+            for kind in NAMESPACED_KINDS:
+                for obj in self.cluster.list(kind):
+                    obj_ns = (
+                        obj.get("namespace") if isinstance(obj, dict)
+                        else getattr(obj, "namespace", "")
+                    )
+                    if obj_ns != key:
+                        continue
+                    obj_name = (
+                        obj.get("name") if isinstance(obj, dict)
+                        else getattr(obj, "name", "")
+                    )
+                    found.append((kind, obj_name))
+            return found
+
+        for kind, obj_name in contents():
+            self.cluster.delete(kind, key, obj_name)
+        # finalize only against an observed-empty namespace: deletes fan out
+        # watch events that may create more work (an RS observed mid-delete
+        # re-creating pods), so re-check and requeue until quiescent
+        if contents():
+            raise RuntimeError("namespace not yet empty; requeue")
+        self.cluster.delete("namespaces", "", key)
+
+
+# --------------------------------------------------------- garbage collector
+
+
+class GarbageCollector(Reconciler):
+    """pkg/controller/garbagecollector: cascade deletion through
+    ownerReferences.  The object model flattens the controller ownerRef to
+    (owner_kind, owner_uid) on pods and RS/Deployment records; when an
+    owner disappears, its dependents are deleted (background propagation
+    policy, the default).
+
+    The RS/Deployment/Job reconcilers already cascade their own dependents
+    promptly; this controller is the ownerRef BACKSTOP (the reference's
+    controllers rely on the GC entirely) — it reacts to owner deletions
+    and resweeps periodically via sweep_all() so a dependent created after
+    its owner's DELETED event is still collected.  Deletes are idempotent,
+    so racing the per-controller cascades is harmless."""
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if event == "DELETED" and kind in ("replicasets", "deployments", "jobs"):
+            self.queue.add(("sweep", kind))
+
+    def sweep_all(self) -> None:
+        """Periodic full resweep (graph_builder's monitors resync analog)."""
+        for kind in ("replicasets", "deployments", "jobs"):
+            self.queue.add(("sweep", kind))
+
+    def _owner_uids(self, kind: str) -> set:
+        return {getattr(o, "uid", "") for o in self.cluster.list(kind)}
+
+    def sync(self, key) -> None:
+        _, owner_kind = key
+        live = self._owner_uids(owner_kind)
+        if owner_kind == "replicasets":
+            owner_name = "ReplicaSet"
+        elif owner_kind == "deployments":
+            owner_name = "Deployment"
+        else:
+            owner_name = "Job"
+        if owner_kind == "deployments":
+            # Deployment -> ReplicaSet edge: orphaned RSes cascade (their
+            # own deletion events then sweep their pods)
+            for rs in list(self.cluster.list("replicasets")):
+                if rs.owner_uid and rs.owner_uid not in live:
+                    self.cluster.delete("replicasets", rs.namespace, rs.name)
+            return
+        for pod in list(self.cluster.list("pods")):
+            ou = pod.metadata.owner_uid
+            if (
+                ou
+                and pod.metadata.owner_kind == owner_name
+                and ou not in live
+            ):
+                self.cluster.delete("pods", pod.namespace, pod.name)
+
+
+# ------------------------------------------------------------------- pod GC
+
+
+class PodGCController:
+    """pkg/controller/podgc: periodically delete (a) terminated pods beyond
+    a threshold, oldest first, and (b) pods bound to nodes that no longer
+    exist (gc_controller.go:152-197 gcTerminated / gcOrphaned)."""
+
+    def __init__(self, cluster: LocalCluster, terminated_threshold: int = 12500):
+        self.cluster = cluster
+        self.threshold = terminated_threshold
+
+    def gc_once(self) -> int:
+        deleted = 0
+        nodes = {n.name for n in self.cluster.list("nodes")}
+        terminated = []
+        for pod in list(self.cluster.list("pods")):
+            if pod.spec.node_name and pod.spec.node_name not in nodes:
+                self.cluster.delete("pods", pod.namespace, pod.name)
+                deleted += 1
+                continue
+            if pod.status.phase in ("Succeeded", "Failed"):
+                terminated.append(pod)
+        excess = len(terminated) - self.threshold
+        if excess > 0:
+            terminated.sort(key=lambda p: p.status.start_time or 0.0)
+            for pod in terminated[:excess]:
+                self.cluster.delete("pods", pod.namespace, pod.name)
+                deleted += 1
+        return deleted
+
+    def run(self, stop: threading.Event, period: float = 20.0) -> threading.Thread:
+        def loop():
+            while not stop.wait(period):
+                self.gc_once()
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t
+
+
+# ------------------------------------------------------------ resourcequota
+
+
+class ResourceQuotaController(Reconciler):
+    """pkg/controller/resourcequota: keeps each quota's status.used in sync
+    with live usage (the admission plugin enforces; this controller
+    reports)."""
+
+    _RESOURCES = (
+        "pods", "cpu", "memory", "requests.cpu", "requests.memory",
+        "limits.cpu", "limits.memory",
+    )
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "resourcequotas":
+            if isinstance(obj, dict):
+                self.queue.add((obj.get("namespace", ""), obj.get("name", "")))
+        elif kind == "pods":
+            ns = (
+                obj.get("namespace") if isinstance(obj, dict)
+                else getattr(obj, "namespace", "")
+            )
+            for q in self.cluster.list("resourcequotas"):
+                if q.get("namespace") == ns:
+                    self.queue.add((ns, q.get("name", "")))
+
+    def sync(self, key) -> None:
+        from kubernetes_tpu.apiserver.admission import quota_usage
+
+        ns, name = key
+        q, rv = self.cluster.get_with_rv("resourcequotas", ns, name)
+        if q is None:
+            return
+        hard = (q.get("spec") or {}).get("hard") or {}
+        tracked = [r for r in hard if r in self._RESOURCES]
+        used = {
+            r: str(v) for r, v in quota_usage(self.cluster, ns, tracked).items()
+        }
+        status = dict(q.get("status") or {})
+        if status.get("used") != used:
+            new = dict(q)
+            new["status"] = {**status, "hard": dict(hard), "used": used}
+            self.cluster.update("resourcequotas", new, expect_rv=rv)

@@ -1,0 +1,316 @@
+"""Admission plugin chain (plugin/pkg/admission analogs) + the namespace /
+garbage-collection / quota controllers that complete their stories."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apiserver.admission import (
+    AdmissionDenied,
+    DefaultTolerationSeconds,
+    LimitRanger,
+    NamespaceLifecycle,
+    PodNodeSelector,
+    Priority,
+    ResourceQuota,
+    TaintNodesByCondition,
+    default_admission_chain,
+)
+from kubernetes_tpu.runtime.cluster import LocalCluster
+from kubernetes_tpu.runtime.controllers import (
+    GarbageCollector,
+    NamespaceController,
+    PodGCController,
+    ReplicaSet,
+    ResourceQuotaController,
+)
+
+from fixtures import make_node, make_pod
+
+
+def _pod_dict(name, ns="default", cpu=None, priority_class=None, **kw):
+    resources = {}
+    if cpu:
+        resources = {"requests": {"cpu": cpu, "memory": "64Mi"}}
+    d = {
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "containers": [{"name": "c", "image": "img", "resources": resources}],
+        },
+    }
+    if priority_class:
+        d["spec"]["priorityClassName"] = priority_class
+    d["spec"].update(kw)
+    return d
+
+
+# ------------------------------------------------------------------ priority
+
+
+def test_priority_resolves_class_and_default():
+    cluster = LocalCluster()
+    cluster.create("priorityclasses",
+                   {"namespace": "", "name": "high", "value": 1000})
+    cluster.create("priorityclasses",
+                   {"namespace": "", "name": "base", "value": 7,
+                    "globalDefault": True})
+    p = Priority(cluster)
+    out = p("CREATE", "pods", _pod_dict("a", priority_class="high"))
+    assert out["spec"]["priority"] == 1000
+    out = p("CREATE", "pods", _pod_dict("b"))
+    assert out["spec"]["priority"] == 7
+    out = p("CREATE", "pods",
+            _pod_dict("c", priority_class="system-node-critical"))
+    assert out["spec"]["priority"] == 2000001000
+    with pytest.raises(AdmissionDenied):
+        p("CREATE", "pods", _pod_dict("d", priority_class="nope"))
+
+
+# --------------------------------------------------------------- limitranger
+
+
+def test_limitranger_defaults_and_max():
+    cluster = LocalCluster()
+    cluster.create("limitranges", {
+        "namespace": "default", "name": "lr",
+        "spec": {"limits": [{
+            "type": "Container",
+            "defaultRequest": {"cpu": "100m"},
+            "default": {"memory": "256Mi"},
+            "max": {"cpu": "2"},
+        }]},
+    })
+    lr = LimitRanger(cluster)
+    out = lr("CREATE", "pods", _pod_dict("a"))
+    c = out["spec"]["containers"][0]["resources"]
+    assert c["requests"]["cpu"] == "100m"
+    assert c["limits"]["memory"] == "256Mi"
+    assert c["requests"]["memory"] == "256Mi"  # request defaults to limit
+    with pytest.raises(AdmissionDenied):
+        lr("CREATE", "pods", _pod_dict("b", cpu="3"))
+
+
+# ------------------------------------------------------------- resourcequota
+
+
+def test_resourcequota_admission_and_status_controller():
+    cluster = LocalCluster()
+    cluster.create("resourcequotas", {
+        "namespace": "default", "name": "rq",
+        "spec": {"hard": {"pods": "2", "requests.cpu": "1"}},
+    })
+    rq = ResourceQuota(cluster)
+    rq("CREATE", "pods", _pod_dict("a", cpu="500m"))
+    cluster.add_pod(make_pod("a", cpu="500m", mem="64Mi"))
+    # cpu exhausted: 500m used + 600m > 1
+    with pytest.raises(AdmissionDenied):
+        rq("CREATE", "pods", _pod_dict("b", cpu="600m"))
+    # quota-limited resources must be requested explicitly
+    with pytest.raises(AdmissionDenied):
+        rq("CREATE", "pods", _pod_dict("c"))
+    cluster.add_pod(make_pod("b", cpu="100m", mem="64Mi"))
+    # pods count exhausted
+    with pytest.raises(AdmissionDenied):
+        rq("CREATE", "pods", _pod_dict("d", cpu="100m"))
+
+    ctrl = ResourceQuotaController(cluster)
+    while ctrl.process_one(timeout=0):
+        pass
+    q = cluster.get("resourcequotas", "default", "rq")
+    assert q["status"]["used"]["pods"] == "2"
+    assert q["status"]["used"]["requests.cpu"] == "0.6"
+
+
+# -------------------------------------------------------- namespace lifecycle
+
+
+def test_namespace_lifecycle_and_controller():
+    cluster = LocalCluster()
+    nl = NamespaceLifecycle(cluster)
+    # unknown namespace -> denied; default is immortal/implicit
+    nl("CREATE", "pods", _pod_dict("a"))
+    with pytest.raises(AdmissionDenied):
+        nl("CREATE", "pods", _pod_dict("b", ns="ghost"))
+    cluster.create("namespaces", {"namespace": "", "name": "team"})
+    nl("CREATE", "pods", _pod_dict("c", ns="team"))
+    with pytest.raises(AdmissionDenied):
+        nl("DELETE", "namespaces", {"metadata": {"name": "kube-system"}})
+    # terminating namespace rejects creates and the controller empties it
+    cluster.add_pod(make_pod("doomed", cpu="10m", mem="1Mi", namespace="team"))
+    ns_obj = dict(cluster.get("namespaces", "", "team"))
+    ns_obj["status"] = {"phase": "Terminating"}
+    cluster.update("namespaces", ns_obj)
+    with pytest.raises(AdmissionDenied):
+        nl("CREATE", "pods", _pod_dict("late", ns="team"))
+    ctrl = NamespaceController(cluster)
+    for _ in range(4):
+        if not ctrl.process_one(timeout=0):
+            break
+    assert cluster.get("pods", "team", "doomed") is None
+    assert cluster.get("namespaces", "", "team") is None
+
+
+# ----------------------------------------------- toleration seconds / taints
+
+
+def test_default_toleration_seconds():
+    out = DefaultTolerationSeconds()("CREATE", "pods", _pod_dict("a"))
+    keys = {t["key"]: t for t in out["spec"]["tolerations"]}
+    assert keys["node.kubernetes.io/not-ready"]["tolerationSeconds"] == 300
+    assert keys["node.kubernetes.io/unreachable"]["effect"] == "NoExecute"
+    # existing toleration for the key is preserved, not duplicated
+    d = _pod_dict("b", tolerations=[
+        {"key": "node.kubernetes.io/not-ready", "operator": "Exists"}
+    ])
+    out = DefaultTolerationSeconds()("CREATE", "pods", d)
+    nr = [t for t in out["spec"]["tolerations"]
+          if t["key"] == "node.kubernetes.io/not-ready"]
+    assert len(nr) == 1 and "tolerationSeconds" not in nr[0]
+
+
+def test_taint_nodes_by_condition():
+    out = TaintNodesByCondition()("CREATE", "nodes",
+                                  {"metadata": {"name": "n"}, "spec": {}})
+    assert {"key": "node.kubernetes.io/not-ready",
+            "effect": "NoSchedule"} in out["spec"]["taints"]
+
+
+def test_pod_node_selector_merge_and_conflict():
+    cluster = LocalCluster()
+    cluster.create("namespaces", {
+        "namespace": "", "name": "restricted",
+        "metadata": {"name": "restricted", "annotations": {
+            PodNodeSelector.ANNOTATION: "tier=gold, region=us"
+        }},
+    })
+    pns = PodNodeSelector(cluster)
+    out = pns("CREATE", "pods", _pod_dict("a", ns="restricted"))
+    assert out["spec"]["nodeSelector"] == {"tier": "gold", "region": "us"}
+    with pytest.raises(AdmissionDenied):
+        pns("CREATE", "pods",
+            _pod_dict("b", ns="restricted", nodeSelector={"tier": "bronze"}))
+
+
+# ------------------------------------------------------------------ REST e2e
+
+
+def _req(url, method="GET", payload=None):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_rest_admission_chain_end_to_end():
+    cluster = LocalCluster()
+    srv = APIServer(
+        cluster=cluster, admission=default_admission_chain(cluster)
+    ).start()
+    try:
+        base = srv.url
+        # priority class over REST, then a pod resolving it
+        code, _ = _req(f"{base}/api/v1/priorityclasses", "POST",
+                       {"metadata": {"name": "gold"}, "value": 77})
+        assert code == 201
+        code, out = _req(f"{base}/api/v1/namespaces/default/pods", "POST",
+                         _pod_dict("p1", cpu="100m", priority_class="gold"))
+        assert code == 201
+        stored = cluster.get("pods", "default", "p1")
+        assert stored.spec.priority == 77
+        # fresh node gets the not-ready taint
+        code, _ = _req(f"{base}/api/v1/nodes", "POST",
+                       {"metadata": {"name": "n1"},
+                        "status": {"capacity": {"cpu": "4",
+                                                "memory": "8Gi"}}})
+        assert code == 201
+        node = cluster.get("nodes", "", "n1")
+        assert any(t.key == "node.kubernetes.io/not-ready"
+                   for t in node.spec.taints)
+        # create into a missing namespace -> 403
+        code, body = _req(f"{base}/api/v1/namespaces/ghost/pods", "POST",
+                          _pod_dict("p2", ns="ghost", cpu="1m"))
+        assert code == 403, body
+        # namespace lifecycle over REST: create, delete -> Terminating
+        code, _ = _req(f"{base}/api/v1/namespaces", "POST",
+                       {"metadata": {"name": "team"}})
+        assert code == 201
+        code, _ = _req(f"{base}/api/v1/namespaces/team", "DELETE")
+        assert code == 200
+        ns = cluster.get("namespaces", "", "team")
+        assert ns["status"]["phase"] == "Terminating"
+        code, _ = _req(f"{base}/api/v1/namespaces/kube-system", "DELETE")
+        assert code in (403, 404)
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------------------- GC
+
+
+def test_garbage_collector_cascade():
+    cluster = LocalCluster()
+    rs = ReplicaSet(namespace="default", name="rs", replicas=1,
+                    selector={"app": "x"}, template={})
+    cluster.create("replicasets", rs)
+    pod = make_pod("owned", cpu="10m", mem="1Mi", owner=("ReplicaSet", "rs"))
+    pod.metadata.owner_uid = rs.uid
+    cluster.add_pod(pod)
+    gc = GarbageCollector(cluster)
+    cluster.delete("replicasets", "default", "rs")
+    while gc.process_one(timeout=0):
+        pass
+    assert cluster.get("pods", "default", "owned") is None
+
+
+def test_podgc_orphans_and_terminated():
+    cluster = LocalCluster()
+    cluster.add_node(make_node("n1", cpu="4", mem="8Gi"))
+    orphan = make_pod("orphan", cpu="10m", mem="1Mi", node_name="gone-node")
+    cluster.add_pod(orphan)
+    ok = make_pod("ok", cpu="10m", mem="1Mi", node_name="n1")
+    cluster.add_pod(ok)
+    gc = PodGCController(cluster, terminated_threshold=0)
+    n = gc.gc_once()
+    assert n == 1
+    assert cluster.get("pods", "default", "orphan") is None
+    assert cluster.get("pods", "default", "ok") is not None
+
+
+def test_not_ready_taint_removed_on_heartbeat():
+    """TaintNodesByCondition's registration taint is shed by the
+    nodelifecycle controller once the node heartbeats (the reference's
+    condition-taint reconciliation)."""
+    import time as _time
+
+    from kubernetes_tpu.api.types import Node
+    from kubernetes_tpu.runtime.controllers import (
+        LEASE_NAMESPACE,
+        NodeLifecycleController,
+        TAINT_NOT_READY,
+    )
+
+    cluster = LocalCluster()
+    node_dict = TaintNodesByCondition()("CREATE", "nodes", {
+        "metadata": {"name": "n1"},
+        "status": {"capacity": {"cpu": "4", "memory": "8Gi"}},
+    })
+    cluster.create("nodes", Node.from_dict(node_dict))
+    assert any(t.key == TAINT_NOT_READY
+               for t in cluster.get("nodes", "", "n1").spec.taints)
+    now = _time.monotonic()
+    cluster.create("leases", {"namespace": LEASE_NAMESPACE, "name": "n1",
+                              "renew_time": now})
+    ctrl = NodeLifecycleController(cluster, grace_period=40.0)
+    ctrl.monitor(now + 1.0)
+    node = cluster.get("nodes", "", "n1")
+    assert not any(t.key == TAINT_NOT_READY for t in node.spec.taints)
+    assert node.status.conditions["Ready"] == "True"
