@@ -257,13 +257,21 @@ class DefaultTolerationSeconds:
 class TaintNodesByCondition:
     """Taint fresh nodes not-ready:NoSchedule so nothing lands before the
     node reports Ready (nodetaint/admission.go:69-94; the nodelifecycle
-    controller removes it)."""
+    controller removes it on the first lease heartbeat).
+
+    A registration that already carries Ready=True is not tainted: in this
+    framework an API-created node with a Ready condition IS the ready
+    signal (hollow kubelets register without conditions and heartbeat
+    leases; plain API nodes have no kubelet to shed the taint for them)."""
 
     NOT_READY = "node.kubernetes.io/not-ready"
 
     def __call__(self, op: str, kind: str, obj: dict) -> dict:
         if kind != "nodes" or op != "CREATE":
             return obj
+        for cond in (obj.get("status") or {}).get("conditions") or []:
+            if cond.get("type") == "Ready" and cond.get("status") == "True":
+                return obj
         spec = obj.setdefault("spec", {})
         taints = spec.setdefault("taints", [])
         if not any(

@@ -41,6 +41,7 @@ LIST_KINDS = {"pods": "PodList", "nodes": "NodeList",
               "deployments": "DeploymentList",
               "poddisruptionbudgets": "PodDisruptionBudgetList",
               "endpoints": "EndpointsList",
+              "jobs": "JobList",
               "namespaces": "NamespaceList",
               "limitranges": "LimitRangeList",
               "resourcequotas": "ResourceQuotaList",
@@ -123,6 +124,34 @@ def _decode(kind: str, d: dict):
             "name": meta.get("name", ""),
             "selector": dict((d.get("spec") or {}).get("selector") or {}),
         }
+    if kind == "jobs":
+        from kubernetes_tpu.runtime.controllers import Job
+
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        conds = {c.get("type"): c.get("status") for c in status.get("conditions") or []}
+        job = Job(
+            namespace=meta.get("namespace", "default"),
+            name=meta.get("name", ""),
+            completions=int(spec.get("completions", 1)),
+            parallelism=int(spec.get("parallelism", 1)),
+            template=spec.get("template") or {},
+            backoff_limit=int(spec.get("backoffLimit", 6)),
+            succeeded=int(status.get("succeeded", 0)),
+            failed=int(status.get("failed", 0)),
+            complete=conds.get("Complete") == "True",
+            failed_state=conds.get("Failed") == "True",
+        )
+        if meta.get("uid"):
+            job.uid = meta["uid"]
+        return job
+    if kind == "leases":
+        meta = d.get("metadata") or {}
+        out = dict(d)
+        out["namespace"] = d.get("namespace") or meta.get("namespace", "")
+        out["name"] = d.get("name") or meta.get("name", "")
+        return out
     if kind in _DICT_KINDS:
         meta = d.get("metadata") or {}
         out = dict(d)
@@ -150,6 +179,9 @@ class APIServer:
         self.admission: List[Callable[[str, str, dict], dict]] = list(
             admission or []
         )
+        # serializes admission + write so read-then-create policy checks
+        # (quota) are atomic across the threaded handler pool
+        self._write_lock = threading.Lock()
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
@@ -195,6 +227,8 @@ class APIServer:
         elif parts[:3] == ["apis", "apps", "v1"]:
             rest = parts[3:]
         elif parts[:3] == ["apis", "policy", "v1beta1"]:
+            rest = parts[3:]
+        elif parts[:3] == ["apis", "batch", "v1"]:
             rest = parts[3:]
         elif parts[:3] == ["apis", "metrics.k8s.io", "v1beta1"]:
             rest = ["@metrics"] + parts[3:]
@@ -446,12 +480,18 @@ class APIServer:
                     if kind not in LIST_KINDS:
                         self._status(404, "NotFound", f"unknown resource {kind}")
                         return
-                    body = outer._admit("CREATE", kind, body)
+                    # path namespace first: admission plugins must see the
+                    # namespace the object actually lands in
                     meta = body.setdefault("metadata", {})
                     if ns and not meta.get("namespace"):
                         meta["namespace"] = ns
-                    obj = _decode(kind, body)
-                    rv = outer.cluster.create(kind, obj)
+                    # one write at a time: quota/limit admission is a
+                    # read-then-create; serializing the write path makes it
+                    # atomic (etcd serializes writes the same way)
+                    with outer._write_lock:
+                        body = outer._admit("CREATE", kind, body)
+                        obj = _decode(kind, body)
+                        rv = outer.cluster.create(kind, obj)
                     out = object_to_dict(kind, obj)
                     out.setdefault("metadata", {})["resourceVersion"] = str(rv)
                     self._send(out, 201)
@@ -474,24 +514,26 @@ class APIServer:
                     self._status(400, "BadRequest", "invalid JSON")
                     return
                 try:
-                    body = outer._admit("UPDATE", kind, body)
                     meta = body.setdefault("metadata", {})
                     if ns and not meta.get("namespace"):
-                        meta["namespace"] = ns  # path ns wins, as on POST
-                    expect = meta.get("resourceVersion")
-                    obj = _decode(kind, body)
-                    if kind in ("replicasets", "deployments") and not (
-                        (body.get("metadata") or {}).get("uid")
-                    ):
-                        # keep the stored identity: a spec-only manifest must
-                        # not orphan the RS's pods behind a fresh uid
-                        cur = outer.cluster.get(kind, ns, name)
-                        if cur is not None:
-                            obj.uid = cur.uid
-                    rv = outer.cluster.update(
-                        kind, obj,
-                        expect_rv=int(expect) if expect else None,
-                    )
+                        meta["namespace"] = ns  # path ns first, as on POST
+                    with outer._write_lock:
+                        body = outer._admit("UPDATE", kind, body)
+                        expect = meta.get("resourceVersion")
+                        obj = _decode(kind, body)
+                        if kind in (
+                            "replicasets", "deployments", "jobs"
+                        ) and not meta.get("uid"):
+                            # keep the stored identity: a spec-only manifest
+                            # must not orphan the owner's pods behind a
+                            # fresh uid
+                            cur = outer.cluster.get(kind, ns, name)
+                            if cur is not None:
+                                obj.uid = cur.uid
+                        rv = outer.cluster.update(
+                            kind, obj,
+                            expect_rv=int(expect) if expect else None,
+                        )
                     out = object_to_dict(kind, obj)
                     out.setdefault("metadata", {})["resourceVersion"] = str(rv)
                     self._send(out)

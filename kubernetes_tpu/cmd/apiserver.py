@@ -35,6 +35,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--with-controllers", action="store_true")
     p.add_argument("--hollow-nodes", type=int, default=0)
     p.add_argument(
+        "--data-dir", default="",
+        help="persist the store (WAL + snapshots) under this directory; "
+        "empty = in-memory only",
+    )
+    p.add_argument(
         "--disable-admission", action="store_true",
         help="skip the default admission chain (NamespaceLifecycle, "
         "LimitRanger, PodNodeSelector, Priority, DefaultTolerationSeconds, "
@@ -50,7 +55,12 @@ def main(argv=None) -> int:
     from kubernetes_tpu.apiserver import APIServer
     from kubernetes_tpu.runtime.cluster import LocalCluster
 
-    cluster = LocalCluster()
+    if args.data_dir:
+        from kubernetes_tpu.runtime.persist import PersistentCluster
+
+        cluster = PersistentCluster(args.data_dir)
+    else:
+        cluster = LocalCluster()
     admission = None
     if not args.disable_admission:
         from kubernetes_tpu.apiserver.admission import default_admission_chain

@@ -31,6 +31,8 @@ KIND_PATHS = {
     "pdb": "/apis/policy/v1beta1/namespaces/{ns}/poddisruptionbudgets",
     "endpoints": "/api/v1/namespaces/{ns}/endpoints",
     "services": "/api/v1/namespaces/{ns}/services",
+    "jobs": "/apis/batch/v1/namespaces/{ns}/jobs",
+    "job": "/apis/batch/v1/namespaces/{ns}/jobs",
     "namespaces": "/api/v1/namespaces",
     "ns": "/api/v1/namespaces",
     "limitranges": "/api/v1/namespaces/{ns}/limitranges",

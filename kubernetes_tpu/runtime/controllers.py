@@ -1004,10 +1004,11 @@ class ResourceQuotaController(Reconciler):
     with live usage (the admission plugin enforces; this controller
     reports)."""
 
-    _RESOURCES = (
-        "pods", "cpu", "memory", "requests.cpu", "requests.memory",
-        "limits.cpu", "limits.memory",
-    )
+    @property
+    def _RESOURCES(self):
+        from kubernetes_tpu.apiserver.admission import _QUOTA_POD_RESOURCES
+
+        return _QUOTA_POD_RESOURCES
 
     def _on_event(self, event: str, kind: str, obj) -> None:
         if kind == "resourcequotas":

@@ -314,3 +314,38 @@ def test_not_ready_taint_removed_on_heartbeat():
     node = cluster.get("nodes", "", "n1")
     assert not any(t.key == TAINT_NOT_READY for t in node.spec.taints)
     assert node.status.conditions["Ready"] == "True"
+
+
+def test_quota_enforcement_is_atomic_under_concurrency():
+    """The write path serializes admission+create, so parallel POSTs cannot
+    jointly overshoot a hard quota (the etcd-serialized-writes analog)."""
+    import threading
+
+    cluster = LocalCluster()
+    cluster.create("resourcequotas", {
+        "namespace": "default", "name": "rq",
+        "spec": {"hard": {"pods": "5"}},
+    })
+    srv = APIServer(
+        cluster=cluster, admission=default_admission_chain(cluster)
+    ).start()
+    try:
+        codes = []
+
+        def post(i):
+            code, _ = _req(
+                f"{srv.url}/api/v1/namespaces/default/pods", "POST",
+                _pod_dict(f"p{i}", cpu="1m"),
+            )
+            codes.append(code)
+
+        threads = [threading.Thread(target=post, args=(i,)) for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert codes.count(201) == 5
+        assert codes.count(403) == 7
+        assert len(cluster.list("pods")) == 5
+    finally:
+        srv.stop()
