@@ -1,0 +1,112 @@
+"""API extension mechanisms: CRD schema validation + lookup helpers.
+
+Reference: staging/src/k8s.io/apiextensions-apiserver (CustomResource
+Definitions — establish a new REST resource at runtime, validate instances
+against spec.validation.openAPIV3Schema) and staging/src/k8s.io/
+kube-aggregator (APIService — delegate a whole group/version to another
+server).  The routing halves live in APIServer._route_extension; this
+module holds the pure logic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class SchemaError(ValueError):
+    """Instance does not conform to the CRD's openAPIV3Schema."""
+
+
+def crd_storage_kind(crd: dict) -> str:
+    spec = crd.get("spec") or {}
+    plural = (spec.get("names") or {}).get("plural", "")
+    return f"{plural}.{spec.get('group', '')}"
+
+
+def validate_crd_spec(crd: dict) -> None:
+    """The establishment-time sanity checks (customresourcedefinition
+    strategy validation): group, version(s), and names.plural required."""
+    spec = crd.get("spec") or {}
+    if not spec.get("group"):
+        raise SchemaError("spec.group is required")
+    if not spec.get("version") and not spec.get("versions"):
+        raise SchemaError("spec.version (or versions) is required")
+    if not (spec.get("names") or {}).get("plural"):
+        raise SchemaError("spec.names.plural is required")
+
+
+def crd_schema(crd: dict) -> Optional[dict]:
+    return ((crd.get("spec") or {}).get("validation") or {}).get(
+        "openAPIV3Schema"
+    )
+
+
+_TYPES = {
+    "object": dict,
+    "array": list,
+    "string": str,
+    "boolean": bool,
+}
+
+
+def validate_schema(obj, schema: dict, path: str = "") -> None:
+    """Validate obj against the supported openAPIV3Schema subset: type,
+    properties, required, items, enum, minimum/maximum.  Raises SchemaError
+    naming the offending path (apiextensions validation.go behavior)."""
+    t = schema.get("type")
+    if t:
+        if t == "integer":
+            ok = isinstance(obj, int) and not isinstance(obj, bool)
+        elif t == "number":
+            ok = (
+                isinstance(obj, (int, float)) and not isinstance(obj, bool)
+            )
+        else:
+            ok = isinstance(obj, _TYPES.get(t, object))
+        if not ok:
+            raise SchemaError(
+                f"{path or '<root>'}: expected {t}, got {type(obj).__name__}"
+            )
+    if "enum" in schema and obj not in schema["enum"]:
+        raise SchemaError(f"{path or '<root>'}: {obj!r} not in {schema['enum']}")
+    if isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        if "minimum" in schema and obj < schema["minimum"]:
+            raise SchemaError(f"{path}: {obj} < minimum {schema['minimum']}")
+        if "maximum" in schema and obj > schema["maximum"]:
+            raise SchemaError(f"{path}: {obj} > maximum {schema['maximum']}")
+    if isinstance(obj, dict):
+        for req in schema.get("required") or []:
+            if req not in obj:
+                raise SchemaError(f"{path or '<root>'}: missing required "
+                                  f"property {req!r}")
+        props = schema.get("properties") or {}
+        for k, sub in props.items():
+            if k in obj:
+                validate_schema(obj[k], sub, f"{path}.{k}" if path else k)
+    if isinstance(obj, list) and "items" in schema:
+        for i, item in enumerate(obj):
+            validate_schema(item, schema["items"], f"{path}[{i}]")
+
+
+def flatten_wire_dict(d: dict, default_ns: Optional[str] = None) -> dict:
+    """Wire object -> store dict: copy with flat name/namespace keys lifted
+    from metadata (the single flattening used for every dict-stored kind).
+
+    default_ns=None  -> cluster-scoped: namespace forced to ""
+    default_ns="x"   -> namespaced: metadata/top-level namespace, else "x"
+    """
+    meta = d.get("metadata") or {}
+    out = dict(d)
+    out["name"] = d.get("name") or meta.get("name", "")
+    out["namespace"] = (
+        "" if default_ns is None
+        else (d.get("namespace") or meta.get("namespace") or default_ns)
+    )
+    return out
+
+
+def find_crd_for_kind(cluster, storage_kind: str) -> Optional[dict]:
+    for crd in cluster.list("customresourcedefinitions"):
+        if crd_storage_kind(crd) == storage_kind:
+            return crd
+    return None

@@ -28,6 +28,8 @@ from __future__ import annotations
 import json
 import queue as _queue
 import threading
+import urllib.error
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional, Tuple
 
@@ -45,7 +47,9 @@ LIST_KINDS = {"pods": "PodList", "nodes": "NodeList",
               "namespaces": "NamespaceList",
               "limitranges": "LimitRangeList",
               "resourcequotas": "ResourceQuotaList",
-              "priorityclasses": "PriorityClassList"}
+              "priorityclasses": "PriorityClassList",
+              "customresourcedefinitions": "CustomResourceDefinitionList",
+              "apiservices": "APIServiceList"}
 
 # kinds stored as plain dicts carrying the original wire body plus flat
 # namespace/name keys for the store (cluster-scoped kinds use "")
@@ -54,6 +58,8 @@ _DICT_KINDS = {
     "priorityclasses": "",     # cluster-scoped
     "limitranges": "default",
     "resourcequotas": "default",
+    "customresourcedefinitions": "",  # cluster-scoped
+    "apiservices": "",                # cluster-scoped
 }
 
 
@@ -152,16 +158,16 @@ def _decode(kind: str, d: dict):
         out["namespace"] = d.get("namespace") or meta.get("namespace", "")
         out["name"] = d.get("name") or meta.get("name", "")
         return out
+    from kubernetes_tpu.apiserver.extensions import flatten_wire_dict
+
     if kind in _DICT_KINDS:
-        meta = d.get("metadata") or {}
-        out = dict(d)
-        out["name"] = d.get("name") or meta.get("name", "")
         default_ns = _DICT_KINDS[kind]
-        out["namespace"] = (
-            "" if default_ns == ""
-            else (d.get("namespace") or meta.get("namespace", default_ns))
-        )
-        return out
+        return flatten_wire_dict(d, None if default_ns == "" else default_ns)
+    if "." in kind:
+        # CRD-established custom resource ("<plural>.<group>"): stored as
+        # its wire dict; the path namespace was injected into metadata
+        # before decode (cluster-scoped CRs have none -> "")
+        return flatten_wire_dict(d, default_ns="")
     raise ValueError(f"unknown kind {kind!r}")
 
 
@@ -210,6 +216,27 @@ class APIServer:
 
     # ----------------------------------------------------------- admission
 
+    def _validate_extension(self, kind: str, body: dict) -> None:
+        """CRD-specific write checks: establishment sanity for CRDs, and
+        openAPIV3Schema validation for custom-resource instances
+        (apiextensions-apiserver validation.go)."""
+        from kubernetes_tpu.apiserver.extensions import (
+            crd_schema,
+            find_crd_for_kind,
+            validate_crd_spec,
+            validate_schema,
+        )
+
+        if kind == "customresourcedefinitions":
+            validate_crd_spec(body)
+            return
+        if "." in kind:
+            crd = find_crd_for_kind(self.cluster, kind)
+            if crd is not None:
+                schema = crd_schema(crd)
+                if schema:
+                    validate_schema(body, schema)
+
     def _admit(self, op: str, kind: str, obj_dict: dict) -> dict:
         for plugin in self.admission:
             obj_dict = plugin(op, kind, obj_dict)
@@ -217,9 +244,14 @@ class APIServer:
 
     # ------------------------------------------------------------- routes
 
-    @staticmethod
-    def _route(path: str):
-        """-> (kind, namespace, name, subresource) or None."""
+    def _route(self, path: str):
+        """-> (kind, namespace, name, subresource) or None.
+
+        Dynamic groups resolve through the extension mechanisms: a
+        CustomResourceDefinition's group/version/plural maps to its storage
+        kind (apiextensions-apiserver analog), and an APIService proxies
+        the whole group prefix to its backing server (kube-aggregator
+        analog; returned as ("@proxy", url, "", ""))."""
         parts = [p for p in path.split("?")[0].split("/") if p]
         # /api/v1/... or /apis/apps/v1/...
         if parts[:2] == ["api", "v1"]:
@@ -232,6 +264,11 @@ class APIServer:
             rest = parts[3:]
         elif parts[:3] == ["apis", "metrics.k8s.io", "v1beta1"]:
             rest = ["@metrics"] + parts[3:]
+        elif parts[:1] == ["apis"] and len(parts) >= 3:
+            ext = self._route_extension(parts[1], parts[2], parts[3:])
+            if ext is not None:
+                return ext
+            return None
         else:
             return None
         if not rest:
@@ -242,10 +279,45 @@ class APIServer:
             ns, kind = rest[1], rest[2]
             name = rest[3] if len(rest) > 3 else ""
             sub = rest[4] if len(rest) > 4 else ""
-            return (kind, ns, name, sub)
-        kind = rest[0]
-        name = rest[1] if len(rest) > 1 else ""
-        return (kind, "", name, "")
+        else:
+            kind, ns = rest[0], ""
+            name = rest[1] if len(rest) > 1 else ""
+            sub = ""
+        if "." in kind:
+            # custom resources are reachable ONLY through their CRD's
+            # /apis/{group}/{version} route (which enforces establishment
+            # and schema); the storage kind must not leak into core paths
+            return None
+        return (kind, ns, name, sub)
+
+    def _route_extension(self, group: str, version: str, rest):
+        """Resolve /apis/{group}/{version}/... via CRDs, then APIServices."""
+        for crd in self.cluster.list("customresourcedefinitions"):
+            spec = crd.get("spec") or {}
+            if spec.get("group") != group:
+                continue
+            versions = {spec.get("version")} | {
+                v.get("name") for v in spec.get("versions") or []
+            }
+            if version not in versions:
+                continue
+            plural = (spec.get("names") or {}).get("plural", "")
+            storage_kind = f"{plural}.{group}"
+            if rest[:1] == ["namespaces"] and len(rest) >= 3 and rest[2] == plural:
+                self.cluster.register_kind(storage_kind)  # lazy re-establish
+                name = rest[3] if len(rest) > 3 else ""
+                return (storage_kind, rest[1], name, "")
+            if rest[:1] == [plural]:
+                self.cluster.register_kind(storage_kind)
+                name = rest[1] if len(rest) > 1 else ""
+                return (storage_kind, "", name, "")
+        for svc in self.cluster.list("apiservices"):
+            spec = svc.get("spec") or {}
+            if spec.get("group") == group and spec.get("version") == version:
+                url = (spec.get("service") or {}).get("url", "")
+                if url:
+                    return ("@proxy", url, "", "")
+        return None
 
     def _make_handler(self):
         outer = self
@@ -302,7 +374,10 @@ class APIServer:
                 if kind == "@metrics":
                     self._serve_metrics_api(ns, name)
                     return
-                if kind not in LIST_KINDS:
+                if kind == "@proxy":
+                    self._proxy(ns)  # ns slot carries the backend URL
+                    return
+                if kind not in LIST_KINDS and not outer.cluster.has_kind(kind):
                     self._status(404, "NotFound", f"unknown resource {kind}")
                     return
                 if name:
@@ -322,8 +397,8 @@ class APIServer:
                         for o in outer.cluster.list(kind)
                         if not ns or ns_of(o) == ns
                     ]
-                    self._send({"kind": LIST_KINDS[kind], "apiVersion": "v1",
-                                "items": items})
+                    self._send({"kind": LIST_KINDS.get(kind, "List"),
+                                "apiVersion": "v1", "items": items})
 
             def _serve_metrics_api(self, ns: str, name: str):
                 """metrics.k8s.io/v1beta1 analog (staging/src/k8s.io/metrics
@@ -401,6 +476,38 @@ class APIServer:
                     return
                 self._status(404, "NotFound", self.path)
 
+            def _proxy(self, backend: str):
+                """kube-aggregator: forward this request verbatim to the
+                APIService's backing server and relay the response."""
+                n = int(self.headers.get("Content-Length", 0))
+                data = self.rfile.read(n) if n else None
+                req = urllib.request.Request(
+                    backend.rstrip("/") + self.path, data=data,
+                    method=self.command,
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        payload = resp.read()
+                        self.send_response(resp.status)
+                        ct = resp.headers.get(
+                            "Content-Type", "application/json"
+                        )
+                        self.send_header("Content-Type", ct)
+                        self.send_header("Content-Length", str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                except urllib.error.HTTPError as e:
+                    payload = e.read()
+                    self.send_response(e.code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                except OSError as e:
+                    self._status(502, "BadGateway",
+                                 f"APIService backend {backend}: {e}")
+
             def _send_text(self, body: bytes, ct: str = "text/plain"):
                 self.send_response(200)
                 self.send_header("Content-Type", ct)
@@ -458,6 +565,10 @@ class APIServer:
                     self._status(404, "NotFound", self.path)
                     return
                 kind, ns, name, sub = r
+                if kind == "@proxy":
+                    # before _body(): the proxy relays the raw stream itself
+                    self._proxy(ns)
+                    return
                 try:
                     body = self._body()
                 except ValueError:
@@ -477,7 +588,9 @@ class APIServer:
                             return
                         self._status(201, "Created", "binding recorded")
                         return
-                    if kind not in LIST_KINDS:
+                    if kind not in LIST_KINDS and not outer.cluster.has_kind(
+                        kind
+                    ):
                         self._status(404, "NotFound", f"unknown resource {kind}")
                         return
                     # path namespace first: admission plugins must see the
@@ -490,8 +603,18 @@ class APIServer:
                     # atomic (etcd serializes writes the same way)
                     with outer._write_lock:
                         body = outer._admit("CREATE", kind, body)
+                        # schema validation AFTER admission: mutating
+                        # plugins must not produce out-of-schema objects
+                        outer._validate_extension(kind, body)
                         obj = _decode(kind, body)
                         rv = outer.cluster.create(kind, obj)
+                    if kind == "customresourcedefinitions":
+                        # establish the new REST resource immediately
+                        from kubernetes_tpu.apiserver.extensions import (
+                            crd_storage_kind,
+                        )
+
+                        outer.cluster.register_kind(crd_storage_kind(body))
                     out = object_to_dict(kind, obj)
                     out.setdefault("metadata", {})["resourceVersion"] = str(rv)
                     self._send(out, 201)
@@ -504,6 +627,9 @@ class APIServer:
 
             def do_PUT(self):
                 r = outer._route(self.path)
+                if r is not None and r[0] == "@proxy":
+                    self._proxy(r[1])
+                    return
                 if r is None or not r[2]:
                     self._status(404, "NotFound", self.path)
                     return
@@ -519,6 +645,7 @@ class APIServer:
                         meta["namespace"] = ns  # path ns first, as on POST
                     with outer._write_lock:
                         body = outer._admit("UPDATE", kind, body)
+                        outer._validate_extension(kind, body)
                         expect = meta.get("resourceVersion")
                         obj = _decode(kind, body)
                         if kind in (
@@ -546,11 +673,14 @@ class APIServer:
 
             def do_DELETE(self):
                 r = outer._route(self.path)
+                if r is not None and r[0] == "@proxy":
+                    self._proxy(r[1])
+                    return
                 if r is None or not r[2]:
                     self._status(404, "NotFound", self.path)
                     return
                 kind, ns, name, _sub = r
-                if kind not in LIST_KINDS:
+                if kind not in LIST_KINDS and not outer.cluster.has_kind(kind):
                     self._status(404, "NotFound", f"unknown resource {kind}")
                     return
                 store_ns = "" if kind in ("nodes",) or (
@@ -586,6 +716,21 @@ class APIServer:
                             pass
                     self._status(200, "Success", "namespace terminating")
                     return
+                if kind == "customresourcedefinitions":
+                    # un-establishing a CRD deletes its instances too
+                    # (apiextensions finalizer semantics)
+                    from kubernetes_tpu.apiserver.extensions import (
+                        crd_storage_kind,
+                    )
+
+                    sk = crd_storage_kind(cur)
+                    if outer.cluster.has_kind(sk):
+                        for inst in list(outer.cluster.list(sk)):
+                            outer.cluster.delete(
+                                sk, inst.get("namespace", ""),
+                                inst.get("name", ""),
+                            )
+                        outer.cluster.unregister_kind(sk)
                 outer.cluster.delete(kind, store_ns, name)
                 self._status(200, "Success", "deleted")
 

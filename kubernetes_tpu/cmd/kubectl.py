@@ -41,7 +41,54 @@ KIND_PATHS = {
     "quota": "/api/v1/namespaces/{ns}/resourcequotas",
     "priorityclasses": "/api/v1/priorityclasses",
     "pc": "/api/v1/priorityclasses",
+    "customresourcedefinitions": "/api/v1/customresourcedefinitions",
+    "crd": "/api/v1/customresourcedefinitions",
+    "crds": "/api/v1/customresourcedefinitions",
+    "apiservices": "/api/v1/apiservices",
 }
+
+
+def _discover_crd(server: str, *, storage=None, kind=None):
+    """Find a CRD spec by storage name ('<plural>.<group>') or by wire
+    kind — API discovery, the kubectl RESTMapper analog."""
+    out = _req(server, "GET", "/api/v1/customresourcedefinitions")
+    for crd in out.get("items") or []:
+        spec = crd.get("spec") or {}
+        names = spec.get("names") or {}
+        plural = names.get("plural", "")
+        if storage and f"{plural}.{spec.get('group', '')}" == storage:
+            return spec
+        if kind and names.get("kind", "").lower() == kind:
+            return spec
+    return None
+
+
+def _crd_collection(spec: dict, ns: str) -> str:
+    group = spec.get("group", "")
+    version = spec.get("version") or next(
+        (v.get("name") for v in spec.get("versions") or []), "v1"
+    )
+    plural = (spec.get("names") or {}).get("plural", "")
+    if spec.get("scope", "Namespaced") == "Cluster":
+        return f"/apis/{group}/{version}/{plural}"
+    return f"/apis/{group}/{version}/namespaces/{ns}/{plural}"
+
+
+def _resolve_path(server: str, kind: str, ns: str, name: str = "") -> str:
+    """_path plus CR discovery: an unknown kind containing a dot is a
+    '<plural>.<group>' storage name resolved through its CRD (correct
+    version and scope)."""
+    if kind in KIND_PATHS:
+        return _path(kind, ns, name)
+    if "." in kind:
+        spec = _discover_crd(server, storage=kind)
+        if spec is None:  # server unreachable or CRD missing: best guess
+            plural, _, group = kind.partition(".")
+            base = f"/apis/{group}/v1/namespaces/{ns}/{plural}"
+        else:
+            base = _crd_collection(spec, ns)
+        return f"{base}/{name}" if name else base
+    raise SystemExit(f"error: unknown resource kind {kind!r}")
 
 
 def _req(server: str, method: str, path: str, payload=None) -> dict:
@@ -79,6 +126,25 @@ def _plural(k: str) -> str:
     if k + "es" in KIND_PATHS:
         return k + "es"
     return k if k.endswith("s") else k + "s"
+
+
+def _manifest_path(server: str, obj: dict, ns: str) -> "tuple[str, str]":
+    """(plural kind, collection path) for a manifest: builtin kinds via the
+    table, custom resources via CRD discovery (correct plural/scope), with
+    the manifest's own apiVersion as the fallback route."""
+    k = obj.get("kind", "Pod").lower()
+    kind = _plural(k)
+    if kind in KIND_PATHS:
+        return kind, _path(kind, ns)
+    api = obj.get("apiVersion", "")
+    if "/" in api:
+        spec = _discover_crd(server, kind=k)
+        if spec is not None:
+            return (spec.get("names") or {}).get("plural", kind), \
+                _crd_collection(spec, ns)
+        group, version = api.split("/", 1)
+        return kind, f"/apis/{group}/{version}/namespaces/{ns}/{kind}"
+    raise SystemExit(f"error: unknown resource kind {obj.get('kind')!r}")
 
 
 def _pod_row(p: dict):
@@ -153,7 +219,7 @@ def main(argv=None) -> int:
     ns = getattr(args, "namespace", "default")
 
     if args.verb == "get":
-        out = _req(args.server, "GET", _path(args.kind, ns, args.name))
+        out = _req(args.server, "GET", _resolve_path(args.server, args.kind, ns, args.name))
         if out.get("kind") == "Status":
             print(out.get("message", ""), file=sys.stderr)
             return 1
@@ -173,9 +239,9 @@ def main(argv=None) -> int:
         with open(args.filename) as f:
             obj = json.load(f)
         k = obj.get("kind", "Pod").lower()
-        kind = _plural(k)
         obj_ns = (obj.get("metadata") or {}).get("namespace") or ns
-        out = _req(args.server, "POST", _path(kind, obj_ns), obj)
+        kind, coll = _manifest_path(args.server, obj, obj_ns)
+        out = _req(args.server, "POST", coll, obj)
         if out.get("kind") == "Status" and out.get("code", 201) >= 400:
             print(out.get("message", ""), file=sys.stderr)
             return 1
@@ -184,13 +250,13 @@ def main(argv=None) -> int:
         return 0
 
     if args.verb == "delete":
-        out = _req(args.server, "DELETE", _path(args.kind, ns, args.name))
+        out = _req(args.server, "DELETE", _resolve_path(args.server, args.kind, ns, args.name))
         ok = out.get("reason") == "Success"
         print(out.get("message", ""), file=sys.stderr if not ok else sys.stdout)
         return 0 if ok else 1
 
     if args.verb == "describe":
-        out = _req(args.server, "GET", _path(args.kind, ns, args.name))
+        out = _req(args.server, "GET", _resolve_path(args.server, args.kind, ns, args.name))
         if out.get("kind") == "Status":
             print(out.get("message", ""), file=sys.stderr)
             return 1
@@ -199,12 +265,12 @@ def main(argv=None) -> int:
 
     if args.verb == "scale":
         # GET -> mutate spec.replicas -> PUT (kubectl scale shape)
-        out = _req(args.server, "GET", _path(args.kind, ns, args.name))
+        out = _req(args.server, "GET", _resolve_path(args.server, args.kind, ns, args.name))
         if out.get("kind") == "Status":
             print(out.get("message", ""), file=sys.stderr)
             return 1
         out.setdefault("spec", {})["replicas"] = args.replicas
-        res = _req(args.server, "PUT", _path(args.kind, ns, args.name), out)
+        res = _req(args.server, "PUT", _resolve_path(args.server, args.kind, ns, args.name), out)
         if res.get("kind") == "Status" and res.get("code", 200) >= 400:
             print(res.get("message", ""), file=sys.stderr)
             return 1
@@ -217,12 +283,12 @@ def main(argv=None) -> int:
         with open(args.filename) as f:
             obj = json.load(f)
         k = obj.get("kind", "Pod").lower()
-        kind = _plural(k)
         obj_ns = (obj.get("metadata") or {}).get("namespace") or ns
         name = (obj.get("metadata") or {}).get("name", "")
-        out = _req(args.server, "POST", _path(kind, obj_ns), obj)
+        kind, coll = _manifest_path(args.server, obj, obj_ns)
+        out = _req(args.server, "POST", coll, obj)
         if out.get("kind") == "Status" and out.get("code") == 409:
-            out = _req(args.server, "PUT", _path(kind, obj_ns, name), obj)
+            out = _req(args.server, "PUT", f"{coll}/{name}", obj)
             if out.get("kind") == "Status" and out.get("code", 200) >= 400:
                 print(out.get("message", ""), file=sys.stderr)
                 return 1

@@ -48,13 +48,16 @@ class LocalCluster:
     KINDS = ("nodes", "pods", "services", "leases", "replicasets",
              "poddisruptionbudgets", "endpoints", "deployments", "jobs",
              "namespaces", "limitranges", "resourcequotas",
-             "priorityclasses")
+             "priorityclasses", "customresourcedefinitions", "apiservices")
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._rv = 0
+        # per-instance kind registry: CRDs add kinds at runtime
+        # (apiextensions-apiserver analog)
+        self.kinds: List[str] = list(self.KINDS)
         self._store: Dict[str, Dict[Tuple[str, str], _Stored]] = {
-            k: {} for k in self.KINDS
+            k: {} for k in self.kinds
         }
         self._watchers: List[Callable[[str, str, object], None]] = []
         # the events API analog: components record through here
@@ -80,7 +83,7 @@ class LocalCluster:
         (the reflector LIST+WATCH contract)."""
         with self._lock:
             self._watchers.append(fn)
-            for kind in self.KINDS:
+            for kind in self.kinds:
                 for s in self._store[kind].values():
                     fn(ADDED, kind, s.obj)
 
@@ -91,6 +94,26 @@ class LocalCluster:
                 self._watchers.remove(fn)
             except ValueError:
                 pass
+
+    def register_kind(self, kind: str) -> None:
+        """Add a storage bucket for a custom resource kind at runtime (the
+        CRD establishment step; apiextensions-apiserver customresource
+        storage).  Idempotent."""
+        with self._lock:
+            if kind not in self._store:
+                self.kinds.append(kind)
+                self._store[kind] = {}
+
+    def unregister_kind(self, kind: str) -> None:
+        """Drop a dynamic kind's bucket (CRD un-establishment).  Built-in
+        kinds cannot be unregistered."""
+        with self._lock:
+            if kind in self._store and kind not in self.KINDS:
+                self.kinds.remove(kind)
+                del self._store[kind]
+
+    def has_kind(self, kind: str) -> bool:
+        return kind in self._store
 
     def create(self, kind: str, obj) -> int:
         with self._lock:

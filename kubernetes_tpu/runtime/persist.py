@@ -42,8 +42,15 @@ class CompactedError(Exception):
 
 
 def _decode(kind: str, d: dict):
+    """Wire dict -> stored object.  Dynamic (CRD-established) kinds — and
+    only those, recognized by the '<plural>.<group>' dot convention — are
+    stored as wire dicts; decode errors on builtin kinds stay loud (a
+    corrupt WAL entry must fail recovery, not load as a dict)."""
+    from kubernetes_tpu.apiserver.extensions import flatten_wire_dict
     from kubernetes_tpu.apiserver.server import _decode as decode
 
+    if "." in kind:
+        return flatten_wire_dict(d, default_ns="")
     return decode(kind, d)
 
 
@@ -74,6 +81,7 @@ class PersistentCluster(LocalCluster):
             self._compacted_rv = self._rv = int(snap["rv"])
             for entry in snap["objects"]:
                 kind, rv, d = entry["kind"], int(entry["rv"]), entry["obj"]
+                self.register_kind(kind)  # dynamic kinds re-establish first
                 obj = _decode(kind, d)
                 key = self._key(kind, obj)
                 from kubernetes_tpu.runtime.cluster import _Stored
@@ -102,6 +110,7 @@ class PersistentCluster(LocalCluster):
             return
         from kubernetes_tpu.runtime.cluster import _Stored
 
+        self.register_kind(kind)
         if op == "delete":
             ns, name = e["key"]
             self._store[kind].pop((ns, name), None)
@@ -157,7 +166,7 @@ class PersistentCluster(LocalCluster):
         history.  Returns the snapshot revision."""
         with self._lock:
             objects = []
-            for kind in self.KINDS:
+            for kind in self.kinds:
                 for s in self._store[kind].values():
                     objects.append({
                         "kind": kind,

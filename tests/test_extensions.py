@@ -1,0 +1,211 @@
+"""API extension mechanisms: CustomResourceDefinitions (establish, CRUD,
+schema validation, cascade delete, persistence) and APIService aggregation
+(proxying a group to a backing server)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apiserver.extensions import SchemaError, validate_schema
+from kubernetes_tpu.runtime.cluster import LocalCluster
+from kubernetes_tpu.runtime.persist import PersistentCluster
+
+
+def _req(url, method="GET", payload=None):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+WIDGET_CRD = {
+    "kind": "CustomResourceDefinition",
+    "metadata": {"name": "widgets.example.com"},
+    "spec": {
+        "group": "example.com",
+        "version": "v1",
+        "names": {"plural": "widgets", "kind": "Widget"},
+        "scope": "Namespaced",
+        "validation": {"openAPIV3Schema": {
+            "type": "object",
+            "required": ["spec"],
+            "properties": {"spec": {
+                "type": "object",
+                "required": ["size"],
+                "properties": {
+                    "size": {"type": "integer", "minimum": 1, "maximum": 10},
+                    "color": {"type": "string",
+                              "enum": ["red", "green", "blue"]},
+                },
+            }},
+        }},
+    },
+}
+
+
+def test_crd_establish_crud_and_validation():
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster).start()
+    try:
+        base = srv.url
+        code, _ = _req(f"{base}/api/v1/customresourcedefinitions", "POST",
+                       WIDGET_CRD)
+        assert code == 201
+        # instances CRUD under the new group route
+        code, out = _req(
+            f"{base}/apis/example.com/v1/namespaces/default/widgets", "POST",
+            {"metadata": {"name": "w1"}, "spec": {"size": 3, "color": "red"}},
+        )
+        assert code == 201, out
+        code, out = _req(
+            f"{base}/apis/example.com/v1/namespaces/default/widgets/w1")
+        assert code == 200 and out["spec"]["size"] == 3
+        # schema enforcement: missing required, wrong type, out-of-enum
+        for bad in (
+            {"metadata": {"name": "w2"}},                       # no spec
+            {"metadata": {"name": "w2"}, "spec": {"size": "x"}},
+            {"metadata": {"name": "w2"}, "spec": {"size": 99}},
+            {"metadata": {"name": "w2"},
+             "spec": {"size": 2, "color": "mauve"}},
+        ):
+            code, out = _req(
+                f"{base}/apis/example.com/v1/namespaces/default/widgets",
+                "POST", bad,
+            )
+            assert code == 422, (bad, out)
+        # update via PUT revalidates
+        code, _ = _req(
+            f"{base}/apis/example.com/v1/namespaces/default/widgets/w1",
+            "PUT",
+            {"metadata": {"name": "w1"}, "spec": {"size": 5}},
+        )
+        assert code == 200
+        # list
+        code, out = _req(
+            f"{base}/apis/example.com/v1/namespaces/default/widgets")
+        assert code == 200 and len(out["items"]) == 1
+        # deleting the CRD cascades to instances and unestablishes the route
+        code, _ = _req(
+            f"{base}/api/v1/customresourcedefinitions/widgets.example.com",
+            "DELETE")
+        assert code == 200
+        assert not cluster.has_kind("widgets.example.com")  # un-established
+        code, _ = _req(
+            f"{base}/apis/example.com/v1/namespaces/default/widgets")
+        assert code == 404
+    finally:
+        srv.stop()
+
+
+def test_crd_missing_establishment_fields_rejected():
+    srv = APIServer().start()
+    try:
+        code, out = _req(f"{srv.url}/api/v1/customresourcedefinitions",
+                         "POST",
+                         {"metadata": {"name": "x"}, "spec": {"group": "g"}})
+        assert code == 422
+    finally:
+        srv.stop()
+
+
+def test_crd_survives_persistence(tmp_path):
+    d = str(tmp_path / "data")
+    c1 = PersistentCluster(d)
+    srv = APIServer(cluster=c1).start()
+    try:
+        _req(f"{srv.url}/api/v1/customresourcedefinitions", "POST", WIDGET_CRD)
+        code, _ = _req(
+            f"{srv.url}/apis/example.com/v1/namespaces/default/widgets",
+            "POST",
+            {"metadata": {"name": "w1"}, "spec": {"size": 3}},
+        )
+        assert code == 201
+    finally:
+        srv.stop()
+        c1.close()
+    c2 = PersistentCluster(d)
+    srv2 = APIServer(cluster=c2).start()
+    try:
+        code, out = _req(
+            f"{srv2.url}/apis/example.com/v1/namespaces/default/widgets/w1")
+        assert code == 200 and out["spec"]["size"] == 3
+    finally:
+        srv2.stop()
+        c2.close()
+
+
+def test_schema_validator_paths():
+    schema = WIDGET_CRD["spec"]["validation"]["openAPIV3Schema"]
+    validate_schema({"spec": {"size": 2}}, schema)
+    with pytest.raises(SchemaError, match="spec.size"):
+        validate_schema({"spec": {"size": True}}, schema)
+    with pytest.raises(SchemaError, match="missing required"):
+        validate_schema({}, schema)
+    with pytest.raises(SchemaError, match="minimum"):
+        validate_schema({"spec": {"size": 0}}, schema)
+    # arrays
+    validate_schema([1, 2], {"type": "array", "items": {"type": "integer"}})
+    with pytest.raises(SchemaError, match=r"\[1\]"):
+        validate_schema([1, "x"],
+                        {"type": "array", "items": {"type": "integer"}})
+
+
+def test_apiservice_aggregation_proxies_group():
+    """An APIService delegates its whole group/version to a backing server
+    (kube-aggregator)."""
+
+    class Backend(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps({"echo": self.path, "method": "GET"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            data = json.loads(self.rfile.read(n) or b"{}")
+            body = json.dumps({"got": data}).encode()
+            self.send_response(201)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    backend = ThreadingHTTPServer(("127.0.0.1", 0), Backend)
+    threading.Thread(target=backend.serve_forever, daemon=True).start()
+    h, p = backend.server_address[:2]
+    srv = APIServer().start()
+    try:
+        code, _ = _req(f"{srv.url}/api/v1/apiservices", "POST", {
+            "metadata": {"name": "v1alpha1.custom.metrics.io"},
+            "spec": {"group": "custom.metrics.io", "version": "v1alpha1",
+                     "service": {"url": f"http://{h}:{p}"}},
+        })
+        assert code == 201
+        code, out = _req(
+            f"{srv.url}/apis/custom.metrics.io/v1alpha1/anything/here")
+        assert code == 200
+        assert out["echo"] == "/apis/custom.metrics.io/v1alpha1/anything/here"
+        code, out = _req(
+            f"{srv.url}/apis/custom.metrics.io/v1alpha1/things", "POST",
+            {"a": 1})
+        assert code == 201 and out == {"got": {"a": 1}}
+    finally:
+        srv.stop()
+        backend.shutdown()
