@@ -246,6 +246,42 @@ def object_to_dict(kind: str, obj) -> dict:
                 ),
             }),
         }
+    if kind == "daemonsets":
+        return {
+            "kind": "DaemonSet",
+            "apiVersion": "apps/v1",
+            "metadata": {"name": obj.name, "namespace": obj.namespace,
+                         "uid": obj.uid},
+            "spec": {
+                "selector": {"matchLabels": dict(obj.selector)},
+                "template": obj.template,
+            },
+        }
+    if kind == "statefulsets":
+        return {
+            "kind": "StatefulSet",
+            "apiVersion": "apps/v1",
+            "metadata": {"name": obj.name, "namespace": obj.namespace,
+                         "uid": obj.uid},
+            "spec": {
+                "replicas": obj.replicas,
+                "selector": {"matchLabels": dict(obj.selector)},
+                "template": obj.template,
+            },
+        }
+    if kind == "cronjobs":
+        return {
+            "kind": "CronJob",
+            "apiVersion": "batch/v1beta1",
+            "metadata": {"name": obj.name, "namespace": obj.namespace,
+                         "uid": obj.uid},
+            "spec": _drop_empty({
+                "schedule": obj.schedule,
+                "jobTemplate": obj.job_template,
+                "concurrencyPolicy": obj.concurrency_policy,
+                "suspend": obj.suspend,
+            }),
+        }
     if kind == "replicasets":
         return {
             "kind": "ReplicaSet",

@@ -50,6 +50,7 @@ SYSTEM_PRIORITY_CLASSES = {
 NAMESPACED_KINDS = (
     "pods", "services", "replicasets", "deployments", "jobs", "endpoints",
     "poddisruptionbudgets", "limitranges", "resourcequotas",
+    "daemonsets", "statefulsets", "cronjobs",
 )
 
 

@@ -44,6 +44,9 @@ LIST_KINDS = {"pods": "PodList", "nodes": "NodeList",
               "poddisruptionbudgets": "PodDisruptionBudgetList",
               "endpoints": "EndpointsList",
               "jobs": "JobList",
+              "daemonsets": "DaemonSetList",
+              "statefulsets": "StatefulSetList",
+              "cronjobs": "CronJobList",
               "namespaces": "NamespaceList",
               "limitranges": "LimitRangeList",
               "resourcequotas": "ResourceQuotaList",
@@ -130,6 +133,56 @@ def _decode(kind: str, d: dict):
             "name": meta.get("name", ""),
             "selector": dict((d.get("spec") or {}).get("selector") or {}),
         }
+    if kind == "daemonsets":
+        from kubernetes_tpu.runtime.controllers import DaemonSet
+
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        ds = DaemonSet(
+            namespace=meta.get("namespace", "default"),
+            name=meta.get("name", ""),
+            selector=dict((spec.get("selector") or {}).get("matchLabels") or {}),
+            template=spec.get("template") or {},
+        )
+        if meta.get("uid"):
+            ds.uid = meta["uid"]
+        return ds
+    if kind == "statefulsets":
+        from kubernetes_tpu.runtime.controllers import StatefulSet
+
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        st = StatefulSet(
+            namespace=meta.get("namespace", "default"),
+            name=meta.get("name", ""),
+            replicas=int(spec.get("replicas", 1)),
+            selector=dict((spec.get("selector") or {}).get("matchLabels") or {}),
+            template=spec.get("template") or {},
+        )
+        if meta.get("uid"):
+            st.uid = meta["uid"]
+        return st
+    if kind == "cronjobs":
+        import time as _time
+
+        from kubernetes_tpu.runtime.controllers import CronJob, cron_matches
+
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        # reject malformed schedules at the write path (422), not at tick
+        # time (cronjob strategy validation)
+        cron_matches(spec.get("schedule", "* * * * *"), _time.localtime())
+        cj = CronJob(
+            namespace=meta.get("namespace", "default"),
+            name=meta.get("name", ""),
+            schedule=spec.get("schedule", "* * * * *"),
+            job_template=spec.get("jobTemplate") or {},
+            concurrency_policy=spec.get("concurrencyPolicy", "Allow"),
+            suspend=bool(spec.get("suspend", False)),
+        )
+        if meta.get("uid"):
+            cj.uid = meta["uid"]
+        return cj
     if kind == "jobs":
         from kubernetes_tpu.runtime.controllers import Job
 
@@ -261,6 +314,8 @@ class APIServer:
         elif parts[:3] == ["apis", "policy", "v1beta1"]:
             rest = parts[3:]
         elif parts[:3] == ["apis", "batch", "v1"]:
+            rest = parts[3:]
+        elif parts[:3] == ["apis", "batch", "v1beta1"]:
             rest = parts[3:]
         elif parts[:3] == ["apis", "metrics.k8s.io", "v1beta1"]:
             rest = ["@metrics"] + parts[3:]

@@ -48,7 +48,8 @@ class LocalCluster:
     KINDS = ("nodes", "pods", "services", "leases", "replicasets",
              "poddisruptionbudgets", "endpoints", "deployments", "jobs",
              "namespaces", "limitranges", "resourcequotas",
-             "priorityclasses", "customresourcedefinitions", "apiservices")
+             "priorityclasses", "customresourcedefinitions", "apiservices",
+             "daemonsets", "statefulsets", "cronjobs")
 
     def __init__(self) -> None:
         self._lock = threading.RLock()

@@ -432,6 +432,9 @@ class ControllerManager:
         self.gc = GarbageCollector(cluster)
         self.podgc = PodGCController(cluster)
         self.quota = ResourceQuotaController(cluster)
+        self.daemonset = DaemonSetController(cluster)
+        self.statefulset = StatefulSetController(cluster)
+        self.cronjob = CronJobController(cluster)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -448,6 +451,9 @@ class ControllerManager:
         self._threads += self.gc.run(self._stop)
         self._threads.append(self.podgc.run(self._stop))
         self._threads += self.quota.run(self._stop)
+        self._threads += self.daemonset.run(self._stop)
+        self._threads += self.statefulset.run(self._stop)
+        self._threads.append(self.cronjob.run(self._stop))
 
         def gc_resweep():
             while not self._stop.wait(30.0):
@@ -467,6 +473,8 @@ class ControllerManager:
         self.namespace.queue.close()
         self.gc.queue.close()
         self.quota.queue.close()
+        self.daemonset.queue.close()
+        self.statefulset.queue.close()
 
 
 # ---------------------------------------------------------------- disruption
@@ -734,6 +742,7 @@ class Job:
     template: dict = field(default_factory=dict)
     backoff_limit: int = 6
     uid: str = field(default_factory=lambda: uuid.uuid4().hex)
+    owner_uid: str = ""   # owning CronJob's uid ("" = standalone)
     # status (controller-maintained; succeeded/complete are MONOTONIC —
     # deleting a terminal pod cannot un-complete finished work)
     succeeded: int = 0
@@ -917,13 +926,24 @@ class GarbageCollector(Reconciler):
     its owner's DELETED event is still collected.  Deletes are idempotent,
     so racing the per-controller cascades is harmless."""
 
+    # owner store kind -> the owner_kind string its dependents carry
+    OWNER_KINDS = {
+        "replicasets": "ReplicaSet",
+        "jobs": "Job",
+        "daemonsets": "DaemonSet",
+        "statefulsets": "StatefulSet",
+        # edge owners with non-pod dependents handled in sync():
+        "deployments": "Deployment",   # -> ReplicaSets
+        "cronjobs": "CronJob",         # -> Jobs
+    }
+
     def _on_event(self, event: str, kind: str, obj) -> None:
-        if event == "DELETED" and kind in ("replicasets", "deployments", "jobs"):
+        if event == "DELETED" and kind in self.OWNER_KINDS:
             self.queue.add(("sweep", kind))
 
     def sweep_all(self) -> None:
         """Periodic full resweep (graph_builder's monitors resync analog)."""
-        for kind in ("replicasets", "deployments", "jobs"):
+        for kind in self.OWNER_KINDS:
             self.queue.add(("sweep", kind))
 
     def _owner_uids(self, kind: str) -> set:
@@ -932,12 +952,6 @@ class GarbageCollector(Reconciler):
     def sync(self, key) -> None:
         _, owner_kind = key
         live = self._owner_uids(owner_kind)
-        if owner_kind == "replicasets":
-            owner_name = "ReplicaSet"
-        elif owner_kind == "deployments":
-            owner_name = "Deployment"
-        else:
-            owner_name = "Job"
         if owner_kind == "deployments":
             # Deployment -> ReplicaSet edge: orphaned RSes cascade (their
             # own deletion events then sweep their pods)
@@ -945,6 +959,13 @@ class GarbageCollector(Reconciler):
                 if rs.owner_uid and rs.owner_uid not in live:
                     self.cluster.delete("replicasets", rs.namespace, rs.name)
             return
+        if owner_kind == "cronjobs":
+            # CronJob -> Job edge (the Job's own deletion sweeps its pods)
+            for job in list(self.cluster.list("jobs")):
+                if job.owner_uid and job.owner_uid not in live:
+                    self.cluster.delete("jobs", job.namespace, job.name)
+            return
+        owner_name = self.OWNER_KINDS[owner_kind]
         for pod in list(self.cluster.list("pods")):
             ou = pod.metadata.owner_uid
             if (
@@ -1040,3 +1061,321 @@ class ResourceQuotaController(Reconciler):
             new = dict(q)
             new["status"] = {**status, "hard": dict(hard), "used": used}
             self.cluster.update("resourcequotas", new, expect_rv=rv)
+
+
+# ----------------------------------------------------------------- daemonset
+
+
+@dataclass
+class DaemonSet:
+    """apps/v1 DaemonSet slice: one pod per eligible node."""
+
+    namespace: str
+    name: str
+    selector: Dict[str, str]
+    template: dict
+    uid: str = field(default_factory=lambda: uuid.uuid4().hex)
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.namespace, self.name)
+
+
+class DaemonSetController(Reconciler):
+    """pkg/controller/daemon syncDaemonSet: ensure exactly one owned pod on
+    every node that should run the daemon.  Placement follows the classic
+    controller-scheduled behavior (spec.nodeName set directly by the
+    controller; the ScheduleDaemonSetPods feature moved this to the default
+    scheduler in later versions — daemon pods here bypass the queue the
+    same way).  Node eligibility: schedulable nodes whose NoSchedule/
+    NoExecute taints the template tolerates (nodeShouldRunDaemonPod)."""
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "daemonsets":
+            self.queue.add(obj.key)
+        elif kind in ("nodes",):
+            for ds in self.cluster.list("daemonsets"):
+                self.queue.add(ds.key)
+        elif kind == "pods" and getattr(obj.metadata, "owner_kind", "") == "DaemonSet":
+            for ds in self.cluster.list("daemonsets"):
+                if ds.uid == obj.metadata.owner_uid:
+                    self.queue.add(ds.key)
+                    break
+
+    def _eligible(self, ds: DaemonSet) -> List[Node]:
+        tmpl_tols = [
+            t for t in (ds.template.get("spec") or {}).get("tolerations") or []
+        ]
+        from kubernetes_tpu.api.types import Toleration
+
+        tols = [Toleration.from_dict(t) for t in tmpl_tols]
+        out = []
+        for node in self.cluster.list("nodes"):
+            if node.spec.unschedulable:
+                continue
+            blocked = False
+            for taint in node.spec.taints:
+                if taint.effect not in (TAINT_NO_SCHEDULE, TAINT_NO_EXECUTE):
+                    continue
+                if not any(t.tolerates(taint) for t in tols):
+                    blocked = True
+                    break
+            if not blocked:
+                out.append(node)
+        return out
+
+    def sync(self, key: Tuple[str, str]) -> None:
+        ns, name = key
+        ds = self.cluster.get("daemonsets", ns, name)
+        if ds is None:
+            live = {d.uid for d in self.cluster.list("daemonsets")}
+            for p in self.cluster.list("pods"):
+                if (
+                    p.metadata.owner_kind == "DaemonSet"
+                    and p.metadata.owner_uid not in live
+                ):
+                    self.cluster.delete("pods", p.namespace, p.name)
+            return
+        want = {n.name for n in self._eligible(ds)}
+        have: Dict[str, Pod] = {}
+        for p in list(self.cluster.list("pods")):
+            if p.namespace != ns or p.metadata.owner_uid != ds.uid:
+                continue
+            if p.status.phase in ("Succeeded", "Failed"):
+                # a dead daemon pod holds its deterministic name; delete it
+                # so the replacement create below can't name-conflict
+                self.cluster.delete("pods", p.namespace, p.name)
+                continue
+            have[p.spec.node_name] = p
+        for node_name in want - set(have):
+            d = dict(ds.template)
+            meta = dict(d.get("metadata") or {})
+            meta["name"] = f"{ds.name}-{node_name}"
+            meta["namespace"] = ns
+            meta["ownerReferences"] = [
+                {"kind": "DaemonSet", "name": ds.name, "uid": ds.uid,
+                 "controller": True}
+            ]
+            d["metadata"] = meta
+            spec = dict(d.get("spec") or {})
+            spec["nodeName"] = node_name  # controller-scheduled
+            d["spec"] = spec
+            try:
+                self.cluster.create("pods", Pod.from_dict(d))
+            except ConflictError:
+                pass  # stale view; next event reconverges
+        for node_name in set(have) - want:
+            p = have[node_name]
+            self.cluster.delete("pods", p.namespace, p.name)
+
+
+# ---------------------------------------------------------------- statefulset
+
+
+@dataclass
+class StatefulSet:
+    """apps/v1 StatefulSet slice: ordered, stable-identity replicas."""
+
+    namespace: str
+    name: str
+    replicas: int
+    selector: Dict[str, str]
+    template: dict
+    uid: str = field(default_factory=lambda: uuid.uuid4().hex)
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.namespace, self.name)
+
+
+class StatefulSetController(Reconciler):
+    """pkg/controller/statefulset: pods are <name>-0..<name>-N-1 with stable
+    identity; OrderedReady semantics — pod i is created only after pods
+    0..i-1 exist and are Running, scale-down removes the highest ordinal
+    first (one step per sync; events drive reconvergence)."""
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "statefulsets":
+            self.queue.add(obj.key)
+        elif kind == "pods" and getattr(obj.metadata, "owner_kind", "") == "StatefulSet":
+            for st in self.cluster.list("statefulsets"):
+                if st.uid == obj.metadata.owner_uid:
+                    self.queue.add(st.key)
+                    break
+
+    def sync(self, key: Tuple[str, str]) -> None:
+        ns, name = key
+        st = self.cluster.get("statefulsets", ns, name)
+        if st is None:
+            live = {s.uid for s in self.cluster.list("statefulsets")}
+            for p in self.cluster.list("pods"):
+                if (
+                    p.metadata.owner_kind == "StatefulSet"
+                    and p.metadata.owner_uid not in live
+                ):
+                    self.cluster.delete("pods", p.namespace, p.name)
+            return
+        owned: Dict[int, Pod] = {}
+        prefix = f"{st.name}-"
+        for p in list(self.cluster.list("pods")):
+            if (
+                p.namespace == ns
+                and p.metadata.owner_uid == st.uid
+                and p.name.startswith(prefix)
+            ):
+                if p.status.phase in ("Succeeded", "Failed"):
+                    # stable identity means replace-in-place: delete the
+                    # dead pod so its ordinal can be recreated (the
+                    # reference StatefulSet controller does the same)
+                    self.cluster.delete("pods", p.namespace, p.name)
+                    continue
+                try:
+                    owned[int(p.name[len(prefix):])] = p
+                except ValueError:
+                    pass
+        # scale down: highest ordinal first, one at a time
+        extra = [i for i in sorted(owned, reverse=True) if i >= st.replicas]
+        if extra:
+            p = owned[extra[0]]
+            self.cluster.delete("pods", p.namespace, p.name)
+            return
+        # scale up: lowest missing ordinal, only if all predecessors Running
+        for i in range(st.replicas):
+            if i in owned:
+                if owned[i].status.phase != "Running":
+                    return  # OrderedReady: wait for predecessor
+                continue
+            d = dict(st.template)
+            meta = dict(d.get("metadata") or {})
+            meta["name"] = f"{st.name}-{i}"
+            meta["namespace"] = ns
+            meta["ownerReferences"] = [
+                {"kind": "StatefulSet", "name": st.name, "uid": st.uid,
+                 "controller": True}
+            ]
+            d["metadata"] = meta
+            try:
+                self.cluster.create("pods", Pod.from_dict(d))
+            except ConflictError:
+                pass
+            return  # one creation per sync; the pod's Running event resumes
+
+
+# -------------------------------------------------------------------- cronjob
+
+
+def cron_matches(expr: str, t: time.struct_time) -> bool:
+    """5-field cron (minute hour dom month dow) with *, */N, N, and
+    comma lists — the subset cronjob schedules actually use
+    (pkg/controller/cronjob uses robfig/cron)."""
+    fields = expr.split()
+    if len(fields) != 5:
+        raise ValueError(f"bad cron expression {expr!r}")
+    vals = (t.tm_min, t.tm_hour, t.tm_mday, t.tm_mon, (t.tm_wday + 1) % 7)
+
+    def field_ok(spec: str, v: int) -> bool:
+        ok = False
+        for part in spec.split(","):
+            if part == "*":
+                ok = True
+            elif part.startswith("*/"):
+                step = int(part[2:])  # raises on junk / ZeroDivision below
+                if step <= 0:
+                    raise ValueError(f"bad cron step {part!r}")
+                if v % step == 0:
+                    ok = True
+            elif part.isdigit():
+                if int(part) == v:
+                    ok = True
+            else:
+                raise ValueError(f"bad cron field {part!r} in {expr!r}")
+        return ok
+
+    return all(field_ok(f, v) for f, v in zip(fields, vals))
+
+
+@dataclass
+class CronJob:
+    """batch/v1beta1 CronJob slice."""
+
+    namespace: str
+    name: str
+    schedule: str
+    job_template: dict                     # {"spec": {... Job spec ...}}
+    concurrency_policy: str = "Allow"      # Allow | Forbid
+    suspend: bool = False
+    uid: str = field(default_factory=lambda: uuid.uuid4().hex)
+    last_schedule_minute: int = -1         # epoch-minute of last trigger
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.namespace, self.name)
+
+
+class CronJobController:
+    """pkg/controller/cronjob syncAll: a 10s poll (not watch-driven in the
+    reference either) that creates a Job whenever the schedule matches a
+    new minute; Forbid skips the tick while an owned Job is still active."""
+
+    def __init__(self, cluster: LocalCluster):
+        self.cluster = cluster
+
+    def tick(self, now: Optional[float] = None) -> int:
+        now = time.time() if now is None else now
+        minute = int(now // 60)
+        created = 0
+        for cj in self.cluster.list("cronjobs"):
+            # HandleError semantics PER CRONJOB: one bad schedule must not
+            # starve the others
+            try:
+                created += self._tick_one(cj, now, minute)
+            except Exception:
+                continue
+        return created
+
+    def _tick_one(self, cj: "CronJob", now: float, minute: int) -> int:
+        if cj.suspend or cj.last_schedule_minute == minute:
+            return 0
+        if not cron_matches(cj.schedule, time.localtime(now)):
+            return 0
+        if cj.concurrency_policy == "Forbid":
+            active = any(
+                j.owner_uid == cj.uid and not j.complete and not j.failed_state
+                for j in self.cluster.list("jobs")
+            )
+            if active:
+                return 0
+        spec = (cj.job_template.get("spec") or {})
+        job = Job(
+            namespace=cj.namespace,
+            name=f"{cj.name}-{minute}",
+            completions=int(spec.get("completions", 1)),
+            parallelism=int(spec.get("parallelism", 1)),
+            template=spec.get("template") or {},
+            backoff_limit=int(spec.get("backoffLimit", 6)),
+            owner_uid=cj.uid,
+        )
+        try:
+            self.cluster.create("jobs", job)
+        except ConflictError:
+            return 0
+        cj2, rv = self.cluster.get_with_rv("cronjobs", cj.namespace, cj.name)
+        if cj2 is not None:
+            self.cluster.update(
+                "cronjobs",
+                dataclasses.replace(cj2, last_schedule_minute=minute),
+                expect_rv=rv,
+            )
+        return 1
+
+    def run(self, stop: threading.Event, period: float = 10.0) -> threading.Thread:
+        def loop():
+            while not stop.wait(period):
+                try:
+                    self.tick()
+                except Exception:
+                    pass  # HandleError semantics: a bad cronjob can't kill the loop
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t

@@ -1,0 +1,281 @@
+"""DaemonSet / StatefulSet / CronJob controllers (pkg/controller/{daemon,
+statefulset,cronjob} analogs) and their REST wiring."""
+
+import dataclasses
+import time
+
+from kubernetes_tpu.runtime.cluster import LocalCluster
+from kubernetes_tpu.runtime.controllers import (
+    CronJob,
+    CronJobController,
+    DaemonSet,
+    DaemonSetController,
+    StatefulSet,
+    StatefulSetController,
+    cron_matches,
+)
+
+from fixtures import make_node, make_pod
+
+
+def _drain(ctrl, n=20):
+    for _ in range(n):
+        if not ctrl.process_one(timeout=0):
+            break
+
+
+TEMPLATE = {
+    "metadata": {"labels": {"app": "d"}},
+    "spec": {"containers": [{"name": "c", "resources": {
+        "requests": {"cpu": "10m", "memory": "16Mi"}}}]},
+}
+
+
+def test_daemonset_one_pod_per_eligible_node():
+    cluster = LocalCluster()
+    for i in range(3):
+        cluster.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    cluster.add_node(make_node(
+        "tainted", cpu="4", mem="8Gi",
+        taints=[{"key": "dedicated", "value": "x", "effect": "NoSchedule"}],
+    ))
+    ctrl = DaemonSetController(cluster)
+    ds = DaemonSet(namespace="default", name="agent",
+                   selector={"app": "d"}, template=TEMPLATE)
+    cluster.create("daemonsets", ds)
+    _drain(ctrl)
+    pods = cluster.list("pods")
+    assert {p.spec.node_name for p in pods} == {"n0", "n1", "n2"}
+    # a new node gets its daemon; a removed node's pod goes away
+    cluster.add_node(make_node("n3", cpu="4", mem="8Gi"))
+    _drain(ctrl)
+    assert {p.spec.node_name for p in cluster.list("pods")} == {
+        "n0", "n1", "n2", "n3"}
+    cluster.delete("nodes", "", "n0")
+    _drain(ctrl)
+    assert {p.spec.node_name for p in cluster.list("pods")} == {
+        "n1", "n2", "n3"}
+    # toleration opens the tainted node
+    tol_template = dict(TEMPLATE)
+    tol_template["spec"] = dict(TEMPLATE["spec"])
+    tol_template["spec"]["tolerations"] = [
+        {"key": "dedicated", "operator": "Exists", "effect": "NoSchedule"}
+    ]
+    ds2, rv = cluster.get_with_rv("daemonsets", "default", "agent")
+    cluster.update("daemonsets",
+                   dataclasses.replace(ds2, template=tol_template),
+                   expect_rv=rv)
+    _drain(ctrl)
+    assert "tainted" in {p.spec.node_name for p in cluster.list("pods")}
+    # DS deletion sweeps its pods
+    cluster.delete("daemonsets", "default", "agent")
+    _drain(ctrl)
+    assert cluster.list("pods") == []
+
+
+def test_statefulset_ordered_scale_up_and_down():
+    cluster = LocalCluster()
+    ctrl = StatefulSetController(cluster)
+    st = StatefulSet(namespace="default", name="db", replicas=3,
+                     selector={"app": "d"}, template=TEMPLATE)
+    cluster.create("statefulsets", st)
+    _drain(ctrl)
+    # OrderedReady: only db-0 exists until it runs
+    names = sorted(p.name for p in cluster.list("pods"))
+    assert names == ["db-0"]
+
+    def mark_running(name):
+        p, rv = cluster.get_with_rv("pods", "default", name)
+        cluster.update(
+            "pods",
+            dataclasses.replace(
+                p, status=dataclasses.replace(p.status, phase="Running")
+            ),
+            expect_rv=rv,
+        )
+
+    mark_running("db-0")
+    _drain(ctrl)
+    assert sorted(p.name for p in cluster.list("pods")) == ["db-0", "db-1"]
+    mark_running("db-1")
+    _drain(ctrl)
+    mark_running("db-2")
+    assert sorted(p.name for p in cluster.list("pods")) == [
+        "db-0", "db-1", "db-2"]
+    # scale down removes the highest ordinal first
+    st2, rv = cluster.get_with_rv("statefulsets", "default", "db")
+    cluster.update("statefulsets", dataclasses.replace(st2, replicas=1),
+                   expect_rv=rv)
+    _drain(ctrl)
+    assert sorted(p.name for p in cluster.list("pods")) == ["db-0"]
+
+
+def test_cron_matches():
+    t = time.struct_time((2026, 7, 30, 10, 15, 0, 3, 211, 0))  # Thu 10:15
+    assert cron_matches("* * * * *", t)
+    assert cron_matches("*/5 * * * *", t)
+    assert cron_matches("15 10 * * *", t)
+    assert not cron_matches("16 10 * * *", t)
+    assert cron_matches("15 10 30 7 *", t)
+    assert not cron_matches("* * * * 0", t)  # Sunday
+    assert cron_matches("0,15,30 * * * *", t)
+
+
+def test_cronjob_creates_jobs_on_schedule():
+    cluster = LocalCluster()
+    ctrl = CronJobController(cluster)
+    cj = CronJob(namespace="default", name="backup", schedule="* * * * *",
+                 job_template={"spec": {"completions": 1,
+                                        "template": TEMPLATE}})
+    cluster.create("cronjobs", cj)
+    now = int(time.time() // 60) * 60 + 5  # mid-minute: +1s stays in-minute
+    assert ctrl.tick(now) == 1
+    jobs = cluster.list("jobs")
+    assert len(jobs) == 1 and jobs[0].name.startswith("backup-")
+    # same minute: no duplicate
+    assert ctrl.tick(now + 1) == 0
+    # next minute: Forbid skips while the first job is active
+    cj2, rv = cluster.get_with_rv("cronjobs", "default", "backup")
+    cluster.update("cronjobs",
+                   dataclasses.replace(cj2, concurrency_policy="Forbid"),
+                   expect_rv=rv)
+    assert ctrl.tick(now + 60) == 0
+    # completing the job unblocks the following tick
+    j, rv = cluster.get_with_rv("jobs", "default", jobs[0].name)
+    cluster.update("jobs", dataclasses.replace(j, complete=True),
+                   expect_rv=rv)
+    assert ctrl.tick(now + 120) == 1
+    # suspend stops everything
+    cj3, rv = cluster.get_with_rv("cronjobs", "default", "backup")
+    cluster.update("cronjobs", dataclasses.replace(cj3, suspend=True),
+                   expect_rv=rv)
+    assert ctrl.tick(now + 180) == 0
+
+
+def test_workload_kinds_rest_round_trip():
+    import json
+    import urllib.request
+
+    from kubernetes_tpu.apiserver import APIServer
+
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster).start()
+    try:
+        for path, payload in (
+            ("/apis/apps/v1/namespaces/default/daemonsets",
+             {"kind": "DaemonSet", "metadata": {"name": "d1"},
+              "spec": {"selector": {"matchLabels": {"app": "d"}},
+                       "template": TEMPLATE}}),
+            ("/apis/apps/v1/namespaces/default/statefulsets",
+             {"kind": "StatefulSet", "metadata": {"name": "s1"},
+              "spec": {"replicas": 2,
+                       "selector": {"matchLabels": {"app": "d"}},
+                       "template": TEMPLATE}}),
+            ("/apis/batch/v1beta1/namespaces/default/cronjobs",
+             {"kind": "CronJob", "metadata": {"name": "c1"},
+              "spec": {"schedule": "*/5 * * * *",
+                       "jobTemplate": {"spec": {"template": TEMPLATE}}}}),
+        ):
+            req = urllib.request.Request(
+                srv.url + path, data=json.dumps(payload).encode(),
+                method="POST", headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 201
+            with urllib.request.urlopen(
+                srv.url + path + "/" + payload["metadata"]["name"], timeout=10
+            ) as r:
+                back = json.loads(r.read())
+                assert back["metadata"]["name"] == payload["metadata"]["name"]
+        assert cluster.get("cronjobs", "default", "c1").schedule == "*/5 * * * *"
+        assert cluster.get("statefulsets", "default", "s1").replicas == 2
+    finally:
+        srv.stop()
+
+
+def test_daemonset_replaces_failed_pod():
+    cluster = LocalCluster()
+    cluster.add_node(make_node("n1", cpu="4", mem="8Gi"))
+    ctrl = DaemonSetController(cluster)
+    cluster.create("daemonsets", DaemonSet(
+        namespace="default", name="agent",
+        selector={"app": "d"}, template=TEMPLATE))
+    _drain(ctrl)
+    p, rv = cluster.get_with_rv("pods", "default", "agent-n1")
+    cluster.update("pods", dataclasses.replace(
+        p, status=dataclasses.replace(p.status, phase="Failed")), expect_rv=rv)
+    _drain(ctrl)
+    p2 = cluster.get("pods", "default", "agent-n1")
+    assert p2 is not None and p2.status.phase == "Pending"  # replaced, not stuck
+
+
+def test_statefulset_replaces_failed_ordinal():
+    cluster = LocalCluster()
+    ctrl = StatefulSetController(cluster)
+    cluster.create("statefulsets", StatefulSet(
+        namespace="default", name="db", replicas=2,
+        selector={"app": "d"}, template=TEMPLATE))
+    _drain(ctrl)
+    p, rv = cluster.get_with_rv("pods", "default", "db-0")
+    cluster.update("pods", dataclasses.replace(
+        p, status=dataclasses.replace(p.status, phase="Failed")), expect_rv=rv)
+    _drain(ctrl)
+    p2 = cluster.get("pods", "default", "db-0")
+    assert p2 is not None and p2.status.phase == "Pending"
+
+
+def test_cronjob_bad_schedule_isolated_and_rejected():
+    import pytest
+
+    cluster = LocalCluster()
+    ctrl = CronJobController(cluster)
+    # a bad schedule in the store cannot starve the good one
+    bad = CronJob(namespace="default", name="bad", schedule="nope nope",
+                  job_template={"spec": {"template": TEMPLATE}})
+    good = CronJob(namespace="default", name="good", schedule="* * * * *",
+                   job_template={"spec": {"template": TEMPLATE}})
+    cluster.create("cronjobs", bad)
+    cluster.create("cronjobs", good)
+    assert ctrl.tick(time.time()) == 1
+    assert any(j.name.startswith("good-") for j in cluster.list("jobs"))
+    # and the REST write path rejects it up front (422)
+    with pytest.raises(ValueError):
+        cron_matches("abc * * * *", time.localtime())
+    with pytest.raises(ValueError):
+        cron_matches("*/0 * * * *", time.localtime())
+
+
+def test_cronjob_deletion_cascades_to_jobs_via_gc():
+    from kubernetes_tpu.runtime.controllers import GarbageCollector
+
+    cluster = LocalCluster()
+    ctrl = CronJobController(cluster)
+    gc = GarbageCollector(cluster)
+    cluster.create("cronjobs", CronJob(
+        namespace="default", name="backup", schedule="* * * * *",
+        job_template={"spec": {"template": TEMPLATE}}))
+    assert ctrl.tick(time.time()) == 1
+    cluster.delete("cronjobs", "default", "backup")
+    _drain(gc)
+    assert cluster.list("jobs") == []
+
+
+def test_forbid_ignores_other_cronjobs_jobs():
+    cluster = LocalCluster()
+    ctrl = CronJobController(cluster)
+    a = CronJob(namespace="default", name="backup", schedule="* * * * *",
+                concurrency_policy="Forbid",
+                job_template={"spec": {"template": TEMPLATE}})
+    b = CronJob(namespace="default", name="backup-db", schedule="* * * * *",
+                job_template={"spec": {"template": TEMPLATE}})
+    cluster.create("cronjobs", a)
+    cluster.create("cronjobs", b)
+    now = time.time()
+    assert ctrl.tick(now) == 2
+    # backup-db's ACTIVE job must not block backup's next run
+    for j in cluster.list("jobs"):
+        if j.owner_uid == a.uid:
+            j2, rv = cluster.get_with_rv("jobs", j.namespace, j.name)
+            cluster.update("jobs", dataclasses.replace(j2, complete=True),
+                           expect_rv=rv)
+    assert ctrl.tick(now + 60) == 2
