@@ -155,6 +155,14 @@ def pod_to_dict(pod: Pod) -> dict:
             "phase": pod.status.phase,
             "startTime": pod.status.start_time or None,
             "nominatedNodeName": pod.status.nominated_node_name,
+            "conditions": (
+                [{"type": "Ready", "status": "False"}]
+                if not pod.status.ready else None
+            ),
+            "containerStatuses": (
+                [{"restartCount": pod.status.restart_count}]
+                if pod.status.restart_count else None
+            ),
         }),
     }
 
@@ -280,6 +288,14 @@ def object_to_dict(kind: str, obj) -> dict:
                 "jobTemplate": obj.job_template,
                 "concurrencyPolicy": obj.concurrency_policy,
                 "suspend": obj.suspend,
+            }),
+            # status.lastScheduleTime round-trips the dedup state: a
+            # read-modify-write must not allow the same minute to fire twice
+            "status": _drop_empty({
+                "lastScheduleTime": (
+                    obj.last_schedule_minute * 60
+                    if obj.last_schedule_minute >= 0 else None
+                ),
             }),
         }
     if kind == "replicasets":

@@ -46,6 +46,24 @@ def is_best_effort(pod: "Pod") -> bool:
     return all(not c.requests and not c.limits for c in pod.spec.containers)
 
 
+def qos_class(pod: "Pod") -> str:
+    """GetPodQOS (pkg/apis/core/v1/helper/qos/qos.go:37-95): Guaranteed =
+    every container has limits == requests for cpu+memory; BestEffort = no
+    requests/limits anywhere; Burstable = the rest."""
+    if is_best_effort(pod):
+        return "BestEffort"
+    # only the supported compute resources participate (qos.go
+    # supportedQoSComputeResources = {cpu, memory}): an extended-resource
+    # request must not demote a pod out of Guaranteed
+    for c in pod.spec.containers:
+        for res in ("cpu", "memory"):
+            if res not in c.limits:
+                return "Burstable"
+            if res in c.requests and c.requests[res] != c.limits[res]:
+                return "Burstable"
+    return "Guaranteed"
+
+
 def parse_time(v) -> Optional[float]:
     """Timestamp codec: the Kubernetes wire format serializes times as
     RFC3339 strings (metav1.Time); tests and internal callers may pass epoch
@@ -372,6 +390,13 @@ class PodStatus:
     # node name this pod preempted victims on and expects to land on
     # (ref v1.PodStatus.NominatedNodeName, scheduler.go:310-312)
     nominated_node_name: str = ""
+    # aggregate readiness (the Ready condition; endpoints only route to
+    # ready pods — pkg/controller/endpoint includes a pod iff
+    # podutil.IsPodReady)
+    ready: bool = True
+    # total container restarts (statusManager; incremented by the kubelet
+    # when a liveness probe fails and the container is recreated)
+    restart_count: int = 0
 
 
 @dataclass
@@ -421,6 +446,14 @@ class Pod:
                 phase=st.get("phase", "Pending"),
                 start_time=parse_time(st.get("startTime")) or 0.0,
                 nominated_node_name=st.get("nominatedNodeName", ""),
+                ready=not any(
+                    c.get("type") == "Ready" and c.get("status") == "False"
+                    for c in st.get("conditions") or []
+                ),
+                restart_count=sum(
+                    int(cs.get("restartCount", 0))
+                    for cs in st.get("containerStatuses") or []
+                ),
             ),
         )
 

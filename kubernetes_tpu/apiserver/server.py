@@ -172,6 +172,8 @@ def _decode(kind: str, d: dict):
         # reject malformed schedules at the write path (422), not at tick
         # time (cronjob strategy validation)
         cron_matches(spec.get("schedule", "* * * * *"), _time.localtime())
+        status = d.get("status") or {}
+        lst = status.get("lastScheduleTime")
         cj = CronJob(
             namespace=meta.get("namespace", "default"),
             name=meta.get("name", ""),
@@ -179,6 +181,9 @@ def _decode(kind: str, d: dict):
             job_template=spec.get("jobTemplate") or {},
             concurrency_policy=spec.get("concurrencyPolicy", "Allow"),
             suspend=bool(spec.get("suspend", False)),
+            last_schedule_minute=(
+                int(lst) // 60 if lst is not None else -1
+            ),
         )
         if meta.get("uid"):
             cj.uid = meta["uid"]

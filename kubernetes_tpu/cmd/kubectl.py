@@ -34,10 +34,13 @@ KIND_PATHS = {
     "jobs": "/apis/batch/v1/namespaces/{ns}/jobs",
     "job": "/apis/batch/v1/namespaces/{ns}/jobs",
     "daemonsets": "/apis/apps/v1/namespaces/{ns}/daemonsets",
+    "daemonset": "/apis/apps/v1/namespaces/{ns}/daemonsets",
     "ds": "/apis/apps/v1/namespaces/{ns}/daemonsets",
     "statefulsets": "/apis/apps/v1/namespaces/{ns}/statefulsets",
+    "statefulset": "/apis/apps/v1/namespaces/{ns}/statefulsets",
     "sts": "/apis/apps/v1/namespaces/{ns}/statefulsets",
     "cronjobs": "/apis/batch/v1beta1/namespaces/{ns}/cronjobs",
+    "cronjob": "/apis/batch/v1beta1/namespaces/{ns}/cronjobs",
     "cj": "/apis/batch/v1beta1/namespaces/{ns}/cronjobs",
     "namespaces": "/api/v1/namespaces",
     "ns": "/api/v1/namespaces",

@@ -1291,7 +1291,11 @@ def cron_matches(expr: str, t: time.struct_time) -> bool:
                 raise ValueError(f"bad cron field {part!r} in {expr!r}")
         return ok
 
-    return all(field_ok(f, v) for f, v in zip(fields, vals))
+    # evaluate EVERY field (no short-circuit): malformed later fields must
+    # raise regardless of whether an earlier field already failed to match,
+    # so write-path validation is time-independent
+    results = [field_ok(f, v) for f, v in zip(fields, vals)]
+    return all(results)
 
 
 @dataclass

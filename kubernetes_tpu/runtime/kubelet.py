@@ -27,7 +27,7 @@ import itertools
 import time
 from typing import Dict, List, Optional
 
-from kubernetes_tpu.api.types import Node, Pod, PodStatus, is_best_effort
+from kubernetes_tpu.api.types import Node, Pod, PodStatus, qos_class
 from kubernetes_tpu.runtime.cluster import ADDED, DELETED, MODIFIED, LocalCluster
 from kubernetes_tpu.runtime.controllers import renew_node_lease
 
@@ -75,6 +75,8 @@ class Kubelet:
         node: Node,
         runtime=None,
         completer=None,
+        liveness=None,
+        readiness=None,
         register: bool = True,
         subscribe: bool = True,
     ):
@@ -82,6 +84,12 @@ class Kubelet:
         self.node = node
         self.runtime = runtime if runtime is not None else FakeRuntime()
         self.completer = completer
+        # prober manager seam (pkg/kubelet/prober): callables pod -> bool.
+        # liveness False -> container restarted (sandbox recreated,
+        # restartCount++); readiness False -> Ready condition cleared
+        # (endpoints stop routing) without a restart.
+        self.liveness = liveness
+        self.readiness = readiness
         self.sandbox_of: Dict[tuple, str] = {}   # pod key -> sandbox id
         self.evictions: List[tuple] = []
         if register:
@@ -178,17 +186,78 @@ class Kubelet:
     def heartbeat(self, now: Optional[float] = None) -> None:
         renew_node_lease(self.cluster, self.node.name, now=now)
 
-    def eviction_tick(self) -> List[tuple]:
-        """pkg/kubelet/eviction slice: under MemoryPressure, evict
-        BestEffort pods (the lowest qos rank) — phase Failed, torn down,
-        recorded as an Evicted event.  Returns evicted pod keys."""
-        if self.node.status.conditions.get("MemoryPressure") != "True":
-            return []
-        evicted = []
+    def probe_tick(self) -> int:
+        """Prober manager sweep (pkg/kubelet/prober/prober_manager.go): run
+        liveness and readiness probes against every sandboxed Running pod.
+        Liveness failure kills + recreates the container (restartCount++);
+        readiness flips the Ready condition only.  Returns restarts."""
+        restarts = 0
         for key in list(self.sandbox_of):
             pod = self.cluster.get("pods", *key)
-            if pod is None or not is_best_effort(pod):
+            if pod is None or pod.status.phase != "Running":
                 continue
+            if self.liveness is not None and not self.liveness(pod):
+                self._teardown(key)
+                self.sandbox_of[key] = self.runtime.run_pod_sandbox(pod)
+                pod = dataclasses.replace(
+                    pod,
+                    status=dataclasses.replace(
+                        pod.status,
+                        restart_count=pod.status.restart_count + 1,
+                        # without a readiness probe a running container IS
+                        # ready (the reference defaults Ready=true); with
+                        # one, stay out of rotation until it passes
+                        ready=self.readiness is None,
+                    ),
+                )
+                self.cluster.update("pods", pod)
+                self.cluster.events.eventf(
+                    "Pod", pod.namespace, pod.name, "Warning", "Unhealthy",
+                    "liveness probe failed; container restarted",
+                )
+                restarts += 1
+                continue
+            if self.readiness is not None:
+                ready = bool(self.readiness(pod))
+                if ready != pod.status.ready:
+                    self.cluster.update(
+                        "pods",
+                        dataclasses.replace(
+                            pod,
+                            status=dataclasses.replace(
+                                pod.status, ready=ready
+                            ),
+                        ),
+                    )
+        return restarts
+
+    def eviction_tick(self, max_evict: Optional[int] = None) -> List[tuple]:
+        """pkg/kubelet/eviction (eviction_manager.go rankMemoryPressure):
+        under MemoryPressure, evict in QoS-then-priority order — every
+        BestEffort pod first; if none exist, the lowest-priority Burstable
+        (one per tick, Guaranteed last) — phase Failed, torn down, recorded
+        as an Evicted event.  Returns evicted pod keys."""
+        if self.node.status.conditions.get("MemoryPressure") != "True":
+            return []
+        ranked = []
+        for key in list(self.sandbox_of):
+            pod = self.cluster.get("pods", *key)
+            if pod is None:
+                continue
+            qos = qos_class(pod)
+            rank = {"BestEffort": 0, "Burstable": 1, "Guaranteed": 2}[qos]
+            ranked.append((rank, pod.spec.priority, key, pod))
+        ranked.sort(key=lambda r: (r[0], r[1]))
+        if not ranked:
+            return []
+        if any(r[0] == 0 for r in ranked):
+            victims = [r for r in ranked if r[0] == 0]
+        else:
+            victims = ranked[:1]  # non-BestEffort: shed one, reassess
+        if max_evict is not None:
+            victims = victims[:max_evict]
+        evicted = []
+        for _, _, key, pod in victims:
             self._teardown(key)
             self.cluster.update(
                 "pods",

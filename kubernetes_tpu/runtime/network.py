@@ -37,6 +37,7 @@ def _service_backends(cluster: LocalCluster, svc: dict) -> List[dict]:
             p.namespace == svc["namespace"]
             and p.spec.node_name
             and p.status.phase == "Running"
+            and p.status.ready  # IsPodReady: probes gate endpoint membership
             and sel.matches(p.labels)
         ):
             out.append({"pod": p.name, "node": p.spec.node_name})

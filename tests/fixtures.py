@@ -73,6 +73,7 @@ def make_pod(
     volumes: Sequence[dict] = (),
     requests: Optional[Dict[str, str]] = None,  # full request dict (extended
                                                 # resources, ephemeral-storage…)
+    limits: Optional[Dict[str, str]] = None,    # container limits dict
     init_requests: Sequence[Dict[str, str]] = (),  # one init container each
     extra_containers: Sequence[Dict[str, str]] = (),  # request dict each
 ) -> Pod:
@@ -81,11 +82,16 @@ def make_pod(
         req["cpu"] = cpu
     if mem is not None:
         req["memory"] = mem
+    resources: dict = {}
+    if req:
+        resources["requests"] = req
+    if limits:
+        resources["limits"] = dict(limits)
     containers = [
         {
             "name": "c0",
             "image": images[0] if images else "",
-            "resources": {"requests": req} if req else {},
+            "resources": resources,
             "ports": list(ports),
         }
     ]

@@ -109,3 +109,93 @@ def test_memory_pressure_evicts_best_effort_first_and_rs_replaces():
             break
     assert fresh and fresh[0].name != be_pod.name
     assert fresh[0].spec.node_name != be_node.node.name
+
+
+# ------------------------------------------------------------------ probes
+
+
+def test_liveness_restart_and_readiness_gate():
+    """Prober manager (pkg/kubelet/prober): liveness failure restarts the
+    container (restartCount++, fresh sandbox); readiness gates the Ready
+    condition and endpoints membership."""
+    import dataclasses
+
+    from kubernetes_tpu.runtime.network import EndpointsController
+
+    cluster = LocalCluster()
+    healthy = {"ok": True}
+    ready_state = {"ready": True}
+    kl = Kubelet(
+        cluster,
+        make_node("n1", cpu="4", mem="8Gi"),
+        liveness=lambda p: healthy["ok"],
+        readiness=lambda p: ready_state["ready"],
+    )
+    ep = EndpointsController(cluster)
+    cluster.add_service("default", "web", {"app": "w"})
+    pod = make_pod("p1", cpu="100m", mem="64Mi", labels={"app": "w"},
+                   node_name="n1")
+    cluster.add_pod(pod)
+
+    def drain():
+        for _ in range(10):
+            if not ep.process_one(timeout=0):
+                break
+
+    drain()
+    assert [a["pod"] for a in cluster.get("endpoints", "default", "web")
+            ["addresses"]] == ["p1"]
+    old_sandbox = kl.sandbox_of[("default", "p1")]
+    # liveness failure: restart + not-ready until the next healthy probe
+    healthy["ok"] = False
+    assert kl.probe_tick() == 1
+    p = cluster.get("pods", "default", "p1")
+    assert p.status.restart_count == 1 and not p.status.ready
+    assert kl.sandbox_of[("default", "p1")] != old_sandbox
+    drain()
+    assert cluster.get("endpoints", "default", "web")["addresses"] == []
+    # healthy again: readiness probe restores the endpoint
+    healthy["ok"] = True
+    kl.probe_tick()
+    assert cluster.get("pods", "default", "p1").status.ready
+    drain()
+    assert [a["pod"] for a in cluster.get("endpoints", "default", "web")
+            ["addresses"]] == ["p1"]
+    # readiness-only failure: no restart, just out of rotation
+    ready_state["ready"] = False
+    assert kl.probe_tick() == 0
+    p = cluster.get("pods", "default", "p1")
+    assert p.status.restart_count == 1 and not p.status.ready
+
+
+def test_eviction_ranks_qos_then_priority():
+    """eviction_manager rank: all BestEffort first; without BestEffort the
+    lowest-priority Burstable goes (one per tick); Guaranteed last."""
+    import dataclasses
+
+    cluster = LocalCluster()
+    node = make_node("n1", cpu="16", mem="64Gi")
+    node = dataclasses.replace(
+        node,
+        status=dataclasses.replace(
+            node.status,
+            conditions={**node.status.conditions, "MemoryPressure": "True"},
+        ),
+    )
+    kl = Kubelet(cluster, node)
+    # best-effort (no requests), burstable (requests only), guaranteed
+    be = make_pod("be", node_name="n1")
+    bu_low = make_pod("bu-low", cpu="100m", mem="64Mi", node_name="n1",
+                      priority=1)
+    bu_high = make_pod("bu-high", cpu="100m", mem="64Mi", node_name="n1",
+                       priority=100)
+    ga = make_pod("ga", cpu="100m", mem="64Mi",
+                  limits={"cpu": "100m", "memory": "64Mi"},
+                  node_name="n1", priority=0)
+    for p in (be, bu_low, bu_high, ga):
+        cluster.add_pod(p)
+    assert {k[1] for k in kl.eviction_tick()} == {"be"}
+    assert [k[1] for k in kl.eviction_tick()] == ["bu-low"]
+    assert [k[1] for k in kl.eviction_tick()] == ["bu-high"]
+    assert [k[1] for k in kl.eviction_tick()] == ["ga"]
+    assert kl.eviction_tick() == []
