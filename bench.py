@@ -339,6 +339,11 @@ def main():
 
     attempt = int(os.environ.get(_ATTEMPT_ENV, "0"))
     on_cpu = args.platform == "cpu" or os.environ.get("JAX_PLATFORMS") == "cpu"
+    if on_cpu and args.batch > 512:
+        # the TPU sweet spot (2048: one big launch amortizes the tunnel)
+        # inverts on CPU, where per-round grid cost scales with B and the
+        # encode/commit overlap does the amortizing
+        args.batch = 512
     lock = None
     if not on_cpu:  # cpu runs don't touch the tunnel; no serialization needed
         lock = _acquire_device_lock(args.lock_timeout)

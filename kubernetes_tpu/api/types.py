@@ -553,10 +553,12 @@ class NodeStatus:
     @staticmethod
     def from_dict(d: Optional[dict]) -> "NodeStatus":
         d = d or {}
+        # allocatable defaults to capacity when absent (the kubelet computes
+        # allocatable = capacity - reserved; a registration that reports
+        # only capacity means "nothing reserved" — v1.NodeStatus semantics)
+        alloc = d.get("allocatable") or d.get("capacity") or {}
         return NodeStatus(
-            allocatable={
-                k: parse_quantity(v) for k, v in (d.get("allocatable") or {}).items()
-            },
+            allocatable={k: parse_quantity(v) for k, v in alloc.items()},
             capacity={
                 k: parse_quantity(v) for k, v in (d.get("capacity") or {}).items()
             },

@@ -28,6 +28,7 @@ from __future__ import annotations
 import json
 import queue as _queue
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -163,15 +164,13 @@ def _decode(kind: str, d: dict):
             st.uid = meta["uid"]
         return st
     if kind == "cronjobs":
-        import time as _time
-
         from kubernetes_tpu.runtime.controllers import CronJob, cron_matches
 
         meta = d.get("metadata") or {}
         spec = d.get("spec") or {}
         # reject malformed schedules at the write path (422), not at tick
         # time (cronjob strategy validation)
-        cron_matches(spec.get("schedule", "* * * * *"), _time.localtime())
+        cron_matches(spec.get("schedule", "* * * * *"), time.localtime())
         status = d.get("status") or {}
         lst = status.get("lastScheduleTime")
         cj = CronJob(
@@ -215,6 +214,10 @@ def _decode(kind: str, d: dict):
         out = dict(d)
         out["namespace"] = d.get("namespace") or meta.get("namespace", "")
         out["name"] = d.get("name") or meta.get("name", "")
+        # the SERVER stamps renewTime: remote agents' clocks (and their
+        # monotonic epochs) are meaningless to the lease-age check the
+        # nodelifecycle controller runs on this process's clock
+        out["renew_time"] = time.monotonic()
         return out
     from kubernetes_tpu.apiserver.extensions import flatten_wire_dict
 

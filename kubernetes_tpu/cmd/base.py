@@ -25,6 +25,32 @@ def add_common_flags(p: argparse.ArgumentParser) -> None:
                    help="log level (klog.V analog)")
 
 
+def api_request(server: str, method: str, path: str, payload=None) -> dict:
+    """One HTTP helper for every CLI: JSON in/out, HTTP errors surfaced as
+    Status dicts (body preserved), unreachable server as a 503 Status."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    data = _json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        server.rstrip("/") + path, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return _json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        body = e.read().decode(errors="replace")
+        try:
+            return _json.loads(body)
+        except ValueError:
+            return {"kind": "Status", "code": e.code, "message": body}
+    except urllib.error.URLError as e:
+        return {"kind": "Status", "code": 503, "reason": "Unreachable",
+                "message": f"cannot reach apiserver {server}: {e.reason}"}
+
+
 def parse_hostport(addr: str, default_port: int) -> Tuple[str, int]:
     """'0.0.0.0:10251' / ':10251' / '10251' -> (host, port)."""
     if ":" in addr:
@@ -33,10 +59,14 @@ def parse_hostport(addr: str, default_port: int) -> Tuple[str, int]:
     return "0.0.0.0", int(addr or default_port)
 
 
-def apply_platform(platform: Optional[str]) -> None:
+def apply_platform(platform: Optional[str], verbosity: int = 0) -> None:
     """The axon-tunnel gotcha: env vars were consumed at interpreter start,
     so the cpu override must go through jax.config before first backend
-    touch (tests/conftest.py recipe)."""
+    touch (tests/conftest.py recipe).  Also initializes the leveled logger
+    (the component-base logs.go init step)."""
+    from kubernetes_tpu.utils import klog
+
+    klog.set_verbosity(verbosity)
     if platform == "cpu":
         from kubernetes_tpu.utils.jaxenv import force_cpu_mesh
 

@@ -42,7 +42,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    apply_platform(args.platform)
+    apply_platform(args.platform, args.verbosity)
 
     from kubernetes_tpu.cmd.base import build_wired_scheduler, load_component_config
     from kubernetes_tpu.cmd.scheduler import _sim_nodes

@@ -36,7 +36,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    apply_platform(args.platform)
+    apply_platform(args.platform, args.verbosity)
 
     from kubernetes_tpu.extender.server import ExtenderServer
     from kubernetes_tpu.runtime.cache import SchedulerCache

@@ -1,0 +1,247 @@
+"""Cluster bootstrap: the kubeadm analog.
+
+Reference: cmd/kubeadm (init/join/token/reset phases).  The standalone
+framework's control plane is one process, so `init` brings up the
+all-in-one server (apiserver + admission + scheduler + controllers, with
+optional on-disk store), mints a bootstrap token (kubeadm's
+bootstraptoken phase stores it as a Secret; here a store object), and
+writes a kubeconfig JSON.  `join --token ...` validates the token against
+the control plane and registers this "machine" as a node running a hollow
+kubelet (heartbeating leases, syncing pods).  `token list` / `reset`
+round out the lifecycle.
+
+    ktpuadm init --port 8001 [--data-dir DIR]       # prints join command
+    ktpuadm join --server http://H:P --token TOKEN --node-name worker-1
+    ktpuadm token list --server http://H:P
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import secrets
+import sys
+import threading
+import time
+
+from kubernetes_tpu.cmd.base import (
+    add_common_flags,
+    api_request as _req,
+    apply_platform,
+    wait_for_term,
+)
+from kubernetes_tpu.utils import klog
+
+TOKEN_NS = "kube-system"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kubeadm (kubernetes-tpu)")
+    add_common_flags(p)
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    init = sub.add_parser("init")
+    init.add_argument("--host", default="127.0.0.1")
+    init.add_argument("--port", type=int, default=8001)
+    init.add_argument("--data-dir", default="",
+                      help="persist the store under this directory")
+    init.add_argument("--kubeconfig", default="",
+                      help="where to write the kubeconfig JSON "
+                      "(default <data-dir or .>/admin.conf)")
+    init.add_argument("--hollow-nodes", type=int, default=0)
+    init.add_argument("--one-shot", action="store_true",
+                      help="bring the plane up, print the join line, exit "
+                      "(for tests; default blocks until SIGTERM)")
+
+    join = sub.add_parser("join")
+    join.add_argument("--server", required=True)
+    join.add_argument("--token", required=True)
+    join.add_argument("--node-name", default="")
+    join.add_argument("--cpu", default="8")
+    join.add_argument("--memory", default="32Gi")
+    join.add_argument("--one-shot", action="store_true",
+                      help="register + first heartbeat, then exit")
+
+    tok = sub.add_parser("token")
+    tok.add_argument("action", choices=("list", "create"))
+    tok.add_argument("--server", required=True)
+    return p
+
+
+def _mint_token() -> str:
+    """kubeadm token format: [a-z0-9]{6}.[a-z0-9]{16}."""
+    alphabet = "abcdefghijklmnopqrstuvwxyz0123456789"
+    pick = lambda n: "".join(secrets.choice(alphabet) for _ in range(n))
+    return f"{pick(6)}.{pick(16)}"
+
+
+def _store_token(server: str, token: str) -> None:
+    tid, _, tsecret = token.partition(".")
+    out = _req(server, "POST", f"/api/v1/namespaces/{TOKEN_NS}/services", {
+        "metadata": {"name": f"bootstrap-token-{tid}",
+                     "namespace": TOKEN_NS},
+        "spec": {"selector": {"token-secret": tsecret,
+                              "usage": "bootstrap"}},
+    })
+    if out.get("kind") == "Status" and out.get("code", 201) >= 400:
+        raise RuntimeError(
+            f"bootstrap token not stored: {out.get('message', out)}"
+        )
+
+
+def _check_token(server: str, token: str) -> bool:
+    tid, _, tsecret = token.partition(".")
+    out = _req(server, "GET",
+               f"/api/v1/namespaces/{TOKEN_NS}/services/bootstrap-token-{tid}")
+    if out.get("kind") == "Status" and out.get("code") == 503:
+        # connectivity, not credentials: surface the real problem
+        raise RuntimeError(out.get("message", "control plane unreachable"))
+    sel = ((out.get("spec") or {}).get("selector")
+           or out.get("selector") or {})
+    return sel.get("token-secret") == tsecret
+
+
+def cmd_init(args) -> int:
+    apply_platform(args.platform, args.verbosity)
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.apiserver.admission import default_admission_chain
+    from kubernetes_tpu.cmd.base import build_wired_scheduler, load_component_config
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+    from kubernetes_tpu.runtime.controllers import ControllerManager
+
+    if args.data_dir:
+        from kubernetes_tpu.runtime.persist import PersistentCluster
+
+        cluster = PersistentCluster(args.data_dir)
+    else:
+        cluster = LocalCluster()
+    srv = APIServer(
+        cluster=cluster, host=args.host, port=args.port,
+        admission=default_admission_chain(cluster),
+    ).start()
+    klog.infof("[init] control plane up at %s", srv.url)
+
+    sched = build_wired_scheduler(cluster, load_component_config(args.config))
+    threading.Thread(target=sched.run, daemon=True).start()
+    cm = ControllerManager(cluster)
+    cm.start()
+    klog.V(1).infof("[init] scheduler + controller-manager started")
+
+    token = _mint_token()
+    _store_token(srv.url, token)
+    kubeconfig = args.kubeconfig or os.path.join(
+        args.data_dir or ".", "admin.conf"
+    )
+    with open(kubeconfig, "w") as f:
+        json.dump({"server": srv.url, "token": token}, f)
+    klog.infof("[init] kubeconfig written to %s", kubeconfig)
+
+    if args.hollow_nodes:
+        from kubernetes_tpu.cmd.scheduler import _sim_nodes
+        from kubernetes_tpu.runtime.kubemark import HollowFleet
+
+        HollowFleet(cluster, _sim_nodes(args.hollow_nodes))
+        klog.infof("[init] %d hollow nodes registered", args.hollow_nodes)
+
+    print(
+        f"join with:\n  python -m kubernetes_tpu.cmd.kubeadm join "
+        f"--server {srv.url} --token {token}"
+    )
+    if args.one_shot:
+        sched.stop()
+        cm.stop()
+        srv.stop()
+        return 0
+    try:
+        wait_for_term()
+    finally:
+        sched.stop()
+        cm.stop()
+        srv.stop()
+    return 0
+
+
+def cmd_join(args) -> int:
+    apply_platform(args.platform, args.verbosity)
+    try:
+        ok = _check_token(args.server, args.token)
+    except RuntimeError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if not ok:
+        print("error: invalid bootstrap token", file=sys.stderr)
+        return 1
+    node_name = args.node_name or f"node-{secrets.token_hex(3)}"
+    out = _req(args.server, "POST", "/api/v1/nodes", {
+        "metadata": {"name": node_name,
+                     "labels": {"kubernetes.io/hostname": node_name}},
+        "status": {
+            "capacity": {"cpu": args.cpu, "memory": args.memory,
+                         "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    })
+    if out.get("kind") == "Status" and out.get("code", 201) >= 400:
+        print(f"error: {out.get('message', out)}", file=sys.stderr)
+        return 1
+    klog.infof("[join] node %s registered at %s", node_name, args.server)
+
+    def heartbeat_loop():
+        while True:
+            _req(args.server, "PUT",
+                 f"/api/v1/namespaces/kube-node-lease/leases/{node_name}",
+                 {"namespace": "kube-node-lease", "name": node_name,
+                  "renew_time": time.monotonic()})
+            time.sleep(5.0)
+
+    # first heartbeat synchronously (lease create-or-update)
+    _req(args.server, "POST", "/api/v1/namespaces/kube-node-lease/leases",
+         {"namespace": "kube-node-lease", "name": node_name,
+          "renew_time": time.monotonic()})
+    if args.one_shot:
+        print(f"node {node_name} joined")
+        return 0
+    threading.Thread(target=heartbeat_loop, daemon=True).start()
+    wait_for_term()
+    return 0
+
+
+def cmd_token(args) -> int:
+    if args.action == "list":
+        out = _req(args.server, "GET",
+                   f"/api/v1/namespaces/{TOKEN_NS}/services")
+        if out.get("kind") == "Status" and out.get("code", 200) >= 400:
+            print(f"error: {out.get('message', out)}", file=sys.stderr)
+            return 1
+        for item in out.get("items") or []:
+            name = (item.get("metadata") or {}).get("name") or item.get("name", "")
+            if name.startswith("bootstrap-token-"):
+                print(name[len("bootstrap-token-"):])
+        return 0
+    if args.action == "create":
+        token = _mint_token()
+        try:
+            _store_token(args.server, token)
+        except RuntimeError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(token)
+        return 0
+    return 2
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.verb == "init":
+        return cmd_init(args)
+    if args.verb == "join":
+        return cmd_join(args)
+    if args.verb == "token":
+        return cmd_token(args)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,8 +15,8 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import urllib.error
-import urllib.request
+
+from kubernetes_tpu.cmd.base import api_request as _req
 
 KIND_PATHS = {
     "pods": "/api/v1/namespaces/{ns}/pods",
@@ -98,26 +98,6 @@ def _resolve_path(server: str, kind: str, ns: str, name: str = "") -> str:
             base = _crd_collection(spec, ns)
         return f"{base}/{name}" if name else base
     raise SystemExit(f"error: unknown resource kind {kind!r}")
-
-
-def _req(server: str, method: str, path: str, payload=None) -> dict:
-    data = json.dumps(payload).encode() if payload is not None else None
-    req = urllib.request.Request(
-        server.rstrip("/") + path, data=data, method=method,
-        headers={"Content-Type": "application/json"},
-    )
-    try:
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            return json.loads(resp.read() or b"{}")
-    except urllib.error.HTTPError as e:
-        body = e.read().decode(errors="replace")
-        try:
-            return json.loads(body)
-        except ValueError:
-            return {"kind": "Status", "code": e.code, "message": body}
-    except urllib.error.URLError as e:
-        return {"kind": "Status", "code": 503,
-                "message": f"cannot reach apiserver {server}: {e.reason}"}
 
 
 def _path(kind: str, ns: str, name: str = "") -> str:

@@ -92,7 +92,9 @@ def make_speculative_scheduler(
     caller can overlap its fetch with the next batch's dispatch."""
     w = None if weights is None else np.asarray(weights, np.float32)
 
-    def _impl(cluster, pods, pod_ports, conflict, last_index0, emask0, escore):
+    def _round(cluster, pods, pod_ports, conflict, escore, c):
+        """One propose-and-commit round (shared by the on-device while_loop
+        and the host-driven CPU loop)."""
         B = pods.valid.shape[0]
         N = cluster.allocatable.shape[0]
         reqf = pods.req.astype(jnp.float32)
@@ -101,87 +103,96 @@ def make_speculative_scheduler(
         pports_f = pod_ports.astype(jnp.float32)
         conflict_f = conflict.astype(jnp.float32)
         tril = jnp.tril(jnp.ones((B, B), jnp.float32), k=-1)
-
-        def cond(c):
-            return jnp.any(c["active"])
-
-        def body(c):
-            cl = dataclasses.replace(
-                cluster, requested=c["req"], nonzero_req=c["nz"]
+        cl = dataclasses.replace(
+            cluster, requested=c["req"], nonzero_req=c["nz"]
+        )
+        mask, _ = filter_batch(cl, pods, cfg, unsched_taint_key)
+        total, _ = score_batch(
+            cl, pods, weights=w, score_cfg=score_cfg,
+            zone_key_id=zone_key_id,
+        )
+        mask = mask & c["active"][:, None] & c["emask"] & pods.valid[:, None]
+        if percentage_of_nodes_to_score < 100:  # 0 = adaptive
+            lim = num_feasible_nodes_device(
+                jnp.sum(cl.valid.astype(jnp.int32)),
+                percentage_of_nodes_to_score,
             )
-            mask, _ = filter_batch(cl, pods, cfg, unsched_taint_key)
-            total, _ = score_batch(
-                cl, pods, weights=w, score_cfg=score_cfg,
-                zone_key_id=zone_key_id,
+            starts = c["li"] + jnp.arange(B, dtype=jnp.int32)
+            mask = jax.vmap(limit_feasible, in_axes=(0, None, 0))(
+                mask, lim, starts
             )
-            mask = mask & c["active"][:, None] & c["emask"] & pods.valid[:, None]
-            if percentage_of_nodes_to_score < 100:  # 0 = adaptive
-                lim = num_feasible_nodes_device(
-                    jnp.sum(cl.valid.astype(jnp.int32)),
-                    percentage_of_nodes_to_score,
-                )
-                starts = c["li"] + jnp.arange(B, dtype=jnp.int32)
-                mask = jax.vmap(limit_feasible, in_axes=(0, None, 0))(
-                    mask, lim, starts
-                )
-            total = total + escore
-            hosts, feasible = select_hosts_batch(total, mask, c["li"])
-            prop = c["active"] & feasible            # proposers this round
-            onehot = jax.nn.one_hot(hosts, N, dtype=jnp.float32)
-            onehot = onehot * prop[:, None].astype(jnp.float32)  # [B, N]
-            # earlier same-node proposers, as a strict-lower-triangle
-            # incidence product (batch order = commit order)
-            same = jnp.matmul(onehot, onehot.T, precision=_X)    # [B, B]
-            prior = same * tril
-            cum_req = jnp.matmul(prior, reqf, precision=_X)      # [B, R]
-            node_req = c["req"][hosts]                           # [B, R]
-            alloc_h = cluster.allocatable[hosts]
-            over = (reqf > 0) & (node_req + cum_req + reqf > alloc_h)
-            fits = ~jnp.any(over, axis=1)
-            # ports: conflict with claims already on the node OR with an
-            # earlier same-node proposer's wanted ports
-            prior_ports = jnp.matmul(prior, pports_f, precision=_X) > 0
-            claimed_h = c["claimed"][hosts]                      # [B, PV]
-            blocked = jnp.matmul(
-                (claimed_h | prior_ports).astype(jnp.float32),
-                conflict_f, precision=_X,
-            ) > 0
-            pconf = jnp.any(pports & blocked, axis=1)
-            accept = prop & fits & ~pconf
-            acc1 = onehot * accept[:, None].astype(jnp.float32)
-            # the accept pass is conservative (earlier proposers count even
-            # if they themselves bounce), which never overcommits but can
-            # bounce a pod that would fit the truly-accepted state.  Only
-            # ban the node (emask clear) when the bounce ALSO holds against
-            # accepted-only prior state — a conservatively-bounced pod keeps
-            # the node and retries next round.
-            prior_acc = prior * accept[None, :].astype(jnp.float32)
-            cum_acc = jnp.matmul(prior_acc, reqf, precision=_X)
-            over_acc = (reqf > 0) & (node_req + cum_acc + reqf > alloc_h)
-            fits_acc = ~jnp.any(over_acc, axis=1)
-            prior_ports_acc = jnp.matmul(prior_acc, pports_f, precision=_X) > 0
-            blocked_acc = jnp.matmul(
-                (claimed_h | prior_ports_acc).astype(jnp.float32),
-                conflict_f, precision=_X,
-            ) > 0
-            pconf_acc = jnp.any(pports & blocked_acc, axis=1)
-            real_bounce = prop & ~accept & (~fits_acc | pconf_acc)
-            return {
-                "hosts": jnp.where(accept, hosts, c["hosts"]),
-                "req": c["req"] + jnp.matmul(acc1.T, reqf, precision=_X),
-                "nz": c["nz"] + jnp.matmul(acc1.T, nzf, precision=_X),
-                "claimed": c["claimed"]
-                | (jnp.matmul(acc1.T, pports_f, precision=_X) > 0),
-                # really-bounced proposers never re-pick the node that
-                # bounced them (progress: the first active proposer of any
-                # contended node is always accepted or really bounced)
-                "emask": c["emask"] & ~((onehot > 0) & real_bounce[:, None]),
-                # retired: accepted, or nothing feasible this round
-                "active": c["active"] & feasible & ~accept,
-                "li": c["li"] + jnp.int32(B),
-            }
+        total = total + escore
+        hosts, feasible = select_hosts_batch(total, mask, c["li"])
+        prop = c["active"] & feasible            # proposers this round
+        # earlier same-node proposers: an equality comparison masked by
+        # the strict lower triangle (batch order = commit order) — B^2
+        # elementwise work, NOT a [B,N] incidence matmul, so the commit
+        # bookkeeping stays cheap on the CPU fallback too
+        same = (
+            (hosts[:, None] == hosts[None, :])
+            & prop[:, None] & prop[None, :]
+        )
+        prior = same.astype(jnp.float32) * tril              # [B, B]
+        cum_req = jnp.matmul(prior, reqf, precision=_X)      # [B, R]
+        node_req = c["req"][hosts]                           # [B, R]
+        alloc_h = cluster.allocatable[hosts]
+        over = (reqf > 0) & (node_req + cum_req + reqf > alloc_h)
+        fits = ~jnp.any(over, axis=1)
+        # ports: conflict with claims already on the node OR with an
+        # earlier same-node proposer's wanted ports
+        prior_ports = jnp.matmul(prior, pports_f, precision=_X) > 0
+        claimed_h = c["claimed"][hosts]                      # [B, PV]
+        blocked = jnp.matmul(
+            (claimed_h | prior_ports).astype(jnp.float32),
+            conflict_f, precision=_X,
+        ) > 0
+        pconf = jnp.any(pports & blocked, axis=1)
+        accept = prop & fits & ~pconf
+        accf = accept[:, None].astype(jnp.float32)
+        # the accept pass is conservative (earlier proposers count even
+        # if they themselves bounce), which never overcommits but can
+        # bounce a pod that would fit the truly-accepted state.  Only
+        # ban the node (emask clear) when the bounce ALSO holds against
+        # accepted-only prior state — a conservatively-bounced pod keeps
+        # the node and retries next round.
+        prior_acc = prior * accept[None, :].astype(jnp.float32)
+        cum_acc = jnp.matmul(prior_acc, reqf, precision=_X)
+        over_acc = (reqf > 0) & (node_req + cum_acc + reqf > alloc_h)
+        fits_acc = ~jnp.any(over_acc, axis=1)
+        prior_ports_acc = jnp.matmul(prior_acc, pports_f, precision=_X) > 0
+        blocked_acc = jnp.matmul(
+            (claimed_h | prior_ports_acc).astype(jnp.float32),
+            conflict_f, precision=_X,
+        ) > 0
+        pconf_acc = jnp.any(pports & blocked_acc, axis=1)
+        real_bounce = prop & ~accept & (~fits_acc | pconf_acc)
+        # committed state lands via scatter-add on the node axis (a
+        # segment-sum; XLA lowers it to a cheap scatter on every
+        # backend, where the old one_hot.T matmuls cost B*N*R flops)
+        return {
+            "hosts": jnp.where(accept, hosts, c["hosts"]),
+            "req": c["req"].at[hosts].add(reqf * accf),
+            "nz": c["nz"].at[hosts].add(nzf * accf),
+            "claimed": c["claimed"].at[hosts].max(
+                pports & accept[:, None]
+            ),
+            # really-bounced proposers never re-pick the node that
+            # bounced them (progress: the first active proposer of any
+            # contended node is always accepted or really bounced)
+            "emask": c["emask"] & ~(
+                real_bounce[:, None]
+                & (jnp.arange(N, dtype=jnp.int32)[None, :]
+                   == hosts[:, None])
+            ),
+            # retired: accepted, or nothing feasible this round
+            "active": c["active"] & feasible & ~accept,
+            "li": c["li"] + jnp.int32(B),
+        }
 
-        init = {
+    def _init_carry(cluster, pods, pod_ports, last_index0, emask0):
+        B = pods.valid.shape[0]
+        N = cluster.allocatable.shape[0]
+        return {
             "hosts": jnp.full((B,), -1, jnp.int32),
             "req": cluster.requested.astype(jnp.float32),
             "nz": cluster.nonzero_req.astype(jnp.float32),
@@ -190,8 +201,17 @@ def make_speculative_scheduler(
             "active": pods.valid,
             "li": jnp.asarray(last_index0, jnp.int32),
         }
-        out = lax.while_loop(cond, body, init)
-        return out["hosts"], out["req"], out["nz"]
+
+    def _impl(cluster, pods, pod_ports, conflict, last_index0, emask0, escore):
+        B = pods.valid.shape[0]
+        init = _init_carry(cluster, pods, pod_ports, last_index0, emask0)
+        out = lax.while_loop(
+            lambda c: jnp.any(c["active"]),
+            lambda c: _round(cluster, pods, pod_ports, conflict, escore, c),
+            init,
+        )
+        rounds = (out["li"] - jnp.asarray(last_index0, jnp.int32)) // B
+        return out["hosts"], out["req"], out["nz"], rounds
 
     @lru_cache(maxsize=64)
     def _packed_plain(meta):
@@ -219,6 +239,59 @@ def make_speculative_scheduler(
 
         return run
 
+    # ---- CPU path: host-driven rounds.  XLA:CPU executes while_loop bodies
+    # without intra-op thread parallelism, so the SAME round as a
+    # free-standing jit runs ~8x faster on the multicore host; the handful
+    # of tiny host syncs per batch are free without a tunnel.
+
+    @lru_cache(maxsize=64)
+    def _round_plain(meta):
+        @jax.jit
+        def run(cluster, bufs, c):
+            pods, pod_ports, conflict = unpack_tree(bufs, meta)
+            B = pods.valid.shape[0]
+            N = cluster.allocatable.shape[0]
+            return _round(
+                cluster, pods, pod_ports, conflict,
+                jnp.zeros((B, N), jnp.float32), c,
+            )
+
+        return run
+
+    @lru_cache(maxsize=64)
+    def _round_extras(meta):
+        @jax.jit
+        def run(cluster, bufs, c):
+            pods, pod_ports, conflict, emask0, escore = unpack_tree(bufs, meta)
+            return _round(cluster, pods, pod_ports, conflict, escore, c)
+
+        return run
+
+    @lru_cache(maxsize=64)
+    def _carry_init(meta):
+        @jax.jit
+        def run(cluster, bufs, last_index0):
+            parts = unpack_tree(bufs, meta)
+            pods, pod_ports = parts[0], parts[1]
+            B = pods.valid.shape[0]
+            N = cluster.allocatable.shape[0]
+            emask0 = (
+                parts[3].astype(jnp.bool_) if len(parts) == 5
+                else jnp.ones((B, N), jnp.bool_)
+            )
+            return _init_carry(cluster, pods, pod_ports, last_index0, emask0)
+
+        return run
+
+    def _host_rounds(cluster, bufs, meta, last_index0, extras: bool):
+        step = (_round_extras if extras else _round_plain)(meta)
+        c = _carry_init(meta)(cluster, bufs, np.int32(last_index0))
+        rounds = 0
+        while bool(np.asarray(c["active"]).any()):
+            c = step(cluster, bufs, c)
+            rounds += 1
+        return c["hosts"], c["req"], c["nz"], rounds
+
     def schedule(cluster: ClusterTensors, pods: PodBatch, ports,
                  last_index0, nominated=None, extra_mask=None,
                  extra_score=None, aff_state=None):
@@ -226,11 +299,17 @@ def make_speculative_scheduler(
             "speculative engine handles the plain fast path; affinity/"
             "nominated batches take the sequential scan"
         )
+        on_cpu = jax.default_backend() == "cpu"
         if extra_mask is None and extra_score is None:
             bufs, meta = pack_tree((pods, ports.pod_ports, ports.conflict))
-            hosts, req, nz = _packed_plain(meta)(
-                cluster, bufs, np.int32(last_index0)
-            )
+            if on_cpu:
+                hosts, req, nz, rounds = _host_rounds(
+                    cluster, bufs, meta, last_index0, extras=False
+                )
+            else:
+                hosts, req, nz, rounds = _packed_plain(meta)(
+                    cluster, bufs, np.int32(last_index0)
+                )
         else:
             B, N = pods.valid.shape[0], cluster.valid.shape[0]
             emask = (
@@ -245,9 +324,15 @@ def make_speculative_scheduler(
             bufs, meta = pack_tree(
                 (pods, ports.pod_ports, ports.conflict, emask, esc)
             )
-            hosts, req, nz = _packed_extras(meta)(
-                cluster, bufs, np.int32(last_index0)
-            )
+            if on_cpu:
+                hosts, req, nz, rounds = _host_rounds(
+                    cluster, bufs, meta, last_index0, extras=True
+                )
+            else:
+                hosts, req, nz, rounds = _packed_extras(meta)(
+                    cluster, bufs, np.int32(last_index0)
+                )
+        schedule.last_rounds = rounds  # observability: repair rounds used
         new_cluster = dataclasses.replace(cluster, requested=req, nonzero_req=nz)
         return hosts, new_cluster
 

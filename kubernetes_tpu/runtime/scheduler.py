@@ -48,6 +48,7 @@ from kubernetes_tpu.runtime.events import (
     EventRecorder,
 )
 from kubernetes_tpu.runtime.queue import PriorityQueue
+from kubernetes_tpu.utils import klog
 from kubernetes_tpu.utils import metrics as m
 from kubernetes_tpu.utils.trace import Trace
 
@@ -408,6 +409,10 @@ class Scheduler:
     def _record_scheduled(self, pod: Pod, node_name: str, e2e: float) -> None:
         """Scheduled event + counters, only once a bind actually succeeded
         (scheduler.go:268 emits after bind, not at assume)."""
+        klog.V(2).infof(
+            "scheduled %s/%s to %s (%.1fms e2e)",
+            pod.namespace, pod.name, node_name, e2e * 1000,
+        )
         m.SCHEDULE_ATTEMPTS.inc(result=m.SCHEDULED)
         m.E2E_LATENCY.observe(e2e)
         self.recorder.eventf(
