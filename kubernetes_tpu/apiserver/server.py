@@ -596,7 +596,10 @@ class APIServer:
                         # stream is the 410 Gone analog — never drop silently
                         overflow.set()
 
-                outer.cluster.watch(fan)
+                # replay + end-of-replay BOOKMARK delivered under the store
+                # lock: no live event can precede the bookmark (the k8s
+                # watch-bookmark contract the reflector's atomic swap needs)
+                outer.cluster.watch(fan, bookmark=True)
                 try:
                     while not overflow.is_set():
                         try:
@@ -609,7 +612,10 @@ class APIServer:
                         line = json.dumps({
                             "type": event,
                             "kind": kind,
-                            "object": object_to_dict(kind, obj),
+                            "object": (
+                                object_to_dict(kind, obj)
+                                if obj is not None else None
+                            ),
                         }).encode() + b"\n"
                         self.wfile.write(
                             f"{len(line):x}\r\n".encode() + line + b"\r\n"

@@ -1,0 +1,12 @@
+"""Client machinery: the client-go analog (SURVEY.md layer 5)."""
+
+from kubernetes_tpu.client.reflector import (
+    Reflector,
+    RemoteBinder,
+    remote_unbinder,
+    remote_victim_deleter,
+)
+
+__all__ = [
+    "Reflector", "RemoteBinder", "remote_unbinder", "remote_victim_deleter",
+]

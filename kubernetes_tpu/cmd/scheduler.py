@@ -39,6 +39,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--healthz-bind-address", default=None,
                    help="host:port for /healthz and /metrics "
                    "(default from config, 0 disables)")
+    p.add_argument("--server", default=None,
+                   help="remote apiserver URL: reflect its state into a "
+                   "local mirror and POST bindings back (the real "
+                   "multi-process scheduler deployment)")
     p.add_argument("--leader-elect", action="store_true",
                    help="run behind a LocalCluster lease")
     p.add_argument("--leader-elect-identity", default="scheduler-0")
@@ -73,8 +77,43 @@ def main(argv=None) -> int:
     if args.batch_size:
         cc.batch_size = args.batch_size
 
-    cluster = LocalCluster()
-    sched = build_wired_scheduler(cluster, cc)
+    reflector = None
+    if args.server:
+        # remote mode: informer mirror in, every WRITE back to the remote
+        # apiserver — bind (Binding subresource), preemption victim delete,
+        # gang unbind (cmd/kube-scheduler against a real apiserver; SURVEY
+        # section 3.2 informer start + WaitForCacheSync)
+        from kubernetes_tpu.client import (
+            Reflector,
+            RemoteBinder,
+            remote_unbinder,
+            remote_victim_deleter,
+        )
+
+        if args.leader_elect:
+            # leases would live in each process's private mirror: every
+            # instance would elect itself; refuse instead of double-running
+            print("error: --leader-elect requires a shared store and is "
+                  "not supported with --server", file=sys.stderr)
+            return 2
+        if args.simulate_nodes or args.simulate_pods:
+            print("error: --simulate-* inject into the local mirror only "
+                  "(the next resync would destroy them); create the "
+                  "workload on the remote server instead", file=sys.stderr)
+            return 2
+        reflector = Reflector(args.server).start()
+        if not reflector.wait_for_sync(timeout=30.0):
+            print(f"error: cache sync against {args.server} timed out",
+                  file=sys.stderr)
+            return 1
+        cluster = reflector.mirror
+        sched = build_wired_scheduler(cluster, cc)
+        sched.binder = RemoteBinder(args.server)
+        sched.victim_deleter = remote_victim_deleter(args.server)
+        sched.unbinder = remote_unbinder(args.server)
+    else:
+        cluster = LocalCluster()
+        sched = build_wired_scheduler(cluster, cc)
 
     health = None
     addr = args.healthz_bind_address or cc.healthz_bind_address
@@ -94,7 +133,20 @@ def main(argv=None) -> int:
     try:
         if args.one_shot:
             t0 = time.monotonic()
-            target = args.simulate_pods
+            snapshot_keys = None
+            if args.server:
+                # remote mode: the workload is the PRE-DRAIN snapshot of
+                # pending pods — pods arriving mid-drain must corrupt
+                # neither the loop bound nor the exit status
+                snapshot_keys = {
+                    (p.namespace, p.name)
+                    for p in cluster.list("pods")
+                    if not p.spec.node_name
+                    and p.status.phase not in ("Succeeded", "Failed")
+                }
+                target = len(snapshot_keys)
+            else:
+                target = args.simulate_pods
             # drain until every pod has a verdict (scheduled OR failed once)
             # — unschedulable pods park+retry forever, so len(queue) alone
             # would spin; no-progress across a cycle also terminates
@@ -103,13 +155,19 @@ def main(argv=None) -> int:
                 before = len(sched.results)
                 sched.run_once(timeout=0.5)
                 for r in sched.results[before:]:
-                    seen.add((r.pod.namespace, r.pod.name))
+                    key = (r.pod.namespace, r.pod.name)
+                    if snapshot_keys is None or key in snapshot_keys:
+                        seen.add(key)
                 if len(sched.results) == before:
                     break
             dt = time.monotonic() - t0
             done = len({
                 (r.pod.namespace, r.pod.name)
-                for r in sched.results if r.node is not None
+                for r in sched.results
+                if r.node is not None and (
+                    snapshot_keys is None
+                    or (r.pod.namespace, r.pod.name) in snapshot_keys
+                )
             })
             print(json.dumps({
                 "pods_scheduled": done,

@@ -79,14 +79,20 @@ class LocalCluster:
         for w in list(self._watchers):
             w(event, kind, obj)
 
-    def watch(self, fn: Callable[[str, str, object], None]) -> None:
+    def watch(self, fn: Callable[[str, str, object], None],
+              bookmark: bool = False) -> None:
         """Subscribe; immediately replays the current state as ADDED events
-        (the reflector LIST+WATCH contract)."""
+        (the reflector LIST+WATCH contract).  With bookmark=True the replay
+        ends with a ("BOOKMARK", "", None) event delivered under the SAME
+        lock — no concurrent write can slip between the replay and the
+        bookmark, so a reflector can swap in the replayed state atomically."""
         with self._lock:
             self._watchers.append(fn)
             for kind in self.kinds:
                 for s in self._store[kind].values():
                     fn(ADDED, kind, s.obj)
+            if bookmark:
+                fn("BOOKMARK", "", None)
 
     def unwatch(self, fn: Callable[[str, str, object], None]) -> None:
         """Drop a subscription (watch-stream teardown)."""
