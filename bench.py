@@ -148,6 +148,8 @@ def run(args) -> dict:
     from tests.fixtures import make_node, make_pod
     from kubernetes_tpu.codec import SnapshotEncoder
     from kubernetes_tpu.models.batched import (
+        batch_has_pod_affinity,
+        encode_batch_affinity,
         encode_batch_ports,
         make_sequential_scheduler,
     )
@@ -175,7 +177,53 @@ def run(args) -> dict:
     t_nodes = time.monotonic() - t0
 
     def pending_pod(i):
+        """One pending pod in the selected workload shape — the
+        scheduler_bench_test.go:39-131 matrix: plain (BenchmarkScheduling),
+        node-affinity, pod-affinity, pod-anti-affinity variants."""
         d = i % n_deploy
+        if args.workload == "node-affinity":
+            # BenchmarkSchedulingNodeAffinity: required In-match on a label
+            return make_pod(
+                f"pod-{i}", cpu="100m", mem="256Mi",
+                labels={"app": f"dep-{d}"},
+                affinity={"nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{"matchExpressions": [
+                            # selective: only the ~2/3 tier-a nodes match
+                            {"key": "tier", "operator": "In",
+                             "values": ["a"]}
+                        ]}]}}},
+                owner=("ReplicaSet", f"rs-{d}"),
+            )
+        if args.workload == "pod-affinity":
+            # BenchmarkSchedulingPodAffinity: zone-level required affinity
+            # to the workload's own label (co-locate with mates)
+            return make_pod(
+                f"pod-{i}", cpu="100m", mem="256Mi",
+                labels={"app": f"dep-{d}"},
+                affinity={"podAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [{
+                        "labelSelector": {
+                            "matchLabels": {"app": f"dep-{d}"}},
+                        "topologyKey":
+                            "failure-domain.beta.kubernetes.io/zone",
+                    }]}},
+                owner=("ReplicaSet", f"rs-{d}"),
+            )
+        if args.workload == "pod-anti-affinity":
+            # BenchmarkSchedulingPodAntiAffinity: hostname-level required
+            # anti-affinity (one per node per group)
+            return make_pod(
+                f"pod-{i}", cpu="100m", mem="256Mi",
+                labels={"app": f"dep-{d}"},
+                affinity={"podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [{
+                        "labelSelector": {
+                            "matchLabels": {"app": f"dep-{d}"}},
+                        "topologyKey": "kubernetes.io/hostname",
+                    }]}},
+                owner=("ReplicaSet", f"rs-{d}"),
+            )
         return make_pod(
             f"pod-{i}",
             cpu="100m",
@@ -185,9 +233,15 @@ def run(args) -> dict:
             owner=("ReplicaSet", f"rs-{d}"),
         )
 
+    # affinity workloads carry required (anti-)affinity terms, which the
+    # speculative engine refuses by design (in-batch affinity state lives
+    # in the sequential scan); node-affinity is fine speculatively
+    engine = args.engine
+    if args.workload in ("pod-affinity", "pod-anti-affinity"):
+        engine = "sequential"
     make_engine = (
         make_speculative_scheduler
-        if args.engine == "speculative"
+        if engine == "speculative"
         else make_sequential_scheduler
     )
     fn = make_engine(
@@ -199,17 +253,29 @@ def run(args) -> dict:
     # the static leaves stay resident and chain through every batch (the
     # tunnel otherwise re-uploads ~70MB of label/taint/topology tensors
     # per call)
+    def build_aff_state(pods):
+        """In-batch affinity carry, identical for warmup and timed batches
+        (aff_state toggles the jit variant: warm and timed MUST agree, and
+        a tail batch must not retrace — build it whenever the workload
+        carries pod affinity, whatever the batch size)."""
+        if engine == "sequential" and batch_has_pod_affinity(pods):
+            return encode_batch_affinity(enc, pods)
+        return None
+
     pods = [pending_pod(i) for i in range(args.batch)]
+    warm_aff = build_aff_state(pods)
     batch = enc.encode_pods(pods)
     ports = encode_batch_ports(enc, pods)
     cluster = jax.device_put(enc.snapshot())
     warm = cluster
     for i in range(args.warmup):
-        # chain the device state exactly like the timed loop, and FETCH the
-        # result: on the tunnel-attached TPU the first device->host copy
-        # after compile pays a multi-second one-time setup cost
-        # (block_until_ready alone does not surface it)
-        hosts, warm = fn(warm, batch, ports, np.int32(i * args.batch))
+        # chain the device state exactly like the timed loop (incl. the
+        # in-batch affinity variant), and FETCH the result: on the
+        # tunnel-attached TPU the first device->host copy after compile
+        # pays a multi-second one-time setup cost (block_until_ready alone
+        # does not surface it)
+        hosts, warm = fn(warm, batch, ports, np.int32(i * args.batch),
+                         aff_state=warm_aff)
         np.asarray(hosts)
 
     # timed run: chain device state, host does cache-commit bookkeeping.
@@ -264,6 +330,11 @@ def run(args) -> dict:
     for start in range(0, args.pods, args.batch):
         n, pods = prebuilt[start]
         tp = time.monotonic()
+        # in-batch affinity carry (models/batched.py BatchAffinityState) so
+        # co-batched mates see each other — built BEFORE encode_pods, as
+        # the scheduler runtime does (novel topology keys must register
+        # before the TP-wide tensors are cut)
+        aff_state = build_aff_state(pods)
         batch = enc.encode_pods(pods)
         if n < args.batch:
             valid = np.array(batch.valid, bool)  # padded width, not args.batch
@@ -272,7 +343,8 @@ def run(args) -> dict:
         ports = encode_batch_ports(enc, pods)
         phases["encode"] += time.monotonic() - tp
         tp = time.monotonic()
-        hosts, state = fn(state, batch, ports, np.int32(last))
+        hosts, state = fn(state, batch, ports, np.int32(last),
+                          aff_state=aff_state)
         if hasattr(hosts, "copy_to_host_async"):
             hosts.copy_to_host_async()
         phases["dispatch"] += time.monotonic() - tp
@@ -291,7 +363,8 @@ def run(args) -> dict:
         "pods_scheduled": scheduled,
         "unschedulable": unschedulable,
         "batch": args.batch,
-        "engine": args.engine,
+        "engine": engine,
+        "workload": args.workload,
         "seconds": round(dt, 3),
         "node_encode_seconds": round(t_nodes, 3),
         "phases": {k: round(v, 3) for k, v in phases.items()},
@@ -314,6 +387,14 @@ def main():
     ap.add_argument("--nodes", type=int, default=5000)
     ap.add_argument("--pods", type=int, default=10000)
     ap.add_argument("--batch", type=int, default=2048)
+    ap.add_argument(
+        "--workload",
+        choices=("plain", "node-affinity", "pod-affinity",
+                 "pod-anti-affinity"),
+        default="plain",
+        help="scheduler_bench_test.go matrix variant (affinity workloads "
+        "force the sequential engine: in-batch affinity state lives there)",
+    )
     ap.add_argument(
         "--engine", choices=("speculative", "sequential"), default="speculative",
         help="speculative = parallel placement + conflict repair (fast path); "
