@@ -326,9 +326,18 @@ def run(args) -> dict:
     # "dispatch" is the async enqueue only; device compute + the D2H copy
     # surface in "fetch" (the np.asarray sync point); "commit" is pure host
     # bookkeeping
+    # affinity workloads evaluate REQUIRED predicates against the encoder's
+    # committed-pod pair tensors: batch k MUST be committed before batch
+    # k+1 encodes, or placements go blind to the previous batch and violate
+    # (anti-)affinity.  Plain workloads keep the overlap (only spread
+    # SCORES go one batch stale there, which the engine already accepts).
+    overlap_commit = args.workload in ("plain", "node-affinity")
     phases = {"encode": 0.0, "dispatch": 0.0, "fetch": 0.0, "commit": 0.0}
     for start in range(0, args.pods, args.batch):
         n, pods = prebuilt[start]
+        if not overlap_commit and in_flight is not None:
+            commit(*in_flight)
+            in_flight = None
         tp = time.monotonic()
         # in-batch affinity carry (models/batched.py BatchAffinityState) so
         # co-batched mates see each other — built BEFORE encode_pods, as

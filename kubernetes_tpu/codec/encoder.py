@@ -1478,12 +1478,16 @@ class SnapshotEncoder:
             self._pod_row_cache.clear()
             self._pod_cache_token = token
 
+        # cache-hit pods grouped by row key: one broadcast assignment per
+        # DISTINCT row per field instead of a per-pod python loop —
+        # controller-stamped workloads have ~20 distinct rows across
+        # thousands of pods, so this is ~100x fewer numpy calls
+        hit_groups: Dict[Tuple, List[int]] = {}
         for b, pod in enumerate(pods):
             ck = self._pod_static_key(pod)
             cached = self._pod_row_cache.get(ck) if ck is not None else None
             if cached is not None:
-                for k, v in cached.items():
-                    out[k][b] = v
+                hit_groups.setdefault(ck, []).append(b)
                 continue
             out["valid"][b] = True
             req = self._req_vector(pod.resource_request())
@@ -1591,6 +1595,12 @@ class SnapshotEncoder:
                 self._pod_row_cache[ck] = {
                     k: np.copy(v[b]) for k, v in out.items()
                 }
+
+        for ck, idxs in hit_groups.items():
+            cached = self._pod_row_cache[ck]
+            ia = np.asarray(idxs, np.intp)
+            for k, v in cached.items():
+                out[k][ia] = v
 
         # state-dependent, so computed fresh every call (outside the row
         # cache): per-node counts of existing pods matching ALL of each pod's
