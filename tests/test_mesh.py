@@ -128,3 +128,29 @@ def test_binpack_blockwise_sharded_matches_unsharded():
     got = what_if_sharded(reqs, shapes, mesh, max_bins=256)
     assert got == ref
     assert ref, "at least the largest shapes must pack everything"
+
+
+def test_speculative_engine_sharded_matches_unsharded():
+    """The one-launch speculative engine over the sharded node axis: the
+    scatter commits and cross-shard argmax reductions must produce the
+    SAME placements and committed columns as unsharded."""
+    from kubernetes_tpu.models.speculative import make_speculative_scheduler
+
+    enc, cluster, batch, ports = _world()
+    fn = make_speculative_scheduler(
+        unsched_taint_key=enc.interner.intern("node.kubernetes.io/unschedulable"),
+        zone_key_id=enc.getzone_key,
+    )
+    hosts_ref, new_ref = fn(cluster, batch, ports, np.int32(0))
+    hosts_ref = np.asarray(hosts_ref)
+    assert (hosts_ref[:12] >= 0).all(), "fixture must be schedulable"
+
+    mesh = make_mesh(N_DEV)
+    cluster_s, batch_s, ports_s = _shard_all(cluster, batch, ports, mesh)
+    with mesh:
+        hosts_s, new_s = fn(cluster_s, batch_s, ports_s, np.int32(0))
+    np.testing.assert_array_equal(np.asarray(hosts_s), hosts_ref)
+    np.testing.assert_allclose(
+        np.asarray(new_s.requested), np.asarray(new_ref.requested),
+        rtol=0, atol=0,
+    )
