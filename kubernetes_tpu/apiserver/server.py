@@ -239,8 +239,14 @@ class APIServer:
         host: str = "127.0.0.1",
         port: int = 0,
         admission: Optional[List[Callable[[str, str, dict], dict]]] = None,
+        audit_path: Optional[str] = None,
     ):
         self.cluster = cluster if cluster is not None else LocalCluster()
+        # API audit (staging/src/k8s.io/apiserver/pkg/audit): one JSON line
+        # per WRITE request — verb, path, response code, stage
+        # ResponseComplete — appended to audit_path when configured
+        self._audit_f = open(audit_path, "a") if audit_path else None
+        self._audit_lock = threading.Lock()
         # ordered admission chain (mutating-then-validating collapses to
         # "each plugin may mutate or raise")
         self.admission: List[Callable[[str, str, dict], dict]] = list(
@@ -274,8 +280,33 @@ class APIServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._audit_f is not None:
+            self._audit_f.close()
+            self._audit_f = None
 
     # ----------------------------------------------------------- admission
+
+    def _audit(self, verb: str, path: str, code: int) -> None:
+        """ResponseComplete audit event (audit/v1 Event slice: level
+        Metadata — verb/resource/code/timestamp, no request bodies)."""
+        if self._audit_f is None:
+            return
+        import time as _t
+
+        line = json.dumps({
+            "kind": "Event",
+            "apiVersion": "audit.k8s.io/v1",
+            "stage": "ResponseComplete",
+            "verb": verb.lower(),
+            "requestURI": path,
+            "responseStatus": {"code": code},
+            "stageTimestamp": _t.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", _t.gmtime()
+            ),
+        })
+        with self._audit_lock:
+            self._audit_f.write(line + "\n")
+            self._audit_f.flush()
 
     def _validate_extension(self, kind: str, body: dict) -> None:
         """CRD-specific write checks: establishment sanity for CRDs, and
@@ -803,4 +834,28 @@ class APIServer:
                 outer.cluster.delete(kind, store_ns, name)
                 self._status(200, "Success", "deleted")
 
+        # audit wiring: record the response code (send_response hook) and
+        # emit one ResponseComplete event per write request
+        real_send_response = Handler.send_response
+
+        def send_response(self, code, message=None):
+            self._audit_code = code
+            real_send_response(self, code, message)
+
+        Handler.send_response = send_response
+        for method, verb in (
+            ("do_POST", "create"), ("do_PUT", "update"),
+            ("do_DELETE", "delete"),
+        ):
+            inner = getattr(Handler, method)
+
+            def wrapped(self, _inner=inner, _verb=verb):
+                try:
+                    _inner(self)
+                finally:
+                    outer._audit(
+                        _verb, self.path, getattr(self, "_audit_code", 0)
+                    )
+
+            setattr(Handler, method, wrapped)
         return Handler

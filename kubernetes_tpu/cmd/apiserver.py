@@ -35,6 +35,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--with-controllers", action="store_true")
     p.add_argument("--hollow-nodes", type=int, default=0)
     p.add_argument(
+        "--audit-log", default="",
+        help="append one audit.k8s.io/v1 Event JSON line per write here",
+    )
+    p.add_argument(
         "--data-dir", default="",
         help="persist the store (WAL + snapshots) under this directory; "
         "empty = in-memory only",
@@ -67,7 +71,8 @@ def main(argv=None) -> int:
 
         admission = default_admission_chain(cluster)
     srv = APIServer(
-        cluster=cluster, host=args.host, port=args.port, admission=admission
+        cluster=cluster, host=args.host, port=args.port, admission=admission,
+        audit_path=args.audit_log or None,
     ).start()
     print(f"apiserver on {srv.url}", file=sys.stderr)
 

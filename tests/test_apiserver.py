@@ -431,3 +431,24 @@ def test_metrics_api_analog():
         assert podm["items"][0]["usage"]["cpu"] == "250m"
     finally:
         srv.stop()
+
+
+def test_audit_log_records_writes(tmp_path):
+    """API audit subsystem (apiserver/pkg/audit): one ResponseComplete
+    Event line per write, none for reads."""
+    audit = str(tmp_path / "audit.jsonl")
+    srv = APIServer(audit_path=audit).start()
+    try:
+        u = srv.url
+        _req(f"{u}/api/v1/nodes", "POST", node_to_dict(make_node("n1")))
+        _req(f"{u}/api/v1/nodes")                          # read: not audited
+        _req(f"{u}/api/v1/nodes/n1", "DELETE")
+        _req(f"{u}/api/v1/nodes/ghost", "DELETE")          # 404 still audited
+    finally:
+        srv.stop()
+    events = [json.loads(l) for l in open(audit) if l.strip()]
+    assert [(e["verb"], e["responseStatus"]["code"]) for e in events] == [
+        ("create", 201), ("delete", 200), ("delete", 404),
+    ]
+    assert all(e["stage"] == "ResponseComplete" for e in events)
+    assert events[0]["requestURI"] == "/api/v1/nodes"
