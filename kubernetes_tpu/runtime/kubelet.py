@@ -270,3 +270,71 @@ class Kubelet:
             evicted.append(key)
         self.evictions.extend(evicted)
         return evicted
+
+
+class ProcessRuntime:
+    """CRI backend anchored by REAL pause processes (native/pause.c — the
+    analog of the reference's only compiled-C artifact, build/pause/
+    pause.c): RunPodSandbox spawns one pause process per sandbox, Stop
+    SIGTERMs it, Remove reaps the record.  The pause binary holds the
+    sandbox alive, exits cleanly on SIGTERM, and reaps zombies reparented
+    to it — byte-for-byte the reference pause contract.
+
+    Builds the binary on first use via `make -C native` when missing."""
+
+    def __init__(self, pause_path: Optional[str] = None):
+        import os
+        import subprocess
+
+        if pause_path is None:
+            root = os.path.dirname(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            )
+            native = os.path.join(root, "native")
+            pause_path = os.path.join(native, "pause")
+            if not os.path.exists(pause_path):
+                subprocess.run(
+                    ["make", "-C", native], check=True,
+                    capture_output=True,
+                )
+        self.pause_path = pause_path
+        self._procs: Dict[str, object] = {}   # sandbox id -> Popen
+        self.sandboxes: Dict[str, dict] = {}
+        self._ids = itertools.count(1)
+
+    def run_pod_sandbox(self, pod: Pod) -> str:
+        import subprocess
+
+        sid = f"sandbox-{next(self._ids)}"
+        proc = subprocess.Popen(
+            [self.pause_path],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        self._procs[sid] = proc
+        self.sandboxes[sid] = {
+            "id": sid,
+            "pod": (pod.namespace, pod.name),
+            "state": SANDBOX_READY,
+            "pid": proc.pid,
+        }
+        return sid
+
+    def stop_pod_sandbox(self, sandbox_id: str) -> None:
+        proc = self._procs.get(sandbox_id)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                proc.kill()
+        sb = self.sandboxes.get(sandbox_id)
+        if sb is not None:
+            sb["state"] = SANDBOX_NOTREADY
+
+    def remove_pod_sandbox(self, sandbox_id: str) -> None:
+        self.stop_pod_sandbox(sandbox_id)
+        self._procs.pop(sandbox_id, None)
+        self.sandboxes.pop(sandbox_id, None)
+
+    def list_pod_sandboxes(self) -> List[dict]:
+        return list(self.sandboxes.values())

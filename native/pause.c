@@ -1,0 +1,45 @@
+/*
+ * pause: the sandbox anchor process.
+ *
+ * Reference: build/pause/pause.c — the only compiled-C artifact in the
+ * reference tree.  One pause process anchors each pod sandbox: it holds
+ * the sandbox's namespaces open, reaps any zombies reparented to it, and
+ * sleeps until terminated.  Behavior reproduced from scratch:
+ *
+ *   - SIGINT/SIGTERM exit cleanly (the runtime's StopPodSandbox);
+ *   - SIGCHLD reaps exited children in a loop (waitpid WNOHANG);
+ *   - otherwise pause()s forever.
+ */
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static void sigdown(int signo) {
+    (void)signo;
+    _exit(0);
+}
+
+static void sigreap(int signo) {
+    (void)signo;
+    while (waitpid(-1, NULL, WNOHANG) > 0) {
+    }
+}
+
+int main(void) {
+    struct sigaction down = {0}, reap = {0};
+    down.sa_handler = sigdown;
+    reap.sa_handler = sigreap;
+    reap.sa_flags = SA_NOCLDSTOP;
+    if (sigaction(SIGINT, &down, NULL) < 0 ||
+        sigaction(SIGTERM, &down, NULL) < 0 ||
+        sigaction(SIGCHLD, &reap, NULL) < 0) {
+        return 1;
+    }
+    for (;;) {
+        pause();
+    }
+    return 42; /* unreachable */
+}
