@@ -1407,6 +1407,20 @@ class SnapshotEncoder:
         TPA = 1 if aff_lean else d.TP
         TPV = 1 if vol_lean else d.TP
         SA = max(len(self.service_affinity_keys), 1)
+        # node-affinity lean widths: a batch where NO pod carries required /
+        # preferred nodeAffinity emits zero-width term tensors, and the
+        # selector/affinity kernels skip statically on shape — the expr
+        # evaluation is [B, S, E, N, L] work, the single hottest kernel on
+        # the CPU fallback for affinity-free workloads
+        def _na(p):
+            return p.spec.affinity.node_affinity if p.spec.affinity else None
+
+        SL = 0 if not any(
+            _na(p) and _na(p).required for p in pods
+        ) else d.S
+        PSL = 0 if not any(
+            _na(p) and _na(p).preferred for p in pods
+        ) else d.PS
 
         out = dict(
             valid=zb(B),
@@ -1430,21 +1444,21 @@ class SnapshotEncoder:
             ns_vals=zi(B, d.NS),
             ns_valid=zb(B, d.NS),
             has_req_affinity=zb(B),
-            term_valid=zb(B, d.S),
-            expr_key=zi(B, d.S, d.E),
-            expr_op=np.zeros((B, d.S, d.E), i32),
-            expr_vals=zi(B, d.S, d.E, d.V),
-            expr_nval=np.zeros((B, d.S, d.E), i32),
-            expr_num=np.full((B, d.S, d.E), np.nan, f32),
-            expr_valid=zb(B, d.S, d.E),
-            pref_weight=zf(B, d.PS),
-            pref_term_valid=zb(B, d.PS),
-            pref_expr_key=zi(B, d.PS, d.E),
-            pref_expr_op=np.zeros((B, d.PS, d.E), i32),
-            pref_expr_vals=zi(B, d.PS, d.E, d.V),
-            pref_expr_nval=np.zeros((B, d.PS, d.E), i32),
-            pref_expr_num=np.full((B, d.PS, d.E), np.nan, f32),
-            pref_expr_valid=zb(B, d.PS, d.E),
+            term_valid=zb(B, SL),
+            expr_key=zi(B, SL, d.E),
+            expr_op=np.zeros((B, SL, d.E), i32),
+            expr_vals=zi(B, SL, d.E, d.V),
+            expr_nval=np.zeros((B, SL, d.E), i32),
+            expr_num=np.full((B, SL, d.E), np.nan, f32),
+            expr_valid=zb(B, SL, d.E),
+            pref_weight=zf(B, PSL),
+            pref_term_valid=zb(B, PSL),
+            pref_expr_key=zi(B, PSL, d.E),
+            pref_expr_op=np.zeros((B, PSL, d.E), i32),
+            pref_expr_vals=zi(B, PSL, d.E, d.V),
+            pref_expr_nval=np.zeros((B, PSL, d.E), i32),
+            pref_expr_num=np.full((B, PSL, d.E), np.nan, f32),
+            pref_expr_valid=zb(B, PSL, d.E),
             forbidden_pairs=zb(B, TPA),
             aff_term_pairs=zb(B, d.PT, TPA),
             aff_term_valid=zb(B, d.PT),
@@ -1471,7 +1485,11 @@ class SnapshotEncoder:
 
         # interner ids are append-only (stable), so only pad-dim or
         # spread-registry changes invalidate cached rows
-        token = (self.dims, len(self._spread), aff_lean, vol_lean,
+        # NOTE: SL/PSL in the token means a lean<->full flip flushes the
+        # whole row cache; accepted — scheduler batches are formed per
+        # cycle from queue order, so affinity presence rarely oscillates,
+        # and a flush costs one re-encode, not correctness
+        token = (self.dims, len(self._spread), aff_lean, vol_lean, SL, PSL,
                  tuple(self.service_affinity_keys))
         cnt_ids_by_b: dict = {}
         if token != self._pod_cache_token:

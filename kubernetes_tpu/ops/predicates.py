@@ -131,6 +131,10 @@ def pod_match_node_selector(cluster: ClusterTensors, pods: PodBatch):
     val, _ = node_label_value(cluster, pods.ns_keys)       # [B, NS, N]
     ok = (val == pods.ns_vals[..., None]) | ~pods.ns_valid[..., None]
     sel_ok = jnp.all(ok, axis=1)                            # [B, N]
+    if pods.expr_key.shape[1] == 0:
+        # affinity-lean batch (no pod carries required nodeAffinity): the
+        # encoder emitted zero-width term tensors, skip the expr grid
+        return sel_ok
     # required node affinity
     m = _eval_exprs(
         cluster,

@@ -123,6 +123,9 @@ def balanced_allocation(cluster: ClusterTensors, pods: PodBatch):
 def node_affinity(cluster: ClusterTensors, pods: PodBatch):
     """NodeAffinityPriority (priorities/node_affinity.go): sum the weights of
     matching preferredDuringScheduling terms, then NormalizeReduce(10, false)."""
+    if pods.pref_weight.shape[1] == 0:
+        # affinity-lean batch: no preferred terms anywhere -> all-zero counts
+        return jnp.zeros((pods.n_pods, cluster.n_nodes), jnp.float32)
     m = _eval_exprs(
         cluster,
         pods.pref_expr_key,
@@ -199,23 +202,36 @@ def node_prefer_avoid_pods(cluster: ClusterTensors, pods: PodBatch):
 def spread_score_from_counts(counts, cluster: ClusterTensors, zone_key_id: int):
     """The SelectorSpread reduce (selector_spreading.go:95-140) given per-node
     matching-pod counts [..., N]: fScore = (1-2/3)*nodeScore + 2/3*zoneScore,
-    int-truncated.  Zone aggregation rides the zone topology-pair one-hot
-    (a [N, TP] masked matmul — the segment-sum lands on the MXU)."""
+    int-truncated.  Zone aggregation is a segment-sum over each node's zone
+    pair id (scatter + gather, O(B*N))."""
     max_node = jnp.max(counts, axis=-1, keepdims=True)
     node_score = jnp.where(
         max_node > 0, MAX_PRIORITY * (max_node - counts) / max_node, MAX_PRIORITY
     )
+    # zone aggregation as a segment-sum over each node's zone pair id:
+    # O(B*N) scatter+gather instead of two [.., N] x [N, TP] matmuls over
+    # the WHOLE pair vocabulary (hostname pairs make TP ~ N, so the matmul
+    # form costs B*N*TP flops — negligible on the MXU, seconds on the CPU
+    # fallback).  GetZoneKey gives each node at most ONE zone pair, so the
+    # argmax column is exact.
     zmask = cluster.pair_topo_key == zone_key_id             # [TP]
-    zpairs = (cluster.topo_pairs & zmask[None]).astype(jnp.float32)  # [N, TP]
-    zcounts = counts @ zpairs                                # [..., TP] per-zone
-    zcount_per_node = zcounts @ zpairs.T                     # [..., N]
-    max_zone = jnp.max(zcounts, axis=-1, keepdims=True)
+    zpairs_b = cluster.topo_pairs & zmask[None]              # [N, TP] bool
+    node_in_zone = jnp.any(zpairs_b, axis=-1)                # [N]
+    zone_of_node = jnp.argmax(zpairs_b, axis=-1)             # [N] pair id
+    TP = zpairs_b.shape[1]
+    lead = counts.shape[:-1]
+    n = counts.shape[-1]
+    flat = counts.reshape((-1, n))
+    contrib = jnp.where(node_in_zone[None, :], flat, 0.0)
+    zsums = jnp.zeros((flat.shape[0], TP), flat.dtype)
+    zsums = zsums.at[:, zone_of_node].add(contrib)           # [M, TP]
+    zcount_per_node = zsums[:, zone_of_node].reshape(lead + (n,))
+    max_zone = jnp.max(zsums, axis=-1).reshape(lead + (1,))
     zone_score = jnp.where(
         max_zone > 0,
         MAX_PRIORITY * (max_zone - zcount_per_node) / max_zone,
         MAX_PRIORITY,
     )
-    node_in_zone = jnp.any(zpairs > 0, axis=-1)              # [N]
     have_zones = jnp.any(node_in_zone)
     blended = jnp.where(
         have_zones & node_in_zone,
