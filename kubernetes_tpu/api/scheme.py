@@ -1,0 +1,137 @@
+"""Scheme: the GroupVersionKind registry + codec dispatch.
+
+Reference: staging/src/k8s.io/apimachinery/pkg/runtime (runtime.Scheme,
+`schema.GroupVersionKind`) — one registry answering "what wire identity
+does this storage kind carry, and how do its objects encode/decode".
+Every serialization seam (REST layer, WAL/snapshot persistence, the
+reflector) dispatches through here instead of growing private tables.
+
+  gvk_for("deployments")      -> GroupVersionKind("apps", "v1", "Deployment")
+  rest_path("jobs", "ns")     -> "/apis/batch/v1/namespaces/ns/jobs"
+  decode("pods", wire_dict)   -> Pod
+  encode("pods", pod)         -> wire dict
+
+Dynamic (CRD-established) kinds — the "<plural>.<group>" convention —
+resolve to their group with version v1* and encode/decode as wire dicts,
+the unstructured.Unstructured analog.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+@dataclass(frozen=True)
+class GroupVersionKind:
+    group: str          # "" = core
+    version: str
+    kind: str           # wire Kind ("Pod")
+
+    @property
+    def api_version(self) -> str:
+        return self.version if not self.group else f"{self.group}/{self.version}"
+
+
+# storage kind -> (GVK, cluster_scoped)
+_REGISTRY: Dict[str, tuple] = {
+    "pods": (GroupVersionKind("", "v1", "Pod"), False),
+    "nodes": (GroupVersionKind("", "v1", "Node"), True),
+    "services": (GroupVersionKind("", "v1", "Service"), False),
+    "endpoints": (GroupVersionKind("", "v1", "Endpoints"), False),
+    "namespaces": (GroupVersionKind("", "v1", "Namespace"), True),
+    "limitranges": (GroupVersionKind("", "v1", "LimitRange"), False),
+    "resourcequotas": (GroupVersionKind("", "v1", "ResourceQuota"), False),
+    "leases": (
+        GroupVersionKind("coordination.k8s.io", "v1", "Lease"), False),
+    "priorityclasses": (
+        GroupVersionKind("scheduling.k8s.io", "v1beta1", "PriorityClass"),
+        True),
+    "replicasets": (GroupVersionKind("apps", "v1", "ReplicaSet"), False),
+    "deployments": (GroupVersionKind("apps", "v1", "Deployment"), False),
+    "daemonsets": (GroupVersionKind("apps", "v1", "DaemonSet"), False),
+    "statefulsets": (GroupVersionKind("apps", "v1", "StatefulSet"), False),
+    "jobs": (GroupVersionKind("batch", "v1", "Job"), False),
+    "cronjobs": (GroupVersionKind("batch", "v1beta1", "CronJob"), False),
+    "poddisruptionbudgets": (
+        GroupVersionKind("policy", "v1beta1", "PodDisruptionBudget"), False),
+    "customresourcedefinitions": (
+        GroupVersionKind("apiextensions.k8s.io", "v1beta1",
+                         "CustomResourceDefinition"), True),
+    "apiservices": (
+        GroupVersionKind("apiregistration.k8s.io", "v1", "APIService"), True),
+}
+
+
+def kinds() -> tuple:
+    return tuple(_REGISTRY)
+
+
+def gvk_for(kind: str) -> GroupVersionKind:
+    """Storage kind -> wire identity; dynamic '<plural>.<group>' kinds map
+    to their CRD group (unstructured)."""
+    if kind in _REGISTRY:
+        return _REGISTRY[kind][0]
+    if "." in kind:
+        # the true wire Kind lives in the CRD's spec.names.kind, which the
+        # scheme cannot see — carry the plural verbatim (capitalized) the
+        # way unstructured objects carry whatever the wire said; do NOT
+        # guess singulars ("policies" -> "Policy" needs the CRD)
+        plural, _, group = kind.partition(".")
+        return GroupVersionKind(group, "v1", plural[:1].upper() + plural[1:])
+    raise KeyError(f"unknown kind {kind!r}")
+
+
+def is_cluster_scoped(kind: str) -> bool:
+    if kind in _REGISTRY:
+        return _REGISTRY[kind][1]
+    return False  # custom resources default Namespaced (CRD spec.scope)
+
+
+def kind_for_wire(wire_kind: str) -> Optional[str]:
+    """Wire Kind ("Deployment") -> storage kind ("deployments")."""
+    for k, (gvk, _) in _REGISTRY.items():
+        if gvk.kind == wire_kind:
+            return k
+    return None
+
+
+# kinds the server routes under their API group; everything else (core +
+# cluster-scoped extension kinds) is served flat under /api/v1
+_GROUP_ROUTED = (
+    "replicasets", "deployments", "daemonsets", "statefulsets",
+    "jobs", "cronjobs", "poddisruptionbudgets",
+)
+
+
+def rest_path(kind: str, namespace: str = "default", name: str = "") -> str:
+    """The REST collection/object path the API server actually serves for a
+    kind (the RESTMapper half of the scheme)."""
+    gvk = gvk_for(kind)
+    if "." in kind:
+        # custom resources serve under their CRD's group route
+        plural, _, group = kind.partition(".")
+        base = (f"/apis/{group}/{gvk.version}"
+                f"/namespaces/{namespace}/{plural}")
+    elif kind in _GROUP_ROUTED:
+        base = (f"/apis/{gvk.group}/{gvk.version}"
+                f"/namespaces/{namespace}/{kind}")
+    elif is_cluster_scoped(kind):
+        base = f"/api/v1/{kind}"
+    else:
+        base = f"/api/v1/namespaces/{namespace}/{kind}"
+    return f"{base}/{name}" if name else base
+
+
+def decode(kind: str, d: dict):
+    """Wire dict -> stored object (the codec's Decode half)."""
+    from kubernetes_tpu.apiserver.server import _decode
+
+    return _decode(kind, d)
+
+
+def encode(kind: str, obj) -> dict:
+    """Stored object -> wire dict (the codec's Encode half)."""
+    from kubernetes_tpu.api.serialize import object_to_dict
+
+    return object_to_dict(kind, obj)

@@ -834,12 +834,17 @@ class APIServer:
                 outer.cluster.delete(kind, store_ns, name)
                 self._status(200, "Success", "deleted")
 
-        # audit wiring: record the response code (send_response hook) and
-        # emit one ResponseComplete event per write request
+        # audit wiring: the event is written AT send_response time — before
+        # the client can observe the response — so a caller that gets its
+        # reply and immediately stops the server cannot race the audit
+        # append (ResponseComplete ordering)
         real_send_response = Handler.send_response
 
         def send_response(self, code, message=None):
-            self._audit_code = code
+            verb = getattr(self, "_audit_verb", None)
+            if verb is not None:
+                self._audit_verb = None
+                outer._audit(verb, self.path, code)
             real_send_response(self, code, message)
 
         Handler.send_response = send_response
@@ -850,12 +855,15 @@ class APIServer:
             inner = getattr(Handler, method)
 
             def wrapped(self, _inner=inner, _verb=verb):
+                self._audit_verb = _verb
                 try:
                     _inner(self)
                 finally:
-                    outer._audit(
-                        _verb, self.path, getattr(self, "_audit_code", 0)
-                    )
+                    if getattr(self, "_audit_verb", None) is not None:
+                        # the handler died before ANY response: still one
+                        # event per write attempt (code 0 = no response)
+                        self._audit_verb = None
+                        outer._audit(_verb, self.path, 0)
 
             setattr(Handler, method, wrapped)
         return Handler

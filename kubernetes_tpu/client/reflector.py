@@ -33,9 +33,9 @@ from kubernetes_tpu.runtime.cluster import ConflictError, LocalCluster
 
 
 def _decode(kind: str, d: dict):
-    from kubernetes_tpu.apiserver.server import _decode as decode
+    from kubernetes_tpu.api import scheme
 
-    return decode(kind, d)
+    return scheme.decode(kind, d)
 
 
 class Reflector:
