@@ -53,6 +53,9 @@ _REGISTRY: Dict[str, tuple] = {
     "statefulsets": (GroupVersionKind("apps", "v1", "StatefulSet"), False),
     "jobs": (GroupVersionKind("batch", "v1", "Job"), False),
     "cronjobs": (GroupVersionKind("batch", "v1beta1", "CronJob"), False),
+    "horizontalpodautoscalers": (
+        GroupVersionKind("autoscaling", "v1", "HorizontalPodAutoscaler"),
+        False),
     "poddisruptionbudgets": (
         GroupVersionKind("policy", "v1beta1", "PodDisruptionBudget"), False),
     "customresourcedefinitions": (
@@ -101,6 +104,7 @@ def kind_for_wire(wire_kind: str) -> Optional[str]:
 _GROUP_ROUTED = (
     "replicasets", "deployments", "daemonsets", "statefulsets",
     "jobs", "cronjobs", "poddisruptionbudgets",
+    "horizontalpodautoscalers",
 )
 
 

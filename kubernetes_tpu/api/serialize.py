@@ -298,6 +298,22 @@ def object_to_dict(kind: str, obj) -> dict:
                 ),
             }),
         }
+    if kind == "horizontalpodautoscalers":
+        return {
+            "kind": "HorizontalPodAutoscaler",
+            "apiVersion": "autoscaling/v1",
+            "metadata": {"name": obj.name, "namespace": obj.namespace,
+                         "uid": obj.uid},
+            "spec": {
+                "scaleTargetRef": {"kind": obj.target_kind,
+                                   "name": obj.target_name},
+                "minReplicas": obj.min_replicas,
+                "maxReplicas": obj.max_replicas,
+                "targetCPUUtilizationPercentage": obj.target_cpu_utilization,
+            },
+            "status": {"currentReplicas": obj.current_replicas,
+                       "desiredReplicas": obj.desired_replicas},
+        }
     if kind == "replicasets":
         return {
             "kind": "ReplicaSet",

@@ -51,6 +51,7 @@ NAMESPACED_KINDS = (
     "pods", "services", "replicasets", "deployments", "jobs", "endpoints",
     "poddisruptionbudgets", "limitranges", "resourcequotas",
     "daemonsets", "statefulsets", "cronjobs",
+    "horizontalpodautoscalers",
 )
 
 

@@ -48,6 +48,7 @@ LIST_KINDS = {"pods": "PodList", "nodes": "NodeList",
               "daemonsets": "DaemonSetList",
               "statefulsets": "StatefulSetList",
               "cronjobs": "CronJobList",
+              "horizontalpodautoscalers": "HorizontalPodAutoscalerList",
               "namespaces": "NamespaceList",
               "limitranges": "LimitRangeList",
               "resourcequotas": "ResourceQuotaList",
@@ -187,6 +188,29 @@ def _decode(kind: str, d: dict):
         if meta.get("uid"):
             cj.uid = meta["uid"]
         return cj
+    if kind == "horizontalpodautoscalers":
+        from kubernetes_tpu.runtime.controllers import HorizontalPodAutoscaler
+
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        ref = spec.get("scaleTargetRef") or {}
+        status = d.get("status") or {}
+        hpa = HorizontalPodAutoscaler(
+            namespace=meta.get("namespace", "default"),
+            name=meta.get("name", ""),
+            target_kind=ref.get("kind", "Deployment"),
+            target_name=ref.get("name", ""),
+            min_replicas=int(spec.get("minReplicas", 1)),
+            max_replicas=int(spec.get("maxReplicas", 10)),
+            target_cpu_utilization=int(
+                spec.get("targetCPUUtilizationPercentage", 80)
+            ),
+            current_replicas=int(status.get("currentReplicas", 0)),
+            desired_replicas=int(status.get("desiredReplicas", 0)),
+        )
+        if meta.get("uid"):
+            hpa.uid = meta["uid"]
+        return hpa
     if kind == "jobs":
         from kubernetes_tpu.runtime.controllers import Job
 
@@ -355,6 +379,8 @@ class APIServer:
         elif parts[:3] == ["apis", "batch", "v1"]:
             rest = parts[3:]
         elif parts[:3] == ["apis", "batch", "v1beta1"]:
+            rest = parts[3:]
+        elif parts[:3] == ["apis", "autoscaling", "v1"]:
             rest = parts[3:]
         elif parts[:3] == ["apis", "metrics.k8s.io", "v1beta1"]:
             rest = ["@metrics"] + parts[3:]

@@ -18,43 +18,27 @@ import sys
 
 from kubernetes_tpu.cmd.base import api_request as _req
 
-KIND_PATHS = {
-    "pods": "/api/v1/namespaces/{ns}/pods",
-    "pod": "/api/v1/namespaces/{ns}/pods",
-    "nodes": "/api/v1/nodes",
-    "node": "/api/v1/nodes",
-    "replicasets": "/apis/apps/v1/namespaces/{ns}/replicasets",
-    "rs": "/apis/apps/v1/namespaces/{ns}/replicasets",
-    "deployments": "/apis/apps/v1/namespaces/{ns}/deployments",
-    "deploy": "/apis/apps/v1/namespaces/{ns}/deployments",
-    "poddisruptionbudgets": "/apis/policy/v1beta1/namespaces/{ns}/poddisruptionbudgets",
-    "pdb": "/apis/policy/v1beta1/namespaces/{ns}/poddisruptionbudgets",
-    "endpoints": "/api/v1/namespaces/{ns}/endpoints",
-    "services": "/api/v1/namespaces/{ns}/services",
-    "jobs": "/apis/batch/v1/namespaces/{ns}/jobs",
-    "job": "/apis/batch/v1/namespaces/{ns}/jobs",
-    "daemonsets": "/apis/apps/v1/namespaces/{ns}/daemonsets",
-    "daemonset": "/apis/apps/v1/namespaces/{ns}/daemonsets",
-    "ds": "/apis/apps/v1/namespaces/{ns}/daemonsets",
-    "statefulsets": "/apis/apps/v1/namespaces/{ns}/statefulsets",
-    "statefulset": "/apis/apps/v1/namespaces/{ns}/statefulsets",
-    "sts": "/apis/apps/v1/namespaces/{ns}/statefulsets",
-    "cronjobs": "/apis/batch/v1beta1/namespaces/{ns}/cronjobs",
-    "cronjob": "/apis/batch/v1beta1/namespaces/{ns}/cronjobs",
-    "cj": "/apis/batch/v1beta1/namespaces/{ns}/cronjobs",
-    "namespaces": "/api/v1/namespaces",
-    "ns": "/api/v1/namespaces",
-    "limitranges": "/api/v1/namespaces/{ns}/limitranges",
-    "limits": "/api/v1/namespaces/{ns}/limitranges",
-    "resourcequotas": "/api/v1/namespaces/{ns}/resourcequotas",
-    "quota": "/api/v1/namespaces/{ns}/resourcequotas",
-    "priorityclasses": "/api/v1/priorityclasses",
-    "pc": "/api/v1/priorityclasses",
-    "customresourcedefinitions": "/api/v1/customresourcedefinitions",
-    "crd": "/api/v1/customresourcedefinitions",
-    "crds": "/api/v1/customresourcedefinitions",
-    "apiservices": "/api/v1/apiservices",
+# resource paths derive from the scheme (api/scheme.py rest_path — ONE
+# source of truth for served routes); aliases map shorthand to storage kinds
+from kubernetes_tpu.api import scheme as _scheme
+
+_ALIASES = {
+    "pod": "pods", "node": "nodes", "rs": "replicasets",
+    "deploy": "deployments", "deployment": "deployments",
+    "pdb": "poddisruptionbudgets", "job": "jobs",
+    "daemonset": "daemonsets", "ds": "daemonsets",
+    "statefulset": "statefulsets", "sts": "statefulsets",
+    "cronjob": "cronjobs", "cj": "cronjobs",
+    "horizontalpodautoscaler": "horizontalpodautoscalers",
+    "hpa": "horizontalpodautoscalers",
+    "ns": "namespaces", "limits": "limitranges",
+    "quota": "resourcequotas", "pc": "priorityclasses",
+    "crd": "customresourcedefinitions", "crds": "customresourcedefinitions",
+    "service": "services",
 }
+
+KIND_PATHS = {k: _scheme.rest_path(k, "{ns}") for k in _scheme.kinds()}
+KIND_PATHS.update({a: KIND_PATHS[k] for a, k in _ALIASES.items()})
 
 
 def _discover_crd(server: str, *, storage=None, kind=None):

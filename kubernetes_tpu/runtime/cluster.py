@@ -49,7 +49,8 @@ class LocalCluster:
              "poddisruptionbudgets", "endpoints", "deployments", "jobs",
              "namespaces", "limitranges", "resourcequotas",
              "priorityclasses", "customresourcedefinitions", "apiservices",
-             "daemonsets", "statefulsets", "cronjobs")
+             "daemonsets", "statefulsets", "cronjobs",
+             "horizontalpodautoscalers")
 
     def __init__(self) -> None:
         self._lock = threading.RLock()

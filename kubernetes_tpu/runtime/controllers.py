@@ -435,6 +435,7 @@ class ControllerManager:
         self.daemonset = DaemonSetController(cluster)
         self.statefulset = StatefulSetController(cluster)
         self.cronjob = CronJobController(cluster)
+        self.hpa = HPAController(cluster)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -454,6 +455,7 @@ class ControllerManager:
         self._threads += self.daemonset.run(self._stop)
         self._threads += self.statefulset.run(self._stop)
         self._threads.append(self.cronjob.run(self._stop))
+        self._threads.append(self.hpa.run(self._stop))
 
         def gc_resweep():
             while not self._stop.wait(30.0):
@@ -1379,6 +1381,151 @@ class CronJobController:
                     self.tick()
                 except Exception:
                     pass  # HandleError semantics: a bad cronjob can't kill the loop
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t
+
+
+# ------------------------------------------------------------------- HPA
+
+
+@dataclass
+class HorizontalPodAutoscaler:
+    """autoscaling/v1 slice: scale a Deployment/ReplicaSet between
+    [min_replicas, max_replicas] toward target CPU utilization."""
+
+    namespace: str
+    name: str
+    target_kind: str          # "Deployment" | "ReplicaSet"
+    target_name: str
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_cpu_utilization: int = 80   # percent of requests
+    uid: str = field(default_factory=lambda: uuid.uuid4().hex)
+    # status
+    current_replicas: int = 0
+    desired_replicas: int = 0
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.namespace, self.name)
+
+
+class HPAController:
+    """pkg/controller/podautoscaler: the classic utilization loop —
+    desired = ceil(current * currentUtilization / targetUtilization),
+    clamped to [min, max] (replica_calculator.go GetResourceReplicas).
+
+    Usage comes through the resource-metrics seam (`usage_fn(pod) ->
+    milliCPU`); the default reads requests — exactly what this framework's
+    metrics.k8s.io endpoint reports for hollow pods — so a real cadvisor
+    would plug in at the same point."""
+
+    def __init__(self, cluster: LocalCluster, usage_fn=None):
+        self.cluster = cluster
+        self.usage_fn = usage_fn or self._requests_usage
+
+    @staticmethod
+    def _requests_usage(pod: Pod) -> float:
+        cpu = 0.0
+        for c in pod.spec.containers:
+            if "cpu" in c.requests:
+                cpu += c.requests["cpu"].milli
+        return cpu
+
+    def _target(self, hpa: HorizontalPodAutoscaler):
+        kind = {"Deployment": "deployments",
+                "ReplicaSet": "replicasets"}.get(hpa.target_kind)
+        if kind is None:
+            return None, None
+        return kind, self.cluster.get(kind, hpa.namespace, hpa.target_name)
+
+    def sync_one(self, hpa: HorizontalPodAutoscaler):
+        """Returns the applied desired replica count, or None when the HPA
+        did not act (missing target, or autoscaling suspended because the
+        target was manually scaled to zero — horizontal.go: spec.replicas
+        == 0 disables the autoscaler for that target)."""
+        import math
+
+        kind, target = self._target(hpa)
+        if target is None:
+            return None
+        if target.replicas == 0:
+            return None  # manual scale-to-zero pauses the workload
+        # pods selected by the scale target, Running only (the metrics
+        # client returns samples only for running pods)
+        sel = klabels.selector_from_match_labels(target.selector)
+        pods = [
+            p for p in self.cluster.list("pods")
+            if p.namespace == hpa.namespace and sel.matches(p.labels)
+            and p.status.phase == "Running"
+        ]
+        current = target.replicas
+        if pods and hpa.target_cpu_utilization > 0:
+            usage = sum(self.usage_fn(p) for p in pods)
+            requested = sum(self._requests_usage(p) for p in pods)
+            if requested > 0:
+                utilization = 100.0 * usage / requested
+                desired = math.ceil(
+                    len(pods) * utilization / hpa.target_cpu_utilization
+                )
+            else:
+                desired = current
+        else:
+            desired = current
+        desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
+        hpa2, rv = self.cluster.get_with_rv(
+            "horizontalpodautoscalers", hpa.namespace, hpa.name
+        )
+        if hpa2 is not None and (
+            hpa2.current_replicas != len(pods)
+            or hpa2.desired_replicas != desired
+        ):
+            self.cluster.update(
+                "horizontalpodautoscalers",
+                dataclasses.replace(
+                    hpa2, current_replicas=len(pods),
+                    desired_replicas=desired,
+                ),
+                expect_rv=rv,
+            )
+        if desired != current:
+            tgt, trv = self.cluster.get_with_rv(kind, hpa.namespace,
+                                                hpa.target_name)
+            if tgt is not None:
+                self.cluster.update(
+                    kind, dataclasses.replace(tgt, replicas=desired),
+                    expect_rv=trv,
+                )
+                self.cluster.events.eventf(
+                    "HorizontalPodAutoscaler", hpa.namespace, hpa.name,
+                    "Normal", "SuccessfulRescale",
+                    "scaled %s/%s to %d", hpa.target_kind,
+                    hpa.target_name, desired,
+                )
+        return desired
+
+    def tick(self) -> int:
+        """Reconciles every HPA; returns how many acted.  Per-HPA error
+        isolation (HandleError): one broken usage_fn or conflicting write
+        must not starve the HPAs after it in list order."""
+        acted = 0
+        for hpa in self.cluster.list("horizontalpodautoscalers"):
+            try:
+                if self.sync_one(hpa) is not None:
+                    acted += 1
+            except Exception:
+                continue  # incl. ConflictError: next tick re-reads
+        return acted
+
+    def run(self, stop: threading.Event, period: float = 15.0) -> threading.Thread:
+        def loop():
+            while not stop.wait(period):
+                try:
+                    self.tick()
+                except Exception:
+                    pass
 
         t = threading.Thread(target=loop, daemon=True)
         t.start()

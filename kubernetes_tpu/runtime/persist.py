@@ -42,16 +42,12 @@ class CompactedError(Exception):
 
 
 def _decode(kind: str, d: dict):
-    """Wire dict -> stored object, via the scheme (api/scheme.py).
-    Dynamic (CRD-established) kinds — and only those, recognized by the
-    '<plural>.<group>' dot convention — are stored as wire dicts; decode
-    errors on builtin kinds stay loud (a corrupt WAL entry must fail
+    """Wire dict -> stored object, via the scheme (api/scheme.py), which
+    handles dynamic '<plural>.<group>' kinds as wire dicts and raises
+    loudly for unknown builtin kinds (a corrupt WAL entry must fail
     recovery, not load as a dict)."""
     from kubernetes_tpu.api import scheme
-    from kubernetes_tpu.apiserver.extensions import flatten_wire_dict
 
-    if "." in kind:
-        return flatten_wire_dict(d, default_ns="")
     return scheme.decode(kind, d)
 
 

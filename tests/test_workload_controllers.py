@@ -279,3 +279,112 @@ def test_forbid_ignores_other_cronjobs_jobs():
             cluster.update("jobs", dataclasses.replace(j2, complete=True),
                            expect_rv=rv)
     assert ctrl.tick(now + 60) == 2
+
+
+def test_hpa_scales_deployment_toward_target_utilization():
+    """pkg/controller/podautoscaler: desired = ceil(current * utilization /
+    target), clamped to [min, max]; scaling writes through the Deployment
+    so the rollout machinery fans it out."""
+    from kubernetes_tpu.runtime.controllers import (
+        Deployment,
+        DeploymentController,
+        HPAController,
+        HorizontalPodAutoscaler,
+        ReplicaSetController,
+    )
+
+    cluster = LocalCluster()
+    dep_ctrl = DeploymentController(cluster)
+    rs_ctrl = ReplicaSetController(cluster)
+    cluster.create("deployments", Deployment(
+        namespace="default", name="web", replicas=2,
+        selector={"app": "web"},
+        template={"metadata": {"labels": {"app": "web"}},
+                  "spec": {"containers": [{"name": "c", "resources": {
+                      "requests": {"cpu": "100m", "memory": "64Mi"}}}]}},
+    ))
+    _drain(dep_ctrl)
+    _drain(rs_ctrl)
+
+    def mark_all_running():
+        for p in cluster.list("pods"):
+            if p.status.phase != "Running":
+                p2, rv = cluster.get_with_rv("pods", p.namespace, p.name)
+                cluster.update("pods", dataclasses.replace(
+                    p2, status=dataclasses.replace(p2.status, phase="Running")
+                ), expect_rv=rv)
+
+    mark_all_running()
+    assert len(cluster.list("pods")) == 2
+
+    # usage = 2x requests -> utilization 200%; target 100% -> desired 4
+    hpa_ctrl = HPAController(
+        cluster, usage_fn=lambda p: 2 * HPAController._requests_usage(p)
+    )
+    cluster.create("horizontalpodautoscalers", HorizontalPodAutoscaler(
+        namespace="default", name="web-hpa",
+        target_kind="Deployment", target_name="web",
+        min_replicas=1, max_replicas=6, target_cpu_utilization=100,
+    ))
+    hpa_ctrl.tick()
+    assert cluster.get("deployments", "default", "web").replicas == 4
+    _drain(dep_ctrl)
+    _drain(rs_ctrl)
+    mark_all_running()
+    assert len(cluster.list("pods")) == 4
+    # next tick: still 200% utilization -> 8, clamped to max 6
+    hpa_ctrl.tick()
+    assert cluster.get("deployments", "default", "web").replicas == 6
+    status = cluster.get("horizontalpodautoscalers", "default", "web-hpa")
+    assert status.desired_replicas == 6 and status.current_replicas == 4
+    # load drops to 25% -> desired 2 (ceil(6 * 25 / 100) at 6 running)
+    _drain(dep_ctrl)
+    _drain(rs_ctrl)
+    mark_all_running()
+    hpa_ctrl.usage_fn = lambda p: 0.25 * HPAController._requests_usage(p)
+    hpa_ctrl.tick()
+    assert cluster.get("deployments", "default", "web").replicas == 2
+    # floor: utilization 0 clamps at min_replicas
+    _drain(dep_ctrl)
+    _drain(rs_ctrl)
+    mark_all_running()
+    hpa_ctrl.usage_fn = lambda p: 0.0
+    hpa_ctrl.tick()
+    assert cluster.get("deployments", "default", "web").replicas == 1
+
+
+def test_hpa_rest_round_trip():
+    import json
+    import urllib.request
+
+    from kubernetes_tpu.apiserver import APIServer
+
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster).start()
+    try:
+        payload = {
+            "kind": "HorizontalPodAutoscaler",
+            "apiVersion": "autoscaling/v1",
+            "metadata": {"name": "h1"},
+            "spec": {"scaleTargetRef": {"kind": "Deployment", "name": "web"},
+                     "minReplicas": 2, "maxReplicas": 9,
+                     "targetCPUUtilizationPercentage": 55},
+        }
+        req = urllib.request.Request(
+            srv.url + "/apis/autoscaling/v1/namespaces/default/"
+            "horizontalpodautoscalers",
+            data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+        hpa = cluster.get("horizontalpodautoscalers", "default", "h1")
+        assert hpa.max_replicas == 9 and hpa.target_cpu_utilization == 55
+        with urllib.request.urlopen(
+            srv.url + "/apis/autoscaling/v1/namespaces/default/"
+            "horizontalpodautoscalers/h1", timeout=10
+        ) as r:
+            back = json.loads(r.read())
+            assert back["spec"]["targetCPUUtilizationPercentage"] == 55
+    finally:
+        srv.stop()
