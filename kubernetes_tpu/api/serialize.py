@@ -239,13 +239,16 @@ def object_to_dict(kind: str, obj) -> dict:
             "apiVersion": "batch/v1",
             "metadata": {"name": obj.name, "namespace": obj.namespace,
                          "uid": obj.uid},
-            "spec": {"completions": obj.completions,
+            "spec": _drop_empty({"completions": obj.completions,
                      "parallelism": obj.parallelism,
                      "backoffLimit": obj.backoff_limit,
-                     "template": obj.template},
+                     "ttlSecondsAfterFinished":
+                         obj.ttl_seconds_after_finished,
+                     "template": obj.template}),
             "status": _drop_empty({
                 "succeeded": obj.succeeded,
                 "failed": obj.failed,
+                "completionTime": obj.finished_at or None,
                 "conditions": (
                     [{"type": "Complete", "status": "True"}]
                     if obj.complete else

@@ -225,10 +225,15 @@ def _decode(kind: str, d: dict):
             parallelism=int(spec.get("parallelism", 1)),
             template=spec.get("template") or {},
             backoff_limit=int(spec.get("backoffLimit", 6)),
+            ttl_seconds_after_finished=(
+                int(spec["ttlSecondsAfterFinished"])
+                if spec.get("ttlSecondsAfterFinished") is not None else None
+            ),
             succeeded=int(status.get("succeeded", 0)),
             failed=int(status.get("failed", 0)),
             complete=conds.get("Complete") == "True",
             failed_state=conds.get("Failed") == "True",
+            finished_at=float(status.get("completionTime") or 0.0),
         )
         if meta.get("uid"):
             job.uid = meta["uid"]

@@ -436,6 +436,7 @@ class ControllerManager:
         self.statefulset = StatefulSetController(cluster)
         self.cronjob = CronJobController(cluster)
         self.hpa = HPAController(cluster)
+        self.ttl = TTLAfterFinishedController(cluster)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -456,6 +457,7 @@ class ControllerManager:
         self._threads += self.statefulset.run(self._stop)
         self._threads.append(self.cronjob.run(self._stop))
         self._threads.append(self.hpa.run(self._stop))
+        self._threads.append(self.ttl.run(self._stop))
 
         def gc_resweep():
             while not self._stop.wait(30.0):
@@ -743,6 +745,9 @@ class Job:
     parallelism: int = 1
     template: dict = field(default_factory=dict)
     backoff_limit: int = 6
+    # delete this long after reaching Complete/Failed (None = keep forever;
+    # pkg/controller/ttlafterfinished)
+    ttl_seconds_after_finished: Optional[int] = None
     uid: str = field(default_factory=lambda: uuid.uuid4().hex)
     owner_uid: str = ""   # owning CronJob's uid ("" = standalone)
     # status (controller-maintained; succeeded/complete are MONOTONIC —
@@ -751,6 +756,7 @@ class Job:
     failed: int = 0
     complete: bool = False
     failed_state: bool = False  # backoffLimit exceeded ("Failed" condition)
+    finished_at: float = 0.0    # epoch seconds the terminal condition landed
 
     @property
     def key(self) -> Tuple[str, str]:
@@ -841,11 +847,20 @@ class JobController(Reconciler):
             or complete != job.complete
             or failed_state != job.failed_state
         ):
+            newly_terminal = (
+                (complete or failed_state)
+                and not (job.complete or job.failed_state)
+            )
             self.cluster.update(
                 "jobs",
                 dataclasses.replace(
                     job, succeeded=succeeded, failed=failed,
                     complete=complete, failed_state=failed_state,
+                    # the TTL-after-finished clock starts at the terminal
+                    # condition (ttlafterfinished timeLeft semantics)
+                    finished_at=(
+                        time.time() if newly_terminal else job.finished_at
+                    ),
                 ),
                 expect_rv=rv,
             )
@@ -1520,6 +1535,45 @@ class HPAController:
         return acted
 
     def run(self, stop: threading.Event, period: float = 15.0) -> threading.Thread:
+        def loop():
+            while not stop.wait(period):
+                try:
+                    self.tick()
+                except Exception:
+                    pass
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t
+
+
+# --------------------------------------------------------- ttl-after-finished
+
+
+class TTLAfterFinishedController:
+    """pkg/controller/ttlafterfinished: delete finished Jobs once their
+    ttlSecondsAfterFinished elapses (the Job's own deletion cascades its
+    pods through the per-controller sweep / GC backstop)."""
+
+    def __init__(self, cluster: LocalCluster):
+        self.cluster = cluster
+
+    def tick(self, now: Optional[float] = None) -> int:
+        now = time.time() if now is None else now
+        deleted = 0
+        for job in list(self.cluster.list("jobs")):
+            if job.ttl_seconds_after_finished is None:
+                continue
+            if not (job.complete or job.failed_state):
+                continue
+            if not job.finished_at:
+                continue
+            if now - job.finished_at >= job.ttl_seconds_after_finished:
+                self.cluster.delete("jobs", job.namespace, job.name)
+                deleted += 1
+        return deleted
+
+    def run(self, stop: threading.Event, period: float = 10.0) -> threading.Thread:
         def loop():
             while not stop.wait(period):
                 try:

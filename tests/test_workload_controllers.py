@@ -388,3 +388,30 @@ def test_hpa_rest_round_trip():
             assert back["spec"]["targetCPUUtilizationPercentage"] == 55
     finally:
         srv.stop()
+
+
+def test_ttl_after_finished_reaps_done_jobs():
+    from kubernetes_tpu.runtime.controllers import (
+        Job,
+        TTLAfterFinishedController,
+    )
+
+    cluster = LocalCluster()
+    ctrl = TTLAfterFinishedController(cluster)
+    now = time.time()
+    keep = Job("default", "keep", complete=True, finished_at=now - 100)
+    ttld = Job("default", "ttld", complete=True, finished_at=now - 100,
+               ttl_seconds_after_finished=60)
+    fresh = Job("default", "fresh", complete=True, finished_at=now - 10,
+                ttl_seconds_after_finished=60)
+    running = Job("default", "running", ttl_seconds_after_finished=60)
+    for j in (keep, ttld, fresh, running):
+        cluster.create("jobs", j)
+    assert ctrl.tick(now) == 1
+    assert cluster.get("jobs", "default", "ttld") is None
+    assert cluster.get("jobs", "default", "keep") is not None   # no TTL
+    assert cluster.get("jobs", "default", "fresh") is not None  # not yet
+    assert cluster.get("jobs", "default", "running") is not None
+    # fresh expires later
+    assert ctrl.tick(now + 55) == 1
+    assert cluster.get("jobs", "default", "fresh") is None
