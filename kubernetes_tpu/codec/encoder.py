@@ -124,7 +124,7 @@ class _PodRecord:
     nonzero: np.ndarray              # f32[2]
     ports: List[Tuple[int, int]]     # (proto/port id, ip id)
     disk_vols: List[int]
-    vol_counts: np.ndarray           # f32[NUM_VOL_TYPES] (unique per pod)
+    vol_counts: np.ndarray           # f32[VT] (unique per pod)
     cnt_vols: list = None            # per-type unique volume id sets
     priority: int = 0
     group_refs: List[Tuple] = field(default_factory=list)  # term-group signatures
@@ -172,7 +172,10 @@ class SnapshotEncoder:
         # attachable-count volumes: per row per TYPE id refcounts, plus the
         # reverse id -> rows index (per-(pod,node) overlap tensors)
         self._node_cnt_vols: Dict[int, list] = {}
-        self._cnt_vol_rows: list = [dict() for _ in range(NUM_VOL_TYPES)]
+        self._cnt_vol_rows: list = [dict() for _ in range(self.dims.VT)]
+        # per-CSI-driver attach-count columns (csi_volume_predicate.go
+        # counts/limits PER DRIVER): driver name -> column >= NUM_VOL_TYPES
+        self._vol_cols: Dict[str, int] = {}
         self._alloc_node_arena()
 
         # ---- existing-pod arena (vectorized selector matching) ----
@@ -247,8 +250,8 @@ class SnapshotEncoder:
         self.a_img_id = np.full((n, d.I), PAD, i32)
         self.a_img_sz = np.zeros((n, d.I), f32)
         self.a_avoid = np.full((n, d.A), PAD, i32)
-        self.a_volcnt = np.zeros((n, NUM_VOL_TYPES), f32)
-        self.a_vollim = np.full((n, NUM_VOL_TYPES), np.inf, f32)
+        self.a_volcnt = np.zeros((n, d.VT), f32)
+        self.a_vollim = np.full((n, d.VT), np.inf, f32)
         self.a_dvol = np.full((n, d.DVN), PAD, i32)
         # per-topo-key per-node value/pair id (host-side helper columns)
         self._node_pair_id: Dict[int, np.ndarray] = {
@@ -497,7 +500,13 @@ class SnapshotEncoder:
         }
         for name, q in node.status.allocatable.items():
             if name.startswith("attachable-volumes-"):
-                col = vol_limit_cols.get(name, VOL_CSI if "csi" in name else None)
+                col = vol_limit_cols.get(name)
+                if col is None and name.startswith("attachable-volumes-csi-"):
+                    # per-driver cap: attachable-volumes-csi-<driver>
+                    driver = name[len("attachable-volumes-csi-"):]
+                    col = self._vol_col(driver)
+                elif col is None and "csi" in name:
+                    col = VOL_CSI
                 if col is not None:
                     self.a_vollim[row, col] = float(q)
                 continue
@@ -590,7 +599,7 @@ class SnapshotEncoder:
         self.a_nonzero[:, :] = 0.0
         self.a_volcnt[:, :] = 0.0
         self._node_cnt_vols.clear()
-        self._cnt_vol_rows = [dict() for _ in range(NUM_VOL_TYPES)]
+        self._cnt_vol_rows = [dict() for _ in range(self.dims.VT)]
         for rec in self.pods.values():
             if rec.node_row >= 0:
                 self.a_requested[rec.node_row, : rec.req.shape[0]] += rec.req
@@ -598,7 +607,7 @@ class SnapshotEncoder:
                 if rec.cnt_vols:
                     cnts = self._node_cnt_vols.setdefault(
                         rec.node_row,
-                        [Counter() for _ in range(NUM_VOL_TYPES)],
+                        [Counter() for _ in range(self.dims.VT)],
                     )
                     for t, ids in enumerate(rec.cnt_vols):
                         for vid in ids:
@@ -651,6 +660,39 @@ class SnapshotEncoder:
             out.append((pp, ipid))
         return out
 
+    def _vol_col(self, csi_driver: str) -> int:
+        """Attach-count column for a CSI driver ('' = the generic CSI
+        column).  New drivers widen the VT axis — node arenas, per-record
+        vectors, and the per-node/per-id bookkeeping all regrow, the same
+        discipline _res_col applies to extended resources."""
+        if not csi_driver:
+            return VOL_CSI
+        col = self._vol_cols.get(csi_driver)
+        if col is not None:
+            return col
+        col = NUM_VOL_TYPES + len(self._vol_cols)
+        if col >= self.dims.VT:
+            old = self.dims.VT
+            self.dims = dataclasses.replace(self.dims, VT=_pow2(col + 1))
+            grow = self.dims.VT - old
+            for attr, fill in (("a_volcnt", 0.0), ("a_vollim", np.inf)):
+                src_arr = getattr(self, attr)
+                new = np.full((self._cap_n, self.dims.VT), fill, np.float32)
+                new[:, :old] = src_arr
+                setattr(self, attr, new)
+            self._cnt_vol_rows += [dict() for _ in range(grow)]
+            for counters in self._node_cnt_vols.values():
+                counters.extend(Counter() for _ in range(grow))
+            for rec in self.pods.values():
+                v = np.zeros(self.dims.VT, np.float32)
+                v[: rec.vol_counts.shape[0]] = rec.vol_counts
+                rec.vol_counts = v
+                rec.cnt_vols = list(rec.cnt_vols) + [
+                    set() for _ in range(grow)
+                ]
+        self._vol_cols[csi_driver] = col
+        return col
+
     def _pod_vols(self, pod: Pod) -> Tuple[List[int], np.ndarray, list]:
         """(exclusive disk-conflict volume ids, per-filter-type UNIQUE new
         volume counts, per-type unique id sets).
@@ -661,11 +703,11 @@ class SnapshotEncoder:
         referencing one EBS volume twice counts once.
         """
         if not pod.spec.volumes:  # hot path: most pods mount nothing
-            return [], np.zeros(NUM_VOL_TYPES, np.float32), [
-                set() for _ in range(NUM_VOL_TYPES)
+            return [], np.zeros(self.dims.VT, np.float32), [
+                set() for _ in range(self.dims.VT)
             ]
         disk: List[int] = []
-        cnt_ids: list = [set() for _ in range(NUM_VOL_TYPES)]
+        cnt_ids: list = [set() for _ in range(self.dims.VT)]
         for v in pod.spec.volumes:
             if "gcePersistentDisk" in v:
                 vid = self.interner.intern("gce/" + v["gcePersistentDisk"].get("pdName", ""))
@@ -713,15 +755,26 @@ class SnapshotEncoder:
                             kstorage.SRC_CINDER: VOL_CINDER,
                         }.get(pv.source_kind)
                         if col is not None:
+                            if pv.source_kind == kstorage.SRC_CSI:
+                                # per-driver accounting: each CSI driver
+                                # gets its own count/limit column
+                                col = self._vol_col(pv.csi_driver)
+                                if col >= len(cnt_ids):
+                                    cnt_ids.extend(
+                                        set() for _ in
+                                        range(col + 1 - len(cnt_ids))
+                                    )
                             prefix = {
                                 VOL_EBS: "ebs/", VOL_GCE: "gce/",
                                 VOL_CSI: "csi/", VOL_AZURE: "azd/",
                                 VOL_CINDER: "cinder/",
-                            }[col]
+                            }.get(col, "csi/")
                             ident = pv.source_id or ("pvname/" + pv.name)
                             cnt_ids[col].add(
                                 self.interner.intern(prefix + ident)
                             )
+        if len(cnt_ids) < self.dims.VT:  # a driver column appeared mid-scan
+            cnt_ids.extend(set() for _ in range(self.dims.VT - len(cnt_ids)))
         counts = np.asarray([len(ids) for ids in cnt_ids], np.float32)
         return disk, counts, cnt_ids
 
@@ -816,7 +869,7 @@ class SnapshotEncoder:
             # attachable-count state dedupes by volume identity: the node's
             # used count is the number of DISTINCT ids per type
             cnts = self._node_cnt_vols.setdefault(
-                node_row, [Counter() for _ in range(NUM_VOL_TYPES)]
+                node_row, [Counter() for _ in range(self.dims.VT)]
             )
             for t, ids in enumerate(cnt_ids):
                 for vid in ids:
@@ -1255,7 +1308,7 @@ class SnapshotEncoder:
         Returns (pod_req_ext f32[E], requested_ext f32[N, E],
         allocatable_ext f32[N, E], pods_req_ext f32[M, E])."""
         R = self.dims.R
-        E = R + 2 + NUM_VOL_TYPES
+        E = R + 2 + self.dims.VT
         M, N = self._cap_m, self._cap_n
 
         want_ports = self._pod_ports(pod)
@@ -1306,9 +1359,15 @@ class SnapshotEncoder:
         allocatable_ext[:, :R] = self.a_allocatable
         allocatable_ext[:, R] = 0.5
         allocatable_ext[:, R + 1] = 0.5
-        allocatable_ext[:, R + 2 :] = np.minimum(
-            np.asarray(max_vols, np.float32)[None], self.a_vollim
-        )
+        defaults = np.asarray(max_vols, np.float32)
+        if defaults.shape[0] < self.dims.VT:
+            # per-CSI-driver columns inherit the CSI default cap
+            defaults = np.concatenate([
+                defaults,
+                np.full(self.dims.VT - defaults.shape[0],
+                        float(max_vols[VOL_CSI]), np.float32),
+            ])
+        allocatable_ext[:, R + 2 :] = np.minimum(defaults[None], self.a_vollim)
 
         pod_req_ext = np.zeros(E, np.float32)
         req = self._req_vector(pod.resource_request())
@@ -1375,6 +1434,18 @@ class SnapshotEncoder:
             for c in pod.spec.init_containers:
                 for rname in c.requests:
                     self._res_col(rname)
+            # CSI driver columns must exist BEFORE the out arrays are cut
+            # (same reason as resource columns: a mid-loop dims.VT bump
+            # would orphan already-allocated batch arrays)
+            for v in pod.spec.volumes:
+                claim = v.get("persistentVolumeClaim")
+                if not claim:
+                    continue
+                pvc = self.pvcs.get((pod.namespace, claim.get("claimName", "")))
+                if pvc is not None and pvc.volume_name:
+                    pv = self.pvs.get(pvc.volume_name)
+                    if pv is not None and pv.source_kind == "csi" and pv.csi_driver:
+                        self._vol_col(pv.csi_driver)
         d = self.dims
         it = self.interner
         f32, i32 = np.float32, np.int32
@@ -1474,7 +1545,7 @@ class SnapshotEncoder:
             svc_aff_fixed=zi(B, SA),
             image_ids=zi(B, d.C),
             image_bytes=zf(B, d.C),
-            new_vol_counts=zf(B, NUM_VOL_TYPES),
+            new_vol_counts=zf(B, d.VT),
             disk_vol_ids=zi(B, d.DV),
             vol_zone_pairs=zb(B, d.VZ, TPV),
             vol_zone_valid=zb(B, d.VZ),
@@ -1639,15 +1710,15 @@ class SnapshotEncoder:
         )
 
     def _vol_overlap(self, pods, cnt_ids_by_b=None) -> np.ndarray:
-        """f32[B, NUM_VOL_TYPES, N] count of the pod's attachable volumes
+        """f32[B, VT, N] count of the pod's attachable volumes
         ALREADY mounted on each node (filterVolumes' already-mounted
         subtraction: they add no new attachment); [B, VT, 1] lean
         placeholder when no pod carries volumes.  `cnt_ids_by_b` reuses the
         id sets the encode loop already computed."""
         B = _pow2(max(len(pods), 1, self.dims.B))
         if not any(getattr(p.spec, "volumes", None) for p in pods):
-            return np.zeros((B, NUM_VOL_TYPES, 1), np.float32)
-        out = np.zeros((B, NUM_VOL_TYPES, self._cap_n), np.float32)
+            return np.zeros((B, self.dims.VT, 1), np.float32)
+        out = np.zeros((B, self.dims.VT, self._cap_n), np.float32)
         for b, pod in enumerate(pods):
             if not pod.spec.volumes:
                 continue

@@ -156,6 +156,9 @@ class PadDims:
     DVN: int = 8      # disk-conflict volume ids per node
     VZ: int = 2       # volume zone-restriction terms per pod (bound PV labels)
     VB: int = 2       # volume binding-restriction terms per pod
+    VT: int = 5       # attach-count filter columns (5 base types + one per
+                      #   distinct CSI driver — csi_volume_predicate.go
+                      #   counts and limits PER DRIVER)
 
     def bump(self, **kw: int) -> "PadDims":
         return dataclasses.replace(
@@ -219,8 +222,9 @@ class ClusterTensors:
     # -- NodePreferAvoidPods --
     avoid_owner: Any        # i32[N, A]  controller-owner uid ids to avoid
     # -- volumes --
-    vol_counts: Any         # f32[N, NUM_VOL_TYPES] attached unique volumes per filter type
-    vol_limits: Any         # f32[N, NUM_VOL_TYPES] per-node attachable limits
+    vol_counts: Any         # f32[N, VT] attached unique volumes per filter
+                            #   column (5 base types + per-CSI-driver)
+    vol_limits: Any         # f32[N, VT] per-node attachable limits
     disk_vol_ids: Any       # i32[N, DVN] interned volume ids in use (NoDiskConflict)
 
     @property
@@ -311,7 +315,7 @@ class PodBatch:
     image_ids: Any          # i32[B, C]  (PAD empty)
     image_bytes: Any        # f32[B, C]  total size if known (0 otherwise)
     # volumes
-    new_vol_counts: Any     # f32[B, NUM_VOL_TYPES] unique volumes the pod
+    new_vol_counts: Any     # f32[B, VT] unique volumes the pod
                             #   references (per attach-count filter type)
     vol_overlap: Any        # f32[B, VT, N] of those, how many are already
                             #   mounted per node (subtract: they attach

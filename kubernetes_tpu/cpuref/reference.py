@@ -13,6 +13,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from kubernetes_tpu.api import labels as klabels
 from kubernetes_tpu.api.resource import Quantity
+from kubernetes_tpu.codec.schema import NUM_VOL_TYPES, VOL_CSI
 from kubernetes_tpu.api.types import (
     DEFAULT_MEMORY_REQUEST,
     DEFAULT_MILLI_CPU_REQUEST,
@@ -377,10 +378,23 @@ class CPUScheduler:
                         return False
         return True
 
+    def _vol_cols_count(self) -> int:
+        """5 base columns + one per distinct CSI driver across the PV set
+        (csi_volume_predicate.go accounts per driver)."""
+        return NUM_VOL_TYPES + len(self._csi_driver_cols())
+
+    def _csi_driver_cols(self) -> Dict[str, int]:
+        drivers = sorted({
+            pv.csi_driver for pv in self.pvs.values()
+            if pv.source_kind == "csi" and pv.csi_driver
+        })
+        return {d: NUM_VOL_TYPES + i for i, d in enumerate(drivers)}
+
     def _vol_ids_with_pvc(self, pod: Pod) -> List[set]:
-        """Per-type UNIQUE volume identities (direct + PVC-resolved) — the
-        filterVolumes map keys (predicates.go:330-430)."""
-        ids: List[set] = [set() for _ in range(5)]
+        """Per-column UNIQUE volume identities (direct + PVC-resolved) — the
+        filterVolumes map keys (predicates.go:330-430); columns 5+ are
+        per-CSI-driver."""
+        ids: List[set] = [set() for _ in range(self._vol_cols_count())]
         for v in pod.spec.volumes:
             if "awsElasticBlockStore" in v:
                 ids[0].add("ebs/" + v["awsElasticBlockStore"].get("volumeID", ""))
@@ -398,13 +412,19 @@ class CPUScheduler:
             "cinder": 4,
         }
         prefix = ["ebs/", "gce/", "csi/", "azd/", "cinder/"]
+        driver_cols = self._csi_driver_cols()
         for pvc in self._pod_pvcs(pod):
             if pvc is not None and pvc.volume_name:
                 pv = self.pvs.get(pvc.volume_name)
                 if pv is not None and pv.source_kind in kind_col:
                     col = kind_col[pv.source_kind]
+                    if pv.source_kind == "csi" and pv.csi_driver:
+                        col = driver_cols[pv.csi_driver]
                     ident = getattr(pv, "source_id", "") or ("pvname/" + pv.name)
-                    ids[col].add(prefix[col] + ident)
+                    ids[col].add(
+                        ("csi/" if col >= NUM_VOL_TYPES else prefix[col])
+                        + ident
+                    )
         return ids
 
     def max_volume_counts_full(self, pod: Pod, node: Node) -> List[bool]:
@@ -412,26 +432,40 @@ class CPUScheduler:
         the node's DISTINCT attached set, and pod volumes already mounted
         there attach nothing new (the already-mounted subtraction,
         predicates.go:349-363)."""
+        VT = self._vol_cols_count()
         pod_ids = self._vol_ids_with_pvc(pod)
-        node_ids: List[set] = [set() for _ in range(5)]
+        node_ids: List[set] = [set() for _ in range(VT)]
         for p in self.by_node[node.name]:
             for i, x in enumerate(self._vol_ids_with_pvc(p)):
                 node_ids[i] |= x
         used = [float(len(x)) for x in node_ids]
-        new = [float(len(pod_ids[i] - node_ids[i])) for i in range(5)]
-        limits = list(self.max_vols)
+        new = [float(len(pod_ids[i] - node_ids[i])) for i in range(VT)]
+        # per-driver columns inherit the CSI default cap
+        limits = list(self.max_vols) + [
+            float(self.max_vols[VOL_CSI])
+            for _ in range(VT - NUM_VOL_TYPES)
+        ]
         limit_keys = {
             "attachable-volumes-aws-ebs": 0,
             "attachable-volumes-gce-pd": 1,
             "attachable-volumes-azure-disk": 3,
         }
+        driver_cols = self._csi_driver_cols()
         for k, q in node.status.allocatable.items():
             if k in limit_keys:
                 limits[limit_keys[k]] = min(limits[limit_keys[k]], float(q))
+            elif k.startswith("attachable-volumes-csi-"):
+                # a per-driver cap applies ONLY to that driver's column;
+                # a cap for a driver with no volumes constrains nothing
+                driver = k[len("attachable-volumes-csi-"):]
+                col = driver_cols.get(driver)
+                if col is not None:
+                    limits[col] = min(limits[col], float(q))
             elif k.startswith("attachable-volumes-") and "csi" in k:
                 limits[2] = min(limits[2], float(q))
         return [
-            not (new[i] > 0 and used[i] + new[i] > limits[i]) for i in range(5)
+            not (new[i] > 0 and used[i] + new[i] > limits[i])
+            for i in range(VT)
         ]
 
     def match_inter_pod_affinity(self, pod: Pod, node: Node) -> bool:
@@ -517,7 +551,11 @@ class CPUScheduler:
             "CheckServiceAffinity": self.check_service_affinity(pod, node),
             "MaxEBSVolumeCount": vols[0],
             "MaxGCEPDVolumeCount": vols[1],
-            "MaxCSIVolumeCount": vols[2],
+            # the named CSI predicate folds the generic column and every
+            # per-driver column
+            "MaxCSIVolumeCount": (
+                vols[VOL_CSI] and all(vols[NUM_VOL_TYPES:])
+            ),
             "MaxAzureDiskVolumeCount": vols[3],
             "MaxCinderVolumeCount": vols[4],
             "CheckVolumeBinding": self.check_volume_binding(pod, node),

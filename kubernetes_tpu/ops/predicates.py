@@ -24,8 +24,10 @@ from kubernetes_tpu.codec.schema import (
     NUM_PREDICATES,
     PAD,
     PodBatch,
+    NUM_VOL_TYPES,
     PRED_INDEX,
     RES_PODS,
+    VOL_CSI,
 )
 
 # taint effect codes
@@ -244,12 +246,23 @@ def max_volume_counts(cluster: ClusterTensors, pods: PodBatch, max_vols):
     already-mounted subtraction, predicate lines 355-361).  Per-node
     attachable limits (AttachVolumeLimit allocatable keys) override the
     static defaults."""
-    new = pods.new_vol_counts[:, :, None]       # [B, 5, 1]
+    new = pods.new_vol_counts[:, :, None]       # [B, VT, 1]
     if pods.vol_overlap.shape[-1] == cluster.n_nodes:
         new = jnp.maximum(new - pods.vol_overlap, 0.0)
-    used = cluster.vol_counts.T[None]           # [1, 5, N]
-    default = jnp.asarray(max_vols, jnp.float32)[None, :, None]
-    node_lim = cluster.vol_limits.T[None]       # [1, 5, N] (inf = unset)
+    used = cluster.vol_counts.T[None]           # [1, VT, N]
+    base = jnp.asarray(max_vols, jnp.float32)
+    VT = new.shape[1]
+    if VT > base.shape[0]:
+        # columns past the base types are per-CSI-driver: each inherits
+        # the CSI default limit (csi_volume_predicate.go per-driver caps
+        # come from node allocatable; the static default is shared)
+        base = jnp.concatenate([
+            base,
+            jnp.full((VT - base.shape[0],), float(max_vols[VOL_CSI]),
+                     jnp.float32),
+        ])
+    default = base[None, :, None]
+    node_lim = cluster.vol_limits.T[None]       # [1, VT, N] (inf = unset)
     limit = jnp.minimum(default, node_lim)
     return ~((new > 0) & (used + new > limit))
 
@@ -423,7 +436,12 @@ def filter_batch(cluster: ClusterTensors, pods: PodBatch, cfg: FilterConfig,
         "CheckServiceAffinity": check_service_affinity(cluster, pods, cfg),
         "MaxEBSVolumeCount": vols[:, 0],
         "MaxGCEPDVolumeCount": vols[:, 1],
-        "MaxCSIVolumeCount": vols[:, 2],
+        # the named CSI predicate folds the generic column AND every
+        # per-driver column (one verdict, per-driver accounting)
+        "MaxCSIVolumeCount": (
+            vols[:, VOL_CSI] & jnp.all(vols[:, NUM_VOL_TYPES:], axis=1)
+            if vols.shape[1] > NUM_VOL_TYPES else vols[:, VOL_CSI]
+        ),
         "MaxAzureDiskVolumeCount": vols[:, 3],
         "MaxCinderVolumeCount": vols[:, 4],
         "CheckVolumeBinding": check_volume_binding(cluster, pods),

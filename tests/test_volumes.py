@@ -210,3 +210,108 @@ def test_volume_binder_assume_and_revert():
     assert ok and pvc.volume_name == "avail" and pv.phase == "Bound"
     vb.revert(assumptions)
     assert pvc.volume_name == "" and pv.phase == "Available"
+
+
+def test_csi_per_driver_limits_and_counts():
+    """MaxCSIVolumeCount accounts PER DRIVER (csi_volume_predicate.go):
+    each driver's attachments count against its own
+    attachable-volumes-csi-<driver> cap; different drivers don't share a
+    budget."""
+    node = make_node(
+        "n1", cpu="8", mem="16Gi",
+        allocatable_extra={"attachable-volumes-csi-driver-a": "1",
+                           "attachable-volumes-csi-driver-b": "2"},
+    )
+    pvs = []
+    pvcs = []
+    for i, driver in enumerate(["driver-a", "driver-a", "driver-b"]):
+        pvs.append(PersistentVolume.from_dict({
+            "metadata": {"name": f"pv{i}"},
+            "spec": {"capacity": {"storage": "1Gi"},
+                     "accessModes": ["ReadWriteOnce"],
+                     "csi": {"driver": driver, "volumeHandle": f"h{i}"}},
+        }))
+        pvcs.append(PersistentVolumeClaim.from_dict({
+            "metadata": {"name": f"c{i}", "namespace": "default"},
+            "spec": {"volumeName": f"pv{i}"},
+        }))
+    # two driver-a claims exceed its cap of 1; a+b together fit (separate
+    # budgets); two driver-b claims fit its cap of 2
+    over_a = make_pod("over-a", volumes=[
+        {"persistentVolumeClaim": {"claimName": "c0"}},
+        {"persistentVolumeClaim": {"claimName": "c1"}},
+    ])
+    mixed = make_pod("mixed", volumes=[
+        {"persistentVolumeClaim": {"claimName": "c0"}},
+        {"persistentVolumeClaim": {"claimName": "c2"}},
+    ])
+    enc = build([node], [], pvs, pvcs)
+    golden = CPUScheduler([node], [], pvs=pvs, pvcs=pvcs)
+    pending = [over_a, mixed]
+    batch = enc.encode_pods(pending)
+    _, per_pred = filter_batch(enc.snapshot(), batch, FilterConfig(), 0)
+    row = PRED_INDEX["MaxCSIVolumeCount"]
+    dev = np.asarray(per_pred)[:, row, 0]
+    assert not dev[0], "two driver-a attachments must exceed cap 1"
+    assert dev[1], "one a + one b ride separate budgets"
+    # differential vs the golden
+    for b, pod in enumerate(pending):
+        assert golden.predicates(pod, node)["MaxCSIVolumeCount"] == bool(dev[b]), pod.name
+
+
+def test_csi_driver_first_seen_at_encode_time():
+    """A pending pod may introduce a CSI driver no assigned pod uses: the
+    driver column must register BEFORE the batch arrays are cut (the
+    extended-resource pre-registration discipline)."""
+    enc = SnapshotEncoder(TEST_DIMS)
+    node = make_node("n1", cpu="8", mem="16Gi")
+    enc.add_node(node)
+    pv = PersistentVolume.from_dict({
+        "metadata": {"name": "pv0"},
+        "spec": {"capacity": {"storage": "1Gi"},
+                 "accessModes": ["ReadWriteOnce"],
+                 "csi": {"driver": "fresh", "volumeHandle": "h"}},
+    })
+    pvc = PersistentVolumeClaim.from_dict({
+        "metadata": {"name": "c0", "namespace": "default"},
+        "spec": {"volumeName": "pv0"},
+    })
+    enc.add_pv(pv)
+    enc.add_pvc(pvc)
+    pod = pvc_pod("p", "c0")
+    batch = enc.encode_pods([pod])
+    _, per_pred = filter_batch(enc.snapshot(), batch, FilterConfig(), 0)
+    row = PRED_INDEX["MaxCSIVolumeCount"]
+    assert bool(np.asarray(per_pred)[0, row, 0])
+    golden = CPUScheduler([node], [], pvs=[pv], pvcs=[pvc])
+    assert golden.predicates(pod, node)["MaxCSIVolumeCount"]
+
+
+def test_unknown_driver_cap_does_not_clamp_generic_csi():
+    """attachable-volumes-csi-<driver> for a driver with no volumes must
+    constrain nothing (golden and device agree)."""
+    node = make_node("n1", cpu="8", mem="16Gi",
+                     allocatable_extra={"attachable-volumes-csi-rare": "1"})
+    pvs, pvcs = [], []
+    for i in range(2):  # two driverless CSI PVs (generic column)
+        pvs.append(PersistentVolume.from_dict({
+            "metadata": {"name": f"pv{i}"},
+            "spec": {"capacity": {"storage": "1Gi"},
+                     "accessModes": ["ReadWriteOnce"],
+                     "csi": {"volumeHandle": f"h{i}"}},
+        }))
+        pvcs.append(PersistentVolumeClaim.from_dict({
+            "metadata": {"name": f"c{i}", "namespace": "default"},
+            "spec": {"volumeName": f"pv{i}"},
+        }))
+    pod = make_pod("p", volumes=[
+        {"persistentVolumeClaim": {"claimName": "c0"}},
+        {"persistentVolumeClaim": {"claimName": "c1"}},
+    ])
+    enc = build([node], [], pvs, pvcs)
+    golden = CPUScheduler([node], [], pvs=pvs, pvcs=pvcs)
+    batch = enc.encode_pods([pod])
+    _, per_pred = filter_batch(enc.snapshot(), batch, FilterConfig(), 0)
+    dev = bool(np.asarray(per_pred)[0, PRED_INDEX["MaxCSIVolumeCount"], 0])
+    assert dev, "the rare-driver cap must not clamp the generic column"
+    assert golden.predicates(pod, node)["MaxCSIVolumeCount"] == dev
