@@ -502,9 +502,11 @@ class SnapshotEncoder:
             if name.startswith("attachable-volumes-"):
                 col = vol_limit_cols.get(name)
                 if col is None and name.startswith("attachable-volumes-csi-"):
-                    # per-driver cap: attachable-volumes-csi-<driver>
+                    # per-driver cap: attachable-volumes-csi-<driver>; a
+                    # malformed empty-driver key constrains nothing (the
+                    # golden ignores it too)
                     driver = name[len("attachable-volumes-csi-"):]
-                    col = self._vol_col(driver)
+                    col = self._vol_col(driver) if driver else None
                 elif col is None and "csi" in name:
                     col = VOL_CSI
                 if col is not None:
@@ -693,41 +695,55 @@ class SnapshotEncoder:
         self._vol_cols[csi_driver] = col
         return col
 
-    def _pod_vols(self, pod: Pod) -> Tuple[List[int], np.ndarray, list]:
-        """(exclusive disk-conflict volume ids, per-filter-type UNIQUE new
-        volume counts, per-type unique id sets).
+    def _pod_vols(self, pod: Pod) -> Tuple[List[int], List[int], np.ndarray, list]:
+        """(disk-conflict CHECK tokens, disk-conflict ADVERTISE tokens,
+        per-filter-type UNIQUE new volume counts, per-type unique id sets).
 
-        ref predicates.go NoDiskConflict (GCE PD / AWS EBS / RBD / ISCSI) and
-        MaxVolumeCount filters — the count predicates dedupe by volume
-        identity (filterVolumes keys a map by unique id), so a pod
-        referencing one EBS volume twice counts once.
+        ref predicates.go NoDiskConflict (isVolumeConflict :295-328) and
+        MaxVolumeCount filters.  Counts dedupe by volume identity
+        (filterVolumes keys a map by unique id).  Conflict tokens encode
+        the read-only allowance: GCE-PD / RBD / ISCSI mounts that are BOTH
+        read-only don't conflict, so volume V advertises "V#any" (+"V#rw"
+        when read-write) and checks "V#any" when read-write but only
+        "V#rw" when read-only; EBS conflicts regardless (one token).
         """
         if not pod.spec.volumes:  # hot path: most pods mount nothing
-            return [], np.zeros(self.dims.VT, np.float32), [
+            return [], [], np.zeros(self.dims.VT, np.float32), [
                 set() for _ in range(self.dims.VT)
             ]
-        disk: List[int] = []
+        disk: List[int] = []       # check tokens (the pod's own mounts)
+        disk_adv: List[int] = []   # advertise tokens (what a node shows)
         cnt_ids: list = [set() for _ in range(self.dims.VT)]
+
+        def allow_ro(base: str, ro: bool) -> None:
+            it = self.interner
+            disk_adv.append(it.intern(base + "#any"))
+            if not ro:
+                disk_adv.append(it.intern(base + "#rw"))
+            disk.append(it.intern(base + ("#rw" if ro else "#any")))
+
         for v in pod.spec.volumes:
             if "gcePersistentDisk" in v:
-                vid = self.interner.intern("gce/" + v["gcePersistentDisk"].get("pdName", ""))
-                disk.append(vid)
-                cnt_ids[VOL_GCE].add(vid)
+                g = v["gcePersistentDisk"]
+                base = "gce/" + g.get("pdName", "")
+                allow_ro(base, bool(g.get("readOnly")))
+                cnt_ids[VOL_GCE].add(self.interner.intern(base))
             elif "awsElasticBlockStore" in v:
                 vid = self.interner.intern("ebs/" + v["awsElasticBlockStore"].get("volumeID", ""))
                 disk.append(vid)
+                disk_adv.append(vid)
                 cnt_ids[VOL_EBS].add(vid)
             elif "rbd" in v:
                 r = v["rbd"]
-                disk.append(
-                    self.interner.intern(
-                        "rbd/%s/%s/%s" % (",".join(r.get("monitors", [])), r.get("pool", "rbd"), r.get("image", ""))
-                    )
+                allow_ro(
+                    "rbd/%s/%s/%s" % (",".join(r.get("monitors", [])), r.get("pool", "rbd"), r.get("image", "")),
+                    bool(r.get("readOnly")),
                 )
             elif "iscsi" in v:
                 r = v["iscsi"]
-                disk.append(
-                    self.interner.intern("iscsi/%s/%s/%s" % (r.get("targetPortal", ""), r.get("iqn", ""), r.get("lun", 0)))
+                allow_ro(
+                    "iscsi/%s/%s/%s" % (r.get("targetPortal", ""), r.get("iqn", ""), r.get("lun", 0)),
+                    bool(r.get("readOnly")),
                 )
             elif "azureDisk" in v:
                 cnt_ids[VOL_AZURE].add(
@@ -776,7 +792,7 @@ class SnapshotEncoder:
         if len(cnt_ids) < self.dims.VT:  # a driver column appeared mid-scan
             cnt_ids.extend(set() for _ in range(self.dims.VT - len(cnt_ids)))
         counts = np.asarray([len(ids) for ids in cnt_ids], np.float32)
-        return disk, counts, cnt_ids
+        return disk, disk_adv, counts, cnt_ids
 
     def _nonzero(self, pod: Pod) -> np.ndarray:
         cpu = 0.0
@@ -827,7 +843,8 @@ class SnapshotEncoder:
             self._req_memo[rk] = hit
         req, nonzero = hit
         ports = self._pod_ports(pod)
-        disk, vcounts, cnt_ids = self._pod_vols(pod)
+        disk_check, disk_adv, vcounts, cnt_ids = self._pod_vols(pod)
+        disk = disk_adv  # the NODE advertises; rec stores what to retract
         rec = _PodRecord(
             key=key,
             labels=dict(pod.labels),
@@ -1307,12 +1324,14 @@ class SnapshotEncoder:
 
         Returns (pod_req_ext f32[E], requested_ext f32[N, E],
         allocatable_ext f32[N, E], pods_req_ext f32[M, E])."""
+        # _pod_vols can grow dims.VT (first-seen CSI driver): call it
+        # BEFORE sizing the ext arrays (the encode_pods pre-registration
+        # discipline)
+        want_ports = self._pod_ports(pod)
+        want_disk, _, new_vols, _ = self._pod_vols(pod)
         R = self.dims.R
         E = R + 2 + self.dims.VT
         M, N = self._cap_m, self._cap_n
-
-        want_ports = self._pod_ports(pod)
-        want_disk, new_vols, _ = self._pod_vols(pod)
         want_disk_set = set(want_disk)
 
         pods_ext = np.zeros((M, E), np.float32)
@@ -1667,7 +1686,7 @@ class SnapshotEncoder:
                     out["image_ids"][b, j] = it.lookup(
                         normalized_image(c.image)
                     )
-            disk, vcounts, cnt_ids = self._pod_vols(pod)
+            disk, _, vcounts, cnt_ids = self._pod_vols(pod)
             cnt_ids_by_b[b] = cnt_ids
             out["new_vol_counts"][b] = vcounts
             for j, dv in enumerate(disk[: d.DV]):
@@ -1724,7 +1743,7 @@ class SnapshotEncoder:
                 continue
             cnt_ids = (cnt_ids_by_b or {}).get(b)
             if cnt_ids is None:
-                _, _, cnt_ids = self._pod_vols(pod)
+                _, _, _, cnt_ids = self._pod_vols(pod)
             for t, ids in enumerate(cnt_ids):
                 for vid in ids:
                     for row in self._cnt_vol_rows[t].get(vid, ()):

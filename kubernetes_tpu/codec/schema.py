@@ -156,7 +156,7 @@ class PadDims:
     DVN: int = 8      # disk-conflict volume ids per node
     VZ: int = 2       # volume zone-restriction terms per pod (bound PV labels)
     VB: int = 2       # volume binding-restriction terms per pod
-    VT: int = 5       # attach-count filter columns (5 base types + one per
+    VT: int = NUM_VOL_TYPES  # attach-count filter columns (base types + one per
                       #   distinct CSI driver — csi_volume_predicate.go
                       #   counts and limits PER DRIVER)
 

@@ -279,27 +279,45 @@ class CPUScheduler:
         return node.status.conditions.get("PIDPressure", "False") != "True"
 
     @staticmethod
-    def _disk_vols(pod: Pod) -> List[str]:
-        out = []
+    def _disk_vols(pod: Pod) -> Tuple[List[str], List[str]]:
+        """(check tokens, advertise tokens) for NoDiskConflict
+        (predicates.go isVolumeConflict :295-328): GCE-PD / RBD / ISCSI
+        mounts that are BOTH read-only don't conflict, so an ro-allowance
+        volume V advertises "V#any" (+"V#rw" when mounted read-write) and
+        checks "V#any" when read-write but only "V#rw" when read-only;
+        EBS conflicts regardless of access mode (one token both ways)."""
+        check, adv = [], []
+
+        def allow_ro(base: str, ro: bool) -> None:
+            adv.append(base + "#any")
+            if not ro:
+                adv.append(base + "#rw")
+            check.append(base + ("#rw" if ro else "#any"))
+
         for v in pod.spec.volumes:
             if "gcePersistentDisk" in v:
-                out.append("gce/" + v["gcePersistentDisk"].get("pdName", ""))
+                g = v["gcePersistentDisk"]
+                allow_ro("gce/" + g.get("pdName", ""), bool(g.get("readOnly")))
             elif "awsElasticBlockStore" in v:
-                out.append("ebs/" + v["awsElasticBlockStore"].get("volumeID", ""))
+                t = "ebs/" + v["awsElasticBlockStore"].get("volumeID", "")
+                check.append(t)
+                adv.append(t)
             elif "rbd" in v:
                 r = v["rbd"]
-                out.append("rbd/%s/%s/%s" % (",".join(r.get("monitors", [])), r.get("pool", "rbd"), r.get("image", "")))
+                base = "rbd/%s/%s/%s" % (",".join(r.get("monitors", [])), r.get("pool", "rbd"), r.get("image", ""))
+                allow_ro(base, bool(r.get("readOnly")))
             elif "iscsi" in v:
                 r = v["iscsi"]
-                out.append("iscsi/%s/%s/%s" % (r.get("targetPortal", ""), r.get("iqn", ""), r.get("lun", 0)))
-        return out
+                base = "iscsi/%s/%s/%s" % (r.get("targetPortal", ""), r.get("iqn", ""), r.get("lun", 0))
+                allow_ro(base, bool(r.get("readOnly")))
+        return check, adv
 
     def no_disk_conflict(self, pod: Pod, node: Node) -> bool:
-        mine = set(self._disk_vols(pod))
+        mine = set(self._disk_vols(pod)[0])
         if not mine:
             return True
         for p in self.by_node[node.name]:
-            if mine & set(self._disk_vols(p)):
+            if mine & set(self._disk_vols(p)[1]):
                 return False
         return True
 
@@ -390,11 +408,16 @@ class CPUScheduler:
         })
         return {d: NUM_VOL_TYPES + i for i, d in enumerate(drivers)}
 
-    def _vol_ids_with_pvc(self, pod: Pod) -> List[set]:
+    def _vol_ids_with_pvc(self, pod: Pod, driver_cols=None) -> List[set]:
         """Per-column UNIQUE volume identities (direct + PVC-resolved) — the
-        filterVolumes map keys (predicates.go:330-430); columns 5+ are
-        per-CSI-driver."""
-        ids: List[set] = [set() for _ in range(self._vol_cols_count())]
+        filterVolumes map keys (predicates.go:330-430); columns past the
+        base types are per-CSI-driver.  driver_cols may be precomputed by
+        the caller (one scan per verdict, not per pod)."""
+        if driver_cols is None:
+            driver_cols = self._csi_driver_cols()
+        ids: List[set] = [
+            set() for _ in range(NUM_VOL_TYPES + len(driver_cols))
+        ]
         for v in pod.spec.volumes:
             if "awsElasticBlockStore" in v:
                 ids[0].add("ebs/" + v["awsElasticBlockStore"].get("volumeID", ""))
@@ -412,7 +435,6 @@ class CPUScheduler:
             "cinder": 4,
         }
         prefix = ["ebs/", "gce/", "csi/", "azd/", "cinder/"]
-        driver_cols = self._csi_driver_cols()
         for pvc in self._pod_pvcs(pod):
             if pvc is not None and pvc.volume_name:
                 pv = self.pvs.get(pvc.volume_name)
@@ -432,11 +454,12 @@ class CPUScheduler:
         the node's DISTINCT attached set, and pod volumes already mounted
         there attach nothing new (the already-mounted subtraction,
         predicates.go:349-363)."""
-        VT = self._vol_cols_count()
-        pod_ids = self._vol_ids_with_pvc(pod)
+        driver_cols = self._csi_driver_cols()
+        VT = NUM_VOL_TYPES + len(driver_cols)
+        pod_ids = self._vol_ids_with_pvc(pod, driver_cols)
         node_ids: List[set] = [set() for _ in range(VT)]
         for p in self.by_node[node.name]:
-            for i, x in enumerate(self._vol_ids_with_pvc(p)):
+            for i, x in enumerate(self._vol_ids_with_pvc(p, driver_cols)):
                 node_ids[i] |= x
         used = [float(len(x)) for x in node_ids]
         new = [float(len(pod_ids[i] - node_ids[i])) for i in range(VT)]
@@ -450,7 +473,6 @@ class CPUScheduler:
             "attachable-volumes-gce-pd": 1,
             "attachable-volumes-azure-disk": 3,
         }
-        driver_cols = self._csi_driver_cols()
         for k, q in node.status.allocatable.items():
             if k in limit_keys:
                 limits[limit_keys[k]] = min(limits[limit_keys[k]], float(q))

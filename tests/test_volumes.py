@@ -315,3 +315,38 @@ def test_unknown_driver_cap_does_not_clamp_generic_csi():
     dev = bool(np.asarray(per_pred)[0, PRED_INDEX["MaxCSIVolumeCount"], 0])
     assert dev, "the rare-driver cap must not clamp the generic column"
     assert golden.predicates(pod, node)["MaxCSIVolumeCount"] == dev
+
+
+def test_no_disk_conflict_read_only_allowance():
+    """isVolumeConflict (predicates.go:295-328): GCE-PD mounts that are
+    BOTH read-only coexist; any read-write side conflicts; EBS conflicts
+    regardless of access mode."""
+    node = make_node("n1", cpu="8", mem="16Gi")
+
+    def gce(name, ro):
+        return {"gcePersistentDisk": {"pdName": name, "readOnly": ro}}
+
+    def ebs(name, ro):
+        return {"awsElasticBlockStore": {"volumeID": name, "readOnly": ro}}
+
+    cases = [
+        # (existing volume, pending volume, fits?)
+        (gce("d", True), gce("d", True), True),    # ro + ro: allowed
+        (gce("d", True), gce("d", False), False),  # rw against ro mount
+        (gce("d", False), gce("d", True), False),  # ro against rw mount
+        (gce("d", False), gce("d", False), False),
+        (ebs("e", True), ebs("e", True), False),   # EBS: no allowance
+        (gce("d", True), gce("other", False), True),
+    ]
+    for i, (existing_vol, pending_vol, fits) in enumerate(cases):
+        existing = make_pod(f"e{i}", cpu="10m", mem="1Mi", node_name="n1",
+                            volumes=[existing_vol])
+        pending = make_pod(f"p{i}", cpu="10m", mem="1Mi",
+                           volumes=[pending_vol])
+        enc = build([node], [existing], [], [])
+        golden = CPUScheduler([node], [existing])
+        batch = enc.encode_pods([pending])
+        _, per_pred = filter_batch(enc.snapshot(), batch, FilterConfig(), 0)
+        dev = bool(np.asarray(per_pred)[0, PRED_INDEX["NoDiskConflict"], 0])
+        assert dev == fits, (i, existing_vol, pending_vol, dev)
+        assert golden.predicates(pending, node)["NoDiskConflict"] == fits, i
