@@ -734,17 +734,22 @@ class SnapshotEncoder:
                 disk_adv.append(vid)
                 cnt_ids[VOL_EBS].add(vid)
             elif "rbd" in v:
+                # identity = monitor OVERLAP + pool + image (predicates.go
+                # :264-272 haveOverlap): one token per monitor, so any
+                # shared monitor collides
                 r = v["rbd"]
-                allow_ro(
-                    "rbd/%s/%s/%s" % (",".join(r.get("monitors", [])), r.get("pool", "rbd"), r.get("image", "")),
-                    bool(r.get("readOnly")),
-                )
+                # no monitors -> no tokens (haveOverlap([], x) is false)
+                for mon in r.get("monitors", []) or ():
+                    allow_ro(
+                        "rbd/%s/%s/%s" % (mon, r.get("pool", "rbd"), r.get("image", "")),
+                        bool(r.get("readOnly")),
+                    )
             elif "iscsi" in v:
+                # identity = IQN alone (predicates.go:253-262 — multi-path
+                # target portals reach the same LUNs)
                 r = v["iscsi"]
-                allow_ro(
-                    "iscsi/%s/%s/%s" % (r.get("targetPortal", ""), r.get("iqn", ""), r.get("lun", 0)),
-                    bool(r.get("readOnly")),
-                )
+                allow_ro("iscsi/%s" % r.get("iqn", ""),
+                         bool(r.get("readOnly")))
             elif "azureDisk" in v:
                 cnt_ids[VOL_AZURE].add(
                     self.interner.intern("azd/" + v["azureDisk"].get("diskName", ""))
@@ -1410,6 +1415,17 @@ class SnapshotEncoder:
                     DV=1, VZ=1, VB=1)
         for pod in pods:
             need["Q"] = max(need["Q"], len(pod.host_ports()))
+            # pod-side disk-conflict check tokens: one per gce/ebs/iscsi
+            # volume, one PER MONITOR for rbd (the overlap identity) — the
+            # DV axis must fit them all or conflicts silently vanish
+            n_disk = 0
+            for v in pod.spec.volumes:
+                if "rbd" in v:
+                    n_disk += len(v["rbd"].get("monitors", []) or ())
+                elif ("gcePersistentDisk" in v or "awsElasticBlockStore" in v
+                      or "iscsi" in v):
+                    n_disk += 1
+            need["DV"] = max(need["DV"], n_disk)
             n_pvc = sum(1 for v in pod.spec.volumes if "persistentVolumeClaim" in v)
             need["VZ"] = max(need["VZ"], n_pvc)
             need["VB"] = max(need["VB"], n_pvc)

@@ -303,13 +303,20 @@ class CPUScheduler:
                 check.append(t)
                 adv.append(t)
             elif "rbd" in v:
+                # monitor OVERLAP + pool + image (haveOverlap, :264-272):
+                # one token per monitor
                 r = v["rbd"]
-                base = "rbd/%s/%s/%s" % (",".join(r.get("monitors", [])), r.get("pool", "rbd"), r.get("image", ""))
-                allow_ro(base, bool(r.get("readOnly")))
+                # no monitors -> no tokens (haveOverlap([], x) is false)
+                for mon in r.get("monitors", []) or ():
+                    allow_ro(
+                        "rbd/%s/%s/%s" % (mon, r.get("pool", "rbd"), r.get("image", "")),
+                        bool(r.get("readOnly")),
+                    )
             elif "iscsi" in v:
+                # IQN alone (:253-262 — multi-path portals, same LUNs)
                 r = v["iscsi"]
-                base = "iscsi/%s/%s/%s" % (r.get("targetPortal", ""), r.get("iqn", ""), r.get("lun", 0))
-                allow_ro(base, bool(r.get("readOnly")))
+                allow_ro("iscsi/%s" % r.get("iqn", ""),
+                         bool(r.get("readOnly")))
         return check, adv
 
     def no_disk_conflict(self, pod: Pod, node: Node) -> bool:

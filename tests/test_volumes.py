@@ -350,3 +350,66 @@ def test_no_disk_conflict_read_only_allowance():
         dev = bool(np.asarray(per_pred)[0, PRED_INDEX["NoDiskConflict"], 0])
         assert dev == fits, (i, existing_vol, pending_vol, dev)
         assert golden.predicates(pending, node)["NoDiskConflict"] == fits, i
+
+
+def test_disk_conflict_iscsi_iqn_and_rbd_monitor_overlap():
+    """isVolumeConflict identity rules (predicates.go:253-272): ISCSI keys
+    on IQN alone (multi-path portals still conflict); RBD keys on monitor
+    OVERLAP + pool + image."""
+    node = make_node("n1", cpu="8", mem="16Gi")
+
+    def iscsi(portal, iqn, ro=False):
+        return {"iscsi": {"targetPortal": portal, "iqn": iqn, "lun": 0,
+                          "readOnly": ro}}
+
+    def rbd(mons, image, ro=False):
+        return {"rbd": {"monitors": mons, "pool": "p", "image": image,
+                        "readOnly": ro}}
+
+    cases = [
+        # same IQN via DIFFERENT portals: conflict (multi-path)
+        (iscsi("10.0.0.1:3260", "iqn.x"), iscsi("10.0.0.2:3260", "iqn.x"),
+         False),
+        (iscsi("10.0.0.1:3260", "iqn.x"), iscsi("10.0.0.1:3260", "iqn.y"),
+         True),
+        # same IQN both read-only: allowed
+        (iscsi("a", "iqn.x", ro=True), iscsi("b", "iqn.x", ro=True), True),
+        # overlapping (not identical) monitor lists: conflict
+        (rbd(["m1", "m2"], "img"), rbd(["m2", "m3"], "img"), False),
+        # disjoint monitors: no conflict even for the same image
+        (rbd(["m1"], "img"), rbd(["m9"], "img"), True),
+        # overlap but different image: no conflict
+        (rbd(["m1"], "img"), rbd(["m1"], "other"), True),
+    ]
+    for i, (existing_vol, pending_vol, fits) in enumerate(cases):
+        existing = make_pod(f"e{i}", cpu="10m", mem="1Mi", node_name="n1",
+                            volumes=[existing_vol])
+        pending = make_pod(f"p{i}", cpu="10m", mem="1Mi",
+                           volumes=[pending_vol])
+        enc = build([node], [existing], [], [])
+        golden = CPUScheduler([node], [existing])
+        batch = enc.encode_pods([pending])
+        _, per_pred = filter_batch(enc.snapshot(), batch, FilterConfig(), 0)
+        dev = bool(np.asarray(per_pred)[0, PRED_INDEX["NoDiskConflict"], 0])
+        assert dev == fits, (i, existing_vol, pending_vol, dev)
+        assert golden.predicates(pending, node)["NoDiskConflict"] == fits, i
+
+
+def test_rbd_many_monitors_do_not_truncate():
+    """A 5-monitor RBD volume (standard Ceph HA) must check every monitor
+    token — DV grows with the pod's token count, no silent truncation."""
+    node = make_node("n1", cpu="8", mem="16Gi")
+    mons = [f"m{i}" for i in range(5)]
+    existing = make_pod("e", cpu="10m", mem="1Mi", node_name="n1",
+                        volumes=[{"rbd": {"monitors": ["m4"], "pool": "p",
+                                          "image": "img"}}])
+    pending = make_pod("p", cpu="10m", mem="1Mi",
+                       volumes=[{"rbd": {"monitors": mons, "pool": "p",
+                                         "image": "img"}}])
+    enc = build([node], [existing], [], [])
+    golden = CPUScheduler([node], [existing])
+    batch = enc.encode_pods([pending])
+    _, per_pred = filter_batch(enc.snapshot(), batch, FilterConfig(), 0)
+    dev = bool(np.asarray(per_pred)[0, PRED_INDEX["NoDiskConflict"], 0])
+    assert not dev, "overlap through the 5th monitor must conflict"
+    assert golden.predicates(pending, node)["NoDiskConflict"] == dev
