@@ -199,29 +199,69 @@ class Priority:
             if _pc_field(obj, "value") is None:
                 raise AdmissionDenied("priority class needs a value")
             return obj
-        if kind != "pods" or op != "CREATE":
+        if kind != "pods" or op not in ("CREATE", "UPDATE"):
             return obj
         spec = obj.setdefault("spec", {})
+        if op == "UPDATE":
+            # spec.priority is immutable after CREATE (ValidatePodUpdate):
+            # without this, a client could PUT an arbitrary priority and
+            # bypass the CREATE-time self-assignment denial below.
+            meta = obj.get("metadata") or {}
+            ns = obj.get("namespace", meta.get("namespace", "default"))
+            pod_name = obj.get("name", meta.get("name", ""))
+            cur = self.cluster.get("pods", ns, pod_name)
+            cur_pri = getattr(getattr(cur, "spec", None), "priority", None)
+            if cur is None or cur_pri is None:
+                return obj
+            provided = spec.get("priority")
+            if provided is not None:
+                try:
+                    provided = int(provided)
+                except (TypeError, ValueError):
+                    raise AdmissionDenied(
+                        f"spec.priority must be an integer, got {provided!r}"
+                    )
+                if provided != int(cur_pri):
+                    raise AdmissionDenied(
+                        "pod updates may not change spec.priority "
+                        f"(have {cur_pri}, got {provided})"
+                    )
+            spec["priority"] = int(cur_pri)
+            return obj
         name = spec.get("priorityClassName", "")
+        provided = spec.get("priority")
         if name:
             if name in SYSTEM_PRIORITY_CLASSES:
-                spec["priority"] = SYSTEM_PRIORITY_CLASSES[name]
-                return obj
-            pc = self.cluster.get("priorityclasses", "", name)
-            if pc is None:
+                resolved = SYSTEM_PRIORITY_CLASSES[name]
+            else:
+                pc = self.cluster.get("priorityclasses", "", name)
+                if pc is None:
+                    raise AdmissionDenied(
+                        f"no PriorityClass with name {name} was found"
+                    )
+                resolved = int(_pc_field(pc, "value", 0))
+        else:
+            resolved = 0
+            for pc in self.cluster.list("priorityclasses"):
+                if _pc_field(pc, "globalDefault"):
+                    resolved = int(_pc_field(pc, "value", 0))
+                    break
+        # A client-supplied priority must match the computed value — pods
+        # may not self-assign priorities (priority/admission.go:216).
+        if provided is not None:
+            try:
+                provided = int(provided)
+            except (TypeError, ValueError):
                 raise AdmissionDenied(
-                    f"no PriorityClass with name {name} was found"
+                    f"spec.priority must be an integer, got {provided!r}"
                 )
-            spec["priority"] = int(_pc_field(pc, "value", 0))
-            return obj
-        if "priority" in spec:
-            return obj
-        default = 0
-        for pc in self.cluster.list("priorityclasses"):
-            if _pc_field(pc, "globalDefault"):
-                default = int(_pc_field(pc, "value", 0))
-                break
-        spec["priority"] = default
+        if provided is not None and provided != resolved:
+            raise AdmissionDenied(
+                "the integer value of priority must not be provided in pod "
+                f"spec; priority admission controller computed {resolved} "
+                f"from the given PriorityClass name, got {provided}"
+            )
+        spec["priority"] = resolved
         return obj
 
 

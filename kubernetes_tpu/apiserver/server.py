@@ -506,11 +506,20 @@ class APIServer:
                     self._status(404, "NotFound", f"unknown resource {kind}")
                     return
                 if name:
-                    obj = outer.cluster.get(kind, ns, name)
+                    obj, rv = outer.cluster.get_with_rv(kind, ns, name)
                     if obj is None:
                         self._status(404, "NotFound", f"{kind} {ns}/{name}")
                         return
-                    self._send(object_to_dict(kind, obj))
+                    # copy before injecting: for dict-backed kinds
+                    # object_to_dict returns the STORED dict by reference —
+                    # mutating it here would alter live cluster state from
+                    # the handler thread, outside the cluster lock
+                    out = dict(object_to_dict(kind, obj))
+                    out["metadata"] = dict(out.get("metadata") or {})
+                    # expose the revision so read-modify-write clients can
+                    # round-trip it into PUT's CAS (etcd3 mod_revision analog)
+                    out["metadata"]["resourceVersion"] = str(rv)
+                    self._send(out)
                 else:
                     def ns_of(o):
                         if isinstance(o, dict):

@@ -30,6 +30,7 @@ import urllib.request
 from typing import Optional
 
 from kubernetes_tpu.runtime.cluster import ConflictError, LocalCluster
+from kubernetes_tpu.utils import klog
 
 
 def _decode(kind: str, d: dict):
@@ -74,8 +75,11 @@ class Reflector:
             try:
                 self._list_and_watch()
                 delay = self.backoff  # clean disconnect: reset backoff
-            except Exception:
-                pass
+            except Exception as e:
+                # distinguish stream loss from decode/schema bugs — a silent
+                # reconnect loop hides both (reflector.go logs via utilruntime
+                # HandleError before backing off)
+                klog.errorf("reflector: watch of %s failed: %r", self.server, e)
             if self._stop.is_set():
                 return
             time.sleep(delay)
@@ -171,20 +175,29 @@ def remote_unbinder(server: str):
     the pod with spec.nodeName cleared (the store-level unbind analog)."""
     server = server.rstrip("/")
 
-    def unbind(pod) -> bool:
+    def unbind(pod, _retries: int = 3) -> bool:
         base = f"{server}/api/v1/namespaces/{pod.namespace}/pods/{pod.name}"
-        try:
-            with urllib.request.urlopen(base, timeout=10) as resp:
-                d = json.loads(resp.read())
-            d.setdefault("spec", {})["nodeName"] = ""
-            req = urllib.request.Request(
-                base, data=json.dumps(d).encode(), method="PUT",
-                headers={"Content-Type": "application/json"},
-            )
-            with urllib.request.urlopen(req, timeout=10) as resp:
-                return resp.status == 200
-        except (urllib.error.HTTPError, urllib.error.URLError):
-            return False
+        for _ in range(_retries):
+            try:
+                with urllib.request.urlopen(base, timeout=10) as resp:
+                    d = json.loads(resp.read())
+                d.setdefault("spec", {})["nodeName"] = ""
+                # carry the fetched resourceVersion so the server's CAS
+                # rejects this write if a concurrent status update / re-bind
+                # landed between our GET and PUT (no silent clobber)
+                req = urllib.request.Request(
+                    base, data=json.dumps(d).encode(), method="PUT",
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return resp.status == 200
+            except urllib.error.HTTPError as e:
+                if e.code == 409:
+                    continue  # stale read: re-GET and retry the CAS
+                return False
+            except urllib.error.URLError:
+                return False
+        return False
 
     return unbind
 

@@ -1437,6 +1437,13 @@ class HPAController:
     metrics.k8s.io endpoint reports for hollow pods — so a real cadvisor
     would plug in at the same point."""
 
+    # rescale only when |usage/requested/target - 1| exceeds this band
+    # (replica_calculator.go defaultTolerance = 0.1).  NOTE: the default
+    # requests-based usage_fn always reads utilization == 100%, so with
+    # target < ~91 an HPA ratchets toward max unless a real usage source
+    # (metrics.k8s.io observed values) is plugged in.
+    TOLERANCE = 0.1
+
     def __init__(self, cluster: LocalCluster, usage_fn=None):
         self.cluster = cluster
         self.usage_fn = usage_fn or self._requests_usage
@@ -1482,9 +1489,14 @@ class HPAController:
             requested = sum(self._requests_usage(p) for p in pods)
             if requested > 0:
                 utilization = 100.0 * usage / requested
-                desired = math.ceil(
-                    len(pods) * utilization / hpa.target_cpu_utilization
-                )
+                ratio = utilization / hpa.target_cpu_utilization
+                if abs(ratio - 1.0) <= self.TOLERANCE:
+                    # within the tolerance band: no rescale
+                    # (replica_calculator.go:71-76) — without this, steady
+                    # utilization slightly off target rescales every tick
+                    desired = current
+                else:
+                    desired = math.ceil(len(pods) * ratio)
             else:
                 desired = current
         else:

@@ -108,12 +108,25 @@ class PersistentCluster(LocalCluster):
         from kubernetes_tpu.runtime.cluster import _Stored
 
         self.register_kind(kind)
+        # Rebuild the in-memory event history alongside state, so a
+        # post-restart watch_from(rv) inside the (compacted_rv, head] window
+        # replays the WAL tail instead of silently delivering nothing (the
+        # etcd watcher resume contract: deliver or ErrCompacted, never skip).
         if op == "delete":
             ns, name = e["key"]
-            self._store[kind].pop((ns, name), None)
+            prev = self._store[kind].pop((ns, name), None)
+            if prev is not None:
+                self._events.append((rv, DELETED, kind, prev.obj))
+            else:
+                # pre-delete payload unavailable (entry references an object
+                # the snapshot+WAL never materialized); a faithful replay is
+                # impossible, so compact past it: resumes below rv get 410
+                # and relist rather than a silently dropped event
+                self._compacted_rv = rv
         else:
             obj = _decode(kind, e["obj"])
             self._store[kind][self._key(kind, obj)] = _Stored(obj, rv)
+            self._events.append((rv, ADDED if op == "create" else MODIFIED, kind, obj))
         self._rv = max(self._rv, rv)
 
     # ------------------------------------------------------------ wal hooks

@@ -349,3 +349,50 @@ def test_quota_enforcement_is_atomic_under_concurrency():
         assert len(cluster.list("pods")) == 5
     finally:
         srv.stop()
+
+
+def test_priority_denies_client_supplied_priority_mismatch():
+    """priority/admission.go:216: a pod may not self-assign spec.priority —
+    a provided value must equal what the (default) class resolves to."""
+    cluster = LocalCluster()
+    cluster.create("priorityclasses",
+                   {"namespace": "", "name": "high", "value": 1000})
+    p = Priority(cluster)
+    # mismatching the named class -> denied
+    d = _pod_dict("a", priority_class="high")
+    d["spec"]["priority"] = 2000001000
+    with pytest.raises(AdmissionDenied):
+        p("CREATE", "pods", d)
+    # matching value passes
+    d = _pod_dict("b", priority_class="high")
+    d["spec"]["priority"] = 1000
+    assert p("CREATE", "pods", d)["spec"]["priority"] == 1000
+    # no class: provided nonzero (default is 0) -> denied
+    d = _pod_dict("c")
+    d["spec"]["priority"] = 7
+    with pytest.raises(AdmissionDenied):
+        p("CREATE", "pods", d)
+
+
+def test_priority_immutable_on_update():
+    """ValidatePodUpdate: spec.priority cannot change after CREATE — a PUT
+    carrying a different value is denied, and one omitting it keeps the
+    stored value (no bypass of the CREATE-time self-assignment denial)."""
+    from kubernetes_tpu.api.serialize import pod_to_dict
+    from fixtures import make_pod
+
+    cluster = LocalCluster()
+    p = Priority(cluster)
+    d = p("CREATE", "pods", _pod_dict("a"))
+    assert d["spec"]["priority"] == 0
+    import dataclasses as _dc
+    pod = make_pod("a")
+    pod = _dc.replace(pod, spec=_dc.replace(pod.spec, priority=0))
+    cluster.add_pod(pod)
+    upd = _pod_dict("a")
+    upd["spec"]["priority"] = 2000001000
+    with pytest.raises(AdmissionDenied):
+        p("UPDATE", "pods", upd)
+    upd2 = _pod_dict("a")          # omitted -> stored value re-injected
+    out = p("UPDATE", "pods", upd2)
+    assert out["spec"]["priority"] == 0

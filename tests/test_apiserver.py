@@ -127,6 +127,26 @@ def test_optimistic_concurrency_put(server):
     assert code == 409
 
 
+def test_get_returns_resource_version_for_cas(server):
+    """Single-object GET carries metadata.resourceVersion so read-modify-
+    write clients (remote_unbinder) can round-trip it into PUT's CAS."""
+    u = server.url
+    code, _ = _req(f"{u}/api/v1/nodes", "POST",
+                   node_to_dict(make_node("nrv", cpu="4")))
+    assert code == 201
+    code, got = _req(f"{u}/api/v1/nodes/nrv")
+    assert code == 200
+    rv = got["metadata"]["resourceVersion"]
+    assert rv
+    # GET -> mutate -> PUT succeeds with the fetched rv...
+    got["metadata"]["resourceVersion"] = rv
+    code, _ = _req(f"{u}/api/v1/nodes/nrv", "PUT", got)
+    assert code == 200
+    # ...and the stale rv now loses the CAS
+    code, _ = _req(f"{u}/api/v1/nodes/nrv", "PUT", got)
+    assert code == 409
+
+
 def test_admission_chain_mutates_and_denies():
     def defaulter(op, kind, d):
         if kind == "pods":

@@ -415,3 +415,54 @@ def test_ttl_after_finished_reaps_done_jobs():
     # fresh expires later
     assert ctrl.tick(now + 55) == 1
     assert cluster.get("jobs", "default", "fresh") is None
+
+
+def test_hpa_tolerance_band_suppresses_rescale():
+    """replica_calculator.go defaultTolerance = 0.1: steady utilization
+    within 10% of target must NOT rescale (ADVICE r2: without the band,
+    every 15s tick rescales on tiny deviations)."""
+    from kubernetes_tpu.runtime.controllers import (
+        Deployment,
+        DeploymentController,
+        HPAController,
+        HorizontalPodAutoscaler,
+        ReplicaSetController,
+    )
+
+    cluster = LocalCluster()
+    dep_ctrl = DeploymentController(cluster)
+    rs_ctrl = ReplicaSetController(cluster)
+    cluster.create("deployments", Deployment(
+        namespace="default", name="web", replicas=3,
+        selector={"app": "web"},
+        template={"metadata": {"labels": {"app": "web"}},
+                  "spec": {"containers": [{"name": "c", "resources": {
+                      "requests": {"cpu": "100m", "memory": "64Mi"}}}]}},
+    ))
+    _drain(dep_ctrl)
+    _drain(rs_ctrl)
+    for p in cluster.list("pods"):
+        p2, rv = cluster.get_with_rv("pods", p.namespace, p.name)
+        cluster.update("pods", dataclasses.replace(
+            p2, status=dataclasses.replace(p2.status, phase="Running")
+        ), expect_rv=rv)
+
+    # utilization 108% of target: inside the band -> no rescale
+    hpa_ctrl = HPAController(
+        cluster, usage_fn=lambda p: 1.08 * HPAController._requests_usage(p)
+    )
+    cluster.create("horizontalpodautoscalers", HorizontalPodAutoscaler(
+        namespace="default", name="web-hpa",
+        target_kind="Deployment", target_name="web",
+        min_replicas=1, max_replicas=10, target_cpu_utilization=100,
+    ))
+    hpa_ctrl.tick()
+    assert cluster.get("deployments", "default", "web").replicas == 3
+    # 93% of target: also inside -> no downscale either
+    hpa_ctrl.usage_fn = lambda p: 0.93 * HPAController._requests_usage(p)
+    hpa_ctrl.tick()
+    assert cluster.get("deployments", "default", "web").replicas == 3
+    # 120%: outside the band -> rescales to ceil(3 * 1.2) = 4
+    hpa_ctrl.usage_fn = lambda p: 1.2 * HPAController._requests_usage(p)
+    hpa_ctrl.tick()
+    assert cluster.get("deployments", "default", "web").replicas == 4
