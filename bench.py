@@ -34,6 +34,7 @@ import numpy as np
 
 _ATTEMPT_ENV = "KTPU_BENCH_ATTEMPT"
 _TPU_ERROR_ENV = "KTPU_BENCH_TPU_ERROR"
+_TPU_LOG_ENV = "KTPU_BENCH_TPU_LOG"  # JSON list of per-attempt failures
 _DEADLINE_ENV = "KTPU_BENCH_DEADLINE"  # wall-clock; survives the re-exec
 _LOCK_PATH = "/tmp/ktpu_device.lock"
 
@@ -56,17 +57,40 @@ def _emit(result: dict) -> bool:
         return True
 
 
+def _attempt_log() -> list:
+    """Per-attempt TPU failure history, accumulated across re-execs via an
+    env var so the final JSON (success OR fallback) shows what each device
+    attempt saw — the audit trail VERDICT r2 asked for."""
+    try:
+        return json.loads(os.environ.get(_TPU_LOG_ENV, "[]"))
+    except ValueError:
+        return []
+
+
+def _log_attempt(attempt: int, err: BaseException) -> None:
+    log = _attempt_log()
+    log.append({
+        "attempt": attempt,
+        "t": round(time.time(), 1),
+        "error": f"{type(err).__name__}: {err}"[:500],
+    })
+    os.environ[_TPU_LOG_ENV] = json.dumps(log)
+
+
 def _error_line(stage: str, err: BaseException) -> dict:
+    detail = {
+        "error": f"{type(err).__name__}: {err}"[:2000],
+        "stage": stage,
+        "attempt": int(os.environ.get(_ATTEMPT_ENV, "0")),
+    }
+    if _attempt_log():
+        detail["tpu_attempts"] = _attempt_log()
     return {
         "metric": "pods_scheduled_per_sec_5k_nodes",
         "value": 0.0,
         "unit": "pods/s",
         "vs_baseline": 0.0,
-        "detail": {
-            "error": f"{type(err).__name__}: {err}"[:2000],
-            "stage": stage,
-            "attempt": int(os.environ.get(_ATTEMPT_ENV, "0")),
-        },
+        "detail": detail,
     }
 
 
@@ -100,10 +124,15 @@ def _reexec(attempt: int, err: BaseException, max_attempts: int, backoff: float)
     run still yields a labeled number instead of nothing.
     """
     msg = f"{type(err).__name__}: {err}"[:1000]
+    _log_attempt(attempt, err)
     if attempt < max_attempts:
-        sys.stderr.write(f"bench: device attempt {attempt} failed ({msg}); retrying\n")
+        delay = backoff * (2 ** attempt)  # real spread: a wedged tunnel
+        # needs minutes, not back-to-back re-inits (VERDICT r2)
+        sys.stderr.write(
+            f"bench: device attempt {attempt} failed ({msg}); "
+            f"retrying in {delay:.0f}s\n")
         sys.stderr.flush()
-        time.sleep(backoff * (attempt + 1))
+        time.sleep(delay)
         os.environ[_ATTEMPT_ENV] = str(attempt + 1)
     elif os.environ.get("JAX_PLATFORMS", "") != "cpu":
         sys.stderr.write(f"bench: TPU retries exhausted ({msg}); falling back to cpu\n")
@@ -382,6 +411,8 @@ def run(args) -> dict:
     }
     if os.environ.get(_TPU_ERROR_ENV):
         detail["tpu_error"] = os.environ[_TPU_ERROR_ENV]
+    if _attempt_log():
+        detail["tpu_attempts"] = _attempt_log()
     return {
         "metric": "pods_scheduled_per_sec_5k_nodes",
         "value": round(pods_per_s, 1),
@@ -412,7 +443,8 @@ def main():
     ap.add_argument("--warmup", type=int, default=2,
                     help="warmup batches (compile + first-fetch setup)")
     ap.add_argument("--retries", type=int, default=3, help="fresh-process TPU retries")
-    ap.add_argument("--retry-backoff", type=float, default=20.0, help="seconds")
+    ap.add_argument("--retry-backoff", type=float, default=45.0,
+                    help="base seconds; attempt k sleeps base * 2^k")
     ap.add_argument("--lock-timeout", type=float, default=600.0, help="seconds")
     ap.add_argument("--init-timeout", type=float, default=180.0,
                     help="seconds before a hung backend init counts as a "
@@ -501,6 +533,15 @@ def main():
             def _init():
                 try:
                     init_done["devices"] = jax.devices()
+                    # pre-warm with a trivial kernel AND a fetch inside the
+                    # same deadline: a tunnel that wedges at first USE (init
+                    # succeeds, compute hangs) is caught here, not after the
+                    # 5k-node encode; the fetch also pays the one-time D2H
+                    # setup cost outside the timed window
+                    import jax.numpy as jnp
+
+                    probe = np.asarray(jnp.arange(8.0) * 2.0)
+                    init_done["probe"] = float(probe[-1])
                 except Exception as ie:  # noqa: BLE001
                     init_done["error"] = ie
 
