@@ -63,6 +63,19 @@ _REGISTRY: Dict[str, tuple] = {
                          "CustomResourceDefinition"), True),
     "apiservices": (
         GroupVersionKind("apiregistration.k8s.io", "v1", "APIService"), True),
+    "secrets": (GroupVersionKind("", "v1", "Secret"), False),
+    "serviceaccounts": (GroupVersionKind("", "v1", "ServiceAccount"), False),
+    "roles": (
+        GroupVersionKind("rbac.authorization.k8s.io", "v1", "Role"), False),
+    "rolebindings": (
+        GroupVersionKind("rbac.authorization.k8s.io", "v1", "RoleBinding"),
+        False),
+    "clusterroles": (
+        GroupVersionKind("rbac.authorization.k8s.io", "v1", "ClusterRole"),
+        True),
+    "clusterrolebindings": (
+        GroupVersionKind("rbac.authorization.k8s.io", "v1",
+                         "ClusterRoleBinding"), True),
 }
 
 

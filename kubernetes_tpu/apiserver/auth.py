@@ -1,0 +1,357 @@
+"""Authentication + RBAC authorization for the REST layer.
+
+Reference: the apiserver handler chain wires WithAuthentication and
+WithAuthorization around every request
+(staging/src/k8s.io/apiserver/pkg/server/config.go:544-550); the stock
+authorizer is RBAC (plugin/pkg/auth/authorizer/rbac/rbac.go) evaluating
+Role/ClusterRole rules bound to users and groups
+(rbac.go RuleAllows + VisitRulesFor); bearer tokens resolve through a
+union of authenticators — bootstrap-token secrets
+(plugin/pkg/auth/authenticator/token/bootstrap/bootstrap.go:116-180,
+user ``system:bootstrap:<id>``, group ``system:bootstrappers``) and
+service-account token secrets (pkg/serviceaccount/jwt.go, user
+``system:serviceaccount:<ns>:<name>``).
+
+This module reproduces those semantics over the LocalCluster store:
+
+  * ``TokenAuthenticator`` resolves ``Authorization: Bearer`` tokens
+    against (a) an in-process static table (the kubeadm admin
+    credential), (b) ``bootstrap.kubernetes.io/token`` Secrets in
+    kube-system, (c) ``kubernetes.io/service-account-token`` Secrets,
+    and (d) generic ``kubernetes-tpu/auth-token`` Secrets carrying an
+    explicit user+groups payload (the stand-in for client-cert
+    identities like ``system:node:<name>`` — this snapshot's TLS
+    bootstrap/CSR machinery distilled to its authentication outcome).
+  * ``RBACAuthorizer`` evaluates live Role/ClusterRole(+Binding)
+    objects from the store; ``system:masters`` is the hardwired
+    superuser group (rbac.go:76-80 does the same via the legacy
+    cluster-admin binding).
+  * ``bootstrap_policy()`` is the default policy set kubeadm installs
+    (plugin/pkg/auth/authorizer/rbac/bootstrappolicy/policy.go).
+
+Unauthenticated requests run as ``system:anonymous`` in group
+``system:unauthenticated`` (apiserver/pkg/authentication/request/
+anonymous) — with RBAC on, that identity has no bindings, so anonymous
+writes fail closed with 403; a *present but invalid* token is 401.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class UserInfo:
+    name: str
+    groups: Tuple[str, ...] = ()
+
+    def in_group(self, g: str) -> bool:
+        return g in self.groups
+
+
+ANONYMOUS = UserInfo("system:anonymous", ("system:unauthenticated",))
+AUTHENTICATED = "system:authenticated"
+SUPERUSER_GROUP = "system:masters"
+NODES_GROUP = "system:nodes"
+BOOTSTRAP_GROUP = "system:bootstrappers"
+
+BOOTSTRAP_TOKEN_TYPE = "bootstrap.kubernetes.io/token"
+SA_TOKEN_TYPE = "kubernetes.io/service-account-token"
+AUTH_TOKEN_TYPE = "kubernetes-tpu/auth-token"
+TOKEN_NS = "kube-system"
+
+
+class AuthenticationError(Exception):
+    """Presented credentials are invalid (HTTP 401) — distinct from no
+    credentials at all, which degrades to the anonymous identity."""
+
+
+def _secret_data(s: dict) -> dict:
+    """Secrets carry payloads under .data (stringData accepted too);
+    flattened dict-kind storage may hold them at top level."""
+    out = {}
+    out.update(s.get("data") or {})
+    out.update(s.get("stringData") or {})
+    return out
+
+
+class TokenAuthenticator:
+    """Union token authenticator over the store + a static table."""
+
+    def __init__(self, cluster, static: Optional[Dict[str, UserInfo]] = None):
+        self.cluster = cluster
+        self._static: Dict[str, UserInfo] = dict(static or {})
+        # RLock: subscribing to the store replays events synchronously,
+        # re-entering _on_event while authenticate still holds the lock
+        self._lock = threading.RLock()
+        # token -> UserInfo index over secret-backed credentials,
+        # invalidated by secrets watch events: authenticate() is on every
+        # request's path, a linear store scan there is O(fleet) per
+        # heartbeat
+        self._index: Optional[Dict[str, UserInfo]] = None
+        self._watching = False
+
+    def add_static(self, token: str, name: str,
+                   groups: Iterable[str] = ()) -> None:
+        with self._lock:
+            self._static[token] = UserInfo(
+                name, tuple(groups) + (AUTHENTICATED,))
+
+    def _on_event(self, event, kind, obj) -> None:
+        if kind == "secrets":
+            with self._lock:
+                self._index = None
+
+    @staticmethod
+    def _secret_identity(s: dict) -> Optional[Tuple[str, UserInfo]]:
+        """(token, identity) a Secret grants, or None."""
+        stype = s.get("type", "")
+        data = _secret_data(s)
+        if stype == BOOTSTRAP_TOKEN_TYPE:
+            # bootstrap.go:116-180: token is <id>.<secret>, both halves
+            # must be present, usage-bootstrap-authentication must be true
+            tid = data.get("token-id", "")
+            tsec = data.get("token-secret", "")
+            if (tid and tsec and s.get("namespace") == TOKEN_NS
+                    and str(data.get(
+                        "usage-bootstrap-authentication", "true"
+                    )).lower() == "true"):
+                groups = tuple(
+                    g.strip() for g in str(
+                        data.get("auth-extra-groups", "")
+                    ).split(",") if g.strip()
+                )
+                return f"{tid}.{tsec}", UserInfo(
+                    f"system:bootstrap:{tid}",
+                    (BOOTSTRAP_GROUP,) + groups + (AUTHENTICATED,),
+                )
+        elif stype == SA_TOKEN_TYPE:
+            tok = data.get("token", "")
+            ns = data.get("namespace") or s.get("namespace", "default")
+            sa = (data.get("serviceAccountName")
+                  or s.get("annotations", {}).get(
+                      "kubernetes.io/service-account.name", ""))
+            if tok and sa:
+                return tok, UserInfo(
+                    f"system:serviceaccount:{ns}:{sa}",
+                    ("system:serviceaccounts",
+                     f"system:serviceaccounts:{ns}",
+                     AUTHENTICATED),
+                )
+        elif stype == AUTH_TOKEN_TYPE:
+            tok = data.get("token", "")
+            if tok and data.get("user"):
+                groups = data.get("groups") or []
+                if isinstance(groups, str):
+                    groups = [g for g in groups.split(",") if g]
+                return tok, UserInfo(
+                    data["user"], tuple(groups) + (AUTHENTICATED,))
+        return None
+
+    def _build_index(self) -> Dict[str, UserInfo]:
+        index: Dict[str, UserInfo] = {}
+        if self.cluster.has_kind("secrets"):
+            for s in self.cluster.list("secrets"):
+                if not isinstance(s, dict):
+                    continue
+                hit = self._secret_identity(s)
+                if hit is not None:
+                    index[hit[0]] = hit[1]
+        return index
+
+    def authenticate(self, token: str) -> UserInfo:
+        """Resolve a bearer token or raise AuthenticationError."""
+        with self._lock:
+            hit = self._static.get(token)
+            if hit is not None:
+                return hit
+            if not self._watching:
+                # lazy: subscribe for invalidation on the first lookup
+                self.cluster.watch(self._on_event)
+                self._watching = True
+                self._index = None
+            if self._index is None:
+                self._index = self._build_index()
+            hit = self._index.get(token)
+        if hit is not None:
+            return hit
+        raise AuthenticationError("unknown bearer token")
+
+
+# ---------------------------------------------------------------- RBAC
+
+
+def _match(items, want: str) -> bool:
+    return "*" in items or want in items
+
+
+def _rule_allows(rule: dict, verb: str, resource: str, name: str) -> bool:
+    """rbac/v1 PolicyRule semantics (rbac.go RuleAllows): verbs and
+    resources with '*' wildcard; subresources must be named explicitly
+    ('pods/binding') or covered by '*'; resourceNames (when present)
+    restrict to listed objects except for create (no name yet)."""
+    verbs = rule.get("verbs") or []
+    resources = rule.get("resources") or []
+    if not _match(verbs, verb):
+        return False
+    base = resource.split("/", 1)[0]
+    if not ("*" in resources or resource in resources
+            or (("/" not in resource) and base in resources)
+            or f"{base}/*" in resources):
+        return False
+    rnames = rule.get("resourceNames") or []
+    if rnames and verb != "create" and name not in rnames:
+        return False
+    return True
+
+
+def _subject_matches(subj: dict, user: UserInfo) -> bool:
+    kind = subj.get("kind", "")
+    name = subj.get("name", "")
+    if kind == "User":
+        return name == user.name
+    if kind == "Group":
+        return user.in_group(name)
+    if kind == "ServiceAccount":
+        ns = subj.get("namespace", "default")
+        return user.name == f"system:serviceaccount:{ns}:{name}"
+    return False
+
+
+class RBACAuthorizer:
+    """Role/ClusterRole(+Binding) evaluation over live store objects."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def _rules_for(self, kind: str, ns: str, role_name: str) -> List[dict]:
+        if not self.cluster.has_kind(kind):
+            return []
+        role = self.cluster.get(kind, ns, role_name)
+        if role is None:
+            return []
+        return list(role.get("rules") or [])
+
+    def authorize(self, user: UserInfo, verb: str, resource: str,
+                  namespace: str = "", name: str = "") -> bool:
+        if user.in_group(SUPERUSER_GROUP):
+            return True  # the hardwired superuser escape hatch
+        # cluster-scoped bindings grant across every namespace
+        if self.cluster.has_kind("clusterrolebindings"):
+            for b in self.cluster.list("clusterrolebindings"):
+                if not any(_subject_matches(s, user)
+                           for s in b.get("subjects") or []):
+                    continue
+                ref = b.get("roleRef") or {}
+                for rule in self._rules_for(
+                        "clusterroles", "", ref.get("name", "")):
+                    if _rule_allows(rule, verb, resource, name):
+                        return True
+        # namespaced bindings grant only inside their own namespace
+        if namespace and self.cluster.has_kind("rolebindings"):
+            for b in self.cluster.list("rolebindings"):
+                if b.get("namespace") != namespace:
+                    continue
+                if not any(_subject_matches(s, user)
+                           for s in b.get("subjects") or []):
+                    continue
+                ref = b.get("roleRef") or {}
+                if ref.get("kind") == "ClusterRole":
+                    rules = self._rules_for(
+                        "clusterroles", "", ref.get("name", ""))
+                else:
+                    rules = self._rules_for(
+                        "roles", namespace, ref.get("name", ""))
+                for rule in rules:
+                    if _rule_allows(rule, verb, resource, name):
+                        return True
+        return False
+
+
+class AlwaysAllowAuthorizer:
+    def authorize(self, user, verb, resource, namespace="", name="") -> bool:
+        return True
+
+
+# -------------------------------------------------- default policy set
+
+
+def bootstrap_policy() -> List[Tuple[str, dict]]:
+    """The default roles+bindings kubeadm installs — the minimal subset
+    of bootstrappolicy/policy.go this framework's components exercise.
+    Returned as (kind, object) pairs for idempotent ensure-create."""
+    return [
+        ("clusterroles", {
+            "namespace": "", "name": "cluster-admin",
+            "rules": [{"verbs": ["*"], "resources": ["*"]}],
+        }),
+        ("clusterrolebindings", {
+            "namespace": "", "name": "cluster-admin",
+            "subjects": [{"kind": "Group", "name": SUPERUSER_GROUP}],
+            "roleRef": {"kind": "ClusterRole", "name": "cluster-admin"},
+        }),
+        # kubeadm:node-bootstrapper: a joining machine may register its
+        # node and heartbeat its lease — nothing else
+        ("clusterroles", {
+            "namespace": "", "name": "system:node-bootstrapper",
+            "rules": [
+                {"verbs": ["create", "get"], "resources": ["nodes"]},
+                {"verbs": ["create", "update", "get"],
+                 "resources": ["leases"]},
+            ],
+        }),
+        ("clusterrolebindings", {
+            "namespace": "", "name": "kubeadm:node-bootstrapper",
+            "subjects": [{"kind": "Group", "name": BOOTSTRAP_GROUP}],
+            "roleRef": {"kind": "ClusterRole",
+                        "name": "system:node-bootstrapper"},
+        }),
+        # system:node: what the hollow kubelet needs (the node authorizer
+        # distilled into RBAC; NodeRestriction admission narrows writes
+        # to the kubelet's OWN objects)
+        ("clusterroles", {
+            "namespace": "", "name": "system:node",
+            "rules": [
+                {"verbs": ["get", "list", "watch", "update", "patch"],
+                 "resources": ["nodes", "nodes/status"]},
+                {"verbs": ["get", "list", "watch"],
+                 "resources": ["pods", "services", "endpoints"]},
+                {"verbs": ["update", "patch"],
+                 "resources": ["pods/status"]},
+                {"verbs": ["create", "update", "get"],
+                 "resources": ["leases"]},
+                {"verbs": ["create"], "resources": ["events"]},
+            ],
+        }),
+        ("clusterrolebindings", {
+            "namespace": "", "name": "system:node",
+            "subjects": [{"kind": "Group", "name": NODES_GROUP}],
+            "roleRef": {"kind": "ClusterRole", "name": "system:node"},
+        }),
+        # discovery for any authenticated identity (read-only basics)
+        ("clusterroles", {
+            "namespace": "", "name": "system:basic-user",
+            "rules": [{"verbs": ["get", "list"],
+                       "resources": ["namespaces"]}],
+        }),
+        ("clusterrolebindings", {
+            "namespace": "", "name": "system:basic-user",
+            "subjects": [{"kind": "Group", "name": AUTHENTICATED}],
+            "roleRef": {"kind": "ClusterRole", "name": "system:basic-user"},
+        }),
+    ]
+
+
+def ensure_bootstrap_policy(cluster) -> None:
+    """Create the default policy objects if absent (kubeadm's
+    clusterrolebinding ensure step — idempotent)."""
+    from kubernetes_tpu.runtime.cluster import ConflictError
+
+    for kind, obj in bootstrap_policy():
+        cluster.register_kind(kind)
+        try:
+            cluster.create(kind, dict(obj))
+        except ConflictError:
+            pass  # already installed

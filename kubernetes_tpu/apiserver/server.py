@@ -54,7 +54,13 @@ LIST_KINDS = {"pods": "PodList", "nodes": "NodeList",
               "resourcequotas": "ResourceQuotaList",
               "priorityclasses": "PriorityClassList",
               "customresourcedefinitions": "CustomResourceDefinitionList",
-              "apiservices": "APIServiceList"}
+              "apiservices": "APIServiceList",
+              "secrets": "SecretList",
+              "serviceaccounts": "ServiceAccountList",
+              "roles": "RoleList",
+              "rolebindings": "RoleBindingList",
+              "clusterroles": "ClusterRoleList",
+              "clusterrolebindings": "ClusterRoleBindingList"}
 
 # kinds stored as plain dicts carrying the original wire body plus flat
 # namespace/name keys for the store (cluster-scoped kinds use "")
@@ -65,6 +71,12 @@ _DICT_KINDS = {
     "resourcequotas": "default",
     "customresourcedefinitions": "",  # cluster-scoped
     "apiservices": "",                # cluster-scoped
+    "secrets": "default",
+    "serviceaccounts": "default",
+    "roles": "default",
+    "rolebindings": "default",
+    "clusterroles": "",               # cluster-scoped
+    "clusterrolebindings": "",        # cluster-scoped
 }
 
 
@@ -269,8 +281,19 @@ class APIServer:
         port: int = 0,
         admission: Optional[List[Callable[[str, str, dict], dict]]] = None,
         audit_path: Optional[str] = None,
+        authenticator=None,
+        authorizer=None,
     ):
         self.cluster = cluster if cluster is not None else LocalCluster()
+        # authn/authz handler-chain slots (config.go:544-550).  Both None =
+        # open server (embedded/test mode, the historical behavior); with an
+        # authenticator, bad tokens 401 and missing tokens degrade to the
+        # anonymous identity; with an authorizer, denied requests 403.
+        self.authenticator = authenticator
+        self.authorizer = authorizer
+        # per-request identity for admission plugins (NodeRestriction needs
+        # the caller); each request runs on its own handler thread
+        self.request_user = threading.local()
         # API audit (staging/src/k8s.io/apiserver/pkg/audit): one JSON line
         # per WRITE request — verb, path, response code, stage
         # ResponseComplete — appended to audit_path when configured
@@ -407,7 +430,7 @@ class APIServer:
         else:
             kind, ns = rest[0], ""
             name = rest[1] if len(rest) > 1 else ""
-            sub = ""
+            sub = rest[2] if len(rest) > 2 else ""
         if "." in kind:
             # custom resources are reachable ONLY through their CRD's
             # /apis/{group}/{version} route (which enforces establishment
@@ -472,6 +495,65 @@ class APIServer:
                 n = int(self.headers.get("Content-Length", 0))
                 return json.loads(self.rfile.read(n) or b"{}")
 
+            # -------------------------------------------------- authn/authz
+
+            def _authenticate(self):
+                """WithAuthentication: -> UserInfo, or None after sending
+                401.  No Authorization header degrades to the anonymous
+                identity; a present-but-invalid bearer token is 401."""
+                from kubernetes_tpu.apiserver.auth import (
+                    ANONYMOUS,
+                    SUPERUSER_GROUP,
+                    AuthenticationError,
+                    UserInfo,
+                )
+
+                # refresh per request: handler threads are reused across
+                # keep-alive requests, so a stale identity must never
+                # survive into the next request's admission run
+                outer.request_user.user = None
+                if outer.authenticator is None:
+                    # open server: every caller is effectively the admin
+                    user = UserInfo("system:admin", (SUPERUSER_GROUP,))
+                    outer.request_user.user = user
+                    return user
+                hdr = self.headers.get("Authorization", "")
+                if not hdr:
+                    outer.request_user.user = ANONYMOUS
+                    return ANONYMOUS
+                if not hdr.startswith("Bearer "):
+                    self._status(401, "Unauthorized",
+                                 "unsupported authorization scheme")
+                    return None
+                try:
+                    user = outer.authenticator.authenticate(hdr[7:].strip())
+                    outer.request_user.user = user
+                    return user
+                except AuthenticationError as e:
+                    self._status(401, "Unauthorized", str(e))
+                    return None
+
+            def _authorize(self, verb: str, resource: str,
+                           ns: str = "", name: str = ""):
+                """WithAuthorization: -> UserInfo, or None after sending
+                401/403.  Also parks the identity in request_user so the
+                admission chain can see the caller."""
+                user = self._authenticate()
+                if user is None:
+                    return None
+                if outer.authorizer is not None and not (
+                    outer.authorizer.authorize(user, verb, resource, ns, name)
+                ):
+                    where = f' in namespace "{ns}"' if ns else ""
+                    self._status(
+                        403, "Forbidden",
+                        f'User "{user.name}" cannot {verb} resource '
+                        f'"{resource}"{where}',
+                    )
+                    return None
+                outer.request_user.user = user
+                return user
+
             # ------------------------------------------------------- GET
 
             def do_GET(self):
@@ -494,13 +576,26 @@ class APIServer:
                     return
                 kind, ns, name, _sub = r
                 if kind == "watch":
+                    # the firehose streams every kind: requires a grant on
+                    # resource "*" (the remote scheduler runs as admin)
+                    if self._authorize("watch", "*") is None:
+                        return
                     self._serve_watch()
                     return
                 if kind == "@metrics":
+                    if self._authorize("get", "metrics.k8s.io") is None:
+                        return
                     self._serve_metrics_api(ns, name)
                     return
                 if kind == "@proxy":
+                    # the backend does its own authz; still authenticate +
+                    # gate the aggregation hop itself
+                    if self._authorize("get", "proxy") is None:
+                        return
                     self._proxy(ns)  # ns slot carries the backend URL
+                    return
+                if self._authorize("get" if name else "list",
+                                   kind, ns, name) is None:
                     return
                 if kind not in LIST_KINDS and not outer.cluster.has_kind(kind):
                     self._status(404, "NotFound", f"unknown resource {kind}")
@@ -706,8 +801,16 @@ class APIServer:
                     return
                 kind, ns, name, sub = r
                 if kind == "@proxy":
+                    if self._authorize("create", "proxy") is None:
+                        return
                     # before _body(): the proxy relays the raw stream itself
                     self._proxy(ns)
+                    return
+                # subresources authorize as "<resource>/<sub>" (RBAC rules
+                # must name them explicitly, e.g. "pods/binding")
+                if self._authorize(
+                    "create", f"{kind}/{sub}" if sub else kind, ns, name
+                ) is None:
                     return
                 try:
                     body = self._body()
@@ -768,12 +871,18 @@ class APIServer:
             def do_PUT(self):
                 r = outer._route(self.path)
                 if r is not None and r[0] == "@proxy":
+                    if self._authorize("update", "proxy") is None:
+                        return
                     self._proxy(r[1])
                     return
                 if r is None or not r[2]:
                     self._status(404, "NotFound", self.path)
                     return
-                kind, ns, name, _sub = r
+                kind, ns, name, sub = r
+                if self._authorize(
+                    "update", f"{kind}/{sub}" if sub else kind, ns, name
+                ) is None:
+                    return
                 try:
                     body = self._body()
                 except ValueError:
@@ -814,12 +923,18 @@ class APIServer:
             def do_DELETE(self):
                 r = outer._route(self.path)
                 if r is not None and r[0] == "@proxy":
+                    if self._authorize("delete", "proxy") is None:
+                        return
                     self._proxy(r[1])
                     return
                 if r is None or not r[2]:
                     self._status(404, "NotFound", self.path)
                     return
-                kind, ns, name, _sub = r
+                kind, ns, name, sub = r
+                if self._authorize(
+                    "delete", f"{kind}/{sub}" if sub else kind, ns, name
+                ) is None:
+                    return
                 if kind not in LIST_KINDS and not outer.cluster.has_kind(kind):
                     self._status(404, "NotFound", f"unknown resource {kind}")
                     return

@@ -39,15 +39,24 @@ def _decode(kind: str, d: dict):
     return scheme.decode(kind, d)
 
 
+def _auth_headers(token: str, json_body: bool = False) -> dict:
+    headers = {"Content-Type": "application/json"} if json_body else {}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    return headers
+
+
 class Reflector:
     """Mirror a remote apiserver's store into a LocalCluster."""
 
     def __init__(self, server: str, mirror: Optional[LocalCluster] = None,
-                 backoff: float = 0.5, max_backoff: float = 10.0):
+                 backoff: float = 0.5, max_backoff: float = 10.0,
+                 token: str = ""):
         self.server = server.rstrip("/")
         self.mirror = mirror if mirror is not None else LocalCluster()
         self.backoff = backoff
         self.max_backoff = max_backoff
+        self.token = token  # bearer credential for RBAC'd planes
         self.synced = threading.Event()   # set after the first bookmark
         self.resyncs = 0
         self._stop = threading.Event()
@@ -86,7 +95,8 @@ class Reflector:
             delay = min(delay * 2, self.max_backoff)
 
     def _list_and_watch(self) -> None:
-        req = urllib.request.Request(self.server + "/api/v1/watch")
+        req = urllib.request.Request(
+            self.server + "/api/v1/watch", headers=_auth_headers(self.token))
         with urllib.request.urlopen(req, timeout=30) as resp:
             replay: list = []
             in_replay = True
@@ -151,7 +161,7 @@ class Reflector:
             self.mirror.update(kind, obj)
 
 
-def remote_victim_deleter(server: str):
+def remote_victim_deleter(server: str, token: str = ""):
     """Preemption victim deletion against the remote apiserver (the
     PodPreemptor.DeletePod path, scheduler.go:319-326).  The DELETE event
     then reflects back into the mirror."""
@@ -160,7 +170,7 @@ def remote_victim_deleter(server: str):
     def delete(pod) -> None:
         req = urllib.request.Request(
             f"{server}/api/v1/namespaces/{pod.namespace}/pods/{pod.name}",
-            method="DELETE",
+            method="DELETE", headers=_auth_headers(token),
         )
         try:
             urllib.request.urlopen(req, timeout=10)
@@ -170,7 +180,7 @@ def remote_victim_deleter(server: str):
     return delete
 
 
-def remote_unbinder(server: str):
+def remote_unbinder(server: str, token: str = ""):
     """Gang-rollback unbind against the remote apiserver: read-modify-write
     the pod with spec.nodeName cleared (the store-level unbind analog)."""
     server = server.rstrip("/")
@@ -179,7 +189,9 @@ def remote_unbinder(server: str):
         base = f"{server}/api/v1/namespaces/{pod.namespace}/pods/{pod.name}"
         for _ in range(_retries):
             try:
-                with urllib.request.urlopen(base, timeout=10) as resp:
+                get_req = urllib.request.Request(
+                    base, headers=_auth_headers(token))
+                with urllib.request.urlopen(get_req, timeout=10) as resp:
                     d = json.loads(resp.read())
                 d.setdefault("spec", {})["nodeName"] = ""
                 # carry the fetched resourceVersion so the server's CAS
@@ -187,7 +199,7 @@ def remote_unbinder(server: str):
                 # landed between our GET and PUT (no silent clobber)
                 req = urllib.request.Request(
                     base, data=json.dumps(d).encode(), method="PUT",
-                    headers={"Content-Type": "application/json"},
+                    headers=_auth_headers(token, json_body=True),
                 )
                 with urllib.request.urlopen(req, timeout=10) as resp:
                     return resp.status == 200
@@ -206,8 +218,9 @@ class RemoteBinder:
     """Scheduler binder that POSTs the Binding subresource to the remote
     apiserver (scheduler.go:411-435 b.Create path)."""
 
-    def __init__(self, server: str):
+    def __init__(self, server: str, token: str = ""):
         self.server = server.rstrip("/")
+        self.token = token
 
     def __call__(self, pod, node_name: str) -> bool:
         body = json.dumps({"target": {"name": node_name}}).encode()
@@ -215,7 +228,7 @@ class RemoteBinder:
             f"{self.server}/api/v1/namespaces/{pod.namespace}/pods/"
             f"{pod.name}/binding",
             data=body, method="POST",
-            headers={"Content-Type": "application/json"},
+            headers=_auth_headers(self.token, json_body=True),
         )
         try:
             with urllib.request.urlopen(req, timeout=10) as resp:

@@ -49,6 +49,15 @@ def build_parser() -> argparse.ArgumentParser:
         "LimitRanger, PodNodeSelector, Priority, DefaultTolerationSeconds, "
         "TaintNodesByCondition, ResourceQuota)",
     )
+    p.add_argument(
+        "--authorization-mode", choices=("AlwaysAllow", "RBAC"),
+        default="AlwaysAllow",
+        help="RBAC enables bearer authn + Role/ClusterRole authz over the "
+        "default bootstrap policy; an admin token is minted and printed to "
+        "stderr (or written to --token-file)",
+    )
+    p.add_argument("--token-file", default="",
+                   help="with RBAC: write the minted admin token here")
     return p
 
 
@@ -70,9 +79,35 @@ def main(argv=None) -> int:
         from kubernetes_tpu.apiserver.admission import default_admission_chain
 
         admission = default_admission_chain(cluster)
+    authn = authz = None
+    if args.authorization_mode == "RBAC":
+        import secrets as _secrets
+
+        from kubernetes_tpu.apiserver.auth import (
+            RBACAuthorizer,
+            TokenAuthenticator,
+            ensure_bootstrap_policy,
+        )
+
+        ensure_bootstrap_policy(cluster)
+        authn = TokenAuthenticator(cluster)
+        authz = RBACAuthorizer(cluster)
+        admin_token = _secrets.token_hex(16)
+        authn.add_static(admin_token, "kubernetes-admin",
+                         ("system:masters",))
+        if args.token_file:
+            import os as _os
+
+            fd = _os.open(args.token_file,
+                          _os.O_WRONLY | _os.O_CREAT | _os.O_TRUNC, 0o600)
+            with _os.fdopen(fd, "w") as f:
+                f.write(admin_token)
+        else:
+            print(f"admin token: {admin_token}", file=sys.stderr)
     srv = APIServer(
         cluster=cluster, host=args.host, port=args.port, admission=admission,
         audit_path=args.audit_log or None,
+        authenticator=authn, authorizer=authz,
     ).start()
     print(f"apiserver on {srv.url}", file=sys.stderr)
 

@@ -25,17 +25,22 @@ def add_common_flags(p: argparse.ArgumentParser) -> None:
                    help="log level (klog.V analog)")
 
 
-def api_request(server: str, method: str, path: str, payload=None) -> dict:
+def api_request(server: str, method: str, path: str, payload=None,
+                token: Optional[str] = None) -> dict:
     """One HTTP helper for every CLI: JSON in/out, HTTP errors surfaced as
-    Status dicts (body preserved), unreachable server as a 503 Status."""
+    Status dicts (body preserved), unreachable server as a 503 Status.
+    ``token`` adds an ``Authorization: Bearer`` header (RBAC'd planes)."""
     import json as _json
     import urllib.error
     import urllib.request
 
     data = _json.dumps(payload).encode() if payload is not None else None
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
     req = urllib.request.Request(
         server.rstrip("/") + path, data=data, method=method,
-        headers={"Content-Type": "application/json"},
+        headers=headers,
     )
     try:
         with urllib.request.urlopen(req, timeout=30) as resp:

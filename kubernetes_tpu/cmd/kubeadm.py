@@ -66,6 +66,8 @@ def build_parser() -> argparse.ArgumentParser:
     tok = sub.add_parser("token")
     tok.add_argument("action", choices=("list", "create"))
     tok.add_argument("--server", required=True)
+    tok.add_argument("--token", default="",
+                     help="admin credential (RBAC planes; admin.conf token)")
     return p
 
 
@@ -76,14 +78,18 @@ def _mint_token() -> str:
     return f"{pick(6)}.{pick(16)}"
 
 
-def _store_token(server: str, token: str) -> None:
+def _store_token(server: str, token: str, admin_token: str = "") -> None:
+    """Persist a bootstrap token as a kube-system Secret (the kubeadm
+    bootstraptoken phase; authenticated as system:bootstrap:<id> by the
+    TokenAuthenticator)."""
     tid, _, tsecret = token.partition(".")
-    out = _req(server, "POST", f"/api/v1/namespaces/{TOKEN_NS}/services", {
+    out = _req(server, "POST", f"/api/v1/namespaces/{TOKEN_NS}/secrets", {
         "metadata": {"name": f"bootstrap-token-{tid}",
                      "namespace": TOKEN_NS},
-        "spec": {"selector": {"token-secret": tsecret,
-                              "usage": "bootstrap"}},
-    })
+        "type": "bootstrap.kubernetes.io/token",
+        "data": {"token-id": tid, "token-secret": tsecret,
+                 "usage-bootstrap-authentication": "true"},
+    }, token=admin_token or None)
     if out.get("kind") == "Status" and out.get("code", 201) >= 400:
         raise RuntimeError(
             f"bootstrap token not stored: {out.get('message', out)}"
@@ -91,15 +97,29 @@ def _store_token(server: str, token: str) -> None:
 
 
 def _check_token(server: str, token: str) -> bool:
-    tid, _, tsecret = token.partition(".")
-    out = _req(server, "GET",
-               f"/api/v1/namespaces/{TOKEN_NS}/services/bootstrap-token-{tid}")
+    """Validate credentials.  Against an RBAC plane, an authenticated read
+    every identity is allowed (system:basic-user covers namespaces)
+    answers it: 401 = bad token.  Against an OPEN plane (AlwaysAllow, no
+    authenticator) every request succeeds regardless of token, so fall
+    back to materially comparing the stored bootstrap secret."""
+    out = _req(server, "GET", "/api/v1/namespaces", token=token)
     if out.get("kind") == "Status" and out.get("code") == 503:
         # connectivity, not credentials: surface the real problem
         raise RuntimeError(out.get("message", "control plane unreachable"))
-    sel = ((out.get("spec") or {}).get("selector")
-           or out.get("selector") or {})
-    return sel.get("token-secret") == tsecret
+    if out.get("kind") == "Status" and out.get("code") == 401:
+        return False
+    tid, _, tsecret = token.partition(".")
+    probe = _req(server, "GET",
+                 f"/api/v1/namespaces/{TOKEN_NS}/secrets/"
+                 f"bootstrap-token-{tid}")
+    if probe.get("kind") == "Status" and probe.get("code") in (401, 403):
+        # secrets are guarded -> an authenticator exists, and it already
+        # accepted this token above
+        return True
+    if probe.get("kind") == "Status":
+        return False  # open plane, no such bootstrap token
+    data = probe.get("data") or {}
+    return bool(tsecret) and data.get("token-secret") == tsecret
 
 
 def cmd_init(args) -> int:
@@ -111,17 +131,30 @@ def cmd_init(args) -> int:
     from kubernetes_tpu.runtime.cluster import LocalCluster
     from kubernetes_tpu.runtime.controllers import ControllerManager
 
+    from kubernetes_tpu.apiserver.auth import (
+        RBACAuthorizer,
+        TokenAuthenticator,
+        ensure_bootstrap_policy,
+    )
+
     if args.data_dir:
         from kubernetes_tpu.runtime.persist import PersistentCluster
 
         cluster = PersistentCluster(args.data_dir)
     else:
         cluster = LocalCluster()
+    # the real handler chain: bearer authn + RBAC authz over the default
+    # bootstrap policy; the admin credential lands in kubeconfig AND as an
+    # auth-token Secret so a data-dir restart still authenticates it
+    ensure_bootstrap_policy(cluster)
+    authn = TokenAuthenticator(cluster)
     srv = APIServer(
         cluster=cluster, host=args.host, port=args.port,
         admission=default_admission_chain(cluster),
+        authenticator=authn,
+        authorizer=RBACAuthorizer(cluster),
     ).start()
-    klog.infof("[init] control plane up at %s", srv.url)
+    klog.infof("[init] control plane up at %s (RBAC on)", srv.url)
 
     sched = build_wired_scheduler(cluster, load_component_config(args.config))
     threading.Thread(target=sched.run, daemon=True).start()
@@ -129,13 +162,34 @@ def cmd_init(args) -> int:
     cm.start()
     klog.V(1).infof("[init] scheduler + controller-manager started")
 
+    # admin credential: system:masters via a durable auth-token Secret
+    # (the admin.conf client-cert analog)
+    admin_token = secrets.token_hex(16)
+    existing = (
+        cluster.get("secrets", TOKEN_NS, "admin-token")
+        if cluster.has_kind("secrets") else None
+    )
+    if existing is not None:
+        admin_token = (existing.get("data") or {}).get("token", admin_token)
+    else:
+        cluster.register_kind("secrets")
+        cluster.create("secrets", {
+            "namespace": TOKEN_NS, "name": "admin-token",
+            "type": "kubernetes-tpu/auth-token",
+            "data": {"token": admin_token, "user": "kubernetes-admin",
+                     "groups": ["system:masters"]},
+        })
+
     token = _mint_token()
-    _store_token(srv.url, token)
+    _store_token(srv.url, token, admin_token=admin_token)
     kubeconfig = args.kubeconfig or os.path.join(
         args.data_dir or ".", "admin.conf"
     )
-    with open(kubeconfig, "w") as f:
-        json.dump({"server": srv.url, "token": token}, f)
+    # 0600: the file now carries the system:masters credential
+    fd = os.open(kubeconfig, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        json.dump({"server": srv.url, "token": admin_token,
+                   "bootstrap-token": token}, f)
     klog.infof("[init] kubeconfig written to %s", kubeconfig)
 
     if args.hollow_nodes:
@@ -182,7 +236,7 @@ def cmd_join(args) -> int:
                          "pods": "110"},
             "conditions": [{"type": "Ready", "status": "True"}],
         },
-    })
+    }, token=args.token)
     if out.get("kind") == "Status" and out.get("code", 201) >= 400:
         print(f"error: {out.get('message', out)}", file=sys.stderr)
         return 1
@@ -193,13 +247,13 @@ def cmd_join(args) -> int:
             _req(args.server, "PUT",
                  f"/api/v1/namespaces/kube-node-lease/leases/{node_name}",
                  {"namespace": "kube-node-lease", "name": node_name,
-                  "renew_time": time.monotonic()})
+                  "renew_time": time.monotonic()}, token=args.token)
             time.sleep(5.0)
 
     # first heartbeat synchronously (lease create-or-update)
     _req(args.server, "POST", "/api/v1/namespaces/kube-node-lease/leases",
          {"namespace": "kube-node-lease", "name": node_name,
-          "renew_time": time.monotonic()})
+          "renew_time": time.monotonic()}, token=args.token)
     if args.one_shot:
         print(f"node {node_name} joined")
         return 0
@@ -211,7 +265,8 @@ def cmd_join(args) -> int:
 def cmd_token(args) -> int:
     if args.action == "list":
         out = _req(args.server, "GET",
-                   f"/api/v1/namespaces/{TOKEN_NS}/services")
+                   f"/api/v1/namespaces/{TOKEN_NS}/secrets",
+                   token=args.token or None)
         if out.get("kind") == "Status" and out.get("code", 200) >= 400:
             print(f"error: {out.get('message', out)}", file=sys.stderr)
             return 1
@@ -223,7 +278,7 @@ def cmd_token(args) -> int:
     if args.action == "create":
         token = _mint_token()
         try:
-            _store_token(args.server, token)
+            _store_token(args.server, token, admin_token=args.token)
         except RuntimeError as e:
             print(f"error: {e}", file=sys.stderr)
             return 1

@@ -16,7 +16,15 @@ import argparse
 import json
 import sys
 
-from kubernetes_tpu.cmd.base import api_request as _req
+from kubernetes_tpu.cmd.base import api_request
+
+# bearer credential for every request this invocation makes (--token /
+# --kubeconfig); empty = anonymous (open servers)
+_TOKEN = ""
+
+
+def _req(server: str, method: str, path: str, payload=None) -> dict:
+    return api_request(server, method, path, payload, token=_TOKEN or None)
 
 # resource paths derive from the scheme (api/scheme.py rest_path — ONE
 # source of truth for served routes); aliases map shorthand to storage kinds
@@ -155,6 +163,10 @@ def main(argv=None) -> int:
     common.add_argument("-n", "--namespace", default=argparse.SUPPRESS)
     common.add_argument("-o", "--output", choices=("", "json", "wide"),
                         default=argparse.SUPPRESS)
+    common.add_argument("--token", default=argparse.SUPPRESS,
+                        help="bearer token (RBAC planes)")
+    common.add_argument("--kubeconfig", default=argparse.SUPPRESS,
+                        help="kubeadm admin.conf JSON ({server, token})")
     p = argparse.ArgumentParser(prog="kubectl (kubernetes-tpu)",
                                 parents=[common])
     sub = p.add_subparsers(dest="verb", required=True)
@@ -187,7 +199,17 @@ def main(argv=None) -> int:
     ap_.add_argument("-f", "--filename", required=True)
 
     args = p.parse_args(argv)
-    args.server = getattr(args, "server", "http://127.0.0.1:8001")
+    global _TOKEN
+    _TOKEN = ""  # never leak a credential across in-process invocations
+    kubeconfig = getattr(args, "kubeconfig", "")
+    if kubeconfig:
+        with open(kubeconfig) as f:
+            conf = json.load(f)
+        if conf.get("server"):
+            args.server = getattr(args, "server", conf["server"])
+        _TOKEN = conf.get("token", "")
+    args.server = getattr(args, "server", "") or "http://127.0.0.1:8001"
+    _TOKEN = getattr(args, "token", _TOKEN)
     args.output = getattr(args, "output", "")
     ns = getattr(args, "namespace", "default")
 

@@ -43,6 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="remote apiserver URL: reflect its state into a "
                    "local mirror and POST bindings back (the real "
                    "multi-process scheduler deployment)")
+    p.add_argument("--token", default="",
+                   help="bearer token for --server (RBAC planes)")
+    p.add_argument("--kubeconfig", default="",
+                   help="kubeadm admin.conf JSON; supplies --server/--token")
     p.add_argument("--leader-elect", action="store_true",
                    help="run behind a LocalCluster lease")
     p.add_argument("--leader-elect-identity", default="scheduler-0")
@@ -77,6 +81,12 @@ def main(argv=None) -> int:
     if args.batch_size:
         cc.batch_size = args.batch_size
 
+    if args.kubeconfig:
+        with open(args.kubeconfig) as f:
+            conf = json.load(f)
+        args.server = args.server or conf.get("server")
+        args.token = args.token or conf.get("token", "")
+
     reflector = None
     if args.server:
         # remote mode: informer mirror in, every WRITE back to the remote
@@ -101,16 +111,17 @@ def main(argv=None) -> int:
                   "(the next resync would destroy them); create the "
                   "workload on the remote server instead", file=sys.stderr)
             return 2
-        reflector = Reflector(args.server).start()
+        reflector = Reflector(args.server, token=args.token).start()
         if not reflector.wait_for_sync(timeout=30.0):
             print(f"error: cache sync against {args.server} timed out",
                   file=sys.stderr)
             return 1
         cluster = reflector.mirror
         sched = build_wired_scheduler(cluster, cc)
-        sched.binder = RemoteBinder(args.server)
-        sched.victim_deleter = remote_victim_deleter(args.server)
-        sched.unbinder = remote_unbinder(args.server)
+        sched.binder = RemoteBinder(args.server, token=args.token)
+        sched.victim_deleter = remote_victim_deleter(
+            args.server, token=args.token)
+        sched.unbinder = remote_unbinder(args.server, token=args.token)
     else:
         cluster = LocalCluster()
         sched = build_wired_scheduler(cluster, cc)

@@ -50,7 +50,9 @@ class LocalCluster:
              "namespaces", "limitranges", "resourcequotas",
              "priorityclasses", "customresourcedefinitions", "apiservices",
              "daemonsets", "statefulsets", "cronjobs",
-             "horizontalpodautoscalers")
+             "horizontalpodautoscalers",
+             "secrets", "serviceaccounts", "roles", "rolebindings",
+             "clusterroles", "clusterrolebindings")
 
     def __init__(self) -> None:
         self._lock = threading.RLock()

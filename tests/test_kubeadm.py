@@ -19,17 +19,29 @@ def test_init_writes_kubeconfig_and_join_flow(tmp_path):
     ])
     assert rc == 0
     cfg = json.load(open(kc))
-    assert cfg["server"].startswith("http://") and "." in cfg["token"]
+    assert cfg["server"].startswith("http://")
+    assert cfg["token"]                      # admin credential
+    assert "." in cfg["bootstrap-token"]     # kubeadm token format
 
 
 def test_join_token_validation_and_node_registration(tmp_path):
+    from kubernetes_tpu.apiserver.auth import (
+        RBACAuthorizer,
+        TokenAuthenticator,
+        ensure_bootstrap_policy,
+    )
+
     cluster = LocalCluster()
+    ensure_bootstrap_policy(cluster)
+    authn = TokenAuthenticator(cluster)
+    authn.add_static("admintok", "kubernetes-admin", ("system:masters",))
     srv = APIServer(
-        cluster=cluster, admission=default_admission_chain(cluster)
+        cluster=cluster, admission=default_admission_chain(cluster),
+        authenticator=authn, authorizer=RBACAuthorizer(cluster),
     ).start()
     try:
         token = kubeadm._mint_token()
-        kubeadm._store_token(srv.url, token)
+        kubeadm._store_token(srv.url, token, admin_token="admintok")
         # bad token rejected
         rc = kubeadm.main([
             "join", "--server", srv.url, "--token", "aaaaaa.0000000000000000",
@@ -55,7 +67,8 @@ def test_join_token_validation_and_node_registration(tmp_path):
         old = _sys.stdout
         _sys.stdout = buf
         try:
-            kubeadm.main(["token", "list", "--server", srv.url])
+            kubeadm.main(["token", "list", "--server", srv.url,
+                          "--token", "admintok"])
         finally:
             _sys.stdout = old
         assert token.split(".")[0] in buf.getvalue()
