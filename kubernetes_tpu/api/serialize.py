@@ -234,11 +234,16 @@ def object_to_dict(kind: str, obj) -> dict:
             "status": {"disruptionsAllowed": obj.disruptions_allowed},
         }
     if kind == "jobs":
+        job_meta = {"name": obj.name, "namespace": obj.namespace,
+                    "uid": obj.uid}
+        if getattr(obj, "owner_uid", ""):
+            job_meta["ownerReferences"] = [{"kind": "CronJob",
+                                            "uid": obj.owner_uid,
+                                            "controller": True}]
         return {
             "kind": "Job",
             "apiVersion": "batch/v1",
-            "metadata": {"name": obj.name, "namespace": obj.namespace,
-                         "uid": obj.uid},
+            "metadata": job_meta,
             "spec": _drop_empty({"completions": obj.completions,
                      "parallelism": obj.parallelism,
                      "backoffLimit": obj.backoff_limit,
@@ -318,11 +323,18 @@ def object_to_dict(kind: str, obj) -> dict:
                        "desiredReplicas": obj.desired_replicas},
         }
     if kind == "replicasets":
+        meta = {"name": obj.name, "namespace": obj.namespace,
+                "uid": obj.uid}
+        if obj.owner_uid:
+            # the Deployment->RS controller link must survive the wire or a
+            # remote controller-manager orphans every managed ReplicaSet
+            meta["ownerReferences"] = [{"kind": "Deployment",
+                                        "uid": obj.owner_uid,
+                                        "controller": True}]
         return {
             "kind": "ReplicaSet",
             "apiVersion": "apps/v1",
-            "metadata": {"name": obj.name, "namespace": obj.namespace,
-                         "uid": obj.uid},
+            "metadata": meta,
             "spec": {
                 "replicas": obj.replicas,
                 "selector": {"matchLabels": dict(obj.selector)},

@@ -83,26 +83,34 @@ class TokenAuthenticator:
     def __init__(self, cluster, static: Optional[Dict[str, UserInfo]] = None):
         self.cluster = cluster
         self._static: Dict[str, UserInfo] = dict(static or {})
-        # RLock: subscribing to the store replays events synchronously,
-        # re-entering _on_event while authenticate still holds the lock
-        self._lock = threading.RLock()
-        # token -> UserInfo index over secret-backed credentials,
-        # invalidated by secrets watch events: authenticate() is on every
-        # request's path, a linear store scan there is O(fleet) per
-        # heartbeat
+        # LOCK ORDER CONSTRAINT: _on_event runs INSIDE the cluster's write
+        # lock (store fan-out is synchronous), so nothing here may hold a
+        # lock that authenticate() also holds while it calls INTO the
+        # cluster — that is an ABBA deadlock wedging the whole apiserver.
+        # The invalidation protocol is therefore lock-free on the event
+        # side: _on_event only bumps a generation counter (its own tiny
+        # lock, never held around cluster calls), and authenticate builds
+        # the index outside any shared lock, publishing it only if the
+        # generation is unchanged (a racing invalidation wins).
+        self._gen = 0
+        self._gen_lock = threading.Lock()
+        # token -> UserInfo index over secret-backed credentials:
+        # authenticate() is on every request's path, a linear store scan
+        # there is O(fleet) per heartbeat
         self._index: Optional[Dict[str, UserInfo]] = None
+        self._index_gen = -1
         self._watching = False
+        self._watch_lock = threading.Lock()
 
     def add_static(self, token: str, name: str,
                    groups: Iterable[str] = ()) -> None:
-        with self._lock:
-            self._static[token] = UserInfo(
-                name, tuple(groups) + (AUTHENTICATED,))
+        self._static = {**self._static,
+                        token: UserInfo(name, tuple(groups) + (AUTHENTICATED,))}
 
     def _on_event(self, event, kind, obj) -> None:
         if kind == "secrets":
-            with self._lock:
-                self._index = None
+            with self._gen_lock:
+                self._gen += 1
 
     @staticmethod
     def _secret_identity(s: dict) -> Optional[Tuple[str, UserInfo]]:
@@ -163,18 +171,29 @@ class TokenAuthenticator:
 
     def authenticate(self, token: str) -> UserInfo:
         """Resolve a bearer token or raise AuthenticationError."""
-        with self._lock:
-            hit = self._static.get(token)
-            if hit is not None:
-                return hit
+        hit = self._static.get(token)  # copy-on-write dict: lock-free read
+        if hit is not None:
+            return hit
+        with self._watch_lock:
             if not self._watching:
-                # lazy: subscribe for invalidation on the first lookup
-                self.cluster.watch(self._on_event)
+                # lazy: subscribe for invalidation on the first lookup.
+                # watch() replays synchronously into _on_event, which only
+                # bumps the generation — no lock cycle with the store.
                 self._watching = True
-                self._index = None
-            if self._index is None:
-                self._index = self._build_index()
-            hit = self._index.get(token)
+                self.cluster.watch(self._on_event)
+        index = self._index
+        with self._gen_lock:
+            gen = self._gen
+            fresh = self._index_gen == gen and index is not None
+        if not fresh:
+            index = self._build_index()  # cluster reads: NO auth lock held
+            with self._gen_lock:
+                if self._gen == gen:
+                    # no invalidation raced the build: publish
+                    self._index = index
+                    self._index_gen = gen
+                # else: leave stale markers; next request rebuilds
+        hit = index.get(token)
         if hit is not None:
             return hit
         raise AuthenticationError("unknown bearer token")

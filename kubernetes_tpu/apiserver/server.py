@@ -104,6 +104,9 @@ def _decode(kind: str, d: dict):
         )
         if meta.get("uid"):
             rs.uid = meta["uid"]
+        for ref in meta.get("ownerReferences") or []:
+            if ref.get("controller"):
+                rs.owner_uid = ref.get("uid", "")
         return rs
     if kind == "deployments":
         from kubernetes_tpu.runtime.controllers import Deployment
@@ -249,6 +252,9 @@ def _decode(kind: str, d: dict):
         )
         if meta.get("uid"):
             job.uid = meta["uid"]
+        for ref in meta.get("ownerReferences") or []:
+            if ref.get("controller"):
+                job.owner_uid = ref.get("uid", "")
         return job
     if kind == "leases":
         meta = d.get("metadata") or {}
@@ -755,8 +761,15 @@ class APIServer:
                 overflow = threading.Event()
 
                 def fan(event, kind, obj):
+                    # fan runs synchronously inside the store's write lock;
+                    # event_rv is the revision THIS event committed at —
+                    # clients mirror the remote's resourceVersions for CAS
+                    # round-trips (see LocalCluster._notify)
+                    rv = getattr(outer.cluster, "event_rv", None)
+                    if event == "DELETED":
+                        rv = None  # no CAS target once the object is gone
                     try:
-                        q.put_nowait((event, kind, obj))
+                        q.put_nowait((event, kind, obj, rv))
                     except _queue.Full:
                         # a watcher this far behind must re-list; closing the
                         # stream is the 410 Gone analog — never drop silently
@@ -769,20 +782,23 @@ class APIServer:
                 try:
                     while not overflow.is_set():
                         try:
-                            event, kind, obj = q.get(timeout=1.0)
+                            event, kind, obj, rv = q.get(timeout=1.0)
                         except _queue.Empty:
                             # heartbeat chunk keeps the connection honest
                             self.wfile.write(b"1\r\n\n\r\n")
                             self.wfile.flush()
                             continue
-                        line = json.dumps({
+                        payload = {
                             "type": event,
                             "kind": kind,
                             "object": (
                                 object_to_dict(kind, obj)
                                 if obj is not None else None
                             ),
-                        }).encode() + b"\n"
+                        }
+                        if rv is not None:
+                            payload["resourceVersion"] = str(rv)
+                        line = json.dumps(payload).encode() + b"\n"
                         self.wfile.write(
                             f"{len(line):x}\r\n".encode() + line + b"\r\n"
                         )

@@ -1,12 +1,23 @@
 """Client machinery: the client-go analog (SURVEY.md layer 5)."""
 
+from kubernetes_tpu.client.informer import (
+    DeltaFIFO,
+    Indexer,
+    SharedIndexInformer,
+    SharedInformerFactory,
+    wire_scheduler_informers,
+)
 from kubernetes_tpu.client.reflector import (
     Reflector,
     RemoteBinder,
     remote_unbinder,
     remote_victim_deleter,
 )
+from kubernetes_tpu.client.remote import RemoteCluster
 
 __all__ = [
+    "DeltaFIFO", "Indexer", "SharedIndexInformer", "SharedInformerFactory",
+    "wire_scheduler_informers",
     "Reflector", "RemoteBinder", "remote_unbinder", "remote_victim_deleter",
+    "RemoteCluster",
 ]

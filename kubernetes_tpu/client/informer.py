@@ -1,0 +1,423 @@
+"""Informer machinery: DeltaFIFO -> shared indexed store -> handlers.
+
+Reference: client-go tools/cache — delta_fifo.go:655 (per-key compressed
+delta queues between the reflector and the processor),
+shared_informer.go:650 (ONE upstream watch fanned out to N event
+handlers over a shared indexed cache, with periodic resync),
+thread_safe_store.go (the indexer), controller.go (processLoop: pop a
+key's deltas, apply to the store, then notify handlers).
+
+The framework's LocalCluster already *is* a listable/watchable store, so
+the informer's upstream source is any LocalCluster-like object — the
+in-process store, a PersistentCluster, or a Reflector mirror of a remote
+apiserver.  What the informer adds over a raw ``cluster.watch`` is the
+reference's client architecture: per-kind subscription, handler fan-out
+decoupled from the write path (a slow handler no longer blocks the
+store's write lock), delta compression, named indices for O(1) lookups
+(pods-by-node, pods-by-namespace), and resync.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.runtime.cluster import ADDED, DELETED, MODIFIED, LocalCluster
+
+# delta types (delta_fifo.go:77-97); Sync marks resync/replay deltas so
+# handlers can tell a periodic re-list from a real change
+D_ADDED = "Added"
+D_UPDATED = "Updated"
+D_DELETED = "Deleted"
+D_SYNC = "Sync"
+
+_EVENT_TO_DELTA = {ADDED: D_ADDED, MODIFIED: D_UPDATED, DELETED: D_DELETED}
+
+
+class DeltaFIFO:
+    """Per-key delta queues: producers append (type, obj) deltas under a
+    key; the consumer pops ONE key's accumulated deltas at a time.  Two
+    consecutive Deleted deltas compress into one (dedupDeltas,
+    delta_fifo.go:571-602)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._items: Dict[object, List[Tuple[str, object]]] = {}
+        self._queue: deque = deque()
+        self._closed = False
+
+    def add(self, dtype: str, key, obj) -> None:
+        with self._cond:
+            deltas = self._items.get(key)
+            if deltas is None:
+                deltas = self._items[key] = []
+                self._queue.append(key)
+            if deltas and dtype == D_DELETED and deltas[-1][0] == D_DELETED:
+                deltas[-1] = (D_DELETED, obj)  # dedup consecutive deletes
+            else:
+                deltas.append((dtype, obj))
+            self._cond.notify()
+
+    def pop(self, timeout: Optional[float] = None):
+        """-> (key, [deltas]) or None on close/timeout."""
+        with self._cond:
+            deadline = (time.monotonic() + timeout
+                        if timeout is not None else None)
+            while not self._queue:
+                if self._closed:
+                    return None
+                left = (None if deadline is None
+                        else deadline - time.monotonic())
+                if left is not None and left <= 0:
+                    return None
+                self._cond.wait(left)
+            key = self._queue.popleft()
+            return key, self._items.pop(key)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+
+class Indexer:
+    """Thread-safe object store with named indices
+    (thread_safe_store.go): an index function maps an object to a list
+    of index values; by_index(name, value) answers in O(result)."""
+
+    def __init__(self, indexers: Optional[Dict[str, Callable]] = None):
+        self._lock = threading.Lock()
+        self._items: Dict[object, object] = {}
+        self._indexers: Dict[str, Callable] = dict(indexers or {})
+        self._indices: Dict[str, Dict[str, set]] = {
+            name: {} for name in self._indexers
+        }
+
+    def add_indexer(self, name: str, fn: Callable) -> None:
+        with self._lock:
+            if name in self._indexers:
+                return
+            self._indexers[name] = fn
+            idx: Dict[str, set] = {}
+            for key, obj in self._items.items():
+                for v in fn(obj):
+                    idx.setdefault(v, set()).add(key)
+            self._indices[name] = idx
+
+    def _unindex(self, key, obj) -> None:
+        for name, fn in self._indexers.items():
+            idx = self._indices[name]
+            for v in fn(obj):
+                bucket = idx.get(v)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del idx[v]
+
+    def _index(self, key, obj) -> None:
+        for name, fn in self._indexers.items():
+            for v in fn(obj):
+                self._indices[name].setdefault(v, set()).add(key)
+
+    def upsert(self, key, obj):
+        """-> the previous object (None if new)."""
+        with self._lock:
+            old = self._items.get(key)
+            if old is not None:
+                self._unindex(key, old)
+            self._items[key] = obj
+            self._index(key, obj)
+            return old
+
+    def delete(self, key):
+        with self._lock:
+            old = self._items.pop(key, None)
+            if old is not None:
+                self._unindex(key, old)
+            return old
+
+    def get(self, key):
+        with self._lock:
+            return self._items.get(key)
+
+    def list(self) -> List[object]:
+        with self._lock:
+            return list(self._items.values())
+
+    def keys(self) -> List[object]:
+        with self._lock:
+            return list(self._items.keys())
+
+    def by_index(self, name: str, value: str) -> List[object]:
+        with self._lock:
+            keys = self._indices.get(name, {}).get(value, ())
+            return [self._items[k] for k in keys if k in self._items]
+
+    def index_values(self, name: str) -> List[str]:
+        with self._lock:
+            return list(self._indices.get(name, {}))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+class SharedIndexInformer:
+    """One upstream subscription on (cluster, kind), shared by N handlers.
+
+    Source events land in a DeltaFIFO on the store's write path (cheap
+    append); a dedicated process thread applies them to the Indexer and
+    dispatches handlers — so handler latency never blocks writers, the
+    decoupling shared_informer.go gets from its processor goroutines."""
+
+    def __init__(self, cluster: LocalCluster, kind: str,
+                 resync_period: float = 0.0):
+        self.cluster = cluster
+        self.kind = kind
+        self.resync_period = resync_period
+        self.store = Indexer()
+        self.fifo = DeltaFIFO()
+        self._handlers: List[Tuple[Optional[Callable], Optional[Callable],
+                                   Optional[Callable]]] = []
+        self._handlers_lock = threading.Lock()
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+
+    # ------------------------------------------------------------- config
+
+    def add_event_handler(self, on_add: Optional[Callable] = None,
+                          on_update: Optional[Callable] = None,
+                          on_delete: Optional[Callable] = None) -> None:
+        """on_add(obj), on_update(old, new), on_delete(obj) — dispatched
+        AFTER the shared store reflects the change, so handlers reading
+        the store see at-least-as-fresh state (shared_informer contract)."""
+        with self._handlers_lock:
+            self._handlers.append((on_add, on_update, on_delete))
+
+    def add_indexer(self, name: str, fn: Callable) -> None:
+        self.store.add_indexer(name, fn)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "SharedIndexInformer":
+        if self._started:
+            return self
+        self._started = True
+        self._thread = threading.Thread(target=self._process_loop,
+                                        daemon=True)
+        self._thread.start()
+        # subscribing replays current state synchronously under the store
+        # lock; the sentinel marks the end of the replay so has_synced
+        # flips only after the replayed state is QUERYABLE in self.store
+        self.cluster.watch(self._on_source_event)
+        self.fifo.add(D_SYNC, ("", "\x00sync-sentinel"), None)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.cluster.unwatch(self._on_source_event)
+        self.fifo.close()
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return self._synced.wait(timeout)
+
+    # ------------------------------------------------------------ internals
+
+    def _on_source_event(self, event: str, kind: str, obj) -> None:
+        if kind != self.kind or event not in _EVENT_TO_DELTA:
+            return
+        key = LocalCluster._key(kind, obj)
+        self.fifo.add(_EVENT_TO_DELTA[event], key, obj)
+
+    def _resync_tick(self) -> None:
+        for key in self.store.keys():
+            obj = self.store.get(key)
+            if obj is not None:
+                self.fifo.add(D_SYNC, key, obj)
+
+    def _process_loop(self) -> None:
+        next_resync = (time.monotonic() + self.resync_period
+                       if self.resync_period else None)
+        while not self._stop.is_set():
+            item = self.fifo.pop(timeout=0.2)
+            if item is None:
+                if self.fifo._closed:
+                    return
+                if next_resync and time.monotonic() >= next_resync:
+                    self._resync_tick()
+                    next_resync = time.monotonic() + self.resync_period
+                continue
+            key, deltas = item
+            if key == ("", "\x00sync-sentinel"):
+                self._synced.set()
+                continue
+            for dtype, obj in deltas:
+                try:
+                    self._apply(key, dtype, obj)
+                except Exception:  # HandleError: a bad handler can't kill
+                    pass           # the shared process loop
+
+    def _apply(self, key, dtype: str, obj) -> None:
+        if dtype == D_DELETED:
+            old = self.store.delete(key)
+            if old is None:
+                return  # delete of something we never saw
+            with self._handlers_lock:
+                handlers = list(self._handlers)
+            for _, _, on_delete in handlers:
+                if on_delete is not None:
+                    on_delete(obj)
+            return
+        old = self.store.upsert(key, obj)
+        with self._handlers_lock:
+            handlers = list(self._handlers)
+        if old is None:
+            # first sighting dispatches as add whatever the delta type
+            # (a Sync for an unknown object is an add — processDeltas)
+            for on_add, _, _ in handlers:
+                if on_add is not None:
+                    on_add(obj)
+        else:
+            # known object: update; resyncs re-deliver with old == new
+            for _, on_update, _ in handlers:
+                if on_update is not None:
+                    on_update(old, obj)
+
+
+class SharedInformerFactory:
+    """One informer per kind, shared by every consumer
+    (informers/factory.go)."""
+
+    def __init__(self, cluster: LocalCluster):
+        self.cluster = cluster
+        self._informers: Dict[str, SharedIndexInformer] = {}
+        self._lock = threading.Lock()
+
+    def informer(self, kind: str,
+                 resync_period: float = 0.0) -> SharedIndexInformer:
+        with self._lock:
+            inf = self._informers.get(kind)
+            if inf is None:
+                inf = SharedIndexInformer(self.cluster, kind, resync_period)
+                self._informers[kind] = inf
+            return inf
+
+    def start(self) -> "SharedInformerFactory":
+        with self._lock:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.start()
+        return self
+
+    def wait_for_cache_sync(self, timeout: float = 10.0) -> bool:
+        with self._lock:
+            informers = list(self._informers.values())
+        deadline = time.monotonic() + timeout
+        for inf in informers:
+            if not inf.wait_for_sync(max(0.0, deadline - time.monotonic())):
+                return False
+        return True
+
+    def stop(self) -> None:
+        with self._lock:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.stop()
+
+
+def wire_scheduler_informers(factory: SharedInformerFactory,
+                             scheduler) -> SharedInformerFactory:
+    """AddAllEventHandlers through the informer stack
+    (pkg/scheduler/eventhandlers.go:319-378 wired onto shared informers,
+    the way cmd/kube-scheduler/app/server.go does): nodes/pods/services
+    informers feed the scheduler cache + queue.  Functionally equivalent
+    to runtime.cluster.wire_scheduler, but events traverse
+    reflector->DeltaFIFO->shared store first — the real client-side
+    pipeline, usable against a remote mirror."""
+    from kubernetes_tpu.runtime.cluster import (
+        wire_scheduler_defaults as _defaults,
+    )
+
+    _defaults(factory.cluster, scheduler)
+    cache = scheduler.cache
+    queue = scheduler.queue
+
+    def node_add(node):
+        cache.add_node(node)
+        queue.move_all_to_active()
+
+    def node_update(_old, node):
+        cache.update_node(node)
+        queue.move_all_to_active()
+
+    def node_delete(node):
+        cache.remove_node(node.name)
+        queue.move_all_to_active()
+
+    ninf = factory.informer("nodes")
+    ninf.add_event_handler(on_add=node_add, on_update=node_update,
+                           on_delete=node_delete)
+
+    def _terminal(pod) -> bool:
+        return pod.status.phase in ("Succeeded", "Failed")
+
+    def pod_add(pod):
+        if _terminal(pod):
+            cache.remove_pod(pod)
+            queue.delete(pod)
+            queue.move_all_to_active()
+            return
+        if pod.spec.node_name:
+            cache.add_pod(pod)
+            queue.move_all_to_active()
+        else:
+            queue.add(pod)
+
+    def pod_update(_old, pod):
+        if _terminal(pod):
+            cache.remove_pod(pod)
+            queue.delete(pod)
+            queue.move_all_to_active()
+            return
+        if pod.spec.node_name:
+            cache.add_pod(pod)
+            queue.delete(pod)
+        else:
+            cache.remove_pod(pod)
+            queue.delete(pod)
+            queue.add(pod)
+
+    def pod_delete(pod):
+        if _terminal(pod):
+            return
+        if pod.spec.node_name:
+            cache.remove_pod(pod)
+            queue.move_all_to_active()
+        else:
+            queue.delete(pod)
+
+    pinf = factory.informer("pods")
+    # the index the node-side consumers want anyway (assignedPods)
+    pinf.add_indexer("byNode", lambda p: [p.spec.node_name]
+                     if p.spec.node_name else [])
+    pinf.add_event_handler(on_add=pod_add, on_update=pod_update,
+                           on_delete=pod_delete)
+
+    def svc_add(svc):
+        cache.encoder.add_spread_selector(svc["namespace"], svc["selector"])
+        queue.move_all_to_active()
+
+    factory.informer("services").add_event_handler(on_add=svc_add)
+    return factory

@@ -29,7 +29,7 @@ import urllib.error
 import urllib.request
 from typing import Optional
 
-from kubernetes_tpu.runtime.cluster import ConflictError, LocalCluster
+from kubernetes_tpu.runtime.cluster import LocalCluster
 from kubernetes_tpu.utils import klog
 
 
@@ -122,19 +122,21 @@ class Reflector:
                 obj_d = ev.get("object")
                 if obj_d is None:
                     continue
+                rv = ev.get("resourceVersion")
+                rv = int(rv) if rv is not None else None
                 if in_replay:
-                    replay.append((kind, obj_d))
+                    replay.append((kind, obj_d, rv))
                     continue
-                self._apply(etype, kind, obj_d)
+                self._apply(etype, kind, obj_d, rv)
 
     def _swap(self, replay) -> None:
         """Atomically reconcile the mirror to the replayed state (the
         re-list: stale mirror objects are deleted)."""
         fresh = {}
-        for kind, obj_d in replay:
+        for kind, obj_d, rv in replay:
             self.mirror.register_kind(kind)
             obj = _decode(kind, obj_d)
-            fresh[(kind,) + self.mirror._key(kind, obj)] = obj
+            fresh[(kind,) + self.mirror._key(kind, obj)] = (obj, rv)
         with self.mirror._lock:
             # delete what disappeared while we were away
             for kind in list(self.mirror.kinds):
@@ -142,23 +144,16 @@ class Reflector:
                     if (kind,) + key not in fresh:
                         ns, name = key
                         self.mirror.delete(kind, ns, name)
-            for (kind, _ns, _name), obj in fresh.items():
-                self._upsert(kind, obj)
+            for (kind, _ns, _name), (obj, rv) in fresh.items():
+                # remote resourceVersions are preserved in the mirror so
+                # CAS writes (expect_rv) round-trip to the remote store
+                self.mirror.apply_event("MODIFIED", kind, obj, rv=rv)
 
-    def _apply(self, etype: str, kind: str, obj_d: dict) -> None:
+    def _apply(self, etype: str, kind: str, obj_d: dict,
+               rv: Optional[int] = None) -> None:
         self.mirror.register_kind(kind)
         obj = _decode(kind, obj_d)
-        if etype == "DELETED":
-            ns, name = self.mirror._key(kind, obj)
-            self.mirror.delete(kind, ns, name)
-            return
-        self._upsert(kind, obj)
-
-    def _upsert(self, kind: str, obj) -> None:
-        try:
-            self.mirror.create(kind, obj)
-        except ConflictError:
-            self.mirror.update(kind, obj)
+        self.mirror.apply_event(etype, kind, obj, rv=rv)
 
 
 def remote_victim_deleter(server: str, token: str = ""):

@@ -98,10 +98,13 @@ def wait_for_term(stop_event: Optional[threading.Event] = None) -> None:
     ev.wait()
 
 
-def build_wired_scheduler(cluster, cc=None):
+def build_wired_scheduler(cluster, cc=None, use_informers: bool = False):
     """One shared recipe for embedding a scheduler against a LocalCluster
     (the server.go:164-201 build + AddAllEventHandlers): component config
-    honored when given."""
+    honored when given.  use_informers routes events through the shared
+    informer stack (reflector->DeltaFIFO->indexed store->handlers) the
+    way cmd/kube-scheduler does — the right mode against a remote
+    mirror; the direct wiring avoids the extra thread for embedded use."""
     from kubernetes_tpu.runtime.cache import SchedulerCache
     from kubernetes_tpu.runtime.cluster import (
         make_cluster_binder,
@@ -118,5 +121,17 @@ def build_wired_scheduler(cluster, cc=None):
         cache=SchedulerCache(), queue=PriorityQueue(),
         binder=make_cluster_binder(cluster), config=cfg,
     )
-    wire_scheduler(cluster, sched)
+    if use_informers:
+        from kubernetes_tpu.client.informer import (
+            SharedInformerFactory,
+            wire_scheduler_informers,
+        )
+
+        factory = SharedInformerFactory(cluster)
+        wire_scheduler_informers(factory, sched)
+        factory.start()
+        factory.wait_for_cache_sync(30.0)
+        sched.informer_factory = factory  # teardown handle
+    else:
+        wire_scheduler(cluster, sched)
     return sched

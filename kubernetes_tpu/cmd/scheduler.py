@@ -117,7 +117,10 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 1
         cluster = reflector.mirror
-        sched = build_wired_scheduler(cluster, cc)
+        # the real client pipeline: remote watch -> mirror -> shared
+        # informers -> scheduler cache/queue (server.go:224-229 informer
+        # start + WaitForCacheSync)
+        sched = build_wired_scheduler(cluster, cc, use_informers=True)
         sched.binder = RemoteBinder(args.server, token=args.token)
         sched.victim_deleter = remote_victim_deleter(
             args.server, token=args.token)

@@ -78,7 +78,14 @@ class LocalCluster:
             return (obj["namespace"], obj["name"])
         return (obj.namespace, obj.name)
 
-    def _notify(self, event: str, kind: str, obj) -> None:
+    def _notify(self, event: str, kind: str, obj,
+                rv: Optional[int] = None) -> None:
+        # event_rv: the revision this event committed at, readable by
+        # watchers DURING the synchronous fan-out only (they run inside
+        # the store lock).  Keeps the 3-arg watcher signature while
+        # letting the REST watch stream attach exact resourceVersions
+        # without re-deriving them per watcher.
+        self.event_rv = rv
         for w in list(self._watchers):
             w(event, kind, obj)
 
@@ -93,8 +100,10 @@ class LocalCluster:
             self._watchers.append(fn)
             for kind in self.kinds:
                 for s in self._store[kind].values():
+                    self.event_rv = s.rv
                     fn(ADDED, kind, s.obj)
             if bookmark:
+                self.event_rv = None
                 fn("BOOKMARK", "", None)
 
     def unwatch(self, fn: Callable[[str, str, object], None]) -> None:
@@ -132,7 +141,7 @@ class LocalCluster:
                 raise ConflictError(f"{kind} {key} exists")
             self._rv += 1
             self._store[kind][key] = _Stored(obj, self._rv)
-            self._notify(ADDED, kind, obj)
+            self._notify(ADDED, kind, obj, rv=self._rv)
             return self._rv
 
     def update(self, kind: str, obj, expect_rv: Optional[int] = None) -> int:
@@ -145,7 +154,7 @@ class LocalCluster:
                 raise ConflictError(f"{kind} {key} rv {cur.rv} != {expect_rv}")
             self._rv += 1
             self._store[kind][key] = _Stored(obj, self._rv)
-            self._notify(MODIFIED, kind, obj)
+            self._notify(MODIFIED, kind, obj, rv=self._rv)
             return self._rv
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -154,7 +163,33 @@ class LocalCluster:
             cur = self._store[kind].pop(key, None)
             if cur is not None:
                 self._rv += 1
-                self._notify(DELETED, kind, cur.obj)
+                self._notify(DELETED, kind, cur.obj, rv=self._rv)
+
+    def apply_event(self, event: str, kind: str, obj,
+                    rv: Optional[int] = None) -> None:
+        """Reflector ingestion: upsert/delete mirroring a REMOTE store.
+
+        Unlike create/update, an explicit ``rv`` (the remote's
+        resourceVersion, carried on the watch stream) is preserved so a
+        client doing get_with_rv on the mirror and PUTting expect_rv back
+        to the remote round-trips the REMOTE's CAS — the mirror's own
+        counter would be meaningless there."""
+        with self._lock:
+            key = self._key(kind, obj)
+            if event == DELETED:
+                cur = self._store[kind].pop(key, None)
+                if cur is not None:
+                    self._rv += 1
+                    self._notify(DELETED, kind, cur.obj, rv=self._rv)
+                return
+            existed = key in self._store[kind]
+            if rv is None:
+                self._rv += 1
+                rv = self._rv
+            else:
+                self._rv = max(self._rv, rv)
+            self._store[kind][key] = _Stored(obj, rv)
+            self._notify(MODIFIED if existed else ADDED, kind, obj, rv=rv)
 
     def get(self, kind: str, namespace: str, name: str):
         with self._lock:
@@ -222,12 +257,11 @@ class LocalCluster:
             return True
 
 
-def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
-    """AddAllEventHandlers analog (pkg/scheduler/eventhandlers.go:319-378):
-    route store events into the scheduler's cache and queue; the scheduler's
-    event recorder becomes the cluster's (one audit trail)."""
-    cache = scheduler.cache
-    queue = scheduler.queue
+def wire_scheduler_defaults(cluster: LocalCluster, scheduler) -> None:
+    """The non-event half of AddAllEventHandlers wiring: point the
+    scheduler's defaulted collaborators (recorder, PDB lister, unbinder,
+    victim deleter) at the store.  Shared by the direct-watch wiring
+    below and the informer-based wiring (client/informer.py)."""
     if getattr(scheduler, "_recorder_defaulted", False):
         scheduler.recorder = cluster.events
     if getattr(scheduler, "_pdb_defaulted", False):
@@ -244,6 +278,15 @@ def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
         scheduler.victim_deleter = (
             lambda v: cluster.delete("pods", v.namespace, v.name)
         )
+
+
+def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
+    """AddAllEventHandlers analog (pkg/scheduler/eventhandlers.go:319-378):
+    route store events into the scheduler's cache and queue; the scheduler's
+    event recorder becomes the cluster's (one audit trail)."""
+    cache = scheduler.cache
+    queue = scheduler.queue
+    wire_scheduler_defaults(cluster, scheduler)
 
     def on_event(event: str, kind: str, obj) -> None:
         if kind == "nodes":

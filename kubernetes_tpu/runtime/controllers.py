@@ -137,12 +137,33 @@ class Reconciler:
     """The shared controller worker shape (SURVEY.md section 3.5): a
     WorkQueue of keys + sync(key), with rate-limited requeue on ANY error
     (client-go HandleError semantics — a bad object must not kill the
-    thread).  Subclasses implement sync() and enqueue from watch events."""
+    thread).  Subclasses implement sync() and enqueue from watch events.
 
-    def __init__(self, cluster: LocalCluster):
+    Event source: by default the store's raw watch (embedded mode); when
+    an informer factory is passed AND the subclass declares WATCH_KINDS,
+    events arrive through per-kind shared informers instead — the
+    reference's informer->workqueue->reconcile pipeline
+    (shared_informer.go handlers feeding controller workqueues), which
+    also decouples handler latency from the store's write lock."""
+
+    #: kinds this controller subscribes to via informers (empty =
+    #: firehose raw watch; the informer path needs the explicit list)
+    WATCH_KINDS: Tuple[str, ...] = ()
+
+    def __init__(self, cluster: LocalCluster, informers=None):
         self.cluster = cluster
         self.queue = WorkQueue()
-        cluster.watch(self._on_event)
+        if informers is not None and self.WATCH_KINDS:
+            for kind in self.WATCH_KINDS:
+                informers.informer(kind).add_event_handler(
+                    on_add=lambda o, k=kind: self._on_event(ADDED, k, o),
+                    on_update=lambda _old, new, k=kind: self._on_event(
+                        MODIFIED, k, new),
+                    on_delete=lambda o, k=kind: self._on_event(
+                        DELETED, k, o),
+                )
+        else:
+            cluster.watch(self._on_event)
 
     def _on_event(self, event: str, kind: str, obj) -> None:  # pragma: no cover
         raise NotImplementedError
@@ -198,26 +219,82 @@ class ReplicaSet:
         return (self.namespace, self.name)
 
 
+class ControllerExpectations:
+    """pkg/controller/controller_utils.go ControllerExpectations: a sync
+    that just created/deleted N children must not run again until the
+    watch has delivered those N events — otherwise a controller reading a
+    LAGGING cache (the remote-mirror deployment) sees stale counts and
+    over-creates.  Expectations expire after a timeout so one lost event
+    can't wedge a key forever (ExpectationsTimeout, 5 min there)."""
+
+    TIMEOUT = 60.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._exp: Dict[object, List[float]] = {}  # key -> [adds, dels, t0]
+
+    def expect(self, key, adds: int = 0, dels: int = 0) -> None:
+        with self._lock:
+            self._exp[key] = [float(adds), float(dels), time.monotonic()]
+
+    def creation_observed(self, key) -> None:
+        with self._lock:
+            e = self._exp.get(key)
+            if e is not None and e[0] > 0:
+                e[0] -= 1
+
+    def deletion_observed(self, key) -> None:
+        with self._lock:
+            e = self._exp.get(key)
+            if e is not None and e[1] > 0:
+                e[1] -= 1
+
+    def satisfied(self, key) -> bool:
+        with self._lock:
+            e = self._exp.get(key)
+            if e is None:
+                return True
+            if e[0] <= 0 and e[1] <= 0:
+                del self._exp[key]
+                return True
+            if time.monotonic() - e[2] > self.TIMEOUT:
+                del self._exp[key]  # lost event: give up and resync
+                return True
+            return False
+
+
 class ReplicaSetController(Reconciler):
     """pkg/controller/replicaset syncReplicaSet: observed = store pods owned
     by the RS (owner_uid) and matching the selector; diff against
     spec.replicas; create/delete through the store."""
 
-    def __init__(self, cluster: LocalCluster):
+    WATCH_KINDS = ("replicasets", "pods")
+
+    def __init__(self, cluster: LocalCluster, informers=None):
         self._seq = 0
-        super().__init__(cluster)
+        self.expectations = ControllerExpectations()
+        super().__init__(cluster, informers=informers)
 
     # ------------------------------------------------------ informer seam
+
+    def _resolve_owner(self, obj):
+        for rs in self.cluster.list("replicasets"):
+            if rs.uid == obj.metadata.owner_uid:
+                return rs
+        return None
 
     def _on_event(self, event: str, kind: str, obj) -> None:
         if kind == "replicasets":
             self.queue.add(obj.key)
         elif kind == "pods" and getattr(obj.metadata, "owner_uid", ""):
             # resolve owner RS by uid (resolveControllerRef)
-            for rs in self.cluster.list("replicasets"):
-                if rs.uid == obj.metadata.owner_uid:
-                    self.queue.add(rs.key)
-                    break
+            rs = self._resolve_owner(obj)
+            if rs is not None:
+                if event == ADDED:
+                    self.expectations.creation_observed(rs.key)
+                elif event == DELETED:
+                    self.expectations.deletion_observed(rs.key)
+                self.queue.add(rs.key)
 
     # ------------------------------------------------------------- sync
 
@@ -248,29 +325,54 @@ class ReplicaSetController(Reconciler):
                 ):
                     self.cluster.delete("pods", p.namespace, p.name)
             return
+        if not self.expectations.satisfied(key):
+            # a previous sync's creates/deletes haven't round-tripped the
+            # watch yet (remote mirror lag): acting on stale counts would
+            # over-create — requeue and wait (syncReplicaSet's
+            # rsNeedsSync gate)
+            self.queue.add_rate_limited(key)
+            return
         owned = self._owned_pods(rs)
         diff = rs.replicas - len(owned)
         if diff > 0:
-            for _ in range(diff):
-                self._seq += 1
-                d = dict(rs.template)
-                meta = dict(d.get("metadata") or {})
-                meta["name"] = f"{rs.name}-{self._seq:05d}"
-                meta["namespace"] = rs.namespace
-                meta["ownerReferences"] = [
-                    {"kind": "ReplicaSet", "name": rs.name, "uid": rs.uid,
-                     "controller": True}
-                ]
-                d["metadata"] = meta
-                self.cluster.create("pods", Pod.from_dict(d))
+            self.expectations.expect(key, adds=diff)
+            done = 0
+            try:
+                for _ in range(diff):
+                    self._seq += 1
+                    d = dict(rs.template)
+                    meta = dict(d.get("metadata") or {})
+                    meta["name"] = f"{rs.name}-{self._seq:05d}"
+                    meta["namespace"] = rs.namespace
+                    meta["ownerReferences"] = [
+                        {"kind": "ReplicaSet", "name": rs.name,
+                         "uid": rs.uid, "controller": True}
+                    ]
+                    d["metadata"] = meta
+                    self.cluster.create("pods", Pod.from_dict(d))
+                    done += 1
+            finally:
+                # a failed create produces no watch event: lower the
+                # expectation for every pod NOT created, or the key stalls
+                # until the expectations timeout (controller_utils.go
+                # CreationObserved on failure)
+                for _ in range(diff - done):
+                    self.expectations.creation_observed(key)
         elif diff < 0:
             # delete surplus: prefer unassigned, then youngest (the
             # getPodsToDelete ranking, abbreviated; names carry the creation
             # sequence so name-descending = youngest-first)
+            self.expectations.expect(key, dels=-diff)
             owned.sort(key=lambda p: p.name, reverse=True)
             owned.sort(key=lambda p: bool(p.spec.node_name))  # stable
-            for p in owned[:-diff]:
-                self.cluster.delete("pods", p.namespace, p.name)
+            done = 0
+            try:
+                for p in owned[:-diff]:
+                    self.cluster.delete("pods", p.namespace, p.name)
+                    done += 1
+            finally:
+                for _ in range(-diff - done):
+                    self.expectations.deletion_observed(key)
 
 
 def add_replicaset(cluster: LocalCluster, rs: ReplicaSet) -> None:
@@ -418,9 +520,19 @@ class ControllerManager:
     """cmd/kube-controller-manager shape: start every controller against one
     cluster; stop() tears all of them down."""
 
-    def __init__(self, cluster: LocalCluster, grace_period: float = 40.0):
+    def __init__(self, cluster: LocalCluster, grace_period: float = 40.0,
+                 use_informers: bool = False):
         self.cluster = cluster
-        self.replicaset = ReplicaSetController(cluster)
+        self.informers = None
+        if use_informers:
+            # the reference wiring: one shared informer factory, each
+            # controller subscribing per-kind (controllermanager.go builds
+            # a SharedInformerFactory handed to every controller ctor)
+            from kubernetes_tpu.client.informer import SharedInformerFactory
+
+            self.informers = SharedInformerFactory(cluster)
+        self.replicaset = ReplicaSetController(cluster,
+                                               informers=self.informers)
         self.nodelifecycle = NodeLifecycleController(cluster, grace_period)
         self.disruption = DisruptionController(cluster)
         self.deployment = DeploymentController(cluster)
@@ -441,6 +553,9 @@ class ControllerManager:
         self._threads: List[threading.Thread] = []
 
     def start(self, rs_workers: int = 2, monitor_period: float = 5.0) -> None:
+        if self.informers is not None:
+            self.informers.start()
+            self.informers.wait_for_cache_sync(30.0)
         self._threads += self.replicaset.run(self._stop, workers=rs_workers)
         self._threads.append(
             self.nodelifecycle.run(self._stop, period=monitor_period)
@@ -469,6 +584,8 @@ class ControllerManager:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.informers is not None:
+            self.informers.stop()
         self.replicaset.queue.close()
         self.disruption.queue.close()
         self.deployment.queue.close()
