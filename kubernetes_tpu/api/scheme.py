@@ -76,6 +76,12 @@ _REGISTRY: Dict[str, tuple] = {
     "clusterrolebindings": (
         GroupVersionKind("rbac.authorization.k8s.io", "v1",
                          "ClusterRoleBinding"), True),
+    "persistentvolumes": (
+        GroupVersionKind("", "v1", "PersistentVolume"), True),
+    "persistentvolumeclaims": (
+        GroupVersionKind("", "v1", "PersistentVolumeClaim"), False),
+    "storageclasses": (
+        GroupVersionKind("storage.k8s.io", "v1", "StorageClass"), True),
 }
 
 

@@ -193,6 +193,10 @@ def node_to_dict(node: Node) -> dict:
                 {"type": k, "status": v}
                 for k, v in sorted(node.status.conditions.items())
             ],
+            "volumesAttached": [
+                {"name": n, "devicePath": ""}
+                for n in node.status.volumes_attached
+            ],
         }),
     }
 
@@ -202,6 +206,9 @@ def object_to_dict(kind: str, obj) -> dict:
         return pod_to_dict(obj)
     if kind == "nodes":
         return node_to_dict(obj)
+    if kind in ("persistentvolumes", "persistentvolumeclaims",
+                "storageclasses"):
+        return obj.to_dict()
     if isinstance(obj, dict):
         return obj  # services / leases / raw objects
     if kind == "deployments":

@@ -28,6 +28,12 @@ SRC_AZURE = "azureDisk"
 SRC_CINDER = "cinder"
 SRC_CSI = "csi"
 
+# which spec field carries each source kind's volume identity
+_SRC_ID_FIELD = {
+    SRC_EBS: "volumeID", SRC_GCE: "pdName", SRC_AZURE: "diskName",
+    SRC_CINDER: "volumeID", SRC_CSI: "volumeHandle",
+}
+
 
 @dataclass
 class PersistentVolume:
@@ -45,14 +51,49 @@ class PersistentVolume:
     source_id: str = ""
     phase: str = "Available"                       # Available | Bound | ...
     claim_ref: str = ""                            # "ns/name" of bound PVC
+    # persistentVolumeReclaimPolicy: Retain | Delete (Recycle deprecated);
+    # manual PVs default Retain, dynamically provisioned ones Delete
+    reclaim_policy: str = "Retain"
 
     @property
     def name(self) -> str:
         return self.metadata.name
 
     @property
+    def namespace(self) -> str:
+        return ""  # cluster-scoped
+
+    @property
     def labels(self) -> Dict[str, str]:
         return self.metadata.labels
+
+    def to_dict(self) -> dict:
+        src: Dict[str, dict] = {}
+        if self.source_kind:
+            src[self.source_kind] = {
+                _SRC_ID_FIELD[self.source_kind]: self.source_id}
+            if self.source_kind == SRC_CSI and self.csi_driver:
+                src[self.source_kind]["driver"] = self.csi_driver
+        spec = {
+            "capacity": ({"storage": str(self.capacity)}
+                         if self.capacity is not None else {}),
+            "accessModes": list(self.access_modes),
+            "storageClassName": self.storage_class,
+            "persistentVolumeReclaimPolicy": self.reclaim_policy,
+            **src,
+        }
+        if self.node_affinity is not None:
+            spec["nodeAffinity"] = {"required": self.node_affinity.to_dict()}
+        if self.claim_ref:
+            ns, _, nm = self.claim_ref.partition("/")
+            spec["claimRef"] = {"namespace": ns, "name": nm}
+        return {
+            "kind": "PersistentVolume", "apiVersion": "v1",
+            "metadata": {"name": self.metadata.name,
+                         "labels": dict(self.metadata.labels)},
+            "spec": spec,
+            "status": {"phase": self.phase},
+        }
 
     @staticmethod
     def from_dict(d: dict) -> "PersistentVolume":
@@ -60,14 +101,10 @@ class PersistentVolume:
         source_kind = ""
         csi_driver = ""
         source_id = ""
-        id_field = {
-            SRC_EBS: "volumeID", SRC_GCE: "pdName", SRC_AZURE: "diskName",
-            SRC_CINDER: "volumeID", SRC_CSI: "volumeHandle",
-        }
         for k in (SRC_EBS, SRC_GCE, SRC_AZURE, SRC_CINDER, SRC_CSI):
             if k in spec:
                 source_kind = k
-                source_id = spec[k].get(id_field[k], "")
+                source_id = spec[k].get(_SRC_ID_FIELD[k], "")
                 if k == SRC_CSI:
                     csi_driver = spec[k].get("driver", "")
                 break
@@ -85,8 +122,10 @@ class PersistentVolume:
             node_affinity=na,
             source_kind=source_kind,
             csi_driver=csi_driver,
+            source_id=source_id,
             phase=(d.get("status") or {}).get("phase", "Available"),
             claim_ref=f"{cr.get('namespace', '')}/{cr.get('name', '')}" if cr else "",
+            reclaim_policy=spec.get("persistentVolumeReclaimPolicy", "Retain"),
         )
 
 
@@ -120,12 +159,34 @@ class PersistentVolumeClaim:
             phase=(d.get("status") or {}).get("phase", "Pending"),
         )
 
+    def to_dict(self) -> dict:
+        return {
+            "kind": "PersistentVolumeClaim", "apiVersion": "v1",
+            "metadata": {"name": self.metadata.name,
+                         "namespace": self.metadata.namespace,
+                         "labels": dict(self.metadata.labels)},
+            "spec": {
+                "storageClassName": self.storage_class,
+                "volumeName": self.volume_name,
+                "accessModes": list(self.access_modes),
+                "resources": {"requests": (
+                    {"storage": str(self.request)}
+                    if self.request is not None else {}
+                )},
+            },
+            "status": {"phase": self.phase},
+        }
+
 
 @dataclass
 class StorageClass:
     name: str = ""
     provisioner: str = ""
     binding_mode: str = IMMEDIATE
+
+    @property
+    def namespace(self) -> str:
+        return ""  # cluster-scoped
 
     @staticmethod
     def from_dict(d: dict) -> "StorageClass":
@@ -134,3 +195,11 @@ class StorageClass:
             provisioner=d.get("provisioner", ""),
             binding_mode=d.get("volumeBindingMode", IMMEDIATE),
         )
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": "StorageClass", "apiVersion": "storage.k8s.io/v1",
+            "metadata": {"name": self.name},
+            "provisioner": self.provisioner,
+            "volumeBindingMode": self.binding_mode,
+        }

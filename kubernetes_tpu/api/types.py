@@ -172,6 +172,12 @@ class NodeSelectorRequirement:
             d["key"], d["operator"], tuple(d.get("values") or ())
         )
 
+    def to_dict(self) -> dict:
+        out = {"key": self.key, "operator": self.operator}
+        if self.values:
+            out["values"] = list(self.values)
+        return out
+
 
 @dataclass(frozen=True)
 class NodeSelectorTerm:
@@ -191,6 +197,16 @@ class NodeSelectorTerm:
             ),
         )
 
+    def to_dict(self) -> dict:
+        out: dict = {}
+        if self.match_expressions:
+            out["matchExpressions"] = [
+                e.to_dict() for e in self.match_expressions
+            ]
+        if self.match_fields:
+            out["matchFields"] = [e.to_dict() for e in self.match_fields]
+        return out
+
 
 @dataclass(frozen=True)
 class NodeSelector:
@@ -204,6 +220,9 @@ class NodeSelector:
         return NodeSelector(
             tuple(NodeSelectorTerm.from_dict(t) for t in d.get("nodeSelectorTerms") or ())
         )
+
+    def to_dict(self) -> dict:
+        return {"nodeSelectorTerms": [t.to_dict() for t in self.terms]}
 
 
 @dataclass(frozen=True)
@@ -549,6 +568,9 @@ class NodeStatus:
     images: Tuple[ContainerImage, ...] = ()
     # condition type -> status ("True"/"False"/"Unknown"), e.g. {"Ready": "True"}
     conditions: Dict[str, str] = field(default_factory=dict)
+    # PV names attached to this node (status.volumesAttached[].name,
+    # maintained by the attach-detach controller)
+    volumes_attached: Tuple[str, ...] = ()
 
     @staticmethod
     def from_dict(d: Optional[dict]) -> "NodeStatus":
@@ -566,6 +588,9 @@ class NodeStatus:
             conditions={
                 c["type"]: c["status"] for c in d.get("conditions") or []
             },
+            volumes_attached=tuple(
+                v.get("name", "") for v in d.get("volumesAttached") or ()
+            ),
         )
 
 

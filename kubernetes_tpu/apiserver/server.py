@@ -60,7 +60,10 @@ LIST_KINDS = {"pods": "PodList", "nodes": "NodeList",
               "roles": "RoleList",
               "rolebindings": "RoleBindingList",
               "clusterroles": "ClusterRoleList",
-              "clusterrolebindings": "ClusterRoleBindingList"}
+              "clusterrolebindings": "ClusterRoleBindingList",
+              "persistentvolumes": "PersistentVolumeList",
+              "persistentvolumeclaims": "PersistentVolumeClaimList",
+              "storageclasses": "StorageClassList"}
 
 # kinds stored as plain dicts carrying the original wire body plus flat
 # namespace/name keys for the store (cluster-scoped kinds use "")
@@ -266,6 +269,18 @@ def _decode(kind: str, d: dict):
         # nodelifecycle controller runs on this process's clock
         out["renew_time"] = time.monotonic()
         return out
+    if kind == "persistentvolumes":
+        from kubernetes_tpu.api.storage import PersistentVolume
+
+        return PersistentVolume.from_dict(d)
+    if kind == "persistentvolumeclaims":
+        from kubernetes_tpu.api.storage import PersistentVolumeClaim
+
+        return PersistentVolumeClaim.from_dict(d)
+    if kind == "storageclasses":
+        from kubernetes_tpu.api.storage import StorageClass
+
+        return StorageClass.from_dict(d)
     from kubernetes_tpu.apiserver.extensions import flatten_wire_dict
 
     if kind in _DICT_KINDS:

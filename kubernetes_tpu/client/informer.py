@@ -420,4 +420,31 @@ def wire_scheduler_informers(factory: SharedInformerFactory,
         queue.move_all_to_active()
 
     factory.informer("services").add_event_handler(on_add=svc_add)
+
+    # storage events unblock volume-bound pods (eventhandlers.go wires
+    # PV/PVC/StorageClass informers to MoveAllToActiveQueue the same way)
+    def pv_upsert(pv):
+        cache.encoder.add_pv(pv)
+        queue.move_all_to_active()
+
+    factory.informer("persistentvolumes").add_event_handler(
+        on_add=pv_upsert, on_update=lambda _o, pv: pv_upsert(pv),
+        on_delete=lambda pv: (cache.encoder.remove_pv(pv.name),
+                              queue.move_all_to_active()))
+
+    def pvc_upsert(pvc):
+        cache.encoder.add_pvc(pvc)
+        queue.move_all_to_active()
+
+    factory.informer("persistentvolumeclaims").add_event_handler(
+        on_add=pvc_upsert, on_update=lambda _o, c: pvc_upsert(c),
+        on_delete=lambda c: (cache.encoder.remove_pvc(c.namespace, c.name),
+                             queue.move_all_to_active()))
+
+    def sc_upsert(sc):
+        cache.encoder.add_storage_class(sc)
+        queue.move_all_to_active()
+
+    factory.informer("storageclasses").add_event_handler(
+        on_add=sc_upsert, on_update=lambda _o, s: sc_upsert(s))
     return factory

@@ -52,7 +52,9 @@ class LocalCluster:
              "daemonsets", "statefulsets", "cronjobs",
              "horizontalpodautoscalers",
              "secrets", "serviceaccounts", "roles", "rolebindings",
-             "clusterroles", "clusterrolebindings")
+             "clusterroles", "clusterrolebindings",
+             "persistentvolumes", "persistentvolumeclaims",
+             "storageclasses")
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
@@ -344,6 +346,22 @@ def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
                 cache.encoder.add_spread_selector(
                     obj["namespace"], obj["selector"]
                 )
+                queue.move_all_to_active()
+        elif kind == "persistentvolumes":
+            if event == DELETED:
+                cache.encoder.remove_pv(obj.name)
+            else:
+                cache.encoder.add_pv(obj)
+            queue.move_all_to_active()  # PV events unblock volume-bound pods
+        elif kind == "persistentvolumeclaims":
+            if event == DELETED:
+                cache.encoder.remove_pvc(obj.namespace, obj.name)
+            else:
+                cache.encoder.add_pvc(obj)
+            queue.move_all_to_active()
+        elif kind == "storageclasses":
+            if event != DELETED:
+                cache.encoder.add_storage_class(obj)
                 queue.move_all_to_active()
 
     cluster.watch(on_event)

@@ -549,6 +549,20 @@ class ControllerManager:
         self.cronjob = CronJobController(cluster)
         self.hpa = HPAController(cluster)
         self.ttl = TTLAfterFinishedController(cluster)
+        from kubernetes_tpu.runtime.volumecontrollers import (
+            AttachDetachController,
+            PersistentVolumeController,
+            ServiceAccountController,
+            TokenController,
+        )
+
+        self.pv = PersistentVolumeController(cluster,
+                                             informers=self.informers)
+        self.attachdetach = AttachDetachController(cluster,
+                                                   informers=self.informers)
+        self.serviceaccount = ServiceAccountController(
+            cluster, informers=self.informers)
+        self.token = TokenController(cluster, informers=self.informers)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -573,6 +587,10 @@ class ControllerManager:
         self._threads.append(self.cronjob.run(self._stop))
         self._threads.append(self.hpa.run(self._stop))
         self._threads.append(self.ttl.run(self._stop))
+        self._threads += self.pv.run(self._stop)
+        self._threads += self.attachdetach.run(self._stop)
+        self._threads += self.serviceaccount.run(self._stop)
+        self._threads += self.token.run(self._stop)
 
         def gc_resweep():
             while not self._stop.wait(30.0):
@@ -596,6 +614,10 @@ class ControllerManager:
         self.quota.queue.close()
         self.daemonset.queue.close()
         self.statefulset.queue.close()
+        self.pv.queue.close()
+        self.attachdetach.queue.close()
+        self.serviceaccount.queue.close()
+        self.token.queue.close()
 
 
 # ---------------------------------------------------------------- disruption

@@ -1,0 +1,395 @@
+"""Volume + serviceaccount controllers (the server-side reconcilers).
+
+Reference:
+  * pkg/controller/volume/persistentvolume/pv_controller.go (+
+    pv_controller_base.go, index.go findBestMatchForClaim): claim<->volume
+    binding — syncUnboundClaim matches an Available PV by capacity /
+    access modes / storage class (smallest-that-fits), sets
+    pv.spec.claimRef + both phases Bound; syncVolume releases PVs whose
+    claim vanished and applies the reclaim policy (Retain -> Released,
+    Delete -> delete the PV); dynamic provisioning creates a PV for
+    claims whose class names a provisioner (WaitForFirstConsumer waits
+    for the scheduler's node pick, read from the pod that uses the
+    claim).
+  * pkg/controller/volume/attachdetach/attach_detach_controller.go:
+    desired state = pods assigned to nodes x their PV-backed volumes;
+    reconciler attaches/detaches, surfacing node.status.volumesAttached.
+  * pkg/controller/serviceaccount/serviceaccounts_controller.go: every
+    active namespace gets a "default" ServiceAccount.
+  * pkg/controller/serviceaccount/tokens_controller.go: every SA gets a
+    token Secret (type kubernetes.io/service-account-token) — which this
+    framework's TokenAuthenticator then accepts as
+    system:serviceaccount:<ns>:<name>.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import secrets as _secrets
+import threading
+from typing import List, Optional, Tuple
+
+from kubernetes_tpu.api.storage import (
+    IMMEDIATE,
+    WAIT_FOR_FIRST_CONSUMER,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+)
+from kubernetes_tpu.api.types import ObjectMeta
+from kubernetes_tpu.runtime.cluster import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    ConflictError,
+    LocalCluster,
+)
+from kubernetes_tpu.runtime.controllers import Reconciler
+
+
+def _access_modes_satisfied(pv: PersistentVolume,
+                            pvc: PersistentVolumeClaim) -> bool:
+    """Every requested mode must be offered (CheckAccessModes,
+    index.go:290-302)."""
+    return set(pvc.access_modes) <= set(pv.access_modes)
+
+
+class PersistentVolumeController(Reconciler):
+    """Claim<->volume binding + reclaim + dynamic provisioning."""
+
+    WATCH_KINDS = ("persistentvolumeclaims", "persistentvolumes", "pods")
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "persistentvolumeclaims":
+            self.queue.add(("claim", obj.namespace, obj.name))
+        elif kind == "persistentvolumes":
+            self.queue.add(("volume", "", obj.name))
+        elif kind == "pods" and obj.spec.node_name:
+            # a scheduled pod may unblock WaitForFirstConsumer provisioning
+            for v in obj.spec.volumes:
+                claim = (v.get("persistentVolumeClaim") or {})
+                if claim.get("claimName"):
+                    self.queue.add(
+                        ("claim", obj.namespace, claim["claimName"]))
+
+    # ------------------------------------------------------------- claims
+
+    def _find_best_match(self, pvc: PersistentVolumeClaim
+                         ) -> Optional[PersistentVolume]:
+        """Smallest Available PV satisfying class/modes/capacity
+        (findBestMatchForClaim)."""
+        best = None
+        for pv in self.cluster.list("persistentvolumes"):
+            if pv.phase != "Available" or pv.claim_ref:
+                continue
+            if pv.storage_class != pvc.storage_class:
+                continue
+            if not _access_modes_satisfied(pv, pvc):
+                continue
+            if pvc.request is not None:
+                if pv.capacity is None or float(pv.capacity) < float(pvc.request):
+                    continue
+            if best is None or (
+                pv.capacity is not None and best.capacity is not None
+                and float(pv.capacity) < float(best.capacity)
+            ):
+                best = pv
+        return best
+
+    def _selected_node(self, pvc: PersistentVolumeClaim) -> str:
+        """WaitForFirstConsumer: the node the scheduler picked, read from
+        a pod that uses this claim (the selected-node annotation analog)."""
+        for p in self.cluster.list("pods"):
+            if p.namespace != pvc.namespace or not p.spec.node_name:
+                continue
+            for v in p.spec.volumes:
+                if (v.get("persistentVolumeClaim") or {}).get(
+                        "claimName") == pvc.name:
+                    return p.spec.node_name
+        return ""
+
+    def _provision(self, pvc: PersistentVolumeClaim, sc: StorageClass,
+                   node_name: str) -> PersistentVolume:
+        """Dynamic provisioning: mint a PV sized to the claim; WFFC pins
+        it to the selected node via nodeAffinity (provisioned volumes
+        reclaim Delete)."""
+        from kubernetes_tpu.api.types import (
+            NodeSelector,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+        )
+
+        na = None
+        if node_name:
+            na = NodeSelector((NodeSelectorTerm((
+                NodeSelectorRequirement("kubernetes.io/hostname", "In",
+                                        (node_name,)),
+            )),))
+        return PersistentVolume(
+            metadata=ObjectMeta(
+                name=f"pvc-{pvc.namespace}-{pvc.name}-"
+                     f"{_secrets.token_hex(4)}"),
+            capacity=pvc.request,
+            access_modes=pvc.access_modes or ("ReadWriteOnce",),
+            storage_class=pvc.storage_class,
+            node_affinity=na,
+            source_kind="csi",
+            csi_driver=sc.provisioner,
+            source_id=_secrets.token_hex(8),
+            reclaim_policy="Delete",
+        )
+
+    def _sync_claim(self, ns: str, name: str) -> None:
+        pvc = self.cluster.get("persistentvolumeclaims", ns, name)
+        if pvc is None:
+            # claim deleted: release its PV (syncVolume's release half
+            # handles reclaim when the volume event fires)
+            for pv in self.cluster.list("persistentvolumes"):
+                if pv.claim_ref == f"{ns}/{name}":
+                    self.queue.add(("volume", "", pv.name))
+            return
+        if pvc.volume_name:
+            # user-pre-bound claim (spec.volumeName): the PV side must be
+            # bound too or the volume stays Available and a second claim
+            # can steal it (syncUnboundClaim's volumeName!=nil arm)
+            pv = self.cluster.get("persistentvolumes", "", pvc.volume_name)
+            if pv is None:
+                return  # named volume doesn't exist yet: stays Pending
+            ours = f"{pvc.namespace}/{pvc.name}"
+            if pv.claim_ref and pv.claim_ref != ours:
+                return  # volume belongs to someone else: stays Pending
+            self._bind(pv, pvc)
+            return
+        # pre-bound by PV side? (a PV claiming this PVC)
+        for pv in self.cluster.list("persistentvolumes"):
+            if pv.claim_ref == f"{ns}/{name}":
+                self._bind(pv, pvc)
+                return
+        match = self._find_best_match(pvc)
+        if match is not None:
+            self._bind(match, pvc)
+            return
+        sc = None
+        for s in self.cluster.list("storageclasses"):
+            if s.name == pvc.storage_class:
+                sc = s
+                break
+        if sc is None or not sc.provisioner:
+            return  # stays Pending until a PV appears
+        if sc.binding_mode == WAIT_FOR_FIRST_CONSUMER:
+            node = self._selected_node(pvc)
+            if not node:
+                return  # scheduler hasn't picked a node yet
+        else:
+            node = ""
+        pv = self._provision(pvc, sc, node)
+        pv.claim_ref = f"{ns}/{name}"  # pre-bind to the provoking claim
+        try:
+            self.cluster.create("persistentvolumes", pv)
+        except ConflictError:
+            return  # raced another worker; requeue via events
+        self._bind(pv, pvc)
+
+    def _bind(self, pv: PersistentVolume, pvc: PersistentVolumeClaim) -> None:
+        """The two-object transaction (bindVolumeToClaim +
+        bindClaimToVolume): PV first, claim second — a crash in between
+        leaves a pre-bound PV that _sync_claim's pre-bound check heals."""
+        if pv.claim_ref != f"{pvc.namespace}/{pvc.name}" or pv.phase != "Bound":
+            self.cluster.update(
+                "persistentvolumes",
+                dataclasses.replace(
+                    pv, claim_ref=f"{pvc.namespace}/{pvc.name}",
+                    phase="Bound"))
+        self.cluster.update(
+            "persistentvolumeclaims",
+            dataclasses.replace(pvc, volume_name=pv.name, phase="Bound"))
+
+    # ------------------------------------------------------------ volumes
+
+    def _sync_volume(self, name: str) -> None:
+        pv = self.cluster.get("persistentvolumes", "", name)
+        if pv is None:
+            return
+        if not pv.claim_ref:
+            if pv.phase not in ("Available", "Released"):
+                self.cluster.update(
+                    "persistentvolumes",
+                    dataclasses.replace(pv, phase="Available"))
+            # a newly Available volume may satisfy a Pending claim: re-sync
+            # matching unbound claims (pv_controller_base.go enqueues
+            # claims on volume events for exactly this)
+            for pvc in self.cluster.list("persistentvolumeclaims"):
+                if not pvc.volume_name and pvc.storage_class == pv.storage_class:
+                    self.queue.add(("claim", pvc.namespace, pvc.name))
+            return
+        ns, _, claim_name = pv.claim_ref.partition("/")
+        pvc = self.cluster.get("persistentvolumeclaims", ns, claim_name)
+        if pvc is not None:
+            if pvc.volume_name == "":
+                # statically pre-bound PV arriving after its claim: finish
+                # the binding from the claim side (syncVolume enqueues the
+                # claim for exactly this case)
+                self.queue.add(("claim", ns, claim_name))
+                return
+            if pvc.volume_name == pv.name:
+                return  # live binding
+            # the claim bound to a DIFFERENT volume: this never-used PV
+            # goes back to Available, not to reclaim (syncVolume unbinds)
+            self.cluster.update(
+                "persistentvolumes",
+                dataclasses.replace(pv, claim_ref="", phase="Available"))
+            return
+        # bound claim is gone: reclaim (reclaimVolume)
+        if pv.reclaim_policy == "Delete":
+            self.cluster.delete("persistentvolumes", "", pv.name)
+        else:  # Retain: keep the data, mark Released (needs admin action)
+            self.cluster.update(
+                "persistentvolumes",
+                dataclasses.replace(pv, phase="Released"))
+
+    def sync(self, key) -> None:
+        what, ns, name = key
+        if what == "claim":
+            self._sync_claim(ns, name)
+        else:
+            self._sync_volume(name)
+
+
+class AttachDetachController(Reconciler):
+    """Desired attachments from assigned pods -> node.status.volumesAttached
+    (attach_detach_controller.go reconciler, collapsed: the framework has
+    no real attach operation, so desired state IS actual state)."""
+
+    WATCH_KINDS = ("pods", "nodes", "persistentvolumeclaims")
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "pods":
+            if obj.spec.node_name:
+                self.queue.add(obj.spec.node_name)
+        elif kind == "nodes" and event != DELETED:
+            self.queue.add(obj.name)
+        elif kind == "persistentvolumeclaims":
+            # (re)bound claim changes which PV a pod's volume resolves to —
+            # only nodes running pods that actually reference THIS claim
+            for p in self.cluster.list("pods"):
+                if p.namespace != obj.namespace or not p.spec.node_name:
+                    continue
+                if any((v.get("persistentVolumeClaim") or {}).get(
+                        "claimName") == obj.name for v in p.spec.volumes):
+                    self.queue.add(p.spec.node_name)
+
+    def _desired_for_node(self, node_name: str) -> Tuple[str, ...]:
+        attached: List[str] = []
+        for p in self.cluster.list("pods"):
+            if p.spec.node_name != node_name:
+                continue
+            if p.status.phase in ("Succeeded", "Failed"):
+                continue
+            for v in p.spec.volumes:
+                claim = (v.get("persistentVolumeClaim") or {})
+                cn = claim.get("claimName")
+                if cn:
+                    pvc = self.cluster.get(
+                        "persistentvolumeclaims", p.namespace, cn)
+                    if pvc is not None and pvc.volume_name:
+                        attached.append(pvc.volume_name)
+        return tuple(sorted(set(attached)))
+
+    def sync(self, node_name: str) -> None:
+        node, rv = self.cluster.get_with_rv("nodes", "", node_name)
+        if node is None:
+            return
+        desired = self._desired_for_node(node_name)
+        if tuple(node.status.volumes_attached) == desired:
+            return
+        self.cluster.update(
+            "nodes",
+            dataclasses.replace(
+                node, status=dataclasses.replace(
+                    node.status, volumes_attached=desired)),
+            expect_rv=rv,
+        )
+
+
+class ServiceAccountController(Reconciler):
+    """Every active namespace carries a 'default' ServiceAccount
+    (serviceaccounts_controller.go)."""
+
+    WATCH_KINDS = ("namespaces", "serviceaccounts")
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "namespaces":
+            ns = obj.get("name") if isinstance(obj, dict) else obj.name
+            self.queue.add(ns)
+        elif kind == "serviceaccounts" and event == DELETED:
+            self.queue.add(obj.get("namespace", "default"))
+
+    def sync(self, ns: str) -> None:
+        nso = self.cluster.get("namespaces", "", ns)
+        if nso is None:
+            return
+        phase = (nso.get("status") or {}).get("phase", "Active") \
+            if isinstance(nso, dict) else "Active"
+        if phase == "Terminating":
+            return
+        if self.cluster.get("serviceaccounts", ns, "default") is None:
+            try:
+                self.cluster.create("serviceaccounts", {
+                    "namespace": ns, "name": "default",
+                    "kind": "ServiceAccount", "apiVersion": "v1",
+                    "metadata": {"namespace": ns, "name": "default"},
+                })
+            except ConflictError:
+                pass
+
+
+class TokenController(Reconciler):
+    """Every ServiceAccount gets a token Secret; deleting the SA reaps its
+    secrets (tokens_controller.go).  The minted secret is exactly what
+    TokenAuthenticator resolves to system:serviceaccount:<ns>:<name>."""
+
+    WATCH_KINDS = ("serviceaccounts", "secrets")
+
+    @staticmethod
+    def _secret_name(sa_name: str) -> str:
+        return f"{sa_name}-token"
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "serviceaccounts":
+            self.queue.add((obj.get("namespace", "default"),
+                            obj.get("name", "")))
+        elif kind == "secrets" and event == DELETED:
+            if obj.get("type") == "kubernetes.io/service-account-token":
+                sa = (obj.get("data") or {}).get("serviceAccountName", "")
+                if sa:
+                    self.queue.add((obj.get("namespace", "default"), sa))
+
+    def sync(self, key) -> None:
+        ns, name = key
+        sa = self.cluster.get("serviceaccounts", ns, name)
+        secret_name = self._secret_name(name)
+        if sa is None:
+            # SA deleted: reap its token secrets
+            cur = self.cluster.get("secrets", ns, secret_name)
+            if cur is not None:
+                self.cluster.delete("secrets", ns, secret_name)
+            return
+        if self.cluster.get("secrets", ns, secret_name) is not None:
+            return
+        try:
+            self.cluster.create("secrets", {
+                "namespace": ns, "name": secret_name,
+                "kind": "Secret", "apiVersion": "v1",
+                "type": "kubernetes.io/service-account-token",
+                "metadata": {"namespace": ns, "name": secret_name},
+                "annotations": {
+                    "kubernetes.io/service-account.name": name,
+                },
+                "data": {
+                    "token": _secrets.token_hex(16),
+                    "namespace": ns,
+                    "serviceAccountName": name,
+                },
+            })
+        except ConflictError:
+            pass

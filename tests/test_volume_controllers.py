@@ -1,0 +1,315 @@
+"""Volume + serviceaccount controllers (runtime/volumecontrollers.py).
+
+Reference: pkg/controller/volume/persistentvolume/pv_controller.go,
+attachdetach/attach_detach_controller.go,
+serviceaccount/{serviceaccounts,tokens}_controller.go."""
+
+import dataclasses
+import time
+
+from kubernetes_tpu.api.storage import (
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+)
+from kubernetes_tpu.runtime.cluster import LocalCluster
+from kubernetes_tpu.runtime.volumecontrollers import (
+    AttachDetachController,
+    PersistentVolumeController,
+    ServiceAccountController,
+    TokenController,
+)
+
+from fixtures import make_node, make_pod
+
+
+def _drain(ctrl, n=50):
+    for _ in range(n):
+        if not ctrl.process_one(timeout=0.01):
+            break
+
+
+def _pv(name, size="10Gi", sc="", modes=("ReadWriteOnce",), **kw):
+    return PersistentVolume.from_dict({
+        "metadata": {"name": name},
+        "spec": {"capacity": {"storage": size},
+                 "accessModes": list(modes),
+                 "storageClassName": sc,
+                 "gcePersistentDisk": {"pdName": name}, **kw},
+    })
+
+
+def _pvc(name, ns="default", size="5Gi", sc="", modes=("ReadWriteOnce",)):
+    return PersistentVolumeClaim.from_dict({
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"resources": {"requests": {"storage": size}},
+                 "accessModes": list(modes),
+                 "storageClassName": sc},
+    })
+
+
+def test_pv_controller_binds_smallest_fitting_volume():
+    cluster = LocalCluster()
+    ctrl = PersistentVolumeController(cluster)
+    cluster.create("persistentvolumes", _pv("big", "100Gi"))
+    cluster.create("persistentvolumes", _pv("small", "10Gi"))
+    cluster.create("persistentvolumes", _pv("tiny", "1Gi"))
+    cluster.create("persistentvolumeclaims", _pvc("c1", size="5Gi"))
+    _drain(ctrl)
+    pvc = cluster.get("persistentvolumeclaims", "default", "c1")
+    assert pvc.volume_name == "small"       # smallest that fits, not "big"
+    assert pvc.phase == "Bound"
+    pv = cluster.get("persistentvolumes", "", "small")
+    assert pv.phase == "Bound" and pv.claim_ref == "default/c1"
+    # the others stay Available
+    assert cluster.get("persistentvolumes", "", "big").phase == "Available"
+
+
+def test_pv_controller_respects_class_and_access_modes():
+    cluster = LocalCluster()
+    ctrl = PersistentVolumeController(cluster)
+    cluster.create("persistentvolumes", _pv("gold-pv", sc="gold"))
+    cluster.create("persistentvolumes",
+                   _pv("rox", modes=("ReadOnlyMany",)))
+    cluster.create("persistentvolumeclaims", _pvc("c1"))  # class ""
+    _drain(ctrl)
+    # neither matches: gold-pv wrong class, rox wrong modes
+    assert cluster.get(
+        "persistentvolumeclaims", "default", "c1").volume_name == ""
+    # a matching PV arriving later binds on its event
+    cluster.create("persistentvolumes", _pv("plain"))
+    _drain(ctrl)
+    assert cluster.get(
+        "persistentvolumeclaims", "default", "c1").volume_name == "plain"
+
+
+def test_reclaim_policy_on_claim_deletion():
+    cluster = LocalCluster()
+    ctrl = PersistentVolumeController(cluster)
+    retain = _pv("keepme")
+    delete = dataclasses.replace(_pv("dropme"), reclaim_policy="Delete")
+    cluster.create("persistentvolumes", retain)
+    cluster.create("persistentvolumes", delete)
+    cluster.create("persistentvolumeclaims", _pvc("c1"))
+    cluster.create("persistentvolumeclaims", _pvc("c2"))
+    _drain(ctrl)
+    c1 = cluster.get("persistentvolumeclaims", "default", "c1")
+    c2 = cluster.get("persistentvolumeclaims", "default", "c2")
+    assert {c1.volume_name, c2.volume_name} == {"keepme", "dropme"}
+    cluster.delete("persistentvolumeclaims", "default", "c1")
+    cluster.delete("persistentvolumeclaims", "default", "c2")
+    _drain(ctrl)
+    kept = cluster.get("persistentvolumes", "", "keepme")
+    assert kept is not None and kept.phase == "Released"   # Retain
+    assert cluster.get("persistentvolumes", "", "dropme") is None  # Delete
+
+
+def test_dynamic_provisioning_immediate_mode():
+    cluster = LocalCluster()
+    ctrl = PersistentVolumeController(cluster)
+    cluster.create("storageclasses", StorageClass.from_dict({
+        "metadata": {"name": "fast"}, "provisioner": "csi.example.com",
+    }))
+    cluster.create("persistentvolumeclaims", _pvc("c1", sc="fast"))
+    _drain(ctrl)
+    pvc = cluster.get("persistentvolumeclaims", "default", "c1")
+    assert pvc.volume_name and pvc.phase == "Bound"
+    pv = cluster.get("persistentvolumes", "", pvc.volume_name)
+    assert pv.csi_driver == "csi.example.com"
+    assert pv.reclaim_policy == "Delete"   # provisioned volumes get Delete
+    # ... and the claim's deletion reclaims the provisioned PV
+    cluster.delete("persistentvolumeclaims", "default", "c1")
+    _drain(ctrl)
+    assert cluster.get("persistentvolumes", "", pvc.volume_name) is None
+
+
+def test_wffc_provisioning_waits_for_scheduler_then_binds():
+    """The dynamic-provisioning e2e VERDICT asked for: a pod with an
+    unbound WaitForFirstConsumer claim schedules (CheckVolumeBinding
+    allows provisioner classes), then the PV controller provisions a PV
+    pinned to the chosen node and binds the claim."""
+    from kubernetes_tpu.cmd.base import build_wired_scheduler
+
+    cluster = LocalCluster()
+    sched = build_wired_scheduler(cluster)
+    ctrl = PersistentVolumeController(cluster)
+    cluster.add_node(make_node("n1", cpu="4", mem="8Gi"))
+    cluster.create("storageclasses", StorageClass.from_dict({
+        "metadata": {"name": "wffc"}, "provisioner": "csi.example.com",
+        "volumeBindingMode": "WaitForFirstConsumer",
+    }))
+    cluster.create("persistentvolumeclaims", _pvc("data", sc="wffc"))
+    _drain(ctrl)
+    # no pod yet -> no provisioning
+    assert cluster.get(
+        "persistentvolumeclaims", "default", "data").volume_name == ""
+    pod = make_pod("p1", cpu="100m", mem="64Mi")
+    pod = dataclasses.replace(pod, spec=dataclasses.replace(
+        pod.spec,
+        volumes=({"persistentVolumeClaim": {"claimName": "data"}},)))
+    cluster.add_pod(pod)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        sched.run_once(timeout=0.3)
+        p = cluster.get("pods", "default", "p1")
+        if p is not None and p.spec.node_name:
+            break
+    p = cluster.get("pods", "default", "p1")
+    assert p.spec.node_name == "n1"        # scheduled despite unbound claim
+    _drain(ctrl)
+    pvc = cluster.get("persistentvolumeclaims", "default", "data")
+    assert pvc.volume_name and pvc.phase == "Bound"
+    pv = cluster.get("persistentvolumes", "", pvc.volume_name)
+    # provisioned PV is pinned to the scheduler's node pick
+    terms = pv.node_affinity.terms
+    assert terms[0].match_expressions[0].values == ("n1",)
+
+
+def test_attach_detach_surfaces_volumes_attached():
+    cluster = LocalCluster()
+    pvctrl = PersistentVolumeController(cluster)
+    ad = AttachDetachController(cluster)
+    cluster.add_node(make_node("n1", cpu="4", mem="8Gi"))
+    cluster.create("persistentvolumes", _pv("disk1"))
+    cluster.create("persistentvolumeclaims", _pvc("c1"))
+    _drain(pvctrl)
+    pod = make_pod("p1", cpu="100m", mem="64Mi")
+    pod = dataclasses.replace(pod, spec=dataclasses.replace(
+        pod.spec, node_name="n1",
+        volumes=({"persistentVolumeClaim": {"claimName": "c1"}},)))
+    cluster.add_pod(pod)
+    _drain(ad)
+    node = cluster.get("nodes", "", "n1")
+    assert node.status.volumes_attached == ("disk1",)
+    # pod leaves -> volume detaches
+    cluster.delete("pods", "default", "p1")
+    _drain(ad)
+    assert cluster.get("nodes", "", "n1").status.volumes_attached == ()
+
+
+def test_serviceaccount_and_token_controllers():
+    cluster = LocalCluster()
+    sactrl = ServiceAccountController(cluster)
+    tkctrl = TokenController(cluster)
+    cluster.create("namespaces", {"namespace": "", "name": "team"})
+    _drain(sactrl)
+    sa = cluster.get("serviceaccounts", "team", "default")
+    assert sa is not None
+    _drain(tkctrl)
+    secret = cluster.get("secrets", "team", "default-token")
+    assert secret is not None
+    assert secret["type"] == "kubernetes.io/service-account-token"
+    tok = secret["data"]["token"]
+    # the minted token authenticates as the SA identity
+    from kubernetes_tpu.apiserver.auth import TokenAuthenticator
+
+    user = TokenAuthenticator(cluster).authenticate(tok)
+    assert user.name == "system:serviceaccount:team:default"
+    # deleting the SA reaps its token secret
+    cluster.delete("serviceaccounts", "team", "default")
+    _drain(tkctrl)
+    assert cluster.get("secrets", "team", "default-token") is None
+
+
+def test_pv_pvc_rest_round_trip():
+    import json
+    import urllib.request
+
+    from kubernetes_tpu.apiserver import APIServer
+
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster).start()
+    try:
+        u = srv.url
+        body = json.dumps({
+            "kind": "PersistentVolume", "apiVersion": "v1",
+            "metadata": {"name": "pv1"},
+            "spec": {"capacity": {"storage": "10Gi"},
+                     "accessModes": ["ReadWriteOnce"],
+                     "gcePersistentDisk": {"pdName": "pv1"}},
+        }).encode()
+        req = urllib.request.Request(f"{u}/api/v1/persistentvolumes",
+                                     data=body, method="POST")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 201
+        with urllib.request.urlopen(
+                f"{u}/api/v1/persistentvolumes/pv1", timeout=5) as resp:
+            d = json.loads(resp.read())
+        from kubernetes_tpu.api.resource import parse_quantity
+
+        assert float(parse_quantity(d["spec"]["capacity"]["storage"])) == \
+            float(parse_quantity("10Gi"))
+        assert d["spec"]["persistentVolumeReclaimPolicy"] == "Retain"
+        body = json.dumps({
+            "kind": "PersistentVolumeClaim", "apiVersion": "v1",
+            "metadata": {"name": "c1", "namespace": "default"},
+            "spec": {"resources": {"requests": {"storage": "5Gi"}},
+                     "accessModes": ["ReadWriteOnce"]},
+        }).encode()
+        req = urllib.request.Request(
+            f"{u}/api/v1/namespaces/default/persistentvolumeclaims",
+            data=body, method="POST")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 201
+        assert cluster.get(
+            "persistentvolumeclaims", "default", "c1") is not None
+    finally:
+        srv.stop()
+
+
+def test_prebound_pvc_claims_the_pv_side():
+    """A user-pre-bound PVC (spec.volumeName) must bind the PV too, or a
+    second claim can steal the volume (syncUnboundClaim volumeName arm)."""
+    cluster = LocalCluster()
+    ctrl = PersistentVolumeController(cluster)
+    cluster.create("persistentvolumes", _pv("pv1"))
+    pvc_a = PersistentVolumeClaim.from_dict({
+        "metadata": {"name": "a", "namespace": "default"},
+        "spec": {"volumeName": "pv1", "accessModes": ["ReadWriteOnce"],
+                 "resources": {"requests": {"storage": "1Gi"}}},
+    })
+    cluster.create("persistentvolumeclaims", pvc_a)
+    _drain(ctrl)
+    pv = cluster.get("persistentvolumes", "", "pv1")
+    assert pv.phase == "Bound" and pv.claim_ref == "default/a"
+    # a second claim can no longer match pv1
+    cluster.create("persistentvolumeclaims", _pvc("b", size="1Gi"))
+    _drain(ctrl)
+    assert cluster.get(
+        "persistentvolumeclaims", "default", "b").volume_name == ""
+
+
+def test_prebound_pv_after_claim_completes_binding():
+    """A statically pre-bound PV (spec.claimRef) created AFTER its claim
+    must complete the binding (syncVolume enqueues the claim)."""
+    cluster = LocalCluster()
+    ctrl = PersistentVolumeController(cluster)
+    cluster.create("persistentvolumeclaims", _pvc("x", sc="manual"))
+    _drain(ctrl)
+    assert cluster.get(
+        "persistentvolumeclaims", "default", "x").volume_name == ""
+    pv = dataclasses.replace(_pv("pvx", sc="manual"),
+                             claim_ref="default/x")
+    cluster.create("persistentvolumes", pv)
+    _drain(ctrl)
+    pvc = cluster.get("persistentvolumeclaims", "default", "x")
+    assert pvc.volume_name == "pvx" and pvc.phase == "Bound"
+
+
+def test_prebound_pv_whose_claim_bound_elsewhere_resets_available():
+    """claimRef pointing at a claim that bound another volume: the unused
+    PV resets to Available — NOT reclaimed (no data loss)."""
+    cluster = LocalCluster()
+    ctrl = PersistentVolumeController(cluster)
+    cluster.create("persistentvolumes", _pv("pv-b"))
+    cluster.create("persistentvolumeclaims", _pvc("x", size="1Gi"))
+    _drain(ctrl)
+    assert cluster.get(
+        "persistentvolumeclaims", "default", "x").volume_name == "pv-b"
+    stray = dataclasses.replace(_pv("pv-a"), claim_ref="default/x",
+                                reclaim_policy="Delete")
+    cluster.create("persistentvolumes", stray)
+    _drain(ctrl)
+    pv_a = cluster.get("persistentvolumes", "", "pv-a")
+    assert pv_a is not None                 # NOT deleted despite Delete
+    assert pv_a.phase == "Available" and pv_a.claim_ref == ""
