@@ -446,5 +446,6 @@ def wire_scheduler_informers(factory: SharedInformerFactory,
         queue.move_all_to_active()
 
     factory.informer("storageclasses").add_event_handler(
-        on_add=sc_upsert, on_update=lambda _o, s: sc_upsert(s))
+        on_add=sc_upsert, on_update=lambda _o, s: sc_upsert(s),
+        on_delete=lambda s: cache.encoder.remove_storage_class(s.name))
     return factory

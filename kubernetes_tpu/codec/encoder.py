@@ -1044,6 +1044,10 @@ class SnapshotEncoder:
         self.storage_classes[sc.name] = sc
         self.generation += 1
 
+    def remove_storage_class(self, name: str) -> None:
+        self.storage_classes.pop(name, None)
+        self.generation += 1
+
     def _rows_matching_pv_topology(self, pv) -> List[int]:
         """Node rows compatible with a PV's nodeAffinity (exact host-side
         evaluation — ref volumebinder checking PV.spec.nodeAffinity)."""

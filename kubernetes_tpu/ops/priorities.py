@@ -249,6 +249,24 @@ def pod_group_onehot(pods: PodBatch, n_groups: int):
     ).astype(jnp.float32).sum(axis=1)
 
 
+def pod_spread_match(pods: PodBatch, n_groups: int):
+    """f32[B, B] [i, j]: committing pod j raises pod i's spread count at
+    j's node — i.e. j matches ALL of i's selectors, expressed as "i's
+    group set is a subset of j's" over the one-hots (groups are
+    namespace-scoped, so the ns check rides along).  countMatchingPods
+    AND semantics (selector_spreading.go:95-140); shared by BOTH engines
+    so their in-batch bookkeeping can never desync."""
+    from jax import lax as _lax
+
+    onehot = pod_group_onehot(pods, n_groups)                # [B, G]
+    has_groups = jnp.any(pods.group_valid, axis=1)           # [B]
+    return (
+        has_groups[:, None]
+        & (jnp.matmul(onehot, (1.0 - onehot).T,
+                      precision=_lax.Precision.HIGHEST) == 0)
+    ).astype(jnp.float32)
+
+
 def selector_spread(cluster: ClusterTensors, pods: PodBatch, zone_key_id: int = 5):
     """SelectorSpreadPriority (priorities/selector_spreading.go:77-140):
     per-node counts of existing pods matching ALL the pod's selectors

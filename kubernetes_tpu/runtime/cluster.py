@@ -360,7 +360,11 @@ def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
                 cache.encoder.add_pvc(obj)
             queue.move_all_to_active()
         elif kind == "storageclasses":
-            if event != DELETED:
+            if event == DELETED:
+                # a dead provisioner must stop admitting WFFC pods through
+                # CheckVolumeBinding's dynamic-provisioning branch
+                cache.encoder.remove_storage_class(obj.name)
+            else:
                 cache.encoder.add_storage_class(obj)
                 queue.move_all_to_active()
 

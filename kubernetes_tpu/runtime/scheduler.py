@@ -32,6 +32,7 @@ from kubernetes_tpu.models.batched import (
     encode_batch_affinity,
     encode_batch_ports,
     encode_nominated,
+    encode_nominated_block,
     make_sequential_scheduler,
 )
 from kubernetes_tpu.models.preemption import (
@@ -200,15 +201,19 @@ class Scheduler:
             ports = encode_batch_ports(enc, pods)
             # two-pass evaluation: nominated pods (other than those being
             # scheduled now) are added to their nominated nodes in pass one
-            nominated = encode_nominated(
-                enc,
-                [
-                    (p, n)
-                    for p, n in self.queue.nominated_pods()
-                    if (p.namespace, p.name) not in batch_keys
-                ],
-            )
+            nominated_pairs = [
+                (p, n)
+                for p, n in self.queue.nominated_pods()
+                if (p.namespace, p.name) not in batch_keys
+            ]
+            nominated = encode_nominated(enc, nominated_pairs)
             cluster, generation = self.cache.snapshot()
+            # ports + anti-affinity contributions of nominated pods (the
+            # non-resource half of podFitsOnNode's pass one) as a host
+            # mask folded into extra_mask below
+            nom_block = encode_nominated_block(
+                enc, nominated_pairs, pods, batch.n_pods, cluster.n_nodes,
+            )
             # point-in-time name->row map consistent with THIS snapshot;
             # extender round-trips below run outside the lock, and the live
             # node_rows dict may be mutated (rows recycled/regrown) meanwhile
@@ -248,6 +253,11 @@ class Scheduler:
                 pods, node_row_map, cluster, extra_mask, extra_score
             )
             trace.step("extenders")
+        if nom_block is not None:
+            # pass-one infeasibility from nominated ports/anti-affinity
+            extra_mask = (
+                ~nom_block if extra_mask is None else (extra_mask & ~nom_block)
+            )
         fn = self._schedule_fn
         if (
             self._speculative_fn is not None

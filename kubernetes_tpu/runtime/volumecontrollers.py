@@ -74,10 +74,13 @@ class PersistentVolumeController(Reconciler):
 
     # ------------------------------------------------------------- claims
 
-    def _find_best_match(self, pvc: PersistentVolumeClaim
-                         ) -> Optional[PersistentVolume]:
+    def _find_best_match(self, pvc: PersistentVolumeClaim,
+                         node_name: str = "") -> Optional[PersistentVolume]:
         """Smallest Available PV satisfying class/modes/capacity
-        (findBestMatchForClaim)."""
+        (findBestMatchForClaim); with node_name (the WFFC selected node),
+        topology-pinned PVs must admit that node."""
+        node = (self.cluster.get("nodes", "", node_name)
+                if node_name else None)
         best = None
         for pv in self.cluster.list("persistentvolumes"):
             if pv.phase != "Available" or pv.claim_ref:
@@ -86,6 +89,14 @@ class PersistentVolumeController(Reconciler):
                 continue
             if not _access_modes_satisfied(pv, pvc):
                 continue
+            if node is not None and pv.node_affinity is not None:
+                from kubernetes_tpu.cpuref.reference import (
+                    match_node_selector_term,
+                )
+
+                if not any(match_node_selector_term(t, node)
+                           for t in pv.node_affinity.terms):
+                    continue
             if pvc.request is not None:
                 if pv.capacity is None or float(pv.capacity) < float(pvc.request):
                     continue
@@ -160,28 +171,34 @@ class PersistentVolumeController(Reconciler):
                 return  # volume belongs to someone else: stays Pending
             self._bind(pv, pvc)
             return
-        # pre-bound by PV side? (a PV claiming this PVC)
+        # pre-bound by PV side? (a PV claiming this PVC).  A Released
+        # volume keeps its old claimRef for the admin — a NEW claim with
+        # the same ns/name must NOT silently inherit it (and its data);
+        # the reference compares claimRef UID for the same reason.
         for pv in self.cluster.list("persistentvolumes"):
-            if pv.claim_ref == f"{ns}/{name}":
+            if pv.claim_ref == f"{ns}/{name}" and pv.phase != "Released":
                 self._bind(pv, pvc)
                 return
-        match = self._find_best_match(pvc)
-        if match is not None:
-            self._bind(match, pvc)
-            return
         sc = None
         for s in self.cluster.list("storageclasses"):
             if s.name == pvc.storage_class:
                 sc = s
                 break
-        if sc is None or not sc.provisioner:
-            return  # stays Pending until a PV appears
-        if sc.binding_mode == WAIT_FOR_FIRST_CONSUMER:
+        node = ""
+        if sc is not None and sc.binding_mode == WAIT_FOR_FIRST_CONSUMER:
+            # delayed binding: NOTHING binds (static or dynamic) until the
+            # scheduler picks a node — binding early to a topology-pinned
+            # PV is exactly the failure WFFC exists to avoid
+            # (syncUnboundClaim's shouldDelayBinding gate)
             node = self._selected_node(pvc)
             if not node:
-                return  # scheduler hasn't picked a node yet
-        else:
-            node = ""
+                return
+        match = self._find_best_match(pvc, node_name=node)
+        if match is not None:
+            self._bind(match, pvc)
+            return
+        if sc is None or not sc.provisioner:
+            return  # stays Pending until a PV appears
         pv = self._provision(pvc, sc, node)
         pv.claim_ref = f"{ns}/{name}"  # pre-bind to the provoking claim
         try:

