@@ -34,6 +34,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional, Tuple
 
+from kubernetes_tpu.api import binary as k8s_binary
 from kubernetes_tpu.api.serialize import object_to_dict
 from kubernetes_tpu.api.types import Node, Pod
 from kubernetes_tpu.runtime.cluster import ConflictError, LocalCluster
@@ -497,10 +498,23 @@ class APIServer:
             def log_message(self, fmt, *args):
                 pass
 
+            def _wants_binary(self) -> bool:
+                return (k8s_binary.BINARY_MEDIA_TYPE
+                        in self.headers.get("Accept", ""))
+
             def _send(self, obj, code: int = 200):
-                body = json.dumps(obj).encode()
+                # content negotiation (protobuf.go analog): clients opt
+                # in to the binary wire format via Accept; default traffic
+                # AND errors stay JSON (error-handling clients parse
+                # Status bodies as JSON regardless of their data Accept)
+                if code < 400 and self._wants_binary():
+                    body = k8s_binary.dumps(obj)
+                    ct = k8s_binary.BINARY_MEDIA_TYPE
+                else:
+                    body = json.dumps(obj).encode()
+                    ct = "application/json"
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ct)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -514,7 +528,11 @@ class APIServer:
 
             def _body(self) -> dict:
                 n = int(self.headers.get("Content-Length", 0))
-                return json.loads(self.rfile.read(n) or b"{}")
+                raw = self.rfile.read(n) or b"{}"
+                if (k8s_binary.BINARY_MEDIA_TYPE
+                        in self.headers.get("Content-Type", "")):
+                    return k8s_binary.loads(raw)
+                return json.loads(raw)
 
             # -------------------------------------------------- authn/authz
 
@@ -766,10 +784,16 @@ class APIServer:
                 self.wfile.write(body)
 
             def _serve_watch(self):
-                """Chunked JSON-lines stream (the watch contract; one line
-                per event, replay-then-follow)."""
+                """Chunked watch stream, replay-then-follow: JSON-lines by
+                default, length-prefixed binary frames when the client
+                Accepts the binary media type (the protobuf watch
+                negotiation analog)."""
+                use_binary = self._wants_binary()
                 self.send_response(200)
-                self.send_header("Content-Type", "application/json")
+                self.send_header(
+                    "Content-Type",
+                    k8s_binary.BINARY_MEDIA_TYPE if use_binary
+                    else "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 q: "_queue.Queue" = _queue.Queue(maxsize=10000)
@@ -794,13 +818,18 @@ class APIServer:
                 # lock: no live event can precede the bookmark (the k8s
                 # watch-bookmark contract the reflector's atomic swap needs)
                 outer.cluster.watch(fan, bookmark=True)
+                def chunk(b: bytes) -> bytes:
+                    return f"{len(b):x}\r\n".encode() + b + b"\r\n"
+
                 try:
                     while not overflow.is_set():
                         try:
                             event, kind, obj, rv = q.get(timeout=1.0)
                         except _queue.Empty:
                             # heartbeat chunk keeps the connection honest
-                            self.wfile.write(b"1\r\n\n\r\n")
+                            self.wfile.write(
+                                chunk(k8s_binary.HEARTBEAT_FRAME) if use_binary
+                                else b"1\r\n\n\r\n")
                             self.wfile.flush()
                             continue
                         payload = {
@@ -813,10 +842,11 @@ class APIServer:
                         }
                         if rv is not None:
                             payload["resourceVersion"] = str(rv)
-                        line = json.dumps(payload).encode() + b"\n"
-                        self.wfile.write(
-                            f"{len(line):x}\r\n".encode() + line + b"\r\n"
-                        )
+                        if use_binary:
+                            body = chunk(k8s_binary.frame(k8s_binary.dumps(payload)))
+                        else:
+                            body = chunk(json.dumps(payload).encode() + b"\n")
+                        self.wfile.write(body)
                         self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass

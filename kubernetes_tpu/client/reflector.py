@@ -51,12 +51,15 @@ class Reflector:
 
     def __init__(self, server: str, mirror: Optional[LocalCluster] = None,
                  backoff: float = 0.5, max_backoff: float = 10.0,
-                 token: str = ""):
+                 token: str = "", binary: bool = False):
         self.server = server.rstrip("/")
         self.mirror = mirror if mirror is not None else LocalCluster()
         self.backoff = backoff
         self.max_backoff = max_backoff
         self.token = token  # bearer credential for RBAC'd planes
+        # negotiate the binary wire format for the watch stream (the
+        # protobuf-for-high-QPS-clients analog, api/binary.py)
+        self.binary = binary
         self.synced = threading.Event()   # set after the first bookmark
         self.resyncs = 0
         self._stop = threading.Event()
@@ -94,22 +97,42 @@ class Reflector:
             time.sleep(delay)
             delay = min(delay * 2, self.max_backoff)
 
+    def _event_stream(self, resp):
+        """Yield decoded event dicts; heartbeats yield None so the caller's
+        stop check still runs ~1/s on an idle stream (a stopped reflector
+        must release its socket and the server's watch fan-out entry
+        promptly, not wait for the next real event)."""
+        if self.binary:
+            from kubernetes_tpu.api import binary as _bin
+
+            for payload in _bin.read_frames(resp, heartbeats=True):
+                yield _bin.loads(payload) if payload is not None else None
+            return
+        for raw in resp:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                yield json.loads(raw)
+            except ValueError:
+                yield None  # heartbeat chunk
+
     def _list_and_watch(self) -> None:
+        headers = _auth_headers(self.token)
+        if self.binary:
+            from kubernetes_tpu.api.binary import BINARY_MEDIA_TYPE
+
+            headers["Accept"] = BINARY_MEDIA_TYPE
         req = urllib.request.Request(
-            self.server + "/api/v1/watch", headers=_auth_headers(self.token))
+            self.server + "/api/v1/watch", headers=headers)
         with urllib.request.urlopen(req, timeout=30) as resp:
             replay: list = []
             in_replay = True
-            for raw in resp:
+            for ev in self._event_stream(resp):
                 if self._stop.is_set():
                     return
-                raw = raw.strip()
-                if not raw:
-                    continue
-                try:
-                    ev = json.loads(raw)
-                except ValueError:
-                    continue  # heartbeat chunk
+                if ev is None:
+                    continue  # heartbeat: only the stop check mattered
                 etype = ev.get("type")
                 if etype == "BOOKMARK":
                     if in_replay:

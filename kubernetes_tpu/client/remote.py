@@ -41,10 +41,13 @@ class RemoteAPIError(RuntimeError):
 class RemoteCluster:
     """LocalCluster-surface client for a remote apiserver."""
 
-    def __init__(self, server: str, token: str = ""):
+    def __init__(self, server: str, token: str = "", binary: bool = False):
         self.server = server.rstrip("/")
         self.token = token
-        self.reflector = Reflector(server, token=token)
+        # binary: negotiate the compact wire format for the watch stream
+        # and write bodies (api/binary.py — the protobuf-client analog)
+        self.binary = binary
+        self.reflector = Reflector(server, token=token, binary=binary)
         self.mirror: LocalCluster = self.reflector.mirror
         # controllers record events locally (tools/record buffers and
         # posts; the buffered recorder is the shared piece)
@@ -92,10 +95,17 @@ class RemoteCluster:
     # -------------------------------------------------------------- writes
 
     def _request(self, method: str, path: str, payload=None) -> dict:
-        data = json.dumps(payload).encode() if payload is not None else None
+        headers = _auth_headers(self.token, json_body=payload is not None)
+        if self.binary and payload is not None:
+            from kubernetes_tpu.api import binary as _bin
+
+            data = _bin.dumps(payload)
+            headers["Content-Type"] = _bin.BINARY_MEDIA_TYPE
+        else:
+            data = (json.dumps(payload).encode()
+                    if payload is not None else None)
         req = urllib.request.Request(
-            self.server + path, data=data, method=method,
-            headers=_auth_headers(self.token, json_body=payload is not None),
+            self.server + path, data=data, method=method, headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:

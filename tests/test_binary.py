@@ -1,0 +1,185 @@
+"""Binary wire format + content negotiation (api/binary.py).
+
+Reference: apimachinery runtime/serializer/protobuf/protobuf.go — the
+k8s\\x00 envelope, negotiated via Accept/Content-Type for the high-QPS
+paths; LIST/WATCH move several times fewer bytes than JSON."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import binary
+from kubernetes_tpu.api.serialize import node_to_dict, pod_to_dict
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.runtime.cluster import LocalCluster
+
+from fixtures import make_node, make_pod
+
+
+# ------------------------------------------------------------------ codec
+
+
+def test_round_trip_values():
+    cases = [
+        None, True, False, 0, 1, -1, 2 ** 40, -(2 ** 40), 3.5, -0.25,
+        "", "hello", "ünïcødé",
+        [], [1, "a", None, [2.5, True]],
+        {}, {"a": 1, "b": {"c": [1, 2, 3]}, "": "empty-key"},
+        b"\x00\xffbytes",
+    ]
+    for v in cases:
+        assert binary.loads(binary.dumps(v)) == v
+
+
+def test_magic_envelope_enforced():
+    with pytest.raises(ValueError):
+        binary.loads(b"{}")
+    assert binary.dumps({})[:4] == binary.MAGIC
+
+
+def test_round_trip_scheme_kinds():
+    """Every registered kind's wire dict survives the binary codec."""
+    from kubernetes_tpu.api import scheme
+
+    node = make_node("n1", cpu="4", mem="8Gi",
+                     labels={"zone": "a"},
+                     taints=[{"key": "k", "value": "v",
+                              "effect": "NoSchedule"}])
+    pod = make_pod("p1", cpu="250m", mem="256Mi",
+                   labels={"app": "web"},
+                   ports=[{"hostPort": 80, "protocol": "TCP"}])
+    for kind, obj in (("nodes", node), ("pods", pod)):
+        wire = scheme.encode(kind, obj)
+        assert binary.loads(binary.dumps(wire)) == wire
+    # dict kinds (rbac, secrets) ride verbatim
+    secret = {"namespace": "ns", "name": "s", "type": "Opaque",
+              "data": {"k": "v"}}
+    assert binary.loads(binary.dumps(secret)) == secret
+
+
+def test_string_table_dedups_repeats():
+    """The per-message string table is where LIST savings come from:
+    repeated keys/values cost a varint, not a full string."""
+    items = [{"metadata": {"name": f"pod-{i}", "namespace": "default"},
+              "spec": {"containers": [{"name": "c", "image": "repo/app:v1"}]}}
+             for i in range(100)]
+    payload = {"kind": "PodList", "items": items}
+    b = binary.dumps(payload)
+    j = json.dumps(payload).encode()
+    assert len(b) < len(j) * 0.5   # >2x smaller on a repetitive LIST
+
+
+# ------------------------------------------------------------ negotiation
+
+
+def _req(url, method="GET", payload=None, accept=None, ct=None):
+    headers = {}
+    data = None
+    if payload is not None:
+        if ct == binary.BINARY_MEDIA_TYPE:
+            data = binary.dumps(payload)
+        else:
+            data = json.dumps(payload).encode()
+        headers["Content-Type"] = ct or "application/json"
+    if accept:
+        headers["Accept"] = accept
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type"), resp.read()
+
+
+def test_rest_negotiation_round_trip():
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster).start()
+    try:
+        u = srv.url
+        # binary POST body
+        code, _ct, _body = _req(
+            f"{u}/api/v1/nodes", "POST",
+            payload=node_to_dict(make_node("n1", cpu="4", mem="8Gi")),
+            ct=binary.BINARY_MEDIA_TYPE)
+        assert code == 201
+        assert cluster.get("nodes", "", "n1") is not None
+        # binary GET via Accept
+        code, ct, body = _req(f"{u}/api/v1/nodes/n1",
+                              accept=binary.BINARY_MEDIA_TYPE)
+        assert code == 200 and ct == binary.BINARY_MEDIA_TYPE
+        d = binary.loads(body)
+        assert d["metadata"]["name"] == "n1"
+        # JSON stays the default
+        code, ct, body = _req(f"{u}/api/v1/nodes/n1")
+        assert ct == "application/json"
+        assert json.loads(body)["metadata"]["name"] == "n1"
+    finally:
+        srv.stop()
+
+
+def test_binary_watch_stream_and_reflector():
+    from kubernetes_tpu.client import Reflector
+
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster).start()
+    try:
+        cluster.add_node(make_node("n1", cpu="4", mem="8Gi"))
+        refl = Reflector(srv.url, binary=True).start()
+        try:
+            assert refl.wait_for_sync(5)
+            assert refl.mirror.get("nodes", "", "n1") is not None
+            # live event over the binary stream
+            cluster.add_pod(make_pod("p1", cpu="100m", mem="64Mi"))
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if refl.mirror.get("pods", "default", "p1") is not None:
+                    break
+                time.sleep(0.02)
+            assert refl.mirror.get("pods", "default", "p1") is not None
+            # remote resourceVersion still round-trips over binary
+            _, rv = refl.mirror.get_with_rv("pods", "default", "p1")
+            _, remote_rv = cluster.get_with_rv("pods", "default", "p1")
+            assert rv == remote_rv
+        finally:
+            refl.stop()
+    finally:
+        srv.stop()
+
+
+def test_list_throughput_json_vs_binary_kubemark_scale():
+    """The measurement VERDICT item 8 asked for: LIST bytes+time at
+    hollow-fleet scale, JSON vs binary.  Asserts the byte win; prints
+    both so the numbers land in CI logs."""
+    cluster = LocalCluster()
+    for i in range(300):
+        cluster.add_node(make_node(f"n{i}", cpu="8", mem="32Gi",
+                                   labels={"zone": f"z{i % 8}"}))
+    for i in range(1500):
+        cluster.add_pod(make_pod(
+            f"p{i}", cpu="100m", mem="64Mi",
+            labels={"app": f"dep-{i % 20}"}, node_name=f"n{i % 300}"))
+    srv = APIServer(cluster=cluster).start()
+    try:
+        u = srv.url
+
+        def fetch(accept=None):
+            t0 = time.monotonic()
+            code, ct, body = _req(f"{u}/api/v1/namespaces/default/pods",
+                                  accept=accept)
+            dt = time.monotonic() - t0
+            assert code == 200
+            return len(body), dt, ct
+
+        jb, jt, _ = fetch()
+        bb, bt, ct = fetch(accept=binary.BINARY_MEDIA_TYPE)
+        assert ct == binary.BINARY_MEDIA_TYPE
+        items = binary.loads(
+            _req(f"{u}/api/v1/namespaces/default/pods",
+                 accept=binary.BINARY_MEDIA_TYPE)[2])["items"]
+        assert len(items) == 1500
+        print(f"\nLIST 1500 pods: json={jb}B/{jt * 1e3:.1f}ms "
+              f"binary={bb}B/{bt * 1e3:.1f}ms "
+              f"({jb / bb:.2f}x smaller)")
+        assert bb < jb * 0.6   # >=1.7x byte win at kubemark scale
+    finally:
+        srv.stop()
