@@ -144,6 +144,7 @@ def pod_to_dict(pod: Pod) -> dict:
         ],
         "priority": pod.spec.priority,
         "volumes": [dict(v) for v in pod.spec.volumes],
+        "serviceAccountName": pod.spec.service_account_name,
     })
     spec["schedulerName"] = pod.spec.scheduler_name
     return {

@@ -378,6 +378,7 @@ class PodSpec:
     priority: int = 0
     scheduler_name: str = "default-scheduler"
     volumes: Tuple[dict, ...] = ()  # raw volume dicts (gcePersistentDisk, ...)
+    service_account_name: str = ""  # injected by ServiceAccount admission
 
     @staticmethod
     def from_dict(d: Optional[dict]) -> "PodSpec":
@@ -396,6 +397,7 @@ class PodSpec:
             priority=int(d.get("priority") or 0),
             scheduler_name=d.get("schedulerName", "default-scheduler"),
             volumes=tuple(d.get("volumes") or ()),
+            service_account_name=d.get("serviceAccountName", ""),
         )
 
 

@@ -423,17 +423,148 @@ class ResourceQuota:
         return obj
 
 
-def default_admission_chain(cluster) -> List[Callable]:
+class NodeRestriction:
+    """Scope a kubelet identity to ITS OWN objects
+    (plugin/pkg/admission/noderestriction/admission.go): a requester named
+    ``system:node:<name>`` in group ``system:nodes`` may only
+
+      * create/update the Node object named <name> (and its status);
+      * create MIRROR pods bound to <name> (the static-pod surfacing
+        path, admission.go:178-210) — never regular pods;
+      * update/delete pods already bound to <name>;
+      * create/update the node lease named <name>.
+
+    RBAC grants the system:nodes GROUP broad verbs; this plugin narrows
+    them per-object, which roles cannot express.  ``user_getter`` reads
+    the authenticated identity the server parked for the request
+    (APIServer.request_user)."""
+
+    MIRROR_ANNOTATION = "kubernetes.io/config.mirror"
+
+    def __init__(self, cluster, user_getter: Callable):
+        self.cluster = cluster
+        self.user_getter = user_getter
+
+    def _node_name(self) -> Optional[str]:
+        user = self.user_getter()
+        if user is None or not user.name.startswith("system:node:"):
+            return None
+        if "system:nodes" not in getattr(user, "groups", ()):
+            return None
+        return user.name[len("system:node:"):]
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        me = self._node_name()
+        if me is None:
+            return obj  # not a kubelet identity: plugin doesn't apply
+        meta = obj.get("metadata") or {}
+        name = obj.get("name") or meta.get("name", "")
+        ns = obj.get("namespace") or meta.get("namespace", "default")
+        if kind == "nodes":
+            if name != me:
+                raise AdmissionDenied(
+                    f"node {me!r} is not allowed to modify node {name!r}")
+            return obj
+        if kind == "leases":
+            # confined to kube-node-lease (admission.go admitLease): a
+            # kubelet named like another component must not be able to
+            # hijack that component's leader-election lease elsewhere
+            if ns != "kube-node-lease" or name != me:
+                raise AdmissionDenied(
+                    f"node {me!r} may only modify its own lease in "
+                    f"kube-node-lease (got {ns}/{name})")
+            return obj
+        if kind == "pods":
+            if op == "CREATE":
+                anns = (meta.get("annotations") or {})
+                if self.MIRROR_ANNOTATION not in anns:
+                    raise AdmissionDenied(
+                        f"node {me!r} may only create mirror pods")
+                bound = (obj.get("spec") or {}).get("nodeName", "")
+                if bound != me:
+                    raise AdmissionDenied(
+                        f"node {me!r} may only create mirror pods bound "
+                        f"to itself (got {bound!r})")
+                return obj
+            # UPDATE (status) / DELETE: only pods bound to this node
+            cur = self.cluster.get("pods", ns, name)
+            bound = cur.spec.node_name if cur is not None else ""
+            if bound != me:
+                raise AdmissionDenied(
+                    f"node {me!r} may only {op.lower()} pods bound to "
+                    f"itself")
+            return obj
+        # other kinds: RBAC already scopes what system:nodes can touch
+        return obj
+
+
+class ServiceAccount:
+    """Inject the default ServiceAccount and require the referenced one
+    to exist (plugin/pkg/admission/serviceaccount/admission.go: empty
+    spec.serviceAccountName becomes "default"; a pod referencing a
+    missing SA is rejected — the SA controller creates 'default' per
+    namespace, so steady-state pods always pass)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind != "pods" or op not in ("CREATE", "UPDATE"):
+            return obj
+        spec = obj.setdefault("spec", {})
+        meta = obj.get("metadata") or {}
+        ns = meta.get("namespace", "default")
+        if op == "UPDATE":
+            # the field is immutable after CREATE (admission.go rejects
+            # spec changes via ValidatePodUpdate); an omitted field on a
+            # read-modify-write body keeps the stored value rather than
+            # silently clearing it
+            cur = self.cluster.get("pods", ns, meta.get("name", ""))
+            cur_sa = (cur.spec.service_account_name
+                      if cur is not None else "")
+            provided = spec.get("serviceAccountName")
+            if provided and cur_sa and provided != cur_sa:
+                raise AdmissionDenied(
+                    "pod updates may not change serviceAccountName "
+                    f"(have {cur_sa!r}, got {provided!r})")
+            if cur_sa:
+                spec["serviceAccountName"] = cur_sa
+            return obj
+        if not spec.get("serviceAccountName"):
+            spec["serviceAccountName"] = "default"
+        sa = spec["serviceAccountName"]
+        if self.cluster.get("serviceaccounts", ns, sa) is None:
+            raise AdmissionDenied(
+                f'service account {ns}/{sa} was not found, retry after '
+                f'the service account is created')
+        return obj
+
+
+def default_admission_chain(cluster, user_getter: Optional[Callable] = None,
+                            with_service_account: bool = False,
+                            ) -> List[Callable]:
     """The enabled-by-default chain in reference order
     (pkg/kubeapiserver/options/plugins.go:43-77: NamespaceLifecycle,
-    LimitRanger, ..., Priority, DefaultTolerationSeconds, TaintNodesBy
-    Condition, ..., ResourceQuota last)."""
-    return [
+    LimitRanger, ServiceAccount, ..., Priority, DefaultTolerationSeconds,
+    TaintNodesByCondition, ..., NodeRestriction, ResourceQuota last).
+
+    NodeRestriction joins when a user_getter is provided (it needs the
+    authenticated request identity — authn must be on); ServiceAccount
+    joins on request (it requires the SA controller to be running, or
+    every pod create fails for want of the default SA)."""
+    chain: List[Callable] = [
         NamespaceLifecycle(cluster),
         LimitRanger(cluster),
+    ]
+    if with_service_account:
+        chain.append(ServiceAccount(cluster))
+    chain += [
         PodNodeSelector(cluster),
         Priority(cluster),
         DefaultTolerationSeconds(),
         TaintNodesByCondition(),
-        ResourceQuota(cluster),
     ]
+    if user_getter is not None:
+        chain.append(NodeRestriction(cluster, user_getter))
+    chain.append(ResourceQuota(cluster))
+    return chain

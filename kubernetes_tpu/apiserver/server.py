@@ -358,6 +358,11 @@ class APIServer:
             self._audit_f.close()
             self._audit_f = None
 
+    def current_user(self):
+        """The authenticated identity of the request being handled on THIS
+        thread (parked by the authn step) — what NodeRestriction consumes."""
+        return getattr(self.request_user, "user", None)
+
     # ----------------------------------------------------------- admission
 
     def _audit(self, verb: str, path: str, code: int) -> None:

@@ -74,11 +74,6 @@ def main(argv=None) -> int:
         cluster = PersistentCluster(args.data_dir)
     else:
         cluster = LocalCluster()
-    admission = None
-    if not args.disable_admission:
-        from kubernetes_tpu.apiserver.admission import default_admission_chain
-
-        admission = default_admission_chain(cluster)
     authn = authz = None
     if args.authorization_mode == "RBAC":
         import secrets as _secrets
@@ -105,10 +100,20 @@ def main(argv=None) -> int:
         else:
             print(f"admin token: {admin_token}", file=sys.stderr)
     srv = APIServer(
-        cluster=cluster, host=args.host, port=args.port, admission=admission,
+        cluster=cluster, host=args.host, port=args.port,
         audit_path=args.audit_log or None,
         authenticator=authn, authorizer=authz,
-    ).start()
+    )
+    if not args.disable_admission:
+        # one chain, built once the server exists: with authn on, kubelet
+        # identities additionally get NodeRestriction's per-object scoping
+        from kubernetes_tpu.apiserver.admission import default_admission_chain
+
+        srv.admission = default_admission_chain(
+            cluster,
+            user_getter=srv.current_user if authn is not None else None,
+        )
+    srv.start()
     print(f"apiserver on {srv.url}", file=sys.stderr)
 
     sched = cm = None

@@ -150,10 +150,27 @@ def cmd_init(args) -> int:
     authn = TokenAuthenticator(cluster)
     srv = APIServer(
         cluster=cluster, host=args.host, port=args.port,
-        admission=default_admission_chain(cluster),
         authenticator=authn,
         authorizer=RBACAuthorizer(cluster),
-    ).start()
+    )
+    # the full production chain: ServiceAccount admission (the SA/token
+    # controllers run below) + NodeRestriction (kubelet identities only
+    # touch their own objects)
+    srv.admission = default_admission_chain(
+        cluster, user_getter=srv.current_user, with_service_account=True,
+    )
+    # system namespaces (the apiserver auto-creates these in the
+    # reference): the SA controller then mints each one's default SA,
+    # which ServiceAccount admission requires for pod creates
+    from kubernetes_tpu.runtime.cluster import ConflictError
+
+    for ns_name in ("default", "kube-system", "kube-public",
+                    "kube-node-lease"):
+        try:
+            cluster.create("namespaces", {"namespace": "", "name": ns_name})
+        except ConflictError:
+            pass
+    srv.start()
     klog.infof("[init] control plane up at %s (RBAC on)", srv.url)
 
     sched = build_wired_scheduler(cluster, load_component_config(args.config))

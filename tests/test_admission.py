@@ -396,3 +396,142 @@ def test_priority_immutable_on_update():
     upd2 = _pod_dict("a")          # omitted -> stored value re-injected
     out = p("UPDATE", "pods", upd2)
     assert out["spec"]["priority"] == 0
+
+
+def test_node_restriction_scopes_kubelet_to_own_objects():
+    """noderestriction/admission.go: system:node:<n> may only touch its
+    own node/lease and pods bound to itself; regular pod creates denied
+    (mirror pods bound to itself allowed)."""
+    from kubernetes_tpu.apiserver.admission import NodeRestriction
+    from kubernetes_tpu.apiserver.auth import UserInfo
+    from fixtures import make_pod
+
+    cluster = LocalCluster()
+    cluster.add_pod(make_pod("mine", node_name="n1"))
+    cluster.add_pod(make_pod("other", node_name="n2"))
+    user = [UserInfo("system:node:n1", ("system:nodes",))]
+    plugin = NodeRestriction(cluster, lambda: user[0])
+
+    # own node ok; other node denied
+    assert plugin("UPDATE", "nodes", {"metadata": {"name": "n1"}})
+    with pytest.raises(AdmissionDenied):
+        plugin("UPDATE", "nodes", {"metadata": {"name": "n2"}})
+    # own lease ok; other denied
+    assert plugin("UPDATE", "leases",
+                  {"namespace": "kube-node-lease", "name": "n1"})
+    with pytest.raises(AdmissionDenied):
+        plugin("UPDATE", "leases",
+               {"namespace": "kube-node-lease", "name": "n2"})
+    # a lease named like the node but OUTSIDE kube-node-lease is denied
+    # (leader-election hijack guard)
+    with pytest.raises(AdmissionDenied):
+        plugin("UPDATE", "leases", {"namespace": "kube-system", "name": "n1"})
+    # pod status update: own-bound ok, other denied
+    assert plugin("UPDATE", "pods",
+                  {"metadata": {"name": "mine", "namespace": "default"}})
+    with pytest.raises(AdmissionDenied):
+        plugin("UPDATE", "pods",
+               {"metadata": {"name": "other", "namespace": "default"}})
+    with pytest.raises(AdmissionDenied):
+        plugin("DELETE", "pods",
+               {"metadata": {"name": "other", "namespace": "default"}})
+    # regular pod create denied; mirror pod bound to self allowed
+    with pytest.raises(AdmissionDenied):
+        plugin("CREATE", "pods", _pod_dict("new"))
+    mirror = _pod_dict("static-web")
+    mirror["metadata"]["annotations"] = {
+        "kubernetes.io/config.mirror": "hash"}
+    mirror["spec"]["nodeName"] = "n1"
+    assert plugin("CREATE", "pods", mirror)
+    mirror2 = dict(mirror)
+    mirror2["spec"] = dict(mirror["spec"], nodeName="n2")
+    with pytest.raises(AdmissionDenied):
+        plugin("CREATE", "pods", mirror2)
+    # a non-kubelet identity passes through untouched
+    user[0] = UserInfo("alice", ("system:authenticated",))
+    assert plugin("UPDATE", "nodes", {"metadata": {"name": "n2"}})
+
+
+def test_service_account_admission_injects_and_requires():
+    """serviceaccount/admission.go: empty serviceAccountName -> default;
+    referencing a missing SA denies until the controller creates it."""
+    from kubernetes_tpu.apiserver.admission import ServiceAccount
+
+    cluster = LocalCluster()
+    plugin = ServiceAccount(cluster)
+    with pytest.raises(AdmissionDenied):   # no default SA yet
+        plugin("CREATE", "pods", _pod_dict("a"))
+    cluster.create("serviceaccounts", {
+        "namespace": "default", "name": "default"})
+    out = plugin("CREATE", "pods", _pod_dict("a"))
+    assert out["spec"]["serviceAccountName"] == "default"
+    # explicit missing SA denied; existing one passes
+    d = _pod_dict("b")
+    d["spec"]["serviceAccountName"] = "builder"
+    with pytest.raises(AdmissionDenied):
+        plugin("CREATE", "pods", d)
+    cluster.create("serviceaccounts", {
+        "namespace": "default", "name": "builder"})
+    assert plugin("CREATE", "pods", d)["spec"]["serviceAccountName"] == \
+        "builder"
+
+
+def test_node_restriction_through_rest_with_node_token():
+    """E2e: a node-token identity is narrowed per-object by admission
+    even though RBAC grants the system:nodes group the verbs."""
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.apiserver.admission import default_admission_chain
+    from kubernetes_tpu.apiserver.auth import (
+        RBACAuthorizer,
+        TokenAuthenticator,
+        ensure_bootstrap_policy,
+    )
+    from kubernetes_tpu.api.serialize import node_to_dict
+    from fixtures import make_node
+
+    cluster = LocalCluster()
+    ensure_bootstrap_policy(cluster)
+    cluster.add_node(make_node("n1", cpu="4"))
+    cluster.add_node(make_node("n2", cpu="4"))
+    cluster.create("secrets", {
+        "namespace": "kube-system", "name": "node-token-n1",
+        "type": "kubernetes-tpu/auth-token",
+        "data": {"token": "n1tok", "user": "system:node:n1",
+                 "groups": ["system:nodes"]},
+    })
+    srv = APIServer(cluster=cluster,
+                    authenticator=TokenAuthenticator(cluster),
+                    authorizer=RBACAuthorizer(cluster))
+    srv.admission = default_admission_chain(
+        cluster, user_getter=srv.current_user)
+    srv.start()
+    try:
+        u = srv.url
+        code, _ = _req_http(f"{u}/api/v1/nodes/n1", "PUT",
+                            node_to_dict(make_node("n1", cpu="8")),
+                            token="n1tok")
+        assert code == 200      # own node: authorized AND admitted
+        code, body = _req_http(f"{u}/api/v1/nodes/n2", "PUT",
+                               node_to_dict(make_node("n2", cpu="8")),
+                               token="n1tok")
+        assert code == 403      # other node: RBAC passed, admission denied
+        assert "not allowed to modify node" in body.get("message", "")
+    finally:
+        srv.stop()
+
+
+def _req_http(url, method="GET", payload=None, token=None):
+    import urllib.error
+    import urllib.request
+
+    data = json.dumps(payload).encode() if payload is not None else None
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
