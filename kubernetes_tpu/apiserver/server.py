@@ -683,8 +683,22 @@ class APIServer:
                 # /apis/metrics.k8s.io/v1beta1/<rest...>
                 rest = [p for p in route if p][3:]
                 pods = outer.cluster.list("pods")
+                # OBSERVED samples published by kubelets' stats providers
+                # (runtime/kubelet_resources.StatsProvider.publish) win
+                # over the declared-requests fallback — metrics.k8s.io
+                # serves measured usage when a measurement exists
+                observed = {}
+                if outer.cluster.has_kind("podmetrics"):
+                    for s in outer.cluster.list("podmetrics"):
+                        observed[(s.get("namespace"), s.get("name"))] = (
+                            float(s.get("cpu_milli", 0.0)),
+                            float(s.get("memory_bytes", 0.0)),
+                        )
 
                 def pod_usage(p):
+                    hit = observed.get((p.namespace, p.name))
+                    if hit is not None:
+                        return hit
                     cpu = mem = 0.0
                     for c in p.spec.containers:
                         if "cpu" in c.requests:
@@ -730,16 +744,29 @@ class APIServer:
                         if p.namespace != ns_want or p.status.phase != "Running":
                             continue
                         cpu, mem = pod_usage(p)
+                        # container usage must SUM to the pod line (a
+                        # client totaling containers reads the same
+                        # number): distribute the pod-level measurement
+                        # proportionally to requests, evenly when none
+                        reqs = [
+                            (float(c.requests["cpu"].milli)
+                             if "cpu" in c.requests else 0.0,
+                             float(c.requests["memory"])
+                             if "memory" in c.requests else 0.0)
+                            for c in p.spec.containers
+                        ]
+                        tot_c = sum(r[0] for r in reqs) or len(reqs) or 1
+                        tot_m = sum(r[1] for r in reqs) or len(reqs) or 1
                         items.append({
                             "metadata": {"name": p.name,
                                          "namespace": p.namespace},
                             "containers": [{
                                 "name": c.name,
                                 "usage": {
-                                    "cpu": f"{int(c.requests['cpu'].milli) if 'cpu' in c.requests else 0}m",
-                                    "memory": f"{int(float(c.requests['memory'])) if 'memory' in c.requests else 0}",
+                                    "cpu": f"{int(cpu * ((r[0] or 1) / tot_c))}m",
+                                    "memory": f"{int(mem * ((r[1] or 1) / tot_m))}",
                                 },
-                            } for c in p.spec.containers],
+                            } for c, r in zip(p.spec.containers, reqs)],
                             "usage": {"cpu": f"{int(cpu)}m",
                                       "memory": f"{int(mem)}"},
                         })

@@ -84,6 +84,18 @@ class Kubelet:
         self.node = node
         self.runtime = runtime if runtime is not None else FakeRuntime()
         self.completer = completer
+        # resource management (pkg/kubelet/cm, volumemanager, stats): the
+        # cgroup hierarchy as data, the volume mount state machine, and
+        # the observed-usage provider feeding eviction + metrics
+        from kubernetes_tpu.runtime.kubelet_resources import (
+            CgroupManager,
+            StatsProvider,
+            VolumeManager,
+        )
+
+        self.cgroups = CgroupManager()
+        self.volume_manager = VolumeManager(cluster, node.name)
+        self.stats = StatsProvider(cluster, node.name)
         # prober manager seam (pkg/kubelet/prober): callables pod -> bool.
         # liveness False -> container restarted (sandbox recreated,
         # restartCount++); readiness False -> Ready condition cleared
@@ -91,6 +103,8 @@ class Kubelet:
         self.liveness = liveness
         self.readiness = readiness
         self.sandbox_of: Dict[tuple, str] = {}   # pod key -> sandbox id
+        # pods waiting on WaitForAttachAndMount (retried on node events)
+        self._awaiting_volumes: set = set()
         self.evictions: List[tuple] = []
         if register:
             cluster.add_node(node)
@@ -102,12 +116,21 @@ class Kubelet:
     def observe(self, event: str, kind: str, obj) -> None:
         if kind == "nodes" and obj.name == self.node.name:
             self.node = obj  # track condition changes (pressure)
+            # volumesAttached may have grown: retry pods blocked on
+            # WaitForAttachAndMount (the volume manager's wakeup)
+            for key in list(self._awaiting_volumes):
+                pod = self.cluster.get("pods", *key)
+                if pod is None:
+                    self._awaiting_volumes.discard(key)
+                elif self.volume_manager.all_mounted(pod):
+                    self.sync_pod(pod)
             return
         if kind != "pods" or obj.spec.node_name != self.node.name:
             return
         key = (obj.namespace, obj.name)
         if event == DELETED or obj.status.phase in ("Succeeded", "Failed"):
-            self._teardown(key)
+            self._awaiting_volumes.discard(key)
+            self._teardown(key, pod=obj)
             return
         if key in self.sandbox_of:
             # event-driven completion (the hollow-node fast path; pleg_relist
@@ -128,9 +151,18 @@ class Kubelet:
         self.sync_pod(obj)
 
     def sync_pod(self, pod: Pod) -> None:
-        """kubelet.syncPod -> kuberuntime SyncPod -> CRI RunPodSandbox, then
-        the statusManager reports Running."""
+        """kubelet.syncPod -> pod cgroup -> WaitForAttachAndMount ->
+        kuberuntime SyncPod -> CRI RunPodSandbox, then the statusManager
+        reports Running.  A pod whose PV-backed volume hasn't been
+        surfaced on node.status.volumesAttached yet stays Pending (no
+        sandbox) until a node/claim event re-syncs it — the reference
+        blocks syncPod on the volume manager the same way."""
         key = (pod.namespace, pod.name)
+        self.cgroups.create_pod_cgroup(pod)
+        if not self.volume_manager.all_mounted(pod):
+            self._awaiting_volumes.add(key)
+            return
+        self._awaiting_volumes.discard(key)
         self.sandbox_of[key] = self.runtime.run_pod_sandbox(pod)
         if pod.status.phase != "Running":
             self.cluster.update(
@@ -143,11 +175,16 @@ class Kubelet:
                 ),
             )
 
-    def _teardown(self, key: tuple) -> None:
+    def _teardown(self, key: tuple, pod=None) -> None:
         sid = self.sandbox_of.pop(key, None)
         if sid is not None:
             self.runtime.stop_pod_sandbox(sid)
             self.runtime.remove_pod_sandbox(sid)
+        # DELETED events carry the final object; the store no longer has it
+        pod = pod if pod is not None else self.cluster.get("pods", *key)
+        if pod is not None:
+            self.cgroups.remove_pod_cgroup(pod)
+        self.volume_manager.sync()  # unmount the departed pod's volumes
 
     # -------------------------------------------------------------- plegCh
 
@@ -232,32 +269,37 @@ class Kubelet:
         return restarts
 
     def eviction_tick(self, max_evict: Optional[int] = None) -> List[tuple]:
-        """pkg/kubelet/eviction (eviction_manager.go rankMemoryPressure):
-        under MemoryPressure, evict in QoS-then-priority order — every
-        BestEffort pod first; if none exist, the lowest-priority Burstable
-        (one per tick, Guaranteed last) — phase Failed, torn down, recorded
-        as an Evicted event.  Returns evicted pod keys."""
+        """pkg/kubelet/eviction (eviction_manager.go + helpers.go
+        rankMemoryPressure): under MemoryPressure, rank by OBSERVED
+        usage-over-request — exceeders first (BestEffort pods, with zero
+        requests and nonzero usage, always exceed, reproducing the
+        QoS-first outcome), then lower priority, then largest overage —
+        phase Failed, torn down, recorded as an Evicted event.  Returns
+        evicted pod keys."""
+        from kubernetes_tpu.runtime.kubelet_resources import (
+            rank_for_memory_eviction,
+        )
+
         if self.node.status.conditions.get("MemoryPressure") != "True":
             return []
-        ranked = []
+        pods = []
         for key in list(self.sandbox_of):
             pod = self.cluster.get("pods", *key)
-            if pod is None:
-                continue
-            qos = qos_class(pod)
-            rank = {"BestEffort": 0, "Burstable": 1, "Guaranteed": 2}[qos]
-            ranked.append((rank, pod.spec.priority, key, pod))
-        ranked.sort(key=lambda r: (r[0], r[1]))
-        if not ranked:
+            if pod is not None:
+                pods.append(pod)
+        if not pods:
             return []
-        if any(r[0] == 0 for r in ranked):
-            victims = [r for r in ranked if r[0] == 0]
-        else:
-            victims = ranked[:1]  # non-BestEffort: shed one, reassess
+        ranked = rank_for_memory_eviction(pods, self.stats.usage_fn)
+        exceeders = [p for p, over in ranked if over > 0]
+        # every usage-over-request pod goes this tick; otherwise shed the
+        # top-ranked one and reassess (the reference evicts one victim
+        # per synchronize loop)
+        chosen = exceeders if exceeders else [ranked[0][0]]
+        victims = [((p.namespace, p.name), p) for p in chosen]
         if max_evict is not None:
             victims = victims[:max_evict]
         evicted = []
-        for _, _, key, pod in victims:
+        for key, pod in victims:
             self._teardown(key)
             self.cluster.update(
                 "pods",

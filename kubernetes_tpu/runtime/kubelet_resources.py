@@ -1,0 +1,330 @@
+"""Kubelet resource management: cgroups/QoS, volume manager, stats.
+
+Reference:
+  * pkg/kubelet/cm/cgroup_manager_linux.go (593 LoC) +
+    qos_container_manager_linux.go + helpers_linux.go: the kubepods
+    cgroup hierarchy — Guaranteed pods parented directly under
+    ``kubepods``, Burstable under ``kubepods/burstable``, BestEffort
+    under ``kubepods/besteffort``; cpu.shares from requests
+    (MilliCPUToShares: milli*1024/1000, floor MinShares=2), cpu quota +
+    memory limits from limits.  This framework has no OS cgroupfs to
+    write, so the hierarchy is held AS DATA — the accounting model the
+    rest of the kubelet (eviction, stats) reads.
+  * pkg/kubelet/volumemanager (3.3k LoC): desired-state-of-world vs
+    actual-state-of-world reconciler — a pod's PV-backed volume waits
+    for the attach-detach controller to surface the attachment on
+    node.status.volumesAttached, then mounts; pod deletion unmounts.
+  * pkg/kubelet/stats + cadvisor seam: OBSERVED per-pod usage (not the
+    declared requests) feeding /stats/summary — here a pluggable
+    ``usage_fn`` stands in for cadvisor, and ``publish`` surfaces the
+    samples to the store so the metrics.k8s.io endpoint serves measured
+    values; eviction ranks by observed-over-request
+    (eviction/helpers.go rankMemoryPressure).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.types import Pod, qos_class
+from kubernetes_tpu.runtime.cluster import ConflictError, LocalCluster
+
+MIN_SHARES = 2           # cm/helpers_linux.go MinShares
+SHARES_PER_CPU = 1024    # SharesPerCPU
+QUOTA_PERIOD = 100000    # QuotaPeriod (100ms, microseconds)
+
+
+def milli_cpu_to_shares(milli: float) -> int:
+    """MilliCPUToShares (helpers_linux.go:52-63)."""
+    if milli <= 0:
+        return MIN_SHARES
+    return max(MIN_SHARES, int(milli * SHARES_PER_CPU / 1000))
+
+
+def milli_cpu_to_quota(milli: float) -> Optional[int]:
+    """MilliCPUToQuota: cpu limit -> CFS quota per period (helpers_linux.go
+    :37-50); no limit -> no quota."""
+    if milli <= 0:
+        return None
+    return max(1000, int(milli * QUOTA_PERIOD / 1000))  # minQuotaPeriod
+
+
+def _pod_milli_requests(pod: Pod) -> float:
+    return sum(float(c.requests["cpu"].milli)
+               for c in pod.spec.containers if "cpu" in c.requests)
+
+
+def _pod_milli_limits(pod: Pod) -> float:
+    return sum(float(c.limits["cpu"].milli)
+               for c in pod.spec.containers if "cpu" in c.limits)
+
+
+def _pod_memory_limits(pod: Pod) -> Optional[float]:
+    """Sum of container memory limits; None unless EVERY container sets
+    one (an unlimited container makes the pod cgroup unlimited)."""
+    total = 0.0
+    for c in pod.spec.containers:
+        if "memory" not in c.limits:
+            return None
+        total += float(c.limits["memory"])
+    return total if pod.spec.containers else None
+
+
+@dataclasses.dataclass
+class Cgroup:
+    """One node of the hierarchy, as data (cgroup_manager's CgroupConfig)."""
+
+    name: str                       # slash path, e.g. kubepods/burstable/pod<uid>
+    cpu_shares: int = MIN_SHARES
+    cpu_quota: Optional[int] = None       # CFS quota (us per 100ms period)
+    memory_limit: Optional[float] = None  # bytes; None = unlimited
+    children: Dict[str, "Cgroup"] = dataclasses.field(default_factory=dict)
+
+
+class CgroupManager:
+    """The kubepods hierarchy: qos_container_manager's structure +
+    cgroup_manager's per-cgroup resource math, held as data."""
+
+    def __init__(self):
+        self.root = Cgroup("kubepods")
+        self.root.children["burstable"] = Cgroup("kubepods/burstable")
+        self.root.children["besteffort"] = Cgroup(
+            "kubepods/besteffort", cpu_shares=MIN_SHARES)
+        self._pod_parent: Dict[str, Cgroup] = {}
+
+    def pod_cgroup_name(self, pod: Pod) -> str:
+        qos = qos_class(pod)
+        # uid when present (the reference's pod<UID>); otherwise ns+name so
+        # same-named pods in different namespaces can never collide
+        ident = pod.metadata.uid or f"{pod.namespace}-{pod.name}"
+        leaf = f"pod{ident}"
+        if qos == "Guaranteed":
+            return f"kubepods/{leaf}"
+        return f"kubepods/{qos.lower()}/{leaf}"
+
+    def _parent_for(self, pod: Pod) -> Cgroup:
+        qos = qos_class(pod)
+        if qos == "Guaranteed":
+            return self.root
+        return self.root.children[qos.lower()]
+
+    def create_pod_cgroup(self, pod: Pod) -> Cgroup:
+        """ResourceConfigForPod (helpers_linux.go:85-160): shares from
+        requests, quota from cpu limits, memory limit iff every container
+        sets one."""
+        name = self.pod_cgroup_name(pod)
+        cg = Cgroup(
+            name,
+            cpu_shares=milli_cpu_to_shares(_pod_milli_requests(pod)),
+            cpu_quota=milli_cpu_to_quota(_pod_milli_limits(pod)),
+            memory_limit=_pod_memory_limits(pod),
+        )
+        parent = self._parent_for(pod)
+        parent.children[name.rsplit("/", 1)[-1]] = cg
+        self._pod_parent[name] = parent
+        self._update_qos_shares()
+        return cg
+
+    def remove_pod_cgroup(self, pod: Pod) -> None:
+        name = self.pod_cgroup_name(pod)
+        parent = self._pod_parent.pop(name, None)
+        if parent is not None:
+            parent.children.pop(name.rsplit("/", 1)[-1], None)
+            self._update_qos_shares()
+
+    def _update_qos_shares(self) -> None:
+        """UpdateCgroups (qos_container_manager_linux.go:get*CPURequests):
+        burstable shares track the sum of its pods' request-derived
+        shares; besteffort stays at MinShares."""
+        burst = self.root.children["burstable"]
+        total = sum(c.cpu_shares for c in burst.children.values())
+        burst.cpu_shares = max(MIN_SHARES, total)
+
+    def get(self, name: str) -> Optional[Cgroup]:
+        node = self.root
+        parts = name.split("/")
+        if parts[0] != "kubepods":
+            return None
+        for p in parts[1:]:
+            node = node.children.get(p)
+            if node is None:
+                return None
+        return node
+
+
+# ------------------------------------------------------------ volumemanager
+
+WAIT_FOR_ATTACH = "WaitForAttach"
+MOUNTED = "Mounted"
+
+
+class VolumeManager:
+    """Desired-vs-actual volume reconciler for ONE node
+    (volumemanager/reconciler/reconciler.go, collapsed to the state
+    machine): a PV-backed volume is mountable once the attach-detach
+    controller lists the PV on node.status.volumesAttached; non-PV
+    volumes (emptyDir and friends) mount immediately."""
+
+    def __init__(self, cluster: LocalCluster, node_name: str):
+        self.cluster = cluster
+        self.node_name = node_name
+        # (pod_key, volume_name_or_claim) -> state
+        self.state: Dict[Tuple[tuple, str], str] = {}
+
+    def _desired(self) -> Dict[Tuple[tuple, str], Optional[str]]:
+        """(pod key, volume id) -> PV name (None for non-PV volumes)."""
+        out: Dict[Tuple[tuple, str], Optional[str]] = {}
+        for p in self.cluster.list("pods"):
+            if p.spec.node_name != self.node_name:
+                continue
+            if p.status.phase in ("Succeeded", "Failed"):
+                continue
+            key = (p.namespace, p.name)
+            for i, v in enumerate(p.spec.volumes):
+                claim = (v.get("persistentVolumeClaim") or {})
+                cn = claim.get("claimName")
+                if cn:
+                    pvc = self.cluster.get(
+                        "persistentvolumeclaims", p.namespace, cn)
+                    pv = (pvc.volume_name
+                          if pvc is not None and pvc.volume_name else None)
+                    out[(key, f"pvc:{cn}")] = pv or ""
+                else:
+                    vid = v.get("name") or f"vol-{i}"
+                    out[(key, vid)] = None
+        return out
+
+    def sync(self) -> Dict[Tuple[tuple, str], str]:
+        """One reconcile pass; returns the actual-state map."""
+        desired = self._desired()
+        node = self.cluster.get("nodes", "", self.node_name)
+        attached = set(node.status.volumes_attached) if node else set()
+        for dkey, pv in desired.items():
+            if pv is None:
+                self.state[dkey] = MOUNTED       # emptyDir-class: no attach
+            elif pv and pv in attached:
+                self.state[dkey] = MOUNTED       # attach observed -> mount
+            elif self.state.get(dkey) != MOUNTED:
+                # unbound claim or attach not yet surfaced; an
+                # already-MOUNTED volume stays mounted after a detach
+                # blip (unmount happens on pod departure, not here)
+                self.state[dkey] = WAIT_FOR_ATTACH
+        # unmount volumes whose pod left (the reconciler's unmount arm)
+        for dkey in list(self.state):
+            if dkey not in desired:
+                del self.state[dkey]
+        return dict(self.state)
+
+    def all_mounted(self, pod: Pod) -> bool:
+        """WaitForAttachAndMount's answer for one pod (volume_manager.go):
+        every declared volume reached Mounted."""
+        self.sync()
+        key = (pod.namespace, pod.name)
+        states = [s for (k, _v), s in self.state.items() if k == key]
+        n_declared = len(pod.spec.volumes)
+        return len(states) >= n_declared and all(
+            s == MOUNTED for s in states)
+
+
+# ------------------------------------------------------------------- stats
+
+
+class StatsProvider:
+    """Observed usage (the cadvisor seam, pkg/kubelet/stats): usage_fn
+    stands in for the measurement source; publish() surfaces samples to
+    the store as podmetrics objects so metrics.k8s.io serves MEASURED
+    values instead of declared requests."""
+
+    def __init__(self, cluster: LocalCluster, node_name: str,
+                 usage_fn: Optional[Callable] = None):
+        self.cluster = cluster
+        self.node_name = node_name
+        self.usage_fn = usage_fn or self._default_usage
+
+    @staticmethod
+    def _default_usage(pod: Pod) -> Tuple[float, float]:
+        """Deterministic 'measured' usage distinct from the declared
+        requests: a per-pod utilization factor in [0.55, 0.95) derived
+        from the pod identity (the hollow-world cadvisor).  Containers
+        with NO request still consume (the scheduler's non-zero
+        defaults, util/non_zero.go) — which is exactly why BestEffort
+        pods always exceed their (zero) requests and rank first for
+        eviction."""
+        import zlib
+
+        from kubernetes_tpu.api.types import (
+            DEFAULT_MEMORY_REQUEST,
+            DEFAULT_MILLI_CPU_REQUEST,
+        )
+
+        cpu = mem = 0.0
+        for c in pod.spec.containers:
+            cpu += (float(c.requests["cpu"].milli) if "cpu" in c.requests
+                    else DEFAULT_MILLI_CPU_REQUEST)
+            mem += (float(c.requests["memory"]) if "memory" in c.requests
+                    else DEFAULT_MEMORY_REQUEST)
+        # crc32, not hash(): PYTHONHASHSEED randomizes str hashing per
+        # process, which would make "measured" usage differ run to run
+        f = 0.55 + (zlib.crc32(
+            f"{pod.namespace}/{pod.name}".encode()) % 40) / 100.0
+        return cpu * f, mem * f
+
+    def pod_stats(self) -> Dict[tuple, Tuple[float, float]]:
+        out = {}
+        for p in self.cluster.list("pods"):
+            if p.spec.node_name != self.node_name:
+                continue
+            if p.status.phase != "Running":
+                continue
+            out[(p.namespace, p.name)] = self.usage_fn(p)
+        return out
+
+    def node_summary(self) -> Tuple[float, float]:
+        stats = self.pod_stats().values()
+        return (sum(c for c, _ in stats), sum(m for _, m in stats))
+
+    def publish(self) -> int:
+        """Write podmetrics samples into the store (the metrics-server
+        scrape path collapsed: kubelet /stats/summary -> metrics.k8s.io)
+        and reap THIS node's samples for pods no longer reporting — a
+        departed pod must not keep serving stale 'measured' usage.
+        Returns samples written."""
+        self.cluster.register_kind("podmetrics")
+        stats = self.pod_stats()
+        n = 0
+        for (ns, name), (cpu, mem) in stats.items():
+            sample = {
+                "namespace": ns, "name": name,
+                "node": self.node_name,
+                "cpu_milli": round(cpu, 3), "memory_bytes": round(mem),
+            }
+            try:
+                self.cluster.create("podmetrics", sample)
+            except ConflictError:
+                self.cluster.update("podmetrics", sample)
+            n += 1
+        for s in list(self.cluster.list("podmetrics")):
+            if (s.get("node") == self.node_name
+                    and (s.get("namespace"), s.get("name")) not in stats):
+                self.cluster.delete(
+                    "podmetrics", s.get("namespace", ""), s.get("name", ""))
+        return n
+
+
+def rank_for_memory_eviction(pods: List[Pod], usage_fn: Callable,
+                             ) -> List[Tuple[Pod, float]]:
+    """eviction/helpers.go rankMemoryPressure: order by (1) whether
+    memory usage exceeds requests (exceeders first), (2) pod priority
+    (lower first), (3) usage-over-request (larger first).  Returns
+    (pod, usage_minus_request) pairs so callers share the one exceeder
+    predicate (over > 0)."""
+    scored = []
+    for pod in pods:
+        _cpu, mem = usage_fn(pod)
+        req = sum(float(c.requests["memory"])
+                  for c in pod.spec.containers if "memory" in c.requests)
+        scored.append((pod, mem - req))
+    scored.sort(key=lambda po: (0 if po[1] > 0 else 1,
+                                po[0].spec.priority, -po[1]))
+    return scored

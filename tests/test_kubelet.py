@@ -168,9 +168,14 @@ def test_liveness_restart_and_readiness_gate():
     assert p.status.restart_count == 1 and not p.status.ready
 
 
-def test_eviction_ranks_qos_then_priority():
-    """eviction_manager rank: all BestEffort first; without BestEffort the
-    lowest-priority Burstable goes (one per tick); Guaranteed last."""
+def test_eviction_ranks_by_observed_over_request():
+    """eviction/helpers.go rankMemoryPressure: (1) usage-exceeds-requests
+    first — BestEffort pods, with zero requests and real usage, always
+    exceed, so they still go first; (2) then priority ascending; (3)
+    then overage.  NOTE: QoS does NOT rank directly — a priority-0
+    Guaranteed pod under its requests is evicted before a priority-1
+    Burstable one (the reference's actual ordering, not the QoS
+    folklore)."""
     import dataclasses
 
     cluster = LocalCluster()
@@ -194,11 +199,41 @@ def test_eviction_ranks_qos_then_priority():
                   node_name="n1", priority=0)
     for p in (be, bu_low, bu_high, ga):
         cluster.add_pod(p)
+    # the exceeder (BestEffort: usage > 0 == requests) goes first
     assert {k[1] for k in kl.eviction_tick()} == {"be"}
+    # then one per tick by ascending priority: ga(0), bu-low(1), bu-high
+    assert [k[1] for k in kl.eviction_tick()] == ["ga"]
     assert [k[1] for k in kl.eviction_tick()] == ["bu-low"]
     assert [k[1] for k in kl.eviction_tick()] == ["bu-high"]
-    assert [k[1] for k in kl.eviction_tick()] == ["ga"]
     assert kl.eviction_tick() == []
+
+
+def test_eviction_prefers_largest_overage_via_observed_stats():
+    """A pod measured OVER its request is evicted before same-priority
+    pods under theirs — only observable usage (not declared requests)
+    can produce this ordering."""
+    import dataclasses
+
+    cluster = LocalCluster()
+    node = make_node("n1", cpu="16", mem="64Gi")
+    node = dataclasses.replace(
+        node, status=dataclasses.replace(
+            node.status,
+            conditions={**node.status.conditions,
+                        "MemoryPressure": "True"}))
+    kl = Kubelet(cluster, node)
+    hog = make_pod("hog", cpu="100m", mem="64Mi", node_name="n1",
+                   priority=100)
+    calm = make_pod("calm", cpu="100m", mem="64Mi", node_name="n1",
+                    priority=1)
+    cluster.add_pod(hog)
+    cluster.add_pod(calm)
+    mi = 64 * 1024 * 1024
+    usage = {"hog": (100.0, 2.0 * mi), "calm": (50.0, 0.5 * mi)}
+    kl.stats.usage_fn = lambda p: usage[p.name]
+    # despite its higher priority, the exceeder goes first
+    assert [k[1] for k in kl.eviction_tick()] == ["hog"]
+    assert [k[1] for k in kl.eviction_tick()] == ["calm"]
 
 
 def test_process_runtime_spawns_real_pause_sandboxes():
@@ -228,6 +263,10 @@ def test_process_runtime_spawns_real_pause_sandboxes():
     deadline = __import__("time").monotonic() + 5
     while os.path.exists(f"/proc/{pid}") and __import__("time").monotonic() < deadline:
         __import__("time").sleep(0.05)
-    # process gone (or zombie-reaped by us via Popen.wait)
-    assert not os.path.exists(f"/proc/{pid}") or \
-        open(f"/proc/{pid}/stat").read().split()[2] == "Z"
+    # process gone (or zombie-reaped by us via Popen.wait); the /proc
+    # read can race the exit under load — a vanished entry passes
+    try:
+        state = open(f"/proc/{pid}/stat").read().split()[2]
+    except (FileNotFoundError, ProcessLookupError):
+        state = None
+    assert state is None or state == "Z"

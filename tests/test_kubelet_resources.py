@@ -1,0 +1,158 @@
+"""Kubelet resource management (runtime/kubelet_resources.py): the
+cgroup/QoS hierarchy as data, the volume mount state machine, and the
+observed-usage stats provider feeding metrics.k8s.io.
+
+Reference: pkg/kubelet/cm/cgroup_manager_linux.go +
+qos_container_manager_linux.go, pkg/kubelet/volumemanager,
+pkg/kubelet/stats."""
+
+import dataclasses
+import json
+import urllib.request
+
+from kubernetes_tpu.runtime.cluster import LocalCluster
+from kubernetes_tpu.runtime.kubelet_resources import (
+    MIN_SHARES,
+    MOUNTED,
+    WAIT_FOR_ATTACH,
+    CgroupManager,
+    StatsProvider,
+    VolumeManager,
+    milli_cpu_to_shares,
+)
+
+from fixtures import make_node, make_pod
+
+
+def test_cgroup_hierarchy_and_share_math():
+    cm = CgroupManager()
+    # MilliCPUToShares: 1000m -> 1024 shares, floor MinShares
+    assert milli_cpu_to_shares(1000) == 1024
+    assert milli_cpu_to_shares(250) == 256
+    assert milli_cpu_to_shares(0) == MIN_SHARES
+
+    guaranteed = make_pod("ga", cpu="500m", mem="64Mi",
+                          limits={"cpu": "500m", "memory": "64Mi"})
+    burstable = make_pod("bu", cpu="250m", mem="64Mi")
+    besteffort = make_pod("be")
+    cg_g = cm.create_pod_cgroup(guaranteed)
+    cg_b = cm.create_pod_cgroup(burstable)
+    cg_e = cm.create_pod_cgroup(besteffort)
+    # placement: Guaranteed under kubepods, others under their qos group
+    assert cg_g.name.startswith("kubepods/pod")
+    assert cg_b.name.startswith("kubepods/burstable/pod")
+    assert cg_e.name.startswith("kubepods/besteffort/pod")
+    # per-pod resources: shares from requests, quota+memory from limits
+    assert cg_g.cpu_shares == 512 and cg_g.cpu_quota == 50000
+    assert cg_g.memory_limit == 64 * 1024 * 1024
+    assert cg_b.cpu_shares == 256 and cg_b.cpu_quota is None
+    assert cg_b.memory_limit is None        # no limit -> unlimited
+    assert cg_e.cpu_shares == MIN_SHARES
+    # qos-level: burstable shares track their pods; besteffort pinned
+    assert cm.root.children["burstable"].cpu_shares == 256
+    assert cm.root.children["besteffort"].cpu_shares == MIN_SHARES
+    # removal collapses the burstable aggregate back to the floor
+    cm.remove_pod_cgroup(burstable)
+    assert cm.root.children["burstable"].cpu_shares == MIN_SHARES
+    assert cm.get(cg_b.name) is None
+    assert cm.get(cg_g.name) is not None
+
+
+def test_volume_manager_waits_for_attach_then_mounts():
+    from kubernetes_tpu.api.storage import (
+        PersistentVolume,
+        PersistentVolumeClaim,
+    )
+
+    cluster = LocalCluster()
+    cluster.add_node(make_node("n1", cpu="4", mem="8Gi"))
+    cluster.create("persistentvolumes", PersistentVolume.from_dict({
+        "metadata": {"name": "disk1"},
+        "spec": {"capacity": {"storage": "10Gi"},
+                 "accessModes": ["ReadWriteOnce"],
+                 "gcePersistentDisk": {"pdName": "disk1"}},
+    }))
+    pvc = PersistentVolumeClaim.from_dict({
+        "metadata": {"name": "c1", "namespace": "default"},
+        "spec": {"volumeName": "disk1",
+                 "accessModes": ["ReadWriteOnce"]},
+    })
+    pvc.phase = "Bound"
+    cluster.create("persistentvolumeclaims", pvc)
+    pod = make_pod("p1", cpu="100m", mem="64Mi")
+    pod = dataclasses.replace(pod, spec=dataclasses.replace(
+        pod.spec, node_name="n1",
+        volumes=({"persistentVolumeClaim": {"claimName": "c1"}},
+                 {"name": "scratch", "emptyDir": {}})))
+    cluster.add_pod(pod)
+
+    vm = VolumeManager(cluster, "n1")
+    state = vm.sync()
+    key = ("default", "p1")
+    # emptyDir mounts immediately; the PV waits for the attach
+    assert state[(key, "scratch")] == MOUNTED
+    assert state[(key, "pvc:c1")] == WAIT_FOR_ATTACH
+    assert not vm.all_mounted(pod)
+    # the attach-detach controller surfaces the attachment -> mount
+    node, rv = cluster.get_with_rv("nodes", "", "n1")
+    cluster.update("nodes", dataclasses.replace(
+        node, status=dataclasses.replace(
+            node.status, volumes_attached=("disk1",))), expect_rv=rv)
+    state = vm.sync()
+    assert state[(key, "pvc:c1")] == MOUNTED
+    assert vm.all_mounted(pod)
+    # pod leaves -> unmounted (state dropped)
+    cluster.delete("pods", "default", "p1")
+    assert vm.sync() == {}
+
+
+def test_stats_provider_publishes_observed_usage_to_metrics_api():
+    """VERDICT r2 item 10 'done' check: the metrics endpoints serve
+    measured (non-declared) values once a kubelet publishes stats."""
+    from kubernetes_tpu.apiserver import APIServer
+
+    cluster = LocalCluster()
+    cluster.add_node(make_node("n1", cpu="4", mem="8Gi"))
+    pod = make_pod("p1", cpu="200m", mem="128Mi", node_name="n1")
+    pod = dataclasses.replace(
+        pod, status=dataclasses.replace(pod.status, phase="Running"))
+    cluster.add_pod(pod)
+    stats = StatsProvider(cluster, "n1",
+                          usage_fn=lambda p: (137.0, 99 * 1024 * 1024))
+    assert stats.publish() == 1
+    cpu, mem = stats.node_summary()
+    assert cpu == 137.0 and mem == 99 * 1024 * 1024
+
+    srv = APIServer(cluster=cluster).start()
+    try:
+        u = srv.url
+        with urllib.request.urlopen(
+            f"{u}/apis/metrics.k8s.io/v1beta1/namespaces/default/pods",
+            timeout=5,
+        ) as resp:
+            out = json.loads(resp.read())
+        item = out["items"][0]
+        # 137m measured, NOT the declared 200m request
+        assert item["usage"]["cpu"] == "137m"
+        assert item["usage"]["memory"] == str(99 * 1024 * 1024)
+        with urllib.request.urlopen(
+            f"{u}/apis/metrics.k8s.io/v1beta1/nodes/n1", timeout=5,
+        ) as resp:
+            node_out = json.loads(resp.read())
+        assert node_out["usage"]["cpu"] == "137m"
+    finally:
+        srv.stop()
+
+
+def test_kubelet_maintains_cgroups_through_lifecycle():
+    from kubernetes_tpu.runtime.kubelet import Kubelet
+
+    cluster = LocalCluster()
+    kl = Kubelet(cluster, make_node("n1", cpu="8", mem="16Gi"))
+    pod = make_pod("p1", cpu="500m", mem="64Mi", node_name="n1")
+    cluster.add_pod(pod)
+    name = kl.cgroups.pod_cgroup_name(pod)
+    assert kl.cgroups.get(name) is not None         # created on sync
+    assert kl.cgroups.get(name).cpu_shares == 512
+    cluster.delete("pods", "default", "p1")
+    assert kl.cgroups.get(name) is None             # removed on teardown
