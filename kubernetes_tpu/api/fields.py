@@ -1,0 +1,69 @@
+"""Field selectors: server-side LIST filtering on object fields.
+
+Reference: staging/src/k8s.io/apimachinery/pkg/fields — selectors of the
+form ``metadata.name=x,spec.nodeName!=y`` parsed by ParseSelector
+(selector.go:449-485, operators ``=``/``==``/``!=`` only), evaluated
+against the per-kind field set each registry exposes via
+GetAttrs/ToSelectableFields (e.g. pods: pkg/registry/core/pod/strategy.go
+PodToSelectableFields — metadata.name, metadata.namespace, spec.nodeName,
+spec.schedulerName, status.phase...).
+
+Here selectors evaluate against the object's WIRE dict by dotted path,
+which covers every field the reference registries expose without a
+per-kind table; unknown paths simply compare against "" (the reference's
+selectable-field maps default absent fields to the empty string too)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+
+class FieldSelector:
+    def __init__(self, requirements: List[Tuple[str, str, str]]):
+        self.requirements = requirements  # (dotted path, op, value)
+
+    @staticmethod
+    def parse(s: str) -> "FieldSelector":
+        """ParseSelector: comma-separated terms, ``=``/``==``/``!=``;
+        malformed terms raise ValueError (HTTP 400)."""
+        reqs: List[Tuple[str, str, str]] = []
+        for term in s.split(","):
+            term = term.strip()
+            if not term:
+                continue
+            if "!=" in term:
+                path, _, value = term.partition("!=")
+                op = "!="
+            elif "==" in term:
+                path, _, value = term.partition("==")
+                op = "="
+            elif "=" in term:
+                path, _, value = term.partition("=")
+                op = "="
+            else:
+                raise ValueError(f"invalid field selector term {term!r}")
+            path = path.strip()
+            if not path:
+                raise ValueError(f"invalid field selector term {term!r}")
+            reqs.append((path, op, value.strip()))
+        return FieldSelector(reqs)
+
+    @staticmethod
+    def _lookup(obj: dict, path: str) -> str:
+        cur = obj
+        for part in path.split("."):
+            if not isinstance(cur, dict):
+                return ""
+            cur = cur.get(part)
+            if cur is None:
+                return ""
+        return str(cur)
+
+    def matches(self, wire: dict) -> bool:
+        for path, op, value in self.requirements:
+            have = self._lookup(wire, path)
+            if op == "=" and have != value:
+                return False
+            if op == "!=" and have == value:
+                return False
+        return True

@@ -95,6 +95,52 @@ def selector_from_label_selector(ls: Optional[dict]) -> Optional[Selector]:
         )
     return Selector(tuple(reqs))
 
+def parse_selector(s: str) -> Selector:
+    """labels.Parse string grammar (apimachinery/pkg/labels/selector.go):
+    comma-separated terms ``k=v`` / ``k==v`` / ``k!=v`` / ``k`` (exists)
+    / ``!k`` (not exists) / ``k in (a,b)`` / ``k notin (a,b)``.
+    Malformed terms raise ValueError (HTTP 400 at the REST layer)."""
+    import re
+
+    reqs: List[Requirement] = []
+    # split on commas NOT inside parentheses (the in/notin value sets)
+    terms = re.split(r",(?![^()]*\))", s)
+    for term in terms:
+        term = term.strip()
+        if not term:
+            continue
+        m = re.fullmatch(
+            r"(?P<key>[^\s!=,()]+)\s+(?P<op>in|notin)\s+"
+            r"\((?P<vals>[^)]*)\)", term)
+        if m:
+            vals = tuple(v.strip() for v in m.group("vals").split(",")
+                         if v.strip())
+            reqs.append(Requirement(
+                m.group("key"), IN if m.group("op") == "in" else NOT_IN,
+                vals))
+            continue
+        if term.startswith("!"):
+            reqs.append(Requirement(term[1:].strip(), DOES_NOT_EXIST))
+            continue
+        if "!=" in term:
+            k, _, v = term.partition("!=")
+            reqs.append(Requirement(k.strip(), NOT_IN, (v.strip(),)))
+            continue
+        if "==" in term:
+            k, _, v = term.partition("==")
+            reqs.append(Requirement(k.strip(), IN, (v.strip(),)))
+            continue
+        if "=" in term:
+            k, _, v = term.partition("=")
+            reqs.append(Requirement(k.strip(), IN, (v.strip(),)))
+            continue
+        if re.fullmatch(r"[^\s!=,()]+", term):
+            reqs.append(Requirement(term, EXISTS))
+            continue
+        raise ValueError(f"invalid label selector term {term!r}")
+    return Selector(tuple(reqs))
+
+
 import re as _re
 
 _LABEL_VALUE_RE = _re.compile(r"(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?")

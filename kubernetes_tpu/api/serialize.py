@@ -213,11 +213,16 @@ def object_to_dict(kind: str, obj) -> dict:
     if isinstance(obj, dict):
         return obj  # services / leases / raw objects
     if kind == "deployments":
+        dep_meta = {"name": obj.name, "namespace": obj.namespace,
+                    "uid": obj.uid}
+        if getattr(obj, "labels", None):
+            dep_meta["labels"] = dict(obj.labels)
+        if getattr(obj, "annotations", None):
+            dep_meta["annotations"] = dict(obj.annotations)
         return {
             "kind": "Deployment",
             "apiVersion": "apps/v1",
-            "metadata": {"name": obj.name, "namespace": obj.namespace,
-                         "uid": obj.uid},
+            "metadata": dep_meta,
             "spec": {
                 "replicas": obj.replicas,
                 "selector": {"matchLabels": dict(obj.selector)},

@@ -131,6 +131,8 @@ def _decode(kind: str, d: dict):
         )
         if meta.get("uid"):
             dep.uid = meta["uid"]
+        dep.labels = dict(meta.get("labels") or {})
+        dep.annotations = dict(meta.get("annotations") or {})
         return dep
     if kind == "poddisruptionbudgets":
         from kubernetes_tpu.api.types import PodDisruptionBudget
@@ -614,6 +616,11 @@ class APIServer:
                     self._send({"gitVersion": "v1.15-tpu", "major": "1",
                                 "minor": "15"})
                     return
+                if self._is_discovery_path():
+                    # discovery + openapi stay open like /healthz (the
+                    # reference binds system:discovery to every identity)
+                    self._serve_discovery()
+                    return
                 r = outer._route(self.path)
                 if r is None:
                     self._status(404, "NotFound", self.path)
@@ -670,8 +677,189 @@ class APIServer:
                         for o in outer.cluster.list(kind)
                         if not ns or ns_of(o) == ns
                     ]
+                    # LIST filtering: fieldSelector (apimachinery/pkg/
+                    # fields) and labelSelector query params
+                    query = self.path.partition("?")[2]
+                    if query:
+                        from urllib.parse import parse_qs
+
+                        params = parse_qs(query)
+                        fs = params.get("fieldSelector", [""])[0]
+                        if fs:
+                            from kubernetes_tpu.api.fields import (
+                                FieldSelector,
+                            )
+
+                            try:
+                                sel = FieldSelector.parse(fs)
+                            except ValueError as e:
+                                self._status(400, "BadRequest", str(e))
+                                return
+                            items = [d for d in items if sel.matches(d)]
+                        ls = params.get("labelSelector", [""])[0]
+                        if ls:
+                            from kubernetes_tpu.api import labels as klabels
+
+                            try:
+                                lsel = klabels.parse_selector(ls)
+                            except ValueError as e:
+                                self._status(400, "BadRequest", str(e))
+                                return
+                            items = [
+                                d for d in items
+                                if lsel.matches(
+                                    (d.get("metadata") or {}).get(
+                                        "labels") or {})
+                            ]
                     self._send({"kind": LIST_KINDS.get(kind, "List"),
                                 "apiVersion": "v1", "items": items})
+
+            # -------------------------------------------------- discovery
+
+            def _is_discovery_path(self) -> bool:
+                """/api, /apis, /api/v1, /apis/{g}, /apis/{g}/{v},
+                /openapi/v2 — group/version docs, never resource routes."""
+                parts = [p for p in self.path.split("?")[0].split("/") if p]
+                if parts == ["api"] or parts == ["apis"]:
+                    return True
+                if parts == ["api", "v1"]:
+                    return True
+                if parts == ["openapi", "v2"]:
+                    return True
+                return parts[:1] == ["apis"] and len(parts) in (2, 3)
+
+            def _groups(self):
+                """(group -> {version, ...}) from the scheme + live CRDs
+                (the aggregated discovery the RESTMapper walks)."""
+                from kubernetes_tpu.api import scheme as _scheme
+
+                groups: dict = {}
+                for kind in _scheme.kinds():
+                    gvk = _scheme.gvk_for(kind)
+                    if gvk.group:
+                        groups.setdefault(gvk.group, set()).add(gvk.version)
+                for crd in outer.cluster.list("customresourcedefinitions"):
+                    spec = crd.get("spec") or {}
+                    g = spec.get("group", "")
+                    if not g:
+                        continue
+                    vs = {spec.get("version")} | {
+                        v.get("name") for v in spec.get("versions") or []
+                    }
+                    groups.setdefault(g, set()).update(v for v in vs if v)
+                return groups
+
+            def _resources_for(self, group: str, version: str):
+                from kubernetes_tpu.api import scheme as _scheme
+
+                out = []
+                for kind in _scheme.kinds():
+                    gvk = _scheme.gvk_for(kind)
+                    if gvk.group != group or gvk.version != version:
+                        continue
+                    out.append({
+                        "name": kind,
+                        "kind": gvk.kind,
+                        "namespaced": not _scheme.is_cluster_scoped(kind),
+                        "verbs": ["create", "delete", "get", "list",
+                                  "update", "watch"],
+                    })
+                for crd in outer.cluster.list("customresourcedefinitions"):
+                    spec = crd.get("spec") or {}
+                    if spec.get("group") != group:
+                        continue
+                    vs = {spec.get("version")} | {
+                        v.get("name") for v in spec.get("versions") or []
+                    }
+                    if version not in vs:
+                        continue
+                    names = spec.get("names") or {}
+                    out.append({
+                        "name": names.get("plural", ""),
+                        "kind": names.get("kind", ""),
+                        "namespaced": spec.get("scope", "Namespaced")
+                        == "Namespaced",
+                        "verbs": ["create", "delete", "get", "list",
+                                  "update"],
+                    })
+                return out
+
+            def _serve_discovery(self):
+                """Group/version discovery docs + /openapi/v2 (the
+                endpoints kubectl's RESTMapper and `kubectl explain`
+                walk; ref apiserver/pkg/endpoints/discovery + openapi)."""
+                parts = [p for p in self.path.split("?")[0].split("/") if p]
+                if parts == ["api"]:
+                    self._send({"kind": "APIVersions", "versions": ["v1"]})
+                    return
+                if parts == ["apis"]:
+                    groups = []
+                    for g, versions in sorted(self._groups().items()):
+                        vlist = [{"groupVersion": f"{g}/{v}", "version": v}
+                                 for v in sorted(versions)]
+                        groups.append({
+                            "name": g,
+                            "versions": vlist,
+                            "preferredVersion": vlist[0],
+                        })
+                    self._send({"kind": "APIGroupList", "groups": groups})
+                    return
+                if parts == ["api", "v1"]:
+                    self._send({
+                        "kind": "APIResourceList",
+                        "groupVersion": "v1",
+                        "resources": self._resources_for("", "v1"),
+                    })
+                    return
+                if parts[:1] == ["apis"] and len(parts) == 2:
+                    g = parts[1]
+                    versions = sorted(self._groups().get(g, ()))
+                    if not versions:
+                        self._status(404, "NotFound", f"group {g}")
+                        return
+                    vlist = [{"groupVersion": f"{g}/{v}", "version": v}
+                             for v in versions]
+                    self._send({"kind": "APIGroup", "name": g,
+                                "versions": vlist,
+                                "preferredVersion": vlist[0]})
+                    return
+                if parts[:1] == ["apis"] and len(parts) == 3:
+                    g, v = parts[1], parts[2]
+                    res = self._resources_for(g, v)
+                    if not res:
+                        self._status(404, "NotFound", f"{g}/{v}")
+                        return
+                    self._send({"kind": "APIResourceList",
+                                "groupVersion": f"{g}/{v}",
+                                "resources": res})
+                    return
+                # /openapi/v2: a swagger 2.0 doc with one path entry per
+                # served collection and shallow kind definitions
+                from kubernetes_tpu.api import scheme as _scheme
+
+                paths = {}
+                definitions = {}
+                for kind in _scheme.kinds():
+                    gvk = _scheme.gvk_for(kind)
+                    coll = _scheme.rest_path(kind, "{namespace}")
+                    paths[coll] = {
+                        "get": {"operationId": f"list-{kind}"},
+                        "post": {"operationId": f"create-{kind}"},
+                    }
+                    definitions[f"io.k8s.api.{gvk.group or 'core'}."
+                                f"{gvk.version}.{gvk.kind}"] = {
+                        "type": "object",
+                        "x-kubernetes-group-version-kind": [{
+                            "group": gvk.group, "version": gvk.version,
+                            "kind": gvk.kind,
+                        }],
+                    }
+                self._send({
+                    "swagger": "2.0",
+                    "info": {"title": "kubernetes-tpu", "version": "v1.15"},
+                    "paths": paths,
+                    "definitions": definitions,
+                })
 
             def _serve_metrics_api(self, ns: str, name: str):
                 """metrics.k8s.io/v1beta1 analog (staging/src/k8s.io/metrics
@@ -750,21 +938,21 @@ class APIServer:
                         # proportionally to requests, evenly when none
                         reqs = [
                             (float(c.requests["cpu"].milli)
-                             if "cpu" in c.requests else 0.0,
+                             if "cpu" in c.requests else 1.0,
                              float(c.requests["memory"])
-                             if "memory" in c.requests else 0.0)
+                             if "memory" in c.requests else 1.0)
                             for c in p.spec.containers
                         ]
-                        tot_c = sum(r[0] for r in reqs) or len(reqs) or 1
-                        tot_m = sum(r[1] for r in reqs) or len(reqs) or 1
+                        tot_c = sum(r[0] for r in reqs) or 1
+                        tot_m = sum(r[1] for r in reqs) or 1
                         items.append({
                             "metadata": {"name": p.name,
                                          "namespace": p.namespace},
                             "containers": [{
                                 "name": c.name,
                                 "usage": {
-                                    "cpu": f"{int(cpu * ((r[0] or 1) / tot_c))}m",
-                                    "memory": f"{int(mem * ((r[1] or 1) / tot_m))}",
+                                    "cpu": f"{int(cpu * (r[0] / tot_c))}m",
+                                    "memory": f"{int(mem * (r[1] / tot_m))}",
                                 },
                             } for c, r in zip(p.spec.containers, reqs)],
                             "usage": {"cpu": f"{int(cpu)}m",

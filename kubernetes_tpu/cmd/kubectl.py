@@ -128,6 +128,49 @@ def _manifest_path(server: str, obj: dict, ns: str) -> "tuple[str, str]":
     raise SystemExit(f"error: unknown resource kind {obj.get('kind')!r}")
 
 
+LAST_APPLIED = "kubectl.kubernetes.io/last-applied-configuration"
+
+
+def _three_way_merge(last: dict, live: dict, new: dict) -> dict:
+    """The apply patch computation (pkg/kubectl/cmd/apply/apply.go ->
+    strategicpatch CreateThreeWayMergePatch, in the JSON-merge shape the
+    reference uses for unstructured/CRD objects): keys the PREVIOUS apply
+    set (present in last-applied) but dropped from the new manifest are
+    DELETED from live; keys in the new manifest overlay live recursively;
+    everything else (e.g. server-populated status, scheduler-set
+    spec.nodeName) is preserved.  Lists replace wholesale (JSON-merge
+    semantics; the reference's patchMergeKey lists apply only to
+    registered go-structs)."""
+    out = dict(live)
+    for k in set(last) - set(new):
+        out.pop(k, None)
+    for k, v in new.items():
+        cur = out.get(k)
+        if isinstance(v, dict) and isinstance(cur, dict):
+            prev = last.get(k)
+            out[k] = _three_way_merge(
+                prev if isinstance(prev, dict) else {}, cur, v)
+        else:
+            out[k] = v
+    return out
+
+
+def _stamp_last_applied(base: dict, manifest: dict = None) -> dict:
+    """Return ``base`` carrying the last-applied annotation recording
+    ``manifest`` (default: base itself, the create path).  The NEXT apply
+    diffs deletions against what was recorded here, never against the
+    merged result."""
+    manifest = base if manifest is None else manifest
+    clean = json.loads(json.dumps(manifest))
+    anns = (clean.get("metadata") or {}).get("annotations")
+    if anns:
+        anns.pop(LAST_APPLIED, None)
+    out = json.loads(json.dumps(base))
+    out.setdefault("metadata", {}).setdefault("annotations", {})[
+        LAST_APPLIED] = json.dumps(clean, sort_keys=True)
+    return out
+
+
 def _pod_row(p: dict):
     meta, spec, status = p.get("metadata", {}), p.get("spec", {}), p.get("status", {})
     return (meta.get("namespace", ""), meta.get("name", ""),
@@ -174,6 +217,10 @@ def main(argv=None) -> int:
     g = sub.add_parser("get", parents=[common])
     g.add_argument("kind")
     g.add_argument("name", nargs="?", default="")
+    g.add_argument("-l", "--selector", default="",
+                   help="label selector, e.g. app=web,tier!=db")
+    g.add_argument("--field-selector", default="",
+                   help="field selector, e.g. spec.nodeName=n1")
 
     c = sub.add_parser("create", parents=[common])
     c.add_argument("-f", "--filename", required=True)
@@ -198,6 +245,9 @@ def main(argv=None) -> int:
     ap_ = sub.add_parser("apply", parents=[common])
     ap_.add_argument("-f", "--filename", required=True)
 
+    df = sub.add_parser("diff", parents=[common])
+    df.add_argument("-f", "--filename", required=True)
+
     args = p.parse_args(argv)
     global _TOKEN
     _TOKEN = ""  # never leak a credential across in-process invocations
@@ -214,7 +264,26 @@ def main(argv=None) -> int:
     ns = getattr(args, "namespace", "default")
 
     if args.verb == "get":
-        out = _req(args.server, "GET", _resolve_path(args.server, args.kind, ns, args.name))
+        if args.name and (getattr(args, "selector", "")
+                          or getattr(args, "field_selector", "")):
+            # real kubectl rejects name+selector; a silently unfiltered
+            # named get would LOOK filtered
+            print("error: selectors cannot be combined with a resource "
+                  "name", file=sys.stderr)
+            return 1
+        path = _resolve_path(args.server, args.kind, ns, args.name)
+        params = []
+        if getattr(args, "selector", ""):
+            from urllib.parse import quote
+
+            params.append(f"labelSelector={quote(args.selector)}")
+        if getattr(args, "field_selector", ""):
+            from urllib.parse import quote
+
+            params.append(f"fieldSelector={quote(args.field_selector)}")
+        if params:
+            path += "?" + "&".join(params)
+        out = _req(args.server, "GET", path)
         if out.get("kind") == "Status":
             print(out.get("message", ""), file=sys.stderr)
             return 1
@@ -273,26 +342,66 @@ def main(argv=None) -> int:
               f"/{args.name} scaled")
         return 0
 
-    if args.verb == "apply":
-        # create-or-update (server-side apply lite): POST, 409 -> PUT
+    if args.verb in ("apply", "diff"):
+        # the real apply: last-applied-configuration annotation + 3-way
+        # merge against the live object (apply.go); `diff` prints what
+        # apply WOULD change and makes no writes (cmd/diff)
         with open(args.filename) as f:
             obj = json.load(f)
         k = obj.get("kind", "Pod").lower()
         obj_ns = (obj.get("metadata") or {}).get("namespace") or ns
         name = (obj.get("metadata") or {}).get("name", "")
         kind, coll = _manifest_path(args.server, obj, obj_ns)
-        out = _req(args.server, "POST", coll, obj)
-        if out.get("kind") == "Status" and out.get("code") == 409:
-            out = _req(args.server, "PUT", f"{coll}/{name}", obj)
-            if out.get("kind") == "Status" and out.get("code", 200) >= 400:
-                print(out.get("message", ""), file=sys.stderr)
-                return 1
-            print(f"{k}/{name} configured")
-            return 0
-        if out.get("kind") == "Status" and out.get("code", 201) >= 400:
+        live = _req(args.server, "GET", f"{coll}/{name}")
+        exists = live.get("kind") != "Status"
+        if not exists:
+            if args.verb == "diff":
+                import difflib
+
+                new_doc = json.dumps(obj, indent=2, sort_keys=True)
+                sys.stdout.writelines(difflib.unified_diff(
+                    [], new_doc.splitlines(keepends=True),
+                    fromfile=f"live/{name}", tofile=f"merged/{name}"))
+                return 1    # differences found (kubectl diff exit code)
+            out = _req(args.server, "POST", coll, _stamp_last_applied(obj))
+            if out.get("kind") == "Status" and out.get("code") == 409:
+                # another writer created it between our GET and POST:
+                # fall through to the update path against the fresh live
+                live = _req(args.server, "GET", f"{coll}/{name}")
+                exists = live.get("kind") != "Status"
+            else:
+                if (out.get("kind") == "Status"
+                        and out.get("code", 201) >= 400):
+                    print(out.get("message", ""), file=sys.stderr)
+                    return 1
+                print(f"{k}/{name} created")
+                return 0
+        anns = (live.get("metadata") or {}).get("annotations") or {}
+        try:
+            last = json.loads(anns.get(LAST_APPLIED, "{}"))
+        except ValueError:
+            last = {}
+        merged = _three_way_merge(last, live, obj)
+        if args.verb == "diff":
+            import difflib
+
+            def doc(d):
+                d = json.loads(json.dumps(d))
+                (d.get("metadata") or {}).pop("annotations", None)
+                return json.dumps(
+                    d, indent=2, sort_keys=True).splitlines(keepends=True)
+
+            delta = list(difflib.unified_diff(
+                doc(live), doc(merged),
+                fromfile=f"live/{name}", tofile=f"merged/{name}"))
+            sys.stdout.writelines(delta)
+            return 1 if delta else 0
+        merged = _stamp_last_applied(merged, obj)
+        out = _req(args.server, "PUT", f"{coll}/{name}", merged)
+        if out.get("kind") == "Status" and out.get("code", 200) >= 400:
             print(out.get("message", ""), file=sys.stderr)
             return 1
-        print(f"{k}/{name} created")
+        print(f"{k}/{name} configured")
         return 0
 
     if args.verb == "bind":

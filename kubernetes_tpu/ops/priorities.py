@@ -205,8 +205,12 @@ def spread_score_from_counts(counts, cluster: ClusterTensors, zone_key_id: int):
     int-truncated.  Zone aggregation is a segment-sum over each node's zone
     pair id (scatter + gather, O(B*N))."""
     max_node = jnp.max(counts, axis=-1, keepdims=True)
+    # guarded denominator: the zero branch is selected away, but dividing
+    # by 0 first would trip the checkify float guards (tests/test_checkify)
     node_score = jnp.where(
-        max_node > 0, MAX_PRIORITY * (max_node - counts) / max_node, MAX_PRIORITY
+        max_node > 0,
+        MAX_PRIORITY * (max_node - counts) / jnp.maximum(max_node, 1.0),
+        MAX_PRIORITY,
     )
     # zone aggregation as a segment-sum over each node's zone pair id:
     # O(B*N) scatter+gather instead of two [.., N] x [N, TP] matmuls over
@@ -229,7 +233,8 @@ def spread_score_from_counts(counts, cluster: ClusterTensors, zone_key_id: int):
     max_zone = jnp.max(zsums, axis=-1).reshape(lead + (1,))
     zone_score = jnp.where(
         max_zone > 0,
-        MAX_PRIORITY * (max_zone - zcount_per_node) / max_zone,
+        MAX_PRIORITY * (max_zone - zcount_per_node)
+        / jnp.maximum(max_zone, 1.0),
         MAX_PRIORITY,
     )
     have_zones = jnp.any(node_in_zone)
@@ -309,7 +314,9 @@ def inter_pod_affinity_score(cluster: ClusterTensors, pods: PodBatch):
     mx = jnp.max(jnp.where(valid, sums, -big), axis=-1, keepdims=True)
     spread = mx - mn
     score = jnp.where(
-        spread > 0, jnp.floor(MAX_PRIORITY * (sums - mn) / spread), 0.0
+        spread > 0,
+        jnp.floor(MAX_PRIORITY * (sums - mn) / jnp.maximum(spread, 1e-30)),
+        0.0,
     )
     return jnp.where(valid, score, 0.0)
 

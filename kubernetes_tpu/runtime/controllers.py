@@ -715,6 +715,10 @@ class Deployment:
     max_surge: object = "25%"                 # int or percent (round UP)
     max_unavailable: object = "25%"           # int or percent (round DOWN)
     uid: str = field(default_factory=lambda: uuid.uuid4().hex)
+    # metadata.labels/annotations round-trip (kubectl apply's
+    # last-applied lives in annotations; the controller reads neither)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
 
     @property
     def key(self) -> Tuple[str, str]:

@@ -182,6 +182,10 @@ class VolumeManager:
                 continue
             key = (p.namespace, p.name)
             for i, v in enumerate(p.spec.volumes):
+                # key by the VOLUME slot (name-or-index), never by claim:
+                # a pod may mount one claim through two volume entries and
+                # each must reach Mounted for all_mounted to hold
+                vid = v.get("name") or f"vol-{i}"
                 claim = (v.get("persistentVolumeClaim") or {})
                 cn = claim.get("claimName")
                 if cn:
@@ -189,9 +193,8 @@ class VolumeManager:
                         "persistentvolumeclaims", p.namespace, cn)
                     pv = (pvc.volume_name
                           if pvc is not None and pvc.volume_name else None)
-                    out[(key, f"pvc:{cn}")] = pv or ""
+                    out[(key, vid)] = pv or ""
                 else:
-                    vid = v.get("name") or f"vol-{i}"
                     out[(key, vid)] = None
         return out
 

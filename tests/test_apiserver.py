@@ -472,3 +472,75 @@ def test_audit_log_records_writes(tmp_path):
     ]
     assert all(e["stage"] == "ResponseComplete" for e in events)
     assert events[0]["requestURI"] == "/api/v1/nodes"
+
+
+def test_field_and_label_selectors_on_list(server):
+    from fixtures import make_pod as _mk
+
+    u = server.url
+    code, _ = _req(f"{u}/api/v1/nodes", "POST",
+                   node_to_dict(make_node("sel-n1", cpu="4")))
+    for name, labels, node in (("sp1", {"app": "web"}, "sel-n1"),
+                               ("sp2", {"app": "web"}, ""),
+                               ("sp3", {"app": "db"}, "")):
+        d = pod_to_dict(_mk(name, cpu="100m", mem="64Mi", labels=labels))
+        if node:
+            d["spec"]["nodeName"] = node
+        code, _ = _req(f"{u}/api/v1/namespaces/default/pods", "POST", d)
+        assert code == 201
+    # fieldSelector on spec.nodeName
+    code, out = _req(
+        f"{u}/api/v1/namespaces/default/pods?fieldSelector=spec.nodeName%3Dsel-n1")
+    assert code == 200
+    assert [i["metadata"]["name"] for i in out["items"]] == ["sp1"]
+    # unassigned pods (the scheduler's informer filter shape)
+    code, out = _req(
+        f"{u}/api/v1/namespaces/default/pods?fieldSelector=spec.nodeName%21%3Dsel-n1")
+    assert {i["metadata"]["name"] for i in out["items"]} == {"sp2", "sp3"}
+    # labelSelector
+    code, out = _req(
+        f"{u}/api/v1/namespaces/default/pods?labelSelector=app%3Dweb")
+    assert {i["metadata"]["name"] for i in out["items"]} == {"sp1", "sp2"}
+    code, out = _req(
+        f"{u}/api/v1/namespaces/default/pods?labelSelector=app+in+%28db%29")
+    assert {i["metadata"]["name"] for i in out["items"]} == {"sp3"}
+    # malformed -> 400
+    code, _ = _req(
+        f"{u}/api/v1/namespaces/default/pods?fieldSelector=junk")
+    assert code == 400
+
+
+def test_discovery_and_openapi_docs(server):
+    u = server.url
+    code, out = _req(f"{u}/api")
+    assert out["versions"] == ["v1"]
+    code, out = _req(f"{u}/apis")
+    groups = {g["name"] for g in out["groups"]}
+    assert {"apps", "batch", "rbac.authorization.k8s.io",
+            "storage.k8s.io"} <= groups
+    code, out = _req(f"{u}/api/v1")
+    names = {r["name"] for r in out["resources"]}
+    assert {"pods", "nodes", "secrets", "persistentvolumes"} <= names
+    pod_res = next(r for r in out["resources"] if r["name"] == "pods")
+    assert pod_res["kind"] == "Pod" and pod_res["namespaced"]
+    node_res = next(r for r in out["resources"] if r["name"] == "nodes")
+    assert not node_res["namespaced"]
+    code, out = _req(f"{u}/apis/apps/v1")
+    assert {"deployments", "replicasets"} <= {
+        r["name"] for r in out["resources"]}
+    code, out = _req(f"{u}/apis/nope/v9")
+    assert code == 404
+    code, out = _req(f"{u}/openapi/v2")
+    assert out["swagger"] == "2.0"
+    assert "io.k8s.api.core.v1.Pod" in out["definitions"]
+    # a CRD extends discovery live
+    code, _ = _req(f"{u}/api/v1/customresourcedefinitions", "POST", {
+        "metadata": {"name": "widgets.example.com"},
+        "spec": {"group": "example.com", "version": "v1",
+                 "names": {"plural": "widgets", "kind": "Widget"},
+                 "scope": "Namespaced"},
+    })
+    assert code == 201
+    code, out = _req(f"{u}/apis/example.com/v1")
+    assert code == 200
+    assert [r["name"] for r in out["resources"]] == ["widgets"]

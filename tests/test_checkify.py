@@ -1,0 +1,110 @@
+"""Kernel sanitizer harness: checkify float/index guards over the device
+kernels on randomized cluster states.
+
+Reference analog: the Go race detector runs under every unit/integration
+test (hack/make-rules/test.sh KUBE_RACE=-race, SURVEY section 5); for
+XLA kernels the equivalent guardrail is jax.experimental.checkify's
+float_checks (NaN/Inf surfacing through any fused op) and index_checks
+(gather/scatter bounds) — nothing here asserts semantics (the
+differential suites do that); this asserts no non-finite value or OOB
+index can escape a kernel edit unnoticed."""
+
+import numpy as np
+import pytest
+from jax.experimental import checkify
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.codec.schema import FilterConfig
+from kubernetes_tpu.models.batched import encode_batch_ports
+from kubernetes_tpu.ops import filter_batch, score_batch, select_hosts_batch
+
+from fixtures import TEST_DIMS, make_node, make_pod
+
+ZONE = "failure-domain.beta.kubernetes.io/zone"
+
+
+def _random_world(seed: int, n_nodes=24, n_existing=40, n_pending=12):
+    rng = np.random.default_rng(seed)
+    enc = SnapshotEncoder(TEST_DIMS)
+    for i in range(n_nodes):
+        enc.add_node(make_node(
+            f"n{i}",
+            cpu=str(int(rng.integers(1, 32))),
+            mem=f"{int(rng.integers(1, 64))}Gi",
+            pods=int(rng.integers(4, 110)),
+            labels={ZONE: f"z{int(rng.integers(0, 4))}",
+                    "disk": "ssd" if rng.random() < 0.5 else "hdd"},
+            taints=[{"key": "dedicated", "value": "x",
+                     "effect": "NoSchedule"}] if rng.random() < 0.1 else [],
+        ))
+    enc.add_spread_selector("default", {"app": "web"})
+    for i in range(n_existing):
+        enc.add_pod(make_pod(
+            f"e{i}", cpu=f"{int(rng.integers(50, 2000))}m",
+            mem=f"{int(rng.integers(32, 2048))}Mi",
+            labels={"app": "web" if rng.random() < 0.5 else "db"},
+            node_name=f"n{int(rng.integers(0, n_nodes))}",
+        ))
+    pending = [
+        make_pod(
+            f"p{i}", cpu=f"{int(rng.integers(50, 4000))}m",
+            mem=f"{int(rng.integers(32, 4096))}Mi",
+            labels={"app": "web"},
+            node_selector={"disk": "ssd"} if rng.random() < 0.3 else None,
+            ports=[{"hostPort": int(rng.integers(8000, 8004)),
+                    "protocol": "TCP"}] if rng.random() < 0.2 else (),
+        )
+        for i in range(n_pending)
+    ]
+    batch = enc.encode_pods(pending)
+    cluster = enc.snapshot()
+    ports = encode_batch_ports(enc, pending)
+    return enc, cluster, batch, ports
+
+
+@pytest.mark.parametrize("seed", [0, 7, 1234])
+def test_filter_score_select_under_checkify(seed):
+    enc, cluster, batch, ports = _random_world(seed)
+    cfg = FilterConfig()
+    unsched = enc.interner.intern("node.kubernetes.io/unschedulable")
+
+    def kernel(cluster, batch):
+        mask, per_pred = filter_batch(cluster, batch, cfg, unsched)
+        total, parts = score_batch(cluster, batch,
+                                   zone_key_id=enc.getzone_key)
+        hosts, feasible = select_hosts_batch(total, mask, 0)
+        return mask, total, hosts, feasible
+
+    checked = checkify.checkify(
+        kernel, errors=checkify.float_checks | checkify.index_checks)
+    err, (mask, total, hosts, feasible) = checked(cluster, batch)
+    err.throw()   # any NaN/Inf or OOB gather inside the fused kernels
+    total = np.asarray(total)
+    assert np.isfinite(total).all()
+    hosts = np.asarray(hosts)
+    assert ((hosts >= -1) & (hosts < cluster.valid.shape[0])).all()
+    # feasibility consistent with the mask
+    m = np.asarray(mask)
+    f = np.asarray(feasible)
+    np.testing.assert_array_equal(f, m.any(axis=1))
+
+
+@pytest.mark.parametrize("engine", ["sequential", "speculative"])
+def test_engines_produce_finite_committed_state(engine):
+    from kubernetes_tpu.models.batched import make_sequential_scheduler
+    from kubernetes_tpu.models.speculative import make_speculative_scheduler
+
+    enc, cluster, batch, ports = _random_world(99)
+    maker = (make_sequential_scheduler if engine == "sequential"
+             else make_speculative_scheduler)
+    fn = maker(
+        unsched_taint_key=enc.interner.intern(
+            "node.kubernetes.io/unschedulable"),
+        zone_key_id=enc.getzone_key,
+    )
+    hosts, new_cluster = fn(cluster, batch, ports, np.int32(0))
+    req = np.asarray(new_cluster.requested)
+    assert np.isfinite(req).all()
+    assert (req >= 0).all()
+    hosts = np.asarray(hosts)
+    assert ((hosts >= -1) & (hosts < cluster.valid.shape[0])).all()

@@ -63,3 +63,66 @@ def test_scheduler_policy_file(tmp_path, capsys):
     assert rc == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["pods_scheduled"] == 8
+
+
+def test_kubectl_apply_three_way_merge_and_diff(tmp_path, capsys):
+    """apply.go semantics: last-applied-configuration annotation + 3-way
+    merge — fields dropped from the manifest are removed, fields OTHER
+    writers set (scheduler nodeName, scale) survive; diff previews."""
+    import json as _json
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cmd import kubectl
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster).start()
+    try:
+        manifest = {
+            "kind": "Deployment", "apiVersion": "apps/v1",
+            "metadata": {"name": "web", "namespace": "default",
+                         "labels": {"team": "a", "tier": "fe"}},
+            "spec": {"replicas": 2,
+                     "selector": {"matchLabels": {"app": "web"}},
+                     "template": {"metadata": {"labels": {"app": "web"}},
+                                  "spec": {"containers": [{"name": "c"}]}}},
+        }
+        f = tmp_path / "dep.json"
+        f.write_text(_json.dumps(manifest))
+        rc = kubectl.main(["-s", srv.url, "apply", "-f", str(f)])
+        assert rc == 0
+        dep = cluster.get("deployments", "default", "web")
+        assert dep.replicas == 2
+        assert kubectl.LAST_APPLIED in dep.annotations
+        # another writer scales it (HPA analog)
+        import dataclasses as _dc
+
+        cur, rv = cluster.get_with_rv("deployments", "default", "web")
+        cluster.update("deployments", _dc.replace(cur, replicas=5),
+                       expect_rv=rv)
+        # new manifest DROPS spec.replicas: the merge must keep 5 (other
+        # writer's value) since last-applied had it removed... but the
+        # previous apply SET replicas=2, so dropping it deletes the field
+        # -> server default applies.  Keep replicas, change template:
+        manifest2 = _json.loads(_json.dumps(manifest))
+        manifest2["spec"]["template"]["spec"]["containers"][0]["image"] = \
+            "repo/app:v2"
+        del manifest2["spec"]["replicas"]
+        f.write_text(_json.dumps(manifest2))
+        capsys.readouterr()
+        rc = kubectl.main(["-s", srv.url, "diff", "-f", str(f)])
+        out = capsys.readouterr().out
+        assert rc == 1 and "repo/app:v2" in out
+        rc = kubectl.main(["-s", srv.url, "apply", "-f", str(f)])
+        assert rc == 0
+        dep = cluster.get("deployments", "default", "web")
+        # template updated; replicas: previous apply owned it (2), the
+        # new manifest dropped it -> deleted -> decode default 1
+        assert dep.template["spec"]["containers"][0]["image"] == "repo/app:v2"
+        assert dep.replicas == 1
+        # diff now clean (modulo annotation) -> rc 0
+        capsys.readouterr()
+        rc = kubectl.main(["-s", srv.url, "diff", "-f", str(f)])
+        assert rc == 0
+    finally:
+        srv.stop()

@@ -91,7 +91,7 @@ def test_volume_manager_waits_for_attach_then_mounts():
     key = ("default", "p1")
     # emptyDir mounts immediately; the PV waits for the attach
     assert state[(key, "scratch")] == MOUNTED
-    assert state[(key, "pvc:c1")] == WAIT_FOR_ATTACH
+    assert state[(key, "vol-0")] == WAIT_FOR_ATTACH
     assert not vm.all_mounted(pod)
     # the attach-detach controller surfaces the attachment -> mount
     node, rv = cluster.get_with_rv("nodes", "", "n1")
@@ -99,7 +99,7 @@ def test_volume_manager_waits_for_attach_then_mounts():
         node, status=dataclasses.replace(
             node.status, volumes_attached=("disk1",))), expect_rv=rv)
     state = vm.sync()
-    assert state[(key, "pvc:c1")] == MOUNTED
+    assert state[(key, "vol-0")] == MOUNTED
     assert vm.all_mounted(pod)
     # pod leaves -> unmounted (state dropped)
     cluster.delete("pods", "default", "p1")
