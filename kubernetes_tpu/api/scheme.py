@@ -82,6 +82,8 @@ _REGISTRY: Dict[str, tuple] = {
         GroupVersionKind("", "v1", "PersistentVolumeClaim"), False),
     "storageclasses": (
         GroupVersionKind("storage.k8s.io", "v1", "StorageClass"), True),
+    "replicationcontrollers": (
+        GroupVersionKind("", "v1", "ReplicationController"), False),
 }
 
 

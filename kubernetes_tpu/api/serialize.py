@@ -175,6 +175,7 @@ def node_to_dict(node: Node) -> dict:
         "metadata": meta_to_dict(node.metadata),
         "spec": _drop_empty({
             "unschedulable": node.spec.unschedulable or None,
+            "podCIDR": node.spec.pod_cidr,
             "taints": [
                 _drop_empty({"key": t.key, "value": t.value,
                              "effect": t.effect})
@@ -334,6 +335,18 @@ def object_to_dict(kind: str, obj) -> dict:
             },
             "status": {"currentReplicas": obj.current_replicas,
                        "desiredReplicas": obj.desired_replicas},
+        }
+    if kind == "replicationcontrollers":
+        return {
+            "kind": "ReplicationController",
+            "apiVersion": "v1",
+            "metadata": {"name": obj.name, "namespace": obj.namespace,
+                         "uid": obj.uid},
+            "spec": {
+                "replicas": obj.replicas,
+                "selector": dict(obj.selector),   # plain map (core/v1)
+                "template": obj.template,
+            },
         }
     if kind == "replicasets":
         meta = {"name": obj.name, "namespace": obj.namespace,

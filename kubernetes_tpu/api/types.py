@@ -553,6 +553,7 @@ class ContainerImage:
 class NodeSpec:
     unschedulable: bool = False
     taints: Tuple[Taint, ...] = ()
+    pod_cidr: str = ""   # assigned by the nodeipam controller
 
     @staticmethod
     def from_dict(d: Optional[dict]) -> "NodeSpec":
@@ -560,6 +561,7 @@ class NodeSpec:
         return NodeSpec(
             unschedulable=bool(d.get("unschedulable", False)),
             taints=tuple(Taint.from_dict(t) for t in d.get("taints") or ()),
+            pod_cidr=d.get("podCIDR", ""),
         )
 
 

@@ -48,7 +48,8 @@ SYSTEM_PRIORITY_CLASSES = {
 }
 
 NAMESPACED_KINDS = (
-    "pods", "services", "replicasets", "deployments", "jobs", "endpoints",
+    "pods", "services", "replicasets", "replicationcontrollers",
+    "deployments", "jobs", "endpoints",
     "poddisruptionbudgets", "limitranges", "resourcequotas",
     "daemonsets", "statefulsets", "cronjobs",
     "horizontalpodautoscalers",

@@ -64,7 +64,8 @@ LIST_KINDS = {"pods": "PodList", "nodes": "NodeList",
               "clusterrolebindings": "ClusterRoleBindingList",
               "persistentvolumes": "PersistentVolumeList",
               "persistentvolumeclaims": "PersistentVolumeClaimList",
-              "storageclasses": "StorageClassList"}
+              "storageclasses": "StorageClassList",
+              "replicationcontrollers": "ReplicationControllerList"}
 
 # kinds stored as plain dicts carrying the original wire body plus flat
 # namespace/name keys for the store (cluster-scoped kinds use "")
@@ -112,6 +113,22 @@ def _decode(kind: str, d: dict):
             if ref.get("controller"):
                 rs.owner_uid = ref.get("uid", "")
         return rs
+    if kind == "replicationcontrollers":
+        from kubernetes_tpu.runtime.controllers import ReplicationController
+
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        # RC selector is a PLAIN map (core/v1), not a LabelSelector
+        rc = ReplicationController(
+            namespace=meta.get("namespace", "default"),
+            name=meta.get("name", ""),
+            replicas=int(spec.get("replicas", 1)),
+            selector=dict(spec.get("selector") or {}),
+            template=spec.get("template") or {},
+        )
+        if meta.get("uid"):
+            rc.uid = meta["uid"]
+        return rc
     if kind == "deployments":
         from kubernetes_tpu.runtime.controllers import Deployment
 
@@ -637,6 +654,46 @@ class APIServer:
                     if self._authorize("get", "metrics.k8s.io") is None:
                         return
                     self._serve_metrics_api(ns, name)
+                    return
+                if kind == "events":
+                    # the events API is served from the recorder (the
+                    # components' user-visible audit trail, tools/record):
+                    # a virtual read-only kind
+                    if self._authorize("list", "events", ns) is None:
+                        return
+                    evs = outer.cluster.events.events(
+                        namespace=ns or None, name=name or None)
+                    items = [{
+                        "metadata": {"namespace": e.namespace,
+                                     "name": f"{e.name}.{i}"},
+                        "involvedObject": {"kind": e.kind,
+                                           "namespace": e.namespace,
+                                           "name": e.name},
+                        "type": e.type, "reason": e.reason,
+                        "message": e.message, "count": e.count,
+                        "firstTimestamp": e.first_timestamp,
+                        "lastTimestamp": e.last_timestamp,
+                    } for i, e in enumerate(evs)]
+                    # fieldSelector works here too (`kubectl get events
+                    # --field-selector type=Warning` is the canonical use)
+                    query = self.path.partition("?")[2]
+                    if query:
+                        from urllib.parse import parse_qs
+
+                        fs = parse_qs(query).get("fieldSelector", [""])[0]
+                        if fs:
+                            from kubernetes_tpu.api.fields import (
+                                FieldSelector,
+                            )
+
+                            try:
+                                sel = FieldSelector.parse(fs)
+                            except ValueError as e:
+                                self._status(400, "BadRequest", str(e))
+                                return
+                            items = [d for d in items if sel.matches(d)]
+                    self._send({"kind": "EventList", "apiVersion": "v1",
+                                "items": items})
                     return
                 if kind == "@proxy":
                     # the backend does its own authz; still authenticate +

@@ -43,9 +43,14 @@ _ALIASES = {
     "quota": "resourcequotas", "pc": "priorityclasses",
     "crd": "customresourcedefinitions", "crds": "customresourcedefinitions",
     "service": "services",
+    "rc": "replicationcontrollers",
+    "replicationcontroller": "replicationcontrollers",
 }
 
 KIND_PATHS = {k: _scheme.rest_path(k, "{ns}") for k in _scheme.kinds()}
+# the events API is a virtual read-only kind served from the recorder
+KIND_PATHS["events"] = "/api/v1/namespaces/{ns}/events"
+KIND_PATHS["event"] = KIND_PATHS["ev"] = KIND_PATHS["events"]
 KIND_PATHS.update({a: KIND_PATHS[k] for a, k in _ALIASES.items()})
 
 
@@ -294,6 +299,16 @@ def main(argv=None) -> int:
         if args.kind in ("nodes", "node"):
             _print_table([_node_row(i) for i in items],
                          ("NAME", "STATUS", "CPU", "MEMORY"))
+        elif args.kind in ("events", "event", "ev"):
+            rows = [
+                (e.get("type", ""), e.get("reason", ""),
+                 f"{(e.get('involvedObject') or {}).get('kind', '')}/"
+                 f"{(e.get('involvedObject') or {}).get('name', '')}",
+                 str(e.get("count", 1)), e.get("message", "")[:60])
+                for e in items
+            ]
+            _print_table(rows, ("TYPE", "REASON", "OBJECT", "COUNT",
+                                "MESSAGE"))
         else:
             _print_table([_pod_row(i) for i in items],
                          ("NAMESPACE", "NAME", "STATUS", "NODE"))

@@ -54,7 +54,7 @@ class LocalCluster:
              "secrets", "serviceaccounts", "roles", "rolebindings",
              "clusterroles", "clusterrolebindings",
              "persistentvolumes", "persistentvolumeclaims",
-             "storageclasses")
+             "storageclasses", "replicationcontrollers")
 
     def __init__(self) -> None:
         self._lock = threading.RLock()

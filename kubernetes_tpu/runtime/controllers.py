@@ -266,8 +266,16 @@ class ControllerExpectations:
 class ReplicaSetController(Reconciler):
     """pkg/controller/replicaset syncReplicaSet: observed = store pods owned
     by the RS (owner_uid) and matching the selector; diff against
-    spec.replicas; create/delete through the store."""
+    spec.replicas; create/delete through the store.
 
+    The class is kind-parameterized: ReplicationControllerController below
+    reuses the whole reconcile (the reference's replication controller is
+    the same loop over the older core/v1 kind,
+    pkg/controller/replication/replication_controller.go delegating to
+    replicaset.NewBaseController)."""
+
+    KIND = "replicasets"
+    OWNER_KIND = "ReplicaSet"
     WATCH_KINDS = ("replicasets", "pods")
 
     def __init__(self, cluster: LocalCluster, informers=None):
@@ -278,13 +286,13 @@ class ReplicaSetController(Reconciler):
     # ------------------------------------------------------ informer seam
 
     def _resolve_owner(self, obj):
-        for rs in self.cluster.list("replicasets"):
+        for rs in self.cluster.list(self.KIND):
             if rs.uid == obj.metadata.owner_uid:
                 return rs
         return None
 
     def _on_event(self, event: str, kind: str, obj) -> None:
-        if kind == "replicasets":
+        if kind == self.KIND:
             self.queue.add(obj.key)
         elif kind == "pods" and getattr(obj.metadata, "owner_uid", ""):
             # resolve owner RS by uid (resolveControllerRef)
@@ -312,15 +320,15 @@ class ReplicaSetController(Reconciler):
 
     def sync(self, key: Tuple[str, str]) -> None:
         ns, name = key
-        rs = self.cluster.get("replicasets", ns, name)
+        rs = self.cluster.get(self.KIND, ns, name)
         if rs is None:
-            # RS deleted: cascade-delete pods whose owner uid no longer
-            # resolves to a live ReplicaSet (the garbagecollector analog)
-            live = {r.uid for r in self.cluster.list("replicasets")}
+            # deleted: cascade-delete pods whose owner uid no longer
+            # resolves to a live owner (the garbagecollector analog)
+            live = {r.uid for r in self.cluster.list(self.KIND)}
             for p in self.cluster.list("pods"):
                 if (
                     p.namespace == ns
-                    and p.metadata.owner_kind == "ReplicaSet"
+                    and p.metadata.owner_kind == self.OWNER_KIND
                     and p.metadata.owner_uid not in live
                 ):
                     self.cluster.delete("pods", p.namespace, p.name)
@@ -345,7 +353,7 @@ class ReplicaSetController(Reconciler):
                     meta["name"] = f"{rs.name}-{self._seq:05d}"
                     meta["namespace"] = rs.namespace
                     meta["ownerReferences"] = [
-                        {"kind": "ReplicaSet", "name": rs.name,
+                        {"kind": self.OWNER_KIND, "name": rs.name,
                          "uid": rs.uid, "controller": True}
                     ]
                     d["metadata"] = meta
@@ -377,6 +385,22 @@ class ReplicaSetController(Reconciler):
 
 def add_replicaset(cluster: LocalCluster, rs: ReplicaSet) -> None:
     cluster.create("replicasets", rs)
+
+
+@dataclass
+class ReplicationController(ReplicaSet):
+    """core/v1 ReplicationController: the pre-apps workload kind — same
+    reconcile semantics as ReplicaSet with a plain-map selector
+    (pkg/apis/core/types.go ReplicationControllerSpec.Selector)."""
+
+
+class ReplicationControllerController(ReplicaSetController):
+    """pkg/controller/replication: replicaset.NewBaseController over the
+    core kind."""
+
+    KIND = "replicationcontrollers"
+    OWNER_KIND = "ReplicationController"
+    WATCH_KINDS = ("replicationcontrollers", "pods")
 
 
 # ------------------------------------------------------------ node lifecycle
@@ -533,6 +557,8 @@ class ControllerManager:
             self.informers = SharedInformerFactory(cluster)
         self.replicaset = ReplicaSetController(cluster,
                                                informers=self.informers)
+        self.replication = ReplicationControllerController(
+            cluster, informers=self.informers)
         self.nodelifecycle = NodeLifecycleController(cluster, grace_period)
         self.disruption = DisruptionController(cluster)
         self.deployment = DeploymentController(cluster)
@@ -556,8 +582,16 @@ class ControllerManager:
             TokenController,
         )
 
+        from kubernetes_tpu.runtime.volumecontrollers import (
+            NodeIpamController,
+            TokenCleaner,
+        )
+
         self.pv = PersistentVolumeController(cluster,
                                              informers=self.informers)
+        self.tokencleaner = TokenCleaner(cluster, informers=self.informers)
+        self.nodeipam = NodeIpamController(cluster,
+                                           informers=self.informers)
         self.attachdetach = AttachDetachController(cluster,
                                                    informers=self.informers)
         self.serviceaccount = ServiceAccountController(
@@ -571,6 +605,7 @@ class ControllerManager:
             self.informers.start()
             self.informers.wait_for_cache_sync(30.0)
         self._threads += self.replicaset.run(self._stop, workers=rs_workers)
+        self._threads += self.replication.run(self._stop)
         self._threads.append(
             self.nodelifecycle.run(self._stop, period=monitor_period)
         )
@@ -588,6 +623,19 @@ class ControllerManager:
         self._threads.append(self.hpa.run(self._stop))
         self._threads.append(self.ttl.run(self._stop))
         self._threads += self.pv.run(self._stop)
+        self._threads += self.tokencleaner.run(self._stop)
+        self._threads += self.nodeipam.run(self._stop)
+
+        def token_sweep():
+            while not self._stop.wait(30.0):
+                try:
+                    self.tokencleaner.tick()
+                except Exception:
+                    pass
+
+        t_sw = threading.Thread(target=token_sweep, daemon=True)
+        t_sw.start()
+        self._threads.append(t_sw)
         self._threads += self.attachdetach.run(self._stop)
         self._threads += self.serviceaccount.run(self._stop)
         self._threads += self.token.run(self._stop)
@@ -605,6 +653,7 @@ class ControllerManager:
         if self.informers is not None:
             self.informers.stop()
         self.replicaset.queue.close()
+        self.replication.queue.close()
         self.disruption.queue.close()
         self.deployment.queue.close()
         self.job.queue.close()
@@ -615,6 +664,8 @@ class ControllerManager:
         self.daemonset.queue.close()
         self.statefulset.queue.close()
         self.pv.queue.close()
+        self.tokencleaner.queue.close()
+        self.nodeipam.queue.close()
         self.attachdetach.queue.close()
         self.serviceaccount.queue.close()
         self.token.queue.close()
@@ -1089,6 +1140,7 @@ class GarbageCollector(Reconciler):
     # owner store kind -> the owner_kind string its dependents carry
     OWNER_KINDS = {
         "replicasets": "ReplicaSet",
+        "replicationcontrollers": "ReplicationController",
         "jobs": "Job",
         "daemonsets": "DaemonSet",
         "statefulsets": "StatefulSet",

@@ -328,6 +328,88 @@ class AttachDetachController(Reconciler):
         )
 
 
+class TokenCleaner(Reconciler):
+    """Delete expired bootstrap-token Secrets
+    (pkg/controller/bootstrap/tokencleaner.go): a token whose
+    ``expiration`` (epoch seconds or RFC3339) has passed stops
+    authenticating by ceasing to exist."""
+
+    WATCH_KINDS = ("secrets",)
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if (kind == "secrets" and event != DELETED
+                and isinstance(obj, dict)
+                and obj.get("type") == "bootstrap.kubernetes.io/token"):
+            self.queue.add((obj.get("namespace", ""), obj.get("name", "")))
+
+    def tick(self, now: float = None) -> int:
+        """Periodic sweep (the controller also re-queues on events);
+        returns deletions."""
+        import time as _time
+
+        from kubernetes_tpu.api.types import parse_time
+
+        now = _time.time() if now is None else now
+        n = 0
+        for s in list(self.cluster.list("secrets")):
+            if not isinstance(s, dict):
+                continue
+            if s.get("type") != "bootstrap.kubernetes.io/token":
+                continue
+            exp = parse_time((s.get("data") or {}).get("expiration"))
+            if exp is not None and exp <= now:
+                self.cluster.delete(
+                    "secrets", s.get("namespace", ""), s.get("name", ""))
+                n += 1
+        return n
+
+    def sync(self, key) -> None:
+        self.tick()
+
+
+class NodeIpamController(Reconciler):
+    """Assign each node a pod CIDR from the cluster CIDR
+    (pkg/controller/nodeipam/ipam/range_allocator.go): the cluster range
+    is carved into per-node subnets of node_cidr_mask_size; a node
+    keeps its assignment for life, freed slots are reused."""
+
+    WATCH_KINDS = ("nodes",)
+
+    def __init__(self, cluster, cluster_cidr: str = "10.244.0.0/16",
+                 node_mask: int = 24, informers=None):
+        import ipaddress
+
+        self.network = ipaddress.ip_network(cluster_cidr)
+        self.node_mask = node_mask
+        self._subnets = list(self.network.subnets(new_prefix=node_mask))
+        super().__init__(cluster, informers=informers)
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "nodes" and event != DELETED and not obj.spec.pod_cidr:
+            self.queue.add(obj.name)
+
+    def sync(self, name: str) -> None:
+        node, rv = self.cluster.get_with_rv("nodes", "", name)
+        if node is None or node.spec.pod_cidr:
+            return
+        used = {n.spec.pod_cidr for n in self.cluster.list("nodes")
+                if n.spec.pod_cidr}
+        for subnet in self._subnets:
+            cidr = str(subnet)
+            if cidr not in used:
+                self.cluster.update(
+                    "nodes",
+                    dataclasses.replace(
+                        node, spec=dataclasses.replace(
+                            node.spec, pod_cidr=cidr)),
+                    expect_rv=rv,
+                )
+                return
+        raise RuntimeError(
+            f"cluster CIDR {self.network} exhausted "
+            f"({len(self._subnets)} /{self.node_mask} ranges)")
+
+
 class ServiceAccountController(Reconciler):
     """Every active namespace carries a 'default' ServiceAccount
     (serviceaccounts_controller.go)."""

@@ -103,3 +103,48 @@ def test_health_server_unhealthy():
             assert e.code == 500
     finally:
         srv.stop()
+
+
+def test_events_api_and_kubectl(capsys):
+    """The events API: recorder-backed read-only kind over REST +
+    kubectl get events (tools/record -> the user-visible audit trail)."""
+    import json
+    import urllib.request
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cmd import kubectl
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+
+    cluster = LocalCluster()
+    cluster.events.eventf("Pod", "default", "p1", "Normal", "Scheduled",
+                          "assigned to n1")
+    cluster.events.eventf("Pod", "default", "p1", "Normal", "Scheduled",
+                          "assigned to n1")   # aggregates: count 2
+    cluster.events.eventf("Node", "", "n1", "Warning", "MemoryPressure",
+                          "node is low on memory")
+    srv = APIServer(cluster=cluster).start()
+    try:
+        with urllib.request.urlopen(
+            f"{srv.url}/api/v1/namespaces/default/events", timeout=5,
+        ) as resp:
+            out = json.loads(resp.read())
+        assert out["kind"] == "EventList"
+        assert len(out["items"]) == 1
+        ev = out["items"][0]
+        assert ev["reason"] == "Scheduled" and ev["count"] == 2
+        assert ev["involvedObject"] == {"kind": "Pod",
+                                        "namespace": "default",
+                                        "name": "p1"}
+        # cluster-wide listing includes the node event
+        with urllib.request.urlopen(f"{srv.url}/api/v1/events",
+                                    timeout=5) as resp:
+            allout = json.loads(resp.read())
+        assert len(allout["items"]) == 2
+        # kubectl renders the table
+        capsys.readouterr()
+        rc = kubectl.main(["-s", srv.url, "get", "events"])
+        out_text = capsys.readouterr().out
+        assert rc == 0
+        assert "Scheduled" in out_text and "Pod/p1" in out_text
+    finally:
+        srv.stop()

@@ -313,3 +313,110 @@ def test_prebound_pv_whose_claim_bound_elsewhere_resets_available():
     pv_a = cluster.get("persistentvolumes", "", "pv-a")
     assert pv_a is not None                 # NOT deleted despite Delete
     assert pv_a.phase == "Available" and pv_a.claim_ref == ""
+
+
+def test_token_cleaner_reaps_expired_bootstrap_tokens():
+    import time as _t
+
+    from kubernetes_tpu.runtime.volumecontrollers import TokenCleaner
+
+    cluster = LocalCluster()
+    tc = TokenCleaner(cluster)
+    now = _t.time()
+    cluster.create("secrets", {
+        "namespace": "kube-system", "name": "bootstrap-token-old",
+        "type": "bootstrap.kubernetes.io/token",
+        "data": {"token-id": "old", "token-secret": "x" * 16,
+                 "expiration": now - 10},
+    })
+    cluster.create("secrets", {
+        "namespace": "kube-system", "name": "bootstrap-token-live",
+        "type": "bootstrap.kubernetes.io/token",
+        "data": {"token-id": "live", "token-secret": "y" * 16,
+                 "expiration": now + 3600},
+    })
+    cluster.create("secrets", {   # no expiration: never reaped
+        "namespace": "kube-system", "name": "bootstrap-token-forever",
+        "type": "bootstrap.kubernetes.io/token",
+        "data": {"token-id": "forever", "token-secret": "z" * 16},
+    })
+    assert tc.tick() == 1
+    assert cluster.get("secrets", "kube-system", "bootstrap-token-old") is None
+    assert cluster.get("secrets", "kube-system",
+                       "bootstrap-token-live") is not None
+    assert cluster.get("secrets", "kube-system",
+                       "bootstrap-token-forever") is not None
+
+
+def test_nodeipam_assigns_unique_pod_cidrs():
+    from kubernetes_tpu.runtime.volumecontrollers import NodeIpamController
+
+    cluster = LocalCluster()
+    ctrl = NodeIpamController(cluster, cluster_cidr="10.244.0.0/22",
+                              node_mask=24)
+    for i in range(4):
+        cluster.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    _drain(ctrl)
+    cidrs = [cluster.get("nodes", "", f"n{i}").spec.pod_cidr
+             for i in range(4)]
+    assert all(cidrs)
+    assert len(set(cidrs)) == 4                 # unique per node
+    assert cidrs[0].startswith("10.244.")
+    # a node keeps its assignment across re-syncs
+    ctrl.queue.add("n0")
+    _drain(ctrl)
+    assert cluster.get("nodes", "", "n0").spec.pod_cidr == cidrs[0]
+    # freed slot is reused by the next node
+    cluster.delete("nodes", "", "n2")
+    cluster.add_node(make_node("n9", cpu="4", mem="8Gi"))
+    _drain(ctrl)
+    assert cluster.get("nodes", "", "n9").spec.pod_cidr == cidrs[2]
+
+
+def test_replication_controller_reconciles():
+    """The core/v1 workload kind rides the parameterized RS reconcile."""
+    from kubernetes_tpu.runtime.controllers import (
+        ReplicationController,
+        ReplicationControllerController,
+    )
+
+    cluster = LocalCluster()
+    ctrl = ReplicationControllerController(cluster)
+    cluster.create("replicationcontrollers", ReplicationController(
+        namespace="default", name="web-rc", replicas=3,
+        selector={"app": "web"},
+        template={"metadata": {"labels": {"app": "web"}},
+                  "spec": {"containers": [{"name": "c"}]}},
+    ))
+    _drain(ctrl)
+    pods = [p for p in cluster.list("pods")
+            if p.labels.get("app") == "web"]
+    assert len(pods) == 3
+    assert all(p.metadata.owner_kind == "ReplicationController"
+               for p in pods)
+    # scale down through the store
+    import dataclasses as _dc
+
+    rc, rv = cluster.get_with_rv("replicationcontrollers", "default",
+                                 "web-rc")
+    cluster.update("replicationcontrollers", _dc.replace(rc, replicas=1),
+                   expect_rv=rv)
+    _drain(ctrl)
+    assert len([p for p in cluster.list("pods")
+                if p.labels.get("app") == "web"]) == 1
+    # deleting the RC cascades its pods
+    cluster.delete("replicationcontrollers", "default", "web-rc")
+    _drain(ctrl)
+    assert not [p for p in cluster.list("pods")
+                if p.labels.get("app") == "web"]
+
+
+def test_rc_namespace_teardown_and_gc_coverage():
+    """Integration guards from review: RC participates in namespace
+    teardown (NAMESPACED_KINDS) and the GC backstop (OWNER_KINDS)."""
+    from kubernetes_tpu.apiserver.admission import NAMESPACED_KINDS
+    from kubernetes_tpu.runtime.controllers import GarbageCollector
+
+    assert "replicationcontrollers" in NAMESPACED_KINDS
+    assert GarbageCollector.OWNER_KINDS.get(
+        "replicationcontrollers") == "ReplicationController"
