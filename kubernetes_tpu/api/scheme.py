@@ -84,6 +84,9 @@ _REGISTRY: Dict[str, tuple] = {
         GroupVersionKind("storage.k8s.io", "v1", "StorageClass"), True),
     "replicationcontrollers": (
         GroupVersionKind("", "v1", "ReplicationController"), False),
+    "certificatesigningrequests": (
+        GroupVersionKind("certificates.k8s.io", "v1beta1",
+                         "CertificateSigningRequest"), True),
 }
 
 

@@ -319,6 +319,10 @@ def bootstrap_policy() -> List[Tuple[str, dict]]:
                 {"verbs": ["create", "get"], "resources": ["nodes"]},
                 {"verbs": ["create", "update", "get"],
                  "resources": ["leases"]},
+                # the TLS-bootstrap analog: submit a CSR and poll it
+                # (certificates flow, runtime/certificates.py)
+                {"verbs": ["create", "get"],
+                 "resources": ["certificatesigningrequests"]},
             ],
         }),
         ("clusterrolebindings", {

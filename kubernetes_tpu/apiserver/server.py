@@ -65,7 +65,9 @@ LIST_KINDS = {"pods": "PodList", "nodes": "NodeList",
               "persistentvolumes": "PersistentVolumeList",
               "persistentvolumeclaims": "PersistentVolumeClaimList",
               "storageclasses": "StorageClassList",
-              "replicationcontrollers": "ReplicationControllerList"}
+              "replicationcontrollers": "ReplicationControllerList",
+              "certificatesigningrequests":
+                  "CertificateSigningRequestList"}
 
 # kinds stored as plain dicts carrying the original wire body plus flat
 # namespace/name keys for the store (cluster-scoped kinds use "")
@@ -82,6 +84,7 @@ _DICT_KINDS = {
     "rolebindings": "default",
     "clusterroles": "",               # cluster-scoped
     "clusterrolebindings": "",        # cluster-scoped
+    "certificatesigningrequests": "",  # cluster-scoped
 }
 
 
@@ -722,6 +725,20 @@ class APIServer:
                     # expose the revision so read-modify-write clients can
                     # round-trip it into PUT's CAS (etcd3 mod_revision analog)
                     out["metadata"]["resourceVersion"] = str(rv)
+                    if kind == "certificatesigningrequests":
+                        # status.certificate carries a BEARER credential in
+                        # this framework (the reference's PEM is public):
+                        # only the requestor (or an admin) may read it
+                        user = outer.current_user()
+                        requestor = (out.get("spec") or {}).get(
+                            "requestorUsername", "")
+                        if (outer.authenticator is not None
+                                and user is not None
+                                and user.name != requestor
+                                and not user.in_group("system:masters")):
+                            status = dict(out.get("status") or {})
+                            status.pop("certificate", None)
+                            out["status"] = status
                     self._send(out)
                 else:
                     def ns_of(o):
@@ -1179,6 +1196,19 @@ class APIServer:
                     meta = body.setdefault("metadata", {})
                     if ns and not meta.get("namespace"):
                         meta["namespace"] = ns
+                    if kind == "certificatesigningrequests":
+                        # the registry stamps the REQUESTOR identity from
+                        # authn (csr strategy PrepareForCreate) — a client
+                        # must not be able to claim someone else's — and
+                        # strips any client-supplied status (a preset
+                        # certificate/Approved condition would be adopted
+                        # as if the signer granted it)
+                        body.pop("status", None)
+                        user = outer.current_user()
+                        if user is not None:
+                            csr_spec = body.setdefault("spec", {})
+                            csr_spec["requestorUsername"] = user.name
+                            csr_spec["requestorGroups"] = list(user.groups)
                     # one write at a time: quota/limit admission is a
                     # read-then-create; serializing the write path makes it
                     # atomic (etcd serializes writes the same way)

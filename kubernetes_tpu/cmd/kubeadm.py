@@ -62,6 +62,10 @@ def build_parser() -> argparse.ArgumentParser:
     join.add_argument("--memory", default="32Gi")
     join.add_argument("--one-shot", action="store_true",
                       help="register + first heartbeat, then exit")
+    join.add_argument("--csr-timeout", type=float, default=3.0,
+                      help="seconds to wait for the node credential "
+                      "(0 skips the CSR flow; joins keep the "
+                      "bootstrap token when no signer answers)")
 
     tok = sub.add_parser("token")
     tok.add_argument("action", choices=("list", "create"))
@@ -259,18 +263,57 @@ def cmd_join(args) -> int:
         return 1
     klog.infof("[join] node %s registered at %s", node_name, args.server)
 
+    # TLS bootstrap analog (runtime/certificates.py): trade the bootstrap
+    # token for a node identity via a CSR; the signer rotates in a fresh
+    # node credential and returns it in status.certificate.  Unique CSR
+    # names per attempt (the kubelet generates node-csr-<rand> the same
+    # way) so re-joins mint fresh credentials instead of reading stale
+    # ones.  Falls back to the bootstrap token against planes without the
+    # certificates controller (--csr-timeout 0 skips the flow).
+    node_token = args.token
+    if args.csr_timeout > 0:
+        csr_name = f"node-csr-{node_name}-{secrets.token_hex(3)}"
+        out = _req(args.server, "POST",
+                   "/api/v1/certificatesigningrequests", {
+                       "metadata": {"name": csr_name},
+                       "spec": {
+                           "signerName":
+                           "kubernetes.io/kube-apiserver-client-kubelet",
+                           "username": f"system:node:{node_name}"},
+                   }, token=args.token)
+        if not (out.get("kind") == "Status"
+                and out.get("code", 201) >= 400):
+            deadline = time.monotonic() + args.csr_timeout
+            while time.monotonic() < deadline:
+                csr = _req(
+                    args.server, "GET",
+                    f"/api/v1/certificatesigningrequests/{csr_name}",
+                    token=args.token)
+                cert = (csr.get("status") or {}).get("certificate", "")
+                if cert:
+                    node_token = cert
+                    klog.infof("[join] node credential issued "
+                               "(system:node:%s)", node_name)
+                    break
+                time.sleep(0.2)
+            else:
+                klog.infof("[join] no certificates controller answered "
+                           "in %.0fs; staying on the bootstrap token",
+                           args.csr_timeout)
+
     def heartbeat_loop():
         while True:
             _req(args.server, "PUT",
                  f"/api/v1/namespaces/kube-node-lease/leases/{node_name}",
                  {"namespace": "kube-node-lease", "name": node_name,
-                  "renew_time": time.monotonic()}, token=args.token)
+                  "renew_time": time.monotonic()}, token=node_token)
             time.sleep(5.0)
 
-    # first heartbeat synchronously (lease create-or-update)
+    # first heartbeat synchronously (lease create-or-update), already
+    # under the NODE identity when the CSR flow issued one
     _req(args.server, "POST", "/api/v1/namespaces/kube-node-lease/leases",
          {"namespace": "kube-node-lease", "name": node_name,
-          "renew_time": time.monotonic()}, token=args.token)
+          "renew_time": time.monotonic()}, token=node_token)
     if args.one_shot:
         print(f"node {node_name} joined")
         return 0

@@ -1,0 +1,118 @@
+"""Certificates: the TLS-bootstrap flow distilled to its auth outcome.
+
+Reference: the kubelet TLS bootstrap — a machine holding only a
+bootstrap token submits a CertificateSigningRequest for the identity
+``system:node:<name>`` (certificates.k8s.io/v1beta1); the
+kube-controller-manager's csrapproving controller auto-approves
+node-client CSRs from bootstrap identities
+(pkg/controller/certificates/approver/sarapprove.go) and the csrsigning
+controller signs them (pkg/controller/certificates/signer/signer.go),
+returning the credential in ``status.certificate``; the kubelet then
+drops the bootstrap token and authenticates as its node identity, which
+RBAC (system:nodes) and NodeRestriction scope per-object.
+
+This framework's client credentials are bearer tokens, so the "signed
+certificate" is a minted node auth-token Secret
+(``kubernetes-tpu/auth-token`` with user ``system:node:<name>``, the
+form TokenAuthenticator resolves); the token itself is returned in
+``status.certificate`` exactly where the reference returns the PEM —
+readable by the requester polling its own CSR.
+"""
+
+from __future__ import annotations
+
+import secrets as _secrets
+
+from kubernetes_tpu.runtime.cluster import DELETED, ConflictError, LocalCluster
+from kubernetes_tpu.runtime.controllers import Reconciler
+
+NODE_CLIENT_SIGNER = "kubernetes.io/kube-apiserver-client-kubelet"
+
+
+class CSRApproverSigner(Reconciler):
+    """csrapproving + csrsigning collapsed into one reconciler: approve
+    node-client CSRs from bootstrap/admin identities, mint the node
+    credential, surface it in status.certificate."""
+
+    WATCH_KINDS = ("certificatesigningrequests",)
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "certificatesigningrequests" and event != DELETED:
+            self.queue.add(obj.get("name", ""))
+
+    @staticmethod
+    def _requested_node(csr: dict) -> str:
+        """The node identity a CSR requests (spec.username in the
+        reference's x509 CN form system:node:<name>)."""
+        username = (csr.get("spec") or {}).get("username", "")
+        if username.startswith("system:node:"):
+            return username[len("system:node:"):]
+        return ""
+
+    def sync(self, name: str) -> None:
+        csr = self.cluster.get("certificatesigningrequests", "", name)
+        if csr is None:
+            return
+        status = csr.get("status") or {}
+        conds = {c.get("type") for c in status.get("conditions") or []}
+        if status.get("certificate") or "Denied" in conds:
+            return  # terminal: signed or denied (re-writing the same
+            # denial would re-trigger this controller forever)
+        spec = csr.get("spec") or {}
+        node = self._requested_node(csr)
+        requestor = spec.get("requestorUsername", "")
+        groups = spec.get("requestorGroups") or []
+        # approval policy (sarapprove.go): the node-client signer NAMED
+        # EXPLICITLY (signerName is required in the reference; a
+        # default-allow here would sign unrelated signers' CSRs), a node
+        # identity requested, and a requestor entitled to bootstrap —
+        # system:bootstrappers (kubeadm join) or system:masters
+        ok = (
+            spec.get("signerName", "") == NODE_CLIENT_SIGNER
+            and node
+            and ("system:bootstrappers" in groups
+                 or "system:masters" in groups
+                 or requestor.startswith("system:bootstrap:"))
+        )
+        out = dict(csr)
+        if not ok:
+            out["status"] = {**status, "conditions": [
+                {"type": "Denied",
+                 "reason": "SignerValidationFailure",
+                 "message": "not a node-client CSR from a bootstrap "
+                            "identity"},
+            ]}
+            self.cluster.update("certificatesigningrequests", out)
+            return
+        # sign: mint a FRESH node credential, ROTATING any existing one.
+        # Never reuse-and-return the stored token: that would hand a
+        # joined node's LIVE credential to any bootstrap-token holder who
+        # asks (in the reference a re-sign issues a new cert and cannot
+        # disclose the old key).  Rotation kicks a stale holder off; the
+        # legitimate node re-CSRs on its next join.
+        secret_name = f"node-token-{node}"
+        token = _secrets.token_hex(16)
+        secret = {
+            "namespace": "kube-system", "name": secret_name,
+            "kind": "Secret", "apiVersion": "v1",
+            "type": "kubernetes-tpu/auth-token",
+            "data": {"token": token,
+                     "user": f"system:node:{node}",
+                     "groups": ["system:nodes"]},
+        }
+        try:
+            self.cluster.create("secrets", secret)
+        except ConflictError:
+            self.cluster.update("secrets", secret)
+        out["status"] = {
+            "conditions": [{"type": "Approved",
+                            "reason": "AutoApproved",
+                            "message": "node client cert approved"}],
+            # the credential rides where the reference puts the PEM
+            "certificate": token,
+        }
+        self.cluster.update("certificatesigningrequests", out)
+        self.cluster.events.eventf(
+            "CertificateSigningRequest", "", name, "Normal", "Issued",
+            "node credential issued for system:node:%s", node,
+        )

@@ -54,7 +54,8 @@ class LocalCluster:
              "secrets", "serviceaccounts", "roles", "rolebindings",
              "clusterroles", "clusterrolebindings",
              "persistentvolumes", "persistentvolumeclaims",
-             "storageclasses", "replicationcontrollers")
+             "storageclasses", "replicationcontrollers",
+             "certificatesigningrequests")
 
     def __init__(self) -> None:
         self._lock = threading.RLock()

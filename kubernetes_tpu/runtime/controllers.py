@@ -589,7 +589,10 @@ class ControllerManager:
 
         self.pv = PersistentVolumeController(cluster,
                                              informers=self.informers)
+        from kubernetes_tpu.runtime.certificates import CSRApproverSigner
+
         self.tokencleaner = TokenCleaner(cluster, informers=self.informers)
+        self.csr = CSRApproverSigner(cluster, informers=self.informers)
         self.nodeipam = NodeIpamController(cluster,
                                            informers=self.informers)
         self.attachdetach = AttachDetachController(cluster,
@@ -624,6 +627,7 @@ class ControllerManager:
         self._threads.append(self.ttl.run(self._stop))
         self._threads += self.pv.run(self._stop)
         self._threads += self.tokencleaner.run(self._stop)
+        self._threads += self.csr.run(self._stop)
         self._threads += self.nodeipam.run(self._stop)
 
         def token_sweep():
@@ -665,6 +669,7 @@ class ControllerManager:
         self.statefulset.queue.close()
         self.pv.queue.close()
         self.tokencleaner.queue.close()
+        self.csr.queue.close()
         self.nodeipam.queue.close()
         self.attachdetach.queue.close()
         self.serviceaccount.queue.close()

@@ -312,3 +312,85 @@ def test_remote_scheduler_converges_against_rbac_plane(rbac_server):
         assert bound == "n1"
     finally:
         refl.stop()
+
+
+def test_csr_flow_issues_node_identity():
+    """The TLS-bootstrap analog end to end: a bootstrap identity submits
+    a CSR, the approver/signer mints the node credential and returns it
+    in status.certificate; the node identity then authenticates and is
+    scoped by NodeRestriction."""
+    from kubernetes_tpu.runtime.certificates import CSRApproverSigner
+
+    cluster = LocalCluster()
+    ensure_bootstrap_policy(cluster)
+    authn = TokenAuthenticator(cluster)
+    cluster.create("secrets", {
+        "namespace": "kube-system", "name": "bootstrap-token-csrtst",
+        "type": "bootstrap.kubernetes.io/token",
+        "data": {"token-id": "csrtst", "token-secret": "s" * 16,
+                 "usage-bootstrap-authentication": "true"},
+    })
+    srv = APIServer(cluster=cluster, authenticator=authn,
+                    authorizer=RBACAuthorizer(cluster))
+    from kubernetes_tpu.apiserver.admission import default_admission_chain
+
+    srv.admission = default_admission_chain(
+        cluster, user_getter=srv.current_user)
+    srv.start()
+    signer = CSRApproverSigner(cluster)
+    try:
+        u = srv.url
+        boot = "csrtst." + "s" * 16
+        code, _b = _req(f"{u}/api/v1/certificatesigningrequests", "POST", {
+            "metadata": {"name": "node-csr-w1"},
+            "spec": {"username": "system:node:w1"},
+        }, token=boot)
+        assert code == 201
+        # the server stamped the requestor from authn, not the client
+        csr = cluster.get("certificatesigningrequests", "", "node-csr-w1")
+        assert csr["spec"]["requestorUsername"] == "system:bootstrap:csrtst"
+        while signer.process_one(timeout=0.01):
+            pass
+        code, csr_out = _req(
+            f"{u}/api/v1/certificatesigningrequests/node-csr-w1",
+            token=boot)
+        assert code == 200
+        node_tok = csr_out["status"]["certificate"]
+        assert node_tok
+        assert csr_out["status"]["conditions"][0]["type"] == "Approved"
+        # the issued credential authenticates as the node identity
+        user = authn.authenticate(node_tok)
+        assert user.name == "system:node:w1"
+        assert user.in_group("system:nodes")
+        # ... which NodeRestriction scopes: own lease ok, other denied
+        code, _b = _req(
+            f"{u}/api/v1/namespaces/kube-node-lease/leases", "POST",
+            {"namespace": "kube-node-lease", "name": "w1"},
+            token=node_tok)
+        assert code == 201
+        code, _b = _req(
+            f"{u}/api/v1/namespaces/kube-node-lease/leases", "POST",
+            {"namespace": "kube-node-lease", "name": "other"},
+            token=node_tok)
+        assert code == 403
+        # an unauthorized requestor's CSR is denied, no credential minted
+        cluster.create("secrets", {
+            "namespace": "team", "name": "sa-tok",
+            "type": "kubernetes.io/service-account-token",
+            "data": {"token": "satok2", "namespace": "team",
+                     "serviceAccountName": "app"},
+        })
+        cluster.create("certificatesigningrequests", {
+            "namespace": "", "name": "evil-csr",
+            "spec": {"username": "system:node:evil",
+                     "requestorUsername": "system:serviceaccount:team:app",
+                     "requestorGroups": ["system:serviceaccounts"]},
+        })
+        while signer.process_one(timeout=0.01):
+            pass
+        evil = cluster.get("certificatesigningrequests", "", "evil-csr")
+        assert evil["status"]["conditions"][0]["type"] == "Denied"
+        assert cluster.get("secrets", "kube-system",
+                           "node-token-evil") is None
+    finally:
+        srv.stop()
