@@ -343,7 +343,15 @@ def test_csr_flow_issues_node_identity():
         boot = "csrtst." + "s" * 16
         code, _b = _req(f"{u}/api/v1/certificatesigningrequests", "POST", {
             "metadata": {"name": "node-csr-w1"},
-            "spec": {"username": "system:node:w1"},
+            "spec": {"username": "system:node:w1",
+                     "signerName":
+                     "kubernetes.io/kube-apiserver-client-kubelet"},
+        }, token=boot)
+        assert code == 201
+        # a CSR without the kubelet signerName must be Denied, not signed
+        code, _b = _req(f"{u}/api/v1/certificatesigningrequests", "POST", {
+            "metadata": {"name": "node-csr-nosigner"},
+            "spec": {"username": "system:node:w2"},
         }, token=boot)
         assert code == 201
         # the server stamped the requestor from authn, not the client
@@ -351,6 +359,10 @@ def test_csr_flow_issues_node_identity():
         assert csr["spec"]["requestorUsername"] == "system:bootstrap:csrtst"
         while signer.process_one(timeout=0.01):
             pass
+        bad = cluster.get("certificatesigningrequests", "", "node-csr-nosigner")
+        assert "certificate" not in bad.get("status", {})
+        assert any(c["type"] == "Denied"
+                   for c in bad.get("status", {}).get("conditions", []))
         code, csr_out = _req(
             f"{u}/api/v1/certificatesigningrequests/node-csr-w1",
             token=boot)
