@@ -14,41 +14,62 @@ sequential steps.  This engine places the WHOLE batch in one device launch:
        (ops/select.select_hosts_batch);
     2. commit on device, in batch order: pod b is accepted iff its proposed
        node still fits the resources of b PLUS every earlier same-node
-       proposer this round, and none of b's host ports conflict with ports
+       proposer this round, none of b's host ports conflict with ports
        already claimed on the node or wanted by an earlier same-node
-       proposer.  "Earlier same-node proposer" is a strictly-lower-triangle
-       incidence product (one_hot(hosts) @ one_hot(hosts).T masked by
-       tril) — the conflict-repair bookkeeping is three small matmuls, not
-       a host loop.  Rejected pods get emask[b, node] = False (progress:
-       a pod never re-picks a node it was bounced from) and go to round
-       r+1 against the updated resource columns.
+       proposer, and (affinity batches) no earlier accepted proposer this
+       round creates a required anti-affinity violation with b in a shared
+       topology domain.  "Earlier same-node proposer" is a strictly-lower-
+       triangle incidence product — the conflict-repair bookkeeping is a
+       handful of small matmuls, not a host loop.  Rejected pods get
+       emask[b, node] = False (progress: a pod never re-picks a node it was
+       bounced from) and go to round r+1 against the updated columns.
+
+In-batch REQUIRED (anti-)affinity (VERDICT r3 #3 — previously scan-only):
+the carry holds the same per-topology-pair extras the sequential scan
+threads through its steps (extra_aff/anti/forb/pref, the tensorization of
+predicateMetadata.AddPod, ref algorithm/predicates/metadata.go:64-94),
+batch-updated once per round from that round's accepted placements via
+einsums over the BatchAffinityState cross-match tensors.  Two orderings
+keep this faithful to the sequential semantics:
+  * bootstrap gating: a pod whose required affinity term has no match
+    anywhere may self-bootstrap ONLY if no earlier-in-batch pod that could
+    satisfy the term is still pending — so one group founder places first
+    and mates then co-locate in its domain, exactly as the one-at-a-time
+    scan would, instead of the whole group scattering in round 1;
+  * deferred retirement: a pod with no feasible node stays active while
+    the round commits anything (its mates may land and open domains);
+    retirement happens on the first commit-free round, which bounds the
+    loop (every round commits >= 1 pod, clears >= 1 emask bit, or is the
+    last).
+Nominated pods (preemptors awaiting victims' exit) join the commit check:
+claims from >=-priority nominated pods on the proposed node are added to
+the fit test (podFitsOnNode pass one, ref generic_scheduler.go:598-664);
+their port/anti-affinity pass-one effects arrive host-precomputed through
+extra_mask (models/batched.py encode_nominated_block), shared with the
+sequential engine.
 
 The commit is slightly more conservative than a sequential host commit:
 earlier proposers count against a node's budget even if they themselves end
 up bounced on ports, so an accepted placement NEVER overcommits, but a pod
 can be bounced a round earlier than strictly necessary (it simply re-picks
 next round).  Every PREDICATE is enforced on the accepted state.  In-batch
-score freshness: resource balance AND spreading counts both refresh
-between rounds (the carry accumulates committed pods' group counts via
-the same AND-subset match the sequential scan uses), so same-batch
-service mates repel from round 2 on; within a single round proposals are
-simultaneous (the staggered argmax distributes ties).  Workloads carrying
-required (anti-)affinity use the sequential scan (the scheduler's auto
-mode does), since in-batch affinity state lives there.
+score freshness: resource balance, spreading counts AND the inter-pod-
+affinity score all refresh between rounds from the carry.
 
 Transfer discipline (the tunnel bills per leaf AND per byte):
-  * the PodBatch/port tensors are packed into three flat buffers
-    (codec/transfer.py) — one RTT instead of ~60;
+  * the PodBatch/port/affinity tensors are packed into three flat buffers
+    (codec/transfer.py) — one RTT per dtype kind instead of ~60;
   * the cluster snapshot should be device-put ONCE by the caller and
     chained between batches (the returned new_cluster reuses the resident
     static leaves) — bench.py does; the scheduler runtime uploads through
     the encoder's incremental device-snapshot cache.
 
-Termination: each round every active pod is accepted (retired), infeasible
-(retired), or bounced (clears one emask bit) — bounded by B*N bit-clears.
-Typical convergence: round 1 commits ~all pods (staggered ties make
-collisions rare by construction) — ~1 parallel launch per batch instead of
-B scan steps, the path to the >=10k pods/s north star (BASELINE.json).
+Termination: each round every active pod is accepted (retired), bounced
+(clears one emask bit), or — on a commit-free round — retired infeasible;
+bounded by B + B*N rounds.  Typical convergence: round 1 commits ~all pods
+(staggered ties make collisions rare by construction) — ~1 parallel launch
+per batch instead of B scan steps, the path to the >=10k pods/s north star
+(BASELINE.json).
 
 Reference for the semantics being reproduced at batch scale:
 core/generic_scheduler.go Schedule (:184-254) / selectHost (:284-296);
@@ -65,10 +86,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from kubernetes_tpu.codec.schema import ClusterTensors, FilterConfig, PodBatch
+from kubernetes_tpu.codec.schema import (
+    ClusterTensors,
+    DEFAULT_PRIORITY_WEIGHTS,
+    FilterConfig,
+    PodBatch,
+    PRED_INDEX,
+    PRIO_INDEX,
+)
 from kubernetes_tpu.codec.transfer import pack_tree, unpack_tree
 from kubernetes_tpu.ops.predicates import filter_batch
 from kubernetes_tpu.ops.priorities import (
+    MAX_PRIORITY,
     pod_spread_match,
     score_batch,
     spread_counts,
@@ -91,15 +120,25 @@ def make_speculative_scheduler(
     percentage_of_nodes_to_score: int = 100,
 ):
     """Same call contract as make_sequential_scheduler:
-    fn(cluster, pods, ports, last_index0, extra_mask=None, extra_score=None)
-    -> (hosts i32[B] (-1 unschedulable), new_cluster with committed
-    requested/nonzero columns).  hosts is returned as a device array so the
-    caller can overlap its fetch with the next batch's dispatch."""
-    w = None if weights is None else np.asarray(weights, np.float32)
+    fn(cluster, pods, ports, last_index0, nominated=None, extra_mask=None,
+    extra_score=None, aff_state=None) -> (hosts i32[B] (-1 unschedulable),
+    new_cluster with committed requested/nonzero columns).  hosts is
+    returned as a device array so the caller can overlap its fetch with the
+    next batch's dispatch."""
+    w_all = np.asarray(
+        DEFAULT_PRIORITY_WEIGHTS if weights is None else weights, np.float32
+    )
+    w_ipa = float(w_all[PRIO_INDEX["InterPodAffinityPriority"]])
+    # affinity batches move the IPA score from score_batch's static pass
+    # into the per-round dynamic evaluation (it must see in-batch commits)
+    w_no_ipa = w_all.copy()
+    w_no_ipa[PRIO_INDEX["InterPodAffinityPriority"]] = 0.0
+    hard_w = float(cfg.hard_pod_affinity_weight)
 
-    def _round(cluster, pods, pod_ports, conflict, escore, c):
+    def _round(cluster, pods, pod_ports, conflict, escore, nom, aff, c):
         """One propose-and-commit round (shared by the on-device while_loop
-        and the host-driven CPU loop)."""
+        and the host-driven CPU loop).  nom: NominatedState or None;
+        aff: BatchAffinityState or None."""
         B = pods.valid.shape[0]
         N = cluster.allocatable.shape[0]
         reqf = pods.req.astype(jnp.float32)
@@ -111,7 +150,39 @@ def make_speculative_scheduler(
         cl = dataclasses.replace(
             cluster, requested=c["req"], nonzero_req=c["nz"]
         )
-        mask, _ = filter_batch(cl, pods, cfg, unsched_taint_key)
+        if aff is not None:
+            topo = cluster.topo_pairs.astype(jnp.float32)     # [N, TP]
+            # topology-key -> pair-slot masks (cheap broadcasts; XLA CSEs
+            # them across the uses below)
+            aff_kp = (
+                pods.aff_term_topo_key[:, :, None]
+                == cluster.pair_topo_key[None, None]
+            )                                                 # [B, PT, TP]
+            anti_kp = (
+                pods.anti_term_topo_key[:, :, None]
+                == cluster.pair_topo_key[None, None]
+            )                                                 # [B, AT, TP]
+            # bootstrap gating: pod i may self-bootstrap term t only when
+            # no EARLIER-in-batch pod that could satisfy t is still pending
+            # (batch order = the order the sequential scan would commit);
+            # the gate folds into aff_term_self, so the SHARED
+            # MatchInterPodAffinity predicate (ops/predicates.py) evaluates
+            # the unioned (pre-batch | in-batch) state unchanged
+            earlier_alive = tril * c["active"].astype(jnp.float32)[None, :]
+            cb = jnp.einsum(
+                "jit,ij->it", aff.aff_match.astype(jnp.float32),
+                earlier_alive, precision=_X,
+            ) <= 0                                            # [B, PT]
+            pods_eval = dataclasses.replace(
+                pods,
+                aff_term_pairs=pods.aff_term_pairs | c["xaff"],
+                anti_term_pairs=pods.anti_term_pairs | c["xanti"],
+                forbidden_pairs=pods.forbidden_pairs | c["xforb"],
+                aff_term_self=pods.aff_term_self & cb,
+            )
+        else:
+            pods_eval = pods
+        mask, _ = filter_batch(cl, pods_eval, cfg, unsched_taint_key)
         # spread freshness (VERDICT r2 item 6): counts refresh between
         # repair rounds exactly like resources — base snapshot counts plus
         # the in-batch commits accumulated in the carry, so same-batch
@@ -121,10 +192,30 @@ def make_speculative_scheduler(
             pods, spread_counts=spread_counts(cl, pods) + c["spread"]
         )
         total, _ = score_batch(
-            cl, pods_r, weights=w, score_cfg=score_cfg,
-            zone_key_id=zone_key_id,
+            cl, pods_r, weights=(w_no_ipa if aff is not None else w_all),
+            score_cfg=score_cfg, zone_key_id=zone_key_id,
         )
         mask = mask & c["active"][:, None] & c["emask"] & pods.valid[:, None]
+        if aff is not None:
+            # dynamic IPA score (interpod_affinity.go fScore) over
+            # (pre-batch | in-batch) raw pair weights, renormalized per pod
+            raw = jnp.matmul(
+                pods.pref_pair_weights + c["xpref"], topo.T, precision=_X
+            )                                                 # [B, N]
+            big = jnp.float32(3.4e38)
+            mn = jnp.min(
+                jnp.where(cluster.valid[None], raw, big), axis=1,
+                keepdims=True,
+            )
+            mx = jnp.max(
+                jnp.where(cluster.valid[None], raw, -big), axis=1,
+                keepdims=True,
+            )
+            spr = mx - mn
+            ipa = jnp.where(
+                spr > 0, jnp.floor(MAX_PRIORITY * (raw - mn) / spr), 0.0
+            )
+            total = total + w_ipa * jnp.where(cluster.valid[None], ipa, 0.0)
         if percentage_of_nodes_to_score < 100:  # 0 = adaptive
             lim = num_feasible_nodes_device(
                 jnp.sum(cl.valid.astype(jnp.int32)),
@@ -134,7 +225,8 @@ def make_speculative_scheduler(
             mask = jax.vmap(limit_feasible, in_axes=(0, None, 0))(
                 mask, lim, starts
             )
-        total = total + escore
+        if escore is not None:
+            total = total + escore
         hosts, feasible = select_hosts_batch(total, mask, c["li"])
         prop = c["active"] & feasible            # proposers this round
         # earlier same-node proposers: an equality comparison masked by
@@ -149,7 +241,19 @@ def make_speculative_scheduler(
         cum_req = jnp.matmul(prior, reqf, precision=_X)      # [B, R]
         node_req = c["req"][hosts]                           # [B, R]
         alloc_h = cluster.allocatable[hosts]
-        over = (reqf > 0) & (node_req + cum_req + reqf > alloc_h)
+        if nom is not None:
+            # podFitsOnNode pass one: nominated pods with priority >= this
+            # pod's claim resources on their nominated node (resource fit
+            # is monotone, so pass one implies the no-nominated pass two)
+            nw = (
+                (nom.prio[None, :] >= pods.priority[:, None])
+                & (nom.node[None, :] >= 0)
+                & (nom.node[None, :] == hosts[:, None])
+            ).astype(jnp.float32)                            # [B, K]
+            nom_extra = jnp.matmul(nw, nom.req, precision=_X)  # [B, R]
+        else:
+            nom_extra = jnp.float32(0.0)
+        over = (reqf > 0) & (node_req + cum_req + nom_extra + reqf > alloc_h)
         fits = ~jnp.any(over, axis=1)
         # ports: conflict with claims already on the node OR with an
         # earlier same-node proposer's wanted ports
@@ -161,6 +265,23 @@ def make_speculative_scheduler(
         ) > 0
         pconf = jnp.any(pports & blocked, axis=1)
         accept = prop & fits & ~pconf
+        if aff is not None:
+            # same-round required-anti ordering: pod b is rejected when an
+            # earlier proposer j shares a topology domain with b under one
+            # of b's anti terms (j matches the term) or one of j's anti
+            # terms (b matches it).  D[o, t, c] = "candidate c's proposed
+            # node is in owner o's term-t domain at o's proposed node".
+            H = topo[hosts]                                   # [B, TP]
+            a_own = anti_kp.astype(jnp.float32) * H[:, None, :]  # [B, AT, TP]
+            D = jnp.einsum("otp,cp->otc", a_own, H, precision=_X) > 0
+            # am1[b, t, j] = "pod j matches pod b's required anti term t"
+            am1 = jnp.transpose(aff.anti_match, (1, 2, 0))    # [B, AT, B]
+            v1 = jnp.any(D & am1, axis=1)                     # [b, j]
+            v2 = jnp.any(D & aff.anti_own, axis=1)            # [j, b]
+            conf_ba = v1 | v2.T                               # [b, j]
+            earlier_prop = (tril > 0) & prop[None, :]
+            aviol = jnp.any(conf_ba & earlier_prop, axis=1)
+            accept = accept & ~aviol
         accf = accept[:, None].astype(jnp.float32)
         # the accept pass is conservative (earlier proposers count even
         # if they themselves bounce), which never overcommits but can
@@ -170,7 +291,7 @@ def make_speculative_scheduler(
         # the node and retries next round.
         prior_acc = prior * accept[None, :].astype(jnp.float32)
         cum_acc = jnp.matmul(prior_acc, reqf, precision=_X)
-        over_acc = (reqf > 0) & (node_req + cum_acc + reqf > alloc_h)
+        over_acc = (reqf > 0) & (node_req + cum_acc + nom_extra + reqf > alloc_h)
         fits_acc = ~jnp.any(over_acc, axis=1)
         prior_ports_acc = jnp.matmul(prior_acc, pports_f, precision=_X) > 0
         blocked_acc = jnp.matmul(
@@ -179,6 +300,13 @@ def make_speculative_scheduler(
         ) > 0
         pconf_acc = jnp.any(pports & blocked_acc, axis=1)
         real_bounce = prop & ~accept & (~fits_acc | pconf_acc)
+        if aff is not None:
+            # an anti-violation against an ACCEPTED peer needs no emask
+            # ban: next round's xanti/xforb exclude the whole domain
+            aviol_acc = jnp.any(
+                conf_ba & (tril > 0) & accept[None, :], axis=1
+            )
+            real_bounce = real_bounce & ~aviol_acc
         # in-batch spread bookkeeping: the SAME AND-subset match the
         # sequential scan uses (ops/priorities.py pod_spread_match)
         spread_match = pod_spread_match(
@@ -189,7 +317,7 @@ def make_speculative_scheduler(
         # committed state lands via scatter-add on the node axis (a
         # segment-sum; XLA lowers it to a cheap scatter on every
         # backend, where the old one_hot.T matmuls cost B*N*R flops)
-        return {
+        out = {
             "hosts": jnp.where(accept, hosts, c["hosts"]),
             "req": c["req"].at[hosts].add(reqf * accf),
             "nz": c["nz"].at[hosts].add(nzf * accf),
@@ -206,15 +334,81 @@ def make_speculative_scheduler(
                 & (jnp.arange(N, dtype=jnp.int32)[None, :]
                    == hosts[:, None])
             ),
-            # retired: accepted, or nothing feasible this round
-            "active": c["active"] & feasible & ~accept,
             "li": c["li"] + jnp.int32(B),
         }
+        if aff is None:
+            # retired: accepted, or nothing feasible this round
+            out["active"] = c["active"] & feasible & ~accept
+        else:
+            # deferred retirement: while the round commits anything, an
+            # infeasible pod stays active (a mate's landing may open its
+            # domain next round).  A commit-free round retires only the
+            # FIRST infeasible pod in batch order — exactly the pod the
+            # sequential scan would fail next — so a later founder whose
+            # bootstrap was gated by that pod gets its round with the
+            # blocker finally dead instead of being mass-retired with it.
+            any_acc = jnp.any(accept)
+            inf = c["active"] & ~feasible
+            first_inf = inf & (jnp.cumsum(inf.astype(jnp.int32)) == 1)
+            out["active"] = (
+                (c["active"] & feasible & ~accept)
+                | jnp.where(any_acc, inf, inf & ~first_inf)
+            )
+            # predicateMetadata.AddPod analog, batched over this round's
+            # accepted placements: their topology pairs flow into the
+            # pending pods' affinity state for the next round
+            accN = accf * H                                   # [B(j), TP]
+            am_f = aff.aff_match.astype(jnp.float32)
+            nm_f = aff.anti_match.astype(jnp.float32)
+            out["xaff"] = c["xaff"] | (
+                (jnp.einsum("jit,jp->itp", am_f, accN, precision=_X) > 0)
+                & aff_kp
+            )
+            out["xanti"] = c["xanti"] | (
+                (jnp.einsum("jit,jp->itp", nm_f, accN, precision=_X) > 0)
+                & anti_kp
+            )
+            keyed_anti = anti_kp.astype(jnp.float32) * accN[:, None, :]
+            out["xforb"] = c["xforb"] | (
+                jnp.einsum(
+                    "jti,jtp->ip", aff.anti_own.astype(jnp.float32),
+                    keyed_anti, precision=_X,
+                ) > 0
+            )
+            keyed_aff = aff_kp.astype(jnp.float32) * accN[:, None, :]
+            xpref = c["xpref"] + hard_w * jnp.einsum(
+                "jti,jtp->ip", aff.aff_own.astype(jnp.float32), keyed_aff,
+                precision=_X,
+            )
+            # preferred (soft) terms, both directions (scan parity):
+            # 1. pending pods' own preferred terms the accepted pods match
+            pref_kp = (
+                aff.pref_topo_key[:, :, None]
+                == cluster.pair_topo_key[None, None]
+            )                                                 # [B, PP, TP]
+            m1 = jnp.einsum(
+                "jit,jp->itp", aff.pref_match.astype(jnp.float32), accN,
+                precision=_X,
+            )
+            xpref = xpref + jnp.sum(
+                m1 * aff.pref_weight[:, :, None]
+                * pref_kp.astype(jnp.float32),
+                axis=1,
+            )
+            # 2. the accepted pods' preferred terms add +-w per matching
+            #    pending pod over the landing domain
+            keyed_pref = pref_kp.astype(jnp.float32) * accN[:, None, :]
+            xpref = xpref + jnp.einsum(
+                "jti,jt,jtp->ip", aff.pref_own.astype(jnp.float32),
+                aff.pref_weight, keyed_pref, precision=_X,
+            )
+            out["xpref"] = xpref
+        return out
 
-    def _init_carry(cluster, pods, pod_ports, last_index0, emask0):
+    def _init_carry(cluster, pods, pod_ports, last_index0, emask0, has_aff):
         B = pods.valid.shape[0]
         N = cluster.allocatable.shape[0]
-        return {
+        c = {
             "hosts": jnp.full((B,), -1, jnp.int32),
             "req": cluster.requested.astype(jnp.float32),
             "nz": cluster.nonzero_req.astype(jnp.float32),
@@ -224,41 +418,50 @@ def make_speculative_scheduler(
             "active": pods.valid,
             "li": jnp.asarray(last_index0, jnp.int32),
         }
+        if has_aff:
+            TP = cluster.topo_pairs.shape[1]
+            PT = pods.aff_term_pairs.shape[1]
+            AT = pods.anti_term_pairs.shape[1]
+            c["xaff"] = jnp.zeros((B, PT, TP), jnp.bool_)
+            c["xanti"] = jnp.zeros((B, AT, TP), jnp.bool_)
+            c["xforb"] = jnp.zeros((B, TP), jnp.bool_)
+            c["xpref"] = jnp.zeros((B, TP), jnp.float32)
+        return c
 
-    def _impl(cluster, pods, pod_ports, conflict, last_index0, emask0, escore):
+    def _parts(tree):
+        pods = tree["pods"]
+        return (
+            pods, tree["pp"], tree["cf"], tree.get("emask"),
+            tree.get("escore"), tree.get("nom"), tree.get("aff"),
+        )
+
+    def _impl(cluster, tree, last_index0):
+        pods, pod_ports, conflict, emask0, escore, nom, aff = _parts(tree)
         B = pods.valid.shape[0]
-        init = _init_carry(cluster, pods, pod_ports, last_index0, emask0)
+        N = cluster.allocatable.shape[0]
+        if emask0 is None:
+            emask0 = jnp.ones((B, N), jnp.bool_)
+        else:
+            emask0 = emask0.astype(jnp.bool_)
+        init = _init_carry(
+            cluster, pods, pod_ports, last_index0, emask0, aff is not None
+        )
         out = lax.while_loop(
             lambda c: jnp.any(c["active"]),
-            lambda c: _round(cluster, pods, pod_ports, conflict, escore, c),
+            lambda c: _round(
+                cluster, pods, pod_ports, conflict, escore, nom, aff, c
+            ),
             init,
         )
         rounds = (out["li"] - jnp.asarray(last_index0, jnp.int32)) // B
         return out["hosts"], out["req"], out["nz"], rounds
 
     @lru_cache(maxsize=64)
-    def _packed_plain(meta):
+    def _packed(meta):
         @jax.jit
         def run(cluster, bufs, last_index0):
-            pods, pod_ports, conflict = unpack_tree(bufs, meta)
-            B = pods.valid.shape[0]
-            N = cluster.allocatable.shape[0]
-            return _impl(
-                cluster, pods, pod_ports, conflict, last_index0,
-                jnp.ones((B, N), jnp.bool_), jnp.zeros((B, N), jnp.float32),
-            )
-
-        return run
-
-    @lru_cache(maxsize=64)
-    def _packed_extras(meta):
-        @jax.jit
-        def run(cluster, bufs, last_index0):
-            pods, pod_ports, conflict, emask0, escore = unpack_tree(bufs, meta)
-            return _impl(
-                cluster, pods, pod_ports, conflict, last_index0,
-                emask0, escore,
-            )
+            tree = unpack_tree(bufs, meta)
+            return _impl(cluster, tree, last_index0)
 
         return run
 
@@ -268,25 +471,14 @@ def make_speculative_scheduler(
     # of tiny host syncs per batch are free without a tunnel.
 
     @lru_cache(maxsize=64)
-    def _round_plain(meta):
+    def _round_host(meta):
         @jax.jit
         def run(cluster, bufs, c):
-            pods, pod_ports, conflict = unpack_tree(bufs, meta)
-            B = pods.valid.shape[0]
-            N = cluster.allocatable.shape[0]
+            tree = unpack_tree(bufs, meta)
+            pods, pod_ports, conflict, _em, escore, nom, aff = _parts(tree)
             return _round(
-                cluster, pods, pod_ports, conflict,
-                jnp.zeros((B, N), jnp.float32), c,
+                cluster, pods, pod_ports, conflict, escore, nom, aff, c
             )
-
-        return run
-
-    @lru_cache(maxsize=64)
-    def _round_extras(meta):
-        @jax.jit
-        def run(cluster, bufs, c):
-            pods, pod_ports, conflict, emask0, escore = unpack_tree(bufs, meta)
-            return _round(cluster, pods, pod_ports, conflict, escore, c)
 
         return run
 
@@ -294,20 +486,22 @@ def make_speculative_scheduler(
     def _carry_init(meta):
         @jax.jit
         def run(cluster, bufs, last_index0):
-            parts = unpack_tree(bufs, meta)
-            pods, pod_ports = parts[0], parts[1]
+            tree = unpack_tree(bufs, meta)
+            pods, pod_ports, _cf, emask0, _es, _nom, aff = _parts(tree)
             B = pods.valid.shape[0]
             N = cluster.allocatable.shape[0]
-            emask0 = (
-                parts[3].astype(jnp.bool_) if len(parts) == 5
-                else jnp.ones((B, N), jnp.bool_)
+            if emask0 is None:
+                emask0 = jnp.ones((B, N), jnp.bool_)
+            else:
+                emask0 = emask0.astype(jnp.bool_)
+            return _init_carry(
+                cluster, pods, pod_ports, last_index0, emask0, aff is not None
             )
-            return _init_carry(cluster, pods, pod_ports, last_index0, emask0)
 
         return run
 
-    def _host_rounds(cluster, bufs, meta, last_index0, extras: bool):
-        step = (_round_extras if extras else _round_plain)(meta)
+    def _host_rounds(cluster, bufs, meta, last_index0):
+        step = _round_host(meta)
         c = _carry_init(meta)(cluster, bufs, np.int32(last_index0))
         rounds = 0
         while bool(np.asarray(c["active"]).any()):
@@ -318,43 +512,27 @@ def make_speculative_scheduler(
     def schedule(cluster: ClusterTensors, pods: PodBatch, ports,
                  last_index0, nominated=None, extra_mask=None,
                  extra_score=None, aff_state=None):
-        assert aff_state is None and nominated is None, (
-            "speculative engine handles the plain fast path; affinity/"
-            "nominated batches take the sequential scan"
-        )
         on_cpu = jax.default_backend() == "cpu"
-        if extra_mask is None and extra_score is None:
-            bufs, meta = pack_tree((pods, ports.pod_ports, ports.conflict))
-            if on_cpu:
-                hosts, req, nz, rounds = _host_rounds(
-                    cluster, bufs, meta, last_index0, extras=False
-                )
-            else:
-                hosts, req, nz, rounds = _packed_plain(meta)(
-                    cluster, bufs, np.int32(last_index0)
-                )
+        tree = {"pods": pods, "pp": ports.pod_ports, "cf": ports.conflict}
+        if extra_mask is not None:
+            tree["emask"] = np.asarray(extra_mask, bool)
+        if extra_score is not None:
+            tree["escore"] = np.asarray(extra_score, np.float32)
+        if nominated is not None:
+            tree["nom"] = nominated
+        if aff_state is not None:
+            tree["aff"] = aff_state
+        # the optional extras ride the same packed buffers (<=3 RTTs); the
+        # tree's key set is part of meta, so each combination jits once
+        bufs, meta = pack_tree(tree)
+        if on_cpu:
+            hosts, req, nz, rounds = _host_rounds(
+                cluster, bufs, meta, last_index0
+            )
         else:
-            B, N = pods.valid.shape[0], cluster.valid.shape[0]
-            emask = (
-                np.ones((B, N), bool) if extra_mask is None
-                else np.asarray(extra_mask, bool)
+            hosts, req, nz, rounds = _packed(meta)(
+                cluster, bufs, np.int32(last_index0)
             )
-            esc = (
-                np.zeros((B, N), np.float32) if extra_score is None
-                else np.asarray(extra_score, np.float32)
-            )
-            # the extras ride the same packed buffers (3 RTTs, not 3 + 2)
-            bufs, meta = pack_tree(
-                (pods, ports.pod_ports, ports.conflict, emask, esc)
-            )
-            if on_cpu:
-                hosts, req, nz, rounds = _host_rounds(
-                    cluster, bufs, meta, last_index0, extras=True
-                )
-            else:
-                hosts, req, nz, rounds = _packed_extras(meta)(
-                    cluster, bufs, np.int32(last_index0)
-                )
         schedule.last_rounds = rounds  # observability: repair rounds used
         new_cluster = dataclasses.replace(cluster, requested=req, nonzero_req=nz)
         return hosts, new_cluster

@@ -62,9 +62,10 @@ class SchedulerConfig:
     batch_window_s: float = 0.001
     # "sequential" = exact one-pod-at-a-time commit semantics (lax.scan);
     # "speculative" = parallel placement + conflict repair (higher
-    # throughput; in-batch spread scores stale within a cycle).  Batches
-    # carrying pod affinity or nominated pods always take the sequential
-    # scan regardless (the in-batch state lives there).
+    # throughput; in-batch spread scores stale within a round).  Both
+    # engines carry in-batch affinity and nominated-pod state (the
+    # speculative engine batch-updates the same per-topology-pair extras
+    # the scan threads through its steps).
     engine: str = "sequential"
     percentage_of_nodes_to_score: int = 100  # TPU path scans all; knob for parity
     disable_preemption: bool = False
@@ -259,10 +260,7 @@ class Scheduler:
                 ~nom_block if extra_mask is None else (extra_mask & ~nom_block)
             )
         fn = self._schedule_fn
-        if (
-            self._speculative_fn is not None
-            and aff_state is None and nominated is None
-        ):
+        if self._speculative_fn is not None:
             fn = self._speculative_fn
         hosts, _ = fn(
             self._dev_snapshot.update(cluster), batch, ports,
