@@ -99,6 +99,10 @@ class ObjectMeta:
     # (ref metav1.ObjectMeta.DeletionTimestamp; consulted by
     # podEligibleToPreemptOthers, generic_scheduler.go:1159-1180)
     deletion_timestamp: Optional[float] = None
+    # deletion is deferred until every finalizer is removed
+    # (ref metav1.ObjectMeta.Finalizers; store semantics in
+    # runtime/cluster.py delete/update)
+    finalizers: Tuple[str, ...] = ()
 
     @staticmethod
     def from_dict(d: Optional[dict]) -> "ObjectMeta":
@@ -118,6 +122,7 @@ class ObjectMeta:
             owner_uid=owner_uid,
             owner_kind=owner_kind,
             deletion_timestamp=parse_time(d.get("deletionTimestamp")),
+            finalizers=tuple(d.get("finalizers") or ()),
         )
 
 

@@ -1196,6 +1196,10 @@ class APIServer:
                     meta = body.setdefault("metadata", {})
                     if ns and not meta.get("namespace"):
                         meta["namespace"] = ns
+                    # the registry stamps creation time (ObjectMeta
+                    # PrepareForCreate); age-based reconcilers (csrcleaner,
+                    # token cleaner) depend on it
+                    meta.setdefault("creationTimestamp", time.time())
                     if kind == "certificatesigningrequests":
                         # the registry stamps the REQUESTOR identity from
                         # authn (csr strategy PrepareForCreate) — a client

@@ -147,6 +147,23 @@ class LocalCluster:
             self._notify(ADDED, kind, obj, rv=self._rv)
             return self._rv
 
+    @staticmethod
+    def _finalizers(obj) -> list:
+        if isinstance(obj, dict):
+            meta = obj.get("metadata") or {}
+            return list(meta.get("finalizers") or obj.get("finalizers") or ())
+        meta = getattr(obj, "metadata", None)
+        return list(getattr(meta, "finalizers", ()) or ())
+
+    @staticmethod
+    def _deleting(obj) -> bool:
+        if isinstance(obj, dict):
+            meta = obj.get("metadata") or {}
+            return bool(meta.get("deletionTimestamp")
+                        or obj.get("deletionTimestamp"))
+        meta = getattr(obj, "metadata", None)
+        return getattr(meta, "deletion_timestamp", None) is not None
+
     def update(self, kind: str, obj, expect_rv: Optional[int] = None) -> int:
         with self._lock:
             key = self._key(kind, obj)
@@ -155,6 +172,14 @@ class LocalCluster:
                 raise ConflictError(f"{kind} {key} missing")
             if expect_rv is not None and cur.rv != expect_rv:
                 raise ConflictError(f"{kind} {key} rv {cur.rv} != {expect_rv}")
+            if self._deleting(obj) and not self._finalizers(obj):
+                # the last finalizer was removed from a terminating object:
+                # complete the deferred deletion (apimachinery
+                # registry/generic/registry/store.go deleteWithoutFinalizers)
+                del self._store[kind][key]
+                self._rv += 1
+                self._notify(DELETED, kind, obj, rv=self._rv)
+                return self._rv
             self._rv += 1
             self._store[kind][key] = _Stored(obj, self._rv)
             self._notify(MODIFIED, kind, obj, rv=self._rv)
@@ -163,10 +188,37 @@ class LocalCluster:
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
             key = (namespace if kind != "nodes" else "", name)
-            cur = self._store[kind].pop(key, None)
-            if cur is not None:
-                self._rv += 1
-                self._notify(DELETED, kind, cur.obj, rv=self._rv)
+            cur = self._store[kind].get(key)
+            if cur is None:
+                return
+            if self._finalizers(cur.obj):
+                # finalizer-gated: mark terminating instead of removing
+                # (the protection controllers remove their finalizer when
+                # the object is safe to drop, which completes the delete)
+                if not self._deleting(cur.obj):
+                    import time as _time
+
+                    obj = cur.obj
+                    if isinstance(obj, dict):
+                        obj = dict(obj)
+                        if "metadata" in obj:
+                            obj["metadata"] = dict(obj["metadata"] or {})
+                            obj["metadata"]["deletionTimestamp"] = _time.time()
+                        obj["deletionTimestamp"] = _time.time()
+                    else:
+                        import dataclasses as _dc
+
+                        obj = _dc.replace(
+                            obj, metadata=_dc.replace(
+                                obj.metadata,
+                                deletion_timestamp=_time.time()))
+                    self._rv += 1
+                    self._store[kind][key] = _Stored(obj, self._rv)
+                    self._notify(MODIFIED, kind, obj, rv=self._rv)
+                return
+            self._store[kind].pop(key, None)
+            self._rv += 1
+            self._notify(DELETED, kind, cur.obj, rv=self._rv)
 
     def apply_event(self, event: str, kind: str, obj,
                     rv: Optional[int] = None) -> None:

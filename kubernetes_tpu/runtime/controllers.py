@@ -600,6 +600,29 @@ class ControllerManager:
         self.serviceaccount = ServiceAccountController(
             cluster, informers=self.informers)
         self.token = TokenController(cluster, informers=self.informers)
+        from kubernetes_tpu.runtime.protection import (
+            BootstrapSigner,
+            ClusterRoleAggregationController,
+            CSRCleaner,
+            ExpandController,
+            NodeTTLController,
+            PVCProtectionController,
+            PVProtectionController,
+            RootCACertPublisher,
+        )
+
+        self.pvcprotection = PVCProtectionController(
+            cluster, informers=self.informers)
+        self.pvprotection = PVProtectionController(
+            cluster, informers=self.informers)
+        self.clusterroleagg = ClusterRoleAggregationController(
+            cluster, informers=self.informers)
+        self.nodettl = NodeTTLController(cluster, informers=self.informers)
+        self.bootstrapsigner = BootstrapSigner(
+            cluster, informers=self.informers)
+        self.csrcleaner = CSRCleaner(cluster)
+        self.expand = ExpandController(cluster, informers=self.informers)
+        self.rootca = RootCACertPublisher(cluster, informers=self.informers)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -630,10 +653,16 @@ class ControllerManager:
         self._threads += self.csr.run(self._stop)
         self._threads += self.nodeipam.run(self._stop)
 
+        for r in (self.pvcprotection, self.pvprotection,
+                  self.clusterroleagg, self.nodettl, self.bootstrapsigner,
+                  self.expand, self.rootca):
+            self._threads += r.run(self._stop)
+
         def token_sweep():
             while not self._stop.wait(30.0):
                 try:
                     self.tokencleaner.tick()
+                    self.csrcleaner.tick()
                 except Exception:
                     pass
 
@@ -674,6 +703,10 @@ class ControllerManager:
         self.attachdetach.queue.close()
         self.serviceaccount.queue.close()
         self.token.queue.close()
+        for r in (self.pvcprotection, self.pvprotection,
+                  self.clusterroleagg, self.nodettl, self.bootstrapsigner,
+                  self.expand, self.rootca):
+            r.queue.close()
 
 
 # ---------------------------------------------------------------- disruption

@@ -1,0 +1,462 @@
+"""Controller-completeness sweep: the last 8 non-cloud reconcilers
+(VERDICT r3 #7 / missing #4) — storage-object protection finalizers,
+ClusterRole aggregation, node TTL annotations, bootstrap-token signing of
+the cluster-info ConfigMap, CSR garbage collection, PVC expansion, and the
+root-CA ConfigMap publisher.  Each is a small reconciler on the existing
+WorkQueue/Reconciler machinery (runtime/controllers.py).
+
+Reference:
+  * pkg/controller/volume/pvcprotection/pvc_protection_controller.go:1-288
+    and .../pvprotection: a finalizer (kubernetes.io/pvc-protection /
+    kubernetes.io/pv-protection) defers deletion while the object is in
+    use; the store's finalizer semantics live in runtime/cluster.py
+    delete/update.
+  * pkg/controller/clusterroleaggregation/clusterroleaggregation_controller.go:1-213:
+    ClusterRoles with an aggregationRule get .rules = union of the rules
+    of every ClusterRole matched by the label selectors.
+  * pkg/controller/ttl/ttl_controller.go:1-291: annotate nodes with
+    node.alpha.kubernetes.io/ttl from cluster-size boundaries (with the
+    reference's hysteresis bands).
+  * pkg/controller/bootstrap/bootstrapsigner.go:1-306: detached-JWS-sign
+    the kube-public/cluster-info ConfigMap with every signing-enabled
+    bootstrap token (jws-kubeconfig-<tokenid> keys).
+  * pkg/controller/certificates/cleaner/cleaner.go: drop CSRs that are
+    approved/denied older than 1h or pending older than 24h.
+  * pkg/controller/volume/expand/expand_controller.go: grow the bound
+    PV when a claim requests more than the volume provides.
+  * pkg/controller/certificates/rootcacertpublisher/publisher.go:
+    a kube-root-ca.crt ConfigMap in every active namespace.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import hashlib
+import hmac
+import json
+import time
+from typing import Optional
+
+from kubernetes_tpu.runtime.cluster import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    ConflictError,
+    LocalCluster,
+)
+from kubernetes_tpu.runtime.controllers import Reconciler
+
+PVC_PROTECTION_FINALIZER = "kubernetes.io/pvc-protection"
+PV_PROTECTION_FINALIZER = "kubernetes.io/pv-protection"
+TTL_ANNOTATION = "node.alpha.kubernetes.io/ttl"
+ROOT_CA_CONFIGMAP = "kube-root-ca.crt"
+
+
+def _with_finalizer(meta, fin: str):
+    if fin in meta.finalizers:
+        return meta
+    return dataclasses.replace(meta, finalizers=meta.finalizers + (fin,))
+
+
+def _without_finalizer(meta, fin: str):
+    return dataclasses.replace(
+        meta, finalizers=tuple(f for f in meta.finalizers if f != fin)
+    )
+
+
+class PVCProtectionController(Reconciler):
+    """Add the pvc-protection finalizer to every live claim; lift it from
+    terminating claims no running pod uses (pvc_protection_controller.go
+    askInformer/askAPIServer collapsed to a store list)."""
+
+    WATCH_KINDS = ("persistentvolumeclaims", "pods")
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "persistentvolumeclaims" and event != DELETED:
+            self.queue.add((obj.namespace, obj.name))
+        elif kind == "pods":
+            # a pod going away may unblock a terminating claim it used
+            for v in getattr(obj.spec, "volumes", ()) or ():
+                claim = (v.get("persistentVolumeClaim") or {})
+                if claim.get("claimName"):
+                    self.queue.add((obj.namespace, claim["claimName"]))
+
+    def _in_use(self, ns: str, name: str) -> bool:
+        for pod in self.cluster.list("pods"):
+            if pod.namespace != ns:
+                continue
+            if (pod.status.phase or "Pending") in ("Succeeded", "Failed"):
+                continue  # terminated pods don't pin the claim
+            for v in pod.spec.volumes or ():
+                if (v.get("persistentVolumeClaim") or {}).get(
+                        "claimName") == name:
+                    return True
+        return False
+
+    def sync(self, key) -> None:
+        ns, name = key
+        pvc, rv = self.cluster.get_with_rv("persistentvolumeclaims", ns, name)
+        if pvc is None:
+            return
+        meta = pvc.metadata
+        if meta.deletion_timestamp is None:
+            if PVC_PROTECTION_FINALIZER not in meta.finalizers:
+                self.cluster.update(
+                    "persistentvolumeclaims",
+                    dataclasses.replace(
+                        pvc, metadata=_with_finalizer(
+                            meta, PVC_PROTECTION_FINALIZER)),
+                    expect_rv=rv,
+                )
+        elif (PVC_PROTECTION_FINALIZER in meta.finalizers
+              and not self._in_use(ns, name)):
+            self.cluster.update(
+                "persistentvolumeclaims",
+                dataclasses.replace(
+                    pvc, metadata=_without_finalizer(
+                        meta, PVC_PROTECTION_FINALIZER)),
+                expect_rv=rv,
+            )
+
+
+class PVProtectionController(Reconciler):
+    """pv-protection finalizer: a terminating PV is released only once no
+    claim is bound to it (pvprotection/pv_protection_controller.go)."""
+
+    WATCH_KINDS = ("persistentvolumes",)
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "persistentvolumes" and event != DELETED:
+            self.queue.add(obj.name)
+
+    def sync(self, name: str) -> None:
+        pv, rv = self.cluster.get_with_rv("persistentvolumes", "", name)
+        if pv is None:
+            return
+        meta = pv.metadata
+        bound = pv.phase == "Bound" or bool(pv.claim_ref)
+        if meta.deletion_timestamp is None:
+            if PV_PROTECTION_FINALIZER not in meta.finalizers:
+                self.cluster.update(
+                    "persistentvolumes",
+                    dataclasses.replace(
+                        pv, metadata=_with_finalizer(
+                            meta, PV_PROTECTION_FINALIZER)),
+                    expect_rv=rv,
+                )
+        elif PV_PROTECTION_FINALIZER in meta.finalizers and not bound:
+            self.cluster.update(
+                "persistentvolumes",
+                dataclasses.replace(
+                    pv, metadata=_without_finalizer(
+                        meta, PV_PROTECTION_FINALIZER)),
+                expect_rv=rv,
+            )
+
+
+class ClusterRoleAggregationController(Reconciler):
+    """ClusterRoles with an aggregationRule get .rules = the union of every
+    selected ClusterRole's rules (clusterroleaggregation_controller.go
+    syncClusterRole; rule order follows selector then role-name order)."""
+
+    WATCH_KINDS = ("clusterroles",)
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind != "clusterroles" or not isinstance(obj, dict):
+            return
+        if obj.get("aggregationRule"):
+            self.queue.add(obj.get("name", ""))
+        else:
+            # a labeled part changed: re-sync every aggregating role
+            for role in self.cluster.list("clusterroles"):
+                if isinstance(role, dict) and role.get("aggregationRule"):
+                    self.queue.add(role.get("name", ""))
+
+    def sync(self, name: str) -> None:
+        from kubernetes_tpu.api.labels import selector_from_label_selector
+
+        role = self.cluster.get("clusterroles", "", name)
+        if role is None or not role.get("aggregationRule"):
+            return
+        selectors = (role["aggregationRule"].get("clusterRoleSelectors")
+                     or [])
+        rules = []
+        for ls in selectors:
+            sel = selector_from_label_selector(ls)
+            if sel is None:
+                continue
+            for part in sorted(
+                    self.cluster.list("clusterroles"),
+                    key=lambda r: r.get("name", "")):
+                if not isinstance(part, dict) or part.get("name") == name:
+                    continue
+                if sel.matches(part.get("labels")
+                               or (part.get("metadata") or {}).get(
+                                   "labels") or {}):
+                    rules.extend(part.get("rules") or [])
+        if rules != (role.get("rules") or []):
+            self.cluster.update("clusterroles", {**role, "rules": rules})
+
+
+# reference boundaries (ttl_controller.go:102-109): overlapping bands give
+# hysteresis so a cluster hovering at a threshold doesn't flap annotations
+TTL_BOUNDARIES = (
+    (0, 100, 0),
+    (90, 500, 15),
+    (450, 1000, 30),
+    (900, 2000, 60),
+    (1800, 10000, 300),
+    (9000, 1 << 31, 600),
+)
+
+
+class NodeTTLController(Reconciler):
+    """Annotate every node with the cluster-size-derived object-cache TTL
+    (ttl_controller.go): kubelets use it to decide how long secrets/
+    configmaps may be cached."""
+
+    WATCH_KINDS = ("nodes",)
+
+    def __init__(self, cluster: LocalCluster, informers=None):
+        self._ttl = 0
+        super().__init__(cluster, informers=informers)
+
+    def _desired_ttl(self) -> int:
+        n = len(self.cluster.list("nodes"))
+        cur = self._ttl
+        for lo, hi, ttl in TTL_BOUNDARIES:
+            if ttl == cur:
+                # stay in the current band while the size is inside its
+                # (overlapping) hysteresis range
+                if lo <= n <= hi:
+                    return cur
+        for lo, hi, ttl in TTL_BOUNDARIES:
+            if n <= hi:
+                return ttl
+        return TTL_BOUNDARIES[-1][2]
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "nodes":
+            if event in (ADDED, DELETED):
+                # size change: every node may need the new annotation
+                for node in self.cluster.list("nodes"):
+                    self.queue.add(node.name)
+            elif event == MODIFIED:
+                self.queue.add(obj.name)
+
+    def sync(self, name: str) -> None:
+        node, rv = self.cluster.get_with_rv("nodes", "", name)
+        if node is None:
+            return
+        self._ttl = self._desired_ttl()
+        want = str(self._ttl)
+        if node.metadata.annotations.get(TTL_ANNOTATION) == want:
+            return
+        ann = {**node.metadata.annotations, TTL_ANNOTATION: want}
+        self.cluster.update(
+            "nodes",
+            dataclasses.replace(
+                node, metadata=dataclasses.replace(
+                    node.metadata, annotations=ann)),
+            expect_rv=rv,
+        )
+
+
+def _b64url(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+def compute_detached_jws(content: str, token_id: str,
+                         token_secret: str) -> str:
+    """Detached-payload JWS (RFC 7515 appendix F) over the cluster-info
+    kubeconfig, HS256 keyed by the bootstrap token secret with the token
+    id as kid — what `kubeadm join --discovery-token` verifies
+    (bootstrapsigner.go computeDetachedSig)."""
+    header = _b64url(json.dumps(
+        {"alg": "HS256", "kid": token_id}, separators=(",", ":")
+    ).encode())
+    payload = _b64url(content.encode())
+    sig = hmac.new(token_secret.encode(),
+                   f"{header}.{payload}".encode(), hashlib.sha256).digest()
+    return f"{header}..{_b64url(sig)}"
+
+
+class BootstrapSigner(Reconciler):
+    """Keep kube-public/cluster-info signed by every signing-enabled
+    bootstrap token; stale signatures (revoked/expired tokens) are
+    removed (bootstrapsigner.go signConfigMap)."""
+
+    WATCH_KINDS = ("configmaps", "secrets")
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "configmaps" and isinstance(obj, dict):
+            if (obj.get("namespace") == "kube-public"
+                    and obj.get("name") == "cluster-info"):
+                self.queue.add("cluster-info")
+        elif kind == "secrets" and isinstance(obj, dict):
+            if obj.get("type") == "bootstrap.kubernetes.io/token":
+                self.queue.add("cluster-info")
+
+    def _signing_tokens(self):
+        for s in self.cluster.list("secrets"):
+            if not isinstance(s, dict):
+                continue
+            if s.get("type") != "bootstrap.kubernetes.io/token":
+                continue
+            if s.get("namespace") != "kube-system":
+                continue
+            data = {**(s.get("data") or {}), **(s.get("stringData") or {})}
+            if str(data.get("usage-bootstrap-signing",
+                            "true")).lower() != "true":
+                continue
+            tid, tsec = data.get("token-id"), data.get("token-secret")
+            if tid and tsec:
+                yield tid, tsec
+
+    def sync(self, _key) -> None:
+        cm = self.cluster.get("configmaps", "kube-public", "cluster-info")
+        if cm is None:
+            return
+        data = dict(cm.get("data") or {})
+        content = data.get("kubeconfig", "")
+        want = {
+            f"jws-kubeconfig-{tid}": compute_detached_jws(content, tid, tsec)
+            for tid, tsec in self._signing_tokens()
+        }
+        new_data = {k: v for k, v in data.items()
+                    if not k.startswith("jws-kubeconfig-")}
+        new_data.update(want)
+        if new_data != data:
+            self.cluster.update(
+                "configmaps", {**cm, "data": new_data})
+
+
+class CSRCleaner:
+    """Garbage-collect settled CertificateSigningRequests (cleaner.go):
+    approved/denied CSRs after 1h, pending after 24h."""
+
+    APPROVED_EXPIRY = 3600.0
+    DENIED_EXPIRY = 3600.0
+    PENDING_EXPIRY = 24 * 3600.0
+
+    def __init__(self, cluster: LocalCluster):
+        self.cluster = cluster
+
+    @staticmethod
+    def _created(csr: dict) -> Optional[float]:
+        from kubernetes_tpu.api.types import parse_time
+
+        meta = csr.get("metadata") or {}
+        return parse_time(meta.get("creationTimestamp")
+                          or csr.get("creationTimestamp"))
+
+    def tick(self, now: Optional[float] = None) -> int:
+        if not self.cluster.has_kind("certificatesigningrequests"):
+            return 0
+        now = time.time() if now is None else now
+        n = 0
+        for csr in list(self.cluster.list("certificatesigningrequests")):
+            if not isinstance(csr, dict):
+                continue
+            created = self._created(csr)
+            if created is None:
+                continue  # unknown age: never reap
+            conds = {c.get("type")
+                     for c in (csr.get("status") or {}).get("conditions")
+                     or []}
+            age = now - created
+            settled = ("Approved" in conds and age > self.APPROVED_EXPIRY) \
+                or ("Denied" in conds and age > self.DENIED_EXPIRY)
+            pending = not conds and age > self.PENDING_EXPIRY
+            if settled or pending:
+                self.cluster.delete(
+                    "certificatesigningrequests", "", csr.get("name", ""))
+                n += 1
+        return n
+
+
+class ExpandController(Reconciler):
+    """Volume expansion (expand_controller.go distilled): when a bound
+    claim requests more than its volume provides, grow the volume to the
+    requested size (the in-tree resize step; filesystem resize is the
+    kubelet's NodeExpand, out of scope for a control-plane store)."""
+
+    WATCH_KINDS = ("persistentvolumeclaims",)
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "persistentvolumeclaims" and event != DELETED:
+            self.queue.add((obj.namespace, obj.name))
+
+    def sync(self, key) -> None:
+        ns, name = key
+        pvc = self.cluster.get("persistentvolumeclaims", ns, name)
+        if pvc is None or not pvc.volume_name or pvc.request is None:
+            return
+        pv, rv = self.cluster.get_with_rv(
+            "persistentvolumes", "", pvc.volume_name)
+        if pv is None or pv.capacity is None:
+            return
+        if pvc.request.value > pv.capacity.value:
+            self.cluster.update(
+                "persistentvolumes",
+                dataclasses.replace(pv, capacity=pvc.request),
+                expect_rv=rv,
+            )
+
+
+class RootCACertPublisher(Reconciler):
+    """Publish the cluster root CA into a kube-root-ca.crt ConfigMap in
+    every active namespace (rootcacertpublisher/publisher.go) — what pods
+    mount to verify the apiserver.  The CA content comes from the
+    kube-system/kube-root-ca Secret (minted by kubeadm init when serving
+    over TLS) or the constructor."""
+
+    WATCH_KINDS = ("namespaces", "configmaps")
+
+    def __init__(self, cluster: LocalCluster, ca_cert: str = "",
+                 informers=None):
+        self._ca = ca_cert
+        super().__init__(cluster, informers=informers)
+
+    def _root_ca(self) -> str:
+        if self._ca:
+            return self._ca
+        if self.cluster.has_kind("secrets"):
+            s = self.cluster.get("secrets", "kube-system", "kube-root-ca")
+            if isinstance(s, dict):
+                return (s.get("data") or {}).get("ca.crt", "")
+        return ""
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind == "namespaces":
+            ns = obj.get("name") if isinstance(obj, dict) else obj.name
+            self.queue.add(ns)
+        elif (kind == "configmaps" and isinstance(obj, dict)
+                and obj.get("name") == ROOT_CA_CONFIGMAP):
+            self.queue.add(obj.get("namespace", "default"))
+
+    def sync(self, ns: str) -> None:
+        nso = self.cluster.get("namespaces", "", ns)
+        if nso is None:
+            return
+        phase = ((nso.get("status") or {}).get("phase", "Active")
+                 if isinstance(nso, dict) else "Active")
+        if phase == "Terminating":
+            return
+        ca = self._root_ca()
+        if not ca:
+            return
+        cm = self.cluster.get("configmaps", ns, ROOT_CA_CONFIGMAP)
+        want = {
+            "namespace": ns, "name": ROOT_CA_CONFIGMAP,
+            "kind": "ConfigMap", "apiVersion": "v1",
+            "data": {"ca.crt": ca},
+        }
+        if cm is None:
+            try:
+                self.cluster.create("configmaps", want)
+            except ConflictError:
+                pass
+        elif (cm.get("data") or {}).get("ca.crt") != ca:
+            self.cluster.update("configmaps", {**cm, "data": {"ca.crt": ca}})

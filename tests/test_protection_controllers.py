@@ -1,0 +1,246 @@
+"""The controller-completeness sweep (runtime/protection.py): finalizer
+protection, clusterrole aggregation, node TTL, bootstrap signing, CSR
+cleaning, volume expansion, root-CA publishing — one behavior test each
+(VERDICT r3 #7: 31/31 non-cloud reference controllers).
+
+Reference: pkg/controller/volume/pvcprotection/pvc_protection_controller.go,
+clusterroleaggregation_controller.go, ttl/ttl_controller.go,
+bootstrap/bootstrapsigner.go, certificates/cleaner/cleaner.go,
+volume/expand/expand_controller.go, rootcacertpublisher/publisher.go."""
+
+import base64
+import dataclasses
+import hashlib
+import hmac
+import json
+
+from kubernetes_tpu.api.storage import (
+    PersistentVolume,
+    PersistentVolumeClaim,
+)
+from kubernetes_tpu.api.resource import parse_quantity
+from kubernetes_tpu.api.types import ObjectMeta
+from kubernetes_tpu.runtime.cluster import LocalCluster
+from kubernetes_tpu.runtime.protection import (
+    BootstrapSigner,
+    ClusterRoleAggregationController,
+    CSRCleaner,
+    ExpandController,
+    NodeTTLController,
+    PVC_PROTECTION_FINALIZER,
+    PV_PROTECTION_FINALIZER,
+    PVCProtectionController,
+    PVProtectionController,
+    RootCACertPublisher,
+    TTL_ANNOTATION,
+    compute_detached_jws,
+)
+
+from fixtures import make_node, make_pod
+
+
+def _drain(ctrl, n=20):
+    for _ in range(n):
+        if not ctrl.process_one(timeout=0.01):
+            break
+
+
+def test_pvc_protection_finalizer_defers_deletion():
+    cluster = LocalCluster()
+    for k in ("persistentvolumeclaims",):
+        cluster.register_kind(k)
+    ctrl = PVCProtectionController(cluster)
+    pvc = PersistentVolumeClaim(
+        metadata=ObjectMeta(namespace="default", name="data"),
+        request=parse_quantity("1Gi"),
+    )
+    cluster.create("persistentvolumeclaims", pvc)
+    _drain(ctrl)
+    got = cluster.get("persistentvolumeclaims", "default", "data")
+    assert PVC_PROTECTION_FINALIZER in got.metadata.finalizers
+    # a running pod uses the claim -> deletion is deferred
+    pod = make_pod("user", volumes=[
+        {"persistentVolumeClaim": {"claimName": "data"}}])
+    cluster.add_pod(pod)
+    cluster.delete("persistentvolumeclaims", "default", "data")
+    _drain(ctrl)
+    got = cluster.get("persistentvolumeclaims", "default", "data")
+    assert got is not None, "in-use claim must survive deletion"
+    assert got.metadata.deletion_timestamp is not None
+    # the pod goes away -> the finalizer lifts -> the claim is gone
+    cluster.delete("pods", "default", "user")
+    _drain(ctrl)
+    assert cluster.get("persistentvolumeclaims", "default", "data") is None
+
+
+def test_pv_protection_bound_volume_survives():
+    cluster = LocalCluster()
+    cluster.register_kind("persistentvolumes")
+    ctrl = PVProtectionController(cluster)
+    pv = PersistentVolume(
+        metadata=ObjectMeta(namespace="", name="vol-1"),
+        capacity=parse_quantity("10Gi"),
+        phase="Bound", claim_ref="default/data",
+    )
+    cluster.create("persistentvolumes", pv)
+    _drain(ctrl)
+    got = cluster.get("persistentvolumes", "", "vol-1")
+    assert PV_PROTECTION_FINALIZER in got.metadata.finalizers
+    cluster.delete("persistentvolumes", "", "vol-1")
+    _drain(ctrl)
+    got = cluster.get("persistentvolumes", "", "vol-1")
+    assert got is not None, "bound PV must survive deletion"
+    # release the volume -> finalizer lifts on the next sync
+    cluster.update("persistentvolumes", dataclasses.replace(
+        got, phase="Released", claim_ref=""))
+    _drain(ctrl)
+    assert cluster.get("persistentvolumes", "", "vol-1") is None
+
+
+def test_clusterrole_aggregation_unions_rules():
+    cluster = LocalCluster()
+    cluster.register_kind("clusterroles")
+    ctrl = ClusterRoleAggregationController(cluster)
+    cluster.create("clusterroles", {
+        "namespace": "", "name": "edit",
+        "aggregationRule": {"clusterRoleSelectors": [
+            {"matchLabels": {"rbac.example.com/aggregate-to-edit": "true"}},
+        ]},
+        "rules": [],
+    })
+    cluster.create("clusterroles", {
+        "namespace": "", "name": "cm-writer",
+        "labels": {"rbac.example.com/aggregate-to-edit": "true"},
+        "rules": [{"verbs": ["create"], "resources": ["configmaps"]}],
+    })
+    cluster.create("clusterroles", {
+        "namespace": "", "name": "unrelated",
+        "rules": [{"verbs": ["*"], "resources": ["secrets"]}],
+    })
+    _drain(ctrl)
+    got = cluster.get("clusterroles", "", "edit")
+    assert got["rules"] == [
+        {"verbs": ["create"], "resources": ["configmaps"]}]
+    # a new labeled part flows into the aggregate
+    cluster.create("clusterroles", {
+        "namespace": "", "name": "pod-lister",
+        "labels": {"rbac.example.com/aggregate-to-edit": "true"},
+        "rules": [{"verbs": ["list"], "resources": ["pods"]}],
+    })
+    _drain(ctrl)
+    got = cluster.get("clusterroles", "", "edit")
+    assert {"verbs": ["list"], "resources": ["pods"]} in got["rules"]
+    assert len(got["rules"]) == 2
+
+
+def test_node_ttl_annotation_tracks_cluster_size():
+    cluster = LocalCluster()
+    ctrl = NodeTTLController(cluster)
+    for i in range(5):
+        cluster.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    _drain(ctrl, n=50)
+    for node in cluster.list("nodes"):
+        assert node.metadata.annotations.get(TTL_ANNOTATION) == "0"
+    # the 0-TTL band tops out at 100 nodes; crossing it moves to 15s
+    for i in range(5, 120):
+        cluster.add_node(make_node(f"n{i}", cpu="1", mem="1Gi"))
+    _drain(ctrl, n=2000)
+    node = cluster.get("nodes", "", "n0")
+    assert node.metadata.annotations.get(TTL_ANNOTATION) == "15"
+
+
+def test_bootstrap_signer_signs_cluster_info():
+    cluster = LocalCluster()
+    for k in ("configmaps", "secrets"):
+        cluster.register_kind(k)
+    ctrl = BootstrapSigner(cluster)
+    cluster.create("configmaps", {
+        "namespace": "kube-public", "name": "cluster-info",
+        "data": {"kubeconfig": "apiVersion: v1\nclusters: []\n"},
+    })
+    cluster.create("secrets", {
+        "namespace": "kube-system", "name": "bootstrap-token-abc123",
+        "type": "bootstrap.kubernetes.io/token",
+        "data": {"token-id": "abc123", "token-secret": "x" * 16,
+                 "usage-bootstrap-signing": "true"},
+    })
+    _drain(ctrl)
+    cm = cluster.get("configmaps", "kube-public", "cluster-info")
+    sig = cm["data"].get("jws-kubeconfig-abc123")
+    assert sig, cm["data"].keys()
+    # verify the detached JWS out-of-band (what kubeadm join does)
+    header, _, signature = sig.split(".")
+    hdr = json.loads(base64.urlsafe_b64decode(header + "=="))
+    assert hdr == {"alg": "HS256", "kid": "abc123"}
+    assert sig == compute_detached_jws(
+        cm["data"]["kubeconfig"], "abc123", "x" * 16)
+    # deleting the token removes its signature
+    cluster.delete("secrets", "kube-system", "bootstrap-token-abc123")
+    _drain(ctrl)
+    cm = cluster.get("configmaps", "kube-public", "cluster-info")
+    assert "jws-kubeconfig-abc123" not in cm["data"]
+
+
+def test_csr_cleaner_reaps_settled_and_stale():
+    cluster = LocalCluster()
+    cluster.register_kind("certificatesigningrequests")
+    now = 1_000_000.0
+    mk = lambda name, age, conds: cluster.create(
+        "certificatesigningrequests", {
+            "namespace": "", "name": name,
+            "metadata": {"name": name, "creationTimestamp": now - age},
+            "status": {"conditions": [{"type": c} for c in conds]},
+        })
+    mk("fresh-approved", 600, ["Approved"])       # < 1h: keep
+    mk("old-approved", 7200, ["Approved"])        # > 1h: reap
+    mk("old-denied", 7200, ["Denied"])            # > 1h: reap
+    mk("pending-young", 7200, [])                 # < 24h pending: keep
+    mk("pending-stale", 100_000, [])              # > 24h pending: reap
+    cleaner = CSRCleaner(cluster)
+    assert cleaner.tick(now=now) == 3
+    left = {c["name"] for c in cluster.list("certificatesigningrequests")}
+    assert left == {"fresh-approved", "pending-young"}
+
+
+def test_expand_controller_grows_bound_volume():
+    cluster = LocalCluster()
+    for k in ("persistentvolumeclaims", "persistentvolumes"):
+        cluster.register_kind(k)
+    ctrl = ExpandController(cluster)
+    cluster.create("persistentvolumes", PersistentVolume(
+        metadata=ObjectMeta(namespace="", name="vol-1"),
+        capacity=parse_quantity("1Gi"), phase="Bound",
+        claim_ref="default/data",
+    ))
+    cluster.create("persistentvolumeclaims", PersistentVolumeClaim(
+        metadata=ObjectMeta(namespace="default", name="data"),
+        volume_name="vol-1", request=parse_quantity("5Gi"), phase="Bound",
+    ))
+    _drain(ctrl)
+    pv = cluster.get("persistentvolumes", "", "vol-1")
+    assert str(pv.capacity) == str(parse_quantity("5Gi"))
+
+
+def test_root_ca_publisher_covers_every_namespace():
+    cluster = LocalCluster()
+    for k in ("namespaces", "configmaps", "secrets"):
+        cluster.register_kind(k)
+    cluster.create("secrets", {
+        "namespace": "kube-system", "name": "kube-root-ca",
+        "data": {"ca.crt": "---CERT---"},
+    })
+    ctrl = RootCACertPublisher(cluster)
+    for ns in ("default", "team-a"):
+        cluster.create("namespaces", {"namespace": "", "name": ns})
+    _drain(ctrl)
+    for ns in ("default", "team-a"):
+        cm = cluster.get("configmaps", ns, "kube-root-ca.crt")
+        assert cm is not None and cm["data"]["ca.crt"] == "---CERT---"
+    # drift heals: an edited copy is restored
+    cluster.update("configmaps", {
+        "namespace": "team-a", "name": "kube-root-ca.crt",
+        "data": {"ca.crt": "tampered"},
+    })
+    _drain(ctrl)
+    cm = cluster.get("configmaps", "team-a", "kube-root-ca.crt")
+    assert cm["data"]["ca.crt"] == "---CERT---"
