@@ -87,6 +87,13 @@ _REGISTRY: Dict[str, tuple] = {
     "certificatesigningrequests": (
         GroupVersionKind("certificates.k8s.io", "v1beta1",
                          "CertificateSigningRequest"), True),
+    "configmaps": (GroupVersionKind("", "v1", "ConfigMap"), False),
+    "mutatingwebhookconfigurations": (
+        GroupVersionKind("admissionregistration.k8s.io", "v1",
+                         "MutatingWebhookConfiguration"), True),
+    "validatingwebhookconfigurations": (
+        GroupVersionKind("admissionregistration.k8s.io", "v1",
+                         "ValidatingWebhookConfiguration"), True),
 }
 
 

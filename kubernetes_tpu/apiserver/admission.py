@@ -567,5 +567,11 @@ def default_admission_chain(cluster, user_getter: Optional[Callable] = None,
     ]
     if user_getter is not None:
         chain.append(NodeRestriction(cluster, user_getter))
+    # dynamic admission: the Mutating/Validating webhook pair sits after
+    # the compiled-in plugins, before ResourceQuota (plugins.go:43-77);
+    # with no configurations registered it is a no-op
+    from kubernetes_tpu.apiserver.webhooks import WebhookDispatcher
+
+    chain.append(WebhookDispatcher(cluster))
     chain.append(ResourceQuota(cluster))
     return chain

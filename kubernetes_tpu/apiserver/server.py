@@ -67,7 +67,12 @@ LIST_KINDS = {"pods": "PodList", "nodes": "NodeList",
               "storageclasses": "StorageClassList",
               "replicationcontrollers": "ReplicationControllerList",
               "certificatesigningrequests":
-                  "CertificateSigningRequestList"}
+                  "CertificateSigningRequestList",
+              "configmaps": "ConfigMapList",
+              "mutatingwebhookconfigurations":
+                  "MutatingWebhookConfigurationList",
+              "validatingwebhookconfigurations":
+                  "ValidatingWebhookConfigurationList"}
 
 # kinds stored as plain dicts carrying the original wire body plus flat
 # namespace/name keys for the store (cluster-scoped kinds use "")
@@ -85,6 +90,9 @@ _DICT_KINDS = {
     "clusterroles": "",               # cluster-scoped
     "clusterrolebindings": "",        # cluster-scoped
     "certificatesigningrequests": "",  # cluster-scoped
+    "configmaps": "default",
+    "mutatingwebhookconfigurations": "",   # cluster-scoped
+    "validatingwebhookconfigurations": "",  # cluster-scoped
 }
 
 

