@@ -10,11 +10,19 @@ CREATE/UPDATE/DELETE before the registry strategy.
 Implemented plugins (each cites its reference):
 
   NamespaceLifecycle        plugin/pkg/admission/namespace/lifecycle/admission.go
+  EventRateLimit            plugin/pkg/admission/eventratelimit/admission.go
   LimitRanger               plugin/pkg/admission/limitranger/admission.go
+  AlwaysPullImages          plugin/pkg/admission/alwayspullimages/admission.go
+  ServiceAccount            plugin/pkg/admission/serviceaccount/admission.go
   PodNodeSelector           plugin/pkg/admission/podnodeselector/admission.go
   Priority                  plugin/pkg/admission/priority/admission.go
   DefaultTolerationSeconds  plugin/pkg/admission/defaulttolerationseconds/admission.go
   TaintNodesByCondition     plugin/pkg/admission/nodetaint/admission.go
+  StorageObjectInUseProtection  plugin/pkg/admission/storage/storageobjectinuseprotection/admission.go
+  PersistentVolumeClaimResize   plugin/pkg/admission/storage/persistentvolumeclaimresize/admission.go
+  PodSecurityPolicy         plugin/pkg/admission/security/podsecuritypolicy/admission.go
+  NodeRestriction           plugin/pkg/admission/noderestriction/admission.go
+  MutatingAdmissionWebhook / ValidatingAdmissionWebhook  apiserver/pkg/admission/plugin/webhook (webhooks.py)
   ResourceQuota             plugin/pkg/admission/resourcequota/admission.go
 
 ``default_admission_chain`` assembles them in the reference's recommended
@@ -541,6 +549,209 @@ class ServiceAccount:
         return obj
 
 
+class AlwaysPullImages:
+    """Force every container's imagePullPolicy to Always
+    (plugin/pkg/admission/alwayspullimages/admission.go): in a multi-
+    tenant cluster a pod must not ride a node-cached private image it
+    could not itself pull."""
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind != "pods" or op not in ("CREATE", "UPDATE"):
+            return obj
+        spec = obj.get("spec") or {}
+        for key in ("containers", "initContainers"):
+            for c in spec.get(key) or []:
+                c["imagePullPolicy"] = "Always"
+        return obj
+
+
+class EventRateLimit:
+    """Token-bucket cap on Event creates
+    (plugin/pkg/admission/eventratelimit/admission.go, server +
+    namespace scopes): a crash-looping fleet must not write-storm the
+    store.  Over-limit creates are REJECTED (429 semantics surfaced as
+    the admission denial)."""
+
+    def __init__(self, qps: float = 50.0, burst: int = 100,
+                 namespace_qps: float = 10.0, namespace_burst: int = 50,
+                 now: Optional[Callable[[], float]] = None):
+        import time as _time
+
+        self._now = now or _time.monotonic
+        self._server = self._bucket(qps, burst)
+        self._ns_cfg = (namespace_qps, namespace_burst)
+        self._ns: Dict[str, dict] = {}
+
+    def _bucket(self, qps: float, burst: int) -> dict:
+        return {"qps": qps, "burst": burst, "tokens": float(burst),
+                "t": self._now()}
+
+    @staticmethod
+    def _take(b: dict, now: float) -> bool:
+        b["tokens"] = min(b["burst"], b["tokens"] + (now - b["t"]) * b["qps"])
+        b["t"] = now
+        if b["tokens"] >= 1.0:
+            b["tokens"] -= 1.0
+            return True
+        return False
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind != "events" or op != "CREATE":
+            return obj
+        now = self._now()
+        ns = (obj.get("metadata") or {}).get("namespace") \
+            or obj.get("namespace", "default")
+        nsb = self._ns.get(ns)
+        if nsb is None:
+            nsb = self._ns[ns] = self._bucket(*self._ns_cfg)
+        if not self._take(self._server, now) or not self._take(nsb, now):
+            raise AdmissionDenied(
+                f"event rate limit exceeded (namespace {ns!r})")
+        return obj
+
+
+class StorageObjectInUseProtection:
+    """Stamp the protection finalizers at create time
+    (plugin/pkg/admission/storage/storageobjectinuseprotection/
+    admission.go) — the admission half of the pvc/pv-protection
+    controllers (runtime/protection.py lifts them when safe)."""
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if op != "CREATE":
+            return obj
+        fin = {"persistentvolumeclaims": "kubernetes.io/pvc-protection",
+               "persistentvolumes": "kubernetes.io/pv-protection"}.get(kind)
+        if fin is None:
+            return obj
+        meta = _meta(obj)
+        fins = list(meta.get("finalizers") or [])
+        if fin not in fins:
+            meta["finalizers"] = fins + [fin]
+        return obj
+
+
+class PersistentVolumeClaimResize:
+    """Gate claim resizes (plugin/pkg/admission/storage/
+    persistentvolumeclaimresize/admission.go): shrinking is never
+    allowed; growing requires the claim's StorageClass to set
+    allowVolumeExpansion."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    @staticmethod
+    def _request(obj: dict) -> Optional[Quantity]:
+        spec = obj.get("spec") or {}
+        req = ((spec.get("resources") or {}).get("requests") or {}
+               ).get("storage")
+        return parse_quantity(req) if req is not None else None
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind != "persistentvolumeclaims" or op != "UPDATE":
+            return obj
+        meta = obj.get("metadata") or {}
+        ns = obj.get("namespace") or meta.get("namespace", "default")
+        name = obj.get("name") or meta.get("name", "")
+        cur = self.cluster.get("persistentvolumeclaims", ns, name)
+        if cur is None:
+            return obj
+        old_req = getattr(cur, "request", None)
+        new_req = self._request(obj)
+        if old_req is None or new_req is None:
+            return obj
+        if new_req.value < old_req.value:
+            raise AdmissionDenied(
+                "persistent volume claims may not shrink "
+                f"({old_req} -> {new_req})")
+        if new_req.value > old_req.value:
+            sc_name = getattr(cur, "storage_class", "")
+            sc = (self.cluster.get("storageclasses", "", sc_name)
+                  if sc_name and self.cluster.has_kind("storageclasses")
+                  else None)
+            allow = False
+            if sc is not None:
+                allow = bool(sc.get("allowVolumeExpansion")
+                             if isinstance(sc, dict)
+                             else getattr(sc, "allow_volume_expansion",
+                                          False))
+            if not allow:
+                raise AdmissionDenied(
+                    f"storage class {sc_name!r} does not allow volume "
+                    "expansion")
+        return obj
+
+
+class PodSecurityPolicy:
+    """PSP validation distilled (plugin/pkg/admission/security/
+    podsecuritypolicy/admission.go:1-379): with policies registered, a
+    pod is admitted iff AT LEAST ONE admits every security-relevant
+    field; with none, the plugin is inert (the reference fails open
+    only when the plugin is disabled — an empty policy set here means
+    the operator opted out of PSP).
+
+    Policy fields honored (spec.): privileged, hostNetwork, hostPID,
+    hostIPC, hostPorts ranges, runAsUser.rule (RunAsAny |
+    MustRunAsNonRoot), volumes ('*' or source-kind names)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    @staticmethod
+    def _violations(psp: dict, pod: dict) -> Optional[str]:
+        spec = psp.get("spec") or {}
+        pspec = pod.get("spec") or {}
+        sc = pspec.get("securityContext") or {}
+        for c in pspec.get("containers") or []:
+            csc = c.get("securityContext") or {}
+            if csc.get("privileged") and not spec.get("privileged"):
+                return f"privileged container {c.get('name')!r}"
+            run_rule = (spec.get("runAsUser") or {}).get("rule", "RunAsAny")
+            if run_rule == "MustRunAsNonRoot":
+                uid = csc.get("runAsUser", sc.get("runAsUser"))
+                if uid == 0:
+                    return f"container {c.get('name')!r} runs as root"
+                if uid is None and not csc.get(
+                        "runAsNonRoot", sc.get("runAsNonRoot")):
+                    return (f"container {c.get('name')!r} must set "
+                            "runAsNonRoot")
+            for p in c.get("ports") or []:
+                hp = p.get("hostPort")
+                if hp:
+                    ranges = spec.get("hostPorts") or []
+                    if not any(r.get("min", 0) <= hp <= r.get("max", 0)
+                               for r in ranges):
+                        return f"host port {hp} not allowed"
+        for flag in ("hostNetwork", "hostPID", "hostIPC"):
+            if pspec.get(flag) and not spec.get(flag):
+                return f"{flag} is not allowed"
+        allowed_vols = spec.get("volumes") or ["*"]
+        if "*" not in allowed_vols:
+            for v in pspec.get("volumes") or []:
+                src = next((k for k in v if k != "name"), None)
+                if src is not None and src not in allowed_vols:
+                    return f"volume source {src!r} not allowed"
+        return None
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind != "pods" or op != "CREATE":
+            return obj
+        if not self.cluster.has_kind("podsecuritypolicies"):
+            return obj
+        psps = [p for p in self.cluster.list("podsecuritypolicies")
+                if isinstance(p, dict)]
+        if not psps:
+            return obj
+        reasons = []
+        for psp in sorted(psps, key=lambda p: p.get("name", "")):
+            why = self._violations(psp, obj)
+            if why is None:
+                return obj  # first admitting policy wins
+            reasons.append(f"{psp.get('name')}: {why}")
+        raise AdmissionDenied(
+            "unable to validate against any pod security policy: "
+            + "; ".join(reasons))
+
+
 def default_admission_chain(cluster, user_getter: Optional[Callable] = None,
                             with_service_account: bool = False,
                             ) -> List[Callable]:
@@ -555,7 +766,9 @@ def default_admission_chain(cluster, user_getter: Optional[Callable] = None,
     every pod create fails for want of the default SA)."""
     chain: List[Callable] = [
         NamespaceLifecycle(cluster),
+        EventRateLimit(),
         LimitRanger(cluster),
+        AlwaysPullImages(),
     ]
     if with_service_account:
         chain.append(ServiceAccount(cluster))
@@ -564,6 +777,9 @@ def default_admission_chain(cluster, user_getter: Optional[Callable] = None,
         Priority(cluster),
         DefaultTolerationSeconds(),
         TaintNodesByCondition(),
+        StorageObjectInUseProtection(),
+        PersistentVolumeClaimResize(cluster),
+        PodSecurityPolicy(cluster),
     ]
     if user_getter is not None:
         chain.append(NodeRestriction(cluster, user_getter))

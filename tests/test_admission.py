@@ -535,3 +535,140 @@ def _req_http(url, method="GET", payload=None, token=None):
             return resp.status, json.loads(resp.read() or b"{}")
     except urllib.error.HTTPError as e:
         return e.code, json.loads(e.read() or b"{}")
+
+
+# ----------------------------------------------- round-4 breadth plugins
+
+
+def test_always_pull_images():
+    from kubernetes_tpu.apiserver.admission import AlwaysPullImages
+
+    p = AlwaysPullImages()
+    pod = {"spec": {
+        "containers": [{"name": "c", "image": "nginx",
+                        "imagePullPolicy": "IfNotPresent"}],
+        "initContainers": [{"name": "i", "image": "busybox"}],
+    }}
+    out = p("CREATE", "pods", pod)
+    assert out["spec"]["containers"][0]["imagePullPolicy"] == "Always"
+    assert out["spec"]["initContainers"][0]["imagePullPolicy"] == "Always"
+    # non-pod kinds untouched
+    assert p("CREATE", "secrets", {"x": 1}) == {"x": 1}
+
+
+def test_event_rate_limit_buckets():
+    from kubernetes_tpu.apiserver.admission import (
+        AdmissionDenied,
+        EventRateLimit,
+    )
+
+    clock = {"t": 0.0}
+    p = EventRateLimit(qps=10.0, burst=5, namespace_qps=10.0,
+                       namespace_burst=3, now=lambda: clock["t"])
+    ev = {"metadata": {"namespace": "default", "name": "e"}}
+    # namespace burst (3) trips first
+    for _ in range(3):
+        p("CREATE", "events", dict(ev))
+    with pytest.raises(AdmissionDenied):
+        p("CREATE", "events", dict(ev))
+    # another namespace has its own bucket (server burst 5 still has 1)
+    p("CREATE", "events", {"metadata": {"namespace": "other", "name": "e"}})
+    # time refills tokens
+    clock["t"] = 1.0
+    p("CREATE", "events", dict(ev))
+
+
+def test_storage_object_in_use_protection_stamps_finalizer():
+    from kubernetes_tpu.apiserver.admission import (
+        StorageObjectInUseProtection,
+    )
+
+    p = StorageObjectInUseProtection()
+    pvc = {"metadata": {"namespace": "default", "name": "data"}}
+    out = p("CREATE", "persistentvolumeclaims", pvc)
+    assert out["metadata"]["finalizers"] == ["kubernetes.io/pvc-protection"]
+    pv = {"metadata": {"name": "vol"}}
+    out = p("CREATE", "persistentvolumes", pv)
+    assert out["metadata"]["finalizers"] == ["kubernetes.io/pv-protection"]
+    # idempotent
+    out = p("CREATE", "persistentvolumes", out)
+    assert out["metadata"]["finalizers"] == ["kubernetes.io/pv-protection"]
+
+
+def test_pvc_resize_gate():
+    from kubernetes_tpu.api.resource import parse_quantity
+    from kubernetes_tpu.api.storage import PersistentVolumeClaim
+    from kubernetes_tpu.api.types import ObjectMeta
+    from kubernetes_tpu.apiserver.admission import (
+        AdmissionDenied,
+        PersistentVolumeClaimResize,
+    )
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+
+    cluster = LocalCluster()
+    cluster.create("persistentvolumeclaims", PersistentVolumeClaim(
+        metadata=ObjectMeta(namespace="default", name="data"),
+        storage_class="fast", request=parse_quantity("5Gi"),
+    ))
+    p = PersistentVolumeClaimResize(cluster)
+    body = lambda size: {
+        "metadata": {"namespace": "default", "name": "data"},
+        "spec": {"storageClassName": "fast",
+                 "resources": {"requests": {"storage": size}}},
+    }
+    # shrink: never
+    with pytest.raises(AdmissionDenied):
+        p("UPDATE", "persistentvolumeclaims", body("1Gi"))
+    # grow without an expandable class: denied
+    with pytest.raises(AdmissionDenied):
+        p("UPDATE", "persistentvolumeclaims", body("10Gi"))
+    cluster.create("storageclasses", {
+        "namespace": "", "name": "fast", "allowVolumeExpansion": True,
+    })
+    assert p("UPDATE", "persistentvolumeclaims", body("10Gi"))
+    # same size passes untouched
+    assert p("UPDATE", "persistentvolumeclaims", body("5Gi"))
+
+
+def test_pod_security_policy_any_admitting_policy_wins():
+    from kubernetes_tpu.apiserver.admission import (
+        AdmissionDenied,
+        PodSecurityPolicy,
+    )
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+
+    cluster = LocalCluster()
+    cluster.register_kind("podsecuritypolicies")
+    p = PodSecurityPolicy(cluster)
+    priv_pod = {"spec": {"hostNetwork": True, "containers": [
+        {"name": "c", "securityContext": {"privileged": True}}]}}
+    plain_pod = {"spec": {"containers": [
+        {"name": "c", "securityContext": {"runAsUser": 1000}}]}}
+    # no policies: inert
+    assert p("CREATE", "pods", dict(priv_pod))
+    cluster.create("podsecuritypolicies", {
+        "namespace": "", "name": "restricted",
+        "spec": {"privileged": False,
+                 "runAsUser": {"rule": "MustRunAsNonRoot"},
+                 "volumes": ["configMap", "secret",
+                             "persistentVolumeClaim"]},
+    })
+    assert p("CREATE", "pods", dict(plain_pod))
+    with pytest.raises(AdmissionDenied):
+        p("CREATE", "pods", dict(priv_pod))
+    root_pod = {"spec": {"containers": [
+        {"name": "c", "securityContext": {"runAsUser": 0}}]}}
+    with pytest.raises(AdmissionDenied):
+        p("CREATE", "pods", dict(root_pod))
+    hostpath_pod = {"spec": {"containers": [{"name": "c"}], "volumes": [
+        {"name": "v", "hostPath": {"path": "/etc"}}]}}
+    with pytest.raises(AdmissionDenied):
+        p("CREATE", "pods", dict(hostpath_pod))
+    # a second, privileged policy admits what restricted rejects
+    cluster.create("podsecuritypolicies", {
+        "namespace": "", "name": "privileged",
+        "spec": {"privileged": True, "hostNetwork": True, "hostPID": True,
+                 "runAsUser": {"rule": "RunAsAny"}, "volumes": ["*"]},
+    })
+    assert p("CREATE", "pods", dict(priv_pod))
+    assert p("CREATE", "pods", dict(root_pod))
