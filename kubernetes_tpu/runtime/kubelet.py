@@ -163,7 +163,19 @@ class Kubelet:
             self._awaiting_volumes.add(key)
             return
         self._awaiting_volumes.discard(key)
-        self.sandbox_of[key] = self.runtime.run_pod_sandbox(pod)
+        try:
+            self.sandbox_of[key] = self.runtime.run_pod_sandbox(pod)
+        except Exception as e:
+            # a dead/unreachable runtime (kill -9 across the CRI socket,
+            # runtime/cri.py RuntimeUnavailable) is a POD sync failure,
+            # never a kubelet crash: surface the event and leave the pod
+            # Pending for the next sync to retry (syncPod error path)
+            self.cluster.events.eventf(
+                "Pod", pod.namespace, pod.name, "Warning",
+                "FailedCreatePodSandBox",
+                "runtime: %s", e,
+            )
+            return
         if pod.status.phase != "Running":
             self.cluster.update(
                 "pods",
@@ -178,8 +190,13 @@ class Kubelet:
     def _teardown(self, key: tuple, pod=None) -> None:
         sid = self.sandbox_of.pop(key, None)
         if sid is not None:
-            self.runtime.stop_pod_sandbox(sid)
-            self.runtime.remove_pod_sandbox(sid)
+            try:
+                self.runtime.stop_pod_sandbox(sid)
+                self.runtime.remove_pod_sandbox(sid)
+            except Exception:
+                # an unreachable runtime cannot stop the sandbox now; the
+                # PLEG relist reconciles once it returns
+                pass
         # DELETED events carry the final object; the store no longer has it
         pod = pod if pod is not None else self.cluster.get("pods", *key)
         if pod is not None:
@@ -193,7 +210,11 @@ class Kubelet:
         pods the completer approves, tear down sandboxes whose pod is gone.
         Returns completions this sweep."""
         done = 0
-        for sb in self.runtime.list_pod_sandboxes():
+        try:
+            sandboxes = self.runtime.list_pod_sandboxes()
+        except Exception:
+            return 0  # runtime away: nothing to reconcile this sweep
+        for sb in sandboxes:
             ns, name = sb["pod"]
             pod = self.cluster.get("pods", ns, name)
             if pod is None or pod.spec.node_name != self.node.name:

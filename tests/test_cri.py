@@ -1,0 +1,174 @@
+"""CRI over a real process boundary (runtime/cri.py): wire round trips,
+the container state machine, the kubelet driving a runtime daemon in a
+SEPARATE OS process, and kill -9 surfacing as pod failures — VERDICT r3
+#5 'done' criteria.
+
+Reference: pkg/kubelet/remote/remote_runtime.go:1-512,
+cri-api/pkg/apis/runtime/v1alpha2/api.proto."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubernetes_tpu.runtime.cluster import LocalCluster
+from kubernetes_tpu.runtime.cri import (
+    CONTAINER_CREATED,
+    CONTAINER_EXITED,
+    CONTAINER_RUNNING,
+    CRIError,
+    CRIServer,
+    CRIService,
+    RemoteRuntime,
+    RuntimeUnavailable,
+)
+from kubernetes_tpu.runtime.kubelet import FakeRuntime, Kubelet
+
+from fixtures import make_node, make_pod
+
+
+def _sock(tmp_path):
+    return str(tmp_path / "cri.sock")
+
+
+def test_wire_round_trip_and_container_lifecycle(tmp_path):
+    srv = CRIServer(CRIService(FakeRuntime()), _sock(tmp_path)).start()
+    rt = RemoteRuntime(_sock(tmp_path))
+    try:
+        assert rt.version()["runtime_api_version"] == "v1alpha2"
+        assert all(c["status"] for c in rt.status()["conditions"])
+        sid = rt.run_pod_sandbox(make_pod("web"))
+        assert [sb["id"] for sb in rt.list_pod_sandboxes()] == [sid]
+        assert rt.pod_sandbox_status(sid)["pod"] == ["default", "web"]
+        # container state machine: CREATED -> RUNNING -> EXITED
+        cid = rt.create_container(sid, "app", image="nginx")
+        assert rt.container_status(cid)["state"] == CONTAINER_CREATED
+        rt.start_container(cid)
+        assert rt.container_status(cid)["state"] == CONTAINER_RUNNING
+        with pytest.raises(CRIError):
+            rt.start_container(cid)  # double-start
+        with pytest.raises(CRIError):
+            rt.remove_container(cid)  # running
+        # stopping the sandbox kills its containers (exit 137)
+        rt.stop_pod_sandbox(sid)
+        st = rt.container_status(cid)
+        assert st["state"] == CONTAINER_EXITED and st["exit_code"] == 137
+        rt.remove_pod_sandbox(sid)
+        assert rt.list_containers() == []
+        with pytest.raises(CRIError):
+            rt.container_status(cid)
+    finally:
+        rt.close()
+        srv.stop()
+
+
+def test_unknown_method_and_missing_sandbox(tmp_path):
+    srv = CRIServer(CRIService(FakeRuntime()), _sock(tmp_path)).start()
+    rt = RemoteRuntime(_sock(tmp_path))
+    try:
+        with pytest.raises(CRIError):
+            rt._call("no_such_verb")
+        with pytest.raises(CRIError):
+            rt.create_container("sandbox-404", "app")
+    finally:
+        rt.close()
+        srv.stop()
+
+
+def _spawn_runtime_daemon(sock_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubernetes_tpu.runtime.cri",
+         "--socket", sock_path, "--backend", "fake"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if os.path.exists(sock_path):
+            return proc
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"runtime daemon died: {proc.stdout.read().decode()}")
+        time.sleep(0.05)
+    proc.kill()
+    raise RuntimeError("runtime daemon never bound its socket")
+
+
+def test_kubelet_against_separate_process_runtime(tmp_path):
+    """The kubelet syncs pods through a runtime living in ANOTHER OS
+    process; kill -9 of that process surfaces as pod sync failures and
+    events — the kubelet keeps running."""
+    sock_path = _sock(tmp_path)
+    daemon = _spawn_runtime_daemon(sock_path)
+    cluster = LocalCluster()
+    node = make_node("n1", cpu="4", mem="8Gi")
+    rt = RemoteRuntime(sock_path, timeout=3.0)
+    kubelet = Kubelet(cluster, node, runtime=rt)
+    try:
+        pod = make_pod("web", cpu="100m", node_name="n1")
+        cluster.add_pod(pod)
+        kubelet.sync_pod(cluster.get("pods", "default", "web"))
+        got = cluster.get("pods", "default", "web")
+        assert got.status.phase == "Running"
+        assert rt.list_pod_sandboxes()[0]["pod"] == ("default", "web")
+        # the runtime process dies hard
+        daemon.send_signal(signal.SIGKILL)
+        daemon.wait(timeout=10)
+        # a fresh pod syncs WITHOUT crashing the kubelet: pod failure only
+        pod2 = make_pod("web2", cpu="100m", node_name="n1")
+        cluster.add_pod(pod2)
+        kubelet.sync_pod(cluster.get("pods", "default", "web2"))
+        got2 = cluster.get("pods", "default", "web2")
+        assert got2.status.phase != "Running"
+        events = cluster.events.events(reason="FailedCreatePodSandBox")
+        assert events, "runtime failure must surface as a pod event"
+        # PLEG sweeps degrade gracefully too
+        assert kubelet.pleg_relist() == 0
+        # direct client calls raise the typed transport error
+        with pytest.raises(RuntimeUnavailable):
+            rt.list_pod_sandboxes()
+    finally:
+        rt.close()
+        if daemon.poll() is None:
+            daemon.kill()
+
+
+def test_process_runtime_behind_cri_daemon(tmp_path):
+    """ProcessRuntime (real pause processes) served over the socket from
+    a separate daemon process: the sandbox is anchored by a live pause
+    pid in THAT process tree."""
+    sock_path = _sock(tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "kubernetes_tpu.runtime.cri",
+         "--socket", sock_path, "--backend", "process"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    try:
+        deadline = time.time() + 30
+        while not os.path.exists(sock_path):
+            if daemon.poll() is not None:
+                pytest.skip("pause build unavailable: "
+                            + daemon.stdout.read().decode()[:200])
+            if time.time() > deadline:
+                raise RuntimeError("daemon never bound socket")
+            time.sleep(0.05)
+        rt = RemoteRuntime(sock_path, timeout=5.0)
+        sid = rt.run_pod_sandbox(make_pod("anchored"))
+        sb = rt.pod_sandbox_status(sid)
+        pid = sb.get("pid")
+        assert pid and pid != os.getpid()
+        os.kill(pid, 0)  # alive
+        rt.stop_pod_sandbox(sid)
+        rt.remove_pod_sandbox(sid)
+        assert rt.list_pod_sandboxes() == []
+        rt.close()
+    finally:
+        if daemon.poll() is None:
+            daemon.kill()
+            daemon.wait(timeout=5)
