@@ -100,6 +100,19 @@ _DICT_KINDS = {
 # handler code and external callers share one type
 from kubernetes_tpu.apiserver.admission import AdmissionDenied  # noqa: E402
 
+from dataclasses import dataclass  # noqa: E402
+
+
+@dataclass
+class TLSConfig:
+    """Secure-serving material (secure_serving.go SecureServingInfo):
+    the serving keypair plus, optionally, the CA that client certs must
+    chain to (enables x509 authn)."""
+
+    cert_path: str
+    key_path: str
+    client_ca_path: str = ""
+
 
 def _decode(kind: str, d: dict):
     if kind == "pods":
@@ -335,6 +348,7 @@ class APIServer:
         audit_path: Optional[str] = None,
         authenticator=None,
         authorizer=None,
+        tls: Optional["TLSConfig"] = None,
     ):
         self.cluster = cluster if cluster is not None else LocalCluster()
         # authn/authz handler-chain slots (config.go:544-550).  Both None =
@@ -361,6 +375,22 @@ class APIServer:
         self._write_lock = threading.Lock()
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._httpd.daemon_threads = True
+        # secure serving (secure_serving.go:1-238): wrap the listener in
+        # TLS; with a client CA configured, request (not require) client
+        # certs — the x509 authenticator turns them into identities, and
+        # cert-less clients fall through to bearer tokens
+        self.tls = tls
+        if tls is not None:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=tls.cert_path,
+                                keyfile=tls.key_path)
+            if tls.client_ca_path:
+                ctx.load_verify_locations(cafile=tls.client_ca_path)
+                ctx.verify_mode = ssl.CERT_OPTIONAL
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True)
         self._thread: Optional[threading.Thread] = None
 
     # ----------------------------------------------------------- lifecycle
@@ -372,7 +402,8 @@ class APIServer:
     @property
     def url(self) -> str:
         h, p = self.address
-        return f"http://{h}:{p}"
+        scheme = "https" if self.tls is not None else "http"
+        return f"{scheme}://{h}:{p}"
 
     def start(self) -> "APIServer":
         self._thread = threading.Thread(
@@ -586,6 +617,26 @@ class APIServer:
                 # keep-alive requests, so a stale identity must never
                 # survive into the next request's admission run
                 outer.request_user.user = None
+                # x509 client-cert authn runs FIRST in the union
+                # (authentication/request/x509: CN = user, O = groups);
+                # the TLS layer already verified the chain against the
+                # client CA, so a presented cert IS the identity
+                if outer.tls is not None and outer.tls.client_ca_path:
+                    try:
+                        der = self.connection.getpeercert(binary_form=True)
+                    except (AttributeError, ValueError):
+                        der = None
+                    if der:
+                        from kubernetes_tpu.utils.pki import (
+                            identity_from_cert_der,
+                        )
+
+                        cn, orgs = identity_from_cert_der(der)
+                        if cn:
+                            user = UserInfo(
+                                cn, orgs + ("system:authenticated",))
+                            outer.request_user.user = user
+                            return user
                 if outer.authenticator is None:
                     # open server: every caller is effectively the admin
                     user = UserInfo("system:admin", (SUPERUSER_GROUP,))

@@ -27,6 +27,18 @@ import threading
 import time
 import urllib.error
 import urllib.request
+
+
+def _urlopen(req, timeout):
+    """urlopen with the CLI-wide TLS trust (KTPU_CACERT) for https
+    planes; plain http passes context=None."""
+    url = req.full_url if hasattr(req, "full_url") else str(req)
+    ctx = None
+    if url.startswith("https://"):
+        from kubernetes_tpu.cmd.base import tls_client_context
+
+        ctx = tls_client_context()
+    return urllib.request.urlopen(req, timeout=timeout, context=ctx)
 from typing import Optional
 
 from kubernetes_tpu.runtime.cluster import LocalCluster
@@ -125,7 +137,7 @@ class Reflector:
             headers["Accept"] = BINARY_MEDIA_TYPE
         req = urllib.request.Request(
             self.server + "/api/v1/watch", headers=headers)
-        with urllib.request.urlopen(req, timeout=30) as resp:
+        with _urlopen(req, timeout=30) as resp:
             replay: list = []
             in_replay = True
             for ev in self._event_stream(resp):
@@ -191,7 +203,7 @@ def remote_victim_deleter(server: str, token: str = ""):
             method="DELETE", headers=_auth_headers(token),
         )
         try:
-            urllib.request.urlopen(req, timeout=10)
+            _urlopen(req, timeout=10)
         except (urllib.error.HTTPError, urllib.error.URLError):
             pass  # already gone / transient: the requeue path retries
 
@@ -209,7 +221,7 @@ def remote_unbinder(server: str, token: str = ""):
             try:
                 get_req = urllib.request.Request(
                     base, headers=_auth_headers(token))
-                with urllib.request.urlopen(get_req, timeout=10) as resp:
+                with _urlopen(get_req, timeout=10) as resp:
                     d = json.loads(resp.read())
                 d.setdefault("spec", {})["nodeName"] = ""
                 # carry the fetched resourceVersion so the server's CAS
@@ -219,7 +231,7 @@ def remote_unbinder(server: str, token: str = ""):
                     base, data=json.dumps(d).encode(), method="PUT",
                     headers=_auth_headers(token, json_body=True),
                 )
-                with urllib.request.urlopen(req, timeout=10) as resp:
+                with _urlopen(req, timeout=10) as resp:
                     return resp.status == 200
             except urllib.error.HTTPError as e:
                 if e.code == 409:
@@ -249,7 +261,7 @@ class RemoteBinder:
             headers=_auth_headers(self.token, json_body=True),
         )
         try:
-            with urllib.request.urlopen(req, timeout=10) as resp:
+            with _urlopen(req, timeout=10) as resp:
                 return resp.status in (200, 201)
         except urllib.error.HTTPError:
             return False  # 409 conflict etc -> scheduler rolls back + retries

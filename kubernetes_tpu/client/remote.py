@@ -107,8 +107,14 @@ class RemoteCluster:
         req = urllib.request.Request(
             self.server + path, data=data, method=method, headers=headers,
         )
+        ctx = None
+        if self.server.startswith("https://"):
+            from kubernetes_tpu.cmd.base import tls_client_context
+
+            ctx = tls_client_context()
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
+            with urllib.request.urlopen(req, timeout=30,
+                                        context=ctx) as resp:
                 return json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as e:
             body = e.read().decode(errors="replace")

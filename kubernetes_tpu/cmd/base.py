@@ -25,11 +25,37 @@ def add_common_flags(p: argparse.ArgumentParser) -> None:
                    help="log level (klog.V analog)")
 
 
+def tls_client_context(cacert: Optional[str] = None,
+                       client_cert: Optional[str] = None,
+                       client_key: Optional[str] = None):
+    """ssl context for an HTTPS plane: trust ``cacert`` (or the
+    KTPU_CACERT env var — the kubeconfig certificate-authority analog
+    every CLI inherits), optionally presenting a client cert."""
+    import os as _os
+    import ssl as _ssl
+
+    cacert = cacert or _os.environ.get("KTPU_CACERT", "")
+    if cacert:
+        ctx = _ssl.create_default_context(cafile=cacert)
+        ctx.check_hostname = False  # planes serve by IP SAN
+    elif _os.environ.get("KTPU_INSECURE_SKIP_TLS_VERIFY", "") == "1":
+        ctx = _ssl._create_unverified_context()
+    else:
+        ctx = _ssl.create_default_context()
+        ctx.check_hostname = False
+    cert = client_cert or _os.environ.get("KTPU_CLIENT_CERT", "")
+    key = client_key or _os.environ.get("KTPU_CLIENT_KEY", "")
+    if cert and key:
+        ctx.load_cert_chain(certfile=cert, keyfile=key)
+    return ctx
+
+
 def api_request(server: str, method: str, path: str, payload=None,
                 token: Optional[str] = None) -> dict:
     """One HTTP helper for every CLI: JSON in/out, HTTP errors surfaced as
     Status dicts (body preserved), unreachable server as a 503 Status.
-    ``token`` adds an ``Authorization: Bearer`` header (RBAC'd planes)."""
+    ``token`` adds an ``Authorization: Bearer`` header (RBAC'd planes);
+    https servers verify against KTPU_CACERT (see tls_client_context)."""
     import json as _json
     import urllib.error
     import urllib.request
@@ -42,8 +68,10 @@ def api_request(server: str, method: str, path: str, payload=None,
         server.rstrip("/") + path, data=data, method=method,
         headers=headers,
     )
+    ctx = (tls_client_context()
+           if server.startswith("https://") else None)
     try:
-        with urllib.request.urlopen(req, timeout=30) as resp:
+        with urllib.request.urlopen(req, timeout=30, context=ctx) as resp:
             return _json.loads(resp.read() or b"{}")
     except urllib.error.HTTPError as e:
         body = e.read().decode(errors="replace")

@@ -50,6 +50,14 @@ def build_parser() -> argparse.ArgumentParser:
                       help="where to write the kubeconfig JSON "
                       "(default <data-dir or .>/admin.conf)")
     init.add_argument("--hollow-nodes", type=int, default=0)
+    init.add_argument("--secure", action="store_true",
+                      help="serve HTTPS: mint a cluster CA + serving "
+                      "cert (utils/pki.py), publish ca.crt through the "
+                      "root-CA ConfigMap flow, sign node CSRs as real "
+                      "client certs")
+    init.add_argument("--cert-dir", default="",
+                      help="where the CA + serving material lands "
+                      "(default: <data-dir>/pki or a temp dir)")
     init.add_argument("--one-shot", action="store_true",
                       help="bring the plane up, print the join line, exit "
                       "(for tests; default blocks until SIGTERM)")
@@ -152,10 +160,66 @@ def cmd_init(args) -> int:
     # auth-token Secret so a data-dir restart still authenticates it
     ensure_bootstrap_policy(cluster)
     authn = TokenAuthenticator(cluster)
+    tls_cfg = None
+    node_ca = None
+    if getattr(args, "secure", False):
+        # certs phase (kubeadm app/phases/certs): one cluster CA, a
+        # serving cert for the advertise address, ca.crt into the
+        # kube-root-ca Secret so RootCACertPublisher fans it out to every
+        # namespace, and the CSR signer flips to real client certs
+        import os as _os
+        import tempfile as _tempfile
+
+        from kubernetes_tpu.apiserver.server import TLSConfig
+        from kubernetes_tpu.utils.pki import CertificateAuthority
+
+        cert_dir = args.cert_dir or (
+            _os.path.join(args.data_dir, "pki") if args.data_dir
+            else _tempfile.mkdtemp(prefix="kubeadm-pki-"))
+        _os.makedirs(cert_dir, exist_ok=True)
+        ca_crt = _os.path.join(cert_dir, "ca.crt")
+        ca_key = _os.path.join(cert_dir, "ca.key")
+        if _os.path.exists(ca_crt) and _os.path.exists(ca_key):
+            with open(ca_crt, "rb") as f:
+                crt = f.read()
+            with open(ca_key, "rb") as f:
+                key = f.read()
+            node_ca = CertificateAuthority(crt, key)
+        else:
+            node_ca = CertificateAuthority.create("kubernetes")
+            with open(ca_crt, "wb") as f:
+                f.write(node_ca.cert_pem)
+            with open(ca_key, "wb") as f:
+                f.write(node_ca.key_pem)
+        serving = node_ca.issue(
+            "kube-apiserver", sans=[args.host, "localhost", "127.0.0.1",
+                                    "kubernetes", "kubernetes.default"])
+        srv_crt = _os.path.join(cert_dir, "apiserver.crt")
+        srv_key = _os.path.join(cert_dir, "apiserver.key")
+        with open(srv_crt, "wb") as f:
+            f.write(serving.cert_pem)
+        with open(srv_key, "wb") as f:
+            f.write(serving.key_pem)
+        tls_cfg = TLSConfig(cert_path=srv_crt, key_path=srv_key,
+                            client_ca_path=ca_crt)
+        # init is its own first client (token store, health probes):
+        # trust the CA process-wide, exactly what a kubeconfig's
+        # certificate-authority entry does for external clients
+        _os.environ["KTPU_CACERT"] = ca_crt
+        root_ca_secret = {
+            "namespace": TOKEN_NS, "name": "kube-root-ca",
+            "kind": "Secret", "apiVersion": "v1",
+            "data": {"ca.crt": node_ca.cert_pem.decode()},
+        }
+        try:
+            cluster.create("secrets", root_ca_secret)
+        except Exception:
+            cluster.update("secrets", root_ca_secret)
     srv = APIServer(
         cluster=cluster, host=args.host, port=args.port,
         authenticator=authn,
         authorizer=RBACAuthorizer(cluster),
+        tls=tls_cfg,
     )
     # the full production chain: ServiceAccount admission (the SA/token
     # controllers run below) + NodeRestriction (kubelet identities only
@@ -179,7 +243,7 @@ def cmd_init(args) -> int:
 
     sched = build_wired_scheduler(cluster, load_component_config(args.config))
     threading.Thread(target=sched.run, daemon=True).start()
-    cm = ControllerManager(cluster)
+    cm = ControllerManager(cluster, csr_ca=node_ca)
     cm.start()
     klog.V(1).infof("[init] scheduler + controller-manager started")
 
@@ -209,8 +273,13 @@ def cmd_init(args) -> int:
     # 0600: the file now carries the system:masters credential
     fd = os.open(kubeconfig, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
     with os.fdopen(fd, "w") as f:
-        json.dump({"server": srv.url, "token": admin_token,
-                   "bootstrap-token": token}, f)
+        kc = {"server": srv.url, "token": admin_token,
+              "bootstrap-token": token}
+        if tls_cfg is not None:
+            # the kubeconfig certificate-authority entry: clients export
+            # KTPU_CACERT=<this> (cmd/base.py tls_client_context)
+            kc["certificate-authority"] = tls_cfg.client_ca_path
+        json.dump(kc, f)
     klog.infof("[init] kubeconfig written to %s", kubeconfig)
 
     if args.hollow_nodes:

@@ -11,12 +11,19 @@ returning the credential in ``status.certificate``; the kubelet then
 drops the bootstrap token and authenticates as its node identity, which
 RBAC (system:nodes) and NodeRestriction scope per-object.
 
-This framework's client credentials are bearer tokens, so the "signed
-certificate" is a minted node auth-token Secret
-(``kubernetes-tpu/auth-token`` with user ``system:node:<name>``, the
-form TokenAuthenticator resolves); the token itself is returned in
-``status.certificate`` exactly where the reference returns the PEM —
-readable by the requester polling its own CSR.
+Two credential forms, matching the server's two authn paths:
+
+  * bearer mode (default): the "signed certificate" is a minted node
+    auth-token Secret (``kubernetes-tpu/auth-token`` with user
+    ``system:node:<name>``, the form TokenAuthenticator resolves); the
+    token rides ``status.certificate`` where the reference puts the PEM;
+  * PKI mode (signer constructed with a ``CertificateAuthority``, the
+    TLS serving stack of utils/pki.py): a CSR whose ``spec.request``
+    carries a REAL PEM CSR gets a REAL signed client certificate in
+    ``status.certificate`` (signer.go), subject policy enforced by the
+    approver: CN must be the requested node identity, O must be
+    system:nodes.  The apiserver's x509 authn then accepts the cert
+    directly.
 """
 
 from __future__ import annotations
@@ -35,6 +42,11 @@ class CSRApproverSigner(Reconciler):
     credential, surface it in status.certificate."""
 
     WATCH_KINDS = ("certificatesigningrequests",)
+
+    def __init__(self, cluster: LocalCluster, ca=None, informers=None):
+        #: utils.pki.CertificateAuthority for PKI mode, or None (bearer)
+        self.ca = ca
+        super().__init__(cluster, informers=informers)
 
     def _on_event(self, event: str, kind: str, obj) -> None:
         if kind == "certificatesigningrequests" and event != DELETED:
@@ -84,8 +96,48 @@ class CSRApproverSigner(Reconciler):
             ]}
             self.cluster.update("certificatesigningrequests", out)
             return
-        # sign: mint a FRESH node credential, ROTATING any existing one.
-        # Never reuse-and-return the stored token: that would hand a
+        if self.ca is not None and spec.get("request"):
+            # PKI mode: sign the real CSR (signer.go), with the approver's
+            # subject policy — the CSR may only claim the node identity it
+            # requested (CN) and the nodes group (O); anything else is a
+            # privilege escalation and is Denied
+            from cryptography import x509 as _x509
+            from cryptography.x509.oid import NameOID as _NameOID
+
+            csr_pem = spec["request"].encode()
+            try:
+                req = _x509.load_pem_x509_csr(csr_pem)
+                cn = next((str(a.value) for a in req.subject
+                           if a.oid == _NameOID.COMMON_NAME), "")
+                orgs = [str(a.value) for a in req.subject
+                        if a.oid == _NameOID.ORGANIZATION_NAME]
+                if cn != f"system:node:{node}" or orgs != ["system:nodes"]:
+                    raise ValueError(
+                        f"subject CN={cn!r} O={orgs!r} does not match the "
+                        f"requested node identity")
+                cert_pem = self.ca.sign_csr(csr_pem, client=True)
+            except Exception as e:
+                out["status"] = {**status, "conditions": [
+                    {"type": "Denied",
+                     "reason": "SubjectValidationFailure",
+                     "message": str(e)[:300]},
+                ]}
+                self.cluster.update("certificatesigningrequests", out)
+                return
+            out["status"] = {
+                "conditions": [{"type": "Approved",
+                                "reason": "AutoApproved",
+                                "message": "node client cert approved"}],
+                "certificate": cert_pem.decode(),
+            }
+            self.cluster.update("certificatesigningrequests", out)
+            self.cluster.events.eventf(
+                "CertificateSigningRequest", "", name, "Normal", "Issued",
+                "node client certificate issued for system:node:%s", node,
+            )
+            return
+        # bearer mode: mint a FRESH node credential, ROTATING any existing
+        # one.  Never reuse-and-return the stored token: that would hand a
         # joined node's LIVE credential to any bootstrap-token holder who
         # asks (in the reference a re-sign issues a new cert and cannot
         # disclose the old key).  Rotation kicks a stale holder off; the

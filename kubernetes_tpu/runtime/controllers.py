@@ -545,7 +545,7 @@ class ControllerManager:
     cluster; stop() tears all of them down."""
 
     def __init__(self, cluster: LocalCluster, grace_period: float = 40.0,
-                 use_informers: bool = False):
+                 use_informers: bool = False, csr_ca=None):
         self.cluster = cluster
         self.informers = None
         if use_informers:
@@ -592,7 +592,8 @@ class ControllerManager:
         from kubernetes_tpu.runtime.certificates import CSRApproverSigner
 
         self.tokencleaner = TokenCleaner(cluster, informers=self.informers)
-        self.csr = CSRApproverSigner(cluster, informers=self.informers)
+        self.csr = CSRApproverSigner(cluster, ca=csr_ca,
+                                     informers=self.informers)
         self.nodeipam = NodeIpamController(cluster,
                                            informers=self.informers)
         self.attachdetach = AttachDetachController(cluster,

@@ -1,0 +1,164 @@
+"""Cluster PKI: a real X.509 certificate authority.
+
+The reference's entire serving path is HTTPS with cert chains — kubeadm
+init mints a self-signed CA and issues serving + client certs
+(cmd/kubeadm/app/phases/certs/pki_helpers.go), the apiserver serves TLS
+(staging/src/k8s.io/apiserver/pkg/server/secure_serving.go:1-238) and
+authenticates client certs by CN (user) and O (groups)
+(staging/src/k8s.io/apiserver/pkg/authentication/request/x509/x509.go
+CommonNameUserConversion).  This module is that PKI distilled onto the
+`cryptography` package: ECDSA P-256 keys, one CA, client/server leaf
+certs, CSR signing for the kubelet TLS-bootstrap flow
+(pkg/controller/certificates).
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+from dataclasses import dataclass
+from typing import Iterable, Optional, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+_ONE_DAY = datetime.timedelta(days=1)
+
+
+def _name(common_name: str, organizations: Iterable[str] = ()) -> x509.Name:
+    attrs = [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
+    attrs += [x509.NameAttribute(NameOID.ORGANIZATION_NAME, o)
+              for o in organizations]
+    return x509.Name(attrs)
+
+
+def _key_pem(key) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+
+
+@dataclass
+class Credential:
+    cert_pem: bytes
+    key_pem: bytes
+
+
+class CertificateAuthority:
+    """One cluster CA (the kubeadm `ca.crt`/`ca.key` pair)."""
+
+    def __init__(self, cert_pem: bytes, key_pem: bytes):
+        self.cert_pem = cert_pem
+        self.key_pem = key_pem
+        self._cert = x509.load_pem_x509_certificate(cert_pem)
+        self._key = serialization.load_pem_private_key(key_pem, None)
+
+    @classmethod
+    def create(cls, common_name: str = "kubernetes-tpu-ca",
+               days: int = 3650) -> "CertificateAuthority":
+        key = ec.generate_private_key(ec.SECP256R1())
+        now = datetime.datetime.now(datetime.timezone.utc)
+        name = _name(common_name)
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - _ONE_DAY)
+            .not_valid_after(now + days * _ONE_DAY)
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                           critical=True)
+            .add_extension(
+                x509.KeyUsage(
+                    digital_signature=True, key_cert_sign=True,
+                    crl_sign=True, content_commitment=False,
+                    key_encipherment=False, data_encipherment=False,
+                    key_agreement=False, encipher_only=False,
+                    decipher_only=False),
+                critical=True)
+            .sign(key, hashes.SHA256())
+        )
+        return cls(cert.public_bytes(serialization.Encoding.PEM),
+                   _key_pem(key))
+
+    # ------------------------------------------------------------ issuing
+
+    def _build(self, subject: x509.Name, public_key, sans, client: bool,
+               days: int):
+        now = datetime.datetime.now(datetime.timezone.utc)
+        eku = (ExtendedKeyUsageOID.CLIENT_AUTH if client
+               else ExtendedKeyUsageOID.SERVER_AUTH)
+        b = (
+            x509.CertificateBuilder()
+            .subject_name(subject)
+            .issuer_name(self._cert.subject)
+            .public_key(public_key)
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - _ONE_DAY)
+            .not_valid_after(now + days * _ONE_DAY)
+            .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                           critical=True)
+            .add_extension(x509.ExtendedKeyUsage([eku]), critical=False)
+        )
+        if sans:
+            alt = []
+            for s in sans:
+                try:
+                    alt.append(x509.IPAddress(ipaddress.ip_address(s)))
+                except ValueError:
+                    alt.append(x509.DNSName(s))
+            b = b.add_extension(x509.SubjectAlternativeName(alt),
+                                critical=False)
+        return b.sign(self._key, hashes.SHA256())
+
+    def issue(self, common_name: str, organizations: Iterable[str] = (),
+              sans: Iterable[str] = (), client: bool = False,
+              days: int = 365) -> Credential:
+        """Fresh key + leaf cert (server by default, client=True for an
+        identity cert: CN = user, O = groups)."""
+        key = ec.generate_private_key(ec.SECP256R1())
+        cert = self._build(_name(common_name, organizations),
+                           key.public_key(), list(sans), client, days)
+        return Credential(cert.public_bytes(serialization.Encoding.PEM),
+                          _key_pem(key))
+
+    def sign_csr(self, csr_pem: bytes, days: int = 365,
+                 client: bool = True) -> bytes:
+        """Sign a PEM CSR, preserving its subject (the csrsigning
+        controller's signer; subject policy is the approver's job)."""
+        csr = x509.load_pem_x509_csr(csr_pem)
+        if not csr.is_signature_valid:
+            raise ValueError("CSR signature invalid")
+        cert = self._build(csr.subject, csr.public_key(), [], client, days)
+        return cert.public_bytes(serialization.Encoding.PEM)
+
+
+def make_csr(common_name: str,
+             organizations: Iterable[str] = ()) -> Tuple[bytes, bytes]:
+    """Client-side keygen + CSR (the kubelet TLS-bootstrap first half) ->
+    (csr_pem, key_pem)."""
+    key = ec.generate_private_key(ec.SECP256R1())
+    csr = (
+        x509.CertificateSigningRequestBuilder()
+        .subject_name(_name(common_name, organizations))
+        .sign(key, hashes.SHA256())
+    )
+    return csr.public_bytes(serialization.Encoding.PEM), _key_pem(key)
+
+
+def identity_from_cert_der(der: bytes) -> Tuple[str, Tuple[str, ...]]:
+    """(CN, O...) from a DER client cert — the x509 authenticator's
+    CommonNameUserConversion."""
+    cert = x509.load_der_x509_certificate(der)
+    cn = ""
+    orgs = []
+    for attr in cert.subject:
+        if attr.oid == NameOID.COMMON_NAME:
+            cn = str(attr.value)
+        elif attr.oid == NameOID.ORGANIZATION_NAME:
+            orgs.append(str(attr.value))
+    return cn, tuple(orgs)
