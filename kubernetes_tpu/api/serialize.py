@@ -351,6 +351,8 @@ def object_to_dict(kind: str, obj) -> dict:
     if kind == "replicasets":
         meta = {"name": obj.name, "namespace": obj.namespace,
                 "uid": obj.uid}
+        if getattr(obj, "annotations", None):
+            meta["annotations"] = dict(obj.annotations)
         if obj.owner_uid:
             # the Deployment->RS controller link must survive the wire or a
             # remote controller-manager orphans every managed ReplicaSet

@@ -133,6 +133,8 @@ def _decode(kind: str, d: dict):
         )
         if meta.get("uid"):
             rs.uid = meta["uid"]
+        if meta.get("annotations"):
+            rs.annotations = dict(meta["annotations"])
         for ref in meta.get("ownerReferences") or []:
             if ref.get("controller"):
                 rs.owner_uid = ref.get("uid", "")
@@ -705,6 +707,28 @@ class APIServer:
                     self._status(404, "NotFound", self.path)
                     return
                 kind, ns, name, _sub = r
+                if kind == "pods" and name and _sub == "log":
+                    # the pods/log subresource.  Containers in this
+                    # framework are pause-anchored sandboxes with no stdout
+                    # stream, so the served log is the pod's LIFECYCLE log
+                    # — the recorder's event trail for the pod, rendered as
+                    # text lines (the kubelet-proxied GetContainerLogs
+                    # distilled to the data that actually exists)
+                    if self._authorize("get", "pods/log", ns, name) is None:
+                        return
+                    if outer.cluster.get("pods", ns, name) is None:
+                        self._status(404, "NotFound", f"pods {ns}/{name}")
+                        return
+                    lines = [
+                        f"{e.last_timestamp:.3f} {e.type} {e.reason}: "
+                        f"{e.message}"
+                        for e in outer.cluster.events.events(
+                            namespace=ns, name=name)
+                        if e.kind == "Pod"
+                    ]
+                    self._send({"kind": "PodLog", "log":
+                                "\n".join(lines) + ("\n" if lines else "")})
+                    return
                 if kind == "watch":
                     # the firehose streams every kind: requires a grant on
                     # resource "*" (the remote scheduler runs as admin)

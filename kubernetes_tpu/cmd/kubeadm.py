@@ -80,6 +80,13 @@ def build_parser() -> argparse.ArgumentParser:
     tok.add_argument("--server", required=True)
     tok.add_argument("--token", default="",
                      help="admin credential (RBAC planes; admin.conf token)")
+
+    up = sub.add_parser("upgrade")
+    up.add_argument("action", choices=("plan", "apply"))
+    up.add_argument("--server", required=True)
+    up.add_argument("--token", default="")
+    up.add_argument("--version", default="",
+                    help="apply: target version (default: this binary's)")
     return p
 
 
@@ -416,6 +423,46 @@ def cmd_token(args) -> int:
     return 2
 
 
+def cmd_upgrade(args) -> int:
+    """kubeadm upgrade plan/apply (cmd/kubeadm/app/cmd/upgrade distilled
+    to this framework's single-binary plane): the cluster's component
+    version lives in the kube-system/cluster-version ConfigMap (the
+    kubeadm-config ClusterStatus analog); `plan` diffs it against this
+    binary's version, `apply` writes the target version and re-stamps
+    cluster-info (the signer re-signs on the configmap event)."""
+    from kubernetes_tpu import __version__
+
+    cm_path = f"/api/v1/namespaces/{TOKEN_NS}/configmaps/cluster-version"
+    out = _req(args.server, "GET", cm_path, token=args.token or None)
+    current = (out.get("data") or {}).get("version", "") \
+        if out.get("kind") != "Status" else ""
+    target = args.version or __version__
+    if args.action == "plan":
+        print(f"current cluster version: {current or '(unset)'}")
+        print(f"this binary's version:   {__version__}")
+        if current == __version__:
+            print("cluster is up to date")
+        else:
+            print(f"upgrade available: run `kubeadm upgrade apply "
+                  f"--version {__version__}`")
+        return 0
+    # apply
+    body = {
+        "metadata": {"namespace": TOKEN_NS, "name": "cluster-version"},
+        "data": {"version": target},
+    }
+    verb, path = (
+        ("PUT", cm_path) if out.get("kind") != "Status"
+        else ("POST", f"/api/v1/namespaces/{TOKEN_NS}/configmaps")
+    )
+    res = _req(args.server, verb, path, body, token=args.token or None)
+    if res.get("kind") == "Status" and res.get("code", 200) >= 400:
+        print(res.get("message", ""), file=sys.stderr)
+        return 1
+    print(f"cluster upgraded: {current or '(unset)'} -> {target}")
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.verb == "init":
@@ -424,6 +471,8 @@ def main(argv=None) -> int:
         return cmd_join(args)
     if args.verb == "token":
         return cmd_token(args)
+    if args.verb == "upgrade":
+        return cmd_upgrade(args)
     return 2
 
 

@@ -253,6 +253,16 @@ def main(argv=None) -> int:
     df = sub.add_parser("diff", parents=[common])
     df.add_argument("-f", "--filename", required=True)
 
+    ro = sub.add_parser("rollout", parents=[common])
+    ro.add_argument("action", choices=("status", "history", "undo"))
+    ro.add_argument("target", help="deployment/<name> (or deploy/<name>)")
+    ro.add_argument("--to-revision", type=int, default=0,
+                    help="undo: roll back to this revision "
+                    "(default: the previous one)")
+
+    lg = sub.add_parser("logs", parents=[common])
+    lg.add_argument("pod")
+
     args = p.parse_args(argv)
     global _TOKEN
     _TOKEN = ""  # never leak a credential across in-process invocations
@@ -417,6 +427,109 @@ def main(argv=None) -> int:
             print(out.get("message", ""), file=sys.stderr)
             return 1
         print(f"{k}/{name} configured")
+        return 0
+
+    if args.verb == "rollout":
+        # pkg/kubectl/cmd/rollout: status (readiness vs desired on the
+        # current-template RS), history (owned RSs by revision
+        # annotation), undo (PUT the chosen revision's template back)
+        kind, _, name = args.target.partition("/")
+        if kind not in ("deployment", "deploy", "deployments") or not name:
+            print("error: rollout targets deployment/<name>",
+                  file=sys.stderr)
+            return 1
+        dep_path = _resolve_path(args.server, "deployments", ns, name)
+        dep = _req(args.server, "GET", dep_path)
+        if dep.get("kind") == "Status":
+            print(dep.get("message", ""), file=sys.stderr)
+            return 1
+        rs_list = _req(args.server, "GET",
+                       _resolve_path(args.server, "replicasets", ns, ""))
+        dep_uid = (dep.get("metadata") or {}).get("uid", "")
+        owned = []
+        for rs in rs_list.get("items") or []:
+            meta = rs.get("metadata") or {}
+            refs = meta.get("ownerReferences") or []
+            if any(r.get("uid") == dep_uid for r in refs):
+                rev = int((meta.get("annotations") or {}).get(
+                    "deployment.kubernetes.io/revision", "0"))
+                owned.append((rev, rs))
+        owned.sort(key=lambda t: t[0])
+        if args.action == "history":
+            print("REVISION  REPLICASET  REPLICAS")
+            for rev, rs in owned:
+                print(f"{rev:<9} {rs['metadata']['name']:<11} "
+                      f"{(rs.get('spec') or {}).get('replicas', 0)}")
+            return 0
+        if args.action == "status":
+            import hashlib as _hashlib
+
+            tmpl = (dep.get("spec") or {}).get("template") or {}
+            desired = int((dep.get("spec") or {}).get("replicas", 0))
+            # the current-template RS is the highest revision
+            cur = owned[-1][1] if owned else None
+            cur_replicas = int(
+                (cur.get("spec") or {}).get("replicas", 0)) if cur else 0
+            # ready pods of the current RS (status is pod-derived here)
+            pods = _req(args.server, "GET",
+                        _resolve_path(args.server, "pods", ns, ""))
+            cur_hash = ((cur.get("spec") or {}).get("selector") or {}
+                        ).get("matchLabels", {}).get("pod-template-hash",
+                                                     "") if cur else ""
+            ready = sum(
+                1 for p in pods.get("items") or []
+                if ((p.get("metadata") or {}).get("labels") or {}).get(
+                    "pod-template-hash") == cur_hash
+                and (p.get("spec") or {}).get("nodeName")
+                and (p.get("status") or {}).get("phase") == "Running"
+            )
+            old_live = sum(
+                int((rs.get("spec") or {}).get("replicas", 0))
+                for _, rs in owned[:-1]
+            )
+            if ready >= desired and cur_replicas == desired and not old_live:
+                print(f'deployment "{name}" successfully rolled out')
+                return 0
+            print(f"Waiting for deployment {name!r} rollout to finish: "
+                  f"{ready} of {desired} updated replicas are available "
+                  f"({old_live} old replicas pending termination)...")
+            return 3  # kubectl rollout status --watch=false not-done code
+        if args.action == "undo":
+            if len(owned) < 2 and not args.to_revision:
+                print("error: no rollout history to undo", file=sys.stderr)
+                return 1
+            if args.to_revision:
+                pick = next((rs for rev, rs in owned
+                             if rev == args.to_revision), None)
+                if pick is None:
+                    print(f"error: revision {args.to_revision} not found",
+                          file=sys.stderr)
+                    return 1
+            else:
+                pick = owned[-2][1]  # the previous revision
+            tmpl = dict((pick.get("spec") or {}).get("template") or {})
+            # strip the RS-owned hash label: the controller re-hashes
+            meta_t = dict(tmpl.get("metadata") or {})
+            labels = {k: v for k, v in (meta_t.get("labels") or {}).items()
+                      if k != "pod-template-hash"}
+            meta_t["labels"] = labels
+            tmpl["metadata"] = meta_t
+            dep.setdefault("spec", {})["template"] = tmpl
+            res = _req(args.server, "PUT", dep_path, dep)
+            if res.get("kind") == "Status" and res.get("code", 200) >= 400:
+                print(res.get("message", ""), file=sys.stderr)
+                return 1
+            print(f"deployment.apps/{name} rolled back")
+            return 0
+
+    if args.verb == "logs":
+        out = _req(args.server, "GET",
+                   _path("pods", ns, args.pod) + "/log")
+        if isinstance(out, dict) and out.get("kind") == "Status":
+            print(out.get("message", ""), file=sys.stderr)
+            return 1
+        text = out.get("log", "") if isinstance(out, dict) else str(out)
+        sys.stdout.write(text)
         return 0
 
     if args.verb == "bind":

@@ -213,10 +213,15 @@ class ReplicaSet:
                                              # the selector
     uid: str = field(default_factory=lambda: uuid.uuid4().hex)
     owner_uid: str = ""   # owning Deployment's uid ("" = standalone)
+    # deployment.kubernetes.io/revision etc. (rollout history reads it)
+    annotations: Dict[str, str] = field(default_factory=dict)
 
     @property
     def key(self) -> Tuple[str, str]:
         return (self.namespace, self.name)
+
+
+REVISION_ANNOTATION = "deployment.kubernetes.io/revision"
 
 
 class ControllerExpectations:
@@ -900,6 +905,13 @@ class DeploymentController(Reconciler):
             (rs for rs in owned if rs.selector.get("pod-template-hash") == h),
             None,
         )
+        # revision bookkeeping (deployment/sync.go getNewReplicaSet): the
+        # current-template RS carries the HIGHEST revision; rolling back
+        # to an old template bumps that old RS to a fresh revision number
+        max_rev = max(
+            (int(rs.annotations.get(REVISION_ANNOTATION, "0"))
+             for rs in owned), default=0,
+        )
         if new_rs is None:
             tmpl = dict(dep.template)
             meta = dict(tmpl.get("metadata") or {})
@@ -911,8 +923,13 @@ class DeploymentController(Reconciler):
                 {**dep.selector, "pod-template-hash": h}, tmpl,
             )
             new_rs.owner_uid = dep.uid
+            new_rs.annotations = {REVISION_ANNOTATION: str(max_rev + 1)}
             self.cluster.create("replicasets", new_rs)
             owned.append(new_rs)
+        elif int(new_rs.annotations.get(REVISION_ANNOTATION, "0")) < max_rev:
+            new_rs.annotations = {
+                **new_rs.annotations, REVISION_ANNOTATION: str(max_rev + 1)}
+            self.cluster.update("replicasets", new_rs)
         old = [rs for rs in owned if rs is not new_rs]
         old_total = sum(rs.replicas for rs in old)
         ready_total = sum(self._ready(rs) for rs in owned)

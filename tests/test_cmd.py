@@ -126,3 +126,131 @@ def test_kubectl_apply_three_way_merge_and_diff(tmp_path, capsys):
         assert rc == 0
     finally:
         srv.stop()
+
+
+def test_kubectl_rollout_status_history_undo(capsys):
+    """pkg/kubectl/cmd/rollout distilled: status tracks the current-
+    template RS, history lists revisions, undo PUTs the previous
+    template back (the controller then re-stamps its revision)."""
+    import dataclasses as _dc
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cmd import kubectl
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+    from kubernetes_tpu.runtime.controllers import (
+        Deployment,
+        DeploymentController,
+        ReplicaSetController,
+    )
+    from kubernetes_tpu.api.types import Pod, PodStatus
+
+    cluster = LocalCluster()
+    dep_ctrl = DeploymentController(cluster)
+    rs_ctrl = ReplicaSetController(cluster)
+
+    def drain():
+        for _ in range(60):
+            a = dep_ctrl.process_one(timeout=0.01)
+            b = rs_ctrl.process_one(timeout=0.01)
+            # mark every scheduled-pending pod Running (hollow kubelet)
+            for p in list(cluster.list("pods")):
+                if p.status.phase != "Running":
+                    cluster.update("pods", _dc.replace(
+                        p,
+                        spec=_dc.replace(p.spec, node_name="n1"),
+                        status=PodStatus(phase="Running")))
+            if not a and not b:
+                break
+
+    tmpl_v1 = {"metadata": {"labels": {"app": "web"}},
+               "spec": {"containers": [{"name": "c", "image": "img:v1"}]}}
+    cluster.create("deployments", Deployment(
+        "default", "web", 2, {"app": "web"}, tmpl_v1))
+    drain()
+    srv = APIServer(cluster=cluster).start()
+    try:
+        rc = kubectl.main(["-s", srv.url, "rollout", "status",
+                           "deployment/web"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "successfully rolled out" in out
+        # rev 1 in history
+        rc = kubectl.main(["-s", srv.url, "rollout", "history",
+                           "deployment/web"])
+        out = capsys.readouterr().out
+        assert rc == 0 and out.startswith("REVISION")
+        assert "1" in out
+        # roll to v2
+        dep = cluster.get("deployments", "default", "web")
+        tmpl_v2 = {"metadata": {"labels": {"app": "web"}},
+                   "spec": {"containers": [{"name": "c",
+                                            "image": "img:v2"}]}}
+        cluster.update("deployments", _dc.replace(dep, template=tmpl_v2))
+        drain()
+        rc = kubectl.main(["-s", srv.url, "rollout", "history",
+                           "deployment/web"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "2" in out
+        # undo -> template back to v1, controller bumps revision to 3
+        rc = kubectl.main(["-s", srv.url, "rollout", "undo",
+                           "deployment/web"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "rolled back" in out
+        dep = cluster.get("deployments", "default", "web")
+        assert dep.template["spec"]["containers"][0]["image"] == "img:v1"
+        drain()
+        from kubernetes_tpu.runtime.controllers import REVISION_ANNOTATION
+
+        revs = {rs.annotations.get(REVISION_ANNOTATION)
+                for rs in cluster.list("replicasets")}
+        assert "3" in revs, revs
+    finally:
+        srv.stop()
+
+
+def test_kubectl_logs_serves_pod_lifecycle(capsys):
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cmd import kubectl
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+    from fixtures import make_pod
+
+    cluster = LocalCluster()
+    cluster.add_pod(make_pod("web"))
+    cluster.events.eventf("Pod", "default", "web", "Normal", "Scheduled",
+                          "assigned to n1")
+    cluster.events.eventf("Pod", "default", "web", "Warning", "Unhealthy",
+                          "liveness probe failed")
+    srv = APIServer(cluster=cluster).start()
+    try:
+        rc = kubectl.main(["-s", srv.url, "logs", "web"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Scheduled: assigned to n1" in out
+        assert "Unhealthy: liveness probe failed" in out
+        rc = kubectl.main(["-s", srv.url, "logs", "ghost"])
+        assert rc == 1
+    finally:
+        srv.stop()
+
+
+def test_kubeadm_upgrade_plan_and_apply(capsys):
+    from kubernetes_tpu import __version__
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cmd import kubeadm
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster).start()
+    try:
+        rc = kubeadm.main(["upgrade", "plan", "--server", srv.url])
+        out = capsys.readouterr().out
+        assert rc == 0 and "(unset)" in out and __version__ in out
+        rc = kubeadm.main(["upgrade", "apply", "--server", srv.url])
+        out = capsys.readouterr().out
+        assert rc == 0 and f"-> {__version__}" in out
+        cm = cluster.get("configmaps", "kube-system", "cluster-version")
+        assert cm["data"]["version"] == __version__
+        rc = kubeadm.main(["upgrade", "plan", "--server", srv.url])
+        out = capsys.readouterr().out
+        assert rc == 0 and "up to date" in out
+    finally:
+        srv.stop()
