@@ -79,6 +79,7 @@ class Kubelet:
         readiness=None,
         register: bool = True,
         subscribe: bool = True,
+        checkpoint_dir: Optional[str] = None,
     ):
         self.cluster = cluster
         self.node = node
@@ -96,6 +97,24 @@ class Kubelet:
         self.cgroups = CgroupManager()
         self.volume_manager = VolumeManager(cluster, node.name)
         self.stats = StatsProvider(cluster, node.name)
+        # device/cpu managers + node-local checkpoints (pkg/kubelet/cm/
+        # devicemanager + cpumanager + checkpointmanager): with a
+        # checkpoint_dir, allocations survive a kubelet restart
+        from kubernetes_tpu.runtime.kubelet_devices import (
+            CheckpointManager,
+            CPUManager,
+            DeviceManager,
+        )
+
+        self.checkpoints = (
+            CheckpointManager(checkpoint_dir) if checkpoint_dir else None
+        )
+        self.devices = DeviceManager(self.checkpoints)
+        cpu_alloc = node.status.allocatable.get("cpu")
+        self.cpu_manager = CPUManager(
+            int(cpu_alloc.value) if cpu_alloc is not None else 0,
+            self.checkpoints,
+        )
         # prober manager seam (pkg/kubelet/prober): callables pod -> bool.
         # liveness False -> container restarted (sandbox recreated,
         # restartCount++); readiness False -> Ready condition cleared
@@ -164,6 +183,17 @@ class Kubelet:
             return
         self._awaiting_volumes.discard(key)
         try:
+            # device + exclusive-cpu admission (cm.Allocate before the
+            # sandbox exists): failure is an admission error on THIS pod
+            self.devices.allocate(pod)
+            self.cpu_manager.add_pod(pod)
+        except Exception as e:
+            self.cluster.events.eventf(
+                "Pod", pod.namespace, pod.name, "Warning",
+                "UnexpectedAdmissionError", "%s", e,
+            )
+            return
+        try:
             self.sandbox_of[key] = self.runtime.run_pod_sandbox(pod)
         except Exception as e:
             # a dead/unreachable runtime (kill -9 across the CRI socket,
@@ -201,7 +231,34 @@ class Kubelet:
         pod = pod if pod is not None else self.cluster.get("pods", *key)
         if pod is not None:
             self.cgroups.remove_pod_cgroup(pod)
+            self.devices.release(pod)
+            self.cpu_manager.remove_pod(pod)
         self.volume_manager.sync()  # unmount the departed pod's volumes
+
+    # ------------------------------------------------------ device plugins
+
+    def register_device_plugin(self, plugin) -> None:
+        """Device-plugin registration (devicemanager Registration): the
+        resource becomes node allocatable/capacity immediately, so the
+        scheduler's resource-fit columns see it like cpu/memory."""
+        self.devices.register(plugin)
+        self._publish_device_allocatable()
+
+    def _publish_device_allocatable(self) -> None:
+        from kubernetes_tpu.api.resource import parse_quantity
+
+        node = self.cluster.get("nodes", "", self.node.name)
+        if node is None:
+            return
+        alloc = dict(node.status.allocatable)
+        cap = dict(node.status.capacity)
+        for res, n in self.devices.allocatable().items():
+            alloc[res] = parse_quantity(str(n))
+            cap[res] = parse_quantity(str(n))
+        self.node = dataclasses.replace(
+            node, status=dataclasses.replace(
+                node.status, allocatable=alloc, capacity=cap))
+        self.cluster.update("nodes", self.node)
 
     # -------------------------------------------------------------- plegCh
 

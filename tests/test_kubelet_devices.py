@@ -1,0 +1,119 @@
+"""Device/CPU managers + checkpointing (runtime/kubelet_devices.py) —
+VERDICT r3 missing #5.
+
+Reference: pkg/kubelet/cm/devicemanager/manager.go,
+cpumanager/policy_static.go, checkpointmanager/checkpoint_manager.go."""
+
+import json
+
+import pytest
+
+from kubernetes_tpu.runtime.cluster import LocalCluster
+from kubernetes_tpu.runtime.kubelet import Kubelet
+from kubernetes_tpu.runtime.kubelet_devices import (
+    CheckpointManager,
+    CorruptCheckpoint,
+    CPUManager,
+    DeviceManager,
+    DevicePlugin,
+)
+
+from fixtures import make_node, make_pod
+
+
+def test_checkpoint_manager_round_trip_and_corruption(tmp_path):
+    cm = CheckpointManager(str(tmp_path))
+    cm.create("state", {"a": 1, "nested": {"b": [1, 2]}})
+    assert cm.get("state") == {"a": 1, "nested": {"b": [1, 2]}}
+    assert cm.list() == ["state"]
+    # flip a byte inside the payload: checksum must catch it
+    p = tmp_path / "state"
+    doc = json.loads(p.read_text())
+    doc["data"] = doc["data"].replace("1", "7", 1)
+    p.write_text(json.dumps(doc))
+    with pytest.raises(CorruptCheckpoint):
+        cm.get("state")
+    cm.remove("state")
+    assert cm.get("state") is None
+
+
+def test_device_manager_allocates_and_restores(tmp_path):
+    cp = CheckpointManager(str(tmp_path))
+    dm = DeviceManager(cp)
+    dm.register(DevicePlugin("example.com/gpu",
+                             ("gpu-0", "gpu-1", "gpu-2"),
+                             unhealthy=("gpu-2",)))
+    assert dm.allocatable() == {"example.com/gpu": 2}
+    pod = make_pod("train", requests={"cpu": "1", "example.com/gpu": "2"})
+    got = dm.allocate(pod)
+    assert sorted(got["example.com/gpu"]) == ["gpu-0", "gpu-1"]
+    # idempotent on retry
+    assert dm.allocate(pod) == got
+    # pool exhausted for a second pod
+    pod2 = make_pod("train2", requests={"cpu": "1", "example.com/gpu": "1"})
+    with pytest.raises(RuntimeError):
+        dm.allocate(pod2)
+    # a fresh manager over the same checkpoint dir restores assignments
+    dm2 = DeviceManager(CheckpointManager(str(tmp_path)))
+    dm2.register(DevicePlugin("example.com/gpu",
+                              ("gpu-0", "gpu-1", "gpu-2"),
+                              unhealthy=("gpu-2",)))
+    with pytest.raises(RuntimeError):
+        dm2.allocate(pod2)  # still exhausted: state survived the restart
+    dm2.release(pod)
+    assert dm2.allocate(pod2)["example.com/gpu"] == ["gpu-0"]
+
+
+def test_cpu_manager_static_policy(tmp_path):
+    cp = CheckpointManager(str(tmp_path))
+    mgr = CPUManager(8, cp, reserved=2)
+    # Guaranteed + integral cpu -> exclusive cores from the shared pool
+    g = make_pod("g", cpu="2", mem="1Gi", limits={"cpu": "2",
+                                                  "memory": "1Gi"})
+    got = mgr.add_pod(g)
+    assert len(got) == 2 and set(got).isdisjoint(mgr.reserved)
+    # fractional request -> shared pool even if Guaranteed
+    frac = make_pod("frac", cpu="1500m", mem="1Gi",
+                    limits={"cpu": "1500m", "memory": "1Gi"})
+    assert mgr.add_pod(frac) == []
+    # Burstable -> shared pool
+    b = make_pod("b", cpu="2")
+    assert mgr.add_pod(b) == []
+    assert len(mgr.shared_pool()) == 8 - 2 - 2
+    # restore across restart
+    mgr2 = CPUManager(8, CheckpointManager(str(tmp_path)), reserved=2)
+    uid = g.metadata.uid or "default/g"
+    assert mgr2.assignments and list(mgr2.assignments.values())[0] == got
+    mgr2.remove_pod(g)
+    assert len(mgr2.shared_pool()) == 6
+
+
+def test_kubelet_publishes_device_allocatable_and_admits():
+    cluster = LocalCluster()
+    node = make_node("n1", cpu="8", mem="16Gi")
+    kubelet = Kubelet(cluster, node)
+    kubelet.register_device_plugin(
+        DevicePlugin("google.com/tpu", ("tpu-0", "tpu-1")))
+    got = cluster.get("nodes", "", "n1")
+    assert int(got.status.allocatable["google.com/tpu"].value) == 2
+    assert int(got.status.capacity["google.com/tpu"].value) == 2
+    # a pod requesting the device syncs fine; over-ask fails admission
+    pod = make_pod("ok", node_name="n1",
+                   requests={"cpu": "100m", "google.com/tpu": "2"})
+    cluster.add_pod(pod)
+    kubelet.sync_pod(cluster.get("pods", "default", "ok"))
+    assert cluster.get("pods", "default", "ok").status.phase == "Running"
+    pod2 = make_pod("starved", node_name="n1",
+                    requests={"cpu": "100m", "google.com/tpu": "1"})
+    cluster.add_pod(pod2)
+    kubelet.sync_pod(cluster.get("pods", "default", "starved"))
+    assert cluster.get("pods", "default",
+                       "starved").status.phase != "Running"
+    evs = cluster.events.events(reason="UnexpectedAdmissionError")
+    assert evs and "google.com/tpu" in evs[0].message
+    # teardown releases the devices; the starved pod then admits
+    cluster.delete("pods", "default", "ok")
+    kubelet._teardown(("default", "ok"))
+    kubelet.sync_pod(cluster.get("pods", "default", "starved"))
+    assert cluster.get("pods", "default",
+                       "starved").status.phase == "Running"
