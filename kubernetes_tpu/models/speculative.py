@@ -98,9 +98,11 @@ from kubernetes_tpu.codec.transfer import pack_tree, unpack_tree
 from kubernetes_tpu.ops.predicates import filter_batch
 from kubernetes_tpu.ops.priorities import (
     MAX_PRIORITY,
+    pod_group_onehot,
     pod_spread_match,
     score_batch,
     spread_counts,
+    spread_score_from_counts,
 )
 from kubernetes_tpu.ops.select import (
     limit_feasible,
@@ -182,19 +184,48 @@ def make_speculative_scheduler(
             )
         else:
             pods_eval = pods
-        mask, _ = filter_batch(cl, pods_eval, cfg, unsched_taint_key)
+        mask, _ = filter_batch(cl, pods_eval, cfg, unsched_taint_key,
+                               need_per=False)
         # spread freshness (VERDICT r2 item 6): counts refresh between
         # repair rounds exactly like resources — base snapshot counts plus
         # the in-batch commits accumulated in the carry, so same-batch
         # service mates repel from round 2 on instead of piling up until
         # the next cycle's snapshot
-        pods_r = dataclasses.replace(
-            pods, spread_counts=spread_counts(cl, pods) + c["spread"]
-        )
+        lean_spread = pods.spread_counts.shape[-1] != N
+        w_use = (w_no_ipa if aff is not None else w_all)
+        if lean_spread:
+            # lean batches (every pod in <= 1 spread group): the whole
+            # SelectorSpread score is a function of the pod's GROUP, so
+            # compute it once per group over [G, N] (G ~ tens) and
+            # broadcast with a one-hot matmul — 10-20x less work than the
+            # per-pod [B, N] evaluation the generic path does.  The carry
+            # tracks in-batch commits at group granularity ("spread"
+            # [G, N]), which for single-group pods is exactly the
+            # pod_spread_match bookkeeping.
+            counts_g = cluster.group_counts.T + c["spread"]   # [G, N]
+            scores_g = spread_score_from_counts(
+                counts_g, cluster, zone_key_id)               # [G, N]
+            onehot_g = pod_group_onehot(
+                pods, cluster.group_counts.shape[1])          # [B, G]
+            has_g = jnp.any(onehot_g > 0, axis=-1)
+            sp = jnp.matmul(onehot_g, scores_g, precision=_X)
+            # a groupless pod has zero counts everywhere -> score 10
+            sp = jnp.where(has_g[:, None], sp, MAX_PRIORITY)
+            w_use = np.array(w_use, np.float32)
+            w_spread = float(w_use[PRIO_INDEX["SelectorSpreadPriority"]])
+            w_use[PRIO_INDEX["SelectorSpreadPriority"]] = 0.0
+            pods_r = pods
+        else:
+            pods_r = dataclasses.replace(
+                pods, spread_counts=spread_counts(cl, pods) + c["spread"]
+            )
         total, _ = score_batch(
-            cl, pods_r, weights=(w_no_ipa if aff is not None else w_all),
+            cl, pods_r, weights=w_use,
             score_cfg=score_cfg, zone_key_id=zone_key_id,
+            skip_zero_weight=True,
         )
+        if lean_spread:
+            total = total + w_spread * sp
         mask = mask & c["active"][:, None] & c["emask"] & pods.valid[:, None]
         if aff is not None:
             # dynamic IPA score (interpod_affinity.go fScore) over
@@ -307,13 +338,20 @@ def make_speculative_scheduler(
                 conf_ba & (tril > 0) & accept[None, :], axis=1
             )
             real_bounce = real_bounce & ~aviol_acc
-        # in-batch spread bookkeeping: the SAME AND-subset match the
-        # sequential scan uses (ops/priorities.py pod_spread_match)
-        spread_match = pod_spread_match(
-            pods, cluster.group_counts.shape[1])             # [B, B] [i, j]
         acc_node = accf * (
             hosts[:, None] == jnp.arange(N, dtype=jnp.int32)[None, :]
         ).astype(jnp.float32)                                # [B, N]
+        if lean_spread:
+            # group-granular commit counts ([G, N] carry)
+            spread_next = c["spread"] + jnp.matmul(
+                onehot_g.T, acc_node, precision=_X)
+        else:
+            # the SAME AND-subset match the sequential scan uses
+            # (ops/priorities.py pod_spread_match)
+            spread_match = pod_spread_match(
+                pods, cluster.group_counts.shape[1])         # [B, B] [i, j]
+            spread_next = c["spread"] + jnp.matmul(
+                spread_match, acc_node, precision=_X)
         # committed state lands via scatter-add on the node axis (a
         # segment-sum; XLA lowers it to a cheap scatter on every
         # backend, where the old one_hot.T matmuls cost B*N*R flops)
@@ -321,8 +359,7 @@ def make_speculative_scheduler(
             "hosts": jnp.where(accept, hosts, c["hosts"]),
             "req": c["req"].at[hosts].add(reqf * accf),
             "nz": c["nz"].at[hosts].add(nzf * accf),
-            "spread": c["spread"] + jnp.matmul(
-                spread_match, acc_node, precision=_X),
+            "spread": spread_next,
             "claimed": c["claimed"].at[hosts].max(
                 pports & accept[:, None]
             ),
@@ -408,11 +445,14 @@ def make_speculative_scheduler(
     def _init_carry(cluster, pods, pod_ports, last_index0, emask0, has_aff):
         B = pods.valid.shape[0]
         N = cluster.allocatable.shape[0]
+        # lean batches carry in-batch spread commits per GROUP (see _round)
+        lean_spread = pods.spread_counts.shape[-1] != N
+        S = cluster.group_counts.shape[1] if lean_spread else B
         c = {
             "hosts": jnp.full((B,), -1, jnp.int32),
             "req": cluster.requested.astype(jnp.float32),
             "nz": cluster.nonzero_req.astype(jnp.float32),
-            "spread": jnp.zeros((B, N), jnp.float32),
+            "spread": jnp.zeros((S, N), jnp.float32),
             "claimed": jnp.zeros((N, pod_ports.shape[1]), jnp.bool_),
             "emask": emask0,
             "active": pods.valid,

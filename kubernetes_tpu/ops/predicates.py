@@ -407,12 +407,13 @@ def match_inter_pod_affinity(cluster: ClusterTensors, pods: PodBatch):
 
 
 def filter_batch(cluster: ClusterTensors, pods: PodBatch, cfg: FilterConfig,
-                 unsched_taint_key: int = 0):
+                 unsched_taint_key: int = 0, need_per: bool = True):
     """Run every predicate; returns (mask bool[B, N], per_pred bool[B, K, N]).
 
     per_pred rows follow PREDICATE_ORDER; predicates without device state yet
     (volume binding, zone conflict, service affinity) pass unconditionally and
-    are tracked in PARITY.md.
+    are tracked in PARITY.md.  With need_per=False, per_pred is None and the
+    stack is never materialized (the engines' hot path).
     """
     B, N = pods.n_pods, cluster.n_nodes
     ones = jnp.ones((B, N), bool)
@@ -460,10 +461,19 @@ def filter_batch(cluster: ClusterTensors, pods: PodBatch, cfg: FilterConfig,
             rows.append(ones)
         else:
             rows.append(per[name])
-    stack = jnp.stack(rows, axis=1)
     alive = cluster.valid[None] & pods.valid[:, None]
-    mask = jnp.all(stack, axis=1) & alive
-    return mask, stack
+    if need_per:
+        stack = jnp.stack(rows, axis=1)
+        mask = jnp.all(stack, axis=1) & alive
+        return mask, stack
+    # hot path: fold the AND pairwise instead of materializing the
+    # [B, K, N] stack (~70MB at bench scale) just to reduce over it —
+    # callers that only consume the verdict (the engines' per-round
+    # filter) skip that memory traffic entirely
+    mask = alive
+    for r in rows:
+        mask = mask & r
+    return mask, None
 
 
 def first_failure(per_pred):

@@ -11,6 +11,7 @@ parity suite can compare exactly.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from kubernetes_tpu.codec.schema import (
     ClusterTensors,
@@ -367,7 +368,8 @@ def resource_limits(cluster: ClusterTensors, pods: PodBatch):
 
 
 def score_batch(cluster: ClusterTensors, pods: PodBatch, weights=None,
-                score_cfg=None, zone_key_id: int = 5):
+                score_cfg=None, zone_key_id: int = 5,
+                skip_zero_weight: bool = False):
     """All priorities + weighted sum -> (total f32[B, N], per f32[B, P, N]).
 
     weights follows PRIORITY_ORDER; defaults to the stock weights
@@ -376,30 +378,49 @@ def score_batch(cluster: ClusterTensors, pods: PodBatch, weights=None,
         from kubernetes_tpu.codec.schema import ScoreConfig
 
         score_cfg = ScoreConfig()
-    per = {
-        "SelectorSpreadPriority": selector_spread(cluster, pods, zone_key_id),
-        "InterPodAffinityPriority": inter_pod_affinity_score(cluster, pods),
-        "LeastRequestedPriority": least_requested(cluster, pods),
-        "BalancedResourceAllocation": balanced_allocation(cluster, pods),
-        "NodePreferAvoidPodsPriority": node_prefer_avoid_pods(cluster, pods),
-        "NodeAffinityPriority": node_affinity(cluster, pods),
-        "TaintTolerationPriority": taint_toleration(cluster, pods),
-        "ImageLocalityPriority": image_locality(cluster, pods),
-        "MostRequestedPriority": most_requested(cluster, pods),
-        "NodeLabelPriority": node_label_priority(cluster, pods, score_cfg),
-        "RequestedToCapacityRatioPriority": requested_to_capacity_ratio(
-            cluster, pods, score_cfg
-        ),
-        "ResourceLimitsPriority": resource_limits(cluster, pods),
-    }
-    stack = jnp.stack(
-        [per[name] for name, _ in sorted(PRIO_INDEX.items(), key=lambda kv: kv[1])],
-        axis=1,
-    )                                                        # [B, P, N]
     if weights is None:
         from kubernetes_tpu.codec.schema import DEFAULT_PRIORITY_WEIGHTS
 
         weights = DEFAULT_PRIORITY_WEIGHTS
-    w = jnp.asarray(weights, jnp.float32)
+    w_host = np.asarray(weights, np.float32)
+    makers = {
+        "SelectorSpreadPriority":
+            lambda: selector_spread(cluster, pods, zone_key_id),
+        "InterPodAffinityPriority":
+            lambda: inter_pod_affinity_score(cluster, pods),
+        "LeastRequestedPriority": lambda: least_requested(cluster, pods),
+        "BalancedResourceAllocation":
+            lambda: balanced_allocation(cluster, pods),
+        "NodePreferAvoidPodsPriority":
+            lambda: node_prefer_avoid_pods(cluster, pods),
+        "NodeAffinityPriority": lambda: node_affinity(cluster, pods),
+        "TaintTolerationPriority": lambda: taint_toleration(cluster, pods),
+        "ImageLocalityPriority": lambda: image_locality(cluster, pods),
+        "MostRequestedPriority": lambda: most_requested(cluster, pods),
+        "NodeLabelPriority":
+            lambda: node_label_priority(cluster, pods, score_cfg),
+        "RequestedToCapacityRatioPriority":
+            lambda: requested_to_capacity_ratio(cluster, pods, score_cfg),
+        "ResourceLimitsPriority": lambda: resource_limits(cluster, pods),
+    }
+    # with skip_zero_weight (the engines' hot path), zero-weight
+    # priorities contribute nothing to the total — skip their kernels
+    # entirely (weights are trace-time constants; the stock set zeroes
+    # the 4 policy-only functions, and RTC alone is ~20% of a
+    # CPU-fallback round).  Their stack rows become zeros, so callers
+    # needing the full per-priority breakdown (parity/golden tests, the
+    # one-launch generic path) keep the default full computation.
+    zero = None
+    per = []
+    for name, _ in sorted(PRIO_INDEX.items(), key=lambda kv: kv[1]):
+        if not skip_zero_weight or w_host[PRIO_INDEX[name]] != 0.0:
+            per.append(makers[name]())
+        else:
+            if zero is None:
+                zero = jnp.zeros((pods.n_pods, cluster.n_nodes),
+                                 jnp.float32)
+            per.append(zero)
+    stack = jnp.stack(per, axis=1)                           # [B, P, N]
+    w = jnp.asarray(w_host, jnp.float32)
     total = jnp.einsum("bpn,p->bn", stack, w)
     return total, stack
