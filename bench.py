@@ -486,11 +486,13 @@ def main():
 
     attempt = int(os.environ.get(_ATTEMPT_ENV, "0"))
     on_cpu = args.platform == "cpu" or os.environ.get("JAX_PLATFORMS") == "cpu"
-    if on_cpu and args.batch > 512:
-        # the TPU sweet spot (2048: one big launch amortizes the tunnel)
-        # inverts on CPU, where per-round grid cost scales with B and the
-        # encode/commit overlap does the amortizing
-        args.batch = 512
+    cpu_cap = int(os.environ.get("KTPU_BENCH_CPU_BATCH_CAP", "2048"))
+    if on_cpu and args.batch > cpu_cap:
+        # r04 re-tune: after the group-level spread + zero-weight-skip
+        # kernel cuts, CPU throughput rises monotonically to batch 2048
+        # (512: ~960, 1024: ~1100, 2048: ~1170 pods/s) and falls at 4096
+        # (extra repair rounds); 2048 matches the TPU sweet spot too
+        args.batch = cpu_cap
     lock = None
     if not on_cpu:  # cpu runs don't touch the tunnel; no serialization needed
         lock = _acquire_device_lock(args.lock_timeout)
