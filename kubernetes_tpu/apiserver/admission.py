@@ -473,6 +473,24 @@ class NodeRestriction:
             if name != me:
                 raise AdmissionDenied(
                     f"node {me!r} is not allowed to modify node {name!r}")
+            # label self-escalation guard (admission.go getModifiedLabels
+            # / NodeRestriction label plumbing, 1.16+): a kubelet may not
+            # set or change labels in the node-restriction.kubernetes.io/
+            # namespace on its own Node — those are the operator-asserted
+            # isolation labels workloads select on
+            RESTRICTED = "node-restriction.kubernetes.io/"
+            want = ((meta.get("labels") or {})
+                    if "metadata" in obj else (obj.get("labels") or {}))
+            cur = self.cluster.get("nodes", "", me)
+            have = dict(cur.metadata.labels) if cur is not None else {}
+            for k, v in want.items():
+                if RESTRICTED in k and have.get(k) != v:
+                    raise AdmissionDenied(
+                        f"node {me!r} may not set restricted label {k!r}")
+            for k in have:
+                if RESTRICTED in k and k not in want and want:
+                    raise AdmissionDenied(
+                        f"node {me!r} may not remove restricted label {k!r}")
             return obj
         if kind == "leases":
             # confined to kube-node-lease (admission.go admitLease): a

@@ -192,6 +192,32 @@ class CRIService:
     def container_status(self, container_id: str) -> dict:
         return dict(self._container(container_id))
 
+    def exec_sync(self, container_id: str, cmd: List[str],
+                  timeout: float = 10.0) -> dict:
+        """ExecSync (api.proto): run cmd in the container's context and
+        return stdout/stderr/exit_code.  This framework's containers are
+        host processes anchored by the sandbox pause, so exec runs the
+        command as a host subprocess — the same execution domain."""
+        import subprocess
+
+        c = self._container(container_id)
+        if c["state"] != CONTAINER_RUNNING:
+            raise CRIError(
+                f"container {container_id!r} is {c['state']}, not RUNNING")
+        try:
+            out = subprocess.run(
+                list(cmd), capture_output=True, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return {"stdout": "", "stderr": "exec timed out",
+                    "exit_code": 124}
+        except OSError as e:
+            return {"stdout": "", "stderr": str(e), "exit_code": 126}
+        return {
+            "stdout": out.stdout.decode(errors="replace"),
+            "stderr": out.stderr.decode(errors="replace"),
+            "exit_code": out.returncode,
+        }
+
 
 # -------------------------------------------------------------- server
 
@@ -364,6 +390,11 @@ class RemoteRuntime:
 
     def container_status(self, container_id: str) -> dict:
         return self._call("container_status", container_id=container_id)
+
+    def exec_sync(self, container_id: str, cmd: List[str],
+                  timeout: float = 10.0) -> dict:
+        return self._call("exec_sync", container_id=container_id,
+                          cmd=list(cmd), timeout=timeout)
 
     def version(self) -> dict:
         return self._call("version")

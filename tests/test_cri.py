@@ -172,3 +172,26 @@ def test_process_runtime_behind_cri_daemon(tmp_path):
         if daemon.poll() is None:
             daemon.kill()
             daemon.wait(timeout=5)
+
+
+def test_exec_sync_runs_in_container_context(tmp_path):
+    """ExecSync (api.proto): command output + exit codes round-trip the
+    socket; non-running containers refuse."""
+    srv = CRIServer(CRIService(FakeRuntime()), _sock(tmp_path)).start()
+    rt = RemoteRuntime(_sock(tmp_path))
+    try:
+        sid = rt.run_pod_sandbox(make_pod("web"))
+        cid = rt.create_container(sid, "app")
+        with pytest.raises(CRIError):
+            rt.exec_sync(cid, ["true"])  # CREATED, not RUNNING
+        rt.start_container(cid)
+        out = rt.exec_sync(cid, ["echo", "hello from exec"])
+        assert out["exit_code"] == 0
+        assert "hello from exec" in out["stdout"]
+        out = rt.exec_sync(cid, ["sh", "-c", "echo oops >&2; exit 3"])
+        assert out["exit_code"] == 3 and "oops" in out["stderr"]
+        out = rt.exec_sync(cid, ["/no/such/binary"])
+        assert out["exit_code"] == 126
+    finally:
+        rt.close()
+        srv.stop()
