@@ -122,6 +122,7 @@ class Kubelet:
         self.liveness = liveness
         self.readiness = readiness
         self.sandbox_of: Dict[tuple, str] = {}   # pod key -> sandbox id
+        self.containers_of: Dict[tuple, list] = {}  # pod key -> container ids
         # pods waiting on WaitForAttachAndMount (retried on node events)
         self._awaiting_volumes: set = set()
         self.evictions: List[tuple] = []
@@ -177,6 +178,11 @@ class Kubelet:
         sandbox) until a node/claim event re-syncs it — the reference
         blocks syncPod on the volume manager the same way."""
         key = (pod.namespace, pod.name)
+        if key in self.sandbox_of:
+            # already sandboxed (a watch-triggered sync raced an explicit
+            # one): syncPod's sandbox-actions step finds nothing to do —
+            # re-creating here would LEAK the live sandbox
+            return
         self.cgroups.create_pod_cgroup(pod)
         if not self.volume_manager.all_mounted(pod):
             self._awaiting_volumes.add(key)
@@ -194,7 +200,21 @@ class Kubelet:
             )
             return
         try:
-            self.sandbox_of[key] = self.runtime.run_pod_sandbox(pod)
+            sid = self.runtime.run_pod_sandbox(pod)
+            self.sandbox_of[key] = sid
+            # kuberuntime SyncPod step 6-7: create + start one container
+            # per spec container inside the new sandbox (runtimes without
+            # the container verb set — the hollow FakeRuntime — skip)
+            if hasattr(self.runtime, "create_container"):
+                cids = []
+                specs = pod.spec.containers or None
+                for c in (specs if specs else [None]):
+                    cid = self.runtime.create_container(
+                        sid, c.name if c is not None else "main",
+                        image=c.image if c is not None else "")
+                    self.runtime.start_container(cid)
+                    cids.append(cid)
+                self.containers_of[key] = cids
         except Exception as e:
             # a dead/unreachable runtime (kill -9 across the CRI socket,
             # runtime/cri.py RuntimeUnavailable) is a POD sync failure,
@@ -218,6 +238,8 @@ class Kubelet:
             )
 
     def _teardown(self, key: tuple, pod=None) -> None:
+        self.containers_of.pop(key, None)  # die with their sandbox (CRI
+        # StopPodSandbox exits containers; RemovePodSandbox reaps records)
         sid = self.sandbox_of.pop(key, None)
         if sid is not None:
             try:

@@ -195,3 +195,28 @@ def test_exec_sync_runs_in_container_context(tmp_path):
     finally:
         rt.close()
         srv.stop()
+
+
+def test_kubelet_starts_spec_containers_over_cri(tmp_path):
+    """kuberuntime SyncPod step 6-7: the kubelet creates + starts one CRI
+    container per spec container inside the sandbox; teardown exits them
+    with the sandbox."""
+    srv = CRIServer(CRIService(FakeRuntime()), _sock(tmp_path)).start()
+    rt = RemoteRuntime(_sock(tmp_path))
+    cluster = LocalCluster()
+    kubelet = Kubelet(cluster, make_node("n1", cpu="4", mem="8Gi"),
+                      runtime=rt)
+    try:
+        pod = make_pod("web", node_name="n1", requests={"cpu": "100m"},
+                       extra_containers=[{"cpu": "100m"}])
+        cluster.add_pod(pod)
+        kubelet.sync_pod(cluster.get("pods", "default", "web"))
+        sid = kubelet.sandbox_of[("default", "web")]
+        containers = rt.list_containers(sandbox_id=sid)
+        assert len(containers) == 2
+        assert all(c["state"] == CONTAINER_RUNNING for c in containers)
+        kubelet._teardown(("default", "web"))
+        assert rt.list_containers() == []
+    finally:
+        rt.close()
+        srv.stop()
