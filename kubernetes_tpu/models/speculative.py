@@ -222,7 +222,7 @@ def make_speculative_scheduler(
         total, _ = score_batch(
             cl, pods_r, weights=w_use,
             score_cfg=score_cfg, zone_key_id=zone_key_id,
-            skip_zero_weight=True,
+            skip_zero_weight=True, need_per=False,
         )
         if lean_spread:
             total = total + w_spread * sp
