@@ -451,9 +451,14 @@ class APIServer:
             self._audit_f.flush()
 
     def _validate_extension(self, kind: str, body: dict) -> None:
-        """CRD-specific write checks: establishment sanity for CRDs, and
+        """Write-path schema checks: typed-field validation for the core
+        dict-backed kinds (api/corev1.py — the per-kind strategy Validate
+        analog, surfaced as 422), establishment sanity for CRDs, and
         openAPIV3Schema validation for custom-resource instances
         (apiextensions-apiserver validation.go)."""
+        from kubernetes_tpu.api import corev1
+
+        corev1.validate(kind, body)
         from kubernetes_tpu.apiserver.extensions import (
             crd_schema,
             find_crd_for_kind,

@@ -1,0 +1,129 @@
+"""Typed API surface for the dict-backed kinds (api/corev1.py): typed
+views round-trip the wire form, and the apiserver rejects mistyped
+fields with 422 (VERDICT r3 layer-1 partial -> typed + validated).
+
+Reference: staging/src/k8s.io/api/core/v1 types.go + per-kind strategy
+Validate (pkg/apis/core/validation)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import corev1
+from kubernetes_tpu.api.corev1 import (
+    CertificateSigningRequest,
+    Endpoints,
+    Lease,
+    Role,
+    RoleBinding,
+    Secret,
+    Service,
+    ValidationError,
+)
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.runtime.cluster import LocalCluster
+
+
+def test_service_round_trip_and_typed_view():
+    wire = {
+        "kind": "Service", "apiVersion": "v1",
+        "metadata": {"name": "web", "namespace": "prod"},
+        "spec": {
+            "selector": {"app": "web"},
+            "ports": [{"name": "http", "port": 80, "targetPort": 8080,
+                       "protocol": "TCP"}],
+            "clusterIP": "10.0.0.7",
+            "type": "NodePort",
+        },
+    }
+    svc = Service.from_dict(wire)
+    assert svc.name == "web" and svc.namespace == "prod"
+    assert svc.selector == {"app": "web"}
+    assert svc.ports[0].port == 80 and svc.ports[0].target_port == 8080
+    assert svc.type == "NodePort"
+    back = svc.to_dict()
+    assert Service.from_dict(back) == svc
+    # flat storage form (namespace/name at top level) parses too
+    flat = Service.from_dict({"namespace": "prod", "name": "web",
+                              "selector": {"app": "web"}})
+    assert flat.selector == {"app": "web"}
+
+
+def test_typed_views_for_remaining_kinds():
+    ep = Endpoints.from_dict({
+        "metadata": {"name": "web", "namespace": "prod"},
+        "subsets": [{
+            "addresses": [{"ip": "10.1.0.5", "nodeName": "n1",
+                           "targetRef": {"kind": "Pod", "name": "web-1"}}],
+            "ports": [{"port": 8080, "protocol": "TCP"}],
+        }],
+    })
+    assert ep.addresses[0].target_pod == "web-1"
+    assert ep.ports[0].port == 8080
+    sec = Secret.from_dict({
+        "metadata": {"name": "tok", "namespace": "kube-system"},
+        "type": "bootstrap.kubernetes.io/token",
+        "data": {"token-id": "abc"}, "stringData": {"extra": "x"},
+    })
+    assert sec.type.endswith("token") and sec.data["extra"] == "x"
+    role = Role.from_dict({
+        "metadata": {"name": "pod-reader"},
+        "rules": [{"verbs": ["get"], "resources": ["pods"],
+                   "resourceNames": ["p1"]}],
+    })
+    assert role.rules[0].resource_names == ("p1",)
+    rb = RoleBinding.from_dict({
+        "metadata": {"name": "rb", "namespace": "team"},
+        "roleRef": {"kind": "Role", "name": "pod-reader"},
+        "subjects": [{"kind": "User", "name": "alice"}],
+    })
+    assert rb.role_name == "pod-reader"
+    assert rb.subjects[0].name == "alice"
+    lease = Lease.from_dict({
+        "metadata": {"name": "n1", "namespace": "kube-node-lease"},
+        "spec": {"holderIdentity": "n1", "renewTime": 123.0,
+                 "leaseDurationSeconds": 40},
+    })
+    assert lease.holder == "n1" and lease.lease_duration_seconds == 40
+    csr = CertificateSigningRequest.from_dict({
+        "metadata": {"name": "node-csr"},
+        "spec": {"username": "system:node:w1",
+                 "signerName": "kubernetes.io/kube-apiserver-client-kubelet"},
+        "status": {"conditions": [{"type": "Approved"}],
+                   "certificate": "PEM"},
+    })
+    assert csr.conditions == ("Approved",) and csr.certificate == "PEM"
+    assert corev1.typed("services", {"name": "x"}).name == "x"
+    assert corev1.typed("pods", {"name": "x"}) == {"name": "x"}  # untyped
+
+
+def test_validate_rejects_mistyped_fields():
+    corev1.validate("services", {"spec": {"selector": {"a": "b"}}})
+    with pytest.raises(ValidationError):
+        corev1.validate("services", {"spec": {"selector": ["not", "map"]}})
+    with pytest.raises(ValidationError):
+        corev1.validate("clusterroles", {"rules": {"verbs": ["*"]}})
+    with pytest.raises(ValidationError):
+        corev1.validate("leases", {"spec": {"leaseDurationSeconds": "40"}})
+    corev1.validate("unknown-kind", {"whatever": 1})  # permissive
+
+
+def test_apiserver_rejects_mistyped_writes_with_422():
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster).start()
+    try:
+        body = json.dumps({
+            "metadata": {"name": "bad", "namespace": "default"},
+            "spec": {"selector": "app=web"},   # string, must be a map
+        }).encode()
+        req = urllib.request.Request(
+            f"{srv.url}/api/v1/namespaces/default/services", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 422
+        assert cluster.get("services", "default", "bad") is None
+    finally:
+        srv.stop()
