@@ -51,8 +51,12 @@ _TYPES = {
 
 def validate_schema(obj, schema: dict, path: str = "") -> None:
     """Validate obj against the supported openAPIV3Schema subset: type,
-    properties, required, items, enum, minimum/maximum.  Raises SchemaError
-    naming the offending path (apiextensions validation.go behavior)."""
+    properties, required, items, enum, minimum/maximum, pattern,
+    min/maxLength, min/maxItems, additionalProperties (bool or schema),
+    nullable.  Raises SchemaError naming the offending path
+    (apiextensions validation.go behavior)."""
+    if obj is None and schema.get("nullable"):
+        return
     t = schema.get("type")
     if t:
         if t == "integer":
@@ -74,6 +78,20 @@ def validate_schema(obj, schema: dict, path: str = "") -> None:
             raise SchemaError(f"{path}: {obj} < minimum {schema['minimum']}")
         if "maximum" in schema and obj > schema["maximum"]:
             raise SchemaError(f"{path}: {obj} > maximum {schema['maximum']}")
+    if isinstance(obj, str):
+        if "pattern" in schema:
+            import re as _re
+
+            if _re.search(schema["pattern"], obj) is None:
+                raise SchemaError(
+                    f"{path or '<root>'}: {obj!r} does not match pattern "
+                    f"{schema['pattern']!r}")
+        if "minLength" in schema and len(obj) < schema["minLength"]:
+            raise SchemaError(f"{path}: shorter than minLength "
+                              f"{schema['minLength']}")
+        if "maxLength" in schema and len(obj) > schema["maxLength"]:
+            raise SchemaError(f"{path}: longer than maxLength "
+                              f"{schema['maxLength']}")
     if isinstance(obj, dict):
         for req in schema.get("required") or []:
             if req not in obj:
@@ -83,9 +101,26 @@ def validate_schema(obj, schema: dict, path: str = "") -> None:
         for k, sub in props.items():
             if k in obj:
                 validate_schema(obj[k], sub, f"{path}.{k}" if path else k)
-    if isinstance(obj, list) and "items" in schema:
-        for i, item in enumerate(obj):
-            validate_schema(item, schema["items"], f"{path}[{i}]")
+        addl = schema.get("additionalProperties")
+        if addl is not None:
+            extra = [k for k in obj if k not in props]
+            if addl is False and extra:
+                raise SchemaError(
+                    f"{path or '<root>'}: unknown properties {extra}")
+            if isinstance(addl, dict):
+                for k in extra:
+                    validate_schema(obj[k], addl,
+                                    f"{path}.{k}" if path else k)
+    if isinstance(obj, list):
+        if "minItems" in schema and len(obj) < schema["minItems"]:
+            raise SchemaError(f"{path}: fewer than minItems "
+                              f"{schema['minItems']}")
+        if "maxItems" in schema and len(obj) > schema["maxItems"]:
+            raise SchemaError(f"{path}: more than maxItems "
+                              f"{schema['maxItems']}")
+        if "items" in schema:
+            for i, item in enumerate(obj):
+                validate_schema(item, schema["items"], f"{path}[{i}]")
 
 
 def flatten_wire_dict(d: dict, default_ns: Optional[str] = None) -> dict:

@@ -209,3 +209,43 @@ def test_apiservice_aggregation_proxies_group():
     finally:
         srv.stop()
         backend.shutdown()
+
+
+def test_schema_subset_pattern_additional_props_lengths():
+    """r04 schema-subset widening: pattern, min/maxLength, min/maxItems,
+    additionalProperties (bool + schema), nullable."""
+    import pytest
+
+    from kubernetes_tpu.apiserver.extensions import SchemaError, validate_schema
+
+    schema = {
+        "type": "object",
+        "properties": {
+            "host": {"type": "string",
+                     "pattern": r"^[a-z]+\.[a-z]+$",
+                     "maxLength": 20},
+            "replicas": {"type": "integer", "minimum": 0},
+            "tags": {"type": "array", "minItems": 1, "maxItems": 3,
+                     "items": {"type": "string"}},
+            "note": {"type": "string", "nullable": True},
+        },
+        "additionalProperties": False,
+    }
+    validate_schema({"host": "web.prod", "replicas": 2,
+                     "tags": ["a"], "note": None}, schema)
+    with pytest.raises(SchemaError):
+        validate_schema({"host": "NOPE"}, schema)          # pattern
+    with pytest.raises(SchemaError):
+        validate_schema({"host": "a" * 30 + ".x"}, schema)  # maxLength
+    with pytest.raises(SchemaError):
+        validate_schema({"tags": []}, schema)              # minItems
+    with pytest.raises(SchemaError):
+        validate_schema({"tags": list("abcd")}, schema)    # maxItems
+    with pytest.raises(SchemaError):
+        validate_schema({"surprise": 1}, schema)           # additionalProps
+    # additionalProperties as a schema validates the extras
+    map_schema = {"type": "object",
+                  "additionalProperties": {"type": "string"}}
+    validate_schema({"a": "x", "b": "y"}, map_schema)
+    with pytest.raises(SchemaError):
+        validate_schema({"a": 1}, map_schema)
