@@ -347,15 +347,27 @@ def cmd_join(args) -> int:
     # ones.  Falls back to the bootstrap token against planes without the
     # certificates controller (--csr-timeout 0 skips the flow).
     node_token = args.token
+    secure = args.server.startswith("https://")
     if args.csr_timeout > 0:
         csr_name = f"node-csr-{node_name}-{secrets.token_hex(3)}"
+        spec = {
+            "signerName": "kubernetes.io/kube-apiserver-client-kubelet",
+            "username": f"system:node:{node_name}",
+        }
+        key_pem = None
+        if secure:
+            # real TLS bootstrap: client-side keygen + PEM CSR; the
+            # signer returns an x509 client cert the apiserver's x509
+            # authn accepts directly (no bearer token at all)
+            from kubernetes_tpu.utils.pki import make_csr
+
+            csr_pem, key_pem = make_csr(
+                f"system:node:{node_name}", ["system:nodes"])
+            spec["request"] = csr_pem.decode()
         out = _req(args.server, "POST",
                    "/api/v1/certificatesigningrequests", {
                        "metadata": {"name": csr_name},
-                       "spec": {
-                           "signerName":
-                           "kubernetes.io/kube-apiserver-client-kubelet",
-                           "username": f"system:node:{node_name}"},
+                       "spec": spec,
                    }, token=args.token)
         if not (out.get("kind") == "Status"
                 and out.get("code", 201) >= 400):
@@ -367,9 +379,28 @@ def cmd_join(args) -> int:
                     token=args.token)
                 cert = (csr.get("status") or {}).get("certificate", "")
                 if cert:
-                    node_token = cert
-                    klog.infof("[join] node credential issued "
-                               "(system:node:%s)", node_name)
+                    if secure and cert.startswith("-----BEGIN CERTIFICATE"):
+                        # park the identity keypair where the shared
+                        # transport (cmd/base.py tls_client_context)
+                        # presents it; drop the bearer token entirely
+                        import tempfile
+
+                        d = tempfile.mkdtemp(prefix=f"kubelet-{node_name}-")
+                        cert_path = os.path.join(d, "kubelet-client.crt")
+                        key_path = os.path.join(d, "kubelet-client.key")
+                        with open(cert_path, "w") as f:
+                            f.write(cert)
+                        with open(key_path, "wb") as f:
+                            f.write(key_pem)
+                        os.environ["KTPU_CLIENT_CERT"] = cert_path
+                        os.environ["KTPU_CLIENT_KEY"] = key_path
+                        node_token = ""
+                        klog.infof("[join] node client certificate "
+                                   "issued (system:node:%s)", node_name)
+                    else:
+                        node_token = cert
+                        klog.infof("[join] node credential issued "
+                                   "(system:node:%s)", node_name)
                     break
                 time.sleep(0.2)
             else:

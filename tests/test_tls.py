@@ -238,3 +238,59 @@ def test_tls_bootstrap_issues_real_node_cert(tmp_path):
         assert "certificate" not in bad["status"]
     finally:
         srv.stop()
+
+
+def test_kubeadm_join_tls_bootstrap_uses_client_cert(tmp_path, monkeypatch):
+    """kubeadm join against an HTTPS plane does the REAL TLS bootstrap:
+    keygen + PEM CSR -> signed client cert -> heartbeats authenticate as
+    system:node:<name> via x509 (no bearer token)."""
+    from kubernetes_tpu.apiserver.admission import default_admission_chain
+    from kubernetes_tpu.cmd import kubeadm
+
+    ca = CertificateAuthority.create()
+    cluster = LocalCluster()
+    authn = TokenAuthenticator(cluster)
+    ensure_bootstrap_policy(cluster)
+    cluster.create("secrets", {
+        "namespace": "kube-system", "name": "bootstrap-token-join01",
+        "type": "bootstrap.kubernetes.io/token",
+        "data": {"token-id": "join01", "token-secret": "j" * 16,
+                 "usage-bootstrap-authentication": "true"},
+    })
+    srv, ca_file = _tls_server(
+        tmp_path, cluster, ca,
+        authenticator=authn, authorizer=RBACAuthorizer(cluster),
+    )
+    srv.admission = default_admission_chain(
+        cluster, user_getter=srv.current_user)
+    signer = CSRApproverSigner(cluster, ca=ca)
+    stop = __import__("threading").Event()
+    signer_threads = signer.run(stop)
+    monkeypatch.setenv("KTPU_CACERT", ca_file)
+    # setenv-to-empty registers restoration even though the vars are
+    # currently absent (delenv(raising=False) on a missing key records
+    # NOTHING, so cmd_join's own env writes would leak into later tests);
+    # tls_client_context treats "" as unset
+    monkeypatch.setenv("KTPU_CLIENT_CERT", "")
+    monkeypatch.setenv("KTPU_CLIENT_KEY", "")
+    try:
+        rc = kubeadm.main([
+            "join", "--server", srv.url, "--token", "join01." + "j" * 16,
+            "--node-name", "w7", "--csr-timeout", "10", "--one-shot",
+        ])
+        assert rc == 0
+        assert cluster.get("nodes", "", "w7") is not None
+        assert cluster.get("leases", "kube-node-lease", "w7") is not None
+        # the flow issued a REAL certificate and the join switched to it
+        import os
+
+        cert_path = os.environ.get("KTPU_CLIENT_CERT", "")
+        assert cert_path and os.path.exists(cert_path)
+        assert "BEGIN CERTIFICATE" in open(cert_path).read()
+        csrs = [c for c in cluster.list("certificatesigningrequests")
+                if c.get("name", "").startswith("node-csr-w7")]
+        assert csrs
+        assert "BEGIN CERTIFICATE" in csrs[0]["status"]["certificate"]
+    finally:
+        stop.set()
+        srv.stop()
