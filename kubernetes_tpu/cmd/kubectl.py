@@ -263,6 +263,10 @@ def main(argv=None) -> int:
     lg = sub.add_parser("logs", parents=[common])
     lg.add_argument("pod")
 
+    tp = sub.add_parser("top", parents=[common])
+    tp.add_argument("what", choices=("nodes", "node", "pods", "pod"))
+    tp.add_argument("name", nargs="?", default="")
+
     args = p.parse_args(argv)
     global _TOKEN
     _TOKEN = ""  # never leak a credential across in-process invocations
@@ -530,6 +534,37 @@ def main(argv=None) -> int:
             return 1
         text = out.get("log", "") if isinstance(out, dict) else str(out)
         sys.stdout.write(text)
+        return 0
+
+    if args.verb == "top":
+        # kubectl top: read the resource-metrics API (metrics.k8s.io,
+        # pkg/kubectl/cmd/top) — observed samples when kubelets publish
+        # them, declared requests otherwise
+        if args.what in ("nodes", "node"):
+            path = "/apis/metrics.k8s.io/v1beta1/nodes"
+            if args.name:
+                path += f"/{args.name}"
+        else:
+            path = f"/apis/metrics.k8s.io/v1beta1/namespaces/{ns}/pods"
+        out = _req(args.server, "GET", path)
+        if out.get("kind") == "Status":
+            print(out.get("message", ""), file=sys.stderr)
+            return 1
+        items = out.get("items") or ([out] if out.get("usage")
+                                     or out.get("containers") else [])
+        print("NAME" + " " * 28 + "CPU(cores)  MEMORY(bytes)")
+        for it in items:
+            meta = it.get("metadata") or {}
+            usage = it.get("usage") or {}
+            if not usage:
+                usage = {"cpu": "0m", "memory": "0"}
+                for c in it.get("containers") or []:
+                    cu = c.get("usage") or {}
+                    usage["cpu"] = cu.get("cpu", usage["cpu"])
+                    usage["memory"] = cu.get("memory", usage["memory"])
+            print(f"{meta.get('name', ''):<32}"
+                  f"{usage.get('cpu', '0m'):<12}"
+                  f"{usage.get('memory', '0')}")
         return 0
 
     if args.verb == "bind":

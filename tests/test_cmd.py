@@ -254,3 +254,29 @@ def test_kubeadm_upgrade_plan_and_apply(capsys):
         assert rc == 0 and "up to date" in out
     finally:
         srv.stop()
+
+
+def test_kubectl_top_nodes_and_pods(capsys):
+    import dataclasses as _dc
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cmd import kubectl
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+    from kubernetes_tpu.api.types import PodStatus
+    from fixtures import make_node, make_pod
+
+    cluster = LocalCluster()
+    cluster.add_node(make_node("n1", cpu="4", mem="8Gi"))
+    pod = make_pod("web", cpu="250m", mem="512Mi", node_name="n1")
+    pod = _dc.replace(pod, status=PodStatus(phase="Running"))
+    cluster.add_pod(pod)
+    srv = APIServer(cluster=cluster).start()
+    try:
+        rc = kubectl.main(["-s", srv.url, "top", "nodes"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "n1" in out and "250m" in out
+        rc = kubectl.main(["-s", srv.url, "top", "pods"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "web" in out
+    finally:
+        srv.stop()
