@@ -12,6 +12,7 @@ Implemented plugins (each cites its reference):
   NamespaceLifecycle        plugin/pkg/admission/namespace/lifecycle/admission.go
   EventRateLimit            plugin/pkg/admission/eventratelimit/admission.go
   LimitRanger               plugin/pkg/admission/limitranger/admission.go
+  PodPreset                 plugin/pkg/admission/podpreset/admission.go
   AlwaysPullImages          plugin/pkg/admission/alwayspullimages/admission.go
   ServiceAccount            plugin/pkg/admission/serviceaccount/admission.go
   PodNodeSelector           plugin/pkg/admission/podnodeselector/admission.go
@@ -567,6 +568,83 @@ class ServiceAccount:
         return obj
 
 
+class PodPreset:
+    """Inject env/volumes/volumeMounts from matching PodPreset objects
+    (plugin/pkg/admission/podpreset/admission.go): presets select pods by
+    label in the same namespace; a merge CONFLICT (same env name or
+    volume name, different value) skips injection for that pod rather
+    than failing the create; applied presets are recorded in the
+    podpreset.admission.kubernetes.io/podpreset-<name> annotation."""
+
+    ANNOTATION_PREFIX = "podpreset.admission.kubernetes.io"
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def _matching(self, ns: str, labels: dict) -> List[dict]:
+        from kubernetes_tpu.api.labels import selector_from_label_selector
+
+        if not self.cluster.has_kind("podpresets"):
+            return []
+        out = []
+        for pp in self.cluster.list("podpresets"):
+            if not isinstance(pp, dict) or pp.get("namespace") != ns:
+                continue
+            sel = selector_from_label_selector(
+                (pp.get("spec") or {}).get("selector") or {})
+            if sel is None or sel.matches(labels or {}):
+                out.append(pp)
+        return sorted(out, key=lambda p: p.get("name", ""))
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind != "pods" or op != "CREATE":
+            return obj
+        meta = _meta(obj)
+        presets = self._matching(
+            meta.get("namespace", "default"), meta.get("labels") or {})
+        if not presets:
+            return obj
+        spec = obj.setdefault("spec", {})
+        # merge with conflict detection across ALL presets first
+        # (safeToApplyPodPresetsOnPod): any conflict -> no injection
+        env_merged: Dict[str, dict] = {}
+        vol_merged: Dict[str, dict] = {}
+        for pp in presets:
+            ps = pp.get("spec") or {}
+            for e in ps.get("env") or []:
+                cur = env_merged.get(e.get("name"))
+                if cur is not None and cur != e:
+                    return obj  # conflict: skip injection (klog-warn path)
+                env_merged[e.get("name")] = e
+            for v in ps.get("volumes") or []:
+                cur = vol_merged.get(v.get("name"))
+                if cur is not None and cur != v:
+                    return obj
+                vol_merged[v.get("name")] = v
+        for c in spec.get("containers") or []:
+            have = {e.get("name"): e for e in c.get("env") or []}
+            for name, e in env_merged.items():
+                if name in have and have[name] != e:
+                    return obj  # container-level conflict
+            c["env"] = list((c.get("env") or [])) + [
+                e for n, e in env_merged.items() if n not in have]
+            mounts = {m.get("name") for m in c.get("volumeMounts") or []}
+            for pp in presets:
+                for m in (pp.get("spec") or {}).get("volumeMounts") or []:
+                    if m.get("name") not in mounts:
+                        c.setdefault("volumeMounts", []).append(m)
+                        mounts.add(m.get("name"))
+        have_vols = {v.get("name") for v in spec.get("volumes") or []}
+        for name, v in vol_merged.items():
+            if name not in have_vols:
+                spec.setdefault("volumes", []).append(v)
+        anns = meta.setdefault("annotations", {})
+        for pp in presets:
+            anns[f"{self.ANNOTATION_PREFIX}/podpreset-{pp.get('name')}"] = \
+                str(pp.get("resourceVersion", "0"))
+        return obj
+
+
 class AlwaysPullImages:
     """Force every container's imagePullPolicy to Always
     (plugin/pkg/admission/alwayspullimages/admission.go): in a multi-
@@ -786,6 +864,7 @@ def default_admission_chain(cluster, user_getter: Optional[Callable] = None,
         NamespaceLifecycle(cluster),
         EventRateLimit(),
         LimitRanger(cluster),
+        PodPreset(cluster),
         AlwaysPullImages(),
     ]
     if with_service_account:

@@ -714,3 +714,50 @@ def test_node_restriction_label_self_escalation_guard():
             "name": "n1", "labels": {"zone": "z1"}}})
     # a status-only update body (no labels map) passes through
     assert plugin("UPDATE", "nodes", base)
+
+
+def test_pod_preset_injects_env_and_volumes():
+    """podpreset/admission.go: matching presets inject env/volumes/
+    volumeMounts; merge conflicts skip injection (never fail the pod);
+    applied presets annotate."""
+    from kubernetes_tpu.apiserver.admission import PodPreset
+
+    cluster = LocalCluster()
+    cluster.register_kind("podpresets")
+    cluster.create("podpresets", {
+        "namespace": "default", "name": "db-creds",
+        "spec": {
+            "selector": {"matchLabels": {"app": "web"}},
+            "env": [{"name": "DB_HOST", "value": "db.prod"}],
+            "volumes": [{"name": "cache", "emptyDir": {}}],
+            "volumeMounts": [{"name": "cache", "mountPath": "/cache"}],
+        },
+    })
+    p = PodPreset(cluster)
+    pod = {"metadata": {"namespace": "default", "name": "w",
+                        "labels": {"app": "web"}},
+           "spec": {"containers": [{"name": "c",
+                                    "env": [{"name": "A", "value": "1"}]}]}}
+    out = p("CREATE", "pods", pod)
+    c = out["spec"]["containers"][0]
+    assert {"name": "DB_HOST", "value": "db.prod"} in c["env"]
+    assert {"name": "A", "value": "1"} in c["env"]
+    assert c["volumeMounts"][0]["mountPath"] == "/cache"
+    assert out["spec"]["volumes"][0]["name"] == "cache"
+    anns = out["metadata"]["annotations"]
+    assert any(k.endswith("podpreset-db-creds") for k in anns)
+    # non-matching pod untouched
+    other = {"metadata": {"namespace": "default", "name": "o",
+                          "labels": {"app": "db"}}, "spec": {
+                              "containers": [{"name": "c"}]}}
+    assert "volumes" not in p("CREATE", "pods", dict(other)).get("spec", {})
+    # conflict (same env name, different value): injection skipped
+    clash = {"metadata": {"namespace": "default", "name": "x",
+                          "labels": {"app": "web"}},
+             "spec": {"containers": [
+                 {"name": "c",
+                  "env": [{"name": "DB_HOST", "value": "localhost"}]}]}}
+    out = p("CREATE", "pods", clash)
+    assert out["spec"]["containers"][0]["env"] == [
+        {"name": "DB_HOST", "value": "localhost"}]
+    assert "volumes" not in out["spec"]
