@@ -480,18 +480,24 @@ class NodeRestriction:
             # namespace on its own Node — those are the operator-asserted
             # isolation labels workloads select on
             RESTRICTED = "node-restriction.kubernetes.io/"
-            want = ((meta.get("labels") or {})
-                    if "metadata" in obj else (obj.get("labels") or {}))
+            holder = obj.get("metadata") if "metadata" in obj else obj
+            # distinguish "labels map present" (a label write — possibly
+            # EMPTY, which would strip everything) from "no labels key"
+            # (a status-only update body): only the former is guarded
+            labels_provided = isinstance(holder, dict) and "labels" in holder
+            want = (holder.get("labels") or {}) if labels_provided else {}
             cur = self.cluster.get("nodes", "", me)
             have = dict(cur.metadata.labels) if cur is not None else {}
             for k, v in want.items():
                 if RESTRICTED in k and have.get(k) != v:
                     raise AdmissionDenied(
                         f"node {me!r} may not set restricted label {k!r}")
-            for k in have:
-                if RESTRICTED in k and k not in want and want:
-                    raise AdmissionDenied(
-                        f"node {me!r} may not remove restricted label {k!r}")
+            if labels_provided:
+                for k in have:
+                    if RESTRICTED in k and k not in want:
+                        raise AdmissionDenied(
+                            f"node {me!r} may not remove restricted "
+                            f"label {k!r}")
             return obj
         if kind == "leases":
             # confined to kube-node-lease (admission.go admitLease): a
@@ -621,11 +627,17 @@ class PodPreset:
                 if cur is not None and cur != v:
                     return obj
                 vol_merged[v.get("name")] = v
+        # container-level conflict PRECHECK before any mutation
+        # (safeToApplyPodPresetsOnPod): a conflict in container N must
+        # not leave containers 0..N-1 partially injected with mounts
+        # referencing volumes that were never added
         for c in spec.get("containers") or []:
             have = {e.get("name"): e for e in c.get("env") or []}
             for name, e in env_merged.items():
                 if name in have and have[name] != e:
-                    return obj  # container-level conflict
+                    return obj  # conflict: skip injection entirely
+        for c in spec.get("containers") or []:
+            have = {e.get("name"): e for e in c.get("env") or []}
             c["env"] = list((c.get("env") or [])) + [
                 e for n, e in env_merged.items() if n not in have]
             mounts = {m.get("name") for m in c.get("volumeMounts") or []}

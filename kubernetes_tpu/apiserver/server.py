@@ -481,6 +481,23 @@ class APIServer:
             obj_dict = plugin(op, kind, obj_dict)
         return obj_dict
 
+    def _admit_split(self, op: str, kind: str, obj_dict: dict,
+                     locked: bool) -> dict:
+        """The write handlers run admission in two phases: everything up
+        to ResourceQuota OUTSIDE the write lock (webhook dispatch does
+        remote HTTP — holding the lock there would serialize every write
+        behind slow webhooks and self-deadlock any webhook that writes
+        back to this apiserver), then ResourceQuota INSIDE the lock
+        (its read-then-check must be atomic with the create).  The other
+        compiled-in plugins only READ cluster state, so running them
+        pre-lock keeps their semantics."""
+        from kubernetes_tpu.apiserver.admission import ResourceQuota
+
+        for plugin in self.admission:
+            if isinstance(plugin, ResourceQuota) == locked:
+                obj_dict = plugin(op, kind, obj_dict)
+        return obj_dict
+
     # ------------------------------------------------------------- routes
 
     def _route(self, path: str):
@@ -1301,11 +1318,16 @@ class APIServer:
                             csr_spec = body.setdefault("spec", {})
                             csr_spec["requestorUsername"] = user.name
                             csr_spec["requestorGroups"] = list(user.groups)
-                    # one write at a time: quota/limit admission is a
-                    # read-then-create; serializing the write path makes it
-                    # atomic (etcd serializes writes the same way)
+                    # pre-lock admission phase (incl. webhook HTTP
+                    # dispatch — see _admit_split), then one write at a
+                    # time: quota admission is a read-then-create, so it
+                    # runs atomically with the create under the lock
+                    # (etcd serializes writes the same way)
+                    body = outer._admit_split("CREATE", kind, body,
+                                              locked=False)
                     with outer._write_lock:
-                        body = outer._admit("CREATE", kind, body)
+                        body = outer._admit_split("CREATE", kind, body,
+                                                  locked=True)
                         # schema validation AFTER admission: mutating
                         # plugins must not produce out-of-schema objects
                         outer._validate_extension(kind, body)
@@ -1352,8 +1374,11 @@ class APIServer:
                     meta = body.setdefault("metadata", {})
                     if ns and not meta.get("namespace"):
                         meta["namespace"] = ns  # path ns first, as on POST
+                    body = outer._admit_split("UPDATE", kind, body,
+                                              locked=False)
                     with outer._write_lock:
-                        body = outer._admit("UPDATE", kind, body)
+                        body = outer._admit_split("UPDATE", kind, body,
+                                                  locked=True)
                         outer._validate_extension(kind, body)
                         expect = meta.get("resourceVersion")
                         obj = _decode(kind, body)

@@ -30,15 +30,9 @@ import urllib.request
 
 
 def _urlopen(req, timeout):
-    """urlopen with the CLI-wide TLS trust (KTPU_CACERT) for https
-    planes; plain http passes context=None."""
-    url = req.full_url if hasattr(req, "full_url") else str(req)
-    ctx = None
-    if url.startswith("https://"):
-        from kubernetes_tpu.cmd.base import tls_client_context
+    from kubernetes_tpu.cmd.base import tls_urlopen
 
-        ctx = tls_client_context()
-    return urllib.request.urlopen(req, timeout=timeout, context=ctx)
+    return tls_urlopen(req, timeout)
 from typing import Optional
 
 from kubernetes_tpu.runtime.cluster import LocalCluster

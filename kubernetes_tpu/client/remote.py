@@ -107,14 +107,10 @@ class RemoteCluster:
         req = urllib.request.Request(
             self.server + path, data=data, method=method, headers=headers,
         )
-        ctx = None
-        if self.server.startswith("https://"):
-            from kubernetes_tpu.cmd.base import tls_client_context
+        from kubernetes_tpu.cmd.base import tls_urlopen
 
-            ctx = tls_client_context()
         try:
-            with urllib.request.urlopen(req, timeout=30,
-                                        context=ctx) as resp:
+            with tls_urlopen(req, timeout=30) as resp:
                 return json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as e:
             body = e.read().decode(errors="replace")

@@ -41,13 +41,27 @@ def tls_client_context(cacert: Optional[str] = None,
     elif _os.environ.get("KTPU_INSECURE_SKIP_TLS_VERIFY", "") == "1":
         ctx = _ssl._create_unverified_context()
     else:
+        # no pinned CA: full public-trust verification INCLUDING hostname
+        # (disabling it here would accept any publicly-issued cert for
+        # any name — a silent MITM downgrade); hostname relaxation is
+        # only sound in the pinned-private-CA branch above
         ctx = _ssl.create_default_context()
-        ctx.check_hostname = False
     cert = client_cert or _os.environ.get("KTPU_CLIENT_CERT", "")
     key = client_key or _os.environ.get("KTPU_CLIENT_KEY", "")
     if cert and key:
         ctx.load_cert_chain(certfile=cert, keyfile=key)
     return ctx
+
+
+def tls_urlopen(req, timeout: float):
+    """urlopen with the process-wide TLS trust for https URLs (the ONE
+    client-transport policy point: api_request, RemoteCluster, and the
+    reflector all route through here)."""
+    import urllib.request as _ur
+
+    url = req.full_url if hasattr(req, "full_url") else str(req)
+    ctx = tls_client_context() if url.startswith("https://") else None
+    return _ur.urlopen(req, timeout=timeout, context=ctx)
 
 
 def api_request(server: str, method: str, path: str, payload=None,
@@ -68,10 +82,8 @@ def api_request(server: str, method: str, path: str, payload=None,
         server.rstrip("/") + path, data=data, method=method,
         headers=headers,
     )
-    ctx = (tls_client_context()
-           if server.startswith("https://") else None)
     try:
-        with urllib.request.urlopen(req, timeout=30, context=ctx) as resp:
+        with tls_urlopen(req, timeout=30) as resp:
             return _json.loads(resp.read() or b"{}")
     except urllib.error.HTTPError as e:
         body = e.read().decode(errors="replace")

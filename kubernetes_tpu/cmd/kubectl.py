@@ -552,16 +552,24 @@ def main(argv=None) -> int:
             return 1
         items = out.get("items") or ([out] if out.get("usage")
                                      or out.get("containers") else [])
+        from kubernetes_tpu.api.resource import parse_quantity
+
         print("NAME" + " " * 28 + "CPU(cores)  MEMORY(bytes)")
         for it in items:
             meta = it.get("metadata") or {}
             usage = it.get("usage") or {}
             if not usage:
-                usage = {"cpu": "0m", "memory": "0"}
+                # pod items carry per-container usage: SUM them (top.go
+                # aggregates container samples per pod)
+                cpu_m = 0.0
+                mem = 0.0
                 for c in it.get("containers") or []:
                     cu = c.get("usage") or {}
-                    usage["cpu"] = cu.get("cpu", usage["cpu"])
-                    usage["memory"] = cu.get("memory", usage["memory"])
+                    if cu.get("cpu") is not None:
+                        cpu_m += parse_quantity(cu["cpu"]).milli
+                    if cu.get("memory") is not None:
+                        mem += float(parse_quantity(cu["memory"]))
+                usage = {"cpu": f"{int(cpu_m)}m", "memory": f"{int(mem)}"}
             print(f"{meta.get('name', ''):<32}"
                   f"{usage.get('cpu', '0m'):<12}"
                   f"{usage.get('memory', '0')}")

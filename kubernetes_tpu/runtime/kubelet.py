@@ -335,7 +335,17 @@ class Kubelet:
                 continue
             if self.liveness is not None and not self.liveness(pod):
                 self._teardown(key)
-                self.sandbox_of[key] = self.runtime.run_pod_sandbox(pod)
+                try:
+                    self.sandbox_of[key] = self.runtime.run_pod_sandbox(pod)
+                except Exception as e:
+                    # a dead runtime mid-restart: pod event, prober
+                    # survives; the next sync_pod re-creates the sandbox
+                    self.cluster.events.eventf(
+                        "Pod", pod.namespace, pod.name, "Warning",
+                        "FailedCreatePodSandBox",
+                        "restart after failed liveness probe: %s", e,
+                    )
+                    continue
                 pod = dataclasses.replace(
                     pod,
                     status=dataclasses.replace(

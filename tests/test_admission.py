@@ -712,6 +712,11 @@ def test_node_restriction_label_self_escalation_guard():
     with pytest.raises(AdmissionDenied):
         plugin("UPDATE", "nodes", {"metadata": {
             "name": "n1", "labels": {"zone": "z1"}}})
+    # an EMPTY labels map is a label write stripping everything: denied
+    # (review regression: `and want` used to wave this through)
+    with pytest.raises(AdmissionDenied):
+        plugin("UPDATE", "nodes", {"metadata": {"name": "n1",
+                                                "labels": {}}})
     # a status-only update body (no labels map) passes through
     assert plugin("UPDATE", "nodes", base)
 
