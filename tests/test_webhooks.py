@@ -235,3 +235,56 @@ def test_rules_and_namespace_selector_matching():
         assert calls == ["pods", "deployments"]
     finally:
         srv.shutdown()
+
+
+def test_webhook_writing_back_to_apiserver_does_not_deadlock():
+    """Review regression: webhook dispatch must run OUTSIDE the write
+    lock — a webhook whose handler writes to the SAME apiserver (the
+    common audit/sidecar pattern) used to deadlock on the lock its own
+    admission held."""
+    import urllib.request
+
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster)
+    srv.admission = default_admission_chain(cluster)
+    srv.start()
+
+    def writeback(review):
+        # the webhook records an audit ConfigMap through the front door
+        req = urllib.request.Request(
+            f"{srv.url}/api/v1/namespaces/default/configmaps",
+            data=json.dumps({
+                "metadata": {"name": "webhook-audit",
+                             "namespace": "default"},
+                "data": {"saw": review["request"]["name"]},
+            }).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        urllib.request.urlopen(req, timeout=5)
+        return {"allowed": True}
+
+    hook_srv, url = _start_hook(writeback)
+    cluster.create("validatingwebhookconfigurations", {
+        "namespace": "", "name": "writeback",
+        "webhooks": [{
+            "name": "writeback.test.io",
+            "clientConfig": {"url": url},
+            "rules": [{"operations": ["CREATE"], "resources": ["pods"]}],
+            "failurePolicy": "Fail",
+            "timeoutSeconds": 5,
+        }],
+    })
+    try:
+        req = urllib.request.Request(
+            f"{srv.url}/api/v1/namespaces/default/pods",
+            data=json.dumps({
+                "metadata": {"name": "audited", "namespace": "default"},
+                "spec": {"containers": [{"name": "c"}]},
+            }).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+        cm = cluster.get("configmaps", "default", "webhook-audit")
+        assert cm is not None and cm["data"]["saw"] == "audited"
+    finally:
+        srv.stop()
+        hook_srv.shutdown()
