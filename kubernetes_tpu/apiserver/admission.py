@@ -26,6 +26,15 @@ Implemented plugins (each cites its reference):
   MutatingAdmissionWebhook / ValidatingAdmissionWebhook  apiserver/pkg/admission/plugin/webhook (webhooks.py)
   ResourceQuota             plugin/pkg/admission/resourcequota/admission.go
 
+Available but (like the reference) not default-enabled:
+
+  AlwaysAdmit / AlwaysDeny  plugin/pkg/admission/{admit,deny}
+  NamespaceExists / NamespaceAutoProvision  plugin/pkg/admission/namespace/{exists,autoprovision}
+  ExtendedResourceToleration  plugin/pkg/admission/extendedresourcetoleration/admission.go
+  PodTolerationRestriction  plugin/pkg/admission/podtolerationrestriction
+  SecurityContextDeny       plugin/pkg/admission/securitycontext/scdeny
+  LimitPodHardAntiAffinityTopology  plugin/pkg/admission/antiaffinity
+
 ``default_admission_chain`` assembles them in the reference's recommended
 order (mutating before validating; ResourceQuota last —
 kubeapiserver/options/plugins.go).
@@ -571,6 +580,196 @@ class ServiceAccount:
             raise AdmissionDenied(
                 f'service account {ns}/{sa} was not found, retry after '
                 f'the service account is created')
+        return obj
+
+
+class AlwaysAdmit:
+    """plugin/pkg/admission/admit: the no-op plugin (deprecated in the
+    reference, kept for chain-configuration parity)."""
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        return obj
+
+
+class AlwaysDeny:
+    """plugin/pkg/admission/deny: reject everything (testing plugin)."""
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        raise AdmissionDenied("admission plugin AlwaysDeny denied the "
+                              "request")
+
+
+class NamespaceExists:
+    """plugin/pkg/admission/namespace/exists: reject namespaced writes
+    into namespaces that do not exist (subsumed by NamespaceLifecycle in
+    the default chain; offered for configuration parity)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if op != "CREATE" or kind not in NAMESPACED_KINDS:
+            return obj
+        ns = _meta(obj).get("namespace", "default")
+        if ns in IMMORTAL_NAMESPACES:
+            return obj
+        if self.cluster.get("namespaces", "", ns) is None:
+            raise AdmissionDenied(f"namespace {ns!r} does not exist")
+        return obj
+
+
+class NamespaceAutoProvision:
+    """plugin/pkg/admission/namespace/autoprovision: create the target
+    namespace on demand instead of rejecting the write."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if op != "CREATE" or kind not in NAMESPACED_KINDS:
+            return obj
+        ns = _meta(obj).get("namespace", "default")
+        if self.cluster.get("namespaces", "", ns) is None:
+            from kubernetes_tpu.runtime.cluster import ConflictError
+
+            try:
+                self.cluster.create("namespaces", {
+                    "namespace": "", "name": ns,
+                    "kind": "Namespace", "apiVersion": "v1",
+                    "metadata": {"name": ns},
+                })
+            except ConflictError:
+                pass  # raced another provisioner: fine
+        return obj
+
+
+class ExtendedResourceToleration:
+    """plugin/pkg/admission/extendedresourcetoleration/admission.go: a
+    pod requesting extended resources (device plugins) gets a toleration
+    for each such resource's taint key, so dedicated device nodes can be
+    tainted with their resource name and only consumers land there."""
+
+    @staticmethod
+    def _extended(name: str) -> bool:
+        # not a native resource: has a domain prefix that isn't
+        # kubernetes.io (helpers.IsExtendedResourceName)
+        return "/" in name and not name.startswith("kubernetes.io/")
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind != "pods" or op != "CREATE":
+            return obj
+        spec = obj.get("spec") or {}
+        wanted = set()
+        for c in spec.get("containers") or []:
+            for res in ((c.get("resources") or {}).get("requests")
+                        or {}):
+                if self._extended(res):
+                    wanted.add(res)
+        if not wanted:
+            return obj
+        tols = spec.setdefault("tolerations", [])
+        have = {(t.get("key"), t.get("operator")) for t in tols}
+        for res in sorted(wanted):
+            if (res, "Exists") not in have:
+                tols.append({"key": res, "operator": "Exists",
+                             "effect": "NoSchedule"})
+        return obj
+
+
+class PodTolerationRestriction:
+    """plugin/pkg/admission/podtolerationrestriction: merge the
+    namespace's default tolerations into the pod and reject tolerations
+    outside the namespace whitelist (both carried as namespace
+    annotations, like PodNodeSelector)."""
+
+    DEFAULT_ANN = "scheduler.alpha.kubernetes.io/defaultTolerations"
+    WHITELIST_ANN = "scheduler.alpha.kubernetes.io/tolerationsWhitelist"
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        import json as _json
+
+        if kind != "pods" or op != "CREATE":
+            return obj
+        ns = _meta(obj).get("namespace", "default")
+        rec = self.cluster.get("namespaces", "", ns)
+        if not isinstance(rec, dict):
+            return obj
+        anns = ((rec.get("metadata") or {}).get("annotations")
+                or rec.get("annotations") or {})
+        spec = obj.setdefault("spec", {})
+        if anns.get(self.DEFAULT_ANN):
+            try:
+                defaults = _json.loads(anns[self.DEFAULT_ANN])
+            except ValueError:
+                defaults = []
+            tols = spec.setdefault("tolerations", [])
+            have = {(t.get("key"), t.get("effect")) for t in tols}
+            for t in defaults:
+                if (t.get("key"), t.get("effect")) not in have:
+                    tols.append(t)
+        if anns.get(self.WHITELIST_ANN):
+            try:
+                allowed = _json.loads(anns[self.WHITELIST_ANN])
+            except ValueError:
+                allowed = []
+            keys = {t.get("key") for t in allowed}
+            for t in spec.get("tolerations") or []:
+                if t.get("key") not in keys:
+                    raise AdmissionDenied(
+                        f"pod toleration {t.get('key')!r} is not in the "
+                        f"namespace whitelist")
+        return obj
+
+
+class SecurityContextDeny:
+    """plugin/pkg/admission/securitycontext/scdeny: reject pods setting
+    the identity-altering securityContext fields (the pre-PSP hammer)."""
+
+    POD_FIELDS = ("supplementalGroups", "fsGroup")
+    CONTAINER_FIELDS = ("runAsUser", "runAsGroup", "seLinuxOptions")
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind != "pods" or op not in ("CREATE", "UPDATE"):
+            return obj
+        spec = obj.get("spec") or {}
+        sc = spec.get("securityContext") or {}
+        for f in self.POD_FIELDS + self.CONTAINER_FIELDS:
+            if sc.get(f) is not None:
+                raise AdmissionDenied(
+                    f"SecurityContextDeny: pod securityContext.{f} is "
+                    "forbidden")
+        for c in spec.get("containers") or []:
+            csc = c.get("securityContext") or {}
+            for f in self.CONTAINER_FIELDS:
+                if csc.get(f) is not None:
+                    raise AdmissionDenied(
+                        f"SecurityContextDeny: container "
+                        f"securityContext.{f} is forbidden")
+        return obj
+
+
+class LimitPodHardAntiAffinityTopology:
+    """plugin/pkg/admission/antiaffinity: required pod anti-affinity may
+    only use the kubernetes.io/hostname topology key (unbounded custom
+    topologies make scheduling O(zones) adversarial)."""
+
+    HOSTNAME = "kubernetes.io/hostname"
+
+    def __call__(self, op: str, kind: str, obj: dict) -> dict:
+        if kind != "pods" or op not in ("CREATE", "UPDATE"):
+            return obj
+        aff = ((obj.get("spec") or {}).get("affinity") or {})
+        anti = aff.get("podAntiAffinity") or {}
+        for term in anti.get(
+                "requiredDuringSchedulingIgnoredDuringExecution") or []:
+            key = term.get("topologyKey", "")
+            if key and key != self.HOSTNAME:
+                raise AdmissionDenied(
+                    "pod with required anti-affinity topologyKey "
+                    f"{key!r} is limited to {self.HOSTNAME}")
         return obj
 
 

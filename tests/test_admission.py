@@ -766,3 +766,77 @@ def test_pod_preset_injects_env_and_volumes():
     assert out["spec"]["containers"][0]["env"] == [
         {"name": "DB_HOST", "value": "localhost"}]
     assert "volumes" not in out["spec"]
+
+
+def test_optional_plugin_set():
+    """The non-default plugins (config parity with plugin/pkg/admission's
+    full set): auto-provision, extended-resource tolerations, toleration
+    restriction, scdeny, hard-anti-affinity topology limit."""
+    from kubernetes_tpu.apiserver.admission import (
+        AlwaysAdmit,
+        AlwaysDeny,
+        ExtendedResourceToleration,
+        LimitPodHardAntiAffinityTopology,
+        NamespaceAutoProvision,
+        NamespaceExists,
+        PodTolerationRestriction,
+        SecurityContextDeny,
+    )
+
+    cluster = LocalCluster()
+    assert AlwaysAdmit()("CREATE", "pods", {"x": 1}) == {"x": 1}
+    with pytest.raises(AdmissionDenied):
+        AlwaysDeny()("CREATE", "pods", {})
+    # exists rejects; autoprovision creates
+    pod = {"metadata": {"namespace": "newteam", "name": "p"}, "spec": {}}
+    with pytest.raises(AdmissionDenied):
+        NamespaceExists(cluster)("CREATE", "pods", dict(pod))
+    NamespaceAutoProvision(cluster)("CREATE", "pods", dict(pod))
+    assert cluster.get("namespaces", "", "newteam") is not None
+    NamespaceExists(cluster)("CREATE", "pods", dict(pod))  # now fine
+    # extended resources gain tolerations
+    dev = {"metadata": {"namespace": "default", "name": "d"},
+           "spec": {"containers": [{"name": "c", "resources": {
+               "requests": {"google.com/tpu": "4", "cpu": "1"}}}]}}
+    out = ExtendedResourceToleration()("CREATE", "pods", dev)
+    assert {"key": "google.com/tpu", "operator": "Exists",
+            "effect": "NoSchedule"} in out["spec"]["tolerations"]
+    assert len(out["spec"]["tolerations"]) == 1  # cpu is native
+    # toleration defaults merge; whitelist rejects outsiders
+    import json as _json
+
+    cluster.create("namespaces", {
+        "namespace": "", "name": "restricted",
+        "metadata": {"name": "restricted", "annotations": {
+            PodTolerationRestriction.DEFAULT_ANN: _json.dumps(
+                [{"key": "team", "operator": "Equal", "value": "a",
+                  "effect": "NoSchedule"}]),
+            PodTolerationRestriction.WHITELIST_ANN: _json.dumps(
+                [{"key": "team"}]),
+        }},
+    })
+    p = PodTolerationRestriction(cluster)
+    ok = p("CREATE", "pods", {"metadata": {"namespace": "restricted",
+                                           "name": "x"}, "spec": {}})
+    assert ok["spec"]["tolerations"][0]["key"] == "team"
+    with pytest.raises(AdmissionDenied):
+        p("CREATE", "pods", {"metadata": {"namespace": "restricted",
+                                          "name": "y"},
+                             "spec": {"tolerations": [
+                                 {"key": "rogue", "operator": "Exists"}]}})
+    # scdeny
+    with pytest.raises(AdmissionDenied):
+        SecurityContextDeny()("CREATE", "pods", {"spec": {"containers": [
+            {"name": "c", "securityContext": {"runAsUser": 0}}]}})
+    SecurityContextDeny()("CREATE", "pods", {"spec": {"containers": [
+        {"name": "c"}]}})
+    # anti-affinity topology limit
+    bad = {"spec": {"affinity": {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [
+            {"topologyKey": "failure-domain.beta.kubernetes.io/zone"}]}}}}
+    with pytest.raises(AdmissionDenied):
+        LimitPodHardAntiAffinityTopology()("CREATE", "pods", bad)
+    good = {"spec": {"affinity": {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [
+            {"topologyKey": "kubernetes.io/hostname"}]}}}}
+    LimitPodHardAntiAffinityTopology()("CREATE", "pods", good)
