@@ -1278,6 +1278,63 @@ class APIServer:
                     self._status(400, "BadRequest", "invalid JSON")
                     return
                 try:
+                    if kind == "pods" and sub == "eviction":
+                        # policy/v1beta1 Eviction (registry/core/pod/rest/
+                        # eviction.go): delete only if every matching PDB
+                        # still allows a disruption; a blocked eviction is
+                        # 429 TooManyRequests (kubectl drain retries it)
+                        from kubernetes_tpu.api.labels import (
+                            selector_from_label_selector,
+                        )
+
+                        pod = outer.cluster.get("pods", ns, name)
+                        if pod is None:
+                            self._status(404, "NotFound", f"pod {ns}/{name}")
+                            return
+                        with outer._write_lock:
+                            blocked = None
+                            for pdb in outer.cluster.list(
+                                    "poddisruptionbudgets"):
+                                if pdb.metadata.namespace != ns:
+                                    continue
+                                sel = selector_from_label_selector(
+                                    pdb.selector or {})
+                                if sel is None or not sel.matches(
+                                        pod.labels):
+                                    continue
+                                if pdb.disruptions_allowed <= 0:
+                                    blocked = pdb.metadata.name
+                                    break
+                            if blocked is not None:
+                                self._status(
+                                    429, "TooManyRequests",
+                                    "Cannot evict pod as it would "
+                                    f"violate the pod's disruption "
+                                    f"budget {blocked!r}")
+                                return
+                            # consume the budget immediately (the registry
+                            # decrements before the async controller
+                            # recomputes, closing the thundering-drain race)
+                            for pdb in outer.cluster.list(
+                                    "poddisruptionbudgets"):
+                                if pdb.metadata.namespace != ns:
+                                    continue
+                                sel = selector_from_label_selector(
+                                    pdb.selector or {})
+                                if sel is not None and sel.matches(
+                                        pod.labels):
+                                    import dataclasses as _dc
+
+                                    outer.cluster.update(
+                                        "poddisruptionbudgets",
+                                        _dc.replace(
+                                            pdb, disruptions_allowed=max(
+                                                0,
+                                                pdb.disruptions_allowed
+                                                - 1)))
+                            outer.cluster.delete("pods", ns, name)
+                        self._status(201, "Created", "eviction granted")
+                        return
                     if kind == "pods" and sub == "binding":
                         # Binding subresource: {"target": {"name": node}}
                         node = (body.get("target") or {}).get("name", "")

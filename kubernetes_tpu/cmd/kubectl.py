@@ -267,6 +267,14 @@ def main(argv=None) -> int:
     tp.add_argument("what", choices=("nodes", "node", "pods", "pod"))
     tp.add_argument("name", nargs="?", default="")
 
+    for verb in ("cordon", "uncordon"):
+        cv = sub.add_parser(verb, parents=[common])
+        cv.add_argument("node")
+    dr = sub.add_parser("drain", parents=[common])
+    dr.add_argument("node")
+    dr.add_argument("--timeout", type=float, default=30.0,
+                    help="seconds to keep retrying PDB-blocked evictions")
+
     args = p.parse_args(argv)
     global _TOKEN
     _TOKEN = ""  # never leak a credential across in-process invocations
@@ -534,6 +542,71 @@ def main(argv=None) -> int:
             return 1
         text = out.get("log", "") if isinstance(out, dict) else str(out)
         sys.stdout.write(text)
+        return 0
+
+    if args.verb in ("cordon", "uncordon"):
+        # pkg/kubectl/cmd/drain: flip spec.unschedulable via PUT
+        path = _resolve_path(args.server, "nodes", "", args.node)
+        node = _req(args.server, "GET", path)
+        if node.get("kind") == "Status":
+            print(node.get("message", ""), file=sys.stderr)
+            return 1
+        node.setdefault("spec", {})["unschedulable"] = \
+            args.verb == "cordon"
+        res = _req(args.server, "PUT", path, node)
+        if res.get("kind") == "Status" and res.get("code", 200) >= 400:
+            print(res.get("message", ""), file=sys.stderr)
+            return 1
+        print(f"node/{args.node} "
+              + ("cordoned" if args.verb == "cordon" else "uncordoned"))
+        return 0
+
+    if args.verb == "drain":
+        # cordon, then evict every pod bound to the node through the
+        # PDB-gated eviction subresource, retrying 429s until --timeout
+        # (drain.go's exact loop); mirror pods are skipped
+        import time as _time
+
+        rc = main(["-s", args.server, "cordon", args.node])
+        if rc != 0:
+            return rc
+        pods = _req(args.server, "GET",
+                    "/api/v1/pods?fieldSelector=spec.nodeName%3D"
+                    + args.node)
+        targets = []
+        for p in pods.get("items") or []:
+            meta = p.get("metadata") or {}
+            anns = meta.get("annotations") or {}
+            if "kubernetes.io/config.mirror" in anns:
+                continue  # mirror pods restart with the kubelet: skip
+            targets.append((meta.get("namespace", "default"),
+                            meta.get("name", "")))
+        deadline = _time.monotonic() + args.timeout
+        pending = list(targets)
+        while pending and _time.monotonic() < deadline:
+            nxt = []
+            for pns, pname in pending:
+                out = _req(args.server, "POST",
+                           _path("pods", pns, pname) + "/eviction",
+                           {"metadata": {"name": pname,
+                                         "namespace": pns}})
+                if out.get("code") == 429:
+                    nxt.append((pns, pname))  # PDB-blocked: retry
+                elif out.get("code", 201) >= 400 and \
+                        out.get("code") != 404:
+                    print(f"error evicting {pns}/{pname}: "
+                          f"{out.get('message', '')}", file=sys.stderr)
+                    return 1
+                else:
+                    print(f"pod/{pname} evicted")
+            if nxt:
+                _time.sleep(0.5)
+            pending = nxt
+        if pending:
+            print(f"error: {len(pending)} pods still blocked by "
+                  "disruption budgets", file=sys.stderr)
+            return 1
+        print(f"node/{args.node} drained")
         return 0
 
     if args.verb == "top":

@@ -280,3 +280,61 @@ def test_kubectl_top_nodes_and_pods(capsys):
         assert rc == 0 and "web" in out
     finally:
         srv.stop()
+
+
+def test_kubectl_cordon_drain_with_pdb(capsys):
+    """drain.go distilled: cordon flips spec.unschedulable; drain evicts
+    through the PDB-gated eviction subresource, retrying 429s until the
+    budget opens."""
+    import dataclasses as _dc
+    import threading
+    import time as _time
+
+    from kubernetes_tpu.api.types import PodDisruptionBudget, ObjectMeta
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cmd import kubectl
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+    from fixtures import make_node, make_pod
+
+    cluster = LocalCluster()
+    cluster.add_node(make_node("n1", cpu="4", mem="8Gi"))
+    cluster.add_pod(make_pod("web-1", cpu="100m", node_name="n1",
+                             labels={"app": "web"}))
+    cluster.add_pod(make_pod("loose", cpu="100m", node_name="n1"))
+    cluster.create("poddisruptionbudgets", PodDisruptionBudget(
+        metadata=ObjectMeta(namespace="default", name="web-pdb"),
+        selector={"matchLabels": {"app": "web"}},
+        disruptions_allowed=0, min_available=1,
+    ))
+    srv = APIServer(cluster=cluster).start()
+    try:
+        rc = kubectl.main(["-s", srv.url, "cordon", "n1"])
+        assert rc == 0 and "cordoned" in capsys.readouterr().out
+        assert cluster.get("nodes", "", "n1").spec.unschedulable
+        # drain with a short timeout: the PDB (0 allowed) blocks web-1
+        rc = kubectl.main(["-s", srv.url, "drain", "n1",
+                           "--timeout", "1.5"])
+        out = capsys.readouterr()
+        assert rc == 1 and "disruption budgets" in out.err
+        assert cluster.get("pods", "default", "loose") is None  # evicted
+        assert cluster.get("pods", "default", "web-1") is not None
+        # open the budget after a moment; drain retries through
+        def open_budget():
+            _time.sleep(0.4)
+            pdb = cluster.get("poddisruptionbudgets", "default", "web-pdb")
+            cluster.update("poddisruptionbudgets",
+                           _dc.replace(pdb, disruptions_allowed=1))
+        threading.Thread(target=open_budget, daemon=True).start()
+        rc = kubectl.main(["-s", srv.url, "drain", "n1",
+                           "--timeout", "10"])
+        out = capsys.readouterr()
+        assert rc == 0 and "drained" in out.out
+        assert cluster.get("pods", "default", "web-1") is None
+        # the budget was consumed by the eviction
+        pdb = cluster.get("poddisruptionbudgets", "default", "web-pdb")
+        assert pdb.disruptions_allowed == 0
+        rc = kubectl.main(["-s", srv.url, "uncordon", "n1"])
+        assert rc == 0
+        assert not cluster.get("nodes", "", "n1").spec.unschedulable
+    finally:
+        srv.stop()
