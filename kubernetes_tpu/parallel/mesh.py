@@ -58,3 +58,37 @@ def replicate(tree, mesh: Mesh):
         return jax.device_put(arr, NamedSharding(mesh, P(*([None] * arr.ndim))))
 
     return jax.tree_util.tree_map(put, tree)
+
+
+POD_AXIS = "pods"
+
+
+def make_mesh_2d(pod_devices: int, node_devices: int) -> Mesh:
+    """2D (pods x nodes) mesh: the [B, N] filter/score grid shards BOTH
+    ways — the batch axis across one mesh dimension, every node-axis
+    column across the other.  The speculative engine's commit matmuls
+    ([B, B] incidence against per-node state) become XLA collectives
+    across the pod axis automatically; placements stay bit-identical to
+    the unsharded program (tests/test_mesh.py).  This is the layout that
+    scales BOTH a 100k-pod backlog and a 50k-node fleet past one chip's
+    HBM."""
+    devs = np.array(jax.devices()[: pod_devices * node_devices])
+    return Mesh(devs.reshape(pod_devices, node_devices),
+                (POD_AXIS, NODE_AXIS))
+
+
+def shard_pods(tree, mesh: Mesh, n_pods: int):
+    """Shard every batch-axis leaf (leading dim == the padded pod count)
+    over the mesh's pod axis; everything else replicates.  Use with
+    make_mesh_2d for 2D layouts (a 1D node mesh replicates pods via
+    `replicate`)."""
+
+    def put(x):
+        arr = np.asarray(x)
+        if arr.ndim >= 1 and arr.shape[0] == n_pods:
+            spec = P(POD_AXIS, *([None] * (arr.ndim - 1)))
+        else:
+            spec = P(*([None] * arr.ndim))
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, tree)

@@ -154,3 +154,52 @@ def test_speculative_engine_sharded_matches_unsharded():
         np.asarray(new_s.requested), np.asarray(new_ref.requested),
         rtol=0, atol=0,
     )
+
+
+def test_speculative_engine_2d_pods_by_nodes_mesh():
+    """SURVEY §2.4: shard the [B, N] grid BOTH ways — a 2x4 (pods x
+    nodes) mesh produces bit-identical placements to the unsharded
+    program (the commit-pass cross-pod matmuls become collectives over
+    the pod axis; XLA inserts them from the shardings alone)."""
+    import numpy as np
+
+    from kubernetes_tpu.codec import SnapshotEncoder
+    from kubernetes_tpu.models.batched import encode_batch_ports
+    from kubernetes_tpu.models.speculative import make_speculative_scheduler
+    from kubernetes_tpu.parallel.mesh import (
+        make_mesh_2d,
+        replicate,
+        shard_cluster,
+        shard_pods,
+    )
+    from fixtures import TEST_DIMS, make_node, make_pod
+
+    enc = SnapshotEncoder(TEST_DIMS)
+    for i in range(32):
+        enc.add_node(make_node(f"n{i}", cpu="8", mem="16Gi"))
+    enc.add_spread_selector("default", {"app": "w"})
+    fn = make_speculative_scheduler(
+        unsched_taint_key=enc.interner.intern(
+            "node.kubernetes.io/unschedulable"),
+        zone_key_id=enc.getzone_key)
+    pods = [make_pod(f"p{i}", cpu="200m", mem="128Mi",
+                     labels={"app": "w"}, owner=("ReplicaSet", "rs"))
+            for i in range(16)]
+    batch = enc.encode_pods(pods)
+    cluster = enc.snapshot()
+    ports = encode_batch_ports(enc, pods)
+    h_ref, _ = fn(cluster, batch, ports, np.int32(0))
+    h_ref = np.asarray(h_ref)
+
+    mesh = make_mesh_2d(2, 4)
+    B = np.asarray(batch.valid).shape[0]
+    cl_s = shard_cluster(cluster, mesh)
+    batch_s = shard_pods(batch, mesh, B)
+    ports_s = replicate(ports, mesh)
+    import jax
+
+    with mesh:
+        h_s, new_s = fn(cl_s, batch_s, ports_s, np.int32(0))
+    h_s = np.asarray(jax.block_until_ready(h_s))
+    np.testing.assert_array_equal(h_s, h_ref)
+    assert (h_s[:16] >= 0).all()
