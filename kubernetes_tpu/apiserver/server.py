@@ -706,7 +706,10 @@ class APIServer:
             # ------------------------------------------------------- GET
 
             def do_GET(self):
-                if self.path == "/healthz":
+                if self.path in ("/healthz", "/livez", "/readyz"):
+                    # healthz (legacy) + livez/readyz split
+                    # (apiserver/pkg/server/healthz): this single-process
+                    # server is ready exactly when it is alive
                     self._send_text(b"ok")
                     return
                 if self.path == "/metrics":
