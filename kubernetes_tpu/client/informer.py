@@ -353,6 +353,14 @@ def wire_scheduler_informers(factory: SharedInformerFactory,
     _defaults(factory.cluster, scheduler)
     cache = scheduler.cache
     queue = scheduler.queue
+    # responsibleForPod (eventhandlers.go:319-378): only pods naming
+    # THIS scheduler enter its queue
+    my_name = getattr(getattr(scheduler, "config", None),
+                      "scheduler_name", "default-scheduler")
+
+    def responsible(pod) -> bool:
+        return (getattr(pod.spec, "scheduler_name", "default-scheduler")
+                or "default-scheduler") == my_name
 
     def node_add(node):
         cache.add_node(node)
@@ -382,7 +390,7 @@ def wire_scheduler_informers(factory: SharedInformerFactory,
         if pod.spec.node_name:
             cache.add_pod(pod)
             queue.move_all_to_active()
-        else:
+        elif responsible(pod):
             queue.add(pod)
 
     def pod_update(_old, pod):
@@ -397,7 +405,8 @@ def wire_scheduler_informers(factory: SharedInformerFactory,
         else:
             cache.remove_pod(pod)
             queue.delete(pod)
-            queue.add(pod)
+            if responsible(pod):
+                queue.add(pod)
 
     def pod_delete(pod):
         if _terminal(pod):

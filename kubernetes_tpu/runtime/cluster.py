@@ -344,6 +344,15 @@ def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
     cache = scheduler.cache
     queue = scheduler.queue
     wire_scheduler_defaults(cluster, scheduler)
+    # responsibleForPod (eventhandlers.go:319-378): only pods naming THIS
+    # scheduler enter its queue; assigned pods feed the cache regardless
+    # (everyone's placements consume resources)
+    my_name = getattr(getattr(scheduler, "config", None),
+                      "scheduler_name", "default-scheduler")
+
+    def responsible(pod) -> bool:
+        return (getattr(pod.spec, "scheduler_name", "default-scheduler")
+                or "default-scheduler") == my_name
 
     def on_event(event: str, kind: str, obj) -> None:
         if kind == "nodes":
@@ -370,7 +379,7 @@ def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
                 if assigned:
                     cache.add_pod(obj)
                     queue.move_all_to_active()
-                else:
+                elif responsible(obj):
                     queue.add(obj)
             elif event == MODIFIED:
                 if assigned:
@@ -389,7 +398,8 @@ def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
                     cache.remove_pod(obj)
                     # spec update while pending: re-queue the fresh copy
                     queue.delete(obj)
-                    queue.add(obj)
+                    if responsible(obj):
+                        queue.add(obj)
             else:
                 if assigned:
                     cache.remove_pod(obj)

@@ -69,6 +69,9 @@ class SchedulerConfig:
     engine: str = "sequential"
     percentage_of_nodes_to_score: int = 100  # TPU path scans all; knob for parity
     disable_preemption: bool = False
+    # multi-scheduler: only pods whose spec.schedulerName names THIS
+    # scheduler enter its queue (eventhandlers.go responsibleForPod)
+    scheduler_name: str = "default-scheduler"
     weights: Optional[Sequence[float]] = None
     filter_config: FilterConfig = field(default_factory=FilterConfig)
     profile: Optional[object] = None  # config.SchedulingProfile; overrides
@@ -83,6 +86,7 @@ class SchedulerConfig:
             batch_window_s=cc.batch_window_s,
             percentage_of_nodes_to_score=cc.percentage_of_nodes_to_score,
             disable_preemption=cc.disable_preemption,
+            scheduler_name=cc.scheduler_name,
             weights=profile.weights_array(),
             filter_config=profile.filter_config,
             profile=profile,

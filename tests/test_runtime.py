@@ -175,3 +175,52 @@ def test_scheduler_end_to_end_cycle():
     q.add(make_pod("fail", cpu="100m"))
     sched.run_once(timeout=0.2)
     assert sched.results[-1].node is None
+
+
+def test_multi_scheduler_responsibility():
+    """eventhandlers.go responsibleForPod: two schedulers share one
+    store; each queues only pods naming it (spec.schedulerName), and
+    every ASSIGNED pod charges both caches regardless of who bound it."""
+    import dataclasses as _dc
+
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+    from kubernetes_tpu.runtime.cluster import LocalCluster, wire_scheduler
+    from kubernetes_tpu.runtime.queue import PriorityQueue
+    from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+    from fixtures import make_node, make_pod
+
+    from kubernetes_tpu.runtime.cluster import make_cluster_binder
+
+    cluster = LocalCluster()
+    bound = {"default": [], "gpu": []}
+    scheds = {}
+    for name in ("default-scheduler", "gpu-scheduler"):
+        short = "default" if name.startswith("default") else "gpu"
+        real_bind = make_cluster_binder(cluster)
+
+        def binder(p, n, short=short, real_bind=real_bind):
+            bound[short].append((p.name, n))
+            return real_bind(p, n)
+
+        s = Scheduler(
+            cache=SchedulerCache(), queue=PriorityQueue(),
+            binder=binder,
+            config=SchedulerConfig(scheduler_name=name),
+        )
+        wire_scheduler(cluster, s)
+        scheds[short] = s
+    cluster.add_node(make_node("n1", cpu="8", mem="16Gi"))
+    p_def = make_pod("web", cpu="100m")
+    p_gpu = make_pod("train", cpu="100m")
+    p_gpu = _dc.replace(p_gpu, spec=_dc.replace(
+        p_gpu.spec, scheduler_name="gpu-scheduler"))
+    cluster.add_pod(p_def)
+    cluster.add_pod(p_gpu)
+    scheds["default"].run_once(timeout=0.5)
+    scheds["gpu"].run_once(timeout=0.5)
+    assert [n for n, _ in bound["default"]] == ["web"]
+    assert [n for n, _ in bound["gpu"]] == ["train"]
+    # both caches account for BOTH bound pods (resources are global)
+    for s in scheds.values():
+        names = set(s.cache.encoder.pods)
+        assert ("default", "web") in names and ("default", "train") in names
