@@ -1410,6 +1410,88 @@ class APIServer:
                 except Exception as e:
                     self._status(422, "Invalid", f"{type(e).__name__}: {e}")
 
+            def do_PATCH(self):
+                """PATCH: application/merge-patch+json (RFC 7386, null
+                deletes a key — also accepted for strategic-merge, the
+                closest semantics this object model has) or
+                application/json-patch+json (RFC 6902) — apimachinery
+                types.PatchType.  Applies against the stored wire form,
+                then rides the normal UPDATE pipeline (admission +
+                validation + CAS against the revision read here)."""
+                r = outer._route(self.path)
+                if r is None or not r[2]:
+                    self._status(404, "NotFound", self.path)
+                    return
+                kind, ns, name, sub = r
+                if self._authorize(
+                    "patch", f"{kind}/{sub}" if sub else kind, ns, name
+                ) is None:
+                    return
+                try:
+                    patch = self._body()
+                except ValueError:
+                    self._status(400, "BadRequest", "invalid JSON")
+                    return
+                cur, rv = outer.cluster.get_with_rv(kind, ns, name)
+                if cur is None:
+                    self._status(404, "NotFound", f"{kind} {ns}/{name}")
+                    return
+                body = dict(object_to_dict(kind, cur))
+                ctype = self.headers.get("Content-Type", "")
+                try:
+                    if "json-patch" in ctype:
+                        from kubernetes_tpu.apiserver.webhooks import (
+                            apply_json_patch,
+                        )
+
+                        body = apply_json_patch(body, patch)
+                    else:
+                        def merge(dst, src):
+                            out = dict(dst)
+                            for k, v in src.items():
+                                if v is None:
+                                    out.pop(k, None)
+                                elif (isinstance(v, dict)
+                                      and isinstance(out.get(k), dict)):
+                                    out[k] = merge(out[k], v)
+                                else:
+                                    out[k] = v
+                            return out
+
+                        body = merge(body, patch)
+                except Exception as e:
+                    self._status(422, "Invalid", f"patch failed: {e}")
+                    return
+                try:
+                    meta = body.setdefault("metadata", {})
+                    if ns and not meta.get("namespace"):
+                        meta["namespace"] = ns
+                    meta["name"] = name  # a patch cannot rename
+                    body = outer._admit_split("UPDATE", kind, body,
+                                              locked=False)
+                    with outer._write_lock:
+                        body = outer._admit_split("UPDATE", kind, body,
+                                                  locked=True)
+                        outer._validate_extension(kind, body)
+                        obj = _decode(kind, body)
+                        if kind in (
+                            "replicasets", "deployments", "jobs"
+                        ) and not meta.get("uid"):
+                            if cur is not None and hasattr(cur, "uid"):
+                                obj.uid = cur.uid
+                        new_rv = outer.cluster.update(kind, obj,
+                                                      expect_rv=rv)
+                    out = dict(object_to_dict(kind, obj))
+                    out["metadata"] = dict(out.get("metadata") or {})
+                    out["metadata"]["resourceVersion"] = str(new_rv)
+                    self._send(out)
+                except AdmissionDenied as e:
+                    self._status(403, "Forbidden", str(e))
+                except ConflictError as e:
+                    self._status(409, "Conflict", str(e))
+                except Exception as e:
+                    self._status(422, "Invalid", f"{type(e).__name__}: {e}")
+
             def do_PUT(self):
                 r = outer._route(self.path)
                 if r is not None and r[0] == "@proxy":
