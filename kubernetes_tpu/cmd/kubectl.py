@@ -267,6 +267,21 @@ def main(argv=None) -> int:
     tp.add_argument("what", choices=("nodes", "node", "pods", "pod"))
     tp.add_argument("name", nargs="?", default="")
 
+    pa = sub.add_parser("patch", parents=[common])
+    pa.add_argument("kind")
+    pa.add_argument("name")
+    pa.add_argument("-p", "--patch", required=True,
+                    help="JSON merge patch (or a JSON list for --type json)")
+    pa.add_argument("--type", dest="patch_type", default="merge",
+                    choices=("merge", "strategic", "json"))
+
+    for verb in ("label", "annotate"):
+        lv = sub.add_parser(verb, parents=[common])
+        lv.add_argument("kind")
+        lv.add_argument("name")
+        lv.add_argument("pairs", nargs="+",
+                        help="key=value to set, key- to remove")
+
     for verb in ("cordon", "uncordon"):
         cv = sub.add_parser(verb, parents=[common])
         cv.add_argument("node")
@@ -542,6 +557,56 @@ def main(argv=None) -> int:
             return 1
         text = out.get("log", "") if isinstance(out, dict) else str(out)
         sys.stdout.write(text)
+        return 0
+
+    if args.verb in ("patch", "label", "annotate"):
+        import urllib.error
+        import urllib.request
+
+        path = _resolve_path(args.server, args.kind, ns, args.name)
+        if args.verb == "patch":
+            try:
+                payload = json.loads(args.patch)
+            except ValueError as e:
+                print(f"error: invalid patch JSON: {e}", file=sys.stderr)
+                return 1
+            ctype = ("application/json-patch+json"
+                     if args.patch_type == "json"
+                     else "application/merge-patch+json")
+        else:
+            field = "labels" if args.verb == "label" else "annotations"
+            kv = {}
+            for pair in args.pairs:
+                if pair.endswith("-"):
+                    kv[pair[:-1]] = None  # merge-patch null deletes
+                else:
+                    k, sep, v = pair.partition("=")
+                    if not sep:
+                        print(f"error: {pair!r} is not key=value or key-",
+                              file=sys.stderr)
+                        return 1
+                    kv[k] = v
+            payload = {"metadata": {field: kv}}
+            ctype = "application/merge-patch+json"
+        req = urllib.request.Request(
+            args.server.rstrip("/") + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": ctype,
+                     **({"Authorization": f"Bearer {_TOKEN}"}
+                        if _TOKEN else {})},
+            method="PATCH")
+        from kubernetes_tpu.cmd.base import tls_urlopen
+
+        try:
+            with tls_urlopen(req, timeout=30):
+                pass
+        except urllib.error.HTTPError as e:
+            print(e.read().decode(errors="replace"), file=sys.stderr)
+            return 1
+        short = args.kind[:-1] if args.kind.endswith("s") else args.kind
+        done = {"patch": "patched", "label": "labeled",
+                "annotate": "annotated"}[args.verb]
+        print(f"{short}/{args.name} {done}")
         return 0
 
     if args.verb in ("cordon", "uncordon"):

@@ -338,3 +338,36 @@ def test_kubectl_cordon_drain_with_pdb(capsys):
         assert not cluster.get("nodes", "", "n1").spec.unschedulable
     finally:
         srv.stop()
+
+
+def test_kubectl_patch_label_annotate(capsys):
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cmd import kubectl
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+    from fixtures import make_pod
+
+    cluster = LocalCluster()
+    cluster.add_pod(make_pod("web", cpu="100m", labels={"app": "web"}))
+    srv = APIServer(cluster=cluster).start()
+    try:
+        rc = kubectl.main(["-s", srv.url, "label", "pods", "web",
+                           "tier=frontend", "app-"])
+        assert rc == 0 and "labeled" in capsys.readouterr().out
+        pod = cluster.get("pods", "default", "web")
+        assert pod.labels == {"tier": "frontend"}
+        rc = kubectl.main(["-s", srv.url, "annotate", "pods", "web",
+                           "owner=team-a"])
+        assert rc == 0
+        pod = cluster.get("pods", "default", "web")
+        assert pod.metadata.annotations.get("owner") == "team-a"
+        rc = kubectl.main([
+            "-s", srv.url, "patch", "pods", "web", "--type", "json",
+            "-p", '[{"op": "add", "path": "/metadata/labels/x",'
+                  ' "value": "1"}]'])
+        assert rc == 0 and "patched" in capsys.readouterr().out
+        assert cluster.get("pods", "default", "web").labels["x"] == "1"
+        rc = kubectl.main(["-s", srv.url, "patch", "pods", "ghost",
+                           "-p", '{"metadata": {}}'])
+        assert rc == 1
+    finally:
+        srv.stop()
