@@ -40,6 +40,8 @@ def meta_to_dict(m) -> dict:
         ]
     if m.deletion_timestamp is not None:
         out["deletionTimestamp"] = m.deletion_timestamp
+    if m.finalizers:
+        out["finalizers"] = list(m.finalizers)
     return _drop_empty(out)
 
 

@@ -35,6 +35,17 @@ _SRC_ID_FIELD = {
 }
 
 
+def _storage_meta(meta: "ObjectMeta", namespaced: bool) -> dict:
+    out = {"name": meta.name, "labels": dict(meta.labels)}
+    if namespaced:
+        out["namespace"] = meta.namespace
+    if meta.deletion_timestamp is not None:
+        out["deletionTimestamp"] = meta.deletion_timestamp
+    if meta.finalizers:
+        out["finalizers"] = list(meta.finalizers)
+    return out
+
+
 @dataclass
 class PersistentVolume:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
@@ -89,8 +100,7 @@ class PersistentVolume:
             spec["claimRef"] = {"namespace": ns, "name": nm}
         return {
             "kind": "PersistentVolume", "apiVersion": "v1",
-            "metadata": {"name": self.metadata.name,
-                         "labels": dict(self.metadata.labels)},
+            "metadata": _storage_meta(self.metadata, namespaced=False),
             "spec": spec,
             "status": {"phase": self.phase},
         }
@@ -162,9 +172,7 @@ class PersistentVolumeClaim:
     def to_dict(self) -> dict:
         return {
             "kind": "PersistentVolumeClaim", "apiVersion": "v1",
-            "metadata": {"name": self.metadata.name,
-                         "namespace": self.metadata.namespace,
-                         "labels": dict(self.metadata.labels)},
+            "metadata": _storage_meta(self.metadata, namespaced=True),
             "spec": {
                 "storageClassName": self.storage_class,
                 "volumeName": self.volume_name,

@@ -154,8 +154,16 @@ class PersistentCluster(LocalCluster):
 
     def update(self, kind: str, obj, expect_rv: Optional[int] = None) -> int:
         with self._lock:
+            key = self._key(kind, obj)
             rv = super().update(kind, obj, expect_rv=expect_rv)
-            self._append(rv, "update", kind, obj=obj)
+            if key not in self._store[kind]:
+                # removing the LAST finalizer from a terminating object
+                # completes the deferred deletion (cluster.py update):
+                # the durable record must be the delete, not an update a
+                # replay would resurrect
+                self._append(rv, "delete", kind, obj=obj, key=key)
+            else:
+                self._append(rv, "update", kind, obj=obj)
             return rv
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -163,10 +171,18 @@ class PersistentCluster(LocalCluster):
             key = (namespace if kind != "nodes" else "", name)
             cur = self._store[kind].get(key)
             super().delete(kind, namespace, name)
-            if cur is not None:
-                # WAL records the key; the in-memory event history keeps the
-                # full object so watch_from replays the same payload live
-                # watchers saw
+            if cur is None:
+                return
+            after = self._store[kind].get(key)
+            if after is not None:
+                # finalizer-gated: the store only MARKED the object
+                # terminating — persist that mutation, NOT a delete a
+                # replay would apply eagerly
+                self._append(self._rv, "update", kind, obj=after.obj)
+            else:
+                # WAL records the key; the in-memory event history keeps
+                # the full object so watch_from replays the same payload
+                # live watchers saw
                 self._append(self._rv, "delete", kind, obj=cur.obj, key=key)
 
     # --------------------------------------------------- snapshot / compact

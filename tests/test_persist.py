@@ -171,3 +171,42 @@ def test_watch_from_after_restart_with_snapshot_plus_tail(tmp_path):
     with pytest.raises(CompactedError):
         c2.watch_from(snap_rv - 1, lambda *a: None)
     c2.close()
+
+
+def test_finalizer_gated_delete_survives_restart(tmp_path):
+    """Round-4 regression: a finalizer-gated DELETE must persist as the
+    terminating MUTATION (not an eager delete), and the finalizer-
+    removing update that completes deletion must persist as a delete —
+    otherwise a restart resurrects or loses the object."""
+    import dataclasses
+
+    from kubernetes_tpu.api.resource import parse_quantity
+    from kubernetes_tpu.api.storage import PersistentVolumeClaim
+    from kubernetes_tpu.api.types import ObjectMeta
+    from kubernetes_tpu.runtime.persist import PersistentCluster
+
+    d = str(tmp_path / "data")
+    c1 = PersistentCluster(d)
+    pvc = PersistentVolumeClaim(
+        metadata=ObjectMeta(namespace="default", name="data",
+                            finalizers=("kubernetes.io/pvc-protection",)),
+        request=parse_quantity("1Gi"),
+    )
+    c1.create("persistentvolumeclaims", pvc)
+    c1.delete("persistentvolumeclaims", "default", "data")
+    got = c1.get("persistentvolumeclaims", "default", "data")
+    assert got is not None and got.metadata.deletion_timestamp is not None
+    c1.close()
+    # restart: the terminating object is still there, still terminating
+    c2 = PersistentCluster(d)
+    got = c2.get("persistentvolumeclaims", "default", "data")
+    assert got is not None, "finalized delete must not replay as removal"
+    assert got.metadata.deletion_timestamp is not None
+    # lift the finalizer -> real deletion, durable across another restart
+    c2.update("persistentvolumeclaims", dataclasses.replace(
+        got, metadata=dataclasses.replace(got.metadata, finalizers=())))
+    assert c2.get("persistentvolumeclaims", "default", "data") is None
+    c2.close()
+    c3 = PersistentCluster(d)
+    assert c3.get("persistentvolumeclaims", "default", "data") is None
+    c3.close()
