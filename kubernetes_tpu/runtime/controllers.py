@@ -1442,13 +1442,17 @@ class DaemonSetController(Reconciler):
 
 @dataclass
 class StatefulSet:
-    """apps/v1 StatefulSet slice: ordered, stable-identity replicas."""
+    """apps/v1 StatefulSet slice: ordered, stable-identity replicas.
+    volume_claim_templates: PVC dicts (spec form) stamped per ordinal as
+    <template-name>-<set>-<ordinal>, retained on scale-down (the
+    reference never deletes them)."""
 
     namespace: str
     name: str
     replicas: int
     selector: Dict[str, str]
     template: dict
+    volume_claim_templates: Tuple[dict, ...] = ()
     uid: str = field(default_factory=lambda: uuid.uuid4().hex)
 
     @property
@@ -1522,6 +1526,41 @@ class StatefulSetController(Reconciler):
                  "controller": True}
             ]
             d["metadata"] = meta
+            # per-ordinal PVCs from volumeClaimTemplates (statefulset
+            # pod_control.go createPersistentVolumeClaims): claim name
+            # <template>-<set>-<ordinal>; the pod mounts it by that name
+            if st.volume_claim_templates:
+                from kubernetes_tpu.api.storage import (
+                    PersistentVolumeClaim,
+                )
+
+                spec_d = dict(d.get("spec") or {})
+                vols = list(spec_d.get("volumes") or [])
+                for tmpl in st.volume_claim_templates:
+                    t_meta = tmpl.get("metadata") or {}
+                    t_name = t_meta.get("name", "data")
+                    claim_name = f"{t_name}-{st.name}-{i}"
+                    if self.cluster.get("persistentvolumeclaims", ns,
+                                        claim_name) is None:
+                        body = {
+                            "metadata": {"name": claim_name,
+                                         "namespace": ns},
+                            "spec": tmpl.get("spec") or {},
+                        }
+                        try:
+                            self.cluster.create(
+                                "persistentvolumeclaims",
+                                PersistentVolumeClaim.from_dict(body))
+                        except ConflictError:
+                            pass
+                    if not any(v.get("name") == t_name for v in vols):
+                        vols.append({
+                            "name": t_name,
+                            "persistentVolumeClaim": {
+                                "claimName": claim_name},
+                        })
+                spec_d["volumes"] = vols
+                d["spec"] = spec_d
             try:
                 self.cluster.create("pods", Pod.from_dict(d))
             except ConflictError:

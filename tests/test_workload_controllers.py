@@ -466,3 +466,64 @@ def test_hpa_tolerance_band_suppresses_rescale():
     hpa_ctrl.usage_fn = lambda p: 1.2 * HPAController._requests_usage(p)
     hpa_ctrl.tick()
     assert cluster.get("deployments", "default", "web").replicas == 4
+
+
+def test_statefulset_volume_claim_templates():
+    """pod_control.go createPersistentVolumeClaims: each ordinal gets
+    <template>-<set>-<ordinal> PVCs, the pod mounts them by template
+    name, and scale-down RETAINS the claims."""
+    import dataclasses as _dc
+
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+    from kubernetes_tpu.runtime.controllers import (
+        StatefulSet,
+        StatefulSetController,
+    )
+    from kubernetes_tpu.api.types import PodStatus
+
+    cluster = LocalCluster()
+    ctrl = StatefulSetController(cluster)
+    st = StatefulSet(
+        "default", "db", 2, {"app": "db"},
+        {"metadata": {"labels": {"app": "db"}},
+         "spec": {"containers": [{"name": "c", "image": "pg"}]}},
+        volume_claim_templates=(
+            {"metadata": {"name": "data"},
+             "spec": {"resources": {"requests": {"storage": "1Gi"}},
+                      "storageClassName": "fast"}},
+        ),
+    )
+    cluster.create("statefulsets", st)
+
+    def drain():
+        for _ in range(20):
+            if not ctrl.process_one(timeout=0.01):
+                break
+            # hollow kubelet: run whatever was created
+            for p in list(cluster.list("pods")):
+                if p.status.phase != "Running":
+                    cluster.update("pods", _dc.replace(
+                        p, status=PodStatus(phase="Running")))
+
+    drain()
+    assert cluster.get("pods", "default", "db-0") is not None
+    assert cluster.get("pods", "default", "db-1") is not None
+    for i in (0, 1):
+        pvc = cluster.get("persistentvolumeclaims", "default",
+                          f"data-db-{i}")
+        assert pvc is not None
+        from kubernetes_tpu.api.resource import parse_quantity
+
+        assert pvc.request == parse_quantity("1Gi")
+        assert pvc.storage_class == "fast"
+        pod = cluster.get("pods", "default", f"db-{i}")
+        claims = [(v.get("persistentVolumeClaim") or {}).get("claimName")
+                  for v in pod.spec.volumes]
+        assert f"data-db-{i}" in claims
+    # scale down: pod goes, the claim stays
+    st2 = cluster.get("statefulsets", "default", "db")
+    cluster.update("statefulsets", _dc.replace(st2, replicas=1))
+    drain()
+    assert cluster.get("pods", "default", "db-1") is None
+    assert cluster.get("persistentvolumeclaims", "default",
+                       "data-db-1") is not None
