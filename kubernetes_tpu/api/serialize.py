@@ -290,16 +290,20 @@ def object_to_dict(kind: str, obj) -> dict:
             },
         }
     if kind == "statefulsets":
+        st_spec = {
+            "replicas": obj.replicas,
+            "selector": {"matchLabels": dict(obj.selector)},
+            "template": obj.template,
+        }
+        if getattr(obj, "volume_claim_templates", ()):
+            st_spec["volumeClaimTemplates"] = [
+                dict(t) for t in obj.volume_claim_templates]
         return {
             "kind": "StatefulSet",
             "apiVersion": "apps/v1",
             "metadata": {"name": obj.name, "namespace": obj.namespace,
                          "uid": obj.uid},
-            "spec": {
-                "replicas": obj.replicas,
-                "selector": {"matchLabels": dict(obj.selector)},
-                "template": obj.template,
-            },
+            "spec": st_spec,
         }
     if kind == "cronjobs":
         return {

@@ -224,6 +224,8 @@ def _decode(kind: str, d: dict):
             replicas=int(spec.get("replicas", 1)),
             selector=dict((spec.get("selector") or {}).get("matchLabels") or {}),
             template=spec.get("template") or {},
+            volume_claim_templates=tuple(
+                spec.get("volumeClaimTemplates") or ()),
         )
         if meta.get("uid"):
             st.uid = meta["uid"]
