@@ -133,6 +133,53 @@ def _manifest_path(server: str, obj: dict, ns: str) -> "tuple[str, str]":
     raise SystemExit(f"error: unknown resource kind {obj.get('kind')!r}")
 
 
+def _follow_watch(args, ns: str) -> int:
+    """`kubectl get KIND -w`: follow the server's chunked watch stream
+    (JSON lines), print rows for events matching the requested kind +
+    namespace (the stream itself is the all-kinds firehose this server
+    serves; filtering is client-side, like the reflector's)."""
+    import time as _time
+    import urllib.request
+
+    from kubernetes_tpu.cmd.base import tls_urlopen
+
+    want_kind = _ALIASES.get(args.kind, args.kind)
+    req = urllib.request.Request(
+        args.server.rstrip("/") + "/api/v1/watch",
+        headers=({"Authorization": f"Bearer {_TOKEN}"} if _TOKEN else {}))
+    deadline = (_time.monotonic() + args.watch_seconds
+                if args.watch_seconds else None)
+    try:
+        with tls_urlopen(req, timeout=30) as resp:
+            for raw in resp:
+                if deadline is not None and _time.monotonic() > deadline:
+                    break
+                line = raw.strip()
+                if not line:
+                    continue  # heartbeat chunk
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("kind") != want_kind:
+                    continue
+                obj = ev.get("object") or {}
+                meta = obj.get("metadata") or {}
+                if ns and meta.get("namespace", obj.get(
+                        "namespace", "")) not in ("", ns):
+                    continue
+                row = (_node_row(obj) if want_kind == "nodes"
+                       else _pod_row(obj))
+                print(f"{ev.get('type', ''):<10}" + "  ".join(
+                    str(c) for c in row), flush=True)
+    except KeyboardInterrupt:
+        pass
+    except Exception as e:
+        print(f"watch ended: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
 LAST_APPLIED = "kubectl.kubernetes.io/last-applied-configuration"
 
 
@@ -226,6 +273,11 @@ def main(argv=None) -> int:
                    help="label selector, e.g. app=web,tier!=db")
     g.add_argument("--field-selector", default="",
                    help="field selector, e.g. spec.nodeName=n1")
+    g.add_argument("-w", "--watch", action="store_true",
+                   help="after listing, follow the watch stream and "
+                   "print changes as they land")
+    g.add_argument("--watch-seconds", type=float, default=0.0,
+                   help="stop watching after this long (0 = forever)")
 
     c = sub.add_parser("create", parents=[common])
     c.add_argument("-f", "--filename", required=True)
@@ -349,6 +401,8 @@ def main(argv=None) -> int:
         else:
             _print_table([_pod_row(i) for i in items],
                          ("NAMESPACE", "NAME", "STATUS", "NODE"))
+        if getattr(args, "watch", False):
+            return _follow_watch(args, ns)
         return 0
 
     if args.verb == "create":

@@ -371,3 +371,34 @@ def test_kubectl_patch_label_annotate(capsys):
         assert rc == 1
     finally:
         srv.stop()
+
+
+def test_kubectl_get_watch_streams_changes(capsys):
+    import threading
+    import time as _time
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cmd import kubectl
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+    from fixtures import make_pod
+
+    cluster = LocalCluster()
+    cluster.add_pod(make_pod("web", cpu="100m"))
+    srv = APIServer(cluster=cluster).start()
+
+    def later():
+        _time.sleep(0.4)
+        cluster.add_pod(make_pod("late-arrival", cpu="100m"))
+        _time.sleep(0.2)
+        cluster.delete("pods", "default", "web")
+
+    threading.Thread(target=later, daemon=True).start()
+    try:
+        rc = kubectl.main(["-s", srv.url, "get", "pods", "-w",
+                           "--watch-seconds", "1.5"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "ADDED" in out and "late-arrival" in out
+        assert "DELETED" in out
+    finally:
+        srv.stop()
