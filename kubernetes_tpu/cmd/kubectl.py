@@ -319,6 +319,9 @@ def main(argv=None) -> int:
     tp.add_argument("what", choices=("nodes", "node", "pods", "pod"))
     tp.add_argument("name", nargs="?", default="")
 
+    sub.add_parser("api-resources", parents=[common])
+    sub.add_parser("api-versions", parents=[common])
+
     pa = sub.add_parser("patch", parents=[common])
     pa.add_argument("kind")
     pa.add_argument("name")
@@ -611,6 +614,37 @@ def main(argv=None) -> int:
             return 1
         text = out.get("log", "") if isinstance(out, dict) else str(out)
         sys.stdout.write(text)
+        return 0
+
+    if args.verb == "api-versions":
+        out = _req(args.server, "GET", "/apis")
+        if out.get("kind") == "Status":
+            print(out.get("message", ""), file=sys.stderr)
+            return 1
+        print("v1")
+        for g in out.get("groups") or []:
+            for v in g.get("versions") or []:
+                print(v.get("groupVersion", ""))
+        return 0
+
+    if args.verb == "api-resources":
+        rows = []
+        for kind in sorted(_scheme.kinds()):
+            gvk = _scheme.gvk_for(kind)
+            rows.append((kind, gvk.group or "v1", gvk.kind,
+                         "false" if _scheme.is_cluster_scoped(kind)
+                         else "true"))
+        # CRDs join through discovery
+        out = _req(args.server, "GET", "/api/v1/customresourcedefinitions")
+        for crd in out.get("items") or []:
+            spec = crd.get("spec") or {}
+            names = spec.get("names") or {}
+            rows.append((
+                names.get("plural", ""), spec.get("group", ""),
+                names.get("kind", ""),
+                "false" if spec.get("scope") == "Cluster" else "true",
+            ))
+        _print_table(rows, ("NAME", "APIGROUP", "KIND", "NAMESPACED"))
         return 0
 
     if args.verb in ("patch", "label", "annotate"):

@@ -402,3 +402,29 @@ def test_kubectl_get_watch_streams_changes(capsys):
         assert "DELETED" in out
     finally:
         srv.stop()
+
+
+def test_kubectl_api_resources_and_versions(capsys):
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cmd import kubectl
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+
+    cluster = LocalCluster()
+    cluster.create("customresourcedefinitions", {
+        "namespace": "", "name": "widgets.example.com",
+        "spec": {"group": "example.com", "version": "v1",
+                 "names": {"plural": "widgets", "kind": "Widget"},
+                 "scope": "Namespaced"},
+    })
+    srv = APIServer(cluster=cluster).start()
+    try:
+        rc = kubectl.main(["-s", srv.url, "api-resources"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "pods" in out and "deployments" in out
+        assert "widgets" in out and "example.com" in out
+        rc = kubectl.main(["-s", srv.url, "api-versions"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "v1" in out and "apps/v1" in out
+    finally:
+        srv.stop()
