@@ -321,6 +321,7 @@ def main(argv=None) -> int:
 
     sub.add_parser("api-resources", parents=[common])
     sub.add_parser("api-versions", parents=[common])
+    sub.add_parser("version", parents=[common])
 
     pa = sub.add_parser("patch", parents=[common])
     pa.add_argument("kind")
@@ -614,6 +615,19 @@ def main(argv=None) -> int:
             return 1
         text = out.get("log", "") if isinstance(out, dict) else str(out)
         sys.stdout.write(text)
+        return 0
+
+    if args.verb == "version":
+        from kubernetes_tpu import __version__
+
+        print(f"Client Version: kubernetes-tpu v{__version__}")
+        cm = _req(args.server, "GET",
+                  "/api/v1/namespaces/kube-system/configmaps/"
+                  "cluster-version")
+        server_v = ((cm.get("data") or {}).get("version")
+                    if cm.get("kind") != "Status" else None)
+        print(f"Server Version: kubernetes-tpu "
+              f"v{server_v or __version__}")
         return 0
 
     if args.verb == "api-versions":
