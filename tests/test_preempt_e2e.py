@@ -207,3 +207,42 @@ def test_nominated_anti_affinity_blocks_pass_one():
     queue.add(web)
     _drain(sched, cycles=3)
     assert ("web", "zb1") in bound         # zone a is claimed against web
+
+
+def test_preempt_end_to_end_speculative_engine():
+    """The same preemption -> nominated-claim flow with the SPECULATIVE
+    engine (r04: it carries nominated resource claims in the commit
+    pass, so the runtime routes every batch through it)."""
+    bound = []
+    deleted = []
+    cache = SchedulerCache()
+    queue = PriorityQueue(backoff=PodBackoff(initial=0.01,
+                                             max_duration=0.05))
+    sched = Scheduler(
+        cache=cache, queue=queue,
+        binder=lambda pod, node: bound.append((pod.name, node)) or True,
+        config=SchedulerConfig(engine="speculative"),
+        victim_deleter=lambda pod: deleted.append(pod.name)
+        or cache.remove_pod(pod),
+    )
+    cache.add_node(make_node("n1", cpu="1", mem="4Gi"))
+    cache.add_node(make_node("n2", cpu="1", mem="4Gi"))
+    cache.add_pod(make_pod("low-a", cpu="600m", node_name="n1", priority=1))
+    cache.add_pod(make_pod("low-b", cpu="600m", node_name="n2", priority=2))
+    boss = make_pod("boss", cpu="800m", priority=100)
+    queue.add(boss)
+    _drain(sched)
+    assert deleted == ["low-a"]
+    assert ("boss", "n1") in bound
+    # a later lower-priority pod must NOT squeeze into a nominated
+    # claim while a preemptor waits (pass-one semantics, now enforced by
+    # the speculative commit check): nominate a fresh waiting preemptor
+    # on n2 whose claim fills the node
+    waiter = make_pod("waiter", cpu="900m", priority=100)
+    queue.update_nominated_pod(waiter, "n2")
+    cache.remove_pod(make_pod("low-b", cpu="600m", node_name="n2",
+                              priority=2))  # its victim already evicted
+    sneak = make_pod("sneak", cpu="900m", priority=0)
+    queue.add(sneak)
+    _drain(sched)
+    assert ("sneak", "n2") not in bound
