@@ -1033,6 +1033,20 @@ class APIServer:
                     definitions[f"io.k8s.api.{gvk.group or 'core'}."
                                 f"{gvk.version}.{gvk.kind}"] = {
                         "type": "object",
+                        "description": f"{gvk.kind} "
+                        f"({gvk.group or 'core'}/{gvk.version}), served "
+                        f"at {coll}",
+                        # the universal envelope every kind shares
+                        # (kubectl explain's top level); per-field depth
+                        # lives in the typed models (api/types.py,
+                        # api/corev1.py)
+                        "properties": {
+                            "apiVersion": {"type": "string"},
+                            "kind": {"type": "string"},
+                            "metadata": {"type": "object"},
+                            "spec": {"type": "object"},
+                            "status": {"type": "object"},
+                        },
                         "x-kubernetes-group-version-kind": [{
                             "group": gvk.group, "version": gvk.version,
                             "kind": gvk.kind,

@@ -323,6 +323,9 @@ def main(argv=None) -> int:
     sub.add_parser("api-versions", parents=[common])
     sub.add_parser("version", parents=[common])
 
+    ex = sub.add_parser("explain", parents=[common])
+    ex.add_argument("kind")
+
     pa = sub.add_parser("patch", parents=[common])
     pa.add_argument("kind")
     pa.add_argument("name")
@@ -615,6 +618,41 @@ def main(argv=None) -> int:
             return 1
         text = out.get("log", "") if isinstance(out, dict) else str(out)
         sys.stdout.write(text)
+        return 0
+
+    if args.verb == "explain":
+        # pkg/kubectl/explain off /openapi/v2: resolve the kind's
+        # definition and print its top-level fields
+        doc = _req(args.server, "GET", "/openapi/v2")
+        if doc.get("kind") == "Status":
+            print(doc.get("message", ""), file=sys.stderr)
+            return 1
+        plural = _ALIASES.get(args.kind, args.kind)
+        wire = None
+        try:
+            wire = _scheme.gvk_for(plural).kind
+        except KeyError:
+            wire = args.kind.capitalize()
+        hit = None
+        for name, d in (doc.get("definitions") or {}).items():
+            if name.rsplit(".", 1)[-1].lower() == wire.lower():
+                hit = (name, d)
+                break
+        if hit is None:
+            print(f"error: no schema found for {args.kind!r}",
+                  file=sys.stderr)
+            return 1
+        name, d = hit
+        print(f"KIND:     {wire}\nRESOURCE: {plural}\n")
+        print(d.get("description", "").strip() or "(no description)")
+        props = d.get("properties") or {}
+        if props:
+            print("\nFIELDS:")
+            for k in sorted(props):
+                p = props[k]
+                t = p.get("type") or p.get("$ref", "").rsplit(
+                    ".", 1)[-1] or "Object"
+                print(f"  {k:<24}<{t}>")
         return 0
 
     if args.verb == "version":

@@ -428,3 +428,22 @@ def test_kubectl_api_resources_and_versions(capsys):
         assert rc == 0 and "v1" in out and "apps/v1" in out
     finally:
         srv.stop()
+
+
+def test_kubectl_explain_and_version(capsys):
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cmd import kubectl
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+
+    srv = APIServer(cluster=LocalCluster()).start()
+    try:
+        rc = kubectl.main(["-s", srv.url, "explain", "pods"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "KIND:     Pod" in out and "spec" in out
+        rc = kubectl.main(["-s", srv.url, "explain", "nosuchkind"])
+        assert rc == 1
+        rc = kubectl.main(["-s", srv.url, "version"])
+        out = capsys.readouterr()
+        assert rc == 0 and "Client Version" in out.out
+    finally:
+        srv.stop()
