@@ -133,6 +133,14 @@ class RemoteCluster:
             d["metadata"]["resourceVersion"] = str(expect_rv)
         return d
 
+    def add_node(self, node) -> None:
+        """LocalCluster helper parity (the hollow kubelet registers
+        through whichever store surface it is handed)."""
+        self.create("nodes", node)
+
+    def add_pod(self, pod) -> None:
+        self.create("pods", pod)
+
     def create(self, kind: str, obj) -> int:
         ns, name = LocalCluster._key(kind, obj)
         path = scheme.rest_path(kind, ns or "default")

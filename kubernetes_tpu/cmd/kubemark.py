@@ -1,0 +1,111 @@
+"""Hollow-node binary: kubemark against a REMOTE control plane.
+
+Reference: cmd/kubemark/hollow-node.go — a process hosting N hollow
+kubelets (real sync loops, fake runtime) pointed at a real apiserver;
+test/kubemark launches thousands to measure 5k-node control-plane
+behavior without machines.
+
+This binary is that shape over this framework's client stack: a
+RemoteCluster (reflector mirror + REST writes) presents the store
+surface, and HollowFleet runs the REAL Kubelet sync loops (claim ->
+CRI sandbox -> Running status -> lease heartbeat) against it.
+
+    python -m kubernetes_tpu.cmd.kubemark --server http://H:P \
+        --nodes 100 [--name-prefix hollow] [--token T] [--one-shot]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+
+from kubernetes_tpu.cmd.base import (
+    add_common_flags,
+    apply_platform,
+    wait_for_term,
+)
+from kubernetes_tpu.utils import klog
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kubemark (kubernetes-tpu)")
+    add_common_flags(p)
+    p.add_argument("--server", required=True)
+    p.add_argument("--token", default="",
+                   help="bearer credential (RBAC planes)")
+    p.add_argument("--nodes", type=int, default=10)
+    p.add_argument("--name-prefix", default="hollow")
+    p.add_argument("--cpu", default="8")
+    p.add_argument("--memory", default="32Gi")
+    p.add_argument("--heartbeat", type=float, default=5.0,
+                   help="lease renewal period seconds")
+    p.add_argument("--one-shot", action="store_true",
+                   help="register, run one sync sweep + heartbeat, exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    apply_platform(args.platform, args.verbosity)
+
+    from kubernetes_tpu.api.resource import parse_quantity
+    from kubernetes_tpu.api.types import Node, NodeSpec, NodeStatus, ObjectMeta
+    from kubernetes_tpu.client.remote import RemoteCluster
+    from kubernetes_tpu.runtime.kubemark import HollowFleet
+
+    remote = RemoteCluster(args.server, token=args.token).start()
+    if not remote.wait_for_sync(15.0):
+        print("error: control plane never synced", file=sys.stderr)
+        return 1
+    caps = {
+        "cpu": parse_quantity(args.cpu),
+        "memory": parse_quantity(args.memory),
+        "pods": parse_quantity("110"),
+    }
+    nodes = [
+        Node(
+            metadata=ObjectMeta(
+                name=f"{args.name_prefix}-{i}", namespace="",
+                labels={"kubernetes.io/hostname": f"{args.name_prefix}-{i}"},
+            ),
+            spec=NodeSpec(),
+            status=NodeStatus(allocatable=dict(caps), capacity=dict(caps),
+                              conditions={"Ready": "True"}),
+        )
+        for i in range(args.nodes)
+    ]
+    # skip nodes that already exist (process restart over a live fleet)
+    fresh = [n for n in nodes if remote.get("nodes", "", n.name) is None]
+    fleet = HollowFleet(remote, fresh)
+    klog.infof("[kubemark] %d hollow nodes registered (%d pre-existing) "
+               "against %s", len(fresh), len(nodes) - len(fresh),
+               args.server)
+
+    def sweep():
+        for h in fleet.nodes:
+            h.heartbeat()
+            h.pleg_relist()
+
+    sweep()
+    if args.one_shot:
+        print(f"{len(fresh)} hollow nodes up")
+        return 0
+
+    def loop():
+        while True:
+            time.sleep(args.heartbeat)
+            try:
+                sweep()
+            except Exception as e:  # keep the fleet alive through blips
+                klog.infof("[kubemark] sweep error: %s", e)
+
+    threading.Thread(target=loop, daemon=True).start()
+    wait_for_term()
+    remote.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -447,3 +447,37 @@ def test_kubectl_explain_and_version(capsys):
         assert rc == 0 and "Client Version" in out.out
     finally:
         srv.stop()
+
+
+def test_kubemark_hollow_nodes_against_remote_plane(capsys):
+    """cmd/kubemark: hollow kubelets register + heartbeat against a
+    REMOTE apiserver through the client stack; a scheduled pod runs
+    (sandbox -> Running) on its hollow node."""
+    import time as _time
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cmd import kubemark
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+    from fixtures import make_pod
+
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster).start()
+    try:
+        rc = kubemark.main([
+            "--server", srv.url, "--nodes", "3", "--one-shot",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0 and "3 hollow nodes up" in out
+        names = {n.name for n in cluster.list("nodes")}
+        assert names == {"hollow-0", "hollow-1", "hollow-2"}
+        for n in cluster.list("nodes"):
+            assert n.status.conditions.get("Ready") == "True"
+        leases = {l["name"] for l in cluster.list("leases")}
+        assert "hollow-0" in leases
+        # re-registration over a live fleet is idempotent
+        rc = kubemark.main([
+            "--server", srv.url, "--nodes", "3", "--one-shot",
+        ])
+        assert rc == 0 and "0 hollow nodes up" in capsys.readouterr().out
+    finally:
+        srv.stop()
