@@ -1311,19 +1311,19 @@ class APIServer:
                             self._status(404, "NotFound", f"pod {ns}/{name}")
                             return
                         with outer._write_lock:
-                            blocked = None
+                            matching = []
                             for pdb in outer.cluster.list(
                                     "poddisruptionbudgets"):
                                 if pdb.metadata.namespace != ns:
                                     continue
                                 sel = selector_from_label_selector(
                                     pdb.selector or {})
-                                if sel is None or not sel.matches(
+                                if sel is not None and sel.matches(
                                         pod.labels):
-                                    continue
-                                if pdb.disruptions_allowed <= 0:
-                                    blocked = pdb.metadata.name
-                                    break
+                                    matching.append(pdb)
+                            blocked = next(
+                                (p.metadata.name for p in matching
+                                 if p.disruptions_allowed <= 0), None)
                             if blocked is not None:
                                 self._status(
                                     429, "TooManyRequests",
@@ -1334,23 +1334,15 @@ class APIServer:
                             # consume the budget immediately (the registry
                             # decrements before the async controller
                             # recomputes, closing the thundering-drain race)
-                            for pdb in outer.cluster.list(
-                                    "poddisruptionbudgets"):
-                                if pdb.metadata.namespace != ns:
-                                    continue
-                                sel = selector_from_label_selector(
-                                    pdb.selector or {})
-                                if sel is not None and sel.matches(
-                                        pod.labels):
-                                    import dataclasses as _dc
+                            import dataclasses as _dc
 
-                                    outer.cluster.update(
-                                        "poddisruptionbudgets",
-                                        _dc.replace(
-                                            pdb, disruptions_allowed=max(
-                                                0,
-                                                pdb.disruptions_allowed
-                                                - 1)))
+                            for pdb in matching:
+                                outer.cluster.update(
+                                    "poddisruptionbudgets",
+                                    _dc.replace(
+                                        pdb, disruptions_allowed=max(
+                                            0,
+                                            pdb.disruptions_allowed - 1)))
                             outer.cluster.delete("pods", ns, name)
                         self._status(201, "Created", "eviction granted")
                         return
@@ -1435,6 +1427,11 @@ class APIServer:
                 then rides the normal UPDATE pipeline (admission +
                 validation + CAS against the revision read here)."""
                 r = outer._route(self.path)
+                if r is not None and r[0] == "@proxy":
+                    if self._authorize("patch", "proxy") is None:
+                        return
+                    self._proxy(r[1])
+                    return
                 if r is None or not r[2]:
                     self._status(404, "NotFound", self.path)
                     return

@@ -353,14 +353,11 @@ def wire_scheduler_informers(factory: SharedInformerFactory,
     _defaults(factory.cluster, scheduler)
     cache = scheduler.cache
     queue = scheduler.queue
-    # responsibleForPod (eventhandlers.go:319-378): only pods naming
-    # THIS scheduler enter its queue
-    my_name = getattr(getattr(scheduler, "config", None),
-                      "scheduler_name", "default-scheduler")
+    # responsibleForPod: only pods naming THIS scheduler enter its queue
+    from kubernetes_tpu.runtime.scheduler import responsible_for
 
     def responsible(pod) -> bool:
-        return (getattr(pod.spec, "scheduler_name", "default-scheduler")
-                or "default-scheduler") == my_name
+        return responsible_for(pod, scheduler)
 
     def node_add(node):
         cache.add_node(node)

@@ -149,6 +149,10 @@ def _follow_watch(args, ns: str) -> int:
         headers=({"Authorization": f"Bearer {_TOKEN}"} if _TOKEN else {}))
     deadline = (_time.monotonic() + args.watch_seconds
                 if args.watch_seconds else None)
+    # the server's stream replays ALL current objects before following;
+    # -w prints only what happens AFTER the list above, so skip until
+    # the end-of-replay BOOKMARK frame
+    live = False
     try:
         with tls_urlopen(req, timeout=30) as resp:
             for raw in resp:
@@ -161,7 +165,10 @@ def _follow_watch(args, ns: str) -> int:
                     ev = json.loads(line)
                 except ValueError:
                     continue
-                if ev.get("kind") != want_kind:
+                if ev.get("type") == "BOOKMARK":
+                    live = True
+                    continue
+                if not live or ev.get("kind") != want_kind:
                     continue
                 obj = ev.get("object") or {}
                 meta = obj.get("metadata") or {}

@@ -76,10 +76,13 @@ def main(argv=None) -> int:
         )
         for i in range(args.nodes)
     ]
-    # skip nodes that already exist (process restart over a live fleet)
-    fresh = [n for n in nodes if remote.get("nodes", "", n.name) is None]
-    fleet = HollowFleet(remote, fresh)
-    klog.infof("[kubemark] %d hollow nodes registered (%d pre-existing) "
+    # a process restart over a live fleet re-hosts EVERY node's kubelet
+    # loop but only registers the ones the plane doesn't know yet
+    fresh = {n.name for n in nodes
+             if remote.get("nodes", "", n.name) is None}
+    fleet = HollowFleet(remote, nodes,
+                        register=lambda n: n.name in fresh)
+    klog.infof("[kubemark] %d hollow nodes registered (%d re-hosted) "
                "against %s", len(fresh), len(nodes) - len(fresh),
                args.server)
 
@@ -90,7 +93,8 @@ def main(argv=None) -> int:
 
     sweep()
     if args.one_shot:
-        print(f"{len(fresh)} hollow nodes up")
+        print(f"{len(fresh)} hollow nodes registered, "
+              f"{len(nodes)} hosted")
         return 0
 
     def loop():

@@ -344,15 +344,13 @@ def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
     cache = scheduler.cache
     queue = scheduler.queue
     wire_scheduler_defaults(cluster, scheduler)
-    # responsibleForPod (eventhandlers.go:319-378): only pods naming THIS
-    # scheduler enter its queue; assigned pods feed the cache regardless
-    # (everyone's placements consume resources)
-    my_name = getattr(getattr(scheduler, "config", None),
-                      "scheduler_name", "default-scheduler")
+    # responsibleForPod: only pods naming THIS scheduler enter its
+    # queue; assigned pods feed the cache regardless (everyone's
+    # placements consume resources)
+    from kubernetes_tpu.runtime.scheduler import responsible_for
 
     def responsible(pod) -> bool:
-        return (getattr(pod.spec, "scheduler_name", "default-scheduler")
-                or "default-scheduler") == my_name
+        return responsible_for(pod, scheduler)
 
     def on_event(event: str, kind: str, obj) -> None:
         if kind == "nodes":

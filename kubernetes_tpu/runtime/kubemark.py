@@ -35,10 +35,16 @@ class HollowFleet:
     a real fleet gets from per-process reflectors)."""
 
     def __init__(self, cluster: LocalCluster, nodes: List[Node],
-                 completer=None):
+                 completer=None, register=True):
+        """register: bool, or a predicate(node) -> bool — a restarted
+        hollow-node process passes `lambda n: not already_exists(n)` so
+        pre-existing nodes still get kubelet loops without a duplicate
+        registration."""
         self.cluster = cluster
+        reg = register if callable(register) else (lambda n: register)
         self.nodes = [
-            HollowNode(cluster, n, completer, register=True, subscribe=False)
+            HollowNode(cluster, n, completer, register=reg(n),
+                       subscribe=False)
             for n in nodes
         ]
         by_name = {h.node.name: h for h in self.nodes}

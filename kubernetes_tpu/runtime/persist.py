@@ -175,6 +175,12 @@ class PersistentCluster(LocalCluster):
                 return
             after = self._store[kind].get(key)
             if after is not None:
+                if after is cur:
+                    # retried DELETE of an already-terminating object:
+                    # the store changed nothing — logging anything would
+                    # stamp a foreign rv into the WAL/event history and
+                    # break post-restart CAS
+                    return
                 # finalizer-gated: the store only MARKED the object
                 # terminating — persist that mutation, NOT a delete a
                 # replay would apply eagerly

@@ -93,6 +93,16 @@ class SchedulerConfig:
         )
 
 
+def responsible_for(pod, scheduler) -> bool:
+    """eventhandlers.go responsibleForPod: does this scheduler own the
+    pod?  Shared by both event-wiring paths (runtime.cluster
+    wire_scheduler and client.informer wire_scheduler_informers)."""
+    my_name = getattr(getattr(scheduler, "config", None),
+                      "scheduler_name", "default-scheduler")
+    return (getattr(pod.spec, "scheduler_name", "default-scheduler")
+            or "default-scheduler") == my_name
+
+
 @dataclass
 class ScheduleResult:
     pod: Pod

@@ -467,7 +467,7 @@ def test_kubemark_hollow_nodes_against_remote_plane(capsys):
             "--server", srv.url, "--nodes", "3", "--one-shot",
         ])
         out = capsys.readouterr().out
-        assert rc == 0 and "3 hollow nodes up" in out
+        assert rc == 0 and "3 hollow nodes registered, 3 hosted" in out
         names = {n.name for n in cluster.list("nodes")}
         assert names == {"hollow-0", "hollow-1", "hollow-2"}
         for n in cluster.list("nodes"):
@@ -478,6 +478,8 @@ def test_kubemark_hollow_nodes_against_remote_plane(capsys):
         rc = kubemark.main([
             "--server", srv.url, "--nodes", "3", "--one-shot",
         ])
-        assert rc == 0 and "0 hollow nodes up" in capsys.readouterr().out
+        out2 = capsys.readouterr().out
+        # restart over a live fleet: nothing re-registered, all re-hosted
+        assert rc == 0 and "0 hollow nodes registered, 3 hosted" in out2
     finally:
         srv.stop()
