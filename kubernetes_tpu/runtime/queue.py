@@ -107,11 +107,18 @@ class PriorityQueue:
         self.scheduling_cycle = 0
         self.move_request_cycle = -1
         self._closed = False
+        # key -> monotonic first-enqueue time (cleared on delete / taken at
+        # bind-commit for the e2e_scheduling_duration histogram)
+        self._enqueued_at: Dict[Tuple[str, str], float] = {}
 
     # ---- internal (lock held) ----
 
     def _push_active(self, pod: Pod) -> None:
         key = _pod_key(pod)
+        # first-seen enqueue stamp: survives backoff/unschedulable requeues
+        # so queue-add -> bind-commit latency covers the pod's whole wait
+        # (the density SLO measures create -> scheduled the same way)
+        self._enqueued_at.setdefault(key, time.monotonic())
         if key in self._active_entry:
             return
         if self._less is not None:
@@ -178,6 +185,14 @@ class PriorityQueue:
             if entry is not None:
                 entry[_VALID] = False
             self.backoff.clear(key)
+            self._enqueued_at.pop(key, None)
+
+    def take_enqueue_time(self, pod: Pod) -> Optional[float]:
+        """Pop and return the pod's first-enqueue monotonic timestamp (None
+        if the pod never passed through this queue — e.g. direct
+        schedule_cycle calls in tests)."""
+        with self._lock:
+            return self._enqueued_at.pop(_pod_key(pod), None)
 
     # ---- nominated pods (UpdateNominatedPodForNode / DeleteNominatedPodIfExists) ----
 

@@ -430,7 +430,14 @@ class Scheduler:
 
     def _record_scheduled(self, pod: Pod, node_name: str, e2e: float) -> None:
         """Scheduled event + counters, only once a bind actually succeeded
-        (scheduler.go:268 emits after bind, not at assume)."""
+        (scheduler.go:268 emits after bind, not at assume).  The e2e
+        histogram records queue-add -> bind-commit when the pod came
+        through the queue (the density SLO pair: throughput + p99,
+        density.go:988-990); the caller's algo+bind figure is the fallback
+        for direct schedule_cycle() calls."""
+        qt = self.queue.take_enqueue_time(pod)
+        if qt is not None:
+            e2e = time.monotonic() - qt
         klog.V(2).infof(
             "scheduled %s/%s to %s (%.1fms e2e)",
             pod.namespace, pod.name, node_name, e2e * 1000,
