@@ -156,6 +156,8 @@ def pod_to_dict(pod: Pod) -> dict:
         "spec": spec,
         "status": _drop_empty({
             "phase": pod.status.phase,
+            "reason": pod.status.reason or None,
+            "message": pod.status.message or None,
             "startTime": pod.status.start_time or None,
             "nominatedNodeName": pod.status.nominated_node_name,
             "conditions": (

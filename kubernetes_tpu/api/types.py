@@ -423,6 +423,10 @@ class PodStatus:
     # total container restarts (statusManager; incremented by the kubelet
     # when a liveness probe fails and the container is recreated)
     restart_count: int = 0
+    # terminal-phase attribution (ref v1.PodStatus.Reason/Message, e.g.
+    # UnexpectedAdmissionError when kubelet admission rejects the pod)
+    reason: str = ""
+    message: str = ""
 
 
 @dataclass
@@ -480,6 +484,8 @@ class Pod:
                     int(cs.get("restartCount", 0))
                     for cs in st.get("containerStatuses") or []
                 ),
+                reason=st.get("reason", ""),
+                message=st.get("message", ""),
             ),
         )
 

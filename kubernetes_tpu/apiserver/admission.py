@@ -879,15 +879,25 @@ class EventRateLimit:
     store.  Over-limit creates are REJECTED (429 semantics surfaced as
     the admission denial)."""
 
+    # bounded per-namespace cache (the reference uses an LRU of the same
+    # size, eventratelimit defaults cacheSize=4096)
+    MAX_NS_BUCKETS = 4096
+
     def __init__(self, qps: float = 50.0, burst: int = 100,
                  namespace_qps: float = 10.0, namespace_burst: int = 50,
                  now: Optional[Callable[[], float]] = None):
+        import threading as _threading
         import time as _time
+        from collections import OrderedDict
 
         self._now = now or _time.monotonic
         self._server = self._bucket(qps, burst)
         self._ns_cfg = (namespace_qps, namespace_burst)
-        self._ns: Dict[str, dict] = {}
+        self._ns: "OrderedDict[str, dict]" = OrderedDict()
+        # this plugin runs in the pre-write-lock admission phase, so
+        # concurrent requests reach the read-modify-write in _take
+        # simultaneously — one small lock keeps the cap exact
+        self._lock = _threading.Lock()
 
     def _bucket(self, qps: float, burst: int) -> dict:
         return {"qps": qps, "burst": burst, "tokens": float(burst),
@@ -908,12 +918,17 @@ class EventRateLimit:
         now = self._now()
         ns = (obj.get("metadata") or {}).get("namespace") \
             or obj.get("namespace", "default")
-        nsb = self._ns.get(ns)
-        if nsb is None:
-            nsb = self._ns[ns] = self._bucket(*self._ns_cfg)
-        if not self._take(self._server, now) or not self._take(nsb, now):
-            raise AdmissionDenied(
-                f"event rate limit exceeded (namespace {ns!r})")
+        with self._lock:
+            nsb = self._ns.get(ns)
+            if nsb is None:
+                nsb = self._ns[ns] = self._bucket(*self._ns_cfg)
+                if len(self._ns) > self.MAX_NS_BUCKETS:
+                    self._ns.popitem(last=False)  # evict least-recent
+            else:
+                self._ns.move_to_end(ns)
+            if not self._take(self._server, now) or not self._take(nsb, now):
+                raise AdmissionDenied(
+                    f"event rate limit exceeded (namespace {ns!r})")
         return obj
 
 

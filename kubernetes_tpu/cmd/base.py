@@ -36,8 +36,13 @@ def tls_client_context(cacert: Optional[str] = None,
 
     cacert = cacert or _os.environ.get("KTPU_CACERT", "")
     if cacert:
+        # pinned cluster CA: hostname verification STAYS on (ADVICE r4
+        # medium — kubeadm init issues the serving cert with IP/DNS SANs
+        # for host/127.0.0.1/localhost/kubernetes*, and Python ssl matches
+        # IP SANs, so relaxing here would accept ANY cert the cluster CA
+        # signed for ANY address).  Reach planes by a SAN'd address or add
+        # the address to the serving cert's SANs.
         ctx = _ssl.create_default_context(cafile=cacert)
-        ctx.check_hostname = False  # planes serve by IP SAN
     elif _os.environ.get("KTPU_INSECURE_SKIP_TLS_VERIFY", "") == "1":
         ctx = _ssl._create_unverified_context()
     else:

@@ -72,6 +72,11 @@ class LocalCluster:
         # the events API analog: components record through here
         # (tools/record; queryable via cluster.events.events(...))
         self.events = EventRecorder()
+        # node name -> exec handler registered by that node's kubelet
+        # (the kubelet :10250 /exec endpoint's in-cluster analog; the
+        # apiserver's pods/exec subresource dispatches through it —
+        # ref pkg/registry/core/pod/rest/subresources.go ExecREST)
+        self.node_exec: Dict[str, Callable] = {}
 
     # ------------------------------------------------------------ storage
 
@@ -166,6 +171,46 @@ class LocalCluster:
         meta = getattr(obj, "metadata", None)
         return getattr(meta, "deletion_timestamp", None) is not None
 
+    @staticmethod
+    def _deletion_ts(obj):
+        if isinstance(obj, dict):
+            meta = obj.get("metadata") or {}
+            return meta.get("deletionTimestamp") or obj.get("deletionTimestamp")
+        meta = getattr(obj, "metadata", None)
+        return getattr(meta, "deletion_timestamp", None)
+
+    @classmethod
+    def _carry_deletion_ts(cls, obj, stored):
+        """deletionTimestamp is immutable through update (apimachinery
+        ValidateObjectMetaUpdate: it can be set only by the delete path):
+        carry the STORED object's value onto the incoming body, whatever
+        the client sent — otherwise any writer with update permission
+        could hard-delete (set it + omit finalizers) or resurrect (clear
+        it) an object, bypassing finalizer protection."""
+        ts = cls._deletion_ts(stored)
+        if cls._deletion_ts(obj) == ts:
+            return obj
+        if isinstance(obj, dict):
+            obj = dict(obj)
+            meta = dict(obj.get("metadata") or {})
+            if ts is None:
+                meta.pop("deletionTimestamp", None)
+                obj.pop("deletionTimestamp", None)
+            else:
+                meta["deletionTimestamp"] = ts
+                if "deletionTimestamp" in obj:
+                    obj["deletionTimestamp"] = ts
+            if meta or "metadata" in obj:
+                obj["metadata"] = meta
+            return obj
+        import dataclasses as _dc
+
+        meta = getattr(obj, "metadata", None)
+        if meta is not None and hasattr(meta, "deletion_timestamp"):
+            return _dc.replace(
+                obj, metadata=_dc.replace(meta, deletion_timestamp=ts))
+        return obj
+
     def update(self, kind: str, obj, expect_rv: Optional[int] = None) -> int:
         with self._lock:
             key = self._key(kind, obj)
@@ -174,6 +219,7 @@ class LocalCluster:
                 raise ConflictError(f"{kind} {key} missing")
             if expect_rv is not None and cur.rv != expect_rv:
                 raise ConflictError(f"{kind} {key} rv {cur.rv} != {expect_rv}")
+            obj = self._carry_deletion_ts(obj, cur.obj)
             if self._deleting(obj) and not self._finalizers(obj):
                 # the last finalizer was removed from a terminating object:
                 # complete the deferred deletion (apimachinery

@@ -158,30 +158,37 @@ class CRIService:
         return cid
 
     def _container(self, container_id: str) -> dict:
+        """Caller must hold self._lock (CRIServer runs one thread per
+        connection — every container-state read/transition serializes on
+        the one lock so e.g. remove_pod_sandbox cannot interleave with
+        start_container and leave a RUNNING record on a reaped sandbox)."""
         c = self._containers.get(container_id)
         if c is None:
             raise CRIError(f"container {container_id!r} not found")
         return c
 
     def start_container(self, container_id: str) -> None:
-        c = self._container(container_id)
-        if c["state"] != CONTAINER_CREATED:
-            raise CRIError(
-                f"container {container_id!r} is {c['state']}, not CREATED")
-        c["state"] = CONTAINER_RUNNING
+        with self._lock:
+            c = self._container(container_id)
+            if c["state"] != CONTAINER_CREATED:
+                raise CRIError(
+                    f"container {container_id!r} is {c['state']}, not CREATED")
+            c["state"] = CONTAINER_RUNNING
 
     def stop_container(self, container_id: str,
                        timeout: float = 0) -> None:
-        c = self._container(container_id)
-        if c["state"] == CONTAINER_RUNNING:
-            c["state"] = CONTAINER_EXITED
-            c["exit_code"] = 0
+        with self._lock:
+            c = self._container(container_id)
+            if c["state"] == CONTAINER_RUNNING:
+                c["state"] = CONTAINER_EXITED
+                c["exit_code"] = 0
 
     def remove_container(self, container_id: str) -> None:
-        c = self._containers.get(container_id)
-        if c is not None and c["state"] == CONTAINER_RUNNING:
-            raise CRIError(f"container {container_id!r} is running")
-        self._containers.pop(container_id, None)
+        with self._lock:
+            c = self._containers.get(container_id)
+            if c is not None and c["state"] == CONTAINER_RUNNING:
+                raise CRIError(f"container {container_id!r} is running")
+            self._containers.pop(container_id, None)
 
     def list_containers(self,
                         sandbox_id: Optional[str] = None) -> List[dict]:
@@ -190,7 +197,8 @@ class CRIService:
                     if sandbox_id is None or c["sandbox_id"] == sandbox_id]
 
     def container_status(self, container_id: str) -> dict:
-        return dict(self._container(container_id))
+        with self._lock:
+            return dict(self._container(container_id))
 
     def exec_sync(self, container_id: str, cmd: List[str],
                   timeout: float = 10.0) -> dict:
@@ -200,10 +208,11 @@ class CRIService:
         command as a host subprocess — the same execution domain."""
         import subprocess
 
-        c = self._container(container_id)
-        if c["state"] != CONTAINER_RUNNING:
-            raise CRIError(
-                f"container {container_id!r} is {c['state']}, not RUNNING")
+        with self._lock:  # state check only; the exec itself runs unlocked
+            c = self._container(container_id)
+            if c["state"] != CONTAINER_RUNNING:
+                raise CRIError(
+                    f"container {container_id!r} is {c['state']}, not RUNNING")
         try:
             out = subprocess.run(
                 list(cmd), capture_output=True, timeout=timeout)

@@ -130,6 +130,30 @@ class Kubelet:
             cluster.add_node(node)
         if register and subscribe:
             cluster.watch(self.observe)
+        # kubelet :10250 /exec analog: runtimes with the ExecSync verb
+        # publish an exec handler the apiserver's pods/exec subresource
+        # dispatches through (ref kubelet server.go GetExec -> CRI)
+        if hasattr(self.runtime, "exec_sync"):
+            cluster.node_exec[node.name] = self.exec_in_pod
+
+    def exec_in_pod(self, namespace: str, name: str, container: str,
+                    command, timeout: float = 10.0) -> dict:
+        """Resolve the pod's sandbox + container record and ExecSync the
+        command (ref pkg/kubelet/server/server.go:701-741 getExec ->
+        kuberuntime ExecSync).  Returns {stdout, stderr, exit_code}."""
+        key = (namespace, name)
+        sid = self.sandbox_of.get(key)
+        if sid is None:
+            raise KeyError(f"pod {namespace}/{name} has no running sandbox")
+        target = None
+        for c in self.runtime.list_containers(sid):
+            if not container or c.get("name") == container:
+                target = c
+                break
+        if target is None:
+            raise KeyError(
+                f"container {container!r} not found in {namespace}/{name}")
+        return self.runtime.exec_sync(target["id"], list(command), timeout)
 
     # ------------------------------------------------------------ configCh
 
@@ -178,6 +202,11 @@ class Kubelet:
         sandbox) until a node/claim event re-syncs it — the reference
         blocks syncPod on the volume manager the same way."""
         key = (pod.namespace, pod.name)
+        if pod.status.phase in ("Failed", "Succeeded"):
+            # terminal phases never re-host (kubelet_pods.go
+            # podIsTerminated gates syncPod): an admission-rejected pod
+            # stays Failed until the controller replaces it
+            return
         if key in self.sandbox_of:
             # already sandboxed (a watch-triggered sync raced an explicit
             # one): syncPod's sandbox-actions step finds nothing to do —
@@ -197,6 +226,19 @@ class Kubelet:
             self.cluster.events.eventf(
                 "Pod", pod.namespace, pod.name, "Warning",
                 "UnexpectedAdmissionError", "%s", e,
+            )
+            # terminal rejection (kubelet_pods.go rejectPod): leaving the
+            # pod Pending-and-bound would hold its scheduler-side
+            # resources forever; Failed lets the controller replace it
+            self.cluster.update(
+                "pods",
+                dataclasses.replace(
+                    pod,
+                    status=dataclasses.replace(
+                        pod.status, phase="Failed",
+                        reason="UnexpectedAdmissionError", message=str(e),
+                    ),
+                ),
             )
             return
         try:

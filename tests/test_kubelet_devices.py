@@ -111,9 +111,20 @@ def test_kubelet_publishes_device_allocatable_and_admits():
                        "starved").status.phase != "Running"
     evs = cluster.events.events(reason="UnexpectedAdmissionError")
     assert evs and "google.com/tpu" in evs[0].message
-    # teardown releases the devices; the starved pod then admits
+    # ADVICE r4: the rejection is TERMINAL (kubelet_pods.go rejectPod) —
+    # phase Failed with the reason, so the controller can replace it
+    got = cluster.get("pods", "default", "starved")
+    assert got.status.phase == "Failed"
+    assert got.status.reason == "UnexpectedAdmissionError"
+    # teardown releases the devices; the Failed pod does NOT resurrect,
+    # its replacement admits
     cluster.delete("pods", "default", "ok")
     kubelet._teardown(("default", "ok"))
     kubelet.sync_pod(cluster.get("pods", "default", "starved"))
+    assert cluster.get("pods", "default", "starved").status.phase == "Failed"
+    repl = make_pod("starved-repl", node_name="n1",
+                    requests={"cpu": "100m", "google.com/tpu": "1"})
+    cluster.add_pod(repl)
+    kubelet.sync_pod(cluster.get("pods", "default", "starved-repl"))
     assert cluster.get("pods", "default",
-                       "starved").status.phase == "Running"
+                       "starved-repl").status.phase == "Running"

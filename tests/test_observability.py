@@ -166,14 +166,18 @@ def test_e2e_latency_measures_queue_add_to_bind_commit():
     wire_scheduler(cluster, sched)
     cluster.add_node(make_node("n1", cpu="2", mem="4Gi"))
 
-    before_total = m.E2E_LATENCY.total
-    before_sum = m.E2E_LATENCY.sum
-    cluster.add_pod(make_pod("waits", cpu="100m"))
-    time.sleep(0.05)  # the pod waits in the queue
-    sched.run_once(timeout=0.3)
+    # a fresh histogram isolates from other tests' lingering loop threads
+    fresh = m.Histogram("test_e2e", "")
+    orig = m.E2E_LATENCY
+    m.E2E_LATENCY = fresh
+    try:
+        cluster.add_pod(make_pod("waits", cpu="100m"))
+        time.sleep(0.05)  # the pod waits in the queue
+        sched.run_once(timeout=0.3)
+    finally:
+        m.E2E_LATENCY = orig
 
-    assert m.E2E_LATENCY.total == before_total + 1
-    observed = m.E2E_LATENCY.sum - before_sum
-    assert observed >= 0.05  # queue wait included
+    assert fresh.total == 1
+    assert fresh.sum >= 0.05  # queue wait included
     # the stamp is consumed exactly once (no leak for the bound pod)
     assert queue.take_enqueue_time(make_pod("waits", cpu="100m")) is None

@@ -244,3 +244,52 @@ def test_root_ca_publisher_covers_every_namespace():
     _drain(ctrl)
     cm = cluster.get("configmaps", "team-a", "kube-root-ca.crt")
     assert cm["data"]["ca.crt"] == "---CERT---"
+
+
+def test_update_cannot_set_or_clear_deletion_timestamp():
+    """ADVICE r4 (medium): deletionTimestamp is immutable through update
+    (apimachinery ValidateObjectMetaUpdate) — a writer with update
+    permission must not be able to hard-delete a protected object by
+    PUTting a body with deletionTimestamp set and finalizers omitted,
+    nor resurrect a terminating one by clearing it."""
+    cluster = LocalCluster()
+    cluster.register_kind("persistentvolumeclaims")
+    pvc = PersistentVolumeClaim(
+        metadata=ObjectMeta(namespace="default", name="data",
+                            finalizers=[PVC_PROTECTION_FINALIZER]),
+        request=parse_quantity("1Gi"),
+    )
+    cluster.create("persistentvolumeclaims", pvc)
+
+    # attack 1: PUT with deletionTimestamp set + finalizers omitted on a
+    # NON-terminating object -> must NOT delete, stored stays live
+    forged = dataclasses.replace(
+        pvc, metadata=dataclasses.replace(
+            pvc.metadata, deletion_timestamp=1.0, finalizers=[]))
+    cluster.update("persistentvolumeclaims", forged)
+    got = cluster.get("persistentvolumeclaims", "default", "data")
+    assert got is not None, "forged deletionTimestamp must not hard-delete"
+    assert got.metadata.deletion_timestamp is None
+
+    # attack 2: clearing deletionTimestamp on a TERMINATING object must
+    # not resurrect it (the stored value carries forward)
+    got = dataclasses.replace(
+        got, metadata=dataclasses.replace(
+            got.metadata, finalizers=[PVC_PROTECTION_FINALIZER]))
+    cluster.update("persistentvolumeclaims", got)
+    cluster.delete("persistentvolumeclaims", "default", "data")
+    got = cluster.get("persistentvolumeclaims", "default", "data")
+    assert got.metadata.deletion_timestamp is not None
+    resurrect = dataclasses.replace(
+        got, metadata=dataclasses.replace(
+            got.metadata, deletion_timestamp=None))
+    cluster.update("persistentvolumeclaims", resurrect)
+    got = cluster.get("persistentvolumeclaims", "default", "data")
+    assert got.metadata.deletion_timestamp is not None
+
+    # the legitimate path still completes: the finalizer owner removes its
+    # finalizer from the TERMINATING object -> deferred deletion fires
+    done = dataclasses.replace(
+        got, metadata=dataclasses.replace(got.metadata, finalizers=[]))
+    cluster.update("persistentvolumeclaims", done)
+    assert cluster.get("persistentvolumeclaims", "default", "data") is None
