@@ -120,6 +120,7 @@ def make_speculative_scheduler(
     zone_key_id: int = 5,
     score_cfg=None,
     percentage_of_nodes_to_score: int = 100,
+    hybrid: bool = True,
 ):
     """Same call contract as make_sequential_scheduler:
     fn(cluster, pods, ports, last_index0, nominated=None, extra_mask=None,
@@ -313,6 +314,33 @@ def make_speculative_scheduler(
             earlier_prop = (tril > 0) & prop[None, :]
             aviol = jnp.any(conf_ba & earlier_prop, axis=1)
             accept = accept & ~aviol
+        # ---- hybrid exactness sentinel (VERDICT r4 #3): the engine's only
+        # semantic divergence from the one-at-a-time scan is ORDER
+        # INVERSION — a later pod committing while an earlier pod is
+        # passed over (bounced or still infeasible), where the commit can
+        # INTERFERE with what the earlier pod would have gotten
+        # one-at-a-time.  Interference = j's accepted node was feasible
+        # for i this round (capacity/ports race), or i and j are related
+        # through required (anti-)affinity terms in either direction
+        # (domain races, including a later mate opening a domain the scan
+        # would never have opened for i).  When the flag trips, schedule()
+        # discards the speculative result and redoes the batch through
+        # the exact sequential scan — so the scheduled/unschedulable
+        # split always matches scan semantics.  Orderly multi-round
+        # convergence (founder-then-mates bootstrap chains) does NOT trip
+        # it: gated mates are infeasible (empty mask row) and unrelated
+        # to other groups' founders.
+        passed_over = c["active"] & ~accept              # [i]
+        later = tril.T > 0                               # [i, j]: j > i
+        interf = mask[:, hosts]                          # [i, j] = mask[i, host_j]
+        if aff is not None:
+            a_any = jnp.any(aff.aff_match, axis=2)       # [x, y]: x sats y's aff
+            n_any = jnp.any(aff.anti_match, axis=2)      # [x, y]: x matches y's anti
+            rel = a_any | a_any.T | n_any | n_any.T      # either direction
+            interf = interf | rel
+        inv_new = jnp.any(
+            passed_over[:, None] & accept[None, :] & later & interf
+        )
         accf = accept[:, None].astype(jnp.float32)
         # the accept pass is conservative (earlier proposers count even
         # if they themselves bounce), which never overcommits but can
@@ -372,6 +400,13 @@ def make_speculative_scheduler(
                    == hosts[:, None])
             ),
             "li": c["li"] + jnp.int32(B),
+            # the three contention signals the hybrid redo triggers on
+            # (see schedule()): order inversion with interference, any
+            # REAL capacity/port bounce (under pressure, round-1
+            # simultaneity alone can change the packing — different
+            # tie-break SETS — without any pod being passed over), and
+            # any pod left unscheduled (checked host-side on the result)
+            "inv": c["inv"] | inv_new | jnp.any(real_bounce),
         }
         if aff is None:
             # retired: accepted, or nothing feasible this round
@@ -457,6 +492,7 @@ def make_speculative_scheduler(
             "emask": emask0,
             "active": pods.valid,
             "li": jnp.asarray(last_index0, jnp.int32),
+            "inv": jnp.asarray(False),
         }
         if has_aff:
             TP = cluster.topo_pairs.shape[1]
@@ -494,7 +530,11 @@ def make_speculative_scheduler(
             init,
         )
         rounds = (out["li"] - jnp.asarray(last_index0, jnp.int32)) // B
-        return out["hosts"], out["req"], out["nz"], rounds
+        # third contention sentinel, ON DEVICE (one scalar rides the same
+        # fetch): a pod left unscheduled means capacity/domain pressure,
+        # under which any placement difference can change the split
+        inv = out["inv"] | jnp.any(pods.valid & (out["hosts"] < 0))
+        return out["hosts"], out["req"], out["nz"], rounds, inv
 
     @lru_cache(maxsize=64)
     def _packed(meta):
@@ -547,7 +587,9 @@ def make_speculative_scheduler(
         while bool(np.asarray(c["active"]).any()):
             c = step(cluster, bufs, c)
             rounds += 1
-        return c["hosts"], c["req"], c["nz"], rounds
+        return c["hosts"], c["req"], c["nz"], rounds, c["inv"]
+
+    seq_fn = [None]  # lazily-built exact scan for the hybrid redo
 
     def schedule(cluster: ClusterTensors, pods: PodBatch, ports,
                  last_index0, nominated=None, extra_mask=None,
@@ -566,14 +608,47 @@ def make_speculative_scheduler(
         # tree's key set is part of meta, so each combination jits once
         bufs, meta = pack_tree(tree)
         if on_cpu:
-            hosts, req, nz, rounds = _host_rounds(
+            hosts, req, nz, rounds, inv = _host_rounds(
                 cluster, bufs, meta, last_index0
             )
         else:
-            hosts, req, nz, rounds = _packed(meta)(
+            hosts, req, nz, rounds, inv = _packed(meta)(
                 cluster, bufs, np.int32(last_index0)
             )
         schedule.last_rounds = rounds  # observability: repair rounds used
+        schedule.last_redo = False
+        if hybrid and on_cpu and not bool(np.asarray(inv)):
+            # CPU path: the unscheduled-pod sentinel is checked host-side
+            # (hosts are host-resident; the device path folds it into the
+            # in-_impl inv scalar so only ONE scalar rides the fetch and
+            # the caller keeps the async hosts-fetch overlap)
+            hn = np.asarray(hosts)
+            valid = np.asarray(pods.valid, bool)
+            inv = bool((hn[valid] < 0).any())
+        if hybrid and bool(np.asarray(inv)):
+            # order inversion with interference detected: the split could
+            # deviate from one-at-a-time semantics, so redo the WHOLE
+            # batch through the exact sequential scan (the speculative
+            # commits above never touched the caller's cluster).  This
+            # costs one scan on the contended batches only — uncontended
+            # batches (the common case: round 1 commits everything, or
+            # orderly founder->mates chains) keep the parallel fast path.
+            if seq_fn[0] is None:
+                from kubernetes_tpu.models.batched import (
+                    make_sequential_scheduler,
+                )
+
+                seq_fn[0] = make_sequential_scheduler(
+                    cfg=cfg, weights=weights,
+                    unsched_taint_key=unsched_taint_key,
+                    zone_key_id=zone_key_id, score_cfg=score_cfg,
+                    percentage_of_nodes_to_score=percentage_of_nodes_to_score,
+                )
+            schedule.last_redo = True
+            return seq_fn[0](
+                cluster, pods, ports, last_index0, nominated,
+                extra_mask, extra_score, aff_state,
+            )
         new_cluster = dataclasses.replace(cluster, requested=req, nonzero_req=nz)
         return hosts, new_cluster
 

@@ -60,13 +60,14 @@ TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
 class SchedulerConfig:
     batch_size: int = 256
     batch_window_s: float = 0.001
-    # "sequential" = exact one-pod-at-a-time commit semantics (lax.scan);
-    # "speculative" = parallel placement + conflict repair (higher
-    # throughput; in-batch spread scores stale within a round).  Both
-    # engines carry in-batch affinity and nominated-pod state (the
-    # speculative engine batch-updates the same per-topology-pair extras
-    # the scan threads through its steps).
-    engine: str = "sequential"
+    # "speculative" (default) = parallel placement + conflict repair with
+    # the HYBRID exactness fallback: contention sentinels (order
+    # inversion, real bounce, unscheduled pod) trigger a sequential-scan
+    # redo of the batch, so the scheduled/unschedulable split always
+    # matches one-at-a-time semantics while uncontended batches keep the
+    # one-launch fast path.  "sequential" = always the exact lax.scan.
+    # Both engines carry in-batch affinity and nominated-pod state.
+    engine: str = "speculative"
     percentage_of_nodes_to_score: int = 100  # TPU path scans all; knob for parity
     disable_preemption: bool = False
     # multi-scheduler: only pods whose spec.schedulerName names THIS
