@@ -36,6 +36,8 @@ preemptor (queue nominatedPods map), and requeues.
 
 from __future__ import annotations
 
+import os
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -147,9 +149,34 @@ def pick_preemption_node(encoder, pod, cands, arena, slots, violating, max_vols)
       3. a veto masks the node and re-picks.
 
     Returns (node_row, victim_arena_indices, victim_pods, PreemptionResult)
-    with node_row == -1 when preemption helps nowhere."""
+    with node_row == -1 when preemption helps nowhere.
+
+    The counting what-if is device-exact for resources, ports, disk
+    conflicts AND identity-deduped volume attach budgets (VERDICT r4 #4),
+    so the object-level verify_nomination pass runs only when the what-if
+    cannot model the failure: required (anti-)affinity terms live in the
+    cluster or on the preemptor, service-affinity pins are configured, or
+    the pick produced ZERO victims (the original failure then lies outside
+    the modeled predicate set).  KTPU_PREEMPT_VERIFY=always restores the
+    unconditional debug-mode check."""
     pod_req_ext, requested_ext, allocatable_ext, pods_ext = (
         encoder.preemption_arrays(pod, max_vols)
+    )
+    # identity-deduped volume credit: zero the per-pod volume-count
+    # columns (the linear path PARITY §3 documented) and let the vid
+    # tables drive both the initial credit and the reprieve deltas
+    vol_tables = encoder.victim_volume_tables(slots)
+    R_plus2 = requested_ext.shape[1] - vol_tables[4].shape[1]
+    pods_ext = pods_ext.copy()
+    pods_ext[:, R_plus2:] = 0.0
+    aff = pod.spec.affinity
+    need_verify = (
+        os.environ.get("KTPU_PREEMPT_VERIFY", "") == "always"
+        or encoder.has_required_pod_terms()
+        or (aff is not None
+            and (aff.pod_affinity is not None
+                 or aff.pod_anti_affinity is not None))
+        or bool(encoder.service_affinity_keys)
     )
     start_ranks = dense_start_ranks(arena.start)
     cands = np.asarray(cands).copy()
@@ -165,6 +192,8 @@ def pick_preemption_node(encoder, pod, cands, arena, slots, violating, max_vols)
             violating,
             start_ranks,
             slots,
+            vol_tables=vol_tables,
+            has_vols=True,
         )
         row = int(res.node)
         if row < 0:
@@ -176,6 +205,8 @@ def pick_preemption_node(encoder, pod, cands, arena, slots, violating, max_vols)
             if arena.keys[m] in encoder.pods
             and encoder.pods[arena.keys[m]].pod is not None
         ]
+        if not (need_verify or len(victims) == 0):
+            return row, victim_ms, victims, res
         if verify_nomination(encoder, pod, row, victims, max_vols):
             return row, victim_ms, victims, res
         cands[row] = False
@@ -242,7 +273,7 @@ def _exact_prio_sum(vic_m, pods_priority, seg, n_segments):
     return hi_sum, lo_sum
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("has_vols",))
 def preempt_one(
     requested: jnp.ndarray,     # f32[N, R'] current usage, extended columns
     allocatable: jnp.ndarray,   # f32[N, R'] limits, extended columns
@@ -251,10 +282,17 @@ def preempt_one(
     pods_node: jnp.ndarray,     # i32[M] arena: pod -> node row (-1 unassigned)
     pods_priority: jnp.ndarray, # i32[M]
     pods_req: jnp.ndarray,      # f32[M, R'] per-pod usage, extended columns
+                                # (volume-count columns ZEROED when
+                                # vol_tables drive the identity credit)
     pods_violating: jnp.ndarray,  # bool[M] eviction would violate a PDB
     pods_start: jnp.ndarray,    # f32[M] start-time dense ranks
                                 # (dense_start_ranks; order == f64 times)
     victim_slots: jnp.ndarray,  # i32[Kv] from sorted_victim_slots
+    vol_tables=None,            # encoder.victim_volume_tables(slots):
+                                # identity-deduped attach credit (shared
+                                # volumes freed ONCE, and only when every
+                                # holder is evicted) — VERDICT r4 #4
+    has_vols: bool = False,     # static: vol_tables present (jit variant)
 ) -> PreemptionResult:
     N = requested.shape[0]
     M = pods_node.shape[0]
@@ -266,6 +304,13 @@ def preempt_one(
     freed_all = jax.ops.segment_sum(
         pods_req * listed[:, None].astype(jnp.float32), seg, num_segments=N + 1
     )[:N]                                                    # [N, R']
+    if has_vols:
+        slot_vids, vid_type, vid_total, vid_listed, freed_vol_init = vol_tables
+        VT = freed_vol_init.shape[1]
+        RV = requested.shape[1] - VT                         # first vol column
+        # exact initial credit: a volume counts as freed iff ALL its
+        # holders are listed victims (host-computed per identity)
+        freed_all = freed_all.at[:, RV:].add(freed_vol_init)
     need = pod_req[None] > 0
 
     def fits(freed_row, node_row):
@@ -281,17 +326,43 @@ def preempt_one(
     possible = candidates & fits_all                         # [N]
 
     # ---- reprieve: re-add victims (PDB-violating first, priority desc)
-    # while the pod still fits
-    def step(freed, m):
+    # while the pod still fits.  With vol_tables the carry also tracks
+    # per-volume evicted-holder counts: reprieving the FIRST holder of a
+    # fully-freed volume restores the attachment (delta 1); reprieving
+    # further holders adds nothing — the exact inverse of the identity-
+    # deduped initial credit.
+    def step(carry, x):
+        freed, evicted = carry
+        if has_vols:
+            m, vids = x
+        else:
+            m = x
         valid_slot = m >= 0
         mi = jnp.maximum(m, 0)
         n = jnp.clip(pods_node[mi], 0, N - 1)
         new_row = freed[n] - pods_req[mi]
+        if has_vols:
+            vv = jnp.where(vids >= 0, vids, vid_type.shape[0] - 1)
+            was_full = (evicted[vv] >= vid_total[vv]) & (vids >= 0)
+            delta = jnp.zeros(VT, jnp.float32).at[vid_type[vv]].add(
+                was_full.astype(jnp.float32), mode="drop"
+            )
+            new_row = new_row.at[RV:].add(-delta)
         keep = fits(new_row, n) & valid_slot & possible[n]
         freed = freed.at[n].set(jnp.where(keep, new_row, freed[n]))
-        return freed, keep
+        if has_vols:
+            evicted = jnp.where(
+                keep, evicted.at[vv].add(-1, mode="drop"), evicted
+            )
+        return (freed, evicted), keep
 
-    _, kept = jax.lax.scan(step, freed_all, victim_slots)
+    if has_vols:
+        init_evicted = vid_listed
+        xs = (victim_slots, slot_vids)
+    else:
+        init_evicted = jnp.zeros((1,), jnp.int32)
+        xs = victim_slots
+    (_, _), kept = jax.lax.scan(step, (freed_all, init_evicted), xs)
     kept_mask = jnp.zeros(M, bool).at[slot_idx].set(kept, mode="drop")
     vic_m = listed & ~kept_mask                              # final victims [M]
 
