@@ -14,23 +14,34 @@ Reference:
   * failurePolicy (apiserver/pkg/apis/admissionregistration types.go):
     Fail (a webhook error denies the request) vs Ignore (skip it).
 
-The wire protocol is admission/v1 AdmissionReview JSON over plain HTTP
-POST (this snapshot's serving stack; the reference requires HTTPS to the
-webhook).  Mutating responses patch the object with RFC 6902 JSON Patch
-(base64 in .response.patch, patchType JSONPatch), applied between
-webhooks so each sees its predecessors' edits — dispatcher.go:121-150.
+The wire protocol is admission/v1 AdmissionReview JSON POSTed over the
+hook's clientConfig target: a bare `url`, or an in-cluster `service:`
+reference resolved through the service's Endpoints (the reference's
+ServiceResolver, staging/src/k8s.io/apiserver/pkg/util/webhook/
+client.go:119-146 + webhook.go serviceResolver).  A per-hook `caBundle`
+builds the TLS trust for https targets (client.go:43-48) — in an
+otherwise-HTTPS cluster, admission must not be the one cleartext hop.
+Mutating responses patch the object with RFC 6902 JSON Patch (base64 in
+.response.patch, patchType JSONPatch), applied between webhooks so each
+sees its predecessors' edits — dispatcher.go:121-150.  Every round trip
+lands in the apiserver_admission_webhook_admission_duration_seconds
+histogram (a slow failurePolicy=Fail hook stalls all matching writes;
+it must be observable).
 """
 
 from __future__ import annotations
 
 import base64
 import json
+import ssl
+import time
 import urllib.error
 import urllib.request
 import uuid
 from typing import Callable, List, Optional
 
 from kubernetes_tpu.apiserver.admission import AdmissionDenied
+from kubernetes_tpu.utils import metrics as m
 
 MUTATING_KIND = "mutatingwebhookconfigurations"
 VALIDATING_KIND = "validatingwebhookconfigurations"
@@ -165,15 +176,83 @@ class WebhookDispatcher:
         self.cluster = cluster
         self.timeout_s = timeout_s
         self._post = http_post or self._http_post
+        # injected test doubles may keep the legacy 3-arg signature
+        # (url, payload, timeout) — detect the arity ONCE here; a
+        # retry-on-TypeError fallback would double-dispatch a review
+        # whenever a 4-arg post raises TypeError internally
+        import inspect
+
+        try:
+            self._post_takes_ca = (
+                len(inspect.signature(self._post).parameters) >= 4)
+        except (TypeError, ValueError):
+            self._post_takes_ca = True
+        # hook name -> last round-trip seconds (debug view over the
+        # WEBHOOK_LATENCY histogram)
+        self.last_latency = {}
 
     @staticmethod
-    def _http_post(url: str, payload: dict, timeout: float) -> dict:
+    def _http_post(url: str, payload: dict, timeout: float,
+                   ca_bundle: Optional[str] = None) -> dict:
         req = urllib.request.Request(
             url, data=json.dumps(payload).encode(),
             headers={"Content-Type": "application/json"}, method="POST",
         )
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
+        ctx = None
+        if url.startswith("https://"):
+            if ca_bundle:
+                # per-hook private trust (client.go:43-48 TLSConfig.RootCAs
+                # from cc.CABundle); hostname/IP-SAN verification stays on
+                ctx = ssl.create_default_context(
+                    cadata=base64.b64decode(ca_bundle).decode())
+            else:
+                ctx = ssl.create_default_context()
+        with urllib.request.urlopen(req, timeout=timeout, context=ctx) as resp:
             return json.loads(resp.read() or b"{}")
+
+    def _resolve_target(self, hook: dict):
+        """clientConfig -> (url, caBundle).  A `service:` reference
+        resolves through the service's Endpoints to a reachable backend
+        address (the reference's ServiceResolver yields the cluster-IP
+        and relies on kube-proxy; this framework's dataplane is the
+        Endpoints object itself), defaulting port 443 and scheme https —
+        in-cluster admission traffic is never cleartext."""
+        cc = hook.get("clientConfig") or {}
+        ca = cc.get("caBundle")
+        if cc.get("url"):
+            return cc["url"], ca
+        svc = cc.get("service")
+        if not svc:
+            raise ValueError(
+                f"webhook {hook.get('name')!r} has neither url nor service")
+        ns = svc.get("namespace") or "default"
+        name = svc.get("name") or ""
+        port = int(svc.get("port") or 443)
+        path = svc.get("path") or "/"
+        host = None
+        if self.cluster.has_kind("endpoints"):
+            ep = self.cluster.get("endpoints", ns, name)
+            if isinstance(ep, dict):
+                for ss in ep.get("subsets") or []:
+                    addrs = ss.get("addresses") or []
+                    if addrs:
+                        host = addrs[0].get("ip")
+                        eports = ss.get("ports") or []
+                        if eports:  # endpoints carry the TARGET port
+                            port = int(eports[0].get("port") or port)
+                        break
+        if host is None and self.cluster.has_kind("services"):
+            so = self.cluster.get("services", ns, name)
+            if isinstance(so, dict):
+                host = (so.get("spec") or {}).get("clusterIP") \
+                    or so.get("clusterIP")
+        if not host:
+            raise ValueError(
+                f"webhook {hook.get('name')!r}: service {ns}/{name} "
+                "has no reachable endpoint")
+        if not path.startswith("/"):
+            path = "/" + path
+        return f"https://{host}:{port}{path}", ca
 
     def _hooks(self, config_kind: str):
         if not self.cluster.has_kind(config_kind):
@@ -188,9 +267,7 @@ class WebhookDispatcher:
     def _call(self, hook: dict, op: str, kind: str, obj: dict) -> dict:
         """One AdmissionReview round trip -> the .response dict.
         Raises on transport errors (failurePolicy decides what happens)."""
-        url = (hook.get("clientConfig") or {}).get("url", "")
-        if not url:
-            raise ValueError(f"webhook {hook.get('name')!r} has no url")
+        url, ca_bundle = self._resolve_target(hook)
         uid = str(uuid.uuid4())
         review = {
             "apiVersion": "admission.k8s.io/v1",
@@ -208,7 +285,16 @@ class WebhookDispatcher:
             },
         }
         timeout = float(hook.get("timeoutSeconds") or self.timeout_s)
-        out = self._post(url, review, timeout)
+        t0 = time.monotonic()
+        try:
+            if self._post_takes_ca:
+                out = self._post(url, review, timeout, ca_bundle)
+            else:
+                out = self._post(url, review, timeout)
+        finally:
+            dt = time.monotonic() - t0
+            m.WEBHOOK_LATENCY.observe(dt)
+            self.last_latency[hook.get("name", "")] = dt
         return out.get("response") or {}
 
     def _dispatch(self, config_kind: str, op: str, kind: str,

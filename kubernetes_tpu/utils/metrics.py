@@ -162,6 +162,14 @@ PREEMPTION_VICTIMS = REGISTRY.register(
 PREEMPTION_ATTEMPTS = REGISTRY.register(
     Counter("scheduler_total_preemption_attempts", "Total preemption attempts")
 )
+# per-dispatch admission-webhook round-trip latency (the reference's
+# apiserver_admission_webhook_admission_duration_seconds — a slow
+# failurePolicy=Fail hook stalls every matching write, so it must be
+# observable)
+WEBHOOK_LATENCY = REGISTRY.register(Histogram(
+    "apiserver_admission_webhook_admission_duration_seconds",
+    "Admission webhook round-trip latency",
+))
 
 # schedule_attempts_total result label values (metrics.go:44-52)
 SCHEDULED, UNSCHEDULABLE, SCHEDULE_ERROR = "scheduled", "unschedulable", "error"
