@@ -1346,6 +1346,47 @@ class APIServer:
                             outer.cluster.delete("pods", ns, name)
                         self._status(201, "Created", "eviction granted")
                         return
+                    if kind == "pods" and sub == "exec":
+                        # pods/exec subresource (registry/core/pod/rest/
+                        # subresources.go ExecREST; the reference upgrades
+                        # to SPDY streams and proxies the kubelet's :10250
+                        # /exec — this plane's network is the cluster
+                        # object, so the dispatch rides the kubelet's
+                        # registered exec handler and the result returns
+                        # as one JSON document)
+                        pod = outer.cluster.get("pods", ns, name)
+                        if pod is None:
+                            self._status(404, "NotFound", f"pod {ns}/{name}")
+                            return
+                        node = getattr(pod.spec, "node_name", "") or ""
+                        fn = outer.cluster.node_exec.get(node)
+                        if fn is None:
+                            self._status(
+                                501, "NotImplemented",
+                                f"node {node!r} has no exec-capable "
+                                "runtime (hollow kubelets serve no exec)")
+                            return
+                        command = body.get("command") or []
+                        if not command:
+                            self._status(400, "BadRequest", "empty command")
+                            return
+                        try:
+                            res = fn(ns, name, body.get("container", ""),
+                                     command,
+                                     float(body.get("timeout") or 10.0))
+                        except KeyError as e:
+                            self._status(404, "NotFound", str(e))
+                            return
+                        except Exception as e:  # runtime down mid-exec
+                            self._status(500, "InternalError", str(e))
+                            return
+                        self._send({
+                            "kind": "ExecResult",
+                            "stdout": res.get("stdout", ""),
+                            "stderr": res.get("stderr", ""),
+                            "exitCode": int(res.get("exit_code", 0)),
+                        })
+                        return
                     if kind == "pods" and sub == "binding":
                         # Binding subresource: {"target": {"name": node}}
                         node = (body.get("target") or {}).get("name", "")

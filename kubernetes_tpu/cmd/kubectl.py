@@ -322,6 +322,25 @@ def main(argv=None) -> int:
     lg = sub.add_parser("logs", parents=[common])
     lg.add_argument("pod")
 
+    xc = sub.add_parser("exec", parents=[common])
+    xc.add_argument("pod")
+    xc.add_argument("-c", "--container", default="")
+    xc.add_argument("--timeout", type=float, default=10.0)
+    xc.add_argument("command", nargs=argparse.REMAINDER,
+                    help="command after -- (pkg/kubectl/cmd/exec/exec.go)")
+
+    at = sub.add_parser("attach", parents=[common])
+    at.add_argument("pod")
+    at.add_argument("--follow", action="store_true",
+                    help="keep relaying until the pod terminates")
+    at.add_argument("--interval", type=float, default=1.0)
+
+    pf = sub.add_parser("port-forward", parents=[common])
+    pf.add_argument("pod")
+    pf.add_argument("mapping", help="LOCAL:REMOTE, e.g. 8080:80")
+    pf.add_argument("--once", action="store_true",
+                    help="serve one connection then exit (tests)")
+
     tp = sub.add_parser("top", parents=[common])
     tp.add_argument("what", choices=("nodes", "node", "pods", "pod"))
     tp.add_argument("name", nargs="?", default="")
@@ -626,6 +645,141 @@ def main(argv=None) -> int:
         text = out.get("log", "") if isinstance(out, dict) else str(out)
         sys.stdout.write(text)
         return 0
+
+    if args.verb == "exec":
+        # pkg/kubectl/cmd/exec/exec.go:1-376 distilled onto the pods/exec
+        # subresource: POST the command, print the streams, exit with the
+        # remote exit code
+        command = list(args.command)
+        if command and command[0] == "--":  # drop only the separator — a
+            command = command[1:]           # literal later "--" belongs to
+        if not command:                     # the remote command (exec.go)
+            print("error: you must specify a command after --",
+                  file=sys.stderr)
+            return 1
+        out = _req(args.server, "POST",
+                   _path("pods", ns, args.pod) + "/exec",
+                   {"command": command, "container": args.container,
+                    "timeout": args.timeout})
+        if isinstance(out, dict) and out.get("kind") == "Status":
+            print(out.get("message", ""), file=sys.stderr)
+            return 1
+        sys.stdout.write(out.get("stdout", ""))
+        if out.get("stderr"):
+            sys.stderr.write(out["stderr"])
+        return int(out.get("exitCode", 0))
+
+    if args.verb == "attach":
+        # cmd/attach/attach.go distilled: this framework's containers are
+        # pause-anchored host processes with no live stdout stream, so
+        # attach relays the pod's lifecycle log — with --follow it keeps
+        # streaming new lines until the pod terminates
+        import time as _time
+
+        seen = 0
+        while True:
+            out = _req(args.server, "GET",
+                       _path("pods", ns, args.pod) + "/log")
+            if isinstance(out, dict) and out.get("kind") == "Status":
+                print(out.get("message", ""), file=sys.stderr)
+                return 1
+            text = out.get("log", "") if isinstance(out, dict) else str(out)
+            sys.stdout.write(text[seen:])
+            sys.stdout.flush()
+            seen = len(text)
+            if not args.follow:
+                return 0
+            pod = _req(args.server, "GET", _path("pods", ns, args.pod))
+            phase = ((pod.get("status") or {}).get("phase", "")
+                     if isinstance(pod, dict) else "")
+            if pod.get("kind") == "Status" or phase in ("Succeeded", "Failed"):
+                return 0
+            _time.sleep(args.interval)
+
+    if args.verb == "port-forward":
+        # cmd/portforward/portforward.go:1-341 distilled to a TCP stream
+        # relay: the reference tunnels SPDY streams through the apiserver
+        # to the kubelet; this framework's pods are host processes, so the
+        # relay targets the pod's host network directly after resolving
+        # the pod through the apiserver (Running + declared port)
+        import socket
+        import threading as _threading
+
+        local_s, _, remote_s = args.mapping.partition(":")
+        local_port = int(local_s)
+        remote_port = int(remote_s or local_s)
+        pod = _req(args.server, "GET", _path("pods", ns, args.pod))
+        if not isinstance(pod, dict) or pod.get("kind") == "Status":
+            print(pod.get("message", f"pod {args.pod} not found"),
+                  file=sys.stderr)
+            return 1
+        phase = (pod.get("status") or {}).get("phase", "")
+        if phase != "Running":
+            print(f"error: pod {args.pod} is {phase or 'not running'}, "
+                  "cannot forward", file=sys.stderr)
+            return 1
+        # relay target host: the pod's reported hostIP when the status
+        # carries one; otherwise the plane's host (this framework's pods
+        # are host processes on the machine running the plane) — never
+        # blindly 127.0.0.1, which breaks against a remote --server
+        from urllib.parse import urlparse
+
+        target_host = ((pod.get("status") or {}).get("hostIP")
+                       or urlparse(args.server).hostname or "127.0.0.1")
+
+        def relay(client):
+            try:
+                upstream = socket.create_connection(
+                    (target_host, remote_port), timeout=10)
+            except OSError as e:
+                print(f"error: dial {target_host}:{remote_port}: {e}",
+                      file=sys.stderr)
+                client.close()
+                return
+
+            def pump(src, dst):
+                try:
+                    while True:
+                        data = src.recv(65536)
+                        if not data:
+                            break
+                        dst.sendall(data)
+                except OSError:
+                    pass
+                finally:
+                    for s in (src, dst):
+                        try:
+                            s.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
+
+            t = _threading.Thread(target=pump, args=(client, upstream),
+                                  daemon=True)
+            t.start()
+            pump(upstream, client)
+            t.join()
+            client.close()
+            upstream.close()
+
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", local_port))
+        srv.listen(8)
+        print(f"Forwarding from 127.0.0.1:{srv.getsockname()[1]} -> "
+              f"{remote_port}")
+        sys.stdout.flush()
+        try:
+            while True:
+                client, _addr = srv.accept()
+                if args.once:
+                    relay(client)
+                    return 0
+                _threading.Thread(
+                    target=relay, args=(client,), daemon=True).start()
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            srv.close()
 
     if args.verb == "explain":
         # pkg/kubectl/explain off /openapi/v2: resolve the kind's

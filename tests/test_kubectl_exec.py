@@ -1,0 +1,176 @@
+"""kubectl exec / attach / port-forward (VERDICT r4 #7) — driven through
+the full stack: kubectl -> apiserver pods/exec subresource -> the
+kubelet's registered exec handler -> CRI ExecSync on a real runtime
+daemon across a unix socket.
+
+Reference: pkg/kubectl/cmd/exec/exec.go:1-376, cmd/attach/attach.go,
+cmd/portforward/portforward.go:1-341,
+pkg/registry/core/pod/rest/subresources.go."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.cmd import kubectl
+from kubernetes_tpu.runtime.cluster import LocalCluster
+from kubernetes_tpu.runtime.cri import RemoteRuntime
+from kubernetes_tpu.runtime.kubelet import Kubelet
+
+from fixtures import make_node, make_pod
+
+
+def _start_cri_daemon(tmp_path):
+    sock_path = str(tmp_path / "cri.sock")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "kubernetes_tpu.runtime.cri",
+         "--socket", sock_path, "--backend", "process"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    deadline = time.time() + 30
+    while not os.path.exists(sock_path):
+        if daemon.poll() is not None:
+            pytest.skip("pause build unavailable: "
+                        + daemon.stdout.read().decode()[:200])
+        if time.time() > deadline:
+            daemon.kill()
+            raise RuntimeError("daemon never bound socket")
+        time.sleep(0.05)
+    return daemon, sock_path
+
+
+def test_kubectl_exec_through_full_stack(tmp_path, capsys):
+    """`kubectl exec pod -- cmd` returns stdout from a ProcessRuntime
+    container: kubectl -> apiserver -> kubelet exec handler -> CRI
+    ExecSync across the unix socket."""
+    daemon, sock_path = _start_cri_daemon(tmp_path)
+    srv = None
+    try:
+        cluster = LocalCluster()
+        rt = RemoteRuntime(sock_path, timeout=5.0)
+        kubelet = Kubelet(cluster, make_node("n1", cpu="4", mem="8Gi"),
+                          runtime=rt)
+        pod = make_pod("shell", cpu="100m", node_name="n1")
+        cluster.add_pod(pod)
+        kubelet.sync_pod(cluster.get("pods", "default", "shell"))
+        assert cluster.get("pods", "default", "shell").status.phase == "Running"
+        srv = APIServer(cluster=cluster).start()
+
+        capsys.readouterr()
+        rc = kubectl.main(["-s", srv.url, "exec", "shell", "--",
+                           "echo", "hello-from-container"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "hello-from-container" in out
+
+        # remote exit codes propagate (exec.go returns the command's code)
+        rc = kubectl.main(["-s", srv.url, "exec", "shell", "--",
+                           "sh", "-c", "exit 3"])
+        assert rc == 3
+
+        # a pod on a node with no exec-capable runtime -> 501 surface
+        cluster.add_node(make_node("hollow-n", cpu="4", mem="8Gi"))
+        ghost = make_pod("ghost", cpu="100m", node_name="hollow-n")
+        cluster.add_pod(ghost)
+        capsys.readouterr()
+        rc = kubectl.main(["-s", srv.url, "exec", "ghost", "--", "true"])
+        err = capsys.readouterr().err
+        assert rc == 1 and "no exec-capable runtime" in err
+    finally:
+        if srv is not None:
+            srv.stop()
+        if daemon.poll() is None:
+            daemon.kill()
+            daemon.wait(timeout=5)
+
+
+def test_kubectl_attach_relays_pod_log(capsys):
+    cluster = LocalCluster()
+    Kubelet(cluster, make_node("n1", cpu="4", mem="8Gi"))
+    pod = make_pod("talker", cpu="100m", node_name="n1")
+    cluster.add_pod(pod)
+    cluster.events.eventf("Pod", "default", "talker", "Normal",
+                          "Started", "container started")
+    srv = APIServer(cluster=cluster).start()
+    try:
+        capsys.readouterr()
+        rc = kubectl.main(["-s", srv.url, "attach", "talker"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Started" in out
+    finally:
+        srv.stop()
+
+
+def test_kubectl_port_forward_relays_tcp(capsys):
+    """port-forward LOCAL:REMOTE relays a real TCP stream to the pod's
+    host process (the framework's pods are host processes)."""
+    # the "container workload": a TCP echo server on an ephemeral port
+    backend = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    backend.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    backend.bind(("127.0.0.1", 0))
+    backend.listen(1)
+    backend_port = backend.getsockname()[1]
+
+    def serve_once():
+        conn, _ = backend.accept()
+        data = conn.recv(1024)
+        conn.sendall(b"pong:" + data)
+        conn.close()
+
+    threading.Thread(target=serve_once, daemon=True).start()
+
+    cluster = LocalCluster()
+    Kubelet(cluster, make_node("n1", cpu="4", mem="8Gi"))
+    pod = make_pod("web", cpu="100m", node_name="n1")
+    cluster.add_pod(pod)
+    kubelet_pod = cluster.get("pods", "default", "web")
+    import dataclasses
+
+    cluster.update("pods", dataclasses.replace(
+        kubelet_pod, status=dataclasses.replace(
+            kubelet_pod.status, phase="Running")))
+    srv = APIServer(cluster=cluster).start()
+    try:
+        # free local port for the listener
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        local_port = probe.getsockname()[1]
+        probe.close()
+
+        rcs = {}
+
+        def forward():
+            rcs["rc"] = kubectl.main([
+                "-s", srv.url, "port-forward", "web",
+                f"{local_port}:{backend_port}", "--once"])
+
+        t = threading.Thread(target=forward, daemon=True)
+        t.start()
+        deadline = time.time() + 10
+        reply = None
+        while time.time() < deadline:
+            try:
+                c = socket.create_connection(("127.0.0.1", local_port),
+                                             timeout=1)
+                c.sendall(b"ping")
+                c.shutdown(socket.SHUT_WR)
+                reply = c.recv(1024)
+                c.close()
+                break
+            except OSError:
+                time.sleep(0.1)
+        t.join(timeout=10)
+        assert reply == b"pong:ping"
+        assert rcs.get("rc") == 0
+    finally:
+        srv.stop()
+        backend.close()
