@@ -33,6 +33,19 @@ def validate_crd_spec(crd: dict) -> None:
         raise SchemaError("spec.version (or versions) is required")
     if not (spec.get("names") or {}).get("plural"):
         raise SchemaError("spec.names.plural is required")
+    versions = spec.get("versions") or []
+    if versions:
+        n_storage = sum(1 for v in versions if v.get("storage"))
+        if n_storage > 1:
+            raise SchemaError(
+                "exactly one version may set storage: true")
+        if not any(v.get("served", True) for v in versions):
+            raise SchemaError("at least one version must be served")
+        strategy = ((spec.get("conversion") or {}).get("strategy")
+                    or "None")
+        if strategy not in ("None", "Webhook"):
+            raise SchemaError(
+                f"unknown conversion strategy {strategy!r}")
 
 
 def crd_schema(crd: dict) -> Optional[dict]:
@@ -145,3 +158,119 @@ def find_crd_for_kind(cluster, storage_kind: str) -> Optional[dict]:
         if crd_storage_kind(crd) == storage_kind:
             return crd
     return None
+
+
+# ------------------------------------------------- versions + conversion
+
+
+def crd_versions(crd: dict) -> list:
+    """Normalized [{name, served, storage}] (apiextensions types.go:67-104
+    CustomResourceDefinitionVersion).  The legacy single spec.version is a
+    one-entry served+storage list; a versions[] entry defaults to
+    served=True so pre-r05 single-version CRDs keep working."""
+    spec = crd.get("spec") or {}
+    out = []
+    for v in spec.get("versions") or []:
+        out.append({
+            "name": v.get("name", ""),
+            "served": bool(v.get("served", True)),
+            "storage": bool(v.get("storage", False)),
+        })
+    if not out and spec.get("version"):
+        out = [{"name": spec["version"], "served": True, "storage": True}]
+    if out and not any(v["storage"] for v in out):
+        out[0]["storage"] = True  # exactly one storage version
+    return out
+
+
+def crd_storage_version(crd: dict) -> str:
+    for v in crd_versions(crd):
+        if v["storage"]:
+            return v["name"]
+    vs = crd_versions(crd)
+    return vs[0]["name"] if vs else ""
+
+
+def crd_served_versions(crd: dict) -> list:
+    return [v["name"] for v in crd_versions(crd) if v["served"]]
+
+
+def convert_cr_objects(cluster, crd: dict, objs: list,
+                       target_version: str) -> list:
+    """Convert custom resources between served/storage versions, in ONE
+    round trip for the whole list (ConversionReview.request.objects is a
+    list — the reference batches a LIST exactly this way,
+    apiextensions-apiserver pkg/apiserver/conversion/webhook_converter.go).
+
+    Strategy None (the default) rewrites apiVersion only — identical
+    schemas across versions (apiextensions types.go ConversionStrategy
+    None).  Strategy Webhook POSTs one ConversionReview to
+    spec.conversion.webhook(ClientConfig) — resolved and trusted exactly
+    like admission webhooks (service refs + caBundle)."""
+    import copy
+    import uuid as _uuid
+
+    spec = crd.get("spec") or {}
+    group = spec.get("group", "")
+    storage_v = crd_storage_version(crd)
+
+    def src_of(obj):
+        return (obj.get("apiVersion") or "").rpartition("/")[2] or storage_v
+
+    if not target_version:
+        return objs
+    need = [i for i, o in enumerate(objs)
+            if src_of(o) != target_version]
+    if not need:
+        return objs
+    conv = spec.get("conversion") or {}
+    strategy = conv.get("strategy") or "None"
+    out_list = list(objs)
+    if strategy == "None":
+        for i in need:
+            out = copy.deepcopy(objs[i])
+            out["apiVersion"] = f"{group}/{target_version}"
+            out_list[i] = out
+        return out_list
+    if strategy != "Webhook":
+        raise SchemaError(f"unknown conversion strategy {strategy!r}")
+    from kubernetes_tpu.apiserver.webhooks import (
+        post_json,
+        resolve_client_config,
+    )
+
+    cc = (conv.get("webhook") or {}).get("clientConfig") \
+        or conv.get("webhookClientConfig") or {}
+    url, ca = resolve_client_config(cluster, cc, crd_storage_kind(crd))
+    wires = []
+    for i in need:
+        wire = copy.deepcopy(objs[i])
+        wire["apiVersion"] = f"{group}/{src_of(objs[i])}"
+        wires.append(wire)
+    review = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "ConversionReview",
+        "request": {
+            "uid": str(_uuid.uuid4()),
+            "desiredAPIVersion": f"{group}/{target_version}",
+            "objects": wires,
+        },
+    }
+    out = post_json(url, review, timeout=10.0, ca_bundle=ca)
+    resp = out.get("response") or {}
+    if (resp.get("result") or {}).get("status", "Success") != "Success":
+        raise SchemaError(
+            "conversion webhook failed: "
+            + str((resp.get("result") or {}).get("message", "")))
+    converted = resp.get("convertedObjects") or []
+    if len(converted) != len(need):
+        raise SchemaError(
+            f"conversion webhook returned {len(converted)} objects "
+            f"for {len(need)}")
+    for i, obj in zip(need, converted):
+        out_list[i] = obj
+    return out_list
+
+
+def convert_cr(cluster, crd: dict, obj: dict, target_version: str) -> dict:
+    return convert_cr_objects(cluster, crd, [obj], target_version)[0]

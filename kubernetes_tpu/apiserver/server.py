@@ -350,11 +350,18 @@ class APIServer:
         port: int = 0,
         admission: Optional[List[Callable[[str, str, dict], dict]]] = None,
         audit_path: Optional[str] = None,
+        audit_policy: Optional[dict] = None,
         authenticator=None,
         authorizer=None,
         tls: Optional["TLSConfig"] = None,
     ):
         self.cluster = cluster if cluster is not None else LocalCluster()
+        # per-request custom-resource version (set by _route_extension,
+        # consumed by the conversion seams; thread-local because the
+        # HTTP server runs one thread per connection)
+        import threading as _threading
+
+        self._cr_req = _threading.local()
         # authn/authz handler-chain slots (config.go:544-550).  Both None =
         # open server (embedded/test mode, the historical behavior); with an
         # authenticator, bad tokens 401 and missing tokens degrade to the
@@ -369,6 +376,13 @@ class APIServer:
         # ResponseComplete — appended to audit_path when configured
         self._audit_f = open(audit_path, "a") if audit_path else None
         self._audit_lock = threading.Lock()
+        # audit policy (audit/policy/checker.go:28-38): first matching
+        # rule's level wins — None drops the event, Metadata logs
+        # verb/resource/code, Request adds the request body,
+        # RequestResponse adds the response body.  No policy = Metadata
+        # for every write (the historical behavior); a policy with no
+        # matching rule logs nothing.
+        self.audit_policy = audit_policy
         # ordered admission chain (mutating-then-validating collapses to
         # "each plugin may mutate or raise")
         self.admission: List[Callable[[str, str, dict], dict]] = list(
@@ -430,27 +444,134 @@ class APIServer:
 
     # ----------------------------------------------------------- admission
 
-    def _audit(self, verb: str, path: str, code: int) -> None:
-        """ResponseComplete audit event (audit/v1 Event slice: level
-        Metadata — verb/resource/code/timestamp, no request bodies)."""
+    def _audit_level(self, verb: str, kind: str, ns: str,
+                     user: str) -> str:
+        """First matching policy rule's level (audit/policy/checker.go:
+        28-38 LevelForPolicy): rules filter on verbs / users /
+        namespaces / resources (each omitted = match-all); an explicit
+        policy with no matching rule audits nothing."""
+        if self.audit_policy is None:
+            return "Metadata"
+        for r in self.audit_policy.get("rules") or []:
+            if r.get("verbs") and verb.lower() not in [
+                    v.lower() for v in r["verbs"]]:
+                continue
+            if r.get("users") and (user or "") not in r["users"]:
+                continue
+            if r.get("namespaces") and ns not in r["namespaces"]:
+                continue
+            groups = r.get("resources")
+            if groups:
+                if not any(
+                    "*" in (g.get("resources") or [])
+                    or kind in (g.get("resources") or [])
+                    for g in groups
+                ):
+                    continue
+            return r.get("level", "Metadata")
+        return "None"
+
+    def _audit(self, verb: str, path: str, code: int,
+               handler=None) -> None:
+        """ResponseComplete audit event (audit/v1 Event), shaped by the
+        policy level: Metadata = verb/resource/code/user; Request adds
+        requestObject; RequestResponse adds responseObject."""
         if self._audit_f is None:
             return
         import time as _t
 
-        line = json.dumps({
+        kind, ns, name = "", "", ""
+        r = self._route(path.partition("?")[0])
+        if r is not None:
+            kind, ns, name = r[0], r[1], r[2]
+        user = self.current_user()
+        username = getattr(user, "name", "") if user is not None else ""
+        level = self._audit_level(verb, kind, ns, username)
+        if level == "None":
+            return
+        ev = {
             "kind": "Event",
             "apiVersion": "audit.k8s.io/v1",
+            "level": level,
             "stage": "ResponseComplete",
             "verb": verb.lower(),
             "requestURI": path,
+            "objectRef": {"resource": kind, "namespace": ns, "name": name},
+            "user": {"username": username},
             "responseStatus": {"code": code},
             "stageTimestamp": _t.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", _t.gmtime()
             ),
-        })
+        }
+        if level in ("Request", "RequestResponse") and handler is not None:
+            body = getattr(handler, "_audit_req_body", None)
+            if body is not None:
+                ev["requestObject"] = body
+        if level == "RequestResponse" and handler is not None:
+            resp = getattr(handler, "_audit_resp_obj", None)
+            if resp is not None:
+                ev["responseObject"] = resp
+        line = json.dumps(ev)
         with self._audit_lock:
             self._audit_f.write(line + "\n")
             self._audit_f.flush()
+
+    def _cr_request_version(self, kind: str):
+        d = getattr(self._cr_req, "data", None)
+        return d[1] if d and d[0] == kind else None
+
+    def _cr_to_request_version(self, kind: str, obj):
+        """READ seam: a custom resource leaves the server in the version
+        the request named (storage -> request conversion)."""
+        if "." not in kind or not isinstance(obj, dict):
+            return obj
+        v = self._cr_request_version(kind)
+        if not v:
+            return obj
+        from kubernetes_tpu.apiserver.extensions import (
+            convert_cr,
+            find_crd_for_kind,
+        )
+
+        crd = find_crd_for_kind(self.cluster, kind)
+        if crd is None:
+            return obj
+        return convert_cr(self.cluster, crd, obj, v)
+
+    def _cr_list_to_request_version(self, kind: str, items: list) -> list:
+        """LIST read seam: one batched ConversionReview for the whole
+        list (webhook_converter.go sends all objects in one review)."""
+        if "." not in kind or not items:
+            return items
+        v = self._cr_request_version(kind)
+        if not v:
+            return items
+        from kubernetes_tpu.apiserver.extensions import (
+            convert_cr_objects,
+            find_crd_for_kind,
+        )
+
+        crd = find_crd_for_kind(self.cluster, kind)
+        if crd is None:
+            return items
+        return convert_cr_objects(self.cluster, crd, items, v)
+
+    def _cr_to_storage_version(self, kind: str, body):
+        """WRITE seam: a custom resource persists in the CRD's storage
+        version whatever version the request used (apiextensions
+        CustomResourceDefinitionVersion.storage)."""
+        if "." not in kind or not isinstance(body, dict):
+            return body
+        from kubernetes_tpu.apiserver.extensions import (
+            convert_cr,
+            crd_storage_version,
+            find_crd_for_kind,
+        )
+
+        crd = find_crd_for_kind(self.cluster, kind)
+        if crd is None:
+            return body
+        return convert_cr(self.cluster, crd, body, crd_storage_version(crd))
 
     def _validate_extension(self, kind: str, body: dict) -> None:
         """Write-path schema checks: typed-field validation for the core
@@ -553,25 +674,30 @@ class APIServer:
         return (kind, ns, name, sub)
 
     def _route_extension(self, group: str, version: str, rest):
-        """Resolve /apis/{group}/{version}/... via CRDs, then APIServices."""
+        """Resolve /apis/{group}/{version}/... via CRDs, then APIServices.
+        Only SERVED versions route (a declared-but-unserved version 404s,
+        apiextensions types.go:67-104); the requested version is recorded
+        per-thread so reads convert storage -> request version and writes
+        convert request -> storage version."""
+        from kubernetes_tpu.apiserver.extensions import crd_served_versions
+
         for crd in self.cluster.list("customresourcedefinitions"):
             spec = crd.get("spec") or {}
             if spec.get("group") != group:
                 continue
-            versions = {spec.get("version")} | {
-                v.get("name") for v in spec.get("versions") or []
-            }
-            if version not in versions:
+            if version not in crd_served_versions(crd):
                 continue
             plural = (spec.get("names") or {}).get("plural", "")
             storage_kind = f"{plural}.{group}"
             if rest[:1] == ["namespaces"] and len(rest) >= 3 and rest[2] == plural:
                 self.cluster.register_kind(storage_kind)  # lazy re-establish
                 name = rest[3] if len(rest) > 3 else ""
+                self._cr_req.data = (storage_kind, version)
                 return (storage_kind, rest[1], name, "")
             if rest[:1] == [plural]:
                 self.cluster.register_kind(storage_kind)
                 name = rest[1] if len(rest) > 1 else ""
+                self._cr_req.data = (storage_kind, version)
                 return (storage_kind, "", name, "")
         for svc in self.cluster.list("apiservices"):
             spec = svc.get("spec") or {}
@@ -831,6 +957,14 @@ class APIServer:
                     # mutating it here would alter live cluster state from
                     # the handler thread, outside the cluster lock
                     out = dict(object_to_dict(kind, obj))
+                    if "." in kind:  # custom resource: serve the REQUEST
+                        try:
+                            out = dict(
+                                outer._cr_to_request_version(kind, out))
+                        except Exception as e:  # conversion webhook down
+                            self._status(500, "InternalError",
+                                         f"conversion failed: {e}")
+                            return
                     out["metadata"] = dict(out.get("metadata") or {})
                     # expose the revision so read-modify-write clients can
                     # round-trip it into PUT's CAS (etcd3 mod_revision analog)
@@ -861,6 +995,14 @@ class APIServer:
                         for o in outer.cluster.list(kind)
                         if not ns or ns_of(o) == ns
                     ]
+                    if "." in kind:
+                        try:
+                            items = outer._cr_list_to_request_version(
+                                kind, items)
+                        except Exception as e:  # conversion webhook down
+                            self._status(500, "InternalError",
+                                         f"conversion failed: {e}")
+                            return
                     # LIST filtering: fieldSelector (apimachinery/pkg/
                     # fields) and labelSelector query params
                     query = self.path.partition("?")[2]
@@ -1439,6 +1581,8 @@ class APIServer:
                                                   locked=True)
                         # schema validation AFTER admission: mutating
                         # plugins must not produce out-of-schema objects
+                        if "." in kind:  # persist the STORAGE version
+                            body = outer._cr_to_storage_version(kind, body)
                         outer._validate_extension(kind, body)
                         obj = _decode(kind, body)
                         rv = outer.cluster.create(kind, obj)
@@ -1491,6 +1635,18 @@ class APIServer:
                     self._status(404, "NotFound", f"{kind} {ns}/{name}")
                     return
                 body = dict(object_to_dict(kind, cur))
+                if "." in kind:
+                    # multi-version CR: the patch is expressed in the
+                    # REQUEST version, so apply it there — convert the
+                    # stored object up, merge, and let the write seam
+                    # convert the result back to storage
+                    try:
+                        body = dict(
+                            outer._cr_to_request_version(kind, body))
+                    except Exception as e:
+                        self._status(500, "InternalError",
+                                     f"conversion failed: {e}")
+                        return
                 ctype = self.headers.get("Content-Type", "")
                 try:
                     if "json-patch" in ctype:
@@ -1526,6 +1682,8 @@ class APIServer:
                     with outer._write_lock:
                         body = outer._admit_split("UPDATE", kind, body,
                                                   locked=True)
+                        if "." in kind:  # persist the STORAGE version
+                            body = outer._cr_to_storage_version(kind, body)
                         outer._validate_extension(kind, body)
                         obj = _decode(kind, body)
                         if kind in (
@@ -1575,6 +1733,8 @@ class APIServer:
                     with outer._write_lock:
                         body = outer._admit_split("UPDATE", kind, body,
                                                   locked=True)
+                        if "." in kind:  # persist the STORAGE version
+                            body = outer._cr_to_storage_version(kind, body)
                         outer._validate_extension(kind, body)
                         expect = meta.get("resourceVersion")
                         obj = _decode(kind, body)
@@ -1680,10 +1840,28 @@ class APIServer:
             verb = getattr(self, "_audit_verb", None)
             if verb is not None:
                 self._audit_verb = None
-                outer._audit(verb, self.path, code)
+                outer._audit(verb, self.path, code, handler=self)
             real_send_response(self, code, message)
 
         Handler.send_response = send_response
+        # policy levels Request/RequestResponse need the bodies: stash the
+        # parsed request body and the outgoing response object on the
+        # handler as they pass through the existing seams
+        real_body = Handler._body
+
+        def _body_stash(self):
+            b = real_body(self)
+            self._audit_req_body = b
+            return b
+
+        Handler._body = _body_stash
+        real_send = Handler._send
+
+        def _send_stash(self, obj, code: int = 200):
+            self._audit_resp_obj = obj
+            real_send(self, obj, code)
+
+        Handler._send = _send_stash
         for method, verb in (
             ("do_POST", "create"), ("do_PUT", "update"),
             ("do_DELETE", "delete"),
@@ -1692,6 +1870,12 @@ class APIServer:
 
             def wrapped(self, _inner=inner, _verb=verb):
                 self._audit_verb = _verb
+                # handler instances persist per keep-alive connection:
+                # clear the body stashes so a bodiless request (DELETE)
+                # cannot inherit the previous request's body into its
+                # audit event
+                self._audit_req_body = None
+                self._audit_resp_obj = None
                 try:
                     _inner(self)
                 finally:
@@ -1699,7 +1883,7 @@ class APIServer:
                         # the handler died before ANY response: still one
                         # event per write attempt (code 0 = no response)
                         self._audit_verb = None
-                        outer._audit(_verb, self.path, 0)
+                        outer._audit(_verb, self.path, 0, handler=self)
 
             setattr(Handler, method, wrapped)
         return Handler

@@ -161,6 +161,60 @@ def _webhook_matches(hook: dict, cluster, op: str, kind: str,
     return True
 
 
+# ----------------------------------------------------- client resolution
+
+
+def resolve_client_config(cluster, cc: dict, name: str = ""):
+    """WebhookClientConfig -> (url, caBundle).  A `service:` reference
+    resolves through the service's Endpoints (the reference's
+    ServiceResolver yields the cluster-IP and relies on kube-proxy; this
+    framework's dataplane is the Endpoints object itself), defaulting
+    port 443 and scheme https — in-cluster admission/conversion traffic
+    is never cleartext.  Shared by admission webhooks and the CRD
+    conversion webhook client (apiserver/pkg/util/webhook/client.go)."""
+    ca = cc.get("caBundle")
+    if cc.get("url"):
+        return cc["url"], ca
+    svc = cc.get("service")
+    if not svc:
+        raise ValueError(f"webhook {name!r} has neither url nor service")
+    ns = svc.get("namespace") or "default"
+    svc_name = svc.get("name") or ""
+    port = int(svc.get("port") or 443)
+    path = svc.get("path") or "/"
+    host = None
+    if cluster.has_kind("endpoints"):
+        ep = cluster.get("endpoints", ns, svc_name)
+        if isinstance(ep, dict):
+            for ss in ep.get("subsets") or []:
+                addrs = ss.get("addresses") or []
+                if addrs:
+                    host = addrs[0].get("ip")
+                    eports = ss.get("ports") or []
+                    if eports:  # endpoints carry the TARGET port
+                        port = int(eports[0].get("port") or port)
+                    break
+    if host is None and cluster.has_kind("services"):
+        so = cluster.get("services", ns, svc_name)
+        if isinstance(so, dict):
+            host = (so.get("spec") or {}).get("clusterIP") \
+                or so.get("clusterIP")
+    if not host:
+        raise ValueError(
+            f"webhook {name!r}: service {ns}/{svc_name} "
+            "has no reachable endpoint")
+    if not path.startswith("/"):
+        path = "/" + path
+    return f"https://{host}:{port}{path}", ca
+
+
+def post_json(url: str, payload: dict, timeout: float,
+              ca_bundle: Optional[str] = None) -> dict:
+    """One HTTPS-aware JSON POST with per-target caBundle trust (the
+    conversion/admission webhook wire call)."""
+    return WebhookDispatcher._http_post(url, payload, timeout, ca_bundle)
+
+
 # ------------------------------------------------------------- dispatch
 
 
@@ -211,48 +265,9 @@ class WebhookDispatcher:
             return json.loads(resp.read() or b"{}")
 
     def _resolve_target(self, hook: dict):
-        """clientConfig -> (url, caBundle).  A `service:` reference
-        resolves through the service's Endpoints to a reachable backend
-        address (the reference's ServiceResolver yields the cluster-IP
-        and relies on kube-proxy; this framework's dataplane is the
-        Endpoints object itself), defaulting port 443 and scheme https —
-        in-cluster admission traffic is never cleartext."""
-        cc = hook.get("clientConfig") or {}
-        ca = cc.get("caBundle")
-        if cc.get("url"):
-            return cc["url"], ca
-        svc = cc.get("service")
-        if not svc:
-            raise ValueError(
-                f"webhook {hook.get('name')!r} has neither url nor service")
-        ns = svc.get("namespace") or "default"
-        name = svc.get("name") or ""
-        port = int(svc.get("port") or 443)
-        path = svc.get("path") or "/"
-        host = None
-        if self.cluster.has_kind("endpoints"):
-            ep = self.cluster.get("endpoints", ns, name)
-            if isinstance(ep, dict):
-                for ss in ep.get("subsets") or []:
-                    addrs = ss.get("addresses") or []
-                    if addrs:
-                        host = addrs[0].get("ip")
-                        eports = ss.get("ports") or []
-                        if eports:  # endpoints carry the TARGET port
-                            port = int(eports[0].get("port") or port)
-                        break
-        if host is None and self.cluster.has_kind("services"):
-            so = self.cluster.get("services", ns, name)
-            if isinstance(so, dict):
-                host = (so.get("spec") or {}).get("clusterIP") \
-                    or so.get("clusterIP")
-        if not host:
-            raise ValueError(
-                f"webhook {hook.get('name')!r}: service {ns}/{name} "
-                "has no reachable endpoint")
-        if not path.startswith("/"):
-            path = "/" + path
-        return f"https://{host}:{port}{path}", ca
+        return resolve_client_config(
+            self.cluster, hook.get("clientConfig") or {},
+            hook.get("name", ""))
 
     def _hooks(self, config_kind: str):
         if not self.cluster.has_kind(config_kind):
