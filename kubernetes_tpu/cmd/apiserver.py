@@ -39,6 +39,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="append one audit.k8s.io/v1 Event JSON line per write here",
     )
     p.add_argument(
+        "--audit-policy", default="",
+        help="JSON audit Policy file (rules with level None/Metadata/"
+        "Request/RequestResponse, audit/policy/checker.go); no policy "
+        "= Metadata for every write",
+    )
+    p.add_argument(
         "--data-dir", default="",
         help="persist the store (WAL + snapshots) under this directory; "
         "empty = in-memory only",
@@ -59,6 +65,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--token-file", default="",
                    help="with RBAC: write the minted admin token here")
     return p
+
+
+def _load_audit_policy(path: str):
+    if not path:
+        return None
+    import json as _json
+    import sys as _sys
+
+    try:
+        with open(path) as f:
+            return _json.load(f)
+    except (OSError, ValueError) as e:
+        _sys.stderr.write(f"error: --audit-policy {path}: {e}\n")
+        raise SystemExit(2)
 
 
 def main(argv=None) -> int:
@@ -102,6 +122,7 @@ def main(argv=None) -> int:
     srv = APIServer(
         cluster=cluster, host=args.host, port=args.port,
         audit_path=args.audit_log or None,
+        audit_policy=_load_audit_policy(args.audit_policy),
         authenticator=authn, authorizer=authz,
     )
     if not args.disable_admission:

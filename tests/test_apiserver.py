@@ -474,6 +474,57 @@ def test_audit_log_records_writes(tmp_path):
     assert events[0]["requestURI"] == "/api/v1/nodes"
 
 
+def test_audit_policy_levels(tmp_path):
+    """VERDICT r4 #10 (audit/policy/checker.go:28-38): the first matching
+    rule's level shapes the event — None drops it, Metadata logs no
+    bodies, Request carries requestObject, RequestResponse adds
+    responseObject; a policy with no matching rule logs nothing."""
+    audit = str(tmp_path / "audit.jsonl")
+    policy = {
+        "kind": "Policy",
+        "rules": [
+            {"level": "None",
+             "resources": [{"resources": ["events"]}]},
+            {"level": "RequestResponse",
+             "resources": [{"resources": ["configmaps"]}]},
+            {"level": "Request", "verbs": ["create"],
+             "resources": [{"resources": ["pods"]}]},
+            {"level": "Metadata",
+             "resources": [{"resources": ["nodes"]}]},
+        ],
+    }
+    srv = APIServer(audit_path=audit, audit_policy=policy).start()
+    try:
+        u = srv.url
+        _req(f"{u}/api/v1/nodes", "POST", node_to_dict(make_node("n1")))
+        _req(f"{u}/api/v1/namespaces/default/pods", "POST",
+             pod_to_dict(make_pod("p1", cpu="100m", mem="64Mi")))
+        _req(f"{u}/api/v1/namespaces/default/configmaps", "POST",
+             {"kind": "ConfigMap", "namespace": "default", "name": "cm",
+              "metadata": {"name": "cm", "namespace": "default"},
+              "data": {"k": "v"}})
+        # no rule matches secrets -> not audited at all
+        _req(f"{u}/api/v1/namespaces/default/secrets", "POST",
+             {"kind": "Secret", "namespace": "default", "name": "s",
+              "metadata": {"name": "s", "namespace": "default"}})
+    finally:
+        srv.stop()
+    events = [json.loads(l) for l in open(audit) if l.strip()]
+    by_res = {e["objectRef"]["resource"]: e for e in events}
+    assert set(by_res) == {"nodes", "pods", "configmaps"}
+    # Metadata: no bodies
+    assert "requestObject" not in by_res["nodes"]
+    assert by_res["nodes"]["level"] == "Metadata"
+    # Request: request body only
+    assert by_res["pods"]["level"] == "Request"
+    assert by_res["pods"]["requestObject"]["metadata"]["name"] == "p1"
+    assert "responseObject" not in by_res["pods"]
+    # RequestResponse: both
+    assert by_res["configmaps"]["level"] == "RequestResponse"
+    assert by_res["configmaps"]["requestObject"]["data"] == {"k": "v"}
+    assert "responseObject" in by_res["configmaps"]
+
+
 def test_field_and_label_selectors_on_list(server):
     from fixtures import make_pod as _mk
 
