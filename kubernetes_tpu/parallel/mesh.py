@@ -34,16 +34,27 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = NODE_AXIS) -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
-def shard_cluster(cluster: ClusterTensors, mesh: Mesh) -> ClusterTensors:
+def _mesh_2level(outer: int, inner: int, axes) -> Mesh:
+    devs = jax.devices()
+    if len(devs) < outer * inner:
+        raise ValueError(
+            f"mesh {axes} needs {outer}x{inner} devices, have {len(devs)}")
+    return Mesh(np.array(devs[: outer * inner]).reshape(outer, inner), axes)
+
+
+def shard_cluster(cluster: ClusterTensors, mesh: Mesh,
+                  spec_axis=NODE_AXIS) -> ClusterTensors:
     """Place every node-axis column sharded over the mesh; small cluster-wide
-    vectors (pair_topo_key [TP]) replicated."""
+    vectors (pair_topo_key [TP]) replicated.  spec_axis names the mesh
+    axis (or axis tuple, e.g. ("dcn", "ici")) the node dimension splits
+    over — ONE classification heuristic for every layout."""
     n = cluster.n_nodes
     out = {}
     for f in dataclasses.fields(cluster):
         v = getattr(cluster, f.name)
         arr = np.asarray(v)
         if arr.ndim >= 1 and arr.shape[0] == n:
-            spec = P(NODE_AXIS, *([None] * (arr.ndim - 1)))
+            spec = P(spec_axis, *([None] * (arr.ndim - 1)))
         else:
             spec = P(*([None] * arr.ndim))
         out[f.name] = jax.device_put(arr, NamedSharding(mesh, spec))
@@ -72,9 +83,7 @@ def make_mesh_2d(pod_devices: int, node_devices: int) -> Mesh:
     the unsharded program (tests/test_mesh.py).  This is the layout that
     scales BOTH a 100k-pod backlog and a 50k-node fleet past one chip's
     HBM."""
-    devs = np.array(jax.devices()[: pod_devices * node_devices])
-    return Mesh(devs.reshape(pod_devices, node_devices),
-                (POD_AXIS, NODE_AXIS))
+    return _mesh_2level(pod_devices, node_devices, (POD_AXIS, NODE_AXIS))
 
 
 def shard_pods(tree, mesh: Mesh, n_pods: int):
@@ -92,3 +101,36 @@ def shard_pods(tree, mesh: Mesh, n_pods: int):
         return jax.device_put(arr, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map(put, tree)
+
+
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+
+def make_mesh_multihost(n_hosts: int, chips_per_host: int) -> Mesh:
+    """Two-level (dcn x ici) mesh for multi-host scale-out: the outer axis
+    spans hosts (DCN links), the inner axis the chips within each host
+    (ICI links).  The node axis shards over BOTH axes flattened —
+    `P(("dcn", "ici"))` — so each host owns a contiguous node block and
+    each chip a sub-block.  XLA then lowers cross-shard reductions
+    (argmax/min/max in host selection and score normalization)
+    hierarchically: intra-host partials ride ICI, only the per-host
+    partial crosses DCN — the scaling-book recipe for multi-host meshes,
+    with no hand-written collectives.  On real hardware the device order
+    from jax.devices() already groups chips by host (process index), so
+    the reshape below maps the outer axis onto DCN boundaries; under the
+    virtual CPU mesh the layout is exercised structurally and validated
+    by placement identity (tests/test_mesh.py).
+
+    This is the multi-host analog of the reference's kubemark scale-out:
+    a 50k-node fleet splits across hosts at the DCN level while each
+    host's chips scan their node block in parallel (SURVEY §2.4 last
+    row, previously deferred)."""
+    return _mesh_2level(n_hosts, chips_per_host, (DCN_AXIS, ICI_AXIS))
+
+
+def shard_cluster_multihost(cluster: ClusterTensors, mesh: Mesh) -> ClusterTensors:
+    """shard_cluster over the flattened (dcn, ici) axes: node columns
+    split across every chip on every host; cluster-wide vectors
+    replicate."""
+    return shard_cluster(cluster, mesh, spec_axis=(DCN_AXIS, ICI_AXIS))

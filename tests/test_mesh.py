@@ -203,3 +203,42 @@ def test_speculative_engine_2d_pods_by_nodes_mesh():
     h_s = np.asarray(jax.block_until_ready(h_s))
     np.testing.assert_array_equal(h_s, h_ref)
     assert (h_s[:16] >= 0).all()
+
+
+def test_multihost_dcn_ici_mesh_matches_unsharded():
+    """SURVEY §2.4 (last row, previously deferred): the two-level
+    (dcn x ici) multi-host mesh — node axis sharded over BOTH axes
+    flattened, so each host owns a node block and each chip a
+    sub-block.  Cross-shard reductions lower hierarchically (intra-host
+    partials over ICI, per-host partials over DCN); placements must be
+    bit-identical to the unsharded program for both engines."""
+    from kubernetes_tpu.models.batched import make_sequential_scheduler
+    from kubernetes_tpu.models.speculative import make_speculative_scheduler
+    from kubernetes_tpu.parallel.mesh import (
+        make_mesh_multihost,
+        shard_cluster_multihost,
+    )
+
+    enc, cluster, batch, ports = _world()
+    kw = dict(
+        unsched_taint_key=enc.interner.intern(
+            "node.kubernetes.io/unschedulable"),
+        zone_key_id=enc.getzone_key,
+    )
+    mesh = make_mesh_multihost(2, N_DEV // 2)  # 2 "hosts" x 4 "chips"
+    for maker in (make_sequential_scheduler, make_speculative_scheduler):
+        fn = maker(**kw)
+        hosts_ref, new_ref = fn(cluster, batch, ports, np.int32(0))
+        hosts_ref = np.asarray(hosts_ref)
+        assert (hosts_ref[:12] >= 0).all()
+        cluster_s = shard_cluster_multihost(cluster, mesh)
+        with mesh:
+            hosts_s, new_s = fn(
+                cluster_s, replicate(batch, mesh),
+                replicate(ports, mesh), np.int32(0))
+        np.testing.assert_array_equal(np.asarray(hosts_s), hosts_ref)
+        np.testing.assert_array_equal(
+            np.asarray(new_s.requested), np.asarray(new_ref.requested))
+        # the committed state is genuinely split across all 8 shards
+        shard_set = {s.index for s in new_s.requested.addressable_shards}
+        assert len(shard_set) == N_DEV
