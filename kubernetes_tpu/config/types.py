@@ -54,6 +54,9 @@ class KubeSchedulerConfiguration:
     # schedules one pod per cycle)
     batch_size: int = 256
     batch_window_s: float = 0.001
+    # "speculative" (hybrid exactness fallback, the default) or
+    # "sequential" (always the exact lax.scan)
+    engine: str = "speculative"
 
     def build_profile(self, interner=None) -> SchedulingProfile:
         """CreateFromConfig / CreateFromProvider (scheduler.go:162-192)."""
@@ -94,6 +97,7 @@ class KubeSchedulerConfiguration:
             feature_gates=FeatureGates(d.get("featureGates")),
             batch_size=int(d.get("batchSize", 256)),
             batch_window_s=float(d.get("batchWindowSeconds", 0.001)),
+            engine=d.get("engine", "speculative"),
         )
 
     @staticmethod

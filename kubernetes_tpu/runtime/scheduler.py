@@ -85,6 +85,7 @@ class SchedulerConfig:
         return SchedulerConfig(
             batch_size=cc.batch_size,
             batch_window_s=cc.batch_window_s,
+            engine=cc.engine,
             percentage_of_nodes_to_score=cc.percentage_of_nodes_to_score,
             disable_preemption=cc.disable_preemption,
             scheduler_name=cc.scheduler_name,
