@@ -389,7 +389,7 @@ def run_density(args) -> dict:
     return run_sustained_density(
         nodes=args.nodes, pods=args.pods, batch=args.batch,
         interval_s=args.density_interval, churn_fraction=args.density_churn,
-        engine=args.engine,
+        engine=args.engine, arrival_rate=args.density_arrival_rate,
     )
 
 
@@ -526,6 +526,9 @@ def _child_cmd(args, platform: str | None) -> list:
         cmd += ["--density",
                 "--density-interval", str(args.density_interval),
                 "--density-churn", str(args.density_churn)]
+        if args.density_arrival_rate is not None:
+            cmd += ["--density-arrival-rate",
+                    str(args.density_arrival_rate)]
     if platform:
         cmd += ["--platform", platform]
     return cmd
@@ -666,6 +669,10 @@ def main():
                     help="per-interval throughput bucket seconds")
     ap.add_argument("--density-churn", type=float, default=0.1,
                     help="fraction of scheduled pods deleted + replaced")
+    ap.add_argument("--density-arrival-rate", type=float, default=None,
+                    help="paced pod arrival (pods/s) instead of deep-queue "
+                    "waves: below saturation this measures the true per-pod "
+                    "latency distribution vs the <=5s e2e SLO")
     ap.add_argument("--lock-timeout", type=float, default=300.0, help="seconds")
     ap.add_argument("--init-timeout", type=float, default=600.0,
                     help="seconds before a hung backend init fails the single "

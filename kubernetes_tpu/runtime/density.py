@@ -40,11 +40,23 @@ def run_sustained_density(
     churn_fraction: float = 0.1,
     engine: str = "speculative",
     wave: Optional[int] = None,
+    arrival_rate: Optional[float] = None,
 ) -> dict:
     """Schedule `pods` pods through a live control plane on `nodes` hollow
     nodes, pods arriving in waves with churn, and return the bench JSON
-    shape with per-interval pods/s in detail.intervals."""
+    shape with per-interval pods/s in detail.intervals.
+
+    arrival_rate (pods/s) switches from deep-queue waves to PACED
+    arrival — pod i becomes pending at t0 + i/rate, the reference
+    density harness's controlled create rate.  Below the saturation
+    throughput this measures the true per-pod queue-add -> bind-commit
+    latency distribution (detail.latency_ms), the pair the e2e SLO
+    names: p50 = p90 = p99 <= 5s (density.go:56,988-990)."""
     from kubernetes_tpu.api.factory import make_node, make_pod
+    from kubernetes_tpu.utils import metrics as m
+
+    if arrival_rate is not None and arrival_rate <= 0:
+        raise ValueError(f"arrival_rate must be > 0, got {arrival_rate}")
 
     zone = "failure-domain.beta.kubernetes.io/zone"
     cluster = LocalCluster()
@@ -82,48 +94,69 @@ def run_sustained_density(
     churned = 0
     next_id = pods  # replacement pods get fresh ids past the base range
 
+    # per-pod queue-add -> bind-commit latency rides the runtime's own
+    # e2e histogram (scheduler._record_scheduled); a fresh instance
+    # isolates this run's distribution
+    lat_hist = m.Histogram("density_e2e", "")
+    orig_hist = m.E2E_LATENCY
+    m.E2E_LATENCY = lat_hist
+
     # first cycle = jit compile + first placements: measured separately
     # (the reference's harness likewise excludes master setup from the
     # sampled window); its binds stamp at t0 so every pod still counts
-    while created < pods and len(queue) < wave:
-        n = min(wave, pods - created)
-        for i in range(created, created + n):
-            cluster.add_pod(pending_pod(i))
-        created += n
-    t_c0 = time.monotonic()
-    first_placed = sched.run_once(timeout=0.05)
-    compile_s = time.monotonic() - t_c0
-    t0 = time.monotonic()
-    bind_times.extend([t0] * first_placed)
+    warm_n = min(wave, pods) if arrival_rate is None else min(batch, pods)
+    while created < warm_n:
+        cluster.add_pod(pending_pod(created))
+        created += 1
+    try:
+        t_c0 = time.monotonic()
+        first_placed = sched.run_once(timeout=0.05)
+        compile_s = time.monotonic() - t_c0
+        t0 = time.monotonic()
+        bind_times.extend([t0] * first_placed)
+        if arrival_rate is not None:
+            # the compile cycle's queue-wait samples would dominate the
+            # distribution: restart the histogram for the PACED window
+            lat_hist = m.Histogram("density_e2e", "")
+            m.E2E_LATENCY = lat_hist
 
-    while True:
-        # arrival wave: keep the queue fed until the base population is in
-        while created < pods and len(queue) < wave:
-            n = min(wave, pods - created)
-            for i in range(created, created + n):
-                cluster.add_pod(pending_pod(i))
-            created += n
-        placed = sched.run_once(timeout=0.05)
-        now = time.monotonic()
-        bind_times.extend([now] * placed)
-        # churn: delete a slice of scheduled pods and replace them with
-        # fresh pending ones (runners.go's delete/create strategies) —
-        # bounded by the configured fraction of the BASE population
-        if placed and churned < int(pods * churn_fraction):
-            kill = min(max(1, placed // 10),
-                       int(pods * churn_fraction) - churned)
-            victims = [r.pod for r in sched.results[-placed:]
-                       if r.node is not None][:kill]
-            for v in victims:
-                cluster.delete("pods", v.namespace, v.name)
-                cluster.add_pod(pending_pod(next_id))
-                next_id += 1
-                churned += 1
-        if created >= pods and len(queue) == 0:
-            break
-        if now - t0 > 3600:  # hard safety stop
-            break
-    dt = time.monotonic() - t0
+        while True:
+            if arrival_rate is None:
+                # deep-queue waves: keep the queue fed (saturation)
+                while created < pods and len(queue) < wave:
+                    n = min(wave, pods - created)
+                    for i in range(created, created + n):
+                        cluster.add_pod(pending_pod(i))
+                    created += n
+            else:
+                # paced arrival: pod i due at t0 + (i - warm)/rate
+                due = warm_n + int((time.monotonic() - t0) * arrival_rate)
+                while created < min(due, pods):
+                    cluster.add_pod(pending_pod(created))
+                    created += 1
+            placed = sched.run_once(timeout=0.05)
+            now = time.monotonic()
+            bind_times.extend([now] * placed)
+            # churn: delete a slice of scheduled pods and replace them
+            # with fresh pending ones (runners.go's delete/create
+            # strategies) — bounded by the configured fraction
+            if placed and churned < int(pods * churn_fraction):
+                kill = min(max(1, placed // 10),
+                           int(pods * churn_fraction) - churned)
+                victims = [r.pod for r in sched.results[-placed:]
+                           if r.node is not None][:kill]
+                for v in victims:
+                    cluster.delete("pods", v.namespace, v.name)
+                    cluster.add_pod(pending_pod(next_id))
+                    next_id += 1
+                    churned += 1
+            if created >= pods and len(queue) == 0:
+                break
+            if now - t0 > 3600:  # hard safety stop
+                break
+        dt = time.monotonic() - t0
+    finally:
+        m.E2E_LATENCY = orig_hist  # restore the global histogram
 
     total_bound = len(bind_times)
     rel = np.asarray(bind_times) - t0
@@ -148,6 +181,16 @@ def run_sustained_density(
         "min_interval_rate": min(sustained) if sustained else 0.0,
         "unschedulable": sum(
             1 for r in sched.results if r.node is None),
+        # queue-add -> bind-commit percentiles from the runtime's own e2e
+        # histogram (bucket upper bounds); under paced arrival this is
+        # the e2e SLO pair: p50 = p90 = p99 <= 5s (density.go:988-990)
+        "latency_ms": {
+            p: (round(q * 1000, 1) if np.isfinite(q) else "gt_32s")
+            for p, q in (("p50", lat_hist.quantile(0.5)),
+                         ("p90", lat_hist.quantile(0.9)),
+                         ("p99", lat_hist.quantile(0.99)))
+        },
+        "arrival_rate": arrival_rate,
     }
     return {
         "metric": "sustained_density_pods_per_sec_1k_nodes",

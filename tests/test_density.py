@@ -21,3 +21,19 @@ def test_sustained_density_small_config():
     assert round(total) == d["pods_bound"]
     # the run is measured AFTER the compile cycle (recorded separately)
     assert d["first_cycle_seconds"] > 0
+
+
+def test_paced_arrival_measures_slo_latency():
+    """Paced arrival below saturation: per-pod queue-add -> bind-commit
+    latency must sit far inside the reference's e2e SLO (p99 <= 5s,
+    density.go:56,988-990), and throughput tracks the arrival rate."""
+    out = run_sustained_density(
+        nodes=50, pods=600, batch=128, interval_s=0.5,
+        churn_fraction=0.0, arrival_rate=400.0)
+    d = out["detail"]
+    assert d["pods_bound"] == 600
+    assert d["arrival_rate"] == 400.0
+    lat = d["latency_ms"]
+    assert isinstance(lat["p99"], float) and lat["p99"] <= 5000.0
+    # throughput ~ arrival rate (not saturation): within 50% above/below
+    assert 200.0 <= out["value"] <= 800.0
