@@ -330,17 +330,30 @@ def make_speculative_scheduler(
         # convergence (founder-then-mates bootstrap chains) does NOT trip
         # it: gated mates are infeasible (empty mask row) and unrelated
         # to other groups' founders.
-        passed_over = c["active"] & ~accept              # [i]
-        later = tril.T > 0                               # [i, j]: j > i
-        interf = mask[:, hosts]                          # [i, j] = mask[i, host_j]
         if aff is not None:
+            passed_over = c["active"] & ~accept          # [i]
+            later = tril.T > 0                           # [i, j]: j > i
+            interf = mask[:, hosts]                      # [i, j] = mask[i, host_j]
             a_any = jnp.any(aff.aff_match, axis=2)       # [x, y]: x sats y's aff
             n_any = jnp.any(aff.anti_match, axis=2)      # [x, y]: x matches y's anti
             rel = a_any | a_any.T | n_any | n_any.T      # either direction
             interf = interf | rel
-        inv_new = jnp.any(
-            passed_over[:, None] & accept[None, :] & later & interf
-        )
+            inv_new = jnp.any(
+                passed_over[:, None] & accept[None, :] & later & interf
+            )
+        else:
+            # plain batches: the inversion term is subsumed by the other
+            # two sentinels, so skip its [B, B] work on the hot path.
+            # Invariant: a passed-over pod is either infeasible this
+            # round (it retires with hosts=-1 -> the unscheduled sentinel
+            # fires) or bounced — and in any round with a bounce, the
+            # EARLIEST bounced proposer on that node has only accepted
+            # pods before it (prior_acc == prior for it), so its bounce
+            # is a real_bounce and that sentinel fires.  This subsumption
+            # argument does NOT carry to affinity batches (aviol bounces
+            # are excluded from real_bounce; domain openings retire
+            # nothing), which keep the full inversion term above.
+            inv_new = jnp.asarray(False)
         accf = accept[:, None].astype(jnp.float32)
         # the accept pass is conservative (earlier proposers count even
         # if they themselves bounce), which never overcommits but can
