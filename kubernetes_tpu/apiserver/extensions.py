@@ -10,6 +10,8 @@ module holds the pure logic.
 
 from __future__ import annotations
 
+import json
+
 from typing import Optional
 
 
@@ -64,12 +66,50 @@ _TYPES = {
 
 def validate_schema(obj, schema: dict, path: str = "") -> None:
     """Validate obj against the supported openAPIV3Schema subset: type,
-    properties, required, items, enum, minimum/maximum, pattern,
-    min/maxLength, min/maxItems, additionalProperties (bool or schema),
-    nullable.  Raises SchemaError naming the offending path
+    properties, required, items, enum, minimum/maximum (+ exclusive
+    variants), multipleOf, pattern, min/maxLength, min/maxItems,
+    uniqueItems, min/maxProperties, additionalProperties (bool or
+    schema), nullable, and the composition keywords allOf / anyOf /
+    oneOf / not.  Raises SchemaError naming the offending path
     (apiextensions validation.go behavior)."""
     if obj is None and schema.get("nullable"):
         return
+    for sub in schema.get("allOf") or []:
+        validate_schema(obj, sub, path)
+    any_of = schema.get("anyOf")
+    if any_of:
+        errs = []
+        for sub in any_of:
+            try:
+                validate_schema(obj, sub, path)
+                break
+            except SchemaError as e:
+                errs.append(str(e))
+        else:
+            raise SchemaError(
+                f"{path or '<root>'}: matches no anyOf branch "
+                f"({'; '.join(errs[:3])})")
+    one_of = schema.get("oneOf")
+    if one_of:
+        matched = 0
+        for sub in one_of:
+            try:
+                validate_schema(obj, sub, path)
+                matched += 1
+            except SchemaError:
+                pass
+        if matched != 1:
+            raise SchemaError(
+                f"{path or '<root>'}: matches {matched} oneOf branches "
+                "(need exactly 1)")
+    if "not" in schema:
+        try:
+            validate_schema(obj, schema["not"], path)
+        except SchemaError:
+            pass
+        else:
+            raise SchemaError(
+                f"{path or '<root>'}: matches the 'not' schema")
     t = schema.get("type")
     if t:
         if t == "integer":
@@ -87,10 +127,40 @@ def validate_schema(obj, schema: dict, path: str = "") -> None:
     if "enum" in schema and obj not in schema["enum"]:
         raise SchemaError(f"{path or '<root>'}: {obj!r} not in {schema['enum']}")
     if isinstance(obj, (int, float)) and not isinstance(obj, bool):
-        if "minimum" in schema and obj < schema["minimum"]:
-            raise SchemaError(f"{path}: {obj} < minimum {schema['minimum']}")
-        if "maximum" in schema and obj > schema["maximum"]:
-            raise SchemaError(f"{path}: {obj} > maximum {schema['maximum']}")
+        def _bound(key, excl_key):
+            """(limit, exclusive) handling BOTH exclusive forms: the
+            OpenAPI 3.0 boolean flag next to minimum/maximum and the
+            2019-draft numeric form where exclusiveMinimum IS the
+            bound."""
+            excl = schema.get(excl_key)
+            if isinstance(excl, bool):
+                return schema.get(key), excl
+            if isinstance(excl, (int, float)):
+                return excl, True
+            return schema.get(key), False
+
+        lo, lo_x = _bound("minimum", "exclusiveMinimum")
+        if lo is not None:
+            if lo_x and obj <= lo:
+                raise SchemaError(f"{path}: {obj} <= exclusive minimum {lo}")
+            if not lo_x and obj < lo:
+                raise SchemaError(f"{path}: {obj} < minimum {lo}")
+        hi, hi_x = _bound("maximum", "exclusiveMaximum")
+        if hi is not None:
+            if hi_x and obj >= hi:
+                raise SchemaError(f"{path}: {obj} >= exclusive maximum {hi}")
+            if not hi_x and obj > hi:
+                raise SchemaError(f"{path}: {obj} > maximum {hi}")
+        if schema.get("multipleOf"):
+            mult = schema["multipleOf"]
+            if isinstance(obj, int) and isinstance(mult, int):
+                bad = obj % mult != 0  # exact for arbitrary-size ints
+            else:
+                q = obj / mult
+                bad = abs(q - round(q)) > 1e-9
+            if bad:
+                raise SchemaError(
+                    f"{path}: {obj} is not a multiple of {mult}")
     if isinstance(obj, str):
         if "pattern" in schema:
             import re as _re
@@ -106,6 +176,12 @@ def validate_schema(obj, schema: dict, path: str = "") -> None:
             raise SchemaError(f"{path}: longer than maxLength "
                               f"{schema['maxLength']}")
     if isinstance(obj, dict):
+        if "minProperties" in schema and len(obj) < schema["minProperties"]:
+            raise SchemaError(f"{path or '<root>'}: fewer than "
+                              f"minProperties {schema['minProperties']}")
+        if "maxProperties" in schema and len(obj) > schema["maxProperties"]:
+            raise SchemaError(f"{path or '<root>'}: more than "
+                              f"maxProperties {schema['maxProperties']}")
         for req in schema.get("required") or []:
             if req not in obj:
                 raise SchemaError(f"{path or '<root>'}: missing required "
@@ -131,6 +207,18 @@ def validate_schema(obj, schema: dict, path: str = "") -> None:
         if "maxItems" in schema and len(obj) > schema["maxItems"]:
             raise SchemaError(f"{path}: more than maxItems "
                               f"{schema['maxItems']}")
+        if schema.get("uniqueItems"):
+            # canonical-form keys: O(n) via a set, and type-aware so the
+            # JSON values 1 and true stay DISTINCT (Python True == 1)
+            seen = set()
+            for item in obj:
+                key = (type(item).__name__,
+                       json.dumps(item, sort_keys=True, default=str))
+                if key in seen:
+                    raise SchemaError(
+                        f"{path or '<root>'}: duplicate item {item!r} "
+                        "(uniqueItems)")
+                seen.add(key)
         if "items" in schema:
             for i, item in enumerate(obj):
                 validate_schema(item, schema["items"], f"{path}[{i}]")

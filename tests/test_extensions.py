@@ -397,3 +397,44 @@ def test_crd_webhook_conversion():
     finally:
         srv.stop()
         hook.shutdown()
+
+
+def test_schema_composition_and_numeric_keywords():
+    """openAPIV3Schema widened subset (apiextensions validation.go):
+    allOf/anyOf/oneOf/not, exclusive bounds, multipleOf, uniqueItems,
+    min/maxProperties."""
+    from kubernetes_tpu.apiserver.extensions import validate_schema
+
+    sch = {
+        "type": "object",
+        "properties": {
+            "mode": {"anyOf": [{"type": "string"},
+                               {"type": "integer"}]},
+            "size": {"type": "integer", "minimum": 0,
+                     "exclusiveMinimum": True, "multipleOf": 4},
+            "kind": {"oneOf": [
+                {"type": "string", "pattern": "a"},
+                {"type": "string", "pattern": "b"},
+            ]},
+            "tags": {"type": "array", "uniqueItems": True},
+            "meta": {"type": "object", "maxProperties": 2},
+            "never": {"not": {"type": "string"}},
+        },
+        "allOf": [{"required": ["size"]}],
+    }
+    ok = {"mode": "auto", "size": 8, "kind": "alpha",
+          "tags": ["x", "y"], "meta": {"a": 1}, "never": 3}
+    validate_schema(ok, sch)
+    for bad, why in (
+        ({"size": 0}, "exclusiveMinimum"),
+        ({"size": 6}, "multipleOf"),
+        ({"size": 8, "mode": 1.5}, "anyOf"),
+        ({"size": 8, "kind": "ab"}, "oneOf matches both"),
+        ({"size": 8, "kind": "xyz"}, "oneOf matches none"),
+        ({"size": 8, "tags": ["x", "x"]}, "uniqueItems"),
+        ({"size": 8, "meta": {"a": 1, "b": 2, "c": 3}}, "maxProperties"),
+        ({"size": 8, "never": "str"}, "not"),
+        ({}, "allOf required"),
+    ):
+        with pytest.raises(SchemaError):
+            validate_schema(bad, sch)
