@@ -1416,6 +1416,50 @@ class APIServer:
             # ------------------------------------------------------ writes
 
             def do_POST(self):
+                if (self.path.split("?")[0].rstrip("/")
+                        == "/apis/authorization.k8s.io/v1"
+                        "/selfsubjectaccessreviews"):
+                    # SelfSubjectAccessReview (registry/authorization/
+                    # selfsubjectaccessreview/rest.go): any AUTHENTICATED
+                    # caller may ask "can I ...?" about itself — the
+                    # kubectl auth can-i backend.  Anonymous callers are
+                    # rejected (system:unauthenticated has no SSAR grant
+                    # upstream; answering would let a scanner enumerate
+                    # system:anonymous's grants)
+                    user = self._authenticate()
+                    if user is None:
+                        return
+                    if (outer.authenticator is not None
+                            and user.name == "system:anonymous"):
+                        self._status(403, "Forbidden",
+                                     "anonymous cannot create "
+                                     "selfsubjectaccessreviews")
+                        return
+                    try:
+                        body = self._body()
+                    except ValueError:
+                        self._status(400, "BadRequest", "invalid JSON")
+                        return
+                    ra = ((body.get("spec") or {})
+                          .get("resourceAttributes") or {})
+                    # subresource folds into the resource string exactly
+                    # as the serving path authorizes ("pods/exec")
+                    resource = ra.get("resource", "")
+                    if ra.get("subresource"):
+                        resource = f"{resource}/{ra['subresource']}"
+                    allowed = (outer.authorizer is None
+                               or outer.authorizer.authorize(
+                                   user,
+                                   ra.get("verb", ""),
+                                   resource,
+                                   ra.get("namespace", ""),
+                                   ra.get("name", "")))
+                    self._send({
+                        "kind": "SelfSubjectAccessReview",
+                        "apiVersion": "authorization.k8s.io/v1",
+                        "status": {"allowed": bool(allowed)},
+                    }, code=201)
+                    return
                 r = outer._route(self.path)
                 if r is None:
                     self._status(404, "NotFound", self.path)

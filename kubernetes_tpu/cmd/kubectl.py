@@ -341,6 +341,12 @@ def main(argv=None) -> int:
     pf.add_argument("--once", action="store_true",
                     help="serve one connection then exit (tests)")
 
+    au = sub.add_parser("auth", parents=[common])
+    au.add_argument("subverb", choices=("can-i",))
+    au.add_argument("canverb", help="e.g. create")
+    au.add_argument("resource", help="e.g. pods or pods/exec")
+    au.add_argument("name", nargs="?", default="")
+
     tp = sub.add_parser("top", parents=[common])
     tp.add_argument("what", choices=("nodes", "node", "pods", "pod"))
     tp.add_argument("name", nargs="?", default="")
@@ -668,6 +674,21 @@ def main(argv=None) -> int:
         if out.get("stderr"):
             sys.stderr.write(out["stderr"])
         return int(out.get("exitCode", 0))
+
+    if args.verb == "auth":
+        # kubectl auth can-i (pkg/kubectl/cmd/auth/cani.go): a
+        # SelfSubjectAccessReview round trip; exit 0 on yes, 1 on no
+        out = _req(args.server, "POST",
+                   "/apis/authorization.k8s.io/v1/selfsubjectaccessreviews",
+                   {"spec": {"resourceAttributes": {
+                       "verb": args.canverb, "resource": args.resource,
+                       "namespace": ns, "name": args.name}}})
+        if isinstance(out, dict) and out.get("kind") == "Status":
+            print(out.get("message", ""), file=sys.stderr)
+            return 1
+        allowed = bool((out.get("status") or {}).get("allowed"))
+        print("yes" if allowed else "no")
+        return 0 if allowed else 1
 
     if args.verb == "attach":
         # cmd/attach/attach.go distilled: this framework's containers are

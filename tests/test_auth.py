@@ -499,3 +499,60 @@ def test_rbac_namespaced_binding_scoping_with_index():
     assert authz.authorize(bot, "update", "configmaps", "team-a")
     assert not authz.authorize(bot, "update", "configmaps", "team-b")
     assert not authz.authorize(bot, "update", "configmaps", "")
+
+
+def test_self_subject_access_review_and_kubectl_can_i(rbac_server, capsys):
+    """SelfSubjectAccessReview (registry/authorization/
+    selfsubjectaccessreview/rest.go) + kubectl auth can-i
+    (cmd/auth/cani.go): the admin can create pods, a viewer-bound user
+    can get but not create, and exit codes follow yes/no."""
+    srv, cluster = rbac_server
+    ssar = "/apis/authorization.k8s.io/v1/selfsubjectaccessreviews"
+
+    def can(token, verb, resource, ns="default"):
+        code, out = _req(srv.url + ssar, "POST",
+                         {"spec": {"resourceAttributes": {
+                             "verb": verb, "resource": resource,
+                             "namespace": ns}}},
+                         token=token)
+        assert code == 201, (code, out)
+        return out["status"]["allowed"]
+
+    assert can("admintok", "create", "pods") is True
+    # subresources fold into the resource string ("pods/exec")
+    code, out = _req(srv.url + ssar, "POST",
+                     {"spec": {"resourceAttributes": {
+                         "verb": "create", "resource": "pods",
+                         "subresource": "exec",
+                         "namespace": "default"}}},
+                     token="admintok")
+    assert code == 201 and out["status"]["allowed"] is True
+    # anonymous callers are rejected, not answered
+    code, _ = _req(srv.url + ssar, "POST",
+                   {"spec": {"resourceAttributes": {
+                       "verb": "get", "resource": "pods"}}})
+    assert code == 403
+    # a read-only user: Role+Binding granting get/list on pods
+    cluster.create("roles", {
+        "namespace": "default", "name": "pod-reader",
+        "rules": [{"verbs": ["get", "list"], "resources": ["pods"]}],
+    })
+    cluster.create("rolebindings", {
+        "namespace": "default", "name": "reader-binding",
+        "roleRef": {"kind": "Role", "name": "pod-reader"},
+        "subjects": [{"kind": "User", "name": "viewer"}],
+    })
+    srv.authenticator.add_static("viewtok", "viewer", ())
+    assert can("viewtok", "get", "pods") is True
+    assert can("viewtok", "create", "pods") is False
+    assert can("viewtok", "get", "pods", ns="other") is False
+
+    # kubectl auth can-i: output + exit code
+    from kubernetes_tpu.cmd import kubectl
+
+    rc = kubectl.main(["-s", srv.url, "--token", "viewtok",
+                       "auth", "can-i", "get", "pods"])
+    assert rc == 0 and capsys.readouterr().out.strip() == "yes"
+    rc = kubectl.main(["-s", srv.url, "--token", "viewtok",
+                       "auth", "can-i", "create", "pods"])
+    assert rc == 1 and capsys.readouterr().out.strip() == "no"
