@@ -347,6 +347,13 @@ def main(argv=None) -> int:
     au.add_argument("resource", help="e.g. pods or pods/exec")
     au.add_argument("name", nargs="?", default="")
 
+    wt = sub.add_parser("wait", parents=[common])
+    wt.add_argument("kind")
+    wt.add_argument("name")
+    wt.add_argument("--for", dest="for_cond", required=True,
+                    help="delete | condition=NAME (wait.go)")
+    wt.add_argument("--timeout", default="30s")
+
     tp = sub.add_parser("top", parents=[common])
     tp.add_argument("what", choices=("nodes", "node", "pods", "pod"))
     tp.add_argument("name", nargs="?", default="")
@@ -674,6 +681,72 @@ def main(argv=None) -> int:
         if out.get("stderr"):
             sys.stderr.write(out["stderr"])
         return int(out.get("exitCode", 0))
+
+    if args.verb == "wait":
+        # pkg/kubectl/cmd/wait/wait.go: poll until --for holds or the
+        # timeout expires (exit 1, like the reference's wait error).
+        # A NotFound while waiting for a condition, or any other API
+        # error, fails FAST with the real message (wait.go surfaces
+        # NotFound/Forbidden immediately rather than as a timeout).
+        import re as _re
+        import time as _time
+
+        kind = _ALIASES.get(args.kind, args.kind)
+        parts = _re.findall(r"(\d+(?:\.\d+)?)(ms|s|m|h)", args.timeout)
+        if parts:  # Go durations: 30s, 1m30s, 500ms (time.ParseDuration)
+            seconds = sum(
+                float(v) * {"ms": 0.001, "s": 1, "m": 60, "h": 3600}[u]
+                for v, u in parts)
+        else:
+            try:
+                seconds = float(args.timeout)
+            except ValueError:
+                print(f"error: invalid --timeout {args.timeout!r}",
+                      file=sys.stderr)
+                return 1
+        want = args.for_cond
+        cond_name = want.split("=", 1)[1] if want.startswith("condition=") \
+            else None
+        if cond_name is None and want != "delete":
+            print(f"error: unsupported --for {want!r} "
+                  "(delete | condition=NAME)", file=sys.stderr)
+            return 1
+        path = _resolve_path(args.server, kind, ns, args.name)
+        deadline = _time.monotonic() + seconds
+        while True:
+            out = _req(args.server, "GET", path)
+            is_status = (isinstance(out, dict)
+                         and out.get("kind") == "Status")
+            missing = is_status and out.get("code", 200) == 404
+            if want == "delete":
+                if missing:
+                    print(f"{kind}/{args.name} condition met")
+                    return 0
+                if is_status and out.get("code", 200) >= 400:
+                    print(out.get("message", ""), file=sys.stderr)
+                    return 1
+            else:
+                if is_status:  # NotFound/Forbidden/unreachable: fail fast
+                    print(out.get("message", ""), file=sys.stderr)
+                    return 1
+                st = out.get("status") or {}
+                conds = {str(c.get("type", "")).lower(): c.get("status")
+                         for c in st.get("conditions") or []}
+                # condition names match case-insensitively (wait.go uses
+                # strings.EqualFold); absence follows the kind's wire
+                # contract (pods emit Ready only when False)
+                ok = conds.get(cond_name.lower())
+                if (ok is None and cond_name.lower() == "ready"
+                        and kind == "pods"):
+                    ok = "True" if st.get("phase") == "Running" else "False"
+                if str(ok).lower() == "true":
+                    print(f"{kind}/{args.name} condition met")
+                    return 0
+            if _time.monotonic() >= deadline:
+                print(f"error: timed out waiting for {want} on "
+                      f"{kind}/{args.name}", file=sys.stderr)
+                return 1
+            _time.sleep(0.2)
 
     if args.verb == "auth":
         # kubectl auth can-i (pkg/kubectl/cmd/auth/cani.go): a

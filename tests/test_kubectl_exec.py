@@ -174,3 +174,56 @@ def test_kubectl_port_forward_relays_tcp(capsys):
     finally:
         srv.stop()
         backend.close()
+
+
+def test_kubectl_wait_for_condition_and_delete(capsys):
+    """kubectl wait --for=condition=Ready / --for=delete
+    (pkg/kubectl/cmd/wait/wait.go:62-66): polls until the condition holds
+    or times out with exit 1."""
+    import dataclasses
+
+    cluster = LocalCluster()
+    cluster.add_node(make_node("n1", cpu="4", mem="8Gi"))
+    pod = make_pod("waiter", cpu="100m", node_name="n1")
+    cluster.add_pod(pod)  # no kubelet: stays Pending until promoted
+    srv = APIServer(cluster=cluster).start()
+    try:
+        # not Running yet -> short wait times out with rc 1
+        rc = kubectl.main(["-s", srv.url, "wait", "pod", "waiter",
+                           "--for", "condition=Ready", "--timeout", "1s"])
+        assert rc == 1
+
+        # flip Running in the background; wait sees it
+        def promote():
+            time.sleep(0.3)
+            cur = cluster.get("pods", "default", "waiter")
+            cluster.update("pods", dataclasses.replace(
+                cur, status=dataclasses.replace(
+                    cur.status, phase="Running")))
+
+        threading.Thread(target=promote, daemon=True).start()
+        capsys.readouterr()
+        rc = kubectl.main(["-s", srv.url, "wait", "pod", "waiter",
+                           "--for", "condition=ready",  # EqualFold match
+                           "--timeout", "0m10s"])       # Go duration form
+        assert rc == 0
+        assert "condition met" in capsys.readouterr().out
+
+        # waiting on a condition of a nonexistent object fails FAST
+        import time as _t
+        t0 = _t.monotonic()
+        rc = kubectl.main(["-s", srv.url, "wait", "pod", "ghost-pod",
+                           "--for", "condition=Ready", "--timeout", "30s"])
+        assert rc == 1 and _t.monotonic() - t0 < 5
+
+        # --for=delete
+        def reap():
+            time.sleep(0.3)
+            cluster.delete("pods", "default", "waiter")
+
+        threading.Thread(target=reap, daemon=True).start()
+        rc = kubectl.main(["-s", srv.url, "wait", "pod", "waiter",
+                           "--for", "delete", "--timeout", "10s"])
+        assert rc == 0
+    finally:
+        srv.stop()
