@@ -80,6 +80,23 @@ def _crd_collection(spec: dict, ns: str) -> str:
     return f"/apis/{group}/{version}/namespaces/{ns}/{plural}"
 
 
+def _load_manifests(path: str):
+    """-f manifests: YAML (a superset of JSON) with multi-document
+    support (kubectl accepts both; pkg/kubectl/cmd/util resource
+    builder).  Returns the non-empty documents in file order."""
+    import yaml
+
+    with open(path) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    bad = next((d for d in docs if not isinstance(d, dict)), None)
+    if bad is not None:
+        raise SystemExit(
+            f"error: {path}: document is not an object: {bad!r:.80}")
+    if not docs:
+        raise SystemExit(f"error: no objects found in {path}")
+    return docs
+
+
 def _resolve_path(server: str, kind: str, ns: str, name: str = "") -> str:
     """_path plus CR discovery: an unknown kind containing a dot is a
     '<plural>.<group>' storage name resolved through its CRD (correct
@@ -452,18 +469,24 @@ def main(argv=None) -> int:
         return 0
 
     if args.verb == "create":
-        with open(args.filename) as f:
-            obj = json.load(f)
-        k = obj.get("kind", "Pod").lower()
-        obj_ns = (obj.get("metadata") or {}).get("namespace") or ns
-        kind, coll = _manifest_path(args.server, obj, obj_ns)
-        out = _req(args.server, "POST", coll, obj)
-        if out.get("kind") == "Status" and out.get("code", 201) >= 400:
-            print(out.get("message", ""), file=sys.stderr)
-            return 1
-        name = (out.get("metadata") or {}).get("name", "")
-        print(f"{k}/{name} created")
-        return 0
+        rc = 0
+        for obj in _load_manifests(args.filename):
+            k = obj.get("kind", "Pod").lower()
+            obj_ns = (obj.get("metadata") or {}).get("namespace") or ns
+            try:
+                kind, coll = _manifest_path(args.server, obj, obj_ns)
+            except SystemExit as e:  # unknown kind: report, keep going
+                print(e, file=sys.stderr)
+                rc = 1
+                continue
+            out = _req(args.server, "POST", coll, obj)
+            if out.get("kind") == "Status" and out.get("code", 201) >= 400:
+                print(out.get("message", ""), file=sys.stderr)
+                rc = 1
+                continue
+            name = (out.get("metadata") or {}).get("name", "")
+            print(f"{k}/{name} created")
+        return rc
 
     if args.verb == "delete":
         out = _req(args.server, "DELETE", _resolve_path(args.server, args.kind, ns, args.name))
@@ -497,64 +520,75 @@ def main(argv=None) -> int:
     if args.verb in ("apply", "diff"):
         # the real apply: last-applied-configuration annotation + 3-way
         # merge against the live object (apply.go); `diff` prints what
-        # apply WOULD change and makes no writes (cmd/diff)
-        with open(args.filename) as f:
-            obj = json.load(f)
-        k = obj.get("kind", "Pod").lower()
-        obj_ns = (obj.get("metadata") or {}).get("namespace") or ns
-        name = (obj.get("metadata") or {}).get("name", "")
-        kind, coll = _manifest_path(args.server, obj, obj_ns)
-        live = _req(args.server, "GET", f"{coll}/{name}")
-        exists = live.get("kind") != "Status"
-        if not exists:
+        # apply WOULD change and makes no writes (cmd/diff).  Multi-doc
+        # YAML manifests apply each object in file order.
+
+        def _apply_one(obj):
+            k = obj.get("kind", "Pod").lower()
+            obj_ns = (obj.get("metadata") or {}).get("namespace") or ns
+            name = (obj.get("metadata") or {}).get("name", "")
+            kind, coll = _manifest_path(args.server, obj, obj_ns)
+            live = _req(args.server, "GET", f"{coll}/{name}")
+            exists = live.get("kind") != "Status"
+            if not exists:
+                if args.verb == "diff":
+                    import difflib
+
+                    new_doc = json.dumps(obj, indent=2, sort_keys=True)
+                    sys.stdout.writelines(difflib.unified_diff(
+                        [], new_doc.splitlines(keepends=True),
+                        fromfile=f"live/{name}", tofile=f"merged/{name}"))
+                    return 1    # differences found (kubectl diff exit code)
+                out = _req(args.server, "POST", coll, _stamp_last_applied(obj))
+                if out.get("kind") == "Status" and out.get("code") == 409:
+                    # another writer created it between our GET and POST:
+                    # fall through to the update path against the fresh live
+                    live = _req(args.server, "GET", f"{coll}/{name}")
+                    exists = live.get("kind") != "Status"
+                else:
+                    if (out.get("kind") == "Status"
+                            and out.get("code", 201) >= 400):
+                        print(out.get("message", ""), file=sys.stderr)
+                        return 1
+                    print(f"{k}/{name} created")
+                    return 0
+            anns = (live.get("metadata") or {}).get("annotations") or {}
+            try:
+                last = json.loads(anns.get(LAST_APPLIED, "{}"))
+            except ValueError:
+                last = {}
+            merged = _three_way_merge(last, live, obj)
             if args.verb == "diff":
                 import difflib
 
-                new_doc = json.dumps(obj, indent=2, sort_keys=True)
-                sys.stdout.writelines(difflib.unified_diff(
-                    [], new_doc.splitlines(keepends=True),
+                def doc(d):
+                    d = json.loads(json.dumps(d))
+                    (d.get("metadata") or {}).pop("annotations", None)
+                    return json.dumps(
+                        d, indent=2, sort_keys=True).splitlines(keepends=True)
+
+                delta = list(difflib.unified_diff(
+                    doc(live), doc(merged),
                     fromfile=f"live/{name}", tofile=f"merged/{name}"))
-                return 1    # differences found (kubectl diff exit code)
-            out = _req(args.server, "POST", coll, _stamp_last_applied(obj))
-            if out.get("kind") == "Status" and out.get("code") == 409:
-                # another writer created it between our GET and POST:
-                # fall through to the update path against the fresh live
-                live = _req(args.server, "GET", f"{coll}/{name}")
-                exists = live.get("kind") != "Status"
-            else:
-                if (out.get("kind") == "Status"
-                        and out.get("code", 201) >= 400):
-                    print(out.get("message", ""), file=sys.stderr)
-                    return 1
-                print(f"{k}/{name} created")
-                return 0
-        anns = (live.get("metadata") or {}).get("annotations") or {}
-        try:
-            last = json.loads(anns.get(LAST_APPLIED, "{}"))
-        except ValueError:
-            last = {}
-        merged = _three_way_merge(last, live, obj)
-        if args.verb == "diff":
-            import difflib
+                sys.stdout.writelines(delta)
+                return 1 if delta else 0
+            merged = _stamp_last_applied(merged, obj)
+            out = _req(args.server, "PUT", f"{coll}/{name}", merged)
+            if out.get("kind") == "Status" and out.get("code", 200) >= 400:
+                print(out.get("message", ""), file=sys.stderr)
+                return 1
+            print(f"{k}/{name} configured")
+            return 0
 
-            def doc(d):
-                d = json.loads(json.dumps(d))
-                (d.get("metadata") or {}).pop("annotations", None)
-                return json.dumps(
-                    d, indent=2, sort_keys=True).splitlines(keepends=True)
 
-            delta = list(difflib.unified_diff(
-                doc(live), doc(merged),
-                fromfile=f"live/{name}", tofile=f"merged/{name}"))
-            sys.stdout.writelines(delta)
-            return 1 if delta else 0
-        merged = _stamp_last_applied(merged, obj)
-        out = _req(args.server, "PUT", f"{coll}/{name}", merged)
-        if out.get("kind") == "Status" and out.get("code", 200) >= 400:
-            print(out.get("message", ""), file=sys.stderr)
-            return 1
-        print(f"{k}/{name} configured")
-        return 0
+        rcs = []
+        for obj in _load_manifests(args.filename):
+            try:
+                rcs.append(_apply_one(obj))
+            except SystemExit as e:  # unknown kind: report, keep going
+                print(e, file=sys.stderr)
+                rcs.append(1)
+        return max(rcs)
 
     if args.verb == "rollout":
         # pkg/kubectl/cmd/rollout: status (readiness vs desired on the

@@ -227,3 +227,65 @@ def test_kubectl_wait_for_condition_and_delete(capsys):
         assert rc == 0
     finally:
         srv.stop()
+
+
+def test_kubectl_yaml_multidoc_create_and_apply(tmp_path, capsys):
+    """-f manifests accept YAML with multiple documents (the kubectl
+    resource-builder behavior); apply works per document."""
+    cluster = LocalCluster()
+    srv = APIServer(cluster=cluster).start()
+    try:
+        f = tmp_path / "stack.yaml"
+        f.write_text("""\
+apiVersion: v1
+kind: Namespace
+metadata:
+  name: team-a
+---
+apiVersion: v1
+kind: ConfigMap
+metadata:
+  name: settings
+  namespace: default
+data:
+  mode: fast
+---
+# a comment-only fragment between docs is ignored
+---
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: web
+  namespace: default
+spec:
+  replicas: 2
+  selector:
+    matchLabels: {app: web}
+  template:
+    metadata:
+      labels: {app: web}
+    spec:
+      containers:
+        - name: c
+          image: repo/app:v1
+""")
+        capsys.readouterr()
+        rc = kubectl.main(["-s", srv.url, "create", "-f", str(f)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "namespace/team-a created" in out
+        assert "configmap/settings created" in out
+        assert "deployment/web created" in out
+        assert cluster.get("deployments", "default", "web").replicas == 2
+        cm = cluster.get("configmaps", "default", "settings")
+        assert (cm.get("data") or {}).get("mode") == "fast"
+
+        # apply the same file with a change: per-doc 3-way merge
+        f.write_text(f.read_text().replace("replicas: 2", "replicas: 5"))
+        capsys.readouterr()
+        rc = kubectl.main(["-s", srv.url, "apply", "-f", str(f)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert cluster.get("deployments", "default", "web").replicas == 5
+    finally:
+        srv.stop()
