@@ -280,7 +280,8 @@ def main(argv=None) -> int:
     common = argparse.ArgumentParser(add_help=False)
     common.add_argument("--server", "-s", default=argparse.SUPPRESS)
     common.add_argument("-n", "--namespace", default=argparse.SUPPRESS)
-    common.add_argument("-o", "--output", choices=("", "json", "wide"),
+    common.add_argument("-o", "--output",
+                        choices=("", "json", "yaml", "wide"),
                         default=argparse.SUPPRESS)
     common.add_argument("--token", default=argparse.SUPPRESS,
                         help="bearer token (RBAC planes)")
@@ -444,8 +445,19 @@ def main(argv=None) -> int:
         if out.get("kind") == "Status":
             print(out.get("message", ""), file=sys.stderr)
             return 1
-        if args.output == "json":
-            print(json.dumps(out, indent=2))
+        if args.output in ("json", "yaml"):
+            if getattr(args, "watch", False):
+                # -w streams table rows; a one-shot document would LOOK
+                # like a successful watch that saw nothing
+                print("error: -o json|yaml cannot be combined with -w",
+                      file=sys.stderr)
+                return 1
+            if args.output == "json":
+                print(json.dumps(out, indent=2))
+            else:
+                import yaml
+
+                print(yaml.safe_dump(out, sort_keys=False), end="")
             return 0
         items = out.get("items", [out] if out else [])
         if args.kind in ("nodes", "node"):

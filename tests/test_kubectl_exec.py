@@ -287,5 +287,15 @@ spec:
         out = capsys.readouterr().out
         assert rc == 0
         assert cluster.get("deployments", "default", "web").replicas == 5
+
+        # get -o yaml round-trips through the YAML printer
+        import yaml as _yaml
+
+        capsys.readouterr()
+        rc = kubectl.main(["-s", srv.url, "get", "deployments", "web",
+                           "-o", "yaml"])
+        assert rc == 0
+        doc = _yaml.safe_load(capsys.readouterr().out)
+        assert doc["spec"]["replicas"] == 5
     finally:
         srv.stop()
