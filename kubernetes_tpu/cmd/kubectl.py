@@ -150,6 +150,26 @@ def _manifest_path(server: str, obj: dict, ns: str) -> "tuple[str, str]":
     raise SystemExit(f"error: unknown resource kind {obj.get('kind')!r}")
 
 
+def _resolve_manifest_docs(server, filename, ns):
+    """Per-document target resolution with error-and-continue (the
+    resource-builder skeleton shared by create/delete -f): returns
+    ([(obj, kind_label, name, obj_ns, collection)], rc) where rc=1 when
+    any document named an unknown kind."""
+    out, rc = [], 0
+    for obj in _load_manifests(filename):
+        k = obj.get("kind", "Pod").lower()
+        meta = obj.get("metadata") or {}
+        obj_ns = meta.get("namespace") or ns
+        try:
+            _, coll = _manifest_path(server, obj, obj_ns)
+        except SystemExit as e:  # unknown kind: report, keep going
+            print(e, file=sys.stderr)
+            rc = 1
+            continue
+        out.append((obj, k, meta.get("name", ""), obj_ns, coll))
+    return out, rc
+
+
 def _follow_watch(args, ns: str) -> int:
     """`kubectl get KIND -w`: follow the server's chunked watch stream
     (JSON lines), print rows for events matching the requested kind +
@@ -308,8 +328,10 @@ def main(argv=None) -> int:
     c.add_argument("-f", "--filename", required=True)
 
     d = sub.add_parser("delete", parents=[common])
-    d.add_argument("kind")
-    d.add_argument("name")
+    d.add_argument("kind", nargs="?", default="")
+    d.add_argument("name", nargs="?", default="")
+    d.add_argument("-f", "--filename", default="",
+                   help="delete the objects named in a YAML/JSON manifest")
 
     e = sub.add_parser("describe", parents=[common])
     e.add_argument("kind")
@@ -481,16 +503,8 @@ def main(argv=None) -> int:
         return 0
 
     if args.verb == "create":
-        rc = 0
-        for obj in _load_manifests(args.filename):
-            k = obj.get("kind", "Pod").lower()
-            obj_ns = (obj.get("metadata") or {}).get("namespace") or ns
-            try:
-                kind, coll = _manifest_path(args.server, obj, obj_ns)
-            except SystemExit as e:  # unknown kind: report, keep going
-                print(e, file=sys.stderr)
-                rc = 1
-                continue
+        docs, rc = _resolve_manifest_docs(args.server, args.filename, ns)
+        for obj, k, _name, _obj_ns, coll in docs:
             out = _req(args.server, "POST", coll, obj)
             if out.get("kind") == "Status" and out.get("code", 201) >= 400:
                 print(out.get("message", ""), file=sys.stderr)
@@ -501,6 +515,34 @@ def main(argv=None) -> int:
         return rc
 
     if args.verb == "delete":
+        if args.filename:
+            if args.kind or args.name:
+                # kubectl proper rejects mixing -f with positionals; a
+                # silent ignore would leave the named object alive while
+                # the user believes it was deleted
+                print("error: cannot combine -f with KIND/NAME",
+                      file=sys.stderr)
+                return 1
+            docs, rc = _resolve_manifest_docs(
+                args.server, args.filename, ns)
+            for _obj, k, name, _obj_ns, coll in docs:
+                if not name:
+                    print(f"error: {k} document has no metadata.name",
+                          file=sys.stderr)
+                    rc = 1
+                    continue
+                out = _req(args.server, "DELETE", f"{coll}/{name}")
+                ok = out.get("reason") == "Success"
+                if ok:
+                    print(f"{k}/{name} deleted")
+                else:
+                    print(out.get("message", ""), file=sys.stderr)
+                    rc = 1
+            return rc
+        if not args.kind or not args.name:
+            print("error: delete needs KIND NAME or -f FILE",
+                  file=sys.stderr)
+            return 1
         out = _req(args.server, "DELETE", _resolve_path(args.server, args.kind, ns, args.name))
         ok = out.get("reason") == "Success"
         print(out.get("message", ""), file=sys.stderr if not ok else sys.stdout)

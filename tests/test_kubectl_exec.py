@@ -297,5 +297,14 @@ spec:
         assert rc == 0
         doc = _yaml.safe_load(capsys.readouterr().out)
         assert doc["spec"]["replicas"] == 5
+
+        # delete -f reaps every object the manifest names
+        capsys.readouterr()
+        rc = kubectl.main(["-s", srv.url, "delete", "-f", str(f)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "deployment/web deleted" in out
+        assert cluster.get("deployments", "default", "web") is None
+        assert cluster.get("configmaps", "default", "settings") is None
     finally:
         srv.stop()
