@@ -52,6 +52,16 @@ class Quantity:
 
     value: Fraction
 
+    def __hash__(self) -> int:
+        # Fraction.__hash__ is modular-inverse arithmetic; quantities are
+        # hashed on every (req, nonzero) memo lookup in the cache-commit
+        # path, so memoize it on the (frozen) instance.
+        h = self.__dict__.get("_hash")
+        if h is None:
+            h = hash(self.value)
+            object.__setattr__(self, "_hash", h)
+        return h
+
     @property
     def milli(self) -> int:
         """MilliValue(): value * 1000 rounded up (ref resource.Quantity.MilliValue)."""
@@ -83,14 +93,33 @@ class Quantity:
         return str(float(self.value))
 
 
+_PARSE_MEMO: dict = {}
+
+
 def parse_quantity(s: "str | int | float | Quantity") -> Quantity:
-    """Parse a Kubernetes quantity string ("100m", "2Gi", "1e3", 4) exactly."""
+    """Parse a Kubernetes quantity string ("100m", "2Gi", "1e3", 4) exactly.
+
+    String parses are memoized to a canonical instance: workloads stamp
+    thousands of pods with identical request strings, and sharing the
+    instance lets downstream dict/tuple comparisons take the identity
+    fast path (Quantity is immutable, so sharing is safe)."""
     if isinstance(s, Quantity):
         return s
+    if isinstance(s, str):
+        q = _PARSE_MEMO.get(s)
+        if q is None:
+            if len(_PARSE_MEMO) > 65536:
+                _PARSE_MEMO.clear()
+            q = _PARSE_MEMO[s] = _parse_quantity_str(s)
+        return q
     if isinstance(s, int):
         return Quantity(Fraction(s))
     if isinstance(s, float):
         return Quantity(Fraction(s).limit_denominator(10**9))
+    raise ValueError(f"invalid quantity {s!r}")
+
+
+def _parse_quantity_str(s: str) -> Quantity:
     m = _QTY_RE.match(s)
     if not m:
         raise ValueError(f"invalid quantity {s!r}")

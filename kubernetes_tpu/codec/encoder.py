@@ -220,6 +220,7 @@ class SnapshotEncoder:
         self._pod_row_cache: Dict[Tuple, Dict[str, np.ndarray]] = {}
         self._pod_cache_token: Tuple = ()
         self._req_memo: Dict[Tuple, Tuple[np.ndarray, np.ndarray]] = {}
+        self._empty_vcounts: np.ndarray | None = None
 
     # ------------------------------------------------------------------ arena
 
@@ -685,7 +686,13 @@ class SnapshotEncoder:
             self._cnt_vol_rows += [dict() for _ in range(grow)]
             for counters in self._node_cnt_vols.values():
                 counters.extend(Counter() for _ in range(grow))
+            wide_empty = np.zeros(self.dims.VT, np.float32)
+            wide_empty.setflags(write=False)
+            self._empty_vcounts = wide_empty
             for rec in self.pods.values():
+                if not rec.cnt_vols:  # () sentinel (no volumes) stays ()
+                    rec.vol_counts = wide_empty  # keep records shared
+                    continue
                 v = np.zeros(self.dims.VT, np.float32)
                 v[: rec.vol_counts.shape[0]] = rec.vol_counts
                 rec.vol_counts = v
@@ -708,9 +715,18 @@ class SnapshotEncoder:
         "V#rw" when read-only; EBS conflicts regardless (one token).
         """
         if not pod.spec.volumes:  # hot path: most pods mount nothing
-            return [], [], np.zeros(self.dims.VT, np.float32), [
-                set() for _ in range(self.dims.VT)
-            ]
+            # shared read-only zero vector + empty cnt_ids sentinel: the
+            # cache-commit path calls this once per bound pod, and per-call
+            # allocation of VT sets dominated the commit profile.  Every
+            # consumer iterates cnt_ids with enumerate, so () is safe; the
+            # zeros array is marked unwriteable and replaced per-record on
+            # VT regrow (_vol_col), so sharing cannot alias a mutation.
+            z = self._empty_vcounts
+            if z is None or z.shape[0] != self.dims.VT:
+                z = np.zeros(self.dims.VT, np.float32)
+                z.setflags(write=False)
+                self._empty_vcounts = z
+            return [], [], z, ()
         disk: List[int] = []       # check tokens (the pod's own mounts)
         disk_adv: List[int] = []   # advertise tokens (what a node shows)
         cnt_ids: list = [set() for _ in range(self.dims.VT)]
@@ -882,22 +898,29 @@ class SnapshotEncoder:
             self._row_pods.setdefault(node_row, set()).add(key)
             self.a_requested[node_row, : req.shape[0]] += req
             self.a_nonzero[node_row] += nonzero
-            for pp_ip in ports:
-                self._node_ports[node_row][pp_ip] += 1
-            self._rebuild_node_ports(node_row)
-            for dv in disk:
-                self._node_disk_vols[node_row][dv] += 1
-            self._rebuild_node_vols(node_row)
+            if ports:  # rebuilds are row-wide sorts: skip when untouched
+                for pp_ip in ports:
+                    self._node_ports[node_row][pp_ip] += 1
+                self._rebuild_node_ports(node_row)
+            if disk:
+                for dv in disk:
+                    self._node_disk_vols[node_row][dv] += 1
+                self._rebuild_node_vols(node_row)
             # attachable-count state dedupes by volume identity: the node's
             # used count is the number of DISTINCT ids per type
-            cnts = self._node_cnt_vols.setdefault(
-                node_row, [Counter() for _ in range(self.dims.VT)]
-            )
-            for t, ids in enumerate(cnt_ids):
-                for vid in ids:
-                    cnts[t][vid] += 1
-                    self._cnt_vol_rows[t].setdefault(vid, set()).add(node_row)
-                self.a_volcnt[node_row, t] = len(cnts[t])
+            if cnt_ids:
+                cnts = self._node_cnt_vols.get(node_row)
+                if cnts is None:
+                    cnts = self._node_cnt_vols[node_row] = [
+                        Counter() for _ in range(self.dims.VT)
+                    ]
+                for t, ids in enumerate(cnt_ids):
+                    for vid in ids:
+                        cnts[t][vid] += 1
+                        self._cnt_vol_rows[t].setdefault(vid, set()).add(
+                            node_row
+                        )
+                    self.a_volcnt[node_row, t] = len(cnts[t])
         self._register_pod_terms(pod, rec)
         self.generation += 1
 
@@ -918,18 +941,20 @@ class SnapshotEncoder:
             self._row_pods.get(row, set()).discard(key)
             self.a_requested[row, : rec.req.shape[0]] -= rec.req
             self.a_nonzero[row] -= rec.nonzero
-            for pp_ip in rec.ports:
+            if rec.ports:  # rebuilds are row-wide sorts: skip when untouched
                 c = self._node_ports[row]
-                c[pp_ip] -= 1
-                if c[pp_ip] <= 0:
-                    del c[pp_ip]
-            self._rebuild_node_ports(row)
-            for dv in rec.disk_vols:
+                for pp_ip in rec.ports:
+                    c[pp_ip] -= 1
+                    if c[pp_ip] <= 0:
+                        del c[pp_ip]
+                self._rebuild_node_ports(row)
+            if rec.disk_vols:
                 c = self._node_disk_vols[row]
-                c[dv] -= 1
-                if c[dv] <= 0:
-                    del c[dv]
-            self._rebuild_node_vols(row)
+                for dv in rec.disk_vols:
+                    c[dv] -= 1
+                    if c[dv] <= 0:
+                        del c[dv]
+                self._rebuild_node_vols(row)
             cnts = self._node_cnt_vols.get(row)
             if cnts is not None:
                 for t, ids in enumerate(rec.cnt_vols):
