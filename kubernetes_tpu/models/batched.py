@@ -164,18 +164,56 @@ def encode_batch_affinity(encoder, pods: Sequence) -> BatchAffinityState:
 
     d = encoder.dims
     B = _pow2(max(len(pods), 1, d.B))
+    nb = len(pods)
     A = np.zeros((B, d.PT, B), bool)   # [owner i, term t, candidate j]
     N = np.zeros((B, d.AT, B), bool)
 
+    # Controller-stamped batches repeat a handful of (namespace, labels)
+    # shapes and an equally small set of terms, so the naive owner x term x
+    # candidate fill is O(B^2 T) selector matches (4M+ at batch 2048).
+    # Group candidates by (namespace, label signature) and memoize each
+    # distinct (selector, namespaces) term's match vector: the match work
+    # collapses to distinct-terms x distinct-groups, and the fill becomes
+    # one vector row-assign per (owner, term).
+    gid_of: dict = {}
+    pod_gid = np.empty(max(nb, 1), np.int32)
+    reps: list = []  # one (namespace, labels) representative per group
+    for j, p in enumerate(pods):
+        sig = (p.namespace, tuple(sorted(p.labels.items())))
+        g = gid_of.get(sig)
+        if g is None:
+            g = gid_of[sig] = len(reps)
+            reps.append((p.namespace, p.labels))
+        pod_gid[j] = g
+    _match_memo: dict = {}
+
+    def _term_vec(term, owner_ns):
+        """bool[B] candidate-match vector for one term, memoized across
+        the batch by (requirements, namespaces)."""
+        sel = klabels.selector_from_label_selector(term.label_selector)
+        if sel is None:
+            return None
+        nss = term.namespaces or (owner_ns,)
+        key = (tuple(sel.requirements), frozenset(nss))
+        vec = _match_memo.get(key)
+        if vec is None:
+            gm = np.fromiter(
+                ((ns in nss) and sel.matches(lbls) for ns, lbls in reps),
+                bool, count=len(reps),
+            )
+            vec = np.zeros(B, bool)
+            if nb:
+                vec[:nb] = gm[pod_gid[:nb]]
+            vec.setflags(write=False)  # rows are shared across owners
+            _match_memo[key] = vec
+        return vec
+
     def _fill(out, terms, i, owner, slot=None):
         for t, term in enumerate(terms):
-            sel = klabels.selector_from_label_selector(term.label_selector)
-            if sel is None:
+            vec = _term_vec(term, owner.namespace)
+            if vec is None:
                 continue
-            nss = term.namespaces or (owner.namespace,)
-            for j, other in enumerate(pods):
-                if other.namespace in nss and sel.matches(other.labels):
-                    out[i, slot if slot is not None else t, j] = True
+            out[i, slot if slot is not None else t, :] = vec
 
     # preferred terms: owner-major lists (signed weights), then the same
     # cross-match fill as required terms
