@@ -112,6 +112,12 @@ from kubernetes_tpu.ops.select import (
 
 _X = lax.Precision.HIGHEST  # exact f32 matmuls: these carry counts, not ML
 
+# Test hook: route the CPU backend through the packed device path
+# (_impl: device while_loop rounds + in-program lax.cond exactness redo)
+# instead of the host-driven rounds, so the TPU program is testable on the
+# CPU-only CI mesh.
+FORCE_PACKED_PATH = False
+
 
 def make_speculative_scheduler(
     cfg: FilterConfig = FilterConfig(),
@@ -547,6 +553,39 @@ def make_speculative_scheduler(
         # fetch): a pod left unscheduled means capacity/domain pressure,
         # under which any placement difference can change the split
         inv = out["inv"] | jnp.any(pods.valid & (out["hosts"] < 0))
+        if hybrid:
+            # device-resident exactness redo: fold the sequential-scan
+            # fallback into the SAME program behind lax.cond (XLA executes
+            # only the taken branch), so the caller never syncs on the
+            # sentinel — the old host-side bool(np.asarray(inv)) check
+            # serialized the whole pipeline on device compute + a scalar
+            # D2H RTT every batch.  Uncontended batches pay one predicate;
+            # contended ones run the exact scan on device.
+            from kubernetes_tpu.models.batched import BatchPortState
+
+            seq = _exact_scan()
+            ports_state = BatchPortState(pod_ports, conflict)
+
+            def _redo(_):
+                h2, c2 = seq(
+                    cluster, pods, ports_state, last_index0, nom,
+                    emask0, escore, aff,
+                )
+                return (
+                    h2.astype(jnp.int32),
+                    c2.requested.astype(jnp.float32),
+                    c2.nonzero_req.astype(jnp.float32),
+                )
+
+            def _keep(_):
+                return (
+                    out["hosts"].astype(jnp.int32),
+                    out["req"].astype(jnp.float32),
+                    out["nz"].astype(jnp.float32),
+                )
+
+            hosts, req, nz = lax.cond(inv, _redo, _keep, None)
+            return hosts, req, nz, rounds, inv
         return out["hosts"], out["req"], out["nz"], rounds, inv
 
     @lru_cache(maxsize=64)
@@ -602,12 +641,24 @@ def make_speculative_scheduler(
             rounds += 1
         return c["hosts"], c["req"], c["nz"], rounds, c["inv"]
 
-    seq_fn = [None]  # lazily-built exact scan for the hybrid redo
+    def _exact_scan():
+        """The memoized sequential scan both redo paths share (in-_impl
+        lax.cond on device, host-side redo on CPU) — one construction
+        site so the two cannot diverge.  make_sequential_scheduler is
+        _SEQ_CACHE-memoized, so calling per redo costs nothing."""
+        from kubernetes_tpu.models.batched import make_sequential_scheduler
+
+        return make_sequential_scheduler(
+            cfg=cfg, weights=weights,
+            unsched_taint_key=unsched_taint_key,
+            zone_key_id=zone_key_id, score_cfg=score_cfg,
+            percentage_of_nodes_to_score=percentage_of_nodes_to_score,
+        )
 
     def schedule(cluster: ClusterTensors, pods: PodBatch, ports,
                  last_index0, nominated=None, extra_mask=None,
                  extra_score=None, aff_state=None):
-        on_cpu = jax.default_backend() == "cpu"
+        on_cpu = jax.default_backend() == "cpu" and not FORCE_PACKED_PATH
         tree = {"pods": pods, "pp": ports.pod_ports, "cf": ports.conflict}
         if extra_mask is not None:
             tree["emask"] = np.asarray(extra_mask, bool)
@@ -628,13 +679,23 @@ def make_speculative_scheduler(
             hosts, req, nz, rounds, inv = _packed(meta)(
                 cluster, bufs, np.int32(last_index0)
             )
+            # the exactness redo already ran ON DEVICE behind lax.cond
+            # (_impl), so nothing here syncs: hosts/req/nz are final and
+            # the pipeline stays fully async.  last_redo is the device
+            # sentinel scalar — fetching it (bool()/int()) blocks on the
+            # batch, so only observability/tests should touch it.
+            schedule.last_rounds = rounds
+            schedule.last_redo = inv if hybrid else False
+            new_cluster = dataclasses.replace(
+                cluster, requested=req, nonzero_req=nz
+            )
+            return hosts, new_cluster
         schedule.last_rounds = rounds  # observability: repair rounds used
         schedule.last_redo = False
-        if hybrid and on_cpu and not bool(np.asarray(inv)):
-            # CPU path: the unscheduled-pod sentinel is checked host-side
-            # (hosts are host-resident; the device path folds it into the
-            # in-_impl inv scalar so only ONE scalar rides the fetch and
-            # the caller keeps the async hosts-fetch overlap)
+        if hybrid and not bool(np.asarray(inv)):
+            # CPU path (host-driven rounds): the unscheduled-pod sentinel
+            # is checked host-side — hosts are already host-resident and
+            # syncs are free without a tunnel
             hn = np.asarray(hosts)
             valid = np.asarray(pods.valid, bool)
             inv = bool((hn[valid] < 0).any())
@@ -646,19 +707,8 @@ def make_speculative_scheduler(
             # costs one scan on the contended batches only — uncontended
             # batches (the common case: round 1 commits everything, or
             # orderly founder->mates chains) keep the parallel fast path.
-            if seq_fn[0] is None:
-                from kubernetes_tpu.models.batched import (
-                    make_sequential_scheduler,
-                )
-
-                seq_fn[0] = make_sequential_scheduler(
-                    cfg=cfg, weights=weights,
-                    unsched_taint_key=unsched_taint_key,
-                    zone_key_id=zone_key_id, score_cfg=score_cfg,
-                    percentage_of_nodes_to_score=percentage_of_nodes_to_score,
-                )
             schedule.last_redo = True
-            return seq_fn[0](
+            return _exact_scan()(
                 cluster, pods, ports, last_index0, nominated,
                 extra_mask, extra_score, aff_state,
             )

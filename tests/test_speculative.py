@@ -509,3 +509,52 @@ def test_hybrid_split_identity_tight_binpack_soak():
         assert (h_spec[:10] >= 0).sum() == (h_seq[:10] >= 0).sum(), (
             seed, h_spec.tolist(), h_seq.tolist())
     assert redos > 0
+
+
+def test_device_path_cond_redo_split_identity():
+    """The PACKED device path (the program TPUs actually run) folds the
+    hybrid exactness redo into the jitted program behind lax.cond, so the
+    caller never syncs on the sentinel.  Forced onto the CPU backend via
+    FORCE_PACKED_PATH, the contended trials' scheduled/unschedulable
+    split must still equal the sequential scan's, and the device inv
+    sentinel must actually fire on some trial."""
+    from kubernetes_tpu.models import speculative as spec_mod
+
+    spec_mod.FORCE_PACKED_PATH = True
+    try:
+        fired = 0
+        # tight bin-packing (resource contention)
+        for seed in range(8):
+            rng = np.random.default_rng(3000 + seed)
+            enc = SnapshotEncoder(TEST_DIMS)
+            for i in range(3):
+                enc.add_node(make_node(f"n{i}", cpu="2", mem="8Gi"))
+            spec, seq = _engines(enc)
+            pods = [
+                make_pod(f"p{i}", cpu=f"{int(rng.integers(3, 14)) * 100}m")
+                for i in range(10)
+            ]
+            h_spec, _, _, _ = _run(enc, spec, pods)
+            fired += int(bool(np.asarray(spec.last_redo)))
+            h_seq, _, _, _ = _run(enc, seq, pods)
+            assert (h_spec[:10] >= 0).sum() == (h_seq[:10] >= 0).sum(), (
+                seed, h_spec.tolist(), h_seq.tolist())
+        # contended anti-affinity (domain pressure): 5 pods, 3 hostname
+        # domains — the unscheduled-pod sentinel must trigger the redo
+        enc = SnapshotEncoder(TEST_DIMS)
+        for i in range(3):
+            enc.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+        spec, seq = _engines(enc)
+        pods = [
+            make_pod(f"p{i}", cpu="100m", labels={"app": "x"},
+                     affinity=_anti("x"))
+            for i in range(5)
+        ]
+        h_spec = _run_aff(enc, spec, pods)[:5]
+        fired += int(bool(np.asarray(spec.last_redo)))
+        h_seq = _run_aff(enc, seq, pods)[:5]
+        assert (h_spec >= 0).sum() == (h_seq >= 0).sum(), (
+            h_spec.tolist(), h_seq.tolist())
+        assert fired > 0  # the in-program sentinel is actually wired
+    finally:
+        spec_mod.FORCE_PACKED_PATH = False
