@@ -849,10 +849,13 @@ class SnapshotEncoder:
         # commits of controller-stamped identical pods skip the exact
         # Fraction summation (~60us/pod).  rec.req arrays are never mutated
         # in place (the R-regrow path replaces them), so sharing is safe.
+        # unsorted items(): two insertion orders of the same content just
+        # occupy two memo slots mapping to equal arrays — correct either
+        # way, and skipping 3 sorts/pod matters at 10k commits/s
         rk = (
-            tuple(tuple(sorted(c.requests.items())) for c in pod.spec.containers),
-            tuple(
-                tuple(sorted(c.requests.items()))
+            tuple(tuple(c.requests.items()) for c in pod.spec.containers),
+            () if not pod.spec.init_containers else tuple(
+                tuple(c.requests.items())
                 for c in pod.spec.init_containers
             ),
         )
