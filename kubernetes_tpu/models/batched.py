@@ -26,7 +26,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -143,6 +143,46 @@ jax.tree_util.register_dataclass(
 )
 
 
+class LeanBatchAffinity(NamedTuple):
+    """Factored form of BatchAffinityState — what actually crosses the
+    host->device link.
+
+    Controller-stamped batches repeat a handful of (namespace, labels)
+    shapes, so every dense [B, ., B] cross-match tensor is low-rank:
+    match[owner i, term t, candidate j] = gm[i, t, group(j)].  Shipping the
+    factors (G = distinct label groups, padded to a power of two; the last
+    pad column is all-False and absorbs padding pods) is ~KBs where the
+    dense tensors are ~40MB at batch 2048 — which matters because a
+    remote-attached accelerator bills per byte moved.  densify() rebuilds
+    the dense tensors ON DEVICE with one gather per family."""
+
+    gid: Any            # i32[B]      candidate j -> label-group id
+    aff_gm: Any         # bool[B, PT, G]  owner i's aff term t matches group g
+    anti_gm: Any        # bool[B, AT, G]
+    pref_gm: Any        # bool[B, PP, G]
+    pref_topo_key: Any  # i32[B, PP]
+    pref_weight: Any    # f32[B, PP]
+
+
+def densify_batch_affinity(lean: LeanBatchAffinity) -> BatchAffinityState:
+    """Rebuild the dense cross-match tensors from the factored form —
+    called INSIDE jit so only the factors cross the link."""
+    gid = lean.gid
+    aff_own = jnp.take(lean.aff_gm, gid, axis=2)    # [owner i, t, cand j]
+    anti_own = jnp.take(lean.anti_gm, gid, axis=2)
+    pref_own = jnp.take(lean.pref_gm, gid, axis=2)
+    return BatchAffinityState(
+        aff_match=jnp.transpose(aff_own, (2, 0, 1)),   # [step j, i, t]
+        anti_match=jnp.transpose(anti_own, (2, 0, 1)),
+        anti_own=anti_own,
+        aff_own=aff_own,
+        pref_topo_key=lean.pref_topo_key,
+        pref_weight=lean.pref_weight,
+        pref_match=jnp.transpose(pref_own, (2, 0, 1)),
+        pref_own=pref_own,
+    )
+
+
 def batch_has_pod_affinity(pods: Sequence) -> bool:
     """True if any pod carries ANY pod-(anti-)affinity terms (required or
     preferred) — the signal to run the affinity-aware scan variant so
@@ -156,25 +196,23 @@ def batch_has_pod_affinity(pods: Sequence) -> bool:
     return False
 
 
-def encode_batch_affinity(encoder, pods: Sequence) -> BatchAffinityState:
-    """Host-side precompute of the in-batch cross-match tensors; term slot
-    order matches SnapshotEncoder._encode_pod_affinity (required[:PT] /
+def encode_batch_affinity(encoder, pods: Sequence) -> LeanBatchAffinity:
+    """Host-side precompute of the in-batch cross-match FACTORS (the
+    engines densify on device — see LeanBatchAffinity); term slot order
+    matches SnapshotEncoder._encode_pod_affinity (required[:PT] /
     required[:AT] in spec order)."""
     from kubernetes_tpu.api import labels as klabels
 
     d = encoder.dims
     B = _pow2(max(len(pods), 1, d.B))
     nb = len(pods)
-    A = np.zeros((B, d.PT, B), bool)   # [owner i, term t, candidate j]
-    N = np.zeros((B, d.AT, B), bool)
 
     # Controller-stamped batches repeat a handful of (namespace, labels)
-    # shapes and an equally small set of terms, so the naive owner x term x
-    # candidate fill is O(B^2 T) selector matches (4M+ at batch 2048).
-    # Group candidates by (namespace, label signature) and memoize each
-    # distinct (selector, namespaces) term's match vector: the match work
-    # collapses to distinct-terms x distinct-groups, and the fill becomes
-    # one vector row-assign per (owner, term).
+    # shapes and an equally small set of terms, so the dense owner x term x
+    # candidate tensors are low-rank: group candidates by (namespace, label
+    # signature), memoize each distinct (selector, namespaces) term's
+    # GROUP-match vector, and ship only the factors (LeanBatchAffinity) —
+    # the engines densify on device with one gather per tensor family.
     gid_of: dict = {}
     pod_gid = np.empty(max(nb, 1), np.int32)
     reps: list = []  # one (namespace, labels) representative per group
@@ -185,11 +223,18 @@ def encode_batch_affinity(encoder, pods: Sequence) -> BatchAffinityState:
             g = gid_of[sig] = len(reps)
             reps.append((p.namespace, p.labels))
         pod_gid[j] = g
+    # pad the group axis to a power of two; the LAST column stays all-False
+    # in every gm tensor and absorbs batch-padding pods, so they can never
+    # match a term
+    G = _pow2(len(reps) + 1)
+    gid = np.full(B, G - 1, np.int32)
+    if nb:
+        gid[:nb] = pod_gid[:nb]
     _match_memo: dict = {}
 
-    def _term_vec(term, owner_ns):
-        """bool[B] candidate-match vector for one term, memoized across
-        the batch by (requirements, namespaces)."""
+    def _term_gvec(term, owner_ns):
+        """bool[G] group-match vector for one term, memoized across the
+        batch by (requirements, namespaces)."""
         sel = klabels.selector_from_label_selector(term.label_selector)
         if sel is None:
             return None
@@ -197,20 +242,21 @@ def encode_batch_affinity(encoder, pods: Sequence) -> BatchAffinityState:
         key = (tuple(sel.requirements), frozenset(nss))
         vec = _match_memo.get(key)
         if vec is None:
-            gm = np.fromiter(
+            vec = np.zeros(G, bool)
+            vec[: len(reps)] = np.fromiter(
                 ((ns in nss) and sel.matches(lbls) for ns, lbls in reps),
                 bool, count=len(reps),
             )
-            vec = np.zeros(B, bool)
-            if nb:
-                vec[:nb] = gm[pod_gid[:nb]]
             vec.setflags(write=False)  # rows are shared across owners
             _match_memo[key] = vec
         return vec
 
+    A = np.zeros((B, d.PT, G), bool)   # [owner i, term t, group g]
+    N = np.zeros((B, d.AT, G), bool)
+
     def _fill(out, terms, i, owner, slot=None):
         for t, term in enumerate(terms):
-            vec = _term_vec(term, owner.namespace)
+            vec = _term_gvec(term, owner.namespace)
             if vec is None:
                 continue
             out[i, slot if slot is not None else t, :] = vec
@@ -230,7 +276,7 @@ def encode_batch_affinity(encoder, pods: Sequence) -> BatchAffinityState:
                           for w in a.pod_anti_affinity.preferred]
         pref_lists.append(terms)
     PP = _pow2(max([len(t) for t in pref_lists] + [1]))
-    P = np.zeros((B, PP, B), bool)       # [owner i, term t, candidate j]
+    P = np.zeros((B, PP, G), bool)       # [owner i, term t, group g]
     p_key = np.zeros((B, PP), np.int32)
     p_w = np.zeros((B, PP), np.float32)
 
@@ -246,15 +292,9 @@ def encode_batch_affinity(encoder, pods: Sequence) -> BatchAffinityState:
             p_w[i, t] = w
             p_key[i, t] = encoder.register_topology_key(term.topology_key)
             _fill(P, [term], i, pod, slot=t)
-    return BatchAffinityState(
-        aff_match=A.transpose(2, 0, 1),   # [step j, i, t]
-        anti_match=N.transpose(2, 0, 1),  # [step j, i, t]
-        anti_own=N,                       # [step j(owner), t, i]
-        aff_own=A,                        # [step j(owner), t, i]
-        pref_topo_key=p_key,
-        pref_weight=p_w,
-        pref_match=P.transpose(2, 0, 1),  # [step j, i, t]
-        pref_own=P,                       # [step j(owner), t, i]
+    return LeanBatchAffinity(
+        gid=gid, aff_gm=A, anti_gm=N, pref_gm=P,
+        pref_topo_key=p_key, pref_weight=p_w,
     )
 
 
@@ -475,6 +515,8 @@ def make_sequential_scheduler(
         MatchInterPodAffinity moves from the static pass into the scan with
         carried per-topology-pair extras, so co-batched pods see each
         other's placements (kills the batch>1 affinity-blindness gap)."""
+        if isinstance(aff_state, LeanBatchAffinity):
+            aff_state = densify_batch_affinity(aff_state)  # on device
         B = pods.n_pods
         G = cluster.group_counts.shape[1]
         # ---- static pass: every predicate except the dynamic ones, plus the
@@ -777,7 +819,29 @@ def make_sequential_scheduler(
         )
         return hosts, new_cluster
 
-    _SEQ_CACHE[key] = schedule
+    def schedule_entry(cluster, pods, ports, last_index0, nominated=None,
+                       extra_mask=None, extra_score=None, aff_state=None):
+        """Host entry: on accelerator backends, move the batch pytrees to
+        the device via explicit device_put first — host-numpy jit ARGUMENTS
+        cross a remote-attached tunnel on a slow synchronous path (~55MB/s
+        measured vs ~1.4GB/s async DMA), which matters for the [B, ., B]
+        affinity cross-match tensors.  device_put is a no-op passthrough
+        for leaves already on the device."""
+        if jax.default_backend() != "cpu":
+            pods, ports, nominated, extra_mask, extra_score, aff_state = (
+                jax.device_put(
+                    (pods, ports, nominated, extra_mask, extra_score,
+                     aff_state)
+                )
+            )
+        return schedule(cluster, pods, ports, last_index0, nominated,
+                        extra_mask, extra_score, aff_state)
+
+    # the raw traced fn for callers composing INSIDE jit (the speculative
+    # engine's in-program lax.cond redo)
+    schedule_entry.jitted = schedule
+
+    _SEQ_CACHE[key] = schedule_entry
     while len(_SEQ_CACHE) > _SEQ_CACHE_CAP:
         _SEQ_CACHE.popitem(last=False)
-    return schedule
+    return schedule_entry

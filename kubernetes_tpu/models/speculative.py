@@ -524,10 +524,22 @@ def make_speculative_scheduler(
         return c
 
     def _parts(tree):
+        from kubernetes_tpu.models.batched import (
+            LeanBatchAffinity,
+            densify_batch_affinity,
+        )
+
         pods = tree["pods"]
+        aff = tree.get("aff")
+        if isinstance(aff, LeanBatchAffinity):
+            # only the factors crossed the link; rebuild the dense
+            # cross-match tensors on device (one gather per family).
+            # _parts is the single chokepoint every jitted path
+            # (_packed, _round_host, _carry_init) funnels through.
+            aff = densify_batch_affinity(aff)
         return (
             pods, tree["pp"], tree["cf"], tree.get("emask"),
-            tree.get("escore"), tree.get("nom"), tree.get("aff"),
+            tree.get("escore"), tree.get("nom"), aff,
         )
 
     def _impl(cluster, tree, last_index0):
@@ -563,7 +575,9 @@ def make_speculative_scheduler(
             # contended ones run the exact scan on device.
             from kubernetes_tpu.models.batched import BatchPortState
 
-            seq = _exact_scan()
+            # .jitted = the raw traceable fn (schedule_entry's host-side
+            # device_put wrapper must not run inside this traced branch)
+            seq = _exact_scan().jitted
             ports_state = BatchPortState(pod_ports, conflict)
 
             def _redo(_):
@@ -603,41 +617,43 @@ def make_speculative_scheduler(
     # of tiny host syncs per batch are free without a tunnel.
 
     @lru_cache(maxsize=64)
-    def _round_host(meta):
+    def _materialize(meta):
+        """Unpack + densify ONCE per batch: the per-round jits below take
+        the materialized parts pytree directly, so the lean affinity
+        state's dense reconstruction doesn't repeat every repair round."""
+
         @jax.jit
-        def run(cluster, bufs, c):
-            tree = unpack_tree(bufs, meta)
-            pods, pod_ports, conflict, _em, escore, nom, aff = _parts(tree)
-            return _round(
-                cluster, pods, pod_ports, conflict, escore, nom, aff, c
-            )
+        def run(bufs):
+            return _parts(unpack_tree(bufs, meta))
 
         return run
 
-    @lru_cache(maxsize=64)
-    def _carry_init(meta):
-        @jax.jit
-        def run(cluster, bufs, last_index0):
-            tree = unpack_tree(bufs, meta)
-            pods, pod_ports, _cf, emask0, _es, _nom, aff = _parts(tree)
-            B = pods.valid.shape[0]
-            N = cluster.allocatable.shape[0]
-            if emask0 is None:
-                emask0 = jnp.ones((B, N), jnp.bool_)
-            else:
-                emask0 = emask0.astype(jnp.bool_)
-            return _init_carry(
-                cluster, pods, pod_ports, last_index0, emask0, aff is not None
-            )
+    @jax.jit
+    def _round_host(cluster, parts, c):
+        pods, pod_ports, conflict, _em, escore, nom, aff = parts
+        return _round(
+            cluster, pods, pod_ports, conflict, escore, nom, aff, c
+        )
 
-        return run
+    @jax.jit
+    def _carry_init(cluster, parts, last_index0):
+        pods, pod_ports, _cf, emask0, _es, _nom, aff = parts
+        B = pods.valid.shape[0]
+        N = cluster.allocatable.shape[0]
+        if emask0 is None:
+            emask0 = jnp.ones((B, N), jnp.bool_)
+        else:
+            emask0 = emask0.astype(jnp.bool_)
+        return _init_carry(
+            cluster, pods, pod_ports, last_index0, emask0, aff is not None
+        )
 
     def _host_rounds(cluster, bufs, meta, last_index0):
-        step = _round_host(meta)
-        c = _carry_init(meta)(cluster, bufs, np.int32(last_index0))
+        parts = _materialize(meta)(bufs)
+        c = _carry_init(cluster, parts, np.int32(last_index0))
         rounds = 0
         while bool(np.asarray(c["active"]).any()):
-            c = step(cluster, bufs, c)
+            c = _round_host(cluster, parts, c)
             rounds += 1
         return c["hosts"], c["req"], c["nz"], rounds, c["inv"]
 
@@ -671,6 +687,12 @@ def make_speculative_scheduler(
         # the optional extras ride the same packed buffers (<=3 RTTs); the
         # tree's key set is part of meta, so each combination jits once
         bufs, meta = pack_tree(tree)
+        if not on_cpu:
+            # explicit async DMA: host-numpy jit ARGUMENTS cross the
+            # remote-attached tunnel on a slow synchronous path (~55MB/s
+            # measured vs ~1.4GB/s for device_put), which stalled every
+            # affinity batch ~2s on its [B, ., B] cross-match tensors
+            bufs = jax.device_put(bufs)
         if on_cpu:
             hosts, req, nz, rounds, inv = _host_rounds(
                 cluster, bufs, meta, last_index0
