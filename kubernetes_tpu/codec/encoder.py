@@ -1711,12 +1711,28 @@ class SnapshotEncoder:
         # controller-stamped workloads have ~20 distinct rows across
         # thousands of pods, so this is ~100x fewer numpy calls
         hit_groups: Dict[Tuple, List[int]] = {}
+        # CALL-LOCAL row sharing for the pods the cross-call cache must
+        # refuse (affinity / live term_groups, where rows depend on cluster
+        # state): within one encode_pods call the state is frozen (callers
+        # hold the cache lock), so same-content pods share a row.  Keyed by
+        # the static key EXTENDED with the affinity content signature;
+        # pods with volumes stay per-pod (PVC rows also carry per-call
+        # binder assumptions).
+        local_first: Dict[Tuple, int] = {}
+        local_hits: Dict[int, List[int]] = {}
         for b, pod in enumerate(pods):
             ck = self._pod_static_key(pod)
             cached = self._pod_row_cache.get(ck) if ck is not None else None
             if cached is not None:
                 hit_groups.setdefault(ck, []).append(b)
                 continue
+            lk = self._pod_local_key(pod) if ck is None else None
+            if lk is not None:
+                first = local_first.get(lk)
+                if first is not None:
+                    local_hits.setdefault(first, []).append(b)
+                    continue
+                local_first[lk] = b
             out["valid"][b] = True
             req = self._req_vector(pod.resource_request())
             out["req"][b, : req.shape[0]] = req
@@ -1823,6 +1839,14 @@ class SnapshotEncoder:
                 self._pod_row_cache[ck] = {
                     k: np.copy(v[b]) for k, v in out.items()
                 }
+
+        for first, idxs in local_hits.items():
+            ia = np.asarray(idxs, np.intp)
+            for k, v in out.items():
+                v[ia] = v[first]
+            if first in cnt_ids_by_b:
+                for b2 in idxs:
+                    cnt_ids_by_b[b2] = cnt_ids_by_b[first]
 
         for ck, idxs in hit_groups.items():
             cached = self._pod_row_cache[ck]
@@ -1954,6 +1978,81 @@ class SnapshotEncoder:
                 )[: self._cap_n].astype(np.float32)
         return counts
 
+    def _pod_key_base(self, pod: Pod):
+        """The shared content-key body both caching keys build on: every
+        non-affinity pod attribute an encoded row depends on.  Raises
+        TypeError for unhashable content (callers translate to None)."""
+        return (
+            pod.namespace,
+            tuple(sorted(pod.labels.items())),
+            tuple(sorted(pod.spec.node_selector.items())),
+            # the *resolved* image id goes into the key: a lookup miss
+            # (image not yet on any node) must not freeze ImageLocality
+            # at 0 once the image appears and gets interned
+            # Quantity is a frozen dataclass over Fraction: hashable and
+            # ordered, so the exact objects key the row directly (str()
+            # round-trips cost Fraction formatting, ~10us/pod)
+            tuple(
+                (self.interner.lookup(normalized_image(c.image)),
+                 tuple(sorted(c.requests.items())),
+                 # limits participate in the row (limits2, best_effort):
+                 # two pods differing only in limits must not share a row
+                 tuple(sorted(c.limits.items())),
+                 tuple(c.ports))
+                for c in pod.spec.containers
+            ),
+            tuple(
+                (c.image,
+                 tuple(sorted(c.requests.items())),
+                 tuple(sorted(c.limits.items())))
+                for c in pod.spec.init_containers
+            ),
+            pod.spec.tolerations,
+            pod.spec.node_name,
+            pod.spec.priority,
+            pod.metadata.owner_uid,
+            pod.metadata.owner_kind,
+        )
+
+    def _pod_local_key(self, pod: Pod):
+        """Key for CALL-LOCAL row sharing (encode_pods): the cross-call
+        gate fields (affinity content) join the shared key base, since
+        within one call the cluster state every row depends on is frozen.
+        Pods with volumes return None — their rows also carry per-call
+        binder assumptions keyed by pod identity (CheckVolumeBinding
+        assume bookkeeping), so sharing could alias distinct claims."""
+        if pod.spec.volumes:
+            return None
+
+        def _ts(t):
+            # canonical selector form — the same _sel_requirements
+            # canonicalization _term_sig uses, so semantically identical
+            # terms (matchLabels vs equivalent matchExpressions) share
+            sel = _sel_requirements(t.label_selector)
+            sel_key = tuple(sel.requirements) if sel is not None else None
+            return (sel_key, t.topology_key, frozenset(t.namespaces))
+
+        aff = pod.spec.affinity
+        try:
+            if aff is None:
+                aff_sig = None
+            else:
+                pa, paa = aff.pod_affinity, aff.pod_anti_affinity
+                aff_sig = (
+                    aff.node_affinity,  # frozen dataclasses: hashable
+                    None if pa is None else (
+                        tuple(_ts(t) for t in pa.required),
+                        tuple((w.weight, _ts(w.term)) for w in pa.preferred),
+                    ),
+                    None if paa is None else (
+                        tuple(_ts(t) for t in paa.required),
+                        tuple((w.weight, _ts(w.term)) for w in paa.preferred),
+                    ),
+                )
+            return (aff_sig,) + self._pod_key_base(pod)
+        except TypeError:
+            return None
+
     def _pod_static_key(self, pod: Pod):
         """Cache key for state-independent pods; None disables caching.
 
@@ -1964,37 +2063,7 @@ class SnapshotEncoder:
         if pod.spec.affinity is not None or pod.spec.volumes or self.term_groups:
             return None
         try:
-            return (
-                pod.namespace,
-                tuple(sorted(pod.labels.items())),
-                tuple(sorted(pod.spec.node_selector.items())),
-                # the *resolved* image id goes into the key: a lookup miss
-                # (image not yet on any node) must not freeze ImageLocality
-                # at 0 once the image appears and gets interned
-                # Quantity is a frozen dataclass over Fraction: hashable and
-                # ordered, so the exact objects key the row directly (str()
-                # round-trips cost Fraction formatting, ~10us/pod)
-                tuple(
-                    (self.interner.lookup(normalized_image(c.image)),
-                     tuple(sorted(c.requests.items())),
-                     # limits participate in the row (limits2, best_effort):
-                     # two pods differing only in limits must not share a row
-                     tuple(sorted(c.limits.items())),
-                     tuple(c.ports))
-                    for c in pod.spec.containers
-                ),
-                tuple(
-                    (c.image,
-                     tuple(sorted(c.requests.items())),
-                     tuple(sorted(c.limits.items())))
-                    for c in pod.spec.init_containers
-                ),
-                pod.spec.tolerations,
-                pod.spec.node_name,
-                pod.spec.priority,
-                pod.metadata.owner_uid,
-                pod.metadata.owner_kind,
-            )
+            return self._pod_key_base(pod)
         except TypeError:
             return None
 
