@@ -561,9 +561,9 @@ def make_speculative_scheduler(
             init,
         )
         rounds = (out["li"] - jnp.asarray(last_index0, jnp.int32)) // B
-        # third contention sentinel, ON DEVICE (one scalar rides the same
-        # fetch): a pod left unscheduled means capacity/domain pressure,
-        # under which any placement difference can change the split
+        # third contention sentinel, ON DEVICE: a pod left unscheduled
+        # means capacity/domain pressure, under which any placement
+        # difference can change the split
         inv = out["inv"] | jnp.any(pods.valid & (out["hosts"] < 0))
         if hybrid:
             # device-resident exactness redo: fold the sequential-scan
