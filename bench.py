@@ -129,6 +129,22 @@ def run(args) -> dict:
     for d in range(n_deploy):
         enc.add_spread_selector("default", {"app": f"dep-{d}"})
     t_nodes = time.monotonic() - t0
+    # the scheduler_bench_test.go matrix's second dimension: N pods
+    # ALREADY running before the measured scheduling starts (existing-pod
+    # state exercises spread counts, resource accumulation, and — for the
+    # affinity workloads — the committed-pod pair tensors); timed apart
+    # so node_encode_seconds keeps measuring node encoding alone
+    t0 = time.monotonic()
+    for i in range(args.existing):
+        enc.add_pod(
+            make_pod(
+                f"existing-{i}", cpu="100m", mem="256Mi",
+                labels={"app": f"dep-{i % n_deploy}"},
+                node_name=f"node-{i % args.nodes}",
+                owner=("ReplicaSet", f"rs-{i % n_deploy}"),
+            )
+        )
+    t_existing = time.monotonic() - t0
 
     def pending_pod(i):
         """One pending pod in the selected workload shape — the
@@ -355,6 +371,8 @@ def run(args) -> dict:
         "pods_scheduled": scheduled,
         "unschedulable": unschedulable,
         "batch": args.batch,
+        "existing": args.existing,
+        "existing_encode_seconds": round(t_existing, 3),
         "engine": engine,
         "workload": args.workload,
         "seconds": round(dt, 3),
@@ -522,6 +540,7 @@ def _child_cmd(args, platform: str | None) -> list:
         sys.executable, os.path.abspath(__file__),
         "--nodes", str(args.nodes), "--pods", str(args.pods),
         "--batch", str(args.batch), "--workload", args.workload,
+        "--existing", str(args.existing),
         "--engine", args.engine, "--warmup", str(args.warmup),
         "--init-timeout", str(args.init_timeout),
         "--lock-timeout", str(args.lock_timeout),
@@ -649,6 +668,10 @@ def main():
     ap.add_argument("--nodes", type=int, default=5000)
     ap.add_argument("--pods", type=int, default=10000)
     ap.add_argument("--batch", type=int, default=2048)
+    ap.add_argument("--existing", type=int, default=0,
+                    help="pods already running before the measured run "
+                    "(scheduler_bench_test.go's {0,1000}-existing matrix "
+                    "dimension)")
     ap.add_argument(
         "--workload",
         choices=("plain", "node-affinity", "pod-affinity",
