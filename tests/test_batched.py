@@ -8,7 +8,7 @@ from kubernetes_tpu.codec import SnapshotEncoder
 from kubernetes_tpu.cpuref import CPUScheduler
 from kubernetes_tpu.models.batched import encode_batch_ports, make_sequential_scheduler
 
-from fixtures import TEST_DIMS, make_node, make_pod, random_cluster, random_pending_pod
+from fixtures import TEST_DIMS, ZONE_KEY, make_node, make_pod, random_cluster, random_pending_pod
 
 
 def golden_sequential(nodes, existing, services, pending):
@@ -132,3 +132,76 @@ def test_sequential_randomized(seed):
         placed.append(
             dataclasses.replace(pod, spec=dataclasses.replace(pod.spec, node_name=host))
         )
+
+
+def test_encode_pods_local_row_sharing_differential():
+    """The call-local row cache (encoder._pod_local_key) must be
+    invisible: a randomized mixed population (plain / affinity / ports /
+    tolerations, repeated and unique shapes) encodes bit-identically with
+    the cache disabled."""
+    import dataclasses as _dc
+
+    def build():
+        enc = SnapshotEncoder(TEST_DIMS)
+        for i in range(16):
+            enc.add_node(make_node(
+                f"n{i}", cpu="8", mem="32Gi",
+                labels={ZONE_KEY: f"z{i % 3}", "tier": "a" if i % 2 else "b"},
+            ))
+        enc.add_spread_selector("default", {"app": "web"})
+        # committed pods with terms => term_groups non-empty (the
+        # state-dependent regime the cross-call cache refuses)
+        enc.add_pod(make_pod(
+            "committed", cpu="100m", labels={"app": "web"},
+            node_name="n0",
+            affinity={"podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "labelSelector": {"matchLabels": {"app": "web"}},
+                    "topologyKey": ZONE_KEY}]}},
+        ))
+        rng = np.random.default_rng(42)
+        pods = []
+        for i in range(60):
+            kind = int(rng.integers(0, 5))
+            app = f"app-{int(rng.integers(0, 3))}"
+            if kind == 0:
+                pods.append(make_pod(f"p{i}", cpu="100m", mem="128Mi",
+                                     labels={"app": app}))
+            elif kind == 1:
+                pods.append(make_pod(
+                    f"p{i}", cpu="200m", labels={"app": app},
+                    affinity={"podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [{
+                            "labelSelector": {"matchLabels": {"app": app}},
+                            "topologyKey": ZONE_KEY}]}}))
+            elif kind == 2:
+                pods.append(make_pod(
+                    f"p{i}", cpu="50m", labels={"app": app},
+                    affinity={"podAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [{
+                            "labelSelector": {"matchLabels": {"app": "web"}},
+                            "topologyKey": ZONE_KEY}]}}))
+            elif kind == 3:
+                pods.append(make_pod(f"p{i}", cpu="50m", labels={"app": app},
+                                     ports=[{"hostPort": 8000 + i % 4}]))
+            else:
+                pods.append(make_pod(
+                    f"p{i}", cpu="50m", labels={"app": app},
+                    tolerations=[{"key": "dedicated", "operator": "Exists",
+                                  "effect": "NoSchedule"}]))
+        return enc, pods
+
+    enc1, pods1 = build()
+    b1 = enc1.encode_pods(pods1)
+    enc2, pods2 = build()
+    orig = SnapshotEncoder._pod_local_key
+    SnapshotEncoder._pod_local_key = lambda self, pod: None
+    try:
+        b2 = enc2.encode_pods(pods2)
+    finally:
+        SnapshotEncoder._pod_local_key = orig
+    for f in _dc.fields(b1):
+        v1, v2 = getattr(b1, f.name), getattr(b2, f.name)
+        if hasattr(v1, "shape"):
+            np.testing.assert_array_equal(
+                np.asarray(v1), np.asarray(v2), err_msg=f.name)

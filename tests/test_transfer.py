@@ -88,8 +88,6 @@ def test_pack_tree_row_factoring_roundtrip():
     + index) and unpack bit-identically; unique-rowed leaves bail out and
     ship dense; small leaves are untouched.  factor=True forces the
     accelerator path on the CPU backend."""
-    import jax
-
     rng = np.random.default_rng(7)
     base = rng.integers(0, 2, size=(20, 16384)).astype(bool)     # 20 rows
     rep_b = base[rng.integers(0, 20, size=2048)]                 # 32MB dense
@@ -115,3 +113,35 @@ def test_pack_tree_row_factoring_roundtrip():
     out_d = jax.jit(lambda b: unpack_tree(b, meta_d))(bufs_d)
     for k, v in tree.items():
         np.testing.assert_array_equal(np.asarray(out_d[k]), v, err_msg=k)
+
+
+def test_pack_tree_factoring_randomized_property():
+    """Property soak: for random mixes of repeated/unique/odd-shaped
+    leaves, factor=True and factor=False unpack to identical trees."""
+    for seed in range(6):
+        rng = np.random.default_rng(100 + seed)
+        tree = {}
+        for i in range(rng.integers(2, 6)):
+            kind = rng.integers(0, 3)
+            B = int(rng.choice([64, 257, 1024]))
+            w = int(rng.choice([512, 2048, 4096]))
+            if kind == 0:  # group-repeated rows
+                g = int(rng.integers(1, 9))
+                base = rng.random((g, w)).astype(np.float32)
+                tree[f"r{i}"] = base[rng.integers(0, g, size=B)]
+            elif kind == 1:  # unique rows
+                tree[f"u{i}"] = rng.integers(
+                    0, 2, size=(B, w)).astype(bool)
+            else:  # small leaf
+                tree[f"s{i}"] = rng.integers(
+                    0, 50, size=(int(rng.integers(1, 64)),)
+                ).astype(np.int32)
+        bufs_f, meta_f = pack_tree(tree, factor=True)
+        bufs_d, meta_d = pack_tree(tree, factor=False)
+        out_f = jax.jit(lambda b: unpack_tree(b, meta_f))(bufs_f)
+        out_d = jax.jit(lambda b: unpack_tree(b, meta_d))(bufs_d)
+        for k, v in tree.items():
+            np.testing.assert_array_equal(np.asarray(out_f[k]), v,
+                                          err_msg=f"seed {seed} {k}")
+            np.testing.assert_array_equal(np.asarray(out_d[k]), v,
+                                          err_msg=f"seed {seed} {k}")
