@@ -27,15 +27,18 @@ import numpy as np
 
 
 @partial(jax.jit, static_argnames=("max_bins",))
-def binpack_ffd(pod_reqs, capacity, max_bins: int = 1024):
+def binpack_ffd(pod_reqs, capacity, max_bins: int = 1024, order=None):
     """First-fit binpack of pod_reqs f32[P, R] into bins of `capacity` f32[R].
 
     pod_reqs should be pre-sorted descending (see sort_pods_for_ffd) for the
-    FFD guarantee; zero rows (padding) are skipped.  Returns (n_bins i32,
-    loads f32[max_bins, R], placed bool[P] — False when max_bins overflowed).
-    """
+    FFD guarantee — or pass `order` i32[P] to pack in that index order
+    WITHOUT materializing a gathered copy of the pod list (the scan gathers
+    one request per step); zero rows (padding) are skipped.  Returns
+    (n_bins i32, loads f32[max_bins, R], placed bool[P] — False when
+    max_bins overflowed)."""
 
-    def step(loads, req):
+    def step(loads, oi):
+        req = pod_reqs[oi]
         real = jnp.any(req > 0)
         fits = jnp.all(loads + req[None, :] <= capacity[None, :], axis=-1)
         idx = jnp.argmax(fits)  # first fitting bin (zeros always fit if req<=cap)
@@ -43,8 +46,10 @@ def binpack_ffd(pod_reqs, capacity, max_bins: int = 1024):
         loads = loads.at[idx].add(jnp.where(ok, req, 0.0))
         return loads, ok | ~real
 
+    if order is None:
+        order = jnp.arange(pod_reqs.shape[0], dtype=jnp.int32)
     loads, placed = jax.lax.scan(
-        step, jnp.zeros((max_bins, pod_reqs.shape[1]), jnp.float32), pod_reqs
+        step, jnp.zeros((max_bins, pod_reqs.shape[1]), jnp.float32), order
     )
     used = jnp.sum(jnp.any(loads > 0, axis=-1))
     return used.astype(jnp.int32), loads, placed
@@ -56,14 +61,18 @@ def binpack_shapes(pod_reqs, capacities, max_bins: int = 1024):
     (bins_needed i32[S], all_placed bool[S]).
 
     The FFD "decreasing" order is shape-relative (dominant fraction of THAT
-    shape's capacity), so each lane sorts its own copy of the pod list on
-    device before packing — heterogeneous shapes get a true FFD each."""
+    shape's capacity), so each lane sorts an INDEX permutation of the
+    shared pod list and the scan gathers one request per step —
+    materializing pod_reqs[order] per lane ([S, P, R], tile-padded 64x on
+    the R axis) is what used to OOM the 50k x 10k BASELINE config."""
 
     def one(cap):
         frac = pod_reqs / jnp.maximum(cap[None, :], 1e-30)
         key = jnp.max(frac, axis=-1)
-        order = jnp.argsort(-key, stable=True)
-        used, _, placed = binpack_ffd(pod_reqs[order], cap, max_bins=max_bins)
+        order = jnp.argsort(-key, stable=True).astype(jnp.int32)
+        used, _, placed = binpack_ffd(
+            pod_reqs, cap, max_bins=max_bins, order=order
+        )
         return used, jnp.all(placed)
 
     return jax.vmap(one)(capacities)
