@@ -45,11 +45,10 @@ class GangScheduler:
     def __init__(self, scheduler):
         self.scheduler = scheduler
 
-    def schedule_gang(
-        self, group: PodGroup, pods: Sequence[Pod]
-    ) -> Tuple[Optional[List[str]], int]:
-        """Returns (node names per pod, n_placed) — names is None if the gang
-        did not reach min_member and nothing was committed."""
+    def _launch(self, pods: Sequence[Pod]) -> np.ndarray:
+        """One engine launch over `pods` against a fresh snapshot; returns
+        hosts i32[len(pods)] (-1 = unplaced).  Shared by the per-gang and
+        co-batched paths."""
         from kubernetes_tpu.models.batched import (
             batch_has_pod_affinity,
             encode_batch_affinity,
@@ -58,7 +57,6 @@ class GangScheduler:
 
         sched = self.scheduler
         enc = sched.cache.encoder
-        need = group.min_member or len(pods)
         with sched.cache._lock:
             # affinity state first: novel term topology keys must register
             # before the TP-wide batch tensors are cut (vocab growth retiles)
@@ -71,18 +69,140 @@ class GangScheduler:
             ports = encode_batch_ports(enc, pods)
             cluster, _ = sched.cache.snapshot()
         hosts, _new_state = sched._schedule_fn(
-            cluster, batch, ports, np.int32(sched._last_index), None, None, None,
-            aff_state,
+            cluster, batch, ports, np.int32(sched._last_index), None, None,
+            None, aff_state,
         )
         sched._last_index += len(pods)
-        hosts = np.asarray(hosts)[: len(pods)]
+        return np.asarray(hosts)[: len(pods)]
+
+    def schedule_gang(
+        self, group: PodGroup, pods: Sequence[Pod]
+    ) -> Tuple[Optional[List[str]], int]:
+        """Returns (node names per pod, n_placed) — names is None if the gang
+        did not reach min_member and nothing was committed."""
+        need = group.min_member or len(pods)
+        hosts = self._launch(pods)
         placed = int((hosts >= 0).sum())
         if placed < need:
             return None, placed
-        out: List[str] = []
+        return self._commit_gang(group, pods, hosts)
+
+    def schedule_gangs(
+        self, gangs: Sequence[Tuple[PodGroup, Sequence[Pod]]]
+    ) -> List[Tuple[Optional[List[str]], int]]:
+        """Many gangs, ONE device launch per co-batch: the per-gang
+        transaction costs one snapshot + launch + fetch (~100ms through a
+        remote-attached chip), so 1k PodGroups pay 1k launches; co-batching
+        amortizes the launch across every gang that fits in the engine's
+        batch width.
+
+        Per-gang all-or-nothing survives co-batching because dropping a
+        failed gang's placements only FREES constraints for the committed
+        ones: resources/ports/anti-affinity stay satisfied (fewer pods
+        can't add conflicts).  Two conservative rules keep it exact:
+
+        * a gang the co-batch could NOT complete is retried through the
+          per-gang path on a FRESH snapshot (a failed gang's partial
+          in-scan placements inflate the scan state for later co-batched
+          gangs, so in-batch incompleteness can be spurious);
+        * when the co-batch carries ANY required pod-affinity terms and
+          any gang fails (in-scan or at bind time), the affected gangs
+          re-run per-gang — a dropped gang's pods could have been what
+          satisfied a committed gang's required affinity."""
+        results: List[Tuple[Optional[List[str]], int]] = [
+            (None, 0) for _ in gangs
+        ]
+
+        def _has_required_pod_affinity(pods) -> bool:
+            # the cross-gang drop hazard exists ONLY for required
+            # pod-affinity: dropping pods cannot violate anti-affinity
+            # (removal only removes matches) and preferred terms are
+            # score-only — so anti/preferred terms must not trigger the
+            # per-gang redo that defeats co-batch amortization
+            for p in pods:
+                a = p.spec.affinity
+                if (
+                    a is not None
+                    and a.pod_affinity is not None
+                    and a.pod_affinity.required
+                ):
+                    return True
+            return False
+
+        sched = self.scheduler
+        cap = max(1, int(getattr(sched.config, "batch_size", 2048)))
+        i = 0
+        while i < len(gangs):
+            # greedy co-batch: whole gangs up to the engine batch width
+            batch_slice: List[int] = []
+            width = 0
+            while i < len(gangs):
+                n = len(gangs[i][1])
+                if batch_slice and width + n > cap:
+                    break
+                batch_slice.append(i)
+                width += n
+                i += 1
+            if len(batch_slice) == 1:
+                g = batch_slice[0]
+                results[g] = self.schedule_gang(*gangs[g])
+                continue
+            flat: List[Pod] = []
+            spans: List[Tuple[int, int]] = []
+            for g in batch_slice:
+                spans.append((len(flat), len(flat) + len(gangs[g][1])))
+                flat.extend(gangs[g][1])
+            has_aff = _has_required_pod_affinity(flat)
+            hosts = self._launch(flat)
+            complete = []
+            for j, g in enumerate(batch_slice):
+                lo, hi = spans[j]
+                need = gangs[g][0].min_member or (hi - lo)
+                complete.append(int((hosts[lo:hi] >= 0).sum()) >= need)
+            if not all(complete) and has_aff:
+                # a dropped gang could have satisfied a committed gang's
+                # required affinity — redo the whole co-batch per-gang
+                for g in batch_slice:
+                    results[g] = self.schedule_gang(*gangs[g])
+                continue
+            # commit every COMPLETE gang from the batch placements FIRST
+            # (valid: the batch world is a superset of what commits, so
+            # dropped gangs only free constraints); retries run AFTER on
+            # fresh snapshots — a retried gang taking new capacity must
+            # not race placements assumed from the stale batch world
+            retry: List[int] = []
+            dropped = False  # any pod placed in-scan but not committed
+            for j, g in enumerate(batch_slice):
+                lo, hi = spans[j]
+                group, pods = gangs[g]
+                if not complete[j] or (dropped and has_aff):
+                    # in-batch incompleteness can be SPURIOUS (earlier
+                    # failed gangs' partials inflated the scan state),
+                    # and an earlier DROP — a rolled-back gang OR a
+                    # min_member truncation discarding beyond-need
+                    # placements — could strand a later gang's required
+                    # affinity: exact per-gang redo on a fresh snapshot
+                    retry.append(g)
+                    continue
+                # commit through the exact per-pod assume/bind path
+                # (rollback on binder failure, min_member semantics)
+                results[g] = self._commit_gang(group, pods, hosts[lo:hi])
+                in_scan = int((hosts[lo:hi] >= 0).sum())
+                if results[g][0] is None or results[g][1] < in_scan:
+                    dropped = True
+            for g in retry:
+                results[g] = self.schedule_gang(*gangs[g])
+        return results
+
+    def _commit_gang(self, group, pods, hosts):
+        """assume+bind one gang's precomputed placements; all-or-nothing."""
         import dataclasses
 
-        committed: List = []  # (assumed pod, node) pairs, for rollback
+        sched = self.scheduler
+        enc = sched.cache.encoder
+        need = group.min_member or len(pods)
+        out: List[str] = []
+        committed: List = []
         failed = False
         for i, pod in enumerate(pods):
             if len(committed) >= need and group.min_member:
@@ -108,7 +228,6 @@ class GangScheduler:
             committed.append((assumed, node))
             out.append(node)
         if failed or len(committed) < need:
-            # all-or-nothing: unwind every bind of this gang
             for assumed, _node in committed:
                 sched.cache.forget_pod(assumed)
                 unbinder = getattr(sched, "unbinder", None)

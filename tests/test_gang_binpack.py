@@ -147,3 +147,114 @@ def test_gang_rollback_unbinds_from_store():
 
     assert float(np.asarray(sched.cache.encoder.a_requested).sum()) == 0.0
     assert not sched.cache.encoder.pods
+
+
+def test_schedule_gangs_cobatched_matches_per_gang():
+    """Co-batched gangs (one launch per co-batch) must commit the same
+    gangs the per-gang path does, with identical cache effects."""
+    nodes = [make_node(f"n{i}", cpu="4") for i in range(6)]
+    gangs = [
+        (PodGroup(f"grp{g}"), [make_pod(f"g{g}-{i}", cpu="1")
+                               for i in range(4)])
+        for g in range(5)  # 20 cpu asked, 24 available -> last gang rides
+    ]
+    s1 = build_sched(nodes)
+    out1 = GangScheduler(s1).schedule_gangs(gangs)
+    s2 = build_sched([make_node(f"n{i}", cpu="4") for i in range(6)])
+    gs2 = GangScheduler(s2)
+    out2 = [gs2.schedule_gang(g, p) for g, p in gangs]
+    assert [o[0] is not None for o in out1] == [o[0] is not None for o in out2]
+    assert len(s1.cache.encoder.pods) == len(s2.cache.encoder.pods)
+
+
+def test_schedule_gangs_partial_failure_rolls_back_only_failed():
+    """Capacity for exactly 2 of 3 gangs: the complete gangs commit, the
+    failed gang leaves nothing in the cache."""
+    sched = build_sched([make_node(f"n{i}", cpu="4") for i in range(2)])
+    gangs = [
+        (PodGroup(f"grp{g}"), [make_pod(f"g{g}-{i}", cpu="1")
+                               for i in range(4)])
+        for g in range(3)
+    ]
+    out = GangScheduler(sched).schedule_gangs(gangs)
+    committed = [o for o in out if o[0] is not None]
+    assert len(committed) == 2
+    assert len(sched.cache.encoder.pods) == 8  # only whole gangs
+    # the failed gang reports its partial count without committing
+    failed = [o for o in out if o[0] is None]
+    assert failed and all(o[1] < 4 or o[1] == 0 for o in failed)
+
+
+def test_schedule_gangs_affinity_gang_falls_back_on_failure():
+    """A required-affinity gang co-batched with a failing gang must be
+    re-run per-gang (conservative cross-gang affinity guard) and still
+    commit correctly."""
+    sched = build_sched([make_node(f"n{i}", cpu="4",
+                                   labels={"z": f"z{i % 2}"})
+                         for i in range(2)])
+    aff = {"podAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [{
+            "labelSelector": {"matchLabels": {"app": "a"}},
+            "topologyKey": "z"}]}}
+    gangs = [
+        (PodGroup("aff"), [make_pod(f"a-{i}", cpu="1", labels={"app": "a"},
+                                    affinity=aff) for i in range(2)]),
+        (PodGroup("big"), [make_pod(f"b-{i}", cpu="2") for i in range(4)]),
+    ]
+    out = GangScheduler(sched).schedule_gangs(gangs)
+    assert out[0][0] is not None       # affinity gang committed
+    assert out[1][0] is None           # 8-cpu gang cannot fit in 8 - 2
+    assert len(sched.cache.encoder.pods) == 2
+
+
+def test_schedule_gangs_spurious_infeasibility_retried():
+    """A failed gang's partial in-scan placements must not starve later
+    co-batched gangs: gang A (3 x 3cpu, cannot complete on 2 x 4cpu
+    nodes) is dropped, and gang B (2 x 2cpu) must still commit — the
+    co-batch retries B on a fresh snapshot if its in-batch run was
+    starved by A's partials (review scenario)."""
+    sched = build_sched([make_node(f"n{i}", cpu="4") for i in range(2)])
+    gangs = [
+        (PodGroup("A"), [make_pod(f"a-{i}", cpu="3") for i in range(3)]),
+        (PodGroup("B"), [make_pod(f"b-{i}", cpu="2") for i in range(2)]),
+    ]
+    out = GangScheduler(sched).schedule_gangs(gangs)
+    assert out[0][0] is None           # A cannot fit (2 nodes x 1 pod max)
+    assert out[1][0] is not None, out  # B must commit like the per-gang path
+    assert len(sched.cache.encoder.pods) == 2
+
+
+def test_schedule_gangs_min_member_truncation_guards_affinity():
+    """min_member truncation DROPS beyond-need placements; a later gang
+    whose required pod-affinity was satisfied in-scan by a dropped pod
+    must be re-run per-gang so it lands where the affinity actually
+    holds (review scenario: truncation bypassing the drop guard)."""
+    nodes = [make_node(f"n{i}", cpu="4", labels={"z": f"z{i}"})
+             for i in range(2)]
+    sched = build_sched(nodes)
+    a_pods = [make_pod(f"a-{i}", cpu="1", labels={"app": "a"})
+              for i in range(2)]
+    b_pod = make_pod("b-0", cpu="1", labels={"app": "b"},
+                     affinity={"podAffinity": {
+                         "requiredDuringSchedulingIgnoredDuringExecution": [{
+                             "labelSelector": {"matchLabels": {"app": "a"}},
+                             "topologyKey": "z"}]}})
+    out = GangScheduler(sched).schedule_gangs([
+        (PodGroup("A", min_member=1), a_pods),
+        (PodGroup("B"), [b_pod]),
+    ])
+    assert out[0][0] is not None and out[0][1] == 1  # truncated to 1 pod
+    assert out[1][0] is not None
+    # B must share a zone with A's COMMITTED pod (the real cluster), not
+    # with a dropped in-scan placement
+    committed_a = [rec for key, rec in sched.cache.encoder.pods.items()
+                   if key[1].startswith("a-")]
+    assert len(committed_a) == 1
+    a_node = {n.name: n for n in nodes}[
+        [k for k, v in sched.cache.encoder.node_rows.items()
+         if v == committed_a[0].node_row][0]]
+    b_rec = [rec for key, rec in sched.cache.encoder.pods.items()
+             if key[1] == "b-0"][0]
+    b_node = [k for k, v in sched.cache.encoder.node_rows.items()
+              if v == b_rec.node_row][0]
+    assert {n.name: n for n in nodes}[b_node].labels["z"] == a_node.labels["z"]
