@@ -134,6 +134,7 @@ def run_sustained_density(
                 while created < min(due, pods):
                     cluster.add_pod(pending_pod(created))
                     created += 1
+            results_before = len(sched.results)
             placed = sched.run_once(timeout=0.05)
             now = time.monotonic()
             bind_times.extend([now] * placed)
@@ -143,7 +144,10 @@ def run_sustained_density(
             if placed and churned < int(pods * churn_fraction):
                 kill = min(max(1, placed // 10),
                            int(pods * churn_fraction) - churned)
-                victims = [r.pod for r in sched.results[-placed:]
+                # slice by results-list growth, not the placed count:
+                # run_once returns PLACED pods while results records every
+                # attempt (and gang cycles append in gang order)
+                victims = [r.pod for r in sched.results[results_before:]
                            if r.node is not None][:kill]
                 for v in victims:
                     cluster.delete("pods", v.namespace, v.name)

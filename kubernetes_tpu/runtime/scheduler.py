@@ -738,11 +738,106 @@ class Scheduler:
 
     # ------------------------------------------------------------- run loop
 
+    # pods carrying this label pair schedule as all-or-nothing PodGroups
+    # (the scheduler-plugins lightweight-coscheduling convention:
+    # .../name = group, .../min-available = minMember).  Scope and limits,
+    # deliberately matching the convention's own semantics:
+    #  * atomicity covers the members CO-PENDING in one scheduling cycle
+    #    (the plugin likewise gates on min-available pods being Pending);
+    #    a group split across cycles schedules per co-arriving cohort, and
+    #    min-available larger than the engine batch width can never be
+    #    satisfied in one cycle and parks with backoff each retry;
+    #  * gangs do not trigger preemption (a failed gang parks like a
+    #    FitError pod but never evicts victims);
+    #  * when EXTENDERS are configured the gang path is bypassed (members
+    #    schedule as plain pods, no atomicity) — the gang launch cannot
+    #    consult extender filter verdicts, and silently ignoring them
+    #    would place pods on extender-vetoed nodes.
+    POD_GROUP_LABEL = "pod-group.scheduling.sigs.k8s.io/name"
+    POD_GROUP_MIN_MEMBER = "pod-group.scheduling.sigs.k8s.io/min-available"
+
     def run_once(self, timeout: float = 0.1) -> int:
+        """Pop one cycle's batch and schedule it; returns the number of
+        pods PLACED (both the gang and plain paths count placements)."""
         pods = self.queue.pop_batch(
             self.config.batch_size, timeout, self.config.batch_window_s
         )
-        return len(self.schedule_cycle(pods))
+        # gang-eligibility is conservative: extenders and framework
+        # plugins enforce verdicts the gang launch cannot consult, and an
+        # outstanding preemption nomination must not be absorbed by a
+        # gang (the plain path's two-pass protection, scheduler.py
+        # nominated handling) — any of these routes the members through
+        # the plain cycle (no atomicity) rather than risk a placement
+        # the normal path would reject
+        gang_eligible = (
+            not self.extenders
+            and self.framework is None
+            and not self.queue.nominated_pods()
+        )
+        plain = [p for p in pods
+                 if not gang_eligible or self.POD_GROUP_LABEL not in p.labels]
+        grouped: dict = {}
+        if gang_eligible:
+            for p in pods:
+                gname = p.labels.get(self.POD_GROUP_LABEL)
+                if gname is not None:
+                    grouped.setdefault((p.namespace, gname), []).append(p)
+        n = 0
+        if grouped:
+            # gangs first: they were popped in priority order and the
+            # plain sub-cycle must not strip capacity from them
+            from kubernetes_tpu.models.gang import GangScheduler, PodGroup
+
+            cycle = self.queue.scheduling_cycle
+            gangs = []
+            for (ns, gname), members in grouped.items():
+                mm = 0
+                for p in members:
+                    try:
+                        mm = max(mm, int(
+                            p.labels.get(self.POD_GROUP_MIN_MEMBER, 0)))
+                    except ValueError:
+                        pass
+                gangs.append(
+                    (PodGroup(gname, namespace=ns, min_member=mm), members)
+                )
+            t_cycle = time.monotonic()
+            results = GangScheduler(self).schedule_gangs(gangs)
+            for (group, members), (nodes, placed) in zip(gangs, results):
+                if nodes is None:
+                    # gang did not reach min_member: members park in the
+                    # unschedulableQ with backoff like any failed pod,
+                    # with the same failure bookkeeping
+                    for p in members:
+                        self.queue.add_unschedulable(p, cycle)
+                        self.results.append(ScheduleResult(p, None))
+                        m.SCHEDULE_ATTEMPTS.inc(result=m.UNSCHEDULABLE)
+                        self.recorder.eventf(
+                            "Pod", p.namespace, p.name,
+                            EVENT_TYPE_WARNING, "FailedScheduling",
+                            "pod group %s/%s: %d/%d members placed",
+                            group.namespace, group.name, placed,
+                            group.min_member or len(members),
+                        )
+                    continue
+                n += placed
+                for p, node in zip(members, nodes):
+                    if not node:
+                        # surplus member beyond min_member was NOT bound:
+                        # requeue (still-pending pod, not a failure)
+                        self.queue.add(p)
+                        continue
+                    # success bookkeeping identical to the plain path:
+                    # Scheduled event, counters, e2e histogram, results
+                    self.results.append(ScheduleResult(p, node))
+                    self._record_scheduled(
+                        p, node, time.monotonic() - t_cycle
+                    )
+        if plain:
+            n += sum(
+                1 for r in self.schedule_cycle(plain) if r.node is not None
+            )
+        return n
 
     def run(self) -> None:
         """wait.Until(scheduleOne) analog (scheduler.go:250-256)."""

@@ -258,3 +258,60 @@ def test_schedule_gangs_min_member_truncation_guards_affinity():
     b_node = [k for k, v in sched.cache.encoder.node_rows.items()
               if v == b_rec.node_row][0]
     assert {n.name: n for n in nodes}[b_node].labels["z"] == a_node.labels["z"]
+
+
+def test_run_once_routes_pod_groups_through_gang_path():
+    """Pods labeled with the coscheduling pod-group convention schedule
+    all-or-nothing through run_once; plain pods in the same cycle use the
+    normal batch path; an unsatisfiable gang parks in unschedulableQ."""
+    from kubernetes_tpu.runtime import PriorityQueue, Scheduler, SchedulerCache
+    from kubernetes_tpu.runtime.scheduler import SchedulerConfig
+
+    bound = []
+    cache = SchedulerCache()
+    sched = Scheduler(cache, PriorityQueue(),
+                      lambda p, n: bound.append((p.name, n)) or True,
+                      SchedulerConfig(batch_size=64, batch_window_s=0.0))
+    for i in range(2):
+        cache.add_node(make_node(f"n{i}", cpu="4"))
+    G = Scheduler.POD_GROUP_LABEL
+    M = Scheduler.POD_GROUP_MIN_MEMBER
+    for p in (
+        [make_pod("plain-0", cpu="1"), make_pod("plain-1", cpu="1")]
+        + [make_pod(f"ok-{i}", cpu="1", labels={G: "ok"}) for i in range(2)]
+        + [make_pod(f"big-{i}", cpu="3", labels={G: "big", M: "3"})
+           for i in range(3)]  # needs 9 cpu; only ~4 left
+    ):
+        sched.queue.add(p)
+    n = sched.run_once(timeout=0.05)
+    names = {nm for nm, _ in bound}
+    assert {"plain-0", "plain-1", "ok-0", "ok-1"} <= names
+    assert not any(nm.startswith("big-") for nm in names)  # all-or-nothing
+    assert n == 4
+    assert len(cache.encoder.pods) == 4
+    # the failed gang parked (unschedulable or backoff), not lost
+    parked = (len(sched.queue._unschedulable)
+              + sum(1 for e in sched.queue._backoffq if e[3])
+              + sum(1 for e in sched.queue._active if e[3]))
+    assert parked == 3
+
+
+def test_run_once_requeues_surplus_gang_members():
+    """A gang committing at min_member must requeue (not lose) the
+    surplus members, and the return value counts placements only."""
+    from kubernetes_tpu.runtime import PriorityQueue, Scheduler, SchedulerCache
+    from kubernetes_tpu.runtime.scheduler import SchedulerConfig
+
+    cache = SchedulerCache()
+    sched = Scheduler(cache, PriorityQueue(), lambda p, n: True,
+                      SchedulerConfig(batch_size=64, batch_window_s=0.0))
+    cache.add_node(make_node("n0", cpu="4"))
+    G, M = Scheduler.POD_GROUP_LABEL, Scheduler.POD_GROUP_MIN_MEMBER
+    for i in range(4):
+        sched.queue.add(make_pod(f"m-{i}", cpu="1", labels={G: "g", M: "2"}))
+    n = sched.run_once(timeout=0.05)
+    assert n == 2                      # min_member placements only
+    assert len(cache.encoder.pods) == 2
+    # the 2 surplus members are back in the ACTIVE queue (still pending)
+    again = sched.queue.pop_batch(8, 0.05, 0.0)
+    assert len(again) == 2

@@ -159,8 +159,11 @@ def test_kubectl_port_forward_relays_tcp(capsys):
         reply = None
         while time.time() < deadline:
             try:
+                # generous per-attempt timeout: the backend serves ONCE,
+                # so a recv that times out mid-relay under box load
+                # cannot be retried — waiting beats flaking
                 c = socket.create_connection(("127.0.0.1", local_port),
-                                             timeout=1)
+                                             timeout=8)
                 c.sendall(b"ping")
                 c.shutdown(socket.SHUT_WR)
                 reply = c.recv(1024)
