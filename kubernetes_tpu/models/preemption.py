@@ -138,6 +138,37 @@ def dense_start_ranks(starts) -> np.ndarray:
     return inv.astype(np.float32)
 
 
+_PREEMPT_EVAL_CACHE: dict = {}
+
+
+def make_preempt_eval(cfg, unsched_taint_key: int):
+    """Memoized jitted candidate evaluation (filter_batch +
+    required_affinity_ok + preemption_candidates in ONE launch) — called
+    eagerly these are ~30 op-by-op dispatches, i.e. ~30 tunnel RTTs per
+    preempt() on a remote-attached chip.  Memoized per (cfg, key) like
+    make_sequential_scheduler's _SEQ_CACHE, so many Scheduler instances
+    with one config share one pinned executable."""
+    key = (cfg, unsched_taint_key)
+    hit = _PREEMPT_EVAL_CACHE.get(key)
+    if hit is not None:
+        return hit
+    from kubernetes_tpu.ops.predicates import (
+        filter_batch,
+        required_affinity_ok,
+    )
+
+    @jax.jit
+    def run(cluster, batch):
+        _, per_pred = filter_batch(cluster, batch, cfg, unsched_taint_key)
+        aff_ok = required_affinity_ok(cluster, batch)
+        return preemption_candidates(per_pred, cluster.valid, aff_ok)
+
+    if len(_PREEMPT_EVAL_CACHE) > 64:
+        _PREEMPT_EVAL_CACHE.clear()
+    _PREEMPT_EVAL_CACHE[key] = run
+    return run
+
+
 def pick_preemption_node(encoder, pod, cands, arena, slots, violating, max_vols):
     """Shared host driver for the pick -> verify -> veto loop (used by both
     the scheduler's preempt and the extender's /preempt verb):

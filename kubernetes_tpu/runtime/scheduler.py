@@ -23,6 +23,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 
 from kubernetes_tpu.api.types import Pod, PodDisruptionBudget
@@ -36,6 +37,7 @@ from kubernetes_tpu.models.batched import (
     make_sequential_scheduler,
 )
 from kubernetes_tpu.models.preemption import (
+    make_preempt_eval,
     pick_preemption_node,
     preemption_candidates,
     sorted_victim_slots,
@@ -153,6 +155,9 @@ class Scheduler:
             percentage_of_nodes_to_score=self.config.percentage_of_nodes_to_score,
         )
         self._schedule_fn = make_sequential_scheduler(**engine_kw)
+        self._preempt_eval = make_preempt_eval(
+            self.config.filter_config, self._unsched_key
+        )
         # incremental host->device snapshot upload: unchanged fields reuse
         # their resident device buffers between cycles (codec/transfer.py)
         from kubernetes_tpu.codec.transfer import DeviceSnapshotCache
@@ -591,19 +596,27 @@ class Scheduler:
                 return None
             batch = enc.encode_pods([pod])
             cluster, _ = self.cache.snapshot()
-            _, per_pred = filter_batch(
-                cluster, batch, self.config.filter_config, self._unsched_key
-            )
-            aff_ok = required_affinity_ok(cluster, batch)
-            cands = np.asarray(
-                preemption_candidates(
-                    np.asarray(per_pred), np.asarray(cluster.valid), np.asarray(aff_ok)
-                )
-            )[0].copy()
-            if not cands.any():
-                # nodesWherePreemptionMightHelp came back empty: clear any
-                # previous nomination (generic_scheduler.go:328-333)
-                self._clear_nomination(pod)
+        # device work OUTSIDE the cache lock: a first-shape preempt pays a
+        # multi-second XLA compile, and informer/event threads must not
+        # stall on the lock for it.  The snapshot is a point-in-time copy;
+        # cands may be one event stale vs the re-acquired state below —
+        # the same optimistic semantics as the reference (the pick loop's
+        # verify/veto and the next cycle resolve races).
+        # Resident-buffer reuse + explicit device_put: preemption runs
+        # right after a failed cycle (snapshot mostly byte-identical), and
+        # host-numpy jit ARGUMENTS cross the tunnel on the slow
+        # synchronous path (codec/transfer.py).
+        cluster = self._dev_snapshot.update(cluster)
+        if jax.default_backend() != "cpu":
+            batch = jax.device_put(batch)
+        cands = np.asarray(self._preempt_eval(cluster, batch))[0].copy()
+        if not cands.any():
+            # nodesWherePreemptionMightHelp came back empty: clear any
+            # previous nomination (generic_scheduler.go:328-333)
+            self._clear_nomination(pod)
+            return None
+        with self.cache._lock:
+            if not self._eligible_to_preempt(pod):
                 return None
             arena = enc.pods_snapshot()
             violating = self._pdb_violating_flags(enc, len(arena.node))
