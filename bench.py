@@ -96,11 +96,99 @@ def _acquire_device_lock(timeout_s: float):
             time.sleep(2.0)
 
 
+_N_DEPLOY = 20
+_ZONE = "failure-domain.beta.kubernetes.io/zone"
+
+
+def _build_encoder(args):
+    """The shared 5k-node cluster shape (raw-engine AND live-path stages:
+    identical padded tensor shapes mean one compiled program serves both)."""
+    from kubernetes_tpu.api.factory import make_node
+    from kubernetes_tpu.codec import SnapshotEncoder
+
+    enc = SnapshotEncoder()
+    for i in range(args.nodes):
+        enc.add_node(
+            make_node(
+                f"node-{i}",
+                cpu="32",
+                mem="256Gi",
+                pods=110,
+                labels={_ZONE: f"zone-{i % 8}", "tier": "a" if i % 3 else "b"},
+                taints=[{"key": "dedicated", "value": "x", "effect": "NoSchedule"}]
+                if i % 50 == 0
+                else [],
+            )
+        )
+    for d in range(_N_DEPLOY):
+        enc.add_spread_selector("default", {"app": f"dep-{d}"})
+    return enc
+
+
+def _pending_pod(args, i):
+    """One pending pod in the selected workload shape — the
+    scheduler_bench_test.go:39-131 matrix: plain (BenchmarkScheduling),
+    node-affinity, pod-affinity, pod-anti-affinity variants."""
+    from kubernetes_tpu.api.factory import make_pod
+
+    d = i % _N_DEPLOY
+    if args.workload == "node-affinity":
+        # BenchmarkSchedulingNodeAffinity: required In-match on a label
+        return make_pod(
+            f"pod-{i}", cpu="100m", mem="256Mi",
+            labels={"app": f"dep-{d}"},
+            affinity={"nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{"matchExpressions": [
+                        # selective: only the ~2/3 tier-a nodes match
+                        {"key": "tier", "operator": "In",
+                         "values": ["a"]}
+                    ]}]}}},
+            owner=("ReplicaSet", f"rs-{d}"),
+        )
+    if args.workload == "pod-affinity":
+        # BenchmarkSchedulingPodAffinity: zone-level required affinity
+        # to the workload's own label (co-locate with mates)
+        return make_pod(
+            f"pod-{i}", cpu="100m", mem="256Mi",
+            labels={"app": f"dep-{d}"},
+            affinity={"podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "labelSelector": {
+                        "matchLabels": {"app": f"dep-{d}"}},
+                    "topologyKey":
+                        "failure-domain.beta.kubernetes.io/zone",
+                }]}},
+            owner=("ReplicaSet", f"rs-{d}"),
+        )
+    if args.workload == "pod-anti-affinity":
+        # BenchmarkSchedulingPodAntiAffinity: hostname-level required
+        # anti-affinity (one per node per group)
+        return make_pod(
+            f"pod-{i}", cpu="100m", mem="256Mi",
+            labels={"app": f"dep-{d}"},
+            affinity={"podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "labelSelector": {
+                        "matchLabels": {"app": f"dep-{d}"}},
+                    "topologyKey": "kubernetes.io/hostname",
+                }]}},
+            owner=("ReplicaSet", f"rs-{d}"),
+        )
+    return make_pod(
+        f"pod-{i}",
+        cpu="100m",
+        mem="256Mi",
+        labels={"app": f"dep-{d}"},
+        node_selector={"tier": "a"} if d % 4 == 0 else None,
+        owner=("ReplicaSet", f"rs-{d}"),
+    )
+
+
 def run(args) -> dict:
     import jax
 
-    from kubernetes_tpu.api.factory import make_node, make_pod
-    from kubernetes_tpu.codec import SnapshotEncoder
+    from kubernetes_tpu.api.factory import make_pod
     from kubernetes_tpu.models.batched import (
         batch_has_pod_affinity,
         encode_batch_affinity,
@@ -109,26 +197,10 @@ def run(args) -> dict:
     )
     from kubernetes_tpu.models.speculative import make_speculative_scheduler
 
-    zone = "failure-domain.beta.kubernetes.io/zone"
-    enc = SnapshotEncoder()
     t0 = time.monotonic()
-    for i in range(args.nodes):
-        enc.add_node(
-            make_node(
-                f"node-{i}",
-                cpu="32",
-                mem="256Gi",
-                pods=110,
-                labels={zone: f"zone-{i % 8}", "tier": "a" if i % 3 else "b"},
-                taints=[{"key": "dedicated", "value": "x", "effect": "NoSchedule"}]
-                if i % 50 == 0
-                else [],
-            )
-        )
-    n_deploy = 20
-    for d in range(n_deploy):
-        enc.add_spread_selector("default", {"app": f"dep-{d}"})
+    enc = _build_encoder(args)
     t_nodes = time.monotonic() - t0
+    n_deploy = _N_DEPLOY
     # the scheduler_bench_test.go matrix's second dimension: N pods
     # ALREADY running before the measured scheduling starts (existing-pod
     # state exercises spread counts, resource accumulation, and — for the
@@ -147,61 +219,7 @@ def run(args) -> dict:
     t_existing = time.monotonic() - t0
 
     def pending_pod(i):
-        """One pending pod in the selected workload shape — the
-        scheduler_bench_test.go:39-131 matrix: plain (BenchmarkScheduling),
-        node-affinity, pod-affinity, pod-anti-affinity variants."""
-        d = i % n_deploy
-        if args.workload == "node-affinity":
-            # BenchmarkSchedulingNodeAffinity: required In-match on a label
-            return make_pod(
-                f"pod-{i}", cpu="100m", mem="256Mi",
-                labels={"app": f"dep-{d}"},
-                affinity={"nodeAffinity": {
-                    "requiredDuringSchedulingIgnoredDuringExecution": {
-                        "nodeSelectorTerms": [{"matchExpressions": [
-                            # selective: only the ~2/3 tier-a nodes match
-                            {"key": "tier", "operator": "In",
-                             "values": ["a"]}
-                        ]}]}}},
-                owner=("ReplicaSet", f"rs-{d}"),
-            )
-        if args.workload == "pod-affinity":
-            # BenchmarkSchedulingPodAffinity: zone-level required affinity
-            # to the workload's own label (co-locate with mates)
-            return make_pod(
-                f"pod-{i}", cpu="100m", mem="256Mi",
-                labels={"app": f"dep-{d}"},
-                affinity={"podAffinity": {
-                    "requiredDuringSchedulingIgnoredDuringExecution": [{
-                        "labelSelector": {
-                            "matchLabels": {"app": f"dep-{d}"}},
-                        "topologyKey":
-                            "failure-domain.beta.kubernetes.io/zone",
-                    }]}},
-                owner=("ReplicaSet", f"rs-{d}"),
-            )
-        if args.workload == "pod-anti-affinity":
-            # BenchmarkSchedulingPodAntiAffinity: hostname-level required
-            # anti-affinity (one per node per group)
-            return make_pod(
-                f"pod-{i}", cpu="100m", mem="256Mi",
-                labels={"app": f"dep-{d}"},
-                affinity={"podAntiAffinity": {
-                    "requiredDuringSchedulingIgnoredDuringExecution": [{
-                        "labelSelector": {
-                            "matchLabels": {"app": f"dep-{d}"}},
-                        "topologyKey": "kubernetes.io/hostname",
-                    }]}},
-                owner=("ReplicaSet", f"rs-{d}"),
-            )
-        return make_pod(
-            f"pod-{i}",
-            cpu="100m",
-            mem="256Mi",
-            labels={"app": f"dep-{d}"},
-            node_selector={"tier": "a"} if d % 4 == 0 else None,
-            owner=("ReplicaSet", f"rs-{d}"),
-        )
+        return _pending_pod(args, i)
 
     # both engines carry in-batch affinity state (the speculative engine
     # batch-updates the scan's per-topology-pair extras between repair
@@ -274,7 +292,7 @@ def run(args) -> dict:
         hosts = np.asarray(hosts_dev)  # blocks on device compute + D2H copy
         tb = time.monotonic()
         phases["fetch"] += tb - tf
-        bound = 0
+        committed = []
         for j, pod in enumerate(pods):
             r = int(hosts[j])
             if r < 0:
@@ -284,10 +302,13 @@ def run(args) -> dict:
             # at 10k commits/s (Pod/PodSpec are plain mutable dataclasses)
             spec = copy.copy(pod.spec)
             spec.node_name = row_names[r]
-            committed = copy.copy(pod)
-            committed.spec = spec
-            enc.add_pod(committed)
-            bound += 1
+            c = copy.copy(pod)
+            c.spec = spec
+            committed.append(c)
+        # ONE vectorized encoder delta for the whole batch (the per-pod
+        # add_pod loop was the dominant host cost at 10k commits/s)
+        enc.add_pods(committed)
+        bound = len(committed)
         scheduled += bound
         t_done = time.monotonic()
         lat_e2e.extend([t_done - t0] * bound)
@@ -386,7 +407,22 @@ def run(args) -> dict:
         "pipeline_latency_ms": pct(lat_pipe),
         "device": str(jax.devices()[0]),
     }
-    return {
+    # ---- live-path stage: the number that actually matters (VERDICT r05
+    # weak #1) — queue -> schedule_cycle -> reserve/assume/bind through the
+    # real Scheduler runtime, batched+pipelined commit.  On the CPU path a
+    # second run with the per-pod commit loop pins the batched commit's
+    # win as commit-phase seconds in the same artifact.
+    try:
+        if jax.default_backend() == "cpu":
+            # comparison run FIRST so any one-time cost (jit variants,
+            # allocator warm-up) lands on it, not on the headline figure
+            detail["live_path_perpod"] = run_live(
+                args, batched=False, pipeline=False
+            )
+        detail["live_path"] = run_live(args, batched=True, pipeline=True)
+    except Exception as e:  # noqa: BLE001 — the raw number still emits
+        detail["live_path_error"] = f"{type(e).__name__}: {e}"
+    out = {
         "metric": "pods_scheduled_per_sec_5k_nodes",
         "value": round(pods_per_s, 1),
         "unit": "pods/s",
@@ -398,6 +434,91 @@ def run(args) -> dict:
         "vs_north_star": round(pods_per_s / 10000.0, 3),
         "p99_schedule_latency_ms": lat.get("p99", 0.0),
         "detail": detail,
+    }
+    if "live_path" in detail:
+        # surface the live-control-plane figure next to the raw-engine one
+        # so the perf trajectory tracks the number that actually matters
+        out["live_path_pods_per_s"] = detail["live_path"]["pods_per_s"]
+    return out
+
+
+def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
+    """Live control-plane throughput: queue -> pop_batch -> schedule_cycle
+    -> reserve/assume/bind through the real Scheduler runtime (the path
+    the density SLO measures), on the same cluster/workload shape as the
+    raw-engine stage so the two figures are directly comparable.
+
+    batched/pipeline select the commit implementation (SchedulerConfig
+    .batched_commit / .pipeline_commit); per-phase host seconds come from
+    the Scheduler's own phase_seconds counters, so `commit_seconds` is the
+    apples-to-apples cost of the commit stage under each mode."""
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+    from kubernetes_tpu.runtime.queue import PriorityQueue
+    from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+    t_setup = time.monotonic()
+    enc = _build_encoder(args)
+    cache = SchedulerCache(enc)
+    queue = PriorityQueue()
+    sched = Scheduler(
+        cache=cache,
+        queue=queue,
+        binder=lambda pod, node: True,
+        config=SchedulerConfig(
+            batch_size=args.batch,
+            batch_window_s=0.0,
+            engine=args.engine,
+            disable_preemption=True,
+            batched_commit=batched,
+            pipeline_commit=pipeline,
+        ),
+    )
+    def _drain(budget_s: float) -> int:
+        """run_once until nothing schedulable remains: active/backoff work,
+        an in-flight pipelined batch, or the budget.  Pods parked
+        unschedulable do NOT keep the loop alive (no cluster events fire
+        here to revive them — without this check a single FitError pod
+        would spin the loop to the deadline)."""
+        placed = 0
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            got = sched.run_once(timeout=0.0)
+            placed += got
+            if got == 0 and not sched.pipeline_pending:
+                if not queue.has_schedulable():
+                    break
+                time.sleep(0.002)  # backoff expiries: don't hot-spin
+        return placed + sched.flush_pipeline()
+
+    # warmup: one full-width batch through the whole path (compile + row
+    # caches + first-fetch setup), outside the timed window
+    for j in range(args.batch):
+        queue.add(_pending_pod(args, args.pods + j))
+    _drain(600)
+    setup_s = time.monotonic() - t_setup
+
+    for k in sched.phase_seconds:
+        sched.phase_seconds[k] = 0.0
+    total = args.pods
+    # pod-object construction stays outside the timed window (the raw
+    # stage and the reference's create strategy both exclude it); the
+    # queue adds are inside — they ARE the live path's entry point
+    pending = [_pending_pod(args, i) for i in range(total)]
+    t0 = time.monotonic()
+    for p in pending:
+        queue.add(p)
+    placed = _drain(900)
+    dt = time.monotonic() - t0
+    return {
+        "pods_per_s": round(placed / dt, 1) if dt > 0 else 0.0,
+        "seconds": round(dt, 3),
+        "placed": placed,
+        "unschedulable": total - placed,
+        "batched_commit": batched,
+        "pipeline_commit": pipeline,
+        "commit_seconds": round(sched.phase_seconds["commit"], 3),
+        "phases": {k: round(v, 3) for k, v in sched.phase_seconds.items()},
+        "setup_seconds": round(setup_s, 3),
     }
 
 

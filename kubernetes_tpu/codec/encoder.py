@@ -222,6 +222,54 @@ class SnapshotEncoder:
         self._req_memo: Dict[Tuple, Tuple[np.ndarray, np.ndarray]] = {}
         self._empty_vcounts: np.ndarray | None = None
 
+        # ---- incremental snapshot bookkeeping ----
+        # snapshot() re-encodes ONLY rows touched since the previous
+        # snapshot (copy-on-write per field); untouched fields are returned
+        # as the SAME array object, which DeviceSnapshotCache detects by
+        # identity and skips re-transferring.  Node-level mutations dirty
+        # every per-row field of a row; pod commits dirty only the
+        # aggregate fields (requested/nonzero/ports/vols).  Any arena
+        # retile / vocabulary growth / bulk backfill falls back to a full
+        # rebuild (_mark_all_dirty) — content correctness never depends on
+        # a mutation site remembering to mark precisely.
+        self._snap: Optional[ClusterTensors] = None
+        self._snap_dirty_all = True
+        self._dirty_node_rows: Set[int] = set()
+        self._dirty_pod_rows: Set[int] = set()
+        self._gc_dirty = True          # group_counts (pod/spread dependent)
+        self._snap_pairs_len = -1      # pair_topo_key rebuild detector
+        # rows refreshed by snapshots since the last take_dirty_rows();
+        # None = a full rebuild happened (consumer must full-sync)
+        self._snap_rows_acc: Optional[Set[int]] = set()
+
+    # ---------------------------------------------------- dirty bookkeeping
+
+    def _mark_all_dirty(self) -> None:
+        self._snap_dirty_all = True
+        self._gc_dirty = True
+
+    def _mark_node_dirty(self, row: int) -> None:
+        self._dirty_node_rows.add(row)
+
+    def _mark_pod_dirty(self, row: int) -> None:
+        if row >= 0:
+            self._dirty_pod_rows.add(row)
+
+    def take_dirty_rows(self) -> Optional[np.ndarray]:
+        """Rows whose snapshot content may differ from what the (single)
+        transfer consumer last uploaded: the union of rows applied by
+        snapshots since the previous take, plus still-pending marks (extra
+        rows are harmless — the scatter just rewrites identical values).
+        Returns None after a full rebuild (consumer must resync every
+        field).  Single-consumer contract: the scheduler's
+        DeviceSnapshotCache; a second taker would starve the first."""
+        if self._snap_rows_acc is None or self._snap_dirty_all:
+            self._snap_rows_acc = set()
+            return None
+        rows = self._snap_rows_acc | self._dirty_node_rows | self._dirty_pod_rows
+        self._snap_rows_acc = set()
+        return np.asarray(sorted(rows), np.int32)
+
     # ------------------------------------------------------------------ arena
 
     def _alloc_node_arena(self) -> None:
@@ -288,6 +336,7 @@ class SnapshotEncoder:
             if k in self._node_pair_id:
                 n = min(old_cap, self._cap_n)
                 self._node_pair_id[k][:n] = col[:n]
+        self._mark_all_dirty()
 
     def _grow_pods(self) -> None:
         old = self._cap_m
@@ -312,6 +361,7 @@ class SnapshotEncoder:
             nc = np.zeros(self.dims.TP, np.float32)
             nc[: g.pair_counts.shape[0]] = g.pair_counts
             g.pair_counts = nc
+        self._mark_all_dirty()
 
     # ------------------------------------------------------------- vocabulary
 
@@ -331,6 +381,7 @@ class SnapshotEncoder:
         if kid in self.topo_keys:
             return kid
         self.topo_keys.add(kid)
+        self._mark_all_dirty()  # backfill below rewrites a_topo across rows
         self._node_pair_id[kid] = np.full(self._cap_n, PAD, np.int32)
         for name, row in self.node_rows.items():
             node = self._row_node[row]
@@ -365,6 +416,7 @@ class SnapshotEncoder:
                     r = np.zeros(self.dims.R, np.float32)
                     r[:old] = rec.req
                     rec.req = r
+                self._mark_all_dirty()
             self._res_cols[name] = col
         return col
 
@@ -392,6 +444,7 @@ class SnapshotEncoder:
         self._node_ports[row] = Counter()
         self._node_disk_vols[row] = Counter()
         self._write_node_row(row, node)
+        self._mark_node_dirty(row)
         self.generation += 1
         return row
 
@@ -412,6 +465,7 @@ class SnapshotEncoder:
         self._write_node_row(row, node)
         for rec in resident:
             self._shift_pod_pairs(rec, add=True)
+        self._mark_node_dirty(row)
         self.generation += 1
         return row
 
@@ -458,6 +512,8 @@ class SnapshotEncoder:
         for col in self._node_pair_id.values():
             col[row] = PAD
         self._free_rows.append(row)
+        self._mark_node_dirty(row)
+        self._gc_dirty = True  # detached pods left p_node
         self.generation += 1
 
     def _write_node_row(self, row: int, node: Node) -> None:
@@ -622,6 +678,7 @@ class SnapshotEncoder:
         for row in self._node_ports:
             self._rebuild_node_ports(row)
             self._rebuild_node_vols(row)
+        self._mark_all_dirty()
 
     def _rebuild_node_ports(self, row: int) -> None:
         self.a_ppp[row, :] = PAD
@@ -699,6 +756,7 @@ class SnapshotEncoder:
                 rec.cnt_vols = list(rec.cnt_vols) + [
                     set() for _ in range(grow)
                 ]
+            self._mark_all_dirty()
         self._vol_cols[csi_driver] = col
         return col
 
@@ -925,7 +983,166 @@ class SnapshotEncoder:
                         )
                     self.a_volcnt[node_row, t] = len(cnts[t])
         self._register_pod_terms(pod, rec)
+        self._mark_pod_dirty(node_row)
+        self._gc_dirty = True
         self.generation += 1
+
+    def add_pods(self, pods: Sequence[Pod]) -> None:
+        """Batched add_pod: one pass that produces byte-identical arena
+        state to calling add_pod(p) for each pod in order, amortizing the
+        per-pod numpy overhead (the host-commit wall of the live control
+        plane):
+
+          * row aggregates apply as ONE ordered np.add.at scatter instead
+            of 2B row-slice adds (same accumulation order -> identical
+            floats);
+          * the pod-arena columns (alive/ns/node, label columns) write via
+            fancy indexing, grouped per label key;
+          * port/volume row rebuilds (row-wide sorts) run once per TOUCHED
+            row after all pods applied, not once per pod;
+          * the generation counter advances by len(pods) in one step.
+
+        Equivalence is pinned by tests/test_batched_commit.py."""
+        if not pods:
+            return
+        # Replacement batches take the exact per-pod path: duplicate keys
+        # within the batch would corrupt the two-pass layout, and replacing
+        # already-resident keys would reorder the -old/+new float
+        # accumulation on shared node rows (per-pod interleaves per pod;
+        # the batched passes would group all removes first), breaking the
+        # byte-identical contract in the low-order bits.  The hot path —
+        # assuming a cycle's freshly-scheduled winners — never replaces.
+        batch_keys = [(p.namespace, p.name) for p in pods]
+        if len(set(batch_keys)) != len(batch_keys) or any(
+            k in self.pods for k in batch_keys
+        ):
+            for pod in pods:
+                self.add_pod(pod)
+            return
+        # -- pass 1: arena-slot allocation (growth first, so all later
+        # vectorized writes target the final arrays)
+        ms: List[int] = []
+        for pod in pods:
+            if self._free_m:
+                m = self._free_m.pop()
+            else:
+                m = self._next_m
+                self._next_m += 1
+                if m >= self._cap_m:
+                    self._grow_pods()
+            ms.append(m)
+        # -- pass 2: per-pod records + bookkeeping collection
+        recs: List[_PodRecord] = []
+        rows: List[int] = []
+        ns_ids: List[int] = []
+        label_writes: Dict[int, Tuple[List[int], List[int]]] = {}
+        touched_ports: Set[int] = set()
+        touched_vols: Set[int] = set()
+        vol_rows: Set[int] = set()
+        for pod, m in zip(pods, ms):
+            key = (pod.namespace, pod.name)
+            node_row = self.node_rows.get(pod.spec.node_name, -1)
+            rk = (
+                tuple(tuple(c.requests.items()) for c in pod.spec.containers),
+                () if not pod.spec.init_containers else tuple(
+                    tuple(c.requests.items())
+                    for c in pod.spec.init_containers
+                ),
+            )
+            hit = self._req_memo.get(rk)
+            if hit is None or hit[0].shape[0] != self.dims.R:
+                if len(self._req_memo) > 4096:
+                    self._req_memo.clear()
+                hit = (self._req_vector(pod.resource_request()), self._nonzero(pod))
+                self._req_memo[rk] = hit
+            req, nonzero = hit
+            ports = self._pod_ports(pod)
+            disk_check, disk_adv, vcounts, cnt_ids = self._pod_vols(pod)
+            rec = _PodRecord(
+                key=key,
+                labels=dict(pod.labels),
+                ns=pod.namespace,
+                node_row=node_row,
+                m=m,
+                req=req,
+                nonzero=nonzero,
+                ports=ports,
+                disk_vols=disk_adv,
+                vol_counts=vcounts,
+                cnt_vols=cnt_ids,
+                priority=pod.spec.priority,
+                pod=pod,
+                start_time=pod.status.start_time,
+                uid=pod.metadata.uid,
+            )
+            self.pods[key] = rec
+            recs.append(rec)
+            rows.append(node_row)
+            ns_ids.append(self.interner.intern(pod.namespace))
+            for k, v in pod.labels.items():
+                kid = self.interner.intern(k)
+                tgt = label_writes.setdefault(kid, ([], []))
+                tgt[0].append(m)
+                tgt[1].append(self.interner.intern(v))
+            # term registration stays IN the per-pod pass: it interns the
+            # term's selector/topology strings, and id assignment must
+            # follow add_pod's per-pod order (ns, labels, terms) or
+            # novel-string batches diverge from the per-pod loop in every
+            # interned-id-bearing tensor
+            self._register_pod_terms(pod, rec)
+            if node_row >= 0:
+                self._row_pods.setdefault(node_row, set()).add(key)
+                if ports:
+                    for pp_ip in ports:
+                        self._node_ports[node_row][pp_ip] += 1
+                    touched_ports.add(node_row)
+                if disk_adv:
+                    for dv in disk_adv:
+                        self._node_disk_vols[node_row][dv] += 1
+                    touched_vols.add(node_row)
+                if cnt_ids:
+                    cnts = self._node_cnt_vols.get(node_row)
+                    if cnts is None:
+                        cnts = self._node_cnt_vols[node_row] = [
+                            Counter() for _ in range(self.dims.VT)
+                        ]
+                    for t, ids in enumerate(cnt_ids):
+                        for vid in ids:
+                            cnts[t][vid] += 1
+                            self._cnt_vol_rows[t].setdefault(vid, set()).add(
+                                node_row
+                            )
+                    vol_rows.add(node_row)
+        # -- pass 3: vectorized arena writes
+        ms_arr = np.asarray(ms, np.intp)
+        self.p_alive[ms_arr] = True
+        self.p_ns[ms_arr] = np.asarray(ns_ids, np.int32)
+        self.p_node[ms_arr] = np.asarray(rows, np.int32)
+        for kid, (kms, vids) in label_writes.items():
+            col = self._label_cols.get(kid)
+            if col is None:
+                col = np.full(self._cap_m, PAD, np.int32)
+                self._label_cols[kid] = col
+            col[np.asarray(kms, np.intp)] = np.asarray(vids, np.int32)
+        rows_arr = np.asarray(rows, np.intp)
+        on_node = rows_arr >= 0
+        if on_node.any():
+            req_stack = np.stack([r.req for r in recs])
+            nz_stack = np.stack([r.nonzero for r in recs])
+            np.add.at(self.a_requested, rows_arr[on_node], req_stack[on_node])
+            np.add.at(self.a_nonzero, rows_arr[on_node], nz_stack[on_node])
+        for row in vol_rows:
+            cnts = self._node_cnt_vols[row]
+            for t in range(self.dims.VT):
+                self.a_volcnt[row, t] = len(cnts[t])
+        for row in touched_ports:
+            self._rebuild_node_ports(row)
+        for row in touched_vols:
+            self._rebuild_node_vols(row)
+        for rec in recs:
+            self._mark_pod_dirty(rec.node_row)
+        self._gc_dirty = True
+        self.generation += len(pods)
 
     def remove_pod(self, pod: Pod) -> None:
         key = (pod.namespace, pod.name)
@@ -972,6 +1189,8 @@ class SnapshotEncoder:
                                     del self._cnt_vol_rows[t][vid]
                     self.a_volcnt[row, t] = len(cnts[t])
         self._unregister_pod_terms(rec)
+        self._mark_pod_dirty(row)
+        self._gc_dirty = True
         self.generation += 1
 
     # ------------------------------------------------- affinity term grouping
@@ -1222,6 +1441,7 @@ class SnapshotEncoder:
             self._service_selectors.append((namespace, dict(match_labels)))
         if len(self._spread) > self.dims.G:
             self.dims = self.dims.bump(G=len(self._spread))
+        self._gc_dirty = True
         self.generation += 1
 
     def _match_selector_vec(
@@ -1257,10 +1477,34 @@ class SnapshotEncoder:
 
     # ------------------------------------------------------------- snapshot
 
-    def snapshot(self) -> ClusterTensors:
+    # ClusterTensors field -> arena attribute, split by what dirties them:
+    # pod commits touch only the aggregate fields, node events touch every
+    # per-row field of the affected row.
+    _POD_FIELDS = (
+        ("requested", "a_requested"), ("nonzero_req", "a_nonzero"),
+        ("vol_counts", "a_volcnt"), ("port_pp", "a_ppp"),
+        ("port_ip", "a_pip"), ("port_used", "a_pused"),
+        ("disk_vol_ids", "a_dvol"),
+    )
+    _NODE_FIELDS = (
+        ("allocatable", "a_allocatable"), ("valid", "a_valid"),
+        ("unschedulable", "a_unsched"), ("not_ready", "a_notready"),
+        ("mem_pressure", "a_mempress"), ("disk_pressure", "a_diskpress"),
+        ("pid_pressure", "a_pidpress"), ("node_name_id", "a_name"),
+        ("label_keys", "a_lkeys"), ("label_vals", "a_lvals"),
+        ("label_nums", "a_lnums"), ("taint_key", "a_tkey"),
+        ("taint_val", "a_tval"), ("taint_effect", "a_teff"),
+        ("topo_pairs", "a_topo"), ("image_id", "a_img_id"),
+        ("avoid_owner", "a_avoid"), ("vol_limits", "a_vollim"),
+    )
+
+    def _pair_topo_key_arr(self) -> np.ndarray:
         pk = np.full(self.dims.TP, PAD, np.int32)
         if self._pair_topo_key:
             pk[: len(self._pair_topo_key)] = np.asarray(self._pair_topo_key, np.int32)
+        return pk
+
+    def _image_size_arr(self) -> np.ndarray:
         # image spread scaling (image_locality.go scaledImageScore):
         # scaled = size * numNodesWithImage / totalNodes
         total = max(len(self.node_rows), 1)
@@ -1273,40 +1517,73 @@ class SnapshotEncoder:
                 if iid >= 0:
                     lut[iid] = cnt / total
             scale = np.where(ids >= 0, lut[np.maximum(ids, 0)], 0.0)
+        return (self.a_img_sz * scale).astype(np.float32)
+
+    def snapshot(self, full: bool = False) -> ClusterTensors:
+        """Point-in-time ClusterTensors.  Incremental by default: only rows
+        dirtied since the previous snapshot are re-encoded (copy-on-write
+        per field), and fields with no dirty rows are returned as the SAME
+        array object as last time — consumers must treat snapshot arrays as
+        immutable (everything downstream already does: they feed jit).
+        `full=True` forces a from-scratch rebuild of every field."""
+        if full or self._snap is None or self._snap_dirty_all:
+            snap = self._snapshot_full()
+            self._snap_rows_acc = None  # consumer must full-sync
+        else:
+            snap = self._snapshot_incremental()
+        self._snap = snap
+        self._snap_dirty_all = False
+        self._dirty_node_rows.clear()
+        self._dirty_pod_rows.clear()
+        self._gc_dirty = False
+        self._snap_pairs_len = len(self._pair_topo_key)
+        return snap
+
+    def _snapshot_full(self) -> ClusterTensors:
+        fields = {
+            name: getattr(self, attr).copy()
+            for name, attr in self._POD_FIELDS + self._NODE_FIELDS
+        }
         return ClusterTensors(
-            allocatable=self.a_allocatable.copy(),
-            requested=self.a_requested.copy(),
-            nonzero_req=self.a_nonzero.copy(),
-            valid=self.a_valid.copy(),
-            unschedulable=self.a_unsched.copy(),
-            not_ready=self.a_notready.copy(),
-            mem_pressure=self.a_mempress.copy(),
-            disk_pressure=self.a_diskpress.copy(),
-            pid_pressure=self.a_pidpress.copy(),
-            node_name_id=self.a_name.copy(),
-            label_keys=self.a_lkeys.copy(),
-            label_vals=self.a_lvals.copy(),
-            label_nums=self.a_lnums.copy(),
-            taint_key=self.a_tkey.copy(),
-            taint_val=self.a_tval.copy(),
-            taint_effect=self.a_teff.copy(),
-            port_pp=self.a_ppp.copy(),
-            port_ip=self.a_pip.copy(),
-            port_used=self.a_pused.copy(),
-            topo_pairs=self.a_topo.copy(),
             # per-group per-node matching-pod counts: the device-side source
             # for SelectorSpread when the batch is spread-lean (every pod in
             # <= 1 group); multi-group batches ship exact AND counts in
             # PodBatch.spread_counts instead
             group_counts=self._group_counts(),
-            pair_topo_key=pk,
-            image_id=self.a_img_id.copy(),
-            image_size=(self.a_img_sz * scale).astype(np.float32),
-            avoid_owner=self.a_avoid.copy(),
-            vol_counts=self.a_volcnt.copy(),
-            vol_limits=self.a_vollim.copy(),
-            disk_vol_ids=self.a_dvol.copy(),
+            pair_topo_key=self._pair_topo_key_arr(),
+            image_size=self._image_size_arr(),
+            **fields,
         )
+
+    def _snapshot_incremental(self) -> ClusterTensors:
+        prev = self._snap
+        node_d = self._dirty_node_rows
+        pod_d = self._dirty_pod_rows | node_d
+        changed: Dict[str, np.ndarray] = {}
+
+        def cow(spec, rows_idx):
+            for name, attr in spec:
+                src = getattr(self, attr)
+                new = getattr(prev, name).copy()
+                new[rows_idx] = src[rows_idx]
+                changed[name] = new
+
+        if pod_d:
+            cow(self._POD_FIELDS, np.asarray(sorted(pod_d), np.intp))
+        if node_d:
+            cow(self._NODE_FIELDS, np.asarray(sorted(node_d), np.intp))
+            # the per-image scale divides by the node count, so any node
+            # event rescales every row
+            changed["image_size"] = self._image_size_arr()
+        if self._gc_dirty or prev.group_counts.shape != (self._cap_n, self.dims.G):
+            changed["group_counts"] = self._group_counts()
+        if len(self._pair_topo_key) != self._snap_pairs_len:
+            changed["pair_topo_key"] = self._pair_topo_key_arr()
+        if self._snap_rows_acc is not None:
+            self._snap_rows_acc |= pod_d
+        if not changed:
+            return prev
+        return dataclasses.replace(prev, **changed)
 
     def row_name(self, row: int) -> str:
         """Node name for an arena row (O(1); _row_node is kept consistent by

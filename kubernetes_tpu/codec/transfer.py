@@ -151,6 +151,22 @@ def unpack_tree(bufs, meta):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+@jax.jit
+def _scatter_rows(dev, rows, vals):
+    """Row scatter into a resident device buffer (duplicate indices carry
+    identical values, so pad-by-repeat is safe)."""
+    return dev.at[rows].set(vals)
+
+
+# fields whose leading axis is NOT the node-row axis, or which the encoder
+# recomputes wholesale so their diffs are NOT confined to dirty rows
+# (image_size rescales every row when the node count moves; group_counts
+# can shift many rows when a spread selector registers) — never scattered
+_NON_ROW_FIELDS = frozenset({"pair_topo_key", "image_size", "group_counts"})
+# scatter only pays while the dirty set stays a small fraction of N
+_SCATTER_MAX_FRAC = 4
+
+
 class DeviceSnapshotCache:
     """Incremental cluster-snapshot upload (SURVEY's "device-resident state
     with delta scatter, not re-upload" requirement; the host-side analog is
@@ -160,24 +176,67 @@ class DeviceSnapshotCache:
     The scheduler takes a fresh host snapshot every cycle, but between
     cycles most cluster tensor fields are byte-identical — label/taint/
     topology tensors only move on node events, while requested/nonzero move
-    on every commit.  update() compares each field against the previous
-    host snapshot (memcmp, ~3ms for the ~70MB of a 5k-node snapshot) and
-    re-uploads ONLY the changed fields; unchanged fields reuse their
-    resident device buffers.  Content comparison makes staleness
-    impossible — there is no mutation-site bookkeeping to miss.
+    on every commit.  update() skips any field whose host array is the
+    SAME OBJECT as last time (the encoder's incremental snapshot reuses
+    unchanged leaves by identity, making unchanged-field detection O(1));
+    non-identical fields fall back to content comparison (memcmp) before
+    re-uploading.  When the caller passes `dirty_rows` (the encoder's
+    take_dirty_rows()), a changed row-indexed field uploads only those
+    rows and scatters them into the resident device buffer instead of
+    re-shipping the whole tensor — the dirty set is exactly the rows the
+    incremental snapshot rewrote, so host arrays cannot differ elsewhere.
     """
 
     def __init__(self) -> None:
         self._host: dict = {}   # field -> last-uploaded host array
         self._dev: dict = {}    # field -> resident device array
 
-    def update(self, cluster):
+    def update(self, cluster, dirty_rows=None):
         """Host ClusterTensors (or any flat dataclass of numpy arrays) ->
-        same type with device-resident leaves, uploading only changes."""
+        same type with device-resident leaves, uploading only changes.
+        dirty_rows: optional i32[] of node rows touched since the previous
+        update (from SnapshotEncoder.take_dirty_rows(); None = unknown,
+        full content comparison)."""
         changed = []
+        rows_arr = None
+        if dirty_rows is not None and len(dirty_rows) > 0:
+            rows_arr = np.asarray(dirty_rows, np.int32)
         for f in dataclasses.fields(cluster):
             host = np.asarray(getattr(cluster, f.name))
             prev = self._host.get(f.name)
+            if prev is host:
+                continue  # identity: unchanged leaf reused by the encoder
+            if (
+                prev is not None
+                and rows_arr is not None
+                and f.name not in _NON_ROW_FIELDS
+                and f.name in self._dev
+                and prev.shape == host.shape
+                and prev.dtype == host.dtype
+                and host.ndim >= 1
+                and len(rows_arr) <= host.shape[0] // _SCATTER_MAX_FRAC
+            ):
+                sub = host[rows_arr]
+                if not np.array_equal(prev[rows_arr], sub):
+                    # pad rows to a pow2 bucket (repeat the first row) so
+                    # the scatter kernel compiles once per shape bucket
+                    k = _pow2(len(rows_arr))
+                    if k > len(rows_arr):
+                        pad = k - len(rows_arr)
+                        rows_p = np.concatenate(
+                            [rows_arr, np.repeat(rows_arr[:1], pad)]
+                        )
+                        sub_p = np.concatenate(
+                            [sub, np.repeat(sub[:1], pad, axis=0)]
+                        )
+                    else:
+                        rows_p, sub_p = rows_arr, sub
+                    dev_rows, dev_vals = jax.device_put((rows_p, sub_p))
+                    self._dev[f.name] = _scatter_rows(
+                        self._dev[f.name], dev_rows, dev_vals
+                    )
+                self._host[f.name] = host
+                continue
             if (
                 prev is None
                 or prev.shape != host.shape
@@ -185,7 +244,7 @@ class DeviceSnapshotCache:
                 or not np.array_equal(prev, host)
             ):
                 changed.append(f.name)
-                self._host[f.name] = host
+            self._host[f.name] = host
         if changed:
             uploaded = jax.device_put([self._host[n] for n in changed])
             self._dev.update(zip(changed, uploaded))

@@ -57,6 +57,12 @@ class KubeSchedulerConfiguration:
     # "speculative" (hybrid exactness fallback, the default) or
     # "sequential" (always the exact lax.scan)
     engine: str = "speculative"
+    # commit-path knobs (runtime/scheduler.py SchedulerConfig): batched =
+    # one encoder delta + batched event/metric emission per cycle;
+    # pipelined = double-buffer cycles (batch k's bind/event tail overlaps
+    # batch k+1's device dispatch)
+    batched_commit: bool = True
+    pipeline_commit: bool = False
 
     def build_profile(self, interner=None) -> SchedulingProfile:
         """CreateFromConfig / CreateFromProvider (scheduler.go:162-192)."""
@@ -98,6 +104,8 @@ class KubeSchedulerConfiguration:
             batch_size=int(d.get("batchSize", 256)),
             batch_window_s=float(d.get("batchWindowSeconds", 0.001)),
             engine=d.get("engine", "speculative"),
+            batched_commit=bool(d.get("batchedCommit", True)),
+            pipeline_commit=bool(d.get("pipelineCommit", False)),
         )
 
     @staticmethod

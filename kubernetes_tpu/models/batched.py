@@ -840,6 +840,10 @@ def make_sequential_scheduler(
     # the raw traced fn for callers composing INSIDE jit (the speculative
     # engine's in-program lax.cond redo)
     schedule_entry.jitted = schedule
+    # engine identity tag: consumers whose correctness depends on the
+    # strictly sequential one-at-a-time commit order (models/gang.py's
+    # cross-gang required-affinity drop guard) assert on this
+    schedule_entry.engine_kind = "sequential"
 
     _SEQ_CACHE[key] = schedule_entry
     while len(_SEQ_CACHE) > _SEQ_CACHE_CAP:

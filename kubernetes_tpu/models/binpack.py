@@ -35,7 +35,14 @@ def binpack_ffd(pod_reqs, capacity, max_bins: int = 1024, order=None):
     WITHOUT materializing a gathered copy of the pod list (the scan gathers
     one request per step); zero rows (padding) are skipped.  Returns
     (n_bins i32, loads f32[max_bins, R], placed bool[P] — False when
-    max_bins overflowed)."""
+    max_bins overflowed).
+
+    placed[] is aligned to SCAN positions, not pod indices: placed[k]
+    refers to pod order[k] when `order` is passed (with the default
+    identity order the two coincide).  Callers needing pod-indexed flags
+    must scatter back: out = np.empty(P, bool); out[order] = placed.
+    The in-tree caller (binpack_shapes) only reduces with jnp.all, which
+    is permutation-insensitive."""
 
     def step(loads, oi):
         req = pod_reqs[oi]

@@ -48,7 +48,19 @@ class GangScheduler:
     def _launch(self, pods: Sequence[Pod]) -> np.ndarray:
         """One engine launch over `pods` against a fresh snapshot; returns
         hosts i32[len(pods)] (-1 = unplaced).  Shared by the per-gang and
-        co-batched paths."""
+        co-batched paths.
+
+        ENGINE DEPENDENCY: this must run the strictly SEQUENTIAL scan.
+        schedule_gangs' cross-gang required-affinity drop guard (redoing
+        only LATER gangs when an earlier gang drops) is sound only because
+        a sequentially-committed pod's placement can depend solely on
+        earlier flat indices; under the speculative engine (multi-round
+        placement, any index order) an already-committed earlier gang
+        could have anchored its required affinity on a later gang's
+        dropped pods.  The Scheduler always builds _schedule_fn from
+        make_sequential_scheduler (the speculative engine lives in
+        _speculative_fn), and the assert below keeps a future engine swap
+        from silently breaking the all-or-nothing affinity guarantee."""
         from kubernetes_tpu.models.batched import (
             batch_has_pod_affinity,
             encode_batch_affinity,
@@ -56,6 +68,16 @@ class GangScheduler:
         )
 
         sched = self.scheduler
+        # fail CLOSED: an engine that doesn't declare its commit order
+        # (engine_kind unset) must be rejected too — defaulting it to
+        # "sequential" would wave through exactly the future engine swap
+        # this assert exists to catch
+        engine_kind = getattr(sched._schedule_fn, "engine_kind", None)
+        assert engine_kind == "sequential", (
+            "GangScheduler requires the sequential-commit engine; got "
+            f"{engine_kind!r} — the cross-gang required-affinity drop "
+            "guard is unsound under any other (or undeclared) commit order"
+        )
         enc = sched.cache.encoder
         with sched.cache._lock:
             # affinity state first: novel term topology keys must register

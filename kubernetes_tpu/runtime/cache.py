@@ -50,6 +50,19 @@ class SchedulerCache:
             self.encoder.add_pod(pod)
             self._assumed[key] = (pod, time.monotonic() + self.assume_ttl)
 
+    def assume_pods(self, pods) -> None:
+        """Batched AssumePod: one lock acquisition + one encoder delta
+        apply for a whole commit batch (the per-pod loop held/released the
+        lock and paid the numpy small-op overhead B times; the batched
+        encoder apply is state-equivalent — see encoder.add_pods)."""
+        if not pods:
+            return
+        with self._lock:
+            deadline = time.monotonic() + self.assume_ttl
+            self.encoder.add_pods(pods)
+            for pod in pods:
+                self._assumed[(pod.namespace, pod.name)] = (pod, deadline)
+
     def forget_pod(self, pod: Pod) -> None:
         """Roll back an assumed pod (cache.go ForgetPod)."""
         with self._lock:

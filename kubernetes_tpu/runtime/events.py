@@ -42,6 +42,23 @@ class EventRecorder:
         self._order: List[Tuple] = []
         self._max = max_events
 
+    def _record_locked(self, key: Tuple, now: float) -> Event:
+        """Aggregate-or-append one event; the caller holds self._lock.
+        `key` is (kind, namespace, name, type_, reason, msg) — the Event
+        constructor's field order."""
+        ev = self._by_key.get(key)
+        if ev is not None:
+            ev.count += 1
+            ev.last_timestamp = now
+            return ev
+        ev = Event(*key)
+        self._by_key[key] = ev
+        self._order.append(key)
+        while len(self._order) > self._max:
+            old = self._order.pop(0)
+            self._by_key.pop(old, None)
+        return ev
+
     def eventf(
         self,
         kind: str,
@@ -53,21 +70,21 @@ class EventRecorder:
         *args,
     ) -> Event:
         msg = message_fmt % args if args else message_fmt
-        key = (kind, namespace, name, type_, reason, msg)
+        with self._lock:
+            return self._record_locked(
+                (kind, namespace, name, type_, reason, msg), time.time()
+            )
+
+    def eventf_batch(self, entries) -> None:
+        """Record many pre-formatted events under ONE lock acquisition (the
+        batched commit path emits a whole cycle's audit trail at once).
+        entries: iterable of (kind, namespace, name, type_, reason, msg)
+        with msg already formatted.  Aggregation semantics identical to
+        per-event eventf calls in the same order."""
         now = time.time()
         with self._lock:
-            ev = self._by_key.get(key)
-            if ev is not None:
-                ev.count += 1
-                ev.last_timestamp = now
-                return ev
-            ev = Event(kind, namespace, name, type_, reason, msg)
-            self._by_key[key] = ev
-            self._order.append(key)
-            while len(self._order) > self._max:
-                old = self._order.pop(0)
-                self._by_key.pop(old, None)
-            return ev
+            for entry in entries:
+                self._record_locked(tuple(entry), now)
 
     def events(
         self,

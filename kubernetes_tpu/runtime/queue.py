@@ -163,6 +163,16 @@ class PriorityQueue:
                 self._unschedulable[key] = (pod, cycle, time.monotonic())
             self._lock.notify()
 
+    def add_unschedulable_batch(self, pods, cycle: int) -> None:
+        """add_unschedulable for a whole failed batch under ONE lock
+        acquisition (the batched commit path's loser requeue; the
+        Condition wraps an RLock, so the per-pod method re-enters)."""
+        if not pods:
+            return
+        with self._lock:
+            for pod in pods:
+                self.add_unschedulable(pod, cycle)
+
     def move_all_to_active(self) -> None:
         """Cluster event: flush unschedulableQ (MoveAllToActiveQueue,
         scheduling_queue.go:73; wired from eventhandlers.go:319-378)."""
@@ -193,6 +203,43 @@ class PriorityQueue:
         schedule_cycle calls in tests)."""
         with self._lock:
             return self._enqueued_at.pop(_pod_key(pod), None)
+
+    def take_enqueue_times(self, pods) -> List[Optional[float]]:
+        """take_enqueue_time for a whole bound batch, one lock acquisition.
+
+        The batched commit tail takes stamps BEFORE its bind fan-out: a
+        bind's informer echo (pod update -> queue.delete) would otherwise
+        race the take and drop the queue-wait from the e2e histogram."""
+        with self._lock:
+            return [self._enqueued_at.pop(_pod_key(p), None) for p in pods]
+
+    def restore_enqueue_time(self, pod, t: Optional[float]) -> None:
+        """Put back a stamp taken optimistically for a pod whose bind then
+        failed: the requeued pod's eventual e2e must still cover its whole
+        wait from FIRST enqueue (matching the per-pod loop, which only
+        consumes the stamp on a successful bind)."""
+        if t is None:
+            return
+        with self._lock:
+            self._enqueued_at[_pod_key(pod)] = t
+
+    def has_nominated(self) -> bool:
+        with self._lock:
+            return bool(self._nominated)
+
+    def has_schedulable(self) -> bool:
+        """Anything that can reach the active queue WITHOUT an external
+        cluster event: active entries, or backoff entries whose expiry the
+        flusher will promote.  Unschedulable-parked pods don't count (they
+        need move_all_to_active or the 60s leftover flush) — drain loops
+        use this to stop instead of spinning on a parked remainder."""
+        with self._lock:
+            return bool(self._active_entry or self._backoff_entry)
+
+    def delete_nominated_batch(self, pods) -> None:
+        with self._lock:
+            for pod in pods:
+                self._nominated.pop(_pod_key(pod), None)
 
     # ---- nominated pods (UpdateNominatedPodForNode / DeleteNominatedPodIfExists) ----
 

@@ -72,6 +72,22 @@ class SchedulerConfig:
     engine: str = "speculative"
     percentage_of_nodes_to_score: int = 100  # TPU path scans all; knob for parity
     disable_preemption: bool = False
+    # batched commit: apply a cycle's winners as ONE cache/encoder delta
+    # under a single lock acquisition, with batched event/metric emission,
+    # instead of the per-pod assume->bind loop.  State-equivalent to the
+    # per-pod loop (pinned by tests/test_batched_commit.py); automatically
+    # bypassed when a framework with plugins is attached (Reserve/Permit/
+    # Prebind are per-pod extension points).
+    batched_commit: bool = True
+    # pipelined commit: overlap batch k's host bind/event/requeue tail with
+    # batch k+1's device dispatch (double-buffered cycles).  Placement
+    # correctness is preserved because the STATE half of the commit
+    # (assume + encoder delta) still happens before batch k+1 encodes;
+    # only the side-effect tail (binds, events, metrics, preemption) runs
+    # while the device crunches the next batch.  Bind failures roll back
+    # via the standard optimistic ForgetPod + requeue, exactly like the
+    # reference's async bind goroutine (scheduler.go:523).
+    pipeline_commit: bool = False
     # multi-scheduler: only pods whose spec.schedulerName names THIS
     # scheduler enter its queue (eventhandlers.go responsibleForPod)
     scheduler_name: str = "default-scheduler"
@@ -94,6 +110,8 @@ class SchedulerConfig:
             weights=profile.weights_array(),
             filter_config=profile.filter_config,
             profile=profile,
+            batched_commit=getattr(cc, "batched_commit", True),
+            pipeline_commit=getattr(cc, "pipeline_commit", False),
         )
 
 
@@ -112,6 +130,38 @@ class ScheduleResult:
     pod: Pod
     node: Optional[str]          # None = unschedulable
     generation: int = 0
+
+
+@dataclass
+class _InFlight:
+    """One dispatched-but-unfetched cycle: the double-buffer slot of the
+    pipelined commit path (hosts_dev is still computing on device)."""
+
+    pods: List[Pod]
+    hosts_dev: object            # device i32[B], fetch blocks on compute
+    generation: int
+    cycle: int
+    ext_failed: Dict[int, str]
+    pc: object                   # shared PluginContext (framework cycles)
+    t_cycle0: float
+    trace: Trace
+
+
+@dataclass
+class _Staged:
+    """A fetched cycle whose cache-STATE half (batched assume) has been
+    applied; the side-effect tail (binds/events/metrics/preemption) is
+    still pending and may overlap the next batch's device dispatch."""
+
+    inf: _InFlight
+    hosts: np.ndarray
+    algo_dt: float
+    batched: bool
+    t_state0: float = 0.0
+    state_seconds: float = 0.0
+    # (batch index, pod, assumed copy, node name) per device winner
+    winners: List[Tuple] = field(default_factory=list)
+    fit_idx: List[int] = field(default_factory=list)
 
 
 class Scheduler:
@@ -193,6 +243,17 @@ class Scheduler:
         self.pdb_lister = pdb_lister or (lambda: [])
         self._last_index = 0
         self._stop = threading.Event()
+        # double-buffer slot for pipeline_commit: at most one dispatched
+        # batch whose host tail has not run yet
+        self._in_flight: Optional[_InFlight] = None
+        # per-phase host seconds, cumulative (bench live-path reporting):
+        # encode (host tensors + snapshot), dispatch (async enqueue),
+        # fetch (device compute + D2H sync), commit (assume + bind +
+        # events + requeues), preempt
+        self.phase_seconds: Dict[str, float] = {
+            "encode": 0.0, "dispatch": 0.0, "fetch": 0.0,
+            "commit": 0.0, "preempt": 0.0,
+        }
         self.results: List[ScheduleResult] = []
         # (preemptor key, node name, victim keys) per successful preemption
         self.preemptions: List[Tuple[Tuple[str, str], str, List[Tuple[str, str]]]] = []
@@ -201,9 +262,25 @@ class Scheduler:
 
     def schedule_cycle(self, pods: Sequence[Pod]) -> List[ScheduleResult]:
         """Place a batch of pods against the current cache state; assume+bind
-        winners, requeue losers.  Returns per-pod results."""
-        if not pods:
+        winners, requeue losers.  Returns per-pod results.
+
+        Internally split into encode/dispatch -> state-commit -> tail so
+        the pipelined run loop (config.pipeline_commit) can overlap batch
+        k's tail with batch k+1's device dispatch; called directly it is
+        strictly synchronous (any in-flight pipelined batch is drained
+        first so cycles never interleave)."""
+        self.flush_pipeline()
+        inf = self._encode_and_dispatch(pods)
+        if inf is None:
             return []
+        return self._commit_tail(self._commit_state(inf))
+
+    def _encode_and_dispatch(self, pods: Sequence[Pod]) -> Optional[_InFlight]:
+        """Encode the batch + snapshot under the cache lock, run the
+        extender/framework fan-out, and LAUNCH the engine.  Returns with
+        the device still computing (hosts_dev is an async handle)."""
+        if not pods:
+            return None
         t_cycle0 = time.monotonic()
         trace = Trace("schedule_cycle", pods=len(pods))
         enc = self.cache.encoder
@@ -230,6 +307,11 @@ class Scheduler:
             ]
             nominated = encode_nominated(enc, nominated_pairs)
             cluster, generation = self.cache.snapshot()
+            # rows the incremental snapshot refreshed: lets the device
+            # cache scatter-update just those rows instead of re-shipping
+            # whole tensors (codec/transfer.py); taken under the lock so
+            # the row set corresponds exactly to THIS snapshot
+            dirty_rows = enc.take_dirty_rows()
             # ports + anti-affinity contributions of nominated pods (the
             # non-resource half of podFitsOnNode's pass one) as a host
             # mask folded into extra_mask below
@@ -272,7 +354,8 @@ class Scheduler:
             for e in self.extenders
         ):
             extra_mask, extra_score, ext_failed = self._apply_extenders(
-                pods, node_row_map, cluster, extra_mask, extra_score
+                pods, node_row_map, cluster, extra_mask, extra_score,
+                n_rows=batch.n_pods,
             )
             trace.step("extenders")
         if nom_block is not None:
@@ -280,22 +363,112 @@ class Scheduler:
             extra_mask = (
                 ~nom_block if extra_mask is None else (extra_mask & ~nom_block)
             )
+        t_disp = time.monotonic()
+        self.phase_seconds["encode"] += t_disp - t_cycle0
         fn = self._schedule_fn
         if self._speculative_fn is not None:
             fn = self._speculative_fn
         hosts, _ = fn(
-            self._dev_snapshot.update(cluster), batch, ports,
+            self._dev_snapshot.update(cluster, dirty_rows=dirty_rows),
+            batch, ports,
             np.int32(self._last_index), nominated,
             extra_mask, extra_score, aff_state,
         )
-        hosts = np.asarray(hosts)
+        if hasattr(hosts, "copy_to_host_async"):
+            # start the D2H copy as soon as the device finishes; the
+            # jax.block_until_ready boundary is the np.asarray in
+            # _commit_state
+            hosts.copy_to_host_async()
         self._last_index += len(pods)
         trace.step("device")
+        self.phase_seconds["dispatch"] += time.monotonic() - t_disp
+        return _InFlight(
+            pods=list(pods), hosts_dev=hosts, generation=generation,
+            cycle=cycle, ext_failed=ext_failed, pc=pc, t_cycle0=t_cycle0,
+            trace=trace,
+        )
+
+    def _commit_state(self, inf: _InFlight) -> _Staged:
+        """Fetch the placements and apply the cache-STATE half of the
+        commit.  In batched mode (config.batched_commit, no framework) the
+        whole batch of winners is assumed as ONE encoder delta under a
+        single lock acquisition; the side-effect tail runs in
+        _commit_tail.  In per-pod mode this only fetches — the tail runs
+        the classic loop."""
+        pods = inf.pods
+        t_fetch0 = time.monotonic()
+        hosts = np.asarray(inf.hosts_dev)  # blocks: device compute + D2H
+        t_state0 = time.monotonic()
+        self.phase_seconds["fetch"] += t_state0 - t_fetch0
+        inf.trace.step("fetch")
         # algorithm latency: encode + device filter/score/select, amortized
         # per pod (metrics.go SchedulingAlgorithmLatency)
-        algo_dt = (time.monotonic() - t_cycle0) / len(pods)
-        for _ in pods:
-            m.ALGO_LATENCY.observe(algo_dt)
+        algo_dt = (time.monotonic() - inf.t_cycle0) / len(pods)
+        m.ALGO_LATENCY.observe_n(algo_dt, len(pods))
+        batched = self.config.batched_commit and self.framework is None
+        staged = _Staged(
+            inf=inf, hosts=hosts, algo_dt=algo_dt, batched=batched,
+            t_state0=t_state0,
+        )
+        if not batched:
+            return staged
+        import copy
+
+        enc = self.cache.encoder
+        winners = staged.winners
+        for i, pod in enumerate(pods):
+            if i in inf.ext_failed:
+                continue
+            row = int(hosts[i])
+            if row < 0:
+                staged.fit_idx.append(i)
+                continue
+            node_name = enc.row_name(row)
+            # shallow-copy + set beats two dataclasses.replace calls ~2x
+            # at 10k commits/s (Pod/PodSpec are plain mutable dataclasses)
+            spec = copy.copy(pod.spec)
+            spec.node_name = node_name
+            assumed = copy.copy(pod)
+            assumed.spec = spec
+            winners.append((i, pod, assumed, node_name))
+        # ONE lock acquisition + one encoder delta for the whole batch
+        self.cache.assume_pods([a for _, _, a, _ in winners])
+        staged.state_seconds = time.monotonic() - t_state0
+        return staged
+
+    def _commit_tail(self, staged: _Staged) -> List[ScheduleResult]:
+        """Side-effect tail of a cycle: binds, events, metrics, requeues,
+        preemption.  Under pipeline_commit this overlaps the next batch's
+        device dispatch (the state half already ran, so the next snapshot
+        is exact)."""
+        inf = staged.inf
+        pods = inf.pods
+        if staged.batched:
+            results, fit_errors = self._tail_batched(staged)
+        else:
+            results, fit_errors = self._tail_perpod(staged)
+        inf.trace.step("commit")
+        if not self.config.disable_preemption:
+            t_p = time.monotonic()
+            for pod in fit_errors:
+                self.preempt(pod)
+            inf.trace.step("preempt")
+            self.phase_seconds["preempt"] += time.monotonic() - t_p
+        inf.trace.log_if_long(0.1)
+        m.PENDING_PODS.set(float(len(self.queue)))
+        self.results.extend(results)
+        return results
+
+    def _tail_perpod(self, staged: _Staged):
+        """The classic per-pod commit loop (framework cycles, or
+        config.batched_commit=False): reserve/assume/bind one pod at a
+        time, emitting events and metrics inline."""
+        inf = staged.inf
+        pods, hosts = inf.pods, staged.hosts
+        generation, cycle, pc = inf.generation, inf.cycle, inf.pc
+        ext_failed, algo_dt = inf.ext_failed, staged.algo_dt
+        t_commit0 = time.monotonic()
+        enc = self.cache.encoder
         results = []
         fit_errors: List[Pod] = []
         for i, pod in enumerate(pods):
@@ -350,19 +523,125 @@ class Scheduler:
                     self._record_scheduled(
                         pod, node_name, algo_dt + (time.monotonic() - t_pod)
                     )
-        trace.step("commit")
-        if not self.config.disable_preemption:
-            for pod in fit_errors:
-                self.preempt(pod)
-            trace.step("preempt")
-        trace.log_if_long(0.1)
-        m.PENDING_PODS.set(float(len(self.queue)))
-        self.results.extend(results)
-        return results
+        self.phase_seconds["commit"] += time.monotonic() - t_commit0
+        return results, fit_errors
+
+    def _tail_batched(self, staged: _Staged):
+        """Batched side-effect tail: per-pod bind callbacks (the only
+        irreducibly per-pod step — each is an external call), then ONE
+        batched emission each for requeues, metrics histograms, counters,
+        and events, all in batch-index order so the audit trail matches
+        the per-pod loop exactly."""
+        inf = staged.inf
+        pods, hosts = inf.pods, staged.hosts
+        generation, cycle = inf.generation, inf.cycle
+        t_tail0 = time.monotonic()
+        B = len(pods)
+        results: List[Optional[ScheduleResult]] = [None] * B
+        events: List[Optional[Tuple]] = [None] * B
+        n_nodes = len(self.cache.encoder.node_rows)
+        losers: List[Pod] = []
+        for i in staged.fit_idx:
+            pod = pods[i]
+            results[i] = ScheduleResult(pod, None, generation)
+            losers.append(pod)
+            events[i] = (
+                "Pod", pod.namespace, pod.name,
+                EVENT_TYPE_WARNING, "FailedScheduling",
+                "0/%d nodes are available" % n_nodes,
+            )
+        for i, msg in inf.ext_failed.items():
+            pod = pods[i]
+            results[i] = ScheduleResult(pod, None, generation)
+            losers.append(pod)
+            events[i] = (
+                "Pod", pod.namespace, pod.name,
+                EVENT_TYPE_WARNING, "FailedScheduling",
+                "extender error: %s" % msg,
+            )
+        # enqueue stamps BEFORE the bind fan-out: a bind's informer echo
+        # (bound-pod update -> queue.delete) races a later take and would
+        # drop the queue wait from the e2e histogram; failed binds restore
+        # their stamp below so a requeued pod keeps its first-enqueue time
+        winner_qts = self.queue.take_enqueue_times(
+            [pod for _, pod, _, _ in staged.winners]
+        )
+        # bind fan-out: one _invoke_binder call per winner (each is an
+        # external call — the only irreducibly per-pod step)
+        bind_dts: List[float] = []
+        bound: List[Tuple[int, Pod, str]] = []
+        bound_qts: List[Optional[float]] = []
+        n_bind_failed = 0
+        for w, (i, pod, assumed, node_name) in enumerate(staged.winners):
+            t0b = time.monotonic()
+            ok = self._invoke_binder(pod, assumed, node_name)
+            bind_dts.append(time.monotonic() - t0b)
+            if ok:
+                bound.append((i, pod, node_name))
+                bound_qts.append(winner_qts[w])
+                results[i] = ScheduleResult(pod, node_name, generation)
+                events[i] = (
+                    "Pod", pod.namespace, pod.name,
+                    EVENT_TYPE_NORMAL, "Scheduled",
+                    "Successfully assigned %s/%s to %s"
+                    % (pod.namespace, pod.name, node_name),
+                )
+            else:
+                # optimistic rollback: ForgetPod + requeue, exactly the
+                # per-pod _reject_assumed path (scheduler.go:416-426)
+                self.cache.forget_pod(assumed)
+                self.queue.restore_enqueue_time(pod, winner_qts[w])
+                n_bind_failed += 1
+                losers.append(pod)
+                results[i] = ScheduleResult(pod, None, generation)
+                events[i] = (
+                    "Pod", pod.namespace, pod.name,
+                    EVENT_TYPE_WARNING, "FailedScheduling",
+                    self._BIND_REJECT_MSG
+                    % (pod.namespace, pod.name, node_name),
+                )
+        # batched bookkeeping: one lock acquisition per structure
+        self.queue.add_unschedulable_batch(losers, cycle)
+        if bound and self.queue.has_nominated():
+            self.queue.delete_nominated_batch([p for _, p, _ in bound])
+        m.BINDING_LATENCY.observe_batch(bind_dts)
+        if staged.fit_idx:
+            m.SCHEDULE_ATTEMPTS.inc(len(staged.fit_idx), result=m.UNSCHEDULABLE)
+        if inf.ext_failed or n_bind_failed:
+            m.SCHEDULE_ATTEMPTS.inc(
+                len(inf.ext_failed) + n_bind_failed, result=m.SCHEDULE_ERROR
+            )
+        if bound:
+            m.SCHEDULE_ATTEMPTS.inc(len(bound), result=m.SCHEDULED)
+            now = time.monotonic()
+            fallback = staged.algo_dt + (now - staged.t_state0)
+            m.E2E_LATENCY.observe_batch(
+                [now - qt if qt is not None else fallback
+                 for qt in bound_qts]
+            )
+            if klog.V(2).enabled:
+                for (_, pod, node_name), qt in zip(bound, bound_qts):
+                    e2e = now - qt if qt is not None else fallback
+                    klog.V(2).infof(
+                        "scheduled %s/%s to %s (%.1fms e2e)",
+                        pod.namespace, pod.name, node_name, e2e * 1000,
+                    )
+        entries = [e for e in events if e is not None]
+        eventf_batch = getattr(self.recorder, "eventf_batch", None)
+        if eventf_batch is not None:
+            eventf_batch(entries)
+        else:  # duck-typed recorder without the batch entry point
+            for kind, ns, name, type_, reason, msg in entries:
+                self.recorder.eventf(kind, ns, name, type_, reason, "%s", msg)
+        self.phase_seconds["commit"] += (
+            staged.state_seconds + time.monotonic() - t_tail0
+        )
+        return list(results), [pods[i] for i in staged.fit_idx]
 
     # --------------------------------------------------------- extenders
 
-    def _apply_extenders(self, pods, rows, cluster, extra_mask, extra_score):
+    def _apply_extenders(self, pods, rows, cluster, extra_mask, extra_score,
+                         n_rows=None):
         """Chain the configured HTTP extenders per pod: each filter
         round-trip intersects the feasibility mask (an extender can only
         veto, never resurrect — generic_scheduler.go:527-554), prioritize
@@ -379,13 +658,18 @@ class Scheduler:
 
         from kubernetes_tpu.extender.client import ExtenderError
 
+        # mask/score are allocated at the ENGINE batch width (n_rows =
+        # batch.n_pods, a pow2 pad >= len(pods)); the pad-row tail stays
+        # all-true/zero and pods.valid masks it on device.  Allocating at
+        # len(pods) broke any non-pow2 batch with extenders configured.
         B, N = len(pods), cluster.n_nodes
+        Bp = n_rows if n_rows is not None else B
         mask = (
-            np.ones((B, N), bool)
+            np.ones((Bp, N), bool)
             if extra_mask is None else np.array(extra_mask, bool)
         )
         score = (
-            np.zeros((B, N), np.float32)
+            np.zeros((Bp, N), np.float32)
             if extra_score is None else np.array(extra_score, np.float32)
         )
         failed: Dict[int, str] = {}
@@ -496,18 +780,15 @@ class Scheduler:
         ok = self._prebind_and_bind(fwk, pc, pod, assumed, node_name, cycle)
         return "bound" if ok else "failed"
 
-    def _prebind_and_bind(self, fwk, pc, pod, assumed, node_name, cycle) -> bool:
-        if fwk is not None and fwk.prebind_plugins:
-            st = fwk.run_prebind_plugins(pc, assumed, node_name)
-            if not st.is_success():
-                self._reject_assumed(
-                    fwk, pc, pod, assumed, node_name, cycle, st.message
-                )
-                return False
-        ok = False
-        t0 = time.monotonic()
-        # a bind-verb extender binds pods it manages in place of the default
-        # binder (extender.go:360-387; scheduler.go bind path)
+    # single source of truth for the bind-rejection audit message (the
+    # batched/per-pod equivalence test compares event text verbatim)
+    _BIND_REJECT_MSG = "Binding rejected for %s/%s on %s"
+
+    def _invoke_binder(self, pod, assumed, node_name) -> bool:
+        """The actual bind call, shared by the per-pod and batched commit
+        paths: a bind-verb extender binds pods it manages in place of the
+        default binder (extender.go:360-387; scheduler.go bind path); any
+        exception counts as a rejection."""
         binder_ext = next(
             (e for e in self.extenders
              if e.is_binder and e.is_interested(pod)),
@@ -518,16 +799,27 @@ class Scheduler:
                 binder_ext.bind(
                     pod.namespace, pod.name, pod.metadata.uid, node_name
                 )
-                ok = True
-            else:
-                ok = self.binder(assumed, node_name)
+                return True
+            return bool(self.binder(assumed, node_name))
         except Exception:
-            ok = False
+            return False
+
+    def _prebind_and_bind(self, fwk, pc, pod, assumed, node_name, cycle) -> bool:
+        if fwk is not None and fwk.prebind_plugins:
+            st = fwk.run_prebind_plugins(pc, assumed, node_name)
+            if not st.is_success():
+                self._reject_assumed(
+                    fwk, pc, pod, assumed, node_name, cycle, st.message
+                )
+                return False
+        t0 = time.monotonic()
+        ok = self._invoke_binder(pod, assumed, node_name)
         m.BINDING_LATENCY.observe(time.monotonic() - t0)
         if not ok:
             self._reject_assumed(
                 fwk, pc, pod, assumed, node_name, cycle,
-                f"Binding rejected for {pod.namespace}/{pod.name} on {node_name}",
+                self._BIND_REJECT_MSG
+                % (pod.namespace, pod.name, node_name),
             )
             return False
         return True
@@ -596,6 +888,7 @@ class Scheduler:
                 return None
             batch = enc.encode_pods([pod])
             cluster, _ = self.cache.snapshot()
+            dirty_rows = enc.take_dirty_rows()
         # device work OUTSIDE the cache lock: a first-shape preempt pays a
         # multi-second XLA compile, and informer/event threads must not
         # stall on the lock for it.  The snapshot is a point-in-time copy;
@@ -606,7 +899,17 @@ class Scheduler:
         # right after a failed cycle (snapshot mostly byte-identical), and
         # host-numpy jit ARGUMENTS cross the tunnel on the slow
         # synchronous path (codec/transfer.py).
-        cluster = self._dev_snapshot.update(cluster)
+        #
+        # SINGLE-SCHEDULING-THREAD INVARIANT: _dev_snapshot (and the
+        # encoder's take_dirty_rows stream feeding it) is the same mutable
+        # DeviceSnapshotCache schedule_cycle uses, mutated here OUTSIDE
+        # cache._lock.  This is safe only because preempt is invoked solely
+        # from the scheduling thread's commit tail (_commit_tail) — the
+        # pipelined commit path keeps every _dev_snapshot.update on that
+        # one thread, interleaved never concurrent.  If preempt ever
+        # becomes callable from another thread, give preemption its own
+        # DeviceSnapshotCache (and its own dirty-row take stream).
+        cluster = self._dev_snapshot.update(cluster, dirty_rows=dirty_rows)
         if jax.default_backend() != "cpu":
             batch = jax.device_put(batch)
         cands = np.asarray(self._preempt_eval(cluster, batch))[0].copy()
@@ -769,12 +1072,63 @@ class Scheduler:
     POD_GROUP_LABEL = "pod-group.scheduling.sigs.k8s.io/name"
     POD_GROUP_MIN_MEMBER = "pod-group.scheduling.sigs.k8s.io/min-available"
 
+    @property
+    def pipeline_pending(self) -> bool:
+        """True while a dispatched batch awaits its commit tail (the
+        public liveness predicate for drain loops)."""
+        return self._in_flight is not None
+
+    def flush_pipeline(self) -> int:
+        """Drain the double-buffer slot: fetch + commit any in-flight
+        pipelined batch.  No-op when nothing is in flight.  Returns the
+        number of pods placed from the drained batch."""
+        inf, self._in_flight = self._in_flight, None
+        if inf is None:
+            return 0
+        results = self._commit_tail(self._commit_state(inf))
+        return sum(1 for r in results if r.node is not None)
+
+    def _run_pipelined(self, pods: Sequence[Pod]) -> int:
+        """Double-buffered cycle: apply the in-flight batch's STATE half
+        (fetch + batched assume — the part the next snapshot must see),
+        dispatch the new batch, then run the previous batch's side-effect
+        tail while the device computes.  Device idle time shrinks to the
+        fetch->dispatch gap (assume + encode), and the per-pod Python tail
+        (binds, events, metrics, preemption) hides behind device compute."""
+        prev, self._in_flight = self._in_flight, None
+        staged = self._commit_state(prev) if prev is not None else None
+        n = 0
+        try:
+            self._in_flight = self._encode_and_dispatch(pods)
+        finally:
+            # batch k's tail MUST run even if batch k+1's dispatch raises:
+            # its losers were already popped from the queue (the requeue
+            # happens in the tail) and its winners sit assumed-but-unbound
+            if staged is not None:
+                results = self._commit_tail(staged)
+                n = sum(1 for r in results if r.node is not None)
+        return n
+
     def run_once(self, timeout: float = 0.1) -> int:
         """Pop one cycle's batch and schedule it; returns the number of
-        pods PLACED (both the gang and plain paths count placements)."""
+        pods PLACED (both the gang and plain paths count placements).
+
+        With config.pipeline_commit, plain batches double-buffer: the call
+        dispatches this batch and returns the PREVIOUS batch's placements
+        (flush_pipeline drains the last one); gang cycles and empty polls
+        drain the pipeline first so snapshots never go stale."""
         pods = self.queue.pop_batch(
-            self.config.batch_size, timeout, self.config.batch_window_s
+            self.config.batch_size,
+            # with a batch in flight, don't block in the pop: its binds/
+            # events/requeues must not wait out the poll timeout when the
+            # queue momentarily empties (trickle arrival, burst tails)
+            0.0 if self.pipeline_pending else timeout,
+            self.config.batch_window_s,
         )
+        if not pods:
+            # idle poll: drain any in-flight batch so binds/events/requeues
+            # don't wait for the next arrival
+            return self.flush_pipeline()
         # gang-eligibility is conservative: extenders and framework
         # plugins enforce verdicts the gang launch cannot consult, and an
         # outstanding preemption nomination must not be absorbed by a
@@ -798,7 +1152,10 @@ class Scheduler:
         n = 0
         if grouped:
             # gangs first: they were popped in priority order and the
-            # plain sub-cycle must not strip capacity from them
+            # plain sub-cycle must not strip capacity from them.  Gang
+            # launches snapshot the cache directly, so any in-flight
+            # pipelined batch must land its state first
+            n += self.flush_pipeline()
             from kubernetes_tpu.models.gang import GangScheduler, PodGroup
 
             cycle = self.queue.scheduling_cycle
@@ -847,15 +1204,23 @@ class Scheduler:
                         p, node, time.monotonic() - t_cycle
                     )
         if plain:
-            n += sum(
-                1 for r in self.schedule_cycle(plain) if r.node is not None
-            )
+            if (
+                self.config.pipeline_commit
+                and self.config.batched_commit
+                and self.framework is None
+            ):
+                n += self._run_pipelined(plain)
+            else:
+                n += sum(
+                    1 for r in self.schedule_cycle(plain) if r.node is not None
+                )
         return n
 
     def run(self) -> None:
         """wait.Until(scheduleOne) analog (scheduler.go:250-256)."""
         while not self._stop.is_set():
             self.run_once(timeout=0.5)
+        self.flush_pipeline()
 
     def stop(self) -> None:
         self._stop.set()

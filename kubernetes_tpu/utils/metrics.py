@@ -33,6 +33,28 @@ class Histogram:
             self.sum += v
             self.total += 1
 
+    def observe_n(self, v: float, n: int) -> None:
+        """n observations of the same value under one lock acquisition
+        (the batched commit path's per-pod amortized latencies)."""
+        if n <= 0:
+            return
+        with self._lock:
+            i = bisect.bisect_left(self.buckets, v)
+            self.counts[i] += n
+            self.sum += v * n
+            self.total += n
+
+    def observe_batch(self, values) -> None:
+        """Many distinct observations under one lock acquisition."""
+        if not values:
+            return
+        with self._lock:
+            for v in values:
+                i = bisect.bisect_left(self.buckets, v)
+                self.counts[i] += 1
+                self.sum += v
+            self.total += len(values)
+
     def quantile(self, q: float) -> float:
         """Approximate quantile from bucket boundaries (upper bound)."""
         with self._lock:

@@ -1,0 +1,446 @@
+"""Batched/pipelined commit path: state equivalence vs the per-pod loop,
+and incremental (dirty-row) snapshot correctness.
+
+The acceptance bar for the batched commit rebuild (ISSUE 1): batched
+commit produces byte-identical cache/encoder state and identical emitted
+events vs the per-pod loop on a mixed success/FitError/extender-error
+batch, and the dirty-row incremental re-encode matches a full re-encode
+after adds/deletes/updates.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.codec.transfer import DeviceSnapshotCache
+from kubernetes_tpu.extender.client import ExtenderError
+from kubernetes_tpu.runtime import (
+    PriorityQueue,
+    Scheduler,
+    SchedulerCache,
+    SchedulerConfig,
+)
+
+from fixtures import TEST_DIMS, ZONE_KEY, make_node, make_pod
+
+
+def snapshots_equal(a, b, msg=""):
+    for f in dataclasses.fields(a):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f.name)), np.asarray(getattr(b, f.name)),
+            err_msg=f"{msg}field {f.name}",
+        )
+
+
+def encoder_state_equal(e1, e2, same_slots=True):
+    """Byte-identical snapshot tensors + equivalent pod bookkeeping.
+
+    same_slots=False relaxes the pod-ARENA slot ids (m): when a bind fails
+    mid-batch, the per-pod loop frees the slot before later pods assume
+    (they reuse it) while the batched path assumes the whole batch first —
+    a pure permutation of an internal index; each pod's own arena row must
+    still carry identical content either way."""
+    snapshots_equal(e1.snapshot(full=True), e2.snapshot(full=True))
+    assert set(e1.pods) == set(e2.pods)
+    for key, r1 in e1.pods.items():
+        r2 = e2.pods[key]
+        if same_slots:
+            assert r1.m == r2.m, key
+        assert (r1.node_row, r1.priority) == (r2.node_row, r2.priority)
+        np.testing.assert_array_equal(r1.req, r2.req)
+        np.testing.assert_array_equal(r1.nonzero, r2.nonzero)
+        for enc, rec in ((e1, r1), (e2, r2)):
+            assert bool(enc.p_alive[rec.m])
+            assert enc.p_node[rec.m] == rec.node_row
+            assert enc.p_ns[rec.m] == enc.interner.lookup(rec.key[0])
+    assert e1.generation == e2.generation
+
+
+# ---------------------------------------------------------- encoder batch
+
+
+def _mixed_pods(n=10):
+    pods = []
+    for i in range(n):
+        kw = dict(
+            cpu=f"{100 + 10 * (i % 3)}m", mem="128Mi",
+            labels={"app": f"dep-{i % 3}", "idx": str(i)},
+            node_name=f"n{i % 4}",
+        )
+        if i % 4 == 0:
+            kw["ports"] = [{"hostPort": 8000 + i, "protocol": "TCP"}]
+        if i % 5 == 0:
+            kw["volumes"] = [
+                {"gcePersistentDisk": {"pdName": f"pd-{i % 2}"}}
+            ]
+        if i == 3:
+            # affinity term with NOVEL strings: interner id assignment
+            # must follow add_pod's per-pod order (labels then terms per
+            # pod) or every interned-id tensor diverges afterwards
+            kw["affinity"] = {"podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "labelSelector": {
+                        "matchLabels": {"novel-sel-key": "novel-sel-val"}},
+                    "topologyKey": "novel.example.com/topo",
+                }]}}
+        if i == 7:
+            kw["node_name"] = "absent-node"  # unassigned row (-1)
+        pods.append(make_pod(f"p{i}", **kw))
+    return pods
+
+
+def test_add_pods_matches_sequential_add_pod():
+    encs = [SnapshotEncoder(TEST_DIMS), SnapshotEncoder(TEST_DIMS)]
+    for enc in encs:
+        for i in range(4):
+            enc.add_node(make_node(
+                f"n{i}", cpu="8", mem="16Gi",
+                labels={ZONE_KEY: f"z-{i % 2}"},
+            ))
+        enc.add_spread_selector("default", {"app": "dep-0"})
+    pods = _mixed_pods()
+    for p in pods:
+        encs[0].add_pod(p)
+    encs[1].add_pods(pods)
+    encoder_state_equal(encs[0], encs[1])
+    # the interner vocabularies (and therefore every id-bearing tensor,
+    # not just the ones compared above) assigned ids in the same order
+    assert len(encs[0].interner) == len(encs[1].interner)
+    assert encs[0].interner.lookup("novel-sel-val") == \
+        encs[1].interner.lookup("novel-sel-val")
+
+
+def test_add_pods_duplicate_keys_in_one_batch():
+    """Degenerate but legal: the same pod key twice in one batch.  The
+    per-pod loop replaces the earlier record; the batched path must not
+    leak a ghost arena slot double-charging the node."""
+    encs = [SnapshotEncoder(TEST_DIMS), SnapshotEncoder(TEST_DIMS)]
+    for enc in encs:
+        enc.add_node(make_node("n0", cpu="8", mem="16Gi"))
+    dup_a = make_pod("dup", cpu="100m", mem="64Mi", node_name="n0")
+    dup_b = make_pod("dup", cpu="300m", mem="256Mi", node_name="n0")
+    other = make_pod("other", cpu="50m", mem="32Mi", node_name="n0")
+    for p in (dup_a, other, dup_b):
+        encs[0].add_pod(p)
+    encs[1].add_pods([dup_a, other, dup_b])
+    encoder_state_equal(encs[0], encs[1])
+    row = encs[1].node_rows["n0"]
+    assert encs[1].a_requested[row, 0] == 350.0  # 300 + 50, not 450
+
+
+def test_add_pods_replaces_existing_records():
+    encs = [SnapshotEncoder(TEST_DIMS), SnapshotEncoder(TEST_DIMS)]
+    for enc in encs:
+        enc.add_node(make_node("n0", cpu="8", mem="16Gi"))
+        enc.add_pod(make_pod("dup", cpu="100m", mem="64Mi", node_name="n0"))
+    updated = make_pod("dup", cpu="300m", mem="256Mi", node_name="n0")
+    encs[0].add_pod(updated)
+    encs[1].add_pods([updated])
+    encoder_state_equal(encs[0], encs[1])
+
+
+# ------------------------------------------------------- commit equivalence
+
+
+class _FailingExtender:
+    """Minimal extender double: non-ignorable filter error for one pod."""
+
+    class _Cfg:
+        filter_verb = "filter"
+        prioritize_verb = ""
+        bind_verb = ""
+
+    config = _Cfg()
+    is_ignorable = False
+    is_binder = False
+    supports_preemption = False
+
+    def __init__(self, fail_name):
+        self.fail_name = fail_name
+
+    def is_interested(self, pod):
+        return pod.name == self.fail_name
+
+    def filter(self, pod, names):
+        raise ExtenderError("extender down")
+
+
+def _mk_scheduler(batched, pipeline=False, with_extender=True):
+    cache = SchedulerCache(SnapshotEncoder(TEST_DIMS))
+    for i in range(6):
+        cache.add_node(make_node(
+            f"n{i}", cpu="4", mem="8Gi", pods=20,
+            labels={ZONE_KEY: f"z-{i % 2}"},
+        ))
+    queue = PriorityQueue()
+    binder = lambda pod, node: pod.name != "bind-fail"  # noqa: E731
+    sched = Scheduler(
+        cache=cache,
+        queue=queue,
+        binder=binder,
+        config=SchedulerConfig(
+            batch_size=16, engine="sequential", disable_preemption=True,
+            batched_commit=batched, pipeline_commit=pipeline,
+        ),
+        extenders=[_FailingExtender("ext-fail")] if with_extender else None,
+    )
+    return sched
+
+
+def _commit_batch_pods():
+    pods = [make_pod(f"ok-{i}", cpu="200m", mem="256Mi",
+                     labels={"app": "a"}) for i in range(6)]
+    # FitError: nothing can hold 64 cpus
+    pods.append(make_pod("fit-fail", cpu="64", mem="128Gi"))
+    # non-ignorable extender error
+    pods.append(make_pod("ext-fail", cpu="100m", mem="64Mi"))
+    # assumed then rejected by the binder (optimistic rollback)
+    pods.append(make_pod("bind-fail", cpu="100m", mem="64Mi"))
+    pods.append(make_pod("ok-last", cpu="100m", mem="64Mi"))
+    return pods
+
+
+def _event_tuples(recorder):
+    return [
+        (e.kind, e.namespace, e.name, e.type, e.reason, e.message, e.count)
+        for e in recorder.events()
+    ]
+
+
+def _queue_state(q):
+    return (
+        sorted(q._unschedulable),
+        sorted(q._active_entry),
+        sorted(q._backoff_entry),
+    )
+
+
+def test_batched_commit_state_equivalent_to_perpod_loop():
+    """Mixed success / FitError / extender-error / bind-failure batch: the
+    batched commit path must leave byte-identical encoder state, identical
+    events (order included), identical results and queue state."""
+    s_batched = _mk_scheduler(batched=True)
+    s_perpod = _mk_scheduler(batched=False)
+    pods = _commit_batch_pods()
+    r1 = s_batched.schedule_cycle(list(pods))
+    r2 = s_perpod.schedule_cycle(list(pods))
+
+    assert [(r.pod.name, r.node) for r in r1] == [
+        (r.pod.name, r.node) for r in r2
+    ]
+    # the batch really was mixed
+    by_name = {r.pod.name: r.node for r in r1}
+    assert by_name["fit-fail"] is None
+    assert by_name["ext-fail"] is None
+    assert by_name["bind-fail"] is None
+    assert by_name["ok-0"] is not None and by_name["ok-last"] is not None
+
+    encoder_state_equal(
+        s_batched.cache.encoder, s_perpod.cache.encoder, same_slots=False
+    )
+    assert set(s_batched.cache._assumed) == set(s_perpod.cache._assumed)
+    assert _event_tuples(s_batched.recorder) == _event_tuples(s_perpod.recorder)
+    assert _queue_state(s_batched.queue) == _queue_state(s_perpod.queue)
+
+
+def test_pipelined_commit_matches_sync_run():
+    """Double-buffered cycles must converge to the same cache state and
+    placement set as strictly synchronous cycles."""
+    s_pipe = _mk_scheduler(batched=True, pipeline=True, with_extender=False)
+    s_sync = _mk_scheduler(batched=True, pipeline=False, with_extender=False)
+    waves = [
+        [make_pod(f"w{w}-p{i}", cpu="150m", mem="128Mi",
+                  labels={"app": f"dep-{w}"})
+         for i in range(5)]
+        for w in range(4)
+    ]
+    for s in (s_pipe, s_sync):
+        placed = 0
+        for wave in waves:
+            for p in wave:
+                s.queue.add(p)
+            placed += s.run_once(timeout=0.05)
+        placed += s.flush_pipeline()
+        assert placed == 20
+    assert s_pipe._in_flight is None
+    encoder_state_equal(s_pipe.cache.encoder, s_sync.cache.encoder)
+    got_pipe = {(r.pod.name, r.node) for r in s_pipe.results}
+    got_sync = {(r.pod.name, r.node) for r in s_sync.results}
+    assert got_pipe == got_sync
+
+
+def test_batched_commit_e2e_survives_bind_echo_delete():
+    """A bind's informer echo deletes the bound pod from the queue —
+    consuming its enqueue stamp.  The batched tail must take stamps BEFORE
+    the bind fan-out, or the e2e histogram silently loses the queue wait.
+    The binder here deletes synchronously: the worst-case echo timing."""
+    import time
+
+    from kubernetes_tpu.utils import metrics as m
+
+    cache = SchedulerCache(SnapshotEncoder(TEST_DIMS))
+    cache.add_node(make_node("n0", cpu="4", mem="8Gi"))
+    queue = PriorityQueue()
+    sched = Scheduler(
+        cache=cache, queue=queue,
+        binder=lambda pod, node: queue.delete(pod) or True,
+        config=SchedulerConfig(
+            batch_size=4, engine="sequential", disable_preemption=True,
+        ),
+    )
+    fresh = m.Histogram("test_e2e_batched", "")
+    orig = m.E2E_LATENCY
+    m.E2E_LATENCY = fresh
+    try:
+        queue.add(make_pod("echoed", cpu="100m", mem="64Mi"))
+        time.sleep(0.03)
+        assert sched.run_once(timeout=0.2) == 1
+    finally:
+        m.E2E_LATENCY = orig
+    assert fresh.total == 1
+    assert fresh.sum >= 0.03  # queue wait included despite the echo delete
+
+
+# ------------------------------------------------------ incremental encode
+
+
+def test_incremental_snapshot_matches_full_reencode():
+    """Dirty-row re-encode == full re-encode across adds/deletes/updates of
+    both nodes and pods, with snapshots interleaved so the cow path (not
+    the full-rebuild path) is what's being exercised."""
+    enc = SnapshotEncoder(TEST_DIMS)
+    for i in range(6):
+        enc.add_node(make_node(
+            f"n{i}", cpu="8", mem="16Gi",
+            labels={ZONE_KEY: f"z-{i % 3}"},
+        ))
+    enc.add_spread_selector("default", {"app": "a"})
+
+    def check(msg):
+        inc = enc.snapshot()
+        full = enc.snapshot(full=True)
+        snapshots_equal(inc, full, msg=msg + ": ")
+
+    check("initial")
+    # pod adds (single + batched)
+    enc.add_pod(make_pod("p0", cpu="100m", mem="64Mi",
+                         labels={"app": "a"}, node_name="n0"))
+    check("pod add")
+    enc.add_pods([
+        make_pod(f"p{i}", cpu="200m", mem="128Mi", labels={"app": "a"},
+                 node_name=f"n{i % 3}",
+                 ports=[{"hostPort": 9000 + i, "protocol": "TCP"}])
+        for i in range(1, 5)
+    ])
+    check("batched pod add")
+    # node label update (topology move)
+    enc.update_node(make_node("n1", cpu="8", mem="16Gi",
+                              labels={ZONE_KEY: "z-9"}))
+    check("node update")
+    # pod delete
+    enc.remove_pod(make_pod("p2", node_name="n2"))
+    check("pod delete")
+    # node delete (detaches resident pods) + row-reusing re-add
+    enc.remove_node("n0")
+    check("node delete")
+    enc.add_node(make_node("n6", cpu="2", mem="4Gi",
+                           labels={ZONE_KEY: "z-1"}))
+    check("row reuse")
+    # unchanged state: incremental returns shared (identity) leaves
+    s1 = enc.snapshot()
+    s2 = enc.snapshot()
+    assert s2.label_keys is s1.label_keys
+    assert s2.requested is s1.requested
+
+
+def test_incremental_snapshot_unchanged_fields_share_identity():
+    enc = SnapshotEncoder(TEST_DIMS)
+    for i in range(4):
+        enc.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    s1 = enc.snapshot()
+    enc.add_pod(make_pod("p", cpu="100m", mem="64Mi", node_name="n1"))
+    s2 = enc.snapshot()
+    # pod commits touch only the aggregate fields
+    assert s2.label_keys is s1.label_keys
+    assert s2.taint_key is s1.taint_key
+    assert s2.topo_pairs is s1.topo_pairs
+    assert s2.requested is not s1.requested
+    row = enc.node_rows["n1"]
+    assert s2.requested[row, 0] == 100.0
+    assert s1.requested[row, 0] == 0.0  # old snapshot untouched (cow)
+
+
+def test_device_snapshot_cache_dirty_row_scatter():
+    """update(cluster, dirty_rows=...) must leave device contents equal to
+    a fresh full upload through adds/commits/updates/removes."""
+    enc = SnapshotEncoder(TEST_DIMS)
+    for i in range(8):
+        enc.add_node(make_node(f"n{i}", cpu="4", mem="8Gi",
+                               labels={ZONE_KEY: f"z-{i % 2}"}))
+    cache = DeviceSnapshotCache()
+
+    def sync_and_check(msg):
+        snap = enc.snapshot()
+        dirty = enc.take_dirty_rows()
+        dev = cache.update(snap, dirty_rows=dirty)
+        full = enc.snapshot(full=True)
+        for f in dataclasses.fields(full):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(dev, f.name)),
+                np.asarray(getattr(full, f.name)),
+                err_msg=f"{msg}: field {f.name}",
+            )
+
+    sync_and_check("initial (full upload)")
+    # a small commit: exactly the scatter-eligible shape (1 row of 8)
+    enc.add_pod(make_pod("p0", cpu="500m", mem="512Mi", node_name="n3"))
+    sync_and_check("single-row commit")
+    enc.add_pods([
+        make_pod(f"q{i}", cpu="100m", mem="64Mi", node_name=f"n{i}")
+        for i in range(2)
+    ])
+    sync_and_check("two-row batched commit")
+    enc.update_node(make_node("n5", cpu="2", mem="4Gi",
+                              labels={ZONE_KEY: "z-7"}))
+    sync_and_check("node update")
+    enc.remove_pod(make_pod("q0", node_name="n0"))
+    sync_and_check("pod remove")
+    enc.remove_node("n7")
+    sync_and_check("node remove")
+
+
+def test_take_dirty_rows_accumulates_across_snapshots():
+    """A snapshot taken WITHOUT a device update (the gang launch path) must
+    not lose its rows for the next update's scatter."""
+    enc = SnapshotEncoder(TEST_DIMS)
+    for i in range(8):
+        enc.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    cache = DeviceSnapshotCache()
+    cache.update(enc.snapshot(), dirty_rows=enc.take_dirty_rows())
+    enc.add_pod(make_pod("a", cpu="100m", mem="64Mi", node_name="n1"))
+    enc.snapshot()          # consumed by someone else; no take, no update
+    enc.add_pod(make_pod("b", cpu="100m", mem="64Mi", node_name="n2"))
+    snap = enc.snapshot()
+    dirty = enc.take_dirty_rows()
+    # rows from BOTH snapshots must be in the take
+    rows = set(np.asarray(dirty).tolist())
+    assert {enc.node_rows["n1"], enc.node_rows["n2"]} <= rows
+    dev = cache.update(snap, dirty_rows=dirty)
+    full = enc.snapshot(full=True)
+    np.testing.assert_array_equal(
+        np.asarray(dev.requested), np.asarray(full.requested)
+    )
+
+
+def test_take_dirty_rows_full_rebuild_returns_none():
+    enc = SnapshotEncoder(TEST_DIMS)
+    enc.add_node(make_node("n0", cpu="4", mem="8Gi"))
+    enc.snapshot()
+    enc.take_dirty_rows()
+    # force an arena regrow (mark-all) by exceeding node capacity
+    for i in range(1, 3 * TEST_DIMS.N):
+        enc.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    enc.snapshot()
+    assert enc.take_dirty_rows() is None

@@ -86,8 +86,10 @@ def test_sequential_commit_sharded_matches_unsharded():
         np.asarray(new_s.requested), np.asarray(new_ref.requested), rtol=0, atol=0
     )
     # the cluster columns really are distributed, not replicated
+    # (str(): Shard.index is a tuple of slices, unhashable before py3.12)
     shard_set = {
-        s.index for s in jax.block_until_ready(cluster_s.requested).addressable_shards
+        str(s.index)
+        for s in jax.block_until_ready(cluster_s.requested).addressable_shards
     }
     assert len(shard_set) == N_DEV
 
@@ -240,5 +242,6 @@ def test_multihost_dcn_ici_mesh_matches_unsharded():
         np.testing.assert_array_equal(
             np.asarray(new_s.requested), np.asarray(new_ref.requested))
         # the committed state is genuinely split across all 8 shards
-        shard_set = {s.index for s in new_s.requested.addressable_shards}
+        # (str(): tuple-of-slices index is unhashable before py3.12)
+        shard_set = {str(s.index) for s in new_s.requested.addressable_shards}
         assert len(shard_set) == N_DEV
