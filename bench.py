@@ -100,29 +100,72 @@ _N_DEPLOY = 20
 _ZONE = "failure-domain.beta.kubernetes.io/zone"
 
 
-def _build_encoder(args):
-    """The shared 5k-node cluster shape (raw-engine AND live-path stages:
-    identical padded tensor shapes mean one compiled program serves both)."""
+def _bench_nodes(args):
+    """The 5k-node fleet's node OBJECTS — constructed once and reused, so
+    node-encode timings measure encoder ingestion, not object parsing."""
     from kubernetes_tpu.api.factory import make_node
+
+    return [
+        make_node(
+            f"node-{i}",
+            cpu="32",
+            mem="256Gi",
+            pods=110,
+            labels={_ZONE: f"zone-{i % 8}", "tier": "a" if i % 3 else "b"},
+            taints=[{"key": "dedicated", "value": "x", "effect": "NoSchedule"}]
+            if i % 50 == 0
+            else [],
+        )
+        for i in range(args.nodes)
+    ]
+
+
+def _build_encoder(args, nodes=None):
+    """The shared 5k-node cluster shape (raw-engine AND live-path stages:
+    identical padded tensor shapes mean one compiled program serves both).
+    Nodes ingest through the bulk columnar path (encoder.add_nodes)."""
     from kubernetes_tpu.codec import SnapshotEncoder
 
     enc = SnapshotEncoder()
-    for i in range(args.nodes):
-        enc.add_node(
-            make_node(
-                f"node-{i}",
-                cpu="32",
-                mem="256Gi",
-                pods=110,
-                labels={_ZONE: f"zone-{i % 8}", "tier": "a" if i % 3 else "b"},
-                taints=[{"key": "dedicated", "value": "x", "effect": "NoSchedule"}]
-                if i % 50 == 0
-                else [],
-            )
-        )
+    enc.add_nodes(nodes if nodes is not None else _bench_nodes(args))
     for d in range(_N_DEPLOY):
         enc.add_spread_selector("default", {"app": f"dep-{d}"})
     return enc
+
+
+def _node_encode_stats(args, nodes):
+    """Cold bulk ingest vs the per-node loop vs warm re-encode, on the
+    same prebuilt objects.  min-of-3 per path: this machine class is
+    noisy, and min is the standard noise-robust point estimate."""
+    from kubernetes_tpu.codec import SnapshotEncoder
+
+    perpod = []
+    for _ in range(3):
+        e = SnapshotEncoder()
+        t0 = time.monotonic()
+        for n in nodes:
+            e.add_node(n)
+        perpod.append(time.monotonic() - t0)
+    bulk = []
+    enc = None
+    for _ in range(3):
+        enc = SnapshotEncoder()
+        t0 = time.monotonic()
+        enc.add_nodes(nodes)
+        bulk.append(time.monotonic() - t0)
+    # warm re-encode: an informer re-list of content-identical nodes
+    # (fresh equal objects, so the equality check is honest)
+    relist = _bench_nodes(args)
+    t0 = time.monotonic()
+    enc.update_nodes(relist)
+    warm = time.monotonic() - t0
+    t_bulk, t_perpod = min(bulk), min(perpod)
+    return {
+        "node_encode_seconds": round(t_bulk, 4),
+        "node_encode_perpod_seconds": round(t_perpod, 4),
+        "node_encode_speedup": round(t_perpod / t_bulk, 2) if t_bulk else 0.0,
+        "node_reencode_warm_seconds": round(warm, 4),
+    }
 
 
 def _pending_pod(args, i):
@@ -197,9 +240,11 @@ def run(args) -> dict:
     )
     from kubernetes_tpu.models.speculative import make_speculative_scheduler
 
+    nodes = _bench_nodes(args)  # object construction excluded from encode
+    enc_stats = _node_encode_stats(args, nodes)
     t0 = time.monotonic()
-    enc = _build_encoder(args)
-    t_nodes = time.monotonic() - t0
+    enc = _build_encoder(args, nodes)
+    t_build = time.monotonic() - t0  # bulk ingest + spread registration
     n_deploy = _N_DEPLOY
     # the scheduler_bench_test.go matrix's second dimension: N pods
     # ALREADY running before the measured scheduling starts (existing-pod
@@ -387,6 +432,10 @@ def run(args) -> dict:
         }
 
     lat = pct(lat_e2e)
+    # cold start = everything between an empty encoder and ready-to-
+    # schedule state: bulk node ingest + spread registration + existing
+    # pods (the failover re-sync figure the ISSUE 2 tentpole targets)
+    cold_start = t_build + t_existing
     detail = {
         "nodes": args.nodes,
         "pods_scheduled": scheduled,
@@ -397,7 +446,8 @@ def run(args) -> dict:
         "engine": engine,
         "workload": args.workload,
         "seconds": round(dt, 3),
-        "node_encode_seconds": round(t_nodes, 3),
+        **enc_stats,
+        "cold_start_seconds": round(cold_start, 3),
         "phases": {k: round(v, 3) for k, v in phases.items()},
         # queue-add -> bind-commit (burst arrival at t0, the density SLO
         # pair: throughput + p99, density.go:988-990)
@@ -433,12 +483,20 @@ def run(args) -> dict:
         "vs_floor": round(pods_per_s / 30.0, 2),
         "vs_north_star": round(pods_per_s / 10000.0, 3),
         "p99_schedule_latency_ms": lat.get("p99", 0.0),
+        # top level, NOT detail: encode/cold-start regressions must move a
+        # tracked trajectory figure, and the speedup pins the bulk-ingest
+        # acceptance (>=3x vs the per-node loop on this very run)
+        "cold_start_seconds": round(cold_start, 3),
+        "node_encode_speedup": enc_stats["node_encode_speedup"],
         "detail": detail,
     }
     if "live_path" in detail:
         # surface the live-control-plane figure next to the raw-engine one
         # so the perf trajectory tracks the number that actually matters
         out["live_path_pods_per_s"] = detail["live_path"]["pods_per_s"]
+        out["live_path_overlap_efficiency"] = detail["live_path"].get(
+            "overlap_efficiency", 0.0
+        )
     return out
 
 
@@ -507,8 +565,21 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
     t0 = time.monotonic()
     for p in pending:
         queue.add(p)
+    t_enqueue = time.monotonic() - t0
     placed = _drain(900)
     dt = time.monotonic() - t0
+    # overlap efficiency: the "fetch" phase is the async D2H window
+    # measured on the fetch worker (codec/transfer.AsyncFetch), so under
+    # the pipelined commit it overlaps the pop/commit host phases and
+    # the PHASE SUM exceeds wall clock — efficiency > 1.0 is the async
+    # result path working, == 1.0 is fully serial.  fetch_block is a
+    # SUBSET of the fetch window (the part the host actually waited on),
+    # so it is excluded from the sum to avoid double counting.
+    phase_sum = (
+        sum(sched.phase_seconds.values())
+        - sched.phase_seconds["fetch_block"]
+        + t_enqueue
+    )
     return {
         "pods_per_s": round(placed / dt, 1) if dt > 0 else 0.0,
         "seconds": round(dt, 3),
@@ -517,7 +588,11 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
         "batched_commit": batched,
         "pipeline_commit": pipeline,
         "commit_seconds": round(sched.phase_seconds["commit"], 3),
-        "phases": {k: round(v, 3) for k, v in sched.phase_seconds.items()},
+        "phases": {"enqueue": round(t_enqueue, 3),
+                   **{k: round(v, 3)
+                      for k, v in sched.phase_seconds.items()}},
+        "phase_sum_seconds": round(phase_sum, 3),
+        "overlap_efficiency": round(phase_sum / dt, 3) if dt > 0 else 0.0,
         "setup_seconds": round(setup_s, 3),
     }
 

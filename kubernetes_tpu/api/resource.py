@@ -113,7 +113,15 @@ def parse_quantity(s: "str | int | float | Quantity") -> Quantity:
             q = _PARSE_MEMO[s] = _parse_quantity_str(s)
         return q
     if isinstance(s, int):
-        return Quantity(Fraction(s))
+        # ints memoize like strings (pods: 110 across a 5k-node fleet):
+        # sharing the canonical instance lets downstream memo keys take the
+        # identity fast path; bool is an int subtype, fine to share too
+        q = _PARSE_MEMO.get(s)
+        if q is None:
+            if len(_PARSE_MEMO) > 65536:
+                _PARSE_MEMO.clear()
+            q = _PARSE_MEMO[s] = Quantity(Fraction(s))
+        return q
     if isinstance(s, float):
         return Quantity(Fraction(s).limit_denominator(10**9))
     raise ValueError(f"invalid quantity {s!r}")

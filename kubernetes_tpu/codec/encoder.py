@@ -22,6 +22,7 @@ from __future__ import annotations
 import dataclasses
 from collections import Counter
 from dataclasses import dataclass, field
+from itertools import chain
 from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -82,6 +83,15 @@ GETZONE_KEY = "\x00getzonekey"
 # kinds of existing-pod affinity term groups
 K_ANTI_REQ, K_ANTI_PREF, K_AFF_REQ, K_AFF_PREF = 0, 1, 2, 3
 
+# attachable-volumes-* allocatable key -> attach-count column (ref the
+# AttachVolumeLimit feature's allocatable keys); the one mapping both the
+# per-node and bulk ingest paths consume (_vol_limit_col)
+_VOL_LIMIT_COLS = {
+    "attachable-volumes-aws-ebs": VOL_EBS,
+    "attachable-volumes-gce-pd": VOL_GCE,
+    "attachable-volumes-azure-disk": VOL_AZURE,
+}
+
 
 def _sel_requirements(raw_selector: Optional[dict]) -> Optional[klabels.Selector]:
     return klabels.selector_from_label_selector(raw_selector)
@@ -135,6 +145,40 @@ class _PodRecord:
 
 
 class SnapshotEncoder:
+    """API objects -> numpy arenas -> incremental ClusterTensors snapshots.
+
+    Dirty-row contract (the ONE place it is documented; the snapshot,
+    transfer, and mutation paths all reference this):
+
+      * Every mutation marks what it touched: node events mark their row
+        via _mark_node_dirty (EVERY per-row field of that row may have
+        changed); pod commits mark only their node row via _mark_pod_dirty
+        (only the aggregate fields — requested/nonzero/ports/vols — may
+        have changed).  Batch ingest (add_pods / add_nodes) marks once per
+        batch.  Wholesale rewrites — arena retile/regrow, pad-dim or
+        vocabulary growth, topology-key backfill, _reapply_pods_to_arena —
+        call _mark_all_dirty instead: content correctness NEVER depends on
+        a mutation site remembering to mark precisely, because imprecise
+        sites must escalate to the full flag.
+
+      * snapshot() consumes the marks: dirty rows re-encode copy-on-write
+        per field, untouched fields return the SAME array object as the
+        previous snapshot (consumers detect no-change by identity, so
+        snapshot arrays are immutable by contract).  A set _snap_dirty_all
+        forces a from-scratch rebuild of every field.
+
+      * take_dirty_rows() is the transfer handshake: it accumulates the
+        rows applied by snapshots since the previous take (plus pending
+        marks) so the device cache can scatter-update exactly those rows.
+        The accumulator survives snapshots that are consumed WITHOUT a
+        device update (e.g. gang launches) — rows keep accumulating until
+        taken.  Any full rebuild (arena regrow, _mark_all_dirty) poisons
+        the accumulator: the next take returns None, meaning "resync every
+        field; row identity may have moved".  Single-consumer: exactly one
+        DeviceSnapshotCache may take; a second taker would starve the
+        first of its rows.
+    """
+
     def __init__(self, dims: Optional[PadDims] = None,
                  hard_pod_affinity_weight: float = 1.0):
         self.dims = dims or PadDims()
@@ -223,15 +267,7 @@ class SnapshotEncoder:
         self._empty_vcounts: np.ndarray | None = None
 
         # ---- incremental snapshot bookkeeping ----
-        # snapshot() re-encodes ONLY rows touched since the previous
-        # snapshot (copy-on-write per field); untouched fields are returned
-        # as the SAME array object, which DeviceSnapshotCache detects by
-        # identity and skips re-transferring.  Node-level mutations dirty
-        # every per-row field of a row; pod commits dirty only the
-        # aggregate fields (requested/nonzero/ports/vols).  Any arena
-        # retile / vocabulary growth / bulk backfill falls back to a full
-        # rebuild (_mark_all_dirty) — content correctness never depends on
-        # a mutation site remembering to mark precisely.
+        # see the class docstring for the dirty-row contract
         self._snap: Optional[ClusterTensors] = None
         self._snap_dirty_all = True
         self._dirty_node_rows: Set[int] = set()
@@ -256,13 +292,11 @@ class SnapshotEncoder:
             self._dirty_pod_rows.add(row)
 
     def take_dirty_rows(self) -> Optional[np.ndarray]:
-        """Rows whose snapshot content may differ from what the (single)
-        transfer consumer last uploaded: the union of rows applied by
-        snapshots since the previous take, plus still-pending marks (extra
-        rows are harmless — the scatter just rewrites identical values).
-        Returns None after a full rebuild (consumer must resync every
-        field).  Single-consumer contract: the scheduler's
-        DeviceSnapshotCache; a second taker would starve the first."""
+        """Rows whose snapshot content may differ from what the transfer
+        consumer last uploaded; None after a full rebuild.  Extra rows are
+        harmless (the scatter rewrites identical values).  Semantics —
+        accumulation across snapshots, rebuild poisoning, the single-
+        consumer rule — are in the class docstring's dirty-row contract."""
         if self._snap_rows_acc is None or self._snap_dirty_all:
             self._snap_rows_acc = set()
             return None
@@ -351,9 +385,20 @@ class SnapshotEncoder:
             new[:old] = col
             self._label_cols[k] = new
 
-    def _grow_pairs(self) -> None:
-        """Topology-pair vocabulary outgrew TP: double it."""
-        self.dims = dataclasses.replace(self.dims, TP=self.dims.TP * 2)
+    def _grow_pairs(self, min_tp: Optional[int] = None) -> None:
+        """Topology-pair vocabulary outgrew TP: double it.  With `min_tp`,
+        replay the doubling schedule to the final width in ONE realloc
+        (the bulk ingest path registers a whole batch's pairs first, then
+        resizes once; the per-miss caller doubles step by step)."""
+        tp = self.dims.TP
+        if min_tp is None:
+            tp *= 2
+        else:
+            while tp < min_tp:
+                tp *= 2
+            if tp == self.dims.TP:
+                return
+        self.dims = dataclasses.replace(self.dims, TP=tp)
         new = np.zeros((self._cap_n, self.dims.TP), bool)
         new[:, : self.a_topo.shape[1]] = self.a_topo
         self.a_topo = new
@@ -391,6 +436,35 @@ class SnapshotEncoder:
                 self.a_topo[row, pid] = True
                 self._node_pair_id[kid][row] = pid
         return kid
+
+    def _vol_limit_col(self, name: str) -> Optional[int]:
+        """Attach-limit column for an attachable-volumes-* allocatable key,
+        or None when the key constrains nothing (malformed empty-driver
+        keys — the golden ignores them too).  May register a per-driver
+        column (and so grow VT)."""
+        col = _VOL_LIMIT_COLS.get(name)
+        if col is None and name.startswith("attachable-volumes-csi-"):
+            driver = name[len("attachable-volumes-csi-"):]
+            col = self._vol_col(driver) if driver else None
+        elif col is None and "csi" in name:
+            col = VOL_CSI
+        return col
+
+    @staticmethod
+    def _cond_bits(cond: Dict[str, str]) -> Tuple[bool, bool, bool, bool]:
+        """(not_ready, mem_pressure, disk_pressure, pid_pressure) from a
+        status.conditions map — CheckNodeConditionPredicate semantics
+        (predicates.go: Ready!=True, OutOfDisk==True, or
+        NetworkUnavailable==True fail the node).  The one decode both the
+        per-node and bulk ingest paths consume."""
+        return (
+            cond.get("Ready", "True") != "True"
+            or cond.get("OutOfDisk", "False") == "True"
+            or cond.get("NetworkUnavailable", "False") == "True",
+            cond.get("MemoryPressure", "False") == "True",
+            cond.get("DiskPressure", "False") == "True",
+            cond.get("PIDPressure", "False") == "True",
+        )
 
     def _res_col(self, name: str) -> int:
         if name == RESOURCE_CPU:
@@ -516,6 +590,488 @@ class SnapshotEncoder:
         self._gc_dirty = True  # detached pods left p_node
         self.generation += 1
 
+    def add_nodes(self, nodes: Sequence[Node]) -> List[int]:
+        """Batched add_node: a columnar encode of many NEW node rows that
+        produces byte-identical arena state to calling add_node(n) for each
+        node in order (pinned by tests/test_bulk_nodes.py), amortizing the
+        per-node numpy overhead — the cold-start / failover re-sync wall
+        (node_encode_seconds in bench.py):
+
+          * per-row numpy slice writes (~40 per node in _write_node_row)
+            collapse into one fancy-indexed scatter per FIELD per batch;
+          * string interning runs through the per-node registration pass
+            in add_node's exact order (name, labels, taints, GetZoneKey,
+            images, avoid), so interner ids, resource/volume columns, and
+            the topology-pair vocabulary are assigned identically;
+          * pad-dim growth (L/T/I, N) happens ONCE up front for the whole
+            batch instead of regrowing per offending node (bump() rounds
+            to pow2 of the max, so final dims match the sequential loop);
+          * dirty-row marks and the generation counter advance once per
+            batch, not once per node.
+
+        Batches containing a duplicate name or a name already resident
+        take the exact per-node path (those are update batches, where the
+        old-row teardown must interleave per node).  Returns the assigned
+        rows, same values the per-node loop would return."""
+        nodes = list(nodes)
+        if not nodes:
+            return []
+        names = [n.name for n in nodes]
+        if len(set(names)) != len(names) or any(
+            n in self.node_rows for n in names
+        ):
+            return [self.add_node(n) for n in nodes]
+
+        # -- pass 0: pad-dim growth to fit the whole batch
+        d0 = self.dims
+        grow = {}
+        max_l = max(len(n.metadata.labels) for n in nodes)
+        max_t = max(len(n.spec.taints) for n in nodes)
+        max_i = max(len(n.status.images) for n in nodes)
+        if max_l > d0.L:
+            grow["L"] = max_l
+        if max_t > d0.T:
+            grow["T"] = max_t
+        if max_i > d0.I:
+            grow["I"] = max_i
+        if grow:
+            self.dims = self.dims.bump(**grow)
+            self._regrow_node_arena(self._cap_n)
+            self._reapply_pods_to_arena()
+
+        # -- pass 1: row allocation (free rows first — the same pop order
+        # the per-node loop uses).  The arena is pre-sized to the FINAL
+        # capacity by replaying _grow_nodes' growth schedule arithmetic
+        # without the intermediate reallocs (one regrow, not ~13 at 5k
+        # nodes; the final cap — and therefore every arena shape — is
+        # byte-identical to the sequential loop's)
+        n_new = len(nodes) - min(len(self._free_rows), len(nodes))
+        if n_new:
+            max_row = self._next_row + n_new - 1
+            cap = self._cap_n
+            while max_row >= cap:
+                cap = cap * 2 if cap < 2048 else -(-(cap + cap // 4) // 512) * 512
+            if cap != self._cap_n:
+                self.dims = dataclasses.replace(self.dims, N=cap)
+                self._regrow_node_arena(self._cap_n)
+        rows: List[int] = []
+        reused: List[int] = []    # rows recycled off the free list (these
+        #                           carry stale content needing row resets)
+        node_rows = self.node_rows
+        row_node = self._row_node
+        node_ports = self._node_ports
+        node_dvols = self._node_disk_vols
+        free_rows = self._free_rows
+        # Counter.__new__ skips the __init__/update call chain; a Counter
+        # is a plain dict subclass, so the uninitialized instance IS the
+        # empty Counter (== Counter(), same type, same methods)
+        counter_new = Counter.__new__
+        for node in nodes:
+            if free_rows:
+                row = free_rows.pop()
+                reused.append(row)
+            else:
+                row = self._next_row
+                self._next_row += 1
+            rows.append(row)
+            node_rows[node.metadata.name] = row
+            row_node[row] = node
+            node_ports[row] = counter_new(Counter)
+            node_dvols[row] = counter_new(Counter)
+
+        # -- pass 2: vocabulary registration + integer row data, per node
+        # in add_node's exact order.  This pass only touches dicts/lists
+        # (interner, _res_cols/_vol_cols, pair vocabulary — all of whose
+        # id-assignment order must match the per-node loop); every numpy
+        # write waits for pass 3, AFTER any R/VT/TP growth has settled.
+        it = self.interner
+        intern = it.intern
+        intern_many = it.intern_many
+        # topology-pair registration without per-miss a_topo doubling: the
+        # vocabulary appends here in the per-node order _pair_id would
+        # use, and the (N x TP) incidence tensor resizes ONCE after the
+        # loop by replaying the doubling schedule (identical final TP; the
+        # sequential loop pays up to ~9 full-width reallocs at 5k nodes)
+        pv = self._pair_vocab
+        pv_get = pv.get
+        ptk = self._pair_topo_key
+        gz_memo: Dict[Tuple[str, str], str] = {}
+        name_ids: List[int] = []
+        # condition/unschedulable EXCEPTIONS only (healthy schedulable
+        # fleets append nothing; pass 3 scatters just the outliers over a
+        # False default)
+        unsched_k: List[int] = []
+        notready_k: List[int] = []
+        mempress_k: List[int] = []
+        diskpress_k: List[int] = []
+        pidpress_k: List[int] = []
+        alloc_n: List[int] = []       # per-node resource-entry count
+        alloc_c: List[int] = []
+        alloc_v: List[float] = []
+        lim_k: List[int] = []         # attachable-volume limit writes
+        lim_c: List[int] = []
+        lim_v: List[float] = []
+        lab_n: List[int] = []         # per-node label count (k/j columns
+        lab_kid: List[int] = []       #   derive via np.repeat/arange)
+        lab_vid: List[int] = []
+        tnt_k: List[int] = []
+        tnt_j: List[int] = []
+        tnt_kid: List[int] = []
+        tnt_vid: List[int] = []
+        tnt_eff: List[int] = []
+        topo_k: List[int] = []        # (batch idx, pair id) True incidences
+        topo_pid: List[int] = []
+        pair_cols: Dict[int, List[int]] = {k: [] for k in self.topo_keys}
+        topo_key_strs = [
+            (kid, it.string(kid), pair_cols[kid].append)
+            for kid in self.topo_keys
+        ]
+        topo_k_app = topo_k.append
+        topo_pid_app = topo_pid.append
+        img_k: List[int] = []
+        img_j: List[int] = []
+        img_id: List[int] = []
+        img_sz: List[float] = []
+        img_names: List[str] = []     # _image_nodes increments, batched
+        av_k: List[int] = []
+        av_j: List[int] = []
+        av_id: List[int] = []
+        # allocatable-dict memo: stamped node fleets share one allocatable
+        # content, so the exact Fraction math (milli/__float__, ~6us/node
+        # at 5k) and column resolution run once per DISTINCT content;
+        # values are (res cols, res vals, limit cols, limit vals)
+        alloc_memo: Dict[Tuple, Tuple] = {}
+        res_memo: Dict[str, int] = {}
+        # image-name cap simulation: the per-node loop caps each row's
+        # flattened image NAMES at the dims.I in effect when that node is
+        # written (I bumps lazily off the image COUNT of the node itself),
+        # so a many-names node written before the bumping node truncates
+        # at the old width — replay that schedule for byte-identity
+        run_i = d0.I
+        import json
+
+        ready_only = {"Ready": "True"}
+        for k, node in enumerate(nodes):
+            cond = node.status.conditions
+            if node.spec.unschedulable:
+                unsched_k.append(k)
+            if cond != ready_only:  # != the healthy-fleet shape: decode
+                nr, mp, dp, pp = self._cond_bits(cond)
+                if nr:
+                    notready_k.append(k)
+                if mp:
+                    mempress_k.append(k)
+                if dp:
+                    diskpress_k.append(k)
+                if pp:
+                    pidpress_k.append(k)
+            # whole-dict memo: a stamped fleet shares one allocatable
+            # content (parse_quantity canonicalizes values to shared
+            # instances with cached hashes, so the tuple key hashes in
+            # ~0.5us and dict equality takes the identity fast path)
+            akey = tuple(node.status.allocatable.items())
+            hit = alloc_memo.get(akey)
+            if hit is None:
+                cols: List[int] = []
+                vals: List[float] = []
+                lcols: List[int] = []
+                lvals: List[float] = []
+                for name, q in node.status.allocatable.items():
+                    if name.startswith("attachable-volumes-"):
+                        col = self._vol_limit_col(name)
+                        if col is not None:
+                            lcols.append(col)
+                            lvals.append(float(q))
+                        continue
+                    col = res_memo.get(name)
+                    if col is None:
+                        col = res_memo[name] = self._res_col(name)
+                    cols.append(col)
+                    vals.append(
+                        q.milli if name == RESOURCE_CPU else float(q)
+                    )
+                hit = alloc_memo[akey] = (cols, vals, lcols, lvals)
+            cols, vals, lcols, lvals = hit
+            alloc_n.append(len(cols))
+            alloc_c.extend(cols)
+            alloc_v.extend(vals)
+            if lcols:
+                lim_k.extend([k] * len(lcols))
+                lim_c.extend(lcols)
+                lim_v.extend(lvals)
+            # one stacked intern for everything this node names, in
+            # _write_node_row's exact order (name, label k/v pairs, taint
+            # key/value pairs, GetZoneKey combo, image names, avoid uids)
+            # so novel-id assignment is position-identical to the loop
+            labels = node.metadata.labels
+            lab_items = sorted(labels.items())
+            taints = node.spec.taints
+            region = labels.get(REGION_KEY, "")
+            zone = labels.get(ZONE_KEY, "")
+            imgs = node.status.images
+            capped_imgs: "List[Tuple[str, float]] | Tuple" = ()
+            if imgs:
+                if len(imgs) > run_i:
+                    run_i = _pow2(len(imgs))
+                capped_imgs = []
+                j = 0
+                for img in imgs:
+                    for name in img.names:
+                        if j >= run_i:
+                            break
+                        capped_imgs.append((name, float(img.size_bytes)))
+                        j += 1
+            # (slot, uid) pairs: empty uids CONSUME a slot but write
+            # nothing, matching _write_node_row's enumerate-then-filter
+            uids: "List[Tuple[int, str]] | Tuple" = ()
+            ann = node.metadata.annotations.get(
+                "scheduler.alpha.kubernetes.io/preferAvoidPods"
+            )
+            if ann:
+                try:
+                    avoid = json.loads(ann)
+                    raw = [
+                        e.get("podSignature", {})
+                        .get("podController", {})
+                        .get("uid", "")
+                        for e in avoid.get("preferAvoidPods", [])
+                    ]
+                    uids = [(j, u) for j, u in enumerate(raw[: self.dims.A]) if u]
+                except (ValueError, AttributeError):
+                    uids = []
+            nl = len(lab_items)
+            nt = len(taints)
+            # the name interns FIRST (as _write_node_row does) and alone:
+            # it is the one always-novel string, so the stacked
+            # intern_many below usually takes its all-hits fast path
+            name_ids.append(intern(node.metadata.name))
+            strs: List[str] = []
+            if nl:
+                strs.extend(chain.from_iterable(lab_items))
+            if nt:
+                strs.extend(
+                    chain.from_iterable((t.key, t.value) for t in taints)
+                )
+            if region or zone:
+                gzk = (region, zone)
+                gz = gz_memo.get(gzk)
+                if gz is None:
+                    gz = gz_memo[gzk] = region + ":\x00:" + zone
+                strs.append(gz)
+            if capped_imgs:
+                strs.extend(nm for nm, _ in capped_imgs)
+            if uids:
+                strs.extend(u for _, u in uids)
+            ids = intern_many(strs)
+            # slice-unpack the stacked ids (C-speed strides, not per-item
+            # python appends): keys at even offsets, values at odd
+            lab_n.append(nl)
+            if nl:
+                lab_kid.extend(ids[0:2 * nl:2])
+                lab_vid.extend(ids[1:1 + 2 * nl:2])
+            base = 2 * nl
+            if nt:
+                tnt_k.extend([k] * nt)
+                tnt_j.extend(range(nt))
+                tnt_kid.extend(ids[base:base + 2 * nt:2])
+                tnt_vid.extend(ids[base + 1:base + 2 * nt:2])
+                for t in taints:
+                    tnt_eff.append(EFFECT_CODES.get(t.effect, 0))
+            pos = base + 2 * nt
+            # topology pairs: label values are interned by now, so the
+            # pair-vocabulary registration order matches the per-node loop
+            labels_get = labels.get
+            for kid, key_str, col_append in topo_key_strs:
+                val = labels_get(key_str)
+                if val is not None:
+                    key2 = (kid, intern(val))
+                    pid = pv_get(key2)
+                    if pid is None:
+                        pid = len(ptk)
+                        pv[key2] = pid
+                        ptk.append(kid)
+                    topo_k_app(k)
+                    topo_pid_app(pid)
+                    col_append(pid)
+                else:
+                    col_append(PAD)
+            if region or zone:
+                key2 = (self.getzone_key, ids[pos])
+                pid = pv_get(key2)
+                if pid is None:
+                    pid = len(ptk)
+                    pv[key2] = pid
+                    ptk.append(self.getzone_key)
+                topo_k_app(k)
+                topo_pid_app(pid)
+                pos += 1
+            for j, (nm, sz) in enumerate(capped_imgs):
+                img_k.append(k)
+                img_j.append(j)
+                img_id.append(ids[pos])
+                pos += 1
+                img_sz.append(sz)
+                img_names.append(nm)
+            for j, _u in uids:
+                av_k.append(k)
+                av_j.append(j)
+                av_id.append(ids[pos])
+                pos += 1
+        if img_names:
+            self._image_nodes.update(img_names)
+        # replay _grow_pairs' doubling schedule in one realloc
+        self._grow_pairs(min_tp=len(ptk))
+
+        # -- pass 3: columnar arena writes (arrays fetched AFTER pass 2 —
+        # R/VT/TP growth replaces them).  Row resets apply ONLY to rows
+        # recycled off the free list: those keep their previous label/
+        # taint/allocatable content until overwritten (remove_node clears
+        # only the aggregates), so exactly the slices _write_node_row
+        # rewrites are reset.  FRESH rows skip resets entirely — the arena
+        # default (PAD/0/inf/nan/False from _alloc_node_arena) is
+        # byte-identical to the reset value — and a no-reuse batch is a
+        # contiguous row range, so the full-batch column writes go through
+        # slice assignment instead of per-element fancy indexing.
+        # Port/volume row rebuilds are SKIPPED: a new row's counters are
+        # empty and its port/vol slices are already PAD/False (fresh from
+        # _alloc, or reset by remove_node before the row was freed).
+        i32, f32 = np.int32, np.float32
+        if reused:
+            rows_arr = np.asarray(rows, np.intp)
+            idx: "slice | np.ndarray" = rows_arr
+            row0 = 0
+            r = np.asarray(reused, np.intp)
+            self.a_unsched[r] = False
+            self.a_notready[r] = False
+            self.a_mempress[r] = False
+            self.a_diskpress[r] = False
+            self.a_pidpress[r] = False
+            self.a_allocatable[r] = 0.0
+            self.a_vollim[r] = np.inf
+            self.a_lkeys[r] = PAD
+            self.a_lvals[r] = PAD
+            self.a_lnums[r] = np.nan
+            self.a_tkey[r] = PAD
+            self.a_tval[r] = PAD
+            self.a_teff[r] = PAD
+            self.a_topo[r] = False
+            self.a_img_id[r] = PAD
+            self.a_img_sz[r] = 0.0
+            self.a_avoid[r] = PAD
+        else:
+            rows_arr = None
+            row0 = rows[0]
+            idx = slice(row0, row0 + len(rows))
+
+        def rowsel(ks):
+            ka = np.asarray(ks, np.intp)
+            return ka + row0 if rows_arr is None else rows_arr[ka]
+
+        def scatter2(dst, ks, js, vals, dtype):
+            dst[rowsel(ks), np.asarray(js, np.intp)] = np.asarray(vals, dtype)
+
+        self.a_valid[idx] = True
+        self.a_name[idx] = np.asarray(name_ids, i32)
+        # condition/unschedulable outliers over the False default
+        if unsched_k:
+            self.a_unsched[rowsel(unsched_k)] = True
+        if notready_k:
+            self.a_notready[rowsel(notready_k)] = True
+        if mempress_k:
+            self.a_mempress[rowsel(mempress_k)] = True
+        if diskpress_k:
+            self.a_diskpress[rowsel(diskpress_k)] = True
+        if pidpress_k:
+            self.a_pidpress[rowsel(pidpress_k)] = True
+        if alloc_c:
+            # the batch-index column derives from the per-node counts
+            # (np.repeat beats 5k python [k]*n extends)
+            alloc_k_arr = np.repeat(
+                np.arange(len(nodes), dtype=np.intp),
+                np.asarray(alloc_n, np.intp),
+            )
+            self.a_allocatable[
+                alloc_k_arr + row0 if rows_arr is None else rows_arr[alloc_k_arr],
+                np.asarray(alloc_c, np.intp),
+            ] = np.asarray(alloc_v, f32)
+        if lim_k:
+            scatter2(self.a_vollim, lim_k, lim_c, lim_v, f32)
+        if lab_kid:
+            lab_n_arr = np.asarray(lab_n, np.intp)
+            lab_k_arr = np.repeat(
+                np.arange(len(nodes), dtype=np.intp), lab_n_arr
+            )
+            # per-node slot index: 0..nl-1 per node, C-speed
+            starts = np.cumsum(lab_n_arr) - lab_n_arr
+            lab_j_arr = (
+                np.arange(len(lab_kid), dtype=np.intp)
+                - np.repeat(starts, lab_n_arr)
+            )
+            lr = lab_k_arr + row0 if rows_arr is None else rows_arr[lab_k_arr]
+            self.a_lkeys[lr, lab_j_arr] = np.asarray(lab_kid, i32)
+            self.a_lvals[lr, lab_j_arr] = np.asarray(lab_vid, i32)
+            # numeric label column (Gt/Lt operands): one parse per
+            # DISTINCT value id, gathered C-speed over the whole batch
+            vid_arr = np.asarray(lab_vid, np.intp)
+            lut = np.full(int(vid_arr.max()) + 1, np.nan, f32)
+            s = it.string
+            for vid in set(lab_vid):
+                v = s(vid)
+                try:
+                    lut[vid] = float(int(v))
+                except ValueError:
+                    pass
+            self.a_lnums[lr, lab_j_arr] = lut[vid_arr]
+        if tnt_k:
+            scatter2(self.a_tkey, tnt_k, tnt_j, tnt_kid, i32)
+            scatter2(self.a_tval, tnt_k, tnt_j, tnt_vid, i32)
+            scatter2(self.a_teff, tnt_k, tnt_j, tnt_eff, i32)
+        if topo_k:
+            self.a_topo[rowsel(topo_k), np.asarray(topo_pid, np.intp)] = True
+        for kid, vals in pair_cols.items():
+            self._node_pair_id[kid][idx] = np.asarray(vals, i32)
+        if img_k:
+            scatter2(self.a_img_id, img_k, img_j, img_id, i32)
+            scatter2(self.a_img_sz, img_k, img_j, img_sz, f32)
+        if av_k:
+            scatter2(self.a_avoid, av_k, av_j, av_id, i32)
+
+        self._dirty_node_rows.update(rows)
+        self.generation += len(nodes)
+        return rows
+
+    def update_nodes(self, nodes: Sequence[Node]) -> List[int]:
+        """Bulk upsert for informer re-list / failover re-sync.  NEW nodes
+        flush through the columnar add_nodes path (consecutive runs keep
+        arrival order, so interner/vocabulary id assignment matches the
+        per-node loop); resident nodes whose stored object compares EQUAL
+        are skipped outright — no row write, no dirty mark, no generation
+        bump (a re-listed unchanged node is not a change; this is the warm
+        re-encode fast path bench.py reports) — and changed nodes take
+        update_node.  Returns each node's arena row."""
+        nodes = list(nodes)
+        rows: List[int] = [-1] * len(nodes)
+        run: List[int] = []
+
+        def flush():
+            if run:
+                for i, r in zip(run, self.add_nodes([nodes[i] for i in run])):
+                    rows[i] = r
+                run.clear()
+
+        for i, node in enumerate(nodes):
+            row = self.node_rows.get(node.name)
+            if row is None:
+                run.append(i)
+                continue
+            flush()
+            if self._row_node.get(row) == node:
+                rows[i] = row
+            else:
+                rows[i] = self.update_node(node)
+        flush()
+        return rows
+
     def _write_node_row(self, row: int, node: Node) -> None:
         d = self.dims
         it = self.interner
@@ -535,37 +1091,19 @@ class SnapshotEncoder:
         self.a_valid[row] = True
         self.a_name[row] = it.intern(node.name)
         self.a_unsched[row] = node.spec.unschedulable
-        cond = node.status.conditions
-        # ref predicates.go CheckNodeConditionPredicate: Ready!=True,
-        # OutOfDisk==True, or NetworkUnavailable==True fail the node
-        self.a_notready[row] = (
-            cond.get("Ready", "True") != "True"
-            or cond.get("OutOfDisk", "False") == "True"
-            or cond.get("NetworkUnavailable", "False") == "True"
-        )
-        self.a_mempress[row] = cond.get("MemoryPressure", "False") == "True"
-        self.a_diskpress[row] = cond.get("DiskPressure", "False") == "True"
-        self.a_pidpress[row] = cond.get("PIDPressure", "False") == "True"
+        (
+            self.a_notready[row],
+            self.a_mempress[row],
+            self.a_diskpress[row],
+            self.a_pidpress[row],
+        ) = self._cond_bits(node.status.conditions)
         # allocatable (+ per-node attachable-volume limits, ref the
         # AttachVolumeLimit feature's attachable-volumes-* allocatable keys)
         self.a_allocatable[row, :] = 0.0
         self.a_vollim[row, :] = np.inf
-        vol_limit_cols = {
-            "attachable-volumes-aws-ebs": VOL_EBS,
-            "attachable-volumes-gce-pd": VOL_GCE,
-            "attachable-volumes-azure-disk": VOL_AZURE,
-        }
         for name, q in node.status.allocatable.items():
             if name.startswith("attachable-volumes-"):
-                col = vol_limit_cols.get(name)
-                if col is None and name.startswith("attachable-volumes-csi-"):
-                    # per-driver cap: attachable-volumes-csi-<driver>; a
-                    # malformed empty-driver key constrains nothing (the
-                    # golden ignores it too)
-                    driver = name[len("attachable-volumes-csi-"):]
-                    col = self._vol_col(driver) if driver else None
-                elif col is None and "csi" in name:
-                    col = VOL_CSI
+                col = self._vol_limit_col(name)
                 if col is not None:
                     self.a_vollim[row, col] = float(q)
                 continue
@@ -1520,12 +2058,10 @@ class SnapshotEncoder:
         return (self.a_img_sz * scale).astype(np.float32)
 
     def snapshot(self, full: bool = False) -> ClusterTensors:
-        """Point-in-time ClusterTensors.  Incremental by default: only rows
-        dirtied since the previous snapshot are re-encoded (copy-on-write
-        per field), and fields with no dirty rows are returned as the SAME
-        array object as last time — consumers must treat snapshot arrays as
-        immutable (everything downstream already does: they feed jit).
-        `full=True` forces a from-scratch rebuild of every field."""
+        """Point-in-time ClusterTensors.  Incremental by default per the
+        class docstring's dirty-row contract (cow re-encode of dirty rows,
+        identity-reuse of untouched fields — treat the arrays as
+        immutable); `full=True` forces a from-scratch rebuild."""
         if full or self._snap is None or self._snap_dirty_all:
             snap = self._snapshot_full()
             self._snap_rows_acc = None  # consumer must full-sync

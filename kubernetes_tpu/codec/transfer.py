@@ -24,13 +24,165 @@ this is TPU-plumbing the same way protobuf wire-batching is etcd-plumbing.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Tuple
+import threading
+import time
+from typing import Any, Callable, List, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_tpu.codec.schema import _pow2
+
+# ---------------------------------------------------------------- D2H fences
+#
+# Every device->host materialization the RUNTIME performs goes through the
+# helpers below, which report each sync that actually BLOCKS the calling
+# thread to the registered listeners.  Tests hook this (on_blocking_sync) to
+# pin the per-cycle blocking-sync budget — the regression guard that keeps
+# per-pod fetches from silently coming back (tests/test_host_sync_guard.py).
+# Engine-INTERNAL syncs (the speculative CPU host-rounds loop) are a
+# documented design choice and are not routed through here.
+
+_SYNC_LISTENERS: List[Callable[[str], None]] = []
+
+
+def on_blocking_sync(fn: Callable[[str], None]) -> Callable[[], None]:
+    """Register a listener called with a tag on every blocking device sync
+    performed through this module's fetch helpers.  Returns a remover."""
+    _SYNC_LISTENERS.append(fn)
+
+    def remove() -> None:
+        try:
+            _SYNC_LISTENERS.remove(fn)
+        except ValueError:
+            pass
+
+    return remove
+
+
+def _note_sync(tag: str) -> None:
+    for fn in _SYNC_LISTENERS:
+        fn(tag)
+
+
+def host_fetch(x, tag: str = "fetch") -> np.ndarray:
+    """The canonical BLOCKING device->host sync point: np.asarray with the
+    fence listeners notified first.  Runtime code must fetch through this
+    (or AsyncFetch) rather than raw np.asarray so sync counts stay
+    observable."""
+    _note_sync(tag)
+    return np.asarray(x)
+
+
+def upload_async(tree):
+    """Async H2D: jax.device_put returns immediately (the copy overlaps
+    host work); pair with ready_fence() when completion must be ordered
+    before a dependent host step.  Exists mostly as the named seam — the
+    point is that NO fence is needed on the hot path, because jit consumers
+    order themselves on the transfer."""
+    return jax.device_put(tree)
+
+
+def ready_fence(tree, tag: str = "fence"):
+    """Explicit blocking fence: waits until every leaf of `tree` is
+    computed/transferred.  Counts as a blocking sync."""
+    _note_sync(tag)
+    jax.block_until_ready(tree)
+    return tree
+
+
+class _FetchWorker:
+    """One persistent daemon thread draining AsyncFetch jobs — per-cycle
+    thread create/teardown was measurable under trickle arrival (hundreds
+    of cycles/s), and a DAEMON thread (unlike a ThreadPoolExecutor's
+    joined workers) cannot let a wedged-tunnel fetch block interpreter
+    exit."""
+
+    def __init__(self) -> None:
+        import queue as _q
+
+        self._jobs: Any = _q.SimpleQueue()
+        self.thread = threading.Thread(
+            target=self._drain, daemon=True, name="ktpu-async-fetch"
+        )
+        self.thread.start()
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        self._jobs.put(fn)
+
+    def _drain(self) -> None:
+        while True:
+            self._jobs.get()()
+
+
+_FETCH_WORKER: "_FetchWorker | None" = None
+_FETCH_WORKER_LOCK = threading.Lock()
+
+
+def _fetch_worker() -> _FetchWorker:
+    global _FETCH_WORKER
+    w = _FETCH_WORKER
+    if w is None or not w.thread.is_alive():  # first use, or post-fork
+        with _FETCH_WORKER_LOCK:
+            w = _FETCH_WORKER
+            if w is None or not w.thread.is_alive():
+                w = _FETCH_WORKER = _FetchWorker()
+    return w
+
+
+class AsyncFetch:
+    """Fetch-in-flight handle for a device result (the D2H half of the
+    double-buffered commit pipeline).
+
+    Starts the wire copy immediately — copy_to_host_async() enqueues the
+    D2H DMA to fire the moment the producing computation finishes — and
+    completes the materialization on the shared fetch worker, so the
+    blocking device sync overlaps whatever the scheduling thread does
+    next (dispatching batch k+1, running batch k-1's side-effect tail).
+
+    result() is the ready-fence: it returns the host array, blocking only
+    if the copy hasn't landed yet (and only that case is reported to the
+    sync listeners); a device error re-raises HERE, so callers own the
+    batch's recovery at the fence.  `seconds` is the device-side window
+    from dispatch to copy-complete — the honest "fetch" phase cost, which
+    may overlap other host phases (bench.py's overlap-efficiency figure
+    divides wall clock by the sum of such phases)."""
+
+    def __init__(self, dev, tag: str = "fetch") -> None:
+        self._dev = dev
+        self._tag = tag
+        if hasattr(dev, "copy_to_host_async"):
+            dev.copy_to_host_async()
+        self._done = threading.Event()
+        self._out: Any = None
+        self._err: Any = None
+        self.seconds = 0.0
+        self._t0 = time.monotonic()
+        _fetch_worker().submit(self._run)
+
+    def _run(self) -> None:
+        try:
+            self._out = np.asarray(self._dev)
+        except BaseException as e:  # noqa: BLE001 — re-raised in result()
+            self._err = e
+        finally:
+            self.seconds = time.monotonic() - self._t0
+            self._done.set()
+
+    def ready(self) -> bool:
+        """Non-blocking fence probe: has the host copy landed?"""
+        return self._done.is_set()
+
+    def result(self) -> np.ndarray:
+        """The ready-fence: host array, blocking (and reporting a blocking
+        sync) only when the copy is still in flight."""
+        if not self._done.is_set():
+            _note_sync(self._tag)
+            self._done.wait()
+        if self._err is not None:
+            raise self._err
+        return self._out
 
 _GROUPS = ("f", "i", "b")
 _HOST_DTYPE = {"f": np.float32, "i": np.int32, "b": np.bool_}

@@ -73,11 +73,13 @@ class GangScheduler:
         # "sequential" would wave through exactly the future engine swap
         # this assert exists to catch
         engine_kind = getattr(sched._schedule_fn, "engine_kind", None)
-        assert engine_kind == "sequential", (
-            "GangScheduler requires the sequential-commit engine; got "
-            f"{engine_kind!r} — the cross-gang required-affinity drop "
-            "guard is unsound under any other (or undeclared) commit order"
-        )
+        if engine_kind != "sequential":  # not assert: survives python -O
+            raise RuntimeError(
+                "GangScheduler requires the sequential-commit engine; got "
+                f"{engine_kind!r} — the cross-gang required-affinity drop "
+                "guard is unsound under any other (or undeclared) commit "
+                "order"
+            )
         enc = sched.cache.encoder
         with sched.cache._lock:
             # affinity state first: novel term topology keys must register
@@ -95,7 +97,12 @@ class GangScheduler:
             None, aff_state,
         )
         sched._last_index += len(pods)
-        return np.asarray(hosts)[: len(pods)]
+        # gang launches are synchronous by design (the all-or-nothing
+        # verdict gates the commit), but the fetch still goes through the
+        # instrumented fence so per-cycle sync budgets stay observable
+        from kubernetes_tpu.codec.transfer import host_fetch
+
+        return host_fetch(hosts, tag="gang")[: len(pods)]
 
     def schedule_gang(
         self, group: PodGroup, pods: Sequence[Pod]

@@ -33,9 +33,26 @@ class SchedulerCache:
         with self._lock:
             self.encoder.add_node(node)
 
+    def add_nodes(self, nodes) -> None:
+        """Batched node ingest: one lock acquisition + one columnar encoder
+        apply for a whole node list (informer initial list / failover
+        re-sync — the cold-start wall; see encoder.add_nodes)."""
+        if not nodes:
+            return
+        with self._lock:
+            self.encoder.add_nodes(nodes)
+
     def update_node(self, node: Node) -> None:
         with self._lock:
             self.encoder.update_node(node)
+
+    def update_nodes(self, nodes) -> None:
+        """Batched upsert (informer re-list): new nodes bulk-encode,
+        unchanged nodes are skipped, changed nodes re-encode per row."""
+        if not nodes:
+            return
+        with self._lock:
+            self.encoder.update_nodes(nodes)
 
     def remove_node(self, name: str) -> None:
         with self._lock:

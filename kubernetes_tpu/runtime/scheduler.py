@@ -43,6 +43,7 @@ from kubernetes_tpu.models.preemption import (
     sorted_victim_slots,
     verify_nomination,
 )
+from kubernetes_tpu.codec.transfer import AsyncFetch, host_fetch
 from kubernetes_tpu.ops.predicates import filter_batch, required_affinity_ok
 from kubernetes_tpu.runtime.cache import SchedulerCache
 from kubernetes_tpu.runtime.events import (
@@ -135,10 +136,14 @@ class ScheduleResult:
 @dataclass
 class _InFlight:
     """One dispatched-but-unfetched cycle: the double-buffer slot of the
-    pipelined commit path (hosts_dev is still computing on device)."""
+    pipelined commit path.  `fetch` is the FETCH-IN-FLIGHT half: an
+    AsyncFetch whose D2H copy was started the moment the winners buffer
+    was dispatched (codec/transfer.py), materializing on a worker thread
+    while the scheduling thread encodes/dispatches the next batch."""
 
     pods: List[Pod]
-    hosts_dev: object            # device i32[B], fetch blocks on compute
+    hosts_dev: object            # device i32[B] winners buffer
+    fetch: AsyncFetch            # in-flight D2H of hosts_dev
     generation: int
     cycle: int
     ext_failed: Dict[int, str]
@@ -246,13 +251,17 @@ class Scheduler:
         # double-buffer slot for pipeline_commit: at most one dispatched
         # batch whose host tail has not run yet
         self._in_flight: Optional[_InFlight] = None
-        # per-phase host seconds, cumulative (bench live-path reporting):
-        # encode (host tensors + snapshot), dispatch (async enqueue),
-        # fetch (device compute + D2H sync), commit (assume + bind +
-        # events + requeues), preempt
+        # per-phase seconds, cumulative (bench live-path reporting):
+        # pop (queue drain — under pipeline_commit this overlaps the
+        # previous batch's in-flight fetch), encode (host tensors +
+        # snapshot), dispatch (async enqueue), fetch (device compute +
+        # D2H, measured on the async-fetch worker — overlaps other
+        # phases), fetch_block (residual host stall at the ready-fence; a
+        # SUBSET of fetch, so phase sums must skip it), commit (assume +
+        # bind + events + requeues), preempt
         self.phase_seconds: Dict[str, float] = {
-            "encode": 0.0, "dispatch": 0.0, "fetch": 0.0,
-            "commit": 0.0, "preempt": 0.0,
+            "pop": 0.0, "encode": 0.0, "dispatch": 0.0, "fetch": 0.0,
+            "fetch_block": 0.0, "commit": 0.0, "preempt": 0.0,
         }
         self.results: List[ScheduleResult] = []
         # (preemptor key, node name, victim keys) per successful preemption
@@ -273,7 +282,20 @@ class Scheduler:
         inf = self._encode_and_dispatch(pods)
         if inf is None:
             return []
-        return self._commit_tail(self._commit_state(inf))
+        return self._commit_tail(self._commit_state_or_requeue(inf))
+
+    def _commit_state_or_requeue(self, inf: _InFlight) -> _Staged:
+        """_commit_state with the batch-loss guard: the ready-fence
+        re-raises device errors (AsyncFetch.result), and the batch's pods
+        were already popped from the queue — on failure requeue them ALL
+        (plain error requeue, the extender-error discipline) before
+        propagating, so a device fault degrades to a retry instead of the
+        batch staying Pending forever."""
+        try:
+            return self._commit_state(inf)
+        except BaseException:
+            self.queue.add_unschedulable_batch(inf.pods, inf.cycle)
+            raise
 
     def _encode_and_dispatch(self, pods: Sequence[Pod]) -> Optional[_InFlight]:
         """Encode the batch + snapshot under the cache lock, run the
@@ -374,18 +396,20 @@ class Scheduler:
             np.int32(self._last_index), nominated,
             extra_mask, extra_score, aff_state,
         )
-        if hasattr(hosts, "copy_to_host_async"):
-            # start the D2H copy as soon as the device finishes; the
-            # jax.block_until_ready boundary is the np.asarray in
-            # _commit_state
-            hosts.copy_to_host_async()
+        # async result path: only the compact winners buffer (i32[B] node
+        # rows) crosses the wire — the D2H copy is enqueued NOW and
+        # materializes on a worker thread, so the blocking fence in
+        # _commit_state is usually a no-op by the time the pipelined loop
+        # reaches it (batch k's fetch overlaps batch k's host tail and
+        # batch k+1's dispatch)
+        fetch = AsyncFetch(hosts)
         self._last_index += len(pods)
         trace.step("device")
         self.phase_seconds["dispatch"] += time.monotonic() - t_disp
         return _InFlight(
-            pods=list(pods), hosts_dev=hosts, generation=generation,
-            cycle=cycle, ext_failed=ext_failed, pc=pc, t_cycle0=t_cycle0,
-            trace=trace,
+            pods=list(pods), hosts_dev=hosts, fetch=fetch,
+            generation=generation, cycle=cycle, ext_failed=ext_failed,
+            pc=pc, t_cycle0=t_cycle0, trace=trace,
         )
 
     def _commit_state(self, inf: _InFlight) -> _Staged:
@@ -397,9 +421,17 @@ class Scheduler:
         the classic loop."""
         pods = inf.pods
         t_fetch0 = time.monotonic()
-        hosts = np.asarray(inf.hosts_dev)  # blocks: device compute + D2H
+        hosts = inf.fetch.result()  # ready-fence: blocks only if the async
+        #                             D2H copy hasn't landed yet
         t_state0 = time.monotonic()
-        self.phase_seconds["fetch"] += t_state0 - t_fetch0
+        # "fetch" records the ASYNC window (dispatch -> copy-complete,
+        # measured on the fetch worker): it overlaps the dispatch/commit
+        # host phases, so sum-of-phases exceeding wall clock is the
+        # overlap working, not double counting.  "fetch_block" is the
+        # residual host stall at the fence — the number the async path
+        # exists to drive to ~0.
+        self.phase_seconds["fetch"] += inf.fetch.seconds
+        self.phase_seconds["fetch_block"] += t_state0 - t_fetch0
         inf.trace.step("fetch")
         # algorithm latency: encode + device filter/score/select, amortized
         # per pod (metrics.go SchedulingAlgorithmLatency)
@@ -571,14 +603,20 @@ class Scheduler:
         bind_dts: List[float] = []
         bound: List[Tuple[int, Pod, str]] = []
         bound_qts: List[Optional[float]] = []
+        bound_ts: List[float] = []   # per-pod bind-commit stamp: e2e must
+        #                              end at THIS pod's bind, not the
+        #                              whole fan-out's end (the per-pod
+        #                              loop stamps each pod individually)
         n_bind_failed = 0
         for w, (i, pod, assumed, node_name) in enumerate(staged.winners):
             t0b = time.monotonic()
             ok = self._invoke_binder(pod, assumed, node_name)
-            bind_dts.append(time.monotonic() - t0b)
+            tb = time.monotonic()
+            bind_dts.append(tb - t0b)
             if ok:
                 bound.append((i, pod, node_name))
                 bound_qts.append(winner_qts[w])
+                bound_ts.append(tb)
                 results[i] = ScheduleResult(pod, node_name, generation)
                 events[i] = (
                     "Pod", pod.namespace, pod.name,
@@ -613,15 +651,14 @@ class Scheduler:
             )
         if bound:
             m.SCHEDULE_ATTEMPTS.inc(len(bound), result=m.SCHEDULED)
-            now = time.monotonic()
-            fallback = staged.algo_dt + (now - staged.t_state0)
-            m.E2E_LATENCY.observe_batch(
-                [now - qt if qt is not None else fallback
-                 for qt in bound_qts]
-            )
+            e2es = [
+                tb - qt if qt is not None
+                else staged.algo_dt + (tb - staged.t_state0)
+                for qt, tb in zip(bound_qts, bound_ts)
+            ]
+            m.E2E_LATENCY.observe_batch(e2es)
             if klog.V(2).enabled:
-                for (_, pod, node_name), qt in zip(bound, bound_qts):
-                    e2e = now - qt if qt is not None else fallback
+                for (_, pod, node_name), e2e in zip(bound, e2es):
                     klog.V(2).infof(
                         "scheduled %s/%s to %s (%.1fms e2e)",
                         pod.namespace, pod.name, node_name, e2e * 1000,
@@ -912,7 +949,9 @@ class Scheduler:
         cluster = self._dev_snapshot.update(cluster, dirty_rows=dirty_rows)
         if jax.default_backend() != "cpu":
             batch = jax.device_put(batch)
-        cands = np.asarray(self._preempt_eval(cluster, batch))[0].copy()
+        cands = host_fetch(
+            self._preempt_eval(cluster, batch), tag="preempt"
+        )[0].copy()
         if not cands.any():
             # nodesWherePreemptionMightHelp came back empty: clear any
             # previous nomination (generic_scheduler.go:328-333)
@@ -1085,7 +1124,7 @@ class Scheduler:
         inf, self._in_flight = self._in_flight, None
         if inf is None:
             return 0
-        results = self._commit_tail(self._commit_state(inf))
+        results = self._commit_tail(self._commit_state_or_requeue(inf))
         return sum(1 for r in results if r.node is not None)
 
     def _run_pipelined(self, pods: Sequence[Pod]) -> int:
@@ -1096,11 +1135,24 @@ class Scheduler:
         fetch->dispatch gap (assume + encode), and the per-pod Python tail
         (binds, events, metrics, preemption) hides behind device compute."""
         prev, self._in_flight = self._in_flight, None
-        staged = self._commit_state(prev) if prev is not None else None
         n = 0
+        staged = None
+        dispatched = False
         try:
+            staged = (
+                self._commit_state_or_requeue(prev)
+                if prev is not None else None
+            )
             self._in_flight = self._encode_and_dispatch(pods)
+            dispatched = True
         finally:
+            if not dispatched:
+                # batch k+1 was popped but never reached the device
+                # (batch k's ready-fence raised, or the dispatch itself
+                # did): requeue it — popped pods must never be lost
+                self.queue.add_unschedulable_batch(
+                    list(pods), self.queue.scheduling_cycle
+                )
             # batch k's tail MUST run even if batch k+1's dispatch raises:
             # its losers were already popped from the queue (the requeue
             # happens in the tail) and its winners sit assumed-but-unbound
@@ -1117,6 +1169,7 @@ class Scheduler:
         dispatches this batch and returns the PREVIOUS batch's placements
         (flush_pipeline drains the last one); gang cycles and empty polls
         drain the pipeline first so snapshots never go stale."""
+        t_pop = time.monotonic()
         pods = self.queue.pop_batch(
             self.config.batch_size,
             # with a batch in flight, don't block in the pop: its binds/
@@ -1125,6 +1178,7 @@ class Scheduler:
             0.0 if self.pipeline_pending else timeout,
             self.config.batch_window_s,
         )
+        self.phase_seconds["pop"] += time.monotonic() - t_pop
         if not pods:
             # idle poll: drain any in-flight batch so binds/events/requeues
             # don't wait for the next arrival

@@ -1,0 +1,280 @@
+"""Bulk columnar node ingest: add_nodes/update_nodes equivalence.
+
+The acceptance bar for the bulk-ingest rebuild (ISSUE 2): add_nodes(batch)
+produces byte-identical arena state — including interner id order, the
+topology-pair vocabulary, port maps, volume columns, and dirty-row sets —
+vs. the per-node add_node loop on a mixed node set (taints, extended
+resources, topology labels, unschedulable, conditions, multi-name images,
+prefer-avoid annotations, attachable-volume limits), through pad-dim
+growth and row recycling.
+"""
+
+import dataclasses
+
+import numpy as np
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.codec.schema import PadDims
+
+from fixtures import TEST_DIMS, ZONE_KEY, REGION_KEY, make_node, make_pod
+
+
+def _mixed_nodes(n=12, prefix="n"):
+    """A node set exercising every column family _write_node_row touches."""
+    nodes = []
+    for i in range(n):
+        labels = {ZONE_KEY: f"zone-{i % 3}", "tier": "a" if i % 2 else "b"}
+        if i % 3 == 0:
+            labels[REGION_KEY] = f"region-{i % 2}"
+        if i % 4 == 0:
+            labels["rank"] = str(i)  # numeric label value (Gt/Lt column)
+        taints = []
+        if i % 3 == 1:
+            taints.append({"key": "dedicated", "value": f"team-{i % 2}",
+                           "effect": "NoSchedule"})
+        if i % 5 == 2:
+            taints.append({"key": "gpu", "value": "", "effect": "NoExecute"})
+        images = []
+        if i % 2 == 0:
+            images.append({
+                # multiple names for ONE image: every name is a lookup key
+                "names": [f"registry/app:{i}", f"registry/app@sha-{i}"],
+                "sizeBytes": 100_000_000 + i,
+            })
+        extra = {}
+        if i % 4 == 1:
+            extra["example.com/gpu"] = "4"  # extended resource column
+        if i % 4 == 2:
+            extra["attachable-volumes-aws-ebs"] = "25"
+            extra["attachable-volumes-csi-dr.example.com"] = "8"
+        if i % 6 == 5:
+            extra[""] = "7"  # malformed empty key: must not crash either path
+        ann = None
+        if i % 6 == 3:
+            ann = {
+                "scheduler.alpha.kubernetes.io/preferAvoidPods":
+                '{"preferAvoidPods": [{"podSignature": {"podController":'
+                ' {"uid": "uid-%d"}}}]}' % i
+            }
+        nodes.append(make_node(
+            f"{prefix}{i}", cpu=f"{4 + i % 3}", mem="16Gi", pods=50,
+            labels=labels, taints=taints, images=images,
+            unschedulable=(i % 5 == 4),
+            conditions=[{"type": "Ready", "status": "True"}]
+            if i % 7 else [{"type": "Ready", "status": "False"}],
+            annotations=ann, allocatable_extra=extra,
+        ))
+    return nodes
+
+
+def _arena_fields(enc):
+    return {a: getattr(enc, a) for a in dir(enc) if a.startswith("a_")}
+
+
+def assert_encoders_identical(e1, e2, msg=""):
+    """Byte-identical observable encoder state: arenas, vocabularies,
+    bookkeeping maps, dirty sets, generation."""
+    # interner id ORDER, not just content
+    assert e1.interner._strs == e2.interner._strs, msg + "interner order"
+    # pair vocabulary order + per-key pair columns
+    assert e1._pair_vocab == e2._pair_vocab, msg + "pair vocab"
+    assert e1._pair_topo_key == e2._pair_topo_key, msg + "pair topo keys"
+    assert e1._res_cols == e2._res_cols, msg + "resource columns"
+    assert e1._vol_cols == e2._vol_cols, msg + "volume columns"
+    assert e1.dims == e2.dims, msg + "dims"
+    assert e1.node_rows == e2.node_rows, msg + "node rows"
+    assert e1._free_rows == e2._free_rows, msg + "free rows"
+    assert e1._next_row == e2._next_row, msg + "next row"
+    assert e1._image_nodes == e2._image_nodes, msg + "image nodes"
+    assert e1._node_ports == e2._node_ports, msg + "port maps"
+    assert e1._node_disk_vols == e2._node_disk_vols, msg + "disk vol maps"
+    assert e1.generation == e2.generation, msg + "generation"
+    # dirty-row bookkeeping (the transfer handshake)
+    assert e1._dirty_node_rows == e2._dirty_node_rows, msg + "dirty nodes"
+    assert e1._snap_dirty_all == e2._snap_dirty_all, msg + "dirty-all flag"
+    a1, a2 = _arena_fields(e1), _arena_fields(e2)
+    assert a1.keys() == a2.keys()
+    for name, arr in a1.items():
+        np.testing.assert_array_equal(
+            arr, a2[name], err_msg=f"{msg}arena {name}"
+        )
+    for kid, col in e1._node_pair_id.items():
+        np.testing.assert_array_equal(
+            col, e2._node_pair_id[kid], err_msg=f"{msg}pair col {kid}"
+        )
+
+
+def test_add_nodes_matches_sequential_add_node():
+    encs = [SnapshotEncoder(TEST_DIMS), SnapshotEncoder(TEST_DIMS)]
+    nodes = _mixed_nodes()
+    for n in nodes:
+        encs[0].add_node(n)
+    rows = encs[1].add_nodes(nodes)
+    assert rows == [encs[0].node_rows[n.name] for n in nodes]
+    assert_encoders_identical(encs[0], encs[1])
+
+
+def test_add_nodes_matches_through_arena_growth():
+    """A batch larger than the node capacity (N growth) with a node whose
+    labels/taints/images exceed the pad dims (L/T/I growth)."""
+    dims = PadDims(N=4, B=4, TP=16, L=4, T=2, I=2)
+    encs = [SnapshotEncoder(dims), SnapshotEncoder(dims)]
+    nodes = _mixed_nodes(11, prefix="g")
+    # one node that forces every pad axis to grow
+    many_labels = {f"k{j}": f"v{j}" for j in range(7)}
+    many_labels[ZONE_KEY] = "zone-x"
+    # a many-NAMES node placed before the I-bumping node: its row truncates
+    # at the pre-bump width in the sequential loop (I bumps off the image
+    # COUNT, not the flattened name count) and the batch must replay that
+    nodes.insert(2, make_node(
+        "g-trunc", cpu="4", mem="8Gi",
+        images=[{"names": [f"alias-{j}" for j in range(4)],
+                 "sizeBytes": 777}],
+    ))
+    nodes.insert(5, make_node(
+        "g-wide", cpu="8", mem="32Gi", labels=many_labels,
+        taints=[{"key": f"t{j}", "value": "x", "effect": "NoSchedule"}
+                for j in range(4)],
+        images=[{"names": [f"img-{j}:latest"], "sizeBytes": 1000 + j}
+                for j in range(5)],
+    ))
+    for n in nodes:
+        encs[0].add_node(n)
+    encs[1].add_nodes(nodes)
+    assert_encoders_identical(encs[0], encs[1])
+
+
+def test_add_nodes_matches_with_recycled_rows():
+    """Rows freed by remove_node must come back byte-identical whether the
+    re-adds go through the loop or the batch (stale label/taint content on
+    recycled rows must be overwritten either way)."""
+    encs = [SnapshotEncoder(TEST_DIMS), SnapshotEncoder(TEST_DIMS)]
+    first = _mixed_nodes(6, prefix="old")
+    for enc in encs:
+        enc.add_nodes(first) if enc is encs[1] else [
+            enc.add_node(n) for n in first
+        ]
+        enc.remove_node("old2")
+        enc.remove_node("old4")
+    fresh = _mixed_nodes(4, prefix="new")
+    for n in fresh:
+        encs[0].add_node(n)
+    encs[1].add_nodes(fresh)
+    assert_encoders_identical(encs[0], encs[1])
+
+
+def test_add_nodes_falls_back_for_duplicates_and_updates():
+    """Duplicate names in one batch, and names already resident, must take
+    the per-node (update) path and still match the loop."""
+    encs = [SnapshotEncoder(TEST_DIMS), SnapshotEncoder(TEST_DIMS)]
+    for enc in encs:
+        enc.add_node(make_node("resident", cpu="4", mem="8Gi"))
+    batch = [
+        make_node("resident", cpu="8", mem="16Gi"),  # update
+        make_node("dup", cpu="2", mem="4Gi", labels={ZONE_KEY: "z-a"}),
+        make_node("dup", cpu="6", mem="12Gi", labels={ZONE_KEY: "z-b"}),
+    ]
+    for n in batch:
+        encs[0].add_node(n)
+    encs[1].add_nodes(batch)
+    assert_encoders_identical(encs[0], encs[1])
+
+
+def test_add_nodes_snapshot_and_dirty_rows_flow():
+    """The bulk path must feed the incremental snapshot/transfer handshake
+    exactly like the loop: same snapshot bytes, same take_dirty_rows."""
+    encs = [SnapshotEncoder(TEST_DIMS), SnapshotEncoder(TEST_DIMS)]
+    seed = _mixed_nodes(4, prefix="s")
+    for enc in encs:
+        for n in seed:
+            enc.add_node(n)
+        enc.snapshot()
+        enc.take_dirty_rows()
+    extra = _mixed_nodes(3, prefix="x")
+    for n in extra:
+        encs[0].add_node(n)
+    encs[1].add_nodes(extra)
+    s0 = encs[0].snapshot()
+    s1 = encs[1].snapshot()
+    for f in dataclasses.fields(s0):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s0, f.name)), np.asarray(getattr(s1, f.name)),
+            err_msg=f"snapshot field {f.name}",
+        )
+    d0, d1 = encs[0].take_dirty_rows(), encs[1].take_dirty_rows()
+    if d0 is None or d1 is None:
+        assert d0 is None and d1 is None
+    else:
+        np.testing.assert_array_equal(d0, d1)
+
+
+def test_add_nodes_with_resident_pods_on_other_rows():
+    """Bulk adds must not disturb pod aggregates already charged to other
+    rows (the cold-resync case interleaves with a live cluster)."""
+    encs = [SnapshotEncoder(TEST_DIMS), SnapshotEncoder(TEST_DIMS)]
+    for enc in encs:
+        enc.add_node(make_node("host0", cpu="8", mem="16Gi",
+                               labels={ZONE_KEY: "z-0"}))
+        enc.add_pod(make_pod("p0", cpu="250m", mem="128Mi",
+                             node_name="host0",
+                             ports=[{"hostPort": 8080, "protocol": "TCP"}]))
+    more = _mixed_nodes(5, prefix="m")
+    for n in more:
+        encs[0].add_node(n)
+    encs[1].add_nodes(more)
+    assert_encoders_identical(encs[0], encs[1])
+    row = encs[1].node_rows["host0"]
+    assert encs[1].a_requested[row, 0] == 250.0
+
+
+# --------------------------------------------------------------- update_nodes
+
+
+def test_update_nodes_mixed_new_changed_unchanged():
+    """update_nodes must leave the same snapshot bytes as the per-node
+    upsert loop on an interleaved new/changed/unchanged list (unchanged
+    nodes are skipped, which elides their generation bumps — a documented
+    difference, so only content is compared)."""
+    base = _mixed_nodes(6, prefix="u")
+    e_loop, e_bulk = SnapshotEncoder(TEST_DIMS), SnapshotEncoder(TEST_DIMS)
+    for enc in (e_loop, e_bulk):
+        for n in _mixed_nodes(6, prefix="u"):
+            enc.add_node(n)
+    changed = make_node("u3", cpu="16", mem="64Gi",
+                        labels={ZONE_KEY: "zone-moved"})
+    new = make_node("u-new", cpu="2", mem="4Gi",
+                    labels={ZONE_KEY: "zone-1"})
+    unchanged = _mixed_nodes(6, prefix="u")[1]  # content-equal rebuild of u1
+    batch = [unchanged, changed, new]
+    for n in batch:
+        if n.name in e_loop.node_rows:
+            e_loop.update_node(n)
+        else:
+            e_loop.add_node(n)
+    rows = e_bulk.update_nodes(batch)
+    assert rows == [e_loop.node_rows[n.name] for n in batch]
+    s0 = e_loop.snapshot(full=True)
+    s1 = e_bulk.snapshot(full=True)
+    for f in dataclasses.fields(s0):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s0, f.name)), np.asarray(getattr(s1, f.name)),
+            err_msg=f"snapshot field {f.name}",
+        )
+    assert base[1].name == "u1"  # the unchanged probe really was resident
+
+
+def test_update_nodes_unchanged_skip_is_free():
+    """Re-listing identical nodes must not dirty rows or bump generation —
+    the warm re-encode fast path."""
+    enc = SnapshotEncoder(TEST_DIMS)
+    nodes = _mixed_nodes(8, prefix="w")
+    enc.add_nodes(nodes)
+    enc.snapshot()
+    enc.take_dirty_rows()
+    gen = enc.generation
+    relisted = _mixed_nodes(8, prefix="w")  # fresh equal objects
+    rows = enc.update_nodes(relisted)
+    assert rows == [enc.node_rows[n.name] for n in relisted]
+    assert enc.generation == gen
+    dirty = enc.take_dirty_rows()
+    assert dirty is not None and len(dirty) == 0

@@ -1,0 +1,208 @@
+"""Host-sync regression guard: the async D2H result pipeline must perform
+at most ONE blocking device sync per scheduling cycle.
+
+Every runtime device->host materialization goes through the instrumented
+fence helpers in codec/transfer.py (host_fetch / AsyncFetch.result), which
+report each sync that actually blocks the calling thread.  These tests pin
+the per-cycle blocking-sync budget so per-pod fetches — the wall the async
+fetch path removed — can't silently come back.
+"""
+
+import time
+
+import numpy as np
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.codec import transfer
+from kubernetes_tpu.runtime import (
+    PriorityQueue,
+    Scheduler,
+    SchedulerCache,
+    SchedulerConfig,
+)
+
+from fixtures import TEST_DIMS, ZONE_KEY, make_node, make_pod
+
+
+def _mk_scheduler(engine="sequential", pipeline=False):
+    cache = SchedulerCache(SnapshotEncoder(TEST_DIMS))
+    cache.add_nodes([
+        make_node(f"n{i}", cpu="8", mem="16Gi", pods=40,
+                  labels={ZONE_KEY: f"z-{i % 2}"})
+        for i in range(8)
+    ])
+    return Scheduler(
+        cache=cache,
+        queue=PriorityQueue(),
+        binder=lambda pod, node: True,
+        config=SchedulerConfig(
+            batch_size=8, engine=engine, disable_preemption=True,
+            batched_commit=True, pipeline_commit=pipeline,
+        ),
+    )
+
+
+class _SyncCounter:
+    def __init__(self):
+        self.tags = []
+        self._remove = transfer.on_blocking_sync(self.tags.append)
+
+    def take(self):
+        got, self.tags = self.tags, []
+        return got
+
+    def close(self):
+        self._remove()
+
+
+def test_schedule_cycle_blocks_at_most_once():
+    """Synchronous cycles: exactly the winners-buffer fence may block —
+    never one sync per pod."""
+    counter = _SyncCounter()
+    try:
+        sched = _mk_scheduler()
+        for wave in range(4):
+            pods = [make_pod(f"w{wave}-p{i}", cpu="100m", mem="64Mi")
+                    for i in range(6)]
+            counter.take()
+            results = sched.schedule_cycle(pods)
+            assert all(r.node is not None for r in results)
+            blocked = counter.take()
+            assert len(blocked) <= 1, (
+                f"cycle {wave} performed {len(blocked)} blocking syncs "
+                f"({blocked}); the async-fetch path allows at most one"
+            )
+    finally:
+        counter.close()
+
+
+def test_pipelined_run_blocks_at_most_once_per_cycle():
+    """Double-buffered cycles keep the same budget: each run_once may pay
+    at most one blocking fence (for whichever batch it lands)."""
+    counter = _SyncCounter()
+    try:
+        sched = _mk_scheduler(pipeline=True)
+        cycles = 0
+        for wave in range(5):
+            for i in range(6):
+                sched.queue.add(
+                    make_pod(f"v{wave}-p{i}", cpu="100m", mem="64Mi")
+                )
+            counter.take()
+            sched.run_once(timeout=0.05)
+            cycles += 1
+            assert len(counter.take()) <= 1
+        counter.take()
+        sched.flush_pipeline()
+        assert len(counter.take()) <= 1
+    finally:
+        counter.close()
+
+
+def test_async_fetch_overlaps_and_reports_window():
+    """AsyncFetch materializes off-thread: ready() flips without the
+    caller syncing, result() returns the host values, and a result() call
+    after the copy landed reports NO blocking sync."""
+    import jax.numpy as jnp
+
+    counter = _SyncCounter()
+    try:
+        dev = jnp.arange(16, dtype=jnp.int32) * 3
+        fetch = transfer.AsyncFetch(dev)
+        got = fetch.result()
+        np.testing.assert_array_equal(got, np.arange(16, dtype=np.int32) * 3)
+        assert fetch.ready()
+        assert fetch.seconds >= 0.0
+        first = counter.take()
+        assert len(first) <= 1  # the join may or may not have blocked
+        # the copy has landed: a second fence is free
+        fetch.result()
+        assert counter.take() == []
+        # give the worker a moment on slow machines before ready() probes
+        deadline = time.monotonic() + 5.0
+        f2 = transfer.AsyncFetch(jnp.zeros(4))
+        while not f2.ready() and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert f2.ready()
+        f2.result()
+        assert counter.take() == []  # already landed: no blocking sync
+    finally:
+        counter.close()
+
+
+def test_device_failure_requeues_inflight_batch():
+    """A device error surfaces at the ready-fence (AsyncFetch.result
+    re-raises).  The in-flight batch's pods were already popped from the
+    queue — they must be requeued, not silently lost."""
+    import pytest
+
+    sched = _mk_scheduler(pipeline=True)
+    pods = [make_pod(f"dead-{i}", cpu="100m", mem="64Mi") for i in range(4)]
+    for p in pods:
+        sched.queue.add(p)
+    sched.run_once(timeout=0.05)
+    assert sched.pipeline_pending
+
+    class _Boom:
+        seconds = 0.0
+
+        def result(self):
+            raise RuntimeError("RESOURCE_EXHAUSTED: device fell over")
+
+    sched._in_flight.fetch = _Boom()
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        sched.flush_pipeline()
+    assert not sched.pipeline_pending
+    q = sched.queue
+    parked = (
+        set(q._unschedulable) | set(q._active_entry) | set(q._backoff_entry)
+    )
+    for p in pods:
+        assert (p.namespace, p.name) in parked, f"{p.name} lost"
+
+
+def test_device_failure_requeues_next_batch_too():
+    """When batch k's ready-fence raises inside the pipelined loop, the
+    ALREADY-POPPED batch k+1 (which never reached the device) must also
+    be requeued — neither batch may be lost."""
+    import pytest
+
+    sched = _mk_scheduler(pipeline=True)
+    wave_a = [make_pod(f"a-{i}", cpu="100m", mem="64Mi") for i in range(4)]
+    for p in wave_a:
+        sched.queue.add(p)
+    sched.run_once(timeout=0.05)  # dispatches wave A, in flight
+    assert sched.pipeline_pending
+
+    class _Boom:
+        seconds = 0.0
+
+        def result(self):
+            raise RuntimeError("RESOURCE_EXHAUSTED: device fell over")
+
+    sched._in_flight.fetch = _Boom()
+    wave_b = [make_pod(f"b-{i}", cpu="100m", mem="64Mi") for i in range(4)]
+    for p in wave_b:
+        sched.queue.add(p)
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        sched.run_once(timeout=0.05)  # pops wave B, fence on A raises
+    q = sched.queue
+    parked = (
+        set(q._unschedulable) | set(q._active_entry) | set(q._backoff_entry)
+    )
+    for p in wave_a + wave_b:
+        assert (p.namespace, p.name) in parked, f"{p.name} lost"
+
+
+def test_host_fetch_counts_every_call():
+    """host_fetch is the canonical blocking sync point: every call is
+    reported (it cannot know the copy already landed)."""
+    import jax.numpy as jnp
+
+    counter = _SyncCounter()
+    try:
+        out = transfer.host_fetch(jnp.ones(8), tag="probe")
+        np.testing.assert_array_equal(out, np.ones(8))
+        assert counter.take() == ["probe"]
+    finally:
+        counter.close()

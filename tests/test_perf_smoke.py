@@ -1,0 +1,129 @@
+"""Perf smoke tier (`pytest -m perf_smoke`): CPU-only, <30s.
+
+A 500-node/1k-pod burst through the LIVE Scheduler (queue -> pop_batch ->
+schedule_cycle -> assume/bind, batched+pipelined commit) must clear a
+conservative pods/s floor, and the bulk node ingest must beat the per-node
+loop.  The floors are ~10x under the measured CPU numbers (3,700 pods/s
+live, ~3x bulk-encode speedup at 5k nodes), so only a structural
+regression — a per-pod fetch sneaking back, a per-node O(N) term in the
+encoder, a lost jit cache — trips them, not machine noise.
+
+The tests carry the `perf_smoke` marker but NOT `slow`, so the tier-1
+command (-m 'not slow') runs them on every verify.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.factory import make_node, make_pod
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.runtime import (
+    PriorityQueue,
+    Scheduler,
+    SchedulerCache,
+    SchedulerConfig,
+)
+
+ZONE = "failure-domain.beta.kubernetes.io/zone"
+
+N_NODES = 500
+N_PODS = 1000
+BATCH = 256
+# enforced floors: the reference harness enforces 30 pods/s
+# (scheduler_test.go:34-38); the live CPU path measures ~3,700 at this
+# shape, so 150 only trips on structural regressions
+PODS_PER_S_FLOOR = 150.0
+
+
+def _nodes(n=N_NODES):
+    return [
+        make_node(
+            f"node-{i}", cpu="16", mem="64Gi", pods=80,
+            labels={ZONE: f"z-{i % 4}", "tier": "a" if i % 3 else "b"},
+        )
+        for i in range(n)
+    ]
+
+
+@pytest.mark.perf_smoke
+def test_live_scheduler_500_nodes_1k_pods_throughput():
+    enc = SnapshotEncoder()
+    enc.add_nodes(_nodes())
+    cache = SchedulerCache(enc)
+    queue = PriorityQueue()
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=lambda pod, node: True,
+        config=SchedulerConfig(
+            batch_size=BATCH, batch_window_s=0.0, engine="speculative",
+            disable_preemption=True, batched_commit=True,
+            pipeline_commit=True,
+        ),
+    )
+
+    def drain(budget_s):
+        placed = 0
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            got = sched.run_once(timeout=0.0)
+            placed += got
+            if got == 0 and not sched.pipeline_pending:
+                if not queue.has_schedulable():
+                    break
+                time.sleep(0.002)
+        return placed + sched.flush_pipeline()
+
+    # warmup: one full-width batch pays the jit compile outside the
+    # measured window
+    for j in range(BATCH):
+        queue.add(make_pod(f"warm-{j}", cpu="50m", mem="64Mi",
+                           labels={"app": "w"}))
+    drain(120)
+
+    pending = [
+        make_pod(f"p-{i}", cpu="50m", mem="64Mi",
+                 labels={"app": f"d-{i % 10}"})
+        for i in range(N_PODS)
+    ]
+    for k in sched.phase_seconds:
+        sched.phase_seconds[k] = 0.0
+    t0 = time.monotonic()
+    for p in pending:
+        queue.add(p)
+    placed = drain(120)
+    dt = time.monotonic() - t0
+
+    assert placed == N_PODS, f"only {placed}/{N_PODS} pods placed"
+    pods_per_s = placed / dt
+    assert pods_per_s >= PODS_PER_S_FLOOR, (
+        f"live path at {pods_per_s:.0f} pods/s, floor {PODS_PER_S_FLOOR}; "
+        f"phases={sched.phase_seconds}"
+    )
+
+
+@pytest.mark.perf_smoke
+def test_bulk_node_ingest_beats_perpod_loop():
+    """The columnar ingest must stay faster than the per-node loop (the
+    ISSUE 2 acceptance is >=3x at 5k nodes; this smoke floor is a lax
+    1.5x at 500 so scheduler-class machines never false-positive)."""
+    nodes = _nodes()
+    best_bulk = min(
+        _timed(lambda: SnapshotEncoder().add_nodes(nodes)) for _ in range(3)
+    )
+
+    def loop():
+        enc = SnapshotEncoder()
+        for n in nodes:
+            enc.add_node(n)
+
+    best_loop = min(_timed(loop) for _ in range(3))
+    assert best_bulk < best_loop / 1.5, (
+        f"bulk {best_bulk * 1000:.1f}ms vs loop {best_loop * 1000:.1f}ms "
+        f"({best_loop / best_bulk:.2f}x): bulk ingest lost its edge"
+    )
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
