@@ -1,0 +1,256 @@
+"""Device-fault model: classified errors + a deterministic injection seam.
+
+The reference treats fault tolerance as a first-class harness (the
+chaosmonkey in test/e2e/chaosmonkey + the disruptive e2e suites), but its
+faults all live at the CLUSTER layer — pods die, nodes go dark, leaders
+crash.  A TPU control plane has a second failure domain the reference never
+had: the accelerator itself.  A tunnel-attached device can time out, come
+back garbled, slow to a crawl, or vanish ("device lost"), and each of those
+deserves a different response from the scheduling loop:
+
+  transient   retry the SAME in-flight batch with jittered backoff — the
+              XLA runtime error family that clears on its own
+              (RESOURCE_EXHAUSTED, DEADLINE_EXCEEDED, UNAVAILABLE, ...)
+  persistent  stop using the device NOW (trip the breaker) and serve
+              cycles from the CPU reference engine — "device lost",
+              DATA_LOSS, INTERNAL
+  corrupt     a fetch that *returned* but fails structural validation
+              (winner rows out of range); treated as transient — re-run
+  slow        not an error: injected latency, exercises the overlap math
+
+This module owns (a) the classified exception types, (b) the mapping from
+real JAX/XLA runtime errors to a fault class, and (c) `FaultInjector` — a
+seeded, deterministic injector the chaos harness (runtime/chaos.py
+Disruptions) arms per SITE:
+
+  dispatch         engine launch in Scheduler._encode_and_dispatch
+  fence            the ready-fence (AsyncFetch.result / ready_fence)
+  fetch            D2H materialization (host_fetch / the fetch worker)
+  snapshot_update  DeviceSnapshotCache.update (H2D delta upload)
+
+Injection is OFF unless an injector is installed (`install_injector`); the
+instrumented code calls `check(site)` / `corrupt(site, arr)` which are
+no-ops otherwise, so the hot path pays one module-global load per site.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+# fault classes (the breaker's retry-policy vocabulary + metrics label)
+FAULT_TRANSIENT = "transient"
+FAULT_PERSISTENT = "persistent"
+FAULT_CORRUPT = "corrupt"
+FAULT_SLOW = "slow"
+
+# injection sites (the seams instrumented in codec/transfer.py and
+# runtime/scheduler.py)
+SITE_DISPATCH = "dispatch"
+SITE_FENCE = "fence"
+SITE_FETCH = "fetch"
+SITE_SNAPSHOT_UPDATE = "snapshot_update"
+SITES = (SITE_DISPATCH, SITE_FENCE, SITE_FETCH, SITE_SNAPSHOT_UPDATE)
+
+
+class DeviceFault(RuntimeError):
+    """Base for classified device-path failures (injected or mapped from
+    real runtime errors).  `fault_class` drives the retry/breaker policy."""
+
+    fault_class = FAULT_TRANSIENT
+
+
+class TransientDeviceError(DeviceFault):
+    """Clears on its own: retry the same batch with backoff."""
+
+    fault_class = FAULT_TRANSIENT
+
+
+class PersistentDeviceError(DeviceFault):
+    """Device lost: trip the breaker, degrade to the CPU engine."""
+
+    fault_class = FAULT_PERSISTENT
+
+
+class CorruptedFetchError(DeviceFault):
+    """A fetch returned structurally-invalid data (winner rows out of
+    range).  Retried like a transient fault — the wire, not the program."""
+
+    fault_class = FAULT_TRANSIENT
+
+
+# XLA status substrings -> fault class.  jaxlib surfaces device errors as
+# XlaRuntimeError (a RuntimeError subclass) whose message leads with the
+# absl status code; the split below mirrors how large control planes
+# (PAPERS.md Borg/Omega lineage) bucket infra errors: codes that clear on
+# retry vs codes that mean the backend is gone.
+_PERSISTENT_MARKERS = (
+    "device lost",
+    "DATA_LOSS",
+    "INTERNAL:",
+    "FAILED_PRECONDITION",
+    "device halted",
+)
+_TRANSIENT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+    "ABORTED",
+    "CANCELLED",
+)
+
+
+def classify_device_error(err: BaseException) -> Optional[str]:
+    """Map an exception raised on the device path to a fault class, or None
+    when it is NOT a device fault (a programming error must propagate, not
+    be retried into oblivion)."""
+    if isinstance(err, DeviceFault):
+        return err.fault_class
+    # real XLA runtime errors: XlaRuntimeError subclasses RuntimeError; the
+    # name check keeps this import-free (jaxlib's module path moves between
+    # releases)
+    if isinstance(err, RuntimeError):
+        msg = str(err)
+        for marker in _PERSISTENT_MARKERS:
+            if marker in msg:
+                return FAULT_PERSISTENT
+        for marker in _TRANSIENT_MARKERS:
+            if marker in msg:
+                return FAULT_TRANSIENT
+        if type(err).__name__ == "XlaRuntimeError":
+            # unknown runtime status from the device: worth one retry round
+            return FAULT_TRANSIENT
+    return None
+
+
+@dataclass
+class _Arm:
+    kind: str
+    p: float
+    count: Optional[int]        # max fires; None = unlimited
+    latency_s: float
+    fired: int = 0
+
+
+class FaultInjector:
+    """Seeded, deterministic per-site fault injection.
+
+    arm(site, kind, ...) arms one site with one fault kind; `count` bounds
+    how many times it fires (the deterministic lever the fault-matrix
+    tests use: count=1 == "exactly the first call faults"), `p` makes it
+    probabilistic from the injector's own seeded rng.  `log` records every
+    fire as (site, kind) for assertions."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+        self._arms: dict = {}
+        self.log: list = []
+
+    def arm(
+        self,
+        site: str,
+        kind: str = FAULT_TRANSIENT,
+        p: float = 1.0,
+        count: Optional[int] = None,
+        latency_s: float = 0.01,
+    ) -> "FaultInjector":
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r} (sites: {SITES})")
+        if kind not in (FAULT_TRANSIENT, FAULT_PERSISTENT, FAULT_CORRUPT,
+                        FAULT_SLOW):
+            raise ValueError(f"unknown fault kind {kind!r}")
+        self._arms[site] = _Arm(kind=kind, p=p, count=count,
+                                latency_s=latency_s)
+        return self
+
+    def disarm(self, site: Optional[str] = None) -> None:
+        if site is None:
+            self._arms.clear()
+        else:
+            self._arms.pop(site, None)
+
+    def _should_fire(self, a: _Arm) -> bool:
+        if a.count is not None and a.fired >= a.count:
+            return False
+        if a.p < 1.0 and self._rng.random() >= a.p:
+            return False
+        return True
+
+    def fire(self, site: str) -> None:
+        """Raise/sleep per the site's armed fault; corrupt-kind arms are
+        handled by maybe_corrupt (they alter data, not control flow)."""
+        a = self._arms.get(site)
+        if a is None or a.kind == FAULT_CORRUPT or not self._should_fire(a):
+            return
+        a.fired += 1
+        self.log.append((site, a.kind))
+        if a.kind == FAULT_SLOW:
+            time.sleep(a.latency_s)
+            return
+        if a.kind == FAULT_PERSISTENT:
+            raise PersistentDeviceError(
+                f"injected device-lost at {site} (fire #{a.fired})"
+            )
+        raise TransientDeviceError(
+            f"injected transient XLA error at {site} (fire #{a.fired}): "
+            "UNAVAILABLE: fabric tunnel reset"
+        )
+
+    def maybe_corrupt(self, site: str, arr):
+        """Scramble a fetched array when the site is armed with a corrupt
+        fault: winner rows are pushed far out of range so structural
+        validation (scheduler._validate_hosts) catches it — the seam has no
+        checksum, so in-range corruption is out of scope by design."""
+        a = self._arms.get(site)
+        if a is None or a.kind != FAULT_CORRUPT or not self._should_fire(a):
+            return arr
+        a.fired += 1
+        self.log.append((site, FAULT_CORRUPT))
+        out = np.array(arr)
+        if out.dtype.kind in ("i", "u"):
+            out = out + (1 << 20)
+        else:
+            out = out + np.float32(3.0e38)
+        return out
+
+
+# ------------------------------------------------------- the global seam
+
+_INJECTOR: Optional[FaultInjector] = None
+
+
+def install_injector(inj: FaultInjector) -> Callable[[], None]:
+    """Install `inj` as the process-wide injector; returns a remover that
+    restores whatever was installed before (tests stack cleanly)."""
+    global _INJECTOR
+    prev = _INJECTOR
+    _INJECTOR = inj
+
+    def remove() -> None:
+        global _INJECTOR
+        _INJECTOR = prev
+
+    return remove
+
+
+def current_injector() -> Optional[FaultInjector]:
+    return _INJECTOR
+
+
+def check(site: str) -> None:
+    """Instrumentation hook: fire the armed fault for `site`, if any."""
+    inj = _INJECTOR
+    if inj is not None:
+        inj.fire(site)
+
+
+def corrupt(site: str, arr):
+    """Instrumentation hook: corrupt fetched data for `site`, if armed."""
+    inj = _INJECTOR
+    if inj is not None:
+        return inj.maybe_corrupt(site, arr)
+    return arr

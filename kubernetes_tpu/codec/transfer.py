@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubernetes_tpu.codec import faults
 from kubernetes_tpu.codec.schema import _pow2
 
 # ---------------------------------------------------------------- D2H fences
@@ -72,7 +73,8 @@ def host_fetch(x, tag: str = "fetch") -> np.ndarray:
     (or AsyncFetch) rather than raw np.asarray so sync counts stay
     observable."""
     _note_sync(tag)
-    return np.asarray(x)
+    faults.check(faults.SITE_FETCH)
+    return faults.corrupt(faults.SITE_FETCH, np.asarray(x))
 
 
 def upload_async(tree):
@@ -88,6 +90,7 @@ def ready_fence(tree, tag: str = "fence"):
     """Explicit blocking fence: waits until every leaf of `tree` is
     computed/transferred.  Counts as a blocking sync."""
     _note_sync(tag)
+    faults.check(faults.SITE_FENCE)
     jax.block_until_ready(tree)
     return tree
 
@@ -113,7 +116,19 @@ class _FetchWorker:
 
     def _drain(self) -> None:
         while True:
-            self._jobs.get()()
+            job = self._jobs.get()
+            try:
+                job()
+            except BaseException:  # noqa: BLE001
+                # A raising job must never kill the shared worker: every
+                # fetch queued BEHIND it would hang forever at result().
+                # AsyncFetch._run routes its own errors into the owning
+                # handle; this guard covers jobs that fail before that
+                # plumbing (or foreign submit() callers) — logged, since
+                # such a caller has no other way to see the failure.
+                import traceback
+
+                traceback.print_exc()
 
 
 _FETCH_WORKER: "_FetchWorker | None" = None
@@ -163,7 +178,10 @@ class AsyncFetch:
 
     def _run(self) -> None:
         try:
-            self._out = np.asarray(self._dev)
+            faults.check(faults.SITE_FETCH)
+            self._out = faults.corrupt(
+                faults.SITE_FETCH, np.asarray(self._dev)
+            )
         except BaseException as e:  # noqa: BLE001 — re-raised in result()
             self._err = e
         finally:
@@ -176,7 +194,10 @@ class AsyncFetch:
 
     def result(self) -> np.ndarray:
         """The ready-fence: host array, blocking (and reporting a blocking
-        sync) only when the copy is still in flight."""
+        sync) only when the copy is still in flight.  Fence-site faults
+        inject HERE — synchronously on the calling thread, where the
+        scheduler's classified-retry wrapper owns recovery."""
+        faults.check(faults.SITE_FENCE)
         if not self._done.is_set():
             _note_sync(self._tag)
             self._done.wait()
@@ -343,13 +364,32 @@ class DeviceSnapshotCache:
         self._host: dict = {}   # field -> last-uploaded host array
         self._dev: dict = {}    # field -> resident device array
 
+    def invalidate(self) -> None:
+        """Drop every resident buffer: the next update() re-uploads the
+        whole snapshot.  Called after a device fault — the wire state is
+        unknown (an upload may have half-landed) and the encoder's
+        dirty-row stream may have been consumed by the failed cycle, so
+        the incremental invariant (_host == device contents) cannot be
+        trusted until rebuilt from scratch."""
+        self._host.clear()
+        self._dev.clear()
+
     def update(self, cluster, dirty_rows=None):
         """Host ClusterTensors (or any flat dataclass of numpy arrays) ->
         same type with device-resident leaves, uploading only changes.
         dirty_rows: optional i32[] of node rows touched since the previous
         update (from SnapshotEncoder.take_dirty_rows(); None = unknown,
-        full content comparison)."""
+        full content comparison).
+
+        Fault discipline: _host must only record arrays whose device copy
+        actually landed — a raising upload leaves the already-committed
+        fields coherent (host+dev move together) and the failed/remaining
+        fields untouched, so a retry after a transient fault re-uploads
+        exactly what is missing.  The whole-tensor path therefore stages
+        its _host commits until after the batched device_put."""
+        faults.check(faults.SITE_SNAPSHOT_UPDATE)
         changed = []
+        staged: dict = {}
         rows_arr = None
         if dirty_rows is not None and len(dirty_rows) > 0:
             rows_arr = np.asarray(dirty_rows, np.int32)
@@ -396,8 +436,11 @@ class DeviceSnapshotCache:
                 or not np.array_equal(prev, host)
             ):
                 changed.append(f.name)
-            self._host[f.name] = host
+                staged[f.name] = host
+            else:
+                self._host[f.name] = host  # content-equal: no upload needed
         if changed:
-            uploaded = jax.device_put([self._host[n] for n in changed])
+            uploaded = jax.device_put([staged[n] for n in changed])
             self._dev.update(zip(changed, uploaded))
+            self._host.update(staged)
         return type(cluster)(**self._dev)

@@ -63,6 +63,16 @@ class KubeSchedulerConfiguration:
     # batch k+1's device dispatch)
     batched_commit: bool = True
     pipeline_commit: bool = False
+    # device-fault resilience knobs (runtime/scheduler.py SchedulerConfig /
+    # runtime/health.py DeviceHealth): classified retry with jittered
+    # exponential backoff, circuit breaker, CPU-engine degradation
+    device_retry_max: int = 2
+    device_backoff_base_s: float = 0.005
+    device_backoff_max_s: float = 0.05
+    device_backoff_jitter: float = 0.5
+    breaker_failure_threshold: int = 3
+    breaker_open_s: float = 0.05
+    cpu_fallback: bool = True
 
     def build_profile(self, interner=None) -> SchedulingProfile:
         """CreateFromConfig / CreateFromProvider (scheduler.go:162-192)."""
@@ -106,6 +116,13 @@ class KubeSchedulerConfiguration:
             engine=d.get("engine", "speculative"),
             batched_commit=bool(d.get("batchedCommit", True)),
             pipeline_commit=bool(d.get("pipelineCommit", False)),
+            device_retry_max=int(d.get("deviceRetryMax", 2)),
+            device_backoff_base_s=float(d.get("deviceBackoffBaseSeconds", 0.005)),
+            device_backoff_max_s=float(d.get("deviceBackoffMaxSeconds", 0.05)),
+            device_backoff_jitter=float(d.get("deviceBackoffJitter", 0.5)),
+            breaker_failure_threshold=int(d.get("breakerFailureThreshold", 3)),
+            breaker_open_s=float(d.get("breakerOpenSeconds", 0.05)),
+            cpu_fallback=bool(d.get("cpuFallback", True)),
         )
 
     @staticmethod

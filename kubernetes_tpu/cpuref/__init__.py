@@ -7,6 +7,7 @@ the TPU kernels on randomized cluster states.  It is also the CPU fallback
 path (the north star's "graceful fallback").
 """
 
+from kubernetes_tpu.cpuref.adapter import CpuEngineAdapter  # noqa: F401
 from kubernetes_tpu.cpuref.reference import (
     CPUScheduler,
     run_predicates,

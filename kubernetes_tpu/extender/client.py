@@ -19,12 +19,25 @@ union, so filtering before or after the device pass yields the same set).
 from __future__ import annotations
 
 import json
+import random
+import time
 import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from kubernetes_tpu.api.types import Pod
+
+# transport-level failures retried for IDEMPOTENT verbs only (refused,
+# reset, DNS blip, timeout — URLError wraps most of these from urllib;
+# OSError covers raw sockets, ConnectionError/socket.timeout are
+# subclasses).  A read timeout can fire AFTER the server executed the
+# request, so only verbs that tolerate a duplicate (filter/prioritize/
+# preempt re-evaluate the same state) retry; bind never does.
+# Application-level failures are NOT transient and surface immediately:
+# an HTTP error status (HTTPError — the server spoke), an HTTP 200 with
+# an "error" body, or malformed JSON.
+_TRANSIENT_HTTP_ERRORS = (urllib.error.URLError, TimeoutError, OSError)
 
 
 class ExtenderError(Exception):
@@ -46,6 +59,14 @@ class ExtenderConfig:
     node_cache_capable: bool = False
     managed_resources: Tuple[str, ...] = ()
     ignorable: bool = False
+    # bounded retry for TRANSIENT transport failures (no reference analog —
+    # the reference fails the pod on the first round-trip error): up to
+    # max_retries re-sends with jittered exponential backoff, the whole
+    # attempt train capped by http_timeout as the TOTAL budget, so an
+    # ignorable extender's flakiness delays a cycle by at most its
+    # configured timeout before the scheduler skips it.
+    max_retries: int = 2
+    retry_backoff_s: float = 0.02
 
     @staticmethod
     def from_dict(d: dict) -> "ExtenderConfig":
@@ -67,6 +88,8 @@ class ExtenderConfig:
                 r.get("name", "") for r in d.get("managedResources") or ()
             ),
             ignorable=bool(d.get("ignorable", False)),
+            max_retries=int(d.get("maxRetries", 2)),
+            retry_backoff_s=float(d.get("retryBackoffSeconds", 0.02)),
         )
 
 
@@ -124,6 +147,8 @@ class HTTPExtender:
     ):
         self.config = config
         self._transport = transport or self._http_post
+        # deterministic per-endpoint jitter stream (tests stay seeded)
+        self._retry_rng = random.Random(config.url_prefix)
 
     @property
     def name(self) -> str:                       # extender.go:119-122
@@ -247,6 +272,8 @@ class HTTPExtender:
             self.config.bind_verb,
             {"PodName": name, "PodNamespace": namespace, "PodUID": uid,
              "Node": node},
+            idempotent=False,  # a bind may have executed before the
+            #                    transport error surfaced: never re-POST
         )
         if not isinstance(result, dict):
             raise ExtenderError(
@@ -259,14 +286,51 @@ class HTTPExtender:
 
     # --------------------------------------------------------- transport
 
-    def _send(self, verb: str, args) -> dict:
+    def _send(self, verb: str, args, idempotent: bool = True) -> dict:
+        """One verb round-trip with bounded transient retry: up to
+        config.max_retries re-sends with jittered exponential backoff for
+        connection-level failures, the whole train budgeted by
+        config.http_timeout (each attempt's transport timeout is the
+        REMAINING budget, so retries can never stretch a cycle past the
+        per-extender timeout the operator configured).
+
+        idempotent=False (the bind verb) disables retry entirely: a read
+        timeout can fire AFTER the server executed the request, and only
+        idempotent verbs (filter/prioritize/preempt re-evaluate the same
+        state) tolerate the duplicate."""
         url = self.config.url_prefix.rstrip("/") + "/" + verb
-        try:
-            return self._transport(url, args, self.config.http_timeout)
-        except ExtenderError:
-            raise
-        except Exception as e:  # timeouts, refused connections, bad JSON
-            raise ExtenderError(f"extender {url}: {e}") from e
+        cfg = self.config
+        deadline = time.monotonic() + cfg.http_timeout
+        delay = max(cfg.retry_backoff_s, 0.0)
+        attempt = 0
+        while True:
+            remaining = deadline - time.monotonic()
+            try:
+                return self._transport(url, args, max(remaining, 0.001))
+            except ExtenderError:
+                raise
+            except urllib.error.HTTPError as e:
+                # non-2xx status: the request REACHED the extender — never
+                # retried (HTTPError subclasses URLError, so this must be
+                # caught before the transient family)
+                raise ExtenderError(f"extender {url}: {e}") from e
+            except _TRANSIENT_HTTP_ERRORS as e:
+                if not idempotent:
+                    raise ExtenderError(f"extender {url}: {e}") from e
+                attempt += 1
+                # jitter spreads synchronized retries across pods' threads
+                pause = delay * (1.0 + self._retry_rng.random())
+                if (
+                    attempt > cfg.max_retries
+                    or time.monotonic() + pause >= deadline
+                ):
+                    raise ExtenderError(
+                        f"extender {url}: {e} (after {attempt} attempts)"
+                    ) from e
+                time.sleep(pause)
+                delay *= 2.0
+            except Exception as e:  # malformed JSON, protocol errors
+                raise ExtenderError(f"extender {url}: {e}") from e
 
     @staticmethod
     def _http_post(url: str, payload: dict, timeout: float) -> dict:

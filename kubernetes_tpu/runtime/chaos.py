@@ -4,8 +4,11 @@ The reference shape (test/e2e/chaosmonkey/chaosmonkey.go:17-60): register
 tests, run a Disruption concurrently, assert behavior across it.  Here a
 `Chaosmonkey` carries (setup, during, teardown) hooks per registered test
 and drives them around a disruption callable; `Disruptions` bundles the
-faults this cluster model can inject (node lease expiry, random pod kills,
-leader kill) so suites compose them.
+faults this cluster model can inject — the reference's cluster-layer
+monkeys (node lease expiry, random pod kills, leader kill) PLUS the
+device-layer faults the reference never had (codec/faults.py FaultInjector:
+transient XLA errors, device-lost, slow device, corrupted fetch) — so
+suites compose cluster and accelerator failure in one storm.
 """
 
 from __future__ import annotations
@@ -16,6 +19,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional
 
+from kubernetes_tpu.codec import faults as device_faults
 from kubernetes_tpu.runtime.cluster import LocalCluster
 
 
@@ -39,15 +43,25 @@ class Chaosmonkey:
 
     def do(self, during_interval: float = 0.05) -> None:
         """Setup all -> run the disruption while polling every `during`
-        hook -> teardown all.  Exceptions propagate (the test fails)."""
+        hook -> teardown all.  Exceptions propagate (the test fails): a
+        `during` hook raising on the poller thread stops the polling,
+        still runs every teardown, then re-raises the FIRST captured
+        exception — previously it died silently with the thread and the
+        invariant violation went unreported."""
         for t in self.tests:
             t.setup()
         stop = threading.Event()
+        poll_errors: List[BaseException] = []
 
         def poller():
             while not stop.is_set():
                 for t in self.tests:
-                    t.during()
+                    try:
+                        t.during()
+                    except BaseException as e:  # noqa: BLE001
+                        poll_errors.append(e)
+                        stop.set()
+                        return
                 stop.wait(during_interval)
 
         th = threading.Thread(target=poller, daemon=True)
@@ -59,14 +73,18 @@ class Chaosmonkey:
             th.join(timeout=5.0)
         for t in self.tests:
             t.teardown()
+        if poll_errors:
+            raise poll_errors[0]
 
 
 class Disruptions:
-    """Fault injectors over the LocalCluster world."""
+    """Fault injectors over the LocalCluster world + the device datapath."""
 
     def __init__(self, cluster: LocalCluster, rng: Optional[random.Random] = None):
         self.cluster = cluster
         self.rng = rng or random.Random(0)
+        self._fault_remover: Optional[Callable[[], None]] = None
+        self._armed_sites: set = set()  # sites THIS Disruptions armed
 
     def kill_random_pods(self, n: int, namespace: str = "default") -> List[str]:
         """Delete n random pods (the pod-kill monkey); owning controllers
@@ -90,3 +108,76 @@ class Disruptions:
         """Stop the current leader WITHOUT releasing its lease (a crash,
         not a graceful shutdown): the standby must wait out the TTL."""
         elector.stop(release=False)
+
+    # ------------------------------------------------- device-layer faults
+    #
+    # The accelerator failure domain (codec/faults.py): each method arms
+    # one site of the process-wide FaultInjector, installing a seeded one
+    # on first use.  Sites: "dispatch" (engine launch), "fence"
+    # (ready-fence / AsyncFetch.result), "fetch" (D2H materialization),
+    # "snapshot_update" (H2D delta upload).  The scheduler's classified
+    # retry / breaker / CPU-degradation machinery is the system under
+    # test; clear_device_faults() ends the storm.
+
+    def _injector(self) -> device_faults.FaultInjector:
+        inj = device_faults.current_injector()
+        if inj is None:
+            inj = device_faults.FaultInjector(seed=self.rng.randrange(2 ** 31))
+            self._fault_remover = device_faults.install_injector(inj)
+        return inj
+
+    def _arm(self, site: str, **kw) -> device_faults.FaultInjector:
+        self._armed_sites.add(site)
+        return self._injector().arm(site, **kw)
+
+    def device_transient(
+        self, site: str = device_faults.SITE_FENCE,
+        count: Optional[int] = 1, p: float = 1.0,
+    ) -> device_faults.FaultInjector:
+        """Transient XLA runtime errors (UNAVAILABLE-family): the retry/
+        backoff monkey."""
+        return self._arm(
+            site, kind=device_faults.FAULT_TRANSIENT, count=count, p=p
+        )
+
+    def device_lost(
+        self, site: str = device_faults.SITE_FENCE,
+        count: Optional[int] = None,
+    ) -> device_faults.FaultInjector:
+        """Persistent device-lost: the breaker-tripping monkey (count=None
+        keeps the device dead until clear_device_faults)."""
+        return self._arm(
+            site, kind=device_faults.FAULT_PERSISTENT, count=count
+        )
+
+    def slow_device(
+        self, site: str = device_faults.SITE_FENCE,
+        latency_s: float = 0.05, count: Optional[int] = None,
+    ) -> device_faults.FaultInjector:
+        """Injected device latency (no error): exercises overlap/backoff
+        accounting without touching the breaker."""
+        return self._arm(
+            site, kind=device_faults.FAULT_SLOW, count=count,
+            latency_s=latency_s,
+        )
+
+    def corrupted_fetch(self, count: Optional[int] = 1) -> device_faults.FaultInjector:
+        """Structurally-corrupt D2H results: winner rows scrambled out of
+        range so the scheduler's fetch validation must catch them."""
+        return self._arm(
+            device_faults.SITE_FETCH, kind=device_faults.FAULT_CORRUPT,
+            count=count,
+        )
+
+    def clear_device_faults(self) -> None:
+        """Disarm the sites THIS Disruptions armed (a shared process-wide
+        injector may carry another owner's arms — leave those alone);
+        uninstall the injector only if this Disruptions installed it."""
+        inj = device_faults.current_injector()
+        if inj is not None:
+            for site in self._armed_sites:
+                inj.disarm(site)
+        self._armed_sites.clear()
+        if self._fault_remover is not None:
+            self._fault_remover()
+            self._fault_remover = None

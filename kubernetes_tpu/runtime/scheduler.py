@@ -43,9 +43,16 @@ from kubernetes_tpu.models.preemption import (
     sorted_victim_slots,
     verify_nomination,
 )
+from kubernetes_tpu.codec.faults import (
+    FAULT_PERSISTENT,
+    CorruptedFetchError,
+    classify_device_error,
+)
+from kubernetes_tpu.codec import faults as device_faults
 from kubernetes_tpu.codec.transfer import AsyncFetch, host_fetch
 from kubernetes_tpu.ops.predicates import filter_batch, required_affinity_ok
 from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.health import DeviceHealth
 from kubernetes_tpu.runtime.events import (
     EVENT_TYPE_NORMAL,
     EVENT_TYPE_WARNING,
@@ -89,6 +96,24 @@ class SchedulerConfig:
     # via the standard optimistic ForgetPod + requeue, exactly like the
     # reference's async bind goroutine (scheduler.go:523).
     pipeline_commit: bool = False
+    # --- device-fault resilience (runtime/health.DeviceHealth +
+    # cpuref/adapter.CpuEngineAdapter; faults classified by codec/faults) ---
+    # transient retries of the SAME in-flight batch before giving up on it
+    device_retry_max: int = 2
+    # jittered exponential backoff between those retries (base * 2^attempt,
+    # jitter-scaled, hard-capped at max so chaos tests stay sub-100ms)
+    device_backoff_base_s: float = 0.005
+    device_backoff_max_s: float = 0.05
+    device_backoff_jitter: float = 0.5
+    # consecutive classified failures that trip the breaker (a persistent
+    # "device lost" trips immediately regardless)
+    breaker_failure_threshold: int = 3
+    # open -> half-open cool-down before a canary batch probes the device
+    breaker_open_s: float = 0.05
+    # graceful degradation: while the breaker is open, serve cycles from
+    # the CPU reference engine instead of stalling/requeueing forever.
+    # False = legacy behavior (device faults requeue the batch and raise).
+    cpu_fallback: bool = True
     # multi-scheduler: only pods whose spec.schedulerName names THIS
     # scheduler enter its queue (eventhandlers.go responsibleForPod)
     scheduler_name: str = "default-scheduler"
@@ -113,6 +138,15 @@ class SchedulerConfig:
             profile=profile,
             batched_commit=getattr(cc, "batched_commit", True),
             pipeline_commit=getattr(cc, "pipeline_commit", False),
+            device_retry_max=getattr(cc, "device_retry_max", 2),
+            device_backoff_base_s=getattr(cc, "device_backoff_base_s", 0.005),
+            device_backoff_max_s=getattr(cc, "device_backoff_max_s", 0.05),
+            device_backoff_jitter=getattr(cc, "device_backoff_jitter", 0.5),
+            breaker_failure_threshold=getattr(
+                cc, "breaker_failure_threshold", 3
+            ),
+            breaker_open_s=getattr(cc, "breaker_open_s", 0.05),
+            cpu_fallback=getattr(cc, "cpu_fallback", True),
         )
 
 
@@ -142,14 +176,39 @@ class _InFlight:
     while the scheduling thread encodes/dispatches the next batch."""
 
     pods: List[Pod]
-    hosts_dev: object            # device i32[B] winners buffer
-    fetch: AsyncFetch            # in-flight D2H of hosts_dev
+    hosts_dev: object            # device i32[B] winners buffer (None when
+    #                              the cycle ran degraded on the CPU engine)
+    fetch: object                # AsyncFetch (device) or _HostResult (CPU)
     generation: int
     cycle: int
     ext_failed: Dict[int, str]
     pc: object                   # shared PluginContext (framework cycles)
     t_cycle0: float
     trace: Trace
+    # --- device-fault resilience ---
+    # re-dispatch the SAME encoded batch (transient-retry path); None for
+    # degraded cycles
+    relaunch: Optional[Callable[[], Tuple[object, AsyncFetch]]] = None
+    # compute this batch's winners on the CPU engine (degradation path);
+    # returns a _HostResult
+    cpu_fetch: Optional[Callable[[], "_HostResult"]] = None
+    degraded: bool = False       # True once served by the CPU engine
+    last_index0: int = 0         # selectHost rotation base for this batch
+
+
+class _HostResult:
+    """AsyncFetch-shaped handle for a host-computed winners buffer (the
+    degraded CPU-engine path): already materialized, never faults."""
+
+    def __init__(self, hosts: np.ndarray, seconds: float = 0.0):
+        self._hosts = hosts
+        self.seconds = seconds
+
+    def ready(self) -> bool:
+        return True
+
+    def result(self) -> np.ndarray:
+        return self._hosts
 
 
 @dataclass
@@ -248,6 +307,18 @@ class Scheduler:
         self.pdb_lister = pdb_lister or (lambda: [])
         self._last_index = 0
         self._stop = threading.Event()
+        # device-fault resilience: classified retry/backoff + circuit
+        # breaker (runtime/health.py) + CPU-engine degradation
+        # (cpuref/adapter.py, built lazily on first degraded cycle)
+        self.device_health = DeviceHealth(
+            failure_threshold=self.config.breaker_failure_threshold,
+            open_duration_s=self.config.breaker_open_s,
+            backoff_base_s=self.config.device_backoff_base_s,
+            backoff_max_s=self.config.device_backoff_max_s,
+            backoff_jitter=self.config.device_backoff_jitter,
+            on_transition=self._on_breaker_transition,
+        )
+        self._cpu_engine = None
         # double-buffer slot for pipeline_commit: at most one dispatched
         # batch whose host tail has not run yet
         self._in_flight: Optional[_InFlight] = None
@@ -279,23 +350,144 @@ class Scheduler:
         strictly synchronous (any in-flight pipelined batch is drained
         first so cycles never interleave)."""
         self.flush_pipeline()
-        inf = self._encode_and_dispatch(pods)
+        try:
+            inf = self._encode_and_dispatch(pods)
+        except BaseException:
+            # popped pods must never be lost: a fault that escaped the
+            # classified-retry/degrade machinery (or a plain bug) still
+            # leaves the batch schedulable later
+            self.queue.add_unschedulable_batch(
+                list(pods), self.queue.scheduling_cycle
+            )
+            raise
         if inf is None:
             return []
         return self._commit_tail(self._commit_state_or_requeue(inf))
 
     def _commit_state_or_requeue(self, inf: _InFlight) -> _Staged:
-        """_commit_state with the batch-loss guard: the ready-fence
-        re-raises device errors (AsyncFetch.result), and the batch's pods
-        were already popped from the queue — on failure requeue them ALL
-        (plain error requeue, the extender-error discipline) before
+        """The resilient fence with the batch-loss guard: classified
+        device faults retry/degrade inside _commit_state_resilient; if
+        even that fails (unclassified error, or cpu_fallback disabled),
+        the batch's pods — already popped from the queue — are requeued
+        ALL (plain error requeue, the extender-error discipline) before
         propagating, so a device fault degrades to a retry instead of the
         batch staying Pending forever."""
         try:
-            return self._commit_state(inf)
+            return self._commit_state_resilient(inf)
         except BaseException:
             self.queue.add_unschedulable_batch(inf.pods, inf.cycle)
             raise
+
+    # ----------------------------------------------- device-fault handling
+
+    @property
+    def cpu_engine(self):
+        """Lazy CpuEngineAdapter (cpuref/adapter.py): the degraded-mode
+        engine serving cycles while the device breaker is open."""
+        if self._cpu_engine is None:
+            from kubernetes_tpu.cpuref.adapter import CpuEngineAdapter
+
+            self._cpu_engine = CpuEngineAdapter(self.cache, self.config)
+        return self._cpu_engine
+
+    def _on_breaker_transition(self, frm: str, to: str) -> None:
+        """Breaker transitions are operator-visible: one Event each (the
+        audit trail the failure-mode table in README documents)."""
+        reason = {
+            "open": "BreakerOpen",
+            "half_open": "BreakerHalfOpen",
+            "closed": "BreakerClosed",
+        }[to]
+        self.recorder.eventf(
+            "Scheduler", "", self.config.scheduler_name,
+            EVENT_TYPE_WARNING if to == "open" else EVENT_TYPE_NORMAL,
+            reason,
+            "device breaker %s -> %s (consecutive failures: %d)",
+            frm, to, self.device_health.consecutive_failures,
+        )
+
+    def _note_device_fault(self, fault_class: str, err: BaseException,
+                           phase: str) -> None:
+        klog.errorf(
+            "device fault (%s) at %s: %s", fault_class, phase, err
+        )
+        self.recorder.eventf(
+            "Scheduler", "", self.config.scheduler_name,
+            EVENT_TYPE_WARNING, "DeviceFault",
+            "%s device fault at %s: %s", fault_class, phase, err,
+        )
+
+    def _degrade_fetch(self, inf: _InFlight) -> None:
+        """Serve an in-flight batch from the CPU engine: swap its fetch
+        handle for a host-computed result and mark the cycle degraded."""
+        inf.fetch = inf.cpu_fetch()
+        inf.degraded = True
+        m.DEGRADED_CYCLES.inc()
+
+    def _fault_retry_allowed(
+        self, fc: str, attempt: int, can_relaunch: bool = True
+    ) -> bool:
+        """THE retry policy, shared by the dispatch and fence wrappers:
+        account the classified failure with the breaker, and decide
+        whether one more same-batch attempt is allowed (counting the
+        retry metric and sleeping the jittered backoff when it is).  On
+        False the device has been given up on for this batch — the
+        resident snapshot buffers are invalidated (a partial upload may
+        have landed) and the caller degrades or raises."""
+        tripped = self.device_health.record_failure(fc)
+        if (
+            not tripped
+            and fc != FAULT_PERSISTENT
+            and can_relaunch
+            and attempt < self.config.device_retry_max
+        ):
+            m.FAULT_RETRIES.inc(**{"class": fc})
+            time.sleep(self.device_health.backoff_s(attempt))
+            return True
+        self._dev_snapshot.invalidate()
+        return False
+
+    def _commit_state_resilient(self, inf: _InFlight) -> _Staged:
+        """_commit_state wrapped in the classified retry/backoff/breaker
+        policy: transient faults re-dispatch the SAME batch up to
+        device_retry_max times with jittered backoff; a persistent fault
+        (or a failure streak reaching the breaker threshold, or a failed
+        half-open canary) trips the breaker and serves THIS batch from the
+        CPU engine — popped pods are never lost, and commit/event
+        semantics are identical either way."""
+        attempt = 0
+        relaunch_pending = False
+        while True:
+            try:
+                if relaunch_pending:
+                    inf.hosts_dev, inf.fetch = inf.relaunch()
+                    relaunch_pending = False
+                staged = self._commit_state(inf)
+            except BaseException as e:
+                fc = classify_device_error(e)
+                if fc is None:
+                    raise
+                self._note_device_fault(
+                    fc, e, "dispatch" if relaunch_pending else "fence"
+                )
+                if self._fault_retry_allowed(
+                    fc, attempt,
+                    can_relaunch=(
+                        not inf.degraded and inf.relaunch is not None
+                    ),
+                ):
+                    attempt += 1
+                    relaunch_pending = True
+                    continue
+                if not self.config.cpu_fallback or inf.cpu_fetch is None:
+                    raise
+                self._degrade_fetch(inf)
+                staged = self._commit_state(inf)  # CPU result: cannot fault
+            if not inf.degraded:
+                # an actual device round-trip succeeded: heal the streak
+                # (and close the breaker if this was the half-open canary)
+                self.device_health.record_success()
+            return staged
 
     def _encode_and_dispatch(self, pods: Sequence[Pod]) -> Optional[_InFlight]:
         """Encode the batch + snapshot under the cache lock, run the
@@ -308,6 +500,15 @@ class Scheduler:
         enc = self.cache.encoder
         cycle = self.queue.scheduling_cycle
         batch_keys = {(p.namespace, p.name) for p in pods}
+        # engine choice is made BEFORE the encode so degraded cycles leave
+        # the encoder's dirty-row stream unconsumed (the device cache isn't
+        # listening; it is invalidated on trip and rebuilt on recovery).
+        # allow_device() may transition open -> half_open: the canary.
+        use_device = (
+            self.device_health.allow_device()
+            if self.config.cpu_fallback
+            else True
+        )
         with self.cache._lock:
             # in-batch affinity state when pods carry ANY pod-affinity terms
             # (required or preferred) AND can interact (B > 1); built BEFORE
@@ -333,7 +534,7 @@ class Scheduler:
             # cache scatter-update just those rows instead of re-shipping
             # whole tensors (codec/transfer.py); taken under the lock so
             # the row set corresponds exactly to THIS snapshot
-            dirty_rows = enc.take_dirty_rows()
+            dirty_rows = enc.take_dirty_rows() if use_device else None
             # ports + anti-affinity contributions of nominated pods (the
             # non-resource half of podFitsOnNode's pass one) as a host
             # mask folded into extra_mask below
@@ -390,27 +591,132 @@ class Scheduler:
         fn = self._schedule_fn
         if self._speculative_fn is not None:
             fn = self._speculative_fn
-        hosts, _ = fn(
-            self._dev_snapshot.update(cluster, dirty_rows=dirty_rows),
-            batch, ports,
-            np.int32(self._last_index), nominated,
-            extra_mask, extra_score, aff_state,
-        )
-        # async result path: only the compact winners buffer (i32[B] node
-        # rows) crosses the wire — the D2H copy is enqueued NOW and
-        # materializes on a worker thread, so the blocking fence in
-        # _commit_state is usually a no-op by the time the pipelined loop
-        # reaches it (batch k's fetch overlaps batch k's host tail and
-        # batch k+1's dispatch)
-        fetch = AsyncFetch(hosts)
+        last_index0 = self._last_index
+
+        def launch():
+            """(Re-)dispatch THIS encoded batch on the device.  Captured
+            by _InFlight.relaunch so the transient-retry path re-runs the
+            same computation with the same rotation base; dirty_rows are
+            re-passed safely — fields whose upload already landed identity-
+            skip, fields whose upload faulted re-scatter."""
+            device_faults.check(device_faults.SITE_DISPATCH)
+            dev_cluster = self._dev_snapshot.update(
+                cluster, dirty_rows=dirty_rows
+            )
+            hosts, _ = fn(
+                dev_cluster, batch, ports,
+                np.int32(last_index0), nominated,
+                extra_mask, extra_score, aff_state,
+            )
+            # async result path: only the compact winners buffer (i32[B]
+            # node rows) crosses the wire — the D2H copy is enqueued NOW
+            # and materializes on a worker thread, so the blocking fence in
+            # _commit_state is usually a no-op by the time the pipelined
+            # loop reaches it (batch k's fetch overlaps batch k's host tail
+            # and batch k+1's dispatch)
+            return hosts, AsyncFetch(hosts)
+
+        def cpu_fetch():
+            """Winners for THIS batch from the CPU reference engine, in the
+            device path's exact shape (cpuref/adapter.py) — the graceful-
+            degradation seam.  Reads the LIVE cache state, which at call
+            time equals the state this batch's snapshot saw (single
+            scheduling thread; the pipelined loop commits batch k's state
+            before dispatching k+1)."""
+            t0 = time.monotonic()
+            hosts = self.cpu_engine.schedule_batch(
+                pods, last_index0,
+                extra_mask=extra_mask, extra_score=extra_score,
+                nominated=nominated_pairs,
+                masked=frozenset(ext_failed),
+                row_map=node_row_map,
+            )
+            return _HostResult(hosts, seconds=time.monotonic() - t0)
+
+        degraded = False
+        hosts_dev = None
+        if use_device:
+            launched = self._launch_resilient(launch)
+        else:
+            launched = None
+        if launched is None:
+            # breaker open (or dispatch gave up): degraded CPU cycle
+            degraded = True
+            m.DEGRADED_CYCLES.inc()
+            fetch = cpu_fetch()
+        else:
+            hosts_dev, fetch = launched
         self._last_index += len(pods)
         trace.step("device")
         self.phase_seconds["dispatch"] += time.monotonic() - t_disp
         return _InFlight(
-            pods=list(pods), hosts_dev=hosts, fetch=fetch,
+            pods=list(pods), hosts_dev=hosts_dev, fetch=fetch,
             generation=generation, cycle=cycle, ext_failed=ext_failed,
             pc=pc, t_cycle0=t_cycle0, trace=trace,
+            relaunch=None if degraded else launch,
+            cpu_fetch=cpu_fetch, degraded=degraded,
+            last_index0=last_index0,
         )
+
+    def _launch_resilient(self, launch):
+        """Run a device launch under the classified retry/backoff policy.
+        Returns (hosts_dev, fetch), or None when the device was given up on
+        for this batch (caller degrades to the CPU engine); unclassified
+        errors propagate (the schedule_cycle/_run_pipelined guards requeue
+        the batch)."""
+        attempt = 0
+        while True:
+            try:
+                return launch()
+            except BaseException as e:
+                fc = classify_device_error(e)
+                if fc is None:
+                    raise
+                self._note_device_fault(fc, e, "dispatch")
+                if self._fault_retry_allowed(fc, attempt):
+                    attempt += 1
+                    continue
+                if not self.config.cpu_fallback:
+                    raise
+                return None
+
+    def _validate_hosts(self, hosts, n_pods: int) -> np.ndarray:
+        """Structural validation of a fetched winners buffer: a corrupted
+        D2H transfer must surface as a CLASSIFIED fault (retried like a
+        transient error) instead of a KeyError deep in row_name or a
+        silently-wrong placement on a never-allocated row.  In-range
+        corruption is undetectable without a checksum — out of scope; the
+        injector's corrupt mode scrambles values out of range on purpose."""
+        hosts = np.asarray(hosts)
+        enc = self.cache.encoder
+        structural = (
+            hosts.ndim == 1
+            and hosts.shape[0] >= n_pods
+            and hosts.dtype.kind in ("i", "u")
+        )
+        if structural and n_pods:
+            head = hosts[:n_pods]
+            # winners live in [-1, next_row): -1 = unschedulable, rows
+            # below the arena high-water mark; anything outside (either
+            # direction) is wire corruption, not a placement
+            structural = (
+                int(head.max(initial=-1)) < max(enc._next_row, 1)
+                and int(head.min(initial=0)) >= -1
+            )
+        if not structural:
+            raise CorruptedFetchError(
+                "fetched winners buffer failed validation: shape=%s "
+                "dtype=%s row_range=%s live_rows<%d"
+                % (
+                    hosts.shape, hosts.dtype,
+                    (int(hosts[:n_pods].min(initial=0)),
+                     int(hosts[:n_pods].max(initial=-1)))
+                    if hosts.ndim == 1 and hosts.shape[0] >= n_pods
+                    else "?",
+                    enc._next_row,
+                )
+            )
+        return hosts
 
     def _commit_state(self, inf: _InFlight) -> _Staged:
         """Fetch the placements and apply the cache-STATE half of the
@@ -423,6 +729,7 @@ class Scheduler:
         t_fetch0 = time.monotonic()
         hosts = inf.fetch.result()  # ready-fence: blocks only if the async
         #                             D2H copy hasn't landed yet
+        hosts = self._validate_hosts(hosts, len(pods))
         t_state0 = time.monotonic()
         # "fetch" records the ASYNC window (dispatch -> copy-complete,
         # measured on the fetch worker): it overlaps the dispatch/commit
@@ -920,12 +1227,21 @@ class Scheduler:
 
     def _preempt_inner(self, pod: Pod) -> Optional[str]:
         enc = self.cache.encoder
+        # preemption must not consume the breaker's half-open canary (the
+        # scheduling cycle is the probe), so it keys off the NON-mutating
+        # availability check: anything but CLOSED routes the candidate scan
+        # through the CPU engine
+        use_device = (
+            self.device_health.device_available
+            if self.config.cpu_fallback
+            else True
+        )
         with self.cache._lock:
             if not self._eligible_to_preempt(pod):
                 return None
             batch = enc.encode_pods([pod])
             cluster, _ = self.cache.snapshot()
-            dirty_rows = enc.take_dirty_rows()
+            dirty_rows = enc.take_dirty_rows() if use_device else None
         # device work OUTSIDE the cache lock: a first-shape preempt pays a
         # multi-second XLA compile, and informer/event threads must not
         # stall on the lock for it.  The snapshot is a point-in-time copy;
@@ -946,12 +1262,32 @@ class Scheduler:
         # one thread, interleaved never concurrent.  If preempt ever
         # becomes callable from another thread, give preemption its own
         # DeviceSnapshotCache (and its own dirty-row take stream).
-        cluster = self._dev_snapshot.update(cluster, dirty_rows=dirty_rows)
-        if jax.default_backend() != "cpu":
-            batch = jax.device_put(batch)
-        cands = host_fetch(
-            self._preempt_eval(cluster, batch), tag="preempt"
-        )[0].copy()
+        if use_device:
+            try:
+                cluster = self._dev_snapshot.update(
+                    cluster, dirty_rows=dirty_rows
+                )
+                if jax.default_backend() != "cpu":
+                    batch = jax.device_put(batch)
+                cands = host_fetch(
+                    self._preempt_eval(cluster, batch), tag="preempt"
+                )[0].copy()
+            except BaseException as e:
+                fc = classify_device_error(e)
+                if fc is None:
+                    raise
+                # preempt device faults feed the same breaker accounting;
+                # the candidate scan degrades to the CPU engine in place
+                self._note_device_fault(fc, e, "preempt")
+                self.device_health.record_failure(fc)
+                self._dev_snapshot.invalidate()
+                if not self.config.cpu_fallback:
+                    raise
+                cands = self.cpu_engine.preempt_candidates(
+                    pod, cluster.n_nodes
+                )
+        else:
+            cands = self.cpu_engine.preempt_candidates(pod, cluster.n_nodes)
         if not cands.any():
             # nodesWherePreemptionMightHelp came back empty: clear any
             # previous nomination (generic_scheduler.go:328-333)
@@ -1189,11 +1525,19 @@ class Scheduler:
         # gang (the plain path's two-pass protection, scheduler.py
         # nominated handling) — any of these routes the members through
         # the plain cycle (no atomicity) rather than risk a placement
-        # the normal path would reject
+        # the normal path would reject.  The same demotion applies while
+        # the device breaker is not closed: the gang launch has its own
+        # device path with no degraded engine, so during an outage
+        # members schedule as plain pods (liveness over atomicity)
+        # through the CPU fallback.
         gang_eligible = (
             not self.extenders
             and self.framework is None
             and not self.queue.nominated_pods()
+            and (
+                self.device_health.device_available
+                or not self.config.cpu_fallback
+            )
         )
         plain = [p for p in pods
                  if not gang_eligible or self.POD_GROUP_LABEL not in p.labels]
@@ -1226,7 +1570,46 @@ class Scheduler:
                     (PodGroup(gname, namespace=ns, min_member=mm), members)
                 )
             t_cycle = time.monotonic()
-            results = GangScheduler(self).schedule_gangs(gangs)
+            try:
+                results = GangScheduler(self).schedule_gangs(gangs)
+            except BaseException as e:
+                # popped gang members must never be lost — but
+                # schedule_gangs commits gang-by-gang, so members of
+                # gangs that already committed are ASSUMED+BOUND: record
+                # their success and recover only the genuinely unplaced
+                # ones (re-scheduling a bound pod would double-bind and
+                # double-charge the cache).  A CLASSIFIED device fault
+                # feeds the breaker and demotes the unplaced members to
+                # THIS cycle's plain path (which owns retry/degrade);
+                # anything else requeues them and propagates.
+                enc = self.cache.encoder
+                unplaced = []
+                for _, ms in gangs:
+                    for p in ms:
+                        rec = enc.pods.get((p.namespace, p.name))
+                        if (
+                            rec is not None
+                            and rec.node_row >= 0
+                            and rec.pod is not None
+                            and rec.pod.spec.node_name
+                        ):
+                            node = rec.pod.spec.node_name
+                            n += 1
+                            self.results.append(ScheduleResult(p, node))
+                            self._record_scheduled(
+                                p, node, time.monotonic() - t_cycle
+                            )
+                        else:
+                            unplaced.append(p)
+                fc = classify_device_error(e)
+                if fc is None:
+                    self.queue.add_unschedulable_batch(unplaced, cycle)
+                    raise
+                self._note_device_fault(fc, e, "gang")
+                self.device_health.record_failure(fc)
+                self._dev_snapshot.invalidate()
+                plain = plain + unplaced
+                gangs, results = [], []
             for (group, members), (nodes, placed) in zip(gangs, results):
                 if nodes is None:
                     # gang did not reach min_member: members park in the

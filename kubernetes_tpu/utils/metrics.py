@@ -193,5 +193,35 @@ WEBHOOK_LATENCY = REGISTRY.register(Histogram(
     "Admission webhook round-trip latency",
 ))
 
+# device-fault resilience observables (no reference analog — the reference
+# scheduler has no accelerator to lose; names follow the scheduler_ family)
+FAULT_RETRIES = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_device_fault_retries_total",
+        "Classified device-fault retries of an in-flight batch, by class",
+        ("class",),
+    )
+)
+BREAKER_STATE = REGISTRY.register(
+    Gauge(
+        "scheduler_device_breaker_state",
+        "Device circuit-breaker state: 0=closed 1=half_open 2=open",
+    )
+)
+BREAKER_TRANSITIONS = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_device_breaker_transitions_total",
+        "Device circuit-breaker transitions, by target state",
+        ("to",),
+    )
+)
+DEGRADED_CYCLES = REGISTRY.register(
+    Counter(
+        "scheduler_degraded_cycles_total",
+        "Scheduling cycles served by the CPU reference engine while the "
+        "device breaker was open",
+    )
+)
+
 # schedule_attempts_total result label values (metrics.go:44-52)
 SCHEDULED, UNSCHEDULABLE, SCHEDULE_ERROR = "scheduled", "unschedulable", "error"

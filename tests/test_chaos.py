@@ -178,3 +178,44 @@ def test_leader_crash_without_release_fails_over_after_ttl():
         time.sleep(0.1)
     assert eb.is_leader, "standby must take over after the TTL"
     eb.stop()
+
+
+def test_during_hook_exception_propagates_after_teardown():
+    """Regression: a `during` hook raising on the poller thread used to be
+    silently swallowed (the thread just died) — the invariant violation
+    never failed the test.  Now the first poller exception re-raises from
+    do(), after every teardown has run."""
+    order = []
+
+    def bad_during():
+        order.append("during")
+        raise AssertionError("invariant violated mid-disruption")
+
+    cm = Chaosmonkey(lambda: time.sleep(0.05))
+    cm.register(ChaosTest(
+        "inv", during=bad_during,
+        teardown=lambda: order.append("teardown"),
+    ))
+    try:
+        cm.do(during_interval=0.01)
+    except AssertionError as e:
+        order.append("raised")
+        assert "invariant violated" in str(e)
+    else:
+        raise AssertionError("poller exception was swallowed")
+    # teardown ran BEFORE the captured exception re-raised
+    assert order.index("teardown") < order.index("raised")
+
+
+def test_device_fault_disruptions_arm_and_clear_injector():
+    """Disruptions' device-layer monkeys install/arm the process-wide
+    injector and clear_device_faults restores the previous state."""
+    from kubernetes_tpu.codec import faults as device_faults
+
+    assert device_faults.current_injector() is None
+    dis = Disruptions(LocalCluster())
+    inj = dis.device_transient("fence", count=1)
+    assert device_faults.current_injector() is inj
+    dis.slow_device("dispatch", latency_s=0.001)
+    dis.clear_device_faults()
+    assert device_faults.current_injector() is None

@@ -1,0 +1,515 @@
+"""Device-fault resilience over the live Scheduler (ISSUE 3 acceptance):
+
+* classified transient faults retry the SAME in-flight batch with backoff;
+* a persistent device-lost trips the breaker and the workload completes
+  through the CPU degraded path — zero pods lost, no hang;
+* breaker transitions closed -> open -> half_open -> closed are emitted as
+  Events/metrics and the device path restores automatically when the
+  injection stops;
+* degraded CPU cycles place bit-identically to the device path on the same
+  snapshot;
+* the fault matrix (every injection site x kind) never loses a pod.
+
+Everything is seeded and deterministic (codec/faults.FaultInjector), all
+sleeps <= 0.1s, runs under JAX_PLATFORMS=cpu inside tier-1.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.codec.faults import (
+    FAULT_CORRUPT,
+    FAULT_PERSISTENT,
+    FAULT_SLOW,
+    FAULT_TRANSIENT,
+    SITES,
+    FaultInjector,
+    PersistentDeviceError,
+    classify_device_error,
+    install_injector,
+)
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.health import (
+    BREAKER_CLOSED,
+    BREAKER_OPEN,
+    DeviceHealth,
+)
+from kubernetes_tpu.runtime.queue import PriorityQueue
+from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.utils import metrics as m
+
+from fixtures import TEST_DIMS, make_node, make_pod
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def injector():
+    inj = FaultInjector(seed=7)
+    remove = install_injector(inj)
+    yield inj
+    remove()
+
+
+def _sched(n_nodes=4, cpu="8", **cfg_kw):
+    cache = SchedulerCache(SnapshotEncoder(TEST_DIMS))
+    for i in range(n_nodes):
+        cache.add_node(make_node(f"n{i}", cpu=cpu, mem="8Gi"))
+    kw = dict(
+        batch_size=8,
+        device_backoff_base_s=0.001,
+        device_backoff_max_s=0.005,
+        breaker_open_s=0.02,
+    )
+    kw.update(cfg_kw)
+    return Scheduler(
+        cache=cache, queue=PriorityQueue(), config=SchedulerConfig(**kw)
+    )
+
+
+def _pods(n, prefix="p", cpu="100m"):
+    return [make_pod(f"{prefix}{i}", cpu=cpu, mem="128Mi") for i in range(n)]
+
+
+def _no_pod_lost(sched, pods):
+    """The invariant: every pod handed to the scheduler is either bound
+    (present in the encoder charged to a node) or still reachable through
+    the queue (active/backoff/unschedulable)."""
+    enc = sched.cache.encoder
+    for p in pods:
+        key = (p.namespace, p.name)
+        rec = enc.pods.get(key)
+        bound = rec is not None and rec.node_row >= 0
+        queued = (
+            key in sched.queue._active_entry
+            or key in sched.queue._backoff_entry
+            or key in sched.queue._unschedulable
+        )
+        assert bound or queued, f"pod {key} lost (neither bound nor queued)"
+
+
+# --------------------------------------------------------- classification
+
+
+def test_classification_maps_xla_status_markers():
+    assert classify_device_error(
+        RuntimeError("UNAVAILABLE: socket closed")
+    ) == FAULT_TRANSIENT
+    assert classify_device_error(
+        RuntimeError("INTERNAL: device lost")
+    ) == FAULT_PERSISTENT
+    assert classify_device_error(ValueError("shape mismatch")) is None
+    assert classify_device_error(
+        PersistentDeviceError("gone")
+    ) == FAULT_PERSISTENT
+
+
+# ------------------------------------------------------- transient retries
+
+
+def test_transient_fence_fault_retries_same_batch(injector):
+    injector.arm("fence", kind=FAULT_TRANSIENT, count=1)
+    s = _sched()
+    before = m.FAULT_RETRIES.value(**{"class": "transient"})
+    pods = _pods(4)
+    res = s.schedule_cycle(pods)
+    assert all(r.node is not None for r in res)
+    assert s.device_health.state == BREAKER_CLOSED
+    assert s.device_health.consecutive_failures == 0  # healed by success
+    assert m.FAULT_RETRIES.value(**{"class": "transient"}) == before + 1
+    assert injector.log == [("fence", FAULT_TRANSIENT)]
+    # operator audit trail: the fault was eventful even though it healed
+    assert s.recorder.events(reason="DeviceFault")
+
+
+def test_transient_streak_trips_breaker_and_batch_degrades(injector):
+    injector.arm("fence", kind=FAULT_TRANSIENT)  # unlimited
+    s = _sched(breaker_failure_threshold=3, device_retry_max=5,
+               breaker_open_s=60.0)
+    deg0 = m.DEGRADED_CYCLES.value
+    pods = _pods(4)
+    res = s.schedule_cycle(pods)
+    # threshold consecutive transients opened the breaker mid-retry; the
+    # batch itself was served by the CPU engine — nothing lost
+    assert all(r.node is not None for r in res)
+    assert s.device_health.state == BREAKER_OPEN
+    assert s.device_health.fault_counts[FAULT_TRANSIENT] == 3
+    assert m.DEGRADED_CYCLES.value == deg0 + 1
+    assert s.recorder.events(reason="BreakerOpen")
+
+
+# --------------------------------------- persistent fault -> degraded e2e
+
+
+def test_persistent_fault_completes_workload_on_cpu_then_recovers(injector):
+    """The acceptance-criterion e2e: a persistent device fault mid-run ->
+    the live scheduler completes the workload via the CPU degraded path
+    (no pod lost, no hang), emits breaker Events/metrics, and restores the
+    device path automatically once injection stops."""
+    s = _sched(n_nodes=4, batch_size=4)
+    all_pods = _pods(4, prefix="warm") + _pods(8, prefix="dark") + _pods(
+        4, prefix="heal"
+    )
+    warm, dark, heal = all_pods[:4], all_pods[4:12], all_pods[12:]
+    # phase 1: healthy device
+    for p in warm:
+        s.queue.add(p)
+    placed = sum(s.run_once(timeout=0.05) for _ in range(2))
+    assert placed == 4
+    assert s.device_health.state == BREAKER_CLOSED
+    # phase 2: device lost mid-run
+    injector.arm("fence", kind=FAULT_PERSISTENT)
+    deg0 = m.DEGRADED_CYCLES.value
+    for p in dark:
+        s.queue.add(p)
+    t0 = time.monotonic()
+    placed = 0
+    for _ in range(6):
+        placed += s.run_once(timeout=0.05)
+        if placed >= 8:
+            break
+    assert placed == 8, "workload must complete through the CPU path"
+    assert time.monotonic() - t0 < 10.0  # no hang
+    _no_pod_lost(s, all_pods[:12])
+    assert s.device_health.state == BREAKER_OPEN
+    assert m.DEGRADED_CYCLES.value > deg0
+    assert m.BREAKER_STATE.value == 2.0
+    assert s.recorder.events(reason="BreakerOpen")
+    assert s.recorder.events(reason="DeviceFault")
+    # phase 3: injection stops; cool-down elapses; canary restores device
+    injector.disarm()
+    time.sleep(s.config.breaker_open_s + 0.005)
+    for p in heal:
+        s.queue.add(p)
+    placed = sum(s.run_once(timeout=0.05) for _ in range(3))
+    assert placed == 4
+    assert s.device_health.state == BREAKER_CLOSED
+    assert ("open", "half_open") in s.device_health.transitions
+    assert ("half_open", "closed") in s.device_health.transitions
+    assert s.recorder.events(reason="BreakerClosed")
+    _no_pod_lost(s, all_pods)
+
+
+def test_failed_canary_reopens_breaker(injector):
+    injector.arm("fence", kind=FAULT_PERSISTENT)
+    s = _sched(breaker_open_s=0.01)
+    res = s.schedule_cycle(_pods(4))
+    assert all(r.node is not None for r in res)
+    assert s.device_health.state == BREAKER_OPEN
+    time.sleep(0.015)  # cool-down elapses; next cycle is the canary
+    res2 = s.schedule_cycle(_pods(4, prefix="q"))
+    assert all(r.node is not None for r in res2)
+    assert s.device_health.state == BREAKER_OPEN  # canary failed, re-open
+    assert ("open", "half_open") in s.device_health.transitions
+    assert ("half_open", "open") in s.device_health.transitions
+
+
+# ------------------------------------------------- degraded == device path
+
+
+def test_degraded_cpu_placements_bit_identical_to_device():
+    """Same snapshot, same batches: the CPU degraded path must place every
+    pod on exactly the node the device path picks (winner rows, rotation
+    ties, sequential in-batch commits included)."""
+
+    def build(trip):
+        # heterogeneous nodes (distinct scores) + an identical pair (tie
+        # rotation must match select_host's row-order + last_index contract)
+        cache = SchedulerCache(SnapshotEncoder(TEST_DIMS))
+        for name, cpu in (
+            ("a", "2"), ("b", "4"), ("c", "8"), ("d", "8"), ("e", "16")
+        ):
+            cache.add_node(make_node(name, cpu=cpu, mem="16Gi"))
+        s = Scheduler(
+            cache=cache, queue=PriorityQueue(),
+            config=SchedulerConfig(
+                batch_size=8, engine="sequential", breaker_open_s=60.0
+            ),
+        )
+        if trip:
+            s.device_health.trip()
+        return s
+
+    dev, cpu = build(trip=False), build(trip=True)
+    for batch_no in range(3):  # several batches: last_index advances
+        pods_dev = _pods(6, prefix=f"b{batch_no}-", cpu="300m")
+        pods_cpu = _pods(6, prefix=f"b{batch_no}-", cpu="300m")
+        rd = dev.schedule_cycle(pods_dev)
+        rc = cpu.schedule_cycle(pods_cpu)
+        got_dev = [(r.pod.name, r.node) for r in rd]
+        got_cpu = [(r.pod.name, r.node) for r in rc]
+        assert got_dev == got_cpu, f"batch {batch_no} diverged"
+    assert cpu.device_health.state == BREAKER_OPEN  # never probed (60s)
+    assert dev.device_health.state == BREAKER_CLOSED
+
+
+# ------------------------------------------------------ other fault kinds
+
+
+def test_dispatch_fault_no_fallback_requeues_batch(injector):
+    injector.arm("dispatch", kind=FAULT_PERSISTENT)
+    s = _sched(cpu_fallback=False)
+    pods = _pods(4)
+    with pytest.raises(PersistentDeviceError):
+        s.schedule_cycle(pods)
+    _no_pod_lost(s, pods)
+    assert len(s.queue) == 4
+
+
+def test_corrupted_fetch_detected_and_retried(injector):
+    injector.arm("fetch", kind=FAULT_CORRUPT, count=1)
+    s = _sched(disable_preemption=True)
+    res = s.schedule_cycle(_pods(4))
+    assert all(r.node is not None for r in res)
+    assert injector.log == [("fetch", FAULT_CORRUPT)]
+    assert s.device_health.state == BREAKER_CLOSED
+    # placements are on real nodes, not scrambled rows
+    names = {r.node for r in res}
+    assert names <= {f"n{i}" for i in range(4)}
+
+
+def test_slow_device_is_absorbed_without_breaker_movement(injector):
+    injector.arm("fence", kind=FAULT_SLOW, count=2, latency_s=0.02)
+    s = _sched()
+    res = s.schedule_cycle(_pods(4))
+    assert all(r.node is not None for r in res)
+    assert s.device_health.state == BREAKER_CLOSED
+    assert s.device_health.transitions == []
+
+
+# --------------------------------------------------------- fault matrix
+
+
+@pytest.mark.parametrize("site", list(SITES))
+@pytest.mark.parametrize(
+    "kind", [FAULT_TRANSIENT, FAULT_PERSISTENT, FAULT_CORRUPT, FAULT_SLOW]
+)
+def test_fault_matrix_smoke(injector, site, kind):
+    """Sweep every injection point x fault kind once: whatever fires, the
+    live scheduler neither loses a pod nor wedges, and it still schedules
+    after the injector is disarmed."""
+    injector.arm(site, kind=kind, count=1)
+    s = _sched(disable_preemption=True)
+    pods = _pods(4)
+    for p in pods:
+        s.queue.add(p)
+    for _ in range(3):
+        s.run_once(timeout=0.05)
+    _no_pod_lost(s, pods)
+    # corrupt arms only bite fetch-like sites; others fired exactly once
+    if kind != FAULT_CORRUPT or site == "fetch":
+        assert injector.log, f"{site}/{kind} never fired"
+    injector.disarm()
+    tail = _pods(2, prefix="tail")
+    for p in tail:
+        s.queue.add(p)
+    placed = sum(s.run_once(timeout=0.05) for _ in range(4))
+    assert placed >= 2, "scheduler wedged after the fault cleared"
+    _no_pod_lost(s, pods + tail)
+
+
+# ------------------------------------------------ chaos-harness integration
+
+
+def test_chaosmonkey_device_storm_with_invariants():
+    """The chaosmonkey shape over a device-fault storm: Disruptions arms
+    the injector, the during-hook polls a race-safe liveness probe (a
+    batch legitimately sits in flight mid-cycle, so per-pod accounting is
+    only valid at quiescent points), teardown pins zero-pod-loss once the
+    storm settles."""
+    from kubernetes_tpu.runtime.chaos import Chaosmonkey, ChaosTest, Disruptions
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+
+    s = _sched(n_nodes=4, batch_size=4, breaker_open_s=0.01)
+    dis = Disruptions(LocalCluster())
+    pods = _pods(12, prefix="storm")
+    seen = []
+
+    def probe():
+        # the breaker never reports an out-of-vocabulary state, and the
+        # scheduler thread keeps making progress (results only grow)
+        assert s.device_health.state in ("closed", "open", "half_open")
+        seen.append(len(s.results))
+
+    def disruption():
+        dis.device_lost("fence")
+        for p in pods:
+            s.queue.add(p)
+        for _ in range(8):
+            s.run_once(timeout=0.02)
+        dis.clear_device_faults()
+        time.sleep(s.config.breaker_open_s + 0.005)
+        s.run_once(timeout=0.02)  # canary on an empty/queued poll
+
+    cm = Chaosmonkey(disruption)
+    cm.register(ChaosTest(
+        "no-pod-lost",
+        during=probe,
+        teardown=lambda: _no_pod_lost(s, pods),
+    ))
+    try:
+        cm.do(during_interval=0.01)
+    finally:
+        dis.clear_device_faults()
+    assert seen, "during-hook never polled"
+    # storm over: drain whatever is parked and confirm full completion
+    s.queue.move_all_to_active()
+    for _ in range(8):
+        s.run_once(timeout=0.05)
+    enc = s.cache.encoder
+    bound = sum(
+        1 for p in pods
+        if enc.pods.get((p.namespace, p.name)) is not None
+        and enc.pods[(p.namespace, p.name)].node_row >= 0
+    )
+    assert bound == 12
+    # the breaker only closes when a post-recovery cycle actually probes
+    # the device — push tail work to force the canary
+    tail = _pods(2, prefix="post")
+    for p in tail:
+        s.queue.add(p)
+    for _ in range(3):
+        s.run_once(timeout=0.05)
+    _no_pod_lost(s, tail)
+    assert s.device_health.state == BREAKER_CLOSED
+
+
+# ----------------------------------------------------- DeviceHealth unit
+
+
+def test_device_health_backoff_is_jittered_bounded_deterministic():
+    h1 = DeviceHealth(backoff_base_s=0.01, backoff_max_s=0.05,
+                      backoff_jitter=0.5, seed=3)
+    h2 = DeviceHealth(backoff_base_s=0.01, backoff_max_s=0.05,
+                      backoff_jitter=0.5, seed=3)
+    seq1 = [h1.backoff_s(a) for a in range(6)]
+    seq2 = [h2.backoff_s(a) for a in range(6)]
+    assert seq1 == seq2  # seeded determinism
+    assert all(0.01 <= v <= 0.05 for v in seq1)  # jitter >= base, <= cap
+    assert seq1[1] > seq1[0]  # exponential growth before the cap
+
+
+def test_device_health_halfopen_grants_canary_once_cooled():
+    now = [0.0]
+    h = DeviceHealth(open_duration_s=1.0, clock=lambda: now[0])
+    h.trip()
+    assert not h.allow_device()
+    now[0] = 0.5
+    assert not h.allow_device()
+    now[0] = 1.5
+    assert h.allow_device()  # canary granted; state is half_open
+    assert h.state == "half_open"
+    h.record_success()
+    assert h.state == BREAKER_CLOSED
+    assert h.transitions == [
+        ("closed", "open"), ("open", "half_open"), ("half_open", "closed")
+    ]
+
+
+def test_pipelined_loop_degrades_classified_fence_fault(injector):
+    """The pipelined double-buffer path shares the resilient fence: a
+    persistent fault on batch k's fence degrades k to the CPU engine (and
+    the breaker governs batch k+1's engine choice) — no pod lost, both
+    waves placed."""
+    injector.arm("fence", kind=FAULT_PERSISTENT)
+    s = _sched(batch_size=4, pipeline_commit=True, breaker_open_s=60.0)
+    pods = _pods(8, prefix="pl")
+    for p in pods:
+        s.queue.add(p)
+    placed = 0
+    for _ in range(6):
+        placed += s.run_once(timeout=0.02)
+    placed += s.flush_pipeline()
+    assert placed == 8
+    _no_pod_lost(s, pods)
+    assert s.device_health.state == BREAKER_OPEN
+
+
+def test_gang_members_survive_device_fault_via_plain_path(injector):
+    """The gang launch has its own device path with no degraded engine: a
+    classified fault there must feed the breaker and demote the members
+    to the plain (retry/degrade-capable) path — popped gang members are
+    never lost, and during an open breaker gangs schedule as plain pods
+    (liveness over atomicity)."""
+    injector.arm("fetch", kind=FAULT_PERSISTENT)
+    s = _sched(n_nodes=4, batch_size=8, breaker_open_s=60.0,
+               disable_preemption=True)
+    gang = []
+    for i in range(3):
+        p = make_pod(f"g{i}", cpu="100m", mem="128Mi")
+        p.labels[Scheduler.POD_GROUP_LABEL] = "team"
+        p.labels[Scheduler.POD_GROUP_MIN_MEMBER] = "3"
+        gang.append(p)
+        s.queue.add(p)
+    placed = 0
+    for _ in range(4):
+        placed += s.run_once(timeout=0.05)
+    assert placed == 3, "gang members must place via the degraded path"
+    _no_pod_lost(s, gang)
+    assert s.device_health.state == BREAKER_OPEN
+    assert s.recorder.events(reason="DeviceFault")
+
+
+def test_validate_hosts_rejects_negative_corruption():
+    """A winner value below -1 is wire corruption, not a FitError: it must
+    raise the classified CorruptedFetchError (retry), never silently park
+    the pod as unschedulable."""
+    from kubernetes_tpu.codec.faults import CorruptedFetchError
+
+    s = _sched()
+    with pytest.raises(CorruptedFetchError):
+        s._validate_hosts(np.array([-7, 0, 1, 2], np.int32), 4)
+    # the legit range passes untouched
+    out = s._validate_hosts(np.array([-1, 0, 1, 2], np.int32), 4)
+    np.testing.assert_array_equal(out, [-1, 0, 1, 2])
+
+
+def test_gang_fault_after_partial_commit_never_double_binds(monkeypatch):
+    """schedule_gangs commits gang-by-gang: when a later gang's launch
+    faults, members of already-committed gangs are bound and must NOT be
+    re-scheduled (double bind / double capacity charge) — only the
+    genuinely unplaced members recover through the plain path."""
+    from kubernetes_tpu.models.gang import GangScheduler
+
+    binds = []
+    cache = SchedulerCache(SnapshotEncoder(TEST_DIMS))
+    for i in range(4):
+        cache.add_node(make_node(f"n{i}", cpu="8", mem="8Gi"))
+    s = Scheduler(
+        cache=cache, queue=PriorityQueue(),
+        binder=lambda p, n: binds.append(p.name) or True,
+        config=SchedulerConfig(batch_size=16, breaker_open_s=60.0,
+                               disable_preemption=True),
+    )
+    pods = []
+    for g, gname in enumerate(("alpha", "beta")):
+        for i in range(3):
+            p = make_pod(f"{gname}-{i}", cpu="100m", mem="128Mi")
+            p.labels[Scheduler.POD_GROUP_LABEL] = gname
+            p.labels[Scheduler.POD_GROUP_MIN_MEMBER] = "3"
+            pods.append(p)
+            s.queue.add(p)
+
+    orig = GangScheduler.schedule_gangs
+
+    def commit_first_then_lose_device(self, gangs):
+        orig(self, gangs[:1])  # gang alpha commits (assume + bind) for real
+        raise PersistentDeviceError("injected device-lost at gang launch")
+
+    monkeypatch.setattr(
+        GangScheduler, "schedule_gangs", commit_first_then_lose_device
+    )
+    placed = s.run_once(timeout=0.05)
+    monkeypatch.setattr(GangScheduler, "schedule_gangs", orig)
+    # alpha stayed bound exactly once; beta recovered via the degraded
+    # plain path in the SAME cycle (persistent fault tripped the breaker)
+    assert placed == 6
+    assert sorted(binds) == sorted(p.name for p in pods), binds
+    assert len(binds) == 6  # no double bind
+    _no_pod_lost(s, pods)
+    assert s.device_health.state == BREAKER_OPEN
+    by_name = {r.pod.name: r.node for r in s.results}
+    assert all(by_name.get(p.name) for p in pods)

@@ -355,3 +355,155 @@ def test_client_bind_error_surfaces_from_our_server():
             ext.bind("default", "ghost", "uid", "n0")
     finally:
         srv.stop()
+
+
+# --------------------------------------------------- transient-retry path
+
+
+class FlakyTransport:
+    """Fails the first `n_failures` calls with a connection-level error,
+    then delegates to canned responses."""
+
+    def __init__(self, responses, n_failures, exc=ConnectionRefusedError):
+        self.responses = responses
+        self.n_failures = n_failures
+        self.exc = exc
+        self.calls = 0
+
+    def __call__(self, url, payload, timeout):
+        self.calls += 1
+        if self.calls <= self.n_failures:
+            raise self.exc("connection refused")
+        verb = url.rsplit("/", 1)[1]
+        return self.responses[verb]
+
+
+def test_flaky_transport_retries_within_budget():
+    t = FlakyTransport(
+        {"filter": {"nodenames": ["a", "b"], "failedNodes": {}, "error": ""}},
+        n_failures=2,
+    )
+    ext = HTTPExtender(
+        ExtenderConfig(
+            url_prefix="http://flaky", filter_verb="filter",
+            node_cache_capable=True, http_timeout=2.0,
+            max_retries=3, retry_backoff_s=0.001,
+        ),
+        transport=t,
+    )
+    ok, failed = ext.filter(make_pod("p", cpu="100m"), ["a", "b", "c"])
+    assert ok == ["a", "b"] and failed == {}
+    assert t.calls == 3  # 2 failures + 1 success
+
+
+def test_retry_budget_exhausted_raises_extender_error():
+    t = FlakyTransport({}, n_failures=99)
+    ext = HTTPExtender(
+        ExtenderConfig(
+            url_prefix="http://dead", filter_verb="filter",
+            node_cache_capable=True, http_timeout=0.2,
+            max_retries=2, retry_backoff_s=0.001,
+        ),
+        transport=t,
+    )
+    import time as _t
+    t0 = _t.monotonic()
+    with pytest.raises(ExtenderError) as ei:
+        ext.filter(make_pod("p", cpu="100m"), ["a"])
+    assert _t.monotonic() - t0 < 1.0  # bounded by the total budget
+    assert t.calls == 3  # initial + max_retries
+    assert "attempts" in str(ei.value)
+
+
+def test_timeout_budget_caps_retry_train():
+    """A tiny http_timeout forbids even one backoff pause: the train stops
+    early rather than stretching the cycle past the operator's budget."""
+    t = FlakyTransport({}, n_failures=99)
+    ext = HTTPExtender(
+        ExtenderConfig(
+            url_prefix="http://dead2", filter_verb="filter",
+            node_cache_capable=True, http_timeout=0.005,
+            max_retries=10, retry_backoff_s=0.5,
+        ),
+        transport=t,
+    )
+    with pytest.raises(ExtenderError):
+        ext.filter(make_pod("p", cpu="100m"), ["a"])
+    assert t.calls == 1  # the 0.5s pause would blow the 5ms budget
+
+
+def test_application_error_is_not_retried():
+    t = FakeTransport({"filter": {"error": "policy says no"}})
+    ext = HTTPExtender(
+        ExtenderConfig(
+            url_prefix="http://app", filter_verb="filter",
+            node_cache_capable=True, max_retries=5, retry_backoff_s=0.001,
+        ),
+        transport=t,
+    )
+    with pytest.raises(ExtenderError):
+        ext.filter(make_pod("p", cpu="100m"), ["a"])
+    assert len(t.calls) == 1  # application errors surface immediately
+
+
+def test_ignorable_flaky_extender_skipped_after_bounded_retries():
+    """An ignorable extender that stays down delays the cycle by at most
+    its own budget, then the scheduler skips it (extender.go:534-537)."""
+    t = FlakyTransport({}, n_failures=99)
+    ext = HTTPExtender(
+        ExtenderConfig(
+            url_prefix="http://down", filter_verb="filter",
+            node_cache_capable=True, ignorable=True,
+            http_timeout=0.1, max_retries=1, retry_backoff_s=0.001,
+        ),
+        transport=t,
+    )
+    s, bound = _sched([ext])
+    pod = make_pod("p0", cpu="100m")
+    res = s.schedule_cycle([pod])
+    assert res[0].node is not None  # placement proceeded without the extender
+    assert t.calls == 2
+
+
+def test_http_error_status_is_not_retried():
+    """HTTPError (non-2xx) subclasses URLError but means the request
+    REACHED the server — re-POSTing (especially a bind) is unsafe, so it
+    surfaces immediately with no retry."""
+    import io
+    import urllib.error
+
+    calls = []
+
+    def transport(url, payload, timeout):
+        calls.append(url)
+        raise urllib.error.HTTPError(
+            url, 500, "boom", hdrs=None, fp=io.BytesIO(b"")
+        )
+
+    ext = HTTPExtender(
+        ExtenderConfig(
+            url_prefix="http://err", filter_verb="filter",
+            node_cache_capable=True, max_retries=5, retry_backoff_s=0.001,
+        ),
+        transport=transport,
+    )
+    with pytest.raises(ExtenderError):
+        ext.filter(make_pod("p", cpu="100m"), ["a"])
+    assert len(calls) == 1
+
+
+def test_bind_verb_is_never_retried():
+    """bind is not idempotent: a transport timeout may fire AFTER the
+    server executed the bind, so transient errors surface immediately
+    instead of re-POSTing."""
+    t = FlakyTransport({"bind": {}}, n_failures=1, exc=TimeoutError)
+    ext = HTTPExtender(
+        ExtenderConfig(
+            url_prefix="http://bindy", bind_verb="bind",
+            http_timeout=1.0, max_retries=5, retry_backoff_s=0.001,
+        ),
+        transport=t,
+    )
+    with pytest.raises(ExtenderError):
+        ext.bind("default", "p0", "uid0", "n0")
+    assert t.calls == 1  # exactly one POST, no retry

@@ -131,9 +131,11 @@ def test_async_fetch_overlaps_and_reports_window():
 
 
 def test_device_failure_requeues_inflight_batch():
-    """A device error surfaces at the ready-fence (AsyncFetch.result
+    """An UNCLASSIFIED error surfaces at the ready-fence (AsyncFetch.result
     re-raises).  The in-flight batch's pods were already popped from the
-    queue — they must be requeued, not silently lost."""
+    queue — they must be requeued, not silently lost.  (Classified device
+    faults no longer reach this guard: they retry/degrade instead —
+    tests/test_device_faults.py pins that layer.)"""
     import pytest
 
     sched = _mk_scheduler(pipeline=True)
@@ -147,10 +149,10 @@ def test_device_failure_requeues_inflight_batch():
         seconds = 0.0
 
         def result(self):
-            raise RuntimeError("RESOURCE_EXHAUSTED: device fell over")
+            raise ValueError("host-side bug: stale winners buffer")
 
     sched._in_flight.fetch = _Boom()
-    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+    with pytest.raises(ValueError, match="stale winners"):
         sched.flush_pipeline()
     assert not sched.pipeline_pending
     q = sched.queue
@@ -162,9 +164,9 @@ def test_device_failure_requeues_inflight_batch():
 
 
 def test_device_failure_requeues_next_batch_too():
-    """When batch k's ready-fence raises inside the pipelined loop, the
-    ALREADY-POPPED batch k+1 (which never reached the device) must also
-    be requeued — neither batch may be lost."""
+    """When batch k's ready-fence raises an UNCLASSIFIED error inside the
+    pipelined loop, the ALREADY-POPPED batch k+1 (which never reached the
+    device) must also be requeued — neither batch may be lost."""
     import pytest
 
     sched = _mk_scheduler(pipeline=True)
@@ -178,13 +180,13 @@ def test_device_failure_requeues_next_batch_too():
         seconds = 0.0
 
         def result(self):
-            raise RuntimeError("RESOURCE_EXHAUSTED: device fell over")
+            raise ValueError("host-side bug: stale winners buffer")
 
     sched._in_flight.fetch = _Boom()
     wave_b = [make_pod(f"b-{i}", cpu="100m", mem="64Mi") for i in range(4)]
     for p in wave_b:
         sched.queue.add(p)
-    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+    with pytest.raises(ValueError, match="stale winners"):
         sched.run_once(timeout=0.05)  # pops wave B, fence on A raises
     q = sched.queue
     parked = (

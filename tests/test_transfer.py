@@ -145,3 +145,58 @@ def test_pack_tree_factoring_randomized_property():
                                           err_msg=f"seed {seed} {k}")
             np.testing.assert_array_equal(np.asarray(out_d[k]), v,
                                           err_msg=f"seed {seed} {k}")
+
+
+# ------------------------------------------------------- fetch worker
+
+
+def test_fetch_worker_survives_raising_job():
+    """Regression: a job that raises on the shared fetch worker used to
+    kill the daemon thread, stranding every already-queued fetch (their
+    AsyncFetch.result() hung forever).  Per-job exceptions must be
+    contained and the worker must keep draining."""
+    from kubernetes_tpu.codec.transfer import AsyncFetch, _fetch_worker
+
+    w = _fetch_worker()
+    w.submit(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    # a fetch queued AFTER the poison job still completes promptly
+    f = AsyncFetch(np.arange(8, dtype=np.int32))
+    deadline = 2.0
+    import time as _t
+    t0 = _t.monotonic()
+    out = f.result()
+    assert _t.monotonic() - t0 < deadline
+    np.testing.assert_array_equal(out, np.arange(8, dtype=np.int32))
+    assert w.thread.is_alive()
+
+
+def test_async_fetch_routes_job_error_into_handle():
+    """An error raised while materializing re-raises at result() — the
+    owning handle, not the worker thread, owns the failure."""
+    from kubernetes_tpu.codec.transfer import AsyncFetch
+
+    class Evil:
+        def __array__(self, *a, **k):
+            raise RuntimeError("UNAVAILABLE: tunnel reset")
+
+    f = AsyncFetch(Evil())
+    with np.testing.assert_raises(RuntimeError):
+        f.result()
+    # and the worker still serves later fetches
+    g = AsyncFetch(np.ones(3, np.float32))
+    np.testing.assert_array_equal(g.result(), np.ones(3, np.float32))
+
+
+def test_device_snapshot_cache_invalidate_forces_full_reupload():
+    enc = SnapshotEncoder(TEST_DIMS)
+    for i in range(4):
+        enc.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    cache = DeviceSnapshotCache()
+    d1 = cache.update(enc.snapshot())
+    cache.invalidate()
+    d2 = cache.update(enc.snapshot())
+    # no resident buffer survived: every field re-uploaded (new objects)
+    assert d2.label_keys is not d1.label_keys
+    np.testing.assert_array_equal(
+        np.asarray(d2.requested), np.asarray(d1.requested)
+    )
