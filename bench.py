@@ -100,6 +100,11 @@ _N_DEPLOY = 20
 _ZONE = "failure-domain.beta.kubernetes.io/zone"
 
 
+# per-node pod-slot cap for the bench fleet (binds before the 32-cpu /
+# 100m-request limit would); run_overload sizes its storm against it
+_NODE_PODS_CAP = 110
+
+
 def _bench_nodes(args):
     """The 5k-node fleet's node OBJECTS — constructed once and reused, so
     node-encode timings measure encoder ingestion, not object parsing."""
@@ -110,7 +115,7 @@ def _bench_nodes(args):
             f"node-{i}",
             cpu="32",
             mem="256Gi",
-            pods=110,
+            pods=_NODE_PODS_CAP,
             labels={_ZONE: f"zone-{i % 8}", "tier": "a" if i % 3 else "b"},
             taints=[{"key": "dedicated", "value": "x", "effect": "NoSchedule"}]
             if i % 50 == 0
@@ -597,6 +602,162 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
     }
 
 
+def run_overload(args) -> dict:
+    """Overload scenario (ISSUE 4): bank the live path's SATURATED
+    throughput, then offer --overload-factor x that rate, sustained,
+    against a BOUNDED shedding queue with AIMD adaptive batching —
+    report goodput under pressure, shed rate, storm-phase p99, and
+    post-storm recovery (queue drained, batch width back to baseline)."""
+    import threading
+
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+    from kubernetes_tpu.runtime.queue import PriorityQueue
+    from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+    enc = _build_encoder(args)
+    cache = SchedulerCache(enc)
+    capacity = max(args.batch * 4, 1024)
+    baseline = max(args.batch // 16, 16)
+    queue = PriorityQueue(capacity=capacity)
+    # per-pod arrival stamps + bind latencies, storm phase only (the
+    # global E2E histogram mixes in the saturation phase's deep-queue
+    # waits, which are not the number under test here)
+    arrival: dict = {}
+    bind_log: list = []
+    stats = {"bound": 0}
+
+    def binder(pod, node) -> bool:
+        stats["bound"] += 1
+        t = arrival.pop(pod.name, None)
+        if t is not None:
+            now = time.monotonic()
+            bind_log.append((now, now - t))
+        return True
+
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=binder,
+        config=SchedulerConfig(
+            batch_size=args.batch, batch_window_s=0.0, engine=args.engine,
+            disable_preemption=True, batched_commit=True,
+            pipeline_commit=True, adaptive_batch=True,
+            batch_size_min=baseline, cycle_deadline_s=0.25,
+        ),
+    )
+
+    def _drain(budget_s: float) -> int:
+        placed = 0
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            got = sched.run_once(timeout=0.0)
+            placed += got
+            if got == 0 and not sched.pipeline_pending:
+                if not queue.has_schedulable():
+                    break
+                time.sleep(0.002)
+        return placed + sched.flush_pipeline()
+
+    # warmup: AIMD sweeps the batch width, and each new pow2 pad is a
+    # fresh XLA compile — pay ALL of them here, not inside the measured
+    # saturation window (otherwise phase 1 under-reports and the storm
+    # "beats" saturation)
+    seq = 2_000_000
+    w = baseline
+    while True:
+        sched._cur_batch = w
+        for _ in range(w):
+            queue.add(_pending_pod(args, seq))
+            seq += 1
+        _drain(600)
+        if w >= args.batch:
+            break
+        w = min(w * 2, args.batch)
+    sched._cur_batch = baseline
+    n_sat = min(args.pods, capacity)  # a deeper pour would shed in phase 1
+    sat_pods = [_pending_pod(args, 1_000_000 + i) for i in range(n_sat)]
+    t0 = time.monotonic()
+    for p in sat_pods:
+        queue.add(p)
+    sat_placed = _drain(600)
+    sat_dt = time.monotonic() - t0
+    tput_sat = sat_placed / sat_dt if sat_dt > 0 else 0.0
+
+    # phase 2: the storm — offered load = factor x saturated throughput,
+    # arrivals paced against the wall clock while the scheduler runs live.
+    # The storm is capped at ~80% of REMAINING cluster capacity: past
+    # that, goodput measures node exhaustion (every pod a FitError), not
+    # control-plane overload — the scenario under test
+    offered = max(tput_sat * args.overload_factor, 1.0)
+    slots_left = max(args.nodes * _NODE_PODS_CAP - stats["bound"], 0)
+    count = int(min(
+        offered * args.overload_duration, 200_000, 0.8 * slots_left
+    ))
+    duration = count / offered
+    storm_pods = [_pending_pod(args, i) for i in range(count)]
+    for i, p in enumerate(storm_pods):
+        # two priority bands: shedding must fall entirely on the low band
+        p.spec.priority = 100 if i % 10 == 0 else 0
+    shed0 = queue.shed_total
+    stop = threading.Event()
+
+    def _serve():
+        while not stop.is_set():
+            if sched.run_once(timeout=0.005) == 0 and not sched.pipeline_pending:
+                if not queue.has_schedulable():
+                    time.sleep(0.001)
+        sched.flush_pipeline()
+
+    server = threading.Thread(target=_serve, daemon=True)
+    server.start()
+    t_storm0 = time.monotonic()
+    for i, p in enumerate(storm_pods):
+        arrival[p.name] = time.monotonic()
+        queue.add(p)
+        # pace in ~32-pod chunks against the wall clock: per-pod sub-ms
+        # sleeps degrade into a GIL-hogging spin that starves the serving
+        # thread and measures the adder, not the scheduler
+        if (i & 31) == 31:
+            lag = t_storm0 + (i + 1) / offered - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
+    t_storm1 = time.monotonic()
+    # recovery: let the backlog drain, then stop the serving thread
+    deadline = time.monotonic() + 120.0
+    while queue.has_schedulable() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.05)
+    stop.set()
+    server.join(timeout=10.0)
+    shed = queue.shed_total - shed0
+    in_storm = [lat for t, lat in bind_log if t <= t_storm1]
+    goodput = len(in_storm) / (t_storm1 - t_storm0) if count else 0.0
+    p99 = (
+        sorted(in_storm)[max(0, int(len(in_storm) * 0.99) - 1)]
+        if in_storm else 0.0
+    )
+    recovered = (not queue.has_schedulable()
+                 and sched._cur_batch == baseline)
+    goodput_ratio = goodput / tput_sat if tput_sat > 0 else 0.0
+    return {
+        "metric": "overload_goodput_pods_per_s",
+        "value": round(goodput, 1),
+        "unit": "pods/s",
+        "detail": {
+            "saturated_pods_per_s": round(tput_sat, 1),
+            "offered_pods_per_s": round(offered, 1),
+            "overload_factor": args.overload_factor,
+            "storm_seconds": round(duration, 2),
+            "storm_pods": count,
+            "goodput_ratio": round(goodput_ratio, 3),
+            "shed_total": shed,
+            "shed_rate_per_s": round(shed / duration, 1) if duration else 0.0,
+            "p99_storm_latency_ms": round(p99 * 1000, 1),
+            "queue_capacity": capacity,
+            "batch_baseline": baseline,
+            "recovered": recovered,
+        },
+    }
+
+
 def run_density(args) -> dict:
     """Sustained-density mode (VERDICT r4 #8): the reference's 30k-pod
     density config against a LIVE control plane — 1k hollow nodes, pods
@@ -704,7 +865,12 @@ def run_child(args) -> None:
             return
 
         try:
-            result = run_density(args) if args.density else run(args)
+            if args.overload:
+                result = run_overload(args)
+            elif args.density:
+                result = run_density(args)
+            else:
+                result = run(args)
         except Exception as e:  # compile/runtime failure mid-run
             _emit(_error_line("run", e))
             return
@@ -748,6 +914,10 @@ def _child_cmd(args, platform: str | None) -> list:
         if args.density_arrival_rate is not None:
             cmd += ["--density-arrival-rate",
                     str(args.density_arrival_rate)]
+    if args.overload:
+        cmd += ["--overload",
+                "--overload-factor", str(args.overload_factor),
+                "--overload-duration", str(args.overload_duration)]
     if platform:
         cmd += ["--platform", platform]
     return cmd
@@ -804,9 +974,9 @@ def orchestrate(args) -> None:
     # ---- phase 2: exactly ONE TPU attempt inside whatever budget remains.
     remaining = deadline - time.time()
     tpu_min = args.tpu_min_budget
-    if args.platform == "cpu" or args.density:
-        # explicit cpu-only run, or density mode (a control-plane
-        # benchmark — the host runtime dominates, not the device)
+    if args.platform == "cpu" or args.density or args.overload:
+        # explicit cpu-only run, or density/overload mode (control-plane
+        # benchmarks — the host runtime dominates, not the device)
         remaining = 0
     if remaining < tpu_min:
         det = banked["result"].setdefault("detail", {})
@@ -896,6 +1066,17 @@ def main():
                     help="paced pod arrival (pods/s) instead of deep-queue "
                     "waves: below saturation this measures the true per-pod "
                     "latency distribution vs the <=5s e2e SLO")
+    ap.add_argument("--overload", action="store_true",
+                    help="overload scenario: measure saturated throughput, "
+                    "then offer --overload-factor x that rate against a "
+                    "bounded shedding queue with adaptive batching; "
+                    "reports goodput, shed rate, p99, recovery")
+    ap.add_argument("--overload-factor", type=float, default=2.0,
+                    help="offered load as a multiple of measured saturated "
+                    "throughput")
+    ap.add_argument("--overload-duration", type=float, default=10.0,
+                    help="sustained storm window seconds (pod count capped "
+                    "at 200k)")
     ap.add_argument("--lock-timeout", type=float, default=300.0, help="seconds")
     ap.add_argument("--init-timeout", type=float, default=600.0,
                     help="seconds before a hung backend init fails the single "

@@ -1,0 +1,237 @@
+"""API Priority & Fairness-style inflight limiting for the REST layer.
+
+The reference bounds apiserver demand twice over: the legacy
+--max-requests-inflight / --max-mutating-requests-inflight gate
+(apiserver/pkg/server/filters/maxinflight.go) and, later, API Priority and
+Fairness (apiserver/pkg/util/flowcontrol): requests are classified into
+flows, each flow gets a bounded queue, and the scarce inflight slots are
+dealt fairly across flows so one greedy client cannot starve the rest.
+Over-limit requests are rejected with 429 TooManyRequests + Retry-After
+(filters/maxinflight.go:157-172) — the signal well-behaved clients back
+off on.
+
+This module distills that to the behavior-shaping core:
+
+  * two verb classes — MUTATING (POST/PUT/PATCH/DELETE) and READONLY
+    (GET) — each with its own inflight ceiling, like the reference's
+    split flags;
+  * a *flow* is (client identity, verb class); when the ceiling is hit,
+    waiters park in per-flow FIFO queues of bounded length and slots are
+    granted ROUND-ROBIN across flows with waiters (the fair-queuing
+    analog, shed of its shuffle-sharding) — a flow with 100 queued
+    requests and a flow with 1 alternate grants, so the greedy flow
+    cannot starve the polite one;
+  * a full flow queue, or a queue wait exceeding the timeout, rejects
+    the request immediately — the caller turns that into
+    429 + Retry-After.
+
+The limiter is transport-agnostic (acquire/release around any handler);
+apiserver/server.py wires it ahead of the admission chain and exempts the
+liveness surface (/healthz, /metrics, ...) and long-lived watch streams,
+exactly as the reference's filter chain does.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Deque, Dict, Optional
+
+from kubernetes_tpu.utils import metrics as m
+
+MUTATING = "mutating"
+READONLY = "readOnly"
+
+# verbs that write (the reference's readonly/mutating split,
+# maxinflight.go:40-47); everything else is readonly
+MUTATING_METHODS = frozenset({"POST", "PUT", "PATCH", "DELETE"})
+
+
+class TooManyRequests(Exception):
+    """Over-limit rejection: the HTTP layer renders this as
+    429 TooManyRequests with a Retry-After header (the reference's
+    tooManyRequests helper, filters/maxinflight.go:157-172)."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+@dataclass
+class FlowControlConfig:
+    """The operator knobs (the --max-requests-inflight family plus the
+    APF queue shape)."""
+
+    # inflight ceilings per verb class; <=0 disables limiting for that class
+    max_inflight_mutating: int = 200
+    max_inflight_readonly: int = 400
+    # bounded per-flow queue: the (queues * queueLengthLimit) analog;
+    # a flow with this many waiters already parked rejects further arrivals
+    queue_length_per_flow: int = 50
+    # how long a request may wait in its flow queue before 429
+    queue_wait_timeout_s: float = 1.0
+    # the Retry-After hint stamped on rejections (seconds)
+    retry_after_s: float = 1.0
+
+
+class _Waiter:
+    __slots__ = ("event", "granted")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.granted = False
+
+
+class _ClassLimiter:
+    """One verb class: `limit` inflight slots, per-flow FIFO queues,
+    round-robin grant across flows with waiters."""
+
+    def __init__(self, kind: str, cfg: FlowControlConfig, limit: int):
+        self.kind = kind
+        self.cfg = cfg
+        self.limit = limit
+        self._lock = threading.Lock()
+        self.inflight = 0
+        # flow -> FIFO of parked waiters; the ring rotates through flows
+        # that currently have waiters (round-robin fairness)
+        self._queues: "OrderedDict[str, Deque[_Waiter]]" = OrderedDict()
+        self._ring: Deque[str] = deque()
+        # grants per flow since start (observability + fairness tests)
+        self.grants: Dict[str, int] = {}
+
+    # ---- internal (lock held) ----
+
+    def _drop_flow_if_empty(self, flow: str) -> None:
+        if not self._queues.get(flow):
+            self._queues.pop(flow, None)
+            try:
+                self._ring.remove(flow)
+            except ValueError:
+                pass
+
+    def _grant_waiters(self) -> None:
+        """Hand free slots to parked waiters, one flow per grant in ring
+        order (the fair-queuing dequeue).  Keeps the invariant that
+        waiters exist only while inflight == limit."""
+        while self.inflight < self.limit and self._ring:
+            flow = self._ring[0]
+            q = self._queues.get(flow)
+            if not q:
+                self._drop_flow_if_empty(flow)
+                continue
+            w = q.popleft()
+            # rotate so the NEXT grant serves a different flow first
+            self._ring.rotate(-1)
+            self._drop_flow_if_empty(flow)
+            self.inflight += 1
+            w.granted = True
+            w.event.set()
+        m.APF_INFLIGHT.set(float(self.inflight), request_kind=self.kind)
+
+    def _reject(self, flow: str, reason: str) -> TooManyRequests:
+        m.APF_REJECTED.inc(request_kind=self.kind, reason=reason)
+        return TooManyRequests(
+            f"too many {self.kind} requests for flow {flow!r} ({reason}), "
+            "please try again later",
+            self.cfg.retry_after_s,
+        )
+
+    # ---- surface ----
+
+    def acquire(self, flow: str) -> None:
+        """Take one inflight slot for `flow`, or raise TooManyRequests.
+        Queued waiters are granted slots round-robin ACROSS flows, FIFO
+        within a flow; a new arrival never jumps past parked waiters."""
+        with self._lock:
+            self._grant_waiters()
+            if self.inflight < self.limit and not self._ring:
+                self.inflight += 1
+                self.grants[flow] = self.grants.get(flow, 0) + 1
+                m.APF_INFLIGHT.set(float(self.inflight),
+                                   request_kind=self.kind)
+                return
+            q = self._queues.get(flow)
+            depth = len(q) if q is not None else 0
+            if depth >= max(self.cfg.queue_length_per_flow, 0):
+                raise self._reject(flow, "queue full")
+            if q is None:
+                q = self._queues[flow] = deque()
+            w = _Waiter()
+            q.append(w)
+            if flow not in self._ring:
+                self._ring.append(flow)
+        if w.event.wait(self.cfg.queue_wait_timeout_s):
+            with self._lock:
+                self.grants[flow] = self.grants.get(flow, 0) + 1
+            return
+        with self._lock:
+            if w.granted:
+                # the grant raced the timeout: the slot is ours after all
+                self.grants[flow] = self.grants.get(flow, 0) + 1
+                return
+            q = self._queues.get(flow)
+            if q is not None:
+                try:
+                    q.remove(w)
+                except ValueError:
+                    pass
+                self._drop_flow_if_empty(flow)
+        raise self._reject(flow, "timeout")
+
+    def release(self) -> None:
+        """Return a slot and replay it to the next waiter (round-robin
+        across flows)."""
+        with self._lock:
+            self.inflight = max(0, self.inflight - 1)
+            self._grant_waiters()
+
+    def queued(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._queues.values())
+
+
+class InflightLimiter:
+    """The two verb-class limiters behind one acquire/release surface."""
+
+    def __init__(self, config: Optional[FlowControlConfig] = None):
+        self.config = config or FlowControlConfig()
+        self._classes: Dict[bool, Optional[_ClassLimiter]] = {
+            True: (
+                _ClassLimiter(MUTATING, self.config,
+                              self.config.max_inflight_mutating)
+                if self.config.max_inflight_mutating > 0 else None
+            ),
+            False: (
+                _ClassLimiter(READONLY, self.config,
+                              self.config.max_inflight_readonly)
+                if self.config.max_inflight_readonly > 0 else None
+            ),
+        }
+
+    @staticmethod
+    def flow_of(auth_header: str, client_host: str) -> str:
+        """Flow identity: the caller's credential when one is presented
+        (per-user fairness, the APF flow-distinguisher on username),
+        else the client address.  Runs BEFORE authn — the limiter must
+        shed load without paying the authn path."""
+        if auth_header:
+            return f"cred:{hash(auth_header) & 0xFFFFFFFF:08x}"
+        return f"host:{client_host}"
+
+    def acquire(self, flow: str, mutating: bool) -> Optional[_ClassLimiter]:
+        """Take a slot; returns the class limiter to release() on, or
+        None when that class is unlimited.  Raises TooManyRequests."""
+        lim = self._classes[bool(mutating)]
+        if lim is None:
+            return None
+        lim.acquire(flow)
+        return lim
+
+    def queued(self, mutating: bool) -> int:
+        lim = self._classes[bool(mutating)]
+        return 0 if lim is None else lim.queued()
+
+    def grants(self, mutating: bool) -> Dict[str, int]:
+        lim = self._classes[bool(mutating)]
+        return {} if lim is None else dict(lim.grants)

@@ -354,8 +354,20 @@ class APIServer:
         authenticator=None,
         authorizer=None,
         tls: Optional["TLSConfig"] = None,
+        flow_control=None,
     ):
         self.cluster = cluster if cluster is not None else LocalCluster()
+        # APF-style inflight limiting (apiserver/fairness.py): accepts a
+        # FlowControlConfig or a prebuilt InflightLimiter; None = open
+        # server (unlimited, the historical behavior)
+        from kubernetes_tpu.apiserver.fairness import (
+            FlowControlConfig,
+            InflightLimiter,
+        )
+
+        if isinstance(flow_control, FlowControlConfig):
+            flow_control = InflightLimiter(flow_control)
+        self.flow_control: Optional[InflightLimiter] = flow_control
         # per-request custom-resource version (set by _route_extension,
         # consumed by the conversion seams; thread-local because the
         # HTTP server runs one thread per connection)
@@ -743,6 +755,29 @@ class APIServer:
                      "reason": reason, "message": message},
                     code,
                 )
+
+            def _too_many_requests(self, message: str,
+                                   retry_after_s: float) -> None:
+                """THE 429 path — shared by the inflight limiter's
+                rejection and the eviction-blocked-by-PDB response: a
+                Status body plus the Retry-After header clients key
+                their backoff on (the reference stamps it in both
+                places: filters/maxinflight.go tooManyRequests and
+                registry/core/pod/rest/eviction.go)."""
+                self._audit_resp_obj = obj = {
+                    "kind": "Status", "apiVersion": "v1", "code": 429,
+                    "reason": "TooManyRequests", "message": message,
+                }
+                body = json.dumps(obj).encode()
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header(
+                    "Retry-After",
+                    str(max(1, int(-(-retry_after_s // 1)))),  # ceil, >=1s
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def _body(self) -> dict:
                 n = int(self.headers.get("Content-Length", 0))
@@ -1511,11 +1546,17 @@ class APIServer:
                                 (p.metadata.name for p in matching
                                  if p.disruptions_allowed <= 0), None)
                             if blocked is not None:
-                                self._status(
-                                    429, "TooManyRequests",
+                                # a blocked eviction is retryable once the
+                                # disruption window reopens: same 429 +
+                                # Retry-After construction as the limiter
+                                fc = outer.flow_control
+                                self._too_many_requests(
                                     "Cannot evict pod as it would "
                                     f"violate the pod's disruption "
-                                    f"budget {blocked!r}")
+                                    f"budget {blocked!r}",
+                                    fc.config.retry_after_s
+                                    if fc is not None else 1.0,
+                                )
                                 return
                             # consume the budget immediately (the registry
                             # decrements before the async controller
@@ -1930,4 +1971,44 @@ class APIServer:
                         outer._audit(_verb, self.path, 0, handler=self)
 
             setattr(Handler, method, wrapped)
+        # APF-style inflight limiting (apiserver/fairness.py), OUTERMOST
+        # wrapper: over-limit requests are rejected with 429 + Retry-After
+        # before authn/admission/audit spend anything on them (the
+        # reference's filter-chain order: WithMaxInFlightLimit wraps the
+        # whole handler).  The liveness surface and long-lived watch
+        # streams are exempt — health probes must work under overload,
+        # and a watch would pin a readonly slot for its whole lifetime.
+        if outer.flow_control is not None:
+            exempt = ("/healthz", "/livez", "/readyz", "/metrics",
+                      "/version")
+            for method in ("do_GET", "do_POST", "do_PUT", "do_PATCH",
+                           "do_DELETE"):
+                inner = getattr(Handler, method)
+                mutating = method != "do_GET"
+
+                def limited(self, _inner=inner, _mutating=mutating):
+                    path = self.path.partition("?")[0]
+                    if path in exempt or path.startswith("/api/v1/watch"):
+                        return _inner(self)
+                    from kubernetes_tpu.apiserver.fairness import (
+                        TooManyRequests,
+                    )
+
+                    fc = outer.flow_control
+                    flow = fc.flow_of(
+                        self.headers.get("Authorization", ""),
+                        self.client_address[0],
+                    )
+                    try:
+                        lim = fc.acquire(flow, _mutating)
+                    except TooManyRequests as e:
+                        self._too_many_requests(str(e), e.retry_after_s)
+                        return
+                    try:
+                        _inner(self)
+                    finally:
+                        if lim is not None:
+                            lim.release()
+
+                setattr(Handler, method, limited)
         return Handler

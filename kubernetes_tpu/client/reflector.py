@@ -23,8 +23,8 @@ does (SURVEY section 3.3).
 from __future__ import annotations
 
 import json
+import random
 import threading
-import time
 import urllib.error
 import urllib.request
 
@@ -52,16 +52,41 @@ def _auth_headers(token: str, json_body: bool = False) -> dict:
     return headers
 
 
+def decorrelated_jitter(prev: float, base: float, cap: float,
+                        rng: random.Random) -> float:
+    """Decorrelated-jitter backoff (the client-go wait.Backoff jitter
+    discipline): next = min(cap, uniform(base, prev*3)).  Unlike plain
+    exponential doubling, two clients that disconnected at the same
+    instant (an apiserver restart drops EVERY watch at once) spread their
+    reconnects across the whole window instead of stampeding back in
+    lockstep."""
+    return min(cap, rng.uniform(base, max(base, prev * 3.0)))
+
+
+def parse_retry_after(headers) -> float:
+    """The Retry-After header as seconds (0.0 when absent/unparseable).
+    Only the delta-seconds form is emitted by this framework's apiserver;
+    HTTP-date is out of scope."""
+    try:
+        return max(0.0, float(headers.get("Retry-After", "")))
+    except (AttributeError, TypeError, ValueError):
+        return 0.0
+
+
 class Reflector:
     """Mirror a remote apiserver's store into a LocalCluster."""
 
     def __init__(self, server: str, mirror: Optional[LocalCluster] = None,
                  backoff: float = 0.5, max_backoff: float = 10.0,
-                 token: str = "", binary: bool = False):
+                 token: str = "", binary: bool = False,
+                 jitter_seed: Optional[int] = None):
         self.server = server.rstrip("/")
         self.mirror = mirror if mirror is not None else LocalCluster()
         self.backoff = backoff
         self.max_backoff = max_backoff
+        # decorrelated reconnect jitter: unseeded by default (each process
+        # lands elsewhere in the window); seedable for deterministic tests
+        self._jitter_rng = random.Random(jitter_seed)
         self.token = token  # bearer credential for RBAC'd planes
         # negotiate the binary wire format for the watch stream (the
         # protobuf-for-high-QPS-clients analog, api/binary.py)
@@ -90,9 +115,17 @@ class Reflector:
     def _run(self) -> None:
         delay = self.backoff
         while not self._stop.is_set():
+            retry_after = 0.0
             try:
                 self._list_and_watch()
                 delay = self.backoff  # clean disconnect: reset backoff
+            except urllib.error.HTTPError as e:
+                # an overloaded apiserver sheds watch re-establishment
+                # with 429 + Retry-After: honor the server's pacing hint
+                # (it floors the reconnect pause below)
+                klog.errorf("reflector: watch of %s failed: %r", self.server, e)
+                if e.code == 429:
+                    retry_after = parse_retry_after(e.headers)
             except Exception as e:
                 # distinguish stream loss from decode/schema bugs — a silent
                 # reconnect loop hides both (reflector.go logs via utilruntime
@@ -100,8 +133,20 @@ class Reflector:
                 klog.errorf("reflector: watch of %s failed: %r", self.server, e)
             if self._stop.is_set():
                 return
-            time.sleep(delay)
-            delay = min(delay * 2, self.max_backoff)
+            # decorrelated jitter: a fleet of reflectors dropped by one
+            # apiserver blip must NOT reconnect in lockstep; Retry-After
+            # (when the server sent one) floors the pause, with a jitter
+            # fraction on top so even paced clients don't synchronize
+            delay = decorrelated_jitter(
+                delay, self.backoff, self.max_backoff, self._jitter_rng
+            )
+            wait = delay
+            if retry_after > 0.0:
+                wait = max(
+                    wait,
+                    retry_after * (1.0 + 0.2 * self._jitter_rng.random()),
+                )
+            self._stop.wait(wait)
 
     def _event_stream(self, resp):
         """Yield decoded event dicts; heartbeats yield None so the caller's

@@ -20,30 +20,46 @@ LocalCluster runs unmodified against a REMOTE control plane:
 from __future__ import annotations
 
 import json
+import random
+import time
 import urllib.error
 import urllib.request
 from typing import Optional
 
 from kubernetes_tpu.api import scheme
-from kubernetes_tpu.client.reflector import Reflector, _auth_headers
+from kubernetes_tpu.client.reflector import (
+    Reflector,
+    _auth_headers,
+    parse_retry_after,
+)
 from kubernetes_tpu.runtime.cluster import ConflictError, LocalCluster
 
 
 class RemoteAPIError(RuntimeError):
     """Non-2xx REST response, carrying the HTTP status code (the
-    apierrors.StatusError analog — callers branch on code, not message)."""
+    apierrors.StatusError analog — callers branch on code, not message).
+    429 responses additionally carry the server's Retry-After hint in
+    seconds (0.0 when the server sent none)."""
 
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str, retry_after_s: float = 0.0):
         super().__init__(message)
         self.code = code
+        self.retry_after_s = retry_after_s
 
 
 class RemoteCluster:
     """LocalCluster-surface client for a remote apiserver."""
 
+    # bounded 429 retry: the limiter rejects BEFORE any processing, so a
+    # re-send is safe for every verb (unlike a timeout, a 429 proves the
+    # request did not execute); after this many paced attempts the 429
+    # surfaces as RemoteAPIError(retry_after_s=...) for the caller
+    MAX_429_RETRIES = 2
+
     def __init__(self, server: str, token: str = "", binary: bool = False):
         self.server = server.rstrip("/")
         self.token = token
+        self._retry_rng = random.Random()
         # binary: negotiate the compact wire format for the watch stream
         # and write bodies (api/binary.py — the protobuf-client analog)
         self.binary = binary
@@ -104,25 +120,44 @@ class RemoteCluster:
         else:
             data = (json.dumps(payload).encode()
                     if payload is not None else None)
-        req = urllib.request.Request(
-            self.server + path, data=data, method=method, headers=headers,
-        )
         from kubernetes_tpu.cmd.base import tls_urlopen
 
-        try:
-            with tls_urlopen(req, timeout=30) as resp:
-                return json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:
-            body = e.read().decode(errors="replace")
-            try:
-                out = json.loads(body)
-            except ValueError:
-                out = {"kind": "Status", "code": e.code, "message": body}
-            if e.code == 409:
-                raise ConflictError(out.get("message", "conflict"))
-            raise RemoteAPIError(
-                e.code, f"{method} {path}: {e.code} {out.get('message', body)}"
+        attempt = 0
+        while True:
+            req = urllib.request.Request(
+                self.server + path, data=data, method=method,
+                headers=headers,
             )
+            try:
+                with tls_urlopen(req, timeout=30) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                body = e.read().decode(errors="replace")
+                try:
+                    out = json.loads(body)
+                except ValueError:
+                    out = {"kind": "Status", "code": e.code, "message": body}
+                if e.code == 409:
+                    raise ConflictError(out.get("message", "conflict"))
+                retry_after = 0.0
+                if e.code == 429:
+                    # the apiserver shed this request BEFORE executing it
+                    # (inflight limiter): honor Retry-After and re-send a
+                    # bounded number of times, jittered so a fleet of
+                    # clients doesn't return in lockstep
+                    retry_after = parse_retry_after(e.headers) or 0.5
+                    if attempt < self.MAX_429_RETRIES:
+                        attempt += 1
+                        time.sleep(
+                            retry_after
+                            * (1.0 + 0.25 * self._retry_rng.random())
+                        )
+                        continue
+                raise RemoteAPIError(
+                    e.code,
+                    f"{method} {path}: {e.code} {out.get('message', body)}",
+                    retry_after_s=retry_after,
+                )
 
     def _encode(self, kind: str, obj, expect_rv: Optional[int] = None) -> dict:
         d = dict(scheme.encode(kind, obj))

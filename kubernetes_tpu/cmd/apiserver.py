@@ -64,6 +64,24 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--token-file", default="",
                    help="with RBAC: write the minted admin token here")
+    p.add_argument(
+        "--max-mutating-requests-inflight", type=int, default=0,
+        help="APF-style inflight ceiling for mutating verbs "
+        "(POST/PUT/PATCH/DELETE); 0 = unlimited (the historical default)",
+    )
+    p.add_argument(
+        "--max-requests-inflight", type=int, default=0,
+        help="inflight ceiling for readonly verbs (GET); 0 = unlimited",
+    )
+    p.add_argument(
+        "--inflight-queue-length", type=int, default=50,
+        help="per-flow bounded queue length before 429 (flow = client "
+        "credential or address x verb class)",
+    )
+    p.add_argument(
+        "--inflight-queue-timeout", type=float, default=1.0,
+        help="seconds a request may wait queued before 429",
+    )
     return p
 
 
@@ -119,11 +137,22 @@ def main(argv=None) -> int:
                 f.write(admin_token)
         else:
             print(f"admin token: {admin_token}", file=sys.stderr)
+    flow_control = None
+    if args.max_mutating_requests_inflight > 0 or args.max_requests_inflight > 0:
+        from kubernetes_tpu.apiserver.fairness import FlowControlConfig
+
+        flow_control = FlowControlConfig(
+            max_inflight_mutating=args.max_mutating_requests_inflight,
+            max_inflight_readonly=args.max_requests_inflight,
+            queue_length_per_flow=args.inflight_queue_length,
+            queue_wait_timeout_s=args.inflight_queue_timeout,
+        )
     srv = APIServer(
         cluster=cluster, host=args.host, port=args.port,
         audit_path=args.audit_log or None,
         audit_policy=_load_audit_policy(args.audit_policy),
         authenticator=authn, authorizer=authz,
+        flow_control=flow_control,
     )
     if not args.disable_admission:
         # one chain, built once the server exists: with authn on, kubelet

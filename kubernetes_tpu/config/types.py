@@ -73,6 +73,12 @@ class KubeSchedulerConfiguration:
     breaker_failure_threshold: int = 3
     breaker_open_s: float = 0.05
     cpu_fallback: bool = True
+    # overload protection & backpressure knobs (runtime/queue.py bounded
+    # shedding queue + runtime/scheduler.py AIMD adaptive batch sizing)
+    queue_capacity: Optional[int] = None
+    adaptive_batch: bool = False
+    batch_size_min: int = 16
+    cycle_deadline_s: float = 0.0
 
     def build_profile(self, interner=None) -> SchedulingProfile:
         """CreateFromConfig / CreateFromProvider (scheduler.go:162-192)."""
@@ -123,6 +129,13 @@ class KubeSchedulerConfiguration:
             breaker_failure_threshold=int(d.get("breakerFailureThreshold", 3)),
             breaker_open_s=float(d.get("breakerOpenSeconds", 0.05)),
             cpu_fallback=bool(d.get("cpuFallback", True)),
+            queue_capacity=(
+                int(d["queueCapacity"])
+                if d.get("queueCapacity") is not None else None
+            ),
+            adaptive_batch=bool(d.get("adaptiveBatch", False)),
+            batch_size_min=int(d.get("batchSizeMin", 16)),
+            cycle_deadline_s=float(d.get("cycleDeadlineSeconds", 0.0)),
         )
 
     @staticmethod

@@ -312,8 +312,28 @@ class HTTPExtender:
             except urllib.error.HTTPError as e:
                 # non-2xx status: the request REACHED the extender — never
                 # retried (HTTPError subclasses URLError, so this must be
-                # caught before the transient family)
-                raise ExtenderError(f"extender {url}: {e}") from e
+                # caught before the transient family)... with ONE carve-out:
+                # 429 TooManyRequests means the extender shed the request
+                # before executing it, so idempotent verbs retry, paced by
+                # the server's Retry-After when it sent one
+                if e.code != 429 or not idempotent:
+                    raise ExtenderError(f"extender {url}: {e}") from e
+                from kubernetes_tpu.client.reflector import parse_retry_after
+
+                attempt += 1
+                pause = max(
+                    parse_retry_after(e.headers),
+                    delay * (1.0 + self._retry_rng.random()),
+                )
+                if (
+                    attempt > cfg.max_retries
+                    or time.monotonic() + pause >= deadline
+                ):
+                    raise ExtenderError(
+                        f"extender {url}: {e} (after {attempt} attempts)"
+                    ) from e
+                time.sleep(pause)
+                delay *= 2.0
             except _TRANSIENT_HTTP_ERRORS as e:
                 if not idempotent:
                     raise ExtenderError(f"extender {url}: {e}") from e

@@ -109,6 +109,38 @@ class Disruptions:
         not a graceful shutdown): the standby must wait out the TTL."""
         elector.stop(release=False)
 
+    def overload_storm(
+        self,
+        make_pod: Callable[[int], object],
+        count: int,
+        duration_s: float = 0.0,
+    ) -> List[str]:
+        """Burst create traffic at k× capacity (the overload monkey):
+        pour `count` pods into the cluster's write path — as fast as the
+        store accepts when duration_s == 0, evenly paced across the
+        window otherwise (offered rate = count / duration_s, so a caller
+        that measured saturated throughput T drives a 2× storm with
+        count = 2*T*duration_s).  make_pod(i) -> Pod; the scheduler's
+        bounded queue, shedding, and adaptive batching are the system
+        under test.  Returns the created pod names."""
+        interval = duration_s / count if duration_s > 0 and count else 0.0
+        t0 = time.monotonic()
+        names: List[str] = []
+        # pace in small chunks against the WALL clock: per-create sleeps
+        # would let create cost silently lower the offered rate, and
+        # sub-ms sleeps degrade into a GIL-hogging spin that starves the
+        # scheduler under test
+        chunk = 8
+        for i in range(count):
+            pod = make_pod(i)
+            self.cluster.add_pod(pod)
+            names.append(pod.name)
+            if interval and (i % chunk) == chunk - 1:
+                lag = t0 + (i + 1) * interval - time.monotonic()
+                if lag > 0:
+                    time.sleep(lag)
+        return names
+
     # ------------------------------------------------- device-layer faults
     #
     # The accelerator failure domain (codec/faults.py): each method arms

@@ -21,11 +21,17 @@ import heapq
 import itertools
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.utils import metrics as m
 
 UNSCHEDULABLE_TIME_LIMIT = 60.0  # flushUnschedulableQLeftover interval
+
+# shed reasons (scheduler_queue_shed_pods_total{reason=} label values +
+# the on_shed callback's second argument)
+SHED_EVICTED = "evicted"   # a parked pod dropped for a higher-priority arrival
+SHED_ARRIVAL = "arrival"   # the incoming pod itself rejected at capacity
 
 
 class PodBackoff:
@@ -89,7 +95,29 @@ class PriorityQueue:
     (scheduling_queue.go NewPriorityQueueWithClock activeQComp /
     framework.QueueSortFunc)."""
 
-    def __init__(self, backoff: Optional[PodBackoff] = None, less=None):
+    def __init__(self, backoff: Optional[PodBackoff] = None, less=None,
+                 capacity: Optional[int] = None,
+                 on_shed: Optional[Callable[[Pod, str], None]] = None):
+        # overload protection: bound the TOTAL queue population
+        # (active + backoff + unschedulable).  None = unbounded (the
+        # historical behavior).  At capacity, a NEW arrival sheds the
+        # lowest-priority pod — preferring longest-parked unschedulable
+        # pods, never touching the backoff queue (the starvation guard:
+        # pods mid-retry cannot be evicted by a flood of fresh arrivals)
+        # — or is itself rejected when nothing lower-priority remains.
+        # Requeues (add_unschedulable / move_all_to_active) never shed:
+        # they return a pod the scheduler already popped, so the bound
+        # holds without them.
+        self.capacity = capacity
+        self.on_shed = on_shed
+        self.shed_total = 0
+        # lower bound on the priority of any TRACKED pod (monotone under
+        # admits, reset when the queue is observed empty): lets the
+        # at-capacity shed check reject a can't-win arrival WITHOUT the
+        # O(population) candidate scan — the storm hot path.  A stale-LOW
+        # floor is always safe: it only means candidates are >= incoming,
+        # which is exactly the reject-the-arrival case.
+        self._prio_floor = float("inf")
         self._less = less
         self._lock = threading.Condition()
         self._counter = itertools.count()
@@ -115,6 +143,7 @@ class PriorityQueue:
 
     def _push_active(self, pod: Pod) -> None:
         key = _pod_key(pod)
+        self._prio_floor = min(self._prio_floor, pod.spec.priority)
         # first-seen enqueue stamp: survives backoff/unschedulable requeues
         # so queue-add -> bind-commit latency covers the pod's whole wait
         # (the density SLO measures create -> scheduled the same way)
@@ -133,6 +162,7 @@ class PriorityQueue:
 
     def _push_backoff(self, pod: Pod, expiry: float) -> None:
         key = _pod_key(pod)
+        self._prio_floor = min(self._prio_floor, pod.spec.priority)
         old = self._backoff_entry.get(key)
         if old is not None:
             old[_VALID] = False
@@ -140,12 +170,119 @@ class PriorityQueue:
         heapq.heappush(self._backoffq, entry)
         self._backoff_entry[key] = entry
 
+    def _size_locked(self) -> int:
+        return (
+            len(self._active_entry)
+            + len(self._backoff_entry)
+            + len(self._unschedulable)
+        )
+
+    def _shed_candidate_locked(self, incoming: Pod) -> Optional[Tuple[str, str]]:
+        """Pick the pod a full queue drops to admit `incoming`, or None
+        when the arrival itself must be rejected.  Policy: lowest
+        priority first; at equal priority, an unschedulable-parked pod
+        (it already failed to place) is preferred over an active one and
+        the longest-parked goes first; among active pods the YOUNGEST
+        arrival is dropped (long-waiters keep their place).  Backoff
+        entries are never candidates — the starvation guard: a pod
+        mid-retry cannot be evicted by a flood of fresh arrivals.  The
+        candidate sheds only if it is strictly lower priority than the
+        arrival, or equal priority AND parked unschedulable."""
+        # fast path: when the arrival cannot beat the tracked-priority
+        # floor there is nothing to scan for (the common storm case —
+        # thousands of equal-priority arrivals/s against a full queue
+        # must not pay an O(population) scan under the lock each)
+        if incoming.spec.priority < self._prio_floor or (
+            incoming.spec.priority == self._prio_floor
+            and not self._unschedulable
+        ):
+            return None
+        now = time.monotonic()
+        best = None  # (priority, class, tiebreak) + key
+        for key, (pod, _, parked) in self._unschedulable.items():
+            cand = (pod.spec.priority, 0, parked)
+            if best is None or cand < best[0]:
+                best = (cand, key)
+        for key, entry in self._active_entry.items():
+            if not entry[_VALID]:
+                continue
+            cand = (entry[2].spec.priority, 1,
+                    -self._enqueued_at.get(key, now))
+            if best is None or cand < best[0]:
+                best = (cand, key)
+        if best is None:
+            return None
+        (prio, cls, _), key = best
+        if prio < incoming.spec.priority or (
+            prio == incoming.spec.priority and cls == 0
+        ):
+            return key
+        return None
+
+    def _drop_locked(self, key: Tuple[str, str]) -> Pod:
+        """Remove a shed victim from every structure (delete(), minus the
+        backoff-entry half — victims are never in the backoff queue)."""
+        rec = self._unschedulable.pop(key, None)
+        if rec is not None:
+            pod = rec[0]
+        else:
+            entry = self._active_entry.pop(key)
+            entry[_VALID] = False
+            pod = entry[2]
+        self._nominated.pop(key, None)
+        self.backoff.clear(key)
+        self._enqueued_at.pop(key, None)
+        return pod
+
     # ---- producers ----
 
     def add(self, pod: Pod) -> None:
+        shed: List[Tuple[Pod, str]] = []
         with self._lock:
+            if self._size_locked() == 0:
+                # natural reset point for the priority floor: an empty
+                # queue tracks nothing, so the bound starts over
+                self._prio_floor = float("inf")
             key = _pod_key(pod)
-            self._unschedulable.pop(key, None)
+            tracked = (
+                key in self._active_entry
+                or key in self._backoff_entry
+                or key in self._unschedulable
+            )
+            admitted = True
+            if (
+                not tracked
+                and self.capacity is not None
+                and self._size_locked() >= self.capacity
+            ):
+                victim = self._shed_candidate_locked(pod)
+                self.shed_total += 1
+                if victim is None:
+                    # nothing lower-priority is sheddable: the ARRIVAL is
+                    # dropped (a higher-priority pod is never evicted for
+                    # a lower-priority one)
+                    shed.append((pod, SHED_ARRIVAL))
+                    admitted = False
+                else:
+                    shed.append((self._drop_locked(victim), SHED_EVICTED))
+            if admitted:
+                self._unschedulable.pop(key, None)
+                self._push_active(pod)
+                self._lock.notify()
+        # metric + callback OUTSIDE the lock: on_shed typically records an
+        # Event (and must never deadlock against a queue re-entry)
+        for p, reason in shed:
+            m.QUEUE_SHED.inc(reason=reason)
+            if self.on_shed is not None:
+                self.on_shed(p, reason)
+
+    def readd(self, pod: Pod) -> None:
+        """Re-admit a pod the scheduler already POPPED (a gang's surplus
+        member, rollback paths): EXEMPT from capacity shedding, like
+        every other requeue — the pod was admitted once, and dropping it
+        here would silently lose a popped pod."""
+        with self._lock:
+            self._unschedulable.pop(_pod_key(pod), None)
             self._push_active(pod)
             self._lock.notify()
 
@@ -226,6 +363,14 @@ class PriorityQueue:
     def has_nominated(self) -> bool:
         with self._lock:
             return bool(self._nominated)
+
+    def active_depth(self) -> int:
+        """Pods that will reach the active queue without an external
+        cluster event (active + backoff entries): the adaptive-batch
+        pressure signal (unschedulable-parked pods are excluded — they
+        exert no demand until an event revives them)."""
+        with self._lock:
+            return len(self._active_entry) + len(self._backoff_entry)
 
     def has_schedulable(self) -> bool:
         """Anything that can reach the active queue WITHOUT an external

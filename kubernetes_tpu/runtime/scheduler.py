@@ -114,6 +114,24 @@ class SchedulerConfig:
     # the CPU reference engine instead of stalling/requeueing forever.
     # False = legacy behavior (device faults requeue the batch and raise).
     cpu_fallback: bool = True
+    # --- overload protection & backpressure ---
+    # bound the scheduling queue (runtime/queue.py PriorityQueue capacity):
+    # at capacity a new arrival sheds the lowest-priority longest-
+    # unschedulable pod (backoff pods are starvation-guarded) or is itself
+    # rejected; None = unbounded (legacy).  Only applied to a queue THIS
+    # scheduler constructs — a caller-owned queue keeps its own capacity.
+    queue_capacity: Optional[int] = None
+    # AIMD adaptive batch sizing: each cycle pops up to the CURRENT batch
+    # size, which grows additively (+batch_size_min) toward batch_size
+    # while active-queue depth exceeds it and halves (floored at
+    # batch_size_min) when a cycle overruns cycle_deadline_s — sustained
+    # pressure converts into bigger device launches instead of queue
+    # growth, and latency overruns shed batch width first.
+    adaptive_batch: bool = False
+    batch_size_min: int = 16
+    # per-cycle wall-clock budget driving the multiplicative decrease;
+    # 0 = no deadline (depth alone steers the batch size)
+    cycle_deadline_s: float = 0.0
     # multi-scheduler: only pods whose spec.schedulerName names THIS
     # scheduler enter its queue (eventhandlers.go responsibleForPod)
     scheduler_name: str = "default-scheduler"
@@ -147,6 +165,10 @@ class SchedulerConfig:
             ),
             breaker_open_s=getattr(cc, "breaker_open_s", 0.05),
             cpu_fallback=getattr(cc, "cpu_fallback", True),
+            queue_capacity=getattr(cc, "queue_capacity", None),
+            adaptive_batch=getattr(cc, "adaptive_batch", False),
+            batch_size_min=getattr(cc, "batch_size_min", 16),
+            cycle_deadline_s=getattr(cc, "cycle_deadline_s", 0.0),
         )
 
 
@@ -247,9 +269,17 @@ class Scheduler:
         # NB: PriorityQueue defines __len__, so `queue or PriorityQueue()`
         # would silently replace an *empty* caller-owned queue
         self.cache = cache if cache is not None else SchedulerCache()
-        self.queue = queue if queue is not None else PriorityQueue()
-        self.binder = binder if binder is not None else (lambda pod, node: True)
         self.config = config if config is not None else SchedulerConfig()
+        self.queue = (
+            queue if queue is not None
+            else PriorityQueue(capacity=self.config.queue_capacity)
+        )
+        # shed audit trail: a bounded queue dropping a pod is operator-
+        # visible (the FailedScheduling analog for overload); attach only
+        # where no other owner wired one
+        if getattr(self.queue, "on_shed", "n/a") is None:
+            self.queue.on_shed = self._on_shed
+        self.binder = binder if binder is not None else (lambda pod, node: True)
         enc = self.cache.encoder
         prof = self.config.profile
         if prof is not None:
@@ -306,6 +336,14 @@ class Scheduler:
         self._pdb_defaulted = pdb_lister is None
         self.pdb_lister = pdb_lister or (lambda: [])
         self._last_index = 0
+        # AIMD adaptive batch sizing (config.adaptive_batch): the CURRENT
+        # cycle width, starting at the baseline batch_size_min and steered
+        # by _adapt_batch after every non-empty cycle
+        self._cur_batch = (
+            max(1, self.config.batch_size_min)
+            if self.config.adaptive_batch
+            else self.config.batch_size
+        )
         self._stop = threading.Event()
         # device-fault resilience: classified retry/backoff + circuit
         # breaker (runtime/health.py) + CPU-engine degradation
@@ -405,6 +443,42 @@ class Scheduler:
             "device breaker %s -> %s (consecutive failures: %d)",
             frm, to, self.device_health.consecutive_failures,
         )
+
+    def _on_shed(self, pod: Pod, reason: str) -> None:
+        """Bounded-queue shed audit (runtime/queue.py on_shed): one
+        Warning event per dropped pod, mirroring the FailedScheduling
+        trail (the metric lives with the queue)."""
+        self.recorder.eventf(
+            "Pod", pod.namespace, pod.name,
+            EVENT_TYPE_WARNING, "SchedulingQueueFull",
+            "pod shed from the scheduling queue (%s, capacity %s)",
+            reason, self.queue.capacity,
+        )
+
+    def _adapt_batch(self, cycle_s: float) -> None:
+        """AIMD batch-size update, once per non-empty cycle: halve on a
+        deadline overrun (multiplicative decrease — latency wins), grow
+        by +batch_size_min while the active queue outpaces the current
+        width (additive increase — pressure converts into wider device
+        launches), decay by halving once depth falls away (the batch
+        returns to baseline after a storm, so post-overload cycles keep
+        the low-latency shape)."""
+        cfg = self.config
+        if not cfg.adaptive_batch:
+            return
+        floor = max(1, cfg.batch_size_min)
+        cur = self._cur_batch
+        if cfg.cycle_deadline_s > 0 and cycle_s > cfg.cycle_deadline_s:
+            m.CYCLE_DEADLINE_EXCEEDED.inc()
+            cur = max(floor, cur // 2)
+        else:
+            depth = self.queue.active_depth()
+            if depth > cur:
+                cur = min(cfg.batch_size, cur + floor)
+            elif depth <= cur // 2:
+                cur = max(floor, cur // 2)
+        self._cur_batch = cur
+        m.ADAPTIVE_BATCH.set(float(cur))
 
     def _note_device_fault(self, fault_class: str, err: BaseException,
                            phase: str) -> None:
@@ -1507,18 +1581,26 @@ class Scheduler:
         drain the pipeline first so snapshots never go stale."""
         t_pop = time.monotonic()
         pods = self.queue.pop_batch(
-            self.config.batch_size,
+            # adaptive mode pops at the CURRENT AIMD width; static mode
+            # keeps the configured batch size
+            self._cur_batch if self.config.adaptive_batch
+            else self.config.batch_size,
             # with a batch in flight, don't block in the pop: its binds/
             # events/requeues must not wait out the poll timeout when the
             # queue momentarily empties (trickle arrival, burst tails)
             0.0 if self.pipeline_pending else timeout,
             self.config.batch_window_s,
         )
-        self.phase_seconds["pop"] += time.monotonic() - t_pop
+        t_cycle0 = time.monotonic()
+        self.phase_seconds["pop"] += t_cycle0 - t_pop
         if not pods:
             # idle poll: drain any in-flight batch so binds/events/requeues
-            # don't wait for the next arrival
-            return self.flush_pipeline()
+            # don't wait for the next arrival; idle cycles also DECAY the
+            # adaptive batch width (no pressure -> back toward baseline,
+            # even when the last pop emptied the queue in one gulp)
+            n = self.flush_pipeline()
+            self._adapt_batch(0.0)
+            return n
         # gang-eligibility is conservative: extenders and framework
         # plugins enforce verdicts the gang launch cannot consult, and an
         # outstanding preemption nomination must not be absorbed by a
@@ -1631,8 +1713,9 @@ class Scheduler:
                 for p, node in zip(members, nodes):
                     if not node:
                         # surplus member beyond min_member was NOT bound:
-                        # requeue (still-pending pod, not a failure)
-                        self.queue.add(p)
+                        # requeue (still-pending pod, not a failure) —
+                        # shed-exempt like every requeue of a popped pod
+                        self.queue.readd(p)
                         continue
                     # success bookkeeping identical to the plain path:
                     # Scheduled event, counters, e2e histogram, results
@@ -1651,6 +1734,10 @@ class Scheduler:
                 n += sum(
                     1 for r in self.schedule_cycle(plain) if r.node is not None
                 )
+        # the cycle deadline budget covers the SCHEDULING work (encode ->
+        # commit), not the pop wait — an idle poll must not read as an
+        # overrun and shrink the batch
+        self._adapt_batch(time.monotonic() - t_cycle0)
         return n
 
     def run(self) -> None:

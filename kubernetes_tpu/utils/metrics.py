@@ -143,6 +143,26 @@ class LabeledCounter:
         return "\n".join(out)
 
 
+class LabeledGauge(LabeledCounter):
+    """Gauge family with label sets (the prometheus GaugeVec analog,
+    e.g. apiserver_current_inflight_requests{request_kind=})."""
+
+    def set(self, v: float, **labels) -> None:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        with self._lock:
+            self._children[key] = float(v)
+
+    def expose(self) -> str:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for key, v in sorted(self._children.items()):
+                lbl = ",".join(
+                    f'{n}="{val}"' for n, val in zip(self.label_names, key)
+                )
+                out.append(f"{self.name}{{{lbl}}} {v}")
+        return "\n".join(out)
+
+
 class Registry:
     def __init__(self) -> None:
         self._metrics: Dict[str, object] = {}
@@ -220,6 +240,48 @@ DEGRADED_CYCLES = REGISTRY.register(
         "scheduler_degraded_cycles_total",
         "Scheduling cycles served by the CPU reference engine while the "
         "device breaker was open",
+    )
+)
+
+# overload protection & backpressure observables (PR 4): the apiserver's
+# APF-style inflight limiter (apiserver/fairness.py — reference names from
+# apiserver/pkg/server/filters/maxinflight.go + util/flowcontrol metrics)
+# and the scheduler's bounded-queue shedding + adaptive batch sizing
+APF_INFLIGHT = REGISTRY.register(
+    LabeledGauge(
+        "apiserver_current_inflight_requests",
+        "Inflight request slots currently held, by verb class",
+        ("request_kind",),
+    )
+)
+APF_REJECTED = REGISTRY.register(
+    LabeledCounter(
+        "apiserver_flowcontrol_rejected_requests_total",
+        "Requests rejected with 429 TooManyRequests, by verb class and "
+        "reason (queue full | timeout)",
+        ("request_kind", "reason"),
+    )
+)
+QUEUE_SHED = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_queue_shed_pods_total",
+        "Pods shed from the bounded scheduling queue, by reason: "
+        "'evicted' = a parked pod dropped for a higher-priority arrival, "
+        "'arrival' = the incoming pod itself rejected",
+        ("reason",),
+    )
+)
+ADAPTIVE_BATCH = REGISTRY.register(
+    Gauge(
+        "scheduler_adaptive_batch_size",
+        "Current AIMD batch size (pods per scheduling cycle)",
+    )
+)
+CYCLE_DEADLINE_EXCEEDED = REGISTRY.register(
+    Counter(
+        "scheduler_cycle_deadline_exceeded_total",
+        "Scheduling cycles whose wall time overran the configured "
+        "deadline budget (each triggers a multiplicative batch shrink)",
     )
 )
 

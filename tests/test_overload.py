@@ -1,0 +1,730 @@
+"""Overload protection & backpressure (ISSUE 4).
+
+The acceptance surface, end to end: the apiserver's APF-style inflight
+limiter rejects over-limit traffic with 429 + Retry-After and deals slots
+fairly across flows; clients (reflector / RemoteCluster / extender) honor
+Retry-After with jittered backoff; the scheduler's bounded queue sheds
+only lowest-priority pods (backoff pods starvation-guarded) while AIMD
+batch sizing converts sustained pressure into wider device launches; and
+under a 2x offered-load storm the control plane keeps goodput within 20%
+of saturation, never deadlocks, and fully recovers — including across a
+leader-election failover mid-storm.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from email.message import Message
+
+import pytest
+
+from kubernetes_tpu.api.types import ObjectMeta, PodDisruptionBudget
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apiserver.fairness import (
+    FlowControlConfig,
+    InflightLimiter,
+    TooManyRequests,
+)
+from kubernetes_tpu.client.reflector import (
+    Reflector,
+    decorrelated_jitter,
+    parse_retry_after,
+)
+from kubernetes_tpu.client.remote import RemoteAPIError, RemoteCluster
+from kubernetes_tpu.extender.client import (
+    ExtenderConfig,
+    ExtenderError,
+    HTTPExtender,
+)
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.chaos import ChaosTest, Chaosmonkey, Disruptions
+from kubernetes_tpu.runtime.cluster import (
+    LocalCluster,
+    make_cluster_binder,
+    wire_scheduler,
+)
+from kubernetes_tpu.runtime.leaderelection import (
+    LeaderElectionConfig,
+    run_scheduler_elected,
+)
+from kubernetes_tpu.runtime.queue import (
+    SHED_ARRIVAL,
+    SHED_EVICTED,
+    PodBackoff,
+    PriorityQueue,
+)
+from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.utils import metrics as m
+
+from fixtures import make_node, make_pod
+
+import random
+
+
+# --------------------------------------------------------- inflight limiter
+
+
+def test_limiter_fast_path_and_release():
+    lim = InflightLimiter(FlowControlConfig(
+        max_inflight_mutating=2, max_inflight_readonly=1))
+    a = lim.acquire("f1", mutating=True)
+    b = lim.acquire("f2", mutating=True)
+    r = lim.acquire("f1", mutating=False)  # separate readonly pool
+    assert a is not None and b is not None and r is not None
+    a.release()
+    b.release()
+    r.release()
+    c = lim.acquire("f3", mutating=True)  # slots replayable after release
+    assert c is not None
+    c.release()
+
+
+def test_limiter_queue_full_rejects_with_retry_after():
+    cfg = FlowControlConfig(
+        max_inflight_mutating=1, queue_length_per_flow=2,
+        queue_wait_timeout_s=5.0, retry_after_s=2.5,
+    )
+    lim = InflightLimiter(cfg)
+    holder = lim.acquire("greedy", mutating=True)
+    parked = []
+
+    def park():
+        tok = lim.acquire("greedy", mutating=True)
+        parked.append(tok)
+        tok.release()
+
+    threads = [threading.Thread(target=park, daemon=True) for _ in range(2)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 2.0
+    while lim.queued(True) < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert lim.queued(True) == 2
+    with pytest.raises(TooManyRequests) as ei:
+        lim.acquire("greedy", mutating=True)  # 3rd waiter: flow queue full
+    assert ei.value.retry_after_s == 2.5
+    holder.release()
+    for t in threads:
+        t.join(timeout=5.0)
+    assert len(parked) == 2  # queued waiters were served, FIFO drained
+
+
+def test_limiter_round_robin_fairness_greedy_cannot_starve():
+    """One slot, a greedy flow with 4 parked waiters vs a polite flow
+    with 2: grants must alternate flows (round-robin), so both polite
+    waiters complete within the first 4 grants instead of waiting out
+    the greedy backlog."""
+    lim = InflightLimiter(FlowControlConfig(
+        max_inflight_mutating=1, queue_length_per_flow=10,
+        queue_wait_timeout_s=10.0,
+    ))
+    holder = lim.acquire("warm", mutating=True)
+    order = []
+    order_lock = threading.Lock()
+
+    def worker(flow):
+        tok = lim.acquire(flow, mutating=True)
+        with order_lock:
+            order.append(flow)
+        tok.release()
+
+    threads = []
+    for _ in range(4):
+        threads.append(threading.Thread(
+            target=worker, args=("greedy",), daemon=True))
+        threads[-1].start()
+    deadline = time.monotonic() + 2.0
+    while lim.queued(True) < 4 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    for _ in range(2):
+        threads.append(threading.Thread(
+            target=worker, args=("polite",), daemon=True))
+        threads[-1].start()
+    while lim.queued(True) < 6 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    holder.release()  # starts the grant chain
+    for t in threads:
+        t.join(timeout=10.0)
+    assert len(order) == 6
+    # fair share: the polite flow's 2 requests land in the first 4 grants
+    assert order[:4].count("polite") == 2
+    assert lim.grants(True)["polite"] == 2
+    assert lim.grants(True)["greedy"] >= 4
+
+
+# --------------------------------------------------- apiserver 429 surface
+
+
+def _raw_req(url, method="GET", payload=None, timeout=10):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read() or b"{}")
+
+
+def test_apiserver_limiter_rejects_with_429_and_retry_after():
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def slow_admission(op, kind, d):
+        if op == "CREATE" and kind == "pods":
+            entered.set()
+            gate.wait(5.0)  # hold the single mutating slot
+        return d
+
+    srv = APIServer(
+        admission=[slow_admission],
+        flow_control=FlowControlConfig(
+            max_inflight_mutating=1, queue_length_per_flow=0,
+            retry_after_s=3.0,
+        ),
+    ).start()
+    try:
+        from kubernetes_tpu.api.serialize import pod_to_dict
+
+        holder = threading.Thread(
+            target=_raw_req,
+            args=(f"{srv.url}/api/v1/namespaces/default/pods", "POST",
+                  pod_to_dict(make_pod("p-hold", cpu="1m"))),
+            daemon=True,
+        )
+        holder.start()
+        assert entered.wait(5.0)
+        code, headers, body = _raw_req(
+            f"{srv.url}/api/v1/namespaces/default/pods", "POST",
+            pod_to_dict(make_pod("p-shed", cpu="1m")),
+        )
+        assert code == 429
+        assert body["reason"] == "TooManyRequests"
+        assert headers.get("Retry-After") == "3"
+        # the liveness surface stays exempt while mutating is saturated
+        with urllib.request.urlopen(f"{srv.url}/healthz", timeout=10) as r:
+            assert r.status == 200 and r.read() == b"ok"
+        gate.set()
+        holder.join(timeout=5.0)
+        # capacity freed: writes flow again
+        code, _, _ = _raw_req(
+            f"{srv.url}/api/v1/namespaces/default/pods", "POST",
+            pod_to_dict(make_pod("p-after", cpu="1m")),
+        )
+        assert code == 201
+        assert m.APF_REJECTED.value(
+            request_kind="mutating", reason="queue full") >= 1
+    finally:
+        gate.set()
+        srv.stop()
+
+
+def test_eviction_429_carries_retry_after():
+    cluster = LocalCluster()
+    cluster.add_pod(make_pod("guarded", cpu="1m", labels={"app": "db"}))
+    cluster.create("poddisruptionbudgets", PodDisruptionBudget(
+        metadata=ObjectMeta(namespace="default", name="db-pdb"),
+        selector={"matchLabels": {"app": "db"}},
+        disruptions_allowed=0,
+    ))
+    srv = APIServer(cluster=cluster).start()
+    try:
+        code, headers, body = _raw_req(
+            f"{srv.url}/api/v1/namespaces/default/pods/guarded/eviction",
+            "POST", {"metadata": {"name": "guarded"}},
+        )
+        assert code == 429
+        assert body["reason"] == "TooManyRequests"
+        # the retry signal kubectl drain (and the new clients) pace on
+        assert int(headers.get("Retry-After", "0")) >= 1
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------- client backoff
+
+
+def test_decorrelated_jitter_bounds_and_spread():
+    rng = random.Random(7)
+    prev = 0.5
+    seen = set()
+    for _ in range(64):
+        prev = decorrelated_jitter(prev, 0.5, 10.0, rng)
+        assert 0.5 <= prev <= 10.0
+        seen.add(round(prev, 6))
+    assert len(seen) > 32  # jittered, not a fixed doubling ladder
+
+
+def test_parse_retry_after():
+    msg = Message()
+    msg["Retry-After"] = "4"
+    assert parse_retry_after(msg) == 4.0
+    assert parse_retry_after(Message()) == 0.0
+    bad = Message()
+    bad["Retry-After"] = "soon"
+    assert parse_retry_after(bad) == 0.0
+
+
+def test_reflector_honors_retry_after_on_429(monkeypatch):
+    refl = Reflector("http://127.0.0.1:1", backoff=0.01, max_backoff=0.05,
+                     jitter_seed=3)
+    attempts = []
+    headers = Message()
+    headers["Retry-After"] = "1"
+
+    def fake_law():
+        attempts.append(time.monotonic())
+        if len(attempts) >= 2:
+            refl.stop()
+        raise urllib.error.HTTPError(
+            "http://x", 429, "TooManyRequests", headers, None)
+
+    monkeypatch.setattr(refl, "_list_and_watch", fake_law)
+    refl.start()
+    deadline = time.monotonic() + 10.0
+    while len(attempts) < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    refl.stop()
+    refl._thread.join(timeout=5.0)
+    assert len(attempts) >= 2
+    # the server said 1s: the reconnect waited AT LEAST that (plain
+    # backoff alone would have retried within ~0.05s)
+    assert attempts[1] - attempts[0] >= 1.0
+
+
+def test_remote_cluster_429_bounded_retry_then_error_with_hint():
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def slow_admission(op, kind, d):
+        if op == "CREATE" and kind == "pods":
+            entered.set()
+            gate.wait(10.0)
+        return d
+
+    srv = APIServer(
+        admission=[slow_admission],
+        flow_control=FlowControlConfig(
+            max_inflight_mutating=1, queue_length_per_flow=0,
+            retry_after_s=1.0,
+        ),
+    ).start()
+    try:
+        from kubernetes_tpu.api.serialize import pod_to_dict
+
+        holder = threading.Thread(
+            target=_raw_req,
+            args=(f"{srv.url}/api/v1/namespaces/default/pods", "POST",
+                  pod_to_dict(make_pod("p-hold", cpu="1m"))),
+            daemon=True,
+        )
+        holder.start()
+        assert entered.wait(5.0)
+        rc = RemoteCluster(srv.url)
+        rc.MAX_429_RETRIES = 0  # surface the rejection immediately
+        with pytest.raises(RemoteAPIError) as ei:
+            rc.create("pods", make_pod("p-shed", cpu="1m"))
+        assert ei.value.code == 429
+        assert ei.value.retry_after_s == 1.0
+        # with retries enabled the client waits out Retry-After and lands
+        # the write once the slot frees
+        rc.MAX_429_RETRIES = 3
+        t = threading.Timer(0.3, gate.set)  # free the slot mid-backoff
+        t.start()
+        rv = rc.create("pods", make_pod("p-retried", cpu="1m"))
+        assert rv > 0
+        holder.join(timeout=5.0)
+    finally:
+        gate.set()
+        srv.stop()
+
+
+def test_extender_429_retries_idempotent_never_bind():
+    headers = Message()
+    headers["Retry-After"] = "0"
+    calls = {"filter": 0, "bind": 0}
+
+    def transport(url, payload, timeout):
+        verb = url.rsplit("/", 1)[-1]
+        calls[verb] += 1
+        if calls[verb] == 1:
+            raise urllib.error.HTTPError(url, 429, "TooManyRequests",
+                                         headers, None)
+        if verb == "filter":
+            return {"nodenames": ["n0"]}
+        return {}
+
+    ext = HTTPExtender(
+        ExtenderConfig(
+            url_prefix="http://ext", filter_verb="filter", bind_verb="bind",
+            node_cache_capable=True, max_retries=2, retry_backoff_s=0.001,
+            http_timeout=5.0,
+        ),
+        transport=transport,
+    )
+    ok, _ = ext.filter(make_pod("p", cpu="1m"), ["n0"])
+    assert ok == ["n0"]
+    assert calls["filter"] == 2  # one 429, one paced retry
+    # bind is non-idempotent: a 429 fails it on the FIRST attempt
+    with pytest.raises(ExtenderError):
+        ext.bind("default", "p", "uid", "n0")
+    assert calls["bind"] == 1
+
+
+# --------------------------------------------------- bounded queue shedding
+
+
+def _prio_pod(name, prio):
+    return make_pod(name, cpu="100m", mem="64Mi", priority=prio)
+
+
+def test_queue_sheds_lowest_priority_first():
+    shed = []
+    q = PriorityQueue(capacity=3, on_shed=lambda p, r: shed.append((p.name, r)))
+    for i, prio in enumerate((0, 1, 2)):
+        q.add(_prio_pod(f"p{i}", prio))
+    assert len(q) == 3
+    # higher-priority arrival evicts the lowest-priority pod
+    q.add(_prio_pod("hi", 3))
+    assert shed == [("p0", SHED_EVICTED)]
+    assert len(q) == 3 and q.shed_total == 1
+    # a low-priority arrival is itself rejected: never evict a higher-
+    # priority pod while a lower-priority one (the arrival) exists
+    q.add(_prio_pod("low", 0))
+    assert shed[-1] == ("low", SHED_ARRIVAL)
+    assert len(q) == 3
+    # surviving population pops highest-priority first, intact
+    assert [q.pop(0.1).name for _ in range(3)] == ["hi", "p2", "p1"]
+    assert m.QUEUE_SHED.value(reason=SHED_EVICTED) >= 1
+    assert m.QUEUE_SHED.value(reason=SHED_ARRIVAL) >= 1
+
+
+def test_queue_prefers_shedding_longest_parked_unschedulable():
+    shed = []
+    q = PriorityQueue(capacity=3, on_shed=lambda p, r: shed.append(p.name))
+    stale = _prio_pod("stale", 0)
+    q.add(stale)
+    assert q.pop(0.1) is stale
+    # park it unschedulable (no move request since its cycle -> parking lot)
+    q.add_unschedulable(stale, q.scheduling_cycle)
+    q.add(_prio_pod("a", 0))
+    q.add(_prio_pod("b", 0))
+    assert len(q) == 3
+    # equal priority: the parked-unschedulable pod sheds before active ones
+    q.add(_prio_pod("fresh", 0))
+    assert shed == ["stale"]
+    assert len(q) == 3
+
+
+def test_queue_starvation_guard_protects_backoff_pods():
+    shed = []
+    q = PriorityQueue(
+        capacity=2, backoff=PodBackoff(initial=30.0, max_duration=30.0),
+        on_shed=lambda p, r: shed.append((p.name, r)),
+    )
+    a, b = _prio_pod("a", 0), _prio_pod("b", 0)
+    q.add(a)
+    q.add(b)
+    assert q.pop(0.1) is not None and q.pop(0.1) is not None
+    cycle = q.scheduling_cycle
+    q.move_all_to_active()  # move_request_cycle >= cycle: requeues -> backoff
+    q.add_unschedulable(a, cycle)
+    q.add_unschedulable(b, cycle)
+    assert len(q) == 2
+    # a flood of higher-priority arrivals cannot evict mid-retry pods:
+    # the arrivals themselves are shed (the starvation guard)
+    for i in range(5):
+        q.add(_prio_pod(f"flood-{i}", 100))
+    assert len(q) == 2
+    assert [r for _, r in shed] == [SHED_ARRIVAL] * 5
+    assert {n for n, _ in shed} == {f"flood-{i}" for i in range(5)}
+
+
+def test_queue_requeues_never_shed():
+    q = PriorityQueue(capacity=1)
+    a = _prio_pod("a", 0)
+    q.add(a)
+    assert q.pop(0.1) is a
+    q.add(_prio_pod("b", 0))  # fills the single slot
+    # the popped pod's requeue must re-enter even at capacity (it was
+    # already admitted; dropping it would lose a popped pod)
+    q.add_unschedulable(a, q.scheduling_cycle)
+    assert len(q) == 2
+    assert q.shed_total == 0
+    # same for readd (the gang-surplus / rollback path): straight back
+    # to ACTIVE, shed-exempt even at capacity
+    b2 = q.pop(0.1)  # the parked pod keeps the queue at capacity
+    assert b2 is not None
+    q.readd(b2)
+    assert len(q) == 2
+    assert q.shed_total == 0
+    assert q.pop(0.1) is b2
+
+
+# --------------------------------------------------- adaptive batch (AIMD)
+
+
+def _mini_sched(**cfg_kw):
+    cache = SchedulerCache()
+    for i in range(4):
+        cache.add_node(make_node(f"n{i}", cpu="16", mem="32Gi", pods=200))
+    queue = PriorityQueue(backoff=PodBackoff(initial=0.01, max_duration=0.05))
+    return Scheduler(
+        cache=cache, queue=queue, binder=lambda pod, node: True,
+        config=SchedulerConfig(
+            disable_preemption=True, batched_commit=True, **cfg_kw,
+        ),
+    )
+
+
+def test_adaptive_batch_aimd_grow_shrink_decay():
+    sched = _mini_sched(
+        batch_size=32, adaptive_batch=True, batch_size_min=4,
+        cycle_deadline_s=10.0,
+    )
+    assert sched._cur_batch == 4
+    # pressure: depth above the current width grows it additively
+    for i in range(64):
+        sched.queue.add(make_pod(f"g{i}", cpu="10m", mem="8Mi"))
+    sched.run_once(timeout=0.0)
+    assert sched._cur_batch == 8
+    sched.run_once(timeout=0.0)
+    assert sched._cur_batch == 12
+    # deadline overrun: multiplicative decrease wins over depth
+    before = m.CYCLE_DEADLINE_EXCEEDED.value
+    sched.config.cycle_deadline_s = 1e-9
+    sched.run_once(timeout=0.0)
+    assert sched._cur_batch == 6
+    assert m.CYCLE_DEADLINE_EXCEEDED.value > before
+    # drain + idle: the width decays back to the baseline
+    sched.config.cycle_deadline_s = 10.0
+    deadline = time.monotonic() + 10.0
+    while sched.queue.has_schedulable() and time.monotonic() < deadline:
+        sched.run_once(timeout=0.0)
+    for _ in range(4):  # idle polls decay toward the floor
+        sched.run_once(timeout=0.0)
+    assert sched._cur_batch == 4
+
+
+def test_adaptive_batch_caps_at_configured_max():
+    sched = _mini_sched(batch_size=8, adaptive_batch=True, batch_size_min=4)
+    for i in range(200):
+        sched.queue.add(make_pod(f"c{i}", cpu="10m", mem="8Mi"))
+    for _ in range(6):
+        sched.run_once(timeout=0.0)
+    assert sched._cur_batch == 8  # never exceeds batch_size
+
+
+def test_scheduler_emits_shed_event():
+    # no queue passed: the Scheduler builds its own from queue_capacity
+    # and wires the shed audit trail to its recorder
+    cache = SchedulerCache()
+    cache.add_node(make_node("n0", cpu="16", mem="32Gi", pods=100))
+    sched = Scheduler(
+        cache=cache, binder=lambda pod, node: True,
+        config=SchedulerConfig(
+            batch_size=4, queue_capacity=1, disable_preemption=True,
+        ),
+    )
+    assert sched.queue.capacity == 1
+    sched.queue.add(_prio_pod("first", 0))
+    sched.queue.add(_prio_pod("dropped", 0))
+    evs = sched.recorder.events(name="dropped", reason="SchedulingQueueFull")
+    assert len(evs) == 1 and evs[0].type == "Warning"
+
+
+# ----------------------------------------------------- overload e2e (chaos)
+
+
+def _overload_member(cluster, capacity, bind_sleep=0.002):
+    inner = make_cluster_binder(cluster)
+
+    def binder(pod, node):
+        time.sleep(bind_sleep)  # a throttled apiserver: fixes the ceiling
+        return inner(pod, node)
+
+    sched = Scheduler(
+        cache=SchedulerCache(),
+        queue=PriorityQueue(
+            capacity=capacity,
+            backoff=PodBackoff(initial=0.01, max_duration=0.05),
+        ),
+        binder=binder,
+        config=SchedulerConfig(
+            batch_size=16, batch_window_s=0.0, disable_preemption=True,
+            batched_commit=True, adaptive_batch=True, batch_size_min=4,
+            cycle_deadline_s=2.0,
+        ),
+    )
+    wire_scheduler(cluster, sched)
+    return sched
+
+
+@pytest.mark.chaos
+def test_overload_storm_2x_goodput_sheds_low_priority_recovers():
+    """The tentpole acceptance: at 2x sustained offered load the live
+    control plane keeps goodput within 20% of saturation, sheds ONLY
+    lowest-priority pods, never deadlocks, and fully recovers (queue
+    drains, batch width back to baseline) once the storm passes."""
+    cluster = LocalCluster()
+    for i in range(10):
+        cluster.add_node(make_node(f"n{i}", cpu="64", mem="256Gi", pods=400))
+    shed = []
+    # capacity above the phase-1 burst (no shedding while measuring
+    # saturation) but below the storm's excess (~1.5x tput_sat pods),
+    # so the storm must shed
+    sched = _overload_member(cluster, capacity=120)
+    sched.queue.on_shed = lambda p, r: shed.append((p.name, p.spec.priority, r))
+    runner = threading.Thread(target=sched.run, daemon=True)
+    runner.start()
+    monkey = Disruptions(cluster)
+
+    def bound_count():
+        return sum(1 for p in cluster.list("pods") if p.spec.node_name)
+
+    try:
+        # phase 0: warmup (compile) outside any measured window
+        monkey.overload_storm(
+            lambda i: make_pod(f"warm-{i}", cpu="10m", mem="8Mi"), 32)
+        deadline = time.monotonic() + 30.0
+        while bound_count() < 32 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert bound_count() == 32
+
+        # phase 1: saturated throughput (burst under capacity, then drain)
+        t0 = time.monotonic()
+        monkey.overload_storm(
+            lambda i: make_pod(f"sat-{i}", cpu="10m", mem="8Mi"), 100)
+        deadline = time.monotonic() + 30.0
+        while bound_count() < 132 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        sat_dt = time.monotonic() - t0
+        assert bound_count() == 132, "saturation phase stalled"
+        assert not shed, "saturation phase must not shed"
+        tput_sat = 100 / sat_dt
+
+        # phase 2: the storm — 2x offered, two priority bands (10% high)
+        offered = 2.0 * tput_sat
+        duration = 1.5
+        count = int(offered * duration)
+        hi = {f"storm-{i}" for i in range(count) if i % 10 == 0}
+
+        def storm_pod(i):
+            return make_pod(
+                f"storm-{i}", cpu="10m", mem="8Mi",
+                priority=100 if i % 10 == 0 else 0,
+            )
+
+        b0 = bound_count()
+        t1 = time.monotonic()
+        monkey.overload_storm(storm_pod, count, duration_s=duration)
+        storm_dt = time.monotonic() - t1
+        goodput_in_storm = (bound_count() - b0) / storm_dt
+
+        # recovery: queue drains, nothing left schedulable, no deadlock
+        deadline = time.monotonic() + 30.0
+        while sched.queue.has_schedulable() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not sched.queue.has_schedulable(), "queue failed to drain"
+        time.sleep(0.3)
+
+        # goodput within 20% of saturated throughput DURING the storm
+        assert goodput_in_storm >= 0.8 * tput_sat, (
+            f"goodput {goodput_in_storm:.0f} < 80% of saturated "
+            f"{tput_sat:.0f} pods/s"
+        )
+        # overload genuinely exceeded capacity and was shed, not queued
+        assert shed, "2x offered load produced no shedding"
+        # ONLY lowest-priority pods were shed; every high-priority pod
+        # from the storm was bound
+        assert all(prio == 0 for _, prio, _ in shed), (
+            f"high-priority pod shed: {[s for s in shed if s[1] != 0]}"
+        )
+        storm_bound = {
+            p.name for p in cluster.list("pods")
+            if p.name.startswith("storm-") and p.spec.node_name
+        }
+        assert hi <= storm_bound
+        # conservation: every storm pod is either bound or shed (none
+        # lost in between — the no-deadlock/no-loss invariant)
+        assert len(storm_bound) + len(shed) == count
+        # full recovery: AIMD width back at its baseline after idling
+        deadline = time.monotonic() + 10.0
+        while sched._cur_batch != 4 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sched._cur_batch == 4
+    finally:
+        sched.stop()
+        runner.join(timeout=5.0)
+
+
+@pytest.mark.chaos
+def test_leader_failover_mid_storm_zero_pods_lost_metrics_monotonic():
+    """Kill the leader mid-storm: the standby takes over, no pod is lost
+    (capacity sized so nothing sheds), and the shed/goodput observables
+    only ever move forward across the failover."""
+    cluster = LocalCluster()
+    for i in range(4):
+        cluster.add_node(make_node(f"n{i}", cpu="64", mem="256Gi", pods=300))
+    sched_a = _overload_member(cluster, capacity=500, bind_sleep=0.01)
+    sched_b = _overload_member(cluster, capacity=500, bind_sleep=0.01)
+    fast = LeaderElectionConfig(
+        lease_duration=0.4, renew_deadline=0.3, retry_period=0.05)
+    el_a = run_scheduler_elected(cluster, sched_a, "a", fast)
+    deadline = time.monotonic() + 5.0
+    while not el_a.is_leader and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert el_a.is_leader
+    el_b = run_scheduler_elected(cluster, sched_b, "b", fast)
+    monkey = Disruptions(cluster)
+    n_pods = 40
+
+    def bound_count():
+        return sum(1 for p in cluster.list("pods") if p.spec.node_name)
+
+    seen = {"bound": 0, "shed": 0.0}
+
+    def invariants():
+        b = bound_count()
+        s = (m.QUEUE_SHED.value(reason=SHED_ARRIVAL)
+             + m.QUEUE_SHED.value(reason=SHED_EVICTED))
+        assert b >= seen["bound"], "goodput went backwards"
+        assert s >= seen["shed"], "shed counter went backwards"
+        seen["bound"], seen["shed"] = b, s
+
+    def disruption():
+        # first half of the storm under leader A...
+        monkey.overload_storm(
+            lambda i: make_pod(f"fo-{i}", cpu="10m", mem="8Mi"),
+            n_pods // 2, duration_s=0.4)
+        deadline = time.monotonic() + 10.0
+        while bound_count() < 5 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert bound_count() >= 5
+        monkey.kill_leader(el_a)  # crash: no lease handover
+        # ...second half lands while the standby waits out the TTL
+        monkey.overload_storm(
+            lambda i: make_pod(f"fo-{n_pods // 2 + i}", cpu="10m",
+                               mem="8Mi"),
+            n_pods - n_pods // 2, duration_s=0.4)
+        deadline = time.monotonic() + 20.0
+        while bound_count() < n_pods and time.monotonic() < deadline:
+            time.sleep(0.05)
+
+    cm = Chaosmonkey(disruption)
+    cm.register(ChaosTest(name="monotonic-metrics", during=invariants))
+    try:
+        cm.do(during_interval=0.05)
+        assert bound_count() == n_pods, (
+            f"pods lost across failover: {bound_count()}/{n_pods}"
+        )
+        assert el_b.is_leader
+        assert sched_a.queue.shed_total == 0
+        assert sched_b.queue.shed_total == 0
+    finally:
+        el_a.stop(release=False)
+        el_b.stop()
