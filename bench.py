@@ -874,6 +874,7 @@ def run_child(args) -> None:
         except Exception as e:  # compile/runtime failure mid-run
             _emit(_error_line("run", e))
             return
+        _write_trace_artifact(args)
         _emit(result)
     finally:
         if lock is not None:
@@ -884,6 +885,27 @@ def run_child(args) -> None:
 
 
 # ---------------------------------------------------------- parent orchestration
+
+
+def _write_trace_artifact(args) -> None:
+    """--trace-out: dump the process-wide flight recorder (the cycle
+    spans every live-path Scheduler recorded during this run) as Chrome
+    trace-event JSON — the per-run artifact that makes a bench number's
+    phase claims inspectable in Perfetto.  Best-effort: a trace-write
+    failure must never eat the result line."""
+    path = getattr(args, "trace_out", None)
+    if not path:
+        return
+    try:
+        from kubernetes_tpu.runtime.flightrecorder import RECORDER
+
+        with open(path, "w") as f:
+            json.dump(RECORDER.chrome_trace(), f)
+        sys.stderr.write(
+            f"bench: wrote {len(RECORDER.spans())} cycle spans to {path}\n"
+        )
+    except Exception as e:  # noqa: BLE001
+        sys.stderr.write(f"bench: --trace-out failed: {e}\n")
 
 
 def _last_json_line(text: str):
@@ -907,6 +929,8 @@ def _child_cmd(args, platform: str | None) -> list:
         "--init-timeout", str(args.init_timeout),
         "--lock-timeout", str(args.lock_timeout),
     ]
+    if getattr(args, "trace_out", None):
+        cmd += ["--trace-out", args.trace_out]
     if args.density:
         cmd += ["--density",
                 "--density-interval", str(args.density_interval),
@@ -1092,6 +1116,14 @@ def main():
     ap.add_argument("--tpu-min-budget", type=float, default=420.0,
                     help="skip the TPU attempt when less than this remains "
                     "(compile cache makes a warm attempt ~5-7min)")
+    ap.add_argument(
+        "--trace-out", default=None,
+        help="write the run's scheduling-cycle spans (the flight "
+        "recorder ring) as Chrome trace-event JSON here — loadable in "
+        "Perfetto / chrome://tracing.  In orchestrated mode the child "
+        "that measured writes it (a TPU attempt overwrites the CPU "
+        "phase's file, so the artifact matches the emitted number)",
+    )
     ap.add_argument(
         "--platform",
         default=None,

@@ -881,6 +881,20 @@ class APIServer:
                         ct="text/plain; version=0.0.4",
                     )
                     return
+                if self.path == "/debug/traces":
+                    # the process-wide flight recorder as Chrome
+                    # trace-event JSON (Perfetto-loadable) — in embedded
+                    # deployments (--with-scheduler) the scheduling
+                    # cycles' spans live in this process
+                    from kubernetes_tpu.runtime.flightrecorder import (
+                        RECORDER,
+                    )
+
+                    self._send_text(
+                        json.dumps(RECORDER.chrome_trace()).encode(),
+                        ct="application/json",
+                    )
+                    return
                 if self.path == "/version":
                     self._send({"gitVersion": "v1.15-tpu", "major": "1",
                                 "minor": "15"})
@@ -947,6 +961,10 @@ class APIServer:
                         "message": e.message, "count": e.count,
                         "firstTimestamp": e.first_timestamp,
                         "lastTimestamp": e.last_timestamp,
+                        # the scheduling-cycle join key (utils/trace.py);
+                        # omitted when the emitter carried no context
+                        **({"traceID": e.trace_id}
+                           if getattr(e, "trace_id", "") else {}),
                     } for i, e in enumerate(evs)]
                     # fieldSelector works here too (`kubectl get events
                     # --field-selector type=Warning` is the canonical use)
@@ -1621,7 +1639,16 @@ class APIServer:
                         if pod is None:
                             self._status(404, "NotFound", f"pod {ns}/{name}")
                             return
-                        if not outer.cluster.bind(pod, node):
+                        # cross-component trace propagation (utils/
+                        # trace.py): a scheduler that carried its cycle's
+                        # traceparent gets the trace id stamped onto the
+                        # bound pod, joining this bind to the cycle span
+                        from kubernetes_tpu.utils.trace import trace_id_of
+
+                        tid = trace_id_of(
+                            self.headers.get("Traceparent", "")
+                        )
+                        if not outer.cluster.bind(pod, node, trace_id=tid):
                             self._status(409, "Conflict",
                                          "pod already bound or gone")
                             return
@@ -1980,7 +2007,7 @@ class APIServer:
         # and a watch would pin a readonly slot for its whole lifetime.
         if outer.flow_control is not None:
             exempt = ("/healthz", "/livez", "/readyz", "/metrics",
-                      "/version")
+                      "/version", "/debug/traces")
             for method in ("do_GET", "do_POST", "do_PUT", "do_PATCH",
                            "do_DELETE"):
                 inner = getattr(Handler, method)

@@ -49,6 +49,19 @@ def _auth_headers(token: str, json_body: bool = False) -> dict:
     headers = {"Content-Type": "application/json"} if json_body else {}
     if token:
         headers["Authorization"] = f"Bearer {token}"
+    # cross-component trace propagation (utils/trace.py): every REST
+    # write issued inside a traced section (the scheduler's commit tail
+    # sets the thread-local around binds/victim deletes) carries the
+    # cycle's traceparent, so the apiserver can join the request to the
+    # scheduling decision.  Untraced callers add no header.
+    from kubernetes_tpu.utils.trace import (
+        TRACEPARENT_HEADER,
+        current_traceparent,
+    )
+
+    tp = current_traceparent()
+    if tp:
+        headers[TRACEPARENT_HEADER] = tp
     return headers
 
 

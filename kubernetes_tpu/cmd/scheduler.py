@@ -51,6 +51,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run behind a LocalCluster lease")
     p.add_argument("--leader-elect-identity", default="scheduler-0")
     p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--trace-threshold-seconds", type=float, default=None,
+                   help="log any scheduling cycle whose root span exceeds "
+                   "this many seconds (config traceThresholdSeconds; "
+                   "default 0.1, <=0 disables the slow-cycle log; the "
+                   "flight recorder at /debug/traces stays always-on)")
     p.add_argument("--simulate-nodes", type=int, default=0,
                    help="register N hollow nodes")
     p.add_argument("--simulate-pods", type=int, default=0,
@@ -80,6 +85,8 @@ def main(argv=None) -> int:
         cc.algorithm_provider = args.algorithm_provider
     if args.batch_size:
         cc.batch_size = args.batch_size
+    if args.trace_threshold_seconds is not None:
+        cc.trace_threshold_s = args.trace_threshold_seconds
 
     if args.kubeconfig:
         with open(args.kubeconfig) as f:

@@ -35,6 +35,23 @@ import numpy as np
 from kubernetes_tpu.codec import faults
 from kubernetes_tpu.codec.schema import _pow2
 
+
+def device_annotation(name: str):
+    """Optional jax.profiler annotation around a device-path section:
+    when a real accelerator backend is active (and a jax profiler trace
+    is being captured) the named range shows up in the device timeline,
+    composing with the host-side spans (utils/trace.py).  On the CPU
+    backend — the tier-1 path — this is a zero-cost no-op, so callers
+    can wrap hot sections unconditionally."""
+    import contextlib
+
+    if jax.default_backend() == "cpu":
+        return contextlib.nullcontext()
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # profiler unavailable on this backend build
+        return contextlib.nullcontext()
+
 # ---------------------------------------------------------------- D2H fences
 #
 # Every device->host materialization the RUNTIME performs goes through the
@@ -74,7 +91,8 @@ def host_fetch(x, tag: str = "fetch") -> np.ndarray:
     observable."""
     _note_sync(tag)
     faults.check(faults.SITE_FETCH)
-    return faults.corrupt(faults.SITE_FETCH, np.asarray(x))
+    with device_annotation(f"ktpu.{tag}"):
+        return faults.corrupt(faults.SITE_FETCH, np.asarray(x))
 
 
 def upload_async(tree):
@@ -179,9 +197,10 @@ class AsyncFetch:
     def _run(self) -> None:
         try:
             faults.check(faults.SITE_FETCH)
-            self._out = faults.corrupt(
-                faults.SITE_FETCH, np.asarray(self._dev)
-            )
+            with device_annotation(f"ktpu.{self._tag}"):
+                self._out = faults.corrupt(
+                    faults.SITE_FETCH, np.asarray(self._dev)
+                )
         except BaseException as e:  # noqa: BLE001 — re-raised in result()
             self._err = e
         finally:
@@ -440,7 +459,8 @@ class DeviceSnapshotCache:
             else:
                 self._host[f.name] = host  # content-equal: no upload needed
         if changed:
-            uploaded = jax.device_put([staged[n] for n in changed])
+            with device_annotation("ktpu.snapshot_upload"):
+                uploaded = jax.device_put([staged[n] for n in changed])
             self._dev.update(zip(changed, uploaded))
             self._host.update(staged)
         return type(cluster)(**self._dev)

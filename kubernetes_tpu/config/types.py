@@ -79,6 +79,11 @@ class KubeSchedulerConfiguration:
     adaptive_batch: bool = False
     batch_size_min: int = 16
     cycle_deadline_s: float = 0.0
+    # tracing (utils/trace.py + runtime/flightrecorder.py): cycles whose
+    # root span exceeds this log the full phase breakdown (the utiltrace
+    # 100ms convention, now a knob); <=0 disables the slow-cycle log
+    # (flight-recorder span capture stays always-on)
+    trace_threshold_s: float = 0.1
 
     def build_profile(self, interner=None) -> SchedulingProfile:
         """CreateFromConfig / CreateFromProvider (scheduler.go:162-192)."""
@@ -136,6 +141,7 @@ class KubeSchedulerConfiguration:
             adaptive_batch=bool(d.get("adaptiveBatch", False)),
             batch_size_min=int(d.get("batchSizeMin", 16)),
             cycle_deadline_s=float(d.get("cycleDeadlineSeconds", 0.0)),
+            trace_threshold_s=float(d.get("traceThresholdSeconds", 0.1)),
         )
 
     @staticmethod

@@ -354,10 +354,23 @@ class HTTPExtender:
 
     @staticmethod
     def _http_post(url: str, payload: dict, timeout: float) -> dict:
+        headers = {"Content-Type": "application/json"}
+        # the scheduler sets the cycle's trace context around the
+        # extender fan-out (and the bind tail): every extender
+        # round-trip carries the cycle's traceparent so the extender
+        # side is joinable to the scheduling decision (utils/trace.py)
+        from kubernetes_tpu.utils.trace import (
+            TRACEPARENT_HEADER,
+            current_traceparent,
+        )
+
+        tp = current_traceparent()
+        if tp:
+            headers[TRACEPARENT_HEADER] = tp
         req = urllib.request.Request(
             url,
             data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
+            headers=headers,
             method="POST",
         )
         with urllib.request.urlopen(req, timeout=timeout) as resp:

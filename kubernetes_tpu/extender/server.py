@@ -33,7 +33,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import threading
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
@@ -71,6 +71,10 @@ class ExtenderServer:
         # FIFO-capped so never-bound pods cannot leak for the server's life
         self._pending: "OrderedDict[tuple, Pod]" = OrderedDict()
         self._pending_cap = 10000
+        # trace ids seen on incoming verb requests (the scheduler's
+        # cycle traceparent, utils/trace.py): the extender half of the
+        # end-to-end join — bounded, newest last
+        self.seen_trace_ids: "deque[str]" = deque(maxlen=256)
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._thread: Optional[threading.Thread] = None
 
@@ -274,6 +278,11 @@ class ExtenderServer:
 
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
+                from kubernetes_tpu.utils.trace import trace_id_of
+
+                tid = trace_id_of(self.headers.get("Traceparent", ""))
+                if tid:
+                    outer.seen_trace_ids.append(tid)
                 try:
                     args = json.loads(self.rfile.read(n) or b"{}")
                 except ValueError:

@@ -6,5 +6,6 @@ plane stays on the host (Python), the Filter/Score math lives on device.
 
 from kubernetes_tpu.runtime.queue import PriorityQueue, PodBackoff
 from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.flightrecorder import RECORDER, FlightRecorder
 from kubernetes_tpu.runtime.health import DeviceHealth
 from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig

@@ -342,9 +342,13 @@ class LocalCluster:
             )
             return True
 
-    def bind(self, pod: Pod, node_name: str) -> bool:
+    def bind(self, pod: Pod, node_name: str, trace_id: str = "") -> bool:
         """The Binding-subresource analog (registry sets spec.nodeName,
-        SURVEY section 3.3): CAS on the stored pod."""
+        SURVEY section 3.3): CAS on the stored pod.  A non-empty trace_id
+        (the scheduling cycle's, from the bind request's traceparent
+        header or the in-process trace context) is stamped onto the bound
+        pod as an annotation — the join key that makes one scheduling
+        decision traceable from cycle span to stored object."""
         import dataclasses
 
         with self._lock:
@@ -353,8 +357,18 @@ class LocalCluster:
                 return False
             if cur.spec.node_name:
                 return False  # already bound
+            meta = cur.metadata
+            if trace_id:
+                meta = dataclasses.replace(
+                    meta,
+                    annotations={
+                        **meta.annotations,
+                        "kubernetes-tpu.io/trace-id": trace_id,
+                    },
+                )
             bound = dataclasses.replace(
-                cur, spec=dataclasses.replace(cur.spec, node_name=node_name)
+                cur, metadata=meta,
+                spec=dataclasses.replace(cur.spec, node_name=node_name),
             )
             self.update("pods", bound)
             return True
@@ -481,9 +495,13 @@ def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
 
 
 def make_cluster_binder(cluster: LocalCluster):
-    """Binder callback for Scheduler: POST .../binding analog."""
+    """Binder callback for Scheduler: POST .../binding analog.  Carries
+    the calling thread's trace context (the scheduler sets it around the
+    commit tail) so embedded single-process planes stamp the same
+    trace-id annotation the HTTP Binding path does."""
+    from kubernetes_tpu.utils.trace import current_trace_id
 
     def binder(pod: Pod, node_name: str) -> bool:
-        return cluster.bind(pod, node_name)
+        return cluster.bind(pod, node_name, trace_id=current_trace_id())
 
     return binder

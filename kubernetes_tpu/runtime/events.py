@@ -30,6 +30,10 @@ class Event:
     count: int = 1
     first_timestamp: float = field(default_factory=time.time)
     last_timestamp: float = field(default_factory=time.time)
+    # tracing join key (utils/trace.py): the scheduling-cycle trace id
+    # that produced this event, "" when the emitter carried no context —
+    # what makes one decision joinable across cycle span / bind / event
+    trace_id: str = ""
 
 
 class EventRecorder:
@@ -42,16 +46,21 @@ class EventRecorder:
         self._order: List[Tuple] = []
         self._max = max_events
 
-    def _record_locked(self, key: Tuple, now: float) -> Event:
+    def _record_locked(self, key: Tuple, now: float,
+                       trace_id: str = "") -> Event:
         """Aggregate-or-append one event; the caller holds self._lock.
         `key` is (kind, namespace, name, type_, reason, msg) — the Event
-        constructor's field order."""
+        constructor's field order.  trace_id is NOT part of the
+        aggregation key (a repeat from a later cycle still aggregates);
+        the LATEST non-empty id wins, pointing at the freshest cycle."""
         ev = self._by_key.get(key)
         if ev is not None:
             ev.count += 1
             ev.last_timestamp = now
+            if trace_id:
+                ev.trace_id = trace_id
             return ev
-        ev = Event(*key)
+        ev = Event(*key, trace_id=trace_id)
         self._by_key[key] = ev
         self._order.append(key)
         while len(self._order) > self._max:
@@ -68,23 +77,30 @@ class EventRecorder:
         reason: str,
         message_fmt: str,
         *args,
+        trace_id: str = "",
     ) -> Event:
         msg = message_fmt % args if args else message_fmt
         with self._lock:
             return self._record_locked(
-                (kind, namespace, name, type_, reason, msg), time.time()
+                (kind, namespace, name, type_, reason, msg), time.time(),
+                trace_id=trace_id,
             )
 
     def eventf_batch(self, entries) -> None:
         """Record many pre-formatted events under ONE lock acquisition (the
         batched commit path emits a whole cycle's audit trail at once).
         entries: iterable of (kind, namespace, name, type_, reason, msg)
-        with msg already formatted.  Aggregation semantics identical to
-        per-event eventf calls in the same order."""
+        or 7-tuples with a trailing trace_id, msg already formatted.
+        Aggregation semantics identical to per-event eventf calls in the
+        same order."""
         now = time.time()
         with self._lock:
             for entry in entries:
-                self._record_locked(tuple(entry), now)
+                entry = tuple(entry)
+                trace_id = ""
+                if len(entry) == 7:
+                    entry, trace_id = entry[:6], entry[6]
+                self._record_locked(entry, now, trace_id=trace_id)
 
     def events(
         self,

@@ -168,9 +168,19 @@ class HealthServer:
         port: int = 0,
         healthy: Optional[Callable[[], bool]] = None,
         registry=None,
+        traces: Optional[Callable[[], dict]] = None,
     ):
         self._healthy = healthy or (lambda: True)
         self._registry = registry or m.REGISTRY
+        # /debug/traces: the flight recorder's span ring as Chrome
+        # trace-event JSON (open in Perfetto / chrome://tracing).  The
+        # default serves the process-wide recorder — the one a default-
+        # constructed Scheduler records into.
+        if traces is None:
+            from kubernetes_tpu.runtime.flightrecorder import RECORDER
+
+            traces = RECORDER.chrome_trace
+        self._traces = traces
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -194,6 +204,13 @@ class HealthServer:
                     self._send(
                         outer._registry.expose().encode(),
                         ct="text/plain; version=0.0.4",
+                    )
+                elif self.path == "/debug/traces":
+                    import json
+
+                    self._send(
+                        json.dumps(outer._traces()).encode(),
+                        ct="application/json",
                     )
                 else:
                     self._send(b"not found", 404)

@@ -58,10 +58,11 @@ from kubernetes_tpu.runtime.events import (
     EVENT_TYPE_WARNING,
     EventRecorder,
 )
+from kubernetes_tpu.runtime.flightrecorder import RECORDER, FlightRecorder
 from kubernetes_tpu.runtime.queue import PriorityQueue
 from kubernetes_tpu.utils import klog
 from kubernetes_tpu.utils import metrics as m
-from kubernetes_tpu.utils.trace import Trace
+from kubernetes_tpu.utils.trace import Span, current_trace_id, use_traceparent
 
 TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
 
@@ -132,6 +133,11 @@ class SchedulerConfig:
     # per-cycle wall-clock budget driving the multiplicative decrease;
     # 0 = no deadline (depth alone steers the batch size)
     cycle_deadline_s: float = 0.0
+    # --- tracing (utils/trace.py spans + runtime/flightrecorder.py) ---
+    # a cycle whose root span exceeds this logs the full span breakdown
+    # (the utiltrace 100ms convention, now configurable); <=0 disables
+    # the slow-cycle log (spans still record to the flight recorder)
+    trace_threshold_s: float = 0.1
     # multi-scheduler: only pods whose spec.schedulerName names THIS
     # scheduler enter its queue (eventhandlers.go responsibleForPod)
     scheduler_name: str = "default-scheduler"
@@ -169,6 +175,7 @@ class SchedulerConfig:
             adaptive_batch=getattr(cc, "adaptive_batch", False),
             batch_size_min=getattr(cc, "batch_size_min", 16),
             cycle_deadline_s=getattr(cc, "cycle_deadline_s", 0.0),
+            trace_threshold_s=getattr(cc, "trace_threshold_s", 0.1),
         )
 
 
@@ -206,7 +213,8 @@ class _InFlight:
     ext_failed: Dict[int, str]
     pc: object                   # shared PluginContext (framework cycles)
     t_cycle0: float
-    trace: Trace
+    trace: Span                  # the cycle's ROOT span (one trace id per
+    #                              cycle, propagated to binds/extenders)
     # --- device-fault resilience ---
     # re-dispatch the SAME encoded batch (transient-retry path); None for
     # degraded cycles
@@ -265,6 +273,8 @@ class Scheduler:
         framework=None,  # framework.v1alpha1.Framework; None = no plugins
         recorder: Optional[EventRecorder] = None,
         extenders: Optional[Sequence] = None,  # extender.client.HTTPExtender
+        flight_recorder: Optional[FlightRecorder] = None,  # None = the
+        #                       process-wide ring (flightrecorder.RECORDER)
     ):
         # NB: PriorityQueue defines __len__, so `queue or PriorityQueue()`
         # would silently replace an *empty* caller-owned queue
@@ -360,6 +370,10 @@ class Scheduler:
         # double-buffer slot for pipeline_commit: at most one dispatched
         # batch whose host tail has not run yet
         self._in_flight: Optional[_InFlight] = None
+        # the most recently dispatched cycle's root span: what a
+        # postmortem attaches as in_flight when an anomaly fires before
+        # that cycle retires into the flight-recorder ring
+        self._cur_span: Optional[Span] = None
         # per-phase seconds, cumulative (bench live-path reporting):
         # pop (queue drain — under pipeline_commit this overlaps the
         # previous batch's in-flight fetch), encode (host tensors +
@@ -372,6 +386,11 @@ class Scheduler:
             "pop": 0.0, "encode": 0.0, "dispatch": 0.0, "fetch": 0.0,
             "fetch_block": 0.0, "commit": 0.0, "preempt": 0.0,
         }
+        # always-on cycle-span ring + anomaly postmortems (ISSUE 5); the
+        # default is the process-wide recorder served at /debug/traces
+        self.flight_recorder = (
+            flight_recorder if flight_recorder is not None else RECORDER
+        )
         self.results: List[ScheduleResult] = []
         # (preemptor key, node name, victim keys) per successful preemption
         self.preemptions: List[Tuple[Tuple[str, str], str, List[Tuple[str, str]]]] = []
@@ -412,9 +431,63 @@ class Scheduler:
         batch staying Pending forever."""
         try:
             return self._commit_state_resilient(inf)
-        except BaseException:
+        except BaseException as e:
             self.queue.add_unschedulable_batch(inf.pods, inf.cycle)
+            # the failing cycle's span retires into the ring FIRST so the
+            # postmortem snapshot below contains it
+            inf.trace.annotate(error=f"{type(e).__name__}: {e}")
+            inf.trace.finish()
+            self.flight_recorder.record(inf.trace)
+            if classify_device_error(e) is None:
+                # an error that escaped the classified machinery is by
+                # definition the case nobody predicted: snapshot the ring
+                self._postmortem(
+                    "unclassified_error", f"{type(e).__name__}: {e}"
+                )
             raise
+
+    # -------------------------------------------------- tracing/postmortems
+
+    def _phase(self, name: str, dt: float) -> None:
+        """One accumulation point for per-phase seconds: the driver-
+        visible phase_seconds dict (bench reporting) AND the /metrics
+        counter family move together."""
+        self.phase_seconds[name] += dt
+        m.CYCLE_PHASE_SECONDS.inc(dt, phase=name)
+
+    def _postmortem(self, trigger: str, detail: str = "") -> None:
+        """Dump a flight-recorder postmortem for one anomaly trigger
+        (throttled per trigger inside the recorder): the last N cycle
+        spans + the CURRENT cycle's in-flight span (a breaker trips
+        mid-cycle, before that span retires into the ring) + queue/
+        breaker/AIMD state + the metrics registry text.  State and
+        metrics are passed as THUNKS: a shed storm hits this once per
+        dropped pod, and throttled calls must cost ~nothing."""
+        self.flight_recorder.postmortem(
+            trigger, detail,
+            state=self._postmortem_state,
+            metrics_text=m.REGISTRY.expose,
+            in_flight=[self._cur_span] if self._cur_span is not None else None,
+        )
+
+    def _postmortem_state(self) -> dict:
+        """Point-in-time control-plane state for a postmortem snapshot —
+        the numbers an operator reaches for first in an incident."""
+        q = self.queue
+        return {
+            "queue_depth": len(q),
+            "active_depth": (
+                q.active_depth() if hasattr(q, "active_depth") else None
+            ),
+            "queue_capacity": getattr(q, "capacity", None),
+            "shed_total": getattr(q, "shed_total", 0),
+            "breaker": self.device_health.state,
+            "consecutive_failures": self.device_health.consecutive_failures,
+            "fault_counts": dict(self.device_health.fault_counts),
+            "adaptive_batch": self._cur_batch,
+            "pipeline_pending": self.pipeline_pending,
+            "scheduling_cycle": self.queue.scheduling_cycle,
+        }
 
     # ----------------------------------------------- device-fault handling
 
@@ -443,6 +516,8 @@ class Scheduler:
             "device breaker %s -> %s (consecutive failures: %d)",
             frm, to, self.device_health.consecutive_failures,
         )
+        if to == "open":
+            self._postmortem("breaker_open", f"{frm} -> {to}")
 
     def _on_shed(self, pod: Pod, reason: str) -> None:
         """Bounded-queue shed audit (runtime/queue.py on_shed): one
@@ -454,6 +529,9 @@ class Scheduler:
             "pod shed from the scheduling queue (%s, capacity %s)",
             reason, self.queue.capacity,
         )
+        # per-trigger throttling in the recorder turns a storm of sheds
+        # into ONE postmortem capturing the lead-up, not one per pod
+        self._postmortem("shed_burst", reason)
 
     def _adapt_batch(self, cycle_s: float) -> None:
         """AIMD batch-size update, once per non-empty cycle: halve on a
@@ -470,6 +548,11 @@ class Scheduler:
         cur = self._cur_batch
         if cfg.cycle_deadline_s > 0 and cycle_s > cfg.cycle_deadline_s:
             m.CYCLE_DEADLINE_EXCEEDED.inc()
+            self._postmortem(
+                "cycle_deadline",
+                f"cycle took {cycle_s:.3f}s > {cfg.cycle_deadline_s:.3f}s "
+                f"budget (batch {cur})",
+            )
             cur = max(floor, cur // 2)
         else:
             depth = self.queue.active_depth()
@@ -496,7 +579,11 @@ class Scheduler:
         handle for a host-computed result and mark the cycle degraded."""
         inf.fetch = inf.cpu_fetch()
         inf.degraded = True
+        # overwrite the dispatch-time attrs: the placements this cycle
+        # commits came from the CPU engine, whatever was launched first
+        inf.trace.annotate(degraded=True, engine="cpu")
         m.DEGRADED_CYCLES.inc()
+        self._postmortem("degraded_cycle", "fence gave up on the device")
 
     def _fault_retry_allowed(
         self, fc: str, attempt: int, can_relaunch: bool = True
@@ -544,6 +631,10 @@ class Scheduler:
                 self._note_device_fault(
                     fc, e, "dispatch" if relaunch_pending else "fence"
                 )
+                # the span carries the LAST retry class + attempt count —
+                # the two facts a postmortem reader joins against the
+                # breaker state
+                inf.trace.annotate(fault_class=fc, fault_attempts=attempt + 1)
                 if self._fault_retry_allowed(
                     fc, attempt,
                     can_relaunch=(
@@ -570,9 +661,17 @@ class Scheduler:
         if not pods:
             return None
         t_cycle0 = time.monotonic()
-        trace = Trace("schedule_cycle", pods=len(pods))
         enc = self.cache.encoder
         cycle = self.queue.scheduling_cycle
+        # the cycle's ROOT span: one fresh trace id per cycle, child spans
+        # per phase, annotated with the device-path facts (batch width,
+        # dirty rows, breaker state, retry class) — retired into the
+        # flight recorder when the commit tail finishes
+        trace = Span(
+            "schedule_cycle", start=t_cycle0, pods=len(pods), cycle=cycle,
+        )
+        self._cur_span = trace
+        enc_span = trace.child("encode")
         batch_keys = {(p.namespace, p.name) for p in pods}
         # engine choice is made BEFORE the encode so degraded cycles leave
         # the encoder's dirty-row stream unconsumed (the device cache isn't
@@ -619,7 +718,7 @@ class Scheduler:
             # extender round-trips below run outside the lock, and the live
             # node_rows dict may be mutated (rows recycled/regrown) meanwhile
             node_row_map = dict(enc.node_rows)
-        trace.step("encode")
+        enc_span.finish()
         fwk = self.framework
         pc = None
         extra_mask = extra_score = None
@@ -650,18 +749,19 @@ class Scheduler:
             e.config.filter_verb or e.config.prioritize_verb
             for e in self.extenders
         ):
+            ext_span = trace.child("extenders", n=len(self.extenders))
             extra_mask, extra_score, ext_failed = self._apply_extenders(
                 pods, node_row_map, cluster, extra_mask, extra_score,
-                n_rows=batch.n_pods,
+                n_rows=batch.n_pods, trace_ctx=trace.traceparent(),
             )
-            trace.step("extenders")
+            ext_span.finish()
         if nom_block is not None:
             # pass-one infeasibility from nominated ports/anti-affinity
             extra_mask = (
                 ~nom_block if extra_mask is None else (extra_mask & ~nom_block)
             )
         t_disp = time.monotonic()
-        self.phase_seconds["encode"] += t_disp - t_cycle0
+        self._phase("encode", t_disp - t_cycle0)
         fn = self._schedule_fn
         if self._speculative_fn is not None:
             fn = self._speculative_fn
@@ -709,6 +809,7 @@ class Scheduler:
 
         degraded = False
         hosts_dev = None
+        disp_span = trace.child("dispatch")
         if use_device:
             launched = self._launch_resilient(launch)
         else:
@@ -717,12 +818,24 @@ class Scheduler:
             # breaker open (or dispatch gave up): degraded CPU cycle
             degraded = True
             m.DEGRADED_CYCLES.inc()
+            self._postmortem(
+                "degraded_cycle",
+                "breaker open at dispatch" if not use_device
+                else "dispatch gave up on the device",
+            )
             fetch = cpu_fetch()
         else:
             hosts_dev, fetch = launched
         self._last_index += len(pods)
-        trace.step("device")
-        self.phase_seconds["dispatch"] += time.monotonic() - t_disp
+        disp_span.finish()
+        trace.annotate(
+            batch=len(pods),
+            dirty_rows=len(dirty_rows) if dirty_rows is not None else -1,
+            breaker=self.device_health.state,
+            degraded=degraded,
+            engine="cpu" if degraded else self.config.engine,
+        )
+        self._phase("dispatch", time.monotonic() - t_disp)
         return _InFlight(
             pods=list(pods), hosts_dev=hosts_dev, fetch=fetch,
             generation=generation, cycle=cycle, ext_failed=ext_failed,
@@ -811,9 +924,15 @@ class Scheduler:
         # overlap working, not double counting.  "fetch_block" is the
         # residual host stall at the fence — the number the async path
         # exists to drive to ~0.
-        self.phase_seconds["fetch"] += inf.fetch.seconds
-        self.phase_seconds["fetch_block"] += t_state0 - t_fetch0
-        inf.trace.step("fetch")
+        self._phase("fetch", inf.fetch.seconds)
+        self._phase("fetch_block", t_state0 - t_fetch0)
+        # fetch = the ASYNC device window (stamped on the fetch worker,
+        # reconstructed here from its measured duration); fetch_block =
+        # the residual host stall at the fence, a SUBSET of fetch
+        inf.trace.add_child(
+            "fetch", t_state0 - inf.fetch.seconds, t_state0, overlapped=True,
+        )
+        inf.trace.add_child("fetch_block", t_fetch0, t_state0)
         # algorithm latency: encode + device filter/score/select, amortized
         # per pod (metrics.go SchedulingAlgorithmLatency)
         algo_dt = (time.monotonic() - inf.t_cycle0) / len(pods)
@@ -847,6 +966,9 @@ class Scheduler:
         # ONE lock acquisition + one encoder delta for the whole batch
         self.cache.assume_pods([a for _, _, a, _ in winners])
         staged.state_seconds = time.monotonic() - t_state0
+        inf.trace.add_child(
+            "commit", t_state0, time.monotonic(), winners=len(winners),
+        )
         return staged
 
     def _commit_tail(self, staged: _Staged) -> List[ScheduleResult]:
@@ -856,18 +978,30 @@ class Scheduler:
         is exact)."""
         inf = staged.inf
         pods = inf.pods
-        if staged.batched:
-            results, fit_errors = self._tail_batched(staged)
-        else:
-            results, fit_errors = self._tail_perpod(staged)
-        inf.trace.step("commit")
-        if not self.config.disable_preemption:
-            t_p = time.monotonic()
-            for pod in fit_errors:
-                self.preempt(pod)
-            inf.trace.step("preempt")
-            self.phase_seconds["preempt"] += time.monotonic() - t_p
-        inf.trace.log_if_long(0.1)
+        # the cycle's trace context is CURRENT for the whole tail: binds
+        # (RemoteBinder / bind-verb extenders attach the traceparent
+        # header) and Scheduled/FailedScheduling events (trace_id field)
+        # all join back to this cycle's root span
+        with use_traceparent(inf.trace):
+            tail_span = inf.trace.child("bind-tail")
+            if staged.batched:
+                results, fit_errors = self._tail_batched(staged)
+            else:
+                results, fit_errors = self._tail_perpod(staged)
+            tail_span.finish()
+            if not self.config.disable_preemption:
+                t_p = time.monotonic()
+                p_span = inf.trace.child("preempt", failed=len(fit_errors))
+                for pod in fit_errors:
+                    self.preempt(pod)
+                p_span.finish()
+                self._phase("preempt", time.monotonic() - t_p)
+        placed = sum(1 for r in results if r.node is not None)
+        inf.trace.annotate(placed=placed, unschedulable=len(results) - placed)
+        inf.trace.finish()
+        if self.config.trace_threshold_s > 0:
+            inf.trace.log_if_long(self.config.trace_threshold_s)
+        self.flight_recorder.record(inf.trace)
         m.PENDING_PODS.set(float(len(self.queue)))
         self.results.extend(results)
         return results
@@ -897,6 +1031,7 @@ class Scheduler:
                     "Pod", pod.namespace, pod.name,
                     EVENT_TYPE_WARNING, "FailedScheduling",
                     "extender error: %s", ext_failed[i],
+                    trace_id=inf.trace.trace_id,
                 )
                 continue
             if row < 0:
@@ -911,6 +1046,7 @@ class Scheduler:
                     "Pod", pod.namespace, pod.name,
                     EVENT_TYPE_WARNING, "FailedScheduling",
                     "0/%d nodes are available", len(self.cache.encoder.node_rows),
+                    trace_id=inf.trace.trace_id,
                 )
                 continue
             node_name = enc.row_name(row)
@@ -936,7 +1072,7 @@ class Scheduler:
                     self._record_scheduled(
                         pod, node_name, algo_dt + (time.monotonic() - t_pod)
                     )
-        self.phase_seconds["commit"] += time.monotonic() - t_commit0
+        self._phase("commit", time.monotonic() - t_commit0)
         return results, fit_errors
 
     def _tail_batched(self, staged: _Staged):
@@ -953,6 +1089,9 @@ class Scheduler:
         results: List[Optional[ScheduleResult]] = [None] * B
         events: List[Optional[Tuple]] = [None] * B
         n_nodes = len(self.cache.encoder.node_rows)
+        # every event of this cycle joins the cycle's trace (7th tuple
+        # element; eventf_batch splits it off the aggregation key)
+        tid = inf.trace.trace_id
         losers: List[Pod] = []
         for i in staged.fit_idx:
             pod = pods[i]
@@ -961,7 +1100,7 @@ class Scheduler:
             events[i] = (
                 "Pod", pod.namespace, pod.name,
                 EVENT_TYPE_WARNING, "FailedScheduling",
-                "0/%d nodes are available" % n_nodes,
+                "0/%d nodes are available" % n_nodes, tid,
             )
         for i, msg in inf.ext_failed.items():
             pod = pods[i]
@@ -970,7 +1109,7 @@ class Scheduler:
             events[i] = (
                 "Pod", pod.namespace, pod.name,
                 EVENT_TYPE_WARNING, "FailedScheduling",
-                "extender error: %s" % msg,
+                "extender error: %s" % msg, tid,
             )
         # enqueue stamps BEFORE the bind fan-out: a bind's informer echo
         # (bound-pod update -> queue.delete) races a later take and would
@@ -1003,7 +1142,7 @@ class Scheduler:
                     "Pod", pod.namespace, pod.name,
                     EVENT_TYPE_NORMAL, "Scheduled",
                     "Successfully assigned %s/%s to %s"
-                    % (pod.namespace, pod.name, node_name),
+                    % (pod.namespace, pod.name, node_name), tid,
                 )
             else:
                 # optimistic rollback: ForgetPod + requeue, exactly the
@@ -1017,7 +1156,7 @@ class Scheduler:
                     "Pod", pod.namespace, pod.name,
                     EVENT_TYPE_WARNING, "FailedScheduling",
                     self._BIND_REJECT_MSG
-                    % (pod.namespace, pod.name, node_name),
+                    % (pod.namespace, pod.name, node_name), tid,
                 )
         # batched bookkeeping: one lock acquisition per structure
         self.queue.add_unschedulable_batch(losers, cycle)
@@ -1049,17 +1188,17 @@ class Scheduler:
         if eventf_batch is not None:
             eventf_batch(entries)
         else:  # duck-typed recorder without the batch entry point
-            for kind, ns, name, type_, reason, msg in entries:
+            for kind, ns, name, type_, reason, msg, _tid in entries:
                 self.recorder.eventf(kind, ns, name, type_, reason, "%s", msg)
-        self.phase_seconds["commit"] += (
-            staged.state_seconds + time.monotonic() - t_tail0
+        self._phase(
+            "commit", staged.state_seconds + time.monotonic() - t_tail0
         )
         return list(results), [pods[i] for i in staged.fit_idx]
 
     # --------------------------------------------------------- extenders
 
     def _apply_extenders(self, pods, rows, cluster, extra_mask, extra_score,
-                         n_rows=None):
+                         n_rows=None, trace_ctx=""):
         """Chain the configured HTTP extenders per pod: each filter
         round-trip intersects the feasibility mask (an extender can only
         veto, never resurrect — generic_scheduler.go:527-554), prioritize
@@ -1095,6 +1234,13 @@ class Scheduler:
 
         def one_pod(i_pod):
             i, pod = i_pod
+            # pool workers re-enter the CYCLE's trace context explicitly
+            # (thread-locals don't cross the executor boundary), so every
+            # extender round-trip carries the cycle's traceparent header
+            with use_traceparent(trace_ctx):
+                return _one_pod_traced(i, pod)
+
+        def _one_pod_traced(i, pod):
             names = list(all_names)
             for ext in self.extenders:
                 if not ext.is_interested(pod):
@@ -1158,6 +1304,8 @@ class Scheduler:
             EVENT_TYPE_NORMAL, "Scheduled",
             "Successfully assigned %s/%s to %s",
             pod.namespace, pod.name, node_name,
+            trace_id=current_trace_id(),  # set during the cycle tail;
+            #                               "" on gang/async-bind paths
         )
 
     def _reserve_and_bind(
@@ -1256,6 +1404,7 @@ class Scheduler:
             "Pod", pod.namespace, pod.name,
             EVENT_TYPE_WARNING, "FailedScheduling",
             "%s", message or f"rejected after assume on {node_name}",
+            trace_id=current_trace_id(),
         )
 
     def _finish_waiting_pod(
@@ -1592,7 +1741,7 @@ class Scheduler:
             self.config.batch_window_s,
         )
         t_cycle0 = time.monotonic()
-        self.phase_seconds["pop"] += t_cycle0 - t_pop
+        self._phase("pop", t_cycle0 - t_pop)
         if not pods:
             # idle poll: drain any in-flight batch so binds/events/requeues
             # don't wait for the next arrival; idle cycles also DECAY the

@@ -1,2 +1,11 @@
-from kubernetes_tpu.utils.trace import Trace
+from kubernetes_tpu.utils.trace import (
+    Span,
+    Trace,
+    chrome_trace,
+    current_trace_id,
+    current_traceparent,
+    format_traceparent,
+    parse_traceparent,
+    use_traceparent,
+)
 from kubernetes_tpu.utils.metrics import Histogram, Counter, Gauge, REGISTRY

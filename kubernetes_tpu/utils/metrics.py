@@ -56,17 +56,28 @@ class Histogram:
             self.total += len(values)
 
     def quantile(self, q: float) -> float:
-        """Approximate quantile from bucket boundaries (upper bound)."""
+        """Approximate quantile with LINEAR INTERPOLATION inside the
+        bucket (the prometheus histogram_quantile estimator): the target
+        rank's position within its bucket's count scales between the
+        bucket's lower and upper bound, instead of snapping every answer
+        to the upper bound (which inflated p50 by up to 2x on these
+        pow2-spaced buckets).  The first bucket interpolates from 0; a
+        rank landing in the +Inf overflow bucket reports the highest
+        finite boundary, exactly as histogram_quantile does."""
         with self._lock:
             if self.total == 0:
                 return 0.0
             target = q * self.total
             acc = 0
             for i, c in enumerate(self.counts):
+                if c > 0 and acc + c >= target:
+                    if i >= len(self.buckets):
+                        return self.buckets[-1]  # overflow bucket
+                    lo = self.buckets[i - 1] if i > 0 else 0.0
+                    hi = self.buckets[i]
+                    return lo + (hi - lo) * (target - acc) / c
                 acc += c
-                if acc >= target:
-                    return self.buckets[i] if i < len(self.buckets) else float("inf")
-            return float("inf")
+            return self.buckets[-1]
 
     def expose(self) -> str:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
@@ -282,6 +293,23 @@ CYCLE_DEADLINE_EXCEEDED = REGISTRY.register(
         "scheduler_cycle_deadline_exceeded_total",
         "Scheduling cycles whose wall time overran the configured "
         "deadline budget (each triggers a multiplicative batch shrink)",
+    )
+)
+
+# per-cycle phase accounting (ISSUE 5): the scheduler's phase_seconds
+# dict was driver-only state (bench reporting); this family exposes the
+# same cumulative seconds on /metrics so a dashboard can watch the
+# encode/dispatch/fetch/commit split move without running the bench
+CYCLE_PHASE_SECONDS = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_cycle_phase_seconds_total",
+        "Cumulative seconds spent per scheduling-cycle phase "
+        "(pop|encode|dispatch|fetch|fetch_block|commit|preempt); encode "
+        "includes the extender/framework fan-out (the span tree at "
+        "/debug/traces splits extenders out); fetch overlaps host phases "
+        "and fetch_block is a subset of fetch, so phase sums exceeding "
+        "wall clock means the pipeline is working",
+        ("phase",),
     )
 )
 

@@ -1,0 +1,218 @@
+"""Prometheus text-format validity + histogram quantile estimator.
+
+ISSUE 5 satellites: a STRICT parser over the full `REGISTRY.expose()`
+output (HELP/TYPE pairing, sample-name discipline, label escaping,
+monotone cumulative histogram buckets, +Inf == _count) run against a
+LIVE scheduler after a mixed success / unschedulable / degraded
+workload, plus unit tests pinning the linearly-interpolated
+`Histogram.quantile` on a known distribution (it used to return the
+bucket upper bound, inflating p50 by up to 2x on pow2 buckets).
+"""
+
+import re
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.chaos import Disruptions
+from kubernetes_tpu.runtime.cluster import LocalCluster
+from kubernetes_tpu.runtime.health import start_health_server
+from kubernetes_tpu.runtime.queue import PodBackoff, PriorityQueue
+from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.utils.metrics import Histogram
+
+from fixtures import make_node, make_pod
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME})(?:\{{(.*)\}})? (-?(?:[0-9]+(?:\.[0-9]+)?"
+    r"(?:[eE][+-]?[0-9]+)?|Inf|inf)|NaN)$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\["\\n])*)"')
+
+
+def parse_exposition(text: str) -> dict:
+    """Strict parser for the Prometheus text format (version 0.0.4).
+
+    Returns {family: {"type": ..., "samples": [(name, {labels}, value)]}}
+    and raises AssertionError on any violation: a sample without a
+    preceding HELP+TYPE pair, TYPE before HELP, a sample name that
+    doesn't belong to the current family (histograms may only append
+    _bucket/_sum/_count), malformed label syntax, or an unparseable
+    value."""
+    families: dict = {}
+    helped: set = set()
+    current = None  # family name of the preceding TYPE line
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            assert len(parts) >= 3, f"line {lineno}: malformed HELP"
+            name = parts[2]
+            assert name not in families, (
+                f"line {lineno}: duplicate HELP for {name}"
+            )
+            helped.add(name)
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, f"line {lineno}: malformed TYPE"
+            _, _, name, mtype = parts
+            assert mtype in ("counter", "gauge", "histogram", "summary",
+                            "untyped"), f"line {lineno}: bad type {mtype}"
+            assert name in helped, (
+                f"line {lineno}: TYPE {name} without preceding HELP"
+            )
+            assert name not in families, (
+                f"line {lineno}: duplicate TYPE for {name}"
+            )
+            families[name] = {"type": mtype, "samples": []}
+            current = name
+            continue
+        assert not line.startswith("#"), f"line {lineno}: stray comment"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"line {lineno}: unparseable sample {line!r}"
+        name, labels_raw, value = m.group(1), m.group(2), m.group(3)
+        assert current is not None, (
+            f"line {lineno}: sample before any HELP/TYPE"
+        )
+        allowed = {current}
+        if families[current]["type"] == "histogram":
+            allowed |= {current + s for s in ("_bucket", "_sum", "_count")}
+        assert name in allowed, (
+            f"line {lineno}: sample {name} outside family {current}"
+        )
+        labels = {}
+        if labels_raw:
+            consumed = 0
+            for lm in _LABEL_RE.finditer(labels_raw):
+                labels[lm.group(1)] = lm.group(2)
+                consumed = lm.end()
+            rest = labels_raw[consumed:].strip().strip(",")
+            assert not rest, (
+                f"line {lineno}: malformed labels {labels_raw!r}"
+            )
+        float(value.replace("Inf", "inf"))  # parseable
+        families[current]["samples"].append((name, labels, float(
+            value.replace("Inf", "inf"))))
+    return families
+
+
+def check_histograms(families: dict) -> int:
+    """Monotone cumulative buckets, ascending le, +Inf == _count,
+    non-negative _sum for every histogram family.  Returns how many
+    histograms were checked."""
+    checked = 0
+    for fam, data in families.items():
+        if data["type"] != "histogram":
+            continue
+        buckets = [(lbl["le"], v) for n, lbl, v in data["samples"]
+                   if n == fam + "_bucket"]
+        count = next(v for n, _, v in data["samples"] if n == fam + "_count")
+        total = next(v for n, _, v in data["samples"] if n == fam + "_sum")
+        assert buckets, f"{fam}: no buckets"
+        assert buckets[-1][0] == "+Inf", f"{fam}: last bucket must be +Inf"
+        les = [float(le.replace("+Inf", "inf")) for le, _ in buckets]
+        assert les == sorted(les), f"{fam}: le boundaries not ascending"
+        counts = [v for _, v in buckets]
+        assert counts == sorted(counts), (
+            f"{fam}: cumulative bucket counts not monotone: {counts}"
+        )
+        assert counts[-1] == count, f"{fam}: +Inf bucket != _count"
+        assert total >= 0.0, f"{fam}: negative _sum"
+        checked += 1
+    return checked
+
+
+def test_metrics_exposition_valid_after_mixed_live_workload():
+    """The full registry text, after a live scheduler ran success +
+    unschedulable + DEGRADED (device-lost -> CPU fallback) cycles, must
+    survive the strict parser — fetched over HTTP like a real scraper."""
+    cache = SchedulerCache()
+    queue = PriorityQueue(backoff=PodBackoff(initial=0.01, max_duration=0.05))
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=lambda p, n: True,
+        config=SchedulerConfig(
+            disable_preemption=True,
+            device_retry_max=0, breaker_failure_threshold=1,
+            breaker_open_s=10.0, cpu_fallback=True,
+        ),
+    )
+    cache.add_node(make_node("m1", cpu="4", mem="8Gi"))
+    # success + unschedulable in one cycle
+    queue.add(make_pod("fits", cpu="100m"))
+    queue.add(make_pod("never", cpu="64"))
+    sched.run_once(timeout=0.3)
+    # degraded cycle: persistent fault trips the breaker, CPU serves it
+    dis = Disruptions(LocalCluster())
+    dis.device_lost()
+    try:
+        queue.add(make_pod("degraded", cpu="100m"))
+        sched.run_once(timeout=0.3)
+    finally:
+        dis.clear_device_faults()
+    assert sched.device_health.state == "open"
+
+    srv = start_health_server()
+    try:
+        h, p = srv.address
+        with urllib.request.urlopen(
+            f"http://{h}:{p}/metrics", timeout=5
+        ) as r:
+            assert "text/plain" in r.headers.get("Content-Type", "")
+            body = r.read().decode()
+    finally:
+        srv.stop()
+
+    families = parse_exposition(body)
+    assert check_histograms(families) >= 5
+    # the workload actually moved the counters the parser just validated
+    attempts = families["scheduler_schedule_attempts_total"]["samples"]
+    results = {lbl["result"] for _, lbl, v in attempts if v > 0}
+    assert {"scheduled", "unschedulable"} <= results
+    assert families["scheduler_degraded_cycles_total"]["samples"][0][2] > 0
+    # satellite: the per-cycle phase family is exposed and accumulated
+    phases = {
+        lbl["phase"]: v
+        for _, lbl, v in
+        families["scheduler_cycle_phase_seconds_total"]["samples"]
+    }
+    for phase in ("pop", "encode", "dispatch", "commit"):
+        assert phase in phases, f"phase {phase} missing from /metrics"
+    assert phases["encode"] > 0.0
+
+
+def test_quantile_interpolates_within_bucket():
+    """Known distribution: 1000 evenly spaced samples in [0, 1) over
+    quarter buckets — p50/p99 must land ~where the true percentiles
+    are, not snap to bucket upper bounds (the old behavior returned
+    0.5 for ANY p in (25%, 50%])."""
+    h = Histogram("t_interp", buckets=[0.25, 0.5, 0.75, 1.0])
+    h.observe_batch([i / 1000 for i in range(1000)])
+    # bucket counts: 251 / 250 / 250 / 249 (bisect_left boundary rule)
+    p50 = h.quantile(0.5)
+    assert p50 == pytest.approx(0.25 + 0.25 * (500 - 251) / 250, abs=1e-9)
+    assert abs(p50 - 0.4995) < 0.002  # ~the true median
+    p99 = h.quantile(0.99)
+    assert p99 == pytest.approx(0.75 + 0.25 * (990 - 751) / 249, abs=1e-9)
+    assert abs(p99 - 0.9895) < 0.002
+
+
+def test_quantile_edges():
+    h = Histogram("t_edges", buckets=[1.0, 2.0, 4.0])
+    assert h.quantile(0.5) == 0.0  # empty
+    h.observe_n(0.5, 10)   # bucket [0, 1]
+    h.observe_n(1.5, 10)   # bucket (1, 2]
+    assert h.quantile(0.5) == pytest.approx(1.0)    # rank 10 tops bucket 0
+    assert h.quantile(0.75) == pytest.approx(1.5)   # halfway into bucket 1
+    # overflow bucket reports the highest finite boundary (the
+    # histogram_quantile convention)
+    h2 = Histogram("t_over", buckets=[1.0, 2.0])
+    h2.observe(5.0)
+    assert h2.quantile(0.99) == 2.0
+    # first bucket interpolates from 0
+    h3 = Histogram("t_first", buckets=[1.0, 2.0])
+    h3.observe_n(0.5, 4)
+    assert h3.quantile(0.5) == pytest.approx(0.5)
