@@ -233,6 +233,22 @@ def _pending_pod(args, i):
     )
 
 
+def _pct_ms(samples) -> dict:
+    """Latency percentiles in ms — THE formatter for every per-pod
+    latency report in this file (run/run_overload/run_tiered), so the
+    artifacts cannot drift estimator or rounding between scenarios."""
+    if not samples:
+        return {}
+    p50, p90, p99 = np.percentile(np.asarray(samples), [50, 90, 99])
+    return {
+        "p50": round(float(p50) * 1000, 1),
+        "p90": round(float(p90) * 1000, 1),
+        "p99": round(float(p99) * 1000, 1),
+        "max": round(float(max(samples)) * 1000, 1),
+        "n": len(samples),
+    }
+
+
 def run(args) -> dict:
     import jax
 
@@ -280,9 +296,15 @@ def run(args) -> dict:
         if engine == "speculative"
         else make_sequential_scheduler
     )
+    # chained-state donation (accelerator only): the raw loop consumes
+    # each returned new_cluster and never reuses the input, so the engine
+    # updates requested/nonzero IN PLACE and the per-batch buffers'
+    # HBM recycles into the launch instead of double-buffering
+    donate = jax.default_backend() != "cpu"
     fn = make_engine(
         unsched_taint_key=enc.interner.intern("node.kubernetes.io/unschedulable"),
         zone_key_id=enc.getzone_key,
+        donate_cluster=donate,
     )
 
     # warmup/compile on one batch shape; device-put the snapshot ONCE —
@@ -326,7 +348,10 @@ def run(args) -> dict:
     row_names = {row: name for name, row in enc.node_rows.items()}
     scheduled = 0
     unschedulable = 0
-    state = cluster
+    # under donation the warmup loop CONSUMED the original upload (its
+    # buffers were donated into the first warm launch): re-upload a
+    # pristine snapshot for the timed run, outside the timed window
+    state = jax.device_put(enc.snapshot()) if donate else cluster
     last = 0
     in_flight = None  # (pods, hosts_device, t_formed)
     # per-pod latency samples for BOUND pods only: queue-add -> bind-commit,
@@ -425,18 +450,7 @@ def run(args) -> dict:
 
     pods_per_s = scheduled / dt if dt > 0 else 0.0
 
-    def pct(samples):
-        if not samples:
-            return {}
-        p50, p90, p99 = np.percentile(np.asarray(samples), [50, 90, 99])
-        return {
-            "p50": round(float(p50) * 1000, 1),
-            "p90": round(float(p90) * 1000, 1),
-            "p99": round(float(p99) * 1000, 1),
-            "max": round(float(max(samples)) * 1000, 1),
-        }
-
-    lat = pct(lat_e2e)
+    lat = _pct_ms(lat_e2e)
     # cold start = everything between an empty encoder and ready-to-
     # schedule state: bulk node ingest + spread registration + existing
     # pods (the failover re-sync figure the ISSUE 2 tentpole targets)
@@ -459,7 +473,7 @@ def run(args) -> dict:
         "latency_ms": lat,
         # batch-formation -> bind-commit: what one batch of this size costs
         # a pod in added latency (the batching knob's direct trade)
-        "pipeline_latency_ms": pct(lat_pipe),
+        "pipeline_latency_ms": _pct_ms(lat_pipe),
         "device": str(jax.devices()[0]),
     }
     # ---- live-path stage: the number that actually matters (VERDICT r05
@@ -477,6 +491,23 @@ def run(args) -> dict:
         detail["live_path"] = run_live(args, batched=True, pipeline=True)
     except Exception as e:  # noqa: BLE001 — the raw number still emits
         detail["live_path_error"] = f"{type(e).__name__}: {e}"
+    # ---- latency-tier stage (ISSUE 6): per-tier p50/p99 in the default
+    # artifact — express p99 under a saturating bulk load + the bulk
+    # throughput it costs, ratioed against the live-path single-lane
+    # number just measured.  CPU child only, like --tiered itself in
+    # orchestrate(): it is a control-plane benchmark, and spending the
+    # single budgeted TPU attempt's window on a second full drain (+ an
+    # express-width tunnel compile) risks losing the headline device
+    # number; orchestrate() copies the banked CPU child's tier figures
+    # into a successful TPU artifact's cpu_reference
+    if jax.default_backend() == "cpu":
+        try:
+            detail["latency_tiers"] = run_tiered(
+                args,
+                single_lane_ref=detail.get("live_path", {}).get("pods_per_s"),
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["latency_tiers_error"] = f"{type(e).__name__}: {e}"
     out = {
         "metric": "pods_scheduled_per_sec_5k_nodes",
         "value": round(pods_per_s, 1),
@@ -502,6 +533,13 @@ def run(args) -> dict:
         out["live_path_overlap_efficiency"] = detail["live_path"].get(
             "overlap_efficiency", 0.0
         )
+    if "latency_tiers" in detail:
+        # the tier acceptance pair, tracked at top level: express tail
+        # latency under saturating bulk load + what it cost the bulk lane
+        out["express_p99_ms"] = detail["latency_tiers"]["express_p99_ms"]
+        out["tiered_bulk_tput_ratio"] = detail["latency_tiers"][
+            "bulk_tput_ratio"
+        ]
     return out
 
 
@@ -659,18 +697,18 @@ def run_overload(args) -> dict:
     # warmup: AIMD sweeps the batch width, and each new pow2 pad is a
     # fresh XLA compile — pay ALL of them here, not inside the measured
     # saturation window (otherwise phase 1 under-reports and the storm
-    # "beats" saturation)
+    # "beats" saturation).  The width list is THE shared AIMD pow2 ladder
+    # (codec.schema.aimd_pow2_widths — the same list Scheduler.prewarm
+    # compiles), so bench warmup and runtime pre-warming cannot drift.
+    from kubernetes_tpu.codec.schema import aimd_pow2_widths
+
     seq = 2_000_000
-    w = baseline
-    while True:
+    for w in aimd_pow2_widths(baseline, args.batch):
         sched._cur_batch = w
         for _ in range(w):
             queue.add(_pending_pod(args, seq))
             seq += 1
         _drain(600)
-        if w >= args.batch:
-            break
-        w = min(w * 2, args.batch)
     sched._cur_batch = baseline
     n_sat = min(args.pods, capacity)  # a deeper pour would shed in phase 1
     sat_pods = [_pending_pod(args, 1_000_000 + i) for i in range(n_sat)]
@@ -730,10 +768,7 @@ def run_overload(args) -> dict:
     shed = queue.shed_total - shed0
     in_storm = [lat for t, lat in bind_log if t <= t_storm1]
     goodput = len(in_storm) / (t_storm1 - t_storm0) if count else 0.0
-    p99 = (
-        sorted(in_storm)[max(0, int(len(in_storm) * 0.99) - 1)]
-        if in_storm else 0.0
-    )
+    p99_ms = _pct_ms(in_storm).get("p99", 0.0)
     recovered = (not queue.has_schedulable()
                  and sched._cur_batch == baseline)
     goodput_ratio = goodput / tput_sat if tput_sat > 0 else 0.0
@@ -750,11 +785,189 @@ def run_overload(args) -> dict:
             "goodput_ratio": round(goodput_ratio, 3),
             "shed_total": shed,
             "shed_rate_per_s": round(shed / duration, 1) if duration else 0.0,
-            "p99_storm_latency_ms": round(p99 * 1000, 1),
+            "p99_storm_latency_ms": p99_ms,
             "queue_capacity": capacity,
             "batch_baseline": baseline,
             "recovered": recovered,
         },
+    }
+
+
+def run_tiered(args, single_lane_ref: "float | None" = None) -> dict:
+    """Latency-tier scenario (ISSUE 6): a SATURATING bulk backlog drains
+    through the tiered scheduler while express pods (priority above the
+    threshold) arrive paced throughout the window.  Reports per-tier
+    p50/p99 (arrival -> bind-commit), bulk throughput as a ratio of the
+    single-lane saturated number, and a COMPILE-INCLUSIVE cold start
+    (encoder build + Scheduler.prewarm) — the figure the persistent
+    compile cache collapses on a second run (CI asserts the drop).
+
+    `single_lane_ref`: saturated single-lane pods/s to ratio against;
+    measured fresh via run_live when not supplied (run() passes its
+    live_path figure so the default bench pays the stage once)."""
+    import threading
+
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+    from kubernetes_tpu.runtime.queue import PriorityQueue
+    from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+    if single_lane_ref is None:
+        single_lane_ref = run_live(args, batched=True, pipeline=True)[
+            "pods_per_s"
+        ]
+
+    express_width = 64
+    # cold start, compile-inclusive: everything between an empty encoder
+    # and ready-to-serve-at-every-width (bulk ingest + spread registration
+    # + the AIMD-ladder/express prewarm).  With a warm persistent compile
+    # cache the prewarm half collapses to disk reads.
+    t_cold0 = time.monotonic()
+    enc = _build_encoder(args)
+    cache = SchedulerCache(enc)
+    queue = PriorityQueue()
+    arrival: dict = {}
+    bind_log: list = []  # (bind time, latency, tier)
+
+    def binder(pod, node) -> bool:
+        rec = arrival.pop(pod.name, None)
+        if rec is not None:
+            t, tier = rec
+            now = time.monotonic()
+            bind_log.append((now, now - t, tier))
+        return True
+
+    baseline = max(args.batch // 16, 16)
+    deadline = args.tier_deadline
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=binder,
+        config=SchedulerConfig(
+            batch_size=args.batch, batch_window_s=0.0, engine=args.engine,
+            disable_preemption=True, batched_commit=True,
+            pipeline_commit=True,
+            # AIMD with a cycle deadline: an express pod's wait is bounded
+            # by the bulk cycle IN FLIGHT when it arrives, so the deadline
+            # is the p99 lever (width shrinks until bulk cycles fit; the
+            # bulk_tput_ratio reports what that trade costs).  Set it
+            # BELOW the express SLO but ABOVE the platform's fixed
+            # per-cycle host cost, or AIMD pins to the floor width and
+            # throughput collapses without helping latency.
+            adaptive_batch=True, batch_size_min=baseline,
+            cycle_deadline_s=deadline,
+            express_lane=True, express_batch_size=express_width,
+            express_priority_threshold=1000,
+        ),
+    )
+    t_warm0 = time.monotonic()
+    # warm with WORKLOAD-shaped pods: executables are keyed on every
+    # PodBatch leaf shape, so minimal dummy pods would pre-grow the wrong
+    # pad dims and the real batches would still compile mid-storm.  On the
+    # CPU backend warm the full AIMD ladder (compiles are ~1s); through a
+    # tunnel-attached TPU each compile is MINUTES, so warm only the
+    # express width — the bulk cap is already compiled by the live-path
+    # stage (same engine knobs + cluster shape = same executable), and a
+    # deadline-driven shrink to a new width shows up honestly as one
+    # mid-run stall in the tail
+    import jax as _jax
+
+    widths = None if _jax.default_backend() == "cpu" else [express_width]
+    prewarmed = sched.prewarm(
+        widths=widths,
+        pod_factory=lambda i: _pending_pod(args, 5_000_000 + i),
+    )
+    prewarm_s = time.monotonic() - t_warm0
+    cold_start = time.monotonic() - t_cold0
+    # start the AIMD width at the cap (every width is prewarmed): the
+    # scenario measures the steady-state express/bulk trade, not the
+    # additive ramp — the deadline still shrinks the width if bulk
+    # cycles overrun the latency budget
+    sched._cur_batch = args.batch
+
+    n_bulk = args.pods
+    bulk_pods = [_pending_pod(args, 1_000_000 + i) for i in range(n_bulk)]
+    # express trickle: enough samples for a stable p99, small enough not
+    # to BE the load (the tier is for the latency-sensitive few)
+    n_exp = max(64, min(1024, n_bulk // 20))
+    exp_pods = []
+    for i in range(n_exp):
+        p = _pending_pod(args, 2_000_000 + i)
+        p.spec.priority = 2000  # above the threshold -> express
+        exp_pods.append(p)
+
+    stop = threading.Event()
+
+    def _serve():
+        while not stop.is_set():
+            if (
+                sched.run_once(timeout=0.005) == 0
+                and not sched.pipeline_pending
+                and not queue.has_schedulable()
+            ):
+                time.sleep(0.001)
+        sched.flush_pipeline()
+
+    server = threading.Thread(target=_serve, daemon=True)
+    server.start()
+    t0 = time.monotonic()
+    for p in bulk_pods:
+        arrival[p.name] = (time.monotonic(), "bulk")
+        queue.add(p)
+    # pace express arrivals across ~80% of the expected bulk drain so
+    # (virtually) every sample measures the under-saturating-load case
+    est_drain = max(n_bulk / max(single_lane_ref, 1.0), 0.5)
+    rate = n_exp / (0.8 * est_drain)
+    for i, p in enumerate(exp_pods):
+        lag = t0 + (i + 1) / rate - time.monotonic()
+        if lag > 0:
+            time.sleep(lag)
+        arrival[p.name] = (time.monotonic(), "express")
+        queue.add(p)
+    drain_by = time.monotonic() + 600.0
+    while queue.has_schedulable() and time.monotonic() < drain_by:
+        time.sleep(0.01)
+    time.sleep(0.05)
+    stop.set()
+    server.join(timeout=10.0)
+
+    exp_lat = [lat for _, lat, tier in bind_log if tier == "express"]
+    bulk_binds = [(t, lat) for t, lat, tier in bind_log if tier == "bulk"]
+    bulk_lat = [lat for _, lat in bulk_binds]
+    # bulk throughput over its own drain window (first add -> last bulk
+    # bind); the ratio against the single-lane number is the acceptance
+    bulk_dt = (max(t for t, _ in bulk_binds) - t0) if bulk_binds else 0.0
+    bulk_tput = len(bulk_binds) / bulk_dt if bulk_dt > 0 else 0.0
+    ratio = bulk_tput / single_lane_ref if single_lane_ref > 0 else 0.0
+    exp_pct = _pct_ms(exp_lat)
+    return {
+        "tiers": {
+            "express": exp_pct,
+            "bulk": _pct_ms(bulk_lat),
+        },
+        "express_p99_ms": exp_pct.get("p99", 0.0),
+        "bulk_pods_per_s": round(bulk_tput, 1),
+        "single_lane_pods_per_s": round(single_lane_ref, 1),
+        "bulk_tput_ratio": round(ratio, 3),
+        "cold_start_seconds": round(cold_start, 3),
+        "prewarm_seconds": round(prewarm_s, 3),
+        "prewarm_widths": {
+            str(w): round(s, 3) for w, s in sorted(prewarmed.items())
+        },
+        "express_width": express_width,
+        "express_pods": len(exp_lat),
+        "bulk_pods": len(bulk_binds),
+        "cycle_deadline_s": deadline,
+    }
+
+
+def run_tiered_metric(args) -> dict:
+    """Standalone --tiered entry: one JSON line in the bench contract."""
+    detail = run_tiered(args)
+    return {
+        "metric": "express_lane_p99_ms",
+        "value": detail["express_p99_ms"],
+        "unit": "ms",
+        "cold_start_seconds": detail["cold_start_seconds"],
+        "bulk_tput_ratio": detail["bulk_tput_ratio"],
+        "detail": detail,
     }
 
 
@@ -869,6 +1082,8 @@ def run_child(args) -> None:
                 result = run_overload(args)
             elif args.density:
                 result = run_density(args)
+            elif args.tiered:
+                result = run_tiered_metric(args)
             else:
                 result = run(args)
         except Exception as e:  # compile/runtime failure mid-run
@@ -942,6 +1157,9 @@ def _child_cmd(args, platform: str | None) -> list:
         cmd += ["--overload",
                 "--overload-factor", str(args.overload_factor),
                 "--overload-duration", str(args.overload_duration)]
+    if args.tiered:
+        cmd += ["--tiered"]
+    cmd += ["--tier-deadline", str(args.tier_deadline)]
     if platform:
         cmd += ["--platform", platform]
     return cmd
@@ -998,9 +1216,9 @@ def orchestrate(args) -> None:
     # ---- phase 2: exactly ONE TPU attempt inside whatever budget remains.
     remaining = deadline - time.time()
     tpu_min = args.tpu_min_budget
-    if args.platform == "cpu" or args.density or args.overload:
-        # explicit cpu-only run, or density/overload mode (control-plane
-        # benchmarks — the host runtime dominates, not the device)
+    if args.platform == "cpu" or args.density or args.overload or args.tiered:
+        # explicit cpu-only run, or density/overload/tiered mode (control-
+        # plane benchmarks — the host runtime dominates, not the device)
         remaining = 0
     if remaining < tpu_min:
         det = banked["result"].setdefault("detail", {})
@@ -1042,6 +1260,12 @@ def orchestrate(args) -> None:
         det["cpu_reference"] = {
             "value": cpu_val,
             "latency_ms": banked["result"].get("detail", {}).get("latency_ms"),
+            # the tier stage runs in the CPU child only (budget
+            # protection); its per-tier figures still ride the emitted
+            # TPU artifact here
+            "latency_tiers": banked["result"].get("detail", {}).get(
+                "latency_tiers"
+            ),
         }
         _emit(tpu_res)
         return
@@ -1101,6 +1325,18 @@ def main():
     ap.add_argument("--overload-duration", type=float, default=10.0,
                     help="sustained storm window seconds (pod count capped "
                     "at 200k)")
+    ap.add_argument("--tiered", action="store_true",
+                    help="latency-tier scenario: saturating bulk backlog + "
+                    "paced express arrivals through the two-lane "
+                    "scheduler; reports per-tier p50/p99, bulk throughput "
+                    "ratio vs single-lane, and a compile-inclusive "
+                    "cold_start_seconds (the compile-cache figure)")
+    ap.add_argument("--tier-deadline", type=float, default=0.08,
+                    help="tiered scenario's bulk cycle_deadline_s (the "
+                    "express-p99 lever: an express pod waits out at most "
+                    "the bulk cycle in flight); must exceed the "
+                    "platform's fixed per-cycle host cost or AIMD pins "
+                    "to the floor width")
     ap.add_argument("--lock-timeout", type=float, default=300.0, help="seconds")
     ap.add_argument("--init-timeout", type=float, default=600.0,
                     help="seconds before a hung backend init fails the single "

@@ -70,6 +70,7 @@ def make_pod(
     limits: Optional[Dict[str, str]] = None,    # container limits dict
     init_requests: Sequence[Dict[str, str]] = (),  # one init container each
     extra_containers: Sequence[Dict[str, str]] = (),  # request dict each
+    annotations: Optional[Dict[str, str]] = None,
 ) -> Pod:
     req = dict(requests or {})
     if cpu is not None:
@@ -100,6 +101,8 @@ def make_pod(
         for i, r in enumerate(init_requests)
     ]
     meta: dict = {"name": name, "namespace": namespace, "labels": labels or {}}
+    if annotations:
+        meta["annotations"] = dict(annotations)
     if owner:
         meta["ownerReferences"] = [
             {"kind": owner[0], "uid": owner[1], "controller": True}

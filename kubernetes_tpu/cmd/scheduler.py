@@ -56,6 +56,30 @@ def build_parser() -> argparse.ArgumentParser:
                    "this many seconds (config traceThresholdSeconds; "
                    "default 0.1, <=0 disables the slow-cycle log; the "
                    "flight recorder at /debug/traces stays always-on)")
+    p.add_argument("--express-lane", action="store_true", default=None,
+                   help="enable the latency-tiered express lane (config "
+                   "expressLane): pods opted in via the "
+                   "kubernetes-tpu.io/latency-tier=express annotation or "
+                   "at/above --express-priority-threshold schedule through "
+                   "a small pre-compiled batch interleaved with the bulk "
+                   "AIMD lane")
+    p.add_argument("--express-batch-size", type=int, default=None,
+                   help="express-lane encode width / per-cycle pop cap "
+                   "(config expressBatchSize; default 64)")
+    p.add_argument("--express-priority-threshold", type=int, default=None,
+                   help="pods with spec.priority >= this classify express "
+                   "without the annotation (config "
+                   "expressPriorityThreshold; default: annotation only)")
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="persistent XLA compile cache directory (config "
+                   "compileCacheDir; default /tmp/ktpu_jax_cache or "
+                   "$KTPU_COMPILE_CACHE_DIR; 'off' disables) — restarts "
+                   "pay zero recompiles")
+    p.add_argument("--prewarm", action="store_true", default=None,
+                   help="pre-pay engine compiles for every AIMD pow2 "
+                   "width (+ the express width) at startup (config "
+                   "prewarmWidths) instead of stalling the first cycle "
+                   "at each new width mid-traffic")
     p.add_argument("--simulate-nodes", type=int, default=0,
                    help="register N hollow nodes")
     p.add_argument("--simulate-pods", type=int, default=0,
@@ -87,6 +111,24 @@ def main(argv=None) -> int:
         cc.batch_size = args.batch_size
     if args.trace_threshold_seconds is not None:
         cc.trace_threshold_s = args.trace_threshold_seconds
+    if args.express_lane is not None:
+        cc.express_lane = args.express_lane
+    if args.express_batch_size is not None:
+        cc.express_batch_size = args.express_batch_size
+    if args.express_priority_threshold is not None:
+        cc.express_priority_threshold = args.express_priority_threshold
+        cc.express_lane = True  # a threshold implies the lane
+    if args.compile_cache_dir is not None:
+        cc.compile_cache_dir = args.compile_cache_dir
+    if args.prewarm is not None:
+        cc.prewarm_widths = args.prewarm
+
+    # persistent compile cache BEFORE any jit compile (engine build,
+    # prewarm, first cycle) so every executable of this process is served
+    # from / saved to disk
+    from kubernetes_tpu.utils.compilecache import enable_compile_cache
+
+    enable_compile_cache(cc.compile_cache_dir)
 
     if args.kubeconfig:
         with open(args.kubeconfig) as f:
@@ -150,6 +192,19 @@ def main(argv=None) -> int:
     if args.simulate_pods:
         for p in _sim_pods(args.simulate_pods):
             cluster.add_pod(p)
+
+    if cc.prewarm_widths:
+        # after node registration (compiles are keyed on the snapshot
+        # shape), before serving: with a warm compile cache this is
+        # seconds of disk reads instead of minutes of XLA
+        t_warm = time.monotonic()
+        warmed = sched.prewarm()
+        print(
+            f"prewarmed {len(warmed)} batch widths in "
+            f"{time.monotonic() - t_warm:.1f}s: "
+            + ", ".join(f"{w}:{s:.2f}s" for w, s in sorted(warmed.items())),
+            file=sys.stderr,
+        )
 
     try:
         if args.one_shot:

@@ -19,6 +19,7 @@ existing pod (the same asymptotic trick as the reference's metadata maps).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from collections import Counter
 from dataclasses import dataclass, field
@@ -184,6 +185,13 @@ class SnapshotEncoder:
         self.dims = dims or PadDims()
         self.interner = Interner()
         self.generation = 0
+        # transient pod-batch pad-width override (the express lane's small
+        # pre-compiled shape): when set, encode_pods and the batch helpers
+        # pad to pow2(len(pods), override) WITHOUT growing the sticky
+        # dims.B floor, so a 64-wide express batch keeps its own compiled
+        # program next to the bulk lane's full-width one.  Set through
+        # batch_width() only (restores on exit).
+        self._batch_width: Optional[int] = None
         # HardPodAffinitySymmetricWeight (ref apis/config/types.go, default 1)
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
 
@@ -2320,12 +2328,37 @@ class SnapshotEncoder:
 
     # ------------------------------------------------------------ pod batch
 
+    def batch_pad(self, n: int) -> int:
+        """Effective pod-batch pad width for an n-pod batch: the transient
+        batch_width() override when one is active (never growing dims.B),
+        else the sticky pow2 floor dims.B.  EVERY batch-shaped tensor cut
+        for one encode must use this (encode_pods, _vol_overlap, and the
+        models/batched.py port/affinity helpers) or shapes diverge between
+        the batch leaves and the engine retraces per cycle."""
+        if self._batch_width is not None:
+            return _pow2(max(n, 1), self._batch_width)
+        return _pow2(max(n, 1), max(self.dims.B, 1))
+
+    @contextlib.contextmanager
+    def batch_width(self, width: Optional[int]):
+        """Context manager pinning the pod-batch pad width for the encode
+        calls inside it (width=None is a no-op passthrough).  The express
+        lane wraps its encode in batch_width(express_batch_size) so its
+        small batches compile once at that shape instead of re-padding to
+        the bulk lane's sticky dims.B."""
+        prev = self._batch_width
+        self._batch_width = width
+        try:
+            yield self
+        finally:
+            self._batch_width = prev
+
     def encode_pods(self, pods: Sequence[Pod]) -> PodBatch:
         """Encode pending pods into a PodBatch, precomputing the
         inter-pod-affinity pair tensors against current cluster state."""
         d = self.dims
-        B = _pow2(len(pods), max(d.B, 1))
-        if B > d.B:
+        B = self.batch_pad(len(pods))
+        if self._batch_width is None and B > d.B:
             self.dims = d = dataclasses.replace(d, B=B)
         # grow per-pod dims to fit
         need = dict(Q=1, TT=1, NS=1, S=1, E=1, V=1, PS=1, PT=1, AT=1, GP=1, C=1,
@@ -2691,7 +2724,7 @@ class SnapshotEncoder:
         subtraction: they add no new attachment); [B, VT, 1] lean
         placeholder when no pod carries volumes.  `cnt_ids_by_b` reuses the
         id sets the encode loop already computed."""
-        B = _pow2(max(len(pods), 1, self.dims.B))
+        B = self.batch_pad(len(pods))
         if not any(getattr(p.spec, "volumes", None) for p in pods):
             return np.zeros((B, self.dims.VT, 1), np.float32)
         out = np.zeros((B, self.dims.VT, self._cap_n), np.float32)

@@ -126,6 +126,28 @@ def _pow2(n: int, floor: int = 1) -> int:
     return 1 << (n - 1).bit_length()
 
 
+def aimd_pow2_widths(batch_size_min: int, batch_size: int) -> "list[int]":
+    """The distinct pow2 ENCODE widths the AIMD batch sizer can visit while
+    ramping from batch_size_min to batch_size: the additive-increase steps
+    land on arbitrary integers, but encode_pods pads every batch to a pow2
+    bucket, so these are exactly the XLA compile shapes the runtime pays.
+
+    THE shared source for compile pre-warming — the scheduler's startup
+    prewarm and bench.py's warmup sweep both import this, so the two can
+    never drift (a width missing here is a mid-storm compile stall)."""
+    lo = _pow2(max(1, batch_size_min))
+    hi = _pow2(max(1, batch_size))
+    # a floor above the cap (e.g. batch_size 8 with the default min 16)
+    # still dispatches at the cap width — never return an empty ladder
+    lo = min(lo, hi)
+    out = []
+    w = lo
+    while w <= hi:
+        out.append(w)
+        w *= 2
+    return out
+
+
 @dataclass(frozen=True)
 class PadDims:
     """Static pad widths.  Every field is a maximum-over-the-snapshot, rounded

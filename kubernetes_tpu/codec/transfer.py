@@ -343,11 +343,29 @@ def unpack_tree(bufs, meta):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-@jax.jit
+def _scatter_impl(dev, rows, vals):
+    return dev.at[rows].set(vals)
+
+
+_scatter_copy = jax.jit(_scatter_impl)
+# donated variant: the resident buffer (arg 0) is consumed and its memory
+# reused for the output — the per-cycle dirty-row refresh updates the
+# snapshot IN PLACE instead of allocating + copying a whole tensor per
+# scattered field (requested/nonzero move every cycle; at 50k nodes that
+# is MBs per field per cycle of pure copy).  Sound because the sole
+# caller (DeviceSnapshotCache.update) immediately replaces its _dev entry
+# with the result, and PJRT sequences the donation behind any in-flight
+# reader of the old buffer.
+_scatter_donate = jax.jit(_scatter_impl, donate_argnums=(0,))
+
+
 def _scatter_rows(dev, rows, vals):
     """Row scatter into a resident device buffer (duplicate indices carry
-    identical values, so pad-by-repeat is safe)."""
-    return dev.at[rows].set(vals)
+    identical values, so pad-by-repeat is safe).  XLA:CPU has no buffer
+    donation — the copying variant keeps warning noise out of cpu runs."""
+    if jax.default_backend() == "cpu":
+        return _scatter_copy(dev, rows, vals)
+    return _scatter_donate(dev, rows, vals)
 
 
 # fields whose leading axis is NOT the node-row axis, or which the encoder

@@ -84,6 +84,17 @@ class KubeSchedulerConfiguration:
     # 100ms convention, now a knob); <=0 disables the slow-cycle log
     # (flight-recorder span capture stays always-on)
     trace_threshold_s: float = 0.1
+    # latency tiers (runtime/scheduler.py + runtime/queue.py): a small
+    # pre-compiled express lane interleaved with the bulk AIMD lane for
+    # annotation-opted-in / high-priority pods
+    express_lane: bool = False
+    express_batch_size: int = 64
+    express_priority_threshold: Optional[int] = None
+    # raw-speed knobs: persistent XLA compile cache directory
+    # (utils/compilecache.py; None = process default, "off" disables) and
+    # startup pre-warming of every AIMD pow2 width + the express width
+    compile_cache_dir: Optional[str] = None
+    prewarm_widths: bool = False
 
     def build_profile(self, interner=None) -> SchedulingProfile:
         """CreateFromConfig / CreateFromProvider (scheduler.go:162-192)."""
@@ -142,6 +153,14 @@ class KubeSchedulerConfiguration:
             batch_size_min=int(d.get("batchSizeMin", 16)),
             cycle_deadline_s=float(d.get("cycleDeadlineSeconds", 0.0)),
             trace_threshold_s=float(d.get("traceThresholdSeconds", 0.1)),
+            express_lane=bool(d.get("expressLane", False)),
+            express_batch_size=int(d.get("expressBatchSize", 64)),
+            express_priority_threshold=(
+                int(d["expressPriorityThreshold"])
+                if d.get("expressPriorityThreshold") is not None else None
+            ),
+            compile_cache_dir=d.get("compileCacheDir"),
+            prewarm_widths=bool(d.get("prewarmWidths", False)),
         )
 
     @staticmethod

@@ -204,7 +204,7 @@ def encode_batch_affinity(encoder, pods: Sequence) -> LeanBatchAffinity:
     from kubernetes_tpu.api import labels as klabels
 
     d = encoder.dims
-    B = _pow2(max(len(pods), 1, d.B))
+    B = encoder.batch_pad(len(pods))
     nb = len(pods)
 
     # Controller-stamped batches repeat a handful of (namespace, labels)
@@ -424,7 +424,7 @@ def encode_batch_ports(encoder, pods: Sequence) -> BatchPortState:
                 vocab[(pp, ip)] = len(plist)
                 plist.append((pp, ip))
     PV = _pow2(max(len(plist), 1))
-    B = _pow2(max(len(pods), 1, encoder.dims.B))
+    B = encoder.batch_pad(len(pods))
     pod_ports = np.zeros((B, PV), bool)
     for b, pod in enumerate(pods):
         for pp, ip in encoder._pod_ports(pod):
@@ -471,14 +471,28 @@ def make_sequential_scheduler(
     zone_key_id: int = 5,
     score_cfg: Optional[ScoreConfig] = None,
     percentage_of_nodes_to_score: int = 100,
+    donate_cluster: bool = False,
 ):
     """Build (or fetch the memoized) jitted sequential-commit scheduler.
 
     Returns fn(cluster, pods, ports: BatchPortState, last_index0) ->
       (hosts i32[B] (-1 = unschedulable), new_cluster) where new_cluster has
-      the committed requested/nonzero columns."""
+      the committed requested/nonzero columns.
+
+    Buffer donation (accelerator backends only; XLA:CPU has no donation):
+    the PER-BATCH argument buffers — pods/ports/nominated/extra mask+score/
+    affinity state, freshly device_put by schedule_entry every call — are
+    donated, so XLA reuses their HBM for scan carries and outputs instead
+    of holding both live across the launch.  `donate_cluster=True`
+    additionally donates the cluster argument itself: the returned
+    new_cluster then updates requested/nonzero IN PLACE (the static leaves
+    alias straight through), which is correct ONLY for callers that chain
+    the returned state and never reuse the input (bench.py's raw loop) —
+    the live Scheduler keeps its snapshot resident in DeviceSnapshotCache
+    across cycles and must NOT donate it."""
     if score_cfg is None:
         score_cfg = ScoreConfig()
+    donate_batch = jax.default_backend() != "cpu"
     key = (
         cfg,
         tuple(np.asarray(weights, np.float32)) if weights is not None else None,
@@ -486,6 +500,7 @@ def make_sequential_scheduler(
         zone_key_id,
         score_cfg,
         percentage_of_nodes_to_score,
+        donate_cluster and donate_batch,
     )
     hit = _SEQ_CACHE.get(key)
     if hit is not None:
@@ -502,11 +517,10 @@ def make_sequential_scheduler(
     rtc_xs = np.asarray([p[0] for p in score_cfg.rtc_shape], np.float32)
     rtc_ys = np.asarray([p[1] for p in score_cfg.rtc_shape], np.float32)
 
-    @jax.jit
-    def schedule(cluster: ClusterTensors, pods: PodBatch, ports: BatchPortState,
-                 last_index0: jnp.ndarray, nominated: Optional[NominatedState] = None,
-                 extra_mask=None, extra_score=None,
-                 aff_state: Optional[BatchAffinityState] = None):
+    def schedule_impl(cluster: ClusterTensors, pods: PodBatch, ports: BatchPortState,
+                      last_index0: jnp.ndarray, nominated: Optional[NominatedState] = None,
+                      extra_mask=None, extra_score=None,
+                      aff_state: Optional[BatchAffinityState] = None):
         """extra_mask bool[B, N] / extra_score f32[B, N]: the framework's
         tensor-level Filter/Score plugin outputs, folded into the static
         pass (one launch total — the TPU-shaped plugin seam).
@@ -819,6 +833,19 @@ def make_sequential_scheduler(
         )
         return hosts, new_cluster
 
+    # donation (see the maker docstring): batch buffers always on
+    # accelerator backends, the cluster only for chained-state callers.
+    # XLA:CPU implements no donation — plain jit there keeps warning
+    # noise out of the tier-1 suite.
+    donate: Tuple[int, ...] = ()
+    if donate_batch:
+        # argnums: 1=pods 2=ports 4=nominated 5=extra_mask 6=extra_score
+        # 7=aff_state (3=last_index0 is a scalar, nothing to donate)
+        donate = (1, 2, 4, 5, 6, 7)
+        if donate_cluster:
+            donate = (0,) + donate
+    schedule = jax.jit(schedule_impl, donate_argnums=donate)
+
     def schedule_entry(cluster, pods, ports, last_index0, nominated=None,
                        extra_mask=None, extra_score=None, aff_state=None):
         """Host entry: on accelerator backends, move the batch pytrees to
@@ -826,7 +853,9 @@ def make_sequential_scheduler(
         cross a remote-attached tunnel on a slow synchronous path (~55MB/s
         measured vs ~1.4GB/s async DMA), which matters for the [B, ., B]
         affinity cross-match tensors.  device_put is a no-op passthrough
-        for leaves already on the device."""
+        for leaves already on the device.  The freshly-transferred batch
+        buffers are DONATED into the launch (dead after it by
+        construction: every call re-transfers)."""
         if jax.default_backend() != "cpu":
             pods, ports, nominated, extra_mask, extra_score, aff_state = (
                 jax.device_put(
@@ -837,9 +866,10 @@ def make_sequential_scheduler(
         return schedule(cluster, pods, ports, last_index0, nominated,
                         extra_mask, extra_score, aff_state)
 
-    # the raw traced fn for callers composing INSIDE jit (the speculative
-    # engine's in-program lax.cond redo)
-    schedule_entry.jitted = schedule
+    # the raw traceable fn for callers composing INSIDE jit (the
+    # speculative engine's in-program lax.cond redo): the UNJITTED impl —
+    # it inlines into the outer trace, where donation has no meaning
+    schedule_entry.jitted = schedule_impl
     # engine identity tag: consumers whose correctness depends on the
     # strictly sequential one-at-a-time commit order (models/gang.py's
     # cross-gang required-affinity drop guard) assert on this

@@ -132,6 +132,7 @@ def make_speculative_scheduler(
     score_cfg=None,
     percentage_of_nodes_to_score: int = 100,
     hybrid: bool = True,
+    donate_cluster: bool = False,
 ):
     """Same call contract as make_sequential_scheduler:
     fn(cluster, pods, ports, last_index0, nominated=None, extra_mask=None,
@@ -144,7 +145,16 @@ def make_speculative_scheduler(
     instance with the same knobs shares ONE jitted program, so e.g. the
     bench's raw-engine loop and its live-path Scheduler compile once.
     FORCE_PACKED_PATH is read per call, so the memo never staleness-locks
-    the CPU test hook."""
+    the CPU test hook.
+
+    Buffer donation (accelerator device path only): the PACKED batch
+    buffers — device_put fresh every call, dead after the launch — are
+    always donated, so their HBM recycles into the while_loop carries and
+    outputs.  donate_cluster=True additionally donates the cluster (the
+    in-place chained-state pattern): correct only for callers that
+    consume the returned new_cluster and never touch the input again
+    (bench.py's raw loop); the live Scheduler's resident snapshot cache
+    must NOT donate."""
     key = (
         cfg,
         tuple(np.asarray(weights, np.float32)) if weights is not None else None,
@@ -153,6 +163,7 @@ def make_speculative_scheduler(
         score_cfg,
         percentage_of_nodes_to_score,
         hybrid,
+        donate_cluster,
     )
     hit = _SPEC_CACHE.get(key)
     if hit is not None:
@@ -630,12 +641,28 @@ def make_speculative_scheduler(
 
     @lru_cache(maxsize=64)
     def _packed(meta):
-        @jax.jit
         def run(cluster, bufs, last_index0):
             tree = unpack_tree(bufs, meta)
-            return _impl(cluster, tree, last_index0)
+            hosts, req, nz, rounds, inv = _impl(cluster, tree, last_index0)
+            # new_cluster is assembled INSIDE the jit so that under
+            # donation the untouched static leaves alias input->output
+            # (identity) and req/nz land in the donated buffers — the
+            # in-place chained-state update
+            new_cluster = dataclasses.replace(
+                cluster, requested=req, nonzero_req=nz
+            )
+            return hosts, new_cluster, rounds, inv
 
-        return run
+        # the packed batch buffers (argnum 1) are dead after the launch by
+        # construction (schedule() re-packs + re-uploads every call);
+        # cluster donation is the maker's opt-in for chained-state
+        # callers.  XLA:CPU implements no donation (FORCE_PACKED_PATH
+        # tests run this path on cpu) — plain jit there avoids per-call
+        # donation warnings.
+        donate: tuple = ()
+        if jax.default_backend() != "cpu":
+            donate = (0, 1) if donate_cluster else (1,)
+        return jax.jit(run, donate_argnums=donate)
 
     # ---- CPU path: host-driven rounds.  XLA:CPU executes while_loop bodies
     # without intra-op thread parallelism, so the SAME round as a
@@ -724,19 +751,16 @@ def make_speculative_scheduler(
                 cluster, bufs, meta, last_index0
             )
         else:
-            hosts, req, nz, rounds, inv = _packed(meta)(
+            hosts, new_cluster, rounds, inv = _packed(meta)(
                 cluster, bufs, np.int32(last_index0)
             )
             # the exactness redo already ran ON DEVICE behind lax.cond
-            # (_impl), so nothing here syncs: hosts/req/nz are final and
-            # the pipeline stays fully async.  last_redo is the device
+            # (_impl), so nothing here syncs: hosts/new_cluster are final
+            # and the pipeline stays fully async.  last_redo is the device
             # sentinel scalar — fetching it (bool()/int()) blocks on the
             # batch, so only observability/tests should touch it.
             schedule.last_rounds = rounds
             schedule.last_redo = inv if hybrid else False
-            new_cluster = dataclasses.replace(
-                cluster, requested=req, nonzero_req=nz
-            )
             return hosts, new_cluster
         schedule.last_rounds = rounds  # observability: repair rounds used
         schedule.last_redo = False

@@ -33,6 +33,35 @@ UNSCHEDULABLE_TIME_LIMIT = 60.0  # flushUnschedulableQLeftover interval
 SHED_EVICTED = "evicted"   # a parked pod dropped for a higher-priority arrival
 SHED_ARRIVAL = "arrival"   # the incoming pod itself rejected at capacity
 
+# latency tiers (ISSUE 6): classified once at queue ADMISSION (_push_active),
+# so requeues/backoff re-route a pod to its lane without re-deciding policy
+# anywhere else.  The express lane is a small pre-compiled batch shape the
+# scheduler interleaves with the bulk AIMD lane.  The canonical tier label
+# values live with the metric family that carries them (utils/metrics).
+TIER_BULK = m.TIER_BULK
+TIER_EXPRESS = m.TIER_EXPRESS
+# annotation opt-in/out: "express" forces the express lane, "bulk" forces
+# the bulk lane even above the priority threshold
+LATENCY_TIER_ANNOTATION = "kubernetes-tpu.io/latency-tier"
+
+
+def classify_tier(pod: Pod, priority_threshold: Optional[int] = None) -> str:
+    """Admission-time latency-tier classification: the pod's explicit
+    annotation wins in both directions; otherwise the priority-class
+    threshold (spec.priority >= threshold -> express; None disables the
+    priority route); default bulk."""
+    ann = pod.metadata.annotations.get(LATENCY_TIER_ANNOTATION, "")
+    if ann == TIER_EXPRESS:
+        return TIER_EXPRESS
+    if ann == TIER_BULK:
+        return TIER_BULK
+    if (
+        priority_threshold is not None
+        and pod.spec.priority >= priority_threshold
+    ):
+        return TIER_EXPRESS
+    return TIER_BULK
+
 
 class PodBackoff:
     """ref internal/queue/pod_backoff.go PodBackoffMap."""
@@ -97,7 +126,8 @@ class PriorityQueue:
 
     def __init__(self, backoff: Optional[PodBackoff] = None, less=None,
                  capacity: Optional[int] = None,
-                 on_shed: Optional[Callable[[Pod, str], None]] = None):
+                 on_shed: Optional[Callable[[Pod, str], None]] = None,
+                 tier_of: Optional[Callable[[Pod], str]] = None):
         # overload protection: bound the TOTAL queue population
         # (active + backoff + unschedulable).  None = unbounded (the
         # historical behavior).  At capacity, a NEW arrival sheds the
@@ -110,6 +140,12 @@ class PriorityQueue:
         # holds without them.
         self.capacity = capacity
         self.on_shed = on_shed
+        # latency-tier classifier (classify_tier partial, typically wired
+        # by a Scheduler with config.express_lane): pods it maps to
+        # TIER_EXPRESS enter the express heap and surface ONLY through
+        # pop_express_batch — pop()/pop_batch() keep serving the bulk
+        # lane.  None = single-lane (every pod bulk, the legacy behavior).
+        self.tier_of = tier_of
         self.shed_total = 0
         # lower bound on the priority of any TRACKED pod (monotone under
         # admits, reset when the queue is observed empty): lets the
@@ -122,6 +158,10 @@ class PriorityQueue:
         self._lock = threading.Condition()
         self._counter = itertools.count()
         self._active: List[list] = []          # [-prio, seq, pod, valid]
+        # express-lane heap: same entry layout and ordering as _active;
+        # entries of BOTH heaps share _active_entry, so delete/shedding/
+        # depth accounting see one active population
+        self._express: List[list] = []
         self._active_entry: Dict[Tuple[str, str], list] = {}
         self._backoffq: List[list] = []        # [expiry, seq, pod, valid]
         self._backoff_entry: Dict[Tuple[str, str], list] = {}
@@ -157,7 +197,10 @@ class PriorityQueue:
         else:
             sort_key = -pod.spec.priority
         entry = [sort_key, next(self._counter), pod, True]
-        heapq.heappush(self._active, entry)
+        heap = self._active
+        if self.tier_of is not None and self.tier_of(pod) == TIER_EXPRESS:
+            heap = self._express
+        heapq.heappush(heap, entry)
         self._active_entry[key] = entry
 
     def _push_backoff(self, pod: Pod, expiry: float) -> None:
@@ -432,21 +475,45 @@ class PriorityQueue:
                 del self._unschedulable[key]
                 self._push_backoff(pod, self.backoff.backoff_time(key))
 
-    def pop(self, timeout: Optional[float] = None) -> Optional[Pod]:
+    def _pop_from_locked(self, heap: List[list]) -> Optional[Pod]:
+        """Pop the highest-priority valid entry from one lane's heap (lock
+        held); None when the heap holds only lazily-deleted entries."""
+        while heap:
+            entry = heapq.heappop(heap)
+            if not entry[_VALID]:
+                continue
+            pod = entry[2]
+            key = _pod_key(pod)
+            if self._active_entry.get(key) is entry:
+                del self._active_entry[key]
+            self.scheduling_cycle += 1
+            return pod
+        return None
+
+    def _express_ready_locked(self) -> bool:
+        """Any valid express entry pending?  (Lock held; sheds the heap's
+        lazily-deleted head entries as a side effect, so the check stays
+        O(dead entries), not O(heap).)"""
+        h = self._express
+        while h and not h[0][_VALID]:
+            heapq.heappop(h)
+        return bool(h)
+
+    def pop(self, timeout: Optional[float] = None,
+            yield_to_express: bool = False) -> Optional[Pod]:
+        """Blocking pop from the BULK lane.  With yield_to_express, an
+        express arrival interrupts the wait (returns None) so the tiered
+        run loop can serve the express lane instead of letting a
+        latency-sensitive pod sit out the bulk poll timeout."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while True:
                 self._flush(time.monotonic())
-                while self._active:
-                    entry = heapq.heappop(self._active)
-                    if not entry[_VALID]:
-                        continue
-                    pod = entry[2]
-                    key = _pod_key(pod)
-                    if self._active_entry.get(key) is entry:
-                        del self._active_entry[key]
-                    self.scheduling_cycle += 1
+                pod = self._pop_from_locked(self._active)
+                if pod is not None:
                     return pod
+                if yield_to_express and self._express_ready_locked():
+                    return None
                 if self._closed:
                     return None
                 wait = None
@@ -464,22 +531,47 @@ class PriorityQueue:
                 self._lock.wait(wait)
 
     def pop_batch(self, max_batch: int, timeout: Optional[float] = None,
-                  batch_window: float = 0.0) -> List[Pod]:
+                  batch_window: float = 0.0,
+                  yield_to_express: bool = False) -> List[Pod]:
         """Drain up to max_batch pods; waits `timeout` for the first pod then
-        `batch_window` more for stragglers (deadline-driven batch formation)."""
+        `batch_window` more for stragglers (deadline-driven batch formation).
+        yield_to_express (tiered run loop): an express arrival cuts both the
+        first-pod wait and the straggler window short."""
         out = []
-        first = self.pop(timeout)
+        first = self.pop(timeout, yield_to_express=yield_to_express)
         if first is None:
             return out
         out.append(first)
         deadline = time.monotonic() + batch_window
         while len(out) < max_batch:
             remain = deadline - time.monotonic()
-            nxt = self.pop(max(remain, 0.0) if batch_window else 0.0)
+            nxt = self.pop(max(remain, 0.0) if batch_window else 0.0,
+                           yield_to_express=yield_to_express)
             if nxt is None:
                 break
             out.append(nxt)
         return out
+
+    def pop_express_batch(self, max_batch: int) -> List[Pod]:
+        """Drain up to max_batch pods from the EXPRESS lane, non-blocking
+        (the tiered run loop polls this before every bulk pop; express
+        batch formation never waits — a latency tier that batches by
+        timer would re-create the latency it exists to remove)."""
+        out: List[Pod] = []
+        with self._lock:
+            self._flush(time.monotonic())
+            while len(out) < max_batch:
+                pod = self._pop_from_locked(self._express)
+                if pod is None:
+                    break
+                out.append(pod)
+        return out
+
+    def express_depth(self) -> int:
+        """Valid express-lane entries pending (observability/tests)."""
+        with self._lock:
+            self._express_ready_locked()
+            return sum(1 for e in self._express if e[_VALID])
 
     def __len__(self) -> int:
         with self._lock:

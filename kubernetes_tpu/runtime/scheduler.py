@@ -59,7 +59,12 @@ from kubernetes_tpu.runtime.events import (
     EventRecorder,
 )
 from kubernetes_tpu.runtime.flightrecorder import RECORDER, FlightRecorder
-from kubernetes_tpu.runtime.queue import PriorityQueue
+from kubernetes_tpu.runtime.queue import (
+    TIER_BULK,
+    TIER_EXPRESS,
+    PriorityQueue,
+    classify_tier,
+)
 from kubernetes_tpu.utils import klog
 from kubernetes_tpu.utils import metrics as m
 from kubernetes_tpu.utils.trace import Span, current_trace_id, use_traceparent
@@ -138,6 +143,32 @@ class SchedulerConfig:
     # (the utiltrace 100ms convention, now configurable); <=0 disables
     # the slow-cycle log (spans still record to the flight recorder)
     trace_threshold_s: float = 0.1
+    # --- latency tiers (ISSUE 6): the express lane ---
+    # two-tier dispatch: pods classified express at queue admission
+    # (annotation opt-in, or spec.priority >= express_priority_threshold)
+    # schedule through a small pre-compiled batch shape that the run loop
+    # serves BEFORE each bulk cycle, so a latency-sensitive pod never
+    # waits out a 2048-wide bulk dispatch.  Both lanes share the cache,
+    # snapshot, rotation counter, and the full resilience stack (retry/
+    # breaker/CPU degradation/shed guards) — placements are bit-identical
+    # to running the same pop order through one lane (pinned by test).
+    express_lane: bool = False
+    # express encode width (padded to pow2; also the per-cycle pop cap) —
+    # small enough that an express cycle costs ~ms, large enough to
+    # absorb an arrival burst without queueing a second cycle
+    express_batch_size: int = 64
+    # pods at or above this priority classify express without the
+    # annotation (None = annotation opt-in only)
+    express_priority_threshold: Optional[int] = None
+    # --- raw-speed knobs (ISSUE 6) ---
+    # persistent XLA compile cache directory (utils/compilecache.py):
+    # restarts pay zero recompiles.  None/"" = leave the process default
+    # (cmd/scheduler and bench enable it); the literal "off" disables
+    compile_cache_dir: Optional[str] = None
+    # pre-pay engine compiles at startup for every AIMD pow2 width plus
+    # the express width (Scheduler.prewarm) instead of stalling the first
+    # cycle at each new width mid-traffic
+    prewarm_widths: bool = False
     # multi-scheduler: only pods whose spec.schedulerName names THIS
     # scheduler enter its queue (eventhandlers.go responsibleForPod)
     scheduler_name: str = "default-scheduler"
@@ -176,6 +207,13 @@ class SchedulerConfig:
             batch_size_min=getattr(cc, "batch_size_min", 16),
             cycle_deadline_s=getattr(cc, "cycle_deadline_s", 0.0),
             trace_threshold_s=getattr(cc, "trace_threshold_s", 0.1),
+            express_lane=getattr(cc, "express_lane", False),
+            express_batch_size=getattr(cc, "express_batch_size", 64),
+            express_priority_threshold=getattr(
+                cc, "express_priority_threshold", None
+            ),
+            compile_cache_dir=getattr(cc, "compile_cache_dir", None),
+            prewarm_widths=getattr(cc, "prewarm_widths", False),
         )
 
 
@@ -224,6 +262,8 @@ class _InFlight:
     cpu_fetch: Optional[Callable[[], "_HostResult"]] = None
     degraded: bool = False       # True once served by the CPU engine
     last_index0: int = 0         # selectHost rotation base for this batch
+    tier: str = TIER_BULK        # latency tier this cycle serves: labels
+    #                              the phase/e2e metrics and the span
 
 
 class _HostResult:
@@ -289,6 +329,15 @@ class Scheduler:
         # where no other owner wired one
         if getattr(self.queue, "on_shed", "n/a") is None:
             self.queue.on_shed = self._on_shed
+        # latency-tier classifier (ISSUE 6): express_lane routes opted-in/
+        # high-priority pods to the queue's express heap at admission.
+        # Attach only where no other owner wired one (a caller-owned queue
+        # keeps its own policy, exactly like on_shed/capacity).
+        if (
+            self.config.express_lane
+            and getattr(self.queue, "tier_of", "n/a") is None
+        ):
+            self.queue.tier_of = self._tier_of
         self.binder = binder if binder is not None else (lambda pod, node: True)
         enc = self.cache.encoder
         prof = self.config.profile
@@ -374,6 +423,10 @@ class Scheduler:
         # postmortem attaches as in_flight when an anomaly fires before
         # that cycle retires into the flight-recorder ring
         self._cur_span: Optional[Span] = None
+        # latency tier of the most recently dispatched cycle — joins the
+        # in-flight span in postmortem snapshots (an express-cycle anomaly
+        # reads differently from a bulk one)
+        self._cur_tier: str = TIER_BULK
         # per-phase seconds, cumulative (bench live-path reporting):
         # pop (queue drain — under pipeline_commit this overlaps the
         # previous batch's in-flight fetch), encode (host tensors +
@@ -397,7 +450,8 @@ class Scheduler:
 
     # ------------------------------------------------------------- one cycle
 
-    def schedule_cycle(self, pods: Sequence[Pod]) -> List[ScheduleResult]:
+    def schedule_cycle(self, pods: Sequence[Pod],
+                       tier: str = TIER_BULK) -> List[ScheduleResult]:
         """Place a batch of pods against the current cache state; assume+bind
         winners, requeue losers.  Returns per-pod results.
 
@@ -405,10 +459,12 @@ class Scheduler:
         the pipelined run loop (config.pipeline_commit) can overlap batch
         k's tail with batch k+1's device dispatch; called directly it is
         strictly synchronous (any in-flight pipelined batch is drained
-        first so cycles never interleave)."""
+        first so cycles never interleave).  `tier` labels the cycle's
+        metrics/span and — for TIER_EXPRESS — pins the express encode
+        width; placement semantics are tier-independent."""
         self.flush_pipeline()
         try:
-            inf = self._encode_and_dispatch(pods)
+            inf = self._encode_and_dispatch(pods, tier=tier)
         except BaseException:
             # popped pods must never be lost: a fault that escaped the
             # classified-retry/degrade machinery (or a plain bug) still
@@ -448,12 +504,12 @@ class Scheduler:
 
     # -------------------------------------------------- tracing/postmortems
 
-    def _phase(self, name: str, dt: float) -> None:
+    def _phase(self, name: str, dt: float, tier: str = TIER_BULK) -> None:
         """One accumulation point for per-phase seconds: the driver-
-        visible phase_seconds dict (bench reporting) AND the /metrics
-        counter family move together."""
+        visible phase_seconds dict (bench reporting, tiers aggregated)
+        AND the tier-labeled /metrics counter family move together."""
         self.phase_seconds[name] += dt
-        m.CYCLE_PHASE_SECONDS.inc(dt, phase=name)
+        m.CYCLE_PHASE_SECONDS.inc(dt, phase=name, tier=tier)
 
     def _postmortem(self, trigger: str, detail: str = "") -> None:
         """Dump a flight-recorder postmortem for one anomaly trigger
@@ -487,6 +543,13 @@ class Scheduler:
             "adaptive_batch": self._cur_batch,
             "pipeline_pending": self.pipeline_pending,
             "scheduling_cycle": self.queue.scheduling_cycle,
+            # latency tier of the most recently dispatched cycle — pairs
+            # with the in_flight span in the postmortem
+            "tier": self._cur_tier,
+            "express_depth": (
+                self.queue.express_depth()
+                if hasattr(self.queue, "express_depth") else None
+            ),
         }
 
     # ----------------------------------------------- device-fault handling
@@ -654,23 +717,33 @@ class Scheduler:
                 self.device_health.record_success()
             return staged
 
-    def _encode_and_dispatch(self, pods: Sequence[Pod]) -> Optional[_InFlight]:
+    def _encode_and_dispatch(self, pods: Sequence[Pod],
+                             tier: str = TIER_BULK) -> Optional[_InFlight]:
         """Encode the batch + snapshot under the cache lock, run the
         extender/framework fan-out, and LAUNCH the engine.  Returns with
-        the device still computing (hosts_dev is an async handle)."""
+        the device still computing (hosts_dev is an async handle).
+
+        TIER_EXPRESS cycles encode under the encoder's batch-width
+        override: the batch pads to the small express shape (its own
+        pre-compiled program) instead of the bulk lane's sticky width."""
         if not pods:
             return None
         t_cycle0 = time.monotonic()
         enc = self.cache.encoder
         cycle = self.queue.scheduling_cycle
+        express_width = (
+            self.config.express_batch_size if tier == TIER_EXPRESS else None
+        )
         # the cycle's ROOT span: one fresh trace id per cycle, child spans
         # per phase, annotated with the device-path facts (batch width,
         # dirty rows, breaker state, retry class) — retired into the
         # flight recorder when the commit tail finishes
         trace = Span(
             "schedule_cycle", start=t_cycle0, pods=len(pods), cycle=cycle,
+            tier=tier,
         )
         self._cur_span = trace
+        self._cur_tier = tier
         enc_span = trace.child("encode")
         batch_keys = {(p.namespace, p.name) for p in pods}
         # engine choice is made BEFORE the encode so degraded cycles leave
@@ -682,7 +755,7 @@ class Scheduler:
             if self.config.cpu_fallback
             else True
         )
-        with self.cache._lock:
+        with self.cache._lock, enc.batch_width(express_width):
             # in-batch affinity state when pods carry ANY pod-affinity terms
             # (required or preferred) AND can interact (B > 1); built BEFORE
             # encode_pods so novel term topology keys register (and possibly
@@ -761,7 +834,7 @@ class Scheduler:
                 ~nom_block if extra_mask is None else (extra_mask & ~nom_block)
             )
         t_disp = time.monotonic()
-        self._phase("encode", t_disp - t_cycle0)
+        self._phase("encode", t_disp - t_cycle0, tier)
         fn = self._schedule_fn
         if self._speculative_fn is not None:
             fn = self._speculative_fn
@@ -835,14 +908,14 @@ class Scheduler:
             degraded=degraded,
             engine="cpu" if degraded else self.config.engine,
         )
-        self._phase("dispatch", time.monotonic() - t_disp)
+        self._phase("dispatch", time.monotonic() - t_disp, tier)
         return _InFlight(
             pods=list(pods), hosts_dev=hosts_dev, fetch=fetch,
             generation=generation, cycle=cycle, ext_failed=ext_failed,
             pc=pc, t_cycle0=t_cycle0, trace=trace,
             relaunch=None if degraded else launch,
             cpu_fetch=cpu_fetch, degraded=degraded,
-            last_index0=last_index0,
+            last_index0=last_index0, tier=tier,
         )
 
     def _launch_resilient(self, launch):
@@ -924,8 +997,8 @@ class Scheduler:
         # overlap working, not double counting.  "fetch_block" is the
         # residual host stall at the fence — the number the async path
         # exists to drive to ~0.
-        self._phase("fetch", inf.fetch.seconds)
-        self._phase("fetch_block", t_state0 - t_fetch0)
+        self._phase("fetch", inf.fetch.seconds, inf.tier)
+        self._phase("fetch_block", t_state0 - t_fetch0, inf.tier)
         # fetch = the ASYNC device window (stamped on the fetch worker,
         # reconstructed here from its measured duration); fetch_block =
         # the residual host stall at the fence, a SUBSET of fetch
@@ -995,7 +1068,7 @@ class Scheduler:
                 for pod in fit_errors:
                     self.preempt(pod)
                 p_span.finish()
-                self._phase("preempt", time.monotonic() - t_p)
+                self._phase("preempt", time.monotonic() - t_p, inf.tier)
         placed = sum(1 for r in results if r.node is not None)
         inf.trace.annotate(placed=placed, unschedulable=len(results) - placed)
         inf.trace.finish()
@@ -1070,9 +1143,10 @@ class Scheduler:
                 if outcome == "bound":
                     # "waiting" pods record on async bind completion instead
                     self._record_scheduled(
-                        pod, node_name, algo_dt + (time.monotonic() - t_pod)
+                        pod, node_name, algo_dt + (time.monotonic() - t_pod),
+                        tier=inf.tier,
                     )
-        self._phase("commit", time.monotonic() - t_commit0)
+        self._phase("commit", time.monotonic() - t_commit0, inf.tier)
         return results, fit_errors
 
     def _tail_batched(self, staged: _Staged):
@@ -1176,7 +1250,7 @@ class Scheduler:
                 else staged.algo_dt + (tb - staged.t_state0)
                 for qt, tb in zip(bound_qts, bound_ts)
             ]
-            m.E2E_LATENCY.observe_batch(e2es)
+            m.E2E_LATENCY.observe_batch(e2es, tier=inf.tier)
             if klog.V(2).enabled:
                 for (_, pod, node_name), e2e in zip(bound, e2es):
                     klog.V(2).infof(
@@ -1191,7 +1265,8 @@ class Scheduler:
             for kind, ns, name, type_, reason, msg, _tid in entries:
                 self.recorder.eventf(kind, ns, name, type_, reason, "%s", msg)
         self._phase(
-            "commit", staged.state_seconds + time.monotonic() - t_tail0
+            "commit", staged.state_seconds + time.monotonic() - t_tail0,
+            inf.tier,
         )
         return list(results), [pods[i] for i in staged.fit_idx]
 
@@ -1283,7 +1358,8 @@ class Scheduler:
 
     # ------------------------------------------------- reserve/permit/bind
 
-    def _record_scheduled(self, pod: Pod, node_name: str, e2e: float) -> None:
+    def _record_scheduled(self, pod: Pod, node_name: str, e2e: float,
+                          tier: str = TIER_BULK) -> None:
         """Scheduled event + counters, only once a bind actually succeeded
         (scheduler.go:268 emits after bind, not at assume).  The e2e
         histogram records queue-add -> bind-commit when the pod came
@@ -1298,7 +1374,7 @@ class Scheduler:
             pod.namespace, pod.name, node_name, e2e * 1000,
         )
         m.SCHEDULE_ATTEMPTS.inc(result=m.SCHEDULED)
-        m.E2E_LATENCY.observe(e2e)
+        m.E2E_LATENCY.observe(e2e, tier=tier)
         self.recorder.eventf(
             "Pod", pod.namespace, pod.name,
             EVENT_TYPE_NORMAL, "Scheduled",
@@ -1670,6 +1746,138 @@ class Scheduler:
     POD_GROUP_LABEL = "pod-group.scheduling.sigs.k8s.io/name"
     POD_GROUP_MIN_MEMBER = "pod-group.scheduling.sigs.k8s.io/min-available"
 
+    def _tier_of(self, pod: Pod) -> str:
+        """The queue's admission-time tier classifier (wired when
+        config.express_lane): annotation opt-in / priority threshold via
+        classify_tier, EXCEPT gang members — the express lane has no gang
+        path (atomicity needs the bulk cycle's gang machinery), so a
+        pod-group pod always rides bulk whatever its priority."""
+        if self.POD_GROUP_LABEL in pod.labels:
+            return TIER_BULK
+        return classify_tier(pod, self.config.express_priority_threshold)
+
+    def _run_express(self) -> int:
+        """Serve ONE express-lane cycle if express pods are pending: pop up
+        to express_batch_size from the express heap (never blocking — the
+        tier exists to remove waiting, not add batch-formation windows)
+        and schedule them synchronously at the express encode width.
+        Returns pods placed.  Bounded to one small batch per call, so the
+        interleave with the caller's bulk cycle is the starvation guard in
+        BOTH directions: sustained express load still yields a bulk cycle
+        per iteration, and a saturating bulk backlog still yields an
+        express cycle per iteration."""
+        pop_express = getattr(self.queue, "pop_express_batch", None)
+        if pop_express is None:
+            return 0  # caller-owned queue without tier lanes
+        t_pop = time.monotonic()
+        pods = pop_express(max(1, self.config.express_batch_size))
+        if not pods:
+            return 0
+        self._phase("pop", time.monotonic() - t_pop, TIER_EXPRESS)
+        results = self.schedule_cycle(pods, tier=TIER_EXPRESS)
+        return sum(1 for r in results if r.node is not None)
+
+    def prewarm(self, widths: Optional[Sequence[int]] = None,
+                pod_factory: Optional[Callable[[int], Pod]] = None) -> Dict[int, float]:
+        """Pre-pay the engine's XLA compiles for every batch width the
+        runtime can dispatch — the AIMD pow2 ladder (shared with bench
+        warmup via codec.schema.aimd_pow2_widths) plus the express width —
+        against the CURRENT snapshot shape, so the first cycle at each
+        width serves traffic instead of stalling on a compile.  With a
+        persistent compile cache (utils/compilecache.py) warm, each width
+        is a cache hit and this is seconds, not minutes.
+
+        Runs the engine on throwaway pods and discards the result: nothing
+        commits, the rotation counter does not advance, and the resident
+        device snapshot ends exactly as a normal cycle would leave it.
+        Returns {width: seconds}.
+
+        `pod_factory(i) -> Pod` should build a pod REPRESENTATIVE of the
+        live workload: jit executables are keyed on every PodBatch leaf
+        shape, and per-pod pad dims (selector/affinity/port/volume axes)
+        grow from the pods actually encoded — warming with pods shaped
+        differently from traffic pre-grows the wrong dims and the first
+        real batch at each width still compiles.  Default: minimal
+        cpu-request-only pods (right for homogeneous simple workloads)."""
+        from kubernetes_tpu.api.factory import make_pod
+        from kubernetes_tpu.codec.schema import _pow2, aimd_pow2_widths
+        from kubernetes_tpu.models.batched import encode_batch_ports
+
+        cfg = self.config
+        if widths is None:
+            widths = aimd_pow2_widths(
+                cfg.batch_size_min if cfg.adaptive_batch else cfg.batch_size,
+                cfg.batch_size,
+            )
+            if cfg.express_lane:
+                widths = sorted(
+                    set(widths) | {_pow2(max(1, cfg.express_batch_size))}
+                )
+        enc = self.cache.encoder
+        fn = (
+            self._speculative_fn
+            if self._speculative_fn is not None
+            else self._schedule_fn
+        )
+        if pod_factory is None:
+            def pod_factory(i: int) -> Pod:  # noqa: F811 — default factory
+                return make_pod(f"prewarm-{i}", cpu="1m")
+        # extra-mask/score presence also selects a jit variant: with
+        # filter/prioritize extenders or tensor framework plugins
+        # configured, every live cycle passes non-None arrays — warm THAT
+        # variant (all-true mask / zero score match the no-op fan-out).
+        # Nominated-pod cycles still pick a transient different variant;
+        # those are rare and self-limiting, not the steady state.
+        fwk = self.framework
+        want_mask = any(
+            e.config.filter_verb or e.config.prioritize_verb
+            for e in self.extenders
+        ) or bool(fwk is not None and fwk.tensor_filter_plugins)
+        want_score = any(
+            e.config.filter_verb or e.config.prioritize_verb
+            for e in self.extenders
+        ) or bool(fwk is not None and fwk.tensor_score_plugins)
+        timings: Dict[int, float] = {}
+        for w in widths:
+            t0 = time.monotonic()
+            pods = [pod_factory(i) for i in range(w)]
+            # the width override pins each warm batch to its own pow2
+            # shape WITHOUT growing the sticky dims.B floor — runtime
+            # width selection stays exactly as it would be unwarmed
+            with self.cache._lock, enc.batch_width(w):
+                # in-batch affinity state exactly as _encode_and_dispatch
+                # builds it: its presence selects a DIFFERENT traced
+                # variant, so an affinity-carrying pod_factory must warm
+                # that one (and the encode ordering matters — novel term
+                # topology keys register before the TP-wide tensors cut)
+                aff_state = (
+                    encode_batch_affinity(enc, pods)
+                    if len(pods) > 1 and batch_has_pod_affinity(pods)
+                    else None
+                )
+                batch = enc.encode_pods(pods)
+                ports = encode_batch_ports(enc, pods)
+                cluster, _ = self.cache.snapshot()
+                dirty_rows = enc.take_dirty_rows()
+            dev_cluster = self._dev_snapshot.update(
+                cluster, dirty_rows=dirty_rows
+            )
+            B, N = batch.n_pods, cluster.n_nodes
+            extra_mask = np.ones((B, N), bool) if want_mask else None
+            extra_score = (
+                np.zeros((B, N), np.float32) if want_score else None
+            )
+            hosts, _ = fn(
+                dev_cluster, batch, ports, np.int32(self._last_index),
+                None, extra_mask, extra_score, aff_state,
+            )
+            jax.block_until_ready(hosts)
+            timings[w] = time.monotonic() - t0
+            klog.V(1).infof(
+                "prewarm: width %d compiled in %.2fs", w, timings[w]
+            )
+        return timings
+
     @property
     def pipeline_pending(self) -> bool:
         """True while a dispatched batch awaits its commit tail (the
@@ -1729,6 +1937,16 @@ class Scheduler:
         (flush_pipeline drains the last one); gang cycles and empty polls
         drain the pipeline first so snapshots never go stale."""
         t_pop = time.monotonic()
+        express = self.config.express_lane
+        # tiered mode only adds the kwarg (an express arrival interrupts
+        # the bulk wait so the express cycle below runs immediately), and
+        # only for a queue that actually has tier lanes — a caller-owned
+        # duck-typed queue without them never sees it
+        pop_kw = (
+            {"yield_to_express": True}
+            if express and hasattr(self.queue, "pop_express_batch")
+            else {}
+        )
         pods = self.queue.pop_batch(
             # adaptive mode pops at the CURRENT AIMD width; static mode
             # keeps the configured batch size
@@ -1739,9 +1957,27 @@ class Scheduler:
             # queue momentarily empties (trickle arrival, burst tails)
             0.0 if self.pipeline_pending else timeout,
             self.config.batch_window_s,
+            **pop_kw,
         )
+        self._phase("pop", time.monotonic() - t_pop)
+        # express lane between the bulk pop and the bulk dispatch: pending
+        # latency-sensitive pods schedule (and commit) BEFORE this cycle's
+        # bulk batch, and at most one small express batch runs per
+        # iteration (the bulk lane's starvation guard)
+        try:
+            n_express = self._run_express() if express else 0
+        except BaseException:
+            # the just-popped bulk batch is held only in this frame: an
+            # express-cycle failure must not strand it (popped pods are
+            # never lost; the express cycle's own pods were requeued by
+            # schedule_cycle's guard)
+            self.queue.add_unschedulable_batch(
+                list(pods), self.queue.scheduling_cycle
+            )
+            raise
+        # the AIMD deadline window starts AFTER the express cycle: express
+        # work must not read as a bulk overrun and shrink the bulk batch
         t_cycle0 = time.monotonic()
-        self._phase("pop", t_cycle0 - t_pop)
         if not pods:
             # idle poll: drain any in-flight batch so binds/events/requeues
             # don't wait for the next arrival; idle cycles also DECAY the
@@ -1749,7 +1985,7 @@ class Scheduler:
             # even when the last pop emptied the queue in one gulp)
             n = self.flush_pipeline()
             self._adapt_batch(0.0)
-            return n
+            return n + n_express
         # gang-eligibility is conservative: extenders and framework
         # plugins enforce verdicts the gang launch cannot consult, and an
         # outstanding preemption nomination must not be absorbed by a
@@ -1887,7 +2123,7 @@ class Scheduler:
         # commit), not the pop wait — an idle poll must not read as an
         # overrun and shrink the batch
         self._adapt_batch(time.monotonic() - t_cycle0)
-        return n
+        return n + n_express
 
     def run(self) -> None:
         """wait.Until(scheduleOne) analog (scheduler.go:250-256)."""

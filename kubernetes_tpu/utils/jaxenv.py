@@ -15,7 +15,10 @@ from __future__ import annotations
 import os
 import re
 
-COMPILE_CACHE_DIR = "/tmp/ktpu_jax_cache"
+from kubernetes_tpu.utils.compilecache import (
+    DEFAULT_CACHE_DIR as COMPILE_CACHE_DIR,
+)
+
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 
@@ -36,10 +39,13 @@ def set_host_device_count(n: int) -> None:
 
 
 def enable_compile_cache() -> None:
-    import jax
+    """Delegates to utils/compilecache.py (the knob-driven single source:
+    KTPU_COMPILE_CACHE_DIR / --compile-cache-dir / compileCacheDir)."""
+    from kubernetes_tpu.utils.compilecache import (
+        enable_compile_cache as _enable,
+    )
 
-    jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    _enable()
 
 
 def force_cpu_mesh(n: int) -> None:

@@ -17,6 +17,11 @@ _DEF_BUCKETS = [0.001 * (2 ** i) for i in range(16)]  # 1ms .. ~32s
 
 
 class Histogram:
+    """Single-series histogram.  The observe/quantile methods ACCEPT AND
+    IGNORE label kwargs so a plain Histogram can stand in for a
+    LabeledHistogram (the test/density pattern of swapping a fresh
+    instance over a labeled global like E2E_LATENCY)."""
+
     def __init__(self, name: str, help_: str = "", buckets: Optional[List[float]] = None):
         self.name = name
         self.help = help_
@@ -26,14 +31,14 @@ class Histogram:
         self.total = 0
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, **_labels) -> None:
         with self._lock:
             i = bisect.bisect_left(self.buckets, v)
             self.counts[i] += 1
             self.sum += v
             self.total += 1
 
-    def observe_n(self, v: float, n: int) -> None:
+    def observe_n(self, v: float, n: int, **_labels) -> None:
         """n observations of the same value under one lock acquisition
         (the batched commit path's per-pod amortized latencies)."""
         if n <= 0:
@@ -44,7 +49,7 @@ class Histogram:
             self.sum += v * n
             self.total += n
 
-    def observe_batch(self, values) -> None:
+    def observe_batch(self, values, **_labels) -> None:
         """Many distinct observations under one lock acquisition."""
         if not values:
             return
@@ -55,7 +60,7 @@ class Histogram:
                 self.sum += v
             self.total += len(values)
 
-    def quantile(self, q: float) -> float:
+    def quantile(self, q: float, **_labels) -> float:
         """Approximate quantile with LINEAR INTERPOLATION inside the
         bucket (the prometheus histogram_quantile estimator): the target
         rank's position within its bucket's count scales between the
@@ -154,6 +159,81 @@ class LabeledCounter:
         return "\n".join(out)
 
 
+class LabeledHistogram:
+    """Histogram family with label sets — the prometheus HistogramVec
+    analog (e.g. scheduler_e2e_scheduling_duration_seconds{tier=}).
+
+    Each distinct label set owns a child Histogram; observations without
+    an explicit label fall into `default_labels` (so pre-tier callers keep
+    recording, into the bulk series).  `total` aggregates children (the
+    before/after counters some tests pin); `quantile` reads one child."""
+
+    def __init__(self, name: str, help_: str = "",
+                 label_names: Tuple[str, ...] = (),
+                 buckets: Optional[List[float]] = None,
+                 default_labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._buckets = buckets
+        self._default = dict(default_labels or {})
+        self._children: Dict[Tuple[str, ...], Histogram] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, **labels) -> Histogram:
+        merged = {**self._default, **labels}
+        key = tuple(str(merged.get(n, "")) for n in self.label_names)
+        with self._lock:
+            h = self._children.get(key)
+            if h is None:
+                h = self._children[key] = Histogram(
+                    self.name, self.help, buckets=self._buckets
+                )
+            return h
+
+    def observe(self, v: float, **labels) -> None:
+        self.labels(**labels).observe(v)
+
+    def observe_n(self, v: float, n: int, **labels) -> None:
+        self.labels(**labels).observe_n(v, n)
+
+    def observe_batch(self, values, **labels) -> None:
+        self.labels(**labels).observe_batch(values)
+
+    def quantile(self, q: float, **labels) -> float:
+        return self.labels(**labels).quantile(q)
+
+    @property
+    def total(self) -> int:
+        with self._lock:
+            children = list(self._children.values())
+        return sum(h.total for h in children)
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            children = list(self._children.values())
+        return sum(h.sum for h in children)
+
+    def expose(self) -> str:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            children = sorted(self._children.items())
+        for key, h in children:
+            lbl = ",".join(
+                f'{n}="{val}"' for n, val in zip(self.label_names, key)
+            )
+            acc = 0
+            for i, b in enumerate(h.buckets):
+                acc += h.counts[i]
+                out.append(f'{self.name}_bucket{{{lbl},le="{b}"}} {acc}')
+            out.append(f'{self.name}_bucket{{{lbl},le="+Inf"}} {h.total}')
+            out.append(f"{self.name}_sum{{{lbl}}} {h.sum}")
+            out.append(f"{self.name}_count{{{lbl}}} {h.total}")
+        return "\n".join(out)
+
+
 class LabeledGauge(LabeledCounter):
     """Gauge family with label sets (the prometheus GaugeVec analog,
     e.g. apiserver_current_inflight_requests{request_kind=})."""
@@ -194,8 +274,16 @@ class Registry:
 
 REGISTRY = Registry()
 
-# the scheduler's metric families (metrics.go:86-199 names, seconds units)
-E2E_LATENCY = REGISTRY.register(Histogram("scheduler_e2e_scheduling_duration_seconds"))
+# the scheduler's metric families (metrics.go:86-199 names, seconds units).
+# e2e carries the latency-tier label (ISSUE 6): per-tier p50/p99 is the
+# express lane's acceptance figure, and single-series recording (tests,
+# density, pre-tier callers) lands in the bulk child by default.
+TIER_BULK, TIER_EXPRESS = "bulk", "express"
+E2E_LATENCY = REGISTRY.register(LabeledHistogram(
+    "scheduler_e2e_scheduling_duration_seconds",
+    "Queue-add -> bind-commit latency, by latency tier",
+    ("tier",), default_labels={"tier": TIER_BULK},
+))
 ALGO_LATENCY = REGISTRY.register(Histogram("scheduler_scheduling_algorithm_duration_seconds"))
 PREDICATE_LATENCY = REGISTRY.register(Histogram("scheduler_scheduling_algorithm_predicate_evaluation_seconds"))
 PRIORITY_LATENCY = REGISTRY.register(Histogram("scheduler_scheduling_algorithm_priority_evaluation_seconds"))
@@ -304,12 +392,13 @@ CYCLE_PHASE_SECONDS = REGISTRY.register(
     LabeledCounter(
         "scheduler_cycle_phase_seconds_total",
         "Cumulative seconds spent per scheduling-cycle phase "
-        "(pop|encode|dispatch|fetch|fetch_block|commit|preempt); encode "
-        "includes the extender/framework fan-out (the span tree at "
-        "/debug/traces splits extenders out); fetch overlaps host phases "
-        "and fetch_block is a subset of fetch, so phase sums exceeding "
-        "wall clock means the pipeline is working",
-        ("phase",),
+        "(pop|encode|dispatch|fetch|fetch_block|commit|preempt) and "
+        "latency tier (bulk|express); encode includes the extender/"
+        "framework fan-out (the span tree at /debug/traces splits "
+        "extenders out); fetch overlaps host phases and fetch_block is a "
+        "subset of fetch, so phase sums exceeding wall clock means the "
+        "pipeline is working",
+        ("phase", "tier"),
     )
 )
 

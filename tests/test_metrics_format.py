@@ -102,26 +102,50 @@ def parse_exposition(text: str) -> dict:
 
 def check_histograms(families: dict) -> int:
     """Monotone cumulative buckets, ascending le, +Inf == _count,
-    non-negative _sum for every histogram family.  Returns how many
-    histograms were checked."""
+    non-negative _sum for every histogram SERIES — a labeled histogram
+    family (HistogramVec, e.g. the tier-labeled e2e histogram) exposes
+    one child per label set, each with its own bucket ladder, grouped
+    here by the label set minus `le`.  Returns how many histogram
+    families were checked."""
     checked = 0
     for fam, data in families.items():
         if data["type"] != "histogram":
             continue
-        buckets = [(lbl["le"], v) for n, lbl, v in data["samples"]
-                   if n == fam + "_bucket"]
-        count = next(v for n, _, v in data["samples"] if n == fam + "_count")
-        total = next(v for n, _, v in data["samples"] if n == fam + "_sum")
-        assert buckets, f"{fam}: no buckets"
-        assert buckets[-1][0] == "+Inf", f"{fam}: last bucket must be +Inf"
-        les = [float(le.replace("+Inf", "inf")) for le, _ in buckets]
-        assert les == sorted(les), f"{fam}: le boundaries not ascending"
-        counts = [v for _, v in buckets]
-        assert counts == sorted(counts), (
-            f"{fam}: cumulative bucket counts not monotone: {counts}"
-        )
-        assert counts[-1] == count, f"{fam}: +Inf bucket != _count"
-        assert total >= 0.0, f"{fam}: negative _sum"
+        series: dict = {}
+        for n, lbl, v in data["samples"]:
+            key = frozenset(
+                (k, val) for k, val in lbl.items() if k != "le"
+            )
+            s = series.setdefault(
+                key, {"buckets": [], "count": None, "sum": None}
+            )
+            if n == fam + "_bucket":
+                s["buckets"].append((lbl["le"], v))
+            elif n == fam + "_count":
+                s["count"] = v
+            elif n == fam + "_sum":
+                s["sum"] = v
+        assert series, f"{fam}: no samples"
+        for key, s in series.items():
+            where = f"{fam}{dict(key) if key else ''}"
+            buckets = s["buckets"]
+            assert buckets, f"{where}: no buckets"
+            assert buckets[-1][0] == "+Inf", (
+                f"{where}: last bucket must be +Inf"
+            )
+            les = [float(le.replace("+Inf", "inf")) for le, _ in buckets]
+            assert les == sorted(les), (
+                f"{where}: le boundaries not ascending"
+            )
+            counts = [v for _, v in buckets]
+            assert counts == sorted(counts), (
+                f"{where}: cumulative bucket counts not monotone: {counts}"
+            )
+            assert s["count"] is not None, f"{where}: missing _count"
+            assert counts[-1] == s["count"], f"{where}: +Inf bucket != _count"
+            assert s["sum"] is not None and s["sum"] >= 0.0, (
+                f"{where}: missing or negative _sum"
+            )
         checked += 1
     return checked
 
