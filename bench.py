@@ -561,6 +561,14 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
     enc = _build_encoder(args)
     cache = SchedulerCache(enc)
     queue = PriorityQueue()
+    # decision ledger (--ledger-out): record the HEADLINE live run (the
+    # batched+pipelined stage) for the record->replay bit-identity gate;
+    # the per-pod comparison run stays unrecorded
+    ledger = None
+    if getattr(args, "ledger_out", None) and batched and pipeline:
+        from kubernetes_tpu.runtime.ledger import DecisionLedger
+
+        ledger = DecisionLedger(path=args.ledger_out)
     sched = Scheduler(
         cache=cache,
         queue=queue,
@@ -573,6 +581,7 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
             batched_commit=batched,
             pipeline_commit=pipeline,
         ),
+        ledger=ledger,
     )
     def _drain(budget_s: float) -> int:
         """run_once until nothing schedulable remains: active/backoff work,
@@ -623,6 +632,20 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
         - sched.phase_seconds["fetch_block"]
         + t_enqueue
     )
+    ledger_stats = None
+    if ledger is not None:
+        ledger.flush(30.0)
+        ledger_stats = {
+            "path": args.ledger_out,
+            "cycles": ledger.cycles_total,
+            "bytes": ledger.bytes_total,
+            "dropped": ledger.dropped_total,
+        }
+        sys.stderr.write(
+            f"bench: recorded {ledger.cycles_total} cycles "
+            f"({ledger.bytes_total} bytes, {ledger.dropped_total} "
+            f"dropped) to {args.ledger_out}\n"
+        )
     return {
         "pods_per_s": round(placed / dt, 1) if dt > 0 else 0.0,
         "seconds": round(dt, 3),
@@ -630,6 +653,7 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
         "unschedulable": total - placed,
         "batched_commit": batched,
         "pipeline_commit": pipeline,
+        **({"ledger": ledger_stats} if ledger_stats else {}),
         "commit_seconds": round(sched.phase_seconds["commit"], 3),
         "phases": {"enqueue": round(t_enqueue, 3),
                    **{k: round(v, 3)
@@ -1146,6 +1170,8 @@ def _child_cmd(args, platform: str | None) -> list:
     ]
     if getattr(args, "trace_out", None):
         cmd += ["--trace-out", args.trace_out]
+    if getattr(args, "ledger_out", None):
+        cmd += ["--ledger-out", args.ledger_out]
     if args.density:
         cmd += ["--density",
                 "--density-interval", str(args.density_interval),
@@ -1277,6 +1303,41 @@ def orchestrate(args) -> None:
     _emit(banked["result"])
 
 
+def run_replay(args) -> None:
+    """--replay <ledger>: offline bit-identity gate.  Reconstructs every
+    recorded cycle's snapshot (codec delta chain), re-executes it through
+    a freshly built engine (the recorded config from the ledger header),
+    and compares winners bit-for-bit — the determinism contract the
+    offline weight-tuning loop (ROADMAP item 4) builds on.  Emits exactly
+    one JSON line; exits 1 on any mismatch."""
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    from kubernetes_tpu.runtime.ledger import replay
+
+    t0 = time.monotonic()
+    try:
+        out = replay(args.replay, engine=args.replay_engine)
+    except Exception as e:  # noqa: BLE001 — the JSON line must emit
+        _emit({
+            "metric": "ledger_replay_bit_identical",
+            "value": 0.0,
+            "unit": "bool",
+            "detail": {"error": f"{type(e).__name__}: {e}",
+                       "ledger": args.replay},
+        })
+        sys.exit(1)
+    out["seconds"] = round(time.monotonic() - t0, 3)
+    out["ledger"] = args.replay
+    _emit({
+        "metric": "ledger_replay_bit_identical",
+        "value": 1.0 if out["bit_identical"] else 0.0,
+        "unit": "bool",
+        "detail": out,
+    })
+    if not out["bit_identical"]:
+        sys.exit(1)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=5000)
@@ -1361,13 +1422,39 @@ def main():
         "phase's file, so the artifact matches the emitted number)",
     )
     ap.add_argument(
+        "--ledger-out", default=None,
+        help="record the live-path stage's scheduling cycles to this "
+        "decision-ledger file (runtime/ledger.py): every cycle's inputs "
+        "(snapshot delta, encoded batch, rotation base) and winners, "
+        "replayable with --replay.  In orchestrated mode the child that "
+        "measured writes it, next to the --trace-out artifact",
+    )
+    ap.add_argument(
+        "--replay", default=None, metavar="LEDGER",
+        help="replay a recorded decision ledger: reconstruct each "
+        "cycle's snapshot, re-execute it through a freshly built engine "
+        "and assert bit-identical winners; emits one JSON line and "
+        "exits non-zero on any mismatch",
+    )
+    ap.add_argument(
+        "--replay-engine", default=None,
+        choices=("sequential", "speculative"),
+        help="engine to replay through.  Default: the recorded one, "
+        "which must reproduce the recorded winners bit-for-bit; "
+        "CROSS-engine replay is a comparison tool (the engines match "
+        "semantics, but argmax-tie rotation can pick different "
+        "winners on tie-heavy workloads)",
+    )
+    ap.add_argument(
         "--platform",
         default=None,
         help="force a jax platform (e.g. cpu); default = environment (TPU)",
     )
     args = ap.parse_args()
 
-    if os.environ.get(_CHILD_ENV) == "1":
+    if args.replay:
+        run_replay(args)
+    elif os.environ.get(_CHILD_ENV) == "1":
         run_child(args)
     else:
         orchestrate(args)

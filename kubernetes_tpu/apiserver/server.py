@@ -881,17 +881,44 @@ class APIServer:
                         ct="text/plain; version=0.0.4",
                     )
                     return
-                if self.path == "/debug/traces":
+                if self.path.partition("?")[0] == "/debug/traces":
                     # the process-wide flight recorder as Chrome
                     # trace-event JSON (Perfetto-loadable) — in embedded
                     # deployments (--with-scheduler) the scheduling
-                    # cycles' spans live in this process
+                    # cycles' spans live in this process.  ?limit=N keeps
+                    # the newest N cycle spans; the hard response-size
+                    # cap halves further so a long-lived ring can never
+                    # produce an unbounded body
                     from kubernetes_tpu.runtime.flightrecorder import (
                         RECORDER,
                     )
+                    from kubernetes_tpu.runtime.ledger import debug_body
 
                     self._send_text(
-                        json.dumps(RECORDER.chrome_trace()).encode(),
+                        debug_body(
+                            RECORDER.chrome_trace,
+                            self.path.partition("?")[2],
+                        ),
+                        ct="application/json",
+                    )
+                    return
+                if self.path.partition("?")[0] == "/debug/decisions":
+                    # recent decision-ledger entries (winners + dominant
+                    # rejection reasons per pod), cross-linked to
+                    # /debug/traces by trace id; inflight-exempt like the
+                    # trace endpoint
+                    from kubernetes_tpu.runtime.ledger import (
+                        debug_body,
+                        get_default,
+                    )
+
+                    self._send_text(
+                        debug_body(
+                            lambda lim: {
+                                "decisions": get_default().decisions(lim)
+                            },
+                            self.path.partition("?")[2],
+                        ),
                         ct="application/json",
                     )
                     return
@@ -2007,7 +2034,7 @@ class APIServer:
         # and a watch would pin a readonly slot for its whole lifetime.
         if outer.flow_control is not None:
             exempt = ("/healthz", "/livez", "/readyz", "/metrics",
-                      "/version", "/debug/traces")
+                      "/version", "/debug/traces", "/debug/decisions")
             for method in ("do_GET", "do_POST", "do_PUT", "do_PATCH",
                            "do_DELETE"):
                 inner = getattr(Handler, method)

@@ -80,6 +80,25 @@ def build_parser() -> argparse.ArgumentParser:
                    "width (+ the express width) at startup (config "
                    "prewarmWidths) instead of stalling the first cycle "
                    "at each new width mid-traffic")
+    p.add_argument("--attribution", action="store_true", default=None,
+                   help="per-plugin decision attribution (config "
+                   "attribution): unschedulable events and the "
+                   "kubernetes-tpu.io/unschedulable-reason annotation "
+                   "name the dominant failing predicate with per-reason "
+                   "node counts; forces the sequential engine "
+                   "(bit-identical placements)")
+    p.add_argument("--decision-ledger", action="store_true", default=None,
+                   help="record every scheduling cycle to the decision "
+                   "ledger (config decisionLedger): /debug/decisions + "
+                   "replayable via bench.py --replay when --ledger-dir "
+                   "is set")
+    p.add_argument("--ledger-dir", default=None,
+                   help="directory for the append-only decisions.ledger "
+                   "file (config ledgerDir; implies --decision-ledger; "
+                   "unset = in-memory /debug/decisions ring only)")
+    p.add_argument("--ledger-max-cycles", type=int, default=None,
+                   help="stop recording to the ledger file after this "
+                   "many cycles (config ledgerMaxCycles; default 4096)")
     p.add_argument("--simulate-nodes", type=int, default=0,
                    help="register N hollow nodes")
     p.add_argument("--simulate-pods", type=int, default=0,
@@ -122,6 +141,15 @@ def main(argv=None) -> int:
         cc.compile_cache_dir = args.compile_cache_dir
     if args.prewarm is not None:
         cc.prewarm_widths = args.prewarm
+    if args.attribution is not None:
+        cc.attribution = args.attribution
+    if args.decision_ledger is not None:
+        cc.decision_ledger = args.decision_ledger
+    if args.ledger_dir is not None:
+        cc.ledger_dir = args.ledger_dir
+        cc.decision_ledger = True  # a ledger dir implies recording
+    if args.ledger_max_cycles is not None:
+        cc.ledger_max_cycles = args.ledger_max_cycles
 
     # persistent compile cache BEFORE any jit compile (engine build,
     # prewarm, first cycle) so every executable of this process is served

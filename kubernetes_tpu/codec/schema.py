@@ -87,6 +87,58 @@ PREDICATE_ORDER = (
 PRED_INDEX = {name: i for i, name in enumerate(PREDICATE_ORDER)}
 NUM_PREDICATES = len(PREDICATE_ORDER)
 
+# --- decision attribution (the explain/ledger axis) ---------------------
+# The attribution launch collapses the per-plugin sub-masks into one
+# first-failing-predicate code per (pod, node) in PREDICATE_ORDER — the
+# reference's podFitsOnNode short-circuit attribution — plus one extra
+# code for nodes every predicate passed but the extra mask vetoed (an
+# extender filter verdict, a tensor Filter plugin, or a nominated-pod
+# port/anti-affinity block).  The aggregate GeneralPredicates row never
+# attributes: its constituents (host/ports/selector/resources) follow it
+# in PREDICATE_ORDER and name the precise reason instead.
+REASON_EXTENDER = NUM_PREDICATES
+NUM_REASONS = NUM_PREDICATES + 1
+REASON_EXTENDER_NAME = "ExtenderFilter"
+
+# kubectl-describe-parity message per reason (the FitError reason strings
+# of algorithm/predicates/error.go, phrased for the "N node(s) ..." event
+# format); predicates without a bespoke string fall back to their name.
+REASON_MESSAGES = {
+    "CheckNodeCondition": "node(s) were not ready",
+    "CheckNodeUnschedulable": "node(s) were unschedulable",
+    "PodFitsHost": "node(s) didn't match the requested hostname",
+    "PodFitsHostPorts": "node(s) didn't have free ports for the requested "
+                        "pod ports",
+    "PodMatchNodeSelector": "node(s) didn't match node selector",
+    "PodFitsResources": "Insufficient resources",
+    "NoDiskConflict": "node(s) had no available volume zone",
+    "PodToleratesNodeTaints": "node(s) had taints that the pod didn't "
+                              "tolerate",
+    "PodToleratesNodeNoExecuteTaints": "node(s) had NoExecute taints that "
+                                       "the pod didn't tolerate",
+    "CheckVolumeBinding": "node(s) didn't find available persistent "
+                          "volumes to bind",
+    "NoVolumeZoneConflict": "node(s) had volume node affinity conflict",
+    "CheckNodeMemoryPressure": "node(s) had memory pressure",
+    "CheckNodePIDPressure": "node(s) had pid pressure",
+    "CheckNodeDiskPressure": "node(s) had disk pressure",
+    "MatchInterPodAffinity": "node(s) didn't match pod "
+                             "affinity/anti-affinity",
+    REASON_EXTENDER_NAME: "node(s) were filtered by an extender or plugin",
+}
+
+
+def reason_name(code: int) -> str:
+    """Reason code (attribution counts axis) -> predicate/plugin name."""
+    if 0 <= code < NUM_PREDICATES:
+        return PREDICATE_ORDER[code]
+    return REASON_EXTENDER_NAME
+
+
+def reason_message(code: int) -> str:
+    name = reason_name(code)
+    return REASON_MESSAGES.get(name, f"node(s) failed {name}")
+
 # Priority (score) functions.  The first eight are the default provider set
 # (algorithmprovider/defaults/defaults.go defaultPriorities(): all weight 1;
 # NodePreferAvoidPods weight 10000, register_priorities.go:87); the tail are
@@ -110,6 +162,10 @@ PRIORITY_ORDER = (
 )
 PRIO_INDEX = {name: i for i, name in enumerate(PRIORITY_ORDER)}
 NUM_PRIORITIES = len(PRIORITY_ORDER)
+# attribution score-breakdown axis: every priority plugin plus one
+# "Extra" slot for the extender-prioritize / tensor-Score contribution
+SCORE_COMPONENTS = PRIORITY_ORDER + ("Extra",)
+NUM_SCORE_COMPONENTS = len(SCORE_COMPONENTS)
 DEFAULT_PRIORITY_WEIGHTS = np.array(
     [1.0, 1.0, 1.0, 1.0, 10000.0, 1.0, 1.0, 1.0, 0.0, 0.0, 0.0, 0.0],
     dtype=np.float32,

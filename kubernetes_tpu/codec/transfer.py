@@ -482,3 +482,88 @@ class DeviceSnapshotCache:
             self._dev.update(zip(changed, uploaded))
             self._host.update(staged)
         return type(cluster)(**self._dev)
+
+
+# ------------------------------------------------------- snapshot deltas
+# Host-side snapshot delta serialization for the decision ledger
+# (runtime/ledger.py): the on-disk twin of DeviceSnapshotCache's
+# incremental upload.  A recorded cycle stores only the rows of each
+# field that moved since the previously RECORDED snapshot (the encoder's
+# cow snapshot makes unchanged fields identity-equal, so most fields
+# cost one pointer compare); replay folds the deltas back into a full
+# ClusterTensors, bit-identical to what the cycle dispatched.
+
+
+def _row_changed(prev: np.ndarray, cur: np.ndarray) -> np.ndarray:
+    """intp[] rows (axis 0) where prev and cur differ, NaN-safe (NaN is
+    a live value in label_nums — two NaNs count as equal)."""
+    neq = prev != cur
+    if prev.dtype.kind == "f":
+        neq &= ~(np.isnan(prev) & np.isnan(cur))
+    if neq.ndim > 1:
+        neq = neq.reshape(neq.shape[0], -1).any(axis=1)
+    return np.flatnonzero(neq)
+
+
+def snapshot_delta(prev, cur) -> dict:
+    """ClusterTensors pair -> {field: ("full", arr) | ("rows", idx, vals)}.
+    prev=None (or a shape/dtype change, or a diff touching most rows)
+    records the field whole; identity-equal fields are omitted entirely.
+    Pure numpy — safe to run on the ledger's writer thread because the
+    encoder's snapshot arrays are immutable by the dirty-row contract."""
+    out: dict = {}
+    for f in dataclasses.fields(cur):
+        cur_a = np.asarray(getattr(cur, f.name))
+        prev_a = (
+            np.asarray(getattr(prev, f.name)) if prev is not None else None
+        )
+        if prev_a is cur_a:
+            continue
+        if (
+            prev_a is None
+            or prev_a.shape != cur_a.shape
+            or prev_a.dtype != cur_a.dtype
+            or cur_a.ndim == 0
+        ):
+            out[f.name] = ("full", cur_a)
+            continue
+        rows = _row_changed(prev_a, cur_a)
+        if len(rows) == 0:
+            continue
+        if len(rows) > cur_a.shape[0] // 2:
+            out[f.name] = ("full", cur_a)
+        else:
+            out[f.name] = ("rows", rows.astype(np.int64), cur_a[rows])
+    return out
+
+
+def apply_snapshot_delta(prev, delta: dict, cls=None):
+    """Fold a snapshot_delta back onto `prev` (None for the first,
+    necessarily-full record) -> a reconstructed snapshot of type `cls`
+    (defaults to type(prev)).  Row patches copy-on-write, so the caller
+    may keep every reconstructed snapshot alive (the replay harness
+    does)."""
+    if prev is None:
+        missing = [
+            f.name for f in dataclasses.fields(cls)
+            if f.name not in delta
+        ]
+        if missing:
+            raise ValueError(
+                f"first ledger record is not a full snapshot: {missing}"
+            )
+        fields = {k: v[1] for k, v in delta.items()}
+        return cls(**fields)
+    cls = cls or type(prev)
+    fields = {}
+    for f in dataclasses.fields(prev):
+        cur = np.asarray(getattr(prev, f.name))
+        d = delta.get(f.name)
+        if d is not None:
+            if d[0] == "full":
+                cur = d[1]
+            else:
+                cur = cur.copy()
+                cur[d[1]] = d[2]
+        fields[f.name] = cur
+    return cls(**fields)

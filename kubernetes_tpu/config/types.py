@@ -95,6 +95,15 @@ class KubeSchedulerConfiguration:
     # startup pre-warming of every AIMD pow2 width + the express width
     compile_cache_dir: Optional[str] = None
     prewarm_widths: bool = False
+    # decision ledger + per-plugin attribution (runtime/ledger.py,
+    # models/batched.py Attribution): record every cycle's inputs/outcomes
+    # for /debug/decisions + bench --replay, and have unschedulable
+    # events/annotations name the dominant failing predicate with
+    # per-reason node counts
+    attribution: bool = False
+    decision_ledger: bool = False
+    ledger_dir: Optional[str] = None
+    ledger_max_cycles: int = 4096
 
     def build_profile(self, interner=None) -> SchedulingProfile:
         """CreateFromConfig / CreateFromProvider (scheduler.go:162-192)."""
@@ -161,6 +170,10 @@ class KubeSchedulerConfiguration:
             ),
             compile_cache_dir=d.get("compileCacheDir"),
             prewarm_widths=bool(d.get("prewarmWidths", False)),
+            attribution=bool(d.get("attribution", False)),
+            decision_ledger=bool(d.get("decisionLedger", False)),
+            ledger_dir=d.get("ledgerDir"),
+            ledger_max_cycles=int(d.get("ledgerMaxCycles", 4096)),
         )
 
     @staticmethod

@@ -65,9 +65,36 @@ from kubernetes_tpu.ops.select import (
 )
 from kubernetes_tpu.codec.schema import (
     DEFAULT_PRIORITY_WEIGHTS,
+    NUM_REASONS,
     PRIO_INDEX,
+    REASON_EXTENDER,
     ScoreConfig,
 )
+
+
+class Attribution(NamedTuple):
+    """Per-pod decision attribution, emitted only by the engine's
+    attribution variant (make_sequential_scheduler(attribution=True)) so
+    the default executable is byte-identical to before.
+
+    reason_counts[b, k]: how many live nodes rejected pod b with reason k
+    as the FIRST failure in PREDICATE_ORDER (the reference podFitsOnNode
+    short-circuit attribution; the aggregate GeneralPredicates row never
+    attributes — its constituents do); the last column (REASON_EXTENDER)
+    counts nodes every predicate passed but the extra mask vetoed
+    (extender filter / tensor Filter plugin / nominated-pod block).
+    Evaluated at the pod's OWN scan step, so in-batch commits (resources,
+    ports, affinity) are reflected exactly as selectHost saw them.
+
+    top_nodes/top_scores: the k best-scoring feasible node rows for the
+    pod (-1 where fewer than k are feasible); top_components: the
+    weighted per-plugin score addends of those rows on the
+    schema.SCORE_COMPONENTS axis (PRIORITY_ORDER + "Extra")."""
+
+    reason_counts: Any   # i32[B, NUM_REASONS]
+    top_nodes: Any       # i32[B, TK]
+    top_scores: Any      # f32[B, TK]
+    top_components: Any  # f32[B, TK, NUM_SCORE_COMPONENTS]
 
 
 @dataclass
@@ -472,12 +499,21 @@ def make_sequential_scheduler(
     score_cfg: Optional[ScoreConfig] = None,
     percentage_of_nodes_to_score: int = 100,
     donate_cluster: bool = False,
+    attribution: bool = False,
+    attribution_topk: int = 3,
 ):
     """Build (or fetch the memoized) jitted sequential-commit scheduler.
 
     Returns fn(cluster, pods, ports: BatchPortState, last_index0) ->
       (hosts i32[B] (-1 = unschedulable), new_cluster) where new_cluster has
       the committed requested/nonzero columns.
+
+    With attribution=True (a STATIC flag: a separate executable, the
+    default one unchanged) the launch additionally returns an Attribution
+    pytree — per-pod first-failing-predicate node counts plus a top-k
+    per-plugin score breakdown — computed inside the same scan against
+    the exact per-step state, so winners are bit-identical either way
+    (pinned by tests/test_ledger.py).
 
     Buffer donation (accelerator backends only; XLA:CPU has no donation):
     the PER-BATCH argument buffers — pods/ports/nominated/extra mask+score/
@@ -501,6 +537,8 @@ def make_sequential_scheduler(
         score_cfg,
         percentage_of_nodes_to_score,
         donate_cluster and donate_batch,
+        attribution,
+        attribution_topk,
     )
     hit = _SEQ_CACHE.get(key)
     if hit is not None:
@@ -591,6 +629,21 @@ def make_sequential_scheduler(
             )
         if extra_score is not None:
             static_score = static_score + extra_score
+        if attribution:
+            # per-plugin attribution inputs (static flag: the default
+            # executable never materializes these): the per-predicate
+            # stack (already computed above) and the weighted static
+            # score components — threaded through the scan so the
+            # per-step slices see the SAME state the placement math does
+            from kubernetes_tpu.ops.priorities import static_score_components
+
+            comp_static = static_score_components(
+                cluster, pods, w, score_cfg,
+                include_ipa=(aff_state is None), extra_score=extra_score,
+            )
+            tk = min(attribution_topk, cluster.n_nodes)
+        else:
+            comp_static = None
         feas_limit = (
             num_feasible_nodes_device(
                 jnp.sum(cluster.valid.astype(jnp.int32)),
@@ -626,7 +679,7 @@ def make_sequential_scheduler(
             (requested, nonzero2, spread_extra, port_used, last_idx,
              extra_aff, extra_anti, extra_forb, extra_pref) = state
             (smask, sscore, req, nz2, spread_base, pprio, pport, step_no,
-             aff_xs) = xs
+             aff_xs, attr_xs) = xs
             # dynamic resource fit (PodFitsResources on current state)
             fit = ~jnp.any(
                 (req[None, :] > 0)
@@ -704,7 +757,67 @@ def make_sequential_scheduler(
                     jnp.floor(MAX_PRIORITY * (raw - mn) / spread_r),
                     0.0,
                 )
-                total = total + w_ipa * jnp.where(cluster.valid, ipa, 0.0)
+                ipa_term = w_ipa * jnp.where(cluster.valid, ipa, 0.0)
+                total = total + ipa_term
+            if attribution:
+                pp_j, comp_j = attr_xs
+                # re-point the dynamic predicates at their IN-SCAN
+                # verdicts so the first-failure attribution matches what
+                # the placement mask actually saw at this step
+                ports_ok = pp_j[ports_idx] & ~port_bad
+                rows = pp_j.at[res_idx].set(fit)
+                rows = rows.at[ports_idx].set(ports_ok)
+                # the aggregate row never attributes — its constituents
+                # (host/ports/selector/resources) name the precise reason
+                rows = rows.at[gen_idx].set(True)
+                if aff_state is not None:
+                    rows = rows.at[
+                        PRED_INDEX["MatchInterPodAffinity"]
+                    ].set(aff_ok & ~viol1 & ~viol2)
+                failed = ~rows                                  # [K, N]
+                ff = jnp.argmax(failed, axis=0)
+                any_fail = jnp.any(failed, axis=0)
+                rejected = ~mask & cluster.valid
+                reason = jnp.where(
+                    rejected,
+                    jnp.where(any_fail, ff, REASON_EXTENDER),
+                    NUM_REASONS,            # feasible (never counted)
+                )
+                counts = jnp.sum(
+                    reason[:, None] == jnp.arange(NUM_REASONS)[None, :],
+                    axis=0, dtype=jnp.int32,
+                )                                               # [NUM_REASONS]
+                comp_full = comp_j                              # [C, N]
+                comp_full = comp_full.at[
+                    PRIO_INDEX["LeastRequestedPriority"]].set(w_least * least)
+                comp_full = comp_full.at[
+                    PRIO_INDEX["MostRequestedPriority"]].set(w_most * most)
+                comp_full = comp_full.at[
+                    PRIO_INDEX["BalancedResourceAllocation"]].set(
+                        w_bal * balanced)
+                comp_full = comp_full.at[
+                    PRIO_INDEX["SelectorSpreadPriority"]].set(
+                        w_spread * spread)
+                comp_full = comp_full.at[
+                    PRIO_INDEX["RequestedToCapacityRatioPriority"]].set(
+                        w_rtc * rtc)
+                if aff_state is not None:
+                    comp_full = comp_full.at[
+                        PRIO_INDEX["InterPodAffinityPriority"]].set(ipa_term)
+                neg = jnp.float32(-3.4e38)
+                top_vals, top_idx = jax.lax.top_k(
+                    jnp.where(mask, total, neg), tk
+                )
+                top_comp = jnp.transpose(comp_full[:, top_idx])  # [TK, C]
+                attr_out = (
+                    counts,
+                    jnp.where(top_vals > neg / 2, top_idx, -1).astype(
+                        jnp.int32),
+                    top_vals,
+                    top_comp,
+                )
+            else:
+                attr_out = None
             if percentage_of_nodes_to_score < 100:  # 0 = adaptive
                 # adaptive node sampling (numFeasibleNodesToFind) with the
                 # reference's rotating start offset
@@ -767,7 +880,7 @@ def make_sequential_scheduler(
             return (
                 (requested, nonzero2, spread_extra, port_used, last_idx + 1,
                  extra_aff, extra_anti, extra_forb, extra_pref),
-                out_host,
+                (out_host, attr_out),
             )
 
         PV = ports.pod_ports.shape[1]
@@ -822,8 +935,13 @@ def make_sequential_scheduler(
             ports.pod_ports,
             jnp.arange(B, dtype=jnp.int32),
             aff_xs_in,
+            # extra-mask vetoes need no tensor here: a node rejected with
+            # every predicate passing can ONLY be an extra-mask veto
+            (per_pred, comp_static) if attribution else None,
         )
-        (requested, nonzero2, *_), hosts = jax.lax.scan(step, init, xs)
+        (requested, nonzero2, *_), (hosts, attr_ys) = jax.lax.scan(
+            step, init, xs
+        )
         import dataclasses as _dc
 
         new_cluster = _dc.replace(
@@ -831,6 +949,8 @@ def make_sequential_scheduler(
             requested=requested,
             nonzero_req=nonzero2,
         )
+        if attribution:
+            return hosts, new_cluster, Attribution(*attr_ys)
         return hosts, new_cluster
 
     # donation (see the maker docstring): batch buffers always on
@@ -874,6 +994,9 @@ def make_sequential_scheduler(
     # strictly sequential one-at-a-time commit order (models/gang.py's
     # cross-gang required-affinity drop guard) assert on this
     schedule_entry.engine_kind = "sequential"
+    # attribution variants return (hosts, new_cluster, Attribution);
+    # callers handling either arity key off this
+    schedule_entry.attribution = attribution
 
     _SEQ_CACHE[key] = schedule_entry
     while len(_SEQ_CACHE) > _SEQ_CACHE_CAP:

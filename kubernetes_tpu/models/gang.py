@@ -92,10 +92,13 @@ class GangScheduler:
             batch = enc.encode_pods(pods)
             ports = encode_batch_ports(enc, pods)
             cluster, _ = sched.cache.snapshot()
-        hosts, _new_state = sched._schedule_fn(
+        # index instead of unpack: the attribution variant returns a
+        # third output (Attribution) the gang verdict doesn't consume
+        out = sched._schedule_fn(
             cluster, batch, ports, np.int32(sched._last_index), None, None,
             None, aff_state,
         )
+        hosts = out[0]
         sched._last_index += len(pods)
         # gang launches are synchronous by design (the all-or-nothing
         # verdict gates the commit), but the fetch still goes through the

@@ -434,3 +434,45 @@ def score_batch(cluster: ClusterTensors, pods: PodBatch, weights=None,
     w = jnp.asarray(w_host, jnp.float32)
     total = jnp.einsum("bpn,p->bn", stack, w)
     return total, stack
+
+
+def static_score_components(cluster: ClusterTensors, pods: PodBatch,
+                            weights, score_cfg, include_ipa: bool = True,
+                            extra_score=None):
+    """f32[B, C, N] WEIGHTED static score addends on the attribution
+    component axis (schema.SCORE_COMPONENTS = PRIORITY_ORDER + "Extra").
+
+    The state-dependent priorities (least/most/balanced/spread/RTC — and
+    InterPodAffinity when the in-batch scan owns it) stay zero here; the
+    sequential-commit scan fills them per step against the current
+    committed state, so the per-plugin breakdown sums to the exact score
+    selectHost saw.  Only built under the engines' attribution flag — the
+    default executable never materializes the stack."""
+    from kubernetes_tpu.codec.schema import NUM_SCORE_COMPONENTS
+
+    w = np.asarray(weights, np.float32)
+    B, N = pods.n_pods, cluster.n_nodes
+    comp = jnp.zeros((B, NUM_SCORE_COMPONENTS, N), jnp.float32)
+
+    def put(name, fn):
+        w_i = float(w[PRIO_INDEX[name]])
+        if w_i != 0.0:
+            return comp.at[:, PRIO_INDEX[name]].set(w_i * fn())
+        return comp
+
+    comp = put("NodePreferAvoidPodsPriority",
+               lambda: node_prefer_avoid_pods(cluster, pods))
+    comp = put("NodeAffinityPriority", lambda: node_affinity(cluster, pods))
+    comp = put("TaintTolerationPriority",
+               lambda: taint_toleration(cluster, pods))
+    comp = put("ImageLocalityPriority", lambda: image_locality(cluster, pods))
+    comp = put("NodeLabelPriority",
+               lambda: node_label_priority(cluster, pods, score_cfg))
+    comp = put("ResourceLimitsPriority",
+               lambda: resource_limits(cluster, pods))
+    if include_ipa:
+        comp = put("InterPodAffinityPriority",
+                   lambda: inter_pod_affinity_score(cluster, pods))
+    if extra_score is not None:
+        comp = comp.at[:, NUM_SCORE_COMPONENTS - 1].set(extra_score)
+    return comp

@@ -141,10 +141,16 @@ class FlightRecorder:
 
     # --------------------------------------------------------------- export
 
-    def chrome_trace(self) -> dict:
+    def chrome_trace(self, limit: Optional[int] = None) -> dict:
         """The ring as Chrome trace-event JSON, with one instant event
-        per recorded postmortem so anomalies show up ON the timeline."""
-        out = chrome_trace(self.spans())
+        per recorded postmortem so anomalies show up ON the timeline.
+        `limit` keeps only the NEWEST n cycle spans (the /debug/traces
+        ?limit=N query; the handlers also halve it until the body fits
+        the hard response-size cap)."""
+        spans = self.spans()
+        if limit is not None and limit >= 0:
+            spans = spans[-limit:] if limit else []
+        out = chrome_trace(spans)
         with self._lock:
             pms = list(self._postmortems)
         for pm in pms:

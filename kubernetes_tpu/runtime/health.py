@@ -25,6 +25,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.codec.faults import FAULT_PERSISTENT
+from kubernetes_tpu.runtime.ledger import debug_body
 from kubernetes_tpu.utils import metrics as m
 
 # breaker states (classic Nygard circuit-breaker vocabulary)
@@ -195,21 +196,35 @@ class HealthServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
+                path, _, query = self.path.partition("?")
+                if path == "/healthz":
                     if outer._healthy():
                         self._send(b"ok")
                     else:
                         self._send(b"unhealthy", 500)
-                elif self.path == "/metrics":
+                elif path == "/metrics":
                     self._send(
                         outer._registry.expose().encode(),
                         ct="text/plain; version=0.0.4",
                     )
-                elif self.path == "/debug/traces":
-                    import json
+                elif path == "/debug/traces":
+                    self._send(
+                        debug_body(outer._traces, query),
+                        ct="application/json",
+                    )
+                elif path == "/debug/decisions":
+                    # recent decision-ledger entries (per-pod winners +
+                    # dominant-rejection explanations), cross-linked to
+                    # /debug/traces by trace id
+                    from kubernetes_tpu.runtime.ledger import get_default
 
                     self._send(
-                        json.dumps(outer._traces()).encode(),
+                        debug_body(
+                            lambda lim: {
+                                "decisions": get_default().decisions(lim)
+                            },
+                            query,
+                        ),
                         ct="application/json",
                     )
                 else:

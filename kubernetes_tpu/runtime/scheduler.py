@@ -169,6 +169,26 @@ class SchedulerConfig:
     # the express width (Scheduler.prewarm) instead of stalling the first
     # cycle at each new width mid-traffic
     prewarm_widths: bool = False
+    # --- decision ledger + attribution (ISSUE 7) ---
+    # per-plugin attribution: the engine launch ALSO emits per-pod
+    # first-failing-predicate node counts and a top-k per-plugin score
+    # breakdown (models/batched.py Attribution — a separate executable
+    # behind a static flag; placements stay bit-identical).  Forces the
+    # sequential engine (the scan owns the per-step state the attribution
+    # is computed against); FailedScheduling events and the
+    # kubernetes-tpu.io/unschedulable-reason annotation then name the
+    # dominant failing predicate with per-reason node counts.
+    attribution: bool = False
+    # decision ledger (runtime/ledger.py): record every cycle's inputs
+    # (snapshot delta, encoded batch, rotation base) and outcomes
+    # (winners, engine, tier, faults) off the hot path, replayable via
+    # Scheduler.replay_cycle / bench.py --replay.  ledger_dir=None keeps
+    # the in-memory /debug/decisions ring without touching disk.
+    decision_ledger: bool = False
+    ledger_dir: Optional[str] = None
+    # bounded append-only file: recording stops (and counts drops) after
+    # this many cycles
+    ledger_max_cycles: int = 4096
     # multi-scheduler: only pods whose spec.schedulerName names THIS
     # scheduler enter its queue (eventhandlers.go responsibleForPod)
     scheduler_name: str = "default-scheduler"
@@ -214,6 +234,10 @@ class SchedulerConfig:
             ),
             compile_cache_dir=getattr(cc, "compile_cache_dir", None),
             prewarm_widths=getattr(cc, "prewarm_widths", False),
+            attribution=getattr(cc, "attribution", False),
+            decision_ledger=getattr(cc, "decision_ledger", False),
+            ledger_dir=getattr(cc, "ledger_dir", None),
+            ledger_max_cycles=getattr(cc, "ledger_max_cycles", 4096),
         )
 
 
@@ -264,6 +288,13 @@ class _InFlight:
     last_index0: int = 0         # selectHost rotation base for this batch
     tier: str = TIER_BULK        # latency tier this cycle serves: labels
     #                              the phase/e2e metrics and the span
+    # --- attribution + decision ledger (ISSUE 7) ---
+    attrib_dev: object = None    # device Attribution pytree (attribution
+    #                              launches only; None when off/degraded)
+    attrib: object = None        # host-materialized Attribution (set at
+    #                              the commit fence)
+    ledger_inputs: Optional[dict] = None  # the cycle's encode-time launch
+    #                              inputs, stashed for the ledger record
 
 
 class _HostResult:
@@ -315,6 +346,9 @@ class Scheduler:
         extenders: Optional[Sequence] = None,  # extender.client.HTTPExtender
         flight_recorder: Optional[FlightRecorder] = None,  # None = the
         #                       process-wide ring (flightrecorder.RECORDER)
+        ledger=None,  # runtime/ledger.DecisionLedger; None = built from
+        #               config.decision_ledger (and installed as the
+        #               process default serving /debug/decisions)
     ):
         # NB: PriorityQueue defines __len__, so `queue or PriorityQueue()`
         # would silently replace an *empty* caller-owned queue
@@ -357,7 +391,16 @@ class Scheduler:
             score_cfg=prof.score_config if prof is not None else None,
             percentage_of_nodes_to_score=self.config.percentage_of_nodes_to_score,
         )
-        self._schedule_fn = make_sequential_scheduler(**engine_kw)
+        # attribution rides the sequential engine: the scan owns the
+        # per-step state (resources/ports/affinity as committed so far)
+        # the first-failure attribution is computed against.  The flag
+        # itself is output-only (sequential winners are bit-identical
+        # with it on or off, pinned by test); note that selecting the
+        # sequential engine is itself semantics-preserving but can
+        # rotate argmax TIES differently than the speculative engine.
+        self._schedule_fn = make_sequential_scheduler(
+            **engine_kw, attribution=self.config.attribution
+        )
         self._preempt_eval = make_preempt_eval(
             self.config.filter_config, self._unsched_key
         )
@@ -366,7 +409,7 @@ class Scheduler:
         from kubernetes_tpu.codec.transfer import DeviceSnapshotCache
 
         self._dev_snapshot = DeviceSnapshotCache()
-        if self.config.engine == "speculative":
+        if self.config.engine == "speculative" and not self.config.attribution:
             from kubernetes_tpu.models.speculative import (
                 make_speculative_scheduler,
             )
@@ -374,6 +417,12 @@ class Scheduler:
             self._speculative_fn = make_speculative_scheduler(**engine_kw)
         else:
             self._speculative_fn = None
+        # the engine that ACTUALLY serves device cycles (attribution
+        # forces sequential whatever config.engine says): spans, ledger
+        # records, and the replay header must all agree on this
+        self._engine_kind = (
+            "sequential" if self._speculative_fn is None else "speculative"
+        )
         self.framework = framework
         # scheduler-side extender chain (core/extender.go; chained in config
         # order at generic_scheduler.go:527-554); built from the Policy's
@@ -444,9 +493,47 @@ class Scheduler:
         self.flight_recorder = (
             flight_recorder if flight_recorder is not None else RECORDER
         )
+        # decision ledger (ISSUE 7): opt-in per-cycle record + the
+        # /debug/decisions ring.  A config-built ledger installs itself
+        # as the process default (the RECORDER pattern) so the debug
+        # endpoints serve it without extra wiring.
+        self.ledger = ledger
+        if self.ledger is None and self.config.decision_ledger:
+            import os
+
+            from kubernetes_tpu.runtime import ledger as ledger_mod
+
+            path = None
+            if self.config.ledger_dir:
+                os.makedirs(self.config.ledger_dir, exist_ok=True)
+                path = os.path.join(
+                    self.config.ledger_dir, "decisions.ledger"
+                )
+            self.ledger = ledger_mod.DecisionLedger(
+                path=path, max_cycles=self.config.ledger_max_cycles
+            )
+            ledger_mod.set_default(self.ledger)
+        if self.ledger is not None:
+            self.ledger.ensure_meta(self._engine_meta())
         self.results: List[ScheduleResult] = []
         # (preemptor key, node name, victim keys) per successful preemption
         self.preemptions: List[Tuple[Tuple[str, str], str, List[Tuple[str, str]]]] = []
+
+    def _engine_meta(self) -> dict:
+        """The ledger header: everything a fresh process needs to rebuild
+        a bit-identical engine for replay (runtime/ledger.build_replay_fn)."""
+        from kubernetes_tpu.runtime.ledger import engine_meta
+
+        prof = self.config.profile
+        return engine_meta(
+            self.config.filter_config,
+            self.config.weights,
+            self._unsched_key,
+            self.cache.encoder.getzone_key,
+            prof.score_config if prof is not None else None,
+            self.config.percentage_of_nodes_to_score,
+            self._engine_kind,
+        )
 
     # ------------------------------------------------------------- one cycle
 
@@ -642,6 +729,9 @@ class Scheduler:
         handle for a host-computed result and mark the cycle degraded."""
         inf.fetch = inf.cpu_fetch()
         inf.degraded = True
+        # the CPU engine carries no attribution, and the device pytree
+        # may belong to the failed launch
+        inf.attrib_dev = None
         # overwrite the dispatch-time attrs: the placements this cycle
         # commits came from the CPU engine, whatever was launched first
         inf.trace.annotate(degraded=True, engine="cpu")
@@ -684,7 +774,7 @@ class Scheduler:
         while True:
             try:
                 if relaunch_pending:
-                    inf.hosts_dev, inf.fetch = inf.relaunch()
+                    inf.hosts_dev, inf.fetch, inf.attrib_dev = inf.relaunch()
                     relaunch_pending = False
                 staged = self._commit_state(inf)
             except BaseException as e:
@@ -850,18 +940,23 @@ class Scheduler:
             dev_cluster = self._dev_snapshot.update(
                 cluster, dirty_rows=dirty_rows
             )
-            hosts, _ = fn(
+            out = fn(
                 dev_cluster, batch, ports,
                 np.int32(last_index0), nominated,
                 extra_mask, extra_score, aff_state,
             )
+            hosts = out[0]
+            # attribution launches also return the Attribution pytree
+            # (reason counts + top-k breakdown); materialized at the
+            # commit fence, after the winners land
+            attrib = out[2] if len(out) > 2 else None
             # async result path: only the compact winners buffer (i32[B]
             # node rows) crosses the wire — the D2H copy is enqueued NOW
             # and materializes on a worker thread, so the blocking fence in
             # _commit_state is usually a no-op by the time the pipelined
             # loop reaches it (batch k's fetch overlaps batch k's host tail
             # and batch k+1's dispatch)
-            return hosts, AsyncFetch(hosts)
+            return hosts, AsyncFetch(hosts), attrib
 
         def cpu_fetch():
             """Winners for THIS batch from the CPU reference engine, in the
@@ -881,7 +976,7 @@ class Scheduler:
             return _HostResult(hosts, seconds=time.monotonic() - t0)
 
         degraded = False
-        hosts_dev = None
+        hosts_dev = attrib_dev = None
         disp_span = trace.child("dispatch")
         if use_device:
             launched = self._launch_resilient(launch)
@@ -898,7 +993,7 @@ class Scheduler:
             )
             fetch = cpu_fetch()
         else:
-            hosts_dev, fetch = launched
+            hosts_dev, fetch, attrib_dev = launched
         self._last_index += len(pods)
         disp_span.finish()
         trace.annotate(
@@ -906,17 +1001,29 @@ class Scheduler:
             dirty_rows=len(dirty_rows) if dirty_rows is not None else -1,
             breaker=self.device_health.state,
             degraded=degraded,
-            engine="cpu" if degraded else self.config.engine,
+            engine="cpu" if degraded else self._engine_kind,
         )
         self._phase("dispatch", time.monotonic() - t_disp, tier)
-        return _InFlight(
+        inf = _InFlight(
             pods=list(pods), hosts_dev=hosts_dev, fetch=fetch,
             generation=generation, cycle=cycle, ext_failed=ext_failed,
             pc=pc, t_cycle0=t_cycle0, trace=trace,
             relaunch=None if degraded else launch,
             cpu_fetch=cpu_fetch, degraded=degraded,
-            last_index0=last_index0, tier=tier,
+            last_index0=last_index0, tier=tier, attrib_dev=attrib_dev,
         )
+        if self.ledger is not None:
+            # the exact launch inputs, stashed for the off-hot-path
+            # ledger write after the commit tail (the snapshot arrays are
+            # immutable by the encoder's dirty-row contract, so handing
+            # references to the writer thread is safe)
+            inf.ledger_inputs = dict(
+                cluster=cluster, batch=batch, ports=ports,
+                nominated=nominated, aff_state=aff_state,
+                extra_mask=extra_mask, extra_score=extra_score,
+                last_index0=last_index0,
+            )
+        return inf
 
     def _launch_resilient(self, launch):
         """Run a device launch under the classified retry/backoff policy.
@@ -990,6 +1097,15 @@ class Scheduler:
         hosts = inf.fetch.result()  # ready-fence: blocks only if the async
         #                             D2H copy hasn't landed yet
         hosts = self._validate_hosts(hosts, len(pods))
+        if inf.attrib_dev is not None:
+            # attribution rides the same launch: by the time the winners
+            # landed the rest of the outputs are computed, so this fetch
+            # costs one extra D2H copy, not a second device round-trip.
+            # Inside the resilient fence on purpose — a fault here
+            # retries/degrades exactly like a winners-fetch fault.
+            inf.attrib = type(inf.attrib_dev)(
+                *(np.asarray(x) for x in inf.attrib_dev)
+            )
         t_state0 = time.monotonic()
         # "fetch" records the ASYNC window (dispatch -> copy-complete,
         # measured on the fetch worker): it overlaps the dispatch/commit
@@ -1075,9 +1191,104 @@ class Scheduler:
         if self.config.trace_threshold_s > 0:
             inf.trace.log_if_long(self.config.trace_threshold_s)
         self.flight_recorder.record(inf.trace)
+        if self.ledger is not None and inf.ledger_inputs is not None:
+            self._ledger_record(inf, staged, results)
         m.PENDING_PODS.set(float(len(self.queue)))
         self.results.extend(results)
         return results
+
+    def _ledger_record(self, inf: _InFlight, staged: _Staged,
+                       results: List[ScheduleResult]) -> None:
+        """Submit this cycle to the decision ledger: the stashed launch
+        inputs (snapshot delta computed on the writer thread), the
+        outcome facts, and the per-pod decision summaries the
+        /debug/decisions ring serves (cross-linked by trace id)."""
+        pods = inf.pods
+        attrs = inf.trace.attrs
+        decisions: List[dict] = []
+        for i, pod in enumerate(pods):
+            r = results[i] if i < len(results) else None
+            node = r.node if r is not None else None
+            d: dict = {"pod": f"{pod.namespace}/{pod.name}", "node": node}
+            if node is None and inf.attrib is not None:
+                from kubernetes_tpu.runtime.ledger import (
+                    explain_unschedulable,
+                )
+
+                dominant, msg = explain_unschedulable(
+                    inf.attrib.reason_counts[i]
+                )
+                if dominant:
+                    d["reason"] = dominant
+                    d["detail"] = msg
+            decisions.append(d)
+        outcome = {
+            "cycle": inf.cycle,
+            "tier": inf.tier,
+            "engine": "cpu" if inf.degraded else self._engine_kind,
+            "degraded": inf.degraded,
+            "fault_class": attrs.get("fault_class"),
+            "fault_attempts": int(attrs.get("fault_attempts", 0)),
+            "trace_id": inf.trace.trace_id,
+            "n_pods": len(pods),
+            "pods": [[p.namespace, p.name] for p in pods],
+            "winners": np.asarray(staged.hosts[: len(pods)], np.int32),
+            "time": time.time(),
+        }
+        self.ledger.record_cycle(inf.ledger_inputs, outcome, decisions)
+
+    def replay_cycle(self, rec: dict) -> np.ndarray:
+        """Re-execute one recorded cycle (a runtime/ledger.read_ledger
+        record) through THIS scheduler's engine against the record's
+        reconstructed snapshot, asserting bit-identical winners — the
+        substrate the offline weight-tuning loop (ROADMAP item 4)
+        re-scores against.  Offline: touches neither the cache, the
+        resident device snapshot, nor the rotation counter."""
+        from kubernetes_tpu.runtime.ledger import replay_record
+
+        fn = (
+            self._speculative_fn
+            if self._speculative_fn is not None
+            else self._schedule_fn
+        )
+        if rec.get("engine") == "cpu":
+            # a degraded cycle's winners carry the CPU reference
+            # engine's (= the sequential scan's) tie-rotation semantics
+            fn = self._schedule_fn
+        got = replay_record(fn, rec)
+        want = np.asarray(rec["winners"])[: int(rec["n_pods"])]
+        if not np.array_equal(got, want):
+            raise AssertionError(
+                f"replay mismatch at cycle {rec.get('cycle')}: "
+                f"recorded {want.tolist()} != replayed {got.tolist()}"
+            )
+        return got
+
+    # the dominant-failing-predicate explanation stamped onto an
+    # unschedulable pod (the kubectl-describe FitError parity surface)
+    UNSCHED_REASON_ANNOTATION = "kubernetes-tpu.io/unschedulable-reason"
+
+    def _unsched_message(self, inf: _InFlight, i: int, n_nodes: int,
+                         pod: Pod) -> str:
+        """FailedScheduling audit text for batch index i: with
+        attribution on, name the dominant failing predicate with
+        per-reason node counts ("0/5000 nodes are available: 4987
+        Insufficient resources, 13 node(s) had taints that the pod
+        didn't tolerate.") and stamp the unschedulable-reason annotation
+        + the per-plugin counter; else the classic count-only line."""
+        if inf.attrib is not None:
+            from kubernetes_tpu.runtime.ledger import explain_unschedulable
+
+            dominant, msg = explain_unschedulable(
+                inf.attrib.reason_counts[i]
+            )
+            if dominant:
+                pod.metadata.annotations[
+                    self.UNSCHED_REASON_ANNOTATION
+                ] = msg
+                m.UNSCHEDULABLE_REASONS.inc(plugin=dominant)
+                return msg
+        return "0/%d nodes are available" % n_nodes
 
     def _tail_perpod(self, staged: _Staged):
         """The classic per-pod commit loop (framework cycles, or
@@ -1118,7 +1329,9 @@ class Scheduler:
                 self.recorder.eventf(
                     "Pod", pod.namespace, pod.name,
                     EVENT_TYPE_WARNING, "FailedScheduling",
-                    "0/%d nodes are available", len(self.cache.encoder.node_rows),
+                    "%s", self._unsched_message(
+                        inf, i, len(self.cache.encoder.node_rows), pod
+                    ),
                     trace_id=inf.trace.trace_id,
                 )
                 continue
@@ -1174,7 +1387,7 @@ class Scheduler:
             events[i] = (
                 "Pod", pod.namespace, pod.name,
                 EVENT_TYPE_WARNING, "FailedScheduling",
-                "0/%d nodes are available" % n_nodes, tid,
+                self._unsched_message(inf, i, n_nodes, pod), tid,
             )
         for i, msg in inf.ext_failed.items():
             pod = pods[i]
@@ -1211,6 +1424,11 @@ class Scheduler:
                 bound.append((i, pod, node_name))
                 bound_qts.append(winner_qts[w])
                 bound_ts.append(tb)
+                # a pod that failed an earlier cycle may carry the
+                # unschedulable-reason annotation: stale once it binds
+                pod.metadata.annotations.pop(
+                    self.UNSCHED_REASON_ANNOTATION, None
+                )
                 results[i] = ScheduleResult(pod, node_name, generation)
                 events[i] = (
                     "Pod", pod.namespace, pod.name,
@@ -1369,6 +1587,9 @@ class Scheduler:
         qt = self.queue.take_enqueue_time(pod)
         if qt is not None:
             e2e = time.monotonic() - qt
+        # a FitError retry that now succeeded: the explain annotation a
+        # previous cycle stamped is stale the moment the pod binds
+        pod.metadata.annotations.pop(self.UNSCHED_REASON_ANNOTATION, None)
         klog.V(2).infof(
             "scheduled %s/%s to %s (%.1fms e2e)",
             pod.namespace, pod.name, node_name, e2e * 1000,
@@ -1867,10 +2088,12 @@ class Scheduler:
             extra_score = (
                 np.zeros((B, N), np.float32) if want_score else None
             )
-            hosts, _ = fn(
+            # index instead of unpack: the attribution variant returns a
+            # third output this warm launch discards
+            hosts = fn(
                 dev_cluster, batch, ports, np.int32(self._last_index),
                 None, extra_mask, extra_score, aff_state,
-            )
+            )[0]
             jax.block_until_ready(hosts)
             timings[w] = time.monotonic() - t0
             klog.V(1).infof(

@@ -402,5 +402,39 @@ CYCLE_PHASE_SECONDS = REGISTRY.register(
     )
 )
 
+# decision ledger + attribution (ISSUE 7): unschedulable verdicts by the
+# dominant failing plugin (fed from the engine's attribution launch), and
+# the ledger's own accounting — cycles accepted, bytes appended, records
+# dropped by the bounded writer queue / max-cycles cap
+UNSCHEDULABLE_REASONS = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_unschedulable_reasons_total",
+        "Unschedulable pods by dominant failing predicate/plugin "
+        "(attribution path; the per-reason node counts ride the "
+        "FailedScheduling event and the unschedulable-reason annotation)",
+        ("plugin",),
+    )
+)
+LEDGER_CYCLES = REGISTRY.register(
+    Counter(
+        "scheduler_ledger_cycles_total",
+        "Scheduling cycles accepted into the decision ledger "
+        "(ring and, when a ledger file is configured, the writer queue)",
+    )
+)
+LEDGER_BYTES = REGISTRY.register(
+    Counter(
+        "scheduler_ledger_bytes_total",
+        "Bytes appended to the decision-ledger file",
+    )
+)
+LEDGER_DROPPED = REGISTRY.register(
+    Counter(
+        "scheduler_ledger_dropped_total",
+        "Decision-ledger records dropped (writer queue full, max-cycles "
+        "cap reached, or a failed write)",
+    )
+)
+
 # schedule_attempts_total result label values (metrics.go:44-52)
 SCHEDULED, UNSCHEDULABLE, SCHEDULE_ERROR = "scheduled", "unschedulable", "error"

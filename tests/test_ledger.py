@@ -1,0 +1,492 @@
+"""Decision ledger + per-plugin attribution (ISSUE 7).
+
+Covers the four contracts the tentpole names:
+
+  * attribution-flag bit-identity: the sequential engine's winners are
+    unchanged by the attribution flag (it is output-only), and the
+    attribution itself names the right predicates with the right node
+    counts;
+  * unschedulable explain: FailedScheduling events and the
+    kubernetes-tpu.io/unschedulable-reason annotation name the dominant
+    failing predicate with per-reason node counts, and the
+    scheduler_unschedulable_reasons_total{plugin=} family moves;
+  * record -> replay determinism: live-recorded cycles (fault injection
+    included, both engines) replay through the recorded engine to
+    bit-identical winners, via runtime/ledger.replay AND
+    Scheduler.replay_cycle;
+  * bounded recording: the writer queue and the max-cycles cap drop
+    records without ever blocking a scheduling cycle, counted in
+    scheduler_ledger_dropped_total.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.codec import SnapshotEncoder
+from kubernetes_tpu.codec.schema import (
+    PRED_INDEX,
+    REASON_EXTENDER,
+    reason_name,
+)
+from kubernetes_tpu.codec.transfer import apply_snapshot_delta, snapshot_delta
+from kubernetes_tpu.models.batched import (
+    encode_batch_ports,
+    make_sequential_scheduler,
+)
+from kubernetes_tpu.runtime import ledger as ledger_mod
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.chaos import Disruptions
+from kubernetes_tpu.runtime.cluster import LocalCluster
+from kubernetes_tpu.runtime.health import start_health_server
+from kubernetes_tpu.runtime.ledger import (
+    DecisionLedger,
+    bounded_json,
+    explain_unschedulable,
+    read_ledger,
+    replay,
+)
+from kubernetes_tpu.runtime.queue import PodBackoff, PriorityQueue
+from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.utils import metrics as m
+
+from fixtures import make_node, make_pod
+
+ZONE = "failure-domain.beta.kubernetes.io/zone"
+
+
+def _mini(tmp_path=None, engine="speculative", attribution=False,
+          ledger=None, n_nodes=6, **cfg_kw):
+    cache = SchedulerCache()
+    queue = PriorityQueue(backoff=PodBackoff(initial=0.01, max_duration=0.05))
+    cfg = SchedulerConfig(
+        disable_preemption=True, engine=engine, attribution=attribution,
+        **cfg_kw,
+    )
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=lambda p, n: True, config=cfg,
+        ledger=ledger,
+    )
+    for i in range(n_nodes):
+        taints = (
+            [{"key": "ded", "value": "x", "effect": "NoSchedule"}]
+            if i < 2 else []
+        )
+        cache.add_node(make_node(
+            f"n{i}", cpu="4", mem="8Gi",
+            labels={ZONE: f"z-{i % 2}"}, taints=taints,
+        ))
+    return sched, cache, queue
+
+
+# ------------------------------------------------------ snapshot deltas
+
+
+def test_snapshot_delta_roundtrip_and_nan_safety():
+    enc = SnapshotEncoder()
+    for i in range(5):
+        enc.add_node(make_node(
+            f"n{i}", cpu="4", mem="8Gi",
+            # numeric label -> a real NaN-bearing label_nums column
+            labels={"rank": str(i), "tier": "a"},
+        ))
+    snap0 = enc.snapshot()
+    enc.add_pod(make_pod("p0", cpu="500m", node_name="n2"))
+    enc.add_node(make_node("n5", cpu="8", mem="16Gi"))
+    snap1 = enc.snapshot()
+
+    full = snapshot_delta(None, snap0)
+    rebuilt0 = apply_snapshot_delta(None, full, cls=type(snap0))
+    d01 = snapshot_delta(snap0, snap1)
+    rebuilt1 = apply_snapshot_delta(rebuilt0, d01)
+    import dataclasses
+
+    for f in dataclasses.fields(snap1):
+        a = np.asarray(getattr(snap1, f.name))
+        b = np.asarray(getattr(rebuilt1, f.name))
+        assert a.shape == b.shape and a.dtype == b.dtype, f.name
+        if a.dtype.kind == "f":
+            assert np.array_equal(a, b, equal_nan=True), f.name
+        else:
+            assert np.array_equal(a, b), f.name
+    # unchanged-field identity means NaN-bearing float fields don't
+    # balloon the delta: an untouched-row field records at most its
+    # dirty rows, never a spurious full diff
+    enc.add_pod(make_pod("p1", cpu="100m", node_name="n0"))
+    snap2 = enc.snapshot()
+    d12 = snapshot_delta(snap1, snap2)
+    assert "label_nums" not in d12  # node labels untouched by a pod add
+    mode, idx, _vals = d12["requested"]
+    assert mode == "rows" and list(idx) == [0]
+
+
+def test_first_ledger_record_must_be_full():
+    enc = SnapshotEncoder()
+    enc.add_node(make_node("n0", cpu="1", mem="1Gi"))
+    snap = enc.snapshot()
+    with pytest.raises(ValueError):
+        apply_snapshot_delta(
+            None, {"requested": ("full", snap.requested)}, cls=type(snap)
+        )
+
+
+# ------------------------------------------------- engine attribution
+
+
+def _engine_pair(enc):
+    key = enc.interner.intern("node.kubernetes.io/unschedulable")
+    kw = dict(unsched_taint_key=key, zone_key_id=enc.getzone_key)
+    return (
+        make_sequential_scheduler(**kw),
+        make_sequential_scheduler(**kw, attribution=True),
+    )
+
+
+def test_attribution_flag_bit_identity_and_reason_counts():
+    enc = SnapshotEncoder()
+    for i in range(8):
+        taints = (
+            [{"key": "ded", "value": "x", "effect": "NoSchedule"}]
+            if i < 3 else []
+        )
+        enc.add_node(make_node(f"n{i}", cpu="4", mem="8Gi", taints=taints))
+    pods = [make_pod("fits", cpu="100m"), make_pod("never", cpu="64")]
+    batch = enc.encode_pods(pods)
+    ports = encode_batch_ports(enc, pods)
+    cluster = enc.snapshot()
+    plain, attributed = _engine_pair(enc)
+    h0, _ = plain(cluster, batch, ports, np.int32(0))
+    h1, _, attr = attributed(cluster, batch, ports, np.int32(0))
+    assert np.array_equal(np.asarray(h0), np.asarray(h1)), (
+        "attribution flag changed the winners"
+    )
+    rc = np.asarray(attr.reason_counts)
+    # pod 0 fits: only the 3 tainted nodes reject it
+    assert rc[0, PRED_INDEX["PodToleratesNodeTaints"]] == 3
+    assert rc[0].sum() == 3
+    # pod 1 can't fit anywhere: resources first-fail on all 8 (the
+    # aggregate GeneralPredicates row must NOT swallow the attribution)
+    assert rc[1, PRED_INDEX["PodFitsResources"]] == 8
+    assert rc[1, PRED_INDEX["GeneralPredicates"]] == 0
+    # top-k: pod 0's winner leads its own breakdown and the per-plugin
+    # addends sum to the selected score
+    tn = np.asarray(attr.top_nodes)
+    ts = np.asarray(attr.top_scores)
+    tc = np.asarray(attr.top_components)
+    assert tn[0, 0] == int(np.asarray(h0)[0])
+    assert ts[0, 0] == pytest.approx(tc[0, 0].sum(), rel=1e-5)
+    assert (tn[1] == -1).all()  # nothing feasible -> no top-k rows
+
+
+def test_attribution_extra_mask_attributes_to_extender():
+    enc = SnapshotEncoder()
+    for i in range(4):
+        enc.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    pods = [make_pod("vetoed", cpu="100m")]
+    batch = enc.encode_pods(pods)
+    ports = encode_batch_ports(enc, pods)
+    cluster = enc.snapshot()
+    _, attributed = _engine_pair(enc)
+    extra = np.zeros((batch.n_pods, cluster.n_nodes), bool)  # veto all
+    hosts, _, attr = attributed(
+        cluster, batch, ports, np.int32(0), None, extra, None, None
+    )
+    assert int(np.asarray(hosts)[0]) == -1
+    rc = np.asarray(attr.reason_counts)[0]
+    assert rc[REASON_EXTENDER] == 4 and rc.sum() == 4
+    dominant, msg = explain_unschedulable(rc)
+    assert dominant == "ExtenderFilter"
+    assert "extender or plugin" in msg and msg.startswith("0/4 nodes")
+
+
+# ------------------------------------------------- unschedulable explain
+
+
+def test_unschedulable_event_annotation_and_metric():
+    sched, cache, queue = _mini(attribution=True, decision_ledger=True)
+    before = m.UNSCHEDULABLE_REASONS.value(plugin="PodFitsResources")
+    big = make_pod("big", cpu="64")
+    queue.add(big)
+    queue.add(make_pod("ok", cpu="100m"))
+    sched.run_once(timeout=0.3)
+    msgs = [
+        e.message for e in sched.recorder.events()
+        if e.reason == "FailedScheduling"
+    ]
+    assert len(msgs) == 1
+    # per-reason node counts, dominant first: 4 untainted nodes fail on
+    # resources, 2 tainted nodes fail on taints (taints come after
+    # resources in PREDICATE_ORDER... but tainted nodes ALSO lack cpu;
+    # resources first-fails everywhere)
+    assert "6 Insufficient resources" in msgs[0]
+    assert msgs[0].startswith("0/6 nodes are available: ")
+    ann = big.metadata.annotations[Scheduler.UNSCHED_REASON_ANNOTATION]
+    assert ann == msgs[0]
+    assert (
+        m.UNSCHEDULABLE_REASONS.value(plugin="PodFitsResources")
+        == before + 1
+    )
+    # the decisions ring carries the same explanation, trace-linked
+    entries = sched.ledger.decisions()
+    unsched = [
+        p for e in entries for p in e["pods"] if p["node"] is None
+    ]
+    assert unsched and unsched[0]["reason"] == "PodFitsResources"
+    assert all(e["trace_id"] for e in entries)
+
+
+def test_explain_names_dominant_taint_predicate():
+    # pods that FIT resource-wise: only the tainted nodes reject them
+    sched, cache, queue = _mini(attribution=True)
+    # consume nothing; make a pod that fits everywhere but is repelled
+    # by the 2 tainted nodes AND pinned to one of them by nodeName
+    pinned = make_pod("pinned", cpu="100m", node_name="n0")
+    queue.add(pinned)
+    sched.run_once(timeout=0.3)
+    ann = pinned.metadata.annotations[Scheduler.UNSCHED_REASON_ANNOTATION]
+    # 5 nodes fail the hostname pin (PodFitsHost), the pinned node n0
+    # fails its taint
+    assert "5 node(s) didn't match the requested hostname" in ann
+    assert "1 node(s) had taints that the pod didn't tolerate" in ann
+
+
+# --------------------------------------------------- record -> replay
+
+
+def _run_workload(sched, queue, n=10):
+    for i in range(n):
+        queue.add(make_pod(
+            f"w-{i}", cpu="200m", mem="128Mi",
+            labels={"app": f"d-{i % 3}"},
+        ))
+    deadline = time.monotonic() + 30
+    while queue.has_schedulable() and time.monotonic() < deadline:
+        sched.run_once(timeout=0.05)
+    sched.flush_pipeline()
+
+
+@pytest.mark.parametrize("engine", ["speculative", "sequential"])
+def test_record_replay_bit_identity(tmp_path, engine):
+    path = str(tmp_path / "decisions.ledger")
+    ledger = DecisionLedger(path=path)
+    sched, cache, queue = _mini(engine=engine, ledger=ledger,
+                                batch_size=4)
+    queue.add(make_pod("never", cpu="64"))  # an unschedulable too
+    _run_workload(sched, queue, n=10)
+    assert ledger.flush(10)
+    header, recs = read_ledger(path)
+    assert header["engine"] == engine
+    assert len(recs) >= 2 and sum(r["n_pods"] for r in recs) >= 11
+    out = replay(path)
+    assert out["bit_identical"], out
+    assert out["engine"] == engine
+    # the in-process path agrees record by record
+    for rec in recs:
+        sched.replay_cycle(rec)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("engine", ["speculative", "sequential"])
+def test_record_replay_bit_identity_under_fault_injection(tmp_path, engine):
+    """Cycles recorded WHILE the device faults (transient retries, and a
+    breaker-tripping persistent fault whose batches the CPU engine
+    serves) replay to bit-identical winners once the faults clear: the
+    ledger records the inputs of the launch that COMMITTED, whatever the
+    recovery path was."""
+    path = str(tmp_path / "chaos.ledger")
+    ledger = DecisionLedger(path=path)
+    sched, cache, queue = _mini(
+        engine=engine, ledger=ledger, batch_size=4,
+        device_retry_max=2, breaker_failure_threshold=3,
+        breaker_open_s=0.02, cpu_fallback=True,
+    )
+    dis = Disruptions(LocalCluster())
+    try:
+        dis.device_transient(count=2)
+        _run_workload(sched, queue, n=6)
+        dis.clear_device_faults()
+        dis.device_lost(count=4)
+        _run_workload(sched, queue, n=6)
+    finally:
+        dis.clear_device_faults()
+    # let the breaker recover and schedule a clean tail
+    time.sleep(0.03)
+    _run_workload(sched, queue, n=4)
+    assert ledger.flush(10)
+    _, recs = read_ledger(path)
+    assert len(recs) >= 3
+    engines = {r["engine"] for r in recs}
+    out = replay(path)
+    assert out["bit_identical"], (engines, out)
+
+
+# ----------------------------------------------------------- bounds
+
+
+def test_ledger_max_cycles_cap_drops(tmp_path):
+    path = str(tmp_path / "capped.ledger")
+    ledger = DecisionLedger(path=path, max_cycles=2)
+    sched, cache, queue = _mini(ledger=ledger, batch_size=1)
+    for i in range(5):
+        queue.add(make_pod(f"p{i}", cpu="100m"))
+        sched.run_once(timeout=0.2)
+    assert ledger.flush(10)
+    assert ledger.dropped_total >= 3
+    _, recs = read_ledger(path)
+    assert len(recs) == 2
+    # the ring keeps serving recent decisions past the file cap
+    assert len(ledger.decisions()) == 5
+
+
+def test_ledger_queue_overflow_drops_without_blocking(tmp_path, monkeypatch):
+    path = str(tmp_path / "slow.ledger")
+    ledger = DecisionLedger(path=path, queue_capacity=2)
+    orig = ledger._serialize
+
+    def slow_serialize(inputs, outcome):
+        time.sleep(0.05)
+        return orig(inputs, outcome)
+
+    monkeypatch.setattr(ledger, "_serialize", slow_serialize)
+    sched, cache, queue = _mini(ledger=ledger, batch_size=1)
+    t0 = time.monotonic()
+    for i in range(10):
+        queue.add(make_pod(f"p{i}", cpu="100m"))
+        sched.run_once(timeout=0.2)
+    submit_wall = time.monotonic() - t0
+    assert ledger.flush(10)
+    assert ledger.dropped_total > 0, "queue never overflowed"
+    assert ledger.cycles_total == 10  # every cycle still ring-recorded
+    _, recs = read_ledger(path)
+    assert 0 < len(recs) < 10
+    # a full writer queue must never block the scheduling thread for
+    # the duration of a write (10 cycles << 10 * 50ms serialization)
+    assert submit_wall < 0.4, f"recording blocked the hot path: {submit_wall}s"
+    # dropped records force the next delta chain full, so the file
+    # still reconstructs and replays
+    assert replay(path)["bit_identical"]
+
+
+# --------------------------------------------------------- endpoints
+
+
+def test_debug_decisions_endpoints_limit_and_cap():
+    sched, cache, queue = _mini(attribution=True, decision_ledger=True)
+    for i in range(5):
+        queue.add(make_pod(f"p{i}", cpu="100m"))
+        sched.run_once(timeout=0.2)
+    srv = start_health_server()
+    try:
+        h, p = srv.address
+        with urllib.request.urlopen(
+            f"http://{h}:{p}/debug/decisions", timeout=5
+        ) as r:
+            assert r.headers.get("Content-Type") == "application/json"
+            body = json.loads(r.read())
+        assert len(body["decisions"]) == 5
+        for e in body["decisions"]:
+            assert e["trace_id"] and e["pods"]
+        with urllib.request.urlopen(
+            f"http://{h}:{p}/debug/decisions?limit=2", timeout=5
+        ) as r:
+            assert len(json.loads(r.read())["decisions"]) == 2
+        with urllib.request.urlopen(
+            f"http://{h}:{p}/debug/traces?limit=1", timeout=5
+        ) as r:
+            t = json.loads(r.read())
+        cycles = [
+            e for e in t["traceEvents"] if e["name"] == "schedule_cycle"
+        ]
+        assert len(cycles) == 1
+    finally:
+        srv.stop()
+    # apiserver twin, inflight-limiter exempt by being served at all
+    from kubernetes_tpu.apiserver import APIServer
+
+    srv = APIServer(cluster=LocalCluster()).start()
+    try:
+        with urllib.request.urlopen(
+            f"{srv.url}/debug/decisions?limit=3", timeout=5
+        ) as r:
+            assert len(json.loads(r.read())["decisions"]) == 3
+    finally:
+        srv.stop()
+
+
+def test_bounded_json_halves_to_fit_cap():
+    entries = [{"i": i, "pad": "x" * 100} for i in range(64)]
+
+    def render(lim):
+        return entries[-lim:] if lim is not None else entries
+
+    body = bounded_json(render, None, cap=1200)
+    assert len(body) <= 1200
+    assert 0 < len(json.loads(body)) < 64
+    # a single oversized entry degrades to the well-formed error stub
+    huge = bounded_json(lambda lim: [{"pad": "y" * 4096}], None, cap=128)
+    assert json.loads(huge)["truncated"] is True
+
+
+def test_decisions_cross_link_flight_recorder_trace_ids():
+    from kubernetes_tpu.runtime.flightrecorder import FlightRecorder
+
+    fr = FlightRecorder()
+    sched, cache, queue = _mini(decision_ledger=True)
+    sched.flight_recorder = fr
+    queue.add(make_pod("joined", cpu="100m"))
+    sched.run_once(timeout=0.2)
+    ring_ids = {s.trace_id for s in fr.spans()}
+    for e in sched.ledger.decisions():
+        assert e["trace_id"] in ring_ids
+
+
+def test_unschedulable_annotation_cleared_on_later_bind():
+    """A pod that failed (annotation stamped) and later binds must not
+    keep claiming it is unschedulable."""
+    # one node, tainted: the pod is rejected with a countable reason
+    sched, cache, queue = _mini(attribution=True, n_nodes=1)
+    pod = make_pod("later", cpu="100m")
+    queue.add(pod)
+    sched.run_once(timeout=0.2)  # taint rejects: unschedulable, stamped
+    assert Scheduler.UNSCHED_REASON_ANNOTATION in pod.metadata.annotations
+    cache.add_node(make_node("late-node", cpu="4", mem="8Gi"))
+    queue.move_all_to_active()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        sched.run_once(timeout=0.05)
+        if any(r.node for r in sched.results):
+            break
+    assert any(r.node for r in sched.results), "pod never bound"
+    assert Scheduler.UNSCHED_REASON_ANNOTATION not in pod.metadata.annotations
+
+
+def test_gang_and_prewarm_survive_attribution_engine():
+    """The attribution variant returns a third output; the gang launch
+    and prewarm consume the same _schedule_fn and must index, not
+    unpack (regression: ValueError 'too many values to unpack')."""
+    from kubernetes_tpu.runtime.flightrecorder import FlightRecorder
+
+    sched, cache, queue = _mini(attribution=True, n_nodes=4, batch_size=8)
+    sched.flight_recorder = FlightRecorder()  # isolate from the global ring
+    sched.prewarm(widths=[2])
+    g = {Scheduler.POD_GROUP_LABEL: "g1",
+         Scheduler.POD_GROUP_MIN_MEMBER: "2"}
+    for i in range(2):
+        queue.add(make_pod(f"g1-{i}", cpu="100m", labels=dict(g)))
+    queue.add(make_pod("plain", cpu="100m"))  # one plain cycle too
+    deadline = time.monotonic() + 10
+    placed = 0
+    while time.monotonic() < deadline and placed < 3:
+        placed += sched.run_once(timeout=0.05)
+    assert placed == 3, "gang failed to schedule under attribution"
+    # the spans and the ledger ring agree the sequential engine served
+    # the plain cycles (attribution forces it whatever config.engine is)
+    spans = [s for s in sched.flight_recorder.spans()
+             if s.name == "schedule_cycle"]
+    assert spans and all(
+        s.attrs.get("engine") in ("sequential", "cpu") for s in spans
+    ), [s.attrs.get("engine") for s in spans]

@@ -162,6 +162,9 @@ def test_metrics_exposition_valid_after_mixed_live_workload():
             disable_preemption=True,
             device_retry_max=0, breaker_failure_threshold=1,
             breaker_open_s=10.0, cpu_fallback=True,
+            # ISSUE 7 satellites: the attribution + ledger families must
+            # survive the strict parser with live values
+            attribution=True, decision_ledger=True,
         ),
     )
     cache.add_node(make_node("m1", cpu="4", mem="8Gi"))
@@ -206,6 +209,22 @@ def test_metrics_exposition_valid_after_mixed_live_workload():
     for phase in ("pop", "encode", "dispatch", "commit"):
         assert phase in phases, f"phase {phase} missing from /metrics"
     assert phases["encode"] > 0.0
+    # ISSUE 7 satellites: the unschedulable pod fed the per-plugin
+    # reasons family through the attribution path, and the ledger
+    # accounted its cycles (ring-only here — bytes/dropped expose as
+    # zero-valued counters, still strict-parser-visible)
+    reasons = {
+        lbl["plugin"]: v
+        for _, lbl, v in
+        families["scheduler_unschedulable_reasons_total"]["samples"]
+        if v > 0
+    }
+    assert "PodFitsResources" in reasons, reasons
+    ledger_cycles = families["scheduler_ledger_cycles_total"]["samples"]
+    assert ledger_cycles and ledger_cycles[0][2] > 0
+    for fam in ("scheduler_ledger_bytes_total",
+                "scheduler_ledger_dropped_total"):
+        assert families[fam]["type"] == "counter"
 
 
 def test_quantile_interpolates_within_bucket():

@@ -127,3 +127,112 @@ def _timed(fn):
     t0 = time.perf_counter()
     fn()
     return time.perf_counter() - t0
+
+
+@pytest.mark.perf_smoke
+def test_ledger_recording_overhead_under_2_percent(tmp_path):
+    """ISSUE 7 acceptance: decision-ledger recording must cost the
+    scheduling thread <2% of cycle cost.  record_cycle is an O(1) ring
+    append + non-blocking enqueue (serialization rides the writer
+    thread), so the time spent inside it across a live recorded run is
+    summed and ratioed against the run's wall clock — the honest
+    hot-path overhead, machine-speed independent."""
+    from kubernetes_tpu.runtime.ledger import DecisionLedger
+
+    enc = SnapshotEncoder()
+    enc.add_nodes(_nodes(200))
+    cache = SchedulerCache(enc)
+    queue = PriorityQueue()
+    ledger = DecisionLedger(path=str(tmp_path / "perf.ledger"))
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=lambda pod, node: True,
+        config=SchedulerConfig(
+            batch_size=128, batch_window_s=0.0, engine="speculative",
+            disable_preemption=True, batched_commit=True,
+        ),
+        ledger=ledger,
+    )
+    # wrap the WHOLE scheduler-side recording seam — per-pod decision
+    # summaries + outcome dict + the ledger submit — not just the final
+    # enqueue, so the pin measures everything recording adds per cycle
+    spent = [0.0]
+    orig = sched._ledger_record
+
+    def timed_record(*a, **kw):
+        t0 = time.perf_counter()
+        try:
+            return orig(*a, **kw)
+        finally:
+            spent[0] += time.perf_counter() - t0
+
+    sched._ledger_record = timed_record
+    # warmup compile outside the measured window
+    for j in range(128):
+        queue.add(make_pod(f"warm-{j}", cpu="50m", mem="64Mi"))
+    deadline = time.monotonic() + 120
+    while queue.has_schedulable() and time.monotonic() < deadline:
+        sched.run_once(timeout=0.0)
+    spent[0] = 0.0
+    for i in range(512):
+        queue.add(make_pod(f"p-{i}", cpu="50m", mem="64Mi",
+                           labels={"app": f"d-{i % 10}"}))
+    t0 = time.monotonic()
+    deadline = time.monotonic() + 120
+    while queue.has_schedulable() and time.monotonic() < deadline:
+        sched.run_once(timeout=0.0)
+    wall = time.monotonic() - t0
+    assert ledger.cycles_total >= 5
+    ratio = spent[0] / wall
+    assert ratio < 0.02, (
+        f"ledger submit cost {spent[0] * 1000:.2f}ms of {wall * 1000:.0f}ms "
+        f"({ratio * 100:.2f}%) — recording is leaking onto the hot path"
+    )
+    assert ledger.flush(30)
+
+
+@pytest.mark.perf_smoke
+def test_attribution_launch_overhead_bounded():
+    """The attribution variant recomputes nothing the default launch
+    didn't already have in flight — it adds reductions (first-failure
+    argmax, reason counts, top-k gather) over tensors the scan already
+    materializes.  On CPU those reductions are not free; this bounds
+    them at 2x the plain launch at smoke scale (on TPU they hide inside
+    the launch, and the default path is a DIFFERENT executable, pinned
+    bit-identical by tests/test_ledger.py)."""
+    from kubernetes_tpu.models.batched import (
+        encode_batch_ports,
+        make_sequential_scheduler,
+    )
+
+    enc = SnapshotEncoder()
+    enc.add_nodes(_nodes(200))
+    pods = [
+        make_pod(f"p-{i}", cpu="50m", mem="64Mi",
+                 labels={"app": f"d-{i % 10}"})
+        for i in range(128)
+    ]
+    batch = enc.encode_pods(pods)
+    ports = encode_batch_ports(enc, pods)
+    cluster = enc.snapshot()
+    key = enc.interner.intern("node.kubernetes.io/unschedulable")
+    import numpy as np
+
+    timings = {}
+    for flag in (False, True):
+        fn = make_sequential_scheduler(
+            unsched_taint_key=key, zone_key_id=enc.getzone_key,
+            attribution=flag,
+        )
+        out = fn(cluster, batch, ports, np.int32(0))  # compile
+        np.asarray(out[0])
+        best = min(
+            _timed(lambda: np.asarray(
+                fn(cluster, batch, ports, np.int32(0))[0]
+            ))
+            for _ in range(3)
+        )
+        timings[flag] = best
+    assert timings[True] < 2.0 * timings[False] + 0.01, (
+        f"attribution launch {timings[True] * 1000:.1f}ms vs plain "
+        f"{timings[False] * 1000:.1f}ms: reductions no longer fuse"
+    )
