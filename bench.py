@@ -491,6 +491,12 @@ def run(args) -> dict:
         detail["live_path"] = run_live(args, batched=True, pipeline=True)
     except Exception as e:  # noqa: BLE001 — the raw number still emits
         detail["live_path_error"] = f"{type(e).__name__}: {e}"
+    # ---- cluster_health stage (ISSUE 8), surfaced as its own detail
+    # stage: the fleet analytics + telemetry-overhead figures the live
+    # run just collected (CI asserts presence + sanity and uploads the
+    # /debug/cluster artifact next to the trace + ledger)
+    if "live_path" in detail and "cluster_health" in detail["live_path"]:
+        detail["cluster_health"] = detail["live_path"]["cluster_health"]
     # ---- latency-tier stage (ISSUE 6): per-tier p50/p99 in the default
     # artifact — express p99 under a saturating bulk load + the bulk
     # throughput it costs, ratioed against the live-path single-lane
@@ -609,6 +615,11 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
 
     for k in sched.phase_seconds:
         sched.phase_seconds[k] = 0.0
+    # telemetry-cost watermark: the cumulative counter minus this value
+    # is exactly what the hub cost the timed window below
+    from kubernetes_tpu.utils import metrics as _m_t
+
+    _tel0 = float(_m_t.TELEMETRY_SECONDS.value)
     total = args.pods
     # pod-object construction stays outside the timed window (the raw
     # stage and the reference's create strategy both exclude it); the
@@ -632,6 +643,29 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
         - sched.phase_seconds["fetch_block"]
         + t_enqueue
     )
+    # ---- cluster_health stage (ISSUE 8): the fleet-state analytics the
+    # live run's telemetry hub collected — utilization/fragmentation/
+    # imbalance/occupancy from the device-resident snapshot reduction,
+    # plus the hub's own hot-path cost ratioed against the run's wall
+    # clock (the <2% acceptance pin, measured on the bench shape itself)
+    cluster_health = None
+    if sched.telemetry is not None:
+        from kubernetes_tpu.utils import metrics as _m
+
+        tel_s = float(_m.TELEMETRY_SECONDS.value) - _tel0
+        summary = sched.telemetry.summary()
+        cluster_health = {
+            **(summary.get("analytics") or {}),
+            "samples": summary["samples"],
+            "pending": summary.get("pending"),
+            "slo": summary["slo"],
+            "hbm": summary["hbm"],
+            "compile": summary["compile"],
+            "telemetry_seconds": round(tel_s, 4),
+            "telemetry_overhead_ratio": (
+                round(tel_s / dt, 4) if dt > 0 else 0.0
+            ),
+        }
     ledger_stats = None
     if ledger is not None:
         ledger.flush(30.0)
@@ -653,6 +687,7 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
         "unschedulable": total - placed,
         "batched_commit": batched,
         "pipeline_commit": pipeline,
+        **({"cluster_health": cluster_health} if cluster_health else {}),
         **({"ledger": ledger_stats} if ledger_stats else {}),
         "commit_seconds": round(sched.phase_seconds["commit"], 3),
         "phases": {"enqueue": round(t_enqueue, 3),
@@ -1114,6 +1149,7 @@ def run_child(args) -> None:
             _emit(_error_line("run", e))
             return
         _write_trace_artifact(args)
+        _write_cluster_artifact(args)
         _emit(result)
     finally:
         if lock is not None:
@@ -1147,6 +1183,28 @@ def _write_trace_artifact(args) -> None:
         sys.stderr.write(f"bench: --trace-out failed: {e}\n")
 
 
+def _write_cluster_artifact(args) -> None:
+    """--cluster-out: dump the process-default telemetry hub's
+    /debug/cluster payload (the bounded analytics time series the
+    live-path Scheduler collected) as JSON.  Best-effort like the trace
+    artifact — a write failure must never eat the result line."""
+    path = getattr(args, "cluster_out", None)
+    if not path:
+        return
+    try:
+        from kubernetes_tpu.runtime.telemetry import get_default
+
+        payload = get_default().debug_payload()
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        sys.stderr.write(
+            f"bench: wrote {len(payload['samples'])} telemetry samples "
+            f"to {path}\n"
+        )
+    except Exception as e:  # noqa: BLE001
+        sys.stderr.write(f"bench: --cluster-out failed: {e}\n")
+
+
 def _last_json_line(text: str):
     for line in reversed(text.strip().splitlines()):
         line = line.strip()
@@ -1172,6 +1230,8 @@ def _child_cmd(args, platform: str | None) -> list:
         cmd += ["--trace-out", args.trace_out]
     if getattr(args, "ledger_out", None):
         cmd += ["--ledger-out", args.ledger_out]
+    if getattr(args, "cluster_out", None):
+        cmd += ["--cluster-out", args.cluster_out]
     if args.density:
         cmd += ["--density",
                 "--density-interval", str(args.density_interval),
@@ -1428,6 +1488,14 @@ def main():
         "(snapshot delta, encoded batch, rotation base) and winners, "
         "replayable with --replay.  In orchestrated mode the child that "
         "measured writes it, next to the --trace-out artifact",
+    )
+    ap.add_argument(
+        "--cluster-out", default=None,
+        help="write the run's cluster-telemetry time series (the "
+        "/debug/cluster payload: utilization/fragmentation/imbalance/"
+        "occupancy samples, HBM + compile facts, SLO burn rates) as "
+        "JSON here — the artifact CI uploads next to the Chrome trace "
+        "and the decision ledger",
     )
     ap.add_argument(
         "--replay", default=None, metavar="LEDGER",

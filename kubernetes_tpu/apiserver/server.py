@@ -922,6 +922,26 @@ class APIServer:
                         ct="application/json",
                     )
                     return
+                if self.path.partition("?")[0] == "/debug/cluster":
+                    # the telemetry hub's cluster-state time series
+                    # (utilization/fragmentation/HBM/SLO burn rates) —
+                    # in embedded deployments the scheduling happens in
+                    # this process, so its hub is the process default.
+                    # Inflight-exempt like the other debug endpoints:
+                    # diagnosing an overload needs them reachable
+                    from kubernetes_tpu.runtime.ledger import debug_body
+                    from kubernetes_tpu.runtime.telemetry import (
+                        get_default as get_telemetry,
+                    )
+
+                    self._send_text(
+                        debug_body(
+                            get_telemetry().debug_payload,
+                            self.path.partition("?")[2],
+                        ),
+                        ct="application/json",
+                    )
+                    return
                 if self.path == "/version":
                     self._send({"gitVersion": "v1.15-tpu", "major": "1",
                                 "minor": "15"})
@@ -2034,7 +2054,8 @@ class APIServer:
         # and a watch would pin a readonly slot for its whole lifetime.
         if outer.flow_control is not None:
             exempt = ("/healthz", "/livez", "/readyz", "/metrics",
-                      "/version", "/debug/traces", "/debug/decisions")
+                      "/version", "/debug/traces", "/debug/decisions",
+                      "/debug/cluster")
             for method in ("do_GET", "do_POST", "do_PUT", "do_PATCH",
                            "do_DELETE"):
                 inner = getattr(Handler, method)

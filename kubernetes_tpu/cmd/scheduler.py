@@ -99,6 +99,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ledger-max-cycles", type=int, default=None,
                    help="stop recording to the ledger file after this "
                    "many cycles (config ledgerMaxCycles; default 4096)")
+    p.add_argument("--telemetry", dest="telemetry", action="store_true",
+                   default=None,
+                   help="cluster + device telemetry (config telemetry; "
+                   "DEFAULT ON): device-resident fleet analytics on "
+                   "/metrics + /debug/cluster, HBM/compile-cache/launch "
+                   "facts, SLO burn-rate alerting")
+    p.add_argument("--no-telemetry", dest="telemetry",
+                   action="store_false",
+                   help="disable the telemetry hub entirely")
+    p.add_argument("--telemetry-interval-cycles", type=int, default=None,
+                   help="dispatch the cluster-analytics side-launch "
+                   "every N committed cycles (config "
+                   "telemetryIntervalCycles; default 1)")
+    p.add_argument("--slo-objectives", default=None,
+                   help="JSON list of SLO objectives for the burn-rate "
+                   "evaluator (config sloObjectives), e.g. "
+                   '\'[{"name":"cycle_deadline","objective":0.99,'
+                   '"fastWindowSeconds":60,"slowWindowSeconds":300,'
+                   '"burnThreshold":1.0}]\'; default: cycle_deadline + '
+                   "goodput + degraded")
+    p.add_argument("--heartbeat-seconds", type=float, default=None,
+                   help="one-line liveness heartbeat to the log every "
+                   "N seconds (config heartbeatSeconds; 0 disables — "
+                   "the default)")
     p.add_argument("--simulate-nodes", type=int, default=0,
                    help="register N hollow nodes")
     p.add_argument("--simulate-pods", type=int, default=0,
@@ -150,6 +174,14 @@ def main(argv=None) -> int:
         cc.decision_ledger = True  # a ledger dir implies recording
     if args.ledger_max_cycles is not None:
         cc.ledger_max_cycles = args.ledger_max_cycles
+    if args.telemetry is not None:
+        cc.telemetry = args.telemetry
+    if args.telemetry_interval_cycles is not None:
+        cc.telemetry_interval_cycles = args.telemetry_interval_cycles
+    if args.slo_objectives is not None:
+        cc.slo_objectives = json.loads(args.slo_objectives)
+    if args.heartbeat_seconds is not None:
+        cc.heartbeat_s = args.heartbeat_seconds
 
     # persistent compile cache BEFORE any jit compile (engine build,
     # prewarm, first cycle) so every executable of this process is served

@@ -401,6 +401,22 @@ class DeviceSnapshotCache:
         self._host: dict = {}   # field -> last-uploaded host array
         self._dev: dict = {}    # field -> resident device array
 
+    def resident(self, names: "tuple[str, ...]"):
+        """Device-resident buffers for the named snapshot fields, or None
+        when any is absent (before the first update(), or after a fault
+        invalidate()).  The telemetry analytics side-launch
+        (ops/analytics.py) reads the snapshot THROUGH this accessor so it
+        reduces the buffers already on device — zero extra H2D traffic —
+        and degrades to its host fallback exactly when the device state
+        cannot be trusted."""
+        out = []
+        for n in names:
+            dev = self._dev.get(n)
+            if dev is None:
+                return None
+            out.append(dev)
+        return tuple(out)
+
     def invalidate(self) -> None:
         """Drop every resident buffer: the next update() re-uploads the
         whole snapshot.  Called after a device fault — the wire state is

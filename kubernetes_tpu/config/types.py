@@ -104,6 +104,15 @@ class KubeSchedulerConfiguration:
     decision_ledger: bool = False
     ledger_dir: Optional[str] = None
     ledger_max_cycles: int = 4096
+    # cluster + device telemetry (runtime/telemetry.py): device-resident
+    # fleet analytics every N cycles, HBM/compile-cache/launch facts,
+    # multi-window SLO burn-rate alerting (sloObjectives entries:
+    # {name, objective, fastWindowSeconds, slowWindowSeconds,
+    # burnThreshold}), and the liveness heartbeat line (0 = off)
+    telemetry: bool = True
+    telemetry_interval_cycles: int = 1
+    slo_objectives: Optional[list] = None
+    heartbeat_s: float = 0.0
 
     def build_profile(self, interner=None) -> SchedulingProfile:
         """CreateFromConfig / CreateFromProvider (scheduler.go:162-192)."""
@@ -174,6 +183,12 @@ class KubeSchedulerConfiguration:
             decision_ledger=bool(d.get("decisionLedger", False)),
             ledger_dir=d.get("ledgerDir"),
             ledger_max_cycles=int(d.get("ledgerMaxCycles", 4096)),
+            telemetry=bool(d.get("telemetry", True)),
+            telemetry_interval_cycles=int(
+                d.get("telemetryIntervalCycles", 1)
+            ),
+            slo_objectives=d.get("sloObjectives"),
+            heartbeat_s=float(d.get("heartbeatSeconds", 0.0)),
         )
 
     @staticmethod

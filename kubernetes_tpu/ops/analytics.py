@@ -1,0 +1,286 @@
+"""Device-resident cluster analytics: one fused launch over the snapshot.
+
+The cluster snapshot already lives on device (codec/transfer.py
+DeviceSnapshotCache keeps `allocatable`/`requested`/`valid` resident and
+scatter-refreshed every cycle), so fleet-level analytics — utilization
+percentiles, fragmentation, imbalance, occupancy — are one cheap fused
+reduction away instead of a host-side O(N·R) pass.  `cluster_analytics`
+is that reduction: a single jitted side-launch the telemetry hub
+(runtime/telemetry.py) dispatches every `telemetryIntervalCycles`,
+returning a handful of scalars/tiny vectors (one small D2H copy).
+
+These metrics double as the packing-quality evaluation function ROADMAP
+items 2 (what-if binpack recommendations) and 4 (learned-scoring replay
+harness) score against — the same utilization/fragmentation criteria the
+constraint-based-packing and Gavel papers (PAPERS.md) judge policies by —
+so the math must be REPRODUCIBLE, not just fast:
+
+Bit-exactness contract (pinned by tests/test_telemetry.py): the jitted
+kernel and `cluster_analytics_np` (plain numpy, same source) produce
+bit-identical outputs on any backend.  Achieved by construction, not by
+tolerance: every floating-point reduction is an explicit pairwise TREE
+FOLD (zero-padded to a pow2 length, halves added until one row remains —
+the identical sequence of IEEE adds whichever library executes it),
+percentiles are sort+gather (comparison-based, no accumulation), and the
+remaining ops (divide, sqrt, round, elementwise max) are correctly
+rounded by IEEE 754 everywhere.  XLA's native `reduce` makes no such
+ordering promise, which is exactly why it is not used here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.codec.schema import (
+    RES_EPHEMERAL,
+    RES_MEMORY,
+    RES_MILLICPU,
+    RES_PODS,
+    _dc_pytree,
+)
+
+# the core resource columns the analytics reduce over, in output order
+RESOURCE_NAMES = ("cpu", "memory", "ephemeral", "pods")
+_RES_COLS = (RES_MILLICPU, RES_MEMORY, RES_EPHEMERAL, RES_PODS)
+# per-resource utilization statistics, in output order
+STAT_NAMES = ("mean", "max", "p50", "p90", "p99")
+_QUANTILES = (0.5, 0.9, 0.99)
+# pods-per-node occupancy histogram bins: fraction of the node's pod
+# capacity in use, [i/10, (i+1)/10) with the last bin catching 100%
+OCC_BINS = 10
+
+
+@_dc_pytree
+@dataclass
+class ClusterAnalytics:
+    """One telemetry sample's device outputs (a tiny pytree: ~50 floats).
+
+    utilization[r, s]: resource RESOURCE_NAMES[r] x stat STAT_NAMES[s],
+    where a node's utilization is requested/allocatable (0 when the node
+    allocates none of that resource); invalid (padding/recycled) rows are
+    excluded from every statistic."""
+
+    utilization: Any      # f32[4, 5]
+    largest_free: Any     # f32[4]  max free capacity on any single node
+    #                       per resource — the largest pod request that
+    #                       still fits SOMEWHERE, per dimension
+    stranded: Any         # f32[2]  (cpu stranded by memory, memory
+    #                       stranded by cpu): free units on nodes whose
+    #                       OTHER resource is exhausted — capacity no
+    #                       cpu+memory pod can use
+    fragmentation: Any    # f32[]   stranded fraction of total free
+    #                       (mean of the two directions), in [0, 1]
+    imbalance: Any        # f32[]   stddev of per-node dominant-resource
+    #                       share (0 = perfectly even packing)
+    occupancy: Any        # i32[OCC_BINS] nodes per pod-occupancy decile
+    nodes: Any            # i32[]   valid nodes in the snapshot
+    pods_running: Any     # f32[]   committed pods (sum of the pods col)
+
+
+def _fold_sum(x, xp):
+    """Order-pinned pairwise sum over axis 0: zero-pad to a pow2 length,
+    add halves until one row remains.  The SAME sequence of IEEE adds in
+    numpy and in the jitted kernel — the whole bit-exactness contract
+    rests on this helper."""
+    n = x.shape[0]
+    if n == 0:
+        return xp.zeros(x.shape[1:], x.dtype)
+    k = 1 << (n - 1).bit_length()
+    if k != n:
+        x = xp.concatenate(
+            [x, xp.zeros((k - n,) + x.shape[1:], x.dtype)], axis=0
+        )
+    while x.shape[0] > 1:
+        h = x.shape[0] // 2
+        x = x[:h] + x[h:]
+    return x[0]
+
+
+def _analytics(allocatable, requested, valid, xp):
+    """The shared implementation: xp is jax.numpy inside the jitted
+    kernel and numpy in the reference — every op below exists in both
+    with IEEE-identical elementwise semantics.
+
+    Structured for LAUNCH CHEAPNESS as much as exactness: every float
+    sum rides ONE packed [N, 23] fold chain (column packing changes
+    nothing about each column's add sequence, so bit-exactness holds),
+    the two max reductions fuse into one [N, 8] op, and all three
+    quantiles gather in one indexed load — the whole kernel is ~a dozen
+    XLA ops plus log2(N) fold adds, cheap enough to dispatch every
+    cycle from the scheduling thread."""
+    # the core four resource columns are the leading ones by schema
+    # construction (_RES_COLS == (0, 1, 2, 3)); a plain slice keeps the
+    # gather out of the kernel
+    assert _RES_COLS == (0, 1, 2, 3)
+    alloc = allocatable[:, :4].astype(np.float32)          # [N, 4]
+    used = requested[:, :4].astype(np.float32)             # [N, 4]
+    vmask = valid.astype(bool)                             # [N]
+    zero, one = np.float32(0.0), np.float32(1.0)
+
+    # per-node utilization per resource: requested/allocatable where the
+    # node allocates any, else 0 (a capacity-less node is idle, not 100%)
+    cap_ok = alloc > zero
+    with np.errstate(divide="ignore", invalid="ignore"):
+        ratio = used / alloc
+    util = xp.where(vmask[:, None] & cap_ok, ratio, zero)
+    # free capacity; stranded = free units on nodes whose complementary
+    # resource is exhausted (no cpu+memory pod can land there)
+    free = xp.where(
+        vmask[:, None], xp.maximum(alloc - used, zero), zero
+    )
+    free_cpu, free_mem = free[:, 0], free[:, 1]
+    no_mem = vmask & ~(free_mem > zero)
+    no_cpu = vmask & ~(free_cpu > zero)
+    # dominant-resource share (elementwise max over the 4 columns)
+    dom = xp.max(util, axis=1)                             # [N]
+    # pods-per-node occupancy deciles as 0/1 f32 columns (counts stay
+    # exact in f32 far past any real node count)
+    occ = util[:, 3]
+    bin_idx = xp.clip(
+        xp.floor(occ * np.float32(OCC_BINS)).astype(np.int32),
+        0, OCC_BINS - 1,
+    )
+    counted = vmask & cap_ok[:, 3]
+    onehot = (
+        (bin_idx[:, None] == xp.arange(OCC_BINS, dtype=np.int32)[None, :])
+        & counted[:, None]
+    ).astype(np.float32)                                   # [N, OCC_BINS]
+
+    # ---- ONE packed fold for every float sum.  Column layout:
+    # 0:4 util | 4:8 free | 8 valid | 9 cpu-stranded | 10 mem-stranded
+    # | 11 dom | 12 pods used | 13:23 occupancy one-hot
+    packed = xp.concatenate(
+        [
+            util,
+            free,
+            vmask.astype(np.float32)[:, None],
+            xp.where(no_mem, free_cpu, zero)[:, None],
+            xp.where(no_cpu, free_mem, zero)[:, None],
+            xp.where(vmask, dom, zero)[:, None],
+            xp.where(vmask, used[:, 3], zero)[:, None],
+            onehot,
+        ],
+        axis=1,
+    )
+    S = _fold_sum(packed, xp)                              # [23]
+    sum_util, sum_free = S[0:4], S[4:8]
+    countf = S[8]
+    stranded = S[9:11]
+    sum_dom, pods_running = S[11], S[12]
+    occupancy = S[13:23].astype(np.int32)
+    count_i = countf.astype(np.int32)
+    has_nodes = count_i > 0
+    denom = xp.maximum(countf, one)
+
+    # fused masked max over util + free columns ([N, 8] -> [8])
+    neg_inf = np.float32(-np.inf)
+    maxes = (
+        xp.max(
+            xp.where(
+                vmask[:, None], xp.concatenate([util, free], axis=1),
+                neg_inf,
+            ),
+            axis=0,
+        )
+        if util.shape[0] else xp.full((8,), neg_inf, np.float32)
+    )
+    maxes = xp.where(maxes == neg_inf, zero, maxes)
+    max_util, largest_free = maxes[0:4], maxes[4:8]
+
+    # sort+gather percentiles: one sort, one gather for all quantiles
+    # (nearest-rank, round-half-even — no accumulation anywhere)
+    mean = xp.where(has_nodes, sum_util / denom, zero)
+    if util.shape[0]:
+        sorted_util = xp.sort(
+            xp.where(vmask[:, None], util, np.float32(np.inf)), axis=0
+        )
+        qs = np.asarray(_QUANTILES, np.float32)
+        idx = xp.round(qs * (countf - one)).astype(np.int32)
+        idx = xp.clip(idx, 0, sorted_util.shape[0] - 1)
+        quants = xp.where(has_nodes, sorted_util[idx], zero)  # [3, 4]
+    else:
+        quants = xp.zeros((len(_QUANTILES), 4), np.float32)
+    utilization = xp.concatenate(
+        [mean[None, :], max_util[None, :], quants], axis=0
+    ).T                                                    # [4, 5]
+
+    # fragmentation: stranded fraction of total free, per direction
+    frag_dir = xp.where(
+        sum_free[0:2] > zero,
+        stranded / xp.maximum(sum_free[0:2], one),
+        zero,
+    )
+    fragmentation = (
+        np.float32(0.5) * frag_dir[0] + np.float32(0.5) * frag_dir[1]
+    )
+
+    # imbalance: stddev of dom across valid nodes (second small fold for
+    # the centered squares — the mean must come from the first pass)
+    mean_dom = xp.where(has_nodes, sum_dom / denom, zero)
+    diff = xp.where(vmask, dom - mean_dom, zero)
+    var = xp.where(has_nodes, _fold_sum(diff * diff, xp) / denom, zero)
+    imbalance = xp.sqrt(var)
+
+    return ClusterAnalytics(
+        utilization=utilization,
+        largest_free=largest_free,
+        stranded=stranded,
+        fragmentation=fragmentation,
+        imbalance=imbalance,
+        occupancy=occupancy,
+        nodes=count_i,
+        pods_running=pods_running,
+    )
+
+
+def _analytics_jax(allocatable, requested, valid):
+    return _analytics(allocatable, requested, valid, jnp)
+
+
+# THE kernel: one fused launch per snapshot shape (re-traced only when N
+# changes, like every engine executable).  Inputs may be device-resident
+# buffers (the telemetry hub hands DeviceSnapshotCache.resident()) or
+# host arrays (jit uploads them — the CPU-fallback path).
+cluster_analytics = jax.jit(_analytics_jax)
+
+
+def cluster_analytics_np(allocatable, requested, valid) -> ClusterAnalytics:
+    """The bit-exact numpy reference (and the degraded-mode fallback the
+    telemetry hub uses while the device breaker is open)."""
+    return _analytics(
+        np.asarray(allocatable), np.asarray(requested),
+        np.asarray(valid), np,
+    )
+
+
+def analytics_to_dict(a: ClusterAnalytics) -> dict:
+    """Host-materialized sample -> the plain-JSON shape served by
+    GET /debug/cluster and recorded in the telemetry ring."""
+    util = np.asarray(a.utilization, np.float32)
+    return {
+        "utilization": {
+            RESOURCE_NAMES[r]: {
+                STAT_NAMES[s]: float(util[r, s])
+                for s in range(len(STAT_NAMES))
+            }
+            for r in range(len(RESOURCE_NAMES))
+        },
+        "largest_free": {
+            RESOURCE_NAMES[r]: float(np.asarray(a.largest_free)[r])
+            for r in range(len(RESOURCE_NAMES))
+        },
+        "stranded": {
+            "cpu": float(np.asarray(a.stranded)[0]),
+            "memory": float(np.asarray(a.stranded)[1]),
+        },
+        "fragmentation": float(np.asarray(a.fragmentation)),
+        "imbalance": float(np.asarray(a.imbalance)),
+        "occupancy": [int(x) for x in np.asarray(a.occupancy)],
+        "nodes": int(np.asarray(a.nodes)),
+        "pods_running": float(np.asarray(a.pods_running)),
+    }

@@ -9,3 +9,4 @@ from kubernetes_tpu.runtime.cache import SchedulerCache
 from kubernetes_tpu.runtime.flightrecorder import RECORDER, FlightRecorder
 from kubernetes_tpu.runtime.health import DeviceHealth
 from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.runtime.telemetry import SLOObjective, TelemetryHub

@@ -227,6 +227,22 @@ class HealthServer:
                         ),
                         ct="application/json",
                     )
+                elif path == "/debug/cluster":
+                    # the telemetry hub's bounded time series: cluster
+                    # analytics samples (utilization/fragmentation/
+                    # imbalance/occupancy), HBM + compile facts, SLO
+                    # burn rates — ?limit=N + the shared 4MB cap, like
+                    # /debug/decisions
+                    from kubernetes_tpu.runtime.telemetry import (
+                        get_default as get_telemetry,
+                    )
+
+                    self._send(
+                        debug_body(
+                            get_telemetry().debug_payload, query,
+                        ),
+                        ct="application/json",
+                    )
                 else:
                     self._send(b"not found", 404)
 

@@ -567,10 +567,16 @@ def replay_record(fn, rec: dict) -> np.ndarray:
     return hosts[: int(rec["n_pods"])]
 
 
-def replay(path: str, engine: Optional[str] = None) -> dict:
+def replay(path: str, engine: Optional[str] = None,
+           cluster_stats: bool = True) -> dict:
     """Replay every recorded cycle and compare winners bit-for-bit.
     Returns {"cycles", "pods", "mismatches", "bit_identical",
-    "engine", "mismatch_detail"}."""
+    "engine", "mismatch_detail"} plus — with `cluster_stats` (the
+    default) — per-run utilization/fragmentation columns computed from
+    each reconstructed snapshot via the bit-exact numpy analytics
+    reference (ops/analytics.py): the packing-quality series the
+    offline weight-tuning loop (ROADMAP item 4) scores candidate
+    weights against."""
     header, records = read_ledger_stream(path)
     fns: Dict[str, Any] = {}
 
@@ -591,8 +597,22 @@ def replay(path: str, engine: Optional[str] = None) -> dict:
     pods = 0
     cycles = 0
     detail: List[dict] = []
+    util_cpu: List[float] = []
+    util_mem: List[float] = []
+    frag: List[float] = []
     for rec in records:
         cycles += 1
+        if cluster_stats:
+            from kubernetes_tpu.ops.analytics import cluster_analytics_np
+
+            snap = rec["cluster"]
+            a = cluster_analytics_np(
+                snap.allocatable, snap.requested, snap.valid
+            )
+            u = np.asarray(a.utilization)
+            util_cpu.append(float(u[0, 0]))
+            util_mem.append(float(u[1, 0]))
+            frag.append(float(np.asarray(a.fragmentation)))
         got = replay_record(fn_for(rec), rec)
         want = np.asarray(rec["winners"])[: int(rec["n_pods"])]
         pods += len(want)
@@ -606,7 +626,7 @@ def replay(path: str, engine: Optional[str] = None) -> dict:
                     "want": [int(want[i]) for i in bad[:16]],
                     "got": [int(got[i]) for i in bad[:16]],
                 })
-    return {
+    out = {
         "cycles": cycles,
         "pods": pods,
         "mismatches": mismatches,
@@ -614,3 +634,17 @@ def replay(path: str, engine: Optional[str] = None) -> dict:
         "engine": engine or header.get("engine", "?"),
         "mismatch_detail": detail,
     }
+    if cluster_stats and cycles:
+        def _col(series: List[float]) -> dict:
+            return {
+                "first": round(series[0], 4),
+                "last": round(series[-1], 4),
+                "mean": round(sum(series) / len(series), 4),
+            }
+
+        out["cluster"] = {
+            "utilization_cpu_mean": _col(util_cpu),
+            "utilization_memory_mean": _col(util_mem),
+            "fragmentation": _col(frag),
+        }
+    return out

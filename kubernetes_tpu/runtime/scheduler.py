@@ -189,6 +189,26 @@ class SchedulerConfig:
     # bounded append-only file: recording stops (and counts drops) after
     # this many cycles
     ledger_max_cycles: int = 4096
+    # --- cluster + device telemetry (ISSUE 8: runtime/telemetry.py) ---
+    # the telemetry hub: device-resident cluster analytics (utilization/
+    # fragmentation/imbalance/occupancy percentiles from ops/analytics),
+    # HBM + compile-cache + launch-EWMA runtime facts, and the
+    # multi-window SLO burn-rate evaluator firing slo_burn postmortems.
+    # Always-on by design (the <2%-of-cycle budget is pinned by
+    # perf_smoke); False removes the hook entirely.
+    telemetry: bool = True
+    # analytics side-launch cadence: every Nth committed cycle dispatches
+    # the fused snapshot reduction (the previous launch's tiny result is
+    # materialized first, so the scheduling thread never blocks on it)
+    telemetry_interval_cycles: int = 1
+    # SLO objectives for the burn evaluator: list of dicts ({name,
+    # objective, fastWindowSeconds, slowWindowSeconds, burnThreshold});
+    # None = the defaults (cycle_deadline, goodput, degraded)
+    slo_objectives: Optional[list] = None
+    # liveness heartbeat: a once-per-interval one-line klog summary
+    # (cycles, placed/unschedulable, depths, breaker, AIMD width, HBM
+    # live) so a quiet log still proves the loop is alive; 0 = off
+    heartbeat_s: float = 0.0
     # multi-scheduler: only pods whose spec.schedulerName names THIS
     # scheduler enter its queue (eventhandlers.go responsibleForPod)
     scheduler_name: str = "default-scheduler"
@@ -238,6 +258,12 @@ class SchedulerConfig:
             decision_ledger=getattr(cc, "decision_ledger", False),
             ledger_dir=getattr(cc, "ledger_dir", None),
             ledger_max_cycles=getattr(cc, "ledger_max_cycles", 4096),
+            telemetry=getattr(cc, "telemetry", True),
+            telemetry_interval_cycles=getattr(
+                cc, "telemetry_interval_cycles", 1
+            ),
+            slo_objectives=getattr(cc, "slo_objectives", None),
+            heartbeat_s=getattr(cc, "heartbeat_s", 0.0),
         )
 
 
@@ -295,6 +321,16 @@ class _InFlight:
     #                              the commit fence)
     ledger_inputs: Optional[dict] = None  # the cycle's encode-time launch
     #                              inputs, stashed for the ledger record
+    # --- telemetry (ISSUE 8) ---
+    # host refs to the snapshot fields the analytics kernel reduces
+    # (immutable by the encoder's cow contract): the fallback input when
+    # the resident device buffers are unavailable (degraded cycles)
+    telemetry_host: Optional[tuple] = None
+    # the ENCODED batch width (batch.n_pods — the executable's padded
+    # shape, NOT len(pods)): the launch-EWMA label, so the per-width
+    # family tracks real executables instead of leaking a series per
+    # raw pod count
+    width: int = 0
 
 
 class _HostResult:
@@ -515,6 +551,27 @@ class Scheduler:
             ledger_mod.set_default(self.ledger)
         if self.ledger is not None:
             self.ledger.ensure_meta(self._engine_meta())
+        # cluster + device telemetry (ISSUE 8): analytics side-launches,
+        # HBM/compile/launch-EWMA runtime facts, SLO burn-rate alerting.
+        # A config-built hub installs itself as the process default (the
+        # RECORDER pattern) so /debug/cluster serves it unwired.
+        self.telemetry = None
+        if self.config.telemetry:
+            from kubernetes_tpu.runtime import telemetry as telemetry_mod
+
+            self.telemetry = telemetry_mod.TelemetryHub(
+                interval_cycles=self.config.telemetry_interval_cycles,
+                objectives=telemetry_mod.build_objectives(
+                    self.config.slo_objectives
+                ),
+                postmortem=self._postmortem,
+            )
+            telemetry_mod.set_default(self.telemetry)
+        # shed watermark (per-cycle deltas feed the goodput SLO) +
+        # heartbeat clock + liveness totals (heartbeat line + bench)
+        self._shed_seen = 0
+        self._last_heartbeat = time.monotonic()
+        self._outcome_totals = {"placed": 0, "unschedulable": 0}
         self.results: List[ScheduleResult] = []
         # (preemptor key, node name, victim keys) per successful preemption
         self.preemptions: List[Tuple[Tuple[str, str], str, List[Tuple[str, str]]]] = []
@@ -1011,6 +1068,11 @@ class Scheduler:
             relaunch=None if degraded else launch,
             cpu_fetch=cpu_fetch, degraded=degraded,
             last_index0=last_index0, tier=tier, attrib_dev=attrib_dev,
+            telemetry_host=(
+                (cluster.allocatable, cluster.requested, cluster.valid)
+                if self.telemetry is not None else None
+            ),
+            width=batch.n_pods,
         )
         if self.ledger is not None:
             # the exact launch inputs, stashed for the off-hot-path
@@ -1188,14 +1250,77 @@ class Scheduler:
         placed = sum(1 for r in results if r.node is not None)
         inf.trace.annotate(placed=placed, unschedulable=len(results) - placed)
         inf.trace.finish()
-        if self.config.trace_threshold_s > 0:
-            inf.trace.log_if_long(self.config.trace_threshold_s)
         self.flight_recorder.record(inf.trace)
         if self.ledger is not None and inf.ledger_inputs is not None:
             self._ledger_record(inf, staged, results)
+        self._outcome_totals["placed"] += placed
+        self._outcome_totals["unschedulable"] += len(results) - placed
+        if self.telemetry is not None:
+            t_tel = time.perf_counter()
+            try:
+                self._telemetry_cycle(inf, results, placed)
+            except Exception as e:  # noqa: BLE001 — telemetry must never
+                # fail a cycle whose placements are already committed: a
+                # device fault in the analytics SIDE-launch (dispatched
+                # outside the resilient fence on purpose) costs one
+                # sample, not the batch
+                klog.errorf(
+                    "telemetry hook failed (cycle %d): %s", inf.cycle, e
+                )
+            finally:
+                m.TELEMETRY_SECONDS.inc(time.perf_counter() - t_tel)
         m.PENDING_PODS.set(float(len(self.queue)))
         self.results.extend(results)
+        # slow-cycle log LAST, once the ENTIRE tail (ledger record +
+        # telemetry included) has run: the span was finished above, so
+        # the logged total is the same duration the span tree at
+        # /debug/traces reports — on pipelined cycles the log used to
+        # fire mid-tail, reporting a duration the rest of the tail then
+        # outgrew (regression-pinned by tests/test_tracing.py)
+        if self.config.trace_threshold_s > 0:
+            inf.trace.log_if_long(self.config.trace_threshold_s)
         return results
+
+    def _telemetry_cycle(self, inf: _InFlight, results, placed: int) -> None:
+        """Feed the telemetry hub one committed cycle: SLO good/bad
+        events (deadline overrun, goodput vs shed, degraded), per-tier
+        pending pressure, the per-width launch EWMA, and the amortized
+        analytics side-launch over the RESIDENT snapshot buffers (host
+        fallback when the device state is untrusted)."""
+        hub = self.telemetry
+        q = self.queue
+        shed_total = getattr(q, "shed_total", 0)
+        shed_delta = shed_total - self._shed_seen
+        self._shed_seen = shed_total
+        express = (
+            q.express_depth() if hasattr(q, "express_depth") else 0
+        )
+        active = q.active_depth() if hasattr(q, "active_depth") else len(q)
+        hub.record_pressure(
+            bulk=max(0, active - express), express=express,
+            parked=max(0, len(q) - active),
+        )
+        if not inf.degraded and inf.fetch is not None:
+            hub.note_launch(inf.width or len(inf.pods), inf.fetch.seconds)
+        from kubernetes_tpu.runtime.telemetry import ANALYTICS_FIELDS
+
+        resident = (
+            None if inf.degraded
+            else self._dev_snapshot.resident(ANALYTICS_FIELDS)
+        )
+        hub.on_cycle(
+            cycle=inf.cycle,
+            tier=inf.tier,
+            cycle_s=time.monotonic() - inf.t_cycle0,
+            placed=placed,
+            unschedulable=len(results) - placed,
+            shed=shed_delta,
+            degraded=inf.degraded,
+            deadline_s=self.config.cycle_deadline_s,
+            resident=resident,
+            host_snapshot=inf.telemetry_host,
+            span=inf.trace,
+        )
 
     def _ledger_record(self, inf: _InFlight, staged: _Staged,
                        results: List[ScheduleResult]) -> None:
@@ -1998,6 +2123,33 @@ class Scheduler:
         results = self.schedule_cycle(pods, tier=TIER_EXPRESS)
         return sum(1 for r in results if r.node is not None)
 
+    def _maybe_heartbeat(self) -> None:
+        """Once per config.heartbeat_s (0 = off): ONE klog line with the
+        liveness numbers an operator greps for first — so a quiet log
+        still proves the loop is alive.  Called from run_once on every
+        iteration (including idle polls: an empty queue must still
+        heartbeat)."""
+        hb = self.config.heartbeat_s
+        if hb <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_heartbeat < hb:
+            return
+        self._last_heartbeat = now
+        q = self.queue
+        express = q.express_depth() if hasattr(q, "express_depth") else 0
+        active = q.active_depth() if hasattr(q, "active_depth") else len(q)
+        hbm = self.telemetry.hbm_in_use() if self.telemetry is not None else 0
+        klog.infof(
+            "heartbeat: cycles=%d placed=%d unschedulable=%d depth=%d "
+            "active=%d express=%d breaker=%s batch=%d hbm_bytes=%d",
+            q.scheduling_cycle,
+            self._outcome_totals["placed"],
+            self._outcome_totals["unschedulable"],
+            len(q), active, express,
+            self.device_health.state, self._cur_batch, hbm,
+        )
+
     def prewarm(self, widths: Optional[Sequence[int]] = None,
                 pod_factory: Optional[Callable[[int], Pod]] = None) -> Dict[int, float]:
         """Pre-pay the engine's XLA compiles for every batch width the
@@ -2159,6 +2311,7 @@ class Scheduler:
         dispatches this batch and returns the PREVIOUS batch's placements
         (flush_pipeline drains the last one); gang cycles and empty polls
         drain the pipeline first so snapshots never go stale."""
+        self._maybe_heartbeat()
         t_pop = time.monotonic()
         express = self.config.express_lane
         # tiered mode only adds the kwarg (an express arrival interrupts
@@ -2285,6 +2438,7 @@ class Scheduler:
                         ):
                             node = rec.pod.spec.node_name
                             n += 1
+                            self._outcome_totals["placed"] += 1
                             self.results.append(ScheduleResult(p, node))
                             self._record_scheduled(
                                 p, node, time.monotonic() - t_cycle
@@ -2308,6 +2462,7 @@ class Scheduler:
                     for p in members:
                         self.queue.add_unschedulable(p, cycle)
                         self.results.append(ScheduleResult(p, None))
+                        self._outcome_totals["unschedulable"] += 1
                         m.SCHEDULE_ATTEMPTS.inc(result=m.UNSCHEDULABLE)
                         self.recorder.eventf(
                             "Pod", p.namespace, p.name,
@@ -2327,6 +2482,7 @@ class Scheduler:
                         continue
                     # success bookkeeping identical to the plain path:
                     # Scheduled event, counters, e2e histogram, results
+                    self._outcome_totals["placed"] += 1
                     self.results.append(ScheduleResult(p, node))
                     self._record_scheduled(
                         p, node, time.monotonic() - t_cycle

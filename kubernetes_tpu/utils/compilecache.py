@@ -31,6 +31,60 @@ CACHE_DIR_ENV = "KTPU_COMPILE_CACHE_DIR"
 # sentinel accepted by every spelling of the knob: disables the cache
 DISABLED = "off"
 
+# jax.monitoring event names this module listens on (stable since jax
+# 0.4.x; absent names simply never fire)
+_EVENT_HIT = "/jax/compilation_cache/cache_hits"
+_EVENT_MISS = "/jax/compilation_cache/cache_misses"
+_EVENT_COMPILE_SECS = "/jax/core/compile/backend_compile_duration"
+
+_listeners_installed = False
+
+
+def install_metrics_listeners() -> bool:
+    """Feed compile-cache hits/misses and cumulative backend-compile
+    seconds into the metrics registry (ktpu_compile_cache_events_total,
+    ktpu_backend_compile_seconds_total) via jax.monitoring — the
+    telemetry hub (runtime/telemetry.py) reads the same counters.
+    Idempotent; returns whether the hooks are live (False on a jax
+    build without the monitoring API — never fatal)."""
+    global _listeners_installed
+    if _listeners_installed:
+        return True
+    try:
+        import jax.monitoring as monitoring
+
+        from kubernetes_tpu.utils import metrics as m
+
+        def _on_event(event: str, **kw) -> None:
+            if event == _EVENT_HIT:
+                m.COMPILE_CACHE_EVENTS.inc(event="hit")
+            elif event == _EVENT_MISS:
+                m.COMPILE_CACHE_EVENTS.inc(event="miss")
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            if event == _EVENT_COMPILE_SECS:
+                m.COMPILE_SECONDS.inc(float(duration))
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # noqa: BLE001 — monitoring API absent/changed
+        return False
+    _listeners_installed = True
+    return True
+
+
+def compile_stats() -> dict:
+    """Point-in-time compile telemetry for the hub's samples: cache
+    hit/miss counts and cumulative compile seconds (all zero until
+    install_metrics_listeners() ran and a compile happened)."""
+    from kubernetes_tpu.utils import metrics as m
+
+    return {
+        "cache_hits": int(m.COMPILE_CACHE_EVENTS.value(event="hit")),
+        "cache_misses": int(m.COMPILE_CACHE_EVENTS.value(event="miss")),
+        "compile_seconds": round(float(m.COMPILE_SECONDS.value), 3),
+    }
+
 
 def resolve_cache_dir(cache_dir: Optional[str] = None) -> Optional[str]:
     """The directory the cache will use: explicit argument, else the
@@ -58,6 +112,9 @@ def enable_compile_cache(
     """
     import jax
 
+    # compile telemetry rides along wherever the cache is configured:
+    # the hit/miss counters only mean something once the cache is live
+    install_metrics_listeners()
     d = resolve_cache_dir(cache_dir)
     if d is None:
         return None

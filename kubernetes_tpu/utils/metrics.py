@@ -10,10 +10,38 @@ the same metric names, exposable in the Prometheus text format.
 from __future__ import annotations
 
 import bisect
+import logging
 import threading
 from typing import Dict, List, Optional, Tuple
 
 _DEF_BUCKETS = [0.001 * (2 ** i) for i in range(16)]  # 1ms .. ~32s
+
+# bounded-cardinality guard for labeled families: a family growing past
+# this many children (per-pod labels, unbounded width series, ...) is a
+# memory leak on /metrics — warn ONCE per family so the leak is visible
+# without spamming, and keep recording (prometheus drops nothing either;
+# the fix is remove() or a better label).  Families with a known-larger
+# legitimate cardinality pass their own max_children.
+DEFAULT_MAX_CHILDREN = 64
+_logger = logging.getLogger("kubernetes_tpu")
+
+
+def _label_key(label_names: Tuple[str, ...], labels: dict) -> Tuple[str, ...]:
+    """THE label-set -> child-key normalization, shared by every
+    labeled family (missing labels read as "")."""
+    return tuple(str(labels.get(n, "")) for n in label_names)
+
+
+def _warn_cardinality(name: str, max_children: int, n_children: int,
+                      key) -> None:
+    """The once-per-family guard message (callers track the warned
+    flag; the condition and text must not drift between families)."""
+    _logger.warning(
+        "metric family %s grew past %d label sets "
+        "(%d children; adding %r) — unbounded label cardinality? "
+        "remove() retired series, or raise max_children",
+        name, max_children, n_children, key,
+    )
 
 
 class Histogram:
@@ -128,24 +156,63 @@ class Gauge(Counter):
 
 class LabeledCounter:
     """Counter family with label sets (e.g. schedule_attempts_total{result=})
-    — the prometheus CounterVec analog (metrics.go scheduleAttempts)."""
+    — the prometheus CounterVec analog (metrics.go scheduleAttempts).
 
-    def __init__(self, name: str, help_: str = "", label_names: Tuple[str, ...] = ()):
+    Children are created on first use and live until `remove()`d; growth
+    past `max_children` logs a once-per-family cardinality warning (the
+    guard that keeps a per-width/per-pod label from leaking series
+    without bound)."""
+
+    def __init__(self, name: str, help_: str = "",
+                 label_names: Tuple[str, ...] = (),
+                 max_children: Optional[int] = None):
         self.name = name
         self.help = help_
         self.label_names = tuple(label_names)
+        self.max_children = (
+            max_children if max_children is not None else DEFAULT_MAX_CHILDREN
+        )
+        self._warned = False
         self._children: Dict[Tuple[str, ...], float] = {}
         self._lock = threading.Lock()
 
+    def _key(self, labels: dict) -> Tuple[str, ...]:
+        return _label_key(self.label_names, labels)
+
+    def _check_cardinality_locked(self, key) -> None:
+        """Call with the lock held, BEFORE inserting a new key."""
+        if (
+            not self._warned
+            and key not in self._children
+            and len(self._children) >= self.max_children
+        ):
+            self._warned = True
+            _warn_cardinality(
+                self.name, self.max_children, len(self._children), key
+            )
+
     def inc(self, v: float = 1.0, **labels) -> None:
-        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        key = self._key(labels)
         with self._lock:
+            self._check_cardinality_locked(key)
             self._children[key] = self._children.get(key, 0.0) + v
 
     def value(self, **labels) -> float:
-        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        key = self._key(labels)
         with self._lock:
             return self._children.get(key, 0.0)
+
+    def remove(self, **labels) -> bool:
+        """Retire one label set's series (the CounterVec.DeleteLabelValues
+        analog): the series disappears from /metrics and a later inc()
+        restarts it from zero.  Returns whether it existed."""
+        key = self._key(labels)
+        with self._lock:
+            return self._children.pop(key, None) is not None
+
+    def child_count(self) -> int:
+        with self._lock:
+            return len(self._children)
 
     def expose(self) -> str:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
@@ -171,25 +238,51 @@ class LabeledHistogram:
     def __init__(self, name: str, help_: str = "",
                  label_names: Tuple[str, ...] = (),
                  buckets: Optional[List[float]] = None,
-                 default_labels: Optional[Dict[str, str]] = None):
+                 default_labels: Optional[Dict[str, str]] = None,
+                 max_children: Optional[int] = None):
         self.name = name
         self.help = help_
         self.label_names = tuple(label_names)
         self._buckets = buckets
         self._default = dict(default_labels or {})
+        self.max_children = (
+            max_children if max_children is not None else DEFAULT_MAX_CHILDREN
+        )
+        self._warned = False
         self._children: Dict[Tuple[str, ...], Histogram] = {}
         self._lock = threading.Lock()
 
+    def _key(self, labels: dict) -> Tuple[str, ...]:
+        return _label_key(self.label_names, {**self._default, **labels})
+
     def labels(self, **labels) -> Histogram:
-        merged = {**self._default, **labels}
-        key = tuple(str(merged.get(n, "")) for n in self.label_names)
+        key = self._key(labels)
         with self._lock:
             h = self._children.get(key)
             if h is None:
+                if (
+                    not self._warned
+                    and len(self._children) >= self.max_children
+                ):
+                    self._warned = True
+                    _warn_cardinality(
+                        self.name, self.max_children,
+                        len(self._children), key,
+                    )
                 h = self._children[key] = Histogram(
                     self.name, self.help, buckets=self._buckets
                 )
             return h
+
+    def remove(self, **labels) -> bool:
+        """Retire one label set's child histogram (observations restart
+        from an empty ladder if the series comes back)."""
+        with self._lock:
+            return self._children.pop(self._key(labels), None) is not None
+
+    def child_count(self) -> int:
+        with self._lock:
+            return len(self._children)
 
     def observe(self, v: float, **labels) -> None:
         self.labels(**labels).observe(v)
@@ -239,8 +332,9 @@ class LabeledGauge(LabeledCounter):
     e.g. apiserver_current_inflight_requests{request_kind=})."""
 
     def set(self, v: float, **labels) -> None:
-        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        key = self._key(labels)
         with self._lock:
+            self._check_cardinality_locked(key)
             self._children[key] = float(v)
 
     def expose(self) -> str:
@@ -433,6 +527,148 @@ LEDGER_DROPPED = REGISTRY.register(
         "scheduler_ledger_dropped_total",
         "Decision-ledger records dropped (writer queue full, max-cycles "
         "cap reached, or a failed write)",
+    )
+)
+
+# cluster + device telemetry (ISSUE 8): fleet-state analytics from the
+# device-resident snapshot reduction (ops/analytics.py), TPU runtime
+# facts (HBM, compile cache, launch durations), and the SLO burn-rate
+# evaluator (runtime/telemetry.py).  The reference exposes none of these
+# — its scheduler has no device and no fleet-analytics pass — but they
+# answer the operator questions PRs 5/7 left open: how utilized/
+# fragmented is the fleet, how much HBM headroom does the engine have,
+# are we burning a latency SLO.
+CLUSTER_UTILIZATION = REGISTRY.register(
+    LabeledGauge(
+        "scheduler_cluster_utilization_ratio",
+        "Per-resource cluster utilization statistic across valid nodes "
+        "(requested/allocatable), by resource (cpu|memory|ephemeral|pods)"
+        " and stat (mean|max|p50|p90|p99)",
+        ("resource", "stat"),
+    )
+)
+CLUSTER_LARGEST_FREE = REGISTRY.register(
+    LabeledGauge(
+        "scheduler_cluster_largest_free_capacity",
+        "Largest free capacity on any single node, per resource — the "
+        "biggest pod request that still fits somewhere, per dimension",
+        ("resource",),
+    )
+)
+CLUSTER_STRANDED = REGISTRY.register(
+    LabeledGauge(
+        "scheduler_cluster_stranded_capacity",
+        "Free capacity stranded by the complementary resource: cpu = "
+        "free cpu on nodes with no free memory, memory = vice versa",
+        ("resource",),
+    )
+)
+CLUSTER_FRAGMENTATION = REGISTRY.register(
+    Gauge(
+        "scheduler_cluster_fragmentation_index",
+        "Stranded fraction of total free capacity (mean of the cpu and "
+        "memory directions), 0 = none stranded, 1 = all free capacity "
+        "unusable by a cpu+memory pod",
+    )
+)
+CLUSTER_IMBALANCE = REGISTRY.register(
+    Gauge(
+        "scheduler_cluster_dominant_share_stddev",
+        "Stddev across valid nodes of the dominant-resource share "
+        "(0 = perfectly even packing)",
+    )
+)
+CLUSTER_OCCUPANCY = REGISTRY.register(
+    LabeledGauge(
+        "scheduler_cluster_pods_per_node_occupancy_nodes",
+        "Nodes per pod-capacity occupancy decile (decile 0 = <10% of "
+        "pod slots used, 9 = >=90%)",
+        ("decile",),
+    )
+)
+CLUSTER_NODES = REGISTRY.register(
+    Gauge("scheduler_cluster_nodes", "Valid nodes in the snapshot")
+)
+CLUSTER_PODS_RUNNING = REGISTRY.register(
+    Gauge(
+        "scheduler_cluster_pods_running",
+        "Committed pods in the snapshot (sum of the pods column)",
+    )
+)
+PENDING_PRESSURE = REGISTRY.register(
+    LabeledGauge(
+        "scheduler_pending_pressure_pods",
+        "Pods pending per latency tier (bulk|express active+backoff "
+        "demand; 'parked' = unschedulable pods waiting on an event)",
+        ("tier",),
+    )
+)
+DEVICE_HBM = REGISTRY.register(
+    LabeledGauge(
+        "ktpu_device_hbm_bytes",
+        "Device memory from device.memory_stats(), by device index and "
+        "kind (in_use|peak|limit); absent on backends without stats "
+        "(the CPU fallback reports nothing rather than lying)",
+        ("device", "kind"),
+    )
+)
+COMPILE_CACHE_EVENTS = REGISTRY.register(
+    LabeledCounter(
+        "ktpu_compile_cache_events_total",
+        "Persistent XLA compile-cache events (hit|miss), from "
+        "jax.monitoring via utils/compilecache.py",
+        ("event",),
+    )
+)
+COMPILE_SECONDS = REGISTRY.register(
+    Counter(
+        "ktpu_backend_compile_seconds_total",
+        "Cumulative XLA backend compile seconds this process paid "
+        "(cache hits pay ~0; from jax.monitoring)",
+    )
+)
+LAUNCH_EWMA = REGISTRY.register(
+    LabeledGauge(
+        "scheduler_launch_duration_ewma_seconds",
+        "EWMA of the device dispatch->copy-complete window per "
+        "executable batch width (the per-width launch cost the AIMD "
+        "sizer is implicitly steering); stale widths are remove()d by "
+        "the telemetry hub so the family stays bounded",
+        ("width",),
+        # the AIMD pow2 ladder tops out far below this; the guard fires
+        # only if width labels start leaking non-pow2 values
+        max_children=32,
+    )
+)
+SLO_BURN_RATE = REGISTRY.register(
+    LabeledGauge(
+        "scheduler_slo_burn_rate",
+        "Error-budget burn rate per SLO objective and window "
+        "(fast|slow): 1.0 = burning exactly the budget; an alert fires "
+        "when BOTH windows exceed the objective's threshold",
+        ("objective", "window"),
+    )
+)
+SLO_ALERTS = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_slo_burn_alerts_total",
+        "Multi-window SLO burn alerts fired (each dumps a throttled "
+        "slo_burn flight-recorder postmortem)",
+        ("objective",),
+    )
+)
+TELEMETRY_SECONDS = REGISTRY.register(
+    Counter(
+        "scheduler_telemetry_seconds_total",
+        "Cumulative scheduling-thread seconds spent in the telemetry "
+        "hook (dispatch + materialize + gauges; the <2%-of-cycle-wall "
+        "budget perf_smoke pins)",
+    )
+)
+TELEMETRY_SAMPLES = REGISTRY.register(
+    Counter(
+        "scheduler_telemetry_samples_total",
+        "Cluster-analytics samples materialized into the telemetry ring",
     )
 )
 

@@ -227,6 +227,88 @@ def test_metrics_exposition_valid_after_mixed_live_workload():
         assert families[fam]["type"] == "counter"
 
 
+def test_labeled_families_remove_and_restart():
+    """ISSUE 8 satellite: label-set children are removable — the series
+    disappears from the exposition and restarts from zero if it comes
+    back (the per-width launch-EWMA family depends on this to stay
+    bounded)."""
+    from kubernetes_tpu.utils.metrics import (
+        LabeledCounter,
+        LabeledGauge,
+        LabeledHistogram,
+    )
+
+    c = LabeledCounter("t_rm_counter", label_names=("w",))
+    c.inc(3, w="a")
+    c.inc(1, w="b")
+    assert c.remove(w="a") is True
+    assert c.remove(w="a") is False  # already gone
+    assert 'w="a"' not in c.expose() and 'w="b"' in c.expose()
+    assert c.value(w="a") == 0.0
+    c.inc(w="a")
+    assert c.value(w="a") == 1.0  # restarted from zero
+    assert c.child_count() == 2
+
+    g = LabeledGauge("t_rm_gauge", label_names=("w",))
+    g.set(5, w="x")
+    assert g.remove(w="x") and 'w="x"' not in g.expose()
+
+    h = LabeledHistogram("t_rm_hist", label_names=("tier",))
+    h.observe(0.5, tier="bulk")
+    h.observe(0.5, tier="express")
+    assert h.child_count() == 2
+    assert h.remove(tier="express") is True
+    assert h.remove(tier="express") is False
+    assert 'tier="express"' not in h.expose()
+    assert h.labels(tier="express").total == 0  # fresh ladder
+
+
+def test_labeled_family_cardinality_guard_warns_once():
+    """Past max_children the family logs ONE warning (per family) and
+    keeps recording — a leak is made visible without log spam or data
+    loss."""
+    import logging
+
+    from kubernetes_tpu.utils.metrics import (
+        LabeledCounter,
+        LabeledHistogram,
+    )
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = logging.getLogger("kubernetes_tpu")
+    handler = _Capture(level=logging.WARNING)
+    logger.addHandler(handler)
+    old_level = logger.level
+    logger.setLevel(logging.WARNING)
+    try:
+        c = LabeledCounter("t_guard", label_names=("k",), max_children=3)
+        for i in range(10):
+            c.inc(k=f"v{i}")
+        warns = [r for r in records if "t_guard" in r]
+        assert len(warns) == 1, warns
+        assert "3 label sets" in warns[0]
+        assert c.child_count() == 10  # recording never dropped
+        # an existing key never triggers the guard
+        records.clear()
+        c.inc(k="v0")
+        assert not [r for r in records if "t_guard" in r]
+
+        h = LabeledHistogram("t_guard_h", label_names=("k",),
+                             max_children=2)
+        for i in range(5):
+            h.observe(0.1, k=f"v{i}")
+        assert len([r for r in records if "t_guard_h" in r]) == 1
+        assert h.child_count() == 5
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(old_level)
+
+
 def test_quantile_interpolates_within_bucket():
     """Known distribution: 1000 evenly spaced samples in [0, 1) over
     quarter buckets — p50/p99 must land ~where the true percentiles

@@ -191,6 +191,62 @@ def test_ledger_recording_overhead_under_2_percent(tmp_path):
 
 
 @pytest.mark.perf_smoke
+def test_telemetry_and_heartbeat_overhead_under_2_percent():
+    """ISSUE 8 acceptance: the telemetry hook (SLO events + pressure
+    gauges + the per-cycle analytics side-launch at the default
+    interval of 1) plus a live heartbeat must cost the scheduling
+    thread <2% of cycle wall at perf_smoke scale.  The hook's own
+    cumulative counter (scheduler_telemetry_seconds_total — stamped
+    around the whole scheduler-side seam) is ratioed against the run's
+    wall clock, so the pin is machine-speed independent."""
+    from kubernetes_tpu.utils import metrics as m
+
+    enc = SnapshotEncoder()
+    enc.add_nodes(_nodes())
+    cache = SchedulerCache(enc)
+    queue = PriorityQueue()
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=lambda pod, node: True,
+        config=SchedulerConfig(
+            batch_size=BATCH, batch_window_s=0.0, engine="speculative",
+            disable_preemption=True, batched_commit=True,
+            pipeline_commit=True,
+            heartbeat_s=0.05,  # a LIVE heartbeat rides the measured run
+        ),
+    )
+    assert sched.telemetry is not None
+
+    def drain(budget_s):
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            got = sched.run_once(timeout=0.0)
+            if got == 0 and not sched.pipeline_pending:
+                if not queue.has_schedulable():
+                    break
+                time.sleep(0.002)
+        sched.flush_pipeline()
+
+    for j in range(BATCH):
+        queue.add(make_pod(f"warm-{j}", cpu="50m", mem="64Mi"))
+    drain(120)
+    tel0 = float(m.TELEMETRY_SECONDS.value)
+    t0 = time.monotonic()
+    for i in range(N_PODS):
+        queue.add(make_pod(f"p-{i}", cpu="50m", mem="64Mi",
+                           labels={"app": f"d-{i % 10}"}))
+    drain(120)
+    wall = time.monotonic() - t0
+    spent = float(m.TELEMETRY_SECONDS.value) - tel0
+    assert sched.telemetry.samples_total >= 2
+    ratio = spent / wall
+    assert ratio < 0.02, (
+        f"telemetry hook cost {spent * 1000:.1f}ms of "
+        f"{wall * 1000:.0f}ms ({ratio * 100:.2f}%) — the side-launch is "
+        f"leaking onto the hot path"
+    )
+
+
+@pytest.mark.perf_smoke
 def test_attribution_launch_overhead_bounded():
     """The attribution variant recomputes nothing the default launch
     didn't already have in flight — it adds reductions (first-failure

@@ -336,6 +336,67 @@ def test_slow_cycle_logs_span_breakdown():
     assert "encode" in text and "bind-tail" in text
 
 
+def test_slow_cycle_log_fires_after_full_tail():
+    """ISSUE 8 satellite regression: the slow-cycle log must be stamped
+    AFTER the commit tail completes — by the time it fires, the cycle's
+    span has retired into the flight recorder and the telemetry hook has
+    run, so the logged total is exactly the duration the span tree at
+    /debug/traces reports (it used to fire mid-tail, reporting a number
+    the rest of the tail then outgrew on pipelined cycles)."""
+    import logging
+
+    fr = FlightRecorder()
+    sched, queue = _mini_scheduler(
+        recorder=fr, trace_threshold_s=0.0001, pipeline_commit=True,
+    )
+    seen = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            msg = record.getMessage()
+            if msg.startswith('"schedule_cycle"'):
+                # snapshot what had ALREADY happened when the log fired
+                seen.append((
+                    msg,
+                    {s.trace_id for s in fr.spans()},
+                    sched.telemetry.cycles_total
+                    if sched.telemetry is not None else -1,
+                ))
+
+    logger = logging.getLogger("kubernetes_tpu")
+    handler = _Capture(level=logging.INFO)
+    old_level = logger.level
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        for i in range(3):
+            queue.add(make_pod(f"cycle-{i}", cpu="100m"))
+            sched.run_once(timeout=0.3)
+        sched.flush_pipeline()
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(old_level)
+    assert seen, "threshold 0.1ms must log every cycle"
+    spans = {s.trace_id: s for s in fr.spans()}
+    for i, (msg, ring_ids, tel_cycles) in enumerate(seen):
+        tid = msg.split("trace=")[1].split()[0]
+        # the span had already retired into the ring when the log fired
+        assert tid in ring_ids, (
+            "slow-cycle log fired before the cycle retired into the "
+            "flight recorder"
+        )
+        # ... and the telemetry hook had already run for this cycle
+        assert tel_cycles >= i + 1, (
+            "slow-cycle log fired before the tail's telemetry hook"
+        )
+        # the logged total equals the recorded span's duration (the
+        # number /debug/traces reports), not a mid-tail reading
+        total_ms = float(msg.split("(total ")[1].split("ms")[0])
+        assert total_ms == pytest.approx(
+            spans[tid].duration * 1000, abs=0.05
+        )
+
+
 # ----------------------------------------------------- anomaly postmortems
 
 
