@@ -514,6 +514,17 @@ def run(args) -> dict:
             )
         except Exception as e:  # noqa: BLE001
             detail["latency_tiers_error"] = f"{type(e).__name__}: {e}"
+        # ---- sharded stage (ISSUE 9): the multi-chip live path at the
+        # run's scale — per-cycle placement identity vs single-chip plus
+        # the sharded encode-fits figures, via a subprocess (the virtual
+        # device count must be set before backend init).  CPU child only,
+        # like the tier stage: it is a control-plane identity pin, and
+        # the single budgeted TPU attempt must not spend its window on a
+        # second full drain
+        try:
+            detail["sharded"] = _sharded_stage(args)
+        except Exception as e:  # noqa: BLE001
+            detail["sharded_error"] = f"{type(e).__name__}: {e}"
     out = {
         "metric": "pods_scheduled_per_sec_5k_nodes",
         "value": round(pods_per_s, 1),
@@ -546,6 +557,10 @@ def run(args) -> dict:
         out["tiered_bulk_tput_ratio"] = detail["latency_tiers"][
             "bulk_tput_ratio"
         ]
+    if "sharded" in detail:
+        # the multi-chip acceptance, tracked at top level: sharded
+        # placements bit-identical to single-chip on this very run
+        out["sharded_identity"] = detail["sharded"].get("identical", False)
     return out
 
 
@@ -1017,6 +1032,260 @@ def run_tiered(args, single_lane_ref: "float | None" = None) -> dict:
     }
 
 
+def _ns_with_nodes(args, n_nodes) -> argparse.Namespace:
+    a = argparse.Namespace(**vars(args))
+    a.nodes = n_nodes
+    return a
+
+
+def _sharded_live(args, n_nodes, n_pods, batch,
+                  shard_devices=0, mesh_shape=None) -> dict:
+    """One live control-plane run (queue -> schedule_cycle -> bind) at the
+    given scale, single-chip (shard_devices=0) or sharded, returning the
+    per-pod placements for the identity pin.  Same Scheduler knobs either
+    way so the ONLY variable is the mesh."""
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+    from kubernetes_tpu.runtime.queue import PriorityQueue
+    from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+    a = _ns_with_nodes(args, n_nodes)
+    t_build0 = time.monotonic()
+    enc = _build_encoder(a)
+    build_s = time.monotonic() - t_build0
+    queue = PriorityQueue()
+    sched = Scheduler(
+        cache=SchedulerCache(enc), queue=queue,
+        binder=lambda pod, node: True,
+        config=SchedulerConfig(
+            batch_size=batch, batch_window_s=0.0, engine=args.engine,
+            disable_preemption=True, batched_commit=True,
+            pipeline_commit=True,
+            shard_devices=shard_devices, mesh_shape=mesh_shape,
+        ),
+    )
+
+    def _drain(budget_s: float) -> int:
+        placed = 0
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            got = sched.run_once(timeout=0.0)
+            placed += got
+            if got == 0 and not sched.pipeline_pending:
+                if not queue.has_schedulable():
+                    break
+                time.sleep(0.002)
+        return placed + sched.flush_pipeline()
+
+    # warmup batch outside the timed window (compiles + first fetch)
+    for j in range(batch):
+        queue.add(_pending_pod(a, n_pods + j))
+    _drain(600)
+    pending = [_pending_pod(a, i) for i in range(n_pods)]
+    t0 = time.monotonic()
+    for p in pending:
+        queue.add(p)
+    placed = _drain(900)
+    dt = time.monotonic() - t0
+    return {
+        "pods_per_s": round(placed / dt, 1) if dt > 0 else 0.0,
+        "seconds": round(dt, 3),
+        "placed": placed,
+        "build_seconds": round(build_s, 3),
+        "shard_devices": shard_devices,
+        "mesh_shape": mesh_shape,
+        # warmup + timed placements in commit order: the bit-identity pin
+        # compares the FULL list (same adds either run)
+        "placements": [(r.pod.name, r.node) for r in sched.results],
+    }
+
+
+def _sharded_encode_check(args, n_nodes) -> dict:
+    """The encode-fits half of the --sharded scenario: bulk-encode an
+    n_nodes fleet, upload it SHARDED through the mesh-backed
+    DeviceSnapshotCache, and prove per-device residency — each chip holds
+    1/S of every node-axis tensor (the reason a 50k-node snapshot fits a
+    mesh that no single chip could hold) — then run one sharded analytics
+    reduction over the resident buffers as the compute proof."""
+    import dataclasses
+
+    import jax
+
+    from kubernetes_tpu.codec.transfer import DeviceSnapshotCache
+    from kubernetes_tpu.ops.analytics import (
+        analytics_to_dict,
+        cluster_analytics_auto,
+    )
+    from kubernetes_tpu.parallel.mesh import build_mesh
+
+    a = _ns_with_nodes(args, n_nodes)
+    t0 = time.monotonic()
+    nodes = _bench_nodes(a)
+    t_obj = time.monotonic() - t0
+    t0 = time.monotonic()
+    enc = _build_encoder(a, nodes)
+    encode_s = time.monotonic() - t0
+    cluster = enc.snapshot()
+    total_bytes = sum(
+        np.asarray(getattr(cluster, f.name)).nbytes
+        for f in dataclasses.fields(cluster)
+    )
+    mesh, axis = build_mesh(args.shard_devices or None, args.mesh_shape)
+    dsc = DeviceSnapshotCache(mesh=mesh, spec_axis=axis)
+    t0 = time.monotonic()
+    dev = dsc.update(cluster)
+    jax.block_until_ready(dev.allocatable)
+    upload_s = time.monotonic() - t0
+    per_dev: dict = {}
+    for f in dataclasses.fields(cluster):
+        for sh in getattr(dev, f.name).addressable_shards:
+            d = str(sh.device)
+            per_dev[d] = per_dev.get(d, 0) + int(sh.data.nbytes)
+    t0 = time.monotonic()
+    analytics = analytics_to_dict(
+        cluster_analytics_auto(
+            *dsc.resident(("allocatable", "requested", "valid"))
+        )
+    )
+    analytics_s = time.monotonic() - t0
+    return {
+        "nodes": n_nodes,
+        "node_objects_seconds": round(t_obj, 3),
+        "encode_seconds": round(encode_s, 3),
+        "upload_seconds": round(upload_s, 3),
+        "snapshot_bytes_total": int(total_bytes),
+        "max_device_resident_bytes": max(per_dev.values()),
+        "shards": mesh.size,
+        "encode_ok": analytics["nodes"] == n_nodes,
+        "analytics_seconds": round(analytics_s, 3),
+        "utilization_cpu_mean": analytics["utilization"]["cpu"]["mean"],
+    }
+
+
+def run_sharded(args) -> dict:
+    """--sharded scenario (ISSUE 9): the live multi-chip control plane.
+
+    Phase 1 — identity at scale: the SAME pod stream through the real
+    Scheduler twice (single-chip, then sharded over --shard-devices /
+    --mesh-shape) at --sharded-nodes, pinning bit-identical per-cycle
+    placements across chained batches.  Phase 2 — encode-fits: a
+    --sharded-encode-nodes fleet encoded + uploaded sharded, reporting
+    per-device resident bytes (each chip holds 1/S of the node tensors)
+    and a sharded analytics launch over the resident buffers."""
+    import jax
+
+    from kubernetes_tpu.parallel.mesh import mesh_total
+
+    n_dev = mesh_total(args.mesh_shape, args.shard_devices) or 8
+    have = len(jax.devices())
+    if have < n_dev:
+        raise RuntimeError(
+            f"--sharded needs {n_dev} devices, have {have} (on cpu the "
+            "bench child forces the virtual-device count itself — pass "
+            "--platform cpu)"
+        )
+    n_nodes = args.sharded_nodes
+    n_pods = min(args.pods, 2048)
+    batch = min(args.batch, 256)
+    single = _sharded_live(args, n_nodes, n_pods, batch)
+    sharded = _sharded_live(
+        args, n_nodes, n_pods, batch,
+        shard_devices=args.shard_devices, mesh_shape=args.mesh_shape,
+    )
+    identical = single.pop("placements") == sharded.pop("placements")
+    ratio = (
+        round(sharded["pods_per_s"] / single["pods_per_s"], 3)
+        if single["pods_per_s"] else 0.0
+    )
+    encode = _sharded_encode_check(args, args.sharded_encode_nodes)
+    return {
+        "identical": identical,
+        "devices": n_dev,
+        "mesh_shape": args.mesh_shape,
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "batch": batch,
+        "engine": args.engine,
+        "single_chip": single,
+        "sharded": sharded,
+        "sharded_vs_single_ratio": ratio,
+        "encode": encode,
+    }
+
+
+def run_sharded_metric(args) -> dict:
+    """Standalone --sharded entry: one JSON line in the bench contract.
+    value 1.0 = sharded placements bit-identical to single-chip AND the
+    large-fleet sharded encode landed."""
+    detail = run_sharded(args)
+    ok = detail["identical"] and detail["encode"]["encode_ok"]
+    return {
+        "metric": "sharded_live_identity",
+        "value": 1.0 if ok else 0.0,
+        "unit": "bool",
+        "sharded_pods_per_s": detail["sharded"]["pods_per_s"],
+        "sharded_vs_single_ratio": detail["sharded_vs_single_ratio"],
+        "detail": detail,
+    }
+
+
+def _sharded_stage(args) -> dict:
+    """The default report's `sharded` stage, scaled down to the run's
+    size and executed in a SUBPROCESS: the virtual-device count is baked
+    into XLA_FLAGS at backend init, and this child's backend is already
+    up single-device."""
+    if args.shard_devices < 2:
+        # an explicit --shard-devices 0/1 means single-chip: skip cleanly
+        # (forwarding it would argparse-exit the grandchild with no JSON
+        # line and surface an opaque 'emitted no JSON line' error)
+        raise RuntimeError(
+            f"skipped: --shard-devices {args.shard_devices} < 2 "
+            "(single-chip requested; no sharded leg to compare)"
+        )
+    env = dict(os.environ)
+    env[_CHILD_ENV] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    remaining = (
+        float(os.environ.get(_DEADLINE_ENV, time.time() + 480.0))
+        - time.time()
+    )
+    if remaining < 180.0:
+        # best-effort stage: bowing out beats forcing a >=60s grandchild
+        # into a window the parent's watchdog will kill first, losing the
+        # already-banked headline result
+        raise RuntimeError(
+            f"skipped: {remaining:.0f}s left before the run deadline "
+            "< 180s stage floor"
+        )
+    budget = min(480.0, remaining - 120.0)
+    env[_DEADLINE_ENV] = str(time.time() + budget)
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--sharded",
+        "--platform", "cpu",
+        "--engine", args.engine, "--workload", args.workload,
+        "--pods", str(min(args.pods, 512)),
+        "--batch", str(min(args.batch, 128)),
+        "--shard-devices", str(args.shard_devices),
+        "--sharded-nodes", str(min(args.nodes, 512)),
+        "--sharded-encode-nodes",
+        str(min(max(args.nodes * 2, 1024), 4096)),
+    ]
+    if args.mesh_shape:
+        cmd += ["--mesh-shape", args.mesh_shape]
+    proc = subprocess.run(
+        cmd, env=env, stdout=subprocess.PIPE, timeout=budget + 30,
+        text=True,
+    )
+    res = _last_json_line(proc.stdout)
+    if not res:
+        raise RuntimeError("sharded stage child emitted no JSON line")
+    detail = res.get("detail", res)
+    if "error" in detail:
+        # a grandchild watchdog/error line must surface as sharded_error,
+        # not as sharded_identity=false (a placement-divergence signal)
+        raise RuntimeError(f"sharded stage child failed: {detail['error']}")
+    return detail
+
+
 def run_tiered_metric(args) -> dict:
     """Standalone --tiered entry: one JSON line in the bench contract."""
     detail = run_tiered(args)
@@ -1052,6 +1321,15 @@ def run_child(args) -> None:
     interprets the line; a failure here simply means the parent falls back
     to its banked CPU result."""
     on_cpu = args.platform == "cpu" or os.environ.get("JAX_PLATFORMS") == "cpu"
+    if args.sharded and on_cpu:
+        # the virtual-device count is read ONCE at backend init: force it
+        # before any jax touch (real accelerators bring their own devices)
+        from kubernetes_tpu.parallel.mesh import mesh_total
+        from kubernetes_tpu.utils.jaxenv import set_host_device_count
+
+        set_host_device_count(
+            max(mesh_total(args.mesh_shape, args.shard_devices), 8)
+        )
     deadline = float(os.environ.get(_DEADLINE_ENV,
                                     str(time.time() + args.watchdog)))
     lock = None
@@ -1143,6 +1421,8 @@ def run_child(args) -> None:
                 result = run_density(args)
             elif args.tiered:
                 result = run_tiered_metric(args)
+            elif args.sharded:
+                result = run_sharded_metric(args)
             else:
                 result = run(args)
         except Exception as e:  # compile/runtime failure mid-run
@@ -1245,6 +1525,16 @@ def _child_cmd(args, platform: str | None) -> list:
                 "--overload-duration", str(args.overload_duration)]
     if args.tiered:
         cmd += ["--tiered"]
+    if args.sharded:
+        cmd += ["--sharded",
+                "--sharded-nodes", str(args.sharded_nodes),
+                "--sharded-encode-nodes", str(args.sharded_encode_nodes)]
+    # always forwarded (like --mesh-shape): the default report's sharded
+    # stage must honor an explicit --shard-devices (including 0 = skip),
+    # not have the child re-default it
+    cmd += ["--shard-devices", str(args.shard_devices)]
+    if args.mesh_shape:
+        cmd += ["--mesh-shape", args.mesh_shape]
     cmd += ["--tier-deadline", str(args.tier_deadline)]
     if platform:
         cmd += ["--platform", platform]
@@ -1302,9 +1592,11 @@ def orchestrate(args) -> None:
     # ---- phase 2: exactly ONE TPU attempt inside whatever budget remains.
     remaining = deadline - time.time()
     tpu_min = args.tpu_min_budget
-    if args.platform == "cpu" or args.density or args.overload or args.tiered:
-        # explicit cpu-only run, or density/overload/tiered mode (control-
-        # plane benchmarks — the host runtime dominates, not the device)
+    if (args.platform == "cpu" or args.density or args.overload
+            or args.tiered or args.sharded):
+        # explicit cpu-only run, or density/overload/tiered/sharded mode
+        # (control-plane benchmarks — the host runtime dominates, not the
+        # device; the sharded identity pin runs on the virtual cpu mesh)
         remaining = 0
     if remaining < tpu_min:
         det = banked["result"].setdefault("detail", {})
@@ -1346,12 +1638,13 @@ def orchestrate(args) -> None:
         det["cpu_reference"] = {
             "value": cpu_val,
             "latency_ms": banked["result"].get("detail", {}).get("latency_ms"),
-            # the tier stage runs in the CPU child only (budget
-            # protection); its per-tier figures still ride the emitted
-            # TPU artifact here
+            # the tier + sharded stages run in the CPU child only (budget
+            # protection); their figures still ride the emitted TPU
+            # artifact here
             "latency_tiers": banked["result"].get("detail", {}).get(
                 "latency_tiers"
             ),
+            "sharded": banked["result"].get("detail", {}).get("sharded"),
         }
         _emit(tpu_res)
         return
@@ -1452,6 +1745,27 @@ def main():
                     "scheduler; reports per-tier p50/p99, bulk throughput "
                     "ratio vs single-lane, and a compile-inclusive "
                     "cold_start_seconds (the compile-cache figure)")
+    ap.add_argument("--sharded", action="store_true",
+                    help="multi-chip live-path scenario (ISSUE 9): the "
+                    "same pod stream through the real Scheduler single-"
+                    "chip and sharded over --shard-devices, pinning "
+                    "bit-identical placements at --sharded-nodes scale, "
+                    "plus a --sharded-encode-nodes sharded encode-fits "
+                    "check (per-device resident bytes).  On cpu the "
+                    "child forces the virtual-device count itself")
+    ap.add_argument("--shard-devices", type=int, default=None,
+                    help="devices to shard the node axis across (pow2; "
+                    "config shardDevices; default: the --mesh-shape "
+                    "total, else 8)")
+    ap.add_argument("--mesh-shape", default=None,
+                    help="mesh topology: 'N' (1D node mesh) or 'OxI' "
+                    "(e.g. '2x4', two-level dcn x ici; config meshShape)")
+    ap.add_argument("--sharded-nodes", type=int, default=20000,
+                    help="fleet size for the sharded-vs-single-chip live "
+                    "identity run")
+    ap.add_argument("--sharded-encode-nodes", type=int, default=50000,
+                    help="fleet size for the sharded encode-fits check "
+                    "(each device holds 1/S of every node tensor)")
     ap.add_argument("--tier-deadline", type=float, default=0.08,
                     help="tiered scenario's bulk cycle_deadline_s (the "
                     "express-p99 lever: an express pod waits out at most "
@@ -1519,6 +1833,41 @@ def main():
         help="force a jax platform (e.g. cpu); default = environment (TPU)",
     )
     args = ap.parse_args()
+
+    explicit_shard_cfg = (
+        args.mesh_shape or args.shard_devices is not None
+    )
+    if args.mesh_shape:
+        # --mesh-shape alone implies its total; a malformed shape or a
+        # count/shape conflict fails fast here with the friendly message,
+        # before any leg runs or child spawns
+        from kubernetes_tpu.parallel.mesh import mesh_total
+
+        try:
+            total = mesh_total(args.mesh_shape, 0)
+        except ValueError as e:
+            ap.error(str(e))
+        if args.shard_devices is None:
+            args.shard_devices = total
+        elif total != args.shard_devices:
+            ap.error(f"--shard-devices {args.shard_devices} != "
+                     f"--mesh-shape {args.mesh_shape!r} total {total}")
+    elif args.shard_devices is None:
+        args.shard_devices = 8  # no jax import on default runs
+    if args.sharded and args.shard_devices < 2:
+        ap.error("--sharded needs --shard-devices >= 2 (0 = single-chip "
+                 "is the config default, not a comparable sharded leg)")
+    if explicit_shard_cfg and args.shard_devices >= 2:
+        # pow2/<=512 validation belongs at parse time too: build_mesh
+        # would only reject the count AFTER the single-chip leg drained
+        # (or, on a default run, after the sharded stage spawned a
+        # grandchild that argparse-exits with no JSON line)
+        from kubernetes_tpu.parallel.mesh import validate_device_count
+
+        try:
+            validate_device_count(args.shard_devices)
+        except ValueError as e:
+            ap.error(str(e))
 
     if args.replay:
         run_replay(args)

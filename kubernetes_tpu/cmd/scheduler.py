@@ -123,6 +123,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="one-line liveness heartbeat to the log every "
                    "N seconds (config heartbeatSeconds; 0 disables — "
                    "the default)")
+    p.add_argument("--shard-devices", type=int, default=None,
+                   help="shard the cluster snapshot's node axis across "
+                   "this many devices (config shardDevices; pow2; 0 = "
+                   "single-chip, the default): every scheduling launch, "
+                   "the incremental dirty-row upload, and the telemetry "
+                   "analytics then run sharded with placements "
+                   "bit-identical to single-chip")
+    p.add_argument("--mesh-shape", default=None,
+                   help="mesh topology for --shard-devices (config "
+                   "meshShape): 'N' = 1D node mesh, 'OxI' (e.g. '2x4') "
+                   "= two-level dcn x ici mesh (hosts x chips) — "
+                   "cross-shard reductions then lower hierarchically "
+                   "(intra-host ICI, per-host DCN).  Implies sharding")
     p.add_argument("--simulate-nodes", type=int, default=0,
                    help="register N hollow nodes")
     p.add_argument("--simulate-pods", type=int, default=0,
@@ -182,13 +195,23 @@ def main(argv=None) -> int:
         cc.slo_objectives = json.loads(args.slo_objectives)
     if args.heartbeat_seconds is not None:
         cc.heartbeat_s = args.heartbeat_seconds
+    if args.shard_devices is not None:
+        cc.shard_devices = args.shard_devices
+    if args.mesh_shape is not None:
+        cc.mesh_shape = args.mesh_shape
 
     # persistent compile cache BEFORE any jit compile (engine build,
     # prewarm, first cycle) so every executable of this process is served
-    # from / saved to disk
+    # from / saved to disk.  The cache directory is partitioned by
+    # topology (backend + device count + mesh shape) so an executable
+    # compiled single-chip is never served to a sharded process, or vice
+    # versa (utils/compilecache.py topology_tag)
     from kubernetes_tpu.utils.compilecache import enable_compile_cache
 
-    enable_compile_cache(cc.compile_cache_dir)
+    mesh_extra = None
+    if cc.shard_devices or cc.mesh_shape:
+        mesh_extra = f"mesh{cc.mesh_shape or cc.shard_devices}"
+    enable_compile_cache(cc.compile_cache_dir, topology_extra=mesh_extra)
 
     if args.kubeconfig:
         with open(args.kubeconfig) as f:

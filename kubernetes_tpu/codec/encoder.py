@@ -362,6 +362,40 @@ class SnapshotEncoder:
         self.dims = dataclasses.replace(self.dims, N=new)
         self._regrow_node_arena(old)
 
+    def ensure_node_capacity(self, n: int) -> None:
+        """Grow the node arena (normal growth-schedule steps) until it
+        holds >= n rows.  The sharded Scheduler floors the arena at the
+        mesh device count at startup: every width on the growth schedule
+        (pow2 up to 2048, then 512-multiples) divides over a pow2 mesh of
+        <= 512 devices once the arena is at least that wide, so the
+        divisibility check in DeviceSnapshotCache.update can never fire
+        mid-run from a fleet that stayed small.  Growth also continues
+        until the width DIVIDES n: a non-standard PadDims.N base reaches
+        a divisible width in a few doublings (12 -> 24 divides 8; each
+        doubling adds a factor of two, and every 512-multiple above 2048
+        divides any pow2 mesh of <= 512).  Bounded so a pathological
+        (non-pow2) n is rejected as a config error HERE, at startup — not
+        mid-cycle, where it would read as a device fault and flap the
+        breaker into permanent CPU degradation."""
+        if n <= 0:
+            return
+        # dry-run the growth schedule first: a pathological shard count is
+        # rejected without allocating a single oversized arena
+        target = self._cap_n
+        for _ in range(64):
+            if target >= n and target % n == 0:
+                break
+            target = (target * 2 if target < 2048
+                      else -(-(target + target // 4) // 512) * 512)
+        else:
+            raise ValueError(
+                f"node arena growth never reaches a width divisible over "
+                f"{n} shards from base {self._cap_n} (use a pow2 shard "
+                "count <= 512)"
+            )
+        while self._cap_n < target:
+            self._grow_nodes()
+
     def _regrow_node_arena(self, old_cap: int) -> None:
         """Retile the node arena (bigger N or wider pad dims), preserving the
         overlapping region."""

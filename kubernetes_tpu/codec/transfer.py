@@ -368,6 +368,33 @@ def _scatter_rows(dev, rows, vals):
     return _scatter_donate(dev, rows, vals)
 
 
+# one jitted scatter per (resident sharding, donation) pair — bounded by
+# the handful of distinct field ranks a mesh-backed snapshot carries
+_SCATTER_SHARDED: dict = {}
+
+
+def _scatter_rows_sharded(dev, rows, vals, sharding):
+    """Row scatter into a MESH-SHARDED resident buffer: out_shardings pins
+    the output to the same NamedSharding the resident buffer carries, so
+    XLA's SPMD partitioner routes each row update to the shard that owns
+    the row (a shard drops updates outside its row block locally — the
+    refreshed buffer never gathers to one chip and incremental upload
+    stays O(dirty)).  Donation keeps the `_scatter_rows` semantics
+    per shard on accelerator backends: each device recycles its own
+    block's HBM for the output; XLA:CPU (the virtual test mesh) has no
+    donation, so the copying variant serves it."""
+    donate = jax.default_backend() != "cpu"
+    key = (sharding, donate)
+    fn = _SCATTER_SHARDED.get(key)
+    if fn is None:
+        fn = _SCATTER_SHARDED[key] = jax.jit(
+            _scatter_impl,
+            out_shardings=sharding,
+            donate_argnums=(0,) if donate else (),
+        )
+    return fn(dev, rows, vals)
+
+
 # fields whose leading axis is NOT the node-row axis, or which the encoder
 # recomputes wholesale so their diffs are NOT confined to dirty rows
 # (image_size rescales every row when the node count moves; group_counts
@@ -395,11 +422,44 @@ class DeviceSnapshotCache:
     rows and scatters them into the resident device buffer instead of
     re-shipping the whole tensor — the dirty set is exactly the rows the
     incremental snapshot rewrote, so host arrays cannot differ elsewhere.
+
+    Multi-chip sharding (mesh != None): every node-axis field uploads
+    sharded over the mesh's `spec_axis` (parallel/mesh.py shard_cluster's
+    classification — leading dim == the snapshot's node count; the
+    cluster-wide pair_topo_key vector replicates), so NO single device
+    ever holds the full node tensor, and the dirty-row scatter routes
+    each row delta to the shard that owns the row
+    (_scatter_rows_sharded).  mesh=None is today's single-chip behavior
+    bit-for-bit.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, mesh=None, spec_axis=None) -> None:
         self._host: dict = {}   # field -> last-uploaded host array
         self._dev: dict = {}    # field -> resident device array
+        self._mesh = mesh
+        if mesh is not None and spec_axis is None:
+            names = tuple(mesh.axis_names)
+            spec_axis = names if len(names) > 1 else names[0]
+        self._spec_axis = spec_axis
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def _sharding_for(self, name: str, arr: np.ndarray, n_rows: int):
+        """NamedSharding for one snapshot field (None = unsharded cache),
+        classified by the ONE shared rule (parallel.mesh.node_axis_spec):
+        node-axis fields split over spec_axis, everything else (and the
+        cluster-wide pair_topo_key, whatever its length) replicates."""
+        if self._mesh is None:
+            return None
+        from jax.sharding import NamedSharding
+
+        from kubernetes_tpu.parallel.mesh import node_axis_spec
+
+        return NamedSharding(
+            self._mesh, node_axis_spec(name, arr, n_rows, self._spec_axis)
+        )
 
     def resident(self, names: "tuple[str, ...]"):
         """Device-resident buffers for the named snapshot fields, or None
@@ -446,6 +506,17 @@ class DeviceSnapshotCache:
         rows_arr = None
         if dirty_rows is not None and len(dirty_rows) > 0:
             rows_arr = np.asarray(dirty_rows, np.int32)
+        n_rows = getattr(cluster, "n_nodes", None)
+        if n_rows is None:
+            first = dataclasses.fields(cluster)[0]
+            n_rows = np.asarray(getattr(cluster, first.name)).shape[0]
+        if self._mesh is not None and n_rows % self._mesh.size:
+            raise ValueError(
+                f"snapshot node axis ({n_rows}) does not divide over the "
+                f"{self._mesh.size}-device mesh (node arenas grow pow2 to "
+                "2048 rows then in 512-multiples — use a pow2 mesh of at "
+                "most 512 devices and no larger than the node axis)"
+            )
         for f in dataclasses.fields(cluster):
             host = np.asarray(getattr(cluster, f.name))
             prev = self._host.get(f.name)
@@ -476,10 +547,19 @@ class DeviceSnapshotCache:
                         )
                     else:
                         rows_p, sub_p = rows_arr, sub
-                    dev_rows, dev_vals = jax.device_put((rows_p, sub_p))
-                    self._dev[f.name] = _scatter_rows(
-                        self._dev[f.name], dev_rows, dev_vals
-                    )
+                    if self._mesh is not None:
+                        # rows/vals ship uncommitted (the compiler
+                        # replicates the tiny delta); the scatter routes
+                        # each row to its owning shard
+                        self._dev[f.name] = _scatter_rows_sharded(
+                            self._dev[f.name], rows_p, sub_p,
+                            self._sharding_for(f.name, host, n_rows),
+                        )
+                    else:
+                        dev_rows, dev_vals = jax.device_put((rows_p, sub_p))
+                        self._dev[f.name] = _scatter_rows(
+                            self._dev[f.name], dev_rows, dev_vals
+                        )
                 self._host[f.name] = host
                 continue
             if (
@@ -494,7 +574,14 @@ class DeviceSnapshotCache:
                 self._host[f.name] = host  # content-equal: no upload needed
         if changed:
             with device_annotation("ktpu.snapshot_upload"):
-                uploaded = jax.device_put([staged[n] for n in changed])
+                if self._mesh is not None:
+                    uploaded = jax.device_put(
+                        [staged[n] for n in changed],
+                        [self._sharding_for(n, staged[n], n_rows)
+                         for n in changed],
+                    )
+                else:
+                    uploaded = jax.device_put([staged[n] for n in changed])
             self._dev.update(zip(changed, uploaded))
             self._host.update(staged)
         return type(cluster)(**self._dev)

@@ -113,6 +113,12 @@ class KubeSchedulerConfiguration:
     telemetry_interval_cycles: int = 1
     slo_objectives: Optional[list] = None
     heartbeat_s: float = 0.0
+    # multi-chip sharding (runtime/scheduler.py + parallel/mesh.py): shard
+    # the snapshot's node axis across shardDevices chips (pow2; 0 = the
+    # single-chip path bit-for-bit); meshShape "OxI" (e.g. "2x4") selects
+    # a two-level dcn x ici mesh instead of the 1D node mesh
+    shard_devices: int = 0
+    mesh_shape: Optional[str] = None
 
     def build_profile(self, interner=None) -> SchedulingProfile:
         """CreateFromConfig / CreateFromProvider (scheduler.go:162-192)."""
@@ -189,6 +195,8 @@ class KubeSchedulerConfiguration:
             ),
             slo_objectives=d.get("sloObjectives"),
             heartbeat_s=float(d.get("heartbeatSeconds", 0.0)),
+            shard_devices=int(d.get("shardDevices", 0)),
+            mesh_shape=d.get("meshShape"),
         )
 
     @staticmethod

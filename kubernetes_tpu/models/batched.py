@@ -485,6 +485,14 @@ def _dynamic_scores(cluster, req_cpu_mem, requested2, zone_key_id, counts,
     return least, most, balanced, spread, rtc
 
 
+def _replicated_on_cluster_mesh(cluster):
+    # lives in parallel/mesh.py with the rest of the mesh placement
+    # logic; lazy import keeps this module importable without jax.sharding
+    from kubernetes_tpu.parallel.mesh import replicated_on_cluster_mesh
+
+    return replicated_on_cluster_mesh(cluster)
+
+
 from collections import OrderedDict
 
 _SEQ_CACHE: "OrderedDict" = OrderedDict()
@@ -975,13 +983,17 @@ def make_sequential_scheduler(
         affinity cross-match tensors.  device_put is a no-op passthrough
         for leaves already on the device.  The freshly-transferred batch
         buffers are DONATED into the launch (dead after it by
-        construction: every call re-transfers)."""
+        construction: every call re-transfers).  A mesh-sharded cluster
+        (the multi-chip live path) pins the computation to its mesh, so
+        the batch buffers replicate over the SAME devices — a plain
+        device_put would commit them to device 0 and conflict."""
         if jax.default_backend() != "cpu":
+            tree = (pods, ports, nominated, extra_mask, extra_score,
+                    aff_state)
+            dst = _replicated_on_cluster_mesh(cluster)
             pods, ports, nominated, extra_mask, extra_score, aff_state = (
-                jax.device_put(
-                    (pods, ports, nominated, extra_mask, extra_score,
-                     aff_state)
-                )
+                jax.device_put(tree, dst)
+                if dst is not None else jax.device_put(tree)
             )
         return schedule(cluster, pods, ports, last_index0, nominated,
                         extra_mask, extra_score, aff_state)

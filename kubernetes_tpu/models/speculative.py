@@ -744,8 +744,19 @@ def make_speculative_scheduler(
             # explicit async DMA: host-numpy jit ARGUMENTS cross the
             # remote-attached tunnel on a slow synchronous path (~55MB/s
             # measured vs ~1.4GB/s for device_put), which stalled every
-            # affinity batch ~2s on its [B, ., B] cross-match tensors
-            bufs = jax.device_put(bufs)
+            # affinity batch ~2s on its [B, ., B] cross-match tensors.
+            # A mesh-sharded cluster (multi-chip live path) pins the
+            # launch to its mesh: the packed buffers replicate there
+            # instead of committing to device 0 (which would conflict).
+            from kubernetes_tpu.parallel.mesh import (
+                replicated_on_cluster_mesh,
+            )
+
+            dst = replicated_on_cluster_mesh(cluster)
+            bufs = (
+                jax.device_put(bufs, dst)
+                if dst is not None else jax.device_put(bufs)
+            )
         if on_cpu:
             hosts, req, nz, rounds, inv = _host_rounds(
                 cluster, bufs, meta, last_index0

@@ -249,6 +249,45 @@ def _analytics_jax(allocatable, requested, valid):
 cluster_analytics = jax.jit(_analytics_jax)
 
 
+# one kernel per distinct input-sharding triple — bounded by the handful
+# of mesh layouts a process ever runs (1D node mesh, dcn x ici)
+from functools import lru_cache
+
+
+@lru_cache(maxsize=8)
+def _mesh_kernel(shardings):
+    return jax.jit(_analytics_jax, in_shardings=shardings)
+
+
+def cluster_analytics_auto(allocatable, requested, valid):
+    """Mesh-aware dispatch over the resident snapshot buffers.
+
+    When the inputs carry NamedShardings (a mesh-backed
+    DeviceSnapshotCache — the multi-chip live path), the kernel compiles
+    with those shardings PINNED as in_shardings: the per-node elementwise
+    pass (utilization/free/occupancy one-hots, the packed [N, 23] matrix)
+    stays on the shard that owns each row, the pairwise fold's first
+    log2(N/S) levels are shard-local adds, and only the last log2(S)
+    fold levels plus the percentile sort cross shards — a per-shard
+    reduce with a cross-shard fold, NOT a gather of the full node tensor
+    to one chip (which an unpinned jit could silently re-layout into).
+    Bit-exact vs cluster_analytics_np either way: sharding moves data,
+    never reassociates the order-pinned fold (pinned by
+    tests/test_sharded_live.py).  Unsharded inputs take the classic
+    single-device kernel unchanged."""
+    from jax.sharding import NamedSharding
+
+    shs = tuple(
+        getattr(x, "sharding", None)
+        for x in (allocatable, requested, valid)
+    )
+    if all(isinstance(s, NamedSharding) for s in shs) and any(
+        not s.is_fully_replicated for s in shs
+    ):
+        return _mesh_kernel(shs)(allocatable, requested, valid)
+    return cluster_analytics(allocatable, requested, valid)
+
+
 def cluster_analytics_np(allocatable, requested, valid) -> ClusterAnalytics:
     """The bit-exact numpy reference (and the degraded-mode fallback the
     telemetry hub uses while the device breaker is open)."""

@@ -1,5 +1,7 @@
 from kubernetes_tpu.parallel.mesh import (
+    build_mesh,
     make_mesh,
+    mesh_total,
     shard_cluster,
     replicate,
     NODE_AXIS,

@@ -30,8 +30,101 @@ NODE_AXIS = "nodes"
 def make_mesh(n_devices: Optional[int] = None, axis: str = NODE_AXIS) -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"mesh ({axis},) needs {n_devices} devices, have {len(devs)}"
+            )
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (axis,))
+
+
+def build_mesh(n_devices: Optional[int] = None,
+               shape: Optional[str] = None):
+    """The live Scheduler's mesh constructor (config knobs shardDevices /
+    meshShape) -> (Mesh, spec_axis) where spec_axis is what the node
+    dimension splits over — the axis name for a 1D mesh, the flattened
+    ("dcn", "ici") tuple for a two-level one.
+
+    shape=None/"" builds the 1D node mesh over n_devices; "OxI" (e.g.
+    "2x4") builds the two-level dcn x ici mesh (outer hosts x inner chips
+    — make_mesh_multihost) whose total must match n_devices when both are
+    given.  The total device count must be a power of two: the encoder
+    pads the node axis to a pow2 width, and an uneven split cannot shard
+    it."""
+    if shape:
+        dims = _parse_shape(shape)
+        if len(dims) == 1:
+            if n_devices and n_devices != dims[0]:
+                raise ValueError(
+                    f"shardDevices={n_devices} != meshShape {shape!r} "
+                    f"total {dims[0]}"
+                )
+            n_devices = dims[0]
+        elif len(dims) == 2:
+            outer, inner = dims
+            total = outer * inner
+            if n_devices and n_devices != total:
+                raise ValueError(
+                    f"shardDevices={n_devices} != meshShape {shape!r} "
+                    f"total {total}"
+                )
+            validate_device_count(total)
+            return make_mesh_multihost(outer, inner), (DCN_AXIS, ICI_AXIS)
+    if not n_devices:
+        raise ValueError("sharding requested without a device count "
+                         "(set shardDevices or meshShape)")
+    validate_device_count(n_devices)
+    return make_mesh(n_devices), NODE_AXIS
+
+
+def _parse_shape(shape) -> list:
+    try:
+        dims = [int(p) for p in str(shape).lower().split("x")]
+    except ValueError:
+        raise ValueError(
+            f"meshShape {shape!r} is not 'N' or 'OxI' (e.g. '8', '2x4')"
+        )
+    if len(dims) > 2:
+        raise ValueError(f"meshShape {shape!r} has too many dimensions")
+    if any(d < 1 for d in dims):
+        # a negative pair like "-2x-4" multiplies to a plausible total,
+        # so it would sail through the mesh_total/validate_device_count
+        # preflights and die much later in np.reshape
+        raise ValueError(f"meshShape {shape!r} has non-positive dimensions")
+    return dims
+
+
+def validate_device_count(n: int) -> None:
+    """Reject device counts the sharded control plane cannot serve:
+    non-pow2 (snapshot axes pad to pow2 widths) or > 512 (the node
+    arena growth schedule).  Public so bench/cmd preflights can fail
+    fast before provisioning devices or draining a bench leg."""
+    if n < 1 or n & (n - 1):
+        raise ValueError(
+            f"mesh device count must be a power of two (snapshot node "
+            f"axes pad to pow2 widths), got {n}"
+        )
+    if n > 512:
+        # the encoder's node arena doubles (pow2) up to 2048 rows, then
+        # grows in 512-multiples — every reachable width divides over a
+        # pow2 mesh of <= 512 devices, but a larger mesh can hit a
+        # non-divisible arena (e.g. 2560 % 1024) mid-run
+        raise ValueError(
+            f"mesh device count must be <= 512 (node arenas grow in "
+            f"512-row multiples above 2048), got {n}"
+        )
+
+
+def mesh_total(shape: Optional[str], n_devices: int = 0) -> int:
+    """Total device count a (shardDevices, meshShape) pair asks for —
+    shared by bench/cmd preflight checks (virtual-device provisioning
+    must happen before the backend initializes)."""
+    if shape:
+        total = 1
+        for p in _parse_shape(shape):
+            total *= p
+        return total
+    return int(n_devices)
 
 
 def _mesh_2level(outer: int, inner: int, axes) -> Mesh:
@@ -42,23 +135,42 @@ def _mesh_2level(outer: int, inner: int, axes) -> Mesh:
     return Mesh(np.array(devs[: outer * inner]).reshape(outer, inner), axes)
 
 
+def node_axis_spec(name: str, arr, n_nodes: int, spec_axis=NODE_AXIS) -> P:
+    """THE field-classification rule, shared by shard_cluster and
+    DeviceSnapshotCache: node-axis columns (leading dim == the padded
+    node width) split over spec_axis; everything else — including the
+    cluster-wide pair_topo_key [TP], whatever its length — replicates."""
+    arr = np.asarray(arr)
+    if name != "pair_topo_key" and arr.ndim >= 1 and arr.shape[0] == n_nodes:
+        return P(spec_axis, *([None] * (arr.ndim - 1)))
+    return P(*([None] * arr.ndim))
+
+
 def shard_cluster(cluster: ClusterTensors, mesh: Mesh,
                   spec_axis=NODE_AXIS) -> ClusterTensors:
     """Place every node-axis column sharded over the mesh; small cluster-wide
     vectors (pair_topo_key [TP]) replicated.  spec_axis names the mesh
     axis (or axis tuple, e.g. ("dcn", "ici")) the node dimension splits
-    over — ONE classification heuristic for every layout."""
+    over — ONE classification heuristic (node_axis_spec) for every layout."""
     n = cluster.n_nodes
     out = {}
     for f in dataclasses.fields(cluster):
-        v = getattr(cluster, f.name)
-        arr = np.asarray(v)
-        if arr.ndim >= 1 and arr.shape[0] == n:
-            spec = P(spec_axis, *([None] * (arr.ndim - 1)))
-        else:
-            spec = P(*([None] * arr.ndim))
+        arr = np.asarray(getattr(cluster, f.name))
+        spec = node_axis_spec(f.name, arr, n, spec_axis)
         out[f.name] = jax.device_put(arr, NamedSharding(mesh, spec))
     return ClusterTensors(**out)
+
+
+def replicated_on_cluster_mesh(cluster):
+    """Fully-replicated NamedSharding over the mesh a sharded cluster
+    lives on (None = the cluster is single-device/host — use the default
+    placement).  The seam both engines' host entries use to keep batch
+    uploads on the SAME device set as the snapshot (multi-chip live
+    path, runtime/scheduler.py shardDevices)."""
+    sh = getattr(getattr(cluster, "allocatable", None), "sharding", None)
+    if isinstance(sh, NamedSharding) and sh.mesh.size > 1:
+        return NamedSharding(sh.mesh, P())
+    return None
 
 
 def replicate(tree, mesh: Mesh):

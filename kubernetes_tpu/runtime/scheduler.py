@@ -209,6 +209,22 @@ class SchedulerConfig:
     # (cycles, placed/unschedulable, depths, breaker, AIMD width, HBM
     # live) so a quiet log still proves the loop is alive; 0 = off
     heartbeat_s: float = 0.0
+    # --- multi-chip sharding (ISSUE 9): the live control plane over a
+    # device Mesh (parallel/mesh.py).  shard_devices splits the
+    # snapshot's node axis across that many devices — every engine
+    # launch, the incremental dirty-row upload, and the telemetry
+    # analytics side-launch then run sharded, with only the argmax/
+    # normalize reductions crossing shards (XLA-inserted ICI/DCN
+    # collectives; placements are bit-identical to single-chip, pinned
+    # by tests/test_sharded_live.py).  0 = today's single-chip path
+    # bit-for-bit.  Must be a power of two (node axes pad to pow2).
+    shard_devices: int = 0
+    # mesh topology: None = a 1D node mesh over shard_devices; "OxI"
+    # (e.g. "2x4") = a two-level dcn x ici mesh (hosts x chips-per-host)
+    # with the node axis sharded over both axes flattened, so
+    # cross-shard reductions lower hierarchically (intra-host partials
+    # over ICI, per-host partials over DCN)
+    mesh_shape: Optional[str] = None
     # multi-scheduler: only pods whose spec.schedulerName names THIS
     # scheduler enter its queue (eventhandlers.go responsibleForPod)
     scheduler_name: str = "default-scheduler"
@@ -264,6 +280,8 @@ class SchedulerConfig:
             ),
             slo_objectives=getattr(cc, "slo_objectives", None),
             heartbeat_s=getattr(cc, "heartbeat_s", 0.0),
+            shard_devices=getattr(cc, "shard_devices", 0),
+            mesh_shape=getattr(cc, "mesh_shape", None),
         )
 
 
@@ -440,11 +458,35 @@ class Scheduler:
         self._preempt_eval = make_preempt_eval(
             self.config.filter_config, self._unsched_key
         )
+        # multi-chip sharding (config.shard_devices/mesh_shape): build the
+        # node-axis Mesh ONCE at startup; every snapshot upload and engine
+        # launch then carries NamedShardings and XLA inserts the
+        # cross-shard collectives (no hand-written comms — the
+        # parallel/mesh.py recipe, promoted from the bench-only harness)
+        self.mesh = None
+        mesh_spec_axis = None
+        if self.config.shard_devices or self.config.mesh_shape:
+            from kubernetes_tpu.parallel.mesh import build_mesh
+
+            self.mesh, mesh_spec_axis = build_mesh(
+                self.config.shard_devices or None, self.config.mesh_shape
+            )
+            # floor the node arena at the mesh size NOW: otherwise a
+            # small fleet's arena (e.g. 64 rows under a 128-device mesh)
+            # fails the divisibility check inside the fault-classified
+            # dispatch path, where a static config error would read as a
+            # device fault and flap the breaker into permanent CPU
+            # degradation instead of failing at startup
+            self.cache.encoder.ensure_node_capacity(self.mesh.size)
         # incremental host->device snapshot upload: unchanged fields reuse
-        # their resident device buffers between cycles (codec/transfer.py)
+        # their resident device buffers between cycles (codec/transfer.py);
+        # with a mesh, every node-axis field stays sharded across it and
+        # dirty-row deltas scatter to the owning shard
         from kubernetes_tpu.codec.transfer import DeviceSnapshotCache
 
-        self._dev_snapshot = DeviceSnapshotCache()
+        self._dev_snapshot = DeviceSnapshotCache(
+            mesh=self.mesh, spec_axis=mesh_spec_axis
+        )
         if self.config.engine == "speculative" and not self.config.attribution:
             from kubernetes_tpu.models.speculative import (
                 make_speculative_scheduler,
@@ -1059,6 +1101,7 @@ class Scheduler:
             breaker=self.device_health.state,
             degraded=degraded,
             engine="cpu" if degraded else self._engine_kind,
+            shards=self.mesh.size if self.mesh is not None else 0,
         )
         self._phase("dispatch", time.monotonic() - t_disp, tier)
         inf = _InFlight(
@@ -1913,7 +1956,12 @@ class Scheduler:
                     cluster, dirty_rows=dirty_rows
                 )
                 if jax.default_backend() != "cpu":
-                    batch = jax.device_put(batch)
+                    if self.mesh is not None:
+                        from kubernetes_tpu.parallel.mesh import replicate
+
+                        batch = replicate(batch, self.mesh)
+                    else:
+                        batch = jax.device_put(batch)
                 cands = host_fetch(
                     self._preempt_eval(cluster, batch), tag="preempt"
                 )[0].copy()

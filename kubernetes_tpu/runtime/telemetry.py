@@ -52,7 +52,7 @@ import numpy as np
 
 from kubernetes_tpu.ops.analytics import (
     analytics_to_dict,
-    cluster_analytics,
+    cluster_analytics_auto,
     cluster_analytics_np,
 )
 from kubernetes_tpu.utils import metrics as m
@@ -425,7 +425,12 @@ class TelemetryHub:
                 cluster_fragmentation=sample["analytics"]["fragmentation"],
             )
         if resident is not None:
-            out = cluster_analytics(*resident)
+            # mesh-aware dispatch (ops/analytics.py): sharded resident
+            # buffers reduce per-shard with a cross-shard fold — the full
+            # node tensor never gathers to one chip — and stay bit-exact
+            # vs the numpy reference; single-chip buffers take the
+            # classic kernel unchanged
+            out = cluster_analytics_auto(*resident)
             self._pending = (cycle, tier, out, "device")
         elif host_snapshot is not None:
             out = cluster_analytics_np(*host_snapshot)

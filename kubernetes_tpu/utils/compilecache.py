@@ -86,36 +86,79 @@ def compile_stats() -> dict:
     }
 
 
-def resolve_cache_dir(cache_dir: Optional[str] = None) -> Optional[str]:
+def topology_tag(extra: Optional[str] = None) -> str:
+    """Cache-partition tag for the device topology this process compiles
+    for: platform (+ the forced virtual-device count on cpu) + an
+    optional caller extra (the scheduler passes its mesh shape).
+
+    Computed WITHOUT touching the jax backend: callers (bench.py
+    run_child) enable the cache BEFORE their deadline-guarded backend
+    init, and a wedged tunnel must hang inside that guard, not here.
+    jax's own cache key already hashes the compile options (device
+    assignment included), so the tag is the explicit never-cross-serve
+    partition the mesh knobs demand — a cache written single-chip lives
+    in a different directory than a sharded process's, in both
+    directions — plus per-topology prunability for operators."""
+    import re
+
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    try:  # an in-process config update (cmd --platform) beats the env
+        import jax
+
+        plat = jax.config.jax_platforms or plat
+    except Exception:  # noqa: BLE001 — config knob moved/absent
+        pass
+    plat = (plat or "default").split(",")[0] or "default"
+    tag = plat
+    m = re.search(
+        r"--xla_force_host_platform_device_count=(\d+)",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    if plat == "cpu" and m:
+        tag += f"-d{m.group(1)}"
+    if extra:
+        tag += f"-{extra}"
+    return tag
+
+
+def resolve_cache_dir(cache_dir: Optional[str] = None,
+                      topology: Optional[str] = None) -> Optional[str]:
     """The directory the cache will use: explicit argument, else the
-    KTPU_COMPILE_CACHE_DIR env var, else the default.  None/"" argument
-    means "not specified here" (fall through); the literal "off" (any
-    spelling level) disables the cache and returns None."""
+    KTPU_COMPILE_CACHE_DIR env var, else the default — with a
+    topology_tag() subdirectory appended so executables never cross-serve
+    between device topologies (single-chip vs sharded, different virtual
+    mesh sizes).  None/"" argument means "not specified here" (fall
+    through); the literal "off" (any spelling level) disables the cache
+    and returns None."""
     d = cache_dir or os.environ.get(CACHE_DIR_ENV) or DEFAULT_CACHE_DIR
     if d == DISABLED:
         return None
-    return d
+    return os.path.join(d, topology if topology else topology_tag())
 
 
 def enable_compile_cache(
     cache_dir: Optional[str] = None,
     min_compile_time_s: float = 0.0,
+    topology_extra: Optional[str] = None,
 ) -> Optional[str]:
     """Point jax's persistent compilation cache at resolve_cache_dir(...).
 
     min_compile_time_s=0.0 caches EVERY executable — the runtime's many
     small pow2-width programs are exactly the ones a warm restart wants
     back, and the cold-start acceptance (CI perf_smoke) measures their
-    sum.  Idempotent; safe on any backend (the cpu cache has worked since
-    jax 0.4.16).  Returns the directory in use, or None when disabled.
-    Unknown config knobs on older jax are skipped, never fatal.
+    sum.  `topology_extra` folds into the topology partition tag (the
+    scheduler passes its mesh shape so sharded and single-chip caches
+    can never serve each other).  Idempotent; safe on any backend (the
+    cpu cache has worked since jax 0.4.16).  Returns the directory in
+    use, or None when disabled.  Unknown config knobs on older jax are
+    skipped, never fatal.
     """
     import jax
 
     # compile telemetry rides along wherever the cache is configured:
     # the hit/miss counters only mean something once the cache is live
     install_metrics_listeners()
-    d = resolve_cache_dir(cache_dir)
+    d = resolve_cache_dir(cache_dir, topology=topology_tag(topology_extra))
     if d is None:
         return None
     os.makedirs(d, exist_ok=True)
