@@ -136,6 +136,21 @@ def build_parser() -> argparse.ArgumentParser:
                    "= two-level dcn x ici mesh (hosts x chips) — "
                    "cross-shard reductions then lower hierarchically "
                    "(intra-host ICI, per-host DCN).  Implies sharding")
+    p.add_argument("--shard-breaker-failure-threshold", type=int,
+                   default=None,
+                   help="consecutive classified faults attributed to ONE "
+                   "mesh shard that lose that shard (config "
+                   "shardBreakerFailureThreshold; default 2; a "
+                   "persistent shard fault loses it immediately)")
+    p.add_argument("--no-mesh-shrink", action="store_true",
+                   help="disable the elastic degradation ladder (config "
+                   "meshShrinkEnabled=false): any persistent device "
+                   "fault demotes the whole mesh to the CPU adapter, "
+                   "the pre-ladder behavior")
+    p.add_argument("--no-invariant-checks", action="store_true",
+                   help="disable the online invariant checker (config "
+                   "invariantChecks=false): conservation/double-bind/"
+                   "capacity violations are no longer detected live")
     p.add_argument("--simulate-nodes", type=int, default=0,
                    help="register N hollow nodes")
     p.add_argument("--simulate-pods", type=int, default=0,
@@ -199,6 +214,14 @@ def main(argv=None) -> int:
         cc.shard_devices = args.shard_devices
     if args.mesh_shape is not None:
         cc.mesh_shape = args.mesh_shape
+    if args.shard_breaker_failure_threshold is not None:
+        cc.shard_breaker_failure_threshold = (
+            args.shard_breaker_failure_threshold
+        )
+    if args.no_mesh_shrink:
+        cc.mesh_shrink = False
+    if args.no_invariant_checks:
+        cc.invariant_checks = False
 
     # persistent compile cache BEFORE any jit compile (engine build,
     # prewarm, first cycle) so every executable of this process is served

@@ -27,6 +27,9 @@ Disruptions) arms per SITE:
   fence            the ready-fence (AsyncFetch.result / ready_fence)
   fetch            D2H materialization (host_fetch / the fetch worker)
   snapshot_update  DeviceSnapshotCache.update (H2D delta upload)
+  scatter          the dirty-row scatter into a resident buffer
+                   (_scatter_rows / _scatter_rows_sharded — per-shard on
+                   a mesh, so this is the shard-attributable H2D seam)
 
 Injection is OFF unless an injector is installed (`install_injector`); the
 instrumented code calls `check(site)` / `corrupt(site, arr)` which are
@@ -36,9 +39,10 @@ no-ops otherwise, so the hot path pays one module-global load per site.
 from __future__ import annotations
 
 import random
+import re
 import time
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Callable, Iterable, Optional
 
 import numpy as np
 
@@ -54,14 +58,24 @@ SITE_DISPATCH = "dispatch"
 SITE_FENCE = "fence"
 SITE_FETCH = "fetch"
 SITE_SNAPSHOT_UPDATE = "snapshot_update"
-SITES = (SITE_DISPATCH, SITE_FENCE, SITE_FETCH, SITE_SNAPSHOT_UPDATE)
+# the dirty-row scatter into a resident device buffer (H2D delta path,
+# _scatter_rows / _scatter_rows_sharded): on a mesh each scatter lands on
+# the shard that owns the rows, so a scatter-side fault is exactly the
+# per-shard failure the elastic ladder attributes
+SITE_SCATTER = "scatter"
+SITES = (SITE_DISPATCH, SITE_FENCE, SITE_FETCH, SITE_SNAPSHOT_UPDATE,
+         SITE_SCATTER)
 
 
 class DeviceFault(RuntimeError):
     """Base for classified device-path failures (injected or mapped from
-    real runtime errors).  `fault_class` drives the retry/breaker policy."""
+    real runtime errors).  `fault_class` drives the retry/breaker policy;
+    `device_index` (when known) attributes the fault to ONE device of the
+    mesh — jax device .id — so the scheduler can lose that shard instead
+    of the whole mesh (runtime/scheduler.py elastic degradation ladder)."""
 
     fault_class = FAULT_TRANSIENT
+    device_index: Optional[int] = None
 
 
 class TransientDeviceError(DeviceFault):
@@ -127,12 +141,38 @@ def classify_device_error(err: BaseException) -> Optional[str]:
     return None
 
 
+# "device 3", "device: 3", "device #3", "TPU_2" — the message shapes real
+# runtimes use when they can name the failing chip.  Deliberately narrow:
+# a miss means "unattributed" (whole-mesh policy), never a wrong shard.
+_DEVICE_ID_RE = re.compile(r"\bdevice[ :#]+(\d+)\b|\bTPU_(\d+)\b")
+
+
+def fault_device_index(err: BaseException) -> Optional[int]:
+    """Which device (jax .id) a classified device fault blames, or None
+    when the error names no single device.  Injected faults carry the
+    index as an attribute; real XLA runtime errors are matched against
+    the narrow message patterns above."""
+    idx = getattr(err, "device_index", None)
+    if idx is not None:
+        return int(idx)
+    if isinstance(err, RuntimeError):
+        mt = _DEVICE_ID_RE.search(str(err))
+        if mt is not None:
+            return int(mt.group(1) or mt.group(2))
+    return None
+
+
 @dataclass
 class _Arm:
     kind: str
     p: float
     count: Optional[int]        # max fires; None = unlimited
     latency_s: float
+    # shard-targeted arm: fire only when the instrumented call reports
+    # one of these devices (jax .id) among the devices it touches — the
+    # "mesh device(s) are dead" chaos primitive.  None = untargeted (the
+    # PR 3 behavior: every call at the site faults).
+    device_index: Optional[frozenset] = None
     fired: int = 0
 
 
@@ -157,15 +197,68 @@ class FaultInjector:
         p: float = 1.0,
         count: Optional[int] = None,
         latency_s: float = 0.01,
+        device_index: Optional[int] = None,
     ) -> "FaultInjector":
         if site not in SITES:
             raise ValueError(f"unknown fault site {site!r} (sites: {SITES})")
         if kind not in (FAULT_TRANSIENT, FAULT_PERSISTENT, FAULT_CORRUPT,
                         FAULT_SLOW):
             raise ValueError(f"unknown fault kind {kind!r}")
-        self._arms[site] = _Arm(kind=kind, p=p, count=count,
-                                latency_s=latency_s)
+        if device_index is not None and not isinstance(
+            device_index, (set, frozenset, list, tuple)
+        ):
+            device_index = (device_index,)
+        self._arms[site] = _Arm(
+            kind=kind, p=p, count=count, latency_s=latency_s,
+            device_index=(
+                frozenset(int(d) for d in device_index)
+                if device_index is not None else None
+            ),
+        )
         return self
+
+    def arm_devices(
+        self,
+        site: str,
+        devices: Iterable[int],
+        kind: str = FAULT_PERSISTENT,
+        count: Optional[int] = None,
+    ) -> "FaultInjector":
+        """Merge device targets into the site's arm (creating it when
+        absent).  Unlike re-arming, an existing same-kind targeted arm
+        keeps its consumed fire budget (`fired`) — the accumulate
+        primitive Disruptions.shard_lost builds on (losing a second
+        device must not refresh the first one's count= budget)."""
+        targets = frozenset(int(d) for d in devices)
+        arm = self._arms.get(site)
+        if arm is not None and arm.device_index and arm.kind == kind:
+            arm.device_index = arm.device_index | targets
+            if count is not None:
+                arm.count = count
+            return self
+        return self.arm(site, kind=kind, count=count, device_index=targets)
+
+    def clear_devices(
+        self, site: str, devices: Optional[Iterable[int]] = None
+    ) -> None:
+        """Remove device targets from the site's TARGETED arm (None =
+        all of them), disarming the site when none remain; the arm's
+        remaining budget is preserved.  Untargeted arms are never
+        touched — they belong to other primitives."""
+        arm = self._arms.get(site)
+        if arm is None or arm.device_index is None:
+            return
+        remaining = (
+            arm.device_index - frozenset(int(d) for d in devices)
+            if devices is not None else frozenset()
+        )
+        if remaining:
+            arm.device_index = remaining
+        else:
+            del self._arms[site]
+
+    def is_armed(self, site: str) -> bool:
+        return site in self._arms
 
     def disarm(self, site: Optional[str] = None) -> None:
         if site is None:
@@ -180,11 +273,34 @@ class FaultInjector:
             return False
         return True
 
-    def fire(self, site: str) -> None:
+    def fire(self, site: str,
+             devices: Optional[Iterable[int]] = None) -> None:
         """Raise/sleep per the site's armed fault; corrupt-kind arms are
-        handled by maybe_corrupt (they alter data, not control flow)."""
+        handled by maybe_corrupt (they alter data, not control flow).
+
+        `devices` reports which device ids the instrumented call touches
+        (the mesh's device set at dispatch/scatter, the fetched buffer's
+        sharding at fetch/fence; None = unknown).  A shard-targeted arm
+        (device_index set) fires only when its device is among them — a
+        dead shard faults every computation that involves it, lets
+        everything else through, and the half-open probe of exactly that
+        device (devices=(d,)) keeps failing until the arm clears."""
         a = self._arms.get(site)
-        if a is None or a.kind == FAULT_CORRUPT or not self._should_fire(a):
+        if a is None or a.kind == FAULT_CORRUPT:
+            return
+        hit: Optional[int] = None
+        if a.device_index is not None:
+            if devices is None:
+                return
+            common = a.device_index.intersection(
+                int(d) for d in devices
+            )
+            if not common:
+                return
+            # the error blames ONE device (the attribution contract);
+            # min() keeps repeated fires deterministic
+            hit = min(common)
+        if not self._should_fire(a):
             return
         a.fired += 1
         self.log.append((site, a.kind))
@@ -192,13 +308,16 @@ class FaultInjector:
             time.sleep(a.latency_s)
             return
         if a.kind == FAULT_PERSISTENT:
-            raise PersistentDeviceError(
+            err: DeviceFault = PersistentDeviceError(
                 f"injected device-lost at {site} (fire #{a.fired})"
             )
-        raise TransientDeviceError(
-            f"injected transient XLA error at {site} (fire #{a.fired}): "
-            "UNAVAILABLE: fabric tunnel reset"
-        )
+        else:
+            err = TransientDeviceError(
+                f"injected transient XLA error at {site} (fire #{a.fired}): "
+                "UNAVAILABLE: fabric tunnel reset"
+            )
+        err.device_index = hit
+        raise err
 
     def maybe_corrupt(self, site: str, arr):
         """Scramble a fetched array when the site is armed with a corrupt
@@ -241,11 +360,13 @@ def current_injector() -> Optional[FaultInjector]:
     return _INJECTOR
 
 
-def check(site: str) -> None:
-    """Instrumentation hook: fire the armed fault for `site`, if any."""
+def check(site: str, devices: Optional[Iterable[int]] = None) -> None:
+    """Instrumentation hook: fire the armed fault for `site`, if any.
+    `devices` (optional) names the device ids the call touches so
+    shard-targeted arms can fire selectively (see FaultInjector.fire)."""
     inj = _INJECTOR
     if inj is not None:
-        inj.fire(site)
+        inj.fire(site, devices=devices)
 
 
 def corrupt(site: str, arr):

@@ -84,13 +84,33 @@ def _note_sync(tag: str) -> None:
         fn(tag)
 
 
+def _involved_device_ids(x):
+    """frozenset of jax device ids a device array's sharding spans, or
+    None when unknowable (host arrays, duck-typed handles, or — the
+    common case — no fault injector installed).  The fault-injection
+    seams report these so a shard-targeted arm (faults.FaultInjector
+    device_index) faults exactly the computations that touch the dead
+    device.  Computed ONLY while an injector is live: production runs
+    keep faults.py's no-op contract (one module-global load per site)."""
+    if faults.current_injector() is None:
+        return None
+    sh = getattr(x, "sharding", None)
+    ds = getattr(sh, "device_set", None)
+    if not ds:
+        return None
+    try:
+        return frozenset(int(getattr(d, "id", -1)) for d in ds)
+    except TypeError:
+        return None
+
+
 def host_fetch(x, tag: str = "fetch") -> np.ndarray:
     """The canonical BLOCKING device->host sync point: np.asarray with the
     fence listeners notified first.  Runtime code must fetch through this
     (or AsyncFetch) rather than raw np.asarray so sync counts stay
     observable."""
     _note_sync(tag)
-    faults.check(faults.SITE_FETCH)
+    faults.check(faults.SITE_FETCH, devices=_involved_device_ids(x))
     with device_annotation(f"ktpu.{tag}"):
         return faults.corrupt(faults.SITE_FETCH, np.asarray(x))
 
@@ -185,6 +205,10 @@ class AsyncFetch:
     def __init__(self, dev, tag: str = "fetch") -> None:
         self._dev = dev
         self._tag = tag
+        # device ids this result's sharding spans (a mesh-replicated
+        # winners buffer spans every mesh device): the fault seams below
+        # report them so a lost shard faults this fetch attributably
+        self._devices = _involved_device_ids(dev)
         if hasattr(dev, "copy_to_host_async"):
             dev.copy_to_host_async()
         self._done = threading.Event()
@@ -196,7 +220,7 @@ class AsyncFetch:
 
     def _run(self) -> None:
         try:
-            faults.check(faults.SITE_FETCH)
+            faults.check(faults.SITE_FETCH, devices=self._devices)
             with device_annotation(f"ktpu.{self._tag}"):
                 self._out = faults.corrupt(
                     faults.SITE_FETCH, np.asarray(self._dev)
@@ -216,7 +240,7 @@ class AsyncFetch:
         sync) only when the copy is still in flight.  Fence-site faults
         inject HERE — synchronously on the calling thread, where the
         scheduler's classified-retry wrapper owns recovery."""
-        faults.check(faults.SITE_FENCE)
+        faults.check(faults.SITE_FENCE, devices=self._devices)
         if not self._done.is_set():
             _note_sync(self._tag)
             self._done.wait()
@@ -363,6 +387,7 @@ def _scatter_rows(dev, rows, vals):
     """Row scatter into a resident device buffer (duplicate indices carry
     identical values, so pad-by-repeat is safe).  XLA:CPU has no buffer
     donation — the copying variant keeps warning noise out of cpu runs."""
+    faults.check(faults.SITE_SCATTER, devices=_involved_device_ids(dev))
     if jax.default_backend() == "cpu":
         return _scatter_copy(dev, rows, vals)
     return _scatter_donate(dev, rows, vals)
@@ -382,7 +407,21 @@ def _scatter_rows_sharded(dev, rows, vals, sharding):
     stays O(dirty)).  Donation keeps the `_scatter_rows` semantics
     per shard on accelerator backends: each device recycles its own
     block's HBM for the output; XLA:CPU (the virtual test mesh) has no
-    donation, so the copying variant serves it."""
+    donation, so the copying variant serves it.
+
+    Instrumented as the `scatter` fault seam: a fault here is raised
+    inside the scheduler's classified launch wrapper, and — because the
+    scatter lands on the shard that owns the rows — carries the device
+    ids the delta touches, so the elastic ladder can attribute it to the
+    failing shard instead of demoting the whole mesh.  The id set is
+    only computed while an injector is live (the hot path pays one
+    module-global load, faults.py's contract)."""
+    if faults.current_injector() is not None:
+        from kubernetes_tpu.parallel.mesh import mesh_device_ids
+
+        faults.check(
+            faults.SITE_SCATTER, devices=mesh_device_ids(sharding.mesh)
+        )
     donate = jax.default_backend() != "cpu"
     key = (sharding, donate)
     fn = _SCATTER_SHARDED.get(key)

@@ -119,6 +119,15 @@ class KubeSchedulerConfiguration:
     # a two-level dcn x ici mesh instead of the 1D node mesh
     shard_devices: int = 0
     mesh_shape: Optional[str] = None
+    # elastic degradation ladder (runtime/scheduler.py + runtime/health.py
+    # ShardHealth): shard-attributed faults lose ONE device and rebuild
+    # the mesh over the widest pow2 of survivors (meshShrinkEnabled)
+    # after shardBreakerFailureThreshold consecutive attributed failures
+    # (a persistent shard fault loses it immediately); invariantChecks
+    # keeps the online conservation checker (runtime/invariants.py) on
+    mesh_shrink: bool = True
+    shard_breaker_failure_threshold: int = 2
+    invariant_checks: bool = True
 
     def build_profile(self, interner=None) -> SchedulingProfile:
         """CreateFromConfig / CreateFromProvider (scheduler.go:162-192)."""
@@ -197,6 +206,11 @@ class KubeSchedulerConfiguration:
             heartbeat_s=float(d.get("heartbeatSeconds", 0.0)),
             shard_devices=int(d.get("shardDevices", 0)),
             mesh_shape=d.get("meshShape"),
+            mesh_shrink=bool(d.get("meshShrinkEnabled", True)),
+            shard_breaker_failure_threshold=int(
+                d.get("shardBreakerFailureThreshold", 2)
+            ),
+            invariant_checks=bool(d.get("invariantChecks", True)),
         )
 
     @staticmethod

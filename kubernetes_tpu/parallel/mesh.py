@@ -16,7 +16,7 @@ them (the scaling-book recipe).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import numpy as np
@@ -113,6 +113,48 @@ def validate_device_count(n: int) -> None:
             f"mesh device count must be <= 512 (node arenas grow in "
             f"512-row multiples above 2048), got {n}"
         )
+
+
+def mesh_device_ids(mesh: Optional[Mesh]) -> "frozenset[int]":
+    """The jax device ids a mesh spans (flat order) — the vocabulary the
+    fault-attribution seams (codec/faults.py device_index) and the
+    per-shard breaker bank (runtime/health.ShardHealth) share."""
+    if mesh is None:
+        return frozenset()
+    return frozenset(
+        int(getattr(d, "id", -1))
+        for d in np.asarray(mesh.devices).ravel()
+    )
+
+
+def rebuild_without(full_mesh: Mesh, lost_ids) -> Tuple[Optional[Mesh], Optional[object]]:
+    """The elastic-ladder shrink/rebuild constructor: the WIDEST valid
+    sub-mesh of `full_mesh`'s surviving devices -> (mesh, spec_axis), or
+    (None, None) when nothing survives (the caller falls back to the
+    default single-chip path).
+
+    `lost_ids` are jax device ids (mesh_device_ids vocabulary).  The
+    result is always a 1D node mesh: survivors of a two-level dcn x ici
+    mesh no longer sit on clean DCN boundaries, so the hierarchical
+    layout cannot be preserved — a flat mesh keeps placements
+    bit-identical (sharding is layout, not semantics) at the cost of
+    flat cross-shard reductions until the full mesh restores.  The width
+    is the largest power of two <= the survivor count (snapshot axes pad
+    to pow2, so only pow2 meshes divide them); it is <= the startup
+    width, so the 512-device cap and the arena-divisibility contract
+    (validate_device_count) hold by construction, and survivors keep
+    their flat-order position so repeated shrinks are deterministic."""
+    lost = {int(d) for d in lost_ids}
+    survivors = [
+        d for d in np.asarray(full_mesh.devices).ravel().tolist()
+        if int(getattr(d, "id", -1)) not in lost
+    ]
+    width = 1
+    while width * 2 <= len(survivors):
+        width *= 2
+    if not survivors:
+        return None, None
+    return Mesh(np.array(survivors[:width]), (NODE_AXIS,)), NODE_AXIS
 
 
 def mesh_total(shape: Optional[str], n_devices: int = 0) -> int:

@@ -193,6 +193,51 @@ class Disruptions:
             latency_s=latency_s,
         )
 
+    def shard_lost(
+        self,
+        device_index: int,
+        count: Optional[int] = None,
+        sites: tuple = (
+            device_faults.SITE_DISPATCH,
+            device_faults.SITE_FENCE,
+            device_faults.SITE_SCATTER,
+        ),
+    ) -> device_faults.FaultInjector:
+        """ONE mesh device goes dark (the elastic-ladder monkey): every
+        dispatch/fence/scatter that involves `device_index` (jax device
+        .id) raises a persistent fault ATTRIBUTED to that device, while
+        computations on the surviving devices pass — so the scheduler
+        under test must shrink the mesh, not demote it wholesale.  The
+        half-open probe of exactly that device keeps failing until
+        clear_shard_lost()/clear_device_faults() ends the outage
+        (count=None keeps the shard dead until then).  Repeated calls
+        ACCUMULATE targets — shard_lost(3) then shard_lost(0) keeps both
+        devices dark, the double-loss rung of the ladder matrix."""
+        inj = self._injector()
+        for site in sites:
+            self._armed_sites.add(site)
+            inj.arm_devices(
+                site, {int(device_index)},
+                kind=device_faults.FAULT_PERSISTENT, count=count,
+            )
+        return inj
+
+    def clear_shard_lost(self, device_index: Optional[int] = None) -> None:
+        """End a shard_lost outage — for one device (`device_index`) or
+        all of them (None).  Only device-targeted arms are touched
+        (untargeted arms from other primitives stay), so the scheduler's
+        next lost-shard probe succeeds and the mesh climbs back."""
+        inj = device_faults.current_injector()
+        if inj is None:
+            return
+        for site in list(self._armed_sites):
+            inj.clear_devices(
+                site,
+                None if device_index is None else {int(device_index)},
+            )
+            if not inj.is_armed(site):
+                self._armed_sites.discard(site)
+
     def corrupted_fetch(self, count: Optional[int] = 1) -> device_faults.FaultInjector:
         """Structurally-corrupt D2H results: winner rows scrambled out of
         range so the scheduler's fetch validation must catch them."""

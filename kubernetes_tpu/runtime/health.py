@@ -21,8 +21,9 @@ from __future__ import annotations
 import random
 import threading
 import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from kubernetes_tpu.codec.faults import FAULT_PERSISTENT
 from kubernetes_tpu.runtime.ledger import debug_body
@@ -67,6 +68,7 @@ class DeviceHealth:
         seed: int = 0,
         clock: Callable[[], float] = time.monotonic,
         on_transition: Optional[Callable[[str, str], None]] = None,
+        transitions_maxlen: int = 256,
     ):
         self.failure_threshold = max(1, int(failure_threshold))
         self.open_duration_s = float(open_duration_s)
@@ -80,8 +82,12 @@ class DeviceHealth:
         self.consecutive_failures = 0
         self.fault_counts: Dict[str, int] = {}
         # (from, to) audit trail — the breaker's transition history, pinned
-        # by the chaos tests (open -> half_open -> closed on recovery)
-        self.transitions: List[Tuple[str, str]] = []
+        # by the chaos tests (open -> half_open -> closed on recovery).
+        # BOUNDED: a flapping device transitions forever, and a long-lived
+        # scheduler must not leak memory for it — the deque keeps the
+        # recent window for postmortems while the UNBOUNDED record is the
+        # scheduler_device_breaker_transitions_total counter family.
+        self.transitions: deque = deque(maxlen=max(1, int(transitions_maxlen)))
         self.probes = 0  # half-open canary batches granted
         self._opened_at = 0.0
         # NB: the gauge is only written on TRANSITIONS (its zero-value
@@ -160,6 +166,141 @@ class DeviceHealth:
         m.BREAKER_TRANSITIONS.inc(to=to)
         if self._on_transition is not None:
             self._on_transition(frm, to)
+
+
+class ShardHealth:
+    """Per-shard breaker bank: one circuit breaker PER MESH DEVICE,
+    alongside the global DeviceHealth breaker.
+
+    The global breaker answers "can the device path be trusted at all";
+    this bank answers "which shard is the problem" — the attribution the
+    elastic degradation ladder (runtime/scheduler.py) needs to rebuild
+    the mesh without the failing device instead of demoting an 8-chip
+    control plane to the sequential CPU adapter over one dead shard.
+
+    Per shard, the lifecycle mirrors DeviceHealth: closed -> open on a
+    persistent fault / `failure_threshold` consecutive classified
+    failures / a failed half-open probe; open -> half_open once
+    `open_duration_s` elapses (probe_due); half_open -> closed on a
+    successful probe OF THAT DEVICE (the canary targets the lost shard,
+    not the surviving mesh).  A shard whose breaker is not closed is out
+    of the live mesh (`lost()`).
+
+    Single-scheduling-thread invariant: mutated only from the scheduling
+    thread (fault handling and probes both run there); reads from other
+    threads (telemetry, /debug/cluster, heartbeat) see a
+    consistent-enough snapshot, like DeviceHealth."""
+
+    def __init__(
+        self,
+        device_ids: Iterable[int],
+        failure_threshold: int = 2,
+        open_duration_s: float = 0.05,
+        clock: Callable[[], float] = time.monotonic,
+        on_transition: Optional[Callable[[int, str, str], None]] = None,
+        transitions_maxlen: int = 256,
+    ):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.open_duration_s = float(open_duration_s)
+        self._clock = clock
+        self._on_transition = on_transition
+        self.ids: Tuple[int, ...] = tuple(int(d) for d in device_ids)
+        self._state: Dict[int, str] = {d: BREAKER_CLOSED for d in self.ids}
+        self._consecutive: Dict[int, int] = {d: 0 for d in self.ids}
+        self._opened_at: Dict[int, float] = {}
+        self.fault_counts: Dict[int, Dict[str, int]] = {
+            d: {} for d in self.ids
+        }
+        # (shard, from, to) — bounded like DeviceHealth.transitions; the
+        # unbounded record is the shard-labeled metric families
+        self.transitions: deque = deque(maxlen=max(1, int(transitions_maxlen)))
+        self.probes: Dict[int, int] = {d: 0 for d in self.ids}
+
+    # ------------------------------------------------------------ queries
+
+    def state(self, shard: int) -> str:
+        return self._state[shard]
+
+    def states(self) -> Dict[int, str]:
+        """{device id: breaker state} snapshot (telemetry/debug)."""
+        return dict(self._state)
+
+    def lost(self) -> frozenset:
+        """Device ids currently out of the live mesh (breaker not
+        closed — open or half_open-probing)."""
+        return frozenset(
+            d for d, s in self._state.items() if s != BREAKER_CLOSED
+        )
+
+    def probe_due(self, shard: int) -> bool:
+        """Half-open gate for the lost-shard canary: OPEN moves to
+        HALF_OPEN once the cool-down elapses; HALF_OPEN stays probe-able
+        (at most one probe is in flight on the scheduling thread)."""
+        s = self._state[shard]
+        if s == BREAKER_OPEN and (
+            self._clock() - self._opened_at.get(shard, 0.0)
+            >= self.open_duration_s
+        ):
+            self._transition(shard, BREAKER_HALF_OPEN)
+            s = BREAKER_HALF_OPEN
+        if s == BREAKER_HALF_OPEN:
+            self.probes[shard] += 1
+            return True
+        return False
+
+    # ------------------------------------------------------------ updates
+
+    def record_failure(self, shard: int, fault_class: str) -> bool:
+        """Account one classified fault attributed to `shard`.  Returns
+        True only when this failure NEWLY opened the shard's breaker (the
+        ladder's shrink trigger fires once per loss; repeat faults on an
+        already-lost shard fall through to the global policy)."""
+        self._consecutive[shard] = self._consecutive.get(shard, 0) + 1
+        counts = self.fault_counts.setdefault(shard, {})
+        counts[fault_class] = counts.get(fault_class, 0) + 1
+        m.SHARD_FAULTS.inc(shard=str(shard), **{"class": fault_class})
+        state = self._state[shard]
+        if state == BREAKER_OPEN:
+            # already lost: restart the cool-down, nothing new
+            self._opened_at[shard] = self._clock()
+            return False
+        if (
+            state == BREAKER_HALF_OPEN           # probe of the shard failed
+            or fault_class == FAULT_PERSISTENT   # shard lost
+            or self._consecutive[shard] >= self.failure_threshold
+        ):
+            self._transition(shard, BREAKER_OPEN)
+            self._opened_at[shard] = self._clock()
+            return True
+        return False
+
+    def record_success(self, shard: int) -> None:
+        """A probe of the lost shard succeeded (or a closed shard served
+        cleanly): reset its streak and close its breaker."""
+        self._consecutive[shard] = 0
+        if self._state[shard] != BREAKER_CLOSED:
+            self._transition(shard, BREAKER_CLOSED)
+
+    def heal(self, shards: Iterable[int]) -> None:
+        """A device round-trip over `shards` succeeded: reset their
+        consecutive-failure streaks — the per-shard analog of
+        DeviceHealth.record_success healing the global streak after
+        every clean cycle.  Without this the "consecutive" counter is
+        secretly cumulative: two isolated transients weeks apart would
+        cross the threshold and shrink the mesh.  Only CLOSED shards
+        heal — a lost shard's streak belongs to its half-open probe
+        (record_success), and it was not part of this round-trip."""
+        for d in shards:
+            if self._state.get(d) == BREAKER_CLOSED:
+                self._consecutive[d] = 0
+
+    def _transition(self, shard: int, to: str) -> None:
+        frm = self._state[shard]
+        self._state[shard] = to
+        self.transitions.append((shard, frm, to))
+        m.SHARD_BREAKER_STATE.set(_STATE_GAUGE[to], shard=str(shard))
+        if self._on_transition is not None:
+            self._on_transition(shard, frm, to)
 
 
 class HealthServer:

@@ -127,7 +127,8 @@ class PriorityQueue:
     def __init__(self, backoff: Optional[PodBackoff] = None, less=None,
                  capacity: Optional[int] = None,
                  on_shed: Optional[Callable[[Pod, str], None]] = None,
-                 tier_of: Optional[Callable[[Pod], str]] = None):
+                 tier_of: Optional[Callable[[Pod], str]] = None,
+                 on_requeue: Optional[Callable[[Pod], None]] = None):
         # overload protection: bound the TOTAL queue population
         # (active + backoff + unschedulable).  None = unbounded (the
         # historical behavior).  At capacity, a NEW arrival sheds the
@@ -146,6 +147,13 @@ class PriorityQueue:
         # pop_express_batch — pop()/pop_batch() keep serving the bulk
         # lane.  None = single-lane (every pod bulk, the legacy behavior).
         self.tier_of = tier_of
+        # requeue observer (typically the scheduler's invariant checker,
+        # runtime/invariants.py): called once per pod re-admitted through
+        # ANY requeue seam — add_unschedulable(_batch) and readd — so
+        # "every popped pod ends bound/requeued/shed" is checkable at the
+        # one place all requeue paths funnel through.  Called OUTSIDE the
+        # queue lock, like on_shed.  None = no observer (the default).
+        self.on_requeue = on_requeue
         self.shed_total = 0
         # lower bound on the priority of any TRACKED pod (monotone under
         # admits, reset when the queue is observed empty): lets the
@@ -328,6 +336,17 @@ class PriorityQueue:
             self._unschedulable.pop(_pod_key(pod), None)
             self._push_active(pod)
             self._lock.notify()
+        if self.on_requeue is not None:
+            self.on_requeue(pod)
+
+    def _add_unschedulable_locked(self, pod: Pod, cycle: int) -> None:
+        key = _pod_key(pod)
+        self.backoff.boost(key)
+        if self.move_request_cycle >= cycle:
+            self._push_backoff(pod, self.backoff.backoff_time(key))
+        else:
+            self._unschedulable[key] = (pod, cycle, time.monotonic())
+        self._lock.notify()
 
     def add_unschedulable(self, pod: Pod, cycle: int) -> None:
         """Failed-to-schedule pod (scheduling_queue.go AddUnschedulableIfNotPresent):
@@ -335,23 +354,22 @@ class PriorityQueue:
         backoff (a cluster event might have made it schedulable); otherwise it
         parks in unschedulableQ until an event or the 60s leftover flush."""
         with self._lock:
-            key = _pod_key(pod)
-            self.backoff.boost(key)
-            if self.move_request_cycle >= cycle:
-                self._push_backoff(pod, self.backoff.backoff_time(key))
-            else:
-                self._unschedulable[key] = (pod, cycle, time.monotonic())
-            self._lock.notify()
+            self._add_unschedulable_locked(pod, cycle)
+        if self.on_requeue is not None:
+            self.on_requeue(pod)
 
     def add_unschedulable_batch(self, pods, cycle: int) -> None:
         """add_unschedulable for a whole failed batch under ONE lock
         acquisition (the batched commit path's loser requeue; the
-        Condition wraps an RLock, so the per-pod method re-enters)."""
+        Condition wraps an RLock)."""
         if not pods:
             return
         with self._lock:
             for pod in pods:
-                self.add_unschedulable(pod, cycle)
+                self._add_unschedulable_locked(pod, cycle)
+        if self.on_requeue is not None:
+            for pod in pods:
+                self.on_requeue(pod)
 
     def move_all_to_active(self) -> None:
         """Cluster event: flush unschedulableQ (MoveAllToActiveQueue,

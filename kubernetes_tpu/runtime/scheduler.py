@@ -225,6 +225,29 @@ class SchedulerConfig:
     # cross-shard reductions lower hierarchically (intra-host partials
     # over ICI, per-host partials over DCN)
     mesh_shape: Optional[str] = None
+    # --- elastic degradation ladder (ISSUE 10) ---
+    # mesh shrink-on-failure: when a classified fault is ATTRIBUTED to
+    # one mesh device (codec/faults.fault_device_index) and that shard's
+    # breaker trips, rebuild the mesh over the widest pow2 of the
+    # surviving devices instead of tripping the global breaker — the
+    # ladder full mesh -> shrunken mesh -> single chip -> CPU adapter,
+    # with the in-flight batch served bit-identically by the CPU engine
+    # during the one-cycle transition and a half-open canary probing the
+    # LOST device to restore the original mesh on recovery.  False =
+    # the PR 3 behavior (any persistent fault demotes the whole mesh).
+    mesh_shrink: bool = True
+    # consecutive classified failures attributed to ONE shard that lose
+    # that shard (a persistent shard fault loses it immediately); below
+    # the global breaker_failure_threshold by default so a single sick
+    # device is carved out before the whole mesh is condemned
+    shard_breaker_failure_threshold: int = 2
+    # online invariant checker (runtime/invariants.py): conservation
+    # (every popped pod ends bound/requeued/shed exactly once), no
+    # double-bind, committed usage <= allocatable — fed from the commit
+    # seams, firing scheduler_invariant_violations_total{rule=} + a
+    # flight-recorder postmortem on violation.  Always-on by design
+    # (dict-ops per event); False removes the hooks entirely.
+    invariant_checks: bool = True
     # multi-scheduler: only pods whose spec.schedulerName names THIS
     # scheduler enter its queue (eventhandlers.go responsibleForPod)
     scheduler_name: str = "default-scheduler"
@@ -282,6 +305,11 @@ class SchedulerConfig:
             heartbeat_s=getattr(cc, "heartbeat_s", 0.0),
             shard_devices=getattr(cc, "shard_devices", 0),
             mesh_shape=getattr(cc, "mesh_shape", None),
+            mesh_shrink=getattr(cc, "mesh_shrink", True),
+            shard_breaker_failure_threshold=getattr(
+                cc, "shard_breaker_failure_threshold", 2
+            ),
+            invariant_checks=getattr(cc, "invariant_checks", True),
         )
 
 
@@ -426,6 +454,42 @@ class Scheduler:
             and getattr(self.queue, "tier_of", "n/a") is None
         ):
             self.queue.tier_of = self._tier_of
+        # online invariant checker (ISSUE 10, runtime/invariants.py):
+        # conservation of popped pods, no double-bind, capacity — fed
+        # from the pop/bind/requeue/shed seams below.  The queue's
+        # on_requeue observer funnels EVERY requeue path (unschedulable
+        # verdicts, bind rollbacks, gang surplus readds, batch-loss
+        # guards) through one hook.  The conservation/double-bind rules
+        # are only SOUND when that seam is observable: a requeue the
+        # checker never hears makes the next pop (or re-bind) read as a
+        # false violation.  A caller-owned observer is chained; a
+        # duck-typed queue without the hook disables the checker (with a
+        # log line) rather than crying wolf on a healthy control plane.
+        self.invariants = None
+        if self.config.invariant_checks:
+            if hasattr(self.queue, "on_requeue"):
+                from kubernetes_tpu.runtime.invariants import InvariantChecker
+
+                self.invariants = InvariantChecker(
+                    on_violation=self._on_invariant_violation
+                )
+                prior = self.queue.on_requeue
+                if prior is None:
+                    self.queue.on_requeue = self.invariants.note_requeued
+                else:
+                    note = self.invariants.note_requeued
+
+                    def _chained_requeue(pod, _prior=prior, _note=note):
+                        _prior(pod)
+                        _note(pod)
+
+                    self.queue.on_requeue = _chained_requeue
+            else:
+                klog.infof(
+                    "invariant checker disabled: queue %s has no "
+                    "on_requeue seam to observe",
+                    type(self.queue).__name__,
+                )
         self.binder = binder if binder is not None else (lambda pod, node: True)
         enc = self.cache.encoder
         prof = self.config.profile
@@ -478,6 +542,41 @@ class Scheduler:
             # device fault and flap the breaker into permanent CPU
             # degradation instead of failing at startup
             self.cache.encoder.ensure_node_capacity(self.mesh.size)
+        # elastic degradation ladder (ISSUE 10): the STARTUP mesh is the
+        # ladder's top rung — shrinks rebuild from it minus the lost
+        # shards, the climb-back restores it whole.  ShardHealth is the
+        # per-device breaker bank the fault attribution feeds.
+        self._full_mesh = self.mesh
+        self._full_spec_axis = mesh_spec_axis
+        self._mesh_spec_axis = mesh_spec_axis
+        # the compile-cache partition in use at startup (None = this
+        # process never enabled one): a mesh rebuild re-points the cache
+        # RELATIVE to this, and climb-back restores exactly it — whoever
+        # enabled it (cmd/scheduler's topology tag, an embedded caller's
+        # own convention, or nobody)
+        self._startup_cache_dir = None
+        if self.mesh is not None:
+            import jax as _jax
+
+            self._startup_cache_dir = getattr(
+                _jax.config, "jax_compilation_cache_dir", None
+            )
+        self.shard_health = None
+        if self.mesh is not None:
+            from kubernetes_tpu.parallel.mesh import mesh_device_ids
+            from kubernetes_tpu.runtime.health import ShardHealth
+
+            self._mesh_ids = mesh_device_ids(self.mesh)
+            self.shard_health = ShardHealth(
+                device_ids=sorted(self._mesh_ids),
+                failure_threshold=(
+                    self.config.shard_breaker_failure_threshold
+                ),
+                open_duration_s=self.config.breaker_open_s,
+                on_transition=self._on_shard_transition,
+            )
+        else:
+            self._mesh_ids = None
         # incremental host->device snapshot upload: unchanged fields reuse
         # their resident device buffers between cycles (codec/transfer.py);
         # with a mesh, every node-axis field stays sharded across it and
@@ -487,6 +586,7 @@ class Scheduler:
         self._dev_snapshot = DeviceSnapshotCache(
             mesh=self.mesh, spec_axis=mesh_spec_axis
         )
+        m.MESH_WIDTH.set(float(self.mesh.size if self.mesh is not None else 0))
         if self.config.engine == "speculative" and not self.config.attribution:
             from kubernetes_tpu.models.speculative import (
                 make_speculative_scheduler,
@@ -650,6 +750,13 @@ class Scheduler:
         width; placement semantics are tier-independent."""
         self.flush_pipeline()
         try:
+            # climb-back check between cycles (cheap no-op while no shard
+            # is lost): runs with the pipeline drained so a mesh swap
+            # never races an in-flight batch.  INSIDE the batch-loss
+            # guard: an unclassified probe error (a real runtime's
+            # device_put can raise anything) must requeue the
+            # already-popped batch, not drop it
+            self._maybe_probe_shards()
             inf = self._encode_and_dispatch(pods, tier=tier)
         except BaseException:
             # popped pods must never be lost: a fault that escaped the
@@ -726,6 +833,18 @@ class Scheduler:
             "breaker": self.device_health.state,
             "consecutive_failures": self.device_health.consecutive_failures,
             "fault_counts": dict(self.device_health.fault_counts),
+            # elastic-ladder facts: the rung + shard states a postmortem
+            # reader joins against the fault class/shard on the span
+            "mesh_width": self.mesh.size if self.mesh is not None else 0,
+            "ladder_rung": self.ladder_rung,
+            "shard_breakers": (
+                {str(k): v for k, v in self.shard_health.states().items()}
+                if self.shard_health is not None else None
+            ),
+            "invariants": (
+                self.invariants.summary()
+                if self.invariants is not None else None
+            ),
             "adaptive_batch": self._cur_batch,
             "pipeline_pending": self.pipeline_pending,
             "scheduling_cycle": self.queue.scheduling_cycle,
@@ -767,11 +886,248 @@ class Scheduler:
         )
         if to == "open":
             self._postmortem("breaker_open", f"{frm} -> {to}")
+        m.LADDER_RUNG.set(float(self.RUNG_GAUGE[self.ladder_rung]))
+
+    # ----------------------------------------- elastic degradation ladder
+    #
+    # full mesh -> shrunken mesh (widest pow2 of survivors) -> single
+    # chip (a 1-device mesh) -> CPU adapter.  Shard-ATTRIBUTED faults
+    # (codec/faults.fault_device_index) feed the per-shard breaker bank;
+    # a shard's breaker tripping rebuilds the mesh without it instead of
+    # tripping the global breaker, the in-flight batch is served
+    # bit-identically by the CPU engine for the one gap cycle, and the
+    # half-open canary probes the LOST device to climb back up.
+    # Unattributed faults keep the PR 3 whole-mesh policy.
+
+    RUNG_FULL = "full_mesh"
+    RUNG_SHRUNKEN = "shrunken_mesh"
+    RUNG_SINGLE = "single_chip"
+    RUNG_CPU = "cpu"
+    RUNG_GAUGE = {RUNG_FULL: 0, RUNG_SHRUNKEN: 1, RUNG_SINGLE: 2,
+                  RUNG_CPU: 3}
+
+    @property
+    def ladder_rung(self) -> str:
+        """Which rung currently serves cycles.  The global breaker wins
+        (open/half-open = the device path as a whole is untrusted); an
+        unsharded scheduler's healthy rung is single_chip."""
+        if self.config.cpu_fallback and not self.device_health.device_available:
+            return self.RUNG_CPU
+        if self.mesh is None or self.mesh.size == 1:
+            return self.RUNG_SINGLE
+        if (
+            self._full_mesh is not None
+            and self.mesh.size < self._full_mesh.size
+        ):
+            return self.RUNG_SHRUNKEN
+        return self.RUNG_FULL
+
+    def _on_shard_transition(self, shard: int, frm: str, to: str) -> None:
+        """Shard-breaker transitions are operator-visible, like the
+        global breaker's (the per-shard rows in the README failure
+        table)."""
+        reason = {
+            "open": "ShardBreakerOpen",
+            "half_open": "ShardBreakerHalfOpen",
+            "closed": "ShardBreakerClosed",
+        }[to]
+        self.recorder.eventf(
+            "Scheduler", "", self.config.scheduler_name,
+            EVENT_TYPE_WARNING if to == "open" else EVENT_TYPE_NORMAL,
+            reason,
+            "device shard %d breaker %s -> %s", shard, frm, to,
+        )
+
+    def _on_invariant_violation(self, rule: str, detail: str) -> None:
+        """An invariant violation is the anomaly class the flight
+        recorder exists for: the control plane's own accounting broke."""
+        self.recorder.eventf(
+            "Scheduler", "", self.config.scheduler_name,
+            EVENT_TYPE_WARNING, "InvariantViolation",
+            "%s: %s", rule, detail,
+        )
+        self._postmortem("invariant_violation", f"{rule}: {detail}")
+
+    def _shard_of(self, err: BaseException) -> Optional[int]:
+        """Which shard (device id of the STARTUP mesh) a classified fault
+        blames, or None for whole-mesh attribution.  Only ids the shard
+        bank tracks count — a foreign id from a message pattern must not
+        grow the bank."""
+        if self.shard_health is None:
+            return None
+        idx = device_faults.fault_device_index(err)
+        if idx is None or idx not in self.shard_health._state:
+            return None
+        return idx
+
+    def _note_shard_fault(self, shard: Optional[int], fc: str) -> bool:
+        """Feed one shard-attributed fault to the ladder.  Returns True
+        when the fault was ABSORBED by a mesh shrink (the caller then
+        serves the in-flight batch degraded and skips the global breaker
+        accounting); False routes the fault to the whole-mesh policy —
+        unattributed faults, shrink disabled, faults below the shard
+        threshold (global transient retry still applies), and repeat
+        faults on an already-lost shard (so a wrong rebuild cannot loop:
+        the global breaker eventually trips)."""
+        if (
+            shard is None
+            or self.shard_health is None
+            or not self.config.mesh_shrink
+        ):
+            return False
+        newly_lost = self.shard_health.record_failure(shard, fc)
+        if not newly_lost:
+            return False
+        self._rebuild_mesh(
+            direction="shrink",
+            reason=f"shard {shard} lost ({fc})",
+        )
+        return True
+
+    def _rebuild_mesh(self, direction: str, reason: str) -> None:
+        """Rebuild the live mesh from the startup mesh minus the
+        currently-lost shards (the widest valid sub-mesh), swap in a
+        FRESH DeviceSnapshotCache (the invalidate seam: the next cycle's
+        update() re-uploads the host-truth snapshot sharded onto the new
+        mesh), and re-partition the compile-cache topology tag.  Runs on
+        the scheduling thread only (the _dev_snapshot single-thread
+        invariant)."""
+        from kubernetes_tpu.codec.transfer import DeviceSnapshotCache
+        from kubernetes_tpu.parallel.mesh import (
+            mesh_device_ids,
+            rebuild_without,
+        )
+
+        lost = self.shard_health.lost() if self.shard_health else frozenset()
+        if lost:
+            new_mesh, axis = rebuild_without(self._full_mesh, lost)
+        else:
+            new_mesh, axis = self._full_mesh, self._full_spec_axis
+        self.mesh = new_mesh
+        self._mesh_spec_axis = axis
+        self._mesh_ids = mesh_device_ids(new_mesh) if new_mesh else None
+        self._dev_snapshot = DeviceSnapshotCache(
+            mesh=new_mesh, spec_axis=axis
+        )
+        self._retag_compile_cache()
+        width = new_mesh.size if new_mesh is not None else 0
+        m.MESH_WIDTH.set(float(width))
+        m.MESH_REBUILDS.inc(direction=direction)
+        m.LADDER_RUNG.set(float(self.RUNG_GAUGE[self.ladder_rung]))
+        full = self._full_mesh.size if self._full_mesh is not None else 0
+        klog.errorf(
+            "mesh %s: %s -> serving from %d/%d devices (rung %s)",
+            direction, reason, width, full, self.ladder_rung,
+        )
+        self.recorder.eventf(
+            "Scheduler", "", self.config.scheduler_name,
+            EVENT_TYPE_WARNING if direction == "shrink"
+            else EVENT_TYPE_NORMAL,
+            "MeshShrunk" if direction == "shrink" else "MeshRestored",
+            "%s: live mesh now %d/%d devices (%s)",
+            reason, width, full, self.ladder_rung,
+        )
+        if direction == "shrink":
+            self._postmortem("mesh_shrink", reason)
+
+    def _retag_compile_cache(self) -> None:
+        """Re-point the persistent compile cache at a partition for the
+        CURRENT mesh width: a shrunken mesh's executables (new input
+        shardings = new programs) must neither overwrite nor be served
+        from the full-mesh partition.  Only when THIS process had a
+        cache enabled at startup (recorded with the mesh) — a rebuild
+        must never silently turn on disk caching nobody configured —
+        and the shrink partition derives from that recorded directory,
+        so climb-back restores the exact startup partition whatever
+        convention enabled it (cmd/scheduler's topology tag, an
+        embedded caller's own)."""
+        base = self._startup_cache_dir
+        if base is None:
+            return
+        if (
+            self._full_mesh is not None
+            and self.mesh is not None
+            and self.mesh.size == self._full_mesh.size
+        ):
+            d = base  # back on the startup mesh: the startup partition
+        else:
+            width = self.mesh.size if self.mesh is not None else 1
+            d = f"{base}-shrink{width}"
+        try:
+            import os
+
+            import jax
+
+            os.makedirs(d, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", d)
+        except Exception as e:  # noqa: BLE001 — a cache misconfiguration
+            # must never block a mesh rebuild mid-incident
+            klog.errorf("compile-cache retag failed: %s", e)
+
+    def _maybe_probe_shards(self) -> None:
+        """The climb-back: once per lost shard whose cool-down elapsed,
+        probe THE LOST DEVICE (not the surviving mesh — the scheduling
+        cycles already canary that) and restore the widest mesh when it
+        answers.  Runs on the scheduling thread between cycles, so mesh
+        swaps never race a dispatch."""
+        sh = self.shard_health
+        if sh is None or not sh.lost():
+            return
+        if self._in_flight is not None:
+            # a dispatched batch still references the current mesh's
+            # buffers/closures: let it land first (its commit path calls
+            # back here via schedule_cycle/run_once soon enough)
+            return
+        recovered = False
+        for d in sorted(sh.lost()):
+            if not sh.probe_due(d):
+                continue
+            try:
+                self._probe_shard(d)
+            except BaseException as e:
+                fc = classify_device_error(e)
+                if fc is None:
+                    raise
+                # failed canary: the shard re-opens and the cool-down
+                # restarts (record_failure in HALF_OPEN always re-opens)
+                sh.record_failure(d, fc)
+                continue
+            sh.record_success(d)
+            recovered = True
+        if recovered:
+            self._rebuild_mesh(
+                direction="restore",
+                reason="lost shard recovered (half-open probe passed)",
+            )
+
+    def _probe_shard(self, shard: int) -> None:
+        """One canary round-trip against a single (lost) device: the
+        injection seams fire for exactly this device, and on real
+        hardware a put+fetch raises the runtime's device-lost error while
+        the chip is gone.  Any classified error = still lost."""
+        device_faults.check(
+            device_faults.SITE_DISPATCH, devices=(shard,)
+        )
+        dev = None
+        if self._full_mesh is not None:
+            dev = next(
+                (d for d in np.asarray(self._full_mesh.devices).ravel()
+                 if int(getattr(d, "id", -1)) == shard),
+                None,
+            )
+        if dev is not None:
+            buf = jax.device_put(np.zeros(8, np.float32), dev)
+            device_faults.check(
+                device_faults.SITE_FENCE, devices=(shard,)
+            )
+            np.asarray(buf)
 
     def _on_shed(self, pod: Pod, reason: str) -> None:
         """Bounded-queue shed audit (runtime/queue.py on_shed): one
         Warning event per dropped pod, mirroring the FailedScheduling
         trail (the metric lives with the queue)."""
+        if self.invariants is not None:
+            self.invariants.note_shed(pod)
         self.recorder.eventf(
             "Pod", pod.namespace, pod.name,
             EVENT_TYPE_WARNING, "SchedulingQueueFull",
@@ -838,15 +1194,29 @@ class Scheduler:
         self._postmortem("degraded_cycle", "fence gave up on the device")
 
     def _fault_retry_allowed(
-        self, fc: str, attempt: int, can_relaunch: bool = True
+        self, fc: str, attempt: int, can_relaunch: bool = True,
+        shard: Optional[int] = None,
     ) -> bool:
         """THE retry policy, shared by the dispatch and fence wrappers:
-        account the classified failure with the breaker, and decide
-        whether one more same-batch attempt is allowed (counting the
-        retry metric and sleeping the jittered backoff when it is).  On
-        False the device has been given up on for this batch — the
-        resident snapshot buffers are invalidated (a partial upload may
-        have landed) and the caller degrades or raises."""
+        account the classified failure, and decide whether one more
+        same-batch attempt is allowed (counting the retry metric and
+        sleeping the jittered backoff when it is).  On False the device
+        has been given up on FOR THIS BATCH — the resident snapshot
+        buffers are invalidated (a partial upload may have landed) and
+        the caller degrades or raises.
+
+        Shard-attributed faults (`shard` = a startup-mesh device id) try
+        the elastic ladder first: a fault that LOSES the shard rebuilds
+        the mesh without it and returns False without touching the
+        global breaker — the next cycle dispatches on the shrunken mesh
+        while only this batch rides the CPU adapter.  Shard faults below
+        the shard threshold fall through to the global policy (same-
+        batch transient retry), as do unattributed faults."""
+        if self._note_shard_fault(shard, fc):
+            # the mesh was rebuilt: _dev_snapshot is already a fresh
+            # cache for the NEW mesh; this batch's launch state belongs
+            # to the old one, so no same-batch retry
+            return False
         tripped = self.device_health.record_failure(fc)
         if (
             not tripped
@@ -880,18 +1250,22 @@ class Scheduler:
                 fc = classify_device_error(e)
                 if fc is None:
                     raise
+                shard = self._shard_of(e)
                 self._note_device_fault(
                     fc, e, "dispatch" if relaunch_pending else "fence"
                 )
                 # the span carries the LAST retry class + attempt count —
                 # the two facts a postmortem reader joins against the
-                # breaker state
+                # breaker state (plus the blamed shard when attributed)
                 inf.trace.annotate(fault_class=fc, fault_attempts=attempt + 1)
+                if shard is not None:
+                    inf.trace.annotate(fault_shard=shard)
                 if self._fault_retry_allowed(
                     fc, attempt,
                     can_relaunch=(
                         not inf.degraded and inf.relaunch is not None
                     ),
+                    shard=shard,
                 ):
                     attempt += 1
                     relaunch_pending = True
@@ -904,6 +1278,10 @@ class Scheduler:
                 # an actual device round-trip succeeded: heal the streak
                 # (and close the breaker if this was the half-open canary)
                 self.device_health.record_success()
+                if self.shard_health is not None and self._mesh_ids:
+                    # ...and the per-shard streaks of the devices that
+                    # served it (keeps "consecutive" consecutive)
+                    self.shard_health.heal(self._mesh_ids)
             return staged
 
     def _encode_and_dispatch(self, pods: Sequence[Pod],
@@ -1035,7 +1413,9 @@ class Scheduler:
             same computation with the same rotation base; dirty_rows are
             re-passed safely — fields whose upload already landed identity-
             skip, fields whose upload faulted re-scatter."""
-            device_faults.check(device_faults.SITE_DISPATCH)
+            device_faults.check(
+                device_faults.SITE_DISPATCH, devices=self._mesh_ids
+            )
             dev_cluster = self._dev_snapshot.update(
                 cluster, dirty_rows=dirty_rows
             )
@@ -1145,7 +1525,9 @@ class Scheduler:
                 if fc is None:
                     raise
                 self._note_device_fault(fc, e, "dispatch")
-                if self._fault_retry_allowed(fc, attempt):
+                if self._fault_retry_allowed(
+                    fc, attempt, shard=self._shard_of(e)
+                ):
                     attempt += 1
                     continue
                 if not self.config.cpu_fallback:
@@ -1259,6 +1641,16 @@ class Scheduler:
             winners.append((i, pod, assumed, node_name))
         # ONE lock acquisition + one encoder delta for the whole batch
         self.cache.assume_pods([a for _, _, a, _ in winners])
+        if self.invariants is not None and winners:
+            # capacity invariant over exactly the rows this batch
+            # committed to — O(batch), read under the cache lock so the
+            # arrays are consistent with the delta just applied
+            rows = sorted({int(hosts[i]) for i, _, _, _ in winners})
+            with self.cache._lock:
+                self.invariants.check_capacity(
+                    rows, enc.a_requested, enc.a_allocatable,
+                    row_name=enc.row_name,
+                )
         staged.state_seconds = time.monotonic() - t_state0
         inf.trace.add_child(
             "commit", t_state0, time.monotonic(), winners=len(winners),
@@ -1342,6 +1734,26 @@ class Scheduler:
         hub.record_pressure(
             bulk=max(0, active - express), express=express,
             parked=max(0, len(q) - active),
+        )
+        # ladder telemetry (ISSUE 10): live mesh width, the rung serving
+        # cycles, per-shard breaker states, invariant-checker totals —
+        # sampled fresh every cycle so /debug/cluster reflects rebuilds
+        rung = self.ladder_rung
+        m.LADDER_RUNG.set(float(self.RUNG_GAUGE[rung]))
+        hub.record_mesh(
+            width=self.mesh.size if self.mesh is not None else 0,
+            full_width=(
+                self._full_mesh.size if self._full_mesh is not None else 0
+            ),
+            rung=rung,
+            shard_states=(
+                self.shard_health.states()
+                if self.shard_health is not None else None
+            ),
+            invariants=(
+                self.invariants.summary()
+                if self.invariants is not None else None
+            ),
         )
         if not inf.degraded and inf.fetch is not None:
             hub.note_launch(inf.width or len(inf.pods), inf.fetch.seconds)
@@ -1592,6 +2004,8 @@ class Scheduler:
                 bound.append((i, pod, node_name))
                 bound_qts.append(winner_qts[w])
                 bound_ts.append(tb)
+                if self.invariants is not None:
+                    self.invariants.note_bound(pod, node_name)
                 # a pod that failed an earlier cycle may carry the
                 # unschedulable-reason annotation: stale once it binds
                 pod.metadata.annotations.pop(
@@ -1752,6 +2166,8 @@ class Scheduler:
         through the queue (the density SLO pair: throughput + p99,
         density.go:988-990); the caller's algo+bind figure is the fallback
         for direct schedule_cycle() calls."""
+        if self.invariants is not None:
+            self.invariants.note_bound(pod, node_name)
         qt = self.queue.take_enqueue_time(pod)
         if qt is not None:
             e2e = time.monotonic() - qt
@@ -1969,11 +2385,13 @@ class Scheduler:
                 fc = classify_device_error(e)
                 if fc is None:
                     raise
-                # preempt device faults feed the same breaker accounting;
+                # preempt device faults feed the same breaker accounting
+                # (shard-attributed ones the ladder, like a cycle fault);
                 # the candidate scan degrades to the CPU engine in place
                 self._note_device_fault(fc, e, "preempt")
-                self.device_health.record_failure(fc)
-                self._dev_snapshot.invalidate()
+                if not self._note_shard_fault(self._shard_of(e), fc):
+                    self.device_health.record_failure(fc)
+                    self._dev_snapshot.invalidate()
                 if not self.config.cpu_fallback:
                     raise
                 cands = self.cpu_engine.preempt_candidates(
@@ -2016,6 +2434,10 @@ class Scheduler:
             return None
         for v in victims:
             self.victim_deleter(v)
+            if self.invariants is not None:
+                # the victim left the cluster: a same-name successor must
+                # not read as a double-bind
+                self.invariants.note_removed(v)
             self.recorder.eventf(
                 "Pod", v.namespace, v.name,
                 EVENT_TYPE_NORMAL, "Preempted",
@@ -2167,6 +2589,8 @@ class Scheduler:
         pods = pop_express(max(1, self.config.express_batch_size))
         if not pods:
             return 0
+        if self.invariants is not None:
+            self.invariants.note_popped(pods, self.queue.scheduling_cycle)
         self._phase("pop", time.monotonic() - t_pop, TIER_EXPRESS)
         results = self.schedule_cycle(pods, tier=TIER_EXPRESS)
         return sum(1 for r in results if r.node is not None)
@@ -2190,12 +2614,20 @@ class Scheduler:
         hbm = self.telemetry.hbm_in_use() if self.telemetry is not None else 0
         klog.infof(
             "heartbeat: cycles=%d placed=%d unschedulable=%d depth=%d "
-            "active=%d express=%d breaker=%s batch=%d hbm_bytes=%d",
+            "active=%d express=%d breaker=%s batch=%d hbm_bytes=%d "
+            "mesh=%d rung=%s shards_lost=%d invariant_violations=%d",
             q.scheduling_cycle,
             self._outcome_totals["placed"],
             self._outcome_totals["unschedulable"],
             len(q), active, express,
             self.device_health.state, self._cur_batch, hbm,
+            self.mesh.size if self.mesh is not None else 0,
+            self.ladder_rung,
+            len(self.shard_health.lost()) if self.shard_health else 0,
+            (
+                self.invariants.violations_total()
+                if self.invariants is not None else 0
+            ),
         )
 
     def prewarm(self, widths: Optional[Sequence[int]] = None,
@@ -2360,6 +2792,7 @@ class Scheduler:
         (flush_pipeline drains the last one); gang cycles and empty polls
         drain the pipeline first so snapshots never go stale."""
         self._maybe_heartbeat()
+        self._maybe_probe_shards()
         t_pop = time.monotonic()
         express = self.config.express_lane
         # tiered mode only adds the kwarg (an express arrival interrupts
@@ -2383,6 +2816,8 @@ class Scheduler:
             self.config.batch_window_s,
             **pop_kw,
         )
+        if self.invariants is not None:
+            self.invariants.note_popped(pods, self.queue.scheduling_cycle)
         self._phase("pop", time.monotonic() - t_pop)
         # express lane between the bulk pop and the bulk dispatch: pending
         # latency-sensitive pods schedule (and commit) BEFORE this cycle's
@@ -2498,8 +2933,9 @@ class Scheduler:
                     self.queue.add_unschedulable_batch(unplaced, cycle)
                     raise
                 self._note_device_fault(fc, e, "gang")
-                self.device_health.record_failure(fc)
-                self._dev_snapshot.invalidate()
+                if not self._note_shard_fault(self._shard_of(e), fc):
+                    self.device_health.record_failure(fc)
+                    self._dev_snapshot.invalidate()
                 plain = plain + unplaced
                 gangs, results = [], []
             for (group, members), (nodes, placed) in zip(gangs, results):

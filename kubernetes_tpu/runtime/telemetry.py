@@ -330,6 +330,13 @@ class TelemetryHub:
         self._launch_ewma: Dict[int, float] = {}
         self._pressure: Optional[Dict[str, int]] = None
         self.last_hbm: Dict[str, Dict[str, int]] = {}
+        # elastic-ladder state (ISSUE 10): live mesh width, rung,
+        # per-shard breaker states, invariant-checker totals.  Stamped
+        # FRESH by the scheduler every committed cycle — the hub must
+        # never cache startup topology, because the mesh can now change
+        # at runtime (shrink/restore) and a stale width/sharding here
+        # would misreport every sample after the first rebuild.
+        self._mesh: Optional[dict] = None
         self.cycles_total = 0
         install_metrics_listeners()
 
@@ -436,6 +443,30 @@ class TelemetryHub:
             out = cluster_analytics_np(*host_snapshot)
             self._pending = (cycle, tier, out, "host")
 
+    def record_mesh(
+        self,
+        width: int,
+        full_width: int = 0,
+        rung: str = "single_chip",
+        shard_states: Optional[Dict[int, str]] = None,
+        invariants: Optional[dict] = None,
+    ) -> None:
+        """Per-cycle ladder facts from the scheduler: live mesh width vs
+        the startup width, the rung serving cycles, each shard's breaker
+        state, and the invariant checker's totals.  Joined into every
+        /debug/cluster sample and the summary."""
+        with self._lock:
+            self._mesh = {
+                "width": int(width),
+                "full_width": int(full_width),
+                "rung": rung,
+                "shards": (
+                    {str(k): v for k, v in shard_states.items()}
+                    if shard_states else None
+                ),
+                "invariants": invariants,
+            }
+
     def record_pressure(self, bulk: int, express: int, parked: int) -> None:
         """Per-tier pending pressure (queue depths, stamped by the
         scheduler alongside on_cycle)."""
@@ -475,6 +506,7 @@ class TelemetryHub:
             "source": source,
             "analytics": a,
             "pending": self._pressure,
+            "mesh": self._mesh,
             "hbm": device_memory_stats(),
             "compile": compile_stats(),
             "launch_ewma_s": self._ewma_snapshot(),
@@ -524,6 +556,7 @@ class TelemetryHub:
                 "samples": self.samples_total,
                 "cycles": self.cycles_total,
                 "pending": self._pressure,
+                "mesh": self._mesh,
                 "hbm": dict(self.last_hbm),
                 "launch_ewma_s": {
                     str(w): round(s, 6)

@@ -436,6 +436,65 @@ DEGRADED_CYCLES = REGISTRY.register(
     )
 )
 
+# elastic degradation ladder (ISSUE 10): per-shard fault attribution and
+# mesh shrink/rebuild.  The global breaker above stays the whole-mesh
+# guard; these families track the per-device half — which shard a
+# classified fault blamed, each shard's own breaker state, the live mesh
+# width, and the ladder rung the control plane currently serves from.
+SHARD_BREAKER_STATE = REGISTRY.register(
+    LabeledGauge(
+        "scheduler_device_shard_breaker_state",
+        "Per-shard device circuit-breaker state, by mesh device id: "
+        "0=closed 1=half_open 2=open (open = the shard is out of the "
+        "live mesh)",
+        ("shard",),
+        max_children=512,  # the mesh device cap (parallel/mesh.py)
+    )
+)
+SHARD_FAULTS = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_device_shard_failures_total",
+        "Classified device faults attributed to one mesh shard, by "
+        "device id and fault class",
+        ("shard", "class"),
+        max_children=2048,  # 512 devices x 4 fault classes
+    )
+)
+MESH_WIDTH = REGISTRY.register(
+    Gauge(
+        "scheduler_mesh_live_devices",
+        "Devices in the live scheduling mesh (0 = unsharded single chip)",
+    )
+)
+MESH_REBUILDS = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_mesh_rebuilds_total",
+        "Live mesh rebuilds, by direction: 'shrink' = a shard was lost "
+        "and the mesh rebuilt narrower, 'restore' = a lost shard's "
+        "half-open probe succeeded and the mesh rebuilt wider",
+        ("direction",),
+    )
+)
+LADDER_RUNG = REGISTRY.register(
+    Gauge(
+        "scheduler_degradation_rung",
+        "Degradation-ladder rung currently serving cycles: 0=full_mesh "
+        "1=shrunken_mesh 2=single_chip 3=cpu",
+    )
+)
+# bounded-breaker satellite: the transitions audit list on DeviceHealth
+# is now a deque(maxlen) — scheduler_device_breaker_transitions_total
+# above is the unbounded record (counters never truncate)
+INVARIANT_VIOLATIONS = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_invariant_violations_total",
+        "Online invariant-checker violations, by rule (conservation | "
+        "double_bind | capacity | lost_pod).  Any non-zero value is a "
+        "control-plane bug: each fires a flight-recorder postmortem",
+        ("rule",),
+    )
+)
+
 # overload protection & backpressure observables (PR 4): the apiserver's
 # APF-style inflight limiter (apiserver/fairness.py — reference names from
 # apiserver/pkg/server/filters/maxinflight.go + util/flowcontrol metrics)

@@ -277,7 +277,7 @@ def test_slow_device_is_absorbed_without_breaker_movement(injector):
     res = s.schedule_cycle(_pods(4))
     assert all(r.node is not None for r in res)
     assert s.device_health.state == BREAKER_CLOSED
-    assert s.device_health.transitions == []
+    assert list(s.device_health.transitions) == []
 
 
 # --------------------------------------------------------- fault matrix
@@ -292,12 +292,23 @@ def test_fault_matrix_smoke(injector, site, kind):
     live scheduler neither loses a pod nor wedges, and it still schedules
     after the injector is disarmed."""
     injector.arm(site, kind=kind, count=1)
-    s = _sched(disable_preemption=True)
+    # the scatter seam only runs on a dirty-ROW incremental upload, which
+    # needs a dirty set <= N/4: a wider world plus a second wave after
+    # the first commit drives it (the other sites fire on wave one)
+    s = _sched(disable_preemption=True,
+               n_nodes=32 if site == "scatter" else 4)
     pods = _pods(4)
     for p in pods:
         s.queue.add(p)
     for _ in range(3):
         s.run_once(timeout=0.05)
+    if site == "scatter":
+        wave2 = _pods(4, prefix="w2")
+        pods = pods + wave2
+        for p in wave2:
+            s.queue.add(p)
+        for _ in range(3):
+            s.run_once(timeout=0.05)
     _no_pod_lost(s, pods)
     # corrupt arms only bite fetch-like sites; others fired exactly once
     if kind != FAULT_CORRUPT or site == "fetch":
@@ -404,7 +415,7 @@ def test_device_health_halfopen_grants_canary_once_cooled():
     assert h.state == "half_open"
     h.record_success()
     assert h.state == BREAKER_CLOSED
-    assert h.transitions == [
+    assert list(h.transitions) == [
         ("closed", "open"), ("open", "half_open"), ("half_open", "closed")
     ]
 
