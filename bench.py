@@ -47,16 +47,20 @@ import threading as _threading
 
 _EMITTED = False
 _EMIT_LOCK = _threading.Lock()
+# the dict the one emitted line carried (the --baseline gate compares it
+# against the prior artifact after the run)
+_EMIT_RESULT = None
 
 
 def _emit(result: dict) -> bool:
     """Exactly-one-JSON-line contract: the first caller prints, every later
     caller (e.g. a signal handler racing a just-finished run) no-ops."""
-    global _EMITTED
+    global _EMITTED, _EMIT_RESULT
     with _EMIT_LOCK:
         if _EMITTED:
             return False
         _EMITTED = True
+        _EMIT_RESULT = result
         print(json.dumps(result))
         sys.stdout.flush()
         return True
@@ -690,6 +694,13 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
                 round(tel_s / dt, 4) if dt > 0 else 0.0
             ),
         }
+    # ---- performance observatory stage (ISSUE 11): the live run's
+    # host/device time attribution + transfer accounting, straight from
+    # the scheduler's observatory (the /debug/perf summary body).  CI
+    # asserts the split reconciles and the wire seams moved bytes.
+    # NB transfers are process-cumulative (the raw-engine stage ran in
+    # this process too); the split totals are this Scheduler's own.
+    perf_observatory = sched.perfobs.summary()
     ledger_stats = None
     if ledger is not None:
         ledger.flush(30.0)
@@ -712,6 +723,7 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
         "batched_commit": batched,
         "pipeline_commit": pipeline,
         **({"cluster_health": cluster_health} if cluster_health else {}),
+        "perf_observatory": perf_observatory,
         **({"ledger": ledger_stats} if ledger_stats else {}),
         "commit_seconds": round(sched.phase_seconds["commit"], 3),
         "phases": {"enqueue": round(t_enqueue, 3),
@@ -1804,6 +1816,211 @@ def orchestrate(args) -> None:
     _emit(banked["result"])
 
 
+# ------------------------------------------------- perf-regression gate
+#
+# `--baseline BENCH_rNN.json` turns the pile of banked bench artifacts
+# into a gate: load a prior artifact, compare the tracked trajectory
+# figures against the current run (or `--compare-to` another artifact,
+# offline), emit a delta report, and exit non-zero on an out-of-band
+# regression.  Tolerance bands are per-metric weights scaled by one
+# `--baseline-tolerance` knob, so CI can run the same gate with a
+# generous band on shared runners while a TPU trajectory check runs
+# tight.
+
+# (name, artifact paths tried in order, direction, tolerance weight).
+# Direction says which way is BETTER; the band only gates the worse
+# direction (a faster run never "regresses" by being too good).
+_BASELINE_CHECKS = (
+    ("pods_per_s", ("value",), "higher", 1.0),
+    ("live_path_pods_per_s",
+     ("live_path_pods_per_s", "detail.live_path.pods_per_s"),
+     "higher", 1.0),
+    ("p99_ms",
+     ("p99_schedule_latency_ms", "detail.latency_ms.p99"),
+     "lower", 1.5),
+    ("overlap_efficiency",
+     ("live_path_overlap_efficiency",
+      "detail.live_path.overlap_efficiency"),
+     "higher", 1.0),
+    ("cold_start_seconds",
+     ("cold_start_seconds", "detail.cold_start_seconds"),
+     "lower", 2.0),
+    ("node_encode_speedup", ("node_encode_speedup",), "higher", 1.0),
+    ("express_p99_ms", ("express_p99_ms",), "lower", 1.5),
+)
+
+# phase-second growth is noisy at smoke scale: a phase only regresses
+# past BOTH a relative band (2x the base tolerance) and an absolute
+# floor, so a 20ms phase doubling on a busy runner doesn't fail a build
+_PHASE_ABS_FLOOR_S = 0.5
+
+
+def _artifact_path(d: dict, dotted: str):
+    """Raw value at a dotted path, or None when absent."""
+    cur = d
+    for part in dotted.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+def _artifact_get(d: dict, dotted: str):
+    """Numeric value at a dotted path, or None when absent/non-numeric."""
+    cur = _artifact_path(d, dotted)
+    return float(cur) if isinstance(cur, (int, float)) else None
+
+
+def load_artifact(path: str) -> dict:
+    """A bench artifact from disk.  Accepts both the raw one-JSON-line
+    form bench emits and the driver's banked wrapper (BENCH_rNN.json:
+    {n, cmd, rc, tail, parsed} — the artifact lives under "parsed")."""
+    with open(path) as f:
+        d = json.load(f)
+    if isinstance(d, dict) and isinstance(d.get("parsed"), dict):
+        d = d["parsed"]
+    if not isinstance(d, dict) or "value" not in d:
+        raise ValueError(
+            f"{path} is not a bench artifact (no 'value' field)"
+        )
+    return d
+
+
+def compare_artifacts(baseline: dict, current: dict,
+                      tolerance: float = 0.2) -> dict:
+    """Delta report between two bench artifacts.  Each tracked metric
+    present in BOTH artifacts is checked against its tolerance band
+    (weight x `tolerance`, capped at 95%); detail.phases is compared
+    with the looser 2x band + an absolute floor.  Returns the report —
+    `regressions` lists every check that failed its band."""
+    tolerance = max(0.0, float(tolerance))
+    checks = []
+    regressions = []
+    for name, paths, direction, weight in _BASELINE_CHECKS:
+        base = cur = None
+        for p in paths:
+            if base is None:
+                base = _artifact_get(baseline, p)
+            if cur is None:
+                cur = _artifact_get(current, p)
+        if base is None or cur is None or base <= 0:
+            continue
+        tol = tolerance * weight
+        if direction == "higher":
+            # the cap only applies where a band floor <= 0 would be
+            # meaningless; a lower-is-better ceiling past +100% is valid
+            # (and deliberate: cold start's x2 weight under a generous
+            # CI tolerance must stay the LOOSEST gate, not clip tight)
+            tol = min(0.95, tol)
+            band = [round(base * (1 - tol), 4), None]
+            bad = cur < base * (1 - tol)
+        else:
+            band = [None, round(base * (1 + tol), 4)]
+            bad = cur > base * (1 + tol)
+        checks.append({
+            "name": name,
+            "baseline": base,
+            "current": cur,
+            "ratio": round(cur / base, 4),
+            "direction": direction,
+            "band": band,
+            "regression": bad,
+        })
+        if bad:
+            regressions.append(name)
+    phases = {}
+    base_ph = _artifact_path(baseline, "detail.phases")
+    cur_ph = _artifact_path(current, "detail.phases")
+    if not isinstance(base_ph, dict) or not isinstance(cur_ph, dict):
+        base_ph = cur_ph = None
+    if base_ph and cur_ph:
+        for k in sorted(set(base_ph) & set(cur_ph)):
+            b, c = base_ph[k], cur_ph[k]
+            if not isinstance(b, (int, float)) or not isinstance(
+                c, (int, float)
+            ):
+                continue
+            bad = (
+                b > 0
+                and c > b * (1 + 2 * tolerance)
+                and c - b > _PHASE_ABS_FLOOR_S
+            )
+            phases[k] = {
+                "baseline": b,
+                "current": c,
+                "ratio": round(c / b, 4) if b > 0 else None,
+                "regression": bad,
+            }
+            if bad:
+                regressions.append(f"phase:{k}")
+    return {
+        "tolerance": tolerance,
+        "checks": checks,
+        "phases": phases,
+        "regressions": regressions,
+        "baseline_metric": baseline.get("metric"),
+        "current_metric": current.get("metric"),
+    }
+
+
+def _emit_perf_delta(args, delta: dict, baseline_path: str,
+                     current_desc: str):
+    """Write the delta report + stderr summary; returns (exit code,
+    report) — 1 on any regression, the gate contract.  The ONE report
+    dict serves both --perf-delta-out and the emitted JSON line, so the
+    two can never disagree."""
+    report = {
+        "metric": "perf_delta",
+        "value": 0.0 if delta["regressions"] else 1.0,
+        "unit": "bool",
+        "detail": {
+            "baseline": baseline_path,
+            "current": current_desc,
+            **delta,
+        },
+    }
+    if args.perf_delta_out:
+        with open(args.perf_delta_out, "w") as f:
+            json.dump(report, f, indent=1)
+    for c in delta["checks"]:
+        sys.stderr.write(
+            "bench: perf-delta %-22s base=%-10g cur=%-10g ratio=%.3f%s\n"
+            % (c["name"], c["baseline"], c["current"], c["ratio"],
+               "  REGRESSION" if c["regression"] else "")
+        )
+    if delta["regressions"]:
+        sys.stderr.write(
+            f"bench: perf-delta REGRESSION on {delta['regressions']} "
+            f"(tolerance {delta['tolerance']})\n"
+        )
+    else:
+        sys.stderr.write(
+            f"bench: perf-delta clean vs {baseline_path} "
+            f"({len(delta['checks'])} checks, tolerance "
+            f"{delta['tolerance']})\n"
+        )
+    return (1 if delta["regressions"] else 0), report
+
+
+def run_baseline_compare(args) -> None:
+    """Offline gate: `--baseline OLD --compare-to NEW` compares two
+    artifacts without running anything (the CI perf-delta step; also the
+    self-compare acceptance — an artifact vs itself must exit 0).  Emits
+    the delta report as the run's one JSON line."""
+    try:
+        baseline = load_artifact(args.baseline)
+        current = load_artifact(args.compare_to)
+    except (OSError, ValueError) as e:
+        _emit(_error_line("baseline-load", e))
+        sys.exit(2)
+    delta = compare_artifacts(baseline, current, args.baseline_tolerance)
+    code, report = _emit_perf_delta(
+        args, delta, args.baseline, args.compare_to
+    )
+    _emit(report)
+    sys.exit(code)
+
+
 def run_replay(args) -> None:
     """--replay <ledger>: offline bit-identity gate.  Reconstructs every
     recorded cycle's snapshot (codec delta chain), re-executes it through
@@ -1976,11 +2193,40 @@ def main():
         "winners on tie-heavy workloads)",
     )
     ap.add_argument(
+        "--baseline", default=None, metavar="ARTIFACT",
+        help="perf-regression gate: load a prior bench artifact (raw "
+        "one-line form or the driver's BENCH_rNN.json wrapper) and "
+        "compare the tracked figures — pods/s, p99, phase breakdown, "
+        "overlap efficiency, cold start — against this run's result "
+        "(or --compare-to, offline); writes --perf-delta-out and exits "
+        "non-zero on an out-of-band regression",
+    )
+    ap.add_argument(
+        "--compare-to", default=None, metavar="ARTIFACT",
+        help="with --baseline: compare this artifact instead of running "
+        "the bench (the CI perf-delta step; a self-compare exits 0)",
+    )
+    ap.add_argument(
+        "--baseline-tolerance", type=float, default=0.2,
+        help="base tolerance band for --baseline (default 0.2 = 20%%); "
+        "per-metric weights scale it (p99 x1.5, cold start x2), phases "
+        "use 2x plus a 0.5s absolute floor.  CI runs generous bands on "
+        "shared runners; trajectory checks run tight",
+    )
+    ap.add_argument(
+        "--perf-delta-out", default=None,
+        help="write the --baseline delta report JSON here (CI uploads "
+        "it next to the trace/ledger/cluster artifacts)",
+    )
+    ap.add_argument(
         "--platform",
         default=None,
         help="force a jax platform (e.g. cpu); default = environment (TPU)",
     )
     args = ap.parse_args()
+
+    if args.compare_to and not args.baseline:
+        ap.error("--compare-to requires --baseline")
 
     explicit_shard_cfg = (
         args.mesh_shape or args.shard_devices is not None
@@ -2019,10 +2265,34 @@ def main():
 
     if args.replay:
         run_replay(args)
+    elif args.baseline and args.compare_to:
+        run_baseline_compare(args)
     elif os.environ.get(_CHILD_ENV) == "1":
         run_child(args)
     else:
         orchestrate(args)
+        if args.baseline:
+            # live gate: the run's emitted artifact vs the prior one.
+            # The result line already printed (the one-line contract),
+            # so the delta rides --perf-delta-out + stderr; the exit
+            # code is the gate
+            try:
+                baseline = load_artifact(args.baseline)
+            except (OSError, ValueError) as e:
+                sys.stderr.write(f"bench: --baseline load failed: {e}\n")
+                sys.exit(2)
+            if _EMIT_RESULT is None:
+                sys.stderr.write(
+                    "bench: --baseline: no result emitted to compare\n"
+                )
+                sys.exit(2)
+            delta = compare_artifacts(
+                baseline, _EMIT_RESULT, args.baseline_tolerance
+            )
+            code, _ = _emit_perf_delta(
+                args, delta, args.baseline, "live-run"
+            )
+            sys.exit(code)
 
 
 if __name__ == "__main__":

@@ -942,6 +942,49 @@ class APIServer:
                         ct="application/json",
                     )
                     return
+                if self.path.partition("?")[0] == "/debug/perf":
+                    # the performance observatory (runtime/perfobs.py):
+                    # host/device cycle split, phase x width EWMA,
+                    # transfer accounting, profiler status — in embedded
+                    # deployments the scheduling happens in this
+                    # process, so its observatory is the process
+                    # default.  Inflight-exempt like its siblings
+                    from kubernetes_tpu.runtime import perfobs
+                    from kubernetes_tpu.runtime.ledger import debug_body
+
+                    self._send_text(
+                        debug_body(
+                            perfobs.get_default().debug_payload,
+                            self.path.partition("?")[2],
+                        ),
+                        ct="application/json",
+                    )
+                    return
+                if self.path.partition("?")[0] == "/debug/profile":
+                    # on-demand bounded jax.profiler capture
+                    # (?seconds=N; throttled, graceful no-op where the
+                    # backend lacks profiler support)
+                    import json as _json
+
+                    from kubernetes_tpu.runtime import perfobs
+
+                    self._send_text(
+                        _json.dumps(perfobs.profile_request(
+                            self.path.partition("?")[2]
+                        )).encode(),
+                        ct="application/json",
+                    )
+                    return
+                if self.path.partition("?")[0] in ("/debug", "/debug/"):
+                    import json as _json
+
+                    from kubernetes_tpu.runtime.ledger import debug_index
+
+                    self._send_text(
+                        _json.dumps(debug_index()).encode(),
+                        ct="application/json",
+                    )
+                    return
                 if self.path == "/version":
                     self._send({"gitVersion": "v1.15-tpu", "major": "1",
                                 "minor": "15"})
@@ -2055,7 +2098,8 @@ class APIServer:
         if outer.flow_control is not None:
             exempt = ("/healthz", "/livez", "/readyz", "/metrics",
                       "/version", "/debug/traces", "/debug/decisions",
-                      "/debug/cluster")
+                      "/debug/cluster", "/debug/perf", "/debug/profile",
+                      "/debug", "/debug/")
             for method in ("do_GET", "do_POST", "do_PUT", "do_PATCH",
                            "do_DELETE"):
                 inner = getattr(Handler, method)

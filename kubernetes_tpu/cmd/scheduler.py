@@ -151,6 +151,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable the online invariant checker (config "
                    "invariantChecks=false): conservation/double-bind/"
                    "capacity violations are no longer detected live")
+    p.add_argument("--profile-dir", default=None,
+                   help="directory for on-demand jax.profiler captures "
+                   "(config profileDir; default $KTPU_PROFILE_DIR or "
+                   "/tmp/ktpu_profile) — GET /debug/profile?seconds=N "
+                   "records a bounded device+host trace there; a "
+                   "graceful no-op where the backend lacks profiler "
+                   "support")
     p.add_argument("--simulate-nodes", type=int, default=0,
                    help="register N hollow nodes")
     p.add_argument("--simulate-pods", type=int, default=0,
@@ -222,6 +229,8 @@ def main(argv=None) -> int:
         cc.mesh_shrink = False
     if args.no_invariant_checks:
         cc.invariant_checks = False
+    if args.profile_dir is not None:
+        cc.profile_dir = args.profile_dir
 
     # persistent compile cache BEFORE any jit compile (engine build,
     # prewarm, first cycle) so every executable of this process is served

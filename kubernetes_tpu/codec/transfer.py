@@ -35,6 +35,76 @@ import numpy as np
 from kubernetes_tpu.codec import faults
 from kubernetes_tpu.codec.schema import _pow2
 
+# ------------------------------------------------------ transfer accounting
+#
+# Every wire seam notes the bytes it moved (ISSUE 11): direction h2d/d2h
+# plus the seam name, computed from HOST array nbytes — never a device
+# sync, so the accounting is safe to leave always-on (the perf_smoke
+# budget pins it inside the <2% observatory envelope).  Totals feed the
+# ktpu_transfer_* counter families and the per-cycle deltas the
+# scheduler annotates onto each cycle span / hands to the performance
+# observatory (runtime/perfobs.py).
+
+_XFER_LOCK = threading.Lock()
+# (direction, seam) -> [bytes, calls]; plain ints under a lock — the
+# fetch worker and the scheduling thread both note here
+_XFER_TOTALS: "dict[Tuple[str, str], list]" = {}
+
+
+def note_transfer(direction: str, seam: str, nbytes: int) -> None:
+    """Account one transfer at a wire seam.  Zero-byte calls still count
+    a call (an empty dirty set that reached the wire is signal)."""
+    from kubernetes_tpu.utils import metrics as m
+
+    nbytes = int(nbytes)
+    with _XFER_LOCK:
+        cell = _XFER_TOTALS.get((direction, seam))
+        if cell is None:
+            cell = _XFER_TOTALS[(direction, seam)] = [0, 0]
+        cell[0] += nbytes
+        cell[1] += 1
+    m.TRANSFER_BYTES.inc(nbytes, direction=direction, seam=seam)
+    m.TRANSFER_CALLS.inc(direction=direction, seam=seam)
+
+
+def tree_nbytes(tree) -> int:
+    """Sum of nbytes over the numpy/jax leaves of a pytree (None leaves
+    and scalars without nbytes are free)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nb = getattr(leaf, "nbytes", None)
+        if nb:
+            total += int(nb)
+    return total
+
+
+def note_transfer_tree(direction: str, seam: str, tree) -> None:
+    note_transfer(direction, seam, tree_nbytes(tree))
+
+
+def transfer_totals() -> "dict[str, dict]":
+    """Snapshot of cumulative transfer accounting:
+    {"h2d/snapshot_upload": {"bytes": B, "calls": C}, ...}.  Cheap (a
+    handful of entries) — the scheduler snapshots it per cycle to
+    compute the cycle's transfer delta."""
+    with _XFER_LOCK:
+        return {
+            f"{d}/{s}": {"bytes": v[0], "calls": v[1]}
+            for (d, s), v in _XFER_TOTALS.items()
+        }
+
+
+def transfer_delta(prev: "dict[str, dict]") -> "dict[str, dict]":
+    """Non-zero per-seam deltas of transfer_totals() since `prev` (a
+    previous transfer_totals() snapshot)."""
+    out: dict = {}
+    for key, cur in transfer_totals().items():
+        p = prev.get(key, {"bytes": 0, "calls": 0})
+        db, dc = cur["bytes"] - p["bytes"], cur["calls"] - p["calls"]
+        if db or dc:
+            out[key] = {"bytes": db, "calls": dc}
+    return out
+
 
 def device_annotation(name: str):
     """Optional jax.profiler annotation around a device-path section:
@@ -112,7 +182,9 @@ def host_fetch(x, tag: str = "fetch") -> np.ndarray:
     _note_sync(tag)
     faults.check(faults.SITE_FETCH, devices=_involved_device_ids(x))
     with device_annotation(f"ktpu.{tag}"):
-        return faults.corrupt(faults.SITE_FETCH, np.asarray(x))
+        out = faults.corrupt(faults.SITE_FETCH, np.asarray(x))
+    note_transfer("d2h", tag, out.nbytes)
+    return out
 
 
 def upload_async(tree):
@@ -121,6 +193,7 @@ def upload_async(tree):
     before a dependent host step.  Exists mostly as the named seam — the
     point is that NO fence is needed on the hot path, because jit consumers
     order themselves on the transfer."""
+    note_transfer_tree("h2d", "upload", tree)
     return jax.device_put(tree)
 
 
@@ -215,6 +288,14 @@ class AsyncFetch:
         self._out: Any = None
         self._err: Any = None
         self.seconds = 0.0
+        # the host/device attribution split (ISSUE 11), stamped by the
+        # ready fences in _run: execute = dispatch -> computation ready
+        # (the honest device-execute window), materialize = the residual
+        # D2H landing after the result was ready (with the async copy
+        # prefetch this is usually ~0).  execute + materialize <= seconds
+        # (the worker also pays queueing before the fence).
+        self.execute_seconds = 0.0
+        self.materialize_seconds = 0.0
         self._t0 = time.monotonic()
         _fetch_worker().submit(self._run)
 
@@ -222,9 +303,21 @@ class AsyncFetch:
         try:
             faults.check(faults.SITE_FETCH, devices=self._devices)
             with device_annotation(f"ktpu.{self._tag}"):
+                t_wait0 = time.monotonic()
+                wait = getattr(self._dev, "block_until_ready", None)
+                if wait is not None:
+                    # ready fence BEFORE the materialize: splits "device
+                    # still computing" from "host copying" (a failed
+                    # computation raises here exactly as np.asarray would)
+                    wait()
+                self.execute_seconds = time.monotonic() - t_wait0
                 self._out = faults.corrupt(
                     faults.SITE_FETCH, np.asarray(self._dev)
                 )
+                self.materialize_seconds = (
+                    time.monotonic() - t_wait0 - self.execute_seconds
+                )
+            note_transfer("d2h", self._tag, self._out.nbytes)
         except BaseException as e:  # noqa: BLE001 — re-raised in result()
             self._err = e
         finally:
@@ -586,6 +679,12 @@ class DeviceSnapshotCache:
                         )
                     else:
                         rows_p, sub_p = rows_arr, sub
+                    # the delta that actually crosses the wire: the
+                    # padded row-index vector + the padded row values
+                    note_transfer(
+                        "h2d", "dirty_scatter",
+                        rows_p.nbytes + sub_p.nbytes,
+                    )
                     if self._mesh is not None:
                         # rows/vals ship uncommitted (the compiler
                         # replicates the tiny delta); the scatter routes
@@ -612,6 +711,10 @@ class DeviceSnapshotCache:
             else:
                 self._host[f.name] = host  # content-equal: no upload needed
         if changed:
+            note_transfer(
+                "h2d", "snapshot_upload",
+                sum(staged[n].nbytes for n in changed),
+            )
             with device_annotation("ktpu.snapshot_upload"):
                 if self._mesh is not None:
                     uploaded = jax.device_put(

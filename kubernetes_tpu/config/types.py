@@ -128,6 +128,12 @@ class KubeSchedulerConfiguration:
     mesh_shrink: bool = True
     shard_breaker_failure_threshold: int = 2
     invariant_checks: bool = True
+    # performance observatory (runtime/perfobs.py): directory for the
+    # on-demand jax.profiler capture served at GET /debug/profile
+    # (None = $KTPU_PROFILE_DIR or /tmp/ktpu_profile); the observatory
+    # itself — host/device split, phase x width EWMA, transfer
+    # accounting at /debug/perf — is always-on
+    profile_dir: Optional[str] = None
 
     def build_profile(self, interner=None) -> SchedulingProfile:
         """CreateFromConfig / CreateFromProvider (scheduler.go:162-192)."""
@@ -211,6 +217,7 @@ class KubeSchedulerConfiguration:
                 d.get("shardBreakerFailureThreshold", 2)
             ),
             invariant_checks=bool(d.get("invariantChecks", True)),
+            profile_dir=d.get("profileDir"),
         )
 
     @staticmethod

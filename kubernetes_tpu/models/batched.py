@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubernetes_tpu.codec import transfer
 from kubernetes_tpu.codec.schema import (
     ClusterTensors,
     FilterConfig,
@@ -990,6 +991,7 @@ def make_sequential_scheduler(
         if jax.default_backend() != "cpu":
             tree = (pods, ports, nominated, extra_mask, extra_score,
                     aff_state)
+            transfer.note_transfer_tree("h2d", "batch_replicate", tree)
             dst = _replicated_on_cluster_mesh(cluster)
             pods, ports, nominated, extra_mask, extra_score, aff_state = (
                 jax.device_put(tree, dst)

@@ -752,6 +752,9 @@ def make_speculative_scheduler(
                 replicated_on_cluster_mesh,
             )
 
+            from kubernetes_tpu.codec.transfer import note_transfer_tree
+
+            note_transfer_tree("h2d", "batch_replicate", bufs)
             dst = replicated_on_cluster_mesh(cluster)
             bufs = (
                 jax.device_put(bufs, dst)

@@ -217,6 +217,9 @@ def replicated_on_cluster_mesh(cluster):
 
 def replicate(tree, mesh: Mesh):
     """Replicate a pytree (PodBatch, port state, scalars) across the mesh."""
+    from kubernetes_tpu.codec.transfer import note_transfer_tree
+
+    note_transfer_tree("h2d", "batch_replicate", tree)
 
     def put(x):
         arr = np.asarray(x)

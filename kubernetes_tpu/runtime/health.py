@@ -384,6 +384,43 @@ class HealthServer:
                         ),
                         ct="application/json",
                     )
+                elif path == "/debug/perf":
+                    # the performance observatory (runtime/perfobs.py):
+                    # host/device cycle split, phase x width EWMA,
+                    # transfer accounting, profiler status — ?limit=N +
+                    # the shared 4MB cap, like its siblings
+                    from kubernetes_tpu.runtime import perfobs
+
+                    self._send(
+                        debug_body(
+                            perfobs.get_default().debug_payload, query,
+                        ),
+                        ct="application/json",
+                    )
+                elif path == "/debug/profile":
+                    # on-demand bounded jax.profiler capture
+                    # (?seconds=N; throttled, graceful no-op where the
+                    # backend lacks profiler support)
+                    import json as _json
+
+                    from kubernetes_tpu.runtime import perfobs
+
+                    self._send(
+                        _json.dumps(
+                            perfobs.profile_request(query)
+                        ).encode(),
+                        ct="application/json",
+                    )
+                elif path in ("/debug", "/debug/"):
+                    # the index: every debug endpoint, one line each
+                    import json as _json
+
+                    from kubernetes_tpu.runtime.ledger import debug_index
+
+                    self._send(
+                        _json.dumps(debug_index()).encode(),
+                        ct="application/json",
+                    )
                 else:
                     self._send(b"not found", 404)
 

@@ -451,6 +451,40 @@ def debug_body(render, query: str = "",
     return bounded_json(_render, limit, cap)
 
 
+# the debug surface, one line per endpoint — served at GET /debug/ by
+# both the health server and the apiserver (inflight-exempt like its
+# peers) so an operator can discover the whole family from any one URL
+DEBUG_ENDPOINTS = {
+    "/debug/traces": (
+        "flight-recorder cycle spans + postmortems as Chrome "
+        "trace-event JSON (Perfetto-loadable; ?limit=N)"
+    ),
+    "/debug/decisions": (
+        "recent decision-ledger entries: per-pod winners + dominant "
+        "rejection reasons, trace-id cross-linked (?limit=N)"
+    ),
+    "/debug/cluster": (
+        "telemetry time series: cluster analytics, HBM, compile facts, "
+        "SLO burn rates (?limit=N)"
+    ),
+    "/debug/perf": (
+        "performance observatory: host/device cycle split, phase x "
+        "width EWMA matrix, transfer byte accounting, profiler status "
+        "(?limit=N)"
+    ),
+    "/debug/profile": (
+        "start a bounded on-demand jax.profiler capture "
+        "(?seconds=N; throttled, no-op where unsupported)"
+    ),
+}
+
+
+def debug_index() -> dict:
+    """GET /debug/ body: every debug endpoint with a one-line
+    description."""
+    return {"endpoints": dict(DEBUG_ENDPOINTS)}
+
+
 # ------------------------------------------------------------- replay
 
 def read_ledger_stream(path: str) -> Tuple[dict, Iterator[dict]]:

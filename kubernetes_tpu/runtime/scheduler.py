@@ -248,6 +248,13 @@ class SchedulerConfig:
     # flight-recorder postmortem on violation.  Always-on by design
     # (dict-ops per event); False removes the hooks entirely.
     invariant_checks: bool = True
+    # --- performance observatory (ISSUE 11: runtime/perfobs.py) ---
+    # on-demand jax.profiler capture directory for GET /debug/profile
+    # (None = $KTPU_PROFILE_DIR or /tmp/ktpu_profile).  The observatory
+    # itself — host/device cycle split, phase x width EWMA, transfer
+    # accounting — is always-on by design (dict ops per cycle; the <2%
+    # budget is pinned by perf_smoke alongside the span/telemetry pins)
+    profile_dir: Optional[str] = None
     # multi-scheduler: only pods whose spec.schedulerName names THIS
     # scheduler enter its queue (eventhandlers.go responsibleForPod)
     scheduler_name: str = "default-scheduler"
@@ -310,6 +317,7 @@ class SchedulerConfig:
                 cc, "shard_breaker_failure_threshold", 2
             ),
             invariant_checks=getattr(cc, "invariant_checks", True),
+            profile_dir=getattr(cc, "profile_dir", None),
         )
 
 
@@ -377,6 +385,13 @@ class _InFlight:
     # family tracks real executables instead of leaking a series per
     # raw pod count
     width: int = 0
+    # --- performance observatory (ISSUE 11) ---
+    # scheduling-thread seconds from encode start to the dispatch
+    # returning (host_enqueue in the cost model)
+    enqueue_s: float = 0.0
+    # codec.transfer.transfer_totals() snapshot at encode time: the
+    # commit tail diffs against it to get THIS cycle's wire traffic
+    xfer0: Optional[dict] = None
 
 
 class _HostResult:
@@ -409,6 +424,16 @@ class _Staged:
     # (batch index, pod, assumed copy, node name) per device winner
     winners: List[Tuple] = field(default_factory=list)
     fit_idx: List[int] = field(default_factory=list)
+    # residual host wait at the ready fence (host_stall in the perf
+    # observatory's cost model — the same window as the fetch_block
+    # phase counter)
+    stall_s: float = 0.0
+    # THIS cycle's wire traffic (codec.transfer.transfer_delta vs the
+    # encode-time watermark), taken at the commit fence — under
+    # pipeline_commit the tail runs AFTER the next cycle's dispatch, so
+    # computing the delta there would double-count the next cycle's
+    # uploads into this cycle's span
+    xfer_delta: Optional[dict] = None
 
 
 class Scheduler:
@@ -709,6 +734,18 @@ class Scheduler:
                 postmortem=self._postmortem,
             )
             telemetry_mod.set_default(self.telemetry)
+        # performance observatory (ISSUE 11, runtime/perfobs.py):
+        # host/device time attribution per cycle, the phase x width
+        # EWMA cost matrix, per-cycle transfer deltas, and the
+        # on-demand profiler capture — always-on (dict ops per cycle;
+        # the <2% budget is pinned by perf_smoke), installed as the
+        # process default so /debug/perf serves it unwired
+        from kubernetes_tpu.runtime import perfobs as perfobs_mod
+
+        self.perfobs = perfobs_mod.PerfObservatory(
+            profile_dir=self.config.profile_dir
+        )
+        perfobs_mod.set_default(self.perfobs)
         # shed watermark (per-cycle deltas feed the goodput SLO) +
         # heartbeat clock + liveness totals (heartbeat line + bench)
         self._shed_seen = 0
@@ -1296,6 +1333,12 @@ class Scheduler:
         if not pods:
             return None
         t_cycle0 = time.monotonic()
+        # transfer watermark BEFORE any device work: the commit tail
+        # diffs against it so the cycle's sample/span carry exactly the
+        # bytes THIS cycle moved (codec/transfer.py accounting)
+        from kubernetes_tpu.codec.transfer import transfer_totals
+
+        xfer0 = transfer_totals()
         enc = self.cache.encoder
         cycle = self.queue.scheduling_cycle
         express_width = (
@@ -1483,7 +1526,8 @@ class Scheduler:
             engine="cpu" if degraded else self._engine_kind,
             shards=self.mesh.size if self.mesh is not None else 0,
         )
-        self._phase("dispatch", time.monotonic() - t_disp, tier)
+        t_disp_end = time.monotonic()
+        self._phase("dispatch", t_disp_end - t_disp, tier)
         inf = _InFlight(
             pods=list(pods), hosts_dev=hosts_dev, fetch=fetch,
             generation=generation, cycle=cycle, ext_failed=ext_failed,
@@ -1496,6 +1540,8 @@ class Scheduler:
                 if self.telemetry is not None else None
             ),
             width=batch.n_pods,
+            enqueue_s=t_disp_end - t_cycle0,
+            xfer0=xfer0,
         )
         if self.ledger is not None:
             # the exact launch inputs, stashed for the off-hot-path
@@ -1616,8 +1662,16 @@ class Scheduler:
         batched = self.config.batched_commit and self.framework is None
         staged = _Staged(
             inf=inf, hosts=hosts, algo_dt=algo_dt, batched=batched,
-            t_state0=t_state0,
+            t_state0=t_state0, stall_s=t_state0 - t_fetch0,
         )
+        if inf.xfer0 is not None:
+            # the fence is the honest cycle boundary for transfer
+            # accounting: every upload/fetch this cycle caused has
+            # landed (AsyncFetch notes bytes before its done-event), and
+            # the pipelined loop has not dispatched the next batch yet
+            from kubernetes_tpu.codec.transfer import transfer_delta
+
+            staged.xfer_delta = transfer_delta(inf.xfer0)
         if not batched:
             return staged
         import copy
@@ -1664,6 +1718,7 @@ class Scheduler:
         is exact)."""
         inf = staged.inf
         pods = inf.pods
+        t_tail0 = time.monotonic()
         # the cycle's trace context is CURRENT for the whole tail: binds
         # (RemoteBinder / bind-verb extenders attach the traceparent
         # header) and Scheduled/FailedScheduling events (trace_id field)
@@ -1684,6 +1739,19 @@ class Scheduler:
                 self._phase("preempt", time.monotonic() - t_p, inf.tier)
         placed = sum(1 for r in results if r.node is not None)
         inf.trace.annotate(placed=placed, unschedulable=len(results) - placed)
+        # the cycle's wire traffic (taken at the commit fence — see
+        # _Staged.xfer_delta), annotated onto the span before it
+        # retires (ISSUE 11): total bytes + the dominant seam — the two
+        # facts a Perfetto reader joins against the phase children
+        xfer_delta = staged.xfer_delta
+        if xfer_delta:
+            top = max(xfer_delta.items(), key=lambda kv: kv[1]["bytes"])
+            inf.trace.annotate(
+                transfer_bytes=sum(
+                    v["bytes"] for v in xfer_delta.values()
+                ),
+                transfer_top_seam=top[0],
+            )
         inf.trace.finish()
         self.flight_recorder.record(inf.trace)
         if self.ledger is not None and inf.ledger_inputs is not None:
@@ -1704,6 +1772,36 @@ class Scheduler:
                 )
             finally:
                 m.TELEMETRY_SECONDS.inc(time.perf_counter() - t_tel)
+        # performance observatory (ISSUE 11): fold this cycle's
+        # host/device split + transfer delta into the cost model.  Like
+        # telemetry, the hook must never fail a committed cycle, and its
+        # scheduling-thread cost is stamped into its own counter (the
+        # <2% budget perf_smoke pins).
+        t_perf = time.perf_counter()
+        try:
+            fetch = inf.fetch
+            self.perfobs.on_cycle(
+                width=inf.width or len(inf.pods),
+                tier=inf.tier,
+                degraded=inf.degraded,
+                enqueue_s=inf.enqueue_s,
+                execute_s=getattr(fetch, "execute_seconds", 0.0),
+                materialize_s=getattr(fetch, "materialize_seconds", 0.0),
+                stall_s=staged.stall_s,
+                commit_s=(
+                    staged.state_seconds + time.monotonic() - t_tail0
+                ),
+                wall_s=time.monotonic() - inf.t_cycle0,
+                transfers=xfer_delta,
+                trace_id=inf.trace.trace_id,
+            )
+        except Exception as e:  # noqa: BLE001 — observability must
+            # never fail a cycle whose placements are already committed
+            klog.errorf(
+                "perf observatory hook failed (cycle %d): %s", inf.cycle, e
+            )
+        finally:
+            m.PERFOBS_SECONDS.inc(time.perf_counter() - t_perf)
         m.PENDING_PODS.set(float(len(self.queue)))
         self.results.extend(results)
         # slow-cycle log LAST, once the ENTIRE tail (ledger record +
@@ -2612,10 +2710,16 @@ class Scheduler:
         express = q.express_depth() if hasattr(q, "express_depth") else 0
         active = q.active_depth() if hasattr(q, "active_depth") else len(q)
         hbm = self.telemetry.hbm_in_use() if self.telemetry is not None else 0
+        # observatory window since the last heartbeat (ISSUE 11): host
+        # vs device milliseconds and the transfer seam that moved the
+        # most bytes — the three numbers that say WHERE the interval's
+        # wall time went without opening /debug/perf
+        host_ms, dev_ms, xfer_top = self.perfobs.heartbeat_window()
         klog.infof(
             "heartbeat: cycles=%d placed=%d unschedulable=%d depth=%d "
             "active=%d express=%d breaker=%s batch=%d hbm_bytes=%d "
-            "mesh=%d rung=%s shards_lost=%d invariant_violations=%d",
+            "mesh=%d rung=%s shards_lost=%d invariant_violations=%d "
+            "host_ms=%d dev_ms=%d xfer_top=%s",
             q.scheduling_cycle,
             self._outcome_totals["placed"],
             self._outcome_totals["unschedulable"],
@@ -2628,6 +2732,7 @@ class Scheduler:
                 self.invariants.violations_total()
                 if self.invariants is not None else 0
             ),
+            int(host_ms), int(dev_ms), xfer_top,
         )
 
     def prewarm(self, widths: Optional[Sequence[int]] = None,

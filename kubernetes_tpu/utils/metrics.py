@@ -731,5 +731,50 @@ TELEMETRY_SAMPLES = REGISTRY.register(
     )
 )
 
+# hot-path performance observatory (ISSUE 11: runtime/perfobs.py +
+# codec/transfer.py accounting).  Transfer counters are computed from
+# host-array nbytes at each wire seam — no device sync, always-on —
+# and the per-phase EWMA matrix generalizes the PR 8 launch EWMA to
+# the full host/device cycle split served at /debug/perf.
+TRANSFER_BYTES = REGISTRY.register(
+    LabeledCounter(
+        "ktpu_transfer_bytes_total",
+        "Bytes moved across the host<->device wire, by direction "
+        "(h2d|d2h) and seam (snapshot_upload|dirty_scatter|"
+        "batch_replicate|upload|fetch|preempt); computed from array "
+        "nbytes at the transfer call site, never from a device sync",
+        ("direction", "seam"),
+    )
+)
+TRANSFER_CALLS = REGISTRY.register(
+    LabeledCounter(
+        "ktpu_transfer_calls_total",
+        "Host<->device transfer calls, by direction and seam (the "
+        "round-trip count pairing ktpu_transfer_bytes_total)",
+        ("direction", "seam"),
+    )
+)
+PERF_PHASE_EWMA = REGISTRY.register(
+    LabeledGauge(
+        "scheduler_perf_phase_ewma_seconds",
+        "EWMA seconds per cycle phase (host_enqueue|device_execute|"
+        "d2h_materialize|host_stall|host_commit) and executable batch "
+        "width — the per-cycle cost model the device-resident megacycle "
+        "work (ROADMAP item 2) reads from; served at /debug/perf",
+        ("phase", "width"),
+        # 5 phases x the AIMD pow2 ladder (+ express width); the guard
+        # fires only if width labels start leaking non-pow2 values
+        max_children=128,
+    )
+)
+PERFOBS_SECONDS = REGISTRY.register(
+    Counter(
+        "scheduler_perfobs_seconds_total",
+        "Cumulative scheduling-thread seconds spent in the performance-"
+        "observatory hook (cycle split + transfer delta + EWMA fold; "
+        "the <2%-of-cycle-wall budget perf_smoke pins)",
+    )
+)
+
 # schedule_attempts_total result label values (metrics.go:44-52)
 SCHEDULED, UNSCHEDULABLE, SCHEDULE_ERROR = "scheduled", "unschedulable", "error"

@@ -225,6 +225,33 @@ def test_metrics_exposition_valid_after_mixed_live_workload():
     for fam in ("scheduler_ledger_bytes_total",
                 "scheduler_ledger_dropped_total"):
         assert families[fam]["type"] == "counter"
+    # ISSUE 11 satellites: the transfer and observatory families are
+    # strict-parser-valid AND carry live values from the workload just
+    # run — the snapshot upload + winners fetch both moved bytes, every
+    # byte family has a matching calls series, and the phase x width
+    # EWMA matrix filled for every cost-model phase
+    xfer = {
+        (lbl["direction"], lbl["seam"]): v
+        for _, lbl, v in families["ktpu_transfer_bytes_total"]["samples"]
+    }
+    assert xfer[("h2d", "snapshot_upload")] > 0, xfer
+    assert xfer[("d2h", "fetch")] > 0, xfer
+    calls = {
+        (lbl["direction"], lbl["seam"]): v
+        for _, lbl, v in families["ktpu_transfer_calls_total"]["samples"]
+    }
+    for key, nbytes in xfer.items():
+        assert calls.get(key, 0) > 0, (key, nbytes, calls)
+    ewma_phases = {
+        lbl["phase"]
+        for _, lbl, v in
+        families["scheduler_perf_phase_ewma_seconds"]["samples"]
+    }
+    from kubernetes_tpu.runtime.perfobs import PHASES
+
+    assert ewma_phases == set(PHASES), ewma_phases
+    assert families["scheduler_perfobs_seconds_total"]["type"] == "counter"
+    assert families["scheduler_perfobs_seconds_total"]["samples"][0][2] > 0
 
 
 def test_labeled_families_remove_and_restart():

@@ -247,6 +247,63 @@ def test_telemetry_and_heartbeat_overhead_under_2_percent():
 
 
 @pytest.mark.perf_smoke
+def test_perfobs_overhead_under_2_percent():
+    """ISSUE 11 acceptance: the performance-observatory hook (cycle
+    split + transfer delta + EWMA fold, with transfer accounting
+    always-on at every wire seam) must cost the scheduling thread <2%
+    of cycle wall at perf_smoke scale — the same budget discipline as
+    the PR 5 span pin and the PR 8 telemetry pin.  The hook's own
+    cumulative counter (scheduler_perfobs_seconds_total) is ratioed
+    against the run's wall clock, so the pin is machine-speed
+    independent."""
+    from kubernetes_tpu.utils import metrics as m
+
+    enc = SnapshotEncoder()
+    enc.add_nodes(_nodes())
+    cache = SchedulerCache(enc)
+    queue = PriorityQueue()
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=lambda pod, node: True,
+        config=SchedulerConfig(
+            batch_size=BATCH, batch_window_s=0.0, engine="speculative",
+            disable_preemption=True, batched_commit=True,
+            pipeline_commit=True,
+        ),
+    )
+
+    def drain(budget_s):
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            got = sched.run_once(timeout=0.0)
+            if got == 0 and not sched.pipeline_pending:
+                if not queue.has_schedulable():
+                    break
+                time.sleep(0.002)
+        sched.flush_pipeline()
+
+    for j in range(BATCH):
+        queue.add(make_pod(f"warm-{j}", cpu="50m", mem="64Mi"))
+    drain(120)
+    spent0 = float(m.PERFOBS_SECONDS.value)
+    t0 = time.monotonic()
+    for i in range(N_PODS):
+        queue.add(make_pod(f"p-{i}", cpu="50m", mem="64Mi",
+                           labels={"app": f"d-{i % 10}"}))
+    drain(120)
+    wall = time.monotonic() - t0
+    spent = float(m.PERFOBS_SECONDS.value) - spent0
+    assert sched.perfobs.cycles_total >= 2
+    # the observatory actually observed the run (transfer accounting on)
+    assert sched.perfobs.summary()["transfers"]
+    ratio = spent / wall
+    assert ratio < 0.02, (
+        f"perf observatory cost {spent * 1000:.1f}ms of "
+        f"{wall * 1000:.0f}ms ({ratio * 100:.2f}%) — the cost model is "
+        f"leaking onto the hot path"
+    )
+
+
+@pytest.mark.perf_smoke
 def test_attribution_launch_overhead_bounded():
     """The attribution variant recomputes nothing the default launch
     didn't already have in flight — it adds reductions (first-failure
