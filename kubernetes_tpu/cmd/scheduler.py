@@ -158,6 +158,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "records a bounded device+host trace there; a "
                    "graceful no-op where the backend lacks profiler "
                    "support")
+    p.add_argument("--megacycle-batches", type=int, default=None,
+                   help="chain up to K pre-encoded batches through the "
+                   "cluster state in one XLA launch (config "
+                   "megacycleBatches; default 1 = single-cycle "
+                   "dispatch).  Chain-safe batches only — anything "
+                   "carrying pod-affinity/ports/volumes/gangs rides the "
+                   "single-cycle path, placements identical either way")
     p.add_argument("--simulate-nodes", type=int, default=0,
                    help="register N hollow nodes")
     p.add_argument("--simulate-pods", type=int, default=0,
@@ -231,6 +238,8 @@ def main(argv=None) -> int:
         cc.invariant_checks = False
     if args.profile_dir is not None:
         cc.profile_dir = args.profile_dir
+    if args.megacycle_batches is not None:
+        cc.megacycle_batches = args.megacycle_batches
 
     # persistent compile cache BEFORE any jit compile (engine build,
     # prewarm, first cycle) so every executable of this process is served
@@ -240,9 +249,15 @@ def main(argv=None) -> int:
     # versa (utils/compilecache.py topology_tag)
     from kubernetes_tpu.utils.compilecache import enable_compile_cache
 
+    # ... and by megacycle depth: a K-deep scan is a different program
+    # family than the single-cycle executables, and the K dimension must
+    # partition the cache exactly like the mesh shape does
     mesh_extra = None
     if cc.shard_devices or cc.mesh_shape:
         mesh_extra = f"mesh{cc.mesh_shape or cc.shard_devices}"
+    if cc.megacycle_batches > 1:
+        mega_tag = f"mega{cc.megacycle_batches}"
+        mesh_extra = f"{mesh_extra}-{mega_tag}" if mesh_extra else mega_tag
     enable_compile_cache(cc.compile_cache_dir, topology_extra=mesh_extra)
 
     if args.kubeconfig:
@@ -317,7 +332,10 @@ def main(argv=None) -> int:
         print(
             f"prewarmed {len(warmed)} batch widths in "
             f"{time.monotonic() - t_warm:.1f}s: "
-            + ", ".join(f"{w}:{s:.2f}s" for w, s in sorted(warmed.items())),
+            + ", ".join(
+                f"{w}:{s:.2f}s"
+                for w, s in sorted(warmed.items(), key=lambda kv: str(kv[0]))
+            ),
             file=sys.stderr,
         )
 

@@ -134,6 +134,13 @@ class KubeSchedulerConfiguration:
     # itself — host/device split, phase x width EWMA, transfer
     # accounting at /debug/perf — is always-on
     profile_dir: Optional[str] = None
+    # device-resident megacycle (runtime/scheduler.py +
+    # models/megacycle.py): chain up to this many pre-encoded batches
+    # through the cluster state in ONE XLA launch, committing the K
+    # winner vectors behind the next launch; 1 = single-cycle dispatch
+    # bit-for-bit.  Only chain-safe batches ride a megacycle (no
+    # pod-affinity/ports/volumes/gangs/nominated pods; lean spread)
+    megacycle_batches: int = 1
 
     def build_profile(self, interner=None) -> SchedulingProfile:
         """CreateFromConfig / CreateFromProvider (scheduler.go:162-192)."""
@@ -218,6 +225,7 @@ class KubeSchedulerConfiguration:
             ),
             invariant_checks=bool(d.get("invariantChecks", True)),
             profile_dir=d.get("profileDir"),
+            megacycle_batches=int(d.get("megacycleBatches", 1)),
         )
 
     @staticmethod

@@ -1,0 +1,234 @@
+"""Device-resident megacycle: K pre-encoded batches in ONE XLA launch.
+
+The r05/PR 11 observatory numbers said the per-batch ceiling is not the
+kernel (~20 ms dispatch per 10k pods) but the host↔device ping-pong and
+Python commit around it (~370 ms).  This module removes the per-batch
+roundtrip: a `lax.scan` over the K axis chains each batch through the
+cluster state ON DEVICE — batch k+1 filters/scores against the state
+batch k committed — and returns all K winner vectors at once, so the
+host pays ONE dispatch + ONE fence per K batches and commits the
+winners asynchronously behind the next launch (runtime/scheduler.py).
+
+What chains between sub-batches (the scan carry):
+
+  requested[N, R] / nonzero_req[N, 2]   resource commits (PodFitsResources
+                                        + the resource scores), exactly the
+                                        PR 6 `donate_cluster` chained-state
+                                        seam, now inside one launch
+  group_counts[N, G]                    SelectorSpread per-group counts:
+                                        each committed pod adds one to every
+                                        group it matches at its landing
+                                        node — bit-identical to the host
+                                        commit's integer recount (small
+                                        ints in f32; adds are exact)
+
+Everything else is carried STATICALLY from the dispatch snapshot, which
+is exact only for batches whose cross-batch interactions are resources +
+spread: the scheduler's eligibility gate (Scheduler._megacycle_safe)
+admits only pods with no pod-(anti-)affinity, no host ports, no volumes,
+no gang labels, and at most one spread group (the encoder's "lean"
+shape), with no live affinity term groups or service-affinity labels in
+the cluster — anything else falls back to the single-cycle path.
+
+Bit-identity contract (pinned by tests/test_megacycle.py): a megacycle
+over K batches places identically to K chained single-cycle launches —
+and, through the scheduler, to K separate live cycles with host commits
+in between — for BOTH engines, single-chip and mesh-sharded.
+
+Buffer donation: the stacked batch buffers are freshly device_put every
+call and donated on accelerator backends.  `donate_cluster=True`
+additionally donates the cluster itself (the bench's raw chained loop);
+the live Scheduler keeps its snapshot resident in DeviceSnapshotCache
+and must NOT donate it — its per-cycle dirty-row scatter refreshes the
+resident copy from the host truth instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kubernetes_tpu.codec import transfer
+from kubernetes_tpu.codec.schema import FilterConfig, ScoreConfig
+from kubernetes_tpu.models.batched import (
+    BatchPortState,
+    make_sequential_scheduler,
+)
+from kubernetes_tpu.ops.priorities import pod_group_onehot
+
+_X = lax.Precision.HIGHEST  # exact f32 matmuls: these carry counts
+
+
+def stack_windows(trees: Sequence) -> object:
+    """Stack K same-shaped pytrees (PodBatch / BatchPortState) along a
+    new leading K axis, leaf-wise on host numpy — what the megacycle
+    launch scans over.  Shapes must already agree (the scheduler
+    re-encodes once after a sticky-dim growth to guarantee it)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *trees
+    )
+
+
+def _commit_group_counts(gc, hosts, pods, n_nodes: int):
+    """Fold one sub-batch's committed placements into the carried
+    SelectorSpread counts: gc[n, g] += 1 for every group g of every pod
+    that landed on node n.  Padding/unschedulable pods carry hosts=-1
+    and contribute nothing.  Integer counts in f32 — exact, so the next
+    host snapshot's recount is bit-identical to this chain."""
+    G = gc.shape[1]
+    onehot_g = pod_group_onehot(pods, G)                       # [B, G]
+    acc = hosts >= 0
+    node_oh = (
+        (hosts[:, None] == jnp.arange(n_nodes, dtype=jnp.int32)[None, :])
+        & acc[:, None]
+    ).astype(jnp.float32)                                      # [B, N]
+    return gc + jnp.matmul(node_oh.T, onehot_g, precision=_X)  # [N, G]
+
+
+_MEGA_CACHE: "OrderedDict" = OrderedDict()
+_MEGA_CACHE_CAP = 16
+
+
+def make_megacycle_scheduler(
+    cfg: FilterConfig = FilterConfig(),
+    weights=None,
+    unsched_taint_key: int = 0,
+    zone_key_id: int = 5,
+    score_cfg: Optional[ScoreConfig] = None,
+    percentage_of_nodes_to_score: int = 100,
+    engine: str = "sequential",
+    donate_cluster: bool = False,
+):
+    """Build (or fetch the memoized) jitted megacycle driver.
+
+    Returns fn(cluster, pods_k, ports_k, last_index0_k) ->
+      (hosts i32[K, B], new_cluster) where pods_k/ports_k carry a
+    leading K axis on every leaf (stack_windows) and last_index0_k is
+    the i32[K] per-sub-batch selectHost rotation base — the scheduler
+    passes base + cumulative RAW pod counts, exactly the values K
+    separate cycles would have seen.  new_cluster carries the final
+    chained requested/nonzero_req/group_counts.
+
+    `engine` selects which single-batch program each scan step runs:
+    the exact sequential-commit scan, or the speculative engine's
+    device path (whose in-program lax.cond exactness redo rides along,
+    so contended sub-batches still match scan semantics).  Each is the
+    SAME traced impl the single-cycle path jits, so a megacycle of K
+    batches is bit-identical to K chained single launches by
+    construction."""
+    donate_batch = jax.default_backend() != "cpu"
+    key = (
+        cfg,
+        tuple(np.asarray(weights, np.float32)) if weights is not None else None,
+        unsched_taint_key,
+        zone_key_id,
+        score_cfg,
+        percentage_of_nodes_to_score,
+        engine,
+        donate_cluster and donate_batch,
+    )
+    hit = _MEGA_CACHE.get(key)
+    if hit is not None:
+        _MEGA_CACHE.move_to_end(key)
+        return hit
+
+    engine_kw = dict(
+        cfg=cfg,
+        weights=weights,
+        unsched_taint_key=unsched_taint_key,
+        zone_key_id=zone_key_id,
+        score_cfg=score_cfg,
+        percentage_of_nodes_to_score=percentage_of_nodes_to_score,
+    )
+    if engine == "speculative":
+        from kubernetes_tpu.models.speculative import (
+            make_speculative_scheduler,
+        )
+
+        spec_impl = make_speculative_scheduler(**engine_kw).raw_impl
+
+        def run_one(cluster, pods, pp, cf, li0):
+            tree = {"pods": pods, "pp": pp, "cf": cf}
+            hosts, req, nz, _rounds, _inv = spec_impl(cluster, tree, li0)
+            return hosts.astype(jnp.int32), req, nz
+    else:
+        seq_impl = make_sequential_scheduler(**engine_kw).jitted
+
+        def run_one(cluster, pods, pp, cf, li0):
+            hosts, new_cl = seq_impl(
+                cluster, pods, BatchPortState(pp, cf), li0,
+                None, None, None, None,
+            )
+            return (
+                hosts.astype(jnp.int32),
+                new_cl.requested,
+                new_cl.nonzero_req,
+            )
+
+    def mega_impl(cluster, pods_k, pp_k, cf_k, li0_k):
+        N = cluster.n_nodes
+
+        def step(carry, xs):
+            req, nz, gc = carry
+            pods, pp, cf, li0 = xs
+            cl = dataclasses.replace(
+                cluster, requested=req, nonzero_req=nz, group_counts=gc
+            )
+            hosts, req2, nz2 = run_one(cl, pods, pp, cf, li0)
+            gc = _commit_group_counts(gc, hosts, pods, N)
+            return (req2, nz2, gc), hosts
+
+        (req, nz, gc), hosts_k = lax.scan(
+            step,
+            (cluster.requested, cluster.nonzero_req, cluster.group_counts),
+            (pods_k, pp_k, cf_k, li0_k),
+        )
+        new_cluster = dataclasses.replace(
+            cluster, requested=req, nonzero_req=nz, group_counts=gc
+        )
+        return hosts_k, new_cluster
+
+    # donation: the stacked batch buffers (1=pods 2=pod_ports 3=conflict)
+    # are dead after the launch by construction (every call re-stacks +
+    # re-transfers); the cluster only for chained-state callers (bench's
+    # raw loop).  XLA:CPU implements no donation — plain jit there.
+    donate: Tuple[int, ...] = ()
+    if donate_batch:
+        donate = (1, 2, 3)
+        if donate_cluster:
+            donate = (0,) + donate
+    mega = jax.jit(mega_impl, donate_argnums=donate)
+
+    def schedule_mega(cluster, pods_k, ports_k, last_index0_k):
+        """Host entry: explicit device_put of the stacked batch pytrees
+        (replicated over a mesh-sharded cluster's devices — the same
+        batch_replicate seam/accounting as the single-cycle engines),
+        then the one launch."""
+        li0 = np.asarray(last_index0_k, np.int32)
+        if jax.default_backend() != "cpu":
+            from kubernetes_tpu.parallel.mesh import (
+                replicated_on_cluster_mesh,
+            )
+
+            tree = (pods_k, ports_k)
+            transfer.note_transfer_tree("h2d", "batch_replicate", tree)
+            dst = replicated_on_cluster_mesh(cluster)
+            pods_k, ports_k = (
+                jax.device_put(tree, dst)
+                if dst is not None else jax.device_put(tree)
+            )
+        return mega(
+            cluster, pods_k, ports_k.pod_ports, ports_k.conflict, li0
+        )
+
+    schedule_mega.engine_kind = engine
+    _MEGA_CACHE[key] = schedule_mega
+    while len(_MEGA_CACHE) > _MEGA_CACHE_CAP:
+        _MEGA_CACHE.popitem(last=False)
+    return schedule_mega

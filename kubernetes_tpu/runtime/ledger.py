@@ -248,6 +248,11 @@ class DecisionLedger:
                 "degraded": bool(outcome.get("degraded", False)),
                 "time": time.time(),
                 "pods": decisions,
+                # (k, K) when the cycle was sub-batch k of a K-deep
+                # megacycle launch (ISSUE 12) — /debug/decisions readers
+                # can join the K blocks of one launch
+                **({"mega": outcome["mega"]}
+                   if outcome.get("mega") is not None else {}),
             }
             self._ring.append(entry)
             self.cycles_total += 1

@@ -248,10 +248,15 @@ class PerfObservatory:
         wall_s: float,
         transfers: Optional[dict] = None,
         trace_id: str = "",
+        mega: Optional[Tuple[int, int]] = None,
     ) -> None:
         """Fold one committed cycle into the cost model.  `transfers` is
         the cycle's codec.transfer.transfer_delta — what the wire moved
-        between this cycle's dispatch and its commit tail."""
+        between this cycle's dispatch and its commit tail.  `mega` =
+        (k, K) marks sub-batch k of a K-deep megacycle launch (ISSUE
+        12): its device/enqueue/wall figures are the 1/K share of the
+        one shared launch, reconstructed by the scheduler so the phase
+        totals still reconcile across the megacycle path."""
         split = {
             "host_enqueue": float(enqueue_s),
             "device_execute": float(execute_s),
@@ -275,6 +280,8 @@ class PerfObservatory:
             ),
             "transfers": transfers or {},
             "trace_id": trace_id,
+            **({"mega": [int(mega[0]), int(mega[1])]}
+               if mega is not None else {}),
         }
         with self._lock:
             for phase, v in split.items():

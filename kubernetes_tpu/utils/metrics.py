@@ -545,13 +545,34 @@ CYCLE_PHASE_SECONDS = REGISTRY.register(
     LabeledCounter(
         "scheduler_cycle_phase_seconds_total",
         "Cumulative seconds spent per scheduling-cycle phase "
-        "(pop|encode|dispatch|fetch|fetch_block|commit|preempt) and "
+        "(pop|encode|dispatch|fetch|host_stall|commit|preempt) and "
         "latency tier (bulk|express); encode includes the extender/"
         "framework fan-out (the span tree at /debug/traces splits "
-        "extenders out); fetch overlaps host phases and fetch_block is a "
+        "extenders out); fetch overlaps host phases and host_stall (the "
+        "residual fence wait — the perf-observatory name; the scheduler's "
+        "phase_seconds dict keeps fetch_block as a lockstep alias) is a "
         "subset of fetch, so phase sums exceeding wall clock means the "
         "pipeline is working",
         ("phase", "tier"),
+    )
+)
+
+# device-resident megacycle (ISSUE 12): K pre-encoded batches chained
+# through the cluster state in one XLA launch (models/megacycle.py)
+MEGACYCLES = REGISTRY.register(
+    Counter(
+        "scheduler_megacycles_total",
+        "Megacycle launches dispatched (each chains K>=2 batches "
+        "through the donated cluster state in one XLA launch; single-"
+        "cycle dispatches are not counted here)",
+    )
+)
+MEGACYCLE_DEPTH = REGISTRY.register(
+    Gauge(
+        "scheduler_megacycle_batches",
+        "Effective megacycle depth K (batches chained per launch): the "
+        "AIMD-steered current value under adaptiveBatch, else the last "
+        "launched depth; 1 = single-cycle dispatch",
     )
 )
 
