@@ -501,6 +501,12 @@ def run(args) -> dict:
     # /debug/cluster artifact next to the trace + ledger)
     if "live_path" in detail and "cluster_health" in detail["live_path"]:
         detail["cluster_health"] = detail["live_path"]["cluster_health"]
+    # ---- quality stage (ISSUE 13), surfaced as its own detail stage:
+    # placement margins / feasible counts / FFD regret / drift state
+    # from the live run's quality observatory (CI asserts presence and
+    # uploads the /debug/quality artifact next to its siblings)
+    if "live_path" in detail and "quality" in detail["live_path"]:
+        detail["quality"] = detail["live_path"]["quality"]
     # ---- latency-tier stage (ISSUE 6): per-tier p50/p99 in the default
     # artifact — express p99 under a saturating bulk load + the bulk
     # throughput it costs, ratioed against the live-path single-lane
@@ -587,6 +593,15 @@ def run(args) -> dict:
             "host_s_per_pod_at_max_k"
         ]
         out["megacycle_identity"] = detail["megacycle"]["identical"]
+    if "quality" in detail:
+        # the placement-quality acceptance trio, tracked at top level:
+        # decision confidence (tolerance-banded — a margin COLLAPSE and
+        # a margin explosion both mean the scoring changed), packing
+        # density vs the FFD counterfactual, and what the observatory
+        # cost the hot path (lower is better)
+        out["placement_margin_p50"] = detail["quality"]["margin_p50"]
+        out["regret_ratio"] = detail["quality"]["regret_ratio"]
+        out["quality_overhead_ratio"] = detail["quality"]["overhead_ratio"]
     if "sharded" in detail:
         # the multi-chip acceptance, tracked at top level: sharded
         # placements bit-identical to single-chip on this very run
@@ -640,6 +655,10 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
             disable_preemption=True,
             batched_commit=batched,
             pipeline_commit=pipeline,
+            # regret counterfactual every other cycle: smoke runs have
+            # only a handful of cycles and the quality stage must bank
+            # at least one materialized FFD sample
+            quality_interval_cycles=2,
         ),
         ledger=ledger,
     )
@@ -674,6 +693,10 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
     from kubernetes_tpu.utils import metrics as _m_t
 
     _tel0 = float(_m_t.TELEMETRY_SECONDS.value)
+    # quality-cost watermark: same discipline as the telemetry one —
+    # the cumulative hook counter minus this is what the observatory
+    # cost the timed window (the <2% overhead_ratio figure)
+    _q0 = float(_m_t.QUALITY_SECONDS.value)
     total = args.pods
     # pod-object construction stays outside the timed window (the raw
     # stage and the reference's create strategy both exclude it); the
@@ -723,6 +746,39 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
                 round(tel_s / dt, 4) if dt > 0 else 0.0
             ),
         }
+    # ---- placement-quality stage (ISSUE 13): margins, feasible
+    # counts, the FFD-counterfactual regret, drift-detector state, and
+    # the hook's own hot-path cost ratioed against the run's wall clock
+    # (the <2% acceptance pin, measured on the bench shape itself).
+    # finalize() materializes the last in-flight regret launch — the
+    # amortization would otherwise strand it on a drained queue.
+    quality_stage = None
+    if sched.quality is not None:
+        sched.quality.finalize()
+        q_s = float(_m_t.QUALITY_SECONDS.value) - _q0
+        qsum = sched.quality.summary()
+        quality_stage = {
+            "margin_p50": qsum["margin"]["p50"],
+            "margin_mean": qsum["margin"]["mean"],
+            "margins": qsum["margin"]["count"],
+            "feasible_p50": qsum["feasible"]["p50"],
+            "regret_ratio": (qsum["regret"] or {}).get("ratio", 0.0),
+            "regret": qsum["regret"],
+            "regret_samples": qsum["regret_samples"],
+            "drift": qsum["drift"],
+            "drift_alerts": qsum["drift_alerts_total"],
+            "top_k": qsum["top_k"],
+            "decisions": qsum["decisions"],
+            "quality_seconds": round(q_s, 4),
+            "overhead_ratio": round(q_s / dt, 4) if dt > 0 else 0.0,
+        }
+        if getattr(args, "quality_out", None) and batched and pipeline:
+            with open(args.quality_out, "w") as f:
+                json.dump(sched.quality.debug_payload(), f, indent=1)
+            sys.stderr.write(
+                f"bench: wrote /debug/quality payload to "
+                f"{args.quality_out}\n"
+            )
     # ---- performance observatory stage (ISSUE 11): the live run's
     # host/device time attribution + transfer accounting, straight from
     # the scheduler's observatory (the /debug/perf summary body).  CI
@@ -752,6 +808,7 @@ def run_live(args, batched: bool = True, pipeline: bool = True) -> dict:
         "batched_commit": batched,
         "pipeline_commit": pipeline,
         **({"cluster_health": cluster_health} if cluster_health else {}),
+        **({"quality": quality_stage} if quality_stage else {}),
         "perf_observatory": perf_observatory,
         **({"ledger": ledger_stats} if ledger_stats else {}),
         "commit_seconds": round(sched.phase_seconds["commit"], 3),
@@ -1853,6 +1910,8 @@ def _child_cmd(args, platform: str | None) -> list:
         cmd += ["--ledger-out", args.ledger_out]
     if getattr(args, "cluster_out", None):
         cmd += ["--cluster-out", args.cluster_out]
+    if getattr(args, "quality_out", None):
+        cmd += ["--quality-out", args.quality_out]
     if args.density:
         cmd += ["--density",
                 "--density-interval", str(args.density_interval),
@@ -2045,6 +2104,16 @@ _BASELINE_CHECKS = (
      ("megacycle_host_s_per_pod",
       "detail.megacycle.host_s_per_pod_at_max_k"),
      "lower", 1.5),
+    # placement quality (ISSUE 13): margin is BAND-gated — a collapse
+    # (every decision a coin flip) and an explosion (scores diverged)
+    # both mean the scoring function changed out from under us; the
+    # observatory's hot-path cost gates lower-is-better like a latency
+    ("placement_margin_p50",
+     ("placement_margin_p50", "detail.quality.margin_p50"),
+     "band", 1.0),
+    ("quality_overhead_ratio",
+     ("quality_overhead_ratio", "detail.quality.overhead_ratio"),
+     "lower", 1.5),
 )
 
 # phase-second growth is noisy at smoke scale: a phase only regresses
@@ -2101,7 +2170,14 @@ def compare_artifacts(baseline: dict, current: dict,
                 base = _artifact_get(baseline, p)
             if cur is None:
                 cur = _artifact_get(current, p)
-        if base is None or cur is None or base <= 0:
+        if base is None or cur is None:
+            continue
+        # ratio gates need a positive baseline; the two-sided band gate
+        # also accepts base == 0 (a legitimately tie-dominated margin
+        # baseline must still catch margins EXPLODING — see below)
+        if base <= 0 and direction != "band":
+            continue
+        if base < 0:
             continue
         tol = tolerance * weight
         if direction == "higher":
@@ -2112,6 +2188,17 @@ def compare_artifacts(baseline: dict, current: dict,
             tol = min(0.95, tol)
             band = [round(base * (1 - tol), 4), None]
             bad = cur < base * (1 - tol)
+        elif direction == "band":
+            # two-sided: the metric must stay NEAR the baseline —
+            # either escape direction is a regression (placement
+            # margin: collapse and explosion both mean the scoring
+            # changed).  The band half-width scales on max(base, 0.05)
+            # so a tie-dominated 0.0 margin baseline still gates a
+            # margin explosion instead of degenerating to [0, 0].
+            tol = min(0.95, tol)
+            half = tol * max(base, 0.05)
+            band = [round(base - half, 4), round(base + half, 4)]
+            bad = cur < base - half or cur > base + half
         else:
             band = [None, round(base * (1 + tol), 4)]
             bad = cur > base * (1 + tol)
@@ -2119,7 +2206,9 @@ def compare_artifacts(baseline: dict, current: dict,
             "name": name,
             "baseline": base,
             "current": cur,
-            "ratio": round(cur / base, 4),
+            # a zero baseline (band-gated metrics admit it) has no
+            # meaningful ratio; the band carries the verdict
+            "ratio": round(cur / base, 4) if base > 0 else None,
             "direction": direction,
             "band": band,
             "regression": bad,
@@ -2182,8 +2271,9 @@ def _emit_perf_delta(args, delta: dict, baseline_path: str,
             json.dump(report, f, indent=1)
     for c in delta["checks"]:
         sys.stderr.write(
-            "bench: perf-delta %-22s base=%-10g cur=%-10g ratio=%.3f%s\n"
-            % (c["name"], c["baseline"], c["current"], c["ratio"],
+            "bench: perf-delta %-22s base=%-10g cur=%-10g ratio=%s%s\n"
+            % (c["name"], c["baseline"], c["current"],
+               "%.3f" % c["ratio"] if c["ratio"] is not None else "n/a",
                "  REGRESSION" if c["regression"] else "")
         )
     if delta["regressions"]:
@@ -2382,6 +2472,14 @@ def main():
         "occupancy samples, HBM + compile facts, SLO burn rates) as "
         "JSON here — the artifact CI uploads next to the Chrome trace "
         "and the decision ledger",
+    )
+    ap.add_argument(
+        "--quality-out", default=None,
+        help="write the run's placement-quality payload (the "
+        "/debug/quality body: winner margins, feasible counts, FFD-"
+        "counterfactual regret, drift-detector state and per-cycle "
+        "samples) as JSON here — the artifact CI uploads next to the "
+        "trace/ledger/cluster files",
     )
     ap.add_argument(
         "--replay", default=None, metavar="LEDGER",

@@ -960,28 +960,54 @@ class APIServer:
                         ct="application/json",
                     )
                     return
+                if self.path.partition("?")[0] == "/debug/quality":
+                    # the placement-quality observatory (runtime/
+                    # quality.py): winner margins, feasible counts,
+                    # FFD-counterfactual regret, drift detectors — in
+                    # embedded deployments the scheduling happens in
+                    # this process, so its observatory is the process
+                    # default.  Inflight-exempt like its siblings
+                    from kubernetes_tpu.runtime import quality
+                    from kubernetes_tpu.runtime.ledger import debug_body
+
+                    self._send_text(
+                        debug_body(
+                            quality.get_default().debug_payload,
+                            self.path.partition("?")[2],
+                        ),
+                        ct="application/json",
+                    )
+                    return
                 if self.path.partition("?")[0] == "/debug/profile":
                     # on-demand bounded jax.profiler capture
                     # (?seconds=N; throttled, graceful no-op where the
-                    # backend lacks profiler support)
-                    import json as _json
-
+                    # backend lacks profiler support).  debug_body-
+                    # routed like every /debug/* response
                     from kubernetes_tpu.runtime import perfobs
+                    from kubernetes_tpu.runtime.ledger import debug_body
 
+                    query = self.path.partition("?")[2]
                     self._send_text(
-                        _json.dumps(perfobs.profile_request(
-                            self.path.partition("?")[2]
-                        )).encode(),
+                        debug_body(
+                            lambda _lim=None: perfobs.profile_request(
+                                query
+                            ),
+                            query,
+                        ),
                         ct="application/json",
                     )
                     return
                 if self.path.partition("?")[0] in ("/debug", "/debug/"):
-                    import json as _json
-
-                    from kubernetes_tpu.runtime.ledger import debug_index
+                    from kubernetes_tpu.runtime.ledger import (
+                        debug_body,
+                        debug_index,
+                    )
 
                     self._send_text(
-                        _json.dumps(debug_index()).encode(),
+                        debug_body(
+                            lambda _lim=None: debug_index(),
+                            self.path.partition("?")[2],
+                        ),
                         ct="application/json",
                     )
                     return
@@ -2099,7 +2125,7 @@ class APIServer:
             exempt = ("/healthz", "/livez", "/readyz", "/metrics",
                       "/version", "/debug/traces", "/debug/decisions",
                       "/debug/cluster", "/debug/perf", "/debug/profile",
-                      "/debug", "/debug/")
+                      "/debug/quality", "/debug", "/debug/")
             for method in ("do_GET", "do_POST", "do_PUT", "do_PATCH",
                            "do_DELETE"):
                 inner = getattr(Handler, method)

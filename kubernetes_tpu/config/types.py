@@ -141,6 +141,14 @@ class KubeSchedulerConfiguration:
     # bit-for-bit.  Only chain-safe batches ride a megacycle (no
     # pod-affinity/ports/volumes/gangs/nominated pods; lean spread)
     megacycle_batches: int = 1
+    # placement-quality observatory (runtime/quality.py): in-launch
+    # winner-pinned top-k width (qualityTopK; 0 disables the seam —
+    # placements bit-identical either way), the amortized FFD-regret
+    # sampling cadence (qualityIntervalCycles), and the dual-window
+    # packing-drift step threshold (qualityDriftThreshold)
+    quality_top_k: int = 3
+    quality_interval_cycles: int = 32
+    quality_drift_threshold: float = 0.25
 
     def build_profile(self, interner=None) -> SchedulingProfile:
         """CreateFromConfig / CreateFromProvider (scheduler.go:162-192)."""
@@ -226,6 +234,11 @@ class KubeSchedulerConfiguration:
             invariant_checks=bool(d.get("invariantChecks", True)),
             profile_dir=d.get("profileDir"),
             megacycle_batches=int(d.get("megacycleBatches", 1)),
+            quality_top_k=int(d.get("qualityTopK", 3)),
+            quality_interval_cycles=int(d.get("qualityIntervalCycles", 32)),
+            quality_drift_threshold=float(
+                d.get("qualityDriftThreshold", 0.25)
+            ),
         )
 
     @staticmethod

@@ -60,9 +60,11 @@ from kubernetes_tpu.ops.priorities import (
     taint_toleration,
 )
 from kubernetes_tpu.ops.select import (
+    TopKQuality,
     limit_feasible,
     num_feasible_nodes_device,
     select_host,
+    select_topk,
 )
 from kubernetes_tpu.codec.schema import (
     DEFAULT_PRIORITY_WEIGHTS,
@@ -510,6 +512,7 @@ def make_sequential_scheduler(
     donate_cluster: bool = False,
     attribution: bool = False,
     attribution_topk: int = 3,
+    quality_topk: int = 0,
 ):
     """Build (or fetch the memoized) jitted sequential-commit scheduler.
 
@@ -523,6 +526,16 @@ def make_sequential_scheduler(
     per-plugin score breakdown — computed inside the same scan against
     the exact per-step state, so winners are bit-identical either way
     (pinned by tests/test_ledger.py).
+
+    With quality_topk=K > 0 (another STATIC output-only flag — the
+    placement-quality observatory, runtime/quality.py) the launch ALSO
+    returns an ops/select.TopKQuality pytree: per pod, the K best
+    feasible node rows with the winner pinned at column 0, their total
+    scores, and the feasible-candidate count the selector argmaxed
+    over — all read off the same per-step (mask, total, host) the
+    placement used, so winners stay bit-identical flag-on/off (pinned
+    by tests/test_quality.py).  Output order when both flags are on:
+    (hosts, new_cluster, Attribution, TopKQuality).
 
     Buffer donation (accelerator backends only; XLA:CPU has no donation):
     the PER-BATCH argument buffers — pods/ports/nominated/extra mask+score/
@@ -548,6 +561,7 @@ def make_sequential_scheduler(
         donate_cluster and donate_batch,
         attribution,
         attribution_topk,
+        quality_topk,
     )
     hit = _SEQ_CACHE.get(key)
     if hit is not None:
@@ -653,6 +667,9 @@ def make_sequential_scheduler(
             tk = min(attribution_topk, cluster.n_nodes)
         else:
             comp_static = None
+        # quality top-k width: static, clamped to the arena (a 2-node
+        # toy cluster cannot rank 3 rows)
+        tkq = min(quality_topk, cluster.n_nodes) if quality_topk else 0
         feas_limit = (
             num_feasible_nodes_device(
                 jnp.sum(cluster.valid.astype(jnp.int32)),
@@ -832,6 +849,14 @@ def make_sequential_scheduler(
                 # reference's rotating start offset
                 mask = limit_feasible(mask, feas_limit, last_idx)
             host, feasible = select_host(total, mask, last_idx)
+            # quality top-k (static output-only flag): the winner-pinned
+            # ranking + feasible count off the exact (mask, total, host)
+            # the selection above used — including the adaptive-sampling
+            # cut, so "feasible" means candidates actually considered
+            qual_out = (
+                select_topk(total, mask, host, feasible, tkq)
+                if tkq else None
+            )
             # commit
             commit = feasible
             onehot = (jnp.arange(requested.shape[0]) == host) & commit  # [N]
@@ -889,7 +914,7 @@ def make_sequential_scheduler(
             return (
                 (requested, nonzero2, spread_extra, port_used, last_idx + 1,
                  extra_aff, extra_anti, extra_forb, extra_pref),
-                (out_host, attr_out),
+                (out_host, attr_out, qual_out),
             )
 
         PV = ports.pod_ports.shape[1]
@@ -948,7 +973,7 @@ def make_sequential_scheduler(
             # every predicate passing can ONLY be an extra-mask veto
             (per_pred, comp_static) if attribution else None,
         )
-        (requested, nonzero2, *_), (hosts, attr_ys) = jax.lax.scan(
+        (requested, nonzero2, *_), (hosts, attr_ys, qual_ys) = jax.lax.scan(
             step, init, xs
         )
         import dataclasses as _dc
@@ -958,9 +983,12 @@ def make_sequential_scheduler(
             requested=requested,
             nonzero_req=nonzero2,
         )
+        outs = (hosts, new_cluster)
         if attribution:
-            return hosts, new_cluster, Attribution(*attr_ys)
-        return hosts, new_cluster
+            outs = outs + (Attribution(*attr_ys),)
+        if tkq:
+            outs = outs + (TopKQuality(*qual_ys),)
+        return outs
 
     # donation (see the maker docstring): batch buffers always on
     # accelerator backends, the cluster only for chained-state callers.
@@ -1011,6 +1039,9 @@ def make_sequential_scheduler(
     # attribution variants return (hosts, new_cluster, Attribution);
     # callers handling either arity key off this
     schedule_entry.attribution = attribution
+    # quality variants append a TopKQuality as the LAST output (after
+    # Attribution when both flags are on); 0 = off
+    schedule_entry.quality_topk = quality_topk
 
     _SEQ_CACHE[key] = schedule_entry
     while len(_SEQ_CACHE) > _SEQ_CACHE_CAP:

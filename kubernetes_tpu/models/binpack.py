@@ -28,7 +28,14 @@ import numpy as np
 
 @partial(jax.jit, static_argnames=("max_bins",))
 def binpack_ffd(pod_reqs, capacity, max_bins: int = 1024, order=None):
-    """First-fit binpack of pod_reqs f32[P, R] into bins of `capacity` f32[R].
+    """First-fit binpack of pod_reqs f32[P, R] into bins of `capacity`.
+
+    `capacity` is either f32[R] (every bin the same shape — the
+    autoscaler what-if) or f32[max_bins, R] (per-bin capacities — the
+    quality observatory's regret counterfactual packs into each node's
+    REMAINING free capacity, runtime/quality.py; a zero row is a full
+    node no pod fits).  With the 2D form max_bins must equal
+    capacity.shape[0].
 
     pod_reqs should be pre-sorted descending (see sort_pods_for_ffd) for the
     FFD guarantee — or pass `order` i32[P] to pack in that index order
@@ -43,11 +50,12 @@ def binpack_ffd(pod_reqs, capacity, max_bins: int = 1024, order=None):
     must scatter back: out = np.empty(P, bool); out[order] = placed.
     The in-tree caller (binpack_shapes) only reduces with jnp.all, which
     is permutation-insensitive."""
+    cap = capacity if capacity.ndim == 2 else capacity[None, :]
 
     def step(loads, oi):
         req = pod_reqs[oi]
         real = jnp.any(req > 0)
-        fits = jnp.all(loads + req[None, :] <= capacity[None, :], axis=-1)
+        fits = jnp.all(loads + req[None, :] <= cap, axis=-1)
         idx = jnp.argmax(fits)  # first fitting bin (zeros always fit if req<=cap)
         ok = real & fits[idx]
         loads = loads.at[idx].add(jnp.where(ok, req, 0.0))

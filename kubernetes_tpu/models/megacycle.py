@@ -104,6 +104,7 @@ def make_megacycle_scheduler(
     percentage_of_nodes_to_score: int = 100,
     engine: str = "sequential",
     donate_cluster: bool = False,
+    quality_topk: int = 0,
 ):
     """Build (or fetch the memoized) jitted megacycle driver.
 
@@ -114,6 +115,12 @@ def make_megacycle_scheduler(
     passes base + cumulative RAW pod counts, exactly the values K
     separate cycles would have seen.  new_cluster carries the final
     chained requested/nonzero_req/group_counts.
+
+    quality_topk=K' > 0 (STATIC, output-only — runtime/quality.py):
+    the call returns (hosts, new_cluster, TopKQuality) where the
+    quality leaves carry a leading K axis ([K, B, K'] / [K, B]) — each
+    sub-batch's winner-pinned top-k against exactly the chained state
+    its placements saw.  Placements stay bit-identical flag-on/off.
 
     `engine` selects which single-batch program each scan step runs:
     the exact sequential-commit scan, or the speculative engine's
@@ -132,6 +139,7 @@ def make_megacycle_scheduler(
         percentage_of_nodes_to_score,
         engine,
         donate_cluster and donate_batch,
+        quality_topk,
     )
     hit = _MEGA_CACHE.get(key)
     if hit is not None:
@@ -145,6 +153,7 @@ def make_megacycle_scheduler(
         zone_key_id=zone_key_id,
         score_cfg=score_cfg,
         percentage_of_nodes_to_score=percentage_of_nodes_to_score,
+        quality_topk=quality_topk,
     )
     if engine == "speculative":
         from kubernetes_tpu.models.speculative import (
@@ -155,20 +164,25 @@ def make_megacycle_scheduler(
 
         def run_one(cluster, pods, pp, cf, li0):
             tree = {"pods": pods, "pp": pp, "cf": cf}
-            hosts, req, nz, _rounds, _inv = spec_impl(cluster, tree, li0)
-            return hosts.astype(jnp.int32), req, nz
+            hosts, req, nz, _rounds, _inv, qual = spec_impl(
+                cluster, tree, li0
+            )
+            return hosts.astype(jnp.int32), req, nz, qual
     else:
         seq_impl = make_sequential_scheduler(**engine_kw).jitted
 
         def run_one(cluster, pods, pp, cf, li0):
-            hosts, new_cl = seq_impl(
+            outs = seq_impl(
                 cluster, pods, BatchPortState(pp, cf), li0,
                 None, None, None, None,
             )
+            hosts, new_cl = outs[0], outs[1]
+            qual = outs[2] if quality_topk else None
             return (
                 hosts.astype(jnp.int32),
                 new_cl.requested,
                 new_cl.nonzero_req,
+                qual,
             )
 
     def mega_impl(cluster, pods_k, pp_k, cf_k, li0_k):
@@ -180,11 +194,11 @@ def make_megacycle_scheduler(
             cl = dataclasses.replace(
                 cluster, requested=req, nonzero_req=nz, group_counts=gc
             )
-            hosts, req2, nz2 = run_one(cl, pods, pp, cf, li0)
+            hosts, req2, nz2, qual = run_one(cl, pods, pp, cf, li0)
             gc = _commit_group_counts(gc, hosts, pods, N)
-            return (req2, nz2, gc), hosts
+            return (req2, nz2, gc), (hosts, qual)
 
-        (req, nz, gc), hosts_k = lax.scan(
+        (req, nz, gc), (hosts_k, qual_k) = lax.scan(
             step,
             (cluster.requested, cluster.nonzero_req, cluster.group_counts),
             (pods_k, pp_k, cf_k, li0_k),
@@ -192,6 +206,8 @@ def make_megacycle_scheduler(
         new_cluster = dataclasses.replace(
             cluster, requested=req, nonzero_req=nz, group_counts=gc
         )
+        if quality_topk:
+            return hosts_k, new_cluster, qual_k
         return hosts_k, new_cluster
 
     # donation: the stacked batch buffers (1=pods 2=pod_ports 3=conflict)
@@ -228,6 +244,7 @@ def make_megacycle_scheduler(
         )
 
     schedule_mega.engine_kind = engine
+    schedule_mega.quality_topk = quality_topk
     _MEGA_CACHE[key] = schedule_mega
     while len(_MEGA_CACHE) > _MEGA_CACHE_CAP:
         _MEGA_CACHE.popitem(last=False)

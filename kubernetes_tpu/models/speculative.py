@@ -105,9 +105,11 @@ from kubernetes_tpu.ops.priorities import (
     spread_score_from_counts,
 )
 from kubernetes_tpu.ops.select import (
+    TopKQuality,
     limit_feasible,
     num_feasible_nodes_device,
     select_hosts_batch,
+    select_topk_batch,
 )
 
 _X = lax.Precision.HIGHEST  # exact f32 matmuls: these carry counts, not ML
@@ -133,6 +135,7 @@ def make_speculative_scheduler(
     percentage_of_nodes_to_score: int = 100,
     hybrid: bool = True,
     donate_cluster: bool = False,
+    quality_topk: int = 0,
 ):
     """Same call contract as make_sequential_scheduler:
     fn(cluster, pods, ports, last_index0, nominated=None, extra_mask=None,
@@ -146,6 +149,16 @@ def make_speculative_scheduler(
     bench's raw-engine loop and its live-path Scheduler compile once.
     FORCE_PACKED_PATH is read per call, so the memo never staleness-locks
     the CPU test hook.
+
+    quality_topk=K > 0 (STATIC, output-only — the placement-quality
+    observatory seam, runtime/quality.py): the call returns
+    (hosts, new_cluster, ops/select.TopKQuality) instead of the pair.
+    Each pod's winner-pinned top-k rows + scores + feasible count are
+    captured AT THE ROUND IT WAS ACCEPTED (so they reflect exactly the
+    carry state its commit saw); the hybrid exactness redo returns the
+    sequential scan's quality instead, so the pytree always describes
+    the placements actually committed.  Winners are bit-identical
+    flag-on/off (pinned by tests/test_quality.py).
 
     Buffer donation (accelerator device path only): the PACKED batch
     buffers — device_put fresh every call, dead after the launch — are
@@ -164,6 +177,7 @@ def make_speculative_scheduler(
         percentage_of_nodes_to_score,
         hybrid,
         donate_cluster,
+        quality_topk,
     )
     hit = _SPEC_CACHE.get(key)
     if hit is not None:
@@ -464,6 +478,19 @@ def make_speculative_scheduler(
             # any pod left unscheduled (checked host-side on the result)
             "inv": c["inv"] | inv_new | jnp.any(real_bounce),
         }
+        if quality_topk:
+            # quality top-k (static output-only flag): capture each
+            # accepted pod's winner-pinned ranking + feasible count AT
+            # ITS COMMIT ROUND, off the exact (mask, total, hosts) the
+            # acceptance above used; bounced/pending pods keep -1 until
+            # their round, retired-infeasible pods keep -1 forever
+            qb = select_topk_batch(
+                total, mask, hosts, feasible, min(quality_topk, N)
+            )
+            upd = accept[:, None]
+            out["topn"] = jnp.where(upd, qb.top_nodes, c["topn"])
+            out["tops"] = jnp.where(upd, qb.top_scores, c["tops"])
+            out["feas"] = jnp.where(accept, qb.feasible, c["feas"])
         if aff is None:
             # retired: accepted, or nothing feasible this round
             out["active"] = c["active"] & feasible & ~accept
@@ -558,6 +585,11 @@ def make_speculative_scheduler(
             c["xanti"] = jnp.zeros((B, AT, TP), jnp.bool_)
             c["xforb"] = jnp.zeros((B, TP), jnp.bool_)
             c["xpref"] = jnp.zeros((B, TP), jnp.float32)
+        if quality_topk:
+            tkq = min(quality_topk, N)
+            c["topn"] = jnp.full((B, tkq), -1, jnp.int32)
+            c["tops"] = jnp.zeros((B, tkq), jnp.float32)
+            c["feas"] = jnp.zeros((B,), jnp.int32)
         return c
 
     def _parts(tree):
@@ -618,32 +650,51 @@ def make_speculative_scheduler(
             ports_state = BatchPortState(pod_ports, conflict)
 
             def _redo(_):
-                h2, c2 = seq(
+                souts = seq(
                     cluster, pods, ports_state, last_index0, nom,
                     emask0, escore, aff,
                 )
-                return (
+                h2, c2 = souts[0], souts[1]
+                base = (
                     h2.astype(jnp.int32),
                     c2.requested.astype(jnp.float32),
                     c2.nonzero_req.astype(jnp.float32),
                 )
+                if quality_topk:
+                    # the redo's quality describes the placements
+                    # actually committed (the scan's), same widths by
+                    # construction (same N, same static K)
+                    q2 = souts[2]
+                    base = base + (q2.top_nodes, q2.top_scores, q2.feasible)
+                return base
 
             def _keep(_):
-                return (
+                base = (
                     out["hosts"].astype(jnp.int32),
                     out["req"].astype(jnp.float32),
                     out["nz"].astype(jnp.float32),
                 )
+                if quality_topk:
+                    base = base + (out["topn"], out["tops"], out["feas"])
+                return base
 
-            hosts, req, nz = lax.cond(inv, _redo, _keep, None)
-            return hosts, req, nz, rounds, inv
-        return out["hosts"], out["req"], out["nz"], rounds, inv
+            picked = lax.cond(inv, _redo, _keep, None)
+            hosts, req, nz = picked[:3]
+            qual = TopKQuality(*picked[3:]) if quality_topk else None
+            return hosts, req, nz, rounds, inv, qual
+        qual = (
+            TopKQuality(out["topn"], out["tops"], out["feas"])
+            if quality_topk else None
+        )
+        return out["hosts"], out["req"], out["nz"], rounds, inv, qual
 
     @lru_cache(maxsize=64)
     def _packed(meta):
         def run(cluster, bufs, last_index0):
             tree = unpack_tree(bufs, meta)
-            hosts, req, nz, rounds, inv = _impl(cluster, tree, last_index0)
+            hosts, req, nz, rounds, inv, qual = _impl(
+                cluster, tree, last_index0
+            )
             # new_cluster is assembled INSIDE the jit so that under
             # donation the untouched static leaves alias input->output
             # (identity) and req/nz land in the donated buffers — the
@@ -651,7 +702,7 @@ def make_speculative_scheduler(
             new_cluster = dataclasses.replace(
                 cluster, requested=req, nonzero_req=nz
             )
-            return hosts, new_cluster, rounds, inv
+            return hosts, new_cluster, rounds, inv, qual
 
         # the packed batch buffers (argnum 1) are dead after the launch by
         # construction (schedule() re-packs + re-uploads every call);
@@ -708,7 +759,11 @@ def make_speculative_scheduler(
         while bool(np.asarray(c["active"]).any()):
             c = _round_host(cluster, parts, c)
             rounds += 1
-        return c["hosts"], c["req"], c["nz"], rounds, c["inv"]
+        qual = (
+            TopKQuality(c["topn"], c["tops"], c["feas"])
+            if quality_topk else None
+        )
+        return c["hosts"], c["req"], c["nz"], rounds, c["inv"], qual
 
     def _exact_scan():
         """The memoized sequential scan both redo paths share (in-_impl
@@ -722,6 +777,7 @@ def make_speculative_scheduler(
             unsched_taint_key=unsched_taint_key,
             zone_key_id=zone_key_id, score_cfg=score_cfg,
             percentage_of_nodes_to_score=percentage_of_nodes_to_score,
+            quality_topk=quality_topk,
         )
 
     def schedule(cluster: ClusterTensors, pods: PodBatch, ports,
@@ -761,11 +817,11 @@ def make_speculative_scheduler(
                 if dst is not None else jax.device_put(bufs)
             )
         if on_cpu:
-            hosts, req, nz, rounds, inv = _host_rounds(
+            hosts, req, nz, rounds, inv, qual = _host_rounds(
                 cluster, bufs, meta, last_index0
             )
         else:
-            hosts, new_cluster, rounds, inv = _packed(meta)(
+            hosts, new_cluster, rounds, inv, qual = _packed(meta)(
                 cluster, bufs, np.int32(last_index0)
             )
             # the exactness redo already ran ON DEVICE behind lax.cond
@@ -775,6 +831,8 @@ def make_speculative_scheduler(
             # batch, so only observability/tests should touch it.
             schedule.last_rounds = rounds
             schedule.last_redo = inv if hybrid else False
+            if quality_topk:
+                return hosts, new_cluster, qual
             return hosts, new_cluster
         schedule.last_rounds = rounds  # observability: repair rounds used
         schedule.last_redo = False
@@ -793,12 +851,16 @@ def make_speculative_scheduler(
             # costs one scan on the contended batches only — uncontended
             # batches (the common case: round 1 commits everything, or
             # orderly founder->mates chains) keep the parallel fast path.
+            # With quality on the scan's own TopKQuality rides along as
+            # the third output — same arity either way.
             schedule.last_redo = True
             return _exact_scan()(
                 cluster, pods, ports, last_index0, nominated,
                 extra_mask, extra_score, aff_state,
             )
         new_cluster = dataclasses.replace(cluster, requested=req, nonzero_req=nz)
+        if quality_topk:
+            return hosts, new_cluster, qual
         return hosts, new_cluster
 
     # engine identity tag (see models/batched.py): multi-round placement
@@ -809,8 +871,10 @@ def make_speculative_scheduler(
     # exactness redo) for callers composing INSIDE jit — the megacycle
     # driver (models/megacycle.py) scans it over K chained batches.
     # Signature: _impl(cluster, {"pods","pp","cf",...}, last_index0) ->
-    # (hosts, req, nz, rounds, inv)
+    # (hosts, req, nz, rounds, inv, quality-or-None)
     schedule.raw_impl = _impl
+    # quality variants return (hosts, new_cluster, TopKQuality); 0 = off
+    schedule.quality_topk = quality_topk
     _SPEC_CACHE[key] = schedule
     while len(_SPEC_CACHE) > _SPEC_CACHE_CAP:
         _SPEC_CACHE.popitem(last=False)

@@ -13,6 +13,8 @@ not as a performance necessity.
 
 from __future__ import annotations
 
+from typing import Any, NamedTuple
+
 import jax.numpy as jnp
 
 MIN_FEASIBLE_NODES_TO_FIND = 100          # generic_scheduler.go:52-57
@@ -87,6 +89,74 @@ def select_host(scores, mask, last_index):
     rank = jnp.cumsum(is_tie.astype(jnp.int32)) - 1          # rank among ties
     host = jnp.argmax(is_tie & (rank == k))
     return host.astype(jnp.int32), feasible
+
+
+class TopKQuality(NamedTuple):
+    """Per-pod decision-quality outputs of the engines' `quality_topk`
+    static-flag variant (the placement-quality observatory's raw signal,
+    runtime/quality.py).
+
+    top_nodes[..., K]: the K best-scoring feasible node rows with the
+    WINNER PINNED AT COLUMN 0 (select_host's argmax-with-rotating-tie-
+    break winner, not top_k's first-occurrence tie order — so column 0
+    always equals the committed placement); -1 where fewer than K nodes
+    were feasible (and the whole row when the pod was unschedulable).
+    top_scores[..., K]: those rows' total scores (0 in -1 slots).
+    feasible[...]: how many candidate nodes the selector actually
+    considered for the pod — the post-predicate, post-sampling mask
+    population select_host argmaxed over."""
+
+    top_nodes: Any   # i32[..., K]
+    top_scores: Any  # f32[..., K]
+    feasible: Any    # i32[...]
+
+
+def select_topk(scores, mask, host, feasible, k: int) -> TopKQuality:
+    """Winner-pinned top-k companion to select_host: given the SAME
+    (scores, mask) the selector saw plus its (host, feasible) verdict,
+    return the top-k rows with the winner first and the runner-ups in
+    descending score order.  Read-only — composing this alongside
+    select_host cannot perturb the placement (the flag-on/off
+    bit-identity the quality observatory pins).
+
+    Only the ranking generalizes beyond the argmax: on a node-sharded
+    mesh XLA lowers the masked top_k exactly like the argmax reduction
+    (per-shard candidates, one cross-shard combine), so the sharded
+    engines return the same rows as single-chip."""
+    import jax
+
+    neg = jnp.float32(-3.4e38)
+    n = scores.shape[-1]
+    s = jnp.where(mask, scores, neg)
+    win_score = jnp.where(feasible, s[host], neg)
+    win_node = jnp.where(feasible, host, -1).astype(jnp.int32)
+    if k > 1:
+        # mask the winner out so the remaining k-1 slots are the true
+        # runner-ups even when ties rotated the winner off top_k's
+        # first-occurrence order
+        s2 = jnp.where((jnp.arange(n) == host) & feasible, neg, s)
+        rv, ri = jax.lax.top_k(s2, k - 1)
+        vals = jnp.concatenate([win_score[None], rv])
+        idx = jnp.concatenate([win_node[None], ri.astype(jnp.int32)])
+    else:
+        vals = win_score[None]
+        idx = win_node[None]
+    ok = vals > neg / 2
+    return TopKQuality(
+        top_nodes=jnp.where(ok, idx, -1).astype(jnp.int32),
+        top_scores=jnp.where(ok, vals, jnp.float32(0.0)),
+        feasible=jnp.sum(mask.astype(jnp.int32), axis=-1),
+    )
+
+
+def select_topk_batch(scores, mask, hosts, feasible, k: int) -> TopKQuality:
+    """Vectorized winner-pinned top-k over a [B, N] grid (the
+    speculative engine's per-round companion to select_hosts_batch)."""
+    import jax
+
+    return jax.vmap(
+        lambda s, mk, h, f: select_topk(s, mk, h, f, k)
+    )(scores, mask, hosts, feasible)
 
 
 def select_hosts_batch(scores, mask, last_index0):

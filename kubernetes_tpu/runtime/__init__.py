@@ -8,5 +8,6 @@ from kubernetes_tpu.runtime.queue import PriorityQueue, PodBackoff
 from kubernetes_tpu.runtime.cache import SchedulerCache
 from kubernetes_tpu.runtime.flightrecorder import RECORDER, FlightRecorder
 from kubernetes_tpu.runtime.health import DeviceHealth
+from kubernetes_tpu.runtime.quality import QualityObservatory
 from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
 from kubernetes_tpu.runtime.telemetry import SLOObjective, TelemetryHub

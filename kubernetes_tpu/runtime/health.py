@@ -397,28 +397,43 @@ class HealthServer:
                         ),
                         ct="application/json",
                     )
+                elif path == "/debug/quality":
+                    # the placement-quality observatory (runtime/
+                    # quality.py): winner margins, feasible counts,
+                    # FFD-counterfactual regret, drift detectors —
+                    # ?limit=N + the shared 4MB cap, like its siblings
+                    from kubernetes_tpu.runtime import quality
+
+                    self._send(
+                        debug_body(
+                            quality.get_default().debug_payload, query,
+                        ),
+                        ct="application/json",
+                    )
                 elif path == "/debug/profile":
                     # on-demand bounded jax.profiler capture
                     # (?seconds=N; throttled, graceful no-op where the
-                    # backend lacks profiler support)
-                    import json as _json
-
+                    # backend lacks profiler support).  Routed through
+                    # the shared debug_body like every /debug/* response
+                    # (the body is tiny; the cap is the uniform contract)
                     from kubernetes_tpu.runtime import perfobs
 
                     self._send(
-                        _json.dumps(
-                            perfobs.profile_request(query)
-                        ).encode(),
+                        debug_body(
+                            lambda _lim=None: perfobs.profile_request(
+                                query
+                            ),
+                            query,
+                        ),
                         ct="application/json",
                     )
                 elif path in ("/debug", "/debug/"):
-                    # the index: every debug endpoint, one line each
-                    import json as _json
-
+                    # the index: every debug endpoint, one line each —
+                    # debug_body-routed like its children
                     from kubernetes_tpu.runtime.ledger import debug_index
 
                     self._send(
-                        _json.dumps(debug_index()).encode(),
+                        debug_body(lambda _lim=None: debug_index(), query),
                         ct="application/json",
                     )
                 else:

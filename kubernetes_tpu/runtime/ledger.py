@@ -338,6 +338,19 @@ class DecisionLedger:
                 arrays[key] = np.asarray(arr)
         arrays["winners"] = np.asarray(outcome["winners"], np.int32)
         meta.pop("winners", None)
+        # optional quality top-k (ISSUE 13): the winner-pinned ranking +
+        # feasible counts ride the block so bench --replay can recompute
+        # margins offline without re-running a quality-enabled engine
+        for key, dtype in (
+            ("quality_top_nodes", np.int32),
+            ("quality_top_scores", np.float32),
+            ("quality_feasible", np.int32),
+        ):
+            arr = outcome.get(key)
+            present[key] = arr is not None
+            if arr is not None:
+                arrays[key] = np.asarray(arr, dtype)
+            meta.pop(key, None)
         meta["present"] = present
         meta["last_index0"] = int(inputs["last_index0"])
         arrays["__meta__"] = np.frombuffer(
@@ -481,6 +494,11 @@ DEBUG_ENDPOINTS = {
         "start a bounded on-demand jax.profiler capture "
         "(?seconds=N; throttled, no-op where unsupported)"
     ),
+    "/debug/quality": (
+        "placement-quality observatory: winner margins, feasible "
+        "counts, FFD-counterfactual regret, packing-drift detectors "
+        "(?limit=N)"
+    ),
 }
 
 
@@ -581,6 +599,14 @@ def read_ledger_stream(path: str) -> Tuple[dict, Iterator[dict]]:
                     else None
                 )
                 rec["winners"] = z["winners"]
+                rec["quality"] = (
+                    {
+                        "top_nodes": z["quality_top_nodes"],
+                        "top_scores": z["quality_top_scores"],
+                        "feasible": z["quality_feasible"],
+                    }
+                    if present.get("quality_top_nodes") else None
+                )
                 yield rec
         finally:
             f.close()
@@ -639,8 +665,35 @@ def replay(path: str, engine: Optional[str] = None,
     util_cpu: List[float] = []
     util_mem: List[float] = []
     frag: List[float] = []
+    # offline quality recompute (ISSUE 13): margins + feasible counts
+    # re-derived from the recorded top-k blocks — the same math the
+    # live observatory runs, so the replayed figures are directly
+    # comparable to the /debug/quality ones banked alongside
+    q_margins: List[float] = []
+    q_feasible: List[int] = []
+    q_cycles = 0
     for rec in records:
         cycles += 1
+        qual = rec.get("quality")
+        if qual is not None:
+            from kubernetes_tpu.runtime.quality import normalized_margin
+
+            q_cycles += 1
+            n = int(rec["n_pods"])
+            tn = np.asarray(qual["top_nodes"])[:n]
+            ts = np.asarray(qual["top_scores"])[:n]
+            q_feasible.extend(
+                int(f) for f in np.asarray(qual["feasible"])[:n]
+            )
+            if tn.shape[-1] >= 2:
+                two = (tn[:, 0] >= 0) & (tn[:, 1] >= 0)
+                if two.any():
+                    # THE shared margin formula (runtime/quality.py) —
+                    # offline figures stay bit-comparable to the live
+                    # /debug/quality ones by construction
+                    q_margins.extend(
+                        normalized_margin(ts[two, 0], ts[two, 1]).tolist()
+                    )
         if cluster_stats:
             from kubernetes_tpu.ops.analytics import cluster_analytics_np
 
@@ -685,5 +738,21 @@ def replay(path: str, engine: Optional[str] = None,
             "utilization_cpu_mean": _col(util_cpu),
             "utilization_memory_mean": _col(util_mem),
             "fragmentation": _col(frag),
+        }
+    if q_cycles:
+        out["quality"] = {
+            "cycles_with_topk": q_cycles,
+            "margin_p50": (
+                round(float(np.percentile(np.asarray(q_margins), 50)), 6)
+                if q_margins else 0.0
+            ),
+            "margin_mean": (
+                round(float(np.mean(q_margins)), 6) if q_margins else 0.0
+            ),
+            "margins": len(q_margins),
+            "feasible_p50": (
+                round(float(np.percentile(np.asarray(q_feasible), 50)), 1)
+                if q_feasible else 0.0
+            ),
         }
     return out

@@ -270,6 +270,27 @@ class SchedulerConfig:
     # single cycles, placements bit-identical either way (pinned by
     # tests/test_megacycle.py).
     megacycle_batches: int = 1
+    # --- placement-quality observatory (ISSUE 13: runtime/quality.py) ---
+    # in-launch top-k width: every engine launch ALSO returns, per pod,
+    # the K best feasible node rows (winner pinned at column 0), their
+    # scores, and the feasible-candidate count — fetched at the same
+    # commit fence as attribution (one extra D2H copy, no extra sync)
+    # and folded into margin/feasible/regret/drift records served at
+    # /debug/quality.  Always-on by design like telemetry/perfobs (the
+    # <2%-of-cycle budget is pinned by perf_smoke); 0 disables the seam
+    # entirely (the engines compile their classic executables).
+    # Placements are bit-identical whatever the value (pinned by
+    # tests/test_quality.py).
+    quality_top_k: int = 3
+    # regret-counterfactual cadence: every Nth committed cycle the
+    # cycle's pod requests are FFD-binpacked into the pre-cycle free
+    # capacity as a side launch (dispatched now, materialized next
+    # interval — the telemetry amortization), yielding the
+    # scheduler_placement_regret ratio
+    quality_interval_cycles: int = 32
+    # dual-window EWMA step-detector threshold for the packing-drift
+    # alerts (relative deviation of the fast window from the slow one)
+    quality_drift_threshold: float = 0.25
     # multi-scheduler: only pods whose spec.schedulerName names THIS
     # scheduler enter its queue (eventhandlers.go responsibleForPod)
     scheduler_name: str = "default-scheduler"
@@ -334,6 +355,13 @@ class SchedulerConfig:
             invariant_checks=getattr(cc, "invariant_checks", True),
             profile_dir=getattr(cc, "profile_dir", None),
             megacycle_batches=getattr(cc, "megacycle_batches", 1),
+            quality_top_k=getattr(cc, "quality_top_k", 3),
+            quality_interval_cycles=getattr(
+                cc, "quality_interval_cycles", 32
+            ),
+            quality_drift_threshold=getattr(
+                cc, "quality_drift_threshold", 0.25
+            ),
         )
 
 
@@ -413,6 +441,20 @@ class _InFlight:
     # its winners came from one shared launch whose device window is
     # attributed 1/K to each sub-batch (span, perfobs, telemetry)
     mega: Optional[Tuple[int, int]] = None
+    # --- placement-quality observatory (ISSUE 13) ---
+    quality_dev: object = None   # device TopKQuality pytree (quality
+    #                              launches only; None when off/degraded)
+    quality: object = None       # host-materialized TopKQuality (set at
+    #                              the commit fence, like attrib)
+    # the encoded batch's request matrix (host ref) — the regret
+    # counterfactual's pod-side input
+    quality_reqs: object = None
+    # the snapshot refs the regret counterfactual packs into — set ONLY
+    # when they are genuinely THIS cycle's pre-dispatch state: every
+    # single cycle, but only sub-batch 0 of a megacycle (windows k>0
+    # placed against chained state the shared snapshot predates; FFD
+    # against the emptier pre-megacycle capacity would overstate regret)
+    quality_snapshot: Optional[tuple] = None
 
 
 class _HostResult:
@@ -480,6 +522,9 @@ class _MegaFlight:
     fetch: object                # AsyncFetch of the stacked winners
     relaunch: Optional[Callable] = None
     t_cycle0: float = 0.0
+    # stacked device TopKQuality ([K, B, ...] leaves) when the quality
+    # seam is on; materialized at the fence and sliced per sub-batch
+    quality_dev: object = None
 
 
 class Scheduler:
@@ -572,6 +617,11 @@ class Scheduler:
             self.config.filter_config
         )
         self._unsched_key = enc.interner.intern(TAINT_NODE_UNSCHEDULABLE)
+        # placement-quality top-k width (ISSUE 13): a STATIC output-only
+        # engine flag — both engines (and the megacycle driver) return
+        # the winner-pinned top-k + feasible counts alongside the
+        # winners, placements bit-identical flag-on/off
+        self._quality_k = max(0, int(self.config.quality_top_k))
         engine_kw = dict(
             cfg=self.config.filter_config,
             weights=self.config.weights,
@@ -579,6 +629,7 @@ class Scheduler:
             zone_key_id=enc.getzone_key,
             score_cfg=prof.score_config if prof is not None else None,
             percentage_of_nodes_to_score=self.config.percentage_of_nodes_to_score,
+            quality_topk=self._quality_k,
         )
         # attribution rides the sequential engine: the scan owns the
         # per-step state (resources/ports/affinity as committed so far)
@@ -823,6 +874,23 @@ class Scheduler:
             profile_dir=self.config.profile_dir
         )
         perfobs_mod.set_default(self.perfobs)
+        # placement-quality observatory (ISSUE 13, runtime/quality.py):
+        # per-decision margin/feasible records off the engines' in-launch
+        # top-k, amortized FFD-counterfactual regret, dual-window
+        # packing-drift alerts through the postmortem seam — always-on
+        # like telemetry/perfobs (<2% budget pinned by perf_smoke),
+        # installed as the process default so /debug/quality serves it
+        self.quality = None
+        if self._quality_k > 0:
+            from kubernetes_tpu.runtime import quality as quality_mod
+
+            self.quality = quality_mod.QualityObservatory(
+                top_k=self._quality_k,
+                interval_cycles=self.config.quality_interval_cycles,
+                postmortem=self._postmortem,
+                drift_threshold=self.config.quality_drift_threshold,
+            )
+            quality_mod.set_default(self.quality)
         # shed watermark (per-cycle deltas feed the goodput SLO) +
         # heartbeat clock + liveness totals (heartbeat line + bench)
         self._shed_seen = 0
@@ -1321,9 +1389,11 @@ class Scheduler:
         handle for a host-computed result and mark the cycle degraded."""
         inf.fetch = inf.cpu_fetch()
         inf.degraded = True
-        # the CPU engine carries no attribution, and the device pytree
-        # may belong to the failed launch
+        # the CPU engine carries no attribution or quality seam, and the
+        # device pytrees may belong to the failed launch
         inf.attrib_dev = None
+        inf.quality_dev = None
+        inf.quality = None
         # overwrite the dispatch-time attrs: the placements this cycle
         # commits came from the CPU engine, whatever was launched first
         inf.trace.annotate(degraded=True, engine="cpu")
@@ -1380,7 +1450,8 @@ class Scheduler:
         while True:
             try:
                 if relaunch_pending:
-                    inf.hosts_dev, inf.fetch, inf.attrib_dev = inf.relaunch()
+                    (inf.hosts_dev, inf.fetch, inf.attrib_dev,
+                     inf.quality_dev) = inf.relaunch()
                     relaunch_pending = False
                 staged = self._commit_state(inf)
             except BaseException as e:
@@ -1568,17 +1639,30 @@ class Scheduler:
                 extra_mask, extra_score, aff_state,
             )
             hosts = out[0]
-            # attribution launches also return the Attribution pytree
-            # (reason counts + top-k breakdown); materialized at the
-            # commit fence, after the winners land
-            attrib = out[2] if len(out) > 2 else None
+            # optional extra outputs, in fixed order after new_cluster:
+            # Attribution (sequential attribution launches), then the
+            # quality TopKQuality — both materialized at the commit
+            # fence, after the winners land
+            idx = 2
+            attrib = None
+            if getattr(fn, "attribution", False):
+                attrib = out[idx]
+                idx += 1
+            qual = out[idx] if self._quality_k else None
+            if qual is not None:
+                # enqueue the tiny top-k D2H copies alongside the
+                # winners buffer so the fence materialize is a copy
+                # wait, never a compute sync
+                for leaf in qual:
+                    if hasattr(leaf, "copy_to_host_async"):
+                        leaf.copy_to_host_async()
             # async result path: only the compact winners buffer (i32[B]
             # node rows) crosses the wire — the D2H copy is enqueued NOW
             # and materializes on a worker thread, so the blocking fence in
             # _commit_state is usually a no-op by the time the pipelined
             # loop reaches it (batch k's fetch overlaps batch k's host tail
             # and batch k+1's dispatch)
-            return hosts, AsyncFetch(hosts), attrib
+            return hosts, AsyncFetch(hosts), attrib, qual
 
         def cpu_fetch():
             """Winners for THIS batch from the CPU reference engine, in the
@@ -1598,7 +1682,7 @@ class Scheduler:
             return _HostResult(hosts, seconds=time.monotonic() - t0)
 
         degraded = False
-        hosts_dev = attrib_dev = None
+        hosts_dev = attrib_dev = quality_dev = None
         disp_span = trace.child("dispatch")
         if use_device:
             launched = self._launch_resilient(launch)
@@ -1615,7 +1699,7 @@ class Scheduler:
             )
             fetch = cpu_fetch()
         else:
-            hosts_dev, fetch, attrib_dev = launched
+            hosts_dev, fetch, attrib_dev, quality_dev = launched
         self._last_index += len(pods)
         disp_span.finish()
         trace.annotate(
@@ -1635,6 +1719,14 @@ class Scheduler:
             relaunch=None if degraded else launch,
             cpu_fetch=cpu_fetch, degraded=degraded,
             last_index0=last_index0, tier=tier, attrib_dev=attrib_dev,
+            quality_dev=quality_dev,
+            quality_reqs=(
+                batch.req if self.quality is not None else None
+            ),
+            quality_snapshot=(
+                (cluster.allocatable, cluster.requested, cluster.valid)
+                if self.quality is not None else None
+            ),
             telemetry_host=(
                 (cluster.allocatable, cluster.requested, cluster.valid)
                 if self.telemetry is not None else None
@@ -1852,8 +1944,14 @@ class Scheduler:
             dev_cluster = self._dev_snapshot.update(
                 cluster, dirty_rows=dirty_rows
             )
-            hosts, _final = mega_fn(dev_cluster, batch_k, ports_k, li0_arr)
-            return hosts, AsyncFetch(hosts)
+            out = mega_fn(dev_cluster, batch_k, ports_k, li0_arr)
+            hosts = out[0]
+            qual = out[2] if self._quality_k else None
+            if qual is not None:
+                for leaf in qual:
+                    if hasattr(leaf, "copy_to_host_async"):
+                        leaf.copy_to_host_async()
+            return hosts, AsyncFetch(hosts), qual
 
         disp_span = spans[0].child("dispatch", windows=K)
         launched = self._launch_resilient(launch) if use_device else None
@@ -1861,9 +1959,9 @@ class Scheduler:
         t_disp_end = time.monotonic()
         self._phase("dispatch", t_disp_end - t_disp)
         degraded_dispatch = launched is None
-        hosts_dev = fetch = None
+        hosts_dev = fetch = quality_dev = None
         if not degraded_dispatch:
-            hosts_dev, fetch = launched
+            hosts_dev, fetch, quality_dev = launched
         else:
             m.DEGRADED_CYCLES.inc(K)
             self._postmortem(
@@ -1901,6 +1999,17 @@ class Scheduler:
                 relaunch=None, cpu_fetch=cpu_fetch,
                 degraded=degraded_dispatch, last_index0=li0[k],
                 tier=TIER_BULK,
+                quality_reqs=(
+                    batches[k].req if self.quality is not None else None
+                ),
+                # only window 0's placements saw exactly this snapshot;
+                # later windows placed against chained state, so their
+                # cycles skip the FFD counterfactual (margins/feasible
+                # still record — only the regret cadence passes them by)
+                quality_snapshot=(
+                    (cluster.allocatable, cluster.requested, cluster.valid)
+                    if (self.quality is not None and k == 0) else None
+                ),
                 telemetry_host=(
                     (cluster.allocatable, cluster.requested, cluster.valid)
                     if self.telemetry is not None else None
@@ -1929,7 +2038,7 @@ class Scheduler:
         return _MegaFlight(
             windows=infs, hosts_dev=hosts_dev, fetch=fetch,
             relaunch=None if degraded_dispatch else launch,
-            t_cycle0=t_cycle0,
+            t_cycle0=t_cycle0, quality_dev=quality_dev,
         )
 
     def _commit_state_mega(self, mf: _MegaFlight,
@@ -1951,6 +2060,7 @@ class Scheduler:
         attempt = 0
         relaunch_pending = False
         hosts_all = None
+        qual_all = None
         t_fence0 = time.monotonic()
         while mf.fetch is not None:
             try:
@@ -1959,11 +2069,19 @@ class Scheduler:
                     # loop's relaunch_pending discipline): a classified
                     # fault raised by the re-dispatch itself must feed
                     # the same retry/degrade policy, not escape it
-                    mf.hosts_dev, mf.fetch = mf.relaunch()
+                    mf.hosts_dev, mf.fetch, mf.quality_dev = mf.relaunch()
                     relaunch_pending = False
                 hosts_all = np.asarray(mf.fetch.result())
                 for k, inf in enumerate(mf.windows):
                     self._validate_hosts(hosts_all[k], len(inf.pods))
+                if mf.quality_dev is not None:
+                    # the stacked top-k rides the same fence discipline
+                    # as the winners: by now the launch has computed, so
+                    # this is the pre-enqueued copy landing; a fault here
+                    # retries/degrades the whole megacycle
+                    qual_all = type(mf.quality_dev)(
+                        *(np.asarray(x) for x in mf.quality_dev)
+                    )
                 break
             except BaseException as e:
                 fc = classify_device_error(e)
@@ -2015,6 +2133,12 @@ class Scheduler:
         f = mf.fetch
         for k, inf in enumerate(mf.windows):
             self._stage_mega_window(inf, None)
+            if qual_all is not None:
+                # slice sub-batch k's already-host quality rows; the
+                # fence's materialize in _commit_state is then a no-op
+                inf.quality = type(qual_all)(
+                    *(np.asarray(x)[k] for x in qual_all)
+                )
             inf.fetch = _HostResult(
                 hosts_all[k],
                 seconds=f.seconds / K,
@@ -2162,6 +2286,14 @@ class Scheduler:
             # retries/degrades exactly like a winners-fetch fault.
             inf.attrib = type(inf.attrib_dev)(
                 *(np.asarray(x) for x in inf.attrib_dev)
+            )
+        if inf.quality_dev is not None:
+            # the quality top-k rides the same launch and the same
+            # discipline: its async copies were enqueued at dispatch, so
+            # this is a copy wait behind the landed winners, never a new
+            # sync; a fault here retries/degrades like the winners fetch
+            inf.quality = type(inf.quality_dev)(
+                *(np.asarray(x) for x in inf.quality_dev)
             )
         t_state0 = time.monotonic()
         # "fetch" records the ASYNC window (dispatch -> copy-complete,
@@ -2334,6 +2466,35 @@ class Scheduler:
             )
         finally:
             m.PERFOBS_SECONDS.inc(time.perf_counter() - t_perf)
+        # placement-quality observatory (ISSUE 13): margins off the
+        # in-launch top-k, feasible counts, drift detectors, and the
+        # amortized regret counterfactual.  Same discipline as the
+        # telemetry/perfobs hooks — never fails a committed cycle, cost
+        # stamped into its own counter (the <2% budget perf_smoke pins).
+        if self.quality is not None:
+            t_q = time.perf_counter()
+            try:
+                self.quality.on_cycle(
+                    cycle=inf.cycle,
+                    tier=inf.tier,
+                    degraded=inf.degraded,
+                    hosts=staged.hosts,
+                    n_pods=len(inf.pods),
+                    quality=inf.quality,
+                    reqs=inf.quality_reqs,
+                    snapshot=inf.quality_snapshot,
+                    attrib=inf.attrib,
+                    analytics=(
+                        self.telemetry.analytics
+                        if self.telemetry is not None else None
+                    ),
+                )
+            except Exception as e:  # noqa: BLE001
+                klog.errorf(
+                    "quality hook failed (cycle %d): %s", inf.cycle, e
+                )
+            finally:
+                m.QUALITY_SECONDS.inc(time.perf_counter() - t_q)
         m.PENDING_PODS.set(float(len(self.queue)))
         self.results.extend(results)
         # slow-cycle log LAST, once the ENTIRE tail (ledger record +
@@ -2448,6 +2609,22 @@ class Scheduler:
             # of K replayable blocks (each against the host snapshot its
             # predecessors' commits produced)
             **({"mega": list(inf.mega)} if inf.mega is not None else {}),
+            # quality top-k (ISSUE 13): the winner-pinned ranking rides
+            # the block so bench --replay recomputes margins offline
+            **(
+                {
+                    "quality_top_nodes": np.asarray(
+                        inf.quality.top_nodes[: len(pods)], np.int32
+                    ),
+                    "quality_top_scores": np.asarray(
+                        inf.quality.top_scores[: len(pods)], np.float32
+                    ),
+                    "quality_feasible": np.asarray(
+                        inf.quality.feasible[: len(pods)], np.int32
+                    ),
+                }
+                if inf.quality is not None else {}
+            ),
         }
         self.ledger.record_cycle(inf.ledger_inputs, outcome, decisions)
 
@@ -3251,11 +3428,18 @@ class Scheduler:
         # most bytes — the three numbers that say WHERE the interval's
         # wall time went without opening /debug/perf
         host_ms, dev_ms, xfer_top = self.perfobs.heartbeat_window()
+        # placement-quality satellites (ISSUE 13): sliding margin p50 +
+        # the last sampled regret ratio — decision confidence and
+        # packing density on the same liveness line
+        q_margin, q_regret = (
+            self.quality.heartbeat_fields()
+            if self.quality is not None else (0.0, 0.0)
+        )
         klog.infof(
             "heartbeat: cycles=%d placed=%d unschedulable=%d depth=%d "
             "active=%d express=%d breaker=%s batch=%d hbm_bytes=%d "
             "mesh=%d rung=%s shards_lost=%d invariant_violations=%d "
-            "host_ms=%d dev_ms=%d xfer_top=%s",
+            "host_ms=%d dev_ms=%d xfer_top=%s margin=%.4f regret=%.2f",
             q.scheduling_cycle,
             self._outcome_totals["placed"],
             self._outcome_totals["unschedulable"],
@@ -3269,6 +3453,7 @@ class Scheduler:
                 if self.invariants is not None else 0
             ),
             int(host_ms), int(dev_ms), xfer_top,
+            q_margin, q_regret,
         )
 
     def prewarm(self, widths: Optional[Sequence[int]] = None,
@@ -3404,10 +3589,12 @@ class Scheduler:
                     li0 = np.arange(K, dtype=np.int32) * w + np.int32(
                         self._last_index
                     )
-                    hosts, _final = self._mega_fn(
+                    # index instead of unpack: the quality variant
+                    # returns a third output this warm launch discards
+                    hosts = self._mega_fn(
                         dev_cluster, stack_windows(batches),
                         stack_windows(ports_l), li0,
-                    )
+                    )[0]
                     jax.block_until_ready(hosts)
                     timings[f"mega{K}x{w}"] = time.monotonic() - t0
                     klog.V(1).infof(

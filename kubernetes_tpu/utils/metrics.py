@@ -88,6 +88,27 @@ class Histogram:
                 self.sum += v
             self.total += len(values)
 
+    def observe_np(self, values, **_labels) -> None:
+        """Vectorized observe_batch for a numpy array: one searchsorted
+        + bincount instead of a per-value bisect loop — the hot-path
+        form for per-pod batch observations (placement margins,
+        feasible counts) where a 2048-wide cycle would otherwise pay
+        2048 locked bisects."""
+        import numpy as _np
+
+        values = _np.asarray(values)
+        if values.size == 0:
+            return
+        idx = _np.searchsorted(self.buckets, values, side="left")
+        binned = _np.bincount(idx, minlength=len(self.counts))
+        total = _np.sum(values, dtype=_np.float64)
+        with self._lock:
+            for i, c in enumerate(binned):
+                if c:
+                    self.counts[i] += int(c)
+            self.sum += float(total)
+            self.total += int(values.size)
+
     def quantile(self, q: float, **_labels) -> float:
         """Approximate quantile with LINEAR INTERPOLATION inside the
         bucket (the prometheus histogram_quantile estimator): the target
@@ -292,6 +313,9 @@ class LabeledHistogram:
 
     def observe_batch(self, values, **labels) -> None:
         self.labels(**labels).observe_batch(values)
+
+    def observe_np(self, values, **labels) -> None:
+        self.labels(**labels).observe_np(values)
 
     def quantile(self, q: float, **labels) -> float:
         return self.labels(**labels).quantile(q)
@@ -794,6 +818,77 @@ PERFOBS_SECONDS = REGISTRY.register(
         "Cumulative scheduling-thread seconds spent in the performance-"
         "observatory hook (cycle split + transfer delta + EWMA fold; "
         "the <2%-of-cycle-wall budget perf_smoke pins)",
+    )
+)
+
+# placement-quality observatory (ISSUE 13: runtime/quality.py + the
+# engines' quality_topk seam).  The observability stack measured speed
+# (perfobs) and state (telemetry); these families measure DECISION
+# QUALITY — how confident each placement was (winner margin over the
+# runner-up), how constrained (feasible candidates), how dense vs a
+# greedy FFD counterfactual (regret), and whether packing quality is
+# drifting.  This is the per-decision reward signal ROADMAP item 4's
+# learned-scoring loop consumes.
+PLACEMENT_MARGIN = REGISTRY.register(
+    LabeledHistogram(
+        "scheduler_placement_margin",
+        "Normalized winner margin per placed pod — (top-1 score minus "
+        "runner-up score) / max(1, |top-1|), by latency tier; observed "
+        "only for pods with >= 2 feasible candidates (a margin over "
+        "nothing is not confidence)",
+        ("tier",), default_labels={"tier": TIER_BULK},
+        # margins live in [0, ~2]: sub-permille ties up to a clear win
+        buckets=[0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25,
+                 0.5, 1.0, 2.0],
+        max_children=8,  # the two tiers; the guard catches label leaks
+    )
+)
+PLACEMENT_REGRET = REGISTRY.register(
+    Gauge(
+        "scheduler_placement_regret",
+        "Packing regret vs the greedy FFD counterfactual, from the last "
+        "amortized sample: distinct nodes the live placements touched / "
+        "nodes first-fit-decreasing needed for the same pods against "
+        "the same pre-cycle free capacity (1.0 = as dense as FFD; > 1 "
+        "is the price of spreading/affinity priorities, the yardstick "
+        "the constraint-based-packing paper frames)",
+    )
+)
+FEASIBLE_NODES = REGISTRY.register(
+    Histogram(
+        "scheduler_feasible_nodes",
+        "Feasible candidate nodes the selector actually considered per "
+        "pod (post-predicate, post-adaptive-sampling mask population)",
+        buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096,
+                 16384, 65536],
+    )
+)
+QUALITY_DRIFT_ALERTS = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_quality_drift_alerts_total",
+        "Packing-quality drift alerts from the dual-window EWMA step "
+        "detector, by series (margin | utilization_cpu | fragmentation);"
+        " each fires a throttled quality_drift flight-recorder "
+        "postmortem through the scheduler's SLO seam",
+        ("series",),
+        max_children=16,  # the detector series set is fixed and small
+    )
+)
+QUALITY_REGRET_SAMPLES = REGISTRY.register(
+    Counter(
+        "scheduler_quality_regret_samples_total",
+        "FFD-counterfactual regret samples materialized (dispatched "
+        "every qualityIntervalCycles, fetched one interval later so the "
+        "scheduling thread never blocks on the binpack launch)",
+    )
+)
+QUALITY_SECONDS = REGISTRY.register(
+    Counter(
+        "scheduler_quality_seconds_total",
+        "Cumulative scheduling-thread seconds spent in the placement-"
+        "quality hook (top-k materialize + margin/drift fold + the "
+        "amortized regret dispatch; the <2%-of-cycle-wall budget "
+        "perf_smoke pins)",
     )
 )
 

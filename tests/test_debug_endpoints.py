@@ -1,0 +1,148 @@
+"""/debug/* surface audit (ISSUE 13 satellite).
+
+Every debug endpoint must be listed in DEBUG_ENDPOINTS (served at
+GET /debug/), and every listed endpoint must answer with valid JSON on
+BOTH servers (health server + apiserver), routed through the shared
+`debug_body` 4MB-cap/limit helper.  The walk fetches each endpoint from
+the index itself, so adding an endpoint without registering it — or
+registering one without a handler — fails here.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.runtime.health import start_health_server
+from kubernetes_tpu.runtime.ledger import DEBUG_ENDPOINTS
+
+
+class _FakeProfiler:
+    """Stands in for jax.profiler during the walk: the real capture
+    spins up a profiler server (slow, and not the routing under test
+    here — the capture state machine has its own tests in
+    test_perfobs.py)."""
+
+    def start_trace(self, d):
+        pass
+
+    def stop_trace(self):
+        pass
+
+
+@pytest.fixture
+def _no_real_profiler(monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax, "profiler", _FakeProfiler())
+
+
+def _walk(base_url: str):
+    """Fetch the index, then every listed endpoint; return
+    {endpoint: parsed json body}."""
+    with urllib.request.urlopen(f"{base_url}/debug/", timeout=10) as r:
+        assert "application/json" in r.headers.get("Content-Type", "")
+        idx = json.loads(r.read())
+    endpoints = idx["endpoints"]
+    # the index IS the registry: it must match DEBUG_ENDPOINTS exactly,
+    # with a non-empty one-line description per endpoint
+    assert set(endpoints) == set(DEBUG_ENDPOINTS)
+    for desc in endpoints.values():
+        assert isinstance(desc, str) and desc
+    bodies = {}
+    for ep in sorted(endpoints):
+        # ?limit= exercises the shared debug_body limit plumbing;
+        # /debug/profile takes ?seconds= instead (kept tiny)
+        query = "?seconds=0.05" if ep == "/debug/profile" else "?limit=1"
+        with urllib.request.urlopen(
+            f"{base_url}{ep}{query}", timeout=10
+        ) as r:
+            assert r.status == 200, ep
+            assert "application/json" in r.headers.get("Content-Type", "")
+            bodies[ep] = json.loads(r.read())
+    return bodies
+
+
+def _check_shapes(bodies: dict):
+    assert "traceEvents" in bodies["/debug/traces"]
+    assert "decisions" in bodies["/debug/decisions"]
+    assert {"summary", "samples"} <= set(bodies["/debug/cluster"])
+    assert {"summary", "ewma_s", "profiler"} <= set(bodies["/debug/perf"])
+    assert {"summary", "samples"} <= set(bodies["/debug/quality"])
+    q = bodies["/debug/quality"]["summary"]
+    assert {"margin", "feasible", "regret", "drift"} <= set(q)
+    # the profile body reports an outcome either way (started, throttled,
+    # in-progress, or unsupported) — never raises into a 500
+    assert isinstance(bodies["/debug/profile"], dict)
+
+
+def test_debug_index_walk_on_health_server(_no_real_profiler):
+    srv = start_health_server()
+    try:
+        h, p = srv.address
+        _check_shapes(_walk(f"http://{h}:{p}"))
+    finally:
+        srv.stop()
+
+
+def test_debug_index_walk_on_apiserver(_no_real_profiler):
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.apiserver.fairness import FlowControlConfig
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+
+    # a starved inflight limiter: the debug surface is exempt and must
+    # still answer (diagnosing an overload needs it reachable)
+    srv = APIServer(
+        cluster=LocalCluster(),
+        flow_control=FlowControlConfig(
+            max_inflight_readonly=1, max_inflight_mutating=1,
+            queue_length_per_flow=0, queue_wait_timeout_s=0.01,
+        ),
+    ).start()
+    try:
+        _check_shapes(_walk(srv.url))
+    finally:
+        srv.stop()
+
+
+def test_debug_quality_limit_and_cap(_no_real_profiler):
+    """/debug/quality honors ?limit= and the shared 4MB response cap
+    (the debug_body contract every sibling already pins)."""
+    import numpy as np
+
+    from kubernetes_tpu.ops.select import TopKQuality
+    from kubernetes_tpu.runtime import quality as quality_mod
+    from kubernetes_tpu.runtime.ledger import debug_body
+
+    obs = quality_mod.QualityObservatory(top_k=2, ring_capacity=300)
+    q = TopKQuality(
+        top_nodes=np.asarray([[0, 1]], np.int32),
+        top_scores=np.asarray([[5.0, 4.0]], np.float32),
+        feasible=np.asarray([2], np.int32),
+    )
+    for c in range(300):
+        obs.on_cycle(cycle=c, tier="bulk", degraded=False,
+                     hosts=np.asarray([0], np.int32), n_pods=1, quality=q)
+    full = json.loads(debug_body(obs.debug_payload, ""))
+    assert len(full["samples"]) == 300
+    limited = json.loads(debug_body(obs.debug_payload, "limit=5"))
+    assert len(limited["samples"]) == 5
+    capped = json.loads(debug_body(obs.debug_payload, "", cap=8192))
+    assert 0 < len(capped["samples"]) < 300
+
+    old = quality_mod.get_default()
+    quality_mod.set_default(obs)
+    try:
+        srv = start_health_server()
+        try:
+            h, p = srv.address
+            with urllib.request.urlopen(
+                f"http://{h}:{p}/debug/quality?limit=3", timeout=10
+            ) as r:
+                body = json.loads(r.read())
+            assert len(body["samples"]) == 3
+            assert body["summary"]["decisions"] == 300
+        finally:
+            srv.stop()
+    finally:
+        quality_mod.set_default(old)

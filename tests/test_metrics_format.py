@@ -167,7 +167,11 @@ def test_metrics_exposition_valid_after_mixed_live_workload():
             attribution=True, decision_ledger=True,
         ),
     )
+    # TWO nodes: the placed pod then has a runner-up, so the quality
+    # margin family (a labeled histogram — empty families fail the
+    # strict checker by design) records a sample on the device cycle
     cache.add_node(make_node("m1", cpu="4", mem="8Gi"))
+    cache.add_node(make_node("m2", cpu="8", mem="16Gi"))
     # success + unschedulable in one cycle
     queue.add(make_pod("fits", cpu="100m"))
     queue.add(make_pod("never", cpu="64"))
@@ -252,6 +256,47 @@ def test_metrics_exposition_valid_after_mixed_live_workload():
     assert ewma_phases == set(PHASES), ewma_phases
     assert families["scheduler_perfobs_seconds_total"]["type"] == "counter"
     assert families["scheduler_perfobs_seconds_total"]["samples"][0][2] > 0
+    # ISSUE 13 satellites: the quality families survive the strict
+    # parser WITH live values — the device cycle placed a pod with a
+    # runner-up (margin sample in the bulk tier child), counted its
+    # feasible candidates, and stamped the hook's own cost counter;
+    # the drift/regret families expose as their declared types
+    margin = families["scheduler_placement_margin"]
+    assert margin["type"] == "histogram"
+    m_counts = {
+        lbl.get("tier"): v for n, lbl, v in margin["samples"]
+        if n.endswith("_count")
+    }
+    assert m_counts.get("bulk", 0) > 0, m_counts
+    feas = families["scheduler_feasible_nodes"]
+    assert feas["type"] == "histogram"
+    feas_count = [v for n, _, v in feas["samples"] if n.endswith("_count")]
+    assert feas_count and feas_count[0] > 0
+    assert families["scheduler_placement_regret"]["type"] == "gauge"
+    assert (
+        families["scheduler_quality_drift_alerts_total"]["type"]
+        == "counter"
+    )
+    assert families["scheduler_quality_seconds_total"]["samples"][0][2] > 0
+
+
+def test_quality_family_cardinality_bounded():
+    """ISSUE 13 satellite: every labeled quality family declares a
+    bounded max_children (the guard that keeps a tier/k/series label
+    from leaking series without bound), well under the default."""
+    from kubernetes_tpu.utils.metrics import (
+        PLACEMENT_MARGIN,
+        QUALITY_DRIFT_ALERTS,
+    )
+
+    assert PLACEMENT_MARGIN.max_children <= 8
+    assert QUALITY_DRIFT_ALERTS.max_children <= 16
+    # the label sets in live use stay far inside the bound
+    assert PLACEMENT_MARGIN.child_count() <= PLACEMENT_MARGIN.max_children
+    assert (
+        QUALITY_DRIFT_ALERTS.child_count()
+        <= QUALITY_DRIFT_ALERTS.max_children
+    )
 
 
 def test_labeled_families_remove_and_restart():
