@@ -540,6 +540,20 @@ def run(args) -> dict:
             )
         except Exception as e:  # noqa: BLE001
             detail["megacycle_error"] = f"{type(e).__name__}: {e}"
+        # ---- replica stage (ISSUE 14): a scaled-down N sweep (N = 1, 2)
+        # of the queue-sharded replica set + the multi-tenant storm —
+        # scaling factor, conflict rate, zero-lost-pods.  CPU child only
+        # like the tier stage (a control-plane figure; --replicas is the
+        # standalone full-scale sweep)
+        try:
+            rep_args = argparse.Namespace(**vars(args))
+            rep_args.nodes = min(args.nodes, 500)
+            rep_args.pods = min(args.pods, 2048)
+            rep_args.batch = min(args.batch, 128)
+            rep_args.replicas = 2
+            detail["replicas"] = run_replicas(rep_args, ns=[1, 2])
+        except Exception as e:  # noqa: BLE001
+            detail["replicas_error"] = f"{type(e).__name__}: {e}"
         # ---- sharded stage (ISSUE 9): the multi-chip live path at the
         # run's scale — per-cycle placement identity vs single-chip plus
         # the sharded encode-fits figures, via a subprocess (the virtual
@@ -602,6 +616,23 @@ def run(args) -> dict:
         out["placement_margin_p50"] = detail["quality"]["margin_p50"]
         out["regret_ratio"] = detail["quality"]["regret_ratio"]
         out["quality_overhead_ratio"] = detail["quality"]["overhead_ratio"]
+    if "replicas" in detail:
+        # the horizontal scale-out acceptance trio, tracked at top
+        # level: throughput scaling vs one replica, the optimistic
+        # conflict rate at max N (requeues per placement), and the
+        # conservation flag (no popped pod lost across the sweep +
+        # storm)
+        out["replica_scaling_x"] = detail["replicas"]["scaling_x"]
+        out["replica_conflict_rate"] = detail["replicas"][
+            "conflict_rate_at_max_n"
+        ]
+        storm = detail["replicas"].get("storm") or {}
+        out["replica_storm_clean"] = bool(
+            detail["replicas"]["zero_lost_pods"]
+            and storm.get("no_tenant_starved")
+            and storm.get("lost") == 0
+            and storm.get("invariant_violations") == 0
+        )
     if "sharded" in detail:
         # the multi-chip acceptance, tracked at top level: sharded
         # placements bit-identical to single-chip on this very run
@@ -1289,6 +1320,185 @@ def run_megacycle_metric(args) -> dict:
     }
 
 
+def run_replicas(args, ns=None) -> dict:
+    """Replica scaling curve (ISSUE 14): the same live workload drained
+    with N = 1, 2, 4, ... queue-sharded scheduler replicas sharing one
+    cache/queue/resident snapshot and committing through the sequenced
+    optimistic conflict reconciler — pods/s + conflict rate vs N at a
+    fixed cluster size, plus a multi-tenant storm (one flooding tenant
+    against three paced ones) asserting nothing starves and no pod is
+    lost.  Every N gets a fresh cluster and the SAME pod set, warmed
+    outside the timed window; engines compile once per sweep (replicas
+    share replica 0's executables)."""
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+    from kubernetes_tpu.runtime.queue import PriorityQueue
+    from kubernetes_tpu.runtime.replicas import SchedulerReplicaSet
+    from kubernetes_tpu.runtime.scheduler import SchedulerConfig
+
+    if ns is None:
+        ns = []
+        n = 1
+        while n <= max(1, args.replicas):
+            ns.append(n)
+            n *= 2
+
+    def _make(n_replicas: int) -> SchedulerReplicaSet:
+        enc = _build_encoder(args)
+        return SchedulerReplicaSet(
+            replicas=n_replicas,
+            cache=SchedulerCache(enc),
+            queue=PriorityQueue(shards=n_replicas),
+            binder=lambda pod, node: True,
+            config=SchedulerConfig(
+                batch_size=args.batch, batch_window_s=0.0,
+                engine=args.engine, disable_preemption=True,
+                batched_commit=True,
+                # replicas overlap ACROSS loops; the in-loop double
+                # buffer would hold the cache lock pattern hostage to
+                # per-replica pipeline state — keep each loop simple
+                pipeline_commit=False,
+            ),
+        )
+
+    curve = []
+    # the SAME warm count for every N (capacity consumed pre-window must
+    # not vary with N, or the per-N workloads aren't comparable)
+    warm_n = args.batch * max(ns)
+    for N in ns:
+        rs = _make(N)
+        # warmup: full-width batches through the replicas (compile +
+        # row caches + hub upload) plus the reconciler's admission
+        # kernel ladder, outside the timed window
+        rs.reconciler.prewarm(args.batch, rs.cache.encoder.dims.R)
+        for j in range(warm_n):
+            rs.queue.add(_pending_pod(args, args.pods + j))
+        rs.run_until_drained(budget_s=600)
+        rs.stop()
+        conflicts0 = rs.reconciler.conflicts_total
+        pending = [_pending_pod(args, i) for i in range(args.pods)]
+        t0 = time.monotonic()
+        for p in pending:
+            rs.queue.add(p)
+        placed = rs.run_until_drained(budget_s=900)
+        dt = time.monotonic() - t0
+        rs.stop()
+        conflicts = rs.reconciler.conflicts_total - conflicts0
+        drained = rs.assert_drained()
+        curve.append({
+            "replicas": N,
+            "pods_per_s": round(placed / dt, 1) if dt > 0 else 0.0,
+            "seconds": round(dt, 3),
+            "placed": placed,
+            "conflicts": conflicts,
+            "conflict_rate": round(conflicts / placed, 4) if placed else 0.0,
+            "requeued": rs.reconciler.conflicts_total
+            + rs.reconciler.quota_vetoes_total,
+            "fast_path": rs.reconciler.fast_path_total,
+            "scans": rs.reconciler.scans_total,
+            "invariant_violations": rs.invariant_violations_total(),
+            "drained_clean": drained,
+        })
+        sys.stderr.write(
+            f"bench: replicas n={N}: {curve[-1]['pods_per_s']} pods/s, "
+            f"{conflicts} conflicts "
+            f"({curve[-1]['conflict_rate']:.4f}/pod), "
+            f"violations={curve[-1]['invariant_violations']}\n"
+        )
+    base = curve[0]["pods_per_s"]
+    best = max(curve, key=lambda c: c["pods_per_s"])
+    # ---- multi-tenant storm: one flooding tenant offers as much as the
+    # three paced tenants combined, against a capacity-bounded queue at
+    # max N — DRF-tiebroken admission + hash shards must leave every
+    # tenant with placements, conserve every offered pod, and keep the
+    # invariant checker clean
+    storm = None
+    try:
+        n_max = max(ns)
+        rs = _make(n_max)
+        storm_pods = min(args.pods, 2048)
+        offered = []
+        for i in range(storm_pods):
+            # 1 flooding tenant (every other pod) + 3 paced tenants
+            tenant = "flood" if i % 2 == 0 else f"tenant{i % 3}"
+            p = _pending_pod(args, i)
+            p.metadata.namespace = tenant
+            offered.append(p)
+        for j in range(warm_n):  # warm outside the window
+            rs.queue.add(_pending_pod(args, storm_pods + j))
+        rs.run_until_drained(budget_s=600)
+        t0 = time.monotonic()
+        for p in offered:
+            rs.queue.add(p)
+        rs.run_until_drained(budget_s=900)
+        rs.stop()
+        per_tenant: dict = {}
+        placed_keys = set()
+        for s in rs.schedulers:
+            for r in s.results:
+                if r.node is not None:
+                    placed_keys.add((r.pod.namespace, r.pod.name))
+                    per_tenant[r.pod.namespace] = (
+                        per_tenant.get(r.pod.namespace, 0) + 1
+                    )
+        storm_placed = sum(
+            1 for p in offered
+            if (p.metadata.namespace, p.metadata.name) in placed_keys
+        )
+        left = len(rs.queue)
+        shed = rs.queue.shed_total
+        tenants = {"flood"} | {f"tenant{t}" for t in range(3)}
+        storm = {
+            "seconds": round(time.monotonic() - t0, 3),
+            "offered": len(offered),
+            "placed": storm_placed,
+            "shed": shed,
+            "left_in_queue": left,
+            "lost": max(0, len(offered) - storm_placed - shed - left),
+            "per_tenant": {
+                t: per_tenant.get(t, 0) for t in sorted(tenants)
+            },
+            "no_tenant_starved": all(
+                per_tenant.get(t, 0) > 0 for t in tenants
+            ),
+            "invariant_violations": rs.invariant_violations_total(),
+            "drained_clean": rs.assert_drained(),
+        }
+    except Exception as e:  # noqa: BLE001 — the curve still banks
+        storm = {"error": f"{type(e).__name__}: {e}"}
+    return {
+        "curve": curve,
+        # a dead N=1 stage (base 0) must read as scaling 0.0 — the
+        # loud gate failure — never divide-by-fallback into a pass
+        "scaling_x": (
+            round(best["pods_per_s"] / base, 3) if base > 0 else 0.0
+        ),
+        "best_replicas": best["replicas"],
+        "best_pods_per_s": best["pods_per_s"],
+        "conflict_rate_at_max_n": curve[-1]["conflict_rate"],
+        "zero_lost_pods": all(c["drained_clean"] for c in curve),
+        "engine": args.engine,
+        "storm": storm,
+    }
+
+
+def run_replicas_metric(args) -> dict:
+    """--replicas standalone mode: the N sweep as the run's one JSON
+    line (value = best pods/s across the sweep; scaling_x + the storm
+    verdicts ride detail)."""
+    out = run_replicas(args)
+    storm = out.get("storm") or {}
+    return {
+        "metric": "replica_scaling",
+        "value": out["best_pods_per_s"],
+        "unit": "pods/s",
+        "replica_scaling_x": out["scaling_x"],
+        "replica_conflict_rate": out["conflict_rate_at_max_n"],
+        "storm_no_starvation": storm.get("no_tenant_starved"),
+        "storm_lost_pods": storm.get("lost"),
+        "detail": out,
+    }
+
+
 def _ns_with_nodes(args, n_nodes) -> argparse.Namespace:
     a = argparse.Namespace(**vars(args))
     a.nodes = n_nodes
@@ -1819,6 +2029,8 @@ def run_child(args) -> None:
                 result = run_tiered_metric(args)
             elif args.megacycle:
                 result = run_megacycle_metric(args)
+            elif args.replicas:
+                result = run_replicas_metric(args)
             elif args.sharded:
                 result = run_sharded_metric(args)
             else:
@@ -1928,6 +2140,8 @@ def _child_cmd(args, platform: str | None) -> list:
     if args.megacycle:
         cmd += ["--megacycle"]
     cmd += ["--megacycle-max", str(args.megacycle_max)]
+    if args.replicas:
+        cmd += ["--replicas", str(args.replicas)]
     if args.sharded:
         cmd += ["--sharded",
                 "--sharded-nodes", str(args.sharded_nodes),
@@ -2113,6 +2327,17 @@ _BASELINE_CHECKS = (
      "band", 1.0),
     ("quality_overhead_ratio",
      ("quality_overhead_ratio", "detail.quality.overhead_ratio"),
+     "lower", 1.5),
+    # queue-sharded replicas (ISSUE 14): throughput scaling vs one
+    # replica must not collapse (a re-serialized commit path, a lock
+    # held across the device window), and the optimistic conflict rate
+    # must not explode (a broken generation fence scanning — and
+    # losing — every cycle)
+    ("replica_scaling_x",
+     ("replica_scaling_x", "detail.replicas.scaling_x"),
+     "higher", 1.0),
+    ("replica_conflict_rate",
+     ("replica_conflict_rate", "detail.replicas.conflict_rate_at_max_n"),
      "lower", 1.5),
 )
 
@@ -2407,6 +2632,13 @@ def main():
                     help="deepest K the --megacycle sweep (and the "
                     "default report's scaled-down megacycle stage, "
                     "capped at 4 there) reaches")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="replica mode (ISSUE 14): sweep N = 1, 2, ... "
+                    "queue-sharded scheduler replicas through the live "
+                    "path — pods/s + optimistic conflict rate per N, "
+                    "plus a multi-tenant storm asserting no tenant "
+                    "starves and no popped pod is lost; 0 = off (the "
+                    "default report still runs a scaled-down N=2 stage)")
     ap.add_argument("--sharded", action="store_true",
                     help="multi-chip live-path scenario (ISSUE 9): the "
                     "same pod stream through the real Scheduler single-"

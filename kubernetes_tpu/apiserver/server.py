@@ -978,6 +978,23 @@ class APIServer:
                         ct="application/json",
                     )
                     return
+                if self.path.partition("?")[0] == "/debug/replicas":
+                    # queue-sharded replicas (ISSUE 14): the explicit
+                    # process aggregate — per-replica cycle/conflict
+                    # facts, reconciler sequencing stats, tenant
+                    # usage/quota table.  Inflight-exempt like its
+                    # siblings
+                    from kubernetes_tpu.runtime import reconciler
+                    from kubernetes_tpu.runtime.ledger import debug_body
+
+                    self._send_text(
+                        debug_body(
+                            reconciler.debug_payload,
+                            self.path.partition("?")[2],
+                        ),
+                        ct="application/json",
+                    )
+                    return
                 if self.path.partition("?")[0] == "/debug/profile":
                     # on-demand bounded jax.profiler capture
                     # (?seconds=N; throttled, graceful no-op where the
@@ -2125,7 +2142,8 @@ class APIServer:
             exempt = ("/healthz", "/livez", "/readyz", "/metrics",
                       "/version", "/debug/traces", "/debug/decisions",
                       "/debug/cluster", "/debug/perf", "/debug/profile",
-                      "/debug/quality", "/debug", "/debug/")
+                      "/debug/quality", "/debug/replicas",
+                      "/debug", "/debug/")
             for method in ("do_GET", "do_POST", "do_PUT", "do_PATCH",
                            "do_DELETE"):
                 inner = getattr(Handler, method)

@@ -165,6 +165,17 @@ def build_parser() -> argparse.ArgumentParser:
                    "dispatch).  Chain-safe batches only — anything "
                    "carrying pod-affinity/ports/volumes/gangs rides the "
                    "single-cycle path, placements identical either way")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="run N queue-sharded scheduler replicas "
+                   "(threads) over one cache/queue, committing through "
+                   "the sequenced optimistic conflict reconciler "
+                   "(config replicas; default 1 = the classic single "
+                   "loop).  Not combinable with --shard-devices (one "
+                   "scale-out axis per process)")
+    p.add_argument("--namespace-quotas", default=None,
+                   help="JSON {namespace: {resource: quantity}} "
+                   "placement quotas enforced by the reconciler at "
+                   "commit (config namespaceQuotas)")
     p.add_argument("--simulate-nodes", type=int, default=0,
                    help="register N hollow nodes")
     p.add_argument("--simulate-pods", type=int, default=0,
@@ -240,6 +251,10 @@ def main(argv=None) -> int:
         cc.profile_dir = args.profile_dir
     if args.megacycle_batches is not None:
         cc.megacycle_batches = args.megacycle_batches
+    if args.replicas is not None:
+        cc.replicas = args.replicas
+    if args.namespace_quotas is not None:
+        cc.namespace_quotas = json.loads(args.namespace_quotas)
 
     # persistent compile cache BEFORE any jit compile (engine build,
     # prewarm, first cycle) so every executable of this process is served
@@ -308,6 +323,22 @@ def main(argv=None) -> int:
         cluster = LocalCluster()
         sched = build_wired_scheduler(cluster, cc)
 
+    # queue-sharded replicas (ISSUE 14): wrap the wired scheduler as
+    # replica 0 of an N-way set — siblings share its cache/queue/
+    # binder/engines and commit through the sequenced reconciler
+    replica_set = None
+    if cc.replicas > 1:
+        from kubernetes_tpu.runtime.replicas import SchedulerReplicaSet
+
+        if args.leader_elect:
+            print("error: --leader-elect drives one scheduler loop; "
+                  "combine it with --replicas is not supported",
+                  file=sys.stderr)
+            return 2
+        replica_set = SchedulerReplicaSet.from_primary(sched, cc.replicas)
+        print(f"running {cc.replicas} queue-sharded scheduler replicas "
+              "(optimistic conflict reconciler)", file=sys.stderr)
+
     health = None
     addr = args.healthz_bind_address or cc.healthz_bind_address
     if addr and addr != "0":
@@ -328,7 +359,14 @@ def main(argv=None) -> int:
         # shape), before serving: with a warm compile cache this is
         # seconds of disk reads instead of minutes of XLA
         t_warm = time.monotonic()
-        warmed = sched.prewarm()
+        # replica mode warms through the set: the primary's engine
+        # ladder PLUS the reconciler's admission kernels (a
+        # first-conflict compile inside the commit critical section
+        # would stall every sibling replica behind the cache lock)
+        warmed = (
+            replica_set.prewarm() if replica_set is not None
+            else sched.prewarm()
+        )
         print(
             f"prewarmed {len(warmed)} batch widths in "
             f"{time.monotonic() - t_warm:.1f}s: "
@@ -360,19 +398,28 @@ def main(argv=None) -> int:
             # — unschedulable pods park+retry forever, so len(queue) alone
             # would spin; no-progress across a cycle also terminates
             seen: set = set()
+            loops = (
+                replica_set.schedulers if replica_set is not None
+                else [sched]
+            )
             while len(seen) < target:
-                before = len(sched.results)
-                sched.run_once(timeout=0.5)
-                for r in sched.results[before:]:
-                    key = (r.pod.namespace, r.pod.name)
-                    if snapshot_keys is None or key in snapshot_keys:
-                        seen.add(key)
-                if len(sched.results) == before:
+                before = sum(len(s.results) for s in loops)
+                for s in loops:
+                    s.run_once(timeout=0.5 / len(loops))
+                for s in loops:
+                    for r in s.results:
+                        key = (r.pod.namespace, r.pod.name)
+                        if snapshot_keys is None or key in snapshot_keys:
+                            seen.add(key)
+                if sum(len(s.results) for s in loops) == before:
                     break
+            for s in loops:
+                s.flush_pipeline()
             dt = time.monotonic() - t0
             done = len({
                 (r.pod.namespace, r.pod.name)
-                for r in sched.results
+                for s in loops
+                for r in s.results
                 if r.node is not None and (
                     snapshot_keys is None
                     or (r.pod.namespace, r.pod.name) in snapshot_keys
@@ -397,6 +444,10 @@ def main(argv=None) -> int:
             )
             wait_for_term()
             elector.stop()
+        elif replica_set is not None:
+            replica_set.start()
+            wait_for_term()
+            replica_set.stop()
         else:
             import threading
 

@@ -274,6 +274,23 @@ class SnapshotEncoder:
         self._req_memo: Dict[Tuple, Tuple[np.ndarray, np.ndarray]] = {}
         self._empty_vcounts: np.ndarray | None = None
 
+        # ---- per-namespace usage/quota columns (ISSUE 14) ----
+        # tenant axis for placement fairness: committed (node-assigned)
+        # requests aggregated per namespace, maintained incrementally on
+        # the same add/remove seams as a_requested, plus an optional
+        # per-namespace quota row (+inf = unbounded).  The conflict
+        # reconciler's dominant-resource-fairness tiebreak and quota
+        # admission read these under the cache lock; they ride the
+        # encoder (not ClusterTensors) so engine pytree shapes — and
+        # therefore every compiled executable — are untouched.
+        self.ns_rows: Dict[str, int] = {}
+        self._cap_t = 8
+        self.a_ns_usage = np.zeros((self._cap_t, self.dims.R), np.float32)
+        self.a_ns_quota = np.full(
+            (self._cap_t, self.dims.R), np.inf, np.float32
+        )
+        self.ns_quota_set = False  # any finite quota configured?
+
         # ---- incremental snapshot bookkeeping ----
         # see the class docstring for the dirty-row contract
         self._snap: Optional[ClusterTensors] = None
@@ -311,6 +328,72 @@ class SnapshotEncoder:
         rows = self._snap_rows_acc | self._dirty_node_rows | self._dirty_pod_rows
         self._snap_rows_acc = set()
         return np.asarray(sorted(rows), np.int32)
+
+    # ------------------------------- per-namespace usage/quota (ISSUE 14)
+
+    def _ns_row(self, ns: str) -> int:
+        """Tenant index of a namespace, allocating (and growing the
+        usage/quota arrays, quota inf-padded) on first sight."""
+        t = self.ns_rows.get(ns)
+        if t is None:
+            t = len(self.ns_rows)
+            self.ns_rows[ns] = t
+            while t >= self._cap_t:
+                self._cap_t *= 2
+                for attr, fill in (
+                    ("a_ns_usage", 0.0), ("a_ns_quota", np.inf)
+                ):
+                    src = getattr(self, attr)
+                    new = np.full(
+                        (self._cap_t, src.shape[1]), fill, np.float32
+                    )
+                    new[: src.shape[0]] = src
+                    setattr(self, attr, new)
+        return t
+
+    def set_namespace_quota(self, ns: str, limits: Dict) -> None:
+        """Per-namespace placement quota: committed usage beyond this is
+        vetoed by the conflict reconciler at commit (ISSUE 14).  `limits`
+        maps resource name -> quantity (string, number, or Quantity);
+        unnamed resources stay unbounded (+inf)."""
+        from kubernetes_tpu.api.resource import parse_quantity
+
+        t = self._ns_row(ns)
+        row = np.full(self.dims.R, np.inf, np.float32)
+        for name, q in (limits or {}).items():
+            q = parse_quantity(q)
+            col = self._res_col(name)
+            # _res_col may have grown dims.R (and the ns arrays with it,
+            # via the shared R-grow path): refresh the row buffer
+            if row.shape[0] != self.dims.R:
+                old = row
+                row = np.full(self.dims.R, np.inf, np.float32)
+                row[: old.shape[0]] = old
+            row[col] = q.milli if name == RESOURCE_CPU else float(q)
+        self.a_ns_quota[t, : row.shape[0]] = row
+        self.ns_quota_set = bool(
+            np.isfinite(self.a_ns_quota[: len(self.ns_rows)]).any()
+        )
+
+    def namespace_usage(self) -> Dict[str, dict]:
+        """{namespace: {"usage": [R floats], "quota": [R floats|None]}} —
+        the /debug/replicas tenant table (host-side, O(T*R))."""
+        out: Dict[str, dict] = {}
+        for ns, t in self.ns_rows.items():
+            quota = self.a_ns_quota[t]
+            out[ns] = {
+                "usage": [round(float(x), 3) for x in self.a_ns_usage[t]],
+                "quota": [
+                    (round(float(x), 3) if np.isfinite(x) else None)
+                    for x in quota
+                ],
+            }
+        return out
+
+    def capacity_totals(self) -> np.ndarray:
+        """f32[R] cluster-wide allocatable totals over valid rows — the
+        dominant-resource-fairness denominator."""
+        return self.a_allocatable[self.a_valid].sum(axis=0)
 
     # ------------------------------------------------------------------ arena
 
@@ -528,6 +611,17 @@ class SnapshotEncoder:
                     new = np.zeros((self._cap_n, self.dims.R), np.float32)
                     new[:, :old] = src
                     setattr(self, attr, new)
+                # the tenant usage/quota columns track dims.R in lockstep
+                # (quota pads +inf = the new resource starts unbounded)
+                for attr, fill in (
+                    ("a_ns_usage", 0.0), ("a_ns_quota", np.inf)
+                ):
+                    src = getattr(self, attr)
+                    new = np.full(
+                        (self._cap_t, self.dims.R), fill, np.float32
+                    )
+                    new[:, :old] = src
+                    setattr(self, attr, new)
                 for rec in self.pods.values():
                     r = np.zeros(self.dims.R, np.float32)
                     r[:old] = rec.req
@@ -604,6 +698,11 @@ class SnapshotEncoder:
             self._shift_pod_pairs(rec, add=False)
             rec.node_row = -1
             self.p_node[rec.m] = PAD
+            # the detached pod no longer holds committed capacity: its
+            # tenant usage retires with the row's aggregates below
+            self.a_ns_usage[
+                self._ns_row(rec.ns), : rec.req.shape[0]
+            ] -= rec.req
         self._row_pods.pop(row, None)
         # zero the aggregates so row reuse starts clean
         self.a_requested[row, :] = 0.0
@@ -1237,12 +1336,16 @@ class SnapshotEncoder:
         self.a_requested[:, :] = 0.0
         self.a_nonzero[:, :] = 0.0
         self.a_volcnt[:, :] = 0.0
+        self.a_ns_usage[:, :] = 0.0
         self._node_cnt_vols.clear()
         self._cnt_vol_rows = [dict() for _ in range(self.dims.VT)]
         for rec in self.pods.values():
             if rec.node_row >= 0:
                 self.a_requested[rec.node_row, : rec.req.shape[0]] += rec.req
                 self.a_nonzero[rec.node_row] += rec.nonzero
+                self.a_ns_usage[
+                    self._ns_row(rec.ns), : rec.req.shape[0]
+                ] += rec.req
                 if rec.cnt_vols:
                     cnts = self._node_cnt_vols.setdefault(
                         rec.node_row,
@@ -1539,6 +1642,11 @@ class SnapshotEncoder:
             self._row_pods.setdefault(node_row, set()).add(key)
             self.a_requested[node_row, : req.shape[0]] += req
             self.a_nonzero[node_row] += nonzero
+            # tenant usage column (ISSUE 14): committed requests only —
+            # an unassigned pod exerts no placement-fairness pressure
+            self.a_ns_usage[
+                self._ns_row(pod.namespace), : req.shape[0]
+            ] += req
             if ports:  # rebuilds are row-wide sorts: skip when untouched
                 for pp_ip in ports:
                     self._node_ports[node_row][pp_ip] += 1
@@ -1711,6 +1819,11 @@ class SnapshotEncoder:
             nz_stack = np.stack([r.nonzero for r in recs])
             np.add.at(self.a_requested, rows_arr[on_node], req_stack[on_node])
             np.add.at(self.a_nonzero, rows_arr[on_node], nz_stack[on_node])
+            # tenant usage columns (ISSUE 14), same ordered-scatter shape
+            t_arr = np.asarray(
+                [self._ns_row(r.ns) for r in recs], np.intp
+            )
+            np.add.at(self.a_ns_usage, t_arr[on_node], req_stack[on_node])
         for row in vol_rows:
             cnts = self._node_cnt_vols[row]
             for t in range(self.dims.VT):
@@ -1741,6 +1854,9 @@ class SnapshotEncoder:
             self._row_pods.get(row, set()).discard(key)
             self.a_requested[row, : rec.req.shape[0]] -= rec.req
             self.a_nonzero[row] -= rec.nonzero
+            self.a_ns_usage[
+                self._ns_row(rec.ns), : rec.req.shape[0]
+            ] -= rec.req
             if rec.ports:  # rebuilds are row-wide sorts: skip when untouched
                 c = self._node_ports[row]
                 for pp_ip in rec.ports:

@@ -149,6 +149,16 @@ class KubeSchedulerConfiguration:
     quality_top_k: int = 3
     quality_interval_cycles: int = 32
     quality_drift_threshold: float = 0.25
+    # queue-sharded scheduler replicas (runtime/replicas.py +
+    # runtime/reconciler.py): run this many scheduler loops (threads)
+    # over one queue/cache, each draining a stable hash-shard and
+    # committing through the sequenced optimistic conflict reconciler;
+    # 1 = the classic single loop bit-for-bit.  namespaceQuotas
+    # ({namespace: {resource: quantity}}) are enforced at commit by the
+    # same reconciler (placement-fairness quota; DRF tiebreak rides the
+    # encoder's per-namespace usage columns).
+    replicas: int = 1
+    namespace_quotas: Optional[dict] = None
 
     def build_profile(self, interner=None) -> SchedulingProfile:
         """CreateFromConfig / CreateFromProvider (scheduler.go:162-192)."""
@@ -239,6 +249,8 @@ class KubeSchedulerConfiguration:
             quality_drift_threshold=float(
                 d.get("qualityDriftThreshold", 0.25)
             ),
+            replicas=int(d.get("replicas", 1)),
+            namespace_quotas=d.get("namespaceQuotas"),
         )
 
     @staticmethod

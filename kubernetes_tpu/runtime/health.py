@@ -410,6 +410,17 @@ class HealthServer:
                         ),
                         ct="application/json",
                     )
+                elif path == "/debug/replicas":
+                    # queue-sharded replicas (ISSUE 14): the explicit
+                    # process aggregate — per-replica cycle/conflict
+                    # facts, reconciler sequencing stats, tenant
+                    # usage/quota table
+                    from kubernetes_tpu.runtime import reconciler
+
+                    self._send(
+                        debug_body(reconciler.debug_payload, query),
+                        ct="application/json",
+                    )
                 elif path == "/debug/profile":
                     # on-demand bounded jax.profiler capture
                     # (?seconds=N; throttled, graceful no-op where the

@@ -253,6 +253,13 @@ class DecisionLedger:
                 # can join the K blocks of one launch
                 **({"mega": outcome["mega"]}
                    if outcome.get("mega") is not None else {}),
+                # queue-sharded replicas (ISSUE 14): dispatching replica
+                # + reconciler commit sequence, so /debug/decisions
+                # readers can reconstruct the cross-replica interleaving
+                **({"replica": outcome["replica"]}
+                   if outcome.get("replica") is not None else {}),
+                **({"seq": outcome["seq"]}
+                   if outcome.get("seq") is not None else {}),
             }
             self._ring.append(entry)
             self.cycles_total += 1
@@ -416,9 +423,22 @@ def get_default() -> DecisionLedger:
     return LEDGER
 
 
-def set_default(ledger: DecisionLedger) -> None:
+# per-replica installs (ISSUE 14 satellite; see runtime/telemetry.py).
+# Replicas normally SHARE one ledger (replica id + commit seq in every
+# block), so the registry usually holds one instance under several ids.
+_REPLICAS: dict = {}
+
+
+def set_default(ledger: DecisionLedger, replica: int = 0) -> None:
     global LEDGER
-    LEDGER = ledger
+    _REPLICAS[int(replica)] = ledger
+    if int(replica) == 0:
+        LEDGER = ledger
+
+
+def replica_instances() -> dict:
+    """{replica id: DecisionLedger} of every install this process saw."""
+    return dict(sorted(_REPLICAS.items()))
 
 
 def bounded_json(render, limit: Optional[int],
@@ -498,6 +518,11 @@ DEBUG_ENDPOINTS = {
         "placement-quality observatory: winner margins, feasible "
         "counts, FFD-counterfactual regret, packing-drift detectors "
         "(?limit=N)"
+    ),
+    "/debug/replicas": (
+        "queue-sharded scheduler replicas: per-replica cycle/conflict "
+        "facts, the sequenced reconciler's stats, and the per-namespace "
+        "usage/quota table (?limit=N bounds the tenant table)"
     ),
 }
 

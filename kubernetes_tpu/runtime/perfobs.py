@@ -414,6 +414,18 @@ def get_default() -> PerfObservatory:
     return OBSERVATORY
 
 
-def set_default(obs: PerfObservatory) -> None:
+# per-replica installs (ISSUE 14 satellite; see runtime/telemetry.py):
+# replica 0 stays the process default, siblings register alongside
+_REPLICAS: dict = {}
+
+
+def set_default(obs: PerfObservatory, replica: int = 0) -> None:
     global OBSERVATORY
-    OBSERVATORY = obs
+    _REPLICAS[int(replica)] = obs
+    if int(replica) == 0:
+        OBSERVATORY = obs
+
+
+def replica_instances() -> dict:
+    """{replica id: PerfObservatory} of every install this process saw."""
+    return dict(sorted(_REPLICAS.items()))

@@ -574,6 +574,19 @@ def get_default() -> QualityObservatory:
     return QUALITY
 
 
-def set_default(obs: QualityObservatory) -> None:
+# per-replica installs (ISSUE 14 satellite; see runtime/telemetry.py):
+# replica 0 stays the process default, siblings register alongside
+_REPLICAS: dict = {}
+
+
+def set_default(obs: QualityObservatory, replica: int = 0) -> None:
     global QUALITY
-    QUALITY = obs
+    _REPLICAS[int(replica)] = obs
+    if int(replica) == 0:
+        QUALITY = obs
+
+
+def replica_instances() -> dict:
+    """{replica id: QualityObservatory} of every install this process
+    saw."""
+    return dict(sorted(_REPLICAS.items()))

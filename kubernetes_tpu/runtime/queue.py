@@ -21,6 +21,7 @@ import heapq
 import itertools
 import threading
 import time
+import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api.types import Pod
@@ -128,7 +129,8 @@ class PriorityQueue:
                  capacity: Optional[int] = None,
                  on_shed: Optional[Callable[[Pod, str], None]] = None,
                  tier_of: Optional[Callable[[Pod], str]] = None,
-                 on_requeue: Optional[Callable[[Pod], None]] = None):
+                 on_requeue: Optional[Callable[[Pod], None]] = None,
+                 shards: int = 1):
         # overload protection: bound the TOTAL queue population
         # (active + backoff + unschedulable).  None = unbounded (the
         # historical behavior).  At capacity, a NEW arrival sheds the
@@ -165,10 +167,26 @@ class PriorityQueue:
         self._less = less
         self._lock = threading.Condition()
         self._counter = itertools.count()
-        self._active: List[list] = []          # [-prio, seq, pod, valid]
-        # express-lane heap: same entry layout and ordering as _active;
-        # entries of BOTH heaps share _active_entry, so delete/shedding/
-        # depth accounting see one active population
+        # queue-sharded replicas (ISSUE 14): the bulk lane is a LIST of
+        # heaps, one per stable hash-shard (shard = crc32(ns/name) % N),
+        # so N scheduler replicas each drain a disjoint slice of the
+        # active population without contending on pop order.  shards=1
+        # (the default) is the classic single-heap queue bit-for-bit;
+        # pop()/pop_batch() without a shard argument pop the GLOBAL best
+        # across all heaps (same priority-FIFO order as one heap).
+        # Requeues return to the owner shard by construction (the shard
+        # is a pure function of the pod key); the shed candidate scan and
+        # the backoff starvation guard work over the entry maps, which
+        # span every shard.
+        self._shards_n = max(1, int(shards))
+        self._active: List[List[list]] = [
+            [] for _ in range(self._shards_n)
+        ]                                      # per-shard [-prio, seq, pod, valid] heaps
+        # express-lane heap: same entry layout and ordering as the bulk
+        # heaps (a single cross-shard lane — the express interleave is
+        # served by one replica); entries of ALL heaps share
+        # _active_entry, so delete/shedding/depth accounting see one
+        # active population
         self._express: List[list] = []
         self._active_entry: Dict[Tuple[str, str], list] = {}
         self._backoffq: List[list] = []        # [expiry, seq, pod, valid]
@@ -186,6 +204,46 @@ class PriorityQueue:
         # key -> monotonic first-enqueue time (cleared on delete / taken at
         # bind-commit for the e2e_scheduling_duration histogram)
         self._enqueued_at: Dict[Tuple[str, str], float] = {}
+
+    # ---- sharding ----
+
+    @staticmethod
+    def shard_of(pod, of: int) -> int:
+        """STABLE hash shard of a pod (or (ns, name) key) for an N-way
+        split: crc32 of "ns/name" mod N — deterministic across processes
+        and runs (python's hash() is seed-randomized), so a pod always
+        lands on the same shard through add/delete/readd and every
+        requeue returns it to its owner replica."""
+        if of <= 1:
+            return 0
+        key = pod if isinstance(pod, tuple) else _pod_key(pod)
+        return zlib.crc32(f"{key[0]}/{key[1]}".encode()) % of
+
+    def _set_shards_locked(self, n: int) -> None:
+        """Re-shard the bulk lane to n heaps (lock held): existing valid
+        entries redistribute by their stable hash; entry OBJECTS are
+        preserved so _active_entry identity (lazy deletion) still holds."""
+        n = max(1, int(n))
+        if n == self._shards_n:
+            return
+        entries = [e for h in self._active for e in h if e[_VALID]]
+        self._shards_n = n
+        self._active = [[] for _ in range(n)]
+        for e in entries:
+            heapq.heappush(
+                self._active[self.shard_of(_pod_key(e[2]), n)], e
+            )
+        self._lock.notify_all()
+
+    def set_shards(self, n: int) -> None:
+        """Configure the bulk lane's shard count (SchedulerReplicaSet
+        wires N = replica count).  Idempotent; safe while populated."""
+        with self._lock:
+            self._set_shards_locked(n)
+
+    @property
+    def shards(self) -> int:
+        return self._shards_n
 
     # ---- internal (lock held) ----
 
@@ -205,9 +263,10 @@ class PriorityQueue:
         else:
             sort_key = -pod.spec.priority
         entry = [sort_key, next(self._counter), pod, True]
-        heap = self._active
         if self.tier_of is not None and self.tier_of(pod) == TIER_EXPRESS:
             heap = self._express
+        else:
+            heap = self._active[self.shard_of(key, self._shards_n)]
         heapq.heappush(heap, entry)
         self._active_entry[key] = entry
 
@@ -508,6 +567,25 @@ class PriorityQueue:
             return pod
         return None
 
+    def _pop_bulk_locked(self, shard: Optional[int]) -> Optional[Pod]:
+        """Pop the best valid bulk entry (lock held).  shard=None pops the
+        GLOBAL best across every shard heap (identical order to a single
+        heap: entries compare by [sort_key, seq], and seq is unique);
+        shard=i pops only shard i's heap (a replica's slice)."""
+        if shard is not None:
+            return self._pop_from_locked(self._active[shard])
+        if self._shards_n == 1:
+            return self._pop_from_locked(self._active[0])
+        best_h = None
+        for h in self._active:
+            while h and not h[0][_VALID]:  # shed dead heads before compare
+                heapq.heappop(h)
+            if h and (best_h is None or h[0][:2] < best_h[0][:2]):
+                best_h = h
+        if best_h is None:
+            return None
+        return self._pop_from_locked(best_h)
+
     def _express_ready_locked(self) -> bool:
         """Any valid express entry pending?  (Lock held; sheds the heap's
         lazily-deleted head entries as a side effect, so the check stays
@@ -518,16 +596,29 @@ class PriorityQueue:
         return bool(h)
 
     def pop(self, timeout: Optional[float] = None,
-            yield_to_express: bool = False) -> Optional[Pod]:
+            yield_to_express: bool = False,
+            shard: Optional[int] = None,
+            of: Optional[int] = None) -> Optional[Pod]:
         """Blocking pop from the BULK lane.  With yield_to_express, an
         express arrival interrupts the wait (returns None) so the tiered
         run loop can serve the express lane instead of letting a
-        latency-sensitive pod sit out the bulk poll timeout."""
+        latency-sensitive pod sit out the bulk poll timeout.
+
+        shard=i (with of=N) pops only pods whose stable hash-shard is i —
+        the queue re-shards itself to N heaps on first use, so N replica
+        consumers drain disjoint slices; a replica's blocking wait still
+        wakes on any arrival and re-checks only its own shard."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
+            if of is not None and of != self._shards_n:
+                self._set_shards_locked(of)
+            if shard is not None and not (0 <= shard < self._shards_n):
+                raise ValueError(
+                    f"shard {shard} out of range for {self._shards_n} shards"
+                )
             while True:
                 self._flush(time.monotonic())
-                pod = self._pop_from_locked(self._active)
+                pod = self._pop_bulk_locked(shard)
                 if pod is not None:
                     return pod
                 if yield_to_express and self._express_ready_locked():
@@ -550,13 +641,18 @@ class PriorityQueue:
 
     def pop_batch(self, max_batch: int, timeout: Optional[float] = None,
                   batch_window: float = 0.0,
-                  yield_to_express: bool = False) -> List[Pod]:
+                  yield_to_express: bool = False,
+                  shard: Optional[int] = None,
+                  of: Optional[int] = None) -> List[Pod]:
         """Drain up to max_batch pods; waits `timeout` for the first pod then
         `batch_window` more for stragglers (deadline-driven batch formation).
         yield_to_express (tiered run loop): an express arrival cuts both the
-        first-pod wait and the straggler window short."""
+        first-pod wait and the straggler window short.  shard=i, of=N
+        (ISSUE 14): drain only the stable hash-shard i of an N-way split —
+        the scheduler-replica consumer API."""
         out = []
-        first = self.pop(timeout, yield_to_express=yield_to_express)
+        first = self.pop(timeout, yield_to_express=yield_to_express,
+                         shard=shard, of=of)
         if first is None:
             return out
         out.append(first)
@@ -564,7 +660,7 @@ class PriorityQueue:
         while len(out) < max_batch:
             remain = deadline - time.monotonic()
             nxt = self.pop(max(remain, 0.0) if batch_window else 0.0,
-                           yield_to_express=yield_to_express)
+                           yield_to_express=yield_to_express, shard=shard)
             if nxt is None:
                 break
             out.append(nxt)

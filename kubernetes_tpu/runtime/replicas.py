@@ -1,0 +1,285 @@
+"""SchedulerReplicaSet: N scheduler replicas over one process's state.
+
+ISSUE 14 / ROADMAP item 3 — break the one-Python-loop ceiling.  The
+replica set builds N `Scheduler` instances (threads) sharing:
+
+  * ONE SchedulerCache/SnapshotEncoder (commits serialize under the
+    cache lock; everything else overlaps),
+  * ONE PriorityQueue, hash-sharded N ways (each replica pops only its
+    stable shard; requeues return to the owner shard),
+  * ONE SnapshotHub — THE resident device snapshot every replica
+    dispatches against, refreshed atomically per dispatch and tagged
+    with its generation,
+  * ONE ConflictReconciler sequencing every commit: zero-conflict
+    cycles admit on the generation fence; conflicted cycles run the
+    fused admission scan, keep the sequenced winner per node, and
+    requeue only the losers (DRF-tiebroken, quota-enforced),
+  * ONE DecisionLedger (replica id + commit seq in every block), and
+    the process flight recorder.
+
+Replica 0 is the "primary": it owns the compiled engines (siblings
+reuse the same jitted callables — no N-fold compile), the express lane
+(a single cross-shard latency lane), and the default observability
+installs (/debug/* primary payloads; /debug/replicas serves the
+explicit aggregate).
+
+Scope: replicas require batched_commit and demote gangs to plain pods;
+extenders/framework plugins and device-mesh sharding are not combined
+with replicas yet (one scale-out axis at a time — the mesh shards the
+node tensor, replicas shard the queue).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, List, Optional
+
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.queue import PriorityQueue
+from kubernetes_tpu.runtime.reconciler import ConflictReconciler, SnapshotHub
+from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.utils import metrics as m
+
+
+class SchedulerReplicaSet:
+    """N queue-sharded scheduler replicas with optimistic conflict
+    reconciliation (see module docstring)."""
+
+    def __init__(
+        self,
+        replicas: int = 2,
+        cache: Optional[SchedulerCache] = None,
+        queue: Optional[PriorityQueue] = None,
+        binder: Optional[Callable] = None,
+        config: Optional[SchedulerConfig] = None,
+        recorder=None,
+        ledger=None,
+        victim_deleter=None,
+        pdb_lister=None,
+    ):
+        n = max(1, int(replicas))
+        config = config if config is not None else SchedulerConfig()
+        if not config.batched_commit:
+            raise ValueError(
+                "SchedulerReplicaSet requires batched_commit (the "
+                "reconciler admits winners as one sequenced delta)"
+            )
+        if config.shard_devices or config.mesh_shape:
+            raise ValueError(
+                "SchedulerReplicaSet does not combine with device-mesh "
+                "sharding yet: the mesh shards the node tensor, replicas "
+                "shard the queue — pick one scale-out axis per process"
+            )
+        self.n = n
+        self.cache = cache if cache is not None else SchedulerCache()
+        self.queue = (
+            queue if queue is not None
+            else PriorityQueue(capacity=config.queue_capacity, shards=n)
+        )
+        if hasattr(self.queue, "set_shards"):
+            self.queue.set_shards(n)
+        self.reconciler = ConflictReconciler()
+        self.config = config
+        # replica 0: the primary — owns engines, express lane, ledger,
+        # and the default observability installs
+        cfg0 = dataclasses.replace(config, replicas=n)
+        r0 = Scheduler(
+            cache=self.cache, queue=self.queue, binder=binder,
+            config=cfg0, recorder=recorder, ledger=ledger,
+            victim_deleter=victim_deleter, pdb_lister=pdb_lister,
+            replica_id=0, replica_of=n, reconciler=self.reconciler,
+        )
+        self._assemble(r0)
+
+    def _assemble(self, r0: Scheduler) -> None:
+        """Attach the shared hub to the primary and build the sibling
+        replicas around it (shared by __init__ and from_primary)."""
+        n = self.n
+        # THE shared resident snapshot: the hub wraps replica 0's device
+        # cache (mesh-free by the constructor guard) and becomes every
+        # replica's dispatch surface — including replica 0's
+        self.hub = SnapshotHub(self.cache, r0._dev_snapshot)
+        r0.attach_hub(self.hub)
+        self.schedulers: List[Scheduler] = [r0]
+        for i in range(1, n):
+            cfg_i = dataclasses.replace(
+                r0.config,
+                express_lane=False,       # one express lane (replica 0)
+                decision_ledger=False,    # share replica 0's ledger
+                heartbeat_s=0.0,          # one heartbeat line, not N
+            )
+            self.schedulers.append(
+                Scheduler(
+                    cache=self.cache, queue=self.queue,
+                    binder=r0.binder, config=cfg_i,
+                    recorder=r0.recorder, ledger=r0.ledger,
+                    victim_deleter=r0.victim_deleter,
+                    pdb_lister=r0.pdb_lister,
+                    replica_id=i, replica_of=n,
+                    reconciler=self.reconciler, snapshot_hub=self.hub,
+                    share_engines_with=r0,
+                )
+            )
+        self._threads: List[threading.Thread] = []
+        m.REPLICAS.set(float(n))
+
+    @classmethod
+    def from_primary(cls, primary: Scheduler,
+                     replicas: int) -> "SchedulerReplicaSet":
+        """Wrap an ALREADY-WIRED scheduler (cmd/base
+        build_wired_scheduler: cluster events, informers, binder) as
+        replica 0 of an N-way set.  Must run before the primary serves
+        its first cycle — it retrofits the replica identity, the
+        sequenced reconciler, and the shared hub onto it."""
+        n = max(1, int(replicas))
+        cfg = primary.config
+        if not cfg.batched_commit:
+            raise ValueError("replicas require batched_commit")
+        if primary.framework is not None:
+            raise ValueError(
+                "replicas require the batched commit path; a framework "
+                "forces per-pod commits that bypass the reconciler"
+            )
+        if cfg.shard_devices or cfg.mesh_shape:
+            raise ValueError(
+                "replicas do not combine with device-mesh sharding yet"
+            )
+        self = cls.__new__(cls)
+        self.n = n
+        self.cache = primary.cache
+        self.queue = primary.queue
+        if hasattr(self.queue, "set_shards"):
+            self.queue.set_shards(n)
+        self.reconciler = ConflictReconciler()
+        self.config = cfg
+        cfg.replicas = n
+        primary._replica_of = n
+        primary._reconciler = self.reconciler
+        self._assemble(primary)
+        return self
+
+    # ------------------------------------------------------------ running
+
+    @property
+    def primary(self) -> Scheduler:
+        return self.schedulers[0]
+
+    def prewarm(self, **kw):
+        """Pre-pay compiles once: replicas share replica 0's
+        executables, so warming the primary warms the fleet — plus the
+        reconciler's admission-kernel ladder (a first-conflict compile
+        mid-traffic would read as a conflict-cost spike)."""
+        out = self.primary.prewarm(**kw)
+        self.reconciler.prewarm(
+            self.config.batch_size, self.cache.encoder.dims.R
+        )
+        return out
+
+    def start(self) -> None:
+        """One daemon thread per replica running its scheduling loop.
+        Restartable: a previous stop() only parked the loops (the
+        shared queue stays open), so clearing the stop flags resumes."""
+        if self._threads:
+            return
+        for s in self.schedulers:
+            s._stop.clear()
+        for s in self.schedulers:
+            t = threading.Thread(
+                target=s.run, name=f"scheduler-replica-{s._replica_id}",
+                daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        """Park every replica loop WITHOUT closing the shared queue
+        (Scheduler.stop would — and a closed queue cannot serve a later
+        start(); bench sweeps warm, stop, and re-run).  Loops exit
+        within their pop timeout; run() flushes in-flight work on the
+        way out.  close() ends the set for good."""
+        for s in self.schedulers:
+            s._stop.set()
+        for t in self._threads:
+            t.join(timeout_s)
+        self._threads = []
+
+    def close(self) -> None:
+        """Terminal stop: park the loops AND close the shared queue."""
+        self.stop()
+        self.queue.close()
+
+    def run_until_drained(self, budget_s: float = 60.0,
+                          poll_s: float = 0.01) -> int:
+        """start() (if not already running), then wait until nothing
+        schedulable remains (active/backoff work or an in-flight
+        pipelined batch) or the budget expires.  Returns pods placed
+        across all replicas during the wait.  Pods parked unschedulable
+        do NOT keep the wait alive (no cluster events fire here)."""
+        placed0 = self.placed_total
+        self.start()
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            busy = self.queue.has_schedulable() or any(
+                s.pipeline_pending for s in self.schedulers
+            )
+            if not busy:
+                break
+            time.sleep(poll_s)
+        return self.placed_total - placed0
+
+    # ---------------------------------------------------------- aggregate
+
+    @property
+    def placed_total(self) -> int:
+        return sum(
+            s._outcome_totals["placed"] for s in self.schedulers
+        )
+
+    @property
+    def unschedulable_total(self) -> int:
+        return sum(
+            s._outcome_totals["unschedulable"] for s in self.schedulers
+        )
+
+    @property
+    def conflicts_total(self) -> int:
+        return self.reconciler.conflicts_total
+
+    def assert_drained(self) -> bool:
+        """Every replica's invariant checker confirms no popped pod is
+        unresolved (the chaos-soak teardown gate).  True when clean."""
+        ok = True
+        for s in self.schedulers:
+            if s.invariants is not None:
+                ok = s.invariants.assert_drained() and ok
+        return ok
+
+    def invariant_violations_total(self) -> int:
+        return sum(
+            s.invariants.violations_total()
+            for s in self.schedulers if s.invariants is not None
+        )
+
+    def summary(self) -> dict:
+        """The /debug/replicas-shaped roll-up for bench artifacts."""
+        return {
+            "replicas": self.n,
+            "placed": self.placed_total,
+            "unschedulable": self.unschedulable_total,
+            "conflicts": self.conflicts_total,
+            "quota_vetoes": self.reconciler.quota_vetoes_total,
+            "reconciler": self.reconciler.stats(),
+            "hub_refreshes": self.hub.refreshes,
+            "invariant_violations": self.invariant_violations_total(),
+            "per_replica": {
+                str(s._replica_id): {
+                    "placed": s._outcome_totals["placed"],
+                    "unschedulable": s._outcome_totals["unschedulable"],
+                    "conflicts": s.conflicts_total,
+                    "cycles_results": len(s.results),
+                }
+                for s in self.schedulers
+            },
+        }

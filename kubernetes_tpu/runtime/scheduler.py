@@ -291,6 +291,23 @@ class SchedulerConfig:
     # dual-window EWMA step-detector threshold for the packing-drift
     # alerts (relative deviation of the fast window from the slow one)
     quality_drift_threshold: float = 0.25
+    # --- queue-sharded scheduler replicas (ISSUE 14) ---
+    # horizontal scale-out inside one process: run this many Scheduler
+    # replicas (threads) over ONE cache/queue, each popping a stable
+    # hash-shard of the PriorityQueue and dispatching against the SAME
+    # resident snapshot generation, with commits sequenced through the
+    # optimistic conflict reconciler (runtime/reconciler.py).  1 = the
+    # classic single-loop scheduler bit-for-bit.  Consumed by
+    # SchedulerReplicaSet (runtime/replicas.py) / cmd --replicas; an
+    # individual Scheduler instance reads its own replica identity from
+    # the replica_id/replica_of constructor args instead.
+    replicas: int = 1
+    # per-namespace placement quotas ({namespace: {resource: quantity}}):
+    # committed usage beyond a namespace's quota is vetoed by the
+    # reconciler at commit (the pod parks unschedulable with backoff).
+    # None = no quotas.  Rides the encoder's per-namespace usage/quota
+    # columns; also the DRF tiebreak's fairness substrate.
+    namespace_quotas: Optional[dict] = None
     # multi-scheduler: only pods whose spec.schedulerName names THIS
     # scheduler enter its queue (eventhandlers.go responsibleForPod)
     scheduler_name: str = "default-scheduler"
@@ -362,6 +379,8 @@ class SchedulerConfig:
             quality_drift_threshold=getattr(
                 cc, "quality_drift_threshold", 0.25
             ),
+            replicas=getattr(cc, "replicas", 1),
+            namespace_quotas=getattr(cc, "namespace_quotas", None),
         )
 
 
@@ -455,6 +474,18 @@ class _InFlight:
     # placed against chained state the shared snapshot predates; FFD
     # against the emptier pre-megacycle capacity would overstate regret)
     quality_snapshot: Optional[tuple] = None
+    # --- queue-sharded replicas (ISSUE 14) ---
+    # the encoded batch's request matrix (host ref) when a conflict
+    # reconciler is attached: the admission scan's pod-side input
+    reqs: object = None
+    # commit sequence number stamped by the reconciler (the "sequenced
+    # winner" order; rides the ledger block for cross-replica audit)
+    commit_seq: int = -1
+    # encoder generation right after THIS cycle's state commit: a
+    # megacycle propagates it to the next window's fence so chained
+    # windows keep the zero-conflict fast path when no sibling
+    # interleaved between sub-batch commits
+    gen_after: int = -1
 
 
 class _HostResult:
@@ -504,6 +535,13 @@ class _Staged:
     # computing the delta there would double-count the next cycle's
     # uploads into this cycle's span
     xfer_delta: Optional[dict] = None
+    # (batch index, pod) losers of the optimistic cross-replica race
+    # (ISSUE 14): their node headroom was spent by a sequenced-earlier
+    # commit — the tail readds them to the owner shard (shed-exempt)
+    race_lost: List[Tuple] = field(default_factory=list)
+    # (batch index, pod) vetoed by a namespace quota: parked
+    # unschedulable with backoff (spinning on a full quota helps nobody)
+    quota_lost: List[Tuple] = field(default_factory=list)
 
 
 @dataclass
@@ -547,6 +585,20 @@ class Scheduler:
         ledger=None,  # runtime/ledger.DecisionLedger; None = built from
         #               config.decision_ledger (and installed as the
         #               process default serving /debug/decisions)
+        # --- queue-sharded replicas (ISSUE 14, runtime/replicas.py) ---
+        replica_id: int = 0,     # this instance's replica index (= its
+        #                          stable queue hash-shard)
+        replica_of: int = 1,     # total replicas sharing the queue; 1 =
+        #                          the classic single-loop scheduler
+        reconciler=None,         # shared runtime/reconciler
+        #                          .ConflictReconciler sequencing commits
+        snapshot_hub=None,       # shared runtime/reconciler.SnapshotHub
+        #                          (THE resident device snapshot; None =
+        #                          this instance owns its own cache)
+        share_engines_with=None,  # a sibling Scheduler whose compiled
+        #                          engines/preempt-eval this one reuses
+        #                          (replicas share executables — N
+        #                          replicas must not pay N compiles)
     ):
         # NB: PriorityQueue defines __len__, so `queue or PriorityQueue()`
         # would silently replace an *empty* caller-owned queue
@@ -607,6 +659,24 @@ class Scheduler:
                     type(self.queue).__name__,
                 )
         self.binder = binder if binder is not None else (lambda pod, node: True)
+        # --- queue-sharded replicas (ISSUE 14) ---
+        # replica identity (= the stable queue hash-shard this loop
+        # drains), the shared sequenced reconciler, and the shared
+        # snapshot hub.  replica_of == 1 with no hub/reconciler is the
+        # classic single-loop scheduler bit-for-bit.
+        self._replica_id = int(replica_id)
+        self._replica_of = max(1, int(replica_of))
+        self._reconciler = reconciler
+        self._hub = snapshot_hub
+        self.conflicts_total = 0        # race losers this replica requeued
+        self.race_requeued_total = 0
+        self.quota_vetoed_total = 0
+        if self._reconciler is not None and not self.config.batched_commit:
+            raise ValueError(
+                "replica mode requires batched_commit: the conflict "
+                "reconciler admits a cycle's winners as one sequenced "
+                "critical section"
+            )
         enc = self.cache.encoder
         prof = self.config.profile
         if prof is not None:
@@ -638,12 +708,20 @@ class Scheduler:
         # with it on or off, pinned by test); note that selecting the
         # sequential engine is itself semantics-preserving but can
         # rotate argmax TIES differently than the speculative engine.
-        self._schedule_fn = make_sequential_scheduler(
-            **engine_kw, attribution=self.config.attribution
-        )
-        self._preempt_eval = make_preempt_eval(
-            self.config.filter_config, self._unsched_key
-        )
+        # replica siblings REUSE the first replica's compiled engines
+        # (jitted callables are pure + thread-safe; N replicas paying N
+        # identical XLA compiles would dwarf the scale-out win)
+        self._shared_engines = share_engines_with is not None
+        if self._shared_engines:
+            self._schedule_fn = share_engines_with._schedule_fn
+            self._preempt_eval = share_engines_with._preempt_eval
+        else:
+            self._schedule_fn = make_sequential_scheduler(
+                **engine_kw, attribution=self.config.attribution
+            )
+            self._preempt_eval = make_preempt_eval(
+                self.config.filter_config, self._unsched_key
+            )
         # multi-chip sharding (config.shard_devices/mesh_shape): build the
         # node-axis Mesh ONCE at startup; every snapshot upload and engine
         # launch then carries NamedShardings and XLA inserts the
@@ -709,7 +787,12 @@ class Scheduler:
             mesh=self.mesh, spec_axis=mesh_spec_axis
         )
         m.MESH_WIDTH.set(float(self.mesh.size if self.mesh is not None else 0))
-        if self.config.engine == "speculative" and not self.config.attribution:
+        if self._shared_engines:
+            self._speculative_fn = share_engines_with._speculative_fn
+        elif (
+            self.config.engine == "speculative"
+            and not self.config.attribution
+        ):
             from kubernetes_tpu.models.speculative import (
                 make_speculative_scheduler,
             )
@@ -729,7 +812,9 @@ class Scheduler:
         # construction.  Attribution cycles stay single (the per-pod
         # attribution pytree is a single-batch output shape).
         self._mega_fn = None
-        if self.config.megacycle_batches > 1:
+        if self._shared_engines:
+            self._mega_fn = share_engines_with._mega_fn
+        elif self.config.megacycle_batches > 1:
             if self.config.attribution:
                 klog.infof(
                     "megacycleBatches=%d ignored: attribution cycles "
@@ -843,7 +928,7 @@ class Scheduler:
             self.ledger = ledger_mod.DecisionLedger(
                 path=path, max_cycles=self.config.ledger_max_cycles
             )
-            ledger_mod.set_default(self.ledger)
+            ledger_mod.set_default(self.ledger, replica=self._replica_id)
         if self.ledger is not None:
             self.ledger.ensure_meta(self._engine_meta())
         # cluster + device telemetry (ISSUE 8): analytics side-launches,
@@ -861,7 +946,7 @@ class Scheduler:
                 ),
                 postmortem=self._postmortem,
             )
-            telemetry_mod.set_default(self.telemetry)
+            telemetry_mod.set_default(self.telemetry, replica=self._replica_id)
         # performance observatory (ISSUE 11, runtime/perfobs.py):
         # host/device time attribution per cycle, the phase x width
         # EWMA cost matrix, per-cycle transfer deltas, and the
@@ -873,7 +958,7 @@ class Scheduler:
         self.perfobs = perfobs_mod.PerfObservatory(
             profile_dir=self.config.profile_dir
         )
-        perfobs_mod.set_default(self.perfobs)
+        perfobs_mod.set_default(self.perfobs, replica=self._replica_id)
         # placement-quality observatory (ISSUE 13, runtime/quality.py):
         # per-decision margin/feasible records off the engines' in-launch
         # top-k, amortized FFD-counterfactual regret, dual-window
@@ -890,7 +975,7 @@ class Scheduler:
                 postmortem=self._postmortem,
                 drift_threshold=self.config.quality_drift_threshold,
             )
-            quality_mod.set_default(self.quality)
+            quality_mod.set_default(self.quality, replica=self._replica_id)
         # shed watermark (per-cycle deltas feed the goodput SLO) +
         # heartbeat clock + liveness totals (heartbeat line + bench)
         self._shed_seen = 0
@@ -899,6 +984,23 @@ class Scheduler:
         self.results: List[ScheduleResult] = []
         # (preemptor key, node name, victim keys) per successful preemption
         self.preemptions: List[Tuple[Tuple[str, str], str, List[Tuple[str, str]]]] = []
+        # per-namespace placement quotas (ISSUE 14): seed the encoder's
+        # quota columns before the first commit can consult them
+        for ns, q in (self.config.namespace_quotas or {}).items():
+            enc.set_namespace_quota(ns, q)
+        # replica registry (ISSUE 14): GET /debug/replicas rolls every
+        # registered scheduler into the process aggregate — the explicit
+        # cross-replica roll-up next to the per-replica default installs
+        from kubernetes_tpu.runtime import reconciler as reconciler_mod
+
+        reconciler_mod.register_scheduler(self)
+        m.REPLICAS.set(float(self._replica_of))
+
+    def attach_hub(self, hub) -> None:
+        """Late-bind the shared SnapshotHub (the ReplicaSet builds the
+        hub FROM replica 0's DeviceSnapshotCache, then attaches it).
+        Only valid before any cycle dispatched."""
+        self._hub = hub
 
     def _engine_meta(self) -> dict:
         """The ledger header: everything a fresh process needs to rebuild
@@ -1045,6 +1147,32 @@ class Scheduler:
                 if hasattr(self.queue, "express_depth") else None
             ),
         }
+
+    # ------------------------------------------ resident-snapshot seams
+    #
+    # Every touch of the resident device snapshot goes through these
+    # three, so replica mode (ISSUE 14) can swap in the SHARED
+    # SnapshotHub without the call sites caring: the hub re-snapshots
+    # under the cache lock on every update (a retry can therefore never
+    # scatter stale rows over a sibling replica's newer upload), while
+    # classic mode keeps this instance's own DeviceSnapshotCache and
+    # its incremental dirty-row contract bit-for-bit.
+
+    def _device_update(self, cluster, dirty_rows):
+        if self._hub is not None:
+            return self._hub.refresh()[2]
+        return self._dev_snapshot.update(cluster, dirty_rows=dirty_rows)
+
+    def _device_invalidate(self) -> None:
+        if self._hub is not None:
+            self._hub.invalidate()
+        else:
+            self._dev_snapshot.invalidate()
+
+    def _device_resident(self, fields):
+        if self._hub is not None:
+            return self._hub.resident(fields)
+        return self._dev_snapshot.resident(fields)
 
     # ----------------------------------------------- device-fault handling
 
@@ -1434,7 +1562,7 @@ class Scheduler:
             m.FAULT_RETRIES.inc(**{"class": fc})
             time.sleep(self.device_health.backoff_s(attempt))
             return True
-        self._dev_snapshot.invalidate()
+        self._device_invalidate()
         return False
 
     def _commit_state_resilient(self, inf: _InFlight) -> _Staged:
@@ -1560,8 +1688,14 @@ class Scheduler:
             # rows the incremental snapshot refreshed: lets the device
             # cache scatter-update just those rows instead of re-shipping
             # whole tensors (codec/transfer.py); taken under the lock so
-            # the row set corresponds exactly to THIS snapshot
-            dirty_rows = enc.take_dirty_rows() if use_device else None
+            # the row set corresponds exactly to THIS snapshot.  In hub
+            # mode the dirty stream's SINGLE consumer is the hub itself
+            # (its refresh() takes under the cache lock) — a replica
+            # taking here would starve its siblings' resident state.
+            dirty_rows = (
+                enc.take_dirty_rows()
+                if use_device and self._hub is None else None
+            )
             # ports + anti-affinity contributions of nominated pods (the
             # non-resource half of podFitsOnNode's pass one) as a host
             # mask folded into extra_mask below
@@ -1620,19 +1754,35 @@ class Scheduler:
         if self._speculative_fn is not None:
             fn = self._speculative_fn
         last_index0 = self._last_index
+        # launch-state box (ISSUE 14): hub mode re-snapshots at every
+        # (re-)dispatch, so the cluster the engine ACTUALLY consumed —
+        # the one the ledger must record and the generation the
+        # reconciler's fast path fences on — is written here by launch()
+        launch_box = {"cluster": cluster, "generation": generation,
+                      "ledger": None}
 
         def launch():
             """(Re-)dispatch THIS encoded batch on the device.  Captured
             by _InFlight.relaunch so the transient-retry path re-runs the
             same computation with the same rotation base; dirty_rows are
             re-passed safely — fields whose upload already landed identity-
-            skip, fields whose upload faulted re-scatter."""
+            skip, fields whose upload faulted re-scatter.  Hub mode
+            (shared resident snapshot) refreshes to the CURRENT cache
+            truth instead: replicas dispatch against the newest resident
+            generation, and a retry can never scatter stale rows over a
+            sibling's newer upload."""
             device_faults.check(
                 device_faults.SITE_DISPATCH, devices=self._mesh_ids
             )
-            dev_cluster = self._dev_snapshot.update(
-                cluster, dirty_rows=dirty_rows
-            )
+            if self._hub is not None:
+                c2, g2, dev_cluster = self._hub.refresh()
+                launch_box["cluster"], launch_box["generation"] = c2, g2
+                if launch_box["ledger"] is not None:
+                    launch_box["ledger"]["cluster"] = c2
+            else:
+                dev_cluster = self._dev_snapshot.update(
+                    cluster, dirty_rows=dirty_rows
+                )
             out = fn(
                 dev_cluster, batch, ports,
                 np.int32(last_index0), nominated,
@@ -1672,13 +1822,27 @@ class Scheduler:
             scheduling thread; the pipelined loop commits batch k's state
             before dispatching k+1)."""
             t0 = time.monotonic()
-            hosts = self.cpu_engine.schedule_batch(
-                pods, last_index0,
-                extra_mask=extra_mask, extra_score=extra_score,
-                nominated=nominated_pairs,
-                masked=frozenset(ext_failed),
-                row_map=node_row_map,
-            )
+            if self._hub is not None:
+                # degraded replica cycle: the adapter reads the LIVE
+                # cache, which sibling replicas mutate concurrently —
+                # serialize the host compute under the cache lock (the
+                # reconciler still re-checks its verdicts at commit)
+                with self.cache._lock:
+                    hosts = self.cpu_engine.schedule_batch(
+                        pods, last_index0,
+                        extra_mask=extra_mask, extra_score=extra_score,
+                        nominated=nominated_pairs,
+                        masked=frozenset(ext_failed),
+                        row_map=node_row_map,
+                    )
+            else:
+                hosts = self.cpu_engine.schedule_batch(
+                    pods, last_index0,
+                    extra_mask=extra_mask, extra_score=extra_score,
+                    nominated=nominated_pairs,
+                    masked=frozenset(ext_failed),
+                    row_map=node_row_map,
+                )
             return _HostResult(hosts, seconds=time.monotonic() - t0)
 
         degraded = False
@@ -1712,9 +1876,14 @@ class Scheduler:
         )
         t_disp_end = time.monotonic()
         self._phase("dispatch", t_disp_end - t_disp, tier)
+        # hub mode: the launch refreshed to the newest resident state —
+        # inf carries the generation/cluster the engine ACTUALLY saw
+        # (the reconciler's fast-path fence and the ledger's truth)
+        cluster_used = launch_box["cluster"]
         inf = _InFlight(
             pods=list(pods), hosts_dev=hosts_dev, fetch=fetch,
-            generation=generation, cycle=cycle, ext_failed=ext_failed,
+            generation=launch_box["generation"], cycle=cycle,
+            ext_failed=ext_failed,
             pc=pc, t_cycle0=t_cycle0, trace=trace,
             relaunch=None if degraded else launch,
             cpu_fetch=cpu_fetch, degraded=degraded,
@@ -1724,28 +1893,36 @@ class Scheduler:
                 batch.req if self.quality is not None else None
             ),
             quality_snapshot=(
-                (cluster.allocatable, cluster.requested, cluster.valid)
+                (cluster_used.allocatable, cluster_used.requested,
+                 cluster_used.valid)
                 if self.quality is not None else None
             ),
             telemetry_host=(
-                (cluster.allocatable, cluster.requested, cluster.valid)
+                (cluster_used.allocatable, cluster_used.requested,
+                 cluster_used.valid)
                 if self.telemetry is not None else None
             ),
             width=batch.n_pods,
             enqueue_s=t_disp_end - t_cycle0,
             xfer0=xfer0,
+            reqs=batch.req if self._reconciler is not None else None,
         )
+        if self._replica_of > 1:
+            trace.annotate(replica=self._replica_id)
         if self.ledger is not None:
             # the exact launch inputs, stashed for the off-hot-path
             # ledger write after the commit tail (the snapshot arrays are
             # immutable by the encoder's dirty-row contract, so handing
-            # references to the writer thread is safe)
+            # references to the writer thread is safe).  Registered in
+            # the launch box so a hub-mode retry re-points the recorded
+            # cluster at the snapshot the retry actually consumed.
             inf.ledger_inputs = dict(
-                cluster=cluster, batch=batch, ports=ports,
+                cluster=cluster_used, batch=batch, ports=ports,
                 nominated=nominated, aff_state=aff_state,
                 extra_mask=extra_mask, extra_score=extra_score,
                 last_index0=last_index0,
             )
+            launch_box["ledger"] = inf.ledger_inputs
         return inf
 
     def _launch_resilient(self, launch):
@@ -1856,8 +2033,12 @@ class Scheduler:
             self.config.megacycle_batches,
         )
         t_pop = time.monotonic()
+        mega_pop_kw = (
+            {"shard": self._replica_id, "of": self._replica_of}
+            if self._replica_of > 1 else {}
+        )
         while len(windows) < k_target:
-            w = self.queue.pop_batch(width, 0.0, 0.0)
+            w = self.queue.pop_batch(width, 0.0, 0.0, **mega_pop_kw)
             if not w:
                 break
             if self.invariants is not None:
@@ -1919,7 +2100,12 @@ class Scheduler:
                 batches = [enc.encode_pods(w) for w in windows]
             ports = [encode_batch_ports(enc, w) for w in windows]
             cluster, generation = self.cache.snapshot()
-            dirty_rows = enc.take_dirty_rows() if use_device else None
+            # hub mode: the hub is the dirty stream's single consumer
+            # (see _encode_and_dispatch)
+            dirty_rows = (
+                enc.take_dirty_rows()
+                if use_device and self._hub is None else None
+            )
             node_row_map = dict(enc.node_rows)
         enc_span.finish()
         # per-sub-batch rotation bases: base + cumulative RAW pod counts,
@@ -1936,14 +2122,22 @@ class Scheduler:
         t_disp = time.monotonic()
         self._phase("encode", t_disp - t_cycle0)
         mega_fn = self._mega_fn
+        launch_box = {"cluster": cluster, "generation": generation,
+                      "ledger": None}
 
         def launch():
             device_faults.check(
                 device_faults.SITE_DISPATCH, devices=self._mesh_ids
             )
-            dev_cluster = self._dev_snapshot.update(
-                cluster, dirty_rows=dirty_rows
-            )
+            if self._hub is not None:
+                c2, g2, dev_cluster = self._hub.refresh()
+                launch_box["cluster"], launch_box["generation"] = c2, g2
+                if launch_box["ledger"] is not None:
+                    launch_box["ledger"]["cluster"] = c2
+            else:
+                dev_cluster = self._dev_snapshot.update(
+                    cluster, dirty_rows=dirty_rows
+                )
             out = mega_fn(dev_cluster, batch_k, ports_k, li0_arr)
             hosts = out[0]
             qual = out[2] if self._quality_k else None
@@ -1985,16 +2179,28 @@ class Scheduler:
 
             def cpu_fetch(pods=w, base=li0[k], rows=node_row_map):
                 t0 = time.monotonic()
-                hosts = self.cpu_engine.schedule_batch(
-                    pods, base,
-                    extra_mask=None, extra_score=None,
-                    nominated=[], masked=frozenset(), row_map=rows,
-                )
+                if self._hub is not None:
+                    # degraded replica window: serialize the live-cache
+                    # read against sibling commits (see the single-cycle
+                    # cpu_fetch)
+                    with self.cache._lock:
+                        hosts = self.cpu_engine.schedule_batch(
+                            pods, base,
+                            extra_mask=None, extra_score=None,
+                            nominated=[], masked=frozenset(), row_map=rows,
+                        )
+                else:
+                    hosts = self.cpu_engine.schedule_batch(
+                        pods, base,
+                        extra_mask=None, extra_score=None,
+                        nominated=[], masked=frozenset(), row_map=rows,
+                    )
                 return _HostResult(hosts, seconds=time.monotonic() - t0)
 
             inf = _InFlight(
                 pods=list(w), hosts_dev=None, fetch=None,
-                generation=generation, cycle=cycles[k], ext_failed={},
+                generation=launch_box["generation"], cycle=cycles[k],
+                ext_failed={},
                 pc=None, t_cycle0=t_cycle0, trace=spans[k],
                 relaunch=None, cpu_fetch=cpu_fetch,
                 degraded=degraded_dispatch, last_index0=li0[k],
@@ -2018,19 +2224,27 @@ class Scheduler:
                 enqueue_s=(t_disp_end - t_cycle0) / K,
                 xfer0=xfer0 if k == 0 else None,
                 mega=(k, K),
+                reqs=(
+                    batches[k].req if self._reconciler is not None
+                    else None
+                ),
             )
+            if self._replica_of > 1:
+                spans[k].annotate(replica=self._replica_id)
             if self.ledger is not None:
                 # sub-batch k > 0 replays against the host snapshot taken
                 # AFTER sub-batch k-1's state commit (patched in at the
                 # commit loop) — the host-side twin of the device chain,
                 # so every block replays through the single-batch engine
                 inf.ledger_inputs = dict(
-                    cluster=cluster if k == 0 else None,
+                    cluster=launch_box["cluster"] if k == 0 else None,
                     batch=batches[k], ports=ports[k],
                     nominated=None, aff_state=None,
                     extra_mask=None, extra_score=None,
                     last_index0=li0[k],
                 )
+                if k == 0:
+                    launch_box["ledger"] = inf.ledger_inputs
             infs.append(inf)
         self.megacycles_total += 1
         m.MEGACYCLES.inc()
@@ -2131,8 +2345,16 @@ class Scheduler:
             self.shard_health.heal(self._mesh_ids)
         self._phase("host_stall", stall)
         f = mf.fetch
+        prev_gen = -1
         for k, inf in enumerate(mf.windows):
             self._stage_mega_window(inf, None)
+            if k > 0 and prev_gen >= 0:
+                # chained fence (ISSUE 14): window k placed against the
+                # state window k-1's commit produced — if no sibling
+                # replica interleaved since, the zero-conflict fast path
+                # still applies (commits only ever make real usage <=
+                # what the on-device chain assumed, so verdicts hold)
+                inf.generation = prev_gen
             if qual_all is not None:
                 # slice sub-batch k's already-host quality rows; the
                 # fence's materialize in _commit_state is then a no-op
@@ -2148,6 +2370,7 @@ class Scheduler:
                 ),
             )
             st = self._commit_state(inf)
+            prev_gen = inf.gen_after
             if k == 0:
                 st.stall_s += stall
             staged.append(st)
@@ -2349,6 +2572,44 @@ class Scheduler:
             assumed = copy.copy(pod)
             assumed.spec = spec
             winners.append((i, pod, assumed, node_name))
+        if self._reconciler is not None:
+            # SEQUENCED optimistic-concurrency commit (ISSUE 14): the
+            # admission scan and the assume run as ONE critical section
+            # under the cache lock, so the headroom the scan read is
+            # exactly the headroom the delta lands on.  Race losers
+            # readd to their owner shard in the tail; quota losers park
+            # unschedulable.  Zero-conflict cycles (generation fence
+            # unchanged, no quotas) admit with one integer comparison.
+            with self.cache._lock:
+                kept, race_lost, quota_lost = self._reconciler.reconcile(
+                    self, inf, winners, hosts
+                )
+                if kept is not winners:
+                    staged.winners = winners = list(kept)
+                staged.race_lost = race_lost
+                staged.quota_lost = quota_lost
+                self.cache.assume_pods([a for _, _, a, _ in winners])
+                inf.gen_after = enc.generation
+                if self.invariants is not None and winners:
+                    rows = sorted(
+                        {int(hosts[i]) for i, _, _, _ in winners}
+                    )
+                    self.invariants.check_capacity(
+                        rows, enc.a_requested, enc.a_allocatable,
+                        row_name=enc.row_name,
+                    )
+            if race_lost or quota_lost:
+                self.conflicts_total += len(race_lost)
+                self.race_requeued_total += len(race_lost)
+                self.quota_vetoed_total += len(quota_lost)
+                inf.trace.annotate(
+                    conflicts=len(race_lost), quota_vetoed=len(quota_lost)
+                )
+            staged.state_seconds = time.monotonic() - t_state0
+            inf.trace.add_child(
+                "commit", t_state0, time.monotonic(), winners=len(winners),
+            )
+            return staged
         # ONE lock acquisition + one encoder delta for the whole batch
         self.cache.assume_pods([a for _, _, a, _ in winners])
         if self.invariants is not None and winners:
@@ -2552,7 +2813,7 @@ class Scheduler:
 
         resident = (
             None if inf.degraded
-            else self._dev_snapshot.resident(ANALYTICS_FIELDS)
+            else self._device_resident(ANALYTICS_FIELDS)
         )
         hub.on_cycle(
             cycle=inf.cycle,
@@ -2609,6 +2870,15 @@ class Scheduler:
             # of K replayable blocks (each against the host snapshot its
             # predecessors' commits produced)
             **({"mega": list(inf.mega)} if inf.mega is not None else {}),
+            # queue-sharded replicas (ISSUE 14): which replica dispatched
+            # this cycle, and its reconciler commit sequence number —
+            # cross-replica replay stays deterministic because every
+            # block carries the exact snapshot its launch consumed, and
+            # the sequence orders the interleaving for audit
+            "replica": self._replica_id,
+            **(
+                {"seq": inf.commit_seq} if inf.commit_seq >= 0 else {}
+            ),
             # quality top-k (ISSUE 13): the winner-pinned ranking rides
             # the block so bench --replay recomputes margins offline
             **(
@@ -2789,6 +3059,31 @@ class Scheduler:
                 EVENT_TYPE_WARNING, "FailedScheduling",
                 "extender error: %s" % msg, tid,
             )
+        # optimistic-concurrency losers (ISSUE 14): a sequenced-earlier
+        # replica commit spent this pod's node headroom — requeue it to
+        # its OWNER SHARD via readd (active queue, shed-exempt: no
+        # popped pod is ever lost), not the unschedulable parking lot
+        # (the pod fits elsewhere; it lost a race, not a FitError)
+        for i, pod in staged.race_lost:
+            results[i] = ScheduleResult(pod, None, generation)
+            events[i] = (
+                "Pod", pod.namespace, pod.name,
+                EVENT_TYPE_NORMAL, "PlacementConflict",
+                "lost optimistic concurrency race for node headroom; "
+                "requeued", tid,
+            )
+        # namespace-quota vetoes park unschedulable WITH backoff: the
+        # quota stays full until something terminates, and spinning the
+        # pod through the active queue would starve its shard
+        for i, pod in staged.quota_lost:
+            results[i] = ScheduleResult(pod, None, generation)
+            losers.append(pod)
+            events[i] = (
+                "Pod", pod.namespace, pod.name,
+                EVENT_TYPE_WARNING, "QuotaExceeded",
+                "namespace %s placement quota exhausted" % pod.namespace,
+                tid,
+            )
         # enqueue stamps BEFORE the bind fan-out: a bind's informer echo
         # (bound-pod update -> queue.delete) races a later take and would
         # drop the queue wait from the e2e histogram; failed binds restore
@@ -2845,6 +3140,12 @@ class Scheduler:
                 )
         # batched bookkeeping: one lock acquisition per structure
         self.queue.add_unschedulable_batch(losers, cycle)
+        for _, pod in staged.race_lost:
+            self.queue.readd(pod)
+        if staged.quota_lost:
+            m.SCHEDULE_ATTEMPTS.inc(
+                len(staged.quota_lost), result=m.UNSCHEDULABLE
+            )
         if bound and self.queue.has_nominated():
             self.queue.delete_nominated_batch([p for _, p, _ in bound])
         m.BINDING_LATENCY.observe_batch(bind_dts)
@@ -3156,7 +3457,10 @@ class Scheduler:
                 return None
             batch = enc.encode_pods([pod])
             cluster, _ = self.cache.snapshot()
-            dirty_rows = enc.take_dirty_rows() if use_device else None
+            dirty_rows = (
+                enc.take_dirty_rows()
+                if use_device and self._hub is None else None
+            )
         # device work OUTSIDE the cache lock: a first-shape preempt pays a
         # multi-second XLA compile, and informer/event threads must not
         # stall on the lock for it.  The snapshot is a point-in-time copy;
@@ -3179,9 +3483,7 @@ class Scheduler:
         # DeviceSnapshotCache (and its own dirty-row take stream).
         if use_device:
             try:
-                cluster = self._dev_snapshot.update(
-                    cluster, dirty_rows=dirty_rows
-                )
+                cluster = self._device_update(cluster, dirty_rows)
                 if jax.default_backend() != "cpu":
                     if self.mesh is not None:
                         from kubernetes_tpu.parallel.mesh import replicate
@@ -3202,7 +3504,7 @@ class Scheduler:
                 self._note_device_fault(fc, e, "preempt")
                 if not self._note_shard_fault(self._shard_of(e), fc):
                     self.device_health.record_failure(fc)
-                    self._dev_snapshot.invalidate()
+                    self._device_invalidate()
                 if not self.config.cpu_fallback:
                     raise
                 cands = self.cpu_engine.preempt_candidates(
@@ -3439,7 +3741,8 @@ class Scheduler:
             "heartbeat: cycles=%d placed=%d unschedulable=%d depth=%d "
             "active=%d express=%d breaker=%s batch=%d hbm_bytes=%d "
             "mesh=%d rung=%s shards_lost=%d invariant_violations=%d "
-            "host_ms=%d dev_ms=%d xfer_top=%s margin=%.4f regret=%.2f",
+            "host_ms=%d dev_ms=%d xfer_top=%s margin=%.4f regret=%.2f "
+            "replicas=%d conflicts=%d",
             q.scheduling_cycle,
             self._outcome_totals["placed"],
             self._outcome_totals["unschedulable"],
@@ -3454,6 +3757,7 @@ class Scheduler:
             ),
             int(host_ms), int(dev_ms), xfer_top,
             q_margin, q_regret,
+            self._replica_of, self.conflicts_total,
         )
 
     def prewarm(self, widths: Optional[Sequence[int]] = None,
@@ -3537,10 +3841,10 @@ class Scheduler:
                 batch = enc.encode_pods(pods)
                 ports = encode_batch_ports(enc, pods)
                 cluster, _ = self.cache.snapshot()
-                dirty_rows = enc.take_dirty_rows()
-            dev_cluster = self._dev_snapshot.update(
-                cluster, dirty_rows=dirty_rows
-            )
+                dirty_rows = (
+                    enc.take_dirty_rows() if self._hub is None else None
+                )
+            dev_cluster = self._device_update(cluster, dirty_rows)
             B, N = batch.n_pods, cluster.n_nodes
             extra_mask = np.ones((B, N), bool) if want_mask else None
             extra_score = (
@@ -3582,9 +3886,12 @@ class Scheduler:
                             encode_batch_ports(enc, ws) for ws in wins
                         ]
                         cluster, _ = self.cache.snapshot()
-                        dirty_rows = enc.take_dirty_rows()
-                    dev_cluster = self._dev_snapshot.update(
-                        cluster, dirty_rows=dirty_rows
+                        dirty_rows = (
+                            enc.take_dirty_rows()
+                            if self._hub is None else None
+                        )
+                    dev_cluster = self._device_update(
+                        cluster, dirty_rows
                     )
                     li0 = np.arange(K, dtype=np.int32) * w + np.int32(
                         self._last_index
@@ -3690,6 +3997,12 @@ class Scheduler:
             if express and hasattr(self.queue, "pop_express_batch")
             else {}
         )
+        if self._replica_of > 1:
+            # queue-sharded replica (ISSUE 14): drain only this
+            # replica's stable hash-shard — pops are disjoint across
+            # replicas by construction, and every requeue of a popped
+            # pod lands back on this shard
+            pop_kw.update(shard=self._replica_id, of=self._replica_of)
         pods = self.queue.pop_batch(
             # adaptive mode pops at the CURRENT AIMD width; static mode
             # keeps the configured batch size
@@ -3750,6 +4063,12 @@ class Scheduler:
                 self.device_health.device_available
                 or not self.config.cpu_fallback
             )
+            # replica mode demotes gangs to plain pods (no atomicity):
+            # the gang launch snapshots and commits outside the
+            # sequenced reconciler section, so its claims could race a
+            # sibling's — same liveness-over-atomicity policy as the
+            # extender/breaker demotions above
+            and self._replica_of == 1
         )
         plain = [p for p in pods
                  if not gang_eligible or self.POD_GROUP_LABEL not in p.labels]
@@ -3821,7 +4140,7 @@ class Scheduler:
                 self._note_device_fault(fc, e, "gang")
                 if not self._note_shard_fault(self._shard_of(e), fc):
                     self.device_health.record_failure(fc)
-                    self._dev_snapshot.invalidate()
+                    self._device_invalidate()
                 plain = plain + unplaced
                 gangs, results = [], []
             for (group, members), (nodes, placed) in zip(gangs, results):

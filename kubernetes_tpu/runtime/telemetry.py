@@ -601,6 +601,22 @@ def get_default() -> TelemetryHub:
     return HUB
 
 
-def set_default(hub: TelemetryHub) -> None:
+# per-replica installs (ISSUE 14 satellite): with N scheduler replicas
+# in one process, "install as the default" was last-writer-wins — the
+# surviving default misattributed every other replica's cycles.  Each
+# scheduler now installs under its replica id; replica 0 stays THE
+# process default (/debug/cluster primary payload, single-scheduler
+# behavior unchanged), and /debug/replicas rolls all of them up.
+_REPLICAS: dict = {}
+
+
+def set_default(hub: TelemetryHub, replica: int = 0) -> None:
     global HUB
-    HUB = hub
+    _REPLICAS[int(replica)] = hub
+    if int(replica) == 0:
+        HUB = hub
+
+
+def replica_instances() -> dict:
+    """{replica id: TelemetryHub} of every install this process saw."""
+    return dict(sorted(_REPLICAS.items()))

@@ -892,5 +892,32 @@ QUALITY_SECONDS = REGISTRY.register(
     )
 )
 
+# --- queue-sharded scheduler replicas (ISSUE 14) ---
+REPLICAS = REGISTRY.register(
+    Gauge(
+        "scheduler_replicas",
+        "Scheduler replicas sharing this process's queue/cache (1 = the "
+        "classic single scheduling loop)",
+    )
+)
+REPLICA_CONFLICTS = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_replica_conflicts_total",
+        "Optimistic-concurrency commit conflicts detected by the "
+        "sequenced reconciler, per dispatching replica: a sequenced-"
+        "earlier commit spent the winner's node headroom, so the pod "
+        "was requeued to its owner shard",
+        ("replica",),
+    )
+)
+REPLICA_REQUEUED = REGISTRY.register(
+    Counter(
+        "scheduler_replica_requeued_pods_total",
+        "Pods the conflict reconciler requeued instead of admitting "
+        "(race losers back to the owner shard + namespace-quota vetoes "
+        "parked unschedulable) — shed-exempt, no popped pod is lost",
+    )
+)
+
 # schedule_attempts_total result label values (metrics.go:44-52)
 SCHEDULED, UNSCHEDULABLE, SCHEDULE_ERROR = "scheduled", "unschedulable", "error"

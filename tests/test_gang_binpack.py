@@ -292,7 +292,8 @@ def test_run_once_routes_pod_groups_through_gang_path():
     # the failed gang parked (unschedulable or backoff), not lost
     parked = (len(sched.queue._unschedulable)
               + sum(1 for e in sched.queue._backoffq if e[3])
-              + sum(1 for e in sched.queue._active if e[3]))
+              # _active is a list of per-shard heaps (ISSUE 14)
+              + sum(1 for h in sched.queue._active for e in h if e[3]))
     assert parked == 3
 
 
