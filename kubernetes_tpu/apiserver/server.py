@@ -978,6 +978,24 @@ class APIServer:
                         ct="application/json",
                     )
                     return
+                if self.path.partition("?")[0] == "/debug/capacity":
+                    # the capacity planner (runtime/capacity.py): the
+                    # class-compressed backlog what-if's scale-up/
+                    # scale-down recommendation — in embedded
+                    # deployments the scheduling happens in this
+                    # process, so its planner is the process default.
+                    # Inflight-exempt like its siblings
+                    from kubernetes_tpu.runtime import capacity
+                    from kubernetes_tpu.runtime.ledger import debug_body
+
+                    self._send_text(
+                        debug_body(
+                            capacity.get_default().debug_payload,
+                            self.path.partition("?")[2],
+                        ),
+                        ct="application/json",
+                    )
+                    return
                 if self.path.partition("?")[0] == "/debug/replicas":
                     # queue-sharded replicas (ISSUE 14): the explicit
                     # process aggregate — per-replica cycle/conflict

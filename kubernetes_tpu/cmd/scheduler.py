@@ -176,6 +176,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="JSON {namespace: {resource: quantity}} "
                    "placement quotas enforced by the reconciler at "
                    "commit (config namespaceQuotas)")
+    p.add_argument("--capacity-planner", action="store_true",
+                   default=None,
+                   help="enable the device-resident capacity planner "
+                   "(config capacityPlanner): class-compressed what-if "
+                   "binpack of the pending backlog over the node-shape "
+                   "catalog, served at /debug/capacity")
+    p.add_argument("--capacity-interval-cycles", type=int, default=None,
+                   help="committed cycles between capacity solves "
+                   "(config capacityIntervalCycles; default 256)")
+    p.add_argument("--node-shape-catalog", default=None,
+                   help="candidate node shapes for the capacity "
+                   "planner: inline JSON list or a path to a JSON file "
+                   "([{name, cpu, memory, ephemeral-storage?, pods?, "
+                   "...}]; config nodeShapeCatalog).  Implies "
+                   "--capacity-planner")
     p.add_argument("--simulate-nodes", type=int, default=0,
                    help="register N hollow nodes")
     p.add_argument("--simulate-pods", type=int, default=0,
@@ -255,6 +270,18 @@ def main(argv=None) -> int:
         cc.replicas = args.replicas
     if args.namespace_quotas is not None:
         cc.namespace_quotas = json.loads(args.namespace_quotas)
+    if args.capacity_planner is not None:
+        cc.capacity_planner = args.capacity_planner
+    if args.capacity_interval_cycles is not None:
+        cc.capacity_interval_cycles = args.capacity_interval_cycles
+    if args.node_shape_catalog is not None:
+        raw = args.node_shape_catalog
+        if raw.lstrip().startswith("["):
+            cc.node_shape_catalog = json.loads(raw)
+        else:
+            with open(raw) as f:
+                cc.node_shape_catalog = json.load(f)
+        cc.capacity_planner = True  # a catalog implies the planner
 
     # persistent compile cache BEFORE any jit compile (engine build,
     # prewarm, first cycle) so every executable of this process is served

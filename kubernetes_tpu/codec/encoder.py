@@ -638,6 +638,41 @@ class SnapshotEncoder:
         v[RES_PODS] = 1.0
         return v
 
+    # --------------------------------------- read-only accessors (ISSUE 15)
+
+    def res_col_readonly(self, name: str) -> "Optional[int]":
+        """Resource name -> column index WITHOUT interning: core columns
+        map directly, extended resources resolve only if some committed
+        pod/node already established them, else None.  The capacity
+        planner's catalog encoder routes through here — a side
+        observer must never grow dims.R or dirty the arena."""
+        if name == RESOURCE_CPU:
+            return RES_MILLICPU
+        if name == RESOURCE_MEMORY:
+            return RES_MEMORY
+        if name == RESOURCE_EPHEMERAL_STORAGE:
+            return RES_EPHEMERAL
+        if name == RESOURCE_PODS:
+            return RES_PODS
+        return self._res_cols.get(name)
+
+    def backlog_req_vector(self, pod: Pod) -> np.ndarray:
+        """READ-ONLY f32[R] request vector for a NOT-YET-PLACED pod (the
+        capacity planner's backlog encoding): same column layout and
+        units as _req_vector, but unknown extended resources are
+        dropped instead of growing the resource axis — encoding a
+        backlog must not mutate the arena, mark rows dirty, or perturb
+        the interner (placement bit-identity planner on/off rides on
+        this)."""
+        v = np.zeros(self.dims.R, np.float32)
+        for name, q in pod.resource_request().items():
+            col = self.res_col_readonly(name)
+            if col is None:
+                continue
+            v[col] = q.milli if name == RESOURCE_CPU else float(q)
+        v[RES_PODS] = 1.0
+        return v
+
     # ----------------------------------------------------------------- nodes
 
     def add_node(self, node: Node) -> int:

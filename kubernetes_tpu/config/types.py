@@ -149,6 +149,16 @@ class KubeSchedulerConfiguration:
     quality_top_k: int = 3
     quality_interval_cycles: int = 32
     quality_drift_threshold: float = 0.25
+    # device-resident capacity planner (runtime/capacity.py): every
+    # capacityIntervalCycles the pending+unschedulable backlog is
+    # class-compressed and what-if binpacked — existing headroom first,
+    # the overflow over the nodeShapeCatalog ([{name, cpu, memory,
+    # ephemeral-storage?, pods?, ...}]; null = the built-in default) —
+    # as an amortized side-launch, emitting a scale-up/scale-down
+    # recommendation at /debug/capacity + scheduler_capacity_* metrics
+    capacity_planner: bool = False
+    capacity_interval_cycles: int = 256
+    node_shape_catalog: Optional[list] = None
     # queue-sharded scheduler replicas (runtime/replicas.py +
     # runtime/reconciler.py): run this many scheduler loops (threads)
     # over one queue/cache, each draining a stable hash-shard and
@@ -249,6 +259,11 @@ class KubeSchedulerConfiguration:
             quality_drift_threshold=float(
                 d.get("qualityDriftThreshold", 0.25)
             ),
+            capacity_planner=bool(d.get("capacityPlanner", False)),
+            capacity_interval_cycles=int(
+                d.get("capacityIntervalCycles", 256)
+            ),
+            node_shape_catalog=d.get("nodeShapeCatalog"),
             replicas=int(d.get("replicas", 1)),
             namespace_quotas=d.get("namespaceQuotas"),
         )

@@ -15,6 +15,31 @@ autoscaler estimator's algorithm), tensorized:
 Evaluating many candidate shapes is a vmap over the capacity vector — 10k
 shapes x 50k pods runs as one batched program, which is the whole point of
 doing this on a TPU instead of the autoscaler's Go loop.
+
+Class compression (ISSUE 15).  A per-pod scan is the wrong asymptotic
+shape for a 50k-pod backlog: real backlogs are controller-stamped, so
+the 50k request vectors collapse into a few hundred DISTINCT classes.
+`binpack_ffd_counts` packs (class, count) pairs instead — one scan step
+per class, and the step places the class's whole count across all bins
+in one vectorized shot: identical pods admit independently per bin
+(bin b takes a_b = floor(free_b / req) of them), so first-fit of a run
+of identical items is exactly the prefix-greedy fill
+n_b = clip(count - cumsum_excl(a), 0, a_b).  The scan axis shrinks from
+P pods to C classes (~2 orders of magnitude) while staying
+bins-needed-IDENTICAL to the per-pod reference:
+
+  * identical pods are interchangeable, and the composite `ffd_order`
+    key (dominant fraction, then the full per-resource fraction vector
+    lexicographically) totally orders DISTINCT vectors, so both paths
+    process classes in the same sequence;
+  * with INTEGER-VALUED requests/capacities below 2**24 (the planner
+    quantizes to per-resource quanta, runtime/capacity.py) every load,
+    admission and comparison is exact in both paths — the count kernel
+    does its admission arithmetic in int32 so a floor(rem/req) at an
+    exact integer boundary can never round across it.
+
+The identity is pinned by tests/test_capacity.py on randomized
+backlogs including the duplicate-heavy and all-distinct extremes.
 """
 
 from __future__ import annotations
@@ -24,6 +49,11 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# integer-exactness ceiling for the count-carrying kernel: all request /
+# capacity values must be integer-valued and strictly below this so f32
+# holds them exactly and int32 admission arithmetic cannot overflow
+INT_EXACT_LIMIT = float(2 ** 24)
 
 
 @partial(jax.jit, static_argnames=("max_bins",))
@@ -46,10 +76,11 @@ def binpack_ffd(pod_reqs, capacity, max_bins: int = 1024, order=None):
 
     placed[] is aligned to SCAN positions, not pod indices: placed[k]
     refers to pod order[k] when `order` is passed (with the default
-    identity order the two coincide).  Callers needing pod-indexed flags
-    must scatter back: out = np.empty(P, bool); out[order] = placed.
-    The in-tree caller (binpack_shapes) only reduces with jnp.all, which
-    is permutation-insensitive."""
+    identity order the two coincide).  Callers needing pod-indexed
+    flags must route through `placed_by_pod(placed, order)` — the
+    scatter-back helper that makes the alignment un-misreadable (and
+    length-checks the pair).  The in-tree sweep caller (binpack_shapes)
+    only reduces with jnp.all, which is permutation-insensitive."""
     cap = capacity if capacity.ndim == 2 else capacity[None, :]
 
     def step(loads, oi):
@@ -70,6 +101,112 @@ def binpack_ffd(pod_reqs, capacity, max_bins: int = 1024, order=None):
     return used.astype(jnp.int32), loads, placed
 
 
+def placed_by_pod(placed, order=None):
+    """Scatter binpack_ffd's scan-position-aligned `placed` flags back to
+    POD indices: out[p] says whether pod p (input-row p of pod_reqs) was
+    placed.  This is the documented `placed[k] refers to pod order[k]`
+    footgun made un-misreadable — callers that pass `order=` must route
+    through here (or reduce permutation-insensitively) before indexing
+    by pod.  With the default identity order the flags pass through
+    unchanged.  Works on the count kernel's placed-counts vector too
+    (same scan-position alignment, counts instead of bools)."""
+    placed = np.asarray(placed)
+    if order is None:
+        return placed.copy()
+    order = np.asarray(order)
+    if order.shape[0] != placed.shape[0]:
+        raise ValueError(
+            f"order length {order.shape[0]} != placed length "
+            f"{placed.shape[0]} (placed is scan-position aligned)"
+        )
+    out = np.empty_like(placed)
+    out[order] = placed
+    return out
+
+
+def ffd_order(reqs, capacity):
+    """THE first-fit-decreasing processing order, shared by the per-pod
+    and class-compressed paths so they stay bins-needed comparable.
+
+    Primary key: dominant fraction of `capacity` (the autoscaler
+    estimator's rule), descending.  Tie-break: the full per-resource
+    fraction vector, lexicographically descending — a TOTAL order over
+    distinct request vectors (two vectors differing in a column with
+    positive capacity differ in that column's fraction), so "equal
+    dominant share, different shape" classes can never interleave
+    differently between the two kernels.  Identical vectors tie and
+    keep input order (lexsort is stable) — they are interchangeable.
+    Traceable (jnp) and numpy-compatible."""
+    frac = reqs / jnp.maximum(capacity[None, :], 1e-30)
+    key = jnp.max(frac, axis=-1)
+    # lexsort: LAST key is primary; minor keys break dominant-share ties
+    # column by column
+    keys = tuple(-frac[:, r] for r in range(reqs.shape[1] - 1, -1, -1))
+    return jnp.lexsort(keys + (-key,)).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("max_bins",))
+def binpack_ffd_counts(class_reqs, counts, capacity, max_bins: int = 1024,
+                       order=None):
+    """Count-carrying first-fit binpack: pack `counts[c]` pods of each
+    distinct class `class_reqs` f32[C, R] — ONE scan step per class
+    instead of one per pod.
+
+    A step places the class's whole remaining count in one vectorized
+    shot: each bin's admission a_b = min over requested resources of
+    floor(free_b / req) is independent of its neighbours (identical
+    pods), so first-fit equals the prefix-greedy fill
+    n_b = clip(count - exclusive_cumsum(a), 0, a_b).  Bit-identical in
+    bins-needed to scanning the expanded per-pod list through
+    binpack_ffd in the same class order, PROVIDED requests and
+    capacities are integer-valued and < 2**24 (INT_EXACT_LIMIT): the
+    admission arithmetic runs in int32 (f32 division would round
+    floor(rem/req) across exact integer boundaries), and integer loads
+    bounded by capacity stay exact in f32 on both paths.
+
+    `capacity` is f32[R] (uniform bins — the shape what-if) or
+    f32[max_bins, R] (per-bin free capacities — packing a backlog into
+    existing headroom; a zero row is a full node).  `order` i32[C]
+    packs classes in that index order (default: identity).  Returns
+    (n_bins i32, loads f32[max_bins, R], placed_counts i32[C]).
+    placed_counts is aligned to SCAN positions like binpack_ffd's
+    placed (placed_counts[k] belongs to class order[k]; scatter back
+    via placed_by_pod).  Zero-request classes count as fully placed
+    (padding, matching binpack_ffd's `ok | ~real`)."""
+    cap = capacity if capacity.ndim == 2 else capacity[None, :]
+    cap_i = cap.astype(jnp.int32)
+    counts = counts.astype(jnp.int32)
+
+    def step(loads, oi):
+        req = class_reqs[oi]
+        req_i = req.astype(jnp.int32)
+        m = counts[oi]
+        real = jnp.any(req > 0)
+        # free capacity per bin, exact ints (loads <= cap < 2**24)
+        rem_i = cap_i - loads.astype(jnp.int32)
+        per_res = jnp.where(
+            req_i[None, :] > 0,
+            rem_i // jnp.maximum(req_i[None, :], 1),
+            jnp.int32(2 ** 31 - 1),
+        )
+        a = jnp.clip(jnp.min(per_res, axis=-1), 0, m)   # i32[B]
+        c = jnp.cumsum(a) - a                           # exclusive prefix
+        n = jnp.clip(m - c, 0, a)                       # first-fit fill
+        n = jnp.where(real & (m > 0), n, 0)
+        loads = loads + (n[:, None] * req_i[None, :]).astype(jnp.float32)
+        placed_c = jnp.where(real, jnp.sum(n), m)
+        return loads, placed_c
+
+    if order is None:
+        order = jnp.arange(class_reqs.shape[0], dtype=jnp.int32)
+    loads, placed_counts = jax.lax.scan(
+        step, jnp.zeros((cap.shape[0] if capacity.ndim == 2 else max_bins,
+                         class_reqs.shape[1]), jnp.float32), order
+    )
+    used = jnp.sum(jnp.any(loads > 0, axis=-1))
+    return used.astype(jnp.int32), loads, placed_counts
+
+
 @partial(jax.jit, static_argnames=("max_bins",))
 def binpack_shapes(pod_reqs, capacities, max_bins: int = 1024):
     """vmap the what-if over candidate node shapes: capacities f32[S, R] ->
@@ -79,12 +216,12 @@ def binpack_shapes(pod_reqs, capacities, max_bins: int = 1024):
     shape's capacity), so each lane sorts an INDEX permutation of the
     shared pod list and the scan gathers one request per step —
     materializing pod_reqs[order] per lane ([S, P, R], tile-padded 64x on
-    the R axis) is what used to OOM the 50k x 10k BASELINE config."""
+    the R axis) is what used to OOM the 50k x 10k BASELINE config.  The
+    order is the shared composite `ffd_order` key, so the compressed
+    twin (binpack_shapes_compressed) processes classes identically."""
 
     def one(cap):
-        frac = pod_reqs / jnp.maximum(cap[None, :], 1e-30)
-        key = jnp.max(frac, axis=-1)
-        order = jnp.argsort(-key, stable=True).astype(jnp.int32)
+        order = ffd_order(pod_reqs, cap)
         used, _, placed = binpack_ffd(
             pod_reqs, cap, max_bins=max_bins, order=order
         )
@@ -93,25 +230,127 @@ def binpack_shapes(pod_reqs, capacities, max_bins: int = 1024):
     return jax.vmap(one)(capacities)
 
 
-def what_if(pod_reqs: np.ndarray, shapes: np.ndarray, max_bins: int = 1024):
+@partial(jax.jit, static_argnames=("max_bins",))
+def binpack_shapes_compressed(class_reqs, counts, capacities,
+                              max_bins: int = 1024):
+    """The class-compressed what-if sweep: distinct classes f32[C, R]
+    with counts i32[C] over candidate shapes f32[S, R] ->
+    (bins_needed i32[S], all_placed bool[S]).  Each lane orders the
+    CLASSES by the same shape-relative ffd_order key the per-pod sweep
+    uses and runs the count-carrying scan — C steps instead of P, the
+    whole ISSUE 15 speedup, bins-needed identical to binpack_shapes on
+    the expanded pod list (integer-valued inputs; pinned by test)."""
+    total = jnp.sum(counts.astype(jnp.int32))
+
+    def one(cap):
+        order = ffd_order(class_reqs, cap)
+        used, _, placed_counts = binpack_ffd_counts(
+            class_reqs, counts, cap, max_bins=max_bins, order=order
+        )
+        return used, jnp.sum(placed_counts) == total
+
+    return jax.vmap(one)(capacities)
+
+
+def compress_classes(pod_reqs: np.ndarray, pad_to_pow2: bool = False,
+                     weights=None):
+    """Dedupe a backlog's request matrix [P, R] into (class_reqs f32[C, R],
+    counts i32[C]) — the host half of class compression.  Row order is
+    np.unique's lexicographic order (deterministic; each shape lane
+    re-sorts by its own ffd_order anyway).  All-zero rows (padding) are
+    dropped.  pad_to_pow2 pads the class axis with zero rows / zero
+    counts so the jitted kernels compile one executable per pow2 depth
+    instead of one per exact backlog mix.  `weights` i[P] treats row p
+    as weights[p] pods instead of one — the input for callers that
+    already pre-grouped the backlog (equal rows merge, weights sum)."""
+    reqs = np.ascontiguousarray(np.asarray(pod_reqs, np.float32))
+    real = np.any(reqs > 0, axis=-1)
+    if weights is None:
+        classes, counts = np.unique(
+            reqs[real], axis=0, return_counts=True
+        )
+    else:
+        w = np.asarray(weights)[real]
+        classes, inverse = np.unique(
+            reqs[real], axis=0, return_inverse=True
+        )
+        counts = (
+            np.bincount(inverse, weights=w).astype(np.int64)
+            if classes.size else np.zeros(0, np.int64)
+        )
+    if classes.size == 0:
+        classes = np.zeros((1, reqs.shape[1]), np.float32)
+        counts = np.zeros(1, np.int64)
+    if pad_to_pow2:
+        c = 1
+        while c < classes.shape[0]:
+            c *= 2
+        if c != classes.shape[0]:
+            classes = np.concatenate(
+                [classes, np.zeros((c - classes.shape[0], classes.shape[1]),
+                                   np.float32)]
+            )
+            counts = np.concatenate(
+                [counts, np.zeros(c - counts.shape[0], np.int64)]
+            )
+    return classes.astype(np.float32), counts.astype(np.int32)
+
+
+def int_exact(*arrays) -> bool:
+    """True when every value is a non-negative integer below
+    INT_EXACT_LIMIT — the count kernel's exactness domain.  The public
+    what_if entries auto-fall-back to the per-pod scan outside it
+    (fractional requests would TRUNCATE in the int32 admission
+    arithmetic and pack for free); the capacity planner quantizes
+    instead (runtime/capacity.py), which is the production path."""
+    for a in arrays:
+        a = np.asarray(a)
+        if a.size and (
+            float(a.min()) < 0.0
+            or float(a.max()) >= INT_EXACT_LIMIT
+            or not np.array_equal(a, np.floor(a))
+        ):
+            return False
+    return True
+
+
+def what_if(pod_reqs: np.ndarray, shapes: np.ndarray, max_bins: int = 1024,
+            compress: bool = True):
     """Autoscaler entry: pending pod requests [P, R] x candidate shapes
-    [S, R] -> list of (shape index, nodes needed) for shapes that fit all."""
-    bins, ok = binpack_shapes(
-        pod_reqs.astype(np.float32), shapes.astype(np.float32), max_bins=max_bins
-    )
+    [S, R] -> list of (shape index, nodes needed) for shapes that fit
+    all.  compress=True (the default) dedupes the backlog into
+    (class, count) pairs and runs the count-carrying kernel — same
+    bins-needed, a scan axis of C classes instead of P pods — when the
+    inputs sit in the kernel's integer-exact domain (int_exact);
+    non-integer inputs fall back to the per-pod reference scan rather
+    than silently truncating.  compress=False forces the per-pod scan."""
+    if compress and int_exact(pod_reqs, shapes):
+        classes, counts = compress_classes(pod_reqs, pad_to_pow2=True)
+        bins, ok = binpack_shapes_compressed(
+            classes, counts, shapes.astype(np.float32), max_bins=max_bins
+        )
+    else:
+        bins, ok = binpack_shapes(
+            pod_reqs.astype(np.float32), shapes.astype(np.float32),
+            max_bins=max_bins,
+        )
     bins = np.asarray(bins)
     ok = np.asarray(ok)
     return [(int(s), int(bins[s])) for s in range(shapes.shape[0]) if ok[s]]
 
 
 def what_if_sharded(pod_reqs: np.ndarray, shapes: np.ndarray, mesh,
-                    max_bins: int = 1024):
+                    max_bins: int = 1024, compress: bool = True):
     """Blockwise what-if over a device mesh: the candidate-shape axis is
     data-parallel (each lane packs independently), so shapes shard across
-    the mesh and the pod list replicates — the 50k pods x 10k shapes
-    BASELINE config runs as mesh-width blocks instead of one device's
-    memory footprint.  XLA partitions the vmap lanes; no collectives are
-    needed until the host gathers the per-shape results."""
+    the mesh and the pod list (or its compressed class table) replicates
+    — the 50k pods x 10k shapes BASELINE config runs as mesh-width
+    blocks instead of one device's memory footprint.  XLA partitions the
+    vmap lanes; no collectives are needed until the host gathers the
+    per-shape results.  The shape axis pads to a mesh multiple with
+    ZERO-capacity lanes: nothing real fits a zero shape, so padded lanes
+    report ok=False and the [:S] slice + ok filter drop them (pinned by
+    tests/test_capacity.py)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     axis = mesh.axis_names[0]
@@ -121,11 +360,20 @@ def what_if_sharded(pod_reqs: np.ndarray, shapes: np.ndarray, mesh,
     shp = np.zeros((S + pad, shapes.shape[1]), np.float32)
     shp[:S] = shapes
     shp_s = jax.device_put(shp, NamedSharding(mesh, P(axis, None)))
-    reqs = jax.device_put(
-        pod_reqs.astype(np.float32), NamedSharding(mesh, P(None, None))
-    )
+    replicated = NamedSharding(mesh, P(None, None))
     with mesh:
-        bins, ok = binpack_shapes(reqs, shp_s, max_bins=max_bins)
+        if compress and int_exact(pod_reqs, shapes):
+            classes, counts = compress_classes(pod_reqs, pad_to_pow2=True)
+            bins, ok = binpack_shapes_compressed(
+                jax.device_put(classes, replicated),
+                jax.device_put(counts, NamedSharding(mesh, P(None))),
+                shp_s, max_bins=max_bins,
+            )
+        else:
+            bins, ok = binpack_shapes(
+                jax.device_put(pod_reqs.astype(np.float32), replicated),
+                shp_s, max_bins=max_bins,
+            )
     bins = np.asarray(bins)[:S]
     ok = np.asarray(ok)[:S]
     return [(int(s), int(bins[s])) for s in range(S) if ok[s]]

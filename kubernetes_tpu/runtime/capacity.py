@@ -1,0 +1,534 @@
+"""Device-resident capacity planner (ISSUE 15): class-compressed what-if
+binpack of the live backlog over a candidate node-shape catalog.
+
+BASELINE's fifth config — "cluster-autoscaler what-if binpack: 50k
+pending pods x 10k candidate node shapes" — asked a question nothing in
+the repo answered: *given the live cluster and its pending backlog, what
+should the fleet look like?*  This module is the answer end to end:
+
+  * **Snapshot.**  Every `capacityIntervalCycles` committed cycles the
+    planner snapshots the cycle's host cluster refs (allocatable /
+    requested / valid — immutable by the encoder's cow contract) plus
+    the pending+unschedulable backlog's request vectors (one bounded
+    read-only queue walk), QUANTIZES both to per-resource power-of-two
+    quanta so every value is an exact integer below 2**24 (the
+    models/binpack.py count-kernel exactness contract; requests round
+    UP, capacities round DOWN — the conservative direction), and
+    CLASS-COMPRESSES the backlog: real backlogs are controller-stamped,
+    so 50k request vectors collapse into a few hundred distinct
+    (vector -> count) classes.
+
+  * **Two-stage solve, one amortized side-launch.**  Stage 1 packs the
+    compressed backlog into the EXISTING headroom (per-node free rows
+    as per-bin capacities — models/binpack.binpack_ffd_counts); only
+    the overflow goes to stage 2, the class-compressed what-if sweep
+    over the shape catalog (binpack_shapes_compressed — C scan steps
+    instead of P, the ISSUE 15 speedup).  Both stages dispatch
+    back-to-back as ONE chained async side-launch behind the scheduling
+    loop and materialize one interval later — the TelemetryHub
+    amortization, so a scheduling cycle never blocks on the solve.
+    With a device mesh the shape axis shards exactly like
+    models/binpack.what_if_sharded (padded zero-capacity lanes report
+    ok=False and are filtered).
+
+  * **Recommendation.**  "add 37 x shape-C nodes" (the cheapest shape
+    that fits the whole overflow, runners-up included), or — when the
+    headroom already absorbs everything — "nodes n12,n47 drainable"
+    (valid, pod-free nodes stage 1 left untouched).  Served at
+    GET /debug/capacity on both servers, exported as the
+    scheduler_capacity_* metric families, and banked by
+    bench.py --autoscale.
+
+Placements are bit-identical with the planner on or off (it only READS
+immutable snapshot refs and the queue's backlog — pinned by
+tests/test_capacity.py), and the hook's scheduling-thread cost is
+stamped into scheduler_capacity_seconds_total (the <2%-of-cycle budget
+perf_smoke pins, the telemetry/quality discipline).  `CAPACITY` /
+`get_default` / `set_default` follow the flightrecorder RECORDER
+pattern.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.codec.schema import (
+    RES_EPHEMERAL,
+    RES_MEMORY,
+    RES_MILLICPU,
+    RES_PODS,
+)
+from kubernetes_tpu.models.binpack import INT_EXACT_LIMIT, compress_classes
+from kubernetes_tpu.utils import metrics as m
+
+# a small general-purpose default catalog (GCE-flavored names) so
+# enabling the planner without a nodeShapeCatalog still recommends
+# something sensible; production deployments pass their own
+DEFAULT_SHAPE_CATALOG: Tuple[dict, ...] = (
+    {"name": "c2-standard-8", "cpu": "8", "memory": "32Gi"},
+    {"name": "c2-standard-16", "cpu": "16", "memory": "64Gi"},
+    {"name": "c2-standard-30", "cpu": "30", "memory": "120Gi"},
+    {"name": "m1-highmem-16", "cpu": "16", "memory": "128Gi"},
+)
+
+# catalog entry keys that are NOT resource quantities
+_META_KEYS = frozenset({"name", "pods"})
+
+# default allocatable-pods slots per catalog node (the kubelet default)
+DEFAULT_SHAPE_PODS = 110.0
+
+
+def catalog_vectors(
+    catalog,
+    r: int,
+    res_col: Optional[Callable[[str], Optional[int]]] = None,
+) -> Tuple[List[str], np.ndarray]:
+    """Shape-catalog entries ({name, cpu, memory, ephemeral-storage?,
+    pods?, <extended>...}) -> (names, capacities f32[S, r]) in the
+    snapshot encoder's resource-column units (cpu in milli, bytes for
+    memory/ephemeral).  `res_col` maps extended resource names to
+    columns READ-ONLY (unknown names are skipped — a shape advertising
+    a resource no pod ever requested cannot matter to the pack)."""
+    from kubernetes_tpu.api.resource import parse_quantity
+
+    names: List[str] = []
+    caps = np.zeros((len(catalog), r), np.float32)
+    for i, entry in enumerate(catalog):
+        names.append(str(entry.get("name", f"shape-{i}")))
+        caps[i, RES_PODS] = float(entry.get("pods", DEFAULT_SHAPE_PODS))
+        for key, val in entry.items():
+            if key in _META_KEYS:
+                continue
+            if key == "cpu":
+                caps[i, RES_MILLICPU] = float(parse_quantity(val).milli)
+            elif key == "memory":
+                caps[i, RES_MEMORY] = float(parse_quantity(val))
+            elif key == "ephemeral-storage":
+                caps[i, RES_EPHEMERAL] = float(parse_quantity(val))
+            else:
+                col = res_col(key) if res_col is not None else None
+                if col is not None and 0 <= col < r:
+                    caps[i, col] = float(parse_quantity(val))
+    return names, caps
+
+
+def quantize_columns(*arrays) -> np.ndarray:
+    """Per-resource power-of-two quanta making every value in `arrays`
+    fit the count kernel's integer-exactness contract (< 2**24 after
+    division).  Power-of-two quanta divide exactly in binary floats, so
+    quantization introduces no rounding beyond the ceil/floor the
+    caller chooses."""
+    r = arrays[0].shape[-1]
+    maxv = np.zeros(r, np.float64)
+    for a in arrays:
+        if a.size:
+            maxv = np.maximum(maxv, a.reshape(-1, r).max(axis=0))
+    quanta = np.ones(r, np.float64)
+    over = maxv >= INT_EXACT_LIMIT
+    if over.any():
+        quanta[over] = 2.0 ** np.ceil(
+            np.log2(maxv[over] / (INT_EXACT_LIMIT - 1.0))
+        )
+    return quanta
+
+
+_STAGE1 = None
+
+
+def _stage1_kernel():
+    """ONE jitted stage-1 (pack into existing headroom) kernel for the
+    process, re-traced per (N, C) shape like every engine executable:
+    order classes by the shared FFD key against the fleet's largest
+    free shape, count-pack into the per-node free rows, and return the
+    class-indexed leftovers + which nodes the pack touched."""
+    global _STAGE1
+    if _STAGE1 is None:
+        import jax
+        import jax.numpy as jnp
+
+        from kubernetes_tpu.models.binpack import (
+            binpack_ffd_counts,
+            ffd_order,
+        )
+
+        def stage1(free, classes, counts):
+            ref = jnp.maximum(jnp.max(free, axis=0), 1.0)
+            order = ffd_order(classes, ref)
+            _, loads, placed_c = binpack_ffd_counts(
+                classes, counts, free, max_bins=free.shape[0], order=order
+            )
+            placed = jnp.zeros_like(counts).at[order].set(placed_c)
+            real = jnp.any(classes > 0, axis=-1)
+            leftover = jnp.where(real, counts - placed, 0)
+            touched = jnp.any(loads > 0, axis=-1)
+            return leftover, jnp.sum(jnp.where(real, placed, 0)), touched
+
+        _STAGE1 = jax.jit(stage1)
+    return _STAGE1
+
+
+class CapacityPlanner:
+    """Per-scheduler capacity-planning aggregation point.
+
+    The scheduling thread calls `on_cycle` once per committed cycle
+    (runtime/scheduler.py stamps the call's cost into
+    scheduler_capacity_seconds_total); readers (/debug/capacity, bench)
+    come from other threads and take the lock only around ring/summary
+    state.  The backlog and snapshot are read lazily — only on a due
+    interval cycle — so off-interval cycles cost two integer bumps."""
+
+    def __init__(
+        self,
+        catalog=None,
+        interval_cycles: int = 256,
+        ring_capacity: int = 128,
+        max_bins: int = 1024,
+        backlog_cap: int = 65536,
+        mesh=None,  # a Mesh, or a zero-arg callable returning the CURRENT
+        #             mesh (the elastic ladder rebuilds at runtime; a
+        #             getter keeps the shape axis sharding over whatever
+        #             mesh is serving cycles right now)
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.catalog = list(catalog) if catalog else list(
+            DEFAULT_SHAPE_CATALOG
+        )
+        self.interval_cycles = max(1, int(interval_cycles))
+        self.max_bins = max(1, int(max_bins))
+        self.backlog_cap = max(1, int(backlog_cap))
+        self.mesh = mesh
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(1, int(ring_capacity)))
+        self.cycles_total = 0
+        self.solves_total = 0
+        self._cycles_since = self.interval_cycles  # first cycle is due
+        # in-flight solve: (cycle, device outs tuple, meta dict) —
+        # dispatched on one due cycle, materialized on the next (the
+        # telemetry hub's amortization pattern)
+        self._pending: Optional[Tuple[int, tuple, dict]] = None
+        self.recommendation: Optional[dict] = None
+        # the shape whose recommended-nodes gauge child is currently
+        # exported: cleared before the next solve's winner lands, so
+        # /metrics never shows two "winning" shapes at once (or a
+        # stale one after the overflow drains)
+        self._reco_shape: Optional[str] = None
+        # shape vectors are rebuilt when the snapshot's R width moves
+        # (extended-resource growth) — keyed on (r, id-ish catalog len)
+        self._caps_cache: Dict[int, Tuple[List[str], np.ndarray]] = {}
+
+    # ------------------------------------------------------ hot-path API
+
+    def on_cycle(
+        self,
+        cycle: int,
+        backlog: Callable[[int], np.ndarray],
+        snapshot: Optional[tuple],
+        node_names: Optional[Callable[[], Dict[int, str]]] = None,
+        res_col: Optional[Callable[[str], Optional[int]]] = None,
+    ) -> None:
+        """Fold one committed cycle: amortized materialize-then-dispatch.
+
+        `backlog` is a CALLABLE returning the pending+unschedulable
+        request matrix f32[P, R] — or the pre-grouped form
+        (vectors f32[G, R], counts i[G]), which skips materializing a
+        per-pod matrix entirely (the scheduler's walk already groups
+        by request content) — invoked only on due cycles: the queue
+        walk must not run 256x more often than the solve;
+        `snapshot` the cycle's host (allocatable, requested, valid)
+        refs; `node_names` resolves node rows to names for the
+        drainable report; `res_col` the encoder's read-only extended-
+        resource column lookup for catalog vectors.  The cadence
+        counter resets only on an actual dispatch, so a due cycle that
+        cannot sample (no snapshot yet) leaves the interval due."""
+        self.cycles_total += 1
+        self._cycles_since += 1
+        if self._cycles_since < self.interval_cycles:
+            return
+        self._materialize_pending()
+        if snapshot is None:
+            return
+        try:
+            reqs = backlog(self.backlog_cap)
+        except Exception:  # noqa: BLE001 — a failed backlog walk costs
+            # one sample, never the cycle (the telemetry discipline)
+            return
+        if self._dispatch(cycle, reqs, snapshot, node_names, res_col):
+            self._cycles_since = 0
+
+    # ------------------------------------------------------ solve launch
+
+    def _shape_caps(self, r: int, res_col) -> Tuple[List[str], np.ndarray]:
+        hit = self._caps_cache.get(r)
+        if hit is None:
+            hit = catalog_vectors(self.catalog, r, res_col=res_col)
+            self._caps_cache[r] = hit
+        return hit
+
+    def _dispatch(self, cycle: int, reqs, snapshot, node_names,
+                  res_col) -> bool:
+        """Quantize + compress + launch the two-stage solve; the result
+        materializes one interval from now.  Returns whether a launch
+        actually dispatched."""
+        import jax
+
+        alloc, used, valid = (np.asarray(x) for x in snapshot)
+        # the backlog arrives per-pod ([P, R]) or pre-grouped
+        # ((vectors [G, R], counts [G])); normalize to rows + weights
+        if isinstance(reqs, tuple):
+            reqs, req_counts = reqs
+            req_counts = np.asarray(req_counts, np.int64)
+        else:
+            req_counts = None
+        reqs = np.asarray(reqs, np.float32)
+        if reqs.ndim != 2 or reqs.shape[1] != alloc.shape[1]:
+            reqs = np.zeros((0, alloc.shape[1]), np.float32)
+            req_counts = None
+        names, caps = self._shape_caps(alloc.shape[1], res_col)
+        if not len(names):
+            return False
+        free = np.where(
+            valid[:, None],
+            np.maximum(alloc.astype(np.float64) - used.astype(np.float64),
+                       0.0),
+            0.0,
+        )
+        # per-resource power-of-two quanta -> exact-integer arithmetic
+        # in the count kernel (requests ceil, capacities floor: the
+        # conservative direction — a recommendation may buy one node
+        # too many, never one too few)
+        quanta = quantize_columns(free, caps.astype(np.float64),
+                                  reqs.astype(np.float64))
+        free_q = np.floor(free / quanta).astype(np.float32)
+        caps_q = np.floor(caps.astype(np.float64) / quanta).astype(
+            np.float32
+        )
+        reqs_q = np.ceil(reqs.astype(np.float64) / quanta).astype(
+            np.float32
+        )
+        classes, counts = compress_classes(
+            reqs_q, pad_to_pow2=True, weights=req_counts
+        )
+        backlog_pods = int(counts.sum())
+        n_classes = int(np.sum(np.any(classes > 0, axis=-1)))
+        meta = {
+            "backlog_pods": backlog_pods,
+            "classes": max(n_classes, 1 if backlog_pods else 0),
+            "shapes": len(names),
+            "shape_names": names,
+            "quanta": [float(q) for q in quanta],
+            "node_names": node_names,
+            "valid": valid,
+            "pod_free": used[:, RES_PODS] <= 0,
+        }
+        try:
+            from kubernetes_tpu.models.binpack import (
+                binpack_shapes_compressed,
+            )
+
+            mesh = self.mesh() if callable(self.mesh) else self.mesh
+            if mesh is not None:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                axis = mesh.axis_names[0]
+                n_dev = mesh.devices.size
+                s = caps_q.shape[0]
+                pad = (-s) % n_dev
+                shp = np.zeros((s + pad, caps_q.shape[1]), np.float32)
+                shp[:s] = caps_q
+                repl = NamedSharding(mesh, P(None, None))
+                with mesh:
+                    free_d = jax.device_put(
+                        free_q.astype(np.float32), repl
+                    )
+                    cls_d = jax.device_put(classes, repl)
+                    cnt_d = jax.device_put(
+                        counts, NamedSharding(mesh, P(None))
+                    )
+                    leftover, absorbed, touched = _stage1_kernel()(
+                        free_d, cls_d, cnt_d
+                    )
+                    bins, ok = binpack_shapes_compressed(
+                        cls_d, leftover,
+                        jax.device_put(
+                            shp, NamedSharding(mesh, P(axis, None))
+                        ),
+                        max_bins=self.max_bins,
+                    )
+                meta["padded_shapes"] = int(pad)
+            else:
+                leftover, absorbed, touched = _stage1_kernel()(
+                    free_q.astype(np.float32), classes, counts
+                )
+                bins, ok = binpack_shapes_compressed(
+                    classes, leftover, caps_q, max_bins=self.max_bins
+                )
+        except Exception:  # noqa: BLE001 — a faulted side launch costs
+            # one sample, never the cycle (the telemetry discipline)
+            return False
+        with self._lock:  # /debug readers race the swap
+            self._pending = (
+                cycle, (leftover, absorbed, touched, bins, ok), meta,
+            )
+        return True
+
+    # ------------------------------------------------------ materialize
+
+    def _materialize_pending(self) -> Optional[dict]:
+        with self._lock:  # one consumer wins (scheduling thread vs
+            # HTTP readers via debug_payload/finalize)
+            pending, self._pending = self._pending, None
+        if pending is None:
+            return None
+        cycle, outs, meta = pending
+        try:
+            leftover, absorbed, touched, bins, ok = (
+                np.asarray(x) for x in outs
+            )
+        except Exception:  # noqa: BLE001 — one lost sample, not a cycle
+            return None
+        names: List[str] = meta["shape_names"]
+        s = len(names)
+        bins, ok = bins[:s], ok[:s]
+        overflow = int(leftover.sum())
+        fits = np.flatnonzero(ok & (bins > 0)) if overflow else (
+            np.empty(0, np.int64)
+        )
+        scale_up = None
+        runners_up: List[dict] = []
+        if overflow and len(fits):
+            ranked = fits[np.argsort(bins[fits], kind="stable")]
+            best = int(ranked[0])
+            scale_up = {
+                "shape": names[best],
+                "count": int(bins[best]),
+                "shape_index": best,
+            }
+            runners_up = [
+                {"shape": names[int(i)], "count": int(bins[int(i)])}
+                for i in ranked[1:4]
+            ]
+        # drainable: valid, pod-free nodes the headroom pack left
+        # untouched — removable without moving anything
+        drain_rows = np.flatnonzero(
+            meta["valid"] & meta["pod_free"] & ~touched[: len(meta["valid"])]
+        )
+        drain_names: List[str] = []
+        resolve = meta.get("node_names")
+        if resolve is not None and len(drain_rows):
+            try:
+                by_row = resolve()
+                drain_names = [
+                    by_row[int(r)] for r in drain_rows[:16]
+                    if int(r) in by_row
+                ]
+            except Exception:  # noqa: BLE001 — names are advisory
+                drain_names = []
+        backlog_pods = meta["backlog_pods"]
+        n_classes = meta["classes"]
+        sample = {
+            "time": time.time(),
+            "cycle": int(cycle),
+            "backlog_pods": backlog_pods,
+            "classes": n_classes,
+            "compression_x": round(backlog_pods / max(n_classes, 1), 1),
+            "absorbed_existing": int(absorbed),
+            "overflow_pods": overflow,
+            "shapes_evaluated": s,
+            "shapes_fitting": int(len(fits)),
+            "scale_up": scale_up,
+            "runners_up": runners_up,
+            "drainable": {
+                "count": int(len(drain_rows)),
+                "nodes": drain_names,
+            },
+            "quanta": meta["quanta"],
+        }
+        with self._lock:
+            self.recommendation = sample
+            self._ring.append(sample)
+            self.solves_total += 1
+        m.CAPACITY_SOLVES.inc()
+        m.CAPACITY_BACKLOG.set(float(backlog_pods), kind="pods")
+        m.CAPACITY_BACKLOG.set(float(n_classes), kind="classes")
+        m.CAPACITY_OVERFLOW.set(float(overflow))
+        m.CAPACITY_ABSORBED.set(float(absorbed))
+        m.CAPACITY_DRAINABLE.set(float(len(drain_rows)))
+        new_shape = scale_up["shape"] if scale_up is not None else None
+        if self._reco_shape is not None and self._reco_shape != new_shape:
+            m.CAPACITY_RECOMMENDED.remove(shape=self._reco_shape)
+        if scale_up is not None:
+            m.CAPACITY_RECOMMENDED.set(
+                float(scale_up["count"]), shape=new_shape
+            )
+        self._reco_shape = new_shape
+        return sample
+
+    def finalize(self) -> None:
+        """Materialize any in-flight solve (bench/test exit — the
+        amortization would otherwise leave the last sample in flight
+        forever on a drained queue)."""
+        self._materialize_pending()
+
+    # ----------------------------------------------------------- readers
+
+    def summary(self) -> dict:
+        with self._lock:
+            reco = dict(self.recommendation) if self.recommendation else None
+            return {
+                "cycles": self.cycles_total,
+                "solves": self.solves_total,
+                "interval_cycles": self.interval_cycles,
+                "catalog_shapes": len(self.catalog),
+                "max_bins": self.max_bins,
+                "backlog_cap": self.backlog_cap,
+                "sharded": (
+                    (self.mesh() if callable(self.mesh) else self.mesh)
+                    is not None
+                ),
+                "recommendation": reco,
+            }
+
+    def debug_payload(self, limit: Optional[int] = None) -> dict:
+        """GET /debug/capacity body: summary + the newest `limit` solve
+        samples (the shared debug_body halves the limit until the body
+        fits the 4MB cap, like its siblings)."""
+        self._materialize_pending()
+        with self._lock:
+            samples = list(self._ring)
+        if limit is not None and limit >= 0:
+            samples = samples[-limit:] if limit else []
+        return {"summary": self.summary(), "samples": samples}
+
+
+# process-wide default (the flightrecorder.RECORDER pattern): the
+# planner /debug/capacity serves when none was wired explicitly; a
+# Scheduler with capacity_planner enabled installs its own here
+CAPACITY = CapacityPlanner()
+
+
+def get_default() -> CapacityPlanner:
+    return CAPACITY
+
+
+# per-replica installs (the ISSUE 14 registry discipline): replica 0
+# stays the process default, siblings register alongside
+_REPLICAS: dict = {}
+
+
+def set_default(planner: CapacityPlanner, replica: int = 0) -> None:
+    global CAPACITY
+    _REPLICAS[int(replica)] = planner
+    if int(replica) == 0:
+        CAPACITY = planner
+
+
+def replica_instances() -> dict:
+    """{replica id: CapacityPlanner} of every install this process saw."""
+    return dict(sorted(_REPLICAS.items()))

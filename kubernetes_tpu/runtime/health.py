@@ -410,6 +410,20 @@ class HealthServer:
                         ),
                         ct="application/json",
                     )
+                elif path == "/debug/capacity":
+                    # the capacity planner (runtime/capacity.py):
+                    # class-compressed backlog what-if — scale-up/
+                    # scale-down recommendation, compression and
+                    # absorption facts — ?limit=N + the shared 4MB
+                    # cap, like its siblings
+                    from kubernetes_tpu.runtime import capacity
+
+                    self._send(
+                        debug_body(
+                            capacity.get_default().debug_payload, query,
+                        ),
+                        ct="application/json",
+                    )
                 elif path == "/debug/replicas":
                     # queue-sharded replicas (ISSUE 14): the explicit
                     # process aggregate — per-replica cycle/conflict

@@ -524,6 +524,11 @@ DEBUG_ENDPOINTS = {
         "facts, the sequenced reconciler's stats, and the per-namespace "
         "usage/quota table (?limit=N bounds the tenant table)"
     ),
+    "/debug/capacity": (
+        "capacity planner: class-compressed what-if binpack of the "
+        "pending backlog — scale-up/scale-down recommendation, "
+        "compression/absorption/overflow facts (?limit=N)"
+    ),
 }
 
 

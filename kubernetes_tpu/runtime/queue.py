@@ -480,6 +480,24 @@ class PriorityQueue:
         with self._lock:
             self._enqueued_at[_pod_key(pod)] = t
 
+    def backlog_pods(self, limit: Optional[int] = None) -> List[Pod]:
+        """READ-ONLY snapshot of every tracked pod — active (both
+        lanes), backoff, and unschedulable-parked — under one lock
+        acquisition, newest-admission-last within each tier.  The
+        capacity planner's backlog source (runtime/capacity.py): what
+        would the fleet need to place ALL of this?  `limit` bounds the
+        walk (a 1M-pod storm queue must not be copied wholesale onto
+        the scheduling thread)."""
+        with self._lock:
+            out: List[Pod] = [
+                e[2] for e in self._active_entry.values() if e[_VALID]
+            ]
+            out += [
+                e[2] for e in self._backoff_entry.values() if e[_VALID]
+            ]
+            out += [rec[0] for rec in self._unschedulable.values()]
+        return out if limit is None else out[:limit]
+
     def has_nominated(self) -> bool:
         with self._lock:
             return bool(self._nominated)

@@ -291,6 +291,21 @@ class SchedulerConfig:
     # dual-window EWMA step-detector threshold for the packing-drift
     # alerts (relative deviation of the fast window from the slow one)
     quality_drift_threshold: float = 0.25
+    # --- device-resident capacity planner (ISSUE 15: runtime/capacity.py) ---
+    # what-if binpack of the pending+unschedulable backlog: every
+    # capacityIntervalCycles the backlog is CLASS-COMPRESSED (distinct
+    # request vector -> count) and packed — existing node headroom
+    # first, the overflow over the node-shape catalog — as an amortized
+    # side-launch behind the scheduling loop, emitting a scale-up/
+    # scale-down recommendation at /debug/capacity + the
+    # scheduler_capacity_* families.  Placements are bit-identical with
+    # the planner on or off (purely observational).
+    capacity_planner: bool = False
+    capacity_interval_cycles: int = 256
+    # candidate node shapes ([{name, cpu, memory, ephemeral-storage?,
+    # pods?, <extended resources>...}]); None = the small built-in
+    # default catalog (runtime/capacity.DEFAULT_SHAPE_CATALOG)
+    node_shape_catalog: Optional[list] = None
     # --- queue-sharded scheduler replicas (ISSUE 14) ---
     # horizontal scale-out inside one process: run this many Scheduler
     # replicas (threads) over ONE cache/queue, each popping a stable
@@ -379,6 +394,11 @@ class SchedulerConfig:
             quality_drift_threshold=getattr(
                 cc, "quality_drift_threshold", 0.25
             ),
+            capacity_planner=getattr(cc, "capacity_planner", False),
+            capacity_interval_cycles=getattr(
+                cc, "capacity_interval_cycles", 256
+            ),
+            node_shape_catalog=getattr(cc, "node_shape_catalog", None),
             replicas=getattr(cc, "replicas", 1),
             namespace_quotas=getattr(cc, "namespace_quotas", None),
         )
@@ -474,6 +494,12 @@ class _InFlight:
     # placed against chained state the shared snapshot predates; FFD
     # against the emptier pre-megacycle capacity would overstate regret)
     quality_snapshot: Optional[tuple] = None
+    # --- device-resident capacity planner (ISSUE 15) ---
+    # the cycle's host (allocatable, requested, valid) refs for the
+    # capacity solve (immutable by the encoder's cow contract) — kept
+    # separate from telemetry_host/quality_snapshot so the planner
+    # works whatever combination of observatories is enabled
+    capacity_snapshot: Optional[tuple] = None
     # --- queue-sharded replicas (ISSUE 14) ---
     # the encoded batch's request matrix (host ref) when a conflict
     # reconciler is attached: the admission scan's pod-side input
@@ -976,6 +1002,29 @@ class Scheduler:
                 drift_threshold=self.config.quality_drift_threshold,
             )
             quality_mod.set_default(self.quality, replica=self._replica_id)
+        # device-resident capacity planner (ISSUE 15, runtime/capacity.py):
+        # every capacityIntervalCycles the pending+unschedulable backlog
+        # is class-compressed and what-if binpacked — existing headroom
+        # first, overflow over the node-shape catalog — as an amortized
+        # side-launch behind the loop (the telemetry discipline; the <2%
+        # budget pinned by perf_smoke), emitting a scale-up/scale-down
+        # recommendation at /debug/capacity.  Placements are
+        # bit-identical planner on/off (purely observational; pinned by
+        # tests/test_capacity.py).  The mesh is read through a getter at
+        # dispatch time so the elastic ladder's shrinks/rebuilds are
+        # always honored.
+        self.capacity = None
+        if self.config.capacity_planner:
+            from kubernetes_tpu.runtime import capacity as capacity_mod
+
+            self.capacity = capacity_mod.CapacityPlanner(
+                catalog=self.config.node_shape_catalog,
+                interval_cycles=self.config.capacity_interval_cycles,
+                mesh=lambda: self.mesh,
+            )
+            capacity_mod.set_default(
+                self.capacity, replica=self._replica_id
+            )
         # shed watermark (per-cycle deltas feed the goodput SLO) +
         # heartbeat clock + liveness totals (heartbeat line + bench)
         self._shed_seen = 0
@@ -1902,6 +1951,11 @@ class Scheduler:
                  cluster_used.valid)
                 if self.telemetry is not None else None
             ),
+            capacity_snapshot=(
+                (cluster_used.allocatable, cluster_used.requested,
+                 cluster_used.valid)
+                if self.capacity is not None else None
+            ),
             width=batch.n_pods,
             enqueue_s=t_disp_end - t_cycle0,
             xfer0=xfer0,
@@ -2219,6 +2273,14 @@ class Scheduler:
                 telemetry_host=(
                     (cluster.allocatable, cluster.requested, cluster.valid)
                     if self.telemetry is not None else None
+                ),
+                # window 0's refs suffice for the capacity planner: its
+                # interval cadence samples at most one window per
+                # megacycle anyway, and the backlog solve wants the
+                # pre-megacycle fleet state
+                capacity_snapshot=(
+                    (cluster.allocatable, cluster.requested, cluster.valid)
+                    if (self.capacity is not None and k == 0) else None
                 ),
                 width=batches[k].n_pods,
                 enqueue_s=(t_disp_end - t_cycle0) / K,
@@ -2756,6 +2818,21 @@ class Scheduler:
                 )
             finally:
                 m.QUALITY_SECONDS.inc(time.perf_counter() - t_q)
+        # device-resident capacity planner (ISSUE 15): the amortized
+        # class-compressed what-if solve over the backlog + shape
+        # catalog.  Same discipline as the telemetry/quality hooks —
+        # never fails a committed cycle, cost stamped into its own
+        # counter (the <2% budget perf_smoke pins).
+        if self.capacity is not None:
+            t_cap = time.perf_counter()
+            try:
+                self._capacity_cycle(inf)
+            except Exception as e:  # noqa: BLE001
+                klog.errorf(
+                    "capacity hook failed (cycle %d): %s", inf.cycle, e
+                )
+            finally:
+                m.CAPACITY_SECONDS.inc(time.perf_counter() - t_cap)
         m.PENDING_PODS.set(float(len(self.queue)))
         self.results.extend(results)
         # slow-cycle log LAST, once the ENTIRE tail (ledger record +
@@ -2828,6 +2905,63 @@ class Scheduler:
             host_snapshot=inf.telemetry_host,
             span=inf.trace,
         )
+
+    def _capacity_cycle(self, inf: _InFlight) -> None:
+        """Feed the capacity planner one committed cycle: the cycle's
+        host snapshot refs, a lazy backlog reader (invoked only on due
+        interval cycles), the node-name resolver for the drainable
+        report, and the encoder's read-only extended-resource column
+        lookup for catalog vectors."""
+        enc = self.cache.encoder
+
+        def node_names():
+            return {row: name for name, row in enc.node_rows.items()}
+
+        self.capacity.on_cycle(
+            cycle=inf.cycle,
+            backlog=self._capacity_backlog,
+            snapshot=inf.capacity_snapshot,
+            node_names=node_names,
+            res_col=enc.res_col_readonly,
+        )
+
+    def _capacity_backlog(self, cap: int):
+        """The pending+unschedulable backlog in the planner's
+        PRE-GROUPED form (distinct request vectors f32[G, R], counts
+        i[G]; bounded at `cap` pods), encoded READ-ONLY — the planner
+        must not grow the encoder's resource axis or intern anything.
+        Controller-stamped backlogs collapse to a handful of distinct
+        request contents, so pods group by content (the encoder's
+        _req_memo key scheme) and each distinct content encodes once —
+        the walk is dict ops per pod and the planner never
+        materializes (or re-sorts) a per-pod matrix (the
+        <2%-of-cycle hook budget)."""
+        enc = self.cache.encoder
+        q = self.queue
+        pods = (
+            q.backlog_pods(cap) if hasattr(q, "backlog_pods") else []
+        )
+        if not pods:
+            return np.zeros((0, enc.dims.R), np.float32)
+        groups: Dict[tuple, list] = {}
+        for p in pods:
+            rk = (
+                tuple(
+                    tuple(c.requests.items()) for c in p.spec.containers
+                ),
+                () if not p.spec.init_containers else tuple(
+                    tuple(c.requests.items())
+                    for c in p.spec.init_containers
+                ),
+            )
+            g = groups.get(rk)
+            if g is None:
+                groups[rk] = [enc.backlog_req_vector(p), 1]
+            else:
+                g[1] += 1
+        vecs = np.stack([v for v, _ in groups.values()])
+        counts = np.asarray([c for _, c in groups.values()], np.int64)
+        return vecs, counts
 
     def _ledger_record(self, inf: _InFlight, staged: _Staged,
                        results: List[ScheduleResult]) -> None:

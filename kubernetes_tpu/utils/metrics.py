@@ -892,6 +892,70 @@ QUALITY_SECONDS = REGISTRY.register(
     )
 )
 
+# --- device-resident capacity planner (ISSUE 15: runtime/capacity.py) ---
+# the class-compressed what-if binpack of the live backlog over the
+# node-shape catalog, solved as an amortized side-launch behind the
+# scheduling loop; gauges reflect the last materialized solve
+CAPACITY_SECONDS = REGISTRY.register(
+    Counter(
+        "scheduler_capacity_seconds_total",
+        "Cumulative scheduling-thread seconds spent in the capacity-"
+        "planner hook (backlog snapshot + class compression + the "
+        "amortized two-stage solve dispatch; the <2%-of-cycle-wall "
+        "budget perf_smoke pins)",
+    )
+)
+CAPACITY_SOLVES = REGISTRY.register(
+    Counter(
+        "scheduler_capacity_solves_total",
+        "Capacity what-if solves materialized (dispatched every "
+        "capacityIntervalCycles, fetched one interval later so the "
+        "scheduling thread never blocks on the binpack launch)",
+    )
+)
+CAPACITY_BACKLOG = REGISTRY.register(
+    LabeledGauge(
+        "scheduler_capacity_backlog",
+        "Pending+unschedulable backlog the last solve packed, by kind "
+        "(pods = raw backlog size, classes = distinct request vectors "
+        "after class compression — their ratio is the scan-axis "
+        "compression the count kernel banks)",
+        ("kind",),
+        max_children=8,
+    )
+)
+CAPACITY_ABSORBED = REGISTRY.register(
+    Gauge(
+        "scheduler_capacity_absorbed_pods",
+        "Backlog pods the last solve packed into EXISTING node headroom "
+        "(stage 1) — only the remainder needs new capacity",
+    )
+)
+CAPACITY_OVERFLOW = REGISTRY.register(
+    Gauge(
+        "scheduler_capacity_overflow_pods",
+        "Backlog pods the existing headroom could NOT absorb — the "
+        "scale-up demand the shape sweep (stage 2) sizes",
+    )
+)
+CAPACITY_RECOMMENDED = REGISTRY.register(
+    LabeledGauge(
+        "scheduler_capacity_recommended_nodes",
+        "Nodes of the winning catalog shape the last solve recommends "
+        "adding to absorb the overflow (the cheapest all-fitting shape)",
+        ("shape",),
+        max_children=128,  # bounded by the catalog size
+    )
+)
+CAPACITY_DRAINABLE = REGISTRY.register(
+    Gauge(
+        "scheduler_capacity_drainable_nodes",
+        "Valid, pod-free nodes the headroom pack left untouched — "
+        "drainable without moving anything (the scale-down half of the "
+        "recommendation)",
+    )
+)
+
 # --- queue-sharded scheduler replicas (ISSUE 14) ---
 REPLICAS = REGISTRY.register(
     Gauge(

@@ -1,0 +1,578 @@
+"""Device-resident capacity planner (ISSUE 15).
+
+Three layers under test:
+
+  * the class-compressed binpack kernels (models/binpack.py): the
+    count-carrying scan must be bins-needed-IDENTICAL to the per-pod
+    reference on randomized integer backlogs — duplicate-heavy and
+    all-distinct extremes included — plus the placed_by_pod
+    scatter-back helper and the sharded shape axis (padded
+    zero-capacity lanes filter out; sharded == single-chip);
+
+  * the CapacityPlanner (runtime/capacity.py): headroom-first packing,
+    scale-up recommendation + runners-up, drainable-node detection,
+    the dispatch-now/materialize-next-interval amortization, and the
+    /debug/capacity payload;
+
+  * the live Scheduler integration: placements bit-identical with the
+    planner on or off, the default install serving /debug/capacity,
+    and the <2%-of-cycle hot-path budget (perf_smoke tier).
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.factory import make_node, make_pod
+from kubernetes_tpu.codec.encoder import SnapshotEncoder
+from kubernetes_tpu.models.binpack import (
+    binpack_ffd,
+    binpack_ffd_counts,
+    binpack_shapes,
+    binpack_shapes_compressed,
+    compress_classes,
+    ffd_order,
+    placed_by_pod,
+    what_if,
+    what_if_sharded,
+)
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.capacity import (
+    CapacityPlanner,
+    catalog_vectors,
+    quantize_columns,
+)
+from kubernetes_tpu.runtime.queue import PriorityQueue
+from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+pytestmark = pytest.mark.capacity
+
+R = 8
+
+
+def _backlog(rng, n_classes, n_pods):
+    """Duplicate-heavy integer backlog: n_pods drawn from n_classes
+    distinct controller-stamped request vectors (milli/Mi units — the
+    count kernel's integer-exactness contract)."""
+    base = np.zeros((n_classes, R), np.float32)
+    base[:, 0] = rng.integers(50, 4000, n_classes)
+    base[:, 1] = rng.integers(64, 8192, n_classes)
+    base[:, 3] = 1.0
+    return base[rng.integers(0, n_classes, n_pods)]
+
+
+def _shapes(rng, s):
+    shapes = np.zeros((s, R), np.float32)
+    shapes[:, 0] = rng.integers(4000, 128001, s)
+    shapes[:, 1] = rng.integers(16 * 1024, 512 * 1024 + 1, s)
+    shapes[:, 3] = 110.0
+    return shapes
+
+
+# --------------------------------------------------- kernel identity
+
+
+@pytest.mark.parametrize(
+    "n_classes,n_pods",
+    [
+        (4, 400),     # extreme duplicate-heavy
+        (32, 500),    # typical controller mix
+        (300, 300),   # all-distinct extreme (every pod its own class)
+        (1, 7),       # degenerate single class
+    ],
+)
+def test_compressed_bins_identical_to_per_pod(rng, n_classes, n_pods):
+    reqs = _backlog(rng, n_classes, n_pods)
+    shapes = _shapes(rng, 11)
+    b_ref, ok_ref = binpack_shapes(reqs, shapes, max_bins=256)
+    classes, counts = compress_classes(reqs, pad_to_pow2=True)
+    assert int(counts.sum()) == n_pods
+    b_c, ok_c = binpack_shapes_compressed(
+        classes, counts, shapes, max_bins=256
+    )
+    assert np.array_equal(np.asarray(b_ref), np.asarray(b_c))
+    assert np.array_equal(np.asarray(ok_ref), np.asarray(ok_c))
+
+
+def test_compressed_identity_under_overflow(rng):
+    """max_bins overflow: some pods unplaceable — the ok flags and the
+    bins-needed of partially-packed lanes must still match."""
+    reqs = np.asarray(
+        _backlog(rng, 8, 300), np.float32
+    )
+    shapes = _shapes(rng, 7)
+    b_ref, ok_ref = binpack_shapes(reqs, shapes, max_bins=4)
+    classes, counts = compress_classes(reqs, pad_to_pow2=True)
+    b_c, ok_c = binpack_shapes_compressed(
+        classes, counts, shapes, max_bins=4
+    )
+    assert np.array_equal(np.asarray(b_ref), np.asarray(b_c))
+    assert np.array_equal(np.asarray(ok_ref), np.asarray(ok_c))
+    assert not np.asarray(ok_c).any()  # 300 pods never fit 4 bins
+
+
+def test_count_kernel_matches_expanded_scan_per_bin_capacities(rng):
+    """The headroom form (per-bin capacities, zero rows = full nodes):
+    count-packing classes equals scanning the expanded pod list, LOADS
+    INCLUDED (exact integer arithmetic both sides)."""
+    free = rng.integers(0, 3000, size=(24, R)).astype(np.float32)
+    free[::5] = 0.0  # full nodes
+    reqs = _backlog(rng, 6, 150)
+    ref_cap = np.maximum(free.max(axis=0), 1.0)
+    order_p = np.asarray(ffd_order(reqs, ref_cap))
+    u1, l1, p1 = binpack_ffd(reqs, free, max_bins=24, order=order_p)
+    classes, counts = compress_classes(reqs)
+    order_c = np.asarray(ffd_order(classes, ref_cap))
+    u2, l2, p2 = binpack_ffd_counts(
+        classes, counts, free, max_bins=24, order=order_c
+    )
+    assert int(u1) == int(u2)
+    assert np.array_equal(np.asarray(l1), np.asarray(l2))
+    # per-pod placed bools and per-class placed counts agree in total
+    assert int(np.asarray(p1)[np.any(reqs[order_p] > 0, -1)].sum()) == int(
+        np.asarray(p2).sum()
+    )
+
+
+def test_placed_by_pod_scatter_back(rng):
+    """The documented placed[k]-refers-to-pod-order[k] footgun: the
+    helper scatters scan-position flags back to pod indices."""
+    reqs = np.zeros((6, 2), np.float32)
+    reqs[:, 0] = [10, 1, 8, 1, 9, 1]
+    reqs[:, 1] = 1
+    cap = np.asarray([10.0, 100.0], np.float32)
+    order = np.asarray(ffd_order(reqs, cap))
+    _, _, placed = binpack_ffd(reqs, cap, max_bins=2, order=order)
+    placed = np.asarray(placed)
+    by_pod = placed_by_pod(placed, order)
+    # pods 0 (10) and 2+4 (8+... ) — verify against a hand reference:
+    # order is by size desc: 0(10), 4(9), 2(8), then the 1s.  Two bins
+    # of cap 10: bin0 gets 10; bin1 gets 9; 8 fits nowhere; 1s top up.
+    assert by_pod[0] and by_pod[4] and not by_pod[2]
+    # identity order passes through
+    assert np.array_equal(placed_by_pod(placed), placed)
+    with pytest.raises(ValueError):
+        placed_by_pod(placed, order[:3])
+
+
+def test_what_if_compressed_matches_reference(rng):
+    reqs = _backlog(rng, 16, 400)
+    shapes = _shapes(rng, 9)
+    assert what_if(reqs, shapes, max_bins=128) == what_if(
+        reqs, shapes, max_bins=128, compress=False
+    )
+
+
+def test_what_if_fractional_inputs_fall_back_to_per_pod(rng):
+    """Non-integer requests sit OUTSIDE the count kernel's exactness
+    domain (int32 admissions would truncate 0.5-core requests to free):
+    the public entry must auto-fall-back to the per-pod scan, not
+    silently under-provision."""
+    reqs = rng.uniform(0.1, 2.0, size=(60, R)).astype(np.float32)
+    shapes = rng.uniform(4.0, 16.0, size=(5, R)).astype(np.float32)
+    assert what_if(reqs, shapes, max_bins=64) == what_if(
+        reqs, shapes, max_bins=64, compress=False
+    )
+
+
+def test_compress_classes_weighted_matches_expanded(rng):
+    """The pre-grouped backlog form: weights sum across rows that merge
+    (e.g. after quantization), identical to compressing the expanded
+    per-pod matrix."""
+    vecs = _backlog(rng, 6, 6)  # 6 rows, some duplicated classes
+    weights = rng.integers(1, 40, 6)
+    expanded = np.repeat(vecs, weights, axis=0)
+    c1, n1 = compress_classes(expanded, pad_to_pow2=True)
+    c2, n2 = compress_classes(vecs, pad_to_pow2=True, weights=weights)
+    assert np.array_equal(c1, c2)
+    assert np.array_equal(n1, n2)
+    assert int(n2.sum()) == int(weights.sum())
+
+
+# --------------------------------------------------- sharded shape axis
+
+
+def _mesh(n):
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), ("shapes",))
+
+
+@pytest.mark.sharded
+def test_what_if_sharded_pad_lanes_filtered(rng):
+    """ISSUE 15 satellite: a shape count that does NOT divide the mesh
+    pads with zero-capacity lanes — they must report ok=False inside
+    the kernel and be filtered from the result, and the sharded result
+    must equal the single-chip what_if on the same inputs."""
+    mesh = _mesh(8)
+    reqs = _backlog(rng, 8, 200)
+    shapes = _shapes(rng, 11)  # 11 % 8 = 3 -> 5 padded zero lanes
+    single = what_if(reqs, shapes, max_bins=128)
+    sharded = what_if_sharded(reqs, shapes, mesh, max_bins=128)
+    assert sharded == single
+    assert len(single) > 0
+    # no shape index outside the real catalog may ever surface
+    assert all(0 <= s < shapes.shape[0] for s, _ in sharded)
+    # the kernel-level fact the filter relies on: a zero-capacity lane
+    # reports ok=False for a real backlog
+    classes, counts = compress_classes(reqs, pad_to_pow2=True)
+    padded = np.zeros((16, R), np.float32)
+    padded[:11] = shapes
+    bins, ok = binpack_shapes_compressed(
+        classes, counts, padded, max_bins=128
+    )
+    assert not np.asarray(ok)[11:].any()
+    assert np.asarray(bins)[11:].sum() == 0
+
+
+@pytest.mark.sharded
+def test_what_if_sharded_per_pod_reference_matches(rng):
+    """The uncompressed sharded path stays identical too (the ISSUE 15
+    sharded-leg contract covers both kernels)."""
+    mesh = _mesh(8)
+    reqs = _backlog(rng, 4, 100)
+    shapes = _shapes(rng, 10)
+    assert what_if_sharded(
+        reqs, shapes, mesh, max_bins=64, compress=False
+    ) == what_if(reqs, shapes, max_bins=64, compress=False)
+
+
+# --------------------------------------------------- planner unit tests
+
+
+def _snapshot(n_nodes=6, cpu=8000.0, mem=32 * 2 ** 30, used_frac=0.9,
+              n_empty=2):
+    alloc = np.zeros((n_nodes, R), np.float32)
+    alloc[:, 0] = cpu
+    alloc[:, 1] = mem
+    alloc[:, 3] = 110.0
+    used = np.zeros((n_nodes, R), np.float32)
+    busy = n_nodes - n_empty
+    used[:busy, 0] = cpu * used_frac
+    used[:busy, 1] = mem * used_frac
+    used[:busy, 3] = 20.0
+    valid = np.ones(n_nodes, bool)
+    return alloc, used, valid
+
+
+def test_planner_recommends_scale_up_after_headroom():
+    """The backlog packs into existing headroom FIRST; only the
+    overflow sizes the scale-up, and the recommended shape is the
+    cheapest all-fitting one."""
+    alloc, used, valid = _snapshot()
+    backlog = np.zeros((500, R), np.float32)
+    backlog[:, 0] = 1000.0          # 1 core
+    backlog[:, 1] = 4 * 2 ** 30    # 4Gi
+    backlog[:, 3] = 1.0
+    p = CapacityPlanner(interval_cycles=1, max_bins=256)
+    p.on_cycle(1, lambda cap: backlog, (alloc, used, valid))
+    p.finalize()
+    reco = p.recommendation
+    assert reco is not None
+    assert reco["backlog_pods"] == 500
+    assert reco["classes"] == 1
+    assert reco["compression_x"] == 500.0
+    # 2 empty 8-core nodes + 4 x 10% headroom absorb some of the load
+    assert reco["absorbed_existing"] > 0
+    assert reco["overflow_pods"] == 500 - reco["absorbed_existing"]
+    assert reco["scale_up"] is not None
+    best = reco["scale_up"]
+    # every runner-up needs at least as many nodes
+    for r in reco["runners_up"]:
+        assert r["count"] >= best["count"]
+    # conservative sizing: the recommended count actually covers the
+    # overflow for a 30-core/120Gi shape (one pod = 1 core / 4Gi)
+    assert best["count"] >= reco["overflow_pods"] / 110
+
+
+def test_planner_reports_drainable_when_backlog_empty():
+    alloc, used, valid = _snapshot(n_empty=2)
+    p = CapacityPlanner(interval_cycles=1)
+    p.on_cycle(
+        1, lambda cap: np.zeros((0, R), np.float32),
+        (alloc, used, valid),
+        node_names=lambda: {i: f"node-{i}" for i in range(len(valid))},
+    )
+    p.finalize()
+    reco = p.recommendation
+    assert reco["backlog_pods"] == 0
+    assert reco["overflow_pods"] == 0
+    assert reco["scale_up"] is None
+    assert reco["drainable"]["count"] == 2
+    assert set(reco["drainable"]["nodes"]) == {"node-4", "node-5"}
+
+
+def test_planner_amortizes_dispatch_then_materialize():
+    """The telemetry amortization: a due cycle dispatches; the NEXT due
+    cycle materializes it.  Nothing blocks in between."""
+    alloc, used, valid = _snapshot()
+    backlog = np.zeros((10, R), np.float32)
+    backlog[:, 0] = 100.0
+    backlog[:, 3] = 1.0
+    p = CapacityPlanner(interval_cycles=2)
+    # the first cycle is due immediately (the telemetry convention):
+    # it DISPATCHES but materializes nothing yet
+    p.on_cycle(1, lambda cap: backlog, (alloc, used, valid))
+    assert p.recommendation is None and p.solves_total == 0
+    p.on_cycle(2, lambda cap: backlog, (alloc, used, valid))  # off-interval
+    assert p.recommendation is None and p.solves_total == 0
+    # next due cycle materializes cycle 1's solve and dispatches its own
+    p.on_cycle(3, lambda cap: backlog, (alloc, used, valid))
+    assert p.solves_total == 1
+    assert p.recommendation["cycle"] == 1
+    p.on_cycle(4, lambda cap: backlog, (alloc, used, valid))
+    p.on_cycle(5, lambda cap: backlog, (alloc, used, valid))
+    assert p.solves_total == 2
+    assert p.recommendation["cycle"] == 3
+
+
+def test_planner_accepts_pregrouped_backlog_and_clears_stale_gauge():
+    """The scheduler's walk hands (vectors, counts) — no per-pod matrix
+    — and a changed (or drained) recommendation clears the previous
+    shape's gauge child instead of leaving two 'winners' exported."""
+    from kubernetes_tpu.utils import metrics as m
+
+    alloc, used, valid = _snapshot(n_empty=0)
+    vec = np.zeros((1, R), np.float32)
+    vec[0, 0] = 1000.0
+    vec[0, 1] = 4 * 2 ** 30
+    vec[0, 3] = 1.0
+    p = CapacityPlanner(interval_cycles=1, max_bins=256)
+    p.on_cycle(1, lambda cap: (vec, np.asarray([300])),
+               (alloc, used, valid))
+    p.finalize()
+    reco = p.recommendation
+    assert reco["backlog_pods"] == 300
+    assert reco["scale_up"] is not None
+    first_shape = reco["scale_up"]["shape"]
+    assert m.CAPACITY_RECOMMENDED.child_count() >= 1
+    # backlog drained: the next solve must clear the stale child
+    p.on_cycle(2, lambda cap: np.zeros((0, R), np.float32),
+               (alloc, used, valid))
+    p.finalize()
+    assert p.recommendation["scale_up"] is None
+    exported = m.REGISTRY.expose()
+    assert (
+        f'scheduler_capacity_recommended_nodes{{shape="{first_shape}"}}'
+        not in exported
+    )
+
+
+def test_planner_backlog_cap_and_failed_walk():
+    """The backlog read is bounded and a raising walk costs one sample,
+    never an exception out of the hook."""
+    alloc, used, valid = _snapshot()
+    seen = {}
+
+    def walk(cap):
+        seen["cap"] = cap
+        raise RuntimeError("queue exploded")
+
+    p = CapacityPlanner(interval_cycles=1, backlog_cap=123)
+    p.on_cycle(1, walk, (alloc, used, valid))  # must not raise
+    assert seen["cap"] == 123
+    assert p.solves_total == 0
+
+
+def test_planner_debug_payload_limit():
+    alloc, used, valid = _snapshot()
+    backlog = np.zeros((4, R), np.float32)
+    backlog[:, 0] = 100.0
+    backlog[:, 3] = 1.0
+    p = CapacityPlanner(interval_cycles=1)
+    for c in range(6):
+        p.on_cycle(c, lambda cap: backlog, (alloc, used, valid))
+    p.finalize()
+    body = p.debug_payload()
+    assert body["summary"]["solves"] >= 5
+    assert len(body["samples"]) == body["summary"]["solves"]
+    assert len(p.debug_payload(limit=2)["samples"]) == 2
+
+
+def test_catalog_vectors_units_and_quantization():
+    names, caps = catalog_vectors(
+        [{"name": "s", "cpu": "8", "memory": "32Gi", "pods": 64},
+         {"name": "t", "cpu": "500m", "memory": "1Mi"}],
+        R,
+    )
+    assert names == ["s", "t"]
+    assert caps[0, 0] == 8000.0 and caps[0, 1] == float(32 * 2 ** 30)
+    assert caps[0, 3] == 64.0
+    assert caps[1, 0] == 500.0 and caps[1, 3] == 110.0
+    quanta = quantize_columns(caps.astype(np.float64))
+    # memory column needs scaling below 2**24; cpu/pods do not
+    assert quanta[1] > 1.0 and quanta[0] == 1.0 and quanta[3] == 1.0
+    assert caps[:, 1].max() / quanta[1] < 2 ** 24
+    # power-of-two quanta
+    assert float(np.log2(quanta[1])).is_integer()
+
+
+# --------------------------------------------------- live integration
+
+
+def _live_scheduler(capacity: bool, interval: int = 1, catalog=None):
+    cache = SchedulerCache(SnapshotEncoder())
+    for i in range(6):
+        cache.add_node(make_node(f"n{i}", cpu="4", mem="8Gi"))
+    return Scheduler(
+        cache=cache, queue=PriorityQueue(), binder=lambda p, n: True,
+        config=SchedulerConfig(
+            batch_size=8, batch_window_s=0.0, disable_preemption=True,
+            capacity_planner=capacity,
+            capacity_interval_cycles=interval,
+            node_shape_catalog=catalog,
+        ),
+    )
+
+
+def _drain(s, budget_s=60.0):
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        got = s.run_once(timeout=0.0)
+        if got == 0 and not s.pipeline_pending:
+            if not s.queue.has_schedulable():
+                break
+            time.sleep(0.002)
+    s.flush_pipeline()
+
+
+def test_live_placements_bit_identical_planner_on_off():
+    """The acceptance pin: the scheduling loop's placements are
+    bit-identical with the planner on vs off (the planner only READS
+    immutable snapshot refs and the queue)."""
+    runs = {}
+    for on in (False, True):
+        s = _live_scheduler(on)
+        for i in range(48):
+            # a mix that places some and parks some
+            s.queue.add(make_pod(
+                f"p{i}", cpu="1500m" if i % 3 else "300m", mem="512Mi",
+            ))
+        _drain(s)
+        if on:
+            s.capacity.finalize()
+            assert s.capacity.solves_total > 0
+        runs[on] = {
+            (r.pod.namespace, r.pod.name): r.node for r in s.results
+        }
+    assert runs[True] == runs[False]
+    assert any(n is not None for n in runs[True].values())
+
+
+def test_live_planner_solves_and_serves_debug_endpoint():
+    """A live run with a parked backlog produces a scale-up
+    recommendation, served at /debug/capacity through the default
+    install on the health server."""
+    from kubernetes_tpu.runtime import capacity as capacity_mod
+    from kubernetes_tpu.runtime.health import start_health_server
+
+    old = capacity_mod.get_default()
+    s = _live_scheduler(
+        True,
+        catalog=[{"name": "big", "cpu": "64", "memory": "256Gi"}],
+    )
+    try:
+        for i in range(40):
+            s.queue.add(make_pod(f"q{i}", cpu="2500m", mem="1Gi"))
+        _drain(s)
+        s.capacity.finalize()
+        reco = s.capacity.recommendation
+        assert reco is not None
+        assert reco["overflow_pods"] > 0
+        assert reco["scale_up"]["shape"] == "big"
+        assert reco["scale_up"]["count"] >= 1
+        srv = start_health_server()
+        try:
+            h, p = srv.address
+            with urllib.request.urlopen(
+                f"http://{h}:{p}/debug/capacity?limit=3", timeout=10
+            ) as r:
+                body = json.loads(r.read())
+        finally:
+            srv.stop()
+        assert body["summary"]["recommendation"]["scale_up"]["shape"] == (
+            "big"
+        )
+        assert len(body["samples"]) <= 3
+    finally:
+        capacity_mod.set_default(old)
+
+
+def test_backlog_req_vector_is_read_only():
+    """The planner's backlog encoding must not grow the resource axis,
+    intern anything, or dirty rows (placement identity rides on it)."""
+    enc = SnapshotEncoder()
+    enc.add_node(make_node("n0", cpu="4", mem="8Gi"))
+    enc.snapshot()
+    r0 = enc.dims.R
+    dirty0 = len(enc._dirty_rows) if hasattr(enc, "_dirty_rows") else None
+    pod = make_pod("x", cpu="250m", mem="64Mi")
+    # an extended resource no node ever advertised: dropped, not grown
+    pod.spec.containers[0].requests["vendor.example/gpu"] = (
+        __import__(
+            "kubernetes_tpu.api.resource", fromlist=["parse_quantity"]
+        ).parse_quantity("2")
+    )
+    v = enc.backlog_req_vector(pod)
+    assert v.shape == (r0,)
+    assert v[0] == 250.0 and v[3] == 1.0
+    assert enc.dims.R == r0
+    if dirty0 is not None:
+        assert len(enc._dirty_rows) == dirty0
+    # the queue's backlog snapshot spans active + unschedulable
+    q = PriorityQueue()
+    q.add(make_pod("a", cpu="1"))
+    q.add_unschedulable(make_pod("b", cpu="1"), cycle=0)
+    pods = q.backlog_pods()
+    assert {p.name for p in pods} == {"a", "b"}
+    assert len(q.backlog_pods(limit=1)) == 1
+
+
+@pytest.mark.perf_smoke
+def test_capacity_hook_overhead_under_2_percent():
+    """The planner's scheduling-thread cost — backlog walk + class
+    compression + solve dispatch, amortized over the interval — stays
+    under 2% of cycle wall (the telemetry/quality discipline)."""
+    from kubernetes_tpu.utils import metrics as m
+
+    # the production-shaped cadence: the default interval is 256; 64
+    # amortizes the walk+compress+dispatch cost over enough cycles to
+    # be representative while still materializing solves in-run
+    s = _live_scheduler(True, interval=64)
+    # warm the solve executables outside the timed window at EVERY
+    # padded class depth the timed drain can hit (the backlog shrinks
+    # toward 1/0 classes as it empties): the pin measures the steady
+    # state, not the one-time XLA compiles the engines also pre-pay
+    # via prewarm in production
+    s.capacity.interval_cycles = 1
+    for i in range(40):
+        s.queue.add(make_pod(
+            f"w{i}", cpu="900m" if i % 2 else "200m", mem="256Mi",
+        ))
+    _drain(s)
+    s.capacity.finalize()
+    assert s.capacity.solves_total > 0
+    s.capacity.interval_cycles = 64
+    for i in range(1024):
+        s.queue.add(make_pod(
+            f"s{i}", cpu="900m" if i % 2 else "200m", mem="256Mi",
+        ))
+    spent0 = float(m.CAPACITY_SECONDS.value)
+    t0 = time.monotonic()
+    _drain(s)
+    wall = time.monotonic() - t0
+    spent = float(m.CAPACITY_SECONDS.value) - spent0
+    s.capacity.finalize()
+    assert s.capacity.solves_total > 0
+    ratio = spent / max(wall, 1e-9)
+    assert ratio < 0.02, (
+        f"capacity hook cost {spent:.4f}s of {wall:.3f}s wall "
+        f"({ratio:.1%} >= 2%)"
+    )

@@ -71,6 +71,12 @@ def _check_shapes(bodies: dict):
     assert {"summary", "samples"} <= set(bodies["/debug/quality"])
     q = bodies["/debug/quality"]["summary"]
     assert {"margin", "feasible", "regret", "drift"} <= set(q)
+    # capacity planner (ISSUE 15): the endpoint must register and
+    # answer on BOTH servers with the summary/samples payload shape
+    assert {"summary", "samples"} <= set(bodies["/debug/capacity"])
+    cap = bodies["/debug/capacity"]["summary"]
+    assert {"solves", "interval_cycles", "catalog_shapes",
+            "recommendation"} <= set(cap)
     # the profile body reports an outcome either way (started, throttled,
     # in-progress, or unsupported) — never raises into a 500
     assert isinstance(bodies["/debug/profile"], dict)

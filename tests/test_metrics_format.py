@@ -165,6 +165,15 @@ def test_metrics_exposition_valid_after_mixed_live_workload():
             # ISSUE 7 satellites: the attribution + ledger families must
             # survive the strict parser with live values
             attribution=True, decision_ledger=True,
+            # ISSUE 15: the capacity families must carry live values —
+            # interval 1 so the 'never' pod's parked backlog solves and
+            # materializes within the two cycles below; the 64-core
+            # shape makes the overflow fit SOME catalog entry, so the
+            # labeled recommended-nodes gauge gets a child
+            capacity_planner=True, capacity_interval_cycles=1,
+            node_shape_catalog=[
+                {"name": "metrics-big", "cpu": "128", "memory": "512Gi"},
+            ],
         ),
     )
     # TWO nodes: the placed pod then has a runner-up, so the quality
@@ -185,6 +194,10 @@ def test_metrics_exposition_valid_after_mixed_live_workload():
     finally:
         dis.clear_device_faults()
     assert sched.device_health.state == "open"
+    # materialize the in-flight capacity solve (dispatched on the last
+    # cycle with 'never' parked unschedulable) so the gauges below
+    # carry the live backlog/overflow/recommendation values
+    sched.capacity.finalize()
 
     srv = start_health_server()
     try:
@@ -278,6 +291,37 @@ def test_metrics_exposition_valid_after_mixed_live_workload():
         == "counter"
     )
     assert families["scheduler_quality_seconds_total"]["samples"][0][2] > 0
+    # ISSUE 15 satellites: the capacity families survive the strict
+    # parser WITH live values — the hook stamped its cost counter, the
+    # 'never' pod's parked backlog drove a materialized solve (backlog/
+    # overflow gauges non-zero), and the 128-core catalog shape fit the
+    # overflow so the labeled recommendation gauge carries a child
+    assert (
+        families["scheduler_capacity_seconds_total"]["type"] == "counter"
+    )
+    assert (
+        families["scheduler_capacity_seconds_total"]["samples"][0][2] > 0
+    )
+    assert families["scheduler_capacity_solves_total"]["samples"][0][2] > 0
+    backlog = {
+        lbl["kind"]: v
+        for _, lbl, v in
+        families["scheduler_capacity_backlog"]["samples"]
+    }
+    assert backlog.get("pods", 0) >= 1, backlog
+    assert backlog.get("classes", 0) >= 1, backlog
+    assert (
+        families["scheduler_capacity_overflow_pods"]["samples"][0][2] >= 1
+    )
+    reco = {
+        lbl["shape"]: v
+        for _, lbl, v in
+        families["scheduler_capacity_recommended_nodes"]["samples"]
+    }
+    assert reco.get("metrics-big", 0) >= 1, reco
+    for fam in ("scheduler_capacity_absorbed_pods",
+                "scheduler_capacity_drainable_nodes"):
+        assert families[fam]["type"] == "gauge"
 
 
 def test_quality_family_cardinality_bounded():
