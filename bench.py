@@ -576,6 +576,26 @@ def run(args) -> dict:
             detail["sharded"] = _sharded_stage(args)
         except Exception as e:  # noqa: BLE001
             detail["sharded_error"] = f"{type(e).__name__}: {e}"
+        # ---- scenario stage (ISSUE 18): a scaled-down rolling-drain
+        # campaign through the trace engine — mass displacement through
+        # the shed-exempt requeue path with the invariant checker as the
+        # oracle, banking the recovery tail (reschedule p99) and the
+        # goodput-during-event ratio the gate rows track.  CPU child
+        # only like its siblings (a control-plane robustness figure;
+        # --scenario is the standalone full-scale campaign)
+        try:
+            from kubernetes_tpu.runtime.scenario import run_scenario
+
+            scen = run_scenario(
+                "drain", seed=args.scenario_seed, pods=120, nodes=10,
+                rate=120.0, drain_timeout_s=60.0,
+            ).to_dict()
+            scen["clean"] = (
+                scen["lost"] == 0 and scen["violations"] == 0
+            )
+            detail["scenario"] = scen
+        except Exception as e:  # noqa: BLE001
+            detail["scenario_error"] = f"{type(e).__name__}: {e}"
     out = {
         "metric": "pods_scheduled_per_sec_5k_nodes",
         "value": round(pods_per_s, 1),
@@ -663,6 +683,15 @@ def run(args) -> dict:
                 "identical", True
             )
         )
+    if "scenario" in detail:
+        # the lifecycle-robustness acceptance trio, tracked at top
+        # level: displaced pods reschedule within the banked tail,
+        # goodput holds through the event, and the run was CLEAN (zero
+        # lost pods, zero invariant violations — the hard oracle)
+        out["scenario_reschedule_p99_ms"] = detail["scenario"][
+            "reschedule_ms"]["p99"]
+        out["scenario_goodput_ratio"] = detail["scenario"]["goodput_ratio"]
+        out["scenario_clean"] = detail["scenario"]["clean"]
     if "sharded" in detail:
         # the multi-chip acceptance, tracked at top level: sharded
         # placements bit-identical to single-chip on this very run
@@ -1032,6 +1061,52 @@ def run_overload(args) -> dict:
             "batch_baseline": baseline,
             "recovered": recovered,
         },
+    }
+
+
+def run_scenario_metric(args) -> dict:
+    """--scenario {drain,zone,diurnal,trace}: the trace-driven lifecycle
+    campaign (ISSUE 18, runtime/scenario.py) against the LIVE scheduler —
+    arrivals replayed under a virtual clock, chaos (rolling drain / zone
+    outage / diurnal swing) composed at trace time, the invariant checker
+    as the pass/fail oracle.  Banks the recovery figures the gate rows
+    track: displaced-pod reschedule p99, goodput ratio during the event,
+    time-to-drain — and `scenario_clean` (zero lost pods AND zero
+    invariant violations), which CI asserts.  --ledger-out records every
+    cycle so `bench.py --replay` re-verifies the window bit-identically
+    offline; --scenario-trace replays an external Alibaba/Google-format
+    trace file instead of the synthetic generator."""
+    from kubernetes_tpu.runtime.scenario import run_scenario
+
+    ledger = None
+    if getattr(args, "ledger_out", None):
+        from kubernetes_tpu.runtime.ledger import DecisionLedger
+
+        ledger = DecisionLedger(path=args.ledger_out)
+    res = run_scenario(
+        args.scenario,
+        seed=args.scenario_seed,
+        pods=args.scenario_pods,
+        nodes=args.scenario_nodes,
+        rate=args.scenario_rate,
+        compression=args.scenario_compression,
+        trace_path=args.scenario_trace,
+        ledger=ledger,
+    )
+    d = res.to_dict()
+    clean = res.lost == 0 and res.violations == 0
+    return {
+        "metric": f"scenario_{args.scenario}_reschedule_p99_ms",
+        "value": res.reschedule_ms.get("p99", 0.0),
+        "unit": "ms",
+        "scenario_clean": clean,
+        "scenario_lost": res.lost,
+        "scenario_violations": res.violations,
+        "scenario_displaced": res.displaced,
+        "scenario_reschedule_p99_ms": res.reschedule_ms.get("p99", 0.0),
+        "scenario_goodput_ratio": res.goodput_ratio,
+        "scenario_time_to_drain_s": res.time_to_drain_s,
+        "detail": {"scenario": d},
     }
 
 
@@ -2313,6 +2388,8 @@ def run_child(args) -> None:
                 result = run_replicas_metric(args)
             elif args.sharded:
                 result = run_sharded_metric(args)
+            elif args.scenario:
+                result = run_scenario_metric(args)
             else:
                 result = run(args)
         except Exception as e:  # compile/runtime failure mid-run
@@ -2433,6 +2510,15 @@ def _child_cmd(args, platform: str | None) -> list:
         cmd += ["--sharded",
                 "--sharded-nodes", str(args.sharded_nodes),
                 "--sharded-encode-nodes", str(args.sharded_encode_nodes)]
+    if args.scenario:
+        cmd += ["--scenario", args.scenario]
+        if args.scenario_trace:
+            cmd += ["--scenario-trace", args.scenario_trace]
+    cmd += ["--scenario-pods", str(args.scenario_pods),
+            "--scenario-nodes", str(args.scenario_nodes),
+            "--scenario-rate", str(args.scenario_rate),
+            "--scenario-compression", str(args.scenario_compression),
+            "--scenario-seed", str(args.scenario_seed)]
     # always forwarded (like --mesh-shape): the default report's sharded
     # stage must honor an explicit --shard-devices (including 0 = skip),
     # not have the child re-default it
@@ -2497,11 +2583,12 @@ def orchestrate(args) -> None:
     remaining = deadline - time.time()
     tpu_min = args.tpu_min_budget
     if (args.platform == "cpu" or args.density or args.overload
-            or args.tiered or args.sharded or args.megacycle):
+            or args.tiered or args.sharded or args.megacycle
+            or args.scenario):
         # explicit cpu-only run, or density/overload/tiered/sharded/
-        # megacycle mode (control-plane benchmarks — the host runtime
-        # dominates, not the device; the sharded identity pin runs on
-        # the virtual cpu mesh)
+        # megacycle/scenario mode (control-plane benchmarks — the host
+        # runtime dominates, not the device; the sharded identity pin
+        # runs on the virtual cpu mesh)
         remaining = 0
     if remaining < tpu_min:
         det = banked["result"].setdefault("detail", {})
@@ -2636,6 +2723,16 @@ _BASELINE_CHECKS = (
     ("autoscale_shapes_per_s",
      ("autoscale_shapes_per_s", "detail.autoscale.shapes_per_s"),
      "higher", 1.0),
+    # scenario engine (ISSUE 18): recovery from a rolling drain must not
+    # degrade — displaced pods reschedule within the banked tail (a
+    # regression here means the displaced requeue path slowed or broke)
+    # and goodput during the event holds its ratio to the pre-event rate
+    ("scenario_reschedule_p99_ms",
+     ("scenario_reschedule_p99_ms", "detail.scenario.reschedule_ms.p99"),
+     "lower", 2.0),
+    ("scenario_goodput_ratio",
+     ("scenario_goodput_ratio", "detail.scenario.goodput_ratio"),
+     "higher", 1.5),
 )
 
 # phase-second growth is noisy at smoke scale: a phase only regresses
@@ -2960,6 +3057,31 @@ def main():
                     "plus a multi-tenant storm asserting no tenant "
                     "starves and no popped pod is lost; 0 = off (the "
                     "default report still runs a scaled-down N=2 stage)")
+    ap.add_argument(
+        "--scenario", default=None,
+        choices=["drain", "zone", "diurnal", "trace"],
+        help="trace-driven lifecycle campaign (runtime/scenario.py) "
+             "against the live scheduler: a synthetic (or --scenario-trace "
+             "file) arrival trace replayed under a virtual clock with the "
+             "named chaos composed mid-trace — rolling drain, zone outage, "
+             "diurnal load swing — scored by the invariant checker (zero "
+             "lost pods, zero violations) plus displaced-reschedule p99 / "
+             "goodput-during-event / time-to-drain; --ledger-out records "
+             "the window for --replay re-verification")
+    ap.add_argument("--scenario-pods", type=int, default=600,
+                    help="arrivals in the scenario trace")
+    ap.add_argument("--scenario-nodes", type=int, default=24,
+                    help="cluster size for the scenario")
+    ap.add_argument("--scenario-rate", type=float, default=120.0,
+                    help="mean arrival rate, pods per virtual second")
+    ap.add_argument("--scenario-compression", type=float, default=1.0,
+                    help="virtual seconds per wall second (60 replays an "
+                         "hour-long trace in a minute)")
+    ap.add_argument("--scenario-seed", type=int, default=0,
+                    help="seed for the synthetic trace AND the chaos rng")
+    ap.add_argument("--scenario-trace", default=None,
+                    help="external trace file (CSV/JSON, Alibaba/Google "
+                         "column aliases) for --scenario trace")
     ap.add_argument("--sharded", action="store_true",
                     help="multi-chip live-path scenario (ISSUE 9): the "
                     "same pod stream through the real Scheduler single-"

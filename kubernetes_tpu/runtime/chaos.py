@@ -13,6 +13,8 @@ suites compose cluster and accelerator failure in one storm.
 
 from __future__ import annotations
 
+import dataclasses
+import math
 import random
 import threading
 import time
@@ -78,7 +80,19 @@ class Chaosmonkey:
 
 
 class Disruptions:
-    """Fault injectors over the LocalCluster world + the device datapath."""
+    """Fault injectors over the LocalCluster world + the device datapath.
+
+    Determinism contract (ISSUE 18): every random choice any primitive
+    makes — victim sampling in kill_random_pods, the drain ORDER when
+    rolling_drain is given no explicit node list, the zone pick when
+    zone_outage is given none, the device FaultInjector's seed — draws
+    from the ONE instance `rng` (`random.Random(seed)`; default seed 0).
+    Two Disruptions built with the same seed against the same cluster
+    state make identical choices in identical order, so a failing chaos
+    scenario reproduces from its logged seed alone.  Primitives take no
+    other entropy: wall-clock pacing affects WHEN faults land, never
+    WHICH — pass explicit node lists / zones / `now` timestamps to pin
+    the remaining degrees of freedom for bit-exact replay."""
 
     def __init__(self, cluster: LocalCluster, rng: Optional[random.Random] = None):
         self.cluster = cluster
@@ -139,6 +153,226 @@ class Disruptions:
                 lag = t0 + (i + 1) * interval - time.monotonic()
                 if lag > 0:
                     time.sleep(lag)
+        return names
+
+    # --------------------------------------------- cluster-lifecycle chaos
+    #
+    # ISSUE 18: the correlated cluster-level events the ladder never
+    # faced.  All three drive the REAL seams — cordon + the PDB/429
+    # eviction path (controllers.try_evict), the NodeLifecycleController
+    # taint/eviction monitor, the bounded queue's AIMD pressure — so a
+    # scenario exercises mass requeue and recovery end to end, with the
+    # invariant checker as the pass/fail oracle.
+
+    def rolling_drain(
+        self,
+        nodes: Optional[List[str]] = None,
+        wave_size: int = 2,
+        mode: str = "displace",
+        retry_rounds: int = 8,
+        retry_after_s: float = 0.05,
+    ) -> dict:
+        """Rolling node drain (the upgrade monkey): cordon + evict in
+        waves of `wave_size` through the PDB-respecting eviction seam
+        (controllers.try_evict — the pods/eviction subresource's 429 +
+        Retry-After semantics).  `nodes` None drains EVERY node in an
+        rng-shuffled order (seeded: same seed, same order); an explicit
+        list drains exactly those, in that order.
+
+        A PDB-blocked eviction is retried up to `retry_rounds` times,
+        each round paced by the refusal's Retry-After hint (capped at
+        `retry_after_s` so tests stay fast) — bounded progress, never a
+        spin.  Pods still blocked after the rounds are SKIPPED: the wave
+        records them, emits a DrainBlocked Warning event on the node,
+        and moves on.  mode "displace" (default) revokes bindings in
+        place so the same pods re-enter the queue shed-exempt;
+        mode "delete" is the reference kubectl-drain behavior.
+
+        Returns {"order", "waves", "evicted", "blocked_retries",
+        "skipped"} — skipped non-empty means PDBs held the line."""
+        from kubernetes_tpu.runtime.controllers import (
+            EvictionBlocked,
+            try_evict,
+        )
+
+        if nodes is None:
+            nodes = sorted(n.name for n in self.cluster.list("nodes"))
+            self.rng.shuffle(nodes)
+        wave_size = max(1, int(wave_size))
+        evicted: List[tuple] = []
+        skipped: List[tuple] = []
+        retries = 0
+        waves = 0
+        for w0 in range(0, len(nodes), wave_size):
+            wave = nodes[w0:w0 + wave_size]
+            waves += 1
+            for name in wave:
+                self._cordon(name)
+            pending = [
+                p for p in self.cluster.list("pods")
+                if p.spec.node_name in wave
+                and p.status.phase not in ("Succeeded", "Failed")
+            ]
+            for round_i in range(retry_rounds + 1):
+                blocked: List[tuple] = []
+                pause = 0.0
+                for p in pending:
+                    try:
+                        if try_evict(self.cluster, p, mode=mode,
+                                     reason="drain",
+                                     retry_after_s=retry_after_s):
+                            evicted.append((p.namespace, p.name,
+                                            p.spec.node_name))
+                    except EvictionBlocked as e:
+                        blocked.append((p, e))
+                        pause = max(pause, min(e.retry_after_s,
+                                               retry_after_s))
+                if not blocked:
+                    pending = []
+                    break
+                pending = [p for p, _ in blocked]
+                retries += len(blocked)
+                if round_i < retry_rounds and pause > 0:
+                    time.sleep(pause)  # the Retry-After pacing bound
+            for p in pending:  # budget never reopened: skip, don't spin
+                skipped.append((p.namespace, p.name, p.spec.node_name))
+                self.cluster.events.eventf(
+                    "Node", "", p.spec.node_name, "Warning", "DrainBlocked",
+                    "pod %s/%s eviction blocked by PDB after %d rounds; "
+                    "skipping", p.namespace, p.name, retry_rounds,
+                )
+        return {
+            "order": list(nodes),
+            "waves": waves,
+            "evicted": evicted,
+            "blocked_retries": retries,
+            "skipped": skipped,
+        }
+
+    def _cordon(self, node_name: str) -> None:
+        """kubectl cordon: spec.unschedulable = True (the scheduler's
+        node-unschedulable filter stops NEW placements; running pods stay
+        until evicted)."""
+        node = self.cluster.get("nodes", "", node_name)
+        if node is None or node.spec.unschedulable:
+            return
+        self.cluster.update(
+            "nodes",
+            dataclasses.replace(
+                node,
+                spec=dataclasses.replace(node.spec, unschedulable=True),
+            ),
+        )
+
+    def uncordon(self, node_name: str) -> None:
+        """Undo a drain's cordon (the post-upgrade return to service)."""
+        node = self.cluster.get("nodes", "", node_name)
+        if node is None or not node.spec.unschedulable:
+            return
+        self.cluster.update(
+            "nodes",
+            dataclasses.replace(
+                node,
+                spec=dataclasses.replace(node.spec, unschedulable=False),
+            ),
+        )
+
+    def zone_outage(
+        self,
+        zone: Optional[str] = None,
+        lifecycle=None,
+        now: Optional[float] = None,
+    ) -> dict:
+        """Correlated node loss (the zone-failure monkey): every node
+        labeled with `zone` (failure-domain zone key) goes silent at
+        once — their leases are backdated past the lifecycle grace and
+        the monitor runs, so the whole zone is tainted unreachable and
+        its pods mass-evicted through the controller's real path.
+        `zone` None picks one rng-uniform from the zones present
+        (seeded: same seed, same zone).  `lifecycle` defaults to a
+        displace-mode NodeLifecycleController so the displaced pods
+        re-enter the queue for mass rescheduling; pass your own to keep
+        one controller across the scenario.  Returns {"zone", "nodes",
+        "evicted"} (the controller's eviction delta)."""
+        from kubernetes_tpu.api.factory import ZONE_KEY
+        from kubernetes_tpu.runtime.controllers import (
+            NodeLifecycleController,
+            renew_node_lease,
+        )
+
+        if lifecycle is None:
+            lifecycle = NodeLifecycleController(
+                self.cluster, grace_period=1.0, eviction_mode="displace"
+            )
+        if zone is None:
+            zones = sorted({
+                n.labels.get(ZONE_KEY)
+                for n in self.cluster.list("nodes")
+                if n.labels.get(ZONE_KEY)
+            })
+            if not zones:
+                return {"zone": None, "nodes": [], "evicted": []}
+            zone = self.rng.choice(zones)
+        now = time.monotonic() if now is None else now
+        dead = [
+            n.name for n in self.cluster.list("nodes")
+            if n.labels.get(ZONE_KEY) == zone
+        ]
+        stale = now - lifecycle.grace - 1.0
+        for name in dead:
+            # upsert a STALE lease: covers both a heartbeating node going
+            # silent and a never-heartbeated node (no lease = invisible to
+            # the monitor, which would mask the outage)
+            renew_node_lease(self.cluster, name, now=stale)
+        before = len(lifecycle.evictions)
+        lifecycle.monitor(now=now)
+        return {
+            "zone": zone,
+            "nodes": dead,
+            "evicted": list(lifecycle.evictions[before:]),
+        }
+
+    def diurnal_load(
+        self,
+        make_pod: Callable[[int], object],
+        period_s: float,
+        amplitude: float,
+        base_rate: float,
+        cycles: float = 1.0,
+        slices_per_period: int = 32,
+    ) -> List[str]:
+        """Diurnal load swing (the day/night monkey): offered create
+        rate r(t) = base_rate * (1 + amplitude * sin(2*pi*t/period_s)),
+        poured through the cluster write path for `cycles` periods —
+        the swing drives AIMD batch sizing up the peak and back down the
+        trough, and gives the capacity planner a breathing backlog.  Pod
+        COUNT per slice is a pure function of the arguments (floor-
+        accumulated, no rng), so two runs offer identical pod sequences;
+        the wall clock only paces delivery, exactly like overload_storm.
+        amplitude in [0, 1); base_rate in pods/s.  Returns the created
+        pod names."""
+        amplitude = max(0.0, min(float(amplitude), 0.999))
+        n_slices = max(1, int(slices_per_period * cycles))
+        dt = period_s / slices_per_period
+        names: List[str] = []
+        t0 = time.monotonic()
+        offered = 0.0
+        created = 0
+        for s in range(n_slices):
+            t_mid = (s + 0.5) * dt
+            rate = base_rate * (
+                1.0 + amplitude * math.sin(2.0 * math.pi * t_mid / period_s)
+            )
+            offered += max(rate, 0.0) * dt
+            want = int(math.floor(offered)) - created
+            for _ in range(want):
+                pod = make_pod(created)
+                self.cluster.add_pod(pod)
+                names.append(pod.name)
+                created += 1
+            lag = t0 + (s + 1) * dt - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
         return names
 
     # ------------------------------------------------- device-layer faults

@@ -33,6 +33,16 @@ from kubernetes_tpu.runtime.events import EventRecorder
 
 ADDED, MODIFIED, DELETED = "ADDED", "MODIFIED", "DELETED"
 
+# stamped onto a pod whose binding a cluster-lifecycle event revoked
+# (NodeLifecycleController eviction in displace mode, a drain wave, a
+# zone outage — ISSUE 18).  wire_scheduler routes annotated unassigned
+# pods through the shed-exempt displaced requeue path
+# (PriorityQueue.readd_displaced + InvariantChecker.note_displaced)
+# instead of the sheddable arrival path; the annotation value names the
+# displacing event and is cleared by the next bind's informer echo
+# being irrelevant (binds don't strip it — the value records history).
+DISPLACED_BY_ANNOTATION = "kubernetes-tpu.io/displaced-by"
+
 
 class ConflictError(Exception):
     """resourceVersion mismatch (etcd3 txn failure analog)."""
@@ -342,6 +352,36 @@ class LocalCluster:
             )
             return True
 
+    def displace_pod(self, pod: Pod, reason: str) -> bool:
+        """Revoke a pod's binding for a cluster-lifecycle event: clear
+        spec.nodeName AND stamp the displaced-by annotation, one store
+        write (ISSUE 18).  Unlike delete, the pod keeps its identity —
+        wire_scheduler's MODIFIED unassigned branch sees the annotation
+        and re-admits it through the shed-exempt displaced requeue path,
+        so a mass eviction is a mass reschedule, never pod loss.
+        Returns False when the pod is gone or already unbound."""
+        import dataclasses
+
+        with self._lock:
+            cur = self.get("pods", pod.namespace, pod.name)
+            if cur is None or not cur.spec.node_name:
+                return False
+            self.update(
+                "pods",
+                dataclasses.replace(
+                    cur,
+                    metadata=dataclasses.replace(
+                        cur.metadata,
+                        annotations={
+                            **cur.metadata.annotations,
+                            DISPLACED_BY_ANNOTATION: reason,
+                        },
+                    ),
+                    spec=dataclasses.replace(cur.spec, node_name=""),
+                ),
+            )
+            return True
+
     def bind(self, pod: Pod, node_name: str, trace_id: str = "") -> bool:
         """The Binding-subresource analog (registry sets spec.nodeName,
         SURVEY section 3.3): CAS on the stored pod.  A non-empty trace_id
@@ -457,7 +497,26 @@ def wire_scheduler(cluster: LocalCluster, scheduler) -> None:
                     # spec update while pending: re-queue the fresh copy
                     queue.delete(obj)
                     if responsible(obj):
-                        queue.add(obj)
+                        reason = obj.metadata.annotations.get(
+                            DISPLACED_BY_ANNOTATION
+                        )
+                        if reason and hasattr(queue, "readd_displaced"):
+                            # lifecycle displacement (ISSUE 18): close the
+                            # checker's bound mark FIRST (the pod is not a
+                            # popped-and-unresolved entry, it was running),
+                            # then re-admit shed-exempt + shed-protected
+                            inv = getattr(scheduler, "invariants", None)
+                            if inv is not None:
+                                inv.note_displaced(obj)
+                            from kubernetes_tpu.utils import metrics as _m
+
+                            _m.PODS_DISPLACED.inc(reason=reason)
+                            queue.readd_displaced(obj)
+                            # the freed node capacity may revive parked
+                            # unschedulable pods, same as a delete would
+                            queue.move_all_to_active()
+                        else:
+                            queue.add(obj)
             else:
                 if assigned:
                     cache.remove_pod(obj)

@@ -47,6 +47,7 @@ from kubernetes_tpu.api.types import (
 from kubernetes_tpu.runtime.cluster import (
     ADDED,
     DELETED,
+    DISPLACED_BY_ANNOTATION,
     MODIFIED,
     ConflictError,
     LocalCluster,
@@ -55,6 +56,75 @@ from kubernetes_tpu.runtime.cluster import (
 TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
 TAINT_NOT_READY = "node.kubernetes.io/not-ready"
 LEASE_NAMESPACE = "kube-node-lease"
+
+# NodeLifecycleController eviction modes (ISSUE 18): "delete" is the
+# reference behavior (TaintBasedEviction deletes; owning controllers
+# recreate), "displace" revokes the binding in place — the pod keeps its
+# identity, gets the displaced-by annotation, and re-enters the
+# scheduling queue through the shed-exempt displaced requeue path
+# (wire_scheduler), so a node loss is a mass RESCHEDULE of the same
+# pods, trackable end to end by the invariant checker
+EVICT_DELETE = "delete"
+EVICT_DISPLACE = "displace"
+
+
+class EvictionBlocked(Exception):
+    """A PDB vetoed the eviction (the 429 TooManyRequests analog of the
+    pods/eviction subresource).  Carries the Retry-After pacing hint and
+    the blocking budget's name so drain loops can back off instead of
+    spinning — apiserver/server.py constructs the same refusal over HTTP."""
+
+    def __init__(self, pdb_name: str, retry_after_s: float):
+        super().__init__(
+            "Cannot evict pod as it would violate the pod's disruption "
+            f"budget {pdb_name!r}"
+        )
+        self.pdb_name = pdb_name
+        self.retry_after_s = retry_after_s
+
+
+def try_evict(cluster: LocalCluster, pod: Pod, *,
+              mode: str = EVICT_DELETE,
+              reason: str = "eviction",
+              retry_after_s: float = 1.0) -> bool:
+    """The pods/eviction subresource's store-level analog (registry/core/
+    pod/rest/eviction.go; the HTTP twin lives in apiserver/server.py):
+    grant the eviction only if every PDB matching the pod still allows a
+    disruption, consuming one unit of each matching budget immediately
+    (the async DisruptionController recompute closes behind it — the
+    thundering-drain race the reference decrements against too).
+
+    Blocked -> raises EvictionBlocked carrying `retry_after_s` (the
+    Retry-After pacing a drain wave must honor); granted -> True after
+    deleting (EVICT_DELETE) or displacing (EVICT_DISPLACE, ISSUE 18) the
+    pod; False when the pod is already gone/unbound (nothing to evict).
+    The PDB check + budget decrement + pod write run under the store
+    lock, exactly like the apiserver path runs under its write lock."""
+    with cluster._lock:
+        cur = cluster.get("pods", pod.namespace, pod.name)
+        if cur is None:
+            return False
+        matching = [
+            pdb for pdb in cluster.list("poddisruptionbudgets")
+            if pdb.namespace == pod.namespace and pdb.matches(cur)
+        ]
+        blocked = next(
+            (p.name for p in matching if p.disruptions_allowed <= 0), None
+        )
+        if blocked is not None:
+            raise EvictionBlocked(blocked, retry_after_s)
+        for pdb in matching:
+            cluster.update(
+                "poddisruptionbudgets",
+                dataclasses.replace(
+                    pdb,
+                    disruptions_allowed=max(0, pdb.disruptions_allowed - 1),
+                ),
+            )
+        if mode == EVICT_DISPLACE:
+            return cluster.displace_pod(cur, reason)
+        cluster.delete("pods", pod.namespace, pod.name)
+        return True
 
 
 # ---------------------------------------------------------------- workqueue
@@ -429,9 +499,19 @@ class NodeLifecycleController:
     the unreachable NoExecute taint and their pods evicted; recovered nodes
     are restored.  Drive monitor(now) from a loop or directly in tests."""
 
-    def __init__(self, cluster: LocalCluster, grace_period: float = 40.0):
+    def __init__(self, cluster: LocalCluster, grace_period: float = 40.0,
+                 eviction_mode: str = EVICT_DELETE):
+        if eviction_mode not in (EVICT_DELETE, EVICT_DISPLACE):
+            raise ValueError(
+                f"eviction_mode {eviction_mode!r}: "
+                f"expected {EVICT_DELETE!r} or {EVICT_DISPLACE!r}"
+            )
         self.cluster = cluster
         self.grace = grace_period
+        # "delete" = the reference TaintBasedEviction (controllers
+        # recreate); "displace" = revoke the binding in place so the SAME
+        # pod re-enters the scheduling queue shed-exempt (ISSUE 18)
+        self.eviction_mode = eviction_mode
         self.evictions: List[Tuple[str, str, str]] = []  # (ns, pod, node)
 
     def _lease_age(self, node_name: str, now: float) -> Optional[float]:
@@ -506,7 +586,11 @@ class NodeLifecycleController:
                 and p.status.phase not in ("Succeeded", "Failed")
                 and not _tolerates_noexecute(p)
             ):
-                self.cluster.delete("pods", p.namespace, p.name)
+                if self.eviction_mode == EVICT_DISPLACE:
+                    if not self.cluster.displace_pod(p, "node-lifecycle"):
+                        continue  # already unbound/gone: nothing to do
+                else:
+                    self.cluster.delete("pods", p.namespace, p.name)
                 self.evictions.append((p.namespace, p.name, node.name))
 
     def _restore(self, node: Node) -> None:

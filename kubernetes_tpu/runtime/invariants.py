@@ -187,6 +187,23 @@ class InvariantChecker:
             self._bound.pop(key, None)
         self._fire_callbacks()
 
+    def note_displaced(self, pod) -> None:
+        """A BOUND pod was displaced back toward the queue by a
+        cluster-lifecycle event (NodeLifecycleController eviction, a
+        drain wave, a zone outage): clear the bound mark and drop the
+        tracked entry so the shed-exempt displaced requeue is NOT
+        misread as "resolved twice: bound then requeued" and the pod's
+        next pop opens a fresh conservation window.  Mass eviction is a
+        legal lifecycle transition, not a conservation bug — the rules
+        resume the moment the displaced pod is popped again."""
+        key = self._key(pod)
+        with self._lock:
+            self.events_total += 1
+            self._bound.pop(key, None)
+            entry = self._tracked.pop(key, None)
+            if entry is not None and entry[1] is None:
+                self._outstanding -= 1
+
     def note_removed(self, pod) -> None:
         """The pod left the cluster entirely (preemption victim delete,
         informer delete): clear every mark so a same-name successor

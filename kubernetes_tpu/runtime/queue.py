@@ -204,6 +204,12 @@ class PriorityQueue:
         # key -> monotonic first-enqueue time (cleared on delete / taken at
         # bind-commit for the e2e_scheduling_duration histogram)
         self._enqueued_at: Dict[Tuple[str, str], float] = {}
+        # displaced-pod shed protection (ISSUE 18): pods re-admitted via
+        # readd_displaced (a lifecycle event revoked their binding) are
+        # not shed candidates until their next pop — a mass drain must
+        # not convert running pods into shed ones before the scheduler
+        # gets one retry at placing them.  Cleared on pop and delete.
+        self._shed_protected: set = set()
 
     # ---- sharding ----
 
@@ -310,11 +316,13 @@ class PriorityQueue:
         now = time.monotonic()
         best = None  # (priority, class, tiebreak) + key
         for key, (pod, _, parked) in self._unschedulable.items():
+            if key in self._shed_protected:
+                continue  # displaced: not sheddable before one retry
             cand = (pod.spec.priority, 0, parked)
             if best is None or cand < best[0]:
                 best = (cand, key)
         for key, entry in self._active_entry.items():
-            if not entry[_VALID]:
+            if not entry[_VALID] or key in self._shed_protected:
                 continue
             cand = (entry[2].spec.priority, 1,
                     -self._enqueued_at.get(key, now))
@@ -398,6 +406,25 @@ class PriorityQueue:
         if self.on_requeue is not None:
             self.on_requeue(pod)
 
+    def readd_displaced(self, pod: Pod) -> None:
+        """Re-admit a pod whose BINDING a cluster-lifecycle event revoked
+        (NodeLifecycleController eviction, a drain wave, a zone outage —
+        ISSUE 18).  Shed-EXEMPT like every requeue — the pod was running,
+        and a capacity drop here would turn a node drain into silent pod
+        loss — and additionally shed-PROTECTED until its next pop: a
+        displaced pod is never a shed candidate before the scheduler gets
+        one retry at placing it (the mass-requeue guarantee a rolling
+        drain leans on).  No on_requeue call: the pod was not popped by
+        this scheduler's current conservation window — the displaced
+        seam (InvariantChecker.note_displaced) already closed its bound
+        mark, so this is a fresh admission, not a resolution."""
+        key = _pod_key(pod)
+        with self._lock:
+            self._unschedulable.pop(key, None)
+            self._shed_protected.add(key)
+            self._push_active(pod)
+            self._lock.notify()
+
     def _add_unschedulable_locked(self, pod: Pod, cycle: int) -> None:
         key = _pod_key(pod)
         self.backoff.boost(key)
@@ -440,6 +467,19 @@ class PriorityQueue:
             self._unschedulable.clear()
             self._lock.notify()
 
+    def tracks(self, pod: Pod) -> bool:
+        """Membership across all three sub-queues (active/backoff/
+        unschedulable) — the conservation scorer's "still queued"
+        bucket (runtime/scenario.py): an unbound pod the queue does NOT
+        track and that was never shed has been lost."""
+        key = _pod_key(pod)
+        with self._lock:
+            return (
+                key in self._active_entry
+                or key in self._backoff_entry
+                or key in self._unschedulable
+            )
+
     def delete(self, pod: Pod) -> None:
         with self._lock:
             key = _pod_key(pod)
@@ -453,6 +493,7 @@ class PriorityQueue:
                 entry[_VALID] = False
             self.backoff.clear(key)
             self._enqueued_at.pop(key, None)
+            self._shed_protected.discard(key)
 
     def take_enqueue_time(self, pod: Pod) -> Optional[float]:
         """Pop and return the pod's first-enqueue monotonic timestamp (None
@@ -581,6 +622,8 @@ class PriorityQueue:
             key = _pod_key(pod)
             if self._active_entry.get(key) is entry:
                 del self._active_entry[key]
+            # the displaced pod got its retry: normal shed policy resumes
+            self._shed_protected.discard(key)
             self.scheduling_cycle += 1
             return pod
         return None

@@ -1,0 +1,794 @@
+"""Trace-driven scenario engine (ISSUE 18): replay real or synthetic
+cluster traces through the LIVE scheduler, compose cluster-lifecycle
+chaos at trace time, and score the run with the invariant checker as
+the pass/fail oracle.
+
+Three layers:
+
+1.  **Trace frontend** — `load_trace(path)` reads a cluster trace in
+    CSV or JSON.  Column names are resolved through an alias table
+    covering the Alibaba cluster-trace (``start_time``/``plan_cpu``/
+    ``plan_mem``) and Google cluster-trace (``submit_time``/
+    ``cpu_request``/``memory_request``/``scheduling_class``) shapes, so
+    a trimmed export of either replays without massaging.
+    `synthesize_trace(seed, ...)` emits the SAME `TraceEvent` schema
+    from a seeded generator (Poisson arrivals, optional diurnal rate
+    modulation, exponential lifetimes, a small resource catalog), so
+    synthetic and real traces are interchangeable downstream.
+
+2.  **Replay** — `ScenarioRunner` owns a cluster + live scheduler
+    (batched commit, AIMD adaptive batch, invariant checks on) and
+    replays a trace against it under a deterministic virtual clock:
+    event ORDER and virtual timestamps come from the trace alone;
+    `compression` only rescales virtual seconds to wall seconds
+    (compression=60 replays an hour-long trace in a minute).  Chaos is
+    injected as ``(virtual_t, callable)`` pairs interleaved with
+    arrivals — the callables are typically bound methods of
+    `runtime.chaos.Disruptions` (rolling_drain / zone_outage), so a
+    scenario is "this trace, and at t=300 the upgrade monkey drains
+    half the fleet".
+
+3.  **Scoring** — the runner watches the store and banks per-pod bind
+    and displacement timestamps, producing: displaced-pod reschedule
+    p50/p99, goodput ratio during the chaos window vs before it,
+    time-to-drain after the last arrival, shed/lost accounting (lost
+    MUST be zero: conservation), and the scheduler's own invariant
+    summary (violations MUST be zero).  Pass ``ledger`` to record every
+    cycle for the offline ``bench.py --replay`` bit-identity gate.
+
+`run_scenario(kind, ...)` packages the four named campaigns — drain,
+zone, diurnal, trace — behind one call; `bench.py --scenario` is a thin
+CLI over it and tests/test_scenario.py drives it directly.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import heapq
+import json
+import math
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.api.factory import ZONE_KEY, make_node, make_pod
+from kubernetes_tpu.runtime.cluster import (
+    DISPLACED_BY_ANNOTATION,
+    LocalCluster,
+    make_cluster_binder,
+    wire_scheduler,
+)
+
+# ----------------------------------------------------------- trace schema
+
+
+@dataclass(frozen=True)
+class TraceEvent:
+    """One trace row, normalized.  kind "arrival" submits a pod at
+    virtual time `t`; kind "evict" is a workload-initiated kill of a
+    previously arrived pod (the trace's own terminations, distinct from
+    chaos-driven displacement).  `lifetime_s` None = runs to the end of
+    the scenario; otherwise the pod completes (phase Succeeded) that
+    many virtual seconds after it BINDS — lifetimes model run time, and
+    a pod that never starts never finishes."""
+
+    t: float                        # virtual seconds from trace start
+    name: str
+    kind: str = "arrival"           # "arrival" | "evict"
+    namespace: str = "default"
+    cpu: str = "500m"               # resource vector (factory strings)
+    mem: str = "512Mi"
+    priority: int = 0
+    lifetime_s: Optional[float] = None
+
+
+# Column aliases, checked in order: first present wins.  Covers the
+# Alibaba cluster-trace batch_task table and the Google cluster-data
+# task_events table, plus the obvious generic names.
+_COLS = {
+    "t": ("t", "time", "timestamp", "start_time", "submit_time",
+          "arrive_time", "create_time"),
+    "name": ("name", "pod", "pod_name", "task_name", "job_name",
+             "job_id", "task_id", "instance_name", "collection_id"),
+    "namespace": ("namespace", "ns", "user", "tenant"),
+    "cpu": ("cpu", "plan_cpu", "cpu_request", "request_cpu", "cpus",
+            "resource_request_cpu"),
+    "mem": ("mem", "memory", "plan_mem", "memory_request",
+            "request_memory", "resource_request_memory"),
+    "priority": ("priority", "scheduling_class", "sched_class", "qos"),
+    "lifetime": ("lifetime", "lifetime_s", "duration", "run_time",
+                 "runtime"),
+    "end": ("end_time", "finish_time"),
+    "kind": ("kind", "event_type", "event", "type", "status"),
+}
+
+_EVICT_VALUES = {"evict", "evicted", "eviction", "kill", "killed", "fail"}
+
+
+def _pick(row: dict, key: str):
+    for alias in _COLS[key]:
+        if alias in row and row[alias] not in (None, ""):
+            return row[alias]
+    return None
+
+
+def _norm_cpu(v, scale: float) -> str:
+    """Numeric cpu -> a factory request string.  Alibaba plan_cpu is
+    cores*100 and Google requests are normalized [0,1] — `cpu_scale`
+    maps whatever unit the trace uses onto cores; the scaled value is
+    emitted in millicores."""
+    if v is None:
+        return "500m"
+    try:
+        cores = float(v) * scale
+    except (TypeError, ValueError):
+        return str(v)            # already a k8s quantity string
+    return f"{max(1, int(round(cores * 1000)))}m"
+
+
+def _norm_mem(v, scale: float) -> str:
+    """Numeric mem -> Mi after scaling (`mem_scale` maps trace units
+    onto MiB)."""
+    if v is None:
+        return "512Mi"
+    try:
+        mib = float(v) * scale
+    except (TypeError, ValueError):
+        return str(v)
+    return f"{max(1, int(round(mib)))}Mi"
+
+
+def load_trace(path: str, *, cpu_scale: float = 1.0,
+               mem_scale: float = 1.0,
+               limit: Optional[int] = None) -> List[TraceEvent]:
+    """Load a cluster trace (CSV with a header row, a JSON array, or
+    JSON lines) into the normalized TraceEvent schema.  Times are
+    rebased so the first arrival is t=0; rows whose kind column matches
+    an eviction value become "evict" events; an end-time column (minus
+    start) becomes the lifetime when no explicit lifetime column
+    exists.  Rows without a name get one synthesized from their index
+    (traces keyed on numeric job ids stay usable)."""
+    rows: List[dict] = []
+    if path.endswith(".json") or path.endswith(".jsonl"):
+        with open(path) as f:
+            head = f.read(1)
+            f.seek(0)
+            if head == "[":
+                rows = json.load(f)
+            else:
+                rows = [json.loads(line) for line in f if line.strip()]
+    else:
+        with open(path, newline="") as f:
+            rows = list(csv.DictReader(f))
+    events: List[TraceEvent] = []
+    for i, row in enumerate(rows):
+        if limit is not None and i >= limit:
+            break
+        t = float(_pick(row, "t") or 0.0)
+        kind_raw = str(_pick(row, "kind") or "").strip().lower()
+        kind = "evict" if kind_raw in _EVICT_VALUES else "arrival"
+        lifetime = _pick(row, "lifetime")
+        if lifetime is None:
+            end = _pick(row, "end")
+            if end is not None:
+                try:
+                    lifetime = max(0.0, float(end) - t)
+                except (TypeError, ValueError):
+                    lifetime = None
+        events.append(TraceEvent(
+            t=t,
+            name=str(_pick(row, "name") or f"trace-{i}"),
+            kind=kind,
+            namespace=str(_pick(row, "namespace") or "default"),
+            cpu=_norm_cpu(_pick(row, "cpu"), cpu_scale),
+            mem=_norm_mem(_pick(row, "mem"), mem_scale),
+            priority=int(float(_pick(row, "priority") or 0)),
+            lifetime_s=float(lifetime) if lifetime is not None else None,
+        ))
+    events.sort(key=lambda e: (e.t, e.name))
+    if events:
+        t0 = events[0].t
+        if t0:
+            events = [dataclasses.replace(e, t=e.t - t0) for e in events]
+    return events
+
+
+# the synthetic resource catalog: (weight, cpu, mem) — small pods
+# dominate, with a tail of chunky ones, like every real trace
+_CATALOG: Sequence[Tuple[int, str, str]] = (
+    (6, "250m", "256Mi"),
+    (3, "500m", "1Gi"),
+    (2, "1",    "2Gi"),
+    (1, "2",    "4Gi"),
+)
+
+
+def synthesize_trace(
+    seed: int,
+    count: int = 200,
+    rate: float = 50.0,
+    mean_lifetime_s: float = 30.0,
+    hi_priority_fraction: float = 0.1,
+    diurnal: Optional[Tuple[float, float]] = None,
+    prefix: str = "syn",
+) -> List[TraceEvent]:
+    """Seeded synthetic trace in the same schema: Poisson arrivals at
+    `rate`/s (exponential inter-arrival), exponential lifetimes around
+    `mean_lifetime_s` (0 disables completion), resource vectors drawn
+    from a weighted catalog, ~`hi_priority_fraction` of pods at
+    priority 100.  `diurnal=(period_s, amplitude)` modulates the
+    arrival rate sinusoidally — r(t) = rate*(1 + a*sin(2πt/period)) —
+    by thinning/stretching inter-arrival draws, the load swing that
+    drives AIMD batch breathing.  Same seed, same trace, always."""
+    rng = random.Random(seed)
+    bag: List[Tuple[str, str]] = []
+    for w, cpu, mem in _CATALOG:
+        bag.extend([(cpu, mem)] * w)
+    events: List[TraceEvent] = []
+    t = 0.0
+    for i in range(count):
+        r = rate
+        if diurnal is not None:
+            period, amp = diurnal
+            r = rate * (1.0 + max(0.0, min(amp, 0.999))
+                        * math.sin(2.0 * math.pi * t / period))
+        t += rng.expovariate(max(r, 1e-6))
+        cpu, mem = rng.choice(bag)
+        life = (rng.expovariate(1.0 / mean_lifetime_s)
+                if mean_lifetime_s > 0 else None)
+        events.append(TraceEvent(
+            t=t,
+            name=f"{prefix}-{i}",
+            cpu=cpu,
+            mem=mem,
+            priority=100 if rng.random() < hi_priority_fraction else 0,
+            lifetime_s=life,
+        ))
+    return events
+
+
+# ------------------------------------------------------------- the runner
+
+
+@dataclass
+class ScenarioResult:
+    """What a replay banks.  `lost` and `violations` are the pass/fail
+    oracle: both MUST be zero — every arrived pod is bound, completed,
+    shed (accounted), evicted by the trace, or still queued; nothing
+    vanishes, and the online conservation/double-bind/capacity checks
+    all held."""
+
+    arrivals: int = 0
+    bound: int = 0                  # distinct pods that ever bound
+    completed: int = 0
+    trace_evictions: int = 0
+    shed: int = 0
+    queued_end: int = 0             # still in queue at scenario end
+    lost: int = 0
+    violations: int = 0
+    displaced: int = 0
+    redisplaced: int = 0            # displacement of an already-displaced pod
+    rescheduled: int = 0            # displaced pods that rebound
+    displaced_unrescheduled: int = 0
+    reschedule_ms: Dict[str, float] = field(default_factory=dict)
+    first_bind_ms: Dict[str, float] = field(default_factory=dict)
+    goodput_before: float = 0.0     # binds/s before the chaos window
+    goodput_during: float = 0.0     # binds/s inside it
+    goodput_ratio: float = 1.0      # during/before (1.0 when no chaos)
+    time_to_drain_s: float = 0.0    # last arrival -> queue empty
+    wall_s: float = 0.0
+    virtual_s: float = 0.0
+    chaos: List[dict] = field(default_factory=list)
+    invariants: Optional[dict] = None
+    ledger: Optional[dict] = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _pct(samples: List[float]) -> Dict[str, float]:
+    """p50/p99/max over ms samples (bench.py's shape, local so the
+    runner has no bench dependency)."""
+    if not samples:
+        return {"p50": 0.0, "p99": 0.0, "max": 0.0, "n": 0}
+    s = sorted(samples)
+    def q(p: float) -> float:
+        return s[min(len(s) - 1, int(math.ceil(p * len(s))) - 1)]
+    return {"p50": round(q(0.50), 3), "p99": round(q(0.99), 3),
+            "max": round(s[-1], 3), "n": len(s)}
+
+
+class ScenarioRunner:
+    """Own a cluster + live scheduler and replay traces against it.
+
+    The scheduler runs the production configuration under test: batched
+    commit, AIMD adaptive batch sizing, bounded queue (optional),
+    invariant checks on.  A store watch stamps wall-clock bind and
+    displacement times per pod; `replay()` converts them into the
+    recovery metrics.  Construct once per scenario — the runner owns
+    the scheduler thread and must be `close()`d (or used as a context
+    manager)."""
+
+    def __init__(
+        self,
+        nodes: int = 16,
+        node_cpu: str = "16",
+        node_mem: str = "64Gi",
+        node_pods: int = 256,
+        zones: int = 2,
+        capacity: Optional[int] = None,
+        batch_size: int = 64,
+        batch_size_min: int = 8,
+        compression: float = 1.0,
+        seed: int = 0,
+        ledger=None,
+        bind_sleep: float = 0.0,
+    ):
+        from kubernetes_tpu.runtime.cache import SchedulerCache
+        from kubernetes_tpu.runtime.queue import PodBackoff, PriorityQueue
+        from kubernetes_tpu.runtime.scheduler import (
+            Scheduler,
+            SchedulerConfig,
+        )
+
+        self.compression = max(float(compression), 1e-9)
+        self.seed = seed
+        self.cluster = LocalCluster()
+        for i in range(nodes):
+            self.cluster.add_node(make_node(
+                f"node-{i}", cpu=node_cpu, mem=node_mem, pods=node_pods,
+                labels={ZONE_KEY: f"zone-{i % max(zones, 1)}"},
+            ))
+        inner = make_cluster_binder(self.cluster)
+        if bind_sleep > 0:
+            def binder(pod, node):
+                time.sleep(bind_sleep)   # a throttled apiserver
+                return inner(pod, node)
+        else:
+            binder = inner
+        self.shed: List[Tuple[str, str]] = []
+        self.scheduler = Scheduler(
+            cache=SchedulerCache(),
+            queue=PriorityQueue(
+                capacity=capacity,
+                backoff=PodBackoff(initial=0.01, max_duration=0.05),
+            ),
+            binder=binder,
+            config=SchedulerConfig(
+                batch_size=batch_size,
+                batch_window_s=0.0,
+                disable_preemption=True,
+                batched_commit=True,
+                pipeline_commit=ledger is not None,
+                adaptive_batch=True,
+                batch_size_min=batch_size_min,
+                cycle_deadline_s=2.0,
+            ),
+            ledger=ledger,
+        )
+        self._ledger = ledger
+        self.scheduler.queue.on_shed = (
+            lambda p, r: self.shed.append((p.name, r))
+        )
+        # --- the observation watch: wall-clock bind / displacement /
+        # completion stamps per pod.  Registered BEFORE wire_scheduler so
+        # its view is never behind the scheduler's.
+        self._obs_lock = threading.Lock()
+        self._bind_wall: Dict[Tuple[str, str], float] = {}
+        self._bind_times: List[float] = []       # every (re)bind, for goodput
+        self._displace_wall: Dict[Tuple[str, str], float] = {}
+        self._displaced_seen: set = set()
+        self._redisplaced = 0
+        self._resched_ms: List[float] = []
+        self._resched_wall: List[float] = []
+        self._event_mark: Optional[float] = None
+        self._completed: set = set()
+        self.cluster.watch(self._observe)
+        wire_scheduler(self.cluster, self.scheduler)
+        self._thread = threading.Thread(
+            target=self.scheduler.run, daemon=True,
+            name="scenario-scheduler",
+        )
+        self._thread.start()
+
+    # -- context manager ------------------------------------------------
+    def __enter__(self) -> "ScenarioRunner":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        self.scheduler.stop()
+        self._thread.join(timeout=10.0)
+        if self._ledger is not None:
+            self._ledger.flush(30.0)
+
+    # -- the store observer ---------------------------------------------
+    def _observe(self, event: str, kind: str, obj) -> None:
+        if kind != "pods" or obj is None:
+            return
+        key = (obj.namespace, obj.name)
+        now = time.monotonic()
+        with self._obs_lock:
+            if obj.status.phase in ("Succeeded", "Failed"):
+                self._completed.add(key)
+                return
+            if obj.spec.node_name:
+                if key not in self._bind_wall:
+                    self._bind_wall[key] = now
+                self._bind_times.append(now)
+                t0 = self._displace_wall.pop(key, None)
+                if t0 is not None:
+                    self._resched_ms.append((now - t0) * 1000.0)
+                    self._resched_wall.append(now)
+            elif (event == "MODIFIED"
+                  and obj.metadata.annotations.get(DISPLACED_BY_ANNOTATION)):
+                if key in self._displace_wall:
+                    return           # displaced again before rebinding
+                if key in self._displaced_seen:
+                    self._redisplaced += 1
+                self._displaced_seen.add(key)
+                self._displace_wall[key] = now
+                self._bind_wall.pop(key, None)   # must rebind to count again
+
+    # -- helpers ---------------------------------------------------------
+    def bound_count(self) -> int:
+        return sum(
+            1 for p in self.cluster.list("pods")
+            if p.spec.node_name
+            and p.status.phase not in ("Succeeded", "Failed")
+        )
+
+    def _complete(self, namespace: str, name: str) -> bool:
+        """Trace-lifetime completion: flip the pod to Succeeded through
+        the store, which routes it out of cache + queue (the completed-
+        pod path in wire_scheduler) and frees its node."""
+        with self.cluster._lock:
+            cur = self.cluster.get("pods", namespace, name)
+            if cur is None or cur.status.phase in ("Succeeded", "Failed"):
+                return False
+            self.cluster.update("pods", dataclasses.replace(
+                cur,
+                status=dataclasses.replace(cur.status, phase="Succeeded"),
+            ))
+            return True
+
+    def mark_event_start(self) -> None:
+        """Stamp the ACTUAL start of a disruption from inside a chaos
+        callable.  A campaign that first waits for a loaded cluster
+        (await_bound — which also absorbs first-cycle compiles) calls
+        this after the wait, so the goodput window measures the
+        disruption, not the warm-up it deliberately sat out."""
+        self._event_mark = time.monotonic()
+
+    def await_bound(self, n: int, timeout_s: float = 10.0) -> int:
+        """Block (bounded) until at least `n` pods are live-bound —
+        campaigns use it inside a chaos callable so the disruption hits
+        a LOADED cluster whatever the compression; returns the count."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            c = self.bound_count()
+            if c >= n:
+                return c
+            time.sleep(0.005)
+        return self.bound_count()
+
+    def wait_drained(self, timeout_s: float = 30.0) -> float:
+        """Block until nothing schedulable remains (an in-flight
+        pipelined batch counts); returns the wall seconds it took."""
+        t0 = time.monotonic()
+        deadline = t0 + timeout_s
+        q = self.scheduler.queue
+        inv = self.scheduler.invariants
+        while time.monotonic() < deadline:
+            # three-way idle: nothing poppable, no pipelined batch in
+            # flight, AND no popped pod mid-cycle (the checker's
+            # outstanding count) — without the last clause a score taken
+            # mid-commit reads in-flight pods as unbound+untracked (lost)
+            if (not q.has_schedulable()
+                    and not self.scheduler.pipeline_pending
+                    and (inv is None or inv.summary()["outstanding"] == 0)):
+                return time.monotonic() - t0
+            time.sleep(0.005)
+        return time.monotonic() - t0
+
+    # -- the replay loop -------------------------------------------------
+    def replay(
+        self,
+        events: Sequence[TraceEvent],
+        chaos: Sequence[Tuple[float, Callable[[], object]]] = (),
+        drain_timeout_s: float = 60.0,
+    ) -> ScenarioResult:
+        """Replay `events` under the virtual clock, firing each chaos
+        callable when virtual time reaches its trigger.  Virtual time
+        advances as wall*compression; the loop sleeps to pace arrivals
+        and wakes early for whichever of (next event, next completion,
+        next chaos) is due first.  After the last arrival it drains the
+        queue, settles lifetimes, and scores."""
+        events = sorted(events, key=lambda e: (e.t, e.name))
+        chaos = sorted(chaos, key=lambda c: c[0])
+        res = ScenarioResult()
+        arrived: Dict[Tuple[str, str], TraceEvent] = {}
+        evicted_keys: set = set()
+        # completion heap: (virtual_due, ns, name, orig_due); entries
+        # re-arm (due pushed forward) while their pod is unbound — a pod
+        # can't finish before it starts — but keep orig_due so the
+        # post-drain pass can settle anything whose TRACE lifetime has
+        # elapsed without waiting out the re-arm slack
+        comp: List[Tuple[float, str, str, float]] = []
+        chaos_windows: List[Tuple[float, float]] = []  # wall (start, end)
+        ei = ci = 0
+        wall0 = time.monotonic()
+
+        def vnow() -> float:
+            return (time.monotonic() - wall0) * self.compression
+
+        def settle_completions(v: float) -> None:
+            while comp and comp[0][0] <= v:
+                due, ns, name, orig = heapq.heappop(comp)
+                key = (ns, name)
+                pod = self.cluster.get("pods", ns, name)
+                if pod is None or key in self._completed:
+                    continue
+                if pod.spec.node_name:
+                    self._complete(ns, name)
+                    res.completed += 1
+                else:
+                    # not running yet (queued, or displaced mid-chaos):
+                    # lifetime hasn't elapsed — re-arm a slice later
+                    heapq.heappush(comp, (due + 1.0 * self.compression,
+                                          ns, name, orig))
+                    break
+
+        while ei < len(events) or ci < len(chaos):
+            next_t = min(
+                events[ei].t if ei < len(events) else math.inf,
+                chaos[ci][0] if ci < len(chaos) else math.inf,
+                comp[0][0] if comp else math.inf,
+            )
+            lag = next_t / self.compression - (time.monotonic() - wall0)
+            if lag > 0:
+                time.sleep(min(lag, 0.05))
+            v = vnow()
+            settle_completions(v)
+            while ci < len(chaos) and chaos[ci][0] <= v:
+                _, fn = chaos[ci]
+                ci += 1
+                w0 = time.monotonic()
+                self._event_mark = None
+                out = fn()
+                chaos_windows.append(
+                    (self._event_mark or w0, time.monotonic()))
+                res.chaos.append({
+                    "virtual_t": round(v, 3),
+                    "result": out if isinstance(out, dict) else str(out),
+                })
+            while ei < len(events) and events[ei].t <= v:
+                e = events[ei]
+                ei += 1
+                if e.kind == "evict":
+                    if self.cluster.get("pods", e.namespace, e.name):
+                        self.cluster.delete("pods", e.namespace, e.name)
+                        res.trace_evictions += 1
+                        evicted_keys.add((e.namespace, e.name))
+                    continue
+                pod = make_pod(e.name, namespace=e.namespace, cpu=e.cpu,
+                               mem=e.mem, priority=e.priority)
+                self.cluster.add_pod(pod)
+                arrived[(e.namespace, e.name)] = e
+                res.arrivals += 1
+                if e.lifetime_s is not None:
+                    due = e.t + e.lifetime_s
+                    heapq.heappush(
+                        comp, (due, e.namespace, e.name, due))
+
+        res.time_to_drain_s = round(self.wait_drained(drain_timeout_s), 3)
+        # settle remaining due lifetimes now that the queue is quiet:
+        # judge by the ORIGINAL due time (the re-arm slack was only ever
+        # "can't finish before it starts", and everything bound by now
+        # has started)
+        deadline = time.monotonic() + 5.0
+        while comp and time.monotonic() < deadline:
+            due, ns, name, orig = comp[0]
+            if orig > vnow():
+                break       # genuinely not yet elapsed on the trace clock
+            heapq.heappop(comp)
+            key = (ns, name)
+            pod = self.cluster.get("pods", ns, name)
+            if pod is None or key in self._completed:
+                continue
+            if pod.spec.node_name and self._complete(ns, name):
+                res.completed += 1
+        res.wall_s = round(time.monotonic() - wall0, 3)
+        res.virtual_s = round(vnow(), 3)
+        self._score(res, arrived, evicted_keys, chaos_windows, wall0)
+        return res
+
+    # -- scoring ---------------------------------------------------------
+    def _score(self, res: ScenarioResult, arrived, evicted_keys,
+               chaos_windows, wall0: float) -> None:
+        with self._obs_lock:
+            binds = list(self._bind_times)
+            resched = list(self._resched_ms)
+            resched_wall = list(self._resched_wall)
+            displaced = len(self._displaced_seen)
+            unresched = len(self._displace_wall)
+            redisplaced = self._redisplaced
+            first_binds = dict(self._bind_wall)
+            completed = set(self._completed)
+        res.displaced = displaced
+        res.redisplaced = redisplaced
+        res.rescheduled = len(resched)
+        res.displaced_unrescheduled = unresched
+        res.reschedule_ms = _pct(resched)
+        res.first_bind_ms = _pct([
+            (first_binds[k] - wall0) * 1000.0 for k in first_binds
+        ])
+        res.shed = len(self.shed)
+        shed_names = {n for n, _ in self.shed}
+        q = self.scheduler.queue
+        res.queued_end = len(q)
+        live = {
+            (p.namespace, p.name): p for p in self.cluster.list("pods")
+        }
+        res.bound = sum(
+            1 for p in live.values()
+            if p.spec.node_name and p.status.phase not in
+            ("Succeeded", "Failed")
+        )
+        # conservation at the pod-identity level: every arrival is
+        # bound, completed, shed, trace-evicted, or still queued.  A pod
+        # in none of those buckets was LOST — the failure the displaced
+        # requeue path exists to prevent.
+        lost = 0
+        for key, e in arrived.items():
+            pod = live.get(key)
+            if pod is None:
+                # gone from the store: completed, trace-evicted, or lost
+                if (key in completed or key in evicted_keys
+                        or e.name in shed_names):
+                    continue
+                lost += 1
+            elif not pod.spec.node_name:
+                # present but unbound: must be queue-tracked or shed
+                if q.tracks(pod) or e.name in shed_names:
+                    continue
+                lost += 1
+        res.lost = lost
+        inv = self.scheduler.invariants
+        if inv is not None:
+            res.invariants = inv.summary()
+            res.violations = inv.violations_total()
+        if self._ledger is not None:
+            self._ledger.flush(30.0)
+            res.ledger = {
+                "cycles": self._ledger.cycles_total,
+                "bytes": self._ledger.bytes_total,
+                "dropped": self._ledger.dropped_total,
+            }
+        # goodput: binds/s inside the EVENT window vs before it.  The
+        # window runs from the first disruption's start through recovery
+        # — the later of the last chaos callable returning and the last
+        # displaced pod rebinding — so a millisecond-long trigger (a
+        # zone's monitor tick) is still scored over the disruption it
+        # caused.  No chaos -> ratio 1.0 by definition.
+        if chaos_windows and binds:
+            c0 = chaos_windows[0][0]
+            c1 = max(w[1] for w in chaos_windows)
+            if resched_wall:
+                c1 = max(c1, max(resched_wall))
+            before = [b for b in binds if b < c0]
+            during = [b for b in binds if c0 <= b <= c1]
+            # the before-span starts at the FIRST bind (first-cycle
+            # compile time is dead air, not low goodput)
+            span_before = max(c0 - (min(before) if before else wall0), 1e-9)
+            span_during = max(c1 - c0, 1e-9)
+            res.goodput_before = round(len(before) / span_before, 3)
+            res.goodput_during = round(len(during) / span_during, 3)
+            if res.goodput_before > 0:
+                res.goodput_ratio = round(
+                    res.goodput_during / res.goodput_before, 4)
+            else:
+                res.goodput_ratio = 1.0 if res.goodput_during >= 0 else 0.0
+
+
+# ------------------------------------------------- the named campaigns
+
+
+SCENARIOS = ("drain", "zone", "diurnal", "trace")
+
+
+def run_scenario(
+    kind: str,
+    *,
+    seed: int = 0,
+    pods: int = 120,
+    nodes: int = 12,
+    zones: int = 3,
+    rate: float = 120.0,
+    compression: float = 1.0,
+    capacity: Optional[int] = None,
+    trace_path: Optional[str] = None,
+    ledger=None,
+    drain_timeout_s: float = 60.0,
+) -> ScenarioResult:
+    """One call per campaign — the shared engine behind
+    ``bench.py --scenario`` and the scenario tests:
+
+    - **drain**: steady synthetic trace; at one-third of the trace the
+      upgrade monkey rolling-drains half the fleet (displace mode) in
+      waves of 2, then uncordons — mass requeue through the shed-exempt
+      displaced path, rescheduling onto the surviving half and back.
+    - **zone**: same trace; one zone's nodes all go silent at once
+      (lease expiry -> lifecycle taint -> displace) — correlated loss
+      and mass rescheduling.  The dead zone's leases stay stale so the
+      zone is NOT restored; the survivors must absorb everything.
+    - **diurnal**: a sinusoidal-rate trace (two periods, amplitude
+      0.9) with no chaos — the swing itself is the event, driving AIMD
+      batch breathing and capacity-planner backlog oscillation.
+    - **trace**: replay `trace_path` (load_trace) verbatim, no chaos —
+      the external-trace front door.
+
+    Lifetimes are long relative to the replay (pods mostly stay bound)
+    so displacement math is well-conditioned."""
+    if kind not in SCENARIOS:
+        raise ValueError(f"unknown scenario {kind!r}: one of {SCENARIOS}")
+    from kubernetes_tpu.runtime.chaos import Disruptions
+
+    mean_life = max(60.0, 4.0 * pods / max(rate, 1e-6))
+    if kind == "trace":
+        if not trace_path:
+            raise ValueError("scenario 'trace' needs trace_path")
+        events = load_trace(trace_path)
+    elif kind == "diurnal":
+        span = pods / max(rate, 1e-6)
+        events = synthesize_trace(
+            seed, count=pods, rate=rate, mean_lifetime_s=mean_life,
+            diurnal=(span / 2.0, 0.9), prefix="diurnal",
+        )
+    else:
+        events = synthesize_trace(
+            seed, count=pods, rate=rate, mean_lifetime_s=mean_life,
+            prefix=kind,
+        )
+    with ScenarioRunner(
+        nodes=nodes, zones=zones, capacity=capacity,
+        compression=compression, seed=seed, ledger=ledger,
+    ) as runner:
+        monkey = Disruptions(runner.cluster, rng=random.Random(seed))
+        chaos: List[Tuple[float, Callable[[], object]]] = []
+        last_t = events[-1].t if events else 0.0
+        # fire mid-trace, and gate on a loaded cluster: the disruption
+        # must displace RUNNING pods, not race an empty ramp-up
+        warm = max(4, pods // 4)
+        if kind == "drain":
+            half = [f"node-{i}" for i in range(nodes // 2)]
+
+            def _drain():
+                runner.await_bound(warm)
+                runner.mark_event_start()
+                out = monkey.rolling_drain(
+                    nodes=list(half), wave_size=2,
+                    retry_rounds=4, retry_after_s=0.02,
+                )
+                for n in half:
+                    monkey.uncordon(n)
+                return out
+
+            chaos.append((last_t / 2.0, _drain))
+        elif kind == "zone":
+
+            def _zone():
+                runner.await_bound(warm)
+                runner.mark_event_start()
+                return monkey.zone_outage(zone=f"zone-{zones - 1}")
+
+            chaos.append((last_t / 2.0, _zone))
+        result = runner.replay(
+            events, chaos=chaos, drain_timeout_s=drain_timeout_s)
+        result.chaos.insert(0, {"kind": kind, "seed": seed})
+    return result

@@ -547,6 +547,16 @@ QUEUE_SHED = REGISTRY.register(
         ("reason",),
     )
 )
+PODS_DISPLACED = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_pods_displaced_total",
+        "Bound pods displaced back into the scheduling queue by a "
+        "cluster-lifecycle event, by reason (node-lifecycle | drain | "
+        "zone-outage).  Each re-enters through the shed-exempt displaced "
+        "requeue path and must be rescheduled, not lost",
+        ("reason",),
+    )
+)
 ADAPTIVE_BATCH = REGISTRY.register(
     Gauge(
         "scheduler_adaptive_batch_size",

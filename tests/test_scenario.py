@@ -1,0 +1,430 @@
+"""Trace-driven scenario engine + cluster-lifecycle chaos (ISSUE 18).
+
+The acceptance surface: drain-displaced / lifecycle-evicted pods re-enter
+the queue through the shed-exempt displaced requeue path and are NEVER
+read as lost_pod nor shed before one retry (satellite 1); a drain wave
+against fully-PDB-protected pods is paced by 429/Retry-After, makes
+bounded progress, skips-and-records rather than deadlocking (satellite
+2); every lifecycle primitive draws from the instance rng (satellite 3);
+and the drain / zone / diurnal / trace campaigns run through the LIVE
+scheduler with the invariant checker clean — zero lost pods, zero
+violations — banking displaced-reschedule percentiles and goodput.
+"""
+
+import csv
+import json
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api.factory import ZONE_KEY, make_node, make_pod
+from kubernetes_tpu.api.types import ObjectMeta, PodDisruptionBudget
+from kubernetes_tpu.runtime.cache import SchedulerCache
+from kubernetes_tpu.runtime.chaos import Disruptions
+from kubernetes_tpu.runtime.cluster import (
+    DISPLACED_BY_ANNOTATION,
+    LocalCluster,
+    make_cluster_binder,
+    wire_scheduler,
+)
+from kubernetes_tpu.runtime.controllers import (
+    EVICT_DISPLACE,
+    EvictionBlocked,
+    NodeLifecycleController,
+    renew_node_lease,
+    try_evict,
+)
+from kubernetes_tpu.runtime.queue import PodBackoff, PriorityQueue
+from kubernetes_tpu.runtime.scenario import (
+    ScenarioRunner,
+    TraceEvent,
+    load_trace,
+    run_scenario,
+    synthesize_trace,
+)
+from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+import random
+
+
+def _live(cluster, capacity=None):
+    sched = Scheduler(
+        cache=SchedulerCache(),
+        queue=PriorityQueue(
+            capacity=capacity,
+            backoff=PodBackoff(initial=0.01, max_duration=0.05),
+        ),
+        binder=make_cluster_binder(cluster),
+        config=SchedulerConfig(
+            batch_size=16, batch_window_s=0.0, disable_preemption=True,
+            batched_commit=True, adaptive_batch=True, batch_size_min=4,
+            cycle_deadline_s=2.0,
+        ),
+    )
+    wire_scheduler(cluster, sched)
+    t = threading.Thread(target=sched.run, daemon=True)
+    t.start()
+    return sched, t
+
+
+def _wait(pred, timeout=30.0, dt=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(dt)
+    return pred()
+
+
+# ------------------------- satellite 1: the displaced requeue path ----
+
+
+@pytest.mark.scenario
+def test_readd_displaced_is_shed_exempt_and_shed_protected():
+    """The queue-level pin: a displaced pod re-enters ABOVE capacity
+    (shed-exempt), and while it waits for its retry no arrival — even a
+    higher-priority one — can shed it; its protection lapses only once
+    it pops."""
+    shed = []
+    q = PriorityQueue(capacity=4, on_shed=lambda p, r: shed.append(p.name))
+    for i in range(4):
+        q.add(make_pod(f"fill-{i}", cpu="1", mem="1Gi", priority=0))
+    displaced = make_pod("victim", cpu="1", mem="1Gi", priority=0)
+    q.readd_displaced(displaced)
+    assert len(q) == 5 and not shed, "displaced re-admission must not shed"
+    # a storm of HIGHER-priority arrivals at capacity: the lowest-
+    # priority pod in the queue is the displaced one, but it is
+    # protected — the filler pods go instead, and once only protected +
+    # higher-priority pods remain the arrivals themselves are rejected
+    for i in range(6):
+        q.add(make_pod(f"storm-{i}", cpu="1", mem="1Gi", priority=50))
+    assert "victim" not in shed, (
+        "displaced pod shed before its retry: the shed-protection seam "
+        "is broken"
+    )
+    assert shed, "capacity never enforced against the storm"
+    # the displaced pod's retry: it pops (priority 0 pops after the 50s),
+    # and the protection dies with the pop — a LATER storm can shed it
+    popped = []
+    while True:
+        batch = q.pop_batch(16, timeout=0.0)
+        if not batch:
+            break
+        popped.extend(p.name for p in batch)
+    assert "victim" in popped, "displaced pod never surfaced for retry"
+    assert not q._shed_protected, "protection must clear on pop"
+
+
+@pytest.mark.scenario
+@pytest.mark.chaos
+def test_mass_displacement_never_lost_never_shed_before_retry():
+    """The e2e conservation pin: a zone-wide lifecycle eviction in
+    displace mode throws every bound pod on the dead nodes back at a
+    TIGHT queue under arrival pressure — none may be shed before
+    rescheduling, none may be lost, and the invariant checker stays
+    clean through the whole storm."""
+    cluster = LocalCluster()
+    for i in range(8):
+        cluster.add_node(make_node(
+            f"n{i}", cpu="32", mem="64Gi", pods=200,
+            labels={ZONE_KEY: "z0" if i < 4 else "z1"},
+        ))
+    shed = []
+    sched, _t = _live(cluster, capacity=16)
+    sched.queue.on_shed = lambda p, r: shed.append(p.name)
+    try:
+        # paced feed: stay under the tight capacity while loading up
+        for chunk in range(4):
+            for i in range(chunk * 8, chunk * 8 + 8):
+                cluster.add_pod(make_pod(f"p{i}", cpu="500m", mem="512Mi"))
+            assert _wait(lambda: sum(
+                1 for p in cluster.list("pods")
+                if p.spec.node_name) == chunk * 8 + 8)
+        assert not shed, "the feed must not shed: test setup invalid"
+
+        lifecycle = NodeLifecycleController(
+            cluster, grace_period=1.0, eviction_mode=EVICT_DISPLACE)
+        monkey = Disruptions(cluster, rng=random.Random(7))
+        out = monkey.zone_outage(
+            zone="z0", lifecycle=lifecycle, now=1000.0)
+        displaced = {name for _, name, _ in out["evicted"]}
+        assert displaced, "the outage displaced nothing: test is vacuous"
+        # arrival pressure while the displaced pods wait for their retry
+        for i in range(24):
+            cluster.add_pod(make_pod(
+                f"late-{i}", cpu="500m", mem="512Mi", priority=10))
+
+        def all_rebound():
+            return all(
+                (p := cluster.get("pods", "default", n)) is not None
+                and p.spec.node_name
+                for n in displaced
+            )
+
+        assert _wait(all_rebound, timeout=30.0), (
+            "displaced pods never rescheduled"
+        )
+        assert not (displaced & set(shed)), (
+            f"displaced pods shed before their retry: {displaced & set(shed)}"
+        )
+        _wait(lambda: not sched.queue.has_schedulable()
+              and not sched.pipeline_pending, timeout=30.0)
+        inv = sched.invariants
+        assert inv is not None
+        assert inv.assert_drained(), "popped pods unresolved (lost_pod)"
+        assert inv.violations_total() == 0, inv.summary()
+    finally:
+        sched.stop()
+        _t.join(timeout=10.0)
+
+
+# ------------------- satellite 2: PDB-paced drain, never a spin -------
+
+
+def _pdb(name, labels, allowed):
+    return PodDisruptionBudget(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        selector={"matchLabels": labels},
+        disruptions_allowed=allowed,
+    )
+
+
+@pytest.mark.scenario
+def test_drain_wave_blocked_by_pdb_paces_and_skips_without_deadlock():
+    """All remaining pods PDB-protected: the wave retries with
+    Retry-After pacing (bounded: elapsed covers the pacing but the call
+    RETURNS), records every pod as skipped, emits DrainBlocked events —
+    and evicts nothing.  Reopening the budget lets a second drain
+    finish the job."""
+    cluster = LocalCluster()
+    for i in range(2):
+        cluster.add_node(make_node(f"n{i}", cpu="8", mem="16Gi"))
+    for i in range(4):
+        cluster.add_pod(make_pod(
+            f"web-{i}", cpu="1", mem="1Gi",
+            labels={"app": "web"}, node_name=f"n{i % 2}",
+        ))
+    cluster.create("poddisruptionbudgets", _pdb("web-pdb", {"app": "web"}, 0))
+    monkey = Disruptions(cluster, rng=random.Random(0))
+    t0 = time.monotonic()
+    out = monkey.rolling_drain(
+        nodes=["n0", "n1"], wave_size=2,
+        retry_rounds=3, retry_after_s=0.02,
+    )
+    elapsed = time.monotonic() - t0
+    assert out["evicted"] == [], "PDB at 0 must block every eviction"
+    assert len(out["skipped"]) == 4, out
+    assert out["blocked_retries"] >= 4 * (3 + 1), (
+        "every pod must be retried each round"
+    )
+    # paced (three inter-round sleeps) but bounded — no spin, no hang
+    assert 3 * 0.02 <= elapsed < 5.0, f"elapsed {elapsed:.3f}s"
+    assert all(p.spec.node_name for p in cluster.list("pods")), (
+        "blocked pods must stay bound"
+    )
+    blocked_events = [
+        e for e in cluster.events.events() if e.reason == "DrainBlocked"
+    ]
+    assert blocked_events, "skipping must leave an audit trail"
+    # the budget reopens: the same drain now completes
+    pdb = cluster.get("poddisruptionbudgets", "default", "web-pdb")
+    import dataclasses
+    cluster.update("poddisruptionbudgets",
+                   dataclasses.replace(pdb, disruptions_allowed=4))
+    out2 = monkey.rolling_drain(nodes=["n0", "n1"], wave_size=2,
+                                retry_rounds=1, retry_after_s=0.01)
+    assert len(out2["evicted"]) == 4 and not out2["skipped"]
+    assert all(not p.spec.node_name for p in cluster.list("pods"))
+
+
+@pytest.mark.scenario
+def test_drain_wave_partial_pdb_evicts_the_unprotected():
+    """A mixed wave: protected pods skip, everything else drains — one
+    stubborn PDB cannot hold a whole node hostage."""
+    cluster = LocalCluster()
+    cluster.add_node(make_node("n0", cpu="8", mem="16Gi"))
+    cluster.add_pod(make_pod("guarded", cpu="1", mem="1Gi",
+                             labels={"app": "db"}, node_name="n0"))
+    cluster.add_pod(make_pod("free", cpu="1", mem="1Gi", node_name="n0"))
+    cluster.create("poddisruptionbudgets", _pdb("db-pdb", {"app": "db"}, 0))
+    out = Disruptions(cluster, rng=random.Random(0)).rolling_drain(
+        nodes=["n0"], retry_rounds=1, retry_after_s=0.01)
+    assert [e[1] for e in out["evicted"]] == ["free"]
+    assert [s[1] for s in out["skipped"]] == ["guarded"]
+    assert cluster.get("pods", "default", "guarded").spec.node_name == "n0"
+
+
+@pytest.mark.scenario
+def test_try_evict_displace_mode_debits_budget_and_unbinds():
+    """The eviction-subresource analog under displace: a permitted
+    eviction debits EVERY matching budget and revokes the binding in
+    place (same pod identity, node_name cleared, reason annotated)."""
+    cluster = LocalCluster()
+    cluster.add_node(make_node("n0", cpu="8", mem="16Gi"))
+    cluster.add_pod(make_pod("w", cpu="1", mem="1Gi",
+                             labels={"app": "web"}, node_name="n0"))
+    cluster.create("poddisruptionbudgets", _pdb("web-pdb", {"app": "web"}, 1))
+    pod = cluster.get("pods", "default", "w")
+    assert try_evict(cluster, pod, mode=EVICT_DISPLACE, reason="drain")
+    cur = cluster.get("pods", "default", "w")
+    assert cur is not None and not cur.spec.node_name
+    assert cur.metadata.annotations[DISPLACED_BY_ANNOTATION] == "drain"
+    assert cluster.get(
+        "poddisruptionbudgets", "default", "web-pdb"
+    ).disruptions_allowed == 0
+    with pytest.raises(EvictionBlocked) as ei:
+        try_evict(cluster, cur if cur.spec.node_name else pod,
+                  mode=EVICT_DISPLACE)
+    assert ei.value.retry_after_s > 0
+
+
+# --------------------------- satellite 3: seeded rng ------------------
+
+
+@pytest.mark.scenario
+def test_lifecycle_primitives_are_seed_deterministic():
+    """Same seed, same choices: drain order with no node list, the
+    zone an outage picks, and the synthetic trace — the determinism
+    contract in the Disruptions docstring, pinned."""
+
+    def build():
+        c = LocalCluster()
+        for i in range(6):
+            c.add_node(make_node(
+                f"n{i}", cpu="8", mem="16Gi",
+                labels={ZONE_KEY: f"z{i % 3}"},
+            ))
+        return c
+
+    orders, zones = [], []
+    for _ in range(2):
+        c = build()
+        m = Disruptions(c, rng=random.Random(42))
+        orders.append(m.rolling_drain(wave_size=3)["order"])
+        zones.append(m.zone_outage(now=1000.0)["zone"])
+    assert orders[0] == orders[1]
+    assert zones[0] == zones[1]
+    assert synthesize_trace(9, count=40, rate=30.0) == synthesize_trace(
+        9, count=40, rate=30.0)
+    a = synthesize_trace(1, count=40, rate=30.0)
+    b = synthesize_trace(2, count=40, rate=30.0)
+    assert a != b, "different seeds must move the trace"
+
+
+@pytest.mark.scenario
+def test_diurnal_load_pod_sequence_is_deterministic():
+    """The swing's pod COUNT per slice is a pure function of the
+    arguments — two runs offer identical sequences (wall clock paces
+    delivery only)."""
+    made = []
+    for _ in range(2):
+        c = LocalCluster()
+        c.add_node(make_node("n0", cpu="64", mem="128Gi", pods=500))
+        names = Disruptions(c, rng=random.Random(3)).diurnal_load(
+            lambda i: make_pod(f"d-{i}", cpu="10m", mem="8Mi"),
+            period_s=0.2, amplitude=0.8, base_rate=150.0, cycles=1,
+        )
+        made.append(names)
+    assert made[0] == made[1] and len(made[0]) > 10
+
+
+# ------------------------------- trace frontend -----------------------
+
+
+@pytest.mark.scenario
+def test_load_trace_alibaba_and_google_aliases(tmp_path):
+    """Both public-trace column dialects land in one schema: times
+    rebased to t=0, end_time folded into a lifetime, eviction status
+    rows becoming evict events, numeric resources scaled."""
+    p = tmp_path / "alibaba.csv"
+    with open(p, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["start_time", "job_name", "plan_cpu", "plan_mem",
+                    "end_time", "status"])
+        w.writerow([100, "j1", 50, 512, 130, "Terminated"])
+        w.writerow([101, "j2", 200, 1024, "", ""])
+        w.writerow([105, "j1", "", "", "", "Evicted"])
+    ev = load_trace(str(p), cpu_scale=0.01)
+    assert [e.t for e in ev] == [0.0, 1.0, 5.0]
+    assert ev[0].cpu == "500m" and ev[0].lifetime_s == 30.0
+    assert ev[1].cpu == "2000m" and ev[1].lifetime_s is None
+    assert ev[2].kind == "evict" and ev[2].name == "j1"
+
+    g = tmp_path / "google.jsonl"
+    with open(g, "w") as f:
+        f.write(json.dumps({"submit_time": 5, "task_id": 42,
+                            "cpu_request": 0.25, "memory_request": 0.1,
+                            "scheduling_class": 2}) + "\n")
+        f.write(json.dumps({"submit_time": 7, "task_id": 43,
+                            "cpu_request": 0.5,
+                            "memory_request": 0.2}) + "\n")
+    ev = load_trace(str(g), mem_scale=4096)
+    assert ev[0].name == "42" and ev[0].priority == 2
+    assert ev[0].cpu == "250m" and ev[0].mem == "410Mi"
+    assert ev[1].t == 2.0
+
+
+@pytest.mark.scenario
+def test_trace_replay_applies_lifetimes_and_evictions():
+    """A hand-written trace through the runner: the evicted pod leaves
+    the store, the short-lived pod completes and frees its node, and
+    conservation accounts for every arrival."""
+    events = [
+        TraceEvent(t=0.00, name="stay", cpu="250m", mem="256Mi"),
+        TraceEvent(t=0.01, name="quick", cpu="250m", mem="256Mi",
+                   lifetime_s=0.2),
+        TraceEvent(t=0.02, name="doomed", cpu="250m", mem="256Mi"),
+        TraceEvent(t=0.40, name="doomed", kind="evict"),
+    ]
+    with ScenarioRunner(nodes=2, zones=1) as runner:
+        res = runner.replay(events, drain_timeout_s=20.0)
+        assert res.arrivals == 3
+        assert res.trace_evictions == 1
+        assert res.lost == 0 and res.violations == 0
+        assert _wait(lambda: (
+            p := runner.cluster.get("pods", "default", "quick")
+        ) is not None and p.status.phase == "Succeeded", timeout=10.0)
+        assert runner.cluster.get("pods", "default", "doomed") is None
+        stay = runner.cluster.get("pods", "default", "stay")
+        assert stay is not None and stay.spec.node_name
+
+
+# ------------------------------- the campaigns ------------------------
+
+
+@pytest.mark.scenario
+@pytest.mark.chaos
+def test_drain_campaign_clean_with_recovery_metrics():
+    res = run_scenario("drain", seed=5, pods=60, nodes=8, rate=80.0,
+                       drain_timeout_s=40.0)
+    assert res.lost == 0, res.to_dict()
+    assert res.violations == 0, res.invariants
+    assert res.displaced > 0, "the drain displaced nothing"
+    assert res.rescheduled == res.displaced
+    assert res.displaced_unrescheduled == 0
+    assert res.reschedule_ms["p99"] > 0.0
+    assert res.arrivals == 60
+
+
+@pytest.mark.scenario
+@pytest.mark.chaos
+def test_zone_campaign_survivors_absorb_everything():
+    res = run_scenario("zone", seed=5, pods=60, nodes=9, zones=3,
+                       rate=80.0, drain_timeout_s=40.0)
+    assert res.lost == 0 and res.violations == 0
+    assert res.displaced > 0 and res.rescheduled == res.displaced
+    assert res.displaced_unrescheduled == 0
+    zone = next(c for c in res.chaos if "result" in c)["result"]
+    assert zone["zone"] == "zone-2" and zone["evicted"], (
+        "the outage must hit the configured zone and displace its pods"
+    )
+
+
+@pytest.mark.scenario
+def test_diurnal_campaign_breathes_without_loss():
+    res = run_scenario("diurnal", seed=5, pods=60, nodes=8, rate=80.0,
+                       drain_timeout_s=40.0)
+    assert res.lost == 0 and res.violations == 0
+    assert res.shed == 0, "an unbounded queue must absorb the swing"
+    assert res.arrivals == 60
