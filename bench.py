@@ -1110,6 +1110,198 @@ def run_scenario_metric(args) -> dict:
     }
 
 
+def run_autoscale_live(args) -> dict:
+    """--autoscale-live (ISSUE 19): guarded autoscaler actuation in
+    three legs.  A: the diurnal-breathe campaign through the LIVE
+    scheduler — the capacity plan enacted as real node registration /
+    cordon+drain+delete; asserts the fleet grows AND shrinks with zero
+    lost pods, zero invariant violations, goodput >= 0.9, and that the
+    JSONL actuation ledger replays bit-identically offline.  B: the
+    plan-oscillation chaos — a flip-flopping plan source must be
+    absorbed by the cooldown window (<= maxDirectionChanges direction
+    changes per window; the flap counter takes the noise).  C: the
+    stuck-drain chaos — a match-all zero-budget PDB wedges the
+    scale-down drain; past the deadline the controller must roll back
+    (un-cordon everything, fleet bit-identical to pre-actuation), and
+    proceed once the veto lifts."""
+    import tempfile
+
+    from kubernetes_tpu.api.factory import make_node, make_pod
+    from kubernetes_tpu.runtime.autoscaler import (
+        AutoscalerConfig, AutoscalerController, replay_actuations,
+    )
+    from kubernetes_tpu.runtime.chaos import Disruptions
+    from kubernetes_tpu.runtime.cluster import LocalCluster
+    from kubernetes_tpu.runtime.scenario import run_scenario
+
+    detail: dict = {}
+    failures: list = []
+
+    # ---- leg A: diurnal breathe through the live scheduler ----
+    pods = args.autoscale_live_pods
+    ledger_path = args.autoscale_ledger_out or os.path.join(
+        tempfile.mkdtemp(prefix="ktpu-autoscale-"), "actuations.jsonl"
+    )
+    res = run_scenario(
+        "autoscale", seed=args.scenario_seed, pods=pods, nodes=4,
+        rate=pods / 20.0, drain_timeout_s=45.0,
+        autoscale_ledger_path=ledger_path,
+    )
+    a = res.autoscaler or {}
+    summ = a.get("summary") or {}
+    counts = summ.get("counts") or {}
+    rep = replay_actuations(ledger_path)
+    leg_a = {
+        "initial": a.get("initial"), "peak": a.get("peak"),
+        "final": a.get("final"), "counts": counts,
+        "lost": res.lost, "violations": res.violations,
+        "goodput_ratio": res.goodput_ratio,
+        "completed": res.completed,
+        "ledger": ledger_path,
+        "replay_records": rep["records"],
+        "replay_verified": rep["verified"],
+    }
+    detail["breathe"] = leg_a
+    if not (a.get("peak", 0) > a.get("initial", 0)):
+        failures.append("breathe: fleet never grew")
+    if not (counts.get("remove", 0) >= 1
+            and a.get("final", 1 << 30) < a.get("peak", 0)):
+        failures.append("breathe: fleet never shrank")
+    if res.lost:
+        failures.append(f"breathe: {res.lost} lost pods")
+    if res.violations:
+        failures.append(f"breathe: {res.violations} invariant violations")
+    if res.goodput_ratio < 0.9:
+        failures.append(f"breathe: goodput {res.goodput_ratio} < 0.9")
+    if not rep["verified"]:
+        failures.append(
+            f"breathe: ledger replay mismatches {len(rep['mismatches'])}"
+        )
+
+    # ---- leg B: plan-oscillation chaos (flap guard) ----
+    cluster = LocalCluster()
+    for i in range(2):
+        cluster.add_node(make_node(f"flapbase-{i}", cpu="8", mem="32Gi"))
+    t_fake = [0.0]
+    ctrl = AutoscalerController(
+        cluster,
+        config=AutoscalerConfig(
+            up_stable_rounds=1, down_stable_rounds=1, cooldown_s=10.0,
+            max_direction_changes=2, max_nodes_per_round=2, min_nodes=2,
+            max_nodes=32, node_prefix="flap",
+        ),
+        clock=lambda: t_fake[0],
+    )
+    Disruptions(cluster).plan_oscillation(
+        ctrl, shape=ctrl.catalog[0]["name"], count=2, drain=2
+    )
+    max_window = 0
+    fleet_sizes = []
+    for _ in range(120):
+        t_fake[0] += 0.25
+        ctrl.step()
+        s2 = ctrl.summary()
+        max_window = max(max_window, s2["direction_changes_in_window"])
+        fleet_sizes.append(len(list(cluster.list("nodes"))))
+    s2 = ctrl.summary()
+    leg_b = {
+        "rounds": 120,
+        "max_direction_changes_in_window": max_window,
+        "flaps": s2["counts"]["flaps"],
+        "adds": s2["counts"]["add"], "removes": s2["counts"]["remove"],
+        "fleet_min": min(fleet_sizes), "fleet_max": max(fleet_sizes),
+    }
+    detail["oscillation"] = leg_b
+    if max_window > 2:
+        failures.append(
+            f"oscillation: {max_window} direction changes in one window"
+        )
+    if s2["counts"]["flaps"] == 0:
+        failures.append("oscillation: flap guard never engaged")
+
+    # ---- leg C: stuck-drain chaos (rollback) ----
+    cluster = LocalCluster()
+    for i in range(2):
+        cluster.add_node(make_node(f"stuckbase-{i}", cpu="8", mem="32Gi"))
+    ctrl = AutoscalerController(
+        cluster,
+        config=AutoscalerConfig(
+            up_stable_rounds=1, down_stable_rounds=1, cooldown_s=0.0,
+            max_nodes_per_round=2, min_nodes=2, max_nodes=8,
+            drain_deadline_s=0.6, drain_retry_rounds=3,
+            drain_retry_after_s=0.05, node_prefix="stuck",
+        ),
+    )
+    seqs = {"n": 0}
+
+    def source() -> dict:
+        seqs["n"] += 1
+        managed = ctrl.managed_nodes()
+        if not managed:
+            return {
+                "cycle": seqs["n"], "backlog_pods": 4, "overflow_pods": 4,
+                "scale_up": {"shape": ctrl.catalog[0]["name"], "count": 2},
+                "drainable": {"count": 0, "nodes": []},
+            }
+        return {
+            "cycle": seqs["n"], "backlog_pods": 0, "overflow_pods": 0,
+            "scale_up": None,
+            "drainable": {"count": len(managed), "nodes": managed},
+        }
+
+    ctrl.set_plan_source(source)
+    ctrl.step()  # scale up: 2 managed nodes join
+    managed = ctrl.managed_nodes()
+    for i, n in enumerate(managed):
+        p = make_pod(f"stuckpod-{i}", cpu="100m", mem="64Mi",
+                     labels={"app": "stuck"})
+        cluster.add_pod(p)
+        cluster.bind(p, n)
+    monkey = Disruptions(cluster)
+    monkey.stuck_drain()
+    pre = sorted(n.name for n in cluster.list("nodes"))
+    rec = ctrl.step()  # scale-down wedges on the PDB -> rollback
+    post = sorted(n.name for n in cluster.list("nodes"))
+    cordoned = [
+        n.name for n in cluster.list("nodes") if n.spec.unschedulable
+    ]
+    s3 = ctrl.summary()
+    rolled = bool((rec.get("outcome") or {}).get("rollback"))
+    monkey.clear_stuck_drain()
+    ctrl.step()  # veto lifted: the same scale-down must now proceed
+    leg_c = {
+        "managed_before": len(managed),
+        "rollback": rolled,
+        "rollbacks_total": s3["counts"]["rollbacks"],
+        "fleet_preserved": post == pre,
+        "cordoned_after_rollback": cordoned,
+        "managed_after_clear": len(ctrl.managed_nodes()),
+    }
+    detail["stuck_drain"] = leg_c
+    if not rolled:
+        failures.append("stuck-drain: no rollback recorded")
+    if post != pre:
+        failures.append("stuck-drain: fleet not restored")
+    if cordoned:
+        failures.append(f"stuck-drain: still cordoned {cordoned}")
+    if ctrl.managed_nodes():
+        failures.append("stuck-drain: scale-down did not proceed "
+                        "after the veto lifted")
+
+    clean = not failures
+    return {
+        "metric": "autoscale_live_clean",
+        "value": 1.0 if clean else 0.0,
+        "unit": "bool",
+        "autoscale_live_clean": clean,
+        "autoscale_live_failures": failures,
+        "autoscale_live_peak": a.get("peak"),
+        "autoscale_live_final": a.get("final"),
+        "autoscale_live_replay_verified": rep["verified"],
+        "detail": {"autoscale_live": detail},
+    }
+
+
 def run_tiered(args, single_lane_ref: "float | None" = None) -> dict:
     """Latency-tier scenario (ISSUE 6): a SATURATING bulk backlog drains
     through the tiered scheduler while express pods (priority above the
@@ -2382,6 +2574,8 @@ def run_child(args) -> None:
                 result = run_tiered_metric(args)
             elif args.megacycle:
                 result = run_megacycle_metric(args)
+            elif args.autoscale_live:
+                result = run_autoscale_live(args)
             elif args.autoscale:
                 result = run_autoscale_metric(args)
             elif args.replicas:
@@ -2504,6 +2698,11 @@ def _child_cmd(args, platform: str | None) -> list:
             "--autoscale-shapes", str(args.autoscale_shapes),
             "--autoscale-ref-shapes", str(args.autoscale_ref_shapes),
             "--autoscale-bins", str(args.autoscale_bins)]
+    if args.autoscale_live:
+        cmd += ["--autoscale-live",
+                "--autoscale-live-pods", str(args.autoscale_live_pods)]
+        if args.autoscale_ledger_out:
+            cmd += ["--autoscale-ledger-out", args.autoscale_ledger_out]
     if args.replicas:
         cmd += ["--replicas", str(args.replicas)]
     if args.sharded:
@@ -2584,11 +2783,11 @@ def orchestrate(args) -> None:
     tpu_min = args.tpu_min_budget
     if (args.platform == "cpu" or args.density or args.overload
             or args.tiered or args.sharded or args.megacycle
-            or args.scenario):
+            or args.scenario or args.autoscale_live):
         # explicit cpu-only run, or density/overload/tiered/sharded/
-        # megacycle/scenario mode (control-plane benchmarks — the host
-        # runtime dominates, not the device; the sharded identity pin
-        # runs on the virtual cpu mesh)
+        # megacycle/scenario/autoscale-live mode (control-plane
+        # benchmarks — the host runtime dominates, not the device; the
+        # sharded identity pin runs on the virtual cpu mesh)
         remaining = 0
     if remaining < tpu_min:
         det = banked["result"].setdefault("detail", {})
@@ -2937,7 +3136,35 @@ def run_replay(args) -> None:
     one JSON line; exits 1 on any mismatch."""
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
+    from kubernetes_tpu.runtime.autoscaler import (
+        replay_actuations, sniff_actuation_ledger,
+    )
     from kubernetes_tpu.runtime.ledger import replay
+
+    if sniff_actuation_ledger(args.replay):
+        # autoscaler actuation JSONL (not a binary cycle ledger): re-run
+        # the pure decide() over every recorded (plan, state) and compare
+        # canonical JSON — the actuation-side half of the offline gate
+        t0 = time.monotonic()
+        try:
+            out = replay_actuations(args.replay)
+        except Exception as e:  # noqa: BLE001 — the JSON line must emit
+            _emit({
+                "metric": "actuation_replay_bit_identical",
+                "value": 0.0, "unit": "bool",
+                "detail": {"error": f"{type(e).__name__}: {e}",
+                           "ledger": args.replay},
+            })
+            sys.exit(1)
+        out["seconds"] = round(time.monotonic() - t0, 3)
+        out["ledger"] = args.replay
+        _emit({
+            "metric": "actuation_replay_bit_identical",
+            "value": 1.0 if out["verified"] else 0.0,
+            "unit": "bool",
+            "detail": out,
+        })
+        sys.exit(0 if out["verified"] else 1)
 
     t0 = time.monotonic()
     try:
@@ -3050,6 +3277,23 @@ def main():
     ap.add_argument("--autoscale-bins", type=int, default=2048,
                     help="max bins per shape lane (must cover the "
                     "backlog's node demand for a shape to report ok)")
+    ap.add_argument("--autoscale-live", action="store_true",
+                    help="guarded autoscaler actuation campaign (ISSUE "
+                    "19): the diurnal-breathe scenario with the LIVE "
+                    "controller enacting the capacity plan (grows AND "
+                    "shrinks, zero lost pods/violations, actuation "
+                    "ledger replayed bit-identically), plus the "
+                    "plan-oscillation flap guard and the stuck-drain "
+                    "rollback chaos legs")
+    ap.add_argument("--autoscale-live-pods", type=int, default=160,
+                    help="arrivals in the --autoscale-live breathe "
+                    "trace (rate is pods/20 so the diurnal span stays "
+                    "~20s whatever the size)")
+    ap.add_argument("--autoscale-ledger-out", default=None,
+                    help="where --autoscale-live records the JSONL "
+                    "actuation ledger (default: a temp dir; the leg "
+                    "replays it inline either way; bench.py --replay "
+                    "<path> re-verifies it offline)")
     ap.add_argument("--replicas", type=int, default=0,
                     help="replica mode (ISSUE 14): sweep N = 1, 2, ... "
                     "queue-sharded scheduler replicas through the live "
@@ -3059,7 +3303,7 @@ def main():
                     "default report still runs a scaled-down N=2 stage)")
     ap.add_argument(
         "--scenario", default=None,
-        choices=["drain", "zone", "diurnal", "trace"],
+        choices=["drain", "zone", "diurnal", "trace", "autoscale"],
         help="trace-driven lifecycle campaign (runtime/scenario.py) "
              "against the live scheduler: a synthetic (or --scenario-trace "
              "file) arrival trace replayed under a virtual clock with the "

@@ -996,6 +996,49 @@ class APIServer:
                         ct="application/json",
                     )
                     return
+                if self.path.partition("?")[0] == "/debug/autoscaler":
+                    # the guarded actuation loop (ISSUE 19): managed
+                    # fleet, hysteresis streaks, cooldown window, cost,
+                    # recent actuation records.  Tolerates no wired
+                    # controller (actuation is commonly off).
+                    # Inflight-exempt like its siblings
+                    from kubernetes_tpu.runtime import autoscaler
+                    from kubernetes_tpu.runtime.ledger import debug_body
+
+                    ctrl = autoscaler.get_default()
+                    self._send_text(
+                        debug_body(
+                            (ctrl.debug_payload if ctrl is not None
+                             else lambda _lim=None: {"enabled": False}),
+                            self.path.partition("?")[2],
+                        ),
+                        ct="application/json",
+                    )
+                    return
+                if self.path.partition("?")[0] == "/debug/capacity/enact":
+                    # GET is a status peek — the actuation verb is POST
+                    # (handled in do_POST); serving the peek keeps the
+                    # /debug/ index walk uniform
+                    from kubernetes_tpu.runtime import autoscaler
+                    from kubernetes_tpu.runtime.ledger import debug_body
+
+                    ctrl = autoscaler.get_default()
+                    self._send_text(
+                        debug_body(
+                            lambda _lim=None: {
+                                "method": "POST",
+                                "hint": "POST runs one guarded round "
+                                        "now; ?dryRun=1 decides + "
+                                        "records without mutating",
+                                "enabled": ctrl is not None,
+                                "last": (ctrl.summary().get("last")
+                                         if ctrl is not None else None),
+                            },
+                            self.path.partition("?")[2],
+                        ),
+                        ct="application/json",
+                    )
+                    return
                 if self.path.partition("?")[0] == "/debug/replicas":
                     # queue-sharded replicas (ISSUE 14): the explicit
                     # process aggregate — per-replica cycle/conflict
@@ -1664,6 +1707,35 @@ class APIServer:
                         "status": {"allowed": bool(allowed)},
                     }, code=201)
                     return
+                if self.path.partition("?")[0] == "/debug/capacity/enact":
+                    # ISSUE 19: run ONE guarded actuation round NOW —
+                    # serialized under the controller's own lock, so a
+                    # manual enact can't interleave with the loop.
+                    # ?dryRun=1 decides + records without mutating the
+                    # fleet.  Inflight-exempt like its siblings
+                    from kubernetes_tpu.runtime import autoscaler
+
+                    ctrl = autoscaler.get_default()
+                    if ctrl is None:
+                        self._status(409, "Conflict",
+                                     "no autoscaler wired")
+                        return
+                    from urllib.parse import parse_qs
+
+                    q = parse_qs(self.path.partition("?")[2])
+                    dry = None
+                    if "dryRun" in q:
+                        dry = q["dryRun"][-1] not in ("0", "false", "")
+                    try:
+                        rec = ctrl.enact(dry_run=dry)
+                    except Exception as e:  # noqa: BLE001
+                        self._status(500, "InternalError", str(e))
+                        return
+                    self._send_text(
+                        json.dumps(rec).encode() + b"\n",
+                        ct="application/json",
+                    )
+                    return
                 r = outer._route(self.path)
                 if r is None:
                     self._status(404, "NotFound", self.path)
@@ -2161,6 +2233,8 @@ class APIServer:
                       "/version", "/debug/traces", "/debug/decisions",
                       "/debug/cluster", "/debug/perf", "/debug/profile",
                       "/debug/quality", "/debug/replicas",
+                      "/debug/capacity", "/debug/autoscaler",
+                      "/debug/capacity/enact",
                       "/debug", "/debug/")
             for method in ("do_GET", "do_POST", "do_PUT", "do_PATCH",
                            "do_DELETE"):

@@ -191,6 +191,42 @@ def build_parser() -> argparse.ArgumentParser:
                    "([{name, cpu, memory, ephemeral-storage?, pods?, "
                    "...}]; config nodeShapeCatalog).  Implies "
                    "--capacity-planner")
+    p.add_argument("--autoscaler", action="store_true", default=None,
+                   help="enact the capacity plan against the live store "
+                   "(config autoscaler; implies --capacity-planner): "
+                   "scale-up registers nodes from the winning catalog "
+                   "shape, scale-down cordons + drains through the PDB "
+                   "path and deletes; hysteresis + cooldown bound "
+                   "flapping, stuck drains and mid-batch failures roll "
+                   "back.  Local mode only — against --server the "
+                   "mirror is read-only for nodes")
+    p.add_argument("--autoscaler-interval-s", type=float, default=None,
+                   help="seconds between actuation rounds (config "
+                   "autoscalerIntervalSeconds; default 1.0)")
+    p.add_argument("--autoscaler-dry-run", action="store_true",
+                   default=None,
+                   help="decide + record but never actuate (config "
+                   "autoscalerDryRun)")
+    p.add_argument("--autoscaler-cooldown-s", type=float, default=None,
+                   help="direction-change window: at most "
+                   "autoscalerMaxDirectionChanges changes inside it "
+                   "(config autoscalerCooldownSeconds; default 30)")
+    p.add_argument("--autoscaler-max-nodes-per-round", type=int,
+                   default=None,
+                   help="batch cap per actuation round (config "
+                   "autoscalerMaxNodesPerRound; default 4)")
+    p.add_argument("--autoscaler-drain-deadline-s", type=float,
+                   default=None,
+                   help="scale-down drain budget before rollback "
+                   "(config autoscalerDrainDeadlineSeconds; default 30)")
+    p.add_argument("--autoscaler-min-nodes", type=int, default=None,
+                   help="fleet floor (config autoscalerMinNodes)")
+    p.add_argument("--autoscaler-max-nodes", type=int, default=None,
+                   help="fleet ceiling (config autoscalerMaxNodes)")
+    p.add_argument("--autoscaler-ledger-path", default=None,
+                   help="JSONL actuation ledger for offline replay "
+                   "(config autoscalerLedgerPath; bench.py --replay "
+                   "re-verifies every recorded decision)")
     p.add_argument("--simulate-nodes", type=int, default=0,
                    help="register N hollow nodes")
     p.add_argument("--simulate-pods", type=int, default=0,
@@ -282,6 +318,26 @@ def main(argv=None) -> int:
             with open(raw) as f:
                 cc.node_shape_catalog = json.load(f)
         cc.capacity_planner = True  # a catalog implies the planner
+    if args.autoscaler is not None:
+        cc.autoscaler = args.autoscaler
+    if args.autoscaler_interval_s is not None:
+        cc.autoscaler_interval_s = args.autoscaler_interval_s
+    if args.autoscaler_dry_run is not None:
+        cc.autoscaler_dry_run = args.autoscaler_dry_run
+    if args.autoscaler_cooldown_s is not None:
+        cc.autoscaler_cooldown_s = args.autoscaler_cooldown_s
+    if args.autoscaler_max_nodes_per_round is not None:
+        cc.autoscaler_max_nodes_per_round = args.autoscaler_max_nodes_per_round
+    if args.autoscaler_drain_deadline_s is not None:
+        cc.autoscaler_drain_deadline_s = args.autoscaler_drain_deadline_s
+    if args.autoscaler_min_nodes is not None:
+        cc.autoscaler_min_nodes = args.autoscaler_min_nodes
+    if args.autoscaler_max_nodes is not None:
+        cc.autoscaler_max_nodes = args.autoscaler_max_nodes
+    if args.autoscaler_ledger_path is not None:
+        cc.autoscaler_ledger_path = args.autoscaler_ledger_path
+    if cc.autoscaler:
+        cc.capacity_planner = True  # actuation needs the plan
 
     # persistent compile cache BEFORE any jit compile (engine build,
     # prewarm, first cycle) so every executable of this process is served
@@ -331,6 +387,12 @@ def main(argv=None) -> int:
             print("error: --simulate-* inject into the local mirror only "
                   "(the next resync would destroy them); create the "
                   "workload on the remote server instead", file=sys.stderr)
+            return 2
+        if cc.autoscaler:
+            print("error: --autoscaler registers/deletes nodes on the "
+                  "local store; against --server the informer mirror is "
+                  "resync-owned (the next relist would destroy them)",
+                  file=sys.stderr)
             return 2
         reflector = Reflector(args.server, token=args.token).start()
         if not reflector.wait_for_sync(timeout=30.0):
@@ -403,6 +465,33 @@ def main(argv=None) -> int:
             ),
             file=sys.stderr,
         )
+
+    autoscaler = None
+    if cc.autoscaler:
+        from kubernetes_tpu.runtime import autoscaler as autoscaler_mod
+
+        autoscaler = autoscaler_mod.AutoscalerController(
+            cluster,
+            planner=getattr(sched, "capacity", None),
+            invariants=sched.invariants,
+            config=autoscaler_mod.AutoscalerConfig(
+                interval_s=cc.autoscaler_interval_s,
+                dry_run=cc.autoscaler_dry_run,
+                cooldown_s=cc.autoscaler_cooldown_s,
+                max_nodes_per_round=cc.autoscaler_max_nodes_per_round,
+                drain_deadline_s=cc.autoscaler_drain_deadline_s,
+                min_nodes=cc.autoscaler_min_nodes,
+                max_nodes=cc.autoscaler_max_nodes,
+            ),
+            ledger=sched.ledger,
+            ledger_path=cc.autoscaler_ledger_path,
+        )
+        autoscaler_mod.set_default(autoscaler)
+        autoscaler.start()
+        print("autoscaler actuation loop on "
+              f"{cc.autoscaler_interval_s}s interval"
+              + (" (dry-run)" if cc.autoscaler_dry_run else ""),
+              file=sys.stderr)
 
     try:
         if args.one_shot:
@@ -484,6 +573,8 @@ def main(argv=None) -> int:
             sched.stop()
         return 0
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         if health is not None:
             health.stop()
 

@@ -159,6 +159,23 @@ class KubeSchedulerConfiguration:
     capacity_planner: bool = False
     capacity_interval_cycles: int = 256
     node_shape_catalog: Optional[list] = None
+    # guarded autoscaler actuation (runtime/autoscaler.py): a control
+    # loop that ENACTS the capacity plan against the live store —
+    # scale-up registers nodes from the winning catalog shape (paced,
+    # batch-capped), scale-down cordons + drains through the PDB path
+    # and deletes.  Dual-threshold hysteresis + a cooldown window bound
+    # direction flapping; stuck drains and mid-batch registration
+    # failures roll back; every actuation is recorded to a JSONL ledger
+    # replayable offline (bench.py --replay).  Implies capacityPlanner.
+    autoscaler: bool = False
+    autoscaler_interval_s: float = 1.0
+    autoscaler_dry_run: bool = False
+    autoscaler_cooldown_s: float = 30.0
+    autoscaler_max_nodes_per_round: int = 4
+    autoscaler_drain_deadline_s: float = 30.0
+    autoscaler_min_nodes: int = 1
+    autoscaler_max_nodes: int = 256
+    autoscaler_ledger_path: Optional[str] = None
     # queue-sharded scheduler replicas (runtime/replicas.py +
     # runtime/reconciler.py): run this many scheduler loops (threads)
     # over one queue/cache, each draining a stable hash-shard and
@@ -264,6 +281,19 @@ class KubeSchedulerConfiguration:
                 d.get("capacityIntervalCycles", 256)
             ),
             node_shape_catalog=d.get("nodeShapeCatalog"),
+            autoscaler=bool(d.get("autoscaler", False)),
+            autoscaler_interval_s=float(d.get("autoscalerIntervalSeconds", 1.0)),
+            autoscaler_dry_run=bool(d.get("autoscalerDryRun", False)),
+            autoscaler_cooldown_s=float(d.get("autoscalerCooldownSeconds", 30.0)),
+            autoscaler_max_nodes_per_round=int(
+                d.get("autoscalerMaxNodesPerRound", 4)
+            ),
+            autoscaler_drain_deadline_s=float(
+                d.get("autoscalerDrainDeadlineSeconds", 30.0)
+            ),
+            autoscaler_min_nodes=int(d.get("autoscalerMinNodes", 1)),
+            autoscaler_max_nodes=int(d.get("autoscalerMaxNodes", 256)),
+            autoscaler_ledger_path=d.get("autoscalerLedgerPath"),
             replicas=int(d.get("replicas", 1)),
             namespace_quotas=d.get("namespaceQuotas"),
         )

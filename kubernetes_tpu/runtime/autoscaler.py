@@ -1,0 +1,807 @@
+"""Guarded autoscaler actuation: close the capacity-plan loop (ISSUE 19).
+
+PR 15's CapacityPlanner *recommends* ("add 37 × shape-C; n12,n47
+drainable") but nothing *enacts*.  AutoscalerController is the missing
+actuator — the cluster-autoscaler analog scoped to this repo's store:
+
+  plan (capacity.summary()["recommendation"])
+      -> decide (PURE: dual-threshold hysteresis, stable-round streaks,
+                 cooldown window bounding direction changes, batch caps,
+                 fleet floor/ceiling)
+      -> enact (REAL apiserver verbs: scale-up registers nodes built
+                from the winning nodeShapeCatalog shape; scale-down
+                cordons + drains through controllers.drain_waves — the
+                same PDB/Retry-After wave loop as the chaos upgrade
+                monkey — then deletes; displaced pods re-enter via the
+                shed-exempt displaced requeue path, so conservation
+                holds by construction)
+
+Robustness is the headline:
+
+  * Dual-threshold hysteresis: scale-up needs `up_stable_rounds`
+    consecutive FRESH plans showing overflow; scale-down needs
+    `down_stable_rounds` showing a drainable set AND zero overflow.
+    Streaks reset after every actuation, so each move needs renewed
+    conviction.
+  * Cooldown window: at most `max_direction_changes` add<->remove
+    direction changes per `cooldown_s` window — an oscillating plan
+    cannot flap the fleet (pinned by test; blocked flips increment
+    scheduler_autoscaler_flaps_total and HOLD).
+  * Rollback: a scale-down whose drain strands pods past
+    `drain_deadline_s` (or whose PDBs never reopen) un-cordons every
+    victim and aborts — the fleet returns to its pre-actuation state; a
+    scale-up failing mid-batch deregisters the partial batch.  Both
+    increment scheduler_autoscaler_rollbacks_total{direction=...}.
+  * Invariant rules: node-lifecycle conservation (every registered node
+    ends active/removed — InvariantChecker.note_node_* seams), no
+    eviction without budget debit (try_evict reports grants), and the
+    capacity floor — a scale-down that would drop fleet allocatable
+    below committed usage is REFUSED before the first cordon.
+  * Replayable actuation ledger: every step appends one JSONL record
+    {seq, t, plan, state, decision, outcome}; replay_actuations()
+    re-runs the pure decide() over the recorded inputs and verifies the
+    decisions are bit-identical (`bench.py --replay` sniffs the file
+    type) — a scale event is re-verifiable offline, like a scheduling
+    cycle.
+  * Dry-run: decide + record, never mutate.
+
+Chaos primitives for a MISBEHAVING actuator live in runtime/chaos.py:
+stuck_drain (match-all zero-budget PDB), actuation_fault (mid-batch
+register failure), plan_oscillation (flip-flopping plan source).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field
+from typing import Callable, Deque, Dict, List, Optional, Set
+
+from kubernetes_tpu.api.factory import make_node
+from kubernetes_tpu.runtime.cluster import LocalCluster
+from kubernetes_tpu.runtime.controllers import (
+    EVICT_DISPLACE,
+    drain_waves,
+    uncordon_node,
+)
+from kubernetes_tpu.utils import klog
+from kubernetes_tpu.utils import metrics as m
+
+# decision actions (the decide() vocabulary)
+HOLD = "hold"
+ADD = "add"
+REMOVE = "remove"
+
+# node label stamped on every node this actuator registers, so the
+# managed set survives a controller restart (rebuilt from the store)
+MANAGED_LABEL = "scheduler.kubernetes-tpu.io/autoscaled"
+SHAPE_LABEL = "scheduler.kubernetes-tpu.io/shape"
+
+# actuation-ledger framing
+LEDGER_KIND = "autoscaler-actuations"
+LEDGER_VERSION = 1
+
+
+class ActuationFault(RuntimeError):
+    """Injected mid-batch registration failure (chaos.actuation_fault):
+    the cloud API returned 5xx halfway through a scale-up batch."""
+
+
+@dataclass
+class AutoscalerConfig:
+    """Knobs for the guarded actuation loop (see README "Autoscaling")."""
+
+    enabled: bool = True
+    interval_s: float = 0.2          # actuation loop period
+    up_overflow_threshold: int = 1   # overflow pods to arm scale-up
+    down_drainable_threshold: int = 1  # drainable nodes to arm scale-down
+    up_stable_rounds: int = 2        # fresh plans agreeing before adding
+    down_stable_rounds: int = 3      # removal needs more conviction
+    cooldown_s: float = 5.0          # direction-change window
+    max_direction_changes: int = 2   # add<->remove flips per window
+    max_nodes_per_round: int = 4     # batch cap per actuation
+    drain_wave_size: int = 2
+    drain_retry_rounds: int = 8
+    drain_retry_after_s: float = 0.05
+    drain_deadline_s: float = 5.0    # stuck-drain rollback deadline
+    min_nodes: int = 1               # fleet floor (never drain below)
+    max_nodes: int = 256             # fleet ceiling (never add above)
+    dry_run: bool = False            # decide + record, never mutate
+    node_prefix: str = "autoscale"   # registered node name prefix
+    scale_down_unmanaged: bool = False  # allow draining base nodes
+
+
+def _compact_plan(plan: Optional[dict]) -> Optional[dict]:
+    """The slice of a capacity recommendation decide() consumes (plus
+    backlog for humans) — this is what the actuation ledger records, so
+    replay re-runs decide over byte-identical inputs."""
+    if not plan:
+        return None
+    dr = plan.get("drainable") or {}
+    return {
+        "cycle": plan.get("cycle"),
+        "backlog_pods": plan.get("backlog_pods"),
+        "overflow_pods": plan.get("overflow_pods"),
+        "scale_up": plan.get("scale_up"),
+        "drainable": {
+            "count": dr.get("count", 0),
+            "nodes": list(dr.get("nodes") or []),
+        },
+    }
+
+
+class AutoscalerController:
+    """The guarded actuation loop.  Thread-safe: step() serializes under
+    a lock, so the background loop, a POST /debug/capacity/enact, and a
+    test driving step() directly cannot interleave an actuation."""
+
+    def __init__(
+        self,
+        cluster: LocalCluster,
+        *,
+        planner=None,
+        config: Optional[AutoscalerConfig] = None,
+        invariants=None,
+        clock: Callable[[], float] = time.monotonic,
+        catalog: Optional[List[dict]] = None,
+        ledger=None,
+        ledger_path: Optional[str] = None,
+    ):
+        self.cluster = cluster
+        self.planner = planner
+        self.config = config or AutoscalerConfig()
+        self.invariants = invariants
+        self.clock = clock
+        self.ledger = ledger  # DecisionLedger: record_event mirror
+        self.ledger_path = ledger_path
+        if catalog is not None:
+            self.catalog = list(catalog)
+        elif planner is not None and getattr(planner, "catalog", None):
+            self.catalog = list(planner.catalog)
+        else:
+            from kubernetes_tpu.runtime.capacity import DEFAULT_SHAPE_CATALOG
+
+            self.catalog = list(DEFAULT_SHAPE_CATALOG)
+
+        self._lock = threading.Lock()        # serializes step()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._plan_source: Callable[[], Optional[dict]] = self._planner_plan
+        self._t0 = self.clock()
+        self._last_step_t: Optional[float] = None
+        self._seq = 0
+        self._node_seq = 0
+        self._last_cycle: Optional[int] = None
+        self._last_direction: Optional[str] = None
+        self._changes: Deque[float] = deque()  # direction-change stamps
+        self._up_streak = 0
+        self._down_streak = 0
+        self._cost_node_s = 0.0
+        self._fleet_peak = 0
+        self._fleet_min = 1 << 30
+        self._counts: Dict[str, int] = {
+            "add": 0, "remove": 0, "hold": 0, "flaps": 0, "rollbacks": 0,
+        }
+        self._history: Deque[dict] = deque(maxlen=256)
+        self._fault: Optional[dict] = None  # {"after": n, "count": k}
+        self._ledger_fh = None
+        # rebuild the managed set from the store (restart survival)
+        self._managed: Set[str] = {
+            n.name for n in cluster.list("nodes")
+            if (n.labels or {}).get(MANAGED_LABEL) == "true"
+        }
+
+    # ------------------------------------------------------------- decide
+
+    @staticmethod
+    def decide(plan: Optional[dict], state: dict,
+               cfg: AutoscalerConfig) -> dict:
+        """PURE actuation policy: (plan, observed state, config) -> one
+        decision dict.  No clock, no store, no randomness — the
+        actuation ledger records its exact inputs, and replay verifies
+        the recorded decision falls out bit-identically.
+
+        `state` keys: fleet (int), managed (sorted list of node names
+        this actuator registered), pending_pods, idle_managed /
+        idle_nodes (pod-free, uncordoned), last_cycle, last_direction,
+        recent_changes (direction changes inside the cooldown window),
+        up_streak, down_streak."""
+        d: dict = {
+            "action": HOLD,
+            "reason": "",
+            "up_streak": int(state.get("up_streak") or 0),
+            "down_streak": int(state.get("down_streak") or 0),
+        }
+        managed = list(state.get("managed") or [])
+        fleet = int(state.get("fleet") or 0)
+        fresh = bool(plan) and plan.get("cycle") is not None and (
+            plan.get("cycle") != state.get("last_cycle")
+        )
+        if fresh:
+            d["cycle"] = plan.get("cycle")
+            su = plan.get("scale_up") or None
+            overflow = int(plan.get("overflow_pods") or 0)
+            dr = plan.get("drainable") or {}
+            want_up = (
+                su is not None
+                and int(su.get("count") or 0) > 0
+                and overflow >= cfg.up_overflow_threshold
+            )
+            if cfg.scale_down_unmanaged:
+                victims_all = list(dr.get("nodes") or [])
+            else:
+                victims_all = [
+                    n for n in (dr.get("nodes") or []) if n in managed
+                ]
+            want_down = (
+                not want_up
+                and overflow == 0
+                and int(dr.get("count") or 0) >= cfg.down_drainable_threshold
+                and bool(victims_all)
+            )
+            down_reason = "plan-drainable"
+        else:
+            # stale or missing plan: never scale UP on old evidence, but
+            # scale DOWN from direct observation — the planner only
+            # solves during scheduling cycles, so an IDLE cluster's plan
+            # is permanently stale.  Waiting for a fresh solve would pin
+            # every autoscaled node forever; the live store (zero
+            # pending pods, pod-free managed nodes) is itself fresh
+            # evidence, re-verified each round by the hysteresis streak.
+            su = None
+            want_up = False
+            victims_all = list(
+                (state.get("idle_nodes") if cfg.scale_down_unmanaged
+                 else state.get("idle_managed")) or []
+            )
+            want_down = (
+                int(state.get("pending_pods") or 0) == 0
+                and len(victims_all) >= cfg.down_drainable_threshold
+            )
+            down_reason = "idle-observed"
+            if not want_down:
+                d["down_streak"] = 0
+                d["reason"] = (
+                    "stale-plan"
+                    if plan and plan.get("cycle") is not None else "no-plan"
+                )
+                return d
+
+        # dual-threshold hysteresis: independent stable-round streaks
+        d["up_streak"] = d["up_streak"] + 1 if want_up else 0
+        d["down_streak"] = d["down_streak"] + 1 if want_down else 0
+        if want_up and d["up_streak"] >= cfg.up_stable_rounds:
+            direction = ADD
+        elif want_down and d["down_streak"] >= cfg.down_stable_rounds:
+            direction = REMOVE
+        else:
+            d["reason"] = "hysteresis"
+            return d
+
+        # cooldown: a direction CHANGE while the window is saturated is
+        # a flap — hold instead of thrash
+        last = state.get("last_direction")
+        if (
+            last is not None
+            and direction != last
+            and int(state.get("recent_changes") or 0)
+            >= cfg.max_direction_changes
+        ):
+            d["reason"] = "cooldown"
+            d["flap"] = True
+            return d
+
+        if direction == ADD:
+            count = min(
+                int(su.get("count") or 0),
+                cfg.max_nodes_per_round,
+                max(0, cfg.max_nodes - fleet),
+            )
+            if count <= 0:
+                d["reason"] = "fleet-ceiling"
+                return d
+            d.update(
+                action=ADD, reason="plan-overflow", count=count,
+                shape=su.get("shape"), up_streak=0,
+            )
+        else:
+            count = min(
+                len(victims_all),
+                cfg.max_nodes_per_round,
+                max(0, fleet - cfg.min_nodes),
+            )
+            if count <= 0:
+                d["reason"] = "fleet-floor"
+                return d
+            d.update(
+                action=REMOVE, reason=down_reason, count=count,
+                victims=victims_all[:count], down_streak=0,
+            )
+        return d
+
+    # --------------------------------------------------------------- step
+
+    def step(self, dry_run: Optional[bool] = None) -> dict:
+        """One actuation round: read plan, decide, enact, record.
+        Returns the ledger record.  `dry_run` overrides the config knob
+        for this round only (the POST endpoint's ?dryRun=)."""
+        with self._lock:
+            return self._step_locked(dry_run)
+
+    def _step_locked(self, dry_run: Optional[bool]) -> dict:
+        now = self.clock()
+        # cost objective: managed node-seconds, integrated per step
+        if self._last_step_t is not None:
+            self._cost_node_s += len(self._managed) * (now - self._last_step_t)
+        self._last_step_t = now
+        m.AUTOSCALER_COST.set(self._cost_node_s)
+        m.AUTOSCALER_MANAGED.set(float(len(self._managed)))
+
+        plan = None
+        try:
+            plan = self._plan_source()
+        except Exception as e:  # noqa: BLE001 — a broken planner holds
+            klog.errorf("autoscaler plan source failed: %s", e)
+        state = self._state(now)
+        self._fleet_peak = max(self._fleet_peak, state["fleet"])
+        self._fleet_min = min(self._fleet_min, state["fleet"])
+        decision = self.decide(plan, state, self.config)
+
+        if "cycle" in decision:
+            self._last_cycle = decision["cycle"]
+        self._up_streak = decision["up_streak"]
+        self._down_streak = decision["down_streak"]
+        if decision.get("flap"):
+            self._counts["flaps"] += 1
+            m.AUTOSCALER_FLAPS.inc()
+
+        dry = self.config.dry_run if dry_run is None else bool(dry_run)
+        outcome: dict = {"enacted": False, "dry_run": dry}
+        if decision["action"] == ADD:
+            if dry:
+                outcome["planned"] = decision["count"]
+            else:
+                outcome = self._scale_up(decision)
+        elif decision["action"] == REMOVE:
+            if dry:
+                outcome["planned"] = decision["count"]
+            else:
+                outcome = self._scale_down(decision)
+        else:
+            self._counts["hold"] += 1
+
+        if outcome.get("enacted"):
+            self._counts[decision["action"]] += 1
+            if (
+                self._last_direction is not None
+                and decision["action"] != self._last_direction
+            ):
+                self._changes.append(now)
+            self._last_direction = decision["action"]
+            # renewed conviction required after every actuation
+            self._up_streak = 0
+            self._down_streak = 0
+        if outcome.get("rollback"):
+            self._counts["rollbacks"] += 1
+
+        rec = {
+            "seq": self._seq,
+            "t": round(now - self._t0, 6),
+            "plan": _compact_plan(plan),
+            "state": state,
+            "decision": decision,
+            "outcome": outcome,
+        }
+        self._seq += 1
+        self._record(rec)
+        return rec
+
+    def enact(self, dry_run: Optional[bool] = None) -> dict:
+        """POST /debug/capacity/enact: one guarded actuation round NOW
+        (same lock as the loop — no interleaving)."""
+        return self.step(dry_run=dry_run)
+
+    # -------------------------------------------------------------- enact
+
+    def _scale_up(self, decision: dict) -> dict:
+        shape = self._shape_entry(decision.get("shape"))
+        added: List[str] = []
+        try:
+            for _ in range(int(decision["count"])):
+                self._maybe_fault()
+                name = f"{self.config.node_prefix}-{self._node_seq}"
+                self._node_seq += 1
+                node = make_node(
+                    name,
+                    cpu=str(shape.get("cpu", "4")),
+                    mem=str(shape.get("memory", "8Gi")),
+                    pods=int(float(shape.get("pods", 110))),
+                    labels={
+                        MANAGED_LABEL: "true",
+                        SHAPE_LABEL: str(shape.get("name", "")),
+                    },
+                )
+                if self.invariants is not None:
+                    self.invariants.note_node_registered(name)
+                self.cluster.add_node(node)
+                self._managed.add(name)
+                added.append(name)
+                if self.invariants is not None:
+                    self.invariants.note_node_active(name)
+                m.AUTOSCALER_NODES_ADDED.inc()
+        except Exception as e:  # noqa: BLE001 — incl. ActuationFault
+            # mid-batch failure: deregister the partial batch so the
+            # fleet never keeps a half-actuated scale event
+            for name in added:
+                try:
+                    self.cluster.delete("nodes", "", name)
+                except Exception:  # noqa: BLE001 — best-effort unwind
+                    pass
+                self._managed.discard(name)
+                if self.invariants is not None:
+                    self.invariants.note_node_removed(name)
+            m.AUTOSCALER_ROLLBACKS.inc(direction="add")
+            klog.errorf(
+                "autoscaler scale-up failed mid-batch (%s); "
+                "deregistered %d node(s)", e, len(added),
+            )
+            return {
+                "enacted": False,
+                "dry_run": False,
+                "rollback": True,
+                "error": str(e),
+                "deregistered": added,
+            }
+        return {
+            "enacted": True,
+            "dry_run": False,
+            "added": added,
+            "shape": shape.get("name"),
+        }
+
+    def _scale_down(self, decision: dict) -> dict:
+        victims = [
+            v for v in decision.get("victims") or []
+            if self.cluster.get("nodes", "", v) is not None
+        ]
+        if not victims:
+            return {"enacted": False, "dry_run": False,
+                    "refused": "victims-gone"}
+        # capacity floor: AFTER removing the victims, the remaining
+        # fleet's allocatable must still cover every bound pod's
+        # requests (including pods about to be displaced off the
+        # victims) — refuse BEFORE the first cordon otherwise
+        if not self._floor_ok(victims):
+            return {"enacted": False, "dry_run": False,
+                    "refused": "capacity-floor"}
+        if self.invariants is not None:
+            for v in victims:
+                self.invariants.note_node_draining(v)
+        deadline = self.clock() + self.config.drain_deadline_s
+        res = drain_waves(
+            self.cluster,
+            victims,
+            wave_size=self.config.drain_wave_size,
+            mode=EVICT_DISPLACE,
+            retry_rounds=self.config.drain_retry_rounds,
+            retry_after_s=self.config.drain_retry_after_s,
+            reason="scale-down",
+            invariants=self.invariants,
+            abort=lambda: self.clock() > deadline or self._stop.is_set(),
+        )
+        stranded = [
+            p for p in self.cluster.list("pods")
+            if p.spec.node_name in victims
+            and p.status.phase not in ("Succeeded", "Failed")
+        ]
+        if res["aborted"] or res["skipped"] or stranded:
+            # rollback: return every victim to service; pods displaced
+            # by the partial drain re-enter the queue shed-exempt and
+            # reschedule — the fleet is back to its pre-actuation state
+            for v in victims:
+                uncordon_node(self.cluster, v)
+                if self.invariants is not None:
+                    self.invariants.note_node_active(v)
+            m.AUTOSCALER_ROLLBACKS.inc(direction="remove")
+            klog.warningf(
+                "autoscaler scale-down rolled back: aborted=%s "
+                "skipped=%d stranded=%d",
+                res["aborted"], len(res["skipped"]), len(stranded),
+            )
+            return {
+                "enacted": False,
+                "dry_run": False,
+                "rollback": True,
+                "stranded": len(stranded),
+                "skipped": len(res["skipped"]),
+                "aborted": res["aborted"],
+                "evicted": len(res["evicted"]),
+            }
+        removed: List[str] = []
+        for v in victims:
+            self.cluster.delete("nodes", "", v)
+            self._managed.discard(v)
+            removed.append(v)
+            if self.invariants is not None:
+                self.invariants.note_node_removed(v)
+            m.AUTOSCALER_NODES_REMOVED.inc()
+        return {
+            "enacted": True,
+            "dry_run": False,
+            "removed": removed,
+            "evicted": len(res["evicted"]),
+            "waves": res["waves"],
+        }
+
+    # ------------------------------------------------------------ helpers
+
+    def _planner_plan(self) -> Optional[dict]:
+        p = self.planner
+        if p is None:
+            from kubernetes_tpu.runtime import capacity
+
+            p = capacity.get_default()
+        if p is None:
+            return None
+        return p.summary().get("recommendation")
+
+    def set_plan_source(self, fn: Callable[[], Optional[dict]]) -> None:
+        """Swap the plan input (chaos.plan_oscillation, tests)."""
+        self._plan_source = fn
+
+    def arm_register_fault(self, after: int = 0, count: int = 1) -> None:
+        """Next scale-up batch: fail registration #after+1 .. #after+count
+        (chaos.actuation_fault — the mid-batch cloud-API 5xx)."""
+        self._fault = {"after": int(after), "count": int(count)}
+
+    def _maybe_fault(self) -> None:
+        f = self._fault
+        if f is None:
+            return
+        if f["after"] > 0:
+            f["after"] -= 1
+            return
+        if f["count"] > 0:
+            f["count"] -= 1
+            if f["count"] == 0:
+                self._fault = None
+            raise ActuationFault("injected actuation fault (chaos)")
+        self._fault = None
+
+    def _state(self, now: float) -> dict:
+        nodes = list(self.cluster.list("nodes"))
+        fleet = [n.name for n in nodes]
+        # live occupancy: pod counts per node + store-visible backlog
+        # (the observation half of decide()'s scale-down evidence)
+        per_node: Dict[str, int] = {}
+        pending = 0
+        for p in self.cluster.list("pods"):
+            if p.status.phase in ("Succeeded", "Failed"):
+                continue
+            if p.spec.node_name:
+                per_node[p.spec.node_name] = (
+                    per_node.get(p.spec.node_name, 0) + 1
+                )
+            else:
+                pending += 1
+        idle_all = sorted(
+            n.name for n in nodes
+            if not n.spec.unschedulable and not per_node.get(n.name)
+        )[:64]
+        while self._changes and now - self._changes[0] > self.config.cooldown_s:
+            self._changes.popleft()
+        return {
+            "fleet": len(fleet),
+            "managed": sorted(self._managed & set(fleet)),
+            "pending_pods": pending,
+            "idle_nodes": idle_all,
+            "idle_managed": [n for n in idle_all if n in self._managed],
+            "last_cycle": self._last_cycle,
+            "last_direction": self._last_direction,
+            "recent_changes": len(self._changes),
+            "up_streak": self._up_streak,
+            "down_streak": self._down_streak,
+        }
+
+    def _shape_entry(self, name: Optional[str]) -> dict:
+        for entry in self.catalog:
+            if entry.get("name") == name:
+                return entry
+        return self.catalog[0] if self.catalog else {"name": "default"}
+
+    def _floor_ok(self, victims: List[str]) -> bool:
+        vset = set(victims)
+        rem = [0.0, 0.0, 0.0]  # cpu(milli), memory(bytes), pod slots
+        for n in self.cluster.list("nodes"):
+            if n.name in vset or n.spec.unschedulable:
+                continue
+            alloc = n.status.allocatable
+            rem[0] += float(alloc["cpu"].milli) if "cpu" in alloc else 0.0
+            rem[1] += float(alloc["memory"]) if "memory" in alloc else 0.0
+            rem[2] += float(alloc["pods"]) if "pods" in alloc else 0.0
+        com = [0.0, 0.0, 0.0]
+        for p in self.cluster.list("pods"):
+            if not p.spec.node_name:
+                continue
+            if p.status.phase in ("Succeeded", "Failed"):
+                continue
+            req = p.resource_request()
+            com[0] += float(req["cpu"].milli) if "cpu" in req else 0.0
+            com[1] += float(req["memory"]) if "memory" in req else 0.0
+            com[2] += 1.0
+        detail = "victims=" + ",".join(sorted(vset)[:4])
+        if self.invariants is not None:
+            return self.invariants.check_capacity_floor(rem, com, detail)
+        return all(c <= r + 1e-3 for c, r in zip(com, rem))
+
+    def managed_nodes(self) -> List[str]:
+        return sorted(self._managed)
+
+    # ------------------------------------------------------------- ledger
+
+    def _record(self, rec: dict) -> None:
+        self._history.append(rec)
+        if self.ledger is not None:
+            try:
+                self.ledger.record_event({"autoscaler": rec})
+            except Exception:  # noqa: BLE001 — telemetry never actuates
+                pass
+        if self.ledger_path:
+            try:
+                if self._ledger_fh is None:
+                    self._ledger_fh = open(  # noqa: SIM115 — long-lived
+                        self.ledger_path, "a", encoding="utf-8",
+                    )
+                    if self._ledger_fh.tell() == 0:
+                        header = {
+                            "kind": LEDGER_KIND,
+                            "version": LEDGER_VERSION,
+                            "config": asdict(self.config),
+                        }
+                        self._ledger_fh.write(
+                            json.dumps(header, sort_keys=True) + "\n"
+                        )
+                self._ledger_fh.write(json.dumps(rec, sort_keys=True) + "\n")
+                self._ledger_fh.flush()
+            except OSError as e:
+                klog.errorf("autoscaler ledger write failed: %s", e)
+
+    # --------------------------------------------------------- loop/debug
+
+    def start(self) -> None:
+        if self._thread is not None or not self.config.enabled:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.config.interval_s):
+                try:
+                    self.step()
+                except Exception as e:  # noqa: BLE001 — loop survives
+                    klog.errorf("autoscaler step failed: %s", e)
+
+        self._thread = threading.Thread(
+            target=loop, name="autoscaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+        fh = self._ledger_fh
+        if fh is not None:
+            self._ledger_fh = None
+            try:
+                fh.close()
+            except OSError:
+                pass
+
+    def summary(self) -> dict:
+        with self._lock:
+            last = self._history[-1] if self._history else None
+            return {
+                "enabled": self.config.enabled,
+                "dry_run": self.config.dry_run,
+                "running": self._thread is not None,
+                "seq": self._seq,
+                "managed": len(self._managed),
+                "managed_nodes": sorted(self._managed)[:16],
+                "cost_node_s": round(self._cost_node_s, 6),
+                "fleet_peak": self._fleet_peak,
+                "fleet_min": (0 if self._fleet_min == 1 << 30
+                              else self._fleet_min),
+                "counts": dict(self._counts),
+                "direction_changes_in_window": len(self._changes),
+                "last_direction": self._last_direction,
+                "up_streak": self._up_streak,
+                "down_streak": self._down_streak,
+                "last": last,
+                "ledger_path": self.ledger_path,
+            }
+
+    def debug_payload(self, limit: int = 32) -> dict:
+        out = self.summary()
+        with self._lock:
+            out["recent"] = list(self._history)[-max(1, int(limit)):]
+        return out
+
+
+# ----------------------------------------------------------------- replay
+
+
+def replay_actuations(path: str) -> dict:
+    """Offline re-verification of an actuation ledger (`bench.py
+    --replay` on a .jsonl actuation file): re-run the PURE decide() over
+    every recorded (plan, state) under the recorded config and demand
+    the decision falls out bit-identical (canonical-JSON comparison).
+    Returns {"records", "verified", "mismatches": [...]}."""
+    header: Optional[dict] = None
+    records = 0
+    mismatches: List[dict] = []
+    cfg = AutoscalerConfig()
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if header is None and obj.get("kind") == LEDGER_KIND:
+                header = obj
+                known = {
+                    k: v for k, v in (obj.get("config") or {}).items()
+                    if k in AutoscalerConfig.__dataclass_fields__
+                }
+                cfg = AutoscalerConfig(**known)
+                continue
+            records += 1
+            got = AutoscalerController.decide(
+                obj.get("plan"), obj.get("state") or {}, cfg
+            )
+            want = obj.get("decision")
+            if json.dumps(got, sort_keys=True) != json.dumps(
+                want, sort_keys=True
+            ):
+                mismatches.append(
+                    {"seq": obj.get("seq"), "want": want, "got": got}
+                )
+    return {
+        "kind": LEDGER_KIND,
+        "records": records,
+        "verified": not mismatches,
+        "mismatches": mismatches[:8],
+    }
+
+
+def sniff_actuation_ledger(path: str) -> bool:
+    """True when `path` looks like an actuation JSONL (text line starting
+    with '{') rather than the binary decision-ledger stream."""
+    try:
+        with open(path, "rb") as fh:
+            head = fh.read(1)
+        return head == b"{"
+    except OSError:
+        return False
+
+
+# ------------------------------------------------------- process default
+
+_default_lock = threading.Lock()
+_default: Optional[AutoscalerController] = None
+
+
+def get_default() -> Optional[AutoscalerController]:
+    """The process's wired AutoscalerController (None until set): the
+    seam /debug/autoscaler + POST /debug/capacity/enact read through."""
+    with _default_lock:
+        return _default
+
+
+def set_default(ctrl: Optional[AutoscalerController]) -> None:
+    global _default
+    with _default_lock:
+        _default = ctrl

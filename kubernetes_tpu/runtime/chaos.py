@@ -189,93 +189,115 @@ class Disruptions:
         mode "delete" is the reference kubectl-drain behavior.
 
         Returns {"order", "waves", "evicted", "blocked_retries",
-        "skipped"} — skipped non-empty means PDBs held the line."""
-        from kubernetes_tpu.runtime.controllers import (
-            EvictionBlocked,
-            try_evict,
-        )
+        "skipped"} — skipped non-empty means PDBs held the line.  The
+        wave loop itself lives in controllers.drain_waves (ISSUE 19):
+        this monkey and the autoscaler's scale-down actuation share one
+        implementation so the two drain paths cannot drift."""
+        from kubernetes_tpu.runtime.controllers import drain_waves
 
         if nodes is None:
             nodes = sorted(n.name for n in self.cluster.list("nodes"))
             self.rng.shuffle(nodes)
-        wave_size = max(1, int(wave_size))
-        evicted: List[tuple] = []
-        skipped: List[tuple] = []
-        retries = 0
-        waves = 0
-        for w0 in range(0, len(nodes), wave_size):
-            wave = nodes[w0:w0 + wave_size]
-            waves += 1
-            for name in wave:
-                self._cordon(name)
-            pending = [
-                p for p in self.cluster.list("pods")
-                if p.spec.node_name in wave
-                and p.status.phase not in ("Succeeded", "Failed")
-            ]
-            for round_i in range(retry_rounds + 1):
-                blocked: List[tuple] = []
-                pause = 0.0
-                for p in pending:
-                    try:
-                        if try_evict(self.cluster, p, mode=mode,
-                                     reason="drain",
-                                     retry_after_s=retry_after_s):
-                            evicted.append((p.namespace, p.name,
-                                            p.spec.node_name))
-                    except EvictionBlocked as e:
-                        blocked.append((p, e))
-                        pause = max(pause, min(e.retry_after_s,
-                                               retry_after_s))
-                if not blocked:
-                    pending = []
-                    break
-                pending = [p for p, _ in blocked]
-                retries += len(blocked)
-                if round_i < retry_rounds and pause > 0:
-                    time.sleep(pause)  # the Retry-After pacing bound
-            for p in pending:  # budget never reopened: skip, don't spin
-                skipped.append((p.namespace, p.name, p.spec.node_name))
-                self.cluster.events.eventf(
-                    "Node", "", p.spec.node_name, "Warning", "DrainBlocked",
-                    "pod %s/%s eviction blocked by PDB after %d rounds; "
-                    "skipping", p.namespace, p.name, retry_rounds,
-                )
-        return {
-            "order": list(nodes),
-            "waves": waves,
-            "evicted": evicted,
-            "blocked_retries": retries,
-            "skipped": skipped,
-        }
+        return drain_waves(
+            self.cluster,
+            nodes,
+            wave_size=wave_size,
+            mode=mode,
+            retry_rounds=retry_rounds,
+            retry_after_s=retry_after_s,
+            reason="drain",
+        )
 
     def _cordon(self, node_name: str) -> None:
-        """kubectl cordon: spec.unschedulable = True (the scheduler's
-        node-unschedulable filter stops NEW placements; running pods stay
-        until evicted)."""
-        node = self.cluster.get("nodes", "", node_name)
-        if node is None or node.spec.unschedulable:
-            return
-        self.cluster.update(
-            "nodes",
-            dataclasses.replace(
-                node,
-                spec=dataclasses.replace(node.spec, unschedulable=True),
-            ),
-        )
+        """kubectl cordon (delegates to controllers.cordon_node)."""
+        from kubernetes_tpu.runtime.controllers import cordon_node
+
+        cordon_node(self.cluster, node_name)
 
     def uncordon(self, node_name: str) -> None:
         """Undo a drain's cordon (the post-upgrade return to service)."""
-        node = self.cluster.get("nodes", "", node_name)
-        if node is None or not node.spec.unschedulable:
-            return
-        self.cluster.update(
-            "nodes",
-            dataclasses.replace(
-                node,
-                spec=dataclasses.replace(node.spec, unschedulable=False),
+        from kubernetes_tpu.runtime.controllers import uncordon_node
+
+        uncordon_node(self.cluster, node_name)
+
+    # ------------------------------------------- misbehaving-actuator chaos
+    #
+    # ISSUE 19: faults aimed at the autoscaler's actuation loop itself —
+    # a drain that can never finish, a cloud API that dies mid-batch, a
+    # plan that flip-flops every read.  The controller's rollback
+    # deadline, partial-batch deregistration, and cooldown hysteresis
+    # are the systems under test; the invariant checker's node-lifecycle
+    # rule is the oracle.
+
+    STUCK_DRAIN_PDB = "chaos-stuck-drain"
+
+    def stuck_drain(self, namespace: str = "default",
+                    name: str = STUCK_DRAIN_PDB) -> str:
+        """Make every drain in `namespace` stick forever: install a
+        match-all PodDisruptionBudget with zero disruptions allowed, so
+        each eviction gets the 429 + Retry-After refusal on every retry
+        round.  A scale-down hitting this must roll back (uncordon the
+        victims) once its drain deadline expires — pods are stranded by
+        policy, not by load.  Returns the PDB name for teardown."""
+        from kubernetes_tpu.api.types import ObjectMeta, PodDisruptionBudget
+
+        self.cluster.create(
+            "poddisruptionbudgets",
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name=name, namespace=namespace),
+                selector={"matchLabels": {}},  # match-all in namespace
+                disruptions_allowed=0,
             ),
         )
+        return name
+
+    def clear_stuck_drain(self, namespace: str = "default",
+                          name: str = STUCK_DRAIN_PDB) -> None:
+        """Lift the stuck-drain veto (drains proceed again)."""
+        self.cluster.delete("poddisruptionbudgets", namespace, name)
+
+    def plan_oscillation(self, autoscaler, shape: str = "c2-standard-8",
+                         count: int = 2, drain: int = 2) -> Callable[[], dict]:
+        """Swap the autoscaler's plan source for one that flip-flops
+        between "add `count` × `shape`" and "drain `drain` managed
+        nodes" on EVERY read, each with a fresh cycle stamp (so
+        staleness can't mask the oscillation).  The cooldown window must
+        bound the fleet to ≤ max_direction_changes direction changes per
+        window — the flap counter, not the fleet size, should absorb the
+        noise.  Returns the installed source (for inspection)."""
+        state = {"i": 0}
+
+        def source() -> dict:
+            state["i"] += 1
+            managed = autoscaler.managed_nodes()
+            if state["i"] % 2:
+                return {
+                    "cycle": state["i"],
+                    "backlog_pods": count,
+                    "overflow_pods": count,
+                    "scale_up": {"shape": shape, "count": count},
+                    "drainable": {"count": 0, "nodes": []},
+                }
+            return {
+                "cycle": state["i"],
+                "backlog_pods": 0,
+                "overflow_pods": 0,
+                "scale_up": None,
+                "drainable": {
+                    "count": min(drain, len(managed)),
+                    "nodes": managed[:drain],
+                },
+            }
+
+        autoscaler.set_plan_source(source)
+        return source
+
+    def actuation_fault(self, autoscaler, after: int = 0,
+                        count: int = 1) -> None:
+        """Arm a mid-batch registration failure (the cloud API's 5xx
+        halfway through a scale-up): registrations #after+1..#after+count
+        raise, and the controller must deregister the partial batch."""
+        autoscaler.arm_register_fault(after=after, count=count)
 
     def zone_outage(
         self,

@@ -86,7 +86,8 @@ class EvictionBlocked(Exception):
 def try_evict(cluster: LocalCluster, pod: Pod, *,
               mode: str = EVICT_DELETE,
               reason: str = "eviction",
-              retry_after_s: float = 1.0) -> bool:
+              retry_after_s: float = 1.0,
+              invariants=None) -> bool:
     """The pods/eviction subresource's store-level analog (registry/core/
     pod/rest/eviction.go; the HTTP twin lives in apiserver/server.py):
     grant the eviction only if every PDB matching the pod still allows a
@@ -113,6 +114,7 @@ def try_evict(cluster: LocalCluster, pod: Pod, *,
         )
         if blocked is not None:
             raise EvictionBlocked(blocked, retry_after_s)
+        debited = 0
         for pdb in matching:
             cluster.update(
                 "poddisruptionbudgets",
@@ -121,10 +123,151 @@ def try_evict(cluster: LocalCluster, pod: Pod, *,
                     disruptions_allowed=max(0, pdb.disruptions_allowed - 1),
                 ),
             )
+            debited += 1
         if mode == EVICT_DISPLACE:
-            return cluster.displace_pod(cur, reason)
-        cluster.delete("pods", pod.namespace, pod.name)
-        return True
+            granted = cluster.displace_pod(cur, reason)
+        else:
+            cluster.delete("pods", pod.namespace, pod.name)
+            granted = True
+    # RULE_EVICTION_BUDGET audit (ISSUE 19): report the grant OUTSIDE the
+    # store lock — note_evicted takes the checker's own lock and may fire
+    # callbacks; nesting it under cluster._lock invites the AB/BA deadlock
+    # the checker's _pending_cb design exists to avoid
+    if granted and invariants is not None:
+        invariants.note_evicted(cur, len(matching), debited)
+    return granted
+
+
+def cordon_node(cluster: LocalCluster, node_name: str) -> bool:
+    """kubectl cordon: spec.unschedulable = True (the scheduler's
+    node-unschedulable filter stops NEW placements; running pods stay
+    until evicted).  Returns True when this call flipped the bit."""
+    node = cluster.get("nodes", "", node_name)
+    if node is None or node.spec.unschedulable:
+        return False
+    cluster.update(
+        "nodes",
+        dataclasses.replace(
+            node,
+            spec=dataclasses.replace(node.spec, unschedulable=True),
+        ),
+    )
+    return True
+
+
+def uncordon_node(cluster: LocalCluster, node_name: str) -> bool:
+    """Undo a cordon (post-upgrade / rollback return to service)."""
+    node = cluster.get("nodes", "", node_name)
+    if node is None or not node.spec.unschedulable:
+        return False
+    cluster.update(
+        "nodes",
+        dataclasses.replace(
+            node,
+            spec=dataclasses.replace(node.spec, unschedulable=False),
+        ),
+    )
+    return True
+
+
+def drain_waves(
+    cluster: LocalCluster,
+    nodes: List[str],
+    *,
+    wave_size: int = 2,
+    mode: str = EVICT_DISPLACE,
+    retry_rounds: int = 8,
+    retry_after_s: float = 0.05,
+    cordon: bool = True,
+    reason: str = "drain",
+    invariants=None,
+    abort: Optional[Callable[[], bool]] = None,
+) -> dict:
+    """The ONE cordon+evict+Retry-After wave loop (ISSUE 19 satellite):
+    chaos.Disruptions.rolling_drain (the upgrade monkey) and the
+    autoscaler's scale-down actuation both delegate here so the two
+    drain paths cannot drift.  Cordon each node in a wave of
+    `wave_size`, then push its pending pods through the PDB-respecting
+    eviction seam (try_evict — the pods/eviction subresource's 429 +
+    Retry-After semantics).
+
+    A PDB-blocked eviction is retried up to `retry_rounds` times, each
+    round paced by the refusal's Retry-After hint (capped at
+    `retry_after_s` so tests stay fast) — bounded progress, never a
+    spin.  Pods still blocked after the rounds are SKIPPED: the wave
+    records them, emits a DrainBlocked Warning event on the node, and
+    moves on.  `abort` (checked between rounds and waves) lets a caller
+    with a deadline — the autoscaler's stuck-drain rollback — stop the
+    loop early; remaining pods land in "skipped" without the event, and
+    the result carries aborted=True so the caller knows to uncordon.
+
+    Returns {"order", "waves", "evicted", "blocked_retries", "skipped",
+    "aborted"} — skipped non-empty means PDBs (or the abort) held the
+    line."""
+    nodes = list(nodes)
+    wave_size = max(1, int(wave_size))
+    evicted: List[tuple] = []
+    skipped: List[tuple] = []
+    retries = 0
+    waves = 0
+    aborted = False
+    for w0 in range(0, len(nodes), wave_size):
+        if abort is not None and abort():
+            aborted = True
+            break
+        wave = nodes[w0:w0 + wave_size]
+        waves += 1
+        if cordon:
+            for name in wave:
+                cordon_node(cluster, name)
+        pending = [
+            p for p in cluster.list("pods")
+            if p.spec.node_name in wave
+            and p.status.phase not in ("Succeeded", "Failed")
+        ]
+        for round_i in range(retry_rounds + 1):
+            if abort is not None and abort():
+                aborted = True
+                break
+            blocked: List[tuple] = []
+            pause = 0.0
+            for p in pending:
+                try:
+                    if try_evict(cluster, p, mode=mode, reason=reason,
+                                 retry_after_s=retry_after_s,
+                                 invariants=invariants):
+                        evicted.append((p.namespace, p.name,
+                                        p.spec.node_name))
+                except EvictionBlocked as e:
+                    blocked.append((p, e))
+                    pause = max(pause, min(e.retry_after_s,
+                                           retry_after_s))
+            if not blocked:
+                pending = []
+                break
+            pending = [p for p, _ in blocked]
+            retries += len(blocked)
+            if round_i < retry_rounds and pause > 0:
+                time.sleep(pause)  # the Retry-After pacing bound
+        for p in pending:  # budget never reopened: skip, don't spin
+            skipped.append((p.namespace, p.name, p.spec.node_name))
+            if not aborted:
+                cluster.events.eventf(
+                    "Node", "", p.spec.node_name, "Warning",
+                    "DrainBlocked",
+                    "pod %s/%s eviction blocked by PDB after %d rounds; "
+                    "skipping", p.namespace, p.name, retry_rounds,
+                )
+        if aborted:
+            break
+    return {
+        "order": nodes,
+        "waves": waves,
+        "evicted": evicted,
+        "blocked_retries": retries,
+        "skipped": skipped,
+        "aborted": aborted,
+    }
 
 
 # ---------------------------------------------------------------- workqueue

@@ -18,6 +18,7 @@ stalling it.
 
 from __future__ import annotations
 
+import json
 import random
 import threading
 import time
@@ -424,6 +425,46 @@ class HealthServer:
                         ),
                         ct="application/json",
                     )
+                elif path == "/debug/autoscaler":
+                    # the guarded actuation loop (ISSUE 19): managed
+                    # fleet, hysteresis streaks, cooldown window, cost,
+                    # recent actuation records — ?limit=N + the shared
+                    # 4MB cap, like its siblings.  Tolerates no wired
+                    # controller (reports disabled) — unlike the
+                    # planner, actuation is commonly off
+                    from kubernetes_tpu.runtime import autoscaler
+
+                    ctrl = autoscaler.get_default()
+                    self._send(
+                        debug_body(
+                            (ctrl.debug_payload if ctrl is not None
+                             else lambda _lim=None: {"enabled": False}),
+                            query,
+                        ),
+                        ct="application/json",
+                    )
+                elif path == "/debug/capacity/enact":
+                    # GET is a status peek — the actuation verb is POST
+                    # (below); serving the peek keeps the /debug/ index
+                    # walk uniform (every listed endpoint GETs 200)
+                    from kubernetes_tpu.runtime import autoscaler
+
+                    ctrl = autoscaler.get_default()
+                    self._send(
+                        debug_body(
+                            lambda _lim=None: {
+                                "method": "POST",
+                                "hint": "POST runs one guarded round "
+                                        "now; ?dryRun=1 decides + "
+                                        "records without mutating",
+                                "enabled": ctrl is not None,
+                                "last": (ctrl.summary().get("last")
+                                         if ctrl is not None else None),
+                            },
+                            query,
+                        ),
+                        ct="application/json",
+                    )
                 elif path == "/debug/replicas":
                     # queue-sharded replicas (ISSUE 14): the explicit
                     # process aggregate — per-replica cycle/conflict
@@ -461,6 +502,46 @@ class HealthServer:
                         debug_body(lambda _lim=None: debug_index(), query),
                         ct="application/json",
                     )
+                else:
+                    self._send(b"not found", 404)
+
+            def do_POST(self):
+                path, _, query = self.path.partition("?")
+                if path == "/debug/capacity/enact":
+                    # ISSUE 19: run ONE guarded actuation round NOW —
+                    # same lock as the loop, so a manual enact can't
+                    # interleave with a scheduled one.  ?dryRun=1
+                    # decides + records without mutating the fleet
+                    from urllib.parse import parse_qs
+
+                    from kubernetes_tpu.runtime import autoscaler
+
+                    ctrl = autoscaler.get_default()
+                    if ctrl is None:
+                        self._send(
+                            json.dumps(
+                                {"error": "no autoscaler wired"}
+                            ).encode(),
+                            409,
+                            ct="application/json",
+                        )
+                        return
+                    q = parse_qs(query)
+                    dry = None
+                    if "dryRun" in q:
+                        dry = q["dryRun"][-1] not in ("0", "false", "")
+                    try:
+                        rec = ctrl.enact(dry_run=dry)
+                        self._send(
+                            json.dumps(rec).encode(),
+                            ct="application/json",
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        self._send(
+                            json.dumps({"error": str(e)}).encode(),
+                            500,
+                            ct="application/json",
+                        )
                 else:
                     self._send(b"not found", 404)
 

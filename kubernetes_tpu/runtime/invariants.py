@@ -54,6 +54,19 @@ RULE_CONSERVATION = "conservation"
 RULE_DOUBLE_BIND = "double_bind"
 RULE_CAPACITY = "capacity"
 RULE_LOST_POD = "lost_pod"
+# autoscaler actuation rules (ISSUE 19: runtime/autoscaler.py)
+RULE_NODE_LIFECYCLE = "node_lifecycle"
+RULE_EVICTION_BUDGET = "eviction_budget"
+RULE_CAPACITY_FLOOR = "capacity_floor"
+
+# node lifecycle states (the RULE_NODE_LIFECYCLE vocabulary): every
+# node REGISTERED by an actuation must end active, drained (rolled
+# back to service counts as active), or removed — a node stuck
+# mid-transition at settle time is the autoscaler's lost-pod analog
+NODE_REGISTERED = "registered"
+NODE_ACTIVE = "active"
+NODE_DRAINING = "draining"
+NODE_REMOVED = "removed"
 
 # resolution kinds for a popped pod (the conservation vocabulary)
 RES_BOUND = "bound"
@@ -103,6 +116,11 @@ class InvariantChecker:
         # (non-reentrant) lock held would deadlock the scheduling thread
         # on the first real violation
         self._pending_cb: List[Tuple[str, str]] = []
+        # node name -> lifecycle state for nodes an actuation registered
+        # or is draining (RULE_NODE_LIFECYCLE); counts maintained
+        # incrementally so summary() stays O(1)
+        self._node_state: Dict[str, str] = {}
+        self._node_counts: Dict[str, int] = {}
 
     # ------------------------------------------------------------- seams
 
@@ -271,6 +289,128 @@ class InvariantChecker:
         self._fire_callbacks()
         return False
 
+    # --------------------------------------- autoscaler seams (ISSUE 19)
+
+    def _node_set_locked(self, name: str, state: Optional[str]) -> None:
+        old = self._node_state.pop(name, None)
+        if old is not None:
+            self._node_counts[old] -= 1
+            if not self._node_counts[old]:
+                del self._node_counts[old]
+        if state is not None:
+            self._node_state[name] = state
+            self._node_counts[state] = self._node_counts.get(state, 0) + 1
+
+    def note_node_registered(self, name: str) -> None:
+        """An actuation registered a node into the store (scale-up).
+        It must be reported active (schedulable), draining, or removed
+        before assert_nodes_settled — a registered node that vanishes
+        from the seams is leaked capacity."""
+        with self._lock:
+            self.events_total += 1
+            if self._node_state.get(name) in (NODE_REGISTERED,
+                                              NODE_ACTIVE, NODE_DRAINING):
+                self._violation_locked(
+                    RULE_NODE_LIFECYCLE,
+                    f"node {name} registered while already "
+                    f"{self._node_state[name]}",
+                )
+            self._node_set_locked(name, NODE_REGISTERED)
+        self._fire_callbacks()
+
+    def note_node_active(self, name: str) -> None:
+        """The node is serving (registered node confirmed schedulable,
+        or a drain rolled back to service)."""
+        with self._lock:
+            self.events_total += 1
+            self._node_set_locked(name, NODE_ACTIVE)
+
+    def note_node_draining(self, name: str) -> None:
+        """A scale-down cordoned the node; it must end removed or be
+        rolled back to active."""
+        with self._lock:
+            self.events_total += 1
+            self._node_set_locked(name, NODE_DRAINING)
+
+    def note_node_removed(self, name: str) -> None:
+        """The node left the store (drain completed + delete, or a
+        faulted scale-up batch deregistered): terminal, clears the
+        entry so a same-name re-registration starts clean."""
+        with self._lock:
+            self.events_total += 1
+            if name in self._node_state:
+                self._node_set_locked(name, None)
+        self._fire_callbacks()
+
+    def assert_nodes_settled(self) -> bool:
+        """Node-lifecycle conservation at settle time (scenario/bench
+        teardown): every node an actuation touched must be active or
+        removed — anything still 'registered' (never confirmed) or
+        'draining' (cordon without a completed drain OR rollback) is a
+        violation.  Clears the stuck entries so a soak's next phase is
+        judged on its own, mirroring assert_drained."""
+        with self._lock:
+            stuck = [
+                n for n, s in self._node_state.items()
+                if s in (NODE_REGISTERED, NODE_DRAINING)
+            ]
+            if not stuck:
+                return True
+            sample = ", ".join(
+                f"{n}({self._node_state[n]})" for n in stuck[:4]
+            )
+            self._violation_locked(
+                RULE_NODE_LIFECYCLE,
+                f"{len(stuck)} node(s) stuck mid-transition after "
+                f"settle: {sample}",
+            )
+            for n in stuck:
+                self._node_set_locked(n, None)
+        self._fire_callbacks()
+        return False
+
+    def note_evicted(self, pod, pdbs_matching: int,
+                     budgets_debited: int) -> None:
+        """An eviction was GRANTED (controllers.try_evict): every
+        matching PDB must have been debited one disruption unit — an
+        eviction that slipped past a matching budget is the
+        thundering-drain race the debit-under-lock exists to close."""
+        with self._lock:
+            self.events_total += 1
+            if pdbs_matching > 0 and budgets_debited < pdbs_matching:
+                key = self._key(pod)
+                self._violation_locked(
+                    RULE_EVICTION_BUDGET,
+                    f"pod {key[0]}/{key[1]} evicted with "
+                    f"{budgets_debited}/{pdbs_matching} matching "
+                    f"budget(s) debited",
+                )
+        self._fire_callbacks()
+
+    def check_capacity_floor(self, remaining, committed,
+                             detail: str = "") -> bool:
+        """Scale-down guard: fleet allocatable AFTER removing the drain
+        set must still cover committed usage per resource.  `remaining`
+        and `committed` are f64[R] totals.  Returns True when the floor
+        holds; False records a violation (the actuator also refuses the
+        removal — capacity never drops below committed usage)."""
+        remaining = np.asarray(remaining, np.float64)
+        committed = np.asarray(committed, np.float64)
+        with self._lock:
+            self.events_total += 1
+        under = committed > remaining * (1.0 + _CAPACITY_REL) + _CAPACITY_EPS
+        if not under.any():
+            return True
+        with self._lock:
+            self._violation_locked(
+                RULE_CAPACITY_FLOOR,
+                f"scale-down would drop fleet capacity below committed "
+                f"usage in {int(under.sum())} resource column(s)"
+                + (f" ({detail})" if detail else ""),
+            )
+        self._fire_callbacks()
+        return False
+
     # ---------------------------------------------------------- internals
 
     def _resolve_locked(self, key, kind: str) -> None:
@@ -339,5 +479,6 @@ class InvariantChecker:
                 "outstanding": self._outstanding,
                 "tracked": len(self._tracked),
                 "bound": len(self._bound),
+                "nodes": dict(self._node_counts),
                 "recent": [list(v) for v in list(self.violations)[-8:]],
             }

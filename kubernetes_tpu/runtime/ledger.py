@@ -282,6 +282,15 @@ class DecisionLedger:
             self.dropped_total += 1
         m.LEDGER_DROPPED.inc()
 
+    def record_event(self, entry: dict) -> None:
+        """Ring-only append of a non-cycle event (ISSUE 19: autoscaler
+        actuations) so /debug/decisions interleaves scale events with
+        the scheduling cycles they bracket.  Never touches the binary
+        file — the authoritative actuation record is the autoscaler's
+        own JSONL ledger; this is the observability mirror."""
+        with self._lock:
+            self._ring.append(dict(entry))
+
     def decisions(self, limit: Optional[int] = None) -> List[dict]:
         with self._lock:
             out = list(self._ring)
@@ -528,6 +537,15 @@ DEBUG_ENDPOINTS = {
         "capacity planner: class-compressed what-if binpack of the "
         "pending backlog — scale-up/scale-down recommendation, "
         "compression/absorption/overflow facts (?limit=N)"
+    ),
+    "/debug/autoscaler": (
+        "guarded autoscaler actuation: managed fleet, hysteresis "
+        "streaks, cooldown window, cost (node-seconds), recent "
+        "actuation records (?limit=N)"
+    ),
+    "/debug/capacity/enact": (
+        "POST: run one guarded actuation round NOW against the live "
+        "capacity plan (?dryRun=1 decides + records without mutating)"
     ),
 }
 

@@ -86,16 +86,29 @@ class PersistentCluster(LocalCluster):
                 self._store[kind][key] = _Stored(obj, rv)
         wal_path = os.path.join(self.dir, WAL)
         if os.path.exists(wal_path):
-            with open(wal_path) as f:
-                for line in f:
-                    line = line.strip()
+            good_end = 0  # byte offset after the last parseable line
+            torn = False
+            with open(wal_path, "rb") as f:
+                for raw in f:
+                    line = raw.strip()
                     if not line:
+                        good_end += len(raw)
                         continue
                     try:
                         e = json.loads(line)
                     except ValueError:
+                        torn = True
                         break  # torn final append (crash mid-write)
                     self._apply_entry(e)
+                    good_end += len(raw)
+            if torn:
+                # Discard the torn tail ON DISK, not just in replay: the
+                # file reopens in append mode, so leaving the half-line
+                # would glue the NEXT record onto it and destroy the
+                # first post-recovery write (e.g. an actuator's rollback
+                # uncordon after a crash mid-scale-down).
+                with open(wal_path, "r+b") as f:
+                    f.truncate(good_end)
 
     def _apply_entry(self, e: dict) -> None:
         rv, op, kind = int(e["rv"]), e["op"], e["kind"]

@@ -284,6 +284,10 @@ class ScenarioResult:
     chaos: List[dict] = field(default_factory=list)
     invariants: Optional[dict] = None
     ledger: Optional[dict] = None
+    # the autoscale campaign (ISSUE 19): controller summary + the fleet
+    # size curve sampled at each actuation record, so callers can assert
+    # the cluster grew AND shrank with the load
+    autoscaler: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -326,6 +330,7 @@ class ScenarioRunner:
         seed: int = 0,
         ledger=None,
         bind_sleep: float = 0.0,
+        config_overrides: Optional[dict] = None,
     ):
         from kubernetes_tpu.runtime.cache import SchedulerCache
         from kubernetes_tpu.runtime.queue import PodBackoff, PriorityQueue
@@ -350,6 +355,20 @@ class ScenarioRunner:
         else:
             binder = inner
         self.shed: List[Tuple[str, str]] = []
+        # config_overrides lets a campaign turn on extra subsystems (the
+        # autoscale campaign enables the capacity planner with a short
+        # solve interval) without widening the runner signature per knob
+        cfg_kwargs = dict(
+            batch_size=batch_size,
+            batch_window_s=0.0,
+            disable_preemption=True,
+            batched_commit=True,
+            pipeline_commit=ledger is not None,
+            adaptive_batch=True,
+            batch_size_min=batch_size_min,
+            cycle_deadline_s=2.0,
+        )
+        cfg_kwargs.update(config_overrides or {})
         self.scheduler = Scheduler(
             cache=SchedulerCache(),
             queue=PriorityQueue(
@@ -357,16 +376,7 @@ class ScenarioRunner:
                 backoff=PodBackoff(initial=0.01, max_duration=0.05),
             ),
             binder=binder,
-            config=SchedulerConfig(
-                batch_size=batch_size,
-                batch_window_s=0.0,
-                disable_preemption=True,
-                batched_commit=True,
-                pipeline_commit=ledger is not None,
-                adaptive_batch=True,
-                batch_size_min=batch_size_min,
-                cycle_deadline_s=2.0,
-            ),
+            config=SchedulerConfig(**cfg_kwargs),
             ledger=ledger,
         )
         self._ledger = ledger
@@ -699,7 +709,7 @@ class ScenarioRunner:
 # ------------------------------------------------- the named campaigns
 
 
-SCENARIOS = ("drain", "zone", "diurnal", "trace")
+SCENARIOS = ("drain", "zone", "diurnal", "trace", "autoscale")
 
 
 def run_scenario(
@@ -715,6 +725,8 @@ def run_scenario(
     trace_path: Optional[str] = None,
     ledger=None,
     drain_timeout_s: float = 60.0,
+    autoscale: Optional[dict] = None,
+    autoscale_ledger_path: Optional[str] = None,
 ) -> ScenarioResult:
     """One call per campaign — the shared engine behind
     ``bench.py --scenario`` and the scenario tests:
@@ -732,9 +744,21 @@ def run_scenario(
       batch breathing and capacity-planner backlog oscillation.
     - **trace**: replay `trace_path` (load_trace) verbatim, no chaos —
       the external-trace front door.
+    - **autoscale** (ISSUE 19): a diurnal trace over a DELIBERATELY
+      small base fleet, with the capacity planner on (short solve
+      interval) and a live AutoscalerController enacting its plan —
+      the cluster must BREATHE: grow through the peak (plan overflow ->
+      paced node registration) and shrink after it (pods complete,
+      managed nodes go drainable -> cordon + PDB-paced drain ->
+      delete).  Lifetimes are ~1/3 of the diurnal period here so the
+      bound population actually tracks the rate curve.  `autoscale`
+      overrides AutoscalerConfig knobs; `autoscale_ledger_path` records
+      the actuation JSONL for the offline replay gate.  The result's
+      `autoscaler` dict carries the controller summary + the fleet-size
+      curve (initial/peak/final) for the grows-AND-shrinks assertion.
 
-    Lifetimes are long relative to the replay (pods mostly stay bound)
-    so displacement math is well-conditioned."""
+    Lifetimes are otherwise long relative to the replay (pods mostly
+    stay bound) so displacement math is well-conditioned."""
     if kind not in SCENARIOS:
         raise ValueError(f"unknown scenario {kind!r}: one of {SCENARIOS}")
     from kubernetes_tpu.runtime.chaos import Disruptions
@@ -750,15 +774,40 @@ def run_scenario(
             seed, count=pods, rate=rate, mean_lifetime_s=mean_life,
             diurnal=(span / 2.0, 0.9), prefix="diurnal",
         )
+    elif kind == "autoscale":
+        # lifetimes ~1/3 of the diurnal period: the bound population
+        # must FALL after the peak for drainable capacity to appear
+        span = pods / max(rate, 1e-6)
+        events = synthesize_trace(
+            seed, count=pods, rate=rate,
+            mean_lifetime_s=max(span / 3.0, 1e-3),
+            diurnal=(span / 2.0, 0.9), prefix="autoscale",
+        )
     else:
         events = synthesize_trace(
             seed, count=pods, rate=rate, mean_lifetime_s=mean_life,
             prefix=kind,
         )
-    with ScenarioRunner(
+    runner_kwargs: dict = dict(
         nodes=nodes, zones=zones, capacity=capacity,
         compression=compression, seed=seed, ledger=ledger,
-    ) as runner:
+    )
+    if kind == "autoscale":
+        # a small-node base fleet the peak MUST overflow, a matching
+        # single-shape catalog, and a planner solving every few cycles
+        # so the actuator sees fresh plans through the whole curve
+        runner_kwargs.update(
+            node_cpu="2", node_mem="4Gi", node_pods=32,
+            config_overrides={
+                "capacity_planner": True,
+                "capacity_interval_cycles": 4,
+                "node_shape_catalog": [
+                    {"name": "autoscale-2c", "cpu": "2",
+                     "memory": "4Gi", "pods": 32},
+                ],
+            },
+        )
+    with ScenarioRunner(**runner_kwargs) as runner:
         monkey = Disruptions(runner.cluster, rng=random.Random(seed))
         chaos: List[Tuple[float, Callable[[], object]]] = []
         last_t = events[-1].t if events else 0.0
@@ -788,7 +837,67 @@ def run_scenario(
                 return monkey.zone_outage(zone=f"zone-{zones - 1}")
 
             chaos.append((last_t / 2.0, _zone))
-        result = runner.replay(
-            events, chaos=chaos, drain_timeout_s=drain_timeout_s)
+        autoctrl = None
+        if kind == "autoscale":
+            from kubernetes_tpu.runtime.autoscaler import (
+                AutoscalerConfig,
+                AutoscalerController,
+            )
+
+            ac_kwargs: dict = dict(
+                interval_s=0.02,
+                up_stable_rounds=1,
+                down_stable_rounds=2,
+                cooldown_s=max(0.25, last_t / compression / 8.0),
+                max_nodes_per_round=4,
+                drain_deadline_s=5.0,
+                min_nodes=nodes,          # base fleet is the floor
+                max_nodes=nodes + 64,
+                node_prefix="autoscale",
+            )
+            ac_kwargs.update(autoscale or {})
+            autoctrl = AutoscalerController(
+                runner.cluster,
+                planner=runner.scheduler.capacity,
+                invariants=runner.scheduler.invariants,
+                config=AutoscalerConfig(**ac_kwargs),
+                ledger=ledger,
+                ledger_path=autoscale_ledger_path,
+            )
+            autoctrl.start()
+        try:
+            result = runner.replay(
+                events, chaos=chaos, drain_timeout_s=drain_timeout_s)
+        finally:
+            if autoctrl is not None:
+                # settle window: completions have freed managed nodes;
+                # give the controller a few cooldowns to shrink back
+                # before judging the curve
+                settle_deadline = time.monotonic() + min(
+                    10.0, 4.0 * ac_kwargs["cooldown_s"] + 1.0)
+                while (time.monotonic() < settle_deadline
+                       and autoctrl.managed_nodes()):
+                    time.sleep(0.05)
+                autoctrl.stop()
+        if autoctrl is not None:
+            inv = runner.scheduler.invariants
+            if inv is not None:
+                # node-lifecycle conservation at settle time, then
+                # re-bank the totals _score already took
+                inv.assert_nodes_settled()
+                result.invariants = inv.summary()
+                result.violations = inv.violations_total()
+            summary = autoctrl.summary()
+            fleet_curve = [
+                (r["t"], r["state"]["fleet"])
+                for r in autoctrl.debug_payload(limit=256)["recent"]
+            ]
+            result.autoscaler = {
+                "summary": summary,
+                "initial": nodes,
+                "peak": max(summary["fleet_peak"], nodes),
+                "final": len(list(runner.cluster.list("nodes"))),
+                "fleet_curve": fleet_curve[-64:],
+            }
         result.chaos.insert(0, {"kind": kind, "seed": seed})
     return result

@@ -966,6 +966,60 @@ CAPACITY_DRAINABLE = REGISTRY.register(
     )
 )
 
+# --- guarded autoscaler actuation (ISSUE 19: runtime/autoscaler.py) ---
+# the controller that ENACTS the capacity planner's recommendation:
+# paced node registration, PDB-funneled drains, hysteresis + rollback
+AUTOSCALER_NODES_ADDED = REGISTRY.register(
+    Counter(
+        "scheduler_autoscaler_nodes_added_total",
+        "Nodes the autoscaler registered from the winning catalog "
+        "shape (scale-up actuations; a mid-batch fault deregisters the "
+        "partial batch and does NOT count here)",
+    )
+)
+AUTOSCALER_NODES_REMOVED = REGISTRY.register(
+    Counter(
+        "scheduler_autoscaler_nodes_removed_total",
+        "Nodes the autoscaler drained (cordon + PDB/Retry-After "
+        "eviction waves) and deleted (scale-down actuations; a rolled-"
+        "back drain does NOT count here)",
+    )
+)
+AUTOSCALER_FLAPS = REGISTRY.register(
+    Counter(
+        "scheduler_autoscaler_flaps_total",
+        "Actuations SUPPRESSED by the hysteresis guard: a direction "
+        "change (add<->remove) that would exceed the bounded changes "
+        "per cooldown window held instead of flapping the fleet",
+    )
+)
+AUTOSCALER_ROLLBACKS = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_autoscaler_rollbacks_total",
+        "Automatic actuation rollbacks, by direction: a scale-down "
+        "whose drain stranded pods past the deadline un-cordoned and "
+        "aborted, or a scale-up that faulted mid-batch deregistered "
+        "the partial batch",
+        ("direction",),
+        max_children=4,
+    )
+)
+AUTOSCALER_COST = REGISTRY.register(
+    Gauge(
+        "scheduler_autoscaler_cost_node_seconds",
+        "Accumulated node-seconds of autoscaler-managed capacity (the "
+        "banked cost objective the diurnal breathe scenario minimizes "
+        "against goodput)",
+    )
+)
+AUTOSCALER_MANAGED = REGISTRY.register(
+    Gauge(
+        "scheduler_autoscaler_managed_nodes",
+        "Nodes currently registered and managed by the autoscaler "
+        "(the breathing half of the fleet)",
+    )
+)
+
 # --- queue-sharded scheduler replicas (ISSUE 14) ---
 REPLICAS = REGISTRY.register(
     Gauge(

@@ -210,3 +210,48 @@ def test_finalizer_gated_delete_survives_restart(tmp_path):
     c3 = PersistentCluster(d)
     assert c3.get("persistentvolumeclaims", "default", "data") is None
     c3.close()
+
+
+def test_torn_actuation_wal_between_cordon_and_delete(tmp_path):
+    """ISSUE 19: a scale-down actuation is cordon (update) -> drain ->
+    delete, each its own WAL append.  A crash that tears the WAL
+    mid-delete must recover to the CONSISTENT intermediate state: the
+    node still exists and is still cordoned (the durable cordon), the
+    torn delete simply never happened — so a restarted actuator can
+    either finish the removal or roll the cordon back, never seeing a
+    half-deleted node."""
+    from kubernetes_tpu.runtime.controllers import cordon_node, uncordon_node
+
+    d = str(tmp_path / "data")
+    c1 = PersistentCluster(d)
+    for i in range(2):
+        c1.add_node(make_node(f"base-{i}", cpu="4", mem="8Gi"))
+    c1.add_node(make_node("scale-1", cpu="4", mem="8Gi"))
+    assert cordon_node(c1, "scale-1")
+    c1.delete("nodes", "", "scale-1")
+    c1.close()
+    wal = os.path.join(d, "wal.jsonl")
+    lines = open(wal).read().splitlines()
+    assert json.loads(lines[-1])["op"] == "delete"  # the verb we tear
+    torn = "\n".join(lines[:-1]) + "\n" + lines[-1][: len(lines[-1]) // 2]
+    with open(wal, "w") as f:
+        f.write(torn)
+
+    c2 = PersistentCluster(d)
+    node = c2.get("nodes", "", "scale-1")
+    assert node is not None, "torn delete must not replay as removal"
+    assert node.spec.unschedulable, "the cordon preceding the tear is durable"
+    assert len(c2.list("nodes")) == 3
+    # a restarted actuator's ROLLBACK path: uncordon, fleet whole
+    assert uncordon_node(c2, "scale-1")
+    c2.close()
+    c3 = PersistentCluster(d)
+    node = c3.get("nodes", "", "scale-1")
+    assert node is not None and not node.spec.unschedulable
+    # ... or its FINISH path: delete again, durable this time
+    c3.delete("nodes", "", "scale-1")
+    c3.close()
+    c4 = PersistentCluster(d)
+    assert c4.get("nodes", "", "scale-1") is None
+    assert len(c4.list("nodes")) == 2
+    c4.close()
