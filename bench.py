@@ -589,6 +589,11 @@ def run(args) -> dict:
             scen = run_scenario(
                 "drain", seed=args.scenario_seed, pods=120, nodes=10,
                 rate=120.0, drain_timeout_s=60.0,
+                # --timeline-out: the stage banks the longitudinal
+                # artifact (fast sampling + chaos-window annotations);
+                # the stage's store is the LAST process default, so
+                # _write_timeline_artifact renders ITS html sibling
+                timeline_path=getattr(args, "timeline_out", None),
             ).to_dict()
             scen["clean"] = (
                 scen["lost"] == 0 and scen["violations"] == 0
@@ -1092,6 +1097,11 @@ def run_scenario_metric(args) -> dict:
         compression=args.scenario_compression,
         trace_path=args.scenario_trace,
         ledger=ledger,
+        # --timeline-out: the campaign samples fast relative to the
+        # compressed replay and banks the JSONL inside run_scenario
+        # (chaos-window annotations aligned with the excursions);
+        # _write_timeline_artifact then renders the HTML sibling
+        timeline_path=getattr(args, "timeline_out", None),
     )
     d = res.to_dict()
     clean = res.lost == 0 and res.violations == 0
@@ -2591,6 +2601,7 @@ def run_child(args) -> None:
             return
         _write_trace_artifact(args)
         _write_cluster_artifact(args)
+        _write_timeline_artifact(args)
         _emit(result)
     finally:
         if lock is not None:
@@ -2646,6 +2657,36 @@ def _write_cluster_artifact(args) -> None:
         sys.stderr.write(f"bench: --cluster-out failed: {e}\n")
 
 
+def _write_timeline_artifact(args) -> None:
+    """--timeline-out: dump the process-default metrics timeline store
+    (ISSUE 20) as JSONL, plus a dependency-free static HTML report
+    (inline SVG sparklines with the annotation lanes) next to it at
+    <path>.html.  A scenario run already exported the JSONL inside
+    run_scenario — re-exporting the same store here is idempotent and
+    keeps ONE artifact path for every bench mode.  Best-effort like the
+    trace/cluster artifacts."""
+    path = getattr(args, "timeline_out", None)
+    if not path:
+        return
+    try:
+        from kubernetes_tpu.runtime import timeline as timeline_mod
+
+        store = timeline_mod.get_default()
+        n = store.export_jsonl(path)
+        html_path = path + ".html"
+        payload = store.debug_payload()
+        with open(html_path, "w") as f:
+            f.write(timeline_mod.render_html(
+                payload, title=f"kubernetes_tpu timeline — {path}"
+            ))
+        sys.stderr.write(
+            f"bench: wrote {n} timeline records to {path} "
+            f"(+ report {html_path})\n"
+        )
+    except Exception as e:  # noqa: BLE001
+        sys.stderr.write(f"bench: --timeline-out failed: {e}\n")
+
+
 def _last_json_line(text: str):
     for line in reversed(text.strip().splitlines()):
         line = line.strip()
@@ -2675,6 +2716,8 @@ def _child_cmd(args, platform: str | None) -> list:
         cmd += ["--cluster-out", args.cluster_out]
     if getattr(args, "quality_out", None):
         cmd += ["--quality-out", args.quality_out]
+    if getattr(args, "timeline_out", None):
+        cmd += ["--timeline-out", args.timeline_out]
     if args.density:
         cmd += ["--density",
                 "--density-interval", str(args.density_interval),
@@ -3399,6 +3442,15 @@ def main():
         "counterfactual regret, drift-detector state and per-cycle "
         "samples) as JSON here — the artifact CI uploads next to the "
         "trace/ledger/cluster files",
+    )
+    ap.add_argument(
+        "--timeline-out", default=None,
+        help="write the run's metrics timeline (the /debug/timeline "
+        "payload: every registered metric family sampled per interval, "
+        "typed event annotations, anomaly firings) as JSONL here, plus "
+        "a dependency-free static HTML report at <path>.html — the "
+        "longitudinal artifact CI uploads next to the trace/ledger/"
+        "cluster files",
     )
     ap.add_argument(
         "--replay", default=None, metavar="LEDGER",

@@ -881,213 +881,30 @@ class APIServer:
                         ct="text/plain; version=0.0.4",
                     )
                     return
-                if self.path.partition("?")[0] == "/debug/traces":
-                    # the process-wide flight recorder as Chrome
-                    # trace-event JSON (Perfetto-loadable) — in embedded
+                if self.path.partition("?")[0].startswith("/debug"):
+                    # EVERY debug endpoint — flight recorder, ledger,
+                    # telemetry, perf/quality observatories, capacity,
+                    # autoscaler, replicas, profile, timeline, and the
+                    # index — routes through the ONE shared table
+                    # (runtime/ledger.py DEBUG_RENDERERS), the same
+                    # table the health server walks: a new endpoint
+                    # registered there is exposed on both servers, and
+                    # can no longer be forgotten on one.  In embedded
                     # deployments (--with-scheduler) the scheduling
-                    # cycles' spans live in this process.  ?limit=N keeps
-                    # the newest N cycle spans; the hard response-size
-                    # cap halves further so a long-lived ring can never
-                    # produce an unbounded body
-                    from kubernetes_tpu.runtime.flightrecorder import (
-                        RECORDER,
-                    )
-                    from kubernetes_tpu.runtime.ledger import debug_body
-
-                    self._send_text(
-                        debug_body(
-                            RECORDER.chrome_trace,
-                            self.path.partition("?")[2],
-                        ),
-                        ct="application/json",
-                    )
-                    return
-                if self.path.partition("?")[0] == "/debug/decisions":
-                    # recent decision-ledger entries (winners + dominant
-                    # rejection reasons per pod), cross-linked to
-                    # /debug/traces by trace id; inflight-exempt like the
-                    # trace endpoint
+                    # happens in this process, so the process defaults
+                    # these renderers read ARE the live instances.
+                    # Inflight-exempt (see the `limited` wrapper):
+                    # diagnosing an overload needs them reachable.
                     from kubernetes_tpu.runtime.ledger import (
-                        debug_body,
-                        get_default,
+                        debug_dispatch,
                     )
 
-                    self._send_text(
-                        debug_body(
-                            lambda lim: {
-                                "decisions": get_default().decisions(lim)
-                            },
-                            self.path.partition("?")[2],
-                        ),
-                        ct="application/json",
-                    )
-                    return
-                if self.path.partition("?")[0] == "/debug/cluster":
-                    # the telemetry hub's cluster-state time series
-                    # (utilization/fragmentation/HBM/SLO burn rates) —
-                    # in embedded deployments the scheduling happens in
-                    # this process, so its hub is the process default.
-                    # Inflight-exempt like the other debug endpoints:
-                    # diagnosing an overload needs them reachable
-                    from kubernetes_tpu.runtime.ledger import debug_body
-                    from kubernetes_tpu.runtime.telemetry import (
-                        get_default as get_telemetry,
-                    )
-
-                    self._send_text(
-                        debug_body(
-                            get_telemetry().debug_payload,
-                            self.path.partition("?")[2],
-                        ),
-                        ct="application/json",
-                    )
-                    return
-                if self.path.partition("?")[0] == "/debug/perf":
-                    # the performance observatory (runtime/perfobs.py):
-                    # host/device cycle split, phase x width EWMA,
-                    # transfer accounting, profiler status — in embedded
-                    # deployments the scheduling happens in this
-                    # process, so its observatory is the process
-                    # default.  Inflight-exempt like its siblings
-                    from kubernetes_tpu.runtime import perfobs
-                    from kubernetes_tpu.runtime.ledger import debug_body
-
-                    self._send_text(
-                        debug_body(
-                            perfobs.get_default().debug_payload,
-                            self.path.partition("?")[2],
-                        ),
-                        ct="application/json",
-                    )
-                    return
-                if self.path.partition("?")[0] == "/debug/quality":
-                    # the placement-quality observatory (runtime/
-                    # quality.py): winner margins, feasible counts,
-                    # FFD-counterfactual regret, drift detectors — in
-                    # embedded deployments the scheduling happens in
-                    # this process, so its observatory is the process
-                    # default.  Inflight-exempt like its siblings
-                    from kubernetes_tpu.runtime import quality
-                    from kubernetes_tpu.runtime.ledger import debug_body
-
-                    self._send_text(
-                        debug_body(
-                            quality.get_default().debug_payload,
-                            self.path.partition("?")[2],
-                        ),
-                        ct="application/json",
-                    )
-                    return
-                if self.path.partition("?")[0] == "/debug/capacity":
-                    # the capacity planner (runtime/capacity.py): the
-                    # class-compressed backlog what-if's scale-up/
-                    # scale-down recommendation — in embedded
-                    # deployments the scheduling happens in this
-                    # process, so its planner is the process default.
-                    # Inflight-exempt like its siblings
-                    from kubernetes_tpu.runtime import capacity
-                    from kubernetes_tpu.runtime.ledger import debug_body
-
-                    self._send_text(
-                        debug_body(
-                            capacity.get_default().debug_payload,
-                            self.path.partition("?")[2],
-                        ),
-                        ct="application/json",
-                    )
-                    return
-                if self.path.partition("?")[0] == "/debug/autoscaler":
-                    # the guarded actuation loop (ISSUE 19): managed
-                    # fleet, hysteresis streaks, cooldown window, cost,
-                    # recent actuation records.  Tolerates no wired
-                    # controller (actuation is commonly off).
-                    # Inflight-exempt like its siblings
-                    from kubernetes_tpu.runtime import autoscaler
-                    from kubernetes_tpu.runtime.ledger import debug_body
-
-                    ctrl = autoscaler.get_default()
-                    self._send_text(
-                        debug_body(
-                            (ctrl.debug_payload if ctrl is not None
-                             else lambda _lim=None: {"enabled": False}),
-                            self.path.partition("?")[2],
-                        ),
-                        ct="application/json",
-                    )
-                    return
-                if self.path.partition("?")[0] == "/debug/capacity/enact":
-                    # GET is a status peek — the actuation verb is POST
-                    # (handled in do_POST); serving the peek keeps the
-                    # /debug/ index walk uniform
-                    from kubernetes_tpu.runtime import autoscaler
-                    from kubernetes_tpu.runtime.ledger import debug_body
-
-                    ctrl = autoscaler.get_default()
-                    self._send_text(
-                        debug_body(
-                            lambda _lim=None: {
-                                "method": "POST",
-                                "hint": "POST runs one guarded round "
-                                        "now; ?dryRun=1 decides + "
-                                        "records without mutating",
-                                "enabled": ctrl is not None,
-                                "last": (ctrl.summary().get("last")
-                                         if ctrl is not None else None),
-                            },
-                            self.path.partition("?")[2],
-                        ),
-                        ct="application/json",
-                    )
-                    return
-                if self.path.partition("?")[0] == "/debug/replicas":
-                    # queue-sharded replicas (ISSUE 14): the explicit
-                    # process aggregate — per-replica cycle/conflict
-                    # facts, reconciler sequencing stats, tenant
-                    # usage/quota table.  Inflight-exempt like its
-                    # siblings
-                    from kubernetes_tpu.runtime import reconciler
-                    from kubernetes_tpu.runtime.ledger import debug_body
-
-                    self._send_text(
-                        debug_body(
-                            reconciler.debug_payload,
-                            self.path.partition("?")[2],
-                        ),
-                        ct="application/json",
-                    )
-                    return
-                if self.path.partition("?")[0] == "/debug/profile":
-                    # on-demand bounded jax.profiler capture
-                    # (?seconds=N; throttled, graceful no-op where the
-                    # backend lacks profiler support).  debug_body-
-                    # routed like every /debug/* response
-                    from kubernetes_tpu.runtime import perfobs
-                    from kubernetes_tpu.runtime.ledger import debug_body
-
-                    query = self.path.partition("?")[2]
-                    self._send_text(
-                        debug_body(
-                            lambda _lim=None: perfobs.profile_request(
-                                query
-                            ),
-                            query,
-                        ),
-                        ct="application/json",
-                    )
-                    return
-                if self.path.partition("?")[0] in ("/debug", "/debug/"):
-                    from kubernetes_tpu.runtime.ledger import (
-                        debug_body,
-                        debug_index,
-                    )
-
-                    self._send_text(
-                        debug_body(
-                            lambda _lim=None: debug_index(),
-                            self.path.partition("?")[2],
-                        ),
-                        ct="application/json",
-                    )
+                    path, _, query = self.path.partition("?")
+                    body = debug_dispatch(path, query)
+                    if body is None:
+                        self._status(404, "NotFound", self.path)
+                    else:
+                        self._send_text(body, ct="application/json")
                     return
                 if self.path == "/version":
                     self._send({"gitVersion": "v1.15-tpu", "major": "1",
@@ -1707,34 +1524,31 @@ class APIServer:
                         "status": {"allowed": bool(allowed)},
                     }, code=201)
                     return
-                if self.path.partition("?")[0] == "/debug/capacity/enact":
-                    # ISSUE 19: run ONE guarded actuation round NOW —
-                    # serialized under the controller's own lock, so a
-                    # manual enact can't interleave with the loop.
-                    # ?dryRun=1 decides + records without mutating the
-                    # fleet.  Inflight-exempt like its siblings
-                    from kubernetes_tpu.runtime import autoscaler
+                if self.path.partition("?")[0].startswith("/debug"):
+                    # debug POST verbs route through the same shared
+                    # table as the GETs (runtime/ledger.py debug_post)
+                    # — currently /debug/capacity/enact: run ONE
+                    # guarded actuation round NOW (?dryRun=1 decides +
+                    # records without mutating).  Inflight-exempt like
+                    # its siblings
+                    from kubernetes_tpu.runtime.ledger import debug_post
 
-                    ctrl = autoscaler.get_default()
-                    if ctrl is None:
-                        self._status(409, "Conflict",
-                                     "no autoscaler wired")
+                    path, _, query = self.path.partition("?")
+                    res = debug_post(path, query)
+                    if res is None:
+                        self._status(404, "NotFound", self.path)
                         return
-                    from urllib.parse import parse_qs
-
-                    q = parse_qs(self.path.partition("?")[2])
-                    dry = None
-                    if "dryRun" in q:
-                        dry = q["dryRun"][-1] not in ("0", "false", "")
-                    try:
-                        rec = ctrl.enact(dry_run=dry)
-                    except Exception as e:  # noqa: BLE001
-                        self._status(500, "InternalError", str(e))
+                    code, body = res
+                    if code != 200:
+                        try:
+                            msg = json.loads(body).get("error", "")
+                        except Exception:  # noqa: BLE001
+                            msg = body.decode(errors="replace")
+                        reason = ("Conflict" if code == 409
+                                  else "InternalError")
+                        self._status(code, reason, msg)
                         return
-                    self._send_text(
-                        json.dumps(rec).encode() + b"\n",
-                        ct="application/json",
-                    )
+                    self._send_text(body + b"\n", ct="application/json")
                     return
                 r = outer._route(self.path)
                 if r is None:
@@ -2229,13 +2043,15 @@ class APIServer:
         # streams are exempt — health probes must work under overload,
         # and a watch would pin a readonly slot for its whole lifetime.
         if outer.flow_control is not None:
+            # the debug family's exemption derives from the SAME table
+            # that routes it (runtime/ledger.py DEBUG_ENDPOINTS), so a
+            # newly registered endpoint is exempt on both servers by
+            # construction instead of by remembering this tuple
+            from kubernetes_tpu.runtime.ledger import DEBUG_ENDPOINTS
+
             exempt = ("/healthz", "/livez", "/readyz", "/metrics",
-                      "/version", "/debug/traces", "/debug/decisions",
-                      "/debug/cluster", "/debug/perf", "/debug/profile",
-                      "/debug/quality", "/debug/replicas",
-                      "/debug/capacity", "/debug/autoscaler",
-                      "/debug/capacity/enact",
-                      "/debug", "/debug/")
+                      "/version", "/debug", "/debug/") \
+                + tuple(DEBUG_ENDPOINTS)
             for method in ("do_GET", "do_POST", "do_PUT", "do_PATCH",
                            "do_DELETE"):
                 inner = getattr(Handler, method)

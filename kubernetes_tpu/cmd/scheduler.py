@@ -227,6 +227,30 @@ def build_parser() -> argparse.ArgumentParser:
                    help="JSONL actuation ledger for offline replay "
                    "(config autoscalerLedgerPath; bench.py --replay "
                    "re-verifies every recorded decision)")
+    p.add_argument("--timeline", dest="timeline", action="store_true",
+                   default=None,
+                   help="metrics timeline store (config timeline; "
+                   "DEFAULT ON): every registered metric family sampled "
+                   "per interval into a bounded ring + typed event "
+                   "annotations + online anomaly detection, served at "
+                   "/debug/timeline")
+    p.add_argument("--no-timeline", dest="timeline",
+                   action="store_false",
+                   help="disable the timeline store entirely")
+    p.add_argument("--timeline-interval-seconds", type=float,
+                   default=None,
+                   help="seconds between timeline samples (config "
+                   "timelineIntervalSeconds; default 1.0)")
+    p.add_argument("--timeline-retention", type=int, default=None,
+                   help="points retained per series (config "
+                   "timelineRetention; default 512)")
+    p.add_argument("--timeline-rules", default=None,
+                   help="JSON list of anomaly rules (config "
+                   "timelineRules), e.g. "
+                   '\'[{"rule":"threshold","series":'
+                   '"scheduler_pending_pods","op":">","value":500}]\'; '
+                   "default: degraded-cycle/invariant thresholds + "
+                   "pending-depth zscore")
     p.add_argument("--simulate-nodes", type=int, default=0,
                    help="register N hollow nodes")
     p.add_argument("--simulate-pods", type=int, default=0,
@@ -338,6 +362,14 @@ def main(argv=None) -> int:
         cc.autoscaler_ledger_path = args.autoscaler_ledger_path
     if cc.autoscaler:
         cc.capacity_planner = True  # actuation needs the plan
+    if args.timeline is not None:
+        cc.timeline = args.timeline
+    if args.timeline_interval_seconds is not None:
+        cc.timeline_interval_s = args.timeline_interval_seconds
+    if args.timeline_retention is not None:
+        cc.timeline_retention = args.timeline_retention
+    if args.timeline_rules is not None:
+        cc.timeline_rules = json.loads(args.timeline_rules)
 
     # persistent compile cache BEFORE any jit compile (engine build,
     # prewarm, first cycle) so every executable of this process is served

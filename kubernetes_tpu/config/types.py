@@ -176,6 +176,19 @@ class KubeSchedulerConfiguration:
     autoscaler_min_nodes: int = 1
     autoscaler_max_nodes: int = 256
     autoscaler_ledger_path: Optional[str] = None
+    # metrics timeline store (runtime/timeline.py): every registered
+    # metric family sampled once per timelineIntervalSeconds into a
+    # bounded ring (counters as deltas, gauges as values, histograms as
+    # p50/p99), interleaved with typed event annotations from the
+    # breaker/shard/mesh/AIMD/shed/autoscaler/chaos seams and run
+    # through the online anomaly detector (timelineRules: [{rule:
+    # threshold|zscore|slope, series, ...}]; null = the conservative
+    # defaults).  Served at /debug/timeline; exported by bench
+    # --timeline-out and the scenario engine.
+    timeline: bool = True
+    timeline_interval_s: float = 1.0
+    timeline_retention: int = 512
+    timeline_rules: Optional[list] = None
     # queue-sharded scheduler replicas (runtime/replicas.py +
     # runtime/reconciler.py): run this many scheduler loops (threads)
     # over one queue/cache, each draining a stable hash-shard and
@@ -294,6 +307,12 @@ class KubeSchedulerConfiguration:
             autoscaler_min_nodes=int(d.get("autoscalerMinNodes", 1)),
             autoscaler_max_nodes=int(d.get("autoscalerMaxNodes", 256)),
             autoscaler_ledger_path=d.get("autoscalerLedgerPath"),
+            timeline=bool(d.get("timeline", True)),
+            timeline_interval_s=float(
+                d.get("timelineIntervalSeconds", 1.0)
+            ),
+            timeline_retention=int(d.get("timelineRetention", 512)),
+            timeline_rules=d.get("timelineRules"),
             replicas=int(d.get("replicas", 1)),
             namespace_quotas=d.get("namespaceQuotas"),
         )

@@ -396,6 +396,26 @@ class AutoscalerController:
         }
         self._seq += 1
         self._record(rec)
+        # timeline annotation (ISSUE 20): non-hold decide() rounds mark
+        # the metrics timeline (hold rounds would flood the bounded
+        # event ring at loop cadence — the scaling story is the
+        # add/remove edges).  Best-effort: the timeline must never
+        # break an actuation round.
+        if decision["action"] != HOLD:
+            try:
+                from kubernetes_tpu.runtime import timeline as timeline_mod
+
+                timeline_mod.get_default().annotate(
+                    "autoscaler",
+                    f"{decision['action']} x{decision.get('count', 0)}"
+                    f" (fleet {state['fleet']}"
+                    f"{', enacted' if outcome.get('enacted') else ''}"
+                    f"{', rollback' if outcome.get('rollback') else ''})",
+                    action=decision["action"],
+                    enacted=bool(outcome.get("enacted")),
+                )
+            except Exception as e:  # noqa: BLE001
+                klog.errorf("autoscaler timeline annotate failed: %s", e)
         return rec
 
     def enact(self, dry_run: Optional[bool] = None) -> dict:
@@ -789,19 +809,20 @@ def sniff_actuation_ledger(path: str) -> bool:
 
 
 # ------------------------------------------------------- process default
+# No factory: the controller is only present when explicitly wired, so
+# get_default() may legitimately return None (runtime/defaults.py
+# ProcessDefault — the shared install/default discipline).
 
-_default_lock = threading.Lock()
-_default: Optional[AutoscalerController] = None
+from kubernetes_tpu.runtime.defaults import ProcessDefault
+
+_DEFAULT = ProcessDefault("autoscaler")
 
 
 def get_default() -> Optional[AutoscalerController]:
     """The process's wired AutoscalerController (None until set): the
     seam /debug/autoscaler + POST /debug/capacity/enact read through."""
-    with _default_lock:
-        return _default
+    return _DEFAULT.get()
 
 
 def set_default(ctrl: Optional[AutoscalerController]) -> None:
-    global _default
-    with _default_lock:
-        _default = ctrl
+    _DEFAULT.set(ctrl)

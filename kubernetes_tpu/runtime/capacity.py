@@ -507,28 +507,29 @@ class CapacityPlanner:
         return {"summary": self.summary(), "samples": samples}
 
 
-# process-wide default (the flightrecorder.RECORDER pattern): the
-# planner /debug/capacity serves when none was wired explicitly; a
-# Scheduler with capacity_planner enabled installs its own here
-CAPACITY = CapacityPlanner()
+# process-wide default: the planner /debug/capacity serves when none
+# was wired explicitly; a Scheduler with capacity_planner enabled
+# installs its own here.  Replica 0 wins the default, siblings register
+# alongside (runtime/defaults.py ProcessDefault)
+from kubernetes_tpu.runtime.defaults import ProcessDefault  # noqa: E402
+
+_DEFAULT = ProcessDefault("capacity", CapacityPlanner)
 
 
 def get_default() -> CapacityPlanner:
-    return CAPACITY
-
-
-# per-replica installs (the ISSUE 14 registry discipline): replica 0
-# stays the process default, siblings register alongside
-_REPLICAS: dict = {}
+    return _DEFAULT.get()
 
 
 def set_default(planner: CapacityPlanner, replica: int = 0) -> None:
-    global CAPACITY
-    _REPLICAS[int(replica)] = planner
-    if int(replica) == 0:
-        CAPACITY = planner
+    _DEFAULT.set(planner, replica)
 
 
 def replica_instances() -> dict:
     """{replica id: CapacityPlanner} of every install this process saw."""
-    return dict(sorted(_REPLICAS.items()))
+    return _DEFAULT.replicas()
+
+
+def __getattr__(name):  # legacy alias: capacity.CAPACITY
+    if name == "CAPACITY":
+        return _DEFAULT.get()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -168,5 +168,29 @@ class FlightRecorder:
 
 
 # process-wide default (the REGISTRY pattern in utils/metrics.py): one
-# ring every component records into unless wired with its own instance
+# ring every component records into unless wired with its own instance.
+# RECORDER stays a real module binding — callers import it by value —
+# while the install/replica registry rides the shared ProcessDefault
+# helper (runtime/defaults.py) like its observability siblings.
 RECORDER = FlightRecorder()
+
+from kubernetes_tpu.runtime.defaults import ProcessDefault  # noqa: E402
+
+_DEFAULT = ProcessDefault("flightrecorder")
+_DEFAULT.set(RECORDER)
+
+
+def get_default() -> FlightRecorder:
+    return _DEFAULT.get()
+
+
+def set_default(rec: FlightRecorder, replica: int = 0) -> None:
+    global RECORDER
+    _DEFAULT.set(rec, replica)
+    if int(replica) == 0:
+        RECORDER = rec
+
+
+def replica_instances() -> dict:
+    """{replica id: FlightRecorder} of every install this process saw."""
+    return _DEFAULT.replicas()

@@ -18,7 +18,6 @@ stalling it.
 
 from __future__ import annotations
 
-import json
 import random
 import threading
 import time
@@ -27,7 +26,6 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from kubernetes_tpu.codec.faults import FAULT_PERSISTENT
-from kubernetes_tpu.runtime.ledger import debug_body
 from kubernetes_tpu.utils import metrics as m
 
 # breaker states (classic Nygard circuit-breaker vocabulary)
@@ -349,201 +347,36 @@ class HealthServer:
                         outer._registry.expose().encode(),
                         ct="text/plain; version=0.0.4",
                     )
-                elif path == "/debug/traces":
-                    self._send(
-                        debug_body(outer._traces, query),
-                        ct="application/json",
-                    )
-                elif path == "/debug/decisions":
-                    # recent decision-ledger entries (per-pod winners +
-                    # dominant-rejection explanations), cross-linked to
-                    # /debug/traces by trace id
-                    from kubernetes_tpu.runtime.ledger import get_default
-
-                    self._send(
-                        debug_body(
-                            lambda lim: {
-                                "decisions": get_default().decisions(lim)
-                            },
-                            query,
-                        ),
-                        ct="application/json",
-                    )
-                elif path == "/debug/cluster":
-                    # the telemetry hub's bounded time series: cluster
-                    # analytics samples (utilization/fragmentation/
-                    # imbalance/occupancy), HBM + compile facts, SLO
-                    # burn rates — ?limit=N + the shared 4MB cap, like
-                    # /debug/decisions
-                    from kubernetes_tpu.runtime.telemetry import (
-                        get_default as get_telemetry,
-                    )
-
-                    self._send(
-                        debug_body(
-                            get_telemetry().debug_payload, query,
-                        ),
-                        ct="application/json",
-                    )
-                elif path == "/debug/perf":
-                    # the performance observatory (runtime/perfobs.py):
-                    # host/device cycle split, phase x width EWMA,
-                    # transfer accounting, profiler status — ?limit=N +
-                    # the shared 4MB cap, like its siblings
-                    from kubernetes_tpu.runtime import perfobs
-
-                    self._send(
-                        debug_body(
-                            perfobs.get_default().debug_payload, query,
-                        ),
-                        ct="application/json",
-                    )
-                elif path == "/debug/quality":
-                    # the placement-quality observatory (runtime/
-                    # quality.py): winner margins, feasible counts,
-                    # FFD-counterfactual regret, drift detectors —
-                    # ?limit=N + the shared 4MB cap, like its siblings
-                    from kubernetes_tpu.runtime import quality
-
-                    self._send(
-                        debug_body(
-                            quality.get_default().debug_payload, query,
-                        ),
-                        ct="application/json",
-                    )
-                elif path == "/debug/capacity":
-                    # the capacity planner (runtime/capacity.py):
-                    # class-compressed backlog what-if — scale-up/
-                    # scale-down recommendation, compression and
-                    # absorption facts — ?limit=N + the shared 4MB
-                    # cap, like its siblings
-                    from kubernetes_tpu.runtime import capacity
-
-                    self._send(
-                        debug_body(
-                            capacity.get_default().debug_payload, query,
-                        ),
-                        ct="application/json",
-                    )
-                elif path == "/debug/autoscaler":
-                    # the guarded actuation loop (ISSUE 19): managed
-                    # fleet, hysteresis streaks, cooldown window, cost,
-                    # recent actuation records — ?limit=N + the shared
-                    # 4MB cap, like its siblings.  Tolerates no wired
-                    # controller (reports disabled) — unlike the
-                    # planner, actuation is commonly off
-                    from kubernetes_tpu.runtime import autoscaler
-
-                    ctrl = autoscaler.get_default()
-                    self._send(
-                        debug_body(
-                            (ctrl.debug_payload if ctrl is not None
-                             else lambda _lim=None: {"enabled": False}),
-                            query,
-                        ),
-                        ct="application/json",
-                    )
-                elif path == "/debug/capacity/enact":
-                    # GET is a status peek — the actuation verb is POST
-                    # (below); serving the peek keeps the /debug/ index
-                    # walk uniform (every listed endpoint GETs 200)
-                    from kubernetes_tpu.runtime import autoscaler
-
-                    ctrl = autoscaler.get_default()
-                    self._send(
-                        debug_body(
-                            lambda _lim=None: {
-                                "method": "POST",
-                                "hint": "POST runs one guarded round "
-                                        "now; ?dryRun=1 decides + "
-                                        "records without mutating",
-                                "enabled": ctrl is not None,
-                                "last": (ctrl.summary().get("last")
-                                         if ctrl is not None else None),
-                            },
-                            query,
-                        ),
-                        ct="application/json",
-                    )
-                elif path == "/debug/replicas":
-                    # queue-sharded replicas (ISSUE 14): the explicit
-                    # process aggregate — per-replica cycle/conflict
-                    # facts, reconciler sequencing stats, tenant
-                    # usage/quota table
-                    from kubernetes_tpu.runtime import reconciler
-
-                    self._send(
-                        debug_body(reconciler.debug_payload, query),
-                        ct="application/json",
-                    )
-                elif path == "/debug/profile":
-                    # on-demand bounded jax.profiler capture
-                    # (?seconds=N; throttled, graceful no-op where the
-                    # backend lacks profiler support).  Routed through
-                    # the shared debug_body like every /debug/* response
-                    # (the body is tiny; the cap is the uniform contract)
-                    from kubernetes_tpu.runtime import perfobs
-
-                    self._send(
-                        debug_body(
-                            lambda _lim=None: perfobs.profile_request(
-                                query
-                            ),
-                            query,
-                        ),
-                        ct="application/json",
-                    )
-                elif path in ("/debug", "/debug/"):
-                    # the index: every debug endpoint, one line each —
-                    # debug_body-routed like its children
-                    from kubernetes_tpu.runtime.ledger import debug_index
-
-                    self._send(
-                        debug_body(lambda _lim=None: debug_index(), query),
-                        ct="application/json",
-                    )
                 else:
-                    self._send(b"not found", 404)
+                    # EVERY debug endpoint routes through the shared
+                    # table (runtime/ledger.py DEBUG_RENDERERS) — one
+                    # registration serves this server AND the
+                    # apiserver, so an endpoint can no longer be
+                    # exposed on one and forgotten on the other.  The
+                    # constructor-injected traces callable rides the
+                    # overrides seam.
+                    from kubernetes_tpu.runtime.ledger import (
+                        debug_dispatch,
+                    )
+
+                    body = debug_dispatch(
+                        path, query, overrides={"traces": outer._traces}
+                    )
+                    if body is None:
+                        self._send(b"not found", 404)
+                    else:
+                        self._send(body, ct="application/json")
 
             def do_POST(self):
                 path, _, query = self.path.partition("?")
-                if path == "/debug/capacity/enact":
-                    # ISSUE 19: run ONE guarded actuation round NOW —
-                    # same lock as the loop, so a manual enact can't
-                    # interleave with a scheduled one.  ?dryRun=1
-                    # decides + records without mutating the fleet
-                    from urllib.parse import parse_qs
+                from kubernetes_tpu.runtime.ledger import debug_post
 
-                    from kubernetes_tpu.runtime import autoscaler
-
-                    ctrl = autoscaler.get_default()
-                    if ctrl is None:
-                        self._send(
-                            json.dumps(
-                                {"error": "no autoscaler wired"}
-                            ).encode(),
-                            409,
-                            ct="application/json",
-                        )
-                        return
-                    q = parse_qs(query)
-                    dry = None
-                    if "dryRun" in q:
-                        dry = q["dryRun"][-1] not in ("0", "false", "")
-                    try:
-                        rec = ctrl.enact(dry_run=dry)
-                        self._send(
-                            json.dumps(rec).encode(),
-                            ct="application/json",
-                        )
-                    except Exception as e:  # noqa: BLE001
-                        self._send(
-                            json.dumps({"error": str(e)}).encode(),
-                            500,
-                            ct="application/json",
-                        )
-                else:
+                res = debug_post(path, query)
+                if res is None:
                     self._send(b"not found", 404)
+                else:
+                    code, body = res
+                    self._send(body, code, ct="application/json")
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None

@@ -421,33 +421,34 @@ class DecisionLedger:
                 self._cv.notify_all()
 
 
-# process-wide default (the flightrecorder.RECORDER pattern): the ring
-# /debug/decisions serves when no instance was wired explicitly.  A
-# Scheduler configured with decision_ledger=True installs its ledger
-# here unless one was injected.
-LEDGER = DecisionLedger()
+# process-wide default: the ring /debug/decisions serves when no
+# instance was wired explicitly.  A Scheduler configured with
+# decision_ledger=True installs its ledger here unless one was
+# injected.  Replicas normally SHARE one ledger (replica id + commit
+# seq in every block), so the registry usually holds one instance under
+# several ids (runtime/defaults.py ProcessDefault).
+from kubernetes_tpu.runtime.defaults import ProcessDefault  # noqa: E402
+
+_DEFAULT = ProcessDefault("ledger", DecisionLedger)
 
 
 def get_default() -> DecisionLedger:
-    return LEDGER
-
-
-# per-replica installs (ISSUE 14 satellite; see runtime/telemetry.py).
-# Replicas normally SHARE one ledger (replica id + commit seq in every
-# block), so the registry usually holds one instance under several ids.
-_REPLICAS: dict = {}
+    return _DEFAULT.get()
 
 
 def set_default(ledger: DecisionLedger, replica: int = 0) -> None:
-    global LEDGER
-    _REPLICAS[int(replica)] = ledger
-    if int(replica) == 0:
-        LEDGER = ledger
+    _DEFAULT.set(ledger, replica)
 
 
 def replica_instances() -> dict:
     """{replica id: DecisionLedger} of every install this process saw."""
-    return dict(sorted(_REPLICAS.items()))
+    return _DEFAULT.replicas()
+
+
+def __getattr__(name):  # legacy alias: ledger.LEDGER
+    if name == "LEDGER":
+        return _DEFAULT.get()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def bounded_json(render, limit: Optional[int],
@@ -547,6 +548,11 @@ DEBUG_ENDPOINTS = {
         "POST: run one guarded actuation round NOW against the live "
         "capacity plan (?dryRun=1 decides + records without mutating)"
     ),
+    "/debug/timeline": (
+        "metrics timeline store: sampled series over every registered "
+        "family + typed event annotations + anomaly firings "
+        "(?series=a,b* ?window=S ?step=S ?limit=N)"
+    ),
 }
 
 
@@ -554,6 +560,166 @@ def debug_index() -> dict:
     """GET /debug/ body: every debug endpoint with a one-line
     description."""
     return {"endpoints": dict(DEBUG_ENDPOINTS)}
+
+
+# --------------------------------------------------- shared debug routing
+# ONE table drives BOTH servers (ISSUE 20 satellite): the health server
+# and the apiserver used to hand-code parallel if/elif chains over the
+# same endpoints, so a new endpoint could be exposed on one and
+# forgotten on the other.  Every GET /debug/* now routes through
+# debug_dispatch() and every debug POST through debug_post(); the
+# renderer table's keys are asserted against DEBUG_ENDPOINTS at import,
+# so an endpoint cannot be listed without a handler or vice versa.
+# Renderer factories take (query, overrides) and return the
+# `render(limit) -> jsonable` callable debug_body expects; imports stay
+# lazy inside each factory (the servers must not drag every subsystem
+# in at import).  `overrides` carries caller-injected seams — the
+# health server's constructor-injected `traces` callable.
+
+def _r_traces(query, overrides):
+    traces = overrides.get("traces")
+    if traces is None:
+        from kubernetes_tpu.runtime import flightrecorder
+
+        traces = flightrecorder.get_default().chrome_trace
+    return traces
+
+
+def _r_decisions(query, overrides):
+    return lambda lim: {"decisions": get_default().decisions(lim)}
+
+
+def _r_cluster(query, overrides):
+    from kubernetes_tpu.runtime import telemetry
+
+    return telemetry.get_default().debug_payload
+
+
+def _r_perf(query, overrides):
+    from kubernetes_tpu.runtime import perfobs
+
+    return perfobs.get_default().debug_payload
+
+
+def _r_profile(query, overrides):
+    from kubernetes_tpu.runtime import perfobs
+
+    return lambda _lim=None: perfobs.profile_request(query)
+
+
+def _r_quality(query, overrides):
+    from kubernetes_tpu.runtime import quality
+
+    return quality.get_default().debug_payload
+
+
+def _r_replicas(query, overrides):
+    from kubernetes_tpu.runtime import reconciler
+
+    return reconciler.debug_payload
+
+
+def _r_capacity(query, overrides):
+    from kubernetes_tpu.runtime import capacity
+
+    return capacity.get_default().debug_payload
+
+
+def _r_autoscaler(query, overrides):
+    from kubernetes_tpu.runtime import autoscaler
+
+    ctrl = autoscaler.get_default()
+    if ctrl is None:
+        # tolerates no wired controller (reports disabled) — unlike
+        # the planner, actuation is commonly off
+        return lambda _lim=None: {"enabled": False}
+    return ctrl.debug_payload
+
+
+def _r_enact_peek(query, overrides):
+    # GET is a status peek — the actuation verb is POST (debug_post);
+    # serving the peek keeps the /debug/ index walk uniform (every
+    # listed endpoint GETs 200)
+    from kubernetes_tpu.runtime import autoscaler
+
+    ctrl = autoscaler.get_default()
+    return lambda _lim=None: {
+        "method": "POST",
+        "hint": "POST runs one guarded round now; ?dryRun=1 decides "
+                "+ records without mutating",
+        "enabled": ctrl is not None,
+        "last": (ctrl.summary().get("last")
+                 if ctrl is not None else None),
+    }
+
+
+def _r_timeline(query, overrides):
+    from kubernetes_tpu.runtime import timeline
+
+    return lambda lim: timeline.get_default().debug_payload(
+        limit=lim, query=query
+    )
+
+
+DEBUG_RENDERERS = {
+    "/debug/traces": _r_traces,
+    "/debug/decisions": _r_decisions,
+    "/debug/cluster": _r_cluster,
+    "/debug/perf": _r_perf,
+    "/debug/profile": _r_profile,
+    "/debug/quality": _r_quality,
+    "/debug/replicas": _r_replicas,
+    "/debug/capacity": _r_capacity,
+    "/debug/autoscaler": _r_autoscaler,
+    "/debug/capacity/enact": _r_enact_peek,
+    "/debug/timeline": _r_timeline,
+}
+
+# the can't-forget guarantee: a path listed without a renderer (or
+# rendered without a listing) fails at import, not in production
+assert set(DEBUG_RENDERERS) == set(DEBUG_ENDPOINTS), (
+    set(DEBUG_RENDERERS) ^ set(DEBUG_ENDPOINTS)
+)
+
+
+def debug_dispatch(path: str, query: str = "",
+                   overrides: Optional[dict] = None) -> Optional[bytes]:
+    """Route one GET /debug/* request through the shared table.
+    Returns the JSON body bytes, or None when the path is not a debug
+    endpoint (the caller 404s)."""
+    if path in ("/debug", "/debug/"):
+        return debug_body(lambda _lim=None: debug_index(), query)
+    factory = DEBUG_RENDERERS.get(path)
+    if factory is None:
+        return None
+    return debug_body(factory(query, overrides or {}), query)
+
+
+def debug_post(path: str, query: str = ""
+               ) -> Optional[Tuple[int, bytes]]:
+    """Route one debug POST verb.  Returns (status, body) or None when
+    the path has no POST handler (the caller falls through/404s).
+    Currently one verb: /debug/capacity/enact — run ONE guarded
+    actuation round NOW (same lock as the loop, so a manual enact
+    can't interleave with a scheduled one; ?dryRun=1 decides +
+    records without mutating the fleet)."""
+    if path != "/debug/capacity/enact":
+        return None
+    from urllib.parse import parse_qs
+
+    from kubernetes_tpu.runtime import autoscaler
+
+    ctrl = autoscaler.get_default()
+    if ctrl is None:
+        return 409, json.dumps({"error": "no autoscaler wired"}).encode()
+    q = parse_qs(query)
+    dry = None
+    if "dryRun" in q:
+        dry = q["dryRun"][-1] not in ("0", "false", "")
+    try:
+        return 200, json.dumps(ctrl.enact(dry_run=dry)).encode()
+    except Exception as e:  # noqa: BLE001 — the verb reports, never raises
+        return 500, json.dumps({"error": str(e)}).encode()
 
 
 # ------------------------------------------------------------- replay

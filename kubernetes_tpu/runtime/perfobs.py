@@ -404,28 +404,29 @@ def profile_request(query: str = "") -> dict:
     return get_default().profiler.start(seconds)
 
 
-# process-wide default (the flightrecorder.RECORDER pattern): the
-# observatory /debug/perf serves when none was wired explicitly; a
-# Scheduler installs its own here at construction
-OBSERVATORY = PerfObservatory()
+# process-wide default: the observatory /debug/perf serves when none
+# was wired explicitly; a Scheduler installs its own here at
+# construction.  Replica 0 wins the default, siblings register
+# alongside (runtime/defaults.py ProcessDefault)
+from kubernetes_tpu.runtime.defaults import ProcessDefault  # noqa: E402
+
+_DEFAULT = ProcessDefault("perfobs", PerfObservatory)
 
 
 def get_default() -> PerfObservatory:
-    return OBSERVATORY
-
-
-# per-replica installs (ISSUE 14 satellite; see runtime/telemetry.py):
-# replica 0 stays the process default, siblings register alongside
-_REPLICAS: dict = {}
+    return _DEFAULT.get()
 
 
 def set_default(obs: PerfObservatory, replica: int = 0) -> None:
-    global OBSERVATORY
-    _REPLICAS[int(replica)] = obs
-    if int(replica) == 0:
-        OBSERVATORY = obs
+    _DEFAULT.set(obs, replica)
 
 
 def replica_instances() -> dict:
     """{replica id: PerfObservatory} of every install this process saw."""
-    return dict(sorted(_REPLICAS.items()))
+    return _DEFAULT.replicas()
+
+
+def __getattr__(name):  # legacy alias: perfobs.OBSERVATORY
+    if name == "OBSERVATORY":
+        return _DEFAULT.get()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

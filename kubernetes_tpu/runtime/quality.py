@@ -564,29 +564,30 @@ class QualityObservatory:
         return {"summary": self.summary(), "samples": samples}
 
 
-# process-wide default (the flightrecorder.RECORDER pattern): the
-# observatory /debug/quality serves when none was wired explicitly; a
-# Scheduler with quality enabled installs its own here at construction
-QUALITY = QualityObservatory()
+# process-wide default: the observatory /debug/quality serves when
+# none was wired explicitly; a Scheduler with quality enabled installs
+# its own here at construction.  Replica 0 wins the default, siblings
+# register alongside (runtime/defaults.py ProcessDefault)
+from kubernetes_tpu.runtime.defaults import ProcessDefault  # noqa: E402
+
+_DEFAULT = ProcessDefault("quality", QualityObservatory)
 
 
 def get_default() -> QualityObservatory:
-    return QUALITY
-
-
-# per-replica installs (ISSUE 14 satellite; see runtime/telemetry.py):
-# replica 0 stays the process default, siblings register alongside
-_REPLICAS: dict = {}
+    return _DEFAULT.get()
 
 
 def set_default(obs: QualityObservatory, replica: int = 0) -> None:
-    global QUALITY
-    _REPLICAS[int(replica)] = obs
-    if int(replica) == 0:
-        QUALITY = obs
+    _DEFAULT.set(obs, replica)
 
 
 def replica_instances() -> dict:
     """{replica id: QualityObservatory} of every install this process
     saw."""
-    return dict(sorted(_REPLICAS.items()))
+    return _DEFAULT.replicas()
+
+
+def __getattr__(name):  # legacy alias: quality.QUALITY
+    if name == "QUALITY":
+        return _DEFAULT.get()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

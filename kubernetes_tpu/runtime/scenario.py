@@ -474,6 +474,46 @@ class ScenarioRunner:
         this after the wait, so the goodput window measures the
         disruption, not the warm-up it deliberately sat out."""
         self._event_mark = time.monotonic()
+        self._tick_timeline()
+
+    def _tick_timeline(self) -> None:
+        """Offer the timeline store a cadence-gated sample at THIS
+        moment.  Called at every chaos-window edge so the ±1-interval
+        alignment between chaos marks and sampled points holds by
+        construction even while the scheduler thread is parked in a
+        queue pop (its own tick only runs at cycle/idle boundaries):
+        either a fresh sample lands now, or the gate proves one
+        already exists within `interval_s`."""
+        tl = getattr(self.scheduler, "timeline", None)
+        if tl is None:
+            return
+        try:
+            tl.maybe_sample()
+        except Exception:  # noqa: BLE001 — observability only
+            pass
+
+    def _mark_chaos(self, edge: str, t: float, **fields) -> None:
+        """Annotate one chaos-window edge on the scheduler's metrics
+        timeline (ISSUE 20) at its exact wall time.  Best-effort: a
+        disabled timeline must not change a campaign."""
+        tl = getattr(self.scheduler, "timeline", None)
+        if tl is None:
+            return
+        try:
+            self._tick_timeline()
+            tl.annotate("chaos", f"window {edge}", t=t, edge=edge,
+                        **fields)
+        except Exception:  # noqa: BLE001 — observability only
+            pass
+
+    def export_timeline(self, path: str) -> int:
+        """Bank the scheduler's timeline store as JSONL (ISSUE 20: the
+        longitudinal artifact next to the trace/ledger ones).  Returns
+        the number of records written, 0 when the timeline is off."""
+        tl = getattr(self.scheduler, "timeline", None)
+        if tl is None:
+            return 0
+        return tl.export_jsonl(path)
 
     def await_bound(self, n: int, timeout_s: float = 10.0) -> int:
         """Block (bounded) until at least `n` pods are live-bound —
@@ -570,9 +610,17 @@ class ScenarioRunner:
                 ci += 1
                 w0 = time.monotonic()
                 self._event_mark = None
+                self._tick_timeline()
                 out = fn()
-                chaos_windows.append(
-                    (self._event_mark or w0, time.monotonic()))
+                w_start = self._event_mark or w0
+                w_end = time.monotonic()
+                chaos_windows.append((w_start, w_end))
+                # timeline annotations (ISSUE 20): both window edges at
+                # their EXACT wall times (the store clock is the same
+                # monotonic clock), so the chaos lane on the rendered
+                # timeline aligns with the metric excursions it caused
+                self._mark_chaos("start", w_start, virtual_t=round(v, 3))
+                self._mark_chaos("end", w_end, virtual_t=round(v, 3))
                 res.chaos.append({
                     "virtual_t": round(v, 3),
                     "result": out if isinstance(out, dict) else str(out),
@@ -727,6 +775,7 @@ def run_scenario(
     drain_timeout_s: float = 60.0,
     autoscale: Optional[dict] = None,
     autoscale_ledger_path: Optional[str] = None,
+    timeline_path: Optional[str] = None,
 ) -> ScenarioResult:
     """One call per campaign — the shared engine behind
     ``bench.py --scenario`` and the scenario tests:
@@ -792,20 +841,31 @@ def run_scenario(
         nodes=nodes, zones=zones, capacity=capacity,
         compression=compression, seed=seed, ledger=ledger,
     )
+    if timeline_path:
+        # banking a timeline artifact: sample fast relative to the
+        # compressed replay so the chaos windows land between real
+        # samples (±1 interval alignment, asserted by the tests)
+        runner_kwargs["config_overrides"] = {
+            "timeline": True,
+            "timeline_interval_s": 0.05,
+            "timeline_retention": 4096,
+        }
     if kind == "autoscale":
         # a small-node base fleet the peak MUST overflow, a matching
         # single-shape catalog, and a planner solving every few cycles
         # so the actuator sees fresh plans through the whole curve
+        overrides = dict(runner_kwargs.get("config_overrides") or {})
+        overrides.update({
+            "capacity_planner": True,
+            "capacity_interval_cycles": 4,
+            "node_shape_catalog": [
+                {"name": "autoscale-2c", "cpu": "2",
+                 "memory": "4Gi", "pods": 32},
+            ],
+        })
         runner_kwargs.update(
             node_cpu="2", node_mem="4Gi", node_pods=32,
-            config_overrides={
-                "capacity_planner": True,
-                "capacity_interval_cycles": 4,
-                "node_shape_catalog": [
-                    {"name": "autoscale-2c", "cpu": "2",
-                     "memory": "4Gi", "pods": 32},
-                ],
-            },
+            config_overrides=overrides,
         )
     with ScenarioRunner(**runner_kwargs) as runner:
         monkey = Disruptions(runner.cluster, rng=random.Random(seed))
@@ -900,4 +960,6 @@ def run_scenario(
                 "fleet_curve": fleet_curve[-64:],
             }
         result.chaos.insert(0, {"kind": kind, "seed": seed})
+        if timeline_path:
+            runner.export_timeline(timeline_path)
     return result

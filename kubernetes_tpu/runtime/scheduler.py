@@ -306,6 +306,29 @@ class SchedulerConfig:
     # pods?, <extended resources>...}]); None = the small built-in
     # default catalog (runtime/capacity.DEFAULT_SHAPE_CATALOG)
     node_shape_catalog: Optional[list] = None
+    # --- metrics timeline store (ISSUE 20: runtime/timeline.py) ---
+    # bounded in-process time-series: every registered metric family is
+    # sampled once per timeline_interval_s (counters as per-interval
+    # deltas, gauges as values, histograms as p50/p99), interleaved with
+    # typed event annotations from the existing seams (breaker/shard
+    # transitions, mesh rebuilds, AIMD resizes, sheds, degraded fetches,
+    # invariant violations, autoscaler rounds, chaos windows) and run
+    # through the online AnomalyDetector (threshold/zscore/slope rules,
+    # edge-triggered, flight-recorder postmortems).  Served at
+    # /debug/timeline; exported as JSONL + static HTML by bench
+    # --timeline-out and the scenario engine.  False removes the
+    # sampling hook entirely.
+    timeline: bool = True
+    # sampling cadence (wall seconds between samples; the hook rides the
+    # commit tail + the idle heartbeat path, so a busy loop samples at
+    # most once per interval and an idle loop still samples)
+    timeline_interval_s: float = 1.0
+    # points retained per series (ring buffer; also bounds events)
+    timeline_retention: int = 512
+    # anomaly rules ([{rule: threshold|zscore|slope, series, ...}]);
+    # None = the conservative defaults (timeline.DEFAULT_RULES: degraded
+    # cycles, invariant violations, pending-depth zscore)
+    timeline_rules: Optional[list] = None
     # --- queue-sharded scheduler replicas (ISSUE 14) ---
     # horizontal scale-out inside one process: run this many Scheduler
     # replicas (threads) over ONE cache/queue, each popping a stable
@@ -399,6 +422,10 @@ class SchedulerConfig:
                 cc, "capacity_interval_cycles", 256
             ),
             node_shape_catalog=getattr(cc, "node_shape_catalog", None),
+            timeline=getattr(cc, "timeline", True),
+            timeline_interval_s=getattr(cc, "timeline_interval_s", 1.0),
+            timeline_retention=getattr(cc, "timeline_retention", 512),
+            timeline_rules=getattr(cc, "timeline_rules", None),
             replicas=getattr(cc, "replicas", 1),
             namespace_quotas=getattr(cc, "namespace_quotas", None),
         )
@@ -1025,6 +1052,30 @@ class Scheduler:
             capacity_mod.set_default(
                 self.capacity, replica=self._replica_id
             )
+        # metrics timeline store (ISSUE 20, runtime/timeline.py): every
+        # registered metric family sampled once per timelineInterval
+        # (counters as deltas, gauges as values, histograms as p50/p99)
+        # into a bounded ring, interleaved with typed event annotations
+        # from the breaker/shard/mesh/AIMD/shed/invariant seams, and run
+        # through the online anomaly detector (edge-triggered rules ->
+        # scheduler_timeline_anomalies_total + a flight-recorder
+        # postmortem).  The hook rides the commit tail AND the idle
+        # heartbeat path so quiet loops keep sampling; the <2% budget is
+        # pinned by perf_smoke.  Installed as the process default so
+        # /debug/timeline serves it unwired.
+        self.timeline = None
+        if self.config.timeline:
+            from kubernetes_tpu.runtime import timeline as timeline_mod
+
+            self.timeline = timeline_mod.TimelineStore(
+                interval_s=self.config.timeline_interval_s,
+                retention=self.config.timeline_retention,
+                detector=timeline_mod.AnomalyDetector(
+                    rules=self.config.timeline_rules,
+                    postmortem=self._postmortem,
+                ),
+            )
+            timeline_mod.set_default(self.timeline, replica=self._replica_id)
         # shed watermark (per-cycle deltas feed the goodput SLO) +
         # heartbeat clock + liveness totals (heartbeat line + bench)
         self._shed_seen = 0
@@ -1150,12 +1201,31 @@ class Scheduler:
         breaker/AIMD state + the metrics registry text.  State and
         metrics are passed as THUNKS: a shed storm hits this once per
         dropped pod, and throttled calls must cost ~nothing."""
-        self.flight_recorder.postmortem(
+        snap = self.flight_recorder.postmortem(
             trigger, detail,
             state=self._postmortem_state,
             metrics_text=m.REGISTRY.expose,
             in_flight=[self._cur_span] if self._cur_span is not None else None,
         )
+        # a fired postmortem is also a timeline annotation — riding the
+        # recorder's per-trigger throttle (snap is None inside the
+        # window), so a shed storm marks the timeline once, not once per
+        # pod.  The anomaly detector's own firings already annotate
+        # kind="anomaly" inside maybe_sample — don't double-mark those.
+        if snap is not None and not trigger.startswith("anomaly_"):
+            self._annotate("postmortem", f"{trigger}: {detail}",
+                           trigger=trigger)
+
+    def _annotate(self, kind: str, detail: str = "", **fields) -> None:
+        """Push one typed event onto the timeline store (no-op when the
+        timeline is off).  Annotation must never break the loop."""
+        tl = getattr(self, "timeline", None)  # None mid-__init__ too
+        if tl is None:
+            return
+        try:
+            tl.annotate(kind, detail, **fields)
+        except Exception as e:  # pragma: no cover - defensive
+            klog.errorf("timeline annotate failed: %s", e)
 
     def _postmortem_state(self) -> dict:
         """Point-in-time control-plane state for a postmortem snapshot —
@@ -1252,6 +1322,7 @@ class Scheduler:
         )
         if to == "open":
             self._postmortem("breaker_open", f"{frm} -> {to}")
+        self._annotate("breaker", f"{frm} -> {to}", to=to)
         m.LADDER_RUNG.set(float(self.RUNG_GAUGE[self.ladder_rung]))
 
     # ----------------------------------------- elastic degradation ladder
@@ -1303,6 +1374,8 @@ class Scheduler:
             reason,
             "device shard %d breaker %s -> %s", shard, frm, to,
         )
+        self._annotate("shard_breaker", f"shard {shard}: {frm} -> {to}",
+                       shard=shard, to=to)
 
     def _on_invariant_violation(self, rule: str, detail: str) -> None:
         """An invariant violation is the anomaly class the flight
@@ -1395,6 +1468,10 @@ class Scheduler:
         )
         if direction == "shrink":
             self._postmortem("mesh_shrink", reason)
+        self._annotate(
+            "mesh_rebuild", f"{direction}: {reason} ({width}/{full})",
+            direction=direction, width=width,
+        )
 
     def _retag_compile_cache(self) -> None:
         """Re-point the persistent compile cache at a partition for the
@@ -1544,6 +1621,11 @@ class Scheduler:
                     mega = min(cfg.megacycle_batches, mega * 2)
                 elif depth <= cur * mega // 2:
                     mega = max(1, mega // 2)
+        if cur != self._cur_batch:
+            self._annotate(
+                "aimd_resize", f"batch {self._cur_batch} -> {cur}",
+                batch=cur,
+            )
         self._cur_batch = cur
         if cfg.megacycle_batches > 1:
             self._cur_mega = mega
@@ -2834,6 +2916,23 @@ class Scheduler:
             finally:
                 m.CAPACITY_SECONDS.inc(time.perf_counter() - t_cap)
         m.PENDING_PODS.set(float(len(self.queue)))
+        # metrics timeline (ISSUE 20): the cadence-gated sampling sweep
+        # + online anomaly detection, AFTER every gauge above settled so
+        # the sample reads this cycle's truth.  Same discipline as the
+        # telemetry/quality/capacity hooks — never fails a committed
+        # cycle, cost stamped into its own counter (the <2% budget
+        # perf_smoke pins).  The idle path in run_once ticks the same
+        # store so quiet loops keep sampling.
+        if self.timeline is not None:
+            t_tl = time.perf_counter()
+            try:
+                self.timeline.maybe_sample()
+            except Exception as e:  # noqa: BLE001
+                klog.errorf(
+                    "timeline hook failed (cycle %d): %s", inf.cycle, e
+                )
+            finally:
+                m.TIMELINE_SECONDS.inc(time.perf_counter() - t_tl)
         self.results.extend(results)
         # slow-cycle log LAST, once the ENTIRE tail (ledger record +
         # telemetry included) has run: the span was finished above, so
@@ -3871,12 +3970,21 @@ class Scheduler:
             self.quality.heartbeat_fields()
             if self.quality is not None else (0.0, 0.0)
         )
+        # timeline satellites (ISSUE 20): anomaly firings so far + how
+        # far sampling lags its cadence — detection liveness on the same
+        # line as the loop's
+        tl = self.timeline
+        tl_anoms = (
+            tl.detector.anomalies_total
+            if tl is not None and tl.detector is not None else 0
+        )
+        tl_lag = tl.lag_s if tl is not None else 0.0
         klog.infof(
             "heartbeat: cycles=%d placed=%d unschedulable=%d depth=%d "
             "active=%d express=%d breaker=%s batch=%d hbm_bytes=%d "
             "mesh=%d rung=%s shards_lost=%d invariant_violations=%d "
             "host_ms=%d dev_ms=%d xfer_top=%s margin=%.4f regret=%.2f "
-            "replicas=%d conflicts=%d",
+            "replicas=%d conflicts=%d anomalies=%d timeline_lag_s=%.3f",
             q.scheduling_cycle,
             self._outcome_totals["placed"],
             self._outcome_totals["unschedulable"],
@@ -3892,6 +4000,7 @@ class Scheduler:
             int(host_ms), int(dev_ms), xfer_top,
             q_margin, q_regret,
             self._replica_of, self.conflicts_total,
+            tl_anoms, tl_lag,
         )
 
     def prewarm(self, widths: Optional[Sequence[int]] = None,
@@ -4120,6 +4229,20 @@ class Scheduler:
         drain the pipeline first so snapshots never go stale."""
         self._maybe_heartbeat()
         self._maybe_probe_shards()
+        # idle-path timeline tick (ISSUE 20): an empty queue must still
+        # sample — the commit tail only runs on committed cycles, and a
+        # quiet interval is exactly when a breaker/SLO excursion needs
+        # surrounding samples.  Cadence-gated inside the store, so a
+        # busy loop that just sampled in the commit tail pays one
+        # monotonic read here.
+        if self.timeline is not None:
+            t_tl = time.perf_counter()
+            try:
+                self.timeline.maybe_sample()
+            except Exception as e:  # noqa: BLE001
+                klog.errorf("timeline idle tick failed: %s", e)
+            finally:
+                m.TIMELINE_SECONDS.inc(time.perf_counter() - t_tl)
         t_pop = time.monotonic()
         express = self.config.express_lane
         # tiered mode only adds the kwarg (an express arrival interrupts

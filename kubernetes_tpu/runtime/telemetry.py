@@ -591,32 +591,30 @@ def _leaves_in_order(out):
     return [getattr(out, f.name) for f in dataclasses.fields(out)]
 
 
-# process-wide default (the flightrecorder.RECORDER pattern): the hub
-# /debug/cluster serves when none was wired explicitly; a Scheduler
-# built with config.telemetry installs its own here
-HUB = TelemetryHub()
+# process-wide default: the hub /debug/cluster serves when none was
+# wired explicitly; a Scheduler built with config.telemetry installs
+# its own here.  Replica 0 wins the process default, siblings register
+# alongside for /debug/replicas (runtime/defaults.py ProcessDefault —
+# the shared install/default/replica-registry discipline)
+from kubernetes_tpu.runtime.defaults import ProcessDefault  # noqa: E402
+
+_DEFAULT = ProcessDefault("telemetry", TelemetryHub)
 
 
 def get_default() -> TelemetryHub:
-    return HUB
-
-
-# per-replica installs (ISSUE 14 satellite): with N scheduler replicas
-# in one process, "install as the default" was last-writer-wins — the
-# surviving default misattributed every other replica's cycles.  Each
-# scheduler now installs under its replica id; replica 0 stays THE
-# process default (/debug/cluster primary payload, single-scheduler
-# behavior unchanged), and /debug/replicas rolls all of them up.
-_REPLICAS: dict = {}
+    return _DEFAULT.get()
 
 
 def set_default(hub: TelemetryHub, replica: int = 0) -> None:
-    global HUB
-    _REPLICAS[int(replica)] = hub
-    if int(replica) == 0:
-        HUB = hub
+    _DEFAULT.set(hub, replica)
 
 
 def replica_instances() -> dict:
     """{replica id: TelemetryHub} of every install this process saw."""
-    return dict(sorted(_REPLICAS.items()))
+    return _DEFAULT.replicas()
+
+
+def __getattr__(name):  # legacy alias: telemetry.HUB
+    if name == "HUB":
+        return _DEFAULT.get()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

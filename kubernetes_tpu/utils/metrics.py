@@ -1047,5 +1047,121 @@ REPLICA_REQUEUED = REGISTRY.register(
     )
 )
 
+# --- metrics timeline store (ISSUE 20: runtime/timeline.py) ---
+# the longitudinal layer: every family above is a point-in-time
+# snapshot; the timeline store samples them all on a cadence into
+# bounded series, so a scenario/autoscaler run leaves a queryable
+# trajectory instead of only terminal numbers
+TIMELINE_SAMPLES = REGISTRY.register(
+    Counter(
+        "scheduler_timeline_samples_total",
+        "Sampling sweeps the timeline store completed over the whole "
+        "metric registry (one sweep touches every family)",
+    )
+)
+TIMELINE_SECONDS = REGISTRY.register(
+    Counter(
+        "scheduler_timeline_seconds_total",
+        "Cumulative seconds the scheduling thread spent inside the "
+        "timeline hook (sampling sweep + anomaly detection) — the "
+        "<2%-of-cycle-wall budget perf_smoke pins",
+    )
+)
+TIMELINE_LAG = REGISTRY.register(
+    Gauge(
+        "scheduler_timeline_lag_seconds",
+        "How far the last sampling sweep ran behind its configured "
+        "cadence (0 = on time; sampling falling behind is itself a "
+        "signal, surfaced on the heartbeat line)",
+    )
+)
+TIMELINE_SERIES = REGISTRY.register(
+    Gauge(
+        "scheduler_timeline_series",
+        "Live series the timeline store currently retains (one per "
+        "sampled family child / histogram quantile)",
+    )
+)
+TIMELINE_EVENTS = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_timeline_events_total",
+        "Typed event annotations pushed into the timeline, by kind "
+        "(breaker/shard transitions, mesh rebuilds, AIMD resizes, "
+        "autoscaler rounds, SLO burns, shed bursts, chaos windows)",
+        ("kind",),
+        max_children=32,
+    )
+)
+TIMELINE_ANOMALIES = REGISTRY.register(
+    LabeledCounter(
+        "scheduler_timeline_anomalies_total",
+        "Anomaly-rule firings over sampled series, by rule and series "
+        "(threshold / zscore / slope; each firing is throttled and "
+        "re-arms only after the series recovers)",
+        ("rule", "series"),
+        max_children=64,
+    )
+)
+
+
+def sample_families(registry: Optional[Registry] = None,
+                    quantiles: Tuple[float, ...] = (0.5, 0.99),
+                    ) -> List[Tuple[str, str, float]]:
+    """One sampling sweep over every registered family, flattened to
+    (series, kind, value) triples — THE timeline sampling protocol
+    (runtime/timeline.py TimelineStore calls this on its cadence):
+
+    - Counter           -> ("name", "counter", value): the store keeps
+                           per-sample deltas, so rates fall out of the
+                           timestamps
+    - Gauge             -> ("name", "gauge", value)
+    - Labeled families  -> one triple per live child, named with the
+                           exposition label syntax: 'name{k="v",...}'
+    - Histogram         -> ('name:p50'/'name:p99' gauges via the
+                           interpolating quantile estimator) +
+                           ('name:count', 'counter', total)
+    - LabeledHistogram  -> the same per child: 'name{k="v"}:p99'
+
+    Kinds mirror the exposition TYPE line because the store treats them
+    differently: counters are monotone (delta-encoded), gauges are not.
+    """
+    reg = registry if registry is not None else REGISTRY
+    with reg._lock:
+        families = list(reg._metrics.values())
+    out: List[Tuple[str, str, float]] = []
+
+    def _lbl(names: Tuple[str, ...], key: Tuple[str, ...]) -> str:
+        return "{" + ",".join(
+            f'{n}="{v}"' for n, v in zip(names, key)
+        ) + "}"
+
+    def _hist(name: str, h: Histogram) -> None:
+        for q in quantiles:
+            out.append((f"{name}:p{int(q * 100)}", "gauge", h.quantile(q)))
+        out.append((f"{name}:count", "counter", float(h.total)))
+
+    for fam in families:
+        if isinstance(fam, LabeledHistogram):
+            with fam._lock:
+                children = sorted(fam._children.items())
+            for key, h in children:
+                _hist(fam.name + _lbl(fam.label_names, key), h)
+        elif isinstance(fam, Histogram):
+            _hist(fam.name, fam)
+        elif isinstance(fam, (LabeledGauge, LabeledCounter)):
+            kind = "gauge" if isinstance(fam, LabeledGauge) else "counter"
+            with fam._lock:
+                children = sorted(fam._children.items())
+            for key, v in children:
+                out.append(
+                    (fam.name + _lbl(fam.label_names, key), kind, float(v))
+                )
+        elif isinstance(fam, Gauge):
+            out.append((fam.name, "gauge", float(fam.value)))
+        elif isinstance(fam, Counter):
+            out.append((fam.name, "counter", float(fam.value)))
+    return out
+
+
 # schedule_attempts_total result label values (metrics.go:44-52)
 SCHEDULED, UNSCHEDULABLE, SCHEDULE_ERROR = "scheduled", "unschedulable", "error"

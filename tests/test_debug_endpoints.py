@@ -80,6 +80,14 @@ def _check_shapes(bodies: dict):
     # the profile body reports an outcome either way (started, throttled,
     # in-progress, or unsupported) — never raises into a 500
     assert isinstance(bodies["/debug/profile"], dict)
+    # metrics timeline store (ISSUE 20): registered through the SAME
+    # shared table, so it must answer on both servers with the
+    # summary/detector/series/events payload shape
+    tl = bodies["/debug/timeline"]
+    assert {"summary", "detector", "series", "events",
+            "anomalies"} <= set(tl)
+    assert {"samples", "series", "interval_s",
+            "retention"} <= set(tl["summary"])
 
 
 def test_debug_index_walk_on_health_server(_no_real_profiler):

@@ -322,6 +322,33 @@ def test_metrics_exposition_valid_after_mixed_live_workload():
     for fam in ("scheduler_capacity_absorbed_pods",
                 "scheduler_capacity_drainable_nodes"):
         assert families[fam]["type"] == "gauge"
+    # ISSUE 20 satellites: the timeline families survive the strict
+    # parser WITH live values — the commit tail sampled at least once
+    # (samples + cost counters, series gauge), and the degraded cycle /
+    # breaker trip pushed typed event annotations through the seams
+    assert (
+        families["scheduler_timeline_samples_total"]["samples"][0][2] > 0
+    )
+    assert (
+        families["scheduler_timeline_seconds_total"]["type"] == "counter"
+    )
+    assert (
+        families["scheduler_timeline_seconds_total"]["samples"][0][2] > 0
+    )
+    assert families["scheduler_timeline_series"]["samples"][0][2] > 0
+    assert families["scheduler_timeline_lag_seconds"]["type"] == "gauge"
+    ev_kinds = {
+        lbl["kind"]: v
+        for _, lbl, v in
+        families["scheduler_timeline_events_total"]["samples"]
+        if v > 0
+    }
+    assert "postmortem" in ev_kinds, ev_kinds
+    assert "breaker" in ev_kinds, ev_kinds
+    assert (
+        families["scheduler_timeline_anomalies_total"]["type"]
+        == "counter"
+    )
 
 
 def test_quality_family_cardinality_bounded():

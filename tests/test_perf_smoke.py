@@ -408,3 +408,61 @@ def test_attribution_launch_overhead_bounded():
         f"attribution launch {timings[True] * 1000:.1f}ms vs plain "
         f"{timings[False] * 1000:.1f}ms: reductions no longer fuse"
     )
+
+
+@pytest.mark.perf_smoke
+def test_timeline_sampling_overhead_under_2_percent():
+    """ISSUE 20 acceptance: the timeline hook (full-registry sampling
+    sweep + anomaly-rule evaluation, at a cadence 20x the default so
+    the pin exercises real sweeps, not the gate) must cost the
+    scheduling thread <2% of cycle wall at perf_smoke scale.  Same
+    budget discipline as the span/telemetry/perfobs/quality/capacity
+    pins: the hook's own cumulative counter — stamped around BOTH the
+    commit-tail sweep and the idle-path tick — is ratioed against the
+    run's wall clock, so the pin is machine-speed independent."""
+    from kubernetes_tpu.utils import metrics as m
+
+    enc = SnapshotEncoder()
+    enc.add_nodes(_nodes())
+    cache = SchedulerCache(enc)
+    queue = PriorityQueue()
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=lambda pod, node: True,
+        config=SchedulerConfig(
+            batch_size=BATCH, batch_window_s=0.0, engine="speculative",
+            disable_preemption=True, batched_commit=True,
+            pipeline_commit=True,
+            timeline_interval_s=0.05,  # 20x the default cadence
+        ),
+    )
+    assert sched.timeline is not None  # always-on default
+
+    def drain(budget_s):
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            got = sched.run_once(timeout=0.0)
+            if got == 0 and not sched.pipeline_pending:
+                if not queue.has_schedulable():
+                    break
+                time.sleep(0.002)
+        sched.flush_pipeline()
+
+    for j in range(BATCH):
+        queue.add(make_pod(f"warm-{j}", cpu="50m", mem="64Mi"))
+    drain(120)
+    spent0 = float(m.TIMELINE_SECONDS.value)
+    t0 = time.monotonic()
+    for i in range(N_PODS):
+        queue.add(make_pod(f"p-{i}", cpu="50m", mem="64Mi",
+                           labels={"app": f"d-{i % 10}"}))
+    drain(120)
+    wall = time.monotonic() - t0
+    spent = float(m.TIMELINE_SECONDS.value) - spent0
+    # the store actually sampled the run (cadence-gated sweeps landed)
+    assert sched.timeline.samples_total >= 2
+    ratio = spent / wall
+    assert ratio < 0.02, (
+        f"timeline hook cost {spent * 1000:.1f}ms of "
+        f"{wall * 1000:.0f}ms ({ratio * 100:.2f}%) — the sampling sweep "
+        f"or the rule evaluation is leaking onto the hot path"
+    )

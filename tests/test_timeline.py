@@ -1,0 +1,501 @@
+"""Metrics timeline store + online anomaly detection (ISSUE 20).
+
+Unit coverage for runtime/timeline.py: deterministic sampling under a
+fake clock (counters as deltas with first-sighting baselines, gauges as
+values, histograms as quantiles, cadence gating, lag accounting),
+edge-triggered anomaly rules (a seeded storm fires exactly once, a
+healthy run stays quiet, recovery re-arms), the /debug/timeline query
+contract (?series=&window=&step=&limit=) over HTTP on the health
+server, the JSONL export round trip, the static HTML report, and the
+scheduler integration seams (commit-tail + idle sampling, event
+annotations, the heartbeat's anomalies=/timeline_lag_s= fields).
+"""
+
+import json
+import logging
+import time
+import urllib.request
+
+from kubernetes_tpu.runtime import timeline as timeline_mod
+from kubernetes_tpu.runtime.timeline import (
+    AnomalyDetector,
+    TimelineStore,
+    load_jsonl,
+    render_html,
+)
+from kubernetes_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+
+from fixtures import make_node, make_pod
+
+
+class _Clock:
+    """A hand-advanced monotonic clock: sampling becomes deterministic."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def _store(clock, registry, rules=None, postmortem=None, **kw):
+    kw.setdefault("interval_s", 1.0)
+    kw.setdefault("retention", 64)
+    det = AnomalyDetector(rules=rules if rules is not None else [],
+                          postmortem=postmortem)
+    return TimelineStore(clock=clock, registry=registry, detector=det,
+                         **kw)
+
+
+# ------------------------------------------------------ deterministic sampling
+
+
+def test_sampling_counters_as_deltas_gauges_as_values():
+    reg = Registry()
+    c = reg.register(Counter("t_jobs_total"))
+    g = reg.register(Gauge("t_depth"))
+    clk = _Clock()
+    st = _store(clk, reg)
+
+    c.inc(100.0)  # pre-existing cumulative total BEFORE the first sweep
+    g.set(7.0)
+    assert st.maybe_sample() is True
+    # first sighting establishes the baseline: a counter that already
+    # accumulated 100 must not read as a spike
+    assert st.series_points("t_jobs_total") == [(100.0, 0.0)]
+    assert st.series_points("t_depth") == [(100.0, 7.0)]
+
+    # inside the cadence window: gated, nothing recorded
+    clk.advance(0.5)
+    c.inc(5.0)
+    assert st.maybe_sample() is False
+    assert st.samples_total == 1
+
+    clk.advance(0.5)  # exactly one interval since the last sweep
+    g.set(9.0)
+    assert st.maybe_sample() is True
+    assert st.series_points("t_jobs_total")[-1] == (101.0, 5.0)
+    assert st.series_points("t_depth")[-1] == (101.0, 9.0)
+    assert st.lag_s == 0.0
+
+    # a late sweep records its lag (sampling falling behind is a signal)
+    clk.advance(2.5)
+    assert st.maybe_sample() is True
+    assert abs(st.lag_s - 1.5) < 1e-9
+    assert st.samples_total == 3
+    assert st._kinds["t_jobs_total"] == "counter"
+    assert st._kinds["t_depth"] == "gauge"
+
+
+def test_sampling_histogram_quantiles():
+    reg = Registry()
+    h = reg.register(Histogram("t_lat_seconds"))
+    for v in (0.01, 0.02, 0.03, 0.04, 4.0):
+        h.observe(v)
+    st = _store(_Clock(), reg)
+    assert st.maybe_sample()
+    names = st.series_names()
+    assert "t_lat_seconds:p50" in names
+    assert "t_lat_seconds:p99" in names
+    assert "t_lat_seconds:count" in names
+    p50 = st.series_points("t_lat_seconds:p50")[0][1]
+    p99 = st.series_points("t_lat_seconds:p99")[0][1]
+    assert 0.0 < p50 < 0.1, p50        # the small cluster
+    assert p99 > 1.0, p99              # the outlier
+    # :count rides the counter encoding — first sighting = baseline 0
+    assert st.series_points("t_lat_seconds:count")[0][1] == 0.0
+    assert st._kinds["t_lat_seconds:count"] == "counter"
+
+
+def test_retention_bounds_series_and_events():
+    reg = Registry()
+    g = reg.register(Gauge("t_depth"))
+    clk = _Clock()
+    st = _store(clk, reg, retention=8)
+    for i in range(32):
+        g.set(float(i))
+        st.maybe_sample()
+        st.annotate("tick", str(i))
+        clk.advance(1.0)
+    assert len(st.series_points("t_depth")) == 8
+    assert len(st.events()) == 8
+    assert st.events()[-1]["detail"] == "31"
+
+
+# ----------------------------------------------------------- anomaly rules
+
+
+def test_threshold_storm_fires_exactly_once_then_rearms():
+    """A seeded chaos storm — the watched counter moving every sweep —
+    fires the rule ONCE (edge-triggered); recovery re-arms it; a second
+    storm fires again.  The postmortem callback rides the same edge."""
+    reg = Registry()
+    c = reg.register(Counter("t_errors_total"))
+    pm = []
+    clk = _Clock()
+    st = _store(
+        clk, reg,
+        rules=[{"rule": "threshold", "series": "t_errors_total",
+                "op": ">", "value": 0.0, "name": "errors"}],
+        postmortem=lambda trig, det: pm.append((trig, det)),
+    )
+    st.maybe_sample()  # baseline sweep
+
+    for _ in range(5):  # the storm: one error per interval
+        clk.advance(1.0)
+        c.inc()
+        st.maybe_sample()
+    assert st.detector.anomalies_total == 1
+    assert len(pm) == 1
+    assert pm[0][0] == "anomaly_errors"
+    assert "t_errors_total" in pm[0][1]
+    assert len(st.anomalies()) == 1
+    kinds = [e["kind"] for e in st.events()]
+    assert kinds.count("anomaly") == 1
+
+    for _ in range(3):  # quiet: delta 0 -> condition false -> re-arm
+        clk.advance(1.0)
+        st.maybe_sample()
+    assert st.detector.anomalies_total == 1
+
+    clk.advance(1.0)  # second storm: fires again
+    c.inc()
+    st.maybe_sample()
+    assert st.detector.anomalies_total == 2
+    assert len(pm) == 2
+
+
+def test_default_rules_quiet_on_healthy_run():
+    """The shipped DEFAULT_RULES are quiet by construction on a healthy
+    trajectory: static degraded/invariant counters, stable queue depth
+    with ordinary jitter."""
+    reg = Registry()
+    deg = reg.register(Counter("scheduler_degraded_cycles_total"))
+    reg.register(Counter("scheduler_invariant_violations_total"))
+    pend = reg.register(Gauge("scheduler_pending_pods"))
+    deg.inc(3.0)  # pre-existing totals from before the store attached
+    clk = _Clock()
+    st = TimelineStore(clock=clk, registry=reg, interval_s=1.0,
+                       retention=256, detector=AnomalyDetector())
+    for i in range(128):
+        pend.set(50.0 + (i % 7))  # healthy jitter
+        st.maybe_sample()
+        clk.advance(1.0)
+    assert st.detector.anomalies_total == 0
+    assert st.anomalies() == []
+
+
+def test_zscore_rule_fires_on_spike():
+    reg = Registry()
+    pend = reg.register(Gauge("scheduler_pending_pods"))
+    clk = _Clock()
+    st = _store(
+        clk, reg,
+        rules=[{"rule": "zscore", "series": "scheduler_pending_pods",
+                "window": 16, "z": 4.0, "min_samples": 8}],
+    )
+    for i in range(20):
+        pend.set(50.0 + (i % 3))
+        st.maybe_sample()
+        clk.advance(1.0)
+    assert st.detector.anomalies_total == 0
+    pend.set(5000.0)  # the spike
+    st.maybe_sample()
+    assert st.detector.anomalies_total == 1
+    assert st.anomalies()[-1]["series"] == "scheduler_pending_pods"
+
+
+def test_slope_rule_fires_on_sustained_climb():
+    reg = Registry()
+    g = reg.register(Gauge("t_backlog"))
+    clk = _Clock()
+    st = _store(
+        clk, reg,
+        rules=[{"rule": "slope", "series": "t_backlog", "window": 8,
+                "per_second": 5.0, "min_samples": 4}],
+    )
+    for i in range(4):  # flat: no fire
+        g.set(10.0)
+        st.maybe_sample()
+        clk.advance(1.0)
+    assert st.detector.anomalies_total == 0
+    for i in range(8):  # +10/s sustained climb
+        g.set(10.0 + 10.0 * i)
+        st.maybe_sample()
+        clk.advance(1.0)
+    assert st.detector.anomalies_total == 1
+
+
+def test_wildcard_series_pattern_covers_labeled_children():
+    reg = Registry()
+    a = reg.register(Counter("t_shed_total_a"))
+    reg.register(Counter("t_shed_total_b"))
+    clk = _Clock()
+    st = _store(
+        clk, reg,
+        rules=[{"rule": "threshold", "series": "t_shed_total_*",
+                "op": ">", "value": 0.0}],
+    )
+    st.maybe_sample()
+    clk.advance(1.0)
+    a.inc()
+    st.maybe_sample()
+    assert st.detector.anomalies_total == 1
+    assert st.anomalies()[0]["series"] == "t_shed_total_a"
+
+
+# ------------------------------------------------------------ query contract
+
+
+def test_debug_payload_query_contract():
+    reg = Registry()
+    g1 = reg.register(Gauge("t_alpha"))
+    g2 = reg.register(Gauge("t_beta"))
+    clk = _Clock(t=0.0)
+    st = _store(clk, reg, interval_s=1.0, retention=128)
+    for i in range(20):
+        g1.set(float(i))
+        g2.set(float(-i))
+        st.maybe_sample()
+        clk.advance(1.0)
+
+    # ?series= comma list with '*' prefix matching
+    body = st.debug_payload(query="series=t_al*")
+    assert set(body["series"]) == {"t_alpha"}
+    body = st.debug_payload(query="series=t_alpha,t_beta")
+    assert set(body["series"]) == {"t_alpha", "t_beta"}
+
+    # ?window= keeps only the trailing seconds (clock is at 20.0)
+    body = st.debug_payload(query="series=t_alpha&window=5")
+    pts = body["series"]["t_alpha"]["points"]
+    assert all(t >= 15.0 for t, _ in pts)
+    assert len(pts) == 5
+
+    # ?step= downsamples: one (newest) point per bucket
+    body = st.debug_payload(query="series=t_alpha&step=4")
+    pts = body["series"]["t_alpha"]["points"]
+    assert len(pts) == 5  # 20 samples / 4s buckets
+    assert pts[0][1] == 3.0  # the NEWEST point of bucket [0,4)
+
+    # limit bounds points per series
+    body = st.debug_payload(limit=3, query="series=t_alpha")
+    assert len(body["series"]["t_alpha"]["points"]) == 3
+
+
+def test_debug_timeline_over_http_on_health_server():
+    """The endpoint serves the process-default store on the health
+    server with the query contract intact (the both-servers walk lives
+    in test_debug_endpoints.py)."""
+    from kubernetes_tpu.runtime.defaults import ProcessDefault
+    from kubernetes_tpu.runtime.health import start_health_server
+
+    reg = Registry()
+    g = reg.register(Gauge("t_http_depth"))
+    clk = _Clock()
+    st = _store(clk, reg, interval_s=1.0)
+    for i in range(6):
+        g.set(float(i))
+        st.maybe_sample()
+        st.annotate("tick", str(i))
+        clk.advance(1.0)
+
+    prev = timeline_mod._DEFAULT
+    timeline_mod._DEFAULT = ProcessDefault("timeline", TimelineStore)
+    timeline_mod.set_default(st)
+    srv = start_health_server()
+    try:
+        h, p = srv.address
+        with urllib.request.urlopen(
+            f"http://{h}:{p}/debug/timeline"
+            f"?series=t_http_*&window=3&limit=2",
+            timeout=5,
+        ) as r:
+            assert r.status == 200
+            body = json.loads(r.read())
+    finally:
+        srv.stop()
+        timeline_mod._DEFAULT = prev
+    assert set(body["series"]) == {"t_http_depth"}
+    assert len(body["series"]["t_http_depth"]["points"]) <= 2
+    assert body["summary"]["samples"] == 6
+    assert len(body["events"]) <= 2
+
+
+# ------------------------------------------------------------ export / HTML
+
+
+def test_jsonl_roundtrip_and_html_report(tmp_path):
+    reg = Registry()
+    c = reg.register(Counter("t_cycles_total"))
+    g = reg.register(Gauge("t_width"))
+    pm = []
+    clk = _Clock()
+    st = _store(
+        clk, reg,
+        rules=[{"rule": "threshold", "series": "t_cycles_total",
+                "op": ">", "value": 2.0, "name": "burst"}],
+        postmortem=lambda t, d: pm.append(t),
+    )
+    for i in range(10):
+        c.inc(4.0 if i == 6 else 1.0)  # one burst -> one anomaly
+        g.set(float(i % 4))
+        st.maybe_sample()
+        clk.advance(1.0)
+    st.annotate("chaos", "window start", edge="start")
+    st.annotate("chaos", "window end", edge="end")
+    assert st.detector.anomalies_total == 1
+
+    path = str(tmp_path / "timeline.jsonl")
+    n = st.export_jsonl(path)
+    # meta + 2 series + events (anomaly annotation + 2 chaos) + 1 anomaly
+    assert n == 1 + 2 + 3 + 1
+
+    loaded = load_jsonl(path)
+    live = st.debug_payload()
+    assert set(loaded["series"]) == set(live["series"])
+    assert loaded["series"]["t_cycles_total"]["points"] == (
+        live["series"]["t_cycles_total"]["points"]
+    )
+    assert loaded["series"]["t_cycles_total"]["kind"] == "counter"
+    # the nested-envelope encoding preserves each event's OWN kind
+    assert [e["kind"] for e in loaded["events"]] == (
+        [e["kind"] for e in live["events"]]
+    )
+    assert loaded["anomalies"][0]["rule"] == "burst"
+    assert loaded["summary"]["samples"] == 10
+
+    for payload in (live, loaded):  # renders live OR offline
+        html = render_html(payload, title="t <report>")
+        assert "<svg" in html
+        assert "t_cycles_total" in html
+        assert "t &lt;report&gt;" in html  # title escaped
+        assert "chaos" in html
+        assert "burst" in html             # anomaly listed
+
+
+# ----------------------------------------------------- scheduler integration
+
+
+def _live_scheduler(**cfg_kw):
+    from kubernetes_tpu.runtime.cache import SchedulerCache
+    from kubernetes_tpu.runtime.queue import PodBackoff, PriorityQueue
+    from kubernetes_tpu.runtime.scheduler import Scheduler, SchedulerConfig
+
+    cache = SchedulerCache()
+    cache.add_node(make_node("tl-node-0", cpu="16", mem="64Gi"))
+    cache.add_node(make_node("tl-node-1", cpu="16", mem="64Gi"))
+    queue = PriorityQueue(
+        backoff=PodBackoff(initial=0.01, max_duration=0.05)
+    )
+    cfg_kw.setdefault("disable_preemption", True)
+    return Scheduler(
+        cache=cache, queue=queue, binder=lambda p, n: True,
+        config=SchedulerConfig(**cfg_kw),
+    )
+
+
+def test_scheduler_samples_from_commit_tail_and_idle_path():
+    s = _live_scheduler(timeline_interval_s=0.0)  # every opportunity
+    assert s.timeline is not None
+    # the constructed store is the process default (replica 0)
+    assert timeline_mod.get_default() is s.timeline
+    for i in range(4):
+        s.queue.add(make_pod(f"tl-{i}", cpu="100m"))
+    s.run_once(timeout=0.3)
+    after_commit = s.timeline.samples_total
+    assert after_commit >= 1
+    assert "scheduler_pending_pods" in s.timeline.series_names()
+    s.run_once(timeout=0.0)  # idle poll: the run_once head still ticks
+    assert s.timeline.samples_total > after_commit
+    from kubernetes_tpu.utils import metrics as m
+
+    assert float(m.TIMELINE_SAMPLES.value) > 0
+    assert float(m.TIMELINE_SECONDS.value) > 0
+
+
+def test_scheduler_timeline_off_removes_the_hook():
+    s = _live_scheduler(timeline=False)
+    assert s.timeline is None
+    s.queue.add(make_pod("tl-off", cpu="100m"))
+    s.run_once(timeout=0.3)  # no hook, no crash
+
+
+def test_aimd_resize_annotates_timeline():
+    s = _live_scheduler(
+        timeline_interval_s=1000.0,  # isolate annotations from sweeps
+        adaptive_batch=True, batch_size=64, batch_size_min=8,
+    )
+    for i in range(24):
+        s.queue.add(make_pod(f"tl-aimd-{i}", cpu="10m"))
+    for _ in range(6):
+        s.run_once(timeout=0.2)
+    kinds = {e["kind"] for e in s.timeline.events()}
+    assert "aimd_resize" in kinds, kinds
+
+
+def test_heartbeat_line_carries_timeline_fields():
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = logging.getLogger("kubernetes_tpu")
+    handler = _Capture(level=logging.INFO)
+    logger.addHandler(handler)
+    old_level = logger.level
+    logger.setLevel(logging.INFO)
+    try:
+        s = _live_scheduler(heartbeat_s=0.01, timeline_interval_s=0.0)
+        s.queue.add(make_pod("tl-hb", cpu="100m"))
+        s.run_once(timeout=0.3)
+        time.sleep(0.02)
+        s.run_once(timeout=0.0)  # idle poll fires the heartbeat
+        beats = [r for r in records if r.startswith("heartbeat:")]
+        assert beats, "no heartbeat line"
+        line = beats[-1]
+        assert "anomalies=" in line, line
+        assert "timeline_lag_s=" in line, line
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(old_level)
+
+
+def test_scenario_chaos_windows_align_with_samples():
+    """The acceptance pin: a scenario-banked timeline artifact carries
+    chaos-window annotations aligned (±1 sample interval) with the
+    sampled series around them."""
+    from kubernetes_tpu.runtime.scenario import run_scenario
+
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "scenario-timeline.jsonl")
+        res = run_scenario(
+            "drain", seed=0, pods=60, nodes=8, rate=240.0,
+            timeline_path=path,
+        )
+        assert res.lost == 0 and res.violations == 0
+        payload = load_jsonl(path)
+    chaos = [e for e in payload["events"] if e["kind"] == "chaos"]
+    assert {e["edge"] for e in chaos} == {"start", "end"}
+    interval = payload["summary"]["interval_s"]
+    pts = payload["series"]["scheduler_pending_pods"]["points"]
+    ts = [t for t, _ in pts]
+    assert len(ts) >= 2
+    for e in chaos:
+        # each window edge lands within one sample interval of a real
+        # sample OR beyond the final sample (the drain tail)
+        near = min(abs(e["t"] - t) for t in ts)
+        assert near <= interval + 1e-6 or e["t"] > ts[-1], (
+            e, near, interval
+        )
